@@ -1,0 +1,29 @@
+"""nebula-tpu: a TPU-native distributed property-graph database framework.
+
+Brand-new implementation with the capabilities of NebulaGraph v1.x
+(reference: shunpeizhang/nebula): a partitioned, Raft-replicated
+property-graph store with an nGQL-style query language, a three-service
+topology (stateless query engine / meta catalog / partitioned storage),
+and a pluggable storage-engine seam.
+
+The query hot path — multi-hop neighbor expansion (GO) and path search
+(FIND SHORTEST PATH) — is offloaded to TPU via JAX/XLA: partition edge
+lists are laid out as CSR arrays in device memory, BFS frontiers are
+advanced with dense-mask scatter/gather under `lax.fori_loop`, and
+cross-partition frontier exchange maps to `lax.all_to_all` over the ICI
+mesh (see `nebula_tpu.engine_tpu`).
+
+Layer map (mirrors reference layers, re-designed TPU-first; see SURVEY.md §1):
+  common/     Status codes, key codec, stats, config   (ref: src/common/)
+  codec/      row/schema codec                         (ref: src/dataman/)
+  parser/     nGQL lexer + recursive-descent parser    (ref: src/parser/)
+  filter/     expression trees, eval + device compile  (ref: src/common/filter/)
+  kvstore/    KV engines, WAL, Raft consensus          (ref: src/kvstore/)
+  storage/    storage processors + client              (ref: src/storage/)
+  meta/       catalog, schemas, balancer, heartbeats   (ref: src/meta/)
+  graph/      session, execution engine, executors     (ref: src/graph/)
+  engine_tpu/ CSR shards + device traversal kernels    (new: the TPU engine)
+  rpc/        wire transport for multi-process deploy  (ref: fbthrift seam)
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,2 @@
+from .schema import PropType, SchemaField, Schema  # noqa: F401
+from .row import RowWriter, RowReader, RowUpdater, RowSetWriter, RowSetReader  # noqa: F401

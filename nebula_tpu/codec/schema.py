@@ -1,0 +1,138 @@
+"""Property schemas.
+
+Role parity with the reference's thrift `Schema`/`ColumnDef` types and
+`dataman/ResultSchemaProvider` / `meta/NebulaSchemaProvider`: a schema
+is an ordered list of typed, optionally-defaulted fields; tag/edge
+schemas are multi-versioned (monotonic SchemaVer) and may carry a TTL
+column (ref: meta/processors/schemaMan/, common.thrift:14-92).
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+
+class PropType(enum.IntEnum):
+    UNKNOWN = 0
+    BOOL = 1
+    INT = 2        # int64
+    VID = 3        # int64 vertex id
+    DOUBLE = 5
+    STRING = 6
+    TIMESTAMP = 7  # int64 seconds
+
+    @staticmethod
+    def from_name(name: str) -> "PropType":
+        name = name.strip().upper()
+        aliases = {
+            "BOOL": PropType.BOOL,
+            "INT": PropType.INT,
+            "INT64": PropType.INT,
+            "VID": PropType.VID,
+            "DOUBLE": PropType.DOUBLE,
+            "FLOAT": PropType.DOUBLE,
+            "STRING": PropType.STRING,
+            "TIMESTAMP": PropType.TIMESTAMP,
+        }
+        if name not in aliases:
+            raise ValueError(f"unknown property type {name!r}")
+        return aliases[name]
+
+    def is_fixed64(self) -> bool:
+        return self in (PropType.INT, PropType.VID, PropType.DOUBLE, PropType.TIMESTAMP)
+
+
+def default_for(t: PropType) -> Any:
+    if t == PropType.BOOL:
+        return False
+    if t in (PropType.INT, PropType.VID, PropType.TIMESTAMP):
+        return 0
+    if t == PropType.DOUBLE:
+        return 0.0
+    if t == PropType.STRING:
+        return ""
+    return None
+
+
+@dataclass
+class SchemaField:
+    name: str
+    type: PropType
+    nullable: bool = False
+    default: Optional[Any] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "type": int(self.type),
+                "nullable": self.nullable, "default": self.default}
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "SchemaField":
+        return SchemaField(d["name"], PropType(d["type"]), d.get("nullable", False),
+                           d.get("default"))
+
+
+@dataclass
+class Schema:
+    """An ordered field list with a version, plus optional TTL config."""
+
+    fields: List[SchemaField] = field(default_factory=list)
+    version: int = 0
+    ttl_col: Optional[str] = None
+    ttl_duration: int = 0  # seconds; 0 = disabled
+
+    def __post_init__(self) -> None:
+        self._index: Dict[str, int] = {f.name: i for i, f in enumerate(self.fields)}
+
+    # -- lookups -------------------------------------------------------
+    def num_fields(self) -> int:
+        return len(self.fields)
+
+    def field_index(self, name: str) -> int:
+        return self._index.get(name, -1)
+
+    def field_type(self, name: str) -> Optional[PropType]:
+        i = self.field_index(name)
+        return self.fields[i].type if i >= 0 else None
+
+    def has_field(self, name: str) -> bool:
+        return name in self._index
+
+    def names(self) -> List[str]:
+        return [f.name for f in self.fields]
+
+    # -- evolution -----------------------------------------------------
+    def with_added(self, new_fields: List[SchemaField]) -> "Schema":
+        for f in new_fields:
+            if self.has_field(f.name):
+                raise ValueError(f"field {f.name!r} already exists")
+        return Schema(self.fields + new_fields, self.version + 1,
+                      self.ttl_col, self.ttl_duration)
+
+    def with_dropped(self, names: List[str]) -> "Schema":
+        drop = set(names)
+        for n in drop:
+            if not self.has_field(n):
+                raise ValueError(f"field {n!r} not found")
+        return Schema([f for f in self.fields if f.name not in drop],
+                      self.version + 1, self.ttl_col, self.ttl_duration)
+
+    def with_changed(self, changed: List[SchemaField]) -> "Schema":
+        out = list(self.fields)
+        for c in changed:
+            i = self.field_index(c.name)
+            if i < 0:
+                raise ValueError(f"field {c.name!r} not found")
+            out[i] = c
+        return Schema(out, self.version + 1, self.ttl_col, self.ttl_duration)
+
+    # -- serialization (for meta catalog + RPC-shipped schemas) --------
+    def to_dict(self) -> Dict[str, Any]:
+        return {"version": self.version,
+                "fields": [f.to_dict() for f in self.fields],
+                "ttl_col": self.ttl_col, "ttl_duration": self.ttl_duration}
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Schema":
+        return Schema([SchemaField.from_dict(f) for f in d["fields"]],
+                      d.get("version", 0), d.get("ttl_col"), d.get("ttl_duration", 0))

@@ -1,0 +1,1 @@
+from .status import Status, StatusOr, ErrorCode, NebulaError  # noqa: F401

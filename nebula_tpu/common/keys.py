@@ -1,0 +1,187 @@
+"""Graph key codec: how vertices/edges map onto ordered KV keys.
+
+Role parity with the reference's `common/base/NebulaKeyUtils.{h,cpp}`
+(vertex key = type+part+vid+tag+version; edge key = type+part+src+etype+
+rank+dst+version, ref NebulaKeyUtils.h:14-21) — but a fresh layout
+designed for prefix-scan locality:
+
+  vertex : [part u32][0x01][vid i64*][tag i32*][ver u64]
+  edge   : [part u32][0x02][src i64*][etype i32*][rank i64*][dst i64*][ver u64]
+  system : [part u32][0x00][subkey u8]
+  uuid   : [part u32][0x03][name bytes]
+  index  : [part u32][0x04][...]
+
+All fields big-endian; signed fields (*) are stored with the sign bit
+flipped so that byte order == numeric order (the reference relies on
+int64 keys already being non-negative instead). The version field is
+`UINT64_MAX - now_micros` so the *newest* write sorts first within a
+(vid,tag) / (src,etype,rank,dst) group, matching the reference's
+decreasing time-based version trick (ref: storage/AddVerticesProcessor
+.cpp:32-35). In-edges are stored under the destination's partition with
+a negated edge type, mirroring the reference's +/- edge type convention.
+"""
+from __future__ import annotations
+
+import struct
+import time
+from typing import Optional, Tuple
+
+KIND_SYSTEM = 0x00
+KIND_VERTEX = 0x01
+KIND_EDGE = 0x02
+KIND_UUID = 0x03
+KIND_INDEX = 0x04
+
+_U32 = struct.Struct(">I")
+_U64 = struct.Struct(">Q")
+_I64_BIAS = 1 << 63
+_I32_BIAS = 1 << 31
+_U64_MAX = (1 << 64) - 1
+
+
+def _i64(v: int) -> bytes:
+    """Order-preserving encoding of a signed 64-bit int."""
+    return _U64.pack((v + _I64_BIAS) & _U64_MAX)
+
+
+def _d64(b: bytes) -> int:
+    return _U64.unpack(b)[0] - _I64_BIAS
+
+
+def _i32(v: int) -> bytes:
+    return _U32.pack((v + _I32_BIAS) & 0xFFFFFFFF)
+
+
+def _d32(b: bytes) -> int:
+    return _U32.unpack(b)[0] - _I32_BIAS
+
+
+def now_version() -> int:
+    """Decreasing, time-based version: newest sorts first."""
+    return _U64_MAX - time.time_ns() // 1000
+
+
+# --------------------------------------------------------------------------
+# vertex keys
+# --------------------------------------------------------------------------
+
+def vertex_key(part: int, vid: int, tag_id: int, version: Optional[int] = None) -> bytes:
+    if version is None:
+        version = now_version()
+    return _U32.pack(part) + bytes([KIND_VERTEX]) + _i64(vid) + _i32(tag_id) + _U64.pack(version)
+
+
+def vertex_prefix(part: int, vid: int, tag_id: Optional[int] = None) -> bytes:
+    p = _U32.pack(part) + bytes([KIND_VERTEX]) + _i64(vid)
+    if tag_id is not None:
+        p += _i32(tag_id)
+    return p
+
+
+def parse_vertex_key(key: bytes) -> Tuple[int, int, int, int]:
+    """-> (part, vid, tag_id, version)."""
+    part = _U32.unpack(key[0:4])[0]
+    vid = _d64(key[5:13])
+    tag = _d32(key[13:17])
+    ver = _U64.unpack(key[17:25])[0]
+    return part, vid, tag, ver
+
+
+# --------------------------------------------------------------------------
+# edge keys
+# --------------------------------------------------------------------------
+
+def edge_key(part: int, src: int, edge_type: int, rank: int, dst: int,
+             version: Optional[int] = None) -> bytes:
+    if version is None:
+        version = now_version()
+    return (_U32.pack(part) + bytes([KIND_EDGE]) + _i64(src) + _i32(edge_type)
+            + _i64(rank) + _i64(dst) + _U64.pack(version))
+
+
+def edge_prefix(part: int, src: int, edge_type: Optional[int] = None) -> bytes:
+    p = _U32.pack(part) + bytes([KIND_EDGE]) + _i64(src)
+    if edge_type is not None:
+        p += _i32(edge_type)
+    return p
+
+
+def edge_group_prefix(part: int, src: int, edge_type: int, rank: int, dst: int) -> bytes:
+    """Prefix identifying one logical edge (all versions)."""
+    return (_U32.pack(part) + bytes([KIND_EDGE]) + _i64(src) + _i32(edge_type)
+            + _i64(rank) + _i64(dst))
+
+
+def parse_edge_key(key: bytes) -> Tuple[int, int, int, int, int, int]:
+    """-> (part, src, edge_type, rank, dst, version)."""
+    part = _U32.unpack(key[0:4])[0]
+    src = _d64(key[5:13])
+    etype = _d32(key[13:17])
+    rank = _d64(key[17:25])
+    dst = _d64(key[25:33])
+    ver = _U64.unpack(key[33:41])[0]
+    return part, src, etype, rank, dst, ver
+
+
+def is_vertex_key(key: bytes) -> bool:
+    return len(key) >= 5 and key[4] == KIND_VERTEX
+
+
+def is_edge_key(key: bytes) -> bool:
+    return len(key) >= 5 and key[4] == KIND_EDGE
+
+
+# --------------------------------------------------------------------------
+# part-level prefixes & system keys
+# --------------------------------------------------------------------------
+
+def part_prefix(part: int) -> bytes:
+    return _U32.pack(part)
+
+def part_data_prefix(part: int, kind: int) -> bytes:
+    return _U32.pack(part) + bytes([kind])
+
+
+def system_commit_key(part: int) -> bytes:
+    """Persists (last committed log id, term) transactionally with data
+    (ref: kvstore/Part.cpp:350-356)."""
+    return _U32.pack(part) + bytes([KIND_SYSTEM, 0x01])
+
+
+def system_balance_key(part: int) -> bytes:
+    return _U32.pack(part) + bytes([KIND_SYSTEM, 0x02])
+
+
+def uuid_key(part: int, name: bytes) -> bytes:
+    return _U32.pack(part) + bytes([KIND_UUID]) + name
+
+
+def encode_commit_value(log_id: int, term: int) -> bytes:
+    return struct.pack(">qq", log_id, term)
+
+
+def decode_commit_value(v: bytes) -> Tuple[int, int]:
+    return struct.unpack(">qq", v)
+
+
+# --------------------------------------------------------------------------
+# partitioner
+# --------------------------------------------------------------------------
+
+def hash_vid(vid: int) -> int:
+    """64-bit mix hash (splitmix64 finalizer) — used for UUID allocation
+    and bucket spreading, NOT for partition routing (see part_id)."""
+    x = vid & _U64_MAX
+    x = (x + 0x9E3779B97F4A7C15) & _U64_MAX
+    x = ((x ^ (x >> 30)) * 0xBF58476D1CE4E5B9) & _U64_MAX
+    x = ((x ^ (x >> 27)) * 0x94D049BB133111EB) & _U64_MAX
+    return x ^ (x >> 31)
+
+
+def part_id(vid: int, num_parts: int) -> int:
+    """Partition ids are 1-based. Plain uint64-cast modulo, matching the
+    reference exactly (`static_cast<uint64_t>(id) % numShards + 1`, ref:
+    storage/client/StorageClient.cpp:10-11) — no hashing, which also keeps
+    the on-device owner-partition computation a single cheap `vid % P`.
+    """
+    return (vid & _U64_MAX) % num_parts + 1

@@ -1,0 +1,171 @@
+"""Status / StatusOr / error codes.
+
+Role parity with the reference's `common/base/Status.h` (Status/StatusOr)
+and the per-service ResultCode enums (storage.thrift, raftex.thrift):
+every cross-service boundary returns typed error codes rather than
+raising, so leader-redirects and partial failures can be handled per
+partition exactly like the reference's per-part ResultCode plumbing.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass
+from typing import Generic, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+class ErrorCode(enum.IntEnum):
+    """Unified error codes across services.
+
+    Mirrors the union of the reference's graph/storage/meta/raft error
+    enums (e.g. storage.thrift ErrorCode, meta.thrift ErrorCode) without
+    copying their numbering.
+    """
+
+    SUCCEEDED = 0
+    # generic
+    E_ERROR = -1
+    E_NOT_FOUND = -2
+    E_EXISTED = -3
+    E_INVALID_ARGUMENT = -4
+    E_UNSUPPORTED = -5
+    E_INTERNAL = -6
+    E_TIMEOUT = -7
+    # topology / routing
+    E_LEADER_CHANGED = -11
+    E_SPACE_NOT_FOUND = -12
+    E_PART_NOT_FOUND = -13
+    E_HOST_NOT_FOUND = -14
+    E_WRONG_PARTITION = -15
+    E_NO_HOSTS = -16
+    # schema
+    E_TAG_NOT_FOUND = -21
+    E_EDGE_NOT_FOUND = -22
+    E_SCHEMA_NOT_FOUND = -23
+    E_INVALID_SCHEMA_VER = -24
+    E_CONFLICT = -25
+    # storage
+    E_KEY_NOT_FOUND = -31
+    E_CONSENSUS_ERROR = -32
+    E_FILTER_OUT = -33
+    E_INVALID_FILTER = -34
+    E_INVALID_UPDATER = -35
+    E_INVALID_DATA = -36
+    E_CHECKPOINT_ERROR = -37
+    # raft
+    E_LOG_GAP = -41
+    E_LOG_STALE = -42
+    E_TERM_OUT_OF_DATE = -43
+    E_WAITING_SNAPSHOT = -44
+    E_BAD_STATE = -45
+    E_NOT_A_LEADER = -46
+    E_WAL_FAIL = -47
+    # session / auth
+    E_SESSION_INVALID = -51
+    E_BAD_USERNAME_PASSWORD = -52
+    E_BAD_PERMISSION = -53
+    # query
+    E_SYNTAX_ERROR = -61
+    E_EXECUTION_ERROR = -62
+    E_STATEMENT_EMPTY = -63
+    # balance
+    E_BALANCED = -71
+    E_BALANCER_RUNNING = -72
+    E_NO_VALID_HOST = -73
+    E_CORRUPTED_BALANCE_PLAN = -74
+
+
+class NebulaError(Exception):
+    """Raised when an in-process call fails and the caller asked to unwrap."""
+
+    def __init__(self, status: "Status"):
+        super().__init__(str(status))
+        self.status = status
+
+
+@dataclass(frozen=True)
+class Status:
+    code: ErrorCode = ErrorCode.SUCCEEDED
+    msg: str = ""
+
+    def ok(self) -> bool:
+        return self.code == ErrorCode.SUCCEEDED
+
+    def __bool__(self) -> bool:
+        return self.ok()
+
+    def __str__(self) -> str:
+        if self.ok():
+            return "OK"
+        return f"{self.code.name}: {self.msg}" if self.msg else self.code.name
+
+    # --- constructors -------------------------------------------------
+    @staticmethod
+    def OK() -> "Status":
+        return _OK
+
+    @staticmethod
+    def error(code: ErrorCode, msg: str = "") -> "Status":
+        return Status(code, msg)
+
+    @staticmethod
+    def syntax_error(msg: str) -> "Status":
+        return Status(ErrorCode.E_SYNTAX_ERROR, msg)
+
+    @staticmethod
+    def not_found(msg: str = "") -> "Status":
+        return Status(ErrorCode.E_NOT_FOUND, msg)
+
+    @staticmethod
+    def leader_changed(msg: str = "") -> "Status":
+        return Status(ErrorCode.E_LEADER_CHANGED, msg)
+
+
+_OK = Status()
+
+
+class StatusOr(Generic[T]):
+    """Either a value or a failure Status (ref: common/base/StatusOr.h)."""
+
+    __slots__ = ("_status", "_value")
+
+    def __init__(self, status: Status, value: Optional[T]):
+        self._status = status
+        self._value = value
+
+    @staticmethod
+    def of(value: T) -> "StatusOr[T]":
+        return StatusOr(_OK, value)
+
+    @staticmethod
+    def err(code: ErrorCode, msg: str = "") -> "StatusOr[T]":
+        return StatusOr(Status(code, msg), None)
+
+    @staticmethod
+    def from_status(status: Status) -> "StatusOr[T]":
+        assert not status.ok()
+        return StatusOr(status, None)
+
+    def ok(self) -> bool:
+        return self._status.ok()
+
+    def __bool__(self) -> bool:
+        return self.ok()
+
+    @property
+    def status(self) -> Status:
+        return self._status
+
+    def value(self) -> T:
+        if not self._status.ok():
+            raise NebulaError(self._status)
+        return self._value  # type: ignore[return-value]
+
+    def value_or(self, default: T) -> T:
+        return self._value if self._status.ok() else default  # type: ignore[return-value]
+
+    def __repr__(self) -> str:
+        if self.ok():
+            return f"StatusOr(OK, {self._value!r})"
+        return f"StatusOr({self._status})"

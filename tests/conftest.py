@@ -1,0 +1,16 @@
+"""Test harness config.
+
+Tests run on CPU with 8 virtual XLA devices so that multi-partition
+mesh/`all_to_all` paths are exercised without real multi-chip hardware
+(the reference's analogue: booting real servers in-process on ephemeral
+ports, ref graph/test/TestEnv.cpp:29-71). Must run before jax imports.
+"""
+import os
+import sys
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
