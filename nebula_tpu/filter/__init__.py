@@ -1,0 +1,8 @@
+from .expressions import (  # noqa: F401
+    Expression, Literal, FunctionCall, UnaryExpr, TypeCastExpr,
+    ArithmeticExpr, RelationalExpr, LogicalExpr, SourcePropExpr,
+    DestPropExpr, EdgePropExpr, EdgeSrcIdExpr, EdgeDstIdExpr,
+    EdgeRankExpr, EdgeTypeExpr, InputPropExpr, VariablePropExpr,
+    ExpressionContext, encode_expression, decode_expression, EvalError,
+)
+from .functions import FunctionManager  # noqa: F401

@@ -1,0 +1,644 @@
+"""Expression trees: parse-time AST, evaluation, and wire serialization.
+
+Role parity with the reference's `common/filter/Expressions.{h,cpp}`:
+16 expression kinds (ref: Expressions.h:329-344) covering literals,
+function calls, unary/arithmetic/relational/logical ops, type casts,
+and the nGQL property references:
+
+    $^.tag.prop     source-vertex property        (SourcePropExpr)
+    $$.tag.prop     destination-vertex property   (DestPropExpr)
+    edge.prop       edge property / alias prop    (EdgePropExpr)
+    _src _dst _rank _type   edge key fields       (EdgeSrcId/... exprs)
+    $-.col          pipe-input column             (InputPropExpr)
+    $var.col        stored-variable column        (VariablePropExpr)
+
+Two capabilities matter architecturally and are kept from the reference:
+
+1. **Serializability** (`encode_expression`/`decode_expression`): WHERE
+   filters cross the graphd→storaged RPC boundary in encoded form so
+   they can be evaluated storage-side ("filter pushdown", ref:
+   storage.thrift:159 + storage/QueryBaseProcessor.inl:146-167).
+
+2. **Pluggable getter context** (`ExpressionContext`): evaluation binds
+   property references to whatever the host has — RPC row readers in
+   the query engine, KV iterators in storage, columnar device arrays in
+   the TPU engine (which *compiles* the tree to vectorized masks
+   instead of evaluating per row; see engine_tpu/filter_compile.py).
+   (ref: graph/GoExecutor.cpp:849-945, storage/QueryBaseProcessor
+   .inl:415-443.)
+"""
+from __future__ import annotations
+
+import struct
+from typing import Any, Callable, List, Optional, Sequence
+
+from ..common.status import ErrorCode, Status
+
+Value = Any  # None | bool | int | float | str
+
+
+class EvalError(Exception):
+    def __init__(self, msg: str):
+        super().__init__(msg)
+        self.status = Status.error(ErrorCode.E_EXECUTION_ERROR, msg)
+
+
+class ExpressionContext:
+    """Getter closure bundle. Hosts override the getters they support."""
+
+    def get_input_prop(self, prop: str) -> Value:
+        raise EvalError(f"input prop $-.{prop} not available here")
+
+    def get_variable_prop(self, var: str, prop: str) -> Value:
+        raise EvalError(f"variable prop ${var}.{prop} not available here")
+
+    def get_src_prop(self, tag: str, prop: str) -> Value:
+        raise EvalError(f"source prop $^.{tag}.{prop} not available here")
+
+    def get_dst_prop(self, tag: str, prop: str) -> Value:
+        raise EvalError(f"dest prop $$.{tag}.{prop} not available here")
+
+    def get_edge_prop(self, edge: Optional[str], prop: str) -> Value:
+        raise EvalError(f"edge prop {edge}.{prop} not available here")
+
+    def get_edge_src(self, edge: Optional[str]) -> Value:
+        raise EvalError("_src not available here")
+
+    def get_edge_dst(self, edge: Optional[str]) -> Value:
+        raise EvalError("_dst not available here")
+
+    def get_edge_rank(self, edge: Optional[str]) -> Value:
+        raise EvalError("_rank not available here")
+
+    def get_edge_type_name(self, edge: Optional[str]) -> Value:
+        raise EvalError("_type not available here")
+
+
+# ---------------------------------------------------------------------------
+# AST nodes
+# ---------------------------------------------------------------------------
+
+class Expression:
+    KIND = 0
+
+    def eval(self, ctx: ExpressionContext) -> Value:
+        raise NotImplementedError
+
+    def to_string(self) -> str:
+        raise NotImplementedError
+
+    def children(self) -> Sequence["Expression"]:
+        return ()
+
+    def walk(self):
+        yield self
+        for c in self.children():
+            yield from c.walk()
+
+    def __repr__(self) -> str:
+        return f"<{type(self).__name__} {self.to_string()}>"
+
+
+class Literal(Expression):
+    KIND = 1
+
+    def __init__(self, value: Value):
+        self.value = value
+
+    def eval(self, ctx: ExpressionContext) -> Value:
+        return self.value
+
+    def to_string(self) -> str:
+        v = self.value
+        if v is None:
+            return "NULL"
+        if isinstance(v, bool):
+            return "true" if v else "false"
+        if isinstance(v, str):
+            escaped = v.replace("\\", "\\\\").replace('"', '\\"')
+            return f'"{escaped}"'
+        return repr(v)
+
+
+class FunctionCall(Expression):
+    KIND = 2
+
+    def __init__(self, name: str, args: List[Expression]):
+        self.name = name.lower()
+        self.args = args
+
+    def eval(self, ctx: ExpressionContext) -> Value:
+        from .functions import FunctionManager
+        vals = [a.eval(ctx) for a in self.args]
+        return FunctionManager.invoke(self.name, vals)
+
+    def to_string(self) -> str:
+        return f"{self.name}({', '.join(a.to_string() for a in self.args)})"
+
+    def children(self):
+        return self.args
+
+
+class UnaryExpr(Expression):
+    KIND = 3
+    OPS = ("+", "-", "!")
+
+    def __init__(self, op: str, operand: Expression):
+        assert op in self.OPS
+        self.op = op
+        self.operand = operand
+
+    def eval(self, ctx: ExpressionContext) -> Value:
+        v = self.operand.eval(ctx)
+        if self.op == "+":
+            _require_num(v, "unary +")
+            return v
+        if self.op == "-":
+            _require_num(v, "unary -")
+            return -v
+        return not _truthy(v)
+
+    def to_string(self) -> str:
+        return f"{self.op}({self.operand.to_string()})"
+
+    def children(self):
+        return (self.operand,)
+
+
+class TypeCastExpr(Expression):
+    KIND = 4
+    TYPES = ("int", "double", "string", "bool")
+
+    def __init__(self, type_name: str, operand: Expression):
+        self.type_name = type_name.lower()
+        self.operand = operand
+
+    def eval(self, ctx: ExpressionContext) -> Value:
+        v = self.operand.eval(ctx)
+        try:
+            if self.type_name == "int":
+                return int(v)
+            if self.type_name == "double":
+                return float(v)
+            if self.type_name == "string":
+                if isinstance(v, bool):
+                    return "true" if v else "false"
+                return str(v)
+            if self.type_name == "bool":
+                return _truthy(v)
+        except (TypeError, ValueError) as e:
+            raise EvalError(f"bad cast to {self.type_name}: {e}")
+        raise EvalError(f"unknown cast type {self.type_name}")
+
+    def to_string(self) -> str:
+        return f"({self.type_name}){self.operand.to_string()}"
+
+    def children(self):
+        return (self.operand,)
+
+
+class ArithmeticExpr(Expression):
+    KIND = 5
+    OPS = ("+", "-", "*", "/", "%", "^")
+
+    def __init__(self, op: str, left: Expression, right: Expression):
+        assert op in self.OPS
+        self.op = op
+        self.left = left
+        self.right = right
+
+    def eval(self, ctx: ExpressionContext) -> Value:
+        l = self.left.eval(ctx)
+        r = self.right.eval(ctx)
+        op = self.op
+        if op == "+":
+            if isinstance(l, str) or isinstance(r, str):
+                # string concat coerces the other side, like the reference
+                return _to_str(l) + _to_str(r)
+            _require_num(l, "+"); _require_num(r, "+")
+            return l + r
+        _require_num(l, op); _require_num(r, op)
+        if op == "-":
+            return l - r
+        if op == "*":
+            return l * r
+        if op == "/":
+            if r == 0:
+                raise EvalError("division by zero")
+            if isinstance(l, int) and isinstance(r, int):
+                return int(l / r)  # C-style truncation, not floor
+            return l / r
+        if op == "%":
+            if r == 0:
+                raise EvalError("modulo by zero")
+            if isinstance(l, int) and isinstance(r, int):
+                return l - int(l / r) * r  # C-style remainder
+            raise EvalError("% requires integers")
+        if op == "^":
+            return l ** r
+        raise AssertionError(op)
+
+    def to_string(self) -> str:
+        return f"({self.left.to_string()}{self.op}{self.right.to_string()})"
+
+    def children(self):
+        return (self.left, self.right)
+
+
+class RelationalExpr(Expression):
+    KIND = 6
+    OPS = ("==", "!=", "<", "<=", ">", ">=", "CONTAINS")
+
+    def __init__(self, op: str, left: Expression, right: Expression):
+        assert op in self.OPS
+        self.op = op
+        self.left = left
+        self.right = right
+
+    def eval(self, ctx: ExpressionContext) -> Value:
+        l = self.left.eval(ctx)
+        r = self.right.eval(ctx)
+        op = self.op
+        if op == "CONTAINS":
+            if not isinstance(l, str) or not isinstance(r, str):
+                raise EvalError("CONTAINS requires strings")
+            return r in l
+        if l is None or r is None:
+            # NULL comparisons: only == and != are defined
+            if op == "==":
+                return l is None and r is None
+            if op == "!=":
+                return (l is None) != (r is None)
+            return False
+        num_l = isinstance(l, (int, float)) and not isinstance(l, bool)
+        num_r = isinstance(r, (int, float)) and not isinstance(r, bool)
+        if num_l != num_r or (isinstance(l, str) != isinstance(r, str)):
+            if op == "==":
+                return False
+            if op == "!=":
+                return True
+            raise EvalError(f"incomparable operands for {op}: {l!r} vs {r!r}")
+        if op == "==":
+            return l == r
+        if op == "!=":
+            return l != r
+        if op == "<":
+            return l < r
+        if op == "<=":
+            return l <= r
+        if op == ">":
+            return l > r
+        if op == ">=":
+            return l >= r
+        raise AssertionError(op)
+
+    def to_string(self) -> str:
+        return f"({self.left.to_string()}{self.op}{self.right.to_string()})"
+
+    def children(self):
+        return (self.left, self.right)
+
+
+class LogicalExpr(Expression):
+    KIND = 7
+    OPS = ("&&", "||", "XOR")
+
+    def __init__(self, op: str, left: Expression, right: Expression):
+        assert op in self.OPS
+        self.op = op
+        self.left = left
+        self.right = right
+
+    def eval(self, ctx: ExpressionContext) -> Value:
+        l = _truthy(self.left.eval(ctx))
+        if self.op == "&&":
+            return l and _truthy(self.right.eval(ctx))
+        if self.op == "||":
+            return l or _truthy(self.right.eval(ctx))
+        return l != _truthy(self.right.eval(ctx))
+
+    def to_string(self) -> str:
+        return f"({self.left.to_string()}{self.op}{self.right.to_string()})"
+
+    def children(self):
+        return (self.left, self.right)
+
+
+class SourcePropExpr(Expression):
+    """$^.tag.prop"""
+    KIND = 8
+
+    def __init__(self, tag: str, prop: str):
+        self.tag = tag
+        self.prop = prop
+
+    def eval(self, ctx: ExpressionContext) -> Value:
+        return ctx.get_src_prop(self.tag, self.prop)
+
+    def to_string(self) -> str:
+        return f"$^.{self.tag}.{self.prop}"
+
+
+class DestPropExpr(Expression):
+    """$$.tag.prop"""
+    KIND = 9
+
+    def __init__(self, tag: str, prop: str):
+        self.tag = tag
+        self.prop = prop
+
+    def eval(self, ctx: ExpressionContext) -> Value:
+        return ctx.get_dst_prop(self.tag, self.prop)
+
+    def to_string(self) -> str:
+        return f"$$.{self.tag}.{self.prop}"
+
+
+class EdgePropExpr(Expression):
+    """edge.prop (edge may be None when only one edge type is in scope)."""
+    KIND = 10
+
+    def __init__(self, edge: Optional[str], prop: str):
+        self.edge = edge
+        self.prop = prop
+
+    def eval(self, ctx: ExpressionContext) -> Value:
+        return ctx.get_edge_prop(self.edge, self.prop)
+
+    def to_string(self) -> str:
+        return f"{self.edge}.{self.prop}" if self.edge else self.prop
+
+
+class EdgeSrcIdExpr(Expression):
+    KIND = 11
+
+    def __init__(self, edge: Optional[str] = None):
+        self.edge = edge
+
+    def eval(self, ctx: ExpressionContext) -> Value:
+        return ctx.get_edge_src(self.edge)
+
+    def to_string(self) -> str:
+        return f"{self.edge}._src" if self.edge else "_src"
+
+
+class EdgeDstIdExpr(Expression):
+    KIND = 12
+
+    def __init__(self, edge: Optional[str] = None):
+        self.edge = edge
+
+    def eval(self, ctx: ExpressionContext) -> Value:
+        return ctx.get_edge_dst(self.edge)
+
+    def to_string(self) -> str:
+        return f"{self.edge}._dst" if self.edge else "_dst"
+
+
+class EdgeRankExpr(Expression):
+    KIND = 13
+
+    def __init__(self, edge: Optional[str] = None):
+        self.edge = edge
+
+    def eval(self, ctx: ExpressionContext) -> Value:
+        return ctx.get_edge_rank(self.edge)
+
+    def to_string(self) -> str:
+        return f"{self.edge}._rank" if self.edge else "_rank"
+
+
+class EdgeTypeExpr(Expression):
+    KIND = 14
+
+    def __init__(self, edge: Optional[str] = None):
+        self.edge = edge
+
+    def eval(self, ctx: ExpressionContext) -> Value:
+        return ctx.get_edge_type_name(self.edge)
+
+    def to_string(self) -> str:
+        return f"{self.edge}._type" if self.edge else "_type"
+
+
+class InputPropExpr(Expression):
+    """$-.col"""
+    KIND = 15
+
+    def __init__(self, prop: str):
+        self.prop = prop
+
+    def eval(self, ctx: ExpressionContext) -> Value:
+        return ctx.get_input_prop(self.prop)
+
+    def to_string(self) -> str:
+        return f"$-.{self.prop}"
+
+
+class VariablePropExpr(Expression):
+    """$var.col"""
+    KIND = 16
+
+    def __init__(self, var: str, prop: str):
+        self.var = var
+        self.prop = prop
+
+    def eval(self, ctx: ExpressionContext) -> Value:
+        return ctx.get_variable_prop(self.var, self.prop)
+
+    def to_string(self) -> str:
+        return f"${self.var}.{self.prop}"
+
+
+# ---------------------------------------------------------------------------
+# helpers
+# ---------------------------------------------------------------------------
+
+def _truthy(v: Value) -> bool:
+    if isinstance(v, bool):
+        return v
+    if v is None:
+        return False
+    if isinstance(v, (int, float)):
+        return v != 0
+    raise EvalError(f"value {v!r} is not a boolean")
+
+
+def _require_num(v: Value, op: str) -> None:
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        raise EvalError(f"operator {op} requires a numeric operand, got {v!r}")
+
+
+def _to_str(v: Value) -> str:
+    if isinstance(v, str):
+        return v
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if v is None:
+        return "NULL"
+    return str(v)
+
+
+# ---------------------------------------------------------------------------
+# wire serialization (filter pushdown across the storage RPC boundary)
+# ---------------------------------------------------------------------------
+
+_VT_NULL, _VT_BOOL, _VT_INT, _VT_DOUBLE, _VT_STR = 0, 1, 2, 3, 4
+
+
+def _enc_value(buf: bytearray, v: Value) -> None:
+    if v is None:
+        buf.append(_VT_NULL)
+    elif isinstance(v, bool):
+        buf.append(_VT_BOOL)
+        buf.append(1 if v else 0)
+    elif isinstance(v, int):
+        buf.append(_VT_INT)
+        buf += struct.pack("<q", v)
+    elif isinstance(v, float):
+        buf.append(_VT_DOUBLE)
+        buf += struct.pack("<d", v)
+    elif isinstance(v, str):
+        b = v.encode("utf-8")
+        buf.append(_VT_STR)
+        buf += struct.pack("<I", len(b)) + b
+    else:
+        raise ValueError(f"cannot encode value {v!r}")
+
+
+def _dec_value(data: bytes, off: int):
+    t = data[off]
+    off += 1
+    if t == _VT_NULL:
+        return None, off
+    if t == _VT_BOOL:
+        return data[off] != 0, off + 1
+    if t == _VT_INT:
+        return struct.unpack_from("<q", data, off)[0], off + 8
+    if t == _VT_DOUBLE:
+        return struct.unpack_from("<d", data, off)[0], off + 8
+    if t == _VT_STR:
+        n = struct.unpack_from("<I", data, off)[0]
+        off += 4
+        return data[off:off + n].decode("utf-8"), off + n
+    raise ValueError(f"bad value tag {t}")
+
+
+def _enc_str(buf: bytearray, s: Optional[str]) -> None:
+    if s is None:
+        buf += struct.pack("<I", 0xFFFFFFFF)
+    else:
+        b = s.encode("utf-8")
+        buf += struct.pack("<I", len(b)) + b
+
+
+def _dec_str(data: bytes, off: int):
+    n = struct.unpack_from("<I", data, off)[0]
+    off += 4
+    if n == 0xFFFFFFFF:
+        return None, off
+    return data[off:off + n].decode("utf-8"), off + n
+
+
+def _encode_into(buf: bytearray, e: Expression) -> None:
+    buf.append(e.KIND)
+    if isinstance(e, Literal):
+        _enc_value(buf, e.value)
+    elif isinstance(e, FunctionCall):
+        _enc_str(buf, e.name)
+        buf.append(len(e.args))
+        for a in e.args:
+            _encode_into(buf, a)
+    elif isinstance(e, UnaryExpr):
+        _enc_str(buf, e.op)
+        _encode_into(buf, e.operand)
+    elif isinstance(e, TypeCastExpr):
+        _enc_str(buf, e.type_name)
+        _encode_into(buf, e.operand)
+    elif isinstance(e, (ArithmeticExpr, RelationalExpr, LogicalExpr)):
+        _enc_str(buf, e.op)
+        _encode_into(buf, e.left)
+        _encode_into(buf, e.right)
+    elif isinstance(e, (SourcePropExpr, DestPropExpr)):
+        _enc_str(buf, e.tag)
+        _enc_str(buf, e.prop)
+    elif isinstance(e, EdgePropExpr):
+        _enc_str(buf, e.edge)
+        _enc_str(buf, e.prop)
+    elif isinstance(e, (EdgeSrcIdExpr, EdgeDstIdExpr, EdgeRankExpr, EdgeTypeExpr)):
+        _enc_str(buf, e.edge)
+    elif isinstance(e, InputPropExpr):
+        _enc_str(buf, e.prop)
+    elif isinstance(e, VariablePropExpr):
+        _enc_str(buf, e.var)
+        _enc_str(buf, e.prop)
+    else:
+        raise ValueError(f"cannot encode {type(e).__name__}")
+
+
+def encode_expression(e: Expression) -> bytes:
+    buf = bytearray()
+    _encode_into(buf, e)
+    return bytes(buf)
+
+
+def _decode_from(data: bytes, off: int):
+    kind = data[off]
+    off += 1
+    if kind == Literal.KIND:
+        v, off = _dec_value(data, off)
+        return Literal(v), off
+    if kind == FunctionCall.KIND:
+        name, off = _dec_str(data, off)
+        n = data[off]
+        off += 1
+        args = []
+        for _ in range(n):
+            a, off = _decode_from(data, off)
+            args.append(a)
+        return FunctionCall(name, args), off
+    if kind == UnaryExpr.KIND:
+        op, off = _dec_str(data, off)
+        o, off = _decode_from(data, off)
+        return UnaryExpr(op, o), off
+    if kind == TypeCastExpr.KIND:
+        t, off = _dec_str(data, off)
+        o, off = _decode_from(data, off)
+        return TypeCastExpr(t, o), off
+    if kind in (ArithmeticExpr.KIND, RelationalExpr.KIND, LogicalExpr.KIND):
+        op, off = _dec_str(data, off)
+        l, off = _decode_from(data, off)
+        r, off = _decode_from(data, off)
+        cls = {ArithmeticExpr.KIND: ArithmeticExpr,
+               RelationalExpr.KIND: RelationalExpr,
+               LogicalExpr.KIND: LogicalExpr}[kind]
+        return cls(op, l, r), off
+    if kind in (SourcePropExpr.KIND, DestPropExpr.KIND):
+        tag, off = _dec_str(data, off)
+        prop, off = _dec_str(data, off)
+        cls = SourcePropExpr if kind == SourcePropExpr.KIND else DestPropExpr
+        return cls(tag, prop), off
+    if kind == EdgePropExpr.KIND:
+        edge, off = _dec_str(data, off)
+        prop, off = _dec_str(data, off)
+        return EdgePropExpr(edge, prop), off
+    if kind in (EdgeSrcIdExpr.KIND, EdgeDstIdExpr.KIND, EdgeRankExpr.KIND, EdgeTypeExpr.KIND):
+        edge, off = _dec_str(data, off)
+        cls = {EdgeSrcIdExpr.KIND: EdgeSrcIdExpr, EdgeDstIdExpr.KIND: EdgeDstIdExpr,
+               EdgeRankExpr.KIND: EdgeRankExpr, EdgeTypeExpr.KIND: EdgeTypeExpr}[kind]
+        return cls(edge), off
+    if kind == InputPropExpr.KIND:
+        prop, off = _dec_str(data, off)
+        return InputPropExpr(prop), off
+    if kind == VariablePropExpr.KIND:
+        var, off = _dec_str(data, off)
+        prop, off = _dec_str(data, off)
+        return VariablePropExpr(var, prop), off
+    raise ValueError(f"bad expression kind {kind}")
+
+
+def decode_expression(data: bytes) -> Expression:
+    e, off = _decode_from(data, 0)
+    if off != len(data):
+        raise ValueError("trailing bytes after expression")
+    return e

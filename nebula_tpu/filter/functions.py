@@ -1,0 +1,132 @@
+"""Built-in function registry for nGQL expressions.
+
+Role parity with the reference's `common/filter/FunctionManager.cpp:23-440`
+(~35 built-ins: math, rand, now, string functions, hash, udf_is_in).
+Arity is validated at lookup time like the reference's minArity/maxArity.
+"""
+from __future__ import annotations
+
+import math
+import random
+import time
+from typing import Any, Callable, Dict, List, Tuple
+
+from .expressions import EvalError
+
+
+class _Fn:
+    __slots__ = ("fn", "min_arity", "max_arity")
+
+    def __init__(self, fn: Callable, min_arity: int, max_arity: int):
+        self.fn = fn
+        self.min_arity = min_arity
+        self.max_arity = max_arity
+
+
+def _num(v, name):
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        raise EvalError(f"{name}() requires numeric argument, got {v!r}")
+    return v
+
+
+def _s(v, name):
+    if not isinstance(v, str):
+        raise EvalError(f"{name}() requires string argument, got {v!r}")
+    return v
+
+
+def _fnv1a64(data: bytes) -> int:
+    h = 0xCBF29CE484222325
+    for b in data:
+        h ^= b
+        h = (h * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    # present as signed int64, like the reference's int64 hash
+    return h - (1 << 64) if h >= (1 << 63) else h
+
+
+class FunctionManager:
+    _registry: Dict[str, _Fn] = {}
+
+    @classmethod
+    def register(cls, name: str, min_arity: int, max_arity: int = None):
+        if max_arity is None:
+            max_arity = min_arity
+
+        def deco(fn):
+            cls._registry[name] = _Fn(fn, min_arity, max_arity)
+            return fn
+        return deco
+
+    @classmethod
+    def exists(cls, name: str) -> bool:
+        return name.lower() in cls._registry
+
+    @classmethod
+    def invoke(cls, name: str, args: List[Any]) -> Any:
+        f = cls._registry.get(name.lower())
+        if f is None:
+            raise EvalError(f"unknown function {name}()")
+        if not (f.min_arity <= len(args) <= f.max_arity):
+            raise EvalError(
+                f"{name}() takes {f.min_arity}"
+                + (f"..{f.max_arity}" if f.max_arity != f.min_arity else "")
+                + f" args, got {len(args)}")
+        return f.fn(*args)
+
+    @classmethod
+    def names(cls) -> List[str]:
+        return sorted(cls._registry)
+
+
+_reg = FunctionManager.register
+
+# --- math ------------------------------------------------------------------
+_reg("abs", 1)(lambda x: abs(_num(x, "abs")))
+_reg("floor", 1)(lambda x: float(math.floor(_num(x, "floor"))))
+_reg("ceil", 1)(lambda x: float(math.ceil(_num(x, "ceil"))))
+_reg("round", 1)(lambda x: float(round(_num(x, "round"))))
+_reg("sqrt", 1)(lambda x: math.sqrt(_num(x, "sqrt")))
+_reg("cbrt", 1)(lambda x: math.copysign(abs(_num(x, "cbrt")) ** (1 / 3), x))
+_reg("hypot", 2)(lambda x, y: math.hypot(_num(x, "hypot"), _num(y, "hypot")))
+_reg("pow", 2)(lambda x, y: _num(x, "pow") ** _num(y, "pow"))
+_reg("exp", 1)(lambda x: math.exp(_num(x, "exp")))
+_reg("exp2", 1)(lambda x: 2.0 ** _num(x, "exp2"))
+_reg("log", 1)(lambda x: math.log(_num(x, "log")))
+_reg("log2", 1)(lambda x: math.log2(_num(x, "log2")))
+_reg("log10", 1)(lambda x: math.log10(_num(x, "log10")))
+_reg("sin", 1)(lambda x: math.sin(_num(x, "sin")))
+_reg("asin", 1)(lambda x: math.asin(_num(x, "asin")))
+_reg("cos", 1)(lambda x: math.cos(_num(x, "cos")))
+_reg("acos", 1)(lambda x: math.acos(_num(x, "acos")))
+_reg("tan", 1)(lambda x: math.tan(_num(x, "tan")))
+_reg("atan", 1)(lambda x: math.atan(_num(x, "atan")))
+
+# --- rand / time -----------------------------------------------------------
+_reg("rand32", 0, 2)(lambda *a: (
+    random.randrange(0, 1 << 32) if len(a) == 0 else
+    random.randrange(0, int(a[0])) if len(a) == 1 else
+    random.randrange(int(a[0]), int(a[1]))))
+_reg("rand64", 0, 2)(lambda *a: (
+    random.randrange(0, 1 << 63) if len(a) == 0 else
+    random.randrange(0, int(a[0])) if len(a) == 1 else
+    random.randrange(int(a[0]), int(a[1]))))
+_reg("now", 0)(lambda: int(time.time()))
+
+# --- strings ---------------------------------------------------------------
+_reg("strcasecmp", 2)(lambda a, b: (
+    (lambda x, y: (x > y) - (x < y))(_s(a, "strcasecmp").lower(), _s(b, "strcasecmp").lower())))
+_reg("lower", 1)(lambda v: _s(v, "lower").lower())
+_reg("upper", 1)(lambda v: _s(v, "upper").upper())
+_reg("length", 1)(lambda v: len(_s(v, "length")))
+_reg("trim", 1)(lambda v: _s(v, "trim").strip())
+_reg("ltrim", 1)(lambda v: _s(v, "ltrim").lstrip())
+_reg("rtrim", 1)(lambda v: _s(v, "rtrim").rstrip())
+_reg("left", 2)(lambda v, n: _s(v, "left")[:max(0, int(n))])
+_reg("right", 2)(lambda v, n: _s(v, "right")[len(_s(v, "right")) - max(0, int(n)):] if int(n) > 0 else "")
+_reg("substr", 3)(lambda v, p, n: _s(v, "substr")[max(0, int(p)):max(0, int(p)) + max(0, int(n))])
+
+# --- misc ------------------------------------------------------------------
+_reg("hash", 1)(lambda v: _fnv1a64(
+    v.encode("utf-8") if isinstance(v, str)
+    else str(v).encode("utf-8")))
+_reg("udf_is_in", 2, 255)(lambda v, *candidates: v in candidates)

@@ -1,0 +1,4 @@
+from .iface import KVEngine, KVIterator  # noqa: F401
+from .memengine import MemEngine  # noqa: F401
+from .store import GraphStore, SpaceInfo  # noqa: F401
+from .part import Part  # noqa: F401

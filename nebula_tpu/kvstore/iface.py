@@ -1,0 +1,107 @@
+"""KV engine / store interfaces.
+
+Role parity with the reference's `kvstore/KVEngine.h` and
+`kvstore/KVStore.h:58-159`: an engine is a single ordered KV namespace
+with prefix/range scans and batched writes; a store multiplexes
+space→partition→engine and pushes writes through consensus while reads
+stay leader-local. The engine seam is the pluggable boundary — the
+reference ships RocksEngine + an HBase plugin; we ship a Python
+in-memory engine (tests/small), a C++ native engine (`native/`), and
+the TPU CSR snapshot consumer hangs off the same seam.
+"""
+from __future__ import annotations
+
+import abc
+from typing import Iterable, Iterator, List, Optional, Tuple
+
+from ..common.status import ErrorCode, Status
+
+KV = Tuple[bytes, bytes]
+
+
+class KVIterator(abc.ABC):
+    """Forward iterator over an ordered key range."""
+
+    @abc.abstractmethod
+    def valid(self) -> bool: ...
+
+    @abc.abstractmethod
+    def next(self) -> None: ...
+
+    @abc.abstractmethod
+    def key(self) -> bytes: ...
+
+    @abc.abstractmethod
+    def value(self) -> bytes: ...
+
+    def __iter__(self) -> Iterator[KV]:
+        while self.valid():
+            yield self.key(), self.value()
+            self.next()
+
+
+class KVEngine(abc.ABC):
+    """One ordered KV namespace (one per (space, data-path) like the
+    reference's one-RocksDB-per-space-per-path)."""
+
+    # --- reads --------------------------------------------------------
+    @abc.abstractmethod
+    def get(self, key: bytes) -> Optional[bytes]: ...
+
+    def multi_get(self, keys: List[bytes]) -> List[Optional[bytes]]:
+        return [self.get(k) for k in keys]
+
+    @abc.abstractmethod
+    def prefix(self, prefix: bytes) -> KVIterator: ...
+
+    @abc.abstractmethod
+    def range(self, start: bytes, end: bytes) -> KVIterator: ...
+
+    # --- writes -------------------------------------------------------
+    @abc.abstractmethod
+    def put(self, key: bytes, value: bytes) -> Status: ...
+
+    def multi_put(self, kvs: Iterable[KV]) -> Status:
+        for k, v in kvs:
+            st = self.put(k, v)
+            if not st.ok():
+                return st
+        return Status.OK()
+
+    @abc.abstractmethod
+    def remove(self, key: bytes) -> Status: ...
+
+    def multi_remove(self, keys: Iterable[bytes]) -> Status:
+        for k in keys:
+            st = self.remove(k)
+            if not st.ok():
+                return st
+        return Status.OK()
+
+    @abc.abstractmethod
+    def remove_range(self, start: bytes, end: bytes) -> Status: ...
+
+    def remove_prefix(self, prefix: bytes) -> Status:
+        it = self.prefix(prefix)
+        dead = [k for k, _ in it]
+        return self.multi_remove(dead)
+
+    # --- maintenance --------------------------------------------------
+    def ingest(self, kvs: Iterable[KV]) -> Status:
+        """Bulk load pre-sorted data (ref: RocksEngine::ingest of SSTs)."""
+        return self.multi_put(kvs)
+
+    def compact(self) -> Status:
+        return Status.OK()
+
+    def flush(self) -> Status:
+        return Status.OK()
+
+    def approximate_size(self) -> int:
+        return 0
+
+    def total_keys(self) -> int:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass

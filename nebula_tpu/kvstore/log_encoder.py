@@ -1,0 +1,126 @@
+"""Raft/WAL log entry encoding for KV mutations.
+
+Role parity with the reference's `kvstore/LogEncoder.h:14-25`
+(OP_PUT, OP_MULTI_PUT, OP_REMOVE, OP_MULTI_REMOVE, OP_REMOVE_RANGE,
+OP_ADD_LEARNER, OP_TRANS_LEADER, OP_ADD_PEER, OP_REMOVE_PEER): every
+mutation that goes through consensus is first serialized to one log
+blob, replicated, then decoded and applied to the engine inside
+`Part.commit_logs` as a single batch.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Iterable, List, Tuple, Union
+
+KV = Tuple[bytes, bytes]
+
+OP_PUT = 1
+OP_MULTI_PUT = 2
+OP_REMOVE = 3
+OP_MULTI_REMOVE = 4
+OP_REMOVE_RANGE = 5
+OP_REMOVE_PREFIX = 6
+OP_ADD_LEARNER = 7
+OP_TRANS_LEADER = 8
+OP_ADD_PEER = 9
+OP_REMOVE_PEER = 10
+
+_U32 = struct.Struct("<I")
+
+
+def _blob(b: bytes) -> bytes:
+    return _U32.pack(len(b)) + b
+
+
+def _read_blob(data: bytes, off: int) -> Tuple[bytes, int]:
+    n = _U32.unpack_from(data, off)[0]
+    off += 4
+    return data[off:off + n], off + n
+
+
+def encode_single(op: int, key: bytes, value: bytes = b"") -> bytes:
+    return bytes([op]) + _blob(key) + (_blob(value) if op == OP_PUT else b"")
+
+
+def encode_multi_put(kvs: Iterable[KV]) -> bytes:
+    out = bytearray([OP_MULTI_PUT])
+    cnt = 0
+    body = bytearray()
+    for k, v in kvs:
+        body += _blob(k) + _blob(v)
+        cnt += 1
+    out += _U32.pack(cnt) + body
+    return bytes(out)
+
+
+def encode_multi_remove(ks: Iterable[bytes]) -> bytes:
+    out = bytearray([OP_MULTI_REMOVE])
+    cnt = 0
+    body = bytearray()
+    for k in ks:
+        body += _blob(k)
+        cnt += 1
+    out += _U32.pack(cnt) + body
+    return bytes(out)
+
+
+def encode_remove_range(start: bytes, end: bytes) -> bytes:
+    return bytes([OP_REMOVE_RANGE]) + _blob(start) + _blob(end)
+
+
+def encode_remove_prefix(prefix: bytes) -> bytes:
+    return bytes([OP_REMOVE_PREFIX]) + _blob(prefix)
+
+
+def encode_host(op: int, host: str) -> bytes:
+    """Membership-change ops carry a host address string."""
+    return bytes([op]) + _blob(host.encode("utf-8"))
+
+
+DecodedOp = Tuple[int, tuple]
+
+
+def decode(data: bytes) -> DecodedOp:
+    """-> (op, payload) where payload depends on op:
+    OP_PUT -> (key, value); OP_REMOVE -> (key,);
+    OP_MULTI_PUT -> (kv_list,); OP_MULTI_REMOVE -> (key_list,);
+    OP_REMOVE_RANGE -> (start, end); OP_REMOVE_PREFIX -> (prefix,);
+    membership ops -> (host_str,).
+    """
+    op = data[0]
+    off = 1
+    if op == OP_PUT:
+        k, off = _read_blob(data, off)
+        v, off = _read_blob(data, off)
+        return op, (k, v)
+    if op == OP_REMOVE:
+        k, off = _read_blob(data, off)
+        return op, (k,)
+    if op == OP_MULTI_PUT:
+        cnt = _U32.unpack_from(data, off)[0]
+        off += 4
+        kvs: List[KV] = []
+        for _ in range(cnt):
+            k, off = _read_blob(data, off)
+            v, off = _read_blob(data, off)
+            kvs.append((k, v))
+        return op, (kvs,)
+    if op == OP_MULTI_REMOVE:
+        cnt = _U32.unpack_from(data, off)[0]
+        off += 4
+        ks: List[bytes] = []
+        for _ in range(cnt):
+            k, off = _read_blob(data, off)
+            ks.append(k)
+        return op, (ks,)
+    if op == OP_REMOVE_RANGE:
+        s, off = _read_blob(data, off)
+        e, off = _read_blob(data, off)
+        return op, (s, e)
+    if op == OP_REMOVE_PREFIX:
+        p, off = _read_blob(data, off)
+        return op, (p,)
+    if op in (OP_ADD_LEARNER, OP_TRANS_LEADER, OP_ADD_PEER, OP_REMOVE_PEER):
+        h, off = _read_blob(data, off)
+        return op, (h.decode("utf-8"),)
+    raise ValueError(f"bad log op {op}")

@@ -1,0 +1,124 @@
+"""In-memory ordered KV engine.
+
+Role parity with the reference's `RocksEngine` for the non-durable case
+(tests, meta fixtures, small spaces): sorted key array + dict, bisect
+lookups, snapshot-free iterators with prefix/range semantics identical
+to a RocksDB prefix iterator. Durability comes from the WAL + raft
+layers above (exactly where the reference puts it), or from the C++
+native engine behind the same `KVEngine` seam.
+"""
+from __future__ import annotations
+
+import bisect
+from typing import Iterable, List, Optional, Tuple
+
+from ..common.status import Status
+from .iface import KV, KVEngine, KVIterator
+
+
+class _ListIterator(KVIterator):
+    __slots__ = ("_keys", "_data", "_idx", "_end")
+
+    def __init__(self, keys: List[bytes], data: dict, lo: int, hi: int):
+        self._keys = keys
+        self._data = data
+        self._idx = lo
+        self._end = hi
+
+    def valid(self) -> bool:
+        return self._idx < self._end
+
+    def next(self) -> None:
+        self._idx += 1
+
+    def key(self) -> bytes:
+        return self._keys[self._idx]
+
+    def value(self) -> bytes:
+        return self._data[self._keys[self._idx]]
+
+
+def _prefix_upper_bound(prefix: bytes) -> Optional[bytes]:
+    """Smallest byte string greater than every key with this prefix."""
+    b = bytearray(prefix)
+    while b:
+        if b[-1] != 0xFF:
+            b[-1] += 1
+            return bytes(b)
+        b.pop()
+    return None  # prefix was all 0xFF: no upper bound
+
+
+class MemEngine(KVEngine):
+    def __init__(self) -> None:
+        self._keys: List[bytes] = []
+        self._data: dict = {}
+
+    # --- reads --------------------------------------------------------
+    def get(self, key: bytes) -> Optional[bytes]:
+        return self._data.get(key)
+
+    def prefix(self, prefix: bytes) -> KVIterator:
+        lo = bisect.bisect_left(self._keys, prefix)
+        ub = _prefix_upper_bound(prefix)
+        hi = bisect.bisect_left(self._keys, ub) if ub is not None else len(self._keys)
+        return _ListIterator(self._keys, self._data, lo, hi)
+
+    def range(self, start: bytes, end: bytes) -> KVIterator:
+        lo = bisect.bisect_left(self._keys, start)
+        hi = bisect.bisect_left(self._keys, end)
+        return _ListIterator(self._keys, self._data, lo, hi)
+
+    # --- writes -------------------------------------------------------
+    def put(self, key: bytes, value: bytes) -> Status:
+        if key not in self._data:
+            bisect.insort(self._keys, key)
+        self._data[key] = value
+        return Status.OK()
+
+    def multi_put(self, kvs: Iterable[KV]) -> Status:
+        new = False
+        for k, v in kvs:
+            if k not in self._data:
+                new = True
+            self._data[k] = v
+        if new:
+            self._keys = sorted(self._data)
+        return Status.OK()
+
+    def remove(self, key: bytes) -> Status:
+        if key in self._data:
+            del self._data[key]
+            i = bisect.bisect_left(self._keys, key)
+            if i < len(self._keys) and self._keys[i] == key:
+                self._keys.pop(i)
+        return Status.OK()
+
+    def multi_remove(self, keys: Iterable[bytes]) -> Status:
+        hit = False
+        for k in keys:
+            if k in self._data:
+                del self._data[k]
+                hit = True
+        if hit:
+            self._keys = sorted(self._data)
+        return Status.OK()
+
+    def remove_range(self, start: bytes, end: bytes) -> Status:
+        lo = bisect.bisect_left(self._keys, start)
+        hi = bisect.bisect_left(self._keys, end)
+        for k in self._keys[lo:hi]:
+            del self._data[k]
+        del self._keys[lo:hi]
+        return Status.OK()
+
+    # --- maintenance --------------------------------------------------
+    def total_keys(self) -> int:
+        return len(self._keys)
+
+    def approximate_size(self) -> int:
+        return sum(len(k) + len(v) for k, v in self._data.items())
+
+    def snapshot_items(self) -> List[KV]:
+        """Stable copy for snapshot transfer / CSR building."""
+        return [(k, self._data[k]) for k in self._keys]

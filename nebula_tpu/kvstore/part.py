@@ -1,0 +1,184 @@
+"""Partition state machine.
+
+Role parity with the reference's `kvstore/Part.cpp:34-417`: a Part is a
+replicated state machine over a KV engine. Mutations are encoded as log
+blobs (log_encoder), submitted through a consensus hook, and applied in
+`commit_logs` as one engine batch together with the committed-log-id
+marker (`system_commit_key`, ref Part.cpp:350-356) so restart recovery
+knows where WAL replay must resume.
+
+In Phase 1 the consensus hook is `DirectCommit` (single replica, commit
+immediately). The Raft layer (kvstore/raft/) plugs into the same hook:
+`RaftPart.append_async` replicates the identical log blobs, then calls
+back into `Part.commit_logs` on quorum — mirroring how the reference
+keeps consensus *below* the KVStore interface and out of the read path.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Iterable, List, Optional, Tuple
+
+from ..common import keys as keyutils
+from ..common.status import ErrorCode, Status
+from . import log_encoder as le
+from .iface import KVEngine
+
+KV = Tuple[bytes, bytes]
+
+# An atomic op runs at the serialization point and returns encoded log
+# bytes to commit (or None to abort) — ref: KVStore.h:140-143 asyncAtomicOp.
+AtomicOp = Callable[[], Optional[bytes]]
+
+
+class Part:
+    def __init__(self, space_id: int, part_id: int, engine: KVEngine,
+                 consensus: Optional["ConsensusHook"] = None):
+        self.space_id = space_id
+        self.part_id = part_id
+        self.engine = engine
+        self._lock = threading.Lock()
+        self.last_committed_log_id = 0
+        self.last_committed_term = 0
+        self._consensus = consensus or DirectCommit(self)
+        self._load_commit_marker()
+
+    # ------------------------------------------------------------------
+    # public write API (async through consensus in the reference; our
+    # Phase-1 hook commits synchronously, raft hook returns futures)
+    # ------------------------------------------------------------------
+    def async_put(self, key: bytes, value: bytes) -> Status:
+        return self._consensus.submit(le.encode_single(le.OP_PUT, key, value))
+
+    def async_multi_put(self, kvs: Iterable[KV]) -> Status:
+        return self._consensus.submit(le.encode_multi_put(kvs))
+
+    def async_remove(self, key: bytes) -> Status:
+        return self._consensus.submit(le.encode_single(le.OP_REMOVE, key))
+
+    def async_multi_remove(self, ks: Iterable[bytes]) -> Status:
+        return self._consensus.submit(le.encode_multi_remove(ks))
+
+    def async_remove_range(self, start: bytes, end: bytes) -> Status:
+        return self._consensus.submit(le.encode_remove_range(start, end))
+
+    def async_remove_prefix(self, prefix: bytes) -> Status:
+        return self._consensus.submit(le.encode_remove_prefix(prefix))
+
+    def async_atomic_op(self, op: AtomicOp) -> Status:
+        return self._consensus.submit_atomic(op)
+
+    # ------------------------------------------------------------------
+    # state machine apply (called under the consensus serialization point)
+    # ------------------------------------------------------------------
+    def commit_logs(self, logs: List[Tuple[int, int, bytes]]) -> Status:
+        """Apply a batch of (log_id, term, data) entries as one engine
+        batch + commit marker (ref: Part::commitLogs Part.cpp:208-319)."""
+        if not logs:
+            return Status.OK()
+        batch_puts: List[KV] = []
+        with self._lock:
+            for log_id, term, data in logs:
+                if not data:
+                    continue  # heartbeat/noop entry
+                op, payload = le.decode(data)
+                if op == le.OP_PUT:
+                    batch_puts.append(payload)
+                elif op == le.OP_MULTI_PUT:
+                    batch_puts.extend(payload[0])
+                else:
+                    # non-put ops flush accumulated puts first to keep order
+                    if batch_puts:
+                        self.engine.multi_put(batch_puts)
+                        batch_puts = []
+                    if op == le.OP_REMOVE:
+                        self.engine.remove(payload[0])
+                    elif op == le.OP_MULTI_REMOVE:
+                        self.engine.multi_remove(payload[0])
+                    elif op == le.OP_REMOVE_RANGE:
+                        self.engine.remove_range(payload[0], payload[1])
+                    elif op == le.OP_REMOVE_PREFIX:
+                        self.engine.remove_prefix(payload[0])
+                    elif op in (le.OP_ADD_LEARNER, le.OP_TRANS_LEADER,
+                                le.OP_ADD_PEER, le.OP_REMOVE_PEER):
+                        pass  # handled by raft pre-process, not the engine
+                    else:
+                        return Status.error(ErrorCode.E_INVALID_DATA,
+                                            f"bad op {op}")
+            last_id, last_term, _ = logs[-1][0], logs[-1][1], None
+            batch_puts.append((keyutils.system_commit_key(self.part_id),
+                               keyutils.encode_commit_value(last_id, logs[-1][1])))
+            self.engine.multi_put(batch_puts)
+            self.last_committed_log_id = last_id
+            self.last_committed_term = logs[-1][1]
+        return Status.OK()
+
+    def commit_snapshot(self, kvs: List[KV], committed_log_id: int,
+                        committed_term: int, finished: bool) -> int:
+        """Ingest a snapshot chunk (ref: Part::commitSnapshot :321-348)."""
+        with self._lock:
+            self.engine.multi_put(kvs)
+            if finished:
+                self.engine.put(keyutils.system_commit_key(self.part_id),
+                                keyutils.encode_commit_value(committed_log_id,
+                                                             committed_term))
+                self.last_committed_log_id = committed_log_id
+                self.last_committed_term = committed_term
+        return len(kvs)
+
+    def cleanup(self) -> Status:
+        """Drop all data of this part (ref: Part::cleanup on removePart)."""
+        with self._lock:
+            return self.engine.remove_prefix(keyutils.part_prefix(self.part_id))
+
+    # ------------------------------------------------------------------
+    def _load_commit_marker(self) -> None:
+        v = self.engine.get(keyutils.system_commit_key(self.part_id))
+        if v is not None:
+            self.last_committed_log_id, self.last_committed_term = \
+                keyutils.decode_commit_value(v)
+
+    def is_leader(self) -> bool:
+        return self._consensus.is_leader()
+
+    def leader(self) -> Optional[str]:
+        return self._consensus.leader()
+
+
+class ConsensusHook:
+    """Seam between Part and the replication machinery."""
+
+    def submit(self, log: bytes) -> Status:
+        raise NotImplementedError
+
+    def submit_atomic(self, op: AtomicOp) -> Status:
+        raise NotImplementedError
+
+    def is_leader(self) -> bool:
+        return True
+
+    def leader(self) -> Optional[str]:
+        return None
+
+
+class DirectCommit(ConsensusHook):
+    """Single-replica commit path: serialize + apply immediately."""
+
+    def __init__(self, part: Part):
+        self._part = part
+        self._lock = threading.Lock()
+        self._next_log_id = 1
+
+    def submit(self, log: bytes) -> Status:
+        with self._lock:
+            log_id = self._next_log_id
+            self._next_log_id += 1
+            return self._part.commit_logs([(log_id, 1, log)])
+
+    def submit_atomic(self, op: AtomicOp) -> Status:
+        with self._lock:
+            log = op()
+            if log is None:
+                return Status.error(ErrorCode.E_FILTER_OUT, "atomic op aborted")
+            log_id = self._next_log_id
+            self._next_log_id += 1
+            return self._part.commit_logs([(log_id, 1, log)])

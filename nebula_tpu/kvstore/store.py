@@ -1,0 +1,168 @@
+"""GraphStore: space → partition → engine multiplexing.
+
+Role parity with the reference's `kvstore/NebulaStore.{h,cpp}`:
+spaces hold a set of local Parts sharing a per-space engine; reads are
+leader-local; writes route to the owning Part and go through its
+consensus hook. Implements the PartManager handler surface
+(add/remove space/part, ref NebulaStore.h:172-178) so meta-driven
+topology changes create/destroy local parts at runtime — the balancer
+drives exactly these entry points.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+from ..common.status import ErrorCode, Status, StatusOr
+from .iface import KVEngine, KVIterator
+from .memengine import MemEngine
+from .part import AtomicOp, Part
+
+KV = Tuple[bytes, bytes]
+
+EngineFactory = Callable[[int], KVEngine]  # space_id -> engine
+
+
+class SpaceInfo:
+    def __init__(self, space_id: int, engine: KVEngine):
+        self.space_id = space_id
+        self.engine = engine
+        self.parts: Dict[int, Part] = {}
+
+
+class GraphStore:
+    def __init__(self, engine_factory: Optional[EngineFactory] = None,
+                 consensus_factory=None):
+        self._engine_factory = engine_factory or (lambda space_id: MemEngine())
+        self._consensus_factory = consensus_factory  # (space,part,engine)->hook
+        self._spaces: Dict[int, SpaceInfo] = {}
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------------
+    # topology management (PartManager::Handler surface)
+    # ------------------------------------------------------------------
+    def add_space(self, space_id: int) -> None:
+        with self._lock:
+            if space_id not in self._spaces:
+                self._spaces[space_id] = SpaceInfo(space_id,
+                                                   self._engine_factory(space_id))
+
+    def remove_space(self, space_id: int) -> None:
+        with self._lock:
+            info = self._spaces.pop(space_id, None)
+        if info is not None:
+            info.engine.close()
+
+    def add_part(self, space_id: int, part_id: int) -> Part:
+        self.add_space(space_id)
+        with self._lock:
+            info = self._spaces[space_id]
+            if part_id not in info.parts:
+                hook = None
+                if self._consensus_factory is not None:
+                    hook = self._consensus_factory(space_id, part_id, info.engine)
+                info.parts[part_id] = Part(space_id, part_id, info.engine, hook)
+            return info.parts[part_id]
+
+    def remove_part(self, space_id: int, part_id: int) -> None:
+        with self._lock:
+            info = self._spaces.get(space_id)
+            part = info.parts.pop(part_id, None) if info else None
+        if part is not None:
+            part.cleanup()
+
+    def spaces(self) -> List[int]:
+        return sorted(self._spaces)
+
+    def parts(self, space_id: int) -> List[int]:
+        info = self._spaces.get(space_id)
+        return sorted(info.parts) if info else []
+
+    def space_engine(self, space_id: int) -> Optional[KVEngine]:
+        info = self._spaces.get(space_id)
+        return info.engine if info else None
+
+    # ------------------------------------------------------------------
+    # part lookup / guards
+    # ------------------------------------------------------------------
+    def part(self, space_id: int, part_id: int) -> StatusOr[Part]:
+        info = self._spaces.get(space_id)
+        if info is None:
+            return StatusOr.err(ErrorCode.E_SPACE_NOT_FOUND, f"space {space_id}")
+        p = info.parts.get(part_id)
+        if p is None:
+            return StatusOr.err(ErrorCode.E_PART_NOT_FOUND,
+                                f"part {part_id} of space {space_id}")
+        if not p.is_leader():
+            return StatusOr.err(ErrorCode.E_LEADER_CHANGED, p.leader() or "")
+        return StatusOr.of(p)
+
+    # ------------------------------------------------------------------
+    # reads (leader-local, ref KVStore.h "reads are local-only")
+    # ------------------------------------------------------------------
+    def get(self, space_id: int, part_id: int, key: bytes) -> StatusOr[bytes]:
+        pr = self.part(space_id, part_id)
+        if not pr.ok():
+            return StatusOr.from_status(pr.status)
+        v = pr.value().engine.get(key)
+        if v is None:
+            return StatusOr.err(ErrorCode.E_KEY_NOT_FOUND)
+        return StatusOr.of(v)
+
+    def multi_get(self, space_id: int, part_id: int,
+                  ks: List[bytes]) -> StatusOr[List[Optional[bytes]]]:
+        pr = self.part(space_id, part_id)
+        if not pr.ok():
+            return StatusOr.from_status(pr.status)
+        return StatusOr.of(pr.value().engine.multi_get(ks))
+
+    def prefix(self, space_id: int, part_id: int,
+               prefix: bytes) -> StatusOr[KVIterator]:
+        pr = self.part(space_id, part_id)
+        if not pr.ok():
+            return StatusOr.from_status(pr.status)
+        return StatusOr.of(pr.value().engine.prefix(prefix))
+
+    def range(self, space_id: int, part_id: int, start: bytes,
+              end: bytes) -> StatusOr[KVIterator]:
+        pr = self.part(space_id, part_id)
+        if not pr.ok():
+            return StatusOr.from_status(pr.status)
+        return StatusOr.of(pr.value().engine.range(start, end))
+
+    # ------------------------------------------------------------------
+    # writes (through consensus)
+    # ------------------------------------------------------------------
+    def async_multi_put(self, space_id: int, part_id: int,
+                        kvs: Iterable[KV]) -> Status:
+        pr = self.part(space_id, part_id)
+        if not pr.ok():
+            return pr.status
+        return pr.value().async_multi_put(kvs)
+
+    def async_multi_remove(self, space_id: int, part_id: int,
+                           ks: Iterable[bytes]) -> Status:
+        pr = self.part(space_id, part_id)
+        if not pr.ok():
+            return pr.status
+        return pr.value().async_multi_remove(ks)
+
+    def async_remove_range(self, space_id: int, part_id: int, start: bytes,
+                           end: bytes) -> Status:
+        pr = self.part(space_id, part_id)
+        if not pr.ok():
+            return pr.status
+        return pr.value().async_remove_range(start, end)
+
+    def async_atomic_op(self, space_id: int, part_id: int,
+                        op: AtomicOp) -> Status:
+        pr = self.part(space_id, part_id)
+        if not pr.ok():
+            return pr.status
+        return pr.value().async_atomic_op(op)
+
+    def ingest(self, space_id: int, part_id: int, kvs: Iterable[KV]) -> Status:
+        pr = self.part(space_id, part_id)
+        if not pr.ok():
+            return pr.status
+        return pr.value().engine.ingest(kvs)

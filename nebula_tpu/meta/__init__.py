@@ -1,0 +1,2 @@
+from .service import MetaService, SpaceDesc  # noqa: F401
+from .schema_manager import SchemaManager  # noqa: F401

@@ -1,0 +1,116 @@
+"""Meta catalog KV schema.
+
+Role parity with the reference's `meta/MetaServiceUtils.{h,cpp}:31-136`:
+every catalog object lives in the meta store under a typed key prefix so
+the whole catalog is one Raft-replicated KV space (space 0, part 0).
+Values are JSON blobs (the reference uses serialized thrift structs).
+"""
+from __future__ import annotations
+
+import struct
+
+META_SPACE_ID = 0
+META_PART_ID = 0
+
+# key prefixes — kept as readable ascii tags since the meta store is tiny
+P_SPACE = b"__spc:"           # + space_id(u32)        -> SpaceDesc json
+P_SPACE_NAME = b"__spn:"      # + name                 -> space_id(u32)
+P_TAG = b"__tag:"             # + space(u32)+tag(u32)+ver(u32) -> Schema json
+P_TAG_NAME = b"__tgn:"        # + space(u32)+name      -> tag_id(u32)
+P_EDGE = b"__edg:"            # + space(u32)+etype(u32)+ver(u32) -> Schema json
+P_EDGE_NAME = b"__egn:"       # + space(u32)+name      -> edge_type(u32)
+P_PART = b"__prt:"            # + space(u32)+part(u32) -> [host,...] json
+P_HOST = b"__hst:"            # + host str             -> HostInfo json
+P_USER = b"__usr:"            # + name                 -> user json
+P_ROLE = b"__rol:"            # + space(u32)+user      -> role str
+P_CONFIG = b"__cfg:"          # + module:name          -> config json
+P_ID = b"__id:"               # + counter name         -> u32 (next id)
+P_BALANCE = b"__bal:"         # + plan_id(u64)+task    -> task json
+P_SEGMENT = b"__seg:"         # + segment:key          -> custom KV
+
+
+_U32 = struct.Struct(">I")
+
+
+def space_key(space_id: int) -> bytes:
+    return P_SPACE + _U32.pack(space_id)
+
+
+def space_name_key(name: str) -> bytes:
+    return P_SPACE_NAME + name.encode("utf-8")
+
+
+def tag_key(space_id: int, tag_id: int, version: int) -> bytes:
+    return P_TAG + _U32.pack(space_id) + _U32.pack(tag_id) + _U32.pack(version)
+
+
+def tag_prefix(space_id: int, tag_id: int = None) -> bytes:
+    p = P_TAG + _U32.pack(space_id)
+    return p if tag_id is None else p + _U32.pack(tag_id)
+
+
+def tag_name_key(space_id: int, name: str) -> bytes:
+    return P_TAG_NAME + _U32.pack(space_id) + name.encode("utf-8")
+
+
+def edge_key(space_id: int, edge_type: int, version: int) -> bytes:
+    return P_EDGE + _U32.pack(space_id) + _U32.pack(edge_type) + _U32.pack(version)
+
+
+def edge_prefix(space_id: int, edge_type: int = None) -> bytes:
+    p = P_EDGE + _U32.pack(space_id)
+    return p if edge_type is None else p + _U32.pack(edge_type)
+
+
+def edge_name_key(space_id: int, name: str) -> bytes:
+    return P_EDGE_NAME + _U32.pack(space_id) + name.encode("utf-8")
+
+
+def part_key(space_id: int, part_id: int) -> bytes:
+    return P_PART + _U32.pack(space_id) + _U32.pack(part_id)
+
+
+def part_prefix(space_id: int) -> bytes:
+    return P_PART + _U32.pack(space_id)
+
+
+def host_key(host: str) -> bytes:
+    return P_HOST + host.encode("utf-8")
+
+
+def user_key(name: str) -> bytes:
+    return P_USER + name.encode("utf-8")
+
+
+def role_key(space_id: int, user: str) -> bytes:
+    return P_ROLE + _U32.pack(space_id) + user.encode("utf-8")
+
+
+def config_key(module: str, name: str) -> bytes:
+    return P_CONFIG + f"{module}:{name}".encode("utf-8")
+
+
+def id_key(counter: str) -> bytes:
+    return P_ID + counter.encode("utf-8")
+
+
+def balance_task_key(plan_id: int, space_id: int, part_id: int,
+                     src: str, dst: str) -> bytes:
+    return (P_BALANCE + struct.pack(">Q", plan_id) + _U32.pack(space_id)
+            + _U32.pack(part_id) + f"{src}>{dst}".encode("utf-8"))
+
+
+def balance_prefix(plan_id: int = None) -> bytes:
+    return P_BALANCE if plan_id is None else P_BALANCE + struct.pack(">Q", plan_id)
+
+
+def segment_key(segment: str, key: str) -> bytes:
+    return P_SEGMENT + f"{segment}:{key}".encode("utf-8")
+
+
+def unpack_u32(b: bytes) -> int:
+    return _U32.unpack(b)[0]
+
+
+def pack_u32(v: int) -> bytes:
+    return _U32.pack(v)

@@ -1,0 +1,130 @@
+"""SchemaManager: schema lookup facade.
+
+Role parity with the reference's `meta/SchemaManager` /
+`ServerBasedSchemaManager`: a thin resolve-by-name/id facade the storage
+processors and query executors use, backed by the meta catalog (in-proc
+or via MetaClient cache). Also covers the test-injection role of the
+reference's `storage/test/AdHocSchemaManager` via `AdHocSchemaManager`.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..codec.schema import Schema
+from ..common.status import ErrorCode, StatusOr
+
+
+class SchemaManager:
+    def __init__(self, meta: "MetaService"):
+        self._meta = meta
+
+    def space_id(self, name: str) -> StatusOr[int]:
+        r = self._meta.get_space(name)
+        if not r.ok():
+            return StatusOr.from_status(r.status)
+        return StatusOr.of(r.value().space_id)
+
+    def num_parts(self, space_id: int) -> int:
+        r = self._meta.get_space_by_id(space_id)
+        return r.value().partition_num if r.ok() else 0
+
+    def tag_id(self, space_id: int, name: str) -> Optional[int]:
+        return self._meta.get_tag_id(space_id, name)
+
+    def edge_type(self, space_id: int, name: str) -> Optional[int]:
+        return self._meta.get_edge_type(space_id, name)
+
+    def tag_name(self, space_id: int, tag_id: int) -> Optional[str]:
+        for name, tid in self._meta.list_tags(space_id):
+            if tid == tag_id:
+                return name
+        return None
+
+    def edge_name(self, space_id: int, edge_type: int) -> Optional[str]:
+        for name, et in self._meta.list_edges(space_id):
+            if et == abs(edge_type):
+                return name
+        return None
+
+    def tag_schema(self, space_id: int, tag_id: int,
+                   version: int = -1) -> StatusOr[Schema]:
+        return self._meta.get_tag_schema(space_id, tag_id, version)
+
+    def edge_schema(self, space_id: int, edge_type: int,
+                    version: int = -1) -> StatusOr[Schema]:
+        return self._meta.get_edge_schema(space_id, abs(edge_type), version)
+
+    def all_edge_types(self, space_id: int) -> List[int]:
+        return [et for _, et in self._meta.list_edges(space_id)]
+
+    def all_tag_ids(self, space_id: int) -> List[int]:
+        return [tid for _, tid in self._meta.list_tags(space_id)]
+
+
+class AdHocSchemaManager(SchemaManager):
+    """Schema injection without a meta service, for storage-layer tests
+    (ref: storage/test/AdHocSchemaManager.{h,cpp})."""
+
+    def __init__(self):
+        self._tags: Dict[Tuple[int, int], Schema] = {}
+        self._edges: Dict[Tuple[int, int], Schema] = {}
+        self._tag_names: Dict[Tuple[int, str], int] = {}
+        self._edge_names: Dict[Tuple[int, str], int] = {}
+        self._num_parts: Dict[int, int] = {}
+
+    def add_tag(self, space_id: int, tag_id: int, name: str, schema: Schema):
+        self._tags[(space_id, tag_id)] = schema
+        self._tag_names[(space_id, name)] = tag_id
+
+    def add_edge(self, space_id: int, edge_type: int, name: str, schema: Schema):
+        self._edges[(space_id, edge_type)] = schema
+        self._edge_names[(space_id, name)] = edge_type
+
+    def set_num_parts(self, space_id: int, n: int):
+        self._num_parts[space_id] = n
+
+    def space_id(self, name: str) -> StatusOr[int]:
+        return StatusOr.of(1)
+
+    def num_parts(self, space_id: int) -> int:
+        return self._num_parts.get(space_id, 1)
+
+    def tag_id(self, space_id: int, name: str) -> Optional[int]:
+        return self._tag_names.get((space_id, name))
+
+    def edge_type(self, space_id: int, name: str) -> Optional[int]:
+        return self._edge_names.get((space_id, name))
+
+    def tag_name(self, space_id: int, tag_id: int) -> Optional[str]:
+        for (sid, name), tid in self._tag_names.items():
+            if sid == space_id and tid == tag_id:
+                return name
+        return None
+
+    def edge_name(self, space_id: int, edge_type: int) -> Optional[str]:
+        for (sid, name), et in self._edge_names.items():
+            if sid == space_id and et == abs(edge_type):
+                return name
+        return None
+
+    def tag_schema(self, space_id: int, tag_id: int,
+                   version: int = -1) -> StatusOr[Schema]:
+        s = self._tags.get((space_id, tag_id))
+        if s is None:
+            return StatusOr.err(ErrorCode.E_TAG_NOT_FOUND, str(tag_id))
+        return StatusOr.of(s)
+
+    def edge_schema(self, space_id: int, edge_type: int,
+                    version: int = -1) -> StatusOr[Schema]:
+        s = self._edges.get((space_id, abs(edge_type)))
+        if s is None:
+            return StatusOr.err(ErrorCode.E_EDGE_NOT_FOUND, str(edge_type))
+        return StatusOr.of(s)
+
+    def all_edge_types(self, space_id: int) -> List[int]:
+        return sorted(self._edge_names[k] for k in self._edge_names
+                      if k[0] == space_id)
+
+    def all_tag_ids(self, space_id: int) -> List[int]:
+        return sorted(self._tag_names[k] for k in self._tag_names
+                      if k[0] == space_id)

@@ -1,0 +1,2 @@
+from .parser import GQLParser, ParseError  # noqa: F401
+from . import ast  # noqa: F401

@@ -1,0 +1,731 @@
+"""nGQL AST: sentences and clauses.
+
+Role parity with the reference's plain-C++ AST (`parser/Sentence.h:19-63`
+— 43 sentence kinds — plus TraverseSentences / MutateSentences /
+MaintainSentences / AdminSentences / UserSentences / Clauses). Each
+node keeps `to_string()` round-trip ability like the reference.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Any, List, Optional, Tuple
+
+from ..filter.expressions import Expression
+
+
+class Kind(enum.Enum):
+    SEQUENTIAL = "sequential"
+    PIPE = "pipe"
+    ASSIGNMENT = "assignment"
+    GO = "go"
+    FIND_PATH = "find_path"
+    FETCH_VERTICES = "fetch_vertices"
+    FETCH_EDGES = "fetch_edges"
+    USE = "use"
+    CREATE_SPACE = "create_space"
+    DROP_SPACE = "drop_space"
+    DESCRIBE_SPACE = "describe_space"
+    CREATE_TAG = "create_tag"
+    CREATE_EDGE = "create_edge"
+    ALTER_TAG = "alter_tag"
+    ALTER_EDGE = "alter_edge"
+    DROP_TAG = "drop_tag"
+    DROP_EDGE = "drop_edge"
+    DESCRIBE_TAG = "describe_tag"
+    DESCRIBE_EDGE = "describe_edge"
+    INSERT_VERTICES = "insert_vertices"
+    INSERT_EDGES = "insert_edges"
+    DELETE_VERTICES = "delete_vertices"
+    DELETE_EDGES = "delete_edges"
+    UPDATE_VERTEX = "update_vertex"
+    UPDATE_EDGE = "update_edge"
+    YIELD = "yield"
+    ORDER_BY = "order_by"
+    LIMIT = "limit"
+    GROUP_BY = "group_by"
+    SET_OP = "set_op"
+    SHOW = "show"
+    CONFIG = "config"
+    BALANCE = "balance"
+    CREATE_USER = "create_user"
+    DROP_USER = "drop_user"
+    ALTER_USER = "alter_user"
+    CHANGE_PASSWORD = "change_password"
+    GRANT = "grant"
+    REVOKE = "revoke"
+    INGEST = "ingest"
+    DOWNLOAD = "download"
+    CREATE_SNAPSHOT = "create_snapshot"
+    DROP_SNAPSHOT = "drop_snapshot"
+
+
+class Sentence:
+    kind: Kind
+
+    def to_string(self) -> str:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"<{type(self).__name__}: {self.to_string()}>"
+
+
+# ---------------------------------------------------------------------------
+# clauses (ref: parser/Clauses.{h,cpp})
+# ---------------------------------------------------------------------------
+
+@dataclass
+class StepClause:
+    steps: int = 1
+    upto: bool = False
+
+    def to_string(self) -> str:
+        s = f"{self.steps} STEPS"
+        return f"UPTO {s}" if self.upto else s
+
+
+@dataclass
+class VertexRef:
+    """FROM source: literal vids / uuids, or an input/variable column ref."""
+    vids: Optional[List[Expression]] = None     # literal/function vid exprs
+    ref: Optional[Expression] = None            # InputPropExpr or VariablePropExpr
+
+    def to_string(self) -> str:
+        if self.ref is not None:
+            return self.ref.to_string()
+        return ", ".join(v.to_string() for v in self.vids or [])
+
+
+@dataclass
+class OverEdge:
+    name: str
+    alias: Optional[str] = None
+
+    def to_string(self) -> str:
+        return f"{self.name} AS {self.alias}" if self.alias else self.name
+
+
+class Direction(enum.Enum):
+    OUT = "out"
+    IN = "in"            # REVERSELY
+    BOTH = "both"        # BIDIRECT
+
+
+@dataclass
+class OverClause:
+    edges: List[OverEdge] = field(default_factory=list)  # empty = OVER *
+    direction: Direction = Direction.OUT
+    is_all: bool = False
+
+    def to_string(self) -> str:
+        core = "*" if self.is_all else ", ".join(e.to_string() for e in self.edges)
+        sfx = {Direction.OUT: "", Direction.IN: " REVERSELY",
+               Direction.BOTH: " BIDIRECT"}[self.direction]
+        return f"OVER {core}{sfx}"
+
+
+@dataclass
+class WhereClause:
+    filter: Expression
+
+    def to_string(self) -> str:
+        return f"WHERE {self.filter.to_string()}"
+
+
+@dataclass
+class YieldColumn:
+    expr: Expression
+    alias: Optional[str] = None
+    agg_fun: Optional[str] = None   # COUNT/SUM/AVG/... when used in GROUP BY
+
+    def name(self) -> str:
+        if self.alias:
+            return self.alias
+        if self.agg_fun:
+            return f"{self.agg_fun}({self.expr.to_string()})"
+        return self.expr.to_string()
+
+    def to_string(self) -> str:
+        s = (f"{self.agg_fun}({self.expr.to_string()})" if self.agg_fun
+             else self.expr.to_string())
+        return f"{s} AS {self.alias}" if self.alias else s
+
+
+@dataclass
+class YieldClause:
+    columns: List[YieldColumn] = field(default_factory=list)
+    distinct: bool = False
+
+    def to_string(self) -> str:
+        d = "DISTINCT " if self.distinct else ""
+        return f"YIELD {d}{', '.join(c.to_string() for c in self.columns)}"
+
+
+@dataclass
+class OrderFactor:
+    expr: Expression      # typically InputPropExpr
+    ascending: bool = True
+
+    def to_string(self) -> str:
+        return f"{self.expr.to_string()}{'' if self.ascending else ' DESC'}"
+
+
+@dataclass
+class EdgeKeyRef:
+    """src -> dst [@rank] for FETCH/DELETE EDGE."""
+    src: Expression
+    dst: Expression
+    rank: int = 0
+
+    def to_string(self) -> str:
+        return f"{self.src.to_string()}->{self.dst.to_string()}@{self.rank}"
+
+
+# ---------------------------------------------------------------------------
+# traverse sentences (ref: parser/TraverseSentences.h)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class SequentialSentences(Sentence):
+    sentences: List[Sentence]
+    kind = Kind.SEQUENTIAL
+
+    def to_string(self) -> str:
+        return "; ".join(s.to_string() for s in self.sentences)
+
+
+@dataclass
+class PipedSentence(Sentence):
+    left: Sentence
+    right: Sentence
+    kind = Kind.PIPE
+
+    def to_string(self) -> str:
+        return f"{self.left.to_string()} | {self.right.to_string()}"
+
+
+@dataclass
+class AssignmentSentence(Sentence):
+    var: str
+    sentence: Sentence
+    kind = Kind.ASSIGNMENT
+
+    def to_string(self) -> str:
+        return f"${self.var} = {self.sentence.to_string()}"
+
+
+@dataclass
+class GoSentence(Sentence):
+    step: StepClause
+    from_: VertexRef
+    over: OverClause
+    where: Optional[WhereClause] = None
+    yield_: Optional[YieldClause] = None
+    kind = Kind.GO
+
+    def to_string(self) -> str:
+        parts = ["GO", self.step.to_string(), "FROM", self.from_.to_string(),
+                 self.over.to_string()]
+        if self.where:
+            parts.append(self.where.to_string())
+        if self.yield_:
+            parts.append(self.yield_.to_string())
+        return " ".join(parts)
+
+
+@dataclass
+class FindPathSentence(Sentence):
+    shortest: bool
+    from_: VertexRef
+    to: VertexRef
+    over: OverClause
+    step: StepClause = field(default_factory=lambda: StepClause(5, upto=True))
+    noloop: bool = False
+    kind = Kind.FIND_PATH
+
+    def to_string(self) -> str:
+        k = "SHORTEST" if self.shortest else ("NOLOOP" if self.noloop else "ALL")
+        return (f"FIND {k} PATH FROM {self.from_.to_string()} TO "
+                f"{self.to.to_string()} {self.over.to_string()} "
+                f"UPTO {self.step.steps} STEPS")
+
+
+@dataclass
+class FetchVerticesSentence(Sentence):
+    tag: str                       # "*" = all tags
+    src: VertexRef
+    yield_: Optional[YieldClause] = None
+    kind = Kind.FETCH_VERTICES
+
+    def to_string(self) -> str:
+        s = f"FETCH PROP ON {self.tag} {self.src.to_string()}"
+        return f"{s} {self.yield_.to_string()}" if self.yield_ else s
+
+
+@dataclass
+class FetchEdgesSentence(Sentence):
+    edge: str
+    keys: Optional[List[EdgeKeyRef]] = None
+    ref: Optional[Expression] = None   # $-.col / $var.col based keys
+    yield_: Optional[YieldClause] = None
+    kind = Kind.FETCH_EDGES
+
+    def to_string(self) -> str:
+        ks = (", ".join(k.to_string() for k in self.keys) if self.keys
+              else (self.ref.to_string() if self.ref else ""))
+        s = f"FETCH PROP ON {self.edge} {ks}"
+        return f"{s} {self.yield_.to_string()}" if self.yield_ else s
+
+
+@dataclass
+class YieldSentence(Sentence):
+    yield_: YieldClause
+    where: Optional[WhereClause] = None
+    kind = Kind.YIELD
+
+    def to_string(self) -> str:
+        s = self.yield_.to_string()
+        return f"{s} {self.where.to_string()}" if self.where else s
+
+
+@dataclass
+class OrderBySentence(Sentence):
+    factors: List[OrderFactor]
+    kind = Kind.ORDER_BY
+
+    def to_string(self) -> str:
+        return "ORDER BY " + ", ".join(f.to_string() for f in self.factors)
+
+
+@dataclass
+class LimitSentence(Sentence):
+    count: int
+    offset: int = 0
+    kind = Kind.LIMIT
+
+    def to_string(self) -> str:
+        return f"LIMIT {self.offset},{self.count}" if self.offset else f"LIMIT {self.count}"
+
+
+@dataclass
+class GroupBySentence(Sentence):
+    group_cols: List[YieldColumn]
+    yield_: YieldClause
+    kind = Kind.GROUP_BY
+
+    def to_string(self) -> str:
+        return ("GROUP BY " + ", ".join(c.to_string() for c in self.group_cols)
+                + " " + self.yield_.to_string())
+
+
+class SetOp(enum.Enum):
+    UNION = "UNION"
+    UNION_DISTINCT = "UNION DISTINCT"
+    INTERSECT = "INTERSECT"
+    MINUS = "MINUS"
+
+
+@dataclass
+class SetSentence(Sentence):
+    op: SetOp
+    left: Sentence
+    right: Sentence
+    kind = Kind.SET_OP
+
+    def to_string(self) -> str:
+        return f"({self.left.to_string()} {self.op.value} {self.right.to_string()})"
+
+
+# ---------------------------------------------------------------------------
+# maintain sentences (DDL; ref: parser/MaintainSentences.h)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class ColumnDef:
+    name: str
+    type_name: str                 # INT/DOUBLE/STRING/BOOL/TIMESTAMP/VID
+    default: Optional[Any] = None
+
+    def to_string(self) -> str:
+        s = f"{self.name} {self.type_name}"
+        if self.default is not None:
+            s += f" DEFAULT {self.default!r}"
+        return s
+
+
+@dataclass
+class SchemaOpts:
+    ttl_duration: Optional[int] = None
+    ttl_col: Optional[str] = None
+
+
+@dataclass
+class UseSentence(Sentence):
+    space: str
+    kind = Kind.USE
+
+    def to_string(self) -> str:
+        return f"USE {self.space}"
+
+
+@dataclass
+class CreateSpaceSentence(Sentence):
+    name: str
+    partition_num: int = 100
+    replica_factor: int = 1
+    if_not_exists: bool = False
+    kind = Kind.CREATE_SPACE
+
+    def to_string(self) -> str:
+        return (f"CREATE SPACE {self.name}(partition_num={self.partition_num}, "
+                f"replica_factor={self.replica_factor})")
+
+
+@dataclass
+class DropSpaceSentence(Sentence):
+    name: str
+    if_exists: bool = False
+    kind = Kind.DROP_SPACE
+
+    def to_string(self) -> str:
+        return f"DROP SPACE {self.name}"
+
+
+@dataclass
+class DescribeSpaceSentence(Sentence):
+    name: str
+    kind = Kind.DESCRIBE_SPACE
+
+    def to_string(self) -> str:
+        return f"DESCRIBE SPACE {self.name}"
+
+
+@dataclass
+class CreateSchemaSentence(Sentence):
+    """CREATE TAG / CREATE EDGE."""
+    is_edge: bool
+    name: str
+    columns: List[ColumnDef] = field(default_factory=list)
+    opts: SchemaOpts = field(default_factory=SchemaOpts)
+    if_not_exists: bool = False
+
+    @property
+    def kind(self):
+        return Kind.CREATE_EDGE if self.is_edge else Kind.CREATE_TAG
+
+    def to_string(self) -> str:
+        what = "EDGE" if self.is_edge else "TAG"
+        cols = ", ".join(c.to_string() for c in self.columns)
+        return f"CREATE {what} {self.name}({cols})"
+
+
+@dataclass
+class AlterSchemaSentence(Sentence):
+    is_edge: bool
+    name: str
+    adds: List[ColumnDef] = field(default_factory=list)
+    changes: List[ColumnDef] = field(default_factory=list)
+    drops: List[str] = field(default_factory=list)
+    opts: SchemaOpts = field(default_factory=SchemaOpts)
+
+    @property
+    def kind(self):
+        return Kind.ALTER_EDGE if self.is_edge else Kind.ALTER_TAG
+
+    def to_string(self) -> str:
+        what = "EDGE" if self.is_edge else "TAG"
+        parts = [f"ALTER {what} {self.name}"]
+        if self.adds:
+            parts.append("ADD (" + ", ".join(c.to_string() for c in self.adds) + ")")
+        if self.changes:
+            parts.append("CHANGE (" + ", ".join(c.to_string() for c in self.changes) + ")")
+        if self.drops:
+            parts.append("DROP (" + ", ".join(self.drops) + ")")
+        return " ".join(parts)
+
+
+@dataclass
+class DropSchemaSentence(Sentence):
+    is_edge: bool
+    name: str
+    if_exists: bool = False
+
+    @property
+    def kind(self):
+        return Kind.DROP_EDGE if self.is_edge else Kind.DROP_TAG
+
+    def to_string(self) -> str:
+        return f"DROP {'EDGE' if self.is_edge else 'TAG'} {self.name}"
+
+
+@dataclass
+class DescribeSchemaSentence(Sentence):
+    is_edge: bool
+    name: str
+
+    @property
+    def kind(self):
+        return Kind.DESCRIBE_EDGE if self.is_edge else Kind.DESCRIBE_TAG
+
+    def to_string(self) -> str:
+        return f"DESCRIBE {'EDGE' if self.is_edge else 'TAG'} {self.name}"
+
+
+# ---------------------------------------------------------------------------
+# mutate sentences (ref: parser/MutateSentences.h)
+# ---------------------------------------------------------------------------
+
+@dataclass
+class InsertVerticesSentence(Sentence):
+    # tag_items: [(tag_name, [prop names])]; rows: [(vid_expr, [value exprs])]
+    tag_items: List[Tuple[str, List[str]]]
+    rows: List[Tuple[Expression, List[Expression]]]
+    overwritable: bool = True
+    kind = Kind.INSERT_VERTICES
+
+    def to_string(self) -> str:
+        tags = ", ".join(f"{t}({', '.join(ps)})" for t, ps in self.tag_items)
+        rows = ", ".join(
+            f"{vid.to_string()}:({', '.join(v.to_string() for v in vals)})"
+            for vid, vals in self.rows)
+        return f"INSERT VERTEX {tags} VALUES {rows}"
+
+
+@dataclass
+class InsertEdgesSentence(Sentence):
+    edge: str
+    props: List[str]
+    # rows: [(src_expr, dst_expr, rank, [value exprs])]
+    rows: List[Tuple[Expression, Expression, int, List[Expression]]]
+    overwritable: bool = True
+    kind = Kind.INSERT_EDGES
+
+    def to_string(self) -> str:
+        rows = ", ".join(
+            f"{s.to_string()}->{d.to_string()}@{r}:"
+            f"({', '.join(v.to_string() for v in vals)})"
+            for s, d, r, vals in self.rows)
+        return f"INSERT EDGE {self.edge}({', '.join(self.props)}) VALUES {rows}"
+
+
+@dataclass
+class DeleteVerticesSentence(Sentence):
+    src: VertexRef
+    kind = Kind.DELETE_VERTICES
+
+    def to_string(self) -> str:
+        return f"DELETE VERTEX {self.src.to_string()}"
+
+
+@dataclass
+class DeleteEdgesSentence(Sentence):
+    edge: str
+    keys: List[EdgeKeyRef]
+    kind = Kind.DELETE_EDGES
+
+    def to_string(self) -> str:
+        return f"DELETE EDGE {self.edge} " + ", ".join(k.to_string() for k in self.keys)
+
+
+@dataclass
+class UpdateItem:
+    field_name: str
+    value: Expression
+
+    def to_string(self) -> str:
+        return f"{self.field_name} = {self.value.to_string()}"
+
+
+@dataclass
+class UpdateVertexSentence(Sentence):
+    vid: Expression
+    tag: Optional[str]
+    items: List[UpdateItem]
+    insertable: bool = False       # UPSERT
+    when: Optional[WhereClause] = None
+    yield_: Optional[YieldClause] = None
+    kind = Kind.UPDATE_VERTEX
+
+    def to_string(self) -> str:
+        verb = "UPSERT" if self.insertable else "UPDATE"
+        s = f"{verb} VERTEX {self.vid.to_string()} SET " + \
+            ", ".join(i.to_string() for i in self.items)
+        if self.when:
+            s += f" WHEN {self.when.filter.to_string()}"
+        if self.yield_:
+            s += " " + self.yield_.to_string()
+        return s
+
+
+@dataclass
+class UpdateEdgeSentence(Sentence):
+    src: Expression
+    dst: Expression
+    rank: int
+    edge: str
+    items: List[UpdateItem]
+    insertable: bool = False
+    when: Optional[WhereClause] = None
+    yield_: Optional[YieldClause] = None
+    kind = Kind.UPDATE_EDGE
+
+    def to_string(self) -> str:
+        verb = "UPSERT" if self.insertable else "UPDATE"
+        s = (f"{verb} EDGE {self.src.to_string()}->{self.dst.to_string()}"
+             f"@{self.rank} OF {self.edge} SET "
+             + ", ".join(i.to_string() for i in self.items))
+        if self.when:
+            s += f" WHEN {self.when.filter.to_string()}"
+        if self.yield_:
+            s += " " + self.yield_.to_string()
+        return s
+
+
+# ---------------------------------------------------------------------------
+# admin sentences (ref: parser/AdminSentences.h, UserSentences.h)
+# ---------------------------------------------------------------------------
+
+class ShowKind(enum.Enum):
+    SPACES = "SPACES"
+    TAGS = "TAGS"
+    EDGES = "EDGES"
+    HOSTS = "HOSTS"
+    PARTS = "PARTS"
+    USERS = "USERS"
+    ROLES = "ROLES"
+    CONFIGS = "CONFIGS"
+    VARIABLES = "VARIABLES"
+    SNAPSHOTS = "SNAPSHOTS"
+
+
+@dataclass
+class ShowSentence(Sentence):
+    what: ShowKind
+    arg: Optional[str] = None
+    kind = Kind.SHOW
+
+    def to_string(self) -> str:
+        return f"SHOW {self.what.value}" + (f" {self.arg}" if self.arg else "")
+
+
+@dataclass
+class ConfigSentence(Sentence):
+    action: str                    # SHOW | GET | SET
+    module: Optional[str] = None   # GRAPH | META | STORAGE
+    name: Optional[str] = None
+    value: Optional[Expression] = None
+    kind = Kind.CONFIG
+
+    def to_string(self) -> str:
+        s = f"{self.action} CONFIGS"
+        if self.module:
+            s += f" {self.module}"
+        if self.name:
+            s += f":{self.name}"
+        if self.value is not None:
+            s += f" = {self.value.to_string()}"
+        return s
+
+
+@dataclass
+class BalanceSentence(Sentence):
+    sub: str                       # DATA | LEADER | SHOW | STOP
+    plan_id: Optional[int] = None
+    remove_hosts: List[str] = field(default_factory=list)
+    kind = Kind.BALANCE
+
+    def to_string(self) -> str:
+        if self.sub == "SHOW":
+            return f"BALANCE DATA {self.plan_id}"
+        s = f"BALANCE {self.sub}"
+        if self.remove_hosts:
+            s += " REMOVE " + ", ".join(self.remove_hosts)
+        return s
+
+
+@dataclass
+class CreateUserSentence(Sentence):
+    user: str
+    password: str
+    if_not_exists: bool = False
+    kind = Kind.CREATE_USER
+
+    def to_string(self) -> str:
+        return f"CREATE USER {self.user} WITH PASSWORD \"***\""
+
+
+@dataclass
+class DropUserSentence(Sentence):
+    user: str
+    if_exists: bool = False
+    kind = Kind.DROP_USER
+
+    def to_string(self) -> str:
+        return f"DROP USER {self.user}"
+
+
+@dataclass
+class ChangePasswordSentence(Sentence):
+    user: str
+    new_password: str
+    old_password: Optional[str] = None
+    kind = Kind.CHANGE_PASSWORD
+
+    def to_string(self) -> str:
+        return f"CHANGE PASSWORD {self.user}"
+
+
+@dataclass
+class GrantSentence(Sentence):
+    role: str                      # GOD/ADMIN/USER/GUEST
+    user: str
+    space: str
+    kind = Kind.GRANT
+
+    def to_string(self) -> str:
+        return f"GRANT ROLE {self.role} ON {self.space} TO {self.user}"
+
+
+@dataclass
+class RevokeSentence(Sentence):
+    role: str
+    user: str
+    space: str
+    kind = Kind.REVOKE
+
+    def to_string(self) -> str:
+        return f"REVOKE ROLE {self.role} ON {self.space} FROM {self.user}"
+
+
+@dataclass
+class IngestSentence(Sentence):
+    kind = Kind.INGEST
+
+    def to_string(self) -> str:
+        return "INGEST"
+
+
+@dataclass
+class DownloadSentence(Sentence):
+    url: str = ""
+    kind = Kind.DOWNLOAD
+
+    def to_string(self) -> str:
+        return f"DOWNLOAD HDFS \"{self.url}\""
+
+
+@dataclass
+class CreateSnapshotSentence(Sentence):
+    kind = Kind.CREATE_SNAPSHOT
+
+    def to_string(self) -> str:
+        return "CREATE SNAPSHOT"
+
+
+@dataclass
+class DropSnapshotSentence(Sentence):
+    name: str = ""
+    kind = Kind.DROP_SNAPSHOT
+
+    def to_string(self) -> str:
+        return f"DROP SNAPSHOT {self.name}"

@@ -1,0 +1,183 @@
+"""nGQL lexer.
+
+Role parity with the reference's flex scanner (`parser/scanner.lex`,
+498 L): case-insensitive keywords, identifiers, int (dec/hex/oct) and
+double literals, single/double-quoted strings with escapes, the
+`$-` / `$^` / `$$` / `$var` reference sigils, and multi-char operators
+(`==`, `!=`, `<=`, `>=`, `&&`, `||`, `->`, `<-`). Hand-written
+table-driven scanner instead of generated flex — Python-native, and
+fast enough (the parse path is not the hot path; traversal is).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List, Optional
+
+KEYWORDS = {
+    "GO", "STEPS", "STEP", "UPTO", "FROM", "TO", "OVER", "WHERE", "YIELD",
+    "AS", "DISTINCT", "REVERSELY", "BIDIRECT", "ALL",
+    "FIND", "SHORTEST", "PATH", "NOLOOP",
+    "FETCH", "PROP", "ON",
+    "USE", "SPACE", "SPACES", "PARTITION_NUM", "REPLICA_FACTOR",
+    "CREATE", "DROP", "ALTER", "DESCRIBE", "DESC", "SHOW", "ADD", "CHANGE",
+    "IF", "NOT", "EXISTS",
+    "TAG", "TAGS", "EDGE", "EDGES", "VERTEX", "VERTICES",
+    "INSERT", "VALUES", "DELETE", "UPDATE", "UPSERT", "SET", "WHEN",
+    "INT", "INT64", "DOUBLE", "FLOAT", "STRING", "BOOL", "TIMESTAMP", "VID",
+    "TTL_DURATION", "TTL_COL", "DEFAULT",
+    "ORDER", "BY", "ASC", "LIMIT", "OFFSET", "GROUP",
+    "UNION", "INTERSECT", "MINUS",
+    "TRUE", "FALSE", "NULL",
+    "AND", "OR", "XOR", "CONTAINS", "UUID", "HOSTS", "PARTS", "PART",
+    "CONFIGS", "GET", "VARIABLES", "GRAPH", "META", "STORAGE",
+    "BALANCE", "DATA", "LEADER", "REMOVE", "PLAN", "STOP",
+    "USER", "USERS", "PASSWORD", "CHANGE", "GRANT", "REVOKE", "ROLE",
+    "ROLES", "GOD", "ADMIN", "GUEST", "WITH",
+    "INGEST", "DOWNLOAD", "HDFS", "SUBMIT", "JOB", "JOBS",
+    "SNAPSHOT", "SNAPSHOTS",
+}
+
+# token types
+T_EOF = "EOF"
+T_ID = "ID"
+T_INT = "INT_LIT"
+T_DOUBLE = "DOUBLE_LIT"
+T_STRING = "STR_LIT"
+T_LABEL = "LABEL"  # `backticked`
+
+
+@dataclass
+class Token:
+    type: str          # keyword name, symbol, or T_* class
+    value: object      # literal value / identifier text
+    pos: int           # byte offset in query (for error messages)
+
+    def __repr__(self):
+        return f"Token({self.type}, {self.value!r})"
+
+
+class LexError(Exception):
+    def __init__(self, msg: str, pos: int):
+        super().__init__(f"{msg} near offset {pos}")
+        self.pos = pos
+
+
+_SYMBOLS2 = {"==", "!=", "<=", ">=", "&&", "||", "->", "<-", "=~"}
+_SYMBOLS1 = set("()[]{},;|.$@=<>+-*/%!^:")
+
+
+def tokenize(text: str) -> List[Token]:
+    toks: List[Token] = []
+    i, n = 0, len(text)
+    while i < n:
+        c = text[i]
+        if c in " \t\r\n":
+            i += 1
+            continue
+        if c == "#" or (c == "/" and i + 1 < n and text[i + 1] == "/"):
+            while i < n and text[i] != "\n":
+                i += 1
+            continue
+        if c == "-" and text[i:i + 2] == "--":
+            while i < n and text[i] != "\n":
+                i += 1
+            continue
+        if c == "/" and text[i:i + 2] == "/*":
+            j = text.find("*/", i + 2)
+            if j < 0:
+                raise LexError("unterminated comment", i)
+            i = j + 2
+            continue
+        start = i
+        # strings
+        if c in "'\"":
+            quote = c
+            i += 1
+            out = []
+            while i < n and text[i] != quote:
+                if text[i] == "\\" and i + 1 < n:
+                    esc = text[i + 1]
+                    out.append({"n": "\n", "t": "\t", "r": "\r", "\\": "\\",
+                                "'": "'", '"': '"', "0": "\0"}.get(esc, esc))
+                    i += 2
+                else:
+                    out.append(text[i])
+                    i += 1
+            if i >= n:
+                raise LexError("unterminated string", start)
+            i += 1
+            toks.append(Token(T_STRING, "".join(out), start))
+            continue
+        # backticked label
+        if c == "`":
+            j = text.find("`", i + 1)
+            if j < 0:
+                raise LexError("unterminated label", i)
+            toks.append(Token(T_ID, text[i + 1:j], start))
+            i = j + 1
+            continue
+        # numbers
+        if c.isdigit() or (c == "." and i + 1 < n and text[i + 1].isdigit()):
+            j = i
+            is_double = False
+            if text[j:j + 2].lower() == "0x":
+                j += 2
+                while j < n and text[j] in "0123456789abcdefABCDEF":
+                    j += 1
+                toks.append(Token(T_INT, int(text[i:j], 16), start))
+                i = j
+                continue
+            while j < n and text[j].isdigit():
+                j += 1
+            if j < n and text[j] == ".":
+                if j + 1 < n and text[j + 1].isdigit():
+                    is_double = True
+                    j += 1
+                    while j < n and text[j].isdigit():
+                        j += 1
+                elif not (j + 1 < n and (text[j + 1].isalpha() or text[j + 1] == "_")):
+                    # "1." style double (but not "1.prop")
+                    is_double = True
+                    j += 1
+            if j < n and text[j] in "eE" and is_double:
+                k = j + 1
+                if k < n and text[k] in "+-":
+                    k += 1
+                if k < n and text[k].isdigit():
+                    j = k
+                    while j < n and text[j].isdigit():
+                        j += 1
+            if is_double:
+                toks.append(Token(T_DOUBLE, float(text[i:j]), start))
+            else:
+                lit = text[i:j]
+                # leading-zero octal like the reference scanner
+                val = int(lit, 8) if len(lit) > 1 and lit[0] == "0" else int(lit)
+                toks.append(Token(T_INT, val, start))
+            i = j
+            continue
+        # identifiers / keywords
+        if c.isalpha() or c == "_":
+            j = i
+            while j < n and (text[j].isalnum() or text[j] == "_"):
+                j += 1
+            word = text[i:j]
+            up = word.upper()
+            if up in KEYWORDS:
+                toks.append(Token(up, word, start))
+            else:
+                toks.append(Token(T_ID, word, start))
+            i = j
+            continue
+        # two-char symbols
+        if text[i:i + 2] in _SYMBOLS2:
+            toks.append(Token(text[i:i + 2], text[i:i + 2], start))
+            i += 2
+            continue
+        if c in _SYMBOLS1:
+            toks.append(Token(c, c, start))
+            i += 1
+            continue
+        raise LexError(f"unexpected character {c!r}", i)
+    toks.append(Token(T_EOF, None, n))
+    return toks

@@ -1,0 +1,861 @@
+"""nGQL recursive-descent parser.
+
+Role parity with the reference's bison grammar (`parser/parser.yy`,
+1802 L; expression precedence ladder at :130-143) and `GQLParser.h`
+entry point. Hand-written recursive descent with precedence climbing
+instead of generated LALR — same language surface, direct AST
+construction, and friendlier error messages.
+
+Statement combinators, lowest to highest binding:
+    stmt ';' stmt          SequentialSentences
+    $var '=' stmt          AssignmentSentence
+    stmt UNION/INTERSECT/MINUS stmt
+    stmt '|' stmt          PipedSentence
+Expression precedence (low→high): OR/|| < XOR < AND/&& < relational
+(==,!=,<,<=,>,>=,CONTAINS) < additive < multiplicative < unary < primary.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..filter.expressions import (ArithmeticExpr, DestPropExpr, EdgeDstIdExpr,
+                                  EdgePropExpr, EdgeRankExpr, EdgeSrcIdExpr,
+                                  EdgeTypeExpr, Expression, FunctionCall,
+                                  InputPropExpr, Literal, LogicalExpr,
+                                  RelationalExpr, SourcePropExpr, TypeCastExpr,
+                                  UnaryExpr, VariablePropExpr)
+from . import ast
+from .lexer import (T_DOUBLE, T_EOF, T_ID, T_INT, T_STRING, LexError, Token,
+                    tokenize)
+
+AGG_FUNS = {"COUNT", "SUM", "AVG", "MAX", "MIN", "STD",
+            "BIT_AND", "BIT_OR", "BIT_XOR", "COUNT_DISTINCT", "COLLECT"}
+
+_TYPE_KWS = {"INT", "INT64", "DOUBLE", "FLOAT", "STRING", "BOOL", "TIMESTAMP", "VID"}
+
+
+class ParseError(Exception):
+    def __init__(self, msg: str, tok: Optional[Token] = None):
+        loc = f" (near {tok.value!r}, offset {tok.pos})" if tok and tok.value is not None else ""
+        super().__init__(f"SyntaxError: {msg}{loc}")
+
+
+class GQLParser:
+    """parse(query) -> ast.SequentialSentences (ref: parser/GQLParser.h)."""
+
+    def parse(self, text: str) -> ast.SequentialSentences:
+        try:
+            self.toks = tokenize(text)
+        except LexError as e:
+            raise ParseError(str(e))
+        self.i = 0
+        sentences = []
+        while not self._at(T_EOF):
+            if self._accept(";"):
+                continue
+            sentences.append(self._statement())
+        if not sentences:
+            raise ParseError("empty statement")
+        return ast.SequentialSentences(sentences)
+
+    # ------------------------------------------------------------------
+    # token helpers
+    # ------------------------------------------------------------------
+    def _peek(self, k: int = 0) -> Token:
+        j = min(self.i + k, len(self.toks) - 1)
+        return self.toks[j]
+
+    def _at(self, *types: str) -> bool:
+        return self.toks[self.i].type in types
+
+    def _accept(self, *types: str) -> Optional[Token]:
+        if self._at(*types):
+            t = self.toks[self.i]
+            self.i += 1
+            return t
+        return None
+
+    def _expect(self, *types: str) -> Token:
+        if not self._at(*types):
+            raise ParseError(f"expected {' or '.join(types)}", self._peek())
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def _ident(self, what: str = "identifier") -> str:
+        # keywords usable as identifiers where unambiguous (like the
+        # reference's unreserved-keyword rule)
+        t = self._peek()
+        if t.type == T_ID:
+            self.i += 1
+            return t.value
+        from .lexer import KEYWORDS
+        if t.type in KEYWORDS and isinstance(t.value, str):
+            self.i += 1
+            return t.value
+        raise ParseError(f"expected {what}", t)
+
+    # ------------------------------------------------------------------
+    # statements
+    # ------------------------------------------------------------------
+    def _statement(self) -> ast.Sentence:
+        # $var = <set expr>
+        if self._at("$") and self._peek(1).type == T_ID and self._peek(2).type == "=":
+            self._expect("$")
+            var = self._ident()
+            self._expect("=")
+            return ast.AssignmentSentence(var, self._set_expr())
+        return self._set_expr()
+
+    def _set_expr(self) -> ast.Sentence:
+        left = self._piped()
+        while self._at("UNION", "INTERSECT", "MINUS"):
+            t = self._expect("UNION", "INTERSECT", "MINUS")
+            if t.type == "UNION":
+                if self._accept("DISTINCT"):
+                    op = ast.SetOp.UNION_DISTINCT
+                else:
+                    self._accept("ALL")
+                    op = ast.SetOp.UNION
+            else:
+                op = ast.SetOp[t.type]
+            right = self._piped()
+            left = ast.SetSentence(op, left, right)
+        return left
+
+    def _piped(self) -> ast.Sentence:
+        left = self._simple()
+        while self._accept("|"):
+            right = self._simple()
+            left = ast.PipedSentence(left, right)
+        return left
+
+    def _simple(self) -> ast.Sentence:
+        t = self._peek()
+        tt = t.type
+        if tt == "GO":
+            return self._go()
+        if tt == "FIND":
+            return self._find_path()
+        if tt == "FETCH":
+            return self._fetch()
+        if tt == "USE":
+            self.i += 1
+            return ast.UseSentence(self._ident("space name"))
+        if tt == "CREATE":
+            return self._create()
+        if tt == "DROP":
+            return self._drop()
+        if tt in ("DESCRIBE", "DESC"):
+            return self._describe()
+        if tt == "ALTER":
+            return self._alter()
+        if tt == "INSERT":
+            return self._insert()
+        if tt == "DELETE":
+            return self._delete()
+        if tt in ("UPDATE", "UPSERT"):
+            return self._update()
+        if tt == "YIELD":
+            return self._yield_sentence()
+        if tt == "ORDER":
+            return self._order_by()
+        if tt == "LIMIT":
+            return self._limit()
+        if tt == "GROUP":
+            return self._group_by()
+        if tt == "SHOW":
+            return self._show()
+        if tt == "GET":
+            return self._configs_get()
+        if tt == "BALANCE":
+            return self._balance()
+        if tt == "CHANGE":
+            return self._change_password()
+        if tt == "GRANT":
+            return self._grant(revoke=False)
+        if tt == "REVOKE":
+            return self._grant(revoke=True)
+        if tt == "INGEST":
+            self.i += 1
+            return ast.IngestSentence()
+        if tt == "DOWNLOAD":
+            self.i += 1
+            self._expect("HDFS")
+            return ast.DownloadSentence(self._expect(T_STRING).value)
+        if tt == "(":
+            self.i += 1
+            inner = self._set_expr()
+            self._expect(")")
+            return inner
+        raise ParseError("unknown statement", t)
+
+    # --- traversals ---------------------------------------------------
+    def _go(self) -> ast.GoSentence:
+        self._expect("GO")
+        step = ast.StepClause(1)
+        if self._at(T_INT):
+            n = self._expect(T_INT).value
+            self._expect("STEPS", "STEP")
+            step = ast.StepClause(n)
+        elif self._accept("UPTO"):
+            n = self._expect(T_INT).value
+            self._expect("STEPS", "STEP")
+            step = ast.StepClause(n, upto=True)
+        self._expect("FROM")
+        from_ = self._vertex_ref()
+        over = self._over_clause()
+        where = self._opt_where()
+        yld = self._opt_yield()
+        return ast.GoSentence(step, from_, over, where, yld)
+
+    def _find_path(self) -> ast.FindPathSentence:
+        self._expect("FIND")
+        shortest = noloop = False
+        if self._accept("SHORTEST"):
+            shortest = True
+        elif self._accept("NOLOOP"):
+            noloop = True
+        else:
+            self._expect("ALL")
+        self._expect("PATH")
+        self._expect("FROM")
+        from_ = self._vertex_ref()
+        self._expect("TO")
+        to = self._vertex_ref()
+        over = self._over_clause()
+        step = ast.StepClause(5, upto=True)
+        if self._accept("UPTO"):
+            n = self._expect(T_INT).value
+            self._expect("STEPS", "STEP")
+            step = ast.StepClause(n, upto=True)
+        return ast.FindPathSentence(shortest, from_, to, over, step, noloop)
+
+    def _fetch(self):
+        self._expect("FETCH")
+        self._expect("PROP")
+        self._expect("ON")
+        if self._accept("*"):
+            name = "*"
+        else:
+            name = self._ident("tag or edge name")
+        # input/variable ref?
+        if self._at("$"):
+            ref = self._expression()
+            yld = self._opt_yield()
+            # decided tag-vs-edge at execution time; vertices by default,
+            # executor re-dispatches if name is an edge
+            return ast.FetchVerticesSentence(name, ast.VertexRef(ref=ref), yld)
+        first = self._expression()
+        if self._at("->"):
+            keys = [self._edge_key_tail(first)]
+            while self._accept(","):
+                keys.append(self._edge_key_tail(self._expression()))
+            yld = self._opt_yield()
+            return ast.FetchEdgesSentence(name, keys, None, yld)
+        vids = [first]
+        while self._accept(","):
+            vids.append(self._expression())
+        yld = self._opt_yield()
+        return ast.FetchVerticesSentence(name, ast.VertexRef(vids=vids), yld)
+
+    def _edge_key_tail(self, src: Expression) -> ast.EdgeKeyRef:
+        self._expect("->")
+        dst = self._expression()
+        rank = 0
+        if self._accept("@"):
+            neg = bool(self._accept("-"))
+            rank = self._expect(T_INT).value
+            if neg:
+                rank = -rank
+        return ast.EdgeKeyRef(src, dst, rank)
+
+    def _vertex_ref(self) -> ast.VertexRef:
+        if self._at("$"):
+            return ast.VertexRef(ref=self._expression())
+        vids = [self._expression()]
+        while self._accept(","):
+            vids.append(self._expression())
+        return ast.VertexRef(vids=vids)
+
+    def _over_clause(self) -> ast.OverClause:
+        self._expect("OVER")
+        if self._accept("*"):
+            over = ast.OverClause(is_all=True)
+        else:
+            edges = [self._over_edge()]
+            while self._accept(","):
+                edges.append(self._over_edge())
+            over = ast.OverClause(edges=edges)
+        if self._accept("REVERSELY"):
+            over.direction = ast.Direction.IN
+        elif self._accept("BIDIRECT"):
+            over.direction = ast.Direction.BOTH
+        return over
+
+    def _over_edge(self) -> ast.OverEdge:
+        name = self._ident("edge name")
+        alias = None
+        if self._accept("AS"):
+            alias = self._ident("alias")
+        return ast.OverEdge(name, alias)
+
+    def _opt_where(self) -> Optional[ast.WhereClause]:
+        if self._accept("WHERE"):
+            return ast.WhereClause(self._expression())
+        return None
+
+    def _opt_yield(self) -> Optional[ast.YieldClause]:
+        if self._at("YIELD"):
+            return self._yield_clause()
+        return None
+
+    def _yield_clause(self) -> ast.YieldClause:
+        self._expect("YIELD")
+        distinct = bool(self._accept("DISTINCT"))
+        cols = [self._yield_column()]
+        while self._accept(","):
+            cols.append(self._yield_column())
+        return ast.YieldClause(cols, distinct)
+
+    def _yield_column(self) -> ast.YieldColumn:
+        # aggregate call? COUNT(*), SUM(expr), ...
+        t = self._peek()
+        if t.type == T_ID and t.value.upper() in AGG_FUNS and self._peek(1).type == "(":
+            fun = t.value.upper()
+            self.i += 2
+            if fun == "COUNT" and self._accept("*"):
+                inner: Expression = Literal(1)
+            elif fun == "COUNT" and self._accept("DISTINCT"):
+                fun = "COUNT_DISTINCT"
+                inner = self._expression()
+            else:
+                inner = self._expression()
+            self._expect(")")
+            alias = self._ident("alias") if self._accept("AS") else None
+            return ast.YieldColumn(inner, alias, agg_fun=fun)
+        expr = self._expression()
+        alias = self._ident("alias") if self._accept("AS") else None
+        return ast.YieldColumn(expr, alias)
+
+    def _yield_sentence(self) -> ast.YieldSentence:
+        yld = self._yield_clause()
+        where = self._opt_where()
+        return ast.YieldSentence(yld, where)
+
+    def _order_by(self) -> ast.OrderBySentence:
+        self._expect("ORDER")
+        self._expect("BY")
+        factors = [self._order_factor()]
+        while self._accept(","):
+            factors.append(self._order_factor())
+        return ast.OrderBySentence(factors)
+
+    def _order_factor(self) -> ast.OrderFactor:
+        expr = self._expression()
+        asc = True
+        if self._accept("DESC"):
+            asc = False
+        else:
+            self._accept("ASC")
+        return ast.OrderFactor(expr, asc)
+
+    def _limit(self) -> ast.LimitSentence:
+        self._expect("LIMIT")
+        a = self._expect(T_INT).value
+        if self._accept(","):
+            b = self._expect(T_INT).value
+            return ast.LimitSentence(count=b, offset=a)
+        if self._accept("OFFSET"):
+            b = self._expect(T_INT).value
+            return ast.LimitSentence(count=a, offset=b)
+        return ast.LimitSentence(count=a)
+
+    def _group_by(self) -> ast.GroupBySentence:
+        self._expect("GROUP")
+        self._expect("BY")
+        cols = [self._yield_column()]
+        while self._accept(","):
+            cols.append(self._yield_column())
+        yld = self._yield_clause()
+        return ast.GroupBySentence(cols, yld)
+
+    # --- DDL ----------------------------------------------------------
+    def _if_not_exists(self) -> bool:
+        if self._at("IF") and self._peek(1).type == "NOT":
+            self.i += 2
+            self._expect("EXISTS")
+            return True
+        return False
+
+    def _if_exists(self) -> bool:
+        if self._accept("IF"):
+            self._expect("EXISTS")
+            return True
+        return False
+
+    def _create(self):
+        self._expect("CREATE")
+        if self._accept("SPACE"):
+            ine = self._if_not_exists()
+            name = self._ident("space name")
+            part_num, replica = 100, 1
+            if self._accept("("):
+                while not self._accept(")"):
+                    opt = self._ident("space option")
+                    self._expect("=")
+                    val = self._expect(T_INT).value
+                    if opt.lower() == "partition_num":
+                        part_num = val
+                    elif opt.lower() == "replica_factor":
+                        replica = val
+                    else:
+                        raise ParseError(f"unknown space option {opt}")
+                    self._accept(",")
+            return ast.CreateSpaceSentence(name, part_num, replica, ine)
+        if self._at("TAG", "EDGE"):
+            is_edge = self._expect("TAG", "EDGE").type == "EDGE"
+            ine = self._if_not_exists()
+            name = self._ident()
+            cols: List[ast.ColumnDef] = []
+            if self._accept("("):
+                while not self._at(")"):
+                    cols.append(self._column_def())
+                    if not self._accept(","):
+                        break
+                self._expect(")")
+            opts = self._schema_opts()
+            return ast.CreateSchemaSentence(is_edge, name, cols, opts, ine)
+        if self._accept("USER"):
+            ine = self._if_not_exists()
+            user = self._ident("user name")
+            self._expect("WITH")
+            self._expect("PASSWORD")
+            pw = self._expect(T_STRING).value
+            return ast.CreateUserSentence(user, pw, ine)
+        if self._accept("SNAPSHOT"):
+            return ast.CreateSnapshotSentence()
+        raise ParseError("expected SPACE, TAG, EDGE, USER or SNAPSHOT", self._peek())
+
+    def _column_def(self) -> ast.ColumnDef:
+        name = self._ident("column name")
+        t = self._expect(*_TYPE_KWS)
+        default = None
+        if self._accept("DEFAULT"):
+            d = self._expression()
+            if not isinstance(d, Literal):
+                try:
+                    from ..filter.expressions import ExpressionContext
+                    d = Literal(d.eval(ExpressionContext()))
+                except Exception:
+                    raise ParseError("DEFAULT value must be a constant")
+            default = d.value
+        return ast.ColumnDef(name, t.type, default)
+
+    def _schema_opts(self) -> ast.SchemaOpts:
+        opts = ast.SchemaOpts()
+        while self._at("TTL_DURATION", "TTL_COL"):
+            t = self._expect("TTL_DURATION", "TTL_COL")
+            self._expect("=")
+            if t.type == "TTL_DURATION":
+                opts.ttl_duration = self._expect(T_INT).value
+            else:
+                opts.ttl_col = self._expect(T_STRING, T_ID).value
+            self._accept(",")
+        return opts
+
+    def _drop(self):
+        self._expect("DROP")
+        if self._accept("SPACE"):
+            ie = self._if_exists()
+            return ast.DropSpaceSentence(self._ident(), ie)
+        if self._at("TAG", "EDGE"):
+            is_edge = self._expect("TAG", "EDGE").type == "EDGE"
+            ie = self._if_exists()
+            return ast.DropSchemaSentence(is_edge, self._ident(), ie)
+        if self._accept("USER"):
+            ie = self._if_exists()
+            return ast.DropUserSentence(self._ident(), ie)
+        if self._accept("SNAPSHOT"):
+            return ast.DropSnapshotSentence(self._ident())
+        raise ParseError("expected SPACE, TAG, EDGE, USER or SNAPSHOT", self._peek())
+
+    def _describe(self):
+        self._expect("DESCRIBE", "DESC")
+        if self._accept("SPACE"):
+            return ast.DescribeSpaceSentence(self._ident())
+        is_edge = self._expect("TAG", "EDGE").type == "EDGE"
+        return ast.DescribeSchemaSentence(is_edge, self._ident())
+
+    def _alter(self):
+        self._expect("ALTER")
+        if self._accept("USER"):
+            user = self._ident()
+            self._expect("WITH")
+            self._expect("PASSWORD")
+            pw = self._expect(T_STRING).value
+            s = ast.ChangePasswordSentence(user, pw)
+            s.kind = ast.Kind.ALTER_USER
+            return s
+        is_edge = self._expect("TAG", "EDGE").type == "EDGE"
+        name = self._ident()
+        out = ast.AlterSchemaSentence(is_edge, name)
+        while self._at("ADD", "CHANGE", "DROP", "TTL_DURATION", "TTL_COL"):
+            if self._at("TTL_DURATION", "TTL_COL"):
+                out.opts = self._schema_opts()
+                continue
+            op = self._expect("ADD", "CHANGE", "DROP").type
+            self._expect("(")
+            if op == "DROP":
+                out.drops.append(self._ident())
+                while self._accept(","):
+                    out.drops.append(self._ident())
+            else:
+                target = out.adds if op == "ADD" else out.changes
+                target.append(self._column_def())
+                while self._accept(","):
+                    target.append(self._column_def())
+            self._expect(")")
+            self._accept(",")
+        return out
+
+    # --- DML ----------------------------------------------------------
+    def _insert(self):
+        self._expect("INSERT")
+        what = self._expect("VERTEX", "EDGE").type
+        if what == "VERTEX":
+            tag_items: List[Tuple[str, List[str]]] = []
+            while True:
+                tag = self._ident("tag name")
+                props: List[str] = []
+                self._expect("(")
+                while not self._at(")"):
+                    props.append(self._ident("prop name"))
+                    if not self._accept(","):
+                        break
+                self._expect(")")
+                tag_items.append((tag, props))
+                if not self._accept(","):
+                    break
+            self._expect("VALUES")
+            rows = []
+            while True:
+                vid = self._expression()
+                self._expect(":")
+                self._expect("(")
+                vals: List[Expression] = []
+                while not self._at(")"):
+                    vals.append(self._expression())
+                    if not self._accept(","):
+                        break
+                self._expect(")")
+                rows.append((vid, vals))
+                if not self._accept(","):
+                    break
+            return ast.InsertVerticesSentence(tag_items, rows)
+        edge = self._ident("edge name")
+        props = []
+        self._expect("(")
+        while not self._at(")"):
+            props.append(self._ident("prop name"))
+            if not self._accept(","):
+                break
+        self._expect(")")
+        self._expect("VALUES")
+        rows = []
+        while True:
+            src = self._expression()
+            self._expect("->")
+            dst = self._expression()
+            rank = 0
+            if self._accept("@"):
+                neg = bool(self._accept("-"))
+                rank = self._expect(T_INT).value
+                if neg:
+                    rank = -rank
+            self._expect(":")
+            self._expect("(")
+            vals = []
+            while not self._at(")"):
+                vals.append(self._expression())
+                if not self._accept(","):
+                    break
+            self._expect(")")
+            rows.append((src, dst, rank, vals))
+            if not self._accept(","):
+                break
+        return ast.InsertEdgesSentence(edge, props, rows)
+
+    def _delete(self):
+        self._expect("DELETE")
+        what = self._expect("VERTEX", "EDGE").type
+        if what == "VERTEX":
+            return ast.DeleteVerticesSentence(self._vertex_ref())
+        edge = self._ident("edge name")
+        keys = [self._edge_key_tail(self._expression())]
+        while self._accept(","):
+            keys.append(self._edge_key_tail(self._expression()))
+        return ast.DeleteEdgesSentence(edge, keys)
+
+    def _update(self):
+        verb = self._expect("UPDATE", "UPSERT").type
+        insertable = verb == "UPSERT"
+        what = self._expect("VERTEX", "EDGE").type
+        if what == "VERTEX":
+            vid = self._expression()
+            tag = None
+            self._expect("SET")
+            items = [self._update_item()]
+            while self._accept(","):
+                items.append(self._update_item())
+            when = ast.WhereClause(self._expression()) if self._accept("WHEN") else None
+            yld = self._opt_yield()
+            return ast.UpdateVertexSentence(vid, tag, items, insertable, when, yld)
+        src = self._expression()
+        self._expect("->")
+        dst = self._expression()
+        rank = 0
+        if self._accept("@"):
+            rank = self._expect(T_INT).value
+        # OF edge (lexes as ID "OF")
+        t = self._peek()
+        if t.type == T_ID and t.value.upper() == "OF":
+            self.i += 1
+        edge = self._ident("edge name")
+        self._expect("SET")
+        items = [self._update_item()]
+        while self._accept(","):
+            items.append(self._update_item())
+        when = ast.WhereClause(self._expression()) if self._accept("WHEN") else None
+        yld = self._opt_yield()
+        return ast.UpdateEdgeSentence(src, dst, rank, edge, items, insertable, when, yld)
+
+    def _update_item(self) -> ast.UpdateItem:
+        name = self._ident("field name")
+        if self._accept("."):
+            name = self._ident("field name")  # tag.field form
+        self._expect("=")
+        return ast.UpdateItem(name, self._expression())
+
+    # --- admin --------------------------------------------------------
+    def _show(self):
+        self._expect("SHOW")
+        if self._accept("CONFIGS"):
+            module = None
+            if self._at("GRAPH", "META", "STORAGE"):
+                module = self._expect("GRAPH", "META", "STORAGE").type
+            return ast.ConfigSentence("SHOW", module)
+        t = self._expect("SPACES", "TAGS", "EDGES", "HOSTS", "PARTS", "USERS",
+                         "ROLES", "VARIABLES", "SNAPSHOTS")
+        arg = None
+        if t.type == "ROLES":
+            self._expect("IF")  # not reachable; ROLES IN space
+        if t.type == "PARTS" and self._at(T_INT):
+            arg = str(self._expect(T_INT).value)
+        return ast.ShowSentence(ast.ShowKind[t.type], arg)
+
+    def _configs_get(self):
+        self._expect("GET")
+        self._expect("CONFIGS")
+        module = None
+        if self._at("GRAPH", "META", "STORAGE"):
+            module = self._expect("GRAPH", "META", "STORAGE").type
+            self._accept(":")
+        name = self._ident("config name")
+        return ast.ConfigSentence("GET", module, name)
+
+    def _balance(self):
+        self._expect("BALANCE")
+        if self._accept("LEADER"):
+            return ast.BalanceSentence("LEADER")
+        self._expect("DATA")
+        if self._at(T_INT):
+            return ast.BalanceSentence("SHOW", plan_id=self._expect(T_INT).value)
+        if self._accept("STOP"):
+            return ast.BalanceSentence("STOP")
+        hosts = []
+        if self._accept("REMOVE"):
+            while True:
+                ip = self._expect(T_STRING, T_ID).value
+                self._expect(":")
+                port = self._expect(T_INT).value
+                hosts.append(f"{ip}:{port}")
+                if not self._accept(","):
+                    break
+        return ast.BalanceSentence("DATA", remove_hosts=hosts)
+
+    def _change_password(self):
+        self._expect("CHANGE")
+        self._expect("PASSWORD")
+        user = self._ident("user name")
+        self._expect("FROM")
+        old = self._expect(T_STRING).value
+        self._expect("TO")
+        new = self._expect(T_STRING).value
+        return ast.ChangePasswordSentence(user, new, old)
+
+    def _grant(self, revoke: bool):
+        self._expect("REVOKE" if revoke else "GRANT")
+        self._accept("ROLE")
+        role = self._expect("GOD", "ADMIN", "USER", "GUEST").type
+        self._expect("ON")
+        space = self._ident("space name")
+        self._expect("FROM" if revoke else "TO")
+        user = self._ident("user name")
+        if revoke:
+            return ast.RevokeSentence(role, user, space)
+        return ast.GrantSentence(role, user, space)
+
+    # ------------------------------------------------------------------
+    # expressions (precedence climbing)
+    # ------------------------------------------------------------------
+    def _expression(self) -> Expression:
+        return self._or_expr()
+
+    def _or_expr(self) -> Expression:
+        left = self._xor_expr()
+        while True:
+            if self._accept("||") or self._accept("OR"):
+                left = LogicalExpr("||", left, self._xor_expr())
+            else:
+                return left
+
+    def _xor_expr(self) -> Expression:
+        left = self._and_expr()
+        while self._accept("XOR"):
+            left = LogicalExpr("XOR", left, self._and_expr())
+        return left
+
+    def _and_expr(self) -> Expression:
+        left = self._rel_expr()
+        while True:
+            if self._accept("&&") or self._accept("AND"):
+                left = LogicalExpr("&&", left, self._rel_expr())
+            else:
+                return left
+
+    _REL_OPS = {"==": "==", "!=": "!=", "<": "<", "<=": "<=", ">": ">", ">=": ">="}
+
+    def _rel_expr(self) -> Expression:
+        left = self._add_expr()
+        while True:
+            t = self._peek()
+            if t.type in self._REL_OPS:
+                self.i += 1
+                left = RelationalExpr(self._REL_OPS[t.type], left, self._add_expr())
+            elif t.type == "CONTAINS":
+                self.i += 1
+                left = RelationalExpr("CONTAINS", left, self._add_expr())
+            else:
+                return left
+
+    def _add_expr(self) -> Expression:
+        left = self._mul_expr()
+        while self._at("+", "-"):
+            op = self._expect("+", "-").type
+            left = ArithmeticExpr(op, left, self._mul_expr())
+        return left
+
+    def _mul_expr(self) -> Expression:
+        left = self._unary_expr()
+        while self._at("*", "/", "%", "^"):
+            op = self._expect("*", "/", "%", "^").type
+            left = ArithmeticExpr(op, left, self._unary_expr())
+        return left
+
+    def _unary_expr(self) -> Expression:
+        if self._at("+", "-", "!"):
+            op = self._expect("+", "-", "!").type
+            operand = self._unary_expr()
+            if op == "-" and isinstance(operand, Literal) and \
+                    isinstance(operand.value, (int, float)) and not isinstance(operand.value, bool):
+                return Literal(-operand.value)
+            return UnaryExpr(op, operand)
+        if self._accept("NOT"):
+            return UnaryExpr("!", self._unary_expr())
+        return self._primary()
+
+    def _primary(self) -> Expression:
+        t = self._peek()
+        tt = t.type
+        if tt == T_INT or tt == T_DOUBLE or tt == T_STRING:
+            self.i += 1
+            return Literal(t.value)
+        if tt == "TRUE":
+            self.i += 1
+            return Literal(True)
+        if tt == "FALSE":
+            self.i += 1
+            return Literal(False)
+        if tt == "NULL":
+            self.i += 1
+            return Literal(None)
+        if tt == "(":
+            # type cast "(int)expr" vs parenthesized expr
+            if self._peek(1).type in _TYPE_KWS and self._peek(2).type == ")":
+                self.i += 1
+                type_tok = self._expect(*_TYPE_KWS)
+                self._expect(")")
+                tn = {"INT": "int", "INT64": "int", "DOUBLE": "double",
+                      "FLOAT": "double", "STRING": "string", "BOOL": "bool",
+                      "TIMESTAMP": "int", "VID": "int"}[type_tok.type]
+                return TypeCastExpr(tn, self._unary_expr())
+            self.i += 1
+            e = self._expression()
+            self._expect(")")
+            return e
+        if tt == "$":
+            return self._dollar_ref()
+        if tt == "UUID":
+            self.i += 1
+            self._expect("(")
+            name = self._expect(T_STRING).value
+            self._expect(")")
+            return FunctionCall("uuid", [Literal(name)])
+        if tt == T_ID:
+            # function call / edge.prop / bare prop
+            if self._peek(1).type == "(":
+                name = t.value
+                self.i += 2
+                args: List[Expression] = []
+                while not self._at(")"):
+                    args.append(self._expression())
+                    if not self._accept(","):
+                        break
+                self._expect(")")
+                return FunctionCall(name, args)
+            if self._peek(1).type == ".":
+                edge = t.value
+                self.i += 2
+                prop = self._ident("property name")
+                return _edge_prop(edge, prop)
+            self.i += 1
+            return _edge_prop(None, t.value)
+        raise ParseError("expected expression", t)
+
+    def _dollar_ref(self) -> Expression:
+        self._expect("$")
+        if self._accept("-"):
+            self._expect(".")
+            return InputPropExpr(self._ident("input column"))
+        if self._accept("^"):
+            self._expect(".")
+            tag = self._ident("tag name")
+            self._expect(".")
+            return SourcePropExpr(tag, self._ident("property name"))
+        if self._accept("$"):
+            self._expect(".")
+            tag = self._ident("tag name")
+            self._expect(".")
+            return DestPropExpr(tag, self._ident("property name"))
+        var = self._ident("variable name")
+        self._expect(".")
+        return VariablePropExpr(var, self._ident("column name"))
+
+
+def _edge_prop(edge: Optional[str], prop: str) -> Expression:
+    special = {"_src": EdgeSrcIdExpr, "_dst": EdgeDstIdExpr,
+               "_rank": EdgeRankExpr, "_type": EdgeTypeExpr}
+    if prop in special:
+        return special[prop](edge)
+    return EdgePropExpr(edge, prop)

@@ -1,0 +1,4 @@
+from .types import (VertexData, EdgeData, NewVertex, NewEdge, EdgeKey,  # noqa: F401
+                    BoundRequest, BoundResponse, PartResult, UpdateItemReq)
+from .processors import StorageService  # noqa: F401
+from .client import StorageClient  # noqa: F401

@@ -1,0 +1,249 @@
+"""StorageClient: partition routing + scatter/gather.
+
+Role parity with the reference's `storage/client/StorageClient.{cpp,inl}`:
+the client (living inside the query engine) maps each vertex id to its
+partition (`vid % num_parts + 1`, ref StorageClient.cpp:10-11), groups
+work per partition per leader host, fans one request out per host, and
+gathers per-part results with leader-cache fixups on E_LEADER_CHANGED
+(ref StorageClient.inl:73-160, 119-134).
+
+In a single-process deployment every partition routes to the local
+StorageService; in multi-process the `hosts` map routes to RPC proxies
+exposing the same method surface (rpc/storage_proxy).
+"""
+from __future__ import annotations
+
+from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ..common import keys as ku
+from ..common.status import ErrorCode, Status, StatusOr
+from ..meta.schema_manager import SchemaManager
+from .types import (BoundRequest, BoundResponse, EdgeData, EdgeKey,
+                    ExecResponse, NewEdge, NewVertex, PartResult,
+                    PropsResponse, UpdateItemReq, UpdateResponse, VertexData)
+
+
+class StorageClient:
+    def __init__(self, sm: SchemaManager,
+                 hosts: Optional[Dict[str, Any]] = None,
+                 part_to_host: Optional[Callable[[int, int], str]] = None,
+                 local_service=None):
+        """hosts: host -> service (in-proc handler or RPC proxy).
+        part_to_host: (space_id, part_id) -> host name (leader lookup).
+        local_service: shorthand for single-node deployments."""
+        self.sm = sm
+        if local_service is not None:
+            self._hosts = {"local": local_service}
+            self._part_to_host = lambda s, p: "local"
+        else:
+            self._hosts = hosts or {}
+            self._part_to_host = part_to_host or (lambda s, p: next(iter(self._hosts)))
+        self._leader_cache: Dict[Tuple[int, int], str] = {}
+        self._pool = ThreadPoolExecutor(max_workers=8,
+                                        thread_name_prefix="storage-client")
+
+    # ------------------------------------------------------------------
+    # routing
+    # ------------------------------------------------------------------
+    def part_id(self, space_id: int, vid: int) -> int:
+        n = self.sm.num_parts(space_id)
+        return ku.part_id(vid, n)
+
+    def _leader(self, space_id: int, part: int) -> str:
+        return self._leader_cache.get((space_id, part)) \
+            or self._part_to_host(space_id, part)
+
+    def _note_leader(self, space_id: int, part: int, leader: Optional[str]):
+        if leader:
+            self._leader_cache[(space_id, part)] = leader
+
+    def cluster_ids_to_parts(self, space_id: int,
+                             vids: List[int]) -> Dict[int, List[int]]:
+        out: Dict[int, List[int]] = {}
+        for vid in vids:
+            out.setdefault(self.part_id(space_id, vid), []).append(vid)
+        return out
+
+    def _group_by_host(self, space_id: int,
+                       parts: Dict[int, Any]) -> Dict[str, Dict[int, Any]]:
+        by_host: Dict[str, Dict[int, Any]] = {}
+        for part, payload in parts.items():
+            by_host.setdefault(self._leader(space_id, part), {})[part] = payload
+        return by_host
+
+    def _fanout(self, space_id: int, parts: Dict[int, Any], call, empty_resp,
+                merge) -> Any:
+        """Scatter per leader host, gather with leader-cache fixups
+        (ref: collectResponse)."""
+        by_host = self._group_by_host(space_id, parts)
+        futures = []
+        for host, host_parts in by_host.items():
+            svc = self._hosts[host]
+            futures.append(self._pool.submit(call, svc, host_parts))
+        resp = empty_resp
+        for fut in futures:
+            merge(resp, fut.result())
+        for part, result in resp.results.items():
+            if result.code == ErrorCode.E_LEADER_CHANGED:
+                self._note_leader(space_id, part, result.leader)
+        return resp
+
+    # ------------------------------------------------------------------
+    # reads
+    # ------------------------------------------------------------------
+    def get_neighbors(self, space_id: int, vids: List[int],
+                      edge_types: List[int],
+                      vertex_props: Optional[Dict[int, List[str]]] = None,
+                      edge_props: Optional[List[str]] = None,
+                      filter_bytes: Optional[bytes] = None,
+                      max_edges_per_vertex: Optional[int] = None) -> BoundResponse:
+        parts = self.cluster_ids_to_parts(space_id, vids)
+
+        def call(svc, host_parts):
+            return svc.get_bound(BoundRequest(
+                space_id=space_id, parts=host_parts, edge_types=edge_types,
+                vertex_props=vertex_props or {}, edge_props=edge_props,
+                filter=filter_bytes,
+                max_edges_per_vertex=max_edges_per_vertex))
+
+        def merge(acc: BoundResponse, part_resp: BoundResponse):
+            acc.results.update(part_resp.results)
+            acc.vertices.extend(part_resp.vertices)
+            acc.latency_us = max(acc.latency_us, part_resp.latency_us)
+
+        return self._fanout(space_id, parts, call, BoundResponse(), merge)
+
+    def get_vertex_props(self, space_id: int, vids: List[int],
+                         tag_ids: Optional[List[int]] = None) -> PropsResponse:
+        parts = self.cluster_ids_to_parts(space_id, vids)
+
+        def call(svc, host_parts):
+            return svc.get_vertex_props(space_id, host_parts, tag_ids)
+
+        def merge(acc, r):
+            acc.results.update(r.results)
+            acc.vertices.extend(r.vertices)
+
+        return self._fanout(space_id, parts, call, PropsResponse(), merge)
+
+    def get_edge_props(self, space_id: int, eks: List[EdgeKey]) -> PropsResponse:
+        parts: Dict[int, List[EdgeKey]] = {}
+        for ek in eks:
+            parts.setdefault(self.part_id(space_id, ek.src), []).append(ek)
+
+        def call(svc, host_parts):
+            return svc.get_edge_props(space_id, host_parts)
+
+        def merge(acc, r):
+            acc.results.update(r.results)
+            acc.edges.extend(r.edges)
+
+        return self._fanout(space_id, parts, call, PropsResponse(), merge)
+
+    # ------------------------------------------------------------------
+    # writes
+    # ------------------------------------------------------------------
+    def add_vertices(self, space_id: int, vertices: List[NewVertex],
+                     overwritable: bool = True) -> ExecResponse:
+        parts: Dict[int, List[NewVertex]] = {}
+        for nv in vertices:
+            parts.setdefault(self.part_id(space_id, nv.vid), []).append(nv)
+
+        def call(svc, host_parts):
+            return svc.add_vertices(space_id, host_parts, overwritable)
+
+        def merge(acc, r):
+            acc.results.update(r.results)
+
+        return self._fanout(space_id, parts, call, ExecResponse(), merge)
+
+    def add_edges(self, space_id: int, edges: List[NewEdge],
+                  overwritable: bool = True) -> ExecResponse:
+        """Writes the out-edge at src's part AND the reverse copy at dst's
+        part with negated type (the reference's in/out edge pair)."""
+        parts: Dict[int, List[NewEdge]] = {}
+        for e in edges:
+            parts.setdefault(self.part_id(space_id, e.src), []).append(e)
+            rev = NewEdge(e.dst, -e.etype, e.rank, e.src, e.row)
+            parts.setdefault(self.part_id(space_id, rev.src), []).append(rev)
+
+        def call(svc, host_parts):
+            return svc.add_edges(space_id, host_parts, overwritable)
+
+        def merge(acc, r):
+            acc.results.update(r.results)
+
+        return self._fanout(space_id, parts, call, ExecResponse(), merge)
+
+    def delete_vertices(self, space_id: int, vids: List[int]) -> ExecResponse:
+        resp = ExecResponse()
+        for vid in vids:
+            part = self.part_id(space_id, vid)
+            svc = self._hosts[self._leader(space_id, part)]
+            pr, local_keys = svc.get_edge_keys(space_id, part, vid)
+            if pr.code != ErrorCode.SUCCEEDED:
+                resp.results[part] = pr
+                continue
+            # counterpart keys live on the neighbor's part
+            remote: List[EdgeKey] = [EdgeKey(ek.dst, -ek.etype, ek.rank, ek.src)
+                                     for ek in local_keys]
+            if remote:
+                self.delete_edges(space_id, remote)
+            r = svc.delete_vertex(space_id, part, vid)
+            resp.results.update(r.results)
+        return resp
+
+    def delete_edges(self, space_id: int, eks: List[EdgeKey]) -> ExecResponse:
+        parts: Dict[int, List[EdgeKey]] = {}
+        for ek in eks:
+            parts.setdefault(self.part_id(space_id, ek.src), []).append(ek)
+            rev = EdgeKey(ek.dst, -ek.etype, ek.rank, ek.src)
+            parts.setdefault(self.part_id(space_id, rev.src), []).append(rev)
+
+        def call(svc, host_parts):
+            return svc.delete_edges(space_id, host_parts)
+
+        def merge(acc, r):
+            acc.results.update(r.results)
+
+        return self._fanout(space_id, parts, call, ExecResponse(), merge)
+
+    def update_vertex(self, space_id: int, vid: int, tag_id: int,
+                      items: List[UpdateItemReq], when: Optional[bytes] = None,
+                      insertable: bool = False,
+                      yield_props: Optional[List[str]] = None) -> UpdateResponse:
+        part = self.part_id(space_id, vid)
+        svc = self._hosts[self._leader(space_id, part)]
+        resp = svc.update_vertex(space_id, part, vid, tag_id, items, when,
+                                 insertable, yield_props)
+        if resp.code == ErrorCode.E_LEADER_CHANGED:
+            self._note_leader(space_id, part, resp.leader)
+        return resp
+
+    def update_edge(self, space_id: int, ek: EdgeKey,
+                    items: List[UpdateItemReq], when: Optional[bytes] = None,
+                    insertable: bool = False,
+                    yield_props: Optional[List[str]] = None) -> UpdateResponse:
+        part = self.part_id(space_id, ek.src)
+        svc = self._hosts[self._leader(space_id, part)]
+        resp = svc.update_edge(space_id, part, ek, items, when, insertable,
+                               yield_props)
+        if resp.code == ErrorCode.SUCCEEDED:
+            # keep the reverse copy in sync (goes beyond the reference,
+            # which leaves reversed scans stale after UPDATE EDGE)
+            rev_part = self.part_id(space_id, ek.dst)
+            rev_svc = self._hosts[self._leader(space_id, rev_part)]
+            rev_svc.update_edge(space_id, rev_part,
+                                EdgeKey(ek.dst, -ek.etype, ek.rank, ek.src),
+                                items, None, True, None)
+        elif resp.code == ErrorCode.E_LEADER_CHANGED:
+            self._note_leader(space_id, part, resp.leader)
+        return resp
+
+    def get_uuid(self, space_id: int, name: str) -> Tuple[PartResult, int]:
+        from ..filter.functions import _fnv1a64
+        n = self.sm.num_parts(space_id)
+        part = ku.part_id(_fnv1a64(name.encode("utf-8")), n)
+        svc = self._hosts[self._leader(space_id, part)]
+        return svc.get_uuid(space_id, part, name)

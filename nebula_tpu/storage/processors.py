@@ -1,0 +1,533 @@
+"""Storage service processors — the CPU data plane.
+
+Role parity with the reference's `src/storage/` processor classes:
+
+  get_bound        <- QueryBoundProcessor (the GetNeighbors hot path,
+                      ref storage/QueryBaseProcessor.inl:292-562)
+  get_vertex_props <- QueryVertexPropsProcessor
+  get_edge_props   <- QueryEdgePropsProcessor
+  get_edge_keys    <- QueryEdgeKeysProcessor (used by DELETE VERTEX)
+  add_vertices     <- AddVerticesProcessor (decreasing versions,
+                      ref AddVerticesProcessor.cpp:31-57)
+  add_edges        <- AddEdgesProcessor (out-edge at src part, in-edge
+                      copy at dst part with negated type)
+  delete_*         <- Delete{Vertex,Edges}Processor
+  update_*         <- Update{Vertex,Edge}Processor (read-modify-write as
+                      an atomic op through the consensus serialization
+                      point, ref UpdateVertexProcessor.cpp:331)
+  kv_put/get       <- PutProcessor/GetProcessor (generic KV API)
+  get_uuid         <- GetUUIDProcessor
+
+Pushed-down WHERE filters arrive as encoded expression trees and are
+evaluated per edge with getters bound to KV rows (ref:
+QueryBaseProcessor.inl:415-443); only `$^` source props and edge props
+are admissible storage-side, mirroring the reference's `checkExp`
+whitelist (`is_pushable` below).
+
+TTL semantics: rows whose `ttl_col + ttl_duration < now` are invisible
+to reads — the read-time analogue of the reference's
+StorageCompactionFilter dropping expired data.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from ..codec.row import RowReader, RowUpdater, RowWriter, peek_schema_version
+from ..codec.schema import Schema
+from ..common import keys as ku
+from ..common.status import ErrorCode, Status
+from ..filter.expressions import (DestPropExpr, EdgePropExpr, EvalError,
+                                  Expression, ExpressionContext, InputPropExpr,
+                                  VariablePropExpr, decode_expression)
+from ..kvstore.store import GraphStore
+from ..kvstore import log_encoder as le
+from ..meta.schema_manager import SchemaManager
+from .types import (BoundRequest, BoundResponse, EdgeData, EdgeKey,
+                    ExecResponse, NewEdge, NewVertex, PartResult,
+                    PropsResponse, UpdateItemReq, UpdateResponse, VertexData)
+
+DEFAULT_MAX_EDGES_PER_VERTEX = 10000  # FLAGS_max_edge_returned_per_vertex
+
+
+def is_pushable(expr: Expression) -> bool:
+    """Can this filter be evaluated storage-side? (ref: checkExp,
+    QueryBaseProcessor.inl:171-290 — no $-, $var, or $$ refs)."""
+    for node in expr.walk():
+        if isinstance(node, (InputPropExpr, VariablePropExpr, DestPropExpr)):
+            return False
+    return True
+
+
+class _StorageExprContext(ExpressionContext):
+    """Binds property refs to the current (vertex tags, edge row) pair."""
+
+    def __init__(self, sm: SchemaManager, space_id: int):
+        self._sm = sm
+        self._space = space_id
+        self.src_props: Dict[str, Dict[str, Any]] = {}  # tag name -> props
+        self.edge_props: Dict[str, Any] = {}
+        self.edge_name: str = ""
+        self.src = 0
+        self.dst = 0
+        self.rank = 0
+
+    def get_src_prop(self, tag: str, prop: str):
+        props = self.src_props.get(tag)
+        if props is None or prop not in props:
+            raise EvalError(f"$^.{tag}.{prop} not found")
+        return props[prop]
+
+    def get_edge_prop(self, edge, prop):
+        if edge is not None and edge != self.edge_name:
+            raise EvalError(f"edge {edge} not in scope")
+        if prop not in self.edge_props:
+            raise EvalError(f"edge prop {prop} not found")
+        return self.edge_props[prop]
+
+    def get_edge_src(self, edge):
+        return self.src
+
+    def get_edge_dst(self, edge):
+        return self.dst
+
+    def get_edge_rank(self, edge):
+        return self.rank
+
+    def get_edge_type_name(self, edge):
+        return self.edge_name
+
+
+class StorageService:
+    """One storage node: processors over a GraphStore."""
+
+    def __init__(self, store: GraphStore, schema_manager: SchemaManager,
+                 host: str = "local",
+                 max_edges_per_vertex: int = DEFAULT_MAX_EDGES_PER_VERTEX):
+        self.store = store
+        self.sm = schema_manager
+        self.host = host
+        self.max_edges_per_vertex = max_edges_per_vertex
+
+    # ------------------------------------------------------------------
+    # schema/row helpers
+    # ------------------------------------------------------------------
+    def _decode_row(self, schema_getter, space_id: int, sid: int,
+                    data: bytes) -> Optional[Dict[str, Any]]:
+        ver = peek_schema_version(data)
+        r = schema_getter(space_id, sid, ver)
+        if not r.ok():
+            r = schema_getter(space_id, sid, -1)
+            if not r.ok():
+                return None
+        schema: Schema = r.value()
+        row = RowReader(schema, data).to_dict()
+        if schema.ttl_col and schema.ttl_duration > 0:
+            ts = row.get(schema.ttl_col)
+            if isinstance(ts, (int, float)) and ts + schema.ttl_duration < time.time():
+                return None  # expired (compaction-filter semantics)
+        return row
+
+    def _newest_tag_row(self, engine, space_id: int, part: int, vid: int,
+                        tag_id: int) -> Optional[Dict[str, Any]]:
+        it = engine.prefix(ku.vertex_prefix(part, vid, tag_id))
+        for _, v in it:
+            return self._decode_row(self.sm.tag_schema, space_id, tag_id, v)
+        return None
+
+    # ------------------------------------------------------------------
+    # get_bound — THE hot loop (ref: collectEdgeProps .inl:380-458)
+    # ------------------------------------------------------------------
+    def get_bound(self, req: BoundRequest) -> BoundResponse:
+        t0 = time.monotonic()
+        resp = BoundResponse()
+        space = req.space_id
+        flt = None
+        if req.filter:
+            flt = decode_expression(req.filter)
+            if not is_pushable(flt):
+                for part in req.parts:
+                    resp.results[part] = PartResult(ErrorCode.E_INVALID_FILTER)
+                return resp
+        edge_types = req.edge_types or self.sm.all_edge_types(space)
+        max_edges = req.max_edges_per_vertex or self.max_edges_per_vertex
+        ctx = _StorageExprContext(self.sm, space)
+
+        for part, vids in req.parts.items():
+            pr = self.store.part(space, part)
+            if not pr.ok():
+                resp.results[part] = PartResult(pr.status.code, pr.status.msg or None)
+                continue
+            engine = pr.value().engine
+            for vid in vids:
+                vd = VertexData(vid)
+                # source-vertex props for $^ refs and YIELD
+                want_tags = set(req.vertex_props)
+                if flt is not None:
+                    # tags used in the filter must be loaded too
+                    for node in flt.walk():
+                        from ..filter.expressions import SourcePropExpr
+                        if isinstance(node, SourcePropExpr):
+                            tid = self.sm.tag_id(space, node.tag)
+                            if tid is not None:
+                                want_tags.add(tid)
+                for tag_id in want_tags:
+                    row = self._newest_tag_row(engine, space, part, vid, tag_id)
+                    if row is not None:
+                        if tag_id in req.vertex_props and req.vertex_props[tag_id]:
+                            vd.tag_props[tag_id] = {
+                                p: row.get(p) for p in req.vertex_props[tag_id]}
+                        else:
+                            vd.tag_props[tag_id] = row
+                ctx.src_props = {
+                    (self.sm.tag_name(space, tid) or str(tid)): props
+                    for tid, props in vd.tag_props.items()}
+                # also load filter-referenced tags not in the request output
+                for etype in edge_types:
+                    self._collect_edge_props(engine, space, part, vid, etype,
+                                             req, ctx, flt, max_edges, vd)
+                resp.vertices.append(vd)
+            resp.results[part] = PartResult(ErrorCode.SUCCEEDED)
+        resp.latency_us = int((time.monotonic() - t0) * 1e6)
+        return resp
+
+    def _collect_edge_props(self, engine, space: int, part: int, vid: int,
+                            etype: int, req: BoundRequest,
+                            ctx: _StorageExprContext, flt, max_edges: int,
+                            vd: VertexData) -> None:
+        edge_name = self.sm.edge_name(space, etype) or str(abs(etype))
+        ctx.edge_name = edge_name
+        it = engine.prefix(ku.edge_prefix(part, vid, etype))
+        last_group: Optional[Tuple[int, int]] = None
+        count = 0
+        for k, v in it:
+            _, src, et, rank, dst, _ver = ku.parse_edge_key(k)
+            group = (rank, dst)
+            if group == last_group:
+                continue  # older version of the same logical edge
+            last_group = group
+            if count >= max_edges:
+                break  # cap, ref: FLAGS_max_edge_returned_per_vertex
+            if not v:
+                continue  # tombstone
+            props = self._decode_row(self.sm.edge_schema, space, etype, v)
+            if props is None:
+                continue
+            ctx.edge_props = props
+            ctx.src, ctx.dst, ctx.rank = vid, dst, rank
+            if flt is not None:
+                try:
+                    if not flt.eval(ctx):
+                        continue
+                except EvalError:
+                    continue
+            if req.edge_props is not None:
+                props = {p: props.get(p) for p in req.edge_props if p in props}
+            vd.edges.append(EdgeData(vid, et, rank, dst, props))
+            count += 1
+
+    # ------------------------------------------------------------------
+    # point lookups
+    # ------------------------------------------------------------------
+    def get_vertex_props(self, space_id: int, parts: Dict[int, List[int]],
+                         tag_ids: Optional[List[int]] = None) -> PropsResponse:
+        resp = PropsResponse()
+        tags = tag_ids if tag_ids else self.sm.all_tag_ids(space_id)
+        for part, vids in parts.items():
+            pr = self.store.part(space_id, part)
+            if not pr.ok():
+                resp.results[part] = PartResult(pr.status.code, pr.status.msg or None)
+                continue
+            engine = pr.value().engine
+            for vid in vids:
+                vd = VertexData(vid)
+                for tag_id in tags:
+                    row = self._newest_tag_row(engine, space_id, part, vid, tag_id)
+                    if row is not None:
+                        vd.tag_props[tag_id] = row
+                if vd.tag_props:
+                    resp.vertices.append(vd)
+            resp.results[part] = PartResult()
+        return resp
+
+    def get_edge_props(self, space_id: int,
+                       parts: Dict[int, List[EdgeKey]]) -> PropsResponse:
+        resp = PropsResponse()
+        for part, eks in parts.items():
+            pr = self.store.part(space_id, part)
+            if not pr.ok():
+                resp.results[part] = PartResult(pr.status.code, pr.status.msg or None)
+                continue
+            engine = pr.value().engine
+            for ek in eks:
+                it = engine.prefix(ku.edge_group_prefix(part, ek.src, ek.etype,
+                                                        ek.rank, ek.dst))
+                for _, v in it:
+                    if not v:
+                        break
+                    props = self._decode_row(self.sm.edge_schema, space_id,
+                                             ek.etype, v)
+                    if props is not None:
+                        resp.edges.append(EdgeData(ek.src, ek.etype, ek.rank,
+                                                   ek.dst, props))
+                    break
+            resp.results[part] = PartResult()
+        return resp
+
+    def get_edge_keys(self, space_id: int, part: int,
+                      vid: int) -> Tuple[PartResult, List[EdgeKey]]:
+        """All out+in edge keys stored locally for vid (DELETE support)."""
+        pr = self.store.part(space_id, part)
+        if not pr.ok():
+            return PartResult(pr.status.code, pr.status.msg or None), []
+        engine = pr.value().engine
+        out: List[EdgeKey] = []
+        seen = set()
+        it = engine.prefix(ku.edge_prefix(part, vid))
+        for k, v in it:
+            _, src, et, rank, dst, _ = ku.parse_edge_key(k)
+            g = (src, et, rank, dst)
+            if g in seen:
+                continue
+            seen.add(g)
+            if v:
+                out.append(EdgeKey(src, et, rank, dst))
+        return PartResult(), out
+
+    # ------------------------------------------------------------------
+    # mutations
+    # ------------------------------------------------------------------
+    def add_vertices(self, space_id: int,
+                     parts: Dict[int, List[NewVertex]],
+                     overwritable: bool = True) -> ExecResponse:
+        resp = ExecResponse()
+        ver = ku.now_version()
+        for part, vertices in parts.items():
+            kvs = []
+            for nv in vertices:
+                for tag_id, row in nv.tags:
+                    kvs.append((ku.vertex_key(part, nv.vid, tag_id, ver), row))
+            st = self.store.async_multi_put(space_id, part, kvs)
+            resp.results[part] = _to_part_result(st)
+        return resp
+
+    def add_edges(self, space_id: int, parts: Dict[int, List[NewEdge]],
+                  overwritable: bool = True) -> ExecResponse:
+        """Each NewEdge lands on the part that owns `src` with its signed
+        etype as given; the client is responsible for sending the reverse
+        copy to the dst part (matching the reference split)."""
+        resp = ExecResponse()
+        ver = ku.now_version()
+        for part, edges in parts.items():
+            kvs = [(ku.edge_key(part, e.src, e.etype, e.rank, e.dst, ver), e.row)
+                   for e in edges]
+            st = self.store.async_multi_put(space_id, part, kvs)
+            resp.results[part] = _to_part_result(st)
+        return resp
+
+    def delete_vertex(self, space_id: int, part: int, vid: int) -> ExecResponse:
+        resp = ExecResponse()
+        pr = self.store.part(space_id, part)
+        if not pr.ok():
+            resp.results[part] = PartResult(pr.status.code, pr.status.msg or None)
+            return resp
+        engine = pr.value().engine
+        dead = [k for k, _ in engine.prefix(ku.vertex_prefix(part, vid))]
+        dead += [k for k, _ in engine.prefix(ku.edge_prefix(part, vid))]
+        st = self.store.async_multi_remove(space_id, part, dead)
+        resp.results[part] = _to_part_result(st)
+        return resp
+
+    def delete_edges(self, space_id: int,
+                     parts: Dict[int, List[EdgeKey]]) -> ExecResponse:
+        resp = ExecResponse()
+        for part, eks in parts.items():
+            pr = self.store.part(space_id, part)
+            if not pr.ok():
+                resp.results[part] = PartResult(pr.status.code, pr.status.msg or None)
+                continue
+            engine = pr.value().engine
+            dead = []
+            for ek in eks:
+                prefix = ku.edge_group_prefix(part, ek.src, ek.etype, ek.rank,
+                                              ek.dst)
+                dead.extend(k for k, _ in engine.prefix(prefix))
+            st = self.store.async_multi_remove(space_id, part, dead)
+            resp.results[part] = _to_part_result(st)
+        return resp
+
+    # ------------------------------------------------------------------
+    # UPDATE / UPSERT as atomic ops through consensus
+    # ------------------------------------------------------------------
+    def update_vertex(self, space_id: int, part: int, vid: int, tag_id: int,
+                      items: List[UpdateItemReq],
+                      when: Optional[bytes] = None,
+                      insertable: bool = False,
+                      yield_props: Optional[List[str]] = None) -> UpdateResponse:
+        out = UpdateResponse()
+        sr = self.sm.tag_schema(space_id, tag_id)
+        if not sr.ok():
+            out.code = sr.status.code
+            return out
+        schema = sr.value()
+        tag_name = self.sm.tag_name(space_id, tag_id) or str(tag_id)
+
+        def atomic_op() -> Optional[bytes]:
+            pr = self.store.part(space_id, part)
+            if not pr.ok():
+                out.code = pr.status.code
+                return None
+            engine = pr.value().engine
+            cur = self._newest_tag_row(engine, space_id, part, vid, tag_id)
+            if cur is None:
+                if not insertable:
+                    out.code = ErrorCode.E_KEY_NOT_FOUND
+                    return None
+                out.upsert = True
+                cur = {}
+            ctx = _StorageExprContext(self.sm, space_id)
+            ctx.src_props = {tag_name: dict(cur)}
+            # bare prop names refer to the row being updated
+            ctx.edge_props = dict(cur)
+            ctx.edge_name = tag_name
+            if when is not None and cur:
+                try:
+                    if not decode_expression(when).eval(ctx):
+                        out.code = ErrorCode.E_FILTER_OUT
+                        return None
+                except EvalError:
+                    out.code = ErrorCode.E_INVALID_FILTER
+                    return None
+            upd = RowUpdater(schema)
+            for f in schema.fields:
+                if f.name in cur:
+                    upd.set(f.name, cur[f.name])
+            for item in items:
+                try:
+                    val = decode_expression(item.value).eval(ctx)
+                except EvalError:
+                    out.code = ErrorCode.E_INVALID_UPDATER
+                    return None
+                prop = item.prop.split(".")[-1]
+                if not schema.has_field(prop):
+                    out.code = ErrorCode.E_INVALID_UPDATER
+                    return None
+                upd.set(prop, val)
+                ctx.edge_props[prop] = val
+                ctx.src_props[tag_name][prop] = val
+            new_row = upd.encode()
+            if yield_props:
+                rd = RowReader(schema, new_row)
+                out.props = {p: rd.get(p) for p in yield_props
+                             if schema.has_field(p)}
+            key = ku.vertex_key(part, vid, tag_id)
+            return le.encode_single(le.OP_PUT, key, new_row)
+
+        st = self.store.async_atomic_op(space_id, part, atomic_op)
+        if not st.ok() and out.code == ErrorCode.SUCCEEDED:
+            out.code = st.code
+        return out
+
+    def update_edge(self, space_id: int, part: int, ek: EdgeKey,
+                    items: List[UpdateItemReq],
+                    when: Optional[bytes] = None,
+                    insertable: bool = False,
+                    yield_props: Optional[List[str]] = None) -> UpdateResponse:
+        out = UpdateResponse()
+        sr = self.sm.edge_schema(space_id, ek.etype)
+        if not sr.ok():
+            out.code = sr.status.code
+            return out
+        schema = sr.value()
+        edge_name = self.sm.edge_name(space_id, ek.etype) or str(ek.etype)
+
+        def atomic_op() -> Optional[bytes]:
+            pr = self.store.part(space_id, part)
+            if not pr.ok():
+                out.code = pr.status.code
+                return None
+            engine = pr.value().engine
+            cur = None
+            it = engine.prefix(ku.edge_group_prefix(part, ek.src, ek.etype,
+                                                    ek.rank, ek.dst))
+            for _, v in it:
+                if v:
+                    cur = self._decode_row(self.sm.edge_schema, space_id,
+                                           ek.etype, v)
+                break
+            if cur is None:
+                if not insertable:
+                    out.code = ErrorCode.E_KEY_NOT_FOUND
+                    return None
+                out.upsert = True
+                cur = {}
+            ctx = _StorageExprContext(self.sm, space_id)
+            ctx.edge_props = dict(cur)
+            ctx.edge_name = edge_name
+            ctx.src, ctx.dst, ctx.rank = ek.src, ek.dst, ek.rank
+            if when is not None and cur:
+                try:
+                    if not decode_expression(when).eval(ctx):
+                        out.code = ErrorCode.E_FILTER_OUT
+                        return None
+                except EvalError:
+                    out.code = ErrorCode.E_INVALID_FILTER
+                    return None
+            upd = RowUpdater(schema)
+            for f in schema.fields:
+                if f.name in cur:
+                    upd.set(f.name, cur[f.name])
+            for item in items:
+                try:
+                    val = decode_expression(item.value).eval(ctx)
+                except EvalError:
+                    out.code = ErrorCode.E_INVALID_UPDATER
+                    return None
+                prop = item.prop.split(".")[-1]
+                if not schema.has_field(prop):
+                    out.code = ErrorCode.E_INVALID_UPDATER
+                    return None
+                upd.set(prop, val)
+                ctx.edge_props[prop] = val
+            new_row = upd.encode()
+            if yield_props:
+                rd = RowReader(schema, new_row)
+                out.props = {p: rd.get(p) for p in yield_props
+                             if schema.has_field(p)}
+            key = ku.edge_key(part, ek.src, ek.etype, ek.rank, ek.dst)
+            return le.encode_single(le.OP_PUT, key, new_row)
+
+        st = self.store.async_atomic_op(space_id, part, atomic_op)
+        if not st.ok() and out.code == ErrorCode.SUCCEEDED:
+            out.code = st.code
+        return out
+
+    # ------------------------------------------------------------------
+    # generic KV + uuid
+    # ------------------------------------------------------------------
+    def kv_put(self, space_id: int, part: int,
+               kvs: List[Tuple[bytes, bytes]]) -> Status:
+        return self.store.async_multi_put(space_id, part, kvs)
+
+    def kv_get(self, space_id: int, part: int, key: bytes):
+        return self.store.get(space_id, part, key)
+
+    def get_uuid(self, space_id: int, part: int, name: str) -> Tuple[PartResult, int]:
+        """Stable name→vid allocation (ref: GetUUIDProcessor)."""
+        key = ku.uuid_key(part, name.encode("utf-8"))
+        r = self.store.get(space_id, part, key)
+        if r.ok():
+            import struct
+            return PartResult(), struct.unpack("<q", r.value())[0]
+        from ..filter.functions import _fnv1a64
+        vid = _fnv1a64(name.encode("utf-8"))
+        import struct
+        st = self.store.async_multi_put(space_id, part,
+                                        [(key, struct.pack("<q", vid))])
+        return _to_part_result(st), vid
+
+
+def _to_part_result(st: Status) -> PartResult:
+    if st.ok():
+        return PartResult()
+    return PartResult(st.code, st.msg or None)

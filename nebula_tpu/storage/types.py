@@ -1,0 +1,116 @@
+"""Storage RPC request/response types.
+
+Role parity with the reference's `interface/storage.thrift` structs
+(GetNeighborsRequest/QueryResponse, AddVerticesRequest, EdgeKey, …):
+these dataclasses are the wire contract between the query engine and
+storage — the in-proc path passes them directly, the rpc/ layer
+serializes them. Per-partition error codes + leader hints ride on every
+response exactly like `ResponseCommon.failed_codes`.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..common.status import ErrorCode
+
+
+@dataclass
+class PartResult:
+    code: ErrorCode = ErrorCode.SUCCEEDED
+    leader: Optional[str] = None  # redirect hint on E_LEADER_CHANGED
+
+
+@dataclass
+class EdgeData:
+    """One qualified edge emitted by getBound."""
+    src: int
+    etype: int          # signed: negative = in-edge (REVERSELY)
+    rank: int
+    dst: int
+    props: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class VertexData:
+    vid: int
+    tag_props: Dict[int, Dict[str, Any]] = field(default_factory=dict)  # tag_id -> props
+    edges: List[EdgeData] = field(default_factory=list)
+
+
+@dataclass
+class BoundRequest:
+    space_id: int
+    # part -> vertex ids owned by that part
+    parts: Dict[int, List[int]]
+    # signed edge types to expand (negative = reverse); empty = all out-edges
+    edge_types: List[int]
+    # tag_id -> prop names to return for source vertices ($^ props)
+    vertex_props: Dict[int, List[str]] = field(default_factory=dict)
+    # edge prop names to return (None = all; applies per edge schema)
+    edge_props: Optional[List[str]] = None
+    # encoded Expression for storage-side filtering (filter pushdown)
+    filter: Optional[bytes] = None
+    max_edges_per_vertex: Optional[int] = None
+
+
+@dataclass
+class BoundResponse:
+    results: Dict[int, PartResult] = field(default_factory=dict)  # per part
+    vertices: List[VertexData] = field(default_factory=list)
+    latency_us: int = 0
+
+
+@dataclass
+class NewVertex:
+    vid: int
+    # tag_id -> encoded row (graphd encodes with RowWriter, like reference)
+    tags: List[Tuple[int, bytes]] = field(default_factory=list)
+
+
+@dataclass
+class NewEdge:
+    src: int
+    etype: int
+    rank: int
+    dst: int
+    row: bytes = b""
+
+
+@dataclass
+class EdgeKey:
+    src: int
+    etype: int
+    rank: int
+    dst: int
+
+
+@dataclass
+class ExecResponse:
+    results: Dict[int, PartResult] = field(default_factory=dict)
+    latency_us: int = 0
+
+    def ok(self) -> bool:
+        return all(r.code == ErrorCode.SUCCEEDED for r in self.results.values())
+
+
+@dataclass
+class PropsResponse:
+    results: Dict[int, PartResult] = field(default_factory=dict)
+    vertices: List[VertexData] = field(default_factory=list)
+    edges: List[EdgeData] = field(default_factory=list)
+    latency_us: int = 0
+
+
+@dataclass
+class UpdateItemReq:
+    prop: str               # field name (optionally tag.prop for vertices)
+    value: bytes            # encoded Expression evaluated at the storage side
+
+
+@dataclass
+class UpdateResponse:
+    code: ErrorCode = ErrorCode.SUCCEEDED
+    leader: Optional[str] = None
+    props: Dict[str, Any] = field(default_factory=dict)  # yielded values
+    upsert: bool = False

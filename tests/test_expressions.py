@@ -1,0 +1,156 @@
+"""Expression eval + serialization tests (parity model: common/filter tests,
+storage-side decode at QueryBaseProcessor.inl:146-167)."""
+import pytest
+
+from nebula_tpu.filter import (ArithmeticExpr, EvalError, ExpressionContext,
+                               FunctionCall, FunctionManager, Literal,
+                               LogicalExpr, RelationalExpr, UnaryExpr,
+                               decode_expression, encode_expression)
+from nebula_tpu.parser import GQLParser
+
+
+def parse_expr(text):
+    """Parse an expression through a YIELD statement."""
+    stmts = GQLParser().parse(f"YIELD {text} AS x")
+    return stmts.sentences[0].yield_.columns[0].expr
+
+
+class Ctx(ExpressionContext):
+    def __init__(self, edge_props=None, src_props=None, dst_props=None,
+                 input_props=None, variables=None):
+        self.edge_props = edge_props or {}
+        self.src_props = src_props or {}
+        self.dst_props = dst_props or {}
+        self.input_props = input_props or {}
+        self.variables = variables or {}
+
+    def get_edge_prop(self, edge, prop):
+        return self.edge_props[prop]
+
+    def get_src_prop(self, tag, prop):
+        return self.src_props[(tag, prop)]
+
+    def get_dst_prop(self, tag, prop):
+        return self.dst_props[(tag, prop)]
+
+    def get_input_prop(self, prop):
+        return self.input_props[prop]
+
+    def get_variable_prop(self, var, prop):
+        return self.variables[(var, prop)]
+
+    def get_edge_src(self, edge):
+        return 100
+
+    def get_edge_dst(self, edge):
+        return 200
+
+    def get_edge_rank(self, edge):
+        return 3
+
+
+CTX = Ctx()
+
+
+@pytest.mark.parametrize("text,expected", [
+    ("1 + 2 * 3", 7),
+    ("(1 + 2) * 3", 9),
+    ("7 / 2", 3),            # C-style int division
+    ("-7 / 2", -3),          # truncation toward zero, not floor
+    ("7 % 3", 1),
+    ("-7 % 3", -1),          # C-style remainder
+    ("7.0 / 2", 3.5),
+    ('"a" + "b"', "ab"),
+    ('"n" + 1', "n1"),       # string concat coerces
+    ("1 < 2", True),
+    ("2 <= 1", False),
+    ('"abc" CONTAINS "b"', True),
+    ("1 == 1.0", True),
+    ('1 == "1"', False),     # cross-type equality is false, not an error
+    ('1 != "1"', True),
+    ("true && false", False),
+    ("true || false", True),
+    ("true XOR true", False),
+    ("!true", False),
+    ("NOT false", True),
+    ("-(3)", -3),
+    ("(int)3.9", 3),
+    ("(string)42", "42"),
+    ("(bool)0", False),
+    ("NULL == NULL", True),
+    ("NULL != 1", True),
+    ("udf_is_in(2, 1, 2, 3)", True),
+    ("udf_is_in(9, 1, 2, 3)", False),
+    ("abs(0-5)", 5),
+    ("pow(2, 10)", 1024),
+    ("lower(\"ABC\")", "abc"),
+    ("substr(\"hello\", 1, 3)", "ell"),
+    ("length(\"hello\")", 5),
+])
+def test_eval(text, expected):
+    assert parse_expr(text).eval(CTX) == expected
+
+
+def test_div_by_zero():
+    with pytest.raises(EvalError):
+        parse_expr("1 / 0").eval(CTX)
+
+
+def test_prop_refs_bind_to_context():
+    ctx = Ctx(edge_props={"likeness": 95.0},
+              src_props={("player", "name"): "Tim Duncan"},
+              dst_props={("player", "age"): 33},
+              input_props={"id": 7},
+              variables={("var", "col"): "v"})
+    assert parse_expr("like.likeness").eval(ctx) == 95.0
+    assert parse_expr("$^.player.name").eval(ctx) == "Tim Duncan"
+    assert parse_expr("$$.player.age + 1").eval(ctx) == 34
+    assert parse_expr("$-.id * 2").eval(ctx) == 14
+    assert parse_expr("$var.col").eval(ctx) == "v"
+    assert parse_expr("like._src").eval(ctx) == 100
+    assert parse_expr("like._dst").eval(ctx) == 200
+    assert parse_expr("_rank").eval(ctx) == 3
+
+
+def test_missing_getter_raises():
+    with pytest.raises(EvalError):
+        parse_expr("$-.absent").eval(ExpressionContext())
+
+
+@pytest.mark.parametrize("text", [
+    "1 + 2 * 3",
+    "$^.player.age >= 30 && like.likeness > 90.0",
+    '$$.team.name == "Spurs" || udf_is_in($-.id, 1, 2, 3)',
+    "(int)(abs(0 - $-.x) % 7)",
+    "like._dst",
+    "_rank == 0",
+    "$var.col CONTAINS \"a\"",
+])
+def test_encode_decode_roundtrip(text):
+    e = parse_expr(text)
+    data = encode_expression(e)
+    e2 = decode_expression(data)
+    assert e2.to_string() == e.to_string()
+    # both evaluate the same under the same context
+    ctx = Ctx(edge_props={"likeness": 95.0},
+              src_props={("player", "age"): 33},
+              dst_props={("team", "name"): "Spurs"},
+              input_props={"id": 2, "x": -10},
+              variables={("var", "col"): "abc"})
+    assert e.eval(ctx) == e2.eval(ctx)
+
+
+def test_function_manager_arity_and_unknown():
+    with pytest.raises(EvalError):
+        FunctionManager.invoke("abs", [1, 2])
+    with pytest.raises(EvalError):
+        FunctionManager.invoke("no_such_fn", [])
+    assert FunctionManager.exists("now")
+    assert len(FunctionManager.names()) >= 30
+
+
+def test_hash_is_stable_int64():
+    h1 = FunctionManager.invoke("hash", ["hello"])
+    h2 = FunctionManager.invoke("hash", ["hello"])
+    assert h1 == h2
+    assert -(1 << 63) <= h1 < (1 << 63)

@@ -1,0 +1,142 @@
+"""KV engine / store / part tests (parity model: kvstore/test/RocksEngineTest,
+NebulaStoreTest, PartTest, LogEncoderTest)."""
+import pytest
+
+from nebula_tpu.common import keys
+from nebula_tpu.common.status import ErrorCode
+from nebula_tpu.kvstore import GraphStore, MemEngine
+from nebula_tpu.kvstore import log_encoder as le
+
+
+def test_engine_basic_ops():
+    e = MemEngine()
+    assert e.get(b"k") is None
+    e.put(b"k", b"v")
+    assert e.get(b"k") == b"v"
+    e.put(b"k", b"v2")
+    assert e.get(b"k") == b"v2"
+    e.remove(b"k")
+    assert e.get(b"k") is None
+    assert e.total_keys() == 0
+
+
+def test_engine_prefix_and_range():
+    e = MemEngine()
+    e.multi_put([(f"a{i}".encode(), str(i).encode()) for i in range(5)])
+    e.multi_put([(f"b{i}".encode(), str(i).encode()) for i in range(3)])
+    assert [k for k, _ in e.prefix(b"a")] == [b"a0", b"a1", b"a2", b"a3", b"a4"]
+    assert [k for k, _ in e.prefix(b"b")] == [b"b0", b"b1", b"b2"]
+    assert [k for k, _ in e.prefix(b"c")] == []
+    assert [k for k, _ in e.range(b"a3", b"b1")] == [b"a3", b"a4", b"b0"]
+    e.remove_range(b"a1", b"a4")
+    assert [k for k, _ in e.prefix(b"a")] == [b"a0", b"a4"]
+    e.remove_prefix(b"a")
+    assert [k for k, _ in e.prefix(b"a")] == []
+    assert e.total_keys() == 3
+
+
+def test_engine_prefix_upper_bound_edge():
+    e = MemEngine()
+    e.put(b"\xff\xff", b"1")
+    e.put(b"\xff\xfe", b"2")
+    assert [k for k, _ in e.prefix(b"\xff")] == [b"\xff\xfe", b"\xff\xff"]
+
+
+def test_log_encoder_roundtrip():
+    op, payload = le.decode(le.encode_single(le.OP_PUT, b"k", b"v"))
+    assert op == le.OP_PUT and payload == (b"k", b"v")
+    op, payload = le.decode(le.encode_multi_put([(b"a", b"1"), (b"b", b"2")]))
+    assert op == le.OP_MULTI_PUT and payload[0] == [(b"a", b"1"), (b"b", b"2")]
+    op, payload = le.decode(le.encode_multi_remove([b"x", b"y"]))
+    assert payload[0] == [b"x", b"y"]
+    op, payload = le.decode(le.encode_remove_range(b"a", b"z"))
+    assert payload == (b"a", b"z")
+    op, payload = le.decode(le.encode_host(le.OP_ADD_LEARNER, "h:1"))
+    assert op == le.OP_ADD_LEARNER and payload == ("h:1",)
+
+
+def test_store_space_part_topology():
+    st = GraphStore()
+    st.add_space(1)
+    st.add_part(1, 1)
+    st.add_part(1, 2)
+    assert st.spaces() == [1]
+    assert st.parts(1) == [1, 2]
+    st.remove_part(1, 2)
+    assert st.parts(1) == [1]
+    st.remove_space(1)
+    assert st.spaces() == []
+
+
+def test_store_routing_errors():
+    st = GraphStore()
+    r = st.get(9, 1, b"k")
+    assert r.status.code == ErrorCode.E_SPACE_NOT_FOUND
+    st.add_space(9)
+    r = st.get(9, 1, b"k")
+    assert r.status.code == ErrorCode.E_PART_NOT_FOUND
+
+
+def test_store_write_read_through_part():
+    st = GraphStore()
+    st.add_part(1, 3)
+    vk = keys.vertex_key(3, 7, 1, version=0)
+    assert st.async_multi_put(1, 3, [(vk, b"row")]).ok()
+    assert st.get(1, 3, vk).value() == b"row"
+    r = st.get(1, 3, b"missing")
+    assert r.status.code == ErrorCode.E_KEY_NOT_FOUND
+
+
+def test_part_commit_marker_persists():
+    st = GraphStore()
+    part = st.add_part(1, 1)
+    part.async_put(b"a", b"1")
+    part.async_put(b"b", b"2")
+    assert part.last_committed_log_id == 2
+    v = part.engine.get(keys.system_commit_key(1))
+    assert keys.decode_commit_value(v)[0] == 2
+
+
+def test_part_atomic_op():
+    st = GraphStore()
+    part = st.add_part(1, 1)
+    part.async_put(b"cnt", b"5")
+
+    def cas():
+        cur = int(part.engine.get(b"cnt"))
+        if cur != 5:
+            return None
+        return le.encode_single(le.OP_PUT, b"cnt", str(cur + 1).encode())
+
+    assert part.async_atomic_op(cas).ok()
+    assert part.engine.get(b"cnt") == b"6"
+    # second run aborts (value no longer 5)
+    st2 = part.async_atomic_op(cas)
+    assert not st2.ok()
+    assert part.engine.get(b"cnt") == b"6"
+
+
+def test_part_cleanup_only_touches_own_prefix():
+    st = GraphStore()
+    p1 = st.add_part(1, 1)
+    p2 = st.add_part(1, 2)
+    p1.async_put(keys.vertex_key(1, 5, 1, version=0), b"x")
+    p2.async_put(keys.vertex_key(2, 5, 1, version=0), b"y")
+    st.remove_part(1, 1)
+    eng = st.space_engine(1)
+    assert eng.get(keys.vertex_key(1, 5, 1, version=0)) is None
+    assert eng.get(keys.vertex_key(2, 5, 1, version=0)) == b"y"
+
+
+def test_multi_version_scan_newest_first():
+    """Mirrors the reference's decreasing-version semantics: a prefix scan
+    over (vid, tag) sees the newest write first."""
+    st = GraphStore()
+    part = st.add_part(1, 1)
+    part.async_put(keys.vertex_key(1, 42, 7, version=keys.now_version()), b"old")
+    import time
+    time.sleep(0.001)
+    part.async_put(keys.vertex_key(1, 42, 7, version=keys.now_version()), b"new")
+    it = part.engine.prefix(keys.vertex_prefix(1, 42, 7))
+    vals = [v for _, v in it]
+    assert vals[0] == b"new"

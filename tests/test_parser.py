@@ -1,0 +1,280 @@
+"""Parser tests (parity model: parser/test/ParserTest.cpp — every statement
+family parses and round-trips via to_string())."""
+import pytest
+
+from nebula_tpu.parser import GQLParser, ParseError
+from nebula_tpu.parser import ast
+
+
+def parse(text):
+    return GQLParser().parse(text)
+
+
+def parse1(text):
+    seq = parse(text)
+    assert len(seq.sentences) == 1
+    return seq.sentences[0]
+
+
+# --- traversals ------------------------------------------------------------
+
+def test_go_minimal():
+    s = parse1("GO FROM 1 OVER like")
+    assert isinstance(s, ast.GoSentence)
+    assert s.step.steps == 1
+    assert [v.to_string() for v in s.from_.vids] == ["1"]
+    assert s.over.edges[0].name == "like"
+    assert s.over.direction == ast.Direction.OUT
+
+
+def test_go_full():
+    s = parse1('GO 3 STEPS FROM 1, 2, 3 OVER like, serve REVERSELY '
+               'WHERE like.likeness > 90 '
+               'YIELD DISTINCT like._dst AS id, $^.player.name')
+    assert s.step.steps == 3
+    assert len(s.from_.vids) == 3
+    assert [e.name for e in s.over.edges] == ["like", "serve"]
+    assert s.over.direction == ast.Direction.IN
+    assert s.where is not None
+    assert s.yield_.distinct
+    assert s.yield_.columns[0].alias == "id"
+
+
+def test_go_over_star_bidirect():
+    s = parse1("GO FROM 1 OVER * BIDIRECT")
+    assert s.over.is_all
+    assert s.over.direction == ast.Direction.BOTH
+
+
+def test_go_from_input_ref():
+    s = parse1("GO FROM $-.id OVER like")
+    assert s.from_.ref is not None
+    assert s.from_.ref.to_string() == "$-.id"
+
+
+def test_pipe_and_variable():
+    s = parse1("GO FROM 1 OVER like YIELD like._dst AS id | GO FROM $-.id OVER serve")
+    assert isinstance(s, ast.PipedSentence)
+    seq = parse("$var = GO FROM 1 OVER like; GO FROM $var.id OVER serve")
+    assert isinstance(seq.sentences[0], ast.AssignmentSentence)
+    assert seq.sentences[0].var == "var"
+    assert len(seq.sentences) == 2
+
+
+def test_find_path():
+    s = parse1("FIND SHORTEST PATH FROM 1 TO 2 OVER like UPTO 4 STEPS")
+    assert isinstance(s, ast.FindPathSentence)
+    assert s.shortest and s.step.steps == 4
+    s = parse1("FIND ALL PATH FROM 1 TO 2, 3 OVER *")
+    assert not s.shortest
+    assert s.over.is_all
+
+
+def test_fetch_vertices_and_edges():
+    s = parse1("FETCH PROP ON player 1, 2 YIELD player.name")
+    assert isinstance(s, ast.FetchVerticesSentence)
+    assert s.tag == "player"
+    s = parse1("FETCH PROP ON like 1->2@0, 3->4")
+    assert isinstance(s, ast.FetchEdgesSentence)
+    assert len(s.keys) == 2
+    assert s.keys[0].rank == 0
+    s = parse1("FETCH PROP ON * 1")
+    assert s.tag == "*"
+
+
+def test_set_ops():
+    s = parse1("GO FROM 1 OVER like UNION GO FROM 2 OVER like MINUS GO FROM 3 OVER like")
+    assert isinstance(s, ast.SetSentence)
+    assert s.op == ast.SetOp.MINUS
+    assert isinstance(s.left, ast.SetSentence)
+    assert s.left.op == ast.SetOp.UNION
+    s2 = parse1("GO FROM 1 OVER e UNION DISTINCT GO FROM 2 OVER e")
+    assert s2.op == ast.SetOp.UNION_DISTINCT
+
+
+def test_order_by_limit_group_by():
+    s = parse1("ORDER BY $-.age DESC, $-.name")
+    assert isinstance(s, ast.OrderBySentence)
+    assert not s.factors[0].ascending and s.factors[1].ascending
+    s = parse1("LIMIT 3, 10")
+    assert s.offset == 3 and s.count == 10
+    s = parse1("GROUP BY $-.team YIELD $-.team, COUNT(*) AS cnt, AVG($-.age) AS avg_age")
+    assert isinstance(s, ast.GroupBySentence)
+    cols = s.yield_.columns
+    assert cols[1].agg_fun == "COUNT" and cols[1].alias == "cnt"
+    assert cols[2].agg_fun == "AVG"
+
+
+def test_yield_standalone():
+    s = parse1("YIELD 1 + 1 AS sum, hash(\"x\") AS h")
+    assert isinstance(s, ast.YieldSentence)
+    assert s.yield_.columns[0].alias == "sum"
+
+
+# --- DDL -------------------------------------------------------------------
+
+def test_create_space():
+    s = parse1("CREATE SPACE nba(partition_num=10, replica_factor=3)")
+    assert isinstance(s, ast.CreateSpaceSentence)
+    assert s.partition_num == 10 and s.replica_factor == 3
+    s = parse1("CREATE SPACE IF NOT EXISTS nba")
+    assert s.if_not_exists
+
+
+def test_create_tag_edge():
+    s = parse1('CREATE TAG player(name string, age int DEFAULT 0)')
+    assert isinstance(s, ast.CreateSchemaSentence)
+    assert not s.is_edge
+    assert [c.name for c in s.columns] == ["name", "age"]
+    assert s.columns[1].default == 0
+    s = parse1("CREATE EDGE like(likeness double) TTL_DURATION = 100, TTL_COL = \"ts\"")
+    assert s.is_edge
+    assert s.opts.ttl_duration == 100 and s.opts.ttl_col == "ts"
+    s = parse1("CREATE TAG empty_tag()")
+    assert s.columns == []
+
+
+def test_alter_schema():
+    s = parse1("ALTER TAG player ADD (height double), DROP (age)")
+    assert isinstance(s, ast.AlterSchemaSentence)
+    assert s.adds[0].name == "height"
+    assert s.drops == ["age"]
+    s = parse1("ALTER EDGE like CHANGE (likeness int)")
+    assert s.changes[0].type_name == "INT"
+
+
+def test_drop_describe():
+    assert isinstance(parse1("DROP TAG player"), ast.DropSchemaSentence)
+    assert isinstance(parse1("DESCRIBE EDGE like"), ast.DescribeSchemaSentence)
+    assert isinstance(parse1("DESC SPACE nba"), ast.DescribeSpaceSentence)
+    assert isinstance(parse1("DROP SPACE IF EXISTS nba"), ast.DropSpaceSentence)
+
+
+# --- DML -------------------------------------------------------------------
+
+def test_insert_vertex():
+    s = parse1('INSERT VERTEX player(name, age) VALUES '
+               '100:("Tim Duncan", 42), 101:("Tony Parker", 36)')
+    assert isinstance(s, ast.InsertVerticesSentence)
+    assert s.tag_items == [("player", ["name", "age"])]
+    assert len(s.rows) == 2
+    vid, vals = s.rows[0]
+    assert vid.to_string() == "100"
+    assert vals[0].value == "Tim Duncan"
+
+
+def test_insert_vertex_multi_tag():
+    s = parse1('INSERT VERTEX player(name), star(rank) VALUES 1:("a", 5)')
+    assert len(s.tag_items) == 2
+
+
+def test_insert_edge():
+    s = parse1("INSERT EDGE like(likeness) VALUES 100 -> 101@7:(95.0), 100 -> 102:(90.0)")
+    assert isinstance(s, ast.InsertEdgesSentence)
+    src, dst, rank, vals = s.rows[0]
+    assert rank == 7
+    assert s.rows[1][2] == 0
+    assert vals[0].value == 95.0
+
+
+def test_insert_with_uuid_and_negative_vid():
+    s = parse1('INSERT VERTEX player(name) VALUES uuid("x"):("a"), -7:("b")')
+    assert s.rows[0][0].to_string() == 'uuid("x")'
+    assert s.rows[1][0].value == -7
+
+
+def test_delete():
+    s = parse1("DELETE VERTEX 1, 2")
+    assert isinstance(s, ast.DeleteVerticesSentence)
+    s = parse1("DELETE EDGE like 1->2@0, 3->4")
+    assert isinstance(s, ast.DeleteEdgesSentence)
+
+
+def test_update_upsert():
+    s = parse1('UPDATE VERTEX 100 SET age = age + 1 WHEN age > 10 YIELD age')
+    assert isinstance(s, ast.UpdateVertexSentence)
+    assert not s.insertable and s.when is not None
+    s = parse1('UPSERT EDGE 100 -> 101 OF like SET likeness = 80.0')
+    assert isinstance(s, ast.UpdateEdgeSentence)
+    assert s.insertable and s.edge == "like"
+
+
+# --- admin -----------------------------------------------------------------
+
+def test_show_and_use():
+    assert parse1("SHOW SPACES").what == ast.ShowKind.SPACES
+    assert parse1("SHOW HOSTS").what == ast.ShowKind.HOSTS
+    assert parse1("USE nba").space == "nba"
+    assert parse1("SHOW TAGS").what == ast.ShowKind.TAGS
+
+
+def test_configs():
+    s = parse1("SHOW CONFIGS GRAPH")
+    assert s.action == "SHOW" and s.module == "GRAPH"
+    s = parse1("GET CONFIGS STORAGE:foo_bar")
+    assert s.action == "GET" and s.name == "foo_bar"
+
+
+def test_balance():
+    assert parse1("BALANCE DATA").sub == "DATA"
+    assert parse1("BALANCE LEADER").sub == "LEADER"
+    assert parse1("BALANCE DATA 123").plan_id == 123
+    s = parse1('BALANCE DATA REMOVE "192.168.0.1":44500')
+    assert s.remove_hosts == ["192.168.0.1:44500"]
+
+
+def test_users():
+    s = parse1('CREATE USER alice WITH PASSWORD "secret"')
+    assert isinstance(s, ast.CreateUserSentence)
+    s = parse1('GRANT ROLE ADMIN ON nba TO alice')
+    assert s.role == "ADMIN" and s.space == "nba" and s.user == "alice"
+    s = parse1('REVOKE ROLE GUEST ON nba FROM bob')
+    assert isinstance(s, ast.RevokeSentence)
+    s = parse1('CHANGE PASSWORD alice FROM "old" TO "new"')
+    assert s.old_password == "old" and s.new_password == "new"
+
+
+# --- errors + robustness ---------------------------------------------------
+
+@pytest.mark.parametrize("bad", [
+    "",
+    "GO OVER like",            # missing FROM
+    "GO FROM 1",               # missing OVER
+    "CREATE",
+    "INSERT VERTEX VALUES 1:(2)",
+    "FFFFF 1",
+    "YIELD",
+    "GO FROM 1 OVER like WHERE",
+    'INSERT VERTEX p(a) VALUES 1:("unterminated)',
+])
+def test_syntax_errors(bad):
+    with pytest.raises(ParseError):
+        parse(bad)
+
+
+def test_case_insensitive_keywords():
+    s = parse1("go from 1 over like yield like._dst")
+    assert isinstance(s, ast.GoSentence)
+
+
+def test_comments_and_whitespace():
+    s = parse("GO FROM 1 OVER like # trailing comment\n; -- another\nSHOW SPACES")
+    assert len(s.sentences) == 2
+
+
+def test_to_string_roundtrip():
+    for q in [
+        "GO 2 STEPS FROM 1 OVER like WHERE like.likeness > 90 YIELD like._dst AS id",
+        'CREATE TAG player(name STRING, age INT)',
+        "INSERT EDGE like(likeness) VALUES 1 -> 2@3:(90.0)",
+        "FIND SHORTEST PATH FROM 1 TO 2 OVER like UPTO 4 STEPS",
+    ]:
+        s1 = parse1(q)
+        s2 = parse1(s1.to_string())
+        assert s2.to_string() == s1.to_string()
+
+
+def test_backticked_identifiers():
+    s = parse1("CREATE TAG `order`(`limit` int)")
+    assert s.name == "order"
+    assert s.columns[0].name == "limit"

@@ -1,0 +1,244 @@
+"""Storage processor + client tests (parity model: storage/test/QueryBoundTest,
+AddEdgesTest, UpdateVertexTest, StorageClientTest)."""
+import pytest
+
+from nebula_tpu.codec import PropType, RowWriter, Schema, SchemaField
+from nebula_tpu.common.status import ErrorCode
+from nebula_tpu.filter.expressions import encode_expression
+from nebula_tpu.kvstore import GraphStore
+from nebula_tpu.meta.schema_manager import AdHocSchemaManager
+from nebula_tpu.parser import GQLParser
+from nebula_tpu.storage import (EdgeKey, NewEdge, NewVertex, StorageClient,
+                                StorageService, UpdateItemReq)
+
+NUM_PARTS = 4
+PLAYER_TAG = 1
+LIKE_EDGE = 1
+SERVE_EDGE = 2
+
+
+def parse_expr(text):
+    return GQLParser().parse(f"YIELD {text} AS x").sentences[0].yield_.columns[0].expr
+
+
+@pytest.fixture()
+def cluster():
+    """In-proc single-node mini-cluster (parity: TestUtils::setupKV)."""
+    sm = AdHocSchemaManager()
+    sm.set_num_parts(1, NUM_PARTS)
+    player = Schema([SchemaField("name", PropType.STRING),
+                     SchemaField("age", PropType.INT)])
+    like = Schema([SchemaField("likeness", PropType.DOUBLE)])
+    serve = Schema([SchemaField("years", PropType.INT)])
+    sm.add_tag(1, PLAYER_TAG, "player", player)
+    sm.add_edge(1, LIKE_EDGE, "like", like)
+    sm.add_edge(1, SERVE_EDGE, "serve", serve)
+    store = GraphStore()
+    for p in range(1, NUM_PARTS + 1):
+        store.add_part(1, p)
+    svc = StorageService(store, sm)
+    client = StorageClient(sm, local_service=svc)
+    return sm, store, svc, client, player, like, serve
+
+
+def insert_sample(client, player, like, serve):
+    vertices = []
+    for vid, name, age in [(100, "Tim", 42), (101, "Tony", 36), (102, "Manu", 41),
+                           (103, "LaMarcus", 33)]:
+        row = RowWriter(player).set("name", name).set("age", age).encode()
+        vertices.append(NewVertex(vid, [(PLAYER_TAG, row)]))
+    assert client.add_vertices(1, vertices).ok()
+    edges = []
+    for src, dst, w in [(100, 101, 95.0), (100, 102, 95.0), (101, 100, 95.0),
+                        (102, 100, 90.0), (103, 100, 75.0)]:
+        row = RowWriter(like).set("likeness", w).encode()
+        edges.append(NewEdge(src, LIKE_EDGE, 0, dst, row))
+    assert client.add_edges(1, edges).ok()
+    return vertices, edges
+
+
+def test_get_neighbors_out(cluster):
+    sm, store, svc, client, player, like, serve = cluster
+    insert_sample(client, player, like, serve)
+    resp = client.get_neighbors(1, [100], [LIKE_EDGE])
+    by_vid = {v.vid: v for v in resp.vertices}
+    dsts = sorted(e.dst for e in by_vid[100].edges)
+    assert dsts == [101, 102]
+    props = {e.dst: e.props["likeness"] for e in by_vid[100].edges}
+    assert props == {101: 95.0, 102: 95.0}
+
+
+def test_get_neighbors_reverse(cluster):
+    sm, store, svc, client, player, like, serve = cluster
+    insert_sample(client, player, like, serve)
+    resp = client.get_neighbors(1, [100], [-LIKE_EDGE])
+    by_vid = {v.vid: v for v in resp.vertices}
+    dsts = sorted(e.dst for e in by_vid[100].edges)
+    assert dsts == [101, 102, 103]  # who likes 100
+
+
+def test_get_neighbors_with_src_props(cluster):
+    sm, store, svc, client, player, like, serve = cluster
+    insert_sample(client, player, like, serve)
+    resp = client.get_neighbors(1, [100], [LIKE_EDGE],
+                                vertex_props={PLAYER_TAG: ["name"]})
+    v = {v.vid: v for v in resp.vertices}[100]
+    assert v.tag_props[PLAYER_TAG] == {"name": "Tim"}
+
+
+def test_filter_pushdown_on_edge_props(cluster):
+    sm, store, svc, client, player, like, serve = cluster
+    insert_sample(client, player, like, serve)
+    flt = encode_expression(parse_expr("like.likeness > 80.0"))
+    resp = client.get_neighbors(1, [103, 102], [LIKE_EDGE], filter_bytes=flt)
+    edges = [e for v in resp.vertices for e in v.edges]
+    # 103 -> 100 has likeness 75, filtered out; 102 -> 100 (90) kept
+    assert [(e.src, e.dst) for e in edges] == [(102, 100)]
+
+
+def test_filter_pushdown_on_src_props(cluster):
+    sm, store, svc, client, player, like, serve = cluster
+    insert_sample(client, player, like, serve)
+    flt = encode_expression(parse_expr("$^.player.age > 40"))
+    resp = client.get_neighbors(1, [100, 101], [LIKE_EDGE], filter_bytes=flt)
+    srcs = sorted({e.src for v in resp.vertices for e in v.edges})
+    assert srcs == [100]  # Tim (42) passes, Tony (36) filtered
+
+
+def test_filter_not_pushable_rejected(cluster):
+    sm, store, svc, client, player, like, serve = cluster
+    insert_sample(client, player, like, serve)
+    flt = encode_expression(parse_expr("$$.player.age > 40"))
+    resp = client.get_neighbors(1, [100], [LIKE_EDGE], filter_bytes=flt)
+    assert all(r.code == ErrorCode.E_INVALID_FILTER for r in resp.results.values())
+
+
+def test_edge_version_dedup(cluster):
+    """Two writes to the same logical edge: scan sees only the newest."""
+    sm, store, svc, client, player, like, serve = cluster
+    row1 = RowWriter(like).set("likeness", 10.0).encode()
+    client.add_edges(1, [NewEdge(1, LIKE_EDGE, 0, 2, row1)])
+    import time
+    time.sleep(0.001)
+    row2 = RowWriter(like).set("likeness", 99.0).encode()
+    client.add_edges(1, [NewEdge(1, LIKE_EDGE, 0, 2, row2)])
+    resp = client.get_neighbors(1, [1], [LIKE_EDGE])
+    edges = [e for v in resp.vertices for e in v.edges]
+    assert len(edges) == 1
+    assert edges[0].props["likeness"] == 99.0
+
+
+def test_max_edges_cap(cluster):
+    sm, store, svc, client, player, like, serve = cluster
+    rows = [NewEdge(7, LIKE_EDGE, r, 1000 + r,
+                    RowWriter(like).set("likeness", 1.0).encode())
+            for r in range(20)]
+    client.add_edges(1, rows)
+    resp = client.get_neighbors(1, [7], [LIKE_EDGE], max_edges_per_vertex=5)
+    edges = [e for v in resp.vertices for e in v.edges]
+    assert len(edges) == 5
+
+
+def test_get_vertex_props(cluster):
+    sm, store, svc, client, player, like, serve = cluster
+    insert_sample(client, player, like, serve)
+    resp = client.get_vertex_props(1, [100, 101])
+    by_vid = {v.vid: v for v in resp.vertices}
+    assert by_vid[100].tag_props[PLAYER_TAG]["name"] == "Tim"
+    assert by_vid[101].tag_props[PLAYER_TAG]["age"] == 36
+
+
+def test_get_edge_props(cluster):
+    sm, store, svc, client, player, like, serve = cluster
+    insert_sample(client, player, like, serve)
+    resp = client.get_edge_props(1, [EdgeKey(100, LIKE_EDGE, 0, 101)])
+    assert len(resp.edges) == 1
+    assert resp.edges[0].props["likeness"] == 95.0
+
+
+def test_delete_edges_removes_both_directions(cluster):
+    sm, store, svc, client, player, like, serve = cluster
+    insert_sample(client, player, like, serve)
+    client.delete_edges(1, [EdgeKey(100, LIKE_EDGE, 0, 101)])
+    out = client.get_neighbors(1, [100], [LIKE_EDGE])
+    assert sorted(e.dst for v in out.vertices for e in v.edges) == [102]
+    rev = client.get_neighbors(1, [101], [-LIKE_EDGE])
+    assert [e.dst for v in rev.vertices for e in v.edges] == []
+
+
+def test_delete_vertex_cascades(cluster):
+    sm, store, svc, client, player, like, serve = cluster
+    insert_sample(client, player, like, serve)
+    client.delete_vertices(1, [100])
+    props = client.get_vertex_props(1, [100])
+    assert props.vertices == []
+    # in-neighbors no longer see edges to 100
+    resp = client.get_neighbors(1, [101, 102, 103], [LIKE_EDGE])
+    dsts = [e.dst for v in resp.vertices for e in v.edges]
+    assert 100 not in dsts
+
+
+def test_update_vertex_with_when_and_yield(cluster):
+    sm, store, svc, client, player, like, serve = cluster
+    insert_sample(client, player, like, serve)
+    items = [UpdateItemReq("age", encode_expression(parse_expr("age + 1")))]
+    resp = client.update_vertex(1, 100, PLAYER_TAG, items,
+                                when=encode_expression(parse_expr("age > 40")),
+                                yield_props=["age"])
+    assert resp.code == ErrorCode.SUCCEEDED
+    assert resp.props == {"age": 43}
+    # WHEN fails for Tony (36)
+    resp = client.update_vertex(1, 101, PLAYER_TAG, items,
+                                when=encode_expression(parse_expr("age > 40")))
+    assert resp.code == ErrorCode.E_FILTER_OUT
+
+
+def test_upsert_vertex_missing(cluster):
+    sm, store, svc, client, player, like, serve = cluster
+    items = [UpdateItemReq("age", encode_expression(parse_expr("77")))]
+    resp = client.update_vertex(1, 999, PLAYER_TAG, items, insertable=False)
+    assert resp.code == ErrorCode.E_KEY_NOT_FOUND
+    resp = client.update_vertex(1, 999, PLAYER_TAG, items, insertable=True,
+                                yield_props=["age"])
+    assert resp.code == ErrorCode.SUCCEEDED and resp.upsert
+    assert resp.props == {"age": 77}
+
+
+def test_update_edge_keeps_reverse_in_sync(cluster):
+    sm, store, svc, client, player, like, serve = cluster
+    insert_sample(client, player, like, serve)
+    items = [UpdateItemReq("likeness", encode_expression(parse_expr("50.0")))]
+    resp = client.update_edge(1, EdgeKey(100, LIKE_EDGE, 0, 101), items)
+    assert resp.code == ErrorCode.SUCCEEDED
+    fwd = client.get_neighbors(1, [100], [LIKE_EDGE])
+    vals = {e.dst: e.props["likeness"] for v in fwd.vertices for e in v.edges}
+    assert vals[101] == 50.0
+    rev = client.get_neighbors(1, [101], [-LIKE_EDGE])
+    vals = {e.dst: e.props["likeness"] for v in rev.vertices for e in v.edges}
+    assert vals[100] == 50.0
+
+
+def test_uuid_stable(cluster):
+    sm, store, svc, client, player, like, serve = cluster
+    _, vid1 = client.get_uuid(1, "Tim Duncan")
+    _, vid2 = client.get_uuid(1, "Tim Duncan")
+    _, vid3 = client.get_uuid(1, "Tony Parker")
+    assert vid1 == vid2
+    assert vid1 != vid3
+
+
+def test_ttl_expired_rows_invisible(cluster):
+    sm, store, svc, client, player, like, serve = cluster
+    import time
+    ttl_tag = Schema([SchemaField("v", PropType.INT),
+                      SchemaField("ts", PropType.TIMESTAMP)],
+                     ttl_col="ts", ttl_duration=1000)
+    sm.add_tag(1, 9, "ephemeral", ttl_tag)
+    now = int(time.time())
+    fresh = RowWriter(ttl_tag).set("v", 1).set("ts", now).encode()
+    stale = RowWriter(ttl_tag).set("v", 2).set("ts", now - 5000).encode()
+    client.add_vertices(1, [NewVertex(201, [(9, fresh)]),
+                            NewVertex(202, [(9, stale)])])
+    resp = client.get_vertex_props(1, [201, 202], tag_ids=[9])
+    vids = [v.vid for v in resp.vertices]
+    assert vids == [201]
