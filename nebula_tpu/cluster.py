@@ -1,0 +1,76 @@
+"""Single-process cluster wiring.
+
+Role parity with the reference's test/deployment bootstrap
+(`graph/test/TestEnv.cpp:29-71` boots metad + storaged + graphd in one
+process; `storage/StorageServer.cpp:88-144` wires MetaClient →
+SchemaManager → store → handlers). This is both the unit-test fixture
+and the single-node deployment entry point; the daemons/ package runs
+the same components behind the rpc/ transport for multi-process.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .graph.engine import ExecutionEngine, GraphService
+from .graph.session import SessionManager
+from .kvstore.store import GraphStore
+from .meta.schema_manager import SchemaManager
+from .meta.service import MetaService
+from .storage.client import StorageClient
+from .storage.processors import StorageService
+
+
+class InProcCluster:
+    """metad + storaged + graphd in one process."""
+
+    def __init__(self, tpu_engine=None, balancer_factory=None):
+        self.meta = MetaService()
+        self.sm = SchemaManager(self.meta)
+        self.store = GraphStore()
+        self.storage = StorageService(self.store, self.sm)
+        self.client = StorageClient(self.sm, local_service=self.storage)
+        # meta-driven topology: new space -> local parts appear (the
+        # MetaChangedListener push, ref meta/client/MetaClient.h:87-96)
+        self.meta.add_listener(self._on_meta_change)
+        self.balancer = balancer_factory(self) if balancer_factory else None
+        self.engine = ExecutionEngine(self.meta, self.sm, self.client,
+                                      tpu_engine=tpu_engine,
+                                      balancer=self.balancer)
+        self.service = GraphService(self.engine)
+        if tpu_engine is not None:
+            tpu_engine.attach(self)
+
+    def _on_meta_change(self, event: str, **kw) -> None:
+        if event == "space_added":
+            desc = kw["desc"]
+            for part in range(1, desc.partition_num + 1):
+                self.store.add_part(desc.space_id, part)
+        elif event == "space_removed":
+            self.store.remove_space(kw["space_id"])
+
+    # ------------------------------------------------------------------
+    # convenience API
+    # ------------------------------------------------------------------
+    def connect(self, user: str = "root", password: str = "") -> "Connection":
+        sid = self.service.authenticate(user, password).value()
+        return Connection(self.service, sid)
+
+
+class Connection:
+    def __init__(self, service: GraphService, session_id: int):
+        self._service = service
+        self.session_id = session_id
+
+    def execute(self, text: str):
+        return self._service.execute(self.session_id, text)
+
+    def must(self, text: str):
+        """Execute and raise on error (test helper)."""
+        resp = self._service.execute(self.session_id, text)
+        if not resp.ok():
+            raise RuntimeError(f"query failed [{resp.code.name}]: "
+                               f"{resp.error_msg}\n  query: {text}")
+        return resp
+
+    def close(self) -> None:
+        self._service.signout(self.session_id)

@@ -1,0 +1,3 @@
+from .engine import ExecutionEngine, ExecutionResponse  # noqa: F401
+from .session import SessionManager, ClientSession  # noqa: F401
+from .interim import InterimResult  # noqa: F401

@@ -1,0 +1,246 @@
+"""Admin / DDL / RBAC executors.
+
+Role parity with the reference's DDL+admin executor family
+(CreateSpace/DropSpace/DescribeSpace, Create/Alter/Drop/Describe
+Tag/Edge, ShowExecutor, ConfigExecutor, BalanceExecutor, UseExecutor,
+user management executors) — thin translations from AST to MetaService
+calls plus table formatting (ref: SURVEY.md §2.1 DDL/admin row).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..common.status import ErrorCode, Status, StatusOr
+from ..parser import ast
+from .context import ExecContext
+from .executors import Result, _err, _ok
+from .interim import InterimResult
+
+_TYPE_NAMES = {1: "bool", 2: "int", 3: "vid", 5: "double", 6: "string",
+               7: "timestamp"}
+
+
+def execute_use(ctx: ExecContext, s: ast.UseSentence) -> Result:
+    r = ctx.meta.get_space(s.space)
+    if not r.ok():
+        return StatusOr.from_status(r.status)
+    ctx.session.space_name = s.space
+    ctx.session.space_id = r.value().space_id
+    return _ok()
+
+
+def execute_create_space(ctx: ExecContext, s: ast.CreateSpaceSentence) -> Result:
+    r = ctx.meta.create_space(s.name, s.partition_num, s.replica_factor,
+                              s.if_not_exists)
+    if not r.ok():
+        return StatusOr.from_status(r.status)
+    return _ok()
+
+
+def execute_drop_space(ctx: ExecContext, s: ast.DropSpaceSentence) -> Result:
+    st = ctx.meta.drop_space(s.name, s.if_exists)
+    if not st.ok():
+        return StatusOr.from_status(st)
+    if ctx.session.space_name == s.name:
+        ctx.session.space_name = None
+        ctx.session.space_id = -1
+    return _ok()
+
+
+def execute_describe_space(ctx: ExecContext, s: ast.DescribeSpaceSentence) -> Result:
+    r = ctx.meta.get_space(s.name)
+    if not r.ok():
+        return StatusOr.from_status(r.status)
+    d = r.value()
+    return _ok(InterimResult(
+        ["ID", "Name", "Partition number", "Replica Factor"],
+        [(d.space_id, d.name, d.partition_num, d.replica_factor)]))
+
+
+def _columns_from_ast(cols: List[ast.ColumnDef]) -> List[dict]:
+    return [{"name": c.name, "type": c.type_name, "default": c.default}
+            for c in cols]
+
+
+def execute_create_schema(ctx: ExecContext, s: ast.CreateSchemaSentence) -> Result:
+    st = ctx.require_space()
+    if not st.ok():
+        return StatusOr.from_status(st)
+    fn = ctx.meta.create_edge if s.is_edge else ctx.meta.create_tag
+    r = fn(ctx.space_id(), s.name, _columns_from_ast(s.columns),
+           ttl_col=s.opts.ttl_col, ttl_duration=s.opts.ttl_duration or 0,
+           if_not_exists=s.if_not_exists)
+    if not r.ok():
+        return StatusOr.from_status(r.status)
+    return _ok()
+
+
+def execute_alter_schema(ctx: ExecContext, s: ast.AlterSchemaSentence) -> Result:
+    st = ctx.require_space()
+    if not st.ok():
+        return StatusOr.from_status(st)
+    fn = ctx.meta.alter_edge if s.is_edge else ctx.meta.alter_tag
+    st = fn(ctx.space_id(), s.name,
+            adds=_columns_from_ast(s.adds),
+            changes=_columns_from_ast(s.changes),
+            drops=list(s.drops),
+            ttl_col=s.opts.ttl_col, ttl_duration=s.opts.ttl_duration)
+    if not st.ok():
+        return StatusOr.from_status(st)
+    return _ok()
+
+
+def execute_drop_schema(ctx: ExecContext, s: ast.DropSchemaSentence) -> Result:
+    st = ctx.require_space()
+    if not st.ok():
+        return StatusOr.from_status(st)
+    fn = ctx.meta.drop_edge if s.is_edge else ctx.meta.drop_tag
+    st = fn(ctx.space_id(), s.name, s.if_exists)
+    if not st.ok():
+        return StatusOr.from_status(st)
+    return _ok()
+
+
+def execute_describe_schema(ctx: ExecContext, s: ast.DescribeSchemaSentence) -> Result:
+    st = ctx.require_space()
+    if not st.ok():
+        return StatusOr.from_status(st)
+    space = ctx.space_id()
+    sid = (ctx.sm.edge_type if s.is_edge else ctx.sm.tag_id)(space, s.name)
+    if sid is None:
+        return _err(ErrorCode.E_EDGE_NOT_FOUND if s.is_edge
+                    else ErrorCode.E_TAG_NOT_FOUND, s.name)
+    sr = (ctx.sm.edge_schema if s.is_edge else ctx.sm.tag_schema)(space, sid)
+    if not sr.ok():
+        return StatusOr.from_status(sr.status)
+    schema = sr.value()
+    rows = [(f.name, _TYPE_NAMES.get(int(f.type), str(int(f.type))),
+             "YES" if f.nullable else "NO",
+             f.default if f.default is not None else "")
+            for f in schema.fields]
+    return _ok(InterimResult(["Field", "Type", "Null", "Default"], rows))
+
+
+def execute_show(ctx: ExecContext, s: ast.ShowSentence) -> Result:
+    k = s.what
+    if k == ast.ShowKind.SPACES:
+        return _ok(InterimResult(["Name"],
+                                 [(d.name,) for d in ctx.meta.list_spaces()]))
+    if k in (ast.ShowKind.TAGS, ast.ShowKind.EDGES):
+        st = ctx.require_space()
+        if not st.ok():
+            return StatusOr.from_status(st)
+        items = (ctx.meta.list_edges if k == ast.ShowKind.EDGES
+                 else ctx.meta.list_tags)(ctx.space_id())
+        return _ok(InterimResult(["ID", "Name"],
+                                 [(i, n) for n, i in sorted(items)]))
+    if k == ast.ShowKind.HOSTS:
+        rows = []
+        for info, alive in ctx.meta.all_hosts():
+            rows.append((info.host, "online" if alive else "offline"))
+        return _ok(InterimResult(["Ip:Port", "Status"], rows))
+    if k == ast.ShowKind.PARTS:
+        st = ctx.require_space()
+        if not st.ok():
+            return StatusOr.from_status(st)
+        alloc = ctx.meta.get_parts_alloc(ctx.space_id())
+        rows = [(pid, ", ".join(hosts)) for pid, hosts in sorted(alloc.items())]
+        return _ok(InterimResult(["Partition ID", "Peers"], rows))
+    if k == ast.ShowKind.USERS:
+        return _ok(InterimResult(["User"],
+                                 [(u,) for u in ctx.meta.list_users()]))
+    if k == ast.ShowKind.ROLES:
+        r = ctx.meta.get_space(s.arg)
+        if not r.ok():
+            return StatusOr.from_status(r.status)
+        return _ok(InterimResult(["User", "Role"],
+                                 ctx.meta.list_roles(r.value().space_id)))
+    if k == ast.ShowKind.SNAPSHOTS:
+        return _ok(InterimResult(["Name", "Status"], []))
+    if k == ast.ShowKind.VARIABLES:
+        rows = [(name, repr(res.columns)) for name, res in ctx.variables.items()]
+        return _ok(InterimResult(["Variable", "Columns"], rows))
+    return _err(ErrorCode.E_UNSUPPORTED, f"SHOW {k.value}")
+
+
+def execute_config(ctx: ExecContext, s: ast.ConfigSentence) -> Result:
+    if s.action == "SHOW":
+        rows = [(mn.split(":")[0], mn.split(":")[1], str(v), mode)
+                for mn, v, mode in ctx.meta.list_configs(s.module)]
+        return _ok(InterimResult(["module", "name", "value", "mode"], rows))
+    if s.action == "GET":
+        r = ctx.meta.get_config(s.module or "GRAPH", s.name)
+        if not r.ok():
+            return StatusOr.from_status(r.status)
+        return _ok(InterimResult(["name", "value"], [(s.name, str(r.value()))]))
+    if s.action == "SET":
+        from .expr_context import RowExprContext
+        try:
+            val = s.value.eval(RowExprContext())
+        except Exception as e:
+            return _err(ErrorCode.E_INVALID_ARGUMENT, str(e))
+        st = ctx.meta.set_config(s.module or "GRAPH", s.name, val)
+        if not st.ok():
+            return StatusOr.from_status(st)
+        return _ok()
+    return _err(ErrorCode.E_UNSUPPORTED, s.action)
+
+
+def execute_balance(ctx: ExecContext, s: ast.BalanceSentence) -> Result:
+    balancer = getattr(ctx.engine, "balancer", None)
+    if balancer is None:
+        return _err(ErrorCode.E_UNSUPPORTED, "balancer not available")
+    if s.sub == "LEADER":
+        st = balancer.leader_balance()
+        if not st.ok():
+            return StatusOr.from_status(st)
+        return _ok()
+    if s.sub == "DATA":
+        r = balancer.balance(remove_hosts=s.remove_hosts)
+        if not r.ok():
+            return StatusOr.from_status(r.status)
+        return _ok(InterimResult(["ID"], [(r.value(),)]))
+    if s.sub == "SHOW":
+        r = balancer.show_plan(s.plan_id)
+        if not r.ok():
+            return StatusOr.from_status(r.status)
+        return _ok(InterimResult(["balance task", "status"], r.value()))
+    if s.sub == "STOP":
+        st = balancer.stop()
+        if not st.ok():
+            return StatusOr.from_status(st)
+        return _ok()
+    return _err(ErrorCode.E_UNSUPPORTED, s.sub)
+
+
+# --- users (ref: graph user executors + meta usersMan) ---------------------
+
+def execute_create_user(ctx: ExecContext, s: ast.CreateUserSentence) -> Result:
+    st = ctx.meta.create_user(s.user, s.password, s.if_not_exists)
+    return _ok() if st.ok() else StatusOr.from_status(st)
+
+
+def execute_drop_user(ctx: ExecContext, s: ast.DropUserSentence) -> Result:
+    st = ctx.meta.drop_user(s.user, s.if_exists)
+    return _ok() if st.ok() else StatusOr.from_status(st)
+
+
+def execute_change_password(ctx: ExecContext, s: ast.ChangePasswordSentence) -> Result:
+    st = ctx.meta.change_password(s.user, s.new_password, s.old_password)
+    return _ok() if st.ok() else StatusOr.from_status(st)
+
+
+def execute_grant(ctx: ExecContext, s: ast.GrantSentence) -> Result:
+    r = ctx.meta.get_space(s.space)
+    if not r.ok():
+        return StatusOr.from_status(r.status)
+    st = ctx.meta.grant_role(r.value().space_id, s.user, s.role)
+    return _ok() if st.ok() else StatusOr.from_status(st)
+
+
+def execute_revoke(ctx: ExecContext, s: ast.RevokeSentence) -> Result:
+    r = ctx.meta.get_space(s.space)
+    if not r.ok():
+        return StatusOr.from_status(r.status)
+    st = ctx.meta.revoke_role(r.value().space_id, s.user)
+    return _ok() if st.ok() else StatusOr.from_status(st)

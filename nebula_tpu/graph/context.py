@@ -1,0 +1,58 @@
+"""Per-query execution context.
+
+Role parity with the reference's `graph/ExecutionContext` +
+`VariableHolder.cpp`: carries the session, the engine's service handles
+(meta / schema / storage client), the `$var` table, and the pipe input
+flowing between executors.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..common.status import ErrorCode, Status
+from .interim import InterimResult
+from .session import ClientSession
+
+
+@dataclass
+class ExecutionResponse:
+    code: ErrorCode = ErrorCode.SUCCEEDED
+    error_msg: str = ""
+    columns: List[str] = field(default_factory=list)
+    rows: List[Tuple] = field(default_factory=list)
+    latency_us: int = 0
+    space_name: str = ""
+    warning: str = ""
+
+    def ok(self) -> bool:
+        return self.code == ErrorCode.SUCCEEDED
+
+
+class ExecContext:
+    def __init__(self, engine, session: ClientSession):
+        self.engine = engine
+        self.session = session
+        self.variables: Dict[str, InterimResult] = {}
+        self.input: Optional[InterimResult] = None
+
+    @property
+    def meta(self):
+        return self.engine.meta
+
+    @property
+    def sm(self):
+        return self.engine.sm
+
+    @property
+    def client(self):
+        return self.engine.client
+
+    def space_id(self) -> int:
+        return self.session.space_id
+
+    def require_space(self) -> Status:
+        if self.session.space_id < 0:
+            return Status.error(ErrorCode.E_EXECUTION_ERROR,
+                                "please choose a graph space with `USE spaceName` first")
+        return Status.OK()

@@ -1,0 +1,200 @@
+"""Execution engine + graph service facade.
+
+Role parity with the reference's `graph/GraphService.cpp` (authenticate/
+signout/execute), `graph/ExecutionEngine.cpp` (owns meta + schema +
+storage clients), `graph/ExecutionPlan.cpp` (parse → execute → respond
+with latency) and `graph/PermissionManager.h` (RBAC gate per sentence).
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+from ..common.status import ErrorCode, Status, StatusOr
+from ..meta.schema_manager import SchemaManager
+from ..parser import GQLParser, ParseError, ast
+from . import admin_executors as adm
+from . import executors as ex
+from .context import ExecContext, ExecutionResponse
+from .interim import InterimResult
+from .session import ClientSession, SessionManager
+
+# role ranks (GOD > ADMIN > USER > GUEST, ref meta.thrift:56-70)
+_ROLE_RANK = {"GOD": 4, "ADMIN": 3, "USER": 2, "GUEST": 1, None: 0}
+
+# sentence kind -> minimum role required in the current space
+_WRITE_KINDS = {ast.Kind.INSERT_VERTICES, ast.Kind.INSERT_EDGES,
+                ast.Kind.DELETE_VERTICES, ast.Kind.DELETE_EDGES,
+                ast.Kind.UPDATE_VERTEX, ast.Kind.UPDATE_EDGE, ast.Kind.INGEST}
+_SCHEMA_KINDS = {ast.Kind.CREATE_TAG, ast.Kind.CREATE_EDGE, ast.Kind.ALTER_TAG,
+                 ast.Kind.ALTER_EDGE, ast.Kind.DROP_TAG, ast.Kind.DROP_EDGE}
+_GOD_KINDS = {ast.Kind.CREATE_SPACE, ast.Kind.DROP_SPACE, ast.Kind.BALANCE,
+              ast.Kind.CREATE_USER, ast.Kind.DROP_USER, ast.Kind.CONFIG,
+              ast.Kind.CREATE_SNAPSHOT, ast.Kind.DROP_SNAPSHOT}
+
+
+class PermissionManager:
+    """ref: graph/PermissionManager.h — role gate ahead of execution."""
+
+    @staticmethod
+    def check(ctx: ExecContext, sentence: ast.Sentence) -> Status:
+        user = ctx.session.user
+        if user == "root":
+            return Status.OK()
+        kind = sentence.kind
+        role = ctx.meta.get_role(ctx.space_id(), user) \
+            if ctx.space_id() >= 0 else None
+        rank = _ROLE_RANK.get(role, 0)
+        if kind in _GOD_KINDS and rank < 4:
+            return Status.error(ErrorCode.E_BAD_PERMISSION,
+                                f"{kind.value} requires GOD role")
+        if kind in _SCHEMA_KINDS and rank < 3:
+            return Status.error(ErrorCode.E_BAD_PERMISSION,
+                                f"{kind.value} requires ADMIN role")
+        if kind in _WRITE_KINDS and rank < 2:
+            return Status.error(ErrorCode.E_BAD_PERMISSION,
+                                f"{kind.value} requires USER role")
+        if kind == ast.Kind.GRANT or kind == ast.Kind.REVOKE:
+            if rank < 3:
+                return Status.error(ErrorCode.E_BAD_PERMISSION,
+                                    "GRANT/REVOKE requires ADMIN role")
+        return Status.OK()
+
+
+class ExecutionEngine:
+    """Owns the service clients; executes parsed statements."""
+
+    def __init__(self, meta, schema_manager: SchemaManager, storage_client,
+                 tpu_engine=None, balancer=None):
+        self.meta = meta
+        self.sm = schema_manager
+        self.client = storage_client
+        self.tpu_engine = tpu_engine
+        self.balancer = balancer
+        self._parser = GQLParser()
+
+    # ------------------------------------------------------------------
+    def execute(self, session: ClientSession, text: str) -> ExecutionResponse:
+        t0 = time.monotonic()
+        resp = ExecutionResponse(space_name=session.space_name or "")
+        try:
+            seq = self._parser.parse(text)
+        except ParseError as e:
+            resp.code = ErrorCode.E_SYNTAX_ERROR
+            resp.error_msg = str(e)
+            return resp
+        ctx = ExecContext(self, session)
+        result: Optional[InterimResult] = None
+        for sentence in seq.sentences:
+            r = self._run(ctx, sentence)
+            if not r.ok():
+                resp.code = r.status.code
+                resp.error_msg = r.status.msg or r.status.code.name
+                resp.latency_us = int((time.monotonic() - t0) * 1e6)
+                return resp
+            result = r.value()
+            ctx.input = None  # pipe input does not leak across ';'
+        if result is not None:
+            resp.columns = result.columns
+            resp.rows = result.rows
+        resp.space_name = session.space_name or ""
+        resp.latency_us = int((time.monotonic() - t0) * 1e6)
+        return resp
+
+    # ------------------------------------------------------------------
+    def _run(self, ctx: ExecContext, s: ast.Sentence) -> ex.Result:
+        st = PermissionManager.check(ctx, s)
+        if not st.ok():
+            return StatusOr.from_status(st)
+        kind = s.kind
+        if kind == ast.Kind.PIPE:
+            lr = self._run(ctx, s.left)
+            if not lr.ok():
+                return lr
+            ctx.input = lr.value()
+            rr = self._run(ctx, s.right)
+            ctx.input = None
+            return rr
+        if kind == ast.Kind.ASSIGNMENT:
+            rr = self._run(ctx, s.sentence)
+            if not rr.ok():
+                return rr
+            if rr.value() is None:
+                return ex._err(ErrorCode.E_EXECUTION_ERROR,
+                               f"${s.var} = <statement> produced no table")
+            ctx.variables[s.var] = rr.value()
+            return ex._ok(None)
+        if kind == ast.Kind.SET_OP:
+            return ex.execute_set_op(ctx, s, self._run)
+        fn = _DISPATCH.get(kind)
+        if fn is None:
+            return ex._err(ErrorCode.E_UNSUPPORTED,
+                           f"statement {kind.value} not supported yet")
+        return fn(ctx, s)
+
+
+_DISPATCH: Dict[ast.Kind, Callable] = {
+    ast.Kind.GO: ex.execute_go,
+    ast.Kind.FIND_PATH: ex.execute_find_path,
+    ast.Kind.FETCH_VERTICES: ex.execute_fetch_vertices,
+    ast.Kind.FETCH_EDGES: ex.execute_fetch_edges,
+    ast.Kind.INSERT_VERTICES: ex.execute_insert_vertices,
+    ast.Kind.INSERT_EDGES: ex.execute_insert_edges,
+    ast.Kind.DELETE_VERTICES: ex.execute_delete_vertices,
+    ast.Kind.DELETE_EDGES: ex.execute_delete_edges,
+    ast.Kind.UPDATE_VERTEX: ex.execute_update_vertex,
+    ast.Kind.UPDATE_EDGE: ex.execute_update_edge,
+    ast.Kind.YIELD: ex.execute_yield,
+    ast.Kind.ORDER_BY: ex.execute_order_by,
+    ast.Kind.LIMIT: ex.execute_limit,
+    ast.Kind.GROUP_BY: ex.execute_group_by,
+    ast.Kind.USE: adm.execute_use,
+    ast.Kind.CREATE_SPACE: adm.execute_create_space,
+    ast.Kind.DROP_SPACE: adm.execute_drop_space,
+    ast.Kind.DESCRIBE_SPACE: adm.execute_describe_space,
+    ast.Kind.CREATE_TAG: adm.execute_create_schema,
+    ast.Kind.CREATE_EDGE: adm.execute_create_schema,
+    ast.Kind.ALTER_TAG: adm.execute_alter_schema,
+    ast.Kind.ALTER_EDGE: adm.execute_alter_schema,
+    ast.Kind.DROP_TAG: adm.execute_drop_schema,
+    ast.Kind.DROP_EDGE: adm.execute_drop_schema,
+    ast.Kind.DESCRIBE_TAG: adm.execute_describe_schema,
+    ast.Kind.DESCRIBE_EDGE: adm.execute_describe_schema,
+    ast.Kind.SHOW: adm.execute_show,
+    ast.Kind.CONFIG: adm.execute_config,
+    ast.Kind.BALANCE: adm.execute_balance,
+    ast.Kind.CREATE_USER: adm.execute_create_user,
+    ast.Kind.DROP_USER: adm.execute_drop_user,
+    ast.Kind.ALTER_USER: adm.execute_change_password,
+    ast.Kind.CHANGE_PASSWORD: adm.execute_change_password,
+    ast.Kind.GRANT: adm.execute_grant,
+    ast.Kind.REVOKE: adm.execute_revoke,
+}
+
+
+class GraphService:
+    """Authentication + session-scoped execute (ref: graph/GraphService
+    .cpp:17-77)."""
+
+    def __init__(self, engine: ExecutionEngine,
+                 sessions: Optional[SessionManager] = None):
+        self.engine = engine
+        self.sessions = sessions or SessionManager()
+
+    def authenticate(self, user: str, password: str) -> StatusOr[int]:
+        if not self.engine.meta.check_password(user, password):
+            return StatusOr.err(ErrorCode.E_BAD_USERNAME_PASSWORD,
+                                "invalid username or password")
+        return StatusOr.of(self.sessions.create(user).session_id)
+
+    def signout(self, session_id: int) -> None:
+        self.sessions.remove(session_id)
+
+    def execute(self, session_id: int, text: str) -> ExecutionResponse:
+        sr = self.sessions.find(session_id)
+        if not sr.ok():
+            resp = ExecutionResponse()
+            resp.code = sr.status.code
+            resp.error_msg = sr.status.msg
+            return resp
+        return self.engine.execute(sr.value(), text)

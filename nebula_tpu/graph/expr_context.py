@@ -1,0 +1,119 @@
+"""Expression contexts for query-engine-side evaluation.
+
+Role parity with the reference's getter-closure binding in
+`graph/GoExecutor.cpp:849-945` (expression getters bound to RPC row
+readers) — here bound to the decoded BoundResponse structures.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ..filter.expressions import EvalError, ExpressionContext
+
+
+class RowExprContext(ExpressionContext):
+    """Binds $- / $var to one row of an InterimResult."""
+
+    def __init__(self, input_row: Optional[Dict[str, Any]] = None,
+                 variables: Optional[Dict[str, Dict[str, Any]]] = None):
+        self.input_row = input_row or {}
+        self.variables = variables or {}
+
+    def get_input_prop(self, prop: str):
+        if prop not in self.input_row:
+            raise EvalError(f"$-.{prop} not found")
+        return self.input_row[prop]
+
+    def get_variable_prop(self, var: str, prop: str):
+        row = self.variables.get(var)
+        if row is None or prop not in row:
+            raise EvalError(f"${var}.{prop} not found")
+        return row[prop]
+
+
+class EdgeRowExprContext(RowExprContext):
+    """Full GO-row context: one edge + its endpoints + back-refs."""
+
+    def __init__(self, *, src_props: Dict[str, Dict[str, Any]],
+                 edge_props: Dict[str, Any], edge_name: str,
+                 alias_map: Dict[str, str],
+                 src: int, dst: int, rank: int,
+                 dst_props: Optional[Dict[str, Dict[str, Any]]] = None,
+                 input_row: Optional[Dict[str, Any]] = None,
+                 variables: Optional[Dict[str, Dict[str, Any]]] = None):
+        super().__init__(input_row, variables)
+        self.src_props = src_props          # tag name -> props
+        self.edge_props = edge_props
+        self.edge_name = edge_name          # canonical name of this row's edge
+        self.alias_map = alias_map          # alias/name -> canonical name
+        self.src = src
+        self.dst = dst
+        self.rank = rank
+        self.dst_props = dst_props or {}    # tag name -> props (of dst vertex)
+
+    def _check_edge(self, edge: Optional[str]) -> bool:
+        if edge is None:
+            return True
+        return self.alias_map.get(edge, edge) == self.edge_name
+
+    def get_src_prop(self, tag: str, prop: str):
+        props = self.src_props.get(tag)
+        if props is None or prop not in props:
+            raise EvalError(f"$^.{tag}.{prop} not found")
+        return props[prop]
+
+    def get_dst_prop(self, tag: str, prop: str):
+        props = self.dst_props.get(tag)
+        if props is None or prop not in props:
+            raise EvalError(f"$$.{tag}.{prop} not found")
+        return props[prop]
+
+    def get_edge_prop(self, edge: Optional[str], prop: str):
+        if not self._check_edge(edge):
+            raise EvalError(f"edge {edge} does not match current row")
+        if prop not in self.edge_props:
+            raise EvalError(f"edge prop {prop} not found")
+        return self.edge_props[prop]
+
+    def get_edge_src(self, edge: Optional[str]):
+        if not self._check_edge(edge):
+            raise EvalError(f"edge {edge} does not match current row")
+        return self.src
+
+    def get_edge_dst(self, edge: Optional[str]):
+        if not self._check_edge(edge):
+            raise EvalError(f"edge {edge} does not match current row")
+        return self.dst
+
+    def get_edge_rank(self, edge: Optional[str]):
+        if not self._check_edge(edge):
+            raise EvalError(f"edge {edge} does not match current row")
+        return self.rank
+
+    def get_edge_type_name(self, edge: Optional[str]):
+        return self.edge_name
+
+
+class TagRowExprContext(RowExprContext):
+    """FETCH PROP ON tag: props of one vertex, addressed as tag.prop."""
+
+    def __init__(self, tag_props: Dict[str, Dict[str, Any]], vid: int,
+                 input_row=None, variables=None):
+        super().__init__(input_row, variables)
+        self.tag_props = tag_props
+        self.vid = vid
+
+    def get_edge_prop(self, edge: Optional[str], prop: str):
+        # tag.prop parses as an EdgePropExpr; resolve against tag props
+        if edge is not None:
+            props = self.tag_props.get(edge)
+            if props is None or prop not in props:
+                raise EvalError(f"{edge}.{prop} not found")
+            return props[prop]
+        for props in self.tag_props.values():
+            if prop in props:
+                return props[prop]
+        raise EvalError(f"{prop} not found")
+
+    def get_src_prop(self, tag: str, prop: str):
+        return self.get_edge_prop(tag, prop)

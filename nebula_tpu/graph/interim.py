@@ -1,0 +1,115 @@
+"""InterimResult: the in-memory table flowing between executors.
+
+Role parity with the reference's `graph/InterimResult.{h,cpp}`: the
+pipe/variable intermediate representation with column access, vid
+extraction for the next traversal step, and a per-vid index for
+back-references ($- / $var props). The reference stores encoded rows
+(RowSetWriter); we store Python tuples — the RPC boundary uses the
+codec, the executor-to-executor hop does not need to.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+
+class InterimResult:
+    def __init__(self, columns: List[str], rows: Optional[List[Tuple]] = None):
+        self.columns = list(columns)
+        self.rows: List[Tuple] = rows or []
+
+    # ------------------------------------------------------------------
+    def col_index(self, name: str) -> int:
+        try:
+            return self.columns.index(name)
+        except ValueError:
+            return -1
+
+    def has_col(self, name: str) -> bool:
+        return name in self.columns
+
+    def get_col(self, name: str) -> List[Any]:
+        i = self.col_index(name)
+        if i < 0:
+            raise KeyError(f"no column {name!r} (have {self.columns})")
+        return [r[i] for r in self.rows]
+
+    def get_vids(self, name: str) -> List[int]:
+        """Distinct int vids of a column, preserving first-seen order
+        (ref: InterimResult::getVIDs)."""
+        seen = set()
+        out = []
+        for v in self.get_col(name):
+            if isinstance(v, bool) or not isinstance(v, int):
+                raise ValueError(f"column {name!r} is not a vid column ({v!r})")
+            if v not in seen:
+                seen.add(v)
+                out.append(v)
+        return out
+
+    def row_dict(self, row: Tuple) -> Dict[str, Any]:
+        return dict(zip(self.columns, row))
+
+    def build_index(self, name: str) -> Dict[int, List[Tuple]]:
+        """vid -> rows (for $- back-references across a traversal step)."""
+        i = self.col_index(name)
+        if i < 0:
+            raise KeyError(name)
+        idx: Dict[int, List[Tuple]] = {}
+        for r in self.rows:
+            idx.setdefault(r[i], []).append(r)
+        return idx
+
+    # ------------------------------------------------------------------
+    def distinct(self) -> "InterimResult":
+        seen = set()
+        out = []
+        for r in self.rows:
+            if r not in seen:
+                seen.add(r)
+                out.append(r)
+        return InterimResult(self.columns, out)
+
+    def union(self, other: "InterimResult", distinct: bool = False) -> "InterimResult":
+        res = InterimResult(self.columns, self.rows + other.rows)
+        return res.distinct() if distinct else res
+
+    def intersect(self, other: "InterimResult") -> "InterimResult":
+        theirs = set(other.rows)
+        return InterimResult(self.columns,
+                             [r for r in self.rows if r in theirs])
+
+    def minus(self, other: "InterimResult") -> "InterimResult":
+        theirs = set(other.rows)
+        return InterimResult(self.columns,
+                             [r for r in self.rows if r not in theirs])
+
+    def limit(self, count: int, offset: int = 0) -> "InterimResult":
+        return InterimResult(self.columns, self.rows[offset:offset + count])
+
+    def order_by(self, factors: Sequence[Tuple[str, bool]]) -> "InterimResult":
+        """factors: [(column, ascending)] applied with stable sorts,
+        least-significant last-first."""
+        rows = list(self.rows)
+        for name, asc in reversed(list(factors)):
+            i = self.col_index(name)
+            if i < 0:
+                raise KeyError(name)
+            rows.sort(key=lambda r: _sort_key(r[i]), reverse=not asc)
+        return InterimResult(self.columns, rows)
+
+    def __len__(self) -> int:
+        return len(self.rows)
+
+    def __repr__(self) -> str:
+        return f"<InterimResult {self.columns} x {len(self.rows)} rows>"
+
+
+def _sort_key(v: Any):
+    """Total order across mixed types: None < bool < numbers < strings."""
+    if v is None:
+        return (0, 0)
+    if isinstance(v, bool):
+        return (1, v)
+    if isinstance(v, (int, float)):
+        return (2, v)
+    return (3, str(v))

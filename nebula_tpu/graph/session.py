@@ -1,0 +1,75 @@
+"""Sessions.
+
+Role parity with the reference's `graph/SessionManager.cpp` /
+`ClientSession.h`: an authenticated session carries the current space
+and user; idle sessions are reclaimed after
+`session_idle_timeout_secs` (ref: graph/GraphFlags.cpp:13-15).
+"""
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Dict, Optional
+
+from ..common.status import ErrorCode, StatusOr
+
+DEFAULT_IDLE_TIMEOUT_SECS = 8 * 3600
+
+
+class ClientSession:
+    def __init__(self, session_id: int, user: str):
+        self.session_id = session_id
+        self.user = user
+        self.space_name: Optional[str] = None
+        self.space_id: int = -1
+        self._last_access = time.time()
+
+    def charge(self) -> None:
+        self._last_access = time.time()
+
+    def idle_secs(self) -> float:
+        return time.time() - self._last_access
+
+
+class SessionManager:
+    def __init__(self, idle_timeout_secs: float = DEFAULT_IDLE_TIMEOUT_SECS):
+        self._sessions: Dict[int, ClientSession] = {}
+        self._next_id = itertools.count(1)
+        self._lock = threading.Lock()
+        self._idle_timeout = idle_timeout_secs
+
+    def create(self, user: str) -> ClientSession:
+        with self._lock:
+            sid = next(self._next_id)
+            s = ClientSession(sid, user)
+            self._sessions[sid] = s
+            return s
+
+    def find(self, session_id: int) -> StatusOr[ClientSession]:
+        with self._lock:
+            s = self._sessions.get(session_id)
+            if s is None:
+                return StatusOr.err(ErrorCode.E_SESSION_INVALID,
+                                    f"session {session_id} not found")
+            if s.idle_secs() > self._idle_timeout:
+                del self._sessions[session_id]
+                return StatusOr.err(ErrorCode.E_SESSION_INVALID,
+                                    f"session {session_id} expired")
+            s.charge()
+            return StatusOr.of(s)
+
+    def remove(self, session_id: int) -> None:
+        with self._lock:
+            self._sessions.pop(session_id, None)
+
+    def reclaim_expired(self) -> int:
+        with self._lock:
+            dead = [sid for sid, s in self._sessions.items()
+                    if s.idle_secs() > self._idle_timeout]
+            for sid in dead:
+                del self._sessions[sid]
+            return len(dead)
+
+    def count(self) -> int:
+        return len(self._sessions)

@@ -15,8 +15,26 @@ from ..common.status import ErrorCode, StatusOr
 
 
 class SchemaManager:
+    """Caches schema/name lookups keyed by the meta catalog version —
+    the in-proc analogue of the reference MetaClient's local caches
+    refreshed by `load_data_interval_secs` (ref: MetaClient.h:28-60).
+    The cache keeps the traversal hot loop free of catalog scans."""
+
     def __init__(self, meta: "MetaService"):
         self._meta = meta
+        self._cache_ver = -1
+        self._cache: Dict[Tuple, object] = {}
+
+    def _memo(self, key: Tuple, compute):
+        ver = getattr(self._meta, "catalog_version", None)
+        if ver is None:
+            return compute()  # uncacheable meta (no version signal)
+        if ver != self._cache_ver:
+            self._cache.clear()
+            self._cache_ver = ver
+        if key not in self._cache:
+            self._cache[key] = compute()
+        return self._cache[key]
 
     def space_id(self, name: str) -> StatusOr[int]:
         r = self._meta.get_space(name)
@@ -25,40 +43,50 @@ class SchemaManager:
         return StatusOr.of(r.value().space_id)
 
     def num_parts(self, space_id: int) -> int:
-        r = self._meta.get_space_by_id(space_id)
-        return r.value().partition_num if r.ok() else 0
+        def compute():
+            r = self._meta.get_space_by_id(space_id)
+            return r.value().partition_num if r.ok() else 0
+        return self._memo(("nparts", space_id), compute)
 
     def tag_id(self, space_id: int, name: str) -> Optional[int]:
-        return self._meta.get_tag_id(space_id, name)
+        return self._memo(("tid", space_id, name),
+                          lambda: self._meta.get_tag_id(space_id, name))
 
     def edge_type(self, space_id: int, name: str) -> Optional[int]:
-        return self._meta.get_edge_type(space_id, name)
+        return self._memo(("et", space_id, name),
+                          lambda: self._meta.get_edge_type(space_id, name))
 
     def tag_name(self, space_id: int, tag_id: int) -> Optional[str]:
-        for name, tid in self._meta.list_tags(space_id):
-            if tid == tag_id:
-                return name
-        return None
+        def compute():
+            return {tid: name for name, tid in self._meta.list_tags(space_id)}
+        return self._memo(("tnames", space_id), compute).get(tag_id)
 
     def edge_name(self, space_id: int, edge_type: int) -> Optional[str]:
-        for name, et in self._meta.list_edges(space_id):
-            if et == abs(edge_type):
-                return name
-        return None
+        def compute():
+            return {et: name for name, et in self._meta.list_edges(space_id)}
+        return self._memo(("enames", space_id), compute).get(abs(edge_type))
 
     def tag_schema(self, space_id: int, tag_id: int,
                    version: int = -1) -> StatusOr[Schema]:
-        return self._meta.get_tag_schema(space_id, tag_id, version)
+        return self._memo(("tschema", space_id, tag_id, version),
+                          lambda: self._meta.get_tag_schema(space_id, tag_id,
+                                                            version))
 
     def edge_schema(self, space_id: int, edge_type: int,
                     version: int = -1) -> StatusOr[Schema]:
-        return self._meta.get_edge_schema(space_id, abs(edge_type), version)
+        return self._memo(("eschema", space_id, abs(edge_type), version),
+                          lambda: self._meta.get_edge_schema(
+                              space_id, abs(edge_type), version))
 
     def all_edge_types(self, space_id: int) -> List[int]:
-        return [et for _, et in self._meta.list_edges(space_id)]
+        return self._memo(("ets", space_id),
+                          lambda: [et for _, et in
+                                   self._meta.list_edges(space_id)])
 
     def all_tag_ids(self, space_id: int) -> List[int]:
-        return [tid for _, tid in self._meta.list_tags(space_id)]
+        return self._memo(("tids", space_id),
+                          lambda: [tid for tid in
+                                   [t for _, t in self._meta.list_tags(space_id)]])
 
 
 class AdHocSchemaManager(SchemaManager):

@@ -61,11 +61,15 @@ class MetaService:
     methods over the wire for multi-process deployments."""
 
     def __init__(self, store: Optional[GraphStore] = None,
-                 expired_threshold_secs: int = DEFAULT_EXPIRED_THRESHOLD_SECS):
+                 expired_threshold_secs: int = DEFAULT_EXPIRED_THRESHOLD_SECS,
+                 root_password: str = ""):
         self._store = store or GraphStore()
         self._store.add_part(mk.META_SPACE_ID, mk.META_PART_ID)
         self._expired_threshold = expired_threshold_secs
+        self._root_password = root_password
         self._listeners: List[Any] = []  # MetaChangedListener callbacks
+        # bumped on every catalog mutation; lets SchemaManager cache safely
+        self.catalog_version = 0
 
     # ------------------------------------------------------------------
     # internals
@@ -135,6 +139,7 @@ class MetaService:
         st = self._put(*kvs)
         if not st.ok():
             return StatusOr.from_status(st)
+        self.catalog_version += 1
         self._notify("space_added", space_id=space_id, desc=desc)
         return StatusOr.of(space_id)
 
@@ -153,6 +158,7 @@ class MetaService:
         dead.extend(k for k, _ in self._scan(mk.P_EDGE_NAME + mk.pack_u32(space_id)))
         st = self._remove(*dead)
         if st.ok():
+            self.catalog_version += 1
             self._notify("space_removed", space_id=space_id)
         return st
 
@@ -226,6 +232,7 @@ class MetaService:
                        (skey, json.dumps(schema.to_dict()).encode()))
         if not st.ok():
             return StatusOr.from_status(st)
+        self.catalog_version += 1
         return StatusOr.of(sid)
 
     def create_tag(self, space_id: int, name: str, columns: List[dict],
@@ -308,7 +315,10 @@ class MetaService:
         if ttl_duration is not None:
             new.ttl_duration = ttl_duration
         skey = (mk.edge_key if is_edge else mk.tag_key)(space_id, sid, new.version)
-        return self._put((skey, json.dumps(new.to_dict()).encode()))
+        st = self._put((skey, json.dumps(new.to_dict()).encode()))
+        if st.ok():
+            self.catalog_version += 1
+        return st
 
     def alter_tag(self, space_id: int, name: str, adds=(), changes=(),
                   drops=(), ttl_col=None, ttl_duration=None) -> Status:
@@ -333,7 +343,10 @@ class MetaService:
         dead = [name_key]
         dead.extend(k for k, _ in self._scan(
             (mk.edge_prefix if is_edge else mk.tag_prefix)(space_id, sid)))
-        return self._remove(*dead)
+        st = self._remove(*dead)
+        if st.ok():
+            self.catalog_version += 1
+        return st
 
     def drop_tag(self, space_id: int, name: str, if_exists=False) -> Status:
         return self._drop_schema(False, space_id, name, if_exists)
@@ -370,16 +383,19 @@ class MetaService:
             return Status.OK() if if_exists else Status.error(
                 ErrorCode.E_NOT_FOUND, name)
         dead = [mk.user_key(name)]
+        # role key = P_ROLE + space(u32) + user; match the user part exactly
         for k, v in self._scan(mk.P_ROLE):
-            if k.endswith(name.encode()):
+            if k[len(mk.P_ROLE) + 4:] == name.encode():
                 dead.append(k)
         return self._remove(*dead)
 
     def check_password(self, name: str, password: str) -> bool:
         raw = self._get(mk.user_key(name))
         if raw is None:
-            # root bootstrap account, like the reference's SimpleAuthenticator
-            return name == "root"
+            # root bootstrap account with a fixed initial password, like the
+            # reference's SimpleAuthenticator (user=root/password=nebula);
+            # ours defaults to "" and is changeable via CHANGE PASSWORD
+            return name == "root" and password == self._root_password
         return json.loads(raw)["password"] == _pw_hash(password)
 
     def user_exists(self, name: str) -> bool:

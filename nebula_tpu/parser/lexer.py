@@ -32,7 +32,7 @@ KEYWORDS = {
     "CONFIGS", "GET", "VARIABLES", "GRAPH", "META", "STORAGE",
     "BALANCE", "DATA", "LEADER", "REMOVE", "PLAN", "STOP",
     "USER", "USERS", "PASSWORD", "CHANGE", "GRANT", "REVOKE", "ROLE",
-    "ROLES", "GOD", "ADMIN", "GUEST", "WITH",
+    "ROLES", "GOD", "ADMIN", "GUEST", "WITH", "IN",
     "INGEST", "DOWNLOAD", "HDFS", "SUBMIT", "JOB", "JOBS",
     "SNAPSHOT", "SNAPSHOTS",
 }
@@ -75,10 +75,8 @@ def tokenize(text: str) -> List[Token]:
             i += 1
             continue
         if c == "#" or (c == "/" and i + 1 < n and text[i + 1] == "/"):
-            while i < n and text[i] != "\n":
-                i += 1
-            continue
-        if c == "-" and text[i:i + 2] == "--":
+            # '#' and '//' line comments, like the reference scanner;
+            # '--' is NOT a comment ('1--2' is double negation)
             while i < n and text[i] != "\n":
                 i += 1
             continue
@@ -139,11 +137,13 @@ def tokenize(text: str) -> List[Token]:
                     # "1." style double (but not "1.prop")
                     is_double = True
                     j += 1
-            if j < n and text[j] in "eE" and is_double:
+            if j < n and text[j] in "eE":
+                # exponent applies to both 1.5e3 and 1e3 forms
                 k = j + 1
                 if k < n and text[k] in "+-":
                     k += 1
                 if k < n and text[k].isdigit():
+                    is_double = True
                     j = k
                     while j < n and text[j].isdigit():
                         j += 1

@@ -649,7 +649,8 @@ class GQLParser:
                          "ROLES", "VARIABLES", "SNAPSHOTS")
         arg = None
         if t.type == "ROLES":
-            self._expect("IF")  # not reachable; ROLES IN space
+            self._expect("IN")
+            arg = self._ident("space name")
         if t.type == "PARTS" and self._at(T_INT):
             arg = str(self._expect(T_INT).value)
         return ast.ShowSentence(ast.ShowKind[t.type], arg)
@@ -758,8 +759,8 @@ class GQLParser:
 
     def _mul_expr(self) -> Expression:
         left = self._unary_expr()
-        while self._at("*", "/", "%", "^"):
-            op = self._expect("*", "/", "%", "^").type
+        while self._at("*", "/", "%"):
+            op = self._expect("*", "/", "%").type
             left = ArithmeticExpr(op, left, self._unary_expr())
         return left
 
@@ -773,7 +774,15 @@ class GQLParser:
             return UnaryExpr(op, operand)
         if self._accept("NOT"):
             return UnaryExpr("!", self._unary_expr())
-        return self._primary()
+        return self._power_expr()
+
+    def _power_expr(self) -> Expression:
+        # '^' binds tighter than unary minus and is right-associative
+        # (-2^2 == -(2^2), 2^3^2 == 2^(3^2))
+        base = self._primary()
+        if self._accept("^"):
+            return ArithmeticExpr("^", base, self._unary_expr())
+        return base
 
     def _primary(self) -> Expression:
         t = self._peek()

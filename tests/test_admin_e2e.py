@@ -1,0 +1,159 @@
+"""DDL / admin / RBAC query tests (parity model: graph/test/SchemaTest.cpp,
+graph/test/PermissionTest-style checks)."""
+import pytest
+
+from nebula_tpu.cluster import InProcCluster
+from nebula_tpu.common.status import ErrorCode
+
+
+@pytest.fixture()
+def conn():
+    c = InProcCluster().connect()
+    yield c
+    c.close()
+
+
+def test_space_lifecycle(conn):
+    conn.must("CREATE SPACE s1(partition_num=3, replica_factor=1)")
+    r = conn.must("SHOW SPACES")
+    assert ("s1",) in r.rows
+    r = conn.must("DESCRIBE SPACE s1")
+    assert r.rows[0][1:] == ("s1", 3, 1)
+    resp = conn.execute("CREATE SPACE s1")
+    assert resp.code == ErrorCode.E_EXISTED
+    conn.must("CREATE SPACE IF NOT EXISTS s1")
+    conn.must("DROP SPACE s1")
+    r = conn.must("SHOW SPACES")
+    assert ("s1",) not in r.rows
+    resp = conn.execute("DROP SPACE s1")
+    assert resp.code == ErrorCode.E_SPACE_NOT_FOUND
+    conn.must("DROP SPACE IF EXISTS s1")
+
+
+def test_schema_lifecycle(conn):
+    conn.must("CREATE SPACE s2")
+    conn.must("USE s2")
+    conn.must("CREATE TAG t(name string, age int DEFAULT 18)")
+    r = conn.must("DESCRIBE TAG t")
+    assert ("name", "string", "NO", "") in r.rows
+    assert ("age", "int", "NO", 18) in r.rows
+    conn.must("CREATE EDGE e(weight double)")
+    r = conn.must("SHOW TAGS")
+    assert any(row[1] == "t" for row in r.rows)
+    r = conn.must("SHOW EDGES")
+    assert any(row[1] == "e" for row in r.rows)
+    # tag/edge name conflict rejected
+    resp = conn.execute("CREATE EDGE t(x int)")
+    assert resp.code == ErrorCode.E_CONFLICT
+    # alter: add + drop
+    conn.must("ALTER TAG t ADD (height double)")
+    r = conn.must("DESCRIBE TAG t")
+    assert any(row[0] == "height" for row in r.rows)
+    conn.must("ALTER TAG t DROP (age)")
+    r = conn.must("DESCRIBE TAG t")
+    assert not any(row[0] == "age" for row in r.rows)
+    # old rows still decodable after alter: insert with new schema
+    conn.must('INSERT VERTEX t(name, height) VALUES 1:("a", 1.8)')
+    r = conn.must("FETCH PROP ON t 1")
+    assert r.rows[0][1] == "a"
+    conn.must("DROP TAG t")
+    resp = conn.execute("DESCRIBE TAG t")
+    assert resp.code == ErrorCode.E_TAG_NOT_FOUND
+
+
+def test_schema_versioning_old_rows(conn):
+    conn.must("CREATE SPACE s3")
+    conn.must("USE s3")
+    conn.must("CREATE TAG p(a int)")
+    conn.must("INSERT VERTEX p(a) VALUES 1:(7)")
+    conn.must("ALTER TAG p ADD (b string)")
+    conn.must('INSERT VERTEX p(a, b) VALUES 2:(8, "x")')
+    r = conn.must("FETCH PROP ON p 1, 2")
+    by_vid = {row[0]: row for row in r.rows}
+    assert by_vid[1][1] == 7          # old row, old schema version
+    assert by_vid[2][1:] == (8, "x")  # new row
+
+
+def test_duplicate_column_rejected(conn):
+    conn.must("CREATE SPACE s4")
+    conn.must("USE s4")
+    resp = conn.execute("CREATE TAG bad(x int, x string)")
+    assert resp.code == ErrorCode.E_INVALID_ARGUMENT
+
+
+def test_users_and_rbac():
+    cluster = InProcCluster()
+    root = cluster.connect()
+    root.must("CREATE SPACE rb")
+    root.must('CREATE USER alice WITH PASSWORD "pw"')
+    root.must('CREATE USER bob WITH PASSWORD "pw2"')
+    root.must("GRANT ROLE ADMIN ON rb TO alice")
+    root.must("GRANT ROLE GUEST ON rb TO bob")
+    r = root.must("SHOW USERS")
+    users = [row[0] for row in r.rows]
+    assert "alice" in users and "bob" in users and "root" in users
+
+    # wrong password rejected at authenticate
+    assert not cluster.service.authenticate("alice", "wrong").ok()
+    alice = cluster.connect("alice", "pw")
+    alice.must("USE rb")
+    alice.must("CREATE TAG adm_t(x int)")      # ADMIN can do schema DDL
+    bob = cluster.connect("bob", "pw2")
+    bob.must("USE rb")
+    resp = bob.execute("CREATE TAG guest_t(x int)")
+    assert resp.code == ErrorCode.E_BAD_PERMISSION
+    resp = bob.execute("INSERT VERTEX adm_t(x) VALUES 1:(1)")
+    assert resp.code == ErrorCode.E_BAD_PERMISSION
+    resp = alice.execute("CREATE SPACE nope")  # GOD-only
+    assert resp.code == ErrorCode.E_BAD_PERMISSION
+    # revoke
+    root.must("REVOKE ROLE ADMIN ON rb FROM alice")
+    resp = alice.execute("CREATE TAG t2(x int)")
+    assert resp.code == ErrorCode.E_BAD_PERMISSION
+    # change password
+    root.must('CHANGE PASSWORD alice FROM "pw" TO "pw3"')
+    assert cluster.service.authenticate("alice", "pw3").ok()
+
+
+def test_configs(conn):
+    conn.must("SHOW CONFIGS")
+    cluster_meta = conn._service.engine.meta
+    cluster_meta.reg_config("GRAPH", "slow_op_threshold_ms", 100)
+    r = conn.must("SHOW CONFIGS GRAPH")
+    assert any(row[1] == "slow_op_threshold_ms" for row in r.rows)
+    r = conn.must("GET CONFIGS GRAPH:slow_op_threshold_ms")
+    assert r.rows == [("slow_op_threshold_ms", "100")]
+
+
+def test_show_hosts_and_parts(conn):
+    meta = conn._service.engine.meta
+    meta.heartbeat("127.0.0.1:44500")
+    r = conn.must("SHOW HOSTS")
+    assert ("127.0.0.1:44500", "online") in r.rows
+    conn.must("CREATE SPACE sp(partition_num=2, replica_factor=1)")
+    conn.must("USE sp")
+    r = conn.must("SHOW PARTS")
+    assert len(r.rows) == 2
+
+
+def test_drop_user_exact_role_match():
+    cluster = InProcCluster()
+    root = cluster.connect()
+    root.must("CREATE SPACE rx")
+    root.must('CREATE USER bob WITH PASSWORD "1"')
+    root.must('CREATE USER jacob WITH PASSWORD "2"')
+    root.must("GRANT ROLE GUEST ON rx TO bob")
+    root.must("GRANT ROLE ADMIN ON rx TO jacob")
+    root.must("DROP USER bob")
+    r = root.must("SHOW ROLES IN rx")
+    assert r.rows == [("jacob", "ADMIN")]
+
+
+def test_root_password_enforced():
+    cluster = InProcCluster()
+    assert cluster.service.authenticate("root", "").ok()
+    assert not cluster.service.authenticate("root", "guess").ok()
+    root = cluster.connect()
+    root.must('CHANGE PASSWORD root FROM "" TO "s3cret"')
+    assert not cluster.service.authenticate("root", "").ok()
+    assert cluster.service.authenticate("root", "s3cret").ok()

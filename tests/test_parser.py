@@ -258,8 +258,33 @@ def test_case_insensitive_keywords():
 
 
 def test_comments_and_whitespace():
-    s = parse("GO FROM 1 OVER like # trailing comment\n; -- another\nSHOW SPACES")
+    s = parse("GO FROM 1 OVER like # trailing comment\n; // another\nSHOW SPACES")
     assert len(s.sentences) == 2
+
+
+def test_double_minus_is_not_a_comment():
+    s = parse1("YIELD 1--2 AS x")
+    from nebula_tpu.filter.expressions import ExpressionContext
+    assert s.yield_.columns[0].expr.eval(ExpressionContext()) == 3
+
+
+def test_scientific_notation():
+    s = parse1("YIELD 1e3 AS x, 2.5e-2 AS y")
+    assert s.yield_.columns[0].expr.value == 1000.0
+    assert s.yield_.columns[1].expr.value == 0.025
+
+
+def test_power_precedence():
+    from nebula_tpu.filter.expressions import ExpressionContext
+    ctx = ExpressionContext()
+    assert parse1("YIELD 2*3^2 AS x").yield_.columns[0].expr.eval(ctx) == 18
+    assert parse1("YIELD 2^3^2 AS x").yield_.columns[0].expr.eval(ctx) == 512
+    assert parse1("YIELD 0-2^2 AS x").yield_.columns[0].expr.eval(ctx) == -4
+
+
+def test_show_roles():
+    s = parse1("SHOW ROLES IN nba")
+    assert s.what == ast.ShowKind.ROLES and s.arg == "nba"
 
 
 def test_to_string_roundtrip():

@@ -1,0 +1,274 @@
+"""Full-stack query tests on the NBA sample (parity model: graph/test/
+GoTest.cpp, FindPathTest.cpp, YieldTest.cpp, SchemaTest.cpp, DataTest.cpp,
+GroupByLimitTest.cpp — golden result-table assertions)."""
+import pytest
+
+from nebula_tpu.common.status import ErrorCode
+from nba_fixture import load_nba
+
+
+@pytest.fixture(scope="module")
+def nba():
+    cluster, conn = load_nba()
+    yield cluster, conn
+    conn.close()
+
+
+def rows(resp):
+    return sorted(resp.rows)
+
+
+# --- GO --------------------------------------------------------------------
+
+def test_go_one_step(nba):
+    _, conn = nba
+    r = conn.must("GO FROM 100 OVER like")
+    assert r.columns == ["like._dst"]
+    assert rows(r) == [(101,), (102,)]
+
+
+def test_go_reversely(nba):
+    _, conn = nba
+    r = conn.must("GO FROM 100 OVER like REVERSELY YIELD like._dst AS id")
+    assert rows(r) == [(101,), (102,), (106,), (107,), (109,)]
+
+
+def test_go_bidirect(nba):
+    _, conn = nba
+    r = conn.must("GO FROM 102 OVER like BIDIRECT YIELD like._dst AS id")
+    # out: 100; in: 100, 101
+    assert sorted(r.rows) == [(100,), (100,), (101,)]
+
+
+def test_go_two_steps(nba):
+    _, conn = nba
+    r = conn.must("GO 2 STEPS FROM 100 OVER like YIELD DISTINCT like._dst")
+    # step1: 101,102 ; step2 from them: 100,102 / 100
+    assert rows(r) == [(100,), (102,)]
+
+
+def test_go_yield_props_and_where(nba):
+    _, conn = nba
+    r = conn.must('GO FROM 100 OVER like WHERE like.likeness > 92 '
+                  'YIELD like._dst AS id, like.likeness AS w, $^.player.name AS me')
+    assert r.columns == ["id", "w", "me"]
+    assert rows(r) == [(101, 95.0, "Tim Duncan")]
+
+
+def test_go_dst_props(nba):
+    _, conn = nba
+    r = conn.must('GO FROM 100 OVER serve YIELD $$.team.name AS team')
+    assert rows(r) == [("Spurs",)]
+
+
+def test_go_where_dst_prop_not_pushable(nba):
+    _, conn = nba
+    r = conn.must('GO FROM 100 OVER like WHERE $$.player.age > 33 '
+                  'YIELD like._dst AS id, $$.player.age AS age')
+    assert rows(r) == [(101, 36)]
+
+
+def test_go_over_star(nba):
+    _, conn = nba
+    r = conn.must("GO FROM 101 OVER * YIELD _dst AS d")
+    # like: 100, 102 ; serve: 204
+    assert rows(r) == [(100,), (102,), (204,)]
+
+
+def test_go_pipe(nba):
+    _, conn = nba
+    r = conn.must("GO FROM 100 OVER like YIELD like._dst AS id | "
+                  "GO FROM $-.id OVER serve YIELD $$.team.name AS team")
+    assert rows(r) == [("Spurs",), ("Spurs",), ("Trail Blazers",)]
+
+
+def test_go_pipe_input_prop(nba):
+    _, conn = nba
+    r = conn.must("GO FROM 100 OVER like YIELD like._dst AS id, like.likeness AS w | "
+                  "GO FROM $-.id OVER like YIELD $-.w AS base, like.likeness AS w2")
+    # from 101 (base 95): ->100 (95), ->102 (91); from 102 (base 90): ->100 (75)
+    assert rows(r) == [(90.0, 75.0), (95.0, 91.0), (95.0, 95.0)]
+
+
+def test_go_variable(nba):
+    _, conn = nba
+    r = conn.must("$a = GO FROM 100 OVER like YIELD like._dst AS id; "
+                  "GO FROM $a.id OVER serve YIELD $$.team.name AS t")
+    assert rows(r) == [("Spurs",), ("Spurs",), ("Trail Blazers",)]
+
+
+def test_go_empty_frontier(nba):
+    _, conn = nba
+    r = conn.must("GO FROM 121 OVER like")  # Useless has no edges
+    assert r.rows == []
+
+
+def test_go_uuid_from(nba):
+    _, conn = nba
+    conn.must('INSERT VERTEX player(name, age) VALUES uuid("Special"):("Special", 1)')
+    conn.must('INSERT EDGE like(likeness) VALUES uuid("Special") -> 100:(99.0)')
+    r = conn.must('GO FROM uuid("Special") OVER like')
+    assert rows(r) == [(100,)]
+
+
+# --- result shaping --------------------------------------------------------
+
+def test_order_by_and_limit(nba):
+    _, conn = nba
+    r = conn.must("GO FROM 100 OVER like YIELD like._dst AS id, like.likeness AS w "
+                  "| ORDER BY $-.w DESC | LIMIT 1")
+    assert r.rows == [(101, 95.0)]
+    r = conn.must("GO FROM 100 OVER like REVERSELY YIELD like._dst AS id "
+                  "| ORDER BY $-.id | LIMIT 1, 2")
+    assert r.rows == [(102,), (106,)]
+
+
+def test_group_by(nba):
+    _, conn = nba
+    r = conn.must(
+        "GO FROM 204 OVER serve REVERSELY YIELD serve.start_year AS y, like._dst AS d"
+    ) if False else None
+    r = conn.must(
+        "GO FROM 100, 101 OVER serve YIELD $$.team.name AS team, serve.start_year AS y "
+        "| GROUP BY $-.team YIELD $-.team AS team, COUNT(*) AS n, MIN($-.y) AS first")
+    assert rows(r) == [("Spurs", 2, 1997)]
+
+
+def test_set_ops(nba):
+    _, conn = nba
+    r = conn.must("GO FROM 100 OVER like YIELD like._dst AS id UNION "
+                  "GO FROM 101 OVER like YIELD like._dst AS id")
+    assert rows(r) == [(100,), (101,), (102,), (102,)]
+    r = conn.must("GO FROM 100 OVER like YIELD like._dst AS id UNION DISTINCT "
+                  "GO FROM 101 OVER like YIELD like._dst AS id")
+    assert rows(r) == [(100,), (101,), (102,)]
+    r = conn.must("GO FROM 100 OVER like YIELD like._dst AS id INTERSECT "
+                  "GO FROM 101 OVER like YIELD like._dst AS id")
+    assert rows(r) == [(102,)]
+    r = conn.must("GO FROM 100 OVER like YIELD like._dst AS id MINUS "
+                  "GO FROM 101 OVER like YIELD like._dst AS id")
+    assert rows(r) == [(101,)]
+
+
+def test_yield_constant_and_where(nba):
+    _, conn = nba
+    r = conn.must("YIELD 1 + 2 AS x, \"hello\" AS s")
+    assert r.rows == [(3, "hello")]
+    r = conn.must("GO FROM 100 OVER like YIELD like._dst AS id, like.likeness AS w "
+                  "| YIELD $-.id AS id WHERE $-.w > 92")
+    assert rows(r) == [(101,)]
+
+
+# --- FETCH -----------------------------------------------------------------
+
+def test_fetch_vertices(nba):
+    _, conn = nba
+    r = conn.must("FETCH PROP ON player 100, 101")
+    assert r.columns == ["VertexID", "player.name", "player.age"]
+    assert rows(r) == [(100, "Tim Duncan", 42), (101, "Tony Parker", 36)]
+    r = conn.must("FETCH PROP ON player 100 YIELD player.name AS name")
+    assert r.rows == [(100, "Tim Duncan")]
+
+
+def test_fetch_edges(nba):
+    _, conn = nba
+    r = conn.must("FETCH PROP ON like 100->101")
+    assert r.columns == ["like._src", "like._dst", "like._rank", "like.likeness"]
+    assert r.rows == [(100, 101, 0, 95.0)]
+
+
+def test_fetch_from_pipe(nba):
+    _, conn = nba
+    r = conn.must("GO FROM 100 OVER like YIELD like._dst AS id "
+                  "| FETCH PROP ON player $-.id YIELD player.name AS name")
+    assert rows(r) == [(101, "Tony Parker"), (102, "LaMarcus Aldridge")]
+
+
+# --- FIND PATH -------------------------------------------------------------
+
+def test_shortest_path_direct(nba):
+    _, conn = nba
+    r = conn.must("FIND SHORTEST PATH FROM 100 TO 102 OVER like UPTO 4 STEPS")
+    assert r.columns == ["_path_"]
+    assert r.rows == [("100<like,0>102",)]
+
+
+def test_shortest_path_two_hops(nba):
+    _, conn = nba
+    r = conn.must("FIND SHORTEST PATH FROM 103 TO 106 OVER like UPTO 5 STEPS")
+    assert r.rows == [("103<like,0>104<like,0>105<like,0>106",)]
+
+
+def test_shortest_path_none(nba):
+    _, conn = nba
+    r = conn.must("FIND SHORTEST PATH FROM 100 TO 121 OVER like UPTO 3 STEPS")
+    assert r.rows == []
+
+
+def test_all_paths(nba):
+    _, conn = nba
+    r = conn.must("FIND ALL PATH FROM 100 TO 102 OVER like UPTO 2 STEPS")
+    assert sorted(r.rows) == [("100<like,0>101<like,0>102",),
+                              ("100<like,0>102",)]
+
+
+# --- mutations through nGQL ------------------------------------------------
+
+def test_update_and_upsert(nba):
+    _, conn = nba
+    conn.must('INSERT VERTEX player(name, age) VALUES 300:("Up Datable", 20)')
+    r = conn.must("UPDATE VERTEX 300 SET age = age + 1 WHEN age == 20 YIELD age")
+    assert r.rows == [(21,)]
+    resp = conn.execute("UPDATE VERTEX 300 SET age = 99 WHEN age == 20")
+    assert resp.code == ErrorCode.E_FILTER_OUT
+    r = conn.must("UPSERT VERTEX 301 SET age = 5 YIELD age")
+    assert r.rows == [(5,)]
+
+
+def test_update_edge_ngql(nba):
+    _, conn = nba
+    conn.must('INSERT EDGE like(likeness) VALUES 300 -> 100:(10.0)')
+    conn.must("UPDATE EDGE 300 -> 100 OF like SET likeness = 20.0")
+    r = conn.must("FETCH PROP ON like 300->100 YIELD like.likeness AS w")
+    assert r.rows == [(20.0,)]
+
+
+def test_delete_vertex_ngql(nba):
+    _, conn = nba
+    conn.must('INSERT VERTEX player(name, age) VALUES 400:("Doomed", 1)')
+    conn.must('INSERT EDGE like(likeness) VALUES 400 -> 100:(50.0), 100 -> 400:(50.0)')
+    conn.must("DELETE VERTEX 400")
+    r = conn.must("FETCH PROP ON player 400")
+    assert r.rows == []
+    r = conn.must("GO FROM 100 OVER like")
+    assert (400,) not in r.rows
+
+
+# --- errors ----------------------------------------------------------------
+
+def test_errors(nba):
+    _, conn = nba
+    resp = conn.execute("GO FROM 100 OVER nonexistent")
+    assert resp.code == ErrorCode.E_EDGE_NOT_FOUND
+    resp = conn.execute("THIS IS NOT NGQL")
+    assert resp.code == ErrorCode.E_SYNTAX_ERROR
+    resp = conn.execute("FETCH PROP ON nop 1")
+    assert resp.code == ErrorCode.E_TAG_NOT_FOUND
+
+
+def test_use_required(nba):
+    cluster, _ = nba
+    c2 = cluster.connect()
+    resp = c2.execute("GO FROM 100 OVER like")
+    assert resp.code == ErrorCode.E_EXECUTION_ERROR
+    assert "USE" in resp.error_msg
+    c2.close()
+
+
+def test_go_upto_accumulates_steps(nba):
+    _, conn = nba
+    # 103 -> 104 -> 105: UPTO 2 returns both 1-step and 2-step neighbors
+    r = conn.must("GO UPTO 2 STEPS FROM 103 OVER like YIELD like._dst AS id")
+    assert rows(r) == [(104,), (105,)]
+    r = conn.must("GO 2 STEPS FROM 103 OVER like YIELD like._dst AS id")
+    assert rows(r) == [(105,)]
