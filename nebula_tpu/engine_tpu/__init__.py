@@ -1,0 +1,9 @@
+import jax as _jax
+
+# int64 must survive on device: vid-free device arrays are int32 by
+# design, but traversal counters (edges traversed on billion-edge
+# graphs x hops) need true 64-bit accumulation.
+_jax.config.update("jax_enable_x64", True)
+
+from .engine import TpuGraphEngine  # noqa: F401,E402
+from .csr import CsrSnapshot, CsrShard  # noqa: F401,E402

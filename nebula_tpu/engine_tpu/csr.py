@@ -1,0 +1,369 @@
+"""CSR snapshot builder: KV partitions → device-resident edge arrays.
+
+This is the TPU-native storage engine behind the same seam where the
+reference plugs alternative engines below the storage service (the
+HBaseStore plugin slot, ref kvstore/plugins/hbase/ + SURVEY.md §2.5):
+partition edge lists become CSR arrays in device memory, property
+columns become aligned columnar arrays, and traversal runs as dense
+masked gathers/scatters instead of RocksDB prefix iteration.
+
+Layout decisions (TPU-first):
+- Every partition is padded to the same (cap_v, cap_e) so the whole
+  space stacks to [P, cap_v] / [P, cap_e] arrays — jittable on one chip
+  and shard_map-able over a mesh without reshapes. Caps round up to
+  multiples of 128 (lane width).
+- Device arrays never hold 64-bit vids. Destinations are pre-resolved
+  at build time to (dst_part, dst_local) and fused into one int32
+  global index `dst_part * cap_v + dst_local`; padded/invalid edges
+  point at a dump slot P*cap_v. The 64-bit vid/rank columns live in
+  host numpy mirrors used only for result materialization.
+- Version dedup and TTL visibility are applied at build time — the scan
+  sees exactly what the CPU read path would see (newest version per
+  logical edge/tag row, expired rows dropped).
+- Numeric props: DOUBLE → float32, INT/TIMESTAMP → int32 when every
+  value fits (else the column is marked host-only), BOOL → bool.
+  STRING → int32 dictionary codes (per column dict, equality-only
+  device filters). Full-fidelity values stay in the host mirrors.
+"""
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..codec.row import RowReader, peek_schema_version
+from ..codec.schema import PropType, Schema
+from ..common import keys as ku
+
+LANE = 128
+
+
+def _round_up(n: int, m: int = LANE) -> int:
+    return max(m, ((n + m - 1) // m) * m)
+
+
+@dataclass
+class PropColumn:
+    """One property column, host mirror + device-encodable form."""
+    name: str
+    ptype: PropType
+    host: np.ndarray                      # full-fidelity (object for strings)
+    device_ok: bool                       # can this column go on device?
+    device_vals: Optional[np.ndarray]     # f32/i32/bool codes, aligned
+    present: Optional[np.ndarray] = None  # bool, False where value is null
+    str_dict: Optional[Dict[str, int]] = None  # string -> code
+
+
+@dataclass
+class CsrShard:
+    """Host-side CSR for one partition."""
+    part_id: int
+    vids: np.ndarray                      # int64[nv] sorted; local idx -> vid
+    vid_to_local: Dict[int, int]
+    num_edges: int
+    # edge arrays, length cap_e (padded tail invalid)
+    edge_src: np.ndarray                  # int32 local src index
+    edge_etype: np.ndarray                # int32 signed edge type
+    edge_rank: np.ndarray                 # int64 (host only)
+    edge_dst_vid: np.ndarray              # int64 (host only)
+    edge_dst_part: np.ndarray             # int32 0-based part index
+    edge_dst_local: np.ndarray            # int32
+    edge_valid: np.ndarray                # bool
+    # per-(signed etype) columnar edge props (aligned to edge arrays)
+    edge_props: Dict[int, Dict[str, PropColumn]] = field(default_factory=dict)
+    # per-tag columnar vertex props (aligned to local index)
+    tag_props: Dict[int, Dict[str, PropColumn]] = field(default_factory=dict)
+
+
+class CsrSnapshot:
+    """All partitions of one space, stacked for the device."""
+
+    def __init__(self, space_id: int, shards: List[CsrShard], cap_v: int,
+                 cap_e: int, write_version: int):
+        import jax.numpy as jnp
+        self.space_id = space_id
+        self.shards = shards
+        self.num_parts = len(shards)
+        self.cap_v = cap_v
+        self.cap_e = cap_e
+        self.write_version = write_version
+        self.built_at = time.time()
+        P = self.num_parts
+        dump = P * cap_v  # scatter dump slot for invalid edges
+        gidx = np.stack([
+            np.where(s.edge_valid,
+                     s.edge_dst_part.astype(np.int64) * cap_v + s.edge_dst_local,
+                     dump).astype(np.int32)
+            for s in shards])
+        # device arrays [P, cap_e] / [P, cap_v]
+        self.d_edge_src = jnp.asarray(np.stack([s.edge_src for s in shards]))
+        self.d_edge_gidx = jnp.asarray(gidx)
+        self.d_edge_etype = jnp.asarray(np.stack([s.edge_etype for s in shards]))
+        self.d_edge_valid = jnp.asarray(np.stack([s.edge_valid for s in shards]))
+        self.total_edges = int(sum(s.num_edges for s in shards))
+        self._device_prop_cache: Dict[Tuple, Any] = {}
+
+    # ------------------------------------------------------------------
+    def locate(self, vid: int) -> Optional[Tuple[int, int]]:
+        """vid -> (0-based part index, local index)."""
+        p = ku.part_id(vid, self.num_parts) - 1
+        loc = self.shards[p].vid_to_local.get(vid)
+        return (p, loc) if loc is not None else None
+
+    def frontier_from_vids(self, vids: List[int]) -> np.ndarray:
+        f = np.zeros((self.num_parts, self.cap_v), dtype=bool)
+        for vid in vids:
+            loc = self.locate(vid)
+            if loc is not None:
+                f[loc[0], loc[1]] = True
+        return f
+
+    def device_edge_prop(self, etype: int, name: str):
+        """Stacked [P, cap_e] device array for a filterable edge prop,
+        or None if the column can't live on device."""
+        import jax.numpy as jnp
+        key = ("e", etype, name)
+        if key in self._device_prop_cache:
+            return self._device_prop_cache[key]
+        cols = []
+        for s in self.shards:
+            col = s.edge_props.get(etype, {}).get(name)
+            if col is None or not col.device_ok:
+                self._device_prop_cache[key] = None
+                return None
+            cols.append(col.device_vals)
+        out = jnp.asarray(np.stack(cols))
+        self._device_prop_cache[key] = out
+        return out
+
+    def device_tag_prop(self, tag_id: int, name: str):
+        import jax.numpy as jnp
+        key = ("t", tag_id, name)
+        if key in self._device_prop_cache:
+            return self._device_prop_cache[key]
+        cols = []
+        for s in self.shards:
+            col = s.tag_props.get(tag_id, {}).get(name)
+            if col is None or not col.device_ok:
+                self._device_prop_cache[key] = None
+                return None
+            cols.append(col.device_vals)
+        out = jnp.asarray(np.stack(cols))
+        self._device_prop_cache[key] = out
+        return out
+
+    def str_code(self, etype_or_tag: Tuple[str, int], name: str,
+                 value: str) -> Optional[int]:
+        """Dictionary code of a string constant for device equality
+        filters; -1 if the string never occurs (matches nothing)."""
+        kind, sid = etype_or_tag
+        for s in self.shards:
+            props = (s.edge_props if kind == "e" else s.tag_props).get(sid, {})
+            col = props.get(name)
+            if col is not None and col.str_dict is not None:
+                if value in col.str_dict:
+                    return col.str_dict[value]
+        return -1
+
+
+# ---------------------------------------------------------------------------
+# builder
+# ---------------------------------------------------------------------------
+
+def _decode_rows_newest(engine, prefix: bytes, group_of, parse_key):
+    """Yield (key_fields, value) keeping only the newest version per
+    logical group, skipping tombstones."""
+    last_group = None
+    for k, v in engine.prefix(prefix):
+        fields = parse_key(k)
+        g = group_of(fields)
+        if g == last_group:
+            continue
+        last_group = g
+        if not v:
+            continue
+        yield fields, v
+
+
+def build_snapshot(store, sm, space_id: int, num_parts: int) -> CsrSnapshot:
+    """Scan every partition's KV range and assemble the CSR snapshot.
+
+    The scan applies the same read semantics as the CPU getBound path:
+    newest-version-wins within a (src, etype, rank, dst) group, TTL
+    expiry honored (ref: storage/QueryBaseProcessor.inl:380-458)."""
+    engine = store.space_engine(space_id)
+    if engine is None:
+        raise ValueError(f"space {space_id} not found")
+    write_version = engine.write_version
+    now = time.time()
+
+    # ---- pass 1: local vid sets + raw edge lists per partition --------
+    per_part_edges: List[List[Tuple[int, int, int, int, bytes]]] = []
+    per_part_vids: List[set] = []
+    for p in range(1, num_parts + 1):
+        vids = set()
+        for (part, vid, tag, ver), v in _decode_rows_newest(
+                engine, ku.part_data_prefix(p, ku.KIND_VERTEX),
+                group_of=lambda f: (f[1], f[2]), parse_key=ku.parse_vertex_key):
+            vids.add(vid)
+        edges = []
+        for (part, src, et, rank, dst, ver), v in _decode_rows_newest(
+                engine, ku.part_data_prefix(p, ku.KIND_EDGE),
+                group_of=lambda f: (f[1], f[2], f[3], f[4]),
+                parse_key=ku.parse_edge_key):
+            vids.add(src)
+            edges.append((src, et, rank, dst, v))
+        per_part_edges.append(edges)
+        per_part_vids.append(vids)
+    # destinations must have a local slot in their own partition
+    for p_edges in per_part_edges:
+        for (_src, _et, _rank, dst, _v) in p_edges:
+            per_part_vids[ku.part_id(dst, num_parts) - 1].add(dst)
+
+    cap_v = _round_up(max((len(v) for v in per_part_vids), default=1))
+    cap_e = _round_up(max((len(e) for e in per_part_edges), default=1))
+
+    # schema lookups
+    def edge_schema(et: int) -> Optional[Schema]:
+        r = sm.edge_schema(space_id, et)
+        return r.value() if r.ok() else None
+
+    shards: List[CsrShard] = []
+    # string dictionaries must be GLOBAL across shards so a code compares
+    # equal on every device partition: (kind, schema id, field) -> dict
+    dict_registry: Dict[Tuple[str, int, str], Dict[str, int]] = {}
+    for p0 in range(num_parts):
+        vids_sorted = np.array(sorted(per_part_vids[p0]), dtype=np.int64)
+        vid_to_local = {int(v): i for i, v in enumerate(vids_sorted)}
+        edges = per_part_edges[p0]
+        # sort by (src_local, etype, rank, dst) for CSR determinism
+        edges.sort(key=lambda e: (vid_to_local[e[0]], e[1], e[2], e[3]))
+        ne = len(edges)
+        edge_src = np.zeros(cap_e, np.int32)
+        edge_etype = np.zeros(cap_e, np.int32)
+        edge_rank = np.zeros(cap_e, np.int64)
+        edge_dst_vid = np.zeros(cap_e, np.int64)
+        edge_dst_part = np.zeros(cap_e, np.int32)
+        edge_dst_local = np.zeros(cap_e, np.int32)
+        edge_valid = np.zeros(cap_e, bool)
+        rows_by_etype: Dict[int, List[Tuple[int, bytes]]] = {}
+        skipped = 0
+        for i, (src, et, rank, dst, row) in enumerate(edges):
+            edge_src[i] = vid_to_local[src]
+            edge_etype[i] = et
+            edge_rank[i] = rank
+            edge_dst_vid[i] = dst
+            edge_dst_part[i] = ku.part_id(dst, num_parts) - 1
+            # edge_dst_local resolved after all shards' vid maps exist
+            rows_by_etype.setdefault(et, []).append((i, row))
+            edge_valid[i] = True
+        shard = CsrShard(p0 + 1, vids_sorted, vid_to_local, ne, edge_src,
+                         edge_etype, edge_rank, edge_dst_vid, edge_dst_part,
+                         edge_dst_local, edge_valid)
+        shards.append(shard)
+        shard._rows_by_etype = rows_by_etype  # temp, consumed below
+
+    # resolve dst locals now that every shard's vid map exists
+    maps = [s.vid_to_local for s in shards]
+    for s in shards:
+        for i in range(s.num_edges):
+            dp = int(s.edge_dst_part[i])
+            s.edge_dst_local[i] = maps[dp][int(s.edge_dst_vid[i])]
+
+    # ---- pass 2: decode property columns ------------------------------
+    for s in shards:
+        rows_by_etype = s._rows_by_etype
+        del s._rows_by_etype
+        for et, idx_rows in rows_by_etype.items():
+            schema = edge_schema(et)
+            if schema is None or not schema.fields:
+                continue
+            cols = _build_columns(schema, cap_e, idx_rows, now,
+                                  dict_registry, ("e", et))
+            if cols:
+                s.edge_props[et] = cols
+        # vertex tag props
+        for tag_id in sm.all_tag_ids(space_id):
+            sr = sm.tag_schema(space_id, tag_id)
+            if not sr.ok() or not sr.value().fields:
+                continue
+            schema = sr.value()
+            idx_rows = []
+            for (part, vid, tag, ver), v in _decode_rows_newest(
+                    engine, ku.part_data_prefix(s.part_id, ku.KIND_VERTEX),
+                    group_of=lambda f: (f[1], f[2]),
+                    parse_key=ku.parse_vertex_key):
+                if tag == tag_id and vid in s.vid_to_local:
+                    idx_rows.append((s.vid_to_local[vid], v))
+            if idx_rows:
+                cols = _build_columns(schema, cap_v, idx_rows, now,
+                                      dict_registry, ("t", tag_id))
+                if cols:
+                    s.tag_props[tag_id] = cols
+
+    return CsrSnapshot(space_id, shards, cap_v, cap_e, write_version)
+
+
+_I32_MIN, _I32_MAX = -(1 << 31), (1 << 31) - 1
+
+
+def _build_columns(schema: Schema, cap: int,
+                   idx_rows: List[Tuple[int, bytes]], now: float,
+                   dict_registry: Dict = None, dict_key: Tuple = None
+                   ) -> Dict[str, PropColumn]:
+    """Decode rows into columnar arrays aligned at the given indices,
+    respecting schema versions and TTL."""
+    out: Dict[str, PropColumn] = {}
+    n_fields = schema.num_fields()
+    host_cols: List[List[Any]] = [[None] * cap for _ in range(n_fields)]
+    ttl = schema.ttl_col is not None and schema.ttl_duration > 0
+    for idx, raw in idx_rows:
+        try:
+            reader = RowReader(schema, raw)
+            row = reader.to_dict()
+        except Exception:
+            continue
+        if ttl:
+            ts = row.get(schema.ttl_col)
+            if isinstance(ts, (int, float)) and ts + schema.ttl_duration < now:
+                continue
+        for fi, f in enumerate(schema.fields):
+            host_cols[fi][idx] = row.get(f.name)
+    for fi, f in enumerate(schema.fields):
+        vals = host_cols[fi]
+        host = np.array(vals, dtype=object)
+        device_ok = True
+        device_vals = None
+        str_dict = None
+        t = f.type
+        if t == PropType.DOUBLE:
+            device_vals = np.array([v if v is not None else np.nan
+                                    for v in vals], dtype=np.float32)
+        elif t in (PropType.INT, PropType.VID, PropType.TIMESTAMP):
+            ints = [v if v is not None else 0 for v in vals]
+            if ints and (min(ints) < _I32_MIN or max(ints) > _I32_MAX):
+                device_ok = False  # host-only column (filter falls back)
+            else:
+                device_vals = np.array(ints, dtype=np.int32)
+        elif t == PropType.BOOL:
+            device_vals = np.array([bool(v) for v in vals], dtype=bool)
+        elif t == PropType.STRING:
+            if dict_registry is not None and dict_key is not None:
+                str_dict = dict_registry.setdefault(dict_key + (f.name,), {})
+            else:
+                str_dict = {}
+            codes = np.full(cap, -1, dtype=np.int32)
+            for i, v in enumerate(vals):
+                if v is None:
+                    continue
+                code = str_dict.setdefault(v, len(str_dict))
+                codes[i] = code
+            device_vals = codes
+        else:
+            device_ok = False
+        present = np.array([v is not None for v in vals], dtype=bool)
+        out[f.name] = PropColumn(f.name, t, host, device_ok, device_vals,
+                                 present, str_dict)
+    return out

@@ -1,0 +1,388 @@
+"""TpuGraphEngine: the device-side query hot path.
+
+The opt-in per-space TPU storage engine (BASELINE.json north star): GO
+multi-hop expansion and FIND SHORTEST PATH run as compiled XLA programs
+over CSR snapshots instead of per-hop storage RPCs. The query engine
+consults `can_serve` per statement — anything unsupported falls back to
+the CPU scatter/gather path, and materialized results flow through the
+exact same yield-evaluation machinery (`_emit_go_rows`) so result sets
+are identical by construction wherever both paths can serve.
+
+Snapshot lifecycle: built lazily from the KV store on first use, keyed
+to the engine's write_version + catalog version; stale snapshots are
+rebuilt transparently (auto_refresh) — the Phase-6 upgrade path is
+delta buffers + periodic repack (SURVEY.md §7 hard-part (a)).
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..common.status import ErrorCode, Status, StatusOr
+from ..filter.expressions import (Expression, InputPropExpr, VariablePropExpr)
+from ..parser import ast
+from ..storage.types import BoundResponse, EdgeData, PartResult, VertexData
+from . import traverse
+from .csr import CsrSnapshot, build_snapshot
+from .filter_compile import FilterCompiler
+
+DEFAULT_MAX_EDGES_PER_VERTEX = 10000
+
+
+def _uses_input_refs(exprs: List[Expression]) -> bool:
+    for e in exprs:
+        for node in e.walk():
+            if isinstance(node, (InputPropExpr, VariablePropExpr)):
+                return True
+    return False
+
+
+class TpuGraphEngine:
+    def __init__(self, auto_refresh: bool = True, enabled: bool = True):
+        self.auto_refresh = auto_refresh
+        self.enabled = enabled
+        self._snapshots: Dict[int, CsrSnapshot] = {}
+        self._store = None
+        self._sm = None
+        self._meta = None
+        self.stats = {"go_served": 0, "path_served": 0, "rebuilds": 0,
+                      "fallbacks": 0}
+
+    # ------------------------------------------------------------------
+    def attach(self, cluster) -> None:
+        self._store = cluster.store
+        self._sm = cluster.sm
+        self._meta = cluster.meta
+
+    def attach_raw(self, store, sm, meta=None) -> None:
+        self._store = store
+        self._sm = sm
+        self._meta = meta
+
+    # ------------------------------------------------------------------
+    # snapshot lifecycle
+    # ------------------------------------------------------------------
+    def _catalog_version(self) -> int:
+        return getattr(self._meta, "catalog_version", 0) if self._meta else 0
+
+    def refresh(self, space_id: int) -> CsrSnapshot:
+        num_parts = self._sm.num_parts(space_id)
+        snap = build_snapshot(self._store, self._sm, space_id, num_parts)
+        snap.catalog_version = self._catalog_version()
+        self._snapshots[space_id] = snap
+        self.stats["rebuilds"] += 1
+        return snap
+
+    def snapshot(self, space_id: int) -> Optional[CsrSnapshot]:
+        engine = self._store.space_engine(space_id) if self._store else None
+        if engine is None:
+            return None
+        snap = self._snapshots.get(space_id)
+        fresh = (snap is not None
+                 and snap.write_version == engine.write_version
+                 and getattr(snap, "catalog_version", -1) == self._catalog_version())
+        if fresh:
+            return snap
+        if not self.auto_refresh and snap is None:
+            return None
+        return self.refresh(space_id)
+
+    # ------------------------------------------------------------------
+    # serve decisions
+    # ------------------------------------------------------------------
+    def can_serve(self, space_id: int, s: ast.GoSentence) -> bool:
+        if not (self.enabled and self._store is not None):
+            return False
+        exprs = [c.expr for c in (s.yield_.columns if s.yield_ else [])]
+        if s.where:
+            exprs.append(s.where.filter)
+        if _uses_input_refs(exprs):
+            return False  # $-/$var back-references need CPU root tracking
+        return True
+
+    def can_serve_path(self, space_id: int, s: ast.FindPathSentence) -> bool:
+        return bool(self.enabled and self._store is not None and s.shortest)
+
+    # ------------------------------------------------------------------
+    # GO on device
+    # ------------------------------------------------------------------
+    def execute_go(self, ctx, s: ast.GoSentence, starts: List[int],
+                   edge_types: List[int], alias_map: Dict[str, str],
+                   name_by_type: Dict[int, str]):
+        """Returns executors.Result, or None to fall back to CPU."""
+        from ..graph import executors as ex
+        if len(edge_types) > traverse.MAX_EDGE_TYPES_PER_QUERY:
+            self.stats["fallbacks"] += 1
+            return None
+        snap = self.snapshot(ctx.space_id())
+        if snap is None:
+            self.stats["fallbacks"] += 1
+            return None
+
+        yield_cols = ex._go_yield_columns(s, ctx, name_by_type)
+        columns = [c.name() for c in yield_cols]
+
+        frontier0 = snap.frontier_from_vids(starts)
+        if not frontier0.any():
+            return StatusOr.of(ex.InterimResult(columns))
+        import jax.numpy as jnp
+        f0 = jnp.asarray(frontier0)
+        req = jnp.asarray(traverse.pad_edge_types(edge_types))
+
+        # filter: try device compile; else host-side at materialization
+        device_mask = None
+        local_filter = None
+        if s.where is not None:
+            fc = FilterCompiler(snap, self._sm, ctx.space_id(), name_by_type,
+                                alias_map, edge_types)
+            device_mask = fc.compile(s.where.filter)
+            if device_mask is None:
+                local_filter = s.where.filter
+
+        if s.step.upto:
+            active = traverse.multi_hop_upto(
+                f0, s.step.steps, snap.d_edge_src, snap.d_edge_gidx,
+                snap.d_edge_etype, snap.d_edge_valid, req)
+        else:
+            _, active = traverse.multi_hop(
+                f0, s.step.steps, snap.d_edge_src, snap.d_edge_gidx,
+                snap.d_edge_etype, snap.d_edge_valid, req)
+        if device_mask is not None:
+            active = active & device_mask
+        mask = np.asarray(active)
+
+        resp = self._materialize(snap, mask, ctx, yield_cols, s)
+        rows: List[Tuple] = []
+        st = ex._emit_go_rows(ctx, resp, rows, yield_cols, local_filter,
+                              alias_map, name_by_type, roots={},
+                              input_index={}, needs_input=False,
+                              needs_dst=_needs_dst(yield_cols, s))
+        if not st.ok():
+            return StatusOr.from_status(st)
+        result = ex.InterimResult(columns, rows)
+        if s.yield_ and s.yield_.distinct:
+            result = result.distinct()
+        self.stats["go_served"] += 1
+        return StatusOr.of(result)
+
+    # ------------------------------------------------------------------
+    def _materialize(self, snap: CsrSnapshot, mask: np.ndarray, ctx,
+                     yield_cols, s) -> BoundResponse:
+        """Compact the active-edge mask into the same BoundResponse shape
+        the CPU storage path returns, reading props from host mirrors."""
+        space = ctx.space_id()
+        resp = BoundResponse()
+        src_tag_reqs, _, _ = _collect_src_tags(ctx, yield_cols, s)
+        per_vertex: Dict[int, VertexData] = {}
+        cap_counts: Dict[Tuple[int, int], int] = {}
+        for p in range(snap.num_parts):
+            shard = snap.shards[p]
+            idxs = np.nonzero(mask[p])[0]
+            for i in idxs:
+                i = int(i)
+                src_vid = int(shard.vids[shard.edge_src[i]])
+                et = int(shard.edge_etype[i])
+                ckey = (src_vid, et)
+                cap_counts[ckey] = cap_counts.get(ckey, 0) + 1
+                if cap_counts[ckey] > DEFAULT_MAX_EDGES_PER_VERTEX:
+                    continue
+                vd = per_vertex.get(src_vid)
+                if vd is None:
+                    vd = VertexData(src_vid)
+                    for tid in src_tag_reqs:
+                        props = _host_tag_props(shard, tid,
+                                                int(shard.edge_src[i]))
+                        if props is not None:
+                            vd.tag_props[tid] = props
+                    per_vertex[src_vid] = vd
+                elif src_tag_reqs and not vd.tag_props:
+                    pass
+                props = _host_edge_props(shard, et, i)
+                vd.edges.append(EdgeData(src_vid, et,
+                                         int(shard.edge_rank[i]),
+                                         int(shard.edge_dst_vid[i]), props))
+            resp.results[p + 1] = PartResult()
+        resp.vertices = list(per_vertex.values())
+        return resp
+
+    # ------------------------------------------------------------------
+    # FIND SHORTEST PATH on device
+    # ------------------------------------------------------------------
+    def execute_find_path(self, ctx, s: ast.FindPathSentence,
+                          sources: List[int], targets: List[int],
+                          edge_types: List[int],
+                          name_by_type: Dict[int, str]):
+        from ..graph import executors as ex
+        if len(edge_types) > traverse.MAX_EDGE_TYPES_PER_QUERY:
+            return None
+        snap = self.snapshot(ctx.space_id())
+        if snap is None or not sources or not targets:
+            if snap is None:
+                return None
+            return StatusOr.of(ex.InterimResult(["_path_"]))
+        import jax.numpy as jnp
+        f_src = snap.frontier_from_vids(sources)
+        f_dst = snap.frontier_from_vids(targets)
+        if not f_src.any() or not f_dst.any():
+            return StatusOr.of(ex.InterimResult(["_path_"]))
+        req_f = jnp.asarray(traverse.pad_edge_types(edge_types))
+        req_b = jnp.asarray(traverse.pad_edge_types([-t for t in edge_types]))
+        upto = s.step.steps
+        # halved-depth bidirectional sweep (ref: FindPathExecutor :155)
+        steps_f = (upto + 1) // 2
+        steps_b = upto - steps_f
+        dist_f = np.asarray(traverse.bfs_dist(
+            jnp.asarray(f_src), steps_f, snap.d_edge_src, snap.d_edge_gidx,
+            snap.d_edge_etype, snap.d_edge_valid, req_f))
+        dist_b = np.asarray(traverse.bfs_dist(
+            jnp.asarray(f_dst), max(steps_b, 0), snap.d_edge_src,
+            snap.d_edge_gidx, snap.d_edge_etype, snap.d_edge_valid, req_b))
+        paths = _reconstruct_shortest(snap, dist_f, dist_b, sources, targets,
+                                      edge_types, upto, name_by_type)
+        self.stats["path_served"] += 1
+        return StatusOr.of(ex.InterimResult(["_path_"], [(p,) for p in paths]))
+
+
+# ---------------------------------------------------------------------------
+# host-side helpers
+# ---------------------------------------------------------------------------
+
+def _collect_src_tags(ctx, yield_cols, s):
+    from ..graph.executors import _collect_prop_requirements
+    exprs = [c.expr for c in yield_cols]
+    if s.where is not None:
+        exprs.append(s.where.filter)
+    return _collect_prop_requirements(exprs, ctx)
+
+
+def _needs_dst(yield_cols, s) -> bool:
+    from ..filter.expressions import DestPropExpr
+    exprs = [c.expr for c in yield_cols]
+    if s.where is not None:
+        exprs.append(s.where.filter)
+    for e in exprs:
+        for node in e.walk():
+            if isinstance(node, DestPropExpr):
+                return True
+    return False
+
+
+def _host_tag_props(shard, tag_id: int, local: int) -> Optional[Dict[str, Any]]:
+    cols = shard.tag_props.get(tag_id)
+    if cols is None:
+        return None
+    first = next(iter(cols.values()), None)
+    if first is None or (first.present is not None and not first.present[local]):
+        # vertex has no row for this tag
+        has_any = any(c.present is not None and c.present[local]
+                      for c in cols.values())
+        if not has_any:
+            return None
+    return {name: col.host[local] for name, col in cols.items()}
+
+
+def _host_edge_props(shard, etype: int, edge_idx: int) -> Dict[str, Any]:
+    cols = shard.edge_props.get(etype)
+    if not cols:
+        return {}
+    return {name: col.host[edge_idx] for name, col in cols.items()}
+
+
+def _shard_indptr(shard) -> np.ndarray:
+    """Lazy CSR indptr over the sorted edge_src array."""
+    if not hasattr(shard, "_indptr"):
+        nv = len(shard.vids)
+        shard._indptr = np.searchsorted(shard.edge_src[:shard.num_edges],
+                                        np.arange(nv + 1))
+    return shard._indptr
+
+
+def _reconstruct_shortest(snap: CsrSnapshot, dist_f: np.ndarray,
+                          dist_b: np.ndarray, sources, targets,
+                          edge_types: List[int], upto: int,
+                          name_by_type: Dict[int, str]) -> List[str]:
+    """Host-side path reconstruction from the two device BFS depth maps.
+
+    Meet vertices minimize dist_f + dist_b; predecessor edges are found
+    through the reverse-copy rows stored in each vertex's own partition
+    (edge u->v of type t is stored at v as (v, -t, rank, u))."""
+    both = (dist_f >= 0) & (dist_b >= 0)
+    if not both.any():
+        return []
+    total = np.where(both, dist_f + dist_b, np.iinfo(np.int32).max)
+    best = int(total.min())
+    if best > upto:
+        return []
+    meets = np.argwhere(total == best)
+    type_set = set(edge_types)
+    rev_set = {-t for t in edge_types}
+
+    def neighbors_at(vid: int, want_types, dist_map, level: int):
+        """Vertices u adjacent to vid (through edges of want_types as seen
+        FROM vid's partition rows) with dist_map[u] == level; returns
+        (u, etype_seen, rank)."""
+        loc = snap.locate(vid)
+        if loc is None:
+            return
+        p, local = loc
+        shard = snap.shards[p]
+        indptr = _shard_indptr(shard)
+        for i in range(indptr[local], indptr[local + 1]):
+            et = int(shard.edge_etype[i])
+            if et not in want_types:
+                continue
+            u = int(shard.edge_dst_vid[i])
+            uloc = snap.locate(u)
+            if uloc is None:
+                continue
+            if dist_map[uloc[0], uloc[1]] == level:
+                yield u, et, int(shard.edge_rank[i])
+
+    # path entry = (vid, etype_into_vid, rank_into_vid); entry 0 carries
+    # no edge info
+    out = set()
+    for p, local in meets:
+        mid = int(snap.shards[p].vids[local])
+        df = int(dist_f[p, local])
+        db = int(dist_b[p, local])
+        prefixes = [((mid, 0, 0),)]
+        for level in range(df - 1, -1, -1):
+            nxt = []
+            for path in prefixes:
+                v = path[0][0]
+                # predecessor u -> v of forward type t is stored at v's
+                # partition as the reverse row (v, -t, rank, u)
+                for u, et_seen, rank in neighbors_at(v, rev_set, dist_f, level):
+                    fixed_head = (v, -et_seen, rank)
+                    nxt.append(((u, 0, 0), fixed_head) + path[1:])
+            prefixes = nxt
+            if not prefixes:
+                break
+        suffixes = [((mid, 0, 0),)]
+        for level in range(db - 1, -1, -1):
+            nxt = []
+            for path in suffixes:
+                v = path[-1][0]
+                # successor v -> w: the forward row (v, t, rank, w) at v
+                for w, et_seen, rank in neighbors_at(v, type_set, dist_b, level):
+                    nxt.append(path + ((w, et_seen, rank),))
+            suffixes = nxt
+            if not suffixes:
+                break
+        for pre in prefixes:
+            for suf in suffixes:
+                full = pre + suf[1:]
+                vids = [e[0] for e in full]
+                steps = [(e[1], e[2]) for e in full[1:]]
+                out.add(traverse_format(vids, steps, name_by_type))
+    return sorted(out)
+
+
+def traverse_format(vids, steps, name_by_type) -> str:
+    parts = [str(vids[0])]
+    for (et, rank), vid in zip(steps, vids[1:]):
+        name = name_by_type.get(abs(et), str(abs(et)))
+        parts.append(f"<{name},{rank}>{vid}")
+    return "".join(parts)

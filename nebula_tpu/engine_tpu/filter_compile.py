@@ -1,0 +1,274 @@
+"""Expression → vectorized device mask compiler.
+
+The TPU answer to the reference's per-row filter closures (ref:
+storage/QueryBaseProcessor.inl:415-443 binds getters to KV iterators,
+evaluated edge-by-edge): instead of evaluating the expression tree per
+edge, compile it once into jnp operations producing a bool mask over
+the whole [P, cap_e] edge block (SURVEY.md §7 hard-part (c)).
+
+Supported on device: literals; edge props; `$^` source-vertex props
+(gathered through edge_src); `$$` dest-vertex props (gathered through
+the dst global index); arithmetic / relational / logical operators;
+string equality via dictionary codes. Anything else (functions, $-,
+$var, _rank/_src/_dst literals, casts) returns None — the engine then
+runs the traversal unfiltered on device and applies the filter on the
+host during materialization, preserving exact semantics.
+
+Null semantics mirror the CPU path: comparisons against a missing
+property are false (tracked with presence masks; DOUBLE uses NaN which
+is naturally false in comparisons).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..codec.schema import PropType
+from ..filter.expressions import (ArithmeticExpr, DestPropExpr, EdgePropExpr,
+                                  Expression, Literal, LogicalExpr,
+                                  RelationalExpr, SourcePropExpr, UnaryExpr)
+
+
+class _Unsupported(Exception):
+    pass
+
+
+class _Val:
+    """A compiled sub-expression: device value + presence + kind."""
+
+    __slots__ = ("kind", "value", "present", "str_meta")
+
+    def __init__(self, kind: str, value, present, str_meta=None):
+        self.kind = kind          # 'num' | 'bool' | 'strcode' | 'strlit'
+        self.value = value        # jnp array or python scalar
+        self.present = present    # jnp bool array or None (always present)
+        self.str_meta = str_meta  # (kind, schema_id, prop) for strcode
+
+
+class FilterCompiler:
+    def __init__(self, snapshot, sm, space_id: int,
+                 name_by_type: Dict[int, str], alias_map: Dict[str, str],
+                 edge_types: List[int]):
+        self.snap = snapshot
+        self.sm = sm
+        self.space_id = space_id
+        self.name_by_type = name_by_type
+        self.alias_map = alias_map
+        self.edge_types = edge_types
+
+    def compile(self, expr: Expression) -> Optional[jnp.ndarray]:
+        """-> bool mask [P, cap_e], or None if not device-compilable."""
+        try:
+            v = self._compile(expr)
+            if v.kind != "bool":
+                return None
+            mask = v.value
+            if v.present is not None:
+                mask = mask & v.present
+            return mask
+        except _Unsupported:
+            return None
+
+    # ------------------------------------------------------------------
+    def _edge_prop_val(self, prop: str) -> _Val:
+        """Value of an edge prop across all requested edge types,
+        selected per edge by its stored etype."""
+        snap = self.snap
+        acc = None
+        present = jnp.zeros(snap.d_edge_etype.shape, dtype=bool)
+        is_string = None
+        str_meta = None
+        for et in self.edge_types:
+            col = snap.device_edge_prop(et, prop)
+            if col is None:
+                continue
+            # column dtype tells us the prop kind for this etype
+            col_is_string = self._edge_prop_type(et, prop) == PropType.STRING
+            if is_string is None:
+                is_string = col_is_string
+                if col_is_string:
+                    str_meta = ("e", et, prop)
+            elif is_string != col_is_string:
+                raise _Unsupported()
+            sel = snap.d_edge_etype == et
+            pres = sel & self._edge_prop_present(et, prop)
+            if acc is None:
+                acc = jnp.where(sel, col, 0 if col.dtype != jnp.float32
+                                else jnp.float32(jnp.nan))
+            else:
+                acc = jnp.where(sel, col, acc)
+            present = present | pres
+        if acc is None:
+            raise _Unsupported()
+        if is_string:
+            return _Val("strcode", acc, present, str_meta)
+        if acc.dtype == jnp.bool_:
+            return _Val("bool", acc, present)
+        return _Val("num", acc, present)
+
+    def _edge_prop_type(self, et: int, prop: str) -> Optional[PropType]:
+        r = self.sm.edge_schema(self.space_id, et)
+        return r.value().field_type(prop) if r.ok() else None
+
+    def _edge_prop_present(self, et: int, prop: str) -> jnp.ndarray:
+        cols = []
+        for s in self.snap.shards:
+            col = s.edge_props.get(et, {}).get(prop)
+            if col is None or col.present is None:
+                cols.append(np.zeros(self.snap.cap_e, bool))
+            else:
+                cols.append(col.present)
+        return jnp.asarray(np.stack(cols))
+
+    def _src_prop_val(self, tag: str, prop: str) -> _Val:
+        tid = self.sm.tag_id(self.space_id, tag)
+        if tid is None:
+            raise _Unsupported()
+        col = self.snap.device_tag_prop(tid, prop)
+        if col is None:
+            raise _Unsupported()
+        ptype = self.sm.tag_schema(self.space_id, tid).value().field_type(prop)
+        pres_np = np.stack([
+            s.tag_props.get(tid, {}).get(prop).present
+            if s.tag_props.get(tid, {}).get(prop) is not None
+            else np.zeros(self.snap.cap_v, bool)
+            for s in self.snap.shards])
+        # gather per-edge source values: [P, cap_v] -> [P, cap_e]
+        vals = jnp.take_along_axis(col, self.snap.d_edge_src, axis=1)
+        pres = jnp.take_along_axis(jnp.asarray(pres_np),
+                                   self.snap.d_edge_src, axis=1)
+        if ptype == PropType.STRING:
+            return _Val("strcode", vals, pres, ("t", tid, prop))
+        if col.dtype == jnp.bool_:
+            return _Val("bool", vals, pres)
+        return _Val("num", vals, pres)
+
+    def _dst_prop_val(self, tag: str, prop: str) -> _Val:
+        tid = self.sm.tag_id(self.space_id, tag)
+        if tid is None:
+            raise _Unsupported()
+        col = self.snap.device_tag_prop(tid, prop)
+        if col is None:
+            raise _Unsupported()
+        ptype = self.sm.tag_schema(self.space_id, tid).value().field_type(prop)
+        pres_np = np.stack([
+            s.tag_props.get(tid, {}).get(prop).present
+            if s.tag_props.get(tid, {}).get(prop) is not None
+            else np.zeros(self.snap.cap_v, bool)
+            for s in self.snap.shards])
+        # flatten [P, cap_v] -> [P*cap_v] + dump slot, gather by global idx
+        flat = jnp.concatenate([col.reshape(-1),
+                                jnp.zeros((1,), col.dtype)])
+        flat_p = jnp.concatenate([jnp.asarray(pres_np).reshape(-1),
+                                  jnp.zeros((1,), jnp.bool_)])
+        vals = flat[self.snap.d_edge_gidx]
+        pres = flat_p[self.snap.d_edge_gidx]
+        if ptype == PropType.STRING:
+            return _Val("strcode", vals, pres, ("t", tid, prop))
+        if col.dtype == jnp.bool_:
+            return _Val("bool", vals, pres)
+        return _Val("num", vals, pres)
+
+    # ------------------------------------------------------------------
+    def _compile(self, e: Expression) -> _Val:
+        if isinstance(e, Literal):
+            v = e.value
+            if isinstance(v, bool):
+                return _Val("bool", v, None)
+            if isinstance(v, (int, float)):
+                return _Val("num", v, None)
+            if isinstance(v, str):
+                return _Val("strlit", v, None)
+            raise _Unsupported()
+        if isinstance(e, EdgePropExpr):
+            if e.edge is not None:
+                canon = self.alias_map.get(e.edge, e.edge)
+                in_scope = any(self.name_by_type.get(abs(t)) == canon
+                               for t in self.edge_types)
+                if not in_scope:
+                    raise _Unsupported()
+            return self._edge_prop_val(e.prop)
+        if isinstance(e, SourcePropExpr):
+            return self._src_prop_val(e.tag, e.prop)
+        if isinstance(e, DestPropExpr):
+            return self._dst_prop_val(e.tag, e.prop)
+        if isinstance(e, UnaryExpr):
+            v = self._compile(e.operand)
+            if e.op == "!" and v.kind == "bool":
+                return _Val("bool", ~v.value if hasattr(v.value, "dtype")
+                            else (not v.value), v.present)
+            if e.op == "-" and v.kind == "num":
+                return _Val("num", -v.value, v.present)
+            if e.op == "+" and v.kind == "num":
+                return v
+            raise _Unsupported()
+        if isinstance(e, ArithmeticExpr):
+            l = self._compile(e.left)
+            r = self._compile(e.right)
+            if l.kind != "num" or r.kind != "num":
+                raise _Unsupported()
+            pres = _and_present(l.present, r.present)
+            if e.op == "+":
+                return _Val("num", l.value + r.value, pres)
+            if e.op == "-":
+                return _Val("num", l.value - r.value, pres)
+            if e.op == "*":
+                return _Val("num", l.value * r.value, pres)
+            if e.op == "/":
+                return _Val("num", l.value / r.value, pres)
+            if e.op == "%":
+                return _Val("num", l.value % r.value, pres)
+            raise _Unsupported()
+        if isinstance(e, RelationalExpr):
+            l = self._compile(e.left)
+            r = self._compile(e.right)
+            pres = _and_present(l.present, r.present)
+            # string comparisons: only == / != via dict codes
+            if "strcode" in (l.kind, r.kind):
+                if e.op not in ("==", "!="):
+                    raise _Unsupported()
+                code_side, lit_side = (l, r) if l.kind == "strcode" else (r, l)
+                if lit_side.kind != "strlit":
+                    raise _Unsupported()
+                kind, sid, prop = code_side.str_meta
+                code = self.snap.str_code((kind, sid), prop, lit_side.value)
+                m = code_side.value == code
+                if e.op == "!=":
+                    m = ~m
+                return _Val("bool", m, pres)
+            if l.kind == "strlit" or r.kind == "strlit":
+                raise _Unsupported()
+            if l.kind == "bool" and r.kind == "bool" and e.op in ("==", "!="):
+                m = (l.value == r.value) if e.op == "==" else (l.value != r.value)
+                return _Val("bool", m, pres)
+            if l.kind != "num" or r.kind != "num":
+                raise _Unsupported()
+            ops = {"==": lambda a, b: a == b, "!=": lambda a, b: a != b,
+                   "<": lambda a, b: a < b, "<=": lambda a, b: a <= b,
+                   ">": lambda a, b: a > b, ">=": lambda a, b: a >= b}
+            if e.op not in ops:
+                raise _Unsupported()
+            return _Val("bool", ops[e.op](l.value, r.value), pres)
+        if isinstance(e, LogicalExpr):
+            l = self._compile(e.left)
+            r = self._compile(e.right)
+            if l.kind != "bool" or r.kind != "bool":
+                raise _Unsupported()
+            lv = l.value if l.present is None else (l.value & l.present)
+            rv = r.value if r.present is None else (r.value & r.present)
+            if e.op == "&&":
+                return _Val("bool", lv & rv, None)
+            if e.op == "||":
+                return _Val("bool", lv | rv, None)
+            return _Val("bool", lv ^ rv, None)
+        raise _Unsupported()
+
+
+def _and_present(a, b):
+    if a is None:
+        return b
+    if b is None:
+        return a
+    return a & b

@@ -1,0 +1,166 @@
+"""Device traversal kernels: dense-mask BFS frontier advance.
+
+The TPU-native replacement for the reference's per-hop RPC loop
+(graphd re-crossing the network every step, ref SURVEY.md §3.1): the
+whole multi-hop expansion compiles to ONE XLA program —
+
+    per hop:  gather   active = frontier[edge_src] & type_ok      (VPU)
+              scatter  hits[dst_gidx] |= active                   (HBM)
+    loop:     lax.fori_loop over hops (dynamic trip count, no retrace)
+
+A dense bool frontier per partition gives within-step dst dedup for
+free — exactly the reference's `getDstIdsFromResp` unordered_set
+semantics (GO revisits previously-seen vertices across steps; BFS-style
+visited masks are used only by shortest-path, which tracks first-hit
+depth in `dist`).
+
+All shapes are static: [P, cap_v] frontiers, [P, cap_e] edge arrays,
+requested edge types padded to a fixed-width vector. Invalid/padded
+edges scatter into a dump slot at index P*cap_v.
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+MAX_EDGE_TYPES_PER_QUERY = 8  # fixed width so type sets don't retrace
+
+
+def pad_edge_types(edge_types: List[int]) -> np.ndarray:
+    """Pad the requested signed-type list to fixed width with 0
+    (0 is never a valid edge type)."""
+    if len(edge_types) > MAX_EDGE_TYPES_PER_QUERY:
+        raise ValueError(f"too many edge types in one traversal "
+                         f"({len(edge_types)} > {MAX_EDGE_TYPES_PER_QUERY})")
+    out = np.zeros(MAX_EDGE_TYPES_PER_QUERY, np.int32)
+    out[:len(edge_types)] = edge_types
+    return out
+
+
+def _edge_ok(edge_etype: jnp.ndarray, edge_valid: jnp.ndarray,
+             req_types: jnp.ndarray) -> jnp.ndarray:
+    """[P, cap_e] mask of edges matching the requested signed types."""
+    m = (edge_etype[None, :, :] == req_types[:, None, None]).any(axis=0)
+    return m & edge_valid
+
+
+def _advance(frontier: jnp.ndarray, edge_src: jnp.ndarray,
+             edge_gidx: jnp.ndarray, edge_ok: jnp.ndarray) -> jnp.ndarray:
+    """One BFS hop on stacked partitions (single device).
+
+    frontier: bool[P, cap_v] -> bool[P, cap_v]
+    """
+    P, cap_v = frontier.shape
+    active = jnp.take_along_axis(frontier, edge_src, axis=1) & edge_ok
+    flat = jnp.zeros((P * cap_v + 1,), dtype=jnp.bool_)
+    flat = flat.at[edge_gidx.reshape(-1)].max(active.reshape(-1))
+    return flat[:P * cap_v].reshape(P, cap_v)
+
+
+@jax.jit
+def multi_hop(frontier0: jnp.ndarray, steps: jnp.ndarray,
+              edge_src: jnp.ndarray, edge_gidx: jnp.ndarray,
+              edge_etype: jnp.ndarray, edge_valid: jnp.ndarray,
+              req_types: jnp.ndarray
+              ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Run `steps-1` frontier advances, then emit the final-step active
+    edge mask (GO semantics: result = edges leaving the step-(N-1)
+    frontier). `steps` is a traced scalar — one compile serves any N.
+
+    -> (final_frontier bool[P, cap_v], final_active bool[P, cap_e])
+    """
+    edge_ok = _edge_ok(edge_etype, edge_valid, req_types)
+
+    def body(_, f):
+        return _advance(f, edge_src, edge_gidx, edge_ok)
+
+    frontier = lax.fori_loop(0, steps - 1, body, frontier0)
+    final_active = jnp.take_along_axis(frontier, edge_src, axis=1) & edge_ok
+    return frontier, final_active
+
+
+@jax.jit
+def multi_hop_upto(frontier0: jnp.ndarray, steps: jnp.ndarray,
+                   edge_src: jnp.ndarray, edge_gidx: jnp.ndarray,
+                   edge_etype: jnp.ndarray, edge_valid: jnp.ndarray,
+                   req_types: jnp.ndarray) -> jnp.ndarray:
+    """GO UPTO: union of active edge masks over steps 1..N.
+
+    -> any_active bool[P, cap_e]
+    """
+    edge_ok = _edge_ok(edge_etype, edge_valid, req_types)
+
+    def body(_, state):
+        frontier, acc = state
+        active = jnp.take_along_axis(frontier, edge_src, axis=1) & edge_ok
+        return _advance(frontier, edge_src, edge_gidx, edge_ok), acc | active
+
+    _, acc = lax.fori_loop(
+        0, steps, body,
+        (frontier0, jnp.zeros_like(edge_ok)))
+    return acc
+
+
+@jax.jit
+def count_edges(final_active: jnp.ndarray) -> jnp.ndarray:
+    return final_active.sum(dtype=jnp.int32)
+
+
+@jax.jit
+def bfs_dist(frontier0: jnp.ndarray, max_steps: jnp.ndarray,
+             edge_src: jnp.ndarray, edge_gidx: jnp.ndarray,
+             edge_etype: jnp.ndarray, edge_valid: jnp.ndarray,
+             req_types: jnp.ndarray) -> jnp.ndarray:
+    """Single-source-set BFS depth map for shortest path: dist[p, v] =
+    first step at which v was reached (0 for sources, -1 unreached).
+
+    -> dist int32[P, cap_v]
+    """
+    edge_ok = _edge_ok(edge_etype, edge_valid, req_types)
+    P, cap_v = frontier0.shape
+    dist0 = jnp.where(frontier0, 0, -1).astype(jnp.int32)
+
+    def cond(state):
+        frontier, dist, step = state
+        return (step < max_steps) & frontier.any()
+
+    def body(state):
+        frontier, dist, step = state
+        nxt = _advance(frontier, edge_src, edge_gidx, edge_ok)
+        fresh = nxt & (dist < 0)
+        dist = jnp.where(fresh, step + 1, dist)
+        return fresh, dist, step + 1
+
+    _, dist, _ = lax.while_loop(cond, body, (frontier0, dist0,
+                                             jnp.int32(0)))
+    return dist
+
+
+# ---------------------------------------------------------------------------
+# multi-hop traversal with edge counting per hop (bench instrumentation)
+# ---------------------------------------------------------------------------
+
+@jax.jit
+def multi_hop_count(frontier0: jnp.ndarray, steps: jnp.ndarray,
+                    edge_src: jnp.ndarray, edge_gidx: jnp.ndarray,
+                    edge_etype: jnp.ndarray, edge_valid: jnp.ndarray,
+                    req_types: jnp.ndarray) -> jnp.ndarray:
+    """Total edges traversed across ALL hops (the bench metric:
+    edges-traversed/sec counts every hop's expansions, not just the
+    final emission)."""
+    edge_ok = _edge_ok(edge_etype, edge_valid, req_types)
+
+    def body(_, state):
+        frontier, total = state
+        active = jnp.take_along_axis(frontier, edge_src, axis=1) & edge_ok
+        total = total + active.sum(dtype=jnp.int64)
+        return _advance(frontier, edge_src, edge_gidx, edge_ok), total
+
+    _, total = lax.fori_loop(0, steps, body,
+                             (frontier0, jnp.zeros((), jnp.int64)))
+    return total

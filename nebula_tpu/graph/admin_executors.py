@@ -226,15 +226,37 @@ def execute_drop_user(ctx: ExecContext, s: ast.DropUserSentence) -> Result:
 
 
 def execute_change_password(ctx: ExecContext, s: ast.ChangePasswordSentence) -> Result:
+    caller = ctx.session.user
+    if s.old_password is None and caller != "root":
+        # ALTER USER (no old password) is a GOD-only account takeover path
+        return _err(ErrorCode.E_BAD_PERMISSION,
+                    "ALTER USER requires GOD; use CHANGE PASSWORD ... FROM ... TO ...")
     st = ctx.meta.change_password(s.user, s.new_password, s.old_password)
     return _ok() if st.ok() else StatusOr.from_status(st)
+
+
+_ROLE_RANK = {"GOD": 4, "ADMIN": 3, "USER": 2, "GUEST": 1}
+
+
+def _caller_rank_in(ctx: ExecContext, space_id: int) -> int:
+    if ctx.session.user == "root":
+        return _ROLE_RANK["GOD"]
+    role = ctx.meta.get_role(space_id, ctx.session.user)
+    return _ROLE_RANK.get(role, 0)
 
 
 def execute_grant(ctx: ExecContext, s: ast.GrantSentence) -> Result:
     r = ctx.meta.get_space(s.space)
     if not r.ok():
         return StatusOr.from_status(r.status)
-    st = ctx.meta.grant_role(r.value().space_id, s.user, s.role)
+    space_id = r.value().space_id
+    # checked against the TARGET space; granted role must be strictly
+    # below the granter's own rank there (only GOD can mint ADMIN/GOD)
+    rank = _caller_rank_in(ctx, space_id)
+    if rank < _ROLE_RANK["ADMIN"] or _ROLE_RANK.get(s.role, 5) >= rank:
+        return _err(ErrorCode.E_BAD_PERMISSION,
+                    f"granting {s.role} on {s.space} requires a higher role there")
+    st = ctx.meta.grant_role(space_id, s.user, s.role)
     return _ok() if st.ok() else StatusOr.from_status(st)
 
 
@@ -242,5 +264,11 @@ def execute_revoke(ctx: ExecContext, s: ast.RevokeSentence) -> Result:
     r = ctx.meta.get_space(s.space)
     if not r.ok():
         return StatusOr.from_status(r.status)
-    st = ctx.meta.revoke_role(r.value().space_id, s.user)
+    space_id = r.value().space_id
+    rank = _caller_rank_in(ctx, space_id)
+    current = ctx.meta.get_role(space_id, s.user)
+    if rank < _ROLE_RANK["ADMIN"] or _ROLE_RANK.get(current, 0) >= rank:
+        return _err(ErrorCode.E_BAD_PERMISSION,
+                    f"revoking {current} on {s.space} requires a higher role there")
+    st = ctx.meta.revoke_role(space_id, s.user)
     return _ok() if st.ok() else StatusOr.from_status(st)

@@ -54,10 +54,8 @@ class PermissionManager:
         if kind in _WRITE_KINDS and rank < 2:
             return Status.error(ErrorCode.E_BAD_PERMISSION,
                                 f"{kind.value} requires USER role")
-        if kind == ast.Kind.GRANT or kind == ast.Kind.REVOKE:
-            if rank < 3:
-                return Status.error(ErrorCode.E_BAD_PERMISSION,
-                                    "GRANT/REVOKE requires ADMIN role")
+        # GRANT/REVOKE and password changes are checked in their executors
+        # against the TARGET space / target user, not the session space
         return Status.OK()
 
 

@@ -201,7 +201,9 @@ def execute_go(ctx: ExecContext, s: ast.GoSentence) -> Result:
     # space has a CSR snapshot attached (Phase 2+); CPU scatter/gather here.
     tpu = getattr(ctx.engine, "tpu_engine", None)
     if tpu is not None and tpu.can_serve(space, s):
-        return tpu.execute_go(ctx, s, starts, edge_types, alias_map, name_by_type)
+        r = tpu.execute_go(ctx, s, starts, edge_types, alias_map, name_by_type)
+        if r is not None:
+            return r  # None = engine declined, fall back to CPU path
 
     yield_cols = _go_yield_columns(s, ctx, name_by_type)
     all_exprs = [c.expr for c in yield_cols]
@@ -263,6 +265,11 @@ def execute_go(ctx: ExecContext, s: ast.GoSentence) -> Result:
         else:
             resp = ctx.client.get_neighbors(space, frontier, edge_types,
                                             edge_props=[])
+            bad = [r for r in resp.results.values()
+                   if r.code != ErrorCode.SUCCEEDED]
+            if bad:
+                return _err(bad[0].code,
+                            f"storage error during GO step {step_no}")
         if final:
             break
         next_roots: Dict[int, Set[int]] = {}
@@ -381,24 +388,40 @@ def execute_find_path(ctx: ExecContext, s: ast.FindPathSentence) -> Result:
 
     tpu = getattr(ctx.engine, "tpu_engine", None)
     if tpu is not None and tpu.can_serve_path(space, s):
-        return tpu.execute_find_path(ctx, s, from_r.value(), to_r.value(),
-                                     edge_types, name_by_type)
+        r = tpu.execute_find_path(ctx, s, from_r.value(), to_r.value(),
+                                  edge_types, name_by_type)
+        if r is not None:
+            return r
 
-    if s.shortest:
-        paths = _shortest_paths(ctx, space, from_r.value(), to_r.value(),
-                                edge_types, s.step.steps, name_by_type)
-    else:
-        paths = _all_paths(ctx, space, from_r.value(), to_r.value(),
-                           edge_types, s.step.steps, name_by_type,
-                           noloop=s.noloop)
+    try:
+        if s.shortest:
+            paths = _shortest_paths(ctx, space, from_r.value(), to_r.value(),
+                                    edge_types, s.step.steps, name_by_type)
+        else:
+            paths = _all_paths(ctx, space, from_r.value(), to_r.value(),
+                               edge_types, s.step.steps, name_by_type,
+                               noloop=s.noloop)
+    except _StorageError as ex:
+        return _err(ex.code, "storage error during FIND PATH")
     rows = [(p,) for p in paths]
     return _ok(InterimResult(["_path_"], rows))
 
 
+class _StorageError(Exception):
+    def __init__(self, code: ErrorCode):
+        super().__init__(code.name)
+        self.code = code
+
+
 def _expand(ctx: ExecContext, space: int, frontier: List[int],
             edge_types: List[int]) -> Dict[int, List[Tuple[int, int, int]]]:
-    """-> dst -> [(src, etype, rank)] adjacency discovered this hop."""
+    """-> dst -> [(src, etype, rank)] adjacency discovered this hop.
+    Raises _StorageError on any partition failure (a silent partial
+    frontier would mean wrong 'no path' answers)."""
     resp = ctx.client.get_neighbors(space, frontier, edge_types, edge_props=[])
+    for r in resp.results.values():
+        if r.code != ErrorCode.SUCCEEDED:
+            raise _StorageError(r.code)
     out: Dict[int, List[Tuple[int, int, int]]] = {}
     for v in resp.vertices:
         for e in v.edges:
@@ -505,22 +528,23 @@ def _all_paths(ctx: ExecContext, space: int, sources: List[int],
         if not frontier:
             break
         adj = _expand(ctx, space, frontier, edge_types)
+        # index by src so each path extends in O(out-degree)
+        by_src: Dict[int, List[Tuple[int, int, int]]] = {}
+        for dst, incomings in adj.items():
+            for (src, et, rank) in incomings:
+                by_src.setdefault(src, []).append((dst, et, rank))
         nxt: List[Tuple[tuple, tuple]] = []
         for vids, steps in level:
-            tail = vids[-1]
-            for dst, incomings in adj.items():
-                for (src, et, rank) in incomings:
-                    if src != tail:
-                        continue
-                    if noloop and dst in vids:
-                        continue
-                    cand = (vids + (dst,), steps + ((et, rank),))
-                    if dst in targets_set:
-                        found.append(_format_path(list(cand[0]),
-                                                  list(cand[1]), name_by_type))
-                        if len(found) >= max_paths:
-                            return sorted(set(found))
-                    nxt.append(cand)
+            for (dst, et, rank) in by_src.get(vids[-1], ()):
+                if noloop and dst in vids:
+                    continue
+                cand = (vids + (dst,), steps + ((et, rank),))
+                if dst in targets_set:
+                    found.append(_format_path(list(cand[0]),
+                                              list(cand[1]), name_by_type))
+                    if len(found) >= max_paths:
+                        return sorted(set(found))
+                nxt.append(cand)
         level = nxt[:max_paths]
     return sorted(set(found))
 

@@ -42,7 +42,13 @@ class KVIterator(abc.ABC):
 
 class KVEngine(abc.ABC):
     """One ordered KV namespace (one per (space, data-path) like the
-    reference's one-RocksDB-per-space-per-path)."""
+    reference's one-RocksDB-per-space-per-path).
+
+    `write_version` is a monotonic mutation counter — the TPU engine
+    uses it to detect stale CSR snapshots (the device-side analogue of
+    the reference's compaction/version visibility)."""
+
+    write_version: int = 0
 
     # --- reads --------------------------------------------------------
     @abc.abstractmethod

@@ -53,6 +53,7 @@ class MemEngine(KVEngine):
     def __init__(self) -> None:
         self._keys: List[bytes] = []
         self._data: dict = {}
+        self.write_version = 0
 
     # --- reads --------------------------------------------------------
     def get(self, key: bytes) -> Optional[bytes]:
@@ -71,12 +72,14 @@ class MemEngine(KVEngine):
 
     # --- writes -------------------------------------------------------
     def put(self, key: bytes, value: bytes) -> Status:
+        self.write_version += 1
         if key not in self._data:
             bisect.insort(self._keys, key)
         self._data[key] = value
         return Status.OK()
 
     def multi_put(self, kvs: Iterable[KV]) -> Status:
+        self.write_version += 1
         new = False
         for k, v in kvs:
             if k not in self._data:
@@ -87,6 +90,7 @@ class MemEngine(KVEngine):
         return Status.OK()
 
     def remove(self, key: bytes) -> Status:
+        self.write_version += 1
         if key in self._data:
             del self._data[key]
             i = bisect.bisect_left(self._keys, key)
@@ -95,6 +99,7 @@ class MemEngine(KVEngine):
         return Status.OK()
 
     def multi_remove(self, keys: Iterable[bytes]) -> Status:
+        self.write_version += 1
         hit = False
         for k in keys:
             if k in self._data:
@@ -105,6 +110,7 @@ class MemEngine(KVEngine):
         return Status.OK()
 
     def remove_range(self, start: bytes, end: bytes) -> Status:
+        self.write_version += 1
         lo = bisect.bisect_left(self._keys, start)
         hi = bisect.bisect_left(self._keys, end)
         for k in self._keys[lo:hi]:

@@ -112,11 +112,14 @@ class GQLParser:
         while self._at("UNION", "INTERSECT", "MINUS"):
             t = self._expect("UNION", "INTERSECT", "MINUS")
             if t.type == "UNION":
-                if self._accept("DISTINCT"):
-                    op = ast.SetOp.UNION_DISTINCT
-                else:
-                    self._accept("ALL")
+                # bare UNION implies DISTINCT, matching the reference
+                # grammar (parser.yy:1110-1121 setDistinct()); UNION ALL
+                # keeps duplicates
+                if self._accept("ALL"):
                     op = ast.SetOp.UNION
+                else:
+                    self._accept("DISTINCT")
+                    op = ast.SetOp.UNION_DISTINCT
             else:
                 op = ast.SetOp[t.type]
             right = self._piped()

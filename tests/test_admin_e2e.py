@@ -157,3 +157,36 @@ def test_root_password_enforced():
     root.must('CHANGE PASSWORD root FROM "" TO "s3cret"')
     assert not cluster.service.authenticate("root", "").ok()
     assert cluster.service.authenticate("root", "s3cret").ok()
+
+
+def test_alter_user_requires_god_and_grant_checks_target_space():
+    cluster = InProcCluster()
+    root = cluster.connect()
+    root.must("CREATE SPACE a")
+    root.must("CREATE SPACE b")
+    root.must('CREATE USER eve WITH PASSWORD "pw"')
+    root.must("GRANT ROLE ADMIN ON a TO eve")
+    eve = cluster.connect("eve", "pw")
+    eve.must("USE a")
+    # account takeover path is closed: ALTER USER by non-root fails
+    resp = eve.execute('ALTER USER root WITH PASSWORD "owned"')
+    assert resp.code == ErrorCode.E_BAD_PERMISSION
+    assert cluster.service.authenticate("root", "").ok()
+    # cross-space escalation closed: eve is ADMIN on a, nothing on b
+    resp = eve.execute("GRANT ROLE GOD ON b TO eve")
+    assert resp.code == ErrorCode.E_BAD_PERMISSION
+    resp = eve.execute("GRANT ROLE ADMIN ON b TO eve")
+    assert resp.code == ErrorCode.E_BAD_PERMISSION
+    # ADMIN cannot mint a peer ADMIN, but can grant USER/GUEST in a
+    resp = eve.execute("GRANT ROLE ADMIN ON a TO eve")
+    assert resp.code == ErrorCode.E_BAD_PERMISSION
+    root.must('CREATE USER mallory WITH PASSWORD "m"')
+    eve.must("GRANT ROLE USER ON a TO mallory")
+    # ADMIN cannot revoke a peer ADMIN; GOD can
+    root.must("GRANT ROLE ADMIN ON a TO mallory")
+    resp = eve.execute("REVOKE ROLE ADMIN ON a FROM mallory")
+    assert resp.code == ErrorCode.E_BAD_PERMISSION
+    root.must("REVOKE ROLE ADMIN ON a FROM mallory")
+    # self-service password change with old password still works
+    eve.must('CHANGE PASSWORD eve FROM "pw" TO "pw2"')
+    assert cluster.service.authenticate("eve", "pw2").ok()

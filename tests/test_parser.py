@@ -87,9 +87,11 @@ def test_set_ops():
     assert isinstance(s, ast.SetSentence)
     assert s.op == ast.SetOp.MINUS
     assert isinstance(s.left, ast.SetSentence)
-    assert s.left.op == ast.SetOp.UNION
-    s2 = parse1("GO FROM 1 OVER e UNION DISTINCT GO FROM 2 OVER e")
-    assert s2.op == ast.SetOp.UNION_DISTINCT
+    assert s.left.op == ast.SetOp.UNION_DISTINCT  # bare UNION = DISTINCT
+    s2 = parse1("GO FROM 1 OVER e UNION ALL GO FROM 2 OVER e")
+    assert s2.op == ast.SetOp.UNION
+    s3 = parse1("GO FROM 1 OVER e UNION DISTINCT GO FROM 2 OVER e")
+    assert s3.op == ast.SetOp.UNION_DISTINCT
 
 
 def test_order_by_limit_group_by():

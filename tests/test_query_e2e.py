@@ -136,12 +136,13 @@ def test_group_by(nba):
 
 def test_set_ops(nba):
     _, conn = nba
+    # bare UNION implies DISTINCT (reference parser.yy:1110-1121)
     r = conn.must("GO FROM 100 OVER like YIELD like._dst AS id UNION "
                   "GO FROM 101 OVER like YIELD like._dst AS id")
-    assert rows(r) == [(100,), (101,), (102,), (102,)]
-    r = conn.must("GO FROM 100 OVER like YIELD like._dst AS id UNION DISTINCT "
-                  "GO FROM 101 OVER like YIELD like._dst AS id")
     assert rows(r) == [(100,), (101,), (102,)]
+    r = conn.must("GO FROM 100 OVER like YIELD like._dst AS id UNION ALL "
+                  "GO FROM 101 OVER like YIELD like._dst AS id")
+    assert rows(r) == [(100,), (101,), (102,), (102,)]
     r = conn.must("GO FROM 100 OVER like YIELD like._dst AS id INTERSECT "
                   "GO FROM 101 OVER like YIELD like._dst AS id")
     assert rows(r) == [(102,)]

@@ -1,0 +1,127 @@
+"""TPU engine tests: CSR snapshot correctness + CPU/TPU result-set equality
+(the north-star requirement: identical result sets, BASELINE.json).
+
+Runs on the CPU XLA backend (conftest forces JAX_PLATFORMS=cpu with 8
+virtual devices); the same code paths run unchanged on a real chip.
+"""
+import numpy as np
+import pytest
+
+from nba_fixture import load_nba
+from nebula_tpu.cluster import InProcCluster
+from nebula_tpu.engine_tpu import TpuGraphEngine
+
+
+@pytest.fixture(scope="module")
+def pair():
+    """(cpu_conn, tpu_conn, tpu_engine): same NBA data, two engines."""
+    _, cpu_conn = load_nba()
+    tpu = TpuGraphEngine()
+    cluster = InProcCluster(tpu_engine=tpu)
+    _, tpu_conn = load_nba(cluster)
+    return cpu_conn, tpu_conn, tpu
+
+
+EQUALITY_QUERIES = [
+    "GO FROM 100 OVER like",
+    "GO FROM 100 OVER like YIELD like._dst AS id, like.likeness AS w",
+    "GO FROM 100 OVER like REVERSELY YIELD like._dst AS id",
+    "GO FROM 102 OVER like BIDIRECT YIELD like._dst AS id",
+    "GO 2 STEPS FROM 100 OVER like YIELD DISTINCT like._dst",
+    "GO 3 STEPS FROM 100 OVER like YIELD like._dst",
+    "GO UPTO 3 STEPS FROM 103 OVER like YIELD like._dst AS id",
+    "GO FROM 100, 101, 107 OVER like YIELD like._dst, like.likeness",
+    "GO FROM 101 OVER * YIELD _dst AS d",
+    "GO FROM 100 OVER like, serve YIELD _dst AS d",
+    "GO FROM 100 OVER like WHERE like.likeness > 92 YIELD like._dst",
+    "GO FROM 100 OVER like WHERE like.likeness > 80 && like.likeness < 93 "
+    "YIELD like._dst, like.likeness",
+    'GO FROM 100 OVER like WHERE $^.player.age > 40 YIELD like._dst, $^.player.name',
+    'GO FROM 100 OVER serve YIELD $$.team.name AS team',
+    'GO FROM 100 OVER like WHERE $$.player.age > 33 YIELD like._dst, $$.player.age',
+    'GO FROM 100 OVER serve WHERE $$.team.name == "Spurs" YIELD serve.start_year',
+    "GO FROM 100 OVER like YIELD like._src AS s, like._dst AS d, like._rank AS r",
+    "GO 2 STEPS FROM 100 OVER like WHERE like.likeness >= 90 YIELD like._dst, like.likeness",
+    "GO FROM 121 OVER like",  # empty frontier
+    "FIND SHORTEST PATH FROM 100 TO 102 OVER like UPTO 4 STEPS",
+    "FIND SHORTEST PATH FROM 103 TO 106 OVER like UPTO 5 STEPS",
+    "FIND SHORTEST PATH FROM 103 TO 100 OVER like UPTO 8 STEPS",
+    "FIND SHORTEST PATH FROM 100 TO 121 OVER like UPTO 4 STEPS",  # no path
+    "FIND SHORTEST PATH FROM 100, 101 TO 105, 106 OVER like UPTO 6 STEPS",
+    "FIND SHORTEST PATH FROM 102 TO 104 OVER like, serve UPTO 6 STEPS",
+]
+
+
+@pytest.mark.parametrize("query", EQUALITY_QUERIES)
+def test_cpu_tpu_identical_results(pair, query):
+    cpu_conn, tpu_conn, tpu = pair
+    r_cpu = cpu_conn.must(query)
+    r_tpu = tpu_conn.must(query)
+    assert r_cpu.columns == r_tpu.columns
+    assert sorted(map(repr, r_cpu.rows)) == sorted(map(repr, r_tpu.rows)), \
+        f"result divergence for: {query}"
+
+
+def test_device_actually_served(pair):
+    cpu_conn, tpu_conn, tpu = pair
+    before = tpu.stats["go_served"]
+    tpu_conn.must("GO FROM 100 OVER like")
+    assert tpu.stats["go_served"] == before + 1
+    before_p = tpu.stats["path_served"]
+    tpu_conn.must("FIND SHORTEST PATH FROM 100 TO 102 OVER like UPTO 4 STEPS")
+    assert tpu.stats["path_served"] == before_p + 1
+
+
+def test_snapshot_rebuilds_after_mutation(pair):
+    cpu_conn, tpu_conn, tpu = pair
+    rebuilds = tpu.stats["rebuilds"]
+    tpu_conn.must('INSERT VERTEX player(name, age) VALUES 500:("Newbie", 20)')
+    tpu_conn.must('INSERT EDGE like(likeness) VALUES 100 -> 500:(88.0)')
+    r = tpu_conn.must("GO FROM 100 OVER like YIELD like._dst AS id")
+    assert (500,) in r.rows
+    assert tpu.stats["rebuilds"] > rebuilds
+    # and unchanged data stays cached
+    rebuilds = tpu.stats["rebuilds"]
+    tpu_conn.must("GO FROM 100 OVER like")
+    assert tpu.stats["rebuilds"] == rebuilds
+    # clean up for other tests in this module
+    tpu_conn.must("DELETE VERTEX 500")
+    cpu_conn.must("GO FROM 100 OVER like")  # keep cpu side warm/symmetric
+
+
+def test_input_ref_falls_back_to_cpu(pair):
+    cpu_conn, tpu_conn, tpu = pair
+    q = ("GO FROM 100 OVER like YIELD like._dst AS id, like.likeness AS w | "
+         "GO FROM $-.id OVER like YIELD $-.w AS base, like.likeness AS w2")
+    r_cpu = cpu_conn.must(q)
+    r_tpu = tpu_conn.must(q)
+    assert sorted(r_cpu.rows) == sorted(r_tpu.rows)
+
+
+def test_string_filter_on_device(pair):
+    cpu_conn, tpu_conn, tpu = pair
+    q = ('GO FROM 100, 101, 102 OVER serve WHERE $$.team.name == "Spurs" '
+         'YIELD serve._dst, serve.start_year')
+    r_cpu = cpu_conn.must(q)
+    before = tpu.stats["go_served"]
+    r_tpu = tpu_conn.must(q)
+    assert tpu.stats["go_served"] == before + 1
+    assert sorted(r_cpu.rows) == sorted(r_tpu.rows)
+
+
+def test_csr_snapshot_shapes():
+    tpu = TpuGraphEngine()
+    cluster = InProcCluster(tpu_engine=tpu)
+    _, conn = load_nba(cluster, space="mini", parts=3)
+    space_id = cluster.meta.get_space("mini").value().space_id
+    snap = tpu.snapshot(space_id)
+    assert snap.num_parts == 3
+    assert snap.cap_v % 128 == 0 and snap.cap_e % 128 == 0
+    # every inserted edge appears twice (out + reverse copy)
+    from nba_fixture import LIKES, SERVES
+    assert snap.total_edges == 2 * (len(LIKES) + len(SERVES))
+    # locate round-trips
+    for vid in (100, 204, 121):
+        p, local = snap.locate(vid)
+        assert int(snap.shards[p].vids[local]) == vid
+    assert snap.locate(99999) is None
