@@ -104,6 +104,8 @@ class CsrSnapshot:
         self.d_edge_valid = jnp.asarray(np.stack([s.edge_valid for s in shards]))
         self.total_edges = int(sum(s.num_edges for s in shards))
         self._device_prop_cache: Dict[Tuple, Any] = {}
+        # global string dictionaries: (kind 'e'|'t', prop name) -> {str: code}
+        self.str_dicts: Dict[Tuple[str, str], Dict[str, int]] = {}
 
     # ------------------------------------------------------------------
     def locate(self, vid: int) -> Optional[Tuple[int, int]]:
@@ -120,52 +122,48 @@ class CsrSnapshot:
                 f[loc[0], loc[1]] = True
         return f
 
-    def device_edge_prop(self, etype: int, name: str):
-        """Stacked [P, cap_e] device array for a filterable edge prop,
-        or None if the column can't live on device."""
+    def _device_prop(self, kind: str, sid: int, name: str, cap: int):
+        """Stacked [P, cap] device array for a filterable prop; shards
+        without the column contribute an all-absent zero block (their
+        presence masks are False there). None only when a shard that HAS
+        the column can't host it on device (e.g. out-of-range ints)."""
         import jax.numpy as jnp
-        key = ("e", etype, name)
+        key = (kind, sid, name)
         if key in self._device_prop_cache:
             return self._device_prop_cache[key]
         cols = []
+        dtype = None
         for s in self.shards:
-            col = s.edge_props.get(etype, {}).get(name)
-            if col is None or not col.device_ok:
+            props = (s.edge_props if kind == "e" else s.tag_props)
+            col = props.get(sid, {}).get(name)
+            if col is None:
+                cols.append(None)
+                continue
+            if not col.device_ok:
                 self._device_prop_cache[key] = None
                 return None
+            dtype = col.device_vals.dtype
             cols.append(col.device_vals)
-        out = jnp.asarray(np.stack(cols))
+        if dtype is None:
+            self._device_prop_cache[key] = None
+            return None
+        filled = [c if c is not None else np.zeros(cap, dtype) for c in cols]
+        out = jnp.asarray(np.stack(filled))
         self._device_prop_cache[key] = out
         return out
+
+    def device_edge_prop(self, etype: int, name: str):
+        return self._device_prop("e", etype, name, self.cap_e)
 
     def device_tag_prop(self, tag_id: int, name: str):
-        import jax.numpy as jnp
-        key = ("t", tag_id, name)
-        if key in self._device_prop_cache:
-            return self._device_prop_cache[key]
-        cols = []
-        for s in self.shards:
-            col = s.tag_props.get(tag_id, {}).get(name)
-            if col is None or not col.device_ok:
-                self._device_prop_cache[key] = None
-                return None
-            cols.append(col.device_vals)
-        out = jnp.asarray(np.stack(cols))
-        self._device_prop_cache[key] = out
-        return out
+        return self._device_prop("t", tag_id, name, self.cap_v)
 
-    def str_code(self, etype_or_tag: Tuple[str, int], name: str,
-                 value: str) -> Optional[int]:
+    def str_code(self, kind: str, name: str, value: str) -> int:
         """Dictionary code of a string constant for device equality
-        filters; -1 if the string never occurs (matches nothing)."""
-        kind, sid = etype_or_tag
-        for s in self.shards:
-            props = (s.edge_props if kind == "e" else s.tag_props).get(sid, {})
-            col = props.get(name)
-            if col is not None and col.str_dict is not None:
-                if value in col.str_dict:
-                    return col.str_dict[value]
-        return -1
+        filters; -1 if the string never occurs (matches nothing).
+        Dictionaries are global per (kind, prop) across all shards and
+        schema ids, so one code means one string everywhere."""
+        return self.str_dicts.get((kind, name), {}).get(value, -1)
 
 
 # ---------------------------------------------------------------------------
@@ -231,9 +229,10 @@ def build_snapshot(store, sm, space_id: int, num_parts: int) -> CsrSnapshot:
         return r.value() if r.ok() else None
 
     shards: List[CsrShard] = []
-    # string dictionaries must be GLOBAL across shards so a code compares
-    # equal on every device partition: (kind, schema id, field) -> dict
-    dict_registry: Dict[Tuple[str, int, str], Dict[str, int]] = {}
+    # string dictionaries must be GLOBAL across shards AND schema ids so
+    # a code identifies one string everywhere a prop of that name is
+    # merged into a single device column: (kind, prop name) -> dict
+    dict_registry: Dict[Tuple[str, str], Dict[str, int]] = {}
     for p0 in range(num_parts):
         vids_sorted = np.array(sorted(per_part_vids[p0]), dtype=np.int64)
         vid_to_local = {int(v): i for i, v in enumerate(vids_sorted)}
@@ -281,29 +280,31 @@ def build_snapshot(store, sm, space_id: int, num_parts: int) -> CsrSnapshot:
             if schema is None or not schema.fields:
                 continue
             cols = _build_columns(schema, cap_e, idx_rows, now,
-                                  dict_registry, ("e", et))
+                                  dict_registry, ("e",))
             if cols:
                 s.edge_props[et] = cols
-        # vertex tag props
-        for tag_id in sm.all_tag_ids(space_id):
+        # vertex tag props: ONE scan per partition, bucketed by tag id
+        rows_by_tag: Dict[int, List[Tuple[int, bytes]]] = {}
+        for (part, vid, tag, ver), v in _decode_rows_newest(
+                engine, ku.part_data_prefix(s.part_id, ku.KIND_VERTEX),
+                group_of=lambda f: (f[1], f[2]),
+                parse_key=ku.parse_vertex_key):
+            if vid in s.vid_to_local:
+                rows_by_tag.setdefault(tag, []).append((s.vid_to_local[vid], v))
+        for tag_id, tag_rows in rows_by_tag.items():
             sr = sm.tag_schema(space_id, tag_id)
             if not sr.ok() or not sr.value().fields:
                 continue
             schema = sr.value()
-            idx_rows = []
-            for (part, vid, tag, ver), v in _decode_rows_newest(
-                    engine, ku.part_data_prefix(s.part_id, ku.KIND_VERTEX),
-                    group_of=lambda f: (f[1], f[2]),
-                    parse_key=ku.parse_vertex_key):
-                if tag == tag_id and vid in s.vid_to_local:
-                    idx_rows.append((s.vid_to_local[vid], v))
-            if idx_rows:
-                cols = _build_columns(schema, cap_v, idx_rows, now,
-                                      dict_registry, ("t", tag_id))
+            if tag_rows:
+                cols = _build_columns(schema, cap_v, tag_rows, now,
+                                      dict_registry, ("t",))
                 if cols:
                     s.tag_props[tag_id] = cols
 
-    return CsrSnapshot(space_id, shards, cap_v, cap_e, write_version)
+    snap = CsrSnapshot(space_id, shards, cap_v, cap_e, write_version)
+    snap.str_dicts = dict_registry
+    return snap
 
 
 _I32_MIN, _I32_MAX = -(1 << 31), (1 << 31) - 1

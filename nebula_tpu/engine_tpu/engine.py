@@ -85,7 +85,9 @@ class TpuGraphEngine:
                  and getattr(snap, "catalog_version", -1) == self._catalog_version())
         if fresh:
             return snap
-        if not self.auto_refresh and snap is None:
+        if not self.auto_refresh:
+            # operator controls rebuild timing; a stale snapshot must not
+            # serve (results would be wrong) — decline so CPU path runs
             return None
         return self.refresh(space_id)
 
@@ -100,6 +102,10 @@ class TpuGraphEngine:
             exprs.append(s.where.filter)
         if _uses_input_refs(exprs):
             return False  # $-/$var back-references need CPU root tracking
+        if s.step.upto:
+            # UPTO emits one row per (edge, step); the device union mask
+            # loses that multiplicity — CPU path serves it exactly
+            return False
         return True
 
     def can_serve_path(self, space_id: int, s: ast.FindPathSentence) -> bool:
@@ -141,14 +147,9 @@ class TpuGraphEngine:
             if device_mask is None:
                 local_filter = s.where.filter
 
-        if s.step.upto:
-            active = traverse.multi_hop_upto(
-                f0, s.step.steps, snap.d_edge_src, snap.d_edge_gidx,
-                snap.d_edge_etype, snap.d_edge_valid, req)
-        else:
-            _, active = traverse.multi_hop(
-                f0, s.step.steps, snap.d_edge_src, snap.d_edge_gidx,
-                snap.d_edge_etype, snap.d_edge_valid, req)
+        _, active = traverse.multi_hop(
+            f0, s.step.steps, snap.d_edge_src, snap.d_edge_gidx,
+            snap.d_edge_etype, snap.d_edge_valid, req)
         if device_mask is not None:
             active = active & device_mask
         mask = np.asarray(active)
@@ -197,8 +198,6 @@ class TpuGraphEngine:
                         if props is not None:
                             vd.tag_props[tid] = props
                     per_vertex[src_vid] = vd
-                elif src_tag_reqs and not vd.tag_props:
-                    pass
                 props = _host_edge_props(shard, et, i)
                 vd.edges.append(EdgeData(src_vid, et,
                                          int(shard.edge_rank[i]),

@@ -72,15 +72,19 @@ class FilterCompiler:
             return None
 
     # ------------------------------------------------------------------
-    def _edge_prop_val(self, prop: str) -> _Val:
-        """Value of an edge prop across all requested edge types,
-        selected per edge by its stored etype."""
+    def _edge_prop_val(self, prop: str,
+                       allowed_types: Optional[List[int]] = None) -> _Val:
+        """Value of an edge prop, selected per edge by its stored etype.
+
+        `allowed_types` restricts which edge types the reference is
+        valid for (a qualified `e1.prop` must evaluate as absent on
+        edges of other types, mirroring the CPU path's EvalError)."""
         snap = self.snap
+        types = allowed_types if allowed_types is not None else self.edge_types
         acc = None
         present = jnp.zeros(snap.d_edge_etype.shape, dtype=bool)
         is_string = None
-        str_meta = None
-        for et in self.edge_types:
+        for et in types:
             col = snap.device_edge_prop(et, prop)
             if col is None:
                 continue
@@ -88,8 +92,6 @@ class FilterCompiler:
             col_is_string = self._edge_prop_type(et, prop) == PropType.STRING
             if is_string is None:
                 is_string = col_is_string
-                if col_is_string:
-                    str_meta = ("e", et, prop)
             elif is_string != col_is_string:
                 raise _Unsupported()
             sel = snap.d_edge_etype == et
@@ -103,7 +105,7 @@ class FilterCompiler:
         if acc is None:
             raise _Unsupported()
         if is_string:
-            return _Val("strcode", acc, present, str_meta)
+            return _Val("strcode", acc, present, ("e", prop))
         if acc.dtype == jnp.bool_:
             return _Val("bool", acc, present)
         return _Val("num", acc, present)
@@ -140,7 +142,7 @@ class FilterCompiler:
         pres = jnp.take_along_axis(jnp.asarray(pres_np),
                                    self.snap.d_edge_src, axis=1)
         if ptype == PropType.STRING:
-            return _Val("strcode", vals, pres, ("t", tid, prop))
+            return _Val("strcode", vals, pres, ("t", prop))
         if col.dtype == jnp.bool_:
             return _Val("bool", vals, pres)
         return _Val("num", vals, pres)
@@ -166,7 +168,7 @@ class FilterCompiler:
         vals = flat[self.snap.d_edge_gidx]
         pres = flat_p[self.snap.d_edge_gidx]
         if ptype == PropType.STRING:
-            return _Val("strcode", vals, pres, ("t", tid, prop))
+            return _Val("strcode", vals, pres, ("t", prop))
         if col.dtype == jnp.bool_:
             return _Val("bool", vals, pres)
         return _Val("num", vals, pres)
@@ -183,13 +185,14 @@ class FilterCompiler:
                 return _Val("strlit", v, None)
             raise _Unsupported()
         if isinstance(e, EdgePropExpr):
+            allowed = None
             if e.edge is not None:
                 canon = self.alias_map.get(e.edge, e.edge)
-                in_scope = any(self.name_by_type.get(abs(t)) == canon
-                               for t in self.edge_types)
-                if not in_scope:
+                allowed = [t for t in self.edge_types
+                           if self.name_by_type.get(abs(t)) == canon]
+                if not allowed:
                     raise _Unsupported()
-            return self._edge_prop_val(e.prop)
+            return self._edge_prop_val(e.prop, allowed)
         if isinstance(e, SourcePropExpr):
             return self._src_prop_val(e.tag, e.prop)
         if isinstance(e, DestPropExpr):
@@ -232,8 +235,8 @@ class FilterCompiler:
                 code_side, lit_side = (l, r) if l.kind == "strcode" else (r, l)
                 if lit_side.kind != "strlit":
                     raise _Unsupported()
-                kind, sid, prop = code_side.str_meta
-                code = self.snap.str_code((kind, sid), prop, lit_side.value)
+                kind, prop = code_side.str_meta
+                code = self.snap.str_code(kind, prop, lit_side.value)
                 m = code_side.value == code
                 if e.op == "!=":
                     m = ~m

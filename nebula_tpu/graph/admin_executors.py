@@ -253,7 +253,11 @@ def execute_grant(ctx: ExecContext, s: ast.GrantSentence) -> Result:
     # checked against the TARGET space; granted role must be strictly
     # below the granter's own rank there (only GOD can mint ADMIN/GOD)
     rank = _caller_rank_in(ctx, space_id)
-    if rank < _ROLE_RANK["ADMIN"] or _ROLE_RANK.get(s.role, 5) >= rank:
+    # GOD may grant any role (incl. GOD); others only roles strictly below
+    allowed = (rank == _ROLE_RANK["GOD"]
+               or (rank >= _ROLE_RANK["ADMIN"]
+                   and _ROLE_RANK.get(s.role, 5) < rank))
+    if not allowed:
         return _err(ErrorCode.E_BAD_PERMISSION,
                     f"granting {s.role} on {s.space} requires a higher role there")
     st = ctx.meta.grant_role(space_id, s.user, s.role)
@@ -267,7 +271,10 @@ def execute_revoke(ctx: ExecContext, s: ast.RevokeSentence) -> Result:
     space_id = r.value().space_id
     rank = _caller_rank_in(ctx, space_id)
     current = ctx.meta.get_role(space_id, s.user)
-    if rank < _ROLE_RANK["ADMIN"] or _ROLE_RANK.get(current, 0) >= rank:
+    allowed = (rank == _ROLE_RANK["GOD"]
+               or (rank >= _ROLE_RANK["ADMIN"]
+                   and _ROLE_RANK.get(current, 0) < rank))
+    if not allowed:
         return _err(ErrorCode.E_BAD_PERMISSION,
                     f"revoking {current} on {s.space} requires a higher role there")
     st = ctx.meta.revoke_role(space_id, s.user)
