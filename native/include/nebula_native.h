@@ -1,0 +1,71 @@
+/* C ABI for the native runtime components.
+ *
+ * Role parity with the reference's native (C++) layer: the write-ahead
+ * log (ref kvstore/wal/FileBasedWal.{h,cpp}), and — in later additions —
+ * the KV engine and codec hot paths. Python binds via ctypes; everything
+ * crossing this boundary is plain C types.
+ */
+#ifndef NEBULA_NATIVE_H
+#define NEBULA_NATIVE_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* ---------------------------------------------------------------- WAL */
+
+typedef struct nwal nwal;
+typedef struct nwal_iter nwal_iter;
+
+/* Open (creating dir if needed) a segmented WAL.
+ * ttl_secs: sealed segments older than this are eligible for clean_ttl.
+ * max_file_size: segment roll threshold in bytes.
+ * sync_every_append: fsync after each append (slow, durable). */
+nwal *nwal_open(const char *dir, int64_t ttl_secs, int64_t max_file_size,
+                int32_t sync_every_append);
+void nwal_close(nwal *w);
+
+int64_t nwal_first_log_id(nwal *w);
+int64_t nwal_last_log_id(nwal *w);
+int64_t nwal_last_log_term(nwal *w);
+/* Term of an arbitrary retained log id; -1 if unknown/evicted. */
+int64_t nwal_log_term(nwal *w, int64_t log_id);
+
+/* Append one record. log_id must be last_log_id+1 (or anything when
+ * empty). Returns 0 on success, negative error code otherwise. */
+int32_t nwal_append(nwal *w, int64_t log_id, int64_t term, int64_t cluster,
+                    const uint8_t *data, int64_t len);
+
+/* Drop every log with id > keep_to (term-conflict rollback,
+ * ref FileBasedWal rollbackToLog). Returns 0 on success. */
+int32_t nwal_rollback(nwal *w, int64_t keep_to);
+
+/* Delete all segments and reset to empty. */
+int32_t nwal_reset(nwal *w);
+
+/* Delete sealed segments whose newest record is older than ttl
+ * (never the active segment). Returns number of files removed. */
+int32_t nwal_clean_ttl(nwal *w);
+
+/* Force an fsync of the active segment. */
+int32_t nwal_sync(nwal *w);
+
+/* Iterator over [from, to] inclusive; to < 0 means "through last". */
+nwal_iter *nwal_iter_new(nwal *w, int64_t from, int64_t to);
+int32_t nwal_iter_valid(nwal_iter *it);
+int64_t nwal_iter_log_id(nwal_iter *it);
+int64_t nwal_iter_term(nwal_iter *it);
+int64_t nwal_iter_cluster(nwal_iter *it);
+/* Returns payload length and sets *out to an internal buffer valid until
+ * the next iterator call. */
+int64_t nwal_iter_data(nwal_iter *it, const uint8_t **out);
+void nwal_iter_next(nwal_iter *it);
+void nwal_iter_free(nwal_iter *it);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* NEBULA_NATIVE_H */

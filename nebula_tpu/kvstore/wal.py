@@ -1,0 +1,109 @@
+"""Write-ahead log over the native C++ segmented WAL.
+
+Role parity with the reference's `kvstore/wal/FileBasedWal.{h,cpp}`:
+raft appends here before replication, followers replay from here after
+restart, and term conflicts roll the tail back. The heavy lifting
+(segment files, CRC validation, torn-tail truncation, the in-memory
+record index) is the native library (`native/src/wal.cc`); this wrapper
+owns lifetime and exposes a Pythonic iterator.
+"""
+from __future__ import annotations
+
+import ctypes
+import threading
+from dataclasses import dataclass
+from typing import Iterator, Optional
+
+from .. import native
+
+
+@dataclass(frozen=True)
+class LogEntry:
+    log_id: int
+    term: int
+    cluster: int
+    data: bytes
+
+
+class Wal:
+    """One WAL instance per raft part (dir is per space/part)."""
+
+    def __init__(self, dir_path: str, ttl_secs: int = 86400,
+                 max_file_size: int = 16 * 1024 * 1024,
+                 sync_every_append: bool = False):
+        self._lib = native.load()
+        self._h = self._lib.nwal_open(
+            dir_path.encode(), ttl_secs, max_file_size,
+            1 if sync_every_append else 0)
+        if not self._h:
+            raise OSError(f"cannot open WAL at {dir_path}")
+        self._lock = threading.Lock()
+        self._closed = False
+
+    # ------------------------------------------------------------------
+    @property
+    def first_log_id(self) -> int:
+        return self._lib.nwal_first_log_id(self._h)
+
+    @property
+    def last_log_id(self) -> int:
+        return self._lib.nwal_last_log_id(self._h)
+
+    @property
+    def last_log_term(self) -> int:
+        return self._lib.nwal_last_log_term(self._h)
+
+    def log_term(self, log_id: int) -> Optional[int]:
+        t = self._lib.nwal_log_term(self._h, log_id)
+        return None if t < 0 else t
+
+    def append(self, log_id: int, term: int, cluster: int,
+               data: bytes) -> bool:
+        with self._lock:
+            rc = self._lib.nwal_append(self._h, log_id, term, cluster,
+                                       data, len(data))
+        return rc == 0
+
+    def rollback(self, keep_to: int) -> bool:
+        """Drop every log with id > keep_to (term conflict)."""
+        with self._lock:
+            return self._lib.nwal_rollback(self._h, keep_to) == 0
+
+    def reset(self) -> None:
+        with self._lock:
+            self._lib.nwal_reset(self._h)
+
+    def clean_ttl(self) -> int:
+        with self._lock:
+            return self._lib.nwal_clean_ttl(self._h)
+
+    def sync(self) -> None:
+        self._lib.nwal_sync(self._h)
+
+    def iterate(self, from_id: int, to_id: int = -1) -> Iterator[LogEntry]:
+        """Yield entries in [from_id, to_id] (to_id<0 → through last)."""
+        it = self._lib.nwal_iter_new(self._h, from_id, to_id)
+        try:
+            while self._lib.nwal_iter_valid(it):
+                out = ctypes.POINTER(ctypes.c_uint8)()
+                n = self._lib.nwal_iter_data(it, ctypes.byref(out))
+                data = ctypes.string_at(out, n) if n else b""
+                yield LogEntry(self._lib.nwal_iter_log_id(it),
+                               self._lib.nwal_iter_term(it),
+                               self._lib.nwal_iter_cluster(it),
+                               data)
+                self._lib.nwal_iter_next(it)
+        finally:
+            self._lib.nwal_iter_free(it)
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._closed:
+                self._lib.nwal_close(self._h)
+                self._closed = True
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,106 @@
+"""ctypes bindings for the native C++ runtime library.
+
+The native layer holds the components the reference implements in C++
+below the Python-visible seams: the segmented WAL (ref
+kvstore/wal/FileBasedWal.{h,cpp}) and, as it grows, the KV engine and
+codec hot paths. The library is built on demand from `native/` with the
+system toolchain and cached; call `load()` to get the bound CDLL or
+raise if the toolchain is unavailable.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_NATIVE_DIR = os.path.join(_REPO, "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libnebula_native.so")
+
+_lock = threading.Lock()
+_lib = None
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _needs_build() -> bool:
+    if not os.path.exists(_LIB_PATH):
+        return True
+    lib_mtime = os.path.getmtime(_LIB_PATH)
+    for sub in ("src", "include"):
+        d = os.path.join(_NATIVE_DIR, sub)
+        for name in os.listdir(d):
+            if os.path.getmtime(os.path.join(d, name)) > lib_mtime:
+                return True
+    return False
+
+
+def _build() -> None:
+    proc = subprocess.run(
+        ["make", "-C", _NATIVE_DIR, "-j4"],
+        capture_output=True, text=True)
+    if proc.returncode != 0:
+        raise NativeBuildError(
+            f"native build failed:\n{proc.stdout}\n{proc.stderr}")
+
+
+def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
+    i64, i32, u8p = ctypes.c_int64, ctypes.c_int32, ctypes.POINTER(ctypes.c_uint8)
+    vp = ctypes.c_void_p
+
+    lib.nwal_open.restype = vp
+    lib.nwal_open.argtypes = [ctypes.c_char_p, i64, i64, i32]
+    lib.nwal_close.restype = None
+    lib.nwal_close.argtypes = [vp]
+    for fn in ("nwal_first_log_id", "nwal_last_log_id", "nwal_last_log_term"):
+        getattr(lib, fn).restype = i64
+        getattr(lib, fn).argtypes = [vp]
+    lib.nwal_log_term.restype = i64
+    lib.nwal_log_term.argtypes = [vp, i64]
+    lib.nwal_append.restype = i32
+    lib.nwal_append.argtypes = [vp, i64, i64, i64, ctypes.c_char_p, i64]
+    lib.nwal_rollback.restype = i32
+    lib.nwal_rollback.argtypes = [vp, i64]
+    lib.nwal_reset.restype = i32
+    lib.nwal_reset.argtypes = [vp]
+    lib.nwal_clean_ttl.restype = i32
+    lib.nwal_clean_ttl.argtypes = [vp]
+    lib.nwal_sync.restype = i32
+    lib.nwal_sync.argtypes = [vp]
+
+    lib.nwal_iter_new.restype = vp
+    lib.nwal_iter_new.argtypes = [vp, i64, i64]
+    lib.nwal_iter_valid.restype = i32
+    lib.nwal_iter_valid.argtypes = [vp]
+    for fn in ("nwal_iter_log_id", "nwal_iter_term", "nwal_iter_cluster"):
+        getattr(lib, fn).restype = i64
+        getattr(lib, fn).argtypes = [vp]
+    lib.nwal_iter_data.restype = i64
+    lib.nwal_iter_data.argtypes = [vp, ctypes.POINTER(u8p)]
+    lib.nwal_iter_next.restype = None
+    lib.nwal_iter_next.argtypes = [vp]
+    lib.nwal_iter_free.restype = None
+    lib.nwal_iter_free.argtypes = [vp]
+    return lib
+
+
+def load() -> ctypes.CDLL:
+    """Build (if stale) and load the native library. Thread-safe."""
+    global _lib
+    with _lock:
+        if _lib is None:
+            if _needs_build():
+                _build()
+            _lib = _bind(ctypes.CDLL(_LIB_PATH))
+        return _lib
+
+
+def available() -> bool:
+    try:
+        load()
+        return True
+    except (NativeBuildError, OSError):
+        return False
