@@ -1,0 +1,15 @@
+"""Raft consensus (ref kvstore/raftex/): RaftPart + Host + RaftexService
+over a pluggable transport, with WAL-backed logs and snapshot transfer."""
+from .types import (AppendLogRequest, AppendLogResponse, AskForVoteRequest,
+                    AskForVoteResponse, LogRecord, LogType, RaftCode, Role,
+                    SendSnapshotRequest, SendSnapshotResponse)
+from .service import InProcNetwork, RaftexService, Transport
+from .host import Host
+from .raft_part import RaftPart
+
+__all__ = [
+    "AppendLogRequest", "AppendLogResponse", "AskForVoteRequest",
+    "AskForVoteResponse", "LogRecord", "LogType", "RaftCode", "Role",
+    "SendSnapshotRequest", "SendSnapshotResponse",
+    "InProcNetwork", "RaftexService", "Transport", "Host", "RaftPart",
+]

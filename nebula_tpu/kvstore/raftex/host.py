@@ -1,0 +1,45 @@
+"""Per-peer replication progress.
+
+Role parity with the reference's `kvstore/raftex/Host.cpp`: tracks how
+far each follower has acknowledged, resolves log gaps by backing the
+send cursor up to the follower's actual last log id, and flags when the
+follower is so far behind that the leader's WAL no longer holds the
+needed logs — the trigger for snapshot transfer (ref Host.cpp:409).
+"""
+from __future__ import annotations
+
+import threading
+
+
+class Host:
+    def __init__(self, addr: str, is_learner: bool = False):
+        self.addr = addr
+        self.is_learner = is_learner
+        # next log id to send; match = highest id known replicated
+        self.next_id = 1
+        self.match_id = 0
+        self.sending_snapshot = False
+        self.paused = False
+        self._lock = threading.Lock()
+
+    def reset_for_leader(self, last_log_id: int) -> None:
+        with self._lock:
+            self.next_id = last_log_id + 1
+            self.match_id = 0
+            self.sending_snapshot = False
+
+    def on_success(self, last_sent: int) -> None:
+        with self._lock:
+            self.match_id = max(self.match_id, last_sent)
+            self.next_id = self.match_id + 1
+
+    def on_gap(self, follower_last: int) -> None:
+        """Follower is behind/conflicting: back up to just past its
+        actual tail (ref Host.cpp:181-330 gap resolution)."""
+        with self._lock:
+            self.next_id = max(1, follower_last + 1)
+            self.match_id = min(self.match_id, follower_last)
+
+    def __repr__(self):
+        return (f"Host({self.addr}, next={self.next_id}, "
+                f"match={self.match_id}, learner={self.is_learner})")

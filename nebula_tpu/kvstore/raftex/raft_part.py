@@ -1,0 +1,677 @@
+"""Raft consensus state machine driver.
+
+Role parity with the reference's `kvstore/raftex/RaftPart.{h,cpp}`:
+ - roles LEADER/FOLLOWER/CANDIDATE/LEARNER (RaftPart.h:272-278)
+ - log types NORMAL/ATOMIC_OP/COMMAND (RaftPart.h:48-60)
+ - batched async appends: callers append to the leader's WAL under the
+   serialization lock and get a future; a single replicator round ships
+   everything new to every peer at once, so concurrent writers coalesce
+   into one round exactly like the reference's PromiseSet buffering
+   (RaftPart.h:381-455)
+ - election with randomized timeout (RaftPart.cpp:1040,1148-1182)
+ - follower append path with gap/stale/term-conflict handling and WAL
+   rollback (RaftPart.cpp:1327, verifyLeader :1513)
+ - membership COMMAND logs (add/remove peer, add learner, transfer
+   leader) applied at append time, mirroring preProcessLog
+   (kvstore/Part.cpp:358-417)
+ - snapshot transfer when a follower is behind the leader's WAL head
+   (SnapshotManager.cpp:20-120, receive at RaftPart.cpp:1601)
+
+Commit rule: advance to the median match index, but only once a log of
+the current term is committed (the term-start noop guarantees progress),
+per the Raft safety argument.
+
+The state machine seam is three callbacks (on_commit / on_snapshot /
+snapshot_rows), matching the reference's commitLogs / commitSnapshot /
+accessAllRowsInSnapshot virtuals (RaftPart.h:241-252).
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from concurrent.futures import Future
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..wal import Wal
+from .host import Host
+from .service import RaftexService, Transport
+from .types import (AppendLogRequest, AppendLogResponse, AskForVoteRequest,
+                    AskForVoteResponse, LogRecord, LogType, RaftCode, Role,
+                    SendSnapshotRequest, SendSnapshotResponse)
+
+# WAL payload = 1-byte log-type marker + payload. COMMAND payloads are
+# raft-owned (membership/leader-transfer), NORMAL payloads belong to the
+# state machine.
+_M_NORMAL = b"\x00"
+_M_COMMAND = b"\x02"
+
+# COMMAND opcodes (raft-internal encoding)
+CMD_ADD_LEARNER = 1
+CMD_ADD_PEER = 2
+CMD_REMOVE_PEER = 3
+CMD_TRANS_LEADER = 4
+
+SNAPSHOT_CHUNK_ROWS = 1024
+
+
+def _encode_cmd(op: int, addr: str) -> bytes:
+    return bytes([op]) + addr.encode()
+
+
+def _decode_cmd(data: bytes) -> Tuple[int, str]:
+    return data[0], data[1:].decode()
+
+
+class RaftPart:
+    def __init__(self, space_id: int, part_id: int, addr: str,
+                 peers: List[str], wal_dir: str,
+                 service: RaftexService,
+                 on_commit: Callable[[List[Tuple[int, int, bytes]]], None],
+                 on_snapshot: Callable[[List[Tuple[bytes, bytes]], int, int, bool], None] = None,
+                 snapshot_rows: Callable[[], List[Tuple[bytes, bytes]]] = None,
+                 applied_id: int = 0,
+                 is_learner: bool = False,
+                 heartbeat_interval: float = 0.15,
+                 election_timeout: float = 0.45,
+                 rpc_timeout: float = 1.0,
+                 wal_ttl_secs: int = 86400,
+                 wal_file_size: int = 16 * 1024 * 1024,
+                 on_leader_change: Callable[[Optional[str]], None] = None):
+        self.space_id = space_id
+        self.part_id = part_id
+        self.addr = addr
+        self.peers = list(peers)            # voting members, includes self
+        self.learners: List[str] = []
+        self.service = service
+        self.network: Transport = service.network
+
+        self._on_commit = on_commit
+        self._on_snapshot = on_snapshot
+        self._snapshot_rows = snapshot_rows
+        self._on_leader_change = on_leader_change
+
+        self._hb = heartbeat_interval
+        self._election_timeout = election_timeout
+        self._rpc_timeout = rpc_timeout
+
+        self._lock = threading.RLock()
+        self.role = Role.LEARNER if is_learner else Role.FOLLOWER
+        self.term = 0
+        self.voted_for: Optional[str] = None
+        self.leader_addr: Optional[str] = None
+        self.committed_id = applied_id
+        self._last_msg_recv = time.monotonic()
+        self._next_election_due = self._rand_timeout()
+
+        os.makedirs(wal_dir, exist_ok=True)
+        self.wal = Wal(os.path.join(wal_dir, "wal"), ttl_secs=wal_ttl_secs,
+                       max_file_size=wal_file_size)
+        self._state_path = os.path.join(wal_dir, "raft_state")
+        self._load_state()
+
+        self._pending: Dict[int, Future] = {}   # log_id -> caller future
+        self.hosts: Dict[str, Host] = {}
+
+        self._running = True
+        self._repl_cv = threading.Condition()
+        self._repl_needed = False
+        self._last_round = 0.0
+        self._repl_thread = threading.Thread(
+            target=self._replicator_loop, daemon=True,
+            name=f"raft-repl-{space_id}-{part_id}-{addr}")
+        self._tick_thread = threading.Thread(
+            target=self._ticker_loop, daemon=True,
+            name=f"raft-tick-{space_id}-{part_id}-{addr}")
+
+        # snapshot receive state
+        self._recv_snapshot_rows = 0
+
+        service.add_part(self)
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        self._repl_thread.start()
+        self._tick_thread.start()
+
+    def stop(self) -> None:
+        with self._lock:
+            self._running = False
+            pending = list(self._pending.values())
+            self._pending.clear()
+        with self._repl_cv:
+            self._repl_cv.notify_all()
+        for f in pending:
+            if not f.done():
+                f.set_result(RaftCode.E_HOST_STOPPED)
+        self.service.remove_part(self.space_id, self.part_id)
+        self.wal.close()
+
+    # ------------------------------------------------------------------
+    # client API
+    # ------------------------------------------------------------------
+    def is_leader(self) -> bool:
+        with self._lock:
+            return self.role is Role.LEADER
+
+    def leader(self) -> Optional[str]:
+        with self._lock:
+            return self.leader_addr
+
+    def append_async(self, data: bytes) -> Future:
+        return self._append(LogType.NORMAL, data)
+
+    def atomic_op_async(self, op: Callable[[], Optional[bytes]]) -> Future:
+        """Evaluate `op` at the serialization point; commit its output
+        (ref atomicOpAsync, RaftPart.h:166-176)."""
+        fut: Future = Future()
+        with self._lock:
+            if self.role is not Role.LEADER:
+                fut.set_result(RaftCode.E_NOT_A_LEADER)
+                return fut
+            data = op()
+            if data is None:
+                fut.set_result(RaftCode.E_BAD_STATE)
+                return fut
+            return self._append_locked(LogType.NORMAL, data, fut)
+
+    def add_learner_async(self, addr: str) -> Future:
+        return self._append(LogType.COMMAND, _encode_cmd(CMD_ADD_LEARNER, addr))
+
+    def add_peer_async(self, addr: str) -> Future:
+        return self._append(LogType.COMMAND, _encode_cmd(CMD_ADD_PEER, addr))
+
+    def remove_peer_async(self, addr: str) -> Future:
+        return self._append(LogType.COMMAND, _encode_cmd(CMD_REMOVE_PEER, addr))
+
+    def transfer_leader_async(self, target: str) -> Future:
+        return self._append(LogType.COMMAND, _encode_cmd(CMD_TRANS_LEADER, target))
+
+    def _append(self, log_type: LogType, data: bytes) -> Future:
+        fut: Future = Future()
+        with self._lock:
+            if self.role is not Role.LEADER:
+                fut.set_result(RaftCode.E_NOT_A_LEADER)
+                return fut
+            return self._append_locked(log_type, data, fut)
+
+    def _append_locked(self, log_type: LogType, data: bytes,
+                       fut: Future) -> Future:
+        marker = _M_COMMAND if log_type is LogType.COMMAND else _M_NORMAL
+        log_id = self.wal.last_log_id + 1
+        if not self.wal.append(log_id, self.term, 0, marker + data):
+            fut.set_result(RaftCode.E_WAL_FAIL)
+            return fut
+        if log_type is LogType.COMMAND:
+            self._apply_command_locked(data)
+        self._pending[log_id] = fut
+        self._wake_replicator()
+        return fut
+
+    # ------------------------------------------------------------------
+    # membership commands (applied at append time on every replica,
+    # mirroring preProcessLog)
+    # ------------------------------------------------------------------
+    def _apply_command_locked(self, data: bytes) -> None:
+        op, target = _decode_cmd(data)
+        if op == CMD_ADD_LEARNER:
+            if target not in self.learners and target not in self.peers:
+                self.learners.append(target)
+            if self.role is Role.LEADER and target != self.addr and \
+                    target not in self.hosts:
+                h = Host(target, is_learner=True)
+                h.reset_for_leader(0)   # start from scratch; gap resolves
+                self.hosts[target] = h
+        elif op == CMD_ADD_PEER:
+            if target in self.learners:
+                self.learners.remove(target)
+            if target not in self.peers:
+                self.peers.append(target)
+            if self.role is Role.LEADER and target != self.addr:
+                h = self.hosts.get(target)
+                if h is None:
+                    h = Host(target)
+                    h.reset_for_leader(0)
+                    self.hosts[target] = h
+                h.is_learner = False
+            # a promoted learner becomes a follower on its own replica
+            if target == self.addr and self.role is Role.LEARNER:
+                self.role = Role.FOLLOWER
+                self._last_msg_recv = time.monotonic()
+        elif op == CMD_REMOVE_PEER:
+            if target in self.peers:
+                self.peers.remove(target)
+            self.hosts.pop(target, None)
+            if target == self.addr and self.role is Role.LEADER:
+                self._step_down_locked(self.term, None)
+        elif op == CMD_TRANS_LEADER:
+            # The designated successor campaigns immediately with a
+            # higher term; the old leader steps down when it sees the
+            # vote request (the command must replicate first, so the
+            # leader does NOT step down at append time).
+            if target == self.addr and self.role is not Role.LEADER:
+                threading.Thread(target=self._leader_election,
+                                 daemon=True).start()
+
+    # ------------------------------------------------------------------
+    # replicator: one round ships wal[next..last] to every host, then
+    # advances commit on quorum — serving appends, heartbeats and
+    # follower catch-up with a single mechanism
+    # ------------------------------------------------------------------
+    def _wake_replicator(self) -> None:
+        with self._repl_cv:
+            self._repl_needed = True
+            self._repl_cv.notify()
+
+    def _replicator_loop(self) -> None:
+        while True:
+            with self._repl_cv:
+                if not self._repl_needed:
+                    self._repl_cv.wait(timeout=self._hb / 2)
+                self._repl_needed = False
+            if not self._running:
+                return
+            with self._lock:
+                is_leader = self.role is Role.LEADER
+                behind = is_leader and (
+                    self.committed_id < self.wal.last_log_id or
+                    any(h.match_id < self.wal.last_log_id
+                        for h in self.hosts.values()))
+            if is_leader and (behind or
+                              time.monotonic() - self._last_round >= self._hb):
+                try:
+                    self._replicate_once()
+                except Exception:
+                    pass
+                self._last_round = time.monotonic()
+
+    def _replicate_once(self) -> None:
+        with self._lock:
+            if self.role is not Role.LEADER:
+                return
+            term = self.term
+            last_id = self.wal.last_log_id
+            committed = self.committed_id
+            targets = [(h, self._build_append_locked(h, committed))
+                       for h in list(self.hosts.values())]
+
+        sends = []
+        for host, req in targets:
+            if req is None:           # host needs a snapshot
+                self._maybe_send_snapshot(host)
+                continue
+            f = self.network.call(self.addr, host.addr, "append_log", req)
+            sends.append((host, req, f))
+
+        for host, req, f in sends:
+            try:
+                resp: AppendLogResponse = f.result(timeout=self._rpc_timeout)
+            except Exception:
+                continue
+            if resp.code is RaftCode.SUCCEEDED:
+                sent_last = (req.prev_log_id + len(req.entries))
+                host.on_success(sent_last)
+            elif resp.code in (RaftCode.E_LOG_GAP, RaftCode.E_LOG_STALE):
+                host.on_gap(resp.last_log_id)
+            elif resp.code is RaftCode.E_TERM_OUT_OF_DATE:
+                with self._lock:
+                    if resp.term > self.term:
+                        self._step_down_locked(resp.term, None)
+                return
+
+        self._advance_commit(term, last_id)
+
+    def _build_append_locked(self, host: Host,
+                             committed: int) -> Optional[AppendLogRequest]:
+        """Build the batch wal[host.next_id .. last], clamped to one term
+        (the per-request log_term covers every entry). None → snapshot."""
+        first = self.wal.first_log_id
+        if first > 0 and host.next_id < first:
+            return None
+        prev_id = host.next_id - 1
+        prev_term = 0
+        if prev_id > 0:
+            t = self.wal.log_term(prev_id)
+            if t is None:
+                return None          # prev evicted: snapshot
+            prev_term = t
+        entries: List[LogRecord] = []
+        log_term = 0
+        for e in self.wal.iterate(host.next_id):
+            if not entries:
+                log_term = e.term
+            elif e.term != log_term:
+                break                # keep the batch single-term
+            entries.append(LogRecord(e.cluster, e.data))
+            if len(entries) >= 256:  # ref max_batch_size
+                break
+        return AppendLogRequest(
+            space=self.space_id, part=self.part_id, term=self.term,
+            leader=self.addr, committed_log_id=committed,
+            prev_log_id=prev_id, prev_log_term=prev_term,
+            entries=entries, log_term=log_term or self.term)
+
+    def _advance_commit(self, term: int, last_id: int) -> None:
+        with self._lock:
+            if self.role is not Role.LEADER or self.term != term:
+                return
+            # median match across voting members (self counts at last_id)
+            matches = [last_id]
+            for h in self.hosts.values():
+                if not h.is_learner:
+                    matches.append(h.match_id)
+            matches.sort(reverse=True)
+            quorum = len(matches) // 2 + 1
+            candidate = matches[quorum - 1]
+            if candidate <= self.committed_id:
+                return
+            # Raft safety: only commit once a current-term log is covered
+            t = self.wal.log_term(candidate)
+            if t is not None and t != self.term:
+                return
+            self._commit_range_locked(self.committed_id + 1, candidate)
+
+    def _commit_range_locked(self, from_id: int, to_id: int) -> None:
+        batch: List[Tuple[int, int, bytes]] = []
+        for e in self.wal.iterate(from_id, to_id):
+            marker, payload = e.data[:1], e.data[1:]
+            if marker == _M_COMMAND:
+                batch.append((e.log_id, e.term, b""))   # id advances only
+            else:
+                batch.append((e.log_id, e.term, payload))
+        if batch:
+            self._on_commit(batch)
+        self.committed_id = to_id
+        done = [f for i, f in self._pending.items() if i <= to_id]
+        for i in [i for i in self._pending if i <= to_id]:
+            del self._pending[i]
+        for f in done:
+            if not f.done():
+                f.set_result(RaftCode.SUCCEEDED)
+
+    # ------------------------------------------------------------------
+    # elections
+    # ------------------------------------------------------------------
+    def _rand_timeout(self) -> float:
+        return self._election_timeout * (1.0 + random.random())
+
+    def _ticker_loop(self) -> None:
+        tick = self._hb / 4
+        while True:
+            time.sleep(tick)
+            if not self._running:
+                return
+            with self._lock:
+                role = self.role
+                idle = time.monotonic() - self._last_msg_recv
+                due = self._next_election_due
+            if role is Role.LEADER:
+                self._wake_replicator()
+            elif role in (Role.FOLLOWER, Role.CANDIDATE) and idle > due:
+                self._leader_election()
+
+    def _leader_election(self) -> None:
+        with self._lock:
+            if not self._running or self.role in (Role.LEADER, Role.LEARNER):
+                return
+            self.role = Role.CANDIDATE
+            self.term += 1
+            self.voted_for = self.addr
+            self.leader_addr = None
+            self._persist_state()
+            term = self.term
+            req = AskForVoteRequest(
+                space=self.space_id, part=self.part_id, candidate=self.addr,
+                term=term, last_log_id=self.wal.last_log_id,
+                last_log_term=self.wal.last_log_term)
+            voters = [p for p in self.peers if p != self.addr]
+            quorum = len(self.peers) // 2 + 1
+            self._last_msg_recv = time.monotonic()
+            self._next_election_due = self._rand_timeout()
+
+        votes = 1   # self
+        futs = [self.network.call(self.addr, p, "ask_for_vote", req)
+                for p in voters]
+        max_term_seen = term
+        for f in futs:
+            try:
+                resp: AskForVoteResponse = f.result(timeout=self._rpc_timeout)
+            except Exception:
+                continue
+            if resp.code is RaftCode.SUCCEEDED:
+                votes += 1
+            max_term_seen = max(max_term_seen, resp.term)
+
+        with self._lock:
+            if self.term != term or self.role is not Role.CANDIDATE:
+                return
+            if max_term_seen > term:
+                self._step_down_locked(max_term_seen, None)
+                return
+            if votes >= quorum:
+                self._become_leader_locked()
+
+    def _become_leader_locked(self) -> None:
+        self.role = Role.LEADER
+        self.leader_addr = self.addr
+        last = self.wal.last_log_id
+        self.hosts = {}
+        for p in self.peers:
+            if p != self.addr:
+                self.hosts[p] = Host(p)
+                self.hosts[p].reset_for_leader(last)
+        for l in self.learners:
+            self.hosts[l] = Host(l, is_learner=True)
+            self.hosts[l].reset_for_leader(last)
+        # term-start noop commits everything from prior terms
+        self.wal.append(last + 1, self.term, 0, _M_NORMAL)
+        if self._on_leader_change:
+            try:
+                self._on_leader_change(self.addr)
+            except Exception:
+                pass
+        self._wake_replicator()
+
+    def _step_down_locked(self, new_term: int, leader: Optional[str]) -> None:
+        was_leader = self.role is Role.LEADER
+        if self.role is not Role.LEARNER:
+            self.role = Role.FOLLOWER
+        if new_term > self.term:
+            self.term = new_term
+            self.voted_for = None
+        self.leader_addr = leader
+        self._persist_state()
+        self._last_msg_recv = time.monotonic()
+        self._next_election_due = self._rand_timeout()
+        if was_leader:
+            pending = list(self._pending.values())
+            self._pending.clear()
+            for f in pending:
+                if not f.done():
+                    f.set_result(RaftCode.E_NOT_A_LEADER)
+            if self._on_leader_change:
+                try:
+                    self._on_leader_change(leader)
+                except Exception:
+                    pass
+
+    # ------------------------------------------------------------------
+    # message handlers (called by RaftexService on transport threads)
+    # ------------------------------------------------------------------
+    def process_ask_for_vote(self, req: AskForVoteRequest) -> AskForVoteResponse:
+        with self._lock:
+            if req.term < self.term:
+                return AskForVoteResponse(RaftCode.E_TERM_OUT_OF_DATE, self.term)
+            if req.term > self.term:
+                self._step_down_locked(req.term, None)
+            if self.voted_for is not None and self.voted_for != req.candidate:
+                return AskForVoteResponse(RaftCode.E_TERM_OUT_OF_DATE, self.term)
+            # candidate's log must be at least as up-to-date as ours
+            my_last_term = self.wal.last_log_term
+            my_last_id = self.wal.last_log_id
+            if (req.last_log_term, req.last_log_id) < (my_last_term, my_last_id):
+                return AskForVoteResponse(RaftCode.E_LOG_STALE, self.term)
+            self.voted_for = req.candidate
+            self._persist_state()
+            self._last_msg_recv = time.monotonic()
+            self._next_election_due = self._rand_timeout()
+            return AskForVoteResponse(RaftCode.SUCCEEDED, self.term)
+
+    def process_append_log(self, req: AppendLogRequest) -> AppendLogResponse:
+        with self._lock:
+            if req.term < self.term:
+                return self._append_resp_locked(RaftCode.E_TERM_OUT_OF_DATE)
+            if req.term > self.term or self.role is Role.CANDIDATE or \
+                    (self.role is Role.LEADER and req.leader != self.addr):
+                self._step_down_locked(req.term, req.leader)
+            self.leader_addr = req.leader
+            self._last_msg_recv = time.monotonic()
+            self._next_election_due = self._rand_timeout()
+
+            wal_last = self.wal.last_log_id
+            # gap: we don't yet have the log preceding this batch
+            if req.prev_log_id > wal_last:
+                return self._append_resp_locked(RaftCode.E_LOG_GAP)
+            # consistency check on the attach point
+            if req.prev_log_id > 0:
+                t = self.wal.log_term(req.prev_log_id)
+                if t is None:
+                    # evicted by snapshot: fine iff at/before our commit
+                    if req.prev_log_id > self.committed_id:
+                        return self._append_resp_locked(RaftCode.E_LOG_GAP)
+                elif t != req.prev_log_term:
+                    # conflicting history: drop our tail, ask for resend
+                    self.wal.rollback(max(self.committed_id,
+                                          req.prev_log_id - 1))
+                    return self._append_resp_locked(RaftCode.E_LOG_GAP)
+
+            # append entries, skipping overlap and truncating conflicts
+            next_id = req.prev_log_id + 1
+            for i, rec in enumerate(req.entries):
+                lid = next_id + i
+                if lid <= self.wal.last_log_id:
+                    if self.wal.log_term(lid) == req.log_term:
+                        continue     # already have it
+                    self.wal.rollback(max(self.committed_id, lid - 1))
+                if not self.wal.append(lid, req.log_term, rec.cluster,
+                                       rec.data):
+                    return self._append_resp_locked(RaftCode.E_WAL_FAIL)
+                if rec.data[:1] == _M_COMMAND:
+                    self._apply_command_locked(rec.data[1:])
+
+            # advance commit to what the leader has committed
+            new_commit = min(req.committed_log_id, self.wal.last_log_id)
+            if new_commit > self.committed_id:
+                self._commit_range_locked(self.committed_id + 1, new_commit)
+            return self._append_resp_locked(RaftCode.SUCCEEDED)
+
+    def _append_resp_locked(self, code: RaftCode) -> AppendLogResponse:
+        return AppendLogResponse(
+            code=code, term=self.term, leader=self.leader_addr,
+            committed_log_id=self.committed_id,
+            last_log_id=self.wal.last_log_id,
+            last_log_term=self.wal.last_log_term)
+
+    # ------------------------------------------------------------------
+    # snapshot transfer
+    # ------------------------------------------------------------------
+    def _maybe_send_snapshot(self, host: Host) -> None:
+        with self._lock:
+            if host.sending_snapshot or self._snapshot_rows is None:
+                return
+            host.sending_snapshot = True
+        threading.Thread(target=self._send_snapshot, args=(host,),
+                         daemon=True).start()
+
+    def _send_snapshot(self, host: Host) -> None:
+        try:
+            with self._lock:
+                if self.role is not Role.LEADER:
+                    return
+                term = self.term
+                cid = self.committed_id
+                cterm = self.wal.log_term(cid) or 0
+            rows = list(self._snapshot_rows())
+            total = len(rows)
+            total_size = sum(len(k) + len(v) for k, v in rows)
+            sent_ok = True
+            for off in range(0, max(total, 1), SNAPSHOT_CHUNK_ROWS):
+                chunk = rows[off:off + SNAPSHOT_CHUNK_ROWS]
+                done = off + SNAPSHOT_CHUNK_ROWS >= total
+                req = SendSnapshotRequest(
+                    space=self.space_id, part=self.part_id, term=term,
+                    leader=self.addr, committed_log_id=cid,
+                    committed_log_term=cterm, rows=chunk,
+                    total_size=total_size, total_count=total, done=done)
+                f = self.network.call(self.addr, host.addr,
+                                      "send_snapshot", req)
+                try:
+                    resp: SendSnapshotResponse = f.result(
+                        timeout=self._rpc_timeout * 5)
+                except Exception:
+                    sent_ok = False
+                    break
+                if resp.code is not RaftCode.SUCCEEDED:
+                    sent_ok = False
+                    break
+                if done:
+                    break
+            if sent_ok:
+                host.on_success(cid)
+        finally:
+            host.sending_snapshot = False
+            self._wake_replicator()
+
+    def process_send_snapshot(self, req: SendSnapshotRequest) -> SendSnapshotResponse:
+        with self._lock:
+            if req.term < self.term:
+                return SendSnapshotResponse(RaftCode.E_TERM_OUT_OF_DATE,
+                                            self.term)
+            if req.term > self.term:
+                self._step_down_locked(req.term, req.leader)
+            self.leader_addr = req.leader
+            self._last_msg_recv = time.monotonic()
+            if self._on_snapshot is not None:
+                self._on_snapshot(req.rows, req.committed_log_id,
+                                  req.committed_log_term, req.done)
+            self._recv_snapshot_rows += len(req.rows)
+            if req.done:
+                # history replaced wholesale: WAL restarts after the
+                # snapshot point (ref RaftPart.cpp:1601)
+                self.wal.reset()
+                self.committed_id = req.committed_log_id
+                self._recv_snapshot_rows = 0
+            return SendSnapshotResponse(RaftCode.SUCCEEDED, self.term)
+
+    # ------------------------------------------------------------------
+    # persistence of (term, voted_for)
+    # ------------------------------------------------------------------
+    def _persist_state(self) -> None:
+        tmp = self._state_path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(f"{self.term}\n{self.voted_for or ''}\n")
+        os.replace(tmp, self._state_path)
+
+    def _load_state(self) -> None:
+        try:
+            with open(self._state_path) as f:
+                lines = f.read().splitlines()
+            self.term = int(lines[0])
+            self.voted_for = lines[1] or None
+        except (OSError, IndexError, ValueError):
+            pass
+
+    # ------------------------------------------------------------------
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "space": self.space_id, "part": self.part_id,
+                "addr": self.addr, "role": self.role.name,
+                "term": self.term, "leader": self.leader_addr,
+                "committed": self.committed_id,
+                "last_log_id": self.wal.last_log_id,
+                "peers": list(self.peers), "learners": list(self.learners),
+            }

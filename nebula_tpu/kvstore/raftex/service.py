@@ -1,0 +1,127 @@
+"""Raft transport + service routing.
+
+Role parity with the reference's `RaftexService` (ref
+kvstore/raftex/RaftexService.cpp): one service per process hosts many
+raft parts and routes incoming messages by (space, part). The transport
+seam is abstract so tests run the reference's idiom — N real services in
+one process (ref kvstore/raftex/test/RaftexTestBase) — over an in-proc
+network that can also inject partitions/isolation, while production can
+bind the same service to TCP.
+"""
+from __future__ import annotations
+
+import threading
+from concurrent.futures import Future, ThreadPoolExecutor
+from typing import Dict, Optional, Tuple
+
+from .types import (AppendLogResponse, AskForVoteResponse, RaftCode,
+                    SendSnapshotResponse)
+
+
+class Transport:
+    """Sends raft messages to a remote service address."""
+
+    def call(self, from_addr: str, to_addr: str, method: str, req) -> Future:
+        raise NotImplementedError
+
+
+class InProcNetwork(Transport):
+    """In-process message fabric with fault injection: services register
+    under string addresses; `isolate(addr)` simulates a network
+    partition (messages to AND from the address are dropped), `stop`
+    unregisters — the reference's kill/restart-in-process test idiom."""
+
+    def __init__(self, max_workers: int = 16):
+        self._services: Dict[str, "RaftexService"] = {}
+        self._isolated: set = set()
+        self._lock = threading.Lock()
+        self._pool = ThreadPoolExecutor(max_workers=max_workers,
+                                        thread_name_prefix="raft-net")
+
+    def register(self, addr: str, service: "RaftexService") -> None:
+        with self._lock:
+            self._services[addr] = service
+
+    def unregister(self, addr: str) -> None:
+        with self._lock:
+            self._services.pop(addr, None)
+
+    def isolate(self, addr: str) -> None:
+        with self._lock:
+            self._isolated.add(addr)
+
+    def heal(self, addr: str) -> None:
+        with self._lock:
+            self._isolated.discard(addr)
+
+    def _unreachable(self, method: str):
+        if method == "ask_for_vote":
+            return AskForVoteResponse(RaftCode.E_UNREACHABLE, 0)
+        if method == "append_log":
+            return AppendLogResponse(RaftCode.E_UNREACHABLE, 0, None, 0, 0, 0)
+        return SendSnapshotResponse(RaftCode.E_UNREACHABLE, 0)
+
+    def call(self, from_addr: str, to_addr: str, method: str, req) -> Future:
+        def run():
+            with self._lock:
+                svc = self._services.get(to_addr)
+                dropped = (from_addr in self._isolated or
+                           to_addr in self._isolated or svc is None)
+            if dropped:
+                return self._unreachable(method)
+            return getattr(svc, method)(req)
+        return self._pool.submit(run)
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False)
+
+
+class RaftexService:
+    """Routes incoming raft messages to registered parts by (space, part)."""
+
+    def __init__(self, addr: str, network: Transport):
+        self.addr = addr
+        self.network = network
+        self._parts: Dict[Tuple[int, int], object] = {}
+        self._lock = threading.Lock()
+        if isinstance(network, InProcNetwork):
+            network.register(addr, self)
+
+    def add_part(self, part) -> None:
+        with self._lock:
+            self._parts[(part.space_id, part.part_id)] = part
+
+    def remove_part(self, space_id: int, part_id: int) -> None:
+        with self._lock:
+            self._parts.pop((space_id, part_id), None)
+
+    def find_part(self, space_id: int, part_id: int):
+        with self._lock:
+            return self._parts.get((space_id, part_id))
+
+    def stop(self) -> None:
+        with self._lock:
+            parts = list(self._parts.values())
+        for p in parts:
+            p.stop()
+        if isinstance(self.network, InProcNetwork):
+            self.network.unregister(self.addr)
+
+    # ----------------------------------------------------------- handlers
+    def ask_for_vote(self, req) -> AskForVoteResponse:
+        part = self.find_part(req.space, req.part)
+        if part is None:
+            return AskForVoteResponse(RaftCode.E_UNKNOWN_PART, 0)
+        return part.process_ask_for_vote(req)
+
+    def append_log(self, req) -> AppendLogResponse:
+        part = self.find_part(req.space, req.part)
+        if part is None:
+            return AppendLogResponse(RaftCode.E_UNKNOWN_PART, 0, None, 0, 0, 0)
+        return part.process_append_log(req)
+
+    def send_snapshot(self, req) -> SendSnapshotResponse:
+        part = self.find_part(req.space, req.part)
+        if part is None:
+            return SendSnapshotResponse(RaftCode.E_UNKNOWN_PART, 0)
+        return part.process_send_snapshot(req)

@@ -1,0 +1,107 @@
+"""Raft message/state types.
+
+Mirrors the reference's raftex thrift IDL (ref interface/raftex.thrift:
+AskForVote/AppendLog/SendSnapshot requests+responses) and RaftPart's
+role/log-type enums (ref kvstore/raftex/RaftPart.h:48-60, 272-278).
+Messages are plain dataclasses because the transport seam (in-proc or
+TCP) owns serialization.
+"""
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import List, Optional, Tuple
+
+
+class Role(enum.Enum):
+    FOLLOWER = 1
+    CANDIDATE = 2
+    LEADER = 3
+    LEARNER = 4
+
+
+class LogType(enum.IntEnum):
+    NORMAL = 0
+    ATOMIC_OP = 1
+    COMMAND = 2
+
+
+class RaftCode(enum.IntEnum):
+    SUCCEEDED = 0
+    E_LOG_GAP = 1            # follower missing logs before the sent batch
+    E_LOG_STALE = 2          # follower already has newer (conflicting) logs
+    E_TERM_OUT_OF_DATE = 3
+    E_WAL_FAIL = 4
+    E_NOT_A_LEADER = 5
+    E_BAD_STATE = 6
+    E_HOST_STOPPED = 7
+    E_NOT_READY = 8
+    E_UNKNOWN_PART = 9
+    E_UNREACHABLE = 10       # transport-level failure
+
+
+@dataclass
+class AskForVoteRequest:
+    space: int
+    part: int
+    candidate: str           # transport address of the candidate
+    term: int
+    last_log_id: int
+    last_log_term: int
+
+
+@dataclass
+class AskForVoteResponse:
+    code: RaftCode
+    term: int                # voter's current term
+
+
+@dataclass
+class LogRecord:
+    cluster: int
+    data: bytes
+
+
+@dataclass
+class AppendLogRequest:
+    space: int
+    part: int
+    term: int
+    leader: str
+    committed_log_id: int
+    # consistency check point: the log immediately before the batch
+    prev_log_id: int
+    prev_log_term: int
+    entries: List[LogRecord] = field(default_factory=list)
+    # term stamped on every entry in this batch
+    log_term: int = 0
+
+
+@dataclass
+class AppendLogResponse:
+    code: RaftCode
+    term: int
+    leader: Optional[str]
+    committed_log_id: int
+    last_log_id: int
+    last_log_term: int
+
+
+@dataclass
+class SendSnapshotRequest:
+    space: int
+    part: int
+    term: int
+    leader: str
+    committed_log_id: int
+    committed_log_term: int
+    rows: List[Tuple[bytes, bytes]]
+    total_size: int
+    total_count: int
+    done: bool
+
+
+@dataclass
+class SendSnapshotResponse:
+    code: RaftCode
+    term: int

@@ -1,0 +1,136 @@
+"""Raft test harness.
+
+Mirrors the reference's test idiom (ref kvstore/raftex/test/
+RaftexTestBase.{h,cpp} + TestShard.{h,cpp}): spin N real raft services
+in-process, each hosting a minimal state machine that records its
+commits, plus helpers to wait for leader election and to kill/restart
+replicas via network isolation.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from nebula_tpu.kvstore.raftex import (InProcNetwork, RaftPart, RaftexService)
+
+FAST = dict(heartbeat_interval=0.06, election_timeout=0.2, rpc_timeout=0.5)
+
+
+class TestShard:
+    """Minimal state machine capturing commits (ref TestShard.h)."""
+
+    def __init__(self):
+        self.commits: List[Tuple[int, int, bytes]] = []
+        self.snapshot_rows: List[Tuple[bytes, bytes]] = []
+        self.lock = threading.Lock()
+
+    def on_commit(self, logs):
+        with self.lock:
+            self.commits.extend(logs)
+
+    def on_snapshot(self, rows, cid, cterm, done):
+        with self.lock:
+            self.snapshot_rows.extend(rows)
+
+    def data(self) -> List[bytes]:
+        with self.lock:
+            return [d for _, _, d in self.commits if d]
+
+
+class RaftCluster:
+    def __init__(self, n: int, tmp_path, learners: int = 0, **kw):
+        self.net = InProcNetwork()
+        self.addrs = [f"127.0.0.1:{9000 + i}" for i in range(n + learners)]
+        self.voting = self.addrs[:n]
+        self.services: Dict[str, RaftexService] = {}
+        self.parts: Dict[str, RaftPart] = {}
+        self.shards: Dict[str, TestShard] = {}
+        self.tmp = tmp_path
+        self.kw = {**FAST, **kw}
+        for i, addr in enumerate(self.addrs):
+            self._spawn(addr, is_learner=i >= n)
+
+    def _spawn(self, addr: str, is_learner: bool = False) -> RaftPart:
+        svc = RaftexService(addr, self.net)
+        shard = TestShard()
+        part = RaftPart(
+            space_id=1, part_id=1, addr=addr, peers=list(self.voting),
+            wal_dir=str(self.tmp / addr.replace(":", "_")),
+            service=svc, on_commit=shard.on_commit,
+            on_snapshot=shard.on_snapshot,
+            snapshot_rows=lambda s=shard: list(s.snapshot_rows) or
+                [(b"k%d" % i, d) for i, d in enumerate(s.data())],
+            is_learner=is_learner, **self.kw)
+        part.start()
+        self.services[addr] = svc
+        self.parts[addr] = part
+        self.shards[addr] = shard
+        return part
+
+    # ------------------------------------------------------------- helpers
+    def wait_leader(self, timeout: float = 5.0,
+                    among: Optional[List[str]] = None) -> RaftPart:
+        """Wait until exactly one reachable voting member is leader."""
+        deadline = time.monotonic() + timeout
+        candidates = among or self.voting
+        while time.monotonic() < deadline:
+            leaders = [self.parts[a] for a in candidates
+                       if a in self.parts and self.parts[a].is_leader()]
+            if len(leaders) == 1:
+                return leaders[0]
+            time.sleep(0.02)
+        raise AssertionError(
+            f"no single leader; status: "
+            f"{[self.parts[a].status() for a in candidates if a in self.parts]}")
+
+    def wait_commit(self, n_entries: int, timeout: float = 5.0,
+                    addrs: Optional[List[str]] = None) -> None:
+        deadline = time.monotonic() + timeout
+        addrs = addrs or list(self.parts)
+        while time.monotonic() < deadline:
+            if all(len(self.shards[a].data()) >= n_entries for a in addrs):
+                return
+            time.sleep(0.02)
+        raise AssertionError(
+            f"commits not propagated: "
+            f"{{a: len(self.shards[a].data()) for a in addrs}} = "
+            f"{ {a: len(self.shards[a].data()) for a in addrs} }")
+
+    def isolate(self, addr: str) -> None:
+        self.net.isolate(addr)
+
+    def heal(self, addr: str) -> None:
+        self.net.heal(addr)
+
+    def kill(self, addr: str) -> None:
+        self.parts[addr].stop()
+        self.services[addr].stop()
+        del self.parts[addr]
+        del self.services[addr]
+
+    def restart(self, addr: str, is_learner: bool = False) -> RaftPart:
+        applied = 0
+        shard = self.shards.get(addr)
+        if shard and shard.commits:
+            applied = shard.commits[-1][0]
+        svc = RaftexService(addr, self.net)
+        part = RaftPart(
+            space_id=1, part_id=1, addr=addr, peers=list(self.voting),
+            wal_dir=str(self.tmp / addr.replace(":", "_")),
+            service=svc, on_commit=shard.on_commit,
+            on_snapshot=shard.on_snapshot,
+            snapshot_rows=lambda s=shard: [(b"k%d" % i, d)
+                                           for i, d in enumerate(s.data())],
+            applied_id=applied, is_learner=is_learner, **self.kw)
+        part.start()
+        self.services[addr] = svc
+        self.parts[addr] = part
+        return part
+
+    def stop(self) -> None:
+        for part in list(self.parts.values()):
+            part.stop()
+        for svc in list(self.services.values()):
+            svc.stop()
+        self.net.shutdown()

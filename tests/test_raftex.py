@@ -1,0 +1,281 @@
+"""Raft consensus tests — the reference's raftex test matrix (ref
+kvstore/raftex/test/: LeaderElectionTest, LogAppendTest, LogCASTest,
+LeaderTransferTest, MemberChangeTest, LearnerTest, SnapshotTest)."""
+import time
+
+import pytest
+
+from nebula_tpu.kvstore.raftex import RaftCode, Role
+from raft_fixture import RaftCluster
+
+
+@pytest.fixture
+def cluster3(tmp_path):
+    c = RaftCluster(3, tmp_path)
+    yield c
+    c.stop()
+
+
+# ---------------------------------------------------------------- election
+
+def test_single_replica_becomes_leader(tmp_path):
+    c = RaftCluster(1, tmp_path)
+    try:
+        leader = c.wait_leader()
+        assert leader.is_leader()
+    finally:
+        c.stop()
+
+
+def test_three_copies_elect_one_leader(cluster3):
+    leader = cluster3.wait_leader()
+    # followers agree on who the leader is
+    time.sleep(0.3)
+    for addr, part in cluster3.parts.items():
+        assert part.leader() == leader.addr, part.status()
+
+
+def test_reelection_after_leader_isolated(cluster3):
+    leader = cluster3.wait_leader()
+    old = leader.addr
+    cluster3.isolate(old)
+    others = [a for a in cluster3.voting if a != old]
+    new_leader = cluster3.wait_leader(among=others)
+    assert new_leader.addr != old
+    # healed old leader rejoins as follower
+    cluster3.heal(old)
+    time.sleep(0.5)
+    assert not cluster3.parts[old].is_leader()
+    assert cluster3.parts[old].leader() == new_leader.addr
+
+
+def test_no_quorum_no_leader(tmp_path):
+    c = RaftCluster(3, tmp_path)
+    try:
+        leader = c.wait_leader()
+        # isolate two of three: nobody can win an election
+        for a in c.voting:
+            if a != leader.addr:
+                c.isolate(a)
+        time.sleep(1.0)
+        # old leader may still think it leads (no lease), but the two
+        # isolated nodes must not elect anything among themselves
+        isolated = [a for a in c.voting if a != leader.addr]
+        for a in isolated:
+            assert not c.parts[a].is_leader()
+    finally:
+        c.stop()
+
+
+# ---------------------------------------------------------------- append
+
+def test_append_replicates_to_all(cluster3):
+    leader = cluster3.wait_leader()
+    for i in range(10):
+        fut = leader.append_async(b"entry-%d" % i)
+        assert fut.result(timeout=3) is RaftCode.SUCCEEDED
+    cluster3.wait_commit(10)
+    datas = [tuple(cluster3.shards[a].data()) for a in cluster3.voting]
+    assert datas[0] == datas[1] == datas[2]
+    assert datas[0] == tuple(b"entry-%d" % i for i in range(10))
+
+
+def test_append_on_follower_rejected(cluster3):
+    leader = cluster3.wait_leader()
+    follower = next(p for a, p in cluster3.parts.items()
+                    if a != leader.addr)
+    assert follower.append_async(b"nope").result(timeout=2) is \
+        RaftCode.E_NOT_A_LEADER
+
+
+def test_concurrent_appends_coalesce(cluster3):
+    leader = cluster3.wait_leader()
+    futs = [leader.append_async(b"c%03d" % i) for i in range(100)]
+    for f in futs:
+        assert f.result(timeout=5) is RaftCode.SUCCEEDED
+    cluster3.wait_commit(100)
+    # commit order matches append order on every replica
+    for a in cluster3.voting:
+        assert cluster3.shards[a].data() == [b"c%03d" % i for i in range(100)]
+
+
+def test_append_survives_leader_change(cluster3):
+    leader = cluster3.wait_leader()
+    for i in range(5):
+        leader.append_async(b"pre-%d" % i).result(timeout=3)
+    cluster3.wait_commit(5)
+    cluster3.isolate(leader.addr)
+    others = [a for a in cluster3.voting if a != leader.addr]
+    new_leader = cluster3.wait_leader(among=others)
+    for i in range(5):
+        assert new_leader.append_async(b"post-%d" % i).result(timeout=3) is \
+            RaftCode.SUCCEEDED
+    cluster3.wait_commit(10, addrs=others)
+    assert cluster3.shards[others[0]].data() == \
+        [b"pre-%d" % i for i in range(5)] + [b"post-%d" % i for i in range(5)]
+    # healed old leader catches up
+    cluster3.heal(leader.addr)
+    cluster3.wait_commit(10)
+
+
+def test_follower_catchup_after_isolation(cluster3):
+    leader = cluster3.wait_leader()
+    lagging = next(a for a in cluster3.voting if a != leader.addr)
+    cluster3.isolate(lagging)
+    for i in range(20):
+        leader.append_async(b"x%d" % i).result(timeout=3)
+    up = [a for a in cluster3.voting if a != lagging]
+    cluster3.wait_commit(20, addrs=up)
+    cluster3.heal(lagging)
+    cluster3.wait_commit(20)   # gap resolution catches the laggard up
+    assert cluster3.shards[lagging].data() == [b"x%d" % i for i in range(20)]
+
+
+# ---------------------------------------------------------------- CAS
+
+def test_atomic_op(cluster3):
+    """LogCAS analogue: the closure runs at the serialization point and
+    can abort (ref LogCASTest)."""
+    leader = cluster3.wait_leader()
+    leader.append_async(b"base").result(timeout=3)
+
+    seen = []
+
+    def cas_ok():
+        seen.append(1)
+        return b"cas-applied"
+
+    def cas_abort():
+        return None
+
+    assert leader.atomic_op_async(cas_ok).result(timeout=3) is \
+        RaftCode.SUCCEEDED
+    assert leader.atomic_op_async(cas_abort).result(timeout=3) is \
+        RaftCode.E_BAD_STATE
+    cluster3.wait_commit(2)
+    for a in cluster3.voting:
+        assert cluster3.shards[a].data() == [b"base", b"cas-applied"]
+
+
+# ---------------------------------------------------------------- transfer
+
+def test_leader_transfer(cluster3):
+    leader = cluster3.wait_leader()
+    target = next(a for a in cluster3.voting if a != leader.addr)
+    leader.transfer_leader_async(target)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        if cluster3.parts[target].is_leader():
+            break
+        time.sleep(0.02)
+    assert cluster3.parts[target].is_leader()
+    # cluster still works
+    new_leader = cluster3.parts[target]
+    assert new_leader.append_async(b"after-transfer").result(timeout=3) is \
+        RaftCode.SUCCEEDED
+    cluster3.wait_commit(1)
+
+
+# ---------------------------------------------------------------- learner
+
+def test_learner_replicates_but_does_not_vote(tmp_path):
+    c = RaftCluster(3, tmp_path, learners=1)
+    try:
+        learner_addr = c.addrs[3]
+        leader = c.wait_leader()
+        leader.add_learner_async(learner_addr).result(timeout=3)
+        for i in range(5):
+            leader.append_async(b"L%d" % i).result(timeout=3)
+        c.wait_commit(5, addrs=[learner_addr])
+        assert c.shards[learner_addr].data() == [b"L%d" % i for i in range(5)]
+        assert c.parts[learner_addr].role is Role.LEARNER
+        assert not c.parts[learner_addr].is_leader()
+    finally:
+        c.stop()
+
+
+# ---------------------------------------------------------------- membership
+
+def test_member_change_add_peer(tmp_path):
+    c = RaftCluster(3, tmp_path, learners=1)
+    try:
+        new_addr = c.addrs[3]
+        leader = c.wait_leader()
+        leader.add_learner_async(new_addr).result(timeout=3)
+        for i in range(5):
+            leader.append_async(b"m%d" % i).result(timeout=3)
+        c.wait_commit(5, addrs=[new_addr])
+        # promote: learner becomes a voting member
+        leader.add_peer_async(new_addr).result(timeout=3)
+        time.sleep(0.3)
+        assert new_addr in leader.peers
+        assert c.parts[new_addr].role is Role.FOLLOWER
+        assert leader.append_async(b"post-add").result(timeout=3) is \
+            RaftCode.SUCCEEDED
+        c.wait_commit(6, addrs=[new_addr])
+    finally:
+        c.stop()
+
+
+def test_member_change_remove_peer(cluster3):
+    leader = cluster3.wait_leader()
+    victim = next(a for a in cluster3.voting if a != leader.addr)
+    leader.remove_peer_async(victim).result(timeout=3)
+    time.sleep(0.2)
+    assert victim not in leader.peers
+    # two-member cluster still commits
+    assert leader.append_async(b"post-remove").result(timeout=3) is \
+        RaftCode.SUCCEEDED
+
+
+# ---------------------------------------------------------------- snapshot
+
+def test_snapshot_catchup_when_wal_evicted(tmp_path):
+    """A rejoining follower whose needed logs were TTL-evicted from the
+    leader's WAL receives a full snapshot instead (ref SnapshotTest)."""
+    c = RaftCluster(3, tmp_path, wal_ttl_secs=0, wal_file_size=512)
+    try:
+        leader = c.wait_leader()
+        lagging = next(a for a in c.voting if a != leader.addr)
+        c.isolate(lagging)
+        for i in range(30):
+            leader.append_async(b"s%02d" % i).result(timeout=3)
+        up = [a for a in c.voting if a != lagging]
+        c.wait_commit(30, addrs=up)
+        # evict the leader's sealed WAL segments
+        leader.wal._lib  # ensure loaded
+        # force multi-segment by rolling: append enough, then clean
+        removed = leader.wal.clean_ttl()
+        if removed == 0:
+            pytest.skip("wal stayed single-segment; nothing evicted")
+        c.heal(lagging)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            if len(c.shards[lagging].snapshot_rows) > 0 or \
+                    len(c.shards[lagging].data()) >= 30:
+                break
+            time.sleep(0.05)
+        assert c.shards[lagging].snapshot_rows or \
+            len(c.shards[lagging].data()) >= 30
+    finally:
+        c.stop()
+
+
+# ---------------------------------------------------------------- restart
+
+def test_restart_recovers_from_wal(tmp_path):
+    c = RaftCluster(3, tmp_path)
+    try:
+        leader = c.wait_leader()
+        for i in range(8):
+            leader.append_async(b"r%d" % i).result(timeout=3)
+        c.wait_commit(8)
+        victim = next(a for a in c.voting if a != leader.addr)
+        c.kill(victim)
+        for i in range(8, 12):
+            leader.append_async(b"r%d" % i).result(timeout=3)
+        c.restart(victim)
+        c.wait_commit(12)
+        assert c.shards[victim].data() == [b"r%d" % i for i in range(12)]
+    finally:
+        c.stop()
