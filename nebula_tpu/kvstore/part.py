@@ -39,8 +39,12 @@ class Part:
         self._lock = threading.Lock()
         self.last_committed_log_id = 0
         self.last_committed_term = 0
-        self._consensus = consensus or DirectCommit(self)
         self._load_commit_marker()
+        self._consensus = consensus or DirectCommit(self)
+        # consensus impls that need the Part (raft: commit/snapshot
+        # callbacks + applied id) late-bind here
+        if hasattr(self._consensus, "bind"):
+            self._consensus.bind(self)
 
     # ------------------------------------------------------------------
     # public write API (async through consensus in the reference; our

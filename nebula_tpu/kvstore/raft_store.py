@@ -1,0 +1,162 @@
+"""Raft-replicated storage: the glue between Part and RaftPart.
+
+Role parity with the reference's NebulaStore-over-raftex layering
+(ref kvstore/NebulaStore.cpp + kvstore/Part.cpp): every storage Part is
+a raft group member; writes are encoded log blobs submitted through
+`RaftConsensusHook`, replicated by RaftPart, and applied on quorum via
+`Part.commit_logs` — consensus stays below the KVStore interface and
+out of the read path. Reads remain leader-local (`GraphStore.part`
+rejects non-leaders with E_LEADER_CHANGED + leader hint, which the
+StorageClient uses for redirect retries).
+
+`ReplicatedStores` is the deployment/test helper that builds N
+GraphStores whose parts form raft groups over a shared network — the
+reference's in-process multi-server fixture idiom.
+"""
+from __future__ import annotations
+
+import os
+from concurrent.futures import Future
+from typing import Dict, List, Optional
+
+from ..common import keys as keyutils
+from ..common.status import ErrorCode, Status
+from .iface import KVEngine
+from .part import AtomicOp, ConsensusHook, Part
+from .raftex import InProcNetwork, RaftCode, RaftPart, RaftexService
+from .store import GraphStore
+
+_CODE_MAP = {
+    RaftCode.SUCCEEDED: ErrorCode.SUCCEEDED,
+    RaftCode.E_NOT_A_LEADER: ErrorCode.E_LEADER_CHANGED,
+    RaftCode.E_BAD_STATE: ErrorCode.E_FILTER_OUT,   # aborted atomic op
+}
+
+
+class RaftConsensusHook(ConsensusHook):
+    """Submits Part log blobs through a RaftPart (created at bind time
+    so the raft callbacks can reach the Part's state machine)."""
+
+    def __init__(self, space_id: int, part_id: int, engine: KVEngine,
+                 addr: str, peers: List[str], wal_root: str,
+                 service: RaftexService, is_learner: bool = False,
+                 **raft_kw):
+        self._space_id = space_id
+        self._part_id = part_id
+        self._engine = engine
+        self._addr = addr
+        self._peers = peers
+        self._wal_root = wal_root
+        self._service = service
+        self._is_learner = is_learner
+        self._raft_kw = raft_kw
+        self.raft: Optional[RaftPart] = None
+
+    def bind(self, part: Part) -> None:
+        prefix = keyutils.part_prefix(self._part_id)
+
+        def snapshot_rows():
+            it = self._engine.prefix(prefix)
+            return [(k, v) for k, v in it]
+
+        wal_dir = os.path.join(
+            self._wal_root, f"s{self._space_id}_p{self._part_id}")
+        self.raft = RaftPart(
+            space_id=self._space_id, part_id=self._part_id,
+            addr=self._addr, peers=self._peers, wal_dir=wal_dir,
+            service=self._service,
+            on_commit=lambda logs: part.commit_logs(logs),
+            on_snapshot=lambda rows, cid, cterm, done:
+                part.commit_snapshot(rows, cid, cterm, done),
+            snapshot_rows=snapshot_rows,
+            applied_id=part.last_committed_log_id,
+            is_learner=self._is_learner,
+            **self._raft_kw)
+        self.raft.start()
+
+    # ------------------------------------------------------------- submit
+    def _wait(self, fut: Future) -> Status:
+        try:
+            code = fut.result(timeout=10)
+        except Exception as e:
+            return Status.error(ErrorCode.E_CONSENSUS_ERROR, str(e))
+        mapped = _CODE_MAP.get(code)
+        if mapped is ErrorCode.SUCCEEDED:
+            return Status.OK()
+        if mapped is ErrorCode.E_LEADER_CHANGED:
+            return Status.error(ErrorCode.E_LEADER_CHANGED,
+                                self.raft.leader() or "")
+        if mapped is ErrorCode.E_FILTER_OUT:
+            return Status.error(ErrorCode.E_FILTER_OUT, "atomic op aborted")
+        return Status.error(ErrorCode.E_CONSENSUS_ERROR, str(code))
+
+    def submit(self, log: bytes) -> Status:
+        return self._wait(self.raft.append_async(log))
+
+    def submit_atomic(self, op: AtomicOp) -> Status:
+        return self._wait(self.raft.atomic_op_async(op))
+
+    def is_leader(self) -> bool:
+        return self.raft is not None and self.raft.is_leader()
+
+    def leader(self) -> Optional[str]:
+        return self.raft.leader() if self.raft else None
+
+    def stop(self) -> None:
+        if self.raft is not None:
+            self.raft.stop()
+
+
+class ReplicatedStores:
+    """N replica GraphStores over one raft network (test/deploy helper)."""
+
+    def __init__(self, n: int, data_root: str,
+                 engine_factory_for=None, **raft_kw):
+        self.net = InProcNetwork()
+        self.addrs = [f"storage-{i}" for i in range(n)]
+        self.data_root = data_root
+        self.raft_kw = raft_kw
+        self.services: Dict[str, RaftexService] = {
+            a: RaftexService(a, self.net) for a in self.addrs}
+        self.hooks: Dict[str, Dict[tuple, RaftConsensusHook]] = {
+            a: {} for a in self.addrs}
+        self.stores: Dict[str, GraphStore] = {}
+        for addr in self.addrs:
+            self.stores[addr] = self._make_store(addr, engine_factory_for)
+
+    def _make_store(self, addr: str, engine_factory_for) -> GraphStore:
+        def consensus_factory(space_id: int, part_id: int, engine: KVEngine):
+            hook = RaftConsensusHook(
+                space_id, part_id, engine, addr, list(self.addrs),
+                os.path.join(self.data_root, addr), self.services[addr],
+                **self.raft_kw)
+            self.hooks[addr][(space_id, part_id)] = hook
+            return hook
+        ef = engine_factory_for(addr) if engine_factory_for else None
+        return GraphStore(engine_factory=ef,
+                          consensus_factory=consensus_factory)
+
+    def add_part(self, space_id: int, part_id: int) -> None:
+        for addr in self.addrs:
+            self.stores[addr].add_part(space_id, part_id)
+
+    def leader_of(self, space_id: int, part_id: int,
+                  timeout: float = 5.0) -> str:
+        import time
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            leaders = [a for a in self.addrs
+                       if self.hooks[a].get((space_id, part_id)) and
+                       self.hooks[a][(space_id, part_id)].is_leader()]
+            if len(leaders) == 1:
+                return leaders[0]
+            time.sleep(0.02)
+        raise AssertionError(f"no leader for ({space_id},{part_id})")
+
+    def stop(self) -> None:
+        for hooks in self.hooks.values():
+            for h in hooks.values():
+                h.stop()
+        for svc in self.services.values():
+            svc.stop()
+        self.net.shutdown()

@@ -104,6 +104,7 @@ class RaftPart:
         self.committed_id = applied_id
         self._last_msg_recv = time.monotonic()
         self._next_election_due = self._rand_timeout()
+        self._last_quorum_contact = time.monotonic()
 
         os.makedirs(wal_dir, exist_ok=True)
         self.wal = Wal(os.path.join(wal_dir, "wal"), ttl_secs=wal_ttl_secs,
@@ -306,11 +307,14 @@ class RaftPart:
             f = self.network.call(self.addr, host.addr, "append_log", req)
             sends.append((host, req, f))
 
+        reached = 1   # self
         for host, req, f in sends:
             try:
                 resp: AppendLogResponse = f.result(timeout=self._rpc_timeout)
             except Exception:
                 continue
+            if resp.code is not RaftCode.E_UNREACHABLE and not host.is_learner:
+                reached += 1
             if resp.code is RaftCode.SUCCEEDED:
                 sent_last = (req.prev_log_id + len(req.entries))
                 host.on_success(sent_last)
@@ -320,6 +324,18 @@ class RaftPart:
                 with self._lock:
                     if resp.term > self.term:
                         self._step_down_locked(resp.term, None)
+                return
+
+        # check-quorum: a leader partitioned away from a majority steps
+        # down so its pending appends fail fast instead of hanging
+        with self._lock:
+            quorum = len(self.peers) // 2 + 1
+            if reached >= quorum:
+                self._last_quorum_contact = time.monotonic()
+            elif (self.role is Role.LEADER and
+                  time.monotonic() - self._last_quorum_contact >
+                  2 * self._election_timeout):
+                self._step_down_locked(self.term, None)
                 return
 
         self._advance_commit(term, last_id)
@@ -457,6 +473,7 @@ class RaftPart:
     def _become_leader_locked(self) -> None:
         self.role = Role.LEADER
         self.leader_addr = self.addr
+        self._last_quorum_contact = time.monotonic()
         last = self.wal.last_log_id
         self.hosts = {}
         for p in self.peers:

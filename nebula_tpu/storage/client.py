@@ -13,6 +13,7 @@ exposing the same method surface (rpc/storage_proxy).
 """
 from __future__ import annotations
 
+import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -73,20 +74,43 @@ class StorageClient:
         return by_host
 
     def _fanout(self, space_id: int, parts: Dict[int, Any], call, empty_resp,
-                merge) -> Any:
-        """Scatter per leader host, gather with leader-cache fixups
-        (ref: collectResponse)."""
-        by_host = self._group_by_host(space_id, parts)
-        futures = []
-        for host, host_parts in by_host.items():
-            svc = self._hosts[host]
-            futures.append(self._pool.submit(call, svc, host_parts))
+                merge, max_retries: int = 3) -> Any:
+        """Scatter per leader host, gather with leader-cache fixups and
+        redirect retries (ref: collectResponse + StorageClient.inl:119-134
+        leader-cache update on E_LEADER_CHANGED)."""
         resp = empty_resp
-        for fut in futures:
-            merge(resp, fut.result())
-        for part, result in resp.results.items():
-            if result.code == ErrorCode.E_LEADER_CHANGED:
-                self._note_leader(space_id, part, result.leader)
+        pending = parts
+        for _ in range(max_retries + 1):
+            by_host = self._group_by_host(space_id, pending)
+            tried = {part: host for host, hp in by_host.items() for part in hp}
+            futures = []
+            for host, host_parts in by_host.items():
+                svc = self._hosts[host]
+                futures.append(self._pool.submit(call, svc, host_parts))
+            round_resp = empty_resp.__class__()
+            for fut in futures:
+                merge(round_resp, fut.result())
+            merge(resp, round_resp)
+            # parts that hit a stale leader: update cache and retry them;
+            # with no leader hint (election in progress / dead host),
+            # rotate to the next host
+            pending = {}
+            hosts_list = list(self._hosts)
+            saw_hintless = False
+            for part, result in round_resp.results.items():
+                if result.code == ErrorCode.E_LEADER_CHANGED and part in parts:
+                    if result.leader:
+                        self._note_leader(space_id, part, result.leader)
+                    else:
+                        saw_hintless = True
+                        prev = tried.get(part, hosts_list[0])
+                        idx = (hosts_list.index(prev) + 1) % len(hosts_list)
+                        self._leader_cache[(space_id, part)] = hosts_list[idx]
+                    pending[part] = parts[part]
+            if not pending:
+                break
+            if saw_hintless:
+                time.sleep(0.05)   # election likely in progress
         return resp
 
     # ------------------------------------------------------------------
