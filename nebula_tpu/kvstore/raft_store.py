@@ -107,6 +107,140 @@ class RaftConsensusHook(ConsensusHook):
             self.raft.stop()
 
 
+class StorageNode:
+    """One storage host: a GraphStore whose parts join raft groups with
+    per-part peer sets — the unit the balancer moves partitions between
+    (ref storage/StorageServer.cpp boot + AdminProcessor surface)."""
+
+    def __init__(self, addr: str, data_root: str, net: InProcNetwork,
+                 engine_factory=None, **raft_kw):
+        self.addr = addr
+        self.data_root = data_root
+        self.service = RaftexService(addr, net)
+        self.hooks: Dict[tuple, RaftConsensusHook] = {}
+        self._part_cfg: Dict[tuple, tuple] = {}
+        self._raft_kw = raft_kw
+
+        def consensus_factory(space_id: int, part_id: int, engine: KVEngine):
+            peers, learner = self._part_cfg.pop(
+                (space_id, part_id), ([addr], False))
+            hook = RaftConsensusHook(
+                space_id, part_id, engine, addr, peers,
+                os.path.join(data_root, addr.replace(":", "_")),
+                self.service, is_learner=learner, **raft_kw)
+            self.hooks[(space_id, part_id)] = hook
+            return hook
+
+        self.store = GraphStore(engine_factory=engine_factory,
+                                consensus_factory=consensus_factory)
+
+    def add_part(self, space_id: int, part_id: int, peers: List[str],
+                 as_learner: bool = False) -> None:
+        self._part_cfg[(space_id, part_id)] = (list(peers), as_learner)
+        self.store.add_part(space_id, part_id)
+
+    def remove_part(self, space_id: int, part_id: int) -> None:
+        hook = self.hooks.pop((space_id, part_id), None)
+        if hook is not None:
+            hook.stop()
+        self.store.remove_part(space_id, part_id)
+
+    def raft(self, space_id: int, part_id: int) -> Optional[RaftPart]:
+        h = self.hooks.get((space_id, part_id))
+        return h.raft if h else None
+
+    def stop(self) -> None:
+        for h in list(self.hooks.values()):
+            h.stop()
+        self.hooks.clear()
+        self.service.stop()
+
+
+class AdminClient:
+    """Part-admin operations the balancer drives, fanned out to storage
+    nodes (ref meta/processors/admin/AdminClient + storaged's
+    AdminProcessor: transLeader/addPart/addLearner/waitingForCatchUpData/
+    memberChange/removePart)."""
+
+    def __init__(self, nodes: Dict[str, StorageNode]):
+        self.nodes = nodes
+
+    def _leader_raft(self, space_id: int, part_id: int,
+                     timeout: float = 5.0) -> RaftPart:
+        import time
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for node in self.nodes.values():
+                r = node.raft(space_id, part_id)
+                if r is not None and r.is_leader():
+                    return r
+            time.sleep(0.02)
+        raise TimeoutError(f"no leader for ({space_id},{part_id})")
+
+    def leader_of(self, space_id: int, part_id: int,
+                  timeout: float = 5.0) -> str:
+        return self._leader_raft(space_id, part_id, timeout).addr
+
+    def add_part(self, addr: str, space_id: int, part_id: int,
+                 peers: List[str], as_learner: bool) -> None:
+        self.nodes[addr].add_part(space_id, part_id, peers, as_learner)
+
+    def add_learner(self, space_id: int, part_id: int, learner: str) -> bool:
+        fut = self._leader_raft(space_id, part_id).add_learner_async(learner)
+        return fut.result(timeout=5) is RaftCode.SUCCEEDED
+
+    def wait_catchup(self, space_id: int, part_id: int, target: str,
+                     timeout: float = 10.0) -> bool:
+        import time
+        leader = self._leader_raft(space_id, part_id)
+        goal = leader.committed_id
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            r = self.nodes[target].raft(space_id, part_id)
+            if r is not None and r.committed_id >= goal:
+                return True
+            time.sleep(0.02)
+        return False
+
+    def member_add(self, space_id: int, part_id: int, addr: str) -> bool:
+        fut = self._leader_raft(space_id, part_id).add_peer_async(addr)
+        return fut.result(timeout=5) is RaftCode.SUCCEEDED
+
+    def member_remove(self, space_id: int, part_id: int, addr: str) -> bool:
+        fut = self._leader_raft(space_id, part_id).remove_peer_async(addr)
+        return fut.result(timeout=5) is RaftCode.SUCCEEDED
+
+    def trans_leader(self, space_id: int, part_id: int, target: str,
+                     timeout: float = 5.0) -> bool:
+        import time
+        leader = self._leader_raft(space_id, part_id)
+        if leader.addr == target:
+            return True
+        leader.transfer_leader_async(target)
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            r = self.nodes[target].raft(space_id, part_id)
+            if r is not None and r.is_leader():
+                return True
+            time.sleep(0.02)
+        return False
+
+    def remove_part(self, addr: str, space_id: int, part_id: int) -> None:
+        node = self.nodes.get(addr)
+        if node is not None:
+            node.remove_part(space_id, part_id)
+
+    def leader_map(self, space_id: int,
+                   parts: List[int]) -> Dict[int, Optional[str]]:
+        out = {}
+        for p in parts:
+            try:
+                out[p] = self.leader_of(space_id, p, timeout=2.0)
+            except TimeoutError:
+                out[p] = None
+        return out
+
+
 class ReplicatedStores:
     """N replica GraphStores over one raft network (test/deploy helper)."""
 

@@ -1,0 +1,295 @@
+"""Data & leader balancer.
+
+Role parity with the reference's `meta/processors/admin/Balancer.{h,cpp}`:
+diff the current part allocation against the live host set (the
+heartbeat-driven failure detector, ActiveHostsMan), build a BalancePlan
+of per-part move tasks, persist every task in the meta KV so a crashed
+balancer resumes (`Balancer::recovery`, Balancer.cpp:67-106), and run
+each task's FSM:
+
+    ADD_PART(dst, learner) → ADD_LEARNER → WAIT_CATCHUP →
+    MEMBER_ADD(dst) → [TRANS_LEADER if src led] → MEMBER_REMOVE(src) →
+    REMOVE_PART(src) → update meta part allocation
+
+A separate leader-balance pass (`Balancer::leaderBalance`,
+Balancer.cpp:615) evens leader counts without moving data.
+"""
+from __future__ import annotations
+
+import json
+import math
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ..common.status import ErrorCode, Status, StatusOr
+from . import keys as mk
+
+# task FSM states (ref BalanceTask::Status)
+ST_START = "START"
+ST_ADD_LEARNER = "ADD_LEARNER"
+ST_CATCHUP = "CATCHUP"
+ST_MEMBER_CHANGE = "MEMBER_CHANGE"
+ST_REMOVE_PART = "REMOVE_PART"
+ST_SUCCEEDED = "SUCCEEDED"
+ST_FAILED = "FAILED"
+ST_INVALID = "INVALID"
+
+_TERMINAL = (ST_SUCCEEDED, ST_FAILED, ST_INVALID)
+
+
+class BalanceTask:
+    def __init__(self, plan_id: int, space_id: int, part_id: int,
+                 src: str, dst: str, status: str = ST_START):
+        self.plan_id = plan_id
+        self.space_id = space_id
+        self.part_id = part_id
+        self.src = src
+        self.dst = dst
+        self.status = status
+
+    def key(self) -> bytes:
+        return mk.balance_task_key(self.plan_id, self.space_id,
+                                   self.part_id, self.src, self.dst)
+
+    def value(self) -> bytes:
+        return json.dumps({"status": self.status}).encode()
+
+    def as_row(self) -> List:
+        return [self.plan_id, self.space_id, self.part_id,
+                self.src, self.dst, self.status]
+
+
+class Balancer:
+    def __init__(self, meta, admin, get_active_hosts=None):
+        """meta: MetaService; admin: AdminClient;
+        get_active_hosts: override liveness source (defaults to the
+        heartbeat-based ActiveHostsMan view)."""
+        self.meta = meta
+        self.admin = admin
+        self._get_active = get_active_hosts or (
+            lambda: [h.host for h in meta.active_hosts()])
+        self._lock = threading.Lock()
+        self._running_plan: Optional[int] = None
+        self._stop_flag = False
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------------
+    # plan construction (ref Balancer::balanceParts, Balancer.cpp:220-287)
+    # ------------------------------------------------------------------
+    def _build_tasks(self, plan_id: int,
+                     remove_hosts: Tuple[str, ...]) -> List[BalanceTask]:
+        active = [h for h in self._get_active() if h not in remove_hosts]
+        if not active:
+            return []
+        tasks: List[BalanceTask] = []
+        for desc in self.meta.list_spaces():
+            alloc = self.meta.get_parts_alloc(desc.space_id)
+            if not alloc:
+                continue
+            # load = #parts hosted per active host
+            load: Dict[str, List[int]] = {h: [] for h in active}
+            must_move: List[Tuple[int, str]] = []   # (part, bad_host)
+            for part, hosts in alloc.items():
+                for h in hosts:
+                    if h in load:
+                        load[h].append(part)
+                    else:
+                        must_move.append((part, h))
+            # first, evacuate dead/removed hosts
+            for part, bad in must_move:
+                cur = set(alloc[part])
+                candidates = [h for h in sorted(load, key=lambda x: len(load[x]))
+                              if h not in cur]
+                if not candidates:
+                    continue
+                dst = candidates[0]
+                load[dst].append(part)
+                alloc[part] = [dst if h == bad else h for h in alloc[part]]
+                tasks.append(BalanceTask(plan_id, desc.space_id, part,
+                                         bad, dst))
+            # then, even out the load: move from max to min while the
+            # spread exceeds 1 (ref balanceParts while-loop)
+            while True:
+                hmax = max(load, key=lambda h: len(load[h]))
+                hmin = min(load, key=lambda h: len(load[h]))
+                if len(load[hmax]) - len(load[hmin]) <= 1:
+                    break
+                moved = None
+                for part in load[hmax]:
+                    if part not in load[hmin] and hmin not in alloc[part]:
+                        moved = part
+                        break
+                if moved is None:
+                    break
+                load[hmax].remove(moved)
+                load[hmin].append(moved)
+                alloc[moved] = [hmin if h == hmax else h
+                                for h in alloc[moved]]
+                tasks.append(BalanceTask(plan_id, desc.space_id, moved,
+                                         hmax, hmin))
+        return tasks
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+    def balance(self, remove_hosts: Tuple[str, ...] = ()) -> StatusOr[int]:
+        with self._lock:
+            if self._thread is not None and self._thread.is_alive():
+                return StatusOr.err(ErrorCode.E_BALANCER_RUNNING,
+                                    f"plan {self._running_plan} in flight")
+            # resume an unfinished plan first (ref Balancer::recovery)
+            unfinished = self._load_unfinished()
+            if unfinished:
+                plan_id, tasks = unfinished
+            else:
+                plan_id = self.meta._next_id("balance_plan")
+                tasks = self._build_tasks(plan_id, tuple(remove_hosts))
+                if not tasks:
+                    return StatusOr.err(ErrorCode.E_NO_VALID_HOST,
+                                        "already balanced / no tasks")
+                for t in tasks:
+                    self.meta._put((t.key(), t.value()))
+            self._running_plan = plan_id
+            self._stop_flag = False
+            self._thread = threading.Thread(
+                target=self._run_plan, args=(plan_id, tasks), daemon=True,
+                name=f"balance-plan-{plan_id}")
+            self._thread.start()
+            return StatusOr.of(plan_id)
+
+    def leader_balance(self) -> Status:
+        """Even out leaders per host without moving data (ref
+        Balancer::leaderBalance)."""
+        for desc in self.meta.list_spaces():
+            alloc = self.meta.get_parts_alloc(desc.space_id)
+            if not alloc:
+                continue
+            leaders = self.admin.leader_map(desc.space_id, sorted(alloc))
+            hosts = sorted({h for hs in alloc.values() for h in hs})
+            if not hosts:
+                continue
+            count = {h: 0 for h in hosts}
+            for p, l in leaders.items():
+                if l in count:
+                    count[l] += 1
+            target = math.ceil(len(alloc) / len(hosts))
+            for part, leader in sorted(leaders.items()):
+                if leader is None or count.get(leader, 0) <= target:
+                    continue
+                members = [h for h in alloc[part] if h != leader]
+                members.sort(key=lambda h: count.get(h, 0))
+                if not members or count[members[0]] + 1 > target:
+                    continue
+                if self.admin.trans_leader(desc.space_id, part, members[0]):
+                    count[leader] -= 1
+                    count[members[0]] += 1
+        return Status.OK()
+
+    def show_plan(self, plan_id: Optional[int] = None) -> List[List]:
+        rows = []
+        for k, v in self.meta._scan(mk.balance_prefix(plan_id)):
+            t = _task_from_kv(k, v)
+            rows.append(t.as_row())
+        return rows
+
+    def stop(self) -> Status:
+        with self._lock:
+            if self._thread is None or not self._thread.is_alive():
+                return Status.error(ErrorCode.E_NOT_FOUND,
+                                    "no balance plan running")
+            self._stop_flag = True
+        return Status.OK()
+
+    def wait(self, timeout: float = 30.0) -> None:
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+
+    # ------------------------------------------------------------------
+    # plan execution
+    # ------------------------------------------------------------------
+    def _run_plan(self, plan_id: int, tasks: List[BalanceTask]) -> None:
+        for task in tasks:
+            if self._stop_flag:
+                break
+            if task.status in _TERMINAL:
+                continue
+            try:
+                self._run_task(task)
+            except Exception:
+                task.status = ST_FAILED
+            self.meta._put((task.key(), task.value()))
+
+    def _run_task(self, t: BalanceTask) -> None:
+        space, part = t.space_id, t.part_id
+        alloc = self.meta.get_parts_alloc(space)
+        cur_hosts = alloc.get(part, [])
+        if t.src not in cur_hosts and t.dst in cur_hosts:
+            t.status = ST_SUCCEEDED   # already done (resume case)
+            return
+        peers = list(cur_hosts)
+
+        # 1. create the destination replica as a learner
+        self.admin.add_part(t.dst, space, part, peers + [t.dst],
+                            as_learner=True)
+        t.status = ST_ADD_LEARNER
+        self.meta._put((t.key(), t.value()))
+        if not self.admin.add_learner(space, part, t.dst):
+            t.status = ST_FAILED
+            return
+
+        # 2. wait until the learner caught up
+        t.status = ST_CATCHUP
+        self.meta._put((t.key(), t.value()))
+        if not self.admin.wait_catchup(space, part, t.dst):
+            t.status = ST_FAILED
+            return
+
+        # 3. membership change: promote dst, demote src
+        t.status = ST_MEMBER_CHANGE
+        self.meta._put((t.key(), t.value()))
+        if not self.admin.member_add(space, part, t.dst):
+            t.status = ST_FAILED
+            return
+        # if src currently leads, hand leadership off first
+        try:
+            if self.admin.leader_of(space, part, timeout=2.0) == t.src:
+                others = [h for h in peers + [t.dst] if h != t.src]
+                if others:
+                    self.admin.trans_leader(space, part, others[0])
+        except TimeoutError:
+            pass
+        if not self.admin.member_remove(space, part, t.src):
+            t.status = ST_FAILED
+            return
+
+        # 4. drop the source replica + record the new allocation
+        t.status = ST_REMOVE_PART
+        self.meta._put((t.key(), t.value()))
+        self.admin.remove_part(t.src, space, part)
+        new_hosts = [h for h in cur_hosts if h != t.src] + [t.dst]
+        self.meta.update_part_alloc(space, part, new_hosts)
+        t.status = ST_SUCCEEDED
+
+    # ------------------------------------------------------------------
+    def _load_unfinished(self) -> Optional[Tuple[int, List[BalanceTask]]]:
+        by_plan: Dict[int, List[BalanceTask]] = {}
+        for k, v in self.meta._scan(mk.balance_prefix()):
+            t = _task_from_kv(k, v)
+            by_plan.setdefault(t.plan_id, []).append(t)
+        for plan_id in sorted(by_plan, reverse=True):
+            tasks = by_plan[plan_id]
+            if any(t.status not in _TERMINAL for t in tasks):
+                return plan_id, tasks
+        return None
+
+
+def _task_from_kv(k: bytes, v: bytes) -> BalanceTask:
+    import struct
+    body = k[len(mk.P_BALANCE):]
+    plan_id = struct.unpack(">Q", body[:8])[0]
+    space_id = struct.unpack(">I", body[8:12])[0]
+    part_id = struct.unpack(">I", body[12:16])[0]
+    src, dst = body[16:].decode().split(">", 1)
+    status = json.loads(v)["status"]
+    return BalanceTask(plan_id, space_id, part_id, src, dst, status)

@@ -1,0 +1,148 @@
+"""Balancer + failure-detector tests (ref meta/test/BalancerTest.cpp,
+BalanceIntegrationTest.cpp, and the ActiveHostsMan liveness rules)."""
+import time
+
+import pytest
+
+from nebula_tpu.kvstore.raftex import InProcNetwork
+from nebula_tpu.kvstore.raft_store import AdminClient, StorageNode
+from nebula_tpu.meta.balancer import ST_SUCCEEDED, Balancer
+from nebula_tpu.meta.service import MetaService
+
+FAST = dict(heartbeat_interval=0.06, election_timeout=0.2, rpc_timeout=0.5)
+HOSTS = ["hostA", "hostB", "hostC"]
+
+
+class BalanceEnv:
+    def __init__(self, tmp_path, live=("hostA",)):
+        self.net = InProcNetwork()
+        self.nodes = {h: StorageNode(h, str(tmp_path), self.net, **FAST)
+                      for h in HOSTS}
+        self.meta = MetaService()
+        self.meta._expired_threshold = 3600
+        for h in live:
+            self.meta.heartbeat(h)
+        self.admin = AdminClient(self.nodes)
+        self.balancer = Balancer(self.meta, self.admin)
+
+    def create_space(self, name, parts, replica=1):
+        sid = self.meta.create_space(name, parts, replica).value()
+        alloc = self.meta.get_parts_alloc(sid)
+        for part, hosts in alloc.items():
+            for h in hosts:
+                self.nodes[h].add_part(sid, part, hosts)
+        # wait for leaders everywhere
+        for part in alloc:
+            self.admin.leader_of(sid, part)
+        return sid
+
+    def put(self, sid, part, key, value):
+        leader = self.admin.leader_of(sid, part)
+        st = self.nodes[leader].store.async_multi_put(
+            sid, part, [(key, value)])
+        assert st.ok(), st
+
+    def hosting(self, sid):
+        """host -> set(parts) as actually instantiated on the nodes."""
+        return {h: set(p for (s, p) in n.hooks if s == sid)
+                for h, n in self.nodes.items()}
+
+    def stop(self):
+        self.balancer.wait()
+        for n in self.nodes.values():
+            n.stop()
+        self.net.shutdown()
+
+
+@pytest.fixture
+def env(tmp_path):
+    e = BalanceEnv(tmp_path)
+    yield e
+    e.stop()
+
+
+def test_balance_spreads_parts_to_new_hosts(env):
+    sid = env.create_space("s1", parts=4)          # all on hostA
+    for p in range(1, 5):
+        env.put(sid, p, b"\x01key%d" % p, b"val%d" % p)
+    assert env.hosting(sid)["hostA"] == {1, 2, 3, 4}
+
+    env.meta.heartbeat("hostB")
+    env.meta.heartbeat("hostC")
+    plan = env.balancer.balance()
+    assert plan.ok(), plan.status
+    env.balancer.wait()
+
+    rows = env.balancer.show_plan(plan.value())
+    assert rows and all(r[5] == ST_SUCCEEDED for r in rows), rows
+    counts = {h: len(ps) for h, ps in env.hosting(sid).items()}
+    assert max(counts.values()) - min(counts.values()) <= 1, counts
+    # meta allocation agrees with reality
+    alloc = env.meta.get_parts_alloc(sid)
+    for part, hosts in alloc.items():
+        for h in hosts:
+            assert part in env.hosting(sid)[h]
+    # data moved with the parts
+    for p in range(1, 5):
+        owner = alloc[p][0]
+        eng = env.nodes[owner].store.space_engine(sid)
+        assert eng.get(b"\x01key%d" % p) == b"val%d" % p, (p, owner)
+
+
+def test_balance_remove_host_evacuates(env):
+    env.meta.heartbeat("hostB")
+    env.meta.heartbeat("hostC")
+    sid = env.create_space("s2", parts=3)
+    for p in range(1, 4):
+        env.put(sid, p, b"\x01k%d" % p, b"v%d" % p)
+
+    plan = env.balancer.balance(remove_hosts=("hostA",))
+    if plan.ok():
+        env.balancer.wait()
+    alloc = env.meta.get_parts_alloc(sid)
+    for part, hosts in alloc.items():
+        assert "hostA" not in hosts, alloc
+    assert env.hosting(sid)["hostA"] == set()
+    for p in range(1, 4):
+        owner = alloc[p][0]
+        assert env.nodes[owner].store.space_engine(sid).get(b"\x01k%d" % p) \
+            == b"v%d" % p
+
+
+def test_balance_noop_when_balanced(env):
+    env.meta.heartbeat("hostB")
+    env.meta.heartbeat("hostC")
+    sid = env.create_space("s3", parts=3)   # round-robin: already even
+    plan = env.balancer.balance()
+    assert not plan.ok()   # nothing to do
+
+
+def test_leader_balance(tmp_path):
+    env = BalanceEnv(tmp_path, live=HOSTS)
+    try:
+        sid = env.create_space("s4", parts=4, replica=3)
+        # concentrate every leader on hostA
+        for p in range(1, 5):
+            assert env.admin.trans_leader(sid, p, "hostA")
+        assert env.balancer.leader_balance().ok()
+        leaders = env.admin.leader_map(sid, [1, 2, 3, 4])
+        counts = {}
+        for l in leaders.values():
+            counts[l] = counts.get(l, 0) + 1
+        assert max(counts.values()) <= 2, counts   # ceil(4/3) = 2
+    finally:
+        env.stop()
+
+
+def test_active_hosts_expiry():
+    meta = MetaService()
+    meta._expired_threshold = 0.2
+    meta.heartbeat("h1")
+    meta.heartbeat("h2")
+    assert {h.host for h in meta.active_hosts()} == {"h1", "h2"}
+    time.sleep(0.3)
+    meta.heartbeat("h2")
+    assert {h.host for h in meta.active_hosts()} == {"h2"}
+    # all_hosts reports liveness flags
+    flags = {h.host: alive for h, alive in meta.all_hosts()}
+    assert flags == {"h1": False, "h2": True}
