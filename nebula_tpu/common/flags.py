@@ -1,0 +1,100 @@
+"""Runtime flag registry.
+
+Role parity with the reference's gflags usage + cluster config registry
+integration (ref §5 of SURVEY: daemons declare flags, the meta configMan
+stores them, clients hot-update MUTABLE ones). `declare` at import time,
+`get`/`set` anywhere; `sync_to_meta` registers declared flags in the
+meta config registry and `pull_from_meta` applies remote values —
+mirroring MetaClient's gflags pull loop (meta/client/MetaClient.cpp:
+1294-1429).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+MUTABLE = "MUTABLE"
+REBOOT = "REBOOT"
+IMMUTABLE = "IMMUTABLE"
+
+
+class _Flag:
+    __slots__ = ("name", "value", "default", "mode", "help")
+
+    def __init__(self, name, default, mode, help_):
+        self.name = name
+        self.value = default
+        self.default = default
+        self.mode = mode
+        self.help = help_
+
+
+class FlagRegistry:
+    def __init__(self, module: str = "GRAPH"):
+        self.module = module
+        self._flags: Dict[str, _Flag] = {}
+        self._lock = threading.Lock()
+        self._watchers: List[Callable[[str, Any], None]] = []
+
+    def declare(self, name: str, default: Any, mode: str = MUTABLE,
+                help_: str = "") -> None:
+        with self._lock:
+            if name not in self._flags:
+                self._flags[name] = _Flag(name, default, mode, help_)
+
+    def get(self, name: str, default: Any = None) -> Any:
+        f = self._flags.get(name)
+        return f.value if f is not None else default
+
+    def set(self, name: str, value: Any) -> bool:
+        with self._lock:
+            f = self._flags.get(name)
+            if f is None or f.mode == IMMUTABLE:
+                return False
+            f.value = value
+        for w in self._watchers:
+            try:
+                w(name, value)
+            except Exception:
+                pass
+        return True
+
+    def watch(self, fn: Callable[[str, Any], None]) -> None:
+        self._watchers.append(fn)
+
+    def items(self) -> List[Tuple[str, Any, str]]:
+        return [(f.name, f.value, f.mode) for f in
+                sorted(self._flags.values(), key=lambda f: f.name)]
+
+    # ---------------------------------------------------------- meta sync
+    def sync_to_meta(self, meta) -> None:
+        for name, value, mode in self.items():
+            meta.reg_config(self.module, name, value, mode)
+
+    def pull_from_meta(self, meta) -> int:
+        n = 0
+        for mod_name, value, mode in meta.list_configs(self.module):
+            name = mod_name.split(":", 1)[1]
+            if mode != IMMUTABLE and name in self._flags and \
+                    self._flags[name].value != value:
+                self.set(name, value)
+                n += 1
+        return n
+
+
+# per-daemon registries (the reference's per-binary gflags)
+graph_flags = FlagRegistry("GRAPH")
+storage_flags = FlagRegistry("STORAGE")
+meta_flags = FlagRegistry("META")
+
+# core declared flags, mirroring the reference defaults
+graph_flags.declare("session_idle_timeout_secs", 28800, MUTABLE,
+                    "idle session reclamation age")
+graph_flags.declare("slow_op_threshold_ms", 50, MUTABLE,
+                    "log queries slower than this")
+storage_flags.declare("max_edge_returned_per_vertex", 1 << 30, MUTABLE,
+                      "per-vertex edge truncation cap")
+storage_flags.declare("heartbeat_interval_secs", 10, MUTABLE,
+                      "storaged -> metad heartbeat period")
+meta_flags.declare("expired_threshold_sec", 10 * 60, MUTABLE,
+                   "host liveness horizon")

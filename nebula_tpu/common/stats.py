@@ -1,0 +1,171 @@
+"""Metrics: counters + windowed histograms.
+
+Role parity with the reference's `common/stats/StatsManager.{h,cpp}`:
+metrics are registered once and fed values; readers query dotted names
+like `query.rate.60`, `query_latency_us.p99.600` — method ∈ {sum, count,
+avg, rate, p<NN>} over trailing windows of 60 s / 600 s / 3600 s (the
+reference's 1 m / 10 m / 1 h granularity, StatsManager.h:20-88).
+
+Implementation: per metric a ring of per-second buckets (sum, count,
+plus a small fixed log-scale histogram for percentiles) covering the
+largest window; thread-safe; O(window) reads, O(1) writes.
+"""
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+WINDOWS = (60, 600, 3600)
+
+# log-scale histogram bounds: 1..10^9, 90 buckets (10 per decade)
+_BOUNDS: List[float] = [
+    10 ** (d + i / 10.0) for d in range(9) for i in range(10)]
+
+
+def _bucket_of(v: float) -> int:
+    if v <= 1:
+        return 0
+    return min(bisect.bisect_left(_BOUNDS, v), len(_BOUNDS) - 1)
+
+
+class _Metric:
+    __slots__ = ("lock", "sums", "counts", "hists", "head_sec")
+
+    def __init__(self, now_sec: int):
+        n = WINDOWS[-1]
+        self.lock = threading.Lock()
+        self.sums = [0.0] * n
+        self.counts = [0] * n
+        self.hists = [None] * n          # lazily allocated per-second hist
+        self.head_sec = now_sec
+
+    def _advance(self, now_sec: int) -> None:
+        gap = now_sec - self.head_sec
+        if gap <= 0:
+            return
+        n = WINDOWS[-1]
+        for k in range(1, min(gap, n) + 1):
+            i = (self.head_sec + k) % n
+            self.sums[i] = 0.0
+            self.counts[i] = 0
+            self.hists[i] = None
+        self.head_sec = now_sec
+
+    def add(self, value: float, now_sec: int) -> None:
+        with self.lock:
+            self._advance(now_sec)
+            i = now_sec % WINDOWS[-1]
+            self.sums[i] += value
+            self.counts[i] += 1
+            h = self.hists[i]
+            if h is None:
+                h = self.hists[i] = {}
+            b = _bucket_of(value)
+            h[b] = h.get(b, 0) + 1
+
+    def read(self, method: str, window: int, now_sec: int) -> float:
+        with self.lock:
+            self._advance(now_sec)
+            n = WINDOWS[-1]
+            idxs = [(now_sec - k) % n for k in range(window)]
+            if method == "sum":
+                return sum(self.sums[i] for i in idxs)
+            if method == "count":
+                return float(sum(self.counts[i] for i in idxs))
+            if method == "avg":
+                c = sum(self.counts[i] for i in idxs)
+                return sum(self.sums[i] for i in idxs) / c if c else 0.0
+            if method == "rate":
+                return sum(self.counts[i] for i in idxs) / float(window)
+            if method.startswith("p"):
+                digits = method[1:]
+                # p50 -> 50, p99 -> 99, p999 -> 99.9
+                q = float(digits) / (10 ** (len(digits) - 2))
+                merged: Dict[int, int] = {}
+                for i in idxs:
+                    h = self.hists[i]
+                    if h:
+                        for b, c in h.items():
+                            merged[b] = merged.get(b, 0) + c
+                total = sum(merged.values())
+                if total == 0:
+                    return 0.0
+                target = math.ceil(total * q / 100.0)
+                acc = 0
+                for b in sorted(merged):
+                    acc += merged[b]
+                    if acc >= target:
+                        return _BOUNDS[b]
+                return _BOUNDS[max(merged)]
+            raise ValueError(f"bad stats method {method!r}")
+
+
+class StatsManager:
+    """Process-global metric registry (instantiable for tests)."""
+
+    def __init__(self, clock=time.time):
+        self._metrics: Dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+        self._clock = clock
+
+    def add_value(self, name: str, value: float = 1.0) -> None:
+        now_sec = int(self._clock())
+        m = self._metrics.get(name)
+        if m is None:
+            with self._lock:
+                m = self._metrics.setdefault(name, _Metric(now_sec))
+        m.add(value, now_sec)
+
+    def read_stats(self, spec: str) -> Optional[float]:
+        """spec = '<name>.<method>.<window-secs>'."""
+        try:
+            name, method, window_s = spec.rsplit(".", 2)
+            window = int(window_s)
+        except ValueError:
+            return None
+        if window not in WINDOWS:
+            return None
+        m = self._metrics.get(name)
+        if m is None:
+            return None
+        try:
+            return m.read(method, window, int(self._clock()))
+        except ValueError:
+            return None
+
+    def names(self) -> List[str]:
+        return sorted(self._metrics)
+
+    def snapshot(self, windows: Tuple[int, ...] = (60,)) -> Dict[str, float]:
+        out = {}
+        for name in self.names():
+            for w in windows:
+                for method in ("rate", "sum", "avg", "p95", "p99"):
+                    v = self.read_stats(f"{name}.{method}.{w}")
+                    if v is not None:
+                        out[f"{name}.{method}.{w}"] = v
+        return out
+
+
+# process-global instance (the reference's static StatsManager)
+stats = StatsManager()
+
+
+class Duration:
+    """Scoped latency helper feeding a metric in microseconds."""
+
+    def __init__(self, manager: StatsManager, metric: str):
+        self._m = manager
+        self._metric = metric
+        self._t0 = time.perf_counter()
+
+    def elapsed_us(self) -> int:
+        return int((time.perf_counter() - self._t0) * 1e6)
+
+    def record(self) -> int:
+        us = self.elapsed_us()
+        self._m.add_value(self._metric, us)
+        return us

@@ -1,0 +1,141 @@
+"""Interactive console.
+
+Role parity with the reference's `src/console/` (CliManager + the table
+rendering in CmdProcessor.cpp): a readline REPL with history, an `-e`
+one-shot mode and an `-f` batch-file mode, ASCII result tables, and
+per-query latency reporting.
+
+Run: python -m nebula_tpu.console [-e STMT] [-f FILE] [--user U] [--password P]
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+from typing import Any, List, Optional, Sequence
+
+
+def render_table(columns: Sequence[str], rows: Sequence[Sequence[Any]]) -> str:
+    """ASCII table identical in spirit to the reference console output:
+    =-delimited header, |-separated cells, width = max cell."""
+    if not columns:
+        return ""
+    cells = [[_fmt(c) for c in row] for row in rows]
+    widths = [len(str(c)) for c in columns]
+    for row in cells:
+        for i, c in enumerate(row):
+            if i < len(widths):
+                widths[i] = max(widths[i], len(c))
+    sep = "+" + "+".join("-" * (w + 2) for w in widths) + "+"
+    hdr = "|" + "|".join(f" {str(c):<{w}} " for c, w in zip(columns, widths)) + "|"
+    out = [sep, hdr, sep]
+    for row in cells:
+        out.append("|" + "|".join(
+            f" {c:<{w}} " for c, w in zip(row, widths)) + "|")
+    out.append(sep)
+    return "\n".join(out)
+
+
+def _fmt(v: Any) -> str:
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if isinstance(v, float):
+        return f"{v:g}"
+    if v is None:
+        return "__NULL__"
+    return str(v)
+
+
+class Console:
+    def __init__(self, connection, out=None):
+        self.conn = connection
+        self.out = out or sys.stdout
+
+    def run_statement(self, text: str) -> bool:
+        """Execute one (possibly ';'-chained) statement; print results.
+        Returns False when the statement asks to quit."""
+        text = text.strip()
+        if not text:
+            return True
+        if text.lower() in ("exit", "quit", "exit;", "quit;"):
+            return False
+        t0 = time.monotonic()
+        resp = self.conn.execute(text)
+        wall_ms = (time.monotonic() - t0) * 1e3
+        if not resp.ok():
+            print(f"[ERROR ({resp.code.name})]: {resp.error_msg}",
+                  file=self.out)
+            return True
+        if resp.columns:
+            print(render_table(resp.columns, resp.rows), file=self.out)
+            n = len(resp.rows)
+            print(f"Got {n} rows (server {resp.latency_us} us, "
+                  f"wall {wall_ms:.2f} ms)", file=self.out)
+        else:
+            print(f"Execution succeeded (server {resp.latency_us} us, "
+                  f"wall {wall_ms:.2f} ms)", file=self.out)
+        return True
+
+    def run_file(self, path: str) -> None:
+        with open(path) as f:
+            buf = ""
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("--") or line.startswith("#"):
+                    continue
+                buf += (" " if buf else "") + line
+                if buf.endswith(";"):
+                    self.run_statement(buf)
+                    buf = ""
+            if buf:
+                self.run_statement(buf)
+
+    def repl(self, in_stream=None) -> None:
+        prompt = "(nebula-tpu) > "
+        if in_stream is None and sys.stdin.isatty():
+            try:
+                import readline  # noqa: F401  (history + line editing)
+            except ImportError:
+                pass
+            while True:
+                try:
+                    line = input(prompt)
+                except (EOFError, KeyboardInterrupt):
+                    print("", file=self.out)
+                    return
+                if not self.run_statement(line):
+                    return
+        else:
+            stream = in_stream or sys.stdin
+            for line in stream:
+                if not self.run_statement(line):
+                    return
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description="nebula-tpu console")
+    ap.add_argument("-e", metavar="STMT", help="execute one statement")
+    ap.add_argument("-f", metavar="FILE", help="batch file of statements")
+    ap.add_argument("--user", default="root")
+    ap.add_argument("--password", default="")
+    args = ap.parse_args(argv)
+
+    # single-process deployment: boot an in-proc cluster with the TPU
+    # engine attached (multi-process daemons connect over rpc instead)
+    from .cluster import InProcCluster
+    from .engine_tpu import TpuGraphEngine
+    cluster = InProcCluster(tpu_engine=TpuGraphEngine())
+    conn = cluster.connect(args.user, args.password)
+    console = Console(conn)
+    if args.e:
+        console.run_statement(args.e)
+    elif args.f:
+        console.run_file(args.f)
+    else:
+        print("Welcome to nebula-tpu console. Type `exit` to leave.")
+        console.repl()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -195,4 +195,16 @@ class GraphService:
             resp.code = sr.status.code
             resp.error_msg = sr.status.msg
             return resp
-        return self.engine.execute(sr.value(), text)
+        resp = self.engine.execute(sr.value(), text)
+        # per-query QPS/latency metrics + slow-op log (ref: per-query
+        # latency_in_us in every response, SlowOpTracker)
+        from ..common.flags import graph_flags
+        from ..common.stats import stats
+        stats.add_value("graph.query")
+        stats.add_value("graph.query_latency_us", resp.latency_us)
+        if not resp.ok():
+            stats.add_value("graph.query_error")
+        slow_ms = graph_flags.get("slow_op_threshold_ms", 50)
+        if resp.latency_us > slow_ms * 1000:
+            stats.add_value("graph.slow_query")
+        return resp

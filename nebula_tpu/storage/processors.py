@@ -43,6 +43,7 @@ from ..filter.expressions import (DestPropExpr, EdgePropExpr, EvalError,
 from ..kvstore.store import GraphStore
 from ..kvstore import log_encoder as le
 from ..meta.schema_manager import SchemaManager
+from ..common.stats import stats
 from .types import (BoundRequest, BoundResponse, EdgeData, EdgeKey,
                     ExecResponse, NewEdge, NewVertex, PartResult,
                     PropsResponse, UpdateItemReq, UpdateResponse, VertexData)
@@ -140,6 +141,7 @@ class StorageService:
     # ------------------------------------------------------------------
     def get_bound(self, req: BoundRequest) -> BoundResponse:
         t0 = time.monotonic()
+        stats.add_value("storage.get_bound_qps")
         resp = BoundResponse()
         space = req.space_id
         flt = None
@@ -189,6 +191,7 @@ class StorageService:
                 resp.vertices.append(vd)
             resp.results[part] = PartResult(ErrorCode.SUCCEEDED)
         resp.latency_us = int((time.monotonic() - t0) * 1e6)
+        stats.add_value("storage.get_bound_latency_us", resp.latency_us)
         return resp
 
     def _collect_edge_props(self, engine, space: int, part: int, vid: int,

@@ -1,0 +1,140 @@
+"""HTTP admin endpoints.
+
+Role parity with the reference's `src/webservice/` (proxygen HTTP server
+per daemon): `/status` liveness, `/flags` get/set (GET ?name=a,b / PUT
+body name=value), `/get_stats?stats=metric.method.window,...` — plus
+custom handlers a daemon registers (the reference's storage admin/
+download/ingest endpoints hang off the same seam, WebService.h:31-49).
+
+Implemented over http.server (stdlib) on a daemon thread; handlers are
+plain callables `(query_params, body) -> (code, obj)`.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional, Tuple
+from urllib.parse import parse_qs, urlparse
+
+from .common.flags import FlagRegistry
+from .common.stats import StatsManager
+
+Handler = Callable[[Dict[str, str], bytes], Tuple[int, Any]]
+
+
+class WebService:
+    def __init__(self, name: str = "daemon",
+                 flags: Optional[FlagRegistry] = None,
+                 stats: Optional[StatsManager] = None,
+                 host: str = "127.0.0.1", port: int = 0):
+        self.name = name
+        self.flags = flags
+        self.stats = stats
+        self._handlers: Dict[str, Handler] = {}
+        self._server: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._host = host
+        self._port = port
+
+        self.register("/status", self._status_handler)
+        self.register("/flags", self._flags_handler)
+        self.register("/get_stats", self._stats_handler)
+
+    # ------------------------------------------------------------------
+    def register(self, path: str, handler: Handler) -> None:
+        self._handlers[path] = handler
+
+    def start(self) -> int:
+        ws = self
+
+        class _Req(BaseHTTPRequestHandler):
+            def log_message(self, *a):   # quiet
+                pass
+
+            def _serve(self, body: bytes):
+                u = urlparse(self.path)
+                h = ws._handlers.get(u.path)
+                if h is None:
+                    self.send_response(404)
+                    self.end_headers()
+                    self.wfile.write(b'{"error": "not found"}')
+                    return
+                params = {k: v[0] for k, v in parse_qs(u.query).items()}
+                try:
+                    code, obj = h(params, body)
+                except Exception as e:   # handler bug -> 500
+                    code, obj = 500, {"error": str(e)}
+                data = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self):
+                self._serve(b"")
+
+            def do_PUT(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self._serve(self.rfile.read(n))
+
+            do_POST = do_PUT
+
+        self._server = ThreadingHTTPServer((self._host, self._port), _Req)
+        self._port = self._server.server_address[1]
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        daemon=True,
+                                        name=f"webservice-{self.name}")
+        self._thread.start()
+        return self._port
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.shutdown()
+            self._server.server_close()
+            self._server = None
+
+    # ------------------------------------------------------------------
+    # built-in handlers
+    # ------------------------------------------------------------------
+    def _status_handler(self, params, body) -> Tuple[int, Any]:
+        return 200, {"status": "running", "name": self.name}
+
+    def _flags_handler(self, params, body) -> Tuple[int, Any]:
+        if self.flags is None:
+            return 200, {}
+        if body:
+            # PUT name=value[&name2=value2]
+            updates = {k: v[0] for k, v in parse_qs(body.decode()).items()}
+            applied = {}
+            for name, raw in updates.items():
+                try:
+                    val = json.loads(raw)
+                except ValueError:
+                    val = raw
+                applied[name] = self.flags.set(name, val)
+            return 200, applied
+        names = params.get("name")
+        items = self.flags.items()
+        if names:
+            want = set(names.split(","))
+            items = [it for it in items if it[0] in want]
+        return 200, {n: {"value": v, "mode": m} for n, v, m in items}
+
+    def _stats_handler(self, params, body) -> Tuple[int, Any]:
+        if self.stats is None:
+            return 200, {}
+        spec = params.get("stats")
+        if not spec:
+            return 200, self.stats.snapshot()
+        out = {}
+        for s in spec.split(","):
+            v = self.stats.read_stats(s.strip())
+            if v is not None:
+                out[s.strip()] = v
+        return 200, out

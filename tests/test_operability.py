@@ -1,0 +1,180 @@
+"""Operability subsystems: stats manager, flags, HTTP admin endpoints,
+console rendering (ref common/stats/StatsManager, webservice/,
+console/ — SURVEY §5)."""
+import json
+import urllib.request
+
+import pytest
+
+from nebula_tpu.common.flags import MUTABLE, IMMUTABLE, FlagRegistry
+from nebula_tpu.common.stats import Duration, StatsManager
+from nebula_tpu.console import Console, render_table
+from nebula_tpu.webservice import WebService
+
+
+# ---------------------------------------------------------------- stats
+
+def test_stats_counter_windows():
+    t = [1000.0]
+    sm = StatsManager(clock=lambda: t[0])
+    for _ in range(10):
+        sm.add_value("qps")
+    assert sm.read_stats("qps.count.60") == 10
+    assert sm.read_stats("qps.sum.60") == 10
+    assert sm.read_stats("qps.rate.60") == pytest.approx(10 / 60)
+    # values age out of the 60 s window but stay in the 600 s one
+    t[0] += 120
+    sm.add_value("qps")
+    assert sm.read_stats("qps.count.60") == 1
+    assert sm.read_stats("qps.count.600") == 11
+    assert sm.read_stats("qps.count.3600") == 11
+
+
+def test_stats_avg_and_percentiles():
+    t = [5000.0]
+    sm = StatsManager(clock=lambda: t[0])
+    for v in range(1, 101):
+        sm.add_value("lat", float(v))
+    assert sm.read_stats("lat.avg.60") == pytest.approx(50.5)
+    # log-bucketed percentiles: approximate but ordered
+    p50 = sm.read_stats("lat.p50.60")
+    p95 = sm.read_stats("lat.p95.60")
+    p99 = sm.read_stats("lat.p99.60")
+    assert p50 <= p95 <= p99
+    assert 30 <= p50 <= 80
+    assert p99 >= 80
+
+
+def test_stats_unknown_and_bad_specs():
+    sm = StatsManager()
+    assert sm.read_stats("nope.sum.60") is None
+    sm.add_value("m")
+    assert sm.read_stats("m.sum.61") is None       # bad window
+    assert sm.read_stats("m.bogus.60") is None     # bad method
+    assert sm.read_stats("m") is None
+
+
+def test_duration_records_us():
+    sm = StatsManager()
+    d = Duration(sm, "op_us")
+    us = d.record()
+    assert us >= 0
+    assert sm.read_stats("op_us.count.60") == 1
+
+
+# ---------------------------------------------------------------- flags
+
+def test_flags_declare_get_set_modes():
+    fr = FlagRegistry("TEST")
+    fr.declare("a", 1, MUTABLE)
+    fr.declare("b", "x", IMMUTABLE)
+    assert fr.get("a") == 1
+    assert fr.set("a", 2)
+    assert fr.get("a") == 2
+    assert not fr.set("b", "y")      # immutable
+    assert not fr.set("missing", 1)
+    seen = []
+    fr.watch(lambda n, v: seen.append((n, v)))
+    fr.set("a", 3)
+    assert seen == [("a", 3)]
+
+
+def test_flags_meta_roundtrip():
+    from nebula_tpu.meta.service import MetaService
+    meta = MetaService()
+    fr = FlagRegistry("GRAPHX")
+    fr.declare("alpha", 10)
+    fr.sync_to_meta(meta)
+    # an operator changes the cluster config; the daemon pulls it
+    assert meta.set_config("GRAPHX", "alpha", 42).ok()
+    assert fr.pull_from_meta(meta) == 1
+    assert fr.get("alpha") == 42
+
+
+# ---------------------------------------------------------------- web
+
+@pytest.fixture
+def web():
+    fr = FlagRegistry("WEB")
+    fr.declare("knob", 5)
+    sm = StatsManager()
+    sm.add_value("hits", 3.0)
+    ws = WebService("test-daemon", flags=fr, stats=sm)
+    port = ws.start()
+    yield ws, fr, sm, port
+    ws.stop()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}") as r:
+        return json.loads(r.read())
+
+
+def test_web_status(web):
+    ws, fr, sm, port = web
+    assert _get(port, "/status") == {"status": "running",
+                                     "name": "test-daemon"}
+
+
+def test_web_flags_get_and_put(web):
+    ws, fr, sm, port = web
+    assert _get(port, "/flags")["knob"]["value"] == 5
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/flags", data=b"knob=9", method="PUT")
+    with urllib.request.urlopen(req) as r:
+        assert json.loads(r.read()) == {"knob": True}
+    assert fr.get("knob") == 9
+
+
+def test_web_get_stats(web):
+    ws, fr, sm, port = web
+    out = _get(port, "/get_stats?stats=hits.sum.60,hits.count.60")
+    assert out["hits.sum.60"] == 3.0
+    assert out["hits.count.60"] == 1.0
+
+
+def test_web_404(web):
+    ws, fr, sm, port = web
+    with pytest.raises(urllib.error.HTTPError):
+        _get(port, "/nope")
+
+
+# ---------------------------------------------------------------- console
+
+def test_render_table():
+    out = render_table(["name", "age"], [["Tim", 42], ["Al", 7]])
+    lines = out.splitlines()
+    assert lines[0].startswith("+")
+    assert "| name | age |" in lines[1]
+    assert "| Tim  | 42  |" in out
+    assert "| Al   | 7   |" in out
+
+
+def test_console_batch(tmp_path, capsys):
+    import io
+    from nebula_tpu.cluster import InProcCluster
+    cluster = InProcCluster()
+    conn = cluster.connect()
+    buf = io.StringIO()
+    console = Console(conn, out=buf)
+    assert console.run_statement(
+        "CREATE SPACE cs(partition_num=1); USE cs;"
+        "CREATE TAG t(name string)")
+    assert console.run_statement(
+        'INSERT VERTEX t(name) VALUES 1:("x")')
+    assert console.run_statement("FETCH PROP ON t 1")
+    text = buf.getvalue()
+    assert "Execution succeeded" in text
+    assert "x" in text
+    assert not console.run_statement("exit")
+
+
+def test_console_error_rendering():
+    import io
+    from nebula_tpu.cluster import InProcCluster
+    cluster = InProcCluster()
+    conn = cluster.connect()
+    buf = io.StringIO()
+    console = Console(conn, out=buf)
+    console.run_statement("THIS IS NOT NGQL")
+    assert "[ERROR" in buf.getvalue()
