@@ -64,6 +64,54 @@ int64_t nwal_iter_data(nwal_iter *it, const uint8_t **out);
 void nwal_iter_next(nwal_iter *it);
 void nwal_iter_free(nwal_iter *it);
 
+/* ---------------------------------------------------------- KV engine */
+
+typedef struct nkv nkv;
+
+/* Open an engine. checkpoint_path may be NULL (pure in-memory) — if the
+ * file exists its contents are loaded. */
+nkv *nkv_open(const char *checkpoint_path);
+void nkv_close(nkv *e);
+
+int64_t nkv_count(nkv *e);
+int64_t nkv_version(nkv *e);        /* monotonic write counter */
+int64_t nkv_approx_size(nkv *e);    /* total key+value bytes */
+
+int32_t nkv_put(nkv *e, const uint8_t *k, int64_t klen,
+                const uint8_t *v, int64_t vlen);
+/* Returns value length and sets *out (valid until the next mutation),
+ * or -1 when the key is absent. */
+int64_t nkv_get(nkv *e, const uint8_t *k, int64_t klen,
+                const uint8_t **out);
+int32_t nkv_remove(nkv *e, const uint8_t *k, int64_t klen);
+int32_t nkv_remove_range(nkv *e, const uint8_t *s, int64_t slen,
+                         const uint8_t *x, int64_t xlen);
+int32_t nkv_remove_prefix(nkv *e, const uint8_t *p, int64_t plen);
+
+/* buf = n repetitions of [u32 klen][k][u32 vlen][v] */
+int32_t nkv_multi_put(nkv *e, const uint8_t *buf, int64_t len, int32_t n);
+/* buf = n repetitions of [u32 klen][k] */
+int32_t nkv_multi_remove(nkv *e, const uint8_t *buf, int64_t len, int32_t n);
+
+/* Scans materialize matches into a malloc'd packed buffer
+ * ([u32 klen][k][u32 vlen][v])*; caller frees with nkv_buf_free.
+ * Returns buffer byte length (0 when empty), sets *out and *n_out. */
+int64_t nkv_scan_prefix(nkv *e, const uint8_t *p, int64_t plen,
+                        uint8_t **out, int64_t *n_out);
+int64_t nkv_scan_range(nkv *e, const uint8_t *s, int64_t slen,
+                       const uint8_t *x, int64_t xlen,
+                       uint8_t **out, int64_t *n_out);
+/* Newest-version dedup scan — the getBound hot-loop primitive: keys
+ * sharing key[:-group_suffix] form one logical record whose first
+ * (= newest, big-endian inverted-timestamp version) row wins. */
+int64_t nkv_scan_prefix_dedup(nkv *e, const uint8_t *p, int64_t plen,
+                              int32_t group_suffix,
+                              uint8_t **out, int64_t *n_out);
+void nkv_buf_free(uint8_t *buf);
+
+/* Persist a point-in-time checkpoint (atomic rename). */
+int32_t nkv_checkpoint(nkv *e, const char *path);
+
 #ifdef __cplusplus
 }
 #endif

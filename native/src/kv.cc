@@ -1,0 +1,341 @@
+// Ordered KV engine.
+//
+// Role parity with the reference's RocksEngine (ref
+// kvstore/RocksEngine.{h,cpp}): one ordered namespace per (space,
+// data-path) with prefix/range scans, batched writes, bulk ingest and a
+// point-in-time checkpoint. The newest-version dedup scan implements
+// the QueryBoundProcessor hot-loop primitive (ref
+// storage/QueryBaseProcessor.inl:380-458: iterate prefix, keep the
+// first — newest, because versions are stored inverted big-endian —
+// row of every (rank,dst) group) so the Python processor loop stays out
+// of the O(edges) path.
+//
+// Checkpoint format: "NKVC" | u32 version | u64 count |
+//                    ([u32 klen][k][u32 vlen][v])* | u64 count (trailer)
+
+#include "nebula_native.h"
+
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+constexpr char kMagic[4] = {'N', 'K', 'V', 'C'};
+constexpr uint32_t kVersion = 1;
+
+std::string next_prefix(const std::string &p) {
+  // smallest string greater than every key starting with p
+  std::string q = p;
+  while (!q.empty()) {
+    unsigned char c = static_cast<unsigned char>(q.back());
+    if (c != 0xFF) {
+      q.back() = static_cast<char>(c + 1);
+      return q;
+    }
+    q.pop_back();
+  }
+  return q;  // empty => no upper bound
+}
+
+void append_u32(std::string &buf, uint32_t v) {
+  buf.append(reinterpret_cast<const char *>(&v), 4);
+}
+
+}  // namespace
+
+struct nkv {
+  std::map<std::string, std::string> data;
+  std::mutex mu;
+  int64_t version = 0;
+  int64_t bytes = 0;
+  std::string get_scratch;
+  std::string ckpt_path;
+
+  bool load(const std::string &path) {
+    FILE *f = fopen(path.c_str(), "rb");
+    if (!f) return true;  // absent: fresh engine
+    char magic[4];
+    uint32_t ver;
+    uint64_t count;
+    if (fread(magic, 1, 4, f) != 4 || memcmp(magic, kMagic, 4) != 0 ||
+        fread(&ver, 4, 1, f) != 1 || ver != kVersion ||
+        fread(&count, 8, 1, f) != 1) {
+      fclose(f);
+      return false;
+    }
+    std::string k, v;
+    for (uint64_t i = 0; i < count; i++) {
+      uint32_t klen, vlen;
+      if (fread(&klen, 4, 1, f) != 1) { fclose(f); return false; }
+      k.resize(klen);
+      if (klen && fread(&k[0], 1, klen, f) != klen) { fclose(f); return false; }
+      if (fread(&vlen, 4, 1, f) != 1) { fclose(f); return false; }
+      v.resize(vlen);
+      if (vlen && fread(&v[0], 1, vlen, f) != vlen) { fclose(f); return false; }
+      bytes += static_cast<int64_t>(k.size() + v.size());
+      data.emplace_hint(data.end(), k, v);
+    }
+    uint64_t trailer = 0;
+    bool ok = fread(&trailer, 8, 1, f) == 1 && trailer == count;
+    fclose(f);
+    if (!ok) { data.clear(); bytes = 0; }
+    return ok;
+  }
+
+  int32_t checkpoint(const std::string &path) {
+    std::lock_guard<std::mutex> g(mu);
+    std::string tmp = path + ".tmp";
+    FILE *f = fopen(tmp.c_str(), "wb");
+    if (!f) return -1;
+    uint64_t count = data.size();
+    fwrite(kMagic, 1, 4, f);
+    fwrite(&kVersion, 4, 1, f);
+    fwrite(&count, 8, 1, f);
+    for (const auto &kv : data) {
+      uint32_t klen = static_cast<uint32_t>(kv.first.size());
+      uint32_t vlen = static_cast<uint32_t>(kv.second.size());
+      fwrite(&klen, 4, 1, f);
+      fwrite(kv.first.data(), 1, klen, f);
+      fwrite(&vlen, 4, 1, f);
+      fwrite(kv.second.data(), 1, vlen, f);
+    }
+    fwrite(&count, 8, 1, f);
+    if (fflush(f) != 0) { fclose(f); return -2; }
+    fclose(f);
+    return rename(tmp.c_str(), path.c_str()) == 0 ? 0 : -3;
+  }
+
+  void put_one(const std::string &k, const std::string &v) {
+    auto it = data.find(k);
+    if (it != data.end()) {
+      bytes += static_cast<int64_t>(v.size()) -
+               static_cast<int64_t>(it->second.size());
+      it->second = v;
+    } else {
+      bytes += static_cast<int64_t>(k.size() + v.size());
+      data.emplace(k, v);
+    }
+  }
+
+  void erase_range(const std::string &start, const std::string &end_excl) {
+    auto lo = data.lower_bound(start);
+    auto hi = end_excl.empty() ? data.end() : data.lower_bound(end_excl);
+    for (auto it = lo; it != hi; ++it)
+      bytes -= static_cast<int64_t>(it->first.size() + it->second.size());
+    data.erase(lo, hi);
+  }
+};
+
+extern "C" {
+
+nkv *nkv_open(const char *checkpoint_path) {
+  nkv *e = new nkv();
+  if (checkpoint_path && *checkpoint_path) {
+    e->ckpt_path = checkpoint_path;
+    if (!e->load(e->ckpt_path)) {
+      delete e;
+      return nullptr;
+    }
+  }
+  return e;
+}
+
+void nkv_close(nkv *e) { delete e; }
+
+int64_t nkv_count(nkv *e) {
+  std::lock_guard<std::mutex> g(e->mu);
+  return static_cast<int64_t>(e->data.size());
+}
+
+int64_t nkv_version(nkv *e) {
+  std::lock_guard<std::mutex> g(e->mu);
+  return e->version;
+}
+
+int64_t nkv_approx_size(nkv *e) {
+  std::lock_guard<std::mutex> g(e->mu);
+  return e->bytes;
+}
+
+int32_t nkv_put(nkv *e, const uint8_t *k, int64_t klen,
+                const uint8_t *v, int64_t vlen) {
+  std::lock_guard<std::mutex> g(e->mu);
+  e->put_one(std::string(reinterpret_cast<const char *>(k), klen),
+             std::string(reinterpret_cast<const char *>(v), vlen));
+  e->version++;
+  return 0;
+}
+
+int64_t nkv_get(nkv *e, const uint8_t *k, int64_t klen,
+                const uint8_t **out) {
+  std::lock_guard<std::mutex> g(e->mu);
+  auto it = e->data.find(std::string(reinterpret_cast<const char *>(k), klen));
+  if (it == e->data.end()) return -1;
+  e->get_scratch = it->second;
+  *out = reinterpret_cast<const uint8_t *>(e->get_scratch.data());
+  return static_cast<int64_t>(e->get_scratch.size());
+}
+
+int32_t nkv_remove(nkv *e, const uint8_t *k, int64_t klen) {
+  std::lock_guard<std::mutex> g(e->mu);
+  auto it = e->data.find(std::string(reinterpret_cast<const char *>(k), klen));
+  if (it != e->data.end()) {
+    e->bytes -= static_cast<int64_t>(it->first.size() + it->second.size());
+    e->data.erase(it);
+  }
+  e->version++;
+  return 0;
+}
+
+int32_t nkv_remove_range(nkv *e, const uint8_t *s, int64_t slen,
+                         const uint8_t *x, int64_t xlen) {
+  std::lock_guard<std::mutex> g(e->mu);
+  e->erase_range(std::string(reinterpret_cast<const char *>(s), slen),
+                 std::string(reinterpret_cast<const char *>(x), xlen));
+  e->version++;
+  return 0;
+}
+
+int32_t nkv_remove_prefix(nkv *e, const uint8_t *p, int64_t plen) {
+  std::lock_guard<std::mutex> g(e->mu);
+  std::string prefix(reinterpret_cast<const char *>(p), plen);
+  e->erase_range(prefix, next_prefix(prefix));
+  e->version++;
+  return 0;
+}
+
+int32_t nkv_multi_put(nkv *e, const uint8_t *buf, int64_t len, int32_t n) {
+  std::lock_guard<std::mutex> g(e->mu);
+  int64_t off = 0;
+  for (int32_t i = 0; i < n; i++) {
+    if (off + 4 > len) return -1;
+    uint32_t klen;
+    memcpy(&klen, buf + off, 4);
+    off += 4;
+    if (off + klen + 4 > len) return -1;
+    std::string k(reinterpret_cast<const char *>(buf + off), klen);
+    off += klen;
+    uint32_t vlen;
+    memcpy(&vlen, buf + off, 4);
+    off += 4;
+    if (off + vlen > len) return -1;
+    std::string v(reinterpret_cast<const char *>(buf + off), vlen);
+    off += vlen;
+    e->put_one(k, v);
+  }
+  e->version++;
+  return 0;
+}
+
+int32_t nkv_multi_remove(nkv *e, const uint8_t *buf, int64_t len, int32_t n) {
+  std::lock_guard<std::mutex> g(e->mu);
+  int64_t off = 0;
+  for (int32_t i = 0; i < n; i++) {
+    if (off + 4 > len) return -1;
+    uint32_t klen;
+    memcpy(&klen, buf + off, 4);
+    off += 4;
+    if (off + klen > len) return -1;
+    auto it = e->data.find(
+        std::string(reinterpret_cast<const char *>(buf + off), klen));
+    off += klen;
+    if (it != e->data.end()) {
+      e->bytes -= static_cast<int64_t>(it->first.size() + it->second.size());
+      e->data.erase(it);
+    }
+  }
+  e->version++;
+  return 0;
+}
+
+static int64_t pack_out(const std::vector<std::pair<const std::string *,
+                                                    const std::string *>> &hits,
+                        uint8_t **out, int64_t *n_out) {
+  int64_t total = 0;
+  for (const auto &kv : hits)
+    total += 8 + static_cast<int64_t>(kv.first->size() + kv.second->size());
+  if (total == 0) {
+    *out = nullptr;
+    *n_out = 0;
+    return 0;
+  }
+  uint8_t *buf = static_cast<uint8_t *>(malloc(static_cast<size_t>(total)));
+  int64_t off = 0;
+  for (const auto &kv : hits) {
+    uint32_t klen = static_cast<uint32_t>(kv.first->size());
+    uint32_t vlen = static_cast<uint32_t>(kv.second->size());
+    memcpy(buf + off, &klen, 4);
+    off += 4;
+    memcpy(buf + off, kv.first->data(), klen);
+    off += klen;
+    memcpy(buf + off, &vlen, 4);
+    off += 4;
+    memcpy(buf + off, kv.second->data(), vlen);
+    off += vlen;
+  }
+  *out = buf;
+  *n_out = static_cast<int64_t>(hits.size());
+  return total;
+}
+
+int64_t nkv_scan_range(nkv *e, const uint8_t *s, int64_t slen,
+                       const uint8_t *x, int64_t xlen,
+                       uint8_t **out, int64_t *n_out) {
+  std::lock_guard<std::mutex> g(e->mu);
+  std::string start(reinterpret_cast<const char *>(s), slen);
+  std::string end(reinterpret_cast<const char *>(x), xlen);
+  auto lo = e->data.lower_bound(start);
+  auto hi = end.empty() ? e->data.end() : e->data.lower_bound(end);
+  std::vector<std::pair<const std::string *, const std::string *>> hits;
+  for (auto it = lo; it != hi; ++it)
+    hits.emplace_back(&it->first, &it->second);
+  return pack_out(hits, out, n_out);
+}
+
+int64_t nkv_scan_prefix(nkv *e, const uint8_t *p, int64_t plen,
+                        uint8_t **out, int64_t *n_out) {
+  std::string prefix(reinterpret_cast<const char *>(p), plen);
+  std::string end = next_prefix(prefix);
+  return nkv_scan_range(e, p, plen,
+                        reinterpret_cast<const uint8_t *>(end.data()),
+                        static_cast<int64_t>(end.size()), out, n_out);
+}
+
+int64_t nkv_scan_prefix_dedup(nkv *e, const uint8_t *p, int64_t plen,
+                              int32_t group_suffix,
+                              uint8_t **out, int64_t *n_out) {
+  std::lock_guard<std::mutex> g(e->mu);
+  std::string prefix(reinterpret_cast<const char *>(p), plen);
+  std::string end = next_prefix(prefix);
+  auto lo = e->data.lower_bound(prefix);
+  auto hi = end.empty() ? e->data.end() : e->data.lower_bound(end);
+  std::vector<std::pair<const std::string *, const std::string *>> hits;
+  const std::string *prev_key = nullptr;
+  for (auto it = lo; it != hi; ++it) {
+    const std::string &k = it->first;
+    size_t glen = k.size() >= static_cast<size_t>(group_suffix)
+                      ? k.size() - static_cast<size_t>(group_suffix)
+                      : k.size();
+    if (prev_key != nullptr && prev_key->size() >= static_cast<size_t>(group_suffix)) {
+      size_t pglen = prev_key->size() - static_cast<size_t>(group_suffix);
+      if (pglen == glen && memcmp(prev_key->data(), k.data(), glen) == 0)
+        continue;  // same group: an older version, skip
+    }
+    hits.emplace_back(&it->first, &it->second);
+    prev_key = &it->first;
+  }
+  return pack_out(hits, out, n_out);
+}
+
+void nkv_buf_free(uint8_t *buf) { free(buf); }
+
+int32_t nkv_checkpoint(nkv *e, const char *path) {
+  return e->checkpoint(path ? path : e->ckpt_path);
+}
+
+}  // extern "C"

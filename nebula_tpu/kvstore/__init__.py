@@ -1,4 +1,21 @@
 from .iface import KVEngine, KVIterator  # noqa: F401
 from .memengine import MemEngine  # noqa: F401
+
+def native_engine_factory(data_root=None):
+    """Engine factory producing native C++ engines (RocksEngine role);
+    falls back to MemEngine when the native toolchain is unavailable."""
+    import os
+    from .. import native as _native
+    if not _native.available():
+        return lambda space_id: MemEngine()
+    from .nativeengine import NativeEngine
+    def factory(space_id):
+        path = None
+        if data_root:
+            os.makedirs(data_root, exist_ok=True)
+            path = os.path.join(data_root, f"space_{space_id}.nkv")
+        return NativeEngine(path)
+    return factory
+
 from .store import GraphStore, SpaceInfo  # noqa: F401
 from .part import Part  # noqa: F401

@@ -1,0 +1,191 @@
+"""KVEngine backed by the native C++ ordered-map engine.
+
+Role parity with the reference's default RocksEngine (ref
+kvstore/RocksEngine.{h,cpp}): the engine below every Part, with batched
+writes, materialized prefix/range scans, bulk ingest, a checkpoint for
+durability (the raft WAL above replays the tail), and the
+newest-version-dedup scan the storage processors use as their hot loop.
+"""
+from __future__ import annotations
+
+import ctypes
+import struct
+from typing import Iterable, List, Optional, Tuple
+
+from .. import native
+from ..common.status import ErrorCode, Status
+from .iface import KVEngine, KVIterator
+
+KV = Tuple[bytes, bytes]
+_U32 = struct.Struct("<I")
+
+
+def _pack_kvs(kvs: List[KV]) -> bytes:
+    parts = []
+    for k, v in kvs:
+        parts.append(_U32.pack(len(k)))
+        parts.append(k)
+        parts.append(_U32.pack(len(v)))
+        parts.append(v)
+    return b"".join(parts)
+
+
+def _pack_keys(keys: List[bytes]) -> bytes:
+    parts = []
+    for k in keys:
+        parts.append(_U32.pack(len(k)))
+        parts.append(k)
+    return b"".join(parts)
+
+
+def _unpack_kvs(raw: bytes, n: int) -> List[KV]:
+    out = []
+    off = 0
+    for _ in range(n):
+        (klen,) = _U32.unpack_from(raw, off)
+        off += 4
+        k = raw[off:off + klen]
+        off += klen
+        (vlen,) = _U32.unpack_from(raw, off)
+        off += 4
+        v = raw[off:off + vlen]
+        off += vlen
+        out.append((k, v))
+    return out
+
+
+class _ListIterator(KVIterator):
+    def __init__(self, items: List[KV]):
+        self._items = items
+        self._i = 0
+
+    def valid(self) -> bool:
+        return self._i < len(self._items)
+
+    def next(self) -> None:
+        self._i += 1
+
+    def key(self) -> bytes:
+        return self._items[self._i][0]
+
+    def value(self) -> bytes:
+        return self._items[self._i][1]
+
+
+class NativeEngine(KVEngine):
+    def __init__(self, checkpoint_path: Optional[str] = None):
+        self._lib = native.load()
+        self._h = self._lib.nkv_open(
+            checkpoint_path.encode() if checkpoint_path else None)
+        if not self._h:
+            raise OSError(f"cannot open native engine at {checkpoint_path}")
+        self._ckpt = checkpoint_path
+        self._closed = False
+
+    @property
+    def write_version(self) -> int:          # type: ignore[override]
+        return self._lib.nkv_version(self._h)
+
+    @write_version.setter
+    def write_version(self, v: int) -> None:
+        pass  # native counter is authoritative
+
+    # --- reads --------------------------------------------------------
+    def get(self, key: bytes) -> Optional[bytes]:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = self._lib.nkv_get(self._h, key, len(key), ctypes.byref(out))
+        if n < 0:
+            return None
+        return ctypes.string_at(out, n) if n else b""
+
+    def _scan(self, fn, *args) -> List[KV]:
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        n = ctypes.c_int64()
+        total = fn(self._h, *args, ctypes.byref(out), ctypes.byref(n))
+        if total <= 0:
+            return []
+        try:
+            raw = ctypes.string_at(out, total)
+        finally:
+            self._lib.nkv_buf_free(out)
+        return _unpack_kvs(raw, n.value)
+
+    def prefix(self, prefix: bytes) -> KVIterator:
+        return _ListIterator(
+            self._scan(self._lib.nkv_scan_prefix, prefix, len(prefix)))
+
+    def range(self, start: bytes, end: bytes) -> KVIterator:
+        return _ListIterator(
+            self._scan(self._lib.nkv_scan_range, start, len(start),
+                       end, len(end)))
+
+    def prefix_dedup(self, prefix: bytes,
+                     group_suffix: int = 8) -> List[KV]:
+        """Newest row per version group — the getBound hot-loop scan."""
+        return self._scan(self._lib.nkv_scan_prefix_dedup,
+                          prefix, len(prefix), group_suffix)
+
+    # --- writes -------------------------------------------------------
+    def put(self, key: bytes, value: bytes) -> Status:
+        self._lib.nkv_put(self._h, key, len(key), value, len(value))
+        return Status.OK()
+
+    def multi_put(self, kvs: Iterable[KV]) -> Status:
+        kvs = list(kvs)
+        buf = _pack_kvs(kvs)
+        rc = self._lib.nkv_multi_put(self._h, buf, len(buf), len(kvs))
+        return Status.OK() if rc == 0 else \
+            Status.error(ErrorCode.E_INVALID_DATA, f"multi_put rc={rc}")
+
+    def remove(self, key: bytes) -> Status:
+        self._lib.nkv_remove(self._h, key, len(key))
+        return Status.OK()
+
+    def multi_remove(self, keys: Iterable[bytes]) -> Status:
+        ks = list(keys)
+        buf = _pack_keys(ks)
+        rc = self._lib.nkv_multi_remove(self._h, buf, len(buf), len(ks))
+        return Status.OK() if rc == 0 else \
+            Status.error(ErrorCode.E_INVALID_DATA, f"multi_remove rc={rc}")
+
+    def remove_range(self, start: bytes, end: bytes) -> Status:
+        self._lib.nkv_remove_range(self._h, start, len(start), end, len(end))
+        return Status.OK()
+
+    def remove_prefix(self, prefix: bytes) -> Status:
+        self._lib.nkv_remove_prefix(self._h, prefix, len(prefix))
+        return Status.OK()
+
+    # --- maintenance --------------------------------------------------
+    def ingest(self, kvs: Iterable[KV]) -> Status:
+        return self.multi_put(kvs)
+
+    def flush(self) -> Status:
+        if self._ckpt:
+            rc = self._lib.nkv_checkpoint(self._h, self._ckpt.encode())
+            if rc != 0:
+                return Status.error(ErrorCode.E_CHECKPOINT_ERROR,
+                                    f"checkpoint rc={rc}")
+        return Status.OK()
+
+    def checkpoint(self, path: str) -> Status:
+        rc = self._lib.nkv_checkpoint(self._h, path.encode())
+        return Status.OK() if rc == 0 else \
+            Status.error(ErrorCode.E_CHECKPOINT_ERROR, f"checkpoint rc={rc}")
+
+    def approximate_size(self) -> int:
+        return self._lib.nkv_approx_size(self._h)
+
+    def total_keys(self) -> int:
+        return self._lib.nkv_count(self._h)
+
+    def close(self) -> None:
+        if not self._closed:
+            self._lib.nkv_close(self._h)
+            self._closed = True
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

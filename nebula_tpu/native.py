@@ -84,6 +84,45 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.nwal_iter_next.argtypes = [vp]
     lib.nwal_iter_free.restype = None
     lib.nwal_iter_free.argtypes = [vp]
+
+    # ------------------------------------------------------------ KV
+    lib.nkv_open.restype = vp
+    lib.nkv_open.argtypes = [ctypes.c_char_p]
+    lib.nkv_close.restype = None
+    lib.nkv_close.argtypes = [vp]
+    for fn in ("nkv_count", "nkv_version", "nkv_approx_size"):
+        getattr(lib, fn).restype = i64
+        getattr(lib, fn).argtypes = [vp]
+    lib.nkv_put.restype = i32
+    lib.nkv_put.argtypes = [vp, ctypes.c_char_p, i64, ctypes.c_char_p, i64]
+    lib.nkv_get.restype = i64
+    lib.nkv_get.argtypes = [vp, ctypes.c_char_p, i64, ctypes.POINTER(u8p)]
+    lib.nkv_remove.restype = i32
+    lib.nkv_remove.argtypes = [vp, ctypes.c_char_p, i64]
+    lib.nkv_remove_range.restype = i32
+    lib.nkv_remove_range.argtypes = [vp, ctypes.c_char_p, i64,
+                                     ctypes.c_char_p, i64]
+    lib.nkv_remove_prefix.restype = i32
+    lib.nkv_remove_prefix.argtypes = [vp, ctypes.c_char_p, i64]
+    lib.nkv_multi_put.restype = i32
+    lib.nkv_multi_put.argtypes = [vp, ctypes.c_char_p, i64, i32]
+    lib.nkv_multi_remove.restype = i32
+    lib.nkv_multi_remove.argtypes = [vp, ctypes.c_char_p, i64, i32]
+    lib.nkv_scan_prefix.restype = i64
+    lib.nkv_scan_prefix.argtypes = [vp, ctypes.c_char_p, i64,
+                                    ctypes.POINTER(u8p), ctypes.POINTER(i64)]
+    lib.nkv_scan_range.restype = i64
+    lib.nkv_scan_range.argtypes = [vp, ctypes.c_char_p, i64,
+                                   ctypes.c_char_p, i64,
+                                   ctypes.POINTER(u8p), ctypes.POINTER(i64)]
+    lib.nkv_scan_prefix_dedup.restype = i64
+    lib.nkv_scan_prefix_dedup.argtypes = [vp, ctypes.c_char_p, i64, i32,
+                                          ctypes.POINTER(u8p),
+                                          ctypes.POINTER(i64)]
+    lib.nkv_buf_free.restype = None
+    lib.nkv_buf_free.argtypes = [u8p]
+    lib.nkv_checkpoint.restype = i32
+    lib.nkv_checkpoint.argtypes = [vp, ctypes.c_char_p]
     return lib
 
 

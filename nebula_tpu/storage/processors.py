@@ -200,7 +200,13 @@ class StorageService:
                             vd: VertexData) -> None:
         edge_name = self.sm.edge_name(space, etype) or str(abs(etype))
         ctx.edge_name = edge_name
-        it = engine.prefix(ku.edge_prefix(part, vid, etype))
+        prefix = ku.edge_prefix(part, vid, etype)
+        if hasattr(engine, "prefix_dedup"):
+            # native hot loop: version dedup happens inside the engine
+            # (ref collectEdgeProps .inl:403-407 done in C++)
+            it = engine.prefix_dedup(prefix, group_suffix=8)
+        else:
+            it = engine.prefix(prefix)
         last_group: Optional[Tuple[int, int]] = None
         count = 0
         for k, v in it:
