@@ -8,17 +8,18 @@ exchange that the reference does with one thrift RPC per peer host per
 hop becomes ONE `lax.all_to_all` over ICI per hop — inside the same
 compiled loop, no host round-trips.
 
+Like the single-chip kernels (traverse.py), the advance is scatter-free:
+each device's edge block is dst-sorted at build time, so its
+contribution to every partition's next frontier is a cumsum + two
+static boundary gathers over [local_parts, P*cap_v] segments. The
+[P*cap_v] hit vector is then split into per-device blocks and
+transposed with all_to_all; the receiving device ORs the D
+contributions into its local frontier.
+
 Layout: with P partitions over D devices (P % D == 0), device d owns the
-contiguous partition block [d*P/D, (d+1)*P/D). Each hop:
-
-  local:    active = frontier[edge_src] & type_ok            (per device)
-  scatter:  flat_hits[P*cap_v] |= active  (hits for ALL partitions)
-  exchange: all_to_all splits flat_hits into D blocks and transposes —
-            device d receives every device's hits for d's partitions
-  reduce:   OR over the D contributions -> new local frontier
-
-This mirrors how the scaling-book recipe maps sharded SpMV: annotate
-shardings, let XLA insert the collective, keep the loop on device.
+contiguous partition block [d*P/D, (d+1)*P/D). This mirrors how the
+scaling-book recipe maps sharded SpMV: annotate shardings, let XLA
+insert the collective, keep the loop on device.
 """
 from __future__ import annotations
 
@@ -39,13 +40,20 @@ def make_mesh(devices: Optional[List] = None) -> Mesh:
     return Mesh(np.array(devices), (AXIS,))
 
 
-def _local_advance(frontier, edge_src, edge_gidx, edge_ok, num_parts, cap_v):
-    """One hop on one device's partition block, returning the full-space
-    hit vector (this device's contribution to every partition)."""
+def _local_hits(frontier, edge_src, edge_ok, seg_starts, seg_ends):
+    """One hop on one device's partition block: the full-space hit
+    vector (this device's contribution to every partition) plus the
+    local active-edge mask.
+
+    frontier: bool[localP, cap_v]; seg_*: int32[localP, P*cap_v]
+    -> (hits bool[P*cap_v], active bool[localP, cap_e])
+    """
     active = jnp.take_along_axis(frontier, edge_src, axis=1) & edge_ok
-    flat = jnp.zeros((num_parts * cap_v + 1,), dtype=jnp.bool_)
-    flat = flat.at[edge_gidx.reshape(-1)].max(active.reshape(-1))
-    return flat[:num_parts * cap_v], active
+    S = jnp.cumsum(active.astype(jnp.int32), axis=1)
+    S0 = jnp.pad(S, ((0, 0), (1, 0)))
+    counts = (jnp.take_along_axis(S0, seg_ends, axis=1)
+              - jnp.take_along_axis(S0, seg_starts, axis=1))
+    return counts.sum(axis=0) > 0, active
 
 
 def _exchange(flat_hits, num_devices, local_block):
@@ -56,11 +64,12 @@ def _exchange(flat_hits, num_devices, local_block):
     return recv.reshape(num_devices, local_block).any(axis=0)
 
 
-def multi_hop_sharded(mesh: Mesh, frontier0, steps, edge_src, edge_gidx,
-                      edge_etype, edge_valid, req_types
+def multi_hop_sharded(mesh: Mesh, frontier0, steps, edge_src, edge_etype,
+                      edge_valid, seg_starts, seg_ends, req_types
                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Distributed GO: returns (final_frontier [P,cap_v], final_active
-    [P,cap_e]), both sharded over the mesh partition axis.
+    [P,cap_e] in device dst-sorted order), both sharded over the mesh
+    partition axis.
 
     All inputs are global [P, ...] arrays; P must divide by mesh size.
     """
@@ -73,28 +82,28 @@ def multi_hop_sharded(mesh: Mesh, frontier0, steps, edge_src, edge_gidx,
     from jax import shard_map
 
     @partial(shard_map, mesh=mesh,
-             in_specs=(P(AXIS), None, P(AXIS), P(AXIS), P(AXIS), P(AXIS), None),
+             in_specs=(P(AXIS), None, P(AXIS), P(AXIS), P(AXIS), P(AXIS),
+                       P(AXIS), None),
              out_specs=(P(AXIS), P(AXIS)))
-    def run(frontier, steps_, src, gidx, etype, valid, req):
+    def run(frontier, steps_, src, etype, valid, starts, ends, req):
         edge_ok = (etype[None] == req[:, None, None]).any(0) & valid
 
         def body(_, f):
-            flat, _active = _local_advance(f, src, gidx, edge_ok,
-                                           num_parts, cap_v)
-            nxt = _exchange(flat, num_devices, local_block)
+            hits, _active = _local_hits(f, src, edge_ok, starts, ends)
+            nxt = _exchange(hits, num_devices, local_block)
             return nxt.reshape(parts_per_dev, cap_v)
 
         f = lax.fori_loop(0, steps_ - 1, body, frontier)
         final_active = jnp.take_along_axis(f, src, axis=1) & edge_ok
         return f, final_active
 
-    return jax.jit(run)(frontier0, steps, edge_src, edge_gidx, edge_etype,
-                        edge_valid, req_types)
+    return jax.jit(run)(frontier0, steps, edge_src, edge_etype, edge_valid,
+                        seg_starts, seg_ends, req_types)
 
 
 def multi_hop_count_sharded(mesh: Mesh, frontier0, steps, edge_src,
-                            edge_gidx, edge_etype, edge_valid, req_types
-                            ) -> jnp.ndarray:
+                            edge_etype, edge_valid, seg_starts, seg_ends,
+                            req_types) -> jnp.ndarray:
     """Distributed total-edges-traversed counter (bench metric)."""
     num_devices = mesh.devices.size
     num_parts, cap_v = frontier0.shape
@@ -105,17 +114,17 @@ def multi_hop_count_sharded(mesh: Mesh, frontier0, steps, edge_src,
     from jax import shard_map
 
     @partial(shard_map, mesh=mesh,
-             in_specs=(P(AXIS), None, P(AXIS), P(AXIS), P(AXIS), P(AXIS), None),
+             in_specs=(P(AXIS), None, P(AXIS), P(AXIS), P(AXIS), P(AXIS),
+                       P(AXIS), None),
              out_specs=P())
-    def run(frontier, steps_, src, gidx, etype, valid, req):
+    def run(frontier, steps_, src, etype, valid, starts, ends, req):
         edge_ok = (etype[None] == req[:, None, None]).any(0) & valid
 
         def body(_, state):
             f, total = state
-            flat, active = _local_advance(f, src, gidx, edge_ok,
-                                          num_parts, cap_v)
+            hits, active = _local_hits(f, src, edge_ok, starts, ends)
             total = total + active.sum(dtype=jnp.int64)
-            nxt = _exchange(flat, num_devices, local_block)
+            nxt = _exchange(hits, num_devices, local_block)
             return nxt.reshape(parts_per_dev, cap_v), total
 
         # the carry must start device-varying to match the loop output
@@ -124,8 +133,8 @@ def multi_hop_count_sharded(mesh: Mesh, frontier0, steps, edge_src,
         _, total = lax.fori_loop(0, steps_, body, (frontier, zero))
         return lax.psum(total, AXIS)
 
-    return jax.jit(run)(frontier0, steps, edge_src, edge_gidx, edge_etype,
-                        edge_valid, req_types)
+    return jax.jit(run)(frontier0, steps, edge_src, edge_etype, edge_valid,
+                        seg_starts, seg_ends, req_types)
 
 
 def shard_snapshot_arrays(mesh: Mesh, snap) -> None:
@@ -133,6 +142,8 @@ def shard_snapshot_arrays(mesh: Mesh, snap) -> None:
     the sharded kernels consume them without host transfers."""
     sharding = NamedSharding(mesh, P(AXIS))
     snap.d_edge_src = jax.device_put(snap.d_edge_src, sharding)
-    snap.d_edge_gidx = jax.device_put(snap.d_edge_gidx, sharding)
     snap.d_edge_etype = jax.device_put(snap.d_edge_etype, sharding)
     snap.d_edge_valid = jax.device_put(snap.d_edge_valid, sharding)
+    snap.d_seg_starts = jax.device_put(snap.d_seg_starts, sharding)
+    snap.d_seg_ends = jax.device_put(snap.d_seg_ends, sharding)
+    snap.d_edge_gidx = jax.device_put(snap.d_edge_gidx, sharding)

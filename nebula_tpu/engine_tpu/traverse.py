@@ -1,27 +1,39 @@
-"""Device traversal kernels: dense-mask BFS frontier advance.
+"""Device traversal kernels: scatter-free BFS frontier advance.
 
 The TPU-native replacement for the reference's per-hop RPC loop
 (graphd re-crossing the network every step, ref SURVEY.md §3.1): the
-whole multi-hop expansion compiles to ONE XLA program —
+whole multi-hop expansion compiles to ONE XLA program.
 
-    per hop:  gather   active = frontier[edge_src] & type_ok      (VPU)
-              scatter  hits[dst_gidx] |= active                   (HBM)
-    loop:     lax.fori_loop over hops (dynamic trip count, no retrace)
+Why no scatter: XLA lowers scatter on TPU to a mostly-serialized
+update loop, which made the first dense-mask implementation ~1000x
+slower than the data movement justifies. Instead the edges of every
+shard are sorted by destination global index AT BUILD TIME (a static
+permutation — the graph is a snapshot), which turns a hop into purely
+parallel, bandwidth-bound primitives:
 
-A dense bool frontier per partition gives within-step dst dedup for
-free — exactly the reference's `getDstIdsFromResp` unordered_set
-semantics (GO revisits previously-seen vertices across steps; BFS-style
-visited masks are used only by shortest-path, which tracks first-hit
-depth in `dist`).
+    gather   active[e] = frontier[edge_src[e]] & type_ok[e]   (VPU)
+    scan     S = cumsum(active) along the edge axis            (HBM)
+    gather   reached[v] = S[seg_end[v]] - S[seg_start[v]] > 0
+    loop     lax.fori_loop over hops (dynamic trip count, no retrace)
 
-All shapes are static: [P, cap_v] frontiers, [P, cap_e] edge arrays,
-requested edge types padded to a fixed-width vector. Invalid/padded
-edges scatter into a dump slot at index P*cap_v.
+seg_start/seg_end are static per-destination boundaries into each
+shard's dst-sorted edge array (searchsorted at build time). A vertex
+may receive edges from several shards; contributions are summed over
+the shard axis (single chip) or exchanged with all_to_all + OR
+(distributed, see distributed.py).
+
+Dense bool frontiers give within-step dst dedup for free — exactly the
+reference's `getDstIdsFromResp` unordered_set semantics (GO revisits
+previously-seen vertices across steps; BFS-style visited masks are used
+only by shortest-path, which tracks first-hit depth in `dist`).
+
+All shapes are static: [P, cap_v] frontiers, [P, cap_e] edge arrays in
+dst-sorted device order, [P, P*cap_v] segment boundaries, requested
+edge types padded to a fixed-width vector.
 """
 from __future__ import annotations
 
-from functools import partial
-from typing import List, Optional, Tuple
+from typing import List, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -42,6 +54,33 @@ def pad_edge_types(edge_types: List[int]) -> np.ndarray:
     return out
 
 
+def build_segments(edge_gidx: np.ndarray, num_parts: int, cap_v: int
+                   ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Static dst-sort order + per-destination segment boundaries.
+
+    edge_gidx: int32[P, cap_e] global dst index `dst_part*cap_v +
+    dst_local` in CANONICAL edge order; invalid/padded edges must carry
+    the dump value num_parts*cap_v so they sort to the tail and fall
+    outside every segment.
+
+    Returns (order, seg_starts, seg_ends):
+      order      int32[P, cap_e]      device position -> canonical index
+      seg_starts int32[P, P*cap_v]    cumsum-boundary (inclusive start)
+      seg_ends   int32[P, P*cap_v]    cumsum-boundary (exclusive end)
+    """
+    P, cap_e = edge_gidx.shape
+    n = num_parts * cap_v
+    order = np.argsort(edge_gidx, axis=1, kind="stable").astype(np.int32)
+    sorted_g = np.take_along_axis(edge_gidx, order, axis=1)
+    seg_starts = np.empty((P, n), np.int32)
+    seg_ends = np.empty((P, n), np.int32)
+    slots = np.arange(n)
+    for p in range(P):
+        seg_starts[p] = np.searchsorted(sorted_g[p], slots, side="left")
+        seg_ends[p] = np.searchsorted(sorted_g[p], slots, side="right")
+    return order, seg_starts, seg_ends
+
+
 def _edge_ok(edge_etype: jnp.ndarray, edge_valid: jnp.ndarray,
              req_types: jnp.ndarray) -> jnp.ndarray:
     """[P, cap_e] mask of edges matching the requested signed types."""
@@ -50,34 +89,39 @@ def _edge_ok(edge_etype: jnp.ndarray, edge_valid: jnp.ndarray,
 
 
 def _advance(frontier: jnp.ndarray, edge_src: jnp.ndarray,
-             edge_gidx: jnp.ndarray, edge_ok: jnp.ndarray) -> jnp.ndarray:
+             edge_ok: jnp.ndarray, seg_starts: jnp.ndarray,
+             seg_ends: jnp.ndarray) -> jnp.ndarray:
     """One BFS hop on stacked partitions (single device).
 
     frontier: bool[P, cap_v] -> bool[P, cap_v]
     """
     P, cap_v = frontier.shape
     active = jnp.take_along_axis(frontier, edge_src, axis=1) & edge_ok
-    flat = jnp.zeros((P * cap_v + 1,), dtype=jnp.bool_)
-    flat = flat.at[edge_gidx.reshape(-1)].max(active.reshape(-1))
-    return flat[:P * cap_v].reshape(P, cap_v)
+    # segmented count per destination: cumsum + static boundary gathers
+    S = jnp.cumsum(active.astype(jnp.int32), axis=1)
+    S0 = jnp.pad(S, ((0, 0), (1, 0)))
+    counts = (jnp.take_along_axis(S0, seg_ends, axis=1)
+              - jnp.take_along_axis(S0, seg_starts, axis=1))
+    return (counts.sum(axis=0) > 0).reshape(P, cap_v)
 
 
 @jax.jit
 def multi_hop(frontier0: jnp.ndarray, steps: jnp.ndarray,
-              edge_src: jnp.ndarray, edge_gidx: jnp.ndarray,
-              edge_etype: jnp.ndarray, edge_valid: jnp.ndarray,
-              req_types: jnp.ndarray
+              edge_src: jnp.ndarray, edge_etype: jnp.ndarray,
+              edge_valid: jnp.ndarray, seg_starts: jnp.ndarray,
+              seg_ends: jnp.ndarray, req_types: jnp.ndarray
               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Run `steps-1` frontier advances, then emit the final-step active
     edge mask (GO semantics: result = edges leaving the step-(N-1)
     frontier). `steps` is a traced scalar — one compile serves any N.
 
-    -> (final_frontier bool[P, cap_v], final_active bool[P, cap_e])
+    -> (final_frontier bool[P, cap_v], final_active bool[P, cap_e]);
+    the edge mask is in DEVICE (dst-sorted) order.
     """
     edge_ok = _edge_ok(edge_etype, edge_valid, req_types)
 
     def body(_, f):
-        return _advance(f, edge_src, edge_gidx, edge_ok)
+        return _advance(f, edge_src, edge_ok, seg_starts, seg_ends)
 
     frontier = lax.fori_loop(0, steps - 1, body, frontier0)
     final_active = jnp.take_along_axis(frontier, edge_src, axis=1) & edge_ok
@@ -86,19 +130,21 @@ def multi_hop(frontier0: jnp.ndarray, steps: jnp.ndarray,
 
 @jax.jit
 def multi_hop_upto(frontier0: jnp.ndarray, steps: jnp.ndarray,
-                   edge_src: jnp.ndarray, edge_gidx: jnp.ndarray,
-                   edge_etype: jnp.ndarray, edge_valid: jnp.ndarray,
-                   req_types: jnp.ndarray) -> jnp.ndarray:
+                   edge_src: jnp.ndarray, edge_etype: jnp.ndarray,
+                   edge_valid: jnp.ndarray, seg_starts: jnp.ndarray,
+                   seg_ends: jnp.ndarray, req_types: jnp.ndarray
+                   ) -> jnp.ndarray:
     """GO UPTO: union of active edge masks over steps 1..N.
 
-    -> any_active bool[P, cap_e]
+    -> any_active bool[P, cap_e] in device order.
     """
     edge_ok = _edge_ok(edge_etype, edge_valid, req_types)
 
     def body(_, state):
         frontier, acc = state
         active = jnp.take_along_axis(frontier, edge_src, axis=1) & edge_ok
-        return _advance(frontier, edge_src, edge_gidx, edge_ok), acc | active
+        return (_advance(frontier, edge_src, edge_ok, seg_starts, seg_ends),
+                acc | active)
 
     _, acc = lax.fori_loop(
         0, steps, body,
@@ -113,16 +159,15 @@ def count_edges(final_active: jnp.ndarray) -> jnp.ndarray:
 
 @jax.jit
 def bfs_dist(frontier0: jnp.ndarray, max_steps: jnp.ndarray,
-             edge_src: jnp.ndarray, edge_gidx: jnp.ndarray,
-             edge_etype: jnp.ndarray, edge_valid: jnp.ndarray,
-             req_types: jnp.ndarray) -> jnp.ndarray:
+             edge_src: jnp.ndarray, edge_etype: jnp.ndarray,
+             edge_valid: jnp.ndarray, seg_starts: jnp.ndarray,
+             seg_ends: jnp.ndarray, req_types: jnp.ndarray) -> jnp.ndarray:
     """Single-source-set BFS depth map for shortest path: dist[p, v] =
     first step at which v was reached (0 for sources, -1 unreached).
 
     -> dist int32[P, cap_v]
     """
     edge_ok = _edge_ok(edge_etype, edge_valid, req_types)
-    P, cap_v = frontier0.shape
     dist0 = jnp.where(frontier0, 0, -1).astype(jnp.int32)
 
     def cond(state):
@@ -131,7 +176,7 @@ def bfs_dist(frontier0: jnp.ndarray, max_steps: jnp.ndarray,
 
     def body(state):
         frontier, dist, step = state
-        nxt = _advance(frontier, edge_src, edge_gidx, edge_ok)
+        nxt = _advance(frontier, edge_src, edge_ok, seg_starts, seg_ends)
         fresh = nxt & (dist < 0)
         dist = jnp.where(fresh, step + 1, dist)
         return fresh, dist, step + 1
@@ -147,9 +192,10 @@ def bfs_dist(frontier0: jnp.ndarray, max_steps: jnp.ndarray,
 
 @jax.jit
 def multi_hop_count(frontier0: jnp.ndarray, steps: jnp.ndarray,
-                    edge_src: jnp.ndarray, edge_gidx: jnp.ndarray,
-                    edge_etype: jnp.ndarray, edge_valid: jnp.ndarray,
-                    req_types: jnp.ndarray) -> jnp.ndarray:
+                    edge_src: jnp.ndarray, edge_etype: jnp.ndarray,
+                    edge_valid: jnp.ndarray, seg_starts: jnp.ndarray,
+                    seg_ends: jnp.ndarray, req_types: jnp.ndarray
+                    ) -> jnp.ndarray:
     """Total edges traversed across ALL hops (the bench metric:
     edges-traversed/sec counts every hop's expansions, not just the
     final emission)."""
@@ -158,9 +204,28 @@ def multi_hop_count(frontier0: jnp.ndarray, steps: jnp.ndarray,
     def body(_, state):
         frontier, total = state
         active = jnp.take_along_axis(frontier, edge_src, axis=1) & edge_ok
+        # int64 accumulator: >2^31 edges per query is reachable on large
+        # graphs (canonicalizes to int32 only when x64 is disabled)
         total = total + active.sum(dtype=jnp.int64)
-        return _advance(frontier, edge_src, edge_gidx, edge_ok), total
+        return (_advance(frontier, edge_src, edge_ok, seg_starts, seg_ends),
+                total)
 
     _, total = lax.fori_loop(0, steps, body,
                              (frontier0, jnp.zeros((), jnp.int64)))
     return total
+
+
+@jax.jit
+def multi_hop_count_batch(frontiers0: jnp.ndarray, steps: jnp.ndarray,
+                          edge_src: jnp.ndarray, edge_etype: jnp.ndarray,
+                          edge_valid: jnp.ndarray, seg_starts: jnp.ndarray,
+                          seg_ends: jnp.ndarray, req_types: jnp.ndarray
+                          ) -> jnp.ndarray:
+    """Batch of independent GO queries in one dispatch: frontiers0 is
+    bool[B, P, cap_v]; returns int32[B] per-query edges traversed.
+    Amortizes per-dispatch overhead — the throughput path for QPS-style
+    workloads (many concurrent sessions issuing GO)."""
+    def one(f0):
+        return multi_hop_count(f0, steps, edge_src, edge_etype, edge_valid,
+                               seg_starts, seg_ends, req_types)
+    return jax.vmap(one)(frontiers0)

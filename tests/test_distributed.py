@@ -41,11 +41,11 @@ def test_sharded_matches_single_device(snap8, starts, steps, etypes):
     req = jnp.asarray(traverse.pad_edge_types(etypes))
 
     f_single, a_single = traverse.multi_hop(
-        f0, steps, snap.d_edge_src, snap.d_edge_gidx, snap.d_edge_etype,
-        snap.d_edge_valid, req)
+        f0, steps, snap.d_edge_src, snap.d_edge_etype, snap.d_edge_valid,
+        snap.d_seg_starts, snap.d_seg_ends, req)
     f_shard, a_shard = dist.multi_hop_sharded(
-        mesh, f0, steps, snap.d_edge_src, snap.d_edge_gidx,
-        snap.d_edge_etype, snap.d_edge_valid, req)
+        mesh, f0, steps, snap.d_edge_src, snap.d_edge_etype,
+        snap.d_edge_valid, snap.d_seg_starts, snap.d_seg_ends, req)
     assert np.array_equal(np.asarray(f_single), np.asarray(f_shard))
     assert np.array_equal(np.asarray(a_single), np.asarray(a_shard))
 
@@ -56,11 +56,11 @@ def test_sharded_count_matches(snap8):
     f0 = jnp.asarray(snap.frontier_from_vids([100, 101]))
     req = jnp.asarray(traverse.pad_edge_types([1]))
     n_single = int(traverse.multi_hop_count(
-        f0, 3, snap.d_edge_src, snap.d_edge_gidx, snap.d_edge_etype,
-        snap.d_edge_valid, req))
+        f0, 3, snap.d_edge_src, snap.d_edge_etype, snap.d_edge_valid,
+        snap.d_seg_starts, snap.d_seg_ends, req))
     n_shard = int(dist.multi_hop_count_sharded(
-        mesh, f0, 3, snap.d_edge_src, snap.d_edge_gidx, snap.d_edge_etype,
-        snap.d_edge_valid, req))
+        mesh, f0, 3, snap.d_edge_src, snap.d_edge_etype, snap.d_edge_valid,
+        snap.d_seg_starts, snap.d_seg_ends, req))
     assert n_single == n_shard > 0
 
 
@@ -73,10 +73,11 @@ def test_sharded_with_placed_arrays(snap8):
     f0 = jnp.asarray(snap.frontier_from_vids([100]))
     req = jnp.asarray(traverse.pad_edge_types([1]))
     f, a = dist.multi_hop_sharded(mesh, f0, 2, snap.d_edge_src,
-                                  snap.d_edge_gidx, snap.d_edge_etype,
-                                  snap.d_edge_valid, req)
+                                  snap.d_edge_etype, snap.d_edge_valid,
+                                  snap.d_seg_starts, snap.d_seg_ends, req)
     # compare against a fresh single-device run
-    f1, a1 = traverse.multi_hop(f0, 2, snap.d_edge_src, snap.d_edge_gidx,
-                                snap.d_edge_etype, snap.d_edge_valid, req)
+    f1, a1 = traverse.multi_hop(f0, 2, snap.d_edge_src, snap.d_edge_etype,
+                                snap.d_edge_valid, snap.d_seg_starts,
+                                snap.d_seg_ends, req)
     assert np.array_equal(np.asarray(f), np.asarray(f1))
     assert np.array_equal(np.asarray(a), np.asarray(a1))
