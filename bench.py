@@ -120,7 +120,8 @@ def bench_tpu(store, sm, seed_sets):
         [snap.frontier_from_vids(s) for s in seed_sets]))
     req = jnp.asarray(traverse.pad_edge_types([1]))
     args = (f_batch, jnp.int32(STEPS), snap.d_edge_src, snap.d_edge_etype,
-            snap.d_edge_valid, snap.d_seg_starts, snap.d_seg_ends, req)
+            snap.d_edge_valid, snap.d_order, snap.d_seg_starts,
+            snap.d_seg_ends, req)
     t0 = time.time()
     counts = np.asarray(traverse.multi_hop_count_batch(*args))
     per_batch = int(counts.sum())

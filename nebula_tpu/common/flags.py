@@ -92,6 +92,10 @@ graph_flags.declare("session_idle_timeout_secs", 28800, MUTABLE,
                     "idle session reclamation age")
 graph_flags.declare("slow_op_threshold_ms", 50, MUTABLE,
                     "log queries slower than this")
+storage_flags.declare("download_dir", "/tmp/nebula_tpu_staging", REBOOT,
+                      "staging dir for DOWNLOAD-ed bulk-load SST files")
+storage_flags.declare("snapshot_dir", "/tmp/nebula_tpu_snapshots", REBOOT,
+                      "root dir for CREATE SNAPSHOT checkpoints")
 storage_flags.declare("max_edge_returned_per_vertex", 1 << 30, MUTABLE,
                       "per-vertex edge truncation cap")
 storage_flags.declare("heartbeat_interval_secs", 10, MUTABLE,

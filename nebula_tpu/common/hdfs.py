@@ -1,0 +1,66 @@
+"""HDFS helper: stage bulk-load files from a remote or local source.
+
+Role parity with the reference's `common/hdfs/HdfsCommandHelper.cpp:
+13-40`, which shells out to the `hdfs dfs` CLI for ls/copyToLocal. URLs
+beginning with hdfs:// go through the CLI when it exists; plain paths
+are treated as local directories (the test/bench path — also what a
+mounted NFS/GCS-fuse volume looks like in a TPU pod)."""
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import List, Tuple
+
+from .status import ErrorCode, Status
+
+
+class HdfsHelper:
+    def __init__(self, hdfs_bin: str = "hdfs"):
+        self.hdfs_bin = hdfs_bin
+
+    def available(self) -> bool:
+        return shutil.which(self.hdfs_bin) is not None
+
+    # ------------------------------------------------------------------
+    def ls(self, url: str) -> Tuple[Status, List[str]]:
+        if url.startswith("hdfs://"):
+            if not self.available():
+                return (Status.error(ErrorCode.E_EXECUTION_ERROR,
+                                     "hdfs CLI not available"), [])
+            r = subprocess.run([self.hdfs_bin, "dfs", "-ls", "-C", url],
+                               capture_output=True, text=True)
+            if r.returncode != 0:
+                return (Status.error(ErrorCode.E_EXECUTION_ERROR,
+                                     r.stderr.strip()), [])
+            return Status.OK(), [l for l in r.stdout.splitlines() if l]
+        if not os.path.isdir(url):
+            return (Status.error(ErrorCode.E_EXECUTION_ERROR,
+                                 f"{url}: not a directory"), [])
+        return Status.OK(), sorted(
+            os.path.join(url, f) for f in os.listdir(url))
+
+    # ------------------------------------------------------------------
+    def copy_to_local(self, url: str, dest_dir: str) -> Status:
+        """Stage every file under `url` into dest_dir (ref: the per-part
+        `/download` handler pulling SSTs before INGEST)."""
+        os.makedirs(dest_dir, exist_ok=True)
+        if url.startswith("hdfs://"):
+            if not self.available():
+                return Status.error(ErrorCode.E_EXECUTION_ERROR,
+                                    "hdfs CLI not available")
+            r = subprocess.run(
+                [self.hdfs_bin, "dfs", "-copyToLocal", "-f",
+                 url.rstrip("/") + "/*", dest_dir],
+                capture_output=True, text=True)
+            if r.returncode != 0:
+                return Status.error(ErrorCode.E_EXECUTION_ERROR,
+                                    r.stderr.strip())
+            return Status.OK()
+        st, files = self.ls(url)
+        if not st.ok():
+            return st
+        for f in files:
+            if os.path.isfile(f):
+                shutil.copy2(f, dest_dir)
+        return Status.OK()

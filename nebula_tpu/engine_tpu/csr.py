@@ -98,39 +98,25 @@ class CsrSnapshot:
                      s.edge_dst_part.astype(np.int64) * cap_v + s.edge_dst_local,
                      dump).astype(np.int32)
             for s in shards])
-        # Device edge arrays live in DST-SORTED order (traverse.py's
-        # scatter-free advance); sort_perm maps device position back to
-        # the canonical (src, etype, rank, dst) host order.
+        self.np_gidx = gidx  # kept for re-blocked segments (mesh sharding)
+        # Static dst-sort permutation + per-destination boundaries for
+        # the scatter-free advance; edge arrays stay in canonical
+        # (src, etype, rank, dst) order.
         order, seg_starts, seg_ends = build_segments(gidx, P, cap_v)
-        self.sort_perm = order                       # np int32 [P, cap_e]
-        self.d_seg_starts = jnp.asarray(seg_starts)  # [P, P*cap_v]
-        self.d_seg_ends = jnp.asarray(seg_ends)
-        # device arrays [P, cap_e] (dst-sorted) / [P, cap_v]
-        self.d_edge_src = jnp.asarray(self.to_device_order(
-            np.stack([s.edge_src for s in shards])))
-        self.d_edge_gidx = jnp.asarray(self.to_device_order(gidx))
-        self.d_edge_etype = jnp.asarray(self.to_device_order(
-            np.stack([s.edge_etype for s in shards])))
-        self.d_edge_valid = jnp.asarray(self.to_device_order(
-            np.stack([s.edge_valid for s in shards])))
+        self.d_order = jnp.asarray(order[0])         # [P*cap_e]
+        self.d_seg_starts = jnp.asarray(seg_starts[0])  # [P*cap_v]
+        self.d_seg_ends = jnp.asarray(seg_ends[0])
+        # device arrays [P, cap_e] / [P, cap_v], canonical order
+        self.d_edge_src = jnp.asarray(np.stack([s.edge_src for s in shards]))
+        self.d_edge_gidx = jnp.asarray(gidx)
+        self.d_edge_etype = jnp.asarray(np.stack([s.edge_etype for s in shards]))
+        self.d_edge_valid = jnp.asarray(np.stack([s.edge_valid for s in shards]))
         self.total_edges = int(sum(s.num_edges for s in shards))
         self._device_prop_cache: Dict[Tuple, Any] = {}
         # global string dictionaries: (kind 'e'|'t', prop name) -> {str: code}
         self.str_dicts: Dict[Tuple[str, str], Dict[str, int]] = {}
 
     # ------------------------------------------------------------------
-    def to_device_order(self, stacked: np.ndarray) -> np.ndarray:
-        """Reorder a canonical-order [P, cap_e] edge array into the
-        device's dst-sorted order."""
-        return np.take_along_axis(stacked, self.sort_perm, axis=1)
-
-    def canonical_edge_indices(self, device_mask_row: np.ndarray,
-                               part_idx: int) -> np.ndarray:
-        """Map one partition's device-order bool edge mask to SORTED
-        canonical edge indices (result rows keep the CPU path's
-        (src, etype, rank, dst) emission order)."""
-        return np.sort(self.sort_perm[part_idx][np.nonzero(device_mask_row)[0]])
-
     def locate(self, vid: int) -> Optional[Tuple[int, int]]:
         """vid -> (0-based part index, local index)."""
         p = ku.part_id(vid, self.num_parts) - 1
@@ -171,10 +157,7 @@ class CsrSnapshot:
             self._device_prop_cache[key] = None
             return None
         filled = [c if c is not None else np.zeros(cap, dtype) for c in cols]
-        stacked = np.stack(filled)
-        if kind == "e":  # edge columns follow the device's dst-sort order
-            stacked = self.to_device_order(stacked)
-        out = jnp.asarray(stacked)
+        out = jnp.asarray(np.stack(filled))
         self._device_prop_cache[key] = out
         return out
 

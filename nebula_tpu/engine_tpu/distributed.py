@@ -9,11 +9,12 @@ hop becomes ONE `lax.all_to_all` over ICI per hop — inside the same
 compiled loop, no host round-trips.
 
 Like the single-chip kernels (traverse.py), the advance is scatter-free:
-each device's edge block is dst-sorted at build time, so its
-contribution to every partition's next frontier is a cumsum + two
-static boundary gathers over [local_parts, P*cap_v] segments. The
-[P*cap_v] hit vector is then split into per-device blocks and
-transposed with all_to_all; the receiving device ORs the D
+each device holds a static dst-sort permutation over ITS block of
+edges (`build_segments(..., num_blocks=D)`), so its contribution to
+every partition's next frontier is one permute-gather + cumsum + two
+[P*cap_v] boundary gathers — linear in local edges + global vertex
+slots. The [P*cap_v] hit vector is then split into per-device blocks
+and transposed with all_to_all; the receiving device ORs the D
 contributions into its local frontier.
 
 Layout: with P partitions over D devices (P % D == 0), device d owns the
@@ -40,20 +41,19 @@ def make_mesh(devices: Optional[List] = None) -> Mesh:
     return Mesh(np.array(devices), (AXIS,))
 
 
-def _local_hits(frontier, edge_src, edge_ok, seg_starts, seg_ends):
+def _local_hits(frontier, edge_src, edge_ok, order, seg_starts, seg_ends):
     """One hop on one device's partition block: the full-space hit
     vector (this device's contribution to every partition) plus the
     local active-edge mask.
 
-    frontier: bool[localP, cap_v]; seg_*: int32[localP, P*cap_v]
+    frontier: bool[localP, cap_v]; order: int32[1, localP*cap_e];
+    seg_*: int32[1, P*cap_v]
     -> (hits bool[P*cap_v], active bool[localP, cap_e])
     """
     active = jnp.take_along_axis(frontier, edge_src, axis=1) & edge_ok
-    S = jnp.cumsum(active.astype(jnp.int32), axis=1)
-    S0 = jnp.pad(S, ((0, 0), (1, 0)))
-    counts = (jnp.take_along_axis(S0, seg_ends, axis=1)
-              - jnp.take_along_axis(S0, seg_starts, axis=1))
-    return counts.sum(axis=0) > 0, active
+    flat = active.reshape(-1)[order[0]]
+    S0 = jnp.pad(jnp.cumsum(flat.astype(jnp.int32)), (1, 0))
+    return (S0[seg_ends[0]] - S0[seg_starts[0]]) > 0, active
 
 
 def _exchange(flat_hits, num_devices, local_block):
@@ -65,13 +65,15 @@ def _exchange(flat_hits, num_devices, local_block):
 
 
 def multi_hop_sharded(mesh: Mesh, frontier0, steps, edge_src, edge_etype,
-                      edge_valid, seg_starts, seg_ends, req_types
+                      edge_valid, order, seg_starts, seg_ends, req_types
                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Distributed GO: returns (final_frontier [P,cap_v], final_active
-    [P,cap_e] in device dst-sorted order), both sharded over the mesh
+    [P,cap_e] in canonical edge order), both sharded over the mesh
     partition axis.
 
-    All inputs are global [P, ...] arrays; P must divide by mesh size.
+    Edge arrays are global [P, ...]; order/seg_starts/seg_ends come from
+    build_segments(gidx, P, cap_v, num_blocks=D) — one row per device.
+    P must divide by mesh size.
     """
     num_devices = mesh.devices.size
     num_parts, cap_v = frontier0.shape
@@ -83,13 +85,13 @@ def multi_hop_sharded(mesh: Mesh, frontier0, steps, edge_src, edge_etype,
 
     @partial(shard_map, mesh=mesh,
              in_specs=(P(AXIS), None, P(AXIS), P(AXIS), P(AXIS), P(AXIS),
-                       P(AXIS), None),
+                       P(AXIS), P(AXIS), None),
              out_specs=(P(AXIS), P(AXIS)))
-    def run(frontier, steps_, src, etype, valid, starts, ends, req):
+    def run(frontier, steps_, src, etype, valid, order_, starts, ends, req):
         edge_ok = (etype[None] == req[:, None, None]).any(0) & valid
 
         def body(_, f):
-            hits, _active = _local_hits(f, src, edge_ok, starts, ends)
+            hits, _active = _local_hits(f, src, edge_ok, order_, starts, ends)
             nxt = _exchange(hits, num_devices, local_block)
             return nxt.reshape(parts_per_dev, cap_v)
 
@@ -98,12 +100,12 @@ def multi_hop_sharded(mesh: Mesh, frontier0, steps, edge_src, edge_etype,
         return f, final_active
 
     return jax.jit(run)(frontier0, steps, edge_src, edge_etype, edge_valid,
-                        seg_starts, seg_ends, req_types)
+                        order, seg_starts, seg_ends, req_types)
 
 
 def multi_hop_count_sharded(mesh: Mesh, frontier0, steps, edge_src,
-                            edge_etype, edge_valid, seg_starts, seg_ends,
-                            req_types) -> jnp.ndarray:
+                            edge_etype, edge_valid, order, seg_starts,
+                            seg_ends, req_types) -> jnp.ndarray:
     """Distributed total-edges-traversed counter (bench metric)."""
     num_devices = mesh.devices.size
     num_parts, cap_v = frontier0.shape
@@ -115,14 +117,14 @@ def multi_hop_count_sharded(mesh: Mesh, frontier0, steps, edge_src,
 
     @partial(shard_map, mesh=mesh,
              in_specs=(P(AXIS), None, P(AXIS), P(AXIS), P(AXIS), P(AXIS),
-                       P(AXIS), None),
+                       P(AXIS), P(AXIS), None),
              out_specs=P())
-    def run(frontier, steps_, src, etype, valid, starts, ends, req):
+    def run(frontier, steps_, src, etype, valid, order_, starts, ends, req):
         edge_ok = (etype[None] == req[:, None, None]).any(0) & valid
 
         def body(_, state):
             f, total = state
-            hits, active = _local_hits(f, src, edge_ok, starts, ends)
+            hits, active = _local_hits(f, src, edge_ok, order_, starts, ends)
             total = total + active.sum(dtype=jnp.int64)
             nxt = _exchange(hits, num_devices, local_block)
             return nxt.reshape(parts_per_dev, cap_v), total
@@ -134,16 +136,23 @@ def multi_hop_count_sharded(mesh: Mesh, frontier0, steps, edge_src,
         return lax.psum(total, AXIS)
 
     return jax.jit(run)(frontier0, steps, edge_src, edge_etype, edge_valid,
-                        seg_starts, seg_ends, req_types)
+                        order, seg_starts, seg_ends, req_types)
 
 
 def shard_snapshot_arrays(mesh: Mesh, snap) -> None:
-    """Re-place a CsrSnapshot's device arrays with the mesh sharding so
-    the sharded kernels consume them without host transfers."""
+    """Re-place a CsrSnapshot's device arrays with the mesh sharding and
+    attach per-device block segments (d_border/d_bseg_starts/
+    d_bseg_ends) so the sharded kernels consume them without host
+    transfers."""
+    from .traverse import build_segments
     sharding = NamedSharding(mesh, P(AXIS))
+    D = mesh.devices.size
+    order, starts, ends = build_segments(snap.np_gidx, snap.num_parts,
+                                         snap.cap_v, num_blocks=D)
+    snap.d_border = jax.device_put(jnp.asarray(order), sharding)
+    snap.d_bseg_starts = jax.device_put(jnp.asarray(starts), sharding)
+    snap.d_bseg_ends = jax.device_put(jnp.asarray(ends), sharding)
     snap.d_edge_src = jax.device_put(snap.d_edge_src, sharding)
     snap.d_edge_etype = jax.device_put(snap.d_edge_etype, sharding)
     snap.d_edge_valid = jax.device_put(snap.d_edge_valid, sharding)
-    snap.d_seg_starts = jax.device_put(snap.d_seg_starts, sharding)
-    snap.d_seg_ends = jax.device_put(snap.d_seg_ends, sharding)
     snap.d_edge_gidx = jax.device_put(snap.d_edge_gidx, sharding)

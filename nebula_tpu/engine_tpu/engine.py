@@ -149,7 +149,8 @@ class TpuGraphEngine:
 
         _, active = traverse.multi_hop(
             f0, s.step.steps, snap.d_edge_src, snap.d_edge_etype,
-            snap.d_edge_valid, snap.d_seg_starts, snap.d_seg_ends, req)
+            snap.d_edge_valid, snap.d_order, snap.d_seg_starts,
+            snap.d_seg_ends, req)
         if device_mask is not None:
             active = active & device_mask
         mask = np.asarray(active)
@@ -180,8 +181,7 @@ class TpuGraphEngine:
         cap_counts: Dict[Tuple[int, int], int] = {}
         for p in range(snap.num_parts):
             shard = snap.shards[p]
-            # mask is in device (dst-sorted) order; emit in canonical order
-            idxs = snap.canonical_edge_indices(mask[p], p)
+            idxs = np.nonzero(mask[p])[0]
             for i in idxs:
                 i = int(i)
                 src_vid = int(shard.vids[shard.edge_src[i]])
@@ -235,11 +235,12 @@ class TpuGraphEngine:
         steps_b = upto - steps_f
         dist_f = np.asarray(traverse.bfs_dist(
             jnp.asarray(f_src), steps_f, snap.d_edge_src, snap.d_edge_etype,
-            snap.d_edge_valid, snap.d_seg_starts, snap.d_seg_ends, req_f))
+            snap.d_edge_valid, snap.d_order, snap.d_seg_starts,
+            snap.d_seg_ends, req_f))
         dist_b = np.asarray(traverse.bfs_dist(
             jnp.asarray(f_dst), max(steps_b, 0), snap.d_edge_src,
-            snap.d_edge_etype, snap.d_edge_valid, snap.d_seg_starts,
-            snap.d_seg_ends, req_b))
+            snap.d_edge_etype, snap.d_edge_valid, snap.d_order,
+            snap.d_seg_starts, snap.d_seg_ends, req_b))
         paths = _reconstruct_shortest(snap, dist_f, dist_b, sources, targets,
                                       edge_types, upto, name_by_type)
         self.stats["path_served"] += 1

@@ -122,7 +122,7 @@ class FilterCompiler:
                 cols.append(np.zeros(self.snap.cap_e, bool))
             else:
                 cols.append(col.present)
-        return jnp.asarray(self.snap.to_device_order(np.stack(cols)))
+        return jnp.asarray(np.stack(cols))
 
     def _src_prop_val(self, tag: str, prop: str) -> _Val:
         tid = self.sm.tag_id(self.space_id, tag)

@@ -6,21 +6,24 @@ whole multi-hop expansion compiles to ONE XLA program.
 
 Why no scatter: XLA lowers scatter on TPU to a mostly-serialized
 update loop, which made the first dense-mask implementation ~1000x
-slower than the data movement justifies. Instead the edges of every
-shard are sorted by destination global index AT BUILD TIME (a static
-permutation — the graph is a snapshot), which turns a hop into purely
-parallel, bandwidth-bound primitives:
+slower than the data movement justifies. Instead a STATIC dst-sort
+permutation over the edges is computed at build time (the graph is a
+snapshot), which turns a hop into purely parallel, bandwidth-bound
+primitives — edge arrays stay in canonical (src, etype, rank, dst)
+order; only the 1-bit active values are permuted per hop:
 
     gather   active[e] = frontier[edge_src[e]] & type_ok[e]   (VPU)
-    scan     S = cumsum(active) along the edge axis            (HBM)
+    gather   sorted = active.flat[order]    (order: static dst-sort)
+    scan     S = cumsum(sorted)                                (HBM)
     gather   reached[v] = S[seg_end[v]] - S[seg_start[v]] > 0
     loop     lax.fori_loop over hops (dynamic trip count, no retrace)
 
-seg_start/seg_end are static per-destination boundaries into each
-shard's dst-sorted edge array (searchsorted at build time). A vertex
-may receive edges from several shards; contributions are summed over
-the shard axis (single chip) or exchanged with all_to_all + OR
-(distributed, see distributed.py).
+order/seg_start/seg_end come from build_segments: the edges of a BLOCK
+of shards (the whole space on one chip; one device's shards in the
+distributed path) are merge-sorted by destination global index, and
+seg boundaries are searchsorted per destination slot — O(E) permutation
+plus O(P*cap_v) boundaries, linear in both, regardless of partition
+count. Cross-block combination is all_to_all + OR (distributed.py).
 
 Dense bool frontiers give within-step dst dedup for free — exactly the
 reference's `getDstIdsFromResp` unordered_set semantics (GO revisits
@@ -28,8 +31,8 @@ previously-seen vertices across steps; BFS-style visited masks are used
 only by shortest-path, which tracks first-hit depth in `dist`).
 
 All shapes are static: [P, cap_v] frontiers, [P, cap_e] edge arrays in
-dst-sorted device order, [P, P*cap_v] segment boundaries, requested
-edge types padded to a fixed-width vector.
+canonical order, [B, P*cap_v] segment boundaries, requested edge types
+padded to a fixed-width vector.
 """
 from __future__ import annotations
 
@@ -54,7 +57,8 @@ def pad_edge_types(edge_types: List[int]) -> np.ndarray:
     return out
 
 
-def build_segments(edge_gidx: np.ndarray, num_parts: int, cap_v: int
+def build_segments(edge_gidx: np.ndarray, num_parts: int, cap_v: int,
+                   num_blocks: int = 1
                    ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Static dst-sort order + per-destination segment boundaries.
 
@@ -63,21 +67,30 @@ def build_segments(edge_gidx: np.ndarray, num_parts: int, cap_v: int
     the dump value num_parts*cap_v so they sort to the tail and fall
     outside every segment.
 
+    Shards are merged in `num_blocks` contiguous groups (1 = whole
+    space, single chip; D = one block per device for the distributed
+    path, since each device can only permute its own edges).
+
     Returns (order, seg_starts, seg_ends):
-      order      int32[P, cap_e]      device position -> canonical index
-      seg_starts int32[P, P*cap_v]    cumsum-boundary (inclusive start)
-      seg_ends   int32[P, P*cap_v]    cumsum-boundary (exclusive end)
+      order      int32[B, (P/B)*cap_e]  sorted position -> flat
+                                        canonical index within block
+      seg_starts int32[B, P*cap_v]      cumsum-boundary (incl. start)
+      seg_ends   int32[B, P*cap_v]      cumsum-boundary (excl. end)
     """
     P, cap_e = edge_gidx.shape
+    assert P % num_blocks == 0
+    bp = P // num_blocks
     n = num_parts * cap_v
-    order = np.argsort(edge_gidx, axis=1, kind="stable").astype(np.int32)
-    sorted_g = np.take_along_axis(edge_gidx, order, axis=1)
-    seg_starts = np.empty((P, n), np.int32)
-    seg_ends = np.empty((P, n), np.int32)
+    order = np.empty((num_blocks, bp * cap_e), np.int32)
+    seg_starts = np.empty((num_blocks, n), np.int32)
+    seg_ends = np.empty((num_blocks, n), np.int32)
     slots = np.arange(n)
-    for p in range(P):
-        seg_starts[p] = np.searchsorted(sorted_g[p], slots, side="left")
-        seg_ends[p] = np.searchsorted(sorted_g[p], slots, side="right")
+    for b in range(num_blocks):
+        flat = edge_gidx[b * bp:(b + 1) * bp].reshape(-1)
+        order[b] = np.argsort(flat, kind="stable").astype(np.int32)
+        sorted_g = flat[order[b]]
+        seg_starts[b] = np.searchsorted(sorted_g, slots, side="left")
+        seg_ends[b] = np.searchsorted(sorted_g, slots, side="right")
     return order, seg_starts, seg_ends
 
 
@@ -89,39 +102,42 @@ def _edge_ok(edge_etype: jnp.ndarray, edge_valid: jnp.ndarray,
 
 
 def _advance(frontier: jnp.ndarray, edge_src: jnp.ndarray,
-             edge_ok: jnp.ndarray, seg_starts: jnp.ndarray,
-             seg_ends: jnp.ndarray) -> jnp.ndarray:
-    """One BFS hop on stacked partitions (single device).
+             edge_ok: jnp.ndarray, order: jnp.ndarray,
+             seg_starts: jnp.ndarray, seg_ends: jnp.ndarray) -> jnp.ndarray:
+    """One BFS hop on stacked partitions (single device = one block).
 
     frontier: bool[P, cap_v] -> bool[P, cap_v]
+    order/seg_starts/seg_ends: block 0 of build_segments(num_blocks=1),
+    i.e. int32[P*cap_e] / int32[P*cap_v] / int32[P*cap_v].
     """
     P, cap_v = frontier.shape
     active = jnp.take_along_axis(frontier, edge_src, axis=1) & edge_ok
-    # segmented count per destination: cumsum + static boundary gathers
-    S = jnp.cumsum(active.astype(jnp.int32), axis=1)
-    S0 = jnp.pad(S, ((0, 0), (1, 0)))
-    counts = (jnp.take_along_axis(S0, seg_ends, axis=1)
-              - jnp.take_along_axis(S0, seg_starts, axis=1))
-    return (counts.sum(axis=0) > 0).reshape(P, cap_v)
+    # dst-sorted segmented count: static permute + cumsum + boundaries
+    flat = active.reshape(-1)[order]
+    S0 = jnp.pad(jnp.cumsum(flat.astype(jnp.int32)), (1, 0))
+    counts = S0[seg_ends] - S0[seg_starts]
+    return (counts > 0).reshape(P, cap_v)
 
 
 @jax.jit
 def multi_hop(frontier0: jnp.ndarray, steps: jnp.ndarray,
               edge_src: jnp.ndarray, edge_etype: jnp.ndarray,
-              edge_valid: jnp.ndarray, seg_starts: jnp.ndarray,
-              seg_ends: jnp.ndarray, req_types: jnp.ndarray
+              edge_valid: jnp.ndarray, order: jnp.ndarray,
+              seg_starts: jnp.ndarray, seg_ends: jnp.ndarray,
+              req_types: jnp.ndarray
               ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Run `steps-1` frontier advances, then emit the final-step active
     edge mask (GO semantics: result = edges leaving the step-(N-1)
     frontier). `steps` is a traced scalar — one compile serves any N.
 
     -> (final_frontier bool[P, cap_v], final_active bool[P, cap_e]);
-    the edge mask is in DEVICE (dst-sorted) order.
+    the edge mask is in canonical edge order.
     """
     edge_ok = _edge_ok(edge_etype, edge_valid, req_types)
 
     def body(_, f):
-        return _advance(f, edge_src, edge_ok, seg_starts, seg_ends)
+        return _advance(f, edge_src, edge_ok, order,
+                        seg_starts, seg_ends)
 
     frontier = lax.fori_loop(0, steps - 1, body, frontier0)
     final_active = jnp.take_along_axis(frontier, edge_src, axis=1) & edge_ok
@@ -131,19 +147,20 @@ def multi_hop(frontier0: jnp.ndarray, steps: jnp.ndarray,
 @jax.jit
 def multi_hop_upto(frontier0: jnp.ndarray, steps: jnp.ndarray,
                    edge_src: jnp.ndarray, edge_etype: jnp.ndarray,
-                   edge_valid: jnp.ndarray, seg_starts: jnp.ndarray,
-                   seg_ends: jnp.ndarray, req_types: jnp.ndarray
-                   ) -> jnp.ndarray:
+                   edge_valid: jnp.ndarray, order: jnp.ndarray,
+                   seg_starts: jnp.ndarray, seg_ends: jnp.ndarray,
+                   req_types: jnp.ndarray) -> jnp.ndarray:
     """GO UPTO: union of active edge masks over steps 1..N.
 
-    -> any_active bool[P, cap_e] in device order.
+    -> any_active bool[P, cap_e] in canonical edge order.
     """
     edge_ok = _edge_ok(edge_etype, edge_valid, req_types)
 
     def body(_, state):
         frontier, acc = state
         active = jnp.take_along_axis(frontier, edge_src, axis=1) & edge_ok
-        return (_advance(frontier, edge_src, edge_ok, seg_starts, seg_ends),
+        return (_advance(frontier, edge_src, edge_ok, order, seg_starts,
+                         seg_ends),
                 acc | active)
 
     _, acc = lax.fori_loop(
@@ -160,8 +177,9 @@ def count_edges(final_active: jnp.ndarray) -> jnp.ndarray:
 @jax.jit
 def bfs_dist(frontier0: jnp.ndarray, max_steps: jnp.ndarray,
              edge_src: jnp.ndarray, edge_etype: jnp.ndarray,
-             edge_valid: jnp.ndarray, seg_starts: jnp.ndarray,
-             seg_ends: jnp.ndarray, req_types: jnp.ndarray) -> jnp.ndarray:
+             edge_valid: jnp.ndarray, order: jnp.ndarray,
+             seg_starts: jnp.ndarray, seg_ends: jnp.ndarray,
+             req_types: jnp.ndarray) -> jnp.ndarray:
     """Single-source-set BFS depth map for shortest path: dist[p, v] =
     first step at which v was reached (0 for sources, -1 unreached).
 
@@ -176,7 +194,8 @@ def bfs_dist(frontier0: jnp.ndarray, max_steps: jnp.ndarray,
 
     def body(state):
         frontier, dist, step = state
-        nxt = _advance(frontier, edge_src, edge_ok, seg_starts, seg_ends)
+        nxt = _advance(frontier, edge_src, edge_ok, order, seg_starts,
+                       seg_ends)
         fresh = nxt & (dist < 0)
         dist = jnp.where(fresh, step + 1, dist)
         return fresh, dist, step + 1
@@ -193,9 +212,9 @@ def bfs_dist(frontier0: jnp.ndarray, max_steps: jnp.ndarray,
 @jax.jit
 def multi_hop_count(frontier0: jnp.ndarray, steps: jnp.ndarray,
                     edge_src: jnp.ndarray, edge_etype: jnp.ndarray,
-                    edge_valid: jnp.ndarray, seg_starts: jnp.ndarray,
-                    seg_ends: jnp.ndarray, req_types: jnp.ndarray
-                    ) -> jnp.ndarray:
+                    edge_valid: jnp.ndarray, order: jnp.ndarray,
+                    seg_starts: jnp.ndarray, seg_ends: jnp.ndarray,
+                    req_types: jnp.ndarray) -> jnp.ndarray:
     """Total edges traversed across ALL hops (the bench metric:
     edges-traversed/sec counts every hop's expansions, not just the
     final emission)."""
@@ -207,7 +226,8 @@ def multi_hop_count(frontier0: jnp.ndarray, steps: jnp.ndarray,
         # int64 accumulator: >2^31 edges per query is reachable on large
         # graphs (canonicalizes to int32 only when x64 is disabled)
         total = total + active.sum(dtype=jnp.int64)
-        return (_advance(frontier, edge_src, edge_ok, seg_starts, seg_ends),
+        return (_advance(frontier, edge_src, edge_ok, order, seg_starts,
+                         seg_ends),
                 total)
 
     _, total = lax.fori_loop(0, steps, body,
@@ -218,14 +238,14 @@ def multi_hop_count(frontier0: jnp.ndarray, steps: jnp.ndarray,
 @jax.jit
 def multi_hop_count_batch(frontiers0: jnp.ndarray, steps: jnp.ndarray,
                           edge_src: jnp.ndarray, edge_etype: jnp.ndarray,
-                          edge_valid: jnp.ndarray, seg_starts: jnp.ndarray,
-                          seg_ends: jnp.ndarray, req_types: jnp.ndarray
-                          ) -> jnp.ndarray:
+                          edge_valid: jnp.ndarray, order: jnp.ndarray,
+                          seg_starts: jnp.ndarray, seg_ends: jnp.ndarray,
+                          req_types: jnp.ndarray) -> jnp.ndarray:
     """Batch of independent GO queries in one dispatch: frontiers0 is
     bool[B, P, cap_v]; returns int32[B] per-query edges traversed.
     Amortizes per-dispatch overhead — the throughput path for QPS-style
     workloads (many concurrent sessions issuing GO)."""
     def one(f0):
         return multi_hop_count(f0, steps, edge_src, edge_etype, edge_valid,
-                               seg_starts, seg_ends, req_types)
+                               order, seg_starts, seg_ends, req_types)
     return jax.vmap(one)(frontiers0)

@@ -156,7 +156,8 @@ def execute_show(ctx: ExecContext, s: ast.ShowSentence) -> Result:
         return _ok(InterimResult(["User", "Role"],
                                  ctx.meta.list_roles(r.value().space_id)))
     if k == ast.ShowKind.SNAPSHOTS:
-        return _ok(InterimResult(["Name", "Status"], []))
+        return _ok(InterimResult(["Name", "Status"],
+                                 ctx.meta.list_snapshots()))
     if k == ast.ShowKind.VARIABLES:
         rows = [(name, repr(res.columns)) for name, res in ctx.variables.items()]
         return _ok(InterimResult(["Variable", "Columns"], rows))
@@ -236,6 +237,66 @@ def execute_change_password(ctx: ExecContext, s: ast.ChangePasswordSentence) -> 
 
 
 _ROLE_RANK = {"GOD": 4, "ADMIN": 3, "USER": 2, "GUEST": 1}
+
+
+def execute_download(ctx: ExecContext, s: ast.DownloadSentence) -> Result:
+    """DOWNLOAD HDFS "url" — stage bulk-load SSTs for the current space
+    (ref: meta /download-dispatch → storaged /download per part)."""
+    st = ctx.require_space()
+    if not st.ok():
+        return StatusOr.from_status(st)
+    st = ctx.client.download(ctx.space_id(), s.url)
+    if not st.ok():
+        return StatusOr.from_status(st)
+    return _ok()
+
+
+def execute_ingest(ctx: ExecContext, s: ast.IngestSentence) -> Result:
+    """INGEST — load staged SSTs into the current space (ref:
+    IngestExecutor → storaged /ingest → engine ingest)."""
+    st = ctx.require_space()
+    if not st.ok():
+        return StatusOr.from_status(st)
+    st, n = ctx.client.ingest(ctx.space_id())
+    if not st.ok():
+        return StatusOr.from_status(st)
+    return _ok(InterimResult(["Ingested"], [(n,)]))
+
+
+def _snapshot_name() -> str:
+    import time
+    return time.strftime("SNAPSHOT_%Y_%m_%d_%H_%M_%S")
+
+
+def execute_create_snapshot(ctx: ExecContext,
+                            s: ast.CreateSnapshotSentence) -> Result:
+    """CREATE SNAPSHOT — meta records the snapshot, every storage host
+    dumps a checkpoint, then the record flips INVALID→VALID (crash
+    between the two leaves an INVALID record, like the reference)."""
+    name = _snapshot_name()
+    st = ctx.meta.create_snapshot(name)
+    if not st.ok():
+        return StatusOr.from_status(st)
+    st = ctx.client.create_checkpoint(name)
+    if not st.ok():
+        return StatusOr.from_status(st)
+    ctx.meta.set_snapshot_status(name, "VALID")
+    return _ok(InterimResult(["Name"], [(name,)]))
+
+
+def execute_drop_snapshot(ctx: ExecContext,
+                          s: ast.DropSnapshotSentence) -> Result:
+    # storage dumps go first: if any host fails, the catalog record
+    # survives so DROP SNAPSHOT can be retried
+    if not ctx.meta.has_snapshot(s.name):
+        return _err(ErrorCode.E_NOT_FOUND, f"snapshot {s.name} not found")
+    st = ctx.client.drop_checkpoint(s.name)
+    if not st.ok():
+        return StatusOr.from_status(st)
+    st = ctx.meta.drop_snapshot(s.name)
+    if not st.ok():
+        return StatusOr.from_status(st)
+    return _ok()
 
 
 def _caller_rank_in(ctx: ExecContext, space_id: int) -> int:

@@ -25,7 +25,8 @@ _ROLE_RANK = {"GOD": 4, "ADMIN": 3, "USER": 2, "GUEST": 1, None: 0}
 # sentence kind -> minimum role required in the current space
 _WRITE_KINDS = {ast.Kind.INSERT_VERTICES, ast.Kind.INSERT_EDGES,
                 ast.Kind.DELETE_VERTICES, ast.Kind.DELETE_EDGES,
-                ast.Kind.UPDATE_VERTEX, ast.Kind.UPDATE_EDGE, ast.Kind.INGEST}
+                ast.Kind.UPDATE_VERTEX, ast.Kind.UPDATE_EDGE, ast.Kind.INGEST,
+                ast.Kind.DOWNLOAD}
 _SCHEMA_KINDS = {ast.Kind.CREATE_TAG, ast.Kind.CREATE_EDGE, ast.Kind.ALTER_TAG,
                  ast.Kind.ALTER_EDGE, ast.Kind.DROP_TAG, ast.Kind.DROP_EDGE}
 _GOD_KINDS = {ast.Kind.CREATE_SPACE, ast.Kind.DROP_SPACE, ast.Kind.BALANCE,
@@ -167,6 +168,10 @@ _DISPATCH: Dict[ast.Kind, Callable] = {
     ast.Kind.CHANGE_PASSWORD: adm.execute_change_password,
     ast.Kind.GRANT: adm.execute_grant,
     ast.Kind.REVOKE: adm.execute_revoke,
+    ast.Kind.DOWNLOAD: adm.execute_download,
+    ast.Kind.INGEST: adm.execute_ingest,
+    ast.Kind.CREATE_SNAPSHOT: adm.execute_create_snapshot,
+    ast.Kind.DROP_SNAPSHOT: adm.execute_drop_snapshot,
 }
 
 

@@ -27,6 +27,7 @@ P_CONFIG = b"__cfg:"          # + module:name          -> config json
 P_ID = b"__id:"               # + counter name         -> u32 (next id)
 P_BALANCE = b"__bal:"         # + plan_id(u64)+task    -> task json
 P_SEGMENT = b"__seg:"         # + segment:key          -> custom KV
+P_SNAPSHOT = b"__snp:"        # + name                 -> status str
 
 
 _U32 = struct.Struct(">I")
@@ -106,6 +107,10 @@ def balance_prefix(plan_id: int = None) -> bytes:
 
 def segment_key(segment: str, key: str) -> bytes:
     return P_SEGMENT + f"{segment}:{key}".encode("utf-8")
+
+
+def snapshot_key(name: str) -> bytes:
+    return P_SNAPSHOT + name.encode("utf-8")
 
 
 def unpack_u32(b: bytes) -> int:

@@ -434,6 +434,35 @@ class MetaService:
                 for k, v in self._scan(prefix)]
 
     # ------------------------------------------------------------------
+    # snapshots (catalog records; the storage-side checkpoint dump is
+    # driven by the graph executor through the storage client)
+    # ------------------------------------------------------------------
+    def create_snapshot(self, name: str) -> Status:
+        if self._get(mk.snapshot_key(name)) is not None:
+            return Status.error(ErrorCode.E_EXISTED,
+                                f"snapshot {name} already exists")
+        return self._put((mk.snapshot_key(name), b"INVALID"))
+
+    def set_snapshot_status(self, name: str, status: str) -> Status:
+        if self._get(mk.snapshot_key(name)) is None:
+            return Status.error(ErrorCode.E_NOT_FOUND,
+                                f"snapshot {name} not found")
+        return self._put((mk.snapshot_key(name), status.encode()))
+
+    def has_snapshot(self, name: str) -> bool:
+        return self._get(mk.snapshot_key(name)) is not None
+
+    def drop_snapshot(self, name: str) -> Status:
+        if self._get(mk.snapshot_key(name)) is None:
+            return Status.error(ErrorCode.E_NOT_FOUND,
+                                f"snapshot {name} not found")
+        return self._remove(mk.snapshot_key(name))
+
+    def list_snapshots(self) -> List[Tuple[str, str]]:
+        return [(k[len(mk.P_SNAPSHOT):].decode(), v.decode())
+                for k, v in self._scan(mk.P_SNAPSHOT)]
+
+    # ------------------------------------------------------------------
     # config registry (configMan; modes IMMUTABLE/REBOOT/MUTABLE)
     # ------------------------------------------------------------------
     def reg_config(self, module: str, name: str, value: Any,

@@ -271,3 +271,32 @@ class StorageClient:
         part = ku.part_id(_fnv1a64(name.encode("utf-8")), n)
         svc = self._hosts[self._leader(space_id, part)]
         return svc.get_uuid(space_id, part, name)
+
+    # ------------------------------------------------------------------
+    # admin fan-out to every storage host (ref: meta dispatches download/
+    # ingest/checkpoint to all storaged over HTTP)
+    # ------------------------------------------------------------------
+    def _all_hosts_ok(self, call) -> Status:
+        for host, svc in self._hosts.items():
+            st = call(svc)
+            if not st.ok():
+                return Status.error(st.code, f"{host}: {st.msg}")
+        return Status.OK()
+
+    def download(self, space_id: int, url: str) -> Status:
+        return self._all_hosts_ok(lambda s: s.download(space_id, url))
+
+    def ingest(self, space_id: int) -> Tuple[Status, int]:
+        total = 0
+        for host, svc in self._hosts.items():
+            st, n = svc.ingest(space_id)
+            if not st.ok():
+                return Status.error(st.code, f"{host}: {st.msg}"), total
+            total += n
+        return Status.OK(), total
+
+    def create_checkpoint(self, name: str) -> Status:
+        return self._all_hosts_ok(lambda s: s.create_checkpoint(name))
+
+    def drop_checkpoint(self, name: str) -> Status:
+        return self._all_hosts_ok(lambda s: s.drop_checkpoint(name))

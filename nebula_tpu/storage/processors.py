@@ -521,6 +521,84 @@ class StorageService:
     def kv_get(self, space_id: int, part: int, key: bytes):
         return self.store.get(space_id, part, key)
 
+    # ------------------------------------------------------------------
+    # bulk load + checkpoints (ref: StorageHttp{Download,Ingest}Handler,
+    # checkpoint dispatch in the meta snapshot flow)
+    # ------------------------------------------------------------------
+    def _staging_dir(self, space_id: int) -> str:
+        from ..common.flags import storage_flags
+        import os
+        return os.path.join(storage_flags.get("download_dir"),
+                            f"space_{space_id}")
+
+    def download(self, space_id: int, url: str) -> Status:
+        """Stage bulk-load SST files for this space's parts (ref:
+        StorageHttpDownloadHandler pulls per-part SSTs from HDFS)."""
+        from ..common.hdfs import HdfsHelper
+        if not self.store.parts(space_id):
+            return Status.error(ErrorCode.E_SPACE_NOT_FOUND,
+                                f"space {space_id} has no local parts")
+        return HdfsHelper().copy_to_local(url, self._staging_dir(space_id))
+
+    def ingest(self, space_id: int) -> Tuple[Status, int]:
+        """Ingest previously staged SSTs into the space's parts (ref:
+        StorageHttpIngestHandler → RocksEngine::ingest)."""
+        from .sst import ingest_dir
+        return ingest_dir(self.store, space_id, self._staging_dir(space_id))
+
+    def _checkpoint_dir(self, name: str) -> str:
+        """Per-host checkpoint dir: hosts sharing a filesystem (or the
+        in-process multi-host topology) must not overwrite each other's
+        dumps."""
+        import os
+        from ..common.flags import storage_flags
+        return os.path.join(storage_flags.get("snapshot_dir"), name,
+                            self.host.replace(":", "_"))
+
+    def create_checkpoint(self, name: str) -> Status:
+        """Dump every space to <snapshot_dir>/<name>/<host>/ (ref:
+        storaged checkpoint dispatch behind CREATE SNAPSHOT)."""
+        import os
+        from .sst import write_sst
+        root = self._checkpoint_dir(name)
+        os.makedirs(root, exist_ok=True)
+        for space_id in self.store.spaces():
+            engine = self.store.space_engine(space_id)
+            if engine is None:
+                continue
+            kvs = list(engine.prefix(b""))
+            write_sst(os.path.join(root, f"space_{space_id}.nsst"), kvs)
+        return Status.OK()
+
+    def drop_checkpoint(self, name: str) -> Status:
+        import os
+        import shutil
+        from ..common.flags import storage_flags
+        root = self._checkpoint_dir(name)
+        if os.path.isdir(root):
+            shutil.rmtree(root)
+        # remove the snapshot dir itself once the last host's dump is gone
+        parent = os.path.join(storage_flags.get("snapshot_dir"), name)
+        if os.path.isdir(parent) and not os.listdir(parent):
+            os.rmdir(parent)
+        return Status.OK()
+
+    def restore_checkpoint(self, name: str, space_id: int) -> Status:
+        """Load a snapshot dump back into the space's engine (recovery
+        path — the reference restarts storaged on checkpoint dirs)."""
+        import os
+        from .sst import read_sst
+        path = os.path.join(self._checkpoint_dir(name),
+                            f"space_{space_id}.nsst")
+        if not os.path.exists(path):
+            return Status.error(ErrorCode.E_EXECUTION_ERROR,
+                                f"no snapshot dump at {path}")
+        engine = self.store.space_engine(space_id)
+        if engine is None:
+            return Status.error(ErrorCode.E_SPACE_NOT_FOUND,
+                                f"space {space_id} not found")
+        return engine.ingest(read_sst(path))
+
     def get_uuid(self, space_id: int, part: int, name: str) -> Tuple[PartResult, int]:
         """Stable name→vid allocation (ref: GetUUIDProcessor)."""
         key = ku.uuid_key(part, name.encode("utf-8"))

@@ -1,0 +1,151 @@
+"""Bulk-load SST files: offline-generated sorted KV batches per partition.
+
+Role parity with the reference's SST bulk-load pipeline: the Spark
+sstfile-generator writes per-part RocksDB SST files to HDFS
+(tools/spark-sstfile-generator), storaged pulls them with the
+`/download` HTTP handler per part and `INGEST` calls
+`RocksEngine::ingest` (ref: storage/StorageHttpDownloadHandler.cpp,
+kvstore/RocksEngine.cpp:360).
+
+Our container is the NSST file: magic + count + length-prefixed
+key/value pairs, keys in sorted order — the simplest format the
+engines' `ingest` accepts, written offline by `SstGenerator` (the
+Spark-generator equivalent: rows in, per-part sorted KV files out,
+including the reverse edge copy exactly as the online write path
+splits them).
+
+File layout (little-endian):
+    magic  b"NSST\\x01"
+    u64    pair count
+    repeat: u32 klen, key, u32 vlen, value
+"""
+from __future__ import annotations
+
+import os
+import struct
+from typing import Dict, Iterable, List, Tuple
+
+from ..codec.row import RowWriter
+from ..codec.schema import Schema
+from ..common import keys as ku
+from ..common.status import ErrorCode, Status
+
+MAGIC = b"NSST\x01"
+_U32 = struct.Struct("<I")
+_U64 = struct.Struct("<Q")
+
+KV = Tuple[bytes, bytes]
+
+
+def _encode_row(schema: Schema, values: Dict) -> bytes:
+    w = RowWriter(schema)
+    for name, v in values.items():
+        w.set(name, v)
+    return w.encode()
+
+
+def write_sst(path: str, kvs: Iterable[KV]) -> int:
+    """Write a sorted NSST file; returns the pair count."""
+    pairs = sorted(kvs)
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:
+        f.write(MAGIC)
+        f.write(_U64.pack(len(pairs)))
+        for k, v in pairs:
+            f.write(_U32.pack(len(k)))
+            f.write(k)
+            f.write(_U32.pack(len(v)))
+            f.write(v)
+    os.replace(tmp, path)
+    return len(pairs)
+
+
+def read_sst(path: str) -> List[KV]:
+    with open(path, "rb") as f:
+        raw = f.read()
+    if raw[:len(MAGIC)] != MAGIC:
+        raise ValueError(f"{path}: not an NSST file")
+    off = len(MAGIC)
+    (n,) = _U64.unpack_from(raw, off)
+    off += _U64.size
+    out: List[KV] = []
+    for _ in range(n):
+        (klen,) = _U32.unpack_from(raw, off)
+        off += _U32.size
+        k = raw[off:off + klen]
+        off += klen
+        (vlen,) = _U32.unpack_from(raw, off)
+        off += _U32.size
+        v = raw[off:off + vlen]
+        off += vlen
+        out.append((k, v))
+    return out
+
+
+def part_file(part_id: int) -> str:
+    return f"part_{part_id}.nsst"
+
+
+class SstGenerator:
+    """Offline per-part SST generation from raw rows (the Spark
+    generator's role): callers add vertices/edges with python values,
+    rows are encoded with the schema codec, keys shard by
+    `vid % num_parts + 1` exactly like the online path, and edges get
+    their reverse copy on the dst part."""
+
+    def __init__(self, num_parts: int):
+        self.num_parts = num_parts
+        self._per_part: Dict[int, List[KV]] = {p: [] for p in
+                                               range(1, num_parts + 1)}
+        self._version = ku.now_version()
+
+    def _part(self, vid: int) -> int:
+        return ku.part_id(vid, self.num_parts)
+
+    def add_vertex(self, vid: int, tag_id: int, schema: Schema,
+                   values: Dict) -> None:
+        row = _encode_row(schema, values)
+        p = self._part(vid)
+        self._per_part[p].append(
+            (ku.vertex_key(p, vid, tag_id, self._version), row))
+
+    def add_edge(self, src: int, etype: int, rank: int, dst: int,
+                 schema: Schema, values: Dict) -> None:
+        row = _encode_row(schema, values)
+        sp, dp = self._part(src), self._part(dst)
+        self._per_part[sp].append(
+            (ku.edge_key(sp, src, etype, rank, dst, self._version), row))
+        self._per_part[dp].append(
+            (ku.edge_key(dp, dst, -etype, rank, src, self._version), row))
+
+    def write(self, out_dir: str) -> Dict[int, int]:
+        """Write one NSST per part into out_dir; returns part -> count."""
+        os.makedirs(out_dir, exist_ok=True)
+        counts = {}
+        for p, kvs in self._per_part.items():
+            if kvs:
+                counts[p] = write_sst(os.path.join(out_dir, part_file(p)), kvs)
+        return counts
+
+
+def ingest_dir(store, space_id: int, staging_dir: str) -> Tuple[Status, int]:
+    """INGEST: load every staged per-part NSST into the space's parts
+    (ref: StorageHttpIngestHandler → RocksEngine::ingest). Returns
+    (status, pairs ingested)."""
+    if not os.path.isdir(staging_dir):
+        return (Status.error(ErrorCode.E_EXECUTION_ERROR,
+                             f"no staged download at {staging_dir}"), 0)
+    total = 0
+    for p in store.parts(space_id):
+        path = os.path.join(staging_dir, part_file(p))
+        if not os.path.exists(path):
+            continue
+        kvs = read_sst(path)
+        st = store.ingest(space_id, p, kvs)
+        if not st.ok():
+            return st, total
+        total += len(kvs)
+    if total == 0:
+        return (Status.error(ErrorCode.E_EXECUTION_ERROR,
+                             f"no part files found under {staging_dir}"), 0)
+    return Status.OK(), total

@@ -42,10 +42,14 @@ def test_sharded_matches_single_device(snap8, starts, steps, etypes):
 
     f_single, a_single = traverse.multi_hop(
         f0, steps, snap.d_edge_src, snap.d_edge_etype, snap.d_edge_valid,
-        snap.d_seg_starts, snap.d_seg_ends, req)
+        snap.d_order, snap.d_seg_starts, snap.d_seg_ends, req)
+    border, bstarts, bends = traverse.build_segments(
+        snap.np_gidx, snap.num_parts, snap.cap_v,
+        num_blocks=mesh.devices.size)
     f_shard, a_shard = dist.multi_hop_sharded(
         mesh, f0, steps, snap.d_edge_src, snap.d_edge_etype,
-        snap.d_edge_valid, snap.d_seg_starts, snap.d_seg_ends, req)
+        snap.d_edge_valid, jnp.asarray(border), jnp.asarray(bstarts),
+        jnp.asarray(bends), req)
     assert np.array_equal(np.asarray(f_single), np.asarray(f_shard))
     assert np.array_equal(np.asarray(a_single), np.asarray(a_shard))
 
@@ -57,10 +61,13 @@ def test_sharded_count_matches(snap8):
     req = jnp.asarray(traverse.pad_edge_types([1]))
     n_single = int(traverse.multi_hop_count(
         f0, 3, snap.d_edge_src, snap.d_edge_etype, snap.d_edge_valid,
-        snap.d_seg_starts, snap.d_seg_ends, req))
+        snap.d_order, snap.d_seg_starts, snap.d_seg_ends, req))
+    border, bstarts, bends = traverse.build_segments(
+        snap.np_gidx, snap.num_parts, snap.cap_v,
+        num_blocks=mesh.devices.size)
     n_shard = int(dist.multi_hop_count_sharded(
         mesh, f0, 3, snap.d_edge_src, snap.d_edge_etype, snap.d_edge_valid,
-        snap.d_seg_starts, snap.d_seg_ends, req))
+        jnp.asarray(border), jnp.asarray(bstarts), jnp.asarray(bends), req))
     assert n_single == n_shard > 0
 
 
@@ -74,10 +81,11 @@ def test_sharded_with_placed_arrays(snap8):
     req = jnp.asarray(traverse.pad_edge_types([1]))
     f, a = dist.multi_hop_sharded(mesh, f0, 2, snap.d_edge_src,
                                   snap.d_edge_etype, snap.d_edge_valid,
-                                  snap.d_seg_starts, snap.d_seg_ends, req)
+                                  snap.d_border, snap.d_bseg_starts,
+                                  snap.d_bseg_ends, req)
     # compare against a fresh single-device run
     f1, a1 = traverse.multi_hop(f0, 2, snap.d_edge_src, snap.d_edge_etype,
-                                snap.d_edge_valid, snap.d_seg_starts,
-                                snap.d_seg_ends, req)
+                                snap.d_edge_valid, snap.d_order,
+                                snap.d_seg_starts, snap.d_seg_ends, req)
     assert np.array_equal(np.asarray(f), np.asarray(f1))
     assert np.array_equal(np.asarray(a), np.asarray(a1))
