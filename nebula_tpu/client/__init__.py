@@ -1,0 +1,54 @@
+"""Client library: connect to a graphd over the rpc/ transport.
+
+Role parity with the reference's `client/cpp/GraphClient` (ref
+client/cpp/GraphClient.{h,cpp}): connect → authenticate → execute nGQL →
+ExecutionResponse with columns/rows/latency; plus a context-manager
+convenience. The console REPL and tools drive this same class.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..common.status import ErrorCode, NebulaError
+from ..graph.context import ExecutionResponse
+from ..rpc import proxy
+
+
+class GraphClient:
+    def __init__(self, addr: str):
+        self._rpc = proxy(addr, "graph")
+        self.addr = addr
+        self._session_id: Optional[int] = None
+
+    # ------------------------------------------------------------------
+    def connect(self, user: str = "root", password: str = "") -> "GraphClient":
+        r = self._rpc.authenticate(user, password)
+        if not r.ok():
+            raise NebulaError(r.status)
+        self._session_id = r.value()
+        return self
+
+    def execute(self, stmt: str) -> ExecutionResponse:
+        if self._session_id is None:
+            resp = ExecutionResponse()
+            resp.code = ErrorCode.E_SESSION_INVALID
+            resp.error_msg = "not connected (call connect() first)"
+            return resp
+        return self._rpc.execute(self._session_id, stmt)
+
+    def disconnect(self) -> None:
+        if self._session_id is not None:
+            try:
+                self._rpc.signout(self._session_id)
+            finally:
+                self._session_id = None
+
+    # ------------------------------------------------------------------
+    def __enter__(self) -> "GraphClient":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.disconnect()
+
+
+__all__ = ["GraphClient"]

@@ -118,14 +118,21 @@ def main(argv: Optional[List[str]] = None) -> int:
     ap.add_argument("-f", metavar="FILE", help="batch file of statements")
     ap.add_argument("--user", default="root")
     ap.add_argument("--password", default="")
+    ap.add_argument("--addr", metavar="HOST:PORT",
+                    help="connect to a running graphd over rpc "
+                         "(default: boot an in-proc cluster)")
     args = ap.parse_args(argv)
 
-    # single-process deployment: boot an in-proc cluster with the TPU
-    # engine attached (multi-process daemons connect over rpc instead)
-    from .cluster import InProcCluster
-    from .engine_tpu import TpuGraphEngine
-    cluster = InProcCluster(tpu_engine=TpuGraphEngine())
-    conn = cluster.connect(args.user, args.password)
+    if args.addr:
+        from .client import GraphClient
+        conn = GraphClient(args.addr).connect(args.user, args.password)
+    else:
+        # single-process deployment: boot an in-proc cluster with the
+        # TPU engine attached
+        from .cluster import InProcCluster
+        from .engine_tpu import TpuGraphEngine
+        cluster = InProcCluster(tpu_engine=TpuGraphEngine())
+        conn = cluster.connect(args.user, args.password)
     console = Console(conn)
     if args.e:
         console.run_statement(args.e)

@@ -1,0 +1,10 @@
+"""The three daemons (ref: src/daemons/{Meta,Storage,Graph}Daemon.cpp):
+each boots its services behind the rpc/ transport; `serve_*` returns a
+running handle for in-process cluster tests (the reference's TestEnv
+idiom), `main()`s are the CLI entry points."""
+from .metad import MetadHandle, serve_metad
+from .storaged import StoragedHandle, serve_storaged
+from .graphd import GraphdHandle, serve_graphd
+
+__all__ = ["serve_metad", "serve_storaged", "serve_graphd",
+           "MetadHandle", "StoragedHandle", "GraphdHandle"]

@@ -1,0 +1,86 @@
+"""graphd: the stateless query daemon (ref: daemons/GraphDaemon.cpp:
+128-158 boots GraphService::init → ExecutionEngine::init → MetaClient →
+SchemaManager → StorageClient, then serves the graph thrift API)."""
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass
+from typing import Dict
+
+from ..graph.engine import ExecutionEngine, GraphService
+from ..meta.client import MetaClient
+from ..meta.schema_manager import SchemaManager
+from ..rpc import RpcServer, proxy
+from ..storage.client import StorageClient
+
+
+class _StorageHostMap(dict):
+    """host addr -> storage service proxy, created on first use — new
+    storaged hosts become reachable without re-wiring (the reference's
+    ThriftClientManager creates clients per address on demand)."""
+
+    def __missing__(self, addr: str):
+        p = proxy(addr, "storage")
+        self[addr] = p
+        return p
+
+
+@dataclass
+class GraphdHandle:
+    service: GraphService
+    engine: ExecutionEngine
+    meta_client: MetaClient
+    server: RpcServer
+
+    @property
+    def addr(self) -> str:
+        return self.server.addr
+
+    def stop(self) -> None:
+        self.meta_client.stop()
+        self.server.stop()
+
+
+def serve_graphd(meta_addr: str, host: str = "127.0.0.1", port: int = 0,
+                 tpu_engine=None) -> GraphdHandle:
+    mc = MetaClient(meta_addr, role="graph")
+    mc.start(heartbeat=False)  # topology snapshot for part routing
+    sm = SchemaManager(mc)
+    hosts = _StorageHostMap()
+
+    def refresh_hosts():
+        for h in mc.storage_hosts():
+            hosts[h]  # admin fan-out must reach late-joining hosts too
+
+    refresh_hosts()
+    client = StorageClient(sm, hosts=hosts, part_to_host=mc.part_host,
+                           refresh_hosts=refresh_hosts)
+    engine = ExecutionEngine(mc, sm, client, tpu_engine=tpu_engine)
+    service = GraphService(engine)
+    server = RpcServer(host, port).register("graph", service).start()
+    return GraphdHandle(service, engine, mc, server)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description="nebula-tpu graph daemon")
+    ap.add_argument("--meta", required=True, help="metad host:port")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=3699)
+    ap.add_argument("--tpu", action="store_true",
+                    help="enable the TPU graph engine for GO/FIND PATH")
+    args = ap.parse_args(argv)
+    tpu = None
+    if args.tpu:
+        from ..engine_tpu import TpuGraphEngine
+        tpu = TpuGraphEngine()
+    h = serve_graphd(args.meta, args.host, args.port, tpu_engine=tpu)
+    print(f"graphd listening on {h.addr} (meta {args.meta})")
+    try:
+        import threading
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        h.stop()
+
+
+if __name__ == "__main__":
+    main()

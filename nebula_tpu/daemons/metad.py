@@ -1,0 +1,46 @@
+"""metad: the meta service daemon (ref: daemons/MetaDaemon.cpp:160-259
+boots the meta KV, cluster id, gflags manager, and thrift handler)."""
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass
+
+from ..meta.service import MetaService
+from ..rpc import RpcServer
+
+
+@dataclass
+class MetadHandle:
+    meta: MetaService
+    server: RpcServer
+
+    @property
+    def addr(self) -> str:
+        return self.server.addr
+
+    def stop(self) -> None:
+        self.server.stop()
+
+
+def serve_metad(host: str = "127.0.0.1", port: int = 0) -> MetadHandle:
+    meta = MetaService()
+    server = RpcServer(host, port).register("meta", meta).start()
+    return MetadHandle(meta, server)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description="nebula-tpu meta daemon")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=45500)
+    args = ap.parse_args(argv)
+    h = serve_metad(args.host, args.port)
+    print(f"metad listening on {h.addr}")
+    try:
+        import threading
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        h.stop()
+
+
+if __name__ == "__main__":
+    main()

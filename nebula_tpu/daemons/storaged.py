@@ -1,0 +1,80 @@
+"""storaged: the storage daemon (ref: storage/StorageServer.cpp:88-144
+wires MetaClient → waitForMetadReady → SchemaManager → NebulaStore with
+a meta-driven PartManager → handlers → thrift serve; heartbeats keep
+the host active so metad allocates parts here)."""
+from __future__ import annotations
+
+import argparse
+from dataclasses import dataclass
+
+from ..kvstore.store import GraphStore
+from ..meta.client import MetaClient
+from ..meta.schema_manager import SchemaManager
+from ..rpc import RpcServer
+from ..storage.processors import StorageService
+
+
+@dataclass
+class StoragedHandle:
+    store: GraphStore
+    storage: StorageService
+    meta_client: MetaClient
+    server: RpcServer
+
+    @property
+    def addr(self) -> str:
+        return self.server.addr
+
+    def stop(self) -> None:
+        self.meta_client.stop()
+        self.server.stop()
+
+
+def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
+                   port: int = 0,
+                   load_interval: float = 0.2) -> StoragedHandle:
+    server = RpcServer(host, port)
+    addr = server.addr
+    store = GraphStore()
+    mc = MetaClient(meta_addr, local_addr=addr, role="storage")
+
+    def on_change(event: str, **kw):
+        # the MetaServerBasedPartManager push: local parts follow the
+        # meta allocation (ref: kvstore/PartManager.h handler methods)
+        if event in ("space_added", "parts_added"):
+            for p in kw.get("parts", []):
+                store.add_part(kw["space_id"], p)
+        elif event == "parts_removed":
+            for p in kw.get("parts", []):
+                store.remove_part(kw["space_id"], p)
+        elif event == "space_removed":
+            store.remove_space(kw["space_id"])
+
+    mc.add_listener(on_change)
+    # register with metad BEFORE the first topology sync so part
+    # allocation can target this host (waitForMetadReady ordering)
+    mc.heartbeat(addr, "storage")
+    mc.start(load_interval=load_interval)
+    sm = SchemaManager(mc)
+    storage = StorageService(store, sm, host=addr)
+    server.register("storage", storage).start()
+    return StoragedHandle(store, storage, mc, server)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(description="nebula-tpu storage daemon")
+    ap.add_argument("--meta", required=True, help="metad host:port")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--port", type=int, default=44500)
+    args = ap.parse_args(argv)
+    h = serve_storaged(args.meta, args.host, args.port)
+    print(f"storaged listening on {h.addr} (meta {args.meta})")
+    try:
+        import threading
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        h.stop()
+
+
+if __name__ == "__main__":
+    main()

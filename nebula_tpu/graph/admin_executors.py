@@ -260,12 +260,17 @@ def execute_ingest(ctx: ExecContext, s: ast.IngestSentence) -> Result:
     st, n = ctx.client.ingest(ctx.space_id())
     if not st.ok():
         return StatusOr.from_status(st)
+    if n == 0:
+        return _err(ErrorCode.E_EXECUTION_ERROR,
+                    "no staged part files found on any storage host "
+                    "(run DOWNLOAD first)")
     return _ok(InterimResult(["Ingested"], [(n,)]))
 
 
-def _snapshot_name() -> str:
+def _snapshot_name(suffix: int = 0) -> str:
     import time
-    return time.strftime("SNAPSHOT_%Y_%m_%d_%H_%M_%S")
+    base = time.strftime("SNAPSHOT_%Y_%m_%d_%H_%M_%S")
+    return base if suffix == 0 else f"{base}_{suffix}"
 
 
 def execute_create_snapshot(ctx: ExecContext,
@@ -273,14 +278,21 @@ def execute_create_snapshot(ctx: ExecContext,
     """CREATE SNAPSHOT — meta records the snapshot, every storage host
     dumps a checkpoint, then the record flips INVALID→VALID (crash
     between the two leaves an INVALID record, like the reference)."""
-    name = _snapshot_name()
-    st = ctx.meta.create_snapshot(name)
+    st = None
+    name = ""
+    for suffix in range(16):  # same-second snapshots get a suffix
+        name = _snapshot_name(suffix)
+        st = ctx.meta.create_snapshot(name)
+        if st.ok() or st.code != ErrorCode.E_EXISTED:
+            break
     if not st.ok():
         return StatusOr.from_status(st)
     st = ctx.client.create_checkpoint(name)
     if not st.ok():
         return StatusOr.from_status(st)
-    ctx.meta.set_snapshot_status(name, "VALID")
+    st = ctx.meta.set_snapshot_status(name, "VALID")
+    if not st.ok():
+        return StatusOr.from_status(st)
     return _ok(InterimResult(["Name"], [(name,)]))
 
 

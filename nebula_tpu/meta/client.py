@@ -1,0 +1,153 @@
+"""Network MetaClient: RPC passthrough + background heartbeat/topology
+loops.
+
+Role parity with the reference's `meta/client/MetaClient` (ref
+meta/client/MetaClient.{h,cpp}): daemons hold one MetaClient; it
+forwards catalog RPCs to metad, sends heartbeats every
+`heartbeat_interval_secs` (ref MetaClient.cpp:1132), and re-loads the
+topology every `load_data_interval_secs`, diffing part allocation and
+firing MetaChangedListener-style callbacks (ref MetaClient.cpp:120-193,
+454-519) so storaged creates/drops local parts at runtime.
+
+The passthrough design means SchemaManager and the executors run
+unchanged over either a local MetaService or this client — the same
+duck-typed surface, exactly how the reference's ServerBasedSchemaManager
+sits on the MetaClient cache.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Set
+
+from ..common.flags import storage_flags
+from ..rpc import proxy
+
+
+class MetaClient:
+    def __init__(self, meta_addr: str, local_addr: str = "",
+                 role: str = "storage"):
+        self._rpc = proxy(meta_addr, "meta")
+        self.meta_addr = meta_addr
+        self.local_addr = local_addr
+        self.role = role
+        self._listeners: List[Callable] = []
+        self._known_parts: Dict[int, Set[int]] = {}  # space -> my part ids
+        self._known_spaces: Dict[int, object] = {}
+        self._alloc: Dict[int, Dict[int, List[str]]] = {}  # space -> part -> hosts
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+
+    # -- passthrough ---------------------------------------------------
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return getattr(self._rpc, name)
+
+    @property
+    def catalog_version(self) -> int:
+        """Fetched per access: SchemaManager keys its lookup cache on
+        this, so correctness beats the extra round-trip (the reference
+        instead pulls the whole catalog every second)."""
+        try:
+            return self._rpc.get_catalog_version()
+        except Exception:
+            return -1
+
+    # -- listeners (MetaChangedListener) -------------------------------
+    def add_listener(self, listener: Callable) -> None:
+        """listener(event, **kw); events: space_added(space_id, desc,
+        parts), space_removed(space_id), parts_added/parts_removed
+        (space_id, parts)."""
+        self._listeners.append(listener)
+
+    def _notify(self, event: str, **kw) -> None:
+        for l in self._listeners:
+            try:
+                l(event, **kw)
+            except Exception:
+                pass
+
+    # -- background loops ----------------------------------------------
+    def start(self, heartbeat: bool = True, watch_topology: bool = True,
+              load_interval: float = 1.0) -> "MetaClient":
+        if heartbeat and self.local_addr:
+            t = threading.Thread(target=self._hb_loop, daemon=True,
+                                 name="meta-heartbeat")
+            t.start()
+            self._threads.append(t)
+        if watch_topology:
+            self._sync_once()  # synchronous first load (waitForMetadReady)
+            t = threading.Thread(target=self._watch_loop,
+                                 args=(load_interval,), daemon=True,
+                                 name="meta-watch")
+            t.start()
+            self._threads.append(t)
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+    def _hb_loop(self) -> None:
+        while not self._stop.is_set():
+            try:
+                self._rpc.heartbeat(self.local_addr, self.role)
+            except Exception:
+                pass
+            self._stop.wait(storage_flags.get("heartbeat_interval_secs", 10))
+
+    def _watch_loop(self, interval: float) -> None:
+        while not self._stop.is_set():
+            self._stop.wait(interval)
+            try:
+                self._sync_once()
+            except Exception:
+                pass
+
+    def _sync_once(self) -> None:
+        """Pull the full topology snapshot and diff (the reference
+        re-loads everything each tick and diffs, MetaClient.cpp:454)."""
+        spaces = {d.space_id: d for d in self._rpc.list_spaces()}
+        for sid, desc in spaces.items():
+            alloc: Dict[int, List[str]] = self._rpc.get_parts_alloc(sid)
+            self._alloc[sid] = alloc
+            mine = {p for p, hosts in alloc.items()
+                    if not self.local_addr or self.local_addr in hosts
+                    or hosts == ["local"]}
+            known = self._known_parts.get(sid)
+            if known is None:
+                self._known_spaces[sid] = desc
+                self._known_parts[sid] = mine
+                self._notify("space_added", space_id=sid, desc=desc,
+                             parts=sorted(mine))
+            else:
+                added, removed = mine - known, known - mine
+                if added:
+                    self._notify("parts_added", space_id=sid,
+                                 parts=sorted(added))
+                if removed:
+                    self._notify("parts_removed", space_id=sid,
+                                 parts=sorted(removed))
+                self._known_parts[sid] = mine
+        for sid in list(self._known_parts):
+            if sid not in spaces:
+                del self._known_parts[sid]
+                self._known_spaces.pop(sid, None)
+                self._alloc.pop(sid, None)
+                self._notify("space_removed", space_id=sid)
+
+    # -- routing helpers for graphd ------------------------------------
+    def part_host(self, space_id: int, part_id: int) -> str:
+        """First replica host of a part (leader by convention until the
+        raft layer reports real leaders). Served from the watch loop's
+        topology snapshot — one metad round-trip per space on a cache
+        miss, not one per routing lookup in the query hot path."""
+        alloc = self._alloc.get(space_id)
+        if alloc is None or part_id not in alloc:
+            alloc = self._rpc.get_parts_alloc(space_id)
+            self._alloc[space_id] = alloc
+        hosts = alloc.get(part_id) or ["local"]
+        return hosts[0]
+
+    def storage_hosts(self) -> List[str]:
+        return [h.host for h in self._rpc.active_hosts("storage")]

@@ -97,6 +97,10 @@ class MetaService:
         self._put((k, mk.pack_u32(nxt)))
         return nxt
 
+    def get_catalog_version(self) -> int:
+        """RPC-friendly accessor (clients key their caches on this)."""
+        return self.catalog_version
+
     def add_listener(self, listener) -> None:
         """listener: callable(event:str, **kw) — part add/remove pushes."""
         self._listeners.append(listener)

@@ -1,0 +1,4 @@
+from .transport import RpcClient, RpcError, RpcServer, proxy
+from . import wire
+
+__all__ = ["RpcClient", "RpcError", "RpcServer", "proxy", "wire"]

@@ -1,0 +1,224 @@
+"""Framed TCP RPC: server hosting named services + pooled clients.
+
+Role parity with the reference's fbthrift cpp2 stack: one server per
+daemon hosts its service handlers (ref: the three daemons' thrift
+setup, daemons/*.cpp), clients keep pooled connections per (host,
+port) like `ThriftClientManager` (ref common/thrift/ThriftClientManager
+.h). Frames are u32-length-prefixed wire.py payloads:
+
+    request  = (service: str, method: str, args: tuple, kwargs: dict)
+    response = (True, result) | (False, exception string)
+
+Remote exceptions re-raise client-side as RpcError. The server is a
+thread-per-connection loop (daemons are IO-bound python; the heavy
+compute lives in XLA/native code which releases the GIL).
+"""
+from __future__ import annotations
+
+import queue
+import socket
+import socketserver
+import struct
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from . import wire
+
+_U32 = struct.Struct("<I")
+MAX_FRAME = 1 << 30
+
+
+class RpcError(Exception):
+    pass
+
+
+def _read_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("peer closed")
+        buf += chunk
+    return bytes(buf)
+
+
+def _send_frame(sock: socket.socket, payload: bytes) -> None:
+    sock.sendall(_U32.pack(len(payload)) + payload)
+
+
+def _recv_frame(sock: socket.socket) -> bytes:
+    (n,) = _U32.unpack(_read_exact(sock, 4))
+    if n > MAX_FRAME:
+        raise RpcError(f"frame too large ({n})")
+    return _read_exact(sock, n)
+
+
+# ---------------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------------
+
+class RpcServer:
+    """Hosts named service objects; any public method is callable."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0):
+        self._services: Dict[str, Any] = {}
+        outer = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                sock = self.request
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                try:
+                    while True:
+                        raw = _recv_frame(sock)
+                        _send_frame(sock, outer._dispatch(raw))
+                except (ConnectionError, OSError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address
+        self.addr = f"{self.host}:{self.port}"
+        self._thread: Optional[threading.Thread] = None
+
+    def register(self, name: str, service: Any) -> "RpcServer":
+        self._services[name] = service
+        return self
+
+    def _dispatch(self, raw: bytes) -> bytes:
+        try:
+            service_name, method, args, kwargs = wire.decode(raw)
+            svc = self._services.get(service_name)
+            if svc is None:
+                raise RpcError(f"no service {service_name!r}")
+            if method.startswith("_"):
+                raise RpcError(f"method {method!r} not callable")
+            fn = getattr(svc, method, None)
+            if fn is None or not callable(fn):
+                raise RpcError(f"{service_name}.{method} not found")
+            return wire.encode((True, fn(*args, **kwargs)))
+        except Exception as e:  # noqa: BLE001 — errors cross the wire
+            try:
+                return wire.encode((False, f"{type(e).__name__}: {e}"))
+            except Exception:
+                return wire.encode((False, "unserializable server error"))
+
+    def start(self) -> "RpcServer":
+        self._thread = threading.Thread(target=self._server.serve_forever,
+                                        name=f"rpc-{self.port}", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+
+class _ConnPool:
+    """Pooled sockets to one address (ThriftClientManager's role)."""
+
+    def __init__(self, host: str, port: int, size: int = 4,
+                 timeout: float = 30.0):
+        self.host, self.port, self.timeout = host, port, timeout
+        self._free: "queue.Queue[socket.socket]" = queue.Queue(maxsize=size)
+        self._size = size
+        self._created = 0
+        self._lock = threading.Lock()
+
+    def _connect(self) -> socket.socket:
+        sock = socket.create_connection((self.host, self.port),
+                                        timeout=self.timeout)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return sock
+
+    def acquire(self) -> socket.socket:
+        try:
+            return self._free.get_nowait()
+        except queue.Empty:
+            pass
+        with self._lock:
+            if self._created < self._size:
+                self._created += 1
+                try:
+                    return self._connect()
+                except Exception:
+                    self._created -= 1
+                    raise
+        return self._free.get(timeout=self.timeout)
+
+    def release(self, sock: Optional[socket.socket]) -> None:
+        if sock is None:  # connection died — allow a replacement
+            with self._lock:
+                self._created -= 1
+            return
+        try:
+            self._free.put_nowait(sock)
+        except queue.Full:
+            sock.close()
+            with self._lock:
+                self._created -= 1
+
+    def close(self) -> None:
+        while True:
+            try:
+                self._free.get_nowait().close()
+            except queue.Empty:
+                return
+
+
+class RpcClient:
+    """Calls methods on a named service at addr ("host:port")."""
+
+    _pools: Dict[Tuple[str, int], _ConnPool] = {}
+    _pools_lock = threading.Lock()
+
+    def __init__(self, addr: str, service: str):
+        host, port_s = addr.rsplit(":", 1)
+        self._key = (host, int(port_s))
+        self.addr = addr
+        self.service = service
+        with RpcClient._pools_lock:
+            if self._key not in RpcClient._pools:
+                RpcClient._pools[self._key] = _ConnPool(host, int(port_s))
+        self._pool = RpcClient._pools[self._key]
+
+    def call(self, method: str, *args, **kwargs) -> Any:
+        payload = wire.encode((self.service, method, tuple(args), kwargs))
+        last_err: Optional[Exception] = None
+        # after a server restart every pooled socket may be stale; allow
+        # draining the whole pool plus one fresh connect
+        for _ in range(self._pool._size + 1):
+            sock = self._pool.acquire()
+            try:
+                _send_frame(sock, payload)
+                raw = _recv_frame(sock)
+            except (ConnectionError, OSError, socket.timeout) as e:
+                sock.close()
+                self._pool.release(None)
+                last_err = e
+                continue
+            self._pool.release(sock)
+            ok, value = wire.decode(raw)
+            if not ok:
+                raise RpcError(value)
+            return value
+        raise RpcError(f"rpc to {self.addr} failed: {last_err}")
+
+    def __getattr__(self, name: str):
+        if name.startswith("_"):
+            raise AttributeError(name)
+        return lambda *args, **kwargs: self.call(name, *args, **kwargs)
+
+
+def proxy(addr: str, service: str) -> RpcClient:
+    """A client whose attribute calls mirror the remote service's
+    methods — drop-in for the in-proc service objects that
+    StorageClient/MetaClient hold per host."""
+    return RpcClient(addr, service)

@@ -1,0 +1,240 @@
+"""Tagged binary wire codec for inter-daemon RPC.
+
+Role parity with the reference's thrift binary protocol (ref
+src/interface/*.thrift defines the structs; fbthrift serializes them):
+our request/response structs are plain dataclasses, so one generic
+tagged encoder covers every service. Dataclasses and IntEnums cross the
+wire by REGISTERED name — both sides import the same modules, and
+unknown tags fail loudly instead of executing anything (no pickle).
+
+Encoding (little-endian):
+    N   None          T/F  bool            i  zigzag varint int
+    d   f64           s  u32 len + utf8    b  u32 len + bytes
+    l   u32 count + items                  t  tuple (as l, decoded tuple)
+    m   u32 count + key/value pairs        e  enum: u32 reg-id + varint
+    c   dataclass: u32 reg-id + field values in declared order
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+import struct
+from typing import Any, Dict, List, Tuple, Type
+
+_U32 = struct.Struct("<I")
+_F64 = struct.Struct("<d")
+
+
+class WireError(Exception):
+    pass
+
+
+_registry: List[type] = []
+_reg_ids: Dict[type, int] = {}
+
+
+def register(*types: type) -> None:
+    for t in types:
+        if t not in _reg_ids:
+            _reg_ids[t] = len(_registry)
+            _registry.append(t)
+
+
+def _register_defaults() -> None:
+    from ..common.status import ErrorCode, Status, StatusOr
+    from ..codec.schema import PropType, Schema, SchemaField
+    from ..graph.context import ExecutionResponse
+    from ..meta.service import HostInfo, SpaceDesc
+    from ..storage import types as st
+    register(ErrorCode, Status, StatusOr, PropType, SchemaField, Schema,
+             ExecutionResponse, SpaceDesc, HostInfo,
+             st.PartResult, st.EdgeData, st.VertexData, st.BoundRequest,
+             st.BoundResponse, st.PropsResponse, st.ExecResponse,
+             st.NewVertex, st.NewEdge, st.EdgeKey, st.UpdateItemReq,
+             st.UpdateResponse)
+
+
+def _zigzag(n: int) -> int:
+    return (n << 1) if n >= 0 else ((-n) << 1) - 1
+
+
+def _unzigzag(z: int) -> int:
+    return (z >> 1) if (z & 1) == 0 else -((z + 1) >> 1)
+
+
+def _write_varint(out: bytearray, n: int) -> None:
+    z = _zigzag(n)
+    while True:
+        b = z & 0x7F
+        z >>= 7
+        if z:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return
+
+
+def _read_varint(buf: bytes, off: int) -> Tuple[int, int]:
+    shift = 0
+    z = 0
+    while True:
+        b = buf[off]
+        off += 1
+        z |= (b & 0x7F) << shift
+        if not b & 0x80:
+            break
+        shift += 7
+    return _unzigzag(z), off
+
+
+def encode(obj: Any) -> bytes:
+    if not _registry:
+        _register_defaults()
+    out = bytearray()
+    _enc(out, obj)
+    return bytes(out)
+
+
+def _enc(out: bytearray, o: Any) -> None:
+    if o is None:
+        out.append(ord("N"))
+    elif o is True:
+        out.append(ord("T"))
+    elif o is False:
+        out.append(ord("F"))
+    elif isinstance(o, enum.IntEnum):
+        rid = _reg_ids.get(type(o))
+        if rid is None:
+            raise WireError(f"unregistered enum {type(o).__name__}")
+        out.append(ord("e"))
+        out += _U32.pack(rid)
+        _write_varint(out, int(o))
+    elif isinstance(o, int):
+        out.append(ord("i"))
+        _write_varint(out, o)
+    elif isinstance(o, float):
+        out.append(ord("d"))
+        out += _F64.pack(o)
+    elif isinstance(o, str):
+        raw = o.encode("utf-8")
+        out.append(ord("s"))
+        out += _U32.pack(len(raw))
+        out += raw
+    elif isinstance(o, (bytes, bytearray, memoryview)):
+        raw = bytes(o)
+        out.append(ord("b"))
+        out += _U32.pack(len(raw))
+        out += raw
+    elif isinstance(o, (list, set)):
+        out.append(ord("l"))
+        out += _U32.pack(len(o))
+        for x in o:
+            _enc(out, x)
+    elif isinstance(o, tuple):
+        out.append(ord("t"))
+        out += _U32.pack(len(o))
+        for x in o:
+            _enc(out, x)
+    elif isinstance(o, dict):
+        out.append(ord("m"))
+        out += _U32.pack(len(o))
+        for k, v in o.items():
+            _enc(out, k)
+            _enc(out, v)
+    elif dataclasses.is_dataclass(o) and not isinstance(o, type):
+        rid = _reg_ids.get(type(o))
+        if rid is None:
+            raise WireError(f"unregistered dataclass {type(o).__name__}")
+        out.append(ord("c"))
+        out += _U32.pack(rid)
+        for f in dataclasses.fields(o):
+            _enc(out, getattr(o, f.name))
+    elif type(o).__name__ in ("Status", "StatusOr"):
+        # Status/StatusOr are plain classes, not dataclasses
+        rid = _reg_ids.get(type(o))
+        if rid is None:
+            raise WireError(f"unregistered {type(o).__name__}")
+        out.append(ord("c"))
+        out += _U32.pack(rid)
+        if type(o).__name__ == "Status":
+            _enc(out, o.code)
+            _enc(out, o.msg)
+        else:
+            _enc(out, o.status)
+            _enc(out, o._value)
+    else:
+        raise WireError(f"cannot encode {type(o).__name__}")
+
+
+def decode(raw: bytes) -> Any:
+    if not _registry:
+        _register_defaults()
+    v, off = _dec(raw, 0)
+    if off != len(raw):
+        raise WireError(f"trailing {len(raw)-off} bytes")
+    return v
+
+
+def _dec(buf: bytes, off: int) -> Tuple[Any, int]:
+    tag = buf[off]
+    off += 1
+    if tag == ord("N"):
+        return None, off
+    if tag == ord("T"):
+        return True, off
+    if tag == ord("F"):
+        return False, off
+    if tag == ord("i"):
+        return _read_varint(buf, off)
+    if tag == ord("d"):
+        return _F64.unpack_from(buf, off)[0], off + 8
+    if tag == ord("s"):
+        (n,) = _U32.unpack_from(buf, off)
+        off += 4
+        return buf[off:off + n].decode("utf-8"), off + n
+    if tag == ord("b"):
+        (n,) = _U32.unpack_from(buf, off)
+        off += 4
+        return buf[off:off + n], off + n
+    if tag in (ord("l"), ord("t")):
+        (n,) = _U32.unpack_from(buf, off)
+        off += 4
+        items = []
+        for _ in range(n):
+            v, off = _dec(buf, off)
+            items.append(v)
+        return (tuple(items) if tag == ord("t") else items), off
+    if tag == ord("m"):
+        (n,) = _U32.unpack_from(buf, off)
+        off += 4
+        d = {}
+        for _ in range(n):
+            k, off = _dec(buf, off)
+            v, off = _dec(buf, off)
+            d[k] = v
+        return d, off
+    if tag == ord("e"):
+        (rid,) = _U32.unpack_from(buf, off)
+        off += 4
+        v, off = _read_varint(buf, off)
+        return _registry[rid](v), off
+    if tag == ord("c"):
+        (rid,) = _U32.unpack_from(buf, off)
+        off += 4
+        cls = _registry[rid]
+        if cls.__name__ == "Status":
+            code, off = _dec(buf, off)
+            msg, off = _dec(buf, off)
+            from ..common.status import Status
+            return Status(code, msg), off
+        if cls.__name__ == "StatusOr":
+            status, off = _dec(buf, off)
+            value, off = _dec(buf, off)
+            from ..common.status import StatusOr
+            return StatusOr(status, value), off
+        vals = []
+        for _ in dataclasses.fields(cls):
+            v, off = _dec(buf, off)
+            vals.append(v)
+        return cls(*vals), off
+    raise WireError(f"bad tag {tag!r} at {off-1}")

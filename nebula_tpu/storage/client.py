@@ -29,11 +29,15 @@ class StorageClient:
     def __init__(self, sm: SchemaManager,
                  hosts: Optional[Dict[str, Any]] = None,
                  part_to_host: Optional[Callable[[int, int], str]] = None,
-                 local_service=None):
+                 local_service=None,
+                 refresh_hosts: Optional[Callable[[], None]] = None):
         """hosts: host -> service (in-proc handler or RPC proxy).
         part_to_host: (space_id, part_id) -> host name (leader lookup).
-        local_service: shorthand for single-node deployments."""
+        local_service: shorthand for single-node deployments.
+        refresh_hosts: called before admin fan-outs so hosts that joined
+        after boot are included (re-populates the hosts mapping)."""
         self.sm = sm
+        self._refresh_hosts = refresh_hosts
         if local_service is not None:
             self._hosts = {"local": local_service}
             self._part_to_host = lambda s, p: "local"
@@ -277,7 +281,9 @@ class StorageClient:
     # ingest/checkpoint to all storaged over HTTP)
     # ------------------------------------------------------------------
     def _all_hosts_ok(self, call) -> Status:
-        for host, svc in self._hosts.items():
+        if self._refresh_hosts is not None:
+            self._refresh_hosts()  # include hosts that joined after boot
+        for host, svc in list(self._hosts.items()):
             st = call(svc)
             if not st.ok():
                 return Status.error(st.code, f"{host}: {st.msg}")
@@ -287,8 +293,10 @@ class StorageClient:
         return self._all_hosts_ok(lambda s: s.download(space_id, url))
 
     def ingest(self, space_id: int) -> Tuple[Status, int]:
+        if self._refresh_hosts is not None:
+            self._refresh_hosts()
         total = 0
-        for host, svc in self._hosts.items():
+        for host, svc in list(self._hosts.items()):
             st, n = svc.ingest(space_id)
             if not st.ok():
                 return Status.error(st.code, f"{host}: {st.msg}"), total

@@ -536,8 +536,7 @@ class StorageService:
         StorageHttpDownloadHandler pulls per-part SSTs from HDFS)."""
         from ..common.hdfs import HdfsHelper
         if not self.store.parts(space_id):
-            return Status.error(ErrorCode.E_SPACE_NOT_FOUND,
-                                f"space {space_id} has no local parts")
+            return Status.OK()  # no local parts — nothing to stage here
         return HdfsHelper().copy_to_local(url, self._staging_dir(space_id))
 
     def ingest(self, space_id: int) -> Tuple[Status, int]:
