@@ -125,6 +125,20 @@ _reg("left", 2)(lambda v, n: _s(v, "left")[:max(0, int(n))])
 _reg("right", 2)(lambda v, n: _s(v, "right")[len(_s(v, "right")) - max(0, int(n)):] if int(n) > 0 else "")
 _reg("substr", 3)(lambda v, p, n: _s(v, "substr")[max(0, int(p)):max(0, int(p)) + max(0, int(n))])
 
+
+def _pad(v, size, pad, left, name):
+    v, pad, size = _s(v, name), _s(pad, name), max(0, int(size))
+    if size <= len(v):
+        return v[:size]
+    if not pad:
+        return v
+    fill = (pad * ((size - len(v)) // len(pad) + 1))[: size - len(v)]
+    return fill + v if left else v + fill
+
+
+_reg("lpad", 3)(lambda v, n, p: _pad(v, n, p, True, "lpad"))
+_reg("rpad", 3)(lambda v, n, p: _pad(v, n, p, False, "rpad"))
+
 # --- misc ------------------------------------------------------------------
 _reg("hash", 1)(lambda v: _fnv1a64(
     v.encode("utf-8") if isinstance(v, str)

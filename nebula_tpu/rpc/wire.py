@@ -51,7 +51,7 @@ def _register_defaults() -> None:
              st.PartResult, st.EdgeData, st.VertexData, st.BoundRequest,
              st.BoundResponse, st.PropsResponse, st.ExecResponse,
              st.NewVertex, st.NewEdge, st.EdgeKey, st.UpdateItemReq,
-             st.UpdateResponse)
+             st.UpdateResponse, st.StatDef, st.StatsResponse)
 
 
 def _zigzag(n: int) -> int:

@@ -1,4 +1,5 @@
 from .types import (VertexData, EdgeData, NewVertex, NewEdge, EdgeKey,  # noqa: F401
-                    BoundRequest, BoundResponse, PartResult, UpdateItemReq)
+                    BoundRequest, BoundResponse, PartResult, StatDef,
+                    StatsResponse, UpdateItemReq)
 from .processors import StorageService  # noqa: F401
 from .client import StorageClient  # noqa: F401

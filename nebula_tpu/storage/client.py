@@ -22,7 +22,8 @@ from ..common.status import ErrorCode, Status, StatusOr
 from ..meta.schema_manager import SchemaManager
 from .types import (BoundRequest, BoundResponse, EdgeData, EdgeKey,
                     ExecResponse, NewEdge, NewVertex, PartResult,
-                    PropsResponse, UpdateItemReq, UpdateResponse, VertexData)
+                    PropsResponse, StatDef, StatsResponse, UpdateItemReq,
+                    UpdateResponse, VertexData)
 
 
 class StorageClient:
@@ -141,6 +142,33 @@ class StorageClient:
             acc.latency_us = max(acc.latency_us, part_resp.latency_us)
 
         return self._fanout(space_id, parts, call, BoundResponse(), merge)
+
+    def bound_stats(self, space_id: int, vids: List[int],
+                    edge_types: List[int], stat_defs: List[StatDef],
+                    filter_bytes: Optional[bytes] = None,
+                    max_edges_per_vertex: Optional[int] = None) -> StatsResponse:
+        """Aggregate pushdown: SUM/COUNT/AVG computed storage-side, partial
+        (sum, count) pairs merged here (ref: QueryStatsProcessor +
+        boundStats RPC, storage.thrift:65-69)."""
+        parts = self.cluster_ids_to_parts(space_id, vids)
+
+        def call(svc, host_parts):
+            return svc.bound_stats(BoundRequest(
+                space_id=space_id, parts=host_parts, edge_types=edge_types,
+                filter=filter_bytes,
+                max_edges_per_vertex=max_edges_per_vertex), stat_defs)
+
+        def merge(acc: StatsResponse, r: StatsResponse):
+            acc.results.update(r.results)
+            if len(acc.sums) < len(r.sums):
+                acc.sums += [0.0] * (len(r.sums) - len(acc.sums))
+                acc.counts += [0] * (len(r.counts) - len(acc.counts))
+            for i in range(len(r.sums)):
+                acc.sums[i] += r.sums[i]
+                acc.counts[i] += r.counts[i]
+            acc.latency_us = max(acc.latency_us, r.latency_us)
+
+        return self._fanout(space_id, parts, call, StatsResponse(), merge)
 
     def get_vertex_props(self, space_id: int, vids: List[int],
                          tag_ids: Optional[List[int]] = None) -> PropsResponse:

@@ -46,7 +46,8 @@ from ..meta.schema_manager import SchemaManager
 from ..common.stats import stats
 from .types import (BoundRequest, BoundResponse, EdgeData, EdgeKey,
                     ExecResponse, NewEdge, NewVertex, PartResult,
-                    PropsResponse, UpdateItemReq, UpdateResponse, VertexData)
+                    PropsResponse, StatDef, StatsResponse, UpdateItemReq,
+                    UpdateResponse, VertexData)
 
 DEFAULT_MAX_EDGES_PER_VERTEX = 10000  # FLAGS_max_edge_returned_per_vertex
 
@@ -58,6 +59,20 @@ def is_pushable(expr: Expression) -> bool:
         if isinstance(node, (InputPropExpr, VariablePropExpr, DestPropExpr)):
             return False
     return True
+
+
+def _filter_tag_ids(sm: SchemaManager, space: int, flt) -> set:
+    """Tag ids referenced by $^ props in a pushed-down filter (loop-
+    invariant: computed once per request, not per vertex)."""
+    from ..filter.expressions import SourcePropExpr
+    out = set()
+    if flt is not None:
+        for node in flt.walk():
+            if isinstance(node, SourcePropExpr):
+                tid = sm.tag_id(space, node.tag)
+                if tid is not None:
+                    out.add(tid)
+    return out
 
 
 class _StorageExprContext(ExpressionContext):
@@ -154,6 +169,8 @@ class StorageService:
         edge_types = req.edge_types or self.sm.all_edge_types(space)
         max_edges = req.max_edges_per_vertex or self.max_edges_per_vertex
         ctx = _StorageExprContext(self.sm, space)
+        # tags used in the filter must be loaded too
+        filter_tags = _filter_tag_ids(self.sm, space, flt)
 
         for part, vids in req.parts.items():
             pr = self.store.part(space, part)
@@ -164,15 +181,7 @@ class StorageService:
             for vid in vids:
                 vd = VertexData(vid)
                 # source-vertex props for $^ refs and YIELD
-                want_tags = set(req.vertex_props)
-                if flt is not None:
-                    # tags used in the filter must be loaded too
-                    for node in flt.walk():
-                        from ..filter.expressions import SourcePropExpr
-                        if isinstance(node, SourcePropExpr):
-                            tid = self.sm.tag_id(space, node.tag)
-                            if tid is not None:
-                                want_tags.add(tid)
+                want_tags = set(req.vertex_props) | filter_tags
                 for tag_id in want_tags:
                     row = self._newest_tag_row(engine, space, part, vid, tag_id)
                     if row is not None:
@@ -234,6 +243,90 @@ class StorageService:
                 props = {p: props.get(p) for p in req.edge_props if p in props}
             vd.edges.append(EdgeData(vid, et, rank, dst, props))
             count += 1
+
+    # ------------------------------------------------------------------
+    # bound_stats — aggregate pushdown (ref: QueryStatsProcessor,
+    # storage.thrift StatType SUM/COUNT/AVG :65-69)
+    # ------------------------------------------------------------------
+    def bound_stats(self, req: BoundRequest,
+                    stat_defs: List[StatDef]) -> StatsResponse:
+        """Same scan as get_bound but emits partial aggregates instead of
+        rows: per StatDef a (sum, count) pair the client merges across
+        partitions — SUM/COUNT/AVG without shipping edges to graphd.
+
+        The pushed-down filter applies to EDGE rows only, exactly as in
+        the reference (exp_ is evaluated in collectEdgeProps,
+        QueryBaseProcessor.inl:415-449; collectVertexProps has no filter
+        hook) — tag-owner stats aggregate over every requested vertex."""
+        t0 = time.monotonic()
+        stats.add_value("storage.bound_stats_qps")
+        resp = StatsResponse(sums=[0.0] * len(stat_defs),
+                             counts=[0] * len(stat_defs))
+        space = req.space_id
+        flt = None
+        if req.filter:
+            flt = decode_expression(req.filter)
+            if not is_pushable(flt):
+                for part in req.parts:
+                    resp.results[part] = PartResult(ErrorCode.E_INVALID_FILTER)
+                return resp
+        edge_types = req.edge_types or self.sm.all_edge_types(space)
+        max_edges = req.max_edges_per_vertex or self.max_edges_per_vertex
+        ctx = _StorageExprContext(self.sm, space)
+        filter_tags = _filter_tag_ids(self.sm, space, flt)
+        tag_defs = [(i, d) for i, d in enumerate(stat_defs) if d.owner == "tag"]
+        edge_defs = [(i, d) for i, d in enumerate(stat_defs) if d.owner == "edge"]
+
+        def _acc(idx: int, row: Dict[str, Any], d: StatDef) -> None:
+            if d.stat == 2:  # COUNT: rows ("" prop) or non-null prop values
+                if not d.prop or row.get(d.prop) is not None:
+                    resp.counts[idx] += 1
+                return
+            v = row.get(d.prop)
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                return  # non-numeric / missing: not aggregated
+            resp.sums[idx] += v
+            resp.counts[idx] += 1
+
+        for part, vids in req.parts.items():
+            pr = self.store.part(space, part)
+            if not pr.ok():
+                resp.results[part] = PartResult(pr.status.code,
+                                                pr.status.msg or None)
+                continue
+            engine = pr.value().engine
+            for vid in vids:
+                # tag-owner stats + $^ bindings for the filter
+                src_props: Dict[str, Dict[str, Any]] = {}
+                want: Dict[int, Optional[Dict[str, Any]]] = {}
+                for tid in filter_tags:
+                    want[tid] = self._newest_tag_row(engine, space, part,
+                                                     vid, tid)
+                for idx, d in tag_defs:
+                    if d.schema_id not in want:
+                        want[d.schema_id] = self._newest_tag_row(
+                            engine, space, part, vid, d.schema_id)
+                    row = want[d.schema_id]
+                    if row is not None:
+                        _acc(idx, row, d)
+                for tid, row in want.items():
+                    if row is not None:
+                        src_props[self.sm.tag_name(space, tid) or str(tid)] = row
+                ctx.src_props = src_props
+                if not edge_defs:
+                    continue
+                for etype in edge_types:
+                    vd = VertexData(vid)
+                    self._collect_edge_props(engine, space, part, vid, etype,
+                                             req, ctx, flt, max_edges, vd)
+                    for ed in vd.edges:
+                        for idx, d in edge_defs:
+                            if d.schema_id and d.schema_id != ed.etype:
+                                continue
+                            _acc(idx, ed.props, d)
+            resp.results[part] = PartResult(ErrorCode.SUCCEEDED)
+        resp.latency_us = int((time.monotonic() - t0) * 1e6)
+        return resp
 
     # ------------------------------------------------------------------
     # point lookups

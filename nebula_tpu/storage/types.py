@@ -103,6 +103,39 @@ class PropsResponse:
 
 
 @dataclass
+class StatDef:
+    """One requested aggregate (ref: storage.thrift PropDef.stat +
+    StatType:65-69 — SUM=1 COUNT=2 AVG=3)."""
+    owner: str          # "tag" | "edge"
+    schema_id: int      # tag id or signed edge type
+    prop: str           # property name ("" legal for COUNT)
+    stat: int           # 1=SUM 2=COUNT 3=AVG
+
+
+@dataclass
+class StatsResponse:
+    """Partial aggregates, mergeable across partitions/hosts (ref:
+    QueryStatsProcessor::calcResult). sums/counts are parallel to the
+    request's StatDef list; AVG is finalized client-side as sum/count."""
+    results: Dict[int, PartResult] = field(default_factory=dict)
+    sums: List[float] = field(default_factory=list)
+    counts: List[int] = field(default_factory=list)
+    latency_us: int = 0
+
+    def finalize(self, defs: List["StatDef"]) -> List[Any]:
+        out: List[Any] = []
+        for i, d in enumerate(defs):
+            if d.stat == 2:      # COUNT
+                out.append(self.counts[i])
+            elif d.stat == 3:    # AVG
+                out.append(self.sums[i] / self.counts[i]
+                           if self.counts[i] else None)
+            else:                # SUM
+                out.append(self.sums[i])
+        return out
+
+
+@dataclass
 class UpdateItemReq:
     prop: str               # field name (optionally tag.prop for vertices)
     value: bytes            # encoded Expression evaluated at the storage side

@@ -154,3 +154,12 @@ def test_hash_is_stable_int64():
     h2 = FunctionManager.invoke("hash", ["hello"])
     assert h1 == h2
     assert -(1 << 63) <= h1 < (1 << 63)
+
+
+def test_pad_functions():
+    # ref: FunctionManager.cpp lpad/rpad — pad to size, truncate if shorter
+    assert FunctionManager.invoke("lpad", ["abc", 6, "xy"]) == "xyxabc"
+    assert FunctionManager.invoke("rpad", ["abc", 6, "xy"]) == "abcxyx"
+    assert FunctionManager.invoke("lpad", ["abcdef", 3, "x"]) == "abc"
+    assert FunctionManager.invoke("rpad", ["abcdef", 3, "x"]) == "abc"
+    assert FunctionManager.invoke("lpad", ["abc", 3, "x"]) == "abc"

@@ -242,3 +242,48 @@ def test_ttl_expired_rows_invisible(cluster):
     resp = client.get_vertex_props(1, [201, 202], tag_ids=[9])
     vids = [v.vid for v in resp.vertices]
     assert vids == [201]
+
+
+def test_bound_stats_pushdown(cluster):
+    """SUM/COUNT/AVG aggregate pushdown (parity: QueryStatsProcessor,
+    storage.thrift StatType:65-69)."""
+    from nebula_tpu.storage import StatDef
+    sm, store, svc, client, player, like, serve = cluster
+    insert_sample(client, player, like, serve)
+    defs = [StatDef("edge", LIKE_EDGE, "likeness", 1),   # SUM
+            StatDef("edge", LIKE_EDGE, "", 2),           # COUNT(*)
+            StatDef("edge", LIKE_EDGE, "likeness", 3),   # AVG
+            StatDef("tag", PLAYER_TAG, "age", 1)]        # SUM of src ages
+    vids = [100, 101, 102, 103]
+    resp = client.bound_stats(1, vids, [LIKE_EDGE], defs)
+    assert all(r.code == ErrorCode.SUCCEEDED for r in resp.results.values())
+    total, cnt, avg, ages = resp.finalize(defs)
+    # 5 like edges: 95+95+95+90+75 = 450
+    assert cnt == 5
+    assert total == pytest.approx(450.0)
+    assert avg == pytest.approx(90.0)
+    assert ages == 42 + 36 + 41 + 33
+
+
+def test_bound_stats_with_filter(cluster):
+    from nebula_tpu.storage import StatDef
+    sm, store, svc, client, player, like, serve = cluster
+    insert_sample(client, player, like, serve)
+    flt = encode_expression(parse_expr("like.likeness >= 95"))
+    defs = [StatDef("edge", LIKE_EDGE, "", 2)]
+    resp = client.bound_stats(1, [100, 101, 102, 103], [LIKE_EDGE], defs,
+                              filter_bytes=flt)
+    assert resp.finalize(defs) == [3]
+
+
+def test_bound_stats_count_string_prop_and_pad_clamp(cluster):
+    """COUNT of a non-numeric prop counts non-null values (review fix)."""
+    from nebula_tpu.storage import StatDef
+    from nebula_tpu.filter.functions import FunctionManager
+    sm, store, svc, client, player, like, serve = cluster
+    insert_sample(client, player, like, serve)
+    defs = [StatDef("tag", PLAYER_TAG, "name", 2)]  # COUNT of string prop
+    resp = client.bound_stats(1, [100, 101, 102, 103], [LIKE_EDGE], defs)
+    assert resp.finalize(defs) == [4]
+    assert FunctionManager.invoke("lpad", ["abc", -1, "x"]) == ""
+    assert FunctionManager.invoke("rpad", ["abc", -5, "x"]) == ""
