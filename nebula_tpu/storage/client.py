@@ -308,6 +308,51 @@ class StorageClient:
     # admin fan-out to every storage host (ref: meta dispatches download/
     # ingest/checkpoint to all storaged over HTTP)
     # ------------------------------------------------------------------
+    # ------------------------------------------------------------------
+    # generic KV (ref: PutProcessor/GetProcessor via storage.thrift
+    # put/get — used by SimpleKVVerifyTool)
+    # ------------------------------------------------------------------
+    def _kv_part(self, space_id: int, key: bytes) -> int:
+        from ..filter.functions import _fnv1a64
+        return ku.part_id(_fnv1a64(key), self.sm.num_parts(space_id))
+
+    def _kv_retry(self, space_id: int, part: int, call,
+                  is_stale_leader, max_retries: int = 3):
+        """Leader-redirect retry for single-part KV ops (same fixups as
+        _fanout: note the hinted leader, re-dispatch)."""
+        result = None
+        for _ in range(max_retries + 1):
+            result = call(self._hosts[self._leader(space_id, part)])
+            leader_hint = is_stale_leader(result)
+            if leader_hint is None:
+                return result
+            if leader_hint:
+                self._note_leader(space_id, part, leader_hint)
+            else:
+                time.sleep(0.05)  # election in progress
+        return result
+
+    def kv_put(self, space_id: int, kvs: List[Tuple[bytes, bytes]]) -> Status:
+        by_part: Dict[int, List[Tuple[bytes, bytes]]] = {}
+        for k, v in kvs:
+            by_part.setdefault(self._kv_part(space_id, k), []).append((k, v))
+        for part, part_kvs in by_part.items():
+            st = self._kv_retry(
+                space_id, part,
+                lambda svc, pk=part_kvs: svc.kv_put(space_id, part, pk),
+                lambda s: (s.msg or "") if s.code == ErrorCode.E_LEADER_CHANGED
+                else None)
+            if not st.ok():
+                return st
+        return Status.OK()
+
+    def kv_get(self, space_id: int, key: bytes) -> StatusOr:
+        part = self._kv_part(space_id, key)
+        return self._kv_retry(
+            space_id, part, lambda svc: svc.kv_get(space_id, part, key),
+            lambda r: (r.status.msg or "")
+            if r.status.code == ErrorCode.E_LEADER_CHANGED else None)
+
     def _all_hosts_ok(self, call) -> Status:
         if self._refresh_hosts is not None:
             self._refresh_hosts()  # include hosts that joined after boot

@@ -193,7 +193,6 @@ class StorageService:
                 ctx.src_props = {
                     (self.sm.tag_name(space, tid) or str(tid)): props
                     for tid, props in vd.tag_props.items()}
-                # also load filter-referenced tags not in the request output
                 for etype in edge_types:
                     self._collect_edge_props(engine, space, part, vid, etype,
                                              req, ctx, flt, max_edges, vd)

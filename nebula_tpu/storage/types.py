@@ -125,13 +125,15 @@ class StatsResponse:
     def finalize(self, defs: List["StatDef"]) -> List[Any]:
         out: List[Any] = []
         for i, d in enumerate(defs):
+            # an empty fan-out (no vids) returns no partials at all
+            s = self.sums[i] if i < len(self.sums) else 0.0
+            c = self.counts[i] if i < len(self.counts) else 0
             if d.stat == 2:      # COUNT
-                out.append(self.counts[i])
+                out.append(c)
             elif d.stat == 3:    # AVG
-                out.append(self.sums[i] / self.counts[i]
-                           if self.counts[i] else None)
+                out.append(s / c if c else None)
             else:                # SUM
-                out.append(self.sums[i])
+                out.append(s)
         return out
 
 

@@ -1,0 +1,14 @@
+"""Operational tools (role parity with the reference's src/tools/):
+
+  storage_perf     <- StoragePerfTool (QPS/latency driver)
+  integrity_check  <- StorageIntegrityTool (big-linked-list invariant)
+  kv_verify        <- SimpleKVVerifyTool (generic KV put/get roundtrip)
+  importer         <- tools/importer (CSV -> INSERT statements)
+  sst_generator    <- spark-sstfile-generator (offline CSV -> SST files
+                      for the DOWNLOAD/INGEST bulk-load path)
+
+Each module exposes a pure function driving client objects (testable
+in-process) plus a CLI `main()` that builds networked clients from
+--meta / --graph addresses, mirroring how the reference tools take
+--meta_server_addrs.
+"""

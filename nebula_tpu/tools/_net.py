@@ -1,0 +1,35 @@
+"""Shared CLI plumbing: build a networked StorageClient from a metad
+address, the same way graphd does (ref: the tools' MetaClient +
+StorageClient bootstrap, tools/storage-perf/StoragePerfTool.cpp)."""
+from __future__ import annotations
+
+from typing import Tuple
+
+from ..meta.client import MetaClient
+from ..meta.schema_manager import SchemaManager
+from ..rpc import proxy
+from ..storage.client import StorageClient
+
+
+class _StorageHostMap(dict):
+    def __missing__(self, addr: str):
+        p = proxy(addr, "storage")
+        self[addr] = p
+        return p
+
+
+def storage_client_from_meta(meta_addr: str) -> Tuple[MetaClient, SchemaManager,
+                                                      StorageClient]:
+    mc = MetaClient(meta_addr, role="tool")
+    mc.start(heartbeat=False)
+    sm = SchemaManager(mc)
+    hosts = _StorageHostMap()
+
+    def refresh_hosts():
+        for h in mc.storage_hosts():
+            hosts[h]
+
+    refresh_hosts()
+    client = StorageClient(sm, hosts=hosts, part_to_host=mc.part_host,
+                           refresh_hosts=refresh_hosts)
+    return mc, sm, client

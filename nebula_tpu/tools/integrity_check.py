@@ -1,0 +1,103 @@
+"""Storage integrity check (role parity: tools/storage-perf/
+StorageIntegrityTool.cpp — HBase "IntegrationTestBigLinkedList" style).
+
+Writes width*height vertices forming one big circle where each vertex's
+single int property points at the next vertex, then traverses from the
+first vertex and verifies the walk returns home in exactly width*height
+steps — any lost or corrupted write breaks the circle."""
+from __future__ import annotations
+
+import argparse
+from typing import Any, Dict
+
+from ..codec.row import RowWriter
+from ..storage.types import NewVertex
+
+
+def prepare_data(client, sm, space_id: int, tag_id: int, prop: str,
+                 width: int, height: int, first_vid: int = 1,
+                 batch: int = 512) -> None:
+    """Insert the circle: vid i -> i+1, last -> first (ref:
+    StorageIntegrityTool prepareData's matrix walk)."""
+    schema = sm.tag_schema(space_id, tag_id).value()
+    n = width * height
+    pending = []
+    for i in range(n):
+        vid = first_vid + i
+        nxt = first_vid + ((i + 1) % n)
+        row = RowWriter(schema).set(prop, nxt).encode()
+        pending.append(NewVertex(vid, [(tag_id, row)]))
+        if len(pending) >= batch:
+            if not client.add_vertices(space_id, pending).ok():
+                raise RuntimeError(f"insert failed near vid {vid}")
+            pending = []
+    if pending and not client.add_vertices(space_id, pending).ok():
+        raise RuntimeError("final insert batch failed")
+
+
+def validate(client, sm, space_id: int, tag_id: int, prop: str,
+             start_vid: int, expected_steps: int,
+             batch: int = 1024) -> Dict[str, Any]:
+    """Walk the circle from start_vid; OK iff we return to start in
+    exactly expected_steps hops. Hops are chased in batches: the prop of
+    each fetched vertex seeds the next lookup (pointer chasing, but one
+    RPC per batch of consecutive hops is impossible — the chain is
+    sequential — so we fetch one vertex per hop like the reference)."""
+    cur = start_vid
+    steps = 0
+    while steps < expected_steps:
+        resp = client.get_vertex_props(space_id, [cur], [tag_id])
+        nxt = None
+        for vd in resp.vertices:
+            if vd.vid == cur and tag_id in vd.tag_props:
+                nxt = vd.tag_props[tag_id].get(prop)
+        if nxt is None:
+            return {"ok": False, "steps": steps, "broken_at": cur,
+                    "reason": "missing vertex or property"}
+        cur = nxt
+        steps += 1
+        if cur == start_vid:
+            break
+    ok = (cur == start_vid and steps == expected_steps)
+    return {"ok": ok, "steps": steps,
+            "reason": None if ok else
+            f"walk closed after {steps} steps, expected {expected_steps}"}
+
+
+def run_integrity(client, sm, space_id: int, tag_id: int, prop: str,
+                  width: int, height: int, first_vid: int = 1) -> Dict[str, Any]:
+    prepare_data(client, sm, space_id, tag_id, prop, width, height, first_vid)
+    return validate(client, sm, space_id, tag_id, prop, first_vid,
+                    width * height)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="storage integrity tool")
+    ap.add_argument("--meta", required=True, help="metad host:port")
+    ap.add_argument("--space", required=True)
+    ap.add_argument("--tag", default="test_tag")
+    ap.add_argument("--prop", default="test_prop")
+    ap.add_argument("--width", type=int, default=100)
+    ap.add_argument("--height", type=int, default=100)
+    ap.add_argument("--first-vid", type=int, default=1)
+    args = ap.parse_args(argv)
+
+    from ._net import storage_client_from_meta
+    mc, sm, client = storage_client_from_meta(args.meta)
+    try:
+        space_id = mc.get_space(args.space).value().space_id
+        tag_id = sm.tag_id(space_id, args.tag)
+        if tag_id is None:
+            print(f"tag {args.tag!r} not found")
+            return 1
+        out = run_integrity(client, sm, space_id, tag_id, args.prop,
+                            args.width, args.height, args.first_vid)
+        import json
+        print(json.dumps(out))
+        return 0 if out["ok"] else 1
+    finally:
+        mc.stop()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,152 @@
+"""Storage perf driver (role parity: tools/storage-perf/StoragePerfTool
+.cpp — flags threads/qps/totalReqs/method/min,max_vertex_id/size).
+
+Measures sustained QPS and latency percentiles of one storage RPC kind
+against a live cluster (or an in-proc client in tests)."""
+from __future__ import annotations
+
+import argparse
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List
+
+from ..codec.row import RowWriter
+from ..storage.types import NewEdge, NewVertex
+
+
+def _percentile(sorted_us: List[float], p: float) -> float:
+    if not sorted_us:
+        return 0.0
+    idx = min(len(sorted_us) - 1, int(p / 100.0 * len(sorted_us)))
+    return sorted_us[idx]
+
+
+def run_perf(client, sm, space_id: int, tag_id: int, etype: int,
+             method: str = "getNeighbors", total_reqs: int = 1000,
+             concurrency: int = 2, size: int = 16,
+             min_vid: int = 1, max_vid: int = 10000,
+             seed: int = 0) -> Dict[str, Any]:
+    """Fire `total_reqs` requests of `method` from `concurrency` threads;
+    returns {qps, total_reqs, errors, latency_us: {p50, p95, p99, avg}}."""
+    tag_schema = sm.tag_schema(space_id, tag_id).value()
+    edge_schema = sm.edge_schema(space_id, etype).value()
+    rng = random.Random(seed)
+
+    def vrow(i: int) -> bytes:
+        w = RowWriter(tag_schema)
+        for f in tag_schema.fields:
+            w.set(f.name, i if f.type.name == "INT" else f"v{i}"
+                  if f.type.name == "STRING" else float(i))
+        return w.encode()
+
+    def erow(i: int) -> bytes:
+        w = RowWriter(edge_schema)
+        for f in edge_schema.fields:
+            w.set(f.name, i if f.type.name == "INT" else f"e{i}"
+                  if f.type.name == "STRING" else float(i))
+        return w.encode()
+
+    def vid() -> int:
+        return rng.randint(min_vid, max_vid)
+
+    calls: Dict[str, Callable[[], Any]] = {
+        "getNeighbors": lambda: client.get_neighbors(
+            space_id, [vid() for _ in range(size)], [etype]),
+        "getVertices": lambda: client.get_vertex_props(
+            space_id, [vid() for _ in range(size)], [tag_id]),
+        "addVertices": lambda: client.add_vertices(
+            space_id, [NewVertex(vid(), [(tag_id, vrow(i))])
+                       for i in range(size)]),
+        "addEdges": lambda: client.add_edges(
+            space_id, [NewEdge(vid(), etype, 0, vid(), erow(i))
+                       for i in range(size)]),
+    }
+    if method not in calls:
+        raise ValueError(f"unknown method {method!r}; one of {sorted(calls)}")
+    call = calls[method]
+
+    lock = threading.Lock()
+    latencies: List[float] = []
+    errors = [0]
+    remaining = [total_reqs]
+
+    def worker():
+        while True:
+            with lock:
+                if remaining[0] <= 0:
+                    return
+                remaining[0] -= 1
+            t0 = time.monotonic()
+            try:
+                resp = call()
+                ok = resp.ok() if hasattr(resp, "ok") else all(
+                    r.code.value == 0 for r in resp.results.values())
+            except Exception:
+                ok = False
+            us = (time.monotonic() - t0) * 1e6
+            with lock:
+                latencies.append(us)
+                if not ok:
+                    errors[0] += 1
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=worker) for _ in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    latencies.sort()
+    return {
+        "method": method,
+        "total_reqs": total_reqs,
+        "errors": errors[0],
+        "wall_s": round(wall, 3),
+        "qps": round(total_reqs / wall, 1) if wall > 0 else 0.0,
+        "latency_us": {
+            "avg": round(sum(latencies) / len(latencies), 1) if latencies else 0,
+            "p50": round(_percentile(latencies, 50), 1),
+            "p95": round(_percentile(latencies, 95), 1),
+            "p99": round(_percentile(latencies, 99), 1),
+        },
+    }
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="storage perf tool")
+    ap.add_argument("--meta", required=True, help="metad host:port")
+    ap.add_argument("--space", required=True)
+    ap.add_argument("--tag", default="test_tag")
+    ap.add_argument("--edge", default="test_edge")
+    ap.add_argument("--method", default="getNeighbors")
+    ap.add_argument("--total-reqs", type=int, default=10000)
+    ap.add_argument("--threads", type=int, default=2)
+    ap.add_argument("--size", type=int, default=16)
+    ap.add_argument("--min-vid", type=int, default=1)
+    ap.add_argument("--max-vid", type=int, default=10000)
+    args = ap.parse_args(argv)
+
+    from ._net import storage_client_from_meta
+    mc, sm, client = storage_client_from_meta(args.meta)
+    try:
+        space_id = mc.get_space(args.space).value().space_id
+        tag_id = sm.tag_id(space_id, args.tag)
+        etype = sm.edge_type(space_id, args.edge)
+        if tag_id is None or etype is None:
+            print(f"tag {args.tag!r} or edge {args.edge!r} not found in "
+                  f"space {args.space!r}")
+            return 1
+        out = run_perf(client, sm, space_id, tag_id, etype,
+                       method=args.method, total_reqs=args.total_reqs,
+                       concurrency=args.threads, size=args.size,
+                       min_vid=args.min_vid, max_vid=args.max_vid)
+        import json
+        print(json.dumps(out))
+        return 0
+    finally:
+        mc.stop()
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
