@@ -66,6 +66,50 @@ class FlagRegistry:
         return [(f.name, f.value, f.mode) for f in
                 sorted(self._flags.values(), key=lambda f: f.name)]
 
+    # ---------------------------------------------------------- flagfile
+    def load_flagfile(self, path: str) -> int:
+        """Apply `--name=value` lines from a gflags-style flagfile (ref:
+        etc/nebula-*.conf.default + --flagfile). Values are coerced to
+        the declared default's type; undeclared names are declared as
+        string flags. Returns the number of flags applied."""
+        n = 0
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                if line.startswith("--"):
+                    line = line[2:]
+                name, had_eq, raw = line.partition("=")
+                name, raw = name.strip(), raw.strip()
+                if not name:
+                    continue
+                flag = self._flags.get(name)
+                if not had_eq:
+                    # bare `--flag` is boolean true in gflags
+                    if flag is None:
+                        self.declare(name, True)
+                    else:
+                        with self._lock:
+                            flag.value = True
+                    n += 1
+                    continue
+                value: Any = raw
+                if flag is not None and not isinstance(flag.default, str):
+                    if isinstance(flag.default, bool):
+                        value = raw.lower() in ("1", "true", "yes")
+                    elif isinstance(flag.default, int):
+                        value = int(raw)
+                    elif isinstance(flag.default, float):
+                        value = float(raw)
+                elif flag is None:
+                    self.declare(name, raw)
+                with self._lock:
+                    f2 = self._flags[name]
+                    f2.value = value  # flagfiles may set REBOOT/IMMUTABLE
+                n += 1
+        return n
+
     # ---------------------------------------------------------- meta sync
     def sync_to_meta(self, meta) -> None:
         for name, value, mode in self.items():

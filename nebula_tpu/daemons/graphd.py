@@ -64,11 +64,16 @@ def serve_graphd(meta_addr: str, host: str = "127.0.0.1", port: int = 0,
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description="nebula-tpu graph daemon")
     ap.add_argument("--meta", required=True, help="metad host:port")
+    ap.add_argument("--flagfile", default=None,
+                help="gflags-style config file (etc/*.conf)")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=3699)
     ap.add_argument("--tpu", action="store_true",
                     help="enable the TPU graph engine for GO/FIND PATH")
     args = ap.parse_args(argv)
+    if args.flagfile:
+        from ..common.flags import graph_flags
+        graph_flags.load_flagfile(args.flagfile)
     tpu = None
     if args.tpu:
         from ..engine_tpu import TpuGraphEngine

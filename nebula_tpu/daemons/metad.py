@@ -30,9 +30,14 @@ def serve_metad(host: str = "127.0.0.1", port: int = 0) -> MetadHandle:
 
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description="nebula-tpu meta daemon")
+    ap.add_argument("--flagfile", default=None,
+                help="gflags-style config file (etc/*.conf)")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=45500)
     args = ap.parse_args(argv)
+    if args.flagfile:
+        from ..common.flags import meta_flags
+        meta_flags.load_flagfile(args.flagfile)
     h = serve_metad(args.host, args.port)
     print(f"metad listening on {h.addr}")
     try:

@@ -64,9 +64,14 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description="nebula-tpu storage daemon")
     ap.add_argument("--meta", required=True, help="metad host:port")
+    ap.add_argument("--flagfile", default=None,
+                help="gflags-style config file (etc/*.conf)")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=44500)
     args = ap.parse_args(argv)
+    if args.flagfile:
+        from ..common.flags import storage_flags
+        storage_flags.load_flagfile(args.flagfile)
     h = serve_storaged(args.meta, args.host, args.port)
     print(f"storaged listening on {h.addr} (meta {args.meta})")
     try:

@@ -58,6 +58,7 @@ class Kind(enum.Enum):
     DOWNLOAD = "download"
     CREATE_SNAPSHOT = "create_snapshot"
     DROP_SNAPSHOT = "drop_snapshot"
+    MATCH = "match"
 
 
 class Sentence:
@@ -366,6 +367,18 @@ class UseSentence(Sentence):
 
     def to_string(self) -> str:
         return f"USE {self.space}"
+
+
+@dataclass
+class MatchSentence(Sentence):
+    """Grammar-level only, like the reference: MATCH parses but execution
+    reports unsupported (ref: graph/MatchExecutor.cpp 'Match not
+    supported yet', parser Sentence.h kMatch)."""
+    raw: str
+    kind = Kind.MATCH
+
+    def to_string(self) -> str:
+        return self.raw
 
 
 @dataclass

@@ -34,7 +34,7 @@ KEYWORDS = {
     "USER", "USERS", "PASSWORD", "CHANGE", "GRANT", "REVOKE", "ROLE",
     "ROLES", "GOD", "ADMIN", "GUEST", "WITH", "IN",
     "INGEST", "DOWNLOAD", "HDFS", "SUBMIT", "JOB", "JOBS",
-    "SNAPSHOT", "SNAPSHOTS",
+    "SNAPSHOT", "SNAPSHOTS", "MATCH", "RETURN",
 }
 
 # token types

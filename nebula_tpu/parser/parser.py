@@ -140,6 +140,15 @@ class GQLParser:
             return self._go()
         if tt == "FIND":
             return self._find_path()
+        if tt == "MATCH":
+            # grammar-level stub (ref: MATCH parses, executor says
+            # "not supported yet") — swallow tokens to the stmt boundary
+            toks = []
+            while self._peek().type not in (";", "|", "EOF"):
+                t = self._peek()
+                toks.append(str(t.value) if t.value is not None else t.type)
+                self.i += 1
+            return ast.MatchSentence(" ".join(toks))
         if tt == "FETCH":
             return self._fetch()
         if tt == "USE":

@@ -102,6 +102,7 @@ class StorageClient:
             pending = {}
             hosts_list = list(self._hosts)
             saw_hintless = False
+            saw_no_part = False
             for part, result in round_resp.results.items():
                 if result.code == ErrorCode.E_LEADER_CHANGED and part in parts:
                     if result.leader:
@@ -112,9 +113,22 @@ class StorageClient:
                         idx = (hosts_list.index(prev) + 1) % len(hosts_list)
                         self._leader_cache[(space_id, part)] = hosts_list[idx]
                     pending[part] = parts[part]
+                elif result.code in (ErrorCode.E_PART_NOT_FOUND,
+                                     ErrorCode.E_SPACE_NOT_FOUND) \
+                        and part in parts and self._space_exists(space_id):
+                    # freshly created space: the storaged topology watch
+                    # hasn't materialized the part yet (the reference's
+                    # load_data_interval_secs window) — wait and retry;
+                    # a space the catalog doesn't know fails fast
+                    saw_no_part = True
+                    pending[part] = parts[part]
             if not pending:
                 break
-            if saw_hintless:
+            if saw_no_part:
+                if self._refresh_hosts is not None:
+                    self._refresh_hosts()
+                time.sleep(0.2)
+            elif saw_hintless:
                 time.sleep(0.05)   # election likely in progress
         return resp
 
@@ -316,21 +330,51 @@ class StorageClient:
         from ..filter.functions import _fnv1a64
         return ku.part_id(_fnv1a64(key), self.sm.num_parts(space_id))
 
-    def _kv_retry(self, space_id: int, part: int, call,
-                  is_stale_leader, max_retries: int = 3):
-        """Leader-redirect retry for single-part KV ops (same fixups as
-        _fanout: note the hinted leader, re-dispatch)."""
+    def _space_exists(self, space_id: int) -> bool:
+        """Does the catalog still know this space? (distinguishes the
+        fresh-space propagation window from a dropped space)."""
+        get = getattr(self.sm, "_meta", None)
+        get = getattr(get, "get_space_by_id", None)
+        if get is None:
+            return True
+        try:
+            return get(space_id).ok()
+        except Exception:
+            return True
+
+    def _kv_retry(self, space_id: int, part: int, call, classify,
+                  max_retries: int = 3):
+        """Retry loop for single-part KV ops, with the same fixups as
+        _fanout: leader-redirect (note the hinted leader), fresh-space
+        part-not-found (wait for the topology watch). `classify(result)`
+        returns None (done), a leader hint string ("" = hintless), or
+        "no_part"."""
         result = None
         for _ in range(max_retries + 1):
             result = call(self._hosts[self._leader(space_id, part)])
-            leader_hint = is_stale_leader(result)
-            if leader_hint is None:
+            cls = classify(result)
+            if cls is None:
                 return result
-            if leader_hint:
-                self._note_leader(space_id, part, leader_hint)
+            if cls == "no_part":
+                if not self._space_exists(space_id):
+                    return result
+                if self._refresh_hosts is not None:
+                    self._refresh_hosts()
+                time.sleep(0.2)
+            elif cls:
+                self._note_leader(space_id, part, cls)
             else:
                 time.sleep(0.05)  # election in progress
         return result
+
+    @staticmethod
+    def _classify_status(st: Status):
+        if st.code == ErrorCode.E_LEADER_CHANGED:
+            return st.msg or ""
+        if st.code in (ErrorCode.E_PART_NOT_FOUND,
+                       ErrorCode.E_SPACE_NOT_FOUND):
+            return "no_part"
+        return None
 
     def kv_put(self, space_id: int, kvs: List[Tuple[bytes, bytes]]) -> Status:
         by_part: Dict[int, List[Tuple[bytes, bytes]]] = {}
@@ -339,9 +383,8 @@ class StorageClient:
         for part, part_kvs in by_part.items():
             st = self._kv_retry(
                 space_id, part,
-                lambda svc, pk=part_kvs: svc.kv_put(space_id, part, pk),
-                lambda s: (s.msg or "") if s.code == ErrorCode.E_LEADER_CHANGED
-                else None)
+                lambda svc, p=part, pk=part_kvs: svc.kv_put(space_id, p, pk),
+                self._classify_status)
             if not st.ok():
                 return st
         return Status.OK()
@@ -350,8 +393,7 @@ class StorageClient:
         part = self._kv_part(space_id, key)
         return self._kv_retry(
             space_id, part, lambda svc: svc.kv_get(space_id, part, key),
-            lambda r: (r.status.msg or "")
-            if r.status.code == ErrorCode.E_LEADER_CHANGED else None)
+            lambda r: self._classify_status(r.status))
 
     def _all_hosts_ok(self, call) -> Status:
         if self._refresh_hosts is not None:

@@ -101,7 +101,9 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="CSV importer")
     ap.add_argument("--graph", required=True, help="graphd host:port")
     ap.add_argument("--mapping", required=True, help="mapping.json path")
-    ap.add_argument("--base-dir", default=".", help="dir containing CSVs")
+    ap.add_argument("--base-dir", default=None,
+                    help="dir containing CSVs (default: the mapping "
+                         "file's directory)")
     ap.add_argument("--batch", type=int, default=256)
     ap.add_argument("--user", default="root")
     ap.add_argument("--password", default="")
@@ -112,8 +114,7 @@ def main(argv=None) -> int:
     with GraphClient(args.graph).connect(args.user, args.password) as gc:
         with open(args.mapping) as f:
             mapping = json.load(f)
-        base = args.base_dir if args.base_dir != "." else \
-            os.path.dirname(os.path.abspath(args.mapping))
+        base = args.base_dir or os.path.dirname(os.path.abspath(args.mapping))
         counts = import_csv(gc.execute, mapping, base_dir=base,
                             batch=args.batch)
         print(json.dumps(counts))

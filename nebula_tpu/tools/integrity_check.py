@@ -36,13 +36,11 @@ def prepare_data(client, sm, space_id: int, tag_id: int, prop: str,
 
 
 def validate(client, sm, space_id: int, tag_id: int, prop: str,
-             start_vid: int, expected_steps: int,
-             batch: int = 1024) -> Dict[str, Any]:
+             start_vid: int, expected_steps: int) -> Dict[str, Any]:
     """Walk the circle from start_vid; OK iff we return to start in
-    exactly expected_steps hops. Hops are chased in batches: the prop of
-    each fetched vertex seeds the next lookup (pointer chasing, but one
-    RPC per batch of consecutive hops is impossible — the chain is
-    sequential — so we fetch one vertex per hop like the reference)."""
+    exactly expected_steps hops. The chain is sequential pointer
+    chasing, so it is one get_vertex_props RPC per hop, exactly like
+    the reference's traversal loop."""
     cur = start_vid
     steps = 0
     while steps < expected_steps:

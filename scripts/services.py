@@ -1,0 +1,164 @@
+#!/usr/bin/env python3
+"""Local cluster lifecycle manager (role parity: the reference's
+scripts/services.sh + systemd units — start/stop/status/restart the
+three daemons with pidfiles).
+
+    python scripts/services.py start   [--storaged-count 2] [--tpu]
+    python scripts/services.py status
+    python scripts/services.py stop
+    python scripts/services.py restart
+
+Ports: metad 45500, storaged 44500+i, graphd 3699. Pidfiles and logs
+live under --run-dir (default /tmp/nebula_tpu_cluster)."""
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DAEMONS = ("metad", "storaged", "graphd")
+
+
+def _pidfile(run_dir: str, name: str) -> str:
+    return os.path.join(run_dir, f"{name}.pid")
+
+
+def _alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False
+
+
+def _read_pid(run_dir: str, name: str):
+    try:
+        with open(_pidfile(run_dir, name)) as f:
+            return int(f.read().strip())
+    except (OSError, ValueError):
+        return None
+
+
+def _spawn(run_dir: str, name: str, module: str, args) -> int:
+    log = open(os.path.join(run_dir, f"{name}.log"), "a")
+    env = dict(os.environ, PYTHONPATH=REPO + os.pathsep +
+               os.environ.get("PYTHONPATH", ""))
+    p = subprocess.Popen([sys.executable, "-m", module, *args],
+                         stdout=log, stderr=subprocess.STDOUT, env=env,
+                         start_new_session=True)
+    with open(_pidfile(run_dir, name), "w") as f:
+        f.write(str(p.pid))
+    return p.pid
+
+
+def start(args) -> int:
+    os.makedirs(args.run_dir, exist_ok=True)
+    meta_addr = f"{args.host}:{args.meta_port}"
+    etc = os.path.join(REPO, "etc")
+
+    def ff(name):
+        p = os.path.join(etc, f"nebula-{name}.conf.default")
+        return ["--flagfile", p] if os.path.exists(p) else []
+
+    started = []
+    if _read_pid(args.run_dir, "metad") and _alive(_read_pid(args.run_dir, "metad")):
+        print("metad already running")
+    else:
+        pid = _spawn(args.run_dir, "metad", "nebula_tpu.daemons.metad",
+                     ["--host", args.host, "--port", str(args.meta_port),
+                      *ff("metad")])
+        started.append(("metad", pid))
+        time.sleep(0.5)  # metad must accept before storaged registers
+    for i in range(args.storaged_count):
+        name = f"storaged{i}"
+        pid0 = _read_pid(args.run_dir, name)
+        if pid0 and _alive(pid0):
+            print(f"{name} already running")
+            continue
+        pid = _spawn(args.run_dir, name, "nebula_tpu.daemons.storaged",
+                     ["--meta", meta_addr, "--host", args.host,
+                      "--port", str(args.storaged_port + i), *ff("storaged")])
+        started.append((name, pid))
+    time.sleep(0.5)
+    pid0 = _read_pid(args.run_dir, "graphd")
+    if pid0 and _alive(pid0):
+        print("graphd already running")
+    else:
+        extra = ["--tpu"] if args.tpu else []
+        pid = _spawn(args.run_dir, "graphd", "nebula_tpu.daemons.graphd",
+                     ["--meta", meta_addr, "--host", args.host,
+                      "--port", str(args.graphd_port), *extra, *ff("graphd")])
+        started.append(("graphd", pid))
+    for name, pid in started:
+        print(f"started {name} (pid {pid})")
+    print(f"console: python -m nebula_tpu.console "
+          f"--addr {args.host}:{args.graphd_port}")
+    return 0
+
+
+def _iter_names(run_dir: str):
+    if not os.path.isdir(run_dir):
+        return
+    for f in sorted(os.listdir(run_dir)):
+        if f.endswith(".pid"):
+            yield f[:-4]
+
+
+def status(args) -> int:
+    any_up = False
+    for name in _iter_names(args.run_dir):
+        pid = _read_pid(args.run_dir, name)
+        up = pid is not None and _alive(pid)
+        any_up |= up
+        print(f"{name}: {'UP (pid %d)' % pid if up else 'DOWN'}")
+    if not any_up:
+        print("no services running")
+    return 0
+
+
+def stop(args) -> int:
+    # graphd first, metad last — reverse of start order
+    names = sorted(_iter_names(args.run_dir),
+                   key=lambda n: (n != "graphd", n.startswith("metad")))
+    for name in names:
+        pid = _read_pid(args.run_dir, name)
+        if pid and _alive(pid):
+            os.kill(pid, signal.SIGTERM)
+            for _ in range(50):
+                if not _alive(pid):
+                    break
+                time.sleep(0.1)
+            print(f"stopped {name} (pid {pid})")
+        os.unlink(_pidfile(args.run_dir, name))
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="nebula-tpu cluster manager")
+    ap.add_argument("action", choices=["start", "stop", "status", "restart"])
+    ap.add_argument("--run-dir", default="/tmp/nebula_tpu_cluster")
+    ap.add_argument("--host", default="127.0.0.1")
+    ap.add_argument("--meta-port", type=int, default=45500)
+    ap.add_argument("--storaged-port", type=int, default=44500)
+    ap.add_argument("--graphd-port", type=int, default=3699)
+    ap.add_argument("--storaged-count", type=int, default=1)
+    ap.add_argument("--tpu", action="store_true",
+                    help="enable the TPU engine in graphd")
+    args = ap.parse_args(argv)
+    if args.action == "start":
+        return start(args)
+    if args.action == "status":
+        return status(args)
+    if args.action == "stop":
+        return stop(args)
+    stop(args)
+    return start(args)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -178,3 +178,60 @@ def test_console_error_rendering():
     console = Console(conn, out=buf)
     console.run_statement("THIS IS NOT NGQL")
     assert "[ERROR" in buf.getvalue()
+
+
+def test_flagfile_loading(tmp_path):
+    """gflags-style flagfile (ref: etc/*.conf.default + --flagfile)."""
+    from nebula_tpu.common.flags import FlagRegistry
+    reg = FlagRegistry("TEST")
+    reg.declare("an_int", 5)
+    reg.declare("a_bool", False)
+    reg.declare("a_str", "x")
+    p = tmp_path / "test.conf"
+    p.write_text("# comment\n\n--an_int=42\n--a_bool=true\n"
+                 "--a_str=hello world\n--undeclared=7\n")
+    assert reg.load_flagfile(str(p)) == 4
+    assert reg.get("an_int") == 42
+    assert reg.get("a_bool") is True
+    assert reg.get("a_str") == "hello world"
+    assert reg.get("undeclared") == "7"  # undeclared -> string flag
+
+
+def test_default_flagfiles_parse():
+    import os
+    from nebula_tpu.common.flags import FlagRegistry
+    etc = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "etc")
+    for f in os.listdir(etc):
+        reg = FlagRegistry("X")
+        assert reg.load_flagfile(os.path.join(etc, f)) > 0
+
+
+def test_match_is_grammar_level_stub():
+    """MATCH parses but reports unsupported (ref: MatchExecutor stub)."""
+    from nebula_tpu.cluster import InProcCluster
+    from nebula_tpu.common.status import ErrorCode
+    from nebula_tpu.parser import GQLParser, ast
+    seq = GQLParser().parse("MATCH (v:player) RETURN v")
+    assert seq.sentences[0].kind == ast.Kind.MATCH
+    c = InProcCluster()
+    conn = c.connect()
+    r = conn.execute("MATCH (v:player) RETURN v")
+    assert r.code == ErrorCode.E_UNSUPPORTED
+
+
+def test_match_does_not_swallow_following_statements():
+    from nebula_tpu.parser import GQLParser, ast
+    seq = GQLParser().parse("MATCH (v:player) RETURN v; USE nba")
+    assert [s.kind for s in seq.sentences] == [ast.Kind.MATCH, ast.Kind.USE]
+
+
+def test_flagfile_bare_bool(tmp_path):
+    from nebula_tpu.common.flags import FlagRegistry
+    reg = FlagRegistry("TEST")
+    reg.declare("daemonize", False)
+    p = tmp_path / "f.conf"
+    p.write_text("--daemonize\n--local_config\n")
+    assert reg.load_flagfile(str(p)) == 2
+    assert reg.get("daemonize") is True       # gflags: bare flag = true
+    assert reg.get("local_config") is True

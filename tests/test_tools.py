@@ -123,13 +123,17 @@ def test_sst_generator_offline_then_ingest(cluster, tmp_path):
     counts = generate(mapping, str(out_dir), base_dir=str(tmp_path))
     assert sum(counts.values()) == 4  # 2 vertices + out-edge + in-edge
     from nebula_tpu.common.flags import storage_flags
+    prev = storage_flags.get("download_dir")
     storage_flags.set("download_dir", str(tmp_path / "staging"))
-    conn.must(f'DOWNLOAD HDFS "{out_dir}"')
-    conn.must("INGEST")
-    r = conn.must("GO FROM 300 OVER like YIELD like._dst AS d")
-    assert r.rows == [(301,)]
-    r = conn.must("FETCH PROP ON player 301 YIELD player.name")
-    assert r.rows[0][-1] == "Paul"
+    try:
+        conn.must(f'DOWNLOAD HDFS "{out_dir}"')
+        conn.must("INGEST")
+        r = conn.must("GO FROM 300 OVER like YIELD like._dst AS d")
+        assert r.rows == [(301,)]
+        r = conn.must("FETCH PROP ON player 301 YIELD player.name")
+        assert r.rows[0][-1] == "Paul"
+    finally:
+        storage_flags.set("download_dir", prev)
 
 
 def test_tool_clis_parse(capsys):
