@@ -74,7 +74,7 @@ class FlagRegistry:
         string flags. Returns the number of flags applied."""
         n = 0
         with open(path) as f:
-            for line in f:
+            for lineno, line in enumerate(f, 1):
                 line = line.strip()
                 if not line or line.startswith("#"):
                     continue
@@ -96,12 +96,18 @@ class FlagRegistry:
                     continue
                 value: Any = raw
                 if flag is not None and not isinstance(flag.default, str):
-                    if isinstance(flag.default, bool):
-                        value = raw.lower() in ("1", "true", "yes")
-                    elif isinstance(flag.default, int):
-                        value = int(raw)
-                    elif isinstance(flag.default, float):
-                        value = float(raw)
+                    try:
+                        if isinstance(flag.default, bool):
+                            value = raw.lower() in ("1", "true", "yes")
+                        elif isinstance(flag.default, int):
+                            value = int(raw)
+                        elif isinstance(flag.default, float):
+                            value = float(raw)
+                    except ValueError:
+                        raise ValueError(
+                            f"{path}:{lineno}: flag {name!r} expects "
+                            f"{type(flag.default).__name__}, got {raw!r}"
+                        ) from None
                 elif flag is None:
                     self.declare(name, raw)
                 with self._lock:

@@ -1,0 +1,66 @@
+"""Thread-safe bounded LRU cache (role parity: the reference's
+`common/base/ConcurrentLRUCache.h` — sharded folly EvictingCacheMap;
+here one OrderedDict under a lock, which is plenty for CPython where
+the contended path is IO-bound)."""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Hashable, Optional, Tuple
+
+
+class ConcurrentLRUCache:
+    _MISS = object()
+
+    def __init__(self, capacity: int = 1024):
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self._cap = capacity
+        self._map: "OrderedDict[Hashable, Any]" = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, key: Hashable, default: Any = None) -> Any:
+        with self._lock:
+            v = self._map.get(key, self._MISS)
+            if v is self._MISS:
+                self.misses += 1
+                return default
+            self._map.move_to_end(key)
+            self.hits += 1
+            return v
+
+    def contains(self, key: Hashable) -> bool:
+        with self._lock:
+            return key in self._map
+
+    def put(self, key: Hashable, value: Any) -> None:
+        with self._lock:
+            self._map[key] = value
+            self._map.move_to_end(key)
+            while len(self._map) > self._cap:
+                self._map.popitem(last=False)
+
+    def get_or_compute(self, key: Hashable, compute) -> Any:
+        """Single-call read-through. `compute` may run more than once
+        under contention (same as the reference's racy insert; callers
+        cache idempotent lookups)."""
+        v = self.get(key, self._MISS)
+        if v is not self._MISS:
+            return v
+        v = compute()
+        self.put(key, v)
+        return v
+
+    def evict(self, key: Hashable) -> bool:
+        with self._lock:
+            return self._map.pop(key, self._MISS) is not self._MISS
+
+    def clear(self) -> None:
+        with self._lock:
+            self._map.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._map)

@@ -39,6 +39,7 @@ class ErrorCode(enum.IntEnum):
     E_HOST_NOT_FOUND = -14
     E_WRONG_PARTITION = -15
     E_NO_HOSTS = -16
+    E_WRONG_CLUSTER = -17
     # schema
     E_TAG_NOT_FOUND = -21
     E_EDGE_NOT_FOUND = -22

@@ -7,11 +7,14 @@ import argparse
 from dataclasses import dataclass
 from typing import Dict
 
+from ..common.flags import graph_flags
+from ..common.stats import stats
 from ..graph.engine import ExecutionEngine, GraphService
 from ..meta.client import MetaClient
 from ..meta.schema_manager import SchemaManager
 from ..rpc import RpcServer, proxy
 from ..storage.client import StorageClient
+from ..webservice import WebService
 
 
 class _StorageHostMap(dict):
@@ -31,18 +34,25 @@ class GraphdHandle:
     engine: ExecutionEngine
     meta_client: MetaClient
     server: RpcServer
+    web: "WebService" = None
 
     @property
     def addr(self) -> str:
         return self.server.addr
 
+    @property
+    def ws_port(self):
+        return self.web.port if self.web else None
+
     def stop(self) -> None:
         self.meta_client.stop()
         self.server.stop()
+        if self.web:
+            self.web.stop()
 
 
 def serve_graphd(meta_addr: str, host: str = "127.0.0.1", port: int = 0,
-                 tpu_engine=None) -> GraphdHandle:
+                 tpu_engine=None, ws_port=None) -> GraphdHandle:
     mc = MetaClient(meta_addr, role="graph")
     mc.start(heartbeat=False)  # topology snapshot for part routing
     sm = SchemaManager(mc)
@@ -58,7 +68,12 @@ def serve_graphd(meta_addr: str, host: str = "127.0.0.1", port: int = 0,
     engine = ExecutionEngine(mc, sm, client, tpu_engine=tpu_engine)
     service = GraphService(engine)
     server = RpcServer(host, port).register("graph", service).start()
-    return GraphdHandle(service, engine, mc, server)
+    web = None
+    if ws_port is not None:
+        web = WebService("graphd", flags=graph_flags, stats=stats,
+                         host=host, port=ws_port)
+        web.start()
+    return GraphdHandle(service, engine, mc, server, web)
 
 
 def main(argv=None) -> None:
@@ -70,16 +85,20 @@ def main(argv=None) -> None:
     ap.add_argument("--port", type=int, default=3699)
     ap.add_argument("--tpu", action="store_true",
                     help="enable the TPU graph engine for GO/FIND PATH")
+    ap.add_argument("--ws-port", type=int, default=13000,
+                    help="HTTP admin port (-1 disables)")
     args = ap.parse_args(argv)
     if args.flagfile:
-        from ..common.flags import graph_flags
         graph_flags.load_flagfile(args.flagfile)
     tpu = None
     if args.tpu:
         from ..engine_tpu import TpuGraphEngine
         tpu = TpuGraphEngine()
-    h = serve_graphd(args.meta, args.host, args.port, tpu_engine=tpu)
-    print(f"graphd listening on {h.addr} (meta {args.meta})")
+    ws = None if args.ws_port < 0 else args.ws_port
+    h = serve_graphd(args.meta, args.host, args.port, tpu_engine=tpu,
+                     ws_port=ws)
+    print(f"graphd listening on {h.addr} (meta {args.meta}, "
+          f"http {h.ws_port})")
     try:
         import threading
         threading.Event().wait()

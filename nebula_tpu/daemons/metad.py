@@ -4,42 +4,61 @@ from __future__ import annotations
 
 import argparse
 from dataclasses import dataclass
+from typing import Optional
 
+from ..common.flags import meta_flags
+from ..common.stats import stats
 from ..meta.service import MetaService
 from ..rpc import RpcServer
+from ..webservice import WebService
 
 
 @dataclass
 class MetadHandle:
     meta: MetaService
     server: RpcServer
+    web: Optional[WebService] = None
 
     @property
     def addr(self) -> str:
         return self.server.addr
 
+    @property
+    def ws_port(self) -> Optional[int]:
+        return self.web.port if self.web else None
+
     def stop(self) -> None:
         self.server.stop()
+        if self.web:
+            self.web.stop()
 
 
-def serve_metad(host: str = "127.0.0.1", port: int = 0) -> MetadHandle:
+def serve_metad(host: str = "127.0.0.1", port: int = 0,
+                ws_port: Optional[int] = None) -> MetadHandle:
     meta = MetaService()
     server = RpcServer(host, port).register("meta", meta).start()
-    return MetadHandle(meta, server)
+    web = None
+    if ws_port is not None:
+        web = WebService("metad", flags=meta_flags, stats=stats,
+                         host=host, port=ws_port)
+        web.start()
+    return MetadHandle(meta, server, web)
 
 
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description="nebula-tpu meta daemon")
     ap.add_argument("--flagfile", default=None,
-                help="gflags-style config file (etc/*.conf)")
+                    help="gflags-style config file (etc/*.conf)")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=45500)
+    ap.add_argument("--ws-port", type=int, default=11000,
+                    help="HTTP admin port (-1 disables)")
     args = ap.parse_args(argv)
     if args.flagfile:
-        from ..common.flags import meta_flags
         meta_flags.load_flagfile(args.flagfile)
-    h = serve_metad(args.host, args.port)
-    print(f"metad listening on {h.addr}")
+    ws = None if args.ws_port < 0 else args.ws_port
+    h = serve_metad(args.host, args.port, ws_port=ws)
+    print(f"metad listening on {h.addr} (http {h.ws_port})")
     try:
         import threading
         threading.Event().wait()

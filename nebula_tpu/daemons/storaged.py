@@ -1,17 +1,23 @@
 """storaged: the storage daemon (ref: storage/StorageServer.cpp:88-144
 wires MetaClient → waitForMetadReady → SchemaManager → NebulaStore with
 a meta-driven PartManager → handlers → thrift serve; heartbeats keep
-the host active so metad allocates parts here)."""
+the host active so metad allocates parts here). The HTTP admin service
+mirrors the reference's StorageHttp{Status,Download,Ingest,Admin}
+Handler endpoints."""
 from __future__ import annotations
 
 import argparse
 from dataclasses import dataclass
+from typing import Optional
 
+from ..common.flags import storage_flags
+from ..common.stats import stats
 from ..kvstore.store import GraphStore
 from ..meta.client import MetaClient
 from ..meta.schema_manager import SchemaManager
 from ..rpc import RpcServer
 from ..storage.processors import StorageService
+from ..webservice import WebService
 
 
 @dataclass
@@ -20,23 +26,77 @@ class StoragedHandle:
     storage: StorageService
     meta_client: MetaClient
     server: RpcServer
+    web: Optional[WebService] = None
 
     @property
     def addr(self) -> str:
         return self.server.addr
 
+    @property
+    def ws_port(self) -> Optional[int]:
+        return self.web.port if self.web else None
+
     def stop(self) -> None:
         self.meta_client.stop()
         self.server.stop()
+        if self.web:
+            self.web.stop()
+
+
+def _register_admin_handlers(web: WebService, storage: StorageService) -> None:
+    """ref: /admin?op=compact|flush&space=<id>, /download?space=<id>&
+    url=..., /ingest?space=<id> (StorageHttp*Handler)."""
+
+    def admin(params, body):
+        op = params.get("op")
+        try:
+            space = int(params.get("space", "0"))
+        except ValueError:
+            return 400, {"error": "bad space id"}
+        if op == "compact":
+            st, removed = storage.admin_compact(space)
+            return (200, {"result": "ok", "removed": removed}) if st.ok() \
+                else (500, {"error": st.msg})
+        if op == "flush":
+            st = storage.admin_flush(space)
+            return (200, {"result": "ok"}) if st.ok() \
+                else (500, {"error": st.msg})
+        return 400, {"error": f"unknown op {op!r}"}
+
+    def download(params, body):
+        url = params.get("url")
+        if not url:
+            return 400, {"error": "url required"}
+        try:
+            space = int(params.get("space", "0"))
+        except ValueError:
+            return 400, {"error": "bad space id"}
+        st = storage.download(space, url)
+        return (200, {"result": "ok"}) if st.ok() else (500, {"error": st.msg})
+
+    def ingest(params, body):
+        try:
+            space = int(params.get("space", "0"))
+        except ValueError:
+            return 400, {"error": "bad space id"}
+        st, n = storage.ingest(space)
+        return (200, {"result": "ok", "ingested": n}) if st.ok() \
+            else (500, {"error": st.msg})
+
+    web.register("/admin", admin)
+    web.register("/download", download)
+    web.register("/ingest", ingest)
 
 
 def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
-                   port: int = 0,
-                   load_interval: float = 0.2) -> StoragedHandle:
+                   port: int = 0, ws_port: Optional[int] = None,
+                   load_interval: float = 0.2,
+                   cluster_id_file: str = "") -> StoragedHandle:
     server = RpcServer(host, port)
     addr = server.addr
     store = GraphStore()
-    mc = MetaClient(meta_addr, local_addr=addr, role="storage")
+    mc = MetaClient(meta_addr, local_addr=addr, role="storage",
+                    cluster_id_file=cluster_id_file)
 
     def on_change(event: str, **kw):
         # the MetaServerBasedPartManager push: local parts follow the
@@ -58,22 +118,35 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
     sm = SchemaManager(mc)
     storage = StorageService(store, sm, host=addr)
     server.register("storage", storage).start()
-    return StoragedHandle(store, storage, mc, server)
+    web = None
+    if ws_port is not None:
+        web = WebService("storaged", flags=storage_flags, stats=stats,
+                         host=host, port=ws_port)
+        _register_admin_handlers(web, storage)
+        web.start()
+    return StoragedHandle(store, storage, mc, server, web)
 
 
 def main(argv=None) -> None:
     ap = argparse.ArgumentParser(description="nebula-tpu storage daemon")
     ap.add_argument("--meta", required=True, help="metad host:port")
     ap.add_argument("--flagfile", default=None,
-                help="gflags-style config file (etc/*.conf)")
+                    help="gflags-style config file (etc/*.conf)")
     ap.add_argument("--host", default="127.0.0.1")
     ap.add_argument("--port", type=int, default=44500)
+    ap.add_argument("--ws-port", type=int, default=12000,
+                    help="HTTP admin port (-1 disables)")
+    ap.add_argument("--cluster-id-file", default="",
+                    help="persist/verify the cluster id here "
+                         "(ClusterIdMan; empty = learn from metad)")
     args = ap.parse_args(argv)
     if args.flagfile:
-        from ..common.flags import storage_flags
         storage_flags.load_flagfile(args.flagfile)
-    h = serve_storaged(args.meta, args.host, args.port)
-    print(f"storaged listening on {h.addr} (meta {args.meta})")
+    ws = None if args.ws_port < 0 else args.ws_port
+    h = serve_storaged(args.meta, args.host, args.port, ws_port=ws,
+                       cluster_id_file=args.cluster_id_file)
+    print(f"storaged listening on {h.addr} (meta {args.meta}, "
+          f"http {h.ws_port})")
     try:
         import threading
         threading.Event().wait()

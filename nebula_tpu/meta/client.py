@@ -21,16 +21,19 @@ import time
 from typing import Callable, Dict, List, Optional, Set
 
 from ..common.flags import storage_flags
+from ..common.status import ErrorCode
 from ..rpc import proxy
 
 
 class MetaClient:
     def __init__(self, meta_addr: str, local_addr: str = "",
-                 role: str = "storage"):
+                 role: str = "storage", cluster_id_file: str = ""):
         self._rpc = proxy(meta_addr, "meta")
         self.meta_addr = meta_addr
         self.local_addr = local_addr
         self.role = role
+        self._cluster_id_file = cluster_id_file
+        self.wrong_cluster = False
         self._listeners: List[Callable] = []
         self._known_parts: Dict[int, Set[int]] = {}  # space -> my part ids
         self._known_spaces: Dict[int, object] = {}
@@ -88,10 +91,47 @@ class MetaClient:
     def stop(self) -> None:
         self._stop.set()
 
+    def _load_cluster_id(self) -> int:
+        """ClusterIdMan client side: a persisted id (cluster_id_file)
+        pins this daemon to its original cluster like the reference's
+        on-disk cluster.id; without one the id is learned from the metad
+        we're pointed at (dev mode — the gate then only detects metad
+        redeploys, not misconfiguration)."""
+        if self._cluster_id_file:
+            try:
+                with open(self._cluster_id_file) as f:
+                    return int(f.read().strip())
+            except (OSError, ValueError):
+                pass
+        return 0
+
+    def _store_cluster_id(self, cid: int) -> None:
+        if self._cluster_id_file:
+            try:
+                with open(self._cluster_id_file, "w") as f:
+                    f.write(str(cid))
+            except OSError:
+                pass
+
     def _hb_loop(self) -> None:
+        cluster_id = self._load_cluster_id()
         while not self._stop.is_set():
             try:
-                self._rpc.heartbeat(self.local_addr, self.role)
+                if not cluster_id:
+                    cluster_id = self._rpc.get_cluster_id()
+                    self._store_cluster_id(cluster_id)
+                st = self._rpc.heartbeat(self.local_addr, self.role,
+                                         cluster_id=cluster_id)
+                if st is not None and not st.ok() and \
+                        st.code == ErrorCode.E_WRONG_CLUSTER:
+                    # the reference daemon aborts on mismatch; as a
+                    # library we de-register loudly and stop beating
+                    self.wrong_cluster = True
+                    import sys
+                    print(f"FATAL: metad {self.meta_addr} belongs to a "
+                          f"different cluster (our id {cluster_id}) — "
+                          f"heartbeats stopped", file=sys.stderr)
+                    return
             except Exception:
                 pass
             self._stop.wait(storage_flags.get("heartbeat_interval_secs", 10))

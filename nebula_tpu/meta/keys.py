@@ -28,6 +28,7 @@ P_ID = b"__id:"               # + counter name         -> u32 (next id)
 P_BALANCE = b"__bal:"         # + plan_id(u64)+task    -> task json
 P_SEGMENT = b"__seg:"         # + segment:key          -> custom KV
 P_SNAPSHOT = b"__snp:"        # + name                 -> status str
+K_CLUSTER_ID = b"__cluster_id__"  # -> u63 cluster id (ClusterIdMan)
 
 
 _U32 = struct.Struct(">I")

@@ -20,10 +20,11 @@ class SchemaManager:
     refreshed by `load_data_interval_secs` (ref: MetaClient.h:28-60).
     The cache keeps the traversal hot loop free of catalog scans."""
 
-    def __init__(self, meta: "MetaService"):
+    def __init__(self, meta: "MetaService", cache_capacity: int = 4096):
+        from ..common.lru import ConcurrentLRUCache
         self._meta = meta
         self._cache_ver = -1
-        self._cache: Dict[Tuple, object] = {}
+        self._cache = ConcurrentLRUCache(cache_capacity)
 
     def _memo(self, key: Tuple, compute):
         ver = getattr(self._meta, "catalog_version", None)
@@ -32,9 +33,7 @@ class SchemaManager:
         if ver != self._cache_ver:
             self._cache.clear()
             self._cache_ver = ver
-        if key not in self._cache:
-            self._cache[key] = compute()
-        return self._cache[key]
+        return self._cache.get_or_compute(key, compute)
 
     def space_id(self, name: str) -> StatusOr[int]:
         r = self._meta.get_space(name)

@@ -70,6 +70,16 @@ class MetaService:
         self._listeners: List[Any] = []  # MetaChangedListener callbacks
         # bumped on every catalog mutation; lets SchemaManager cache safely
         self.catalog_version = 0
+        # ClusterIdMan (ref: meta/ClusterIdMan.h + MetaDaemon.cpp:102-125):
+        # generated once, persisted in the meta KV; clients echo it in
+        # heartbeats so a daemon can't join the wrong cluster
+        existing = self._get(mk.K_CLUSTER_ID)
+        if existing is not None:
+            self.cluster_id = int(existing)
+        else:
+            import os as _os
+            self.cluster_id = int.from_bytes(_os.urandom(8), "big") >> 1
+            self._put((mk.K_CLUSTER_ID, str(self.cluster_id).encode()))
 
     # ------------------------------------------------------------------
     # internals
@@ -528,7 +538,17 @@ class MetaService:
     # heartbeats / liveness (HBProcessor + ActiveHostsMan — this IS the
     # failure detector, ref meta/ActiveHostsMan.h:20-60)
     # ------------------------------------------------------------------
-    def heartbeat(self, host: str, role: str = "storage") -> Status:
+    def get_cluster_id(self) -> int:
+        return self.cluster_id
+
+    def heartbeat(self, host: str, role: str = "storage",
+                  cluster_id: int = 0) -> Status:
+        # cluster_id 0 = first contact (client hasn't learned it yet);
+        # a non-zero mismatch is a daemon from another cluster (ref:
+        # HBProcessor clusterId check)
+        if cluster_id and cluster_id != self.cluster_id:
+            return Status.error(ErrorCode.E_WRONG_CLUSTER,
+                                f"wrong cluster id {cluster_id}")
         info = HostInfo(host, time.time(), role)
         return self._put((mk.host_key(host), info.to_json()))
 

@@ -103,6 +103,7 @@ class StorageClient:
             hosts_list = list(self._hosts)
             saw_hintless = False
             saw_no_part = False
+            space_known = None  # one catalog probe per round, lazily
             for part, result in round_resp.results.items():
                 if result.code == ErrorCode.E_LEADER_CHANGED and part in parts:
                     if result.leader:
@@ -115,13 +116,16 @@ class StorageClient:
                     pending[part] = parts[part]
                 elif result.code in (ErrorCode.E_PART_NOT_FOUND,
                                      ErrorCode.E_SPACE_NOT_FOUND) \
-                        and part in parts and self._space_exists(space_id):
+                        and part in parts:
                     # freshly created space: the storaged topology watch
                     # hasn't materialized the part yet (the reference's
                     # load_data_interval_secs window) — wait and retry;
                     # a space the catalog doesn't know fails fast
-                    saw_no_part = True
-                    pending[part] = parts[part]
+                    if space_known is None:
+                        space_known = self._space_exists(space_id)
+                    if space_known:
+                        saw_no_part = True
+                        pending[part] = parts[part]
             if not pending:
                 break
             if saw_no_part:

@@ -604,6 +604,62 @@ class StorageService:
         return out
 
     # ------------------------------------------------------------------
+    # maintenance (ref: StorageHttpAdminHandler ?op=compact|flush and the
+    # StorageCompactionFilter run during RocksDB compaction,
+    # storage/CompactionFilter.h: drop superseded versions, tombstoned
+    # groups, TTL-expired and undecodable rows)
+    # ------------------------------------------------------------------
+    def admin_compact(self, space_id: int) -> Tuple[Status, int]:
+        """Physically GC every part engine of the space. Runs below raft
+        like the reference's compaction (engines converge independently
+        because visibility semantics already hide what compact drops).
+        Returns (status, keys removed)."""
+        removed = 0
+        for part in self.store.parts(space_id):
+            pr = self.store.part(space_id, part)
+            if not pr.ok():
+                continue
+            engine = pr.value().engine
+            drop: List[bytes] = []
+            last_group: Optional[bytes] = None
+            for k, v in engine.prefix(b""):
+                if ku.is_vertex_key(k):
+                    decode = lambda d, kk=k: self._decode_row(
+                        self.sm.tag_schema, space_id,
+                        ku.parse_vertex_key(kk)[2], d)
+                elif ku.is_edge_key(k):
+                    decode = lambda d, kk=k: self._decode_row(
+                        self.sm.edge_schema, space_id,
+                        ku.parse_edge_key(kk)[2], d)
+                else:
+                    continue  # system/uuid/custom keys are kept
+                group = k[:-8]  # strip version suffix
+                if group == last_group:
+                    drop.append(k)      # superseded older version
+                    continue
+                last_group = group
+                if not v:
+                    drop.append(k)      # newest is a tombstone
+                    continue
+                if decode(v) is None:
+                    drop.append(k)      # TTL-expired or undecodable
+            if drop:
+                engine.multi_remove(drop)
+                removed += len(drop)
+        stats.add_value("storage.compact")
+        return Status.OK(), removed
+
+    def admin_flush(self, space_id: int) -> Status:
+        """Flush every part engine that supports it (ref: ?op=flush)."""
+        for part in self.store.parts(space_id):
+            pr = self.store.part(space_id, part)
+            if pr.ok() and hasattr(pr.value().engine, "flush"):
+                st = pr.value().engine.flush()
+                if st is not None and not st.ok():
+                    return st
+        return Status.OK()
+
+    # ------------------------------------------------------------------
     # generic KV + uuid
     # ------------------------------------------------------------------
     def kv_put(self, space_id: int, part: int,

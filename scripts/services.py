@@ -82,7 +82,8 @@ def start(args) -> int:
             continue
         pid = _spawn(args.run_dir, name, "nebula_tpu.daemons.storaged",
                      ["--meta", meta_addr, "--host", args.host,
-                      "--port", str(args.storaged_port + i), *ff("storaged")])
+                      "--port", str(args.storaged_port + i),
+                      "--ws-port", str(12000 + i), *ff("storaged")])
         started.append((name, pid))
     time.sleep(0.5)
     pid0 = _read_pid(args.run_dir, "graphd")
@@ -133,7 +134,15 @@ def stop(args) -> int:
                 if not _alive(pid):
                     break
                 time.sleep(0.1)
-            print(f"stopped {name} (pid {pid})")
+            if _alive(pid):      # wedged: escalate so ports free up
+                os.kill(pid, signal.SIGKILL)
+                for _ in range(20):
+                    if not _alive(pid):
+                        break
+                    time.sleep(0.1)
+                print(f"killed {name} (pid {pid}, ignored SIGTERM)")
+            else:
+                print(f"stopped {name} (pid {pid})")
         os.unlink(_pidfile(args.run_dir, name))
     return 0
 

@@ -235,3 +235,144 @@ def test_flagfile_bare_bool(tmp_path):
     assert reg.load_flagfile(str(p)) == 2
     assert reg.get("daemonize") is True       # gflags: bare flag = true
     assert reg.get("local_config") is True
+
+
+def test_cluster_id_heartbeat_gate():
+    """ClusterIdMan parity: persisted id, mismatched heartbeats rejected
+    (ref: meta/ClusterIdMan.h, HBProcessor clusterId check)."""
+    from nebula_tpu.common.status import ErrorCode
+    from nebula_tpu.meta.service import MetaService
+    m = MetaService()
+    cid = m.get_cluster_id()
+    assert cid > 0
+    assert m.heartbeat("h1:1", "storage").ok()            # first contact
+    assert m.heartbeat("h1:1", "storage", cluster_id=cid).ok()
+    st = m.heartbeat("h1:1", "storage", cluster_id=cid + 1)
+    assert st.code == ErrorCode.E_WRONG_CLUSTER
+    # persisted: a new service over the same store sees the same id
+    m2 = MetaService(store=m._store)
+    assert m2.get_cluster_id() == cid
+
+
+def test_concurrent_lru_cache():
+    from nebula_tpu.common.lru import ConcurrentLRUCache
+    c = ConcurrentLRUCache(3)
+    for i in range(5):
+        c.put(i, i * 10)
+    assert len(c) == 3
+    assert c.get(0) is None and c.get(1) is None   # evicted, LRU order
+    assert c.get(4) == 40
+    c.get(2)                      # touch -> most recent
+    c.put(9, 90)
+    assert c.get(3) is None and c.get(2) == 20     # 3 evicted, 2 kept
+    assert c.get_or_compute(7, lambda: 70) == 70
+    assert c.evict(7) and not c.evict(7)
+
+
+def test_storage_http_admin_endpoints():
+    """HTTP admin parity: /status /admin?op=compact|flush /download
+    /ingest on storaged (ref: StorageHttp*Handler)."""
+    import json as _json
+    import urllib.request
+    from nebula_tpu.daemons import serve_graphd, serve_metad, serve_storaged
+    from nebula_tpu.client import GraphClient
+    metad = serve_metad(ws_port=0)
+    storaged = serve_storaged(metad.addr, ws_port=0)
+    graphd = serve_graphd(metad.addr, ws_port=0)
+    try:
+        gc = GraphClient(graphd.addr).connect()
+        for s in ("CREATE SPACE http_s(partition_num=2)", "USE http_s",
+                  "CREATE TAG t(x int)", "INSERT VERTEX t(x) VALUES 1:(5)",
+                  "INSERT VERTEX t(x) VALUES 1:(6)"):   # two versions
+            r = gc.execute(s)
+            assert r.ok(), (s, r.error_msg)
+        space_id = metad.meta.get_space("http_s").value().space_id
+
+        def http(port, path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}") as resp:
+                return resp.status, _json.loads(resp.read())
+
+        for h in (metad, storaged, graphd):
+            code, body = http(h.ws_port, "/status")
+            assert code == 200 and body["status"] == "running"
+        code, body = http(storaged.ws_port,
+                          f"/admin?op=compact&space={space_id}")
+        assert code == 200 and body["removed"] >= 1   # old version GC'd
+        code, body = http(storaged.ws_port,
+                          f"/admin?op=flush&space={space_id}")
+        assert code == 200
+        r = gc.execute("FETCH PROP ON t 1 YIELD t.x")
+        assert r.ok() and r.rows[0][-1] == 6          # newest survives
+    finally:
+        graphd.stop(); storaged.stop(); metad.stop()
+
+
+def test_admin_compact_drops_tombstones_and_old_versions():
+    from nebula_tpu.cluster import InProcCluster
+    c = InProcCluster()
+    conn = c.connect()
+    conn.must("CREATE SPACE gc_s(partition_num=2)")
+    conn.must("USE gc_s")
+    conn.must("CREATE TAG t(x int)")
+    conn.must("CREATE EDGE e(w int)")
+    conn.must("INSERT VERTEX t(x) VALUES 1:(1), 2:(2)")
+    conn.must("INSERT VERTEX t(x) VALUES 1:(10)")     # second version
+    conn.must("INSERT EDGE e(w) VALUES 1->2:(3)")
+    conn.must("INSERT EDGE e(w) VALUES 1->2:(7)")     # second version (x2: fwd+rev)
+    space_id = c.meta.get_space("gc_s").value().space_id
+    st, removed = c.storage.admin_compact(space_id)
+    # superseded: 1 vertex version + fwd and rev copies of the old edge
+    assert st.ok() and removed == 3
+    # semantics unchanged after physical GC
+    r = conn.must("FETCH PROP ON t 1 YIELD t.x")
+    assert r.rows[0][-1] == 10
+    r = conn.must("GO FROM 1 OVER e YIELD e._dst AS d")
+    assert r.rows == [(2,)]
+    # second compact is a no-op
+    st, removed2 = c.storage.admin_compact(space_id)
+    assert st.ok() and removed2 == 0
+
+
+def test_flagfile_bad_value_names_line(tmp_path):
+    import pytest as _pt
+    from nebula_tpu.common.flags import FlagRegistry
+    reg = FlagRegistry("TEST")
+    reg.declare("n", 5)
+    p = tmp_path / "bad.conf"
+    p.write_text("# ok\n--n=ten\n")
+    with _pt.raises(ValueError, match=r"bad\.conf:2.*'n'"):
+        reg.load_flagfile(str(p))
+
+
+def test_cluster_id_file_pins_daemon(tmp_path):
+    """A persisted cluster id detects pointing a daemon at the wrong
+    metad (ref: on-disk cluster.id)."""
+    from nebula_tpu.meta.client import MetaClient
+    from nebula_tpu.daemons import serve_metad
+    import time as _t
+    cid_file = tmp_path / "cluster.id"
+    m1 = serve_metad()
+    m2 = serve_metad()
+    try:
+        mc = MetaClient(m1.addr, local_addr="x:1", role="storage",
+                        cluster_id_file=str(cid_file))
+        mc.start(heartbeat=True, watch_topology=False)
+        for _ in range(50):
+            if cid_file.exists():
+                break
+            _t.sleep(0.05)
+        assert int(cid_file.read_text()) == m1.meta.get_cluster_id()
+        mc.stop()
+        # same id file, different cluster -> heartbeats refused & stop
+        mc2 = MetaClient(m2.addr, local_addr="x:1", role="storage",
+                         cluster_id_file=str(cid_file))
+        mc2.start(heartbeat=True, watch_topology=False)
+        for _ in range(50):
+            if mc2.wrong_cluster:
+                break
+            _t.sleep(0.05)
+        assert mc2.wrong_cluster
+        mc2.stop()
+    finally:
+        m1.stop(); m2.stop()
