@@ -35,7 +35,7 @@ PARTS = int(os.environ.get("BENCH_PARTS", 8))
 SEEDS = int(os.environ.get("BENCH_SEEDS", 64))
 STEPS = int(os.environ.get("BENCH_STEPS", 3))
 ITERS = int(os.environ.get("BENCH_ITERS", 10))
-BATCH = int(os.environ.get("BENCH_BATCH", 16))
+BATCH = int(os.environ.get("BENCH_BATCH", 64))  # concurrent GO queries per dispatch
 
 
 def log(msg):
@@ -119,9 +119,7 @@ def bench_tpu(store, sm, seed_sets):
     f_batch = jnp.asarray(np.stack(
         [snap.frontier_from_vids(s) for s in seed_sets]))
     req = jnp.asarray(traverse.pad_edge_types([1]))
-    args = (f_batch, jnp.int32(STEPS), snap.d_edge_src, snap.d_edge_etype,
-            snap.d_edge_valid, snap.d_order, snap.d_seg_starts,
-            snap.d_seg_ends, req)
+    args = (f_batch, jnp.int32(STEPS), snap.kernel, req)
     t0 = time.time()
     counts = np.asarray(traverse.multi_hop_count_batch(*args))
     per_batch = int(counts.sum())

@@ -47,12 +47,20 @@ def _register_admin_handlers(web: WebService, storage: StorageService) -> None:
     """ref: /admin?op=compact|flush&space=<id>, /download?space=<id>&
     url=..., /ingest?space=<id> (StorageHttp*Handler)."""
 
+    def _space(params):
+        raw = params.get("space")
+        if raw is None:
+            return None
+        try:
+            return int(raw)
+        except ValueError:
+            return None
+
     def admin(params, body):
         op = params.get("op")
-        try:
-            space = int(params.get("space", "0"))
-        except ValueError:
-            return 400, {"error": "bad space id"}
+        space = _space(params)
+        if space is None:
+            return 400, {"error": "space param required (integer)"}
         if op == "compact":
             st, removed = storage.admin_compact(space)
             return (200, {"result": "ok", "removed": removed}) if st.ok() \
@@ -67,18 +75,16 @@ def _register_admin_handlers(web: WebService, storage: StorageService) -> None:
         url = params.get("url")
         if not url:
             return 400, {"error": "url required"}
-        try:
-            space = int(params.get("space", "0"))
-        except ValueError:
-            return 400, {"error": "bad space id"}
+        space = _space(params)
+        if space is None:
+            return 400, {"error": "space param required (integer)"}
         st = storage.download(space, url)
         return (200, {"result": "ok"}) if st.ok() else (500, {"error": st.msg})
 
     def ingest(params, body):
-        try:
-            space = int(params.get("space", "0"))
-        except ValueError:
-            return 400, {"error": "bad space id"}
+        space = _space(params)
+        if space is None:
+            return 400, {"error": "space param required (integer)"}
         st, n = storage.ingest(space)
         return (200, {"result": "ok", "ingested": n}) if st.ok() \
             else (500, {"error": st.msg})

@@ -83,7 +83,7 @@ class CsrSnapshot:
     def __init__(self, space_id: int, shards: List[CsrShard], cap_v: int,
                  cap_e: int, write_version: int):
         import jax.numpy as jnp
-        from .traverse import build_segments
+        from .traverse import build_kernel
         self.space_id = space_id
         self.shards = shards
         self.num_parts = len(shards)
@@ -98,23 +98,28 @@ class CsrSnapshot:
                      s.edge_dst_part.astype(np.int64) * cap_v + s.edge_dst_local,
                      dump).astype(np.int32)
             for s in shards])
-        self.np_gidx = gidx  # kept for re-blocked segments (mesh sharding)
-        # Static dst-sort permutation + per-destination boundaries for
-        # the scatter-free advance; edge arrays stay in canonical
-        # (src, etype, rank, dst) order.
-        order, seg_starts, seg_ends = build_segments(gidx, P, cap_v)
-        self.d_order = jnp.asarray(order[0])         # [P*cap_e]
-        self.d_seg_starts = jnp.asarray(seg_starts[0])  # [P*cap_v]
-        self.d_seg_ends = jnp.asarray(seg_ends[0])
-        # device arrays [P, cap_e] / [P, cap_v], canonical order
-        self.d_edge_src = jnp.asarray(np.stack([s.edge_src for s in shards]))
+        self.np_gidx = gidx  # kept for re-blocked kernels (mesh sharding)
+        # Both layouts on device (EdgeKernel): canonical for result
+        # materialization + host-permuted dst-sorted copies + segment
+        # boundaries for the scatter-free, single-gather-per-hop advance.
+        # Stacks are transient — shards retain the per-part host mirrors.
+        self.kernel = build_kernel(*self._np_edge_stacks(), gidx, P, cap_v)[0]
+        self.d_edge_src = self.kernel.src
         self.d_edge_gidx = jnp.asarray(gidx)
-        self.d_edge_etype = jnp.asarray(np.stack([s.edge_etype for s in shards]))
-        self.d_edge_valid = jnp.asarray(np.stack([s.edge_valid for s in shards]))
+        self.d_edge_etype = self.kernel.etype
+        self.d_edge_valid = self.kernel.valid
         self.total_edges = int(sum(s.num_edges for s in shards))
         self._device_prop_cache: Dict[Tuple, Any] = {}
         # global string dictionaries: (kind 'e'|'t', prop name) -> {str: code}
         self.str_dicts: Dict[Tuple[str, str], Dict[str, int]] = {}
+
+    # ------------------------------------------------------------------
+    def _np_edge_stacks(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(src, etype, valid) stacked [P, cap_e] — built on demand from
+        the per-shard host mirrors (not stored: redundant with shards)."""
+        return (np.stack([s.edge_src for s in self.shards]),
+                np.stack([s.edge_etype for s in self.shards]),
+                np.stack([s.edge_valid for s in self.shards]))
 
     # ------------------------------------------------------------------
     def locate(self, vid: int) -> Optional[Tuple[int, int]]:

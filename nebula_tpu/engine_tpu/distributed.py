@@ -8,14 +8,14 @@ exchange that the reference does with one thrift RPC per peer host per
 hop becomes ONE `lax.all_to_all` over ICI per hop — inside the same
 compiled loop, no host round-trips.
 
-Like the single-chip kernels (traverse.py), the advance is scatter-free:
-each device holds a static dst-sort permutation over ITS block of
-edges (`build_segments(..., num_blocks=D)`), so its contribution to
-every partition's next frontier is one permute-gather + cumsum + two
-[P*cap_v] boundary gathers — linear in local edges + global vertex
-slots. The [P*cap_v] hit vector is then split into per-device blocks
-and transposed with all_to_all; the receiving device ORs the D
-contributions into its local frontier.
+Like the single-chip kernels (traverse.py), the advance is scatter-free
+and gather-minimal: each device holds an EdgeKernel for ITS block of
+edges (`build_kernel(..., num_blocks=D)`) whose dst-sorted copies were
+permuted on the host at build time, so its contribution to every
+partition's next frontier is ONE [E_local] gather + cumsum + two
+[P*cap_v] boundary gathers. The [P*cap_v] hit vector is then split
+into per-device blocks and transposed with all_to_all; the receiving
+device ORs the D contributions into its local frontier.
 
 Layout: with P partitions over D devices (P % D == 0), device d owns the
 contiguous partition block [d*P/D, (d+1)*P/D). This mirrors how the
@@ -33,6 +33,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .traverse import EdgeKernel, _edge_ok
+
 AXIS = "parts"
 
 
@@ -41,19 +43,17 @@ def make_mesh(devices: Optional[List] = None) -> Mesh:
     return Mesh(np.array(devices), (AXIS,))
 
 
-def _local_hits(frontier, edge_src, edge_ok, order, seg_starts, seg_ends):
+def _local_hits(frontier, k: EdgeKernel, ok_sorted):
     """One hop on one device's partition block: the full-space hit
     vector (this device's contribution to every partition) plus the
-    local active-edge mask.
+    hop's local active-edge count.
 
-    frontier: bool[localP, cap_v]; order: int32[1, localP*cap_e];
-    seg_*: int32[1, P*cap_v]
-    -> (hits bool[P*cap_v], active bool[localP, cap_e])
+    frontier: bool[localP, cap_v]; k: this block's EdgeKernel
+    -> (hits bool[P*cap_v], active_count int32)
     """
-    active = jnp.take_along_axis(frontier, edge_src, axis=1) & edge_ok
-    flat = active.reshape(-1)[order[0]]
+    flat = frontier.reshape(-1)[k.src_sorted] & ok_sorted
     S0 = jnp.pad(jnp.cumsum(flat.astype(jnp.int32)), (1, 0))
-    return (S0[seg_ends[0]] - S0[seg_starts[0]]) > 0, active
+    return (S0[k.seg_ends] - S0[k.seg_starts]) > 0, S0[-1]
 
 
 def _exchange(flat_hits, num_devices, local_block):
@@ -64,16 +64,15 @@ def _exchange(flat_hits, num_devices, local_block):
     return recv.reshape(num_devices, local_block).any(axis=0)
 
 
-def multi_hop_sharded(mesh: Mesh, frontier0, steps, edge_src, edge_etype,
-                      edge_valid, order, seg_starts, seg_ends, req_types
-                      ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+def multi_hop_sharded(mesh: Mesh, frontier0, steps, kern: EdgeKernel,
+                      req_types) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Distributed GO: returns (final_frontier [P,cap_v], final_active
     [P,cap_e] in canonical edge order), both sharded over the mesh
     partition axis.
 
-    Edge arrays are global [P, ...]; order/seg_starts/seg_ends come from
-    build_segments(gidx, P, cap_v, num_blocks=D) — one row per device.
-    P must divide by mesh size.
+    kern comes from stack_kernels(build_kernel(..., num_blocks=D)) —
+    every field carries a leading per-device block dim. P must divide
+    by mesh size.
     """
     num_devices = mesh.devices.size
     num_parts, cap_v = frontier0.shape
@@ -84,28 +83,27 @@ def multi_hop_sharded(mesh: Mesh, frontier0, steps, edge_src, edge_etype,
     from jax import shard_map
 
     @partial(shard_map, mesh=mesh,
-             in_specs=(P(AXIS), None, P(AXIS), P(AXIS), P(AXIS), P(AXIS),
-                       P(AXIS), P(AXIS), None),
+             in_specs=(P(AXIS), None, P(AXIS), None),
              out_specs=(P(AXIS), P(AXIS)))
-    def run(frontier, steps_, src, etype, valid, order_, starts, ends, req):
-        edge_ok = (etype[None] == req[:, None, None]).any(0) & valid
+    def run(frontier, steps_, kern_, req):
+        k = jax.tree.map(lambda a: a[0], kern_)  # drop block dim
+        ok_sorted = _edge_ok(k.etype_sorted, k.valid_sorted, req)
 
         def body(_, f):
-            hits, _active = _local_hits(f, src, edge_ok, order_, starts, ends)
+            hits, _n = _local_hits(f, k, ok_sorted)
             nxt = _exchange(hits, num_devices, local_block)
             return nxt.reshape(parts_per_dev, cap_v)
 
         f = lax.fori_loop(0, steps_ - 1, body, frontier)
-        final_active = jnp.take_along_axis(f, src, axis=1) & edge_ok
+        edge_ok = _edge_ok(k.etype, k.valid, req)
+        final_active = jnp.take_along_axis(f, k.src, axis=1) & edge_ok
         return f, final_active
 
-    return jax.jit(run)(frontier0, steps, edge_src, edge_etype, edge_valid,
-                        order, seg_starts, seg_ends, req_types)
+    return jax.jit(run)(frontier0, steps, kern, req_types)
 
 
-def multi_hop_count_sharded(mesh: Mesh, frontier0, steps, edge_src,
-                            edge_etype, edge_valid, order, seg_starts,
-                            seg_ends, req_types) -> jnp.ndarray:
+def multi_hop_count_sharded(mesh: Mesh, frontier0, steps, kern: EdgeKernel,
+                            req_types) -> jnp.ndarray:
     """Distributed total-edges-traversed counter (bench metric)."""
     num_devices = mesh.devices.size
     num_parts, cap_v = frontier0.shape
@@ -116,16 +114,16 @@ def multi_hop_count_sharded(mesh: Mesh, frontier0, steps, edge_src,
     from jax import shard_map
 
     @partial(shard_map, mesh=mesh,
-             in_specs=(P(AXIS), None, P(AXIS), P(AXIS), P(AXIS), P(AXIS),
-                       P(AXIS), P(AXIS), None),
+             in_specs=(P(AXIS), None, P(AXIS), None),
              out_specs=P())
-    def run(frontier, steps_, src, etype, valid, order_, starts, ends, req):
-        edge_ok = (etype[None] == req[:, None, None]).any(0) & valid
+    def run(frontier, steps_, kern_, req):
+        k = jax.tree.map(lambda a: a[0], kern_)
+        ok_sorted = _edge_ok(k.etype_sorted, k.valid_sorted, req)
 
         def body(_, state):
             f, total = state
-            hits, active = _local_hits(f, src, edge_ok, order_, starts, ends)
-            total = total + active.sum(dtype=jnp.int64)
+            hits, n = _local_hits(f, k, ok_sorted)
+            total = total + n.astype(jnp.int64)
             nxt = _exchange(hits, num_devices, local_block)
             return nxt.reshape(parts_per_dev, cap_v), total
 
@@ -135,24 +133,19 @@ def multi_hop_count_sharded(mesh: Mesh, frontier0, steps, edge_src,
         _, total = lax.fori_loop(0, steps_, body, (frontier, zero))
         return lax.psum(total, AXIS)
 
-    return jax.jit(run)(frontier0, steps, edge_src, edge_etype, edge_valid,
-                        order, seg_starts, seg_ends, req_types)
+    return jax.jit(run)(frontier0, steps, kern, req_types)
 
 
-def shard_snapshot_arrays(mesh: Mesh, snap) -> None:
-    """Re-place a CsrSnapshot's device arrays with the mesh sharding and
-    attach per-device block segments (d_border/d_bseg_starts/
-    d_bseg_ends) so the sharded kernels consume them without host
-    transfers."""
-    from .traverse import build_segments
+def shard_snapshot_arrays(mesh: Mesh, snap) -> "EdgeKernel":
+    """Build the per-device-block EdgeKernel for a CsrSnapshot and place
+    it with the mesh sharding (leading block dim sharded over AXIS);
+    also attaches it as snap.sharded_kernel."""
+    from .traverse import build_kernel, stack_kernels
     sharding = NamedSharding(mesh, P(AXIS))
     D = mesh.devices.size
-    order, starts, ends = build_segments(snap.np_gidx, snap.num_parts,
-                                         snap.cap_v, num_blocks=D)
-    snap.d_border = jax.device_put(jnp.asarray(order), sharding)
-    snap.d_bseg_starts = jax.device_put(jnp.asarray(starts), sharding)
-    snap.d_bseg_ends = jax.device_put(jnp.asarray(ends), sharding)
-    snap.d_edge_src = jax.device_put(snap.d_edge_src, sharding)
-    snap.d_edge_etype = jax.device_put(snap.d_edge_etype, sharding)
-    snap.d_edge_valid = jax.device_put(snap.d_edge_valid, sharding)
-    snap.d_edge_gidx = jax.device_put(snap.d_edge_gidx, sharding)
+    kerns = build_kernel(*snap._np_edge_stacks(), snap.np_gidx,
+                         snap.num_parts, snap.cap_v, num_blocks=D)
+    kern = stack_kernels(kerns)
+    kern = jax.tree.map(lambda a: jax.device_put(a, sharding), kern)
+    snap.sharded_kernel = kern
+    return kern

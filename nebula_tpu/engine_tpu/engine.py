@@ -147,10 +147,7 @@ class TpuGraphEngine:
             if device_mask is None:
                 local_filter = s.where.filter
 
-        _, active = traverse.multi_hop(
-            f0, s.step.steps, snap.d_edge_src, snap.d_edge_etype,
-            snap.d_edge_valid, snap.d_order, snap.d_seg_starts,
-            snap.d_seg_ends, req)
+        _, active = traverse.multi_hop(f0, s.step.steps, snap.kernel, req)
         if device_mask is not None:
             active = active & device_mask
         mask = np.asarray(active)
@@ -234,13 +231,9 @@ class TpuGraphEngine:
         steps_f = (upto + 1) // 2
         steps_b = upto - steps_f
         dist_f = np.asarray(traverse.bfs_dist(
-            jnp.asarray(f_src), steps_f, snap.d_edge_src, snap.d_edge_etype,
-            snap.d_edge_valid, snap.d_order, snap.d_seg_starts,
-            snap.d_seg_ends, req_f))
+            jnp.asarray(f_src), steps_f, snap.kernel, req_f))
         dist_b = np.asarray(traverse.bfs_dist(
-            jnp.asarray(f_dst), max(steps_b, 0), snap.d_edge_src,
-            snap.d_edge_etype, snap.d_edge_valid, snap.d_order,
-            snap.d_seg_starts, snap.d_seg_ends, req_b))
+            jnp.asarray(f_dst), max(steps_b, 0), snap.kernel, req_b))
         paths = _reconstruct_shortest(snap, dist_f, dist_b, sources, targets,
                                       edge_types, upto, name_by_type)
         self.stats["path_served"] += 1

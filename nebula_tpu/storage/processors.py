@@ -622,7 +622,9 @@ class StorageService:
             engine = pr.value().engine
             drop: List[bytes] = []
             last_group: Optional[bytes] = None
-            for k, v in engine.prefix(b""):
+            # materialize the scan first: concurrent RPC writes mutate
+            # the live engine while we iterate
+            for k, v in list(engine.prefix(b"")):
                 if ku.is_vertex_key(k):
                     decode = lambda d, kk=k: self._decode_row(
                         self.sm.tag_schema, space_id,

@@ -40,16 +40,11 @@ def test_sharded_matches_single_device(snap8, starts, steps, etypes):
     f0 = jnp.asarray(snap.frontier_from_vids(starts))
     req = jnp.asarray(traverse.pad_edge_types(etypes))
 
-    f_single, a_single = traverse.multi_hop(
-        f0, steps, snap.d_edge_src, snap.d_edge_etype, snap.d_edge_valid,
-        snap.d_order, snap.d_seg_starts, snap.d_seg_ends, req)
-    border, bstarts, bends = traverse.build_segments(
-        snap.np_gidx, snap.num_parts, snap.cap_v,
-        num_blocks=mesh.devices.size)
-    f_shard, a_shard = dist.multi_hop_sharded(
-        mesh, f0, steps, snap.d_edge_src, snap.d_edge_etype,
-        snap.d_edge_valid, jnp.asarray(border), jnp.asarray(bstarts),
-        jnp.asarray(bends), req)
+    f_single, a_single = traverse.multi_hop(f0, steps, snap.kernel, req)
+    kern = traverse.stack_kernels(traverse.build_kernel(
+        *snap._np_edge_stacks(), snap.np_gidx, snap.num_parts, snap.cap_v,
+        num_blocks=mesh.devices.size))
+    f_shard, a_shard = dist.multi_hop_sharded(mesh, f0, steps, kern, req)
     assert np.array_equal(np.asarray(f_single), np.asarray(f_shard))
     assert np.array_equal(np.asarray(a_single), np.asarray(a_shard))
 
@@ -59,15 +54,11 @@ def test_sharded_count_matches(snap8):
     mesh = dist.make_mesh()
     f0 = jnp.asarray(snap.frontier_from_vids([100, 101]))
     req = jnp.asarray(traverse.pad_edge_types([1]))
-    n_single = int(traverse.multi_hop_count(
-        f0, 3, snap.d_edge_src, snap.d_edge_etype, snap.d_edge_valid,
-        snap.d_order, snap.d_seg_starts, snap.d_seg_ends, req))
-    border, bstarts, bends = traverse.build_segments(
-        snap.np_gidx, snap.num_parts, snap.cap_v,
-        num_blocks=mesh.devices.size)
-    n_shard = int(dist.multi_hop_count_sharded(
-        mesh, f0, 3, snap.d_edge_src, snap.d_edge_etype, snap.d_edge_valid,
-        jnp.asarray(border), jnp.asarray(bstarts), jnp.asarray(bends), req))
+    n_single = int(traverse.multi_hop_count(f0, 3, snap.kernel, req))
+    kern = traverse.stack_kernels(traverse.build_kernel(
+        *snap._np_edge_stacks(), snap.np_gidx, snap.num_parts, snap.cap_v,
+        num_blocks=mesh.devices.size))
+    n_shard = int(dist.multi_hop_count_sharded(mesh, f0, 3, kern, req))
     assert n_single == n_shard > 0
 
 
@@ -76,16 +67,11 @@ def test_sharded_with_placed_arrays(snap8):
     exercising the NamedSharding placement path used on real hardware."""
     snap, _ = snap8
     mesh = dist.make_mesh()
-    dist.shard_snapshot_arrays(mesh, snap)
+    kern = dist.shard_snapshot_arrays(mesh, snap)
     f0 = jnp.asarray(snap.frontier_from_vids([100]))
     req = jnp.asarray(traverse.pad_edge_types([1]))
-    f, a = dist.multi_hop_sharded(mesh, f0, 2, snap.d_edge_src,
-                                  snap.d_edge_etype, snap.d_edge_valid,
-                                  snap.d_border, snap.d_bseg_starts,
-                                  snap.d_bseg_ends, req)
+    f, a = dist.multi_hop_sharded(mesh, f0, 2, kern, req)
     # compare against a fresh single-device run
-    f1, a1 = traverse.multi_hop(f0, 2, snap.d_edge_src, snap.d_edge_etype,
-                                snap.d_edge_valid, snap.d_order,
-                                snap.d_seg_starts, snap.d_seg_ends, req)
+    f1, a1 = traverse.multi_hop(f0, 2, snap.kernel, req)
     assert np.array_equal(np.asarray(f), np.asarray(f1))
     assert np.array_equal(np.asarray(a), np.asarray(a1))
