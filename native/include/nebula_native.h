@@ -112,6 +112,33 @@ void nkv_buf_free(uint8_t *buf);
 /* Persist a point-in-time checkpoint (atomic rename). */
 int32_t nkv_checkpoint(nkv *e, const char *path);
 
+/* ------------------------------------------------------------- codec */
+
+/* Field type tags: match nebula_tpu/codec/schema.py PropType values. */
+#define NBC_TYPE_BOOL 1
+#define NBC_TYPE_INT 2
+#define NBC_TYPE_VID 3
+#define NBC_TYPE_DOUBLE 5
+#define NBC_TYPE_STRING 6
+#define NBC_TYPE_TIMESTAMP 7
+
+/* Decode n_rows fixed-slot rows of ONE schema into column buffers.
+ * rows_blob: concatenated encoded rows; row_off/row_len per row;
+ * row_idx: destination slot per row (0..cap-1, out-of-range skipped).
+ * Outputs are caller-allocated flat [n_fields * cap] arrays, indexed
+ * f*cap + idx; `nulls` must be pre-filled with 1 (a decoded non-null
+ * value clears it). INT/VID/TIMESTAMP and BOOL(0/1) land in vals_i64,
+ * DOUBLE in vals_f64, STRING as (absolute offset, length) into
+ * rows_blob via str_off/str_len. Returns rows decoded (>=0) or a
+ * negative error. */
+int64_t nbc_decode_batch(const uint8_t *field_types, int32_t n_fields,
+                         const uint8_t *rows_blob, int64_t blob_len,
+                         const int64_t *row_off, const int32_t *row_len,
+                         const int32_t *row_idx, int64_t n_rows, int64_t cap,
+                         int64_t *vals_i64, double *vals_f64,
+                         uint32_t *str_off, uint32_t *str_len,
+                         uint8_t *nulls);
+
 #ifdef __cplusplus
 }
 #endif

@@ -1,0 +1,102 @@
+// Batch row decoder — the codec hot path in C++ (role parity with the
+// reference's dataman/RowReader C++ codec; ref dataman/RowReader.cpp:
+// 221-300). Decodes many fixed-slot rows of one schema straight into
+// column buffers, so snapshot builds and scans pay one FFI call per
+// batch instead of one Python decode per row.
+//
+// Row layout (must match nebula_tpu/codec/row.py):
+//   [u8 ver_len][schema_ver LE (ver_len bytes)]
+//   [null bitmap: ceil(n/8) bytes]
+//   [slot region: BOOL=1 byte; INT/VID/TIMESTAMP/DOUBLE=8 LE;
+//                 STRING=u32 offset + u32 length into var region]
+//   [var region: string payloads]
+#include <cstring>
+
+#include "nebula_native.h"
+
+namespace {
+
+inline int64_t rd_i64(const uint8_t *p) {
+  int64_t v;
+  std::memcpy(&v, p, 8);
+  return v;  // little-endian hosts only (x86/arm64)
+}
+
+inline uint32_t rd_u32(const uint8_t *p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+inline double rd_f64(const uint8_t *p) {
+  double v;
+  std::memcpy(&v, p, 8);
+  return v;
+}
+
+}  // namespace
+
+extern "C" int64_t nbc_decode_batch(
+    const uint8_t *field_types, int32_t n_fields, const uint8_t *rows_blob,
+    int64_t blob_len, const int64_t *row_off, const int32_t *row_len,
+    const int32_t *row_idx, int64_t n_rows, int64_t cap, int64_t *vals_i64,
+    double *vals_f64, uint32_t *str_off, uint32_t *str_len, uint8_t *nulls) {
+  // slot offsets are schema-constant
+  int32_t slot_offs[256];
+  if (n_fields <= 0 || n_fields > 256) return -1;
+  // str_off is u32: refuse blobs it can't address (caller falls back)
+  if (blob_len > static_cast<int64_t>(UINT32_MAX)) return -2;
+  int32_t off = 0;
+  for (int32_t f = 0; f < n_fields; ++f) {
+    slot_offs[f] = off;
+    off += (field_types[f] == NBC_TYPE_BOOL) ? 1 : 8;
+  }
+  const int32_t slot_total = off;
+  const int32_t null_bytes = (n_fields + 7) / 8;
+
+  int64_t ok_rows = 0;
+  for (int64_t r = 0; r < n_rows; ++r) {
+    const int64_t ro = row_off[r];
+    const int32_t rl = row_len[r];
+    const int64_t idx = row_idx[r];
+    if (idx < 0 || idx >= cap) continue;
+    if (ro < 0 || rl < 1 || ro + rl > blob_len) continue;
+    const uint8_t *row = rows_blob + ro;
+    const int32_t ver_len = row[0];
+    const int32_t null_off = 1 + ver_len;
+    const int32_t slot_off = null_off + null_bytes;
+    const int32_t var_off = slot_off + slot_total;
+    if (var_off > rl) continue;  // truncated row: leave fields null
+    ++ok_rows;
+    for (int32_t f = 0; f < n_fields; ++f) {
+      const int64_t out = static_cast<int64_t>(f) * cap + idx;
+      if (row[null_off + (f >> 3)] & (1u << (f & 7))) continue;  // null
+      const uint8_t *slot = row + slot_off + slot_offs[f];
+      switch (field_types[f]) {
+        case NBC_TYPE_BOOL:
+          vals_i64[out] = slot[0] ? 1 : 0;
+          break;
+        case NBC_TYPE_INT:
+        case NBC_TYPE_VID:
+        case NBC_TYPE_TIMESTAMP:
+          vals_i64[out] = rd_i64(slot);
+          break;
+        case NBC_TYPE_DOUBLE:
+          vals_f64[out] = rd_f64(slot);
+          break;
+        case NBC_TYPE_STRING: {
+          const uint32_t so = rd_u32(slot);
+          const uint32_t sl = rd_u32(slot + 4);
+          if (static_cast<int64_t>(var_off) + so + sl > rl) continue;
+          str_off[out] = static_cast<uint32_t>(ro + var_off + so);
+          str_len[out] = sl;
+          break;
+        }
+        default:
+          continue;  // unknown type: stays null
+      }
+      nulls[out] = 0;
+    }
+  }
+  return ok_rows;
+}

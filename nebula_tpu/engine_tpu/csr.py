@@ -324,12 +324,100 @@ def build_snapshot(store, sm, space_id: int, num_parts: int) -> CsrSnapshot:
 _I32_MIN, _I32_MAX = -(1 << 31), (1 << 31) - 1
 
 
+def _native_build_columns(schema: Schema, cap: int,
+                          idx_rows: List[Tuple[int, bytes]], now: float,
+                          dict_registry: Dict, dict_key: Tuple
+                          ) -> Optional[Dict[str, PropColumn]]:
+    """Fast path: one nbc_decode_batch FFI call decodes every row into
+    column buffers (native/src/codec.cc — the C++ codec hot path, role
+    parity with the reference's C++ RowReader). Returns None when the
+    native library is unavailable; semantics match the Python path
+    (newest rows only arrive here; TTL-expired rows fully nulled)."""
+    from .. import native
+    if not native.available():
+        return None
+    try:
+        i64, f64, soff, slen, nulls, blob = native.decode_batch(
+            [f.type.value for f in schema.fields], idx_rows, cap)
+    except Exception:
+        return None
+    # TTL: a row whose ttl prop expired is invisible — null every field
+    if schema.ttl_col and schema.ttl_duration > 0:
+        ti = schema.field_index(schema.ttl_col)
+        if ti >= 0:
+            tt = schema.fields[ti].type
+            tv = f64[ti] if tt == PropType.DOUBLE else i64[ti]
+            expired = (~nulls[ti]) & (tv + schema.ttl_duration < now)
+            nulls[:, expired] = True
+    # strings decode strictly up front; a row with invalid UTF-8 becomes
+    # wholly invisible, matching the Python path's whole-row skip on
+    # decode failure
+    str_vals: Dict[int, Dict[int, str]] = {}
+    for fi, f in enumerate(schema.fields):
+        if f.type != PropType.STRING:
+            continue
+        vals: Dict[int, str] = {}
+        for i in np.nonzero(~nulls[fi])[0]:
+            b = blob[soff[fi, i]:soff[fi, i] + slen[fi, i]]
+            try:
+                vals[int(i)] = b.decode("utf-8")
+            except UnicodeDecodeError:
+                nulls[:, i] = True
+        str_vals[fi] = vals
+    out: Dict[str, PropColumn] = {}
+    for fi, f in enumerate(schema.fields):
+        t = f.type
+        present = ~nulls[fi]
+        pos = np.nonzero(present)[0]
+        host = np.empty(cap, dtype=object)  # object-empty = None-filled
+        device_ok = True
+        device_vals = None
+        str_dict = None
+        if t == PropType.DOUBLE:
+            vals = f64[fi]
+            host[pos] = np.array(vals[pos].tolist(), dtype=object)
+            device_vals = np.where(present, vals, np.nan).astype(np.float32)
+        elif t in (PropType.INT, PropType.VID, PropType.TIMESTAMP):
+            vals = i64[fi]
+            host[pos] = np.array(vals[pos].tolist(), dtype=object)
+            if pos.size and (vals[pos].min() < _I32_MIN
+                             or vals[pos].max() > _I32_MAX):
+                device_ok = False  # host-only column (filter falls back)
+            else:
+                device_vals = np.where(present, vals, 0).astype(np.int32)
+        elif t == PropType.BOOL:
+            vals = i64[fi] != 0
+            host[pos] = np.array(vals[pos].tolist(), dtype=object)
+            device_vals = np.where(present, vals, False)
+        elif t == PropType.STRING:
+            if dict_registry is not None and dict_key is not None:
+                str_dict = dict_registry.setdefault(dict_key + (f.name,), {})
+            else:
+                str_dict = {}
+            codes = np.full(cap, -1, dtype=np.int32)
+            for i, s in str_vals[fi].items():
+                if nulls[fi, i]:
+                    continue  # row nulled by a later field's bad UTF-8
+                host[i] = s
+                codes[i] = str_dict.setdefault(s, len(str_dict))
+            device_vals = codes
+        else:
+            device_ok = False
+        out[f.name] = PropColumn(f.name, t, host, device_ok, device_vals,
+                                 present, str_dict)
+    return out
+
+
 def _build_columns(schema: Schema, cap: int,
                    idx_rows: List[Tuple[int, bytes]], now: float,
                    dict_registry: Dict = None, dict_key: Tuple = None
                    ) -> Dict[str, PropColumn]:
     """Decode rows into columnar arrays aligned at the given indices,
     respecting schema versions and TTL."""
+    fast = _native_build_columns(schema, cap, idx_rows, now,
+                                 dict_registry, dict_key)
+    if fast is not None:
+        return fast
     out: Dict[str, PropColumn] = {}
     n_fields = schema.num_fields()
     host_cols: List[List[Any]] = [[None] * cap for _ in range(n_fields)]

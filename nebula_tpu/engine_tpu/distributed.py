@@ -33,7 +33,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .traverse import EdgeKernel, _edge_ok
+from .traverse import EdgeKernel, _edge_ok, hop_hits
 
 AXIS = "parts"
 
@@ -51,9 +51,8 @@ def _local_hits(frontier, k: EdgeKernel, ok_sorted):
     frontier: bool[localP, cap_v]; k: this block's EdgeKernel
     -> (hits bool[P*cap_v], active_count int32)
     """
-    flat = frontier.reshape(-1)[k.src_sorted] & ok_sorted
-    S0 = jnp.pad(jnp.cumsum(flat.astype(jnp.int32)), (1, 0))
-    return (S0[k.seg_ends] - S0[k.seg_starts]) > 0, S0[-1]
+    return hop_hits(frontier, k.src_sorted, ok_sorted,
+                    k.seg_starts, k.seg_ends)
 
 
 def _exchange(flat_hits, num_devices, local_block):

@@ -139,18 +139,30 @@ def _edge_ok(edge_etype: jnp.ndarray, edge_valid: jnp.ndarray,
     return m & edge_valid
 
 
+def hop_hits(frontier: jnp.ndarray, src_sorted: jnp.ndarray,
+             ok_sorted: jnp.ndarray, seg_starts: jnp.ndarray,
+             seg_ends: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """THE hop primitive, shared by every traversal variant (single-chip
+    advance, counting, and the distributed per-block contribution): one
+    [E] gather (sorted src slots) + cumsum + two boundary gathers;
+    scatter-free.
+
+    frontier: bool[P_local, cap_v] -> (hits bool[n_slots],
+    active_count i32) where n_slots = len(seg_starts) (the full space's
+    destination slots — equal to frontier.size on a single block).
+    """
+    flat = frontier.reshape(-1)[src_sorted] & ok_sorted
+    S0 = jnp.pad(jnp.cumsum(flat.astype(jnp.int32)), (1, 0))
+    return (S0[seg_ends] - S0[seg_starts]) > 0, S0[-1]
+
+
 def _advance(frontier: jnp.ndarray, k: EdgeKernel,
              ok_sorted: jnp.ndarray) -> jnp.ndarray:
-    """One BFS hop on stacked partitions (single device = one block).
-
-    frontier: bool[P, cap_v] -> bool[P, cap_v]. One [E] gather (sorted
-    src slots) + cumsum + two [P*cap_v] boundary gathers; scatter-free.
-    """
+    """One BFS hop on stacked partitions (single device = one block)."""
     P, cap_v = frontier.shape
-    flat = frontier.reshape(-1)[k.src_sorted] & ok_sorted
-    S0 = jnp.pad(jnp.cumsum(flat.astype(jnp.int32)), (1, 0))
-    counts = S0[k.seg_ends] - S0[k.seg_starts]
-    return (counts > 0).reshape(P, cap_v)
+    hits, _ = hop_hits(frontier, k.src_sorted, ok_sorted,
+                       k.seg_starts, k.seg_ends)
+    return hits.reshape(P, cap_v)
 
 
 @jax.jit
@@ -243,14 +255,12 @@ def multi_hop_count(frontier0: jnp.ndarray, steps: jnp.ndarray,
 
     def body(_, state):
         frontier, total = state
-        flat = frontier.reshape(-1)[k.src_sorted] & ok_sorted
-        S0 = jnp.pad(jnp.cumsum(flat.astype(jnp.int32)), (1, 0))
-        counts = S0[k.seg_ends] - S0[k.seg_starts]
+        hits, n = hop_hits(frontier, k.src_sorted, ok_sorted,
+                           k.seg_starts, k.seg_ends)
         # int64 accumulator: >2^31 edges per query is reachable on large
         # graphs (canonicalizes to int32 only when x64 is disabled)
-        total = total + S0[-1].astype(jnp.int64)
-        P, cap_v = frontier.shape
-        return (counts > 0).reshape(P, cap_v), total
+        total = total + n.astype(jnp.int64)
+        return hits.reshape(frontier.shape), total
 
     _, total = lax.fori_loop(0, steps, body,
                              (frontier0, jnp.zeros((), jnp.int64)))

@@ -123,6 +123,17 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.nkv_buf_free.argtypes = [u8p]
     lib.nkv_checkpoint.restype = i32
     lib.nkv_checkpoint.argtypes = [vp, ctypes.c_char_p]
+
+    # --------------------------------------------------------- codec
+    lib.nbc_decode_batch.restype = i64
+    lib.nbc_decode_batch.argtypes = [
+        u8p, i32,                     # field_types, n_fields
+        u8p, i64,                     # rows_blob, blob_len
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(i32),  # row_off/len
+        ctypes.POINTER(i32), i64, i64,                        # row_idx, n, cap
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_double),
+        ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
+        u8p]
     return lib
 
 
@@ -143,3 +154,52 @@ def available() -> bool:
         return True
     except (NativeBuildError, OSError):
         return False
+
+
+def decode_batch(field_types, idx_rows, cap):
+    """Batch-decode fixed-slot rows of one schema into columns via the
+    native codec (nbc_decode_batch).
+
+    field_types: list of PropType int values per schema field.
+    idx_rows: list of (dest index, encoded row bytes).
+    cap: column length.
+
+    Returns (vals_i64, vals_f64, str_off, str_len, nulls, blob) — numpy
+    arrays shaped [n_fields, cap] (nulls: True = null) plus the
+    concatenated blob str_off/str_len point into. Raises if the native
+    library is unavailable (callers fall back to the Python codec).
+    """
+    import numpy as np
+    lib = load()
+    n_fields = len(field_types)
+    n = len(idx_rows)
+    blob = b"".join(raw for _, raw in idx_rows)
+    row_len = np.fromiter((len(raw) for _, raw in idx_rows),
+                          dtype=np.int32, count=n)
+    row_off = np.zeros(n, np.int64)
+    if n > 1:
+        np.cumsum(row_len[:-1], out=row_off[1:])
+    row_idx = np.fromiter((i for i, _ in idx_rows), dtype=np.int32, count=n)
+    ft = np.asarray(field_types, np.uint8)
+    vals_i64 = np.zeros((n_fields, cap), np.int64)
+    vals_f64 = np.zeros((n_fields, cap), np.float64)
+    str_off = np.zeros((n_fields, cap), np.uint32)
+    str_len = np.zeros((n_fields, cap), np.uint32)
+    nulls = np.ones((n_fields, cap), np.uint8)
+
+    c_u8p = ctypes.POINTER(ctypes.c_uint8)
+    rc = lib.nbc_decode_batch(
+        ft.ctypes.data_as(c_u8p), n_fields,
+        ctypes.cast(ctypes.c_char_p(blob), c_u8p), len(blob),
+        row_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        row_len.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        row_idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+        n, cap,
+        vals_i64.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        vals_f64.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        str_off.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        str_len.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        nulls.ctypes.data_as(c_u8p))
+    if rc < 0:
+        raise NativeBuildError(f"nbc_decode_batch failed ({rc})")
+    return vals_i64, vals_f64, str_off, str_len, nulls.astype(bool), blob

@@ -122,3 +122,87 @@ def test_engine_under_graphstore(tmp_path):
     store.add_part(1, 1)
     assert store.async_multi_put(1, 1, [(b"\x01a", b"1")]).ok()
     assert store.get(1, 1, b"\x01a").value() == b"1"
+
+
+def test_native_codec_matches_python_columns(monkeypatch, tmp_path):
+    """nbc_decode_batch column build == pure-Python column build
+    (values, nulls, device arrays, string dicts, TTL)."""
+    import numpy as np
+    import time
+    from nebula_tpu.codec import PropType, RowWriter, Schema, SchemaField
+    from nebula_tpu.engine_tpu import csr as csr_mod
+
+    schema = Schema([SchemaField("name", PropType.STRING),
+                     SchemaField("age", PropType.INT),
+                     SchemaField("w", PropType.DOUBLE),
+                     SchemaField("ok", PropType.BOOL),
+                     SchemaField("big", PropType.INT)])
+    now = time.time()
+    rows = []
+    for i in range(7):
+        w = RowWriter(schema)
+        if i != 3:
+            w.set("name", f"s{i % 2}")      # repeated -> shared dict codes
+        w.set("age", 10 * i)
+        if i != 5:
+            w.set("w", i / 4)
+        w.set("ok", i % 2 == 0)
+        w.set("big", (1 << 40) if i == 6 else i)   # forces host-only col
+        rows.append((i * 3, w.encode()))
+    cap = 32
+
+    reg_n, reg_p = {}, {}
+    native_cols = csr_mod._native_build_columns(schema, cap, rows, now,
+                                                reg_n, ("e",))
+    assert native_cols is not None, "native lib should be available in CI"
+    monkeypatch.setattr("nebula_tpu.native.available", lambda: False)
+    python_cols = csr_mod._build_columns(schema, cap, rows, now,
+                                         reg_p, ("e",))
+    assert set(native_cols) == set(python_cols)
+    for name in python_cols:
+        pn, pp = native_cols[name], python_cols[name]
+        assert pn.device_ok == pp.device_ok, name
+        assert np.array_equal(pn.present, pp.present), name
+        assert [x for x in pn.host] == [x for x in pp.host], name
+        if pp.device_vals is not None:
+            assert np.array_equal(pn.device_vals, pp.device_vals,
+                                  equal_nan=True), name
+    assert reg_n == reg_p
+
+
+def test_native_codec_ttl_rows_nulled(monkeypatch):
+    import time
+    from nebula_tpu.codec import PropType, RowWriter, Schema, SchemaField
+    from nebula_tpu.engine_tpu import csr as csr_mod
+
+    schema = Schema([SchemaField("ts", PropType.TIMESTAMP),
+                     SchemaField("x", PropType.INT)],
+                    ttl_col="ts", ttl_duration=100)
+    now = time.time()
+    rows = [(0, RowWriter(schema).set("ts", int(now) - 500).set("x", 1).encode()),
+            (1, RowWriter(schema).set("ts", int(now)).set("x", 2).encode())]
+    cols = csr_mod._native_build_columns(schema, 4, rows, now, {}, ("t",))
+    assert cols is not None
+    assert cols["x"].host[0] is None      # expired row invisible
+    assert cols["x"].host[1] == 2
+
+
+def test_native_codec_invalid_utf8_row_invisible(monkeypatch):
+    """Both codec paths drop the ENTIRE row on invalid UTF-8."""
+    import time
+    from nebula_tpu.codec import PropType, RowWriter, Schema, SchemaField
+    from nebula_tpu.engine_tpu import csr as csr_mod
+    schema = Schema([SchemaField("s", PropType.STRING),
+                     SchemaField("x", PropType.INT)])
+    good = RowWriter(schema).set("s", "fine").set("x", 1).encode()
+    bad = RowWriter(schema).set("s", b"\xff\xfe\xff").set("x", 2).encode()
+    now = time.time()
+    n_cols = csr_mod._native_build_columns(schema, 4, [(0, good), (1, bad)],
+                                           now, {}, ("e",))
+    assert n_cols["x"].host[0] == 1 and n_cols["x"].host[1] is None
+    assert n_cols["s"].host[1] is None
+    import nebula_tpu.native as native
+    monkeypatch.setattr(native, "available", lambda: False)
+    p_cols = csr_mod._build_columns(schema, 4, [(0, good), (1, bad)],
+                                    now, {}, ("e",))
+    assert p_cols["x"].host[1] is None and p_cols["s"].host[1] is None
