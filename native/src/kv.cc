@@ -42,10 +42,6 @@ std::string next_prefix(const std::string &p) {
   return q;  // empty => no upper bound
 }
 
-void append_u32(std::string &buf, uint32_t v) {
-  buf.append(reinterpret_cast<const char *>(&v), 4);
-}
-
 }  // namespace
 
 struct nkv {

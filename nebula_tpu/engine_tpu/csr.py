@@ -344,7 +344,12 @@ def _native_build_columns(schema: Schema, cap: int,
     # TTL: a row whose ttl prop expired is invisible — null every field
     if schema.ttl_col and schema.ttl_duration > 0:
         ti = schema.field_index(schema.ttl_col)
-        if ti >= 0:
+        # only numeric ttl cols expire — the Python/storage paths treat a
+        # non-numeric ttl value as never-expired (their isinstance check
+        # admits int/float/bool, so BOOL stays in the numeric set here)
+        if ti >= 0 and schema.fields[ti].type in (
+                PropType.INT, PropType.VID, PropType.TIMESTAMP,
+                PropType.DOUBLE, PropType.BOOL):
             tt = schema.fields[ti].type
             tv = f64[ti] if tt == PropType.DOUBLE else i64[ti]
             expired = (~nulls[ti]) & (tv + schema.ttl_duration < now)

@@ -59,6 +59,7 @@ class Kind(enum.Enum):
     CREATE_SNAPSHOT = "create_snapshot"
     DROP_SNAPSHOT = "drop_snapshot"
     MATCH = "match"
+    FIND = "find"
 
 
 class Sentence:
@@ -376,6 +377,18 @@ class MatchSentence(Sentence):
     supported yet', parser Sentence.h kMatch)."""
     raw: str
     kind = Kind.MATCH
+
+    def to_string(self) -> str:
+        return self.raw
+
+
+@dataclass
+class FindSentence(Sentence):
+    """Grammar-level only, like the reference: FIND <props> FROM <label>
+    parses but execution reports unsupported (ref: graph/FindExecutor
+    .cpp:20 'Does not support')."""
+    raw: str
+    kind = Kind.FIND
 
     def to_string(self) -> str:
         return self.raw

@@ -143,12 +143,7 @@ class GQLParser:
         if tt == "MATCH":
             # grammar-level stub (ref: MATCH parses, executor says
             # "not supported yet") — swallow tokens to the stmt boundary
-            toks = []
-            while self._peek().type not in (";", "|", "EOF"):
-                t = self._peek()
-                toks.append(str(t.value) if t.value is not None else t.type)
-                self.i += 1
-            return ast.MatchSentence(" ".join(toks))
+            return ast.MatchSentence(self._swallow_to_stmt_boundary())
         if tt == "FETCH":
             return self._fetch()
         if tt == "USE":
@@ -221,8 +216,25 @@ class GQLParser:
         yld = self._opt_yield()
         return ast.GoSentence(step, from_, over, where, yld)
 
-    def _find_path(self) -> ast.FindPathSentence:
+    def _swallow_to_stmt_boundary(self) -> str:
+        """Consume tokens up to the next statement boundary (`;`, `|`,
+        EOF), returning the reconstructed raw text — used by the
+        grammar-level MATCH/FIND stubs."""
+        toks = []
+        while self._peek().type not in (";", "|", "EOF"):
+            t = self._peek()
+            toks.append(str(t.value) if t.value is not None else t.type)
+            self.i += 1
+        return " ".join(toks)
+
+    def _find_path(self) -> ast.Sentence:
         self._expect("FIND")
+        if self._peek().type not in ("SHORTEST", "NOLOOP", "ALL"):
+            # plain FIND <props> FROM <label>: grammar-level stub like the
+            # reference (FindExecutor: "Does not support") — swallow to
+            # the statement boundary
+            return ast.FindSentence(
+                "FIND " + self._swallow_to_stmt_boundary())
         shortest = noloop = False
         if self._accept("SHORTEST"):
             shortest = True

@@ -206,3 +206,18 @@ def test_native_codec_invalid_utf8_row_invisible(monkeypatch):
     p_cols = csr_mod._build_columns(schema, 4, [(0, good), (1, bad)],
                                     now, {}, ("e",))
     assert p_cols["x"].host[1] is None and p_cols["s"].host[1] is None
+
+
+def test_native_codec_non_numeric_ttl_never_expires(monkeypatch):
+    """String ttl_col never expires — native must match the Python
+    path's isinstance numeric check."""
+    import time
+    from nebula_tpu.codec import PropType, RowWriter, Schema, SchemaField
+    from nebula_tpu.engine_tpu import csr as csr_mod
+    schema = Schema([SchemaField("name", PropType.STRING),
+                     SchemaField("x", PropType.INT)],
+                    ttl_col="name", ttl_duration=100)
+    rows = [(0, RowWriter(schema).set("name", "n").set("x", 7).encode())]
+    now = time.time()
+    cols = csr_mod._native_build_columns(schema, 2, rows, now, {}, ("t",))
+    assert cols["x"].host[0] == 7   # visible: string ttl is a no-op

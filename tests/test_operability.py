@@ -376,3 +376,17 @@ def test_cluster_id_file_pins_daemon(tmp_path):
         mc2.stop()
     finally:
         m1.stop(); m2.stop()
+
+
+def test_find_is_grammar_level_stub():
+    from nebula_tpu.cluster import InProcCluster
+    from nebula_tpu.common.status import ErrorCode
+    from nebula_tpu.parser import GQLParser, ast
+    seq = GQLParser().parse("FIND name, age FROM player; YIELD 1 AS x")
+    assert [s.kind for s in seq.sentences] == [ast.Kind.FIND, ast.Kind.YIELD]
+    conn = InProcCluster().connect()
+    r = conn.execute("FIND name FROM player")
+    assert r.code == ErrorCode.E_UNSUPPORTED
+    # FIND SHORTEST/ALL PATH still parses as a real statement
+    seq = GQLParser().parse("FIND SHORTEST PATH FROM 1 TO 2 OVER like")
+    assert seq.sentences[0].kind == ast.Kind.FIND_PATH
