@@ -27,6 +27,8 @@ class StoragedHandle:
     meta_client: MetaClient
     server: RpcServer
     web: Optional[WebService] = None
+    node: Optional[object] = None        # StorageNode when replicated
+    raft_server: Optional[RpcServer] = None
 
     @property
     def addr(self) -> str:
@@ -39,6 +41,13 @@ class StoragedHandle:
     def stop(self) -> None:
         self.meta_client.stop()
         self.server.stop()
+        if self.node is not None:
+            self.node.stop()
+            net = getattr(self.node, "raft_net", None)
+            if net is not None:
+                net.shutdown()
+        if self.raft_server is not None:
+            self.raft_server.stop()
         if self.web:
             self.web.stop()
 
@@ -94,13 +103,46 @@ def _register_admin_handlers(web: WebService, storage: StorageService) -> None:
     web.register("/ingest", ingest)
 
 
+def _raft_addr(storage_addr: str) -> str:
+    """Raft listens on storage port + 1, the reference convention
+    (NebulaStore::getRaftAddr, kvstore/NebulaStore.h:55-60)."""
+    h, p = storage_addr.rsplit(":", 1)
+    return f"{h}:{int(p) + 1}"
+
+
 def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
                    port: int = 0, ws_port: Optional[int] = None,
                    load_interval: float = 0.2,
-                   cluster_id_file: str = "") -> StoragedHandle:
+                   cluster_id_file: str = "",
+                   replicated: bool = False,
+                   data_dir: Optional[str] = None) -> StoragedHandle:
     server = RpcServer(host, port)
     addr = server.addr
-    store = GraphStore()
+    raft_server = None
+    node = None
+    if replicated:
+        # raft-replicated parts: a second RpcServer on port+1 hosts this
+        # node's RaftexService; peers reach it via RpcTransport
+        from ..kvstore.raft_store import StorageNode
+        from ..kvstore.raftex.service import RpcTransport
+        import tempfile
+        raft_server = RpcServer(host, int(addr.rsplit(":", 1)[1]) + 1)
+
+        def storage_addr_of(raft_addr: str) -> str:
+            h, p = raft_addr.rsplit(":", 1)
+            return f"{h}:{int(p) - 1}"
+
+        raft_net = RpcTransport()
+        node = StorageNode(addr=_raft_addr(addr),
+                           data_root=data_dir or tempfile.mkdtemp(
+                               prefix="nebula_tpu_storaged_"),
+                           net=raft_net,
+                           leader_hint=storage_addr_of)
+        node.raft_net = raft_net  # shut down with the node (handle.stop)
+        raft_server.register("raftex", node.service).start()
+        store = node.store
+    else:
+        store = GraphStore()
     mc = MetaClient(meta_addr, local_addr=addr, role="storage",
                     cluster_id_file=cluster_id_file)
 
@@ -109,12 +151,24 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
         # meta allocation (ref: kvstore/PartManager.h handler methods)
         if event in ("space_added", "parts_added"):
             for p in kw.get("parts", []):
-                store.add_part(kw["space_id"], p)
+                if node is not None:
+                    peers = [_raft_addr(h) for h in
+                             mc.part_peers(kw["space_id"], p)]
+                    node.add_part(kw["space_id"], p, peers or
+                                  [_raft_addr(addr)])
+                else:
+                    store.add_part(kw["space_id"], p)
         elif event == "parts_removed":
             for p in kw.get("parts", []):
-                store.remove_part(kw["space_id"], p)
+                if node is not None:
+                    node.remove_part(kw["space_id"], p)
+                else:
+                    store.remove_part(kw["space_id"], p)
         elif event == "space_removed":
-            store.remove_space(kw["space_id"])
+            if node is not None:
+                node.remove_space(kw["space_id"])
+            else:
+                store.remove_space(kw["space_id"])
 
     mc.add_listener(on_change)
     # register with metad BEFORE the first topology sync so part
@@ -130,7 +184,7 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
                          host=host, port=ws_port)
         _register_admin_handlers(web, storage)
         web.start()
-    return StoragedHandle(store, storage, mc, server, web)
+    return StoragedHandle(store, storage, mc, server, web, node, raft_server)
 
 
 def main(argv=None) -> None:
@@ -145,12 +199,18 @@ def main(argv=None) -> None:
     ap.add_argument("--cluster-id-file", default="",
                     help="persist/verify the cluster id here "
                          "(ClusterIdMan; empty = learn from metad)")
+    ap.add_argument("--replicated", action="store_true",
+                    help="raft-replicate parts across storaged peers "
+                         "(raft listens on port+1)")
+    ap.add_argument("--data-dir", default=None,
+                    help="WAL/engine root for replicated mode")
     args = ap.parse_args(argv)
     if args.flagfile:
         storage_flags.load_flagfile(args.flagfile)
     ws = None if args.ws_port < 0 else args.ws_port
     h = serve_storaged(args.meta, args.host, args.port, ws_port=ws,
-                       cluster_id_file=args.cluster_id_file)
+                       cluster_id_file=args.cluster_id_file,
+                       replicated=args.replicated, data_dir=args.data_dir)
     print(f"storaged listening on {h.addr} (meta {args.meta}, "
           f"http {h.ws_port})")
     try:

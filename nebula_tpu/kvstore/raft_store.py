@@ -30,6 +30,9 @@ _CODE_MAP = {
     RaftCode.SUCCEEDED: ErrorCode.SUCCEEDED,
     RaftCode.E_NOT_A_LEADER: ErrorCode.E_LEADER_CHANGED,
     RaftCode.E_BAD_STATE: ErrorCode.E_FILTER_OUT,   # aborted atomic op
+    # a stopping node redirects clients to another replica (hintless:
+    # an election is typically in flight)
+    RaftCode.E_HOST_STOPPED: ErrorCode.E_LEADER_CHANGED,
 }
 
 
@@ -40,7 +43,7 @@ class RaftConsensusHook(ConsensusHook):
     def __init__(self, space_id: int, part_id: int, engine: KVEngine,
                  addr: str, peers: List[str], wal_root: str,
                  service: RaftexService, is_learner: bool = False,
-                 **raft_kw):
+                 leader_hint=None, **raft_kw):
         self._space_id = space_id
         self._part_id = part_id
         self._engine = engine
@@ -49,6 +52,10 @@ class RaftConsensusHook(ConsensusHook):
         self._wal_root = wal_root
         self._service = service
         self._is_learner = is_learner
+        # maps the leader's RAFT address to the address clients should
+        # redirect to (the storage RPC addr; identity for in-proc tests
+        # whose raft addrs ARE the client addrs)
+        self._leader_hint = leader_hint or (lambda a: a)
         self._raft_kw = raft_kw
         self.raft: Optional[RaftPart] = None
 
@@ -84,8 +91,10 @@ class RaftConsensusHook(ConsensusHook):
         if mapped is ErrorCode.SUCCEEDED:
             return Status.OK()
         if mapped is ErrorCode.E_LEADER_CHANGED:
-            return Status.error(ErrorCode.E_LEADER_CHANGED,
-                                self.raft.leader() or "")
+            # a stopped host's cached leader may be itself — never hint it
+            hint = "" if code is RaftCode.E_HOST_STOPPED else \
+                (self.leader() or "")
+            return Status.error(ErrorCode.E_LEADER_CHANGED, hint)
         if mapped is ErrorCode.E_FILTER_OUT:
             return Status.error(ErrorCode.E_FILTER_OUT, "atomic op aborted")
         return Status.error(ErrorCode.E_CONSENSUS_ERROR, str(code))
@@ -100,7 +109,8 @@ class RaftConsensusHook(ConsensusHook):
         return self.raft is not None and self.raft.is_leader()
 
     def leader(self) -> Optional[str]:
-        return self.raft.leader() if self.raft else None
+        raw = self.raft.leader() if self.raft else None
+        return self._leader_hint(raw) if raw else raw
 
     def stop(self) -> None:
         if self.raft is not None:
@@ -113,7 +123,7 @@ class StorageNode:
     (ref storage/StorageServer.cpp boot + AdminProcessor surface)."""
 
     def __init__(self, addr: str, data_root: str, net: InProcNetwork,
-                 engine_factory=None, **raft_kw):
+                 engine_factory=None, leader_hint=None, **raft_kw):
         self.addr = addr
         self.data_root = data_root
         self.service = RaftexService(addr, net)
@@ -127,7 +137,8 @@ class StorageNode:
             hook = RaftConsensusHook(
                 space_id, part_id, engine, addr, peers,
                 os.path.join(data_root, addr.replace(":", "_")),
-                self.service, is_learner=learner, **raft_kw)
+                self.service, is_learner=learner,
+                leader_hint=leader_hint, **raft_kw)
             self.hooks[(space_id, part_id)] = hook
             return hook
 
@@ -144,6 +155,13 @@ class StorageNode:
         if hook is not None:
             hook.stop()
         self.store.remove_part(space_id, part_id)
+
+    def remove_space(self, space_id: int) -> None:
+        """Stop every part's raft BEFORE the engine closes — committing
+        into a freed native engine is a use-after-free."""
+        for key in [k for k in self.hooks if k[0] == space_id]:
+            self.hooks.pop(key).stop()
+        self.store.remove_space(space_id)
 
     def raft(self, space_id: int, part_id: int) -> Optional[RaftPart]:
         h = self.hooks.get((space_id, part_id))

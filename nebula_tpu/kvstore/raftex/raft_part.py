@@ -194,6 +194,9 @@ class RaftPart:
     def _append(self, log_type: LogType, data: bytes) -> Future:
         fut: Future = Future()
         with self._lock:
+            if not self._running:
+                fut.set_result(RaftCode.E_HOST_STOPPED)
+                return fut
             if self.role is not Role.LEADER:
                 fut.set_result(RaftCode.E_NOT_A_LEADER)
                 return fut
@@ -356,7 +359,9 @@ class RaftPart:
             prev_term = t
         entries: List[LogRecord] = []
         log_term = 0
-        for e in self.wal.iterate(host.next_id):
+        # bounded range: iterate() materializes under the WAL lock, so
+        # the scan must not cover a lagging follower's whole tail
+        for e in self.wal.iterate(host.next_id, host.next_id + 255):
             if not entries:
                 log_term = e.term
             elif e.term != log_term:

@@ -25,6 +25,44 @@ class Transport:
         raise NotImplementedError
 
 
+def _unreachable_response(method: str):
+    if method == "ask_for_vote":
+        return AskForVoteResponse(RaftCode.E_UNREACHABLE, 0)
+    if method == "append_log":
+        return AppendLogResponse(RaftCode.E_UNREACHABLE, 0, None, 0, 0, 0)
+    return SendSnapshotResponse(RaftCode.E_UNREACHABLE, 0)
+
+
+class RpcTransport(Transport):
+    """Raft messages over the framed-TCP rpc/ layer — the cross-process
+    production transport (role parity: the reference's RaftexService
+    thrift server on the raft port, kvstore/NebulaStore.h:55-60
+    getRaftAddr). Peer addresses are `host:port` of the peer's raft
+    RpcServer hosting its RaftexService under the "raftex" name.
+
+    Socket timeout is on the order of election timeouts, NOT the
+    default 30s RPC timeout: a black-holed peer must not pin worker
+    threads long enough to starve heartbeats to healthy peers."""
+
+    def __init__(self, max_workers: int = 16, timeout: float = 1.5):
+        self._pool = ThreadPoolExecutor(max_workers=max_workers,
+                                        thread_name_prefix="raft-rpc")
+        self._timeout = timeout
+
+    def call(self, from_addr: str, to_addr: str, method: str, req) -> Future:
+        def run():
+            from ...rpc import proxy
+            try:
+                return proxy(to_addr, "raftex",
+                             timeout=self._timeout).call(method, req)
+            except Exception:
+                return _unreachable_response(method)
+        return self._pool.submit(run)
+
+    def shutdown(self) -> None:
+        self._pool.shutdown(wait=False)
+
+
 class InProcNetwork(Transport):
     """In-process message fabric with fault injection: services register
     under string addresses; `isolate(addr)` simulates a network
@@ -54,13 +92,6 @@ class InProcNetwork(Transport):
         with self._lock:
             self._isolated.discard(addr)
 
-    def _unreachable(self, method: str):
-        if method == "ask_for_vote":
-            return AskForVoteResponse(RaftCode.E_UNREACHABLE, 0)
-        if method == "append_log":
-            return AppendLogResponse(RaftCode.E_UNREACHABLE, 0, None, 0, 0, 0)
-        return SendSnapshotResponse(RaftCode.E_UNREACHABLE, 0)
-
     def call(self, from_addr: str, to_addr: str, method: str, req) -> Future:
         def run():
             with self._lock:
@@ -68,7 +99,7 @@ class InProcNetwork(Transport):
                 dropped = (from_addr in self._isolated or
                            to_addr in self._isolated or svc is None)
             if dropped:
-                return self._unreachable(method)
+                return _unreachable_response(method)
             return getattr(svc, method)(req)
         return self._pool.submit(run)
 

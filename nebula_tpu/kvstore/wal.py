@@ -6,13 +6,17 @@ restart, and term conflicts roll the tail back. The heavy lifting
 (segment files, CRC validation, torn-tail truncation, the in-memory
 record index) is the native library (`native/src/wal.cc`); this wrapper
 owns lifetime and exposes a Pythonic iterator.
+
+Every native call is serialized with close() under one lock, so raft
+background threads racing a part shutdown see benign defaults instead
+of touching a freed native handle (use-after-free -> heap corruption).
 """
 from __future__ import annotations
 
 import ctypes
 import threading
 from dataclasses import dataclass
-from typing import Iterator, Optional
+from typing import Iterator, List, Optional
 
 from .. import native
 
@@ -43,23 +47,31 @@ class Wal:
     # ------------------------------------------------------------------
     @property
     def first_log_id(self) -> int:
-        return self._lib.nwal_first_log_id(self._h)
+        with self._lock:
+            return 0 if self._closed else self._lib.nwal_first_log_id(self._h)
 
     @property
     def last_log_id(self) -> int:
-        return self._lib.nwal_last_log_id(self._h)
+        with self._lock:
+            return 0 if self._closed else self._lib.nwal_last_log_id(self._h)
 
     @property
     def last_log_term(self) -> int:
-        return self._lib.nwal_last_log_term(self._h)
+        with self._lock:
+            return 0 if self._closed else self._lib.nwal_last_log_term(self._h)
 
     def log_term(self, log_id: int) -> Optional[int]:
-        t = self._lib.nwal_log_term(self._h, log_id)
+        with self._lock:
+            if self._closed:
+                return None
+            t = self._lib.nwal_log_term(self._h, log_id)
         return None if t < 0 else t
 
     def append(self, log_id: int, term: int, cluster: int,
                data: bytes) -> bool:
         with self._lock:
+            if self._closed:
+                return False
             rc = self._lib.nwal_append(self._h, log_id, term, cluster,
                                        data, len(data))
         return rc == 0
@@ -67,34 +79,47 @@ class Wal:
     def rollback(self, keep_to: int) -> bool:
         """Drop every log with id > keep_to (term conflict)."""
         with self._lock:
+            if self._closed:
+                return False
             return self._lib.nwal_rollback(self._h, keep_to) == 0
 
     def reset(self) -> None:
         with self._lock:
-            self._lib.nwal_reset(self._h)
+            if not self._closed:
+                self._lib.nwal_reset(self._h)
 
     def clean_ttl(self) -> int:
         with self._lock:
+            if self._closed:
+                return 0
             return self._lib.nwal_clean_ttl(self._h)
 
     def sync(self) -> None:
-        self._lib.nwal_sync(self._h)
+        with self._lock:
+            if not self._closed:
+                self._lib.nwal_sync(self._h)
 
     def iterate(self, from_id: int, to_id: int = -1) -> Iterator[LogEntry]:
-        """Yield entries in [from_id, to_id] (to_id<0 → through last)."""
-        it = self._lib.nwal_iter_new(self._h, from_id, to_id)
-        try:
-            while self._lib.nwal_iter_valid(it):
-                out = ctypes.POINTER(ctypes.c_uint8)()
-                n = self._lib.nwal_iter_data(it, ctypes.byref(out))
-                data = ctypes.string_at(out, n) if n else b""
-                yield LogEntry(self._lib.nwal_iter_log_id(it),
-                               self._lib.nwal_iter_term(it),
-                               self._lib.nwal_iter_cluster(it),
-                               data)
-                self._lib.nwal_iter_next(it)
-        finally:
-            self._lib.nwal_iter_free(it)
+        """Yield entries in [from_id, to_id] (to_id<0 → through last).
+        The scan materializes under the lock so it cannot race close()."""
+        entries: List[LogEntry] = []
+        with self._lock:
+            if self._closed:
+                return iter(())
+            it = self._lib.nwal_iter_new(self._h, from_id, to_id)
+            try:
+                while self._lib.nwal_iter_valid(it):
+                    out = ctypes.POINTER(ctypes.c_uint8)()
+                    n = self._lib.nwal_iter_data(it, ctypes.byref(out))
+                    data = ctypes.string_at(out, n) if n else b""
+                    entries.append(LogEntry(self._lib.nwal_iter_log_id(it),
+                                            self._lib.nwal_iter_term(it),
+                                            self._lib.nwal_iter_cluster(it),
+                                            data))
+                    self._lib.nwal_iter_next(it)
+            finally:
+                self._lib.nwal_iter_free(it)
+        return iter(entries)
 
     def close(self) -> None:
         with self._lock:

@@ -177,17 +177,24 @@ class MetaClient:
                 self._notify("space_removed", space_id=sid)
 
     # -- routing helpers for graphd ------------------------------------
-    def part_host(self, space_id: int, part_id: int) -> str:
-        """First replica host of a part (leader by convention until the
-        raft layer reports real leaders). Served from the watch loop's
-        topology snapshot — one metad round-trip per space on a cache
-        miss, not one per routing lookup in the query hot path."""
+    def _alloc_for(self, space_id: int, part_id: int) -> Dict[int, List[str]]:
+        """Topology-snapshot part allocation, refetched on cache miss —
+        one metad round-trip per space, not one per routing lookup."""
         alloc = self._alloc.get(space_id)
         if alloc is None or part_id not in alloc:
             alloc = self._rpc.get_parts_alloc(space_id)
             self._alloc[space_id] = alloc
-        hosts = alloc.get(part_id) or ["local"]
+        return alloc
+
+    def part_host(self, space_id: int, part_id: int) -> str:
+        """First replica host of a part (leader by convention until the
+        raft layer reports real leaders)."""
+        hosts = self._alloc_for(space_id, part_id).get(part_id) or ["local"]
         return hosts[0]
+
+    def part_peers(self, space_id: int, part_id: int) -> List[str]:
+        """All replica hosts of a part (the raft peer set)."""
+        return list(self._alloc_for(space_id, part_id).get(part_id) or [])
 
     def storage_hosts(self) -> List[str]:
         return [h.host for h in self._rpc.active_hosts("storage")]

@@ -62,18 +62,25 @@ class RpcServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self._services: Dict[str, Any] = {}
+        self._conns: set = set()
+        self._conns_lock = threading.Lock()
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 sock = self.request
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                with outer._conns_lock:
+                    outer._conns.add(sock)
                 try:
                     while True:
                         raw = _recv_frame(sock)
                         _send_frame(sock, outer._dispatch(raw))
                 except (ConnectionError, OSError):
                     pass
+                finally:
+                    with outer._conns_lock:
+                        outer._conns.discard(sock)
 
         class Server(socketserver.ThreadingTCPServer):
             allow_reuse_address = True
@@ -115,6 +122,21 @@ class RpcServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+        # kill established connections too — a stopped daemon must go
+        # silent (peers would otherwise keep talking to handler threads
+        # whose services are already stopped)
+        with self._conns_lock:
+            conns = list(self._conns)
+            self._conns.clear()
+        for sock in conns:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass
 
 
 # ---------------------------------------------------------------------------
@@ -179,14 +201,17 @@ class RpcClient:
     _pools: Dict[Tuple[str, int], _ConnPool] = {}
     _pools_lock = threading.Lock()
 
-    def __init__(self, addr: str, service: str):
+    def __init__(self, addr: str, service: str,
+                 timeout: Optional[float] = None):
         host, port_s = addr.rsplit(":", 1)
         self._key = (host, int(port_s))
         self.addr = addr
         self.service = service
         with RpcClient._pools_lock:
             if self._key not in RpcClient._pools:
-                RpcClient._pools[self._key] = _ConnPool(host, int(port_s))
+                RpcClient._pools[self._key] = _ConnPool(
+                    host, int(port_s),
+                    timeout=timeout if timeout is not None else 30.0)
         self._pool = RpcClient._pools[self._key]
 
     def call(self, method: str, *args, **kwargs) -> Any:
@@ -217,8 +242,10 @@ class RpcClient:
         return lambda *args, **kwargs: self.call(name, *args, **kwargs)
 
 
-def proxy(addr: str, service: str) -> RpcClient:
+def proxy(addr: str, service: str,
+          timeout: Optional[float] = None) -> RpcClient:
     """A client whose attribute calls mirror the remote service's
     methods — drop-in for the in-proc service objects that
-    StorageClient/MetaClient hold per host."""
-    return RpcClient(addr, service)
+    StorageClient/MetaClient hold per host. `timeout` applies only if
+    this address's connection pool doesn't exist yet."""
+    return RpcClient(addr, service, timeout=timeout)

@@ -44,6 +44,7 @@ def _register_defaults() -> None:
     from ..common.status import ErrorCode, Status, StatusOr
     from ..codec.schema import PropType, Schema, SchemaField
     from ..graph.context import ExecutionResponse
+    from ..kvstore.raftex import types as rt
     from ..meta.service import HostInfo, SpaceDesc
     from ..storage import types as st
     register(ErrorCode, Status, StatusOr, PropType, SchemaField, Schema,
@@ -51,7 +52,12 @@ def _register_defaults() -> None:
              st.PartResult, st.EdgeData, st.VertexData, st.BoundRequest,
              st.BoundResponse, st.PropsResponse, st.ExecResponse,
              st.NewVertex, st.NewEdge, st.EdgeKey, st.UpdateItemReq,
-             st.UpdateResponse, st.StatDef, st.StatsResponse)
+             st.UpdateResponse, st.StatDef, st.StatsResponse,
+             # raft consensus messages (the reference's raftex.thrift)
+             rt.RaftCode, rt.LogType, rt.LogRecord,
+             rt.AskForVoteRequest, rt.AskForVoteResponse,
+             rt.AppendLogRequest, rt.AppendLogResponse,
+             rt.SendSnapshotRequest, rt.SendSnapshotResponse)
 
 
 def _zigzag(n: int) -> int:

@@ -91,10 +91,19 @@ class StorageClient:
             futures = []
             for host, host_parts in by_host.items():
                 svc = self._hosts[host]
-                futures.append(self._pool.submit(call, svc, host_parts))
+                futures.append((host_parts,
+                                self._pool.submit(call, svc, host_parts)))
             round_resp = empty_resp.__class__()
-            for fut in futures:
-                merge(round_resp, fut.result())
+            dead_parts: list = []
+            for host_parts, fut in futures:
+                try:
+                    merge(round_resp, fut.result())
+                except Exception:
+                    # dead/unreachable host: treat its parts like a
+                    # hintless leader change (failover to another
+                    # replica; the reference's client rotates the same
+                    # way when a storaged dies mid-request)
+                    dead_parts.extend(host_parts)
             merge(resp, round_resp)
             # parts that hit a stale leader: update cache and retry them;
             # with no leader hint (election in progress / dead host),
@@ -104,6 +113,14 @@ class StorageClient:
             saw_hintless = False
             saw_no_part = False
             space_known = None  # one catalog probe per round, lazily
+            for part in dead_parts:
+                if part not in parts:
+                    continue
+                saw_hintless = True
+                prev = tried.get(part, hosts_list[0])
+                idx = (hosts_list.index(prev) + 1) % len(hosts_list)
+                self._leader_cache[(space_id, part)] = hosts_list[idx]
+                pending[part] = parts[part]
             for part, result in round_resp.results.items():
                 if result.code == ErrorCode.E_LEADER_CHANGED and part in parts:
                     if result.leader:
@@ -134,6 +151,11 @@ class StorageClient:
                 time.sleep(0.2)
             elif saw_hintless:
                 time.sleep(0.05)   # election likely in progress
+        # parts still unreachable after every retry must surface as
+        # errors — a missing entry would read as success to executors
+        for part in pending:
+            resp.results.setdefault(
+                part, PartResult(ErrorCode.E_HOST_NOT_FOUND, None))
         return resp
 
     # ------------------------------------------------------------------

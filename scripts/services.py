@@ -80,10 +80,12 @@ def start(args) -> int:
         if pid0 and _alive(pid0):
             print(f"{name} already running")
             continue
+        extra_s = ["--replicated"] if args.replicated else []
         pid = _spawn(args.run_dir, name, "nebula_tpu.daemons.storaged",
                      ["--meta", meta_addr, "--host", args.host,
-                      "--port", str(args.storaged_port + i),
-                      "--ws-port", str(12000 + i), *ff("storaged")])
+                      "--port", str(args.storaged_port +
+                                    i * (10 if args.replicated else 1)),
+                      "--ws-port", str(12000 + i), *extra_s, *ff("storaged")])
         started.append((name, pid))
     time.sleep(0.5)
     pid0 = _read_pid(args.run_dir, "graphd")
@@ -158,6 +160,9 @@ def main(argv=None) -> int:
     ap.add_argument("--storaged-count", type=int, default=1)
     ap.add_argument("--tpu", action="store_true",
                     help="enable the TPU engine in graphd")
+    ap.add_argument("--replicated", action="store_true",
+                    help="raft-replicate storaged parts (raft on port+1; "
+                         "storaged ports are spaced by 10)")
     args = ap.parse_args(argv)
     if args.action == "start":
         return start(args)

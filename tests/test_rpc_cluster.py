@@ -160,3 +160,71 @@ def test_second_graphd_same_meta(cluster):
         assert r.rows[0][1] == "a"
     finally:
         g2.stop()
+
+
+# ---------------------------------------------------------------------------
+# raft replication over real TCP (RpcTransport — the port+1 raft servers)
+# ---------------------------------------------------------------------------
+
+def test_replicated_cluster_failover(tmp_path):
+    """3 replicated storaged over TCP raft: writes survive killing the
+    leader replica (ref: parallel-raft failover + client E_LEADER_CHANGED
+    retry, storage/client/StorageClient.inl:119-134)."""
+    metad = serve_metad()
+    storers = [serve_storaged(metad.addr, replicated=True,
+                              data_dir=str(tmp_path / f"s{i}"))
+               for i in range(3)]
+    graphd = serve_graphd(metad.addr)
+    gc = GraphClient(graphd.addr).connect()
+    try:
+        for s in ("CREATE SPACE rf(partition_num=2, replica_factor=3)",
+                  "USE rf", "CREATE TAG t(x int)"):
+            r = gc.execute(s)
+            assert r.ok(), (s, r.error_msg)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            r = gc.execute("INSERT VERTEX t(x) VALUES 1:(10)")
+            if r.ok():
+                break
+            time.sleep(0.2)  # raft elections in progress
+        assert r.ok(), r.error_msg
+        r = gc.execute("FETCH PROP ON t 1 YIELD t.x")
+        assert r.ok() and r.rows[0][-1] == 10
+
+        # find and kill the replica leading part of vid 2's partition
+        space_id = metad.meta.get_space("rf").value().space_id
+        from nebula_tpu.common import keys as ku
+        part = ku.part_id(2, 2)
+        leader_idx = None
+        deadline = time.time() + 10
+        while leader_idx is None and time.time() < deadline:
+            for i, h in enumerate(storers):
+                raft = h.node.raft(space_id, part)
+                if raft is not None and raft.is_leader():
+                    leader_idx = i
+            if leader_idx is None:
+                time.sleep(0.1)   # this part's election still running
+        assert leader_idx is not None
+        storers[leader_idx].stop()
+
+        # the client must fail over to the new leader and keep serving
+        deadline = time.time() + 15
+        ok = False
+        while time.time() < deadline:
+            r = gc.execute("INSERT VERTEX t(x) VALUES 2:(20)")
+            if r.ok():
+                ok = True
+                break
+            time.sleep(0.25)
+        assert ok, f"no failover: {r.error_msg}"
+        r = gc.execute("FETCH PROP ON t 2 YIELD t.x")
+        assert r.ok() and r.rows[0][-1] == 20
+    finally:
+        graphd.stop()
+        for i, h in enumerate(storers):
+            if i != (leader_idx if 'leader_idx' in dir() else -1):
+                try:
+                    h.stop()
+                except Exception:
+                    pass
+        metad.stop()
