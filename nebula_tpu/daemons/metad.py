@@ -36,6 +36,16 @@ class MetadHandle:
 def serve_metad(host: str = "127.0.0.1", port: int = 0,
                 ws_port: Optional[int] = None) -> MetadHandle:
     meta = MetaService()
+    # metad hosts the balancer; it drives replicated storaged through
+    # their "admin" RPC services (ref: Balancer + AdminClient in metad)
+    from ..meta.balancer import Balancer
+    from ..meta.net_admin import NetAdminClient
+    def active_storage_hosts():
+        return [h.host for h in meta.active_hosts("storage")]
+
+    admin = NetAdminClient(active_storage_hosts)
+    meta.attach_balancer(Balancer(meta, admin,
+                                  get_active_hosts=active_storage_hosts))
     server = RpcServer(host, port).register("meta", meta).start()
     web = None
     if ws_port is not None:

@@ -103,13 +103,6 @@ def _register_admin_handlers(web: WebService, storage: StorageService) -> None:
     web.register("/ingest", ingest)
 
 
-def _raft_addr(storage_addr: str) -> str:
-    """Raft listens on storage port + 1, the reference convention
-    (NebulaStore::getRaftAddr, kvstore/NebulaStore.h:55-60)."""
-    h, p = storage_addr.rsplit(":", 1)
-    return f"{h}:{int(p) + 1}"
-
-
 def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
                    port: int = 0, ws_port: Optional[int] = None,
                    load_interval: float = 0.2,
@@ -125,15 +118,11 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
         # node's RaftexService; peers reach it via RpcTransport
         from ..kvstore.raft_store import StorageNode
         from ..kvstore.raftex.service import RpcTransport
+        from ..meta.net_admin import raft_addr_of, storage_addr_of
         import tempfile
         raft_server = RpcServer(host, int(addr.rsplit(":", 1)[1]) + 1)
-
-        def storage_addr_of(raft_addr: str) -> str:
-            h, p = raft_addr.rsplit(":", 1)
-            return f"{h}:{int(p) - 1}"
-
         raft_net = RpcTransport()
-        node = StorageNode(addr=_raft_addr(addr),
+        node = StorageNode(addr=raft_addr_of(addr),
                            data_root=data_dir or tempfile.mkdtemp(
                                prefix="nebula_tpu_storaged_"),
                            net=raft_net,
@@ -152,10 +141,10 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
         if event in ("space_added", "parts_added"):
             for p in kw.get("parts", []):
                 if node is not None:
-                    peers = [_raft_addr(h) for h in
+                    peers = [raft_addr_of(h) for h in
                              mc.part_peers(kw["space_id"], p)]
                     node.add_part(kw["space_id"], p, peers or
-                                  [_raft_addr(addr)])
+                                  [raft_addr_of(addr)])
                 else:
                     store.add_part(kw["space_id"], p)
         elif event == "parts_removed":
@@ -177,7 +166,13 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
     mc.start(load_interval=load_interval)
     sm = SchemaManager(mc)
     storage = StorageService(store, sm, host=addr)
-    server.register("storage", storage).start()
+    server.register("storage", storage)
+    if node is not None:
+        # part-admin surface the meta balancer drives (ref:
+        # storaged's AdminProcessor)
+        from ..meta.net_admin import AdminService
+        server.register("admin", AdminService(node))
+    server.start()
     web = None
     if ws_port is not None:
         web = WebService("storaged", flags=storage_flags, stats=stats,

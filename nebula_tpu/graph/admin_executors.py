@@ -187,10 +187,31 @@ def execute_config(ctx: ExecContext, s: ast.ConfigSentence) -> Result:
     return _err(ErrorCode.E_UNSUPPORTED, s.action)
 
 
+class _MetaBalancerProxy:
+    """BALANCE in a deployed cluster: graphd holds no balancer — the
+    statement forwards to the metad-hosted one over the meta RPC
+    surface (ref: BalanceProcessor)."""
+
+    def __init__(self, meta):
+        self._meta = meta
+
+    def leader_balance(self):
+        return self._meta.balance_leader()
+
+    def balance(self, remove_hosts=()):
+        return self._meta.balance_data(list(remove_hosts))
+
+    def show_plan(self, plan_id=None):
+        return self._meta.balance_show(plan_id)
+
+    def stop(self):
+        return self._meta.balance_stop()
+
+
 def execute_balance(ctx: ExecContext, s: ast.BalanceSentence) -> Result:
     balancer = getattr(ctx.engine, "balancer", None)
     if balancer is None:
-        return _err(ErrorCode.E_UNSUPPORTED, "balancer not available")
+        balancer = _MetaBalancerProxy(ctx.meta)
     if s.sub == "LEADER":
         st = balancer.leader_balance()
         if not st.ok():
@@ -202,10 +223,9 @@ def execute_balance(ctx: ExecContext, s: ast.BalanceSentence) -> Result:
             return StatusOr.from_status(r.status)
         return _ok(InterimResult(["ID"], [(r.value(),)]))
     if s.sub == "SHOW":
-        r = balancer.show_plan(s.plan_id)
-        if not r.ok():
-            return StatusOr.from_status(r.status)
-        return _ok(InterimResult(["balance task", "status"], r.value()))
+        rows = balancer.show_plan(s.plan_id)
+        return _ok(InterimResult(
+            ["plan", "space", "part", "src", "dst", "status"], rows))
     if s.sub == "STOP":
         st = balancer.stop()
         if not st.ok():

@@ -53,8 +53,11 @@ class RpcTransport(Transport):
         def run():
             from ...rpc import proxy
             try:
-                return proxy(to_addr, "raftex",
-                             timeout=self._timeout).call(method, req)
+                # max_attempts=2: one stale-socket drain + one fresh
+                # connect — a black-holed peer costs ~1 timeout, not a
+                # whole pool drain
+                return proxy(to_addr, "raftex", timeout=self._timeout,
+                             max_attempts=2).call(method, req)
             except Exception:
                 return _unreachable_response(method)
         return self._pool.submit(run)

@@ -1,0 +1,219 @@
+"""Part-admin over the network: storaged-side AdminService + metad-side
+NetAdminClient.
+
+Role parity with the reference's storage AdminProcessor (transLeader/
+addPart/addLearner/waitingForCatchUpData/memberChange/removePart,
+storage/AdminProcessor.h) driven by the meta Balancer through
+AdminClient RPC fan-out (meta/processors/admin/AdminClient). Addresses
+crossing this boundary are STORAGE addrs; each side converts to raft
+addrs with the port+1 convention locally.
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..common.status import ErrorCode, Status
+from ..rpc import proxy
+
+
+def raft_addr_of(storage_addr: str) -> str:
+    """Raft listens on storage port + 1 (the reference's getRaftAddr
+    convention, kvstore/NebulaStore.h:55-60). THE single home of the
+    conversion — the inverse lives right below."""
+    h, p = storage_addr.rsplit(":", 1)
+    return f"{h}:{int(p) + 1}"
+
+
+def storage_addr_of(raft_addr: str) -> str:
+    h, p = raft_addr.rsplit(":", 1)
+    return f"{h}:{int(p) - 1}"
+
+
+class AdminService:
+    """Registered as the "admin" service on a replicated storaged's RPC
+    server; operates on the local StorageNode."""
+
+    def __init__(self, node):
+        self._node = node
+
+    def add_part(self, space_id: int, part_id: int,
+                 peers_storage: List[str], as_learner: bool) -> bool:
+        self._node.add_part(space_id, part_id,
+                            [raft_addr_of(p) for p in peers_storage],
+                            as_learner=as_learner)
+        return True
+
+    def remove_part(self, space_id: int, part_id: int) -> bool:
+        self._node.remove_part(space_id, part_id)
+        return True
+
+    def raft_state(self, space_id: int, part_id: int) -> Optional[Dict]:
+        r = self._node.raft(space_id, part_id)
+        if r is None:
+            return None
+        return {"is_leader": r.is_leader(), "term": r.term,
+                "committed": r.committed_id, "role": r.role.name}
+
+    # leader-only raft membership ops (the balancer routes these to the
+    # host it believes leads; a non-leader returns False and the caller
+    # re-resolves)
+    def add_learner(self, space_id: int, part_id: int,
+                    learner_storage: str) -> bool:
+        r = self._node.raft(space_id, part_id)
+        if r is None or not r.is_leader():
+            return False
+        from ..kvstore.raftex import RaftCode
+        return r.add_learner_async(
+            raft_addr_of(learner_storage)).result(timeout=5) is RaftCode.SUCCEEDED
+
+    def member_add(self, space_id: int, part_id: int,
+                   target_storage: str) -> bool:
+        r = self._node.raft(space_id, part_id)
+        if r is None or not r.is_leader():
+            return False
+        from ..kvstore.raftex import RaftCode
+        return r.add_peer_async(
+            raft_addr_of(target_storage)).result(timeout=5) is RaftCode.SUCCEEDED
+
+    def member_remove(self, space_id: int, part_id: int,
+                      target_storage: str) -> bool:
+        r = self._node.raft(space_id, part_id)
+        if r is None or not r.is_leader():
+            return False
+        from ..kvstore.raftex import RaftCode
+        return r.remove_peer_async(
+            raft_addr_of(target_storage)).result(timeout=5) is RaftCode.SUCCEEDED
+
+    def trans_leader(self, space_id: int, part_id: int,
+                     target_storage: str) -> bool:
+        r = self._node.raft(space_id, part_id)
+        if r is None or not r.is_leader():
+            return False
+        r.transfer_leader_async(raft_addr_of(target_storage))
+        return True
+
+
+class NetAdminClient:
+    """The Balancer's admin surface over storaged "admin" RPC services —
+    same method contract as kvstore.raft_store.AdminClient, usable from
+    inside metad."""
+
+    def __init__(self, get_hosts: Callable[[], List[str]]):
+        self._get_hosts = get_hosts
+
+    def _svc(self, addr: str):
+        return proxy(addr, "admin", timeout=5.0)
+
+    def ready(self) -> Status:
+        """Every active storaged must expose the admin service (i.e. run
+        --replicated) before a balance plan can execute — otherwise the
+        plan would return a success-looking id and fail asynchronously."""
+        hosts = self._get_hosts()
+        if not hosts:
+            return Status.error(ErrorCode.E_NO_HOSTS, "no active storaged")
+        for h in hosts:
+            try:
+                self._svc(h).raft_state(0, 0)
+            except Exception:
+                return Status.error(
+                    ErrorCode.E_UNSUPPORTED,
+                    f"storaged {h} has no admin service "
+                    f"(balance requires --replicated storaged)")
+        return Status.OK()
+
+    def _state(self, addr: str, space_id: int, part_id: int) -> Optional[Dict]:
+        try:
+            return self._svc(addr).raft_state(space_id, part_id)
+        except Exception:
+            return None
+
+    def _leader_host(self, space_id: int, part_id: int,
+                     timeout: float = 5.0) -> str:
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            for h in self._get_hosts():
+                st = self._state(h, space_id, part_id)
+                if st and st["is_leader"]:
+                    return h
+            time.sleep(0.05)
+        raise TimeoutError(f"no leader for ({space_id},{part_id})")
+
+    # ----------------------------------------------------- AdminClient API
+    def leader_of(self, space_id: int, part_id: int,
+                  timeout: float = 5.0) -> str:
+        return self._leader_host(space_id, part_id, timeout)
+
+    def add_part(self, addr: str, space_id: int, part_id: int,
+                 peers: List[str], as_learner: bool) -> None:
+        self._svc(addr).add_part(space_id, part_id, peers, as_learner)
+
+    def add_learner(self, space_id: int, part_id: int, learner: str) -> bool:
+        try:
+            leader = self._leader_host(space_id, part_id)
+            return self._svc(leader).add_learner(space_id, part_id, learner)
+        except (TimeoutError, Exception):
+            return False
+
+    def wait_catchup(self, space_id: int, part_id: int, target: str,
+                     timeout: float = 10.0) -> bool:
+        try:
+            leader = self._leader_host(space_id, part_id)
+            goal = (self._state(leader, space_id, part_id) or {}).get(
+                "committed", 0)
+        except TimeoutError:
+            return False
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            st = self._state(target, space_id, part_id)
+            if st is not None and st["committed"] >= goal:
+                return True
+            time.sleep(0.05)
+        return False
+
+    def member_add(self, space_id: int, part_id: int, addr: str) -> bool:
+        try:
+            leader = self._leader_host(space_id, part_id)
+            return self._svc(leader).member_add(space_id, part_id, addr)
+        except (TimeoutError, Exception):
+            return False
+
+    def member_remove(self, space_id: int, part_id: int, addr: str) -> bool:
+        try:
+            leader = self._leader_host(space_id, part_id)
+            return self._svc(leader).member_remove(space_id, part_id, addr)
+        except (TimeoutError, Exception):
+            return False
+
+    def trans_leader(self, space_id: int, part_id: int, target: str,
+                     timeout: float = 5.0) -> bool:
+        try:
+            leader = self._leader_host(space_id, part_id)
+            if leader == target:
+                return True
+            self._svc(leader).trans_leader(space_id, part_id, target)
+        except (TimeoutError, Exception):
+            return False
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            st = self._state(target, space_id, part_id)
+            if st and st["is_leader"]:
+                return True
+            time.sleep(0.05)
+        return False
+
+    def remove_part(self, addr: str, space_id: int, part_id: int) -> None:
+        try:
+            self._svc(addr).remove_part(space_id, part_id)
+        except Exception:
+            pass  # host already gone: nothing to remove
+
+    def leader_map(self, space_id: int,
+                   parts: List[int]) -> Dict[int, Optional[str]]:
+        out: Dict[int, Optional[str]] = {}
+        for p in parts:
+            try:
+                out[p] = self.leader_of(space_id, p, timeout=2.0)
+            except TimeoutError:
+                out[p] = None
+        return out

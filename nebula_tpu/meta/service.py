@@ -569,6 +569,46 @@ class MetaService:
             out.append((info, now - info.last_hb < self._expired_threshold))
         return out
 
+    # ------------------------------------------------------------------
+    # balancer facade (ref: BalanceProcessor — BALANCE statements reach
+    # the meta-hosted Balancer through the meta RPC surface)
+    # ------------------------------------------------------------------
+    def attach_balancer(self, balancer) -> None:
+        self._balancer = balancer
+
+    def _bal(self):
+        return getattr(self, "_balancer", None)
+
+    def balance_data(self, remove_hosts: List[str] = ()) -> StatusOr[int]:
+        b = self._bal()
+        if b is None:
+            return StatusOr.err(ErrorCode.E_UNSUPPORTED,
+                                "balancer not available")
+        ready = getattr(b.admin, "ready", None)
+        if ready is not None:
+            st = ready()
+            if not st.ok():
+                return StatusOr.from_status(st)
+        return b.balance(remove_hosts=tuple(remove_hosts))
+
+    def balance_leader(self) -> Status:
+        b = self._bal()
+        if b is None:
+            return Status.error(ErrorCode.E_UNSUPPORTED,
+                                "balancer not available")
+        return b.leader_balance()
+
+    def balance_show(self, plan_id: Optional[int] = None) -> List[List]:
+        b = self._bal()
+        return [] if b is None else b.show_plan(plan_id)
+
+    def balance_stop(self) -> Status:
+        b = self._bal()
+        if b is None:
+            return Status.error(ErrorCode.E_UNSUPPORTED,
+                                "balancer not available")
+        return b.stop()
+
 
 def _pw_hash(password: str) -> str:
     import hashlib

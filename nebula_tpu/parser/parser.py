@@ -693,6 +693,10 @@ class GQLParser:
         self._expect("BALANCE")
         if self._accept("LEADER"):
             return ast.BalanceSentence("LEADER")
+        if self._accept("PLAN"):
+            # BALANCE PLAN [id]: show the (persisted) plan's tasks
+            pid = self._expect(T_INT).value if self._at(T_INT) else None
+            return ast.BalanceSentence("SHOW", plan_id=pid)
         self._expect("DATA")
         if self._at(T_INT):
             return ast.BalanceSentence("SHOW", plan_id=self._expect(T_INT).value)

@@ -64,6 +64,7 @@ class RpcServer:
         self._services: Dict[str, Any] = {}
         self._conns: set = set()
         self._conns_lock = threading.Lock()
+        self._stopping = False
         outer = self
 
         class Handler(socketserver.BaseRequestHandler):
@@ -71,6 +72,13 @@ class RpcServer:
                 sock = self.request
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                 with outer._conns_lock:
+                    if outer._stopping:
+                        # accepted in the shutdown window: go silent
+                        try:
+                            sock.close()
+                        except OSError:
+                            pass
+                        return
                     outer._conns.add(sock)
                 try:
                     while True:
@@ -120,6 +128,8 @@ class RpcServer:
         return self
 
     def stop(self) -> None:
+        with self._conns_lock:
+            self._stopping = True   # handlers mid-accept close themselves
         self._server.shutdown()
         self._server.server_close()
         # kill established connections too — a stopped daemon must go
@@ -202,7 +212,8 @@ class RpcClient:
     _pools_lock = threading.Lock()
 
     def __init__(self, addr: str, service: str,
-                 timeout: Optional[float] = None):
+                 timeout: Optional[float] = None,
+                 max_attempts: Optional[int] = None):
         host, port_s = addr.rsplit(":", 1)
         self._key = (host, int(port_s))
         self.addr = addr
@@ -213,13 +224,17 @@ class RpcClient:
                     host, int(port_s),
                     timeout=timeout if timeout is not None else 30.0)
         self._pool = RpcClient._pools[self._key]
+        # low-latency callers (raft) cap the stale-socket drain so a
+        # black-holed peer costs ~1 timeout, not pool_size timeouts
+        self._max_attempts = max_attempts
 
     def call(self, method: str, *args, **kwargs) -> Any:
         payload = wire.encode((self.service, method, tuple(args), kwargs))
         last_err: Optional[Exception] = None
         # after a server restart every pooled socket may be stale; allow
         # draining the whole pool plus one fresh connect
-        for _ in range(self._pool._size + 1):
+        attempts = self._max_attempts or (self._pool._size + 1)
+        for _ in range(attempts):
             sock = self._pool.acquire()
             try:
                 _send_frame(sock, payload)
@@ -242,10 +257,11 @@ class RpcClient:
         return lambda *args, **kwargs: self.call(name, *args, **kwargs)
 
 
-def proxy(addr: str, service: str,
-          timeout: Optional[float] = None) -> RpcClient:
+def proxy(addr: str, service: str, timeout: Optional[float] = None,
+          max_attempts: Optional[int] = None) -> RpcClient:
     """A client whose attribute calls mirror the remote service's
     methods — drop-in for the in-proc service objects that
     StorageClient/MetaClient hold per host. `timeout` applies only if
     this address's connection pool doesn't exist yet."""
-    return RpcClient(addr, service, timeout=timeout)
+    return RpcClient(addr, service, timeout=timeout,
+                     max_attempts=max_attempts)

@@ -142,6 +142,10 @@ class StorageClient:
                         space_known = self._space_exists(space_id)
                     if space_known:
                         saw_no_part = True
+                        # the part may have MOVED (balance): drop the
+                        # cached leader so routing re-consults the meta
+                        # allocation
+                        self._leader_cache.pop((space_id, part), None)
                         pending[part] = parts[part]
             if not pending:
                 break

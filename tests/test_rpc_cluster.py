@@ -228,3 +228,80 @@ def test_replicated_cluster_failover(tmp_path):
                 except Exception:
                     pass
         metad.stop()
+
+
+def test_balance_data_over_network(tmp_path):
+    """BALANCE DATA in a deployed cluster: graphd forwards to the
+    metad-hosted balancer, which moves parts onto a newly joined
+    storaged through the storage admin RPC services (ref: Balancer +
+    AdminClient + storaged AdminProcessor)."""
+    metad = serve_metad()
+    s0 = serve_storaged(metad.addr, replicated=True,
+                        data_dir=str(tmp_path / "s0"))
+    graphd = serve_graphd(metad.addr)
+    gc = GraphClient(graphd.addr).connect()
+    s1 = None
+    try:
+        for stmt in ("CREATE SPACE bal(partition_num=4, replica_factor=1)",
+                     "USE bal", "CREATE TAG t(x int)"):
+            r = gc.execute(stmt)
+            assert r.ok(), (stmt, r.error_msg)
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            r = gc.execute("INSERT VERTEX t(x) VALUES 1:(1), 2:(2), 3:(3), 4:(4)")
+            if r.ok():
+                break
+            time.sleep(0.2)
+        assert r.ok(), r.error_msg
+
+        space_id = metad.meta.get_space("bal").value().space_id
+        assert all(h == [s0.addr] for h in
+                   metad.meta.get_parts_alloc(space_id).values())
+
+        # a second storaged joins; BALANCE DATA spreads parts onto it
+        s1 = serve_storaged(metad.addr, replicated=True,
+                            data_dir=str(tmp_path / "s1"))
+        time.sleep(0.3)   # let its heartbeat register
+        r = gc.execute("BALANCE DATA")
+        assert r.ok(), r.error_msg
+        metad.meta._balancer.wait(30)
+        alloc = metad.meta.get_parts_alloc(space_id)
+        on_s1 = [p for p, hosts in alloc.items() if s1.addr in hosts]
+        assert len(on_s1) == 2, alloc  # 4 parts -> 2 each
+
+        # every task reached SUCCEEDED in the persisted plan
+        tasks = metad.meta.balance_show()
+        assert tasks and all(t[-1] == "SUCCEEDED" for t in tasks), tasks
+
+        # data still all reachable after the moves
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            r = gc.execute("FETCH PROP ON t 1,2,3,4 YIELD t.x")
+            if r.ok() and len(r.rows) == 4:
+                break
+            time.sleep(0.25)
+        assert r.ok() and sorted(x[-1] for x in r.rows) == [1, 2, 3, 4], \
+            (r.rows, r.error_msg)
+    finally:
+        graphd.stop()
+        s0.stop()
+        if s1 is not None:
+            s1.stop()
+        metad.stop()
+
+
+def test_balance_refused_on_non_replicated_cluster():
+    """BALANCE DATA on a non-replicated cluster fails loudly instead of
+    returning a plan whose tasks all fail asynchronously."""
+    metad = serve_metad()
+    s0 = serve_storaged(metad.addr)   # no --replicated: no admin service
+    graphd = serve_graphd(metad.addr)
+    gc = GraphClient(graphd.addr).connect()
+    try:
+        r = gc.execute("CREATE SPACE nb(partition_num=2)")
+        assert r.ok(), r.error_msg
+        r = gc.execute("BALANCE DATA")
+        assert not r.ok()
+        assert "replicated" in r.error_msg or "admin" in r.error_msg
+    finally:
+        graphd.stop(); s0.stop(); metad.stop()
