@@ -107,10 +107,45 @@ int64_t nkv_scan_range(nkv *e, const uint8_t *s, int64_t slen,
 int64_t nkv_scan_prefix_dedup(nkv *e, const uint8_t *p, int64_t plen,
                               int32_t group_suffix,
                               uint8_t **out, int64_t *n_out);
+/* Columnar scan: all keys in one blob + all values in another, with
+ * per-item u32 length arrays (n entries each). Returns item count (or
+ * -1 on alloc failure); caller frees all four buffers via nkv_buf_free
+ * (klens/vlens cast to uint8_t*). Empty scans return 0 with NULL
+ * buffers. The CSR snapshot builder's scan path. */
+int64_t nkv_scan_prefix_cols(nkv *e, const uint8_t *p, int64_t plen,
+                             uint8_t **keys_out, int64_t *keys_len,
+                             uint8_t **vals_out, int64_t *vals_len,
+                             uint32_t **klens_out, uint32_t **vlens_out);
 void nkv_buf_free(uint8_t *buf);
 
 /* Persist a point-in-time checkpoint (atomic rename). */
 int32_t nkv_checkpoint(nkv *e, const char *path);
+
+/* ----------------------------------------------------- CSR extraction
+ * One-call pass-1 CSR snapshot build over the engine's graph keys
+ * (layout: nebula_tpu/common/keys.py): per part 1..num_parts, scans
+ * vertex and edge ranges with newest-version dedup + tombstone skip,
+ * parses key fields, assembles sorted-unique per-part vid sets
+ * (vertex rows + edge srcs + incoming dsts) and resolves local
+ * indices. want_values != 0 retains row values for property decode.
+ * Accessor pointers stay valid until ncsr_free; part0 is 0-based. */
+typedef struct ncsr ncsr;
+
+ncsr *ncsr_build(nkv *e, int32_t num_parts, int32_t want_values);
+void ncsr_free(ncsr *b);
+int64_t ncsr_vids(ncsr *b, int32_t part0, const int64_t **vids);
+int64_t ncsr_edges(ncsr *b, int32_t part0, const int32_t **src_local,
+                   const int32_t **etype, const int64_t **rank,
+                   const int64_t **dst_vid, const int32_t **dst_part,
+                   const int32_t **dst_local);
+int64_t ncsr_edge_vals(ncsr *b, int32_t part0, const uint8_t **blob,
+                       int64_t *blob_len, const int64_t **offs,
+                       const int32_t **lens);
+int64_t ncsr_vert_rows(ncsr *b, int32_t part0, const int32_t **local,
+                       const int32_t **tag);
+int64_t ncsr_vert_vals(ncsr *b, int32_t part0, const uint8_t **blob,
+                       int64_t *blob_len, const int64_t **offs,
+                       const int32_t **lens);
 
 /* ------------------------------------------------------------- codec */
 

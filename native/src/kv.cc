@@ -15,12 +15,16 @@
 
 #include "nebula_native.h"
 
+#include <algorithm>
+#include <atomic>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <functional>
 #include <map>
 #include <mutex>
 #include <string>
+#include <thread>
 #include <vector>
 
 namespace {
@@ -328,10 +332,329 @@ int64_t nkv_scan_prefix_dedup(nkv *e, const uint8_t *p, int64_t plen,
   return pack_out(hits, out, n_out);
 }
 
+int64_t nkv_scan_prefix_cols(nkv *e, const uint8_t *p, int64_t plen,
+                             uint8_t **keys_out, int64_t *keys_len,
+                             uint8_t **vals_out, int64_t *vals_len,
+                             uint32_t **klens_out, uint32_t **vlens_out) {
+  // Columnar scan for the CSR snapshot builder: keys and values land in
+  // two contiguous blobs plus per-item length arrays, so Python sees
+  // exactly four buffers (numpy-viewable) instead of 2N bytes objects.
+  std::lock_guard<std::mutex> g(e->mu);
+  std::string prefix(reinterpret_cast<const char *>(p), plen);
+  std::string end = next_prefix(prefix);
+  auto lo = e->data.lower_bound(prefix);
+  auto hi = end.empty() ? e->data.end() : e->data.lower_bound(end);
+  int64_t n = 0, kbytes = 0, vbytes = 0;
+  for (auto it = lo; it != hi; ++it) {
+    ++n;
+    kbytes += static_cast<int64_t>(it->first.size());
+    vbytes += static_cast<int64_t>(it->second.size());
+  }
+  *keys_len = kbytes;
+  *vals_len = vbytes;
+  if (n == 0) {
+    *keys_out = *vals_out = nullptr;
+    *klens_out = *vlens_out = nullptr;
+    return 0;
+  }
+  uint8_t *kb = static_cast<uint8_t *>(malloc(kbytes ? kbytes : 1));
+  uint8_t *vb = static_cast<uint8_t *>(malloc(vbytes ? vbytes : 1));
+  uint32_t *kl = static_cast<uint32_t *>(malloc(n * sizeof(uint32_t)));
+  uint32_t *vl = static_cast<uint32_t *>(malloc(n * sizeof(uint32_t)));
+  if (!kb || !vb || !kl || !vl) {
+    free(kb); free(vb); free(kl); free(vl);
+    return -1;
+  }
+  int64_t ko = 0, vo = 0, i = 0;
+  for (auto it = lo; it != hi; ++it, ++i) {
+    memcpy(kb + ko, it->first.data(), it->first.size());
+    kl[i] = static_cast<uint32_t>(it->first.size());
+    ko += static_cast<int64_t>(it->first.size());
+    memcpy(vb + vo, it->second.data(), it->second.size());
+    vl[i] = static_cast<uint32_t>(it->second.size());
+    vo += static_cast<int64_t>(it->second.size());
+  }
+  *keys_out = kb;
+  *vals_out = vb;
+  *klens_out = kl;
+  *vlens_out = vl;
+  return n;
+}
+
 void nkv_buf_free(uint8_t *buf) { free(buf); }
 
 int32_t nkv_checkpoint(nkv *e, const char *path) {
   return e->checkpoint(path ? path : e->ckpt_path);
+}
+
+}  // extern "C"
+
+/* ------------------------------------------------------------------ CSR
+ * Pass-1 CSR snapshot extraction (the reference's "storage engine feeds
+ * the traversal layout" role — here the whole scan→dedup→parse→
+ * local-index loop runs in C++, one call per space; ref role:
+ * storage/QueryBaseProcessor.inl:380-458 is the equivalent per-RPC scan).
+ * Key layout: common/keys.py — part u32be | kind u8 | biased big-endian
+ * fields | version u64be. Vertex keys 25 bytes, edge keys 41.
+ */
+
+namespace {
+
+inline uint64_t be64_at(const char *p) {
+  uint64_t v;
+  memcpy(&v, p, 8);
+  return __builtin_bswap64(v);
+}
+
+inline uint32_t be32_at(const char *p) {
+  uint32_t v;
+  memcpy(&v, p, 4);
+  return __builtin_bswap32(v);
+}
+
+inline int64_t unbias64(uint64_t u) {
+  return static_cast<int64_t>(u ^ 0x8000000000000000ull);
+}
+
+inline int32_t unbias32(uint32_t u) {
+  return static_cast<int32_t>(u ^ 0x80000000u);
+}
+
+std::string part_kind_prefix(int32_t part, uint8_t kind) {
+  std::string p(5, '\0');
+  uint32_t be = __builtin_bswap32(static_cast<uint32_t>(part));
+  memcpy(&p[0], &be, 4);
+  p[4] = static_cast<char>(kind);
+  return p;
+}
+
+constexpr size_t kVertKeyLen = 25;
+constexpr size_t kEdgeKeyLen = 41;
+constexpr size_t kVertGroupLen = 17;  // part+kind+vid+tag
+constexpr size_t kEdgeGroupLen = 33;  // part+kind+src+etype+rank+dst
+
+struct DstRef {
+  int64_t dst;
+  int32_t src_part;
+  int32_t idx;
+};
+
+struct ncsr_part_data {
+  std::vector<int64_t> vids;            // sorted unique after build
+  // edges, canonical (scan) order
+  std::vector<int64_t> src_vid, rank, dst_vid;
+  std::vector<int32_t> src_local, etype, dst_part, dst_local;
+  std::string evals;
+  std::vector<int64_t> evoffs;
+  std::vector<int32_t> evlens;
+  // visible vertex rows
+  std::vector<int64_t> vert_vid;
+  std::vector<int32_t> vert_local, vert_tag;
+  std::string vvals;
+  std::vector<int64_t> vvoffs;
+  std::vector<int32_t> vvlens;
+  // this part's edge dsts bucketed by OWNER part (resolution phase)
+  std::vector<std::vector<DstRef>> dst_by_target;
+};
+
+// Parallel loop over partitions (scan and resolution phases are
+// per-part independent; the map is read-only while e->mu is held).
+void parallel_parts(int32_t num_parts,
+                    const std::function<void(int32_t)> &fn) {
+  unsigned hw = std::thread::hardware_concurrency();
+  unsigned n = std::min<unsigned>(hw ? hw : 1,
+                                  static_cast<unsigned>(num_parts));
+  if (n <= 1) {
+    for (int32_t p = 0; p < num_parts; ++p) fn(p);
+    return;
+  }
+  std::atomic<int32_t> next{0};
+  std::vector<std::thread> ts;
+  for (unsigned t = 0; t < n; ++t)
+    ts.emplace_back([&] {
+      int32_t p;
+      while ((p = next.fetch_add(1)) < num_parts) fn(p);
+    });
+  for (auto &t : ts) t.join();
+}
+
+}  // namespace
+
+struct ncsr {
+  std::vector<ncsr_part_data> parts;
+};
+
+extern "C" {
+
+ncsr *ncsr_build(nkv *e, int32_t num_parts, int32_t want_values) {
+  std::lock_guard<std::mutex> g(e->mu);
+  ncsr *b = new ncsr();
+  b->parts.resize(static_cast<size_t>(num_parts));
+  // ---- phase 1: scan + parse + visibility, parallel per part --------
+  parallel_parts(num_parts, [&](int32_t p0) {
+    int32_t p = p0 + 1;
+    ncsr_part_data &P = b->parts[static_cast<size_t>(p0)];
+    P.dst_by_target.resize(static_cast<size_t>(num_parts));
+    {  // vertices: newest (vid, tag) row wins, tombstones invisible
+      std::string pre = part_kind_prefix(p, 0x01);
+      std::string end = next_prefix(pre);
+      auto lo = e->data.lower_bound(pre);
+      auto hi = end.empty() ? e->data.end() : e->data.lower_bound(end);
+      const std::string *prev = nullptr;
+      for (auto it = lo; it != hi; ++it) {
+        const std::string &k = it->first;
+        if (k.size() != kVertKeyLen) continue;
+        if (prev && memcmp(prev->data(), k.data(), kVertGroupLen) == 0)
+          continue;
+        prev = &k;
+        if (it->second.empty()) continue;
+        int64_t vid = unbias64(be64_at(k.data() + 5));
+        P.vert_vid.push_back(vid);
+        P.vert_tag.push_back(unbias32(be32_at(k.data() + 13)));
+        if (P.vids.empty() || P.vids.back() != vid)  // scan is vid-sorted
+          P.vids.push_back(vid);
+        if (want_values) {
+          P.vvoffs.push_back(static_cast<int64_t>(P.vvals.size()));
+          P.vvlens.push_back(static_cast<int32_t>(it->second.size()));
+          P.vvals += it->second;
+        }
+      }
+    }
+    {  // edges: newest (src, etype, rank, dst) row wins
+      std::string pre = part_kind_prefix(p, 0x02);
+      std::string end = next_prefix(pre);
+      auto lo = e->data.lower_bound(pre);
+      auto hi = end.empty() ? e->data.end() : e->data.lower_bound(end);
+      const std::string *prev = nullptr;
+      for (auto it = lo; it != hi; ++it) {
+        const std::string &k = it->first;
+        if (k.size() != kEdgeKeyLen) continue;
+        if (prev && memcmp(prev->data(), k.data(), kEdgeGroupLen) == 0)
+          continue;
+        prev = &k;
+        if (it->second.empty()) continue;
+        int64_t src = unbias64(be64_at(k.data() + 5));
+        int64_t dst = unbias64(be64_at(k.data() + 25));
+        int32_t dp = static_cast<int32_t>(
+            static_cast<uint64_t>(dst) % static_cast<uint64_t>(num_parts));
+        P.dst_by_target[static_cast<size_t>(dp)].push_back(
+            {dst, p0, static_cast<int32_t>(P.dst_vid.size())});
+        P.src_vid.push_back(src);
+        P.etype.push_back(unbias32(be32_at(k.data() + 13)));
+        P.rank.push_back(unbias64(be64_at(k.data() + 17)));
+        P.dst_vid.push_back(dst);
+        P.dst_part.push_back(dp);
+        if (P.vids.empty() || P.vids.back() != src)  // scan is src-sorted
+          P.vids.push_back(src);
+        if (want_values) {
+          P.evoffs.push_back(static_cast<int64_t>(P.evals.size()));
+          P.evlens.push_back(static_cast<int32_t>(it->second.size()));
+          P.evals += it->second;
+        }
+      }
+    }
+    P.dst_local.resize(P.dst_vid.size());
+  });
+  // ---- phase 2: vid sets + local resolution, parallel per OWNER part.
+  // Each worker q merges incoming dsts from every part into q's vid
+  // set, then resolves q's own src/vert locals and every edge whose
+  // dst q owns (disjoint dst_local slots — data-race free).
+  parallel_parts(num_parts, [&](int32_t q) {
+    ncsr_part_data &Q = b->parts[static_cast<size_t>(q)];
+    std::vector<DstRef> incoming;
+    size_t total = 0;
+    for (auto &P : b->parts)
+      total += P.dst_by_target[static_cast<size_t>(q)].size();
+    incoming.reserve(total);
+    for (auto &P : b->parts) {
+      auto &bk = P.dst_by_target[static_cast<size_t>(q)];
+      incoming.insert(incoming.end(), bk.begin(), bk.end());
+    }
+    std::sort(incoming.begin(), incoming.end(),
+              [](const DstRef &a, const DstRef &x) { return a.dst < x.dst; });
+    // destinations get a local slot in their owning partition
+    for (const auto &r : incoming)
+      if (Q.vids.empty() || Q.vids.back() != r.dst) Q.vids.push_back(r.dst);
+    std::sort(Q.vids.begin(), Q.vids.end());
+    Q.vids.erase(std::unique(Q.vids.begin(), Q.vids.end()), Q.vids.end());
+    // src/vert locals: scan order is src-ascending, one merge walk
+    Q.src_local.resize(Q.src_vid.size());
+    size_t vi = 0;
+    for (size_t i = 0; i < Q.src_vid.size(); ++i) {
+      while (Q.vids[vi] < Q.src_vid[i]) ++vi;
+      Q.src_local[i] = static_cast<int32_t>(vi);
+    }
+    Q.vert_local.resize(Q.vert_vid.size());
+    vi = 0;
+    for (size_t i = 0; i < Q.vert_vid.size(); ++i) {
+      while (Q.vids[vi] < Q.vert_vid[i]) ++vi;
+      Q.vert_local[i] = static_cast<int32_t>(vi);
+    }
+    // dst locals for edges landing here (sorted merge)
+    vi = 0;
+    for (const auto &r : incoming) {
+      while (Q.vids[vi] < r.dst) ++vi;
+      b->parts[static_cast<size_t>(r.src_part)]
+          .dst_local[static_cast<size_t>(r.idx)] = static_cast<int32_t>(vi);
+    }
+  });
+  for (auto &P : b->parts) {
+    P.dst_by_target.clear();
+    P.dst_by_target.shrink_to_fit();
+  }
+  return b;
+}
+
+void ncsr_free(ncsr *b) { delete b; }
+
+int64_t ncsr_vids(ncsr *b, int32_t part0, const int64_t **vids) {
+  const auto &P = b->parts[static_cast<size_t>(part0)];
+  *vids = P.vids.data();
+  return static_cast<int64_t>(P.vids.size());
+}
+
+int64_t ncsr_edges(ncsr *b, int32_t part0, const int32_t **src_local,
+                   const int32_t **etype, const int64_t **rank,
+                   const int64_t **dst_vid, const int32_t **dst_part,
+                   const int32_t **dst_local) {
+  const auto &P = b->parts[static_cast<size_t>(part0)];
+  *src_local = P.src_local.data();
+  *etype = P.etype.data();
+  *rank = P.rank.data();
+  *dst_vid = P.dst_vid.data();
+  *dst_part = P.dst_part.data();
+  *dst_local = P.dst_local.data();
+  return static_cast<int64_t>(P.etype.size());
+}
+
+int64_t ncsr_edge_vals(ncsr *b, int32_t part0, const uint8_t **blob,
+                       int64_t *blob_len, const int64_t **offs,
+                       const int32_t **lens) {
+  const auto &P = b->parts[static_cast<size_t>(part0)];
+  *blob = reinterpret_cast<const uint8_t *>(P.evals.data());
+  *blob_len = static_cast<int64_t>(P.evals.size());
+  *offs = P.evoffs.data();
+  *lens = P.evlens.data();
+  return static_cast<int64_t>(P.evlens.size());
+}
+
+int64_t ncsr_vert_rows(ncsr *b, int32_t part0, const int32_t **local,
+                       const int32_t **tag) {
+  const auto &P = b->parts[static_cast<size_t>(part0)];
+  *local = P.vert_local.data();
+  *tag = P.vert_tag.data();
+  return static_cast<int64_t>(P.vert_tag.size());
+}
+
+int64_t ncsr_vert_vals(ncsr *b, int32_t part0, const uint8_t **blob,
+                       int64_t *blob_len, const int64_t **offs,
+                       const int32_t **lens) {
+  const auto &P = b->parts[static_cast<size_t>(part0)];
+  *blob = reinterpret_cast<const uint8_t *>(P.vvals.data());
+  *blob_len = static_cast<int64_t>(P.vvals.size());
+  *offs = P.vvoffs.data();
+  *lens = P.vvlens.data();
+  return static_cast<int64_t>(P.vvlens.size());
 }
 
 }  // extern "C"

@@ -61,7 +61,6 @@ class CsrShard:
     """Host-side CSR for one partition."""
     part_id: int
     vids: np.ndarray                      # int64[nv] sorted; local idx -> vid
-    vid_to_local: Dict[int, int]
     num_edges: int
     # edge arrays, length cap_e (padded tail invalid)
     edge_src: np.ndarray                  # int32 local src index
@@ -123,10 +122,15 @@ class CsrSnapshot:
 
     # ------------------------------------------------------------------
     def locate(self, vid: int) -> Optional[Tuple[int, int]]:
-        """vid -> (0-based part index, local index)."""
+        """vid -> (0-based part index, local index). Binary search over
+        the sorted per-part vid array (no per-vid dict is materialized —
+        snapshots at 10M+ vertices would pay seconds building one)."""
         p = ku.part_id(vid, self.num_parts) - 1
-        loc = self.shards[p].vid_to_local.get(vid)
-        return (p, loc) if loc is not None else None
+        vids = self.shards[p].vids
+        i = int(np.searchsorted(vids, vid))
+        if i < len(vids) and int(vids[i]) == vid:
+            return (p, i)
+        return None
 
     def frontier_from_vids(self, vids: List[int]) -> np.ndarray:
         f = np.zeros((self.num_parts, self.cap_v), dtype=bool)
@@ -181,22 +185,152 @@ class CsrSnapshot:
 
 
 # ---------------------------------------------------------------------------
-# builder
+# builder — vectorized: the keys are fixed-width big-endian with
+# order-preserving biased encodings (common/keys.py), so an entire
+# partition scan parses as ONE numpy structured-dtype view and the
+# newest-version dedup is an adjacent-difference mask. No per-edge
+# Python in pass 1 (the round-1 builder's 4.4 s/M-edge bottleneck).
 # ---------------------------------------------------------------------------
 
-def _decode_rows_newest(engine, prefix: bytes, group_of, parse_key):
-    """Yield (key_fields, value) keeping only the newest version per
-    logical group, skipping tombstones."""
-    last_group = None
+_EDGE_DT = np.dtype([("part", ">u4"), ("kind", "u1"), ("src", ">u8"),
+                     ("etype", ">u4"), ("rank", ">u8"), ("dst", ">u8"),
+                     ("ver", ">u8")])
+_VERT_DT = np.dtype([("part", ">u4"), ("kind", "u1"), ("vid", ">u8"),
+                     ("tag", ">u4"), ("ver", ">u8")])
+_SIGN64 = np.uint64(1 << 63)
+_SIGN32 = np.uint32(1 << 31)
+
+
+def _unbias64(u: np.ndarray) -> np.ndarray:
+    """Biased order-preserving u64 -> signed int64 (keys._i64 inverse)."""
+    return (np.ascontiguousarray(u, np.uint64) ^ _SIGN64).view(np.int64)
+
+
+def _unbias32(u: np.ndarray) -> np.ndarray:
+    return (np.ascontiguousarray(u, np.uint32) ^ _SIGN32).view(np.int32)
+
+
+def _dst_part0(dst: np.ndarray, num_parts: int) -> np.ndarray:
+    """0-based owner partition — uint64-cast modulo, identical to
+    keys.part_id (ref StorageClient.cpp:10-11)."""
+    return (dst.view(np.uint64) % np.uint64(num_parts)).astype(np.int32)
+
+
+class ScanCols:
+    """One partition-kind scan in columnar form: all keys in one blob,
+    value lengths as an array, and values either as one blob + offsets
+    (native engines, the snapshot-sync wire format) or as a list
+    (engines that store Python bytes). Everything downstream is numpy.
+    """
+    __slots__ = ("n", "keys_blob", "vlens", "vals_blob", "voffs",
+                 "vals_list")
+
+    def __init__(self, n, keys_blob, vlens, vals_blob=None, voffs=None,
+                 vals_list=None):
+        self.n = n
+        self.keys_blob = keys_blob
+        self.vlens = vlens
+        self.vals_blob = vals_blob
+        self.voffs = voffs
+        self.vals_list = vals_list
+
+    @classmethod
+    def from_lists(cls, keys: List[bytes], vals: List[bytes]) -> "ScanCols":
+        n = len(keys)
+        vlens = np.fromiter(map(len, vals), np.int64, n)
+        return cls(n, b"".join(keys), vlens, vals_list=vals)
+
+    @classmethod
+    def from_blobs(cls, n: int, keys_blob: bytes, vals_blob: bytes,
+                   vlens: np.ndarray) -> "ScanCols":
+        voffs = np.zeros(n, np.int64)
+        if n > 1:
+            np.cumsum(vlens[:-1], out=voffs[1:])
+        return cls(n, keys_blob, np.asarray(vlens, np.int64), vals_blob,
+                   voffs)
+
+
+class RowsBlock:
+    """Encoded rows selected from a scan, addressed for batch decode:
+    blob + per-row (offset, length) + destination column index."""
+    __slots__ = ("blob", "offs", "lens", "idxs")
+
+    def __init__(self, blob: bytes, offs: np.ndarray, lens: np.ndarray,
+                 idxs: np.ndarray):
+        self.blob = blob
+        self.offs = np.asarray(offs, np.int64)
+        self.lens = np.asarray(lens, np.int32)
+        self.idxs = np.asarray(idxs, np.int32)
+
+    @classmethod
+    def from_pairs(cls, pairs: List[Tuple[int, bytes]]) -> "RowsBlock":
+        n = len(pairs)
+        lens = np.fromiter((len(r) for _, r in pairs), np.int32, n)
+        offs = np.zeros(n, np.int64)
+        if n > 1:
+            np.cumsum(lens[:-1], out=offs[1:])
+        idxs = np.fromiter((i for i, _ in pairs), np.int32, n)
+        return cls(b"".join(r for _, r in pairs), offs, lens, idxs)
+
+    @classmethod
+    def from_scan(cls, scan: ScanCols, scan_idx: np.ndarray,
+                  dest_idx: np.ndarray) -> "RowsBlock":
+        if scan.vals_blob is not None:
+            return cls(scan.vals_blob, scan.voffs[scan_idx],
+                       scan.vlens[scan_idx], dest_idx)
+        vals = list(map(scan.vals_list.__getitem__, scan_idx.tolist()))
+        lens = scan.vlens[scan_idx]
+        offs = np.zeros(len(vals), np.int64)
+        if len(vals) > 1:
+            np.cumsum(lens[:-1], out=offs[1:])
+        return cls(b"".join(vals), offs, lens, dest_idx)
+
+    def __len__(self) -> int:
+        return len(self.idxs)
+
+    def items(self):
+        """(dest index, row bytes) pairs — the Python-codec fallback."""
+        for j in range(len(self.idxs)):
+            o = int(self.offs[j])
+            yield int(self.idxs[j]), self.blob[o:o + int(self.lens[j])]
+
+
+def _scan_cols(engine, prefix: bytes) -> ScanCols:
+    fn = getattr(engine, "scan_cols", None)
+    if fn is not None:
+        return fn(prefix)
+    fn = getattr(engine, "scan_batch", None)
+    if fn is not None:
+        return ScanCols.from_lists(*fn(prefix))
+    keys: List[bytes] = []
+    vals: List[bytes] = []
     for k, v in engine.prefix(prefix):
-        fields = parse_key(k)
-        g = group_of(fields)
-        if g == last_group:
-            continue
-        last_group = g
-        if not v:
-            continue
-        yield fields, v
+        keys.append(k)
+        vals.append(v)
+    return ScanCols.from_lists(keys, vals)
+
+
+def _visible(scan: ScanCols, dt: np.dtype, group_fields: Tuple[str, ...]):
+    """Parse a scan into a structured key array + indices of VISIBLE
+    rows: newest version per logical group (first in key order —
+    versions are decreasing), tombstones dropped.
+    -> (arr | None, vis_idx int64[])"""
+    if scan.n == 0:
+        return None, np.empty(0, np.int64)
+    blob = scan.keys_blob
+    if len(blob) != scan.n * dt.itemsize:
+        raise ValueError(f"mixed key widths under data prefix "
+                         f"({len(blob)} != {scan.n}*{dt.itemsize})")
+    arr = np.frombuffer(blob, dtype=dt)
+    n = len(arr)
+    first = np.ones(n, bool)
+    if n > 1:
+        diff = np.zeros(n - 1, bool)
+        for f in group_fields:
+            col = arr[f]
+            diff |= col[1:] != col[:-1]
+        first[1:] = diff
+    return arr, np.nonzero(first & (scan.vlens > 0))[0]
 
 
 def build_snapshot(store, sm, space_id: int, num_parts: int) -> CsrSnapshot:
@@ -209,51 +343,119 @@ def build_snapshot(store, sm, space_id: int, num_parts: int) -> CsrSnapshot:
     if engine is None:
         raise ValueError(f"space {space_id} not found")
     write_version = engine.write_version
+    shards, cap_v, cap_e, dict_registry = build_shards(
+        _EngineScanSource(engine), sm, space_id, num_parts)
+    snap = CsrSnapshot(space_id, shards, cap_v, cap_e, write_version)
+    snap.str_dicts = dict_registry
+    return snap
+
+
+class _EngineScanSource:
+    """ScanSource over a local KV engine (one engine per space)."""
+
+    def __init__(self, engine):
+        self._engine = engine
+
+    def scan(self, part: int, kind: int) -> ScanCols:
+        return _scan_cols(self._engine, ku.part_data_prefix(part, kind))
+
+    def extract(self, num_parts: int, want_values: bool):
+        """Native one-call pass-1 extraction (ncsr_build) when the
+        engine is the C++ one; None -> caller uses the scan path."""
+        h = getattr(self._engine, "native_handle", None)
+        if h is None:
+            return None
+        from .. import native
+        if not native.available():
+            return None
+        return native.extract_csr(h, num_parts, want_values)
+
+
+def _space_has_props(sm, space_id: int) -> bool:
+    """Any tag/edge schema with fields? (prop-free spaces skip value
+    retention in the native extract entirely)."""
+    for t in sm.all_tag_ids(space_id):
+        r = sm.tag_schema(space_id, t)
+        if r.ok() and r.value().fields:
+            return True
+    for t in sm.all_edge_types(space_id):
+        r = sm.edge_schema(space_id, abs(t))
+        if r.ok() and r.value().fields:
+            return True
+    return False
+
+
+def build_shards(source, sm, space_id: int, num_parts: int
+                 ) -> Tuple[List[CsrShard], int, int, Dict]:
+    """Assemble per-part CsrShards from any ScanSource (an object with
+    `scan(part, kind) -> ScanCols` — local engine or the remote
+    snapshot-sync RPC). A source that also offers `extract()` (native
+    C++ engine) takes the one-call pass-1 path instead.
+    Returns (shards, cap_v, cap_e, str_dicts)."""
+    ex_fn = getattr(source, "extract", None)
+    if ex_fn is not None:
+        ext = ex_fn(num_parts, _space_has_props(sm, space_id))
+        if ext is not None:
+            try:
+                return _build_shards_native(ext, sm, space_id, num_parts)
+            finally:
+                ext.close()
     now = time.time()
+    P = num_parts
 
-    # ---- pass 1: local vid sets + raw edge lists per partition --------
-    per_part_edges: List[List[Tuple[int, int, int, int, bytes]]] = []
-    per_part_vids: List[set] = []
-    for p in range(1, num_parts + 1):
-        vids = set()
-        for (part, vid, tag, ver), v in _decode_rows_newest(
-                engine, ku.part_data_prefix(p, ku.KIND_VERTEX),
-                group_of=lambda f: (f[1], f[2]), parse_key=ku.parse_vertex_key):
-            vids.add(vid)
-        edges = []
-        for (part, src, et, rank, dst, ver), v in _decode_rows_newest(
-                engine, ku.part_data_prefix(p, ku.KIND_EDGE),
-                group_of=lambda f: (f[1], f[2], f[3], f[4]),
-                parse_key=ku.parse_edge_key):
-            vids.add(src)
-            edges.append((src, et, rank, dst, v))
-        per_part_edges.append(edges)
-        per_part_vids.append(vids)
-    # destinations must have a local slot in their own partition
-    for p_edges in per_part_edges:
-        for (_src, _et, _rank, dst, _v) in p_edges:
-            per_part_vids[ku.part_id(dst, num_parts) - 1].add(dst)
+    # ---- pass 1: scan + parse + visibility, all vectorized ------------
+    vert_scans = []   # (arr|None, vis_idx, ScanCols)
+    edge_scans = []
+    for p in range(1, P + 1):
+        vscan = source.scan(p, ku.KIND_VERTEX)
+        varr, vidx = _visible(vscan, _VERT_DT, ("vid", "tag"))
+        vert_scans.append((varr, vidx, vscan))
+        escan = source.scan(p, ku.KIND_EDGE)
+        earr, eidx = _visible(escan, _EDGE_DT,
+                              ("src", "etype", "rank", "dst"))
+        edge_scans.append((earr, eidx, escan))
 
-    cap_v = _round_up(max((len(v) for v in per_part_vids), default=1))
-    cap_e = _round_up(max((len(e) for e in per_part_edges), default=1))
+    # ---- per-part vid sets: vertex rows + edge srcs + incoming dsts ---
+    vid_chunks: List[List[np.ndarray]] = [[] for _ in range(P)]
+    edge_fields: List[Optional[Tuple]] = [None] * P  # parsed once, reused
+    for p0 in range(P):
+        varr, vidx, _ = vert_scans[p0]
+        if varr is not None and len(vidx):
+            vid_chunks[p0].append(_unbias64(varr["vid"][vidx]))
+        earr, eidx, _ = edge_scans[p0]
+        if earr is not None and len(eidx):
+            src = _unbias64(earr["src"][eidx])
+            vid_chunks[p0].append(src)
+            # destinations must have a local slot in their own partition
+            dst = _unbias64(earr["dst"][eidx])
+            dpart = _dst_part0(dst, P)
+            order = np.argsort(dpart, kind="stable")
+            bounds = np.searchsorted(dpart[order], np.arange(P + 1))
+            edge_fields[p0] = (src, dst, dpart, order, bounds)
+            for q in range(P):
+                chunk = dst[order[bounds[q]:bounds[q + 1]]]
+                if len(chunk):
+                    vid_chunks[q].append(chunk)
+    vids_per_part = [
+        np.unique(np.concatenate(ch)) if ch else np.empty(0, np.int64)
+        for ch in vid_chunks]
 
-    # schema lookups
+    cap_v = _round_up(max((len(v) for v in vids_per_part), default=1))
+    cap_e = _round_up(max((len(ei) for _, ei, _ in edge_scans), default=1))
+
     def edge_schema(et: int) -> Optional[Schema]:
         r = sm.edge_schema(space_id, et)
         return r.value() if r.ok() else None
 
-    shards: List[CsrShard] = []
     # string dictionaries must be GLOBAL across shards AND schema ids so
     # a code identifies one string everywhere a prop of that name is
     # merged into a single device column: (kind, prop name) -> dict
     dict_registry: Dict[Tuple[str, str], Dict[str, int]] = {}
-    for p0 in range(num_parts):
-        vids_sorted = np.array(sorted(per_part_vids[p0]), dtype=np.int64)
-        vid_to_local = {int(v): i for i, v in enumerate(vids_sorted)}
-        edges = per_part_edges[p0]
-        # sort by (src_local, etype, rank, dst) for CSR determinism
-        edges.sort(key=lambda e: (vid_to_local[e[0]], e[1], e[2], e[3]))
-        ne = len(edges)
+    shards: List[CsrShard] = []
+    for p0 in range(P):
+        vids_sorted = vids_per_part[p0]
+        earr, eidx, escan = edge_scans[p0]
+        ne = len(eidx)
         edge_src = np.zeros(cap_e, np.int32)
         edge_etype = np.zeros(cap_e, np.int32)
         edge_rank = np.zeros(cap_e, np.int64)
@@ -261,72 +463,129 @@ def build_snapshot(store, sm, space_id: int, num_parts: int) -> CsrSnapshot:
         edge_dst_part = np.zeros(cap_e, np.int32)
         edge_dst_local = np.zeros(cap_e, np.int32)
         edge_valid = np.zeros(cap_e, bool)
-        rows_by_etype: Dict[int, List[Tuple[int, bytes]]] = {}
-        skipped = 0
-        for i, (src, et, rank, dst, row) in enumerate(edges):
-            edge_src[i] = vid_to_local[src]
-            edge_etype[i] = et
-            edge_rank[i] = rank
-            edge_dst_vid[i] = dst
-            edge_dst_part[i] = ku.part_id(dst, num_parts) - 1
-            # edge_dst_local resolved after all shards' vid maps exist
-            rows_by_etype.setdefault(et, []).append((i, row))
-            edge_valid[i] = True
-        shard = CsrShard(p0 + 1, vids_sorted, vid_to_local, ne, edge_src,
-                         edge_etype, edge_rank, edge_dst_vid, edge_dst_part,
+        et = np.empty(0, np.int32)
+        if ne:
+            # scan order is already canonical (src, etype, rank, dst) —
+            # the biased key encodings sort numerically, so no re-sort
+            src, dst, dpart, order, bounds = edge_fields[p0]
+            et = _unbias32(earr["etype"][eidx])
+            edge_src[:ne] = np.searchsorted(vids_sorted, src)
+            edge_etype[:ne] = et
+            edge_rank[:ne] = _unbias64(earr["rank"][eidx])
+            edge_dst_vid[:ne] = dst
+            edge_dst_part[:ne] = dpart
+            for q in range(P):
+                sel = order[bounds[q]:bounds[q + 1]]
+                if len(sel):
+                    edge_dst_local[sel] = np.searchsorted(
+                        vids_per_part[q], dst[sel])
+            edge_valid[:ne] = True
+        shard = CsrShard(p0 + 1, vids_sorted, ne, edge_src, edge_etype,
+                         edge_rank, edge_dst_vid, edge_dst_part,
                          edge_dst_local, edge_valid)
         shards.append(shard)
-        shard._rows_by_etype = rows_by_etype  # temp, consumed below
 
-    # resolve dst locals now that every shard's vid map exists
-    maps = [s.vid_to_local for s in shards]
-    for s in shards:
-        for i in range(s.num_edges):
-            dp = int(s.edge_dst_part[i])
-            s.edge_dst_local[i] = maps[dp][int(s.edge_dst_vid[i])]
-
-    # ---- pass 2: decode property columns ------------------------------
-    for s in shards:
-        rows_by_etype = s._rows_by_etype
-        del s._rows_by_etype
-        for et, idx_rows in rows_by_etype.items():
-            schema = edge_schema(et)
-            if schema is None or not schema.fields:
-                continue
-            cols = _build_columns(schema, cap_e, idx_rows, now,
-                                  dict_registry, ("e",))
-            if cols:
-                s.edge_props[et] = cols
-        # vertex tag props: ONE scan per partition, bucketed by tag id
-        rows_by_tag: Dict[int, List[Tuple[int, bytes]]] = {}
-        for (part, vid, tag, ver), v in _decode_rows_newest(
-                engine, ku.part_data_prefix(s.part_id, ku.KIND_VERTEX),
-                group_of=lambda f: (f[1], f[2]),
-                parse_key=ku.parse_vertex_key):
-            if vid in s.vid_to_local:
-                rows_by_tag.setdefault(tag, []).append((s.vid_to_local[vid], v))
-        for tag_id, tag_rows in rows_by_tag.items():
-            sr = sm.tag_schema(space_id, tag_id)
-            if not sr.ok() or not sr.value().fields:
-                continue
-            schema = sr.value()
-            if tag_rows:
-                cols = _build_columns(schema, cap_v, tag_rows, now,
+        # ---- pass 2: property columns (skipped for prop-free schemas) --
+        if ne:
+            for t in np.unique(et):
+                schema = edge_schema(int(t))
+                if schema is None or not schema.fields:
+                    continue
+                sel = np.nonzero(et == t)[0]
+                rows = RowsBlock.from_scan(escan, eidx[sel], sel)
+                cols = _build_columns(schema, cap_e, rows, now,
+                                      dict_registry, ("e",))
+                if cols:
+                    shard.edge_props[int(t)] = cols
+        varr, vidx, vscan = vert_scans[p0]
+        if varr is not None and len(vidx):
+            tags = _unbias32(varr["tag"][vidx])
+            vlocal = np.searchsorted(vids_sorted,
+                                     _unbias64(varr["vid"][vidx]))
+            for t in np.unique(tags):
+                sr = sm.tag_schema(space_id, int(t))
+                if not sr.ok() or not sr.value().fields:
+                    continue
+                sel = np.nonzero(tags == t)[0]
+                rows = RowsBlock.from_scan(vscan, vidx[sel], vlocal[sel])
+                cols = _build_columns(sr.value(), cap_v, rows, now,
                                       dict_registry, ("t",))
                 if cols:
-                    s.tag_props[tag_id] = cols
+                    shard.tag_props[int(t)] = cols
+    return shards, cap_v, cap_e, dict_registry
 
-    snap = CsrSnapshot(space_id, shards, cap_v, cap_e, write_version)
-    snap.str_dicts = dict_registry
-    return snap
+
+def _build_shards_native(ext, sm, space_id: int, P: int
+                         ) -> Tuple[List[CsrShard], int, int, Dict]:
+    """Shards from a native CsrExtract: pass 1 (scan, dedup, parse,
+    local-index resolution) already ran in C++; here only padding into
+    the [cap] layout and property-column decode remain."""
+    now = time.time()
+    per_part = [(ext.vids(p0), ext.edges(p0)) for p0 in range(P)]
+    cap_v = _round_up(max((len(v) for v, _ in per_part), default=1))
+    cap_e = _round_up(max((len(e[1]) for _, e in per_part), default=1))
+    dict_registry: Dict[Tuple[str, str], Dict[str, int]] = {}
+    shards: List[CsrShard] = []
+    for p0 in range(P):
+        vids_sorted, (src_l, et, rank, dst_v, dst_p, dst_l) = per_part[p0]
+        ne = len(et)
+        edge_src = np.zeros(cap_e, np.int32)
+        edge_etype = np.zeros(cap_e, np.int32)
+        edge_rank = np.zeros(cap_e, np.int64)
+        edge_dst_vid = np.zeros(cap_e, np.int64)
+        edge_dst_part = np.zeros(cap_e, np.int32)
+        edge_dst_local = np.zeros(cap_e, np.int32)
+        edge_valid = np.zeros(cap_e, bool)
+        if ne:
+            edge_src[:ne] = src_l
+            edge_etype[:ne] = et
+            edge_rank[:ne] = rank
+            edge_dst_vid[:ne] = dst_v
+            edge_dst_part[:ne] = dst_p
+            edge_dst_local[:ne] = dst_l
+            edge_valid[:ne] = True
+        shard = CsrShard(p0 + 1, vids_sorted, ne, edge_src, edge_etype,
+                         edge_rank, edge_dst_vid, edge_dst_part,
+                         edge_dst_local, edge_valid)
+        shards.append(shard)
+        if ne:
+            ev = ext.edge_vals(p0)
+            if ev is not None:
+                blob, offs, lens = ev
+                for t in np.unique(et):
+                    r = sm.edge_schema(space_id, int(t))
+                    if not r.ok() or not r.value().fields:
+                        continue
+                    sel = np.nonzero(et == t)[0]
+                    rows = RowsBlock(blob, offs[sel], lens[sel], sel)
+                    cols = _build_columns(r.value(), cap_e, rows, now,
+                                          dict_registry, ("e",))
+                    if cols:
+                        shard.edge_props[int(t)] = cols
+        vlocal, vtag = ext.vert_rows(p0)
+        if len(vtag):
+            vv = ext.vert_vals(p0)
+            if vv is not None:
+                blob, offs, lens = vv
+                for t in np.unique(vtag):
+                    sr = sm.tag_schema(space_id, int(t))
+                    if not sr.ok() or not sr.value().fields:
+                        continue
+                    sel = np.nonzero(vtag == t)[0]
+                    rows = RowsBlock(blob, offs[sel], lens[sel],
+                                     vlocal[sel])
+                    cols = _build_columns(sr.value(), cap_v, rows, now,
+                                          dict_registry, ("t",))
+                    if cols:
+                        shard.tag_props[int(t)] = cols
+    return shards, cap_v, cap_e, dict_registry
 
 
 _I32_MIN, _I32_MAX = -(1 << 31), (1 << 31) - 1
 
 
-def _native_build_columns(schema: Schema, cap: int,
-                          idx_rows: List[Tuple[int, bytes]], now: float,
-                          dict_registry: Dict, dict_key: Tuple
+def _native_build_columns(schema: Schema, cap: int, rows: "RowsBlock",
+                          now: float, dict_registry: Dict, dict_key: Tuple
                           ) -> Optional[Dict[str, PropColumn]]:
     """Fast path: one nbc_decode_batch FFI call decodes every row into
     column buffers (native/src/codec.cc — the C++ codec hot path, role
@@ -336,9 +595,12 @@ def _native_build_columns(schema: Schema, cap: int,
     from .. import native
     if not native.available():
         return None
+    if isinstance(rows, list):
+        rows = RowsBlock.from_pairs(rows)
     try:
-        i64, f64, soff, slen, nulls, blob = native.decode_batch(
-            [f.type.value for f in schema.fields], idx_rows, cap)
+        i64, f64, soff, slen, nulls, blob = native.decode_rows(
+            [f.type.value for f in schema.fields], rows.blob, rows.offs,
+            rows.lens, rows.idxs, cap)
     except Exception:
         return None
     # TTL: a row whose ttl prop expired is invisible — null every field
@@ -413,13 +675,14 @@ def _native_build_columns(schema: Schema, cap: int,
     return out
 
 
-def _build_columns(schema: Schema, cap: int,
-                   idx_rows: List[Tuple[int, bytes]], now: float,
+def _build_columns(schema: Schema, cap: int, rows: "RowsBlock", now: float,
                    dict_registry: Dict = None, dict_key: Tuple = None
                    ) -> Dict[str, PropColumn]:
     """Decode rows into columnar arrays aligned at the given indices,
     respecting schema versions and TTL."""
-    fast = _native_build_columns(schema, cap, idx_rows, now,
+    if isinstance(rows, list):
+        rows = RowsBlock.from_pairs(rows)
+    fast = _native_build_columns(schema, cap, rows, now,
                                  dict_registry, dict_key)
     if fast is not None:
         return fast
@@ -427,7 +690,7 @@ def _build_columns(schema: Schema, cap: int,
     n_fields = schema.num_fields()
     host_cols: List[List[Any]] = [[None] * cap for _ in range(n_fields)]
     ttl = schema.ttl_col is not None and schema.ttl_duration > 0
-    for idx, raw in idx_rows:
+    for idx, raw in rows.items():
         try:
             reader = RowReader(schema, raw)
             row = reader.to_dict()

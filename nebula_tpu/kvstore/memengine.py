@@ -70,6 +70,17 @@ class MemEngine(KVEngine):
         hi = bisect.bisect_left(self._keys, end)
         return _ListIterator(self._keys, self._data, lo, hi)
 
+    def scan_batch(self, prefix: bytes) -> Tuple[List[bytes], List[bytes]]:
+        """Whole prefix range in two lists (keys, values) — the batched
+        form the CSR snapshot builder consumes (one call, no per-item
+        iterator overhead)."""
+        lo = bisect.bisect_left(self._keys, prefix)
+        ub = _prefix_upper_bound(prefix)
+        hi = bisect.bisect_left(self._keys, ub) if ub is not None \
+            else len(self._keys)
+        ks = self._keys[lo:hi]
+        return ks, list(map(self._data.__getitem__, ks))
+
     # --- writes -------------------------------------------------------
     def put(self, key: bytes, value: bytes) -> Status:
         self.write_version += 1

@@ -83,6 +83,11 @@ class NativeEngine(KVEngine):
         self._closed = False
 
     @property
+    def native_handle(self):
+        """Raw nkv* for native one-call operations (CSR extraction)."""
+        return self._h
+
+    @property
     def write_version(self) -> int:          # type: ignore[override]
         return self._lib.nkv_version(self._h)
 
@@ -118,6 +123,42 @@ class NativeEngine(KVEngine):
         return _ListIterator(
             self._scan(self._lib.nkv_scan_range, start, len(start),
                        end, len(end)))
+
+    def scan_batch(self, prefix: bytes) -> Tuple[List[bytes], List[bytes]]:
+        """(keys, values) under prefix — batched scan for the CSR
+        snapshot builder (one native call + one unpack pass)."""
+        items = self._scan(self._lib.nkv_scan_prefix, prefix, len(prefix))
+        return [k for k, _ in items], [v for _, v in items]
+
+    def scan_cols(self, prefix: bytes):
+        """Columnar scan (nkv_scan_prefix_cols): keys blob + values blob
+        + length arrays in ONE native call, zero per-item Python — the
+        CSR builder's hot scan path."""
+        import numpy as np
+        from ..engine_tpu.csr import ScanCols
+        u8p = ctypes.POINTER(ctypes.c_uint8)
+        u32p = ctypes.POINTER(ctypes.c_uint32)
+        kb, vb = u8p(), u8p()
+        kl, vl = u32p(), u32p()
+        kn, vn = ctypes.c_int64(), ctypes.c_int64()
+        n = self._lib.nkv_scan_prefix_cols(
+            self._h, prefix, len(prefix), ctypes.byref(kb),
+            ctypes.byref(kn), ctypes.byref(vb), ctypes.byref(vn),
+            ctypes.byref(kl), ctypes.byref(vl))
+        if n < 0:
+            raise MemoryError("nkv_scan_prefix_cols failed")
+        if n == 0:
+            return ScanCols.from_lists([], [])
+        try:
+            keys_blob = ctypes.string_at(kb, kn.value)
+            vals_blob = ctypes.string_at(vb, vn.value) if vn.value else b""
+            vlens = np.ctypeslib.as_array(vl, shape=(n,)).astype(np.int64)
+        finally:
+            self._lib.nkv_buf_free(kb)
+            self._lib.nkv_buf_free(vb)
+            self._lib.nkv_buf_free(ctypes.cast(kl, u8p))
+            self._lib.nkv_buf_free(ctypes.cast(vl, u8p))
+        return ScanCols.from_blobs(n, keys_blob, vals_blob, vlens)
 
     def prefix_dedup(self, prefix: bytes,
                      group_suffix: int = 8) -> List[KV]:

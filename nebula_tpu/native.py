@@ -119,10 +119,45 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.nkv_scan_prefix_dedup.argtypes = [vp, ctypes.c_char_p, i64, i32,
                                           ctypes.POINTER(u8p),
                                           ctypes.POINTER(i64)]
+    u32p = ctypes.POINTER(ctypes.c_uint32)
+    lib.nkv_scan_prefix_cols.restype = i64
+    lib.nkv_scan_prefix_cols.argtypes = [vp, ctypes.c_char_p, i64,
+                                         ctypes.POINTER(u8p),
+                                         ctypes.POINTER(i64),
+                                         ctypes.POINTER(u8p),
+                                         ctypes.POINTER(i64),
+                                         ctypes.POINTER(u32p),
+                                         ctypes.POINTER(u32p)]
     lib.nkv_buf_free.restype = None
     lib.nkv_buf_free.argtypes = [u8p]
     lib.nkv_checkpoint.restype = i32
     lib.nkv_checkpoint.argtypes = [vp, ctypes.c_char_p]
+
+    # ----------------------------------------------------------- CSR
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    lib.ncsr_build.restype = vp
+    lib.ncsr_build.argtypes = [vp, i32, i32]
+    lib.ncsr_free.restype = None
+    lib.ncsr_free.argtypes = [vp]
+    lib.ncsr_vids.restype = i64
+    lib.ncsr_vids.argtypes = [vp, i32, ctypes.POINTER(i64p)]
+    lib.ncsr_edges.restype = i64
+    lib.ncsr_edges.argtypes = [vp, i32] + [ctypes.POINTER(i32p)] * 2 + \
+        [ctypes.POINTER(i64p)] * 2 + [ctypes.POINTER(i32p)] * 2
+    lib.ncsr_edge_vals.restype = i64
+    lib.ncsr_edge_vals.argtypes = [vp, i32, ctypes.POINTER(u8p),
+                                   ctypes.POINTER(i64),
+                                   ctypes.POINTER(i64p),
+                                   ctypes.POINTER(i32p)]
+    lib.ncsr_vert_rows.restype = i64
+    lib.ncsr_vert_rows.argtypes = [vp, i32, ctypes.POINTER(i32p),
+                                   ctypes.POINTER(i32p)]
+    lib.ncsr_vert_vals.restype = i64
+    lib.ncsr_vert_vals.argtypes = [vp, i32, ctypes.POINTER(u8p),
+                                   ctypes.POINTER(i64),
+                                   ctypes.POINTER(i64p),
+                                   ctypes.POINTER(i32p)]
 
     # --------------------------------------------------------- codec
     lib.nbc_decode_batch.restype = i64
@@ -156,30 +191,110 @@ def available() -> bool:
         return False
 
 
-def decode_batch(field_types, idx_rows, cap):
+class CsrExtract:
+    """Handle over a native pass-1 CSR build (ncsr_build). Accessors
+    COPY into numpy arrays (the native buffers die with the handle)."""
+
+    def __init__(self, lib, handle, num_parts: int):
+        self._lib = lib
+        self._h = handle
+        self.num_parts = num_parts
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.ncsr_free(self._h)
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+    @staticmethod
+    def _np(ptr, n, dtype):
+        import numpy as np
+        if n == 0:
+            return np.empty(0, dtype)
+        return np.ctypeslib.as_array(ptr, shape=(int(n),)).copy()
+
+    def vids(self, part0: int):
+        p = ctypes.POINTER(ctypes.c_int64)()
+        n = self._lib.ncsr_vids(self._h, part0, ctypes.byref(p))
+        import numpy as np
+        return self._np(p, n, np.int64)
+
+    def edges(self, part0: int):
+        import numpy as np
+        i64p, i32p = ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int32)
+        src, et, dp, dl = i32p(), i32p(), i32p(), i32p()
+        rank, dst = i64p(), i64p()
+        n = self._lib.ncsr_edges(self._h, part0, ctypes.byref(src),
+                                 ctypes.byref(et), ctypes.byref(rank),
+                                 ctypes.byref(dst), ctypes.byref(dp),
+                                 ctypes.byref(dl))
+        return (self._np(src, n, np.int32), self._np(et, n, np.int32),
+                self._np(rank, n, np.int64), self._np(dst, n, np.int64),
+                self._np(dp, n, np.int32), self._np(dl, n, np.int32))
+
+    def _vals(self, fn, part0: int):
+        import numpy as np
+        blob = ctypes.POINTER(ctypes.c_uint8)()
+        blen = ctypes.c_int64()
+        offs = ctypes.POINTER(ctypes.c_int64)()
+        lens = ctypes.POINTER(ctypes.c_int32)()
+        n = fn(self._h, part0, ctypes.byref(blob), ctypes.byref(blen),
+               ctypes.byref(offs), ctypes.byref(lens))
+        if n == 0:
+            return None
+        raw = ctypes.string_at(blob, blen.value) if blen.value else b""
+        return raw, self._np(offs, n, np.int64), self._np(lens, n, np.int32)
+
+    def edge_vals(self, part0: int):
+        return self._vals(self._lib.ncsr_edge_vals, part0)
+
+    def vert_rows(self, part0: int):
+        import numpy as np
+        i32p = ctypes.POINTER(ctypes.c_int32)
+        local, tag = i32p(), i32p()
+        n = self._lib.ncsr_vert_rows(self._h, part0, ctypes.byref(local),
+                                     ctypes.byref(tag))
+        return self._np(local, n, np.int32), self._np(tag, n, np.int32)
+
+    def vert_vals(self, part0: int):
+        return self._vals(self._lib.ncsr_vert_vals, part0)
+
+
+def extract_csr(engine_handle, num_parts: int,
+                want_values: bool) -> CsrExtract:
+    """Run the native pass-1 CSR build over an nkv engine handle."""
+    lib = load()
+    h = lib.ncsr_build(engine_handle, num_parts, 1 if want_values else 0)
+    if not h:
+        raise NativeBuildError("ncsr_build failed")
+    return CsrExtract(lib, h, num_parts)
+
+
+def decode_rows(field_types, blob, row_off, row_len, row_idx, cap):
     """Batch-decode fixed-slot rows of one schema into columns via the
-    native codec (nbc_decode_batch).
+    native codec (nbc_decode_batch) — zero per-row Python.
 
     field_types: list of PropType int values per schema field.
-    idx_rows: list of (dest index, encoded row bytes).
-    cap: column length.
+    blob: concatenated encoded rows; row_off (i64) / row_len (i32) per
+    row; row_idx (i32): destination slot per row. cap: column length.
 
     Returns (vals_i64, vals_f64, str_off, str_len, nulls, blob) — numpy
-    arrays shaped [n_fields, cap] (nulls: True = null) plus the
-    concatenated blob str_off/str_len point into. Raises if the native
-    library is unavailable (callers fall back to the Python codec).
+    arrays shaped [n_fields, cap] (nulls: True = null) plus the blob
+    str_off/str_len point into. Raises if the native library is
+    unavailable (callers fall back to the Python codec).
     """
     import numpy as np
     lib = load()
     n_fields = len(field_types)
-    n = len(idx_rows)
-    blob = b"".join(raw for _, raw in idx_rows)
-    row_len = np.fromiter((len(raw) for _, raw in idx_rows),
-                          dtype=np.int32, count=n)
-    row_off = np.zeros(n, np.int64)
-    if n > 1:
-        np.cumsum(row_len[:-1], out=row_off[1:])
-    row_idx = np.fromiter((i for i, _ in idx_rows), dtype=np.int32, count=n)
+    n = len(row_idx)
+    row_off = np.ascontiguousarray(row_off, np.int64)
+    row_len = np.ascontiguousarray(row_len, np.int32)
+    row_idx = np.ascontiguousarray(row_idx, np.int32)
     ft = np.asarray(field_types, np.uint8)
     vals_i64 = np.zeros((n_fields, cap), np.int64)
     vals_f64 = np.zeros((n_fields, cap), np.float64)
