@@ -459,23 +459,36 @@ struct ncsr_part_data {
 
 // Parallel loop over partitions (scan and resolution phases are
 // per-part independent; the map is read-only while e->mu is held).
-void parallel_parts(int32_t num_parts,
+// Returns false if any worker threw (e.g. bad_alloc on an
+// out-of-memory graph) — exceptions never escape a thread (that would
+// std::terminate the daemon) and never cross the C ABI.
+bool parallel_parts(int32_t num_parts,
                     const std::function<void(int32_t)> &fn) {
   unsigned hw = std::thread::hardware_concurrency();
   unsigned n = std::min<unsigned>(hw ? hw : 1,
                                   static_cast<unsigned>(num_parts));
+  std::atomic<bool> failed{false};
+  auto safe = [&](int32_t p) {
+    try {
+      fn(p);
+    } catch (...) {
+      failed.store(true);
+    }
+  };
   if (n <= 1) {
-    for (int32_t p = 0; p < num_parts; ++p) fn(p);
-    return;
+    for (int32_t p = 0; p < num_parts && !failed.load(); ++p) safe(p);
+    return !failed.load();
   }
   std::atomic<int32_t> next{0};
   std::vector<std::thread> ts;
   for (unsigned t = 0; t < n; ++t)
     ts.emplace_back([&] {
       int32_t p;
-      while ((p = next.fetch_add(1)) < num_parts) fn(p);
+      while (!failed.load() && (p = next.fetch_add(1)) < num_parts)
+        safe(p);
     });
   for (auto &t : ts) t.join();
+  return !failed.load();
 }
 
 }  // namespace
@@ -488,10 +501,15 @@ extern "C" {
 
 ncsr *ncsr_build(nkv *e, int32_t num_parts, int32_t want_values) {
   std::lock_guard<std::mutex> g(e->mu);
-  ncsr *b = new ncsr();
-  b->parts.resize(static_cast<size_t>(num_parts));
+  ncsr *b;
+  try {
+    b = new ncsr();
+    b->parts.resize(static_cast<size_t>(num_parts));
+  } catch (...) {
+    return nullptr;
+  }
   // ---- phase 1: scan + parse + visibility, parallel per part --------
-  parallel_parts(num_parts, [&](int32_t p0) {
+  bool ok = parallel_parts(num_parts, [&](int32_t p0) {
     int32_t p = p0 + 1;
     ncsr_part_data &P = b->parts[static_cast<size_t>(p0)];
     P.dst_by_target.resize(static_cast<size_t>(num_parts));
@@ -555,11 +573,15 @@ ncsr *ncsr_build(nkv *e, int32_t num_parts, int32_t want_values) {
     }
     P.dst_local.resize(P.dst_vid.size());
   });
+  if (!ok) {
+    delete b;
+    return nullptr;
+  }
   // ---- phase 2: vid sets + local resolution, parallel per OWNER part.
   // Each worker q merges incoming dsts from every part into q's vid
   // set, then resolves q's own src/vert locals and every edge whose
   // dst q owns (disjoint dst_local slots — data-race free).
-  parallel_parts(num_parts, [&](int32_t q) {
+  ok = parallel_parts(num_parts, [&](int32_t q) {
     ncsr_part_data &Q = b->parts[static_cast<size_t>(q)];
     std::vector<DstRef> incoming;
     size_t total = 0;
@@ -598,6 +620,10 @@ ncsr *ncsr_build(nkv *e, int32_t num_parts, int32_t want_values) {
           .dst_local[static_cast<size_t>(r.idx)] = static_cast<int32_t>(vi);
     }
   });
+  if (!ok) {
+    delete b;
+    return nullptr;
+  }
   for (auto &P : b->parts) {
     P.dst_by_target.clear();
     P.dst_by_target.shrink_to_fit();

@@ -65,6 +65,13 @@ def serve_graphd(meta_addr: str, host: str = "127.0.0.1", port: int = 0,
     refresh_hosts()
     client = StorageClient(sm, hosts=hosts, part_to_host=mc.part_host,
                            refresh_hosts=refresh_hosts)
+    if tpu_engine is not None:
+        # the real 3-daemon --tpu path: snapshots sync from remote
+        # storaged parts over the storage RPC boundary (ref seam:
+        # storage/StorageServer.cpp:32-55, FLAGS_store_type)
+        from ..engine_tpu.provider import RemoteStorageProvider
+        tpu_engine.attach_provider(RemoteStorageProvider(client, sm),
+                                   sm, meta=mc)
     engine = ExecutionEngine(mc, sm, client, tpu_engine=tpu_engine)
     service = GraphService(engine)
     server = RpcServer(host, port).register("graph", service).start()
@@ -92,6 +99,19 @@ def main(argv=None) -> None:
         graph_flags.load_flagfile(args.flagfile)
     tpu = None
     if args.tpu:
+        # fail LOUDLY here rather than silently serving CPU-only — an
+        # operator who passed --tpu must know if the device is unusable
+        import os
+        import jax
+        devs = jax.devices()
+        if (all(d.platform == "cpu" for d in devs)
+                and not os.environ.get("NEBULA_TPU_ALLOW_CPU")):
+            raise SystemExit(
+                f"graphd --tpu: no accelerator device (jax sees {devs}); "
+                f"refusing to silently serve CPU-only. Set "
+                f"NEBULA_TPU_ALLOW_CPU=1 to run the engine on the CPU "
+                f"XLA backend anyway.")
+        print(f"graphd --tpu: JAX backend up ({devs})")
         from ..engine_tpu import TpuGraphEngine
         tpu = TpuGraphEngine()
     ws = None if args.ws_port < 0 else args.ws_port

@@ -36,6 +36,7 @@ import numpy as np
 from ..codec.row import RowReader, peek_schema_version
 from ..codec.schema import PropType, Schema
 from ..common import keys as ku
+from ..kvstore.scan import RowsBlock, ScanCols, scan_cols as _scan_cols
 
 LANE = 128
 
@@ -216,112 +217,41 @@ def _dst_part0(dst: np.ndarray, num_parts: int) -> np.ndarray:
     return (dst.view(np.uint64) % np.uint64(num_parts)).astype(np.int32)
 
 
-class ScanCols:
-    """One partition-kind scan in columnar form: all keys in one blob,
-    value lengths as an array, and values either as one blob + offsets
-    (native engines, the snapshot-sync wire format) or as a list
-    (engines that store Python bytes). Everything downstream is numpy.
-    """
-    __slots__ = ("n", "keys_blob", "vlens", "vals_blob", "voffs",
-                 "vals_list")
-
-    def __init__(self, n, keys_blob, vlens, vals_blob=None, voffs=None,
-                 vals_list=None):
-        self.n = n
-        self.keys_blob = keys_blob
-        self.vlens = vlens
-        self.vals_blob = vals_blob
-        self.voffs = voffs
-        self.vals_list = vals_list
-
-    @classmethod
-    def from_lists(cls, keys: List[bytes], vals: List[bytes]) -> "ScanCols":
-        n = len(keys)
-        vlens = np.fromiter(map(len, vals), np.int64, n)
-        return cls(n, b"".join(keys), vlens, vals_list=vals)
-
-    @classmethod
-    def from_blobs(cls, n: int, keys_blob: bytes, vals_blob: bytes,
-                   vlens: np.ndarray) -> "ScanCols":
-        voffs = np.zeros(n, np.int64)
-        if n > 1:
-            np.cumsum(vlens[:-1], out=voffs[1:])
-        return cls(n, keys_blob, np.asarray(vlens, np.int64), vals_blob,
-                   voffs)
-
-
-class RowsBlock:
-    """Encoded rows selected from a scan, addressed for batch decode:
-    blob + per-row (offset, length) + destination column index."""
-    __slots__ = ("blob", "offs", "lens", "idxs")
-
-    def __init__(self, blob: bytes, offs: np.ndarray, lens: np.ndarray,
-                 idxs: np.ndarray):
-        self.blob = blob
-        self.offs = np.asarray(offs, np.int64)
-        self.lens = np.asarray(lens, np.int32)
-        self.idxs = np.asarray(idxs, np.int32)
-
-    @classmethod
-    def from_pairs(cls, pairs: List[Tuple[int, bytes]]) -> "RowsBlock":
-        n = len(pairs)
-        lens = np.fromiter((len(r) for _, r in pairs), np.int32, n)
-        offs = np.zeros(n, np.int64)
-        if n > 1:
-            np.cumsum(lens[:-1], out=offs[1:])
-        idxs = np.fromiter((i for i, _ in pairs), np.int32, n)
-        return cls(b"".join(r for _, r in pairs), offs, lens, idxs)
-
-    @classmethod
-    def from_scan(cls, scan: ScanCols, scan_idx: np.ndarray,
-                  dest_idx: np.ndarray) -> "RowsBlock":
-        if scan.vals_blob is not None:
-            return cls(scan.vals_blob, scan.voffs[scan_idx],
-                       scan.vlens[scan_idx], dest_idx)
-        vals = list(map(scan.vals_list.__getitem__, scan_idx.tolist()))
-        lens = scan.vlens[scan_idx]
-        offs = np.zeros(len(vals), np.int64)
-        if len(vals) > 1:
-            np.cumsum(lens[:-1], out=offs[1:])
-        return cls(b"".join(vals), offs, lens, dest_idx)
-
-    def __len__(self) -> int:
-        return len(self.idxs)
-
-    def items(self):
-        """(dest index, row bytes) pairs — the Python-codec fallback."""
-        for j in range(len(self.idxs)):
-            o = int(self.offs[j])
-            yield int(self.idxs[j]), self.blob[o:o + int(self.lens[j])]
-
-
-def _scan_cols(engine, prefix: bytes) -> ScanCols:
-    fn = getattr(engine, "scan_cols", None)
-    if fn is not None:
-        return fn(prefix)
-    fn = getattr(engine, "scan_batch", None)
-    if fn is not None:
-        return ScanCols.from_lists(*fn(prefix))
-    keys: List[bytes] = []
-    vals: List[bytes] = []
-    for k, v in engine.prefix(prefix):
-        keys.append(k)
-        vals.append(v)
-    return ScanCols.from_lists(keys, vals)
+def _narrow_to_width(scan: ScanCols, width: int) -> ScanCols:
+    """Restrict a scan to keys of exactly `width` bytes, dropping
+    foreign-width keys (corruption, future key kinds) — matching the
+    native extract's `k.size() != kKeyLen` skip so both builder paths
+    see identical data. Indices of the result align with its arrays."""
+    good = np.nonzero(scan.klens == width)[0]
+    koffs = np.zeros(scan.n, np.int64)
+    if scan.n > 1:
+        np.cumsum(scan.klens[:-1], out=koffs[1:])
+    blob = b"".join(scan.keys_blob[int(koffs[i]):int(koffs[i]) + width]
+                    for i in good)
+    if scan.vals_blob is not None:
+        return ScanCols(len(good), blob,
+                        np.full(len(good), width, np.int64),
+                        scan.vlens[good], vals_blob=scan.vals_blob,
+                        voffs=scan.voffs[good])
+    return ScanCols(len(good), blob, np.full(len(good), width, np.int64),
+                    scan.vlens[good],
+                    vals_list=[scan.vals_list[int(i)] for i in good])
 
 
 def _visible(scan: ScanCols, dt: np.dtype, group_fields: Tuple[str, ...]):
     """Parse a scan into a structured key array + indices of VISIBLE
     rows: newest version per logical group (first in key order —
     versions are decreasing), tombstones dropped.
-    -> (arr | None, vis_idx int64[])"""
+    -> (arr | None, vis_idx int64[], scan) — indices address BOTH the
+    returned arr and the returned scan (which may be a narrowed copy
+    when foreign-width keys had to be dropped)."""
     if scan.n == 0:
-        return None, np.empty(0, np.int64)
-    blob = scan.keys_blob
-    if len(blob) != scan.n * dt.itemsize:
-        raise ValueError(f"mixed key widths under data prefix "
-                         f"({len(blob)} != {scan.n}*{dt.itemsize})")
-    arr = np.frombuffer(blob, dtype=dt)
+        return None, np.empty(0, np.int64), scan
+    if len(scan.keys_blob) != scan.n * dt.itemsize:
+        scan = _narrow_to_width(scan, dt.itemsize)
+        if scan.n == 0:
+            return None, np.empty(0, np.int64), scan
+    arr = np.frombuffer(scan.keys_blob, dtype=dt)
     n = len(arr)
     first = np.ones(n, bool)
     if n > 1:
@@ -330,7 +260,7 @@ def _visible(scan: ScanCols, dt: np.dtype, group_fields: Tuple[str, ...]):
             col = arr[f]
             diff |= col[1:] != col[:-1]
         first[1:] = diff
-    return arr, np.nonzero(first & (scan.vlens > 0))[0]
+    return arr, np.nonzero(first & (scan.vlens > 0))[0], scan
 
 
 def build_snapshot(store, sm, space_id: int, num_parts: int) -> CsrSnapshot:
@@ -368,7 +298,10 @@ class _EngineScanSource:
         from .. import native
         if not native.available():
             return None
-        return native.extract_csr(h, num_parts, want_values)
+        try:
+            return native.extract_csr(h, num_parts, want_values)
+        except native.NativeBuildError:
+            return None  # e.g. allocation failure: generic path retries
 
 
 def _space_has_props(sm, space_id: int) -> bool:
@@ -407,13 +340,11 @@ def build_shards(source, sm, space_id: int, num_parts: int
     vert_scans = []   # (arr|None, vis_idx, ScanCols)
     edge_scans = []
     for p in range(1, P + 1):
-        vscan = source.scan(p, ku.KIND_VERTEX)
-        varr, vidx = _visible(vscan, _VERT_DT, ("vid", "tag"))
-        vert_scans.append((varr, vidx, vscan))
-        escan = source.scan(p, ku.KIND_EDGE)
-        earr, eidx = _visible(escan, _EDGE_DT,
-                              ("src", "etype", "rank", "dst"))
-        edge_scans.append((earr, eidx, escan))
+        vert_scans.append(_visible(source.scan(p, ku.KIND_VERTEX),
+                                   _VERT_DT, ("vid", "tag")))
+        edge_scans.append(_visible(source.scan(p, ku.KIND_EDGE),
+                                   _EDGE_DT, ("src", "etype", "rank",
+                                              "dst")))
 
     # ---- per-part vid sets: vertex rows + edge srcs + incoming dsts ---
     vid_chunks: List[List[np.ndarray]] = [[] for _ in range(P)]

@@ -25,7 +25,7 @@ from ..filter.expressions import (Expression, InputPropExpr, VariablePropExpr)
 from ..parser import ast
 from ..storage.types import BoundResponse, EdgeData, PartResult, VertexData
 from . import traverse
-from .csr import CsrSnapshot, build_snapshot
+from .csr import CsrSnapshot
 from .filter_compile import FilterCompiler
 
 DEFAULT_MAX_EDGES_PER_VERTEX = 10000
@@ -44,7 +44,7 @@ class TpuGraphEngine:
         self.auto_refresh = auto_refresh
         self.enabled = enabled
         self._snapshots: Dict[int, CsrSnapshot] = {}
-        self._store = None
+        self._provider = None
         self._sm = None
         self._meta = None
         self.stats = {"go_served": 0, "path_served": 0, "rebuilds": 0,
@@ -52,12 +52,21 @@ class TpuGraphEngine:
 
     # ------------------------------------------------------------------
     def attach(self, cluster) -> None:
-        self._store = cluster.store
+        from .provider import LocalStoreProvider
+        self._provider = LocalStoreProvider(cluster.store, cluster.sm)
         self._sm = cluster.sm
         self._meta = cluster.meta
 
     def attach_raw(self, store, sm, meta=None) -> None:
-        self._store = store
+        from .provider import LocalStoreProvider
+        self._provider = LocalStoreProvider(store, sm)
+        self._sm = sm
+        self._meta = meta
+
+    def attach_provider(self, provider, sm, meta=None) -> None:
+        """Arbitrary snapshot feed — the RemoteStorageProvider path for
+        the real 3-daemon topology (graphd --tpu)."""
+        self._provider = provider
         self._sm = sm
         self._meta = meta
 
@@ -65,23 +74,28 @@ class TpuGraphEngine:
     # snapshot lifecycle
     # ------------------------------------------------------------------
     def _catalog_version(self) -> int:
-        return getattr(self._meta, "catalog_version", 0) if self._meta else 0
+        v = getattr(self._meta, "catalog_version", 0) if self._meta else 0
+        return v() if callable(v) else v
 
-    def refresh(self, space_id: int) -> CsrSnapshot:
-        num_parts = self._sm.num_parts(space_id)
-        snap = build_snapshot(self._store, self._sm, space_id, num_parts)
-        snap.catalog_version = self._catalog_version()
+    def refresh(self, space_id: int) -> Optional[CsrSnapshot]:
+        catalog = self._catalog_version()
+        snap = self._provider.build(space_id)
+        if snap is None:
+            return None
+        snap.catalog_version = catalog
         self._snapshots[space_id] = snap
         self.stats["rebuilds"] += 1
         return snap
 
     def snapshot(self, space_id: int) -> Optional[CsrSnapshot]:
-        engine = self._store.space_engine(space_id) if self._store else None
-        if engine is None:
+        if self._provider is None:
+            return None
+        token = self._provider.version(space_id)
+        if token is None:
             return None
         snap = self._snapshots.get(space_id)
         fresh = (snap is not None
-                 and snap.write_version == engine.write_version
+                 and snap.write_version == token
                  and getattr(snap, "catalog_version", -1) == self._catalog_version())
         if fresh:
             return snap
@@ -95,7 +109,7 @@ class TpuGraphEngine:
     # serve decisions
     # ------------------------------------------------------------------
     def can_serve(self, space_id: int, s: ast.GoSentence) -> bool:
-        if not (self.enabled and self._store is not None):
+        if not (self.enabled and self._provider is not None):
             return False
         exprs = [c.expr for c in (s.yield_.columns if s.yield_ else [])]
         if s.where:
@@ -109,7 +123,8 @@ class TpuGraphEngine:
         return True
 
     def can_serve_path(self, space_id: int, s: ast.FindPathSentence) -> bool:
-        return bool(self.enabled and self._store is not None and s.shortest)
+        return bool(self.enabled and self._provider is not None
+                    and s.shortest)
 
     # ------------------------------------------------------------------
     # GO on device

@@ -1,0 +1,95 @@
+"""Snapshot providers: where the TPU engine's CSR builds come from.
+
+The reference puts its storage-engine plugin seam below the storage
+service (`FLAGS_store_type`, ref storage/StorageServer.cpp:32-55). The
+TPU engine mirrors that seam from the consuming side: a provider hands
+it (a) a freshness token that changes whenever the space's data or
+routing changes, and (b) a full CSR build. Two implementations:
+
+- LocalStoreProvider: graphd and storaged share a process (single-node
+  deployment, the in-proc test cluster) — scans the local engine.
+- RemoteStorageProvider: the real 3-daemon topology — pulls columnar
+  part scans over the storage RPC boundary (scan_part_cols) with the
+  same leader routing/retry discipline as every other storage read.
+
+Ordering invariant: build() captures the token BEFORE scanning, so a
+write racing the build bumps the live version past the snapshot's and
+forces a rebuild — the snapshot can only ever be too fresh, never
+stale.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from ..kvstore.scan import ScanCols
+from .csr import CsrSnapshot, build_shards, build_snapshot
+
+
+class SnapshotBuildError(RuntimeError):
+    """A partition scan failed mid-build (leader moved, host died)."""
+
+
+class LocalStoreProvider:
+    """Snapshot feed from an in-process GraphStore."""
+
+    def __init__(self, store, sm):
+        self._store = store
+        self._sm = sm
+
+    def version(self, space_id: int):
+        engine = self._store.space_engine(space_id)
+        return None if engine is None else engine.write_version
+
+    def build(self, space_id: int) -> Optional[CsrSnapshot]:
+        if self._store.space_engine(space_id) is None:
+            return None
+        return build_snapshot(self._store, self._sm, space_id,
+                              self._sm.num_parts(space_id))
+
+
+class _RemoteScanSource:
+    """ScanSource over the storage RPC boundary (one scan_part_cols
+    round-trip per (part, kind), leader-routed)."""
+
+    def __init__(self, client, space_id: int):
+        self._client = client
+        self._space = space_id
+
+    def scan(self, part: int, kind: int) -> ScanCols:
+        from ..common.status import ErrorCode
+        resp = self._client.scan_part_cols(self._space, part, kind)
+        if resp.result.code != ErrorCode.SUCCEEDED:
+            raise SnapshotBuildError(
+                f"scan of part {part} failed: {resp.result.code.name}")
+        return ScanCols.from_blobs(resp.n, resp.keys_blob, resp.vals_blob,
+                                   np.frombuffer(resp.vlens, np.int64),
+                                   np.frombuffer(resp.klens, np.int64))
+
+
+class RemoteStorageProvider:
+    """Snapshot feed over the storage service boundary — the TPU engine
+    in graphd serving queries against data held by remote storaged."""
+
+    def __init__(self, client, sm):
+        self._client = client
+        self._sm = sm
+
+    def version(self, space_id: int):
+        return self._client.space_versions(space_id)
+
+    def build(self, space_id: int) -> Optional[CsrSnapshot]:
+        token = self.version(space_id)   # BEFORE the scans (see module doc)
+        if token is None:
+            return None
+        num_parts = self._sm.num_parts(space_id)
+        try:
+            shards, cap_v, cap_e, dicts = build_shards(
+                _RemoteScanSource(self._client, space_id), self._sm,
+                space_id, num_parts)
+        except SnapshotBuildError:
+            return None
+        snap = CsrSnapshot(space_id, shards, cap_v, cap_e, token)
+        snap.str_dicts = dicts
+        return snap

@@ -135,7 +135,7 @@ class NativeEngine(KVEngine):
         + length arrays in ONE native call, zero per-item Python — the
         CSR builder's hot scan path."""
         import numpy as np
-        from ..engine_tpu.csr import ScanCols
+        from .scan import ScanCols
         u8p = ctypes.POINTER(ctypes.c_uint8)
         u32p = ctypes.POINTER(ctypes.c_uint32)
         kb, vb = u8p(), u8p()
@@ -152,13 +152,14 @@ class NativeEngine(KVEngine):
         try:
             keys_blob = ctypes.string_at(kb, kn.value)
             vals_blob = ctypes.string_at(vb, vn.value) if vn.value else b""
+            klens = np.ctypeslib.as_array(kl, shape=(n,)).astype(np.int64)
             vlens = np.ctypeslib.as_array(vl, shape=(n,)).astype(np.int64)
         finally:
             self._lib.nkv_buf_free(kb)
             self._lib.nkv_buf_free(vb)
             self._lib.nkv_buf_free(ctypes.cast(kl, u8p))
             self._lib.nkv_buf_free(ctypes.cast(vl, u8p))
-        return ScanCols.from_blobs(n, keys_blob, vals_blob, vlens)
+        return ScanCols.from_blobs(n, keys_blob, vals_blob, vlens, klens)
 
     def prefix_dedup(self, prefix: bytes,
                      group_suffix: int = 8) -> List[KV]:

@@ -1,0 +1,112 @@
+"""Columnar scan containers — jax-free, importable by storaged.
+
+The CSR snapshot builder (engine_tpu/csr.py) and the snapshot-sync RPC
+(storage/processors.py scan_part_cols) share these forms; keeping them
+out of engine_tpu means a storage daemon serving scans never imports
+jax (graphd is the only device-touching process, matching the
+reference's separation where storaged knows nothing about the query
+engine's execution backend).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+class ScanCols:
+    """One partition-kind scan in columnar form: all keys in one blob,
+    per-item length arrays, and values either as one blob + offsets
+    (native engines, the snapshot-sync wire format) or as a list
+    (engines that store Python bytes). Everything downstream is numpy.
+    """
+    __slots__ = ("n", "keys_blob", "klens", "vlens", "vals_blob", "voffs",
+                 "vals_list")
+
+    def __init__(self, n, keys_blob, klens, vlens, vals_blob=None,
+                 voffs=None, vals_list=None):
+        self.n = n
+        self.keys_blob = keys_blob
+        self.klens = klens
+        self.vlens = vlens
+        self.vals_blob = vals_blob
+        self.voffs = voffs
+        self.vals_list = vals_list
+
+    @classmethod
+    def from_lists(cls, keys: List[bytes], vals: List[bytes]) -> "ScanCols":
+        n = len(keys)
+        klens = np.fromiter(map(len, keys), np.int64, n)
+        vlens = np.fromiter(map(len, vals), np.int64, n)
+        return cls(n, b"".join(keys), klens, vlens, vals_list=vals)
+
+    @classmethod
+    def from_blobs(cls, n: int, keys_blob: bytes, vals_blob: bytes,
+                   vlens: np.ndarray, klens: np.ndarray) -> "ScanCols":
+        vlens = np.asarray(vlens, np.int64)
+        voffs = np.zeros(n, np.int64)
+        if n > 1:
+            np.cumsum(vlens[:-1], out=voffs[1:])
+        return cls(n, keys_blob, np.asarray(klens, np.int64), vlens,
+                   vals_blob, voffs)
+
+
+class RowsBlock:
+    """Encoded rows selected from a scan, addressed for batch decode:
+    blob + per-row (offset, length) + destination column index."""
+    __slots__ = ("blob", "offs", "lens", "idxs")
+
+    def __init__(self, blob: bytes, offs: np.ndarray, lens: np.ndarray,
+                 idxs: np.ndarray):
+        self.blob = blob
+        self.offs = np.asarray(offs, np.int64)
+        self.lens = np.asarray(lens, np.int32)
+        self.idxs = np.asarray(idxs, np.int32)
+
+    @classmethod
+    def from_pairs(cls, pairs: List[Tuple[int, bytes]]) -> "RowsBlock":
+        n = len(pairs)
+        lens = np.fromiter((len(r) for _, r in pairs), np.int32, n)
+        offs = np.zeros(n, np.int64)
+        if n > 1:
+            np.cumsum(lens[:-1], out=offs[1:])
+        idxs = np.fromiter((i for i, _ in pairs), np.int32, n)
+        return cls(b"".join(r for _, r in pairs), offs, lens, idxs)
+
+    @classmethod
+    def from_scan(cls, scan: ScanCols, scan_idx: np.ndarray,
+                  dest_idx: np.ndarray) -> "RowsBlock":
+        if scan.vals_blob is not None:
+            return cls(scan.vals_blob, scan.voffs[scan_idx],
+                       scan.vlens[scan_idx], dest_idx)
+        vals = list(map(scan.vals_list.__getitem__, scan_idx.tolist()))
+        lens = scan.vlens[scan_idx]
+        offs = np.zeros(len(vals), np.int64)
+        if len(vals) > 1:
+            np.cumsum(lens[:-1], out=offs[1:])
+        return cls(b"".join(vals), offs, lens, dest_idx)
+
+    def __len__(self) -> int:
+        return len(self.idxs)
+
+    def items(self):
+        """(dest index, row bytes) pairs — the Python-codec fallback."""
+        for j in range(len(self.idxs)):
+            o = int(self.offs[j])
+            yield int(self.idxs[j]), self.blob[o:o + int(self.lens[j])]
+
+
+def scan_cols(engine, prefix: bytes) -> ScanCols:
+    """Batched columnar scan of an engine prefix range (key order)."""
+    fn = getattr(engine, "scan_cols", None)
+    if fn is not None:
+        return fn(prefix)
+    fn = getattr(engine, "scan_batch", None)
+    if fn is not None:
+        return ScanCols.from_lists(*fn(prefix))
+    keys: List[bytes] = []
+    vals: List[bytes] = []
+    for k, v in engine.prefix(prefix):
+        keys.append(k)
+        vals.append(v)
+    return ScanCols.from_lists(keys, vals)

@@ -57,7 +57,10 @@ def _register_defaults() -> None:
              rt.RaftCode, rt.LogType, rt.LogRecord,
              rt.AskForVoteRequest, rt.AskForVoteResponse,
              rt.AppendLogRequest, rt.AppendLogResponse,
-             rt.SendSnapshotRequest, rt.SendSnapshotResponse)
+             rt.SendSnapshotRequest, rt.SendSnapshotResponse,
+             # NEW types append at the END: registry ids are positional
+             # and must stay stable across versions (wire compat)
+             st.ScanPartResponse)
 
 
 def _zigzag(n: int) -> int:

@@ -406,6 +406,53 @@ class StorageClient:
             return "no_part"
         return None
 
+    # ------------------------------------------------------------------
+    # snapshot sync (TPU engine feed; see processors.scan_part_cols)
+    # ------------------------------------------------------------------
+    def scan_part_cols(self, space_id: int, part: int, kind: int):
+        """Leader-routed columnar scan of one (part, kind) range, with
+        the same leader-redirect/fresh-part retries as any KV op.
+        -> ScanPartResponse (result.code != SUCCEEDED on failure)."""
+        from .types import ScanPartResponse
+
+        def call(svc):
+            try:
+                return svc.scan_part_cols(space_id, part, kind)
+            except Exception:
+                # unreachable host == hintless leader change: rotate
+                return ScanPartResponse(PartResult(
+                    ErrorCode.E_LEADER_CHANGED, None))
+
+        def classify(resp):
+            if resp.result.code == ErrorCode.E_LEADER_CHANGED:
+                return resp.result.leader or ""
+            if resp.result.code in (ErrorCode.E_PART_NOT_FOUND,
+                                    ErrorCode.E_SPACE_NOT_FOUND):
+                return "no_part"
+            return None
+
+        return self._kv_retry(space_id, part, call, classify)
+
+    def space_versions(self, space_id: int) -> Optional[Tuple]:
+        """Freshness token: engine write-version of every host serving
+        the space's parts, plus the part->leader routing used to read
+        them. Probes run concurrently (this is on the TPU engine's
+        per-query hot path). None when any host is unreachable — the
+        TPU engine then declines and the CPU fan-out path serves."""
+        n = self.sm.num_parts(space_id)
+        routing = tuple(sorted(
+            (p, self._leader(space_id, p)) for p in range(1, n + 1)))
+        hosts = sorted({h for _, h in routing})
+        futs = [(h, self._pool.submit(self._hosts[h].space_version,
+                                      space_id)) for h in hosts]
+        versions = []
+        for host, fut in futs:
+            try:
+                versions.append((host, int(fut.result())))
+            except Exception:
+                return None
+        return tuple(versions), routing
+
     def kv_put(self, space_id: int, kvs: List[Tuple[bytes, bytes]]) -> Status:
         by_part: Dict[int, List[Tuple[bytes, bytes]]] = {}
         for k, v in kvs:

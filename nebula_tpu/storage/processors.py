@@ -662,6 +662,46 @@ class StorageService:
         return Status.OK()
 
     # ------------------------------------------------------------------
+    # snapshot sync — the TPU engine's feed from remote parts (this is
+    # the storage-service seam the north star designates as the engine
+    # plugin boundary; ref storage/StorageServer.cpp:32-55)
+    # ------------------------------------------------------------------
+    def space_version(self, space_id: int) -> int:
+        """Monotonic write-version of this host's engine for the space
+        (-1 when the space has no local engine). Any data change bumps
+        it; the TPU engine's freshness token aggregates these across
+        hosts."""
+        engine = self.store.space_engine(space_id)
+        return -1 if engine is None else int(engine.write_version)
+
+    def scan_part_cols(self, space_id: int, part: int,
+                       kind: int) -> "ScanPartResponse":
+        """Leader-local columnar scan of one (part, kind) data range.
+        Same leader guard as every read (reads are leader-only, ref
+        KVStore.h) so a snapshot never mixes stale follower data."""
+        from .types import ScanPartResponse
+        t0 = time.monotonic()
+        stats.add_value("storage.scan_part_qps")
+        pr = self.store.part(space_id, part)
+        if not pr.ok():
+            leader = pr.status.msg if \
+                pr.status.code == ErrorCode.E_LEADER_CHANGED else None
+            return ScanPartResponse(PartResult(pr.status.code, leader))
+        from ..kvstore.scan import scan_cols
+        import numpy as np
+        scan = scan_cols(pr.value().engine, ku.part_data_prefix(part, kind))
+        if scan.vals_blob is not None:
+            vals_blob = scan.vals_blob
+        else:
+            vals_blob = b"".join(scan.vals_list)
+        resp = ScanPartResponse(
+            PartResult(), scan.n, scan.keys_blob, vals_blob,
+            np.asarray(scan.vlens, np.int64).tobytes(),
+            np.asarray(scan.klens, np.int64).tobytes())
+        resp.latency_us = int((time.monotonic() - t0) * 1e6)
+        return resp
+
+    # ------------------------------------------------------------------
     # generic KV + uuid
     # ------------------------------------------------------------------
     def kv_put(self, space_id: int, part: int,

@@ -55,6 +55,21 @@ class BoundRequest:
 
 
 @dataclass
+class ScanPartResponse:
+    """Columnar snapshot-sync scan of one (part, kind) range — the wire
+    form of engine_tpu.csr.ScanCols. Feeds the TPU engine's CSR build
+    from remote storaged parts (the storage-seam role the reference
+    gives its engine plugins, ref storage/StorageServer.cpp:32-55)."""
+    result: PartResult = field(default_factory=PartResult)
+    n: int = 0
+    keys_blob: bytes = b""
+    vals_blob: bytes = b""
+    vlens: bytes = b""          # int64[n] little-endian
+    klens: bytes = b""          # int64[n] little-endian
+    latency_us: int = 0
+
+
+@dataclass
 class BoundResponse:
     results: Dict[int, PartResult] = field(default_factory=dict)  # per part
     vertices: List[VertexData] = field(default_factory=list)
