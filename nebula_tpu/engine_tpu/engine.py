@@ -55,7 +55,8 @@ class TpuGraphEngine:
         self._sm = None
         self._meta = None
         self.stats = {"go_served": 0, "path_served": 0, "rebuilds": 0,
-                      "fallbacks": 0, "sharded_queries": 0}
+                      "fallbacks": 0, "sharded_queries": 0,
+                      "fast_materialize": 0, "slow_materialize": 0}
 
     # ------------------------------------------------------------------
     def attach(self, cluster) -> None:
@@ -186,14 +187,26 @@ class TpuGraphEngine:
             active = active & device_mask
         mask = np.asarray(active)
 
-        resp = self._materialize(snap, mask, ctx, yield_cols, s)
-        rows: List[Tuple] = []
-        st = ex._emit_go_rows(ctx, resp, rows, yield_cols, local_filter,
-                              alias_map, name_by_type, roots={},
-                              input_index={}, needs_input=False,
-                              needs_dst=_needs_dst(yield_cols, s))
-        if not st.ok():
-            return StatusOr.from_status(st)
+        rows: Optional[List[Tuple]] = None
+        if local_filter is None:
+            # columnar fast path: one numpy gather per YIELD column over
+            # the host mirrors; declines (None) on any case whose CPU
+            # semantics aren't a pure gather — identity by construction
+            from . import materialize
+            rows = materialize.emit_rows(snap, mask, ctx, yield_cols,
+                                         alias_map, name_by_type)
+        if rows is not None:
+            self.stats["fast_materialize"] += 1
+        else:
+            self.stats["slow_materialize"] += 1
+            resp = self._materialize(snap, mask, ctx, yield_cols, s)
+            rows = []
+            st = ex._emit_go_rows(ctx, resp, rows, yield_cols, local_filter,
+                                  alias_map, name_by_type, roots={},
+                                  input_index={}, needs_input=False,
+                                  needs_dst=_needs_dst(yield_cols, s))
+            if not st.ok():
+                return StatusOr.from_status(st)
         result = ex.InterimResult(columns, rows)
         if s.yield_ and s.yield_.distinct:
             result = result.distinct()

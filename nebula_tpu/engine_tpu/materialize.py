@@ -1,0 +1,230 @@
+"""Columnar GO-result materialization — the device-path answer to the
+reference's in-scan row emission (ref storage/QueryBaseProcessor.inl:
+380-458 emits encoded rows inside the storage hot loop).
+
+The traversal kernel emits a bool edge mask; this module turns it into
+result rows WITHOUT per-edge Python: the mask compacts to index arrays
+(np.nonzero), every YIELD column compiles to one numpy gather over the
+snapshot's host prop mirrors, and rows assemble with a single zip.
+
+Identity discipline: each column planner handles only cases whose CPU
+semantics are a pure per-row gather; ANYTHING else — unsupported
+expression kinds, a row whose edge type mismatches a named prop ref
+(CPU raises), a source/dst vertex missing a referenced tag (CPU
+raises) — returns None and the engine falls back to the slow
+VertexData path, which reproduces CPU behavior exactly. So the fast
+path can only produce rows the slow path would have produced.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..filter.expressions import (DestPropExpr, EdgeDstIdExpr, EdgePropExpr,
+                                  EdgeRankExpr, EdgeSrcIdExpr, EdgeTypeExpr,
+                                  Literal, SourcePropExpr)
+
+DEFAULT_MAX_EDGES_PER_VERTEX = 10000
+
+
+class _PartEnv:
+    """Shared per-part gathered arrays, built lazily once per column
+    that needs them."""
+
+    __slots__ = ("snap", "shard", "p0", "idx", "_cache")
+
+    def __init__(self, snap, shard, p0: int, idx: np.ndarray):
+        self.snap = snap
+        self.shard = shard
+        self.p0 = p0
+        self.idx = idx
+        self._cache: Dict[str, np.ndarray] = {}
+
+    def _get(self, name: str, fn) -> np.ndarray:
+        a = self._cache.get(name)
+        if a is None:
+            a = fn()
+            self._cache[name] = a
+        return a
+
+    def src_local(self):
+        return self._get("src_local", lambda: self.shard.edge_src[self.idx])
+
+    def src_vid(self):
+        return self._get("src_vid",
+                         lambda: self.shard.vids[self.src_local()])
+
+    def dst_vid(self):
+        return self._get("dst_vid",
+                         lambda: self.shard.edge_dst_vid[self.idx])
+
+    def rank(self):
+        return self._get("rank", lambda: self.shard.edge_rank[self.idx])
+
+    def etype(self):
+        return self._get("etype", lambda: self.shard.edge_etype[self.idx])
+
+
+def _alias_match(env: _PartEnv, alias_name: str,
+                 name_by_type: Dict[int, str]) -> np.ndarray:
+    """bool[n]: rows whose edge name equals alias_name (the CPU
+    _check_edge / _eval_yield None-masking rule)."""
+    ets = env.etype()
+    out = np.zeros(len(ets), bool)
+    for t in np.unique(ets):
+        if name_by_type.get(abs(int(t))) == alias_name:
+            out |= ets == t
+    return out
+
+
+def _masked_object(vals: np.ndarray, match: np.ndarray) -> np.ndarray:
+    out = vals.astype(object)
+    out[~match] = None
+    return out
+
+
+def _plan(expr, sm, space: int, alias_map: Dict[str, str],
+          name_by_type: Dict[int, str]
+          ) -> Optional[Callable[[_PartEnv], Optional[np.ndarray]]]:
+    """Compile one YIELD expression to a per-part column evaluator.
+    None = not vectorizable (caller falls back to the slow path)."""
+    if isinstance(expr, Literal):
+        v = expr.value
+        return lambda env: np.full(len(env.idx), v, dtype=object)
+
+    if isinstance(expr, (EdgeDstIdExpr, EdgeSrcIdExpr, EdgeRankExpr)):
+        src = {EdgeDstIdExpr: _PartEnv.dst_vid, EdgeSrcIdExpr: _PartEnv.src_vid,
+               EdgeRankExpr: _PartEnv.rank}[type(expr)]
+        if expr.edge is None:
+            return lambda env: src(env).astype(object)
+        alias_name = alias_map.get(expr.edge, expr.edge)
+
+        def named(env):
+            # rows of another edge type yield None (the _eval_yield rule)
+            return _masked_object(src(env),
+                                  _alias_match(env, alias_name, name_by_type))
+        return named
+
+    if isinstance(expr, EdgeTypeExpr):
+        def type_name(env):
+            ets = env.etype()
+            out = np.empty(len(ets), object)
+            for t in np.unique(ets):
+                out[ets == t] = name_by_type.get(abs(int(t)),
+                                                 str(abs(int(t))))
+            return out
+        return type_name
+
+    if isinstance(expr, EdgePropExpr):
+        alias_name = (alias_map.get(expr.edge, expr.edge)
+                      if expr.edge is not None else None)
+        prop = expr.prop
+
+        def edge_prop(env):
+            ets = env.etype()
+            out = np.empty(len(ets), object)
+            for t in np.unique(ets):
+                t = int(t)
+                name = name_by_type.get(abs(t))
+                if alias_name is not None and name != alias_name:
+                    return None  # CPU raises on mismatched rows: fallback
+                cols = env.shard.edge_props.get(t)
+                if cols is None or prop not in cols:
+                    return None  # CPU raises "prop not found": fallback
+                sel = ets == t
+                out[sel] = cols[prop].host[env.idx[sel]]
+            return out
+        return edge_prop
+
+    if isinstance(expr, SourcePropExpr):
+        tid = sm.tag_id(space, expr.tag)
+        if tid is None:
+            return None
+        prop = expr.prop
+
+        def src_prop(env):
+            cols = env.shard.tag_props.get(tid)
+            if cols is None or prop not in cols:
+                return None   # tag/prop unknown here: CPU raises
+            col = cols[prop]
+            locals_ = env.src_local()
+            if col.present is not None and not col.present[locals_].all():
+                return None   # some src lacks the tag row: CPU raises
+            return col.host[locals_]
+        return src_prop
+
+    if isinstance(expr, DestPropExpr):
+        tid = sm.tag_id(space, expr.tag)
+        if tid is None:
+            return None
+        prop = expr.prop
+
+        def dst_prop(env):
+            dparts = env.shard.edge_dst_part[env.idx]
+            dlocals = env.shard.edge_dst_local[env.idx]
+            out = np.empty(len(env.idx), object)
+            for q in np.unique(dparts):
+                qshard = env.snap.shards[int(q)]
+                cols = qshard.tag_props.get(tid)
+                if cols is None or prop not in cols:
+                    return None
+                col = cols[prop]
+                sel = dparts == q
+                loc = dlocals[sel]
+                if col.present is not None and not col.present[loc].all():
+                    return None   # dst lacks the tag row: CPU raises
+                out[sel] = col.host[loc]
+            return out
+        return dst_prop
+
+    return None   # FunctionCall / arithmetic / $- refs: slow path
+
+
+def _apply_cap(shard, idx: np.ndarray,
+               cap: int = DEFAULT_MAX_EDGES_PER_VERTEX) -> np.ndarray:
+    """Per-(src, etype) edge cap over ACTIVE edges — identical to the
+    slow path's cap_counts (ref FLAGS_max_edge_returned_per_vertex).
+    Active indices are ascending and canonical order groups (src,
+    etype) contiguously, so within-group rank is positional."""
+    if len(idx) <= cap:
+        return idx
+    grp_change = np.ones(len(idx), bool)
+    src = shard.edge_src[idx]
+    et = shard.edge_etype[idx]
+    grp_change[1:] = (src[1:] != src[:-1]) | (et[1:] != et[:-1])
+    starts = np.nonzero(grp_change)[0]
+    counts = np.diff(np.append(starts, len(idx)))
+    rank = np.arange(len(idx)) - np.repeat(starts, counts)
+    return idx[rank < cap]
+
+
+def emit_rows(snap, mask: np.ndarray, ctx, yield_cols, alias_map,
+              name_by_type) -> Optional[List[Tuple]]:
+    """Fully-columnar GO row emission. None = fall back to the slow
+    (VertexData) path. Only call when no CPU-side filter or input
+    back-references remain (can_serve already excludes $-/$var)."""
+    sm = ctx.sm
+    space = ctx.space_id()
+    plans = []
+    for c in yield_cols:
+        p = _plan(c.expr, sm, space, alias_map, name_by_type)
+        if p is None:
+            return None
+        plans.append(p)
+
+    rows: List[Tuple] = []
+    for p0, shard in enumerate(snap.shards):
+        idx = np.nonzero(mask[p0])[0]
+        if idx.size == 0:
+            continue
+        idx = _apply_cap(shard, idx)
+        env = _PartEnv(snap, shard, p0, idx)
+        cols = []
+        for plan in plans:
+            col = plan(env)
+            if col is None:
+                return None
+            cols.append(col)
+        rows.extend(zip(*(c.tolist() for c in cols)))
+    return rows
