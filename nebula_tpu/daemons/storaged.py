@@ -159,6 +159,27 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
             else:
                 store.remove_space(kw["space_id"])
 
+    # the web service is created after the heartbeat thread starts, so
+    # the callback reads it through this box (and the box records the
+    # event in case it fires inside that window)
+    wc_state = {"fired": False, "web": None}
+
+    def on_wrong_cluster():
+        # a mis-pointed storaged must refuse ALL traffic — rpc, raft and
+        # http admin alike (the reference daemon aborts the process)
+        wc_state["fired"] = True
+        server.stop()
+        if raft_server is not None:
+            raft_server.stop()
+        if node is not None:
+            node.stop()
+            net = getattr(node, "raft_net", None)
+            if net is not None:
+                net.shutdown()
+        if wc_state["web"] is not None:
+            wc_state["web"].stop()
+
+    mc.on_wrong_cluster = on_wrong_cluster
     mc.add_listener(on_change)
     # register with metad BEFORE the first topology sync so part
     # allocation can target this host (waitForMetadReady ordering)
@@ -179,6 +200,9 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
                          host=host, port=ws_port)
         _register_admin_handlers(web, storage)
         web.start()
+        wc_state["web"] = web
+        if wc_state["fired"]:   # wrong-cluster fired before web existed
+            web.stop()
     return StoragedHandle(store, storage, mc, server, web, node, raft_server)
 
 

@@ -34,6 +34,10 @@ class MetaClient:
         self.role = role
         self._cluster_id_file = cluster_id_file
         self.wrong_cluster = False
+        # fired (once, from the heartbeat thread) when metad rejects our
+        # cluster id — the daemon must stop serving (the reference
+        # aborts the process, HBProcessor clusterId check)
+        self.on_wrong_cluster: Optional[Callable[[], None]] = None
         self._listeners: List[Callable] = []
         self._known_parts: Dict[int, Set[int]] = {}  # space -> my part ids
         self._known_spaces: Dict[int, object] = {}
@@ -131,6 +135,11 @@ class MetaClient:
                     print(f"FATAL: metad {self.meta_addr} belongs to a "
                           f"different cluster (our id {cluster_id}) — "
                           f"heartbeats stopped", file=sys.stderr)
+                    if self.on_wrong_cluster is not None:
+                        try:
+                            self.on_wrong_cluster()
+                        except Exception:
+                            pass
                     return
             except Exception:
                 pass

@@ -122,15 +122,22 @@ class RpcServer:
                 return wire.encode((False, "unserializable server error"))
 
     def start(self) -> "RpcServer":
-        self._thread = threading.Thread(target=self._server.serve_forever,
-                                        name=f"rpc-{self.port}", daemon=True)
-        self._thread.start()
+        with self._conns_lock:   # atomic vs stop(): no serve-after-close
+            if self._stopping:
+                return self   # stopped before serving (e.g. wrong_cluster)
+            self._thread = threading.Thread(
+                target=self._server.serve_forever,
+                name=f"rpc-{self.port}", daemon=True)
+            self._thread.start()
         return self
 
     def stop(self) -> None:
         with self._conns_lock:
+            if self._stopping:
+                return              # idempotent — callers may race
             self._stopping = True   # handlers mid-accept close themselves
-        self._server.shutdown()
+        if self._thread is not None:
+            self._server.shutdown()
         self._server.server_close()
         # kill established connections too — a stopped daemon must go
         # silent (peers would otherwise keep talking to handler threads
@@ -154,23 +161,26 @@ class RpcServer:
 # ---------------------------------------------------------------------------
 
 class _ConnPool:
-    """Pooled sockets to one address (ThriftClientManager's role)."""
+    """Pooled sockets to one address (ThriftClientManager's role).
 
-    def __init__(self, host: str, port: int, size: int = 4,
-                 timeout: float = 30.0):
-        self.host, self.port, self.timeout = host, port, timeout
+    Timeouts are per-acquire, not per-pool: raft clients (1.5s
+    election-scale deadlines) and bulk movers (30s) share one pool per
+    peer without one silently inheriting the other's deadline."""
+
+    def __init__(self, host: str, port: int, size: int = 4):
+        self.host, self.port = host, port
         self._free: "queue.Queue[socket.socket]" = queue.Queue(maxsize=size)
         self._size = size
         self._created = 0
         self._lock = threading.Lock()
 
-    def _connect(self) -> socket.socket:
+    def _connect(self, timeout: float) -> socket.socket:
         sock = socket.create_connection((self.host, self.port),
-                                        timeout=self.timeout)
+                                        timeout=timeout)
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         return sock
 
-    def acquire(self) -> socket.socket:
+    def acquire(self, timeout: float) -> socket.socket:
         try:
             return self._free.get_nowait()
         except queue.Empty:
@@ -179,11 +189,11 @@ class _ConnPool:
             if self._created < self._size:
                 self._created += 1
                 try:
-                    return self._connect()
+                    return self._connect(timeout)
                 except Exception:
                     self._created -= 1
                     raise
-        return self._free.get(timeout=self.timeout)
+        return self._free.get(timeout=timeout)
 
     def release(self, sock: Optional[socket.socket]) -> None:
         if sock is None:  # connection died — allow a replacement
@@ -218,11 +228,10 @@ class RpcClient:
         self._key = (host, int(port_s))
         self.addr = addr
         self.service = service
+        self._timeout = timeout if timeout is not None else 30.0
         with RpcClient._pools_lock:
             if self._key not in RpcClient._pools:
-                RpcClient._pools[self._key] = _ConnPool(
-                    host, int(port_s),
-                    timeout=timeout if timeout is not None else 30.0)
+                RpcClient._pools[self._key] = _ConnPool(host, int(port_s))
         self._pool = RpcClient._pools[self._key]
         # low-latency callers (raft) cap the stale-socket drain so a
         # black-holed peer costs ~1 timeout, not pool_size timeouts
@@ -235,11 +244,32 @@ class RpcClient:
         # draining the whole pool plus one fresh connect
         attempts = self._max_attempts or (self._pool._size + 1)
         for _ in range(attempts):
-            sock = self._pool.acquire()
+            try:
+                sock = self._pool.acquire(self._timeout)
+            except socket.timeout as e:
+                # SYN-dropped peer: the connect already consumed the
+                # caller's full budget — don't multiply it by retrying
+                raise RpcError(f"rpc to {self.addr} connect timed out "
+                               f"({self._timeout}s): {e}") from e
+            except queue.Empty as e:
+                raise RpcError(f"rpc to {self.addr}: no pooled connection "
+                               f"within {self._timeout}s") from e
+            except OSError as e:
+                last_err = e   # instant failures (refused etc.): retry
+                continue
+            sock.settimeout(self._timeout)  # deadline is per-call
             try:
                 _send_frame(sock, payload)
                 raw = _recv_frame(sock)
-            except (ConnectionError, OSError, socket.timeout) as e:
+            except socket.timeout as e:
+                # a live-but-unresponsive (black-holed) peer: retrying
+                # another pooled socket would multiply the deadline —
+                # fail within the caller's budget instead
+                sock.close()
+                self._pool.release(None)
+                raise RpcError(f"rpc to {self.addr} timed out "
+                               f"({self._timeout}s): {e}") from e
+            except (ConnectionError, OSError) as e:
                 sock.close()
                 self._pool.release(None)
                 last_err = e
@@ -261,7 +291,8 @@ def proxy(addr: str, service: str, timeout: Optional[float] = None,
           max_attempts: Optional[int] = None) -> RpcClient:
     """A client whose attribute calls mirror the remote service's
     methods — drop-in for the in-proc service objects that
-    StorageClient/MetaClient hold per host. `timeout` applies only if
-    this address's connection pool doesn't exist yet."""
+    StorageClient/MetaClient hold per host. `timeout` is this client's
+    per-call deadline (connect + send + recv), independent of any other
+    client sharing the address's connection pool."""
     return RpcClient(addr, service, timeout=timeout,
                      max_attempts=max_attempts)

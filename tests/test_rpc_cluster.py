@@ -305,3 +305,70 @@ def test_balance_refused_on_non_replicated_cluster():
         assert "replicated" in r.error_msg or "admin" in r.error_msg
     finally:
         graphd.stop(); s0.stop(); metad.stop()
+
+
+# ---------------------------------------------------------------------------
+# transport deadlines + cluster-id enforcement (advisor findings)
+# ---------------------------------------------------------------------------
+
+def test_per_call_timeout_independent_of_pool():
+    """A black-holed peer must cost <= the CALLER's timeout even when a
+    long-timeout client created the address's connection pool first
+    (previously the pool pinned the first client's deadline)."""
+    import socket
+    import threading
+
+    from nebula_tpu.rpc import proxy
+    from nebula_tpu.rpc.transport import RpcError
+
+    lst = socket.socket()
+    lst.bind(("127.0.0.1", 0))
+    lst.listen(8)
+    addr = f"127.0.0.1:{lst.getsockname()[1]}"
+    accepted = []
+
+    def accept_loop():
+        try:
+            while True:
+                c, _ = lst.accept()
+                accepted.append(c)   # accept, never respond
+        except OSError:
+            pass
+
+    threading.Thread(target=accept_loop, daemon=True).start()
+    try:
+        proxy(addr, "svc", timeout=30.0)          # creates the pool
+        fast = proxy(addr, "svc", timeout=0.5)
+        t0 = time.time()
+        with pytest.raises(RpcError):
+            fast.ping()
+        elapsed = time.time() - t0
+        assert elapsed < 2.0, f"timeout not per-call: took {elapsed:.1f}s"
+    finally:
+        lst.close()
+        for c in accepted:
+            c.close()
+
+
+def test_wrong_cluster_storaged_refuses_traffic(tmp_path):
+    """A storaged pointed at a metad from a different cluster must stop
+    serving (the reference daemon aborts; ref HBProcessor clusterId
+    check), not keep serving traffic while invisible to liveness."""
+    from nebula_tpu.rpc import proxy
+    from nebula_tpu.rpc.transport import RpcError
+
+    metad = serve_metad()
+    cid_file = tmp_path / "cluster.id"
+    cid_file.write_text(str(metad.meta.cluster_id + 1))  # stale/foreign id
+    s = serve_storaged(metad.addr, cluster_id_file=str(cid_file),
+                       load_interval=0.1)
+    try:
+        _wait(lambda: s.meta_client.wrong_cluster,
+              msg="wrong-cluster detection")
+        _wait(lambda: s.server._stopping, msg="rpc server refusing traffic")
+        client = proxy(s.addr, "storage", timeout=1.0, max_attempts=2)
+        with pytest.raises(RpcError):
+            client.space_version(1)
+    finally:
+        s.stop()
+        metad.stop()
