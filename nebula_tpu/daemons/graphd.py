@@ -46,6 +46,7 @@ class GraphdHandle:
 
     def stop(self) -> None:
         self.meta_client.stop()
+        self.engine.client.close()   # ends the version-watch threads
         self.server.stop()
         if self.web:
             self.web.stop()

@@ -12,6 +12,15 @@ Snapshot lifecycle: built lazily from the KV store on first use, keyed
 to the engine's write_version + catalog version; stale snapshots are
 rebuilt transparently (auto_refresh) — the Phase-6 upgrade path is
 delta buffers + periodic repack (SURVEY.md §7 hard-part (a)).
+
+Freshness model (remote topology): the token rides a push-fed watch
+cache, not per-query probes. Writes through THIS graphd are strictly
+read-your-writes (the client's local write seq is part of the token);
+writes through ANOTHER graphd become visible within one watch push
+(~50-150ms) — the same staleness class as the reference's 1s cached
+topology pull (MetaClient.cpp:120-193). A local write currently
+invalidates twice (seq bump now, version push later); cheap once
+invalidation is a delta apply instead of a rebuild.
 """
 from __future__ import annotations
 

@@ -13,6 +13,7 @@ exposing the same method surface (rpc/storage_proxy).
 """
 from __future__ import annotations
 
+import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -48,6 +49,15 @@ class StorageClient:
         self._leader_cache: Dict[Tuple[int, int], str] = {}
         self._pool = ThreadPoolExecutor(max_workers=8,
                                         thread_name_prefix="storage-client")
+        # version-watch cache: host -> {space_id: write_version}, fed by
+        # one long-poll thread per host (zero per-query version RPCs)
+        self._vlock = threading.Lock()
+        self._vcache: Dict[str, Dict[int, int]] = {}
+        self._vfresh: Dict[str, bool] = {}
+        self._vwatchers: Dict[str, threading.Thread] = {}
+        self._local_write_seq: Dict[int, int] = {}
+        self._closed = False
+        self.version_stats = {"probe_rpcs": 0, "watch_rounds": 0}
 
     # ------------------------------------------------------------------
     # routing
@@ -256,7 +266,9 @@ class StorageClient:
         def merge(acc, r):
             acc.results.update(r.results)
 
-        return self._fanout(space_id, parts, call, ExecResponse(), merge)
+        resp = self._fanout(space_id, parts, call, ExecResponse(), merge)
+        self.note_local_write(space_id)   # AFTER the write lands
+        return resp
 
     def add_edges(self, space_id: int, edges: List[NewEdge],
                   overwritable: bool = True) -> ExecResponse:
@@ -274,7 +286,9 @@ class StorageClient:
         def merge(acc, r):
             acc.results.update(r.results)
 
-        return self._fanout(space_id, parts, call, ExecResponse(), merge)
+        resp = self._fanout(space_id, parts, call, ExecResponse(), merge)
+        self.note_local_write(space_id)   # AFTER the write lands
+        return resp
 
     def delete_vertices(self, space_id: int, vids: List[int]) -> ExecResponse:
         resp = ExecResponse()
@@ -292,6 +306,7 @@ class StorageClient:
                 self.delete_edges(space_id, remote)
             r = svc.delete_vertex(space_id, part, vid)
             resp.results.update(r.results)
+        self.note_local_write(space_id)
         return resp
 
     def delete_edges(self, space_id: int, eks: List[EdgeKey]) -> ExecResponse:
@@ -307,7 +322,9 @@ class StorageClient:
         def merge(acc, r):
             acc.results.update(r.results)
 
-        return self._fanout(space_id, parts, call, ExecResponse(), merge)
+        resp = self._fanout(space_id, parts, call, ExecResponse(), merge)
+        self.note_local_write(space_id)   # AFTER the write lands
+        return resp
 
     def update_vertex(self, space_id: int, vid: int, tag_id: int,
                       items: List[UpdateItemReq], when: Optional[bytes] = None,
@@ -319,6 +336,7 @@ class StorageClient:
                                  insertable, yield_props)
         if resp.code == ErrorCode.E_LEADER_CHANGED:
             self._note_leader(space_id, part, resp.leader)
+        self.note_local_write(space_id)   # AFTER the write lands
         return resp
 
     def update_edge(self, space_id: int, ek: EdgeKey,
@@ -339,6 +357,7 @@ class StorageClient:
                                 items, None, True, None)
         elif resp.code == ErrorCode.E_LEADER_CHANGED:
             self._note_leader(space_id, part, resp.leader)
+        self.note_local_write(space_id)   # AFTER the write lands
         return resp
 
     def get_uuid(self, space_id: int, name: str) -> Tuple[PartResult, int]:
@@ -435,23 +454,89 @@ class StorageClient:
 
     def space_versions(self, space_id: int) -> Optional[Tuple]:
         """Freshness token: engine write-version of every host serving
-        the space's parts, plus the part->leader routing used to read
-        them. Probes run concurrently (this is on the TPU engine's
-        per-query hot path). None when any host is unreachable — the
-        TPU engine then declines and the CPU fan-out path serves."""
+        the space's parts (from the local watch cache — ZERO per-query
+        RPCs; storaged pushes changes through the `watch_space_versions`
+        long-poll), the part->leader routing, and this client's own
+        write sequence (read-your-writes while a push is in flight).
+        None when any host's watch channel is down — the TPU engine
+        then declines and the CPU fan-out path serves."""
         n = self.sm.num_parts(space_id)
         routing = tuple(sorted(
             (p, self._leader(space_id, p)) for p in range(1, n + 1)))
         hosts = sorted({h for _, h in routing})
-        futs = [(h, self._pool.submit(self._hosts[h].space_version,
-                                      space_id)) for h in hosts]
         versions = []
-        for host, fut in futs:
-            try:
-                versions.append((host, int(fut.result())))
-            except Exception:
+        for host in hosts:
+            v = self._cached_version(host, space_id)
+            if v is None:
                 return None
-        return tuple(versions), routing
+            versions.append((host, v))
+        return (tuple(versions), routing,
+                self._local_write_seq.get(space_id, 0))
+
+    def _cached_version(self, host: str, space_id: int) -> Optional[int]:
+        """This host's engine write-version for the space from the watch
+        cache; one synchronous probe primes a cold host. None while the
+        host's watch channel is broken (host unreachable)."""
+        with self._vlock:
+            fresh = self._vfresh.get(host)
+            vmap = self._vcache.get(host)
+        if fresh and vmap is not None:
+            return vmap.get(space_id, -1)   # -1 = no engine (space_version)
+        if fresh is False:
+            return None                     # watch channel down
+        self._ensure_watcher(host)          # cold host: start watching...
+        svc = self._hosts.get(host)
+        if svc is None:
+            return None
+        try:                                # ...and prime synchronously
+            self.version_stats["probe_rpcs"] += 1
+            return int(svc.space_version(space_id))
+        except Exception:
+            return None
+
+    def _ensure_watcher(self, host: str) -> None:
+        with self._vlock:
+            t = self._vwatchers.get(host)
+            if t is not None and t.is_alive():
+                return
+            t = threading.Thread(target=self._watch_host, args=(host,),
+                                 name=f"version-watch-{host}", daemon=True)
+            self._vwatchers[host] = t
+        t.start()
+
+    def _watch_host(self, host: str) -> None:
+        """One long-poll loop per storage host. A broken connection
+        (storaged death) marks the host stale immediately — the TPU
+        path declines until the channel re-establishes."""
+        known: Dict[int, int] = {}
+        while not self._closed:
+            svc = self._hosts.get(host)
+            if svc is None:
+                break
+            try:
+                cur = svc.watch_space_versions(known, timeout=1.0)
+            except Exception:
+                with self._vlock:
+                    self._vfresh[host] = False
+                known = {}
+                time.sleep(0.25)
+                continue
+            with self._vlock:
+                self._vcache[host] = cur
+                self._vfresh[host] = True
+            self.version_stats["watch_rounds"] += 1
+            known = cur
+
+    def note_local_write(self, space_id: int) -> None:
+        """Every mutation through this client bumps the space's local
+        write sequence, which is part of the freshness token — so this
+        client's next read rebuilds/patches the device snapshot even
+        before the storaged version push lands (read-your-writes)."""
+        self._local_write_seq[space_id] = \
+            self._local_write_seq.get(space_id, 0) + 1
+
+    def close(self) -> None:
+        self._closed = True
 
     def kv_put(self, space_id: int, kvs: List[Tuple[bytes, bytes]]) -> Status:
         by_part: Dict[int, List[Tuple[bytes, bytes]]] = {}
@@ -464,6 +549,7 @@ class StorageClient:
                 self._classify_status)
             if not st.ok():
                 return st
+        self.note_local_write(space_id)   # AFTER the writes land
         return Status.OK()
 
     def kv_get(self, space_id: int, key: bytes) -> StatusOr:
@@ -493,6 +579,7 @@ class StorageClient:
             if not st.ok():
                 return Status.error(st.code, f"{host}: {st.msg}"), total
             total += n
+        self.note_local_write(space_id)   # AFTER the ingest lands
         return Status.OK(), total
 
     def create_checkpoint(self, name: str) -> Status:

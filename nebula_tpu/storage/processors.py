@@ -674,6 +674,30 @@ class StorageService:
         engine = self.store.space_engine(space_id)
         return -1 if engine is None else int(engine.write_version)
 
+    def _version_map(self) -> Dict[int, int]:
+        out: Dict[int, int] = {}
+        for sid in self.store.spaces():
+            engine = self.store.space_engine(sid)
+            if engine is not None:
+                out[sid] = int(engine.write_version)
+        return out
+
+    def watch_space_versions(self, known: Optional[Dict[int, int]] = None,
+                             timeout: float = 1.0) -> Dict[int, int]:
+        """Long-poll version watch: blocks until this host's per-space
+        engine write-versions differ from `known` (or `timeout`
+        elapses), then returns the current map. The query engine's
+        freshness cache rides this channel instead of probing per query
+        (ref role: MetaClient.cpp:120-193's cached 1s topology pull —
+        here push-on-change, so writes invalidate within ~50ms)."""
+        deadline = time.monotonic() + min(float(timeout), 5.0)
+        known = dict(known or {})
+        while True:
+            cur = self._version_map()
+            if cur != known or time.monotonic() >= deadline:
+                return cur
+            time.sleep(0.05)
+
     def scan_part_cols(self, space_id: int, part: int,
                        kind: int) -> "ScanPartResponse":
         """Leader-local columnar scan of one (part, kind) data range.

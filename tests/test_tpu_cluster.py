@@ -105,6 +105,25 @@ def test_tpu_sees_remote_writes(net_cluster):
     assert rt.rows == [(106,)], rt.rows
 
 
+def test_no_per_query_version_rpcs(net_cluster):
+    """Steady state: the freshness token comes from the push-fed watch
+    cache — ZERO per-query version RPCs (the round-2 hot path probed
+    every host serving the space on every query; ref role:
+    MetaClient.cpp:120-193 caches topology instead of probing)."""
+    tc, cc, tpu, _ = net_cluster
+    sc = tpu._provider._client
+    # one warm-up query may cold-prime the cache with sync probes
+    assert tc.execute("GO FROM 100 OVER like YIELD like._dst").ok()
+    probes0 = sc.version_stats["probe_rpcs"]
+    served0 = tpu.stats["go_served"]
+    for _ in range(5):
+        r = tc.execute("GO 2 STEPS FROM 100 OVER like YIELD like._dst")
+        assert r.ok(), r.error_msg
+    assert tpu.stats["go_served"] - served0 == 5, tpu.stats
+    assert sc.version_stats["probe_rpcs"] == probes0, sc.version_stats
+    assert sc.version_stats["watch_rounds"] > 0
+
+
 def test_storaged_death_falls_back_to_cpu(net_cluster):
     """Killing a storaged mid-flight: space_versions goes None and the
     engine declines; the query surface stays correct via CPU fan-out
