@@ -75,6 +75,15 @@ class CsrShard:
     edge_props: Dict[int, Dict[str, PropColumn]] = field(default_factory=dict)
     # per-tag columnar vertex props (aligned to local index)
     tag_props: Dict[int, Dict[str, PropColumn]] = field(default_factory=dict)
+    # vids added after build via the delta buffer: vid -> spare local
+    # slot in [len(vids), cap_v) (delta.py assigns them sequentially)
+    delta_vids: Dict[int, int] = field(default_factory=dict)
+
+    @property
+    def num_vids_base(self) -> int:
+        """Local slots [0, num_vids_base) belong to build-time vids;
+        anything >= is a delta-assigned spare slot."""
+        return len(self.vids)
 
 
 class CsrSnapshot:
@@ -103,7 +112,16 @@ class CsrSnapshot:
         # materialization + host-permuted dst-sorted copies + segment
         # boundaries for the scatter-free, single-gather-per-hop advance.
         # Stacks are transient — shards retain the per-part host mirrors.
-        self.kernel = build_kernel(*self._np_edge_stacks(), gidx, P, cap_v)[0]
+        orders: list = []
+        self.kernel = build_kernel(*self._np_edge_stacks(), gidx, P, cap_v,
+                                   orders_out=orders)[0]
+        # canonical-flat -> sorted position, for delta tombstone
+        # point-updates of valid_sorted (delta.py)
+        order = orders[0]
+        self.kernel_order_inv = np.empty(len(order), np.int32)
+        self.kernel_order_inv[order] = np.arange(len(order), dtype=np.int32)
+        self.delta = None                # SnapshotDelta once writes land
+        self.stale = False               # poisoned mid-apply: must not serve
         self.d_edge_src = self.kernel.src
         self.d_edge_gidx = jnp.asarray(gidx)
         self.d_edge_etype = self.kernel.etype
@@ -125,12 +143,27 @@ class CsrSnapshot:
     def locate(self, vid: int) -> Optional[Tuple[int, int]]:
         """vid -> (0-based part index, local index). Binary search over
         the sorted per-part vid array (no per-vid dict is materialized —
-        snapshots at 10M+ vertices would pay seconds building one)."""
+        snapshots at 10M+ vertices would pay seconds building one);
+        delta-added vids resolve through the shard's spare-slot map."""
         p = ku.part_id(vid, self.num_parts) - 1
-        vids = self.shards[p].vids
+        shard = self.shards[p]
+        vids = shard.vids
         i = int(np.searchsorted(vids, vid))
         if i < len(vids) and int(vids[i]) == vid:
             return (p, i)
+        local = shard.delta_vids.get(vid)
+        if local is not None:
+            return (p, local)
+        return None
+
+    def vid_of_slot(self, p0: int, local: int) -> Optional[int]:
+        """Inverse of locate (base or delta slot) — delta materialization."""
+        shard = self.shards[p0]
+        if local < shard.num_vids_base:
+            return int(shard.vids[local])
+        for vid, loc in shard.delta_vids.items():
+            if loc == local:
+                return vid
         return None
 
     def frontier_from_vids(self, vids: List[int]) -> np.ndarray:

@@ -1,0 +1,408 @@
+"""Device-snapshot delta buffer: committed writes patch the CSR in
+place instead of forcing a full rebuild.
+
+Role parity with the reference's in-place mutability (`Part::commitLogs`
+applies every committed batch straight into the engine and readers see
+it immediately, ref kvstore/Part.cpp:208-319; §2.10 P6's delta-buffer
+TPU equivalent). The feed is `kvstore/changelog.py`'s resolved logical
+entries; this module applies them to a CsrSnapshot:
+
+- Edge ADD (no canonical slot): appended to a fixed-capacity ELL
+  buffer keyed by DESTINATION slot — up to K lanes per dst. Keying by
+  dst keeps the hop union scatter-free (traverse.DeltaKernel): the
+  kernel just gathers frontier[src[v, k]] per lane.
+- Edge DELETE of a canonical edge: tombstone — the kernel's
+  valid/valid_sorted masks are point-updated on device (the segment
+  boundaries never change, so no re-sort).
+- Edge prop UPDATE of a canonical edge: host prop mirrors are patched
+  and the stacked device prop cache invalidated (filter columns
+  re-upload lazily).
+- Vertex rows: patched into the tag prop columns; NEW vids get spare
+  local slots (cap_v is lane-rounded, so shards almost always have
+  spare slots) tracked in `CsrShard.delta_vids`.
+
+Capacity exhaustion (ELL lanes, spare slots) fails the apply — the
+engine then falls back to a rebuild (repack), which folds the delta
+into a fresh base. All application is idempotent: entries carry the
+CURRENT visible state of their group, so replays converge.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..codec.row import RowReader
+from ..codec.schema import PropType
+from ..common import keys as ku
+
+_SIGN64 = np.uint64(1 << 63)
+_SIGN32 = np.uint32(1 << 31)
+_I32_MIN, _I32_MAX = -(1 << 31), (1 << 31) - 1
+
+
+def _bias64(v: np.ndarray) -> np.ndarray:
+    return (np.ascontiguousarray(v, np.int64).view(np.uint64) ^ _SIGN64)
+
+
+def _bias32(v: np.ndarray) -> np.ndarray:
+    return (np.ascontiguousarray(v, np.int32).view(np.uint32) ^ _SIGN32)
+
+
+_CANON_DT = np.dtype([("s", ">u4"), ("e", ">u4"), ("r", ">u8"),
+                      ("d", ">u8")])
+
+
+def _canon_keys(shard) -> np.ndarray:
+    """Packed big-endian (src_local, etype, rank, dst) keys of the
+    shard's canonical edges, viewable as fixed-width byte strings whose
+    lexicographic order equals the canonical sort order (the key codec
+    is order-preserving) — binary-searchable for point lookups."""
+    canon = getattr(shard, "_canon_keys", None)
+    if canon is not None:
+        return canon
+    ne = shard.num_edges
+    a = np.empty(ne, _CANON_DT)
+    a["s"] = shard.edge_src[:ne].astype(np.uint32)
+    a["e"] = _bias32(shard.edge_etype[:ne])
+    a["r"] = _bias64(shard.edge_rank[:ne])
+    a["d"] = _bias64(shard.edge_dst_vid[:ne])
+    canon = a.view("S24")
+    shard._canon_keys = canon
+    return canon
+
+
+def _canon_find(shard, src_local: int, etype: int, rank: int,
+                dst: int) -> Optional[int]:
+    """Canonical edge index of (src_local, etype, rank, dst), or None."""
+    if shard.num_edges == 0:
+        return None
+    key = np.empty(1, _CANON_DT)
+    key["s"], key["e"] = src_local, _bias32(np.int32(etype))
+    key["r"], key["d"] = _bias64(np.int64(rank)), _bias64(np.int64(dst))
+    canon = _canon_keys(shard)
+    i = int(np.searchsorted(canon, key.view("S24")[0]))
+    if i < len(canon) and canon[i] == key.view("S24")[0]:
+        return i
+    return None
+
+
+class SnapshotDelta:
+    """Host-side state of the add-buffer + tombstones for one snapshot.
+    Device mirrors are re-derived lazily after each apply batch."""
+
+    def __init__(self, snap, lanes: int = 4, max_edges: Optional[int] = None):
+        n_slots = snap.num_parts * snap.cap_v
+        self.n_slots = n_slots
+        self.K = lanes
+        # fan-in bound: reverse-copy rows make every fan-OUT from one
+        # vertex a fan-IN onto its dst slot, so lanes must be able to
+        # grow well past the average degree; cap by a ~64MB host/device
+        # budget so huge snapshots don't balloon (overflow => repack)
+        self.k_max = int(min(64, max(8, (64 << 20) // (9 * n_slots))))
+        self.h_src = np.zeros((n_slots, lanes), np.int32)
+        self.h_etype = np.zeros((n_slots, lanes), np.int32)
+        self.h_ok = np.zeros((n_slots, lanes), bool)
+        self.edge_count = 0
+        self.tomb_count = 0
+        self.max_edges = max_edges if max_edges is not None \
+            else max(1024, n_slots // 8)
+        # (part, src, etype, rank, dst) -> (gdst, lane)
+        self.map: Dict[Tuple, Tuple[int, int]] = {}
+        # (gdst, lane) -> (src_vid, etype, rank, dst_vid, props dict)
+        self.info: Dict[Tuple[int, int], Tuple] = {}
+        # src global slot -> set of (gdst, lane) — path reconstruction
+        self.by_src: Dict[int, set] = {}
+        self._device = None
+
+    def device(self):
+        """traverse.DeltaKernel for the current host state (cached)."""
+        if self._device is None:
+            import jax.numpy as jnp
+            from .traverse import DeltaKernel
+            self._device = DeltaKernel(jnp.asarray(self.h_src),
+                                       jnp.asarray(self.h_etype),
+                                       jnp.asarray(self.h_ok))
+        return self._device
+
+    # -- mutation primitives (host) ------------------------------------
+    def add_edge(self, gkey: Tuple, gsrc: int, gdst: int, src_vid: int,
+                 etype: int, rank: int, dst_vid: int, props: dict) -> bool:
+        slot = self.map.get(gkey)
+        if slot is not None:                 # prop update of a delta edge
+            self.info[slot] = (src_vid, etype, rank, dst_vid, props)
+            return True
+        if self.edge_count >= self.max_edges:
+            return False
+        lane = int(np.argmin(self.h_ok[gdst]))
+        if self.h_ok[gdst, lane]:
+            if self.K >= self.k_max:
+                return False                 # lane budget exhausted: repack
+            lane = self.K                    # first lane added by growth
+            self._grow_lanes()               # (k_max may clamp below 2K)
+        self.h_src[gdst, lane] = gsrc
+        self.h_etype[gdst, lane] = etype
+        self.h_ok[gdst, lane] = True
+        self.map[gkey] = (gdst, lane)
+        self.info[(gdst, lane)] = (src_vid, etype, rank, dst_vid, props)
+        self.by_src.setdefault(gsrc, set()).add((gdst, lane))
+        self.edge_count += 1
+        self._device = None
+        return True
+
+    def _grow_lanes(self) -> None:
+        """Double K (a hot destination filled its lanes); existing
+        (gdst, lane) coordinates stay valid."""
+        k2 = min(self.K * 2, self.k_max)
+        for name in ("h_src", "h_etype", "h_ok"):
+            old = getattr(self, name)
+            new = np.zeros((self.n_slots, k2), old.dtype)
+            new[:, :self.K] = old
+            setattr(self, name, new)
+        self.K = k2
+        self._device = None
+
+    def remove_edge(self, gkey: Tuple, gsrc: int) -> None:
+        slot = self.map.pop(gkey, None)
+        if slot is None:
+            return
+        self.h_ok[slot] = False
+        self.info.pop(slot, None)
+        s = self.by_src.get(gsrc)
+        if s is not None:
+            s.discard(slot)
+        self.edge_count -= 1
+        self._device = None
+
+
+def _decode_props(sm, space_id: int, kind: str, type_id: int,
+                  row: bytes, now: float) -> Optional[dict]:
+    """Row bytes -> props dict with the builder's TTL semantics (None =
+    invisible: undecodable or TTL-expired)."""
+    r = (sm.tag_schema(space_id, type_id) if kind == "v"
+         else sm.edge_schema(space_id, type_id))
+    if not r.ok():
+        return {}
+    schema = r.value()
+    if not schema.fields:
+        return {}
+    try:
+        props = RowReader(schema, row).to_dict()
+    except Exception:
+        return None
+    if schema.ttl_col and schema.ttl_duration > 0:
+        ts = props.get(schema.ttl_col)
+        if isinstance(ts, (int, float)) and ts + schema.ttl_duration < now:
+            return None
+    return props
+
+
+def _encode_device_val(col, value):
+    """Python value -> the column's device encoding (None = can't)."""
+    t = col.ptype
+    if value is None:
+        return None
+    if t == PropType.DOUBLE:
+        return np.float32(value)
+    if t in (PropType.INT, PropType.VID, PropType.TIMESTAMP):
+        if not (_I32_MIN <= int(value) <= _I32_MAX):
+            return None
+        return np.int32(value)
+    if t == PropType.BOOL:
+        return bool(value)
+    if t == PropType.STRING and col.str_dict is not None:
+        return np.int32(col.str_dict.setdefault(value,
+                                                len(col.str_dict)))
+    return None
+
+
+def _patch_prop_columns(snap, cols: Dict, idx: int, props: Optional[dict],
+                        visible: bool) -> None:
+    """Write one row's values into existing PropColumn mirrors at idx."""
+    for name, col in cols.items():
+        v = props.get(name) if (visible and props is not None) else None
+        col.host[idx] = v
+        if col.present is not None:
+            col.present[idx] = v is not None
+        if col.device_vals is not None:
+            enc = _encode_device_val(col, v)
+            if enc is None and v is not None:
+                col.device_ok = False   # out-of-range: host-only now
+            elif enc is not None:
+                col.device_vals[idx] = enc
+            elif col.ptype == PropType.STRING:
+                col.device_vals[idx] = -1
+    snap._device_prop_cache.clear()
+
+
+def _ensure_prop_columns(snap, shard, kind: str, sm, space_id: int,
+                         type_id: int, cap: int) -> Optional[Dict]:
+    """Prop columns dict for (shard, tag/etype), creating empty aligned
+    columns when this shard had no rows of that type at build time."""
+    store = shard.tag_props if kind == "v" else shard.edge_props
+    cols = store.get(type_id)
+    if cols is not None:
+        return cols
+    r = (sm.tag_schema(space_id, type_id) if kind == "v"
+         else sm.edge_schema(space_id, type_id))
+    if not r.ok() or not r.value().fields:
+        return None
+    from .csr import PropColumn
+    cols = {}
+    for f in r.value().fields:
+        host = np.empty(cap, dtype=object)
+        present = np.zeros(cap, bool)
+        t = f.type
+        str_dict = None
+        if t == PropType.DOUBLE:
+            dv = np.full(cap, np.nan, np.float32)
+        elif t in (PropType.INT, PropType.VID, PropType.TIMESTAMP):
+            dv = np.zeros(cap, np.int32)
+        elif t == PropType.BOOL:
+            dv = np.zeros(cap, bool)
+        elif t == PropType.STRING:
+            dv = np.full(cap, -1, np.int32)
+            str_dict = snap.str_dicts.setdefault(
+                ("t" if kind == "v" else "e", f.name), {})
+        else:
+            cols[f.name] = PropColumn(f.name, t, host, False, None, present)
+            continue
+        cols[f.name] = PropColumn(f.name, t, host, True, dv, present,
+                                  str_dict)
+    store[type_id] = cols
+    return cols
+
+
+def apply_entries(snap, sm, entries: List[tuple], now: float) -> bool:
+    """Apply resolved logical entries to the snapshot. False = capacity
+    exhausted or unappliable — caller must repack (the snapshot may be
+    partially patched and MUST NOT serve until rebuilt)."""
+    delta = snap.delta
+    if delta is None:
+        delta = snap.delta = SnapshotDelta(snap)
+    space_id = snap.space_id
+    cap_v = snap.cap_v
+    tomb: List[int] = []      # flat canonical indices to clear
+    untomb: List[int] = []    # flat canonical indices to restore
+    for ent in entries:
+        if ent[0] == "e":
+            _, part, src, etype, rank, dst, row = ent
+            p0 = part - 1
+            if not (0 <= p0 < snap.num_parts):
+                return False
+            shard = snap.shards[p0]
+            visible = row is not None
+            props = None
+            if visible:
+                props = _decode_props(sm, space_id, "e", abs(etype), row,
+                                      now)
+                if props is None:
+                    visible = False      # TTL-expired / undecodable
+            src_loc = snap.locate(src)
+            canon = None
+            if src_loc is not None and src_loc[0] == p0 \
+                    and src_loc[1] < shard.num_vids_base:
+                canon = _canon_find(shard, src_loc[1], etype, rank, dst)
+                # a dst assigned a DELTA slot can't be a canonical edge
+                dst_loc0 = snap.locate(dst)
+                if canon is not None and (
+                        dst_loc0 is None
+                        or dst_loc0[1] >= snap.shards[dst_loc0[0]].num_vids_base):
+                    canon = None
+            gkey = (part, src, etype, rank, dst)
+            if canon is not None:
+                flat = p0 * snap.cap_e + canon
+                if visible:
+                    if not shard.edge_valid[canon]:
+                        shard.edge_valid[canon] = True
+                        untomb.append(flat)
+                        delta.tomb_count -= 1
+                    cols = _ensure_prop_columns(snap, shard, "e", sm,
+                                                space_id, etype, snap.cap_e)
+                    if cols is not None:
+                        _patch_prop_columns(snap, cols, canon, props, True)
+                else:
+                    if shard.edge_valid[canon]:
+                        shard.edge_valid[canon] = False
+                        tomb.append(flat)
+                        delta.tomb_count += 1
+                continue
+            # non-canonical: delta add / delta remove
+            if not visible:
+                src_loc2 = snap.locate(src)
+                gsrc = (src_loc2[0] * cap_v + src_loc2[1]) \
+                    if src_loc2 is not None else -1
+                delta.remove_edge(gkey, gsrc)
+                continue
+            sl = _locate_or_add(snap, src)
+            dl = _locate_or_add(snap, dst)
+            if sl is None or dl is None:
+                return False             # spare slots exhausted: repack
+            gsrc = sl[0] * cap_v + sl[1]
+            gdst = dl[0] * cap_v + dl[1]
+            if not delta.add_edge(gkey, gsrc, gdst, src, etype, rank, dst,
+                                  props or {}):
+                return False             # ELL lanes exhausted: repack
+        elif ent[0] == "v":
+            _, part, vid, tag, row = ent
+            visible = row is not None
+            props = None
+            if visible:
+                props = _decode_props(sm, space_id, "v", tag, row, now)
+                if props is None:
+                    visible = False
+            loc = snap.locate(vid)
+            if loc is None:
+                if not visible:
+                    continue             # delete of an unknown vertex
+                loc = _locate_or_add(snap, vid)
+                if loc is None:
+                    return False
+            shard = snap.shards[loc[0]]
+            cols = _ensure_prop_columns(snap, shard, "v", sm, space_id,
+                                        tag, cap_v)
+            if cols is not None:
+                _patch_prop_columns(snap, cols, loc[1], props, visible)
+        else:
+            return False
+    if tomb or untomb:
+        _apply_valid_updates(snap, tomb, untomb)
+    return True
+
+
+def _locate_or_add(snap, vid: int) -> Optional[Tuple[int, int]]:
+    """(part0, local) of vid, assigning a spare slot in its owner shard
+    when new; None when the shard is out of spare slots."""
+    loc = snap.locate(vid)
+    if loc is not None:
+        return loc
+    p0 = ku.part_id(vid, snap.num_parts) - 1
+    shard = snap.shards[p0]
+    local = shard.num_vids_base + len(shard.delta_vids)
+    if local >= snap.cap_v:
+        return None
+    shard.delta_vids[vid] = local
+    return (p0, local)
+
+
+def _apply_valid_updates(snap, tomb: List[int], untomb: List[int]) -> None:
+    """Point-update the kernel's valid masks on device (one batched
+    functional update per apply; segment boundaries are unaffected
+    because sorting keys ignore validity)."""
+    import jax.numpy as jnp
+    k = snap.kernel
+    order_inv = snap.kernel_order_inv
+    P = snap.num_parts
+    valid = k.valid.reshape(-1)
+    valid_sorted = k.valid_sorted
+    if tomb:
+        t = np.asarray(tomb, np.int32)
+        valid = valid.at[jnp.asarray(t)].set(False)
+        valid_sorted = valid_sorted.at[jnp.asarray(order_inv[t])].set(False)
+    if untomb:
+        u = np.asarray(untomb, np.int32)
+        valid = valid.at[jnp.asarray(u)].set(True)
+        valid_sorted = valid_sorted.at[jnp.asarray(order_inv[u])].set(True)
+    snap.kernel = k._replace(valid=valid.reshape(P, snap.cap_e),
+                             valid_sorted=valid_sorted)

@@ -9,9 +9,15 @@ exact same yield-evaluation machinery (`_emit_go_rows`) so result sets
 are identical by construction wherever both paths can serve.
 
 Snapshot lifecycle: built lazily from the KV store on first use, keyed
-to the engine's write_version + catalog version; stale snapshots are
-rebuilt transparently (auto_refresh) — the Phase-6 upgrade path is
-delta buffers + periodic repack (SURVEY.md §7 hard-part (a)).
+to the engine's write_version + catalog version. Committed writes no
+longer rebuild: the engine pulls the storage-side change feed
+(kvstore/changelog.py) and PATCHES the live snapshot — delta adds into
+an ELL buffer the hop kernel unions with the base CSR, deletes as
+device tombstone point-updates, prop updates into the host mirrors
+(delta.py; SURVEY.md §7 hard-part (a), §2.10 P6). When the delta fills,
+a background repack folds it into a fresh base while queries keep
+serving; a failed apply poisons the snapshot so CPU fallback serves
+until the repack swaps in.
 
 Freshness model (remote topology): the token rides a push-fed watch
 cache, not per-query probes. Writes through THIS graphd are strictly
@@ -24,6 +30,7 @@ invalidation is a delta apply instead of a rebuild.
 """
 from __future__ import annotations
 
+import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
@@ -63,9 +70,17 @@ class TpuGraphEngine:
         self._provider = None
         self._sm = None
         self._meta = None
+        # serializes snapshot lifecycle + host-mirror reads: delta
+        # applies mutate shard mirrors in place, so queries and applies
+        # must not interleave (rebuild swaps were immutable; deltas are
+        # not)
+        self._lock = threading.RLock()
+        self._repacking: Dict[int, bool] = {}
         self.stats = {"go_served": 0, "path_served": 0, "rebuilds": 0,
                       "fallbacks": 0, "sharded_queries": 0,
-                      "fast_materialize": 0, "slow_materialize": 0}
+                      "fast_materialize": 0, "slow_materialize": 0,
+                      "delta_applies": 0, "delta_edges": 0,
+                      "bg_repacks": 0}
 
     # ------------------------------------------------------------------
     def attach(self, cluster) -> None:
@@ -95,6 +110,16 @@ class TpuGraphEngine:
         return v() if callable(v) else v
 
     def refresh(self, space_id: int) -> Optional[CsrSnapshot]:
+        snap = self._build_fresh(space_id)
+        if snap is None:
+            return None
+        self._snapshots[space_id] = snap
+        self.stats["rebuilds"] += 1
+        return snap
+
+    def _build_fresh(self, space_id: int) -> Optional[CsrSnapshot]:
+        """Build (but don't install) a fresh snapshot — lock-free, so
+        the background repack can scan while queries keep serving."""
         catalog = self._catalog_version()
         snap = self._provider.build(space_id)
         if snap is None:
@@ -104,27 +129,105 @@ class TpuGraphEngine:
                 and snap.num_parts % self.mesh.devices.size == 0):
             from .distributed import shard_snapshot_arrays
             shard_snapshot_arrays(self.mesh, snap)
-        self._snapshots[space_id] = snap
-        self.stats["rebuilds"] += 1
         return snap
 
     def snapshot(self, space_id: int) -> Optional[CsrSnapshot]:
         if self._provider is None:
             return None
+        with self._lock:
+            return self._snapshot_locked(space_id)
+
+    def _snapshot_locked(self, space_id: int) -> Optional[CsrSnapshot]:
         token = self._provider.version(space_id)
         if token is None:
             return None
         snap = self._snapshots.get(space_id)
-        fresh = (snap is not None
+        catalog = self._catalog_version()
+        fresh = (snap is not None and not snap.stale
                  and snap.write_version == token
-                 and getattr(snap, "catalog_version", -1) == self._catalog_version())
+                 and getattr(snap, "catalog_version", -1) == catalog)
         if fresh:
             return snap
+        if self._repacking.get(space_id):
+            # a background repack is folding the delta / replacing a
+            # poisoned snapshot: decline (CPU serves) rather than start
+            # a racing synchronous rebuild under the engine lock
+            return None
         if not self.auto_refresh:
             # operator controls rebuild timing; a stale snapshot must not
             # serve (results would be wrong) — decline so CPU path runs
             return None
+        # incremental path: patch the live snapshot from the committed-
+        # write feed instead of rebuilding (SURVEY §7 hard-part (a))
+        if (snap is not None and not snap.stale
+                and getattr(snap, "catalog_version", -1) == catalog
+                and getattr(snap, "sharded_kernel", None) is None
+                and self._token_compatible(snap, token)):
+            if self._try_apply_deltas(snap, token):
+                return snap
+            # apply failed mid-way (capacity / barrier): the snapshot may
+            # be partially patched — poison it, rebuild off the query
+            # path, serve via CPU fallback until the swap
+            snap.stale = True
+            self._kick_repack(space_id)
+            return None
         return self.refresh(space_id)
+
+    @staticmethod
+    def _token_compatible(snap, token) -> bool:
+        """Deltas can only patch a snapshot whose routing still matches
+        (remote tokens carry part->leader routing; a moved part means
+        scans would come from a different host — rebuild)."""
+        old = snap.write_version
+        if isinstance(token, tuple) and isinstance(old, tuple):
+            return len(token) == 3 and len(old) == 3 and token[1] == old[1]
+        return not isinstance(token, tuple) and not isinstance(old, tuple)
+
+    def _try_apply_deltas(self, snap, token) -> bool:
+        cs = getattr(self._provider, "changes_since", None)
+        cursor = getattr(snap, "delta_cursor", None)
+        if cs is None or cursor is None:
+            return False
+        entries, new_cursor = cs(snap.space_id, cursor)
+        if entries is None:
+            return False
+        if entries:
+            from .delta import apply_entries
+            if not apply_entries(snap, self._sm, entries, time.time()):
+                return False
+            self.stats["delta_applies"] += 1
+        snap.delta_cursor = new_cursor
+        snap.write_version = token
+        d = snap.delta
+        if d is not None:
+            self.stats["delta_edges"] = d.edge_count
+            if d.edge_count + d.tomb_count > 0.75 * d.max_edges:
+                # fold the delta into a fresh base while still serving
+                self._kick_repack(snap.space_id)
+        return True
+
+    def _kick_repack(self, space_id: int) -> None:
+        """Rebuild off the query path; queries keep serving the current
+        snapshot (or CPU fallback when poisoned) until the swap."""
+        if self._repacking.get(space_id):
+            return
+        self._repacking[space_id] = True
+
+        def run():
+            try:
+                snap = self._build_fresh(space_id)   # scan without lock
+                if snap is not None:
+                    with self._lock:                 # swap under lock
+                        self._snapshots[space_id] = snap
+                    self.stats["rebuilds"] += 1
+                    self.stats["bg_repacks"] += 1
+            except Exception:
+                pass
+            finally:
+                self._repacking[space_id] = False
+
+        threading.Thread(target=run, daemon=True,
+                         name=f"csr-repack-{space_id}").start()
 
     # ------------------------------------------------------------------
     # serve decisions
@@ -158,7 +261,13 @@ class TpuGraphEngine:
         if len(edge_types) > traverse.MAX_EDGE_TYPES_PER_QUERY:
             self.stats["fallbacks"] += 1
             return None
-        snap = self.snapshot(ctx.space_id())
+        with self._lock:   # delta applies mutate host mirrors in place
+            return self._execute_go_locked(ctx, s, starts, edge_types,
+                                           alias_map, name_by_type, ex)
+
+    def _execute_go_locked(self, ctx, s, starts, edge_types, alias_map,
+                           name_by_type, ex):
+        snap = self._snapshot_locked(ctx.space_id())
         if snap is None:
             self.stats["fallbacks"] += 1
             return None
@@ -173,22 +282,33 @@ class TpuGraphEngine:
         f0 = jnp.asarray(frontier0)
         req = jnp.asarray(traverse.pad_edge_types(edge_types))
 
-        # filter: try device compile; else host-side at materialization
+        use_delta = snap.delta is not None and snap.delta.edge_count > 0
+        # filter: try device compile; else host-side at materialization.
+        # With delta edges in play a compiled device mask would cover
+        # only canonical edges — evaluate the filter on the host for
+        # ALL rows so the two row sources stay consistent.
         device_mask = None
         local_filter = None
         if s.where is not None:
-            fc = FilterCompiler(snap, self._sm, ctx.space_id(), name_by_type,
-                                alias_map, edge_types)
-            device_mask = fc.compile(s.where.filter)
-            if device_mask is None:
+            if use_delta:
                 local_filter = s.where.filter
+            else:
+                fc = FilterCompiler(snap, self._sm, ctx.space_id(),
+                                    name_by_type, alias_map, edge_types)
+                device_mask = fc.compile(s.where.filter)
+                if device_mask is None:
+                    local_filter = s.where.filter
 
+        d_active = None
         if getattr(snap, "sharded_kernel", None) is not None:
             from . import distributed
             _, active = distributed.multi_hop_sharded(
                 self.mesh, f0, jnp.int32(s.step.steps),
                 snap.sharded_kernel, req)
             self.stats["sharded_queries"] += 1
+        elif use_delta:
+            _, active, d_active = traverse.multi_hop_delta(
+                f0, s.step.steps, snap.kernel, snap.delta.device(), req)
         else:
             _, active = traverse.multi_hop(f0, s.step.steps, snap.kernel,
                                            req)
@@ -216,11 +336,67 @@ class TpuGraphEngine:
                                   needs_dst=_needs_dst(yield_cols, s))
             if not st.ok():
                 return StatusOr.from_status(st)
+        if d_active is not None:
+            d_mask = np.asarray(d_active)
+            if d_mask.any():
+                delta_resp = self._materialize_delta(snap, d_mask, mask,
+                                                     ctx, yield_cols, s)
+                st = ex._emit_go_rows(ctx, delta_resp, rows, yield_cols,
+                                      local_filter, alias_map, name_by_type,
+                                      roots={}, input_index={},
+                                      needs_input=False,
+                                      needs_dst=_needs_dst(yield_cols, s))
+                if not st.ok():
+                    return StatusOr.from_status(st)
         result = ex.InterimResult(columns, rows)
         if s.yield_ and s.yield_.distinct:
             result = result.distinct()
         self.stats["go_served"] += 1
         return StatusOr.of(result)
+
+    def _materialize_delta(self, snap: CsrSnapshot, d_mask: np.ndarray,
+                           base_mask: np.ndarray, ctx, yield_cols,
+                           s) -> BoundResponse:
+        """Delta-buffer edges active in the final hop, in the same
+        BoundResponse shape as _materialize — one host loop over the few
+        delta edges, flowing through the identical yield machinery.
+        The per-vertex edge cap counts BASE rows first (the CPU storage
+        path truncates across all of a vertex's edges, ref
+        FLAGS_max_edge_returned_per_vertex)."""
+        resp = BoundResponse()
+        src_tag_reqs, _, _ = _collect_src_tags(ctx, yield_cols, s)
+        per_vertex: Dict[int, VertexData] = {}
+        delta = snap.delta
+        cap_counts: Dict[Tuple[int, int], int] = {}
+        for gdst, lane in zip(*np.nonzero(d_mask)):
+            info = delta.info.get((int(gdst), int(lane)))
+            if info is None:
+                continue
+            src_vid, etype, rank, dst_vid, props = info
+            ckey = (src_vid, etype)
+            if ckey not in cap_counts:
+                cap_counts[ckey] = _base_active_count(snap, base_mask,
+                                                      src_vid, etype)
+            cap_counts[ckey] += 1
+            if cap_counts[ckey] > DEFAULT_MAX_EDGES_PER_VERTEX:
+                continue
+            vd = per_vertex.get(src_vid)
+            if vd is None:
+                vd = VertexData(src_vid)
+                loc = snap.locate(src_vid)
+                if loc is not None:
+                    shard = snap.shards[loc[0]]
+                    for tid in src_tag_reqs:
+                        tp = _host_tag_props(shard, tid, loc[1])
+                        if tp is not None:
+                            vd.tag_props[tid] = tp
+                per_vertex[src_vid] = vd
+            vd.edges.append(EdgeData(src_vid, etype, rank, dst_vid,
+                                     dict(props)))
+        for p in range(snap.num_parts):
+            resp.results[p + 1] = PartResult()
+        resp.vertices = list(per_vertex.values())
+        return resp
 
     # ------------------------------------------------------------------
     def _materialize(self, snap: CsrSnapshot, mask: np.ndarray, ctx,
@@ -270,7 +446,14 @@ class TpuGraphEngine:
         from ..graph import executors as ex
         if len(edge_types) > traverse.MAX_EDGE_TYPES_PER_QUERY:
             return None
-        snap = self.snapshot(ctx.space_id())
+        with self._lock:   # delta applies mutate host mirrors in place
+            return self._execute_find_path_locked(ctx, s, sources, targets,
+                                                  edge_types, name_by_type,
+                                                  ex)
+
+    def _execute_find_path_locked(self, ctx, s, sources, targets,
+                                  edge_types, name_by_type, ex):
+        snap = self._snapshot_locked(ctx.space_id())
         if snap is None or not sources or not targets:
             if snap is None:
                 return None
@@ -283,6 +466,7 @@ class TpuGraphEngine:
         req_f = jnp.asarray(traverse.pad_edge_types(edge_types))
         req_b = jnp.asarray(traverse.pad_edge_types([-t for t in edge_types]))
         upto = s.step.steps
+        use_delta = snap.delta is not None and snap.delta.edge_count > 0
         # halved-depth bidirectional sweep (ref: FindPathExecutor :155)
         steps_f = (upto + 1) // 2
         steps_b = upto - steps_f
@@ -295,6 +479,12 @@ class TpuGraphEngine:
                 self.mesh, jnp.asarray(f_dst), jnp.int32(max(steps_b, 0)),
                 snap.sharded_kernel, req_b))
             self.stats["sharded_queries"] += 1
+        elif use_delta:
+            dk = snap.delta.device()
+            dist_f = np.asarray(traverse.bfs_dist_delta(
+                jnp.asarray(f_src), steps_f, snap.kernel, dk, req_f))
+            dist_b = np.asarray(traverse.bfs_dist_delta(
+                jnp.asarray(f_dst), max(steps_b, 0), snap.kernel, dk, req_b))
         else:
             dist_f = np.asarray(traverse.bfs_dist(
                 jnp.asarray(f_src), steps_f, snap.kernel, req_f))
@@ -328,6 +518,26 @@ def _needs_dst(yield_cols, s) -> bool:
             if isinstance(node, DestPropExpr):
                 return True
     return False
+
+
+def _base_active_count(snap, base_mask: np.ndarray, src_vid: int,
+                       etype: int) -> int:
+    """Active base edges of (src, etype) in the final-hop mask — the
+    starting point for the per-vertex cap over delta rows."""
+    loc = snap.locate(src_vid)
+    if loc is None:
+        return 0
+    p, local = loc
+    shard = snap.shards[p]
+    if local >= shard.num_vids_base:
+        return 0    # delta vertex: no canonical rows
+    indptr = _shard_indptr(shard)
+    lo, hi = int(indptr[local]), int(indptr[local + 1])
+    if lo >= hi:
+        return 0
+    seg = slice(lo, hi)
+    return int((base_mask[p, seg]
+                & (shard.edge_etype[seg] == etype)).sum())
 
 
 def _host_tag_props(shard, tag_id: int, local: int) -> Optional[Dict[str, Any]]:
@@ -383,29 +593,50 @@ def _reconstruct_shortest(snap: CsrSnapshot, dist_f: np.ndarray,
     def neighbors_at(vid: int, want_types, dist_map, level: int):
         """Vertices u adjacent to vid (through edges of want_types as seen
         FROM vid's partition rows) with dist_map[u] == level; returns
-        (u, etype_seen, rank)."""
+        (u, etype_seen, rank). Covers base CSR rows (skipping delta
+        tombstones) plus delta-buffer rows whose row-src is vid."""
         loc = snap.locate(vid)
         if loc is None:
             return
         p, local = loc
         shard = snap.shards[p]
-        indptr = _shard_indptr(shard)
-        for i in range(indptr[local], indptr[local + 1]):
-            et = int(shard.edge_etype[i])
-            if et not in want_types:
-                continue
-            u = int(shard.edge_dst_vid[i])
-            uloc = snap.locate(u)
-            if uloc is None:
-                continue
-            if dist_map[uloc[0], uloc[1]] == level:
-                yield u, et, int(shard.edge_rank[i])
+        if local < shard.num_vids_base:
+            indptr = _shard_indptr(shard)
+            for i in range(indptr[local], indptr[local + 1]):
+                if not shard.edge_valid[i]:
+                    continue   # tombstoned after build
+                et = int(shard.edge_etype[i])
+                if et not in want_types:
+                    continue
+                u = int(shard.edge_dst_vid[i])
+                uloc = snap.locate(u)
+                if uloc is None:
+                    continue
+                if dist_map[uloc[0], uloc[1]] == level:
+                    yield u, et, int(shard.edge_rank[i])
+        d = snap.delta
+        if d is not None:
+            gslot = p * snap.cap_v + local
+            for slot in d.by_src.get(gslot, ()):
+                info = d.info.get(slot)
+                if info is None or not d.h_ok[slot]:
+                    continue
+                _, et, rank, u, _props = info
+                if et not in want_types:
+                    continue
+                uloc = snap.locate(u)
+                if uloc is None:
+                    continue
+                if dist_map[uloc[0], uloc[1]] == level:
+                    yield u, et, rank
 
     # path entry = (vid, etype_into_vid, rank_into_vid); entry 0 carries
     # no edge info
     out = set()
     for p, local in meets:
-        mid = int(snap.shards[p].vids[local])
+        mid = snap.vid_of_slot(int(p), int(local))
+        if mid is None:
+            continue
         df = int(dist_f[p, local])
         db = int(dist_b[p, local])
         prefixes = [((mid, 0, 0),)]

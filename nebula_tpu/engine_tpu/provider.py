@@ -45,8 +45,26 @@ class LocalStoreProvider:
     def build(self, space_id: int) -> Optional[CsrSnapshot]:
         if self._store.space_engine(space_id) is None:
             return None
-        return build_snapshot(self._store, self._sm, space_id,
+        snap = build_snapshot(self._store, self._sm, space_id,
                               self._sm.num_parts(space_id))
+        snap.delta_cursor = snap.write_version
+        return snap
+
+    def changes_since(self, space_id: int, cursor):
+        """Committed writes since `cursor` as resolved logical deltas.
+        -> (entries | None, new_cursor); None entries = rebuild (ring
+        truncated or a barrier op)."""
+        from ..kvstore.changelog import resolve_changes
+        engine = self._store.space_engine(space_id)
+        if engine is None or getattr(engine, "changes", None) is None:
+            return None, cursor
+        now_v, raw = engine.changes_snapshot(cursor)
+        if raw is None:
+            return None, cursor
+        entries = resolve_changes(engine, raw)
+        if entries is None:
+            return None, cursor
+        return entries, now_v
 
 
 class _RemoteScanSource:
@@ -92,4 +110,31 @@ class RemoteStorageProvider:
             return None
         snap = CsrSnapshot(space_id, shards, cap_v, cap_e, token)
         snap.str_dicts = dicts
+        snap.delta_cursor = dict(token[0])   # host -> version at build
         return snap
+
+    def changes_since(self, space_id: int, cursor):
+        """Pull resolved deltas from every host serving the space (one
+        RPC per host per INVALIDATION, never per query). Every host is
+        polled authoritatively — the cached watch versions can lag a
+        local write by one push (~50ms), and trusting them here would
+        stamp the snapshot fresh without that write.
+        -> (entries | None, new_cursor)."""
+        token = self.version(space_id)
+        if token is None:
+            return None, cursor
+        if {h for h, _ in token[0]} != set(cursor):
+            return None, cursor          # host set changed: rebuild
+        entries = []
+        new_cursor = dict(cursor)
+        for host, since in cursor.items():
+            try:
+                now_v, es = self._client.host_changes_since(host, space_id,
+                                                            since)
+            except Exception:
+                return None, cursor
+            if es is None:
+                return None, cursor
+            entries.extend(es)
+            new_cursor[host] = now_v
+        return entries, new_cursor

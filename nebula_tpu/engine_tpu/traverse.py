@@ -39,7 +39,7 @@ padded to a fixed-width vector.
 """
 from __future__ import annotations
 
-from typing import List, NamedTuple, Tuple
+from typing import List, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -84,7 +84,9 @@ class EdgeKernel(NamedTuple):
 def build_kernel(edge_src: np.ndarray, edge_etype: np.ndarray,
                  edge_valid: np.ndarray, edge_gidx: np.ndarray,
                  num_parts: int, cap_v: int,
-                 num_blocks: int = 1) -> List[EdgeKernel]:
+                 num_blocks: int = 1,
+                 orders_out: Optional[List[np.ndarray]] = None
+                 ) -> List[EdgeKernel]:
     """Build per-block EdgeKernels (host-side, numpy).
 
     edge_gidx: int32[P, cap_e] global dst index `dst_part*cap_v +
@@ -96,6 +98,10 @@ def build_kernel(edge_src: np.ndarray, edge_etype: np.ndarray,
     space, single chip; D = one block per device for the distributed
     path, since each device only reads its own edges). `src_sorted`
     holds block-local frontier slots `local_part*cap_v + src_local`.
+
+    orders_out: when given, receives each block's canonical->sorted
+    permutation (int64[bp*cap_e]) — the delta applier uses it to point-
+    update `valid_sorted` when an edge is tombstoned in place.
     """
     P, cap_e = edge_gidx.shape
     assert P % num_blocks == 0
@@ -108,6 +114,8 @@ def build_kernel(edge_src: np.ndarray, edge_etype: np.ndarray,
         flat_g = edge_gidx[sl].reshape(-1)
         order = np.argsort(flat_g, kind="stable")
         sorted_g = flat_g[order]
+        if orders_out is not None:
+            orders_out.append(order)
         src_flat = (np.arange(bp, dtype=np.int64)[:, None] * cap_v
                     + edge_src[sl]).reshape(-1)
         out.append(EdgeKernel(
@@ -211,6 +219,78 @@ def multi_hop_upto(frontier0: jnp.ndarray, steps: jnp.ndarray,
 @jax.jit
 def count_edges(final_active: jnp.ndarray) -> jnp.ndarray:
     return final_active.sum(dtype=jnp.int32)
+
+
+# ---------------------------------------------------------------------------
+# delta-aware traversal (CSR + ELL add-buffer union)
+# ---------------------------------------------------------------------------
+
+class DeltaKernel(NamedTuple):
+    """Device form of the snapshot's ELL add-buffer: up to K delta
+    edges per DESTINATION slot. Keying by dst makes the per-hop union a
+    pure GATHER (reached[v] |= any_k frontier[src[v,k]]) — no scatter,
+    which XLA would serialize on TPU (see module doc). Unused lanes
+    have ok=False and src=0 (slot 0 is a real slot; the False mask
+    gates it)."""
+    src: jnp.ndarray     # int32[n_slots, K] global src slot
+    etype: jnp.ndarray   # int32[n_slots, K] signed edge type
+    ok: jnp.ndarray      # bool [n_slots, K] lane in use
+
+
+def _delta_hits(frontier: jnp.ndarray, dk: DeltaKernel,
+                d_ok: jnp.ndarray) -> jnp.ndarray:
+    """Union contribution of the delta edges for one hop: bool[P, cap_v]."""
+    hit = (frontier.reshape(-1)[dk.src] & d_ok).any(axis=1)
+    return hit.reshape(frontier.shape)
+
+
+@jax.jit
+def multi_hop_delta(frontier0: jnp.ndarray, steps: jnp.ndarray,
+                    k: EdgeKernel, dk: DeltaKernel, req_types: jnp.ndarray
+                    ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """multi_hop over the union graph (base CSR ∪ delta adds; base
+    tombstones are already cleared in k.valid/k.valid_sorted).
+
+    -> (final_frontier [P, cap_v], final_active [P, cap_e] canonical,
+        delta_active bool[n_slots, K])
+    """
+    ok_sorted = _edge_ok(k.etype_sorted, k.valid_sorted, req_types)
+    d_ok = _edge_ok(dk.etype, dk.ok, req_types)
+
+    def body(_, f):
+        return _advance(f, k, ok_sorted) | _delta_hits(f, dk, d_ok)
+
+    frontier = lax.fori_loop(0, steps - 1, body, frontier0)
+    edge_ok = _edge_ok(k.etype, k.valid, req_types)
+    final_active = jnp.take_along_axis(frontier, k.src, axis=1) & edge_ok
+    delta_active = frontier.reshape(-1)[dk.src] & d_ok
+    return frontier, final_active, delta_active
+
+
+@jax.jit
+def bfs_dist_delta(frontier0: jnp.ndarray, max_steps: jnp.ndarray,
+                   k: EdgeKernel, dk: DeltaKernel,
+                   req_types: jnp.ndarray) -> jnp.ndarray:
+    """bfs_dist over the union graph (shortest-path depth maps)."""
+    ok_sorted = _edge_ok(k.etype_sorted, k.valid_sorted, req_types)
+    d_ok = _edge_ok(dk.etype, dk.ok, req_types)
+    dist0 = jnp.where(frontier0, 0, -1).astype(jnp.int32)
+
+    def cond(state):
+        frontier, dist, step = state
+        return (step < max_steps) & frontier.any()
+
+    def body(state):
+        frontier, dist, step = state
+        nxt = _advance(frontier, k, ok_sorted) | _delta_hits(frontier, dk,
+                                                             d_ok)
+        fresh = nxt & (dist < 0)
+        dist = jnp.where(fresh, step + 1, dist)
+        return fresh, dist, step + 1
+
+    _, dist, _ = lax.while_loop(cond, body, (frontier0, dist0,
+                                             jnp.int32(0)))
+    return dist
 
 
 @jax.jit

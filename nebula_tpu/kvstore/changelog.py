@@ -1,0 +1,127 @@
+"""Engine change log: the committed-write feed for incremental device
+snapshots.
+
+Role parity with the reference's in-place apply (`Part::commitLogs`
+replays every committed batch into the engine and readers see it
+immediately, ref kvstore/Part.cpp:208-319): here the engine ALSO
+records each committed batch in a bounded ring, and the TPU engine
+pulls the tail to patch its CSR snapshot instead of rebuilding —
+SURVEY.md §7 hard-part (a), §2.10 P6's delta-buffer half.
+
+Two layers:
+
+- `ChangeRing` — raw committed ops `(version, op, payload)` recorded at
+  the engine choke point (every write path — direct, raft leader AND
+  follower apply, snapshot ingest — funnels into the engine's write
+  methods). Bounded; `since()` returns None once truncated, which the
+  consumer treats as "rebuild".
+- `resolve_changes` — turns raw ops into LOGICAL deltas by re-reading
+  the engine's CURRENT visible state per touched group. This makes
+  application idempotent and immune to op-ordering subtleties: a
+  compaction's removal of a superseded version resolves to "edge still
+  there, same row", a real DELETE resolves to "gone", racing writes
+  resolve to whatever is newest. Runs on the storage side (local
+  engine access), so remote consumers receive resolved entries over
+  one RPC.
+
+Logical entry shapes (wire-codec friendly tuples):
+    ("e", part, src, etype, rank, dst, row_bytes | None)   None = gone
+    ("v", part, vid, tag_id, row_bytes | None)
+"""
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Iterable, List, Optional, Tuple
+
+from ..common import keys as ku
+
+RawEntry = Tuple[int, str, object]   # (version, op, payload)
+
+OP_PUT = "put"          # payload: List[(key, value)]
+OP_RM = "rm"            # payload: List[key]
+OP_BARRIER = "barrier"  # payload: None — unresolvable (range/prefix wipe)
+
+
+class ChangeRing:
+    """Bounded ring of committed raw ops, tagged with the engine
+    write_version AFTER each op (versions are strictly increasing, one
+    per engine call)."""
+
+    def __init__(self, cap_ops: int = 4096, cap_kvs: int = 131072):
+        self._entries: deque = deque()
+        self._lock = threading.Lock()
+        self._cap_ops = cap_ops
+        self._cap_kvs = cap_kvs
+        self._kvs = 0
+        # highest version known to be dropped from the ring; a `since`
+        # at or below this can't be served (0 = nothing dropped yet,
+        # and version 0 predates every write)
+        self._floor = 0
+
+    def record(self, version: int, op: str, payload) -> None:
+        n = len(payload) if isinstance(payload, list) else 1
+        with self._lock:
+            self._entries.append((version, op, payload))
+            self._kvs += n
+            while self._entries and (len(self._entries) > self._cap_ops
+                                     or self._kvs > self._cap_kvs):
+                v, _, p = self._entries.popleft()
+                self._kvs -= len(p) if isinstance(p, list) else 1
+                self._floor = v
+
+    def since(self, version: int) -> Optional[List[RawEntry]]:
+        """Entries with version > `version`, oldest first; None when the
+        ring no longer reaches back that far (consumer must rebuild)."""
+        with self._lock:
+            if version < self._floor:
+                return None
+            return [e for e in self._entries if e[0] > version]
+
+
+def _group_of(key: bytes):
+    """Data-key -> logical group id, or None for non-data kinds
+    (system/commit markers, uuid, index)."""
+    if ku.is_edge_key(key):
+        part, src, etype, rank, dst, _ = ku.parse_edge_key(key)
+        return ("e", part, src, etype, rank, dst)
+    if ku.is_vertex_key(key):
+        part, vid, tag, _ = ku.parse_vertex_key(key)
+        return ("v", part, vid, tag)
+    return None
+
+
+def _visible_row(engine, prefix: bytes) -> Optional[bytes]:
+    """Current visible row for a version group: versions are decreasing
+    (newest sorts first, ref AddVerticesProcessor.cpp:32-35), so the
+    first key under the group prefix wins; empty value = tombstone."""
+    for _, v in engine.prefix(prefix):
+        return v if v else None
+    return None
+
+
+def resolve_changes(engine, raw: Iterable[RawEntry]
+                    ) -> Optional[List[tuple]]:
+    """Raw ring entries -> logical deltas against CURRENT engine state.
+    None = a barrier op was seen (range wipe / part cleanup): rebuild."""
+    groups = {}
+    for _, op, payload in raw:
+        if op == OP_BARRIER:
+            return None
+        keys = [k for k, _ in payload] if op == OP_PUT else payload
+        for k in keys:
+            g = _group_of(k)
+            if g is not None:
+                groups[g] = None
+    out: List[tuple] = []
+    for g in groups:
+        if g[0] == "e":
+            _, part, src, etype, rank, dst = g
+            row = _visible_row(engine, ku.edge_group_prefix(
+                part, src, etype, rank, dst))
+            out.append(("e", part, src, etype, rank, dst, row))
+        else:
+            _, part, vid, tag = g
+            row = _visible_row(engine, ku.vertex_prefix(part, vid, tag))
+            out.append(("v", part, vid, tag, row))
+    return out

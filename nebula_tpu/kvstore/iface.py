@@ -46,9 +46,23 @@ class KVEngine(abc.ABC):
 
     `write_version` is a monotonic mutation counter — the TPU engine
     uses it to detect stale CSR snapshots (the device-side analogue of
-    the reference's compaction/version visibility)."""
+    the reference's compaction/version visibility). Engines that keep a
+    `changes` ring (kvstore/changelog.py) feed incremental snapshot
+    patches through `changes_snapshot`."""
 
     write_version: int = 0
+    changes = None   # Optional[ChangeRing]
+
+    def changes_snapshot(self, since: int):
+        """(current write_version, raw ring entries since `since` |
+        None). The version is read BEFORE the ring pull so the caller's
+        cursor never claims coverage of an op it didn't see; writers
+        must record their ring entry before publishing the version (or
+        override this under their write lock)."""
+        if self.changes is None:
+            return self.write_version, None
+        now_v = int(self.write_version)
+        return now_v, self.changes.since(since)
 
     # --- reads --------------------------------------------------------
     @abc.abstractmethod

@@ -51,9 +51,11 @@ def _prefix_upper_bound(prefix: bytes) -> Optional[bytes]:
 
 class MemEngine(KVEngine):
     def __init__(self) -> None:
+        from .changelog import ChangeRing
         self._keys: List[bytes] = []
         self._data: dict = {}
         self.write_version = 0
+        self.changes = ChangeRing()  # committed-write feed (delta sync)
 
     # --- reads --------------------------------------------------------
     def get(self, key: bytes) -> Optional[bytes]:
@@ -83,14 +85,20 @@ class MemEngine(KVEngine):
 
     # --- writes -------------------------------------------------------
     def put(self, key: bytes, value: bytes) -> Status:
-        self.write_version += 1
+        # ring entry is recorded BEFORE write_version advances so a
+        # concurrent changes_snapshot(v) never misses an op it claims
+        # to cover (the delta feed's never-stale rule)
+        v = self.write_version + 1
         if key not in self._data:
             bisect.insort(self._keys, key)
         self._data[key] = value
+        self.changes.record(v, "put", [(key, value)])
+        self.write_version = v
         return Status.OK()
 
     def multi_put(self, kvs: Iterable[KV]) -> Status:
-        self.write_version += 1
+        kvs = list(kvs)
+        ver = self.write_version + 1
         new = False
         for k, v in kvs:
             if k not in self._data:
@@ -98,19 +106,24 @@ class MemEngine(KVEngine):
             self._data[k] = v
         if new:
             self._keys = sorted(self._data)
+        self.changes.record(ver, "put", kvs)
+        self.write_version = ver
         return Status.OK()
 
     def remove(self, key: bytes) -> Status:
-        self.write_version += 1
+        v = self.write_version + 1
         if key in self._data:
             del self._data[key]
             i = bisect.bisect_left(self._keys, key)
             if i < len(self._keys) and self._keys[i] == key:
                 self._keys.pop(i)
+        self.changes.record(v, "rm", [key])
+        self.write_version = v
         return Status.OK()
 
     def multi_remove(self, keys: Iterable[bytes]) -> Status:
-        self.write_version += 1
+        keys = list(keys)
+        v = self.write_version + 1
         hit = False
         for k in keys:
             if k in self._data:
@@ -118,15 +131,19 @@ class MemEngine(KVEngine):
                 hit = True
         if hit:
             self._keys = sorted(self._data)
+        self.changes.record(v, "rm", keys)
+        self.write_version = v
         return Status.OK()
 
     def remove_range(self, start: bytes, end: bytes) -> Status:
-        self.write_version += 1
+        v = self.write_version + 1
         lo = bisect.bisect_left(self._keys, start)
         hi = bisect.bisect_left(self._keys, end)
         for k in self._keys[lo:hi]:
             del self._data[k]
         del self._keys[lo:hi]
+        self.changes.record(v, "barrier", None)
+        self.write_version = v
         return Status.OK()
 
     # --- maintenance --------------------------------------------------

@@ -74,6 +74,8 @@ class _ListIterator(KVIterator):
 
 class NativeEngine(KVEngine):
     def __init__(self, checkpoint_path: Optional[str] = None):
+        import threading
+        from .changelog import ChangeRing
         self._lib = native.load()
         self._h = self._lib.nkv_open(
             checkpoint_path.encode() if checkpoint_path else None)
@@ -81,6 +83,10 @@ class NativeEngine(KVEngine):
             raise OSError(f"cannot open native engine at {checkpoint_path}")
         self._ckpt = checkpoint_path
         self._closed = False
+        self.changes = ChangeRing()  # committed-write feed (delta sync)
+        # orders the (native write, python-side record) pair — the C++
+        # engine has its own mutex but the ring tag must match
+        self._wlock = threading.Lock()
 
     @property
     def native_handle(self):
@@ -169,34 +175,57 @@ class NativeEngine(KVEngine):
 
     # --- writes -------------------------------------------------------
     def put(self, key: bytes, value: bytes) -> Status:
-        self._lib.nkv_put(self._h, key, len(key), value, len(value))
+        with self._wlock:
+            self._lib.nkv_put(self._h, key, len(key), value, len(value))
+            self.changes.record(self.write_version, "put", [(key, value)])
         return Status.OK()
 
     def multi_put(self, kvs: Iterable[KV]) -> Status:
         kvs = list(kvs)
         buf = _pack_kvs(kvs)
-        rc = self._lib.nkv_multi_put(self._h, buf, len(buf), len(kvs))
+        with self._wlock:
+            rc = self._lib.nkv_multi_put(self._h, buf, len(buf), len(kvs))
+            if rc == 0:
+                self.changes.record(self.write_version, "put", kvs)
         return Status.OK() if rc == 0 else \
             Status.error(ErrorCode.E_INVALID_DATA, f"multi_put rc={rc}")
 
     def remove(self, key: bytes) -> Status:
-        self._lib.nkv_remove(self._h, key, len(key))
+        with self._wlock:
+            self._lib.nkv_remove(self._h, key, len(key))
+            self.changes.record(self.write_version, "rm", [key])
         return Status.OK()
 
     def multi_remove(self, keys: Iterable[bytes]) -> Status:
         ks = list(keys)
         buf = _pack_keys(ks)
-        rc = self._lib.nkv_multi_remove(self._h, buf, len(buf), len(ks))
+        with self._wlock:
+            rc = self._lib.nkv_multi_remove(self._h, buf, len(buf), len(ks))
+            if rc == 0:
+                self.changes.record(self.write_version, "rm", ks)
         return Status.OK() if rc == 0 else \
             Status.error(ErrorCode.E_INVALID_DATA, f"multi_remove rc={rc}")
 
     def remove_range(self, start: bytes, end: bytes) -> Status:
-        self._lib.nkv_remove_range(self._h, start, len(start), end, len(end))
+        with self._wlock:
+            self._lib.nkv_remove_range(self._h, start, len(start),
+                                       end, len(end))
+            self.changes.record(self.write_version, "barrier", None)
         return Status.OK()
 
     def remove_prefix(self, prefix: bytes) -> Status:
-        self._lib.nkv_remove_prefix(self._h, prefix, len(prefix))
+        with self._wlock:
+            self._lib.nkv_remove_prefix(self._h, prefix, len(prefix))
+            self.changes.record(self.write_version, "barrier", None)
         return Status.OK()
+
+    def changes_snapshot(self, since: int):
+        # under _wlock: the native version advances inside the C++ call
+        # BEFORE the python-side ring record, so an unlocked reader
+        # could see a version whose op isn't in the ring yet
+        with self._wlock:
+            now_v = int(self.write_version)
+            return now_v, self.changes.since(since)
 
     # --- maintenance --------------------------------------------------
     def ingest(self, kvs: Iterable[KV]) -> Status:

@@ -527,6 +527,14 @@ class StorageClient:
             self.version_stats["watch_rounds"] += 1
             known = cur
 
+    def host_changes_since(self, host: str, space_id: int, since: int):
+        """Delta-sync passthrough to one storage host (TPU engine feed;
+        runs only on invalidation, never per query)."""
+        svc = self._hosts.get(host)
+        if svc is None:
+            raise KeyError(host)
+        return svc.changes_since(space_id, since)
+
     def note_local_write(self, space_id: int) -> None:
         """Every mutation through this client bumps the space's local
         write sequence, which is part of the freshness token — so this

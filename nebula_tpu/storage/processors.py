@@ -698,6 +698,20 @@ class StorageService:
                 return cur
             time.sleep(0.05)
 
+    def changes_since(self, space_id: int, since: int):
+        """Committed writes of this host's space engine since version
+        `since`, resolved into logical deltas (kvstore/changelog.py) —
+        the remote TPU engine's incremental snapshot feed.
+        -> (now_version, entries | None); None = rebuild needed."""
+        from ..kvstore.changelog import resolve_changes
+        engine = self.store.space_engine(space_id)
+        if engine is None or getattr(engine, "changes", None) is None:
+            return -1, None
+        now_v, raw = engine.changes_snapshot(since)
+        if raw is None:
+            return now_v, None
+        return now_v, resolve_changes(engine, raw)
+
     def scan_part_cols(self, space_id: int, part: int,
                        kind: int) -> "ScanPartResponse":
         """Leader-local columnar scan of one (part, kind) data range.

@@ -88,21 +88,27 @@ def test_tpu_served_over_real_topology(net_cluster):
 
 
 def test_tpu_sees_remote_writes(net_cluster):
-    """Freshness across the RPC boundary: a write through graphd must
-    invalidate the device snapshot before the next read."""
+    """Freshness across the RPC boundary: a write through graphd must be
+    visible to the very next device read — WITHOUT a full snapshot
+    rebuild (the committed-write feed patches the CSR in place; ref
+    role: Part::commitLogs in-place apply, kvstore/Part.cpp:208-319)."""
     tc, cc, tpu, _ = net_cluster
+    assert tc.execute("GO FROM 110 OVER like YIELD like._dst").ok()
     rebuilds0 = tpu.stats["rebuilds"]
+    applies0 = tpu.stats["delta_applies"]
     assert tc.execute(
         "INSERT EDGE like(likeness) VALUES 110 -> 100:(55.0)").ok()
     rt = tc.execute("GO FROM 110 OVER like YIELD like._dst, like.likeness")
     rc = cc.execute("GO FROM 110 OVER like YIELD like._dst, like.likeness")
     assert sorted(map(str, rt.rows)) == sorted(map(str, rc.rows))
     assert (106, 70.0) in rt.rows and (100, 55.0) in rt.rows
-    assert tpu.stats["rebuilds"] > rebuilds0
-    # and a delete is equally visible
+    assert tpu.stats["rebuilds"] == rebuilds0, "write forced a rebuild"
+    assert tpu.stats["delta_applies"] > applies0
+    # and a delete is equally visible, also without a rebuild
     assert tc.execute("DELETE EDGE like 110 -> 100").ok()
     rt = tc.execute("GO FROM 110 OVER like YIELD like._dst")
     assert rt.rows == [(106,)], rt.rows
+    assert tpu.stats["rebuilds"] == rebuilds0, "delete forced a rebuild"
 
 
 def test_no_per_query_version_rpcs(net_cluster):

@@ -72,20 +72,29 @@ def test_device_actually_served(pair):
     assert tpu.stats["path_served"] == before_p + 1
 
 
-def test_snapshot_rebuilds_after_mutation(pair):
+def test_snapshot_patches_after_mutation(pair):
+    """Writes no longer force a rebuild: the committed-write feed
+    patches the device snapshot in place (delta buffer, SURVEY §7
+    hard-part (a)); results reflect the write immediately."""
     cpu_conn, tpu_conn, tpu = pair
+    tpu_conn.must("GO FROM 100 OVER like")   # snapshot exists
     rebuilds = tpu.stats["rebuilds"]
+    applies = tpu.stats["delta_applies"]
     tpu_conn.must('INSERT VERTEX player(name, age) VALUES 500:("Newbie", 20)')
     tpu_conn.must('INSERT EDGE like(likeness) VALUES 100 -> 500:(88.0)')
     r = tpu_conn.must("GO FROM 100 OVER like YIELD like._dst AS id")
     assert (500,) in r.rows
-    assert tpu.stats["rebuilds"] > rebuilds
+    assert tpu.stats["rebuilds"] == rebuilds, "write forced a full rebuild"
+    assert tpu.stats["delta_applies"] > applies
     # and unchanged data stays cached
     rebuilds = tpu.stats["rebuilds"]
     tpu_conn.must("GO FROM 100 OVER like")
     assert tpu.stats["rebuilds"] == rebuilds
-    # clean up for other tests in this module
+    # deletes are patched too (tombstone + delta removal)
     tpu_conn.must("DELETE VERTEX 500")
+    r = tpu_conn.must("GO FROM 100 OVER like YIELD like._dst AS id")
+    assert (500,) not in r.rows
+    assert tpu.stats["rebuilds"] == rebuilds, "delete forced a full rebuild"
     cpu_conn.must("GO FROM 100 OVER like")  # keep cpu side warm/symmetric
 
 
