@@ -119,7 +119,7 @@ def bench_tpu(store, sm, seed_sets):
     f_batch = jnp.asarray(np.stack(
         [snap.frontier_from_vids(s) for s in seed_sets]))
     req = jnp.asarray(traverse.pad_edge_types([1]))
-    args = (f_batch, jnp.int32(STEPS), snap.kernel, req)
+    args = (f_batch, jnp.int32(STEPS), snap.aligned_kernel(), req)
     t0 = time.time()
     counts = np.asarray(traverse.multi_hop_count_batch(*args))
     per_batch = int(counts.sum())

@@ -122,6 +122,7 @@ class CsrSnapshot:
         self.kernel_order_inv[order] = np.arange(len(order), dtype=np.int32)
         self.delta = None                # SnapshotDelta once writes land
         self.stale = False               # poisoned mid-apply: must not serve
+        self._aligned = None             # lazy batched-path layout
         self.d_edge_src = self.kernel.src
         self.d_edge_gidx = jnp.asarray(gidx)
         self.d_edge_etype = self.kernel.etype
@@ -155,6 +156,24 @@ class CsrSnapshot:
         if local is not None:
             return (p, local)
         return None
+
+    def aligned_kernel(self):
+        """Lazy AlignedKernel for the batched frontier-matrix path
+        (traverse.multi_hop_count_batch). Built from the CURRENT host
+        mirrors, so build-time state and tombstones are reflected; delta
+        ADDS are not — callers holding a non-empty delta must rebuild or
+        fall back to per-query kernels."""
+        if self._aligned is None:
+            from .traverse import build_aligned
+            P = self.num_parts
+            src, etype, valid = (a.reshape(-1)
+                                 for a in self._np_edge_stacks())
+            gsrc = (np.repeat(np.arange(P, dtype=np.int64), self.cap_e)
+                    * self.cap_v + src).astype(np.int32)
+            gdst = np.where(valid, self.np_gidx.reshape(-1),
+                            P * self.cap_v).astype(np.int64)
+            self._aligned = build_aligned(gsrc, etype, gdst, P * self.cap_v)
+        return self._aligned
 
     def vid_of_slot(self, p0: int, local: int) -> Optional[int]:
         """Inverse of locate (base or delta slot) — delta materialization."""

@@ -406,3 +406,4 @@ def _apply_valid_updates(snap, tomb: List[int], untomb: List[int]) -> None:
         valid_sorted = valid_sorted.at[jnp.asarray(order_inv[u])].set(True)
     snap.kernel = k._replace(valid=valid.reshape(P, snap.cap_e),
                              valid_sorted=valid_sorted)
+    snap._aligned = None   # batched layout must see the tombstones too

