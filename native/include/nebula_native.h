@@ -90,6 +90,9 @@ int32_t nkv_remove_prefix(nkv *e, const uint8_t *p, int64_t plen);
 
 /* buf = n repetitions of [u32 klen][k][u32 vlen][v] */
 int32_t nkv_multi_put(nkv *e, const uint8_t *buf, int64_t len, int32_t n);
+/* same buf layout, keys pre-sorted ascending: O(1)/key bulk load */
+int64_t nkv_ingest_sorted(nkv *e, const uint8_t *buf, int64_t len,
+                          int64_t n);
 /* buf = n repetitions of [u32 klen][k] */
 int32_t nkv_multi_remove(nkv *e, const uint8_t *buf, int64_t len, int32_t n);
 

@@ -23,10 +23,13 @@ from .storage.processors import StorageService
 class InProcCluster:
     """metad + storaged + graphd in one process."""
 
-    def __init__(self, tpu_engine=None, balancer_factory=None):
+    def __init__(self, tpu_engine=None, balancer_factory=None,
+                 engine_factory=None):
+        """engine_factory: space_id -> KVEngine (default MemEngine);
+        pass a NativeEngine factory for performance-grade storage."""
         self.meta = MetaService()
         self.sm = SchemaManager(self.meta)
-        self.store = GraphStore()
+        self.store = GraphStore(engine_factory=engine_factory)
         self.storage = StorageService(self.store, self.sm)
         self.client = StorageClient(self.sm, local_service=self.storage)
         # meta-driven topology: new space -> local parts appear (the

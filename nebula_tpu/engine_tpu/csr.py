@@ -47,14 +47,43 @@ def _round_up(n: int, m: int = LANE) -> int:
 
 @dataclass
 class PropColumn:
-    """One property column, host mirror + device-encodable form."""
+    """One property column, host mirror + device-encodable form.
+
+    `host` is full-fidelity: an object array (strings, and the python
+    decode path) OR a plain numeric numpy array (native decode path —
+    materializing 10^8 python objects at build time is prohibitive).
+    Read single cells through `host_item`, slices through
+    `host_gather`: both normalize nulls to None and numpy scalars to
+    python values so result rows stay identical to the CPU path."""
     name: str
     ptype: PropType
-    host: np.ndarray                      # full-fidelity (object for strings)
+    host: np.ndarray
     device_ok: bool                       # can this column go on device?
     device_vals: Optional[np.ndarray]     # f32/i32/bool codes, aligned
     present: Optional[np.ndarray] = None  # bool, False where value is null
     str_dict: Optional[Dict[str, int]] = None  # string -> code
+
+
+def host_item(col: PropColumn, idx: int):
+    """One host-mirror cell as a python value (None when null)."""
+    if col.present is not None and not col.present[idx]:
+        return None
+    v = col.host[idx]
+    return v.item() if isinstance(v, np.generic) else v
+
+
+def host_gather(col: PropColumn, ii: np.ndarray) -> np.ndarray:
+    """Host-mirror slice with nulls as None (object array when any null
+    or when the mirror itself is object-typed)."""
+    vals = col.host[ii]
+    if col.present is None:
+        return vals
+    pres = col.present[ii]
+    if pres.all():
+        return vals
+    out = vals.astype(object)
+    out[~pres] = None
+    return out
 
 
 @dataclass
@@ -158,11 +187,15 @@ class CsrSnapshot:
         return None
 
     def aligned_kernel(self):
-        """Lazy AlignedKernel for the batched frontier-matrix path
-        (traverse.multi_hop_count_batch). Built from the CURRENT host
-        mirrors, so build-time state and tombstones are reflected; delta
-        ADDS are not — callers holding a non-empty delta must rebuild or
-        fall back to per-query kernels."""
+        """Lazy (AlignedKernel, chunk, group) for the batched frontier-
+        matrix path (traverse.multi_hop_count_batch). Built from the
+        CURRENT host mirrors, so build-time state and tombstones are
+        reflected; delta ADDS are not — callers holding a non-empty
+        delta must rebuild or fall back to per-query kernels."""
+        if self.delta is not None and self.delta.edge_count > 0:
+            raise RuntimeError(
+                "aligned_kernel does not include delta-buffer edges; "
+                "repack the snapshot or use the per-query kernels")
         if self._aligned is None:
             from .traverse import build_aligned
             P = self.num_parts
@@ -623,13 +656,15 @@ def _native_build_columns(schema: Schema, cap: int, rows: "RowsBlock",
         device_ok = True
         device_vals = None
         str_dict = None
+        # numeric mirrors stay NUMPY (see PropColumn doc: no per-value
+        # python objects at snapshot scale); nulls ride `present`
         if t == PropType.DOUBLE:
             vals = f64[fi]
-            host[pos] = np.array(vals[pos].tolist(), dtype=object)
+            host = np.where(present, vals, 0.0)
             device_vals = np.where(present, vals, np.nan).astype(np.float32)
         elif t in (PropType.INT, PropType.VID, PropType.TIMESTAMP):
             vals = i64[fi]
-            host[pos] = np.array(vals[pos].tolist(), dtype=object)
+            host = np.where(present, vals, 0)
             if pos.size and (vals[pos].min() < _I32_MIN
                              or vals[pos].max() > _I32_MAX):
                 device_ok = False  # host-only column (filter falls back)
@@ -637,7 +672,7 @@ def _native_build_columns(schema: Schema, cap: int, rows: "RowsBlock",
                 device_vals = np.where(present, vals, 0).astype(np.int32)
         elif t == PropType.BOOL:
             vals = i64[fi] != 0
-            host[pos] = np.array(vals[pos].tolist(), dtype=object)
+            host = np.where(present, vals, False)
             device_vals = np.where(present, vals, False)
         elif t == PropType.STRING:
             if dict_registry is not None and dict_key is not None:

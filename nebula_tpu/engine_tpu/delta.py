@@ -222,7 +222,10 @@ def _patch_prop_columns(snap, cols: Dict, idx: int, props: Optional[dict],
     """Write one row's values into existing PropColumn mirrors at idx."""
     for name, col in cols.items():
         v = props.get(name) if (visible and props is not None) else None
-        col.host[idx] = v
+        if col.host.dtype == object:
+            col.host[idx] = v
+        else:   # numeric mirror: nulls ride `present`, cell stores 0
+            col.host[idx] = 0 if v is None else v
         if col.present is not None:
             col.present[idx] = v is not None
         if col.device_vals is not None:

@@ -76,11 +76,15 @@ class TpuGraphEngine:
         # not)
         self._lock = threading.RLock()
         self._repacking: Dict[int, bool] = {}
+        # pull-mode budget: frontiers whose cumulative edge visits stay
+        # under this run on host mirrors; larger ones amortize the dense
+        # device dispatch (direction-optimized execution)
+        self.sparse_edge_budget = 1 << 21
         self.stats = {"go_served": 0, "path_served": 0, "rebuilds": 0,
                       "fallbacks": 0, "sharded_queries": 0,
                       "fast_materialize": 0, "slow_materialize": 0,
                       "delta_applies": 0, "delta_edges": 0,
-                      "bg_repacks": 0}
+                      "bg_repacks": 0, "sparse_served": 0}
 
     # ------------------------------------------------------------------
     def attach(self, cluster) -> None:
@@ -173,6 +177,24 @@ class TpuGraphEngine:
             return None
         return self.refresh(space_id)
 
+    def _plan_filter(self, ctx, s, snap, use_delta, name_by_type,
+                     alias_map, edge_types):
+        """(device_mask, local_filter) for a WHERE clause: try the
+        device compile; fall back to host evaluation. With delta edges
+        in play a compiled mask would cover only canonical edges —
+        evaluate on the host for ALL rows so both row sources stay
+        consistent."""
+        if s.where is None:
+            return None, None
+        if use_delta:
+            return None, s.where.filter
+        fc = FilterCompiler(snap, self._sm, ctx.space_id(), name_by_type,
+                            alias_map, edge_types)
+        device_mask = fc.compile(s.where.filter)
+        if device_mask is None:
+            return None, s.where.filter
+        return device_mask, None
+
     @staticmethod
     def _token_compatible(snap, token) -> bool:
         """Deltas can only patch a snapshot whose routing still matches
@@ -238,17 +260,14 @@ class TpuGraphEngine:
         exprs = [c.expr for c in (s.yield_.columns if s.yield_ else [])]
         if s.where:
             exprs.append(s.where.filter)
-        if _uses_input_refs(exprs):
-            return False  # $-/$var back-references need CPU root tracking
-        if s.step.upto:
-            # UPTO emits one row per (edge, step); the device union mask
-            # loses that multiplicity — CPU path serves it exactly
+        if _uses_input_refs(exprs) and s.step.upto:
+            # per-root frontiers x per-step masks in one program is the
+            # rare combination we leave to the CPU loop
             return False
         return True
 
     def can_serve_path(self, space_id: int, s: ast.FindPathSentence) -> bool:
-        return bool(self.enabled and self._provider is not None
-                    and s.shortest)
+        return bool(self.enabled and self._provider is not None)
 
     # ------------------------------------------------------------------
     # GO on device
@@ -265,6 +284,12 @@ class TpuGraphEngine:
             return self._execute_go_locked(ctx, s, starts, edge_types,
                                            alias_map, name_by_type, ex)
 
+    MAX_ROOTS_ON_DEVICE = 64   # per-root frontier memory bound
+    MAX_DEVICE_STEPS = 16      # per-step mask stacks are [N, P, cap_e]:
+                               # unbounded N would unroll the trace and
+                               # OOM the chip — huge-N queries fall back
+                               # to the bounded-memory CPU loop
+
     def _execute_go_locked(self, ctx, s, starts, edge_types, alias_map,
                            name_by_type, ex):
         snap = self._snapshot_locked(ctx.space_id())
@@ -274,6 +299,18 @@ class TpuGraphEngine:
 
         yield_cols = ex._go_yield_columns(s, ctx, name_by_type)
         columns = [c.name() for c in yield_cols]
+        exprs = [c.expr for c in yield_cols]
+        if s.where is not None:
+            exprs.append(s.where.filter)
+        needs_input = _uses_input_refs(exprs)
+        upto = bool(s.step.upto)
+        if (needs_input or upto) and \
+                getattr(snap, "sharded_kernel", None) is not None:
+            self.stats["fallbacks"] += 1
+            return None   # mesh-sharded kernels serve the plain form only
+        if upto and not 1 <= int(s.step.steps) <= self.MAX_DEVICE_STEPS:
+            self.stats["fallbacks"] += 1
+            return None   # 0 steps / huge N: the CPU loop serves exactly
 
         frontier0 = snap.frontier_from_vids(starts)
         if not frontier0.any():
@@ -283,21 +320,28 @@ class TpuGraphEngine:
         req = jnp.asarray(traverse.pad_edge_types(edge_types))
 
         use_delta = snap.delta is not None and snap.delta.edge_count > 0
-        # filter: try device compile; else host-side at materialization.
-        # With delta edges in play a compiled device mask would cover
-        # only canonical edges — evaluate the filter on the host for
-        # ALL rows so the two row sources stay consistent.
-        device_mask = None
-        local_filter = None
-        if s.where is not None:
-            if use_delta:
-                local_filter = s.where.filter
-            else:
-                fc = FilterCompiler(snap, self._sm, ctx.space_id(),
-                                    name_by_type, alias_map, edge_types)
-                device_mask = fc.compile(s.where.filter)
-                if device_mask is None:
-                    local_filter = s.where.filter
+        if needs_input:
+            return self._go_roots(ctx, s, starts, req, snap, use_delta,
+                                  yield_cols, columns, alias_map,
+                                  name_by_type, ex)
+        if upto:
+            return self._go_upto(ctx, s, f0, req, edge_types, snap,
+                                 use_delta, yield_cols, columns, alias_map,
+                                 name_by_type, ex)
+        # direction-optimized execution: a frontier that stays small is
+        # served by a host-mirror pull over the snapshot (O(frontier
+        # edges)) instead of the dense device dispatch (O(E) per hop) —
+        # at SNB scale a selective 3-hop GO touches ~10^4 edges while
+        # the dense path reads all 10^8 slots every hop
+        if getattr(snap, "sharded_kernel", None) is None:
+            sparse = self._sparse_expand(snap, starts, edge_types,
+                                         int(s.step.steps))
+            if sparse is not None:
+                return self._emit_sparse(ctx, s, snap, sparse, yield_cols,
+                                         columns, alias_map, name_by_type,
+                                         ex)
+        device_mask, local_filter = self._plan_filter(
+            ctx, s, snap, use_delta, name_by_type, alias_map, edge_types)
 
         d_active = None
         if getattr(snap, "sharded_kernel", None) is not None:
@@ -399,10 +443,13 @@ class TpuGraphEngine:
         return resp
 
     # ------------------------------------------------------------------
-    def _materialize(self, snap: CsrSnapshot, mask: np.ndarray, ctx,
-                     yield_cols, s) -> BoundResponse:
+    def _materialize(self, snap: CsrSnapshot, mask: Optional[np.ndarray],
+                     ctx, yield_cols, s,
+                     idx_per_part: Optional[Dict[int, np.ndarray]] = None
+                     ) -> BoundResponse:
         """Compact the active-edge mask into the same BoundResponse shape
-        the CPU storage path returns, reading props from host mirrors."""
+        the CPU storage path returns, reading props from host mirrors.
+        Active edges come from `mask` or sparse `idx_per_part`."""
         space = ctx.space_id()
         resp = BoundResponse()
         src_tag_reqs, _, _ = _collect_src_tags(ctx, yield_cols, s)
@@ -410,7 +457,10 @@ class TpuGraphEngine:
         cap_counts: Dict[Tuple[int, int], int] = {}
         for p in range(snap.num_parts):
             shard = snap.shards[p]
-            idxs = np.nonzero(mask[p])[0]
+            if idx_per_part is not None:
+                idxs = idx_per_part.get(p, np.empty(0, np.int64))
+            else:
+                idxs = np.nonzero(mask[p])[0]
             for i in idxs:
                 i = int(i)
                 src_vid = int(shard.vids[shard.edge_src[i]])
@@ -437,6 +487,315 @@ class TpuGraphEngine:
         return resp
 
     # ------------------------------------------------------------------
+    # sparse (pull-mode) GO: host-mirror frontier advance for small
+    # frontiers — the direction-optimized half of the engine
+    # ------------------------------------------------------------------
+    def _sparse_expand(self, snap, starts, edge_types, steps):
+        """Advance the frontier over the snapshot's host mirrors,
+        visiting only the frontier's own edges. Returns (final active
+        canonical idx per part, final active delta slots) or None when
+        the visited-edge budget is exceeded (the dense device dispatch
+        amortizes better there)."""
+        req = set(edge_types)
+        delta = snap.delta if (snap.delta is not None
+                               and snap.delta.edge_count > 0) else None
+        frontier: Dict[int, np.ndarray] = {}
+        for v in set(starts):
+            loc = snap.locate(v)
+            if loc is not None:
+                frontier.setdefault(loc[0], []).append(loc[1])
+        frontier = {p: np.unique(np.asarray(ls, np.int64))
+                    for p, ls in frontier.items()}
+        budget = self.sparse_edge_budget
+        visited = 0
+        for step in range(steps):
+            final = step == steps - 1
+            act_idx: Dict[int, np.ndarray] = {}
+            d_act: List[Tuple[int, int]] = []
+            nxt: Dict[int, List[np.ndarray]] = {}
+            for p, locals_ in frontier.items():
+                shard = snap.shards[p]
+                base = locals_[locals_ < shard.num_vids_base]
+                if base.size:
+                    indptr = _shard_indptr(shard)
+                    lo, hi = indptr[base], indptr[base + 1]
+                    counts = (hi - lo).astype(np.int64)
+                    total = int(counts.sum())
+                    visited += total
+                    if visited > budget:
+                        return None
+                    if total:
+                        offs = np.repeat(lo - np.pad(np.cumsum(counts),
+                                                     (1, 0))[:-1], counts)
+                        idx = offs + np.arange(total)
+                        ok = shard.edge_valid[idx] & np.isin(
+                            shard.edge_etype[idx], list(req))
+                        idx = idx[ok]
+                        if idx.size:
+                            act_idx[p] = idx
+                            if not final:
+                                dp = shard.edge_dst_part[idx]
+                                dl = shard.edge_dst_local[idx]
+                                for q in np.unique(dp):
+                                    nxt.setdefault(int(q), []).append(
+                                        dl[dp == q].astype(np.int64))
+                if delta is not None:
+                    for l in locals_:
+                        gs = p * snap.cap_v + int(l)
+                        for slot in delta.by_src.get(gs, ()):
+                            if not delta.h_ok[slot]:
+                                continue
+                            info = delta.info.get(slot)
+                            if info is None or info[1] not in req:
+                                continue
+                            visited += 1
+                            if visited > budget:
+                                return None
+                            d_act.append(slot)
+                            if not final:
+                                q, dl = divmod(slot[0], snap.cap_v)
+                                nxt.setdefault(q, []).append(
+                                    np.asarray([dl], np.int64))
+            if final:
+                return act_idx, d_act
+            if not nxt:
+                return {}, []
+            frontier = {q: np.unique(np.concatenate(ls))
+                        for q, ls in nxt.items()}
+        return {}, []
+
+    def _emit_sparse(self, ctx, s, snap, sparse, yield_cols, columns,
+                     alias_map, name_by_type, ex):
+        from . import materialize
+        act_idx, d_act = sparse
+        # filters evaluate on the host: row counts here are small by
+        # construction (the sparse path only runs under the edge budget)
+        local_filter = s.where.filter if s.where is not None else None
+        rows: Optional[List[Tuple]] = None
+        needs_dst = _needs_dst(yield_cols, s)
+        if local_filter is None:
+            rows = materialize.emit_rows(snap, None, ctx, yield_cols,
+                                         alias_map, name_by_type,
+                                         idx_per_part=act_idx)
+        if rows is not None:
+            self.stats["fast_materialize"] += 1
+        else:
+            self.stats["slow_materialize"] += 1
+            resp = self._materialize(snap, None, ctx, yield_cols, s,
+                                     idx_per_part=act_idx)
+            rows = []
+            st = ex._emit_go_rows(ctx, resp, rows, yield_cols, local_filter,
+                                  alias_map, name_by_type, roots={},
+                                  input_index={}, needs_input=False,
+                                  needs_dst=needs_dst)
+            if not st.ok():
+                return StatusOr.from_status(st)
+        if d_act:
+            delta = snap.delta
+            d_mask = np.zeros_like(delta.h_ok)
+            for slot in d_act:
+                d_mask[slot] = True
+            dresp = self._materialize_delta(snap, d_mask, act_idx, ctx,
+                                            yield_cols, s)
+            st = ex._emit_go_rows(ctx, dresp, rows, yield_cols, local_filter,
+                                  alias_map, name_by_type, roots={},
+                                  input_index={}, needs_input=False,
+                                  needs_dst=needs_dst)
+            if not st.ok():
+                return StatusOr.from_status(st)
+        result = ex.InterimResult(columns, rows)
+        if s.yield_ and s.yield_.distinct:
+            result = result.distinct()
+        self.stats["go_served"] += 1
+        self.stats["sparse_served"] += 1
+        return StatusOr.of(result)
+
+    # ------------------------------------------------------------------
+    # FIND ALL/NOLOOP PATH: per-level device adjacency, host enumeration
+    # (ref FindPathExecutor.cpp:218-290 — the join stays on CPU, the
+    # per-hop storage expansion moves on-chip)
+    # ------------------------------------------------------------------
+    def _find_all_paths(self, ctx, s, sources, targets, edge_types,
+                        name_by_type, snap, ex):
+        if getattr(snap, "sharded_kernel", None) is not None:
+            return None   # mesh-sharded kernels serve shortest only
+        if not 1 <= int(s.step.steps) <= self.MAX_DEVICE_STEPS:
+            return None   # 0 steps / huge N: bounded CPU loop serves
+        import jax.numpy as jnp
+        upto = int(s.step.steps)
+        f0 = jnp.asarray(snap.frontier_from_vids(sources))
+        req = jnp.asarray(traverse.pad_edge_types(edge_types))
+        use_delta = snap.delta is not None and snap.delta.edge_count > 0
+        if use_delta:
+            masks, dmasks = traverse.multi_hop_steps_delta(
+                f0, snap.kernel, snap.delta.device(), req, steps=upto)
+        else:
+            masks = traverse.multi_hop_steps(f0, snap.kernel, req,
+                                             steps=upto)
+            dmasks = None
+        masks = np.asarray(masks)
+        dmasks = None if dmasks is None else np.asarray(dmasks)
+        delta = snap.delta
+
+        def expand_fn(_frontier, depth):
+            """ALL edges active at this level, indexed by src vid — a
+            superset of the enumeration loop's path-end lookups (the
+            device frontier never prunes by path like NOLOOP does).
+            The per-(src, etype) cap matches the CPU path's
+            max_edges_per_vertex truncation in get_neighbors."""
+            from .materialize import _apply_cap
+            by_src: Dict[int, list] = {}
+            cap_counts: Dict[Tuple[int, int], int] = {}
+            mask = masks[depth]
+            for p, shard in enumerate(snap.shards):
+                idx = np.nonzero(mask[p])[0]
+                if idx.size == 0:
+                    continue
+                idx = _apply_cap(shard, idx)
+                svids = shard.vids[shard.edge_src[idx]]
+                for i, sv in zip(idx, svids):
+                    sv, et = int(sv), int(shard.edge_etype[i])
+                    cap_counts[(sv, et)] = cap_counts.get((sv, et), 0) + 1
+                    by_src.setdefault(sv, []).append(
+                        (int(shard.edge_dst_vid[i]), et,
+                         int(shard.edge_rank[i])))
+            if dmasks is not None:
+                for gdst, lane in zip(*np.nonzero(dmasks[depth])):
+                    info = delta.info.get((int(gdst), int(lane)))
+                    if info is None:
+                        continue
+                    src_vid, etype, rank, dst_vid, _props = info
+                    ck = (src_vid, etype)
+                    cap_counts[ck] = cap_counts.get(ck, 0) + 1
+                    if cap_counts[ck] > DEFAULT_MAX_EDGES_PER_VERTEX:
+                        continue
+                    by_src.setdefault(src_vid, []).append(
+                        (dst_vid, etype, rank))
+            return by_src
+
+        paths = ex._all_paths(ctx, ctx.space_id(), sources, targets,
+                              edge_types, upto, name_by_type,
+                              noloop=s.noloop, expand_fn=expand_fn)
+        self.stats["path_served"] += 1
+        return StatusOr.of(ex.InterimResult(["_path_"],
+                                            [(p,) for p in paths]))
+
+    # ------------------------------------------------------------------
+    # GO UPTO: per-step masks (one row per (edge, step), ref upto
+    # emission in the CPU loop / GoExecutor union semantics)
+    # ------------------------------------------------------------------
+    def _go_upto(self, ctx, s, f0, req, edge_types, snap, use_delta,
+                 yield_cols, columns, alias_map, name_by_type, ex):
+        from . import materialize
+        steps = int(s.step.steps)
+        device_mask, local_filter = self._plan_filter(
+            ctx, s, snap, use_delta, name_by_type, alias_map, edge_types)
+        if use_delta:
+            masks, dmasks = traverse.multi_hop_steps_delta(
+                f0, snap.kernel, snap.delta.device(), req, steps=steps)
+        else:
+            masks = traverse.multi_hop_steps(f0, snap.kernel, req,
+                                             steps=steps)
+            dmasks = None
+        dm_np = None if device_mask is None else np.asarray(device_mask)
+        rows: List[Tuple] = []
+        needs_dst = _needs_dst(yield_cols, s)
+        for si in range(steps):
+            mask = np.asarray(masks[si])
+            if dm_np is not None:
+                mask = mask & dm_np
+            step_rows = None
+            if local_filter is None:
+                step_rows = materialize.emit_rows(snap, mask, ctx,
+                                                  yield_cols, alias_map,
+                                                  name_by_type)
+            if step_rows is not None:
+                self.stats["fast_materialize"] += 1
+                rows.extend(step_rows)
+            else:
+                self.stats["slow_materialize"] += 1
+                resp = self._materialize(snap, mask, ctx, yield_cols, s)
+                st = ex._emit_go_rows(ctx, resp, rows, yield_cols,
+                                      local_filter, alias_map, name_by_type,
+                                      roots={}, input_index={},
+                                      needs_input=False, needs_dst=needs_dst)
+                if not st.ok():
+                    return StatusOr.from_status(st)
+            if dmasks is not None:
+                d_mask = np.asarray(dmasks[si])
+                if d_mask.any():
+                    dresp = self._materialize_delta(snap, d_mask, mask, ctx,
+                                                    yield_cols, s)
+                    st = ex._emit_go_rows(ctx, dresp, rows, yield_cols,
+                                          local_filter, alias_map,
+                                          name_by_type, roots={},
+                                          input_index={}, needs_input=False,
+                                          needs_dst=needs_dst)
+                    if not st.ok():
+                        return StatusOr.from_status(st)
+        result = ex.InterimResult(columns, rows)
+        if s.yield_ and s.yield_.distinct:
+            result = result.distinct()
+        self.stats["go_served"] += 1
+        return StatusOr.of(result)
+
+    # ------------------------------------------------------------------
+    # input-ref GO: one frontier per root so result rows join back to
+    # the input rows of the root that reached them (the device form of
+    # VertexBackTracker, ref GoExecutor.cpp:1067-1075)
+    # ------------------------------------------------------------------
+    def _go_roots(self, ctx, s, starts, req, snap, use_delta, yield_cols,
+                  columns, alias_map, name_by_type, ex):
+        import jax.numpy as jnp
+        roots = sorted(set(starts))
+        # [R, P, cap_e] masks materialize on device AND host: bound the
+        # root count by a ~1GB mask budget, not just the fixed cap
+        mask_budget = (1 << 30) // max(snap.num_parts * snap.cap_e, 1)
+        if len(roots) > min(self.MAX_ROOTS_ON_DEVICE, max(mask_budget, 1)):
+            self.stats["fallbacks"] += 1
+            return None
+        # input/var refs are evaluated per joined input row on the host
+        local_filter = s.where.filter if s.where is not None else None
+        f0s = jnp.asarray(np.stack(
+            [snap.frontier_from_vids([r]) for r in roots]))
+        if use_delta:
+            masks, dmasks = traverse.multi_hop_roots_delta(
+                f0s, s.step.steps, snap.kernel, snap.delta.device(), req)
+        else:
+            masks = traverse.multi_hop_roots(f0s, s.step.steps, snap.kernel,
+                                             req)
+            dmasks = None
+        masks = np.asarray(masks)
+        dmasks = None if dmasks is None else np.asarray(dmasks)
+        input_index = ex.build_input_index(ctx, s)
+        input_var = s.from_.ref.var \
+            if isinstance(s.from_.ref, VariablePropExpr) else None
+        needs_dst = _needs_dst(yield_cols, s)
+        rows: List[Tuple] = []
+        for i, root in enumerate(roots):
+            mask = masks[i]
+            d_mask = dmasks[i] if dmasks is not None else None
+            if not mask.any() and (d_mask is None or not d_mask.any()):
+                continue
+            resp = self._materialize(snap, mask, ctx, yield_cols, s)
+            if d_mask is not None and d_mask.any():
+                dresp = self._materialize_delta(snap, d_mask, mask, ctx,
+                                                yield_cols, s)
+                _merge_bound_resp(resp, dresp)
+            roots_map = {v.vid: {root} for v in resp.vertices}
+            st = ex._emit_go_rows(ctx, resp, rows, yield_cols, local_filter,
+                                  alias_map, name_by_type, roots=roots_map,
+                                  input_index=input_index, needs_input=True,
+                                  needs_dst=needs_dst, input_var=input_var)
+            if not st.ok():
+                return StatusOr.from_status(st)
+        result = ex.InterimResult(columns, rows)
+        if s.yield_ and s.yield_.distinct:
+            result = result.distinct()
+        self.stats["go_served"] += 1
+        return StatusOr.of(result)
+
+    # ------------------------------------------------------------------
     # FIND SHORTEST PATH on device
     # ------------------------------------------------------------------
     def execute_find_path(self, ctx, s: ast.FindPathSentence,
@@ -458,6 +817,9 @@ class TpuGraphEngine:
             if snap is None:
                 return None
             return StatusOr.of(ex.InterimResult(["_path_"]))
+        if not s.shortest:
+            return self._find_all_paths(ctx, s, sources, targets,
+                                        edge_types, name_by_type, snap, ex)
         import jax.numpy as jnp
         f_src = snap.frontier_from_vids(sources)
         f_dst = snap.frontier_from_vids(targets)
@@ -520,10 +882,27 @@ def _needs_dst(yield_cols, s) -> bool:
     return False
 
 
-def _base_active_count(snap, base_mask: np.ndarray, src_vid: int,
-                       etype: int) -> int:
-    """Active base edges of (src, etype) in the final-hop mask — the
-    starting point for the per-vertex cap over delta rows."""
+def _merge_bound_resp(resp: BoundResponse, other: BoundResponse) -> None:
+    """Merge `other`'s vertices into resp (same shape the CPU client's
+    collectResponse produces for one host) — delta rows join base rows
+    under their shared source vertex."""
+    by_vid = {v.vid: v for v in resp.vertices}
+    for v in other.vertices:
+        mine = by_vid.get(v.vid)
+        if mine is None:
+            resp.vertices.append(v)
+            by_vid[v.vid] = v
+        else:
+            mine.edges.extend(v.edges)
+            for tid, props in v.tag_props.items():
+                mine.tag_props.setdefault(tid, props)
+
+
+def _base_active_count(snap, base, src_vid: int, etype: int) -> int:
+    """Active base edges of (src, etype) in the final hop — the
+    starting point for the per-vertex cap over delta rows. `base` is a
+    dense [P, cap_e] bool mask OR a sparse {part0: ascending idx} dict
+    (the pull-mode form)."""
     loc = snap.locate(src_vid)
     if loc is None:
         return 0
@@ -535,12 +914,19 @@ def _base_active_count(snap, base_mask: np.ndarray, src_vid: int,
     lo, hi = int(indptr[local]), int(indptr[local + 1])
     if lo >= hi:
         return 0
+    if isinstance(base, dict):
+        idx = base.get(p)
+        if idx is None or idx.size == 0:
+            return 0
+        sel = idx[np.searchsorted(idx, lo):np.searchsorted(idx, hi)]
+        return int((shard.edge_etype[sel] == etype).sum())
     seg = slice(lo, hi)
-    return int((base_mask[p, seg]
+    return int((base[p, seg]
                 & (shard.edge_etype[seg] == etype)).sum())
 
 
 def _host_tag_props(shard, tag_id: int, local: int) -> Optional[Dict[str, Any]]:
+    from .csr import host_item
     cols = shard.tag_props.get(tag_id)
     if cols is None:
         return None
@@ -551,14 +937,15 @@ def _host_tag_props(shard, tag_id: int, local: int) -> Optional[Dict[str, Any]]:
                       for c in cols.values())
         if not has_any:
             return None
-    return {name: col.host[local] for name, col in cols.items()}
+    return {name: host_item(col, local) for name, col in cols.items()}
 
 
 def _host_edge_props(shard, etype: int, edge_idx: int) -> Dict[str, Any]:
+    from .csr import host_item
     cols = shard.edge_props.get(etype)
     if not cols:
         return {}
-    return {name: col.host[edge_idx] for name, col in cols.items()}
+    return {name: host_item(col, edge_idx) for name, col in cols.items()}
 
 
 def _shard_indptr(shard) -> np.ndarray:

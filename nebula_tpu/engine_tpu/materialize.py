@@ -133,7 +133,8 @@ def _plan(expr, sm, space: int, alias_map: Dict[str, str],
                 if cols is None or prop not in cols:
                     return None  # CPU raises "prop not found": fallback
                 sel = ets == t
-                out[sel] = cols[prop].host[env.idx[sel]]
+                from .csr import host_gather
+                out[sel] = host_gather(cols[prop], env.idx[sel]).tolist()
             return out
         return edge_prop
 
@@ -174,7 +175,7 @@ def _plan(expr, sm, space: int, alias_map: Dict[str, str],
                 loc = dlocals[sel]
                 if col.present is not None and not col.present[loc].all():
                     return None   # dst lacks the tag row: CPU raises
-                out[sel] = col.host[loc]
+                out[sel] = col.host[loc].tolist()
             return out
         return dst_prop
 
@@ -199,11 +200,15 @@ def _apply_cap(shard, idx: np.ndarray,
     return idx[rank < cap]
 
 
-def emit_rows(snap, mask: np.ndarray, ctx, yield_cols, alias_map,
-              name_by_type) -> Optional[List[Tuple]]:
+def emit_rows(snap, mask: Optional[np.ndarray], ctx, yield_cols, alias_map,
+              name_by_type,
+              idx_per_part: Optional[Dict[int, np.ndarray]] = None
+              ) -> Optional[List[Tuple]]:
     """Fully-columnar GO row emission. None = fall back to the slow
     (VertexData) path. Only call when no CPU-side filter or input
-    back-references remain (can_serve already excludes $-/$var)."""
+    back-references remain (can_serve already excludes $-/$var).
+    Active edges come from `mask` (dense [P, cap_e] bool) or
+    `idx_per_part` (sparse: part0 -> ascending canonical indices)."""
     sm = ctx.sm
     space = ctx.space_id()
     plans = []
@@ -215,7 +220,12 @@ def emit_rows(snap, mask: np.ndarray, ctx, yield_cols, alias_map,
 
     rows: List[Tuple] = []
     for p0, shard in enumerate(snap.shards):
-        idx = np.nonzero(mask[p0])[0]
+        if idx_per_part is not None:
+            idx = idx_per_part.get(p0)
+            if idx is None:
+                continue
+        else:
+            idx = np.nonzero(mask[p0])[0]
         if idx.size == 0:
             continue
         idx = _apply_cap(shard, idx)

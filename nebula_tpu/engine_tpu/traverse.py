@@ -348,6 +348,73 @@ def multi_hop_count(frontier0: jnp.ndarray, steps: jnp.ndarray,
 
 
 # ---------------------------------------------------------------------------
+# UPTO (per-step masks) and input-ref (per-root) traversal
+# ---------------------------------------------------------------------------
+
+from functools import partial
+
+
+@partial(jax.jit, static_argnames=("steps",))
+def multi_hop_steps(frontier0: jnp.ndarray, k: EdgeKernel,
+                    req_types: jnp.ndarray, steps: int) -> jnp.ndarray:
+    """Per-step active edge masks for GO UPTO: the device analogue of
+    emitting rows at EVERY step 1..N (ref: GoExecutor's upto emission).
+    `steps` is static — the AST carries a literal N, and the stacked
+    [steps, P, cap_e] output shape depends on it (one trace per N).
+    """
+    edge_ok = _edge_ok(k.etype, k.valid, req_types)
+    ok_sorted = _edge_ok(k.etype_sorted, k.valid_sorted, req_types)
+    masks = []
+    f = frontier0
+    for _ in range(steps):
+        masks.append(jnp.take_along_axis(f, k.src, axis=1) & edge_ok)
+        f = _advance(f, k, ok_sorted)
+    return jnp.stack(masks)
+
+
+@partial(jax.jit, static_argnames=("steps",))
+def multi_hop_steps_delta(frontier0: jnp.ndarray, k: EdgeKernel,
+                          dk: DeltaKernel, req_types: jnp.ndarray,
+                          steps: int):
+    """multi_hop_steps over the union graph.
+    -> (masks [steps, P, cap_e], delta_masks [steps, n_slots, K])."""
+    edge_ok = _edge_ok(k.etype, k.valid, req_types)
+    ok_sorted = _edge_ok(k.etype_sorted, k.valid_sorted, req_types)
+    d_ok = _edge_ok(dk.etype, dk.ok, req_types)
+    masks, dmasks = [], []
+    f = frontier0
+    for _ in range(steps):
+        masks.append(jnp.take_along_axis(f, k.src, axis=1) & edge_ok)
+        dmasks.append(f.reshape(-1)[dk.src] & d_ok)
+        f = _advance(f, k, ok_sorted) | _delta_hits(f, dk, d_ok)
+    return jnp.stack(masks), jnp.stack(dmasks)
+
+
+@jax.jit
+def multi_hop_roots(frontiers0: jnp.ndarray, steps: jnp.ndarray,
+                    k: EdgeKernel, req_types: jnp.ndarray) -> jnp.ndarray:
+    """Final-step active edge masks per ROOT — input-ref GO runs one
+    frontier per root so materialization can join result rows back to
+    the input rows of the root that reached them (the device form of
+    VertexBackTracker, ref GoExecutor.cpp:1067-1075).
+    frontiers0: bool[R, P, cap_v] -> bool[R, P, cap_e]."""
+    return jax.vmap(
+        lambda f: multi_hop(f, steps, k, req_types)[1])(frontiers0)
+
+
+@jax.jit
+def multi_hop_roots_delta(frontiers0: jnp.ndarray, steps: jnp.ndarray,
+                          k: EdgeKernel, dk: DeltaKernel,
+                          req_types: jnp.ndarray):
+    """multi_hop_roots over the union graph.
+    -> (masks [R, P, cap_e], delta_masks [R, n_slots, K])."""
+    def one(f):
+        _, active, d_active = multi_hop_delta(f, steps, k, dk, req_types)
+        return active, d_active
+    return jax.vmap(one)(frontiers0)
+
+
+# ---------------------------------------------------------------------------
 # batched traversal: chunk-aligned layout + int8 lane matrix
 # ---------------------------------------------------------------------------
 
@@ -377,23 +444,42 @@ class AlignedKernel(NamedTuple):
     cbound: jnp.ndarray  # int32[n_slots+1] chunk index of each segment start
 
 
+def pick_chunk(n_edges: int) -> Tuple[int, int]:
+    """(chunk, group) for an edge count: chunks of 8 measure fastest at
+    <=10M-edge scale, but the per-chunk device arrays are O(E/chunk *
+    512B) — at 10^8 edges chunk=8 alone would cost ~6.7GB, so larger
+    graphs take bigger chunks (more segment padding, far less chunk-sum
+    memory/traffic)."""
+    if n_edges <= (1 << 25):
+        return 8, 16
+    if n_edges <= (1 << 27):
+        return 16, 16
+    return 32, 16
+
+
 def build_aligned(gsrc: np.ndarray, etype: np.ndarray, gdst: np.ndarray,
-                  n_slots: int) -> AlignedKernel:
+                  n_slots: int,
+                  chunk: Optional[int] = None,
+                  group: int = G_ALIGN
+                  ) -> Tuple[AlignedKernel, int, int]:
     """Host-side aligned-layout build from flat canonical edge arrays
     (gdst = dump >= n_slots for invalid/padded edges, which are
-    dropped)."""
+    dropped). -> (kernel, chunk, group) — chunk/group are static
+    parameters of the matching multi_hop_count_batch call."""
     order = np.argsort(gdst, kind="stable")
     sg = gdst[order]
     nreal = int(np.searchsorted(sg, n_slots))
+    if chunk is None:
+        chunk, group = pick_chunk(nreal)
     order, sg = order[:nreal], sg[:nreal]
     starts = np.searchsorted(sg, np.arange(n_slots)).astype(np.int64)
     ends = np.searchsorted(sg, np.arange(n_slots) + 1).astype(np.int64)
-    pdeg = ((ends - starts + C_ALIGN - 1) // C_ALIGN) * C_ALIGN
+    pdeg = ((ends - starts + chunk - 1) // chunk) * chunk
     astart = np.zeros(n_slots + 1, np.int64)
     np.cumsum(pdeg, out=astart[1:])
-    span = C_ALIGN * G_ALIGN
-    # round up, then add one all-zero group so the exclusive prefix
-    # covers the final boundary without a concat in the kernel
+    span = chunk * group
+    # round up, then add one all-zero group so the prefix pieces cover
+    # the final boundary
     e_pad = (int(astart[-1]) + span - 1) // span * span + span
     a_src = np.full(e_pad, n_slots, np.int32)
     a_etype = np.zeros(e_pad, np.int32)
@@ -401,21 +487,27 @@ def build_aligned(gsrc: np.ndarray, etype: np.ndarray, gdst: np.ndarray,
         pos = astart[:-1][sg] + (np.arange(nreal) - starts[sg])
         a_src[pos] = gsrc[order]
         a_etype[pos] = etype[order]
-    cbound = (astart // C_ALIGN).astype(np.int32)
-    return AlignedKernel(jnp.asarray(a_src), jnp.asarray(a_etype),
-                         jnp.asarray(cbound))
+    cbound = (astart // chunk).astype(np.int32)
+    return (AlignedKernel(jnp.asarray(a_src), jnp.asarray(a_etype),
+                          jnp.asarray(cbound)), chunk, group)
 
 
-@jax.jit
+@partial(jax.jit, static_argnames=("chunk", "group"))
 def multi_hop_count_batch(frontiers0: jnp.ndarray, steps: jnp.ndarray,
-                          ak: AlignedKernel,
-                          req_types: jnp.ndarray) -> jnp.ndarray:
+                          ak: AlignedKernel, req_types: jnp.ndarray,
+                          chunk: int = C_ALIGN,
+                          group: int = G_ALIGN) -> jnp.ndarray:
     """Batch of independent GO queries in ONE dispatch over a
     [n_slots+1, 128] int8 frontier matrix (row n_slots stays zero): per
     hop, ONE [E_pad] gather of 128-byte frontier rows fused into chunk
     sums, a two-level prefix over chunks, and one boundary gather. The
     random-gather count per hop is independent of B — batching
     amortizes the gather-engine bottleneck across all lanes.
+
+    The edge axis is processed in ~8M-edge blocks (lax.map) so the
+    [block, 128] gather intermediate stays bounded — at 10^8 edges an
+    unblocked [E_pad, 128] int8 would be ~13GB and OOM the chip.
+    chunk/group must be the values build_aligned returned for `ak`.
 
     frontiers0: bool[B, P, cap_v], B <= 128 (lanes beyond B ride along
     zero) -> int64[B] per-query edges traversed (every hop's expansions
@@ -425,29 +517,43 @@ def multi_hop_count_batch(frontiers0: jnp.ndarray, steps: jnp.ndarray,
     if B > LANES:
         raise ValueError(f"batch {B} > {LANES} lanes per dispatch")
     ns = ak.cbound.shape[0] - 1
-    NC = ak.src.shape[0] // C_ALIGN
-    NG = NC // G_ALIGN
+    e_pad = ak.src.shape[0]
+    span = chunk * group
+    nb = max(1, -(-e_pad // (1 << 23)))          # ~8M edges per block
+    blk = -(-e_pad // nb // span) * span
+    tot = nb * blk
+    nc = tot // chunk
+    ng = nc // group
     F = jnp.zeros((ns + 1, LANES), jnp.int8)
     F = F.at[:ns, :B].set(frontiers0.reshape(B, -1).T.astype(jnp.int8))
     # dead edges (type mismatch this dispatch) -> the always-zero row
     ok = (ak.etype[None] == req_types[:, None]).any(axis=0)
-    src_eff = jnp.where(ok, ak.src, ns)
+    src_eff = jnp.pad(jnp.where(ok, ak.src, ns), (0, tot - e_pad),
+                      constant_values=ns).reshape(nb, blk)
+    g_idx = ak.cbound // group                   # [ns+1] group of boundary
+    j_idx = ak.cbound % group                    # [ns+1] chunk within group
 
     def body(_, state):
         f, total = state
-        cs = f[src_eff].reshape(NC, C_ALIGN, LANES).sum(
-            axis=1, dtype=jnp.int32)                      # fused gather+sum
-        local_inc = jnp.cumsum(cs.reshape(NG, G_ALIGN, LANES), axis=1)
+
+        def block_cs(sb):                        # fused gather + chunk sum
+            return f[sb].reshape(blk // chunk, chunk, LANES).sum(
+                axis=1, dtype=jnp.int32)
+
+        cs = lax.map(block_cs, src_eff).reshape(nc, LANES)
+        local_inc = jnp.cumsum(cs.reshape(ng, group, LANES), axis=1)
         grp_tot = local_inc[:, -1]
         grp_exc = jnp.pad(jnp.cumsum(grp_tot, axis=0),
                           ((1, 0), (0, 0)))[:-1]
-        S_exc = (grp_exc[:, None]
-                 + jnp.pad(local_inc, ((0, 0), (1, 0), (0, 0)))[:, :-1]
-                 ).reshape(NC, LANES)                     # exclusive @ chunk
         # int64 accumulator: >2^31 edges per query is reachable on large
         # graphs (canonicalizes to int32 only when x64 is disabled)
         total = total + (grp_exc[-1] + grp_tot[-1]).astype(jnp.int64)
-        Sv = S_exc[ak.cbound]                             # ONE [ns+1] gather
+        # exclusive prefix AT the boundaries only (never materializing
+        # the full [nc, LANES] scan): grp_exc[g] + within-group prefix
+        local_prev = jnp.where(
+            (j_idx > 0)[:, None],
+            local_inc[g_idx, jnp.maximum(j_idx - 1, 0)], 0)
+        Sv = grp_exc[g_idx] + local_prev         # [ns+1, LANES]
         hits = (Sv[1:] - Sv[:-1]) > 0
         return jnp.pad(hits.astype(jnp.int8), ((0, 1), (0, 0))), total
 

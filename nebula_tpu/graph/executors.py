@@ -177,6 +177,26 @@ def _fetch_dst_props(ctx: ExecContext, dsts: List[int]
 # GO (ref: graph/GoExecutor.cpp — the north-star read path)
 # ---------------------------------------------------------------------------
 
+def build_input_index(ctx: ExecContext, s: ast.GoSentence
+                      ) -> Dict[int, List[Dict[str, Any]]]:
+    """Root vid -> input rows for $-/$var back-references (the
+    VertexBackTracker join table, ref GoExecutor.cpp:1067-1075). Shared
+    by the CPU loop and the device engine's per-root path."""
+    input_index: Dict[int, List[Dict[str, Any]]] = {}
+    src_table = None
+    key_col = None
+    if s.from_.ref is not None and isinstance(s.from_.ref, VariablePropExpr):
+        src_table = ctx.variables.get(s.from_.ref.var)
+        key_col = s.from_.ref.prop
+    elif ctx.input is not None and s.from_.ref is not None:
+        src_table = ctx.input
+        key_col = s.from_.ref.prop
+    if src_table is not None:
+        for vid, rows in src_table.build_index(key_col).items():
+            input_index[vid] = [src_table.row_dict(r) for r in rows]
+    return input_index
+
+
 def execute_go(ctx: ExecContext, s: ast.GoSentence) -> Result:
     st = ctx.require_space()
     if not st.ok():
@@ -221,17 +241,10 @@ def execute_go(ctx: ExecContext, s: ast.GoSentence) -> Result:
 
     # input back-reference index: root vid -> input rows
     input_index: Dict[int, List[Dict[str, Any]]] = {}
+    input_var = s.from_.ref.var \
+        if isinstance(s.from_.ref, VariablePropExpr) else None
     if needs_input:
-        src_table = None
-        if s.from_.ref is not None and isinstance(s.from_.ref, VariablePropExpr):
-            src_table = ctx.variables.get(s.from_.ref.var)
-            key_col = s.from_.ref.prop
-        elif ctx.input is not None and s.from_.ref is not None:
-            src_table = ctx.input
-            key_col = s.from_.ref.prop
-        if src_table is not None:
-            for vid, rows in src_table.build_index(key_col).items():
-                input_index[vid] = [src_table.row_dict(r) for r in rows]
+        input_index = build_input_index(ctx, s)
 
     # multi-hop frontier loop (ref: stepOut/onStepOutResponse); roots map
     # mirrors VertexBackTracker (ref GoExecutor.cpp:1067-1075). With UPTO,
@@ -259,7 +272,7 @@ def execute_go(ctx: ExecContext, s: ast.GoSentence) -> Result:
                 return _err(bad[0].code, "storage error during GO")
             st = _emit_go_rows(ctx, resp, rows, yield_cols, local_filter,
                                alias_map, name_by_type, roots, input_index,
-                               needs_input, needs_dst)
+                               needs_input, needs_dst, input_var=input_var)
             if not st.ok():
                 return StatusOr.from_status(st)
         else:
@@ -297,7 +310,8 @@ def _emit_go_rows(ctx: ExecContext, resp, rows: List[Tuple],
                   alias_map: Dict[str, str], name_by_type: Dict[int, str],
                   roots: Dict[int, Set[int]],
                   input_index: Dict[int, List[Dict[str, Any]]],
-                  needs_input: bool, needs_dst: bool) -> Status:
+                  needs_input: bool, needs_dst: bool,
+                  input_var: Optional[str] = None) -> Status:
     space = ctx.space_id()
     dst_props: Dict[int, Dict[str, Dict[str, Any]]] = {}
     if needs_dst:
@@ -321,7 +335,12 @@ def _emit_go_rows(ctx: ExecContext, resp, rows: List[Tuple],
             else:
                 in_rows = [None]
             for in_row in in_rows:
-                ectx = EdgeRowExprContext(input_row=in_row, **base)
+                # a $var-sourced GO exposes the joined row as BOTH the
+                # input row and the named variable ($var.prop yields)
+                variables = {input_var: in_row} \
+                    if input_var is not None and in_row else None
+                ectx = EdgeRowExprContext(input_row=in_row,
+                                          variables=variables, **base)
                 if local_filter is not None:
                     try:
                         if not local_filter.eval(ectx):
@@ -514,8 +533,13 @@ def _shortest_paths(ctx: ExecContext, space: int, sources: List[int],
 def _all_paths(ctx: ExecContext, space: int, sources: List[int],
                targets: List[int], edge_types: List[int], upto: int,
                name_by_type: Dict[int, str], noloop: bool = False,
-               max_paths: int = 10000) -> List[str]:
-    """ALL/NOLOOP PATH: iterative-deepening DFS over batched expansions."""
+               max_paths: int = 10000, expand_fn=None) -> List[str]:
+    """ALL/NOLOOP PATH: iterative-deepening DFS over batched expansions.
+
+    expand_fn(frontier, depth) -> {src: [(dst, etype, rank)]}: optional
+    adjacency source — the TPU engine passes per-level device masks so
+    the SAME enumeration loop runs over on-chip expansions (superset
+    adjacency is fine; only path-end lookups are consulted)."""
     targets_set = set(targets)
     found: List[str] = []
     # BFS by levels, keeping every path (exponential — capped)
@@ -527,12 +551,15 @@ def _all_paths(ctx: ExecContext, space: int, sources: List[int],
         frontier = sorted({p[0][-1] for p in level})
         if not frontier:
             break
-        adj = _expand(ctx, space, frontier, edge_types)
-        # index by src so each path extends in O(out-degree)
-        by_src: Dict[int, List[Tuple[int, int, int]]] = {}
-        for dst, incomings in adj.items():
-            for (src, et, rank) in incomings:
-                by_src.setdefault(src, []).append((dst, et, rank))
+        if expand_fn is not None:
+            by_src = expand_fn(frontier, depth)
+        else:
+            adj = _expand(ctx, space, frontier, edge_types)
+            # index by src so each path extends in O(out-degree)
+            by_src = {}
+            for dst, incomings in adj.items():
+                for (s_, et, rank) in incomings:
+                    by_src.setdefault(s_, []).append((dst, et, rank))
         nxt: List[Tuple[tuple, tuple]] = []
         for vids, steps in level:
             for (dst, et, rank) in by_src.get(vids[-1], ()):

@@ -219,6 +219,18 @@ class NativeEngine(KVEngine):
             self.changes.record(self.write_version, "barrier", None)
         return Status.OK()
 
+    def ingest_packed(self, buf: bytes, n: int) -> Status:
+        """Bulk load `n` pre-sorted [u32 klen][k][u32 vlen][v] rows in
+        one native call (the SST-ingest fast path; ref:
+        RocksEngine::ingest, RocksEngine.cpp:360). Records a barrier on
+        the change ring — consumers rebuild rather than replaying an
+        arbitrarily large load as deltas."""
+        with self._wlock:
+            rc = self._lib.nkv_ingest_sorted(self._h, buf, len(buf), n)
+            self.changes.record(self.write_version, "barrier", None)
+        return Status.OK() if rc == n else \
+            Status.error(ErrorCode.E_INVALID_DATA, f"ingest rc={rc}")
+
     def changes_snapshot(self, since: int):
         # under _wlock: the native version advances inside the C++ call
         # BEFORE the python-side ring record, so an unlocked reader

@@ -106,6 +106,8 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.nkv_remove_prefix.argtypes = [vp, ctypes.c_char_p, i64]
     lib.nkv_multi_put.restype = i32
     lib.nkv_multi_put.argtypes = [vp, ctypes.c_char_p, i64, i32]
+    lib.nkv_ingest_sorted.restype = i64
+    lib.nkv_ingest_sorted.argtypes = [vp, ctypes.c_char_p, i64, i64]
     lib.nkv_multi_remove.restype = i32
     lib.nkv_multi_remove.argtypes = [vp, ctypes.c_char_p, i64, i32]
     lib.nkv_scan_prefix.restype = i64

@@ -163,7 +163,11 @@ def test_native_codec_matches_python_columns(monkeypatch, tmp_path):
         pn, pp = native_cols[name], python_cols[name]
         assert pn.device_ok == pp.device_ok, name
         assert np.array_equal(pn.present, pp.present), name
-        assert [x for x in pn.host] == [x for x in pp.host], name
+        # read through the host_item contract: numeric mirrors are
+        # plain numpy arrays with nulls riding `present`
+        from nebula_tpu.engine_tpu.csr import host_item
+        assert [host_item(pn, i) for i in range(cap)] == \
+            [host_item(pp, i) for i in range(cap)], name
         if pp.device_vals is not None:
             assert np.array_equal(pn.device_vals, pp.device_vals,
                                   equal_nan=True), name
@@ -183,8 +187,9 @@ def test_native_codec_ttl_rows_nulled(monkeypatch):
             (1, RowWriter(schema).set("ts", int(now)).set("x", 2).encode())]
     cols = csr_mod._native_build_columns(schema, 4, rows, now, {}, ("t",))
     assert cols is not None
-    assert cols["x"].host[0] is None      # expired row invisible
-    assert cols["x"].host[1] == 2
+    from nebula_tpu.engine_tpu.csr import host_item
+    assert host_item(cols["x"], 0) is None   # expired row invisible
+    assert host_item(cols["x"], 1) == 2
 
 
 def test_native_codec_invalid_utf8_row_invisible(monkeypatch):
@@ -199,13 +204,16 @@ def test_native_codec_invalid_utf8_row_invisible(monkeypatch):
     now = time.time()
     n_cols = csr_mod._native_build_columns(schema, 4, [(0, good), (1, bad)],
                                            now, {}, ("e",))
-    assert n_cols["x"].host[0] == 1 and n_cols["x"].host[1] is None
-    assert n_cols["s"].host[1] is None
+    from nebula_tpu.engine_tpu.csr import host_item
+    assert host_item(n_cols["x"], 0) == 1
+    assert host_item(n_cols["x"], 1) is None
+    assert host_item(n_cols["s"], 1) is None
     import nebula_tpu.native as native
     monkeypatch.setattr(native, "available", lambda: False)
     p_cols = csr_mod._build_columns(schema, 4, [(0, good), (1, bad)],
                                     now, {}, ("e",))
-    assert p_cols["x"].host[1] is None and p_cols["s"].host[1] is None
+    assert host_item(p_cols["x"], 1) is None
+    assert host_item(p_cols["s"], 1) is None
 
 
 def test_native_codec_non_numeric_ttl_never_expires(monkeypatch):
@@ -220,4 +228,5 @@ def test_native_codec_non_numeric_ttl_never_expires(monkeypatch):
     rows = [(0, RowWriter(schema).set("name", "n").set("x", 7).encode())]
     now = time.time()
     cols = csr_mod._native_build_columns(schema, 2, rows, now, {}, ("t",))
-    assert cols["x"].host[0] == 7   # visible: string ttl is a no-op
+    from nebula_tpu.engine_tpu.csr import host_item
+    assert host_item(cols["x"], 0) == 7   # visible: string ttl is a no-op

@@ -81,6 +81,8 @@ CHECK_QUERIES = [
     "GO FROM 100, 777 OVER like YIELD like._dst",
     "FIND SHORTEST PATH FROM 103 TO 100 OVER like UPTO 8 STEPS",
     "FIND SHORTEST PATH FROM 100 TO 777 OVER like UPTO 4 STEPS",
+    "FIND ALL PATH FROM 100 TO 777 OVER like UPTO 3 STEPS",
+    "FIND NOLOOP PATH FROM 103 TO 777 OVER like UPTO 5 STEPS",
 ]
 
 

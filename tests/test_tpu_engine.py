@@ -98,7 +98,7 @@ def test_snapshot_patches_after_mutation(pair):
     cpu_conn.must("GO FROM 100 OVER like")  # keep cpu side warm/symmetric
 
 
-def test_input_ref_falls_back_to_cpu(pair):
+def test_input_ref_pipe_identity(pair):
     cpu_conn, tpu_conn, tpu = pair
     q = ("GO FROM 100 OVER like YIELD like._dst AS id, like.likeness AS w | "
          "GO FROM $-.id OVER like YIELD $-.w AS base, like.likeness AS w2")
@@ -208,10 +208,148 @@ def test_batched_count_identity(pair):
         [snap.frontier_from_vids(s) for s in seeds]))
     req = jnp.asarray(traverse.pad_edge_types([1]))
     for steps in (1, 2, 3):
+        ak, chunk, group = snap.aligned_kernel()
         batch = np.asarray(traverse.multi_hop_count_batch(
-            f_batch, jnp.int32(steps), snap.aligned_kernel(), req))
+            f_batch, jnp.int32(steps), ak, req, chunk=chunk, group=group))
         for i, s in enumerate(seeds):
             single = int(traverse.multi_hop_count(
                 jnp.asarray(snap.frontier_from_vids(s)), jnp.int32(steps),
                 snap.kernel, req))
             assert int(batch[i]) == single, (steps, s, batch[i], single)
+
+
+UPTO_INPUT_QUERIES = [
+    "GO UPTO 3 STEPS FROM 103 OVER like YIELD like._dst AS id",
+    "GO UPTO 2 STEPS FROM 100 OVER like YIELD like._dst, like.likeness",
+    "GO UPTO 4 STEPS FROM 100 OVER like WHERE like.likeness > 80 "
+    "YIELD like._dst, like.likeness",
+    "GO UPTO 2 STEPS FROM 100, 101 OVER like YIELD DISTINCT like._dst",
+    # $- input back-references through a pipe (per-root device frontiers)
+    "GO FROM 100 OVER like YIELD like._dst AS id, like.likeness AS w | "
+    "GO FROM $-.id OVER like YIELD $-.w AS base, like.likeness AS w2",
+    "GO FROM 100 OVER like YIELD like._dst AS id | "
+    "GO 2 STEPS FROM $-.id OVER like YIELD $-.id AS root, like._dst",
+    "GO FROM 100 OVER like YIELD like._dst AS id, like.likeness AS w | "
+    "GO FROM $-.id OVER like WHERE $-.w > 80 YIELD $-.w, like._dst",
+    # $var back-references
+    "$a = GO FROM 100 OVER like YIELD like._dst AS id, like.likeness AS w; "
+    "GO FROM $a.id OVER like YIELD $a.w AS base, like._dst",
+]
+
+
+@pytest.mark.parametrize("query", UPTO_INPUT_QUERIES)
+def test_upto_and_input_ref_served_on_device(pair, query):
+    """GO UPTO (per-step masks) and $-/$var input-ref GO (per-root
+    frontiers) now run on device with identical results (VERDICT r2
+    item 6; ref GoExecutor upto emission + VertexBackTracker)."""
+    cpu_conn, tpu_conn, tpu = pair
+    r_cpu = cpu_conn.must(query)
+    before = tpu.stats["go_served"]
+    r_tpu = tpu_conn.must(query)
+    assert r_cpu.columns == r_tpu.columns, query
+    assert sorted(map(repr, r_cpu.rows)) == sorted(map(repr, r_tpu.rows)), \
+        (query, r_cpu.rows, r_tpu.rows)
+    assert tpu.stats["go_served"] > before, f"not device-served: {query}"
+
+
+ALL_PATH_QUERIES = [
+    "FIND ALL PATH FROM 100 TO 102 OVER like UPTO 4 STEPS",
+    "FIND ALL PATH FROM 103 TO 100 OVER like UPTO 5 STEPS",
+    "FIND ALL PATH FROM 100, 101 TO 105 OVER like UPTO 4 STEPS",
+    "FIND ALL PATH FROM 100 TO 121 OVER like UPTO 4 STEPS",   # no path
+    "FIND NOLOOP PATH FROM 100 TO 102 OVER like UPTO 4 STEPS",
+    "FIND NOLOOP PATH FROM 103 TO 106 OVER like UPTO 6 STEPS",
+    "FIND ALL PATH FROM 102 TO 104 OVER like, serve UPTO 4 STEPS",
+]
+
+
+@pytest.mark.parametrize("query", ALL_PATH_QUERIES)
+def test_all_path_served_on_device(pair, query):
+    """FIND ALL/NOLOOP PATH now runs its per-hop expansion on device
+    (per-level masks); enumeration shares the CPU loop so results are
+    identical by construction (VERDICT r2 item 8)."""
+    cpu_conn, tpu_conn, tpu = pair
+    r_cpu = cpu_conn.must(query)
+    before = tpu.stats["path_served"]
+    r_tpu = tpu_conn.must(query)
+    assert sorted(map(repr, r_cpu.rows)) == sorted(map(repr, r_tpu.rows)), \
+        (query, r_cpu.rows, r_tpu.rows)
+    assert tpu.stats["path_served"] > before, f"not device-served: {query}"
+
+
+def test_all_path_random_graph_identity():
+    """ALL/NOLOOP/SHORTEST path identity on a denser random graph (the
+    NBA fixture's path space is narrow; this exercises multiplicity)."""
+    import numpy as np
+    rng = np.random.default_rng(11)
+    tpu = TpuGraphEngine()
+    cpu_cluster = InProcCluster()
+    tpu_cluster = InProcCluster(tpu_engine=tpu)
+    conns = []
+    V, E = 60, 300
+    edges = {(int(s), int(d)) for s, d in
+             zip(rng.integers(0, V, E), rng.integers(0, V, E)) if s != d}
+    for cluster in (cpu_cluster, tpu_cluster):
+        c = cluster.connect()
+        c.must("CREATE SPACE rnd(partition_num=3, replica_factor=1)")
+        c.must("USE rnd")
+        c.must("CREATE TAG n(x int)")
+        c.must("CREATE EDGE e(w int)")
+        rows = ", ".join(f"{v}:({v})" for v in range(V))
+        c.must(f"INSERT VERTEX n(x) VALUES {rows}")
+        rows = ", ".join(f"{s} -> {d}:({s + d})" for s, d in sorted(edges))
+        c.must(f"INSERT EDGE e(w) VALUES {rows}")
+        conns.append(c)
+    cpu, tpuc = conns
+    for q in ["FIND ALL PATH FROM 0 TO 7 OVER e UPTO 3 STEPS",
+              "FIND NOLOOP PATH FROM 0 TO 7 OVER e UPTO 4 STEPS",
+              "FIND ALL PATH FROM 1, 2 TO 9, 11 OVER e UPTO 3 STEPS",
+              "FIND SHORTEST PATH FROM 0 TO 13 OVER e UPTO 6 STEPS"]:
+        r_cpu = cpu.must(q)
+        before = tpu.stats["path_served"]
+        r_tpu = tpuc.must(q)
+        assert sorted(map(repr, r_cpu.rows)) == \
+            sorted(map(repr, r_tpu.rows)), q
+        assert tpu.stats["path_served"] > before, q
+
+
+@pytest.fixture(scope="module")
+def pair_dense():
+    """Same as `pair` but with the pull-mode budget zeroed, forcing the
+    DENSE device dispatch — identity coverage for both halves of the
+    direction-optimized engine."""
+    _, cpu_conn = load_nba()
+    tpu = TpuGraphEngine()
+    tpu.sparse_edge_budget = 0
+    cluster = InProcCluster(tpu_engine=tpu)
+    _, tpu_conn = load_nba(cluster)
+    return cpu_conn, tpu_conn, tpu
+
+
+@pytest.mark.parametrize("query", EQUALITY_QUERIES)
+def test_dense_path_identical_results(pair_dense, query):
+    cpu_conn, tpu_conn, tpu = pair_dense
+    r_cpu = cpu_conn.must(query)
+    r_tpu = tpu_conn.must(query)
+    assert r_cpu.columns == r_tpu.columns
+    assert sorted(map(repr, r_cpu.rows)) == sorted(map(repr, r_tpu.rows)), \
+        f"dense-path divergence for: {query}"
+
+
+def test_dense_mode_really_dense(pair_dense):
+    """With the pull budget zeroed, a non-empty GO must take the dense
+    device dispatch (a zero-edge frontier may still 'serve' sparsely —
+    visiting nothing is under any budget)."""
+    _, tpu_conn, tpu = pair_dense
+    before = tpu.stats["sparse_served"]
+    tpu_conn.must("GO 2 STEPS FROM 100 OVER like YIELD like._dst")
+    assert tpu.stats["sparse_served"] == before
+
+
+def test_sparse_path_actually_served(pair):
+    """At NBA scale every plain GO fits the pull budget — assert the
+    sparse half really is what served."""
+    cpu_conn, tpu_conn, tpu = pair
+    before = tpu.stats["sparse_served"]
+    tpu_conn.must("GO 2 STEPS FROM 100 OVER like YIELD like._dst")
+    assert tpu.stats["sparse_served"] == before + 1
