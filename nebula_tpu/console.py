@@ -47,9 +47,10 @@ def _fmt(v: Any) -> str:
 
 
 class Console:
-    def __init__(self, connection, out=None):
+    def __init__(self, connection, out=None, show_profile=False):
         self.conn = connection
         self.out = out or sys.stdout
+        self.show_profile = show_profile
 
     def run_statement(self, text: str) -> bool:
         """Execute one (possibly ';'-chained) statement; print results.
@@ -59,6 +60,13 @@ class Console:
             return True
         if text.lower() in ("exit", "quit", "exit;", "quit;"):
             return False
+        if text.lower().rstrip(";") == ":profile":
+            # console-local toggle: show the device path's per-stage
+            # breakdown after each query (snapshot/kernel/materialize)
+            self.show_profile = not self.show_profile
+            print(f"profile display "
+                  f"{'on' if self.show_profile else 'off'}", file=self.out)
+            return True
         t0 = time.monotonic()
         resp = self.conn.execute(text)
         wall_ms = (time.monotonic() - t0) * 1e3
@@ -74,6 +82,12 @@ class Console:
         else:
             print(f"Execution succeeded (server {resp.latency_us} us, "
                   f"wall {wall_ms:.2f} ms)", file=self.out)
+        prof = getattr(resp, "profile", None)
+        if self.show_profile and prof:
+            print(f"[tpu {prof['mode']}] snapshot {prof['snapshot_us']} us"
+                  f" | kernel {prof['kernel_us']} us"
+                  f" | materialize {prof['materialize_us']} us"
+                  f" | delta edges {prof['delta_edges']}", file=self.out)
         return True
 
     def run_file(self, path: str) -> None:

@@ -80,6 +80,26 @@ def serve_graphd(meta_addr: str, host: str = "127.0.0.1", port: int = 0,
     if ws_port is not None:
         web = WebService("graphd", flags=graph_flags, stats=stats,
                          host=host, port=ws_port)
+        if tpu_engine is not None:
+            def trace(params, body):
+                # /trace?op=start&dir=/tmp/xprof | /trace?op=stop —
+                # opt-in jax.profiler capture of the device path
+                op = params.get("op")
+                if op == "start":
+                    d = params.get("dir")
+                    if not d:
+                        return 400, {"error": "dir param required"}
+                    if not tpu_engine.start_trace(d):
+                        return 409, {"error": "a trace is already "
+                                              "running; stop it first"}
+                    return 200, {"result": "tracing", "dir": d}
+                if op == "stop":
+                    if not tpu_engine.stop_trace():
+                        return 409, {"error": "no trace running"}
+                    return 200, {"result": "stopped"}
+                return 400, {"error": f"unknown op {op!r}"}
+
+            web.register("/trace", trace)
         web.start()
     return GraphdHandle(service, engine, mc, server, web)
 

@@ -85,6 +85,49 @@ class TpuGraphEngine:
                       "fast_materialize": 0, "slow_materialize": 0,
                       "delta_applies": 0, "delta_edges": 0,
                       "bg_repacks": 0, "sparse_served": 0}
+        # per-query stage breakdown of the LAST device-served query
+        # (snapshot check / kernel / materialize — ref role: per-stage
+        # latency in responses, ExecutionPlan.cpp:57) + a serial so the
+        # query layer knows whether a given query was the one served
+        self.last_profile: Optional[Dict[str, Any]] = None
+        self.profile_seq = 0
+        self._tracing = False
+
+    # ------------------------------------------------------------------
+    # observability
+    # ------------------------------------------------------------------
+    def _record_profile(self, mode: str, t_snap: float, t_kernel: float,
+                        t_mat: float, snap=None) -> None:
+        self.last_profile = {
+            "mode": mode,
+            "snapshot_us": int(t_snap * 1e6),
+            "kernel_us": int(t_kernel * 1e6),
+            "materialize_us": int(t_mat * 1e6),
+            "delta_edges": (snap.delta.edge_count
+                            if snap is not None and snap.delta else 0),
+        }
+        self.profile_seq += 1
+
+    def start_trace(self, trace_dir: str) -> bool:
+        """Opt-in XLA/JAX profiler trace of the device path; view with
+        TensorBoard or xprof. One trace at a time — returns False (and
+        keeps the active trace) when one is already running."""
+        import jax
+        with self._lock:
+            if self._tracing:
+                return False
+            jax.profiler.start_trace(trace_dir)
+            self._tracing = True
+            return True
+
+    def stop_trace(self) -> bool:
+        import jax
+        with self._lock:
+            if not self._tracing:
+                return False
+            jax.profiler.stop_trace()
+            self._tracing = False
+            return True
 
     # ------------------------------------------------------------------
     def attach(self, cluster) -> None:
@@ -292,7 +335,9 @@ class TpuGraphEngine:
 
     def _execute_go_locked(self, ctx, s, starts, edge_types, alias_map,
                            name_by_type, ex):
+        t0 = time.monotonic()
         snap = self._snapshot_locked(ctx.space_id())
+        t_snap = time.monotonic() - t0
         if snap is None:
             self.stats["fallbacks"] += 1
             return None
@@ -323,27 +368,30 @@ class TpuGraphEngine:
         if needs_input:
             return self._go_roots(ctx, s, starts, req, snap, use_delta,
                                   yield_cols, columns, alias_map,
-                                  name_by_type, ex)
+                                  name_by_type, ex, t_snap)
         if upto:
             return self._go_upto(ctx, s, f0, req, edge_types, snap,
                                  use_delta, yield_cols, columns, alias_map,
-                                 name_by_type, ex)
+                                 name_by_type, ex, t_snap)
         # direction-optimized execution: a frontier that stays small is
         # served by a host-mirror pull over the snapshot (O(frontier
         # edges)) instead of the dense device dispatch (O(E) per hop) —
         # at SNB scale a selective 3-hop GO touches ~10^4 edges while
         # the dense path reads all 10^8 slots every hop
         if getattr(snap, "sharded_kernel", None) is None:
+            t1 = time.monotonic()
             sparse = self._sparse_expand(snap, starts, edge_types,
                                          int(s.step.steps))
+            t_kernel = time.monotonic() - t1
             if sparse is not None:
                 return self._emit_sparse(ctx, s, snap, sparse, yield_cols,
                                          columns, alias_map, name_by_type,
-                                         ex)
+                                         ex, t_snap, t_kernel)
         device_mask, local_filter = self._plan_filter(
             ctx, s, snap, use_delta, name_by_type, alias_map, edge_types)
 
         d_active = None
+        t1 = time.monotonic()
         if getattr(snap, "sharded_kernel", None) is not None:
             from . import distributed
             _, active = distributed.multi_hop_sharded(
@@ -359,6 +407,8 @@ class TpuGraphEngine:
         if device_mask is not None:
             active = active & device_mask
         mask = np.asarray(active)
+        t_kernel = time.monotonic() - t1
+        t2 = time.monotonic()
 
         rows: Optional[List[Tuple]] = None
         if local_filter is None:
@@ -396,6 +446,8 @@ class TpuGraphEngine:
         if s.yield_ and s.yield_.distinct:
             result = result.distinct()
         self.stats["go_served"] += 1
+        self._record_profile("dense", t_snap, t_kernel,
+                             time.monotonic() - t2, snap)
         return StatusOr.of(result)
 
     def _materialize_delta(self, snap: CsrSnapshot, d_mask: np.ndarray,
@@ -565,8 +617,9 @@ class TpuGraphEngine:
         return {}, []
 
     def _emit_sparse(self, ctx, s, snap, sparse, yield_cols, columns,
-                     alias_map, name_by_type, ex):
+                     alias_map, name_by_type, ex, t_snap=0.0, t_kernel=0.0):
         from . import materialize
+        t2 = time.monotonic()
         act_idx, d_act = sparse
         # filters evaluate on the host: row counts here are small by
         # construction (the sparse path only runs under the edge budget)
@@ -608,6 +661,8 @@ class TpuGraphEngine:
             result = result.distinct()
         self.stats["go_served"] += 1
         self.stats["sparse_served"] += 1
+        self._record_profile("sparse", t_snap, t_kernel,
+                             time.monotonic() - t2, snap)
         return StatusOr.of(result)
 
     # ------------------------------------------------------------------
@@ -685,8 +740,10 @@ class TpuGraphEngine:
     # emission in the CPU loop / GoExecutor union semantics)
     # ------------------------------------------------------------------
     def _go_upto(self, ctx, s, f0, req, edge_types, snap, use_delta,
-                 yield_cols, columns, alias_map, name_by_type, ex):
+                 yield_cols, columns, alias_map, name_by_type, ex,
+                 t_snap=0.0):
         from . import materialize
+        t1 = time.monotonic()
         steps = int(s.step.steps)
         device_mask, local_filter = self._plan_filter(
             ctx, s, snap, use_delta, name_by_type, alias_map, edge_types)
@@ -698,6 +755,8 @@ class TpuGraphEngine:
                                              steps=steps)
             dmasks = None
         dm_np = None if device_mask is None else np.asarray(device_mask)
+        t_kernel = time.monotonic() - t1
+        t2 = time.monotonic()
         rows: List[Tuple] = []
         needs_dst = _needs_dst(yield_cols, s)
         for si in range(steps):
@@ -737,6 +796,8 @@ class TpuGraphEngine:
         if s.yield_ and s.yield_.distinct:
             result = result.distinct()
         self.stats["go_served"] += 1
+        self._record_profile("upto", t_snap, t_kernel,
+                             time.monotonic() - t2, snap)
         return StatusOr.of(result)
 
     # ------------------------------------------------------------------
@@ -745,8 +806,9 @@ class TpuGraphEngine:
     # VertexBackTracker, ref GoExecutor.cpp:1067-1075)
     # ------------------------------------------------------------------
     def _go_roots(self, ctx, s, starts, req, snap, use_delta, yield_cols,
-                  columns, alias_map, name_by_type, ex):
+                  columns, alias_map, name_by_type, ex, t_snap=0.0):
         import jax.numpy as jnp
+        t1 = time.monotonic()
         roots = sorted(set(starts))
         # [R, P, cap_e] masks materialize on device AND host: bound the
         # root count by a ~1GB mask budget, not just the fixed cap
@@ -767,6 +829,8 @@ class TpuGraphEngine:
             dmasks = None
         masks = np.asarray(masks)
         dmasks = None if dmasks is None else np.asarray(dmasks)
+        t_kernel = time.monotonic() - t1
+        t2 = time.monotonic()
         input_index = ex.build_input_index(ctx, s)
         input_var = s.from_.ref.var \
             if isinstance(s.from_.ref, VariablePropExpr) else None
@@ -793,6 +857,8 @@ class TpuGraphEngine:
         if s.yield_ and s.yield_.distinct:
             result = result.distinct()
         self.stats["go_served"] += 1
+        self._record_profile("roots", t_snap, t_kernel,
+                             time.monotonic() - t2, snap)
         return StatusOr.of(result)
 
     # ------------------------------------------------------------------
@@ -812,7 +878,9 @@ class TpuGraphEngine:
 
     def _execute_find_path_locked(self, ctx, s, sources, targets,
                                   edge_types, name_by_type, ex):
+        t0 = time.monotonic()
         snap = self._snapshot_locked(ctx.space_id())
+        t_snap = time.monotonic() - t0
         if snap is None or not sources or not targets:
             if snap is None:
                 return None
@@ -832,6 +900,7 @@ class TpuGraphEngine:
         # halved-depth bidirectional sweep (ref: FindPathExecutor :155)
         steps_f = (upto + 1) // 2
         steps_b = upto - steps_f
+        t1 = time.monotonic()
         if getattr(snap, "sharded_kernel", None) is not None:
             from . import distributed
             dist_f = np.asarray(distributed.bfs_dist_sharded(
@@ -852,9 +921,12 @@ class TpuGraphEngine:
                 jnp.asarray(f_src), steps_f, snap.kernel, req_f))
             dist_b = np.asarray(traverse.bfs_dist(
                 jnp.asarray(f_dst), max(steps_b, 0), snap.kernel, req_b))
+        t2 = time.monotonic()
         paths = _reconstruct_shortest(snap, dist_f, dist_b, sources, targets,
                                       edge_types, upto, name_by_type)
         self.stats["path_served"] += 1
+        self._record_profile("path", t_snap, t2 - t1,
+                             time.monotonic() - t2, snap)
         return StatusOr.of(ex.InterimResult(["_path_"], [(p,) for p in paths]))
 
 

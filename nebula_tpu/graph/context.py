@@ -24,6 +24,9 @@ class ExecutionResponse:
     latency_us: int = 0
     space_name: str = ""
     warning: str = ""
+    # device-path stage breakdown when the TPU engine served this query
+    # (ref role: per-stage latency in ExecutionPlan.cpp:57 responses)
+    profile: Optional[Dict[str, Any]] = None
 
     def ok(self) -> bool:
         return self.code == ErrorCode.SUCCEEDED

@@ -84,6 +84,8 @@ class ExecutionEngine:
             return resp
         ctx = ExecContext(self, session)
         result: Optional[InterimResult] = None
+        tpu = self.tpu_engine
+        profile_seq0 = tpu.profile_seq if tpu is not None else 0
         for sentence in seq.sentences:
             r = self._run(ctx, sentence)
             if not r.ok():
@@ -97,6 +99,11 @@ class ExecutionEngine:
             resp.columns = result.columns
             resp.rows = result.rows
         resp.space_name = session.space_name or ""
+        if tpu is not None and tpu.profile_seq != profile_seq0:
+            # device-served: attach the engine's per-stage breakdown
+            # (under concurrent sessions the latest served wins — the
+            # breakdown is diagnostics, not an accounting ledger)
+            resp.profile = tpu.last_profile
         resp.latency_us = int((time.monotonic() - t0) * 1e6)
         return resp
 
