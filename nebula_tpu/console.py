@@ -46,6 +46,62 @@ def _fmt(v: Any) -> str:
     return str(v)
 
 
+# the parser's keyword table is the single source of truth for verbs
+from .parser.lexer import KEYWORDS as _LEXER_KEYWORDS
+
+NGQL_KEYWORDS = sorted(k.upper() for k in _LEXER_KEYWORDS)
+
+
+class ConsoleCompleter:
+    """readline completer: nGQL verbs/clauses plus live space/tag/edge
+    names pulled from the connected catalog (ref role: the console
+    autocomplete machinery in console/CliManager.h:14-40)."""
+
+    def __init__(self, conn, ttl: float = 5.0):
+        self._conn = conn
+        self._ttl = ttl
+        self._cached_at = 0.0
+        self._names: List[str] = []
+        self._matches: List[str] = []
+
+    def schema_names(self) -> List[str]:
+        now = time.monotonic()
+        if now - self._cached_at < self._ttl:
+            return self._names
+        names: List[str] = []
+        for stmt in ("SHOW SPACES", "SHOW TAGS", "SHOW EDGES"):
+            try:
+                r = self._conn.execute(stmt)
+            except Exception:
+                continue
+            if r.ok() and r.rows:
+                # name is the last column (SPACES: [Name]; TAGS/EDGES:
+                # [ID, Name])
+                names.extend(str(row[-1]) for row in r.rows)
+        self._cached_at = now
+        self._names = names
+        return names
+
+    def complete(self, text: str, state: int):
+        if state == 0:
+            up = text.upper()
+            self._matches = [k + " " for k in NGQL_KEYWORDS
+                             if k.startswith(up)]
+            self._matches += [n for n in self.schema_names()
+                              if n.startswith(text)]
+        return self._matches[state] if state < len(self._matches) else None
+
+    def install(self) -> bool:
+        try:
+            import readline
+        except ImportError:
+            return False
+        readline.set_completer(self.complete)
+        readline.set_completer_delims(" \t\n,;()=<>!|")
+        readline.parse_and_bind("tab: complete")
+        return True
+
+
 class Console:
     def __init__(self, connection, out=None, show_profile=False):
         self.conn = connection
@@ -107,10 +163,9 @@ class Console:
     def repl(self, in_stream=None) -> None:
         prompt = "(nebula-tpu) > "
         if in_stream is None and sys.stdin.isatty():
-            try:
-                import readline  # noqa: F401  (history + line editing)
-            except ImportError:
-                pass
+            # history + line editing + tab completion over verbs and
+            # live schema names
+            ConsoleCompleter(self.conn).install()
             while True:
                 try:
                     line = input(prompt)

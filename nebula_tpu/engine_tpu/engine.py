@@ -125,8 +125,8 @@ class TpuGraphEngine:
         with self._lock:
             if not self._tracing:
                 return False
+            self._tracing = False   # never wedge: cleared even on error
             jax.profiler.stop_trace()
-            self._tracing = False
             return True
 
     # ------------------------------------------------------------------
@@ -743,10 +743,10 @@ class TpuGraphEngine:
                  yield_cols, columns, alias_map, name_by_type, ex,
                  t_snap=0.0):
         from . import materialize
-        t1 = time.monotonic()
         steps = int(s.step.steps)
         device_mask, local_filter = self._plan_filter(
             ctx, s, snap, use_delta, name_by_type, alias_map, edge_types)
+        t1 = time.monotonic()   # kernel time = device dispatch only
         if use_delta:
             masks, dmasks = traverse.multi_hop_steps_delta(
                 f0, snap.kernel, snap.delta.device(), req, steps=steps)
@@ -808,7 +808,6 @@ class TpuGraphEngine:
     def _go_roots(self, ctx, s, starts, req, snap, use_delta, yield_cols,
                   columns, alias_map, name_by_type, ex, t_snap=0.0):
         import jax.numpy as jnp
-        t1 = time.monotonic()
         roots = sorted(set(starts))
         # [R, P, cap_e] masks materialize on device AND host: bound the
         # root count by a ~1GB mask budget, not just the fixed cap
@@ -820,6 +819,7 @@ class TpuGraphEngine:
         local_filter = s.where.filter if s.where is not None else None
         f0s = jnp.asarray(np.stack(
             [snap.frontier_from_vids([r]) for r in roots]))
+        t1 = time.monotonic()   # kernel time = device dispatch only
         if use_delta:
             masks, dmasks = traverse.multi_hop_roots_delta(
                 f0s, s.step.steps, snap.kernel, snap.delta.device(), req)

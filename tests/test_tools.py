@@ -145,3 +145,28 @@ def test_tool_clis_parse(capsys):
             tool.main(["--help"])
         assert e.value.code == 0
         capsys.readouterr()
+
+
+def test_console_completer_keywords_and_schema_names():
+    """Tab completion offers nGQL verbs plus live space/tag/edge names
+    from the catalog (VERDICT r2 item 10; ref console/CliManager.h)."""
+    from nba_fixture import load_nba
+    from nebula_tpu.console import ConsoleCompleter
+
+    _, conn = load_nba(space="comp")
+    comp = ConsoleCompleter(conn)
+
+    def all_matches(text):
+        out, i = [], 0
+        while True:
+            m = comp.complete(text, i)
+            if m is None:
+                return out
+            out.append(m)
+            i += 1
+
+    assert "GO " in all_matches("g") or "GO " in all_matches("G")
+    assert any(m.startswith("FIND") for m in all_matches("FI"))
+    assert "player" in all_matches("pla")       # tag name from catalog
+    assert "like" in all_matches("li")          # edge name
+    assert "comp" in all_matches("com")         # space name
