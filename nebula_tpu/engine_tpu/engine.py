@@ -47,6 +47,10 @@ from .filter_compile import FilterCompiler
 DEFAULT_MAX_EDGES_PER_VERTEX = 10000
 
 
+class _BudgetExceeded(Exception):
+    """Pull-mode edge budget ran out: fall to the dense device path."""
+
+
 def _uses_input_refs(exprs: List[Expression]) -> bool:
     for e in exprs:
         for node in e.walk():
@@ -666,6 +670,75 @@ class TpuGraphEngine:
         return StatusOr.of(result)
 
     # ------------------------------------------------------------------
+    # pull-mode adjacency for path queries (direction optimization)
+    # ------------------------------------------------------------------
+    def _mirror_adj(self, snap, frontier, edge_types, state):
+        """{dst: [(src, etype, rank)]} for one expansion over the
+        snapshot's host mirrors — the _expand contract without the
+        storage RPC. The frontier walk is VECTORIZED (the budget check
+        runs on raw segment sizes before any per-edge python), so a
+        budget-exceeding frontier bails in numpy time instead of
+        crawling millions of edges scalar-wise under the engine lock.
+        Raises _BudgetExceeded past the pull budget (caller falls to
+        the dense device path)."""
+        req = list(set(edge_types))
+        delta = snap.delta if (snap.delta is not None
+                               and snap.delta.edge_count > 0) else None
+        out: Dict[int, list] = {}
+        by_part: Dict[int, list] = {}
+        delta_locs = []
+        for vid in frontier:
+            loc = snap.locate(vid)
+            if loc is None:
+                continue
+            by_part.setdefault(loc[0], []).append((loc[1], vid))
+            if delta is not None:
+                delta_locs.append((loc[0], loc[1], vid))
+        for p, pairs in by_part.items():
+            shard = snap.shards[p]
+            base = [(l, v) for l, v in pairs if l < shard.num_vids_base]
+            if not base:
+                continue
+            locals_ = np.asarray([l for l, _ in base], np.int64)
+            vids_ = np.asarray([v for _, v in base], np.int64)
+            indptr = _shard_indptr(shard)
+            lo, hi = indptr[locals_], indptr[locals_ + 1]
+            counts = (hi - lo).astype(np.int64)
+            total = int(counts.sum())
+            state["visited"] += total
+            if state["visited"] > self.sparse_edge_budget:
+                raise _BudgetExceeded()
+            if total == 0:
+                continue
+            idx = (np.repeat(lo - np.pad(np.cumsum(counts), (1, 0))[:-1],
+                             counts) + np.arange(total))
+            src_per_edge = np.repeat(vids_, counts)
+            ok = shard.edge_valid[idx] & np.isin(shard.edge_etype[idx], req)
+            idx, src_per_edge = idx[ok], src_per_edge[ok]
+            ets = shard.edge_etype[idx]
+            ranks = shard.edge_rank[idx]
+            dsts = shard.edge_dst_vid[idx]
+            for j in range(len(idx)):     # survivors only
+                out.setdefault(int(dsts[j]), []).append(
+                    (int(src_per_edge[j]), int(ets[j]), int(ranks[j])))
+        if delta is not None:
+            req_set = set(req)
+            for p, local, vid in delta_locs:
+                gs = p * snap.cap_v + local
+                for slot in delta.by_src.get(gs, ()):
+                    info = delta.info.get(slot)
+                    if info is None or not delta.h_ok[slot]:
+                        continue
+                    _, et, rank, dst_vid, _props = info
+                    if et not in req_set:
+                        continue
+                    state["visited"] += 1
+                    if state["visited"] > self.sparse_edge_budget:
+                        raise _BudgetExceeded()
+                    out.setdefault(dst_vid, []).append((vid, et, rank))
+        return out
+
+    # ------------------------------------------------------------------
     # FIND ALL/NOLOOP PATH: per-level device adjacency, host enumeration
     # (ref FindPathExecutor.cpp:218-290 — the join stays on CPU, the
     # per-hop storage expansion moves on-chip)
@@ -888,6 +961,28 @@ class TpuGraphEngine:
         if not s.shortest:
             return self._find_all_paths(ctx, s, sources, targets,
                                         edge_types, name_by_type, snap, ex)
+        # direction optimization: a short path on a big graph touches a
+        # handful of edges — run the CPU bidirectional join over the
+        # snapshot mirrors under the pull budget before paying the
+        # dense O(E)-per-hop device BFS
+        if getattr(snap, "sharded_kernel", None) is None:
+            state = {"visited": 0}
+            t1 = time.monotonic()
+            try:
+                paths = ex._shortest_paths(
+                    ctx, ctx.space_id(), sources, targets, edge_types,
+                    int(s.step.steps), name_by_type,
+                    expand_fn=lambda f, t: self._mirror_adj(snap, f, t,
+                                                            state))
+            except _BudgetExceeded:
+                pass
+            else:
+                self.stats["path_served"] += 1
+                self.stats["sparse_served"] += 1
+                self._record_profile("path-sparse", t_snap,
+                                     time.monotonic() - t1, 0.0, snap)
+                return StatusOr.of(ex.InterimResult(
+                    ["_path_"], [(p,) for p in paths]))
         import jax.numpy as jnp
         f_src = snap.frontier_from_vids(sources)
         f_dst = snap.frontier_from_vids(targets)

@@ -460,9 +460,16 @@ def _format_path(vids: List[int], steps: List[Tuple[int, int]],
 
 def _shortest_paths(ctx: ExecContext, space: int, sources: List[int],
                     targets: List[int], edge_types: List[int], upto: int,
-                    name_by_type: Dict[int, str]) -> List[str]:
+                    name_by_type: Dict[int, str], expand_fn=None) -> List[str]:
     """Bidirectional BFS, halved depth per side (ref: FindPathExecutor
-    :155 `steps = ceil(k/2)`, odd/even meets :233-279)."""
+    :155 `steps = ceil(k/2)`, odd/even meets :233-279).
+
+    expand_fn(frontier, types) -> {dst: [(src, etype, rank)]}: optional
+    adjacency source — the TPU engine's pull mode passes a snapshot-
+    mirror expansion so small path queries skip both the storage RPC
+    fan-out AND the dense device sweep."""
+    if expand_fn is None:
+        expand_fn = lambda f, t: _expand(ctx, space, f, t)  # noqa: E731
     if not sources or not targets:
         return []
     # paths_f[v] = list of (vids, steps) shortest prefixes from a source
@@ -482,7 +489,7 @@ def _shortest_paths(ctx: ExecContext, space: int, sources: List[int],
     for depth in range(upto):
         expand_from_f = len(frontier_f) <= len(frontier_t)
         if expand_from_f:
-            adj = _expand(ctx, space, frontier_f, edge_types)
+            adj = expand_fn(frontier_f, edge_types)
             nxt: Dict[int, List[Tuple[tuple, tuple]]] = {}
             for dst, incomings in adj.items():
                 if dst in visited_f:
@@ -498,7 +505,7 @@ def _shortest_paths(ctx: ExecContext, space: int, sources: List[int],
             visited_f |= set(nxt)
             frontier_f = list(nxt)
         else:
-            adj = _expand(ctx, space, frontier_t, rev_types)
+            adj = expand_fn(frontier_t, rev_types)
             nxt = {}
             for dst, incomings in adj.items():
                 if dst in visited_t:
