@@ -387,12 +387,11 @@ def _ensure_backend():
     import jax
     jax.config.update("jax_platforms", "cpu")
     # shrink each knob individually unless the user pinned it
-    global V, E, BATCH, ITERS, PY_E, LAT_N
     for var, small in (("BENCH_V", 50_000), ("BENCH_E", 500_000),
                        ("BENCH_BATCH", 32), ("BENCH_ITERS", 3),
                        ("BENCH_PY_E", 200_000), ("BENCH_LAT_N", 5)):
         if var not in os.environ:
-            globals()[var[6:] if var != "BENCH_PY_E" else "PY_E"] = small
+            globals()[var[len("BENCH_"):]] = small
     label = "cpu-fallback(accelerator unreachable)" if not plat else "cpu"
     log(f"WARNING: running on {label} at V={V} E={E} — accelerator "
         f"numbers are NOT represented by this run")

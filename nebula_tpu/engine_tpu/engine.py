@@ -546,6 +546,27 @@ class TpuGraphEngine:
     # sparse (pull-mode) GO: host-mirror frontier advance for small
     # frontiers — the direction-optimized half of the engine
     # ------------------------------------------------------------------
+    @staticmethod
+    def _part_frontier_edges(shard, locals_, req):
+        """Vectorized expansion of one part's frontier locals over the
+        base CSR: -> (idx int64[], per_edge_row int64[] positions into
+        `locals_`, raw_count) with validity+etype filtering applied.
+        raw_count is the UNFILTERED segment total — budget accounting
+        must see it before any per-edge work. Shared by the pull-mode
+        GO walk and the pull-mode path expansion."""
+        indptr = _shard_indptr(shard)
+        lo, hi = indptr[locals_], indptr[locals_ + 1]
+        counts = (hi - lo).astype(np.int64)
+        total = int(counts.sum())
+        if total == 0:
+            return (np.empty(0, np.int64), np.empty(0, np.int64), 0)
+        idx = (np.repeat(lo - np.pad(np.cumsum(counts), (1, 0))[:-1],
+                         counts) + np.arange(total))
+        rows = np.repeat(np.arange(len(locals_), dtype=np.int64), counts)
+        ok = shard.edge_valid[idx] & np.isin(shard.edge_etype[idx],
+                                             list(req))
+        return idx[ok], rows[ok], total
+
     def _sparse_expand(self, snap, starts, edge_types, steps):
         """Advance the frontier over the snapshot's host mirrors,
         visiting only the frontier's own edges. Returns (final active
@@ -573,28 +594,19 @@ class TpuGraphEngine:
                 shard = snap.shards[p]
                 base = locals_[locals_ < shard.num_vids_base]
                 if base.size:
-                    indptr = _shard_indptr(shard)
-                    lo, hi = indptr[base], indptr[base + 1]
-                    counts = (hi - lo).astype(np.int64)
-                    total = int(counts.sum())
-                    visited += total
+                    idx, _, raw = self._part_frontier_edges(shard, base,
+                                                            req)
+                    visited += raw
                     if visited > budget:
                         return None
-                    if total:
-                        offs = np.repeat(lo - np.pad(np.cumsum(counts),
-                                                     (1, 0))[:-1], counts)
-                        idx = offs + np.arange(total)
-                        ok = shard.edge_valid[idx] & np.isin(
-                            shard.edge_etype[idx], list(req))
-                        idx = idx[ok]
-                        if idx.size:
-                            act_idx[p] = idx
-                            if not final:
-                                dp = shard.edge_dst_part[idx]
-                                dl = shard.edge_dst_local[idx]
-                                for q in np.unique(dp):
-                                    nxt.setdefault(int(q), []).append(
-                                        dl[dp == q].astype(np.int64))
+                    if idx.size:
+                        act_idx[p] = idx
+                        if not final:
+                            dp = shard.edge_dst_part[idx]
+                            dl = shard.edge_dst_local[idx]
+                            for q in np.unique(dp):
+                                nxt.setdefault(int(q), []).append(
+                                    dl[dp == q].astype(np.int64))
                 if delta is not None:
                     for l in locals_:
                         gs = p * snap.cap_v + int(l)
@@ -701,20 +713,11 @@ class TpuGraphEngine:
                 continue
             locals_ = np.asarray([l for l, _ in base], np.int64)
             vids_ = np.asarray([v for _, v in base], np.int64)
-            indptr = _shard_indptr(shard)
-            lo, hi = indptr[locals_], indptr[locals_ + 1]
-            counts = (hi - lo).astype(np.int64)
-            total = int(counts.sum())
-            state["visited"] += total
+            idx, rows, raw = self._part_frontier_edges(shard, locals_, req)
+            state["visited"] += raw
             if state["visited"] > self.sparse_edge_budget:
                 raise _BudgetExceeded()
-            if total == 0:
-                continue
-            idx = (np.repeat(lo - np.pad(np.cumsum(counts), (1, 0))[:-1],
-                             counts) + np.arange(total))
-            src_per_edge = np.repeat(vids_, counts)
-            ok = shard.edge_valid[idx] & np.isin(shard.edge_etype[idx], req)
-            idx, src_per_edge = idx[ok], src_per_edge[ok]
+            src_per_edge = vids_[rows]
             ets = shard.edge_etype[idx]
             ranks = shard.edge_rank[idx]
             dsts = shard.edge_dst_vid[idx]

@@ -367,6 +367,13 @@ def test_profile_breakdown_in_response(pair):
     # CPU-only statements carry no device profile
     r2 = cpu_conn.must("GO FROM 100 OVER like")
     assert r2.profile is None
+    # UPTO and path modes report too
+    r3 = tpu_conn.must("GO UPTO 2 STEPS FROM 100 OVER like YIELD like._dst")
+    assert r3.profile is not None and r3.profile["mode"] in ("upto",
+                                                             "sparse")
+    r4 = tpu_conn.must(
+        "FIND SHORTEST PATH FROM 100 TO 102 OVER like UPTO 4 STEPS")
+    assert r4.profile is not None and r4.profile["mode"].startswith("path")
 
 
 def test_console_profile_toggle(pair):
