@@ -54,14 +54,31 @@ class PropColumn:
     materializing 10^8 python objects at build time is prohibitive).
     Read single cells through `host_item`, slices through
     `host_gather`: both normalize nulls to None and numpy scalars to
-    python values so result rows stay identical to the CPU path."""
+    python values so result rows stay identical to the CPU path.
+
+    Cells are three-state, mirroring the CPU walk's distinction
+    (processors.py _StorageExprContext):
+      present[i]                 -> usable value (host/device_vals[i])
+      ~present[i] & ~missing[i]  -> explicit NULL (the row has the
+                                    field, null bit set) — CPU
+                                    RelationalExpr null rules apply
+      missing[i]                 -> the row's schema version doesn't
+                                    have the field, or no row decoded
+                                    at this slot (vertex without the
+                                    tag): evaluating it raises
+                                    EvalError on the CPU path (drops
+                                    the row in WHERE, fails the query
+                                    in YIELD)
+    `missing is None` is the common fast case: every slot that callers
+    can select decoded a row carrying the field — ~present means NULL."""
     name: str
     ptype: PropType
     host: np.ndarray
     device_ok: bool                       # can this column go on device?
     device_vals: Optional[np.ndarray]     # f32/i32/bool codes, aligned
-    present: Optional[np.ndarray] = None  # bool, False where value is null
+    present: Optional[np.ndarray] = None  # bool, True where value usable
     str_dict: Optional[Dict[str, int]] = None  # string -> code
+    missing: Optional[np.ndarray] = None  # bool, see above
 
 
 def host_item(col: PropColumn, idx: int):
@@ -509,8 +526,10 @@ def build_shards(source, sm, space_id: int, num_parts: int
                     continue
                 sel = np.nonzero(et == t)[0]
                 rows = RowsBlock.from_scan(escan, eidx[sel], sel)
-                cols = _build_columns(schema, cap_e, rows, now,
-                                      dict_registry, ("e",))
+                cols = _build_columns(
+                    schema, cap_e, rows, now, dict_registry, ("e",),
+                    schema_at=lambda v, _t=int(t): _ver_schema(
+                        sm.edge_schema, space_id, _t, v))
                 if cols:
                     shard.edge_props[int(t)] = cols
         varr, vidx, vscan = vert_scans[p0]
@@ -524,8 +543,10 @@ def build_shards(source, sm, space_id: int, num_parts: int
                     continue
                 sel = np.nonzero(tags == t)[0]
                 rows = RowsBlock.from_scan(vscan, vidx[sel], vlocal[sel])
-                cols = _build_columns(sr.value(), cap_v, rows, now,
-                                      dict_registry, ("t",))
+                cols = _build_columns(
+                    sr.value(), cap_v, rows, now, dict_registry, ("t",),
+                    schema_at=lambda v, _t=int(t): _ver_schema(
+                        sm.tag_schema, space_id, _t, v))
                 if cols:
                     shard.tag_props[int(t)] = cols
     return shards, cap_v, cap_e, dict_registry
@@ -574,8 +595,10 @@ def _build_shards_native(ext, sm, space_id: int, P: int
                         continue
                     sel = np.nonzero(et == t)[0]
                     rows = RowsBlock(blob, offs[sel], lens[sel], sel)
-                    cols = _build_columns(r.value(), cap_e, rows, now,
-                                          dict_registry, ("e",))
+                    cols = _build_columns(
+                        r.value(), cap_e, rows, now, dict_registry, ("e",),
+                        schema_at=lambda v, _t=int(t): _ver_schema(
+                            sm.edge_schema, space_id, _t, v))
                     if cols:
                         shard.edge_props[int(t)] = cols
         vlocal, vtag = ext.vert_rows(p0)
@@ -590,14 +613,24 @@ def _build_shards_native(ext, sm, space_id: int, P: int
                     sel = np.nonzero(vtag == t)[0]
                     rows = RowsBlock(blob, offs[sel], lens[sel],
                                      vlocal[sel])
-                    cols = _build_columns(sr.value(), cap_v, rows, now,
-                                          dict_registry, ("t",))
+                    cols = _build_columns(
+                        sr.value(), cap_v, rows, now, dict_registry,
+                        ("t",),
+                        schema_at=lambda v, _t=int(t): _ver_schema(
+                            sm.tag_schema, space_id, _t, v))
                     if cols:
                         shard.tag_props[int(t)] = cols
     return shards, cap_v, cap_e, dict_registry
 
 
 _I32_MIN, _I32_MAX = -(1 << 31), (1 << 31) - 1
+
+
+def _ver_schema(getter, space_id: int, type_id: int,
+                version: int) -> Optional[Schema]:
+    """Versioned schema lookup for _build_columns' schema_at."""
+    r = getter(space_id, abs(type_id), version)
+    return r.value() if r.ok() else None
 
 
 def _native_build_columns(schema: Schema, cap: int, rows: "RowsBlock",
@@ -693,66 +726,124 @@ def _native_build_columns(schema: Schema, cap: int, rows: "RowsBlock",
     return out
 
 
+def _row_versions(rows: "RowsBlock") -> np.ndarray:
+    """Schema version of every row (vectorized peek_schema_version):
+    byte 0 is the version length, little-endian version bytes follow."""
+    n = len(rows.idxs)
+    if n == 0:
+        return np.zeros(0, np.int64)
+    b = np.frombuffer(rows.blob, np.uint8)
+    offs = rows.offs
+    vl = b[offs].astype(np.int64)
+    ver = np.zeros(n, np.int64)
+    for k in range(int(vl.max())):
+        sel = vl > k
+        ver[sel] |= b[offs[sel] + 1 + k].astype(np.int64) << (8 * k)
+    return ver
+
+
+def _finish_column(name: str, t: PropType, vals: List[Any], cap: int,
+                   dict_registry: Dict, dict_key: Tuple,
+                   missing: Optional[np.ndarray]) -> PropColumn:
+    """Assemble one PropColumn from a None-holed python value list."""
+    host = np.array(vals, dtype=object)
+    device_ok = True
+    device_vals = None
+    str_dict = None
+    if t == PropType.DOUBLE:
+        device_vals = np.array([v if v is not None else np.nan
+                                for v in vals], dtype=np.float32)
+    elif t in (PropType.INT, PropType.VID, PropType.TIMESTAMP):
+        ints = [v if v is not None else 0 for v in vals]
+        if ints and (min(ints) < _I32_MIN or max(ints) > _I32_MAX):
+            device_ok = False  # host-only column (filter falls back)
+        else:
+            device_vals = np.array(ints, dtype=np.int32)
+    elif t == PropType.BOOL:
+        device_vals = np.array([bool(v) for v in vals], dtype=bool)
+    elif t == PropType.STRING:
+        if dict_registry is not None and dict_key is not None:
+            str_dict = dict_registry.setdefault(dict_key + (name,), {})
+        else:
+            str_dict = {}
+        codes = np.full(cap, -1, dtype=np.int32)
+        for i, v in enumerate(vals):
+            if v is None:
+                continue
+            codes[i] = str_dict.setdefault(v, len(str_dict))
+        device_vals = codes
+    else:
+        device_ok = False
+    present = np.array([v is not None for v in vals], dtype=bool)
+    return PropColumn(name, t, host, device_ok, device_vals, present,
+                      str_dict, missing)
+
+
 def _build_columns(schema: Schema, cap: int, rows: "RowsBlock", now: float,
-                   dict_registry: Dict = None, dict_key: Tuple = None
-                   ) -> Dict[str, PropColumn]:
+                   dict_registry: Dict = None, dict_key: Tuple = None,
+                   schema_at=None) -> Dict[str, PropColumn]:
     """Decode rows into columnar arrays aligned at the given indices,
-    respecting schema versions and TTL."""
+    respecting per-row schema versions and TTL.
+
+    `schema` is the LATEST schema; `schema_at(ver)` resolves an older
+    version (None -> fall back to latest, the _decode_row rule,
+    processors.py:131-140). When every row carries the latest version
+    (the overwhelmingly common case) the single-schema fast path runs —
+    native batch decode when available — and `missing` stays None.
+    Mixed-version row sets (post-ALTER spaces) take the exact path:
+    each row decodes with ITS OWN version's schema, and cells whose row
+    version lacks the field are marked `missing` (the CPU walk raises
+    EvalError for them; see PropColumn doc)."""
     if isinstance(rows, list):
         rows = RowsBlock.from_pairs(rows)
-    fast = _native_build_columns(schema, cap, rows, now,
-                                 dict_registry, dict_key)
-    if fast is not None:
-        return fast
-    out: Dict[str, PropColumn] = {}
-    n_fields = schema.num_fields()
-    host_cols: List[List[Any]] = [[None] * cap for _ in range(n_fields)]
-    ttl = schema.ttl_col is not None and schema.ttl_duration > 0
-    for idx, raw in rows.items():
+    vers = _row_versions(rows)
+    uvers = np.unique(vers)
+    single = len(uvers) == 0 or (
+        len(uvers) == 1 and (schema_at is None
+                             or int(uvers[0]) == schema.version))
+    if single:
+        fast = _native_build_columns(schema, cap, rows, now,
+                                     dict_registry, dict_key)
+        if fast is not None:
+            return fast
+    multi = not single and schema_at is not None
+    # union of fields over the versions actually present (the latest
+    # schema's type wins a name clash); latest fields always exist so
+    # filter/YIELD compiles see the column even when no current-version
+    # row landed in this shard
+    field_types: Dict[str, PropType] = {f.name: f.type
+                                        for f in schema.fields}
+    schemas_by_ver: Dict[int, Schema] = {}
+    if multi:
+        for v in (int(x) for x in uvers):
+            sv = schema if v == schema.version else schema_at(v)
+            if sv is None:
+                sv = schema
+            schemas_by_ver[v] = sv
+            for f in sv.fields:
+                field_types.setdefault(f.name, f.type)
+    names = list(field_types)
+    host_cols: Dict[str, List[Any]] = {n: [None] * cap for n in names}
+    miss: Optional[Dict[str, np.ndarray]] = (
+        {n: np.ones(cap, bool) for n in names} if multi else None)
+    for j, (idx, raw) in enumerate(rows.items()):
+        sv = schemas_by_ver.get(int(vers[j]), schema) if multi else schema
         try:
-            reader = RowReader(schema, raw)
-            row = reader.to_dict()
+            row = RowReader(sv, raw).to_dict()
         except Exception:
             continue
-        if ttl:
-            ts = row.get(schema.ttl_col)
-            if isinstance(ts, (int, float)) and ts + schema.ttl_duration < now:
+        if sv.ttl_col and sv.ttl_duration > 0:
+            ts = row.get(sv.ttl_col)
+            if isinstance(ts, (int, float)) and ts + sv.ttl_duration < now:
                 continue
-        for fi, f in enumerate(schema.fields):
-            host_cols[fi][idx] = row.get(f.name)
-    for fi, f in enumerate(schema.fields):
-        vals = host_cols[fi]
-        host = np.array(vals, dtype=object)
-        device_ok = True
-        device_vals = None
-        str_dict = None
-        t = f.type
-        if t == PropType.DOUBLE:
-            device_vals = np.array([v if v is not None else np.nan
-                                    for v in vals], dtype=np.float32)
-        elif t in (PropType.INT, PropType.VID, PropType.TIMESTAMP):
-            ints = [v if v is not None else 0 for v in vals]
-            if ints and (min(ints) < _I32_MIN or max(ints) > _I32_MAX):
-                device_ok = False  # host-only column (filter falls back)
-            else:
-                device_vals = np.array(ints, dtype=np.int32)
-        elif t == PropType.BOOL:
-            device_vals = np.array([bool(v) for v in vals], dtype=bool)
-        elif t == PropType.STRING:
-            if dict_registry is not None and dict_key is not None:
-                str_dict = dict_registry.setdefault(dict_key + (f.name,), {})
-            else:
-                str_dict = {}
-            codes = np.full(cap, -1, dtype=np.int32)
-            for i, v in enumerate(vals):
-                if v is None:
-                    continue
-                code = str_dict.setdefault(v, len(str_dict))
-                codes[i] = code
-            device_vals = codes
-        else:
-            device_ok = False
-        present = np.array([v is not None for v in vals], dtype=bool)
-        out[f.name] = PropColumn(f.name, t, host, device_ok, device_vals,
-                                 present, str_dict)
+        for name, v in row.items():
+            host_cols[name][idx] = v
+            if miss is not None:
+                miss[name][idx] = False
+    out: Dict[str, PropColumn] = {}
+    for name in names:
+        out[name] = _finish_column(
+            name, field_types[name], host_cols[name], cap,
+            dict_registry, dict_key,
+            miss[name] if miss is not None else None)
     return out

@@ -179,15 +179,24 @@ class SnapshotDelta:
 def _decode_props(sm, space_id: int, kind: str, type_id: int,
                   row: bytes, now: float) -> Optional[dict]:
     """Row bytes -> props dict with the builder's TTL semantics (None =
-    invisible: undecodable or TTL-expired)."""
-    r = (sm.tag_schema(space_id, type_id) if kind == "v"
-         else sm.edge_schema(space_id, type_id))
-    if not r.ok():
+    invisible: undecodable or TTL-expired). Decodes with the ROW's own
+    schema version (processors.py _decode_row rule) — keys the row's
+    version doesn't carry are simply absent from the dict, and the
+    patch marks those cells `missing` (CPU raises EvalError there)."""
+    from ..codec.row import peek_schema_version
+    getter = sm.tag_schema if kind == "v" else sm.edge_schema
+    latest = getter(space_id, type_id)
+    if not latest.ok():
         return {}
-    schema = r.value()
+    schema = latest.value()
     if not schema.fields:
         return {}
     try:
+        ver = peek_schema_version(row)
+        if ver != schema.version:
+            rv = getter(space_id, type_id, ver)
+            if rv.ok():
+                schema = rv.value()
         props = RowReader(schema, row).to_dict()
     except Exception:
         return None
@@ -219,9 +228,24 @@ def _encode_device_val(col, value):
 
 def _patch_prop_columns(snap, cols: Dict, idx: int, props: Optional[dict],
                         visible: bool) -> None:
-    """Write one row's values into existing PropColumn mirrors at idx."""
+    """Write one row's values into existing PropColumn mirrors at idx.
+
+    Three-state (PropColumn doc): a key absent from the row's schema
+    version — or the whole row invisible (tombstone/TTL) — marks the
+    cell `missing` (CPU raises EvalError); a key present with None is
+    an explicit null."""
     for name, col in cols.items():
-        v = props.get(name) if (visible and props is not None) else None
+        known = visible and props is not None and name in props
+        v = props.get(name) if known else None
+        if not known:
+            if col.missing is None:
+                # materializing the mask on a fast-build column: its
+                # ~present cells were all err (no-row) — preserve that
+                col.missing = (~col.present if col.present is not None
+                               else np.zeros(len(col.host), bool))
+            col.missing[idx] = True
+        elif col.missing is not None:
+            col.missing[idx] = False
         if col.host.dtype == object:
             col.host[idx] = v
         else:   # numeric mirror: nulls ride `present`, cell stores 0
@@ -244,16 +268,30 @@ def _ensure_prop_columns(snap, shard, kind: str, sm, space_id: int,
     """Prop columns dict for (shard, tag/etype), creating empty aligned
     columns when this shard had no rows of that type at build time."""
     store = shard.tag_props if kind == "v" else shard.edge_props
-    cols = store.get(type_id)
-    if cols is not None:
-        return cols
     r = (sm.tag_schema(space_id, type_id) if kind == "v"
          else sm.edge_schema(space_id, type_id))
+    cols = store.get(type_id)
+    if cols is not None:
+        # reconcile fields an ALTER added after the snapshot was built:
+        # absent-everywhere columns (err for every existing row — their
+        # versions lack the field) that incoming writes then fill
+        if r.ok() and any(f.name not in cols for f in r.value().fields):
+            fresh = _new_columns(snap, kind,
+                                 [f for f in r.value().fields
+                                  if f.name not in cols], cap)
+            cols.update(fresh)
+        return cols
     if not r.ok() or not r.value().fields:
         return None
+    cols = _new_columns(snap, kind, r.value().fields, cap)
+    store[type_id] = cols
+    return cols
+
+
+def _new_columns(snap, kind: str, fields, cap: int) -> Dict:
     from .csr import PropColumn
     cols = {}
-    for f in r.value().fields:
+    for f in fields:
         host = np.empty(cap, dtype=object)
         present = np.zeros(cap, bool)
         t = f.type
@@ -273,7 +311,6 @@ def _ensure_prop_columns(snap, shard, kind: str, sm, space_id: int,
             continue
         cols[f.name] = PropColumn(f.name, t, host, True, dv, present,
                                   str_dict)
-    store[type_id] = cols
     return cols
 
 

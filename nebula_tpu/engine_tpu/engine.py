@@ -88,7 +88,8 @@ class TpuGraphEngine:
                       "fallbacks": 0, "sharded_queries": 0,
                       "fast_materialize": 0, "slow_materialize": 0,
                       "delta_applies": 0, "delta_edges": 0,
-                      "bg_repacks": 0, "sparse_served": 0}
+                      "bg_repacks": 0, "sparse_served": 0,
+                      "host_filter_vectorized": 0}
         # per-query stage breakdown of the LAST device-served query
         # (snapshot check / kernel / materialize — ref role: per-stage
         # latency in responses, ExecutionPlan.cpp:57) + a serial so the
@@ -390,7 +391,7 @@ class TpuGraphEngine:
             if sparse is not None:
                 return self._emit_sparse(ctx, s, snap, sparse, yield_cols,
                                          columns, alias_map, name_by_type,
-                                         ex, t_snap, t_kernel)
+                                         ex, edge_types, t_snap, t_kernel)
         device_mask, local_filter = self._plan_filter(
             ctx, s, snap, use_delta, name_by_type, alias_map, edge_types)
 
@@ -414,6 +415,20 @@ class TpuGraphEngine:
         t_kernel = time.monotonic() - t1
         t2 = time.monotonic()
 
+        delta_filter = local_filter
+        idx_per_part = None
+        if local_filter is not None:
+            # the device compile was declined (e.g. delta edges in play,
+            # _plan_filter): still avoid the per-row Python walk over
+            # the canonical rows with the vectorized host evaluator
+            idx_per_part = self._host_filter_idx(
+                ctx, snap, local_filter,
+                lambda: {p: np.nonzero(mask[p])[0]
+                         for p in range(snap.num_parts)
+                         if mask[p].any()},
+                name_by_type, alias_map, edge_types)
+            if idx_per_part is not None:
+                local_filter = None
         rows: Optional[List[Tuple]] = None
         if local_filter is None:
             # columnar fast path: one numpy gather per YIELD column over
@@ -421,12 +436,14 @@ class TpuGraphEngine:
             # semantics aren't a pure gather — identity by construction
             from . import materialize
             rows = materialize.emit_rows(snap, mask, ctx, yield_cols,
-                                         alias_map, name_by_type)
+                                         alias_map, name_by_type,
+                                         idx_per_part=idx_per_part)
         if rows is not None:
             self.stats["fast_materialize"] += 1
         else:
             self.stats["slow_materialize"] += 1
-            resp = self._materialize(snap, mask, ctx, yield_cols, s)
+            resp = self._materialize(snap, mask, ctx, yield_cols, s,
+                                     idx_per_part=idx_per_part)
             rows = []
             st = ex._emit_go_rows(ctx, resp, rows, yield_cols, local_filter,
                                   alias_map, name_by_type, roots={},
@@ -437,10 +454,16 @@ class TpuGraphEngine:
         if d_active is not None:
             d_mask = np.asarray(d_active)
             if d_mask.any():
-                delta_resp = self._materialize_delta(snap, d_mask, mask,
+                # cap accounting must see the POST-filter base rows
+                # (the CPU hot loop counts only filter-passing edges
+                # toward max_edges_per_vertex, processors.py:235-244)
+                base_for_cap = idx_per_part if idx_per_part is not None \
+                    else mask
+                delta_resp = self._materialize_delta(snap, d_mask,
+                                                     base_for_cap,
                                                      ctx, yield_cols, s)
                 st = ex._emit_go_rows(ctx, delta_resp, rows, yield_cols,
-                                      local_filter, alias_map, name_by_type,
+                                      delta_filter, alias_map, name_by_type,
                                       roots={}, input_index={},
                                       needs_input=False,
                                       needs_dst=_needs_dst(yield_cols, s))
@@ -453,6 +476,26 @@ class TpuGraphEngine:
         self._record_profile("dense", t_snap, t_kernel,
                              time.monotonic() - t2, snap)
         return StatusOr.of(result)
+
+    def _host_filter_idx(self, ctx, snap, flt, idx_provider, name_by_type,
+                         alias_map, edge_types):
+        """Vectorized host filter pass over active canonical indices:
+        -> {part0: filtered idx}, or None when the filter is outside
+        filter_host's surface (caller keeps the exact per-row Python
+        walk). `idx_provider` is called only AFTER the compile
+        succeeds — building index arrays for a filter that then
+        declines would be pure waste on big dense masks. A ~10^6-edge
+        sparse result through the per-row walk costs seconds — the r3
+        bench's 12s p99 outlier."""
+        from .filter_host import HostFilterCompiler
+        hf = HostFilterCompiler(snap, self._sm, ctx.space_id(),
+                                name_by_type, alias_map,
+                                edge_types).compile(flt)
+        if hf is None:
+            return None
+        self.stats["host_filter_vectorized"] += 1
+        return {p: idx[hf.eval_part(p, idx)]
+                for p, idx in idx_provider().items()}
 
     def _materialize_delta(self, snap: CsrSnapshot, d_mask: np.ndarray,
                            base_mask: np.ndarray, ctx, yield_cols,
@@ -547,19 +590,24 @@ class TpuGraphEngine:
     # frontiers — the direction-optimized half of the engine
     # ------------------------------------------------------------------
     @staticmethod
-    def _part_frontier_edges(shard, locals_, req):
+    def _part_frontier_edges(shard, locals_, req, max_total=None):
         """Vectorized expansion of one part's frontier locals over the
         base CSR: -> (idx int64[], per_edge_row int64[] positions into
         `locals_`, raw_count) with validity+etype filtering applied.
-        raw_count is the UNFILTERED segment total — budget accounting
-        must see it before any per-edge work. Shared by the pull-mode
-        GO walk and the pull-mode path expansion."""
+        raw_count is the UNFILTERED segment total, computed from the
+        indptr BEFORE any per-edge allocation; when it exceeds
+        `max_total` the expansion is not materialized and (None, None,
+        raw_count) returns — a supernode frontier must cost O(frontier)
+        host work, not O(its edges), before the budget bails. Shared by
+        the pull-mode GO walk and the pull-mode path expansion."""
         indptr = _shard_indptr(shard)
         lo, hi = indptr[locals_], indptr[locals_ + 1]
         counts = (hi - lo).astype(np.int64)
         total = int(counts.sum())
         if total == 0:
             return (np.empty(0, np.int64), np.empty(0, np.int64), 0)
+        if max_total is not None and total > max_total:
+            return (None, None, total)
         idx = (np.repeat(lo - np.pad(np.cumsum(counts), (1, 0))[:-1],
                          counts) + np.arange(total))
         rows = np.repeat(np.arange(len(locals_), dtype=np.int64), counts)
@@ -594,8 +642,8 @@ class TpuGraphEngine:
                 shard = snap.shards[p]
                 base = locals_[locals_ < shard.num_vids_base]
                 if base.size:
-                    idx, _, raw = self._part_frontier_edges(shard, base,
-                                                            req)
+                    idx, _, raw = self._part_frontier_edges(
+                        shard, base, req, max_total=budget - visited)
                     visited += raw
                     if visited > budget:
                         return None
@@ -633,13 +681,20 @@ class TpuGraphEngine:
         return {}, []
 
     def _emit_sparse(self, ctx, s, snap, sparse, yield_cols, columns,
-                     alias_map, name_by_type, ex, t_snap=0.0, t_kernel=0.0):
+                     alias_map, name_by_type, ex, edge_types,
+                     t_snap=0.0, t_kernel=0.0):
         from . import materialize
         t2 = time.monotonic()
         act_idx, d_act = sparse
-        # filters evaluate on the host: row counts here are small by
-        # construction (the sparse path only runs under the edge budget)
         local_filter = s.where.filter if s.where is not None else None
+        delta_filter = local_filter
+        if local_filter is not None and act_idx:
+            filtered = self._host_filter_idx(ctx, snap, local_filter,
+                                             lambda: act_idx, name_by_type,
+                                             alias_map, edge_types)
+            if filtered is not None:
+                act_idx = filtered
+                local_filter = None   # canonical rows fully filtered
         rows: Optional[List[Tuple]] = None
         needs_dst = _needs_dst(yield_cols, s)
         if local_filter is None:
@@ -666,7 +721,7 @@ class TpuGraphEngine:
                 d_mask[slot] = True
             dresp = self._materialize_delta(snap, d_mask, act_idx, ctx,
                                             yield_cols, s)
-            st = ex._emit_go_rows(ctx, dresp, rows, yield_cols, local_filter,
+            st = ex._emit_go_rows(ctx, dresp, rows, yield_cols, delta_filter,
                                   alias_map, name_by_type, roots={},
                                   input_index={}, needs_input=False,
                                   needs_dst=needs_dst)
@@ -713,7 +768,9 @@ class TpuGraphEngine:
                 continue
             locals_ = np.asarray([l for l, _ in base], np.int64)
             vids_ = np.asarray([v for _, v in base], np.int64)
-            idx, rows, raw = self._part_frontier_edges(shard, locals_, req)
+            idx, rows, raw = self._part_frontier_edges(
+                shard, locals_, req,
+                max_total=self.sparse_edge_budget - state["visited"])
             state["visited"] += raw
             if state["visited"] > self.sparse_edge_budget:
                 raise _BudgetExceeded()
@@ -1096,26 +1153,41 @@ def _base_active_count(snap, base, src_vid: int, etype: int) -> int:
 
 
 def _host_tag_props(shard, tag_id: int, local: int) -> Optional[Dict[str, Any]]:
+    """Tag-row props dict for the slow (VertexData) path, or None when
+    the vertex has no row for the tag. Keys the row's schema version
+    doesn't carry are OMITTED — downstream expression eval then raises
+    EvalError exactly like the CPU path's getters."""
     from .csr import host_item
     cols = shard.tag_props.get(tag_id)
     if cols is None:
         return None
-    first = next(iter(cols.values()), None)
-    if first is None or (first.present is not None and not first.present[local]):
-        # vertex has no row for this tag
-        has_any = any(c.present is not None and c.present[local]
-                      for c in cols.values())
-        if not has_any:
-            return None
-    return {name: host_item(col, local) for name, col in cols.items()}
+    out: Dict[str, Any] = {}
+    has_any = False
+    for name, col in cols.items():
+        if col.missing is not None:
+            if col.missing[local]:
+                continue
+            has_any = True
+            out[name] = host_item(col, local)
+        else:
+            # fast-build column: ~present means no row (nulls are not
+            # reachable through current writes)
+            if col.present is not None and not col.present[local]:
+                continue
+            has_any = True
+            out[name] = host_item(col, local)
+    return out if has_any else None
 
 
 def _host_edge_props(shard, etype: int, edge_idx: int) -> Dict[str, Any]:
+    """Edge-row props for the slow path; version-missing keys omitted
+    (the CPU walk raises for them — see _host_tag_props)."""
     from .csr import host_item
     cols = shard.edge_props.get(etype)
     if not cols:
         return {}
-    return {name: host_item(col, edge_idx) for name, col in cols.items()}
+    return {name: host_item(col, edge_idx) for name, col in cols.items()
+            if col.missing is None or not col.missing[edge_idx]}
 
 
 def _shard_indptr(shard) -> np.ndarray:

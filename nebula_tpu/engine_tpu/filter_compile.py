@@ -1,26 +1,31 @@
 """Expression → vectorized device mask compiler.
 
 The TPU answer to the reference's per-row filter closures (ref:
-storage/QueryBaseProcessor.inl:415-443 binds getters to KV iterators,
-evaluated edge-by-edge): instead of evaluating the expression tree per
-edge, compile it once into jnp operations producing a bool mask over
-the whole [P, cap_e] edge block (SURVEY.md §7 hard-part (c)).
+storage/QueryBaseProcessor.inl:146-167 decodes the pushed expression,
+:415-443 binds getters to KV iterators, evaluated edge-by-edge):
+instead of evaluating the expression tree per edge, compile it once
+into jnp operations producing a bool mask over the whole [P, cap_e]
+edge block (SURVEY.md §7 hard-part (c)).
+
+Exact-semantics discipline — each node tracks THREE states per edge
+slot, identical to filter_host.py (see its module doc for the rules):
+value / null (explicit NULL, CPU relational null rules) / err (the CPU
+walk raises EvalError: prop missing from the row's schema version,
+vertex without the referenced tag, division by zero). err propagation
+follows CPU evaluation order including && / || short-circuit. The
+final mask is `truthy(value) & ~null & ~err`.
 
 Supported on device: literals; edge props; `$^` source-vertex props
 (gathered through edge_src); `$$` dest-vertex props (gathered through
-the dst global index); arithmetic / relational / logical operators;
-string equality via dictionary codes. Anything else (functions, $-,
-$var, _rank/_src/_dst literals, casts) returns None — the engine then
-runs the traversal unfiltered on device and applies the filter on the
-host during materialization, preserving exact semantics.
-
-Null semantics mirror the CPU path: comparisons against a missing
-property are false (tracked with presence masks; DOUBLE uses NaN which
-is naturally false in comparisons).
+the dst global index); arithmetic / relational / logical operators
+(int/int division C-style); string equality via dictionary codes.
+Anything else (functions, $-, $var, casts) returns None — the engine
+then runs the traversal unfiltered on device and applies the filter on
+the host during materialization, preserving exact semantics.
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 import jax.numpy as jnp
 import numpy as np
@@ -35,16 +40,33 @@ class _Unsupported(Exception):
     pass
 
 
+_F = jnp.bool_(False)
+
+
 class _Val:
-    """A compiled sub-expression: device value + presence + kind."""
+    """A compiled sub-expression: device value + null/err masks."""
 
-    __slots__ = ("kind", "value", "present", "str_meta")
+    __slots__ = ("kind", "value", "null", "err", "str_meta", "intlike")
 
-    def __init__(self, kind: str, value, present, str_meta=None):
+    def __init__(self, kind: str, value, null=_F, err=_F, str_meta=None,
+                 intlike=None):
         self.kind = kind          # 'num' | 'bool' | 'strcode' | 'strlit'
         self.value = value        # jnp array or python scalar
-        self.present = present    # jnp bool array or None (always present)
-        self.str_meta = str_meta  # (kind, schema_id, prop) for strcode
+        self.null = null          # jnp bool array/scalar
+        self.err = err            # jnp bool array/scalar
+        self.str_meta = str_meta  # (kind, prop) for strcode
+        self.intlike = intlike    # num only: True=int, False=float
+
+
+def _truthy(v: _Val):
+    """CPU _truthy over (value, null): null is falsy; num != 0."""
+    if v.kind == "bool":
+        t = v.value
+    elif v.kind == "num":
+        t = v.value != 0
+    else:
+        raise _Unsupported()
+    return t & ~v.null
 
 
 class FilterCompiler:
@@ -59,19 +81,40 @@ class FilterCompiler:
         self.edge_types = edge_types
 
     def compile(self, expr: Expression) -> Optional[jnp.ndarray]:
-        """-> bool mask [P, cap_e], or None if not device-compilable."""
+        """-> bool mask [P, cap_e] (True = row passes), or None if not
+        device-compilable."""
         try:
             v = self._compile(expr)
-            if v.kind != "bool":
+            if v.kind not in ("bool", "num"):
                 return None
-            mask = v.value
-            if v.present is not None:
-                mask = mask & v.present
-            return mask
+            return _truthy(v) & ~v.err
         except _Unsupported:
             return None
 
     # ------------------------------------------------------------------
+    def _col_states(self, kind: str, sid: int, prop: str, cap: int):
+        """Per-shard (null, err) stacks for a column, [P, cap] device
+        arrays (filter_host._leaf_states, stacked): with a `missing`
+        mask err = missing, null = ~present & ~missing; without one
+        ~present means no-row/expired which the CPU path raises for."""
+        nulls, errs = [], []
+        for s in self.snap.shards:
+            store = s.edge_props if kind == "e" else s.tag_props
+            col = store.get(sid, {}).get(prop)
+            if col is None:
+                nulls.append(np.zeros(cap, bool))
+                errs.append(np.ones(cap, bool))
+                continue
+            pres = col.present if col.present is not None \
+                else np.ones(cap, bool)
+            if col.missing is not None:
+                errs.append(col.missing)
+                nulls.append(~pres & ~col.missing)
+            else:
+                errs.append(~pres)
+                nulls.append(np.zeros(cap, bool))
+        return jnp.asarray(np.stack(nulls)), jnp.asarray(np.stack(errs))
+
     def _edge_prop_val(self, prop: str,
                        allowed_types: Optional[List[int]] = None) -> _Val:
         """Value of an edge prop, selected per edge by its stored etype.
@@ -82,107 +125,95 @@ class FilterCompiler:
         snap = self.snap
         types = allowed_types if allowed_types is not None else self.edge_types
         acc = None
-        present = jnp.zeros(snap.d_edge_etype.shape, dtype=bool)
+        # slots whose requested type has no column for this prop: the
+        # CPU getter raises "prop not found"
+        null = jnp.zeros(snap.d_edge_etype.shape, dtype=bool)
+        err = jnp.ones(snap.d_edge_etype.shape, dtype=bool)
         is_string = None
+        intlike = None
         for et in types:
             col = snap.device_edge_prop(et, prop)
             if col is None:
                 continue
-            # column dtype tells us the prop kind for this etype
-            col_is_string = self._edge_prop_type(et, prop) == PropType.STRING
+            ptype = self._edge_prop_type(et, prop)
+            if ptype == PropType.DOUBLE:
+                # the device mirror is float32 — comparing through it
+                # diverges from the CPU's exact float64 compare; the
+                # host vectorized evaluator serves doubles instead
+                raise _Unsupported()
+            col_is_string = ptype == PropType.STRING
             if is_string is None:
                 is_string = col_is_string
+                intlike = True
             elif is_string != col_is_string:
                 raise _Unsupported()
             sel = snap.d_edge_etype == et
-            pres = sel & self._edge_prop_present(et, prop)
+            cn, ce = self._col_states("e", et, prop, snap.cap_e)
             if acc is None:
-                acc = jnp.where(sel, col, 0 if col.dtype != jnp.float32
-                                else jnp.float32(jnp.nan))
+                acc = jnp.where(sel, col, 0)
             else:
                 acc = jnp.where(sel, col, acc)
-            present = present | pres
+            null = jnp.where(sel, cn, null)
+            err = jnp.where(sel, ce, err)
         if acc is None:
             raise _Unsupported()
         if is_string:
-            return _Val("strcode", acc, present, ("e", prop))
+            return _Val("strcode", acc, null, err, ("e", prop))
         if acc.dtype == jnp.bool_:
-            return _Val("bool", acc, present)
-        return _Val("num", acc, present)
+            return _Val("bool", acc, null, err)
+        return _Val("num", acc, null, err, intlike=intlike)
 
     def _edge_prop_type(self, et: int, prop: str) -> Optional[PropType]:
         r = self.sm.edge_schema(self.space_id, et)
         return r.value().field_type(prop) if r.ok() else None
 
-    def _edge_prop_present(self, et: int, prop: str) -> jnp.ndarray:
-        cols = []
-        for s in self.snap.shards:
-            col = s.edge_props.get(et, {}).get(prop)
-            if col is None or col.present is None:
-                cols.append(np.zeros(self.snap.cap_e, bool))
-            else:
-                cols.append(col.present)
-        return jnp.asarray(np.stack(cols))
-
-    def _src_prop_val(self, tag: str, prop: str) -> _Val:
+    def _tag_prop_val(self, tag: str, prop: str, dest: bool) -> _Val:
+        """$^ (gather through edge_src) or $$ (gather through the dst
+        global index) tag prop as per-edge values."""
+        snap = self.snap
         tid = self.sm.tag_id(self.space_id, tag)
         if tid is None:
             raise _Unsupported()
-        col = self.snap.device_tag_prop(tid, prop)
+        col = snap.device_tag_prop(tid, prop)
         if col is None:
             raise _Unsupported()
         ptype = self.sm.tag_schema(self.space_id, tid).value().field_type(prop)
-        pres_np = np.stack([
-            s.tag_props.get(tid, {}).get(prop).present
-            if s.tag_props.get(tid, {}).get(prop) is not None
-            else np.zeros(self.snap.cap_v, bool)
-            for s in self.snap.shards])
-        # gather per-edge source values: [P, cap_v] -> [P, cap_e]
-        vals = jnp.take_along_axis(col, self.snap.d_edge_src, axis=1)
-        pres = jnp.take_along_axis(jnp.asarray(pres_np),
-                                   self.snap.d_edge_src, axis=1)
-        if ptype == PropType.STRING:
-            return _Val("strcode", vals, pres, ("t", prop))
-        if col.dtype == jnp.bool_:
-            return _Val("bool", vals, pres)
-        return _Val("num", vals, pres)
-
-    def _dst_prop_val(self, tag: str, prop: str) -> _Val:
-        tid = self.sm.tag_id(self.space_id, tag)
-        if tid is None:
+        if ptype is None or ptype == PropType.DOUBLE:
+            # float32 device mirror diverges from exact float64 — the
+            # host vectorized evaluator serves doubles instead
             raise _Unsupported()
-        col = self.snap.device_tag_prop(tid, prop)
-        if col is None:
-            raise _Unsupported()
-        ptype = self.sm.tag_schema(self.space_id, tid).value().field_type(prop)
-        pres_np = np.stack([
-            s.tag_props.get(tid, {}).get(prop).present
-            if s.tag_props.get(tid, {}).get(prop) is not None
-            else np.zeros(self.snap.cap_v, bool)
-            for s in self.snap.shards])
-        # flatten [P, cap_v] -> [P*cap_v] + dump slot, gather by global idx
-        flat = jnp.concatenate([col.reshape(-1),
-                                jnp.zeros((1,), col.dtype)])
-        flat_p = jnp.concatenate([jnp.asarray(pres_np).reshape(-1),
-                                  jnp.zeros((1,), jnp.bool_)])
-        vals = flat[self.snap.d_edge_gidx]
-        pres = flat_p[self.snap.d_edge_gidx]
+        null_v, err_v = self._col_states("t", tid, prop, snap.cap_v)
+        if dest:
+            flat = jnp.concatenate([col.reshape(-1),
+                                    jnp.zeros((1,), col.dtype)])
+            flat_n = jnp.concatenate([null_v.reshape(-1),
+                                      jnp.zeros((1,), jnp.bool_)])
+            flat_e = jnp.concatenate([err_v.reshape(-1),
+                                      jnp.ones((1,), jnp.bool_)])
+            vals = flat[snap.d_edge_gidx]
+            null = flat_n[snap.d_edge_gidx]
+            err = flat_e[snap.d_edge_gidx]
+        else:
+            vals = jnp.take_along_axis(col, snap.d_edge_src, axis=1)
+            null = jnp.take_along_axis(null_v, snap.d_edge_src, axis=1)
+            err = jnp.take_along_axis(err_v, snap.d_edge_src, axis=1)
         if ptype == PropType.STRING:
-            return _Val("strcode", vals, pres, ("t", prop))
+            return _Val("strcode", vals, null, err, ("t", prop))
         if col.dtype == jnp.bool_:
-            return _Val("bool", vals, pres)
-        return _Val("num", vals, pres)
+            return _Val("bool", vals, null, err)
+        return _Val("num", vals, null, err,
+                    intlike=ptype != PropType.DOUBLE)
 
     # ------------------------------------------------------------------
     def _compile(self, e: Expression) -> _Val:
         if isinstance(e, Literal):
             v = e.value
             if isinstance(v, bool):
-                return _Val("bool", v, None)
+                return _Val("bool", v)
             if isinstance(v, (int, float)):
-                return _Val("num", v, None)
+                return _Val("num", v, intlike=isinstance(v, int))
             if isinstance(v, str):
-                return _Val("strlit", v, None)
+                return _Val("strlit", v)
             raise _Unsupported()
         if isinstance(e, EdgePropExpr):
             allowed = None
@@ -194,40 +225,72 @@ class FilterCompiler:
                     raise _Unsupported()
             return self._edge_prop_val(e.prop, allowed)
         if isinstance(e, SourcePropExpr):
-            return self._src_prop_val(e.tag, e.prop)
+            return self._tag_prop_val(e.tag, e.prop, dest=False)
         if isinstance(e, DestPropExpr):
-            return self._dst_prop_val(e.tag, e.prop)
+            return self._tag_prop_val(e.tag, e.prop, dest=True)
         if isinstance(e, UnaryExpr):
             v = self._compile(e.operand)
-            if e.op == "!" and v.kind == "bool":
-                return _Val("bool", ~v.value if hasattr(v.value, "dtype")
-                            else (not v.value), v.present)
+            if e.op == "!" and v.kind in ("bool", "num"):
+                t = _truthy(v)
+                return _Val("bool", ~t if hasattr(t, "dtype") else (not t),
+                            _F, v.err)
             if e.op == "-" and v.kind == "num":
-                return _Val("num", -v.value, v.present)
+                # CPU: -None is _require_num -> EvalError
+                return _Val("num", -v.value, _F, v.err | v.null,
+                            intlike=v.intlike)
             if e.op == "+" and v.kind == "num":
-                return v
+                return _Val("num", v.value, _F, v.err | v.null,
+                            intlike=v.intlike)
             raise _Unsupported()
         if isinstance(e, ArithmeticExpr):
             l = self._compile(e.left)
             r = self._compile(e.right)
             if l.kind != "num" or r.kind != "num":
                 raise _Unsupported()
-            pres = _and_present(l.present, r.present)
+            # CPU _require_num(None) raises -> null operands err
+            err = l.err | r.err | l.null | r.null
+            both_int = l.intlike and r.intlike
             if e.op == "+":
-                return _Val("num", l.value + r.value, pres)
+                return _Val("num", l.value + r.value, _F, err,
+                            intlike=both_int)
             if e.op == "-":
-                return _Val("num", l.value - r.value, pres)
+                return _Val("num", l.value - r.value, _F, err,
+                            intlike=both_int)
             if e.op == "*":
-                return _Val("num", l.value * r.value, pres)
-            if e.op == "/":
-                return _Val("num", l.value / r.value, pres)
-            if e.op == "%":
-                return _Val("num", l.value % r.value, pres)
+                return _Val("num", l.value * r.value, _F, err,
+                            intlike=both_int)
+            if e.op in ("/", "%"):
+                # CPU: x/0 and x%0 raise EvalError which drops the row
+                # — fold into err. int/int divides C-style (trunc
+                # toward zero — exact in integer arithmetic, no float
+                # rounding at int32 scale); a static int/float mix
+                # can't pick either branch.
+                if l.intlike is None or r.intlike is None:
+                    raise _Unsupported()
+                a, b = jnp.asarray(l.value), jnp.asarray(r.value)
+                zero = b == 0
+                err = err | zero
+                safe_b = jnp.where(zero, 1, b)
+                if both_int:
+                    qa = jnp.abs(a) // jnp.abs(safe_b)
+                    q = jnp.where((a < 0) ^ (safe_b < 0), -qa, qa)
+                    if e.op == "/":
+                        return _Val("num", q, _F, err, intlike=True)
+                    return _Val("num", a - q * safe_b, _F, err,
+                                intlike=True)
+                if e.op == "%":
+                    raise _Unsupported()  # CPU: % requires integers
+                return _Val("num", a / safe_b, _F, err, intlike=False)
             raise _Unsupported()
         if isinstance(e, RelationalExpr):
+            # CPU null rules (expressions.py RelationalExpr.eval): the
+            # result is never null — null==null is True, null!=x is
+            # True iff exactly one side is null, null under an ordering
+            # operator is False.
             l = self._compile(e.left)
             r = self._compile(e.right)
-            pres = _and_present(l.present, r.present)
+            err = l.err | r.err
+            both = ~l.null & ~r.null
             # string comparisons: only == / != via dict codes
             if "strcode" in (l.kind, r.kind):
                 if e.op not in ("==", "!="):
@@ -237,41 +300,41 @@ class FilterCompiler:
                     raise _Unsupported()
                 kind, prop = code_side.str_meta
                 code = self.snap.str_code(kind, prop, lit_side.value)
-                m = code_side.value == code
-                if e.op == "!=":
-                    m = ~m
-                return _Val("bool", m, pres)
+                if e.op == "==":
+                    return _Val("bool", (code_side.value == code) & both,
+                                _F, err)
+                return _Val("bool",
+                            jnp.where(both, code_side.value != code, True),
+                            _F, err)
             if l.kind == "strlit" or r.kind == "strlit":
                 raise _Unsupported()
-            if l.kind == "bool" and r.kind == "bool" and e.op in ("==", "!="):
-                m = (l.value == r.value) if e.op == "==" else (l.value != r.value)
-                return _Val("bool", m, pres)
-            if l.kind != "num" or r.kind != "num":
+            eq_kinds = (l.kind == "bool" and r.kind == "bool") or \
+                (l.kind == "num" and r.kind == "num")
+            if not eq_kinds:
                 raise _Unsupported()
             ops = {"==": lambda a, b: a == b, "!=": lambda a, b: a != b,
                    "<": lambda a, b: a < b, "<=": lambda a, b: a <= b,
                    ">": lambda a, b: a > b, ">=": lambda a, b: a >= b}
             if e.op not in ops:
                 raise _Unsupported()
-            return _Val("bool", ops[e.op](l.value, r.value), pres)
+            m = ops[e.op](l.value, r.value)
+            if e.op == "==":
+                return _Val("bool", jnp.where(both, m, l.null & r.null),
+                            _F, err)
+            if e.op == "!=":
+                return _Val("bool", jnp.where(both, m, l.null ^ r.null),
+                            _F, err)
+            return _Val("bool", jnp.asarray(m) & both, _F, err)
         if isinstance(e, LogicalExpr):
+            # err follows CPU evaluation order: left always evaluates;
+            # right only when && sees a truthy left / || sees a falsy
+            # left (short-circuit)
             l = self._compile(e.left)
             r = self._compile(e.right)
-            if l.kind != "bool" or r.kind != "bool":
-                raise _Unsupported()
-            lv = l.value if l.present is None else (l.value & l.present)
-            rv = r.value if r.present is None else (r.value & r.present)
+            lv, rv = _truthy(l), _truthy(r)
             if e.op == "&&":
-                return _Val("bool", lv & rv, None)
+                return _Val("bool", lv & rv, _F, l.err | (lv & r.err))
             if e.op == "||":
-                return _Val("bool", lv | rv, None)
-            return _Val("bool", lv ^ rv, None)
+                return _Val("bool", lv | rv, _F, l.err | (~lv & r.err))
+            return _Val("bool", lv ^ rv, _F, l.err | r.err)
         raise _Unsupported()
-
-
-def _and_present(a, b):
-    if a is None:
-        return b
-    if b is None:
-        return a
-    return a & b

@@ -133,8 +133,13 @@ def _plan(expr, sm, space: int, alias_map: Dict[str, str],
                 if cols is None or prop not in cols:
                     return None  # CPU raises "prop not found": fallback
                 sel = ets == t
+                col = cols[prop]
+                if col.missing is not None \
+                        and col.missing[env.idx[sel]].any():
+                    # a row's schema version lacks the prop: CPU raises
+                    return None
                 from .csr import host_gather
-                out[sel] = host_gather(cols[prop], env.idx[sel]).tolist()
+                out[sel] = host_gather(col, env.idx[sel]).tolist()
             return out
         return edge_prop
 

@@ -396,3 +396,209 @@ def test_jax_profiler_trace_produced(pair, tmp_path):
     tpu.stop_trace()
     files = [p for p in tmp_path.rglob("*") if p.is_file()]
     assert files, "no trace files produced"
+
+
+def test_sparse_filter_vectorized(pair):
+    """WHERE filters on the pull-mode path evaluate as one vectorized
+    numpy pass over the active edges (filter_host), not a per-row
+    Python walk — and results stay identical to the CPU engine."""
+    cpu_conn, tpu_conn, tpu = pair
+    for q in [
+        "GO FROM 100 OVER like WHERE like.likeness > 85 YIELD like._dst",
+        'GO FROM 100 OVER like WHERE $^.player.age > 40 YIELD like._dst',
+        'GO FROM 100 OVER like WHERE $$.player.age > 33 && like.likeness '
+        ">= 90 YIELD like._dst, like.likeness",
+        'GO FROM 100, 101 OVER serve WHERE $$.team.name == "Spurs" '
+        "YIELD serve._dst",
+        "GO 2 STEPS FROM 100 OVER like WHERE like.likeness + 5 > 95 "
+        "YIELD like._dst",
+    ]:
+        before_v = tpu.stats["host_filter_vectorized"]
+        before_s = tpu.stats["sparse_served"]
+        r_tpu = tpu_conn.must(q)
+        assert tpu.stats["sparse_served"] == before_s + 1, q
+        assert tpu.stats["host_filter_vectorized"] == before_v + 1, q
+        r_cpu = cpu_conn.must(q)
+        assert sorted(map(repr, r_cpu.rows)) == \
+            sorted(map(repr, r_tpu.rows)), q
+
+
+def test_sparse_filter_unsupported_falls_back(pair):
+    """A filter outside the vectorizable surface (function call) still
+    serves sparsely through the exact per-row walk."""
+    cpu_conn, tpu_conn, tpu = pair
+    q = ("GO FROM 100 OVER like WHERE abs(like.likeness) > 85 "
+         "YIELD like._dst")
+    before_v = tpu.stats["host_filter_vectorized"]
+    r_tpu = tpu_conn.must(q)
+    assert tpu.stats["host_filter_vectorized"] == before_v
+    r_cpu = cpu_conn.must(q)
+    assert sorted(map(repr, r_cpu.rows)) == sorted(map(repr, r_tpu.rows))
+
+
+def test_sparse_filter_with_delta_edges(pair):
+    """Host-vectorized canonical rows + per-row-filtered delta rows
+    agree with the CPU engine after an INSERT lands in the delta."""
+    cpu_conn, tpu_conn, tpu = pair
+    for conn in (cpu_conn, tpu_conn):
+        conn.must('INSERT VERTEX player(name, age) VALUES '
+                  '600:("DeltaGuy", 25)')
+        conn.must('INSERT EDGE like(likeness) VALUES 100 -> 600:(99.0)')
+    q = "GO FROM 100 OVER like WHERE like.likeness > 90 YIELD like._dst"
+    r_cpu = cpu_conn.must(q)
+    r_tpu = tpu_conn.must(q)
+    assert (600,) in r_tpu.rows
+    assert sorted(map(repr, r_cpu.rows)) == sorted(map(repr, r_tpu.rows))
+    for conn in (cpu_conn, tpu_conn):
+        conn.must("DELETE VERTEX 600")
+
+
+def test_dense_delta_filter_vectorized(pair_dense):
+    """With delta edges in play the device filter compile is declined
+    (_plan_filter) — the dense path must still vectorize the canonical
+    filter on host instead of walking rows in Python."""
+    cpu_conn, tpu_conn, tpu = pair_dense
+    # warm-up: force the snapshot to exist BEFORE the inserts so the
+    # writes land in the delta buffer (a cold run would fold them into
+    # a fresh canonical build and never exercise the delta-filter path)
+    tpu_conn.must("GO FROM 100 OVER like YIELD like._dst")
+    for conn in (cpu_conn, tpu_conn):
+        conn.must('INSERT VERTEX player(name, age) VALUES '
+                  '601:("DenseDelta", 30)')
+        conn.must('INSERT EDGE like(likeness) VALUES 100 -> 601:(97.0)')
+    q = "GO FROM 100 OVER like WHERE like.likeness > 90 YIELD like._dst"
+    before_v = tpu.stats["host_filter_vectorized"]
+    r_tpu = tpu_conn.must(q)
+    assert tpu.stats["host_filter_vectorized"] == before_v + 1
+    assert (601,) in r_tpu.rows
+    r_cpu = cpu_conn.must(q)
+    assert sorted(map(repr, r_cpu.rows)) == sorted(map(repr, r_tpu.rows))
+    for conn in (cpu_conn, tpu_conn):
+        conn.must("DELETE VERTEX 601")
+
+
+@pytest.fixture(scope="module")
+def null_pair():
+    """CPU + TPU clusters holding rows with NULL props (written before
+    an ALTER added the column) — exercises the null semantics of the
+    filter evaluators against the per-row CPU walk."""
+    tpu = TpuGraphEngine()
+    conns = []
+    for cluster in (InProcCluster(), InProcCluster(tpu_engine=tpu)):
+        c = cluster.connect()
+        c.must("CREATE SPACE ns(partition_num=2)")
+        c.must("USE ns")
+        c.must("CREATE TAG n(x int)")
+        c.must("CREATE EDGE r(w int)")
+        c.must('INSERT VERTEX n(x) VALUES 1:(10), 2:(20), 3:(30), 4:(40)')
+        c.must("INSERT EDGE r(w) VALUES 1 -> 2:(7), 1 -> 3:(0)")
+        # new columns: pre-ALTER rows read as NULL for w2/y
+        c.must("ALTER EDGE r ADD (w2 int)")
+        c.must("ALTER TAG n ADD (y double)")
+        c.must("INSERT EDGE r(w, w2) VALUES 1 -> 4:(5, 50)")
+        conns.append(c)
+    return conns[0], conns[1], tpu
+
+
+NULL_SEMANTICS_QUERIES = [
+    # null != x -> True; null == x -> False (expressions.py:266-272)
+    "GO FROM 1 OVER r WHERE r.w2 != 50 YIELD r._dst",
+    "GO FROM 1 OVER r WHERE r.w2 == 50 YIELD r._dst",
+    "GO FROM 1 OVER r WHERE r.w2 != 99 YIELD r._dst",
+    # ordering ops against null -> False
+    "GO FROM 1 OVER r WHERE r.w2 > 0 YIELD r._dst",
+    "GO FROM 1 OVER r WHERE !(r.w2 > 0) YIELD r._dst",
+    # !null -> True (null is falsy); truthy num in logical ops
+    "GO FROM 1 OVER r WHERE !r.w2 YIELD r._dst",
+    "GO FROM 1 OVER r WHERE r.w && true YIELD r._dst",
+    # null == null -> True (two absent props)
+    "GO FROM 1 OVER r WHERE r.w2 == $$.n.y YIELD r._dst",
+    # arithmetic on null -> EvalError -> row dropped
+    "GO FROM 1 OVER r WHERE r.w2 + 1 > 0 YIELD r._dst",
+    # C-style int division + div-by-zero drops the row
+    "GO FROM 1 OVER r WHERE r.w / 2 >= 3 YIELD r._dst",
+    "GO FROM 1 OVER r WHERE 7 / r.w > 0 YIELD r._dst",
+    "GO FROM 1 OVER r WHERE r.w % 4 == 3 YIELD r._dst",
+    "GO FROM 1 OVER r WHERE -r.w / 2 == -3 YIELD r._dst",
+]
+
+
+@pytest.mark.parametrize("query", NULL_SEMANTICS_QUERIES)
+def test_null_and_division_semantics_sparse(null_pair, query):
+    cpu_conn, tpu_conn, tpu = null_pair
+    r_cpu = cpu_conn.must(query)
+    before = tpu.stats["sparse_served"]
+    r_tpu = tpu_conn.must(query)
+    assert tpu.stats["sparse_served"] == before + 1, query
+    assert sorted(map(repr, r_cpu.rows)) == sorted(map(repr, r_tpu.rows)), \
+        f"null/division divergence (sparse): {query}"
+
+
+@pytest.fixture(scope="module")
+def null_pair_dense():
+    tpu = TpuGraphEngine()
+    tpu.sparse_edge_budget = 0
+    conns = []
+    for cluster in (InProcCluster(), InProcCluster(tpu_engine=tpu)):
+        c = cluster.connect()
+        c.must("CREATE SPACE nd(partition_num=2)")
+        c.must("USE nd")
+        c.must("CREATE TAG n(x int)")
+        c.must("CREATE EDGE r(w int)")
+        c.must('INSERT VERTEX n(x) VALUES 1:(10), 2:(20), 3:(30), 4:(40)')
+        c.must("INSERT EDGE r(w) VALUES 1 -> 2:(7), 1 -> 3:(0)")
+        c.must("ALTER EDGE r ADD (w2 int)")
+        c.must("ALTER TAG n ADD (y double)")
+        c.must("INSERT EDGE r(w, w2) VALUES 1 -> 4:(5, 50)")
+        conns.append(c)
+    return conns[0], conns[1], tpu
+
+
+@pytest.mark.parametrize("query", NULL_SEMANTICS_QUERIES)
+def test_null_and_division_semantics_dense(null_pair_dense, query):
+    cpu_conn, tpu_conn, tpu = null_pair_dense
+    r_cpu = cpu_conn.must(query)
+    r_tpu = tpu_conn.must(query)
+    assert sorted(map(repr, r_cpu.rows)) == sorted(map(repr, r_tpu.rows)), \
+        f"null/division divergence (dense): {query}"
+
+
+def test_schema_evolution_yield_identity(null_pair):
+    """Rows written before an ALTER decode with their OWN schema
+    version in the snapshot (the CPU _decode_row rule): values of
+    still-present fields are correct, and YIELD of a field the row's
+    version lacks fails the query exactly like the CPU engine."""
+    cpu_conn, tpu_conn, tpu = null_pair
+    q = "GO FROM 1 OVER r YIELD r._dst, r.w"
+    r_cpu = cpu_conn.must(q)
+    r_tpu = tpu_conn.must(q)
+    assert sorted(map(repr, r_cpu.rows)) == sorted(map(repr, r_tpu.rows))
+    assert (2, 7) in r_tpu.rows       # old-version row, real value
+    q2 = "GO FROM 1 OVER r YIELD r._dst, r.w2"
+    r2_cpu = cpu_conn.execute(q2)
+    r2_tpu = tpu_conn.execute(q2)
+    assert r2_cpu.code.name == r2_tpu.code.name == "E_EXECUTION_ERROR"
+
+
+def test_double_filter_exactness_after_alter():
+    """Double comparisons must use exact float64 even on shards whose
+    columns were built by the python (object-host) path — the float32
+    device mirror would round 90.10000001 below 90.1 and drop rows."""
+    tpu = TpuGraphEngine()
+    conns = []
+    for cluster in (InProcCluster(), InProcCluster(tpu_engine=tpu)):
+        c = cluster.connect()
+        c.must("CREATE SPACE dx(partition_num=2)")
+        c.must("USE dx")
+        c.must("CREATE TAG n(x int)")
+        c.must("CREATE EDGE r(w double)")
+        c.must("INSERT VERTEX n(x) VALUES 1:(1), 2:(2), 3:(3)")
+        c.must("INSERT EDGE r(w) VALUES 1 -> 2:(90.10000001)")
+        c.must("ALTER EDGE r ADD (z int)")   # forces python column build
+        c.must("INSERT EDGE r(w, z) VALUES 1 -> 3:(95.5, 1)")
+        conns.append(c)
+    cpu_conn, tpu_conn = conns
+    q = "GO FROM 1 OVER r WHERE r.w > 90.1 YIELD r._dst"
+    r_cpu = cpu_conn.must(q)
+    r_tpu = tpu_conn.must(q)
+    assert sorted(r_cpu.rows) == sorted(r_tpu.rows) == [(2,), (3,)]
