@@ -34,7 +34,8 @@ the SAME store through the real service layers):
 
 Env knobs: BENCH_V, BENCH_E, BENCH_PARTS, BENCH_SEEDS, BENCH_STEPS,
 BENCH_ITERS, BENCH_BATCH, BENCH_PY_E (python-baseline edge count),
-BENCH_TARGET_ROWS, BENCH_LAT_N.
+BENCH_TARGET_ROWS, BENCH_LAT_N, BENCH_KERNEL (packed|int8|auto —
+auto times both batched-hop variants and reports the faster).
 """
 import json
 import os
@@ -55,6 +56,7 @@ BATCH = int(os.environ.get("BENCH_BATCH", 128))  # concurrent GO queries/dispatc
 PY_E = int(os.environ.get("BENCH_PY_E", 2_000_000))
 TARGET_ROWS = int(os.environ.get("BENCH_TARGET_ROWS", 2_000))
 LAT_N = int(os.environ.get("BENCH_LAT_N", 30))
+KERNEL = os.environ.get("BENCH_KERNEL", "auto")
 
 TS_MAX = 1_000_000_000
 HBM_PEAK_GBS = 819.0   # v5e HBM bandwidth
@@ -219,30 +221,50 @@ def bench_tpu_batched(cluster, tpu, sid, etype, seed_sets):
     req = jnp.asarray(traverse.pad_edge_types([etype]))
     args = (f_batch, jnp.int32(STEPS), ak, req)
     kw = dict(chunk=chunk, group=group)
-    t0 = time.time()
-    counts = np.asarray(traverse.multi_hop_count_batch(*args, **kw))
+    variants = {"int8": traverse.multi_hop_count_batch,
+                "packed": traverse.multi_hop_count_batch_packed}
+    if KERNEL in variants:
+        picks = [KERNEL]
+    else:   # auto: time both, keep the faster for the measured runs
+        picks = list(variants)
+    timed = {}
+    for name in picks:
+        fn = variants[name]
+        t0 = time.time()
+        counts = np.asarray(fn(*args, **kw))
+        log(f"kernel[{name}]: compile+1 {time.time()-t0:.1f}s")
+        t0 = time.time()
+        out = fn(*args, **kw)
+        out.block_until_ready()
+        timed[name] = time.time() - t0
+    pick = min(timed, key=timed.get)
+    kernel_fn = variants[pick]
+    counts = np.asarray(kernel_fn(*args, **kw))
     per_batch = int(counts.sum())
-    log(f"first run (compile): {time.time()-t0:.1f}s, {per_batch} edges "
-        f"traversed per {len(seed_sets)}-query batch (q0={int(counts[0])})")
+    log(f"kernel pick: {pick} ({ {k: round(v*1e3) for k, v in timed.items()} }"
+        f" ms/dispatch), {per_batch} edges per {len(seed_sets)}-query batch "
+        f"(q0={int(counts[0])})")
     t0 = time.time()
     for _ in range(ITERS):
-        out = traverse.multi_hop_count_batch(*args, **kw)
+        out = kernel_fn(*args, **kw)
     out.block_until_ready()
     dt = time.time() - t0
     eps = per_batch * ITERS / dt
     qps = len(seed_sets) * ITERS / dt
-    # modeled HBM traffic per dispatch: the hop reads E_pad 128B frontier
-    # rows + ~3 passes over the [NC,128] i32 chunk sums + boundary rows
+    # modeled HBM traffic per dispatch: the hop reads E_pad frontier
+    # rows (128B int8 / 16B packed) + ~3 passes over the [NC,128] i32
+    # chunk sums + boundary rows
     e_pad = int(ak.src.shape[0])
     ns = int(ak.cbound.shape[0]) - 1
     nc = e_pad // chunk
-    bytes_per_hop = e_pad * 128 * 2 + nc * 128 * 4 * 3 + ns * 128 * 4 * 2
+    row_b = 16 if pick == "packed" else 128
+    bytes_per_hop = e_pad * row_b * 2 + nc * 128 * 4 * 3 + ns * 128 * 4 * 2
     gbs = bytes_per_hop * STEPS * ITERS / dt / 1e9
-    log(f"TPU tier1: {ITERS} x {len(seed_sets)}-query batches of "
+    log(f"TPU tier1[{pick}]: {ITERS} x {len(seed_sets)}-query batches of "
         f"{STEPS}-hop GO in {dt*1000:.1f}ms -> {eps:,.0f} edges/s, "
         f"{qps:,.1f} QPS, modeled HBM {gbs:,.0f} GB/s "
         f"({100*gbs/HBM_PEAK_GBS:.0f}% of {HBM_PEAK_GBS:.0f} peak)")
-    return eps, qps, gbs, int(counts[0]), snap
+    return eps, qps, gbs, int(counts[0]), snap, pick
 
 
 def bench_full_queries(conn, tpu, snap, etype, seed_sets):
@@ -401,7 +423,7 @@ def _ensure_backend():
 def main():
     platform = _ensure_backend()
     cluster, tpu, conn, sid, etype, seed_sets = load_cluster()
-    tpu_eps, tpu_qps, gbs, q0_edges, snap = bench_tpu_batched(
+    tpu_eps, tpu_qps, gbs, q0_edges, snap, kernel_pick = bench_tpu_batched(
         cluster, tpu, sid, etype, seed_sets)
     p50, p99, qps1, cpu_q_ms = bench_full_queries(
         conn, tpu, snap, etype, seed_sets)
@@ -431,6 +453,7 @@ def main():
         "graph": {"V": V, "E_forward": E, "stored_rows": 2 * E,
                   "shape": "LDBC-SNB person/knows, clipped zipf(1.7)"},
         "batch": BATCH,
+        "tier1_kernel": kernel_pick,
         "tier1_qps": round(tpu_qps, 1),
         "tier1_modeled_hbm_gbs": round(gbs, 1),
         "tier1_hbm_util_vs_peak": round(gbs / HBM_PEAK_GBS, 3),

@@ -814,6 +814,7 @@ def _build_columns(schema: Schema, cap: int, rows: "RowsBlock", now: float,
     field_types: Dict[str, PropType] = {f.name: f.type
                                         for f in schema.fields}
     schemas_by_ver: Dict[int, Schema] = {}
+    conflicted: set = set()
     if multi:
         for v in (int(x) for x in uvers):
             sv = schema if v == schema.version else schema_at(v)
@@ -821,7 +822,13 @@ def _build_columns(schema: Schema, cap: int, rows: "RowsBlock", now: float,
                 sv = schema
             schemas_by_ver[v] = sv
             for f in sv.fields:
-                field_types.setdefault(f.name, f.type)
+                prev = field_types.setdefault(f.name, f.type)
+                if prev != f.type:
+                    # a DROP+ADD (or CHANGE) retyped the field across
+                    # versions: per-row values have mixed types — the
+                    # column stays host-only (filters fall back to the
+                    # exact walk; the CPU path reads per-row types)
+                    conflicted.add(f.name)
     names = list(field_types)
     host_cols: Dict[str, List[Any]] = {n: [None] * cap for n in names}
     miss: Optional[Dict[str, np.ndarray]] = (
@@ -842,8 +849,15 @@ def _build_columns(schema: Schema, cap: int, rows: "RowsBlock", now: float,
                 miss[name][idx] = False
     out: Dict[str, PropColumn] = {}
     for name in names:
+        m = miss[name] if miss is not None else None
+        if name in conflicted:
+            vals = host_cols[name]
+            present = np.array([v is not None for v in vals], bool)
+            out[name] = PropColumn(name, field_types[name],
+                                   np.array(vals, dtype=object), False,
+                                   None, present, None, m)
+            continue
         out[name] = _finish_column(
             name, field_types[name], host_cols[name], cap,
-            dict_registry, dict_key,
-            miss[name] if miss is not None else None)
+            dict_registry, dict_key, m)
     return out

@@ -82,8 +82,10 @@ class TpuGraphEngine:
         self._repacking: Dict[int, bool] = {}
         # pull-mode budget: frontiers whose cumulative edge visits stay
         # under this run on host mirrors; larger ones amortize the dense
-        # device dispatch (direction-optimized execution)
-        self.sparse_edge_budget = 1 << 21
+        # device dispatch (direction-optimized execution). Breakeven on
+        # v5e/SNB: the vectorized walk expands ~23M raw edges/s vs a
+        # ~230ms dense batch-1 dispatch -> ~5M edges; 4M leaves margin
+        self.sparse_edge_budget = 1 << 22
         self.stats = {"go_served": 0, "path_served": 0, "rebuilds": 0,
                       "fallbacks": 0, "sharded_queries": 0,
                       "fast_materialize": 0, "slow_materialize": 0,

@@ -131,6 +131,7 @@ class FilterCompiler:
         err = jnp.ones(snap.d_edge_etype.shape, dtype=bool)
         is_string = None
         intlike = None
+        kind = None
         for et in types:
             col = snap.device_edge_prop(et, prop)
             if col is None:
@@ -141,11 +142,17 @@ class FilterCompiler:
                 # diverges from the CPU's exact float64 compare; the
                 # host vectorized evaluator serves doubles instead
                 raise _Unsupported()
-            col_is_string = ptype == PropType.STRING
-            if is_string is None:
+            k = ("strcode" if ptype == PropType.STRING else
+                 "bool" if ptype == PropType.BOOL else "num")
+            col_is_string = k == "strcode"
+            if kind is None:
+                kind = k
                 is_string = col_is_string
                 intlike = True
-            elif is_string != col_is_string:
+            elif kind != k:
+                # a bool/int mix would silently promote bools to
+                # numbers in jnp.where — CPU treats the kinds as
+                # incomparable per row; fall back
                 raise _Unsupported()
             sel = snap.d_edge_etype == et
             cn, ce = self._col_states("e", et, prop, snap.cap_e)
@@ -243,44 +250,10 @@ class FilterCompiler:
                             intlike=v.intlike)
             raise _Unsupported()
         if isinstance(e, ArithmeticExpr):
-            l = self._compile(e.left)
-            r = self._compile(e.right)
-            if l.kind != "num" or r.kind != "num":
-                raise _Unsupported()
-            # CPU _require_num(None) raises -> null operands err
-            err = l.err | r.err | l.null | r.null
-            both_int = l.intlike and r.intlike
-            if e.op == "+":
-                return _Val("num", l.value + r.value, _F, err,
-                            intlike=both_int)
-            if e.op == "-":
-                return _Val("num", l.value - r.value, _F, err,
-                            intlike=both_int)
-            if e.op == "*":
-                return _Val("num", l.value * r.value, _F, err,
-                            intlike=both_int)
-            if e.op in ("/", "%"):
-                # CPU: x/0 and x%0 raise EvalError which drops the row
-                # — fold into err. int/int divides C-style (trunc
-                # toward zero — exact in integer arithmetic, no float
-                # rounding at int32 scale); a static int/float mix
-                # can't pick either branch.
-                if l.intlike is None or r.intlike is None:
-                    raise _Unsupported()
-                a, b = jnp.asarray(l.value), jnp.asarray(r.value)
-                zero = b == 0
-                err = err | zero
-                safe_b = jnp.where(zero, 1, b)
-                if both_int:
-                    qa = jnp.abs(a) // jnp.abs(safe_b)
-                    q = jnp.where((a < 0) ^ (safe_b < 0), -qa, qa)
-                    if e.op == "/":
-                        return _Val("num", q, _F, err, intlike=True)
-                    return _Val("num", a - q * safe_b, _F, err,
-                                intlike=True)
-                if e.op == "%":
-                    raise _Unsupported()  # CPU: % requires integers
-                return _Val("num", a / safe_b, _F, err, intlike=False)
+            # device int arithmetic runs in int32 and would WRAP where
+            # the CPU's python ints don't (age * 10^8 flips sign) —
+            # arithmetic filters go to the vectorized int64 host
+            # evaluator instead
             raise _Unsupported()
         if isinstance(e, RelationalExpr):
             # CPU null rules (expressions.py RelationalExpr.eval): the
@@ -312,6 +285,16 @@ class FilterCompiler:
                 (l.kind == "num" and r.kind == "num")
             if not eq_kinds:
                 raise _Unsupported()
+            for side in (l, r):
+                if isinstance(side.value, float):
+                    # a float literal against the int32 device mirror
+                    # would compare in float32; CPU compares in exact
+                    # float64 — host evaluator serves it
+                    raise _Unsupported()
+                if isinstance(side.value, int) and not isinstance(
+                        side.value, bool) and not (
+                        -(1 << 31) <= side.value < (1 << 31)):
+                    raise _Unsupported()  # literal outside int32 range
             ops = {"==": lambda a, b: a == b, "!=": lambda a, b: a != b,
                    "<": lambda a, b: a < b, "<=": lambda a, b: a <= b,
                    ">": lambda a, b: a > b, ">=": lambda a, b: a >= b}

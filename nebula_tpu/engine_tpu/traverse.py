@@ -438,10 +438,17 @@ class AlignedKernel(NamedTuple):
     queries, ~5x at the full 128 lanes — the remaining cost is the [E]
     random row-gather, which runs at the TPU gather-engine rate
     (~300K rows/ms) independent of row width up to 128 bytes.
+
+    deg_types/degs: out-degree of every source slot per signed edge
+    type over the kernel's REAL edges — lets the packed-frontier
+    variant count edges-per-lane as one [n_slots] dot against the
+    frontier matrix instead of summing at the edge level.
     """
     src: jnp.ndarray     # int32[E_pad] global src slot; dead -> n_slots
     etype: jnp.ndarray   # int32[E_pad] signed type; padding -> 0
     cbound: jnp.ndarray  # int32[n_slots+1] chunk index of each segment start
+    deg_types: jnp.ndarray  # int32[T] signed types present in the graph
+    degs: jnp.ndarray    # int32[T, n_slots] per-type out-degree per slot
 
 
 def pick_chunk(n_edges: int) -> Tuple[int, int]:
@@ -488,8 +495,19 @@ def build_aligned(gsrc: np.ndarray, etype: np.ndarray, gdst: np.ndarray,
         a_src[pos] = gsrc[order]
         a_etype[pos] = etype[order]
     cbound = (astart // chunk).astype(np.int32)
+    # per-signed-type out-degrees over the REAL edges (the packed
+    # variant's count input)
+    r_src, r_et = gsrc[order], etype[order]
+    types = np.unique(r_et) if nreal else np.zeros(0, np.int32)
+    degs = np.zeros((max(len(types), 1), n_slots), np.int32)
+    for ti, t in enumerate(types):
+        degs[ti] = np.bincount(r_src[r_et == t],
+                               minlength=n_slots)[:n_slots]
+    deg_types = np.zeros(max(len(types), 1), np.int32)
+    deg_types[:len(types)] = types
     return (AlignedKernel(jnp.asarray(a_src), jnp.asarray(a_etype),
-                          jnp.asarray(cbound)), chunk, group)
+                          jnp.asarray(cbound), jnp.asarray(deg_types),
+                          jnp.asarray(degs)), chunk, group)
 
 
 @partial(jax.jit, static_argnames=("chunk", "group"))
@@ -550,6 +568,87 @@ def multi_hop_count_batch(frontiers0: jnp.ndarray, steps: jnp.ndarray,
         total = total + (grp_exc[-1] + grp_tot[-1]).astype(jnp.int64)
         # exclusive prefix AT the boundaries only (never materializing
         # the full [nc, LANES] scan): grp_exc[g] + within-group prefix
+        local_prev = jnp.where(
+            (j_idx > 0)[:, None],
+            local_inc[g_idx, jnp.maximum(j_idx - 1, 0)], 0)
+        Sv = grp_exc[g_idx] + local_prev         # [ns+1, LANES]
+        hits = (Sv[1:] - Sv[:-1]) > 0
+        return jnp.pad(hits.astype(jnp.int8), ((0, 1), (0, 0))), total
+
+    _, total = lax.fori_loop(0, steps, body,
+                             (F, jnp.zeros((LANES,), jnp.int64)))
+    return total[:B]
+
+
+@partial(jax.jit, static_argnames=("chunk", "group"))
+def multi_hop_count_batch_packed(frontiers0: jnp.ndarray,
+                                 steps: jnp.ndarray, ak: AlignedKernel,
+                                 req_types: jnp.ndarray,
+                                 chunk: int = C_ALIGN,
+                                 group: int = G_ALIGN) -> jnp.ndarray:
+    """multi_hop_count_batch with BITPACKED frontier rows: the per-hop
+    [E_pad] gather reads 16-byte uint32x4 rows (128 lanes as bits)
+    instead of 128-byte int8 rows — 8x less gather traffic on the
+    random-access bottleneck. Per-chunk lane hits come from a bitwise
+    OR over the chunk (a chunk crossing a frontier lane >= once is all
+    the advance needs), unpacked to {0,1} per lane only at CHUNK
+    granularity (nc rows, not E_pad) for the same two-level prefix +
+    boundary-diff as the int8 variant.
+
+    Edges-traversed counts drop out of the edge level entirely: per
+    hop, count[lane] = sum_v deg_req[v] * frontier[v, lane] — one dot
+    against the per-slot requested-type out-degrees carried by the
+    kernel (ak.degs), identical by construction to summing gathered
+    actives.
+
+    Semantics and signature match multi_hop_count_batch exactly.
+    """
+    B = frontiers0.shape[0]
+    if B > LANES:
+        raise ValueError(f"batch {B} > {LANES} lanes per dispatch")
+    ns = ak.cbound.shape[0] - 1
+    e_pad = ak.src.shape[0]
+    span = chunk * group
+    nb = max(1, -(-e_pad // (1 << 23)))          # ~8M edges per block
+    blk = -(-e_pad // nb // span) * span
+    tot = nb * blk
+    nc = tot // chunk
+    ng = nc // group
+    F = jnp.zeros((ns + 1, LANES), jnp.int8)
+    F = F.at[:ns, :B].set(frontiers0.reshape(B, -1).T.astype(jnp.int8))
+    ok = (ak.etype[None] == req_types[:, None]).any(axis=0)
+    src_eff = jnp.pad(jnp.where(ok, ak.src, ns), (0, tot - e_pad),
+                      constant_values=ns).reshape(nb, blk)
+    g_idx = ak.cbound // group
+    j_idx = ak.cbound % group
+    tmask = (ak.deg_types[:, None] == req_types[None, :]).any(axis=1)
+    deg_req = (ak.degs * tmask[:, None].astype(ak.degs.dtype)).sum(axis=0)
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+
+    def body(_, state):
+        f, total = state
+        # edges leaving the CURRENT frontier, per lane (int32 is safe:
+        # one hop's count is bounded by E_pad < 2^31)
+        cnt = (f[:ns].astype(jnp.int32) * deg_req[:, None]).sum(
+            axis=0, dtype=jnp.int32)
+        total = total + cnt.astype(jnp.int64)
+        # lanes -> bits: word w holds lanes [32w, 32w+32)
+        packed = (jnp.left_shift(
+            f.astype(jnp.uint32).reshape(ns + 1, 4, 32),
+            shifts[None, None, :])).sum(axis=2, dtype=jnp.uint32)
+
+        def block_or(sb):                        # fused gather + chunk OR
+            rows = packed[sb].reshape(blk // chunk, chunk, 4)
+            return lax.reduce(rows, jnp.uint32(0), lax.bitwise_or, (1,))
+
+        cs = lax.map(block_or, src_eff).reshape(nc, 4)
+        u = ((cs[:, :, None] >> shifts[None, None, :])
+             & jnp.uint32(1)).reshape(nc, LANES).astype(jnp.int8)
+        local_inc = jnp.cumsum(u.reshape(ng, group, LANES), axis=1,
+                               dtype=jnp.int32)
+        grp_tot = local_inc[:, -1]
+        grp_exc = jnp.pad(jnp.cumsum(grp_tot, axis=0),
+                          ((1, 0), (0, 0)))[:-1]
         local_prev = jnp.where(
             (j_idx > 0)[:, None],
             local_inc[g_idx, jnp.maximum(j_idx - 1, 0)], 0)

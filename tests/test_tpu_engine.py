@@ -201,7 +201,8 @@ def test_batched_count_identity(pair):
     import jax.numpy as jnp
     import numpy as np
     from nebula_tpu.engine_tpu import traverse
-    _, _, tpu = pair
+    _, tpu_conn, tpu = pair
+    tpu_conn.must("GO FROM 100 OVER like")   # force the snapshot
     snap = list(tpu._snapshots.values())[0]
     seeds = [[100], [101, 102], [103, 104, 105], [100, 110]]
     f_batch = jnp.asarray(np.stack(
@@ -602,3 +603,70 @@ def test_double_filter_exactness_after_alter():
     r_cpu = cpu_conn.must(q)
     r_tpu = tpu_conn.must(q)
     assert sorted(r_cpu.rows) == sorted(r_tpu.rows) == [(2,), (3,)]
+
+
+def test_batched_count_packed_identity(pair):
+    """The bitpacked batched kernel counts exactly what the int8
+    variant and per-query multi_hop_count count."""
+    import jax.numpy as jnp
+    from nebula_tpu.engine_tpu import traverse
+    _, tpu_conn, tpu = pair
+    tpu_conn.must("GO FROM 100 OVER like")   # force the snapshot
+    snap = list(tpu._snapshots.values())[0]
+    seeds = [[100], [101, 102], [103, 104, 105], [100, 110]]
+    f_batch = jnp.asarray(np.stack(
+        [snap.frontier_from_vids(s) for s in seeds]))
+    for req_list in ([1], [1, -1], [1, 2]):
+        req = jnp.asarray(traverse.pad_edge_types(req_list))
+        for steps in (1, 2, 3):
+            ak, chunk, group = snap.aligned_kernel()
+            packed = np.asarray(traverse.multi_hop_count_batch_packed(
+                f_batch, jnp.int32(steps), ak, req, chunk=chunk,
+                group=group))
+            for i, s in enumerate(seeds):
+                single = int(traverse.multi_hop_count(
+                    jnp.asarray(snap.frontier_from_vids(s)),
+                    jnp.int32(steps), snap.kernel, req))
+                assert int(packed[i]) == single, \
+                    (req_list, steps, s, packed[i], single)
+
+
+def test_device_filter_width_and_retype_identity():
+    """Identity hazards found in review: int32-wrapping arithmetic and
+    out-of-range literals must not be evaluated through the device
+    mirrors, and a DROP+ADD retyped field must not break the snapshot
+    build (its column goes host-only)."""
+    tpu = TpuGraphEngine()
+    tpu.sparse_edge_budget = 0     # force the dense device path
+    conns = []
+    for cluster in (InProcCluster(), InProcCluster(tpu_engine=tpu)):
+        c = cluster.connect()
+        c.must("CREATE SPACE wd(partition_num=2)")
+        c.must("USE wd")
+        c.must("CREATE TAG n(age int)")
+        c.must("CREATE EDGE r(w int)")
+        c.must("INSERT VERTEX n(age) VALUES 1:(40), 2:(20), 3:(30)")
+        c.must("INSERT EDGE r(w) VALUES 1 -> 2:(7), 1 -> 3:(3)")
+        conns.append(c)
+    cpu_conn, tpu_conn = conns
+    for q in [
+        # int32-wrapping product (4e9 > 2^31)
+        "GO FROM 1 OVER r WHERE $^.n.age * 100000000 > 0 YIELD r._dst",
+        # literal outside int32 range
+        "GO FROM 1 OVER r WHERE r.w < 5000000000 YIELD r._dst",
+        # float literal against an int prop
+        "GO FROM 1 OVER r WHERE r.w > 2.5 YIELD r._dst",
+    ]:
+        r_cpu = cpu_conn.must(q)
+        r_tpu = tpu_conn.must(q)
+        assert sorted(r_cpu.rows) == sorted(r_tpu.rows), q
+        assert len(r_tpu.rows) > 0, q   # the guards must not drop rows
+    # retype via DROP+ADD: old rows keep int values, new rows string
+    for c in (cpu_conn, tpu_conn):
+        c.must("ALTER EDGE r DROP (w)")
+        c.must("ALTER EDGE r ADD (w string)")
+        c.must('INSERT EDGE r(w) VALUES 1 -> 3:("high")')
+    q = "GO FROM 1 OVER r YIELD r._dst"
+    r_cpu = cpu_conn.must(q)
+    r_tpu = tpu_conn.must(q)
+    assert sorted(map(repr, r_cpu.rows)) == sorted(map(repr, r_tpu.rows))
