@@ -233,10 +233,13 @@ def bench_tpu_batched(cluster, tpu, sid, etype, seed_sets):
         t0 = time.time()
         counts = np.asarray(fn(*args, **kw))
         log(f"kernel[{name}]: compile+1 {time.time()-t0:.1f}s")
-        t0 = time.time()
-        out = fn(*args, **kw)
-        out.block_until_ready()
-        timed[name] = time.time() - t0
+        best = float("inf")      # min-of-3: one scheduling hiccup must
+        for _ in range(3):       # not mispick the measured kernel
+            t0 = time.time()
+            out = fn(*args, **kw)
+            out.block_until_ready()
+            best = min(best, time.time() - t0)
+        timed[name] = best
     pick = min(timed, key=timed.get)
     kernel_fn = variants[pick]
     counts = np.asarray(kernel_fn(*args, **kw))

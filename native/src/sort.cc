@@ -1,0 +1,74 @@
+// Parallel stable counting sort for small-range uint32 keys.
+//
+// The device kernel layouts (engine_tpu/traverse.py build_kernel /
+// build_aligned) need a stable sort of ~10^8 edges by destination
+// slot, where the key range is only ~10^6 (n_slots+1). numpy's stable
+// argsort is a comparison sort (~100s at SNB scale); key-range
+// counting sort is O(E) and embarrassingly parallel: each thread
+// histograms its slice, a (thread, key) prefix pass assigns exact
+// placement offsets, and each thread scatters its slice in order —
+// stability follows from threads owning contiguous, ordered slices.
+// Role parity: the reference leans on RocksDB's native sorted storage
+// for this ordering; here the sort feeds the TPU edge layout instead.
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Stable sort permutation of keys (values in [0, n_keys)): fills
+// order_out[n] with indices such that keys[order_out] is
+// non-decreasing and equal keys keep input order. Returns 0, or -1 on
+// bad arguments (a key >= n_keys).
+int nsort_counting_u32(const uint32_t* keys, int64_t n, int64_t n_keys,
+                       int64_t* order_out, int threads) {
+  if (n <= 0) return 0;
+  if (threads < 1) threads = 1;
+  if (threads > 64) threads = 64;
+  int64_t chunk = (n + threads - 1) / threads;
+  std::vector<std::vector<int64_t>> hist(
+      threads, std::vector<int64_t>(n_keys, 0));
+  std::vector<int> bad(threads, 0);
+  {
+    std::vector<std::thread> ts;
+    for (int t = 0; t < threads; ++t) {
+      ts.emplace_back([&, t]() {
+        int64_t lo = t * chunk, hi = std::min<int64_t>(n, lo + chunk);
+        auto& h = hist[t];
+        for (int64_t i = lo; i < hi; ++i) {
+          uint32_t k = keys[i];
+          if (k >= n_keys) { bad[t] = 1; return; }
+          ++h[k];
+        }
+      });
+    }
+    for (auto& th : ts) th.join();
+  }
+  for (int t = 0; t < threads; ++t)
+    if (bad[t]) return -1;
+  // exclusive running offset in (key-major, thread-minor) order
+  int64_t run = 0;
+  for (int64_t k = 0; k < n_keys; ++k) {
+    for (int t = 0; t < threads; ++t) {
+      int64_t c = hist[t][k];
+      hist[t][k] = run;
+      run += c;
+    }
+  }
+  {
+    std::vector<std::thread> ts;
+    for (int t = 0; t < threads; ++t) {
+      ts.emplace_back([&, t]() {
+        int64_t lo = t * chunk, hi = std::min<int64_t>(n, lo + chunk);
+        auto& off = hist[t];
+        for (int64_t i = lo; i < hi; ++i)
+          order_out[off[keys[i]]++] = i;
+      });
+    }
+    for (auto& th : ts) th.join();
+  }
+  return 0;
+}
+
+}  // extern "C"

@@ -1,0 +1,185 @@
+"""Pooled client sessions over one or more graphd endpoints.
+
+Role parity with the reference's richer client surface (the Java
+client's connection-pool + session model, ref client/java/; the C++
+GraphClient stays the thin single-connection form in __init__.py):
+
+- `ConnectionPool([addr, ...])` — round-robin endpoint selection with
+  per-endpoint health state; a failed endpoint is quarantined and
+  retried after `retry_after` seconds.
+- `pool.session(user, password)` — authenticated Session handle.
+  Sessions auto-reconnect: on a transport error (graphd restart,
+  network blip) the next execute() re-authenticates — possibly on a
+  different healthy endpoint — and retries the statement once.
+- Sessions are context managers and sign out on close.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from ..common.status import ErrorCode, NebulaError
+from ..graph.context import ExecutionResponse
+from ..rpc import proxy
+
+
+class NoHealthyGraphd(RuntimeError):
+    def __init__(self, detail: str):
+        super().__init__(f"no healthy graphd endpoint: {detail}")
+
+
+class _Endpoint:
+    __slots__ = ("addr", "down_until")
+
+    def __init__(self, addr: str):
+        self.addr = addr
+        self.down_until = 0.0
+
+
+class ConnectionPool:
+    """Round-robin over graphd endpoints with failure quarantine."""
+
+    def __init__(self, addrs: List[str], timeout: Optional[float] = 30.0,
+                 retry_after: float = 3.0):
+        if not addrs:
+            raise ValueError("ConnectionPool needs at least one address")
+        self._eps = [_Endpoint(a) for a in addrs]
+        self._timeout = timeout
+        self._retry_after = retry_after
+        self._next = 0
+        self._lock = threading.Lock()
+
+    # -- endpoint selection -------------------------------------------
+    def _pick(self) -> _Endpoint:
+        now = time.monotonic()
+        with self._lock:
+            n = len(self._eps)
+            for _ in range(n):
+                ep = self._eps[self._next % n]
+                self._next += 1
+                if ep.down_until <= now:
+                    return ep
+            # all quarantined: least-recently-failed gets the probe
+            return min(self._eps, key=lambda e: e.down_until)
+
+    def _mark_down(self, ep: _Endpoint) -> None:
+        with self._lock:
+            ep.down_until = time.monotonic() + self._retry_after
+
+    # -- public -------------------------------------------------------
+    def session(self, user: str = "root", password: str = "") -> "Session":
+        """Authenticate against a healthy endpoint -> Session."""
+        s = Session(self, user, password)
+        s._ensure_connected()
+        return s
+
+    def _connect_once(self, user: str, password: str):
+        """-> (rpc client, endpoint, session_id); raises on total
+        failure (every endpoint tried once)."""
+        last = None
+        for _ in range(len(self._eps)):
+            ep = self._pick()
+            try:
+                rpc = proxy(ep.addr, "graph", timeout=self._timeout)
+                r = rpc.authenticate(user, password)
+            except Exception as e:           # transport-level failure
+                self._mark_down(ep)
+                last = e
+                continue
+            if not r.ok():
+                raise NebulaError(r.status)  # bad credentials: no retry
+            return rpc, ep, r.value()
+        raise NoHealthyGraphd(repr(last))
+
+
+class Session:
+    """One authenticated session; survives graphd restarts by
+    re-authenticating on the next call (the session id itself is NOT
+    preserved across reconnects — server-side session state such as
+    USE <space> must be re-established, matching the reference client's
+    reconnect contract)."""
+
+    def __init__(self, pool: ConnectionPool, user: str, password: str):
+        self._pool = pool
+        self._user = user
+        self._password = password
+        self._rpc = None
+        self._ep = None
+        self._session_id: Optional[int] = None
+        self._space: Optional[str] = None
+
+    # -- connection management ----------------------------------------
+    def _ensure_connected(self) -> None:
+        if self._session_id is not None:
+            return
+        self._rpc, self._ep, self._session_id = \
+            self._pool._connect_once(self._user, self._password)
+        if self._space:
+            r = self._rpc.execute(self._session_id, f"USE {self._space}")
+            if r.code != ErrorCode.SUCCEEDED:
+                self._space = None
+
+    def _drop_connection(self) -> None:
+        if self._ep is not None:
+            self._pool._mark_down(self._ep)
+        self._rpc = None
+        self._ep = None
+        self._session_id = None
+
+    # -- public -------------------------------------------------------
+    def execute(self, stmt: str) -> ExecutionResponse:
+        """Run one statement; on a transport error, reconnect (possibly
+        to another endpoint) and retry the statement once."""
+        for attempt in (0, 1):
+            try:
+                self._ensure_connected()
+                resp = self._rpc.execute(self._session_id, stmt)
+            except Exception:
+                self._drop_connection()
+                if attempt:
+                    raise
+                continue
+            if resp.code == ErrorCode.E_SESSION_INVALID and not attempt:
+                # graphd restarted but the transport survived: new session
+                self._session_id = None
+                continue
+            # track USE so a reconnect can restore the working space
+            if resp.code == ErrorCode.SUCCEEDED:
+                s = stmt.strip()
+                if s.upper().startswith("USE "):
+                    self._space = s[4:].strip().rstrip(";").strip()
+            return resp
+        raise AssertionError("unreachable")
+
+    def must(self, stmt: str) -> ExecutionResponse:
+        resp = self.execute(stmt)
+        if resp.code != ErrorCode.SUCCEEDED:
+            raise RuntimeError(
+                f"query failed [{resp.code.name}]: {resp.error_msg}\n"
+                f"  query: {stmt}")
+        return resp
+
+    def ping(self) -> bool:
+        try:
+            return self.execute("SHOW SPACES").code == ErrorCode.SUCCEEDED
+        except Exception:
+            return False
+
+    def release(self) -> None:
+        if self._session_id is not None and self._rpc is not None:
+            try:
+                self._rpc.signout(self._session_id)
+            except Exception:
+                pass
+        self._rpc = None
+        self._session_id = None
+
+    def __enter__(self) -> "Session":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.release()
+
+
+__all__ = ["ConnectionPool", "Session", "NoHealthyGraphd"]

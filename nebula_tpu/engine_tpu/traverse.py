@@ -49,6 +49,20 @@ from jax import lax
 MAX_EDGE_TYPES_PER_QUERY = 8  # fixed width so type sets don't retrace
 
 
+def _stable_sort_by(keys: np.ndarray, n_keys: int) -> np.ndarray:
+    """Stable argsort of small-range non-negative keys: the native
+    parallel counting sort when available (O(E), ~6x numpy at 50M and
+    growing with size), else numpy's comparison sort."""
+    try:
+        from .. import native
+        order = native.stable_counting_sort(keys, n_keys)
+        if order is not None:
+            return order
+    except Exception:
+        pass
+    return np.argsort(keys, kind="stable")
+
+
 def pad_edge_types(edge_types: List[int]) -> np.ndarray:
     """Pad the requested signed-type list to fixed width with 0
     (0 is never a valid edge type)."""
@@ -112,7 +126,7 @@ def build_kernel(edge_src: np.ndarray, edge_etype: np.ndarray,
     for b in range(num_blocks):
         sl = slice(b * bp, (b + 1) * bp)
         flat_g = edge_gidx[sl].reshape(-1)
-        order = np.argsort(flat_g, kind="stable")
+        order = _stable_sort_by(flat_g, n + 1)
         sorted_g = flat_g[order]
         if orders_out is not None:
             orders_out.append(order)
@@ -473,7 +487,7 @@ def build_aligned(gsrc: np.ndarray, etype: np.ndarray, gdst: np.ndarray,
     (gdst = dump >= n_slots for invalid/padded edges, which are
     dropped). -> (kernel, chunk, group) — chunk/group are static
     parameters of the matching multi_hop_count_batch call."""
-    order = np.argsort(gdst, kind="stable")
+    order = _stable_sort_by(gdst, n_slots + 1)
     sg = gdst[order]
     nreal = int(np.searchsorted(sg, n_slots))
     if chunk is None:
@@ -496,14 +510,19 @@ def build_aligned(gsrc: np.ndarray, etype: np.ndarray, gdst: np.ndarray,
         a_etype[pos] = etype[order]
     cbound = (astart // chunk).astype(np.int32)
     # per-signed-type out-degrees over the REAL edges (the packed
-    # variant's count input)
+    # variant's count input) — ONE combined bincount over
+    # type_index*n_slots + src, not a pass per type
     r_src, r_et = gsrc[order], etype[order]
     types = np.unique(r_et) if nreal else np.zeros(0, np.int32)
-    degs = np.zeros((max(len(types), 1), n_slots), np.int32)
-    for ti, t in enumerate(types):
-        degs[ti] = np.bincount(r_src[r_et == t],
-                               minlength=n_slots)[:n_slots]
-    deg_types = np.zeros(max(len(types), 1), np.int32)
+    nt = max(len(types), 1)
+    if nreal:
+        ti = np.searchsorted(types, r_et).astype(np.int64)
+        degs = np.bincount(ti * n_slots + r_src,
+                           minlength=nt * n_slots).reshape(
+            nt, n_slots).astype(np.int32)
+    else:
+        degs = np.zeros((nt, n_slots), np.int32)
+    deg_types = np.zeros(nt, np.int32)
     deg_types[:len(types)] = types
     return (AlignedKernel(jnp.asarray(a_src), jnp.asarray(a_etype),
                           jnp.asarray(cbound), jnp.asarray(deg_types),

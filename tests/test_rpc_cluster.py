@@ -372,3 +372,61 @@ def test_wrong_cluster_storaged_refuses_traffic(tmp_path):
     finally:
         s.stop()
         metad.stop()
+
+
+# ---------------------------------------------------------------------------
+# pooled client sessions (client/pool.py — the Java-client pool role)
+# ---------------------------------------------------------------------------
+
+def test_pool_session_round_robin_and_reconnect(cluster):
+    from nebula_tpu.client.pool import ConnectionPool
+
+    metad, _, _ = cluster
+    # dedicated graphds — the reconnect half kills one of them, and the
+    # module-scoped fixture daemon must stay up for later tests
+    graphd = serve_graphd(metad.addr)
+    g2 = serve_graphd(metad.addr)
+    try:
+        pool = ConnectionPool([graphd.addr, g2.addr], retry_after=0.2)
+        with pool.session() as s:
+            assert s.must("SHOW SPACES").code.name == "SUCCEEDED"
+            s.must("CREATE SPACE IF NOT EXISTS poolsp(partition_num=2)")
+            s.must("USE poolsp")
+            s.must("CREATE TAG IF NOT EXISTS t(x int)")
+            # sessions from the pool round-robin across endpoints
+            with pool.session() as s2:
+                assert s2.ping()
+                assert s2._ep.addr != s._ep.addr
+            # kill THIS session's endpoint: the next execute must
+            # re-authenticate against the surviving one and restore
+            # the working space (USE is replayed on reconnect)
+            dead = s._ep.addr
+            (graphd if s._ep.addr == graphd.addr else g2).stop()
+            r = s.must('INSERT VERTEX t(x) VALUES 1:(10)')
+            assert s._ep.addr != dead
+            assert s.must("FETCH PROP ON t 1").rows
+    finally:
+        for h in (graphd, g2):
+            try:
+                h.stop()
+            except Exception:
+                pass
+
+
+def test_pool_no_healthy_endpoint():
+    from nebula_tpu.client.pool import ConnectionPool, NoHealthyGraphd
+
+    pool = ConnectionPool(["127.0.0.1:1", "127.0.0.1:2"], timeout=0.5,
+                          retry_after=0.1)
+    with pytest.raises(NoHealthyGraphd):
+        pool.session()
+
+
+def test_pool_bad_credentials(cluster):
+    from nebula_tpu.client.pool import ConnectionPool
+    from nebula_tpu.common.status import NebulaError
+
+    _, _, graphd = cluster
+    pool = ConnectionPool([graphd.addr])
+    with pytest.raises(NebulaError):
+        pool.session("root", "wrong-password")
