@@ -108,9 +108,16 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
                    load_interval: float = 0.2,
                    cluster_id_file: str = "",
                    replicated: bool = False,
-                   data_dir: Optional[str] = None) -> StoragedHandle:
+                   data_dir: Optional[str] = None,
+                   advertise_host: Optional[str] = None) -> StoragedHandle:
     server = RpcServer(host, port)
+    # the address REGISTERED with metad (and dialed by graphd + raft
+    # peers) must be routable from other hosts — binding to 0.0.0.0 in
+    # a container needs a separate advertised hostname, or every peer
+    # would dial its own loopback
     addr = server.addr
+    if advertise_host:
+        addr = f"{advertise_host}:{addr.rsplit(':', 1)[1]}"
     raft_server = None
     node = None
     if replicated:
@@ -223,13 +230,18 @@ def main(argv=None) -> None:
                          "(raft listens on port+1)")
     ap.add_argument("--data-dir", default=None,
                     help="WAL/engine root for replicated mode")
+    ap.add_argument("--advertise-host", default=None,
+                    help="hostname to register with metad when binding "
+                         "a wildcard address (containers: the service "
+                         "hostname)")
     args = ap.parse_args(argv)
     if args.flagfile:
         storage_flags.load_flagfile(args.flagfile)
     ws = None if args.ws_port < 0 else args.ws_port
     h = serve_storaged(args.meta, args.host, args.port, ws_port=ws,
                        cluster_id_file=args.cluster_id_file,
-                       replicated=args.replicated, data_dir=args.data_dir)
+                       replicated=args.replicated, data_dir=args.data_dir,
+                       advertise_host=args.advertise_host)
     print(f"storaged listening on {h.addr} (meta {args.meta}, "
           f"http {h.ws_port})")
     try:

@@ -430,3 +430,21 @@ def test_pool_bad_credentials(cluster):
     pool = ConnectionPool([graphd.addr])
     with pytest.raises(NebulaError):
         pool.session("root", "wrong-password")
+
+
+def test_storaged_advertise_host(cluster):
+    """Binding a wildcard address must not leak 0.0.0.0 into the meta
+    registry: --advertise-host overrides the registered address while
+    the bind address keeps serving (the container deployment shape)."""
+    metad, _, _ = cluster
+    h = serve_storaged(metad.addr, host="0.0.0.0", load_interval=0.1,
+                       advertise_host="127.0.0.1")
+    try:
+        port = int(h.addr.rsplit(":", 1)[1])
+        _wait(lambda: f"127.0.0.1:{port}" in
+              {hi.host for hi in metad.meta.active_hosts()},
+              msg="advertised host registration")
+        hosts = {hi.host for hi in metad.meta.active_hosts()}
+        assert not any(a.startswith("0.0.0.0") for a in hosts), hosts
+    finally:
+        h.stop()
