@@ -633,6 +633,24 @@ def _ver_schema(getter, space_id: int, type_id: int,
     return r.value() if r.ok() else None
 
 
+def _ttl_dead(schema: Schema, i64: np.ndarray, f64: np.ndarray,
+              nulls: np.ndarray, now: float) -> np.ndarray:
+    """TTL-expired mask over decoded column buffers (shared by the
+    single- and multi-version native paths). Only numeric ttl cols
+    expire — the Python/storage paths treat a non-numeric ttl value as
+    never-expired (their isinstance check admits int/float/bool, so
+    BOOL stays in the numeric set here)."""
+    if schema.ttl_col and schema.ttl_duration > 0:
+        ti = schema.field_index(schema.ttl_col)
+        if ti >= 0 and schema.fields[ti].type in (
+                PropType.INT, PropType.VID, PropType.TIMESTAMP,
+                PropType.DOUBLE, PropType.BOOL):
+            tt = schema.fields[ti].type
+            tv = f64[ti] if tt == PropType.DOUBLE else i64[ti]
+            return (~nulls[ti]) & (tv + schema.ttl_duration < now)
+    return np.zeros(nulls.shape[1], bool)
+
+
 def _native_build_columns(schema: Schema, cap: int, rows: "RowsBlock",
                           now: float, dict_registry: Dict, dict_key: Tuple
                           ) -> Optional[Dict[str, PropColumn]]:
@@ -653,18 +671,9 @@ def _native_build_columns(schema: Schema, cap: int, rows: "RowsBlock",
     except Exception:
         return None
     # TTL: a row whose ttl prop expired is invisible — null every field
-    if schema.ttl_col and schema.ttl_duration > 0:
-        ti = schema.field_index(schema.ttl_col)
-        # only numeric ttl cols expire — the Python/storage paths treat a
-        # non-numeric ttl value as never-expired (their isinstance check
-        # admits int/float/bool, so BOOL stays in the numeric set here)
-        if ti >= 0 and schema.fields[ti].type in (
-                PropType.INT, PropType.VID, PropType.TIMESTAMP,
-                PropType.DOUBLE, PropType.BOOL):
-            tt = schema.fields[ti].type
-            tv = f64[ti] if tt == PropType.DOUBLE else i64[ti]
-            expired = (~nulls[ti]) & (tv + schema.ttl_duration < now)
-            nulls[:, expired] = True
+    expired = _ttl_dead(schema, i64, f64, nulls, now)
+    if expired.any():
+        nulls[:, expired] = True
     # strings decode strictly up front; a row with invalid UTF-8 becomes
     # wholly invisible, matching the Python path's whole-row skip on
     # decode failure
@@ -779,6 +788,140 @@ def _finish_column(name: str, t: PropType, vals: List[Any], cap: int,
                       str_dict, missing)
 
 
+def _native_build_columns_multi(schemas_by_ver: Dict[int, Schema],
+                                field_types: Dict[str, PropType],
+                                conflicted: set, cap: int,
+                                rows: "RowsBlock", vers: np.ndarray,
+                                now: float, dict_registry: Dict,
+                                dict_key: Tuple
+                                ) -> Optional[Dict[str, PropColumn]]:
+    """Mixed-version fast path: one nbc_decode_batch call PER VERSION
+    GROUP (each with its version's field list), merged into union
+    columns with `missing` masks — a post-ALTER space rebuilds at
+    native speed instead of per-row Python. Semantics mirror the
+    python multi path: TTL-expired / undecodable rows are invisible
+    (missing), cells whose row version lacks the field are missing,
+    retyped (conflicted) fields stay host-only."""
+    from .. import native
+    if not native.available():
+        return None
+    names = list(field_types)
+    miss = {n: np.ones(cap, bool) for n in names}
+    pres = {n: np.zeros(cap, bool) for n in names}
+    val64 = {}
+    valf = {}
+    valb = {}
+    str_cells: Dict[str, Dict[int, str]] = {}
+    obj = {n: np.empty(cap, object) for n in conflicted}
+    for n, t in field_types.items():
+        if n in conflicted:
+            continue
+        if t in (PropType.INT, PropType.VID, PropType.TIMESTAMP):
+            val64[n] = np.zeros(cap, np.int64)
+        elif t == PropType.DOUBLE:
+            valf[n] = np.zeros(cap, np.float64)
+        elif t == PropType.BOOL:
+            valb[n] = np.zeros(cap, bool)
+        elif t == PropType.STRING:
+            str_cells[n] = {}
+        else:
+            return None   # unsupported type: python path decides
+    for ver, sv in schemas_by_ver.items():
+        sel = np.nonzero(vers == ver)[0]
+        if not len(sel) or not sv.fields:
+            continue
+        sub_idx = rows.idxs[sel]
+        try:
+            i64, f64, soff, slen, nulls, blob = native.decode_rows(
+                [f.type.value for f in sv.fields], rows.blob,
+                rows.offs[sel], rows.lens[sel], sub_idx, cap)
+        except Exception:
+            return None
+        covered = sub_idx.astype(np.int64)
+        # rows of THIS group gone invisible (TTL / bad UTF-8)
+        dead = _ttl_dead(sv, i64, f64, nulls, now)
+        # strings decode strictly; invalid UTF-8 kills the whole row
+        # (the python path's whole-row skip on decode failure)
+        group_strs: Dict[int, Dict[int, str]] = {}
+        for fi, f in enumerate(sv.fields):
+            if f.type != PropType.STRING:
+                continue
+            vals: Dict[int, str] = {}
+            for i in covered[~nulls[fi][covered] & ~dead[covered]]:
+                i = int(i)
+                b = blob[soff[fi, i]:soff[fi, i] + slen[fi, i]]
+                try:
+                    vals[i] = b.decode("utf-8")
+                except UnicodeDecodeError:
+                    dead[i] = True
+            group_strs[fi] = vals
+        alive = covered[~dead[covered]]
+        for fi, f in enumerate(sv.fields):
+            n = f.name
+            p = ~nulls[fi][alive]
+            miss[n][alive] = False
+            pres[n][alive] = p
+            t = f.type
+            if n in conflicted:
+                if t in (PropType.INT, PropType.VID, PropType.TIMESTAMP):
+                    obj[n][alive] = i64[fi][alive]
+                elif t == PropType.DOUBLE:
+                    obj[n][alive] = f64[fi][alive]
+                elif t == PropType.BOOL:
+                    obj[n][alive] = i64[fi][alive] != 0
+                elif t == PropType.STRING:
+                    for i, s in group_strs[fi].items():
+                        if not dead[i]:
+                            obj[n][i] = s
+                obj[n][alive[~p]] = None
+            elif t in (PropType.INT, PropType.VID, PropType.TIMESTAMP):
+                val64[n][alive] = np.where(p, i64[fi][alive], 0)
+            elif t == PropType.DOUBLE:
+                valf[n][alive] = np.where(p, f64[fi][alive], 0.0)
+            elif t == PropType.BOOL:
+                valb[n][alive] = np.where(p, i64[fi][alive] != 0, False)
+            elif t == PropType.STRING:
+                # drop rows a LATER field's bad UTF-8 killed — their
+                # earlier string values must not leak into the column
+                # or intern into the shared dict
+                str_cells[n].update({i: s for i, s in
+                                     group_strs[fi].items()
+                                     if not dead[i]})
+    out: Dict[str, PropColumn] = {}
+    for n in names:
+        t = field_types[n]
+        m, pr = miss[n], pres[n]
+        if n in conflicted:
+            out[n] = PropColumn(n, t, obj[n], False, None, pr, None, m)
+            continue
+        if t in (PropType.INT, PropType.VID, PropType.TIMESTAMP):
+            vals = val64[n]
+            pos = np.nonzero(pr)[0]
+            device_ok = not (pos.size and (
+                vals[pos].min() < _I32_MIN or vals[pos].max() > _I32_MAX))
+            dv = vals.astype(np.int32) if device_ok else None
+            out[n] = PropColumn(n, t, vals, device_ok, dv, pr, None, m)
+        elif t == PropType.DOUBLE:
+            vals = valf[n]
+            dv = np.where(pr, vals, np.nan).astype(np.float32)
+            out[n] = PropColumn(n, t, vals, True, dv, pr, None, m)
+        elif t == PropType.BOOL:
+            out[n] = PropColumn(n, t, valb[n], True, valb[n].copy(), pr,
+                                None, m)
+        else:   # STRING
+            host = np.empty(cap, object)
+            if dict_registry is not None and dict_key is not None:
+                sd = dict_registry.setdefault(dict_key + (n,), {})
+            else:
+                sd = {}
+            codes = np.full(cap, -1, np.int32)
+            for i, s in str_cells[n].items():
+                host[i] = s
+                codes[i] = sd.setdefault(s, len(sd))
+            out[n] = PropColumn(n, t, host, True, codes, pr, sd, m)
+    return out
+
+
 def _build_columns(schema: Schema, cap: int, rows: "RowsBlock", now: float,
                    dict_registry: Dict = None, dict_key: Tuple = None,
                    schema_at=None) -> Dict[str, PropColumn]:
@@ -829,6 +972,12 @@ def _build_columns(schema: Schema, cap: int, rows: "RowsBlock", now: float,
                     # column stays host-only (filters fall back to the
                     # exact walk; the CPU path reads per-row types)
                     conflicted.add(f.name)
+    if multi:
+        fast = _native_build_columns_multi(
+            schemas_by_ver, field_types, conflicted, cap, rows, vers,
+            now, dict_registry, dict_key)
+        if fast is not None:
+            return fast
     names = list(field_types)
     host_cols: Dict[str, List[Any]] = {n: [None] * cap for n in names}
     miss: Optional[Dict[str, np.ndarray]] = (

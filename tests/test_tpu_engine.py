@@ -670,3 +670,61 @@ def test_device_filter_width_and_retype_identity():
     r_cpu = cpu_conn.must(q)
     r_tpu = tpu_conn.must(q)
     assert sorted(map(repr, r_cpu.rows)) == sorted(map(repr, r_tpu.rows))
+
+
+def test_native_multi_version_decode_matches_python():
+    """Post-ALTER snapshot builds take the per-version-group NATIVE
+    decode path; results (values, filters, missing-prop errors) are
+    identical to the python multi-version path."""
+    from nebula_tpu.engine_tpu import csr as csr_mod
+    import nebula_tpu.native as native_mod
+
+    def load(tpu):
+        c = InProcCluster(tpu_engine=tpu).connect()
+        c.must("CREATE SPACE mvx(partition_num=2)")
+        c.must("USE mvx")
+        c.must("CREATE TAG n(x int)")
+        c.must("CREATE EDGE r(w int, s string)")
+        c.must("INSERT VERTEX n(x) VALUES " +
+               ", ".join(f"{i}:({i * 2})" for i in range(1, 30)))
+        c.must("INSERT EDGE r(w, s) VALUES " +
+               ", ".join(f'1 -> {i}:({i}, "a{i % 5}")'
+                         for i in range(2, 15)))
+        c.must("ALTER EDGE r ADD (z double)")
+        c.must("INSERT EDGE r(w, s, z) VALUES " +
+               ", ".join(f'1 -> {i}:({i}, "b{i % 3}", {i}.5)'
+                         for i in range(15, 30)))
+        return c
+
+    calls = {"multi": 0}
+    orig = csr_mod._native_build_columns_multi
+
+    def spy(*a, **kw):
+        r = orig(*a, **kw)
+        if r is not None:
+            calls["multi"] += 1
+        return r
+
+    csr_mod._native_build_columns_multi = spy
+    try:
+        c1 = load(TpuGraphEngine())
+        queries = [
+            "GO FROM 1 OVER r WHERE r.w > 5 YIELD r._dst, r.w, r.s",
+            "GO FROM 1 OVER r WHERE r.z > 17 YIELD r._dst, r.z",
+            'GO FROM 1 OVER r WHERE r.s == "a2" YIELD r._dst',
+        ]
+        native_rows = [sorted(map(repr, c1.must(q).rows)) for q in queries]
+        err1 = c1.execute("GO FROM 1 OVER r YIELD r.z").code.name
+        assert calls["multi"] >= 1, "native multi-version path not taken"
+    finally:
+        csr_mod._native_build_columns_multi = orig
+    avail = native_mod.available
+    native_mod.available = lambda: False
+    try:
+        c2 = load(TpuGraphEngine())
+        for q, expect in zip(queries, native_rows):
+            assert sorted(map(repr, c2.must(q).rows)) == expect, q
+        assert c2.execute("GO FROM 1 OVER r YIELD r.z").code.name == err1 \
+            == "E_EXECUTION_ERROR"
+    finally:
+        native_mod.available = avail
