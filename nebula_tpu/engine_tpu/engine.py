@@ -373,8 +373,8 @@ class TpuGraphEngine:
 
         use_delta = snap.delta is not None and snap.delta.edge_count > 0
         if needs_input:
-            return self._go_roots(ctx, s, starts, req, snap, use_delta,
-                                  yield_cols, columns, alias_map,
+            return self._go_roots(ctx, s, starts, req, edge_types, snap,
+                                  use_delta, yield_cols, columns, alias_map,
                                   name_by_type, ex, t_snap)
         if upto:
             return self._go_upto(ctx, s, f0, req, edge_types, snap,
@@ -479,23 +479,41 @@ class TpuGraphEngine:
                              time.monotonic() - t2, snap)
         return StatusOr.of(result)
 
-    def _host_filter_idx(self, ctx, snap, flt, idx_provider, name_by_type,
-                         alias_map, edge_types):
-        """Vectorized host filter pass over active canonical indices:
-        -> {part0: filtered idx}, or None when the filter is outside
-        filter_host's surface (caller keeps the exact per-row Python
-        walk). `idx_provider` is called only AFTER the compile
-        succeeds — building index arrays for a filter that then
-        declines would be pure waste on big dense masks. A ~10^6-edge
-        sparse result through the per-row walk costs seconds — the r3
-        bench's 12s p99 outlier."""
+    def _compile_host_filter(self, ctx, snap, flt, name_by_type,
+                             alias_map, edge_types):
+        """Compile a WHERE filter to the vectorized host evaluator, or
+        None when it's outside filter_host's surface (caller keeps the
+        exact per-row Python walk). A ~10^6-edge result through the
+        per-row walk costs seconds — the r3 bench's 12s p99 outlier."""
         from .filter_host import HostFilterCompiler
         hf = HostFilterCompiler(snap, self._sm, ctx.space_id(),
                                 name_by_type, alias_map,
                                 edge_types).compile(flt)
+        if hf is not None:
+            self.stats["host_filter_vectorized"] += 1
+        return hf
+
+    @staticmethod
+    def _apply_host_filter(hf, snap, mask):
+        """{part0: filtered ascending idx} over a dense [P, cap_e]
+        active mask."""
+        out = {}
+        for p in range(snap.num_parts):
+            idx = np.nonzero(mask[p])[0]
+            if idx.size:
+                out[p] = idx[hf.eval_part(p, idx)]
+        return out
+
+    def _host_filter_idx(self, ctx, snap, flt, idx_provider, name_by_type,
+                         alias_map, edge_types):
+        """One-shot compile + apply over active canonical indices.
+        `idx_provider` is called only AFTER the compile succeeds —
+        building index arrays for a filter that then declines would be
+        pure waste on big dense masks."""
+        hf = self._compile_host_filter(ctx, snap, flt, name_by_type,
+                                       alias_map, edge_types)
         if hf is None:
             return None
-        self.stats["host_filter_vectorized"] += 1
         return {p: idx[hf.eval_part(p, idx)]
                 for p, idx in idx_provider().items()}
 
@@ -894,21 +912,35 @@ class TpuGraphEngine:
         t2 = time.monotonic()
         rows: List[Tuple] = []
         needs_dst = _needs_dst(yield_cols, s)
+        delta_filter = local_filter
+        host_hf = None
+        if local_filter is not None:
+            # vectorized host filter, compiled ONCE for all steps
+            host_hf = self._compile_host_filter(ctx, snap, local_filter,
+                                                name_by_type, alias_map,
+                                                edge_types)
+            if host_hf is not None:
+                local_filter = None
         for si in range(steps):
             mask = np.asarray(masks[si])
             if dm_np is not None:
                 mask = mask & dm_np
+            idx_pp = None
+            if host_hf is not None:
+                idx_pp = self._apply_host_filter(host_hf, snap, mask)
             step_rows = None
             if local_filter is None:
                 step_rows = materialize.emit_rows(snap, mask, ctx,
                                                   yield_cols, alias_map,
-                                                  name_by_type)
+                                                  name_by_type,
+                                                  idx_per_part=idx_pp)
             if step_rows is not None:
                 self.stats["fast_materialize"] += 1
                 rows.extend(step_rows)
             else:
                 self.stats["slow_materialize"] += 1
-                resp = self._materialize(snap, mask, ctx, yield_cols, s)
+                resp = self._materialize(snap, mask, ctx, yield_cols, s,
+                                         idx_per_part=idx_pp)
                 st = ex._emit_go_rows(ctx, resp, rows, yield_cols,
                                       local_filter, alias_map, name_by_type,
                                       roots={}, input_index={},
@@ -918,10 +950,12 @@ class TpuGraphEngine:
             if dmasks is not None:
                 d_mask = np.asarray(dmasks[si])
                 if d_mask.any():
-                    dresp = self._materialize_delta(snap, d_mask, mask, ctx,
+                    base_for_cap = idx_pp if idx_pp is not None else mask
+                    dresp = self._materialize_delta(snap, d_mask,
+                                                    base_for_cap, ctx,
                                                     yield_cols, s)
                     st = ex._emit_go_rows(ctx, dresp, rows, yield_cols,
-                                          local_filter, alias_map,
+                                          delta_filter, alias_map,
                                           name_by_type, roots={},
                                           input_index={}, needs_input=False,
                                           needs_dst=needs_dst)
@@ -940,8 +974,9 @@ class TpuGraphEngine:
     # the input rows of the root that reached them (the device form of
     # VertexBackTracker, ref GoExecutor.cpp:1067-1075)
     # ------------------------------------------------------------------
-    def _go_roots(self, ctx, s, starts, req, snap, use_delta, yield_cols,
-                  columns, alias_map, name_by_type, ex, t_snap=0.0):
+    def _go_roots(self, ctx, s, starts, req, edge_types, snap, use_delta,
+                  yield_cols, columns, alias_map, name_by_type, ex,
+                  t_snap=0.0):
         import jax.numpy as jnp
         roots = sorted(set(starts))
         # [R, P, cap_e] masks materialize on device AND host: bound the
@@ -950,8 +985,18 @@ class TpuGraphEngine:
         if len(roots) > min(self.MAX_ROOTS_ON_DEVICE, max(mask_budget, 1)):
             self.stats["fallbacks"] += 1
             return None
-        # input/var refs are evaluated per joined input row on the host
+        # input/var refs are evaluated per joined input row on the host;
+        # filters WITHOUT input refs vectorize (the compiler declines
+        # $-/$var nodes, so this can't skip input-dependent filters)
         local_filter = s.where.filter if s.where is not None else None
+        delta_filter = local_filter
+        host_hf = None
+        if local_filter is not None:
+            host_hf = self._compile_host_filter(ctx, snap, local_filter,
+                                                name_by_type, alias_map,
+                                                edge_types)
+            if host_hf is not None:
+                local_filter = None
         f0s = jnp.asarray(np.stack(
             [snap.frontier_from_vids([r]) for r in roots]))
         t1 = time.monotonic()   # kernel time = device dispatch only
@@ -966,6 +1011,16 @@ class TpuGraphEngine:
         dmasks = None if dmasks is None else np.asarray(dmasks)
         t_kernel = time.monotonic() - t1
         t2 = time.monotonic()
+        keep = None
+        if host_hf is not None:
+            # evaluate the filter ONCE over the union of root masks —
+            # overlapping root frontiers would otherwise re-gather the
+            # same edges per root; per root below it's one boolean index
+            keep = np.zeros((snap.num_parts, snap.cap_e), bool)
+            union = masks.any(axis=0)
+            for p, idx in self._apply_host_filter(host_hf, snap,
+                                                  union).items():
+                keep[p][idx] = True
         input_index = ex.build_input_index(ctx, s)
         input_var = s.from_.ref.var \
             if isinstance(s.from_.ref, VariablePropExpr) else None
@@ -976,11 +1031,22 @@ class TpuGraphEngine:
             d_mask = dmasks[i] if dmasks is not None else None
             if not mask.any() and (d_mask is None or not d_mask.any()):
                 continue
-            resp = self._materialize(snap, mask, ctx, yield_cols, s)
+            idx_pp = None
+            if keep is not None:
+                idx_pp = {p: np.nonzero(mask[p] & keep[p])[0]
+                          for p in range(snap.num_parts)
+                          if (mask[p] & keep[p]).any()}
+            resp = self._materialize(snap, mask, ctx, yield_cols, s,
+                                     idx_per_part=idx_pp)
+            dresp = None
             if d_mask is not None and d_mask.any():
-                dresp = self._materialize_delta(snap, d_mask, mask, ctx,
-                                                yield_cols, s)
-                _merge_bound_resp(resp, dresp)
+                base_for_cap = idx_pp if idx_pp is not None else mask
+                dresp = self._materialize_delta(snap, d_mask, base_for_cap,
+                                                ctx, yield_cols, s)
+                if host_hf is None:
+                    # one emit with the shared per-row filter
+                    _merge_bound_resp(resp, dresp)
+                    dresp = None
             roots_map = {v.vid: {root} for v in resp.vertices}
             st = ex._emit_go_rows(ctx, resp, rows, yield_cols, local_filter,
                                   alias_map, name_by_type, roots=roots_map,
@@ -988,6 +1054,17 @@ class TpuGraphEngine:
                                   needs_dst=needs_dst, input_var=input_var)
             if not st.ok():
                 return StatusOr.from_status(st)
+            if dresp is not None:
+                # delta rows were NOT pre-filtered: keep the per-row walk
+                roots_map = {v.vid: {root} for v in dresp.vertices}
+                st = ex._emit_go_rows(ctx, dresp, rows, yield_cols,
+                                      delta_filter, alias_map, name_by_type,
+                                      roots=roots_map,
+                                      input_index=input_index,
+                                      needs_input=True, needs_dst=needs_dst,
+                                      input_var=input_var)
+                if not st.ok():
+                    return StatusOr.from_status(st)
         result = ex.InterimResult(columns, rows)
         if s.yield_ and s.yield_.distinct:
             result = result.distinct()

@@ -728,3 +728,31 @@ def test_native_multi_version_decode_matches_python():
             == "E_EXECUTION_ERROR"
     finally:
         native_mod.available = avail
+
+
+def test_upto_and_roots_filter_vectorized(pair_dense):
+    """UPTO and input-ref GO also vectorize non-input WHERE filters on
+    the host (compiled once across steps/roots), with delta rows still
+    walked per-row — identity against the CPU engine after an INSERT."""
+    cpu_conn, tpu_conn, tpu = pair_dense
+    tpu_conn.must("GO FROM 100 OVER like YIELD like._dst")  # snapshot up
+    for conn in (cpu_conn, tpu_conn):
+        conn.must('INSERT VERTEX player(name, age) VALUES '
+                  '602:("UptoDelta", 28)')
+        conn.must('INSERT EDGE like(likeness) VALUES 100 -> 602:(93.0)')
+    queries = [
+        "GO UPTO 2 STEPS FROM 100 OVER like WHERE like.likeness > 90 "
+        "YIELD like._dst, like.likeness",
+        "GO FROM 100 OVER like YIELD like._dst AS id | "
+        "GO FROM $-.id OVER like WHERE like.likeness > 85 "
+        "YIELD $-.id AS src, like._dst",
+    ]
+    for q in queries:
+        before_v = tpu.stats["host_filter_vectorized"]
+        r_tpu = tpu_conn.must(q)
+        assert tpu.stats["host_filter_vectorized"] > before_v, q
+        r_cpu = cpu_conn.must(q)
+        assert sorted(map(repr, r_cpu.rows)) == \
+            sorted(map(repr, r_tpu.rows)), q
+    for conn in (cpu_conn, tpu_conn):
+        conn.must("DELETE VERTEX 602")
