@@ -310,18 +310,22 @@ def bench_full_queries(conn, tpu, snap, etype, seed_sets):
     qps1 = len(seeds) / wall
     log(f"TPU tier2 (batch=1 FULL query, ~{nrows} rows/query): "
         f"p50={p50:.1f}ms p99={p99:.1f}ms, {qps1:.1f} QPS sequential")
-    # CPU contrast on the same cluster/query (one shot; it is slow)
+    # CPU contrast on the same cluster/queries (a seed subset — the
+    # cpp-scan path is ~100x slower per query)
     tpu.enabled = False
+    cpu_lats = []
     try:
-        t1 = time.time()
-        rc = conn.must(q(seeds[0]))
-        cpu_ms = (time.time() - t1) * 1000
+        for seed in seeds[:max(3, len(seeds) // 4)]:
+            t1 = time.time()
+            rc = conn.must(q(seed))
+            cpu_lats.append((time.time() - t1) * 1000)
     finally:
         tpu.enabled = True
-    rt = conn.must(q(seeds[0]))
+    cpu_ms = float(np.percentile(np.array(cpu_lats), 50))
+    rt = conn.must(q(seeds[len(cpu_lats) - 1]))
     ident = sorted(map(str, rt.rows)) == sorted(map(str, rc.rows))
-    log(f"CPU tier2 same query: {cpu_ms:.0f}ms (cpp-scan storaged path); "
-        f"result identity: {ident}")
+    log(f"CPU tier2 same queries: p50={cpu_ms:.0f}ms over {len(cpu_lats)} "
+        f"seeds (cpp-scan storaged path); result identity: {ident}")
     assert ident, "CPU/TPU full-query results diverged"
     return p50, p99, qps1, cpu_ms
 
@@ -462,7 +466,7 @@ def main():
         "tier1_hbm_util_vs_peak": round(gbs / HBM_PEAK_GBS, 3),
         "tier2_full_query_ms": {"p50": round(p50, 1), "p99": round(p99, 1),
                                 "qps_batch1": round(qps1, 1),
-                                "cpu_same_query_ms": round(cpu_q_ms, 1)},
+                                "cpu_same_query_p50_ms": round(cpu_q_ms, 1)},
     }))
 
 

@@ -417,20 +417,14 @@ class TpuGraphEngine:
         t_kernel = time.monotonic() - t1
         t2 = time.monotonic()
 
-        delta_filter = local_filter
+        # the device compile may have been declined (e.g. delta edges in
+        # play, _plan_filter): still avoid the per-row Python walk over
+        # the canonical rows with the vectorized host evaluator
+        host_hf, local_filter, delta_rf = self._plan_host_filter(
+            ctx, snap, local_filter, name_by_type, alias_map, edge_types)
         idx_per_part = None
-        if local_filter is not None:
-            # the device compile was declined (e.g. delta edges in play,
-            # _plan_filter): still avoid the per-row Python walk over
-            # the canonical rows with the vectorized host evaluator
-            idx_per_part = self._host_filter_idx(
-                ctx, snap, local_filter,
-                lambda: {p: np.nonzero(mask[p])[0]
-                         for p in range(snap.num_parts)
-                         if mask[p].any()},
-                name_by_type, alias_map, edge_types)
-            if idx_per_part is not None:
-                local_filter = None
+        if host_hf is not None:
+            idx_per_part = self._apply_host_filter(host_hf, snap, mask)
         rows: Optional[List[Tuple]] = None
         if local_filter is None:
             # columnar fast path: one numpy gather per YIELD column over
@@ -458,14 +452,17 @@ class TpuGraphEngine:
             if d_mask.any():
                 # cap accounting must see the POST-filter base rows
                 # (the CPU hot loop counts only filter-passing edges
-                # toward max_edges_per_vertex, processors.py:235-244)
+                # toward max_edges_per_vertex, processors.py:235-244);
+                # delta rows are likewise filtered (row_filter) BEFORE
+                # cap counting, then emitted unfiltered
                 base_for_cap = idx_per_part if idx_per_part is not None \
                     else mask
                 delta_resp = self._materialize_delta(snap, d_mask,
                                                      base_for_cap,
-                                                     ctx, yield_cols, s)
+                                                     ctx, yield_cols, s,
+                                                     row_filter=delta_rf)
                 st = ex._emit_go_rows(ctx, delta_resp, rows, yield_cols,
-                                      delta_filter, alias_map, name_by_type,
+                                      local_filter, alias_map, name_by_type,
                                       roots={}, input_index={},
                                       needs_input=False,
                                       needs_dst=_needs_dst(yield_cols, s))
@@ -504,28 +501,107 @@ class TpuGraphEngine:
                 out[p] = idx[hf.eval_part(p, idx)]
         return out
 
-    def _host_filter_idx(self, ctx, snap, flt, idx_provider, name_by_type,
-                         alias_map, edge_types):
-        """One-shot compile + apply over active canonical indices.
-        `idx_provider` is called only AFTER the compile succeeds —
-        building index arrays for a filter that then declines would be
-        pure waste on big dense masks."""
-        hf = self._compile_host_filter(ctx, snap, flt, name_by_type,
-                                       alias_map, edge_types)
+    def _plan_host_filter(self, ctx, snap, local_filter, name_by_type,
+                          alias_map, edge_types):
+        """The shared vectorize-or-keep decision: -> (host_hf,
+        local_filter', delta_row_filter). When the filter compiles,
+        canonical rows are pre-filtered (local_filter' is None) and
+        delta rows get a per-row predicate evaluated DURING delta
+        materialization — BEFORE cap counting, so the per-vertex cap
+        sees only filter-passing rows on both row sources (the CPU hot
+        loop's count-after-filter rule, processors.py:235-244)."""
+        if local_filter is None:
+            return None, None, None
+        hf = self._compile_host_filter(ctx, snap, local_filter,
+                                       name_by_type, alias_map, edge_types)
         if hf is None:
-            return None
+            # not vectorizable: callers keep the per-row walk, where cap
+            # accounting remains pre-filter on the slow path (a known,
+            # narrow divergence: >max_edges_per_vertex rows on one
+            # (src, etype) AND a non-pushable filter)
+            return None, local_filter, None
+        flt = local_filter
+        tag_refs = self._filter_tag_refs(flt)
+
+        def delta_passes(info):
+            return self._delta_row_passes(ctx, snap, flt, alias_map,
+                                          name_by_type, info, tag_refs)
+        return hf, None, delta_passes
+
+    @staticmethod
+    def _filter_tag_refs(flt):
+        """(src tag names, dst tag names) a filter references — the
+        only vertex props _delta_row_passes needs to decode."""
+        from ..filter.expressions import DestPropExpr, SourcePropExpr
+        src, dst = set(), set()
+        stack = [flt]
+        while stack:
+            e = stack.pop()
+            if isinstance(e, SourcePropExpr):
+                src.add(e.tag)
+            elif isinstance(e, DestPropExpr):
+                dst.add(e.tag)
+            stack.extend(e.children())
+        return src, dst
+
+    def _delta_row_passes(self, ctx, snap, flt, alias_map, name_by_type,
+                          info, tag_refs) -> bool:
+        """Evaluate a WHERE filter on one delta-buffer edge row with
+        the executor's exact per-row semantics (EvalError drops the
+        row). Only reachable for host-vectorizable filters, which never
+        reference $-/$var, so no input row is needed; only the tags the
+        filter actually references are decoded."""
+        from ..graph.expr_context import EdgeRowExprContext
+        src_vid, etype, rank, dst_vid, props = info
+        space = ctx.space_id()
+        src_tags, dst_tags = tag_refs
+
+        def named_tag_props(vid, names):
+            if not names:
+                return {}
+            loc = snap.locate(vid)
+            if loc is None:
+                return {}
+            shard = snap.shards[loc[0]]
+            out = {}
+            for name in names:
+                tid = ctx.sm.tag_id(space, name)
+                if tid is None:
+                    continue
+                tp = _host_tag_props(shard, tid, loc[1])
+                if tp is not None:
+                    out[name] = tp
+            return out
+
+        ectx = EdgeRowExprContext(
+            input_row=None, variables=None,
+            src_props=named_tag_props(src_vid, src_tags), edge_props=props,
+            edge_name=name_by_type.get(abs(etype), str(abs(etype))),
+            alias_map=alias_map, src=src_vid, dst=dst_vid, rank=rank,
+            dst_props=named_tag_props(dst_vid, dst_tags))
+        from ..filter.expressions import EvalError
+        try:
+            return bool(flt.eval(ectx))
+        except EvalError:
+            return False
+
+    @staticmethod
+    def _apply_host_filter_idx(hf, idx_per_part):
+        """{part0: filtered idx} over already-sparse active indices."""
         return {p: idx[hf.eval_part(p, idx)]
-                for p, idx in idx_provider().items()}
+                for p, idx in idx_per_part.items()}
 
     def _materialize_delta(self, snap: CsrSnapshot, d_mask: np.ndarray,
                            base_mask: np.ndarray, ctx, yield_cols,
-                           s) -> BoundResponse:
+                           s, row_filter=None) -> BoundResponse:
         """Delta-buffer edges active in the final hop, in the same
         BoundResponse shape as _materialize — one host loop over the few
         delta edges, flowing through the identical yield machinery.
         The per-vertex edge cap counts BASE rows first (the CPU storage
         path truncates across all of a vertex's edges, ref
-        FLAGS_max_edge_returned_per_vertex)."""
+        FLAGS_max_edge_returned_per_vertex). `row_filter` applies the
+        WHERE clause per row BEFORE cap counting (the CPU hot loop's
+        count-after-filter rule) — callers then emit WITHOUT a filter."""
         resp = BoundResponse()
         src_tag_reqs, _, _ = _collect_src_tags(ctx, yield_cols, s)
         per_vertex: Dict[int, VertexData] = {}
@@ -534,6 +610,8 @@ class TpuGraphEngine:
         for gdst, lane in zip(*np.nonzero(d_mask)):
             info = delta.info.get((int(gdst), int(lane)))
             if info is None:
+                continue
+            if row_filter is not None and not row_filter(info):
                 continue
             src_vid, etype, rank, dst_vid, props = info
             ckey = (src_vid, etype)
@@ -707,14 +785,10 @@ class TpuGraphEngine:
         t2 = time.monotonic()
         act_idx, d_act = sparse
         local_filter = s.where.filter if s.where is not None else None
-        delta_filter = local_filter
-        if local_filter is not None and act_idx:
-            filtered = self._host_filter_idx(ctx, snap, local_filter,
-                                             lambda: act_idx, name_by_type,
-                                             alias_map, edge_types)
-            if filtered is not None:
-                act_idx = filtered
-                local_filter = None   # canonical rows fully filtered
+        host_hf, local_filter, delta_rf = self._plan_host_filter(
+            ctx, snap, local_filter, name_by_type, alias_map, edge_types)
+        if host_hf is not None and act_idx:
+            act_idx = self._apply_host_filter_idx(host_hf, act_idx)
         rows: Optional[List[Tuple]] = None
         needs_dst = _needs_dst(yield_cols, s)
         if local_filter is None:
@@ -740,8 +814,9 @@ class TpuGraphEngine:
             for slot in d_act:
                 d_mask[slot] = True
             dresp = self._materialize_delta(snap, d_mask, act_idx, ctx,
-                                            yield_cols, s)
-            st = ex._emit_go_rows(ctx, dresp, rows, yield_cols, delta_filter,
+                                            yield_cols, s,
+                                            row_filter=delta_rf)
+            st = ex._emit_go_rows(ctx, dresp, rows, yield_cols, local_filter,
                                   alias_map, name_by_type, roots={},
                                   input_index={}, needs_input=False,
                                   needs_dst=needs_dst)
@@ -912,15 +987,9 @@ class TpuGraphEngine:
         t2 = time.monotonic()
         rows: List[Tuple] = []
         needs_dst = _needs_dst(yield_cols, s)
-        delta_filter = local_filter
-        host_hf = None
-        if local_filter is not None:
-            # vectorized host filter, compiled ONCE for all steps
-            host_hf = self._compile_host_filter(ctx, snap, local_filter,
-                                                name_by_type, alias_map,
-                                                edge_types)
-            if host_hf is not None:
-                local_filter = None
+        # vectorized host filter, compiled ONCE for all steps
+        host_hf, local_filter, delta_rf = self._plan_host_filter(
+            ctx, snap, local_filter, name_by_type, alias_map, edge_types)
         for si in range(steps):
             mask = np.asarray(masks[si])
             if dm_np is not None:
@@ -953,9 +1022,10 @@ class TpuGraphEngine:
                     base_for_cap = idx_pp if idx_pp is not None else mask
                     dresp = self._materialize_delta(snap, d_mask,
                                                     base_for_cap, ctx,
-                                                    yield_cols, s)
+                                                    yield_cols, s,
+                                                    row_filter=delta_rf)
                     st = ex._emit_go_rows(ctx, dresp, rows, yield_cols,
-                                          delta_filter, alias_map,
+                                          local_filter, alias_map,
                                           name_by_type, roots={},
                                           input_index={}, needs_input=False,
                                           needs_dst=needs_dst)
@@ -989,14 +1059,8 @@ class TpuGraphEngine:
         # filters WITHOUT input refs vectorize (the compiler declines
         # $-/$var nodes, so this can't skip input-dependent filters)
         local_filter = s.where.filter if s.where is not None else None
-        delta_filter = local_filter
-        host_hf = None
-        if local_filter is not None:
-            host_hf = self._compile_host_filter(ctx, snap, local_filter,
-                                                name_by_type, alias_map,
-                                                edge_types)
-            if host_hf is not None:
-                local_filter = None
+        host_hf, local_filter, delta_rf = self._plan_host_filter(
+            ctx, snap, local_filter, name_by_type, alias_map, edge_types)
         f0s = jnp.asarray(np.stack(
             [snap.frontier_from_vids([r]) for r in roots]))
         t1 = time.monotonic()   # kernel time = device dispatch only
@@ -1033,20 +1097,19 @@ class TpuGraphEngine:
                 continue
             idx_pp = None
             if keep is not None:
-                idx_pp = {p: np.nonzero(mask[p] & keep[p])[0]
-                          for p in range(snap.num_parts)
-                          if (mask[p] & keep[p]).any()}
+                kept = mask & keep
+                idx_pp = {p: idx for p in range(snap.num_parts)
+                          if (idx := np.nonzero(kept[p])[0]).size}
             resp = self._materialize(snap, mask, ctx, yield_cols, s,
                                      idx_per_part=idx_pp)
-            dresp = None
             if d_mask is not None and d_mask.any():
+                # delta rows are row_filter-ed (pre-cap) during
+                # materialization, so one merged emit serves both
                 base_for_cap = idx_pp if idx_pp is not None else mask
                 dresp = self._materialize_delta(snap, d_mask, base_for_cap,
-                                                ctx, yield_cols, s)
-                if host_hf is None:
-                    # one emit with the shared per-row filter
-                    _merge_bound_resp(resp, dresp)
-                    dresp = None
+                                                ctx, yield_cols, s,
+                                                row_filter=delta_rf)
+                _merge_bound_resp(resp, dresp)
             roots_map = {v.vid: {root} for v in resp.vertices}
             st = ex._emit_go_rows(ctx, resp, rows, yield_cols, local_filter,
                                   alias_map, name_by_type, roots=roots_map,
@@ -1054,17 +1117,6 @@ class TpuGraphEngine:
                                   needs_dst=needs_dst, input_var=input_var)
             if not st.ok():
                 return StatusOr.from_status(st)
-            if dresp is not None:
-                # delta rows were NOT pre-filtered: keep the per-row walk
-                roots_map = {v.vid: {root} for v in dresp.vertices}
-                st = ex._emit_go_rows(ctx, dresp, rows, yield_cols,
-                                      delta_filter, alias_map, name_by_type,
-                                      roots=roots_map,
-                                      input_index=input_index,
-                                      needs_input=True, needs_dst=needs_dst,
-                                      input_var=input_var)
-                if not st.ok():
-                    return StatusOr.from_status(st)
         result = ex.InterimResult(columns, rows)
         if s.yield_ and s.yield_.distinct:
             result = result.distinct()
