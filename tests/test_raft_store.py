@@ -46,10 +46,19 @@ def test_three_copies_replicate_writes(stores3):
 
 def test_follower_write_rejected_with_leader_hint(stores3):
     stores3.add_part(1, 1)
-    leader_addr = stores3.leader_of(1, 1)
-    follower = next(a for a in stores3.addrs if a != leader_addr)
-    st = stores3.stores[follower].async_multi_put(1, 1, [(b"\x01x", b"y")])
-    assert st.code == ErrorCode.E_LEADER_CHANGED
+    # the follower learns who leads from the first heartbeat AFTER the
+    # election — under load its hint can briefly lag (or leadership can
+    # move between observation and write), so settle within a bound
+    deadline = time.monotonic() + 5
+    while True:
+        leader_addr = stores3.leader_of(1, 1)
+        follower = next(a for a in stores3.addrs if a != leader_addr)
+        st = stores3.stores[follower].async_multi_put(
+            1, 1, [(b"\x01x", b"y")])
+        assert st.code == ErrorCode.E_LEADER_CHANGED
+        if st.msg == leader_addr or time.monotonic() > deadline:
+            break
+        time.sleep(0.05)
     assert st.msg == leader_addr
 
 
