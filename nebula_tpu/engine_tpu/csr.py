@@ -215,15 +215,24 @@ class CsrSnapshot:
                 "repack the snapshot or use the per-query kernels")
         if self._aligned is None:
             from .traverse import build_aligned
-            P = self.num_parts
-            src, etype, valid = (a.reshape(-1)
-                                 for a in self._np_edge_stacks())
-            gsrc = (np.repeat(np.arange(P, dtype=np.int64), self.cap_e)
-                    * self.cap_v + src).astype(np.int32)
-            gdst = np.where(valid, self.np_gidx.reshape(-1),
-                            P * self.cap_v).astype(np.int64)
-            self._aligned = build_aligned(gsrc, etype, gdst, P * self.cap_v)
+            gsrc, etype, gdst = self._flat_canonical_edges()
+            self._aligned = build_aligned(gsrc, etype, gdst,
+                                          self.num_parts * self.cap_v)
         return self._aligned
+
+    def _flat_canonical_edges(self):
+        """Flat (gsrc, etype, gdst) canonical edge arrays in the global
+        slot encoding (invalid edges -> the dump slot num_parts*cap_v)
+        — the shared input of the single-device and sharded aligned
+        layout builds."""
+        P = self.num_parts
+        src, etype, valid = (a.reshape(-1)
+                             for a in self._np_edge_stacks())
+        gsrc = (np.repeat(np.arange(P, dtype=np.int64), self.cap_e)
+                * self.cap_v + src).astype(np.int32)
+        gdst = np.where(valid, self.np_gidx.reshape(-1),
+                        P * self.cap_v).astype(np.int64)
+        return gsrc, etype, gdst
 
     def vid_of_slot(self, p0: int, local: int) -> Optional[int]:
         """Inverse of locate (base or delta slot) — delta materialization."""

@@ -33,7 +33,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from .traverse import EdgeKernel, _edge_ok, hop_hits
+from .traverse import (LANES, AlignedKernel, EdgeKernel, _deg_req,
+                       _edge_ok, _packed_hits, _packed_src_eff, hop_hits)
 
 AXIS = "parts"
 
@@ -201,6 +202,66 @@ def bfs_dist_sharded(mesh: Mesh, frontier0, max_steps, kern: EdgeKernel,
     return fn(frontier0, max_steps, kern, req_types)
 
 
+@lru_cache(maxsize=64)
+def _batch_count_fn(mesh: Mesh, num_devices: int, n_slots: int,
+                    chunk: int, group: int):
+    """Distributed form of the flagship batched counter
+    (traverse.multi_hop_count_batch_packed): the [n_slots+1, 128]
+    frontier matrix is REPLICATED (154MB at SNB scale — data-parallel
+    replication, not sharding), each device takes a packed hop over its
+    OWN aligned edge block, per-hop frontier merge is one elementwise
+    pmax over the hit matrix (the OR across devices), and per-lane
+    counts come from the device-local out-degrees psum'd at the end —
+    the same collective shape the scaling-book recipe gives a
+    replicated-activation sharded-weight matmul."""
+    from jax import shard_map
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(None, None, P(AXIS), None),
+             out_specs=P())
+    def run(F0, steps_, ak_, req):
+        ak = jax.tree.map(lambda a: a[0], ak_)   # this device's block
+        src_eff = _packed_src_eff(ak, req, n_slots, chunk, group)
+        deg_req = _deg_req(ak, req)              # block-local degrees
+        g_idx = ak.cbound // group
+        j_idx = ak.cbound % group
+
+        def body(_, state):
+            f, total = state
+            cnt = (f[:n_slots].astype(jnp.int32)
+                   * deg_req[:, None]).sum(axis=0, dtype=jnp.int32)
+            total = total + cnt.astype(jnp.int64)
+            hits = _packed_hits(f, src_eff, g_idx, j_idx, n_slots,
+                                chunk, group).astype(jnp.int8)
+            merged = lax.pmax(hits, AXIS)        # OR across devices
+            return jnp.pad(merged, ((0, 1), (0, 0))), total
+
+        # the frontier carry stays axis-INVARIANT: pmax's merge output
+        # is identical on every device; only the count is varying
+        zero = lax.pcast(jnp.zeros((LANES,), jnp.int64), (AXIS,),
+                         to="varying")
+        _, total = lax.fori_loop(0, steps_, body, (F0, zero))
+        return lax.psum(total, AXIS)
+
+    return jax.jit(run)
+
+
+def multi_hop_count_batch_sharded(mesh: Mesh, frontiers0, steps,
+                                  ak: AlignedKernel, req_types,
+                                  chunk: int, group: int) -> jnp.ndarray:
+    """Distributed batched GO counter: frontiers0 bool[B, P, cap_v]
+    (B <= 128), ak from traverse.build_aligned_blocks stacked with a
+    leading per-device dim sharded over the mesh. -> int64[B]."""
+    B, num_parts, cap_v = frontiers0.shape
+    if B > LANES:
+        raise ValueError(f"batch {B} > {LANES} lanes per dispatch")
+    ns = num_parts * cap_v
+    F = np.zeros((ns + 1, LANES), np.int8)
+    F[:ns, :B] = np.asarray(frontiers0).reshape(B, -1).T
+    fn = _batch_count_fn(mesh, mesh.devices.size, ns, chunk, group)
+    return fn(jnp.asarray(F), steps, ak, req_types)[:B]
+
+
 def shard_snapshot_arrays(mesh: Mesh, snap) -> "EdgeKernel":
     """Build the per-device-block EdgeKernel for a CsrSnapshot and place
     it with the mesh sharding (leading block dim sharded over AXIS);
@@ -214,3 +275,26 @@ def shard_snapshot_arrays(mesh: Mesh, snap) -> "EdgeKernel":
     kern = jax.tree.map(lambda a: jax.device_put(a, sharding), kern)
     snap.sharded_kernel = kern
     return kern
+
+
+def shard_aligned_blocks(mesh: Mesh, snap):
+    """Per-device-block aligned layouts for the batched counter, placed
+    with the mesh sharding: -> (AlignedKernel[D, ...], chunk, group)."""
+    from .traverse import build_aligned_blocks
+    D = mesh.devices.size
+    num_parts, cap_v, cap_e = snap.num_parts, snap.cap_v, snap.cap_e
+    assert num_parts % D == 0
+    if snap.delta is not None and snap.delta.edge_count > 0:
+        # same contract as CsrSnapshot.aligned_kernel: the aligned
+        # layouts cover only canonical edges — counting over a snapshot
+        # with pending delta ADDs would silently miss them
+        raise RuntimeError(
+            "shard_aligned_blocks does not include delta-buffer edges; "
+            "repack the snapshot or use the per-query kernels")
+    gsrc, etype, gdst = snap._flat_canonical_edges()
+    block_of = np.repeat(np.arange(num_parts) // (num_parts // D), cap_e)
+    ak, chunk, group = build_aligned_blocks(gsrc, etype, gdst,
+                                            num_parts * cap_v, D, block_of)
+    sharding = NamedSharding(mesh, P(AXIS))
+    ak = jax.tree.map(lambda a: jax.device_put(a, sharding), ak)
+    return ak, chunk, group

@@ -599,6 +599,53 @@ def multi_hop_count_batch(frontiers0: jnp.ndarray, steps: jnp.ndarray,
     return total[:B]
 
 
+def build_aligned_blocks(gsrc: np.ndarray, etype: np.ndarray,
+                         gdst: np.ndarray, n_slots: int, num_blocks: int,
+                         block_of: np.ndarray,
+                         chunk: Optional[int] = None,
+                         group: int = G_ALIGN
+                         ) -> Tuple[AlignedKernel, int, int]:
+    """Per-device-block aligned layouts, stacked with a leading block
+    dim (shard_map form of build_aligned): block b gets the aligned
+    layout of ITS edges (block_of[e] == b) over the GLOBAL slot space,
+    padded to a common E_pad; degs/deg_types use one global type list
+    so every block's arrays shape-match."""
+    types = np.unique(etype[gdst < n_slots]) if len(etype) else \
+        np.zeros(0, np.int32)
+    nt = max(len(types), 1)
+    deg_types = np.zeros(nt, np.int32)
+    deg_types[:len(types)] = types
+    builds = []
+    for b in range(num_blocks):
+        sel = np.nonzero(block_of == b)[0]
+        ak_b, chunk, group = build_aligned(gsrc[sel], etype[sel],
+                                           gdst[sel], n_slots,
+                                           chunk=chunk, group=group)
+        builds.append(ak_b)
+    e_pad = max(int(a.src.shape[0]) for a in builds)
+    span = chunk * group
+    e_pad = -(-e_pad // span) * span
+    srcs, etypes, cbounds, degss = [], [], [], []
+    for ak_b in builds:
+        pad = e_pad - int(ak_b.src.shape[0])
+        srcs.append(jnp.pad(ak_b.src, (0, pad), constant_values=n_slots))
+        etypes.append(jnp.pad(ak_b.etype, (0, pad)))
+        cbounds.append(ak_b.cbound)
+        # re-key this block's degs onto the global type list
+        d = np.zeros((nt, n_slots), np.int32)
+        bt = np.asarray(ak_b.deg_types)
+        bd = np.asarray(ak_b.degs)
+        for i, t in enumerate(bt):
+            j = np.searchsorted(types, t) if len(types) else 0
+            if len(types) and j < len(types) and types[j] == t:
+                d[j] += bd[i]
+        degss.append(jnp.asarray(d))
+    return (AlignedKernel(jnp.stack(srcs), jnp.stack(etypes),
+                          jnp.stack(cbounds),
+                          jnp.asarray(np.tile(deg_types, (num_blocks, 1))),
+                          jnp.stack(degss)), chunk, group)
+
+
 @partial(jax.jit, static_argnames=("chunk", "group"))
 def multi_hop_count_batch_packed(frontiers0: jnp.ndarray,
                                  steps: jnp.ndarray, ak: AlignedKernel,
@@ -626,23 +673,12 @@ def multi_hop_count_batch_packed(frontiers0: jnp.ndarray,
     if B > LANES:
         raise ValueError(f"batch {B} > {LANES} lanes per dispatch")
     ns = ak.cbound.shape[0] - 1
-    e_pad = ak.src.shape[0]
-    span = chunk * group
-    nb = max(1, -(-e_pad // (1 << 23)))          # ~8M edges per block
-    blk = -(-e_pad // nb // span) * span
-    tot = nb * blk
-    nc = tot // chunk
-    ng = nc // group
     F = jnp.zeros((ns + 1, LANES), jnp.int8)
     F = F.at[:ns, :B].set(frontiers0.reshape(B, -1).T.astype(jnp.int8))
-    ok = (ak.etype[None] == req_types[:, None]).any(axis=0)
-    src_eff = jnp.pad(jnp.where(ok, ak.src, ns), (0, tot - e_pad),
-                      constant_values=ns).reshape(nb, blk)
+    src_eff = _packed_src_eff(ak, req_types, ns, chunk, group)
+    deg_req = _deg_req(ak, req_types)
     g_idx = ak.cbound // group
     j_idx = ak.cbound % group
-    tmask = (ak.deg_types[:, None] == req_types[None, :]).any(axis=1)
-    deg_req = (ak.degs * tmask[:, None].astype(ak.degs.dtype)).sum(axis=0)
-    shifts = jnp.arange(32, dtype=jnp.uint32)
 
     def body(_, state):
         f, total = state
@@ -651,30 +687,62 @@ def multi_hop_count_batch_packed(frontiers0: jnp.ndarray,
         cnt = (f[:ns].astype(jnp.int32) * deg_req[:, None]).sum(
             axis=0, dtype=jnp.int32)
         total = total + cnt.astype(jnp.int64)
-        # lanes -> bits: word w holds lanes [32w, 32w+32)
-        packed = (jnp.left_shift(
-            f.astype(jnp.uint32).reshape(ns + 1, 4, 32),
-            shifts[None, None, :])).sum(axis=2, dtype=jnp.uint32)
-
-        def block_or(sb):                        # fused gather + chunk OR
-            rows = packed[sb].reshape(blk // chunk, chunk, 4)
-            return lax.reduce(rows, jnp.uint32(0), lax.bitwise_or, (1,))
-
-        cs = lax.map(block_or, src_eff).reshape(nc, 4)
-        u = ((cs[:, :, None] >> shifts[None, None, :])
-             & jnp.uint32(1)).reshape(nc, LANES).astype(jnp.int8)
-        local_inc = jnp.cumsum(u.reshape(ng, group, LANES), axis=1,
-                               dtype=jnp.int32)
-        grp_tot = local_inc[:, -1]
-        grp_exc = jnp.pad(jnp.cumsum(grp_tot, axis=0),
-                          ((1, 0), (0, 0)))[:-1]
-        local_prev = jnp.where(
-            (j_idx > 0)[:, None],
-            local_inc[g_idx, jnp.maximum(j_idx - 1, 0)], 0)
-        Sv = grp_exc[g_idx] + local_prev         # [ns+1, LANES]
-        hits = (Sv[1:] - Sv[:-1]) > 0
+        hits = _packed_hits(f, src_eff, g_idx, j_idx, ns, chunk, group)
         return jnp.pad(hits.astype(jnp.int8), ((0, 1), (0, 0))), total
 
     _, total = lax.fori_loop(0, steps, body,
                              (F, jnp.zeros((LANES,), jnp.int64)))
     return total[:B]
+
+
+def _deg_req(ak: AlignedKernel, req_types: jnp.ndarray) -> jnp.ndarray:
+    """int32[n_slots] out-degree per slot over the requested types."""
+    tmask = (ak.deg_types[:, None] == req_types[None, :]).any(axis=1)
+    return (ak.degs * tmask[:, None].astype(ak.degs.dtype)).sum(axis=0)
+
+
+def _packed_src_eff(ak: AlignedKernel, req_types: jnp.ndarray, ns: int,
+                    chunk: int, group: int) -> jnp.ndarray:
+    """[nb, blk] gather indices with type-dead edges pointed at the
+    always-zero row, padded to whole ~8M-edge map blocks."""
+    e_pad = ak.src.shape[0]
+    span = chunk * group
+    nb = max(1, -(-e_pad // (1 << 23)))          # ~8M edges per block
+    blk = -(-e_pad // nb // span) * span
+    tot = nb * blk
+    ok = (ak.etype[None] == req_types[:, None]).any(axis=0)
+    return jnp.pad(jnp.where(ok, ak.src, ns), (0, tot - e_pad),
+                   constant_values=ns).reshape(nb, blk)
+
+
+def _packed_hits(f: jnp.ndarray, src_eff: jnp.ndarray,
+                 g_idx: jnp.ndarray, j_idx: jnp.ndarray, ns: int,
+                 chunk: int, group: int) -> jnp.ndarray:
+    """One packed-frontier hop: -> hits bool[ns, LANES]. `f` is the
+    [ns+1, LANES] int8 frontier matrix (row ns always zero)."""
+    nb, blk = src_eff.shape
+    nc = (nb * blk) // chunk
+    ng = nc // group
+    shifts = jnp.arange(32, dtype=jnp.uint32)
+    # lanes -> bits: word w holds lanes [32w, 32w+32)
+    packed = (jnp.left_shift(
+        f.astype(jnp.uint32).reshape(ns + 1, 4, 32),
+        shifts[None, None, :])).sum(axis=2, dtype=jnp.uint32)
+
+    def block_or(sb):                            # fused gather + chunk OR
+        rows = packed[sb].reshape(blk // chunk, chunk, 4)
+        return lax.reduce(rows, jnp.uint32(0), lax.bitwise_or, (1,))
+
+    cs = lax.map(block_or, src_eff).reshape(nc, 4)
+    u = ((cs[:, :, None] >> shifts[None, None, :])
+         & jnp.uint32(1)).reshape(nc, LANES).astype(jnp.int8)
+    local_inc = jnp.cumsum(u.reshape(ng, group, LANES), axis=1,
+                           dtype=jnp.int32)
+    grp_tot = local_inc[:, -1]
+    grp_exc = jnp.pad(jnp.cumsum(grp_tot, axis=0),
+                      ((1, 0), (0, 0)))[:-1]
+    local_prev = jnp.where(
+        (j_idx > 0)[:, None],
+        local_inc[g_idx, jnp.maximum(j_idx - 1, 0)], 0)
+    Sv = grp_exc[g_idx] + local_prev             # [ns+1, LANES]
+    return (Sv[1:] - Sv[:-1]) > 0

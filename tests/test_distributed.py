@@ -142,3 +142,26 @@ def test_sharded_with_placed_arrays(snap8):
     f1, a1 = traverse.multi_hop(f0, 2, snap.kernel, req)
     assert np.array_equal(np.asarray(f), np.asarray(f1))
     assert np.array_equal(np.asarray(a), np.asarray(a1))
+
+
+def test_sharded_batched_count_matches(snap8):
+    """The distributed flagship counter (replicated packed frontier
+    matrix, per-device aligned blocks, pmax merge + psum counts) must
+    count exactly what the per-query single-device kernel counts."""
+    snap, _ = snap8
+    mesh = dist.make_mesh()
+    ak, chunk, group = dist.shard_aligned_blocks(mesh, snap)
+    seeds = [[100], [101, 102], [103, 104, 105], [100, 110]]
+    f_batch = jnp.asarray(np.stack(
+        [snap.frontier_from_vids(s) for s in seeds]))
+    for req_list in ([1], [1, -1]):
+        req = jnp.asarray(traverse.pad_edge_types(req_list))
+        for steps in (1, 2, 3):
+            out = np.asarray(dist.multi_hop_count_batch_sharded(
+                mesh, f_batch, jnp.int32(steps), ak, req, chunk, group))
+            for i, s in enumerate(seeds):
+                single = int(traverse.multi_hop_count(
+                    jnp.asarray(snap.frontier_from_vids(s)),
+                    jnp.int32(steps), snap.kernel, req))
+                assert int(out[i]) == single, \
+                    (req_list, steps, s, out[i], single)

@@ -55,10 +55,12 @@ def test_follower_write_rejected_with_leader_hint(stores3):
         follower = next(a for a in stores3.addrs if a != leader_addr)
         st = stores3.stores[follower].async_multi_put(
             1, 1, [(b"\x01x", b"y")])
-        assert st.code == ErrorCode.E_LEADER_CHANGED
-        if st.msg == leader_addr or time.monotonic() > deadline:
+        settled = (st.code == ErrorCode.E_LEADER_CHANGED
+                   and st.msg == leader_addr)
+        if settled or time.monotonic() > deadline:
             break
         time.sleep(0.05)
+    assert st.code == ErrorCode.E_LEADER_CHANGED
     assert st.msg == leader_addr
 
 
