@@ -170,3 +170,14 @@ def test_console_completer_keywords_and_schema_names():
     assert "player" in all_matches("pla")       # tag name from catalog
     assert "like" in all_matches("li")          # edge name
     assert "comp" in all_matches("com")         # space name
+
+
+def test_soak_short():
+    """A short mixed INSERT+GO soak: identity checks pass, the delta
+    buffer absorbs every write (no foreground rebuilds beyond
+    background repacks), and the summary is well-formed."""
+    from nebula_tpu.tools.soak import run_soak
+    out = run_soak(seconds=2.0, verify_every=5, v=500, e=2000)
+    assert out["ok"], out
+    assert out["queries"] > 0 and out["writes"] > 0
+    assert out["identity_verifies"] > 0
