@@ -756,3 +756,41 @@ def test_upto_and_roots_filter_vectorized(pair_dense):
             sorted(map(repr, r_tpu.rows)), q
     for conn in (cpu_conn, tpu_conn):
         conn.must("DELETE VERTEX 602")
+
+
+def test_all_paths_random_graph_identity():
+    """FIND ALL/NOLOOP/SHORTEST PATH on a ~200-vertex random graph:
+    device per-level adjacency + shared enumeration must match the
+    CPU executor exactly (VERDICT r2 item 8's larger-graph criterion)."""
+    import random
+    rnd = random.Random(11)
+    n = 200
+    edges = sorted({(rnd.randrange(n), rnd.randrange(n))
+                    for _ in range(900) if True})
+    edges = [(s, d) for s, d in edges if s != d]
+    tpu = TpuGraphEngine()
+    conns = []
+    for cluster in (InProcCluster(), InProcCluster(tpu_engine=tpu)):
+        c = cluster.connect()
+        c.must("CREATE SPACE rg(partition_num=4)")
+        c.must("USE rg")
+        c.must("CREATE TAG nn(x int)")
+        c.must("CREATE EDGE e(w int)")
+        c.must("INSERT VERTEX nn(x) VALUES " +
+               ", ".join(f"{i}:({i})" for i in range(n)))
+        for i in range(0, len(edges), 400):
+            c.must("INSERT EDGE e(w) VALUES " + ", ".join(
+                f"{s} -> {d}:({s + d})" for s, d in edges[i:i + 400]))
+        conns.append(c)
+    cpu, tpuc = conns
+    pairs = [(0, 7), (3, 150), (42, 199), (11, 11)]
+    for a, b in pairs:
+        for form in ("SHORTEST", "ALL", "NOLOOP"):
+            k = 3 if form == "ALL" else 4
+            q = f"FIND {form} PATH FROM {a} TO {b} OVER e UPTO {k} STEPS"
+            r_cpu = cpu.must(q)
+            before = tpu.stats["path_served"]
+            r_tpu = tpuc.must(q)
+            assert sorted(map(repr, r_cpu.rows)) == \
+                sorted(map(repr, r_tpu.rows)), q
+            assert tpu.stats["path_served"] > before, q
