@@ -1,0 +1,204 @@
+"""Randomized CPU/TPU result-identity fuzzer.
+
+The north-star property (BASELINE.json: "identical result sets") gets
+hand-written identity matrices in tests/; this tool SEARCHES for
+counterexamples instead: random property graphs, random mutations, and
+random nGQL (GO with steps/UPTO/REVERSELY/BIDIRECT, WHERE trees over
+int/double/string/tag props, YIELD mixes, pipes with $- refs, FIND
+SHORTEST/ALL/NOLOOP PATH) executed against a device-engine cluster and
+a CPU-only cluster built from the same statement stream.
+
+    python -m nebula_tpu.tools.identity_fuzz --rounds 200 --seed 3
+
+Any divergence prints the reproducing statement stream and exits 1.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import random
+from typing import List
+
+
+def _build_graph(rnd: random.Random, n_v: int, n_e: int) -> List[str]:
+    stmts = [
+        "CREATE SPACE fz(partition_num=3)",
+        "USE fz",
+        "CREATE TAG person(age int, name string)",
+        "CREATE TAG city(pop int)",
+        "CREATE EDGE knows(w int, s string)",
+        "CREATE EDGE likes(score double)",
+    ]
+    vrows = ", ".join(f'{i}:({rnd.randrange(18, 80)}, "p{i % 13}")'
+                      for i in range(n_v))
+    stmts.append(f"INSERT VERTEX person(age, name) VALUES {vrows}")
+    # a second tag on a subset (vertices can carry several tags; some
+    # sources/dests will lack a referenced tag -> EvalError paths)
+    crows = ", ".join(f"{i}:({i * 10})" for i in range(0, n_v, 3))
+    stmts.append(f"INSERT VERTEX city(pop) VALUES {crows}")
+    krows = []
+    lrows = []
+    for _ in range(n_e):
+        s, d = rnd.randrange(n_v), rnd.randrange(n_v)
+        if rnd.random() < 0.7:
+            krows.append(f'{s} -> {d}:({rnd.randrange(100)}, '
+                         f'"t{rnd.randrange(5)}")')
+        else:
+            lrows.append(f"{s} -> {d}:({rnd.uniform(0, 10):.3f})")
+    if krows:
+        stmts.append("INSERT EDGE knows(w, s) VALUES " + ", ".join(krows))
+    if lrows:
+        stmts.append("INSERT EDGE likes(score) VALUES " + ", ".join(lrows))
+    return stmts
+
+
+def _rand_filter(rnd: random.Random, edge: str) -> str:
+    leaves = []
+    if edge == "knows":
+        leaves += [f"knows.w {rnd.choice(['>', '<', '>=', '==', '!='])} "
+                   f"{rnd.randrange(100)}",
+                   f'knows.s == "t{rnd.randrange(6)}"',
+                   f'knows.s != "t{rnd.randrange(6)}"',
+                   f"knows.w % {rnd.randrange(2, 7)} == "
+                   f"{rnd.randrange(3)}"]
+    else:
+        leaves += [f"likes.score {rnd.choice(['>', '<'])} "
+                   f"{rnd.uniform(0, 10):.2f}"]
+    leaves += [f"$^.person.age {rnd.choice(['>', '<'])} "
+               f"{rnd.randrange(18, 80)}",
+               f"$$.person.age {rnd.choice(['>', '<='])} "
+               f"{rnd.randrange(18, 80)}",
+               f"$^.city.pop > {rnd.randrange(0, 500)}",
+               "!($$.person.age > 50)"]
+    a = rnd.choice(leaves)
+    if rnd.random() < 0.5:
+        b = rnd.choice(leaves)
+        return f"{a} {rnd.choice(['&&', '||'])} {b}"
+    return a
+
+
+def _rand_query(rnd: random.Random, n_v: int) -> str:
+    kind = rnd.random()
+    seeds = ", ".join(str(rnd.randrange(n_v))
+                      for _ in range(rnd.choice([1, 1, 2, 3])))
+    if kind < 0.6:
+        edge = rnd.choice(["knows", "knows", "likes"])
+        steps = rnd.choice(["", "2 STEPS ", "3 STEPS ", "UPTO 2 STEPS "])
+        direction = rnd.choice(["", "", " REVERSELY", " BIDIRECT"])
+        where = ""
+        if rnd.random() < 0.7:
+            where = f" WHERE {_rand_filter(rnd, edge)}"
+        yields = rnd.choice([
+            "", f" YIELD {edge}._dst, {edge}._src",
+            f" YIELD {edge}._dst AS d, $^.person.name",
+            f" YIELD DISTINCT {edge}._dst",
+            f" YIELD {edge}._dst, $$.person.age"])
+        return f"GO {steps}FROM {seeds} OVER {edge}{direction}{where}{yields}"
+    if kind < 0.75:   # pipe with $- back-reference
+        cut = rnd.randrange(100)
+        return (f"GO FROM {seeds} OVER knows YIELD knows._dst AS id, "
+                f"knows.w AS w | GO FROM $-.id OVER knows "
+                f"WHERE knows.w > {cut} YIELD $-.w AS base, knows._dst")
+    form = rnd.choice(["SHORTEST", "ALL", "NOLOOP"])
+    a, b = rnd.randrange(n_v), rnd.randrange(n_v)
+    k = rnd.choice([3, 4]) if form != "ALL" else 3
+    return f"FIND {form} PATH FROM {a} TO {b} OVER knows UPTO {k} STEPS"
+
+
+def _rand_mutation(rnd: random.Random, n_v: int, fresh: List[int]) -> str:
+    r = rnd.random()
+    if r < 0.4:
+        s, d = rnd.randrange(n_v), rnd.randrange(n_v)
+        return (f"INSERT EDGE knows(w, s) VALUES {s} -> {d}:"
+                f'({rnd.randrange(100)}, "t{rnd.randrange(5)}")')
+    if r < 0.6:
+        vid = n_v + len(fresh)
+        fresh.append(vid)
+        return (f"INSERT VERTEX person(age, name) VALUES "
+                f'{vid}:({rnd.randrange(18, 80)}, "new")')
+    if r < 0.8 and fresh:
+        vid = fresh[rnd.randrange(len(fresh))]
+        return (f"INSERT EDGE knows(w, s) VALUES "
+                f'{rnd.randrange(n_v)} -> {vid}:(7, "t1")')
+    s, d = rnd.randrange(n_v), rnd.randrange(n_v)
+    return f"DELETE EDGE knows {s} -> {d}"
+
+
+def run_fuzz(rounds: int = 100, seed: int = 0, n_v: int = 120,
+             n_e: int = 700, mutate_every: int = 7,
+             sparse_budget: int = None, progress=None) -> dict:
+    from ..cluster import InProcCluster
+    from ..engine_tpu import TpuGraphEngine
+
+    rnd = random.Random(seed)
+    stmts = _build_graph(rnd, n_v, n_e)
+    tpu = TpuGraphEngine()
+    if sparse_budget is not None:
+        tpu.sparse_edge_budget = sparse_budget   # 0: non-empty frontiers go dense
+    conns = []
+    for cluster in (InProcCluster(), InProcCluster(tpu_engine=tpu)):
+        c = cluster.connect()
+        for s in stmts:
+            c.must(s)
+        conns.append(c)
+    cpu, dev = conns
+    history: List[str] = []
+    fresh: List[int] = []
+    checked = 0
+    for i in range(rounds):
+        if mutate_every and i and i % mutate_every == 0:
+            m = _rand_mutation(rnd, n_v, fresh)
+            history.append(m)
+            cpu.must(m)
+            dev.must(m)
+            continue
+        q = _rand_query(rnd, n_v)
+        history.append(q)
+        rc = cpu.execute(q)
+        rt = dev.execute(q)
+        if rc.code != rt.code or (
+                rc.code.name == "SUCCEEDED"
+                and sorted(map(repr, rc.rows)) != sorted(map(repr,
+                                                             rt.rows))):
+            return {"ok": False, "at": i, "query": q,
+                    "cpu_code": rc.code.name, "tpu_code": rt.code.name,
+                    "cpu_rows": sorted(map(repr, rc.rows or []))[:10],
+                    "tpu_rows": sorted(map(repr, rt.rows or []))[:10],
+                    "history": history}
+        checked += 1
+        if progress and checked % 50 == 0:
+            progress(checked)
+    return {"ok": True, "rounds": rounds, "queries_checked": checked,
+            "mutations": len(history) - checked, "seed": seed,
+            "served": {k: tpu.stats[k] for k in
+                       ("go_served", "path_served", "sparse_served",
+                        "fallbacks", "host_filter_vectorized")}}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="randomized CPU/TPU result-identity fuzzer")
+    ap.add_argument("--rounds", type=int, default=200)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--vertices", type=int, default=120)
+    ap.add_argument("--edges", type=int, default=700)
+    ap.add_argument("--sparse-budget", type=int, default=None,
+                    help="override the pull budget (0 sends every GO with "
+                         "a non-empty frontier through the dense "
+                         "device dispatch)")
+    args = ap.parse_args(argv)
+    out = run_fuzz(args.rounds, args.seed, args.vertices, args.edges,
+                   sparse_budget=args.sparse_budget,
+                   progress=lambda n: print(f"  ... {n} queries checked",
+                                            flush=True))
+    print(json.dumps(out if out["ok"] else
+                     {k: v for k, v in out.items() if k != "history"}))
+    if not out["ok"]:
+        print("REPRO STATEMENT STREAM:")
+        for s in out["history"]:
+            print("   ", s)
+    return 0 if out["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -181,3 +181,18 @@ def test_soak_short():
     assert out["ok"], out
     assert out["queries"] > 0 and out["writes"] > 0
     assert out["identity_verifies"] > 0
+
+
+def test_identity_fuzz_short():
+    """Randomized CPU/TPU identity search (both engine modes) — any
+    divergence fails with the reproducing query."""
+    from nebula_tpu.tools.identity_fuzz import run_fuzz
+    out = run_fuzz(rounds=40, seed=101, n_v=60, n_e=300)
+    assert out["ok"], out
+    dense = run_fuzz(rounds=30, seed=102, n_v=60, n_e=300,
+                     sparse_budget=0)
+    assert dense["ok"], dense
+    # zero-edge frontiers may still serve sparsely (visiting nothing is
+    # under any budget) — assert the dense dispatch did real work
+    served = dense["served"]
+    assert served["go_served"] - served["sparse_served"] > 0, served
