@@ -540,6 +540,22 @@ int64_t nkv_approx_size(nkv *e) {
   return e->approx_bytes_locked();
 }
 
+// Point lookup under the CALLER's shared lock: memtable first, then
+// runs newest-first; returns the value or nullptr (missing/tombstone).
+// Shared by nkv_get and nkv_multi_get so lookup precedence has one
+// definition.
+static const std::string *lookup_locked(nkv *e, const std::string &key) {
+  auto mit = e->mem.find(key);
+  if (mit != e->mem.end())
+    return mit->second.tomb ? nullptr : &mit->second.val;
+  for (const auto &r : e->runs) {
+    size_t i = r->lower_bound(key);
+    if (i < r->keys.size() && r->keys[i] == key)
+      return r->cells[i].tomb ? nullptr : &r->cells[i].val;
+  }
+  return nullptr;
+}
+
 int64_t nkv_get(nkv *e, const uint8_t *k, int64_t klen,
                 const uint8_t **out) {
   // per-thread scratch: the pointer stays valid until this thread's
@@ -547,23 +563,11 @@ int64_t nkv_get(nkv *e, const uint8_t *k, int64_t klen,
   thread_local std::string scratch;
   std::string key(reinterpret_cast<const char *>(k), klen);
   std::shared_lock<std::shared_mutex> g(e->mu);
-  auto mit = e->mem.find(key);
-  if (mit != e->mem.end()) {
-    if (mit->second.tomb) return -1;
-    scratch = mit->second.val;
-    *out = reinterpret_cast<const uint8_t *>(scratch.data());
-    return static_cast<int64_t>(scratch.size());
-  }
-  for (const auto &r : e->runs) {
-    size_t i = r->lower_bound(key);
-    if (i < r->keys.size() && r->keys[i] == key) {
-      if (r->cells[i].tomb) return -1;
-      scratch = r->cells[i].val;
-      *out = reinterpret_cast<const uint8_t *>(scratch.data());
-      return static_cast<int64_t>(scratch.size());
-    }
-  }
-  return -1;
+  const std::string *val = lookup_locked(e, key);
+  if (!val) return -1;
+  scratch = *val;
+  *out = reinterpret_cast<const uint8_t *>(scratch.data());
+  return static_cast<int64_t>(scratch.size());
 }
 
 int32_t nkv_put(nkv *e, const uint8_t *k, int64_t klen, const uint8_t *v,
@@ -839,6 +843,37 @@ int64_t nkv_scan_prefix_cols(nkv *e, const uint8_t *p, int64_t plen,
   *vals_out = vb;
   *klens_out = kl;
   *vlens_out = vl;
+  return n;
+}
+
+// Batched point lookups: keys packed as [u32 klen][key]...; the result
+// buffer packs [i32 vlen|-1][val]... in key order (one shared-lock
+// acquisition and one FFI crossing for the whole batch — the
+// KVStore::multiGet role, and what lets Python reader threads overlap
+// inside the engine instead of serializing on per-call overhead).
+int64_t nkv_multi_get(nkv *e, const uint8_t *buf, int64_t len, int32_t n,
+                      uint8_t **out, int64_t *out_len) {
+  std::string res;
+  std::shared_lock<std::shared_mutex> g(e->mu);
+  int64_t off = 0;
+  for (int32_t i = 0; i < n; i++) {
+    if (off + 4 > len) return -1;
+    uint32_t klen;
+    memcpy(&klen, buf + off, 4);
+    off += 4;
+    if (off + klen > len) return -1;
+    std::string key(reinterpret_cast<const char *>(buf + off), klen);
+    off += klen;
+    const std::string *val = lookup_locked(e, key);
+    int32_t vlen = val ? static_cast<int32_t>(val->size()) : -1;
+    res.append(reinterpret_cast<const char *>(&vlen), 4);
+    if (val) res.append(*val);
+  }
+  uint8_t *o = static_cast<uint8_t *>(malloc(res.size() ? res.size() : 1));
+  if (!o) return -1;
+  memcpy(o, res.data(), res.size());
+  *out = o;
+  *out_len = static_cast<int64_t>(res.size());
   return n;
 }
 

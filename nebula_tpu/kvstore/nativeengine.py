@@ -18,6 +18,7 @@ from .iface import KVEngine, KVIterator
 
 KV = Tuple[bytes, bytes]
 _U32 = struct.Struct("<I")
+_I32 = struct.Struct("<i")   # multi_get value length (-1 = missing)
 
 
 def _pack_kvs(kvs: List[KV]) -> bytes:
@@ -108,6 +109,36 @@ class NativeEngine(KVEngine):
         if n < 0:
             return None
         return ctypes.string_at(out, n) if n else b""
+
+    def multi_get(self, keys: List[bytes]) -> List[Optional[bytes]]:
+        """Batched lookups in ONE native call (the KVStore::multiGet
+        role): one shared-lock acquisition for the whole batch, and the
+        GIL is released across every key instead of per key."""
+        if not keys:
+            return []
+        buf = b"".join(_U32.pack(len(k)) + k for k in keys)
+        out = ctypes.POINTER(ctypes.c_uint8)()
+        out_len = ctypes.c_int64()
+        rc = self._lib.nkv_multi_get(self._h, buf, len(buf), len(keys),
+                                     ctypes.byref(out),
+                                     ctypes.byref(out_len))
+        if rc < 0:
+            return [self.get(k) for k in keys]
+        try:
+            raw = ctypes.string_at(out, out_len.value)
+        finally:
+            self._lib.nkv_buf_free(out)
+        res: List[Optional[bytes]] = []
+        off = 0
+        for _ in range(len(keys)):
+            (vlen,) = _I32.unpack_from(raw, off)
+            off += 4
+            if vlen < 0:
+                res.append(None)
+            else:
+                res.append(raw[off:off + vlen])
+                off += vlen
+        return res
 
     def _scan(self, fn, *args) -> List[KV]:
         out = ctypes.POINTER(ctypes.c_uint8)()

@@ -130,6 +130,10 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
                                          ctypes.POINTER(i64),
                                          ctypes.POINTER(u32p),
                                          ctypes.POINTER(u32p)]
+    lib.nkv_multi_get.restype = i64
+    lib.nkv_multi_get.argtypes = [vp, ctypes.c_char_p, i64, i32,
+                                  ctypes.POINTER(u8p),
+                                  ctypes.POINTER(i64)]
     lib.nkv_buf_free.restype = None
     lib.nkv_buf_free.argtypes = [u8p]
     lib.nkv_checkpoint.restype = i32
@@ -328,6 +332,15 @@ def decode_rows(field_types, blob, row_off, row_len, row_idx, cap):
     return vals_i64, vals_f64, str_off, str_len, nulls.astype(bool), blob
 
 
+def usable_cpus() -> int:
+    """CPUs this process may actually run on (affinity/cgroup limit,
+    not the host count — containers often pin far below cpu_count)."""
+    try:
+        return len(os.sched_getaffinity(0)) or 1
+    except (AttributeError, OSError):
+        return os.cpu_count() or 1
+
+
 def stable_counting_sort(keys, n_keys: int, threads: int = 0):
     """Stable argsort of small-range non-negative int keys via the
     native parallel counting sort — O(E) vs numpy's comparison sort
@@ -352,7 +365,7 @@ def stable_counting_sort(keys, n_keys: int, threads: int = 0):
     n = len(k)
     order = np.empty(n, np.int64)
     if threads <= 0:
-        threads = min(os.cpu_count() or 1, 16)
+        threads = min(usable_cpus(), 16)
     rc = lib.nsort_counting_u32(
         k.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)), n, n_keys,
         order.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), threads)

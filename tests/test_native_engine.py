@@ -370,3 +370,29 @@ def test_ingest_overwrites_memtable_entries(tmp_path):
     assert e.get(b"dup") == b"ingested"
     assert e.get(b"gone") == b"back"
     e.close()
+
+
+def test_native_multi_get_matches_get():
+    """Batched lookups (one FFI call, one shared-lock hold) return
+    exactly what per-key get() returns, including misses, tombstones,
+    memtable overrides of run values, and empty values."""
+    import struct
+    from nebula_tpu.kvstore.nativeengine import NativeEngine
+    e = NativeEngine()
+    rows = b"".join(struct.pack("<I", 3) + b"k%02d" % i
+                    + struct.pack("<I", 3) + b"v%02d" % i
+                    for i in range(50))
+    assert e.ingest_packed(rows, 50).ok()
+    e.put(b"k07", b"override")      # memtable shadows the run
+    e.remove(b"k09")                # tombstone
+    e.put(b"kZZ", b"")              # empty value
+    keys = ([b"k%02d" % i for i in range(50)]
+            + [b"missing", b"k07", b"k09", b"kZZ"])
+    batched = e.multi_get(keys)
+    singles = [e.get(k) for k in keys]
+    assert batched == singles
+    assert batched[keys.index(b"k07")] == b"override"
+    assert batched[keys.index(b"k09")] is None
+    assert batched[keys.index(b"kZZ")] == b""
+    assert e.multi_get([]) == []
+    e.close()
