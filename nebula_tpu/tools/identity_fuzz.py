@@ -52,7 +52,19 @@ def _build_graph(rnd: random.Random, n_v: int, n_e: int) -> List[str]:
     return stmts
 
 
-def _rand_filter(rnd: random.Random, edge: str) -> str:
+def _rand_filter(rnd: random.Random, edge: str,
+                 alters: List[int] = ()) -> str:
+    # post-ALTER fields get their own heavily-weighted branch: buried
+    # as one uniform leaf among nine they would essentially never run,
+    # and the missing-prop/EvalError machinery they exercise is the
+    # highest-risk identity surface
+    if edge == "knows" and alters and rnd.random() < 0.35:
+        zi = rnd.choice(alters)
+        z = (f"knows.z{zi} {rnd.choice(['>', '!=', '=='])} "
+             f"{rnd.randrange(50)}")
+        if rnd.random() < 0.4:
+            return f"{z} {rnd.choice(['&&', '||'])} knows.w > "                    f"{rnd.randrange(100)}"
+        return z
     leaves = []
     if edge == "knows":
         leaves += [f"knows.w {rnd.choice(['>', '<', '>=', '==', '!='])} "
@@ -77,7 +89,8 @@ def _rand_filter(rnd: random.Random, edge: str) -> str:
     return a
 
 
-def _rand_query(rnd: random.Random, n_v: int) -> str:
+def _rand_query(rnd: random.Random, n_v: int,
+                alters: List[int] = ()) -> str:
     kind = rnd.random()
     seeds = ", ".join(str(rnd.randrange(n_v))
                       for _ in range(rnd.choice([1, 1, 2, 3])))
@@ -87,7 +100,7 @@ def _rand_query(rnd: random.Random, n_v: int) -> str:
         direction = rnd.choice(["", "", " REVERSELY", " BIDIRECT"])
         where = ""
         if rnd.random() < 0.7:
-            where = f" WHERE {_rand_filter(rnd, edge)}"
+            where = f" WHERE {_rand_filter(rnd, edge, alters)}"
         yields = rnd.choice([
             "", f" YIELD {edge}._dst, {edge}._src",
             f" YIELD {edge}._dst AS d, $^.person.name",
@@ -105,8 +118,23 @@ def _rand_query(rnd: random.Random, n_v: int) -> str:
     return f"FIND {form} PATH FROM {a} TO {b} OVER knows UPTO {k} STEPS"
 
 
-def _rand_mutation(rnd: random.Random, n_v: int, fresh: List[int]) -> str:
+def _rand_mutation(rnd: random.Random, n_v: int, fresh: List[int],
+                   alters: List[int]) -> str:
     r = rnd.random()
+    if r < 0.25 and len(alters) < 3:
+        # schema evolution mid-stream: old rows now lack the new field
+        # (missing -> EvalError semantics), new rows carry it
+        zi = len(alters) + 1
+        alters.append(zi)
+        return f"ALTER EDGE knows ADD (z{zi} int)"
+    if r < 0.12 and alters:
+        zi = rnd.choice(alters)
+        s, d = rnd.randrange(n_v), rnd.randrange(n_v)
+        cols = "w, s" + "".join(f", z{j}" for j in alters if j <= zi)
+        vals = (f"{rnd.randrange(100)}, \"t{rnd.randrange(5)}\""
+                + "".join(f", {rnd.randrange(50)}"
+                          for j in alters if j <= zi))
+        return f"INSERT EDGE knows({cols}) VALUES {s} -> {d}:({vals})"
     if r < 0.4:
         s, d = rnd.randrange(n_v), rnd.randrange(n_v)
         return (f"INSERT EDGE knows(w, s) VALUES {s} -> {d}:"
@@ -144,15 +172,16 @@ def run_fuzz(rounds: int = 100, seed: int = 0, n_v: int = 120,
     cpu, dev = conns
     history: List[str] = []
     fresh: List[int] = []
+    alters: List[int] = []
     checked = 0
     for i in range(rounds):
         if mutate_every and i and i % mutate_every == 0:
-            m = _rand_mutation(rnd, n_v, fresh)
+            m = _rand_mutation(rnd, n_v, fresh, alters)
             history.append(m)
             cpu.must(m)
             dev.must(m)
             continue
-        q = _rand_query(rnd, n_v)
+        q = _rand_query(rnd, n_v, alters)
         history.append(q)
         rc = cpu.execute(q)
         rt = dev.execute(q)
