@@ -121,13 +121,16 @@ def _rand_query(rnd: random.Random, n_v: int,
 def _rand_mutation(rnd: random.Random, n_v: int, fresh: List[int],
                    alters: List[int]) -> str:
     r = rnd.random()
-    if r < 0.25 and len(alters) < 3:
+    # disjoint ranges: the z-INSERT branch must be reachable while
+    # ALTERs are still landing, or z-filters would only ever see the
+    # all-missing case instead of mixed present/missing rows
+    if r < 0.15 and len(alters) < 3:
         # schema evolution mid-stream: old rows now lack the new field
         # (missing -> EvalError semantics), new rows carry it
         zi = len(alters) + 1
         alters.append(zi)
         return f"ALTER EDGE knows ADD (z{zi} int)"
-    if r < 0.12 and alters:
+    if r < 0.28 and alters:
         zi = rnd.choice(alters)
         s, d = rnd.randrange(n_v), rnd.randrange(n_v)
         cols = "w, s" + "".join(f", z{j}" for j in alters if j <= zi)
@@ -135,19 +138,32 @@ def _rand_mutation(rnd: random.Random, n_v: int, fresh: List[int],
                 + "".join(f", {rnd.randrange(50)}"
                           for j in alters if j <= zi))
         return f"INSERT EDGE knows({cols}) VALUES {s} -> {d}:({vals})"
-    if r < 0.4:
+    if r < 0.35:
         s, d = rnd.randrange(n_v), rnd.randrange(n_v)
         return (f"INSERT EDGE knows(w, s) VALUES {s} -> {d}:"
                 f'({rnd.randrange(100)}, "t{rnd.randrange(5)}")')
-    if r < 0.6:
+    if r < 0.5:
         vid = n_v + len(fresh)
         fresh.append(vid)
         return (f"INSERT VERTEX person(age, name) VALUES "
                 f'{vid}:({rnd.randrange(18, 80)}, "new")')
-    if r < 0.8 and fresh:
+    if r < 0.6 and fresh:
         vid = fresh[rnd.randrange(len(fresh))]
         return (f"INSERT EDGE knows(w, s) VALUES "
                 f'{rnd.randrange(n_v)} -> {vid}:(7, "t1")')
+    if r < 0.72:
+        # prop patch through the CAS path (UPSERT creates when absent)
+        s, d = rnd.randrange(n_v), rnd.randrange(n_v)
+        verb = rnd.choice(["UPDATE", "UPSERT"])
+        return (f"{verb} EDGE {s} -> {d} OF knows "
+                f"SET w = {rnd.randrange(100)}")
+    if r < 0.82:
+        vid = rnd.randrange(n_v)
+        verb = rnd.choice(["UPDATE", "UPSERT"])
+        return (f"{verb} VERTEX {vid} SET "
+                f"person.age = {rnd.randrange(18, 80)}")
+    if r < 0.9:
+        return f"DELETE VERTEX {rnd.randrange(n_v)}"
     s, d = rnd.randrange(n_v), rnd.randrange(n_v)
     return f"DELETE EDGE knows {s} -> {d}"
 
@@ -174,12 +190,23 @@ def run_fuzz(rounds: int = 100, seed: int = 0, n_v: int = 120,
     fresh: List[int] = []
     alters: List[int] = []
     checked = 0
+    failed_mutations = 0   # identical-failure mutations still lose
+                           # coverage; surface the count
     for i in range(rounds):
         if mutate_every and i and i % mutate_every == 0:
             m = _rand_mutation(rnd, n_v, fresh, alters)
             history.append(m)
-            cpu.must(m)
-            dev.must(m)
+            # mutations may legitimately fail (UPDATE of a missing
+            # edge) — the two engines must fail IDENTICALLY
+            mc, mt = cpu.execute(m), dev.execute(m)
+            if mc.code.name != "SUCCEEDED":
+                failed_mutations += 1
+            if mc.code != mt.code:
+                return {"ok": False, "at": i, "query": m,
+                        "cpu_code": mc.code.name,
+                        "tpu_code": mt.code.name,
+                        "cpu_rows": [], "tpu_rows": [],
+                        "history": history}
             continue
         q = _rand_query(rnd, n_v, alters)
         history.append(q)
@@ -198,7 +225,8 @@ def run_fuzz(rounds: int = 100, seed: int = 0, n_v: int = 120,
         if progress and checked % 50 == 0:
             progress(checked)
     return {"ok": True, "rounds": rounds, "queries_checked": checked,
-            "mutations": len(history) - checked, "seed": seed,
+            "mutations": len(history) - checked,
+            "failed_mutations": failed_mutations, "seed": seed,
             "served": {k: tpu.stats[k] for k in
                        ("go_served", "path_served", "sparse_served",
                         "fallbacks", "host_filter_vectorized")}}
