@@ -161,8 +161,10 @@ def load_cluster():
     dst_part = (dsts.view(np.uint64) % np.uint64(PARTS)).astype(np.int64) + 1
     vid_part = (np.arange(V, dtype=np.int64).view(np.uint64)
                 % np.uint64(PARTS)).astype(np.int64) + 1
-    et_b = np.uint32(etype) + _BIAS32          # biased etype codes
-    et_rev_b = (-np.int32(etype)).view(np.uint32) + _BIAS32
+    # biased etype codes (python-int arithmetic so the intended uint32
+    # wraparound never trips numpy's overflow warning)
+    et_b = np.uint32(int(etype) + int(_BIAS32))
+    et_rev_b = np.uint32((int(_BIAS32) - int(etype)) & 0xFFFFFFFF)
     for p in range(1, PARTS + 1):
         # vertices of part p (kind 1 sorts before kind 2)
         sel = np.nonzero(vid_part == p)[0]
