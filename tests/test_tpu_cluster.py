@@ -148,3 +148,52 @@ def test_storaged_death_falls_back_to_cpu(net_cluster):
         assert tpu.stats["fallbacks"] > fallbacks0
     finally:
         pass  # fixture teardown stops the rest (s2.stop is idempotent)
+
+
+def test_tcp_topology_identity_fuzz():
+    """Randomized identity over the REAL TCP topology: a --tpu graphd
+    and a CPU graphd share one storaged; every random query must return
+    identical rows from both, with mutations (including mid-stream
+    ALTERs) applied once through the shared store."""
+    import random
+    import time as _t
+
+    from nebula_tpu.tools.identity_fuzz import (_build_graph,
+                                                _rand_mutation,
+                                                _rand_query)
+
+    metad = serve_metad()
+    s1 = serve_storaged(metad.addr, load_interval=0.1)
+    g_cpu = serve_graphd(metad.addr)
+    g_tpu = serve_graphd(metad.addr, tpu_engine=TpuGraphEngine())
+    try:
+        cc = GraphClient(g_cpu.addr).connect()
+        ct = GraphClient(g_tpu.addr).connect()
+        rnd = random.Random(9001)
+        for s in _build_graph(rnd, 80, 400):
+            r = cc.execute(s)
+            assert r.ok(), (s, r.error_msg)
+            if s.startswith("CREATE"):
+                _t.sleep(0.05)
+        assert ct.execute("USE fz").ok()
+        _t.sleep(0.5)
+        alters, fresh = [], []
+        checked = 0
+        for i in range(60):
+            if i and i % 6 == 0:
+                m = _rand_mutation(rnd, 80, fresh, alters)
+                cc.execute(m)
+                if m.startswith("ALTER"):
+                    _t.sleep(0.4)   # schema watch propagation
+                continue
+            q = _rand_query(rnd, 80, alters)
+            rc, rt = cc.execute(q), ct.execute(q)
+            assert rc.code == rt.code, (q, rc.code, rt.code)
+            if rc.ok():
+                assert sorted(map(repr, rc.rows)) == \
+                    sorted(map(repr, rt.rows)), q
+            checked += 1
+        assert checked > 40
+    finally:
+        for h in (g_tpu, g_cpu, s1, metad):
+            h.stop()
