@@ -28,7 +28,6 @@ CURRENT visible state of their group, so replays converge.
 """
 from __future__ import annotations
 
-import threading
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np

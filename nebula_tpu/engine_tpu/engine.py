@@ -36,7 +36,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
-from ..common.status import ErrorCode, Status, StatusOr
+from ..common.status import Status, StatusOr
 from ..filter.expressions import (Expression, InputPropExpr, VariablePropExpr)
 from ..parser import ast
 from ..storage.types import BoundResponse, EdgeData, PartResult, VertexData
