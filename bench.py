@@ -397,22 +397,14 @@ def bench_python_baseline():
 
 
 def _ensure_backend():
-    """Probe accelerator reachability in a SUBPROCESS: a dead tunnel
-    makes in-process backend init hang forever (and poison the init
-    lock), which would hang the driver's round-end bench. On a hung or
-    failed probe, force the CPU XLA backend at a reduced graph scale —
-    the bench still reports, loudly labeled."""
-    import subprocess
-    plat = ""
-    try:
-        out = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; print(jax.devices()[0].platform)"],
-            capture_output=True, timeout=180, text=True)
-        if out.returncode == 0 and out.stdout.strip():
-            plat = out.stdout.strip().splitlines()[-1]
-    except subprocess.TimeoutExpired:
-        pass
+    """Probe accelerator reachability in a SUBPROCESS (shared helper:
+    nebula_tpu.common.accel): a dead tunnel makes in-process backend
+    init hang forever (and poison the init lock), which would hang the
+    driver's round-end bench. On a hung or failed probe, force the CPU
+    XLA backend at a reduced graph scale — the bench still reports,
+    loudly labeled."""
+    from nebula_tpu.common import accel
+    plat, _n = accel.probe()
     if plat and plat != "cpu":
         return plat
     import jax

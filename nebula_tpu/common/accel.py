@@ -1,0 +1,68 @@
+"""Accelerator reachability probing.
+
+A dead or flaky accelerator relay makes in-process JAX backend init
+hang forever AND poison the init lock, so reachability must be decided
+in a SUBPROCESS with a deadline. This is the single shared
+implementation of that pattern — bench.py's `_ensure_backend` and
+`__graft_entry__.dryrun_multichip` both consume it (they briefly had
+separate copies which diverged on the platform check).
+
+No reference analogue: the reference assumes local CUDA/CPU devices; a
+tunneled TPU needs a liveness check before anything touches the
+backend.  This module must stay importable without initializing JAX
+(stdlib imports only).
+"""
+from __future__ import annotations
+
+import subprocess
+import sys
+from typing import Tuple
+
+
+def backend_initialized() -> bool:
+    """True iff a JAX backend is already live in THIS process.
+
+    When it is, probing in a subprocess is pointless and actively
+    harmful: on an exclusive-access accelerator the child blocks on the
+    parent's device lock until the probe deadline, then falsely reports
+    the accelerator as unreachable. Callers should inspect
+    `jax.devices()` directly instead — init already happened, so that
+    call cannot hang.
+
+    The check reads the private `jax._src.xla_bridge._backends` (there
+    is no public "is the backend up" API). If that attribute ever moves,
+    this returns False and callers take the subprocess probe: worst case
+    a bounded `timeout`-long stall and a false "unreachable" — chosen
+    over the in-process alternative, whose failure mode is an unbounded
+    hang on a dead relay.
+    """
+    mod = sys.modules.get("jax")
+    if mod is None:
+        return False
+    try:
+        from jax._src import xla_bridge
+        return bool(xla_bridge._backends)
+    except Exception:
+        return False
+
+
+def probe(timeout: float = 180.0) -> Tuple[str, int]:
+    """-> (platform, device_count) of the default JAX backend as seen
+    by a fresh subprocess, or ("", 0) if the probe hangs or fails.
+
+    `platform == "cpu"` means JAX fell back to host devices — callers
+    wanting a *real* accelerator must treat that the same as
+    unreachable (virtual host devices can satisfy any count via
+    --xla_force_host_platform_device_count).
+    """
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); print(d[0].platform, len(d))"],
+            capture_output=True, timeout=timeout, text=True)
+        if out.returncode == 0 and out.stdout.strip():
+            plat, n = out.stdout.strip().splitlines()[-1].split()
+            return plat, int(n)
+    except (subprocess.TimeoutExpired, OSError, ValueError):
+        pass
+    return "", 0

@@ -40,7 +40,12 @@ class _Unsupported(Exception):
     pass
 
 
-_F = jnp.bool_(False)
+# numpy, not jnp: a module-level jnp constant would initialize the JAX
+# backend at import time, before callers (bench.py, __graft_entry__)
+# get a chance to force the platform — on a host whose accelerator
+# relay is down that hangs every import. np.bool_ composes with jnp
+# arrays identically (`~`, `&`, `|`, jnp.where all accept it).
+_F = np.bool_(False)
 
 
 class _Val:
