@@ -128,16 +128,42 @@ class Session:
         self._session_id = None
 
     # -- public -------------------------------------------------------
+    # statements safe to retry after a mid-flight transport error: the
+    # server may have applied the statement before the connection died,
+    # so only reads (and `$var =` result assignments, which only write
+    # session-local state) are retried automatically. A mutation that
+    # dies in flight surfaces the transport error to the caller, who
+    # alone knows whether re-applying is safe (at-least-once).
+    _READ_ONLY = ("GO", "FETCH", "FIND", "YIELD", "USE", "SHOW",
+                  "DESC", "DESCRIBE", "MATCH", "LOOKUP")
+
+    @classmethod
+    def _retry_safe(cls, stmt: str) -> bool:
+        s = stmt.strip()
+        if s.startswith("$"):
+            return True
+        head = s.split(None, 1)[0].upper() if s else ""
+        return head in cls._READ_ONLY
+
     def execute(self, stmt: str) -> ExecutionResponse:
         """Run one statement; on a transport error, reconnect (possibly
-        to another endpoint) and retry the statement once."""
+        to another endpoint) and retry once — automatically only for
+        read-only statements (see _retry_safe)."""
         for attempt in (0, 1):
             try:
                 self._ensure_connected()
+            except Exception:
+                # nothing was sent yet — reconnecting and retrying is
+                # always safe, mutation or not
+                self._drop_connection()
+                if attempt:
+                    raise
+                continue
+            try:
                 resp = self._rpc.execute(self._session_id, stmt)
             except Exception:
                 self._drop_connection()
-                if attempt:
+                if attempt or not self._retry_safe(stmt):
                     raise
                 continue
             if resp.code == ErrorCode.E_SESSION_INVALID and not attempt:

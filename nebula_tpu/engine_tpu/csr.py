@@ -953,7 +953,15 @@ def _build_columns(schema: Schema, cap: int, rows: "RowsBlock", now: float,
     single = len(uvers) == 0 or (
         len(uvers) == 1 and (schema_at is None
                              or int(uvers[0]) == schema.version))
-    if single:
+    # the `missing is None` fast representation encodes "~present ⇒ the
+    # CPU walk raises" (no row / TTL-expired / undecodable). A nullable
+    # field breaks that: an explicit NULL is ~present but must NOT read
+    # as err (delta.py materializes missing as ~present on fast-build
+    # columns). Schemas with nullable fields therefore always build
+    # real `missing` masks, today and for any future DDL that exposes
+    # nullable — enforced here rather than assumed at the write path.
+    has_nullable = any(f.nullable for f in schema.fields)
+    if single and not has_nullable:
         fast = _native_build_columns(schema, cap, rows, now,
                                      dict_registry, dict_key)
         if fast is not None:
@@ -990,7 +998,8 @@ def _build_columns(schema: Schema, cap: int, rows: "RowsBlock", now: float,
     names = list(field_types)
     host_cols: Dict[str, List[Any]] = {n: [None] * cap for n in names}
     miss: Optional[Dict[str, np.ndarray]] = (
-        {n: np.ones(cap, bool) for n in names} if multi else None)
+        {n: np.ones(cap, bool) for n in names}
+        if (multi or has_nullable) else None)
     for j, (idx, raw) in enumerate(rows.items()):
         sv = schemas_by_ver.get(int(vers[j]), schema) if multi else schema
         try:

@@ -239,7 +239,10 @@ def _patch_prop_columns(snap, cols: Dict, idx: int, props: Optional[dict],
         if not known:
             if col.missing is None:
                 # materializing the mask on a fast-build column: its
-                # ~present cells were all err (no-row) — preserve that
+                # ~present cells were all err (no-row) — preserve that.
+                # Sound because _build_columns never takes the
+                # missing=None fast path for schemas with nullable
+                # fields, so no ~present cell here is an explicit NULL
                 col.missing = (~col.present if col.present is not None
                                else np.zeros(len(col.host), bool))
             col.missing[idx] = True

@@ -200,7 +200,12 @@ class HostFilterCompiler:
             elif kind != k:
                 raise _Unsupported()
             elif intlike != (t != PropType.DOUBLE):
-                intlike = None       # int/float mix across edge types
+                # int/float mix across edge types: np.where would
+                # upcast the int64 accumulator to float64, so compares
+                # on ints beyond 2^53 could diverge from the CPU's
+                # exact compare — per-row walk serves it (same
+                # treatment as the bool/num mix above)
+                raise _Unsupported()
         if kind is None:
             raise _Unsupported()
         for et in types:

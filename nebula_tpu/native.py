@@ -352,7 +352,12 @@ def stable_counting_sort(keys, n_keys: int, threads: int = 0):
     if not available():
         return None
     keys = np.asarray(keys)
-    if n_keys > (1 << 32):
+    if n_keys > (1 << 24):
+        # the native sort allocates threads * n_keys * 8B of
+        # histograms (16 threads at 2^24 keys = 2 GiB; unbounded, a
+        # 2^32 range would ask for ~512 GiB and die in malloc rather
+        # than falling back). Past this range the counting strategy
+        # loses to a comparison sort anyway — numpy fallback.
         return None
     if keys.dtype.itemsize > 4 and len(keys) and (
             int(keys.max()) >= (1 << 32) or int(keys.min()) < 0):

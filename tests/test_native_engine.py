@@ -396,3 +396,47 @@ def test_native_multi_get_matches_get():
     assert batched[keys.index(b"kZZ")] == b""
     assert e.multi_get([]) == []
     e.close()
+
+
+def test_counting_sort_matches_numpy_and_caps_range():
+    import numpy as np
+    from nebula_tpu import native
+    if not native.available():
+        import pytest
+        pytest.skip("native lib not built")
+    rng = np.random.default_rng(3)
+    keys = rng.integers(0, 1000, 20_000).astype(np.uint32)
+    order = native.stable_counting_sort(keys, 1000)
+    assert order is not None
+    ref = np.argsort(keys, kind="stable")
+    assert np.array_equal(order, ref)
+    # a huge key range would allocate threads*n_keys*8B of histograms
+    # (hundreds of GiB at 2^32) — must decline so callers fall back to
+    # numpy instead of dying in malloc
+    assert native.stable_counting_sort(keys, 1 << 25) is None
+
+
+def test_nullable_schema_builds_missing_masks():
+    """A nullable field must force real `missing` masks: the
+    missing=None fast representation encodes "~present ⇒ err", which
+    would silently turn explicit NULLs into EvalError when delta
+    materializes the mask as ~present (round-3 advisor finding)."""
+    import time
+    from nebula_tpu.codec import PropType, RowWriter, Schema, SchemaField
+    from nebula_tpu.engine_tpu import csr as csr_mod
+
+    schema = Schema([SchemaField("x", PropType.INT),
+                     SchemaField("opt", PropType.INT, nullable=True)])
+    now = time.time()
+    rows = [(0, RowWriter(schema).set("x", 1).set("opt", 5).encode()),
+            (1, RowWriter(schema).set("x", 2).encode())]   # opt -> NULL
+    cols = csr_mod._build_columns(schema, 4, rows, now, {}, ("t",))
+    c = cols["opt"]
+    assert c.missing is not None
+    assert c.present[0] and not c.missing[0]          # real value
+    assert not c.present[1] and not c.missing[1]      # explicit NULL
+    assert not c.present[2] and c.missing[2]          # no row: err
+    # the non-nullable sibling column sees the no-row slot as err too
+    # (whether via a mask or the fast ~present representation)
+    cx = cols["x"]
+    assert cx.present[0] and cx.present[1]

@@ -397,13 +397,21 @@ def test_pool_session_round_robin_and_reconnect(cluster):
             with pool.session() as s2:
                 assert s2.ping()
                 assert s2._ep.addr != s._ep.addr
-            # kill THIS session's endpoint: the next execute must
-            # re-authenticate against the surviving one and restore
-            # the working space (USE is replayed on reconnect)
+            # kill THIS session's endpoint: a mid-flight MUTATION is
+            # not auto-retried (the server may have applied it before
+            # the connection died — at-least-once hazard), so the
+            # transport error surfaces to the caller...
             dead = s._ep.addr
             (graphd if s._ep.addr == graphd.addr else g2).stop()
-            r = s.must('INSERT VERTEX t(x) VALUES 1:(10)')
+            with pytest.raises(Exception):
+                s.execute('INSERT VERTEX t(x) VALUES 1:(10)')
+            # ...while a READ re-authenticates against the surviving
+            # endpoint and retries transparently, restoring the
+            # working space (USE is replayed on reconnect)
+            assert s.must("SHOW SPACES").code.name == "SUCCEEDED"
             assert s._ep.addr != dead
+            # the caller owns the mutation retry decision
+            s.must('INSERT VERTEX t(x) VALUES 1:(10)')
             assert s.must("FETCH PROP ON t 1").rows
     finally:
         for h in (graphd, g2):
