@@ -30,12 +30,14 @@ invalidation is a delta apply instead of a rebuild.
 """
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..common.stats import stats as global_stats
 from ..common.status import Status, StatusOr
 from ..filter.expressions import (Expression, InputPropExpr, VariablePropExpr)
 from ..parser import ast
@@ -43,6 +45,8 @@ from ..storage.types import BoundResponse, EdgeData, PartResult, VertexData
 from . import traverse
 from .csr import CsrSnapshot
 from .filter_compile import FilterCompiler
+
+_LOG = logging.getLogger("nebula_tpu.engine_tpu")
 
 DEFAULT_MAX_EDGES_PER_VERTEX = 10000
 
@@ -91,7 +95,11 @@ class TpuGraphEngine:
                       "fast_materialize": 0, "slow_materialize": 0,
                       "delta_applies": 0, "delta_edges": 0,
                       "bg_repacks": 0, "sparse_served": 0,
-                      "host_filter_vectorized": 0}
+                      "host_filter_vectorized": 0, "repack_failures": 0}
+        # space -> (consecutive failures, earliest next attempt): a
+        # persistently failing background repack backs off instead of
+        # spinning, and every failure is logged + counted
+        self._repack_backoff: Dict[int, Tuple[int, float]] = {}
         # per-query stage breakdown of the LAST device-served query
         # (snapshot check / kernel / materialize — ref role: per-stage
         # latency in responses, ExecutionPlan.cpp:57) + a serial so the
@@ -280,8 +288,19 @@ class TpuGraphEngine:
 
     def _kick_repack(self, space_id: int) -> None:
         """Rebuild off the query path; queries keep serving the current
-        snapshot (or CPU fallback when poisoned) until the swap."""
+        snapshot (or CPU fallback when poisoned) until the swap.
+
+        A failed build is never silent (ref role: every background
+        path in the reference logs, kvstore/raftex/RaftPart.cpp
+        throughout): it's logged with the traceback, counted in both
+        the engine stats (`repack_failures`) and the global stats
+        manager (`tpu_engine.repack_failures`, visible via
+        /get_stats), and retried with exponential backoff on the next
+        kick — meanwhile queries keep the previous snapshot."""
         if self._repacking.get(space_id):
+            return
+        fails, not_before = self._repack_backoff.get(space_id, (0, 0.0))
+        if time.time() < not_before:
             return
         self._repacking[space_id] = True
 
@@ -293,8 +312,17 @@ class TpuGraphEngine:
                         self._snapshots[space_id] = snap
                     self.stats["rebuilds"] += 1
                     self.stats["bg_repacks"] += 1
+                    self._repack_backoff.pop(space_id, None)
             except Exception:
-                pass
+                n = fails + 1
+                delay = min(2.0 ** (n - 1), 60.0)
+                self._repack_backoff[space_id] = (n, time.time() + delay)
+                self.stats["repack_failures"] += 1
+                global_stats.add_value("tpu_engine.repack_failures")
+                _LOG.exception(
+                    "background repack of space %d failed (consecutive "
+                    "failure %d, next attempt in %.0fs); continuing to "
+                    "serve the previous snapshot", space_id, n, delay)
             finally:
                 self._repacking[space_id] = False
 

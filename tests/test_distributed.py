@@ -165,3 +165,23 @@ def test_sharded_batched_count_matches(snap8):
                     jnp.int32(steps), snap.kernel, req))
                 assert int(out[i]) == single, \
                     (req_list, steps, s, out[i], single)
+
+
+def test_executor_sharded_identity_after_mutation(meshed_pair):
+    """Writes flow into the MESHED snapshot (delta patches / rebuilds)
+    and the sharded path keeps CPU≡TPU identity afterwards — the one
+    executor-level scenario the dryrun entry point exercises that the
+    per-query identity tests above don't. Runs last in this module:
+    it mutates the module-scoped fixture's data."""
+    cpu_conn, tpu_conn, tpu = meshed_pair
+    for stmt in ('INSERT VERTEX player(name, age) VALUES 888:("Mesh", 30)',
+                 "INSERT EDGE like(likeness) VALUES 100 -> 888:(77.0)",
+                 "DELETE EDGE like 100 -> 101"):
+        cpu_conn.must(stmt)
+        tpu_conn.must(stmt)
+    for q in ("GO FROM 100 OVER like YIELD like._dst, like.likeness",
+              "GO 2 STEPS FROM 100 OVER like YIELD like._dst",
+              "FIND SHORTEST PATH FROM 103 TO 888 OVER like UPTO 8 STEPS"):
+        r_cpu, r_tpu = cpu_conn.must(q), tpu_conn.must(q)
+        assert sorted(map(str, r_cpu.rows)) == sorted(map(str, r_tpu.rows)), \
+            (q, r_cpu.rows, r_tpu.rows)
