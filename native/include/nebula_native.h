@@ -76,6 +76,14 @@ void nkv_close(nkv *e);
 int64_t nkv_count(nkv *e);
 int64_t nkv_version(nkv *e);        /* monotonic write counter */
 int64_t nkv_approx_size(nkv *e);    /* total key+value bytes */
+int32_t nkv_run_count(nkv *e);      /* frozen runs currently held */
+
+/* Runtime tuning (config-registry hook; ref role: hot-applied rocksdb
+ * option maps, RocksEngineConfig.cpp). Options: "flush_bytes"
+ * (memtable freeze threshold, >= 4096), "max_runs" (background merge
+ * trigger, >= 1). set: 0 ok, -1 unknown, -2 invalid; get: value or -1. */
+int32_t nkv_set_option(nkv *e, const char *name, int64_t value);
+int64_t nkv_get_option(nkv *e, const char *name);
 
 int32_t nkv_put(nkv *e, const uint8_t *k, int64_t klen,
                 const uint8_t *v, int64_t vlen);

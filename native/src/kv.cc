@@ -57,8 +57,12 @@ constexpr char kMagic[4] = {'N', 'K', 'V', 'C'};
 constexpr char kRunMagic[4] = {'N', 'K', 'V', 'R'};
 constexpr uint32_t kVersion = 1;
 constexpr uint32_t kTombLen = 0xFFFFFFFFu;
-constexpr int64_t kFlushBytes = 64ll << 20;  // memtable freeze threshold
-constexpr size_t kMaxRuns = 8;               // background merge trigger
+// defaults for the per-instance tunables (see nkv_set_option: the
+// config registry hot-updates these at runtime, the role of the
+// reference's nested rocksdb option maps, RocksEngineConfig.cpp /
+// MetaClient.cpp:1294-1429)
+constexpr int64_t kDefaultFlushBytes = 64ll << 20;  // memtable freeze
+constexpr size_t kDefaultMaxRuns = 8;               // merge trigger
 
 std::string next_prefix(const std::string &p) {
   // smallest string greater than every key starting with p
@@ -227,6 +231,9 @@ struct MergeCursor {
 struct nkv {
   MemTable mem;
   int64_t mem_bytes = 0;
+  // runtime-tunable (nkv_set_option, under the exclusive lock)
+  int64_t flush_bytes = kDefaultFlushBytes;
+  size_t max_runs = kDefaultMaxRuns;
   std::vector<RunPtr> runs;  // newest first
   mutable std::shared_mutex mu;
   std::atomic<int64_t> version{0};
@@ -356,7 +363,7 @@ struct nkv {
   }
 
   bool maybe_flush_locked() {
-    if (mem_bytes > kFlushBytes) {
+    if (mem_bytes > flush_bytes) {
       bool ok = flush_mem_locked();
       maybe_merge();
       return ok;
@@ -367,7 +374,7 @@ struct nkv {
   // ---- background merge (compaction role) ---------------------------
   void maybe_merge() {
     // caller holds the exclusive data lock
-    if (runs.size() <= kMaxRuns || merging.exchange(true)) return;
+    if (runs.size() <= max_runs || merging.exchange(true)) return;
     std::lock_guard<std::mutex> tg(merge_mu);
     if (merge_thread.joinable()) merge_thread.join();  // finished thread
     std::vector<RunPtr> snapshot = runs;
@@ -538,6 +545,40 @@ int64_t nkv_count(nkv *e) {
 int64_t nkv_approx_size(nkv *e) {
   std::shared_lock<std::shared_mutex> g(e->mu);
   return e->approx_bytes_locked();
+}
+
+int32_t nkv_run_count(nkv *e) {
+  std::shared_lock<std::shared_mutex> g(e->mu);
+  return static_cast<int32_t>(e->runs.size());
+}
+
+// Runtime engine tuning (the config-registry hook). Applying a smaller
+// flush threshold also flushes an over-threshold memtable immediately,
+// so a hot-set takes effect without waiting for the next write.
+// Returns 0 ok, -1 unknown option, -2 invalid value.
+int32_t nkv_set_option(nkv *e, const char *name, int64_t value) {
+  std::unique_lock<std::shared_mutex> g(e->mu);
+  if (std::strcmp(name, "flush_bytes") == 0) {
+    if (value < 4096) return -2;
+    e->flush_bytes = value;
+    e->maybe_flush_locked();
+    return 0;
+  }
+  if (std::strcmp(name, "max_runs") == 0) {
+    if (value < 1) return -2;
+    e->max_runs = static_cast<size_t>(value);
+    e->maybe_merge();
+    return 0;
+  }
+  return -1;
+}
+
+int64_t nkv_get_option(nkv *e, const char *name) {
+  std::shared_lock<std::shared_mutex> g(e->mu);
+  if (std::strcmp(name, "flush_bytes") == 0) return e->flush_bytes;
+  if (std::strcmp(name, "max_runs") == 0)
+    return static_cast<int64_t>(e->max_runs);
+  return -1;
 }
 
 // Point lookup under the CALLER's shared lock: memtable first, then

@@ -62,6 +62,12 @@ class FlagRegistry:
     def watch(self, fn: Callable[[str, Any], None]) -> None:
         self._watchers.append(fn)
 
+    def unwatch(self, fn: Callable[[str, Any], None]) -> None:
+        try:
+            self._watchers.remove(fn)
+        except ValueError:
+            pass
+
     def items(self) -> List[Tuple[str, Any, str]]:
         return [(f.name, f.value, f.mode) for f in
                 sorted(self._flags.values(), key=lambda f: f.name)]
@@ -148,6 +154,11 @@ storage_flags.declare("snapshot_dir", "/tmp/nebula_tpu_snapshots", REBOOT,
                       "root dir for CREATE SNAPSHOT checkpoints")
 storage_flags.declare("max_edge_returned_per_vertex", 1 << 30, MUTABLE,
                       "per-vertex edge truncation cap")
+storage_flags.declare("kv_engine_options", "", MUTABLE,
+                      "JSON map of native-engine tunables hot-applied to "
+                      'every space engine, e.g. {"flush_bytes": 1048576, '
+                      '"max_runs": 4} (ref role: the nested rocksdb option '
+                      "maps, RocksEngineConfig.cpp)")
 storage_flags.declare("heartbeat_interval_secs", 10, MUTABLE,
                       "storaged -> metad heartbeat period")
 meta_flags.declare("expired_threshold_sec", 10 * 60, MUTABLE,

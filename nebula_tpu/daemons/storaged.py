@@ -7,6 +7,7 @@ Handler endpoints."""
 from __future__ import annotations
 
 import argparse
+import sys
 from dataclasses import dataclass
 from typing import Optional
 
@@ -29,6 +30,7 @@ class StoragedHandle:
     web: Optional[WebService] = None
     node: Optional[object] = None        # StorageNode when replicated
     raft_server: Optional[RpcServer] = None
+    kv_watcher: Optional[object] = None  # storage_flags watcher to detach
 
     @property
     def addr(self) -> str:
@@ -39,6 +41,8 @@ class StoragedHandle:
         return self.web.port if self.web else None
 
     def stop(self) -> None:
+        if self.kv_watcher is not None:
+            storage_flags.unwatch(self.kv_watcher)
         self.meta_client.stop()
         self.server.stop()
         if self.node is not None:
@@ -109,7 +113,8 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
                    cluster_id_file: str = "",
                    replicated: bool = False,
                    data_dir: Optional[str] = None,
-                   advertise_host: Optional[str] = None) -> StoragedHandle:
+                   advertise_host: Optional[str] = None,
+                   engine: str = "native") -> StoragedHandle:
     server = RpcServer(host, port)
     # the address REGISTERED with metad (and dialed by graphd + raft
     # peers) must be routable from other hosts — binding to 0.0.0.0 in
@@ -118,6 +123,17 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
     addr = server.addr
     if advertise_host:
         addr = f"{advertise_host}:{addr.rsplit(':', 1)[1]}"
+    # the storage daemon persists through the native LSM engine like
+    # the reference's always-RocksEngine storaged (kvstore/RocksEngine);
+    # engine="mem" keeps the pure-python MemEngine (tests, no-toolchain
+    # hosts — native_engine_factory itself falls back when the .so is
+    # missing)
+    from ..kvstore import native_engine_factory
+    engine_factory = None
+    if engine == "native":
+        import os as _os
+        engine_factory = native_engine_factory(
+            _os.path.join(data_dir, "engines") if data_dir else None)
     raft_server = None
     node = None
     if replicated:
@@ -133,12 +149,13 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
                            data_root=data_dir or tempfile.mkdtemp(
                                prefix="nebula_tpu_storaged_"),
                            net=raft_net,
+                           engine_factory=engine_factory,
                            leader_hint=storage_addr_of)
         node.raft_net = raft_net  # shut down with the node (handle.stop)
         raft_server.register("raftex", node.service).start()
         store = node.store
     else:
-        store = GraphStore()
+        store = GraphStore(engine_factory=engine_factory)
     mc = MetaClient(meta_addr, local_addr=addr, role="storage",
                     cluster_id_file=cluster_id_file)
 
@@ -192,6 +209,32 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
     # allocation can target this host (waitForMetadReady ordering)
     mc.heartbeat(addr, "storage")
     mc.start(load_interval=load_interval)
+
+    # engine tuning rides the config registry: UPDATE CONFIGS
+    # STORAGE:kv_engine_options='{"flush_bytes":...}' on any graphd
+    # reaches this store within a heartbeat (the MetaClient hb loop
+    # pulls MUTABLE flags; the watcher below hot-applies them — ref
+    # role: RocksEngineConfig.cpp option maps applied at runtime)
+    def _apply_kv_options(name, value):
+        if name != "kv_engine_options" or not value:
+            return
+        import json as _json
+        try:
+            opts = _json.loads(value)
+        except ValueError:
+            print(f"storaged: bad kv_engine_options JSON ignored: "
+                  f"{value!r}", file=sys.stderr)
+            return
+        store.apply_engine_options(opts)
+
+    storage_flags.watch(_apply_kv_options)
+    _apply_kv_options("kv_engine_options",
+                      storage_flags.get("kv_engine_options"))
+    try:
+        storage_flags.sync_to_meta(mc)       # make flags UPDATE-able
+        storage_flags.pull_from_meta(mc)     # adopt cluster-set values
+    except Exception:
+        pass
     sm = SchemaManager(mc)
     storage = StorageService(store, sm, host=addr)
     server.register("storage", storage)
@@ -210,7 +253,8 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
         wc_state["web"] = web
         if wc_state["fired"]:   # wrong-cluster fired before web existed
             web.stop()
-    return StoragedHandle(store, storage, mc, server, web, node, raft_server)
+    return StoragedHandle(store, storage, mc, server, web, node, raft_server,
+                          kv_watcher=_apply_kv_options)
 
 
 def main(argv=None) -> None:

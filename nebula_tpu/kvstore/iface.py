@@ -53,6 +53,16 @@ class KVEngine(abc.ABC):
     write_version: int = 0
     changes = None   # Optional[ChangeRing]
 
+    def set_option(self, name: str, value: int) -> Status:
+        """Hot-apply an engine tuning knob (ref role:
+        RocksEngine::setOption / the nested rocksdb option maps the
+        meta config registry pushes, RocksEngineConfig.cpp). Engines
+        without tunables accept nothing."""
+        return Status.error(f"engine option {name!r} not supported")
+
+    def get_option(self, name: str) -> Optional[int]:
+        return None
+
     def changes_snapshot(self, since: int):
         """(current write_version, raw ring entries since `since` |
         None). The version is read BEFORE the ring pull so the caller's

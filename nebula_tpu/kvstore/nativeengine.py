@@ -293,6 +293,23 @@ class NativeEngine(KVEngine):
     def total_keys(self) -> int:
         return self._lib.nkv_count(self._h)
 
+    def run_count(self) -> int:
+        """Frozen (immutable) runs currently held — compaction-state
+        observability for the tuning tests and /get_stats."""
+        return self._lib.nkv_run_count(self._h)
+
+    def set_option(self, name: str, value: int) -> Status:
+        rc = self._lib.nkv_set_option(self._h, name.encode(), int(value))
+        if rc == 0:
+            return Status.OK()
+        return Status.error(
+            f"engine option {name!r} " +
+            ("not supported" if rc == -1 else f"invalid value {value}"))
+
+    def get_option(self, name: str) -> Optional[int]:
+        v = self._lib.nkv_get_option(self._h, name.encode())
+        return None if v < 0 else int(v)
+
     def close(self) -> None:
         if not self._closed:
             self._lib.nkv_close(self._h)

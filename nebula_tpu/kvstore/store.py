@@ -36,6 +36,7 @@ class GraphStore:
         self._engine_factory = engine_factory or (lambda space_id: MemEngine())
         self._consensus_factory = consensus_factory  # (space,part,engine)->hook
         self._spaces: Dict[int, SpaceInfo] = {}
+        self._engine_options: Dict[str, int] = {}
         self._lock = threading.Lock()
 
     # ------------------------------------------------------------------
@@ -44,8 +45,10 @@ class GraphStore:
     def add_space(self, space_id: int) -> None:
         with self._lock:
             if space_id not in self._spaces:
-                self._spaces[space_id] = SpaceInfo(space_id,
-                                                   self._engine_factory(space_id))
+                info = SpaceInfo(space_id, self._engine_factory(space_id))
+                self._spaces[space_id] = info
+                for k, v in self._engine_options.items():
+                    info.engine.set_option(k, int(v))
 
     def remove_space(self, space_id: int) -> None:
         with self._lock:
@@ -77,6 +80,22 @@ class GraphStore:
     def parts(self, space_id: int) -> List[int]:
         info = self._spaces.get(space_id)
         return sorted(info.parts) if info else []
+
+    def apply_engine_options(self, opts: Dict[str, int]) -> int:
+        """Hot-apply engine tuning knobs to every space engine, and to
+        engines of spaces added later (the config-registry path; ref
+        role: MetaClient applying nested rocksdb option maps at
+        runtime, MetaClient.cpp:1294-1429). Returns how many
+        (engine, option) applications the engines accepted."""
+        with self._lock:
+            self._engine_options = {k: int(v) for k, v in opts.items()}
+            engines = [i.engine for i in self._spaces.values()]
+        n = 0
+        for e in engines:
+            for k, v in opts.items():
+                if e.set_option(k, int(v)).ok():
+                    n += 1
+        return n
 
     def space_engine(self, space_id: int) -> Optional[KVEngine]:
         info = self._spaces.get(space_id)

@@ -20,7 +20,12 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional, Set
 
-from ..common.flags import storage_flags
+from ..common.flags import graph_flags, meta_flags, storage_flags
+
+
+def _flag_registry_for_role(role: str):
+    return {"storage": storage_flags, "graph": graph_flags,
+            "meta": meta_flags}.get(role)
 from ..common.status import ErrorCode
 from ..rpc import proxy
 
@@ -141,6 +146,16 @@ class MetaClient:
                         except Exception:
                             pass
                     return
+            except Exception:
+                pass
+            # hot config pull rides the heartbeat (the reference pulls
+            # gflags in MetaClient's bg thread, MetaClient.cpp:1294):
+            # MUTABLE flags set cluster-wide via UPDATE CONFIGS reach
+            # every daemon within one heartbeat period
+            try:
+                reg = _flag_registry_for_role(self.role)
+                if reg is not None:
+                    reg.pull_from_meta(self._rpc)
             except Exception:
                 pass
             self._stop.wait(storage_flags.get("heartbeat_interval_secs", 10))

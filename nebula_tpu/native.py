@@ -93,6 +93,12 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     for fn in ("nkv_count", "nkv_version", "nkv_approx_size"):
         getattr(lib, fn).restype = i64
         getattr(lib, fn).argtypes = [vp]
+    lib.nkv_run_count.restype = i32
+    lib.nkv_run_count.argtypes = [vp]
+    lib.nkv_set_option.restype = i32
+    lib.nkv_set_option.argtypes = [vp, ctypes.c_char_p, i64]
+    lib.nkv_get_option.restype = i64
+    lib.nkv_get_option.argtypes = [vp, ctypes.c_char_p]
     lib.nkv_put.restype = i32
     lib.nkv_put.argtypes = [vp, ctypes.c_char_p, i64, ctypes.c_char_p, i64]
     lib.nkv_get.restype = i64

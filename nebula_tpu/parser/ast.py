@@ -643,7 +643,8 @@ class ConfigSentence(Sentence):
     kind = Kind.CONFIG
 
     def to_string(self) -> str:
-        s = f"{self.action} CONFIGS"
+        # SET parses/prints as the reference's UPDATE CONFIGS form
+        s = f"{'UPDATE' if self.action == 'SET' else self.action} CONFIGS"
         if self.module:
             s += f" {self.module}"
         if self.name:

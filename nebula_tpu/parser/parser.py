@@ -623,6 +623,17 @@ class GQLParser:
 
     def _update(self):
         verb = self._expect("UPDATE", "UPSERT").type
+        if verb == "UPDATE" and self._accept("CONFIGS"):
+            # UPDATE CONFIGS [module:]name = value (ref parser rule:
+            # config_sentence, UPDATE CONFIGS variant)
+            module = None
+            if self._at("GRAPH", "META", "STORAGE"):
+                module = self._expect("GRAPH", "META", "STORAGE").type
+                self._accept(":")
+            name = self._ident("config name")
+            self._expect("=")
+            return ast.ConfigSentence("SET", module, name,
+                                      self._expression())
         insertable = verb == "UPSERT"
         what = self._expect("VERTEX", "EDGE").type
         if what == "VERTEX":

@@ -440,3 +440,30 @@ def test_nullable_schema_builds_missing_masks():
     # (whether via a mask or the fast ~present representation)
     cx = cols["x"]
     assert cx.present[0] and cx.present[1]
+
+
+def test_engine_option_hot_set_controls_flush_and_merge():
+    """nkv_set_option (config-registry hook, ref role: hot-applied
+    rocksdb option maps, RocksEngineConfig.cpp): a smaller flush
+    threshold freezes the memtable into runs; max_runs drives merge."""
+    from nebula_tpu import native
+    from nebula_tpu.kvstore.nativeengine import NativeEngine
+    if not native.available():
+        import pytest
+        pytest.skip("native lib not built")
+    e = NativeEngine()
+    assert e.get_option("flush_bytes") == 64 << 20
+    assert e.get_option("max_runs") == 8
+    assert e.get_option("nope") is None
+    assert not e.set_option("nope", 1).ok()
+    assert not e.set_option("flush_bytes", 16).ok()   # below floor
+    assert e.run_count() == 0
+    assert e.set_option("flush_bytes", 4096).ok()
+    assert e.set_option("max_runs", 2).ok()
+    for i in range(2000):
+        e.put(b"k%05d" % i, b"v" * 64)
+    assert e.run_count() >= 1
+    # every key still readable through the memtable+runs merged view
+    assert e.get(b"k00000") == b"v" * 64
+    assert e.get(b"k01999") == b"v" * 64
+    e.close()

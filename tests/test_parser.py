@@ -215,6 +215,13 @@ def test_configs():
     assert s.action == "SHOW" and s.module == "GRAPH"
     s = parse1("GET CONFIGS STORAGE:foo_bar")
     assert s.action == "GET" and s.name == "foo_bar"
+    s = parse1('UPDATE CONFIGS STORAGE:kv_engine_options = "{}"')
+    assert s.action == "SET" and s.module == "STORAGE"
+    assert s.name == "kv_engine_options"
+    # round-trips through to_string (the UPDATE CONFIGS print form)
+    assert parse1(s.to_string()).to_string() == s.to_string()
+    s = parse1("UPDATE CONFIGS slow_op_threshold_ms = 10")
+    assert s.action == "SET" and s.module is None and s.value is not None
 
 
 def test_balance():

@@ -456,3 +456,64 @@ def test_storaged_advertise_host(cluster):
         assert not any(a.startswith("0.0.0.0") for a in hosts), hosts
     finally:
         h.stop()
+
+
+def test_engine_options_hot_set_via_update_configs():
+    """UPDATE CONFIGS STORAGE:kv_engine_options on a graphd reaches the
+    storaged's native engines within a heartbeat: set_config in the
+    meta registry -> MetaClient hb pull -> flag watcher ->
+    GraphStore.apply_engine_options -> nkv_set_option. Observed by the
+    engine's flush threshold changing and writes freezing into runs
+    (ref role: nested rocksdb option maps applied at runtime,
+    RocksEngineConfig.cpp / MetaClient.cpp:1294-1429)."""
+    from nebula_tpu import native
+    if not native.available():
+        pytest.skip("native lib not built")
+    from nebula_tpu.common.flags import storage_flags
+    old_hb = storage_flags.get("heartbeat_interval_secs")
+    storage_flags.set("heartbeat_interval_secs", 0.2)
+    metad = serve_metad()
+    sd = serve_storaged(metad.addr, load_interval=0.1)
+    graphd = serve_graphd(metad.addr)
+    try:
+        client = GraphClient(graphd.addr).connect()
+        r = client.execute("CREATE SPACE cfg_sp(partition_num=2)")
+        assert r.ok(), r.error_msg
+        space_id = metad.meta.get_space("cfg_sp").value().space_id
+        _wait(lambda: sd.store.space_engine(space_id) is not None,
+              msg="space engine created")
+        eng = sd.store.space_engine(space_id)
+        assert eng.get_option("flush_bytes") == 64 << 20
+        r = client.execute(
+            "UPDATE CONFIGS STORAGE:kv_engine_options = "
+            "'{\"flush_bytes\": 4096, \"max_runs\": 2}'")
+        assert r.ok(), r.error_msg
+        _wait(lambda: eng.get_option("flush_bytes") == 4096, timeout=10,
+              msg="hot-set option to reach the engine via heartbeat")
+        assert eng.get_option("max_runs") == 2
+        # the tuned threshold takes effect: bulk writes freeze runs
+        r = client.execute("USE cfg_sp")
+        assert r.ok()
+        client.execute("CREATE TAG cfg_t(x string)")
+        _wait(lambda: client.execute(
+            'INSERT VERTEX cfg_t(x) VALUES 1:("seed")').ok(),
+            msg="schema visible to storaged")
+        big = "v" * 200
+        for i in range(2, 60):
+            r = client.execute(
+                f'INSERT VERTEX cfg_t(x) VALUES {i}:("{big}")')
+            assert r.ok(), r.error_msg
+        assert eng.run_count() >= 1
+        # a space created AFTER the hot-set inherits the options
+        r = client.execute("CREATE SPACE cfg_sp2(partition_num=1)")
+        assert r.ok()
+        sid2 = metad.meta.get_space("cfg_sp2").value().space_id
+        _wait(lambda: sd.store.space_engine(sid2) is not None,
+              msg="second space engine")
+        assert sd.store.space_engine(sid2).get_option("flush_bytes") == 4096
+    finally:
+        storage_flags.set("heartbeat_interval_secs", old_hb)
+        storage_flags.set("kv_engine_options", "")
+        metad.meta.set_config("STORAGE", "kv_engine_options", "")
+        for h in (graphd, sd, metad):
+            h.stop()
