@@ -332,6 +332,41 @@ def bench_full_queries(conn, tpu, snap, etype, seed_sets):
     return p50, p99, qps1, cpu_ms
 
 
+def bench_stats_query(conn, tpu, seed_sets):
+    """Stats pushdown at SNB scale: GO | YIELD COUNT/SUM/AVG served as
+    one masked device reduction (engine_tpu/aggregate.py — the
+    bound_stats role, ref storage.thrift StatType) vs the CPU pipe's
+    materialize-then-aggregate over the same query."""
+    def q(seed):
+        return (f"GO {STEPS} STEPS FROM {seed} OVER knows "
+                f"YIELD knows.ts AS t | YIELD COUNT(*) AS n, "
+                f"SUM($-.t) AS s, AVG($-.t) AS a")
+    seeds = [s[0] for s in seed_sets[:max(3, LAT_N // 4)]]
+    conn.must(q(seeds[0]))          # warm/compile
+    a0 = tpu.stats["agg_served"]
+    lats = []
+    for seed in seeds:
+        t1 = time.time()
+        rt = conn.must(q(seed))
+        lats.append((time.time() - t1) * 1000)
+    served = tpu.stats["agg_served"] - a0
+    p50 = float(np.percentile(np.array(lats), 50))
+    tpu.enabled = False
+    try:
+        t1 = time.time()
+        rc = conn.must(q(seeds[-1]))
+        cpu_ms = (time.time() - t1) * 1000
+    finally:
+        tpu.enabled = True
+    ident = rt.rows == rc.rows
+    log(f"stats query (COUNT/SUM/AVG over {STEPS}-hop edges): device "
+        f"p50={p50:.1f}ms ({served}/{len(seeds)} device-served), CPU "
+        f"pipe {cpu_ms:.0f}ms; identity: {ident}")
+    assert ident, (rt.rows, rc.rows)
+    return {"p50_ms": round(p50, 1), "cpu_pipe_ms": round(cpu_ms, 1),
+            "device_served": int(served)}
+
+
 def bench_cpu_scan(cluster, sid, etype, seeds, label):
     """The CPU storage scatter/gather path (get_neighbors fan-out with
     frontier dedup — what GoExecutor drives), over whatever engine the
@@ -428,6 +463,7 @@ def main():
         cluster, tpu, sid, etype, seed_sets)
     p50, p99, qps1, cpu_q_ms = bench_full_queries(
         conn, tpu, snap, etype, seed_sets)
+    stats_extra = bench_stats_query(conn, tpu, seed_sets)
     # CPU baselines measure a RATE — a seed subset keeps the python
     # materialization of the scan bounded at SNB scale
     cpu_seeds = seed_sets[0][:8]
@@ -461,6 +497,7 @@ def main():
         "tier2_full_query_ms": {"p50": round(p50, 1), "p99": round(p99, 1),
                                 "qps_batch1": round(qps1, 1),
                                 "cpu_same_query_p50_ms": round(cpu_q_ms, 1)},
+        "stats_query": stats_extra,
     }))
 
 

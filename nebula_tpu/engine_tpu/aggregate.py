@@ -1,0 +1,93 @@
+"""Device-side aggregation pushdown: GO … | YIELD <aggregates>.
+
+The reference ships aggregates to storage as bound_stats
+(ref: storage/QueryStatsProcessor, storage.thrift StatType :65-69) so
+SUM/COUNT/AVG never materialize edges on graphd. The TPU equivalent is
+a masked reduction over the snapshot's [P, cap_e] edge block: the
+final-hop mask comes from the same multi-hop kernel the GO path uses,
+the value columns from the same FilterCompiler leaf loaders (so
+null/err semantics are shared with WHERE compilation), and only the
+per-partition partial aggregates leave the device.
+
+Exactness discipline (the module's reason to exist — a float32
+`jnp.sum` would silently diverge from the CPU's arbitrary-precision
+Python sum):
+
+  COUNT    popcount of the row mask in int32 — exact (cap_e < 2^31).
+  SUM/AVG  int32 values are bias-shifted to uint32 and split into four
+           8-bit digits; each digit column is summed per partition in
+           CHUNKS of 2^22 slots (chunk_sum <= 2^22 * 255 < 2^30, so
+           every int32 partial is exact at ANY cap_e) and the host
+           reassembles the exact integer sum in Python ints. AVG
+           divides the exact sum on the host, matching the CPU's
+           sum()/len().
+  MIN/MAX  int32 lattice ops under the mask — exact.
+
+DOUBLE props are declined by the shared leaf loader (float32 mirror),
+exactly as WHERE compilation declines them.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+# digit-partial chunk width: 2^22 slots * 255 < 2^30 keeps every int32
+# chunk sum exact regardless of cap_e
+SUM_CHUNK = 1 << 22
+
+_BIAS = 1 << 31
+
+
+def exact_int_sum(value, mask) -> int:
+    """Exact sum of int32 `value` over bool `mask`, both [P, cap_e]
+    device arrays, via chunked per-partition 8-bit digit partials."""
+    import jax.numpy as jnp
+    u = value.astype(jnp.uint32) + jnp.uint32(_BIAS)
+    m = mask
+    n = int(jnp.sum(m))
+    P, cap = u.shape
+    pad = (-cap) % SUM_CHUNK
+    if pad:
+        u = jnp.pad(u, ((0, 0), (0, pad)))
+        m = jnp.pad(m, ((0, 0), (0, pad)))
+    u = u.reshape(P, -1, SUM_CHUNK)
+    m = m.reshape(P, -1, SUM_CHUNK)
+    total = 0
+    for k in range(4):
+        d = ((u >> jnp.uint32(8 * k)) & jnp.uint32(0xFF)).astype(jnp.int32)
+        part = np.asarray(jnp.sum(jnp.where(m, d, 0), axis=-1))
+        total += int(part.astype(object).sum()) << (8 * k)
+    return total - n * _BIAS
+
+
+def reduce_specs(specs: List[Tuple[str, Optional[object]]], active,
+                 vals: dict) -> Optional[List]:
+    """Evaluate each (fun, key) agg spec over the `active` row mask.
+    `vals` maps key -> the compiled _Val for that edge prop (key None =
+    row-count only). Returns the single result row (CPU-identical
+    Python values), or None when an exactness bound is hit."""
+    import jax.numpy as jnp
+    n_rows = int(jnp.sum(active))
+    row: List = []
+    for fun, key in specs:
+        if fun == "COUNT":
+            # CPU COUNT counts every row including NULL values
+            row.append(n_rows)
+            continue
+        v = vals[key]
+        m = active & ~v.null
+        n = int(jnp.sum(m))
+        if n == 0:
+            row.append(None)     # CPU: no non-null values -> None
+            continue
+        if fun == "MIN":
+            row.append(int(jnp.min(jnp.where(m, v.value,
+                                             jnp.int32(2**31 - 1)))))
+        elif fun == "MAX":
+            row.append(int(jnp.max(jnp.where(m, v.value,
+                                             jnp.int32(-(2**31))))))
+        else:
+            s = exact_int_sum(v.value, m)
+            row.append(s if fun == "SUM" else s / n)   # AVG: host float
+    return row

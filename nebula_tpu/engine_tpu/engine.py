@@ -95,7 +95,8 @@ class TpuGraphEngine:
                       "fast_materialize": 0, "slow_materialize": 0,
                       "delta_applies": 0, "delta_edges": 0,
                       "bg_repacks": 0, "sparse_served": 0,
-                      "host_filter_vectorized": 0, "repack_failures": 0}
+                      "host_filter_vectorized": 0, "repack_failures": 0,
+                      "agg_served": 0}
         # space -> (consecutive failures, earliest next attempt): a
         # persistently failing background repack backs off instead of
         # spinning, and every failure is logged + counted
@@ -503,6 +504,131 @@ class TpuGraphEngine:
         self._record_profile("dense", t_snap, t_kernel,
                              time.monotonic() - t2, snap)
         return StatusOr.of(result)
+
+    # ------------------------------------------------------------------
+    # GO | YIELD <aggregates> on device (bound_stats role on TPU)
+    # ------------------------------------------------------------------
+    def execute_go_aggregate(self, ctx, s: ast.GoSentence, specs,
+                             out_cols: List[str], starts: List[int],
+                             edge_types: List[int],
+                             alias_map: Dict[str, str],
+                             name_by_type: Dict[int, str]):
+        """Serve `GO … | YIELD <aggregates>` as a masked device
+        reduction over the final-hop edge block instead of
+        materializing rows (ref role: QueryStatsProcessor /
+        storage.thrift bound_stats :65-69; device math in
+        aggregate.py). `specs` is [(fun, EdgePropExpr|None)] aligned
+        with `out_cols`. Returns a one-row Result, or None to fall
+        back to the CPU pipe — every declined case (delta adds in
+        play, non-device filter, non-int props, err cells the CPU
+        would raise EvalError for) keeps CPU≡TPU identity by
+        construction."""
+        from ..graph import executors as ex
+        if len(edge_types) > traverse.MAX_EDGE_TYPES_PER_QUERY:
+            return None
+        with self._lock:
+            return self._go_aggregate_locked(ctx, s, specs, out_cols,
+                                             starts, edge_types, alias_map,
+                                             name_by_type, ex)
+
+    def _go_aggregate_locked(self, ctx, s, specs, out_cols, starts,
+                             edge_types, alias_map, name_by_type, ex):
+        from . import aggregate
+        from .filter_compile import FilterCompiler, _Unsupported
+        t0 = time.monotonic()
+        snap = self._snapshot_locked(ctx.space_id())
+        t_snap = time.monotonic() - t0
+        if snap is None:
+            self.stats["fallbacks"] += 1
+            return None
+        if snap.delta is not None and snap.delta.edge_count > 0:
+            # buffered adds live outside the canonical block; the CPU
+            # pipe aggregates them exactly (tombstones/prop patches are
+            # already folded into the canonical arrays)
+            return None
+        frontier0 = snap.frontier_from_vids(starts)
+        if not frontier0.any():
+            row = tuple(0 if f == "COUNT" else None for f, _ in specs)
+            return StatusOr.of(ex.InterimResult(out_cols, [row]))
+        # small frontiers: the CPU pipe over the sparse pull is faster
+        # than a dense O(E) dispatch — same routing as execute_go
+        if getattr(snap, "sharded_kernel", None) is None and \
+                self._sparse_expand(snap, starts, edge_types,
+                                    int(s.step.steps)) is not None:
+            return None
+        device_mask, local_filter = self._plan_filter(
+            ctx, s, snap, False, name_by_type, alias_map, edge_types)
+        if local_filter is not None:
+            return None    # WHERE outside the device compiler
+        fc = FilterCompiler(snap, self._sm, ctx.space_id(), name_by_type,
+                            alias_map, edge_types)
+        # value columns for SUM/AVG/MIN/MAX — int-only (exactness)
+        vals: Dict[Any, Any] = {}
+        keyed_specs = []
+        for fun, e in specs:
+            if fun == "COUNT":
+                keyed_specs.append((fun, None))
+                continue
+            key = (e.edge, e.prop)
+            if key not in vals:
+                try:
+                    allowed = None
+                    if e.edge is not None:
+                        canon = alias_map.get(e.edge, e.edge)
+                        allowed = [t for t in edge_types
+                                   if name_by_type.get(abs(t)) == canon]
+                        if not allowed:
+                            return None
+                    v = fc._edge_prop_val(e.prop, allowed)
+                except _Unsupported:
+                    return None
+                if v.kind != "num" or v.intlike is not True:
+                    return None
+                vals[key] = v
+            keyed_specs.append((fun, key))
+        # every LEFT yield column the CPU would evaluate per row can
+        # raise EvalError on err cells — compile their err masks too
+        # (underscore pseudo-props never err)
+        from ..filter.expressions import (EdgeDstIdExpr, EdgePropExpr,
+                                          EdgeRankExpr, EdgeSrcIdExpr,
+                                          EdgeTypeExpr)
+        err_masks = [v.err for v in vals.values()]
+        for c in ex._go_yield_columns(s, ctx, name_by_type):
+            e = c.expr
+            if isinstance(e, (EdgeDstIdExpr, EdgeSrcIdExpr, EdgeRankExpr,
+                              EdgeTypeExpr)):
+                continue    # pseudo-props read key parts, never err
+            if isinstance(e, EdgePropExpr) and e.prop.startswith("_"):
+                continue
+            try:
+                err_masks.append(fc._compile(e).err)
+            except _Unsupported:
+                return None
+        import jax.numpy as jnp
+        f0 = jnp.asarray(frontier0)
+        req = jnp.asarray(traverse.pad_edge_types(edge_types))
+        t1 = time.monotonic()
+        if getattr(snap, "sharded_kernel", None) is not None:
+            from . import distributed
+            _, active = distributed.multi_hop_sharded(
+                self.mesh, f0, jnp.int32(s.step.steps),
+                snap.sharded_kernel, req)
+            self.stats["sharded_queries"] += 1
+        else:
+            _, active = traverse.multi_hop(f0, s.step.steps, snap.kernel,
+                                           req)
+        if device_mask is not None:
+            active = active & device_mask
+        for em in err_masks:
+            if bool(jnp.any(active & em)):
+                return None    # CPU raises EvalError for these rows
+        row = aggregate.reduce_specs(keyed_specs, active, vals)
+        t_kernel = time.monotonic() - t1
+        if row is None:
+            return None
+        self.stats["agg_served"] += 1
+        self._record_profile("aggregate", t_snap, t_kernel, 0.0, snap)
+        return StatusOr.of(ex.InterimResult(out_cols, [tuple(row)]))
 
     def _compile_host_filter(self, ctx, snap, flt, name_by_type,
                              alias_map, edge_types):

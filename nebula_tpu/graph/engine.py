@@ -114,6 +114,11 @@ class ExecutionEngine:
             return StatusOr.from_status(st)
         kind = s.kind
         if kind == ast.Kind.PIPE:
+            # GO | YIELD <aggregates>: one masked device reduction
+            # instead of materialize-then-aggregate (bound_stats role)
+            ar = ex.try_device_aggregate(ctx, s)
+            if ar is not None:
+                return ar
             lr = self._run(ctx, s.left)
             if not lr.ok():
                 return lr

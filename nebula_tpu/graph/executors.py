@@ -1032,6 +1032,75 @@ def _aggregate_rows(cols: List[ast.YieldColumn], rows: List[Tuple]) -> Result:
     return _ok(InterimResult([c.name() for c in cols], [tuple(out_row)]))
 
 
+# aggregates the device reduction path serves exactly (aggregate.py's
+# int-exact surface); the rest (STD, BIT_*, COLLECT, COUNT_DISTINCT)
+# stay on the CPU pipe
+_DEVICE_AGGS = {"COUNT", "SUM", "AVG", "MIN", "MAX"}
+
+
+def try_device_aggregate(ctx: ExecContext, pipe) -> Optional[Result]:
+    """`GO … | YIELD <aggregates only>` served as a masked device
+    reduction (bound_stats role on TPU — ref storage.thrift StatType
+    :65-69; math in engine_tpu/aggregate.py). Returns the one-row
+    Result, or None to run the generic pipe. Every pattern gate keeps
+    CPU≡TPU identity: anything outside the exact surface (mixed
+    agg/non-agg yields, DISTINCT, WHERE on the yield, input-ref GOs,
+    non-edge-prop aggregate args) falls through."""
+    tpu = getattr(ctx.engine, "tpu_engine", None)
+    if tpu is None or not isinstance(pipe.left, ast.GoSentence) \
+            or not isinstance(pipe.right, ast.YieldSentence):
+        return None
+    s, y = pipe.left, pipe.right
+    if y.where is not None or y.yield_ is None or y.yield_.distinct:
+        return None
+    cols = y.yield_.columns
+    if not cols or not all(c.agg_fun in _DEVICE_AGGS for c in cols):
+        return None
+    if s.step.upto or int(s.step.steps) < 1 or \
+            (s.yield_ and s.yield_.distinct):
+        return None
+    if not ctx.require_space().ok():
+        return None
+    space = ctx.space_id()
+    if not tpu.can_serve(space, s):
+        return None
+    starts_r = resolve_starts(ctx, s.from_)
+    if not starts_r.ok() or not starts_r.value():
+        return None
+    over_r = resolve_over(ctx, s.over)
+    if not over_r.ok() or not over_r.value()[0]:
+        return None
+    edge_types, alias_map, name_by_type = over_r.value()
+    left_cols = _go_yield_columns(s, ctx, name_by_type)
+    left_exprs = [c.expr for c in left_cols]
+    if s.where:
+        left_exprs.append(s.where.filter)
+    _, _, needs_input = _collect_prop_requirements(left_exprs, ctx)
+    if needs_input:
+        return None    # per-root attribution: CPU loop
+    by_name = {c.name(): c.expr for c in left_cols}
+    specs = []
+    for c in cols:
+        e = c.expr
+        if c.agg_fun == "COUNT":
+            # COUNT(*) parses as Literal(1); COUNT($-.x) counts every
+            # row (nulls included) as long as the column exists
+            if isinstance(e, Literal) or (
+                    isinstance(e, InputPropExpr) and e.prop in by_name):
+                specs.append(("COUNT", None))
+                continue
+            return None
+        if not isinstance(e, InputPropExpr):
+            return None
+        src = by_name.get(e.prop)
+        if not isinstance(src, EdgePropExpr) or src.prop.startswith("_"):
+            return None
+        specs.append((c.agg_fun, src))
+    return tpu.execute_go_aggregate(
+        ctx, s, specs, [c.name() for c in cols], starts_r.value(),
+        edge_types, alias_map, name_by_type)
+
+
 def execute_group_by(ctx: ExecContext, s: ast.GroupBySentence) -> Result:
     if ctx.input is None:
         return _ok(None)

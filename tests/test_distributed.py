@@ -167,6 +167,19 @@ def test_sharded_batched_count_matches(snap8):
                     (req_list, steps, s, out[i], single)
 
 
+def test_executor_sharded_aggregate_identity(meshed_pair):
+    """GO | YIELD <aggregates> through the MESHED engine: the reduction
+    runs over the sharded multi-hop mask (note: runs before the
+    mutation test below in module order)."""
+    cpu_conn, tpu_conn, tpu = meshed_pair
+    before = tpu.stats["agg_served"]
+    q = ("GO FROM 100, 101, 102 OVER serve YIELD serve.start_year AS y"
+         " | YIELD COUNT(*) AS n, SUM($-.y) AS s, MIN($-.y) AS lo")
+    rc, rt = cpu_conn.must(q), tpu_conn.must(q)
+    assert rc.rows == rt.rows, (rc.rows, rt.rows)
+    assert tpu.stats["agg_served"] == before + 1, tpu.stats
+
+
 def test_executor_sharded_identity_after_mutation(meshed_pair):
     """Writes flow into the MESHED snapshot (delta patches / rebuilds)
     and the sharded path keeps CPU≡TPU identity afterwards — the one
@@ -185,3 +198,4 @@ def test_executor_sharded_identity_after_mutation(meshed_pair):
         r_cpu, r_tpu = cpu_conn.must(q), tpu_conn.must(q)
         assert sorted(map(str, r_cpu.rows)) == sorted(map(str, r_tpu.rows)), \
             (q, r_cpu.rows, r_tpu.rows)
+

@@ -794,3 +794,110 @@ def test_all_paths_random_graph_identity():
             assert sorted(map(repr, r_cpu.rows)) == \
                 sorted(map(repr, r_tpu.rows)), q
             assert tpu.stats["path_served"] > before, q
+
+
+# ---------------------------------------------------------------------------
+# device aggregation pushdown: GO | YIELD <aggregates> (bound_stats role)
+# ---------------------------------------------------------------------------
+
+AGG_QUERIES = [
+    "GO FROM 100 OVER serve YIELD serve.start_year AS y"
+    " | YIELD COUNT(*) AS n, SUM($-.y) AS s, AVG($-.y) AS a,"
+    " MIN($-.y) AS lo, MAX($-.y) AS hi",
+    "GO FROM 100, 101, 102 OVER serve YIELD serve.start_year AS y"
+    " | YIELD SUM($-.y), COUNT($-.y)",
+    "GO 2 STEPS FROM 100 OVER like YIELD like._dst AS d"
+    " | YIELD COUNT(*) AS n",
+    "GO FROM 100 OVER serve WHERE serve.start_year > 1995"
+    " YIELD serve.start_year AS y | YIELD COUNT(*), SUM($-.y)",
+]
+
+
+@pytest.fixture()
+def agg_pair():
+    """Function-scoped pair with the dense device path forced (the NBA
+    graph is tiny, so the sparse CPU-side pull would otherwise win the
+    routing and the pushdown would never trigger)."""
+    _, cpu_conn = load_nba()
+    tpu = TpuGraphEngine()
+    cluster = InProcCluster(tpu_engine=tpu)
+    _, tpu_conn = load_nba(cluster)
+    tpu.sparse_edge_budget = 0
+    return cpu_conn, tpu_conn, tpu, cluster
+
+
+@pytest.mark.parametrize("query", AGG_QUERIES)
+def test_device_aggregate_identity(agg_pair, query):
+    cpu_conn, tpu_conn, tpu, _ = agg_pair
+    rc, rt = cpu_conn.must(query), tpu_conn.must(query)
+    assert rc.columns == rt.columns
+    assert rc.rows == rt.rows, (query, rc.rows, rt.rows)
+    assert tpu.stats["agg_served"] == 1, (query, tpu.stats)
+
+
+def test_device_aggregate_empty_results_identical(agg_pair):
+    """Empty frontiers (known vid without matching edges, and unknown
+    vid) aggregate identically whichever path serves them: COUNT 0,
+    SUM/AVG None."""
+    cpu_conn, tpu_conn, tpu, _ = agg_pair
+    for q in ("GO FROM 121 OVER serve YIELD serve.start_year AS y"
+              " | YIELD COUNT(*), SUM($-.y), AVG($-.y)",
+              "GO FROM 999999 OVER serve YIELD serve.start_year AS y"
+              " | YIELD COUNT(*), SUM($-.y)"):
+        rc, rt = cpu_conn.must(q), tpu_conn.must(q)
+        assert rc.rows == rt.rows, (q, rc.rows, rt.rows)
+        assert rc.rows[0][0] == 0 and rc.rows[0][1] is None
+
+
+def test_device_aggregate_declines_double_and_stays_identical(agg_pair):
+    """likeness is DOUBLE — outside the int-exact device surface; the
+    CPU pipe serves it and results stay identical."""
+    cpu_conn, tpu_conn, tpu, _ = agg_pair
+    q = ("GO FROM 100 OVER like YIELD like.likeness AS w"
+         " | YIELD SUM($-.w) AS s, COUNT(*) AS n")
+    rc, rt = cpu_conn.must(q), tpu_conn.must(q)
+    assert rc.rows == rt.rows
+    assert tpu.stats["agg_served"] == 0, tpu.stats
+
+
+def test_device_aggregate_exact_beyond_int32(agg_pair):
+    """The digit-decomposed device sum must be EXACT where a naive
+    int32 (or float32) reduction would overflow/round: two int32-max
+    start_years sum to 2^32-2."""
+    cpu_conn, tpu_conn, tpu, _ = agg_pair
+    big = 2**31 - 1
+    for conn in (cpu_conn, tpu_conn):
+        conn.must('INSERT VERTEX player(name, age) VALUES 9901:("B1", 30)')
+        conn.must(f"INSERT EDGE serve(start_year, end_year) "
+                  f"VALUES 9901 -> 201:({big}, {big})")
+        conn.must(f"INSERT EDGE serve(start_year, end_year) "
+                  f"VALUES 9901 -> 202:({big}, {big})")
+    # writes land in the delta: repack so the canonical block holds them
+    q = ("GO FROM 9901 OVER serve YIELD serve.start_year AS y"
+         " | YIELD SUM($-.y) AS s, COUNT(*) AS n, AVG($-.y) AS a")
+    rc = cpu_conn.must(q)
+    assert rc.rows == [(2 * big, 2, float(big))]
+    # drop any cached snapshot so the canonical rebuild includes the
+    # inserts (delta adds would decline the pushdown)
+    tpu._snapshots.clear()
+    rt = tpu_conn.must(q)
+    assert rt.rows == rc.rows
+    assert tpu.stats["agg_served"] == 1, tpu.stats
+
+
+def test_device_aggregate_declines_on_delta_adds(agg_pair):
+    """Buffered delta adds keep the CPU pipe in charge — and identity."""
+    cpu_conn, tpu_conn, tpu, _ = agg_pair
+    base = "GO FROM 100 OVER serve YIELD serve.start_year AS y" \
+           " | YIELD COUNT(*) AS n, SUM($-.y) AS s"
+    tpu_conn.must(base)               # builds the snapshot
+    assert tpu.stats["agg_served"] == 1
+    for conn in (cpu_conn, tpu_conn):
+        conn.must("INSERT EDGE serve(start_year, end_year) "
+                  "VALUES 100 -> 202:(2001, 2002)")
+    rc, rt = cpu_conn.must(base), tpu_conn.must(base)
+    assert rc.rows == rt.rows
+    snap = tpu._snapshots.get(list(tpu._snapshots)[0])
+    if snap is not None and snap.delta is not None \
+            and snap.delta.edge_count > 0:
+        assert tpu.stats["agg_served"] == 1, tpu.stats
