@@ -1,0 +1,89 @@
+"""Wire-protocol conformance: the codec must match the frozen v1 spec
+(docs/manual/6-wire-protocol.md + wire-vectors.json) byte-for-byte in
+both directions, and the registry assignment must never drift — the
+spec is what lets a non-Python client speak to graphd (the capability
+the reference gets from thrift IDL, src/interface/graph.thrift)."""
+import dataclasses
+import enum
+import json
+import os
+
+import pytest
+
+from nebula_tpu.common.status import ErrorCode, Status, StatusOr
+from nebula_tpu.rpc import wire
+
+VECTORS = os.path.join(os.path.dirname(__file__), "..", "docs", "manual",
+                       "wire-vectors.json")
+
+with open(VECTORS) as f:
+    SPEC = json.load(f)
+
+wire.encode(None)   # force registry init
+_BY_NAME = {t.__name__: t for t in wire._registry}
+
+
+def from_json(v):
+    """Inverse of the vector file's JSON rendering (spec §6)."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    if isinstance(v, list):
+        return [from_json(x) for x in v]
+    assert isinstance(v, dict), v
+    if "$bytes" in v:
+        return bytes.fromhex(v["$bytes"])
+    if "$tuple" in v:
+        return tuple(from_json(x) for x in v["$tuple"])
+    if "$map" in v:
+        return {from_json(k): from_json(x) for k, x in v["$map"]}
+    if "$enum" in v:
+        return _BY_NAME[v["$enum"]](v["value"])
+    if "$struct" in v:
+        t = _BY_NAME[v["$struct"]]
+        fields = [from_json(x) for x in v["fields"]]
+        return t(*fields)   # StatusOr's __init__ is (status, value) too
+    raise AssertionError(f"unknown rendering {v}")
+
+
+@pytest.mark.parametrize("vec", SPEC["vectors"], ids=lambda v: v["name"])
+def test_vector_roundtrip(vec):
+    value = from_json(vec["value"])
+    raw = bytes.fromhex(vec["hex"])
+    # decode: frozen bytes -> the documented value
+    decoded = wire.decode(raw)
+    if isinstance(value, StatusOr):
+        assert decoded.status.code == value.status.code
+        assert decoded._value == value._value
+    elif isinstance(value, Status):
+        assert decoded.code == value.code and decoded.msg == value.msg
+    else:
+        assert decoded == value, vec["name"]
+    # encode: the value -> the exact frozen bytes (canonical encoding)
+    assert wire.encode(value).hex() == vec["hex"], vec["name"]
+
+
+def test_registry_assignment_frozen():
+    """Registry ids are positional and append-only (spec §4): the live
+    registry must contain the spec's table as an exact PREFIX."""
+    live = [t.__name__ for t in wire._registry]
+    spec = [e["name"] for e in SPEC["registry"]]
+    assert live[:len(spec)] == spec, (
+        "wire registry ids drifted from docs/manual/wire-vectors.json — "
+        "ids are frozen; append new types at the END and regenerate the "
+        "vector file's registry table")
+    for e in SPEC["registry"]:
+        t = _BY_NAME[e["name"]]
+        if "fields" not in e:
+            continue
+        if dataclasses.is_dataclass(t):
+            assert [f.name for f in dataclasses.fields(t)] == e["fields"], \
+                f"{e['name']} field order changed — wire format break"
+
+
+def test_registry_covers_all_defaults():
+    """Every registered type appears in the spec table (no silent
+    additions without a vector-file regeneration)."""
+    spec_names = {e["name"] for e in SPEC["registry"]}
+    live_names = {t.__name__ for t in wire._registry}
+    assert live_names == spec_names, (
+        live_names - spec_names, spec_names - live_names)
