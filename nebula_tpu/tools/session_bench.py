@@ -1,0 +1,148 @@
+"""Multi-session graphd concurrency bench.
+
+The reference's StoragePerfTool methodology (tools/storage-perf/
+README.md: fixed thread count, sustained load, latency percentiles)
+applied one layer up: N INDEPENDENT client sessions fire mixed GO
+traffic at ONE graphd over real TCP, measuring how aggregate QPS and
+per-query latency scale with N. This is the measurement the per-batch
+tier-1 numbers can't give — graphd is thread-per-connection Python, so
+host-side planning/materialization serializes on the GIL while device
+dispatches release it; the sweep shows where that cap bites.
+
+Caveat printed with every run: a container pinned to one CPU core
+(sched_getaffinity -> 1) measures GIL/scheduling overhead only — real
+scaling needs cores.
+"""
+from __future__ import annotations
+
+import argparse
+import threading
+import time
+from typing import Any, Dict, List, Sequence
+
+
+def _percentile(sorted_ms: List[float], p: float) -> float:
+    if not sorted_ms:
+        return 0.0
+    idx = min(len(sorted_ms) - 1, int(p / 100.0 * len(sorted_ms)))
+    return sorted_ms[idx]
+
+
+def run_sessions(addr: str, queries: Sequence[str], n_sessions: int,
+                 duration_s: float = 5.0, user: str = "root",
+                 password: str = "",
+                 use_space: str = "") -> Dict[str, Any]:
+    """N threads, each with its OWN authenticated session/connection,
+    cycling through `queries` (offset per thread so the mix interleaves)
+    for `duration_s`. Returns {n_sessions, qps, errors, latency_ms}."""
+    from ..client import GraphClient
+
+    stop = threading.Event()
+    lats: List[List[float]] = [[] for _ in range(n_sessions)]
+    errs = [0] * n_sessions
+    clients = []
+    for _ in range(n_sessions):
+        c = GraphClient(addr).connect(user, password)
+        if use_space:
+            r = c.execute(f"USE {use_space}")
+            if not r.ok():
+                raise RuntimeError(f"USE {use_space}: {r.error_msg}")
+        clients.append(c)
+
+    def worker(i: int) -> None:
+        c = clients[i]
+        k = i  # per-thread offset interleaves the mix
+        while not stop.is_set():
+            q = queries[k % len(queries)]
+            k += 1
+            t1 = time.time()
+            r = c.execute(q)
+            lats[i].append((time.time() - t1) * 1000)
+            if not r.ok():
+                errs[i] += 1
+
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+               for i in range(n_sessions)]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(timeout=10)
+    wall = time.time() - t0
+    for c in clients:
+        try:
+            c.disconnect()
+        except Exception:
+            pass
+    all_ms = sorted(x for ls in lats for x in ls)
+    total = len(all_ms)
+    return {
+        "n_sessions": n_sessions,
+        "total_queries": total,
+        "errors": sum(errs),
+        "qps": round(total / wall, 1),
+        "latency_ms": {
+            "p50": round(_percentile(all_ms, 50), 2),
+            "p95": round(_percentile(all_ms, 95), 2),
+            "p99": round(_percentile(all_ms, 99), 2),
+            "avg": round(sum(all_ms) / total, 2) if total else 0.0,
+        },
+    }
+
+
+def sweep(addr: str, queries: Sequence[str],
+          session_counts: Sequence[int] = (1, 2, 4, 8, 16),
+          duration_s: float = 5.0, use_space: str = "",
+          user: str = "root", password: str = ""
+          ) -> List[Dict[str, Any]]:
+    """run_sessions over increasing N; returns one record per N. The
+    scaling knee (QPS flat while p99 grows ~linearly with N) is the
+    GIL/host-side cap."""
+    import os
+    out = []
+    try:
+        cores = len(os.sched_getaffinity(0))
+    except (AttributeError, OSError):
+        cores = os.cpu_count() or 1
+    if cores == 1:
+        print("WARNING: this process is pinned to 1 CPU core — the sweep "
+              "measures GIL/scheduling overhead, not parallel capacity")
+    for n in session_counts:
+        rec = run_sessions(addr, queries, n, duration_s,
+                           use_space=use_space, user=user,
+                           password=password)
+        rec["cores"] = cores
+        out.append(rec)
+        print(f"sessions={n:3d}: {rec['qps']:8.1f} QPS  "
+              f"p50={rec['latency_ms']['p50']:.1f}ms "
+              f"p99={rec['latency_ms']['p99']:.1f}ms "
+              f"errors={rec['errors']}")
+    return out
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser(
+        description="nebula-tpu multi-session graphd concurrency bench")
+    ap.add_argument("--graphd", required=True, help="graphd host:port")
+    ap.add_argument("--space", default="", help="USE this space first")
+    ap.add_argument("--query", action="append", required=True,
+                    help="query to mix in (repeatable)")
+    ap.add_argument("--sessions", default="1,2,4,8,16",
+                    help="comma-separated session counts to sweep")
+    ap.add_argument("--duration", type=float, default=5.0,
+                    help="seconds per sweep point")
+    ap.add_argument("--user", default="root")
+    ap.add_argument("--password", default="")
+    args = ap.parse_args(argv)
+    counts = [int(x) for x in args.sessions.split(",") if x]
+    import json
+    out = sweep(args.graphd, args.query, counts, args.duration,
+                use_space=args.space, user=args.user,
+                password=args.password)
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -44,28 +44,110 @@ def _coerce(value: str, t: str) -> Any:
     return value
 
 
+def _csv_chunk(path: str, w: int, nw: int):
+    """DictReader over this worker's byte-range slice of the CSV (the
+    Spark generator's input-split role): boundaries land between rows
+    — worker w owns lines starting in [boundary(w), boundary(w+1)),
+    with boundary(i) snapped forward to the next line start."""
+    import io
+    size = os.path.getsize(path)
+    with open(path, "rb") as f:
+        header = f.readline()
+        data_start = f.tell()
+        span = size - data_start
+
+        def snapped(i: int) -> int:
+            if i <= 0:
+                return data_start
+            if i >= nw:
+                return size
+            f.seek(data_start + span * i // nw)
+            f.readline()
+            return min(f.tell(), size)
+
+        lo, hi = snapped(w), snapped(w + 1)
+        f.seek(lo)
+        chunk = f.read(hi - lo)
+    return csv.DictReader(io.StringIO((header + chunk).decode()))
+
+
+def _feed(gen: SstGenerator, mapping: Dict[str, Any], base_dir: str,
+          w: int, nw: int) -> None:
+    for vm in mapping.get("vertices", []):
+        schema = _schema(vm["props"])
+        path = os.path.join(base_dir, vm["file"])
+        for row in _csv_chunk(path, w, nw):
+            values = {p: _coerce(row[p], t)
+                      for p, t in vm["props"].items()}
+            gen.add_vertex(int(row[vm["vid_col"]]), vm["tag_id"],
+                           schema, values)
+    for em in mapping.get("edges", []):
+        schema = _schema(em["props"])
+        path = os.path.join(base_dir, em["file"])
+        for row in _csv_chunk(path, w, nw):
+            values = {p: _coerce(row[p], t)
+                      for p, t in em["props"].items()}
+            rank = int(row[em["rank_col"]]) if em.get("rank_col") else 0
+            gen.add_edge(int(row[em["src_col"]]), em["edge_type"], rank,
+                         int(row[em["dst_col"]]), schema, values)
+
+
 def generate(mapping: Dict[str, Any], out_dir: str,
              base_dir: str = ".") -> Dict[int, int]:
     """Build per-part SSTs under out_dir; returns part -> kv pairs."""
     gen = SstGenerator(mapping["num_parts"])
-    for vm in mapping.get("vertices", []):
-        schema = _schema(vm["props"])
-        with open(os.path.join(base_dir, vm["file"]), newline="") as f:
-            for row in csv.DictReader(f):
-                values = {p: _coerce(row[p], t)
-                          for p, t in vm["props"].items()}
-                gen.add_vertex(int(row[vm["vid_col"]]), vm["tag_id"],
-                               schema, values)
-    for em in mapping.get("edges", []):
-        schema = _schema(em["props"])
-        with open(os.path.join(base_dir, em["file"]), newline="") as f:
-            for row in csv.DictReader(f):
-                values = {p: _coerce(row[p], t)
-                          for p, t in em["props"].items()}
-                rank = int(row[em["rank_col"]]) if em.get("rank_col") else 0
-                gen.add_edge(int(row[em["src_col"]]), em["edge_type"], rank,
-                             int(row[em["dst_col"]]), schema, values)
+    _feed(gen, mapping, base_dir, 0, 1)
     return gen.write(out_dir)
+
+
+def _worker_generate(args) -> None:
+    mapping, base_dir, run_root, w, nw = args
+    gen = SstGenerator(mapping["num_parts"])
+    _feed(gen, mapping, base_dir, w, nw)
+    gen.write(os.path.join(run_root, f"w{w}"))
+
+
+def generate_parallel(mapping: Dict[str, Any], out_dir: str,
+                      base_dir: str = ".",
+                      workers: int = 0) -> Dict[int, int]:
+    """Scale-out build (role parity: the reference's distributed Spark
+    SST generator, tools/spark-sstfile-generator): the CSVs are split
+    into per-worker byte ranges, each worker process encodes its slice
+    into per-part sorted runs, and a k-way merge folds the runs into
+    one final NSST per part. The same architecture runs across hosts:
+    ship each worker a (w, nw) pair and merge the run directories."""
+    import heapq
+    import multiprocessing as mp
+    import shutil
+
+    if workers <= 0:
+        from .. import native
+        workers = min(8, native.usable_cpus())
+    if workers <= 1:
+        return generate(mapping, out_dir, base_dir)
+    run_root = os.path.join(out_dir, "_runs")
+    os.makedirs(run_root, exist_ok=True)
+    # fork, not spawn: a fresh interpreter would re-run site
+    # customization (which may dial an accelerator relay) per worker
+    ctx = mp.get_context("fork" if "fork" in mp.get_all_start_methods()
+                         else "spawn")
+    jobs = [(mapping, base_dir, run_root, w, workers)
+            for w in range(workers)]
+    with ctx.Pool(workers) as pool:
+        pool.map(_worker_generate, jobs)
+    from ..storage.sst import part_file, read_sst, write_sst
+    counts: Dict[int, int] = {}
+    for p in range(1, mapping["num_parts"] + 1):
+        runs = []
+        for w in range(workers):
+            f = os.path.join(run_root, f"w{w}", part_file(p))
+            if os.path.exists(f):
+                runs.append(read_sst(f))
+        if runs:
+            counts[p] = write_sst(os.path.join(out_dir, part_file(p)),
+                                  list(heapq.merge(*runs)))
+    shutil.rmtree(run_root, ignore_errors=True)
+    return counts
 
 
 def main(argv=None) -> int:
@@ -73,11 +155,18 @@ def main(argv=None) -> int:
     ap.add_argument("--mapping", required=True, help="mapping.json path")
     ap.add_argument("--out", required=True, help="output dir for SSTs")
     ap.add_argument("--base-dir", default=None, help="dir containing CSVs")
+    ap.add_argument("--workers", type=int, default=1,
+                    help="worker processes (0 = one per usable CPU); "
+                         ">1 scales the build out over input splits")
     args = ap.parse_args(argv)
     with open(args.mapping) as f:
         mapping = json.load(f)
     base = args.base_dir or os.path.dirname(os.path.abspath(args.mapping))
-    counts = generate(mapping, args.out, base_dir=base)
+    if args.workers == 1:
+        counts = generate(mapping, args.out, base_dir=base)
+    else:
+        counts = generate_parallel(mapping, args.out, base_dir=base,
+                                   workers=args.workers)
     print(json.dumps({str(k): v for k, v in sorted(counts.items())}))
     return 0
 

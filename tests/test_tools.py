@@ -196,3 +196,101 @@ def test_identity_fuzz_short():
     # under any budget) — assert the dense dispatch did real work
     served = dense["served"]
     assert served["go_served"] - served["sparse_served"] > 0, served
+
+
+def test_session_bench_sweep():
+    """Multi-session concurrency bench against a real TCP graphd (the
+    StoragePerfTool methodology at the query layer): every sweep point
+    completes queries error-free and reports sane latencies."""
+    from nebula_tpu.daemons import serve_graphd, serve_metad, serve_storaged
+    from nebula_tpu.sample import LIKES, PLAYERS
+    from nebula_tpu.client import GraphClient
+    from nebula_tpu.tools.session_bench import sweep
+
+    metad = serve_metad()
+    sd = serve_storaged(metad.addr, load_interval=0.1)
+    graphd = serve_graphd(metad.addr)
+    try:
+        c = GraphClient(graphd.addr).connect()
+        stmts = ["CREATE SPACE nba(partition_num=4)", "USE nba",
+                 "CREATE TAG player(name string, age int)",
+                 "CREATE EDGE like(likeness double)",
+                 "INSERT VERTEX player(name, age) VALUES " + ", ".join(
+                     f'{v}:("{n}", {a})' for v, n, a in PLAYERS),
+                 "INSERT EDGE like(likeness) VALUES " + ", ".join(
+                     f"{s} -> {d}:({w})" for s, d, w in LIKES)]
+        for stmt in stmts:
+            r = c.execute(stmt)
+            assert r.ok(), (stmt, r.error_msg)
+        out = sweep(graphd.addr,
+                    ["GO FROM 100 OVER like YIELD like._dst",
+                     "GO 2 STEPS FROM 100 OVER like YIELD like._dst",
+                     "FETCH PROP ON player 101 YIELD player.name"],
+                    session_counts=(1, 4), duration_s=0.8,
+                    use_space="nba")
+        assert len(out) == 2
+        for rec in out:
+            assert rec["errors"] == 0, rec
+            assert rec["total_queries"] > 0
+            assert rec["latency_ms"]["p99"] >= rec["latency_ms"]["p50"]
+        assert out[1]["n_sessions"] == 4
+    finally:
+        for h in (graphd, sd, metad):
+            h.stop()
+
+
+def test_sst_generator_parallel_matches_serial(cluster, tmp_path):
+    """generate_parallel (the Spark scale-out role: input splits ->
+    per-worker sorted runs -> k-way merge) produces byte-identical
+    per-part files to the serial path, modulo row-version stamps —
+    compared here at the key-set level, and end-to-end via INGEST."""
+    import random
+
+    from nebula_tpu.storage.sst import part_file, read_sst
+    from nebula_tpu.tools.sst_generator import generate, generate_parallel
+
+    c, conn, space_id = cluster
+    conn.must("CREATE TAG pplayer(name string, age int)")
+    conn.must("CREATE EDGE plike(likeness double)")
+    rng = random.Random(5)
+    n_v, n_e = 200, 500
+    vlines = ["id,name,age"] + [f"{400 + i},P{i},{20 + i % 30}"
+                                for i in range(n_v)]
+    elines = ["src,dst,likeness"] + [
+        f"{400 + rng.randrange(n_v)},{400 + rng.randrange(n_v)},"
+        f"{rng.randrange(100)}.5" for _ in range(n_e)]
+    (tmp_path / "pv.csv").write_text("\n".join(vlines) + "\n")
+    (tmp_path / "pe.csv").write_text("\n".join(elines) + "\n")
+    mapping = {
+        "num_parts": 4,
+        "vertices": [{"file": "pv.csv",
+                      "tag_id": c.sm.tag_id(space_id, "pplayer"),
+                      "vid_col": "id",
+                      "props": {"name": "string", "age": "int"}}],
+        "edges": [{"file": "pe.csv",
+                   "edge_type": c.sm.edge_type(space_id, "plike"),
+                   "src_col": "src", "dst_col": "dst", "rank_col": None,
+                   "props": {"likeness": "double"}}],
+    }
+    serial = generate(mapping, str(tmp_path / "serial"),
+                      base_dir=str(tmp_path))
+    par = generate_parallel(mapping, str(tmp_path / "par"),
+                            base_dir=str(tmp_path), workers=3)
+    assert serial == par                      # same per-part counts
+    assert sum(par.values()) == n_v + 2 * n_e
+    for p in par:
+        ks = [k[:-8] for k, _ in read_sst(str(tmp_path / "serial"
+                                              / part_file(p)))]
+        kp = [k[:-8] for k, _ in read_sst(str(tmp_path / "par"
+                                              / part_file(p)))]
+        assert sorted(ks) == sorted(kp)       # version-stripped keys
+    from nebula_tpu.common.flags import storage_flags
+    prev = storage_flags.get("download_dir")
+    storage_flags.set("download_dir", str(tmp_path / "staging2"))
+    try:
+        conn.must(f'DOWNLOAD HDFS "{tmp_path / "par"}"')
+        conn.must("INGEST")
+        r = conn.must("FETCH PROP ON pplayer 400 YIELD pplayer.name")
+        assert r.rows[0][-1] == "P0"
+    finally:
+        storage_flags.set("download_dir", prev)
