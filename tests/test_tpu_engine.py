@@ -901,3 +901,23 @@ def test_device_aggregate_declines_on_delta_adds(agg_pair):
     if snap is not None and snap.delta is not None \
             and snap.delta.edge_count > 0:
         assert tpu.stats["agg_served"] == 1, tpu.stats
+
+
+def test_calibrate_sparse_budget(pair):
+    """The measured pull-vs-push crossover replaces the modeled
+    constant (round-3 verdict: never validated on hardware) and
+    queries keep identical results under the new routing."""
+    cpu_conn, tpu_conn, tpu = pair
+    tpu_conn.must("GO FROM 100 OVER like")      # build the snapshot
+    sid = list(tpu._snapshots)[0]
+    before = tpu.sparse_edge_budget
+    rec = tpu.calibrate_sparse_budget(sid, [100, 101, 102, 103], [1],
+                                      steps=3)
+    assert rec is not None
+    assert rec["fitted_budget"] == tpu.sparse_edge_budget
+    assert rec["probe_edges"] > 0 and rec["sparse_edges_per_sec"] > 0
+    assert rec["dense_dispatch_ms"] > 0
+    r1 = tpu_conn.must("GO 2 STEPS FROM 100 OVER like YIELD like._dst")
+    r2 = cpu_conn.must("GO 2 STEPS FROM 100 OVER like YIELD like._dst")
+    assert sorted(map(str, r1.rows)) == sorted(map(str, r2.rows))
+    tpu.sparse_edge_budget = before
