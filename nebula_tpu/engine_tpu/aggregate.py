@@ -61,6 +61,71 @@ def exact_int_sum(value, mask) -> int:
     return total - n * _BIAS
 
 
+# per-group digit sums accumulate across every partition into one bin,
+# so the exactness bound is on the TOTAL masked rows: n * 255 < 2^31
+MAX_GROUPED_SUM_ROWS = 1 << 23
+
+
+def grouped_reduce(specs: List[Tuple[str, Optional[object]]], active,
+                   vals: dict, gidx, n_groups: int):
+    """Segment reductions keyed by each edge's global dst slot (the
+    GROUP BY $-._dst pushdown): one scatter-add per COUNT, four digit
+    scatter-adds + a non-null count per SUM/AVG, scatter-min/max for
+    MIN/MAX. Returns (sorted group slots np.int64, list of per-spec
+    numpy arrays aligned with the group list). Callers must enforce
+    MAX_GROUPED_SUM_ROWS when any SUM/AVG spec is present."""
+    import jax.numpy as jnp
+    flat_g = gidx.reshape(-1)
+    m = active.reshape(-1)
+    counts = jnp.zeros(n_groups + 1, jnp.int32).at[flat_g].add(
+        m.astype(jnp.int32))
+    counts_np = np.asarray(counts)[:n_groups]
+    groups = np.nonzero(counts_np)[0]
+    # every emitted value is a PYTHON int/float/None — np scalars would
+    # break wire encoding (isinstance int check) and repr identity
+    out: List[List] = []
+    cache: dict = {}
+    for fun, key in specs:
+        if fun == "COUNT":
+            out.append([int(x) for x in counts_np[groups]])
+            continue
+        v = vals[key]
+        if key not in cache:
+            mk = (m & ~v.null.reshape(-1))
+            nn = np.asarray(jnp.zeros(n_groups + 1, jnp.int32)
+                            .at[flat_g].add(mk.astype(jnp.int32)))[:n_groups]
+            cache[key] = (mk, nn)
+        mk, nonnull = cache[key]
+        nn = nonnull[groups]
+        if fun in ("MIN", "MAX"):
+            ident = (2**31 - 1) if fun == "MIN" else -(2**31)
+            fill = jnp.where(mk, v.value.reshape(-1), jnp.int32(ident))
+            seg = jnp.full(n_groups + 1, ident, jnp.int32)
+            seg = (seg.at[flat_g].min(fill) if fun == "MIN"
+                   else seg.at[flat_g].max(fill))
+            sel = np.asarray(seg)[:n_groups][groups]
+            out.append([int(x) if c else None
+                        for x, c in zip(sel, nn)])
+            continue
+        u = v.value.reshape(-1).astype(jnp.uint32) + jnp.uint32(_BIAS)
+        total = np.zeros(n_groups, np.int64)
+        for k in range(4):
+            d = ((u >> jnp.uint32(8 * k)) & jnp.uint32(0xFF)) \
+                .astype(jnp.int32)
+            part = np.asarray(jnp.zeros(n_groups + 1, jnp.int32)
+                              .at[flat_g].add(jnp.where(mk, d, 0))
+                              )[:n_groups]
+            total += part.astype(np.int64) << (8 * k)
+        total -= nonnull.astype(np.int64) * _BIAS
+        sel = total[groups]
+        if fun == "SUM":
+            out.append([int(x) if c else None for x, c in zip(sel, nn)])
+        else:                      # AVG: exact sum / count on host
+            out.append([int(x) / int(c) if c else None
+                        for x, c in zip(sel, nn)])
+    return groups, out
+
+
 def reduce_specs(specs: List[Tuple[str, Optional[object]]], active,
                  vals: dict) -> Optional[List]:
     """Evaluate each (fun, key) agg spec over the `active` row mask.

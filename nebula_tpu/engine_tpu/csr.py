@@ -186,6 +186,23 @@ class CsrSnapshot:
                 np.stack([s.edge_etype for s in self.shards]),
                 np.stack([s.edge_valid for s in self.shards]))
 
+    def gidx_vids(self) -> np.ndarray:
+        """host int64[P*cap_v]: global slot -> vid (-1 unused) — the
+        inverse of the edge gidx encoding, for materializing grouped
+        device reductions keyed by dst slot. Cached per snapshot;
+        delta-added vids resolve through the spare-slot maps (slots a
+        buffered edge could reference are declined upstream anyway
+        while delta adds are live)."""
+        m = getattr(self, "_gidx_vids", None)
+        if m is None:
+            m = np.full(self.num_parts * self.cap_v, -1, np.int64)
+            for p, s in enumerate(self.shards):
+                m[p * self.cap_v:p * self.cap_v + len(s.vids)] = s.vids
+                for vid, loc in s.delta_vids.items():
+                    m[p * self.cap_v + loc] = vid
+            self._gidx_vids = m
+        return m
+
     # ------------------------------------------------------------------
     def locate(self, vid: int) -> Optional[Tuple[int, int]]:
         """vid -> (0-based part index, local index). Binary search over

@@ -515,15 +515,20 @@ class TpuGraphEngine:
                              out_cols: List[str], starts: List[int],
                              edge_types: List[int],
                              alias_map: Dict[str, str],
-                             name_by_type: Dict[int, str]):
-        """Serve `GO … | YIELD <aggregates>` as a masked device
-        reduction over the final-hop edge block instead of
-        materializing rows (ref role: QueryStatsProcessor /
-        storage.thrift bound_stats :65-69; device math in
-        aggregate.py). `specs` is [(fun, EdgePropExpr|None)] aligned
-        with `out_cols`. Returns a one-row Result, or None to fall
-        back to the CPU pipe — every declined case (delta adds in
-        play, non-device filter, non-int props, err cells the CPU
+                             name_by_type: Dict[int, str],
+                             group_layout: Optional[List] = None):
+        """Serve `GO … | YIELD <aggregates>` (and `GO … | GROUP BY
+        $-.<dst> YIELD …`) as a masked device reduction over the
+        final-hop edge block instead of materializing rows (ref role:
+        QueryStatsProcessor / storage.thrift bound_stats :65-69;
+        device math in aggregate.py). `specs` is
+        [(fun, EdgePropExpr|None)]; without `group_layout` the result
+        is one row aligned with `out_cols`; with it the reduction is
+        segmented by the edge's dst slot and `group_layout` orders
+        each row's cells: "key" emits the group's dst vid, an int
+        emits that spec's aggregate. Returns a Result, or None to
+        fall back to the CPU pipe — every declined case (delta adds
+        in play, non-device filter, non-int props, err cells the CPU
         would raise EvalError for) keeps CPU≡TPU identity by
         construction."""
         from ..graph import executors as ex
@@ -532,10 +537,11 @@ class TpuGraphEngine:
         with self._lock:
             return self._go_aggregate_locked(ctx, s, specs, out_cols,
                                              starts, edge_types, alias_map,
-                                             name_by_type, ex)
+                                             name_by_type, ex, group_layout)
 
     def _go_aggregate_locked(self, ctx, s, specs, out_cols, starts,
-                             edge_types, alias_map, name_by_type, ex):
+                             edge_types, alias_map, name_by_type, ex,
+                             group_layout=None):
         from . import aggregate
         from .filter_compile import FilterCompiler, _Unsupported
         t0 = time.monotonic()
@@ -551,6 +557,8 @@ class TpuGraphEngine:
             return None
         frontier0 = snap.frontier_from_vids(starts)
         if not frontier0.any():
+            if group_layout is not None:   # GROUP BY of nothing: no rows
+                return StatusOr.of(ex.InterimResult(out_cols))
             row = tuple(0 if f == "COUNT" else None for f, _ in specs)
             return StatusOr.of(ex.InterimResult(out_cols, [row]))
         # small frontiers: the CPU pipe over the sparse pull is faster
@@ -625,6 +633,26 @@ class TpuGraphEngine:
         for em in err_masks:
             if bool(jnp.any(active & em)):
                 return None    # CPU raises EvalError for these rows
+        if group_layout is not None:
+            if any(f in ("SUM", "AVG") for f, _ in keyed_specs) and \
+                    int(jnp.sum(active)) > aggregate.MAX_GROUPED_SUM_ROWS:
+                return None    # per-group digit sums could overflow
+            groups, cols = aggregate.grouped_reduce(
+                keyed_specs, active, vals, snap.d_edge_gidx,
+                snap.num_parts * snap.cap_v)
+            # t1 spans traversal + reduction, like the ungrouped path
+            t_kernel = time.monotonic() - t1
+            t2 = time.monotonic()
+            vids = snap.gidx_vids()[groups]
+            rows = []
+            for i in range(len(groups)):
+                rows.append(tuple(
+                    int(vids[i]) if cell == "key" else cols[cell][i]
+                    for cell in group_layout))
+            self.stats["agg_served"] += 1
+            self._record_profile("aggregate-grouped", t_snap, t_kernel,
+                                 time.monotonic() - t2, snap)
+            return StatusOr.of(ex.InterimResult(out_cols, rows))
         row = aggregate.reduce_specs(keyed_specs, active, vals)
         t_kernel = time.monotonic() - t1
         if row is None:

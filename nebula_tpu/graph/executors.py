@@ -1047,14 +1047,34 @@ def try_device_aggregate(ctx: ExecContext, pipe) -> Optional[Result]:
     agg/non-agg yields, DISTINCT, WHERE on the yield, input-ref GOs,
     non-edge-prop aggregate args) falls through."""
     tpu = getattr(ctx.engine, "tpu_engine", None)
-    if tpu is None or not isinstance(pipe.left, ast.GoSentence) \
-            or not isinstance(pipe.right, ast.YieldSentence):
+    if tpu is None or not isinstance(pipe.left, ast.GoSentence):
         return None
     s, y = pipe.left, pipe.right
-    if y.where is not None or y.yield_ is None or y.yield_.distinct:
-        return None
-    cols = y.yield_.columns
-    if not cols or not all(c.agg_fun in _DEVICE_AGGS for c in cols):
+    group_key = None
+    if isinstance(y, ast.GroupBySentence):
+        # GROUP BY $-.<one col> — segment reduction keyed by dst slot
+        if len(y.group_cols) != 1 or y.yield_.distinct:
+            return None
+        gk = y.group_cols[0].expr
+        if not isinstance(gk, InputPropExpr):
+            return None
+        group_key = gk.prop
+        cols = y.yield_.columns
+        if not cols:
+            return None
+        for c in cols:
+            ok = (c.agg_fun in _DEVICE_AGGS) or (
+                c.agg_fun is None and isinstance(c.expr, InputPropExpr)
+                and c.expr.prop == group_key)
+            if not ok:
+                return None
+    elif isinstance(y, ast.YieldSentence):
+        if y.where is not None or y.yield_ is None or y.yield_.distinct:
+            return None
+        cols = y.yield_.columns
+        if not cols or not all(c.agg_fun in _DEVICE_AGGS for c in cols):
+            return None
+    else:
         return None
     if s.step.upto or int(s.step.steps) < 1 or \
             (s.yield_ and s.yield_.distinct):
@@ -1079,14 +1099,33 @@ def try_device_aggregate(ctx: ExecContext, pipe) -> Optional[Result]:
     if needs_input:
         return None    # per-root attribution: CPU loop
     by_name = {c.name(): c.expr for c in left_cols}
+    if group_key is not None:
+        # the key must be a left column carrying the edge's dst id —
+        # that's the slot the device reduction segments by. A NAMED
+        # qualifier (serve._dst) must cover every traversed type: the
+        # CPU yields None for <edge>._dst on rows of OTHER types
+        # (a None-keyed group) which the slot keying can't express
+        kexpr = by_name.get(group_key)
+        if not isinstance(kexpr, EdgeDstIdExpr):
+            return None
+        if kexpr.edge is not None:
+            canon = alias_map.get(kexpr.edge, kexpr.edge)
+            if any(name_by_type.get(abs(t)) != canon
+                   for t in edge_types):
+                return None
     specs = []
+    layout = []    # grouped: per-output-cell "key" | spec index
     for c in cols:
         e = c.expr
+        if c.agg_fun is None:     # grouped only: the key column
+            layout.append("key")
+            continue
         if c.agg_fun == "COUNT":
             # COUNT(*) parses as Literal(1); COUNT($-.x) counts every
             # row (nulls included) as long as the column exists
             if isinstance(e, Literal) or (
                     isinstance(e, InputPropExpr) and e.prop in by_name):
+                layout.append(len(specs))
                 specs.append(("COUNT", None))
                 continue
             return None
@@ -1095,10 +1134,12 @@ def try_device_aggregate(ctx: ExecContext, pipe) -> Optional[Result]:
         src = by_name.get(e.prop)
         if not isinstance(src, EdgePropExpr) or src.prop.startswith("_"):
             return None
+        layout.append(len(specs))
         specs.append((c.agg_fun, src))
     return tpu.execute_go_aggregate(
         ctx, s, specs, [c.name() for c in cols], starts_r.value(),
-        edge_types, alias_map, name_by_type)
+        edge_types, alias_map, name_by_type,
+        group_layout=layout if group_key is not None else None)
 
 
 def execute_group_by(ctx: ExecContext, s: ast.GroupBySentence) -> Result:

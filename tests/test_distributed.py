@@ -180,6 +180,19 @@ def test_executor_sharded_aggregate_identity(meshed_pair):
     assert tpu.stats["agg_served"] == before + 1, tpu.stats
 
 
+def test_executor_sharded_grouped_aggregate_identity(meshed_pair):
+    """GROUP BY $-.<dst> segment reduction over the MESHED engine's
+    sharded multi-hop mask (runs before the mutation test)."""
+    cpu_conn, tpu_conn, tpu = meshed_pair
+    before = tpu.stats["agg_served"]
+    q = ("GO FROM 100, 101, 102 OVER serve YIELD serve._dst AS t,"
+         " serve.start_year AS y | GROUP BY $-.t YIELD $-.t AS t,"
+         " COUNT(*) AS n, SUM($-.y) AS s")
+    rc, rt = cpu_conn.must(q), tpu_conn.must(q)
+    assert sorted(map(repr, rc.rows)) == sorted(map(repr, rt.rows))
+    assert tpu.stats["agg_served"] == before + 1, tpu.stats
+
+
 def test_executor_sharded_identity_after_mutation(meshed_pair):
     """Writes flow into the MESHED snapshot (delta patches / rebuilds)
     and the sharded path keeps CPU≡TPU identity afterwards — the one
