@@ -107,11 +107,24 @@ def _rand_query(rnd: random.Random, n_v: int,
             f" YIELD DISTINCT {edge}._dst",
             f" YIELD {edge}._dst, $$.person.age"])
         return f"GO {steps}FROM {seeds} OVER {edge}{direction}{where}{yields}"
-    if kind < 0.75:   # pipe with $- back-reference
+    if kind < 0.72:   # pipe with $- back-reference
         cut = rnd.randrange(100)
         return (f"GO FROM {seeds} OVER knows YIELD knows._dst AS id, "
                 f"knows.w AS w | GO FROM $-.id OVER knows "
                 f"WHERE knows.w > {cut} YIELD $-.w AS base, knows._dst")
+    if kind < 0.85:   # aggregation pipes (device reduction pushdown)
+        steps = rnd.choice(["", "2 STEPS "])
+        where = ""
+        if rnd.random() < 0.4:
+            where = f" WHERE {_rand_filter(rnd, 'knows', alters)}"
+        if rnd.random() < 0.5:
+            return (f"GO {steps}FROM {seeds} OVER knows{where} "
+                    f"YIELD knows.w AS w | YIELD COUNT(*) AS n, "
+                    f"SUM($-.w) AS s, AVG($-.w) AS a, MIN($-.w) AS lo, "
+                    f"MAX($-.w) AS hi")
+        return (f"GO {steps}FROM {seeds} OVER knows{where} "
+                f"YIELD knows._dst AS d, knows.w AS w | GROUP BY $-.d "
+                f"YIELD $-.d AS d, COUNT(*) AS n, SUM($-.w) AS s")
     form = rnd.choice(["SHORTEST", "ALL", "NOLOOP"])
     a, b = rnd.randrange(n_v), rnd.randrange(n_v)
     k = rnd.choice([3, 4]) if form != "ALL" else 3
@@ -229,7 +242,8 @@ def run_fuzz(rounds: int = 100, seed: int = 0, n_v: int = 120,
             "failed_mutations": failed_mutations, "seed": seed,
             "served": {k: tpu.stats[k] for k in
                        ("go_served", "path_served", "sparse_served",
-                        "fallbacks", "host_filter_vectorized")}}
+                        "agg_served", "fallbacks",
+                        "host_filter_vectorized")}}
 
 
 def main(argv=None) -> int:
