@@ -1,0 +1,577 @@
+// nebula-tpu C++ graph client + CLI.
+//
+// A SECOND-LANGUAGE implementation of the frozen v1 wire protocol
+// (docs/manual/6-wire-protocol.md; conformance vectors
+// docs/manual/wire-vectors.json) — the role the reference's Java
+// client fills (ref src/client/java): proof that graphd's wire is
+// language-neutral, and a usable CLI:
+//
+//   nebula_cli --addr 127.0.0.1:3699 [--user root] [--password ""]
+//              [--space nba] "GO FROM 100 OVER like"
+//
+// prints the response as one JSON object {code, columns, rows, ...}.
+// `--selftest <wire-vectors.json>` round-trips every conformance
+// vector through this codec instead (exit 0 = conformant).
+//
+// No dependencies beyond POSIX sockets + C++17.
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <string>
+#include <vector>
+
+namespace wire {
+
+// ---- value model ----------------------------------------------------
+struct Value;
+using ValuePtr = std::shared_ptr<Value>;
+
+struct Value {
+  enum Kind { NUL, BOOL, INT, FLOAT, STR, BYTES, LIST, TUPLE, MAP, ENUM,
+              STRUCT };
+  Kind kind = NUL;
+  bool b = false;
+  long long i = 0;              // INT; ENUM member value
+  double d = 0;
+  std::string s;                // STR/BYTES payload
+  std::vector<ValuePtr> items;  // LIST/TUPLE; STRUCT field values
+  std::vector<std::pair<ValuePtr, ValuePtr>> kv;  // MAP
+  uint32_t reg_id = 0;          // ENUM/STRUCT registry id
+};
+
+inline ValuePtr mk(Value::Kind k) {
+  auto v = std::make_shared<Value>();
+  v->kind = k;
+  return v;
+}
+inline ValuePtr mk_int(long long n) { auto v = mk(Value::INT); v->i = n; return v; }
+inline ValuePtr mk_str(const std::string &s) { auto v = mk(Value::STR); v->s = s; return v; }
+
+// ---- encoding (spec §3) ---------------------------------------------
+inline void put_u32(std::string &out, uint32_t n) {
+  char b[4];
+  memcpy(b, &n, 4);             // little-endian hosts only (x86/arm64)
+  out.append(b, 4);
+}
+
+inline void put_varint(std::string &out, long long n) {
+  // (n << 1) ^ (n >> 63) — overflow-free zigzag incl. INT64_MIN
+  unsigned long long z =
+      (static_cast<unsigned long long>(n) << 1) ^
+      static_cast<unsigned long long>(n >> 63);
+  while (true) {
+    unsigned char byte = z & 0x7F;
+    z >>= 7;
+    if (z) {
+      out.push_back(static_cast<char>(byte | 0x80));
+    } else {
+      out.push_back(static_cast<char>(byte));
+      return;
+    }
+  }
+}
+
+void encode(std::string &out, const Value &v) {
+  switch (v.kind) {
+    case Value::NUL: out.push_back('N'); return;
+    case Value::BOOL: out.push_back(v.b ? 'T' : 'F'); return;
+    case Value::INT: out.push_back('i'); put_varint(out, v.i); return;
+    case Value::FLOAT: {
+      out.push_back('d');
+      char b[8];
+      memcpy(b, &v.d, 8);
+      out.append(b, 8);
+      return;
+    }
+    case Value::STR:
+    case Value::BYTES:
+      out.push_back(v.kind == Value::STR ? 's' : 'b');
+      put_u32(out, static_cast<uint32_t>(v.s.size()));
+      out += v.s;
+      return;
+    case Value::LIST:
+    case Value::TUPLE:
+      out.push_back(v.kind == Value::LIST ? 'l' : 't');
+      put_u32(out, static_cast<uint32_t>(v.items.size()));
+      for (const auto &x : v.items) encode(out, *x);
+      return;
+    case Value::MAP:
+      out.push_back('m');
+      put_u32(out, static_cast<uint32_t>(v.kv.size()));
+      for (const auto &p : v.kv) {
+        encode(out, *p.first);
+        encode(out, *p.second);
+      }
+      return;
+    case Value::ENUM:
+      out.push_back('e');
+      put_u32(out, v.reg_id);
+      put_varint(out, v.i);
+      return;
+    case Value::STRUCT:
+      out.push_back('c');
+      put_u32(out, v.reg_id);
+      for (const auto &x : v.items) encode(out, *x);
+      return;
+  }
+}
+
+// ---- decoding -------------------------------------------------------
+struct DecodeError {
+  std::string msg;
+};
+
+// struct field counts by registry id — the wire carries no count, so a
+// decoder must know the frozen registry (spec §4; regenerated from
+// wire-vectors.json's registry table when types append)
+struct Registry {
+  // id -> field count (structs) or -1 (enums)
+  std::map<uint32_t, int> fields;
+  std::map<uint32_t, std::string> names;
+};
+
+struct Decoder {
+  const unsigned char *p;
+  size_t n, off = 0;
+  const Registry &reg;
+
+  Decoder(const std::string &buf, const Registry &r)
+      : p(reinterpret_cast<const unsigned char *>(buf.data())),
+        n(buf.size()), reg(r) {}
+
+  unsigned char byte() {
+    if (off >= n) throw DecodeError{"truncated"};
+    return p[off++];
+  }
+  uint32_t u32() {
+    if (off + 4 > n) throw DecodeError{"truncated u32"};
+    uint32_t v;
+    memcpy(&v, p + off, 4);
+    off += 4;
+    return v;
+  }
+  long long varint() {
+    unsigned long long z = 0;
+    int shift = 0;
+    while (true) {
+      unsigned char b = byte();
+      z |= static_cast<unsigned long long>(b & 0x7F) << shift;
+      if (!(b & 0x80)) break;
+      shift += 7;
+      if (shift > 70) throw DecodeError{"varint too long"};
+    }
+    // (z >> 1) ^ -(z & 1) — exact at the INT64_MIN boundary
+    return static_cast<long long>((z >> 1) ^
+                                  (~(z & 1) + 1));
+  }
+  std::string raw(size_t len) {
+    if (off + len > n) throw DecodeError{"truncated payload"};
+    std::string s(reinterpret_cast<const char *>(p + off), len);
+    off += len;
+    return s;
+  }
+
+  ValuePtr value() {
+    unsigned char tag = byte();
+    switch (tag) {
+      case 'N': return mk(Value::NUL);
+      case 'T': { auto v = mk(Value::BOOL); v->b = true; return v; }
+      case 'F': { auto v = mk(Value::BOOL); v->b = false; return v; }
+      case 'i': return mk_int(varint());
+      case 'd': {
+        auto v = mk(Value::FLOAT);
+        std::string b = raw(8);
+        memcpy(&v->d, b.data(), 8);
+        return v;
+      }
+      case 's': case 'b': {
+        auto v = mk(tag == 's' ? Value::STR : Value::BYTES);
+        uint32_t len = u32();
+        v->s = raw(len);
+        return v;
+      }
+      case 'l': case 't': {
+        auto v = mk(tag == 'l' ? Value::LIST : Value::TUPLE);
+        uint32_t cnt = u32();
+        v->items.reserve(cnt);
+        for (uint32_t i = 0; i < cnt; i++) v->items.push_back(value());
+        return v;
+      }
+      case 'm': {
+        auto v = mk(Value::MAP);
+        uint32_t cnt = u32();
+        for (uint32_t i = 0; i < cnt; i++) {
+          auto k = value();
+          auto val = value();
+          v->kv.emplace_back(k, val);
+        }
+        return v;
+      }
+      case 'e': {
+        auto v = mk(Value::ENUM);
+        v->reg_id = u32();
+        v->i = varint();
+        return v;
+      }
+      case 'c': {
+        auto v = mk(Value::STRUCT);
+        v->reg_id = u32();
+        auto it = reg.fields.find(v->reg_id);
+        if (it == reg.fields.end() || it->second < 0)
+          throw DecodeError{"unknown struct registry id " +
+                            std::to_string(v->reg_id)};
+        v->items.reserve(it->second);
+        for (int i = 0; i < it->second; i++) v->items.push_back(value());
+        return v;
+      }
+      default:
+        throw DecodeError{std::string("unknown tag '") +
+                          static_cast<char>(tag) + "'"};
+    }
+  }
+};
+
+// ---- JSON rendering -------------------------------------------------
+void json_escape(std::string &out, const std::string &s) {
+  out.push_back('"');
+  for (unsigned char c : s) {
+    switch (c) {
+      case '"': out += "\\\""; break;
+      case '\\': out += "\\\\"; break;
+      case '\n': out += "\\n"; break;
+      case '\r': out += "\\r"; break;
+      case '\t': out += "\\t"; break;
+      default:
+        if (c < 0x20) {
+          char b[8];
+          snprintf(b, sizeof b, "\\u%04x", c);
+          out += b;
+        } else {
+          out.push_back(static_cast<char>(c));
+        }
+    }
+  }
+  out.push_back('"');
+}
+
+void to_json(std::string &out, const Value &v, const Registry &reg) {
+  switch (v.kind) {
+    case Value::NUL: out += "null"; return;
+    case Value::BOOL: out += v.b ? "true" : "false"; return;
+    case Value::INT: out += std::to_string(v.i); return;
+    case Value::FLOAT: {
+      char b[32];
+      snprintf(b, sizeof b, "%.17g", v.d);
+      out += b;
+      return;
+    }
+    case Value::STR: json_escape(out, v.s); return;
+    case Value::BYTES: {
+      static const char *hex = "0123456789abcdef";
+      std::string h;
+      for (unsigned char c : v.s) {
+        h.push_back(hex[c >> 4]);
+        h.push_back(hex[c & 15]);
+      }
+      out += "{\"$bytes\": ";
+      json_escape(out, h);
+      out += "}";
+      return;
+    }
+    case Value::LIST:
+    case Value::TUPLE: {
+      out.push_back('[');
+      for (size_t i = 0; i < v.items.size(); i++) {
+        if (i) out += ", ";
+        to_json(out, *v.items[i], reg);
+      }
+      out.push_back(']');
+      return;
+    }
+    case Value::MAP: {
+      out.push_back('{');
+      for (size_t i = 0; i < v.kv.size(); i++) {
+        if (i) out += ", ";
+        if (v.kv[i].first->kind == Value::STR) {
+          json_escape(out, v.kv[i].first->s);
+        } else {
+          // JSON keys must be strings: render the key and quote it
+          std::string k;
+          to_json(k, *v.kv[i].first, reg);
+          json_escape(out, k);
+        }
+        out += ": ";
+        to_json(out, *v.kv[i].second, reg);
+      }
+      out.push_back('}');
+      return;
+    }
+    case Value::ENUM: {
+      auto it = reg.names.find(v.reg_id);
+      out += "{\"$enum\": ";
+      json_escape(out, it == reg.names.end() ? "?" : it->second);
+      out += ", \"value\": " + std::to_string(v.i) + "}";
+      return;
+    }
+    case Value::STRUCT: {
+      auto it = reg.names.find(v.reg_id);
+      out += "{\"$struct\": ";
+      json_escape(out, it == reg.names.end() ? "?" : it->second);
+      out += ", \"fields\": [";
+      for (size_t i = 0; i < v.items.size(); i++) {
+        if (i) out += ", ";
+        to_json(out, *v.items[i], reg);
+      }
+      out += "]}";
+      return;
+    }
+  }
+}
+
+}  // namespace wire
+
+// ---- v1 registry (docs/manual/wire-vectors.json `registry`) ---------
+// Positional and append-only (spec §4). Struct entries carry their
+// field count; enums -1.
+static wire::Registry v1_registry() {
+  wire::Registry r;
+  struct E { const char *name; int fields; };
+  static const E table[] = {
+      // generated from wire-vectors.json / rpc.wire._register_defaults
+      {"ErrorCode", -1},        {"Status", 2},       {"StatusOr", 2},
+      {"PropType", -1},         {"SchemaField", 4},  {"Schema", 4},
+      {"ExecutionResponse", 8}, {"SpaceDesc", 4},    {"HostInfo", 3},
+      {"PartResult", 2},        {"EdgeData", 5},     {"VertexData", 3},
+      {"BoundRequest", 7},      {"BoundResponse", 3},
+      {"PropsResponse", 4},     {"ExecResponse", 2}, {"NewVertex", 2},
+      {"NewEdge", 5},           {"EdgeKey", 4},      {"UpdateItemReq", 2},
+      {"UpdateResponse", 4},    {"StatDef", 4},      {"StatsResponse", 4},
+      {"RaftCode", -1},         {"LogType", -1},     {"LogRecord", 2},
+      {"AskForVoteRequest", 6}, {"AskForVoteResponse", 2},
+      {"AppendLogRequest", 9},  {"AppendLogResponse", 6},
+      {"SendSnapshotRequest", 10}, {"SendSnapshotResponse", 2},
+      {"ScanPartResponse", 7},
+  };
+  uint32_t id = 0;
+  for (const auto &e : table) {
+    r.fields[id] = e.fields;
+    r.names[id] = e.name;
+    id++;
+  }
+  return r;
+}
+
+// ---- framing + RPC (spec §1, §2) ------------------------------------
+struct Conn {
+  int fd = -1;
+
+  bool dial(const std::string &host, const std::string &port) {
+    addrinfo hints{};
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo *res = nullptr;
+    if (getaddrinfo(host.c_str(), port.c_str(), &hints, &res) != 0)
+      return false;
+    for (addrinfo *a = res; a; a = a->ai_next) {
+      fd = socket(a->ai_family, a->ai_socktype, a->ai_protocol);
+      if (fd < 0) continue;
+      if (connect(fd, a->ai_addr, a->ai_addrlen) == 0) break;
+      close(fd);
+      fd = -1;
+    }
+    freeaddrinfo(res);
+    return fd >= 0;
+  }
+
+  bool send_frame(const std::string &payload) {
+    uint32_t len = static_cast<uint32_t>(payload.size());
+    char hdr[4];
+    memcpy(hdr, &len, 4);
+    std::string buf(hdr, 4);
+    buf += payload;
+    size_t off = 0;
+    while (off < buf.size()) {
+      ssize_t k = write(fd, buf.data() + off, buf.size() - off);
+      if (k <= 0) return false;
+      off += static_cast<size_t>(k);
+    }
+    return true;
+  }
+
+  bool recv_exact(std::string &out, size_t len) {
+    out.resize(len);
+    size_t off = 0;
+    while (off < len) {
+      ssize_t k = read(fd, &out[off], len - off);
+      if (k <= 0) return false;
+      off += static_cast<size_t>(k);
+    }
+    return true;
+  }
+
+  bool recv_frame(std::string &payload) {
+    std::string hdr;
+    if (!recv_exact(hdr, 4)) return false;
+    uint32_t len;
+    memcpy(&len, hdr.data(), 4);
+    if (len > (1u << 30)) return false;
+    return recv_exact(payload, len);
+  }
+
+  // call "graph".<method>(args...) -> result value; throws DecodeError
+  wire::ValuePtr call(const wire::Registry &reg, const std::string &method,
+                      std::vector<wire::ValuePtr> args) {
+    auto req = wire::mk(wire::Value::TUPLE);
+    req->items.push_back(wire::mk_str("graph"));
+    req->items.push_back(wire::mk_str(method));
+    auto arglist = wire::mk(wire::Value::LIST);
+    arglist->items = std::move(args);
+    req->items.push_back(arglist);
+    req->items.push_back(wire::mk(wire::Value::MAP));
+    std::string payload;
+    wire::encode(payload, *req);
+    std::string resp;
+    if (!send_frame(payload) || !recv_frame(resp))
+      throw wire::DecodeError{"transport failure"};
+    wire::Decoder dec(resp, reg);
+    auto v = dec.value();
+    if (v->kind != wire::Value::TUPLE || v->items.size() != 2)
+      throw wire::DecodeError{"bad response envelope"};
+    if (v->items[0]->kind != wire::Value::BOOL || !v->items[0]->b)
+      throw wire::DecodeError{"server error: " + v->items[1]->s};
+    return v->items[1];
+  }
+};
+
+// ---- self-test against the conformance vectors ----------------------
+// Minimal JSON scanner: pulls every {"name":..,"hex":..} vector and
+// round-trips decode(hex) -> encode == hex. Value comparison is via
+// byte equality of the re-encoding (encoding is canonical, spec §6).
+static int selftest(const std::string &path) {
+  FILE *f = fopen(path.c_str(), "rb");
+  if (!f) {
+    fprintf(stderr, "cannot open %s\n", path.c_str());
+    return 2;
+  }
+  std::string js;
+  char buf[1 << 16];
+  size_t k;
+  while ((k = fread(buf, 1, sizeof buf, f)) > 0) js.append(buf, k);
+  fclose(f);
+  auto reg = v1_registry();
+  int count = 0;
+  size_t pos = 0;
+  while ((pos = js.find("\"hex\": \"", pos)) != std::string::npos) {
+    pos += 8;
+    size_t end = js.find('"', pos);
+    std::string hex = js.substr(pos, end - pos);
+    std::string raw;
+    for (size_t i = 0; i + 1 < hex.size(); i += 2)
+      raw.push_back(static_cast<char>(
+          std::stoi(hex.substr(i, 2), nullptr, 16)));
+    try {
+      wire::Decoder dec(raw, reg);
+      auto v = dec.value();
+      if (dec.off != raw.size()) {
+        fprintf(stderr, "vector %d: trailing bytes\n", count);
+        return 1;
+      }
+      std::string re;
+      wire::encode(re, *v);
+      if (re != raw) {
+        fprintf(stderr, "vector %d: re-encode mismatch\n", count);
+        return 1;
+      }
+    } catch (const wire::DecodeError &e) {
+      fprintf(stderr, "vector %d: %s\n", count, e.msg.c_str());
+      return 1;
+    }
+    count++;
+  }
+  printf("{\"selftest\": \"ok\", \"vectors\": %d}\n", count);
+  return count > 0 ? 0 : 1;
+}
+
+int main(int argc, char **argv) {
+  std::string addr = "127.0.0.1:3699", user = "root", password = "",
+              space, query, selftest_path;
+  for (int i = 1; i < argc; i++) {
+    std::string a = argv[i];
+    auto next = [&]() -> std::string {
+      return (i + 1 < argc) ? argv[++i] : "";
+    };
+    if (a == "--addr") addr = next();
+    else if (a == "--user") user = next();
+    else if (a == "--password") password = next();
+    else if (a == "--space") space = next();
+    else if (a == "--selftest") selftest_path = next();
+    else if (a == "--help") {
+      printf("usage: nebula_cli [--addr H:P] [--user U] [--password P] "
+             "[--space S] \"<nGQL>\" | --selftest wire-vectors.json\n");
+      return 0;
+    } else query = a;
+  }
+  if (!selftest_path.empty()) return selftest(selftest_path);
+  if (query.empty()) {
+    fprintf(stderr, "no query given (--help for usage)\n");
+    return 2;
+  }
+  size_t colon = addr.rfind(':');
+  if (colon == std::string::npos) {
+    fprintf(stderr, "bad --addr %s\n", addr.c_str());
+    return 2;
+  }
+  auto reg = v1_registry();
+  Conn c;
+  if (!c.dial(addr.substr(0, colon), addr.substr(colon + 1))) {
+    fprintf(stderr, "cannot connect to %s\n", addr.c_str());
+    return 2;
+  }
+  try {
+    // authenticate -> StatusOr{Status{code, msg}, session_id}
+    auto r = c.call(reg, "authenticate",
+                    {wire::mk_str(user), wire::mk_str(password)});
+    if (r->kind != wire::Value::STRUCT || r->items.size() != 2 ||
+        r->items[0]->items[0]->i != 0) {
+      fprintf(stderr, "auth failed: %s\n",
+              r->items[0]->items[1]->s.c_str());
+      return 1;
+    }
+    long long session = r->items[1]->i;
+    if (!space.empty()) {
+      auto u = c.call(reg, "execute",
+                      {wire::mk_int(session), wire::mk_str("USE " + space)});
+      if (u->items[0]->i != 0) {
+        fprintf(stderr, "USE %s failed: %s\n", space.c_str(),
+                u->items[1]->s.c_str());
+        return 1;
+      }
+    }
+    auto resp = c.call(reg, "execute",
+                       {wire::mk_int(session), wire::mk_str(query)});
+    // ExecutionResponse: code, error_msg, columns, rows, latency_us,
+    // space_name, warning, profile
+    std::string out = "{\"code\": " + std::to_string(resp->items[0]->i);
+    out += ", \"error_msg\": ";
+    wire::json_escape(out, resp->items[1]->s);
+    out += ", \"columns\": ";
+    wire::to_json(out, *resp->items[2], reg);
+    out += ", \"rows\": ";
+    wire::to_json(out, *resp->items[3], reg);
+    out += ", \"latency_us\": " + std::to_string(resp->items[4]->i);
+    out += "}";
+    printf("%s\n", out.c_str());
+    c.call(reg, "signout", {wire::mk_int(session)});
+    return resp->items[0]->i == 0 ? 0 : 1;
+  } catch (const wire::DecodeError &e) {
+    fprintf(stderr, "protocol error: %s\n", e.msg.c_str());
+    return 1;
+  }
+}
