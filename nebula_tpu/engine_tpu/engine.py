@@ -84,6 +84,8 @@ class TpuGraphEngine:
         # not)
         self._lock = threading.RLock()
         self._repacking: Dict[int, bool] = {}
+        self._prewarming: Dict[int, bool] = {}
+        self._prewarm_threads: Dict[int, threading.Thread] = {}
         # pull-mode budget: frontiers whose cumulative edge visits stay
         # under this run on host mirrors; larger ones amortize the dense
         # device dispatch (direction-optimized execution). The default
@@ -202,6 +204,66 @@ class TpuGraphEngine:
             return None
         with self._lock:
             return self._snapshot_locked(space_id)
+
+    def prewarm(self, space_id: int, block: bool = False) -> None:
+        """Build the space's snapshot and compile the hot traversal
+        kernels OFF the query path: on a fresh process the first dense
+        dispatch pays ~20-40s of XLA compile, which would otherwise
+        land on whoever runs the first big query. Fired on USE when
+        the engine serves the space (no reference analogue — compile
+        warmup is an accelerator concern). Idempotent; at most one
+        warmup per space at a time."""
+        if not (self.enabled and self._provider is not None):
+            return
+        if self._prewarming.get(space_id):
+            if block:
+                t = self._prewarm_threads.get(space_id)
+                if t is not None:
+                    t.join()   # wait out the in-flight warmup
+            return
+        self._prewarming[space_id] = True
+
+        def run():
+            try:
+                # build OFF TO THE SIDE (like the background repack) so
+                # a space that's still being bulk-loaded never gets a
+                # soon-stale snapshot installed under live queries
+                snap = self._build_fresh(space_id)
+                if snap is None or getattr(snap, "sharded_kernel",
+                                           None) is not None:
+                    return   # meshed kernels compile per-query shapes
+                import jax.numpy as jnp
+                etypes = sorted({int(t) for s in snap.shards
+                                 for t in np.unique(s.edge_etype)
+                                 if t > 0}) or [1]
+                req = jnp.asarray(traverse.pad_edge_types(
+                    etypes[:traverse.MAX_EDGE_TYPES_PER_QUERY]))
+                f0 = jnp.zeros((snap.num_parts, snap.cap_v), bool)
+                _, a = traverse.multi_hop(f0, jnp.int32(2), snap.kernel,
+                                          req)
+                a.block_until_ready()
+                traverse.bfs_dist(f0, jnp.int32(2), snap.kernel,
+                                  req).block_until_ready()
+                # install only if still current and nothing else served
+                # the space meanwhile — otherwise the compile-cache
+                # warmup was the whole point and the build is dropped
+                with self._lock:
+                    if space_id not in self._snapshots and \
+                            self._provider is not None and \
+                            self._provider.version(space_id) == \
+                            snap.write_version:
+                        self._snapshots[space_id] = snap
+            except Exception:
+                _LOG.exception("prewarm of space %d failed", space_id)
+            finally:
+                self._prewarming[space_id] = False
+
+        t = threading.Thread(target=run, daemon=True,
+                             name=f"csr-prewarm-{space_id}")
+        self._prewarm_threads[space_id] = t
+        t.start()
+        if block:
+            t.join()
 
     def _snapshot_locked(self, space_id: int) -> Optional[CsrSnapshot]:
         token = self._provider.version(space_id)

@@ -26,6 +26,12 @@ def execute_use(ctx: ExecContext, s: ast.UseSentence) -> Result:
         return StatusOr.from_status(r.status)
     ctx.session.space_name = s.space
     ctx.session.space_id = r.value().space_id
+    # USE is the earliest signal a space is about to be queried: build
+    # its device snapshot + compile the traversal kernels in the
+    # background so the first big GO doesn't pay the XLA compile
+    tpu = getattr(ctx.engine, "tpu_engine", None)
+    if tpu is not None:
+        tpu.prewarm(r.value().space_id)
     return _ok()
 
 

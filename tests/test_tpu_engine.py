@@ -976,3 +976,33 @@ def test_device_grouped_declines_qualified_key_over_multi_types(agg_pair):
     rc2, rt2 = cpu_conn.must(q2), tpu_conn.must(q2)
     assert sorted(map(repr, rc2.rows)) == sorted(map(repr, rt2.rows))
     assert tpu.stats["agg_served"] == 1, tpu.stats
+
+
+def test_prewarm_builds_snapshot_and_stays_identical():
+    """USE kicks a background snapshot build + kernel compile so the
+    first big GO doesn't pay the XLA compile; queries before/after are
+    unaffected."""
+    import time as _t
+
+    _, cpu_conn = load_nba(space="pw_cpu")
+    tpu = TpuGraphEngine()
+    cluster = InProcCluster(tpu_engine=tpu)
+    _, conn = load_nba(cluster, space="pw")
+    sid = cluster.meta.get_space("pw").value().space_id
+    # the USE during load already kicked an async warmup whose install
+    # is dropped (data kept changing under it) — drain it, then warm
+    # against the now-stable space
+    tpu.prewarm(sid, block=True)
+    tpu.prewarm(sid, block=True)
+    assert sid in tpu._snapshots              # snapshot built off-path
+    assert not tpu._prewarming.get(sid)
+    r1 = conn.must("GO 2 STEPS FROM 100 OVER like YIELD like._dst")
+    r2 = cpu_conn.must("GO 2 STEPS FROM 100 OVER like YIELD like._dst")
+    assert sorted(map(str, r1.rows)) == sorted(map(str, r2.rows))
+    # USE triggers it too (async): the flag flips or the build finishes
+    tpu._snapshots.clear()
+    conn.must("USE pw")
+    deadline = _t.time() + 15
+    while _t.time() < deadline and sid not in tpu._snapshots:
+        _t.sleep(0.05)
+    assert sid in tpu._snapshots
