@@ -79,8 +79,13 @@ def run_soak(seconds: float = 10.0, write_ratio: float = 0.3,
         seed_vid = rng.randrange(v)
         steps = rng.choice([1, 2, 2, 3])
         cut = rng.randrange(0, 101)
-        q = (f"GO {steps} STEPS FROM {seed_vid} OVER knows "
-             f"WHERE knows.w > {cut} YIELD knows._dst, knows.w")
+        if rng.random() < 0.15:    # aggregation pipes in the soak mix
+            q = (f"GO {steps} STEPS FROM {seed_vid} OVER knows "
+                 f"WHERE knows.w > {cut} YIELD knows.w AS w "
+                 f"| YIELD COUNT(*) AS n, SUM($-.w) AS s, AVG($-.w) AS a")
+        else:
+            q = (f"GO {steps} STEPS FROM {seed_vid} OVER knows "
+                 f"WHERE knows.w > {cut} YIELD knows._dst, knows.w")
         t0 = time.monotonic()
         r = conn.must(q)
         lats.append((time.monotonic() - t0) * 1e3)
