@@ -95,6 +95,21 @@ class Schema:
         i = self.field_index(name)
         return self.fields[i].type if i >= 0 else None
 
+    def field(self, name: str) -> Optional["SchemaField"]:
+        i = self.field_index(name)
+        return self.fields[i] if i >= 0 else None
+
+    def default_value(self, name: str):
+        """Schema default of a field (explicit default, else the type
+        default) — what a vertex missing the tag yields for the prop
+        (ref: RowReader::getDefaultProp, dataman/RowReader.h:91, used
+        by GoExecutor::VertexHolder::get, GoExecutor.cpp:1009-1018).
+        None when the field doesn't exist."""
+        f = self.field(name)
+        if f is None:
+            return None
+        return f.default if f.default is not None else default_for(f.type)
+
     def has_field(self, name: str) -> bool:
         return name in self._index
 

@@ -64,11 +64,17 @@ class PropColumn:
                                     RelationalExpr null rules apply
       missing[i]                 -> the row's schema version doesn't
                                     have the field, or no row decoded
-                                    at this slot (vertex without the
-                                    tag): evaluating it raises
-                                    EvalError on the CPU path (drops
-                                    the row in WHERE, fails the query
-                                    in YIELD)
+                                    at this slot. For EDGE columns the
+                                    CPU path raises EvalError for both
+                                    (drops the row in WHERE, fails the
+                                    query in YIELD). For TAG columns a
+                                    plain no-row cell reads as the
+                                    SCHEMA DEFAULT (ref
+                                    VertexHolder::get → getDefaultProp)
+                                    while version-lacks-the-prop stays
+                                    an error — `version_missing` below
+                                    tells the vectorized paths which
+                                    mix they're looking at
     `missing is None` is the common fast case: every slot that callers
     can select decoded a row carrying the field — ~present means NULL."""
     name: str
@@ -79,6 +85,13 @@ class PropColumn:
     present: Optional[np.ndarray] = None  # bool, True where value usable
     str_dict: Optional[Dict[str, int]] = None  # string -> code
     missing: Optional[np.ndarray] = None  # bool, see above
+    # True iff `missing` may include VERSION-lacks-the-prop cells (the
+    # multi-version builders) — for TAG columns those are CPU errors
+    # while plain no-row cells read as schema defaults (ref
+    # VertexHolder::get → getDefaultProp); vectorized paths decline
+    # only when this is set. Delta materialization (tombstones) keeps
+    # it False: every such missing cell is a no-row cell.
+    version_missing: bool = False
 
 
 def host_item(col: PropColumn, idx: int):
@@ -779,7 +792,8 @@ def _row_versions(rows: "RowsBlock") -> np.ndarray:
 
 def _finish_column(name: str, t: PropType, vals: List[Any], cap: int,
                    dict_registry: Dict, dict_key: Tuple,
-                   missing: Optional[np.ndarray]) -> PropColumn:
+                   missing: Optional[np.ndarray],
+                   version_missing: bool = False) -> PropColumn:
     """Assemble one PropColumn from a None-holed python value list."""
     host = np.array(vals, dtype=object)
     device_ok = True
@@ -811,7 +825,7 @@ def _finish_column(name: str, t: PropType, vals: List[Any], cap: int,
         device_ok = False
     present = np.array([v is not None for v in vals], dtype=bool)
     return PropColumn(name, t, host, device_ok, device_vals, present,
-                      str_dict, missing)
+                      str_dict, missing, version_missing=version_missing)
 
 
 def _native_build_columns_multi(schemas_by_ver: Dict[int, Schema],
@@ -918,7 +932,8 @@ def _native_build_columns_multi(schemas_by_ver: Dict[int, Schema],
         t = field_types[n]
         m, pr = miss[n], pres[n]
         if n in conflicted:
-            out[n] = PropColumn(n, t, obj[n], False, None, pr, None, m)
+            out[n] = PropColumn(n, t, obj[n], False, None, pr, None, m,
+                                version_missing=True)
             continue
         if t in (PropType.INT, PropType.VID, PropType.TIMESTAMP):
             vals = val64[n]
@@ -926,14 +941,16 @@ def _native_build_columns_multi(schemas_by_ver: Dict[int, Schema],
             device_ok = not (pos.size and (
                 vals[pos].min() < _I32_MIN or vals[pos].max() > _I32_MAX))
             dv = vals.astype(np.int32) if device_ok else None
-            out[n] = PropColumn(n, t, vals, device_ok, dv, pr, None, m)
+            out[n] = PropColumn(n, t, vals, device_ok, dv, pr, None, m,
+                                version_missing=True)
         elif t == PropType.DOUBLE:
             vals = valf[n]
             dv = np.where(pr, vals, np.nan).astype(np.float32)
-            out[n] = PropColumn(n, t, vals, True, dv, pr, None, m)
+            out[n] = PropColumn(n, t, vals, True, dv, pr, None, m,
+                                version_missing=True)
         elif t == PropType.BOOL:
             out[n] = PropColumn(n, t, valb[n], True, valb[n].copy(), pr,
-                                None, m)
+                                None, m, version_missing=True)
         else:   # STRING
             host = np.empty(cap, object)
             if dict_registry is not None and dict_key is not None:
@@ -944,7 +961,8 @@ def _native_build_columns_multi(schemas_by_ver: Dict[int, Schema],
             for i, s in str_cells[n].items():
                 host[i] = s
                 codes[i] = sd.setdefault(s, len(sd))
-            out[n] = PropColumn(n, t, host, True, codes, pr, sd, m)
+            out[n] = PropColumn(n, t, host, True, codes, pr, sd, m,
+                                version_missing=True)
     return out
 
 
@@ -1039,9 +1057,10 @@ def _build_columns(schema: Schema, cap: int, rows: "RowsBlock", now: float,
             present = np.array([v is not None for v in vals], bool)
             out[name] = PropColumn(name, field_types[name],
                                    np.array(vals, dtype=object), False,
-                                   None, present, None, m)
+                                   None, present, None, m,
+                                   version_missing=multi)
             continue
         out[name] = _finish_column(
             name, field_types[name], host_cols[name], cap,
-            dict_registry, dict_key, m)
+            dict_registry, dict_key, m, version_missing=multi)
     return out

@@ -246,6 +246,12 @@ def _patch_prop_columns(snap, cols: Dict, idx: int, props: Optional[dict],
                 col.missing = (~col.present if col.present is not None
                                else np.zeros(len(col.host), bool))
             col.missing[idx] = True
+            if visible and props is not None:
+                # a VISIBLE row whose schema version lacks this key:
+                # the CPU raises for it (unlike tombstone/TTL no-row
+                # cells, which read as schema defaults for tags) —
+                # flag the mask so vectorized tag paths decline
+                col.version_missing = True
         elif col.missing is not None:
             col.missing[idx] = False
         if col.host.dtype == object:
@@ -260,8 +266,19 @@ def _patch_prop_columns(snap, cols: Dict, idx: int, props: Optional[dict],
                 col.device_ok = False   # out-of-range: host-only now
             elif enc is not None:
                 col.device_vals[idx] = enc
-            elif col.ptype == PropType.STRING:
-                col.device_vals[idx] = -1
+            else:
+                # v is None (tombstone / null / version-missing):
+                # restore the BUILD-TIME absent encoding — stale
+                # values here would leak into the vectorized tag
+                # paths, which assume absent cells encode defaults
+                if col.ptype == PropType.STRING:
+                    col.device_vals[idx] = -1
+                elif col.ptype == PropType.DOUBLE:
+                    col.device_vals[idx] = np.float32(np.nan)
+                elif col.ptype == PropType.BOOL:
+                    col.device_vals[idx] = False
+                else:
+                    col.device_vals[idx] = 0
     snap._device_prop_cache.clear()
 
 

@@ -769,10 +769,13 @@ class TpuGraphEngine:
             return None, local_filter, None
         flt = local_filter
         tag_refs = self._filter_tag_refs(flt)
+        from ..graph.executors import make_tag_default_resolver
+        tag_default = make_tag_default_resolver(ctx.sm, ctx.space_id())
 
         def delta_passes(info):
             return self._delta_row_passes(ctx, snap, flt, alias_map,
-                                          name_by_type, info, tag_refs)
+                                          name_by_type, info, tag_refs,
+                                          tag_default)
         return hf, None, delta_passes
 
     @staticmethod
@@ -792,7 +795,7 @@ class TpuGraphEngine:
         return src, dst
 
     def _delta_row_passes(self, ctx, snap, flt, alias_map, name_by_type,
-                          info, tag_refs) -> bool:
+                          info, tag_refs, tag_default) -> bool:
         """Evaluate a WHERE filter on one delta-buffer edge row with
         the executor's exact per-row semantics (EvalError drops the
         row). Only reachable for host-vectorizable filters, which never
@@ -825,7 +828,8 @@ class TpuGraphEngine:
             src_props=named_tag_props(src_vid, src_tags), edge_props=props,
             edge_name=name_by_type.get(abs(etype), str(abs(etype))),
             alias_map=alias_map, src=src_vid, dst=dst_vid, rank=rank,
-            dst_props=named_tag_props(dst_vid, dst_tags))
+            dst_props=named_tag_props(dst_vid, dst_tags),
+            tag_default=tag_default)
         from ..filter.expressions import EvalError
         try:
             return bool(flt.eval(ectx))

@@ -181,7 +181,15 @@ class FilterCompiler:
 
     def _tag_prop_val(self, tag: str, prop: str, dest: bool) -> _Val:
         """$^ (gather through edge_src) or $$ (gather through the dst
-        global index) tag prop as per-edge values."""
+        global index) tag prop as per-edge values.
+
+        Tag-prop semantics (ref VertexHolder::get → getDefaultProp,
+        GoExecutor.cpp:1009-1018): a vertex with NO tag row reads as
+        the schema default — its device cell already encodes the type
+        default (0 / False; strings get the interned ""-code patched
+        in). Outside the exact surface (DOUBLE, explicit defaults,
+        nullable, columns with missing-version masks — which mix
+        "no row" with "version lacks the prop") the host walk serves."""
         snap = self.snap
         tid = self.sm.tag_id(self.space_id, tag)
         if tid is None:
@@ -189,32 +197,46 @@ class FilterCompiler:
         col = snap.device_tag_prop(tid, prop)
         if col is None:
             raise _Unsupported()
-        ptype = self.sm.tag_schema(self.space_id, tid).value().field_type(prop)
-        if ptype is None or ptype == PropType.DOUBLE:
-            # float32 device mirror diverges from exact float64 — the
-            # host vectorized evaluator serves doubles instead
+        r = self.sm.tag_schema(self.space_id, tid)
+        f = r.value().field(prop) if r.ok() else None
+        if f is None or f.type == PropType.DOUBLE or \
+                f.default is not None or f.nullable:
             raise _Unsupported()
-        null_v, err_v = self._col_states("t", tid, prop, snap.cap_v)
+        ptype = f.type
+        is_string = ptype == PropType.STRING
+        patches = []
+        for s in snap.shards:
+            c = s.tag_props.get(tid, {}).get(prop)
+            if c is None:
+                if is_string:
+                    patches.append(np.ones(snap.cap_v, bool))
+                continue
+            if c.version_missing and c.missing is not None \
+                    and c.missing.any():
+                raise _Unsupported()
+            if is_string:
+                patches.append(~c.present if c.present is not None
+                               else np.zeros(snap.cap_v, bool))
+        if is_string:
+            sd = snap.str_dicts.setdefault(("t", prop), {})
+            default_code = sd.setdefault("", len(sd))
+            patch_v = jnp.asarray(np.stack(patches))
+            col = jnp.where(patch_v, jnp.int32(default_code), col)
+        # numeric/bool device cells already hold the type default at
+        # absent slots (0 / False)
         if dest:
+            # the dump slot (invalid edges) reads as default too — such
+            # edges are masked out of `active` before the filter lands
             flat = jnp.concatenate([col.reshape(-1),
                                     jnp.zeros((1,), col.dtype)])
-            flat_n = jnp.concatenate([null_v.reshape(-1),
-                                      jnp.zeros((1,), jnp.bool_)])
-            flat_e = jnp.concatenate([err_v.reshape(-1),
-                                      jnp.ones((1,), jnp.bool_)])
             vals = flat[snap.d_edge_gidx]
-            null = flat_n[snap.d_edge_gidx]
-            err = flat_e[snap.d_edge_gidx]
         else:
             vals = jnp.take_along_axis(col, snap.d_edge_src, axis=1)
-            null = jnp.take_along_axis(null_v, snap.d_edge_src, axis=1)
-            err = jnp.take_along_axis(err_v, snap.d_edge_src, axis=1)
         if ptype == PropType.STRING:
-            return _Val("strcode", vals, null, err, ("t", prop))
+            return _Val("strcode", vals, _F, _F, ("t", prop))
         if col.dtype == jnp.bool_:
-            return _Val("bool", vals, null, err)
-        return _Val("num", vals, null, err,
-                    intlike=ptype != PropType.DOUBLE)
+            return _Val("bool", vals, _F, _F)
+        return _Val("num", vals, _F, _F, intlike=True)
 
     # ------------------------------------------------------------------
     def _compile(self, e: Expression) -> _Val:

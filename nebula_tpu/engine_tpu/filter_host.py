@@ -239,27 +239,67 @@ class HostFilterCompiler:
         return fn
 
     def _tag_prop_fn(self, tag: str, prop: str):
-        """-> (kind, intlike, per-(shard, local-idx) gather closure)."""
+        """-> (kind, intlike, per-(shard, local-idx) gather closure).
+
+        Tag-prop semantics (ref VertexHolder::get → getDefaultProp,
+        GoExecutor.cpp:1009-1018): a vertex with NO tag row — incl.
+        TTL-expired, and shards where no vertex carries the tag —
+        evaluates to the schema default; a row whose VERSION lacks the
+        prop stays err (CPU raises). Fields with an explicit default
+        are outside this vectorized surface (mirrors encode type
+        defaults at absent cells) — per-row walk serves them."""
         tid = self.sm.tag_id(self.space_id, tag)
         if tid is None:
             raise _Unsupported()
         r = self.sm.tag_schema(self.space_id, tid)
-        t = r.value().field_type(prop) if r.ok() else None
-        if t is None:
+        f = r.value().field(prop) if r.ok() else None
+        if f is None or f.default is not None or f.nullable:
+            # explicit defaults aren't encoded in the mirrors, and
+            # explicit NULLs aren't defaults — per-row walk serves both
             raise _Unsupported()
+        t = f.type
         self._check_cols("t", tid, prop)
+        for s in self.snap.shards:
+            c = s.tag_props.get(tid, {}).get(prop)
+            if c is not None and c.version_missing and \
+                    c.missing is not None and c.missing.any():
+                # a multi-version mask mixes "no row" (default) with
+                # "version lacks the prop" (CPU raises) — the per-row
+                # walk separates them exactly. Delta-materialized
+                # masks (tombstones) are pure no-row: default cells.
+                raise _Unsupported()
         snap = self.snap
         kind = self._kind_of(t)
         intlike = t != PropType.DOUBLE if kind == "num" else None
 
+        empty_code = None
+        if kind == "strcode":
+            # "" must have ONE consistent code everywhere — intern it
+            # into the global (kind, prop) dict the columns share
+            sd = snap.str_dicts.setdefault(("t", prop), {})
+            empty_code = sd.setdefault("", len(sd))
+
         def gather(p0, locals_):
-            """-> (vals | None, null, err); vals None when no vertex in
-            the shard carries the tag (all err — CPU raises)."""
+            """-> (vals | None, null, err); vals None = every cell is
+            the type default (no column in this shard; numeric/bool —
+            strings fill the interned ""-code instead). Absent cells
+            (no tag row; the missing-mask case was declined above)
+            read as the type default — 0/False already encoded in the
+            mirrors."""
+            n = len(locals_)
+            no_null = np.zeros(n, bool)
             col = snap.shards[p0].tag_props.get(tid, {}).get(prop)
             if col is None:
-                n = len(locals_)
-                return None, np.zeros(n, bool), np.ones(n, bool)
-            return _leaf_states(col, locals_)
+                if kind == "strcode":
+                    return (np.full(n, empty_code, np.int32),
+                            no_null, no_null)
+                return None, no_null, no_null
+            vals, _null, _err = _leaf_states(col, locals_)
+            if kind == "strcode" and col.present is not None:
+                absent = ~col.present[locals_]
+                if absent.any():
+                    vals = np.where(absent, np.int32(empty_code), vals)
+            return vals, no_null, no_null
         return kind, intlike, gather
 
     # -- expression walk ----------------------------------------------

@@ -143,44 +143,55 @@ def _plan(expr, sm, space: int, alias_map: Dict[str, str],
             return out
         return edge_prop
 
-    if isinstance(expr, SourcePropExpr):
+    if isinstance(expr, (SourcePropExpr, DestPropExpr)):
+        # tag-prop semantics (ref VertexHolder::get → getDefaultProp,
+        # GoExecutor.cpp:1009-1018): a vertex with NO tag row yields
+        # the schema default; a row whose VERSION lacks the prop is a
+        # CPU-raise (fallback); unknown tag/prop is a query error
+        # (fallback: the slow path raises it exactly)
         tid = sm.tag_id(space, expr.tag)
         if tid is None:
             return None
+        r = sm.tag_schema(space, tid)
+        if not r.ok() or not r.value().has_field(expr.prop):
+            return None           # unknown prop: CPU raises
+        if r.value().field(expr.prop).nullable:
+            return None    # explicit NULLs aren't defaults: slow path
+        dflt = r.value().default_value(expr.prop)
         prop = expr.prop
 
-        def src_prop(env):
-            cols = env.shard.tag_props.get(tid)
+        def tag_vals(shard, locals_):
+            """column values at local slots with default fill, or None
+            to fall back (version-missing cells)."""
+            cols = shard.tag_props.get(tid)
             if cols is None or prop not in cols:
-                return None   # tag/prop unknown here: CPU raises
+                return np.full(len(locals_), dflt, object)
             col = cols[prop]
-            locals_ = env.src_local()
-            if col.present is not None and not col.present[locals_].all():
-                return None   # some src lacks the tag row: CPU raises
-            return col.host[locals_]
-        return src_prop
+            if col.version_missing and col.missing is not None \
+                    and col.missing[locals_].any():
+                return None       # version lacks the prop: CPU raises
+            vals = col.host[locals_]
+            if col.present is not None:
+                pres = col.present[locals_]
+                if not pres.all():
+                    vals = np.where(pres, vals.astype(object), dflt)
+            return vals
 
-    if isinstance(expr, DestPropExpr):
-        tid = sm.tag_id(space, expr.tag)
-        if tid is None:
-            return None
-        prop = expr.prop
+        if isinstance(expr, SourcePropExpr):
+            def src_prop(env):
+                return tag_vals(env.shard, env.src_local())
+            return src_prop
 
         def dst_prop(env):
             dparts = env.shard.edge_dst_part[env.idx]
             dlocals = env.shard.edge_dst_local[env.idx]
             out = np.empty(len(env.idx), object)
             for q in np.unique(dparts):
-                qshard = env.snap.shards[int(q)]
-                cols = qshard.tag_props.get(tid)
-                if cols is None or prop not in cols:
-                    return None
-                col = cols[prop]
                 sel = dparts == q
-                loc = dlocals[sel]
-                if col.present is not None and not col.present[loc].all():
-                    return None   # dst lacks the tag row: CPU raises
-                out[sel] = col.host[loc].tolist()
+                vals = tag_vals(env.snap.shards[int(q)], dlocals[sel])
+                if vals is None:
+                    return None
+                out[sel] = np.asarray(vals, object)
             return out
         return dst_prop
 

@@ -157,6 +157,30 @@ def _collect_prop_requirements(exprs: List[Expression], ctx: ExecContext
     return {k: sorted(v) for k, v in src_tags.items()}, needs_dst, needs_input
 
 
+def _check_tag_prop_refs(exprs: List[Expression],
+                         ctx: ExecContext) -> Status:
+    """Plan-time validation of every $^ / $$ reference: the TAG and the
+    PROP must exist in the catalog (ref: checkAndBuildContexts returns
+    E_TAG_PROP_NOT_FOUND, QueryBaseProcessor.inl:71-78; GoTest
+    NotExistTagProp). A vertex merely not CARRYING a known tag is NOT
+    an error — it reads as the schema default at eval time."""
+    space = ctx.space_id()
+    for expr in exprs:
+        for node in expr.walk():
+            if isinstance(node, (SourcePropExpr, DestPropExpr)):
+                tid = ctx.sm.tag_id(space, node.tag)
+                r = ctx.sm.tag_schema(space, tid) \
+                    if tid is not None else None
+                if r is None or not r.ok() or \
+                        not r.value().has_field(node.prop):
+                    ref = "$^" if isinstance(node, SourcePropExpr) \
+                        else "$$"
+                    return Status.error(
+                        ErrorCode.E_EXECUTION_ERROR,
+                        f"{ref}.{node.tag}.{node.prop} not found")
+    return Status.OK()
+
+
 def _fetch_dst_props(ctx: ExecContext, dsts: List[int]
                      ) -> Dict[int, Dict[str, Dict[str, Any]]]:
     """$$-prop support: batch-fetch dst vertex props keyed by tag name
@@ -217,6 +241,16 @@ def execute_go(ctx: ExecContext, s: ast.GoSentence) -> Result:
     if not edge_types:
         return _err(ErrorCode.E_EDGE_NOT_FOUND, "no edges in OVER clause")
 
+    yield_cols = _go_yield_columns(s, ctx, name_by_type)
+    all_exprs = [c.expr for c in yield_cols]
+    if s.where:
+        all_exprs.append(s.where.filter)
+    # plan-time $^/$$ validation runs BEFORE the device dispatch so
+    # both engines reject unknown tag props identically
+    st = _check_tag_prop_refs(all_exprs, ctx)
+    if not st.ok():
+        return StatusOr.from_status(st)
+
     # TPU offload seam: multi-hop frontier advance runs on device when the
     # space has a CSR snapshot attached (Phase 2+); CPU scatter/gather here.
     tpu = getattr(ctx.engine, "tpu_engine", None)
@@ -225,10 +259,6 @@ def execute_go(ctx: ExecContext, s: ast.GoSentence) -> Result:
         if r is not None:
             return r  # None = engine declined, fall back to CPU path
 
-    yield_cols = _go_yield_columns(s, ctx, name_by_type)
-    all_exprs = [c.expr for c in yield_cols]
-    if s.where:
-        all_exprs.append(s.where.filter)
     vertex_props, needs_dst, needs_input = _collect_prop_requirements(all_exprs, ctx)
 
     filter_bytes = None
@@ -304,6 +334,23 @@ def execute_go(ctx: ExecContext, s: ast.GoSentence) -> Result:
     return _ok(result)
 
 
+def make_tag_default_resolver(sm, space: int):
+    """(tag, prop) -> schema default for vertices that don't carry the
+    tag (ref: VertexHolder::get → RowReader::getDefaultProp,
+    GoExecutor.cpp:1009-1018); raises EvalError when the tag or prop
+    doesn't exist in the catalog (GoTest NotExistTagProp)."""
+    def resolver(tag: str, prop: str):
+        tid = sm.tag_id(space, tag)
+        if tid is not None:
+            r = sm.tag_schema(space, tid)
+            if r.ok():
+                v = r.value().default_value(prop)
+                if v is not None or r.value().has_field(prop):
+                    return v
+        raise EvalError(f"{tag}.{prop} not found")
+    return resolver
+
+
 def _emit_go_rows(ctx: ExecContext, resp, rows: List[Tuple],
                   yield_cols: List[ast.YieldColumn],
                   local_filter: Optional[Expression],
@@ -313,6 +360,7 @@ def _emit_go_rows(ctx: ExecContext, resp, rows: List[Tuple],
                   needs_input: bool, needs_dst: bool,
                   input_var: Optional[str] = None) -> Status:
     space = ctx.space_id()
+    tag_default = make_tag_default_resolver(ctx.sm, space)
     dst_props: Dict[int, Dict[str, Dict[str, Any]]] = {}
     if needs_dst:
         dsts = sorted({e.dst for v in resp.vertices for e in v.edges})
@@ -325,7 +373,8 @@ def _emit_go_rows(ctx: ExecContext, resp, rows: List[Tuple],
             base = dict(src_props=src_named, edge_props=e.props,
                         edge_name=edge_name, alias_map=alias_map,
                         src=e.src, dst=e.dst, rank=e.rank,
-                        dst_props=dst_props.get(e.dst, {}))
+                        dst_props=dst_props.get(e.dst, {}),
+                        tag_default=tag_default)
             if needs_input:
                 in_rows = []
                 for root in sorted(roots.get(v.vid, {v.vid})):

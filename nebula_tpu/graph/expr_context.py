@@ -40,7 +40,8 @@ class EdgeRowExprContext(RowExprContext):
                  src: int, dst: int, rank: int,
                  dst_props: Optional[Dict[str, Dict[str, Any]]] = None,
                  input_row: Optional[Dict[str, Any]] = None,
-                 variables: Optional[Dict[str, Dict[str, Any]]] = None):
+                 variables: Optional[Dict[str, Dict[str, Any]]] = None,
+                 tag_default=None):
         super().__init__(input_row, variables)
         self.src_props = src_props          # tag name -> props
         self.edge_props = edge_props
@@ -50,21 +51,39 @@ class EdgeRowExprContext(RowExprContext):
         self.dst = dst
         self.rank = rank
         self.dst_props = dst_props or {}    # tag name -> props (of dst vertex)
+        # (tag, prop) -> schema default, or raise EvalError when the
+        # tag/prop is unknown. A vertex that doesn't CARRY the tag
+        # yields the default (ref: VertexHolder::get falls back to
+        # RowReader::getDefaultProp, GoExecutor.cpp:1009-1018) —
+        # while an unknown tag/prop is a query error (GoTest
+        # NotExistTagProp) and a row whose version lacks the prop
+        # stays an error (GoExecutor.cpp:1023). Contexts built without
+        # a resolver keep the strict error behavior.
+        self._tag_default = tag_default
 
     def _check_edge(self, edge: Optional[str]) -> bool:
         if edge is None:
             return True
         return self.alias_map.get(edge, edge) == self.edge_name
 
+    def _default_or_raise(self, ref: str, tag: str, prop: str):
+        if self._tag_default is None:
+            raise EvalError(f"{ref}.{tag}.{prop} not found")
+        return self._tag_default(tag, prop)
+
     def get_src_prop(self, tag: str, prop: str):
         props = self.src_props.get(tag)
-        if props is None or prop not in props:
+        if props is None:
+            return self._default_or_raise("$^", tag, prop)
+        if prop not in props:
             raise EvalError(f"$^.{tag}.{prop} not found")
         return props[prop]
 
     def get_dst_prop(self, tag: str, prop: str):
         props = self.dst_props.get(tag)
-        if props is None or prop not in props:
+        if props is None:
+            return self._default_or_raise("$$", tag, prop)
+        if prop not in props:
             raise EvalError(f"$$.{tag}.{prop} not found")
         return props[prop]
 

@@ -90,7 +90,18 @@ class _StorageExprContext(ExpressionContext):
 
     def get_src_prop(self, tag: str, prop: str):
         props = self.src_props.get(tag)
-        if props is None or prop not in props:
+        if props is None:
+            # vertex doesn't carry the tag: schema default (the
+            # graphd-side rule, VertexHolder::get →
+            # RowReader::getDefaultProp — the pushed-down filter must
+            # evaluate exactly like the local one)
+            tid = self._sm.tag_id(self._space, tag)
+            if tid is not None:
+                r = self._sm.tag_schema(self._space, tid)
+                if r.ok() and r.value().has_field(prop):
+                    return r.value().default_value(prop)
+            raise EvalError(f"$^.{tag}.{prop} not found")
+        if prop not in props:
             raise EvalError(f"$^.{tag}.{prop} not found")
         return props[prop]
 

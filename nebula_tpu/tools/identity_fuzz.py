@@ -81,6 +81,12 @@ def _rand_filter(rnd: random.Random, edge: str,
                f"$$.person.age {rnd.choice(['>', '<='])} "
                f"{rnd.randrange(18, 80)}",
                f"$^.city.pop > {rnd.randrange(0, 500)}",
+               # most vertices lack `city`: pop reads as the schema
+               # default 0 (ref getDefaultProp semantics) — both the
+               # >-side (drops) and the <=-side (keeps) must agree
+               f"$$.city.pop {rnd.choice(['>', '<='])} "
+               f"{rnd.randrange(0, 500)}",
+               "$$.city.pop == 0",
                "!($$.person.age > 50)"]
     a = rnd.choice(leaves)
     if rnd.random() < 0.5:
@@ -105,7 +111,9 @@ def _rand_query(rnd: random.Random, n_v: int,
             "", f" YIELD {edge}._dst, {edge}._src",
             f" YIELD {edge}._dst AS d, $^.person.name",
             f" YIELD DISTINCT {edge}._dst",
-            f" YIELD {edge}._dst, $$.person.age"])
+            f" YIELD {edge}._dst, $$.person.age",
+            # city is on a vertex subset: default-fill YIELD cells
+            f" YIELD {edge}._dst, $$.city.pop, $^.city.pop"])
         return f"GO {steps}FROM {seeds} OVER {edge}{direction}{where}{yields}"
     if kind < 0.72:   # pipe with $- back-reference
         cut = rnd.randrange(100)

@@ -240,3 +240,56 @@ def test_repack_failure_surfaced_and_backed_off(pair, monkeypatch, caplog):
     _wait_done()
     assert sid not in tpu._repack_backoff
     assert tpu.stats["bg_repacks"] >= 1
+
+
+def test_tag_tombstone_reads_default_on_vectorized_paths(pair):
+    """Deleting a vertex resets its mirror cells: WHERE over the
+    snapshot's host/device tag columns must read the schema default
+    (0), not the stale pre-delete value (round-4 review finding)."""
+    cpu_conn, tpu_conn, tpu = pair
+    for conn in (cpu_conn, tpu_conn):
+        conn.must('INSERT VERTEX player(name, age) VALUES 9300:("T", 70)')
+        conn.must("INSERT EDGE like(likeness) VALUES 100 -> 9300:(50.0)")
+    q = "GO FROM 100 OVER like WHERE $$.player.age > 60 YIELD like._dst"
+    rc, rt = cpu_conn.must(q), tpu_conn.must(q)
+    assert sorted(rc.rows) == sorted(rt.rows)
+    assert (9300,) in rc.rows
+    # delete the DST vertex only — its edge remains; age now reads 0
+    for conn in (cpu_conn, tpu_conn):
+        conn.must("DELETE VERTEX 9300")
+    # re-link 100 -> 9300 (DELETE VERTEX removed its edges)
+    for conn in (cpu_conn, tpu_conn):
+        conn.must("INSERT EDGE like(likeness) VALUES 100 -> 9300:(50.0)")
+    rc, rt = cpu_conn.must(q), tpu_conn.must(q)
+    assert sorted(map(repr, rc.rows)) == sorted(map(repr, rt.rows))
+    assert (9300,) not in rc.rows           # default 0 is not > 60
+    q2 = "GO FROM 100 OVER like WHERE $$.player.age <= 60 YIELD like._dst"
+    rc2, rt2 = cpu_conn.must(q2), tpu_conn.must(q2)
+    assert sorted(map(repr, rc2.rows)) == sorted(map(repr, rt2.rows))
+    assert (9300,) in rc2.rows              # default 0 <= 60: kept
+    # YIELD of the defaulted prop agrees too
+    q3 = "GO FROM 100 OVER like YIELD like._dst, $$.player.name"
+    rc3, rt3 = cpu_conn.must(q3), tpu_conn.must(q3)
+    assert sorted(map(repr, rc3.rows)) == sorted(map(repr, rt3.rows))
+    assert (9300, "") in rc3.rows
+
+
+def test_delta_old_version_row_declines_vectorized_tags(pair):
+    """An ALTERed tag + a delta write encoded at the OLD version: the
+    new prop is a CPU EvalError for that row — the vectorized paths
+    must not silently default it (round-4 review finding)."""
+    cpu_conn, tpu_conn, tpu = pair
+    tpu_conn.must("GO FROM 100 OVER like")       # snapshot up
+    for conn in (cpu_conn, tpu_conn):
+        conn.must("ALTER TAG player ADD (mvp int)")
+        # new writes encode at the NEW version; old build-time rows
+        # keep their version -> their mvp cells are version-missing
+        conn.must('INSERT VERTEX player(name, age, mvp) '
+                  'VALUES 9301:("M", 30, 5)')
+        conn.must("INSERT EDGE like(likeness) VALUES 100 -> 9301:(60.0)")
+    # dsts include OLD-version vertices (mvp -> EvalError drops them
+    # in WHERE) and the new one (mvp = 5)
+    q = "GO FROM 100 OVER like WHERE $$.player.mvp >= 0 YIELD like._dst"
+    rc, rt = cpu_conn.must(q), tpu_conn.must(q)
+    assert sorted(map(repr, rc.rows)) == sorted(map(repr, rt.rows))
+    assert (9301,) in rc.rows

@@ -1006,3 +1006,52 @@ def test_prewarm_builds_snapshot_and_stays_identical():
     while _t.time() < deadline and sid not in tpu._snapshots:
         _t.sleep(0.05)
     assert sid in tpu._snapshots
+
+
+# ---------------------------------------------------------------------------
+# reference-parity: tag-prop defaults for vertices without the tag
+# (ref GoTest.cpp:453-465 expects {"Trail Blazers", ""} etc., via
+# VertexHolder::get -> RowReader::getDefaultProp; unknown props stay
+# errors, GoTest NotExistTagProp :683-698)
+# ---------------------------------------------------------------------------
+
+def test_tag_default_semantics_reference_parity(pair):
+    cpu_conn, tpu_conn, tpu = pair
+    # mixed dst kinds: teams have no player tag and vice versa — the
+    # reference yields type defaults ("" / 0), not an error
+    q = "GO FROM 100 OVER * YIELD $$.team.name, $$.player.name"
+    rc, rt = cpu_conn.must(q), tpu_conn.must(q)
+    assert sorted(map(repr, rc.rows)) == sorted(map(repr, rt.rows))
+    assert any(row[0] == "" for row in rc.rows)       # like-edges: no team
+    assert any(row[1] == "" for row in rc.rows)       # serve-edges: no player
+    q2 = "GO FROM 100 OVER like YIELD like._dst, $$.team.name"
+    rc2, rt2 = cpu_conn.must(q2), tpu_conn.must(q2)
+    assert sorted(map(repr, rc2.rows)) == sorted(map(repr, rt2.rows))
+    assert all(row[1] == "" for row in rc2.rows)
+    # int default is 0 — and WHERE compares against it (players have
+    # no team tag; serve dsts have no player tag -> age reads 0)
+    q3 = ("GO FROM 100 OVER serve WHERE $$.player.age < 33 "
+          "YIELD serve._dst")
+    rc3, rt3 = cpu_conn.must(q3), tpu_conn.must(q3)
+    assert sorted(rc3.rows) == sorted(rt3.rows)
+    assert rc3.rows, "default 0 < 33 should keep the team rows"
+    # unknown prop on a KNOWN tag stays a query error (NotExistTagProp)
+    for q4 in ("GO FROM 100 OVER serve YIELD $^.player.nope",
+               "GO FROM 100 OVER serve YIELD $$.team.nope"):
+        r_c, r_t = cpu_conn.execute(q4), tpu_conn.execute(q4)
+        assert not r_c.ok() and not r_t.ok(), q4
+
+
+def test_dangling_dst_defaults_and_traversal(pair):
+    """Edges to vids never inserted as vertices: traversal includes
+    them (edge keys are the truth) and their $$ props read as schema
+    defaults on both engines."""
+    cpu_conn, tpu_conn, tpu = pair
+    for conn in (cpu_conn, tpu_conn):
+        conn.must("INSERT EDGE like(likeness) VALUES 100 -> 888777:(50.0)")
+    q = "GO FROM 100 OVER like YIELD like._dst, $$.player.name"
+    rc, rt = cpu_conn.must(q), tpu_conn.must(q)
+    assert sorted(map(repr, rc.rows)) == sorted(map(repr, rt.rows))
+    assert (888777, "") in rc.rows
+    for conn in (cpu_conn, tpu_conn):   # restore fixture data
+        conn.must("DELETE EDGE like 100 -> 888777")
