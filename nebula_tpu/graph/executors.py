@@ -715,6 +715,33 @@ def execute_fetch_edges(ctx: ExecContext, s: ast.FetchEdgesSentence) -> Result:
         return _err(ErrorCode.E_EDGE_NOT_FOUND, s.edge)
     keys: List[EdgeKey] = []
     for k in s.keys or []:
+        if any(isinstance(x, (InputPropExpr, VariablePropExpr))
+               for x in (k.src, k.dst)):
+            # FETCH PROP ON e $-.src->$-.dst / $var.src->$var.dst:
+            # one edge key per row of the referenced table (ref
+            # FetchEdgesTest.cpp input-ref forms)
+            var = None
+            for x in (k.src, k.dst):
+                if isinstance(x, VariablePropExpr):
+                    var = x.var
+            res = ctx.variables.get(var) if var else ctx.input
+            if res is None or not res.rows:
+                continue
+            for row in res.rows:
+                rd = res.row_dict(row)
+                rctx = RowExprContext(None if var else rd,
+                                      {var: rd} if var else None)
+                try:
+                    sv, dv = k.src.eval(rctx), k.dst.eval(rctx)
+                except EvalError as ex:
+                    return _err(ErrorCode.E_EXECUTION_ERROR, str(ex))
+                for v in (sv, dv):
+                    if isinstance(v, bool) or not isinstance(v, int):
+                        return _err(
+                            ErrorCode.E_EXECUTION_ERROR,
+                            f"vertex id must be an integer, got {v!r}")
+                keys.append(EdgeKey(sv, et, k.rank, dv))
+            continue
         sr = eval_vid(ctx, k.src)
         dr = eval_vid(ctx, k.dst)
         if not sr.ok():
@@ -1197,10 +1224,23 @@ def execute_group_by(ctx: ExecContext, s: ast.GroupBySentence) -> Result:
     groups: Dict[Tuple, List[Tuple]] = {}
     # evaluate group keys + yield inputs per row
     yield_cols = s.yield_.columns
+    # a bare-name group key may reference one of the yield's OWN output
+    # aliases (ref GroupByExecutor: `GROUP BY teamName YIELD $-.name AS
+    # teamName, …` groups by the aliased expression,
+    # GroupByLimitTest.cpp:308-318); unknown names stay errors
+    alias_exprs = {c.name(): c.expr for c in yield_cols
+                   if not c.agg_fun}
+    key_exprs = []
+    for c in s.group_cols:
+        e = c.expr
+        if isinstance(e, EdgePropExpr) and e.edge is None \
+                and e.prop in alias_exprs:
+            e = alias_exprs[e.prop]
+        key_exprs.append(e)
     for r in ctx.input.rows:
         rctx = RowExprContext(ctx.input.row_dict(r))
         try:
-            key = tuple(c.expr.eval(rctx) for c in s.group_cols)
+            key = tuple(e.eval(rctx) for e in key_exprs)
             vals = tuple(c.expr.eval(rctx) for c in yield_cols)
         except EvalError as ex:
             return _err(ErrorCode.E_EXECUTION_ERROR, str(ex))

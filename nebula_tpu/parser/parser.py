@@ -266,6 +266,13 @@ class GQLParser:
         # input/variable ref?
         if self._at("$"):
             ref = self._expression()
+            if self._at("->"):
+                # FETCH PROP ON e $-.src->$-.dst (ref FetchEdgesTest)
+                keys = [self._edge_key_tail(ref)]
+                while self._accept(","):
+                    keys.append(self._edge_key_tail(self._expression()))
+                yld = self._opt_yield()
+                return ast.FetchEdgesSentence(name, keys, None, yld)
             yld = self._opt_yield()
             # decided tag-vs-edge at execution time; vertices by default,
             # executor re-dispatches if name is an edge

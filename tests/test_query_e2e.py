@@ -273,3 +273,34 @@ def test_go_upto_accumulates_steps(nba):
     assert rows(r) == [(104,), (105,)]
     r = conn.must("GO 2 STEPS FROM 103 OVER like YIELD like._dst AS id")
     assert rows(r) == [(105,)]
+
+
+def test_group_by_output_alias_reference_parity(nba):
+    """GROUP BY may name one of the yield's OWN output aliases (ref
+    GroupByLimitTest.cpp:308-318: GROUP BY teamName, start_year with
+    teamName defined by the yield); unknown bare names stay errors."""
+    _, conn = nba
+    r = conn.must(
+        "GO FROM 100, 101, 102 OVER serve "
+        "YIELD $$.team.name AS name, serve.start_year AS start "
+        "| GROUP BY teamName YIELD $-.name AS teamName, "
+        "MAX($-.start) AS mx, COUNT(*) AS n")
+    rows = sorted(r.rows)
+    assert ("Spurs", 2015, 3) in rows and len(rows) == 2
+    r2 = conn.execute("GO FROM 100 OVER serve YIELD serve._dst AS d "
+                      "| GROUP BY nope YIELD COUNT(*)")
+    assert not r2.ok()
+
+
+def test_fetch_edges_input_refs_reference_parity(nba):
+    """FETCH PROP ON <edge> $-.src->$-.dst and $var.src->$var.dst (ref
+    FetchEdgesTest.cpp input-ref forms)."""
+    _, conn = nba
+    r = conn.must("GO FROM 100 OVER serve YIELD serve._src AS src, "
+                  "serve._dst AS dst | FETCH PROP ON serve "
+                  "$-.src->$-.dst YIELD serve.start_year")
+    assert [row[-1] for row in r.rows] == [1997]
+    r = conn.must("$a = GO FROM 100, 101 OVER serve YIELD serve._src "
+                  "AS src, serve._dst AS dst; FETCH PROP ON serve "
+                  "$a.src->$a.dst YIELD serve.start_year")
+    assert sorted(row[-1] for row in r.rows) == [1997, 1999]
