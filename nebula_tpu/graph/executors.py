@@ -991,9 +991,69 @@ def execute_update_edge(ctx: ExecContext, s: ast.UpdateEdgeSentence) -> Result:
 # YieldExecutor.cpp, OrderByExecutor.cpp, LimitExecutor.cpp, GroupByExecutor.cpp)
 # ---------------------------------------------------------------------------
 
+def _expand_star_cols(ctx: ExecContext,
+                      cols: List[ast.YieldColumn]) -> List[ast.YieldColumn]:
+    """YIELD $-.* / $var.* expands to every column of the referenced
+    table (ref YieldTest: `YIELD $var.*`, `$var.* WHERE …`)."""
+    out: List[ast.YieldColumn] = []
+    for c in cols:
+        e = c.expr
+        if c.agg_fun is None and isinstance(e, InputPropExpr) \
+                and e.prop == "*":
+            src = ctx.input
+            for name in (src.columns if src is not None else []):
+                out.append(ast.YieldColumn(InputPropExpr(name), name))
+            continue
+        if c.agg_fun is None and isinstance(e, VariablePropExpr) \
+                and e.prop == "*":
+            src = ctx.variables.get(e.var)
+            for name in (src.columns if src is not None else []):
+                out.append(ast.YieldColumn(
+                    VariablePropExpr(e.var, name), name))
+            continue
+        out.append(c)
+    return out
+
+
 def execute_yield(ctx: ExecContext, s: ast.YieldSentence) -> Result:
-    cols = s.yield_.columns
+    cols = _expand_star_cols(ctx, s.yield_.columns)
     agg = [c for c in cols if c.agg_fun]
+    if ctx.input is None:
+        # a standalone YIELD referencing ONE variable iterates that
+        # variable's rows (ref YieldTest yieldVar: `$var = GO …; YIELD
+        # $var.team` emits one row per var row)
+        exprs = [c.expr for c in cols]
+        if s.where:
+            exprs.append(s.where.filter)
+        vars_used = {n.var for e in exprs for n in e.walk()
+                     if isinstance(n, VariablePropExpr)}
+        if len(vars_used) == 1:
+            res = ctx.variables.get(next(iter(vars_used)))
+            if res is not None:
+                var = next(iter(vars_used))
+                rows = []
+                for r in res.rows:
+                    rctx = RowExprContext(None, {var: res.row_dict(r)})
+                    if s.where:
+                        try:
+                            if not s.where.filter.eval(rctx):
+                                continue
+                        except EvalError:
+                            continue
+                    try:
+                        rows.append(tuple(c.expr.eval(rctx)
+                                          for c in cols))
+                    except EvalError as ex:
+                        return _err(ErrorCode.E_EXECUTION_ERROR, str(ex))
+                if agg:
+                    return _aggregate_rows(list(cols), rows)
+                out = InterimResult([c.name() for c in cols], rows)
+                if s.yield_.distinct:
+                    out = out.distinct()
+                return _ok(out)
+        elif len(vars_used) > 1:
+            return _err(ErrorCode.E_EXECUTION_ERROR,
+                        "a YIELD may reference only one variable table")
     if ctx.input is not None:
         rows = []
         for r in ctx.input.rows:

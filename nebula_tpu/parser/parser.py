@@ -892,6 +892,8 @@ class GQLParser:
         self._expect("$")
         if self._accept("-"):
             self._expect(".")
+            if self._accept("*"):
+                return InputPropExpr("*")   # YIELD $-.* expansion
             return InputPropExpr(self._ident("input column"))
         if self._accept("^"):
             self._expect(".")
@@ -905,6 +907,8 @@ class GQLParser:
             return DestPropExpr(tag, self._ident("property name"))
         var = self._ident("variable name")
         self._expect(".")
+        if self._accept("*"):
+            return VariablePropExpr(var, "*")   # YIELD $var.*
         return VariablePropExpr(var, self._ident("column name"))
 
 

@@ -304,3 +304,29 @@ def test_fetch_edges_input_refs_reference_parity(nba):
                   "AS src, serve._dst AS dst; FETCH PROP ON serve "
                   "$a.src->$a.dst YIELD serve.start_year")
     assert sorted(row[-1] for row in r.rows) == [1997, 1999]
+
+
+def test_yield_star_and_var_rows_reference_parity(nba):
+    """YIELD $var.* / $-.* expand to every column of the referenced
+    table, and a standalone YIELD over one $var iterates the var's
+    ROWS (ref YieldTest yieldVar: one output row per var row)."""
+    _, conn = nba
+    conn.must("INSERT EDGE serve(start_year, end_year) "
+              "VALUES 100 -> 201:(2016, 2018)")
+    try:
+        pre = ("$var = GO FROM 100 OVER serve YIELD "
+               "$^.player.name AS name, serve.start_year AS start, "
+               "$$.team.name AS team; ")
+        r = conn.must(pre + "YIELD $var.*")
+        assert sorted(r.rows) == [("Tim Duncan", 1997, "Spurs"),
+                                  ("Tim Duncan", 2016, "Nuggets")]
+        assert r.columns == ["name", "start", "team"]
+        r = conn.must(pre + "YIELD $var.team WHERE $var.start > 2000")
+        assert r.rows == [("Nuggets",)]
+        r = conn.must(pre + "YIELD AVG($var.start) AS a, COUNT(*) AS n")
+        assert r.rows == [((1997 + 2016) / 2, 2)]
+        r = conn.must("GO FROM 100 OVER like YIELD like._dst AS d, "
+                      "like.likeness AS w | YIELD $-.*")
+        assert r.columns == ["d", "w"] and len(r.rows) == 2
+    finally:
+        conn.must("DELETE EDGE serve 100 -> 201")
