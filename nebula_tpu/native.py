@@ -16,7 +16,12 @@ import threading
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _NATIVE_DIR = os.path.join(_REPO, "native")
-_LIB_PATH = os.path.join(_NATIVE_DIR, "build", "libnebula_native.so")
+# NEBULA_NATIVE_LIB points tests at an alternate build — e.g. the
+# asan/ubsan .so (make -C native asan + LD_PRELOAD libasan), the role
+# of the reference's whole-suite sanitizer builds (CMakeLists:31-33)
+_LIB_PATH = os.environ.get(
+    "NEBULA_NATIVE_LIB",
+    os.path.join(_NATIVE_DIR, "build", "libnebula_native.so"))
 
 _lock = threading.Lock()
 _lib = None
