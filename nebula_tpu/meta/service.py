@@ -218,6 +218,16 @@ class MetaService:
         names = [f.name for f in fields]
         if len(set(names)) != len(names):
             raise ValueError("duplicate column name")
+        if ttl_col:
+            # the TTL column must exist and be int/timestamp (ref:
+            # SchemaTest 'ttl_col on not integer and timestamp column'
+            # must fail; meta/processors TTL validation)
+            t = next((f.type for f in fields if f.name == ttl_col), None)
+            if t is None:
+                raise ValueError(f"ttl_col {ttl_col!r} not a column")
+            if t not in (PropType.INT, PropType.TIMESTAMP):
+                raise ValueError(
+                    f"ttl_col {ttl_col!r} must be int or timestamp")
         return Schema(fields, version, ttl_col, ttl_duration)
 
     def _create_schema(self, is_edge: bool, space_id: int, name: str,
@@ -318,6 +328,11 @@ class MetaService:
                                                     default=c.get("default"))
                                         for c in changes])
             if drops:
+                if new.ttl_col and new.ttl_col in drops and \
+                        (ttl_col is None or ttl_col == new.ttl_col):
+                    return Status.error(
+                        ErrorCode.E_INVALID_ARGUMENT,
+                        f"cannot drop active ttl_col {new.ttl_col!r}")
                 new = new.with_dropped(drops)
             if not (adds or changes or drops):
                 new = Schema(list(cur.fields), cur.version + 1,
@@ -325,6 +340,15 @@ class MetaService:
         except ValueError as e:
             return Status.error(ErrorCode.E_INVALID_ARGUMENT, str(e))
         if ttl_col is not None:
+            if ttl_col:
+                t = new.field_type(ttl_col)
+                if t is None:
+                    return Status.error(ErrorCode.E_INVALID_ARGUMENT,
+                                        f"ttl_col {ttl_col!r} not a column")
+                if t not in (PropType.INT, PropType.TIMESTAMP):
+                    return Status.error(
+                        ErrorCode.E_INVALID_ARGUMENT,
+                        f"ttl_col {ttl_col!r} must be int or timestamp")
             new.ttl_col = ttl_col
         if ttl_duration is not None:
             new.ttl_duration = ttl_duration

@@ -190,3 +190,28 @@ def test_alter_user_requires_god_and_grant_checks_target_space():
     # self-service password change with old password still works
     eve.must('CHANGE PASSWORD eve FROM "pw" TO "pw2"')
     assert cluster.service.authenticate("eve", "pw2").ok()
+
+
+def test_ttl_col_validation_reference_parity(conn):
+    """TTL columns must be int/timestamp and can't be dropped while
+    active (ref SchemaTest: 'ttl_col on not integer and timestamp
+    column' fails)."""
+    conn.must("CREATE SPACE ttlsp(partition_num=1)")
+    conn.must("USE ttlsp")
+    conn.must("CREATE TAG woman(name string, age int, "
+              "row_timestamp timestamp) "
+              "ttl_duration = 100, ttl_col = row_timestamp")
+    conn.must("ALTER TAG woman ttl_duration = 50, "
+              "ttl_col = row_timestamp")
+    r = conn.execute("ALTER TAG woman ttl_col = name")
+    assert not r.ok()                      # string ttl col rejected
+    r = conn.execute("CREATE TAG bad(name string) "
+                     "ttl_duration = 10, ttl_col = name")
+    assert not r.ok()
+    r = conn.execute("CREATE TAG bad2(age int) "
+                     "ttl_duration = 10, ttl_col = nope")
+    assert not r.ok()                      # unknown ttl col rejected
+    r = conn.execute("ALTER TAG woman DROP (row_timestamp)")
+    assert not r.ok()                      # active ttl col undropable
+    conn.must('ALTER TAG woman ttl_col = ""')   # disable ttl...
+    conn.must("ALTER TAG woman DROP (row_timestamp)")   # ...then drop
