@@ -127,6 +127,44 @@ def execute_describe_schema(ctx: ExecContext, s: ast.DescribeSchemaSentence) -> 
     return _ok(InterimResult(["Field", "Type", "Null", "Default"], rows))
 
 
+def execute_show_create(ctx: ExecContext,
+                        s: ast.ShowCreateSentence) -> Result:
+    """SHOW CREATE SPACE|TAG|EDGE — render the DDL that would recreate
+    the object (ref SchemaTest.cpp:101-110, :238-250 output shapes)."""
+    if s.what == "SPACE":
+        r = ctx.meta.get_space(s.name)
+        if not r.ok():
+            return StatusOr.from_status(r.status)
+        d = r.value()
+        ddl = (f"CREATE SPACE {d.name} (partition_num = "
+               f"{d.partition_num}, replica_factor = {d.replica_factor})")
+        return _ok(InterimResult(["Space", "Create Space"],
+                                 [(d.name, ddl)]))
+    st = ctx.require_space()
+    if not st.ok():
+        return StatusOr.from_status(st)
+    space = ctx.space_id()
+    is_edge = s.what == "EDGE"
+    sid = (ctx.sm.edge_type if is_edge else ctx.sm.tag_id)(space, s.name)
+    if sid is None:
+        return _err(ErrorCode.E_EDGE_NOT_FOUND if is_edge
+                    else ErrorCode.E_TAG_NOT_FOUND, s.name)
+    sch = (ctx.sm.edge_schema if is_edge else ctx.sm.tag_schema)(
+        space, sid).value()
+    cols = []
+    for f in sch.fields:
+        col = f"  {f.name} {f.type.name.lower()}"
+        if f.default is not None:
+            col += f" default {f.default!r}" if isinstance(f.default, str) \
+                else f" default {f.default}"
+        cols.append(col)
+    ddl = (f"CREATE {s.what} {s.name} (\n" + ",\n".join(cols) + "\n) "
+           f"ttl_duration = {sch.ttl_duration or 0}, "
+           f"ttl_col = \"{sch.ttl_col or ''}\"")
+    return _ok(InterimResult([s.what.title(), f"Create {s.what.title()}"],
+                             [(s.name, ddl)]))
+
+
 def execute_show(ctx: ExecContext, s: ast.ShowSentence) -> Result:
     k = s.what
     if k == ast.ShowKind.SPACES:

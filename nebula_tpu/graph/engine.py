@@ -172,6 +172,7 @@ _DISPATCH: Dict[ast.Kind, Callable] = {
     ast.Kind.DESCRIBE_TAG: adm.execute_describe_schema,
     ast.Kind.DESCRIBE_EDGE: adm.execute_describe_schema,
     ast.Kind.SHOW: adm.execute_show,
+    ast.Kind.SHOW_CREATE: adm.execute_show_create,
     ast.Kind.CONFIG: adm.execute_config,
     ast.Kind.BALANCE: adm.execute_balance,
     ast.Kind.CREATE_USER: adm.execute_create_user,

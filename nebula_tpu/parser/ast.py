@@ -46,6 +46,7 @@ class Kind(enum.Enum):
     GROUP_BY = "group_by"
     SET_OP = "set_op"
     SHOW = "show"
+    SHOW_CREATE = "show_create"
     CONFIG = "config"
     BALANCE = "balance"
     CREATE_USER = "create_user"
@@ -632,6 +633,18 @@ class ShowSentence(Sentence):
 
     def to_string(self) -> str:
         return f"SHOW {self.what.value}" + (f" {self.arg}" if self.arg else "")
+
+
+@dataclass
+class ShowCreateSentence(Sentence):
+    """SHOW CREATE SPACE|TAG|EDGE <name> (ref: ShowSentence with
+    ShowType::kShowCreate*, parser/AdminSentences.h)."""
+    what: str          # SPACE | TAG | EDGE
+    name: str
+    kind = Kind.SHOW_CREATE
+
+    def to_string(self) -> str:
+        return f"SHOW CREATE {self.what} {self.name}"
 
 
 @dataclass

@@ -682,6 +682,10 @@ class GQLParser:
     # --- admin --------------------------------------------------------
     def _show(self):
         self._expect("SHOW")
+        if self._accept("CREATE"):
+            # SHOW CREATE SPACE|TAG|EDGE <name> (ref SchemaTest)
+            what = self._expect("SPACE", "TAG", "EDGE").type
+            return ast.ShowCreateSentence(what, self._ident("name"))
         if self._accept("CONFIGS"):
             module = None
             if self._at("GRAPH", "META", "STORAGE"):

@@ -215,3 +215,27 @@ def test_ttl_col_validation_reference_parity(conn):
     assert not r.ok()                      # active ttl col undropable
     conn.must('ALTER TAG woman ttl_col = ""')   # disable ttl...
     conn.must("ALTER TAG woman DROP (row_timestamp)")   # ...then drop
+
+
+def test_show_create_reference_parity(conn):
+    """SHOW CREATE SPACE|TAG|EDGE renders recreating DDL (ref
+    SchemaTest.cpp:101-110, :238-250)."""
+    conn.must("CREATE SPACE sc_sp(partition_num=9, replica_factor=1)")
+    r = conn.must("SHOW CREATE SPACE sc_sp")
+    assert r.rows == [("sc_sp", "CREATE SPACE sc_sp (partition_num = 9,"
+                       " replica_factor = 1)")]
+    conn.must("USE sc_sp")
+    conn.must("CREATE TAG person(name string, age int, "
+              "row_timestamp timestamp)")
+    r = conn.must("SHOW CREATE TAG person")
+    assert r.rows == [("person",
+                       "CREATE TAG person (\n  name string,\n"
+                       "  age int,\n  row_timestamp timestamp\n) "
+                       'ttl_duration = 0, ttl_col = ""')]
+    # round-trip: the rendered DDL recreates the schema
+    conn.must("DROP TAG person")
+    create = r.rows[0][1]
+    conn.must(create)
+    r2 = conn.must("SHOW CREATE TAG person")
+    assert r2.rows[0][1] == create
+    assert not conn.execute("SHOW CREATE TAG nope").ok()
