@@ -525,6 +525,14 @@ nkv *nkv_open(const char *checkpoint_path) {
 void nkv_close(nkv *e) {
   if (!e) return;
   e->join_merge();
+  if (!e->ckpt_path.empty()) {
+    // clean-shutdown durability: persist the memtable as a final run
+    // (the RocksEngine role closes through RocksDB's WAL; without
+    // this, an orderly stop would drop everything since the last
+    // threshold flush)
+    std::unique_lock<std::shared_mutex> g(e->mu);
+    e->flush_mem_locked();
+  }
   delete e;
 }
 

@@ -50,6 +50,10 @@ class StoragedHandle:
             net = getattr(self.node, "raft_net", None)
             if net is not None:
                 net.shutdown()
+        else:
+            # unreplicated: no raft WAL below — flush engine buffers
+            # on the way out (clean-shutdown durability)
+            self.store.close()
         if self.raft_server is not None:
             self.raft_server.stop()
         if self.web:

@@ -81,6 +81,18 @@ class GraphStore:
         info = self._spaces.get(space_id)
         return sorted(info.parts) if info else []
 
+    def close(self) -> None:
+        """Close every space engine (flushing what they buffer) — the
+        daemon's orderly-shutdown path."""
+        with self._lock:
+            infos = list(self._spaces.values())
+            self._spaces.clear()
+        for info in infos:
+            try:
+                info.engine.close()
+            except Exception:
+                pass
+
     def apply_engine_options(self, opts: Dict[str, int]) -> int:
         """Hot-apply engine tuning knobs to every space engine, and to
         engines of spaces added later (the config-registry path; ref
