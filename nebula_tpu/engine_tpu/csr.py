@@ -571,6 +571,24 @@ def build_shards(source, sm, space_id: int, num_parts: int
                         sm.edge_schema, space_id, _t, v))
                 if cols:
                     shard.edge_props[int(t)] = cols
+                if schema.ttl_col and schema.ttl_duration > 0 \
+                        and schema.ttl_col in cols:
+                    # TTL'd EDGE rows: the column builders already
+                    # dropped expired rows (invisible cells) — the
+                    # traversal must not serve those edges either (the
+                    # CPU scan checks TTL per row,
+                    # processors.py/get_bound). A null ttl value is
+                    # NOT expired (CPU: isinstance check fails), so
+                    # only missing-marked / no-value cells count.
+                    c = cols[schema.ttl_col]
+                    if c.missing is not None:
+                        dead = c.missing[sel]
+                    elif c.present is not None:
+                        dead = ~c.present[sel]
+                    else:
+                        dead = None
+                    if dead is not None and dead.any():
+                        edge_valid[sel[dead]] = False
         varr, vidx, vscan = vert_scans[p0]
         if varr is not None and len(vidx):
             tags = _unbias32(varr["tag"][vidx])

@@ -1055,3 +1055,58 @@ def test_dangling_dst_defaults_and_traversal(pair):
     assert (888777, "") in rc.rows
     for conn in (cpu_conn, tpu_conn):   # restore fixture data
         conn.must("DELETE EDGE like 100 -> 888777")
+
+
+def test_ttl_identity_on_device():
+    """TTL'd tag and edge rows: expired edges are invisible to the
+    device traversal and expired tag rows read as schema defaults —
+    identical to the CPU engine (TTL visibility applies at snapshot
+    build, matching what the CPU scan sees at query time)."""
+    import time as _t
+
+    now = int(_t.time())
+    stale, fresh = now - 5000, now
+    conns = []
+    tpu = TpuGraphEngine()
+    for cluster in (InProcCluster(), InProcCluster(tpu_engine=tpu)):
+        c = cluster.connect()
+        c.must("CREATE SPACE ttl_dev(partition_num=2)")
+        c.must("USE ttl_dev")
+        c.must("CREATE TAG mark(score int, ts timestamp) "
+               "ttl_duration = 1000, ttl_col = ts")
+        c.must("CREATE EDGE rel(w int, ts timestamp) "
+               "ttl_duration = 1000, ttl_col = ts")
+        c.must(f"INSERT VERTEX mark(score, ts) VALUES "
+               f"1:(11, {fresh}), 2:(22, {stale}), 3:(33, {fresh}), "
+               f"4:(44, {stale})")
+        c.must(f"INSERT EDGE rel(w, ts) VALUES "
+               f"1 -> 2:(12, {fresh}), 1 -> 3:(13, {stale}), "
+               f"2 -> 4:(24, {fresh}), 3 -> 4:(34, {fresh})")
+        conns.append(c)
+    cpu_conn, tpu_conn = conns
+    for q in ("GO FROM 1 OVER rel YIELD rel._dst",          # 1->3 expired
+              "GO 2 STEPS FROM 1 OVER rel YIELD rel._dst",
+              "GO FROM 1 OVER rel YIELD rel._dst, $$.mark.score",
+              "GO FROM 1, 2, 3 OVER rel WHERE $$.mark.score > 0 "
+              "YIELD rel._dst, $$.mark.score"):
+        rc, rt = cpu_conn.must(q), tpu_conn.must(q)
+        assert sorted(map(repr, rc.rows)) == sorted(map(repr, rt.rows)), \
+            (q, rc.rows, rt.rows)
+    # the expired edge really is gone, and the expired dst tag row
+    # (vid 2, stale) reads as default 0 on both engines
+    r = cpu_conn.must("GO FROM 1 OVER rel YIELD rel._dst, $$.mark.score")
+    assert sorted(r.rows) == [(2, 0)]
+    assert tpu.stats["go_served"] >= 4
+    # expired REVERSE copies are invisible too
+    for q in ("GO FROM 3 OVER rel REVERSELY YIELD rel._dst",
+              "GO FROM 4 OVER rel REVERSELY YIELD rel._dst"):
+        rc, rt = cpu_conn.must(q), tpu_conn.must(q)
+        assert sorted(rc.rows) == sorted(rt.rows), (q, rc.rows, rt.rows)
+    # TTL'd edges arriving through the DELTA buffer behave the same
+    for c in (cpu_conn, tpu_conn):
+        c.must(f"INSERT EDGE rel(w, ts) VALUES 1 -> 4:(14, {stale})")
+        c.must(f"INSERT EDGE rel(w, ts) VALUES 3 -> 1:(31, {fresh})")
+    rc = cpu_conn.must("GO FROM 1, 3 OVER rel YIELD rel._dst, rel.w")
+    rt = tpu_conn.must("GO FROM 1, 3 OVER rel YIELD rel._dst, rel.w")
+    assert sorted(map(repr, rc.rows)) == sorted(map(repr, rt.rows))
+    assert (1, 31) in rc.rows and (4, 14) not in rc.rows
