@@ -1,0 +1,172 @@
+"""TPU-capture watchdog: probe the accelerator relay continuously and
+run a trimmed benchmark the moment it answers.
+
+Round-4 verdict, item 1: the relay flaps; BENCH_r03/r04 both recorded
+`platform: cpu-fallback` because the relay happened to be dead at the
+single moment the driver ran bench.py. This watchdog inverts that: it
+probes all round and captures the on-chip number inside whatever
+up-window occurs, writing `BENCH_tpu_onchip.json` (platform: tpu/axon)
+plus a timestamped probe log (`TPU_WATCHDOG.log`) proving coverage
+either way.
+
+Design constraints (see common/accel.py for the history):
+- A dead relay hangs ANY normal `python` start via sitecustomize, so
+  the watchdog itself must be launched with `python -S` and do every
+  JAX-touching thing in a timeout-bounded SUBPROCESS.
+- A probe success can be a narrow window: the trimmed bench must fit
+  in ~5 min end-to-end (graph gen + ingest + compile + measure), so
+  the scale knobs are cut relative to bench.py's SNB defaults while
+  keeping the SNB shape (clipped-zipf knows).
+- The relay can die MID-bench: the bench subprocess gets a hard
+  timeout; a timeout/failure is logged and probing resumes.
+
+Escalation: after the first trimmed capture succeeds, the next
+successful probe attempts the FULL-scale bench (bench.py defaults,
+longer timeout) to `BENCH_tpu_onchip_full.json`. Trimmed evidence in
+hand is never overwritten by a failed full run.
+
+Usage:
+  env JAX_PLATFORMS= python -S scripts/tpu_watchdog.py [--once]
+(launched detached by the round driver / builder; stdlib-only parent).
+
+No reference analogue: QueryBoundBenchmark.cpp:181-191 assumes local
+devices; a tunneled flaky accelerator needs capture-on-recovery.
+"""
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(REPO, "TPU_WATCHDOG.log")
+OUT_TRIM = os.path.join(REPO, "BENCH_tpu_onchip.json")
+OUT_FULL = os.path.join(REPO, "BENCH_tpu_onchip_full.json")
+
+PROBE_TIMEOUT = float(os.environ.get("WATCHDOG_PROBE_TIMEOUT", 60))
+PROBE_INTERVAL = float(os.environ.get("WATCHDOG_PROBE_INTERVAL", 120))
+BENCH_TIMEOUT = float(os.environ.get("WATCHDOG_BENCH_TIMEOUT", 900))
+FULL_BENCH_TIMEOUT = float(os.environ.get("WATCHDOG_FULL_BENCH_TIMEOUT", 3600))
+
+# Trimmed SNB scale: same shape as bench.py defaults (V=1.2M/E=50M cut
+# 8x/10x), sized so gen+ingest+compile+measure lands well under the
+# bench subprocess timeout on a healthy chip.
+TRIM_ENV = {
+    "BENCH_V": "150000", "BENCH_E": "5000000", "BENCH_BATCH": "64",
+    "BENCH_ITERS": "5", "BENCH_LAT_N": "10", "BENCH_PY_E": "400000",
+}
+
+
+def log(msg: str) -> None:
+    line = f"{time.strftime('%Y-%m-%dT%H:%M:%S')} {msg}"
+    print(line, flush=True)
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+
+
+def probe() -> str:
+    """-> platform string of a real accelerator, or '' (down/cpu/hang).
+
+    Runs a fresh non`-S` interpreter (so sitecustomize dials the relay)
+    under a hard deadline; mirrors nebula_tpu/common/accel.py but kept
+    stdlib-inline so the `-S` parent needs no repo imports.
+    """
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)      # let the relay platform win
+    try:
+        out = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d = jax.devices(); "
+             "print('NEBULA_PROBE', d[0].platform, len(d))"],
+            capture_output=True, timeout=PROBE_TIMEOUT, text=True, env=env)
+        # the child is a full (non -S) interpreter: sitecustomize /
+        # runtime banners may share stdout, so parse only the marker
+        # line — and never let a malformed line kill the watchdog loop
+        marker = [ln for ln in (out.stdout or "").splitlines()
+                  if ln.startswith("NEBULA_PROBE ")]
+        if out.returncode == 0 and marker:
+            parts = marker[-1].split()
+            plat = parts[1] if len(parts) >= 2 else ""
+            if plat and plat != "cpu":
+                return plat
+            log("probe: backend up but platform=cpu (no accelerator)")
+        else:
+            err = (out.stderr or "").strip().splitlines()
+            log(f"probe: rc={out.returncode} {err[-1] if err else ''}")
+    except subprocess.TimeoutExpired:
+        log(f"probe: HANG >{PROBE_TIMEOUT:.0f}s (relay dead/flapping)")
+    except Exception as e:          # noqa: BLE001 — the loop must live
+        log(f"probe: error {e!r}")
+    return ""
+
+
+def run_bench(out_path: str, extra_env: dict, timeout: float) -> bool:
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.update(extra_env)
+    tag = os.path.basename(out_path)
+    log(f"bench -> {tag} starting (timeout {timeout:.0f}s, env {extra_env})")
+    t0 = time.time()
+    try:
+        out = subprocess.run(
+            [sys.executable, os.path.join(REPO, "bench.py")],
+            capture_output=True, timeout=timeout, text=True, env=env,
+            cwd=REPO)
+    except subprocess.TimeoutExpired:
+        log(f"bench -> {tag}: TIMEOUT after {timeout:.0f}s (relay died "
+            f"mid-run?)")
+        return False
+    dt = time.time() - t0
+    line = out.stdout.strip().splitlines()[-1] if out.stdout.strip() else ""
+    try:
+        data = json.loads(line)
+    except ValueError:
+        err = (out.stderr or "").strip().splitlines()
+        log(f"bench -> {tag}: FAILED rc={out.returncode} in {dt:.0f}s: "
+            f"{err[-1] if err else 'no output'}")
+        return False
+    plat = str(data.get("platform", ""))
+    if plat.startswith("cpu"):
+        log(f"bench -> {tag}: completed but platform={plat} (relay died "
+            f"between probe and backend init) — NOT capturing")
+        return False
+    data["captured_at"] = time.strftime("%Y-%m-%dT%H:%M:%S")
+    data["captured_by"] = "tpu_watchdog"
+    with open(out_path, "w") as f:
+        json.dump(data, f, indent=1)
+    log(f"bench -> {tag}: CAPTURED platform={plat} "
+        f"value={data.get('value')} {data.get('unit')} "
+        f"vs_baseline={data.get('vs_baseline')} in {dt:.0f}s")
+    return True
+
+
+def main() -> int:
+    once = "--once" in sys.argv
+    log(f"watchdog start pid={os.getpid()} interval={PROBE_INTERVAL:.0f}s "
+        f"probe_timeout={PROBE_TIMEOUT:.0f}s")
+    n = 0
+    while True:
+        n += 1
+        plat = ""
+        try:
+            plat = probe()
+            if plat:
+                log(f"probe #{n}: ACCELERATOR UP platform={plat}")
+                if not os.path.exists(OUT_TRIM):
+                    run_bench(OUT_TRIM, TRIM_ENV, BENCH_TIMEOUT)
+                elif not os.path.exists(OUT_FULL):
+                    run_bench(OUT_FULL, {}, FULL_BENCH_TIMEOUT)
+                else:
+                    log("both artifacts captured; watchdog idling "
+                        "(re-probe continues for the log record)")
+            else:
+                log(f"probe #{n}: down")
+        except Exception as e:      # noqa: BLE001 — the loop must live
+            log(f"watchdog iteration error: {e!r}")
+        if once:
+            return 0 if plat else 1
+        time.sleep(PROBE_INTERVAL)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
