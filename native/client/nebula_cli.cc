@@ -499,6 +499,31 @@ static int selftest(const std::string &path) {
   return count > 0 ? 0 : 1;
 }
 
+// Validated nested response access: a malformed or non-conforming
+// server reply must fail as a protocol error, never index out of
+// bounds or misread a union member (review finding, round 4). `kind`
+// of -1 accepts any member kind (callers reading sub-structs).
+static const wire::Value &field(const wire::Value &v, size_t i,
+                                int kind = -1) {
+  if (v.kind != wire::Value::STRUCT || i >= v.items.size() ||
+      !v.items[i])
+    throw wire::DecodeError{"bad response shape"};
+  const wire::Value &f = *v.items[i];
+  if (kind >= 0 && f.kind != kind)
+    throw wire::DecodeError{"bad response shape"};
+  return f;
+}
+
+// Status/response codes ride as INT or ENUM depending on the type's
+// registry entry — both store the payload in .i; anything else is a
+// protocol error, never a misread of an inactive union member.
+static long long code_field(const wire::Value &v, size_t i) {
+  const wire::Value &f = field(v, i);
+  if (f.kind != wire::Value::INT && f.kind != wire::Value::ENUM)
+    throw wire::DecodeError{"bad response shape"};
+  return f.i;
+}
+
 int main(int argc, char **argv) {
   std::string addr = "127.0.0.1:3699", user = "root", password = "",
               space, query, selftest_path;
@@ -538,19 +563,19 @@ int main(int argc, char **argv) {
     // authenticate -> StatusOr{Status{code, msg}, session_id}
     auto r = c.call(reg, "authenticate",
                     {wire::mk_str(user), wire::mk_str(password)});
-    if (r->kind != wire::Value::STRUCT || r->items.size() != 2 ||
-        r->items[0]->items[0]->i != 0) {
+    const auto &auth_st = field(*r, 0);
+    if (code_field(auth_st, 0) != 0) {
       fprintf(stderr, "auth failed: %s\n",
-              r->items[0]->items[1]->s.c_str());
+              field(auth_st, 1, wire::Value::STR).s.c_str());
       return 1;
     }
-    long long session = r->items[1]->i;
+    long long session = field(*r, 1, wire::Value::INT).i;
     if (!space.empty()) {
       auto u = c.call(reg, "execute",
                       {wire::mk_int(session), wire::mk_str("USE " + space)});
-      if (u->items[0]->i != 0) {
+      if (code_field(*u, 0) != 0) {
         fprintf(stderr, "USE %s failed: %s\n", space.c_str(),
-                u->items[1]->s.c_str());
+                field(*u, 1, wire::Value::STR).s.c_str());
         return 1;
       }
     }
@@ -558,18 +583,20 @@ int main(int argc, char **argv) {
                        {wire::mk_int(session), wire::mk_str(query)});
     // ExecutionResponse: code, error_msg, columns, rows, latency_us,
     // space_name, warning, profile
-    std::string out = "{\"code\": " + std::to_string(resp->items[0]->i);
+    long long code = code_field(*resp, 0);
+    std::string out = "{\"code\": " + std::to_string(code);
     out += ", \"error_msg\": ";
-    wire::json_escape(out, resp->items[1]->s);
+    wire::json_escape(out, field(*resp, 1, wire::Value::STR).s);
     out += ", \"columns\": ";
-    wire::to_json(out, *resp->items[2], reg);
+    wire::to_json(out, field(*resp, 2), reg);
     out += ", \"rows\": ";
-    wire::to_json(out, *resp->items[3], reg);
-    out += ", \"latency_us\": " + std::to_string(resp->items[4]->i);
+    wire::to_json(out, field(*resp, 3), reg);
+    out += ", \"latency_us\": " +
+           std::to_string(field(*resp, 4, wire::Value::INT).i);
     out += "}";
     printf("%s\n", out.c_str());
     c.call(reg, "signout", {wire::mk_int(session)});
-    return resp->items[0]->i == 0 ? 0 : 1;
+    return code == 0 ? 0 : 1;
   } catch (const wire::DecodeError &e) {
     fprintf(stderr, "protocol error: %s\n", e.msg.c_str());
     return 1;

@@ -139,11 +139,55 @@ class Session:
 
     @classmethod
     def _retry_safe(cls, stmt: str) -> bool:
-        s = stmt.strip()
-        if s.startswith("$"):
-            return True
-        head = s.split(None, 1)[0].upper() if s else ""
-        return head in cls._READ_ONLY
+        """One execute() can carry `;`-compound statements (the
+        parser's SequentialSentences): EVERY segment must be read-only
+        for the whole to be retried, else `USE x; INSERT …` would be
+        re-applied after a mid-flight error — exactly the
+        at-least-once hazard this gate exists to prevent. `$var =`
+        assignments are classified by their right-hand sentence."""
+        for seg in cls._split_statements(stmt):
+            s = seg.strip()
+            if not s:
+                continue
+            if s.startswith("$"):
+                eq = s.find("=")
+                if eq < 0:
+                    return False   # not an assignment: fail closed
+                s = s[eq + 1:].strip()
+            head = s.split(None, 1)[0].upper() if s else ""
+            if head not in cls._READ_ONLY:
+                return False
+        return True
+
+    @staticmethod
+    def _split_statements(stmt: str):
+        """Split on top-level `;` only — quote- and escape-aware,
+        matching the lexer's string rules, so a `;` inside a string
+        literal never splits."""
+        out, buf, quote, esc = [], [], None, False
+        for ch in stmt:
+            if esc:
+                buf.append(ch)
+                esc = False
+                continue
+            if quote is not None:
+                if ch == "\\":
+                    esc = True
+                elif ch == quote:
+                    quote = None
+                buf.append(ch)
+                continue
+            if ch in ("'", '"'):
+                quote = ch
+                buf.append(ch)
+                continue
+            if ch == ";":
+                out.append("".join(buf))
+                buf = []
+                continue
+            buf.append(ch)
+        out.append("".join(buf))
+        return out
 
     def execute(self, stmt: str) -> ExecutionResponse:
         """Run one statement; on a transport error, reconnect (possibly

@@ -624,3 +624,22 @@ def test_unreplicated_storaged_survives_restart(tmp_path):
                 h.stop()
             except Exception:
                 pass
+
+
+def test_retry_safe_compound_statements():
+    """Advisor finding (round 4, pool.py:141): classification must
+    cover every `;`-segment, not just the first token — a compound
+    carrying a mutation is NOT auto-retried, while `;` inside string
+    literals never splits."""
+    from nebula_tpu.client.pool import Session as S
+
+    assert S._retry_safe("GO FROM 1 OVER e")
+    assert S._retry_safe("USE x; SHOW TAGS; GO FROM 1 OVER e")
+    assert S._retry_safe("$a = GO FROM 1 OVER e; YIELD $a.x")
+    assert not S._retry_safe("USE x; INSERT VERTEX t(x) VALUES 1:(1)")
+    assert not S._retry_safe("$a = GO FROM 1 OVER e; DELETE VERTEX 1")
+    assert not S._retry_safe("$a = INSERT VERTEX t(x) VALUES 1:(1)")
+    assert not S._retry_safe("$a.b")            # not an assignment
+    # a quoted semicolon + mutation keyword stays ONE read statement
+    assert S._retry_safe('LOOKUP ON t WHERE t.s == "a;DELETE VERTEX 1"')
+    assert not S._retry_safe("UPDATE VERTEX 1 SET t.x = 1")
