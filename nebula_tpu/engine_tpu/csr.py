@@ -565,30 +565,15 @@ def build_shards(source, sm, space_id: int, num_parts: int
                     continue
                 sel = np.nonzero(et == t)[0]
                 rows = RowsBlock.from_scan(escan, eidx[sel], sel)
+                row_dead = np.zeros(cap_e, bool)
                 cols = _build_columns(
                     schema, cap_e, rows, now, dict_registry, ("e",),
                     schema_at=lambda v, _t=int(t): _ver_schema(
-                        sm.edge_schema, space_id, _t, v))
+                        sm.edge_schema, space_id, _t, v),
+                    row_dead=row_dead)
                 if cols:
                     shard.edge_props[int(t)] = cols
-                if schema.ttl_col and schema.ttl_duration > 0 \
-                        and schema.ttl_col in cols:
-                    # TTL'd EDGE rows: the column builders already
-                    # dropped expired rows (invisible cells) — the
-                    # traversal must not serve those edges either (the
-                    # CPU scan checks TTL per row,
-                    # processors.py/get_bound). A null ttl value is
-                    # NOT expired (CPU: isinstance check fails), so
-                    # only missing-marked / no-value cells count.
-                    c = cols[schema.ttl_col]
-                    if c.missing is not None:
-                        dead = c.missing[sel]
-                    elif c.present is not None:
-                        dead = ~c.present[sel]
-                    else:
-                        dead = None
-                    if dead is not None and dead.any():
-                        edge_valid[sel[dead]] = False
+                _mark_ttl_dead_edges(schema, row_dead, sel, edge_valid)
         varr, vidx, vscan = vert_scans[p0]
         if varr is not None and len(vidx):
             tags = _unbias32(varr["tag"][vidx])
@@ -652,12 +637,16 @@ def _build_shards_native(ext, sm, space_id: int, P: int
                         continue
                     sel = np.nonzero(et == t)[0]
                     rows = RowsBlock(blob, offs[sel], lens[sel], sel)
+                    row_dead = np.zeros(cap_e, bool)
                     cols = _build_columns(
                         r.value(), cap_e, rows, now, dict_registry, ("e",),
                         schema_at=lambda v, _t=int(t): _ver_schema(
-                            sm.edge_schema, space_id, _t, v))
+                            sm.edge_schema, space_id, _t, v),
+                        row_dead=row_dead)
                     if cols:
                         shard.edge_props[int(t)] = cols
+                    _mark_ttl_dead_edges(r.value(), row_dead, sel,
+                                         edge_valid)
         vlocal, vtag = ext.vert_rows(p0)
         if len(vtag):
             vv = ext.vert_vals(p0)
@@ -690,6 +679,29 @@ def _ver_schema(getter, space_id: int, type_id: int,
     return r.value() if r.ok() else None
 
 
+def _mark_ttl_dead_edges(schema: Schema, row_dead: np.ndarray,
+                         sel: np.ndarray, edge_valid: np.ndarray) -> None:
+    """Clear edge_valid for rows the column builders DROPPED (TTL-
+    expired or undecodable), via their explicit `row_dead` mask —
+    shared by BOTH shard builders (the native-extract path previously
+    skipped edge TTL invalidation entirely, leaving expired edges
+    device-visible).
+
+    The traversal must not serve dropped edges (the CPU scan checks
+    TTL per row, processors.py/get_bound). Inference from the cell
+    masks is NOT used: a cell can be missing merely because its row's
+    schema VERSION lacks the ttl col (post-ALTER rows — including
+    versions with no shared columns at all), and the CPU reads
+    `row.get(ttl_col) is None` as never-expired
+    (processors.py:152-155), so only explicitly-dropped rows count.
+    Gated on the schema carrying TTL, like the CPU read path."""
+    if not (schema.ttl_col and schema.ttl_duration > 0):
+        return
+    dead = row_dead[sel]
+    if dead.any():
+        edge_valid[sel[dead]] = False
+
+
 def _ttl_dead(schema: Schema, i64: np.ndarray, f64: np.ndarray,
               nulls: np.ndarray, now: float) -> np.ndarray:
     """TTL-expired mask over decoded column buffers (shared by the
@@ -709,7 +721,8 @@ def _ttl_dead(schema: Schema, i64: np.ndarray, f64: np.ndarray,
 
 
 def _native_build_columns(schema: Schema, cap: int, rows: "RowsBlock",
-                          now: float, dict_registry: Dict, dict_key: Tuple
+                          now: float, dict_registry: Dict, dict_key: Tuple,
+                          row_dead: Optional[np.ndarray] = None
                           ) -> Optional[Dict[str, PropColumn]]:
     """Fast path: one nbc_decode_batch FFI call decodes every row into
     column buffers (native/src/codec.cc — the C++ codec hot path, role
@@ -731,6 +744,8 @@ def _native_build_columns(schema: Schema, cap: int, rows: "RowsBlock",
     expired = _ttl_dead(schema, i64, f64, nulls, now)
     if expired.any():
         nulls[:, expired] = True
+        if row_dead is not None:
+            row_dead[expired] = True
     # strings decode strictly up front; a row with invalid UTF-8 becomes
     # wholly invisible, matching the Python path's whole-row skip on
     # decode failure
@@ -745,6 +760,8 @@ def _native_build_columns(schema: Schema, cap: int, rows: "RowsBlock",
                 vals[int(i)] = b.decode("utf-8")
             except UnicodeDecodeError:
                 nulls[:, i] = True
+                if row_dead is not None:
+                    row_dead[i] = True
         str_vals[fi] = vals
     out: Dict[str, PropColumn] = {}
     for fi, f in enumerate(schema.fields):
@@ -851,7 +868,8 @@ def _native_build_columns_multi(schemas_by_ver: Dict[int, Schema],
                                 conflicted: set, cap: int,
                                 rows: "RowsBlock", vers: np.ndarray,
                                 now: float, dict_registry: Dict,
-                                dict_key: Tuple
+                                dict_key: Tuple,
+                                row_dead: Optional[np.ndarray] = None
                                 ) -> Optional[Dict[str, PropColumn]]:
     """Mixed-version fast path: one nbc_decode_batch call PER VERSION
     GROUP (each with its version's field list), merged into union
@@ -914,6 +932,8 @@ def _native_build_columns_multi(schemas_by_ver: Dict[int, Schema],
                     dead[i] = True
             group_strs[fi] = vals
         alive = covered[~dead[covered]]
+        if row_dead is not None:
+            row_dead[covered[dead[covered]]] = True
         for fi, f in enumerate(sv.fields):
             n = f.name
             p = ~nulls[fi][alive]
@@ -986,7 +1006,9 @@ def _native_build_columns_multi(schemas_by_ver: Dict[int, Schema],
 
 def _build_columns(schema: Schema, cap: int, rows: "RowsBlock", now: float,
                    dict_registry: Dict = None, dict_key: Tuple = None,
-                   schema_at=None) -> Dict[str, PropColumn]:
+                   schema_at=None,
+                   row_dead: Optional[np.ndarray] = None
+                   ) -> Dict[str, PropColumn]:
     """Decode rows into columnar arrays aligned at the given indices,
     respecting per-row schema versions and TTL.
 
@@ -1016,7 +1038,8 @@ def _build_columns(schema: Schema, cap: int, rows: "RowsBlock", now: float,
     has_nullable = any(f.nullable for f in schema.fields)
     if single and not has_nullable:
         fast = _native_build_columns(schema, cap, rows, now,
-                                     dict_registry, dict_key)
+                                     dict_registry, dict_key,
+                                     row_dead=row_dead)
         if fast is not None:
             return fast
     multi = not single and schema_at is not None
@@ -1045,7 +1068,7 @@ def _build_columns(schema: Schema, cap: int, rows: "RowsBlock", now: float,
     if multi:
         fast = _native_build_columns_multi(
             schemas_by_ver, field_types, conflicted, cap, rows, vers,
-            now, dict_registry, dict_key)
+            now, dict_registry, dict_key, row_dead=row_dead)
         if fast is not None:
             return fast
     names = list(field_types)
@@ -1058,10 +1081,14 @@ def _build_columns(schema: Schema, cap: int, rows: "RowsBlock", now: float,
         try:
             row = RowReader(sv, raw).to_dict()
         except Exception:
+            if row_dead is not None:
+                row_dead[idx] = True
             continue
         if sv.ttl_col and sv.ttl_duration > 0:
             ts = row.get(sv.ttl_col)
             if isinstance(ts, (int, float)) and ts + sv.ttl_duration < now:
+                if row_dead is not None:
+                    row_dead[idx] = True
                 continue
         for name, v in row.items():
             host_cols[name][idx] = v
