@@ -1110,3 +1110,291 @@ def test_ttl_identity_on_device():
     rt = tpu_conn.must("GO FROM 1, 3 OVER rel YIELD rel._dst, rel.w")
     assert sorted(map(repr, rc.rows)) == sorted(map(repr, rt.rows))
     assert (1, 31) in rc.rows and (4, 14) not in rc.rows
+
+
+# ---------------------------------------------------------------------------
+# sparse aggregation: small frontiers reduced over the pull set instead
+# of declining to the CPU pipe (round-4 verdict item 2)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture()
+def sparse_agg_pair():
+    """Like agg_pair but with the DEFAULT pull budget, so the tiny NBA
+    graph routes every aggregate through the sparse host reduction."""
+    _, cpu_conn = load_nba()
+    tpu = TpuGraphEngine()
+    cluster = InProcCluster(tpu_engine=tpu)
+    _, tpu_conn = load_nba(cluster)
+    return cpu_conn, tpu_conn, tpu, cluster
+
+
+@pytest.mark.parametrize("query", AGG_QUERIES + GROUPED_AGG_QUERIES)
+def test_sparse_aggregate_identity(sparse_agg_pair, query):
+    """Every dense-path aggregate query also serves (identically)
+    through the sparse reduction when the frontier is small — the
+    routing the round-4 bench showed declining 3/3 queries."""
+    cpu_conn, tpu_conn, tpu, _ = sparse_agg_pair
+    rc, rt = cpu_conn.must(query), tpu_conn.must(query)
+    assert rc.columns == rt.columns
+    assert sorted(map(repr, rc.rows)) == sorted(map(repr, rt.rows)), \
+        (query, rc.rows, rt.rows)
+    assert tpu.stats["agg_served"] == 1, (query, tpu.stats)
+    assert tpu.stats["agg_sparse_served"] == 1, (query, tpu.stats)
+
+
+def test_sparse_aggregate_serves_delta_adds(sparse_agg_pair):
+    """Unlike the dense device reduction, the sparse path folds
+    delta-buffer rows into the reduction — buffered adds no longer
+    force the CPU pipe."""
+    cpu_conn, tpu_conn, tpu, _ = sparse_agg_pair
+    q = ("GO FROM 100 OVER serve YIELD serve.start_year AS y"
+         " | YIELD COUNT(*) AS n, SUM($-.y) AS s, MIN($-.y) AS lo")
+    tpu_conn.must(q)              # builds the snapshot
+    assert tpu.stats["agg_sparse_served"] == 1
+    for conn in (cpu_conn, tpu_conn):
+        conn.must("INSERT EDGE serve(start_year, end_year) "
+                  "VALUES 100 -> 202:(2001, 2002)")
+    rc, rt = cpu_conn.must(q), tpu_conn.must(q)
+    assert rc.rows == rt.rows, (rc.rows, rt.rows)
+    sid = list(tpu._snapshots)[0]
+    snap = tpu._snapshots[sid]
+    assert snap.delta is not None and snap.delta.edge_count > 0, \
+        "test must exercise the delta-fold path"
+    assert tpu.stats["agg_sparse_served"] == 2, tpu.stats
+    # grouped twin over the same delta state
+    qg = ("GO FROM 100 OVER serve YIELD serve._dst AS t,"
+          " serve.start_year AS y | GROUP BY $-.t YIELD $-.t AS t,"
+          " COUNT(*) AS n, SUM($-.y) AS s")
+    rcg, rtg = cpu_conn.must(qg), tpu_conn.must(qg)
+    assert sorted(map(repr, rcg.rows)) == sorted(map(repr, rtg.rows)), \
+        (rcg.rows, rtg.rows)
+    assert tpu.stats["agg_sparse_served"] == 3, tpu.stats
+
+
+def test_sparse_aggregate_exact_beyond_int32(sparse_agg_pair):
+    """The hi/lo-split host sum stays exact where float64 or int32
+    accumulation would not."""
+    cpu_conn, tpu_conn, tpu, _ = sparse_agg_pair
+    big = 2**31 - 1
+    for conn in (cpu_conn, tpu_conn):
+        conn.must('INSERT VERTEX player(name, age) VALUES 9901:("B1", 30)')
+        for dst in (201, 202, 203):
+            conn.must(f"INSERT EDGE serve(start_year, end_year) "
+                      f"VALUES 9901 -> {dst}:({big}, {big})")
+    q = ("GO FROM 9901 OVER serve YIELD serve.start_year AS y"
+         " | YIELD SUM($-.y) AS s, COUNT(*) AS n, AVG($-.y) AS a")
+    rc, rt = cpu_conn.must(q), tpu_conn.must(q)
+    assert rc.rows == [(3 * big, 3, float(big))]
+    assert rt.rows == rc.rows
+    assert tpu.stats["agg_sparse_served"] == 1, tpu.stats
+
+
+def test_agg_decline_reasons_counted(sparse_agg_pair):
+    """Round-4 verdict: declines were invisible. Every decline now
+    lands in agg_decline_reasons (and the global stats manager that
+    /get_stats serves)."""
+    from nebula_tpu.common.stats import stats as global_stats
+    cpu_conn, tpu_conn, tpu, _ = sparse_agg_pair
+    before = global_stats.read_stats(
+        "tpu_engine.agg_declined.non_int_prop.sum.600")
+    q = ("GO FROM 100 OVER like YIELD like.likeness AS w"
+         " | YIELD SUM($-.w) AS s")          # DOUBLE prop: declined
+    rc, rt = cpu_conn.must(q), tpu_conn.must(q)
+    assert rc.rows == rt.rows
+    assert tpu.stats["agg_served"] == 0
+    assert tpu.stats["agg_declined"] >= 1
+    assert tpu.agg_decline_reasons.get("non_int_prop", 0) >= 1, \
+        tpu.agg_decline_reasons
+    after = global_stats.read_stats(
+        "tpu_engine.agg_declined.non_int_prop.sum.600")
+    assert (after or 0) > (before or 0)
+
+
+def test_grouped_reduce_chunked_exact():
+    """SUM/AVG past MAX_GROUPED_SUM_ROWS switch to chunked digit
+    partials with host int64 accumulation instead of declining
+    (round-4 verdict weak #6): a >2^23-masked-row grouped SUM must be
+    bit-exact against the numpy int64 reference."""
+    import jax.numpy as jnp
+    from nebula_tpu.engine_tpu import aggregate
+
+    n = aggregate.MAX_GROUPED_SUM_ROWS + (1 << 20)     # 9.4M rows
+    rng = np.random.default_rng(3)
+    vals = rng.integers(-(2**31), 2**31, n, dtype=np.int64).astype(np.int32)
+    groups = rng.integers(0, 4, n).astype(np.int32)
+    mask = rng.random(n) < 0.9
+
+    class _V:
+        pass
+
+    v = _V()
+    v.value = jnp.asarray(vals.reshape(1, -1))
+    v.null = jnp.zeros((1, n), bool)
+    active = jnp.asarray(mask.reshape(1, -1))
+    gidx = jnp.asarray(groups.reshape(1, -1))
+    got_groups, cols = aggregate.grouped_reduce(
+        [("SUM", "k"), ("COUNT", None), ("AVG", "k")], active, {"k": v},
+        gidx, 4)
+    # int64 numpy reference (n * |v| < 2^63 here, so int64 is exact)
+    ref_sum = [int(vals[mask & (groups == g)].astype(np.int64).sum())
+               for g in got_groups]
+    ref_cnt = [int((mask & (groups == g)).sum()) for g in got_groups]
+    assert list(cols[0]) == ref_sum
+    assert list(cols[1]) == ref_cnt
+    assert list(cols[2]) == [s / c for s, c in zip(ref_sum, ref_cnt)]
+
+
+@pytest.mark.parametrize("native", [False, True])
+def test_alter_ttl_identity_on_device(native):
+    """TTL added by ALTER: old-version edge rows WITHOUT the ttl col
+    stay visible forever (CPU: the row's own schema version has no
+    ttl_col, processors.py _decode_row) while post-ALTER stale rows
+    expire — identical on the device for BOTH shard builders. The
+    packed builder used to mark version-missing ttl cells dead
+    (advisor finding r4, csr.py:574); the native-extract builder used
+    to skip edge TTL invalidation entirely."""
+    if native:
+        from nebula_tpu import native as native_mod
+        if not native_mod.available():
+            pytest.skip("native library unavailable")
+        from nebula_tpu.kvstore.nativeengine import NativeEngine
+    import time as _t
+
+    now = int(_t.time())
+    stale, fresh = now - 5000, now
+    conns = []
+    tpu = TpuGraphEngine()
+    for cluster in (InProcCluster(), InProcCluster(tpu_engine=tpu)):
+        if native:
+            cluster.store._engine_factory = lambda sid: NativeEngine()
+        c = cluster.connect()
+        c.must("CREATE SPACE attl(partition_num=2)")
+        c.must("USE attl")
+        c.must("CREATE EDGE rel(w int)")
+        c.must("INSERT EDGE rel(w) VALUES 1 -> 2:(12), 1 -> 3:(13)")
+        c.must("ALTER EDGE rel ADD (ts timestamp) "
+               "TTL_DURATION = 1000, TTL_COL = ts")
+        c.must(f"INSERT EDGE rel(w, ts) VALUES 1 -> 4:(14, {fresh}), "
+               f"1 -> 5:(15, {stale})")
+        conns.append(c)
+    cpu_conn, tpu_conn = conns
+    for q in ("GO FROM 1 OVER rel YIELD rel._dst",
+              "GO FROM 1 OVER rel YIELD rel._dst, rel.w"):
+        rc, rt = cpu_conn.must(q), tpu_conn.must(q)
+        assert sorted(map(repr, rc.rows)) == sorted(map(repr, rt.rows)), \
+            (q, rc.rows, rt.rows)
+    # v0 rows (no ts) + the fresh v1 row are visible; the stale v1 row
+    # expired on both engines
+    r = cpu_conn.must("GO FROM 1 OVER rel YIELD rel._dst")
+    assert sorted(r.rows) == [(2,), (3,), (4,)], r.rows
+    assert tpu.stats["go_served"] >= 2
+    # harder case (review finding r5): v0 has NO fields at all, so v0
+    # rows share no decoded column with the post-ALTER schema — they
+    # must STILL stay visible forever (CPU: v0 schema has no ttl_col)
+    for c in conns:
+        c.must("CREATE EDGE bare()")
+        c.must("INSERT EDGE bare() VALUES 1 -> 7:()")
+        c.must("ALTER EDGE bare ADD (ts timestamp) "
+               "TTL_DURATION = 1000, TTL_COL = ts")
+        c.must(f"INSERT EDGE bare(ts) VALUES 1 -> 8:({fresh}), "
+               f"1 -> 9:({stale})")
+    q = "GO FROM 1 OVER bare YIELD bare._dst"
+    rc, rt = conns[0].must(q), conns[1].must(q)
+    assert sorted(rc.rows) == sorted(rt.rows) == [(7,), (8,)], \
+        (rc.rows, rt.rows)
+
+
+def test_prewarm_auto_calibrates_budget():
+    """Round-4 verdict item 4: production engines must not keep the
+    modeled default crossover — the prewarm hook (fired by USE)
+    calibrates a measured per-space budget; explicit assignment pins
+    routing and disables/clears auto-calibration."""
+    tpu = TpuGraphEngine()
+    cluster = InProcCluster(tpu_engine=tpu)
+    _, conn = load_nba(cluster)
+    sid = cluster.meta.get_space("nba").value().space_id
+    tpu.prewarm(sid, block=True)
+    rec = tpu.sparse_budget_calibrations.get(sid)
+    assert rec is not None, "prewarm must calibrate the space budget"
+    assert tpu._space_budgets[sid] == rec["fitted_budget"]
+    assert rec["fitted_budget"] >= 1 << 14 and rec["probe_edges"] > 0
+    # the fit is visible through the stats manager (/get_stats)
+    from nebula_tpu.common.stats import stats as global_stats
+    assert global_stats.read_stats(
+        "tpu_engine.sparse_budget_fit.sum.600") >= rec["fitted_budget"]
+    # identity under the calibrated routing
+    rc = conn.must("GO 2 STEPS FROM 100 OVER like YIELD like._dst")
+    assert rc.rows
+    # explicit pin wins: per-space fits drop, auto-calibration stops
+    tpu.sparse_edge_budget = 0
+    assert tpu._space_budgets == {}
+    tpu.sparse_budget_calibrations.clear()
+    tpu.prewarm(sid, block=True)
+    assert tpu.sparse_budget_calibrations == {}
+    assert tpu.sparse_edge_budget == 0
+
+
+def test_cross_session_batched_dispatch_identity():
+    """Round-4 verdict item 3: concurrent sessions' dense GOs coalesce
+    into shared [N, P, cap_v] device programs (group commit). Results
+    must be identical to the serial CPU path, errors must stay
+    per-query, and a pile-up during one round must coalesce into the
+    next round's batch."""
+    import threading
+    import time as _t
+
+    _, cpu_conn = load_nba()
+    tpu = TpuGraphEngine()
+    cluster = InProcCluster(tpu_engine=tpu)
+    _, warm = load_nba(cluster)
+    tpu.sparse_edge_budget = 0      # pin: every GO rides the dense path
+    queries = [
+        "GO 2 STEPS FROM 100 OVER like YIELD like._dst",
+        "GO FROM 101 OVER like YIELD like._dst",
+        "GO 2 STEPS FROM 102 OVER like YIELD like._dst, $$.player.name",
+        "GO FROM 100 OVER like WHERE like.likeness > 80 "
+        "YIELD like._dst",
+    ]
+    expected = {q: sorted(map(repr, cpu_conn.must(q).rows))
+                for q in queries}
+    warm.must(queries[0])           # snapshot + XLA compile up front
+    # slow the serve step so a round in flight lets the other threads
+    # pile into the queue — the NEXT round must then coalesce them
+    orig = tpu._serve_batch
+
+    def slow_serve(batch, ex):
+        _t.sleep(0.03)
+        orig(batch, ex)
+
+    tpu._serve_batch = slow_serve
+    n_threads = 8
+    barrier = threading.Barrier(n_threads)
+    errs = []
+
+    def worker(k):
+        conn = cluster.connect()
+        conn.must("USE nba")
+        barrier.wait()
+        for i in range(4):
+            q = queries[(k + i) % len(queries)]
+            try:
+                r = conn.must(q)
+                if sorted(map(repr, r.rows)) != expected[q]:
+                    errs.append((q, r.rows))
+            except Exception as e:      # noqa: BLE001
+                errs.append((q, repr(e)))
+
+    threads = [threading.Thread(target=worker, args=(k,))
+               for k in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs[:3]
+    st = tpu.stats
+    # every query device-served; the coalesced ones (multi-member
+    # groups) shared dispatches — single-member rounds take the plain
+    # path and don't count as batched
+    assert st["go_served"] >= n_threads * 4, st
+    assert st["batched_max_window"] >= 2, st
+    assert st["batched_dispatches"] < st["batched_queries"], st
