@@ -116,56 +116,34 @@ VERT_KEY_FIELDS = [("part", ">u4"), ("kind", "u1"), ("vid", ">u8"),
                    ("tag", ">u4"), ("ver", ">u8")]
 
 
-def load_cluster():
-    """InProcCluster over the native C++ engine, bulk-loaded with the
-    vectorized sorted-ingest path."""
-    from nebula_tpu import native as native_mod
-    from nebula_tpu.cluster import InProcCluster
-    from nebula_tpu.engine_tpu import TpuGraphEngine
-    from nebula_tpu.kvstore.nativeengine import NativeEngine
-
-    if not native_mod.available():
-        raise SystemExit("bench requires the native engine (make -C native)")
-
-    tpu = TpuGraphEngine()
-    cluster = InProcCluster(tpu_engine=tpu,
-                            engine_factory=lambda sid: NativeEngine())
-    conn = cluster.connect()
-    conn.must(f"CREATE SPACE snb(partition_num={PARTS}, replica_factor=1)")
-    conn.must("USE snb")
-    conn.must("CREATE TAG person(age int)")
-    conn.must("CREATE EDGE knows(ts int)")
-    sid = cluster.meta.get_space("snb").value().space_id
-    tag_id = cluster.sm.tag_id(sid, "person")
-    etype = cluster.sm.edge_type(sid, "knows")
-    person_schema = cluster.sm.tag_schema(sid, tag_id).value()
-    knows_schema = cluster.sm.edge_schema(sid, etype).value()
-    engine = cluster.store.space_engine(sid)
-
-    rng = np.random.default_rng(42)
-    log(f"generating SNB-shaped graph V={V} E={E} (x2 stored rows)...")
+def bulk_load_snb(engine, tag_id, etype, person_schema, knows_schema,
+                  v, e, parts, rng):
+    """Vectorized sorted bulk ingest of the SNB-shaped person/knows
+    graph into one native engine (the SST-ingest path). Returns the
+    generated (srcs, dsts) so callers can derive seed sets. Shared by
+    bench.py and scripts/concurrency_sweep.py."""
     t0 = time.time()
-    srcs = gen_degrees(rng, V, E)
-    dsts = rng.integers(0, V, E).astype(np.int64)
-    ts = rng.integers(0, TS_MAX, E).astype(np.int64)
-    ages = rng.integers(18, 80, V).astype(np.int64)
-    ranks = np.arange(E, dtype=np.int64)
+    srcs = gen_degrees(rng, v, e)
+    dsts = rng.integers(0, v, e).astype(np.int64)
+    ts = rng.integers(0, TS_MAX, e).astype(np.int64)
+    ages = rng.integers(18, 80, v).astype(np.int64)
+    ranks = np.arange(e, dtype=np.int64)
     ver = np.uint64((1 << 64) - 1 - time.time_ns() // 1000)
     vhdr = _row_template(person_schema, "age")
     ehdr = _row_template(knows_schema, "ts")
     log(f"  generated in {time.time()-t0:.1f}s; bulk ingest "
-        f"({2*E + V} rows, sorted per (part, kind) bucket)...")
+        f"({2*e + v} rows, sorted per (part, kind) bucket)...")
 
     t0 = time.time()
-    src_part = (srcs.view(np.uint64) % np.uint64(PARTS)).astype(np.int64) + 1
-    dst_part = (dsts.view(np.uint64) % np.uint64(PARTS)).astype(np.int64) + 1
-    vid_part = (np.arange(V, dtype=np.int64).view(np.uint64)
-                % np.uint64(PARTS)).astype(np.int64) + 1
+    src_part = (srcs.view(np.uint64) % np.uint64(parts)).astype(np.int64) + 1
+    dst_part = (dsts.view(np.uint64) % np.uint64(parts)).astype(np.int64) + 1
+    vid_part = (np.arange(v, dtype=np.int64).view(np.uint64)
+                % np.uint64(parts)).astype(np.int64) + 1
     # biased etype codes (python-int arithmetic so the intended uint32
     # wraparound never trips numpy's overflow warning)
     et_b = np.uint32(int(etype) + int(_BIAS32))
     et_rev_b = np.uint32((int(_BIAS32) - int(etype)) & 0xFFFFFFFF)
-    for p in range(1, PARTS + 1):
+    for p in range(1, parts + 1):
         # vertices of part p (kind 1 sorts before kind 2)
         sel = np.nonzero(vid_part == p)[0]
         vr = _Recs(len(sel), VERT_KEY_FIELDS, vhdr)
@@ -197,6 +175,39 @@ def load_cluster():
         log(f"  part {p}: {len(sel)} vertices + {n} edge rows")
     log(f"store loaded in {time.time()-t0:.1f}s "
         f"({engine.total_keys()} keys)")
+    return srcs, dsts
+
+
+def load_cluster():
+    """InProcCluster over the native C++ engine, bulk-loaded with the
+    vectorized sorted-ingest path."""
+    from nebula_tpu import native as native_mod
+    from nebula_tpu.cluster import InProcCluster
+    from nebula_tpu.engine_tpu import TpuGraphEngine
+    from nebula_tpu.kvstore.nativeengine import NativeEngine
+
+    if not native_mod.available():
+        raise SystemExit("bench requires the native engine (make -C native)")
+
+    tpu = TpuGraphEngine()
+    cluster = InProcCluster(tpu_engine=tpu,
+                            engine_factory=lambda sid: NativeEngine())
+    conn = cluster.connect()
+    conn.must(f"CREATE SPACE snb(partition_num={PARTS}, replica_factor=1)")
+    conn.must("USE snb")
+    conn.must("CREATE TAG person(age int)")
+    conn.must("CREATE EDGE knows(ts int)")
+    sid = cluster.meta.get_space("snb").value().space_id
+    tag_id = cluster.sm.tag_id(sid, "person")
+    etype = cluster.sm.edge_type(sid, "knows")
+    person_schema = cluster.sm.tag_schema(sid, tag_id).value()
+    knows_schema = cluster.sm.edge_schema(sid, etype).value()
+    engine = cluster.store.space_engine(sid)
+
+    rng = np.random.default_rng(42)
+    log(f"generating SNB-shaped graph V={V} E={E} (x2 stored rows)...")
+    bulk_load_snb(engine, tag_id, etype, person_schema, knows_schema,
+                  V, E, PARTS, rng)
     seed_sets = [[int(s) for s in rng.choice(V, SEEDS, replace=False)]
                  for _ in range(BATCH)]
     return cluster, tpu, conn, sid, etype, seed_sets

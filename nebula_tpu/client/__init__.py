@@ -16,7 +16,10 @@ from ..rpc import proxy
 
 class GraphClient:
     def __init__(self, addr: str):
-        self._rpc = proxy(addr, "graph")
+        # dedicated socket per client (the reference client's model):
+        # N concurrent clients must mean N concurrent queries, not
+        # contention on the process-wide 4-socket RPC pool
+        self._rpc = proxy(addr, "graph", dedicated=True)
         self.addr = addr
         self._session_id: Optional[int] = None
 
@@ -42,6 +45,7 @@ class GraphClient:
                 self._rpc.signout(self._session_id)
             finally:
                 self._session_id = None
+                self._rpc.close()   # dedicated socket: release the fd
 
     # ------------------------------------------------------------------
     def __enter__(self) -> "GraphClient":

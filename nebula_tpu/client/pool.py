@@ -81,7 +81,9 @@ class ConnectionPool:
         for _ in range(len(self._eps)):
             ep = self._pick()
             try:
-                rpc = proxy(ep.addr, "graph", timeout=self._timeout)
+                # each pooled session owns its socket (see GraphClient)
+                rpc = proxy(ep.addr, "graph", timeout=self._timeout,
+                            dedicated=True)
                 r = rpc.authenticate(user, password)
             except Exception as e:           # transport-level failure
                 self._mark_down(ep)
@@ -123,6 +125,11 @@ class Session:
     def _drop_connection(self) -> None:
         if self._ep is not None:
             self._pool._mark_down(self._ep)
+        if self._rpc is not None:
+            try:
+                self._rpc.close()   # dead socket: still release the fd
+            except Exception:
+                pass
         self._rpc = None
         self._ep = None
         self._session_id = None
@@ -242,6 +249,8 @@ class Session:
                 self._rpc.signout(self._session_id)
             except Exception:
                 pass
+        if self._rpc is not None:
+            self._rpc.close()   # dedicated socket: release the fd
         self._rpc = None
         self._session_id = None
 

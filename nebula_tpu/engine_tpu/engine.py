@@ -706,7 +706,22 @@ class TpuGraphEngine:
                       1)
             for c0 in range(0, len(dense), cap):
                 chunk = dense[c0:c0 + cap]
-                f0s = jnp.asarray(np.stack([f for _, f, _, _ in chunk]))
+                # pad the root axis to a power-of-two bucket: vmapped
+                # programs specialize on R, and a fresh XLA compile per
+                # distinct window size would eat the batching win —
+                # buckets bound the compile count to log2(cap) shapes.
+                # Zero frontiers produce empty masks and carry no
+                # request. Never pad past the memory-derived cap: the
+                # 1GiB mask budget must hold for the PADDED batch too.
+                bucket = 1
+                while bucket < len(chunk):
+                    bucket *= 2
+                bucket = min(bucket, cap)
+                stack = [f for _, f, _, _ in chunk]
+                if bucket > len(chunk):
+                    stack.extend([np.zeros_like(stack[0])]
+                                 * (bucket - len(chunk)))
+                f0s = jnp.asarray(np.stack(stack))
                 t1 = time.monotonic()
                 if use_delta:
                     masks, dmasks = traverse.multi_hop_roots_delta(

@@ -223,19 +223,41 @@ class RpcClient:
 
     def __init__(self, addr: str, service: str,
                  timeout: Optional[float] = None,
-                 max_attempts: Optional[int] = None):
+                 max_attempts: Optional[int] = None,
+                 dedicated: bool = False):
+        """`dedicated` gives THIS client its own private connection
+        instead of the process-wide shared per-address pool. The shared
+        pool (4 sockets) is right for internal control-plane fan-out
+        (meta, storage admin, raft) where many short calls multiplex —
+        but end-user graph clients are session-oriented and must scale
+        with the number of clients, like the reference's one-socket
+        GraphClient (client/cpp/GraphClient.cpp): N in-process sessions
+        sharing 4 sockets capped measured query concurrency at 4
+        regardless of session count."""
         host, port_s = addr.rsplit(":", 1)
         self._key = (host, int(port_s))
         self.addr = addr
         self.service = service
         self._timeout = timeout if timeout is not None else 30.0
-        with RpcClient._pools_lock:
-            if self._key not in RpcClient._pools:
-                RpcClient._pools[self._key] = _ConnPool(host, int(port_s))
-        self._pool = RpcClient._pools[self._key]
+        self._dedicated = dedicated
+        if dedicated:
+            self._pool = _ConnPool(host, int(port_s), size=1)
+        else:
+            with RpcClient._pools_lock:
+                if self._key not in RpcClient._pools:
+                    RpcClient._pools[self._key] = _ConnPool(host,
+                                                            int(port_s))
+            self._pool = RpcClient._pools[self._key]
         # low-latency callers (raft) cap the stale-socket drain so a
         # black-holed peer costs ~1 timeout, not pool_size timeouts
         self._max_attempts = max_attempts
+
+    def close(self) -> None:
+        """Release this client's private socket (dedicated clients
+        own their connection — the reference GraphClient closes on
+        disconnect). Shared pools are process-wide and stay up."""
+        if self._dedicated:
+            self._pool.close()
 
     def call(self, method: str, *args, **kwargs) -> Any:
         payload = wire.encode((self.service, method, tuple(args), kwargs))
@@ -288,11 +310,13 @@ class RpcClient:
 
 
 def proxy(addr: str, service: str, timeout: Optional[float] = None,
-          max_attempts: Optional[int] = None) -> RpcClient:
+          max_attempts: Optional[int] = None,
+          dedicated: bool = False) -> RpcClient:
     """A client whose attribute calls mirror the remote service's
     methods — drop-in for the in-proc service objects that
     StorageClient/MetaClient hold per host. `timeout` is this client's
     per-call deadline (connect + send + recv), independent of any other
-    client sharing the address's connection pool."""
+    client sharing the address's connection pool. `dedicated` opts out
+    of the shared pool (see RpcClient)."""
     return RpcClient(addr, service, timeout=timeout,
-                     max_attempts=max_attempts)
+                     max_attempts=max_attempts, dedicated=dedicated)

@@ -1,0 +1,156 @@
+"""Multi-session concurrency sweep driver (PARITY.md Concurrency).
+
+Spins up the real 3-daemon TCP topology (metad, native-engine storaged,
+--tpu graphd), bulk-loads the zipf person/knows graph through the
+native sorted-ingest path (bench.bulk_load_snb), and runs
+tools/session_bench.sweep over two traffic mixes:
+
+- "mixed": the round-4 load — 1/2-hop GO + filtered GO from ordinary
+  seeds; at this scale these ride the sparse host pull, so the sweep
+  measures the GIL/host ceiling.
+- "dense": 3-hop GO from hub seeds with the pull budget pinned to 0 so
+  every query takes the device path — the traffic the cross-session
+  group-commit dispatcher (engine_tpu/engine.py _go_via_dispatcher)
+  exists for. Round 4 measured aggregate QPS flat at ~630 from N=2;
+  with shared batched dispatches the device half amortizes across the
+  window.
+
+Prints ONE JSON object {graph, cores, mixed: [...], dense: [...],
+dispatcher: {...}} and a human table on stderr.
+
+Ref methodology: tools/storage-perf/README.md (fixed thread count,
+sustained load, percentiles), applied at the query layer.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+
+def log(msg):
+    print(msg, file=sys.stderr, flush=True)
+
+
+def main(argv=None) -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--v", type=int, default=100_000)
+    ap.add_argument("--e", type=int, default=1_000_000)
+    ap.add_argument("--parts", type=int, default=8)
+    ap.add_argument("--sessions", default="1,2,4,8,16,32")
+    ap.add_argument("--duration", type=float, default=4.0)
+    ap.add_argument("--skip-mixed", action="store_true")
+    ap.add_argument("--skip-dense", action="store_true")
+    args = ap.parse_args(argv)
+    counts = [int(x) for x in args.sessions.split(",") if x]
+
+    import bench
+    from nebula_tpu import native as native_mod
+    from nebula_tpu.client import GraphClient
+    from nebula_tpu.daemons import serve_graphd, serve_metad, serve_storaged
+    from nebula_tpu.engine_tpu import TpuGraphEngine
+    from nebula_tpu.tools.session_bench import sweep
+
+    if not native_mod.available():
+        raise SystemExit("needs the native engine (make -C native)")
+
+    metad = serve_metad()
+    sd = serve_storaged(metad.addr, load_interval=0.1)
+    tpu = TpuGraphEngine()
+    gd = serve_graphd(metad.addr, tpu_engine=tpu)
+    try:
+        c = GraphClient(gd.addr).connect()
+        for stmt in (f"CREATE SPACE zipf(partition_num={args.parts})",
+                     "USE zipf", "CREATE TAG person(age int)",
+                     "CREATE EDGE knows(ts int)"):
+            r = c.execute(stmt)
+            assert r.ok(), (stmt, r.error_msg)
+        # wait for the storaged to pick the parts up
+        sm = gd.engine.sm
+        sid = gd.meta_client.get_space("zipf").value().space_id
+        for _ in range(100):
+            if sd.store.space_engine(sid) is not None:
+                break
+            time.sleep(0.1)
+        engine = sd.store.space_engine(sid)
+        assert engine is not None, "storaged never mounted the space"
+        tag_id = sm.tag_id(sid, "person")
+        etype = sm.edge_type(sid, "knows")
+        rng = np.random.default_rng(7)
+        log(f"loading zipf graph V={args.v} E={args.e}...")
+        srcs, _dsts = bench.bulk_load_snb(
+            engine, tag_id, etype, sm.tag_schema(sid, tag_id).value(),
+            sm.edge_schema(sid, etype).value(), args.v, args.e,
+            args.parts, rng)
+        # hubs = highest out-degree sources (zipf head)
+        deg = np.bincount(srcs, minlength=args.v)
+        hubs = [int(x) for x in np.argsort(deg)[-4:]]
+        seeds = [int(s) for s in rng.choice(args.v, 8, replace=False)]
+        out = {"graph": {"V": args.v, "E": args.e, "parts": args.parts},
+               "duration_s": args.duration}
+
+        if not args.skip_mixed:
+            mixed = ([f"GO FROM {s} OVER knows YIELD knows._dst"
+                      for s in seeds[:3]]
+                     + [f"GO 2 STEPS FROM {s} OVER knows YIELD knows._dst"
+                        for s in seeds[3:6]]
+                     + [f"GO FROM {s} OVER knows WHERE knows.ts > "
+                        f"500000000 YIELD knows._dst, knows.ts"
+                        for s in seeds[6:8]])
+            c.execute(mixed[0])    # warm snapshot + compile
+            log("== mixed sweep (sparse-served, GIL-bound) ==")
+            out["mixed"] = sweep(gd.addr, mixed, counts, args.duration,
+                                 use_space="zipf")
+
+        if not args.skip_dense:
+            # pin routing to the dense device path: every GO rides the
+            # batched dispatcher. The tight device-compiled WHERE keeps
+            # result sets small so the sweep measures the traversal
+            # path, not python row serialization of ~10^5-row answers.
+            tpu.sparse_edge_budget = 0
+            dense = [f"GO 3 STEPS FROM {h} OVER knows "
+                     f"WHERE knows.ts > 999000000 "
+                     f"YIELD knows._dst, knows.ts" for h in hubs]
+            r = c.execute(dense[0])    # warm: snapshot + dense compile
+            assert r.ok(), r.error_msg
+            # warm each dispatcher bucket shape (multi_hop_roots
+            # specializes on the padded root count): fire b concurrent
+            # queries per power-of-two bucket once, so no XLA compile
+            # lands inside a measured window
+            import threading as _th
+            from nebula_tpu.tools.session_bench import run_sessions
+            for b in sorted({2 ** k for k in range(1, 7)
+                             if 2 ** k <= max(counts)} | {max(counts)}):
+                log(f"  warming dispatcher bucket ~{b}...")
+                run_sessions(gd.addr, dense, b, duration_s=0.8,
+                             use_space="zipf")
+            # report only MEASURED windows: warm-up ran at max(counts)
+            # concurrency and would otherwise dominate the stat
+            tpu.stats["batched_max_window"] = 0
+            before = dict(tpu.stats)
+            log("== dense sweep (batched device dispatch) ==")
+            out["dense"] = sweep(gd.addr, dense, counts, args.duration,
+                                 use_space="zipf")
+            out["dispatcher"] = {
+                k: tpu.stats[k] - before.get(k, 0)
+                for k in ("batched_dispatches", "batched_queries",
+                          "go_served")}
+            out["dispatcher"]["batched_max_window"] = \
+                tpu.stats["batched_max_window"]
+        print(json.dumps(out))
+    finally:
+        for h in (gd, sd, metad):
+            try:
+                h.stop()
+            except Exception:
+                pass
+
+
+if __name__ == "__main__":
+    main()
