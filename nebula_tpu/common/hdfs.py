@@ -41,17 +41,38 @@ class HdfsHelper:
             os.path.join(url, f) for f in os.listdir(url))
 
     # ------------------------------------------------------------------
-    def copy_to_local(self, url: str, dest_dir: str) -> Status:
-        """Stage every file under `url` into dest_dir (ref: the per-part
-        `/download` handler pulling SSTs before INGEST)."""
+    def copy_to_local(self, url: str, dest_dir: str,
+                      names: List[str] = None) -> Status:
+        """Stage files under `url` into dest_dir (ref: the per-part
+        `/download` handler pulling SSTs before INGEST). With `names`,
+        only those file names are staged — each storaged pulls ITS OWN
+        parts' SSTs, so an N-host cluster downloads the dataset once in
+        aggregate instead of N times (the Spark generator's per-part
+        download posture, StorageHttpDownloadHandler)."""
         os.makedirs(dest_dir, exist_ok=True)
         if url.startswith("hdfs://"):
             if not self.available():
                 return Status.error(ErrorCode.E_EXECUTION_ERROR,
                                     "hdfs CLI not available")
+            base = url.rstrip("/")
+            if names:
+                # filter to names that EXIST: an empty partition
+                # legitimately produced no SST file (the generator
+                # skips zero-row parts and ingest tolerates absence) —
+                # an explicit copy of a missing source must not fail
+                # the whole DOWNLOAD
+                st, files = self.ls(base)
+                if not st.ok():
+                    return st
+                have = {f.rsplit("/", 1)[-1] for f in files}
+                srcs = [f"{base}/{n}" for n in names if n in have]
+                if not srcs:
+                    return Status.OK()
+            else:
+                srcs = [base + "/*"]
             r = subprocess.run(
                 [self.hdfs_bin, "dfs", "-copyToLocal", "-f",
-                 url.rstrip("/") + "/*", dest_dir],
+                 *srcs, dest_dir],
                 capture_output=True, text=True)
             if r.returncode != 0:
                 return Status.error(ErrorCode.E_EXECUTION_ERROR,
@@ -60,7 +81,9 @@ class HdfsHelper:
         st, files = self.ls(url)
         if not st.ok():
             return st
+        want = set(names) if names else None
         for f in files:
-            if os.path.isfile(f):
+            if os.path.isfile(f) and \
+                    (want is None or os.path.basename(f) in want):
                 shutil.copy2(f, dest_dir)
         return Status.OK()

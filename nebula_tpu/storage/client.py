@@ -566,27 +566,52 @@ class StorageClient:
             space_id, part, lambda svc: svc.kv_get(space_id, part, key),
             lambda r: self._classify_status(r.status))
 
-    def _all_hosts_ok(self, call) -> Status:
+    def _fanout_hosts(self, call) -> Dict[str, Any]:
+        """Concurrent per-host admin fan-out: every future is DRAINED
+        before returning (a first-error early return would leave stale
+        tasks racing a retry into the same staging/checkpoint dirs and
+        occupying pool slots), exceptions captured per host."""
         if self._refresh_hosts is not None:
             self._refresh_hosts()  # include hosts that joined after boot
-        for host, svc in list(self._hosts.items()):
-            st = call(svc)
+        futs = {h: self._pool.submit(call, svc)
+                for h, svc in list(self._hosts.items())}
+        out: Dict[str, Any] = {}
+        for host, f in futs.items():
+            try:
+                out[host] = f.result()
+            except Exception as e:      # transport-level failure
+                out[host] = Status.error(ErrorCode.E_INTERNAL, str(e))
+        return out
+
+    def _all_hosts_ok(self, call) -> Status:
+        for host, st in self._fanout_hosts(call).items():
             if not st.ok():
                 return Status.error(st.code, f"{host}: {st.msg}")
         return Status.OK()
 
     def download(self, space_id: int, url: str) -> Status:
+        """Every host stages ITS parts' SSTs concurrently (the Spark
+        generator's cluster-parallel staging role — N hosts pull N
+        disjoint part sets at once, not one after another)."""
         return self._all_hosts_ok(lambda s: s.download(space_id, url))
 
     def ingest(self, space_id: int) -> Tuple[Status, int]:
-        if self._refresh_hosts is not None:
-            self._refresh_hosts()
+        """Concurrent per-host ingest of the disjoint staged part sets
+        (each host loads only parts it serves — ingest_dir skips
+        non-local part files)."""
         total = 0
-        for host, svc in list(self._hosts.items()):
-            st, n = svc.ingest(space_id)
-            if not st.ok():
-                return Status.error(st.code, f"{host}: {st.msg}"), total
+        err: Optional[Status] = None
+        for host, r in self._fanout_hosts(
+                lambda s: s.ingest(space_id)).items():
+            if isinstance(r, Status):     # transport failure wrapped
+                st, n = r, 0
+            else:
+                st, n = r
+            if not st.ok() and err is None:
+                err = Status.error(st.code, f"{host}: {st.msg}")
             total += n
+        if err is not None:
+            return err, total
         self.note_local_write(space_id)   # AFTER the ingest lands
         return Status.OK(), total
 

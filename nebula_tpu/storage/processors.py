@@ -765,18 +765,29 @@ class StorageService:
     # checkpoint dispatch in the meta snapshot flow)
     # ------------------------------------------------------------------
     def _staging_dir(self, space_id: int) -> str:
+        """Per-host staging (like _checkpoint_dir): hosts sharing a
+        filesystem — or the in-process multi-host topology — must not
+        stage into each other's directories, or the per-part selective
+        download could not be observed or cleaned per host."""
         from ..common.flags import storage_flags
         import os
         return os.path.join(storage_flags.get("download_dir"),
-                            f"space_{space_id}")
+                            f"space_{space_id}",
+                            self.host.replace(":", "_"))
 
     def download(self, space_id: int, url: str) -> Status:
-        """Stage bulk-load SST files for this space's parts (ref:
-        StorageHttpDownloadHandler pulls per-part SSTs from HDFS)."""
+        """Stage bulk-load SST files for THIS host's parts only (ref:
+        StorageHttpDownloadHandler pulls per-part SSTs from HDFS —
+        each host fetches the part files it serves, so the cluster
+        downloads the dataset once in aggregate)."""
         from ..common.hdfs import HdfsHelper
-        if not self.store.parts(space_id):
+        from .sst import part_file
+        parts = self.store.parts(space_id)
+        if not parts:
             return Status.OK()  # no local parts — nothing to stage here
-        return HdfsHelper().copy_to_local(url, self._staging_dir(space_id))
+        return HdfsHelper().copy_to_local(
+            url, self._staging_dir(space_id),
+            names=[part_file(p) for p in parts])
 
     def ingest(self, space_id: int) -> Tuple[Status, int]:
         """Ingest previously staged SSTs into the space's parts (ref:
