@@ -371,6 +371,8 @@ def bench_stats_query(conn, tpu, seed_sets):
     seeds = [s[0] for s in seed_sets[:max(3, LAT_N // 4)]]
     conn.must(q(seeds[0]))          # warm/compile
     a0 = tpu.stats["agg_served"]
+    s0 = tpu.stats["agg_sparse_served"]
+    d0 = tpu.stats["agg_declined"]
     lats = []
     for seed in seeds:
         t1 = time.time()
@@ -391,7 +393,10 @@ def bench_stats_query(conn, tpu, seed_sets):
         f"pipe {cpu_ms:.0f}ms; identity: {ident}")
     assert ident, (rt.rows, rc.rows)
     return {"p50_ms": round(p50, 1), "cpu_pipe_ms": round(cpu_ms, 1),
-            "device_served": int(served)}
+            "device_served": int(served),
+            "sparse_served": int(tpu.stats["agg_sparse_served"] - s0),
+            "declined": int(tpu.stats["agg_declined"] - d0),
+            "decline_reasons": dict(tpu.agg_decline_reasons)}
 
 
 def bench_cpu_scan(cluster, sid, etype, seeds, label):
