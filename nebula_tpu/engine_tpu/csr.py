@@ -250,6 +250,29 @@ class CsrSnapshot:
                                           self.num_parts * self.cap_v)
         return self._aligned
 
+    def build_aligned_off_side(self):
+        """Build the aligned layout WITHOUT caching it — for callers
+        that must validate nothing mutated the mirrors mid-build
+        (prewarm grafting onto a live snapshot) before installing via
+        `_aligned`."""
+        from .traverse import build_aligned
+        gsrc, etype, gdst = self._flat_canonical_edges()
+        return build_aligned(gsrc, etype, gdst,
+                             self.num_parts * self.cap_v)
+
+    def aligned_ready(self):
+        """The cached aligned layout, or None — NEVER builds. The
+        query-path consumer (the cross-session dispatcher) must not pay
+        the build; prewarm/repack build it off to the side, and any
+        delta apply invalidates the cache (tombstones mutate the
+        canonical masks the layout was built from)."""
+        if self.delta is not None and self.delta.edge_count > 0:
+            return None
+        return self._aligned
+
+    def invalidate_aligned(self) -> None:
+        self._aligned = None
+
     def _flat_canonical_edges(self):
         """Flat (gsrc, etype, gdst) canonical edge arrays in the global
         slot encoding (invalid edges -> the dump slot num_parts*cap_v)

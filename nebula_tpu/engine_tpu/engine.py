@@ -140,7 +140,8 @@ class TpuGraphEngine:
                       "host_filter_vectorized": 0, "repack_failures": 0,
                       "agg_served": 0, "agg_sparse_served": 0,
                       "agg_declined": 0, "batched_dispatches": 0,
-                      "batched_queries": 0, "batched_max_window": 0}
+                      "batched_queries": 0, "batched_max_window": 0,
+                      "batched_lane_rounds": 0}
         # why aggregate pushdown declined, by reason (round-4 verdict:
         # the decline path was invisible — 0/3 bench queries served
         # with no stat saying why); mirrored into the global stats
@@ -313,6 +314,13 @@ class TpuGraphEngine:
                     a.block_until_ready()
                     traverse.bfs_dist(f0, jnp.int32(2), snap.kernel,
                                       req).block_until_ready()
+                    # batched lane-matrix layout for the dispatcher —
+                    # built HERE (private snapshot, no lock needed)
+                    # because the query path never pays the build
+                    try:
+                        snap.aligned_kernel()
+                    except Exception:
+                        pass
                     # install only if still current and nothing else
                     # served the space meanwhile — otherwise the
                     # compile-cache warmup was the whole point and the
@@ -323,6 +331,49 @@ class TpuGraphEngine:
                                 self._provider.version(space_id) == \
                                 snap.write_version:
                             self._snapshots[space_id] = snap
+                        else:
+                            # a query installed its own snapshot while
+                            # we built: GRAFT the aligned layout onto
+                            # it only when both are PRISTINE builds of
+                            # the same committed state (equal
+                            # write_version, NO delta buffer on either
+                            # side — any apply history, even vertex
+                            # adds or tombstones with edge_count 0,
+                            # can shift slot assignment vs a fresh
+                            # scan and the layout's slot numbering
+                            # would silently mismatch)
+                            cur2 = self._snapshots.get(space_id)
+                            if (cur2 is not None
+                                    and snap._aligned is not None
+                                    and cur2._aligned is None
+                                    and cur2.delta is None
+                                    and snap.delta is None
+                                    and cur2.write_version ==
+                                    snap.write_version):
+                                cur2._aligned = snap._aligned
+                elif snap._aligned is None and \
+                        (snap.delta is None or
+                         (snap.delta.edge_count == 0
+                          and snap.delta.tomb_count == 0)):
+                    # live snapshot lacks the layout: build OFF the
+                    # engine lock from the mutable mirrors, then graft
+                    # only if no delta apply raced the build (applies
+                    # hold the lock and bump write_version after
+                    # mutating, so an unchanged version proves the
+                    # arrays were stable throughout)
+                    with self._lock:
+                        v0 = snap.write_version
+                    try:
+                        built = snap.build_aligned_off_side()
+                    except Exception:
+                        built = None
+                    if built is not None:
+                        with self._lock:
+                            if snap.write_version == v0 and \
+                                    (snap.delta is None or
+                                     snap.delta.edge_count == 0) and \
+                                    snap._aligned is None:
+                                snap._aligned = built
                 # measured pull-vs-push crossover for THIS space: the
                 # fitted budget replaces the modeled default everywhere
                 # the engine serves, not just inside bench.py (round-4
@@ -445,6 +496,9 @@ class TpuGraphEngine:
             from .delta import apply_entries
             if not apply_entries(snap, self._sm, entries, time.time()):
                 return False
+            # tombstones/patches mutate the canonical arrays the
+            # batched aligned layout was built from
+            snap.invalidate_aligned()
             self.stats["delta_applies"] += 1
         snap.delta_cursor = new_cursor
         snap.write_version = token
@@ -478,6 +532,11 @@ class TpuGraphEngine:
             try:
                 snap = self._build_fresh(space_id)   # scan without lock
                 if snap is not None:
+                    if getattr(snap, "sharded_kernel", None) is None:
+                        try:        # dispatcher layout, still off-lock
+                            snap.aligned_kernel()
+                        except Exception:
+                            pass
                     with self._lock:                 # swap under lock
                         self._snapshots[space_id] = snap
                     self.stats["rebuilds"] += 1
@@ -723,11 +782,24 @@ class TpuGraphEngine:
                                  * (bucket - len(chunk)))
                 f0s = jnp.asarray(np.stack(stack))
                 t1 = time.monotonic()
+                aligned = snap.aligned_ready() if not use_delta and \
+                    steps >= 1 and len(chunk) > 1 else None
                 if use_delta:
                     masks, dmasks = traverse.multi_hop_roots_delta(
                         f0s, jnp.int32(steps), snap.kernel,
                         snap.delta.device(), req_arr)
                     dmasks_np = np.asarray(dmasks)
+                elif aligned is not None:
+                    # lane-matrix batched kernel: the edge/index
+                    # streams are read once per hop for the WHOLE
+                    # window (the vmapped fallback only shares them on
+                    # backends that vectorize the batch dim)
+                    ak, a_chunk, a_group = aligned
+                    masks = traverse.multi_hop_masks_batch(
+                        f0s, jnp.int32(steps), ak, snap.kernel,
+                        req_arr, chunk=a_chunk, group=a_group)
+                    dmasks_np = None
+                    self.stats["batched_lane_rounds"] += 1
                 else:
                     masks = traverse.multi_hop_roots(
                         f0s, jnp.int32(steps), snap.kernel, req_arr)

@@ -553,6 +553,24 @@ def multi_hop_count_batch(frontiers0: jnp.ndarray, steps: jnp.ndarray,
     B = frontiers0.shape[0]
     if B > LANES:
         raise ValueError(f"batch {B} > {LANES} lanes per dispatch")
+    lay = _matrix_layout(ak, req_types, chunk, group)
+    F = _init_lanes(frontiers0, lay[0])
+
+    def body(_, state):
+        f, total = state
+        f, count = _matrix_hop(f, lay, chunk, group)
+        return f, total + count
+
+    _, total = lax.fori_loop(0, steps, body,
+                             (F, jnp.zeros((LANES,), jnp.int64)))
+    return total[:B]
+
+
+def _matrix_layout(ak: AlignedKernel, req_types: jnp.ndarray,
+                   chunk: int, group: int):
+    """Shared per-dispatch prologue of the lane-matrix kernels: block
+    sizing, type-gated effective sources, and boundary indices.
+    -> (ns, blk, nc, ng, src_eff, g_idx, j_idx)."""
     ns = ak.cbound.shape[0] - 1
     e_pad = ak.src.shape[0]
     span = chunk * group
@@ -561,42 +579,92 @@ def multi_hop_count_batch(frontiers0: jnp.ndarray, steps: jnp.ndarray,
     tot = nb * blk
     nc = tot // chunk
     ng = nc // group
-    F = jnp.zeros((ns + 1, LANES), jnp.int8)
-    F = F.at[:ns, :B].set(frontiers0.reshape(B, -1).T.astype(jnp.int8))
     # dead edges (type mismatch this dispatch) -> the always-zero row
     ok = (ak.etype[None] == req_types[:, None]).any(axis=0)
     src_eff = jnp.pad(jnp.where(ok, ak.src, ns), (0, tot - e_pad),
                       constant_values=ns).reshape(nb, blk)
     g_idx = ak.cbound // group                   # [ns+1] group of boundary
     j_idx = ak.cbound % group                    # [ns+1] chunk within group
+    return ns, blk, nc, ng, src_eff, g_idx, j_idx
 
-    def body(_, state):
-        f, total = state
 
-        def block_cs(sb):                        # fused gather + chunk sum
-            return f[sb].reshape(blk // chunk, chunk, LANES).sum(
-                axis=1, dtype=jnp.int32)
+def _init_lanes(frontiers0: jnp.ndarray, ns: int) -> jnp.ndarray:
+    """[ns+1, LANES] int8 frontier matrix (row ns stays zero)."""
+    B = frontiers0.shape[0]
+    F = jnp.zeros((ns + 1, LANES), jnp.int8)
+    return F.at[:ns, :B].set(frontiers0.reshape(B, -1).T.astype(jnp.int8))
 
-        cs = lax.map(block_cs, src_eff).reshape(nc, LANES)
-        local_inc = jnp.cumsum(cs.reshape(ng, group, LANES), axis=1)
-        grp_tot = local_inc[:, -1]
-        grp_exc = jnp.pad(jnp.cumsum(grp_tot, axis=0),
-                          ((1, 0), (0, 0)))[:-1]
-        # int64 accumulator: >2^31 edges per query is reachable on large
-        # graphs (canonicalizes to int32 only when x64 is disabled)
-        total = total + (grp_exc[-1] + grp_tot[-1]).astype(jnp.int64)
-        # exclusive prefix AT the boundaries only (never materializing
-        # the full [nc, LANES] scan): grp_exc[g] + within-group prefix
-        local_prev = jnp.where(
-            (j_idx > 0)[:, None],
-            local_inc[g_idx, jnp.maximum(j_idx - 1, 0)], 0)
-        Sv = grp_exc[g_idx] + local_prev         # [ns+1, LANES]
-        hits = (Sv[1:] - Sv[:-1]) > 0
-        return jnp.pad(hits.astype(jnp.int8), ((0, 1), (0, 0))), total
 
-    _, total = lax.fori_loop(0, steps, body,
-                             (F, jnp.zeros((LANES,), jnp.int64)))
-    return total[:B]
+def _matrix_hop(f: jnp.ndarray, lay, chunk: int, group: int):
+    """One frontier-matrix hop over the aligned layout: fused gather +
+    chunk sums, a two-level prefix over chunk sums, one boundary
+    gather. -> (next int8 matrix, per-lane int64 expansion count)."""
+    _ns, blk, nc, ng, src_eff, g_idx, j_idx = lay
+
+    def block_cs(sb):                            # fused gather + chunk sum
+        return f[sb].reshape(blk // chunk, chunk, LANES).sum(
+            axis=1, dtype=jnp.int32)
+
+    cs = lax.map(block_cs, src_eff).reshape(nc, LANES)
+    local_inc = jnp.cumsum(cs.reshape(ng, group, LANES), axis=1)
+    grp_tot = local_inc[:, -1]
+    grp_exc = jnp.pad(jnp.cumsum(grp_tot, axis=0),
+                      ((1, 0), (0, 0)))[:-1]
+    # int64 accumulator: >2^31 edges per query is reachable on large
+    # graphs (canonicalizes to int32 only when x64 is disabled)
+    count = (grp_exc[-1] + grp_tot[-1]).astype(jnp.int64)
+    # exclusive prefix AT the boundaries only (never materializing
+    # the full [nc, LANES] scan): grp_exc[g] + within-group prefix
+    local_prev = jnp.where(
+        (j_idx > 0)[:, None],
+        local_inc[g_idx, jnp.maximum(j_idx - 1, 0)], 0)
+    Sv = grp_exc[g_idx] + local_prev             # [ns+1, LANES]
+    hits = (Sv[1:] - Sv[:-1]) > 0
+    return jnp.pad(hits.astype(jnp.int8), ((0, 1), (0, 0))), count
+
+
+@partial(jax.jit, static_argnames=("chunk", "group"))
+def multi_hop_masks_batch(frontiers0: jnp.ndarray, steps: jnp.ndarray,
+                          ak: AlignedKernel, k: EdgeKernel,
+                          req_types: jnp.ndarray,
+                          chunk: int = C_ALIGN,
+                          group: int = G_ALIGN) -> jnp.ndarray:
+    """Final-hop ACTIVE EDGE MASKS for a batch of GO queries in ONE
+    dispatch — the cross-session dispatcher's shared kernel. The packed
+    [n_slots+1, LANES] int8 frontier matrix advances steps-1 hops over
+    the aligned layout (identical machinery to multi_hop_count_batch —
+    the edge/index streams are read ONCE per hop for the whole window,
+    where a vmapped multi_hop re-reads them per query on backends that
+    lower vmap to loops), then one gather over the CANONICAL layout
+    turns the matrix into per-lane canonical masks:
+
+        active[b, p, e] = valid & etype_ok & F[global_src(p, e), b]
+
+    Identical semantics to `[multi_hop(f, steps, k, req)[1] for f in
+    batch]` (the frontier of hop N-1 selects hop N's edges; revisits
+    allowed, dedup by saturation). frontiers0: bool[B, P, cap_v] ->
+    bool[B, P, cap_e]; B is bounded by the caller's mask-memory budget
+    (the output is the same size the vmapped form materializes)."""
+    B, P, cap_v = frontiers0.shape
+    if B > LANES:
+        raise ValueError(f"batch {B} > {LANES} lanes per dispatch")
+    lay = _matrix_layout(ak, req_types, chunk, group)
+    F = _init_lanes(frontiers0, lay[0])
+
+    def body(_, f):
+        return _matrix_hop(f, lay, chunk, group)[0]
+
+    F = lax.fori_loop(0, jnp.maximum(steps - 1, 0), body, F)
+    # one canonical gather closes the hop: [E, B] frontier bits at each
+    # edge's global src slot, masked by validity + requested types
+    cap_e = k.src.shape[-1]
+    gsrc = (jnp.arange(P, dtype=jnp.int32)[:, None] * cap_v
+            + k.src.reshape(P, cap_e))
+    rows = F[:, :B][gsrc.reshape(-1)]            # [P*cap_e, B] int8
+    ok_c = _edge_ok(k.etype.reshape(P, cap_e),
+                    k.valid.reshape(P, cap_e), req_types)
+    masks = (rows.reshape(P, cap_e, B) > 0) & ok_c[..., None]
+    return jnp.moveaxis(masks, 2, 0)
 
 
 def build_aligned_blocks(gsrc: np.ndarray, etype: np.ndarray,

@@ -1358,6 +1358,11 @@ def test_cross_session_batched_dispatch_identity():
     expected = {q: sorted(map(repr, cpu_conn.must(q).rows))
                 for q in queries}
     warm.must(queries[0])           # snapshot + XLA compile up front
+    # force-build the aligned layout so multi-query rounds take the
+    # lane-matrix batched kernel (prewarm builds it in production;
+    # the test must not race that background thread)
+    sid = cluster.meta.get_space("nba").value().space_id
+    tpu.snapshot(sid).aligned_kernel()
     # slow the serve step so a round in flight lets the other threads
     # pile into the queue — the NEXT round must then coalesce them
     orig = tpu._serve_batch
@@ -1398,3 +1403,5 @@ def test_cross_session_batched_dispatch_identity():
     assert st["go_served"] >= n_threads * 4, st
     assert st["batched_max_window"] >= 2, st
     assert st["batched_dispatches"] < st["batched_queries"], st
+    # multi-query rounds rode the shared lane-matrix kernel
+    assert st["batched_lane_rounds"] >= 1, st
