@@ -70,14 +70,19 @@ class ExecutionEngine:
         self.client = storage_client
         self.tpu_engine = tpu_engine
         self.balancer = balancer
-        self._parser = GQLParser()
 
     # ------------------------------------------------------------------
     def execute(self, session: ClientSession, text: str) -> ExecutionResponse:
         t0 = time.monotonic()
         resp = ExecutionResponse(space_name=session.space_name or "")
         try:
-            seq = self._parser.parse(text)
+            # parser PER CALL: GQLParser keeps its token cursor on the
+            # instance, and graphd is thread-per-connection — a shared
+            # parser under concurrent sessions interleaves cursors and
+            # throws spurious syntax errors (found by the concurrent
+            # soak; the reference constructs its parser per query too,
+            # GQLParser.h)
+            seq = GQLParser().parse(text)
         except ParseError as e:
             resp.code = ErrorCode.E_SYNTAX_ERROR
             resp.error_msg = str(e)

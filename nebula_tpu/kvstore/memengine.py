@@ -6,10 +6,22 @@ lookups, snapshot-free iterators with prefix/range semantics identical
 to a RocksDB prefix iterator. Durability comes from the WAL + raft
 layers above (exactly where the reference puts it), or from the C++
 native engine behind the same `KVEngine` seam.
+
+Concurrency model (found by the concurrent soak, round 5): storaged
+applies writes on RPC handler threads while snapshot builds and delta
+pulls scan — RocksDB gives the reference consistent iterators for
+free, so this engine must too. Writers SERIALIZE on `_wlock` and
+publish a fresh immutable `(keys, data)` pair per committed batch
+(copy-on-write); readers grab `self._state` once and operate on that
+pair, so a scan can never see a half-applied batch, lose an index
+entry to a racing sort, or KeyError on a just-deleted key. The copy
+is O(keys) per write batch — the native C++ engine serves write-heavy
+production loads; this engine's job is correctness at test/meta scale.
 """
 from __future__ import annotations
 
 import bisect
+import threading
 from typing import Iterable, List, Optional, Tuple
 
 from ..common.status import Status
@@ -52,107 +64,137 @@ def _prefix_upper_bound(prefix: bytes) -> Optional[bytes]:
 class MemEngine(KVEngine):
     def __init__(self) -> None:
         from .changelog import ChangeRing
-        self._keys: List[bytes] = []
-        self._data: dict = {}
+        # immutable published snapshot: (sorted keys, key -> value).
+        # Writers replace the whole tuple under _wlock; readers load it
+        # once and never observe intermediate states.
+        self._state: Tuple[List[bytes], dict] = ([], {})
         self.write_version = 0
         self.changes = ChangeRing()  # committed-write feed (delta sync)
+        self._wlock = threading.Lock()
 
     # --- reads --------------------------------------------------------
     def get(self, key: bytes) -> Optional[bytes]:
-        return self._data.get(key)
+        return self._state[1].get(key)
 
     def prefix(self, prefix: bytes) -> KVIterator:
-        lo = bisect.bisect_left(self._keys, prefix)
+        keys, data = self._state
+        lo = bisect.bisect_left(keys, prefix)
         ub = _prefix_upper_bound(prefix)
-        hi = bisect.bisect_left(self._keys, ub) if ub is not None else len(self._keys)
-        return _ListIterator(self._keys, self._data, lo, hi)
+        hi = bisect.bisect_left(keys, ub) if ub is not None else len(keys)
+        return _ListIterator(keys, data, lo, hi)
 
     def range(self, start: bytes, end: bytes) -> KVIterator:
-        lo = bisect.bisect_left(self._keys, start)
-        hi = bisect.bisect_left(self._keys, end)
-        return _ListIterator(self._keys, self._data, lo, hi)
+        keys, data = self._state
+        lo = bisect.bisect_left(keys, start)
+        hi = bisect.bisect_left(keys, end)
+        return _ListIterator(keys, data, lo, hi)
 
     def scan_batch(self, prefix: bytes) -> Tuple[List[bytes], List[bytes]]:
         """Whole prefix range in two lists (keys, values) — the batched
         form the CSR snapshot builder consumes (one call, no per-item
         iterator overhead)."""
-        lo = bisect.bisect_left(self._keys, prefix)
+        keys, data = self._state
+        lo = bisect.bisect_left(keys, prefix)
         ub = _prefix_upper_bound(prefix)
-        hi = bisect.bisect_left(self._keys, ub) if ub is not None \
-            else len(self._keys)
-        ks = self._keys[lo:hi]
-        return ks, list(map(self._data.__getitem__, ks))
+        hi = bisect.bisect_left(keys, ub) if ub is not None \
+            else len(keys)
+        ks = keys[lo:hi]
+        return ks, list(map(data.__getitem__, ks))
 
     # --- writes -------------------------------------------------------
+    # ring entries are recorded BEFORE write_version advances so a
+    # concurrent pull at version v never misses an op it claims to
+    # cover (the delta feed's never-stale rule); the new state is
+    # published before the record, so a resolver reading "current
+    # visible state" for that version always finds the write.
     def put(self, key: bytes, value: bytes) -> Status:
-        # ring entry is recorded BEFORE write_version advances so a
-        # concurrent changes_snapshot(v) never misses an op it claims
-        # to cover (the delta feed's never-stale rule)
-        v = self.write_version + 1
-        if key not in self._data:
-            bisect.insort(self._keys, key)
-        self._data[key] = value
-        self.changes.record(v, "put", [(key, value)])
-        self.write_version = v
+        with self._wlock:
+            v = self.write_version + 1
+            keys, data = self._state
+            nd = dict(data)
+            if key not in nd:
+                nk = keys.copy()
+                bisect.insort(nk, key)
+            else:
+                nk = keys
+            nd[key] = value
+            self._state = (nk, nd)
+            self.changes.record(v, "put", [(key, value)])
+            self.write_version = v
         return Status.OK()
 
     def multi_put(self, kvs: Iterable[KV]) -> Status:
         kvs = list(kvs)
-        ver = self.write_version + 1
-        new = False
-        for k, v in kvs:
-            if k not in self._data:
-                new = True
-            self._data[k] = v
-        if new:
-            self._keys = sorted(self._data)
-        self.changes.record(ver, "put", kvs)
-        self.write_version = ver
+        with self._wlock:
+            ver = self.write_version + 1
+            keys, data = self._state
+            nd = dict(data)
+            new = False
+            for k, v in kvs:
+                if k not in nd:
+                    new = True
+                nd[k] = v
+            nk = sorted(nd) if new else keys
+            self._state = (nk, nd)
+            self.changes.record(ver, "put", kvs)
+            self.write_version = ver
         return Status.OK()
 
     def remove(self, key: bytes) -> Status:
-        v = self.write_version + 1
-        if key in self._data:
-            del self._data[key]
-            i = bisect.bisect_left(self._keys, key)
-            if i < len(self._keys) and self._keys[i] == key:
-                self._keys.pop(i)
-        self.changes.record(v, "rm", [key])
-        self.write_version = v
+        with self._wlock:
+            v = self.write_version + 1
+            keys, data = self._state
+            if key in data:
+                nd = dict(data)
+                del nd[key]
+                nk = keys.copy()
+                i = bisect.bisect_left(nk, key)
+                if i < len(nk) and nk[i] == key:
+                    nk.pop(i)
+                self._state = (nk, nd)
+            self.changes.record(v, "rm", [key])
+            self.write_version = v
         return Status.OK()
 
-    def multi_remove(self, keys: Iterable[bytes]) -> Status:
-        keys = list(keys)
-        v = self.write_version + 1
-        hit = False
-        for k in keys:
-            if k in self._data:
-                del self._data[k]
-                hit = True
-        if hit:
-            self._keys = sorted(self._data)
-        self.changes.record(v, "rm", keys)
-        self.write_version = v
+    def multi_remove(self, keys_in: Iterable[bytes]) -> Status:
+        keys_in = list(keys_in)
+        with self._wlock:
+            v = self.write_version + 1
+            keys, data = self._state
+            nd = dict(data)
+            hit = False
+            for k in keys_in:
+                if k in nd:
+                    del nd[k]
+                    hit = True
+            if hit:
+                self._state = (sorted(nd), nd)
+            self.changes.record(v, "rm", keys_in)
+            self.write_version = v
         return Status.OK()
 
     def remove_range(self, start: bytes, end: bytes) -> Status:
-        v = self.write_version + 1
-        lo = bisect.bisect_left(self._keys, start)
-        hi = bisect.bisect_left(self._keys, end)
-        for k in self._keys[lo:hi]:
-            del self._data[k]
-        del self._keys[lo:hi]
-        self.changes.record(v, "barrier", None)
-        self.write_version = v
+        with self._wlock:
+            v = self.write_version + 1
+            keys, data = self._state
+            lo = bisect.bisect_left(keys, start)
+            hi = bisect.bisect_left(keys, end)
+            nd = dict(data)
+            for k in keys[lo:hi]:
+                del nd[k]
+            self._state = (keys[:lo] + keys[hi:], nd)
+            self.changes.record(v, "barrier", None)
+            self.write_version = v
         return Status.OK()
 
     # --- maintenance --------------------------------------------------
     def total_keys(self) -> int:
-        return len(self._keys)
+        return len(self._state[0])
 
     def approximate_size(self) -> int:
-        return sum(len(k) + len(v) for k, v in self._data.items())
+        return sum(len(k) + len(v) for k, v in self._state[1].items())
 
     def snapshot_items(self) -> List[KV]:
         """Stable copy for snapshot transfer / CSR building."""
-        return [(k, self._data[k]) for k in self._keys]
+        keys, data = self._state
+        return [(k, data[k]) for k in keys]

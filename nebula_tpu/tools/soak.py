@@ -21,34 +21,43 @@ import time
 from typing import List
 
 
-def run_soak(seconds: float = 10.0, write_ratio: float = 0.3,
-             verify_every: int = 20, v: int = 2000, e: int = 10000,
-             seed: int = 7, progress=None) -> dict:
+def _setup_cluster(space: str, v: int, e: int, seed: int):
+    """Shared soak scaffolding: in-proc cluster with the TPU engine,
+    person(age)/knows(w) schema, a zipf-free random graph of v
+    vertices / e edges, and a warmed snapshot.
+    -> (cluster, conn, tpu, srcs, dsts)."""
     import numpy as np
     from ..cluster import InProcCluster
     from ..engine_tpu import TpuGraphEngine
 
-    rng = random.Random(seed)
     tpu = TpuGraphEngine()
     cluster = InProcCluster(tpu_engine=tpu)
     conn = cluster.connect()
-    conn.must("CREATE SPACE soak(partition_num=4)")
-    conn.must("USE soak")
+    conn.must(f"CREATE SPACE {space}(partition_num=4)")
+    conn.must(f"USE {space}")
     conn.must("CREATE TAG person(age int)")
     conn.must("CREATE EDGE knows(w int)")
     for i in range(0, v, 2000):
-        vrows = ", ".join(f"{j}:({j % 80})"
-                          for j in range(i, min(i + 2000, v)))
-        conn.must(f"INSERT VERTEX person(age) VALUES {vrows}")
+        conn.must("INSERT VERTEX person(age) VALUES " + ", ".join(
+            f"{j}:({j % 80})" for j in range(i, min(i + 2000, v))))
     np_rng = np.random.default_rng(seed)
     srcs = np_rng.integers(0, v, e)
     dsts = np_rng.integers(0, v, e)
     for i in range(0, e, 2000):
-        rows = ", ".join(
+        conn.must("INSERT EDGE knows(w) VALUES " + ", ".join(
             f"{int(s)} -> {int(d)}:({int((s + d) % 101)})"
-            for s, d in zip(srcs[i:i + 2000], dsts[i:i + 2000]))
-        conn.must(f"INSERT EDGE knows(w) VALUES {rows}")
+            for s, d in zip(srcs[i:i + 2000], dsts[i:i + 2000])))
     conn.must("GO FROM 0 OVER knows")          # snapshot up
+    return cluster, conn, tpu, srcs, dsts
+
+
+def run_soak(seconds: float = 10.0, write_ratio: float = 0.3,
+             verify_every: int = 20, v: int = 2000, e: int = 10000,
+             seed: int = 7, progress=None) -> dict:
+    import numpy as np
+
+    rng = random.Random(seed)
+    cluster, conn, tpu, srcs, dsts = _setup_cluster("soak", v, e, seed)
     base_rebuilds = tpu.stats["rebuilds"]
 
     lats: List[float] = []
@@ -136,6 +145,165 @@ def run_soak(seconds: float = 10.0, write_ratio: float = 0.3,
     return out
 
 
+def run_soak_concurrent(seconds: float = 8.0, threads: int = 6,
+                        v: int = 2000, e: int = 10000,
+                        seed: int = 11) -> dict:
+    """Concurrency soak: N sessions hammer one engine through the
+    cross-session dispatcher while writers mutate the graph (delta
+    applies + aligned-layout invalidation racing multi-query rounds),
+    in burst/quiesce phases:
+
+      A. mixed burst — default routing, 2 writer + N-2 reader threads;
+      B. dense burst — pull budget pinned 0, every GO rides the
+         batched dispatcher (vmapped or lane-matrix rounds);
+      C. read-only burst — aligned layout force-built, multi-query
+         rounds take the shared lane-matrix kernel.
+
+    After EVERY burst the cluster quiesces and a deterministic query
+    sweep re-runs with the device engine disabled — any row divergence
+    fails the soak. Returns a JSON-able summary; ok = no thread
+    errors, identity green, dispatcher exercised."""
+    import threading
+
+    import numpy as np
+
+    cluster, conn, tpu, srcs, dsts = _setup_cluster("csoak", v, e, seed)
+    sid = cluster.meta.get_space("csoak").value().space_id
+    deg = np.bincount(srcs, minlength=v)
+    hubs = [int(x) for x in np.argsort(deg)[-3:]]
+    errors: List[str] = []
+    queries = writes = 0
+    qlock = threading.Lock()
+
+    def reader(k, stop, dense):
+        nonlocal queries
+        rng = random.Random(seed * 100 + k)
+        c = cluster.connect()
+        c.must("USE csoak")
+        while not stop.is_set():
+            seed_vid = rng.choice(hubs) if (dense or rng.random() < .3) \
+                else rng.randrange(v)
+            # dense phases share one query SHAPE so concurrent sessions
+            # land in the same dispatcher group (space, steps, types)
+            steps = 3 if dense else rng.choice([1, 2, 3])
+            try:
+                if not dense and rng.random() < 0.2:
+                    c.must(f"GO {steps} STEPS FROM {seed_vid} OVER knows"
+                           f" YIELD knows.w AS w | YIELD COUNT(*) AS n,"
+                           f" SUM($-.w) AS s")
+                else:
+                    c.must(f"GO {steps} STEPS FROM {seed_vid} OVER "
+                           f"knows WHERE knows.w > 50 "
+                           f"YIELD knows._dst, knows.w")
+                with qlock:
+                    queries += 1
+            except Exception as ex:   # noqa: BLE001 — recorded, fails ok
+                errors.append(f"reader: {ex!r}")
+                return
+
+    def writer(k, stop):
+        nonlocal writes
+        rng = random.Random(seed * 999 + k)
+        c = cluster.connect()
+        c.must("USE csoak")
+        while not stop.is_set():
+            try:
+                s, d = rng.randrange(v), rng.randrange(v)
+                if rng.random() < 0.75:
+                    c.must(f"INSERT EDGE knows(w) VALUES "
+                           f"{s} -> {d}:({(s + d) % 101})")
+                else:
+                    c.must(f"DELETE EDGE knows {s} -> {d}")
+                with qlock:
+                    writes += 1
+                time.sleep(0.002)
+            except Exception as ex:   # noqa: BLE001
+                errors.append(f"writer: {ex!r}")
+                return
+
+    def burst(n_writers, dense, dur):
+        stop = threading.Event()
+        ts = [threading.Thread(target=writer, args=(i, stop))
+              for i in range(n_writers)]
+        ts += [threading.Thread(target=reader, args=(i, stop, dense))
+               for i in range(threads - n_writers)]
+        for t in ts:
+            t.start()
+        time.sleep(dur)
+        stop.set()
+        for t in ts:
+            t.join(timeout=30)
+        # a straggler still running would mutate the graph DURING the
+        # verify sweep and fake a divergence — fail loudly instead
+        alive = [t.name for t in ts if t.is_alive()]
+        if alive:
+            errors.append(f"burst stragglers did not stop: {alive}")
+
+    def verify_sweep():
+        settle = time.monotonic() + 10
+        while any(tpu._repacking.values()) and time.monotonic() < settle:
+            time.sleep(0.02)
+        checked = 0
+        for q in ([f"GO 2 STEPS FROM {h} OVER knows "
+                   f"YIELD knows._dst, knows.w" for h in hubs]
+                  + [f"GO 3 STEPS FROM {hubs[0]} OVER knows "
+                     f"WHERE knows.w > 50 YIELD knows._dst"]
+                  + [f"GO FROM {hubs[1]}, {hubs[2]} OVER knows YIELD "
+                     f"knows.w AS w | YIELD COUNT(*) AS n, SUM($-.w)"
+                     f" AS s, MIN($-.w) AS lo"]):
+            rt = conn.must(q)
+            tpu.enabled = False
+            try:
+                rc = conn.must(q)
+            finally:
+                tpu.enabled = True
+            a = sorted(map(repr, rt.rows))
+            b = sorted(map(repr, rc.rows))
+            if a != b:
+                with tpu._lock:
+                    s0 = tpu._snapshots.get(sid)
+                    diag = (f"snapv={getattr(s0, 'write_version', None)} "
+                            f"tok={tpu._provider.version(sid)} "
+                            f"stale={getattr(s0, 'stale', None)}")
+                r2 = sorted(map(repr, conn.must(q).rows))
+                errors.append(
+                    f"IDENTITY DIVERGENCE after burst: {q} "
+                    f"tpu_only={sorted(set(a) - set(b))[:4]} "
+                    f"cpu_only={sorted(set(b) - set(a))[:4]} "
+                    f"{diag} retry_heals={r2 == b}")
+                return checked
+            checked += 1
+        return checked
+
+    per = max(seconds / 3.0, 1.0)
+    verifies = 0
+    burst(2, False, per)                     # A: mixed, default routing
+    verifies += verify_sweep()
+    tpu.sparse_edge_budget = 0
+    burst(2, True, per)                      # B: dense + writers
+    verifies += verify_sweep()
+    with tpu._lock:                          # fold bursts A/B's deltas
+        snap = tpu.refresh(sid)              # fresh base, empty delta
+    if snap is not None:
+        snap.aligned_kernel()
+    burst(0, True, per)                      # C: read-only lane rounds
+    verifies += verify_sweep()
+    with tpu._lock:
+        stats = dict(tpu.stats)
+    out = {
+        "seconds": seconds, "threads": threads, "queries": queries,
+        "writes": writes, "identity_verifies": verifies,
+        "errors": errors[:5],
+        "dispatcher": {k: stats[k] for k in
+                       ("batched_dispatches", "batched_queries",
+                        "batched_max_window", "batched_lane_rounds")},
+        "delta_applies": stats["delta_applies"],
+    }
+    out["ok"] = (not errors and verifies >= 15 and queries > 0
+                 and stats["batched_queries"] > 0)
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="mixed INSERT+GO soak with continuous CPU/TPU "
@@ -145,11 +313,19 @@ def main(argv=None) -> int:
     ap.add_argument("--verify-every", type=int, default=20)
     ap.add_argument("--vertices", type=int, default=2000)
     ap.add_argument("--edges", type=int, default=10000)
+    ap.add_argument("--concurrent", action="store_true",
+                    help="multi-session dispatcher soak (burst/quiesce "
+                         "phases) instead of the single-session mix")
+    ap.add_argument("--threads", type=int, default=6)
     args = ap.parse_args(argv)
-    out = run_soak(args.seconds, args.write_ratio, args.verify_every,
-                   args.vertices, args.edges,
-                   progress=lambda q, w: print(f"  ... {q} queries, "
-                                               f"{w} writes", flush=True))
+    if args.concurrent:
+        out = run_soak_concurrent(args.seconds, args.threads,
+                                  args.vertices, args.edges)
+    else:
+        out = run_soak(args.seconds, args.write_ratio, args.verify_every,
+                       args.vertices, args.edges,
+                       progress=lambda q, w: print(
+                           f"  ... {q} queries, {w} writes", flush=True))
     print(json.dumps(out))
     return 0 if out["ok"] else 1
 

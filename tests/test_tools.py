@@ -294,3 +294,14 @@ def test_sst_generator_parallel_matches_serial(cluster, tmp_path):
         assert r.rows[0][-1] == "P0"
     finally:
         storage_flags.set("download_dir", prev)
+
+
+def test_soak_concurrent_short():
+    """Multi-session dispatcher soak: concurrent readers/writers over
+    one engine (delta applies + aligned invalidation racing batched
+    rounds), identity swept after every burst phase."""
+    from nebula_tpu.tools.soak import run_soak_concurrent
+    out = run_soak_concurrent(seconds=4.0, threads=5, v=800, e=4000)
+    assert out["ok"], out
+    assert not out["errors"], out
+    assert out["dispatcher"]["batched_queries"] > 0, out
