@@ -100,6 +100,22 @@ def serve_graphd(meta_addr: str, host: str = "127.0.0.1", port: int = 0,
                 return 400, {"error": f"unknown op {op!r}"}
 
             web.register("/trace", trace)
+
+            def tpu_stats(params, body):
+                # the engine's serving counters + decline reasons +
+                # per-space budget fits, operator-visible like the
+                # reference's storage stats (ref WebService.h:31-49)
+                return 200, {
+                    "stats": dict(tpu_engine.stats),
+                    "agg_decline_reasons":
+                        dict(tpu_engine.agg_decline_reasons),
+                    "sparse_budget_calibrations": {
+                        str(k): v for k, v in
+                        tpu_engine.sparse_budget_calibrations.items()},
+                    "sparse_edge_budget": tpu_engine.sparse_edge_budget,
+                }
+
+            web.register("/tpu_stats", tpu_stats)
         web.start()
     return GraphdHandle(service, engine, mc, server, web)
 

@@ -390,3 +390,38 @@ def test_find_is_grammar_level_stub():
     # FIND SHORTEST/ALL PATH still parses as a real statement
     seq = GQLParser().parse("FIND SHORTEST PATH FROM 1 TO 2 OVER like")
     assert seq.sentences[0].kind == ast.Kind.FIND_PATH
+
+
+def test_graphd_tpu_stats_endpoint():
+    """/tpu_stats on a --tpu graphd: serving counters, aggregation
+    decline reasons and per-space budget fits, operator-visible over
+    the HTTP admin surface."""
+    import json as _json
+    import urllib.request
+    from nebula_tpu.client import GraphClient
+    from nebula_tpu.daemons import serve_graphd, serve_metad, serve_storaged
+    from nebula_tpu.engine_tpu import TpuGraphEngine
+
+    metad = serve_metad()
+    storaged = serve_storaged(metad.addr, load_interval=0.1)
+    tpu = TpuGraphEngine()
+    graphd = serve_graphd(metad.addr, tpu_engine=tpu, ws_port=0)
+    try:
+        gc = GraphClient(graphd.addr).connect()
+        for s in ("CREATE SPACE ts_s(partition_num=2)", "USE ts_s",
+                  "CREATE TAG t(x int)", "CREATE EDGE e(w int)",
+                  "INSERT VERTEX t(x) VALUES 1:(5), 2:(6)",
+                  "INSERT EDGE e(w) VALUES 1 -> 2:(3)"):
+            r = gc.execute(s)
+            assert r.ok(), (s, r.error_msg)
+        r = gc.execute("GO FROM 1 OVER e YIELD e._dst")
+        assert r.ok() and r.rows == [(2,)]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{graphd.ws_port}/tpu_stats") as resp:
+            assert resp.status == 200
+            body = _json.loads(resp.read())
+        assert body["stats"]["go_served"] >= 1, body
+        assert "agg_decline_reasons" in body
+        assert isinstance(body["sparse_edge_budget"], int)
+    finally:
+        graphd.stop(); storaged.stop(); metad.stop()
