@@ -643,3 +643,91 @@ def test_retry_safe_compound_statements():
     # a quoted semicolon + mutation keyword stays ONE read statement
     assert S._retry_safe('LOOKUP ON t WHERE t.s == "a;DELETE VERTEX 1"')
     assert not S._retry_safe("UPDATE VERTEX 1 SET t.x = 1")
+
+
+def test_tpu_served_across_replica_failover(tmp_path):
+    """Device-served GO across a storaged leader kill: the freshness
+    token carries part->leader routing, so the failover invalidates
+    the snapshot (token incompatible -> rebuild from the NEW leaders)
+    and the engine must re-serve on device with identical results —
+    degrading to the CPU fan-out only while the topology settles."""
+    from nebula_tpu.common import keys as ku
+    from nebula_tpu.engine_tpu import TpuGraphEngine
+
+    metad = serve_metad()
+    storers = [serve_storaged(metad.addr, replicated=True,
+                              data_dir=str(tmp_path / f"s{i}"))
+               for i in range(3)]
+    tpu = TpuGraphEngine()
+    graphd = serve_graphd(metad.addr, tpu_engine=tpu)
+    gc = GraphClient(graphd.addr).connect()
+    try:
+        for s in ("CREATE SPACE rft(partition_num=2, replica_factor=3)",
+                  "USE rft", "CREATE TAG person(age int)",
+                  "CREATE EDGE knows(w int)"):
+            r = gc.execute(s)
+            assert r.ok(), (s, r.error_msg)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            r = gc.execute("INSERT VERTEX person(age) VALUES "
+                           "1:(10), 2:(20), 3:(30), 4:(40)")
+            if r.ok():
+                break
+            time.sleep(0.2)   # raft elections in progress
+        assert r.ok(), r.error_msg
+        r = gc.execute("INSERT EDGE knows(w) VALUES 1 -> 2:(5), "
+                       "2 -> 3:(6), 1 -> 3:(7), 3 -> 4:(8)")
+        assert r.ok(), r.error_msg
+        q = "GO 2 STEPS FROM 1 OVER knows YIELD knows._dst"
+        want = [(3,), (4,)]
+
+        def device_served():
+            before = tpu.stats["go_served"]
+            r = gc.execute(q)
+            assert r.ok(), r.error_msg
+            return (sorted(r.rows), tpu.stats["go_served"] > before)
+
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            rows, on_device = device_served()
+            if on_device:
+                break
+            time.sleep(0.3)   # watch channels still priming
+        assert on_device and rows == want, (rows, tpu.stats)
+
+        # kill the leader of vid 1's part; meta moves leadership to a
+        # survivor and the engine must rebuild from the new routing
+        space_id = metad.meta.get_space("rft").value().space_id
+        part = ku.part_id(1, 2)
+        leader_idx = None
+        deadline = time.time() + 10
+        while leader_idx is None and time.time() < deadline:
+            for i, h in enumerate(storers):
+                raft = h.node.raft(space_id, part)
+                if raft is not None and raft.is_leader():
+                    leader_idx = i
+            if leader_idx is None:
+                time.sleep(0.1)
+        assert leader_idx is not None
+        storers[leader_idx].stop()
+
+        deadline = time.time() + 30
+        on_device = False
+        while time.time() < deadline:
+            try:
+                rows, on_device = device_served()
+            except AssertionError:
+                time.sleep(0.3)   # elections / topology settling
+                continue
+            if on_device and rows == want:
+                break
+            time.sleep(0.3)
+        assert on_device and rows == want, (rows, tpu.stats)
+    finally:
+        graphd.stop()
+        for h in storers:
+            try:
+                h.stop()
+            except Exception:
+                pass
+        metad.stop()
