@@ -1260,6 +1260,8 @@ class TpuGraphEngine:
                     continue
                 if isinstance(e, EdgePropExpr):
                     delta_audit.append((e.edge, e.prop))
+                    if (e.edge, e.prop) in loaders:
+                        continue   # the loader's own err check covers it
                 else:
                     delta_audit_strict = True
                 fn = hfc._compile(e)
@@ -1284,6 +1286,10 @@ class TpuGraphEngine:
                     return self._agg_decline("err_cells")
             for k, fn in loaders.items():
                 v = fn(p, idx)
+                if np.any(v.err):
+                    # CPU raises EvalError for these rows (the loader
+                    # doubles as its own column's err audit)
+                    return self._agg_decline("err_cells")
                 null = v.null if isinstance(v.null, np.ndarray) else \
                     np.full(idx.size, bool(v.null))
                 chunks[k].append((np.asarray(v.value), null))
