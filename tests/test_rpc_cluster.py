@@ -731,3 +731,110 @@ def test_tpu_served_across_replica_failover(tmp_path):
             except Exception:
                 pass
         metad.stop()
+
+
+def test_tpu_concurrent_identity_over_tcp_native():
+    """Concurrency soak over the REAL topology: native-engine storaged,
+    --tpu graphd, concurrent TCP writers + readers (dispatcher rounds,
+    delta pulls resolving against the C++ engine under live writes),
+    then a quiesced CPU/TPU identity sweep. Exercises the native
+    changelog + remote snapshot provider under the interleavings the
+    in-proc soak can't."""
+    import threading
+
+    import numpy as np
+    from nebula_tpu import native as native_mod
+    from nebula_tpu.engine_tpu import TpuGraphEngine
+
+    if not native_mod.available():
+        pytest.skip("native library unavailable")
+    metad = serve_metad()
+    sd = serve_storaged(metad.addr, load_interval=0.1)
+    tpu = TpuGraphEngine()
+    graphd = serve_graphd(metad.addr, tpu_engine=tpu)
+    v, e = 600, 3000
+    try:
+        gc = GraphClient(graphd.addr).connect()
+        for s in ("CREATE SPACE tsoak(partition_num=4)", "USE tsoak",
+                  "CREATE TAG person(age int)", "CREATE EDGE knows(w int)"):
+            r = gc.execute(s)
+            assert r.ok(), (s, r.error_msg)
+        rng = np.random.default_rng(23)
+        srcs = rng.integers(0, v, e)
+        dsts = rng.integers(0, v, e)
+        gc.execute("INSERT VERTEX person(age) VALUES " + ", ".join(
+            f"{j}:({j % 70})" for j in range(v)))
+        for i in range(0, e, 1500):
+            r = gc.execute("INSERT EDGE knows(w) VALUES " + ", ".join(
+                f"{int(s)} -> {int(d)}:({int((s + d) % 101)})"
+                for s, d in zip(srcs[i:i + 1500], dsts[i:i + 1500])))
+            assert r.ok(), r.error_msg
+        gc.execute("GO FROM 0 OVER knows")
+        hubs = [int(x) for x in
+                np.argsort(np.bincount(srcs, minlength=v))[-3:]]
+        errors = []
+        stop = threading.Event()
+
+        def reader(k):
+            import random as _r
+            rr = _r.Random(k)
+            c = GraphClient(graphd.addr).connect()
+            c.execute("USE tsoak")
+            while not stop.is_set():
+                h = rr.choice(hubs)
+                r = c.execute(f"GO 2 STEPS FROM {h} OVER knows "
+                              f"YIELD knows._dst, knows.w")
+                if not r.ok():
+                    errors.append(r.error_msg)
+                    return
+
+        def writer(k):
+            import random as _r
+            import time as _t
+            rr = _r.Random(900 + k)
+            c = GraphClient(graphd.addr).connect()
+            c.execute("USE tsoak")
+            while not stop.is_set():
+                s, d = rr.randrange(v), rr.randrange(v)
+                if rr.random() < 0.8:
+                    r = c.execute(f"INSERT EDGE knows(w) VALUES "
+                                  f"{s} -> {d}:({(s + d) % 101})")
+                else:
+                    r = c.execute(f"DELETE EDGE knows {s} -> {d}")
+                if not r.ok():
+                    errors.append(r.error_msg)
+                    return
+                _t.sleep(0.002)
+
+        ts = [threading.Thread(target=writer, args=(i,)) for i in range(2)]
+        ts += [threading.Thread(target=reader, args=(i,)) for i in range(3)]
+        for t in ts:
+            t.start()
+        time.sleep(4.0)
+        stop.set()
+        for t in ts:
+            t.join(timeout=30)
+        assert not [t for t in ts if t.is_alive()], "stragglers"
+        assert not errors, errors[:3]
+        # quiesce background repacks, then identity-sweep
+        deadline = time.time() + 10
+        while any(tpu._repacking.values()) and time.time() < deadline:
+            time.sleep(0.02)
+        for q in ([f"GO 2 STEPS FROM {h} OVER knows "
+                   f"YIELD knows._dst, knows.w" for h in hubs]
+                  + [f"GO FROM {hubs[0]}, {hubs[1]} OVER knows YIELD "
+                     f"knows.w AS w | YIELD COUNT(*) AS n, SUM($-.w)"
+                     f" AS s"]):
+            rt = gc.execute(q)
+            assert rt.ok(), rt.error_msg
+            tpu.enabled = False
+            try:
+                rc = gc.execute(q)
+            finally:
+                tpu.enabled = True
+            assert rc.ok(), rc.error_msg
+            assert sorted(map(repr, rt.rows)) == \
+                sorted(map(repr, rc.rows)), q
+        assert tpu.stats["go_served"] > 0, tpu.stats
+    finally:
+        graphd.stop(); sd.stop(); metad.stop()
