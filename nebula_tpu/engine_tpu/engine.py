@@ -316,9 +316,25 @@ class TpuGraphEngine:
                                       req).block_until_ready()
                     # batched lane-matrix layout for the dispatcher —
                     # built HERE (private snapshot, no lock needed)
-                    # because the query path never pays the build
+                    # because the query path never pays the build —
+                    # plus a compile of BOTH dispatcher bucket shapes,
+                    # so production windows never hit a cold XLA
+                    # compile (20-40s on first chip contact)
                     try:
                         snap.aligned_kernel()
+                        al = snap.aligned_ready()
+                        if al is not None:
+                            ak_w, c_w, g_w = al
+                            cap = self._dispatch_cap(snap)
+                            for b in sorted({min(self.SMALL_BUCKET, cap),
+                                             cap}):
+                                fb = jnp.zeros(
+                                    (b, snap.num_parts, snap.cap_v),
+                                    bool)
+                                traverse.multi_hop_masks_batch(
+                                    fb, jnp.int32(2), ak_w, snap.kernel,
+                                    req, chunk=c_w, group=g_w
+                                ).block_until_ready()
                     except Exception:
                         pass
                     # install only if still current and nothing else
@@ -614,6 +630,7 @@ class TpuGraphEngine:
                                # OOM the chip — huge-N queries fall back
                                # to the bounded-memory CPU loop
     MAX_DISPATCH_BATCH = 64    # queries coalesced per dispatcher round
+    SMALL_BUCKET = 8           # small-window pad size (see _serve_group)
     # per-root edge cap for the calibration walk probe — bounds the
     # engine-lock hold time on huge graphs (rate, not completion)
     CALIBRATION_PROBE_BUDGET = 1 << 18
@@ -740,6 +757,7 @@ class TpuGraphEngine:
             if not dense:
                 return
             use_delta = snap.delta is not None and snap.delta.edge_count > 0
+            cap = self._dispatch_cap(snap)
             req_arr = jnp.asarray(traverse.pad_edge_types(list(etypes)))
             # one device-filter compile per DISTINCT WHERE per round:
             # the common group-commit case is N identical queries, and
@@ -760,30 +778,35 @@ class TpuGraphEngine:
                         r.ctx, r.s, snap, use_delta, r.name_by_type,
                         r.alias_map, r.edge_types)
                 return filter_cache[key]
-            cap = max(min(self.MAX_ROOTS_ON_DEVICE,
-                          (1 << 30) // max(snap.num_parts * snap.cap_e, 1)),
-                      1)
             for c0 in range(0, len(dense), cap):
                 chunk = dense[c0:c0 + cap]
-                # pad the root axis to a power-of-two bucket: vmapped
-                # programs specialize on R, and a fresh XLA compile per
-                # distinct window size would eat the batching win —
-                # buckets bound the compile count to log2(cap) shapes.
-                # Zero frontiers produce empty masks and carry no
-                # request. Never pad past the memory-derived cap: the
-                # 1GiB mask budget must hold for the PADDED batch too.
-                bucket = 1
-                while bucket < len(chunk):
-                    bucket *= 2
-                bucket = min(bucket, cap)
+                aligned = snap.aligned_ready() if not use_delta and \
+                    steps >= 1 and len(chunk) > 1 else None
+                # pad the root axis so XLA compiles FEW shapes, never
+                # past the memory-derived cap (the 1GiB mask budget
+                # must hold for the PADDED batch too); zero frontiers
+                # produce empty masks and carry no request.
+                # - lane path: exactly TWO buckets (small, cap) — both
+                #   precompiled by prewarm, so no cold compile ever
+                #   lands inside a round;
+                # - delta/vmapped rounds: power-of-two buckets (delta
+                #   device shapes vary with the buffer, so those
+                #   programs can't be precompiled — smaller pads keep
+                #   each first-seen compile cheap).
+                if aligned is not None:
+                    bucket = min(self.SMALL_BUCKET, cap) \
+                        if len(chunk) <= self.SMALL_BUCKET else cap
+                else:
+                    bucket = 1
+                    while bucket < len(chunk):
+                        bucket *= 2
+                    bucket = min(bucket, cap)
                 stack = [f for _, f, _, _ in chunk]
                 if bucket > len(chunk):
                     stack.extend([np.zeros_like(stack[0])]
                                  * (bucket - len(chunk)))
                 f0s = jnp.asarray(np.stack(stack))
                 t1 = time.monotonic()
-                aligned = snap.aligned_ready() if not use_delta and \
-                    steps >= 1 and len(chunk) > 1 else None
                 if use_delta:
                     masks, dmasks = traverse.multi_hop_roots_delta(
                         f0s, jnp.int32(steps), snap.kernel,
@@ -1040,6 +1063,15 @@ class TpuGraphEngine:
             return self._go_aggregate_locked(ctx, s, specs, out_cols,
                                              starts, edge_types, alias_map,
                                              name_by_type, ex, group_layout)
+
+    @classmethod
+    def _dispatch_cap(cls, snap) -> int:
+        """Per-round root cap: the padded batch's [B, P, cap_e] masks
+        must stay under a ~1GiB budget (and under the fixed lane
+        width)."""
+        return max(min(cls.MAX_DISPATCH_BATCH,
+                       (1 << 30) // max(snap.num_parts * snap.cap_e, 1)),
+                   1)
 
     def _agg_decline(self, reason: str):
         """Count one aggregation-pushdown decline (engine stats +

@@ -48,6 +48,11 @@ def _setup_cluster(space: str, v: int, e: int, seed: int):
             f"{int(s)} -> {int(d)}:({int((s + d) % 101)})"
             for s, d in zip(srcs[i:i + 2000], dsts[i:i + 2000])))
     conn.must("GO FROM 0 OVER knows")          # snapshot up
+    # absorb the background warmup (kernel + dispatcher-bucket
+    # compiles + calibration) BEFORE any measured burst: on a 1-core
+    # host a compile racing the burst starves the sessions
+    sid = cluster.meta.get_space(space).value().space_id
+    tpu.prewarm(sid, block=True)
     return cluster, conn, tpu, srcs, dsts
 
 
@@ -286,7 +291,22 @@ def run_soak_concurrent(seconds: float = 8.0, threads: int = 6,
         snap = tpu.refresh(sid)              # fresh base, empty delta
     if snap is not None:
         snap.aligned_kernel()
-    burst(0, True, per)                      # C: read-only lane rounds
+    # phase C paces each dispatcher round by 10ms so window formation
+    # is deterministic: on a 1-core GIL-serialized closed loop, fast
+    # rounds rarely overlap arrivals naturally (coalescing under real
+    # load needs either cores or slow rounds — exactly the regimes the
+    # dispatcher targets)
+    orig_sb = tpu._serve_batch
+
+    def paced(batch, ex):
+        time.sleep(0.01)
+        orig_sb(batch, ex)
+
+    tpu._serve_batch = paced
+    try:
+        burst(0, True, per)                  # C: read-only lane rounds
+    finally:
+        tpu._serve_batch = orig_sb
     verifies += verify_sweep()
     with tpu._lock:
         stats = dict(tpu.stats)
