@@ -26,6 +26,10 @@ the SAME store through the real service layers):
     YIELD knows._dst, knows.ts, $$.person.age
   with <cut> tuned so each query yields ~TARGET_ROWS rows; the same
   query also timed once on the CPU path (tpu disabled) for contrast.
+- Tier 3: concurrent sessions — N closed-loop threads through the
+  cross-session group-commit dispatcher (dense routing pinned);
+  aggregate QPS plus how many queries shared device dispatches
+  (lane-matrix rounds).
 - Baselines (labeled): [cpp-scan storaged] = this framework's storage
   scatter/gather hot loop over the native C++ engine (prefix_dedup
   scan); [python-loop storaged] = the same loop over the pure-python
@@ -399,6 +403,62 @@ def bench_stats_query(conn, tpu, seed_sets):
             "decline_reasons": dict(tpu.agg_decline_reasons)}
 
 
+def bench_concurrent(cluster, tpu, seed_sets, seconds=6.0, sessions=8):
+    """Tier 3: concurrent sessions through the cross-session
+    dispatcher — N closed-loop threads firing the tier-2 query shape;
+    aggregate QPS + window coalescing (PARITY.md Concurrency's
+    measurement, in-process at bench scale so it lands in the driver
+    artifact)."""
+    import threading
+    hubs = [s[0] for s in seed_sets[:sessions]]
+    conns = []
+    for _ in range(sessions):
+        c = cluster.connect()
+        c.must("USE snb")
+        conns.append(c)
+    b0 = {k: tpu.stats[k] for k in ("batched_dispatches",
+                                    "batched_queries",
+                                    "batched_lane_rounds", "go_served")}
+    stop = threading.Event()
+    counts = [0] * sessions
+    errs = []
+
+    def worker(k):
+        q = (f"GO {STEPS} STEPS FROM {hubs[k]} OVER knows "
+             f"WHERE knows.ts > {TS_MAX - 1} YIELD knows._dst")
+        while not stop.is_set():
+            try:
+                conns[k].must(q)
+                counts[k] += 1
+            except Exception as ex:   # noqa: BLE001 — recorded, fails run
+                errs.append(repr(ex))
+                return
+
+    threads = [threading.Thread(target=worker, args=(k,))
+               for k in range(sessions)]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    time.sleep(seconds)
+    stop.set()
+    for t in threads:
+        t.join(timeout=60)
+    wall = time.time() - t0
+    assert not errs, errs[:2]
+    total = sum(counts)
+    d = {k: tpu.stats[k] - b0[k] for k in b0}
+    out = {"sessions": sessions, "qps": round(total / wall, 1),
+           "queries": total,
+           "batched_queries": d["batched_queries"],
+           "batched_dispatches": d["batched_dispatches"],
+           "lane_rounds": d["batched_lane_rounds"]}
+    log(f"tier3 concurrent ({sessions} sessions, {wall:.1f}s): "
+        f"{out['qps']} QPS aggregate, {d['batched_queries']} queries "
+        f"over {d['batched_dispatches']} shared dispatches "
+        f"({d['batched_lane_rounds']} lane rounds)")
+    return out
+
+
 def bench_cpu_scan(cluster, sid, etype, seeds, label):
     """The CPU storage scatter/gather path (get_neighbors fan-out with
     frontier dedup — what GoExecutor drives), over whatever engine the
@@ -502,6 +562,12 @@ def main():
     p50, p99, qps1, cpu_q_ms, tier2_profile = bench_full_queries(
         conn, tpu, snap, etype, seed_sets)
     stats_extra = bench_stats_query(conn, tpu, seed_sets)
+    saved_budget = tpu.sparse_edge_budget
+    tpu.sparse_edge_budget = 0       # pin dense: dispatcher rounds
+    try:
+        tier3 = bench_concurrent(cluster, tpu, seed_sets)
+    finally:
+        tpu.sparse_edge_budget = saved_budget
     # CPU baselines measure a RATE — a seed subset keeps the python
     # materialization of the scan bounded at SNB scale
     cpu_seeds = seed_sets[0][:8]
@@ -538,6 +604,7 @@ def main():
         "tier2_profile": tier2_profile,
         "sparse_budget_calibration": cal,
         "stats_query": stats_extra,
+        "tier3_concurrent": tier3,
     }))
 
 
