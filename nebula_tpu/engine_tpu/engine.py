@@ -342,7 +342,15 @@ class TpuGraphEngine:
                     # compile-cache warmup was the whole point and the
                     # build is dropped
                     with self._lock:
+                        # never install an EMPTY snapshot: a space
+                        # being USE'd right before a bulk load would
+                        # get a zero-content snapshot whose later
+                        # delta pull exceeds the change ring
+                        # (poison -> background repack -> transient
+                        # declines at first query); an empty install
+                        # has no serving value anyway
                         if space_id not in self._snapshots and \
+                                snap.total_edges > 0 and \
                                 self._provider is not None and \
                                 self._provider.version(space_id) == \
                                 snap.write_version:
