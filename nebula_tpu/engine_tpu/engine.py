@@ -637,7 +637,11 @@ class TpuGraphEngine:
                                # unbounded N would unroll the trace and
                                # OOM the chip — huge-N queries fall back
                                # to the bounded-memory CPU loop
-    MAX_DISPATCH_BATCH = 64    # queries coalesced per dispatcher round
+    MAX_DISPATCH_BATCH = 128   # queries coalesced per dispatcher round
+                               # (= traverse.LANES, the frontier-matrix
+                               # width — one full TPU lane row); the
+                               # per-round memory cap still governs on
+                               # big graphs (_dispatch_cap)
     SMALL_BUCKET = 8           # small-window pad size (see _serve_group)
     # per-root edge cap for the calibration walk probe — bounds the
     # engine-lock hold time on huge graphs (rate, not completion)
