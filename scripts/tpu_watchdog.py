@@ -100,7 +100,38 @@ def probe() -> str:
     return ""
 
 
+def _foreign_bench_running() -> bool:
+    """True when another process is already running bench.py — the
+    accelerator is exclusive-access, so racing the round driver's own
+    bench would steal the chip and force IT onto the CPU fallback
+    (the exact failure this watchdog exists to prevent)."""
+    me = os.getpid()
+    try:
+        for pid in os.listdir("/proc"):
+            if not pid.isdigit() or int(pid) == me:
+                continue
+            try:
+                with open(f"/proc/{pid}/cmdline", "rb") as f:
+                    argv = f.read().decode("utf-8", "replace").split("\0")
+            except OSError:
+                continue
+            # a PYTHON process whose own argv carries bench.py as a
+            # script path — NOT any process that merely mentions it in
+            # a prompt/flag blob (the round driver's harness does)
+            if argv and "python" in os.path.basename(argv[0]) and any(
+                    a.endswith("bench.py") for a in argv[1:4]):
+                return True
+    except OSError:
+        pass
+    return False
+
+
 def run_bench(out_path: str, extra_env: dict, timeout: float) -> bool:
+    if _foreign_bench_running():
+        log(f"bench -> {os.path.basename(out_path)}: DEFERRED — another "
+            f"bench.py process is running (driver round-end bench?); "
+            f"not contending for the exclusive-access chip")
+        return False
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)
     env.update(extra_env)
