@@ -185,6 +185,21 @@ int64_t nbc_decode_batch(const uint8_t *field_types, int32_t n_fields,
                          uint32_t *str_off, uint32_t *str_len,
                          uint8_t *nulls);
 
+/* Inverse of nbc_decode_batch: encode [n_fields, n_rows] column-major
+ * values into the fixed-slot row layout (byte-identical to
+ * codec/row.py RowWriter), writing one contiguous blob plus per-row
+ * (row_off, row_len). STRING cells reference (str_off, str_len)
+ * slices of str_blob. ver_len (0..8) and schema_ver form each row's
+ * version header. Returns total bytes written, or negative: -1 bad
+ * args, -2 out_cap too small, -3 a string slice out of str_blob. */
+int64_t nbc_encode_rows(const uint8_t *field_types, int32_t n_fields,
+                        const int64_t *vals_i64, const double *vals_f64,
+                        const uint8_t *nulls, const uint8_t *str_blob,
+                        int64_t str_blob_len, const int64_t *str_off,
+                        const uint32_t *str_len, int64_t n_rows,
+                        int32_t ver_len, int64_t schema_ver, uint8_t *out,
+                        int64_t out_cap, int64_t *row_off, int32_t *row_len);
+
 #ifdef __cplusplus
 }
 #endif

@@ -36,6 +36,87 @@ inline double rd_f64(const uint8_t *p) {
 
 }  // namespace
 
+// Inverse of nbc_decode_batch: encode column-major values into the
+// fixed-slot row layout, one contiguous blob + per-row offsets. The
+// serving hot path uses it to emit an entire dispatcher window's
+// result rows in one GIL-released call (ctypes drops the GIL for the
+// duration); byte output is identical to codec/row.py RowWriter so a
+// pure-Python fallback can produce the same blob.
+//
+// Inputs are [n_fields, n_rows] column-major: vals_i64 for
+// BOOL/INT/VID/TIMESTAMP, vals_f64 for DOUBLE, (str_off into
+// str_blob, str_len) for STRING, nulls (1 = null). schema_ver/ver_len
+// form the version header each row carries (ver_len may be 0).
+// Returns total bytes written, or negative: -1 bad args, -2 out_cap
+// too small, -3 a string slice exceeds str_blob.
+extern "C" int64_t nbc_encode_rows(
+    const uint8_t *field_types, int32_t n_fields, const int64_t *vals_i64,
+    const double *vals_f64, const uint8_t *nulls, const uint8_t *str_blob,
+    int64_t str_blob_len, const int64_t *str_off, const uint32_t *str_len,
+    int64_t n_rows, int32_t ver_len, int64_t schema_ver, uint8_t *out,
+    int64_t out_cap, int64_t *row_off, int32_t *row_len) {
+  int32_t slot_offs[256];
+  if (n_fields <= 0 || n_fields > 256 || ver_len < 0 || ver_len > 8)
+    return -1;
+  int32_t off = 0;
+  for (int32_t f = 0; f < n_fields; ++f) {
+    slot_offs[f] = off;
+    off += (field_types[f] == NBC_TYPE_BOOL) ? 1 : 8;
+  }
+  const int32_t slot_total = off;
+  const int32_t null_bytes = (n_fields + 7) / 8;
+  const int32_t fixed = 1 + ver_len + null_bytes + slot_total;
+
+  int64_t pos = 0;
+  for (int64_t r = 0; r < n_rows; ++r) {
+    if (pos + fixed > out_cap) return -2;
+    uint8_t *row = out + pos;
+    row[0] = static_cast<uint8_t>(ver_len);
+    for (int32_t k = 0; k < ver_len; ++k)
+      row[1 + k] = static_cast<uint8_t>((schema_ver >> (8 * k)) & 0xFF);
+    uint8_t *nullmap = row + 1 + ver_len;
+    std::memset(nullmap, 0, null_bytes);
+    uint8_t *slots = nullmap + null_bytes;
+    std::memset(slots, 0, slot_total);
+    int64_t var_len = 0;  // var region filled in a second field pass
+    for (int32_t f = 0; f < n_fields; ++f) {
+      const int64_t in = static_cast<int64_t>(f) * n_rows + r;
+      if (nulls[in]) {
+        nullmap[f >> 3] |= static_cast<uint8_t>(1u << (f & 7));
+        continue;
+      }
+      uint8_t *slot = slots + slot_offs[f];
+      switch (field_types[f]) {
+        case NBC_TYPE_BOOL:
+          slot[0] = vals_i64[in] ? 1 : 0;
+          break;
+        case NBC_TYPE_DOUBLE:
+          std::memcpy(slot, &vals_f64[in], 8);
+          break;
+        case NBC_TYPE_STRING: {
+          const int64_t so = str_off[in];
+          const uint32_t sl = str_len[in];
+          if (so < 0 || so + sl > str_blob_len) return -3;
+          const uint32_t vo = static_cast<uint32_t>(var_len);
+          std::memcpy(slot, &vo, 4);
+          std::memcpy(slot + 4, &sl, 4);
+          if (pos + fixed + var_len + sl > out_cap) return -2;
+          std::memcpy(row + fixed + var_len, str_blob + so, sl);
+          var_len += sl;
+          break;
+        }
+        default:  // INT / VID / TIMESTAMP
+          std::memcpy(slot, &vals_i64[in], 8);
+          break;
+      }
+    }
+    row_off[r] = pos;
+    row_len[r] = static_cast<int32_t>(fixed + var_len);
+    pos += fixed + var_len;
+  }
+  return pos;
+}
+
 extern "C" int64_t nbc_decode_batch(
     const uint8_t *field_types, int32_t n_fields, const uint8_t *rows_blob,
     int64_t blob_len, const int64_t *row_off, const int32_t *row_len,

@@ -104,14 +104,45 @@ def serve_graphd(meta_addr: str, host: str = "127.0.0.1", port: int = 0,
             def tpu_stats(params, body):
                 # the engine's serving counters + decline reasons +
                 # per-space budget fits, operator-visible like the
-                # reference's storage stats (ref WebService.h:31-49)
+                # reference's storage stats (ref WebService.h:31-49).
+                # `dispatcher` condenses the window-lifecycle counters
+                # (docs/manual/7-dispatcher.md): rounds, group mixing,
+                # early waiter releases, cross-group leader handoffs,
+                # per-request dispatcher wait, native row-encode use.
+                st = dict(tpu_engine.stats)
+                rounds = max(st.get("disp_rounds", 0), 1)
+                waits = max(st.get("group_wait_count", 0), 1)
                 return 200, {
-                    "stats": dict(tpu_engine.stats),
+                    "stats": st,
                     "agg_decline_reasons":
                         dict(tpu_engine.agg_decline_reasons),
+                    "path_decline_reasons":
+                        dict(tpu_engine.path_decline_reasons),
+                    "dispatcher": {
+                        "rounds": st.get("disp_rounds", 0),
+                        # avg distinct group keys VISIBLE at leader
+                        # election (served + still queued): each round
+                        # serves exactly one group, so > 1 here means
+                        # mixed-key load ran as concurrent rounds
+                        "groups_per_round": round(
+                            st.get("disp_group_keys", 0) / rounds, 2),
+                        "early_releases": st.get("early_releases", 0),
+                        "leader_handoffs": st.get("leader_handoffs", 0),
+                        "group_wait_us_avg": int(
+                            st.get("group_wait_us_total", 0) / waits),
+                        "group_wait_us_max":
+                            st.get("group_wait_us_max", 0),
+                        "native_encode_rows":
+                            st.get("native_encode_rows", 0),
+                        "encode_fallback_rows":
+                            st.get("encode_fallback_rows", 0),
+                    },
                     "sparse_budget_calibrations": {
                         str(k): v for k, v in
                         tpu_engine.sparse_budget_calibrations.items()},
+                    "batched_kernel_calibrations": {
+                        str(k): v for k, v in
+                        tpu_engine.batched_kernel_calibrations.items()},
                     "sparse_edge_budget": tpu_engine.sparse_edge_budget,
                 }
 

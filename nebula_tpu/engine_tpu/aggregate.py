@@ -71,6 +71,35 @@ def exact_int_sum(value, mask) -> int:
 MAX_GROUPED_SUM_ROWS = 1 << 23
 SUM_SEG = 1 << 23
 
+# COUNT / non-null-count scatter bins are int32 on device: a single
+# pass is exact while the flat slot count stays below 2^31 (each slot
+# contributes <= 1). Past that the count scatters run in COUNT_CHUNK
+# passes accumulated into host int64 — the same chunking discipline as
+# the digit sums, making grouped COUNT exact to ~2^63 rows.
+COUNT_CHUNK = 1 << 30
+
+
+def _scatter_count_i64(flat_mask, flat_g, n_groups: int) -> np.ndarray:
+    """Masked per-group count with int64 exactness: one int32 scatter
+    pass while every bin is provably < 2^31 (flat size < COUNT_CHUNK),
+    chunked int32 passes accumulated on the host beyond."""
+    import jax.numpy as jnp
+    n = int(flat_g.shape[0])
+    if n <= COUNT_CHUNK:
+        return np.asarray(
+            jnp.zeros(n_groups + 1, jnp.int32)
+            .at[flat_g].add(flat_mask.astype(jnp.int32))
+        )[:n_groups].astype(np.int64)
+    total = np.zeros(n_groups, np.int64)
+    for c in range(0, n, COUNT_CHUNK):
+        part = np.asarray(
+            jnp.zeros(n_groups + 1, jnp.int32)
+            .at[flat_g[c:c + COUNT_CHUNK]]
+            .add(flat_mask[c:c + COUNT_CHUNK].astype(jnp.int32))
+        )[:n_groups]
+        total += part
+    return total
+
 
 def grouped_reduce(specs: List[Tuple[str, Optional[object]]], active,
                    vals: dict, gidx, n_groups: int):
@@ -78,14 +107,16 @@ def grouped_reduce(specs: List[Tuple[str, Optional[object]]], active,
     GROUP BY $-._dst pushdown): one scatter-add per COUNT, four digit
     scatter-adds + a non-null count per SUM/AVG, scatter-min/max for
     MIN/MAX. Returns (sorted group slots np.int64, list of per-spec
-    numpy arrays aligned with the group list). SUM/AVG stay exact at
-    any scale (chunked digit partials past MAX_GROUPED_SUM_ROWS)."""
+    numpy arrays aligned with the group list). Exactness bounds:
+    SUM/AVG to ~2^55 rows (chunked digit partials past
+    MAX_GROUPED_SUM_ROWS, host int64 accumulation), COUNT and the
+    non-null counts to ~2^63 rows (int32 scatter passes of at most
+    COUNT_CHUNK slots each, host int64 accumulation) — neither
+    silently wraps at the old single-pass 2^31 bin bound."""
     import jax.numpy as jnp
     flat_g = gidx.reshape(-1)
     m = active.reshape(-1)
-    counts = jnp.zeros(n_groups + 1, jnp.int32).at[flat_g].add(
-        m.astype(jnp.int32))
-    counts_np = np.asarray(counts)[:n_groups]
+    counts_np = _scatter_count_i64(m, flat_g, n_groups)
     groups = np.nonzero(counts_np)[0]
     # every emitted value is a PYTHON int/float/None — np scalars would
     # break wire encoding (isinstance int check) and repr identity
@@ -98,8 +129,7 @@ def grouped_reduce(specs: List[Tuple[str, Optional[object]]], active,
         v = vals[key]
         if key not in cache:
             mk = (m & ~v.null.reshape(-1))
-            nn = np.asarray(jnp.zeros(n_groups + 1, jnp.int32)
-                            .at[flat_g].add(mk.astype(jnp.int32)))[:n_groups]
+            nn = _scatter_count_i64(mk, flat_g, n_groups)
             cache[key] = (mk, nn)
         mk, nonnull = cache[key]
         nn = nonnull[groups]

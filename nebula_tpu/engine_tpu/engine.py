@@ -42,7 +42,7 @@ from ..common.status import Status, StatusOr
 from ..filter.expressions import (Expression, InputPropExpr, VariablePropExpr)
 from ..parser import ast
 from ..storage.types import BoundResponse, EdgeData, PartResult, VertexData
-from . import traverse
+from . import materialize, traverse
 from .csr import CsrSnapshot
 from .filter_compile import FilterCompiler
 
@@ -57,11 +57,14 @@ class _BudgetExceeded(Exception):
 
 class _GoReq:
     """One session's plain GO parked at the cross-session dispatcher.
-    `done` flips exactly once, after `result`/`error` is written; the
-    owning thread re-reads it under the dispatcher condition var."""
+    `done` flips exactly once (via _mark_done, under the dispatcher
+    condition var), after `result`/`error` is written; the owning
+    thread re-reads it under the same condition var. `claimed` means a
+    group leader drained this request into its window — the owner
+    waits for `done` instead of trying to lead."""
     __slots__ = ("ctx", "s", "starts", "edge_types", "alias_map",
                  "name_by_type", "key", "yield_cols", "result", "error",
-                 "done")
+                 "done", "claimed", "t_enq")
 
     def __init__(self, ctx, s, starts, edge_types, alias_map,
                  name_by_type, key, yield_cols):
@@ -76,6 +79,8 @@ class _GoReq:
         self.result = None
         self.error = None
         self.done = False
+        self.claimed = False
+        self.t_enq = 0.0
 
 
 def _uses_input_refs(exprs: List[Expression]) -> bool:
@@ -106,15 +111,28 @@ class TpuGraphEngine:
         # must not interleave (rebuild swaps were immutable; deltas are
         # not)
         self._lock = threading.RLock()
+        # tiny leaf lock for counters bumped OUTSIDE the engine lock
+        # (pre-lock decline paths, off-lock window encode): dict-int
+        # += is read-add-store and loses increments under thread
+        # interleaving. Never held while acquiring any other lock.
+        self._stats_lock = threading.Lock()
         self._repacking: Dict[int, bool] = {}
         self._prewarming: Dict[int, bool] = {}
         self._prewarm_threads: Dict[int, threading.Thread] = {}
         # cross-session dispatcher (group commit): concurrent plain GOs
-        # queue here; one thread becomes leader per round and serves
-        # the whole window in one batched device program
+        # queue here; one thread becomes leader PER (space, steps,
+        # edge_types) GROUP and serves that group's window in one
+        # batched device program. Groups are independent rounds:
+        # `_disp_serving` maps each in-flight group key to its round
+        # owner, so an unrelated slow group neither delays nor is
+        # delayed by this one (group-complete scheduling), while
+        # same-key arrivals still pile up behind the in-flight round
+        # and coalesce into the next window (the group-commit batching
+        # pressure). `MAX_CONCURRENT_ROUNDS` bounds device/queue
+        # pressure from many distinct keys.
         self._disp_cv = threading.Condition()
         self._disp_queue: List["_GoReq"] = []
-        self._disp_active = False
+        self._disp_serving: Dict[Tuple, "_GoReq"] = {}
         # pull-mode budget: frontiers whose cumulative edge visits stay
         # under this run on host mirrors; larger ones amortize the dense
         # device dispatch (direction-optimized execution). The engine-
@@ -132,6 +150,12 @@ class TpuGraphEngine:
         # space -> calibration record (exposed via /get_stats as
         # tpu_engine.sparse_budget_fit samples)
         self.sparse_budget_calibrations: Dict[int, Dict[str, Any]] = {}
+        # space -> measured lane-vs-vmapped batched-kernel pick (the
+        # sparse-budget discipline applied to kernel CHOICE: the
+        # lane-matrix layout is TPU-optimal, but fallback backends can
+        # execute the vmapped variant several times faster — route
+        # windows by measurement, once per snapshot)
+        self.batched_kernel_calibrations: Dict[int, Dict[str, Any]] = {}
         self.stats = {"go_served": 0, "path_served": 0, "rebuilds": 0,
                       "fallbacks": 0, "sharded_queries": 0,
                       "fast_materialize": 0, "slow_materialize": 0,
@@ -141,7 +165,20 @@ class TpuGraphEngine:
                       "agg_served": 0, "agg_sparse_served": 0,
                       "agg_declined": 0, "batched_dispatches": 0,
                       "batched_queries": 0, "batched_max_window": 0,
-                      "batched_lane_rounds": 0}
+                      "batched_lane_rounds": 0,
+                      # dispatcher window lifecycle (docs/manual/
+                      # 7-dispatcher.md): per-group rounds, early
+                      # waiter releases, cross-group leader handoffs,
+                      # and the native batch row-encode counters
+                      "disp_rounds": 0, "disp_group_keys": 0,
+                      "early_releases": 0, "leader_handoffs": 0,
+                      "native_encode_rows": 0, "encode_fallback_rows": 0,
+                      "group_wait_us_total": 0, "group_wait_count": 0,
+                      "group_wait_us_max": 0, "path_declined": 0}
+        # why device path serving declined before lock/snapshot, by
+        # reason (mirrors agg_decline_reasons; /tpu_stats + /get_stats
+        # tpu_engine.path_declined.<reason>)
+        self.path_decline_reasons: Dict[str, int] = {}
         # why aggregate pushdown declined, by reason (round-4 verdict:
         # the decline path was invisible — 0/3 bench queries served
         # with no stat saying why); mirrored into the global stats
@@ -171,9 +208,14 @@ class TpuGraphEngine:
 
     @sparse_edge_budget.setter
     def sparse_edge_budget(self, v: int) -> None:
-        self._sparse_edge_budget = int(v)
-        self._budget_pinned = True
-        self._space_budgets.clear()
+        # under the engine lock so a pin can't interleave with an
+        # auto-calibration install (calibrate_sparse_budget checks
+        # _budget_pinned and installs under the same lock): an
+        # explicit pin always wins, whatever the ordering
+        with self._lock:
+            self._sparse_edge_budget = int(v)
+            self._budget_pinned = True
+            self._space_budgets.clear()
 
     # ------------------------------------------------------------------
     # observability
@@ -598,7 +640,39 @@ class TpuGraphEngine:
         return True
 
     def can_serve_path(self, space_id: int, s: ast.FindPathSentence) -> bool:
-        return bool(self.enabled and self._provider is not None)
+        """Structural routing for FIND PATH, decided BEFORE the engine
+        lock and snapshot are taken (mirroring the aggregation
+        pre-checks): a query the device path would decline anyway must
+        cost schema-free checks only, not a lock + snapshot check +
+        discarded walk. Every decline is counted by reason
+        (`path_decline_reasons`; /tpu_stats + /get_stats
+        tpu_engine.path_declined.<reason>)."""
+        if not (self.enabled and self._provider is not None):
+            return False
+        if not s.shortest:
+            # ALL/NOLOOP paths: the per-level device adjacency serves
+            # the unsharded bounded form only (_find_all_paths). A
+            # single-device mesh never shards, so only a real multi-
+            # device mesh declines here; per-space sharding (parts not
+            # dividing the mesh) is snapshot-dependent and stays with
+            # the in-lock check (all_paths_sharded_snapshot).
+            if self.mesh is not None and self.mesh.devices.size > 1:
+                return self._path_decline("all_paths_meshed")
+            if not 1 <= int(s.step.steps) <= self.MAX_DEVICE_STEPS:
+                return self._path_decline("all_paths_steps_out_of_range")
+        return True
+
+    def _path_decline(self, reason: str) -> bool:
+        """Count one FIND PATH device-path decline (engine stats +
+        /get_stats) and return False so the CPU path serves — without
+        a snapshot ever being touched. Runs pre-lock on concurrent
+        session threads, hence the stats lock."""
+        with self._stats_lock:
+            self.stats["path_declined"] += 1
+            self.path_decline_reasons[reason] = \
+                self.path_decline_reasons.get(reason, 0) + 1
+        global_stats.add_value("tpu_engine.path_declined." + reason)
+        return False
 
     # ------------------------------------------------------------------
     # GO on device
@@ -628,9 +702,10 @@ class TpuGraphEngine:
                                            alias_map, name_by_type, ex,
                                            yield_cols)
         with self._lock:   # delta applies mutate host mirrors in place
-            return self._execute_go_locked(ctx, s, starts, edge_types,
-                                           alias_map, name_by_type, ex,
-                                           yield_cols)
+            r = self._execute_go_locked(ctx, s, starts, edge_types,
+                                        alias_map, name_by_type, ex,
+                                        yield_cols)
+        return self._finalize_result(r)
 
     MAX_ROOTS_ON_DEVICE = 64   # per-root frontier memory bound
     MAX_DEVICE_STEPS = 16      # per-step mask stacks are [N, P, cap_e]:
@@ -642,6 +717,13 @@ class TpuGraphEngine:
                                # width — one full TPU lane row); the
                                # per-round memory cap still governs on
                                # big graphs (_dispatch_cap)
+    MAX_CONCURRENT_ROUNDS = 4  # distinct (space, steps, edge_types)
+                               # groups served at once: group-complete
+                               # scheduling runs unrelated groups as
+                               # independent rounds; this bounds the
+                               # device/queue pressure when many keys
+                               # mix (excess keys wait FIFO-ish on the
+                               # dispatcher cv)
     SMALL_BUCKET = 8           # small-window pad size (see _serve_group)
     # per-root edge cap for the calibration walk probe — bounds the
     # engine-lock hold time on huge graphs (rate, not completion)
@@ -653,76 +735,166 @@ class TpuGraphEngine:
     # concurrency the engine lock + GIL serialize per-query device
     # dispatches — PARITY.md's sweep measured aggregate QPS flat at
     # ~630 from N=2. Group commit fixes the device half: whichever
-    # thread finds no round in flight becomes LEADER, drains the
-    # queue, and serves every compatible query in ONE [N, P, cap_v]
-    # batched program (multi_hop_roots — the hop kernel reads the edge
-    # block once per hop no matter how many frontiers ride along, the
-    # reference's bucket idiom, QueryBaseProcessor.inl:460-513).
-    # Arrivals during a round queue up for the next one — natural
-    # batching under load, zero added latency when idle.
+    # thread finds its (space, steps, edge_types) GROUP idle becomes
+    # that group's LEADER, drains every queued same-key request, and
+    # serves the whole window in ONE [N, P, cap_v] batched program
+    # (multi_hop_roots — the hop kernel reads the edge block once per
+    # hop no matter how many frontiers ride along, the reference's
+    # bucket idiom, QueryBaseProcessor.inl:460-513). Same-key arrivals
+    # during a round queue up for the next one — natural batching
+    # under load, zero added latency when idle. UNRELATED keys elect
+    # their own leaders concurrently (group-complete scheduling), so
+    # no waiter's wall time is bounded by a slow group it doesn't
+    # belong to; waiters wake the moment their own group's results
+    # land, not at end-of-round (docs/manual/7-dispatcher.md).
     # ------------------------------------------------------------------
     def _go_via_dispatcher(self, ctx, s, starts, edge_types, alias_map,
                            name_by_type, ex, yield_cols):
         req = _GoReq(ctx, s, starts, edge_types, alias_map, name_by_type,
                      (ctx.space_id(), int(s.step.steps),
                       tuple(edge_types)), yield_cols)
+        req.t_enq = time.monotonic()
         with self._disp_cv:
             self._disp_queue.append(req)
+        batch = None
         while True:
-            batch = None
             with self._disp_cv:
-                while not req.done and self._disp_active:
+                while not req.done and (
+                        req.claimed
+                        or req.key in self._disp_serving
+                        or len(self._disp_serving)
+                        >= self.MAX_CONCURRENT_ROUNDS):
                     self._disp_cv.wait()
                 if req.done:
                     break
-                self._disp_active = True
-                batch = self._disp_queue[:self.MAX_DISPATCH_BATCH]
-                del self._disp_queue[:self.MAX_DISPATCH_BATCH]
+                # leader election for THIS key only: claim every queued
+                # same-key request (the window); other keys' requests
+                # stay queued for their own leaders
+                if self._disp_serving:
+                    self.stats["leader_handoffs"] += 1
+                batch = [r for r in self._disp_queue
+                         if r.key == req.key][:self.MAX_DISPATCH_BATCH]
+                taken = set(map(id, batch))
+                self._disp_queue = [r for r in self._disp_queue
+                                    if id(r) not in taken]
+                for r in batch:
+                    r.claimed = True
+                self._disp_serving[req.key] = batch[0]
+                self.stats["disp_rounds"] += 1
+                self.stats["disp_group_keys"] += 1 + len(
+                    {r.key for r in self._disp_queue
+                     if r.key != req.key})
             try:
                 self._serve_batch(batch, ex)
             finally:
-                with self._disp_cv:
-                    self._disp_active = False
-                    self._disp_cv.notify_all()
+                self._release_round(req.key, batch[0])
             if req.done:
                 break
         if req.error is not None:
             raise req.error
-        return req.result
+        return self._finalize_result(req.result)
+
+    def _release_round(self, key, owner: "_GoReq") -> None:
+        """End (or early-end) a group round: idempotent per owner, so
+        the leader can hand the key back right after the window's last
+        device launch — window N+1's leader then overlaps its dispatch
+        with window N's materialization — and the round's `finally`
+        stays a no-op."""
+        with self._disp_cv:
+            if self._disp_serving.get(key) is owner:
+                del self._disp_serving[key]
+                self._disp_cv.notify_all()
+
+    def _mark_done(self, reqs: List["_GoReq"], early: bool = False) -> None:
+        """Flip `done` and wake the owners NOW — waiters wake on their
+        own group's completion, never on an unrelated round's end.
+        `early` counts waiters released before their round fully
+        retired (sparse fast-outs, non-final chunks)."""
+        now = time.monotonic()
+        with self._disp_cv:
+            for r in reqs:
+                if r.done:
+                    continue
+                r.done = True
+                w = int((now - r.t_enq) * 1e6)
+                self.stats["group_wait_us_total"] += w
+                self.stats["group_wait_count"] += 1
+                if w > self.stats["group_wait_us_max"]:
+                    self.stats["group_wait_us_max"] = w
+                if early:
+                    self.stats["early_releases"] += 1
+            self._disp_cv.notify_all()
+
+    def _finalize_result(self, r):
+        """Box a deferred (window-encoded) result into Python tuples in
+        the OWNING session's thread — outside the dispatcher round and
+        outside the engine lock (materialize.EncodedRows)."""
+        if r is None:
+            return None
+        try:
+            if not r.ok():
+                return r
+        except AttributeError:
+            return r
+        v = r.value()
+        enc = getattr(v, "_tpu_deferred", None)
+        if enc is not None:
+            v.rows = enc.to_rows()
+            v._tpu_deferred = None
+        return r
+
+    def _count_encode(self, n_rows: int, native_used: bool) -> None:
+        # the window-level encode runs off the engine lock, where
+        # concurrent rounds would race the increment
+        with self._stats_lock:
+            if native_used:
+                self.stats["native_encode_rows"] += n_rows
+            else:
+                self.stats["encode_fallback_rows"] += n_rows
 
     def _serve_batch(self, batch: List["_GoReq"], ex) -> None:
-        """One dispatcher round: group by (space, steps, edge types)
-        and serve each group; a request that fails individually
-        carries its own error back to its session."""
+        """One group's dispatcher round (every request shares one
+        (space, steps, edge types) key); a request that fails
+        individually carries its own error back to its session."""
         if len(batch) > 1:
             self.stats["batched_max_window"] = max(
                 self.stats["batched_max_window"], len(batch))
-        groups: Dict[Tuple, List[_GoReq]] = {}
-        for r in batch:
-            groups.setdefault(r.key, []).append(r)
-        for group in groups.values():
-            try:
-                self._serve_group(group, ex)
-            except Exception as e:   # defensive: never strand a waiter
-                for r in group:
-                    if not r.done:
-                        r.error = e
-                        r.done = True
+        try:
+            self._serve_group(batch, ex)
+        except Exception as e:   # defensive: never strand a waiter
+            for r in batch:
+                if not r.done:
+                    r.error = e
+            self._mark_done(batch)
 
     def _serve_group(self, group: List["_GoReq"], ex) -> None:
+        """Serve one group window in three phases: (1) snapshot +
+        per-query routing + device launch under the engine lock, (2)
+        device wait OFF the lock — after the window's last launch the
+        round is released early, so the NEXT window's leader overlaps
+        its dispatch with this window's materialization, (3)
+        materialize under the lock (host mirrors are delta-mutable),
+        with the whole window's deferred rows encoded in ONE native
+        GIL-released call off-lock at the end. A delta apply landing
+        between phases bumps snap.write_version; affected requests
+        redo through the single-query path."""
         import jax.numpy as jnp
-        with self._lock:
-            if len(group) == 1:
-                r = group[0]
-                try:
+        owner = group[0]
+        multi = len(group) > 1
+        if not multi:
+            r = group[0]
+            try:
+                with self._lock:
                     r.result = self._execute_go_locked(
                         r.ctx, r.s, r.starts, r.edge_types, r.alias_map,
                         r.name_by_type, ex, r.yield_cols)
-                except Exception as e:
-                    r.error = e
-                r.done = True
-                return
-            space_id, steps, etypes = group[0].key
+            except Exception as e:
+                r.error = e
+            self._mark_done([r])
+            return
+        space_id, steps, etypes = group[0].key
+        dense: List[Tuple[_GoReq, np.ndarray, list, list]] = []
+        with self._lock:
             t0 = time.monotonic()
             snap = self._snapshot_locked(space_id)
             t_snap = time.monotonic() - t0
@@ -736,12 +908,15 @@ class TpuGraphEngine:
                             r.alias_map, r.name_by_type, ex, r.yield_cols)
                     except Exception as e:
                         r.error = e
-                    r.done = True
+                    self._mark_done([r])
                 return
+            v0 = snap.write_version
             # per-query routing first, identical to the single path:
             # small frontiers serve from the host pull; only the ones
-            # that exceed the budget ride the shared dense dispatch
-            dense: List[Tuple[_GoReq, np.ndarray, list, list]] = []
+            # that exceed the budget ride the shared dense dispatch.
+            # Sparse-served waiters are released IMMEDIATELY — they box
+            # their deferred rows in their own threads while the leader
+            # is still driving the dense half.
             for r in group:
                 try:
                     yield_cols = r.yield_cols
@@ -749,7 +924,7 @@ class TpuGraphEngine:
                     frontier0 = snap.frontier_from_vids(r.starts)
                     if not frontier0.any():
                         r.result = StatusOr.of(ex.InterimResult(columns))
-                        r.done = True
+                        self._mark_done([r], early=True)
                         continue
                     t1 = time.monotonic()
                     sparse = self._sparse_expand(snap, r.starts,
@@ -760,91 +935,184 @@ class TpuGraphEngine:
                             r.ctx, r.s, snap, sparse, yield_cols, columns,
                             r.alias_map, r.name_by_type, ex, r.edge_types,
                             t_snap, t_walk)
-                        r.done = True
+                        self._mark_done([r], early=True)
                         continue
                     dense.append((r, frontier0, yield_cols, columns))
                 except Exception as e:
                     r.error = e
-                    r.done = True
+                    self._mark_done([r], early=True)
             if not dense:
                 return
             use_delta = snap.delta is not None and snap.delta.edge_count > 0
             cap = self._dispatch_cap(snap)
             req_arr = jnp.asarray(traverse.pad_edge_types(list(etypes)))
-            # one device-filter compile per DISTINCT WHERE per round:
-            # the common group-commit case is N identical queries, and
-            # the compiled edge mask depends only on the filter + the
-            # shared snapshot/types, not on the query's roots (review
-            # finding, round 5)
-            from ..filter.expressions import encode_expression
-            filter_cache: Dict[Any, Tuple] = {}
+        # one device-filter compile per DISTINCT WHERE per round:
+        # the common group-commit case is N identical queries, and
+        # the compiled edge mask depends only on the filter + the
+        # shared snapshot/types, not on the query's roots (review
+        # finding, round 5). Compiles run lazily UNDER the lock in
+        # phase 3 (FilterCompiler reads host mirrors).
+        from ..filter.expressions import encode_expression
+        filter_cache: Dict[Any, Tuple] = {}
 
-            def plan_filter_cached(r):
-                if r.s.where is None:
-                    key = (None, ())
-                else:
-                    key = (encode_expression(r.s.where.filter),
-                           tuple(sorted(r.alias_map.items())))
-                if key not in filter_cache:
-                    filter_cache[key] = self._plan_filter(
-                        r.ctx, r.s, snap, use_delta, r.name_by_type,
-                        r.alias_map, r.edge_types)
-                return filter_cache[key]
-            for c0 in range(0, len(dense), cap):
-                chunk = dense[c0:c0 + cap]
-                aligned = snap.aligned_ready() if not use_delta and \
-                    steps >= 1 and len(chunk) > 1 else None
-                # pad the root axis so XLA compiles FEW shapes, never
-                # past the memory-derived cap (the 1GiB mask budget
-                # must hold for the PADDED batch too); zero frontiers
-                # produce empty masks and carry no request.
-                # - lane path: exactly TWO buckets (small, cap) — both
-                #   precompiled by prewarm, so no cold compile ever
-                #   lands inside a round;
-                # - delta/vmapped rounds: power-of-two buckets (delta
-                #   device shapes vary with the buffer, so those
-                #   programs can't be precompiled — smaller pads keep
-                #   each first-seen compile cheap).
-                if aligned is not None:
-                    bucket = min(self.SMALL_BUCKET, cap) \
-                        if len(chunk) <= self.SMALL_BUCKET else cap
-                else:
-                    bucket = 1
-                    while bucket < len(chunk):
-                        bucket *= 2
-                    bucket = min(bucket, cap)
-                stack = [f for _, f, _, _ in chunk]
-                if bucket > len(chunk):
-                    stack.extend([np.zeros_like(stack[0])]
-                                 * (bucket - len(chunk)))
-                f0s = jnp.asarray(np.stack(stack))
-                t1 = time.monotonic()
-                if use_delta:
-                    masks, dmasks = traverse.multi_hop_roots_delta(
-                        f0s, jnp.int32(steps), snap.kernel,
-                        snap.delta.device(), req_arr)
-                    dmasks_np = np.asarray(dmasks)
-                elif aligned is not None:
-                    # lane-matrix batched kernel: the edge/index
-                    # streams are read once per hop for the WHOLE
-                    # window (the vmapped fallback only shares them on
-                    # backends that vectorize the batch dim)
-                    ak, a_chunk, a_group = aligned
-                    masks = traverse.multi_hop_masks_batch(
-                        f0s, jnp.int32(steps), ak, snap.kernel,
-                        req_arr, chunk=a_chunk, group=a_group)
-                    dmasks_np = None
-                    self.stats["batched_lane_rounds"] += 1
-                else:
-                    masks = traverse.multi_hop_roots(
-                        f0s, jnp.int32(steps), snap.kernel, req_arr)
-                    dmasks_np = None
-                masks_np = np.asarray(masks)
-                t_kernel = time.monotonic() - t1
+        def plan_filter_cached(r):
+            if r.s.where is None:
+                key = (None, ())
+            else:
+                key = (encode_expression(r.s.where.filter),
+                       tuple(sorted(r.alias_map.items())))
+            if key not in filter_cache:
+                filter_cache[key] = self._plan_filter(
+                    r.ctx, r.s, snap, use_delta, r.name_by_type,
+                    r.alias_map, r.edge_types)
+            return filter_cache[key]
+        n_chunks = (len(dense) + cap - 1) // cap
+        self._serve_dense_chunks(dense, cap, n_chunks, snap, v0,
+                                 steps, use_delta, req_arr, owner,
+                                 plan_filter_cached, ex, t_snap)
+
+    def _serve_dense_chunks(self, dense, cap, n_chunks, snap, v0, steps,
+                            use_delta, req_arr, owner,
+                            plan_filter_cached, ex, t_snap) -> None:
+        import jax.numpy as jnp
+        # OWNER-scoped kernel-calibration claim: only the round that
+        # set "calibrating" may reset it (a concurrent round for
+        # another key shares the snapshot object and must not wipe an
+        # in-flight claim); reset covers every bail-out path — launch/
+        # fetch error, stale redo — so a later window retries
+        claimed = [False]
+        try:
+            self._serve_chunk_loop(dense, cap, n_chunks, snap, v0,
+                                   steps, use_delta, req_arr, owner,
+                                   plan_filter_cached, ex, t_snap,
+                                   claimed)
+        finally:
+            if claimed[0] and getattr(snap, "batched_kernel_pick",
+                                      None) == "calibrating":
+                snap.batched_kernel_pick = None
+
+    def _serve_chunk_loop(self, dense, cap, n_chunks, snap, v0, steps,
+                          use_delta, req_arr, owner, plan_filter_cached,
+                          ex, t_snap, claimed) -> None:
+        import jax.numpy as jnp
+        for ci, c0 in enumerate(range(0, len(dense), cap)):
+            chunk = dense[c0:c0 + cap]
+            last_chunk = ci == n_chunks - 1
+            with self._lock:
+                redo = snap.stale or snap.write_version != v0
+                if not redo:
+                    aligned = snap.aligned_ready() if not use_delta and \
+                        steps >= 1 and len(chunk) > 1 else None
+                    if aligned is not None and \
+                            getattr(snap, "batched_kernel_pick",
+                                    None) == "vmap":
+                        # measured on THIS backend: the vmapped batch
+                        # beats the lane-matrix layout — skip it
+                        aligned = None
+                    # pad the root axis so XLA compiles FEW shapes,
+                    # never past the memory-derived cap (the 1GiB mask
+                    # budget must hold for the PADDED batch too); zero
+                    # frontiers produce empty masks and carry no
+                    # request.
+                    # - lane path: exactly TWO buckets (small, cap) —
+                    #   both precompiled by prewarm, so no cold compile
+                    #   ever lands inside a round;
+                    # - delta/vmapped rounds: power-of-two buckets
+                    #   (delta device shapes vary with the buffer, so
+                    #   those programs can't be precompiled — smaller
+                    #   pads keep each first-seen compile cheap).
+                    if aligned is not None:
+                        bucket = min(self.SMALL_BUCKET, cap) \
+                            if len(chunk) <= self.SMALL_BUCKET else cap
+                    else:
+                        bucket = 1
+                        while bucket < len(chunk):
+                            bucket *= 2
+                        bucket = min(bucket, cap)
+                    stack = [f for _, f, _, _ in chunk]
+                    if bucket > len(chunk):
+                        stack.extend([np.zeros_like(stack[0])]
+                                     * (bucket - len(chunk)))
+                    f0s = jnp.asarray(np.stack(stack))
+                    kernel_cal = None
+                    t1 = time.monotonic()
+                    if use_delta:
+                        masks, dmasks = traverse.multi_hop_roots_delta(
+                            f0s, jnp.int32(steps), snap.kernel,
+                            snap.delta.device(), req_arr)
+                    elif aligned is not None:
+                        # lane-matrix batched kernel: the edge/index
+                        # streams are read once per hop for the WHOLE
+                        # window (the vmapped fallback only shares them
+                        # on backends that vectorize the batch dim)
+                        ak, a_chunk, a_group = aligned
+                        if getattr(snap, "batched_kernel_pick",
+                                   None) is None:
+                            # claim the one-shot lane-vs-vmapped
+                            # calibration; the timing itself runs OFF
+                            # the lock in phase 2 (kernel buffers are
+                            # immutable device arrays)
+                            snap.batched_kernel_pick = "calibrating"
+                            claimed[0] = True
+                            kernel_cal = (ak, a_chunk, a_group)
+                        masks = traverse.multi_hop_masks_batch(
+                            f0s, jnp.int32(steps), ak, snap.kernel,
+                            req_arr, chunk=a_chunk, group=a_group)
+                        self.stats["batched_lane_rounds"] += 1
+                        dmasks = None
+                    else:
+                        masks = traverse.multi_hop_roots(
+                            f0s, jnp.int32(steps), snap.kernel, req_arr)
+                        dmasks = None
+            if redo:
+                # snapshot moved under the round (delta apply /
+                # poison): each request re-serves through the exact
+                # single-query path, which re-snapshots
+                for r, _f0, _yc, _cols in chunk:
+                    try:
+                        with self._lock:
+                            r.result = self._execute_go_locked(
+                                r.ctx, r.s, r.starts, r.edge_types,
+                                r.alias_map, r.name_by_type, ex,
+                                r.yield_cols)
+                    except Exception as e:
+                        r.error = e
+                self._mark_done([r for r, *_ in chunk],
+                                early=not last_chunk)
+                continue
+            if last_chunk:
+                # the window's device work is all launched: hand the
+                # key back NOW so window N+1's leader can claim and
+                # launch while we wait for masks + materialize
+                self._release_round(owner.key, owner)
+            # device wait OFF the engine lock (jax releases the GIL):
+            # another group's round — or the next window of this key —
+            # runs its host phases meanwhile
+            masks_np = np.asarray(masks)
+            dmasks_np = None if dmasks is None else np.asarray(dmasks)
+            t_kernel = time.monotonic() - t1
+            if kernel_cal is not None:
+                # one-shot lane-vs-vmapped timing, also OFF the lock —
+                # the extra dispatches never stall the engine, only
+                # this first window's own materialization start
+                self._calibrate_batched_kernel(snap, f0s, steps,
+                                               *kernel_cal, req_arr)
+                claimed[0] = False   # resolved (or reset) by the call
+            sink: List[Tuple] = []
+            with self._lock:
+                # counters under the lock: concurrent rounds would
+                # otherwise race the read-add-store (lost increments)
                 self.stats["batched_dispatches"] += 1
                 self.stats["batched_queries"] += len(chunk)
+                stale2 = snap.stale or snap.write_version != v0
                 for i, (r, _f0, yield_cols, columns) in enumerate(chunk):
                     try:
+                        if stale2:
+                            r.result = self._execute_go_locked(
+                                r.ctx, r.s, r.starts, r.edge_types,
+                                r.alias_map, r.name_by_type, ex,
+                                r.yield_cols)
+                            continue
                         device_mask, local_filter = plan_filter_cached(r)
                         mask = masks_np[i]
                         if device_mask is not None:
@@ -855,10 +1123,75 @@ class TpuGraphEngine:
                             r.ctx, r.s, snap, mask, d_mask, local_filter,
                             yield_cols, columns, r.alias_map,
                             r.name_by_type, ex, r.edge_types, t_snap,
-                            t_kernel)
+                            t_kernel, sink=sink, sink_req=r)
                     except Exception as e:
                         r.error = e
-                    r.done = True
+            if sink:
+                # the whole window's deferred rows in ONE native
+                # GIL-released batch encode, off the engine lock;
+                # waiters box their own tuples after wakeup
+                try:
+                    encs, native_used = materialize.encode_window(
+                        [g for (_r, g, _t) in sink])
+                    self._count_encode(sum(len(e) for e in encs),
+                                       native_used)
+                    for (r, _g, _t2), enc in zip(sink, encs):
+                        r.result.value()._tpu_deferred = enc
+                except Exception as e:   # never a silent empty result
+                    for r, _g, _t2 in sink:
+                        r.result = None
+                        r.error = e
+            self._mark_done([r for r, *_ in chunk], early=not last_chunk)
+
+    def _calibrate_batched_kernel(self, snap, f0s, steps, ak, a_chunk,
+                                  a_group, req_arr):
+        """Measured lane-vs-vmapped routing for batched windows, once
+        per snapshot: the lane-matrix kernel is the layout the TPU
+        wants (edge/index streams read once per hop for the whole
+        window), but fallback backends execute the plain vmapped batch
+        several times faster — XLA:CPU measures ~5x on the SNB bench
+        shape. Modeled preferences go stale; this is the
+        calibrate_sparse_budget discipline applied to kernel choice.
+
+        Runs OFF the engine lock (kernel buffers are immutable device
+        arrays) on the first window's live frontiers, compile excluded
+        from timing. The caller already dispatched + fetched the lane
+        variant for the round itself, so this only pays the timing
+        re-runs; a failure resets the claim so a later window retries."""
+        import jax.numpy as jnp
+        s32 = jnp.int32(steps)
+        try:
+            def lane():
+                return traverse.multi_hop_masks_batch(
+                    f0s, s32, ak, snap.kernel, req_arr, chunk=a_chunk,
+                    group=a_group)
+
+            def vmap():
+                return traverse.multi_hop_roots(f0s, s32, snap.kernel,
+                                                req_arr)
+
+            vmap().block_until_ready()   # compile outside timing (the
+            t0 = time.monotonic()        # lane variant just served)
+            lane().block_until_ready()
+            lane_s = time.monotonic() - t0
+            t0 = time.monotonic()
+            vmap().block_until_ready()
+            vmap_s = time.monotonic() - t0
+        except Exception:
+            # never fail the window over a calibration probe: keep the
+            # lane default and let a later window retry
+            snap.batched_kernel_pick = None
+            _LOG.exception("batched kernel calibration failed "
+                           "(space %d)", snap.space_id)
+            return
+        pick = "lane" if lane_s <= vmap_s else "vmap"
+        snap.batched_kernel_pick = pick
+        rec = {"lane_ms": round(lane_s * 1e3, 1),
+               "vmap_ms": round(vmap_s * 1e3, 1), "pick": pick}
+        self.batched_kernel_calibrations[snap.space_id] = rec
+        global_stats.add_value("tpu_engine.batched_kernel_pick_" + pick)
+        _LOG.info("batched kernel calibrated (space %d): %s",
+                  snap.space_id, rec)
 
     def _execute_go_locked(self, ctx, s, starts, edge_types, alias_map,
                            name_by_type, ex, yield_cols=None):
@@ -944,11 +1277,20 @@ class TpuGraphEngine:
 
     def _go_emit_dense(self, ctx, s, snap, mask, d_mask, local_filter,
                        yield_cols, columns, alias_map, name_by_type, ex,
-                       edge_types, t_snap, t_kernel):
+                       edge_types, t_snap, t_kernel, sink=None,
+                       sink_req=None):
         """Materialize one dense GO result from its final-hop numpy
         masks — the tail shared by the single-query path and the
         cross-session batched dispatcher (each batch member lands here
-        with its own slice of the shared device dispatch)."""
+        with its own slice of the shared device dispatch).
+
+        Deferred fast path: when every YIELD column has a typed form
+        and no delta rows / per-row filter / DISTINCT are in play, the
+        result rows stay COLUMNS here — encoded to row bytes by one
+        native GIL-released call (materialize.encode_window) and boxed
+        into Python tuples only in the owning session's thread
+        (_finalize_result). With `sink` the typed gather is appended
+        for the WINDOW-level encode instead of encoding per query."""
         t2 = time.monotonic()
         # the device compile may have been declined (e.g. delta edges in
         # play, _plan_filter): still avoid the per-row Python walk over
@@ -958,12 +1300,34 @@ class TpuGraphEngine:
         idx_per_part = None
         if host_hf is not None:
             idx_per_part = self._apply_host_filter(host_hf, snap, mask)
+        d_any = d_mask is not None and d_mask.any()
+        if local_filter is None and not d_any \
+                and not (s.yield_ and s.yield_.distinct):
+            gathered = materialize.gather_for_encode(
+                ctx.sm, ctx.space_id(), snap, mask, yield_cols,
+                alias_map, name_by_type, idx_per_part=idx_per_part)
+            if gathered is not None:
+                result = ex.InterimResult(columns)
+                if sink is not None:
+                    # _tpu_deferred is attached by the window-level
+                    # encode in _serve_group (an encode failure errors
+                    # the request — never a silent empty result)
+                    sink.append((sink_req, gathered, t2))
+                else:
+                    encs, native_used = materialize.encode_window(
+                        [gathered])
+                    self._count_encode(len(encs[0]), native_used)
+                    result._tpu_deferred = encs[0]
+                self.stats["fast_materialize"] += 1
+                self.stats["go_served"] += 1
+                self._record_profile("dense", t_snap, t_kernel,
+                                     time.monotonic() - t2, snap)
+                return StatusOr.of(result)
         rows: Optional[List[Tuple]] = None
         if local_filter is None:
             # columnar fast path: one numpy gather per YIELD column over
             # the host mirrors; declines (None) on any case whose CPU
             # semantics aren't a pure gather — identity by construction
-            from . import materialize
             rows = materialize.emit_rows(snap, mask, ctx, yield_cols,
                                          alias_map, name_by_type,
                                          idx_per_part=idx_per_part)
@@ -1087,10 +1451,13 @@ class TpuGraphEngine:
 
     def _agg_decline(self, reason: str):
         """Count one aggregation-pushdown decline (engine stats +
-        /get_stats) and return None so the CPU pipe serves."""
-        self.stats["agg_declined"] += 1
-        self.agg_decline_reasons[reason] = \
-            self.agg_decline_reasons.get(reason, 0) + 1
+        /get_stats) and return None so the CPU pipe serves. The
+        structural pre-checks call this before the engine lock, hence
+        the stats lock."""
+        with self._stats_lock:
+            self.stats["agg_declined"] += 1
+            self.agg_decline_reasons[reason] = \
+                self.agg_decline_reasons.get(reason, 0) + 1
         global_stats.add_value("tpu_engine.agg_declined." + reason)
         return None
 
@@ -1766,10 +2133,15 @@ class TpuGraphEngine:
             return None
         rate = visited / walk_s
         fitted = max(1 << 14, int(dense_s * rate * 0.8))
-        if auto and self._budget_pinned:
-            return None   # pinned mid-probe: never override
-        self._sparse_edge_budget = fitted   # not the property: no pin
-        self._space_budgets[space_id] = fitted
+        # pin check + install are ONE critical section (and the
+        # sparse_edge_budget setter takes the same lock): a pin landing
+        # mid-probe can no longer be overridden by the install racing
+        # between the check and the assignments
+        with self._lock:
+            if auto and self._budget_pinned:
+                return None   # pinned mid-probe: never override
+            self._sparse_edge_budget = fitted   # not the property: no pin
+            self._space_budgets[space_id] = fitted
         rec = {"dense_dispatch_ms": round(dense_s * 1e3, 2),
                "sparse_edges_per_sec": int(rate),
                "probe_roots": len(roots), "probe_edges": int(visited),
@@ -1859,7 +2231,6 @@ class TpuGraphEngine:
     def _emit_sparse(self, ctx, s, snap, sparse, yield_cols, columns,
                      alias_map, name_by_type, ex, edge_types,
                      t_snap=0.0, t_kernel=0.0):
-        from . import materialize
         t2 = time.monotonic()
         act_idx, d_act = sparse
         local_filter = s.where.filter if s.where is not None else None
@@ -1867,6 +2238,25 @@ class TpuGraphEngine:
             ctx, snap, local_filter, name_by_type, alias_map, edge_types)
         if host_hf is not None and act_idx:
             act_idx = self._apply_host_filter_idx(host_hf, act_idx)
+        if local_filter is None and not d_act \
+                and not (s.yield_ and s.yield_.distinct):
+            # deferred fast path (see _go_emit_dense): typed columns +
+            # one native GIL-released encode; the owning session boxes
+            # tuples after wakeup, outside the lock and the dispatcher
+            gathered = materialize.gather_for_encode(
+                ctx.sm, ctx.space_id(), snap, None, yield_cols,
+                alias_map, name_by_type, idx_per_part=act_idx)
+            if gathered is not None:
+                encs, native_used = materialize.encode_window([gathered])
+                self._count_encode(len(encs[0]), native_used)
+                result = ex.InterimResult(columns)
+                result._tpu_deferred = encs[0]
+                self.stats["fast_materialize"] += 1
+                self.stats["go_served"] += 1
+                self.stats["sparse_served"] += 1
+                self._record_profile("sparse", t_snap, t_kernel,
+                                     time.monotonic() - t2, snap)
+                return StatusOr.of(result)
         rows: Optional[List[Tuple]] = None
         needs_dst = _needs_dst(yield_cols, s)
         if local_filter is None:
@@ -1980,9 +2370,12 @@ class TpuGraphEngine:
     def _find_all_paths(self, ctx, s, sources, targets, edge_types,
                         name_by_type, snap, ex):
         if getattr(snap, "sharded_kernel", None) is not None:
-            return None   # mesh-sharded kernels serve shortest only
+            # snapshot-dependent (can_serve_path can't see sharding):
+            # mesh-sharded kernels serve shortest only
+            self._path_decline("all_paths_sharded_snapshot")
+            return None
         if not 1 <= int(s.step.steps) <= self.MAX_DEVICE_STEPS:
-            return None   # 0 steps / huge N: bounded CPU loop serves
+            return None   # pre-checked by can_serve_path; defense only
         import jax.numpy as jnp
         upto = int(s.step.steps)
         f0 = jnp.asarray(snap.frontier_from_vids(sources))
@@ -2213,6 +2606,7 @@ class TpuGraphEngine:
                           name_by_type: Dict[int, str]):
         from ..graph import executors as ex
         if len(edge_types) > traverse.MAX_EDGE_TYPES_PER_QUERY:
+            self._path_decline("too_many_edge_types")
             return None
         with self._lock:   # delta applies mutate host mirrors in place
             return self._execute_find_path_locked(ctx, s, sources, targets,

@@ -17,6 +17,7 @@ path can only produce rows the slow path would have produced.
 """
 from __future__ import annotations
 
+import struct
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -26,6 +27,10 @@ from ..filter.expressions import (DestPropExpr, EdgeDstIdExpr, EdgePropExpr,
                                   Literal, SourcePropExpr)
 
 DEFAULT_MAX_EDGES_PER_VERTEX = 10000
+
+# PropType wire values (codec/schema.py) — materialize avoids importing
+# the enum in the hot path
+_PT_BOOL, _PT_INT, _PT_DOUBLE, _PT_STRING = 1, 2, 5, 6
 
 
 class _PartEnv:
@@ -88,7 +93,13 @@ def _plan(expr, sm, space: int, alias_map: Dict[str, str],
           name_by_type: Dict[int, str]
           ) -> Optional[Callable[[_PartEnv], Optional[np.ndarray]]]:
     """Compile one YIELD expression to a per-part column evaluator.
-    None = not vectorizable (caller falls back to the slow path)."""
+    None = not vectorizable (caller falls back to the slow path).
+
+    KEEP IN SYNC with _plan_typed below: the deferred (encoded) path
+    mirrors these per-case fallback rules with typed outputs — a
+    semantic change here (alias-mismatch raise, missing-prop raise,
+    version-missing fallback, tag default fill, nullable exclusion)
+    must be mirrored there or the two fast paths diverge."""
     if isinstance(expr, Literal):
         v = expr.value
         return lambda env: np.full(len(env.idx), v, dtype=object)
@@ -254,3 +265,386 @@ def emit_rows(snap, mask: Optional[np.ndarray], ctx, yield_cols, alias_map,
             cols.append(col)
         rows.extend(zip(*(c.tolist() for c in cols)))
     return rows
+
+
+# ---------------------------------------------------------------------------
+# deferred (encoded) materialization — the dispatcher-window fast path
+# ---------------------------------------------------------------------------
+# The leader gathers TYPED numpy columns (no per-row Python objects),
+# encodes the whole window's rows in ONE GIL-released native call
+# (nbc_encode_rows; python fallback is byte-identical), and hands each
+# waiter an EncodedRows slice. The waiter boxes its own tuples on
+# wakeup — outside the dispatcher round and outside the engine lock —
+# so the serialized serve path pays numpy gathers + one native call
+# instead of a per-row Python loop per waiter. Typed plans cover only
+# cases whose classic (emit_rows) boxing is a pure typed gather; any
+# other column falls the whole request back to emit_rows, keeping
+# identity by construction.
+
+class EncodedRows:
+    """One request's slice of a window-encoded row blob. `to_rows()`
+    decodes to the exact tuples emit_rows would have produced."""
+
+    __slots__ = ("field_types", "blob", "row_off", "row_len")
+
+    def __init__(self, field_types, blob, row_off, row_len):
+        self.field_types = field_types
+        self.blob = blob
+        self.row_off = row_off
+        self.row_len = row_len
+
+    def __len__(self) -> int:
+        return len(self.row_off)
+
+    def to_rows(self) -> List[Tuple]:
+        n = len(self.row_off)
+        if n == 0:
+            return []
+        from .. import native
+        try:
+            v64, vf, so, sl, nulls, _ = native.decode_rows(
+                self.field_types, self.blob, self.row_off, self.row_len,
+                np.arange(n, dtype=np.int32), n)
+        except Exception:
+            return _decode_rows_py(self.field_types, self.blob,
+                                   self.row_off, self.row_len)
+        cols = []
+        for f, t in enumerate(self.field_types):
+            if t == _PT_DOUBLE:
+                col = vf[f].tolist()
+            elif t == _PT_BOOL:
+                col = [bool(x) for x in v64[f].tolist()]
+            elif t == _PT_STRING:
+                col = [self.blob[o:o + g].decode("utf-8")
+                       for o, g in zip(so[f].tolist(), sl[f].tolist())]
+            else:
+                col = v64[f].tolist()
+            nf = nulls[f]
+            if nf.any():
+                col = [None if z else v
+                       for v, z in zip(col, nf.tolist())]
+            cols.append(col)
+        return list(zip(*cols))
+
+
+def _decode_rows_py(field_types, blob, row_off, row_len) -> List[Tuple]:
+    """struct-based decode of the fixed-slot layout (no native lib)."""
+    n_fields = len(field_types)
+    null_bytes = (n_fields + 7) // 8
+    slot_offs, off = [], 0
+    for t in field_types:
+        slot_offs.append(off)
+        off += 1 if t == _PT_BOOL else 8
+    rows = []
+    for ro, rl in zip(row_off.tolist(), row_len.tolist()):
+        row = blob[ro:ro + rl]
+        ver_len = row[0]
+        null_off = 1 + ver_len
+        slot_off = null_off + null_bytes
+        var_off = slot_off + off
+        vals = []
+        for f, t in enumerate(field_types):
+            if row[null_off + (f >> 3)] & (1 << (f & 7)):
+                vals.append(None)
+                continue
+            o = slot_off + slot_offs[f]
+            if t == _PT_BOOL:
+                vals.append(row[o] != 0)
+            elif t == _PT_DOUBLE:
+                vals.append(struct.unpack_from("<d", row, o)[0])
+            elif t == _PT_STRING:
+                so, sl = struct.unpack_from("<II", row, o)
+                vals.append(row[var_off + so:var_off + so + sl]
+                            .decode("utf-8"))
+            else:
+                vals.append(struct.unpack_from("<q", row, o)[0])
+        rows.append(tuple(vals))
+    return rows
+
+
+def _plan_typed(expr, sm, space: int, alias_map: Dict[str, str],
+                name_by_type: Dict[int, str]):
+    """Compile one YIELD expression to (ptype, evaluator) where
+    evaluator(env) -> (vals ndarray, null bool ndarray) or None (fall
+    back to the classic object path at runtime). Returns None when the
+    expression has no typed form. Only cases whose emit_rows boxing is
+    a pure typed gather are covered — identity by construction.
+
+    KEEP IN SYNC with _plan above: every fallback rule here is the
+    typed mirror of the corresponding _plan case (see its docstring);
+    when in doubt return None — the classic path is always correct."""
+    if isinstance(expr, Literal):
+        v = expr.value
+        if v is None:
+            return _PT_INT, lambda env: (
+                np.zeros(len(env.idx), np.int64),
+                np.ones(len(env.idx), bool))
+        if isinstance(v, bool):
+            return _PT_BOOL, lambda env: (
+                np.full(len(env.idx), int(v), np.int64),
+                np.zeros(len(env.idx), bool))
+        if isinstance(v, int):
+            if not -(1 << 63) <= v < (1 << 63):
+                return None     # beyond int64: classic object path
+            return _PT_INT, lambda env: (
+                np.full(len(env.idx), v, np.int64),
+                np.zeros(len(env.idx), bool))
+        if isinstance(v, float):
+            return _PT_DOUBLE, lambda env: (
+                np.full(len(env.idx), v, np.float64),
+                np.zeros(len(env.idx), bool))
+        return None     # string literals: classic path
+
+    if isinstance(expr, (EdgeDstIdExpr, EdgeSrcIdExpr, EdgeRankExpr)):
+        src = {EdgeDstIdExpr: _PartEnv.dst_vid,
+               EdgeSrcIdExpr: _PartEnv.src_vid,
+               EdgeRankExpr: _PartEnv.rank}[type(expr)]
+        if expr.edge is None:
+            return _PT_INT, lambda env: (
+                src(env).astype(np.int64, copy=False),
+                np.zeros(len(env.idx), bool))
+        alias_name = alias_map.get(expr.edge, expr.edge)
+
+        def named(env):
+            # other-type rows yield None (the _eval_yield rule) —
+            # encoded as null cells
+            match = _alias_match(env, alias_name, name_by_type)
+            return src(env).astype(np.int64, copy=False), ~match
+        return _PT_INT, named
+
+    if isinstance(expr, EdgePropExpr):
+        alias_name = (alias_map.get(expr.edge, expr.edge)
+                      if expr.edge is not None else None)
+        prop = expr.prop
+
+        def edge_prop(env):
+            from .csr import host_gather
+            ets = env.etype()
+            vals = None
+            null = np.zeros(len(ets), bool)
+            for t in np.unique(ets):
+                t = int(t)
+                name = name_by_type.get(abs(t))
+                if alias_name is not None and name != alias_name:
+                    return None  # CPU raises on mismatched rows
+                cols = env.shard.edge_props.get(t)
+                if cols is None or prop not in cols:
+                    return None  # CPU raises "prop not found"
+                sel = ets == t
+                col = cols[prop]
+                if col.missing is not None \
+                        and col.missing[env.idx[sel]].any():
+                    return None  # version lacks the prop: CPU raises
+                part = np.asarray(host_gather(col, env.idx[sel]))
+                if not _typed_ok(part):
+                    return None
+                if vals is None:
+                    vals = np.zeros(len(ets), _widen(part.dtype))
+                elif vals.dtype != _widen(part.dtype):
+                    return None  # mixed dtypes across types: classic
+                vals[sel] = part
+            if vals is None:     # no rows at all (idx empty per type)
+                vals = np.zeros(len(ets), np.int64)
+            return vals, null
+        # declared ptype depends on the mirror dtype, resolved per
+        # part at runtime: report via a mutable probe on first gather
+        return ("edge_prop", edge_prop)
+
+    if isinstance(expr, (SourcePropExpr, DestPropExpr)):
+        tid = sm.tag_id(space, expr.tag)
+        if tid is None:
+            return None
+        r = sm.tag_schema(space, tid)
+        if not r.ok() or not r.value().has_field(expr.prop):
+            return None          # unknown prop: CPU raises
+        if r.value().field(expr.prop).nullable:
+            return None          # explicit NULLs aren't defaults
+        dflt = r.value().default_value(expr.prop)
+        prop = expr.prop
+        if isinstance(dflt, bool) or not isinstance(dflt, (int, float)):
+            return None          # string/None defaults: classic path
+
+        def tag_vals(shard, locals_):
+            cols = shard.tag_props.get(tid)
+            if cols is None or prop not in cols:
+                return np.full(len(locals_), dflt), None
+            col = cols[prop]
+            if col.version_missing and col.missing is not None \
+                    and col.missing[locals_].any():
+                return None, None    # version lacks the prop: CPU raises
+            vals = np.asarray(col.host[locals_])
+            if not _typed_ok(vals):
+                return None, None
+            if col.present is not None:
+                pres = col.present[locals_]
+                if not pres.all():
+                    vals = np.where(pres, vals, dflt)
+            return vals, None
+
+        if isinstance(expr, SourcePropExpr):
+            def src_prop(env):
+                vals, _ = tag_vals(env.shard, env.src_local())
+                if vals is None:
+                    return None
+                return vals, np.zeros(len(env.idx), bool)
+            return ("tag_prop", src_prop)
+
+        def dst_prop(env):
+            dparts = env.shard.edge_dst_part[env.idx]
+            dlocals = env.shard.edge_dst_local[env.idx]
+            out = None
+            for q in np.unique(dparts):
+                sel = dparts == q
+                vals, _ = tag_vals(env.snap.shards[int(q)], dlocals[sel])
+                if vals is None:
+                    return None
+                if out is None:
+                    out = np.zeros(len(env.idx), _widen(vals.dtype))
+                elif out.dtype != _widen(vals.dtype):
+                    return None
+                out[sel] = vals
+            if out is None:
+                out = np.zeros(len(env.idx), np.int64)
+            return out, np.zeros(len(env.idx), bool)
+        return ("tag_prop", dst_prop)
+
+    return None      # EdgeTypeExpr / functions / $- refs: classic path
+
+
+def _typed_ok(a: np.ndarray) -> bool:
+    return a.dtype.kind in "ifb" or a.dtype == np.int64
+
+
+def _widen(dt: np.dtype) -> np.dtype:
+    if dt.kind == "b":
+        return np.dtype(bool)
+    if dt.kind == "f":
+        return np.dtype(np.float64)
+    return np.dtype(np.int64)
+
+
+def _ptype_of(vals: np.ndarray) -> int:
+    if vals.dtype.kind == "b":
+        return _PT_BOOL
+    if vals.dtype.kind == "f":
+        return _PT_DOUBLE
+    return _PT_INT
+
+
+def plan_typed_columns(sm, space: int, yield_cols, alias_map,
+                       name_by_type):
+    """Typed plans for every YIELD column, or None when any column has
+    no typed form (callers use the classic emit_rows path)."""
+    plans = []
+    for c in yield_cols:
+        p = _plan_typed(c.expr, sm, space, alias_map, name_by_type)
+        if p is None:
+            return None
+        plans.append(p)
+    return plans
+
+
+def gather_typed(snap, mask, plans,
+                 idx_per_part: Optional[Dict[int, np.ndarray]] = None):
+    """Evaluate typed plans over the active edges -> (field_types,
+    [(vals, null)] per column) with all parts concatenated, or None
+    (fall back to emit_rows). Row order is identical to emit_rows."""
+    per_col: List[List[Tuple[np.ndarray, np.ndarray]]] = \
+        [[] for _ in plans]
+    for p0, shard in enumerate(snap.shards):
+        if idx_per_part is not None:
+            idx = idx_per_part.get(p0)
+            if idx is None:
+                continue
+        else:
+            idx = np.nonzero(mask[p0])[0]
+        if idx.size == 0:
+            continue
+        idx = _apply_cap(shard, idx)
+        env = _PartEnv(snap, shard, p0, idx)
+        for ci, (kind, fn) in enumerate(plans):
+            out = fn(env)
+            if out is None:
+                return None
+            per_col[ci].append(out)
+    field_types = []
+    cols = []
+    for ci, (kind, _fn) in enumerate(plans):
+        chunks = per_col[ci]
+        if not chunks:
+            vals = np.zeros(0, np.int64)
+            null = np.zeros(0, bool)
+        else:
+            dts = {_widen(v.dtype) for v, _ in chunks}
+            if len(dts) > 1:
+                return None      # per-part dtype drift: classic path
+            vals = np.concatenate([v for v, _ in chunks])
+            null = np.concatenate([n for _, n in chunks])
+        if isinstance(kind, str) and kind in ("edge_prop", "tag_prop"):
+            field_types.append(_ptype_of(vals))
+        else:
+            field_types.append(kind)
+        cols.append((vals, null))
+    return field_types, cols
+
+
+def encode_window(requests):
+    """Encode a WINDOW of gathered column sets into row blobs — one
+    native (GIL-released) nbc_encode_rows call per distinct field
+    signature, usually exactly one for a homogeneous window.
+
+    requests: [(field_types, cols)] from gather_typed. Returns
+    ([EncodedRows per request], native_used: bool)."""
+    from .. import native
+    out: List[Optional[EncodedRows]] = [None] * len(requests)
+    native_used = True
+    by_sig: Dict[Tuple[int, ...], List[int]] = {}
+    for i, (ft, _cols) in enumerate(requests):
+        by_sig.setdefault(tuple(ft), []).append(i)
+    for sig, members in by_sig.items():
+        n_fields = len(sig)
+        counts = [len(requests[i][1][0][0]) if n_fields else 0
+                  for i in members]
+        total = sum(counts)
+        vals_i64 = np.zeros((n_fields, total), np.int64)
+        vals_f64 = np.zeros((n_fields, total), np.float64)
+        nulls = np.zeros((n_fields, total), bool)
+        pos = 0
+        for i, cnt in zip(members, counts):
+            _ft, cols = requests[i]
+            for f, (vals, null) in enumerate(cols):
+                if sig[f] == _PT_DOUBLE:
+                    vals_f64[f, pos:pos + cnt] = vals
+                else:
+                    vals_i64[f, pos:pos + cnt] = vals
+                nulls[f, pos:pos + cnt] = null
+            pos += cnt
+        try:
+            blob, row_off, row_len = native.encode_rows(
+                list(sig), vals_i64, vals_f64, nulls)
+        except Exception:
+            native_used = False
+            blob, row_off, row_len = native.encode_rows_py(
+                list(sig), vals_i64, vals_f64, nulls)
+        pos = 0
+        for i, cnt in zip(members, counts):
+            out[i] = EncodedRows(list(sig), blob,
+                                 row_off[pos:pos + cnt],
+                                 row_len[pos:pos + cnt])
+            pos += cnt
+    return out, native_used
+
+
+def gather_for_encode(sm, space, snap, mask, yield_cols, alias_map,
+                      name_by_type,
+                      idx_per_part: Optional[Dict[int, np.ndarray]] = None
+                      ):
+    """Plan + gather one request's typed columns for the deferred
+    (encoded) path — the shared front half of both engine call sites
+    (single query and dispatcher window). Returns gather_typed's
+    (field_types, cols) or None (callers use emit_rows)."""
+    plans = plan_typed_columns(sm, space, yield_cols, alias_map,
+                               name_by_type)
+    if plans is None:
+        return None
+    return gather_typed(snap, mask, plans, idx_per_part=idx_per_part)

@@ -186,6 +186,16 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
         ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_double),
         ctypes.POINTER(ctypes.c_uint32), ctypes.POINTER(ctypes.c_uint32),
         u8p]
+    lib.nbc_encode_rows.restype = i64
+    lib.nbc_encode_rows.argtypes = [
+        u8p, i32,                                    # field_types, n_fields
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_double),
+        u8p,                                         # nulls
+        u8p, i64,                                    # str_blob, len
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_uint32),
+        i64, i32, i64,                               # n_rows, ver_len, ver
+        u8p, i64,                                    # out, out_cap
+        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(i32)]  # row_off/len
 
     # ---------------------------------------------------------- sort
     lib.nsort_counting_u32.restype = i32
@@ -341,6 +351,128 @@ def decode_rows(field_types, blob, row_off, row_len, row_idx, cap):
     if rc < 0:
         raise NativeBuildError(f"nbc_decode_batch failed ({rc})")
     return vals_i64, vals_f64, str_off, str_len, nulls.astype(bool), blob
+
+
+def _encode_sizes(field_types, nulls, str_len, n, ver_len):
+    """(out_cap, fixed_bytes_per_row) for the fixed-slot row layout."""
+    import numpy as np
+    n_fields = len(field_types)
+    slot_total = sum(1 if t == 1 else 8 for t in field_types)  # BOOL=1
+    fixed = 1 + ver_len + (n_fields + 7) // 8 + slot_total
+    var = 0
+    if str_len is not None:
+        live = np.where(nulls, 0, str_len.astype(np.int64))
+        for f, t in enumerate(field_types):
+            if t == 6:                                         # STRING
+                var += int(live[f].sum())
+    return n * fixed + var, fixed
+
+
+def _min_ver_bytes(version: int) -> int:
+    ver_len = 0
+    while version > 0:
+        version >>= 8
+        ver_len += 1
+    return ver_len
+
+
+def encode_rows(field_types, vals_i64, vals_f64, nulls, str_blob=b"",
+                str_off=None, str_len=None, schema_version: int = 0):
+    """Batch-encode column-major values into the fixed-slot row layout
+    via the native codec (nbc_encode_rows) — the inverse of
+    decode_rows, byte-identical to codec/row.py RowWriter, with the
+    GIL released for the duration of the call.
+
+    field_types: PropType int values per column. vals_i64 [n_fields,
+    n] carries BOOL(0/1)/INT/VID/TIMESTAMP, vals_f64 DOUBLE, STRING
+    columns reference (str_off i64, str_len u32) slices of str_blob.
+    nulls [n_fields, n]: truthy = null cell.
+
+    Returns (blob bytes, row_off int64[n], row_len int32[n]). Raises
+    if the native library is unavailable (callers fall back to
+    encode_rows_py, which produces identical bytes)."""
+    import numpy as np
+    lib = load()
+    ft = np.ascontiguousarray(field_types, np.uint8)
+    n_fields = len(ft)
+    vals_i64 = np.ascontiguousarray(vals_i64, np.int64)
+    vals_f64 = np.ascontiguousarray(vals_f64, np.float64)
+    nulls_u8 = np.ascontiguousarray(
+        np.asarray(nulls, bool).astype(np.uint8))
+    n = vals_i64.shape[1] if vals_i64.ndim == 2 else 0
+    ver_len = _min_ver_bytes(schema_version)
+    if str_off is None:
+        str_off = np.zeros((n_fields, n), np.int64)
+        str_len = np.zeros((n_fields, n), np.uint32)
+    str_off = np.ascontiguousarray(str_off, np.int64)
+    str_len = np.ascontiguousarray(str_len, np.uint32)
+    out_cap, _ = _encode_sizes(ft, nulls_u8, str_len, n, ver_len)
+    out = np.empty(max(out_cap, 1), np.uint8)
+    row_off = np.empty(max(n, 1), np.int64)
+    row_len = np.empty(max(n, 1), np.int32)
+    c_u8p = ctypes.POINTER(ctypes.c_uint8)
+    rc = lib.nbc_encode_rows(
+        ft.ctypes.data_as(c_u8p), n_fields,
+        vals_i64.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        vals_f64.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+        nulls_u8.ctypes.data_as(c_u8p),
+        ctypes.cast(ctypes.c_char_p(bytes(str_blob)), c_u8p),
+        len(str_blob),
+        str_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        str_len.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)),
+        n, ver_len, schema_version,
+        out.ctypes.data_as(c_u8p), out_cap,
+        row_off.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        row_len.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    if rc < 0:
+        raise NativeBuildError(f"nbc_encode_rows failed ({rc})")
+    return out[:rc].tobytes(), row_off[:n], row_len[:n]
+
+
+def encode_rows_py(field_types, vals_i64, vals_f64, nulls, str_blob=b"",
+                   str_off=None, str_len=None, schema_version: int = 0):
+    """Pure-Python twin of encode_rows: same signature, byte-identical
+    output (the fallback when the native toolchain is unavailable —
+    and the identity oracle encode tests compare against)."""
+    import struct
+    import numpy as np
+    ft = list(int(t) for t in field_types)
+    n_fields = len(ft)
+    vals_i64 = np.asarray(vals_i64, np.int64)
+    vals_f64 = np.asarray(vals_f64, np.float64)
+    nulls = np.asarray(nulls, bool)
+    n = vals_i64.shape[1] if vals_i64.ndim == 2 else 0
+    ver_len = _min_ver_bytes(schema_version)
+    hdr = bytes([ver_len]) + schema_version.to_bytes(ver_len, "little")
+    null_bytes = (n_fields + 7) // 8
+    out = bytearray()
+    row_off = np.empty(max(n, 1), np.int64)
+    row_len = np.empty(max(n, 1), np.int32)
+    blob = bytes(str_blob)
+    for r in range(n):
+        nullmap = bytearray(null_bytes)
+        slots = bytearray()
+        var = bytearray()
+        for f, t in enumerate(ft):
+            if nulls[f, r]:
+                nullmap[f >> 3] |= 1 << (f & 7)
+                slots += b"\0" * (1 if t == 1 else 8)
+                continue
+            if t == 1:                                         # BOOL
+                slots.append(1 if vals_i64[f, r] else 0)
+            elif t == 5:                                       # DOUBLE
+                slots += struct.pack("<d", float(vals_f64[f, r]))
+            elif t == 6:                                       # STRING
+                so, sl = int(str_off[f, r]), int(str_len[f, r])
+                slots += struct.pack("<II", len(var), sl)
+                var += blob[so:so + sl]
+            else:                              # INT/VID/TIMESTAMP
+                slots += struct.pack("<q", int(vals_i64[f, r]))
+        row = hdr + bytes(nullmap) + bytes(slots) + bytes(var)
+        row_off[r] = len(out)
+        row_len[r] = len(row)
+        out += row
+    return bytes(out), row_off[:n], row_len[:n]
 
 
 def usable_cpus() -> int:

@@ -208,11 +208,18 @@ class _ConnPool:
                 self._created -= 1
 
     def close(self) -> None:
+        # each drained socket frees its creation slot: a reused client
+        # (disconnect -> connect) must be able to dial fresh sockets —
+        # leaving _created at size made the next acquire block the
+        # full timeout and raise "no pooled connection"
         while True:
             try:
-                self._free.get_nowait().close()
+                sock = self._free.get_nowait()
             except queue.Empty:
                 return
+            sock.close()
+            with self._lock:
+                self._created -= 1
 
 
 class RpcClient:

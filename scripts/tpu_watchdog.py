@@ -26,8 +26,16 @@ longer timeout) to `BENCH_tpu_onchip_full.json`. Trimmed evidence in
 hand is never overwritten by a failed full run.
 
 Usage:
-  env JAX_PLATFORMS= python -S scripts/tpu_watchdog.py [--once]
+  env JAX_PLATFORMS= python -S scripts/tpu_watchdog.py [--once] [--fake-up]
 (launched detached by the round driver / builder; stdlib-only parent).
+
+--fake-up is a SELF-TEST mode: the probe is forced to report success
+and the bench runs against the CPU XLA backend (cpu-platform artifacts
+accepted in this mode only), so the capture + escalation path — which
+otherwise only runs inside a real accelerator up-window — is
+exercisable by the tier-1 suite. Combine with WATCHDOG_OUT_TRIM /
+WATCHDOG_OUT_FULL / WATCHDOG_LOG / WATCHDOG_BENCH_SCRIPT to keep the
+self-test away from the real artifacts.
 
 No reference analogue: QueryBoundBenchmark.cpp:181-191 assumes local
 devices; a tunneled flaky accelerator needs capture-on-recovery.
@@ -39,9 +47,22 @@ import sys
 import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-LOG = os.path.join(REPO, "TPU_WATCHDOG.log")
-OUT_TRIM = os.path.join(REPO, "BENCH_tpu_onchip.json")
-OUT_FULL = os.path.join(REPO, "BENCH_tpu_onchip_full.json")
+LOG = os.environ.get("WATCHDOG_LOG", os.path.join(REPO, "TPU_WATCHDOG.log"))
+OUT_TRIM = os.environ.get("WATCHDOG_OUT_TRIM",
+                          os.path.join(REPO, "BENCH_tpu_onchip.json"))
+OUT_FULL = os.environ.get("WATCHDOG_OUT_FULL",
+                          os.path.join(REPO, "BENCH_tpu_onchip_full.json"))
+# the bench the success branch launches — overridable so the --fake-up
+# self-test can substitute a fast stand-in and still exercise the real
+# launch/parse/capture/escalation machinery
+BENCH_SCRIPT = os.environ.get("WATCHDOG_BENCH_SCRIPT",
+                              os.path.join(REPO, "bench.py"))
+# --fake-up: self-test mode — treat the CPU backend as a successful
+# probe so the success branch (trimmed bench -> capture -> full-bench
+# escalation), which only ever runs inside a real accelerator
+# up-window, is exercisable by a test. cpu-platform artifacts are
+# accepted in this mode ONLY.
+FAKE_UP = False
 
 PROBE_TIMEOUT = float(os.environ.get("WATCHDOG_PROBE_TIMEOUT", 60))
 PROBE_INTERVAL = float(os.environ.get("WATCHDOG_PROBE_INTERVAL", 120))
@@ -71,6 +92,10 @@ def probe() -> str:
     under a hard deadline; mirrors nebula_tpu/common/accel.py but kept
     stdlib-inline so the `-S` parent needs no repo imports.
     """
+    if FAKE_UP:
+        # self-test: skip the relay probe entirely and report "up" so
+        # the success branch runs deterministically on a CPU-only box
+        return "fake-up(cpu)"
     env = dict(os.environ)
     env.pop("JAX_PLATFORMS", None)      # let the relay platform win
     try:
@@ -127,20 +152,26 @@ def _foreign_bench_running() -> bool:
 
 
 def run_bench(out_path: str, extra_env: dict, timeout: float) -> bool:
-    if _foreign_bench_running():
+    # the self-test must be deterministic: it never touches the chip,
+    # so an unrelated bench.py (e.g. the driver's round-end run) is
+    # not a reason to defer
+    if not FAKE_UP and _foreign_bench_running():
         log(f"bench -> {os.path.basename(out_path)}: DEFERRED — another "
             f"bench.py process is running (driver round-end bench?); "
             f"not contending for the exclusive-access chip")
         return False
     env = dict(os.environ)
-    env.pop("JAX_PLATFORMS", None)
+    if FAKE_UP:
+        env["JAX_PLATFORMS"] = "cpu"    # the self-test pins the backend
+    else:
+        env.pop("JAX_PLATFORMS", None)
     env.update(extra_env)
     tag = os.path.basename(out_path)
     log(f"bench -> {tag} starting (timeout {timeout:.0f}s, env {extra_env})")
     t0 = time.time()
     try:
         out = subprocess.run(
-            [sys.executable, os.path.join(REPO, "bench.py")],
+            [sys.executable, BENCH_SCRIPT],
             capture_output=True, timeout=timeout, text=True, env=env,
             cwd=REPO)
     except subprocess.TimeoutExpired:
@@ -157,7 +188,7 @@ def run_bench(out_path: str, extra_env: dict, timeout: float) -> bool:
             f"{err[-1] if err else 'no output'}")
         return False
     plat = str(data.get("platform", ""))
-    if plat.startswith("cpu"):
+    if plat.startswith("cpu") and not FAKE_UP:
         log(f"bench -> {tag}: completed but platform={plat} (relay died "
             f"between probe and backend init) — NOT capturing")
         return False
@@ -172,9 +203,22 @@ def run_bench(out_path: str, extra_env: dict, timeout: float) -> bool:
 
 
 def main() -> int:
+    global FAKE_UP
     once = "--once" in sys.argv
+    if "--fake-up" in sys.argv:
+        FAKE_UP = True
+        if "WATCHDOG_OUT_TRIM" not in os.environ or \
+                "WATCHDOG_OUT_FULL" not in os.environ:
+            # the self-test writes cpu-platform artifacts — refuse to
+            # point it at the REAL capture files (trimmed evidence in
+            # hand must never be overwritten by a fake run)
+            print("--fake-up requires WATCHDOG_OUT_TRIM and "
+                  "WATCHDOG_OUT_FULL to redirect the self-test "
+                  "artifacts away from the real captures", flush=True)
+            return 2
     log(f"watchdog start pid={os.getpid()} interval={PROBE_INTERVAL:.0f}s "
-        f"probe_timeout={PROBE_TIMEOUT:.0f}s")
+        f"probe_timeout={PROBE_TIMEOUT:.0f}s"
+        + (" FAKE-UP self-test" if FAKE_UP else ""))
     n = 0
     while True:
         n += 1

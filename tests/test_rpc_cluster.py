@@ -838,3 +838,28 @@ def test_tpu_concurrent_identity_over_tcp_native():
         assert tpu.stats["go_served"] > 0, tpu.stats
     finally:
         graphd.stop(); sd.stop(); metad.stop()
+
+
+def test_dedicated_client_close_then_reconnect_fast():
+    """Satellite (ISSUE 1): _ConnPool.close() frees creation slots per
+    drained socket — a reused dedicated client (disconnect ->
+    reconnect) dials a fresh connection immediately instead of
+    blocking the full acquire timeout and raising RpcError 'no pooled
+    connection'."""
+    from nebula_tpu.rpc.transport import RpcServer, proxy
+
+    class Echo:
+        def ping(self):
+            return "pong"
+
+    srv = RpcServer().register("echo", Echo()).start()
+    try:
+        c = proxy(srv.addr, "echo", timeout=3.0, dedicated=True)
+        assert c.ping() == "pong"
+        c.close()                       # disconnect
+        t0 = time.time()
+        assert c.ping() == "pong"       # reconnect must not block 3s
+        assert time.time() - t0 < 1.5, "close() leaked a creation slot"
+        c.close()
+    finally:
+        srv.stop()

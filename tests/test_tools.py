@@ -305,3 +305,58 @@ def test_soak_concurrent_short():
     assert out["ok"], out
     assert not out["errors"], out
     assert out["dispatcher"]["batched_queries"] > 0, out
+
+
+def test_watchdog_fake_up_self_test(tmp_path):
+    """Satellite (ISSUE 1): the watchdog's probe-SUCCESS branch —
+    trimmed-bench capture, then escalation to the full bench — has
+    never run on a CPU-only box; --fake-up forces it deterministically
+    against a stand-in bench, with artifacts redirected away from the
+    real capture files."""
+    import subprocess
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    script = os.path.join(repo, "scripts", "tpu_watchdog.py")
+    standin = tmp_path / "standin_metrics.py"
+    standin.write_text(
+        "import json, os\n"
+        "print(json.dumps({'metric': 'selftest', 'value': 1.0,\n"
+        "                  'unit': 'edges/s',\n"
+        "                  'platform': 'cpu-fallback(fake-up)',\n"
+        "                  'trim': os.environ.get('BENCH_V', '')}))\n")
+    env = dict(os.environ)
+    env.update({
+        "WATCHDOG_OUT_TRIM": str(tmp_path / "trim.json"),
+        "WATCHDOG_OUT_FULL": str(tmp_path / "full.json"),
+        "WATCHDOG_LOG": str(tmp_path / "wd.log"),
+        "WATCHDOG_BENCH_SCRIPT": str(standin),
+    })
+
+    def once():
+        return subprocess.run(
+            [sys.executable, "-S", script, "--once", "--fake-up"],
+            capture_output=True, text=True, timeout=120, env=env)
+
+    # 1st probe success -> trimmed capture
+    p1 = once()
+    assert p1.returncode == 0, (p1.stdout, p1.stderr)
+    trim = json.loads((tmp_path / "trim.json").read_text())
+    assert trim["captured_by"] == "tpu_watchdog"
+    assert trim["trim"] != "", "trimmed scale env not applied"
+    # 2nd probe success with trim in hand -> FULL-bench escalation
+    p2 = once()
+    assert p2.returncode == 0, (p2.stdout, p2.stderr)
+    full = json.loads((tmp_path / "full.json").read_text())
+    assert full["captured_by"] == "tpu_watchdog"
+    assert full["trim"] == "", "full run must not inherit trim scale"
+    log_text = (tmp_path / "wd.log").read_text()
+    assert log_text.count("CAPTURED") == 2, log_text
+    # without redirected artifacts the self-test must refuse to run
+    # (it would overwrite the REAL accelerator captures otherwise)
+    bare_env = {k: v for k, v in env.items()
+                if k not in ("WATCHDOG_OUT_TRIM", "WATCHDOG_OUT_FULL")}
+    p3 = subprocess.run(
+        [sys.executable, "-S", script, "--once", "--fake-up"],
+        capture_output=True, text=True, timeout=60, env=bare_env)
+    assert p3.returncode == 2, (p3.stdout, p3.stderr)

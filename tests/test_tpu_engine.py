@@ -1423,3 +1423,241 @@ def test_grouped_chunked_stat_fires(agg_pair, monkeypatch):
     from nebula_tpu.common.stats import stats as global_stats
     assert global_stats.read_stats(
         "tpu_engine.agg_grouped_chunked.sum.600") >= 1
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 1: GIL-free batch materialization + group-complete dispatcher
+# ---------------------------------------------------------------------------
+
+def test_mixed_key_dispatcher_group_complete():
+    """Acceptance: heterogeneous (space, steps, edge_types) groups
+    under concurrent load are INDEPENDENT rounds — a waiter wakes when
+    its own group completes and its wall time is never bounded by an
+    unrelated slow group (pre-rework: one global round served all
+    groups serially, so the 1-step query below would have waited out
+    the slow 2-step window)."""
+    import threading
+    import time as _t
+
+    tpu = TpuGraphEngine()
+    cluster = InProcCluster(tpu_engine=tpu)
+    _, warm = load_nba(cluster)
+    # warm both keys' snapshots/compiles so timings measure scheduling
+    warm.must("GO FROM 100 OVER like YIELD like._dst")
+    warm.must("GO 2 STEPS FROM 100 OVER like YIELD like._dst")
+
+    SLOW = 1.0
+    slow_started = threading.Event()
+    orig = tpu._serve_batch
+
+    def gated(batch, ex):
+        if batch[0].key[1] == 2:       # the slow (2-step) group only
+            slow_started.set()
+            _t.sleep(SLOW)
+        orig(batch, ex)
+
+    tpu._serve_batch = gated
+    results = {}
+    errs = []
+
+    def run_slow():
+        try:
+            c = cluster.connect()
+            c.must("USE nba")
+            t0 = _t.monotonic()
+            c.must("GO 2 STEPS FROM 100 OVER like YIELD like._dst")
+            results["slow"] = _t.monotonic() - t0
+        except Exception as e:          # noqa: BLE001
+            errs.append(repr(e))
+
+    def run_fast():
+        try:
+            c = cluster.connect()
+            c.must("USE nba")
+            assert slow_started.wait(10), "slow round never started"
+            t0 = _t.monotonic()
+            c.must("GO FROM 101 OVER like YIELD like._dst")
+            results["fast"] = _t.monotonic() - t0
+        except Exception as e:          # noqa: BLE001
+            errs.append(repr(e))
+
+    ts = threading.Thread(target=run_slow)
+    tf = threading.Thread(target=run_fast)
+    ts.start(); tf.start(); ts.join(); tf.join()
+    tpu._serve_batch = orig
+    assert not errs, errs
+    # the fast group's waiter completed INSIDE the slow group's round:
+    # group-complete wakeup, not end-of-round
+    assert results["fast"] < SLOW / 2, results
+    assert results["slow"] >= SLOW, results
+    # the fast group's leader took over while the slow round was in
+    # flight — a cross-group handoff
+    assert tpu.stats["leader_handoffs"] >= 1, tpu.stats
+
+
+def test_deferred_native_encode_identity_and_fallback(monkeypatch):
+    """Acceptance: the deferred (window-encoded) materialization path
+    produces byte-identical rows through the native encoder AND the
+    pure-Python fallback, and both match the CPU path."""
+    import nebula_tpu.native as native_mod
+    from nebula_tpu.native import NativeBuildError
+
+    q = "GO 2 STEPS FROM 100 OVER like YIELD like._dst, like.likeness"
+    _, cpu_conn = load_nba()
+    expected = sorted(map(repr, cpu_conn.must(q).rows))
+
+    tpu = TpuGraphEngine()
+    cluster = InProcCluster(tpu_engine=tpu)
+    _, conn = load_nba(cluster)
+    r = conn.must(q)
+    assert sorted(map(repr, r.rows)) == expected
+    assert tpu.stats["native_encode_rows"] > 0, tpu.stats
+    assert tpu.stats["fast_materialize"] > 0, tpu.stats
+
+    # force the pure-Python fallback encoder: rows must stay identical
+    def boom(*a, **k):
+        raise NativeBuildError("forced fallback for test")
+    monkeypatch.setattr(native_mod, "encode_rows", boom)
+    tpu2 = TpuGraphEngine()
+    cluster2 = InProcCluster(tpu_engine=tpu2)
+    _, conn2 = load_nba(cluster2)
+    r2 = conn2.must(q)
+    assert sorted(map(repr, r2.rows)) == expected
+    assert tpu2.stats["encode_fallback_rows"] > 0, tpu2.stats
+
+
+def test_calibrate_pin_not_overridden_mid_probe():
+    """Satellite: an explicit sparse_edge_budget pin landing while an
+    auto-calibration probe is mid-flight can no longer be silently
+    overridden — the pinned-check and the install are one critical
+    section (and the setter takes the same lock)."""
+    tpu = TpuGraphEngine()
+    cluster = InProcCluster(tpu_engine=tpu)
+    _, conn = load_nba(cluster)
+    conn.must("GO FROM 100 OVER like YIELD like._dst")   # build snapshot
+    sid = cluster.meta.get_space("nba").value().space_id
+    etype = cluster.sm.edge_type(sid, "like")
+
+    orig = tpu._sparse_expand
+
+    def pin_mid_probe(snap, starts, edge_types, steps, budget=None):
+        # an operator pin arriving DURING the calibration walk (the
+        # engine RLock is re-entrant, so this models a pin that wins
+        # the lock between the probe and the install)
+        tpu.sparse_edge_budget = 12345
+        return orig(snap, starts, edge_types, steps, budget=budget)
+
+    tpu._sparse_expand = pin_mid_probe
+    try:
+        rec = tpu.calibrate_sparse_budget(sid, [100, 101], [etype],
+                                          steps=2, auto=True)
+    finally:
+        tpu._sparse_expand = orig
+    assert rec is None
+    assert tpu.sparse_edge_budget == 12345
+    assert tpu._budget_pinned
+    assert tpu._space_budgets == {}
+
+
+def test_can_serve_path_prechecks_cost_no_snapshot():
+    """Satellite: a FIND ALL PATH the device path would decline anyway
+    (steps out of the device range) is routed to the CPU BEFORE the
+    engine lock + snapshot are taken, and the decline is counted."""
+    q = "FIND ALL PATH FROM 100 TO 102 OVER like UPTO 0 STEPS"
+    _, cpu_conn = load_nba()
+    expected = sorted(map(repr, cpu_conn.must(q).rows))
+
+    tpu = TpuGraphEngine()
+    cluster = InProcCluster(tpu_engine=tpu)
+    _, conn = load_nba(cluster)
+    snapshot_calls = []
+    orig = tpu._snapshot_locked
+    tpu._snapshot_locked = lambda sid: (snapshot_calls.append(sid),
+                                        orig(sid))[1]
+    try:
+        r = conn.must(q)
+    finally:
+        tpu._snapshot_locked = orig
+    assert sorted(map(repr, r.rows)) == expected
+    assert snapshot_calls == [], "decline paid a snapshot acquisition"
+    assert tpu.stats["path_declined"] >= 1, tpu.stats
+    assert tpu.path_decline_reasons.get(
+        "all_paths_steps_out_of_range", 0) >= 1, tpu.path_decline_reasons
+    from nebula_tpu.common.stats import stats as global_stats
+    assert global_stats.read_stats(
+        "tpu_engine.path_declined.all_paths_steps_out_of_range.sum.600") >= 1
+
+
+def test_grouped_count_chunked_exact(monkeypatch):
+    """Satellite: grouped COUNT / non-null scatter-adds chunk past
+    COUNT_CHUNK slots with host int64 accumulation (the old single
+    int32 pass silently wrapped past 2^31 rows) — forced here by
+    shrinking the chunk, checked against numpy bincount."""
+    import jax.numpy as jnp
+    from nebula_tpu.engine_tpu import aggregate
+    monkeypatch.setattr(aggregate, "COUNT_CHUNK", 7)
+    rng = np.random.default_rng(11)
+    n, n_groups = 53, 6
+    g_np = rng.integers(0, n_groups, n).astype(np.int32)
+    m_np = rng.integers(0, 2, n).astype(bool)
+    out = aggregate._scatter_count_i64(jnp.asarray(m_np),
+                                       jnp.asarray(g_np), n_groups)
+    ref = np.bincount(g_np[m_np], minlength=n_groups)
+    assert out.dtype == np.int64
+    assert (out == ref).all(), (out, ref)
+
+
+def test_batched_kernel_calibration_runs_once_and_keeps_identity():
+    """The first multi-member window measures lane-matrix vs vmapped
+    batched kernels and caches the pick on the snapshot (fallback
+    backends can be several times faster on the vmapped variant);
+    results stay identical either way and the record is
+    operator-visible."""
+    import threading
+
+    q = "GO 2 STEPS FROM 100 OVER like YIELD like._dst"
+    _, cpu_conn = load_nba()
+    expected = sorted(map(repr, cpu_conn.must(q).rows))
+
+    tpu = TpuGraphEngine()
+    cluster = InProcCluster(tpu_engine=tpu)
+    _, warm = load_nba(cluster)
+    tpu.sparse_edge_budget = 0      # dense: dispatcher windows
+    warm.must(q)
+    sid = cluster.meta.get_space("nba").value().space_id
+    tpu.snapshot(sid).aligned_kernel()
+
+    # stall one round so a multi-member window forms behind it
+    orig = tpu._serve_batch
+
+    def slow(batch, ex):
+        import time as _t
+        _t.sleep(0.05)
+        orig(batch, ex)
+
+    tpu._serve_batch = slow
+    errs = []
+
+    def worker():
+        try:
+            c = cluster.connect()
+            c.must("USE nba")
+            for _ in range(3):
+                r = c.must(q)
+                if sorted(map(repr, r.rows)) != expected:
+                    errs.append(r.rows)
+        except Exception as e:      # noqa: BLE001
+            errs.append(repr(e))
+
+    threads = [threading.Thread(target=worker) for _ in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    tpu._serve_batch = orig
+    assert not errs, errs[:3]
+    rec = tpu.batched_kernel_calibrations.get(sid)
+    assert rec is not None and rec["pick"] in ("lane", "vmap"), rec
+    assert rec["lane_ms"] > 0 and rec["vmap_ms"] > 0, rec
+    snap = tpu.snapshot(sid)
+    assert getattr(snap, "batched_kernel_pick", None) == rec["pick"]
