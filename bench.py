@@ -370,7 +370,14 @@ def bench_full_queries(conn, tpu, snap, etype, seed_sets):
         f"seeds (cpp-scan storaged path); result identity: {ident}")
     assert ident, "CPU/TPU full-query results diverged"
     return p50, p99, qps1, cpu_ms, {"modes": modes,
-                                    "stage_median_us": stage_med}
+                                    "stage_median_us": stage_med,
+                                    # mesh serving matrix (empty on an
+                                    # unmeshed bench run; populated by
+                                    # --mesh-dryrun and meshed boxes)
+                                    "mesh_served": dict(tpu.mesh_served),
+                                    "mesh_declined": {
+                                        f: dict(d) for f, d in
+                                        tpu.mesh_decline_reasons.items()}}
 
 
 def bench_stats_query(conn, tpu, seed_sets):
@@ -498,7 +505,10 @@ def bench_concurrent(cluster, tpu, seed_sets, seconds=6.0, sessions=8):
            "leader_handoffs": d["leader_handoffs"],
            "native_encode_rows": d["native_encode_rows"],
            "group_wait_us_avg": int(
-               d["group_wait_us_total"] / max(d["group_wait_count"], 1))}
+               d["group_wait_us_total"] / max(d["group_wait_count"], 1)),
+           "mesh_served": dict(tpu.mesh_served),
+           "mesh_declined": {f: dict(dd) for f, dd in
+                             tpu.mesh_decline_reasons.items()}}
     log(f"tier3 concurrent ({sessions} sessions, {wall:.1f}s): "
         f"{out['qps']} QPS aggregate, {d['batched_queries']} queries "
         f"over {d['batched_dispatches']} shared dispatches "
@@ -598,7 +608,153 @@ def _ensure_backend():
     return label
 
 
+def bench_mesh_dryrun(out_path: str, n_devices: int = 4):
+    """Tier-1-safe mesh smoke tier (`bench.py --mesh-dryrun`): boot a
+    host-emulated n-device mesh (JAX_PLATFORMS=cpu +
+    xla_force_host_platform_device_count — no accelerator, no native
+    engine), drive the FULL meshed serving surface through real nGQL —
+    concurrent mixed-key dispatcher windows, grouped + ungrouped
+    aggregation pushdown, an ALL-path query — identity-checked against
+    a plain CPU cluster, and record the mesh serving matrix into a
+    MULTICHIP json artifact. The env forcing must run before the first
+    jax import, so this tier runs INSTEAD of the accelerator tiers."""
+    import threading
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    n_devices = min(n_devices, len(jax.devices()))
+
+    from nebula_tpu.cluster import InProcCluster
+    from nebula_tpu.engine_tpu import TpuGraphEngine
+    from nebula_tpu.engine_tpu import distributed as dist
+    mesh = dist.make_mesh(jax.devices()[:n_devices])
+    parts = n_devices * 2
+    tpu = TpuGraphEngine(mesh=mesh)
+    clusters = [InProcCluster(tpu_engine=tpu), InProcCluster()]
+
+    rng = np.random.default_rng(5)
+    V, E = 600, 6000
+    deg = np.minimum(rng.zipf(1.6, V), 200).astype(np.int64)
+    srcs = np.repeat(np.arange(V), deg)
+    if len(srcs) < E:
+        srcs = np.concatenate([srcs, rng.integers(0, V, E - len(srcs))])
+    srcs, dsts = srcs[:E], rng.integers(0, V, E)
+    ts = rng.integers(0, TS_MAX, E)
+    conns = []
+    for cl in clusters:
+        conn = cl.connect()
+        conn.must(f"CREATE SPACE meshdry(partition_num={parts})")
+        conn.must("USE meshdry")
+        conn.must("CREATE TAG person(age int)")
+        conn.must("CREATE EDGE knows(ts int)")
+        B = 500
+        for i in range(0, V, B):
+            vals = ", ".join(f"{v}:({20 + v % 60})"
+                             for v in range(i, min(i + B, V)))
+            conn.must(f"INSERT VERTEX person(age) VALUES {vals}")
+        for i in range(0, E, B):
+            vals = ", ".join(f"{srcs[j]} -> {dsts[j]}@{j}:({ts[j]})"
+                             for j in range(i, min(i + B, E)))
+            conn.must(f"INSERT EDGE knows(ts) VALUES {vals}")
+        conns.append(conn)
+    tconn, cconn = conns
+    hubs = [int(x) for x in np.argsort(np.bincount(srcs,
+                                                   minlength=V))[-4:]]
+
+    queries = [
+        f"GO 2 STEPS FROM {hubs[0]} OVER knows YIELD knows._dst",
+        f"GO 3 STEPS FROM {hubs[1]} OVER knows YIELD knows._dst",
+        f"GO FROM {hubs[2]} OVER knows WHERE knows.ts > {TS_MAX // 2} "
+        f"YIELD knows._dst, knows.ts",
+        f"GO 2 STEPS FROM {hubs[0]} OVER knows YIELD knows.ts AS t"
+        f" | YIELD COUNT(*) AS n, SUM($-.t) AS s, AVG($-.t) AS a",
+        f"GO FROM {hubs[1]}, {hubs[2]} OVER knows "
+        f"YIELD knows._dst AS d, knows.ts AS t | GROUP BY $-.d "
+        f"YIELD $-.d AS d, COUNT(*) AS c, SUM($-.t) AS s",
+        f"FIND ALL PATH FROM {hubs[3]} TO {hubs[0]} OVER knows "
+        f"UPTO 3 STEPS",
+    ]
+    checked = 0
+    mismatches = []
+    for q in queries:
+        rt, rc = tconn.must(q), cconn.must(q)
+        if sorted(map(str, rt.rows)) != sorted(map(str, rc.rows)):
+            mismatches.append(q)
+        checked += 1
+
+    # concurrent mixed-key windows through the group-commit dispatcher
+    # (two distinct steps keys x several sessions): the windows must
+    # coalesce on the MESH (mesh_served.go_batched). Pre-build the
+    # per-device window layout so the measurement doesn't race the
+    # engine's off-lock lazy build.
+    from nebula_tpu.engine_tpu import mesh_exec
+    sid = clusters[0].meta.get_space("meshdry").value().space_id
+    snap = tpu.snapshot(sid)
+    if snap is not None and snap.sharded_kernel is not None:
+        mesh_exec.ensure_sharded_aligned(mesh, snap)
+    errs = []
+
+    def worker(q, n):
+        try:
+            c = clusters[0].connect()
+            c.must("USE meshdry")
+            for _ in range(n):
+                c.must(q)
+        except Exception as e:   # noqa: BLE001 — recorded, fails run
+            errs.append(repr(e))
+    threads = []
+    for q in (f"GO 2 STEPS FROM {hubs[0]} OVER knows YIELD knows._dst",
+              f"GO 3 STEPS FROM {hubs[1]} OVER knows YIELD knows._dst"):
+        for _ in range(4):
+            t = threading.Thread(target=worker, args=(q, 3))
+            t.start()
+            threads.append(t)
+    for t in threads:
+        t.join()
+
+    rec = {
+        "n_devices": n_devices,
+        "partitions": parts,
+        "graph": {"V": V, "E": E},
+        "identity_checked": checked,
+        "identity_ok": not mismatches and not errs,
+        "mismatches": mismatches,
+        "errors": errs[:3],
+        "mesh_served": dict(tpu.mesh_served),
+        "mesh_declined": {f: dict(d) for f, d in
+                          tpu.mesh_decline_reasons.items()},
+        "sharded_queries": tpu.stats["sharded_queries"],
+        "batched_dispatches": tpu.stats["batched_dispatches"],
+    }
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=1)
+    log(f"mesh dryrun: {checked} identity-checked queries on a "
+        f"{n_devices}-device host-emulated mesh, mesh_served="
+        f"{rec['mesh_served']} -> {out_path}")
+    print(json.dumps({"metric": "mesh_dryrun", **rec}))
+    ok = rec["identity_ok"] and \
+        all(rec["mesh_served"].get(k, 0) > 0
+            for k in ("go_batched", "agg", "path_all"))
+    if not ok:
+        raise SystemExit(f"mesh dryrun FAILED: {rec}")
+    return rec
+
+
 def main():
+    if "--mesh-dryrun" in sys.argv:
+        out = os.environ.get("BENCH_MESH_OUT",
+                             "MULTICHIP_mesh_dryrun.json")
+        for a in sys.argv:
+            if a.startswith("--out="):
+                out = a.split("=", 1)[1]
+        bench_mesh_dryrun(out,
+                          int(os.environ.get("BENCH_MESH_DEVICES", 4)))
+        return
     platform = _ensure_backend()
     cluster, tpu, conn, sid, etype, seed_sets = load_cluster()
     tpu_eps, tpu_qps, gbs, q0_edges, snap, kernel_pick = bench_tpu_batched(

@@ -118,6 +118,17 @@ def serve_graphd(meta_addr: str, host: str = "127.0.0.1", port: int = 0,
                         dict(tpu_engine.agg_decline_reasons),
                     "path_decline_reasons":
                         dict(tpu_engine.path_decline_reasons),
+                    # mesh execution service (docs/manual/8-mesh.md):
+                    # device-served queries on SHARDED snapshots per
+                    # feature, and the decline matrix {feature:
+                    # {reason: n}} — on a meshed deployment every
+                    # round-5 feature must show served > 0 here
+                    "mesh": {
+                        "served": dict(tpu_engine.mesh_served),
+                        "declined": {
+                            f: dict(d) for f, d in
+                            tpu_engine.mesh_decline_reasons.items()},
+                    },
                     "dispatcher": {
                         "rounds": st.get("disp_rounds", 0),
                         # avg distinct group keys VISIBLE at leader
@@ -181,7 +192,18 @@ def main(argv=None) -> None:
                 f"XLA backend anyway.")
         print(f"graphd --tpu: JAX backend up ({devs})")
         from ..engine_tpu import TpuGraphEngine
-        tpu = TpuGraphEngine()
+        mesh = None
+        if len(devs) > 1:
+            # multi-device host: serve over the partition mesh —
+            # snapshots whose part count divides the mesh get sharded
+            # kernels, and the full query surface runs distributed
+            # (mesh_exec.py; docs/manual/8-mesh.md). NEBULA_TPU_NO_MESH
+            # pins single-device serving for A/B comparison.
+            if not os.environ.get("NEBULA_TPU_NO_MESH"):
+                from ..engine_tpu.distributed import make_mesh
+                mesh = make_mesh()
+                print(f"graphd --tpu: {len(devs)}-device mesh enabled")
+        tpu = TpuGraphEngine(mesh=mesh)
     ws = None if args.ws_port < 0 else args.ws_port
     h = serve_graphd(args.meta, args.host, args.port, tpu_engine=tpu,
                      ws_port=ws)

@@ -182,6 +182,14 @@ class CsrSnapshot:
         self.delta = None                # SnapshotDelta once writes land
         self.stale = False               # poisoned mid-apply: must not serve
         self._aligned = None             # lazy batched-path layout
+        # mesh execution service state: the per-device EdgeKernel
+        # blocks (distributed.shard_snapshot_arrays) and the lazily
+        # cached per-device aligned blocks for sharded dispatcher
+        # windows (mesh_exec.ensure_sharded_aligned; "failed" caches a
+        # build decline so hot windows never retry a doomed build)
+        self.sharded_kernel = None
+        self._sharded_aligned = None
+        self._sharded_aligned_kick = False   # off-lock build started
         self.d_edge_src = self.kernel.src
         self.d_edge_gidx = jnp.asarray(gidx)
         self.d_edge_etype = self.kernel.etype
@@ -272,6 +280,12 @@ class CsrSnapshot:
 
     def invalidate_aligned(self) -> None:
         self._aligned = None
+        # defensive: meshed snapshots rebuild rather than delta-patch,
+        # but any mutation of the canonical arrays must drop BOTH
+        # aligned caches — and re-arm the one-shot build kick, or the
+        # dispatcher could never rebuild the sharded layout
+        self._sharded_aligned = None
+        self._sharded_aligned_kick = False
 
     def _flat_canonical_edges(self):
         """Flat (gsrc, etype, gdst) canonical edge arrays in the global

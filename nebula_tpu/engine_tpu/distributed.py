@@ -33,6 +33,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .shard_compat import pvary, shard_map
 from .traverse import (LANES, AlignedKernel, EdgeKernel, _deg_req,
                        _edge_ok, _packed_hits, _packed_src_eff, hop_hits)
 
@@ -72,7 +73,6 @@ def _exchange(flat_hits, num_devices, local_block):
 @lru_cache(maxsize=64)
 def _multi_hop_fn(mesh: Mesh, num_devices: int, parts_per_dev: int,
                   cap_v: int):
-    from jax import shard_map
     local_block = parts_per_dev * cap_v
 
     @partial(shard_map, mesh=mesh,
@@ -115,7 +115,6 @@ def multi_hop_sharded(mesh: Mesh, frontier0, steps, kern: EdgeKernel,
 @lru_cache(maxsize=64)
 def _count_fn(mesh: Mesh, num_devices: int, parts_per_dev: int,
               cap_v: int):
-    from jax import shard_map
     local_block = parts_per_dev * cap_v
 
     @partial(shard_map, mesh=mesh,
@@ -134,7 +133,7 @@ def _count_fn(mesh: Mesh, num_devices: int, parts_per_dev: int,
 
         # the carry must start device-varying to match the loop output
         # (shard_map vma typing)
-        zero = lax.pcast(jnp.zeros((), jnp.int64), (AXIS,), to="varying")
+        zero = pvary(jnp.zeros((), jnp.int64), (AXIS,))
         _, total = lax.fori_loop(0, steps_, body, (frontier, zero))
         return lax.psum(total, AXIS)
 
@@ -154,7 +153,6 @@ def multi_hop_count_sharded(mesh: Mesh, frontier0, steps, kern: EdgeKernel,
 @lru_cache(maxsize=64)
 def _bfs_dist_fn(mesh: Mesh, num_devices: int, parts_per_dev: int,
                  cap_v: int):
-    from jax import shard_map
     local_block = parts_per_dev * cap_v
 
     @partial(shard_map, mesh=mesh,
@@ -181,7 +179,7 @@ def _bfs_dist_fn(mesh: Mesh, num_devices: int, parts_per_dev: int,
 
         # step must start device-varying to match the loop's carry
         # typing under shard_map (same vma rule as the count kernel)
-        step0 = lax.pcast(jnp.int32(0), (AXIS,), to="varying")
+        step0 = pvary(jnp.int32(0), (AXIS,))
         _, dist, _ = lax.while_loop(cond, body, (frontier, dist0, step0))
         return dist
 
@@ -214,7 +212,6 @@ def _batch_count_fn(mesh: Mesh, num_devices: int, n_slots: int,
     counts come from the device-local out-degrees psum'd at the end —
     the same collective shape the scaling-book recipe gives a
     replicated-activation sharded-weight matmul."""
-    from jax import shard_map
 
     @partial(shard_map, mesh=mesh,
              in_specs=(None, None, P(AXIS), None),
@@ -238,8 +235,7 @@ def _batch_count_fn(mesh: Mesh, num_devices: int, n_slots: int,
 
         # the frontier carry stays axis-INVARIANT: pmax's merge output
         # is identical on every device; only the count is varying
-        zero = lax.pcast(jnp.zeros((LANES,), jnp.int64), (AXIS,),
-                         to="varying")
+        zero = pvary(jnp.zeros((LANES,), jnp.int64), (AXIS,))
         _, total = lax.fori_loop(0, steps_, body, (F0, zero))
         return lax.psum(total, AXIS)
 

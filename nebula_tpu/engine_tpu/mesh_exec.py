@@ -1,0 +1,516 @@
+"""Mesh execution service: the full device query surface on sharded
+snapshots.
+
+distributed.py gives plain GO and SHORTEST a scatter/gather analogue of
+the reference's StorageClient::collectResponse fan-out
+(StorageClient.inl:73-160): per-device partition blocks, one
+`all_to_all` frontier exchange per hop. This module generalizes that
+per-shard-compute -> collective-merge pipeline to the REST of the
+device surface, so a sharded snapshot serves exactly what a
+single-chip one does:
+
+1. Batched dispatcher windows (`multi_hop_masks_batch_sharded`): the
+   cross-session group-commit window rides ONE replicated
+   [n_slots+1, LANES] packed frontier matrix; each device advances it
+   over its OWN aligned edge block (traverse._packed_hits) and the
+   per-hop merge is one elementwise `pmax` — the OR across devices,
+   the same collective shape as the sharded flagship counter. The
+   final hop gathers each device's CANONICAL edge block against the
+   lane matrix, so the output is the familiar [B, P, cap_e] mask
+   stack, partition-sharded over the mesh.
+
+2. Distributed aggregation pushdown (`mesh_reduce_specs`,
+   `mesh_grouped_reduce`): per-shard masked partials — COUNT,
+   non-null counts, MIN/MAX lattice partials, and the 8-bit
+   digit-chunk SUM partials of aggregate.py — computed inside
+   shard_map and combined with `psum` (grouped sums under the
+   single-pass row bound) or gathered per device (`out_specs
+   P(AXIS)`) and reassembled in host Python ints. Every exactness
+   bound in aggregate.py is preserved: device partials stay int32
+   under the same chunk sizes, and cross-device accumulation happens
+   in host int64/Python ints, never in a wrapping dtype.
+
+3. ALL/NOLOOP path expansion (`multi_hop_steps_sharded`): per-step
+   canonical edge masks over the sharded kernel — the sharded twin of
+   traverse.multi_hop_steps — with the per-hop frontier exchange of
+   distributed.py; path enumeration stays on the host
+   (engine._find_all_paths), reading the same mask stack it reads
+   single-chip.
+
+Everything here is provable on a host-emulated mesh
+(`JAX_PLATFORMS=cpu` + `XLA_FLAGS=--xla_force_host_platform_device_
+count=N`, see docs/manual/8-mesh.md) — results must be identical to
+the CPU pipe by construction, which the mesh tests assert.
+"""
+from __future__ import annotations
+
+from functools import lru_cache, partial
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+import threading
+
+from . import aggregate
+from .distributed import AXIS, _exchange, shard_aligned_blocks
+from .shard_compat import shard_map
+from .traverse import (LANES, _edge_ok, _init_lanes, _packed_hits,
+                       _packed_src_eff, hop_hits)
+
+_BIAS = 1 << 31
+
+# serializes sharded aligned-block builds: prewarm, repack and the
+# dispatcher's kick thread can all reach ensure_sharded_aligned for
+# the same fresh snapshot; one O(E) build + device_put is plenty
+_aligned_build_lock = threading.Lock()
+
+
+# ---------------------------------------------------------------------------
+# sharded aligned layout cache (the dispatcher window's edge streams)
+# ---------------------------------------------------------------------------
+
+def sharded_aligned_ready(snap):
+    """The cached per-device aligned blocks, or None — NEVER builds
+    (the dispatcher's locked phase must not pay an O(E) build; the
+    single-chip path keeps the same invariant via aligned_ready)."""
+    cached = getattr(snap, "_sharded_aligned", None)
+    return None if cached in (None, "failed") else cached
+
+
+def ensure_sharded_aligned(mesh, snap):
+    """The snapshot's per-device aligned blocks for batched windows,
+    built once and cached on the snapshot (meshed snapshots rebuild on
+    every version change, so the cache never goes stale mid-life).
+    Returns (AlignedKernel[D, ...], chunk, group) or None when the
+    layout can't be built; a failed build is cached as a decline so a
+    hot dispatcher never retries a doomed build per window."""
+    cached = getattr(snap, "_sharded_aligned", None)
+    if cached is not None:
+        return None if cached == "failed" else cached
+    with _aligned_build_lock:
+        cached = getattr(snap, "_sharded_aligned", None)   # lost race
+        if cached is not None:
+            return None if cached == "failed" else cached
+        try:
+            built = shard_aligned_blocks(mesh, snap)
+        except Exception:
+            snap._sharded_aligned = "failed"
+            return None
+        snap._sharded_aligned = built
+        return built
+
+
+# ---------------------------------------------------------------------------
+# 1. batched dispatcher windows on the mesh
+# ---------------------------------------------------------------------------
+
+@lru_cache(maxsize=64)
+def _batch_masks_fn(mesh, num_devices: int, parts_per_dev: int,
+                    cap_v: int, cap_e: int, n_slots: int, chunk: int,
+                    group: int, batch: int):
+    """shard_map'd window kernel: replicated packed frontier matrix,
+    per-device aligned-block advance, pmax merge per hop, one
+    canonical gather per device block for the final masks."""
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(None, None, P(AXIS), P(AXIS), None),
+             out_specs=P(None, AXIS))
+    def run(frontiers0, steps_, ak_, kern_, req):
+        ak = jax.tree.map(lambda a: a[0], ak_)   # this device's block
+        k = jax.tree.map(lambda a: a[0], kern_)
+        # lane matrix built ON DEVICE from the replicated [B, P, cap_v]
+        # frontiers (traverse._init_lanes, the single-chip prologue):
+        # a host-built [n_slots+1, LANES] matrix would mean a ~P*cap_v
+        # x128 byte alloc + transfer per window, under the engine lock
+        F0 = _init_lanes(frontiers0, n_slots)
+        src_eff = _packed_src_eff(ak, req, n_slots, chunk, group)
+        g_idx = ak.cbound // group
+        j_idx = ak.cbound % group
+
+        def body(_, f):
+            hits = _packed_hits(f, src_eff, g_idx, j_idx, n_slots,
+                                chunk, group).astype(jnp.int8)
+            # OR across devices; the merged matrix is identical
+            # everywhere, so the loop carry stays axis-invariant (the
+            # same collective shape as the sharded batched counter)
+            merged = lax.pmax(hits, AXIS)
+            return jnp.pad(merged, ((0, 1), (0, 0)))
+
+        F = lax.fori_loop(0, jnp.maximum(steps_ - 1, 0), body, F0)
+        # final hop: gather THIS block's canonical edges against the
+        # lane matrix — active[b, p, e] = F[global_src(p, e), b] & ok
+        d = lax.axis_index(AXIS)
+        gsrc = ((d * parts_per_dev
+                 + jnp.arange(parts_per_dev, dtype=jnp.int32))[:, None]
+                * cap_v + k.src)                 # [bp, cap_e] global slot
+        rows = F[:, :batch][gsrc.reshape(-1)]    # [bp*cap_e, B] int8
+        ok_c = _edge_ok(k.etype, k.valid, req)
+        masks = (rows.reshape(parts_per_dev, cap_e, batch) > 0) \
+            & ok_c[..., None]
+        return jnp.moveaxis(masks, 2, 0)         # [B, bp, cap_e]
+
+    return jax.jit(run)
+
+
+def multi_hop_masks_batch_sharded(mesh, frontiers0, steps, ak, kern,
+                                  req_types, chunk: int,
+                                  group: int) -> jnp.ndarray:
+    """Distributed dispatcher window: final-hop active edge masks for a
+    batch of GO queries in ONE sharded dispatch. frontiers0
+    bool[B, P, cap_v]; ak from shard_aligned_blocks / kern the
+    snapshot's sharded EdgeKernel (both leading-dim sharded over the
+    mesh). -> bool[B, P, cap_e], partition-sharded over axis 1.
+    Identical semantics to traverse.multi_hop_masks_batch."""
+    B, num_parts, cap_v = frontiers0.shape
+    if B > LANES:
+        raise ValueError(f"batch {B} > {LANES} lanes per dispatch")
+    D = mesh.devices.size
+    assert num_parts % D == 0
+    ns = num_parts * cap_v
+    cap_e = int(kern.src.shape[-1])
+    fn = _batch_masks_fn(mesh, D, num_parts // D, cap_v, cap_e, ns,
+                         chunk, group, B)
+    return fn(jnp.asarray(frontiers0), steps, ak, kern, req_types)
+
+
+# ---------------------------------------------------------------------------
+# 3. ALL/NOLOOP path: per-step canonical masks on the mesh
+# ---------------------------------------------------------------------------
+
+@lru_cache(maxsize=64)
+def _steps_masks_fn(mesh, num_devices: int, parts_per_dev: int,
+                    cap_v: int, steps: int):
+    local_block = parts_per_dev * cap_v
+
+    @partial(shard_map, mesh=mesh,
+             in_specs=(P(AXIS), P(AXIS), None),
+             out_specs=P(None, AXIS))
+    def run(frontier, kern_, req):
+        k = jax.tree.map(lambda a: a[0], kern_)
+        edge_ok = _edge_ok(k.etype, k.valid, req)
+        ok_sorted = _edge_ok(k.etype_sorted, k.valid_sorted, req)
+        masks = []
+        f = frontier
+        for _ in range(steps):
+            masks.append(jnp.take_along_axis(f, k.src, axis=1) & edge_ok)
+            hits, _n = hop_hits(f, k.src_sorted, ok_sorted,
+                                k.seg_starts, k.seg_ends)
+            f = _exchange(hits, num_devices, local_block).reshape(
+                parts_per_dev, cap_v)
+        return jnp.stack(masks)                  # [steps, bp, cap_e]
+
+    return jax.jit(run)
+
+
+def multi_hop_steps_sharded(mesh, frontier0, kern, req_types,
+                            steps: int) -> jnp.ndarray:
+    """Per-step active edge masks over the sharded kernel (the
+    engine's ALL/NOLOOP path expansion input): `steps` is static, one
+    trace per N, exactly like traverse.multi_hop_steps.
+    -> bool[steps, P, cap_e], partition-sharded over axis 1."""
+    num_parts, cap_v = frontier0.shape
+    D = mesh.devices.size
+    assert num_parts % D == 0
+    fn = _steps_masks_fn(mesh, D, num_parts // D, cap_v, int(steps))
+    return fn(frontier0, kern, req_types)
+
+
+# ---------------------------------------------------------------------------
+# 2. distributed aggregation: per-shard partials, psum/gather merge
+# ---------------------------------------------------------------------------
+
+def _bcast_val(active, v):
+    """Normalize a compiled _Val's (value, null) to full [P, cap_e]
+    device arrays (filter_compile leaves scalars for literal-only
+    nulls)."""
+    value = jnp.broadcast_to(jnp.asarray(v.value, jnp.int32),
+                             active.shape)
+    null = jnp.broadcast_to(jnp.asarray(v.null, bool), active.shape)
+    return value, null
+
+
+@lru_cache(maxsize=64)
+def _active_count_fn(mesh):
+    @partial(shard_map, mesh=mesh, in_specs=(P(AXIS),),
+             out_specs=P(AXIS))
+    def run(active):
+        # per-device row count (int32 exact: a block holds < 2^31
+        # slots); summed on the host in Python ints
+        return active.sum(dtype=jnp.int32)[None]
+
+    return jax.jit(run)
+
+
+def mesh_active_count(mesh, active) -> int:
+    """Exact COUNT over a sharded row mask: per-device int32 partials
+    gathered and summed host-side."""
+    parts = np.asarray(_active_count_fn(mesh)(active))
+    return int(parts.astype(object).sum())
+
+
+@lru_cache(maxsize=64)
+def _reduce_partials_fn(mesh, n_chunks: int, chunk_slots: int):
+    """Per-device partials for one value column: (count, nonnull,
+    min, max, digit-chunk sums). Digit partials follow
+    aggregate.exact_int_sum's discipline — int32 sums over chunks of
+    `chunk_slots` (chunk_sum <= chunk_slots * 255 < 2^31) — but per
+    DEVICE; the host reassembles across chunks AND devices in Python
+    ints, so no cross-device dtype ever accumulates."""
+
+    @partial(shard_map, mesh=mesh, in_specs=(P(AXIS),) * 3,
+             out_specs=(P(AXIS),) * 4)
+    def run(value, null, active):
+        m = active & ~null
+        nn = m.sum(dtype=jnp.int32)
+        mn = jnp.min(jnp.where(m, value, jnp.int32(2**31 - 1)))
+        mx = jnp.max(jnp.where(m, value, jnp.int32(-(2**31))))
+        u = (value.astype(jnp.uint32) + jnp.uint32(_BIAS)).reshape(-1)
+        mf = m.reshape(-1)
+        pad = n_chunks * chunk_slots - u.shape[0]
+        u = jnp.pad(u, (0, pad)).reshape(n_chunks, chunk_slots)
+        mf = jnp.pad(mf, (0, pad)).reshape(n_chunks, chunk_slots)
+        digits = []
+        for k in range(4):
+            d = ((u >> jnp.uint32(8 * k)) & jnp.uint32(0xFF)) \
+                .astype(jnp.int32)
+            digits.append(jnp.sum(jnp.where(mf, d, 0), axis=-1))
+        return (nn[None], mn[None], mx[None],
+                jnp.stack(digits)[None])         # [1, 4, n_chunks]
+
+    return jax.jit(run)
+
+
+def _column_partials(mesh, active, v):
+    """-> (nonnull int, min int|None raw, max raw, exact sum int) for
+    one value column over the sharded active mask."""
+    value, null = _bcast_val(active, v)
+    loc_slots = (active.shape[0] // mesh.devices.size) * active.shape[1]
+    chunk_slots = min(aggregate.SUM_CHUNK, max(loc_slots, 1))
+    n_chunks = max(1, -(-loc_slots // chunk_slots))
+    fn = _reduce_partials_fn(mesh, n_chunks, chunk_slots)
+    nn_d, mn_d, mx_d, dig_d = fn(value, null, active)
+    nn_d = np.asarray(nn_d)
+    nonnull = int(nn_d.astype(object).sum())
+    mn = int(np.asarray(mn_d).min())
+    mx = int(np.asarray(mx_d).max())
+    dig = np.asarray(dig_d)                      # [D, 4, n_chunks]
+    total = 0
+    for k in range(4):
+        total += int(dig[:, k, :].astype(object).sum()) << (8 * k)
+    total -= nonnull * _BIAS
+    return nonnull, mn, mx, total
+
+
+def mesh_reduce_specs(specs, active, vals, mesh) -> Optional[List]:
+    """aggregate.reduce_specs over a SHARDED active mask: per-shard
+    masked partials computed inside shard_map, gathered per device,
+    reassembled exactly on the host. Same result-row contract (CPU-
+    identical Python values); never hits reduce_specs' device-wide
+    transfer of the full mask."""
+    n_rows = mesh_active_count(mesh, active)
+    row: List = []
+    cache: Dict = {}
+    for fun, key in specs:
+        if fun == "COUNT":
+            row.append(n_rows)
+            continue
+        if key not in cache:
+            cache[key] = _column_partials(mesh, active, vals[key])
+        nonnull, mn, mx, total = cache[key]
+        if nonnull == 0:
+            row.append(None)
+            continue
+        if fun == "MIN":
+            row.append(mn)
+        elif fun == "MAX":
+            row.append(mx)
+        else:
+            row.append(total if fun == "SUM" else total / nonnull)
+    return row
+
+
+# -- grouped (GROUP BY dst) --------------------------------------------------
+
+@lru_cache(maxsize=64)
+def _grouped_count_fn(mesh, n_groups: int, flat_len: int,
+                      count_chunk: int):
+    """Per-device masked scatter-counts into the global group bins,
+    one int32 pass per `count_chunk` slots (each pass's bins < 2^31:
+    a slot contributes <= 1) — the distributed form of
+    aggregate._scatter_count_i64. Output [D, n_passes, n_groups]
+    int32; the host accumulates across passes and devices in int64,
+    keeping grouped COUNT exact to ~2^63 rows."""
+    n_passes = max(1, -(-flat_len // count_chunk))
+
+    @partial(shard_map, mesh=mesh, in_specs=(P(AXIS),) * 2,
+             out_specs=P(AXIS))
+    def run(mask, gidx):
+        mf = mask.reshape(-1)
+        gf = gidx.reshape(-1)
+        passes = []
+        for c in range(0, max(flat_len, 1), count_chunk):
+            part = (jnp.zeros(n_groups + 1, jnp.int32)
+                    .at[gf[c:c + count_chunk]]
+                    .add(mf[c:c + count_chunk].astype(jnp.int32)))
+            passes.append(part[:n_groups])
+        return jnp.stack(passes)[None]           # [1, n_passes, G]
+
+    return jax.jit(run), n_passes
+
+
+def _mesh_scatter_count(mesh, mask, gidx, n_groups: int) -> np.ndarray:
+    """int64[n_groups] exact masked group counts over sharded inputs.
+    The pass width follows aggregate.COUNT_CHUNK at call time (tests
+    pin it small to exercise the chunk boundary)."""
+    flat_len = (mask.shape[0] // mesh.devices.size) * mask.shape[1]
+    fn, _ = _grouped_count_fn(mesh, n_groups, flat_len,
+                              int(aggregate.COUNT_CHUNK))
+    parts = np.asarray(fn(mask, gidx))           # [D, n_passes, G] i32
+    return parts.astype(np.int64).sum(axis=(0, 1))
+
+
+@lru_cache(maxsize=64)
+def _grouped_digit_psum_fn(mesh, n_groups: int):
+    """Single-pass grouped digit sums merged with psum ON DEVICE:
+    exact while TOTAL masked rows <= MAX_GROUPED_SUM_ROWS (rows * 255
+    < 2^31 across ALL devices' contributions — the identical bound the
+    single-chip single-pass reduction enforces). out: replicated
+    [4, n_groups] int32."""
+
+    @partial(shard_map, mesh=mesh, in_specs=(P(AXIS),) * 3,
+             out_specs=P())
+    def run(u, mask, gidx):
+        mf = mask.reshape(-1)
+        gf = gidx.reshape(-1)
+        uf = u.reshape(-1)
+        digits = []
+        for k in range(4):
+            d = ((uf >> jnp.uint32(8 * k)) & jnp.uint32(0xFF)) \
+                .astype(jnp.int32)
+            part = (jnp.zeros(n_groups + 1, jnp.int32)
+                    .at[gf].add(jnp.where(mf, d, 0)))[:n_groups]
+            digits.append(part)
+        return lax.psum(jnp.stack(digits), AXIS)
+
+    return jax.jit(run)
+
+
+@lru_cache(maxsize=64)
+def _grouped_digit_gather_fn(mesh, n_groups: int, flat_len: int,
+                             sum_seg: int):
+    """Chunked per-device grouped digit partials for beyond-bound sums:
+    each SUM_SEG pass's int32 bins are exact (<= sum_seg * 255 < 2^31);
+    out [D, n_segs, 4, n_groups] accumulated host-side in int64 —
+    grouped SUM/AVG stays exact to ~2^55 rows on the mesh, the same
+    bound as aggregate.grouped_reduce."""
+    n_segs = max(1, -(-flat_len // sum_seg))
+
+    @partial(shard_map, mesh=mesh, in_specs=(P(AXIS),) * 3,
+             out_specs=P(AXIS))
+    def run(u, mask, gidx):
+        mf = mask.reshape(-1)
+        gf = gidx.reshape(-1)
+        uf = u.reshape(-1)
+        segs = []
+        for c in range(0, max(flat_len, 1), sum_seg):
+            digits = []
+            for k in range(4):
+                d = ((uf[c:c + sum_seg] >> jnp.uint32(8 * k))
+                     & jnp.uint32(0xFF)).astype(jnp.int32)
+                part = (jnp.zeros(n_groups + 1, jnp.int32)
+                        .at[gf[c:c + sum_seg]]
+                        .add(jnp.where(mf[c:c + sum_seg], d, 0))
+                        )[:n_groups]
+                digits.append(part)
+            segs.append(jnp.stack(digits))
+        return jnp.stack(segs)[None]             # [1, n_segs, 4, G]
+
+    return jax.jit(run)
+
+
+@lru_cache(maxsize=64)
+def _grouped_minmax_fn(mesh, n_groups: int):
+    @partial(shard_map, mesh=mesh, in_specs=(P(AXIS),) * 3,
+             out_specs=(P(AXIS), P(AXIS)))
+    def run(value, mask, gidx):
+        gf = gidx.reshape(-1)
+        lo = jnp.where(mask, value, jnp.int32(2**31 - 1)).reshape(-1)
+        hi = jnp.where(mask, value, jnp.int32(-(2**31))).reshape(-1)
+        mn = (jnp.full(n_groups + 1, 2**31 - 1, jnp.int32)
+              .at[gf].min(lo))[:n_groups]
+        mx = (jnp.full(n_groups + 1, -(2**31), jnp.int32)
+              .at[gf].max(hi))[:n_groups]
+        return mn[None], mx[None]
+
+    return jax.jit(run)
+
+
+def mesh_grouped_reduce(specs, active, vals, gidx, n_groups: int,
+                        mesh, stats: Optional[Dict] = None
+                        ) -> Tuple[np.ndarray, List[List]]:
+    """aggregate.grouped_reduce over a SHARDED mask: same signature
+    contract -> (sorted group slots, per-spec python-value columns).
+    COUNT and non-null counts ride chunked per-device scatter passes
+    (host int64 accumulation, exact to ~2^63 rows); SUM/AVG take the
+    device psum fast path under the single-pass row bound and fall to
+    chunked gathered partials past it (exact to ~2^55 rows, counted in
+    `stats` as agg_grouped_chunked just like the single-chip path);
+    MIN/MAX are per-device lattice partials combined on the host."""
+    counts = _mesh_scatter_count(mesh, active, gidx, n_groups)
+    groups = np.nonzero(counts)[0]
+    out: List[List] = []
+    cache: Dict = {}
+    chunked_counted = False
+    loc_flat = (active.shape[0] // mesh.devices.size) * active.shape[1]
+    for fun, key in specs:
+        if fun == "COUNT":
+            out.append([int(x) for x in counts[groups]])
+            continue
+        v = vals[key]
+        if key not in cache:
+            value, null = _bcast_val(active, v)
+            mk = active & ~null
+            nn = _mesh_scatter_count(mesh, mk, gidx, n_groups)
+            cache[key] = (value, mk, nn)
+        value, mk, nonnull = cache[key]
+        nn = nonnull[groups]
+        if fun in ("MIN", "MAX"):
+            mn_d, mx_d = _grouped_minmax_fn(mesh, n_groups)(value, mk,
+                                                            gidx)
+            sel = (np.asarray(mn_d).min(axis=0) if fun == "MIN"
+                   else np.asarray(mx_d).max(axis=0))[groups]
+            out.append([int(x) if c else None for x, c in zip(sel, nn)])
+            continue
+        u = value.astype(jnp.uint32) + jnp.uint32(_BIAS)
+        n_masked = int(nonnull.sum())
+        if n_masked <= aggregate.MAX_GROUPED_SUM_ROWS:
+            dig = np.asarray(_grouped_digit_psum_fn(mesh, n_groups)(
+                u, mk, gidx)).astype(np.int64)   # [4, G], exact
+            total = np.zeros(n_groups, np.int64)
+            for k in range(4):
+                total += dig[k] << (8 * k)
+        else:
+            if stats is not None and not chunked_counted:
+                # once per QUERY, matching the single-chip counter
+                chunked_counted = True
+                stats["agg_grouped_chunked"] = \
+                    stats.get("agg_grouped_chunked", 0) + 1
+            fn = _grouped_digit_gather_fn(mesh, n_groups, loc_flat,
+                                          int(aggregate.SUM_SEG))
+            parts = np.asarray(fn(u, mk, gidx))  # [D, nS, 4, G] i32
+            total = np.zeros(n_groups, np.int64)
+            for k in range(4):
+                total += parts[:, :, k, :].astype(np.int64) \
+                    .sum(axis=(0, 1)) << (8 * k)
+        total -= nonnull * _BIAS
+        sel = total[groups]
+        if fun == "SUM":
+            out.append([int(x) if c else None for x, c in zip(sel, nn)])
+        else:                      # AVG: exact integer sum / count
+            out.append([int(x) / int(c) if c else None
+                        for x, c in zip(sel, nn)])
+    return groups, out

@@ -1,0 +1,46 @@
+"""JAX version-compat shim for the mesh execution path.
+
+The distributed kernels (distributed.py, mesh_exec.py) are written
+against the modern shard_map surface: `jax.shard_map` plus explicit
+varying-manual-axes casts (`lax.pcast(..., to="varying")`) for loop
+carries. Older jax releases ship shard_map under
+`jax.experimental.shard_map` and have no vma typing at all — there the
+pcast is semantically a no-op (the old check_rep machinery infers
+replication instead of demanding explicit casts).
+
+Every shard_map consumer in the engine imports from HERE so the
+version probe happens in exactly one place. Resolution order:
+
+  shard_map:  jax.shard_map  ->  jax.experimental.shard_map.shard_map
+  pvary:      lax.pcast(to="varying")  ->  lax.pvary  ->  identity
+"""
+from __future__ import annotations
+
+from jax import lax
+
+try:                                    # modern surface (jax >= 0.6)
+    from jax import shard_map
+except ImportError:                     # legacy experimental location
+    from functools import partial as _partial
+
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    # the legacy replication checker has no rule for while/fori_loop
+    # (every traversal kernel's core), so its own documented workaround
+    # is applied once here; the modern vma checker stays ON via the
+    # branch above, so new-jax runs keep full checking
+    shard_map = _partial(_shard_map, check_rep=False)
+
+
+def pvary(x, axis_names):
+    """Mark `x` as device-varying over `axis_names` for shard_map's
+    vma typing (loop carries must start varying when the loop body
+    makes them varying). On jax versions without vma typing this is
+    the identity — the old check_rep inference needs no cast."""
+    pc = getattr(lax, "pcast", None)
+    if pc is not None:
+        return pc(x, axis_names, to="varying")
+    pv = getattr(lax, "pvary", None)
+    if pv is not None:
+        return pv(x, axis_names)
+    return x

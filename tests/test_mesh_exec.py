@@ -1,0 +1,343 @@
+"""Mesh execution service tests (engine_tpu/mesh_exec.py): the full
+device query surface on SHARDED snapshots — batched dispatcher windows,
+distributed aggregation partials, ALL/NOLOOP path expansion — must be
+identical to the single-device kernels AND to the CPU pipe, on the
+8-virtual-device CPU mesh conftest provisions."""
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nba_fixture import load_nba
+from nebula_tpu.cluster import InProcCluster
+from nebula_tpu.engine_tpu import TpuGraphEngine, aggregate, traverse
+from nebula_tpu.engine_tpu import distributed as dist
+from nebula_tpu.engine_tpu import mesh_exec
+
+
+def _drain_engine(tpu):
+    """Join the engine's background threads (prewarm compiles, budget
+    refits) so no daemon thread is still inside XLA when the
+    interpreter exits — that aborts the whole pytest process."""
+    for t in list(tpu._prewarm_threads.values()):
+        t.join(timeout=300)
+    import time
+    for _ in range(600):
+        if not tpu._recalibrating:
+            return
+        time.sleep(0.05)
+
+
+@pytest.fixture(scope="module")
+def snap8():
+    tpu = TpuGraphEngine()
+    cluster = InProcCluster(tpu_engine=tpu)
+    _, conn = load_nba(cluster, space="mex8", parts=8)
+    space_id = cluster.meta.get_space("mex8").value().space_id
+    yield tpu.snapshot(space_id)
+    _drain_engine(tpu)
+
+
+# ---------------------------------------------------------------------------
+# kernel level: sharded window masks / per-step masks == single-device
+# ---------------------------------------------------------------------------
+
+def test_batched_masks_sharded_identity(snap8):
+    """The sharded lane-matrix window kernel must emit exactly the
+    per-query multi_hop final masks, lane by lane."""
+    mesh = dist.make_mesh()
+    kern = dist.shard_snapshot_arrays(mesh, snap8)
+    ak, chunk, group = dist.shard_aligned_blocks(mesh, snap8)
+    seeds = [[100], [101, 102], [103], [100, 107, 109]]
+    f_batch = jnp.asarray(np.stack(
+        [snap8.frontier_from_vids(s) for s in seeds]))
+    for req_list in ([1], [1, -1]):
+        req = jnp.asarray(traverse.pad_edge_types(req_list))
+        for steps in (1, 2, 3):
+            out = np.asarray(mesh_exec.multi_hop_masks_batch_sharded(
+                mesh, f_batch, jnp.int32(steps), ak, kern, req,
+                chunk, group))
+            for i, s in enumerate(seeds):
+                _, single = traverse.multi_hop(
+                    jnp.asarray(snap8.frontier_from_vids(s)),
+                    jnp.int32(steps), snap8.kernel, req)
+                assert np.array_equal(out[i], np.asarray(single)), \
+                    (req_list, steps, s)
+
+
+def test_steps_masks_sharded_identity(snap8):
+    """Per-step sharded masks (the ALL-path expansion input) ==
+    traverse.multi_hop_steps for every step."""
+    mesh = dist.make_mesh()
+    kern = dist.shard_snapshot_arrays(mesh, snap8)
+    req = jnp.asarray(traverse.pad_edge_types([1]))
+    f0 = jnp.asarray(snap8.frontier_from_vids([100, 103]))
+    for steps in (1, 2, 4):
+        sharded = np.asarray(mesh_exec.multi_hop_steps_sharded(
+            mesh, f0, kern, req, steps))
+        single = np.asarray(traverse.multi_hop_steps(
+            f0, snap8.kernel, req, steps=steps))
+        assert np.array_equal(sharded, single), steps
+
+
+# ---------------------------------------------------------------------------
+# distributed aggregation partials: exactness incl. the chunk boundary
+# ---------------------------------------------------------------------------
+
+def _sharded_mask_and_groups(mesh, P_, cap_e, n_groups, seed=3):
+    rng = np.random.default_rng(seed)
+    mask = jnp.asarray(rng.random((P_, cap_e)) < 0.5)
+    gidx = jnp.asarray(
+        rng.integers(0, n_groups, (P_, cap_e)).astype(np.int32))
+    return mask, gidx
+
+
+def test_mesh_scatter_count_chunk_boundary(monkeypatch):
+    """Distributed grouped COUNT at the COUNT_CHUNK pass boundary:
+    with the pass width pinned tiny (forcing many int32 passes whose
+    host accumulation crosses the boundary mid-device), the counts
+    must equal a plain numpy bincount — the exactness claim of the
+    chunked discipline, not just the single-pass case."""
+    mesh = dist.make_mesh()
+    P_, cap_e, n_groups = 8, 96, 17
+    mask, gidx = _sharded_mask_and_groups(mesh, P_, cap_e, n_groups)
+    expect = np.bincount(
+        np.asarray(gidx).reshape(-1)[np.asarray(mask).reshape(-1)],
+        minlength=n_groups)
+    # flat per-device length is 96: 40 forces passes [40, 40, 16] —
+    # boundaries both inside and at the end of a device block
+    for chunk in (40, 96, 7, 1 << 30):
+        monkeypatch.setattr(aggregate, "COUNT_CHUNK", chunk)
+        got = mesh_exec._mesh_scatter_count(mesh, mask, gidx, n_groups)
+        assert np.array_equal(got, expect), chunk
+
+
+def test_mesh_grouped_reduce_matches_host(monkeypatch):
+    """mesh_grouped_reduce == a plain numpy reference on random
+    values, across BOTH sum paths (device psum under the single-pass
+    bound, chunked gathered partials past it) and a tiny COUNT pass
+    width."""
+    mesh = dist.make_mesh()
+    P_, cap_e, n_groups = 8, 64, 11
+    rng = np.random.default_rng(9)
+    mask, gidx = _sharded_mask_and_groups(mesh, P_, cap_e, n_groups)
+    vals_np = rng.integers(-2**31, 2**31, (P_, cap_e)).astype(np.int64)
+    null_np = rng.random((P_, cap_e)) < 0.2
+
+    class V:                      # the compiled-_Val duck shape
+        value = jnp.asarray(vals_np.astype(np.int32))
+        null = jnp.asarray(null_np)
+
+    specs = [("COUNT", None), ("SUM", "k"), ("MIN", "k"),
+             ("MAX", "k"), ("AVG", "k")]
+    m = np.asarray(mask)
+    mk = m & ~null_np
+    g = np.asarray(gidx)
+    i32 = vals_np.astype(np.int32).astype(np.int64)  # wrapped values
+    exp_groups = np.nonzero(np.bincount(g.reshape(-1),
+                                        weights=m.reshape(-1).astype(int),
+                                        minlength=n_groups))[0]
+
+    def reference(gi):
+        sel = mk & (g == gi)
+        vs = i32[sel]
+        cnt = int(m[g == gi].sum())
+        if vs.size == 0:
+            return cnt, None, None, None, None
+        s = int(sum(int(x) for x in vs))
+        return (cnt, s, int(vs.min()), int(vs.max()), s / len(vs))
+
+    for sum_bound in (1 << 23, 1):   # psum path, then chunked path
+        monkeypatch.setattr(aggregate, "MAX_GROUPED_SUM_ROWS", sum_bound)
+        monkeypatch.setattr(aggregate, "COUNT_CHUNK", 50)
+        stats = {}
+        groups, cols = mesh_exec.mesh_grouped_reduce(
+            specs, mask, {"k": V}, gidx, n_groups, mesh, stats=stats)
+        assert np.array_equal(groups, exp_groups)
+        if sum_bound == 1:
+            assert stats.get("agg_grouped_chunked", 0) >= 1
+        for j, gi in enumerate(groups):
+            cnt, s, lo, hi, avg = reference(int(gi))
+            assert cols[0][j] == cnt
+            assert cols[1][j] == s
+            assert cols[2][j] == lo
+            assert cols[3][j] == hi
+            assert cols[4][j] == avg
+
+
+# ---------------------------------------------------------------------------
+# engine level: the full meshed serving surface vs the CPU pipe
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def meshed_pair():
+    """(cpu_conn, meshed cluster, meshed conn, engine) over the same
+    NBA data; every traversal on the TPU side runs the 8-device
+    sharded path."""
+    _, cpu_conn = load_nba(space="mexcpu", parts=8)
+    tpu = TpuGraphEngine(mesh=dist.make_mesh())
+    cluster = InProcCluster(tpu_engine=tpu)
+    _, conn = load_nba(cluster, space="mextpu", parts=8)
+    # pre-build the per-device window layout: the engine only kicks it
+    # off-lock on first demand, and these tests assert window serving
+    # deterministically rather than racing the background build
+    sid = cluster.meta.get_space("mextpu").value().space_id
+    snap = tpu.snapshot(sid)
+    mesh_exec.ensure_sharded_aligned(tpu.mesh, snap)
+    yield cpu_conn, cluster, conn, tpu
+    _drain_engine(tpu)
+
+
+def test_meshed_dispatcher_mixed_key_windows(meshed_pair):
+    """Satellite: concurrent sessions with DIFFERING (space, steps,
+    edge_types) group keys on a SHARDED snapshot — every query must
+    coalesce through the dispatcher's meshed window kernel and return
+    exactly the CPU pipe's rows."""
+    cpu_conn, cluster, conn, tpu = meshed_pair
+    queries = ["GO 2 STEPS FROM 100 OVER like YIELD like._dst",
+               "GO 3 STEPS FROM 101 OVER like YIELD like._dst",
+               "GO FROM 102, 103 OVER like YIELD like._dst, "
+               "like.likeness",
+               "GO 2 STEPS FROM 105 OVER serve YIELD serve._dst",
+               # same group key as the first query, with a WHERE: the
+               # window mixes filtered and unfiltered requests, so the
+               # per-request compiled mask must AND into the SHARED
+               # sharded window masks
+               "GO 2 STEPS FROM 100 OVER like WHERE like.likeness > 60 "
+               "YIELD like._dst"]
+    expected = {q: sorted(map(str, cpu_conn.must(q).rows))
+                for q in queries}
+    before = tpu.mesh_served.get("go_batched", 0)
+    errors = []
+
+    def worker(q, reps):
+        try:
+            c = cluster.connect()
+            c.must("USE mextpu")
+            for _ in range(reps):
+                got = sorted(map(str, c.must(q).rows))
+                assert got == expected[q], q
+        except Exception as e:   # noqa: BLE001 — surfaced below
+            errors.append((q, repr(e)))
+
+    threads = [threading.Thread(target=worker, args=(q, 3))
+               for q in queries for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors[:3]
+    assert tpu.mesh_served.get("go_batched", 0) > before, \
+        (tpu.mesh_served, tpu.mesh_decline_reasons)
+    assert tpu.stats["batched_dispatches"] > 0
+
+
+def test_meshed_aggregate_pushdown(meshed_pair):
+    """Grouped + ungrouped aggregation on a sharded snapshot: served
+    by the distributed partials (mesh_served.agg), rows identical to
+    the CPU pipe."""
+    cpu_conn, _cluster, conn, tpu = meshed_pair
+    before = tpu.mesh_served.get("agg", 0)
+    for q in ("GO FROM 100, 101, 102 OVER serve YIELD "
+              "serve.start_year AS y | YIELD COUNT(*) AS n, "
+              "SUM($-.y) AS s, MIN($-.y) AS lo, MAX($-.y) AS hi, "
+              "AVG($-.y) AS a",
+              "GO FROM 100, 101, 102 OVER serve YIELD serve._dst AS t,"
+              " serve.start_year AS y | GROUP BY $-.t YIELD $-.t AS t,"
+              " COUNT(*) AS n, SUM($-.y) AS s, AVG($-.y) AS a"):
+        rc, rt = cpu_conn.must(q), conn.must(q)
+        assert sorted(map(repr, rc.rows)) == sorted(map(repr, rt.rows)), \
+            (q, rc.rows, rt.rows)
+    assert tpu.mesh_served.get("agg", 0) == before + 2, \
+        (tpu.mesh_served, tpu.agg_decline_reasons)
+
+
+def test_meshed_all_paths(meshed_pair):
+    """ALL and NOLOOP path on a sharded snapshot: per-step sharded
+    expansion + host enumeration, identical path strings to the CPU
+    executor."""
+    cpu_conn, _cluster, conn, tpu = meshed_pair
+    before = tpu.mesh_served.get("path_all", 0)
+    for q in ("FIND ALL PATH FROM 100 TO 102 OVER like UPTO 4 STEPS",
+              "FIND NOLOOP PATH FROM 103 TO 100 OVER like UPTO 5 STEPS"):
+        rc, rt = cpu_conn.must(q), conn.must(q)
+        assert sorted(map(str, rc.rows)) == sorted(map(str, rt.rows)), q
+    assert tpu.mesh_served.get("path_all", 0) == before + 2, \
+        (tpu.mesh_served, tpu.path_decline_reasons)
+    assert tpu.stats["path_served"] >= 2
+
+
+def test_meshed_where_window(meshed_pair):
+    """A WHERE-filtered window on the meshed dispatcher: the compiled
+    device mask ANDs into the sharded window masks exactly as it does
+    single-chip."""
+    cpu_conn, _cluster, conn, tpu = meshed_pair
+    q = ("GO FROM 100 OVER like WHERE like.likeness > 80 "
+         "YIELD like._dst, like.likeness")
+    rc, rt = cpu_conn.must(q), conn.must(q)
+    assert sorted(map(str, rc.rows)) == sorted(map(str, rt.rows))
+
+
+# ---------------------------------------------------------------------------
+# sparse-budget staleness (satellite): churn past the threshold
+# re-fits, pins are never overridden
+# ---------------------------------------------------------------------------
+
+def test_budget_recalibration_on_churn():
+    tpu = TpuGraphEngine()
+    cluster = InProcCluster(tpu_engine=tpu)
+    _, conn = load_nba(cluster, space="recal", parts=4)
+    sid = cluster.meta.get_space("recal").value().space_id
+    # let the USE-triggered prewarm (compiles + auto-calibration)
+    # finish first: its fit must not race the record planted below
+    tpu.prewarm(sid, block=True)
+    snap = tpu.snapshot(sid)
+    assert snap is not None
+    # a fit anchored BUDGET_RECAL_CHURN versions ago
+    tpu.sparse_budget_calibrations[sid] = {"fitted_budget": 123,
+                                           "churn_at_fit": 0}
+    tpu._space_budgets[sid] = 123
+    tpu._space_churn[sid] = tpu.BUDGET_RECAL_CHURN
+    before = tpu.stats["budget_recalibrations"]
+    t = tpu._maybe_recalibrate(sid, snap)
+    assert t is not None
+    t.join(timeout=120)
+    assert tpu.stats["budget_recalibrations"] == before + 1
+    rec = tpu.sparse_budget_calibrations.get(sid)
+    assert rec is not None and rec["fitted_budget"] != 123
+    assert rec["churn_at_fit"] == tpu._space_churn[sid]
+    # under the threshold: nothing re-fits
+    assert tpu._maybe_recalibrate(sid, snap) is None
+    # a pinned budget is never touched, whatever the churn
+    tpu.sparse_edge_budget = 7
+    tpu._space_churn[sid] = 10 * tpu.BUDGET_RECAL_CHURN
+    assert tpu._maybe_recalibrate(sid, snap) is None
+    assert tpu.sparse_edge_budget == 7
+    _drain_engine(tpu)
+
+
+def test_budget_recalibration_via_refresh():
+    """The staleness check rides the real rebuild path: refresh()
+    bumps churn and, past the threshold, drops + refits the record."""
+    tpu = TpuGraphEngine()
+    cluster = InProcCluster(tpu_engine=tpu)
+    _, conn = load_nba(cluster, space="recal2", parts=4)
+    sid = cluster.meta.get_space("recal2").value().space_id
+    tpu.prewarm(sid, block=True)
+    assert tpu.snapshot(sid) is not None
+    tpu.sparse_budget_calibrations[sid] = {"fitted_budget": 5,
+                                           "churn_at_fit": 0}
+    tpu._space_churn[sid] = tpu.BUDGET_RECAL_CHURN - 1
+    with tpu._lock:
+        assert tpu.refresh(sid) is not None   # churn hits the threshold
+    for _ in range(600):
+        if sid not in tpu._recalibrating:
+            break
+        import time
+        time.sleep(0.05)
+    assert tpu.stats["budget_recalibrations"] == 1
+    rec = tpu.sparse_budget_calibrations.get(sid)
+    assert rec is not None and rec["fitted_budget"] != 5
+    _drain_engine(tpu)

@@ -423,5 +423,10 @@ def test_graphd_tpu_stats_endpoint():
         assert body["stats"]["go_served"] >= 1, body
         assert "agg_decline_reasons" in body
         assert isinstance(body["sparse_edge_budget"], int)
+        # mesh serving matrix (mesh_exec.py): always present so
+        # dashboards can alert on declined-on-mesh features; empty
+        # dicts on this unmeshed graphd
+        assert body["mesh"] == {"served": {}, "declined": {}}, body
+        assert "budget_recalibrations" in body["stats"]
     finally:
         graphd.stop(); storaged.stop(); metad.stop()
