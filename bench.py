@@ -377,7 +377,11 @@ def bench_full_queries(conn, tpu, snap, etype, seed_sets):
                                     "mesh_served": dict(tpu.mesh_served),
                                     "mesh_declined": {
                                         f: dict(d) for f, d in
-                                        tpu.mesh_decline_reasons.items()}}
+                                        tpu.mesh_decline_reasons.items()},
+                                    # degradation ladder: breaker state
+                                    # + trip/degrade/deadline counters
+                                    # (all zero on a healthy run)
+                                    "robustness": tpu.robustness_stats()}
 
 
 def bench_stats_query(conn, tpu, seed_sets):
@@ -508,7 +512,8 @@ def bench_concurrent(cluster, tpu, seed_sets, seconds=6.0, sessions=8):
                d["group_wait_us_total"] / max(d["group_wait_count"], 1)),
            "mesh_served": dict(tpu.mesh_served),
            "mesh_declined": {f: dict(dd) for f, dd in
-                             tpu.mesh_decline_reasons.items()}}
+                             tpu.mesh_decline_reasons.items()},
+           "robustness": tpu.robustness_stats()}
     log(f"tier3 concurrent ({sessions} sessions, {wall:.1f}s): "
         f"{out['qps']} QPS aggregate, {d['batched_queries']} queries "
         f"over {d['batched_dispatches']} shared dispatches "
@@ -608,6 +613,34 @@ def _ensure_backend():
     return label
 
 
+def zipf_edges(rng, v, e, clip=200):
+    """Clipped-zipf edge lists for the small in-proc tiers (mesh
+    dryrun, chaos): -> (srcs, dsts, ts)."""
+    deg = np.minimum(rng.zipf(1.6, v), clip).astype(np.int64)
+    srcs = np.repeat(np.arange(v), deg)
+    if len(srcs) < e:
+        srcs = np.concatenate([srcs, rng.integers(0, v, e - len(srcs))])
+    return srcs[:e], rng.integers(0, v, e), rng.integers(0, TS_MAX, e)
+
+
+def insert_person_knows(conn, space, parts, v, srcs, dsts, ts):
+    """Create the person(age)/knows(ts) schema in `space` and batch-
+    INSERT the generated graph through real nGQL (shared by the mesh
+    dryrun and chaos tiers)."""
+    conn.must(f"CREATE SPACE {space}(partition_num={parts})")
+    conn.must(f"USE {space}")
+    conn.must("CREATE TAG person(age int)")
+    conn.must("CREATE EDGE knows(ts int)")
+    B = 500
+    for i in range(0, v, B):
+        conn.must("INSERT VERTEX person(age) VALUES " + ", ".join(
+            f"{j}:({20 + j % 60})" for j in range(i, min(i + B, v))))
+    for i in range(0, len(srcs), B):
+        conn.must("INSERT EDGE knows(ts) VALUES " + ", ".join(
+            f"{srcs[j]} -> {dsts[j]}@{j}:({ts[j]})"
+            for j in range(i, min(i + B, len(srcs)))))
+
+
 def bench_mesh_dryrun(out_path: str, n_devices: int = 4):
     """Tier-1-safe mesh smoke tier (`bench.py --mesh-dryrun`): boot a
     host-emulated n-device mesh (JAX_PLATFORMS=cpu +
@@ -639,28 +672,11 @@ def bench_mesh_dryrun(out_path: str, n_devices: int = 4):
 
     rng = np.random.default_rng(5)
     V, E = 600, 6000
-    deg = np.minimum(rng.zipf(1.6, V), 200).astype(np.int64)
-    srcs = np.repeat(np.arange(V), deg)
-    if len(srcs) < E:
-        srcs = np.concatenate([srcs, rng.integers(0, V, E - len(srcs))])
-    srcs, dsts = srcs[:E], rng.integers(0, V, E)
-    ts = rng.integers(0, TS_MAX, E)
+    srcs, dsts, ts = zipf_edges(rng, V, E, clip=200)
     conns = []
     for cl in clusters:
         conn = cl.connect()
-        conn.must(f"CREATE SPACE meshdry(partition_num={parts})")
-        conn.must("USE meshdry")
-        conn.must("CREATE TAG person(age int)")
-        conn.must("CREATE EDGE knows(ts int)")
-        B = 500
-        for i in range(0, V, B):
-            vals = ", ".join(f"{v}:({20 + v % 60})"
-                             for v in range(i, min(i + B, V)))
-            conn.must(f"INSERT VERTEX person(age) VALUES {vals}")
-        for i in range(0, E, B):
-            vals = ", ".join(f"{srcs[j]} -> {dsts[j]}@{j}:({ts[j]})"
-                             for j in range(i, min(i + B, E)))
-            conn.must(f"INSERT EDGE knows(ts) VALUES {vals}")
+        insert_person_knows(conn, "meshdry", parts, V, srcs, dsts, ts)
         conns.append(conn)
     tconn, cconn = conns
     hubs = [int(x) for x in np.argsort(np.bincount(srcs,
@@ -745,7 +761,166 @@ def bench_mesh_dryrun(out_path: str, n_devices: int = 4):
     return rec
 
 
+def bench_chaos(out_path: str, trim: bool = False):
+    """Chaos tier (`bench.py --chaos`): the 8-session workload under
+    injected kernel/mesh/encode faults (common/faults.py; docs/manual/
+    9-robustness.md). PASSES only when
+
+      (a) every result observed by a session is byte-identical to the
+          CPU pipe's for the same query,
+      (b) the error rate seen by clients is ZERO (every device failure
+          degraded, none escaped), and
+      (c) the degradation ladder actually engaged: breaker trips
+          during the fault window, then half-open recovery back to the
+          device path once faults stop.
+
+    Tier-1-safe on XLA:CPU — no accelerator, no native engine needed
+    (`--trim` shrinks the graph/query counts and trips the breaker on
+    the first failure so the smoke test is fast and deterministic)."""
+    import threading
+    from nebula_tpu.cluster import InProcCluster
+    from nebula_tpu.common.faults import faults
+    from nebula_tpu.engine_tpu import TpuGraphEngine
+
+    seed = int(os.environ.get("BENCH_CHAOS_SEED", 7))
+    sessions = 8
+    v, e, per_session = (300, 2500, 6) if trim else (1500, 15000, 40)
+    tpu = TpuGraphEngine()
+    # tight ladder so the run observes the full trip -> half-open ->
+    # recover cycle in seconds (production defaults are 3 / 0.5s / 30s)
+    tpu.breaker_threshold = 1 if trim else 2
+    tpu.breaker_base_s = 0.2
+    tpu.breaker_max_s = 2.0
+    cluster = InProcCluster(tpu_engine=tpu)
+    conn = cluster.connect()
+    rng = np.random.default_rng(seed)
+    srcs, dsts, ts = zipf_edges(rng, v, e, clip=120)
+    insert_person_knows(conn, "chaos", 4, v, srcs, dsts, ts)
+    sid = cluster.meta.get_space("chaos").value().space_id
+    tpu.prewarm(sid, block=True)
+    tpu.sparse_edge_budget = 0   # pin dense: faults land on the
+    hubs = [int(x) for x in     # kernel-launch path, not the host pull
+            np.argsort(np.bincount(srcs, minlength=v))[-4:]]
+    queries = [
+        f"GO 2 STEPS FROM {hubs[0]} OVER knows YIELD knows._dst",
+        f"GO 3 STEPS FROM {hubs[1]} OVER knows YIELD knows._dst",
+        f"GO 2 STEPS FROM {hubs[2]} OVER knows "
+        f"WHERE knows.ts > {TS_MAX // 2} YIELD knows._dst, knows.ts",
+        f"GO 2 STEPS FROM {hubs[3]} OVER knows YIELD knows.ts AS t"
+        f" | YIELD COUNT(*) AS n, SUM($-.t) AS s, AVG($-.t) AS a",
+        f"GO FROM {hubs[0]}, {hubs[1]} OVER knows "
+        f"YIELD knows._dst, knows.ts",
+    ]
+    conn.must(queries[0])   # compile + snapshot warm, OFF the chaos
+
+    # ---- phase 1: the 8-session workload under an armed fault plan
+    plan = (f"seed={seed};kernel.launch:p=0.3;mesh.collective:p=0.3;"
+            f"encode.rows:p=0.2")
+    faults.set_plan(plan)
+    observed: dict = {}
+    errs: list = []
+    olock = threading.Lock()
+
+    def worker(k):
+        try:
+            c = cluster.connect()
+            c.must("USE chaos")
+            for i in range(per_session):
+                q = queries[(k + i) % len(queries)]
+                r = c.must(q)
+                key = tuple(sorted(map(repr, r.rows)))
+                with olock:
+                    observed.setdefault(q, set()).add(key)
+        except Exception as ex:   # noqa: BLE001 — recorded, fails run
+            errs.append(repr(ex))
+
+    threads = [threading.Thread(target=worker, args=(k,))
+               for k in range(sessions)]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    chaos_wall = time.time() - t0
+    faults.clear()
+    fired = faults.counts()
+    trips = tpu.stats["breaker_trips"]
+
+    # ---- identity: every observed result must be byte-identical to
+    # the CPU pipe's (the graph is static, so one reference per query)
+    mismatches = []
+    tpu.enabled = False
+    try:
+        for q in queries:
+            ref = tuple(sorted(map(repr, conn.must(q).rows)))
+            for obs in observed.get(q, ()):
+                if obs != ref:
+                    mismatches.append(q)
+                    break
+    finally:
+        tpu.enabled = True
+
+    # ---- phase 2: faults stopped — half-open probes must re-admit the
+    # device path (breaker closed + device actually serving again)
+    recovered = False
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        g0 = tpu.stats["go_served"] + tpu.stats["agg_served"]
+        for q in queries:
+            conn.must(q)
+        states = tpu.breaker_states()
+        served_again = (tpu.stats["go_served"]
+                        + tpu.stats["agg_served"]) > g0
+        if served_again and all(s == "closed" for s in states.values()):
+            recovered = True
+            break
+        time.sleep(0.1)
+
+    rb = tpu.robustness_stats()
+    rec = {
+        "trim": trim,
+        "seed": seed,
+        "sessions": sessions,
+        "graph": {"V": v, "E": e},
+        "queries_per_session": per_session,
+        "chaos_wall_s": round(chaos_wall, 1),
+        "fault_plan": plan,
+        "faults_injected": fired,
+        "client_errors": errs[:3],
+        "mismatches": mismatches,
+        "breaker_trips": trips,
+        "recovered": recovered,
+        "robustness": rb,
+        "degraded_serves": rb["degraded_serves"],
+        "deadline_exceeded": rb["deadline_exceeded"],
+    }
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=1)
+    ok = (not errs and not mismatches and trips > 0 and recovered
+          and sum(fired.values()) > 0
+          and rb["breaker_recoveries"] > 0)
+    log(f"chaos tier: {sessions} sessions x {per_session} queries under "
+        f"{plan!r}: {sum(fired.values())} faults injected, "
+        f"{trips} breaker trips, {rb['degraded_serves']} degraded "
+        f"serves, errors={len(errs)}, mismatches={len(mismatches)}, "
+        f"recovered={recovered} -> {out_path}")
+    print(json.dumps({"metric": "chaos", "ok": ok, **{
+        k: rec[k] for k in ("faults_injected", "breaker_trips",
+                            "degraded_serves", "recovered",
+                            "mismatches")}}))
+    if not ok:
+        raise SystemExit(f"chaos tier FAILED: {rec}")
+    return rec
+
+
 def main():
+    if "--chaos" in sys.argv:
+        out = os.environ.get("BENCH_CHAOS_OUT", "CHAOS_bench.json")
+        for a in sys.argv:
+            if a.startswith("--out="):
+                out = a.split("=", 1)[1]
+        bench_chaos(out, trim="--trim" in sys.argv)
+        return
     if "--mesh-dryrun" in sys.argv:
         out = os.environ.get("BENCH_MESH_OUT",
                              "MULTICHIP_mesh_dryrun.json")

@@ -1,0 +1,353 @@
+"""Named fault-point registry + circuit breaker for the device serve
+path (docs/manual/9-robustness.md).
+
+The reference survives partial failure by design (Raft-replicated
+parts, leader-stale retry in the storage client, WAL restart
+recovery); the TPU serve path needs the same discipline PROVABLE: a
+fault point is a named site in load-bearing code (`faults.fire(name)`)
+that is a no-op in production and, under an activated plan, injects a
+failure — raise, added latency, probabilistically, or a bounded number
+of times. Every injected fire is counted, so chaos runs (`bench.py
+--chaos`, `tools/soak.py --faults`) can assert both that faults
+actually landed and that no client ever saw one.
+
+Activation, in priority order (all feed the same process registry):
+
+- env var `NEBULA_TPU_FAULTS="kernel.launch:p=0.3;encode.rows:n=2"`
+  read at import;
+- the MUTABLE graphd flag `fault_plan` (hot-settable through /flags);
+- the graphd admin endpoint `/faults` (GET = state, PUT plan=...).
+
+Plan grammar: `point:arg[,arg]...` joined by `;`. Args:
+
+    p=<0..1>      fire with this probability per evaluation (default 1)
+    n=<int>       fire at most N times, then disarm
+    latency=<ms>  sleep instead of raising (latency injection)
+    after=<int>   skip the first K evaluations before arming
+
+A bare `seed=<int>` entry reseeds the plan RNG so probabilistic plans
+replay deterministically (the chaos smoke test pins one).
+
+The module also hosts `CircuitBreaker` — the degradation ladder's
+state machine (closed -> open on N consecutive failures -> half-open
+probes after exponential backoff -> closed on a probe success), used
+per-feature by `TpuGraphEngine`.
+"""
+from __future__ import annotations
+
+import os
+import random
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from .stats import stats as global_stats
+
+
+class InjectedFault(Exception):
+    """Raised by an armed fault point (mode: raise)."""
+
+
+class InjectedConnectionFault(InjectedFault, ConnectionError):
+    """Transport-shaped injected fault: registered points whose real
+    failure mode is a broken socket raise this, so the production
+    retry machinery (reconnect loops, leader rotation) engages exactly
+    as it would for the genuine failure."""
+
+
+class _FaultSpec:
+    __slots__ = ("p", "remaining", "latency_ms", "skip")
+
+    def __init__(self, p: float = 1.0, n: Optional[int] = None,
+                 latency_ms: Optional[float] = None, after: int = 0):
+        self.p = p
+        self.remaining = n          # None = unbounded
+        self.latency_ms = latency_ms
+        self.skip = after
+
+    def describe(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"p": self.p}
+        if self.remaining is not None:
+            out["remaining"] = self.remaining
+        if self.latency_ms is not None:
+            out["latency_ms"] = self.latency_ms
+        if self.skip:
+            out["after"] = self.skip
+        return out
+
+
+class FaultRegistry:
+    """Process-global named fault points. `fire(name)` costs one dict
+    probe when no plan is active — cheap enough for the hot path."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._active: Dict[str, _FaultSpec] = {}
+        self._points: Dict[str, Dict[str, Any]] = {}   # name -> catalog
+        self.fired: Dict[str, int] = {}
+        self._rng = random.Random()
+
+    # -------------------------------------------------------- catalog
+    def register(self, name: str, exc: type = InjectedFault,
+                 doc: str = "") -> None:
+        """Declare a fault point (idempotent): names the site in the
+        /faults catalog and fixes the exception type a raise-mode fire
+        uses (transport points raise InjectedConnectionFault)."""
+        with self._lock:
+            self._points.setdefault(name, {"exc": exc, "doc": doc})
+
+    # ----------------------------------------------------------- fire
+    def fire(self, name: str) -> None:
+        """Evaluate the fault point: no-op unless an active plan arms
+        `name`; otherwise sleep (latency mode) or raise the point's
+        exception type. Every injected fire is counted."""
+        if not self._active:            # fast path: nothing armed
+            return
+        with self._lock:
+            spec = self._active.get(name)
+            if spec is None:
+                return
+            if spec.skip > 0:
+                spec.skip -= 1
+                return
+            if spec.remaining is not None and spec.remaining <= 0:
+                return
+            if spec.p < 1.0 and self._rng.random() >= spec.p:
+                return
+            if spec.remaining is not None:
+                spec.remaining -= 1
+            self.fired[name] = self.fired.get(name, 0) + 1
+            latency = spec.latency_ms
+            exc = self._points.get(name, {}).get("exc", InjectedFault)
+        global_stats.add_value("faults.injected." + name)
+        if latency is not None:
+            time.sleep(latency / 1e3)
+            return
+        raise exc(f"injected fault at {name!r}")
+
+    # ----------------------------------------------------------- plan
+    def set_plan(self, plan: str) -> None:
+        """Parse + install a plan string (see module doc). An empty
+        plan clears every armed point. Raises ValueError on a
+        malformed plan, leaving the previous plan installed."""
+        new: Dict[str, _FaultSpec] = {}
+        seed: Optional[int] = None
+        for part in (plan or "").split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            if part.startswith("seed="):
+                seed = int(part[5:])
+                continue
+            name, _, args = part.partition(":")
+            name = name.strip()
+            if not name:
+                raise ValueError(f"bad fault plan entry {part!r}")
+            kw: Dict[str, Any] = {}
+            for a in args.split(","):
+                a = a.strip()
+                if not a:
+                    continue
+                k, eq, v = a.partition("=")
+                if not eq:
+                    raise ValueError(f"bad fault arg {a!r} in {part!r}")
+                if k == "p":
+                    kw["p"] = float(v)
+                elif k == "n":
+                    kw["n"] = int(v)
+                elif k == "latency":
+                    kw["latency_ms"] = float(v)
+                elif k == "after":
+                    kw["after"] = int(v)
+                else:
+                    raise ValueError(f"unknown fault arg {k!r} in "
+                                     f"{part!r}")
+            new[name] = _FaultSpec(**kw)
+        with self._lock:
+            self._active = new
+            if seed is not None:
+                self._rng = random.Random(seed)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._active = {}
+
+    def reset(self) -> None:
+        """Disarm everything AND zero the fire counters (test
+        isolation; production observability never resets)."""
+        with self._lock:
+            self._active = {}
+            self.fired = {}
+
+    # ---------------------------------------------------- observation
+    def counts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.fired)
+
+    def total_fired(self) -> int:
+        with self._lock:
+            return sum(self.fired.values())
+
+    def describe(self) -> Dict[str, Any]:
+        """JSON-able registry state for the /faults admin endpoint."""
+        with self._lock:
+            return {
+                "active": {n: s.describe()
+                           for n, s in self._active.items()},
+                "fired": dict(self.fired),
+                "total_fired": sum(self.fired.values()),
+                "points": {n: p["doc"] for n, p in self._points.items()},
+            }
+
+
+# process-global instance (the gflags-style singleton every fault
+# point imports)
+faults = FaultRegistry()
+
+# the load-bearing device-serve-path sites (registered here so the
+# /faults catalog is complete even before the sites are first hit)
+faults.register("csr.build",
+                doc="CSR snapshot build from the provider scan")
+faults.register("csr.delta_apply",
+                doc="committed-write delta apply onto a live snapshot")
+faults.register("kernel.launch",
+                doc="device traversal-kernel launch (single query and "
+                    "dispatcher windows)")
+faults.register("mesh.collective",
+                doc="sharded collective entry points in mesh_exec")
+faults.register("encode.rows", doc="native nbc_encode_rows batch row "
+                                   "encode (falls back to pure python)")
+faults.register("rpc.send", exc=InjectedConnectionFault,
+                doc="framed RPC transport send path")
+
+if os.environ.get("NEBULA_TPU_FAULTS"):
+    faults.set_plan(os.environ["NEBULA_TPU_FAULTS"])
+
+
+def _wire_flag() -> None:
+    """`fault_plan` graphd flag: hot-settable through /flags and the
+    meta config pull, mirroring every other MUTABLE flag."""
+    from .flags import MUTABLE, graph_flags
+    graph_flags.declare(
+        "fault_plan", "", MUTABLE,
+        "fault-injection plan (common/faults.py grammar); empty clears")
+
+    def _apply(name: str, value: Any) -> None:
+        if name == "fault_plan":
+            try:
+                faults.set_plan(str(value or ""))
+            except ValueError:
+                pass    # a bad hot-set must never kill the watcher
+
+    graph_flags.watch(_apply)
+
+
+_wire_flag()
+
+
+def jittered_delay(base_s: float, cap_s: float, attempt: int) -> float:
+    """Capped exponential backoff with half-jitter — the one formula
+    every retry loop shares (transport reconnects, storage-client KV
+    retries): min(base * 2^attempt, cap) scaled by [0.5, 1.0)."""
+    return min(base_s * (2 ** attempt), cap_s) \
+        * (0.5 + random.random() * 0.5)
+
+
+# ---------------------------------------------------------------------------
+# circuit breaker (the degradation ladder's state machine)
+# ---------------------------------------------------------------------------
+
+class CircuitBreaker:
+    """Per-feature breaker: CLOSED until `threshold` CONSECUTIVE
+    failures, then OPEN (every `allow()` denied) for an exponentially
+    backed-off window, then HALF-OPEN (probes admitted); a probe
+    success closes it, a probe failure re-opens with doubled backoff.
+
+    States are derived, not stored: tripped + now < next_probe = open;
+    tripped + now >= next_probe = half_open. That keeps `allow()` a
+    couple of comparisons and makes concurrent probes harmless (each
+    records its own outcome; the first success closes).
+
+    Thread-safe; `on_trip`/`on_recover` hooks run outside the lock."""
+
+    CLOSED = "closed"
+    OPEN = "open"
+    HALF_OPEN = "half_open"
+
+    def __init__(self, threshold: int = 3, base_backoff_s: float = 0.5,
+                 max_backoff_s: float = 30.0, clock=time.monotonic,
+                 on_trip=None, on_recover=None):
+        self.threshold = max(int(threshold), 1)
+        self.base_backoff_s = base_backoff_s
+        self.max_backoff_s = max_backoff_s
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._consecutive = 0
+        self._backoff = base_backoff_s
+        self._next_probe = 0.0
+        self._tripped = False
+        self.trips = 0
+        self.recoveries = 0
+        self.half_open_probes = 0
+        self._on_trip = on_trip
+        self._on_recover = on_recover
+
+    # ------------------------------------------------------------------
+    @property
+    def state(self) -> str:
+        with self._lock:
+            return self._state_locked()
+
+    def _state_locked(self) -> str:
+        if not self._tripped:
+            return self.CLOSED
+        if self._clock() < self._next_probe:
+            return self.OPEN
+        return self.HALF_OPEN
+
+    def allow(self) -> bool:
+        """May the protected path run now? True when closed, or when
+        the open window has elapsed (half-open probe — counted)."""
+        with self._lock:
+            st = self._state_locked()
+            if st == self.OPEN:
+                return False
+            if st == self.HALF_OPEN:
+                self.half_open_probes += 1
+            return True
+
+    def record_success(self) -> None:
+        recovered = False
+        with self._lock:
+            if self._tripped:
+                recovered = True
+                self.recoveries += 1
+            self._tripped = False
+            self._consecutive = 0
+            self._backoff = self.base_backoff_s
+        if recovered and self._on_recover is not None:
+            self._on_recover(self)
+
+    def record_failure(self) -> bool:
+        """Returns True when THIS failure tripped the breaker (closed
+        -> open transition), so the caller can log/demote once."""
+        tripped_now = False
+        with self._lock:
+            now = self._clock()
+            if self._tripped:
+                # probe failure (or late failure racing the trip):
+                # re-open with doubled backoff
+                self._backoff = min(self._backoff * 2,
+                                    self.max_backoff_s)
+                self._next_probe = now + self._backoff
+                return False
+            self._consecutive += 1
+            if self._consecutive >= self.threshold:
+                self._tripped = True
+                self.trips += 1
+                self._backoff = self.base_backoff_s
+                self._next_probe = now + self._backoff
+                tripped_now = True
+        if tripped_now and self._on_trip is not None:
+            self._on_trip(self)
+        return tripped_now

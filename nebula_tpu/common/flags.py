@@ -148,6 +148,11 @@ graph_flags.declare("session_idle_timeout_secs", 28800, MUTABLE,
                     "idle session reclamation age")
 graph_flags.declare("slow_op_threshold_ms", 50, MUTABLE,
                     "log queries slower than this")
+graph_flags.declare("tpu_query_deadline_ms", 60000, MUTABLE,
+                    "per-query device-path time budget (dispatcher wait "
+                    "+ kernel + materialize); past it the device path "
+                    "yields to the CPU pipe and deadline_exceeded is "
+                    "counted in /tpu_stats. 0 disables.")
 storage_flags.declare("download_dir", "/tmp/nebula_tpu_staging", REBOOT,
                       "staging dir for DOWNLOAD-ed bulk-load SST files")
 storage_flags.declare("snapshot_dir", "/tmp/nebula_tpu_snapshots", REBOOT,

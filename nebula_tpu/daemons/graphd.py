@@ -80,6 +80,33 @@ def serve_graphd(meta_addr: str, host: str = "127.0.0.1", port: int = 0,
     if ws_port is not None:
         web = WebService("graphd", flags=graph_flags, stats=stats,
                          host=host, port=ws_port)
+
+        def faults_handler(params, body):
+            # /faults: GET = registry state (armed plan, per-point fire
+            # counts, catalog); PUT body `plan=<grammar>` arms a plan,
+            # `?clear=1` (or an empty plan) disarms everything. The
+            # same plan grammar as NEBULA_TPU_FAULTS and the
+            # `fault_plan` flag (common/faults.py).
+            from ..common.faults import faults as freg
+            from urllib.parse import parse_qs as _pq
+            if body:
+                # keep_blank_values so an explicit `plan=` (clear) is
+                # distinguishable from a body MISSING the plan key —
+                # the latter must not silently disarm a live chaos run
+                fields = {k: v[0] for k, v in
+                          _pq(body.decode(),
+                              keep_blank_values=True).items()}
+                if "plan" not in fields:
+                    return 400, {"error": "body must carry plan=<spec>"}
+                try:
+                    freg.set_plan(fields["plan"])
+                except ValueError as e:
+                    return 400, {"error": str(e)}
+            elif params.get("clear"):
+                freg.clear()
+            return 200, freg.describe()
+
+        web.register("/faults", faults_handler)
         if tpu_engine is not None:
             def trace(params, body):
                 # /trace?op=start&dir=/tmp/xprof | /trace?op=stop —
@@ -112,8 +139,16 @@ def serve_graphd(meta_addr: str, host: str = "127.0.0.1", port: int = 0,
                 st = dict(tpu_engine.stats)
                 rounds = max(st.get("disp_rounds", 0), 1)
                 waits = max(st.get("group_wait_count", 0), 1)
+                rb = tpu_engine.robustness_stats()
                 return 200, {
                     "stats": st,
+                    # degradation ladder (docs/manual/9-robustness.md):
+                    # live per-feature breaker states, trip/recovery
+                    # counts, CPU-degraded serves, deadline bailouts,
+                    # poisoned snapshots, and injected-fault counts
+                    "robustness": rb,
+                    "breaker_state": rb["breaker_state"],
+                    "faults_injected": rb["faults_injected"],
                     "agg_decline_reasons":
                         dict(tpu_engine.agg_decline_reasons),
                     "path_decline_reasons":

@@ -37,6 +37,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..common.faults import CircuitBreaker, faults
+from ..common.flags import graph_flags
 from ..common.stats import stats as global_stats
 from ..common.status import Status, StatusOr
 from ..filter.expressions import (Expression, InputPropExpr, VariablePropExpr)
@@ -58,12 +60,14 @@ class _BudgetExceeded(Exception):
 class _GoReq:
     """One session's plain GO parked at the cross-session dispatcher.
     `done` flips exactly once (via _mark_done, under the dispatcher
-    condition var), after `result`/`error` is written; the owning
-    thread re-reads it under the same condition var. `claimed` means a
-    group leader drained this request into its window — the owner
-    waits for `done` instead of trying to lead."""
+    condition var), after `result` is written; the owning thread
+    re-reads it under the same condition var. `claimed` means a group
+    leader drained this request into its window — the owner waits for
+    `done` instead of trying to lead. A device failure never carries
+    an error back: `result` stays None and the owner re-serves on the
+    CPU pipe (docs/manual/9-robustness.md)."""
     __slots__ = ("ctx", "s", "starts", "edge_types", "alias_map",
-                 "name_by_type", "key", "yield_cols", "result", "error",
+                 "name_by_type", "key", "yield_cols", "result",
                  "done", "claimed", "t_enq")
 
     def __init__(self, ctx, s, starts, edge_types, alias_map,
@@ -77,7 +81,6 @@ class _GoReq:
         self.key = key
         self.yield_cols = yield_cols
         self.result = None
-        self.error = None
         self.done = False
         self.claimed = False
         self.t_enq = 0.0
@@ -175,7 +178,16 @@ class TpuGraphEngine:
                       "native_encode_rows": 0, "encode_fallback_rows": 0,
                       "group_wait_us_total": 0, "group_wait_count": 0,
                       "group_wait_us_max": 0, "path_declined": 0,
-                      "budget_recalibrations": 0}
+                      "budget_recalibrations": 0,
+                      # degradation ladder (docs/manual/9-robustness.md):
+                      # breaker lifecycle, queries sent to the CPU pipe
+                      # because a breaker was open or a device serve
+                      # failed, per-query deadline-budget bailouts,
+                      # poisoned snapshots, mesh -> single-device
+                      # demotions
+                      "breaker_trips": 0, "breaker_recoveries": 0,
+                      "degraded_serves": 0, "deadline_exceeded": 0,
+                      "snapshot_poisoned": 0, "mesh_demotions": 0}
         # mesh execution service (mesh_exec.py): device-served queries
         # on SHARDED snapshots, per feature — the decline matrix the
         # round-5 verdict flagged (batched windows / aggregation / ALL
@@ -204,6 +216,24 @@ class TpuGraphEngine:
         # background (honoring the explicit pin lock)
         self._space_churn: Dict[int, int] = {}
         self._recalibrating: set = set()
+        # degradation ladder (docs/manual/9-robustness.md): one
+        # circuit breaker per device feature ("go" / "agg" / "path" /
+        # "mesh"); N consecutive device failures trip the feature to
+        # CPU fallback, exponential-backoff half-open probes re-admit
+        # it, and a tripped MESH breaker first demotes the space to
+        # single-device serving before CPU. Threshold/backoff are
+        # instance attrs so chaos harnesses can tighten them.
+        self._breakers: Dict[str, CircuitBreaker] = {}
+        self.breaker_threshold = 3
+        self.breaker_base_s = 0.5
+        self.breaker_max_s = 30.0
+        # spaces demoted off the mesh (mesh breaker tripped):
+        # _build_fresh skips sharding for them until a half-open probe
+        # re-admits the mesh (see _mesh_failed / _snapshot_locked)
+        self._mesh_demoted: set = set()
+        # per-query device-path deadline; None -> the
+        # tpu_query_deadline_ms graphd flag
+        self.query_deadline_ms: Optional[int] = None
         # per-query stage breakdown of the LAST device-served query
         # (snapshot check / kernel / materialize — ref role: per-stage
         # latency in responses, ExecutionPlan.cpp:57) + a serial so the
@@ -392,6 +422,9 @@ class TpuGraphEngine:
             self.mesh_served[feature] = \
                 self.mesh_served.get(feature, 0) + n
         global_stats.add_value("tpu_engine.mesh_served." + feature)
+        # a successful meshed serve is the mesh breaker's probe
+        # success: a half-open mesh closes and stays re-admitted
+        self._device_ok("mesh")
 
     def _mesh_decline(self, feature: str, reason: str) -> None:
         """Count one meshed-serving decline by (feature, reason) — the
@@ -402,16 +435,162 @@ class TpuGraphEngine:
         global_stats.add_value(
             f"tpu_engine.mesh_declined.{feature}.{reason}")
 
+    # ------------------------------------------------------------------
+    # degradation ladder: per-feature circuit breakers + deadline
+    # budget (docs/manual/9-robustness.md)
+    # ------------------------------------------------------------------
+    def _breaker(self, feature: str) -> CircuitBreaker:
+        b = self._breakers.get(feature)
+        if b is None:
+            with self._stats_lock:
+                b = self._breakers.get(feature)
+                if b is None:
+                    b = CircuitBreaker(self.breaker_threshold,
+                                       self.breaker_base_s,
+                                       self.breaker_max_s)
+                    self._breakers[feature] = b
+        return b
+
+    def _device_admit(self, feature: str, ctx=None) -> bool:
+        """Ladder gate at the top of every device entry point: an OPEN
+        breaker sends the query straight to the CPU pipe (counted in
+        `degraded_serves`); an admitted query gets its deadline budget
+        stamped on the ctx (threaded through dispatcher wait + kernel
+        + materialize via _deadline_exceeded)."""
+        if not self._breaker(feature).allow():
+            with self._stats_lock:
+                self.stats["degraded_serves"] += 1
+            global_stats.add_value("tpu_engine.degraded_serves."
+                                   + feature)
+            return False
+        if ctx is not None:
+            ms = self.query_deadline_ms
+            if ms is None:
+                ms = graph_flags.get("tpu_query_deadline_ms", 0) or 0
+            ctx._tpu_deadline = (time.monotonic() + ms / 1e3) \
+                if ms else None
+        return True
+
+    def _device_ok(self, feature: str) -> None:
+        b = self._breaker(feature)
+        r0 = b.recoveries
+        b.record_success()
+        if b.recoveries != r0:
+            with self._stats_lock:
+                self.stats["breaker_recoveries"] += 1
+            global_stats.add_value("tpu_engine.breaker_recoveries")
+            _LOG.info("device path %r recovered: half-open probe "
+                      "succeeded, breaker closed", feature)
+
+    def _device_failed(self, feature: str, exc: Exception):
+        """One device-path failure: counted against the feature's
+        breaker; the query is NOT errored — callers return None so
+        the CPU pipe re-serves it (failure isolation: the client
+        never sees a device-infrastructure error). Returns None for
+        `return self._device_failed(...)` convenience.
+
+        Data-dependent evaluation errors are NOT infrastructure: the
+        CPU pipe raises the identical error for the same query, so a
+        client retrying one bad query must not trip the breaker and
+        degrade every other session's traffic — the query still
+        re-serves (and errors) on the CPU pipe, without breaker
+        impact."""
+        from ..filter.expressions import EvalError
+        if isinstance(exc, EvalError):
+            with self._stats_lock:
+                self.stats["degraded_serves"] += 1
+            return None
+        tripped = self._breaker(feature).record_failure()
+        if tripped:
+            with self._stats_lock:
+                self.stats["breaker_trips"] += 1
+            global_stats.add_value("tpu_engine.breaker_trips")
+        with self._stats_lock:
+            self.stats["degraded_serves"] += 1
+        global_stats.add_value("tpu_engine.device_failures." + feature)
+        _LOG.warning(
+            "device path %r failed, query retried on the CPU pipe%s: "
+            "%r", feature,
+            " (breaker tripped: CPU fallback until a half-open probe "
+            "succeeds)" if tripped else "", exc)
+        return None
+
+    def _deadline_exceeded(self, ctx, where: str) -> bool:
+        """Has this query's device-path budget run out? Checked at the
+        phase seams (dispatcher claim, kernel launch, materialize);
+        True sends the query to the CPU pipe and counts it."""
+        dl = getattr(ctx, "_tpu_deadline", None)
+        if dl is None or time.monotonic() < dl:
+            return False
+        with self._stats_lock:
+            self.stats["deadline_exceeded"] += 1
+        global_stats.add_value("tpu_engine.deadline_exceeded." + where)
+        return True
+
+    def _mesh_failed(self, feature: str, exc: Exception, snap) -> None:
+        """Mesh rung of the ladder: a failed sharded collective counts
+        against the "mesh" breaker; while the breaker is not closed
+        the space DEMOTES to single-device serving — the sharded
+        snapshot is poisoned and the background repack rebuilds it
+        unsharded (_build_fresh skips sharding for demoted spaces).
+        Half-open probes re-admit the mesh via _snapshot_locked."""
+        self._mesh_decline(feature, "exec_error")
+        b = self._breaker("mesh")
+        tripped = b.record_failure()
+        if tripped:
+            with self._stats_lock:
+                self.stats["breaker_trips"] += 1
+            global_stats.add_value("tpu_engine.breaker_trips")
+        _LOG.warning("meshed %s serve failed%s: %r", feature,
+                     " (mesh breaker tripped)" if tripped else "", exc)
+        if (tripped or b.state != CircuitBreaker.CLOSED) and \
+                getattr(snap, "sharded_kernel", None) is not None:
+            with self._lock:
+                first = snap.space_id not in self._mesh_demoted
+                self._mesh_demoted.add(snap.space_id)
+                snap.stale = True
+            if first:
+                with self._stats_lock:
+                    self.stats["mesh_demotions"] += 1
+                global_stats.add_value("tpu_engine.mesh_demotions")
+                _LOG.warning(
+                    "space %d demoted to single-device serving "
+                    "(unsharded rebuild kicked; half-open mesh probes "
+                    "re-admit)", snap.space_id)
+            self._kick_repack(snap.space_id)
+
+    def breaker_states(self) -> Dict[str, str]:
+        with self._stats_lock:   # _breaker() inserts concurrently
+            breakers = dict(self._breakers)
+        return {f: b.state for f, b in breakers.items()}
+
+    def robustness_stats(self) -> Dict[str, Any]:
+        """The /tpu_stats "robustness" block (also embedded in the
+        bench tier-2/3 JSON): ladder counters + live breaker states +
+        injected-fault counts."""
+        with self._stats_lock:
+            keys = ("breaker_trips", "breaker_recoveries",
+                    "degraded_serves", "deadline_exceeded",
+                    "snapshot_poisoned", "mesh_demotions")
+            out: Dict[str, Any] = {k: self.stats[k] for k in keys}
+        out["breaker_state"] = self.breaker_states()
+        out["faults_injected"] = faults.counts()
+        return out
+
     def _build_fresh(self, space_id: int) -> Optional[CsrSnapshot]:
         """Build (but don't install) a fresh snapshot — lock-free, so
-        the background repack can scan while queries keep serving."""
+        the background repack can scan while queries keep serving.
+        Spaces demoted off the mesh (mesh breaker) build UNSHARDED
+        until a half-open probe re-admits them."""
+        faults.fire("csr.build")
         catalog = self._catalog_version()
         snap = self._provider.build(space_id)
         if snap is None:
             return None
         snap.catalog_version = catalog
         if (self.mesh is not None and self.mesh.devices.size > 1
-                and snap.num_parts % self.mesh.devices.size == 0):
+                and snap.num_parts % self.mesh.devices.size == 0
+                and space_id not in self._mesh_demoted):
             from .distributed import shard_snapshot_arrays
             shard_snapshot_arrays(self.mesh, snap)
         return snap
@@ -609,6 +788,22 @@ class TpuGraphEngine:
             t.join()
 
     def _snapshot_locked(self, space_id: int) -> Optional[CsrSnapshot]:
+        if self._mesh_demoted and space_id in self._mesh_demoted \
+                and self.mesh is not None:
+            # mesh re-admission probe: once the mesh breaker's open
+            # window elapses, kick a SHARDED rebuild off the query
+            # path; the single-device snapshot keeps serving until the
+            # swap, and the first meshed serve's outcome closes or
+            # re-opens the breaker. The demotion flag is dropped only
+            # when the repack actually STARTS — _kick_repack no-ops
+            # while the demotion's own (unsharded) rebuild is still in
+            # flight or backed off, and dropping the flag then would
+            # leave the space single-device with no future trigger.
+            b = self._breakers.get("mesh")
+            if b is not None and b.allow():
+                self._mesh_demoted.discard(space_id)
+                if not self._kick_repack(space_id):
+                    self._mesh_demoted.add(space_id)   # retry later
         token = self._provider.version(space_id)
         if token is None:
             return None
@@ -638,8 +833,12 @@ class TpuGraphEngine:
                 return snap
             # apply failed mid-way (capacity / barrier): the snapshot may
             # be partially patched — poison it, rebuild off the query
-            # path, serve via CPU fallback until the swap
+            # path, serve via CPU fallback until the swap. The poison
+            # hits ONLY this snapshot (counted: snapshot_poisoned) — a
+            # later refresh()/repack rebuilds cleanly.
             snap.stale = True
+            self.stats["snapshot_poisoned"] += 1
+            global_stats.add_value("tpu_engine.snapshot_poisoned")
             self._kick_repack(space_id)
             return None
         return self.refresh(space_id)
@@ -682,7 +881,18 @@ class TpuGraphEngine:
             return False
         if entries:
             from .delta import apply_entries
-            if not apply_entries(snap, self._sm, entries, time.time()):
+            try:
+                faults.fire("csr.delta_apply")
+                ok = apply_entries(snap, self._sm, entries, time.time())
+            except Exception:
+                # an apply that RAISES is handled like one that
+                # declines: the snapshot may be partially patched, so
+                # the caller poisons it and the repack rebuilds — the
+                # query itself serves on the CPU pipe, never errors
+                _LOG.exception("delta apply onto space %d snapshot "
+                               "raised; poisoning", snap.space_id)
+                ok = False
+            if not ok:
                 return False
             # tombstones/patches mutate the canonical arrays the
             # batched aligned layout was built from
@@ -701,9 +911,12 @@ class TpuGraphEngine:
                 self._kick_repack(snap.space_id)
         return True
 
-    def _kick_repack(self, space_id: int) -> None:
+    def _kick_repack(self, space_id: int) -> bool:
         """Rebuild off the query path; queries keep serving the current
         snapshot (or CPU fallback when poisoned) until the swap.
+        Returns True when a rebuild thread actually started (False: one
+        is already in flight, or the failure backoff hasn't elapsed —
+        the mesh re-admission gate keys off this).
 
         A failed build is never silent (ref role: every background
         path in the reference logs, kvstore/raftex/RaftPart.cpp
@@ -713,10 +926,10 @@ class TpuGraphEngine:
         /get_stats), and retried with exponential backoff on the next
         kick — meanwhile queries keep the previous snapshot."""
         if self._repacking.get(space_id):
-            return
+            return False
         fails, not_before = self._repack_backoff.get(space_id, (0, 0.0))
         if time.time() < not_before:
-            return
+            return False
         self._repacking[space_id] = True
 
         def run():
@@ -761,6 +974,7 @@ class TpuGraphEngine:
 
         threading.Thread(target=run, daemon=True,
                          name=f"csr-repack-{space_id}").start()
+        return True
 
     # ------------------------------------------------------------------
     # serve decisions
@@ -816,6 +1030,27 @@ class TpuGraphEngine:
                    edge_types: List[int], alias_map: Dict[str, str],
                    name_by_type: Dict[int, str]):
         """Returns executors.Result, or None to fall back to CPU.
+
+        Ladder wrapper: an open "go" breaker declines straight to the
+        CPU pipe, and any device-path exception is converted to a CPU
+        retry (counted + fed to the breaker) — a client never sees a
+        device-infrastructure error (docs/manual/9-robustness.md)."""
+        if not self._device_admit("go", ctx):
+            return None
+        try:
+            r = self._execute_go_routed(ctx, s, starts, edge_types,
+                                        alias_map, name_by_type)
+        except Exception as e:
+            return self._device_failed("go", e)
+        if r is not None:
+            self._device_ok("go")
+        return r
+
+    def _execute_go_routed(self, ctx, s: ast.GoSentence,
+                           starts: List[int], edge_types: List[int],
+                           alias_map: Dict[str, str],
+                           name_by_type: Dict[int, str]):
+        """Route one GO to the dispatcher or the single-query path.
 
         Plain-form GO (no UPTO, no input refs, unmeshed) goes through
         the cross-session dispatcher: concurrent sessions' traversals
@@ -891,9 +1126,11 @@ class TpuGraphEngine:
                      (ctx.space_id(), int(s.step.steps),
                       tuple(edge_types)), yield_cols)
         req.t_enq = time.monotonic()
+        dl = getattr(ctx, "_tpu_deadline", None)
         with self._disp_cv:
             self._disp_queue.append(req)
         batch = None
+        timed_out = False
         while True:
             with self._disp_cv:
                 while not req.done and (
@@ -901,7 +1138,26 @@ class TpuGraphEngine:
                         or req.key in self._disp_serving
                         or len(self._disp_serving)
                         >= self.MAX_CONCURRENT_ROUNDS):
-                    self._disp_cv.wait()
+                    timeout = None
+                    if dl is not None:
+                        timeout = dl - time.monotonic()
+                        if timeout <= 0 and not req.claimed:
+                            # deadline: balk out of the queue and let
+                            # the CPU pipe serve — an UNCLAIMED waiter
+                            # never blocks past its deadline. (A
+                            # claimed one is owned by an in-flight
+                            # round whose failure isolation guarantees
+                            # a prompt wake — _serve_batch marks every
+                            # claimed request done on every path.)
+                            self._disp_queue = [
+                                r for r in self._disp_queue
+                                if r is not req]
+                            req.done = True
+                            req.result = None
+                            timed_out = True
+                            break
+                        timeout = max(timeout, 0.01)
+                    self._disp_cv.wait(timeout)
                 if req.done:
                     break
                 # leader election for THIS key only: claim every queued
@@ -927,8 +1183,12 @@ class TpuGraphEngine:
                 self._release_round(req.key, batch[0])
             if req.done:
                 break
-        if req.error is not None:
-            raise req.error
+        if timed_out:
+            with self._stats_lock:
+                self.stats["deadline_exceeded"] += 1
+            global_stats.add_value(
+                "tpu_engine.deadline_exceeded.dispatch_wait")
+            return None
         return self._finalize_result(req.result)
 
     def _release_round(self, key, owner: "_GoReq") -> None:
@@ -992,16 +1252,23 @@ class TpuGraphEngine:
     def _serve_batch(self, batch: List["_GoReq"], ex) -> None:
         """One group's dispatcher round (every request shares one
         (space, steps, edge types) key); a request that fails
-        individually carries its own error back to its session."""
+        individually degrades to a CPU-pipe retry in its own session
+        (result stays None — device failures never carry errors back,
+        docs/manual/9-robustness.md)."""
         if len(batch) > 1:
             self.stats["batched_max_window"] = max(
                 self.stats["batched_max_window"], len(batch))
         try:
             self._serve_group(batch, ex)
-        except Exception as e:   # defensive: never strand a waiter
+        except Exception as e:   # defensive: never strand a waiter —
+            # and never error one either: the failed round's requests
+            # wake with result=None and re-serve on the CPU pipe in
+            # their own sessions (failure isolation: other concurrent
+            # groups and later windows are untouched)
+            self._device_failed("go", e)
             for r in batch:
                 if not r.done:
-                    r.error = e
+                    r.result = None
             self._mark_done(batch)
 
     def _serve_group(self, group: List["_GoReq"], ex) -> None:
@@ -1026,7 +1293,8 @@ class TpuGraphEngine:
                         r.ctx, r.s, r.starts, r.edge_types, r.alias_map,
                         r.name_by_type, ex, r.yield_cols)
             except Exception as e:
-                r.error = e
+                self._device_failed("go", e)
+                r.result = None    # owner re-serves on the CPU pipe
             self._mark_done([r])
             return
         space_id, steps, etypes = group[0].key
@@ -1056,6 +1324,10 @@ class TpuGraphEngine:
             # sharded window dispatch.
             for r in group:
                 try:
+                    if self._deadline_exceeded(r.ctx, "dispatch_claim"):
+                        r.result = None    # CPU pipe serves it
+                        self._mark_done([r], early=True)
+                        continue
                     yield_cols = r.yield_cols
                     columns = [c.name() for c in yield_cols]
                     frontier0 = snap.frontier_from_vids(r.starts)
@@ -1077,7 +1349,8 @@ class TpuGraphEngine:
                             continue
                     dense.append((r, frontier0, yield_cols, columns))
                 except Exception as e:
-                    r.error = e
+                    self._device_failed("go", e)
+                    r.result = None    # owner re-serves on the CPU pipe
                     self._mark_done([r], early=True)
             if not dense:
                 return
@@ -1184,7 +1457,9 @@ class TpuGraphEngine:
         """Serve dispatcher requests through the exact single-query
         path — the shared fallback when no batch can carry them (no
         snapshot, snapshot moved under a round, meshed window without
-        its layout). Caller marks done."""
+        its layout). Caller marks done. A request that fails here
+        degrades to the CPU pipe in its own session (result=None),
+        never to a client error."""
         for r in reqs:
             try:
                 with self._lock:
@@ -1192,13 +1467,15 @@ class TpuGraphEngine:
                         r.ctx, r.s, r.starts, r.edge_types, r.alias_map,
                         r.name_by_type, ex, r.yield_cols)
             except Exception as e:
-                r.error = e
+                self._device_failed("go", e)
+                r.result = None
 
     def _encode_sink(self, sink: List[Tuple]) -> None:
         """The whole window's deferred rows in ONE native GIL-released
         batch encode, off the engine lock; waiters box their own
-        tuples after wakeup. An encode failure errors every owner —
-        never a silent empty result."""
+        tuples after wakeup. An encode failure degrades every owner to
+        the CPU pipe (result=None) — never a silent empty result and
+        never a client-visible error."""
         try:
             encs, native_used = materialize.encode_window(
                 [g for (_r, g, _t) in sink])
@@ -1206,9 +1483,9 @@ class TpuGraphEngine:
             for (r, _g, _t2), enc in zip(sink, encs):
                 r.result.value()._tpu_deferred = enc
         except Exception as e:
+            self._device_failed("go", e)
             for r, _g, _t2 in sink:
                 r.result = None
-                r.error = e
 
     def _serve_meshed_chunks(self, dense, cap, n_chunks, snap, v0,
                              steps, req_arr, owner, plan_filter_cached,
@@ -1233,26 +1510,33 @@ class TpuGraphEngine:
         for ci, c0 in enumerate(range(0, len(dense), cap)):
             chunk = dense[c0:c0 + cap]
             last_chunk = ci == n_chunks - 1
+            launch_err = None
+            t1 = time.monotonic()
             with self._lock:
                 redo = snap.stale or snap.write_version != v0
                 if not redo:
-                    # power-of-two buckets: meshed window programs are
-                    # not precompiled by prewarm (meshed kernels
-                    # compile per-query shapes), so smaller pads keep
-                    # each first-seen compile cheap
-                    bucket = 1
-                    while bucket < len(chunk):
-                        bucket *= 2
-                    bucket = min(bucket, cap)
-                    stack = [f for _, f, _, _ in chunk]
-                    if bucket > len(chunk):
-                        stack.extend([np.zeros_like(stack[0])]
-                                     * (bucket - len(chunk)))
-                    f0s = jnp.asarray(np.stack(stack))
-                    t1 = time.monotonic()
-                    masks = mesh_exec.multi_hop_masks_batch_sharded(
-                        self.mesh, f0s, jnp.int32(steps), ak_sh,
-                        snap.sharded_kernel, req_arr, a_chunk, a_group)
+                    try:
+                        faults.fire("kernel.launch")
+                        # power-of-two buckets: meshed window programs
+                        # are not precompiled by prewarm (meshed
+                        # kernels compile per-query shapes), so smaller
+                        # pads keep each first-seen compile cheap
+                        bucket = 1
+                        while bucket < len(chunk):
+                            bucket *= 2
+                        bucket = min(bucket, cap)
+                        stack = [f for _, f, _, _ in chunk]
+                        if bucket > len(chunk):
+                            stack.extend([np.zeros_like(stack[0])]
+                                         * (bucket - len(chunk)))
+                        f0s = jnp.asarray(np.stack(stack))
+                        t1 = time.monotonic()
+                        masks = mesh_exec.multi_hop_masks_batch_sharded(
+                            self.mesh, f0s, jnp.int32(steps), ak_sh,
+                            snap.sharded_kernel, req_arr, a_chunk,
+                            a_group)
+                    except Exception as e:
+                        launch_err = e
             if redo:
                 # snapshot moved under the round: re-serve each through
                 # the single-query path, which re-snapshots
@@ -1260,11 +1544,28 @@ class TpuGraphEngine:
                 self._mark_done([r for r, *_ in chunk],
                                 early=not last_chunk)
                 continue
-            if last_chunk:
-                # window fully launched: hand the key back so window
-                # N+1's leader overlaps its dispatch with our wait
-                self._release_round(owner.key, owner)
-            masks_np = np.asarray(masks)    # device wait OFF the lock
+            if launch_err is None:
+                if last_chunk:
+                    # window fully launched: hand the key back so
+                    # window N+1's leader overlaps its dispatch with
+                    # our wait
+                    self._release_round(owner.key, owner)
+                try:
+                    masks_np = np.asarray(masks)   # wait OFF the lock
+                except Exception as e:
+                    launch_err = e
+            if launch_err is not None:
+                # mesh rung of the ladder: the failed window counts
+                # against the mesh breaker (tripping it demotes the
+                # space to single-device), and exactly this chunk's
+                # requests retry — first per-request on the sharded
+                # kernel, degrading to CPU in their own sessions if
+                # that fails too
+                self._mesh_failed("go_batched", launch_err, snap)
+                self._serve_singles([r for r, *_ in chunk], ex)
+                self._mark_done([r for r, *_ in chunk],
+                                early=not last_chunk)
+                continue
             t_kernel = time.monotonic() - t1
             sink: List[Tuple] = []
             served = 0
@@ -1291,7 +1592,8 @@ class TpuGraphEngine:
                             t_kernel, sink=sink, sink_req=r)
                         served += 1
                     except Exception as e:
-                        r.error = e
+                        self._device_failed("go", e)
+                        r.result = None    # CPU pipe re-serves it
                 # only queries the batched sharded dispatch actually
                 # served — stale2 redos are charged by their own
                 # single-query serve, never twice
@@ -1310,72 +1612,84 @@ class TpuGraphEngine:
         for ci, c0 in enumerate(range(0, len(dense), cap)):
             chunk = dense[c0:c0 + cap]
             last_chunk = ci == n_chunks - 1
+            launch_err = None
+            t1 = time.monotonic()
             with self._lock:
                 redo = snap.stale or snap.write_version != v0
                 if not redo:
-                    aligned = snap.aligned_ready() if not use_delta and \
-                        steps >= 1 and len(chunk) > 1 else None
-                    if aligned is not None and \
-                            getattr(snap, "batched_kernel_pick",
-                                    None) == "vmap":
-                        # measured on THIS backend: the vmapped batch
-                        # beats the lane-matrix layout — skip it
-                        aligned = None
-                    # pad the root axis so XLA compiles FEW shapes,
-                    # never past the memory-derived cap (the 1GiB mask
-                    # budget must hold for the PADDED batch too); zero
-                    # frontiers produce empty masks and carry no
-                    # request.
-                    # - lane path: exactly TWO buckets (small, cap) —
-                    #   both precompiled by prewarm, so no cold compile
-                    #   ever lands inside a round;
-                    # - delta/vmapped rounds: power-of-two buckets
-                    #   (delta device shapes vary with the buffer, so
-                    #   those programs can't be precompiled — smaller
-                    #   pads keep each first-seen compile cheap).
-                    if aligned is not None:
-                        bucket = min(self.SMALL_BUCKET, cap) \
-                            if len(chunk) <= self.SMALL_BUCKET else cap
-                    else:
-                        bucket = 1
-                        while bucket < len(chunk):
-                            bucket *= 2
-                        bucket = min(bucket, cap)
-                    stack = [f for _, f, _, _ in chunk]
-                    if bucket > len(chunk):
-                        stack.extend([np.zeros_like(stack[0])]
-                                     * (bucket - len(chunk)))
-                    f0s = jnp.asarray(np.stack(stack))
-                    kernel_cal = None
-                    t1 = time.monotonic()
-                    if use_delta:
-                        masks, dmasks = traverse.multi_hop_roots_delta(
-                            f0s, jnp.int32(steps), snap.kernel,
-                            snap.delta.device(), req_arr)
-                    elif aligned is not None:
-                        # lane-matrix batched kernel: the edge/index
-                        # streams are read once per hop for the WHOLE
-                        # window (the vmapped fallback only shares them
-                        # on backends that vectorize the batch dim)
-                        ak, a_chunk, a_group = aligned
-                        if getattr(snap, "batched_kernel_pick",
-                                   None) is None:
-                            # claim the one-shot lane-vs-vmapped
-                            # calibration; the timing itself runs OFF
-                            # the lock in phase 2 (kernel buffers are
-                            # immutable device arrays)
-                            snap.batched_kernel_pick = "calibrating"
-                            claimed[0] = True
-                            kernel_cal = (ak, a_chunk, a_group)
-                        masks = traverse.multi_hop_masks_batch(
-                            f0s, jnp.int32(steps), ak, snap.kernel,
-                            req_arr, chunk=a_chunk, group=a_group)
-                        self.stats["batched_lane_rounds"] += 1
-                        dmasks = None
-                    else:
-                        masks = traverse.multi_hop_roots(
-                            f0s, jnp.int32(steps), snap.kernel, req_arr)
-                        dmasks = None
+                    try:
+                        faults.fire("kernel.launch")
+                        aligned = snap.aligned_ready() \
+                            if not use_delta and steps >= 1 \
+                            and len(chunk) > 1 else None
+                        if aligned is not None and \
+                                getattr(snap, "batched_kernel_pick",
+                                        None) == "vmap":
+                            # measured on THIS backend: the vmapped
+                            # batch beats the lane-matrix layout
+                            aligned = None
+                        # pad the root axis so XLA compiles FEW
+                        # shapes, never past the memory-derived cap
+                        # (the 1GiB mask budget must hold for the
+                        # PADDED batch too); zero frontiers produce
+                        # empty masks and carry no request.
+                        # - lane path: exactly TWO buckets (small,
+                        #   cap) — both precompiled by prewarm, so no
+                        #   cold compile ever lands inside a round;
+                        # - delta/vmapped rounds: power-of-two buckets
+                        #   (delta device shapes vary with the buffer,
+                        #   so those programs can't be precompiled —
+                        #   smaller pads keep each first-seen compile
+                        #   cheap).
+                        if aligned is not None:
+                            bucket = min(self.SMALL_BUCKET, cap) \
+                                if len(chunk) <= self.SMALL_BUCKET \
+                                else cap
+                        else:
+                            bucket = 1
+                            while bucket < len(chunk):
+                                bucket *= 2
+                            bucket = min(bucket, cap)
+                        stack = [f for _, f, _, _ in chunk]
+                        if bucket > len(chunk):
+                            stack.extend([np.zeros_like(stack[0])]
+                                         * (bucket - len(chunk)))
+                        f0s = jnp.asarray(np.stack(stack))
+                        kernel_cal = None
+                        t1 = time.monotonic()
+                        if use_delta:
+                            masks, dmasks = \
+                                traverse.multi_hop_roots_delta(
+                                    f0s, jnp.int32(steps), snap.kernel,
+                                    snap.delta.device(), req_arr)
+                        elif aligned is not None:
+                            # lane-matrix batched kernel: the edge/
+                            # index streams are read once per hop for
+                            # the WHOLE window (the vmapped fallback
+                            # only shares them on backends that
+                            # vectorize the batch dim)
+                            ak, a_chunk, a_group = aligned
+                            if getattr(snap, "batched_kernel_pick",
+                                       None) is None:
+                                # claim the one-shot lane-vs-vmapped
+                                # calibration; the timing itself runs
+                                # OFF the lock in phase 2 (kernel
+                                # buffers are immutable device arrays)
+                                snap.batched_kernel_pick = "calibrating"
+                                claimed[0] = True
+                                kernel_cal = (ak, a_chunk, a_group)
+                            masks = traverse.multi_hop_masks_batch(
+                                f0s, jnp.int32(steps), ak, snap.kernel,
+                                req_arr, chunk=a_chunk, group=a_group)
+                            self.stats["batched_lane_rounds"] += 1
+                            dmasks = None
+                        else:
+                            masks = traverse.multi_hop_roots(
+                                f0s, jnp.int32(steps), snap.kernel,
+                                req_arr)
+                            dmasks = None
+                    except Exception as e:
+                        launch_err = e
             if redo:
                 # snapshot moved under the round (delta apply /
                 # poison): each request re-serves through the exact
@@ -1384,16 +1698,35 @@ class TpuGraphEngine:
                 self._mark_done([r for r, *_ in chunk],
                                 early=not last_chunk)
                 continue
-            if last_chunk:
-                # the window's device work is all launched: hand the
-                # key back NOW so window N+1's leader can claim and
-                # launch while we wait for masks + materialize
-                self._release_round(owner.key, owner)
-            # device wait OFF the engine lock (jax releases the GIL):
-            # another group's round — or the next window of this key —
-            # runs its host phases meanwhile
-            masks_np = np.asarray(masks)
-            dmasks_np = None if dmasks is None else np.asarray(dmasks)
+            if launch_err is None:
+                if last_chunk:
+                    # the window's device work is all launched: hand
+                    # the key back NOW so window N+1's leader can claim
+                    # and launch while we wait for masks + materialize
+                    self._release_round(owner.key, owner)
+                # device wait OFF the engine lock (jax releases the
+                # GIL): another group's round — or the next window of
+                # this key — runs its host phases meanwhile. An async
+                # dispatch error surfaces HERE at the fetch.
+                try:
+                    masks_np = np.asarray(masks)
+                    dmasks_np = None if dmasks is None \
+                        else np.asarray(dmasks)
+                except Exception as e:
+                    launch_err = e
+            if launch_err is not None:
+                # failure isolation: exactly this chunk's waiters wake
+                # with result=None and re-serve on the CPU pipe in
+                # their own sessions — other groups, other chunks, and
+                # later windows are untouched, and the round key is
+                # handed back by the owner's finally
+                self._device_failed("go", launch_err)
+                for r, *_ in chunk:
+                    if not r.done:
+                        r.result = None
+                self._mark_done([r for r, *_ in chunk],
+                                early=not last_chunk)
+                continue
             t_kernel = time.monotonic() - t1
             if kernel_cal is not None:
                 # one-shot lane-vs-vmapped timing, also OFF the lock —
@@ -1429,7 +1762,8 @@ class TpuGraphEngine:
                             r.name_by_type, ex, r.edge_types, t_snap,
                             t_kernel, sink=sink, sink_req=r)
                     except Exception as e:
-                        r.error = e
+                        self._device_failed("go", e)
+                        r.result = None    # CPU pipe re-serves it
             if sink:
                 self._encode_sink(sink)
             self._mark_done([r for r, *_ in chunk], early=not last_chunk)
@@ -1539,6 +1873,10 @@ class TpuGraphEngine:
                 return self._emit_sparse(ctx, s, snap, sparse, yield_cols,
                                          columns, alias_map, name_by_type,
                                          ex, edge_types, t_snap, t_kernel)
+        if self._deadline_exceeded(ctx, "kernel"):
+            self.stats["fallbacks"] += 1
+            return None    # budget spent before the dense dispatch
+        faults.fire("kernel.launch")
         device_mask, local_filter = self._plan_filter(
             ctx, s, snap, use_delta, name_by_type, alias_map, edge_types)
 
@@ -1582,6 +1920,8 @@ class TpuGraphEngine:
         into Python tuples only in the owning session's thread
         (_finalize_result). With `sink` the typed gather is appended
         for the WINDOW-level encode instead of encoding per query."""
+        if self._deadline_exceeded(ctx, "materialize"):
+            return None    # budget spent: the CPU pipe serves it
         t2 = time.monotonic()
         # the device compile may have been declined (e.g. delta edges in
         # play, _plan_filter): still avoid the per-row Python walk over
@@ -1671,6 +2011,28 @@ class TpuGraphEngine:
                              alias_map: Dict[str, str],
                              name_by_type: Dict[int, str],
                              group_layout: Optional[List] = None):
+        """Ladder wrapper for the aggregation pushdown: an open "agg"
+        breaker (or any device exception) degrades the query to the
+        CPU pipe — counted, never client-visible (see execute_go)."""
+        if not self._device_admit("agg", ctx):
+            return None
+        try:
+            r = self._execute_go_aggregate_checked(
+                ctx, s, specs, out_cols, starts, edge_types, alias_map,
+                name_by_type, group_layout)
+        except Exception as e:
+            return self._device_failed("agg", e)
+        if r is not None:
+            self._device_ok("agg")
+        return r
+
+    def _execute_go_aggregate_checked(self, ctx, s: ast.GoSentence,
+                                      specs, out_cols: List[str],
+                                      starts: List[int],
+                                      edge_types: List[int],
+                                      alias_map: Dict[str, str],
+                                      name_by_type: Dict[int, str],
+                                      group_layout: Optional[List] = None):
         """Serve `GO … | YIELD <aggregates>` (and `GO … | GROUP BY
         $-.<dst> YIELD …`) as a masked device reduction over the
         final-hop edge block instead of materializing rows (ref role:
@@ -1848,6 +2210,7 @@ class TpuGraphEngine:
         import jax.numpy as jnp
         f0 = jnp.asarray(frontier0)
         req = jnp.asarray(traverse.pad_edge_types(edge_types))
+        faults.fire("kernel.launch")
         t1 = time.monotonic()
         if getattr(snap, "sharded_kernel", None) is not None:
             from . import distributed
@@ -1872,10 +2235,17 @@ class TpuGraphEngine:
                 # every exactness bound of aggregate.py)
                 from . import mesh_exec
                 chunked0 = self.stats.get("agg_grouped_chunked", 0)
-                groups, cols = mesh_exec.mesh_grouped_reduce(
-                    keyed_specs, active, vals, snap.d_edge_gidx,
-                    snap.num_parts * snap.cap_v, self.mesh,
-                    stats=self.stats)
+                try:
+                    groups, cols = mesh_exec.mesh_grouped_reduce(
+                        keyed_specs, active, vals, snap.d_edge_gidx,
+                        snap.num_parts * snap.cap_v, self.mesh,
+                        stats=self.stats)
+                except Exception as e:
+                    # mesh rung: count against the mesh breaker
+                    # (tripping demotes to single-device); the CPU
+                    # pipe serves this query
+                    self._mesh_failed("agg", e, snap)
+                    return self._agg_decline("exec_error")
                 if self.stats.get("agg_grouped_chunked", 0) > chunked0:
                     global_stats.add_value(
                         "tpu_engine.agg_grouped_chunked")
@@ -1910,8 +2280,12 @@ class TpuGraphEngine:
             return StatusOr.of(ex.InterimResult(out_cols, rows))
         if meshed:
             from . import mesh_exec
-            row = mesh_exec.mesh_reduce_specs(keyed_specs, active, vals,
-                                              self.mesh)
+            try:
+                row = mesh_exec.mesh_reduce_specs(keyed_specs, active,
+                                                  vals, self.mesh)
+            except Exception as e:
+                self._mesh_failed("agg", e, snap)
+                return self._agg_decline("exec_error")
             self._mesh_served("agg")
         else:
             row = aggregate.reduce_specs(keyed_specs, active, vals)
@@ -2716,8 +3090,11 @@ class TpuGraphEngine:
                 # reads single-chip
                 masks = mesh_exec.multi_hop_steps_sharded(
                     self.mesh, f0, snap.sharded_kernel, req, upto)
-            except Exception:
-                self._mesh_decline("path_all", "kernel_error")
+            except Exception as e:
+                # mesh rung of the ladder: count against the mesh
+                # breaker (tripping demotes the space to single-
+                # device); the CPU pipe serves this query meanwhile
+                self._mesh_failed("path_all", e, snap)
                 _LOG.exception("sharded ALL-path expansion failed "
                                "(space %d)", snap.space_id)
                 return None
@@ -2947,14 +3324,24 @@ class TpuGraphEngine:
                           sources: List[int], targets: List[int],
                           edge_types: List[int],
                           name_by_type: Dict[int, str]):
+        """Ladder wrapper (see execute_go): an open "path" breaker or
+        a device exception degrades to the CPU pipe, counted."""
         from ..graph import executors as ex
         if len(edge_types) > traverse.MAX_EDGE_TYPES_PER_QUERY:
             self._path_decline("too_many_edge_types")
             return None
-        with self._lock:   # delta applies mutate host mirrors in place
-            return self._execute_find_path_locked(ctx, s, sources, targets,
-                                                  edge_types, name_by_type,
-                                                  ex)
+        if not self._device_admit("path", ctx):
+            return None
+        try:
+            with self._lock:   # delta applies mutate mirrors in place
+                r = self._execute_find_path_locked(ctx, s, sources,
+                                                   targets, edge_types,
+                                                   name_by_type, ex)
+        except Exception as e:
+            return self._device_failed("path", e)
+        if r is not None:
+            self._device_ok("path")
+        return r
 
     def _execute_find_path_locked(self, ctx, s, sources, targets,
                                   edge_types, name_by_type, ex):

@@ -55,6 +55,7 @@ from jax.sharding import PartitionSpec as P
 
 import threading
 
+from ..common.faults import faults
 from . import aggregate
 from .distributed import AXIS, _exchange, shard_aligned_blocks
 from .shard_compat import shard_map
@@ -165,6 +166,7 @@ def multi_hop_masks_batch_sharded(mesh, frontiers0, steps, ak, kern,
     snapshot's sharded EdgeKernel (both leading-dim sharded over the
     mesh). -> bool[B, P, cap_e], partition-sharded over axis 1.
     Identical semantics to traverse.multi_hop_masks_batch."""
+    faults.fire("mesh.collective")
     B, num_parts, cap_v = frontiers0.shape
     if B > LANES:
         raise ValueError(f"batch {B} > {LANES} lanes per dispatch")
@@ -212,6 +214,7 @@ def multi_hop_steps_sharded(mesh, frontier0, kern, req_types,
     engine's ALL/NOLOOP path expansion input): `steps` is static, one
     trace per N, exactly like traverse.multi_hop_steps.
     -> bool[steps, P, cap_e], partition-sharded over axis 1."""
+    faults.fire("mesh.collective")
     num_parts, cap_v = frontier0.shape
     D = mesh.devices.size
     assert num_parts % D == 0
@@ -311,6 +314,7 @@ def mesh_reduce_specs(specs, active, vals, mesh) -> Optional[List]:
     reassembled exactly on the host. Same result-row contract (CPU-
     identical Python values); never hits reduce_specs' device-wide
     transfer of the full mask."""
+    faults.fire("mesh.collective")
     n_rows = mesh_active_count(mesh, active)
     row: List = []
     cache: Dict = {}
@@ -460,6 +464,7 @@ def mesh_grouped_reduce(specs, active, vals, gidx, n_groups: int,
     chunked gathered partials past it (exact to ~2^55 rows, counted in
     `stats` as agg_grouped_chunked just like the single-chip path);
     MIN/MAX are per-device lattice partials combined on the host."""
+    faults.fire("mesh.collective")
     counts = _mesh_scatter_count(mesh, active, gidx, n_groups)
     groups = np.nonzero(counts)[0]
     out: List[List] = []

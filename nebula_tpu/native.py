@@ -390,8 +390,11 @@ def encode_rows(field_types, vals_i64, vals_f64, nulls, str_blob=b"",
 
     Returns (blob bytes, row_off int64[n], row_len int32[n]). Raises
     if the native library is unavailable (callers fall back to
-    encode_rows_py, which produces identical bytes)."""
+    encode_rows_py, which produces identical bytes — the same
+    degradation the "encode.rows" fault point exercises)."""
     import numpy as np
+    from .common.faults import faults
+    faults.fire("encode.rows")
     lib = load()
     ft = np.ascontiguousarray(field_types, np.uint8)
     n_fields = len(ft)

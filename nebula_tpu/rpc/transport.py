@@ -20,12 +20,22 @@ import socket
 import socketserver
 import struct
 import threading
+import time
 from typing import Any, Dict, Optional, Tuple
 
+from ..common.faults import faults, jittered_delay
+from ..common.stats import stats as global_stats
 from . import wire
 
 _U32 = struct.Struct("<I")
 MAX_FRAME = 1 << 30
+
+# reconnect counters, observable in tests and /get_stats
+# (rpc.reconnects): every retry of a freshly-failed connection is
+# counted, and the retry loop backs off instead of hammering a
+# refused/reset peer (capped, jittered exponential)
+rpc_stats = {"reconnects": 0}
+_rpc_stats_lock = threading.Lock()
 
 
 class RpcError(Exception):
@@ -266,13 +276,37 @@ class RpcClient:
         if self._dedicated:
             self._pool.close()
 
+    # instant-failure (refused/reset) reconnect pacing: capped,
+    # jittered exponential backoff so a dead peer is probed, not
+    # hammered (a refused connect returns in microseconds — the old
+    # loop burned its attempts instantly)
+    RETRY_BACKOFF_BASE = 0.02
+    RETRY_BACKOFF_CAP = 0.5
+
+    def _reconnect_backoff(self, paced: int) -> None:
+        time.sleep(jittered_delay(self.RETRY_BACKOFF_BASE,
+                                  self.RETRY_BACKOFF_CAP, paced))
+
     def call(self, method: str, *args, **kwargs) -> Any:
         payload = wire.encode((self.service, method, tuple(args), kwargs))
         last_err: Optional[Exception] = None
+        fresh_fail = False
+        paced = 0
         # after a server restart every pooled socket may be stale; allow
         # draining the whole pool plus one fresh connect
         attempts = self._max_attempts or (self._pool._size + 1)
-        for _ in range(attempts):
+        for attempt in range(attempts):
+            if last_err is not None:
+                with _rpc_stats_lock:
+                    rpc_stats["reconnects"] += 1
+                global_stats.add_value("rpc.reconnects")
+                # pace only FRESH-connect failures (dead peer): a
+                # stale pooled socket from a restarted-but-alive peer
+                # drains instantly, like before. The final attempt's
+                # failure raises below without sleeping.
+                if fresh_fail:
+                    self._reconnect_backoff(paced)
+                    paced += 1
             try:
                 sock = self._pool.acquire(self._timeout)
             except socket.timeout as e:
@@ -285,9 +319,14 @@ class RpcClient:
                                f"within {self._timeout}s") from e
             except OSError as e:
                 last_err = e   # instant failures (refused etc.): retry
+                fresh_fail = True
                 continue
             sock.settimeout(self._timeout)  # deadline is per-call
             try:
+                # transport-shaped fault point: raises a ConnectionError
+                # subclass, so the production retry/backoff machinery
+                # engages exactly as for a genuinely broken socket
+                faults.fire("rpc.send")
                 _send_frame(sock, payload)
                 raw = _recv_frame(sock)
             except socket.timeout as e:
@@ -302,6 +341,7 @@ class RpcClient:
                 sock.close()
                 self._pool.release(None)
                 last_err = e
+                fresh_fail = False   # stale pooled socket: drain fast
                 continue
             self._pool.release(sock)
             ok, value = wire.decode(raw)

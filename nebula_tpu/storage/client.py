@@ -19,6 +19,7 @@ from concurrent.futures import ThreadPoolExecutor
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..common import keys as ku
+from ..common.stats import stats
 from ..common.status import ErrorCode, Status, StatusOr
 from ..meta.schema_manager import SchemaManager
 from .types import (BoundRequest, BoundResponse, EdgeData, EdgeKey,
@@ -58,6 +59,11 @@ class StorageClient:
         self._local_write_seq: Dict[int, int] = {}
         self._closed = False
         self.version_stats = {"probe_rpcs": 0, "watch_rounds": 0}
+        # _kv_retry retries by classification (leader hint followed /
+        # hintless election wait / part-not-yet-materialized), also fed
+        # to the global stats manager as storage_client.kv_retry.<cls>
+        self.retry_stats = {"leader_moved": 0, "hintless": 0,
+                            "no_part": 0}
 
     # ------------------------------------------------------------------
     # routing
@@ -391,29 +397,49 @@ class StorageClient:
         except Exception:
             return True
 
+    # _kv_retry backoff bases per classification (capped exponential
+    # with jitter — a fixed interval either hammers an electing part
+    # or oversleeps a fast redirect); a hinted leader change retries
+    # near-immediately, it only backs off if the leader KEEPS moving
+    KV_BACKOFF = {"leader_moved": (0.005, 0.1), "hintless": (0.05, 0.8),
+                  "no_part": (0.1, 1.6)}
+
+    def _kv_backoff(self, cls_key: str, attempt: int,
+                    retries_left: bool) -> None:
+        from ..common.faults import jittered_delay
+        self.retry_stats[cls_key] += 1
+        stats.add_value("storage_client.kv_retry." + cls_key)
+        if not retries_left:
+            return   # terminal failure: no point sleeping before it
+        base, cap = self.KV_BACKOFF[cls_key]
+        time.sleep(jittered_delay(base, cap, attempt))
+
     def _kv_retry(self, space_id: int, part: int, call, classify,
                   max_retries: int = 3):
         """Retry loop for single-part KV ops, with the same fixups as
         _fanout: leader-redirect (note the hinted leader), fresh-space
         part-not-found (wait for the topology watch). `classify(result)`
         returns None (done), a leader hint string ("" = hintless), or
-        "no_part"."""
+        "no_part". Retries back off exponentially (bounded, jittered)
+        and are counted per classification in `retry_stats`."""
         result = None
-        for _ in range(max_retries + 1):
+        for attempt in range(max_retries + 1):
             result = call(self._hosts[self._leader(space_id, part)])
             cls = classify(result)
             if cls is None:
                 return result
+            left = attempt < max_retries
             if cls == "no_part":
                 if not self._space_exists(space_id):
                     return result
                 if self._refresh_hosts is not None:
                     self._refresh_hosts()
-                time.sleep(0.2)
+                self._kv_backoff("no_part", attempt, left)
             elif cls:
                 self._note_leader(space_id, part, cls)
+                self._kv_backoff("leader_moved", attempt, left)
             else:
-                time.sleep(0.05)  # election in progress
+                self._kv_backoff("hintless", attempt, left)  # election
         return result
 
     @staticmethod
@@ -507,12 +533,24 @@ class StorageClient:
     def _watch_host(self, host: str) -> None:
         """One long-poll loop per storage host. A broken connection
         (storaged death) marks the host stale immediately — the TPU
-        path declines until the channel re-establishes."""
+        path declines until the channel re-establishes. The watch is a
+        LIVENESS probe, so over RPC it uses a fail-fast twin of the
+        shared proxy (max_attempts=1): the paced reconnect backoff is
+        right for request traffic but would delay marking a dead host
+        stale, widening the window where a device snapshot is trusted
+        on an unverifiable freshness token."""
+        from ..rpc.transport import RpcClient, proxy
         known: Dict[int, int] = {}
+        fast = None
         while not self._closed:
             svc = self._hosts.get(host)
             if svc is None:
                 break
+            if isinstance(svc, RpcClient):
+                if fast is None or fast.addr != svc.addr:
+                    fast = proxy(svc.addr, svc.service,
+                                 timeout=svc._timeout, max_attempts=1)
+                svc = fast
             try:
                 cur = svc.watch_space_versions(known, timeout=1.0)
             except Exception:

@@ -56,14 +56,61 @@ def _setup_cluster(space: str, v: int, e: int, seed: int):
     return cluster, conn, tpu, srcs, dsts
 
 
+def _fault_schedule(stop, period: float = 0.8, seed: int = 7):
+    """Background fault schedule for `--faults`: alternates an armed
+    plan (kernel launch + delta apply + native encode failures) with
+    quiet windows, so the soak's continuous identity checks prove the
+    degradation ladder under churn — every injected failure must
+    degrade to the CPU pipe, never to a client error or a divergent
+    row. Returns the toggler thread (joined by the caller)."""
+    import threading
+    from ..common.faults import faults
+
+    plans = [
+        f"seed={seed};kernel.launch:p=0.25;encode.rows:p=0.25",
+        "",                                       # quiet window
+        f"seed={seed + 1};kernel.launch:p=0.5;csr.delta_apply:n=1",
+        "",
+    ]
+
+    def run():
+        i = 0
+        while not stop.wait(period):
+            faults.set_plan(plans[i % len(plans)])
+            i += 1
+        faults.clear()
+
+    t = threading.Thread(target=run, daemon=True, name="fault-schedule")
+    t.start()
+    return t
+
+
 def run_soak(seconds: float = 10.0, write_ratio: float = 0.3,
              verify_every: int = 20, v: int = 2000, e: int = 10000,
-             seed: int = 7, progress=None) -> dict:
+             seed: int = 7, progress=None, fault_schedule: bool = False
+             ) -> dict:
+    import threading
+
     import numpy as np
+    from ..common.faults import faults
 
     rng = random.Random(seed)
     cluster, conn, tpu, srcs, dsts = _setup_cluster("soak", v, e, seed)
     base_rebuilds = tpu.stats["rebuilds"]
+    fstop = threading.Event()
+    fthread = None
+    if fault_schedule:
+        # a tight ladder so trips AND half-open recoveries both happen
+        # within a short soak. Breakers already created by the setup
+        # queries captured the production params at construction —
+        # drop them so they rebuild with these (engine._breaker reads
+        # the attrs only when it instantiates).
+        tpu.breaker_threshold = 2
+        tpu.breaker_base_s = 0.2
+        tpu.breaker_max_s = 2.0
+        with tpu._stats_lock:
+            tpu._breakers.clear()
+        fthread = _fault_schedule(fstop, seed=seed)
 
     lats: List[float] = []
     next_vid = v
@@ -119,6 +166,10 @@ def run_soak(seconds: float = 10.0, write_ratio: float = 0.3,
         if progress and queries % 200 == 0:
             progress(queries, writes)
 
+    if fthread is not None:
+        fstop.set()
+        fthread.join(timeout=5)
+        faults.clear()
     # settle in-flight background repacks, then read the counters under
     # the engine lock — the repack thread increments rebuilds and
     # bg_repacks non-atomically, and racing that pair could report a
@@ -142,17 +193,26 @@ def run_soak(seconds: float = 10.0, write_ratio: float = 0.3,
                    ("go_served", "sparse_served", "fallbacks",
                     "host_filter_vectorized")},
     }
+    if fault_schedule:
+        out["robustness"] = tpu.robustness_stats()
     # foreground rebuilds during the soak mean a write forced a
     # stop-the-world snapshot rebuild — the delta buffer's whole job
-    # is keeping that at zero (background repacks are fine)
+    # is keeping that at zero (background repacks are fine). Under an
+    # injected fault schedule a poisoned snapshot legitimately
+    # rebuilds in the background; the identity verifies remain the
+    # pass condition, plus proof that faults actually landed.
     out["ok"] = (out["rebuilds_during_soak"] <= out["bg_repacks"]
                  and verifies > 0)
+    if fault_schedule:
+        out["ok"] = out["ok"] and \
+            sum(out["robustness"]["faults_injected"].values()) > 0
     return out
 
 
 def run_soak_concurrent(seconds: float = 8.0, threads: int = 6,
                         v: int = 2000, e: int = 10000,
-                        seed: int = 11) -> dict:
+                        seed: int = 11,
+                        fault_schedule: bool = False) -> dict:
     """Concurrency soak: N sessions hammer one engine through the
     cross-session dispatcher while writers mutate the graph (delta
     applies + aligned-layout invalidation racing multi-query rounds),
@@ -172,8 +232,21 @@ def run_soak_concurrent(seconds: float = 8.0, threads: int = 6,
 
     import numpy as np
 
+    from ..common.faults import faults
+
     cluster, conn, tpu, srcs, dsts = _setup_cluster("csoak", v, e, seed)
     sid = cluster.meta.get_space("csoak").value().space_id
+    fstop = threading.Event()
+    fthread = None
+    if fault_schedule:
+        # same tight-ladder wiring as run_soak (breakers created by
+        # the setup queries captured production params — rebuild them)
+        tpu.breaker_threshold = 2
+        tpu.breaker_base_s = 0.2
+        tpu.breaker_max_s = 2.0
+        with tpu._stats_lock:
+            tpu._breakers.clear()
+        fthread = _fault_schedule(fstop, seed=seed)
     deg = np.bincount(srcs, minlength=v)
     hubs = [int(x) for x in np.argsort(deg)[-3:]]
     errors: List[str] = []
@@ -308,6 +381,10 @@ def run_soak_concurrent(seconds: float = 8.0, threads: int = 6,
     finally:
         tpu._serve_batch = orig_sb
     verifies += verify_sweep()
+    if fthread is not None:
+        fstop.set()
+        fthread.join(timeout=5)
+        faults.clear()
     with tpu._lock:
         stats = dict(tpu.stats)
     out = {
@@ -319,8 +396,13 @@ def run_soak_concurrent(seconds: float = 8.0, threads: int = 6,
                         "batched_max_window", "batched_lane_rounds")},
         "delta_applies": stats["delta_applies"],
     }
+    if fault_schedule:
+        out["robustness"] = tpu.robustness_stats()
     out["ok"] = (not errors and verifies >= 15 and queries > 0
                  and stats["batched_queries"] > 0)
+    if fault_schedule:
+        out["ok"] = out["ok"] and \
+            sum(out["robustness"]["faults_injected"].values()) > 0
     return out
 
 
@@ -337,15 +419,22 @@ def main(argv=None) -> int:
                     help="multi-session dispatcher soak (burst/quiesce "
                          "phases) instead of the single-session mix")
     ap.add_argument("--threads", type=int, default=6)
+    ap.add_argument("--faults", action="store_true",
+                    help="run a background fault schedule (kernel/"
+                         "encode/delta-apply injection windows) under "
+                         "the soak; identity checks must stay green "
+                         "and no client may see an error")
     args = ap.parse_args(argv)
     if args.concurrent:
         out = run_soak_concurrent(args.seconds, args.threads,
-                                  args.vertices, args.edges)
+                                  args.vertices, args.edges,
+                                  fault_schedule=args.faults)
     else:
         out = run_soak(args.seconds, args.write_ratio, args.verify_every,
                        args.vertices, args.edges,
                        progress=lambda q, w: print(
-                           f"  ... {q} queries, {w} writes", flush=True))
+                           f"  ... {q} queries, {w} writes", flush=True),
+                       fault_schedule=args.faults)
     print(json.dumps(out))
     return 0 if out["ok"] else 1
 

@@ -1,0 +1,52 @@
+"""Tier-1-safe chaos smoke: `bench.py --chaos --trim` in a SUBPROCESS
+on XLA:CPU with a seeded fault plan — the 8-session workload under
+injected kernel/mesh/encode faults must return CPU-pipe-identical
+results with zero client-visible errors, trip the breaker, and recover
+to the device path through half-open probes once faults stop
+(docs/manual/9-robustness.md). The subprocess keeps the parent's JAX
+backend state out of the picture, exactly like the mesh smoke tier."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def chaos_smoke(tmp_path_factory):
+    out = tmp_path_factory.mktemp("chaos") / "CHAOS_smoke.json"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_CHAOS_SEED"] = "7"           # deterministic fault plan
+    env["BENCH_CHAOS_OUT"] = str(out)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--chaos", "--trim"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, (proc.stdout[-2000:],
+                                  proc.stderr[-2000:])
+    with open(out) as f:
+        return json.load(f)
+
+
+def test_chaos_zero_client_errors_and_identity(chaos_smoke):
+    assert chaos_smoke["client_errors"] == []
+    assert chaos_smoke["mismatches"] == []
+
+
+def test_chaos_faults_actually_landed(chaos_smoke):
+    fired = chaos_smoke["faults_injected"]
+    assert sum(fired.values()) > 0
+    assert fired.get("kernel.launch", 0) > 0
+
+
+def test_chaos_ladder_tripped_and_recovered(chaos_smoke):
+    assert chaos_smoke["breaker_trips"] > 0
+    assert chaos_smoke["recovered"] is True
+    rb = chaos_smoke["robustness"]
+    assert rb["breaker_recoveries"] > 0
+    assert rb["degraded_serves"] > 0
+    assert all(s == "closed" for s in rb["breaker_state"].values())

@@ -428,5 +428,19 @@ def test_graphd_tpu_stats_endpoint():
         # dicts on this unmeshed graphd
         assert body["mesh"] == {"served": {}, "declined": {}}, body
         assert "budget_recalibrations" in body["stats"]
+        # degradation ladder block (docs/manual/9-robustness.md)
+        assert "breaker_trips" in body["robustness"], body
+        assert body["breaker_state"] == body["robustness"]["breaker_state"]
+        # /faults admin endpoint: arm a plan, observe it, clear it
+        base = f"http://127.0.0.1:{graphd.ws_port}/faults"
+        req = urllib.request.Request(
+            base, data=b"plan=encode.rows:n=1", method="PUT")
+        with urllib.request.urlopen(req) as resp:
+            armed = _json.loads(resp.read())
+        assert "encode.rows" in armed["active"], armed
+        assert "kernel.launch" in armed["points"]
+        with urllib.request.urlopen(base + "?clear=1") as resp:
+            cleared = _json.loads(resp.read())
+        assert cleared["active"] == {}, cleared
     finally:
         graphd.stop(); storaged.stop(); metad.stop()
