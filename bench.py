@@ -295,6 +295,29 @@ def bench_tpu_batched(cluster, tpu, sid, etype, seed_sets):
     return eps, qps, gbs, int(counts[0]), snap, pick
 
 
+def span_breakdown_run(run_queries, n_samples):
+    """Force-sample `n_samples` queries through the tracer (the
+    X-Trace arm knob) and reduce their span trees to per-stage p50/p95
+    — BENCH_*.json tracks WHERE the time goes (dispatcher_wait /
+    kernel / materialize / encode), not just end-to-end QPS. The
+    forced-sample pass runs OUTSIDE the measured loops so sampling
+    overhead never touches the headline numbers."""
+    from nebula_tpu.common.tracing import stage_breakdown, tracer
+    # identify NEW traces by id, not ring position: the ring is
+    # bounded, so once full its length stops growing and a positional
+    # slice would silently drop the traces this pass just sampled
+    before = {t["trace_id"] for t in tracer.ring.snapshot()}
+    tracer.arm(n_samples)
+    run_queries()
+    tracer.arm(0)
+    traces = [t for t in tracer.ring.snapshot()
+              if t["trace_id"] not in before
+              and not t.get("remote_fragment")]
+    out = stage_breakdown(traces)
+    out["sampled_traces"] = len(traces)
+    return out
+
+
 def bench_full_queries(conn, tpu, snap, etype, seed_sets):
     """Tier 2: the REAL query path — parse, plan, device traversal,
     pushed-down filter compile, columnar YIELD of edge+dst props."""
@@ -369,7 +392,13 @@ def bench_full_queries(conn, tpu, snap, etype, seed_sets):
     log(f"CPU tier2 same queries: p50={cpu_ms:.0f}ms over {len(cpu_lats)} "
         f"seeds (cpp-scan storaged path); result identity: {ident}")
     assert ident, "CPU/TPU full-query results diverged"
+    # span-level breakdown from a forced-sample pass (off the clock)
+    sb_seeds = seeds[:max(3, len(seeds) // 2)]
+    spans2 = span_breakdown_run(
+        lambda: [conn.must(q(s)) for s in sb_seeds], len(sb_seeds))
+    log(f"tier2 span breakdown (us): {spans2}")
     return p50, p99, qps1, cpu_ms, {"modes": modes,
+                                    "span_breakdown": spans2,
                                     "stage_median_us": stage_med,
                                     # mesh serving matrix (empty on an
                                     # unmeshed bench run; populated by
@@ -496,8 +525,24 @@ def bench_concurrent(cluster, tpu, seed_sets, seconds=6.0, sessions=8):
     assert not errs, errs[:2]
     total = sum(counts)
     d = {k: tpu.stats[k] - b0[k] for k in b0}
+
+    # span-level breakdown under COALESCED load — a short forced-sample
+    # barrage after the measured window (dispatcher_wait is only
+    # meaningful when concurrent sessions share a group)
+    def barrage():
+        ts = [threading.Thread(target=lambda k=k: conns[k].must(
+            tier3_q(k))) for k in range(sessions)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+
+    spans3 = span_breakdown_run(
+        lambda: [barrage() for _ in range(3)], sessions * 3)
+    log(f"tier3 span breakdown (us): {spans3}")
     out = {"sessions": sessions, "qps": round(total / wall, 1),
            "queries": total,
+           "span_breakdown": spans3,
            "batched_queries": d["batched_queries"],
            "batched_dispatches": d["batched_dispatches"],
            "lane_rounds": d["batched_lane_rounds"],

@@ -152,10 +152,18 @@ class Session:
         re-applied after a mid-flight error — exactly the
         at-least-once hazard this gate exists to prevent. `$var =`
         assignments are classified by their right-hand sentence."""
+        first = True
         for seg in cls._split_statements(stmt):
             s = seg.strip()
             if not s:
                 continue
+            # the PROFILE prefix (first statement only, matching the
+            # parser) changes observability, not semantics: classify
+            # by the profiled statement (shared rule: tracing.py)
+            if first:
+                from ..common.tracing import split_profile_prefix
+                s = split_profile_prefix(s)[1]
+                first = False
             if s.startswith("$"):
                 eq = s.find("=")
                 if eq < 0:

@@ -32,15 +32,26 @@ def _bucket_of(v: float) -> int:
 
 
 class _Metric:
-    __slots__ = ("lock", "sums", "counts", "hists", "head_sec")
+    __slots__ = ("lock", "sums", "counts", "hists", "head_sec",
+                 "kind", "life_sum", "life_count")
 
-    def __init__(self, now_sec: int):
+    def __init__(self, now_sec: int, kind: Optional[str] = None):
         n = WINDOWS[-1]
         self.lock = threading.Lock()
         self.sums = [0.0] * n
         self.counts = [0] * n
         self.hists = [None] * n          # lazily allocated per-second hist
         self.head_sec = now_sec
+        # "counter" | "timing" | None (legacy, untagged) — fixed by the
+        # first add_value call-site that opts in; drives which snapshot
+        # methods make sense (a pure counter never fed a histogram-worthy
+        # value distribution, so p95/p99/avg over it are noise) and the
+        # Prometheus # TYPE annotation
+        self.kind = kind
+        # lifetime accumulators: Prometheus counters are cumulative,
+        # the trailing windows above are not
+        self.life_sum = 0.0
+        self.life_count = 0
 
     def _advance(self, now_sec: int) -> None:
         gap = now_sec - self.head_sec
@@ -60,6 +71,8 @@ class _Metric:
             i = now_sec % WINDOWS[-1]
             self.sums[i] += value
             self.counts[i] += 1
+            self.life_sum += value
+            self.life_count += 1
             h = self.hists[i]
             if h is None:
                 h = self.hists[i] = {}
@@ -111,12 +124,19 @@ class StatsManager:
         self._lock = threading.Lock()
         self._clock = clock
 
-    def add_value(self, name: str, value: float = 1.0) -> None:
+    def add_value(self, name: str, value: float = 1.0,
+                  kind: Optional[str] = None) -> None:
+        """`kind` is a call-site opt-in fixed at FIRST registration:
+        "counter" (monotonic event counts — snapshot/Prometheus emit
+        rate + totals only) or "timing" (a value distribution — avg and
+        percentiles are meaningful). Untagged metrics keep the legacy
+        emit-everything behavior; read_stats accepts any method for any
+        kind (backward-compatible specs)."""
         now_sec = int(self._clock())
         m = self._metrics.get(name)
         if m is None:
             with self._lock:
-                m = self._metrics.setdefault(name, _Metric(now_sec))
+                m = self._metrics.setdefault(name, _Metric(now_sec, kind))
         m.add(value, now_sec)
 
     def read_stats(self, spec: str) -> Optional[float]:
@@ -139,15 +159,61 @@ class StatsManager:
     def names(self) -> List[str]:
         return sorted(self._metrics)
 
+    # which snapshot methods make sense per metric kind: counters get
+    # rate/sum (their p95 would always be the bucket of 1.0 — noise),
+    # timings get the distribution views, untagged keeps legacy output
+    _KIND_METHODS = {"counter": ("rate", "sum"),
+                     "timing": ("rate", "avg", "p95", "p99"),
+                     None: ("rate", "sum", "avg", "p95", "p99")}
+
     def snapshot(self, windows: Tuple[int, ...] = (60,)) -> Dict[str, float]:
         out = {}
         for name in self.names():
+            methods = self._KIND_METHODS.get(self._metrics[name].kind,
+                                             self._KIND_METHODS[None])
             for w in windows:
-                for method in ("rate", "sum", "avg", "p95", "p99"):
+                for method in methods:
                     v = self.read_stats(f"{name}.{method}.{w}")
                     if v is not None:
                         out[f"{name}.{method}.{w}"] = v
         return out
+
+    def prometheus_lines(self, prefix: str = "nebula") -> List[str]:
+        """Prometheus text exposition of every metric (served by
+        /metrics). Counters (and untagged metrics' totals) become
+        cumulative `_total` counters from the lifetime accumulators;
+        timings additionally expose 60s-window avg/p95/p99 gauges.
+        Names are stable: `<prefix>_<name>` with non-alphanumerics
+        folded to '_'."""
+        now = int(self._clock())
+        lines: List[str] = []
+        for name in self.names():
+            m = self._metrics[name]
+            base = _prom_name(prefix, name)
+            with m.lock:
+                life_sum, life_count = m.life_sum, m.life_count
+            lines.append(f"# TYPE {base}_total counter")
+            lines.append(f"{base}_total {_prom_num(life_sum)}")
+            if m.kind == "counter":
+                continue
+            lines.append(f"# TYPE {base}_count_total counter")
+            lines.append(f"{base}_count_total {life_count}")
+            for method in ("avg", "p95", "p99"):
+                v = m.read(method, 60, now)
+                lines.append(f"# TYPE {base}_{method}_60s gauge")
+                lines.append(f"{base}_{method}_60s {_prom_num(v)}")
+        return lines
+
+
+def _prom_name(prefix: str, name: str) -> str:
+    safe = "".join(c if (c.isalnum() or c == "_") else "_" for c in name)
+    return f"{prefix}_{safe}"
+
+
+def _prom_num(v: float) -> str:
+    if float(v).is_integer():
+        return str(int(v))
+    return repr(float(v))
 
 
 # process-global instance (the reference's static StatsManager)
@@ -167,5 +233,5 @@ class Duration:
 
     def record(self) -> int:
         us = self.elapsed_us()
-        self._m.add_value(self._metric, us)
+        self._m.add_value(self._metric, us, kind="timing")
         return us

@@ -1,0 +1,647 @@
+"""End-to-end query tracing: span trees across graphd -> storaged -> TPU.
+
+Role parity with the reference's per-request observability surface
+(`latency_in_us` threaded through every thrift response, the
+StatsManager windows behind /get_stats, the slow-op log) extended the
+way production graph stores actually debug tail latency: Dapper-style
+propagated trace contexts. One query = one TRACE; every interesting
+seam on its path (parse, plan, executor, dispatcher enqueue /
+group-wait / window launch, kernel, materialize, encode, each storage
+RPC and the storaged-side processor + KV work behind it) records a
+SPAN (name, tags, t0, dur_us, parent) into that trace. Spans cross the
+RPC boundary by riding the wire envelope (trace_id/span_id out,
+child spans back in the response), so graphd joins the full tree.
+
+Head sampling keeps the cost off the hot path: one flag check per
+query (`trace_sample_rate`), forced to 1 for a statement carrying the
+`PROFILE` prefix or while the `/traces?arm=N` admin knob (the
+X-Trace-style force) has armed samples left. Unsampled queries pay a
+single context-var read per would-be span. Finished traces land in a
+bounded in-memory ring served by `/traces`; what sampling misses is
+covered by the slow-query log (`slow_query_threshold_ms`) and the
+active-query registry (`/queries`, SHOW QUERIES-style).
+
+Degradation events (breaker trips, CPU-pipe retries, deadline balks,
+mesh demotions) tag the trace ROOT, so a degraded query is visibly
+degraded in its own trace (docs/manual/10-observability.md).
+"""
+from __future__ import annotations
+
+import contextvars
+import itertools
+import random
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional, Tuple
+
+from .flags import MUTABLE, REBOOT, graph_flags
+
+# (state, current-span) of the sampled trace this thread of control is
+# inside; None = unsampled (the off-path case: every span() call is one
+# ContextVar read). contextvars (not threading.local) so executor
+# fan-outs can carry the trace into pool threads via copy_context().
+_current: contextvars.ContextVar[Optional[Tuple["_TraceState", "Span"]]] = \
+    contextvars.ContextVar("nebula_trace", default=None)
+
+_ids = random.Random()        # span/trace id generator (non-crypto)
+
+
+def _new_id(bits: int = 64) -> str:
+    return f"{_ids.getrandbits(bits):0{bits // 4}x}"
+
+
+def _wire_tag(v: Any) -> Any:
+    """Tags cross the RPC wire: keep primitives, stringify the rest."""
+    if isinstance(v, (bool, int, float, str)) or v is None:
+        return v
+    return str(v)
+
+
+class Span:
+    """One timed operation inside a trace. `t0` is epoch seconds (for
+    display/merge across hosts), `dur_us` wall microseconds."""
+
+    __slots__ = ("span_id", "parent_id", "name", "t0", "dur_us", "tags")
+
+    def __init__(self, name: str, parent_id: str = "",
+                 t0: Optional[float] = None,
+                 tags: Optional[Dict[str, Any]] = None):
+        self.span_id = _new_id()
+        self.parent_id = parent_id
+        self.name = name
+        self.t0 = time.time() if t0 is None else t0
+        self.dur_us = 0
+        self.tags: Dict[str, Any] = dict(tags) if tags else {}
+
+    def to_wire(self) -> Tuple:
+        return (self.span_id, self.parent_id, self.name,
+                int(self.t0 * 1e6), int(self.dur_us),
+                {k: _wire_tag(v) for k, v in self.tags.items()})
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"span_id": self.span_id, "parent_id": self.parent_id,
+                "name": self.name, "t0_us": int(self.t0 * 1e6),
+                "dur_us": int(self.dur_us),
+                "tags": {k: _wire_tag(v) for k, v in self.tags.items()}}
+
+
+def span_from_wire(w: Tuple) -> Span:
+    s = Span.__new__(Span)
+    s.span_id, s.parent_id, s.name = w[0], w[1], w[2]
+    s.t0 = w[3] / 1e6
+    s.dur_us = int(w[4])
+    s.tags = dict(w[5])
+    return s
+
+
+class _TraceState:
+    """Mutable collector for one in-flight trace. `spans` is appended
+    from the owning thread AND any thread serving on its behalf (the
+    dispatcher leader, fan-out pool threads) — list.append is atomic
+    under the GIL, and readers only see the list after finish()."""
+
+    __slots__ = ("trace_id", "root", "spans")
+
+    def __init__(self, trace_id: str, root: Span):
+        self.trace_id = trace_id
+        self.root = root
+        self.spans: List[Span] = []
+
+
+class _NullSpan:
+    """Shared no-op for unsampled queries — usable as a context manager
+    or imperatively (open/close)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def open(self):
+        return self
+
+    def close(self, **tags) -> None:
+        pass
+
+    def tag(self, key, value) -> None:
+        pass
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _SpanCtx:
+    """A live span: sets itself as the current span for its dynamic
+    extent, appends to the trace on close."""
+
+    __slots__ = ("_state", "_span", "_token", "_t0")
+
+    def __init__(self, state: _TraceState, parent: Span, name: str,
+                 tags: Optional[Dict[str, Any]]):
+        self._state = state
+        self._span = Span(name, parent.span_id, tags=tags)
+        self._token = None
+        self._t0 = 0.0
+
+    def __enter__(self) -> "_SpanCtx":
+        self._t0 = time.perf_counter()
+        self._token = _current.set((self._state, self._span))
+        return self
+
+    open = __enter__
+
+    def __exit__(self, *exc) -> bool:
+        self._span.dur_us = int((time.perf_counter() - self._t0) * 1e6)
+        if exc and exc[0] is not None:
+            self._span.tags.setdefault("error", exc[0].__name__)
+        if self._token is not None:
+            _current.reset(self._token)
+            self._token = None
+        self._state.spans.append(self._span)
+        return False
+
+    def close(self, **tags) -> None:
+        self._span.tags.update(tags)
+        self.__exit__(None, None, None)
+
+    def tag(self, key, value) -> None:
+        self._span.tags[key] = value
+
+
+class _UseCtx:
+    """Temporarily re-point the current thread at another request's
+    trace context (the dispatcher leader serving a waiter's request).
+    A None ctx DETACHES: serving an UNSAMPLED request must not record
+    its spans/degradation tags into the (possibly sampled) leader's
+    own trace — an N-query window would give the leader N duplicates
+    of every stage span and other requests' failure tags."""
+
+    __slots__ = ("_ctx", "_token")
+
+    def __init__(self, ctx):
+        self._ctx = ctx
+        self._token = None
+
+    def __enter__(self):
+        self._token = _current.set(self._ctx)
+        return self
+
+    def __exit__(self, *exc):
+        if self._token is not None:
+            _current.reset(self._token)
+            self._token = None
+        return False
+
+
+class TraceHandle:
+    """One sampled query trace, begin() -> finish(). The root span is
+    the current span for the extent between the two calls."""
+
+    __slots__ = ("_tracer", "_state", "_token", "_t0", "sampled",
+                 "trace_id")
+
+    def __init__(self, tracer: "Tracer", name: str,
+                 tags: Optional[Dict[str, Any]]):
+        self._tracer = tracer
+        root = Span(name, "", tags=tags)
+        self._state = _TraceState(_new_id(128), root)
+        self.trace_id = self._state.trace_id
+        self.sampled = True
+        self._t0 = time.perf_counter()
+        self._token = _current.set((self._state, root))
+
+    def finish(self, **tags) -> Optional[Dict[str, Any]]:
+        state = self._state
+        root = state.root
+        root.dur_us = int((time.perf_counter() - self._t0) * 1e6)
+        root.tags.update(tags)
+        _current.reset(self._token)
+        state.spans.append(root)
+        trace = {"trace_id": state.trace_id, "name": root.name,
+                 "t0_us": int(root.t0 * 1e6), "dur_us": root.dur_us,
+                 "tags": {k: _wire_tag(v) for k, v in root.tags.items()},
+                 "spans": [s.to_dict() for s in state.spans]}
+        self._tracer.ring.add(trace)
+        return trace
+
+
+class _NullHandle:
+    __slots__ = ()
+    sampled = False
+    trace_id = ""
+
+    def finish(self, **tags) -> None:
+        return None
+
+
+_NULL_HANDLE = _NullHandle()
+
+
+class RemoteTrace:
+    """Server-side adoption of a propagated trace context: opens a
+    root span with the CALLER's span as parent under the caller's
+    trace_id, collects every span recorded in its extent, and exposes
+    them wire-shaped for the RPC response. The fragment is also
+    deposited in the LOCAL ring, so storaged's /traces serves the
+    work it did for remote queries."""
+
+    __slots__ = ("_tracer", "_state", "_token", "_t0", "wire_spans")
+
+    def __init__(self, tracer: "Tracer", name: str, trace_id: str,
+                 parent_span_id: str):
+        self._tracer = tracer
+        root = Span(name, parent_span_id)
+        self._state = _TraceState(trace_id, root)
+        self.wire_spans: List[Tuple] = []
+
+    def __enter__(self) -> "RemoteTrace":
+        self._t0 = time.perf_counter()
+        self._token = _current.set((self._state, self._state.root))
+        return self
+
+    def __exit__(self, etype, evalue, tb) -> bool:
+        state = self._state
+        root = state.root
+        root.dur_us = int((time.perf_counter() - self._t0) * 1e6)
+        if etype is not None:
+            root.tags["error"] = etype.__name__
+        _current.reset(self._token)
+        state.spans.append(root)
+        self.wire_spans = [s.to_wire() for s in state.spans]
+        self._tracer.ring.add(
+            {"trace_id": state.trace_id, "name": root.name,
+             "t0_us": int(root.t0 * 1e6), "dur_us": root.dur_us,
+             "tags": dict(root.tags), "remote_fragment": True,
+             "spans": [s.to_dict() for s in state.spans]})
+        return False
+
+
+class TraceRing:
+    """Bounded ring of finished traces (newest kept)."""
+
+    def __init__(self, maxlen: int = 256):
+        self._dq: "deque[Dict[str, Any]]" = deque(maxlen=max(int(maxlen), 1))
+        self._lock = threading.Lock()
+
+    def add(self, trace: Dict[str, Any]) -> None:
+        with self._lock:
+            self._dq.append(trace)
+
+    def get(self, trace_id: str) -> Optional[Dict[str, Any]]:
+        with self._lock:
+            for t in reversed(self._dq):
+                if t["trace_id"] == trace_id:
+                    return t
+        return None
+
+    def list(self, min_dur_us: int = 0, feature: Optional[str] = None,
+             limit: int = 50) -> List[Dict[str, Any]]:
+        """Newest-first summaries (no span bodies — GET by id for the
+        full tree). `feature` matches the root 'feature' tag."""
+        with self._lock:
+            traces = list(self._dq)
+        out = []
+        for t in reversed(traces):
+            if t["dur_us"] < min_dur_us:
+                continue
+            if feature is not None and \
+                    t.get("tags", {}).get("feature") != feature:
+                continue
+            out.append({"trace_id": t["trace_id"], "name": t["name"],
+                        "t0_us": t["t0_us"], "dur_us": t["dur_us"],
+                        "tags": t.get("tags", {}),
+                        "n_spans": len(t.get("spans", ())),
+                        "remote_fragment": t.get("remote_fragment",
+                                                 False)})
+            if len(out) >= limit:
+                break
+        return out
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._dq)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._dq.clear()
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._dq)
+
+
+class Tracer:
+    """Process-global trace head: sampling decisions, the span API the
+    serve path calls, the finished-trace ring."""
+
+    def __init__(self, ring_size: int = 256):
+        self.sample_rate = 0.0
+        self.ring = TraceRing(ring_size)
+        self._armed = 0
+        self._lock = threading.Lock()
+        self._rng = random.Random()
+
+    # ------------------------------------------------------- sampling
+    def arm(self, n: int) -> int:
+        """The X-Trace admin knob: force-sample the next `n` queries
+        regardless of trace_sample_rate (served by /traces?arm=N)."""
+        with self._lock:
+            self._armed = max(int(n), 0)
+            return self._armed
+
+    def armed(self) -> int:
+        return self._armed
+
+    def _take_armed(self) -> bool:
+        if not self._armed:
+            return False
+        with self._lock:
+            if self._armed <= 0:
+                return False
+            self._armed -= 1
+            return True
+
+    def begin(self, name: str, force: bool = False,
+              **tags) -> "TraceHandle | _NullHandle":
+        """Head-sampling decision + trace start. The off-path cost for
+        unsampled queries is this method: one float compare (plus one
+        armed-counter check)."""
+        if not (force or self._take_armed()
+                or (self.sample_rate > 0.0
+                    and self._rng.random() < self.sample_rate)):
+            return _NULL_HANDLE
+        return TraceHandle(self, name, tags or None)
+
+    # ------------------------------------------------------- span API
+    def active(self) -> bool:
+        return _current.get() is not None
+
+    def span(self, name: str, **tags) -> "_SpanCtx | _NullSpan":
+        cur = _current.get()
+        if cur is None:
+            return _NULL_SPAN
+        return _SpanCtx(cur[0], cur[1], name, tags or None)
+
+    def add_span(self, name: str, dur_us: float,
+                 t_end: Optional[float] = None, **tags) -> None:
+        """Backdated child of the current span — for stages whose
+        duration was measured before the tracer is consulted (kernel
+        fetch, window-level encode)."""
+        cur = _current.get()
+        if cur is None:
+            return
+        state, parent = cur
+        end = time.time() if t_end is None else t_end
+        s = Span(name, parent.span_id, t0=end - dur_us / 1e6,
+                 tags=tags or None)
+        s.dur_us = int(dur_us)
+        state.spans.append(s)
+
+    def tag(self, key: str, value: Any) -> None:
+        cur = _current.get()
+        if cur is not None:
+            cur[1].tags[key] = value
+
+    def tag_root(self, key: str, value: Any) -> None:
+        """Tag the trace root — degradation events use this so a
+        degraded query is visible from the trace summary alone."""
+        cur = _current.get()
+        if cur is not None:
+            cur[0].root.tags[key] = value
+
+    # --------------------------------------------- cross-thread / RPC
+    def current_state(self):
+        """Opaque context for cross-THREAD handoff (tracer.use)."""
+        return _current.get()
+
+    def use(self, ctx) -> _UseCtx:
+        return _UseCtx(ctx)
+
+    def current_ctx(self) -> Optional[Tuple[str, str]]:
+        """(trace_id, span_id) for the RPC envelope, None when
+        unsampled."""
+        cur = _current.get()
+        if cur is None:
+            return None
+        return cur[0].trace_id, cur[1].span_id
+
+    def remote(self, name: str, trace_id: str,
+               parent_span_id: str) -> RemoteTrace:
+        return RemoteTrace(self, name, trace_id, parent_span_id)
+
+    def graft(self, wire_spans) -> None:
+        """Join a remote fragment (RPC response spans) into the
+        current trace. No-op when unsampled (a response can only carry
+        spans if the request carried a context, but a retry race may
+        outlive the trace)."""
+        cur = _current.get()
+        if cur is None or not wire_spans:
+            return
+        state = cur[0]
+        for w in wire_spans:
+            try:
+                state.spans.append(span_from_wire(w))
+            except Exception:
+                return   # malformed fragment: drop, never break a query
+
+
+# ---------------------------------------------------------------------------
+# slow-query log + active-query registry (the cases sampling misses)
+# ---------------------------------------------------------------------------
+
+class SlowQueryLog:
+    """Bounded log of queries over `slow_query_threshold_ms` (ref role:
+    the SlowOpTracker log lines, made queryable)."""
+
+    def __init__(self, maxlen: int = 128):
+        self._dq: "deque[Dict[str, Any]]" = deque(maxlen=max(int(maxlen), 1))
+        self._lock = threading.Lock()
+
+    def add(self, stmt: str, latency_us: int, session: int = -1,
+            user: str = "", trace_id: str = "", ok: bool = True) -> None:
+        with self._lock:
+            self._dq.append({"stmt": stmt[:512], "latency_us": int(latency_us),
+                             "session": session, "user": user,
+                             "trace_id": trace_id, "ok": bool(ok),
+                             "ts": time.time()})
+
+    def snapshot(self, limit: int = 50) -> List[Dict[str, Any]]:
+        with self._lock:
+            items = list(self._dq)
+        return list(reversed(items))[:limit]
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._dq)
+
+
+class ActiveQueryRegistry:
+    """What is running RIGHT NOW (SHOW QUERIES-style, served by
+    /queries): per-session current statement + elapsed. graphd
+    registers executing statements; storaged registers in-flight
+    processor work."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._next = itertools.count(1)
+        self._active: Dict[int, Dict[str, Any]] = {}
+
+    def register(self, stmt: str, session: int = -1, user: str = "",
+                 trace_id: str = "") -> int:
+        tok = next(self._next)
+        with self._lock:
+            self._active[tok] = {"id": tok, "stmt": stmt[:512],
+                                 "session": session, "user": user,
+                                 "trace_id": trace_id,
+                                 "t0": time.time(),
+                                 "_mono": time.monotonic()}
+        return tok
+
+    def unregister(self, token: int) -> None:
+        with self._lock:
+            self._active.pop(token, None)
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        now = time.monotonic()
+        with self._lock:
+            items = [dict(v) for v in self._active.values()]
+        out = []
+        for v in items:
+            v["elapsed_ms"] = round((now - v.pop("_mono")) * 1e3, 2)
+            out.append(v)
+        out.sort(key=lambda v: -v["elapsed_ms"])
+        return out
+
+    def count(self) -> int:
+        with self._lock:
+            return len(self._active)
+
+
+def _skip_ws_and_comments(s: str, i: int = 0) -> int:
+    """Advance past whitespace and the lexer's comment forms ('#' and
+    '//' line comments, '/* */' blocks) — the text sniff must see the
+    same first token the parser does."""
+    n = len(s)
+    while i < n:
+        if s[i] in " \t\r\n":
+            i += 1
+        elif s[i] == "#" or s[i:i + 2] == "//":
+            while i < n and s[i] != "\n":
+                i += 1
+        elif s[i:i + 2] == "/*":
+            j = s.find("*/", i + 2)
+            if j < 0:
+                return i   # unterminated: let the lexer error on it
+            i = j + 2
+        else:
+            break
+    return i
+
+
+def split_profile_prefix(stmt: str) -> Tuple[bool, str]:
+    """Text-level `PROFILE` prefix detection — THE shared rule for the
+    trace head (graph/engine) and the client retry classifier
+    (client/pool); GQLParser is the authority that actually consumes
+    the prefix token. Returns (profiled, rest-of-statement).
+    Comment-aware to match the lexer: the prefix is the first
+    identifier token PROFILE followed by any non-identifier
+    character (space, tab, newline, '(' ...)."""
+    s = stmt[_skip_ws_and_comments(stmt):]
+    if len(s) >= 7 and s[:7].upper() == "PROFILE" and \
+            (len(s) == 7 or not (s[7].isalnum() or s[7] == "_")):
+        rest = s[7:]
+        return True, rest[_skip_ws_and_comments(rest):]
+    return False, s
+
+
+# ---------------------------------------------------------------------------
+# rendering + aggregation
+# ---------------------------------------------------------------------------
+
+def render_tree(trace: Dict[str, Any]) -> List[Tuple[str, int, str]]:
+    """Trace dict -> rows (indented span name, dur_us, tags) in tree
+    order — what `PROFILE <stmt>` returns to the console."""
+    spans = trace.get("spans", [])
+    ids = {s["span_id"] for s in spans}
+    children: Dict[str, List[Dict[str, Any]]] = {}
+    roots = []
+    for s in spans:
+        if s["parent_id"] in ids:
+            children.setdefault(s["parent_id"], []).append(s)
+        else:
+            roots.append(s)
+    rows: List[Tuple[str, int, str]] = []
+
+    def fmt_tags(tags: Dict[str, Any]) -> str:
+        return " ".join(f"{k}={v}" for k, v in sorted(tags.items()))
+
+    def walk(s, depth):
+        rows.append(((". " * depth) + s["name"], int(s["dur_us"]),
+                     fmt_tags(s.get("tags", {}))))
+        for c in sorted(children.get(s["span_id"], ()),
+                        key=lambda x: x["t0_us"]):
+            walk(c, depth + 1)
+
+    for r in sorted(roots, key=lambda x: x["t0_us"]):
+        walk(r, 0)
+    return rows
+
+
+def stage_breakdown(traces: List[Dict[str, Any]],
+                    stages: Tuple[str, ...] = ("dispatcher.wait", "kernel",
+                                               "materialize", "encode")
+                    ) -> Dict[str, Dict[str, int]]:
+    """Per-stage p50/p95 (us) across traces — the bench tier-2/3
+    span-level breakdown (where the time goes, not just end-to-end)."""
+    buckets: Dict[str, List[int]] = {s: [] for s in stages}
+    for t in traces:
+        for s in t.get("spans", ()):
+            if s["name"] in buckets:
+                buckets[s["name"]].append(int(s["dur_us"]))
+    out: Dict[str, Dict[str, int]] = {}
+    for name, vals in buckets.items():
+        key = name.replace(".", "_")
+        if not vals:
+            out[key] = {"p50_us": 0, "p95_us": 0, "n": 0}
+            continue
+        vals.sort()
+        out[key] = {"p50_us": vals[len(vals) // 2],
+                    "p95_us": vals[min(len(vals) - 1,
+                                       int(len(vals) * 0.95))],
+                    "n": len(vals)}
+    return out
+
+
+# ---------------------------------------------------------------------------
+# flags + the process-global tracer
+# ---------------------------------------------------------------------------
+
+graph_flags.declare(
+    "trace_sample_rate", 0.0, MUTABLE,
+    "fraction of queries head-sampled into the trace ring (0 disables; "
+    "PROFILE <stmt> and /traces?arm=N force-sample regardless)")
+graph_flags.declare(
+    "slow_query_threshold_ms", 500, MUTABLE,
+    "queries slower than this land in the slow-query log (/queries); "
+    "0 disables")
+graph_flags.declare(
+    "trace_ring_size", 256, REBOOT,
+    "finished traces kept in the in-memory ring served by /traces")
+
+tracer = Tracer(int(graph_flags.get("trace_ring_size", 256) or 256))
+tracer.sample_rate = float(graph_flags.get("trace_sample_rate", 0.0) or 0.0)
+
+
+def _on_flag(name: str, value) -> None:
+    if name == "trace_sample_rate":
+        try:
+            tracer.sample_rate = float(value)
+        except (TypeError, ValueError):
+            pass
+
+
+graph_flags.watch(_on_flag)

@@ -139,11 +139,25 @@ class Console:
             print(f"Execution succeeded (server {resp.latency_us} us, "
                   f"wall {wall_ms:.2f} ms)", file=self.out)
         prof = getattr(resp, "profile", None)
-        if self.show_profile and prof:
+        if self.show_profile and prof and "mode" in prof:
             print(f"[tpu {prof['mode']}] snapshot {prof['snapshot_us']} us"
                   f" | kernel {prof['kernel_us']} us"
                   f" | materialize {prof['materialize_us']} us"
                   f" | delta edges {prof['delta_edges']}", file=self.out)
+        spans = getattr(resp, "trace_spans", None)
+        if spans:
+            # PROFILE <stmt>: the query's span tree, rendered as rows
+            # under the result table (common/tracing.render_tree)
+            from .common.tracing import render_tree
+            tree = render_tree(
+                {"spans": [{"span_id": s[0], "parent_id": s[1],
+                            "name": s[2], "t0_us": s[3], "dur_us": s[4],
+                            "tags": s[5]} for s in spans]})
+            print(render_table(["span", "dur_us", "tags"],
+                               [(n, d, t) for n, d, t in tree]),
+                  file=self.out)
+            print(f"Trace {getattr(resp, 'trace_id', '')} "
+                  f"({len(spans)} spans)", file=self.out)
         return True
 
     def run_file(self, path: str) -> None:

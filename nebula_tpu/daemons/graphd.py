@@ -80,6 +80,12 @@ def serve_graphd(meta_addr: str, host: str = "127.0.0.1", port: int = 0,
     if ws_port is not None:
         web = WebService("graphd", flags=graph_flags, stats=stats,
                          host=host, port=ws_port)
+        # observability surface (docs/manual/10-observability.md):
+        # /traces (trace ring + ?arm=N force knob), /queries (active
+        # statements + slow-query log), /metrics (Prometheus — the
+        # WebService built-in, extended with engine counters below)
+        web.register_observability(active=service.active_queries,
+                                   slow=service.slow_log)
 
         def faults_handler(params, body):
             # /faults: GET = registry state (armed plan, per-point fire
@@ -193,6 +199,37 @@ def serve_graphd(meta_addr: str, host: str = "127.0.0.1", port: int = 0,
                 }
 
             web.register("/tpu_stats", tpu_stats)
+
+            def tpu_metric_source():
+                # engine counter dicts as flat Prometheus gauges:
+                # tpu_engine_<counter>, plus the nested decline/serve
+                # matrices with stable dotted names
+                out = {}
+                # snapshot EVERY dict under the stats lock: engine
+                # threads insert new (feature, reason) keys under it,
+                # and iterating live dicts would intermittently throw
+                # mid-scrape (silently dropping all engine metrics)
+                with tpu_engine._stats_lock:
+                    st = dict(tpu_engine.stats)
+                    mesh_served = dict(tpu_engine.mesh_served)
+                    mesh_decl = {f: dict(d) for f, d in
+                                 tpu_engine.mesh_decline_reasons.items()}
+                    agg_decl = dict(tpu_engine.agg_decline_reasons)
+                    path_decl = dict(tpu_engine.path_decline_reasons)
+                for k, v in st.items():
+                    out[f"tpu_engine.{k}"] = v
+                for k, v in mesh_served.items():
+                    out[f"tpu_engine.mesh_served.{k}"] = v
+                for f, d in mesh_decl.items():
+                    for reason, v in d.items():
+                        out[f"tpu_engine.mesh_declined.{f}.{reason}"] = v
+                for k, v in agg_decl.items():
+                    out[f"tpu_engine.agg_declined.{k}"] = v
+                for k, v in path_decl.items():
+                    out[f"tpu_engine.path_declined.{k}"] = v
+                return out
+
+            web.add_metrics_source(tpu_metric_source)
         web.start()
     return GraphdHandle(service, engine, mc, server, web)
 

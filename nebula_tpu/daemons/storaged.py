@@ -253,6 +253,11 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
         web = WebService("storaged", flags=storage_flags, stats=stats,
                          host=host, port=ws_port)
         _register_admin_handlers(web, storage)
+        # observability surface: /traces serves this daemon's ring
+        # (remote fragments it recorded for graphd-headed traces),
+        # /queries its in-flight processor ops, /metrics the built-in
+        # Prometheus exposition (docs/manual/10-observability.md)
+        web.register_observability(active=storage.active_ops)
         web.start()
         wc_state["web"] = web
         if wc_state["fired"]:   # wrong-cluster fired before web existed

@@ -40,6 +40,7 @@ import numpy as np
 from ..common.faults import CircuitBreaker, faults
 from ..common.flags import graph_flags
 from ..common.stats import stats as global_stats
+from ..common.tracing import tracer as _tr
 from ..common.status import Status, StatusOr
 from ..filter.expressions import (Expression, InputPropExpr, VariablePropExpr)
 from ..parser import ast
@@ -68,7 +69,7 @@ class _GoReq:
     CPU pipe (docs/manual/9-robustness.md)."""
     __slots__ = ("ctx", "s", "starts", "edge_types", "alias_map",
                  "name_by_type", "key", "yield_cols", "result",
-                 "done", "claimed", "t_enq")
+                 "done", "claimed", "t_enq", "tctx")
 
     def __init__(self, ctx, s, starts, edge_types, alias_map,
                  name_by_type, key, yield_cols):
@@ -84,6 +85,10 @@ class _GoReq:
         self.done = False
         self.claimed = False
         self.t_enq = 0.0
+        # the owner's trace context (None unsampled): whoever serves
+        # this request — its own thread or a group leader — records
+        # spans into the OWNER's trace via tracer.use (tracing.py)
+        self.tctx = None
 
 
 def _uses_input_refs(exprs: List[Expression]) -> bool:
@@ -277,6 +282,14 @@ class TpuGraphEngine:
                             if snap is not None and snap.delta else 0),
         }
         self.profile_seq += 1
+        # every device-served query ends here with its stage timings —
+        # the one hook that turns them into trace spans (backdated;
+        # no-ops when the query is unsampled)
+        if _tr.active():
+            _tr.tag_root("mode", mode)
+            _tr.add_span("snapshot", t_snap * 1e6)
+            _tr.add_span("kernel", t_kernel * 1e6, mode=mode)
+            _tr.add_span("materialize", t_mat * 1e6)
 
     def start_trace(self, trace_dir: str) -> bool:
         """Opt-in XLA/JAX profiler trace of the device path; view with
@@ -362,7 +375,7 @@ class TpuGraphEngine:
         if churn - rec.get("churn_at_fit", 0) < self.BUDGET_RECAL_CHURN:
             return None
         self.stats["budget_recalibrations"] += 1
-        global_stats.add_value("tpu_engine.budget_recalibrations")
+        global_stats.add_value("tpu_engine.budget_recalibrations", kind="counter")
         # the stale record stays installed until the refit OVERWRITES
         # it: popping first would make one failed/empty refit disable
         # recalibration for the space forever (rec is None above), and
@@ -421,7 +434,8 @@ class TpuGraphEngine:
         with self._stats_lock:
             self.mesh_served[feature] = \
                 self.mesh_served.get(feature, 0) + n
-        global_stats.add_value("tpu_engine.mesh_served." + feature)
+        global_stats.add_value("tpu_engine.mesh_served." + feature,
+                               kind="counter")
         # a successful meshed serve is the mesh breaker's probe
         # success: a half-open mesh closes and stays re-admitted
         self._device_ok("mesh")
@@ -433,7 +447,8 @@ class TpuGraphEngine:
             d = self.mesh_decline_reasons.setdefault(feature, {})
             d[reason] = d.get(reason, 0) + 1
         global_stats.add_value(
-            f"tpu_engine.mesh_declined.{feature}.{reason}")
+            f"tpu_engine.mesh_declined.{feature}.{reason}",
+            kind="counter")
 
     # ------------------------------------------------------------------
     # degradation ladder: per-feature circuit breakers + deadline
@@ -461,7 +476,8 @@ class TpuGraphEngine:
             with self._stats_lock:
                 self.stats["degraded_serves"] += 1
             global_stats.add_value("tpu_engine.degraded_serves."
-                                   + feature)
+                                   + feature, kind="counter")
+            _tr.tag_root("degraded", "breaker_open:" + feature)
             return False
         if ctx is not None:
             ms = self.query_deadline_ms
@@ -478,7 +494,7 @@ class TpuGraphEngine:
         if b.recoveries != r0:
             with self._stats_lock:
                 self.stats["breaker_recoveries"] += 1
-            global_stats.add_value("tpu_engine.breaker_recoveries")
+            global_stats.add_value("tpu_engine.breaker_recoveries", kind="counter")
             _LOG.info("device path %r recovered: half-open probe "
                       "succeeded, breaker closed", feature)
 
@@ -504,10 +520,18 @@ class TpuGraphEngine:
         if tripped:
             with self._stats_lock:
                 self.stats["breaker_trips"] += 1
-            global_stats.add_value("tpu_engine.breaker_trips")
+            global_stats.add_value("tpu_engine.breaker_trips",
+                                   kind="counter")
         with self._stats_lock:
             self.stats["degraded_serves"] += 1
-        global_stats.add_value("tpu_engine.device_failures." + feature)
+        global_stats.add_value("tpu_engine.device_failures." + feature,
+                               kind="counter")
+        # the degraded serve is visibly degraded in its own trace
+        # (leaders serving a waiter's request are re-pointed at the
+        # waiter's trace via tracer.use, so the tag lands correctly)
+        _tr.tag_root("degraded", "cpu_retry:" + feature)
+        if tripped:
+            _tr.tag_root("breaker_tripped", feature)
         _LOG.warning(
             "device path %r failed, query retried on the CPU pipe%s: "
             "%r", feature,
@@ -524,7 +548,9 @@ class TpuGraphEngine:
             return False
         with self._stats_lock:
             self.stats["deadline_exceeded"] += 1
-        global_stats.add_value("tpu_engine.deadline_exceeded." + where)
+        global_stats.add_value("tpu_engine.deadline_exceeded." + where,
+                               kind="counter")
+        _tr.tag_root("degraded", "deadline:" + where)
         return True
 
     def _mesh_failed(self, feature: str, exc: Exception, snap) -> None:
@@ -535,12 +561,14 @@ class TpuGraphEngine:
         unsharded (_build_fresh skips sharding for demoted spaces).
         Half-open probes re-admit the mesh via _snapshot_locked."""
         self._mesh_decline(feature, "exec_error")
+        _tr.tag_root("degraded", "mesh_failed:" + feature)
         b = self._breaker("mesh")
         tripped = b.record_failure()
         if tripped:
             with self._stats_lock:
                 self.stats["breaker_trips"] += 1
-            global_stats.add_value("tpu_engine.breaker_trips")
+            global_stats.add_value("tpu_engine.breaker_trips",
+                                   kind="counter")
         _LOG.warning("meshed %s serve failed%s: %r", feature,
                      " (mesh breaker tripped)" if tripped else "", exc)
         if (tripped or b.state != CircuitBreaker.CLOSED) and \
@@ -552,7 +580,7 @@ class TpuGraphEngine:
             if first:
                 with self._stats_lock:
                     self.stats["mesh_demotions"] += 1
-                global_stats.add_value("tpu_engine.mesh_demotions")
+                global_stats.add_value("tpu_engine.mesh_demotions", kind="counter")
                 _LOG.warning(
                     "space %d demoted to single-device serving "
                     "(unsharded rebuild kicked; half-open mesh probes "
@@ -838,7 +866,7 @@ class TpuGraphEngine:
             # later refresh()/repack rebuilds cleanly.
             snap.stale = True
             self.stats["snapshot_poisoned"] += 1
-            global_stats.add_value("tpu_engine.snapshot_poisoned")
+            global_stats.add_value("tpu_engine.snapshot_poisoned", kind="counter")
             self._kick_repack(space_id)
             return None
         return self.refresh(space_id)
@@ -964,7 +992,7 @@ class TpuGraphEngine:
                 delay = min(2.0 ** (n - 1), 60.0)
                 self._repack_backoff[space_id] = (n, time.time() + delay)
                 self.stats["repack_failures"] += 1
-                global_stats.add_value("tpu_engine.repack_failures")
+                global_stats.add_value("tpu_engine.repack_failures", kind="counter")
                 _LOG.exception(
                     "background repack of space %d failed (consecutive "
                     "failure %d, next attempt in %.0fs); continuing to "
@@ -1020,7 +1048,8 @@ class TpuGraphEngine:
             self.stats["path_declined"] += 1
             self.path_decline_reasons[reason] = \
                 self.path_decline_reasons.get(reason, 0) + 1
-        global_stats.add_value("tpu_engine.path_declined." + reason)
+        global_stats.add_value("tpu_engine.path_declined." + reason,
+                               kind="counter")
         return False
 
     # ------------------------------------------------------------------
@@ -1126,11 +1155,17 @@ class TpuGraphEngine:
                      (ctx.space_id(), int(s.step.steps),
                       tuple(edge_types)), yield_cols)
         req.t_enq = time.monotonic()
+        req.tctx = _tr.current_state()
         dl = getattr(ctx, "_tpu_deadline", None)
         with self._disp_cv:
             self._disp_queue.append(req)
         batch = None
         timed_out = False
+        # dispatcher_wait: from enqueue until the owner either wakes
+        # done (a leader served it) or becomes a leader itself — the
+        # queueing stage of the span tree (no-op when unsampled)
+        wait_sp = _tr.span("dispatcher.wait").open()
+        waited = False
         while True:
             with self._disp_cv:
                 while not req.done and (
@@ -1177,18 +1212,34 @@ class TpuGraphEngine:
                 self.stats["disp_group_keys"] += 1 + len(
                     {r.key for r in self._disp_queue
                      if r.key != req.key})
+            if not waited:
+                # elected leader: the wait is over — serving time is
+                # accounted by the window/kernel/materialize spans
+                wait_sp.close(role="leader")
+                waited = True
             try:
                 self._serve_batch(batch, ex)
             finally:
                 self._release_round(req.key, batch[0])
             if req.done:
                 break
+        if not waited:
+            wait_sp.close(role="waiter")
         if timed_out:
             with self._stats_lock:
                 self.stats["deadline_exceeded"] += 1
             global_stats.add_value(
-                "tpu_engine.deadline_exceeded.dispatch_wait")
+                "tpu_engine.deadline_exceeded.dispatch_wait",
+                kind="counter")
+            _tr.tag_root("degraded", "deadline:dispatch_wait")
             return None
+        if req.result is None:
+            # the round failed/declined and this request re-serves on
+            # the CPU pipe in its own session — visible in the owner's
+            # trace (specific failure sites add their own tags; this
+            # catch-all covers benign declines like a poisoned or
+            # missing snapshot)
+            _tr.tag_root("degraded", "cpu_fallback")
         return self._finalize_result(req.result)
 
     def _release_round(self, key, owner: "_GoReq") -> None:
@@ -1269,6 +1320,8 @@ class TpuGraphEngine:
             for r in batch:
                 if not r.done:
                     r.result = None
+                    with _tr.use(r.tctx):
+                        _tr.tag_root("degraded", "window_failed")
             self._mark_done(batch)
 
     def _serve_group(self, group: List["_GoReq"], ex) -> None:
@@ -1288,10 +1341,16 @@ class TpuGraphEngine:
         if not multi:
             r = group[0]
             try:
-                with self._lock:
-                    r.result = self._execute_go_locked(
-                        r.ctx, r.s, r.starts, r.edge_types, r.alias_map,
-                        r.name_by_type, ex, r.yield_cols)
+                # the solo round is still a dispatcher window (of 1):
+                # PROFILE of an idle GO shows the same tree shape as a
+                # coalesced one, just with window=1
+                with _tr.use(r.tctx), \
+                        _tr.span("dispatcher.window", window=1):
+                    with self._lock:
+                        r.result = self._execute_go_locked(
+                            r.ctx, r.s, r.starts, r.edge_types,
+                            r.alias_map, r.name_by_type, ex,
+                            r.yield_cols)
             except Exception as e:
                 self._device_failed("go", e)
                 r.result = None    # owner re-serves on the CPU pipe
@@ -1323,35 +1382,40 @@ class TpuGraphEngine:
             # single-query path) — every live frontier rides the
             # sharded window dispatch.
             for r in group:
-                try:
-                    if self._deadline_exceeded(r.ctx, "dispatch_claim"):
-                        r.result = None    # CPU pipe serves it
-                        self._mark_done([r], early=True)
-                        continue
-                    yield_cols = r.yield_cols
-                    columns = [c.name() for c in yield_cols]
-                    frontier0 = snap.frontier_from_vids(r.starts)
-                    if not frontier0.any():
-                        r.result = StatusOr.of(ex.InterimResult(columns))
-                        self._mark_done([r], early=True)
-                        continue
-                    if not meshed:
-                        t1 = time.monotonic()
-                        sparse = self._sparse_expand(snap, r.starts,
-                                                     r.edge_types, steps)
-                        t_walk = time.monotonic() - t1
-                        if sparse is not None:
-                            r.result = self._emit_sparse(
-                                r.ctx, r.s, snap, sparse, yield_cols,
-                                columns, r.alias_map, r.name_by_type, ex,
-                                r.edge_types, t_snap, t_walk)
+                # spans recorded while serving THIS request belong to
+                # its owner's trace, not the leader's
+                with _tr.use(r.tctx):
+                    try:
+                        if self._deadline_exceeded(r.ctx,
+                                                   "dispatch_claim"):
+                            r.result = None    # CPU pipe serves it
                             self._mark_done([r], early=True)
                             continue
-                    dense.append((r, frontier0, yield_cols, columns))
-                except Exception as e:
-                    self._device_failed("go", e)
-                    r.result = None    # owner re-serves on the CPU pipe
-                    self._mark_done([r], early=True)
+                        yield_cols = r.yield_cols
+                        columns = [c.name() for c in yield_cols]
+                        frontier0 = snap.frontier_from_vids(r.starts)
+                        if not frontier0.any():
+                            r.result = StatusOr.of(
+                                ex.InterimResult(columns))
+                            self._mark_done([r], early=True)
+                            continue
+                        if not meshed:
+                            t1 = time.monotonic()
+                            sparse = self._sparse_expand(
+                                snap, r.starts, r.edge_types, steps)
+                            t_walk = time.monotonic() - t1
+                            if sparse is not None:
+                                r.result = self._emit_sparse(
+                                    r.ctx, r.s, snap, sparse, yield_cols,
+                                    columns, r.alias_map, r.name_by_type,
+                                    ex, r.edge_types, t_snap, t_walk)
+                                self._mark_done([r], early=True)
+                                continue
+                        dense.append((r, frontier0, yield_cols, columns))
+                    except Exception as e:
+                        self._device_failed("go", e)
+                        r.result = None    # CPU pipe re-serves it
+                        self._mark_done([r], early=True)
             if not dense:
                 return
             use_delta = snap.delta is not None and snap.delta.edge_count > 0
@@ -1461,14 +1525,16 @@ class TpuGraphEngine:
         degrades to the CPU pipe in its own session (result=None),
         never to a client error."""
         for r in reqs:
-            try:
-                with self._lock:
-                    r.result = self._execute_go_locked(
-                        r.ctx, r.s, r.starts, r.edge_types, r.alias_map,
-                        r.name_by_type, ex, r.yield_cols)
-            except Exception as e:
-                self._device_failed("go", e)
-                r.result = None
+            with _tr.use(r.tctx):
+                try:
+                    with self._lock:
+                        r.result = self._execute_go_locked(
+                            r.ctx, r.s, r.starts, r.edge_types,
+                            r.alias_map, r.name_by_type, ex,
+                            r.yield_cols)
+                except Exception as e:
+                    self._device_failed("go", e)
+                    r.result = None
 
     def _encode_sink(self, sink: List[Tuple]) -> None:
         """The whole window's deferred rows in ONE native GIL-released
@@ -1477,15 +1543,26 @@ class TpuGraphEngine:
         the CPU pipe (result=None) — never a silent empty result and
         never a client-visible error."""
         try:
+            t0 = time.monotonic()
             encs, native_used = materialize.encode_window(
                 [g for (_r, g, _t) in sink])
+            enc_us = (time.monotonic() - t0) * 1e6
             self._count_encode(sum(len(e) for e in encs), native_used)
             for (r, _g, _t2), enc in zip(sink, encs):
                 r.result.value()._tpu_deferred = enc
+                # one shared native call encoded the whole window: each
+                # owner's trace gets the span (same duration, tagged
+                # with the window rows so the sharing is readable)
+                with _tr.use(r.tctx):
+                    _tr.add_span("encode", enc_us, rows=len(enc),
+                                 native=native_used,
+                                 window=len(sink))
         except Exception as e:
             self._device_failed("go", e)
             for r, _g, _t2 in sink:
                 r.result = None
+                with _tr.use(r.tctx):
+                    _tr.tag_root("degraded", "encode_failed")
 
     def _serve_meshed_chunks(self, dense, cap, n_chunks, snap, v0,
                              steps, req_arr, owner, plan_filter_cached,
@@ -1511,6 +1588,7 @@ class TpuGraphEngine:
             chunk = dense[c0:c0 + cap]
             last_chunk = ci == n_chunks - 1
             launch_err = None
+            t_win0 = time.monotonic()
             t1 = time.monotonic()
             with self._lock:
                 redo = snap.stale or snap.write_version != v0
@@ -1573,27 +1651,13 @@ class TpuGraphEngine:
                 self.stats["batched_dispatches"] += 1
                 self.stats["batched_queries"] += len(chunk)
                 stale2 = snap.stale or snap.write_version != v0
-                for i, (r, _f0, yield_cols, columns) in enumerate(chunk):
-                    try:
-                        if stale2:
-                            r.result = self._execute_go_locked(
-                                r.ctx, r.s, r.starts, r.edge_types,
-                                r.alias_map, r.name_by_type, ex,
-                                r.yield_cols)
-                            continue
-                        device_mask, local_filter = plan_filter_cached(r)
-                        mask = masks_np[i]
-                        if device_mask is not None:
-                            mask = mask & np.asarray(device_mask)
-                        r.result = self._go_emit_dense(
-                            r.ctx, r.s, snap, mask, None, local_filter,
-                            yield_cols, columns, r.alias_map,
-                            r.name_by_type, ex, r.edge_types, t_snap,
-                            t_kernel, sink=sink, sink_req=r)
+                win_us = (time.monotonic() - t_win0) * 1e6
+                for i, entry in enumerate(chunk):
+                    if self._serve_window_request(
+                            entry, i, ci, len(chunk), stale2, win_us,
+                            masks_np, None, plan_filter_cached, ex,
+                            snap, t_snap, t_kernel, sink, meshed=True):
                         served += 1
-                    except Exception as e:
-                        self._device_failed("go", e)
-                        r.result = None    # CPU pipe re-serves it
                 # only queries the batched sharded dispatch actually
                 # served — stale2 redos are charged by their own
                 # single-query serve, never twice
@@ -1613,6 +1677,7 @@ class TpuGraphEngine:
             chunk = dense[c0:c0 + cap]
             last_chunk = ci == n_chunks - 1
             launch_err = None
+            t_win0 = time.monotonic()
             t1 = time.monotonic()
             with self._lock:
                 redo = snap.stale or snap.write_version != v0
@@ -1724,6 +1789,8 @@ class TpuGraphEngine:
                 for r, *_ in chunk:
                     if not r.done:
                         r.result = None
+                        with _tr.use(r.tctx):
+                            _tr.tag_root("degraded", "window_failed")
                 self._mark_done([r for r, *_ in chunk],
                                 early=not last_chunk)
                 continue
@@ -1742,31 +1809,54 @@ class TpuGraphEngine:
                 self.stats["batched_dispatches"] += 1
                 self.stats["batched_queries"] += len(chunk)
                 stale2 = snap.stale or snap.write_version != v0
-                for i, (r, _f0, yield_cols, columns) in enumerate(chunk):
-                    try:
-                        if stale2:
-                            r.result = self._execute_go_locked(
-                                r.ctx, r.s, r.starts, r.edge_types,
-                                r.alias_map, r.name_by_type, ex,
-                                r.yield_cols)
-                            continue
-                        device_mask, local_filter = plan_filter_cached(r)
-                        mask = masks_np[i]
-                        if device_mask is not None:
-                            mask = mask & np.asarray(device_mask)
-                        d_mask = dmasks_np[i] if dmasks_np is not None \
-                            else None
-                        r.result = self._go_emit_dense(
-                            r.ctx, r.s, snap, mask, d_mask, local_filter,
-                            yield_cols, columns, r.alias_map,
-                            r.name_by_type, ex, r.edge_types, t_snap,
-                            t_kernel, sink=sink, sink_req=r)
-                    except Exception as e:
-                        self._device_failed("go", e)
-                        r.result = None    # CPU pipe re-serves it
+                win_us = (time.monotonic() - t_win0) * 1e6
+                for i, entry in enumerate(chunk):
+                    self._serve_window_request(
+                        entry, i, ci, len(chunk), stale2, win_us,
+                        masks_np, dmasks_np, plan_filter_cached, ex,
+                        snap, t_snap, t_kernel, sink, meshed=False)
             if sink:
                 self._encode_sink(sink)
             self._mark_done([r for r, *_ in chunk], early=not last_chunk)
+
+    def _serve_window_request(self, entry, i, ci, window, stale2,
+                              win_us, masks_np, dmasks_np,
+                              plan_filter_cached, ex, snap, t_snap,
+                              t_kernel, sink, meshed) -> bool:
+        """One request of a batched window, under the engine lock —
+        the per-request tail SHARED by the meshed and single-chip
+        chunk loops. Per-request spans (the shared window launch +
+        this request's own materialize, via _record_profile) record
+        into the OWNER's trace; a stale snapshot redoes through the
+        single-query path and a failure degrades to the CPU pipe in
+        the owner's session. Returns True only when the batched
+        dispatch actually served the request (mesh accounting: stale2
+        redos are charged by their own single-query serve)."""
+        r, _f0, yield_cols, columns = entry
+        with _tr.use(r.tctx):
+            try:
+                if stale2:
+                    r.result = self._execute_go_locked(
+                        r.ctx, r.s, r.starts, r.edge_types,
+                        r.alias_map, r.name_by_type, ex, r.yield_cols)
+                    return False
+                _tr.add_span("dispatcher.window", win_us,
+                             window=window, chunk=ci, meshed=meshed)
+                device_mask, local_filter = plan_filter_cached(r)
+                mask = masks_np[i]
+                if device_mask is not None:
+                    mask = mask & np.asarray(device_mask)
+                d_mask = dmasks_np[i] if dmasks_np is not None else None
+                r.result = self._go_emit_dense(
+                    r.ctx, r.s, snap, mask, d_mask, local_filter,
+                    yield_cols, columns, r.alias_map, r.name_by_type,
+                    ex, r.edge_types, t_snap, t_kernel,
+                    sink=sink, sink_req=r)
+                return True
+            except Exception as e:
+                self._device_failed("go", e)
+                r.result = None    # CPU pipe re-serves it
+                return False
 
     def _calibrate_batched_kernel(self, snap, f0s, steps, ak, a_chunk,
                                   a_group, req_arr):
@@ -1814,7 +1904,8 @@ class TpuGraphEngine:
         rec = {"lane_ms": round(lane_s * 1e3, 1),
                "vmap_ms": round(vmap_s * 1e3, 1), "pick": pick}
         self.batched_kernel_calibrations[snap.space_id] = rec
-        global_stats.add_value("tpu_engine.batched_kernel_pick_" + pick)
+        global_stats.add_value("tpu_engine.batched_kernel_pick_" + pick,
+                               kind="counter")
         _LOG.info("batched kernel calibrated (space %d): %s",
                   snap.space_id, rec)
 
@@ -1945,10 +2036,14 @@ class TpuGraphEngine:
                     # the request — never a silent empty result)
                     sink.append((sink_req, gathered, t2))
                 else:
+                    t3 = time.monotonic()
                     encs, native_used = materialize.encode_window(
                         [gathered])
                     self._count_encode(len(encs[0]), native_used)
                     result._tpu_deferred = encs[0]
+                    _tr.add_span("encode",
+                                 (time.monotonic() - t3) * 1e6,
+                                 rows=len(encs[0]), native=native_used)
                 self.stats["fast_materialize"] += 1
                 self.stats["go_served"] += 1
                 self._record_profile("dense", t_snap, t_kernel,
@@ -2111,7 +2206,8 @@ class TpuGraphEngine:
             self.stats["agg_declined"] += 1
             self.agg_decline_reasons[reason] = \
                 self.agg_decline_reasons.get(reason, 0) + 1
-        global_stats.add_value("tpu_engine.agg_declined." + reason)
+        global_stats.add_value("tpu_engine.agg_declined." + reason,
+                               kind="counter")
         return None
 
     def _go_aggregate_locked(self, ctx, s, specs, out_cols, starts,
@@ -2248,7 +2344,8 @@ class TpuGraphEngine:
                     return self._agg_decline("exec_error")
                 if self.stats.get("agg_grouped_chunked", 0) > chunked0:
                     global_stats.add_value(
-                        "tpu_engine.agg_grouped_chunked")
+                        "tpu_engine.agg_grouped_chunked",
+                        kind="counter")
                 self._mesh_served("agg")
             else:
                 n_active = int(jnp.sum(active))
@@ -2261,7 +2358,8 @@ class TpuGraphEngine:
                     self.stats["agg_grouped_chunked"] = \
                         self.stats.get("agg_grouped_chunked", 0) + 1
                     global_stats.add_value(
-                        "tpu_engine.agg_grouped_chunked")
+                        "tpu_engine.agg_grouped_chunked",
+                        kind="counter")
                 groups, cols = aggregate.grouped_reduce(
                     keyed_specs, active, vals, snap.d_edge_gidx,
                     snap.num_parts * snap.cap_v)
@@ -2946,10 +3044,13 @@ class TpuGraphEngine:
                 ctx.sm, ctx.space_id(), snap, None, yield_cols,
                 alias_map, name_by_type, idx_per_part=act_idx)
             if gathered is not None:
+                t3 = time.monotonic()
                 encs, native_used = materialize.encode_window([gathered])
                 self._count_encode(len(encs[0]), native_used)
                 result = ex.InterimResult(columns)
                 result._tpu_deferred = encs[0]
+                _tr.add_span("encode", (time.monotonic() - t3) * 1e6,
+                             rows=len(encs[0]), native=native_used)
                 self.stats["fast_materialize"] += 1
                 self.stats["go_served"] += 1
                 self.stats["sparse_served"] += 1

@@ -25,11 +25,36 @@ class ExecutionResponse:
     space_name: str = ""
     warning: str = ""
     # device-path stage breakdown when the TPU engine served this query
-    # (ref role: per-stage latency in ExecutionPlan.cpp:57 responses)
+    # (ref role: per-stage latency in ExecutionPlan.cpp:57 responses).
+    # A `PROFILE <stmt>` additionally carries the query's span tree in
+    # here ("trace_id" + "trace_spans" keys) — the profile MAP is the
+    # one extensible slot the FROZEN v1 wire spec gives us
+    # (docs/manual/6-wire-protocol.md: ExecutionResponse has exactly 8
+    # positional fields; old clients skip unknown map keys, adding a
+    # dataclass field would break every conformance vector)
     profile: Optional[Dict[str, Any]] = None
 
     def ok(self) -> bool:
         return self.code == ErrorCode.SUCCEEDED
+
+    # convenience accessors over the profile map (see field comment)
+    @property
+    def trace_id(self) -> str:
+        return (self.profile or {}).get("trace_id", "")
+
+    @property
+    def trace_spans(self):
+        """PROFILE span tree: list of (span_id, parent_id, name,
+        t0_us, dur_us, tags) — common/tracing.render_tree renders it."""
+        return (self.profile or {}).get("trace_spans")
+
+    def attach_trace(self, trace_id: str, spans) -> None:
+        # copy-on-write: profile may alias a shared dict (the engine's
+        # last_profile) — writing trace keys into it in place would
+        # leak this query's span tree into other sessions' responses
+        self.profile = dict(self.profile) if self.profile else {}
+        self.profile["trace_id"] = trace_id
+        self.profile["trace_spans"] = spans
 
 
 class ExecContext:

@@ -11,6 +11,7 @@ import time
 from typing import Callable, Dict, Optional
 
 from ..common.status import ErrorCode, Status, StatusOr
+from ..common.tracing import (ActiveQueryRegistry, SlowQueryLog, tracer)
 from ..meta.schema_manager import SchemaManager
 from ..parser import GQLParser, ParseError, ast
 from . import admin_executors as adm
@@ -82,17 +83,21 @@ class ExecutionEngine:
             # throws spurious syntax errors (found by the concurrent
             # soak; the reference constructs its parser per query too,
             # GQLParser.h)
-            seq = GQLParser().parse(text)
+            with tracer.span("parse"):
+                seq = GQLParser().parse(text)
         except ParseError as e:
             resp.code = ErrorCode.E_SYNTAX_ERROR
             resp.error_msg = str(e)
             return resp
+        if seq.sentences:
+            tracer.tag_root("feature", seq.sentences[0].kind.value)
         ctx = ExecContext(self, session)
         result: Optional[InterimResult] = None
         tpu = self.tpu_engine
         profile_seq0 = tpu.profile_seq if tpu is not None else 0
         for sentence in seq.sentences:
-            r = self._run(ctx, sentence)
+            with tracer.span("exec." + sentence.kind.value):
+                r = self._run(ctx, sentence)
             if not r.ok():
                 resp.code = r.status.code
                 resp.error_msg = r.status.msg or r.status.code.name
@@ -107,8 +112,11 @@ class ExecutionEngine:
         if tpu is not None and tpu.profile_seq != profile_seq0:
             # device-served: attach the engine's per-stage breakdown
             # (under concurrent sessions the latest served wins — the
-            # breakdown is diagnostics, not an accounting ledger)
-            resp.profile = tpu.last_profile
+            # breakdown is diagnostics, not an accounting ledger).
+            # COPY: the engine dict is shared across sessions, and the
+            # response may later merge per-query trace keys into it
+            lp = tpu.last_profile
+            resp.profile = dict(lp) if lp else lp
         resp.latency_us = int((time.monotonic() - t0) * 1e6)
         return resp
 
@@ -193,14 +201,26 @@ _DISPATCH: Dict[ast.Kind, Callable] = {
 }
 
 
+def _wants_profile(text: str) -> bool:
+    """Pre-parse sniff for the PROFILE prefix — the sampling decision
+    must land BEFORE parsing so the parse span is in the trace; the
+    parser is the authority on actually consuming the prefix."""
+    from ..common.tracing import split_profile_prefix
+    return split_profile_prefix(text)[0]
+
+
 class GraphService:
     """Authentication + session-scoped execute (ref: graph/GraphService
-    .cpp:17-77)."""
+    .cpp:17-77). Hosts the per-daemon observability registries: the
+    active-query registry and slow-query log behind /queries, and the
+    trace head (begin/finish) for every executed statement."""
 
     def __init__(self, engine: ExecutionEngine,
                  sessions: Optional[SessionManager] = None):
         self.engine = engine
         self.sessions = sessions or SessionManager()
+        self.active_queries = ActiveQueryRegistry()
+        self.slow_log = SlowQueryLog()
 
     def authenticate(self, user: str, password: str) -> StatusOr[int]:
         if not self.engine.meta.check_password(user, password):
@@ -218,16 +238,45 @@ class GraphService:
             resp.code = sr.status.code
             resp.error_msg = sr.status.msg
             return resp
-        resp = self.engine.execute(sr.value(), text)
+        session = sr.value()
+        # trace head: one sampled-flag check per query; PROFILE forces
+        # the sample (and attaches the span tree to the response)
+        profiled = _wants_profile(text)
+        handle = tracer.begin("query", force=profiled,
+                              session=session_id, user=session.user)
+        qtok = self.active_queries.register(
+            text, session=session_id, user=session.user,
+            trace_id=handle.trace_id)
+        try:
+            resp = self.engine.execute(session, text)
+        except BaseException:
+            # the handle owns this thread's trace context: finish it
+            # even on an engine bug, or the NEXT query on this
+            # connection thread would record into a dead trace
+            self.active_queries.unregister(qtok)
+            handle.finish(ok=False, error=True)
+            raise
+        self.active_queries.unregister(qtok)
+        trace = handle.finish(ok=resp.ok(), latency_us=resp.latency_us)
+        if trace is not None and profiled and resp.ok():
+            resp.attach_trace(trace["trace_id"], [
+                (s["span_id"], s["parent_id"], s["name"], s["t0_us"],
+                 s["dur_us"], s["tags"]) for s in trace["spans"]])
         # per-query QPS/latency metrics + slow-op log (ref: per-query
         # latency_in_us in every response, SlowOpTracker)
         from ..common.flags import graph_flags
         from ..common.stats import stats
-        stats.add_value("graph.query")
-        stats.add_value("graph.query_latency_us", resp.latency_us)
+        stats.add_value("graph.query", kind="counter")
+        stats.add_value("graph.query_latency_us", resp.latency_us,
+                        kind="timing")
         if not resp.ok():
-            stats.add_value("graph.query_error")
+            stats.add_value("graph.query_error", kind="counter")
         slow_ms = graph_flags.get("slow_op_threshold_ms", 50)
         if resp.latency_us > slow_ms * 1000:
-            stats.add_value("graph.slow_query")
+            stats.add_value("graph.slow_query", kind="counter")
+        slowlog_ms = graph_flags.get("slow_query_threshold_ms", 500)
+        if slowlog_ms and resp.latency_us > slowlog_ms * 1000:
+            self.slow_log.add(text, resp.latency_us, session=session_id,
+                              user=session.user,
+                              trace_id=handle.trace_id, ok=resp.ok())
         return resp

@@ -191,10 +191,15 @@ class EdgeKeyRef:
 @dataclass
 class SequentialSentences(Sentence):
     sentences: List[Sentence]
+    # `PROFILE <stmt>` prefix: execute identically but force-sample the
+    # query's trace and return the rendered span tree with the response
+    # (common/tracing.py; docs/manual/10-observability.md)
+    profile: bool = False
     kind = Kind.SEQUENTIAL
 
     def to_string(self) -> str:
-        return "; ".join(s.to_string() for s in self.sentences)
+        prefix = "PROFILE " if self.profile else ""
+        return prefix + "; ".join(s.to_string() for s in self.sentences)
 
 
 @dataclass

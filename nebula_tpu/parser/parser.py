@@ -49,6 +49,15 @@ class GQLParser:
         except LexError as e:
             raise ParseError(str(e))
         self.i = 0
+        # `PROFILE <stmt>`: a statement PREFIX, not a keyword — an
+        # identifier named "profile" elsewhere still lexes/parses
+        # unchanged (the reference grammar's EXPLAIN/PROFILE seam)
+        profile = False
+        t0 = self.toks[0]
+        if t0.type == T_ID and isinstance(t0.value, str) \
+                and t0.value.upper() == "PROFILE" and len(self.toks) > 2:
+            profile = True
+            self.i = 1
         sentences = []
         while not self._at(T_EOF):
             if self._accept(";"):
@@ -56,7 +65,7 @@ class GQLParser:
             sentences.append(self._statement())
         if not sentences:
             raise ParseError("empty statement")
-        return ast.SequentialSentences(sentences)
+        return ast.SequentialSentences(sentences, profile=profile)
 
     # ------------------------------------------------------------------
     # token helpers

@@ -6,8 +6,17 @@ setup, daemons/*.cpp), clients keep pooled connections per (host,
 port) like `ThriftClientManager` (ref common/thrift/ThriftClientManager
 .h). Frames are u32-length-prefixed wire.py payloads:
 
-    request  = (service: str, method: str, args: tuple, kwargs: dict)
-    response = (True, result) | (False, exception string)
+    request  = (service: str, method: str, args: tuple, kwargs: dict
+                [, (trace_id, span_id)])
+    response = (True, result[, spans]) | (False, exception string)
+
+The optional 5th request element is the Dapper-style propagated trace
+context (common/tracing.py): a traced caller stamps it on the
+envelope, the server adopts it around the handler (child spans open
+around processor + KV work) and returns the recorded spans as the
+response's 3rd element, which the client grafts into its live trace —
+graphd joins the full graphd->storaged span tree with zero cost on
+untraced calls (the envelope stays a 4-tuple).
 
 Remote exceptions re-raise client-side as RpcError. The server is a
 thread-per-connection loop (daemons are IO-bound python; the heavy
@@ -25,6 +34,7 @@ from typing import Any, Dict, Optional, Tuple
 
 from ..common.faults import faults, jittered_delay
 from ..common.stats import stats as global_stats
+from ..common.tracing import tracer
 from . import wire
 
 _U32 = struct.Struct("<I")
@@ -115,7 +125,9 @@ class RpcServer:
 
     def _dispatch(self, raw: bytes) -> bytes:
         try:
-            service_name, method, args, kwargs = wire.decode(raw)
+            envelope = wire.decode(raw)
+            service_name, method, args, kwargs = envelope[:4]
+            tctx = envelope[4] if len(envelope) > 4 else None
             svc = self._services.get(service_name)
             if svc is None:
                 raise RpcError(f"no service {service_name!r}")
@@ -124,7 +136,16 @@ class RpcServer:
             fn = getattr(svc, method, None)
             if fn is None or not callable(fn):
                 raise RpcError(f"{service_name}.{method} not found")
-            return wire.encode((True, fn(*args, **kwargs)))
+            if tctx is None:
+                return wire.encode((True, fn(*args, **kwargs)))
+            # propagated trace context: adopt it around the handler so
+            # processor/KV spans record under the caller's trace, and
+            # hand the recorded fragment back in the response
+            rt = tracer.remote(f"{service_name}.{method}",
+                               tctx[0], tctx[1])
+            with rt:
+                result = fn(*args, **kwargs)
+            return wire.encode((True, result, rt.wire_spans))
         except Exception as e:  # noqa: BLE001 — errors cross the wire
             try:
                 return wire.encode((False, f"{type(e).__name__}: {e}"))
@@ -288,7 +309,20 @@ class RpcClient:
                                   self.RETRY_BACKOFF_CAP, paced))
 
     def call(self, method: str, *args, **kwargs) -> Any:
-        payload = wire.encode((self.service, method, tuple(args), kwargs))
+        if tracer.current_ctx() is None:
+            payload = wire.encode((self.service, method, tuple(args),
+                                   kwargs))
+            return self._call_framed(payload)
+        # traced call: one rpc.call span covering every attempt (a
+        # retry that finally succeeds still joins the remote fragment
+        # under this span — the round-trip survives reconnects)
+        with tracer.span("rpc.call", service=self.service,
+                         method=method, peer=self.addr):
+            payload = wire.encode((self.service, method, tuple(args),
+                                   kwargs, tracer.current_ctx()))
+            return self._call_framed(payload)
+
+    def _call_framed(self, payload: bytes) -> Any:
         last_err: Optional[Exception] = None
         fresh_fail = False
         paced = 0
@@ -299,7 +333,7 @@ class RpcClient:
             if last_err is not None:
                 with _rpc_stats_lock:
                     rpc_stats["reconnects"] += 1
-                global_stats.add_value("rpc.reconnects")
+                global_stats.add_value("rpc.reconnects", kind="counter")
                 # pace only FRESH-connect failures (dead peer): a
                 # stale pooled socket from a restarted-but-alive peer
                 # drains instantly, like before. The final attempt's
@@ -344,9 +378,13 @@ class RpcClient:
                 fresh_fail = False   # stale pooled socket: drain fast
                 continue
             self._pool.release(sock)
-            ok, value = wire.decode(raw)
+            resp = wire.decode(raw)
+            ok, value = resp[0], resp[1]
             if not ok:
                 raise RpcError(value)
+            if len(resp) > 2 and resp[2]:
+                # remote span fragment: join it into the live trace
+                tracer.graft(resp[2])
             return value
         raise RpcError(f"rpc to {self.addr} failed: {last_err}")
 

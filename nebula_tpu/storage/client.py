@@ -13,6 +13,7 @@ exposing the same method surface (rpc/storage_proxy).
 """
 from __future__ import annotations
 
+import contextvars
 import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
@@ -21,6 +22,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from ..common import keys as ku
 from ..common.stats import stats
 from ..common.status import ErrorCode, Status, StatusOr
+from ..common.tracing import tracer
 from ..meta.schema_manager import SchemaManager
 from .types import (BoundRequest, BoundResponse, EdgeData, EdgeKey,
                     ExecResponse, NewEdge, NewVertex, PartResult,
@@ -94,6 +96,16 @@ class StorageClient:
             by_host.setdefault(self._leader(space_id, part), {})[part] = payload
         return by_host
 
+    def _submit(self, fn, *args):
+        """Pool submit that carries the caller's trace context into the
+        worker thread (ContextVars don't cross ThreadPoolExecutor on
+        their own) — the per-host RPC spans then land in the query's
+        trace. Untraced callers pay nothing."""
+        if tracer.active():
+            return self._pool.submit(
+                contextvars.copy_context().run, fn, *args)
+        return self._pool.submit(fn, *args)
+
     def _fanout(self, space_id: int, parts: Dict[int, Any], call, empty_resp,
                 merge, max_retries: int = 3) -> Any:
         """Scatter per leader host, gather with leader-cache fixups and
@@ -108,7 +120,7 @@ class StorageClient:
             for host, host_parts in by_host.items():
                 svc = self._hosts[host]
                 futures.append((host_parts,
-                                self._pool.submit(call, svc, host_parts)))
+                                self._submit(call, svc, host_parts)))
             round_resp = empty_resp.__class__()
             dead_parts: list = []
             for host_parts, fut in futures:
@@ -408,7 +420,8 @@ class StorageClient:
                     retries_left: bool) -> None:
         from ..common.faults import jittered_delay
         self.retry_stats[cls_key] += 1
-        stats.add_value("storage_client.kv_retry." + cls_key)
+        stats.add_value("storage_client.kv_retry." + cls_key,
+                        kind="counter")
         if not retries_left:
             return   # terminal failure: no point sleeping before it
         base, cap = self.KV_BACKOFF[cls_key]
@@ -611,7 +624,7 @@ class StorageClient:
         occupying pool slots), exceptions captured per host."""
         if self._refresh_hosts is not None:
             self._refresh_hosts()  # include hosts that joined after boot
-        futs = {h: self._pool.submit(call, svc)
+        futs = {h: self._submit(call, svc)
                 for h, svc in list(self._hosts.items())}
         out: Dict[str, Any] = {}
         for host, f in futs.items():

@@ -44,6 +44,7 @@ from ..kvstore.store import GraphStore
 from ..kvstore import log_encoder as le
 from ..meta.schema_manager import SchemaManager
 from ..common.stats import stats
+from ..common.tracing import ActiveQueryRegistry, tracer
 from .types import (BoundRequest, BoundResponse, EdgeData, EdgeKey,
                     ExecResponse, NewEdge, NewVertex, PartResult,
                     PropsResponse, StatDef, StatsResponse, UpdateItemReq,
@@ -135,6 +136,9 @@ class StorageService:
         self.sm = schema_manager
         self.host = host
         self.max_edges_per_vertex = max_edges_per_vertex
+        # in-flight read processors, served by storaged's /queries (the
+        # storage-side twin of the graphd active-query registry)
+        self.active_ops = ActiveQueryRegistry()
 
     # ------------------------------------------------------------------
     # schema/row helpers
@@ -166,8 +170,20 @@ class StorageService:
     # get_bound — THE hot loop (ref: collectEdgeProps .inl:380-458)
     # ------------------------------------------------------------------
     def get_bound(self, req: BoundRequest) -> BoundResponse:
+        n_vids = sum(len(v) for v in req.parts.values())
+        tok = self.active_ops.register(
+            f"get_bound space={req.space_id} parts={len(req.parts)} "
+            f"vids={n_vids}")
+        try:
+            with tracer.span("proc.get_bound", parts=len(req.parts),
+                             vids=n_vids, host=self.host):
+                return self._get_bound(req)
+        finally:
+            self.active_ops.unregister(tok)
+
+    def _get_bound(self, req: BoundRequest) -> BoundResponse:
         t0 = time.monotonic()
-        stats.add_value("storage.get_bound_qps")
+        stats.add_value("storage.get_bound_qps", kind="counter")
         resp = BoundResponse()
         space = req.space_id
         flt = None
@@ -210,7 +226,8 @@ class StorageService:
                 resp.vertices.append(vd)
             resp.results[part] = PartResult(ErrorCode.SUCCEEDED)
         resp.latency_us = int((time.monotonic() - t0) * 1e6)
-        stats.add_value("storage.get_bound_latency_us", resp.latency_us)
+        stats.add_value("storage.get_bound_latency_us", resp.latency_us,
+                        kind="timing")
         return resp
 
     def _collect_edge_props(self, engine, space: int, part: int, vid: int,
@@ -260,6 +277,19 @@ class StorageService:
     # ------------------------------------------------------------------
     def bound_stats(self, req: BoundRequest,
                     stat_defs: List[StatDef]) -> StatsResponse:
+        n_vids = sum(len(v) for v in req.parts.values())
+        tok = self.active_ops.register(
+            f"bound_stats space={req.space_id} parts={len(req.parts)} "
+            f"vids={n_vids} defs={len(stat_defs)}")
+        try:
+            with tracer.span("proc.bound_stats", parts=len(req.parts),
+                             vids=n_vids, host=self.host):
+                return self._bound_stats(req, stat_defs)
+        finally:
+            self.active_ops.unregister(tok)
+
+    def _bound_stats(self, req: BoundRequest,
+                     stat_defs: List[StatDef]) -> StatsResponse:
         """Same scan as get_bound but emits partial aggregates instead of
         rows: per StatDef a (sum, count) pair the client merges across
         partitions — SUM/COUNT/AVG without shipping edges to graphd.
@@ -269,7 +299,7 @@ class StorageService:
         QueryBaseProcessor.inl:415-449; collectVertexProps has no filter
         hook) — tag-owner stats aggregate over every requested vertex."""
         t0 = time.monotonic()
-        stats.add_value("storage.bound_stats_qps")
+        stats.add_value("storage.bound_stats_qps", kind="counter")
         resp = StatsResponse(sums=[0.0] * len(stat_defs),
                              counts=[0] * len(stat_defs))
         space = req.space_id
@@ -659,7 +689,7 @@ class StorageService:
             if drop:
                 engine.multi_remove(drop)
                 removed += len(drop)
-        stats.add_value("storage.compact")
+        stats.add_value("storage.compact", kind="counter")
         return Status.OK(), removed
 
     def admin_flush(self, space_id: int) -> Status:
@@ -725,12 +755,23 @@ class StorageService:
 
     def scan_part_cols(self, space_id: int, part: int,
                        kind: int) -> "ScanPartResponse":
+        tok = self.active_ops.register(
+            f"scan_part_cols space={space_id} part={part} kind={kind}")
+        try:
+            with tracer.span("proc.scan_part", part=part, kind=kind,
+                             host=self.host):
+                return self._scan_part_cols(space_id, part, kind)
+        finally:
+            self.active_ops.unregister(tok)
+
+    def _scan_part_cols(self, space_id: int, part: int,
+                        kind: int) -> "ScanPartResponse":
         """Leader-local columnar scan of one (part, kind) data range.
         Same leader guard as every read (reads are leader-only, ref
         KVStore.h) so a snapshot never mixes stale follower data."""
         from .types import ScanPartResponse
         t0 = time.monotonic()
-        stats.add_value("storage.scan_part_qps")
+        stats.add_value("storage.scan_part_qps", kind="counter")
         pr = self.store.part(space_id, part)
         if not pr.ok():
             leader = pr.status.msg if \

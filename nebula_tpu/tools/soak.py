@@ -56,6 +56,46 @@ def _setup_cluster(space: str, v: int, e: int, seed: int):
     return cluster, conn, tpu, srcs, dsts
 
 
+def _debug_bundle(cluster, tpu, extra: dict,
+                  path: str = "SOAK_DEBUG_BUNDLE.json") -> str:
+    """First-class debug bundle: on any identity-check failure the soak
+    dumps the trace ring, the /queries surfaces (active statements +
+    slow-query log) and the engine's counters to one JSON artifact, so
+    a divergence on a remote box arrives with its own evidence instead
+    of a bare assertion line."""
+    import os
+    from ..common.tracing import tracer
+    path = os.environ.get("SOAK_BUNDLE_OUT", path)
+    out = {
+        "trace_ring": tracer.ring.snapshot(),
+        "queries": {
+            "active": cluster.service.active_queries.snapshot(),
+            "slow": cluster.service.slow_log.snapshot(),
+        },
+        "robustness": tpu.robustness_stats(),
+    }
+    with tpu._lock:
+        out["tpu_stats"] = dict(tpu.stats)
+    out.update(extra)
+    with open(path, "w") as f:
+        json.dump(out, f, default=str)
+    print(f"soak: debug bundle written to {path}", flush=True)
+    return path
+
+
+def _chaos_trace_check(out: dict, ring) -> None:
+    """`--chaos` pass condition: with sampling forced on, the sampled
+    traces of degraded serves must carry their degradation tags — the
+    observable promise of docs/manual/10-observability.md, proven
+    under injected faults."""
+    degraded = [t for t in ring.snapshot()
+                if "degraded" in t.get("tags", {})]
+    out["chaos_degraded_traces"] = len(degraded)
+    out["chaos_degraded_kinds"] = sorted(
+        {str(t["tags"]["degraded"]) for t in degraded})[:8]
+    out["ok"] = out["ok"] and len(degraded) > 0
+
+
 def _fault_schedule(stop, period: float = 0.8, seed: int = 7):
     """Background fault schedule for `--faults`: alternates an armed
     plan (kernel launch + delta apply + native encode failures) with
@@ -85,10 +125,45 @@ def _fault_schedule(stop, period: float = 0.8, seed: int = 7):
     return t
 
 
+def _chaos_wrap(run, chaos: bool) -> dict:
+    """Chaos mode samples EVERY query (so degraded serves provably
+    carry their degradation tags) — the forced rate is restored in a
+    finally because the soak's designed failure mode is RAISING on an
+    identity divergence, and a process-global sample rate left at 1.0
+    would poison whatever runs next in this process."""
+    if not chaos:
+        return run()
+    from ..common.flags import graph_flags
+    from ..common.tracing import TraceRing, tracer
+    rate0 = graph_flags.get("trace_sample_rate", 0.0)
+    graph_flags.set("trace_sample_rate", 1.0)
+    # a private, soak-sized ring: the production default (256) can
+    # evict the degraded-serve traces before the end-of-run check —
+    # and the process ring shouldn't be flooded by a chaos run anyway
+    ring0 = tracer.ring
+    tracer.ring = ring = TraceRing(65536)
+    try:
+        out = run()
+    finally:
+        tracer.ring = ring0
+        graph_flags.set("trace_sample_rate", rate0)
+    _chaos_trace_check(out, ring)
+    return out
+
+
 def run_soak(seconds: float = 10.0, write_ratio: float = 0.3,
              verify_every: int = 20, v: int = 2000, e: int = 10000,
-             seed: int = 7, progress=None, fault_schedule: bool = False
-             ) -> dict:
+             seed: int = 7, progress=None, fault_schedule: bool = False,
+             chaos: bool = False) -> dict:
+    return _chaos_wrap(
+        lambda: _run_soak(seconds, write_ratio, verify_every, v, e,
+                          seed, progress,
+                          fault_schedule or chaos),
+        chaos)
+
+
+def _run_soak(seconds, write_ratio, verify_every, v, e, seed, progress,
+              fault_schedule) -> dict:
     import threading
 
     import numpy as np
@@ -158,6 +233,10 @@ def run_soak(seconds: float = 10.0, write_ratio: float = 0.3,
             finally:
                 tpu.enabled = True
             if sorted(map(repr, r.rows)) != sorted(map(repr, rc.rows)):
+                _debug_bundle(cluster, tpu, {
+                    "failure": "identity_divergence", "query": q,
+                    "tpu_rows": sorted(map(repr, r.rows))[:20],
+                    "cpu_rows": sorted(map(repr, rc.rows))[:20]})
                 raise AssertionError(
                     f"IDENTITY DIVERGENCE on: {q}\n"
                     f"tpu={sorted(r.rows)[:5]}... "
@@ -212,7 +291,16 @@ def run_soak(seconds: float = 10.0, write_ratio: float = 0.3,
 def run_soak_concurrent(seconds: float = 8.0, threads: int = 6,
                         v: int = 2000, e: int = 10000,
                         seed: int = 11,
-                        fault_schedule: bool = False) -> dict:
+                        fault_schedule: bool = False,
+                        chaos: bool = False) -> dict:
+    return _chaos_wrap(
+        lambda: _run_soak_concurrent(seconds, threads, v, e, seed,
+                                     fault_schedule or chaos),
+        chaos)
+
+
+def _run_soak_concurrent(seconds, threads, v, e, seed,
+                         fault_schedule) -> dict:
     """Concurrency soak: N sessions hammer one engine through the
     cross-session dispatcher while writers mutate the graph (delta
     applies + aligned-layout invalidation racing multi-query rounds),
@@ -344,6 +432,11 @@ def run_soak_concurrent(seconds: float = 8.0, threads: int = 6,
                             f"tok={tpu._provider.version(sid)} "
                             f"stale={getattr(s0, 'stale', None)}")
                 r2 = sorted(map(repr, conn.must(q).rows))
+                _debug_bundle(cluster, tpu, {
+                    "failure": "identity_divergence", "query": q,
+                    "diag": diag,
+                    "tpu_only": sorted(set(a) - set(b))[:20],
+                    "cpu_only": sorted(set(b) - set(a))[:20]})
                 errors.append(
                     f"IDENTITY DIVERGENCE after burst: {q} "
                     f"tpu_only={sorted(set(a) - set(b))[:4]} "
@@ -424,17 +517,23 @@ def main(argv=None) -> int:
                          "encode/delta-apply injection windows) under "
                          "the soak; identity checks must stay green "
                          "and no client may see an error")
+    ap.add_argument("--chaos", action="store_true",
+                    help="--faults plus forced trace sampling: the "
+                         "soak additionally FAILS unless degraded "
+                         "serves carry their degradation tags in the "
+                         "sampled traces (trace-visibility proof)")
     args = ap.parse_args(argv)
     if args.concurrent:
         out = run_soak_concurrent(args.seconds, args.threads,
                                   args.vertices, args.edges,
-                                  fault_schedule=args.faults)
+                                  fault_schedule=args.faults,
+                                  chaos=args.chaos)
     else:
         out = run_soak(args.seconds, args.write_ratio, args.verify_every,
                        args.vertices, args.edges,
                        progress=lambda q, w: print(
                            f"  ... {q} queries, {w} writes", flush=True),
-                       fault_schedule=args.faults)
+                       fault_schedule=args.faults, chaos=args.chaos)
     print(json.dumps(out))
     return 0 if out["ok"] else 1
 

@@ -6,15 +6,24 @@ body name=value), `/get_stats?stats=metric.method.window,...` — plus
 custom handlers a daemon registers (the reference's storage admin/
 download/ingest endpoints hang off the same seam, WebService.h:31-49).
 
+Observability surface (docs/manual/10-observability.md): every daemon
+serves `/metrics` (Prometheus text exposition of the StatsManager plus
+any registered metric sources), and daemons that opt in via
+`register_observability` serve `/traces` (the finished-trace ring:
+list/filter/get-by-id, plus the ?arm=N X-Trace force knob) and
+`/queries` (active-query registry + slow-query log).
+
 Implemented over http.server (stdlib) on a daemon thread; handlers are
-plain callables `(query_params, body) -> (code, obj)`.
+plain callables `(query_params, body) -> (code, obj)`. A handler that
+returns `bytes` is served verbatim as text/plain (the Prometheus
+exposition format); anything else is JSON-encoded.
 """
 from __future__ import annotations
 
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from .common.flags import FlagRegistry
@@ -32,6 +41,7 @@ class WebService:
         self.flags = flags
         self.stats = stats
         self._handlers: Dict[str, Handler] = {}
+        self._metric_sources: List[Callable[[], Dict[str, Any]]] = []
         self._server: Optional[ThreadingHTTPServer] = None
         self._thread: Optional[threading.Thread] = None
         self._host = host
@@ -40,10 +50,18 @@ class WebService:
         self.register("/status", self._status_handler)
         self.register("/flags", self._flags_handler)
         self.register("/get_stats", self._stats_handler)
+        self.register("/metrics", self._metrics_handler)
 
     # ------------------------------------------------------------------
     def register(self, path: str, handler: Handler) -> None:
         self._handlers[path] = handler
+
+    def add_metrics_source(self, fn: Callable[[], Dict[str, Any]]) -> None:
+        """Extra /metrics gauges: `fn()` returns {name: number} — the
+        seam daemons use to expose engine counter dicts (e.g. the TPU
+        engine's serving/dispatcher/robustness counters) without
+        double-counting them into the StatsManager windows."""
+        self._metric_sources.append(fn)
 
     def start(self) -> int:
         ws = self
@@ -65,9 +83,15 @@ class WebService:
                     code, obj = h(params, body)
                 except Exception as e:   # handler bug -> 500
                     code, obj = 500, {"error": str(e)}
-                data = json.dumps(obj).encode()
+                if isinstance(obj, bytes):
+                    # raw text responses (the Prometheus exposition
+                    # format is line-oriented text, not JSON)
+                    data, ctype = obj, "text/plain; version=0.0.4"
+                else:
+                    data, ctype = json.dumps(obj).encode(), \
+                        "application/json"
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", ctype)
                 self.send_header("Content-Length", str(len(data)))
                 self.end_headers()
                 self.wfile.write(data)
@@ -138,3 +162,83 @@ class WebService:
             if v is not None:
                 out[s.strip()] = v
         return 200, out
+
+    def _metrics_handler(self, params, body) -> Tuple[int, Any]:
+        """Prometheus text exposition: StatsManager windows (# TYPE
+        annotated counters/gauges per metric kind) + every registered
+        metric source rendered as gauges with stable names."""
+        from .common.stats import _prom_name, _prom_num
+        lines: List[str] = []
+        if self.stats is not None:
+            lines.extend(self.stats.prometheus_lines())
+        for src in self._metric_sources:
+            try:
+                extra = src()
+            except Exception:
+                continue   # a broken source must not take down scrapes
+            for name in sorted(extra):
+                v = extra[name]
+                if not isinstance(v, (int, float)) or isinstance(v, bool):
+                    continue
+                mn = _prom_name("nebula", name)
+                lines.append(f"# TYPE {mn} gauge")
+                lines.append(f"{mn} {_prom_num(v)}")
+        return 200, ("\n".join(lines) + "\n").encode()
+
+    # ------------------------------------------------------------------
+    # tracing + query-visibility endpoints (opt-in per daemon)
+    # ------------------------------------------------------------------
+    def register_observability(self, ring=None, active=None,
+                               slow=None) -> None:
+        """Wire /traces and /queries. `ring` defaults to the process
+        tracer's ring; `active` is an ActiveQueryRegistry, `slow` a
+        SlowQueryLog (either may be None — the endpoint still serves
+        with the section empty)."""
+        from .common import tracing
+
+        def traces_handler(params, body) -> Tuple[int, Any]:
+            # resolve the ring per request: tracer.ring is swappable
+            # (tools/soak.py gives chaos runs a private ring) and a
+            # capture at registration time would serve a frozen deque
+            trace_ring = ring if ring is not None else \
+                tracing.tracer.ring
+            # ?arm=N — the X-Trace admin knob: force-sample the next N
+            # queries regardless of trace_sample_rate
+            if "arm" in params:
+                try:
+                    n = int(params["arm"])
+                except ValueError:
+                    return 400, {"error": "arm must be an integer"}
+                return 200, {"armed": tracing.tracer.arm(n)}
+            tid = params.get("id")
+            if tid:
+                t = trace_ring.get(tid)
+                if t is None:
+                    return 404, {"error": f"trace {tid!r} not in ring"}
+                if params.get("render"):
+                    return 200, {"trace_id": tid,
+                                 "tree": tracing.render_tree(t)}
+                return 200, t
+            try:
+                min_dur_us = int(float(params.get("min_dur_ms", 0))
+                                 * 1000)
+                limit = int(params.get("limit", 50))
+            except ValueError:
+                return 400, {"error": "min_dur_ms/limit must be numeric"}
+            return 200, {"traces": trace_ring.list(
+                min_dur_us=min_dur_us, feature=params.get("feature"),
+                limit=limit), "ring_size": len(trace_ring),
+                "armed": tracing.tracer.armed()}
+
+        def queries_handler(params, body) -> Tuple[int, Any]:
+            try:
+                limit = int(params.get("limit", 50))
+            except ValueError:
+                return 400, {"error": "limit must be an integer"}
+            return 200, {
+                "active": active.snapshot() if active is not None else [],
+                "slow": slow.snapshot(limit) if slow is not None else [],
+            }
+
+        self.register("/traces", traces_handler)
+        self.register("/queries", queries_handler)

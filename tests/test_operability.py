@@ -444,3 +444,117 @@ def test_graphd_tpu_stats_endpoint():
         assert cleared["active"] == {}, cleared
     finally:
         graphd.stop(); storaged.stop(); metad.stop()
+
+
+def test_observability_endpoints_3daemon():
+    """Acceptance (ISSUE 4): PROFILE GO over the real graphd→storaged
+    RPC boundary returns identical rows plus a span tree whose leaves
+    include a dispatcher-window span and at least one storaged-side
+    child span joined by trace_id; /traces, /queries and /metrics
+    serve on BOTH graphd and storaged."""
+    import json as _json
+    import urllib.request
+    from nebula_tpu.client import GraphClient
+    from nebula_tpu.common.tracing import tracer
+    from nebula_tpu.daemons import serve_graphd, serve_metad, serve_storaged
+    from nebula_tpu.engine_tpu import TpuGraphEngine
+
+    metad = serve_metad()
+    storaged = serve_storaged(metad.addr, load_interval=0.1, ws_port=0)
+    tpu = TpuGraphEngine()
+    graphd = serve_graphd(metad.addr, tpu_engine=tpu, ws_port=0)
+
+    def http(port, path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}") as r:
+            ctype = r.headers.get("Content-Type", "")
+            body = r.read()
+            return (body if "json" not in ctype
+                    else _json.loads(body)), r.status
+
+    try:
+        gc = GraphClient(graphd.addr).connect()
+        for s in ("CREATE SPACE obs(partition_num=2)", "USE obs",
+                  "CREATE TAG t(x int)", "CREATE EDGE e(w int)",
+                  "INSERT VERTEX t(x) VALUES 1:(5), 2:(6), 3:(7)",
+                  "INSERT EDGE e(w) VALUES 1 -> 2:(3), 2 -> 3:(4)"):
+            r = gc.execute(s)
+            assert r.ok(), (s, r.error_msg)
+        q = ("GO 2 STEPS FROM 1 OVER e YIELD e.w AS w "
+             "| YIELD $-.w AS w")
+        gc.execute(q)                 # snapshot up
+        # an INSERT right before the PROFILE makes the traced query
+        # pull the change feed / rebuild over the storage RPC — the
+        # storaged-side child spans land inside THIS trace (a warm
+        # snapshot needs zero storaged RPCs by design)
+        r = gc.execute("INSERT EDGE e(w) VALUES 3 -> 1:(9)")
+        assert r.ok(), r.error_msg
+        prof = plain = None
+        for _ in range(40):           # version-watch push is async
+            import time as _time
+            _time.sleep(0.05)
+            prof = gc.execute("PROFILE " + q)
+            assert prof.ok(), prof.error_msg
+            names = [s[2] for s in prof.trace_spans or ()]
+            if "dispatcher.window" in names and any(
+                    n.startswith(("storage.", "proc."))
+                    for n in names):
+                break
+            r = gc.execute("INSERT EDGE e(w) VALUES 3 -> 2:(8)")
+            assert r.ok(), r.error_msg
+        plain = gc.execute(q)
+        assert plain.ok() and prof.ok(), (plain.error_msg,
+                                          prof.error_msg)
+        assert sorted(plain.rows) == sorted(prof.rows)  # identical
+        assert prof.trace_id and prof.trace_spans
+        names = [s[2] for s in prof.trace_spans]
+        # the TPU-served traversal went through the dispatcher...
+        assert "dispatcher.window" in names, names
+        # ...and the trace crossed the RPC boundary: at least one
+        # storaged-side child span (the adopted storage.<method> root
+        # and/or its proc.* children), joined to the same tree
+        storaged_side = [s for s in prof.trace_spans
+                         if s[2].startswith(("storage.", "proc."))]
+        assert storaged_side, names
+        ids = {s[0] for s in prof.trace_spans}
+        assert all(s[1] in ids for s in storaged_side), \
+            "remote spans must join the local tree"
+        # /traces on graphd: summary list + get-by-id + arm knob
+        body, st = http(graphd.ws_port, "/traces")
+        assert st == 200 and any(
+            t["trace_id"] == prof.trace_id for t in body["traces"])
+        body, st = http(graphd.ws_port, f"/traces?id={prof.trace_id}")
+        assert st == 200 and len(body["spans"]) == len(prof.trace_spans)
+        body, st = http(graphd.ws_port, "/traces?arm=3")
+        assert body == {"armed": 3}
+        r = gc.execute(q)                   # armed: sampled, no attach
+        assert r.ok() and r.trace_spans is None
+        assert tracer.armed() == 2
+        http(graphd.ws_port, "/traces?arm=0")
+        # /traces on storaged: the remote fragments it recorded
+        body, st = http(storaged.ws_port, "/traces")
+        assert st == 200 and any(t.get("remote_fragment")
+                                 for t in body["traces"]), body
+        # /queries serves on both (graphd also carries the slow log)
+        body, st = http(graphd.ws_port, "/queries")
+        assert st == 200 and "active" in body and "slow" in body
+        body, st = http(storaged.ws_port, "/queries")
+        assert st == 200 and body["active"] == []
+        # /metrics: Prometheus text exposition on all three daemons
+        for port in (graphd.ws_port, storaged.ws_port, metad.ws_port):
+            if port is None:
+                continue
+            body, st = http(port, "/metrics")
+            assert st == 200 and isinstance(body, bytes)
+        text = http(graphd.ws_port, "/metrics")[0].decode()
+        assert "# TYPE nebula_graph_query_total counter" in text
+        assert "nebula_tpu_engine_go_served" in text
+        # counters don't emit meaningless percentiles; timings do
+        assert "nebula_graph_query_p95_60s" not in text
+        assert "nebula_graph_query_latency_us_p95_60s" in text
+        stext = http(storaged.ws_port, "/metrics")[0].decode()
+        # the snapshot sync hit the storage processors (get_bound only
+        # fires on the CPU fan-out path, which the engine avoided)
+        assert "nebula_storage_scan_part_qps_total" in stext
+    finally:
+        graphd.stop(); storaged.stop(); metad.stop()

@@ -1,0 +1,471 @@
+"""End-to-end query tracing (common/tracing.py +
+docs/manual/10-observability.md): span trees, head sampling, the
+PROFILE statement, trace-context propagation over the RPC envelope
+(incl. retry/reconnect), the slow/active-query surfaces, ring bounds,
+and the kind-aware StatsManager snapshot."""
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from nebula_tpu.common.flags import graph_flags
+from nebula_tpu.common.stats import StatsManager
+from nebula_tpu.common.tracing import (ActiveQueryRegistry, SlowQueryLog,
+                                       TraceRing, Tracer, render_tree,
+                                       stage_breakdown, tracer)
+
+
+# ---------------------------------------------------------------- unit
+
+def test_unsampled_spans_are_noops():
+    t = Tracer()
+    assert not t.active()
+    with t.span("anything", k=1) as sp:
+        sp.tag("x", 2)          # must not explode
+        t.tag_root("deg", "y")
+        t.add_span("kernel", 123.0)
+    assert len(t.ring) == 0
+    assert t.current_ctx() is None
+
+
+def test_trace_tree_nesting_and_render():
+    t = Tracer()
+    h = t.begin("query", force=True)
+    with t.span("parse"):
+        pass
+    with t.span("exec.go"):
+        with t.span("kernel", mode="dense"):
+            time.sleep(0.001)
+        t.add_span("encode", 500.0, rows=3)
+        t.tag("served", True)
+    t.tag_root("feature", "go")
+    trace = h.finish(ok=True)
+    assert trace is not None and len(t.ring) == 1
+    assert t.ring.get(trace["trace_id"]) == trace
+    by_name = {s["name"]: s for s in trace["spans"]}
+    assert by_name["kernel"]["parent_id"] == by_name["exec.go"]["span_id"]
+    assert by_name["encode"]["parent_id"] == by_name["exec.go"]["span_id"]
+    assert by_name["parse"]["parent_id"] == by_name["query"]["span_id"]
+    assert by_name["kernel"]["dur_us"] >= 1000
+    assert by_name["encode"]["dur_us"] == 500
+    assert by_name["exec.go"]["tags"]["served"] is True
+    assert trace["tags"]["feature"] == "go"
+    rows = render_tree(trace)
+    assert rows[0][0] == "query"
+    names = [r[0] for r in rows]
+    assert ". . kernel" in names and ". parse" in names
+    # after finish the thread is detached
+    assert not t.active()
+
+
+def test_sampling_rate_and_arm_knob():
+    t = Tracer()
+    t.sample_rate = 0.0
+    assert not t.begin("q").sampled           # null handle, no ctx set
+    assert not t.active()
+    t.sample_rate = 1.0
+    h = t.begin("q")
+    assert h.sampled
+    h.finish()
+    t.sample_rate = 0.0
+    # the X-Trace arm knob fires exactly N forced samples
+    assert t.arm(2) == 2
+    fired = []
+    for _ in range(4):
+        h2 = t.begin("q")
+        fired.append(h2.sampled)
+        h2.finish()
+    assert fired == [True, True, False, False]
+    assert not t.active()
+
+
+def test_ring_bounds_and_filters():
+    ring = TraceRing(maxlen=4)
+    for i in range(10):
+        ring.add({"trace_id": f"t{i}", "name": "query",
+                  "t0_us": i, "dur_us": i * 1000,
+                  "tags": {"feature": "go" if i % 2 else "use"},
+                  "spans": []})
+    assert len(ring) == 4                      # bounded
+    assert ring.get("t0") is None              # evicted
+    lst = ring.list()
+    assert [t["trace_id"] for t in lst] == ["t9", "t8", "t7", "t6"]
+    assert all(t["tags"]["feature"] == "go"
+               for t in ring.list(feature="go"))
+    assert [t["trace_id"] for t in ring.list(min_dur_us=9000)] == ["t9"]
+    assert len(ring.list(limit=2)) == 2
+
+
+def test_slow_log_and_active_registry():
+    slow = SlowQueryLog(maxlen=3)
+    for i in range(5):
+        slow.add(f"GO {i}", latency_us=1000 * i, session=i)
+    snap = slow.snapshot()
+    assert len(snap) == 3 and snap[0]["stmt"] == "GO 4"   # newest first
+    reg = ActiveQueryRegistry()
+    tok = reg.register("GO FROM 1", session=7, user="root")
+    time.sleep(0.002)
+    view = reg.snapshot()
+    assert len(view) == 1 and view[0]["stmt"] == "GO FROM 1"
+    assert view[0]["elapsed_ms"] > 0 and view[0]["session"] == 7
+    reg.unregister(tok)
+    assert reg.snapshot() == [] and reg.count() == 0
+
+
+def test_stage_breakdown():
+    traces = [{"spans": [
+        {"name": "kernel", "dur_us": d, "span_id": "", "parent_id": "",
+         "t0_us": 0, "tags": {}},
+        {"name": "materialize", "dur_us": d * 2, "span_id": "",
+         "parent_id": "", "t0_us": 0, "tags": {}}]}
+        for d in (100, 200, 300)]
+    out = stage_breakdown(traces)
+    assert out["kernel"]["n"] == 3 and out["kernel"]["p50_us"] == 200
+    assert out["materialize"]["p95_us"] == 600
+    assert out["dispatcher_wait"]["n"] == 0
+
+
+# ------------------------------------------------ stats kinds (satellite)
+
+def test_stats_kind_aware_snapshot_and_prometheus():
+    clock = [1000.0]
+    sm = StatsManager(clock=lambda: clock[0])
+    sm.add_value("reqs", kind="counter")
+    sm.add_value("reqs", kind="counter")
+    sm.add_value("lat_us", 100.0, kind="timing")
+    sm.add_value("lat_us", 300.0, kind="timing")
+    sm.add_value("legacy", 5.0)
+    snap = sm.snapshot()
+    # counters: no meaningless distribution methods
+    assert "reqs.sum.60" in snap and snap["reqs.sum.60"] == 2.0
+    assert "reqs.p95.60" not in snap and "reqs.avg.60" not in snap
+    # timings: distribution methods present
+    assert "lat_us.p95.60" in snap and "lat_us.avg.60" in snap
+    assert snap["lat_us.avg.60"] == 200.0
+    # untagged keeps the legacy emit-everything behavior
+    assert "legacy.p95.60" in snap and "legacy.sum.60" in snap
+    # read_stats stays spec-compatible for ANY kind
+    assert sm.read_stats("reqs.p99.60") is not None
+    assert sm.read_stats("reqs.count.60") == 2.0
+    # prometheus: counters cumulative; timings get window gauges
+    lines = sm.prometheus_lines()
+    text = "\n".join(lines)
+    assert "# TYPE nebula_reqs_total counter" in text
+    assert "nebula_reqs_total 2" in text
+    assert "nebula_reqs_p95_60s" not in text
+    assert "nebula_lat_us_p95_60s" in text
+    assert "nebula_lat_us_count_total 2" in text
+    # lifetime totals survive window expiry
+    clock[0] += 7200
+    assert "nebula_reqs_total 2" in "\n".join(sm.prometheus_lines())
+    assert sm.read_stats("reqs.sum.60") == 0.0
+
+
+# ------------------------------------------------------- RPC round-trip
+
+class _EchoSvc:
+    def ping(self, x):
+        with tracer.span("proc.work", x=x):
+            return x + 1
+
+
+def test_trace_context_rpc_roundtrip_and_reconnect():
+    """The envelope carries (trace_id, span_id); the server's remote
+    fragment grafts back under the rpc.call span — including after a
+    server restart mid-trace (retry/reconnect)."""
+    from nebula_tpu.rpc import RpcServer, proxy
+
+    server = RpcServer().register("echo", _EchoSvc()).start()
+    port = server.port
+    cli = proxy(server.addr, "echo", timeout=2.0, dedicated=True)
+    h = tracer.begin("query", force=True)
+    assert cli.ping(1) == 2
+    # restart the server on the same port: the next traced call rides
+    # the reconnect path and must still join the tree
+    server.stop()
+    server2 = RpcServer(port=port).register("echo", _EchoSvc()).start()
+    try:
+        assert cli.ping(5) == 6
+        trace = h.finish(ok=True)
+        by_name = {}
+        for s in trace["spans"]:
+            by_name.setdefault(s["name"], []).append(s)
+        assert len(by_name["rpc.call"]) == 2
+        assert len(by_name["echo.ping"]) == 2      # remote roots
+        assert len(by_name["proc.work"]) == 2      # server-side child
+        ids = {s["span_id"] for s in trace["spans"]}
+        # the remote fragments are JOINED: their roots parent under the
+        # local rpc.call spans, their children under them
+        for remote_root in by_name["echo.ping"]:
+            assert remote_root["parent_id"] in \
+                {s["span_id"] for s in by_name["rpc.call"]}
+        for child in by_name["proc.work"]:
+            assert child["parent_id"] in \
+                {s["span_id"] for s in by_name["echo.ping"]}
+        assert ids  # sanity
+    finally:
+        cli.close()
+        server2.stop()
+
+
+def test_untraced_rpc_stays_4_tuple():
+    """No trace -> classic envelope, classic 2-tuple response (zero
+    overhead and wire-compat for untraced calls)."""
+    from nebula_tpu.rpc import RpcServer, proxy
+    from nebula_tpu.rpc import wire
+
+    seen = {}
+    orig = wire.encode
+
+    server = RpcServer().register("echo", _EchoSvc()).start()
+    cli = proxy(server.addr, "echo", timeout=2.0, dedicated=True)
+    try:
+        def spy(obj):
+            if isinstance(obj, tuple) and obj and obj[0] == "echo":
+                seen["req_len"] = len(obj)
+            return orig(obj)
+
+        wire.encode = spy
+        try:
+            assert cli.ping(1) == 2
+        finally:
+            wire.encode = orig
+        assert seen["req_len"] == 4
+    finally:
+        cli.close()
+        server.stop()
+
+
+# -------------------------------------------------------- PROFILE e2e
+
+@pytest.fixture
+def small_cluster():
+    from nebula_tpu.cluster import InProcCluster
+    from nebula_tpu.engine_tpu import TpuGraphEngine
+
+    tpu = TpuGraphEngine()
+    cluster = InProcCluster(tpu_engine=tpu)
+    conn = cluster.connect()
+    for s in ("CREATE SPACE tr(partition_num=2)", "USE tr",
+              "CREATE TAG person(age int)", "CREATE EDGE knows(w int)",
+              "INSERT VERTEX person(age) VALUES 1:(5), 2:(6), 3:(7), 4:(8)",
+              "INSERT EDGE knows(w) VALUES 1 -> 2:(3), 2 -> 3:(4), "
+              "1 -> 3:(9), 3 -> 4:(1)"):
+        r = conn.execute(s)
+        assert r.ok(), (s, r.error_msg)
+    yield cluster, conn, tpu
+
+
+def test_profile_go_identity_and_span_tree(small_cluster):
+    """PROFILE GO returns the same rows as plain GO plus a span tree
+    containing the dispatcher-window span (acceptance criterion)."""
+    cluster, conn, tpu = small_cluster
+    q = "GO 2 STEPS FROM 1 OVER knows YIELD knows._dst, knows.w"
+    plain = conn.execute(q)
+    prof = conn.execute("PROFILE " + q)
+    assert plain.ok() and prof.ok()
+    assert sorted(plain.rows) == sorted(prof.rows)
+    assert plain.trace_id == "" and plain.trace_spans is None
+    assert prof.trace_id and prof.trace_spans
+    names = {s[2] for s in prof.trace_spans}
+    assert "dispatcher.window" in names, names
+    assert {"query", "parse", "exec.go", "kernel",
+            "materialize"} <= names, names
+    # device-served: the root carries the serve mode
+    root = [s for s in prof.trace_spans if s[2] == "query"][0]
+    assert root[5].get("mode") in ("sparse", "dense")
+    # the full trace is in the ring, and renders
+    t = tracer.ring.get(prof.trace_id)
+    assert t is not None
+    rows = render_tree(t)
+    assert rows[0][0] == "query" and len(rows) == len(prof.trace_spans)
+
+
+def test_profile_pipe_aggregate_identity(small_cluster):
+    cluster, conn, tpu = small_cluster
+    q = ("GO 2 STEPS FROM 1 OVER knows YIELD knows.w AS w "
+         "| YIELD COUNT(*) AS n, SUM($-.w) AS s")
+    plain = conn.execute(q)
+    prof = conn.execute("PROFILE " + q)
+    assert plain.ok() and prof.ok(), (plain.error_msg, prof.error_msg)
+    assert plain.rows == prof.rows
+    assert prof.trace_spans
+
+
+def test_profile_is_not_a_keyword(small_cluster):
+    """An identifier named `profile` still parses (PROFILE is a
+    statement prefix, not a reserved word)."""
+    cluster, conn, tpu = small_cluster
+    r = conn.execute("CREATE TAG profile(x int)")
+    assert r.ok(), r.error_msg
+    r = conn.execute("YIELD 1 AS profile")
+    assert r.ok() and r.columns == ["profile"]
+
+
+def test_sample_rate_flag_traces_plain_queries(small_cluster):
+    cluster, conn, tpu = small_cluster
+    n0 = len(tracer.ring)
+    assert graph_flags.set("trace_sample_rate", 1.0)
+    try:
+        r = conn.execute("GO FROM 1 OVER knows YIELD knows._dst")
+        assert r.ok()
+        # sampled by rate, NOT profiled: ring yes, response no
+        assert r.trace_spans is None
+        assert len(tracer.ring) > n0
+    finally:
+        graph_flags.set("trace_sample_rate", 0.0)
+    assert tracer.sample_rate == 0.0   # flag watcher applied
+
+
+def test_slow_query_log_threshold(small_cluster):
+    cluster, conn, tpu = small_cluster
+    svc = cluster.service
+    n0 = len(svc.slow_log)
+    assert graph_flags.set("slow_query_threshold_ms", 0.0001)
+    try:
+        conn.execute("GO FROM 1 OVER knows YIELD knows._dst")
+    finally:
+        graph_flags.set("slow_query_threshold_ms", 500)
+    assert len(svc.slow_log) > n0
+    entry = svc.slow_log.snapshot()[0]
+    assert "GO FROM 1" in entry["stmt"] and entry["latency_us"] > 0
+    # back at the default threshold fast queries stay out
+    n1 = len(svc.slow_log)
+    conn.execute("YIELD 1")
+    assert len(svc.slow_log) == n1
+
+
+def test_degraded_serve_is_tagged_in_trace(small_cluster):
+    """A device failure injected under a PROFILEd query degrades to
+    the CPU pipe AND tags the trace root (the --chaos contract)."""
+    from nebula_tpu.common.faults import faults
+    cluster, conn, tpu = small_cluster
+    tpu.sparse_edge_budget = 0   # pin dense: kernel.launch is on-path
+    q = "PROFILE GO 2 STEPS FROM 1 OVER knows YIELD knows._dst"
+    base = conn.execute(q)
+    assert base.ok()
+    faults.set_plan("kernel.launch:n=4")
+    try:
+        r = conn.execute(q)
+    finally:
+        faults.clear()
+    assert r.ok(), r.error_msg                  # never a client error
+    assert sorted(r.rows) == sorted(base.rows)  # CPU pipe identical
+    t = tracer.ring.get(r.trace_id)
+    assert t is not None and "degraded" in t["tags"], t["tags"]
+
+
+def test_active_queries_visible_mid_flight(small_cluster):
+    cluster, conn, tpu = small_cluster
+    svc = cluster.service
+    seen = {}
+    barrier = threading.Event()
+    orig = svc.engine.execute
+
+    def slow_execute(session, text):
+        if text.startswith("GO"):
+            seen["active"] = svc.active_queries.snapshot()
+            barrier.set()
+        return orig(session, text)
+
+    svc.engine.execute = slow_execute
+    try:
+        conn.execute("GO FROM 1 OVER knows YIELD knows._dst")
+    finally:
+        svc.engine.execute = orig
+    assert barrier.is_set()
+    assert any("GO FROM 1" in a["stmt"] for a in seen["active"])
+
+
+def test_console_renders_profile_tree(small_cluster, capsys):
+    from nebula_tpu.console import Console
+    cluster, conn, tpu = small_cluster
+    console = Console(conn)
+    assert console.run_statement(
+        "PROFILE GO FROM 1 OVER knows YIELD knows._dst")
+    out = capsys.readouterr().out
+    assert "| span" in out and "dispatcher.window" in out
+    assert "Trace " in out and "spans)" in out
+
+
+def test_profile_does_not_leak_into_shared_engine_profile(small_cluster):
+    """attach_trace must not write into the engine's shared
+    last_profile dict (one session's span tree leaking into other
+    sessions' responses)."""
+    cluster, conn, tpu = small_cluster
+    r = conn.execute("PROFILE GO FROM 1 OVER knows YIELD knows._dst")
+    assert r.ok() and r.trace_spans
+    assert "trace_spans" not in (tpu.last_profile or {})
+    assert "trace_id" not in (tpu.last_profile or {})
+    r2 = conn.execute("GO FROM 1 OVER knows YIELD knows._dst")
+    assert r2.trace_spans is None and r2.trace_id == ""
+
+
+def test_pool_retry_safe_sees_through_profile_prefix():
+    from nebula_tpu.client.pool import Session
+    assert Session._retry_safe("PROFILE GO FROM 1 OVER e")
+    assert Session._retry_safe("PROFILE\tGO FROM 1 OVER e")
+    assert not Session._retry_safe(
+        "PROFILE INSERT EDGE e(w) VALUES 1 -> 2:(1)")
+    # the prefix is only valid on the FIRST statement (parser rule)
+    assert not Session._retry_safe(
+        "GO FROM 1 OVER e; PROFILE GO FROM 1 OVER e")
+
+
+def test_traces_endpoint_follows_ring_swap():
+    """/traces must resolve tracer.ring per request — soak --chaos
+    swaps in a private ring and the endpoint must follow it back."""
+    from nebula_tpu.common.tracing import TraceRing
+    from nebula_tpu.webservice import WebService
+    ws = WebService("swap-test")
+    ws.register_observability()
+    port = ws.start()
+    try:
+        old = tracer.ring
+        tracer.ring = TraceRing(8)
+        try:
+            tracer.ring.add({"trace_id": "swapped", "name": "q",
+                             "t0_us": 0, "dur_us": 5, "tags": {},
+                             "spans": []})
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/traces?id=swapped") as r:
+                assert json.loads(r.read())["trace_id"] == "swapped"
+        finally:
+            tracer.ring = old
+    finally:
+        ws.stop()
+
+
+def test_profile_prefix_is_comment_aware(small_cluster):
+    """The text sniff must see the same first token the lexer does: a
+    leading comment before PROFILE still yields a trace."""
+    from nebula_tpu.common.tracing import split_profile_prefix
+    assert split_profile_prefix("# hi\nPROFILE GO") == (True, "GO")
+    assert split_profile_prefix("/* x */ PROFILE\nGO") == (True, "GO")
+    assert split_profile_prefix("// c\nGO FROM 1 OVER e")[0] is False
+    cluster, conn, tpu = small_cluster
+    r = conn.execute(
+        "# comment\nPROFILE GO FROM 1 OVER knows YIELD knows._dst")
+    assert r.ok(), r.error_msg
+    assert r.trace_spans, "PROFILE behind a comment must still trace"
+
+
+def test_use_none_detaches_leader_trace():
+    """Serving an UNSAMPLED request must not record spans or
+    degradation tags into the (sampled) leader's own trace."""
+    t = Tracer()
+    h = t.begin("query", force=True)
+    with t.span("exec.go"):
+        with t.use(None):          # an unsampled waiter's context
+            assert not t.active()
+            t.add_span("kernel", 100.0)
+            t.tag_root("degraded", "cpu_retry:go")
+        assert t.active()
+        with t.span("materialize"):
+            pass
+    trace = h.finish()
+    names = [s["name"] for s in trace["spans"]]
+    assert "kernel" not in names and "materialize" in names
+    assert "degraded" not in trace["tags"]
