@@ -569,6 +569,140 @@ def bench_concurrent(cluster, tpu, seed_sets, seconds=6.0, sessions=8):
     return out
 
 
+def _cache_rung_stats(cluster, tpu):
+    """One merged cache matrix: engine rungs + the graphd plan cache
+    + the storaged rungs (docs/manual/11-caching.md)."""
+    out = dict(tpu.cache_stats())
+    out["plan"] = cluster.service.engine.plan_cache.stats()
+    out["storaged_stats"] = cluster.storage.stats_cache.stats()
+    out["storaged_scan"] = cluster.storage.scan_cache.stats()
+    return out
+
+
+def bench_hot_repeat(cluster, tpu, conn, seed_sets,
+                     sessions=8, seconds=3.0):
+    """Hot-repeat tier: a REPEATED statement mix through the full
+    cache ladder (docs/manual/11-caching.md) — the tier the earlier
+    tiers deliberately avoid (their seeds are distinct so they measure
+    the serve path, not the cache). Reports cold (cache_mode=off) vs
+    cached (cache_mode=full) p50/QPS, per-rung hit rates, and a
+    concurrent full-mode closed loop in the tier-3 query shape so the
+    JSON records that concurrent QPS does not regress with caching on
+    (identical per-session statements are exactly where the result
+    rung + in-window dedupe bite)."""
+    import threading
+    from nebula_tpu.common.flags import graph_flags, storage_flags
+    hubs = [s[0] for s in seed_sets[:max(3, sessions)]]
+    cut = TS_MAX // 2
+    mix = [
+        f"GO {STEPS} STEPS FROM {hubs[0]} OVER knows "
+        f"WHERE knows.ts > {cut} YIELD knows._dst, knows.ts",
+        f"GO 2 STEPS FROM {hubs[1]} OVER knows YIELD knows._dst",
+        f"GO 2 STEPS FROM {hubs[2]} OVER knows YIELD knows.ts AS t"
+        f" | YIELD COUNT(*) AS n, SUM($-.t) AS s",
+    ]
+    reps = max(5, LAT_N // 3)
+    mode0 = graph_flags.get("cache_mode")
+    smode0 = storage_flags.get("cache_mode")
+
+    def timed_pass():
+        lats = []
+        t0 = time.time()
+        for _ in range(reps):
+            for q in mix:
+                t1 = time.time()
+                conn.must(q)
+                lats.append((time.time() - t1) * 1000)
+        wall = time.time() - t0
+        lats = np.sort(np.array(lats))
+        return (float(np.percentile(lats, 50)),
+                float(np.percentile(lats, 95)),
+                len(lats) / wall)
+
+    try:
+        graph_flags.set("cache_mode", "off")
+        storage_flags.set("cache_mode", "off")
+        for q in mix:
+            conn.must(q)                 # warm compiles off the clock
+        cold_p50, cold_p95, cold_qps = timed_pass()
+        graph_flags.set("cache_mode", "full")
+        storage_flags.set("cache_mode", "full")
+        c0 = _cache_rung_stats(cluster, tpu)
+        for q in mix:
+            conn.must(q)                 # populate pass
+        hot_p50, hot_p95, hot_qps = timed_pass()
+        c1 = _cache_rung_stats(cluster, tpu)
+        rungs = {}
+        for rung in ("result", "negative", "plan"):
+            h = c1[rung]["hits"] - c0[rung]["hits"]
+            m = c1[rung]["misses"] - c0[rung]["misses"]
+            rungs[rung] = {"hits": h, "misses": m,
+                           "hit_rate": round(h / max(h + m, 1), 3)}
+        rungs["filter_plan"] = {
+            "hits": c1["filter_plan"]["hits"] - c0["filter_plan"]["hits"],
+            "misses": (c1["filter_plan"]["misses"]
+                       - c0["filter_plan"]["misses"])}
+
+        # concurrent repeated load, cache_mode=full (tier-3 shape:
+        # every session repeats ITS one statement; sessions share the
+        # hub pool so in-window duplicates are real)
+        conns = []
+        for _ in range(sessions):
+            c = cluster.connect()
+            c.must("USE snb")
+            conns.append(c)
+        counts = [0] * sessions
+        errs = []
+        stop = threading.Event()
+
+        def worker(k):
+            q = mix[k % len(mix)]
+            while not stop.is_set():
+                try:
+                    conns[k].must(q)
+                    counts[k] += 1
+                except Exception as ex:  # noqa: BLE001 — fails the tier
+                    errs.append(repr(ex))
+                    return
+
+        d0 = tpu.stats["dedup_collapsed"]
+        threads = [threading.Thread(target=worker, args=(k,))
+                   for k in range(sessions)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        time.sleep(seconds)
+        stop.set()
+        for t in threads:
+            t.join(timeout=120)
+        wall = time.time() - t0
+        assert not errs, errs[:2]
+        conc_qps = sum(counts) / wall
+    finally:
+        graph_flags.set("cache_mode", mode0)
+        storage_flags.set("cache_mode", smode0)
+    out = {
+        "mix": len(mix), "reps": reps,
+        "cold": {"p50_ms": round(cold_p50, 2), "p95_ms": round(cold_p95, 2),
+                 "qps": round(cold_qps, 1)},
+        "cached": {"p50_ms": round(hot_p50, 2), "p95_ms": round(hot_p95, 2),
+                   "qps": round(hot_qps, 1)},
+        "speedup_p50": round(cold_p50 / max(hot_p50, 1e-6), 2),
+        "rung_hit_rates": rungs,
+        "concurrent_full": {"sessions": sessions,
+                            "qps": round(conc_qps, 1),
+                            "dedup_collapsed":
+                                tpu.stats["dedup_collapsed"] - d0},
+    }
+    log(f"hot-repeat tier: cold p50={cold_p50:.1f}ms "
+        f"{cold_qps:.0f} QPS -> cached p50={hot_p50:.2f}ms "
+        f"{hot_qps:.0f} QPS (x{out['speedup_p50']}); rung hits="
+        f"{ {k: v.get('hit_rate', v) for k, v in rungs.items()} }; "
+        f"concurrent full-mode {out['concurrent_full']['qps']} QPS "
+        f"({out['concurrent_full']['dedup_collapsed']} deduped)")
+    return out
+
+
 def bench_cpu_scan(cluster, sid, etype, seeds, label):
     """The CPU storage scatter/gather path (get_neighbors fan-out with
     frontier dedup — what GoExecutor drives), over whatever engine the
@@ -708,6 +842,7 @@ def bench_mesh_dryrun(out_path: str, n_devices: int = 4):
     n_devices = min(n_devices, len(jax.devices()))
 
     from nebula_tpu.cluster import InProcCluster
+    from nebula_tpu.common.flags import graph_flags
     from nebula_tpu.engine_tpu import TpuGraphEngine
     from nebula_tpu.engine_tpu import distributed as dist
     mesh = dist.make_mesh(jax.devices()[:n_devices])
@@ -778,6 +913,25 @@ def bench_mesh_dryrun(out_path: str, n_devices: int = 4):
     for t in threads:
         t.join()
 
+    # cache segment AFTER the meshed window sections (a full-mode
+    # result cache would absorb the repeated queries those sections
+    # need to form windows): re-run the identity sweep twice under
+    # cache_mode=full — hits must occur and rows must still match the
+    # plain CPU cluster on a MESHED engine
+    mode0 = graph_flags.get("cache_mode")
+    graph_flags.set("cache_mode", "full")
+    try:
+        h0 = tpu.result_cache.stats()["hits"]
+        for q in queries:
+            r1, r2 = tconn.must(q), tconn.must(q)
+            rc = cconn.must(q)
+            if not (sorted(map(str, r1.rows)) == sorted(map(str, r2.rows))
+                    == sorted(map(str, rc.rows))):
+                mismatches.append("cached:" + q)
+        cache_hits = tpu.result_cache.stats()["hits"] - h0
+    finally:
+        graph_flags.set("cache_mode", mode0)
+
     rec = {
         "n_devices": n_devices,
         "partitions": parts,
@@ -791,12 +945,15 @@ def bench_mesh_dryrun(out_path: str, n_devices: int = 4):
                           tpu.mesh_decline_reasons.items()},
         "sharded_queries": tpu.stats["sharded_queries"],
         "batched_dispatches": tpu.stats["batched_dispatches"],
+        "cache": tpu.cache_stats(),
+        "cache_hits_meshed": cache_hits,
     }
     with open(out_path, "w") as f:
         json.dump(rec, f, indent=1)
     log(f"mesh dryrun: {checked} identity-checked queries on a "
         f"{n_devices}-device host-emulated mesh, mesh_served="
         f"{rec['mesh_served']} -> {out_path}")
+    log(f"mesh dryrun cache matrix: {rec['cache']}")
     print(json.dumps({"metric": "mesh_dryrun", **rec}))
     ok = rec["identity_ok"] and \
         all(rec["mesh_served"].get(k, 0) > 0
@@ -830,6 +987,14 @@ def bench_chaos(out_path: str, trim: bool = False):
     seed = int(os.environ.get("BENCH_CHAOS_SEED", 7))
     sessions = 8
     v, e, per_session = (300, 2500, 6) if trim else (1500, 15000, 40)
+    # chaos runs with the FULL cache ladder armed (docs/manual/
+    # 11-caching.md): byte-identity under injected faults must hold
+    # with the result cache, in-window dedupe and negative caches all
+    # live — a stale or fault-corrupted cache entry would surface as a
+    # mismatch here
+    from nebula_tpu.common.flags import graph_flags, storage_flags
+    graph_flags.set("cache_mode", "full")
+    storage_flags.set("cache_mode", "full")
     tpu = TpuGraphEngine()
     # tight ladder so the run observes the full trip -> half-open ->
     # recover cycle in seconds (production defaults are 3 / 0.5s / 30s)
@@ -872,6 +1037,14 @@ def bench_chaos(out_path: str, trim: bool = False):
             c.must("USE chaos")
             for i in range(per_session):
                 q = queries[(k + i) % len(queries)]
+                if i % 2 == 0:
+                    # the full-mode result cache would absorb this
+                    # fixed query pool and starve the kernel-launch
+                    # fault point (no launches -> no trips -> flaky
+                    # run); alternating clears guarantee device serves
+                    # under the armed plan while the odd iterations
+                    # still exercise cached serves' byte-identity
+                    tpu.result_cache.clear()
                 r = c.must(q)
                 key = tuple(sorted(map(repr, r.rows)))
                 with olock:
@@ -906,10 +1079,16 @@ def bench_chaos(out_path: str, trim: bool = False):
         tpu.enabled = True
 
     # ---- phase 2: faults stopped — half-open probes must re-admit the
-    # device path (breaker closed + device actually serving again)
+    # device path (breaker closed + device actually serving again).
+    # The result cache is dropped per sweep: on this STATIC graph the
+    # warm cache would otherwise serve every repeat before the breaker
+    # gate (by design — an open breaker degrades to the cache, and the
+    # half-open probe rides the first MISS; here we force misses so
+    # the run proves the device itself recovers)
     recovered = False
     deadline = time.time() + 60
     while time.time() < deadline:
+        tpu.result_cache.clear()
         g0 = tpu.stats["go_served"] + tpu.stats["agg_served"]
         for q in queries:
             conn.must(q)
@@ -924,6 +1103,8 @@ def bench_chaos(out_path: str, trim: bool = False):
     rb = tpu.robustness_stats()
     rec = {
         "trim": trim,
+        "cache_mode": "full",
+        "cache": tpu.cache_stats(),
         "seed": seed,
         "sessions": sessions,
         "graph": {"V": v, "E": e},
@@ -958,7 +1139,187 @@ def bench_chaos(out_path: str, trim: bool = False):
     return rec
 
 
+def bench_cache_smoke(out_path: str):
+    """Cache smoke tier (`bench.py --cache-smoke`): tier-1-safe on
+    XLA:CPU, no accelerator / native engine. Proves on one small
+    in-proc cluster that the cache ladder (docs/manual/11-caching.md)
+
+      (a) HITS: repeated statements hit the plan + result rungs (and
+          the storaged stats/scan rungs, exercised directly),
+      (b) INVALIDATES: a write between two identical statements moves
+          the freshness token — the second result reflects the write
+          and matches the CPU pipe,
+      (c) IS BIT-IDENTICAL: every cached serve equals the same
+          statement under cache_mode=off, exactly,
+      (d) DEDUPES: identical requests inside one dispatcher window
+          collapse to one lane and fan out identical rows.
+
+    Writes one JSON artifact and exits nonzero on any failure."""
+    import threading
+    from nebula_tpu.cluster import InProcCluster
+    from nebula_tpu.common.flags import graph_flags, storage_flags
+    from nebula_tpu.engine_tpu import TpuGraphEngine
+    from nebula_tpu.storage.types import StatDef
+
+    tpu = TpuGraphEngine()
+    cluster = InProcCluster(tpu_engine=tpu)
+    conn = cluster.connect()
+    rng = np.random.default_rng(11)
+    v, e = 400, 3000
+    srcs, dsts, ts = zipf_edges(rng, v, e, clip=80)
+    insert_person_knows(conn, "cachesmoke", 4, v, srcs, dsts, ts)
+    sid = cluster.meta.get_space("cachesmoke").value().space_id
+    etype = cluster.sm.edge_type(sid, "knows")
+    tpu.prewarm(sid, block=True)
+    hubs = [int(x) for x in np.argsort(np.bincount(srcs,
+                                                   minlength=v))[-3:]]
+    queries = [
+        f"GO 2 STEPS FROM {hubs[0]} OVER knows YIELD knows._dst",
+        f"GO 2 STEPS FROM {hubs[1]} OVER knows "
+        f"WHERE knows.ts > {TS_MAX // 2} YIELD knows._dst, knows.ts",
+        f"GO 2 STEPS FROM {hubs[2]} OVER knows YIELD knows.ts AS t"
+        f" | YIELD COUNT(*) AS n, SUM($-.t) AS s, AVG($-.t) AS a",
+    ]
+    checks: dict = {}
+
+    # ---- (c) baseline: cache_mode=off, run twice (determinism too)
+    graph_flags.set("cache_mode", "off")
+    storage_flags.set("cache_mode", "off")
+    off_rows = {}
+    for q in queries:
+        r1, r2 = conn.must(q), conn.must(q)
+        checks.setdefault("off_deterministic", True)
+        if r1.rows != r2.rows:
+            checks["off_deterministic"] = False
+        off_rows[q] = r1.rows
+
+    # ---- (a) full mode: second pass must HIT, rows bit-identical
+    graph_flags.set("cache_mode", "full")
+    storage_flags.set("cache_mode", "full")
+    h0 = tpu.result_cache.stats()["hits"]
+    p0 = cluster.service.engine.plan_cache.stats()["hits"]
+    full_rows = {}
+    for q in queries:
+        conn.must(q)                       # populate
+        full_rows[q] = conn.must(q).rows   # must hit
+    checks["result_hits"] = tpu.result_cache.stats()["hits"] - h0
+    checks["plan_hits"] = cluster.service.engine.plan_cache.stats()[
+        "hits"] - p0
+    checks["hits_occurred"] = (checks["result_hits"] >= len(queries)
+                               and checks["plan_hits"] > 0)
+    checks["bit_identical_vs_off"] = all(
+        full_rows[q] == off_rows[q] for q in queries)
+
+    # ---- (b) invalidation on write: the token moves, the second
+    # identical statement reflects the write and matches the CPU pipe
+    qw = f"GO FROM {hubs[0]} OVER knows YIELD knows._dst"
+    before = conn.must(qw).rows
+    conn.must(qw)                          # cached
+    conn.must("INSERT VERTEX person(age) VALUES 999777:(1)")
+    conn.must(f"INSERT EDGE knows(ts) VALUES {hubs[0]} -> 999777:(1)")
+    after = conn.must(qw).rows
+    tpu.enabled = False
+    try:
+        cpu_after = conn.must(qw).rows
+    finally:
+        tpu.enabled = True
+    checks["write_invalidates"] = (
+        (999777,) in after and (999777,) not in before
+        and sorted(map(repr, after)) == sorted(map(repr, cpu_after)))
+
+    # ---- (d) in-window dedupe: pace the dispatcher so concurrent
+    # identical statements pile into one window, then collapse
+    orig = tpu._serve_batch
+
+    def paced(batch, ex):
+        time.sleep(0.05)
+        orig(batch, ex)
+
+    qd = f"GO 2 STEPS FROM {hubs[1]} OVER knows YIELD knows._dst"
+    dedup_rows: list = []
+    derrs: list = []
+
+    def worker():
+        try:
+            c = cluster.connect()
+            c.must("USE cachesmoke")
+            dedup_rows.append(sorted(map(repr, c.must(qd).rows)))
+        except Exception as ex:  # noqa: BLE001 — recorded, fails run
+            derrs.append(repr(ex))
+
+    tpu._serve_batch = paced
+    try:
+        for _ in range(5):                 # scheduling is not ours to
+            d0 = tpu.stats["dedup_collapsed"]   # command: retry a few
+            dedup_rows.clear()
+            # drop any cached result for qd so every attempt reaches
+            # the dispatcher (a hit would bypass the window entirely)
+            tpu.result_cache.clear()
+            threads = [threading.Thread(target=worker)
+                       for _ in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            if tpu.stats["dedup_collapsed"] > d0:
+                break
+    finally:
+        tpu._serve_batch = orig
+    ref = sorted(map(repr, off_rows[queries[0]])) \
+        if qd == queries[0] else sorted(map(repr, conn.must(qd).rows))
+    checks["dedup_collapsed"] = tpu.stats["dedup_collapsed"]
+    checks["dedup_fanout_identical"] = (not derrs and len(dedup_rows)
+                                        and all(r == ref
+                                                for r in dedup_rows))
+    checks["dedup_occurred"] = tpu.stats["dedup_collapsed"] > 0
+
+    # ---- storaged rungs, exercised directly: bound_stats + scan
+    defs = [StatDef("edge", etype, "ts", 1),
+            StatDef("edge", etype, "", 2)]
+    s1 = cluster.client.bound_stats(sid, hubs, [etype], defs)
+    s2 = cluster.client.bound_stats(sid, hubs, [etype], defs)
+    checks["stats_cache_hits"] = cluster.storage.stats_cache.stats()[
+        "hits"]
+    checks["stats_cache_identical"] = (s1.sums == s2.sums
+                                       and s1.counts == s2.counts)
+    parts = sorted(cluster.store.parts(sid))
+    cluster.storage.scan_part_cols(sid, parts[0], 2)
+    r_scan = cluster.storage.scan_part_cols(sid, parts[0], 2)
+    checks["scan_cache_hits"] = cluster.storage.scan_cache.stats()[
+        "hits"]
+    checks["storaged_hits_occurred"] = (checks["stats_cache_hits"] > 0
+                                        and checks["scan_cache_hits"] > 0
+                                        and r_scan.n > 0)
+
+    rec = {"graph": {"V": v, "E": e}, "checks": checks,
+           "cache": tpu.cache_stats(),
+           "plan_cache": cluster.service.engine.plan_cache.stats(),
+           "storaged": {
+               "stats_cache": cluster.storage.stats_cache.stats(),
+               "scan_cache": cluster.storage.scan_cache.stats()}}
+    ok = all(checks[k] for k in
+             ("off_deterministic", "hits_occurred",
+              "bit_identical_vs_off", "write_invalidates",
+              "dedup_occurred", "dedup_fanout_identical",
+              "stats_cache_identical", "storaged_hits_occurred"))
+    rec["ok"] = ok
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=1)
+    log(f"cache smoke: checks={checks} -> {out_path}")
+    print(json.dumps({"metric": "cache_smoke", "ok": ok, **checks}))
+    if not ok:
+        raise SystemExit(f"cache smoke FAILED: {rec}")
+    return rec
+
+
 def main():
+    if "--cache-smoke" in sys.argv:
+        out = os.environ.get("BENCH_CACHE_OUT", "CACHE_smoke.json")
+        for a in sys.argv:
+            if a.startswith("--out="):
+                out = a.split("=", 1)[1]
+        bench_cache_smoke(out)
+        return
     if "--chaos" in sys.argv:
         out = os.environ.get("BENCH_CHAOS_OUT", "CHAOS_bench.json")
         for a in sys.argv:
@@ -994,6 +1355,12 @@ def main():
         tier3 = bench_concurrent(cluster, tpu, seed_sets)
     finally:
         tpu.sparse_edge_budget = saved_budget
+    # hot-repeat tier (docs/manual/11-caching.md): repeated statement
+    # mix, cold vs cached + per-rung hit rates + concurrent full-mode
+    # QPS; runs AFTER the serve-path tiers so their numbers stay
+    # cache-free (the default cache_mode=plan never caches results)
+    hot_repeat = bench_hot_repeat(cluster, tpu, conn, seed_sets)
+    tier3["cache"] = _cache_rung_stats(cluster, tpu)
     # CPU baselines measure a RATE — a seed subset keeps the python
     # materialization of the scan bounded at SNB scale
     cpu_seeds = seed_sets[0][:8]
@@ -1031,6 +1398,7 @@ def main():
         "sparse_budget_calibration": cal,
         "stats_query": stats_extra,
         "tier3_concurrent": tier3,
+        "hot_repeat": hot_repeat,
     }))
 
 

@@ -1,0 +1,183 @@
+"""Snapshot-versioned multi-level cache rungs across the serve path.
+
+The serve path pays parse + filter planning + dispatcher wait + device
+kernel + materialize + encode for EVERY statement, even when the text
+is identical and the snapshot version has not moved. The rungs here
+make repetition cheap while keeping correctness STRUCTURAL, not
+probabilistic: every rung's key embeds the version token that governs
+its inputs, so a stale entry is simply unreachable — there is no TTL
+and no heuristic invalidation on the read path (the reference leans on
+the same discipline: MetaClient's cached topology pull is keyed by the
+pulled version, RocksDB's block cache by immutable block identity).
+
+Rungs (docs/manual/11-caching.md):
+
+  plan        graphd statement text -> parsed AST (graph/engine.py);
+              PROFILE-prefix-aware via split_profile_prefix so
+              `PROFILE GO ...` and `GO ...` share one entry
+  filter_plan per-snapshot compiled WHERE plans, keyed by
+              (write_version, filter bytes, edge types, aliases)
+              (engine_tpu/engine.py:_plan_filter)
+  result      encoded device results keyed by (space, snapshot
+              write_version token, catalog version, statement shape)
+              + in-window request dedupe in the dispatcher + negative
+              caching of structural decline decisions
+  storaged    bound-stats responses and (part, version) columnar scan
+              blobs server-side (storage/processors.py)
+
+`cache_mode` (a MUTABLE flag on both graph_flags and storage_flags)
+ladders the rungs for bisection:
+
+  off   no caching anywhere — the pre-cache serve path, bit-identical
+  plan  plan + filter_plan rungs only (pure wins: no observable
+        semantics change beyond latency) — the DEFAULT
+  full  everything: result cache, in-window dedupe, negative caches,
+        storaged stats/scan caches
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Callable, Dict, Hashable, Optional
+
+from .stats import stats as global_stats
+
+MODE_OFF = "off"
+MODE_PLAN = "plan"
+MODE_FULL = "full"
+_MODES = (MODE_OFF, MODE_PLAN, MODE_FULL)
+
+
+def mode_of(flags) -> str:
+    """Resolve the registry's cache_mode to one of off|plan|full
+    (unknown values fall back to the safe default, plan)."""
+    v = str(flags.get("cache_mode", MODE_PLAN)).strip().lower()
+    return v if v in _MODES else MODE_PLAN
+
+
+def plan_stage_enabled(flags) -> bool:
+    return mode_of(flags) != MODE_OFF
+
+
+def result_stage_enabled(flags) -> bool:
+    return mode_of(flags) == MODE_FULL
+
+
+class CacheRung:
+    """One bounded LRU rung with the hit/miss/evict/invalidate counter
+    quartet every rung must expose (/tpu_stats, StatsManager counter
+    kinds -> Prometheus /metrics). Values must be treated as immutable
+    by callers — hand out copies of anything a caller might mutate.
+
+    `stats_prefix` mirrors the counters into the global StatsManager
+    as `<prefix>.hit` / `.miss` / `.evict` / `.invalidate` counters.
+    `weigher` + `byte_cap` add a byte budget on top of the entry cap
+    (the storaged scan rung holds whole columnar part scans);
+    `byte_cap` may be a CALLABLE, resolved per store, so a MUTABLE
+    flag like scan_cache_mb keeps working after construction."""
+
+    _MISS = object()
+
+    def __init__(self, name: str, capacity: int = 256,
+                 stats_prefix: Optional[str] = None,
+                 weigher: Optional[Callable[[Any], int]] = None,
+                 byte_cap=None):
+        if capacity <= 0:
+            raise ValueError("capacity must be positive")
+        self.name = name
+        self._cap = capacity
+        self._weigher = weigher
+        self._byte_cap = byte_cap
+        self._bytes = 0
+        self._map: "OrderedDict[Hashable, Any]" = OrderedDict()
+        self._lock = threading.Lock()
+        self._prefix = stats_prefix
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.invalidations = 0
+        self.stores = 0
+
+    # ------------------------------------------------------------------
+    def _count(self, event: str, n: int = 1) -> None:
+        if self._prefix is not None and n:
+            global_stats.add_value(f"{self._prefix}.{event}", n,
+                                   kind="counter")
+
+    def _cap_bytes(self) -> Optional[int]:
+        c = self._byte_cap
+        return c() if callable(c) else c
+
+    def get(self, key: Hashable, default: Any = None) -> Any:
+        with self._lock:
+            v = self._map.get(key, self._MISS)
+            if v is self._MISS:
+                self.misses += 1
+                miss = True
+            else:
+                self._map.move_to_end(key)
+                self.hits += 1
+                miss = False
+        self._count("miss" if miss else "hit")
+        return default if miss else v
+
+    def put(self, key: Hashable, value: Any) -> None:
+        w = self._weigher(value) if self._weigher is not None else 0
+        cap_b = self._cap_bytes()
+        if cap_b is not None and w > cap_b:
+            return    # one oversized entry must not wipe the rung
+        evicted = 0
+        with self._lock:
+            old = self._map.pop(key, self._MISS)
+            if old is not self._MISS and self._weigher is not None:
+                self._bytes -= self._weigher(old)
+            self._map[key] = value
+            self._bytes += w
+            self.stores += 1
+            while len(self._map) > self._cap or (
+                    cap_b is not None and self._bytes > cap_b
+                    and len(self._map) > 1):
+                _, ev = self._map.popitem(last=False)
+                if self._weigher is not None:
+                    self._bytes -= self._weigher(ev)
+                self.evictions += 1
+                evicted += 1
+        self._count("evict", evicted)
+
+    def invalidate_where(self, pred: Callable[[Hashable], bool]) -> int:
+        """Drop every entry whose KEY matches; returns the count.
+        Poison/purge hygiene — version-keyed entries are already
+        unreachable once their token moves, this frees the memory and
+        makes the purge observable."""
+        with self._lock:
+            dead = [k for k in self._map if pred(k)]
+            for k in dead:
+                v = self._map.pop(k)
+                if self._weigher is not None:
+                    self._bytes -= self._weigher(v)
+            self.invalidations += len(dead)
+        self._count("invalidate", len(dead))
+        return len(dead)
+
+    def clear(self) -> int:
+        with self._lock:
+            n = len(self._map)
+            self._map.clear()
+            self._bytes = 0
+            self.invalidations += n
+        self._count("invalidate", n)
+        return n
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._map)
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            out = {"entries": len(self._map), "hits": self.hits,
+                   "misses": self.misses, "evictions": self.evictions,
+                   "invalidations": self.invalidations,
+                   "stores": self.stores}
+            if self._byte_cap is not None:
+                out["bytes"] = self._bytes
+            return out

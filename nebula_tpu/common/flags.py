@@ -153,6 +153,24 @@ graph_flags.declare("tpu_query_deadline_ms", 60000, MUTABLE,
                     "+ kernel + materialize); past it the device path "
                     "yields to the CPU pipe and deadline_exceeded is "
                     "counted in /tpu_stats. 0 disables.")
+graph_flags.declare("cache_mode", "plan", MUTABLE,
+                    "serve-path cache ladder (common/cache.py; docs/"
+                    "manual/11-caching.md): off = no caching, plan = "
+                    "statement plan + compiled-filter-plan rungs "
+                    "(default; no observable semantics change), full = "
+                    "plan + snapshot-versioned device result cache + "
+                    "in-window request dedupe + negative decline "
+                    "caches")
+storage_flags.declare("cache_mode", "plan", MUTABLE,
+                      "storaged cache ladder: full enables the "
+                      "bound-stats response cache and the (part, "
+                      "version) columnar scan cache; off/plan disable "
+                      "both (docs/manual/11-caching.md)")
+storage_flags.declare("scan_cache_mb", 256, MUTABLE,
+                      "byte budget for the storaged (part, version) "
+                      "columnar scan cache — whole part scans are "
+                      "large, so the rung is byte-capped, not just "
+                      "entry-capped")
 storage_flags.declare("download_dir", "/tmp/nebula_tpu_staging", REBOOT,
                       "staging dir for DOWNLOAD-ed bulk-load SST files")
 storage_flags.declare("snapshot_dir", "/tmp/nebula_tpu_snapshots", REBOOT,

@@ -189,6 +189,15 @@ def serve_graphd(meta_addr: str, host: str = "127.0.0.1", port: int = 0,
                         "encode_fallback_rows":
                             st.get("encode_fallback_rows", 0),
                     },
+                    # cache ladder (docs/manual/11-caching.md): the
+                    # live cache_mode plus per-rung hit/miss/evict/
+                    # invalidate counters — plan (statement -> AST),
+                    # filter_plan (per-snapshot compiled WHERE),
+                    # result + negative + in-window dedupe
+                    "cache": {
+                        **tpu_engine.cache_stats(),
+                        "plan": engine.plan_cache.stats(),
+                    },
                     "sparse_budget_calibrations": {
                         str(k): v for k, v in
                         tpu_engine.sparse_budget_calibrations.items()},
@@ -227,6 +236,16 @@ def serve_graphd(meta_addr: str, host: str = "127.0.0.1", port: int = 0,
                     out[f"tpu_engine.agg_declined.{k}"] = v
                 for k, v in path_decl.items():
                     out[f"tpu_engine.path_declined.{k}"] = v
+                # cache rungs as flat gauges (the per-event counters
+                # additionally stream through the StatsManager with
+                # kind="counter" — see common/cache.py stats_prefix)
+                for rung, st in tpu_engine.cache_stats().items():
+                    if not isinstance(st, dict):
+                        continue
+                    for k, v in st.items():
+                        out[f"tpu_engine.cache.{rung}.{k}"] = v
+                for k, v in engine.plan_cache.stats().items():
+                    out[f"graph.plan_cache.{k}"] = v
                 return out
 
             web.add_metrics_source(tpu_metric_source)

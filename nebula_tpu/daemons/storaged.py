@@ -258,6 +258,20 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
         # /queries its in-flight processor ops, /metrics the built-in
         # Prometheus exposition (docs/manual/10-observability.md)
         web.register_observability(active=storage.active_ops)
+
+        def cache_metric_source():
+            # storaged cache rungs as flat gauges (bound_stats
+            # responses + (part, version) columnar scans; docs/manual/
+            # 11-caching.md) — per-event counters additionally stream
+            # through the StatsManager (common/cache.py stats_prefix)
+            out = {}
+            for rung, st in (("stats_cache", storage.stats_cache),
+                             ("scan_cache", storage.scan_cache)):
+                for k, v in st.stats().items():
+                    out[f"storage.{rung}.{k}"] = v
+            return out
+
+        web.add_metrics_source(cache_metric_source)
         web.start()
         wc_state["web"] = web
         if wc_state["fired"]:   # wrong-cluster fired before web existed

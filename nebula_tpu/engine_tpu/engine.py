@@ -37,12 +37,15 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..common.cache import (CacheRung, plan_stage_enabled,
+                            result_stage_enabled)
 from ..common.faults import CircuitBreaker, faults
 from ..common.flags import graph_flags
 from ..common.stats import stats as global_stats
 from ..common.tracing import tracer as _tr
 from ..common.status import Status, StatusOr
-from ..filter.expressions import (Expression, InputPropExpr, VariablePropExpr)
+from ..filter.expressions import (Expression, InputPropExpr,
+                                  VariablePropExpr, encode_expression)
 from ..parser import ast
 from ..storage.types import BoundResponse, EdgeData, PartResult, VertexData
 from . import materialize, traverse
@@ -66,13 +69,21 @@ class _GoReq:
     leader drained this request into its window — the owner waits for
     `done` instead of trying to lead. A device failure never carries
     an error back: `result` stays None and the owner re-serves on the
-    CPU pipe (docs/manual/9-robustness.md)."""
+    CPU pipe (docs/manual/9-robustness.md). `dkey` is the statement's
+    version-free identity for in-window dedupe (cache_mode=full):
+    identical same-key requests inside one window collapse to a single
+    lane and fan the rows out to every waiter; None = never deduped.
+    `followers` (set by the window leader) are the collapsed twins —
+    _mark_done clones this request's result into them BEFORE flipping
+    its own `done`, the only point where the owner provably isn't yet
+    finalizing/mutating the shared result."""
     __slots__ = ("ctx", "s", "starts", "edge_types", "alias_map",
                  "name_by_type", "key", "yield_cols", "result",
-                 "done", "claimed", "t_enq", "tctx")
+                 "done", "claimed", "t_enq", "tctx", "dkey",
+                 "followers")
 
     def __init__(self, ctx, s, starts, edge_types, alias_map,
-                 name_by_type, key, yield_cols):
+                 name_by_type, key, yield_cols, dkey=None):
         self.ctx = ctx
         self.s = s
         self.starts = starts
@@ -85,6 +96,8 @@ class _GoReq:
         self.done = False
         self.claimed = False
         self.t_enq = 0.0
+        self.dkey = dkey
+        self.followers: Optional[List["_GoReq"]] = None
         # the owner's trace context (None unsampled): whoever serves
         # this request — its own thread or a group leader — records
         # spans into the OWNER's trace via tracer.use (tracing.py)
@@ -192,7 +205,12 @@ class TpuGraphEngine:
                       # demotions
                       "breaker_trips": 0, "breaker_recoveries": 0,
                       "degraded_serves": 0, "deadline_exceeded": 0,
-                      "snapshot_poisoned": 0, "mesh_demotions": 0}
+                      "snapshot_poisoned": 0, "mesh_demotions": 0,
+                      # in-window request dedupe (cache_mode=full;
+                      # docs/manual/11-caching.md): requests that rode
+                      # a twin's lane instead of their own, and windows
+                      # where at least one collapse happened
+                      "dedup_collapsed": 0, "dedup_rounds": 0}
         # mesh execution service (mesh_exec.py): device-served queries
         # on SHARDED snapshots, per feature — the decline matrix the
         # round-5 verdict flagged (batched windows / aggregation / ALL
@@ -246,6 +264,42 @@ class TpuGraphEngine:
         self.last_profile: Optional[Dict[str, Any]] = None
         self.profile_seq = 0
         self._tracing = False
+        # snapshot-versioned cache rungs (common/cache.py; docs/manual/
+        # 11-caching.md; cache_mode=full). Result keys embed the
+        # provider's freshness token + the catalog version, so a write
+        # or schema change makes old entries structurally unreachable —
+        # and a cache hit is served BEFORE the breaker gate (an open
+        # breaker degrades to a warm cache, not straight to the CPU
+        # pipe). Negative rung: structural decline decisions (agg
+        # pre-checks / path routing) keyed by catalog version.
+        self.result_cache = CacheRung(
+            "tpu_engine.cache.result", 512,
+            stats_prefix="tpu_engine.cache.result")
+        self.negative_cache = CacheRung(
+            "tpu_engine.cache.negative", 256,
+            stats_prefix="tpu_engine.cache.negative")
+        # per-snapshot compiled-filter-plan rung counters (the plans
+        # themselves live on each snapshot — see _plan_filter); bumped
+        # under the engine lock, every _plan_filter caller holds it
+        self.filter_plan_counters = {"hits": 0, "misses": 0,
+                                     "evictions": 0, "invalidations": 0}
+
+    # results bigger than this never enter the result cache (a handful
+    # of supernode answers must not evict the whole working set)
+    RESULT_CACHE_MAX_ROWS = 100_000
+
+    def cache_stats(self) -> Dict[str, Any]:
+        """The /tpu_stats "cache" block: per-rung counters + the live
+        cache_mode (docs/manual/11-caching.md)."""
+        from ..common.cache import mode_of
+        with self._stats_lock:
+            dedupe = {"collapsed": self.stats["dedup_collapsed"],
+                      "rounds": self.stats["dedup_rounds"]}
+        return {"mode": mode_of(graph_flags),
+                "result": self.result_cache.stats(),
+                "negative": self.negative_cache.stats(),
+                "filter_plan": dict(self.filter_plan_counters),
+                "dedupe": dedupe}
 
     @property
     def sparse_edge_budget(self) -> int:
@@ -577,6 +631,7 @@ class TpuGraphEngine:
                 first = snap.space_id not in self._mesh_demoted
                 self._mesh_demoted.add(snap.space_id)
                 snap.stale = True
+            self._purge_space_cache(snap.space_id)   # demotion poison
             if first:
                 with self._stats_lock:
                     self.stats["mesh_demotions"] += 1
@@ -867,9 +922,17 @@ class TpuGraphEngine:
             snap.stale = True
             self.stats["snapshot_poisoned"] += 1
             global_stats.add_value("tpu_engine.snapshot_poisoned", kind="counter")
+            # poison hygiene: drop the space's cached results/declines
+            # alongside the snapshot (entries are already version-
+            # orphaned; this frees them and counts the purge)
+            self._purge_space_cache(space_id)
             self._kick_repack(space_id)
             return None
         return self.refresh(space_id)
+
+    # compiled-filter plans kept per snapshot (bounded dict, LRU-ish by
+    # insertion since the working set is a handful of WHERE shapes)
+    FILTER_PLAN_CAP = 64
 
     def _plan_filter(self, ctx, s, snap, use_delta, name_by_type,
                      alias_map, edge_types):
@@ -877,17 +940,64 @@ class TpuGraphEngine:
         device compile; fall back to host evaluation. With delta edges
         in play a compiled mask would cover only canonical edges —
         evaluate on the host for ALL rows so both row sources stay
-        consistent."""
+        consistent.
+
+        Compiled plans are cached ON THE SNAPSHOT keyed by
+        (write_version, filter bytes, edge types, aliases) — the
+        per-snapshot rung of docs/manual/11-caching.md. This is the
+        hoisted form of the old per-window `filter_cache` in
+        _serve_group: a WHERE shape compiled for window N is reused by
+        window N+1 (and by the single-query path) until a delta apply
+        bumps write_version — prop patches mutate the host mirrors the
+        compiler read, so the version is the correctness boundary.
+        Declined compiles are cached too (the decline is deterministic
+        per key). Every caller holds the engine lock (the compiler
+        reads delta-mutable mirrors), so the per-snapshot dict and the
+        engine-level counters need no extra lock."""
         if s.where is None:
             return None, None
         if use_delta:
             return None, s.where.filter
+        key = None
+        cache = None
+        if plan_stage_enabled(graph_flags):
+            try:
+                key = (snap.write_version,
+                       encode_expression(s.where.filter),
+                       tuple(edge_types),
+                       tuple(sorted(alias_map.items())))
+            except Exception:
+                key = None
+            if key is not None:
+                cache = getattr(snap, "_filter_plans", None)
+                if cache is None:
+                    cache = snap._filter_plans = {}
+                plan = cache.get(key)
+                if plan is not None:
+                    self.filter_plan_counters["hits"] += 1
+                    global_stats.add_value(
+                        "tpu_engine.cache.filter_plan.hit",
+                        kind="counter")
+                    return plan
+                self.filter_plan_counters["misses"] += 1
         fc = FilterCompiler(snap, self._sm, ctx.space_id(), name_by_type,
                             alias_map, edge_types)
         device_mask = fc.compile(s.where.filter)
-        if device_mask is None:
-            return None, s.where.filter
-        return device_mask, None
+        plan = (None, s.where.filter) if device_mask is None \
+            else (device_mask, None)
+        if key is not None and cache is not None:
+            # entries keyed to a superseded write_version are dead the
+            # moment the version moved — drop them (counted) before the
+            # cap check so stale plans never crowd out live ones
+            stale = [k for k in cache if k[0] != snap.write_version]
+            for k in stale:
+                del cache[k]
+            self.filter_plan_counters["invalidations"] += len(stale)
+            while len(cache) >= self.FILTER_PLAN_CAP:
+                cache.pop(next(iter(cache)))
+                self.filter_plan_counters["evictions"] += 1
+            cache[key] = plan
+        return plan
 
     @staticmethod
     def _token_compatible(snap, token) -> bool:
@@ -1035,6 +1145,13 @@ class TpuGraphEngine:
             # (mesh_exec.multi_hop_steps_sharded) with the same
             # host-side enumeration; only the bounded-steps form runs
             # on device either way.
+            #
+            # Deliberately NOT negative-cached: this verdict is one
+            # integer range check against a class constant — a locked
+            # LRU probe plus a streamed counter costs strictly more
+            # than the check it would skip. The negative rung carries
+            # the verdicts that DO skip real work (the aggregation
+            # pre-check's per-spec schema walk).
             if not 1 <= int(s.step.steps) <= self.MAX_DEVICE_STEPS:
                 return self._path_decline("all_paths_steps_out_of_range")
         return True
@@ -1063,22 +1180,161 @@ class TpuGraphEngine:
         Ladder wrapper: an open "go" breaker declines straight to the
         CPU pipe, and any device-path exception is converted to a CPU
         retry (counted + fed to the breaker) — a client never sees a
-        device-infrastructure error (docs/manual/9-robustness.md)."""
+        device-infrastructure error (docs/manual/9-robustness.md).
+
+        Result-cache rung (cache_mode=full): a plain-form GO whose
+        (statement shape, starts, snapshot token, catalog version) key
+        hits serves from the cache BEFORE the breaker gate — a tripped
+        device degrades to a warm cache, not straight to the CPU pipe.
+        Keys embed the freshness token, so staleness is structural:
+        any committed write moves the token and orphans old entries."""
+        ck, yield_cols = self._go_cache_key(ctx, s, starts, edge_types,
+                                            alias_map, name_by_type)
+        if ck is not None:
+            hit = self._result_cache_get(ck)
+            if hit is not None:
+                return hit
         if not self._device_admit("go", ctx):
             return None
         try:
             r = self._execute_go_routed(ctx, s, starts, edge_types,
-                                        alias_map, name_by_type)
+                                        alias_map, name_by_type,
+                                        dkey=None if ck is None
+                                        else ck[:3] + ck[5:],
+                                        yield_cols=yield_cols)
         except Exception as e:
             return self._device_failed("go", e)
         if r is not None:
             self._device_ok("go")
+            if ck is not None:
+                self._result_cache_put(ck, r)
         return r
+
+    # ------------------------------------------------------------------
+    # device result cache (rung 2 of docs/manual/11-caching.md)
+    # ------------------------------------------------------------------
+    def _go_cache_key(self, ctx, s, starts, edge_types, alias_map,
+                      name_by_type):
+        """-> (key, yield_cols): the result-cache key for a plain-form
+        GO (None when the rung is off or the statement shape is
+        uncacheable — UPTO / input refs depend on per-session state)
+        plus the resolved yield columns so the serve path downstream
+        reuses them instead of re-deriving. Key layout: (kind, space,
+        steps, token, catalog, etypes, starts, aliases, where bytes,
+        yield bytes, distinct) — space at [1] anchors per-space
+        purges; token/catalog at [3]/[4] so the version-free dedupe
+        identity is ck[:3] + ck[5:]."""
+        if not result_stage_enabled(graph_flags) or \
+                self._provider is None or not self.enabled:
+            return None, None
+        from ..graph import executors as ex
+        yield_cols = None
+        try:
+            yield_cols = ex._go_yield_columns(s, ctx, name_by_type)
+            exprs = [c.expr for c in yield_cols]
+            if s.where is not None:
+                exprs.append(s.where.filter)
+            if s.step.upto or _uses_input_refs(exprs):
+                return None, yield_cols
+            space = ctx.space_id()
+            token = self._provider.version(space)
+            if token is None:
+                return None, yield_cols
+            where_enc = encode_expression(s.where.filter) \
+                if s.where is not None else None
+            yenc = tuple((c.name(), encode_expression(c.expr))
+                         for c in yield_cols)
+        except Exception:
+            # unkeyable statements simply skip the rung
+            return None, yield_cols
+        return (("go", space, int(s.step.steps), token,
+                 self._catalog_version(), tuple(edge_types),
+                 tuple(starts), tuple(sorted(alias_map.items())),
+                 where_enc, yenc,
+                 bool(s.yield_ and s.yield_.distinct)), yield_cols)
+
+    def _result_cache_get(self, ck):
+        v = self.result_cache.get(ck)
+        if v is None:
+            return None
+        cols, rows = v
+        from ..graph.interim import InterimResult
+        _tr.tag_root("cache_hit", "result")
+        return StatusOr.of(InterimResult(list(cols), list(rows)))
+
+    def _result_cache_put(self, ck, r) -> None:
+        """Store one finalized device result — ONLY when the space's
+        freshness token still equals the key's token: a delta apply
+        landing mid-serve (the snapshot-version redo check re-served
+        the request) moves the token, and publishing the pre-write
+        rows under the pre-write key would hand a later same-token
+        reader a result the redo already superseded. Rows are stored
+        as an immutable tuple; hits box a fresh InterimResult, so a
+        downstream ORDER BY/LIMIT can never mutate the cached copy."""
+        try:
+            if not r.ok():
+                return
+        except AttributeError:
+            return
+        v = r.value()
+        rows = getattr(v, "rows", None)
+        if rows is None or len(rows) > self.RESULT_CACHE_MAX_ROWS:
+            return
+        if getattr(v, "_tpu_deferred", None) is not None:
+            return    # not boxed yet (defensive; callers finalize first)
+        if getattr(v, "_tpu_dedupe_clone", False):
+            return    # a deduped window wakes N owners with one shared
+            # payload: the representative's put is the only one needed
+            # — N-1 re-puts of identical tuples would just burn copies
+            # and inflate `stores`
+        space, token = ck[1], ck[3]
+        if self._provider is None or \
+                self._provider.version(space) != token or \
+                self._catalog_version() != ck[4]:
+            return
+        self.result_cache.put(ck, (tuple(v.columns), tuple(rows)))
+
+    def _purge_space_cache(self, space_id: int) -> int:
+        """Drop every cached result/decline of a space — the poison
+        hygiene rung: a poisoned snapshot's entries are already
+        unreachable (the token moved past them), this frees the memory
+        NOW and makes the purge observable (`invalidations`)."""
+        n = self.result_cache.invalidate_where(
+            lambda k: len(k) > 1 and k[1] == space_id)
+        n += self.negative_cache.invalidate_where(
+            lambda k: len(k) > 1 and k[1] == space_id)
+        return n
+
+    @staticmethod
+    def _clone_result(r):
+        """An independent Result over the same immutable payload — the
+        in-window dedupe fan-out: every follower gets its OWN
+        InterimResult (downstream executors may sort/mutate rows in
+        place) while sharing the window-encoded blob (EncodedRows
+        decode is pure) or the row tuples."""
+        if r is None:
+            return None
+        try:
+            if not r.ok():
+                return r
+        except AttributeError:
+            return r
+        v = r.value()
+        from ..graph.interim import InterimResult
+        out = InterimResult(list(v.columns))
+        enc = getattr(v, "_tpu_deferred", None)
+        if enc is not None:
+            out._tpu_deferred = enc
+        else:
+            out.rows = list(v.rows)
+        out._tpu_dedupe_clone = True   # _result_cache_put skips clones
+        return StatusOr.of(out)
 
     def _execute_go_routed(self, ctx, s: ast.GoSentence,
                            starts: List[int], edge_types: List[int],
                            alias_map: Dict[str, str],
-                           name_by_type: Dict[int, str]):
+                           name_by_type: Dict[int, str], dkey=None,
+                           yield_cols=None):
         """Route one GO to the dispatcher or the single-query path.
 
         Plain-form GO (no UPTO, no input refs, unmeshed) goes through
@@ -1091,7 +1347,8 @@ class TpuGraphEngine:
         if len(edge_types) > traverse.MAX_EDGE_TYPES_PER_QUERY:
             self.stats["fallbacks"] += 1
             return None
-        yield_cols = ex._go_yield_columns(s, ctx, name_by_type)
+        if yield_cols is None:   # the cache-key step already resolved
+            yield_cols = ex._go_yield_columns(s, ctx, name_by_type)
         exprs = [c.expr for c in yield_cols]
         if s.where is not None:
             exprs.append(s.where.filter)
@@ -1101,7 +1358,7 @@ class TpuGraphEngine:
         if not s.step.upto and not _uses_input_refs(exprs):
             return self._go_via_dispatcher(ctx, s, starts, edge_types,
                                            alias_map, name_by_type, ex,
-                                           yield_cols)
+                                           yield_cols, dkey=dkey)
         with self._lock:   # delta applies mutate host mirrors in place
             r = self._execute_go_locked(ctx, s, starts, edge_types,
                                         alias_map, name_by_type, ex,
@@ -1150,10 +1407,10 @@ class TpuGraphEngine:
     # land, not at end-of-round (docs/manual/7-dispatcher.md).
     # ------------------------------------------------------------------
     def _go_via_dispatcher(self, ctx, s, starts, edge_types, alias_map,
-                           name_by_type, ex, yield_cols):
+                           name_by_type, ex, yield_cols, dkey=None):
         req = _GoReq(ctx, s, starts, edge_types, alias_map, name_by_type,
                      (ctx.space_id(), int(s.step.steps),
-                      tuple(edge_types)), yield_cols)
+                      tuple(edge_types)), yield_cols, dkey=dkey)
         req.t_enq = time.monotonic()
         req.tctx = _tr.current_state()
         dl = getattr(ctx, "_tpu_deadline", None)
@@ -1257,12 +1514,40 @@ class TpuGraphEngine:
         """Flip `done` and wake the owners NOW — waiters wake on their
         own group's completion, never on an unrelated round's end.
         `early` counts waiters released before their round fully
-        retired (sparse fast-outs, non-final chunks)."""
+        retired (sparse fast-outs, non-final chunks).
+
+        Dedupe fan-out happens HERE, before the representative's
+        `done` flips: its owner thread cannot wake (and start
+        finalizing / letting downstream executors mutate the rows in
+        place) until `done` is visible under this condition var, so
+        cloning first is the one race-free point. Followers wake in
+        the same notify as their representative — a deduped request
+        never waits longer than the lane it rode."""
         now = time.monotonic()
         with self._disp_cv:
-            for r in reqs:
-                if r.done:
+            done_now: List["_GoReq"] = []
+            seen = set()
+            stack = list(reqs)
+            while stack:
+                r = stack.pop()
+                if r.done or id(r) in seen:
                     continue
+                seen.add(id(r))
+                if r.followers:
+                    for f in r.followers:
+                        if f.done:
+                            continue
+                        try:
+                            with _tr.use(f.tctx):
+                                f.result = self._clone_result(r.result)
+                                if f.result is not None:
+                                    _tr.tag_root("cache_hit",
+                                                 "window_dedupe")
+                        except Exception:
+                            f.result = None   # CPU pipe re-serves it
+                        stack.append(f)
+                done_now.append(r)
+            for r in done_now:
                 r.done = True
                 w = int((now - r.t_enq) * 1e6)
                 self.stats["group_wait_us_total"] += w
@@ -1305,24 +1590,67 @@ class TpuGraphEngine:
         (space, steps, edge types) key); a request that fails
         individually degrades to a CPU-pipe retry in its own session
         (result stays None — device failures never carry errors back,
-        docs/manual/9-robustness.md)."""
+        docs/manual/9-robustness.md).
+
+        In-window dedupe (cache_mode=full): identical requests inside
+        the window — same version-free statement identity (`dkey`) —
+        collapse to ONE served lane; the followers' rows fan out as
+        independent clones over the shared encoded blob at the
+        representative's own _mark_done (see there for why that is
+        the race-free point). Tier-3-shaped load (sessions drawing
+        from shared seed pools) stops paying per-duplicate kernel
+        lanes and materialization. A fallen-through representative
+        (exception below) fans out None and every follower re-serves
+        on the CPU pipe in its own session, like a failed lane."""
         if len(batch) > 1:
             self.stats["batched_max_window"] = max(
                 self.stats["batched_max_window"], len(batch))
+        uniques = self._dedupe_window(batch)
         try:
-            self._serve_group(batch, ex)
+            self._serve_group(uniques, ex)
         except Exception as e:   # defensive: never strand a waiter —
             # and never error one either: the failed round's requests
             # wake with result=None and re-serve on the CPU pipe in
             # their own sessions (failure isolation: other concurrent
             # groups and later windows are untouched)
             self._device_failed("go", e)
-            for r in batch:
+            for r in uniques:
                 if not r.done:
                     r.result = None
                     with _tr.use(r.tctx):
                         _tr.tag_root("degraded", "window_failed")
-            self._mark_done(batch)
+            self._mark_done(uniques)
+
+    def _dedupe_window(self, batch: List["_GoReq"]) -> List["_GoReq"]:
+        """Collapse one claimed window to its unique representatives
+        (first occurrence per dkey, preserving order — batch[0] stays
+        first, so the round-ownership handoff in _serve_group is
+        untouched); followers attach to their representative and are
+        fanned out + woken by its _mark_done. Requests without a dkey
+        (rung off, unkeyable) are always unique."""
+        if len(batch) < 2:
+            return batch
+        uniques: List["_GoReq"] = []
+        n_followers = 0
+        rep_by_key: Dict[Any, "_GoReq"] = {}
+        for r in batch:
+            rep = rep_by_key.get(r.dkey) if r.dkey is not None else None
+            if rep is None:
+                if r.dkey is not None:
+                    rep_by_key[r.dkey] = r
+                uniques.append(r)
+            else:
+                if rep.followers is None:
+                    rep.followers = []
+                rep.followers.append(r)
+                n_followers += 1
+        if n_followers:
+            with self._stats_lock:
+                self.stats["dedup_collapsed"] += n_followers
+                self.stats["dedup_rounds"] += 1
+            global_stats.add_value("tpu_engine.dedup_collapsed",
+                                   n_followers, kind="counter")
+        return uniques
 
     def _serve_group(self, group: List["_GoReq"], ex) -> None:
         """Serve one group window in three phases: (1) snapshot +
@@ -1432,13 +1760,14 @@ class TpuGraphEngine:
                 if mesh_aligned is None and \
                         getattr(snap, "_sharded_aligned", None) is None:
                     self._kick_sharded_aligned(snap)
-        # one device-filter compile per DISTINCT WHERE per round:
-        # the common group-commit case is N identical queries, and
-        # the compiled edge mask depends only on the filter + the
-        # shared snapshot/types, not on the query's roots (review
-        # finding, round 5). Compiles run lazily UNDER the lock in
-        # phase 3 (FilterCompiler reads host mirrors).
-        from ..filter.expressions import encode_expression
+        # one device-filter compile per DISTINCT WHERE per round — and,
+        # through _plan_filter's per-snapshot rung, per SNAPSHOT VERSION
+        # across rounds (docs/manual/11-caching.md): the window dict
+        # below is only an L0 memo that skips re-encoding the filter
+        # for each request of the window; the compile itself is served
+        # (and survives) in the snapshot's keyed plan cache. Compiles
+        # run lazily UNDER the lock in phase 3 (FilterCompiler reads
+        # host mirrors).
         filter_cache: Dict[Any, Tuple] = {}
 
         def plan_filter_cached(r):
@@ -2108,7 +2437,17 @@ class TpuGraphEngine:
                              group_layout: Optional[List] = None):
         """Ladder wrapper for the aggregation pushdown: an open "agg"
         breaker (or any device exception) degrades the query to the
-        CPU pipe — counted, never client-visible (see execute_go)."""
+        CPU pipe — counted, never client-visible (see execute_go).
+        Aggregate results ride the snapshot-versioned result cache too
+        (cache_mode=full; rows are tiny and the reductions are the
+        expensive half of the stats surface) — checked BEFORE the
+        breaker gate, same warm-cache-under-breaker rationale as GO."""
+        ck = self._agg_cache_key(ctx, s, specs, out_cols, starts,
+                                 edge_types, alias_map, group_layout)
+        if ck is not None:
+            hit = self._result_cache_get(ck)
+            if hit is not None:
+                return hit
         if not self._device_admit("agg", ctx):
             return None
         try:
@@ -2119,7 +2458,35 @@ class TpuGraphEngine:
             return self._device_failed("agg", e)
         if r is not None:
             self._device_ok("agg")
+            if ck is not None:
+                self._result_cache_put(ck, r)
         return r
+
+    def _agg_cache_key(self, ctx, s, specs, out_cols, starts,
+                       edge_types, alias_map, group_layout):
+        """Result-cache key for the aggregation pushdown (same layout
+        contract as _go_cache_key: space at [1], token at [3],
+        catalog at [4])."""
+        if not result_stage_enabled(graph_flags) or \
+                self._provider is None or not self.enabled:
+            return None
+        try:
+            space = ctx.space_id()
+            token = self._provider.version(space)
+            if token is None:
+                return None
+            where_enc = encode_expression(s.where.filter) \
+                if s.where is not None else None
+            specs_sig = tuple(
+                (fun, None if e is None else (e.edge, e.prop))
+                for fun, e in specs)
+        except Exception:
+            return None
+        return ("agg", space, int(s.step.steps), token,
+                self._catalog_version(), tuple(edge_types),
+                tuple(starts), tuple(sorted(alias_map.items())),
+                where_enc, specs_sig, tuple(out_cols),
+                None if group_layout is None else tuple(group_layout))
 
     def _execute_go_aggregate_checked(self, ctx, s: ast.GoSentence,
                                       specs, out_cols: List[str],
@@ -2154,13 +2521,45 @@ class TpuGraphEngine:
         edge-type count) are decided BEFORE the engine lock and
         snapshot are taken, so a structurally-declined stats query
         costs schema lookups, not a snapshot check + discarded walk."""
-        from ..codec.schema import PropType
         from ..graph import executors as ex
         if len(edge_types) > traverse.MAX_EDGE_TYPES_PER_QUERY:
             return self._agg_decline("too_many_edge_types")
         # pre-lock structural check: every non-COUNT spec must read an
         # int-typed edge prop (the exactness surface) — schema lookups
-        # only, no snapshot / engine lock needed
+        # only, no snapshot / engine lock needed. The verdict is
+        # NEGATIVE-CACHED per (specs, edge types, catalog version)
+        # under cache_mode=full: the same declined stats query used to
+        # re-walk the schema per execution; the per-query decline
+        # COUNTERS still bump on every served query (the decline
+        # matrix stays an accounting ledger).
+        nk = None
+        if result_stage_enabled(graph_flags):
+            try:
+                nk = ("aggpre", ctx.space_id(), self._catalog_version(),
+                      tuple((fun, None if e is None else (e.edge, e.prop))
+                            for fun, e in specs),
+                      tuple(edge_types),
+                      tuple(sorted(alias_map.items())))
+            except Exception:
+                nk = None
+        verdict = self.negative_cache.get(nk) if nk is not None else None
+        if verdict is None:
+            verdict = self._agg_structural_reason(
+                ctx, specs, edge_types, alias_map, name_by_type) or "ok"
+            if nk is not None:
+                self.negative_cache.put(nk, verdict)
+        if verdict != "ok":
+            return self._agg_decline(verdict)
+        with self._lock:
+            return self._go_aggregate_locked(ctx, s, specs, out_cols,
+                                             starts, edge_types, alias_map,
+                                             name_by_type, ex, group_layout)
+
+    def _agg_structural_reason(self, ctx, specs, edge_types, alias_map,
+                               name_by_type) -> Optional[str]:
+        """The schema walk behind the aggregation pre-check: the
+        decline reason, or None when the pushdown may proceed."""
+        from ..codec.schema import PropType
         for fun, e in specs:
             if e is None:
                 continue
@@ -2170,7 +2569,7 @@ class TpuGraphEngine:
                 types = [t for t in edge_types
                          if name_by_type.get(abs(t)) == canon]
                 if not types:
-                    return self._agg_decline("prop_outside_over")
+                    return "prop_outside_over"
             seen = False
             for t in types:
                 r = self._sm.edge_schema(ctx.space_id(), abs(t))
@@ -2179,14 +2578,11 @@ class TpuGraphEngine:
                     continue
                 seen = True
                 if ft in (PropType.DOUBLE, PropType.STRING, PropType.BOOL):
-                    return self._agg_decline("non_int_prop")
+                    return "non_int_prop"
             if not seen:
                 # no traversed type carries the prop: the CPU raises
-                return self._agg_decline("prop_not_found")
-        with self._lock:
-            return self._go_aggregate_locked(ctx, s, specs, out_cols,
-                                             starts, edge_types, alias_map,
-                                             name_by_type, ex, group_layout)
+                return "prop_not_found"
+        return None
 
     @classmethod
     def _dispatch_cap(cls, snap) -> int:

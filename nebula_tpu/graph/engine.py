@@ -10,8 +10,10 @@ from __future__ import annotations
 import time
 from typing import Callable, Dict, Optional
 
+from ..common.cache import CacheRung, plan_stage_enabled
 from ..common.status import ErrorCode, Status, StatusOr
-from ..common.tracing import (ActiveQueryRegistry, SlowQueryLog, tracer)
+from ..common.tracing import (ActiveQueryRegistry, SlowQueryLog,
+                              split_profile_prefix, tracer)
 from ..meta.schema_manager import SchemaManager
 from ..parser import GQLParser, ParseError, ast
 from . import admin_executors as adm
@@ -71,20 +73,73 @@ class ExecutionEngine:
         self.client = storage_client
         self.tpu_engine = tpu_engine
         self.balancer = balancer
+        # plan-cache rung (common/cache.py; docs/manual/11-caching.md):
+        # statement text -> parsed AST. Parse is pure text->tree and
+        # execution never mutates the AST (expressions assign only in
+        # __init__), so one parsed tree serves every session; the
+        # per-call GQLParser below is still constructed PER MISS (its
+        # token cursor lives on the instance). No invalidation needed —
+        # text->AST has no versioned inputs; the LRU bound governs.
+        self.plan_cache = CacheRung("graph.plan_cache", 512,
+                                    stats_prefix="graph.plan_cache")
+
+    # statement kinds whose parse is never cached: mutations/DDL are
+    # one-shot by construction (bulk loads would pin hundreds of
+    # never-repeated literal-heavy INSERT trees and churn out the
+    # read entries the cache exists for)
+    _UNCACHED_KINDS = _WRITE_KINDS | _SCHEMA_KINDS | _GOD_KINDS
+    # and so are huge statements, whatever their kind (bulk-load rows)
+    PLAN_CACHE_MAX_TEXT = 4096
+
+    # ------------------------------------------------------------------
+    def _parse_cached(self, text: str) -> ast.SequentialSentences:
+        """Parse through the plan cache. The key is the statement with
+        any PROFILE prefix stripped (split_profile_prefix — the shared,
+        comment-aware rule), so `PROFILE <stmt>` and `<stmt>` share one
+        entry; the profile decision itself is made from the raw text by
+        the trace head, never from the cached tree. Parse errors are
+        not cached (they re-derive their exact message per call)."""
+        from ..common.flags import graph_flags
+        if not plan_stage_enabled(graph_flags):
+            with tracer.span("parse"):
+                return GQLParser().parse(text)
+        _, key = split_profile_prefix(text)
+        if len(key) > self.PLAN_CACHE_MAX_TEXT:
+            with tracer.span("parse"):
+                return GQLParser().parse(text)
+        seq = self.plan_cache.get(key)
+        if seq is not None:
+            with tracer.span("parse", cached=True):
+                return seq
+        # parser PER MISS: GQLParser keeps its token cursor on the
+        # instance, and graphd is thread-per-connection — a shared
+        # parser under concurrent sessions interleaves cursors and
+        # throws spurious syntax errors (found by the concurrent
+        # soak; the reference constructs its parser per query too,
+        # GQLParser.h). The ORIGINAL text is parsed (the parser stays
+        # the authority that consumes the PROFILE prefix).
+        with tracer.span("parse"):
+            seq = GQLParser().parse(text)
+        if any(s.kind in self._UNCACHED_KINDS for s in seq.sentences):
+            return seq
+        if not seq.profile:
+            self.plan_cache.put(key, seq)
+        else:
+            # the key is the PROFILE-stripped text, so the cached tree
+            # must represent the stripped statement: store a profile-
+            # free twin over the same (immutable) sentences — a later
+            # plain-text hit must not receive a tree claiming
+            # profile=True (latent today, wrong tomorrow)
+            self.plan_cache.put(key, ast.SequentialSentences(
+                seq.sentences, profile=False))
+        return seq
 
     # ------------------------------------------------------------------
     def execute(self, session: ClientSession, text: str) -> ExecutionResponse:
         t0 = time.monotonic()
         resp = ExecutionResponse(space_name=session.space_name or "")
         try:
-            # parser PER CALL: GQLParser keeps its token cursor on the
-            # instance, and graphd is thread-per-connection — a shared
-            # parser under concurrent sessions interleaves cursors and
-            # throws spurious syntax errors (found by the concurrent
-            # soak; the reference constructs its parser per query too,
-            # GQLParser.h)
-            with tracer.span("parse"):
-                seq = GQLParser().parse(text)
+            seq = self._parse_cached(text)
         except ParseError as e:
             resp.code = ErrorCode.E_SYNTAX_ERROR
             resp.error_msg = str(e)

@@ -36,6 +36,8 @@ from typing import Any, Dict, Iterable, List, Optional, Tuple
 from ..codec.row import RowReader, RowUpdater, RowWriter, peek_schema_version
 from ..codec.schema import Schema
 from ..common import keys as ku
+from ..common.cache import CacheRung, result_stage_enabled
+from ..common.flags import storage_flags
 from ..common.status import ErrorCode, Status
 from ..filter.expressions import (DestPropExpr, EdgePropExpr, EvalError,
                                   Expression, ExpressionContext, InputPropExpr,
@@ -139,6 +141,34 @@ class StorageService:
         # in-flight read processors, served by storaged's /queries (the
         # storage-side twin of the graphd active-query registry)
         self.active_ops = ActiveQueryRegistry()
+        # storaged cache rungs (common/cache.py; cache_mode=full on
+        # storage_flags; docs/manual/11-caching.md): bound_stats
+        # responses and (part, version) columnar scans, both keyed by
+        # the space engine's monotonic write_version — the same token
+        # the TPU engine's freshness watch rides, so any committed
+        # write orphans old entries structurally (the RocksDB-block-
+        # cache role under the storage service). The scan rung holds
+        # whole part scans, hence the byte cap.
+        self.stats_cache = CacheRung("storage.stats_cache", 256,
+                                     stats_prefix="storage.stats_cache")
+        self.scan_cache = CacheRung(
+            "storage.scan_cache", 64,
+            stats_prefix="storage.scan_cache",
+            weigher=lambda r: (len(r.keys_blob) + len(r.vals_blob)
+                               + len(r.vlens) + len(r.klens) + 256),
+            # resolved per store: scan_cache_mb is MUTABLE and must
+            # keep working after construction (hot memory relief)
+            byte_cap=lambda: int(storage_flags.get("scan_cache_mb",
+                                                   256)) * (1 << 20))
+
+    def _catalog_version(self) -> int:
+        v = getattr(self.sm, "_meta", None)
+        v = getattr(v, "catalog_version", 0) if v is not None else 0
+        return v() if callable(v) else v
+
+    def _engine_version(self, space_id: int) -> Optional[int]:
+        engine = self.store.space_engine(space_id)
+        return None if engine is None else int(engine.write_version)
 
     # ------------------------------------------------------------------
     # schema/row helpers
@@ -284,9 +314,73 @@ class StorageService:
         try:
             with tracer.span("proc.bound_stats", parts=len(req.parts),
                              vids=n_vids, host=self.host):
-                return self._bound_stats(req, stat_defs)
+                key = self._stats_cache_key(req, stat_defs)
+                if key is not None:
+                    hit = self.stats_cache.get(key)
+                    if hit is not None:
+                        tracer.tag_root("cache_hit", "bound_stats")
+                        return _copy_stats_response(hit)
+                resp = self._bound_stats(req, stat_defs)
+                # put-time version re-check (the engine result cache's
+                # rule): a write committing mid-scan can tear the
+                # response across parts — publishing it under the
+                # pre-write version key would hand a same-key reader
+                # partials no direct scan could return
+                if key is not None and all(
+                        r.code == ErrorCode.SUCCEEDED
+                        for r in resp.results.values()) and \
+                        self._engine_version(req.space_id) == key[1]:
+                    self.stats_cache.put(key, _copy_stats_response(resp))
+                return resp
         finally:
             self.active_ops.unregister(tok)
+
+    def _stats_cache_key(self, req: BoundRequest,
+                         stat_defs: List[StatDef]):
+        """bound_stats cache key, or None when the rung is off or the
+        request is unkeyable. Keyed by the space engine's
+        write_version (any committed write orphans the entry) AND the
+        meta catalog version (ALTER changes defaults/visibility
+        without touching storage data). Schemas with TTL columns
+        never cache — their rows expire by wall clock, invisible to
+        both versions."""
+        if not result_stage_enabled(storage_flags):
+            return None
+        engine = self.store.space_engine(req.space_id)
+        if engine is None:
+            return None
+        space = req.space_id
+        edge_types = req.edge_types or self.sm.all_edge_types(space)
+        for et in edge_types:
+            r = self.sm.edge_schema(space, abs(et))
+            if r.ok() and r.value().ttl_col:
+                return None
+        for d in stat_defs:
+            if d.owner == "tag":
+                r = self.sm.tag_schema(space, d.schema_id)
+                if r.ok() and r.value().ttl_col:
+                    return None
+        filter_tags = set()
+        if req.filter:
+            try:
+                filter_tags = _filter_tag_ids(
+                    self.sm, space, decode_expression(req.filter))
+            except Exception:
+                return None
+        for tid in filter_tags:
+            r = self.sm.tag_schema(space, tid)
+            if r.ok() and r.value().ttl_col:
+                return None
+        return (space, int(engine.write_version),
+                self._catalog_version(),
+                tuple(sorted((p, tuple(v))
+                             for p, v in req.parts.items())),
+                tuple(edge_types), req.filter,
+                tuple((d.owner, d.schema_id, d.prop, d.stat)
+                      for d in stat_defs),
+                req.max_edges_per_vertex,
+                tuple(sorted((t, tuple(ps)) for t, ps in
+                             (req.vertex_props or {}).items())))
 
     def _bound_stats(self, req: BoundRequest,
                      stat_defs: List[StatDef]) -> StatsResponse:
@@ -760,7 +854,36 @@ class StorageService:
         try:
             with tracer.span("proc.scan_part", part=part, kind=kind,
                              host=self.host):
-                return self._scan_part_cols(space_id, part, kind)
+                # (part, version) scan cache (cache_mode=full): the
+                # snapshot-sync feed re-scans whole parts on every
+                # rebuild; at an unchanged write_version the columnar
+                # blobs are byte-identical — repack retries and
+                # mesh demote/re-admit rebuilds stop re-reading the
+                # store. Blobs are immutable bytes; the response
+                # wrapper is copied per hit (latency_us is per-call).
+                key = None
+                if result_stage_enabled(storage_flags):
+                    engine = self.store.space_engine(space_id)
+                    if engine is not None:
+                        key = (space_id, part, kind,
+                               int(engine.write_version))
+                if key is not None:
+                    hit = self.scan_cache.get(key)
+                    if hit is not None:
+                        tracer.tag_root("cache_hit", "scan_part")
+                        from .types import ScanPartResponse
+                        return ScanPartResponse(
+                            hit.result, hit.n, hit.keys_blob,
+                            hit.vals_blob, hit.vlens, hit.klens)
+                resp = self._scan_part_cols(space_id, part, kind)
+                # same put-time version re-check as bound_stats: a
+                # write landing mid-scan must not publish the partial
+                # blob under the pre-write version
+                if key is not None and \
+                        resp.result.code == ErrorCode.SUCCEEDED and \
+                        self._engine_version(space_id) == key[3]:
+                    self.scan_cache.put(key, resp)
+                return resp
         finally:
             self.active_ops.unregister(tok)
 
@@ -908,3 +1031,11 @@ def _to_part_result(st: Status) -> PartResult:
     if st.ok():
         return PartResult()
     return PartResult(st.code, st.msg or None)
+
+
+def _copy_stats_response(r: StatsResponse) -> StatsResponse:
+    """Independent StatsResponse over the same numbers — the client
+    merge loop mutates sums/counts in place, so the cached copy must
+    never be the one handed out."""
+    return StatsResponse(results=dict(r.results), sums=list(r.sums),
+                         counts=list(r.counts), latency_us=r.latency_us)

@@ -125,6 +125,30 @@ def _fault_schedule(stop, period: float = 0.8, seed: int = 7):
     return t
 
 
+def _cache_full_wrap(run, enabled: bool) -> dict:
+    """`--faults`/`--chaos` soaks run with the FULL cache ladder armed
+    (cache_mode=full on both damon registries; docs/manual/
+    11-caching.md): the soak's continuous write + identity-verify mix
+    is exactly the staleness gauntlet the snapshot-versioned result
+    cache must survive byte-identically — and the fault schedule's
+    csr.delta_apply failures exercise the poison -> cache-purge path.
+    Restored in a finally (the designed failure mode is RAISING on a
+    divergence, and a leaked process-global mode would change whatever
+    runs next)."""
+    if not enabled:
+        return run()
+    from ..common.flags import graph_flags, storage_flags
+    g0 = graph_flags.get("cache_mode")
+    s0 = storage_flags.get("cache_mode")
+    graph_flags.set("cache_mode", "full")
+    storage_flags.set("cache_mode", "full")
+    try:
+        return run()
+    finally:
+        graph_flags.set("cache_mode", g0)
+        storage_flags.set("cache_mode", s0)
+
+
 def _chaos_wrap(run, chaos: bool) -> dict:
     """Chaos mode samples EVERY query (so degraded serves provably
     carry their degradation tags) — the forced rate is restored in a
@@ -155,11 +179,13 @@ def run_soak(seconds: float = 10.0, write_ratio: float = 0.3,
              verify_every: int = 20, v: int = 2000, e: int = 10000,
              seed: int = 7, progress=None, fault_schedule: bool = False,
              chaos: bool = False) -> dict:
-    return _chaos_wrap(
-        lambda: _run_soak(seconds, write_ratio, verify_every, v, e,
-                          seed, progress,
-                          fault_schedule or chaos),
-        chaos)
+    return _cache_full_wrap(
+        lambda: _chaos_wrap(
+            lambda: _run_soak(seconds, write_ratio, verify_every, v, e,
+                              seed, progress,
+                              fault_schedule or chaos),
+            chaos),
+        fault_schedule or chaos)
 
 
 def _run_soak(seconds, write_ratio, verify_every, v, e, seed, progress,
@@ -274,6 +300,7 @@ def _run_soak(seconds, write_ratio, verify_every, v, e, seed, progress,
     }
     if fault_schedule:
         out["robustness"] = tpu.robustness_stats()
+        out["cache"] = tpu.cache_stats()   # full ladder is armed here
     # foreground rebuilds during the soak mean a write forced a
     # stop-the-world snapshot rebuild — the delta buffer's whole job
     # is keeping that at zero (background repacks are fine). Under an
@@ -293,10 +320,12 @@ def run_soak_concurrent(seconds: float = 8.0, threads: int = 6,
                         seed: int = 11,
                         fault_schedule: bool = False,
                         chaos: bool = False) -> dict:
-    return _chaos_wrap(
-        lambda: _run_soak_concurrent(seconds, threads, v, e, seed,
-                                     fault_schedule or chaos),
-        chaos)
+    return _cache_full_wrap(
+        lambda: _chaos_wrap(
+            lambda: _run_soak_concurrent(seconds, threads, v, e, seed,
+                                         fault_schedule or chaos),
+            chaos),
+        fault_schedule or chaos)
 
 
 def _run_soak_concurrent(seconds, threads, v, e, seed,
@@ -468,6 +497,12 @@ def _run_soak_concurrent(seconds, threads, v, e, seed,
         time.sleep(0.01)
         orig_sb(batch, ex)
 
+    # with the full cache ladder armed (--faults/--chaos), burst B's
+    # results are still version-valid after the refresh (same token) —
+    # phase C would be all cache hits and never form the lane windows
+    # this phase exists to exercise; dropping the rung's entries makes
+    # the first paced barrage miss -> coalesce deterministically
+    tpu.result_cache.clear()
     tpu._serve_batch = paced
     try:
         burst(0, True, per)                  # C: read-only lane rounds
@@ -491,6 +526,7 @@ def _run_soak_concurrent(seconds, threads, v, e, seed,
     }
     if fault_schedule:
         out["robustness"] = tpu.robustness_stats()
+        out["cache"] = tpu.cache_stats()   # full ladder is armed here
     out["ok"] = (not errors and verifies >= 15 and queries > 0
                  and stats["batched_queries"] > 0)
     if fault_schedule:
@@ -515,8 +551,9 @@ def main(argv=None) -> int:
     ap.add_argument("--faults", action="store_true",
                     help="run a background fault schedule (kernel/"
                          "encode/delta-apply injection windows) under "
-                         "the soak; identity checks must stay green "
-                         "and no client may see an error")
+                         "the soak WITH the full cache ladder armed "
+                         "(cache_mode=full); identity checks must stay "
+                         "green and no client may see an error")
     ap.add_argument("--chaos", action="store_true",
                     help="--faults plus forced trace sampling: the "
                          "soak additionally FAILS unless degraded "

@@ -1,0 +1,376 @@
+"""Snapshot-versioned multi-level caching (common/cache.py; docs/
+manual/11-caching.md): the plan / filter-plan / result / negative /
+in-window-dedupe rungs and the storaged stats/scan rungs, with the
+staleness contract tested by construction — a write between two
+identical statements must make the second reflect the write, a delta
+apply landing mid-serve must never publish the pre-write rows under
+the post-write key, and a poisoned snapshot must purge its entries."""
+import threading
+import time
+
+import pytest
+
+from nebula_tpu.cluster import InProcCluster
+from nebula_tpu.common.cache import CacheRung
+from nebula_tpu.common.faults import faults
+from nebula_tpu.common.flags import graph_flags, storage_flags
+from nebula_tpu.engine_tpu import TpuGraphEngine
+
+
+@pytest.fixture(autouse=True)
+def _restore_modes():
+    """cache_mode is process-global flag state: every test leaves it
+    exactly as found (tier-1 runs unrelated suites after this one)."""
+    g0 = graph_flags.get("cache_mode")
+    s0 = storage_flags.get("cache_mode")
+    faults.reset()
+    yield
+    graph_flags.set("cache_mode", g0)
+    storage_flags.set("cache_mode", s0)
+    faults.reset()
+
+
+def _mini(parts=2, v=50, e=200, seed=5):
+    import numpy as np
+    tpu = TpuGraphEngine()
+    cluster = InProcCluster(tpu_engine=tpu)
+    conn = cluster.connect()
+    conn.must(f"CREATE SPACE cz(partition_num={parts})")
+    conn.must("USE cz")
+    conn.must("CREATE TAG person(age int)")
+    conn.must("CREATE EDGE knows(w int)")
+    conn.must("CREATE EDGE rated(score double)")
+    conn.must("INSERT VERTEX person(age) VALUES " + ", ".join(
+        f"{i}:({i % 70})" for i in range(v)))
+    rng = np.random.default_rng(seed)
+    srcs = rng.integers(0, v, e)
+    dsts = rng.integers(0, v, e)
+    for i in range(0, e, 200):
+        conn.must("INSERT EDGE knows(w) VALUES " + ", ".join(
+            f"{int(s)} -> {int(d)}@{j}:({int((s + d) % 50)})"
+            for j, (s, d) in enumerate(zip(srcs[i:i + 200],
+                                           dsts[i:i + 200]), start=i)))
+    conn.must("INSERT EDGE rated(score) VALUES 1 -> 2:(1.5)")
+    sid = cluster.meta.get_space("cz").value().space_id
+    return cluster, conn, tpu, sid
+
+
+@pytest.fixture()
+def mini():
+    return _mini()
+
+
+def _cpu_rows(conn, tpu, q):
+    tpu.enabled = False
+    try:
+        return sorted(map(repr, conn.must(q).rows))
+    finally:
+        tpu.enabled = True
+
+
+# ---------------------------------------------------------------------------
+# CacheRung unit behavior
+# ---------------------------------------------------------------------------
+
+def test_rung_lru_and_counters():
+    r = CacheRung("t", capacity=2)
+    assert r.get("a") is None and r.misses == 1
+    r.put("a", 1)
+    r.put("b", 2)
+    assert r.get("a") == 1                 # a is now most-recent
+    r.put("c", 3)                          # evicts b (LRU)
+    assert r.get("b") is None
+    assert r.get("a") == 1 and r.get("c") == 3
+    assert r.evictions == 1
+    assert r.invalidate_where(lambda k: k == "a") == 1
+    assert r.get("a") is None
+    st = r.stats()
+    assert st["invalidations"] == 1 and st["entries"] == 1
+
+
+def test_rung_byte_cap_evicts_and_rejects_oversize():
+    r = CacheRung("t", capacity=10, weigher=len, byte_cap=10)
+    r.put("a", b"xxxx")
+    r.put("b", b"xxxx")
+    r.put("c", b"xxxx")                    # 12 bytes > 10: a evicts
+    assert r.get("a") is None and r.get("b") == b"xxxx"
+    r.put("huge", b"x" * 100)              # larger than the whole cap
+    assert r.get("huge") is None           # rejected, rung untouched
+    assert r.stats()["bytes"] <= 10
+
+
+# ---------------------------------------------------------------------------
+# rung 1: graphd plan cache
+# ---------------------------------------------------------------------------
+
+def test_plan_cache_hits_and_profile_shares_entry(mini):
+    cluster, conn, tpu, sid = mini
+    pc = cluster.service.engine.plan_cache
+    q = "GO FROM 1 OVER knows YIELD knows._dst"
+    conn.must(q)
+    h0, m0 = pc.stats()["hits"], pc.stats()["misses"]
+    r = conn.must(q)                       # same text -> plan hit
+    assert pc.stats()["hits"] == h0 + 1
+    # PROFILE-prefix-aware key (split_profile_prefix): the profiled
+    # twin rides the SAME entry — and still returns its span tree
+    rp = conn.must("PROFILE " + q)
+    assert pc.stats()["hits"] == h0 + 2
+    assert pc.stats()["misses"] == m0
+    assert sorted(rp.rows) == sorted(r.rows)
+    assert rp.trace_spans                  # PROFILE semantics intact
+
+
+def test_plan_cache_off_mode_and_parse_errors(mini):
+    cluster, conn, tpu, sid = mini
+    pc = cluster.service.engine.plan_cache
+    graph_flags.set("cache_mode", "off")
+    q = "GO FROM 2 OVER knows YIELD knows._dst"
+    conn.must(q)
+    s0 = pc.stats()["stores"]
+    conn.must(q)
+    assert pc.stats()["stores"] == s0      # off: rung never touched
+    # parse errors are never cached and keep their exact message
+    for _ in range(2):
+        r = conn.execute("GO FRM 1 OVER knows")
+        assert not r.ok() and "SyntaxError" in (r.error_msg or "")
+
+
+# ---------------------------------------------------------------------------
+# rung 2: device result cache — hits, staleness by construction
+# ---------------------------------------------------------------------------
+
+def test_result_cache_hit_counts_and_identity(mini):
+    cluster, conn, tpu, sid = mini
+    graph_flags.set("cache_mode", "full")
+    q = "GO 2 STEPS FROM 1 OVER knows YIELD knows._dst, knows.w"
+    r1 = conn.must(q)
+    h0 = tpu.result_cache.stats()["hits"]
+    g0 = tpu.stats["go_served"]
+    r2 = conn.must(q)
+    assert tpu.result_cache.stats()["hits"] == h0 + 1
+    assert tpu.stats["go_served"] == g0    # hit never re-serves
+    assert r2.rows == r1.rows              # bit-identical
+    assert sorted(map(repr, r2.rows)) == _cpu_rows(conn, tpu, q)
+
+
+def test_write_between_identical_queries_reflects_write(mini):
+    """Satellite: the staleness hazard is closed by construction — a
+    committed write moves the freshness token, so the second identical
+    statement misses and re-serves against the post-write snapshot."""
+    cluster, conn, tpu, sid = mini
+    graph_flags.set("cache_mode", "full")
+    q = "GO FROM 1 OVER knows YIELD knows._dst"
+    conn.must(q)
+    before = conn.must(q).rows             # cached
+    conn.must("INSERT EDGE knows(w) VALUES 1 -> 4999:(7)")
+    after = conn.must(q).rows
+    assert (4999,) in after and (4999,) not in before
+    assert sorted(map(repr, after)) == _cpu_rows(conn, tpu, q)
+
+
+def test_store_rechecks_token_mid_round(mini):
+    """A delta apply landing MID-SERVE must not publish the pre-write
+    rows under the post-write key: _result_cache_put re-checks the
+    provider token at store time (the dispatcher's snapshot-version
+    redo check re-serves the query itself; this guards the cache)."""
+    from nebula_tpu.common.status import StatusOr
+    from nebula_tpu.graph.interim import InterimResult
+    cluster, conn, tpu, sid = mini
+    graph_flags.set("cache_mode", "full")
+    q = "GO FROM 3 OVER knows YIELD knows._dst"
+    r = StatusOr.of(InterimResult(["knows._dst"],
+                                  list(conn.must(q).rows)))
+    # forge a key whose token predates a write that lands "mid-round"
+    stale_token = tpu._provider.version(sid)
+    conn.must("INSERT EDGE knows(w) VALUES 3 -> 4888:(1)")
+    ck = ("go", sid, 1, stale_token, tpu._catalog_version(),
+          (1,), (3,), (), None, (), False)
+    s0 = tpu.result_cache.stats()["stores"]
+    tpu._result_cache_put(ck, r)           # token moved: must refuse
+    assert tpu.result_cache.stats()["stores"] == s0
+    # and with the CURRENT token it stores fine
+    ck_now = ck[:3] + (tpu._provider.version(sid),) + ck[4:]
+    tpu._result_cache_put(ck_now, r)
+    assert tpu.result_cache.stats()["stores"] == s0 + 1
+
+
+def test_poisoned_snapshot_purges_cache_entries(mini):
+    """Satellite: a failed delta apply poisons the snapshot AND purges
+    the space's cached results (counted as invalidations); the query
+    itself serves correctly on the CPU pipe."""
+    cluster, conn, tpu, sid = mini
+    graph_flags.set("cache_mode", "full")
+    q = "GO FROM 1 OVER knows YIELD knows._dst, knows.w"
+    conn.must(q)
+    conn.must(q)                           # entry cached
+    assert len(tpu.result_cache) > 0
+    faults.set_plan("csr.delta_apply:n=1")
+    conn.must("INSERT EDGE knows(w) VALUES 1 -> 2:(9)")
+    p0 = tpu.stats["snapshot_poisoned"]
+    i0 = tpu.result_cache.stats()["invalidations"]
+    r = conn.must(q)                       # apply fires -> poison
+    faults.clear()
+    assert tpu.stats["snapshot_poisoned"] == p0 + 1
+    assert tpu.result_cache.stats()["invalidations"] > i0
+    assert sorted(map(repr, r.rows)) == _cpu_rows(conn, tpu, q)
+
+
+# ---------------------------------------------------------------------------
+# filter-plan rung: compiled WHERE plans survive across windows
+# ---------------------------------------------------------------------------
+
+def test_filter_plan_reused_across_queries(mini):
+    cluster, conn, tpu, sid = mini
+    tpu.sparse_edge_budget = 0             # dense: _plan_filter path
+    q = ("GO 2 STEPS FROM 1 OVER knows WHERE knows.w > 10 "
+         "YIELD knows._dst, knows.w")
+    conn.must(q)
+    h0 = tpu.filter_plan_counters["hits"]
+    # a DIFFERENT statement with the same WHERE shape (other roots)
+    # reuses the compiled plan — per-snapshot, not per-window
+    r = conn.must("GO 2 STEPS FROM 2 OVER knows WHERE knows.w > 10 "
+                  "YIELD knows._dst, knows.w")
+    assert tpu.filter_plan_counters["hits"] > h0
+    assert sorted(map(repr, r.rows)) == _cpu_rows(
+        conn, tpu, "GO 2 STEPS FROM 2 OVER knows WHERE knows.w > 10 "
+                   "YIELD knows._dst, knows.w")
+    # a write bumps write_version: the old plan is version-orphaned
+    # and the next compile records the invalidation
+    conn.must("INSERT EDGE knows(w) VALUES 1 -> 2:(3)")
+    i0 = tpu.filter_plan_counters["invalidations"]
+    conn.must(q)
+    assert tpu.filter_plan_counters["invalidations"] >= i0
+
+
+# ---------------------------------------------------------------------------
+# negative rung: structural declines cached, counters still count
+# ---------------------------------------------------------------------------
+
+def test_negative_cache_agg_decline(mini):
+    cluster, conn, tpu, sid = mini
+    graph_flags.set("cache_mode", "full")
+    q = ("GO FROM 1 OVER rated YIELD rated.score AS s "
+         "| YIELD SUM($-.s) AS total")
+    d0 = tpu.stats["agg_declined"]
+    r1 = conn.must(q)                      # double prop: declines
+    h0 = tpu.negative_cache.stats()["hits"]
+    r2 = conn.must(q)                      # verdict cached...
+    assert tpu.negative_cache.stats()["hits"] > h0
+    assert tpu.stats["agg_declined"] == d0 + 2   # ...still counted
+    assert tpu.agg_decline_reasons.get("non_int_prop", 0) >= 2
+    assert r1.rows == r2.rows              # CPU pipe serves both
+
+
+# ---------------------------------------------------------------------------
+# rung 3: in-window dedupe
+# ---------------------------------------------------------------------------
+
+def test_in_window_dedupe_collapses_and_fans_out(mini):
+    cluster, conn, tpu, sid = mini
+    graph_flags.set("cache_mode", "full")
+    q = "GO 2 STEPS FROM 1 OVER knows YIELD knows._dst"
+    ref = _cpu_rows(conn, tpu, q)
+    orig = tpu._serve_batch
+
+    def paced(batch, ex):                  # let arrivals pile up
+        time.sleep(0.05)
+        orig(batch, ex)
+
+    rows, errs = [], []
+
+    def worker():
+        try:
+            c = cluster.connect()
+            c.must("USE cz")
+            rows.append(sorted(map(repr, c.must(q).rows)))
+        except Exception as ex:  # noqa: BLE001 — recorded, fails test
+            errs.append(repr(ex))
+
+    tpu._serve_batch = paced
+    try:
+        for _ in range(5):                 # window formation is a
+            d0 = tpu.stats["dedup_collapsed"]   # scheduling fact:
+            rows.clear()                        # retry a few times
+            tpu.result_cache.clear()       # misses must reach the
+            threads = [threading.Thread(target=worker)  # dispatcher
+                       for _ in range(6)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+            if tpu.stats["dedup_collapsed"] > d0:
+                break
+    finally:
+        tpu._serve_batch = orig
+    assert not errs, errs[:2]
+    assert tpu.stats["dedup_collapsed"] > 0
+    assert rows and all(r == ref for r in rows)
+
+
+def test_dedupe_off_in_plan_mode(mini):
+    cluster, conn, tpu, sid = mini
+    graph_flags.set("cache_mode", "plan")
+    # plan mode never computes a dedupe identity: requests keep their
+    # own lanes (the pre-cache dispatcher semantics, bit-identical)
+    q = "GO FROM 5 OVER knows YIELD knows._dst"
+    r = conn.must(q)
+    assert tpu.stats["dedup_collapsed"] == 0
+    assert sorted(map(repr, r.rows)) == _cpu_rows(conn, tpu, q)
+
+
+# ---------------------------------------------------------------------------
+# rung 4: storaged bound-stats / scan caches
+# ---------------------------------------------------------------------------
+
+def test_storaged_stats_cache_hit_and_write_invalidate(mini):
+    from nebula_tpu.storage.types import StatDef
+    cluster, conn, tpu, sid = mini
+    storage_flags.set("cache_mode", "full")
+    etype = cluster.sm.edge_type(sid, "knows")
+    defs = [StatDef("edge", etype, "w", 1), StatDef("edge", etype, "", 2)]
+    s1 = cluster.client.bound_stats(sid, [1, 2, 3], [etype], defs)
+    h0 = cluster.storage.stats_cache.stats()["hits"]
+    s2 = cluster.client.bound_stats(sid, [1, 2, 3], [etype], defs)
+    assert cluster.storage.stats_cache.stats()["hits"] > h0
+    assert s1.sums == s2.sums and s1.counts == s2.counts
+    # a committed write moves the engine version: the key misses and
+    # the fresh scan sees the new row
+    conn.must("INSERT EDGE knows(w) VALUES 2 -> 3:(41)")
+    s3 = cluster.client.bound_stats(sid, [1, 2, 3], [etype], defs)
+    assert s3.counts[1] == s2.counts[1] + 1
+    assert s3.sums[0] == s2.sums[0] + 41
+
+
+def test_storaged_scan_cache_versioned(mini):
+    cluster, conn, tpu, sid = mini
+    storage_flags.set("cache_mode", "full")
+    part = sorted(cluster.store.parts(sid))[0]
+    r1 = cluster.storage.scan_part_cols(sid, part, 2)
+    h0 = cluster.storage.scan_cache.stats()["hits"]
+    r2 = cluster.storage.scan_part_cols(sid, part, 2)
+    assert cluster.storage.scan_cache.stats()["hits"] == h0 + 1
+    assert (r2.keys_blob, r2.vals_blob) == (r1.keys_blob, r1.vals_blob)
+    conn.must("INSERT EDGE knows(w) VALUES 7 -> 8:(1)")
+    m0 = cluster.storage.scan_cache.stats()["misses"]
+    cluster.storage.scan_part_cols(sid, part, 2)
+    assert cluster.storage.scan_cache.stats()["misses"] == m0 + 1
+
+
+# ---------------------------------------------------------------------------
+# bisection: cache_mode=off is bit-identical to cached serves
+# ---------------------------------------------------------------------------
+
+def test_off_mode_bit_identical_to_full(mini):
+    cluster, conn, tpu, sid = mini
+    queries = [
+        "GO 2 STEPS FROM 1 OVER knows YIELD knows._dst, knows.w",
+        "GO FROM 1, 2 OVER knows WHERE knows.w > 5 YIELD knows._dst",
+        "GO 2 STEPS FROM 2 OVER knows YIELD knows.w AS w "
+        "| YIELD COUNT(*) AS n, SUM($-.w) AS s",
+    ]
+    graph_flags.set("cache_mode", "off")
+    off = [conn.must(q).rows for q in queries]
+    graph_flags.set("cache_mode", "full")
+    first = [conn.must(q).rows for q in queries]   # populate
+    cached = [conn.must(q).rows for q in queries]  # serve from cache
+    assert off == first == cached

@@ -1,0 +1,62 @@
+"""Tier-1-safe cache smoke: `bench.py --cache-smoke` in a SUBPROCESS
+on XLA:CPU (no accelerator, no native engine — same isolation pattern
+as the chaos/mesh smokes). The tier asserts the whole cache ladder on
+one small cluster: repeated statements HIT the plan + result +
+storaged rungs, a write between two identical statements INVALIDATES
+(the second result reflects the write and matches the CPU pipe),
+cache_mode=off is BIT-IDENTICAL to cached serves, and identical
+in-window requests DEDUPE to one lane with identical fan-out
+(docs/manual/11-caching.md)."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def cache_smoke(tmp_path_factory):
+    out = tmp_path_factory.mktemp("cache") / "CACHE_smoke.json"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_CACHE_OUT"] = str(out)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--cache-smoke"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, (proc.stdout[-2000:],
+                                  proc.stderr[-2000:])
+    with open(out) as f:
+        return json.load(f)
+
+
+def test_cache_smoke_hits_occur(cache_smoke):
+    c = cache_smoke["checks"]
+    assert c["hits_occurred"]
+    assert c["result_hits"] >= 3
+    assert c["plan_hits"] > 0
+    assert c["storaged_hits_occurred"]
+
+
+def test_cache_smoke_invalidation_fires_on_write(cache_smoke):
+    assert cache_smoke["checks"]["write_invalidates"]
+
+
+def test_cache_smoke_off_mode_bit_identical(cache_smoke):
+    c = cache_smoke["checks"]
+    assert c["off_deterministic"]
+    assert c["bit_identical_vs_off"]
+    assert c["stats_cache_identical"]
+
+
+def test_cache_smoke_dedupe_collapses_with_identical_fanout(cache_smoke):
+    c = cache_smoke["checks"]
+    assert c["dedup_occurred"] and c["dedup_collapsed"] > 0
+    assert c["dedup_fanout_identical"]
+
+
+def test_cache_smoke_overall_ok(cache_smoke):
+    assert cache_smoke["ok"] is True
