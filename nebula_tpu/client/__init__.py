@@ -39,6 +39,16 @@ class GraphClient:
             return resp
         return self._rpc.execute(self._session_id, stmt)
 
+    def must(self, stmt: str) -> ExecutionResponse:
+        """Execute and raise on a server-side error (parity with the
+        in-proc Connection.must test/bench helper)."""
+        resp = self.execute(stmt)
+        if not resp.ok():
+            from ..common.status import Status
+            raise NebulaError(Status.error(
+                resp.code, f"{resp.error_msg}  query: {stmt}"))
+        return resp
+
     def disconnect(self) -> None:
         if self._session_id is not None:
             try:

@@ -184,5 +184,12 @@ storage_flags.declare("kv_engine_options", "", MUTABLE,
                       "maps, RocksEngineConfig.cpp)")
 storage_flags.declare("heartbeat_interval_secs", 10, MUTABLE,
                       "storaged -> metad heartbeat period")
+storage_flags.declare("raft_heartbeat_ms", 150, REBOOT,
+                      "raft leader heartbeat/replication round period "
+                      "for replicated parts (read at part bind time)")
+storage_flags.declare("raft_election_timeout_ms", 450, REBOOT,
+                      "raft election timeout base (randomized 1-2x); "
+                      "failover completes within ~2x this after a "
+                      "leader dies")
 meta_flags.declare("expired_threshold_sec", 10 * 60, MUTABLE,
                    "host liveness horizon")

@@ -159,6 +159,12 @@ class StatsManager:
     def names(self) -> List[str]:
         return sorted(self._metrics)
 
+    def lifetime_total(self, name: str) -> float:
+        """Cumulative sum since process start (the Prometheus `_total`
+        value) — 0.0 for a metric never reported."""
+        m = self._metrics.get(name)
+        return float(m.life_sum) if m is not None else 0.0
+
     # which snapshot methods make sense per metric kind: counters get
     # rate/sum (their p95 would always be the bucket of 1.0 — noise),
     # timings get the distribution views, untagged keeps legacy output

@@ -146,8 +146,18 @@ def serve_graphd(meta_addr: str, host: str = "127.0.0.1", port: int = 0,
                 rounds = max(st.get("disp_rounds", 0), 1)
                 waits = max(st.get("group_wait_count", 0), 1)
                 rb = tpu_engine.robustness_stats()
+                # cluster block (docs/manual/12-replication.md): this
+                # graphd's routing state + retry classifications, and
+                # the metad-hosted balancer's plan progress — one stop
+                # to see an election or rebalance from the serve side
+                cluster = client.routing_stats()
+                try:
+                    cluster["balance"] = mc.balance_progress()
+                except Exception:
+                    cluster["balance"] = None
                 return 200, {
                     "stats": st,
+                    "cluster": cluster,
                     # degradation ladder (docs/manual/9-robustness.md):
                     # live per-feature breaker states, trip/recovery
                     # counts, CPU-degraded serves, deadline bailouts,

@@ -34,8 +34,22 @@ class MetadHandle:
 
 
 def serve_metad(host: str = "127.0.0.1", port: int = 0,
-                ws_port: Optional[int] = None) -> MetadHandle:
-    meta = MetaService()
+                ws_port: Optional[int] = None,
+                store=None,
+                expired_threshold_secs: Optional[int] = None) -> MetadHandle:
+    """`store`: a GraphStore backing the meta KV — pass the previous
+    instance's store (or a persistent one) to restart metad with its
+    catalog, cluster id AND any in-flight balance plan intact; the
+    re-attached balancer resumes the plan on the next BALANCE DATA
+    (Balancer::recovery). `expired_threshold_secs` overrides the
+    ActiveHostsMan liveness horizon (defaults to the
+    `expired_threshold_sec` flag)."""
+    if expired_threshold_secs is None:
+        expired_threshold_secs = int(meta_flags.get(
+            "expired_threshold_sec",
+            10 * 60))
+    meta = MetaService(store=store,
+                       expired_threshold_secs=expired_threshold_secs)
     # metad hosts the balancer; it drives replicated storaged through
     # their "admin" RPC services (ref: Balancer + AdminClient in metad)
     from ..meta.balancer import Balancer
@@ -51,6 +65,28 @@ def serve_metad(host: str = "127.0.0.1", port: int = 0,
     if ws_port is not None:
         web = WebService("metad", flags=meta_flags, stats=stats,
                          host=host, port=ws_port)
+
+        def balance_handler(params, body):
+            # /balance: plan progress + persisted task rows (the BALANCE
+            # SHOW table, operator-readable without a console session)
+            pg = meta.balance_progress()
+            pg["rows"] = meta.balance_show(
+                int(params["plan"]) if params.get("plan") else None)
+            return 200, pg
+
+        web.register("/balance", balance_handler)
+
+        def meta_metric_source():
+            out = {"meta.active_storage_hosts":
+                   len(meta.active_hosts("storage"))}
+            pg = meta.balance_progress()
+            out["meta.balance.plan"] = pg["plan"]
+            out["meta.balance.running"] = int(pg["running"])
+            for st_name, n in pg["tasks"].items():
+                out[f"meta.balance.tasks.{st_name}"] = n
+            return out
+
+        web.add_metrics_source(meta_metric_source)
         web.start()
     return MetadHandle(meta, server, web)
 

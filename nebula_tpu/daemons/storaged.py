@@ -149,12 +149,29 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
         import tempfile
         raft_server = RpcServer(host, int(addr.rsplit(":", 1)[1]) + 1)
         raft_net = RpcTransport()
+
+        def on_leader_change(space_id, part_id, leader):
+            # counted for /metrics; when THIS replica takes over, its
+            # view of the meta allocation may already include peers the
+            # group hasn't admitted (heartbeat reconcile) — sync now
+            stats.add_value("raftex.leader_changes", kind="counter")
+            if leader == raft_addr_of(addr):
+                _reconcile_part_membership(space_id, part_id)
+
         node = StorageNode(addr=raft_addr_of(addr),
                            data_root=data_dir or tempfile.mkdtemp(
                                prefix="nebula_tpu_storaged_"),
                            net=raft_net,
                            engine_factory=engine_factory,
-                           leader_hint=storage_addr_of)
+                           leader_hint=storage_addr_of,
+                           on_leader_change=on_leader_change,
+                           heartbeat_interval=max(
+                               0.01, storage_flags.get(
+                                   "raft_heartbeat_ms", 150) / 1000.0),
+                           election_timeout=max(
+                               0.05, storage_flags.get(
+                                   "raft_election_timeout_ms", 450)
+                               / 1000.0))
         node.raft_net = raft_net  # shut down with the node (handle.stop)
         raft_server.register("raftex", node.service).start()
         store = node.store
@@ -163,6 +180,57 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
     mc = MetaClient(meta_addr, local_addr=addr, role="storage",
                     cluster_id_file=cluster_id_file)
 
+    def _reconcile_part_membership(space_id: int, part_id: int) -> None:
+        """Leader-side membership sync against the meta allocation
+        (satellite: CREATE SPACE replica_factor=N end-to-end). A host
+        metad assigned to this part (heartbeat reconcile / balance)
+        that the raft group doesn't know yet is added as a peer — the
+        new replica, already materialized as a learner by its own
+        topology watch, is promoted by the ADD_PEER command and caught
+        up by gap/snapshot replication. Removal stays with the
+        balancer's explicit member_remove."""
+        if node is None:
+            return
+        from ..meta.net_admin import raft_addr_of as _ra
+        raft = node.raft(space_id, part_id)
+        if raft is None or not raft.is_leader():
+            return
+        try:
+            want = {_ra(h) for h in mc.part_peers(space_id, part_id)
+                    if h != "local"}
+        except Exception:
+            return
+        # everything meta assigned that is not a VOTER yet: admits
+        # unknown hosts and promotes meta-assigned replicas stuck as
+        # learners (ADD_PEER both admits and promotes) — a learner
+        # that never becomes a voter would silently shrink the quorum
+        for target in sorted(want - set(raft.peers)):
+            stats.add_value("raftex.membership_reconciled",
+                            kind="counter")
+            raft.add_peer_async(target)
+
+    def _group_formed(space_id: int, part_id: int, others) -> bool:
+        """Does a raft group for this part already run elsewhere? The
+        peers' admin services are probed for term >= 1 (an election
+        happened before this node ever saw the part) — including the
+        boot path, where a late-started replica learns of the space
+        via space_added, not parts_added. False on any doubt: at
+        genuine space creation the sibling replicas materialize the
+        part within one topology tick, so their probes answer
+        no-part/term-0 and everyone starts as a voter."""
+        from ..meta.net_admin import storage_addr_of
+        from ..rpc import proxy as _proxy
+        for rp in others:
+            try:
+                st = _proxy(storage_addr_of(rp), "admin", timeout=0.5,
+                            max_attempts=1).raft_state(space_id,
+                                                       part_id)
+            except Exception:
+                continue
+            if st and st.get("term", 0) >= 1:
+                return True
+        return False
+
     def on_change(event: str, **kw):
         # the MetaServerBasedPartManager push: local parts follow the
         # meta allocation (ref: kvstore/PartManager.h handler methods)
@@ -170,9 +238,25 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
             for p in kw.get("parts", []):
                 if node is not None:
                     peers = [raft_addr_of(h) for h in
-                             mc.part_peers(kw["space_id"], p)]
+                             mc.part_peers(kw["space_id"], p)
+                             if h != "local"]
+                    others = [pe for pe in peers
+                              if pe != raft_addr_of(addr)]
+                    # a part that gains THIS host after its raft group
+                    # already formed elsewhere (heartbeat reconcile,
+                    # balance, late boot) joins as a LEARNER: an
+                    # empty-log voter would campaign and depose the
+                    # incumbent until ADD_PEER lands. The leader's
+                    # membership reconcile promotes the learner; a
+                    # group-log ADD_PEER that committed before this
+                    # replica materialized replays into it and
+                    # promotes it likewise.
+                    joining = bool(others) and (
+                        event == "parts_added"
+                        or _group_formed(kw["space_id"], p, others))
                     node.add_part(kw["space_id"], p, peers or
-                                  [raft_addr_of(addr)])
+                                  [raft_addr_of(addr)],
+                                  as_learner=joining)
                 else:
                     store.add_part(kw["space_id"], p)
         elif event == "parts_removed":
@@ -181,6 +265,11 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
                     node.remove_part(kw["space_id"], p)
                 else:
                     store.remove_part(kw["space_id"], p)
+        elif event == "peers_changed" and node is not None:
+            # replica set changed on parts we host: the leader admits
+            # any meta-assigned host the group doesn't know yet
+            for p in kw.get("parts", {}):
+                _reconcile_part_membership(kw["space_id"], p)
         elif event == "space_removed":
             if node is not None:
                 node.remove_space(kw["space_id"])
@@ -209,6 +298,21 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
 
     mc.on_wrong_cluster = on_wrong_cluster
     mc.add_listener(on_change)
+
+    def leader_source():
+        # heartbeat-carried leadership: metad's ActiveHostsMan leader
+        # view (SHOW HOSTS / SHOW PARTS leader columns). Unreplicated
+        # nodes lead every part they host (DirectCommit).
+        if node is not None:
+            return node.leader_parts()
+        out = {}
+        for sid in store.spaces():
+            led = store.leader_parts(sid)
+            if led:
+                out[sid] = led
+        return out
+
+    mc.leader_source = leader_source
     # register with metad BEFORE the first topology sync so part
     # allocation can target this host (waitForMetadReady ordering)
     mc.heartbeat(addr, "storage")
@@ -272,6 +376,33 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
             return out
 
         web.add_metrics_source(cache_metric_source)
+
+        def raft_handler(params, body):
+            # /raft: per-part consensus state — role/term/leader/
+            # commit-lag/peers (docs/manual/12-replication.md)
+            if node is None:
+                return 200, {"replicated": False, "parts": []}
+            return 200, {"replicated": True, "addr": addr,
+                         "parts": node.raft_status()}
+
+        web.register("/raft", raft_handler)
+
+        if node is not None:
+            def raft_metric_source():
+                # per-part raft gauges: is_leader/term/commit_lag —
+                # a scrape across the fleet shows leader placement and
+                # stuck replication at a glance
+                out = {}
+                for st in node.raft_status():
+                    base = (f"storage.raft.s{st['space']}."
+                            f"p{st['part']}")
+                    out[base + ".is_leader"] = \
+                        1 if st["role"] == "LEADER" else 0
+                    out[base + ".term"] = st["term"]
+                    out[base + ".commit_lag"] = st["commit_lag"]
+                return out
+
+            web.add_metrics_source(raft_metric_source)
         web.start()
         wc_state["web"] = web
         if wc_state["fired"]:   # wrong-cluster fired before web existed

@@ -1003,10 +1003,20 @@ class TpuGraphEngine:
     def _token_compatible(snap, token) -> bool:
         """Deltas can only patch a snapshot whose routing still matches
         (remote tokens carry part->leader routing; a moved part means
-        scans would come from a different host — rebuild)."""
+        scans would come from a different host — rebuild). Likewise a
+        LEADERSHIP change on any routed host (its per-space version
+        element carries a leadership signature): the change ring of a
+        deposed replica stops receiving the new leader's writes, so
+        patching from it would freeze the snapshot at deposal time —
+        rebuild through leader-routed scans instead, which re-resolves
+        the real leaders as a side effect."""
         old = snap.write_version
         if isinstance(token, tuple) and isinstance(old, tuple):
-            return len(token) == 3 and len(old) == 3 and token[1] == old[1]
+            if len(token) != 3 or len(old) != 3 or token[1] != old[1]:
+                return False
+            sig = {h: v[1] for h, v in token[0] if isinstance(v, tuple)}
+            old_sig = {h: v[1] for h, v in old[0] if isinstance(v, tuple)}
+            return sig == old_sig
         return not isinstance(token, tuple) and not isinstance(old, tuple)
 
     def _try_apply_deltas(self, snap, token) -> bool:

@@ -110,7 +110,11 @@ class RemoteStorageProvider:
             return None
         snap = CsrSnapshot(space_id, shards, cap_v, cap_e, token)
         snap.str_dicts = dicts
-        snap.delta_cursor = dict(token[0])   # host -> version at build
+        # host -> engine write-version at build (the per-host token
+        # element is (write_version, leader_sig); the change-ring
+        # cursor wants the bare version)
+        snap.delta_cursor = {h: (v[0] if isinstance(v, tuple) else v)
+                             for h, v in token[0]}
         return snap
 
     def changes_since(self, space_id: int, cursor):

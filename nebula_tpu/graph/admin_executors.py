@@ -179,17 +179,41 @@ def execute_show(ctx: ExecContext, s: ast.ShowSentence) -> Result:
         return _ok(InterimResult(["ID", "Name"],
                                  [(i, n) for n, i in sorted(items)]))
     if k == ast.ShowKind.HOSTS:
-        rows = []
-        for info, alive in ctx.meta.all_hosts():
-            rows.append((info.host, "online" if alive else "offline"))
-        return _ok(InterimResult(["Ip:Port", "Status"], rows))
+        # leader/partition distribution columns from the heartbeat-fed
+        # leader view (ref ListHostsProcessor output shape); falls back
+        # to the two-column form against a meta without the overview
+        def _dist(d):
+            return ", ".join(f"{n}: {c}" for n, c in sorted(d.items())) \
+                or "No valid partition"
+        try:
+            overview = ctx.meta.hosts_overview()
+        except Exception:
+            overview = None
+        if overview is None:
+            rows = [(info.host, "online" if alive else "offline")
+                    for info, alive in ctx.meta.all_hosts()]
+            return _ok(InterimResult(["Ip:Port", "Status"], rows))
+        rows = [(h["host"], h["status"], h["leader_count"],
+                 _dist(h["leader_dist"]), _dist(h["part_dist"]))
+                for h in overview]
+        return _ok(InterimResult(
+            ["Ip:Port", "Status", "Leader count", "Leader distribution",
+             "Partition distribution"], rows))
     if k == ast.ShowKind.PARTS:
         st = ctx.require_space()
         if not st.ok():
             return StatusOr.from_status(st)
-        alloc = ctx.meta.get_parts_alloc(ctx.space_id())
-        rows = [(pid, ", ".join(hosts)) for pid, hosts in sorted(alloc.items())]
-        return _ok(InterimResult(["Partition ID", "Peers"], rows))
+        try:
+            parts = ctx.meta.parts_overview(ctx.space_id())
+            rows = [(pid, leader, ", ".join(hosts), ", ".join(losts))
+                    for pid, leader, hosts, losts in parts]
+            return _ok(InterimResult(
+                ["Partition ID", "Leader", "Peers", "Losts"], rows))
+        except Exception:
+            alloc = ctx.meta.get_parts_alloc(ctx.space_id())
+            rows = [(pid, ", ".join(hosts))
+                    for pid, hosts in sorted(alloc.items())]
+            return _ok(InterimResult(["Partition ID", "Peers"], rows))
     if k == ast.ShowKind.USERS:
         return _ok(InterimResult(["User"],
                                  [(u,) for u in ctx.meta.list_users()]))

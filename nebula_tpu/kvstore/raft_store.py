@@ -43,7 +43,7 @@ class RaftConsensusHook(ConsensusHook):
     def __init__(self, space_id: int, part_id: int, engine: KVEngine,
                  addr: str, peers: List[str], wal_root: str,
                  service: RaftexService, is_learner: bool = False,
-                 leader_hint=None, **raft_kw):
+                 leader_hint=None, on_leader_change=None, **raft_kw):
         self._space_id = space_id
         self._part_id = part_id
         self._engine = engine
@@ -56,6 +56,10 @@ class RaftConsensusHook(ConsensusHook):
         # redirect to (the storage RPC addr; identity for in-proc tests
         # whose raft addrs ARE the client addrs)
         self._leader_hint = leader_hint or (lambda a: a)
+        # on_leader_change(space, part, new_leader_raft_addr|None) —
+        # called off the raft lock path; storaged counts the event and
+        # reconciles membership when this replica takes over
+        self._on_leader_change = on_leader_change
         self._raft_kw = raft_kw
         self.raft: Optional[RaftPart] = None
 
@@ -68,6 +72,19 @@ class RaftConsensusHook(ConsensusHook):
 
         wal_dir = os.path.join(
             self._wal_root, f"s{self._space_id}_p{self._part_id}")
+        on_lc = None
+        if self._on_leader_change is not None:
+            cb, sid, pid = self._on_leader_change, self._space_id, \
+                self._part_id
+
+            def on_lc(leader, _cb=cb, _sid=sid, _pid=pid):
+                # RaftPart fires this under its lock — hand off to a
+                # thread so the callback may call back into raft
+                # (membership reconcile) without deadlocking
+                import threading as _t
+                _t.Thread(target=_cb, args=(_sid, _pid, leader),
+                          daemon=True,
+                          name=f"raft-lc-{_sid}-{_pid}").start()
         self.raft = RaftPart(
             space_id=self._space_id, part_id=self._part_id,
             addr=self._addr, peers=self._peers, wal_dir=wal_dir,
@@ -78,6 +95,7 @@ class RaftConsensusHook(ConsensusHook):
             snapshot_rows=snapshot_rows,
             applied_id=part.last_committed_log_id,
             is_learner=self._is_learner,
+            on_leader_change=on_lc,
             **self._raft_kw)
         self.raft.start()
 
@@ -123,7 +141,8 @@ class StorageNode:
     (ref storage/StorageServer.cpp boot + AdminProcessor surface)."""
 
     def __init__(self, addr: str, data_root: str, net: InProcNetwork,
-                 engine_factory=None, leader_hint=None, **raft_kw):
+                 engine_factory=None, leader_hint=None,
+                 on_leader_change=None, **raft_kw):
         self.addr = addr
         self.data_root = data_root
         self.service = RaftexService(addr, net)
@@ -138,7 +157,8 @@ class StorageNode:
                 space_id, part_id, engine, addr, peers,
                 os.path.join(data_root, addr.replace(":", "_")),
                 self.service, is_learner=learner,
-                leader_hint=leader_hint, **raft_kw)
+                leader_hint=leader_hint,
+                on_leader_change=on_leader_change, **raft_kw)
             self.hooks[(space_id, part_id)] = hook
             return hook
 
@@ -166,6 +186,25 @@ class StorageNode:
     def raft(self, space_id: int, part_id: int) -> Optional[RaftPart]:
         h = self.hooks.get((space_id, part_id))
         return h.raft if h else None
+
+    def raft_status(self) -> List[dict]:
+        """Every local part's raft state (role/term/commit-lag/peers) —
+        the storaged /raft endpoint + Prometheus source."""
+        out = []
+        for key in sorted(self.hooks):
+            h = self.hooks.get(key)
+            if h is not None and h.raft is not None:
+                out.append(h.raft.status())
+        return out
+
+    def leader_parts(self) -> Dict[int, List[int]]:
+        """{space_id: [parts this node currently leads]} — the
+        heartbeat-carried leader view metad aggregates."""
+        out: Dict[int, List[int]] = {}
+        for (sid, pid), h in list(self.hooks.items()):
+            if h.is_leader():
+                out.setdefault(sid, []).append(pid)
+        return {s: sorted(ps) for s, ps in out.items()}
 
     def stop(self) -> None:
         for h in list(self.hooks.values()):

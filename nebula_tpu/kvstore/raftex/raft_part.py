@@ -695,5 +695,9 @@ class RaftPart:
                 "term": self.term, "leader": self.leader_addr,
                 "committed": self.committed_id,
                 "last_log_id": self.wal.last_log_id,
+                # appended-but-uncommitted depth: >0 sustained on a
+                # leader means replication is stuck below quorum
+                "commit_lag": max(0, self.wal.last_log_id
+                                  - self.committed_id),
                 "peers": list(self.peers), "learners": list(self.learners),
             }

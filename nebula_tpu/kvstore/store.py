@@ -81,6 +81,17 @@ class GraphStore:
         info = self._spaces.get(space_id)
         return sorted(info.parts) if info else []
 
+    def leader_parts(self, space_id: int) -> List[int]:
+        """Parts of the space this node currently LEADS (every part for
+        unreplicated DirectCommit nodes). Folded into the freshness
+        token so a deposed replica's version channel stops vouching for
+        parts it no longer serves authoritatively."""
+        info = self._spaces.get(space_id)
+        if info is None:
+            return []
+        return sorted(pid for pid, p in list(info.parts.items())
+                      if p.is_leader())
+
     def close(self) -> None:
         """Close every space engine (flushing what they buffer) — the
         daemon's orderly-shutdown path."""

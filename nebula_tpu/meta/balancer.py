@@ -192,6 +192,24 @@ class Balancer:
             rows.append(t.as_row())
         return rows
 
+    def progress(self) -> Dict:
+        """Latest plan's task counts by FSM status + liveness — the
+        observability shape surfaced in graphd /tpu_stats and metad
+        /metrics (docs/manual/12-replication.md)."""
+        by_plan: Dict[int, Dict[str, int]] = {}
+        for k, v in self.meta._scan(mk.balance_prefix()):
+            t = _task_from_kv(k, v)
+            by_plan.setdefault(t.plan_id, {})
+            by_plan[t.plan_id][t.status] = \
+                by_plan[t.plan_id].get(t.status, 0) + 1
+        if not by_plan:
+            return {"plan": 0, "running": False, "tasks": {}}
+        latest = max(by_plan)
+        with self._lock:
+            running = self._thread is not None and self._thread.is_alive()
+        return {"plan": latest, "running": running,
+                "tasks": by_plan[latest]}
+
     def stop(self) -> Status:
         with self._lock:
             if self._thread is None or not self._thread.is_alive():

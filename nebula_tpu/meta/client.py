@@ -43,6 +43,10 @@ class MetaClient:
         # cluster id — the daemon must stop serving (the reference
         # aborts the process, HBProcessor clusterId check)
         self.on_wrong_cluster: Optional[Callable[[], None]] = None
+        # optional {space_id: [parts led]} provider: storaged wires its
+        # raft leadership here so every heartbeat refreshes metad's
+        # ActiveHostsMan leader view (SHOW HOSTS/PARTS leader columns)
+        self.leader_source: Optional[Callable[[], Dict[int, List[int]]]] = None
         self._listeners: List[Callable] = []
         self._known_parts: Dict[int, Set[int]] = {}  # space -> my part ids
         self._known_spaces: Dict[int, object] = {}
@@ -129,8 +133,15 @@ class MetaClient:
                 if not cluster_id:
                     cluster_id = self._rpc.get_cluster_id()
                     self._store_cluster_id(cluster_id)
+                lp = None
+                if self.leader_source is not None:
+                    try:
+                        lp = self.leader_source()
+                    except Exception:
+                        lp = None
                 st = self._rpc.heartbeat(self.local_addr, self.role,
-                                         cluster_id=cluster_id)
+                                         cluster_id=cluster_id,
+                                         leader_parts=lp)
                 if st is not None and not st.ok() and \
                         st.code == ErrorCode.E_WRONG_CLUSTER:
                     # the reference daemon aborts on mismatch; as a
@@ -174,6 +185,7 @@ class MetaClient:
         spaces = {d.space_id: d for d in self._rpc.list_spaces()}
         for sid, desc in spaces.items():
             alloc: Dict[int, List[str]] = self._rpc.get_parts_alloc(sid)
+            prev = self._alloc.get(sid) or {}
             self._alloc[sid] = alloc
             mine = {p for p, hosts in alloc.items()
                     if not self.local_addr or self.local_addr in hosts
@@ -192,6 +204,14 @@ class MetaClient:
                 if removed:
                     self._notify("parts_removed", space_id=sid,
                                  parts=sorted(removed))
+                # replica-set changes on parts we keep hosting: the
+                # raft leader reconciles its membership against the
+                # meta allocation (a reconcile/balance added a host)
+                changed = {p: list(alloc[p]) for p in (mine & known)
+                           if p in prev and prev.get(p) != alloc.get(p)}
+                if changed:
+                    self._notify("peers_changed", space_id=sid,
+                                 parts=changed)
                 self._known_parts[sid] = mine
         for sid in list(self._known_parts):
             if sid not in spaces:

@@ -70,6 +70,17 @@ class MetaService:
         self._listeners: List[Any] = []  # MetaChangedListener callbacks
         # bumped on every catalog mutation; lets SchemaManager cache safely
         self.catalog_version = 0
+        # heartbeat-fed raft leadership: host -> {space_id: [parts led]}
+        # (the ActiveHostsMan leader view; feeds SHOW HOSTS / SHOW PARTS
+        # leader columns and the balancer's placement decisions)
+        self._leader_view: Dict[str, Dict[int, List[int]]] = {}
+        # replica-reconcile gating: the full catalog sweep runs only
+        # for a host's FIRST heartbeat or while a space is known to be
+        # under-replicated — not on every beat of every host (the
+        # heartbeat handler is the liveness failure detector; it must
+        # stay O(1) in the steady state)
+        self._hosts_seen: set = set()
+        self._needs_reconcile = True   # catalog may predate this boot
         # ClusterIdMan (ref: meta/ClusterIdMan.h + MetaDaemon.cpp:102-125):
         # generated once, persisted in the meta KV; clients echo it in
         # heartbeats so a daemon can't join the wrong cluster
@@ -128,31 +139,43 @@ class MetaService:
         if partition_num < 1 or replica_factor < 1:
             return StatusOr.err(ErrorCode.E_INVALID_ARGUMENT,
                                 "partition_num and replica_factor must be >= 1")
+        # fewer live hosts than replica_factor is fine (reconcile tops
+        # up as hosts join) but an absurd factor is a typo, not a plan:
+        # raft quorums beyond 7 voters only slow commits down
+        if replica_factor > 7:
+            return StatusOr.err(ErrorCode.E_INVALID_ARGUMENT,
+                                f"replica_factor {replica_factor} > 7 "
+                                f"(raft practicality cap)")
         existing = self._get(mk.space_name_key(name))
         if existing is not None:
             if if_not_exists:
                 return StatusOr.of(mk.unpack_u32(existing))
             return StatusOr.err(ErrorCode.E_EXISTED, f"space {name!r} exists")
         hosts = [h.host for h in self.active_hosts()]
-        if replica_factor > max(1, len(hosts)) and hosts:
-            return StatusOr.err(ErrorCode.E_INVALID_ARGUMENT,
-                                f"replica_factor {replica_factor} > {len(hosts)} hosts")
         space_id = self._next_id("space")
         desc = SpaceDesc(space_id, name, partition_num, replica_factor)
         kvs = [(mk.space_key(space_id), desc.to_json()),
                (mk.space_name_key(name), mk.pack_u32(space_id))]
         # round-robin part allocation over active hosts (ref: CreateSpace
-        # processor allocating partition_num x replica_factor round-robin)
+        # processor allocating partition_num x replica_factor round-robin).
+        # Fewer live hosts than replica_factor is NOT an error: the
+        # allocation starts under-replicated and the heartbeat-driven
+        # reconcile (_reconcile_replicas) tops each part up to
+        # replica_factor as storageds join — CREATE SPACE ...
+        # replica_factor=N works end-to-end regardless of boot order
+        # (docs/manual/12-replication.md).
         for part in range(1, partition_num + 1):
             if hosts:
                 assigned = [hosts[(part - 1 + r) % len(hosts)]
-                            for r in range(replica_factor)]
+                            for r in range(min(replica_factor, len(hosts)))]
             else:
                 assigned = ["local"]
             kvs.append((mk.part_key(space_id, part), json.dumps(assigned).encode()))
         st = self._put(*kvs)
         if not st.ok():
             return StatusOr.from_status(st)
+        if len(hosts) < replica_factor:
+            self._needs_reconcile = True   # top up as hosts join
         self.catalog_version += 1
         self._notify("space_added", space_id=space_id, desc=desc)
         return StatusOr.of(space_id)
@@ -566,7 +589,7 @@ class MetaService:
         return self.cluster_id
 
     def heartbeat(self, host: str, role: str = "storage",
-                  cluster_id: int = 0) -> Status:
+                  cluster_id: int = 0, leader_parts=None) -> Status:
         # cluster_id 0 = first contact (client hasn't learned it yet);
         # a non-zero mismatch is a daemon from another cluster (ref:
         # HBProcessor clusterId check)
@@ -574,7 +597,53 @@ class MetaService:
             return Status.error(ErrorCode.E_WRONG_CLUSTER,
                                 f"wrong cluster id {cluster_id}")
         info = HostInfo(host, time.time(), role)
-        return self._put((mk.host_key(host), info.to_json()))
+        st = self._put((mk.host_key(host), info.to_json()))
+        if leader_parts is not None:
+            # heartbeat-carried raft leadership ({space_id: [part...]}),
+            # the ActiveHostsMan leader view (ref meta/ActiveHostsMan.h
+            # leader_parts_): in-memory — it refreshes within one
+            # heartbeat after a metad restart
+            self._leader_view[host] = {
+                int(s): sorted(int(p) for p in ps)
+                for s, ps in dict(leader_parts).items()}
+        if st.ok() and role == "storage":
+            new_host = host not in self._hosts_seen
+            self._hosts_seen.add(host)
+            if new_host or self._needs_reconcile:
+                self._reconcile_replicas(host)
+        return st
+
+    def _reconcile_replicas(self, host: str) -> None:
+        """Validate part allocation against the live host set when a
+        storage host is first seen (or while a space is known
+        under-replicated): a part allocated below its space's
+        replica_factor (hosts were missing at CREATE SPACE, or the
+        placeholder 'local' allocation predates any registration) is
+        topped up with the heartbeating host. The raft side follows
+        through the topology watch: the new host materializes the part
+        (as a learner when it joins an existing group) and the current
+        leader adds it as a peer (daemons/storaged.py). Only ADDITIONS
+        happen here — evacuating dead hosts stays the balancer's job."""
+        still_short = False
+        for desc in self.list_spaces():
+            alloc = self.get_parts_alloc(desc.space_id)
+            changed = False
+            for part, hosts in alloc.items():
+                cur = [h for h in hosts if h != "local"]
+                if host not in cur and len(cur) < desc.replica_factor:
+                    cur = cur + [host]
+                    self.update_part_alloc(desc.space_id, part, cur)
+                    changed = True
+                if len(cur) < desc.replica_factor:
+                    still_short = True   # needs yet another host
+            if changed:
+                self.catalog_version += 1
+                self._notify("parts_realloc", space_id=desc.space_id)
+        # while any space stays under-replicated, keep sweeping on
+        # every beat (another ALREADY-KNOWN host may re-enter the
+        # liveness horizon and fill the gap); in the steady state the
+        # flag is False and heartbeats stay O(1)
+        self._needs_reconcile = still_short
 
     def active_hosts(self, role: str = "storage") -> List[HostInfo]:
         now = time.time()
@@ -592,6 +661,57 @@ class MetaService:
             info = HostInfo.from_json(v)
             out.append((info, now - info.last_hb < self._expired_threshold))
         return out
+
+    # ------------------------------------------------------------------
+    # cluster overview (SHOW HOSTS / SHOW PARTS data; ref: the
+    # ListHostsProcessor joining ActiveHostsMan liveness, the leader
+    # view and the part allocation into one table)
+    # ------------------------------------------------------------------
+    def hosts_overview(self) -> List[Dict[str, Any]]:
+        """Per-host liveness + leader/partition distribution rows."""
+        spaces = self.list_spaces()
+        name_of = {d.space_id: d.name for d in spaces}
+        allocs = {d.space_id: self.get_parts_alloc(d.space_id)
+                  for d in spaces}
+        out = []
+        for info, alive in self.all_hosts():
+            if info.role != "storage":
+                continue
+            led = self._leader_view.get(info.host, {}) if alive else {}
+            leader_dist = {name_of[s]: len(ps) for s, ps in led.items()
+                           if s in name_of and ps}
+            part_dist = {}
+            for sid, alloc in allocs.items():
+                n = sum(1 for hosts in alloc.values()
+                        if info.host in hosts)
+                if n:
+                    part_dist[name_of[sid]] = n
+            out.append({"host": info.host,
+                        "status": "online" if alive else "offline",
+                        "leader_count": sum(leader_dist.values()),
+                        "leader_dist": leader_dist,
+                        "part_dist": part_dist})
+        return out
+
+    def parts_overview(self, space_id: int) -> List[List]:
+        """[part, leader, peers, losts] per part: leader from the
+        heartbeat-carried view (validated against the allocation),
+        losts = allocated hosts outside the liveness horizon."""
+        alive = {h.host for h in self.active_hosts()}
+        leader_of: Dict[int, str] = {}
+        for host, by_space in self._leader_view.items():
+            if host not in alive:
+                continue
+            for p in by_space.get(space_id, []):
+                leader_of[p] = host
+        rows = []
+        for part, hosts in sorted(self.get_parts_alloc(space_id).items()):
+            leader = leader_of.get(part, "")
+            if leader and leader not in hosts:
+                leader = ""          # stale heartbeat from a moved part
+            losts = [h for h in hosts if h != "local" and h not in alive]
+            rows.append([part, leader, list(hosts), losts])
+        return rows
 
     # ------------------------------------------------------------------
     # balancer facade (ref: BalanceProcessor — BALANCE statements reach
@@ -625,6 +745,14 @@ class MetaService:
     def balance_show(self, plan_id: Optional[int] = None) -> List[List]:
         b = self._bal()
         return [] if b is None else b.show_plan(plan_id)
+
+    def balance_progress(self) -> Dict[str, Any]:
+        """Latest plan's task-FSM progress (observability surface:
+        graphd /tpu_stats cluster block + metad /metrics)."""
+        b = self._bal()
+        if b is None:
+            return {"plan": 0, "running": False, "tasks": {}}
+        return b.progress()
 
     def balance_stop(self) -> Status:
         b = self._bal()

@@ -107,13 +107,16 @@ class StorageClient:
         return self._pool.submit(fn, *args)
 
     def _fanout(self, space_id: int, parts: Dict[int, Any], call, empty_resp,
-                merge, max_retries: int = 3) -> Any:
+                merge, max_retries: int = 5) -> Any:
         """Scatter per leader host, gather with leader-cache fixups and
         redirect retries (ref: collectResponse + StorageClient.inl:119-134
-        leader-cache update on E_LEADER_CHANGED)."""
+        leader-cache update on E_LEADER_CHANGED). Hintless rounds (an
+        election in flight, a dead host) back off with bounded jitter —
+        the retry budget must outlast one raft election, so a replica
+        kill mid-soak surfaces as latency, never as a client error."""
         resp = empty_resp
         pending = parts
-        for _ in range(max_retries + 1):
+        for attempt in range(max_retries + 1):
             by_host = self._group_by_host(space_id, pending)
             tried = {part: host for host, hp in by_host.items() for part in hp}
             futures = []
@@ -140,6 +143,7 @@ class StorageClient:
             hosts_list = list(self._hosts)
             saw_hintless = False
             saw_no_part = False
+            redirected: list = []
             space_known = None  # one catalog probe per round, lazily
             for part in dead_parts:
                 if part not in parts:
@@ -151,6 +155,7 @@ class StorageClient:
                 pending[part] = parts[part]
             for part, result in round_resp.results.items():
                 if result.code == ErrorCode.E_LEADER_CHANGED and part in parts:
+                    redirected.append(part)
                     if result.leader:
                         self._note_leader(space_id, part, result.leader)
                     else:
@@ -175,14 +180,35 @@ class StorageClient:
                         # allocation
                         self._leader_cache.pop((space_id, part), None)
                         pending[part] = parts[part]
+            if redirected:
+                # a leader moved under this query — visible in its trace
+                # (the cluster-observability satellite: elections and
+                # rebalances tag the traces they touched)
+                tracer.tag_root("leader_changed",
+                                f"s{space_id}:" + ",".join(
+                                    f"p{p}" for p in sorted(redirected)))
             if not pending:
                 break
+            from ..common.faults import jittered_delay
+            left = attempt < max_retries
             if saw_no_part:
+                self._count_fanout_retry("no_part", left)
                 if self._refresh_hosts is not None:
                     self._refresh_hosts()
                 time.sleep(0.2)
             elif saw_hintless:
-                time.sleep(0.05)   # election likely in progress
+                # election in progress / dead host: bounded expo jitter
+                # (same policy as _kv_retry) — the cumulative budget
+                # spans an election instead of burning retries in 150ms
+                self._count_fanout_retry("hintless", left)
+                if left:
+                    time.sleep(jittered_delay(*self.KV_BACKOFF["hintless"],
+                                              attempt))
+            else:
+                self._count_fanout_retry("leader_moved", left)
+                if left:
+                    time.sleep(jittered_delay(
+                        *self.KV_BACKOFF["leader_moved"], attempt))
         # parts still unreachable after every retry must surface as
         # errors — a missing entry would read as success to executors
         for part in pending:
@@ -416,6 +442,17 @@ class StorageClient:
     KV_BACKOFF = {"leader_moved": (0.005, 0.1), "hintless": (0.05, 0.8),
                   "no_part": (0.1, 1.6)}
 
+    def _count_fanout_retry(self, cls_key: str, retries_left: bool) -> None:
+        """Fan-out retry rounds share _kv_retry's counters, so election
+        waits and leader redirects are visible per classification in
+        /tpu_stats + Prometheus whichever path hit them."""
+        self.retry_stats[cls_key] += 1
+        stats.add_value("storage_client.fanout_retry." + cls_key,
+                        kind="counter")
+        if not retries_left:
+            stats.add_value("storage_client.fanout_exhausted",
+                            kind="counter")
+
     def _kv_backoff(self, cls_key: str, attempt: int,
                     retries_left: bool) -> None:
         from ..common.faults import jittered_delay
@@ -529,7 +566,9 @@ class StorageClient:
             return None
         try:                                # ...and prime synchronously
             self.version_stats["probe_rpcs"] += 1
-            return int(svc.space_version(space_id))
+            # (write_version, leader_sig) tuple — or -1 for no engine;
+            # opaque here, the token only ever compares by equality
+            return svc.space_version(space_id)
         except Exception:
             return None
 
@@ -585,6 +624,16 @@ class StorageClient:
         if svc is None:
             raise KeyError(host)
         return svc.changes_since(space_id, since)
+
+    def routing_stats(self) -> Dict[str, Any]:
+        """Routing/retry state for observability surfaces (graphd
+        /tpu_stats cluster block, soak debug bundle) — the one place
+        that reads the internals, so the surfaces can't diverge."""
+        return {
+            "leader_cache_size": len(self._leader_cache),
+            "retries": dict(self.retry_stats),
+            "version_watch": dict(self.version_stats),
+        }
 
     def note_local_write(self, space_id: int) -> None:
         """Every mutation through this client bumps the space's local

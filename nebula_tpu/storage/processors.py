@@ -801,20 +801,29 @@ class StorageService:
     # the storage-service seam the north star designates as the engine
     # plugin boundary; ref storage/StorageServer.cpp:32-55)
     # ------------------------------------------------------------------
-    def space_version(self, space_id: int) -> int:
-        """Monotonic write-version of this host's engine for the space
-        (-1 when the space has no local engine). Any data change bumps
-        it; the TPU engine's freshness token aggregates these across
-        hosts."""
+    def space_version(self, space_id: int):
+        """Freshness element for this host × space: (engine
+        write-version, leadership signature) — or -1 when the space has
+        no local engine. The write-version moves on any data change;
+        the signature (the sorted part ids this node LEADS) moves on
+        election/deposal/rebalance, so a graphd's device snapshot keyed
+        on the old value structurally invalidates the moment this host
+        stops being authoritative for a part — the version-watch +
+        change ring follow the partition's CURRENT leader instead of a
+        deposed replica's stale ring (docs/manual/12-replication.md)."""
         engine = self.store.space_engine(space_id)
-        return -1 if engine is None else int(engine.write_version)
+        if engine is None:
+            return -1
+        return (int(engine.write_version),
+                tuple(self.store.leader_parts(space_id)))
 
-    def _version_map(self) -> Dict[int, int]:
-        out: Dict[int, int] = {}
+    def _version_map(self) -> Dict[int, Tuple[int, tuple]]:
+        out: Dict[int, Tuple[int, tuple]] = {}
         for sid in self.store.spaces():
             engine = self.store.space_engine(sid)
             if engine is not None:
-                out[sid] = int(engine.write_version)
+                out[sid] = (int(engine.write_version),
+                            tuple(self.store.leader_parts(sid)))
         return out
 
     def watch_space_versions(self, known: Optional[Dict[int, int]] = None,

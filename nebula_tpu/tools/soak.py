@@ -73,6 +73,10 @@ def _debug_bundle(cluster, tpu, extra: dict,
             "slow": cluster.service.slow_log.snapshot(),
         },
         "robustness": tpu.robustness_stats(),
+        # routing state at failure time: a divergence that rode a
+        # leader change / election shows up here as non-zero retry
+        # classifications (docs/manual/12-replication.md)
+        "cluster": cluster.client.routing_stats(),
     }
     with tpu._lock:
         out["tpu_stats"] = dict(tpu.stats)

@@ -4,12 +4,18 @@ scripts/services.sh + systemd units — start/stop/status/restart the
 three daemons with pidfiles).
 
     python scripts/services.py start   [--storaged-count 2] [--tpu]
+    python scripts/services.py start --cluster    # 3x replicated storaged
     python scripts/services.py status
     python scripts/services.py stop
     python scripts/services.py restart
 
 Ports: metad 45500, storaged 44500+i, graphd 3699. Pidfiles and logs
-live under --run-dir (default /tmp/nebula_tpu_cluster)."""
+live under --run-dir (default /tmp/nebula_tpu_cluster); each storaged
+gets its own data dir under <run-dir>/data/storaged<i> so WALs and
+engines survive restarts independently. `--cluster` is the replicated
+topology shorthand: 3 storaged with raft on port+1 (storaged ports
+spaced by 10), replica_factor=3 spaces survive one host loss
+(docs/manual/12-replication.md)."""
 from __future__ import annotations
 
 import argparse
@@ -80,7 +86,13 @@ def start(args) -> int:
         if pid0 and _alive(pid0):
             print(f"{name} already running")
             continue
-        extra_s = ["--replicated"] if args.replicated else []
+        data_dir = os.path.join(args.run_dir, "data", name)
+        os.makedirs(data_dir, exist_ok=True)
+        extra_s = ["--data-dir", data_dir,
+                   "--cluster-id-file",
+                   os.path.join(data_dir, "cluster.id")]
+        if args.replicated:
+            extra_s.append("--replicated")
         pid = _spawn(args.run_dir, name, "nebula_tpu.daemons.storaged",
                      ["--meta", meta_addr, "--host", args.host,
                       "--port", str(args.storaged_port +
@@ -163,7 +175,15 @@ def main(argv=None) -> int:
     ap.add_argument("--replicated", action="store_true",
                     help="raft-replicate storaged parts (raft on port+1; "
                          "storaged ports are spaced by 10)")
+    ap.add_argument("--cluster", action="store_true",
+                    help="replicated 3-storaged topology shorthand "
+                         "(= --replicated --storaged-count 3): "
+                         "replica_factor=3 spaces survive one host "
+                         "loss; BALANCE DATA moves parts online")
     args = ap.parse_args(argv)
+    if args.cluster:
+        args.replicated = True
+        args.storaged_count = max(args.storaged_count, 3)
     if args.action == "start":
         return start(args)
     if args.action == "status":

@@ -13,6 +13,8 @@ import time
 from typing import Dict, List, Optional, Tuple
 
 from nebula_tpu.kvstore.raftex import (InProcNetwork, RaftPart, RaftexService)
+from nebula_tpu.kvstore.raftex.service import (RpcTransport,
+                                               _unreachable_response)
 
 FAST = dict(heartbeat_interval=0.06, election_timeout=0.2, rpc_timeout=0.5)
 
@@ -133,4 +135,82 @@ class RaftCluster:
             part.stop()
         for svc in list(self.services.values()):
             svc.stop()
+        self.net.shutdown()
+
+
+class FilteredRpcTransport(RpcTransport):
+    """RpcTransport with a partition switch: messages from OR to an
+    isolated address are dropped before the socket — a two-way network
+    partition over the real TCP raft transport (the production path,
+    storaged --replicated), controllable like InProcNetwork.isolate."""
+
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.isolated: set = set()
+
+    def call(self, from_addr: str, to_addr: str, method: str, req):
+        if from_addr in self.isolated or to_addr in self.isolated:
+            from concurrent.futures import Future
+            f = Future()
+            f.set_result(_unreachable_response(method))
+            return f
+        return super().call(from_addr, to_addr, method, req)
+
+
+class RpcRaftCluster:
+    """N real raft services over framed-TCP rpc/ servers — the
+    raftex-over-rpc production shape (RaftexService registered as
+    "raftex" on a real socket, peers dialed by host:port), with
+    partition injection via the shared FilteredRpcTransport."""
+
+    def __init__(self, n: int, tmp_path, **kw):
+        from nebula_tpu.rpc import RpcServer
+
+        self.net = FilteredRpcTransport()
+        self.kw = {**FAST, **kw}
+        self.tmp = tmp_path
+        self.servers: Dict[str, "RpcServer"] = {}
+        self.services: Dict[str, RaftexService] = {}
+        self.parts: Dict[str, RaftPart] = {}
+        self.shards: Dict[str, TestShard] = {}
+        servers = [RpcServer("127.0.0.1", 0) for _ in range(n)]
+        self.addrs = [s.addr for s in servers]
+        for addr, server in zip(self.addrs, servers):
+            svc = RaftexService(addr, self.net)
+            server.register("raftex", svc).start()
+            shard = TestShard()
+            part = RaftPart(
+                space_id=1, part_id=1, addr=addr,
+                peers=list(self.addrs),
+                wal_dir=str(tmp_path / addr.replace(":", "_")),
+                service=svc, on_commit=shard.on_commit,
+                on_snapshot=shard.on_snapshot,
+                snapshot_rows=lambda s=shard: [
+                    (b"k%d" % i, d) for i, d in enumerate(s.data())],
+                **self.kw)
+            part.start()
+            self.servers[addr] = server
+            self.services[addr] = svc
+            self.parts[addr] = part
+            self.shards[addr] = shard
+
+    # same helper surface as RaftCluster ------------------------------
+    wait_leader = RaftCluster.wait_leader
+    wait_commit = RaftCluster.wait_commit
+
+    @property
+    def voting(self):
+        return self.addrs
+
+    def isolate(self, addr: str) -> None:
+        self.net.isolated.add(addr)
+
+    def heal(self, addr: str) -> None:
+        self.net.isolated.discard(addr)
+
+    def stop(self) -> None:
+        for part in list(self.parts.values()):
+            part.stop()
+        for server in self.servers.values():
+            server.stop()
         self.net.shutdown()

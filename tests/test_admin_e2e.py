@@ -129,11 +129,13 @@ def test_show_hosts_and_parts(conn):
     meta = conn._service.engine.meta
     meta.heartbeat("127.0.0.1:44500")
     r = conn.must("SHOW HOSTS")
-    assert ("127.0.0.1:44500", "online") in r.rows
+    assert r.columns[:3] == ["Ip:Port", "Status", "Leader count"]
+    assert ("127.0.0.1:44500", "online") in {row[:2] for row in r.rows}
     conn.must("CREATE SPACE sp(partition_num=2, replica_factor=1)")
     conn.must("USE sp")
     r = conn.must("SHOW PARTS")
     assert len(r.rows) == 2
+    assert r.columns == ["Partition ID", "Leader", "Peers", "Losts"]
 
 
 def test_drop_user_exact_role_match():

@@ -146,3 +146,91 @@ def test_active_hosts_expiry():
     # all_hosts reports liveness flags
     flags = {h.host: alive for h, alive in meta.all_hosts()}
     assert flags == {"h1": False, "h2": True}
+
+
+def test_balancer_resumes_after_metad_restart(tmp_path):
+    """Satellite (ISSUE 6): a BalancePlan persisted in the meta KV
+    survives the balancer-owning metad dying mid-flight — a fresh
+    metad on the same store resumes the SAME plan (Balancer::recovery,
+    ref Balancer.cpp:67-106), skips the already-terminal task, and
+    drives the remaining tasks to SUCCEEDED over the storaged admin
+    services."""
+    import socket
+
+    from nebula_tpu.client import GraphClient
+    from nebula_tpu.daemons import (serve_graphd, serve_metad,
+                                    serve_storaged)
+    from nebula_tpu.kvstore.store import GraphStore
+    from nebula_tpu.meta.balancer import ST_START, BalanceTask
+
+    store = GraphStore()            # the "disk" both metad boots share
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    metad = serve_metad(port=port, store=store)
+    s0 = serve_storaged(metad.addr, replicated=True,
+                        data_dir=str(tmp_path / "s0"), load_interval=0.1)
+    s1 = serve_storaged(metad.addr, replicated=True,
+                        data_dir=str(tmp_path / "s1"), load_interval=0.1)
+    graphd = serve_graphd(metad.addr)
+    gc = GraphClient(graphd.addr).connect()
+    metad2 = None
+    try:
+        for stmt in ("CREATE SPACE rebal(partition_num=4, "
+                     "replica_factor=1)", "USE rebal",
+                     "CREATE TAG t(x int)"):
+            r = gc.execute(stmt)
+            assert r.ok(), (stmt, r.error_msg)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            r = gc.execute(
+                "INSERT VERTEX t(x) VALUES 1:(1), 2:(2), 3:(3), 4:(4)")
+            if r.ok():
+                break
+            time.sleep(0.2)
+        assert r.ok(), r.error_msg
+        space_id = metad.meta.get_space("rebal").value().space_id
+        alloc = metad.meta.get_parts_alloc(space_id)
+        moves = sorted(p for p, hosts in alloc.items()
+                       if hosts == [s0.addr])
+        assert len(moves) >= 2, alloc
+
+        # persist a mid-flight plan: first task already terminal (it
+        # "ran" before the crash), the rest still START
+        plan_id = metad.meta._next_id("balance_plan")
+        tasks = [BalanceTask(plan_id, space_id, p, s0.addr, s1.addr,
+                             status=ST_START) for p in moves]
+        tasks[0].status = "SUCCEEDED"
+        for t in tasks:
+            metad.meta._put((t.key(), t.value()))
+
+        # metad dies; a new one boots on the same store and port — the
+        # catalog, cluster id and the unfinished plan all persist
+        metad.stop()
+        metad2 = serve_metad(port=port, store=store)
+        r = gc.must("BALANCE DATA")
+        assert r.rows[0][0] == plan_id, \
+            "resume must drive the persisted plan, not mint a new one"
+        metad2.meta._balancer.wait(60)
+        rows = metad2.meta.balance_show(plan_id)
+        assert rows and all(row[-1] == "SUCCEEDED" for row in rows), rows
+
+        # the unfinished moves actually happened
+        alloc = metad2.meta.get_parts_alloc(space_id)
+        for p in moves[1:]:
+            assert alloc[p] == [s1.addr], (p, alloc)
+        # data reachable after the moves
+        deadline = time.time() + 10
+        while time.time() < deadline:
+            r = gc.execute("FETCH PROP ON t 1,2,3,4 YIELD t.x")
+            if r.ok() and len(r.rows) == 4:
+                break
+            time.sleep(0.25)
+        assert r.ok() and sorted(x[-1] for x in r.rows) == [1, 2, 3, 4]
+    finally:
+        gc.disconnect()
+        graphd.stop()
+        s0.stop()
+        s1.stop()
+        (metad2 or metad).stop()
