@@ -208,6 +208,15 @@ def serve_graphd(meta_addr: str, host: str = "127.0.0.1", port: int = 0,
                         **tpu_engine.cache_stats(),
                         "plan": engine.plan_cache.stats(),
                     },
+                    # fused device programs (docs/manual/13-device-
+                    # speed.md): registry hits/misses, the distinct-
+                    # signature gauge (recompile-bound contract), real
+                    # XLA cache entries, fused launches/declines
+                    "fused_programs": tpu_engine.fused_stats(),
+                    # frontier double-buffering: H2D stages, prefetch
+                    # hit/miss, kernel-overlapped transfers + the time
+                    # they had to hide, donation fallbacks
+                    "frontier_prefetch": tpu_engine.prefetch_stats(),
                     "sparse_budget_calibrations": {
                         str(k): v for k, v in
                         tpu_engine.sparse_budget_calibrations.items()},
@@ -256,6 +265,13 @@ def serve_graphd(meta_addr: str, host: str = "127.0.0.1", port: int = 0,
                         out[f"tpu_engine.cache.{rung}.{k}"] = v
                 for k, v in engine.plan_cache.stats().items():
                     out[f"graph.plan_cache.{k}"] = v
+                # fused-program + frontier-prefetch blocks as flat
+                # gauges (docs/manual/13-device-speed.md), so compile-
+                # cache behavior scrapes like the PR 5 cache rungs
+                for k, v in tpu_engine.fused_stats().items():
+                    out[f"tpu_engine.fused.{k}"] = v
+                for k, v in tpu_engine.prefetch_stats().items():
+                    out[f"tpu_engine.prefetch.{k}"] = v
                 return out
 
             web.add_metrics_source(tpu_metric_source)

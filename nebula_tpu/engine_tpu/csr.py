@@ -27,6 +27,7 @@ Layout decisions (TPU-first):
 """
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
@@ -39,6 +40,40 @@ from ..common import keys as ku
 from ..kvstore.scan import RowsBlock, ScanCols, scan_cols as _scan_cols
 
 LANE = 128
+
+# ---------------------------------------------------------------------------
+# narrow-width edge packing (docs/manual/13-device-speed.md)
+#
+# Local edge indices (edge_src / edge_dst_local, values in [0, cap_v))
+# pack to int16 when cap_v fits, and signed edge types to int8 when
+# every |etype| in the space fits — roughly halving bytes-per-edge on
+# the hop's gather streams. The widths are decided ONCE per build from
+# the caps, so every shard (and the stacked device arrays derived from
+# them) carries one consistent dtype; anything global-slot-valued
+# (gidx, src_sorted, seg boundaries, edge_dst_part) stays int32.
+# int32 fallback is preserved for spaces past either cap, and
+# NEBULA_TPU_WIDE_CSR=1 (or FORCE_WIDE_DTYPES) pins int32 everywhere —
+# the identity harness builds both and compares byte-for-byte.
+# ---------------------------------------------------------------------------
+
+FORCE_WIDE_DTYPES = os.environ.get("NEBULA_TPU_WIDE_CSR", "") == "1"
+NARROW_IDX_CAP = 1 << 15     # cap_v <= 32768 -> local indices fit int16
+NARROW_ETYPE_MAX = 127       # max |signed etype| for int8 packing
+
+
+def edge_index_dtype(cap_v: int) -> np.dtype:
+    """dtype of local-index edge arrays for a given cap_v."""
+    if FORCE_WIDE_DTYPES or cap_v > NARROW_IDX_CAP:
+        return np.dtype(np.int32)
+    return np.dtype(np.int16)
+
+
+def edge_type_dtype(max_abs_etype: int) -> np.dtype:
+    """dtype of the signed edge-type arrays given the largest |etype|
+    actually present in the scanned data (0 for an edge-free space)."""
+    if FORCE_WIDE_DTYPES or max_abs_etype > NARROW_ETYPE_MAX:
+        return np.dtype(np.int32)
+    return np.dtype(np.int8)
 
 
 def _round_up(n: int, m: int = LANE) -> int:
@@ -122,13 +157,15 @@ class CsrShard:
     part_id: int
     vids: np.ndarray                      # int64[nv] sorted; local idx -> vid
     num_edges: int
-    # edge arrays, length cap_e (padded tail invalid)
-    edge_src: np.ndarray                  # int32 local src index
-    edge_etype: np.ndarray                # int32 signed edge type
+    # edge arrays, length cap_e (padded tail invalid); local-index and
+    # etype arrays are width-packed (int16/int8 when the caps allow,
+    # int32 fallback — see edge_index_dtype/edge_type_dtype)
+    edge_src: np.ndarray                  # int16|int32 local src index
+    edge_etype: np.ndarray                # int8|int32 signed edge type
     edge_rank: np.ndarray                 # int64 (host only)
     edge_dst_vid: np.ndarray              # int64 (host only)
     edge_dst_part: np.ndarray             # int32 0-based part index
-    edge_dst_local: np.ndarray            # int32
+    edge_dst_local: np.ndarray            # int16|int32
     edge_valid: np.ndarray                # bool
     # per-(signed etype) columnar edge props (aligned to edge arrays)
     edge_props: Dict[int, Dict[str, PropColumn]] = field(default_factory=dict)
@@ -362,6 +399,17 @@ class CsrSnapshot:
         schema ids, so one code means one string everywhere."""
         return self.str_dicts.get((kind, name), {}).get(value, -1)
 
+    def dtype_widths(self) -> Dict[str, int]:
+        """Byte widths of the packed edge arrays (narrow-width packing,
+        docs/manual/13-device-speed.md) — surfaced by bench.py so the
+        modeled HBM traffic reflects what the kernels actually read."""
+        if not self.shards:
+            return {"edge_src": 4, "edge_etype": 4, "edge_dst_local": 4}
+        s = self.shards[0]
+        return {"edge_src": int(s.edge_src.dtype.itemsize),
+                "edge_etype": int(s.edge_etype.dtype.itemsize),
+                "edge_dst_local": int(s.edge_dst_local.dtype.itemsize)}
+
 
 # ---------------------------------------------------------------------------
 # builder — vectorized: the keys are fixed-width big-endian with
@@ -551,6 +599,15 @@ def build_shards(source, sm, space_id: int, num_parts: int
 
     cap_v = _round_up(max((len(v) for v in vids_per_part), default=1))
     cap_e = _round_up(max((len(ei) for _, ei, _ in edge_scans), default=1))
+    # narrow-width packing: widths decided from the caps/data BEFORE any
+    # shard allocates, so all shards stack to one consistent dtype
+    max_et = 0
+    for earr, eidx, _ in edge_scans:
+        if earr is not None and len(eidx):
+            max_et = max(max_et,
+                         int(np.abs(_unbias32(earr["etype"][eidx])).max()))
+    idx_dt = edge_index_dtype(cap_v)
+    et_dt = edge_type_dtype(max_et)
 
     def edge_schema(et: int) -> Optional[Schema]:
         r = sm.edge_schema(space_id, et)
@@ -565,12 +622,12 @@ def build_shards(source, sm, space_id: int, num_parts: int
         vids_sorted = vids_per_part[p0]
         earr, eidx, escan = edge_scans[p0]
         ne = len(eidx)
-        edge_src = np.zeros(cap_e, np.int32)
-        edge_etype = np.zeros(cap_e, np.int32)
+        edge_src = np.zeros(cap_e, idx_dt)
+        edge_etype = np.zeros(cap_e, et_dt)
         edge_rank = np.zeros(cap_e, np.int64)
         edge_dst_vid = np.zeros(cap_e, np.int64)
         edge_dst_part = np.zeros(cap_e, np.int32)
-        edge_dst_local = np.zeros(cap_e, np.int32)
+        edge_dst_local = np.zeros(cap_e, idx_dt)
         edge_valid = np.zeros(cap_e, bool)
         et = np.empty(0, np.int32)
         if ne:
@@ -640,17 +697,21 @@ def _build_shards_native(ext, sm, space_id: int, P: int
     per_part = [(ext.vids(p0), ext.edges(p0)) for p0 in range(P)]
     cap_v = _round_up(max((len(v) for v, _ in per_part), default=1))
     cap_e = _round_up(max((len(e[1]) for _, e in per_part), default=1))
+    max_et = max((int(np.abs(e[1]).max()) for _, e in per_part
+                  if len(e[1])), default=0)
+    idx_dt = edge_index_dtype(cap_v)
+    et_dt = edge_type_dtype(max_et)
     dict_registry: Dict[Tuple[str, str], Dict[str, int]] = {}
     shards: List[CsrShard] = []
     for p0 in range(P):
         vids_sorted, (src_l, et, rank, dst_v, dst_p, dst_l) = per_part[p0]
         ne = len(et)
-        edge_src = np.zeros(cap_e, np.int32)
-        edge_etype = np.zeros(cap_e, np.int32)
+        edge_src = np.zeros(cap_e, idx_dt)
+        edge_etype = np.zeros(cap_e, et_dt)
         edge_rank = np.zeros(cap_e, np.int64)
         edge_dst_vid = np.zeros(cap_e, np.int64)
         edge_dst_part = np.zeros(cap_e, np.int32)
-        edge_dst_local = np.zeros(cap_e, np.int32)
+        edge_dst_local = np.zeros(cap_e, idx_dt)
         edge_valid = np.zeros(cap_e, bool)
         if ne:
             edge_src[:ne] = src_l

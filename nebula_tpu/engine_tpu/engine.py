@@ -30,9 +30,11 @@ invalidation is a delta apply instead of a rebuild.
 """
 from __future__ import annotations
 
+import atexit
 import logging
 import threading
 import time
+from functools import partial
 from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
@@ -48,11 +50,26 @@ from ..filter.expressions import (Expression, InputPropExpr,
                                   VariablePropExpr, encode_expression)
 from ..parser import ast
 from ..storage.types import BoundResponse, EdgeData, PartResult, VertexData
-from . import materialize, traverse
+from . import fused, materialize, traverse
 from .csr import CsrSnapshot
 from .filter_compile import FilterCompiler
 
 _LOG = logging.getLogger("nebula_tpu.engine_tpu")
+
+# daemon prewarm threads issue XLA compiles; the interpreter killing
+# one mid-compile during finalization segfaults the process. atexit
+# runs BEFORE daemon threads are reaped: stop new compile launches and
+# join the stragglers (bounded) while the runtime is still whole.
+_PREWARM_SHUTDOWN = threading.Event()
+
+
+@atexit.register
+def _drain_prewarm_threads() -> None:
+    _PREWARM_SHUTDOWN.set()
+    for t in threading.enumerate():
+        if t.name.startswith("csr-prewarm-"):
+            t.join(timeout=10.0)
+
 
 DEFAULT_MAX_EDGES_PER_VERTEX = 10000
 
@@ -210,7 +227,13 @@ class TpuGraphEngine:
                       # docs/manual/11-caching.md): requests that rode
                       # a twin's lane instead of their own, and windows
                       # where at least one collapse happened
-                      "dedup_collapsed": 0, "dedup_rounds": 0}
+                      "dedup_collapsed": 0, "dedup_rounds": 0,
+                      # device-resident fused serve loop (fused.py;
+                      # docs/manual/13-device-speed.md): launches of
+                      # the fused window/aggregate programs, and
+                      # windows that mixed more distinct compiled
+                      # WHERE masks than one program fuses
+                      "fused_launches": 0, "fused_declined": 0}
         # mesh execution service (mesh_exec.py): device-served queries
         # on SHARDED snapshots, per feature — the decline matrix the
         # round-5 verdict flagged (batched windows / aggregation / ALL
@@ -283,6 +306,21 @@ class TpuGraphEngine:
         # under the engine lock, every _plan_filter caller holds it
         self.filter_plan_counters = {"hits": 0, "misses": 0,
                                      "evictions": 0, "invalidations": 0}
+        # fused-program registry (fused.py; docs/manual/13-device-
+        # speed.md): per-snapshot program dicts live on each snapshot
+        # (_fused_entry), these are the engine-lifetime counters — the
+        # signature set is the recompile-bound contract the tier-1
+        # guard asserts (tests/test_fused.py)
+        self._fused_counters = {"hits": 0, "misses": 0}
+        self._fused_signatures: set = set()
+        # guards the per-snapshot program dicts: the off-lock
+        # calibration probe and a launching leader can resolve the
+        # same signature concurrently
+        self._fused_reg_lock = threading.Lock()
+        # two-slot donated-buffer H2D staging for window frontier
+        # stacks (double-buffering: window N+1's transfer overlaps
+        # window N's kernel)
+        self.frontier_pool = fused.FrontierPool()
 
     # results bigger than this never enter the result cache (a handful
     # of supernode answers must not evict the whole working set)
@@ -300,6 +338,57 @@ class TpuGraphEngine:
                 "negative": self.negative_cache.stats(),
                 "filter_plan": dict(self.filter_plan_counters),
                 "dedupe": dedupe}
+
+    # ------------------------------------------------------------------
+    # fused device programs (fused.py; docs/manual/13-device-speed.md)
+    # ------------------------------------------------------------------
+    def _fused_entry(self, snap, sig: Tuple, make):
+        """One fused program per (snapshot, signature): the per-
+        snapshot dict next to the PR 5 compiled-filter rung binds the
+        layout statics once; the signature set + hit/miss counters
+        make recompile behavior observable (`fused_programs` in
+        /tpu_stats). Thread-safe on its own (`_fused_reg_lock`) — the
+        calibration probe resolves entries OFF the engine lock while
+        leaders resolve them inside the launch phase; make() only
+        binds statics (jit compiles at call time), so holding the
+        registry lock across it is cheap."""
+        with self._fused_reg_lock:
+            reg = getattr(snap, "_fused_programs", None)
+            if reg is None:
+                reg = snap._fused_programs = {}
+            fn = reg.get(sig)
+            miss = fn is None
+            if miss:
+                fn = reg[sig] = make()
+        with self._stats_lock:
+            if miss:
+                self._fused_counters["misses"] += 1
+                self._fused_signatures.add(sig)
+            else:
+                self._fused_counters["hits"] += 1
+        if miss:
+            global_stats.add_value("tpu_engine.fused.misses",
+                                   kind="counter")
+        return fn
+
+    def fused_stats(self) -> Dict[str, Any]:
+        """The /tpu_stats "fused_programs" block: program-registry
+        hits/misses, the distinct-signature gauge (the recompile-bound
+        contract), the REAL XLA compile-cache entry count across the
+        fused entry points, and fused launches."""
+        with self._stats_lock:
+            out: Dict[str, Any] = dict(self._fused_counters)
+            out["launches"] = self.stats["fused_launches"]
+            out["declined"] = self.stats["fused_declined"]
+        out["signatures"] = len(self._fused_signatures)
+        out["xla_cache_entries"] = fused.compile_cache_size()
+        return out
+
+    def prefetch_stats(self) -> Dict[str, int]:
+        """The /tpu_stats "frontier_prefetch" block: H2D stages,
+        prefetch hits/misses, kernel-overlapped transfers + the wall
+        time they had to hide, and donation fallbacks."""
+        return self.frontier_pool.snapshot()
 
     @property
     def sparse_edge_budget(self) -> int:
@@ -731,6 +820,8 @@ class TpuGraphEngine:
                 etypes = sorted({int(t) for s in snap.shards
                                  for t in np.unique(s.edge_etype)
                                  if t > 0}) or [1]
+                if _PREWARM_SHUTDOWN.is_set():
+                    return
                 if snap is not cur:
                     req = jnp.asarray(traverse.pad_edge_types(
                         etypes[:traverse.MAX_EDGE_TYPES_PER_QUERY]))
@@ -743,10 +834,21 @@ class TpuGraphEngine:
                     # batched lane-matrix layout for the dispatcher —
                     # built HERE (private snapshot, no lock needed)
                     # because the query path never pays the build —
-                    # plus a compile of BOTH dispatcher bucket shapes,
-                    # so production windows never hit a cold XLA
-                    # compile (20-40s on first chip contact)
+                    # plus a compile of BOTH dispatcher bucket shapes
+                    # of the FUSED window program (the entry the serve
+                    # loop actually launches) at EVERY filter arity
+                    # (unfiltered, nf=1, nf=MAX — filter_bucket admits
+                    # no others), so production windows, filtered or
+                    # not, never hit a cold XLA compile (20-40s on
+                    # first chip contact) under the launch lock. On
+                    # the host-CPU fallback backend a compile is
+                    # ~100ms, not worth tripling the warmup: filtered
+                    # variants compile on first use there
                     try:
+                        import jax
+                        nf_variants = (0,) \
+                            if jax.default_backend() == "cpu" \
+                            else (0, 1, fused.MAX_WINDOW_FILTERS)
                         snap.aligned_kernel()
                         al = snap.aligned_ready()
                         if al is not None:
@@ -754,13 +856,22 @@ class TpuGraphEngine:
                             cap = self._dispatch_cap(snap)
                             for b in sorted({min(self.SMALL_BUCKET, cap),
                                              cap}):
-                                fb = jnp.zeros(
-                                    (b, snap.num_parts, snap.cap_v),
-                                    bool)
-                                traverse.multi_hop_masks_batch(
-                                    fb, jnp.int32(2), ak_w, snap.kernel,
-                                    req, chunk=c_w, group=g_w
-                                ).block_until_ready()
+                                for nf in nf_variants:
+                                    if _PREWARM_SHUTDOWN.is_set():
+                                        return
+                                    fb = jnp.zeros(
+                                        (b, snap.num_parts, snap.cap_v),
+                                        bool)
+                                    fm = None if nf == 0 else jnp.zeros(
+                                        (nf, snap.num_parts, snap.cap_e),
+                                        bool)
+                                    fs = None if nf == 0 else jnp.full(
+                                        (b,), -1, jnp.int32)
+                                    fused.window_lane(
+                                        fb, jnp.int32(2), ak_w,
+                                        snap.kernel, req, fm, fs,
+                                        chunk=c_w, group=g_w
+                                    ).block_until_ready()
                     except Exception:
                         pass
                     # install only if still current and nothing else
@@ -1923,10 +2034,12 @@ class TpuGraphEngine:
         import jax.numpy as jnp
         from . import mesh_exec
         ak_sh, a_chunk, a_group = mesh_aligned
+        pool = self.frontier_pool
         for ci, c0 in enumerate(range(0, len(dense), cap)):
             chunk = dense[c0:c0 + cap]
             last_chunk = ci == n_chunks - 1
             launch_err = None
+            fused_sel = None
             t_win0 = time.monotonic()
             t1 = time.monotonic()
             with self._lock:
@@ -1938,20 +2051,34 @@ class TpuGraphEngine:
                         # are not precompiled by prewarm (meshed
                         # kernels compile per-query shapes), so smaller
                         # pads keep each first-seen compile cheap
-                        bucket = 1
-                        while bucket < len(chunk):
-                            bucket *= 2
-                        bucket = min(bucket, cap)
-                        stack = [f for _, f, _, _ in chunk]
-                        if bucket > len(chunk):
-                            stack.extend([np.zeros_like(stack[0])]
-                                         * (bucket - len(chunk)))
-                        f0s = jnp.asarray(np.stack(stack))
+                        bucket = self._window_bucket(len(chunk), cap,
+                                                     False)
+                        staged = pool.stage(
+                            self._stack_frontiers(chunk, bucket))
+                        f0s = staged.take()
+                        # the window's compiled WHERE masks ride the
+                        # sharded program too (one launch per chunk,
+                        # no per-request host ANDs) — same fusion plan
+                        # as the single-chip loop
+                        fmasks, fsel = \
+                            self._window_filter_plan(
+                                chunk, bucket, plan_filter_cached)
+                        fused_sel = fsel
                         t1 = time.monotonic()
                         masks = mesh_exec.multi_hop_masks_batch_sharded(
                             self.mesh, f0s, jnp.int32(steps), ak_sh,
                             snap.sharded_kernel, req_arr, a_chunk,
-                            a_group)
+                            a_group, fmasks=fmasks,
+                            fsel=None if fmasks is None
+                            else jnp.asarray(fsel))
+                        if fmasks is not None:
+                            # an UNFILTERED meshed window runs the
+                            # same program as pre-fusion — only count
+                            # launches that actually fused WHERE masks
+                            self.stats["fused_launches"] += 1
+                        # the shard_map'd window does not take the
+                        # donation (replicated operand) — expected
+                        staged.after_launch(donate_expected=False)
                     except Exception as e:
                         launch_err = e
             if redo:
@@ -1968,7 +2095,11 @@ class TpuGraphEngine:
                     # our wait
                     self._release_round(owner.key, owner)
                 try:
-                    masks_np = np.asarray(masks)   # wait OFF the lock
+                    pool.fetch_begin()
+                    try:
+                        masks_np = np.asarray(masks)   # wait OFF lock
+                    finally:
+                        pool.fetch_end()
                 except Exception as e:
                     launch_err = e
             if launch_err is not None:
@@ -1995,7 +2126,8 @@ class TpuGraphEngine:
                     if self._serve_window_request(
                             entry, i, ci, len(chunk), stale2, win_us,
                             masks_np, None, plan_filter_cached, ex,
-                            snap, t_snap, t_kernel, sink, meshed=True):
+                            snap, t_snap, t_kernel, sink, meshed=True,
+                            fused_sel=fused_sel):
                         served += 1
                 # only queries the batched sharded dispatch actually
                 # served — stale2 redos are charged by their own
@@ -2008,14 +2140,90 @@ class TpuGraphEngine:
             self._mark_done([r for r, *_ in chunk],
                             early=not last_chunk)
 
+    def _window_bucket(self, n: int, cap: int, lane_path: bool) -> int:
+        """Pad size of a window chunk's root axis, so XLA compiles FEW
+        shapes, never past the memory-derived cap (the 1GiB mask
+        budget must hold for the PADDED batch too); zero frontiers
+        produce empty masks and carry no request.
+        - lane path: exactly TWO buckets (small, cap) — both
+          precompiled by prewarm, so no cold compile ever lands inside
+          a round;
+        - delta/vmapped/meshed rounds: power-of-two buckets (those
+          programs compile per-seen shape — smaller pads keep each
+          first-seen compile cheap)."""
+        if lane_path:
+            return min(self.SMALL_BUCKET, cap) \
+                if n <= self.SMALL_BUCKET else cap
+        bucket = 1
+        while bucket < n:
+            bucket *= 2
+        return min(bucket, cap)
+
+    @staticmethod
+    def _stack_frontiers(chunk, bucket: int) -> np.ndarray:
+        """One window chunk's [bucket, P, cap_v] host frontier stack
+        (zero-padded) — the array the FrontierPool stages to device."""
+        stack = [f for _, f, _, _ in chunk]
+        if bucket > len(chunk):
+            stack.extend([np.zeros_like(stack[0])]
+                         * (bucket - len(chunk)))
+        return np.stack(stack)
+
+    def _window_filter_plan(self, chunk, bucket: int,
+                            plan_filter_cached):
+        """Per-lane compiled-WHERE fusion plan for one window chunk:
+        -> (fmasks [NF, P, cap_e] device stack | None,
+            fsel int32[bucket] | None).
+        Distinct compiled device masks (by identity — the per-snapshot
+        PR 5 rung dedupes equal WHERE shapes to one array) stack into
+        the fused program's filter operand; each lane selects its own
+        via fsel (-1 = no device filter). Runs under the engine lock
+        (the filter compiler reads delta-mutable mirrors); a lane
+        whose plan raises stays UNFUSED (fsel -1) and resolves per-
+        request in phase 3 — fsel, not a window-wide flag, is what
+        phase 3 consults, so a plan that raises here but succeeds
+        there still ANDs its mask on the host. Windows mixing more
+        shapes than MAX_WINDOW_FILTERS decline fusion wholesale
+        (counted) so the operand bucket space stays bounded."""
+        import jax.numpy as jnp
+        distinct: List[Any] = []
+        ids: Dict[int, int] = {}
+        sel = np.full(bucket, -1, np.int32)
+        for i, (r, *_rest) in enumerate(chunk):
+            try:
+                dm, _lf = plan_filter_cached(r)
+            except Exception:
+                continue   # phase 3 re-raises per-request
+            if dm is None:
+                continue
+            j = ids.get(id(dm))
+            if j is None:
+                j = ids[id(dm)] = len(distinct)
+                distinct.append(dm)
+            sel[i] = j
+        if not distinct:
+            return None, None
+        if len(distinct) > fused.MAX_WINDOW_FILTERS:
+            self.stats["fused_declined"] += 1
+            return None, None
+        nf = fused.filter_bucket(len(distinct))
+        pads = [distinct[0]] * (nf - len(distinct))
+        return jnp.stack(list(distinct) + pads), sel
+
     def _serve_chunk_loop(self, dense, cap, n_chunks, snap, v0, steps,
                           use_delta, req_arr, owner, plan_filter_cached,
                           ex, t_snap, claimed) -> None:
         import jax.numpy as jnp
+        pool = self.frontier_pool
+        staged_next = None   # (chunk idx, _Staged): prefetched H2D
+        lane_state = [not use_delta]   # bucket prediction for prefetch
         for ci, c0 in enumerate(range(0, len(dense), cap)):
             chunk = dense[c0:c0 + cap]
             last_chunk = ci == n_chunks - 1
             launch_err = None
+            fused_sel = None
+            host_stack = None
+            kernel_cal = None
             t_win0 = time.monotonic()
             t1 = time.monotonic()
             with self._lock:
@@ -2032,66 +2240,95 @@ class TpuGraphEngine:
                             # measured on THIS backend: the vmapped
                             # batch beats the lane-matrix layout
                             aligned = None
-                        # pad the root axis so XLA compiles FEW
-                        # shapes, never past the memory-derived cap
-                        # (the 1GiB mask budget must hold for the
-                        # PADDED batch too); zero frontiers produce
-                        # empty masks and carry no request.
-                        # - lane path: exactly TWO buckets (small,
-                        #   cap) — both precompiled by prewarm, so no
-                        #   cold compile ever lands inside a round;
-                        # - delta/vmapped rounds: power-of-two buckets
-                        #   (delta device shapes vary with the buffer,
-                        #   so those programs can't be precompiled —
-                        #   smaller pads keep each first-seen compile
-                        #   cheap).
-                        if aligned is not None:
-                            bucket = min(self.SMALL_BUCKET, cap) \
-                                if len(chunk) <= self.SMALL_BUCKET \
-                                else cap
-                        else:
-                            bucket = 1
-                            while bucket < len(chunk):
-                                bucket *= 2
-                            bucket = min(bucket, cap)
-                        stack = [f for _, f, _, _ in chunk]
-                        if bucket > len(chunk):
-                            stack.extend([np.zeros_like(stack[0])]
-                                         * (bucket - len(chunk)))
-                        f0s = jnp.asarray(np.stack(stack))
-                        kernel_cal = None
+                        lane_state[0] = aligned is not None
+                        bucket = self._window_bucket(
+                            len(chunk), cap, aligned is not None)
+                        host_stack = self._stack_frontiers(chunk,
+                                                           bucket)
+                        # double-buffered H2D: consume the transfer
+                        # prefetched during the PREVIOUS chunk's
+                        # kernel wait, or stage fresh
+                        staged = None
+                        if staged_next is not None:
+                            pci, st = staged_next
+                            staged_next = None
+                            if pci == ci and st.shape == \
+                                    host_stack.shape:
+                                staged = st
+                                pool.hit()
+                            else:
+                                pool.miss()
+                        if staged is None:
+                            staged = pool.stage(host_stack)
+                        f0s = staged.take()
                         t1 = time.monotonic()
                         if use_delta:
+                            # delta windows keep the unfused kernels:
+                            # the compiled-filter rung declines with
+                            # buffered adds in play (no device mask
+                            # exists to fuse) and delta shapes vary
+                            # with the buffer
                             masks, dmasks = \
                                 traverse.multi_hop_roots_delta(
                                     f0s, jnp.int32(steps), snap.kernel,
                                     snap.delta.device(), req_arr)
-                        elif aligned is not None:
-                            # lane-matrix batched kernel: the edge/
-                            # index streams are read once per hop for
-                            # the WHOLE window (the vmapped fallback
-                            # only shares them on backends that
-                            # vectorize the batch dim)
-                            ak, a_chunk, a_group = aligned
-                            if getattr(snap, "batched_kernel_pick",
-                                       None) is None:
-                                # claim the one-shot lane-vs-vmapped
-                                # calibration; the timing itself runs
-                                # OFF the lock in phase 2 (kernel
-                                # buffers are immutable device arrays)
-                                snap.batched_kernel_pick = "calibrating"
-                                claimed[0] = True
-                                kernel_cal = (ak, a_chunk, a_group)
-                            masks = traverse.multi_hop_masks_batch(
-                                f0s, jnp.int32(steps), ak, snap.kernel,
-                                req_arr, chunk=a_chunk, group=a_group)
-                            self.stats["batched_lane_rounds"] += 1
-                            dmasks = None
+                            staged.after_launch(donate_expected=False)
                         else:
-                            masks = traverse.multi_hop_roots(
-                                f0s, jnp.int32(steps), snap.kernel,
-                                req_arr)
+                            # ONE fused launch per chunk: hop advance,
+                            # final canonical gather and the window's
+                            # compiled WHERE masks in a single device
+                            # program — no per-request host filter
+                            # ANDs, no intermediate sync
+                            fmasks, fsel = \
+                                self._window_filter_plan(
+                                    chunk, bucket, plan_filter_cached)
+                            fused_sel = fsel
+                            fsel_op = None if fmasks is None \
+                                else jnp.asarray(fsel)
+                            nf = 0 if fmasks is None \
+                                else int(fmasks.shape[0])
                             dmasks = None
+                            if aligned is not None:
+                                ak, a_chunk, a_group = aligned
+                                if getattr(snap,
+                                           "batched_kernel_pick",
+                                           None) is None:
+                                    # claim the one-shot lane-vs-
+                                    # vmapped calibration; the timing
+                                    # runs OFF the lock in phase 2
+                                    snap.batched_kernel_pick = \
+                                        "calibrating"
+                                    claimed[0] = True
+                                    kernel_cal = (ak, a_chunk,
+                                                  a_group)
+                                fn = self._fused_entry(
+                                    snap,
+                                    ("win_lane", bucket, nf, a_chunk,
+                                     a_group),
+                                    lambda: partial(
+                                        fused.window_lane,
+                                        chunk=a_chunk,
+                                        group=a_group))
+                                masks = fn(f0s, jnp.int32(steps), ak,
+                                           snap.kernel, req_arr,
+                                           fmasks, fsel_op)
+                                self.stats["batched_lane_rounds"] += 1
+                            else:
+                                fn = self._fused_entry(
+                                    snap, ("win_vmap", bucket, nf),
+                                    lambda: fused.window_vmap)
+                                masks = fn(f0s, jnp.int32(steps),
+                                           snap.kernel, req_arr,
+                                           fmasks, fsel_op)
+                            self.stats["fused_launches"] += 1
+                            # donation can only alias when the output
+                            # matches the donated buffer's byte size
+                            # (masks are [b,P,cap_e], the frontier
+                            # [b,P,cap_v]) — audit a fallback only
+                            # when aliasing was actually possible
+                            staged.after_launch(
+                                donate_expected=int(masks.nbytes) ==
+                                int(np.prod(staged.shape)))
                     except Exception as e:
                         launch_err = e
             if redo:
@@ -2108,14 +2345,31 @@ class TpuGraphEngine:
                     # the key back NOW so window N+1's leader can claim
                     # and launch while we wait for masks + materialize
                     self._release_round(owner.key, owner)
+                elif staged_next is None:
+                    # prefetch slot: start the NEXT chunk's frontier
+                    # H2D now, so the transfer rides under THIS
+                    # chunk's kernel wait (the second slot of the
+                    # donated-buffer pool)
+                    try:
+                        nxt = dense[c0 + cap:c0 + 2 * cap]
+                        nb = self._window_bucket(len(nxt), cap,
+                                                 lane_state[0])
+                        staged_next = (ci + 1, pool.stage(
+                            self._stack_frontiers(nxt, nb)))
+                    except Exception:
+                        staged_next = None
                 # device wait OFF the engine lock (jax releases the
                 # GIL): another group's round — or the next window of
                 # this key — runs its host phases meanwhile. An async
                 # dispatch error surfaces HERE at the fetch.
                 try:
-                    masks_np = np.asarray(masks)
-                    dmasks_np = None if dmasks is None \
-                        else np.asarray(dmasks)
+                    pool.fetch_begin()
+                    try:
+                        masks_np = np.asarray(masks)
+                        dmasks_np = None if dmasks is None \
+                            else np.asarray(dmasks)
+                    finally:
+                        pool.fetch_end()
                 except Exception as e:
                     launch_err = e
             if launch_err is not None:
@@ -2137,8 +2391,10 @@ class TpuGraphEngine:
             if kernel_cal is not None:
                 # one-shot lane-vs-vmapped timing, also OFF the lock —
                 # the extra dispatches never stall the engine, only
-                # this first window's own materialization start
-                self._calibrate_batched_kernel(snap, f0s, steps,
+                # this first window's own materialization start. The
+                # HOST stack is passed (the serving launch DONATED the
+                # device buffer; the probe restages its own copies).
+                self._calibrate_batched_kernel(snap, host_stack, steps,
                                                *kernel_cal, req_arr)
                 claimed[0] = False   # resolved (or reset) by the call
             sink: List[Tuple] = []
@@ -2153,7 +2409,8 @@ class TpuGraphEngine:
                     self._serve_window_request(
                         entry, i, ci, len(chunk), stale2, win_us,
                         masks_np, dmasks_np, plan_filter_cached, ex,
-                        snap, t_snap, t_kernel, sink, meshed=False)
+                        snap, t_snap, t_kernel, sink, meshed=False,
+                        fused_sel=fused_sel)
             if sink:
                 self._encode_sink(sink)
             self._mark_done([r for r, *_ in chunk], early=not last_chunk)
@@ -2161,7 +2418,8 @@ class TpuGraphEngine:
     def _serve_window_request(self, entry, i, ci, window, stale2,
                               win_us, masks_np, dmasks_np,
                               plan_filter_cached, ex, snap, t_snap,
-                              t_kernel, sink, meshed) -> bool:
+                              t_kernel, sink, meshed,
+                              fused_sel=None) -> bool:
         """One request of a batched window, under the engine lock —
         the per-request tail SHARED by the meshed and single-chip
         chunk loops. Per-request spans (the shared window launch +
@@ -2183,7 +2441,13 @@ class TpuGraphEngine:
                              window=window, chunk=ci, meshed=meshed)
                 device_mask, local_filter = plan_filter_cached(r)
                 mask = masks_np[i]
-                if device_mask is not None:
+                if device_mask is not None and \
+                        (fused_sel is None or fused_sel[i] < 0):
+                    # this LANE's mask was not fused (delta round, a
+                    # window that mixed too many WHERE shapes, or a
+                    # plan that raised at fusion time and only
+                    # succeeded on this retry): the compiled mask
+                    # still ANDs in here, per request, like pre-fusion
                     mask = mask & np.asarray(device_mask)
                 d_mask = dmasks_np[i] if dmasks_np is not None else None
                 r.result = self._go_emit_dense(
@@ -2197,8 +2461,8 @@ class TpuGraphEngine:
                 r.result = None    # CPU pipe re-serves it
                 return False
 
-    def _calibrate_batched_kernel(self, snap, f0s, steps, ak, a_chunk,
-                                  a_group, req_arr):
+    def _calibrate_batched_kernel(self, snap, host_f0s, steps, ak,
+                                  a_chunk, a_group, req_arr):
         """Measured lane-vs-vmapped routing for batched windows, once
         per snapshot: the lane-matrix kernel is the layout the TPU
         wants (edge/index streams read once per hop for the whole
@@ -2207,25 +2471,46 @@ class TpuGraphEngine:
         shape. Modeled preferences go stale; this is the
         calibrate_sparse_budget discipline applied to kernel choice.
 
+        The probe times the FUSED window programs the dispatcher
+        actually launches (the registry entries — window_lane served
+        this very round, so its timing pass is warm), not the unfused
+        kernels the pre-fusion probe measured: a pick made against the
+        old cost model would pin the slower variant for the snapshot's
+        whole life. Each timed call restages the frontier stack from
+        the HOST copy (the serving launch donated the device buffer),
+        so both variants pay the same per-window H2D production pays.
+
         Runs OFF the engine lock (kernel buffers are immutable device
-        arrays) on the first window's live frontiers, compile excluded
-        from timing. The caller already dispatched + fetched the lane
-        variant for the round itself, so this only pays the timing
-        re-runs; a failure resets the claim so a later window retries."""
+        arrays) on the first window's live frontiers, compiles excluded
+        from timing; a failure resets the claim so a later window
+        retries."""
         import jax.numpy as jnp
         s32 = jnp.int32(steps)
+        bucket = host_f0s.shape[0]
         try:
+            lane_fn = self._fused_entry(
+                snap, ("win_lane", bucket, 0, a_chunk, a_group),
+                lambda: partial(fused.window_lane, chunk=a_chunk,
+                                group=a_group))
+            vmap_fn = self._fused_entry(
+                snap, ("win_vmap", bucket, 0),
+                lambda: fused.window_vmap)
+
             def lane():
-                return traverse.multi_hop_masks_batch(
-                    f0s, s32, ak, snap.kernel, req_arr, chunk=a_chunk,
-                    group=a_group)
+                return lane_fn(jnp.asarray(host_f0s), s32, ak,
+                               snap.kernel, req_arr, None, None)
 
             def vmap():
-                return traverse.multi_hop_roots(f0s, s32, snap.kernel,
-                                                req_arr)
+                return vmap_fn(jnp.asarray(host_f0s), s32,
+                               snap.kernel, req_arr, None, None)
 
-            vmap().block_until_ready()   # compile outside timing (the
-            t0 = time.monotonic()        # lane variant just served)
+            # compiles outside timing: the lane program just served
+            # the round (warm unless the round ran filtered — one
+            # warm call makes both cases uniform), the vmapped one
+            # compiles here
+            lane().block_until_ready()
+            vmap().block_until_ready()
+            t0 = time.monotonic()
             lane().block_until_ready()
             lane_s = time.monotonic() - t0
             t0 = time.monotonic()
@@ -2241,7 +2526,8 @@ class TpuGraphEngine:
         pick = "lane" if lane_s <= vmap_s else "vmap"
         snap.batched_kernel_pick = pick
         rec = {"lane_ms": round(lane_s * 1e3, 1),
-               "vmap_ms": round(vmap_s * 1e3, 1), "pick": pick}
+               "vmap_ms": round(vmap_s * 1e3, 1), "pick": pick,
+               "fused": True}
         self.batched_kernel_calibrations[snap.space_id] = rec
         global_stats.add_value("tpu_engine.batched_kernel_pick_" + pick,
                                kind="counter")
@@ -2710,23 +2996,77 @@ class TpuGraphEngine:
             except _Unsupported:
                 return _decl("yield_not_compilable")
         import jax.numpy as jnp
+        import jax
         f0 = jnp.asarray(frontier0)
         req = jnp.asarray(traverse.pad_edge_types(edge_types))
+        shape = (snap.num_parts, snap.cap_e)
+        # fold every err mask into ONE program operand: the audit that
+        # used to pay one jnp.any host sync PER mask rides the fused
+        # program (fused.py; docs/manual/13-device-speed.md)
+        err_comb = fused.combine_err_masks(err_masks, shape)
         faults.fire("kernel.launch")
         t1 = time.monotonic()
-        if getattr(snap, "sharded_kernel", None) is not None:
+        if not meshed and group_layout is None:
+            # fully fused ungrouped pushdown: traversal + compiled
+            # WHERE + err audit + exact per-column partials in ONE
+            # launch / ONE fetch (exactness identical to
+            # aggregate.reduce_specs — see fused.agg_reduce)
+            key_list = list(vals.keys())
+            key_index = {k2: i for i, k2 in enumerate(key_list)}
+            if key_list:
+                values_op = jnp.stack([
+                    jnp.broadcast_to(
+                        jnp.asarray(vals[k2].value, jnp.int32), shape)
+                    for k2 in key_list])
+                nulls_op = jnp.stack([
+                    jnp.broadcast_to(jnp.asarray(vals[k2].null, bool),
+                                     shape)
+                    for k2 in key_list])
+            else:
+                values_op = nulls_op = None
+            cs = min(aggregate.SUM_CHUNK, max(snap.cap_e, 1))
+            fn = self._fused_entry(
+                snap, ("agg", len(key_list), device_mask is not None,
+                       err_comb is not None, cs),
+                lambda: partial(fused.agg_reduce, chunk_slots=cs))
+            err_any, n_rows, parts = jax.device_get(
+                fn(f0, jnp.int32(int(s.step.steps)), snap.kernel, req,
+                   device_mask, err_comb, values_op, nulls_op))
+            self.stats["fused_launches"] += 1
+            t_kernel = time.monotonic() - t1
+            if bool(err_any):
+                # CPU raises EvalError for these rows
+                return _decl("err_cells")
+            row = fused.assemble_agg_row(keyed_specs, key_index,
+                                         int(n_rows), parts)
+            self.stats["agg_served"] += 1
+            self._record_profile("aggregate", t_snap, t_kernel, 0.0,
+                                 snap)
+            return StatusOr.of(ex.InterimResult(out_cols, [tuple(row)]))
+        if meshed:
             from . import distributed
             _, active = distributed.multi_hop_sharded(
                 self.mesh, f0, jnp.int32(s.step.steps),
                 snap.sharded_kernel, req)
             self.stats["sharded_queries"] += 1
+            if device_mask is not None:
+                active = active & device_mask
+            if err_comb is not None and bool(jnp.any(active & err_comb)):
+                # CPU raises EvalError for these rows
+                return _decl("err_cells")
         else:
-            _, active = traverse.multi_hop(f0, s.step.steps, snap.kernel,
-                                           req)
-        if device_mask is not None:
-            active = active & device_mask
-        for em in err_masks:
-            if bool(jnp.any(active & em)):
+            # grouped unmeshed: fused traversal + filter + err audit
+            # prologue — the active mask STAYS on device for the
+            # grouped reduction, only the err_any scalar comes home
+            fn = self._fused_entry(
+                snap, ("agg_trav", device_mask is not None,
+                       err_comb is not None),
+                lambda: fused.traverse_filtered)
+            active, err_any = fn(f0, jnp.int32(int(s.step.steps)),
+                                 snap.kernel, req, device_mask,
+                                 err_comb)
+            self.stats["fused_launches"] += 1
+            if bool(err_any):
                 # CPU raises EvalError for these rows
                 return _decl("err_cells")
         if group_layout is not None:
@@ -2782,17 +3122,16 @@ class TpuGraphEngine:
             self._record_profile("aggregate-grouped", t_snap, t_kernel,
                                  time.monotonic() - t2, snap)
             return StatusOr.of(ex.InterimResult(out_cols, rows))
-        if meshed:
-            from . import mesh_exec
-            try:
-                row = mesh_exec.mesh_reduce_specs(keyed_specs, active,
-                                                  vals, self.mesh)
-            except Exception as e:
-                self._mesh_failed("agg", e, snap)
-                return self._agg_decline("exec_error")
-            self._mesh_served("agg")
-        else:
-            row = aggregate.reduce_specs(keyed_specs, active, vals)
+        # only the MESHED ungrouped reduction reaches here — the
+        # unmeshed one returned from the fused program above
+        from . import mesh_exec
+        try:
+            row = mesh_exec.mesh_reduce_specs(keyed_specs, active,
+                                              vals, self.mesh)
+        except Exception as e:
+            self._mesh_failed("agg", e, snap)
+            return self._agg_decline("exec_error")
+        self._mesh_served("agg")
         t_kernel = time.monotonic() - t1
         if row is None:
             return _decl("exactness_bound")

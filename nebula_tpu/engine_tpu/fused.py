@@ -1,0 +1,357 @@
+"""Device-resident fused serve programs: one launch per dispatcher
+chunk, one fetch per result (docs/manual/13-device-speed.md).
+
+BENCH_r05 measured tier1_hbm_util_vs_peak at 0.01 with dispatcher_wait
++ kernel dominating the tier-3 span breakdown — the chip idles between
+host-synchronized stages. This module closes those seams:
+
+1. FUSED WINDOW PROGRAMS — the hop advance (traverse._masks_batch_core
+   / the vmapped multi_hop), the compiled-WHERE lane filters
+   (filter_compile device masks), and the final canonical gather run
+   as ONE jitted program. Per-request `mask & np.asarray(device_mask)`
+   host ANDs (a D2H transfer of the full [P, cap_e] mask PER REQUEST
+   per window) disappear: the window's distinct compiled masks ride
+   along as a stacked [NF, P, cap_e] operand and each lane selects its
+   own (`fsel`, -1 = unfiltered lane).
+
+2. FUSED AGGREGATE PROGRAMS — the aggregation pushdown's traversal,
+   filter, err-cell audit (previously one `jnp.any` host sync PER err
+   mask) and the exact per-column partials (non-null count, MIN/MAX
+   lattice, the 8-bit digit-chunk sums of aggregate.exact_int_sum)
+   return as one pytree in one fetch. Exactness discipline is
+   byte-identical to aggregate.py: int32 digit partials over chunks of
+   SUM_CHUNK slots, host reassembly in Python ints.
+
+3. FRONTIER DOUBLE-BUFFERING (FrontierPool) — window N+1's frontier
+   stack H2D transfer is staged asynchronously (jax.device_put) while
+   window N's kernel is still in flight; the fused window programs
+   DONATE the frontier argument (donate_argnums=0) so XLA may recycle
+   the staged buffer for outputs. The pool alternates conceptual slots
+   by construction: each staged buffer is consumed (donated) by
+   exactly one launch, and the next window stages into fresh memory
+   while the previous launch still owns its slot. The launch-site
+   audit counts `donation_fallbacks` only when aliasing was actually
+   POSSIBLE (output byte size matches the donated buffer) yet the
+   backend left the input alive — size-mismatched launches (the
+   normal cap_e != cap_v case) and no-aliasing backends are expected
+   non-donations, never counted, never warned per launch.
+
+Program SIGNATURES: (kind, batch bucket, filter arity bucket, layout
+statics). `steps` and the requested edge types are traced operands —
+varying them NEVER compiles a new program; WHERE shapes collapse to
+the filter-arity bucket because compiled filters are mask OPERANDS,
+not program structure. The per-snapshot registry
+(TpuGraphEngine._fused_entry) binds snapshot arrays per signature and
+counts hits/misses/signatures so recompile behavior is observable and
+bounded (tests/test_fused.py asserts the bound).
+
+Every fused entry point stays behind the PR 3 ladder: callers fire
+`faults.fire("kernel.launch")` immediately before the launch and wrap
+the call in the per-feature breaker, so chaos runs trip and recover
+through the fused loop exactly as through the old one.
+"""
+from __future__ import annotations
+
+import threading
+import time
+import warnings
+from functools import partial
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import traverse
+from .aggregate import SUM_CHUNK, _BIAS
+
+# distinct compiled WHERE masks fused into one window program; windows
+# mixing more shapes than this fall back to the per-request host AND
+# (counted as fused_declined — the signature space stays bounded)
+MAX_WINDOW_FILTERS = 8
+
+# donation fallbacks are COUNTED (FrontierPool), not warned per launch
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable")
+
+
+def filter_bucket(n_filters: int) -> int:
+    """Pad the distinct-filter count to exactly TWO operand arities —
+    1 (the common single-WHERE-shape window) or MAX_WINDOW_FILTERS —
+    so prewarm can compile EVERY filtered lane-program shape up front
+    and no filtered window ever pays a cold XLA compile under the
+    engine lock. The multi-shape pad wastes some operand bytes on
+    windows mixing 2..MAX-1 shapes; those windows are rare, cold
+    compiles under the launch lock are 20-40s on first chip contact."""
+    return 1 if n_filters <= 1 else MAX_WINDOW_FILTERS
+
+
+def _apply_lane_filters(masks: jnp.ndarray, fmasks: jnp.ndarray,
+                        fsel: jnp.ndarray) -> jnp.ndarray:
+    """AND each lane's compiled WHERE mask into the window masks ON
+    DEVICE: fsel[b] indexes the stacked distinct masks; -1 marks an
+    unfiltered lane (its mask passes through untouched)."""
+    sel = fmasks[jnp.maximum(fsel, 0)]           # [B, P, cap_e]
+    return masks & ((fsel < 0)[:, None, None] | sel)
+
+
+@partial(jax.jit, static_argnames=("chunk", "group"), donate_argnums=(0,))
+def window_lane(f0s: jnp.ndarray, steps: jnp.ndarray, ak, k,
+                req_types: jnp.ndarray, fmasks, fsel, *,
+                chunk: int, group: int) -> jnp.ndarray:
+    """Fused lane-matrix dispatcher window: hop advance + final
+    canonical gather + per-lane compiled WHERE filters in ONE program.
+    fmasks/fsel None -> unfiltered (a distinct trace, not a distinct
+    operand shape). The frontier stack is DONATED."""
+    masks = traverse._masks_batch_core(f0s, steps, ak, k, req_types,
+                                       chunk, group)
+    if fmasks is None:
+        return masks
+    return _apply_lane_filters(masks, fmasks, fsel)
+
+
+@partial(jax.jit, donate_argnums=(0,))
+def window_vmap(f0s: jnp.ndarray, steps: jnp.ndarray, k,
+                req_types: jnp.ndarray, fmasks, fsel) -> jnp.ndarray:
+    """Fused vmapped window — the variant backends that lower vmap
+    efficiently pick via the batched-kernel calibration. Identical
+    semantics to multi_hop_roots + per-lane filter AND."""
+    masks = jax.vmap(
+        lambda f: traverse.multi_hop(f, steps, k, req_types)[1])(f0s)
+    if fmasks is None:
+        return masks
+    return _apply_lane_filters(masks, fmasks, fsel)
+
+
+@jax.jit
+def traverse_filtered(f0: jnp.ndarray, steps: jnp.ndarray, k,
+                      req_types: jnp.ndarray, fmask, err_mask):
+    """Fused prologue of the GROUPED aggregation pushdown: traversal +
+    compiled WHERE + err-cell audit in one program. -> (active mask
+    [P, cap_e] — stays on device for grouped_reduce — and the single
+    err_any scalar that used to cost one host sync per err mask)."""
+    _, active = traverse.multi_hop(f0, steps, k, req_types)
+    if fmask is not None:
+        active = active & fmask
+    err_any = jnp.zeros((), bool) if err_mask is None \
+        else jnp.any(active & err_mask)
+    return active, err_any
+
+
+@partial(jax.jit, static_argnames=("chunk_slots",))
+def agg_reduce(f0: jnp.ndarray, steps: jnp.ndarray, k,
+               req_types: jnp.ndarray, fmask, err_mask, values, nulls,
+               *, chunk_slots: int):
+    """Fused UNGROUPED aggregation pushdown: traversal + filter + err
+    audit + exact per-column partials, one launch / one fetch.
+
+    values int32[NV, P, cap_e], nulls bool[NV, P, cap_e] (NV = distinct
+    aggregate value columns; None when only COUNT is requested).
+    Returns (err_any bool, n_rows int32, None | (nn int32[NV],
+    mn int32[NV], mx int32[NV], digits int32[NV, 4, P, n_chunks])).
+
+    Exactness is aggregate.py's, unchanged: n_rows/nn are int32 row
+    counts (cap_e < 2^31), MIN/MAX are int32 lattice ops under the
+    mask, and SUM rides bias-shifted 8-bit digit partials summed in
+    int32 over chunks of `chunk_slots <= SUM_CHUNK` slots (chunk_sum
+    <= chunk_slots * 255 < 2^30) — the host reassembles Python ints.
+    """
+    _, active = traverse.multi_hop(f0, steps, k, req_types)
+    if fmask is not None:
+        active = active & fmask
+    err_any = jnp.zeros((), bool) if err_mask is None \
+        else jnp.any(active & err_mask)
+    n_rows = jnp.sum(active)                     # int32, like reduce_specs
+    if values is None:
+        return err_any, n_rows, None
+    m = active[None] & ~nulls                    # [NV, P, cap_e]
+    nn = m.sum(axis=(1, 2), dtype=jnp.int32)
+    mn = jnp.min(jnp.where(m, values, jnp.int32(2**31 - 1)), axis=(1, 2))
+    mx = jnp.max(jnp.where(m, values, jnp.int32(-(2**31))), axis=(1, 2))
+    u = values.astype(jnp.uint32) + jnp.uint32(_BIAS)
+    NV, P, cap = u.shape
+    pad = (-cap) % chunk_slots
+    if pad:
+        u = jnp.pad(u, ((0, 0), (0, 0), (0, pad)))
+        m = jnp.pad(m, ((0, 0), (0, 0), (0, pad)))
+    u = u.reshape(NV, P, -1, chunk_slots)
+    m4 = m.reshape(NV, P, -1, chunk_slots)
+    digits = []
+    for kd in range(4):
+        d = ((u >> jnp.uint32(8 * kd)) & jnp.uint32(0xFF)).astype(jnp.int32)
+        digits.append(jnp.sum(jnp.where(m4, d, 0), axis=-1))
+    return err_any, n_rows, (nn, mn, mx, jnp.stack(digits, axis=1))
+
+
+def assemble_agg_row(keyed_specs: List[Tuple[str, Any]],
+                     key_index: Dict[Any, int], n_rows: int,
+                     parts) -> List:
+    """Host tail of agg_reduce: the exact result row, value-identical
+    to aggregate.reduce_specs (Python ints/floats/None only)."""
+    row: List = []
+    if parts is not None:
+        nn, mn, mx, digits = (np.asarray(a) for a in parts)
+    for fun, key in keyed_specs:
+        if fun == "COUNT":
+            row.append(int(n_rows))
+            continue
+        i = key_index[key]
+        c = int(nn[i])
+        if c == 0:
+            row.append(None)                     # CPU: no non-null values
+            continue
+        if fun == "MIN":
+            row.append(int(mn[i]))
+        elif fun == "MAX":
+            row.append(int(mx[i]))
+        else:
+            total = 0
+            for kd in range(4):
+                # object-dtype accumulation: chunk partials are exact
+                # int32, their Python-int sum is exact at any scale
+                total += int(digits[i, kd].astype(object).sum()) << (8 * kd)
+            total -= c * _BIAS
+            row.append(total if fun == "SUM" else total / c)
+    return row
+
+
+def combine_err_masks(err_masks: List, shape: Tuple[int, int]):
+    """Fold the compiled err masks into the single program operand:
+    None (nothing can err), or a [P, cap_e] bool device array. Scalar
+    leaves (filter_compile's np.bool_ False literals) fold away; a
+    degenerate scalar-True err errs everywhere, like the CPU walk."""
+    comb = None
+    for em in err_masks:
+        comb = em if comb is None else comb | em
+    if comb is None:
+        return None
+    if not hasattr(comb, "shape") or comb.shape == ():
+        if not bool(comb):
+            return None
+        return jnp.ones(shape, bool)
+    return comb
+
+
+def compile_cache_size() -> int:
+    """Total XLA compile-cache entries across the fused entry points —
+    the real recompile count the signature registry's misses upper-
+    bound (the jit cache shares across snapshots of equal shapes)."""
+    n = 0
+    for fn in (window_lane, window_vmap, traverse_filtered, agg_reduce):
+        try:
+            n += fn._cache_size()
+        except Exception:
+            pass
+    return n
+
+
+class _Staged:
+    """One staged frontier-stack H2D transfer (see FrontierPool)."""
+
+    __slots__ = ("buf", "shape", "t0", "overlapped", "epoch0", "_pool",
+                 "_donated")
+
+    def __init__(self, buf, shape, t0: float, overlapped: bool,
+                 epoch0: int, pool):
+        self.buf = buf
+        self.shape = shape
+        self.t0 = t0
+        self.overlapped = overlapped
+        self.epoch0 = epoch0
+        self._pool = pool
+        self._donated = False
+
+    def take(self):
+        """Hand the device buffer to a launch. A transfer counts as
+        overlapped if a kernel fetch was in flight when it was staged
+        OR began between stage and take — the serve loop stages chunk
+        N+1's prefetch just BEFORE its own fetch of chunk N's masks,
+        so the overlap it creates is only visible at take time (the
+        fetch epoch moved). Overlapped takes credit the wall time the
+        transfer had to hide behind the kernel (`h2d_overlap_us`)."""
+        with self._pool._lock:
+            if not self.overlapped \
+                    and self._pool._fetch_epoch > self.epoch0:
+                self.overlapped = True
+                self._pool.stats["overlapped"] += 1
+            if self.overlapped:
+                dt = int((time.monotonic() - self.t0) * 1e6)
+                self._pool.stats["h2d_overlap_us"] += dt
+        return self.buf
+
+    def after_launch(self, donate_expected: bool = False) -> None:
+        """Post-launch donation audit: if the launch was expected to
+        donate the buffer (caller verified output/input byte sizes
+        permit aliasing) but it survived, the backend fell back to a
+        copy — counted, so HBM-pressure regressions are visible
+        without drowning the counter in expected non-donations."""
+        if self._donated:
+            return
+        self._donated = True
+        if donate_expected:
+            try:
+                alive = not self.buf.is_deleted()
+            except Exception:
+                alive = False
+            if alive:
+                with self._pool._lock:
+                    self._pool.stats["donation_fallbacks"] += 1
+
+
+class FrontierPool:
+    """Two-slot donated-buffer staging for window frontier stacks.
+
+    stage() starts the H2D transfer immediately (jax.device_put is
+    asynchronous); the caller launches later with take(). The serve
+    loops stage chunk N+1 (and, via the dispatcher's early round
+    release, window N+1's leader stages its first chunk) while chunk
+    N's kernel wait (`fetch_begin`/`fetch_end` bracket the blocking
+    np.asarray) is in flight — a stage during an active fetch, or one
+    whose take() observes a fetch that began after it (the loop's own
+    prefetch lands just before it blocks on the current chunk), counts
+    as `overlapped`, and `h2d_overlap_us` accumulates the wall time
+    each overlapped transfer had to hide. Donation (the launch consuming the buffer)
+    keeps the pool at two live slots: the in-flight kernel owns one
+    staged buffer, the prefetched window owns the other."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._fetches = 0
+        # bumped on every fetch_begin: lets take() detect a fetch that
+        # STARTED after its stage (the serve loop's own prefetch lands
+        # just before the loop blocks on the current chunk's masks)
+        self._fetch_epoch = 0
+        self.stats = {"stages": 0, "prefetch_hits": 0,
+                      "prefetch_misses": 0, "overlapped": 0,
+                      "h2d_overlap_us": 0, "donation_fallbacks": 0}
+
+    def fetch_begin(self) -> None:
+        with self._lock:
+            self._fetches += 1
+            self._fetch_epoch += 1
+
+    def fetch_end(self) -> None:
+        with self._lock:
+            self._fetches -= 1
+
+    def stage(self, arr: np.ndarray) -> _Staged:
+        with self._lock:
+            self.stats["stages"] += 1
+            overlapped = self._fetches > 0
+            if overlapped:
+                self.stats["overlapped"] += 1
+            epoch0 = self._fetch_epoch
+        return _Staged(jax.device_put(arr), arr.shape, time.monotonic(),
+                       overlapped, epoch0, self)
+
+    def hit(self) -> None:
+        with self._lock:
+            self.stats["prefetch_hits"] += 1
+
+    def miss(self) -> None:
+        with self._lock:
+            self.stats["prefetch_misses"] += 1
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self.stats)

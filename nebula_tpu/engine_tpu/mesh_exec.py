@@ -57,6 +57,7 @@ import threading
 
 from ..common.faults import faults
 from . import aggregate
+from .fused import _apply_lane_filters
 from .distributed import AXIS, _exchange, shard_aligned_blocks
 from .shard_compat import shard_map
 from .traverse import (LANES, _edge_ok, _init_lanes, _packed_hits,
@@ -112,15 +113,22 @@ def ensure_sharded_aligned(mesh, snap):
 @lru_cache(maxsize=64)
 def _batch_masks_fn(mesh, num_devices: int, parts_per_dev: int,
                     cap_v: int, cap_e: int, n_slots: int, chunk: int,
-                    group: int, batch: int):
+                    group: int, batch: int, filtered: bool):
     """shard_map'd window kernel: replicated packed frontier matrix,
     per-device aligned-block advance, pmax merge per hop, one
-    canonical gather per device block for the final masks."""
+    canonical gather per device block for the final masks. With
+    `filtered` the window's stacked compiled WHERE masks ([NF, P,
+    cap_e], partition-sharded like the output) AND in per lane INSIDE
+    the same program (fsel[b] = that lane's mask index, -1 =
+    unfiltered) — the sharded twin of fused.window_lane's filter
+    fusion."""
+    in_specs = (None, None, P(AXIS), P(AXIS), None)
+    if filtered:
+        in_specs = in_specs + (P(None, AXIS), None)
 
-    @partial(shard_map, mesh=mesh,
-             in_specs=(None, None, P(AXIS), P(AXIS), None),
+    @partial(shard_map, mesh=mesh, in_specs=in_specs,
              out_specs=P(None, AXIS))
-    def run(frontiers0, steps_, ak_, kern_, req):
+    def run(frontiers0, steps_, ak_, kern_, req, *filt):
         ak = jax.tree.map(lambda a: a[0], ak_)   # this device's block
         k = jax.tree.map(lambda a: a[0], kern_)
         # lane matrix built ON DEVICE from the replicated [B, P, cap_v]
@@ -152,20 +160,26 @@ def _batch_masks_fn(mesh, num_devices: int, parts_per_dev: int,
         ok_c = _edge_ok(k.etype, k.valid, req)
         masks = (rows.reshape(parts_per_dev, cap_e, batch) > 0) \
             & ok_c[..., None]
-        return jnp.moveaxis(masks, 2, 0)         # [B, bp, cap_e]
+        masks = jnp.moveaxis(masks, 2, 0)        # [B, bp, cap_e]
+        if filt:
+            fmasks, fsel = filt                  # [NF, bp, cap_e] block
+            masks = _apply_lane_filters(masks, fmasks, fsel)
+        return masks
 
     return jax.jit(run)
 
 
 def multi_hop_masks_batch_sharded(mesh, frontiers0, steps, ak, kern,
-                                  req_types, chunk: int,
-                                  group: int) -> jnp.ndarray:
+                                  req_types, chunk: int, group: int,
+                                  fmasks=None, fsel=None) -> jnp.ndarray:
     """Distributed dispatcher window: final-hop active edge masks for a
     batch of GO queries in ONE sharded dispatch. frontiers0
     bool[B, P, cap_v]; ak from shard_aligned_blocks / kern the
     snapshot's sharded EdgeKernel (both leading-dim sharded over the
     mesh). -> bool[B, P, cap_e], partition-sharded over axis 1.
-    Identical semantics to traverse.multi_hop_masks_batch."""
+    Identical semantics to traverse.multi_hop_masks_batch; with
+    fmasks/fsel the window's compiled WHERE masks apply per lane
+    inside the program (fused.window_lane's filter contract)."""
     faults.fire("mesh.collective")
     B, num_parts, cap_v = frontiers0.shape
     if B > LANES:
@@ -175,8 +189,11 @@ def multi_hop_masks_batch_sharded(mesh, frontiers0, steps, ak, kern,
     ns = num_parts * cap_v
     cap_e = int(kern.src.shape[-1])
     fn = _batch_masks_fn(mesh, D, num_parts // D, cap_v, cap_e, ns,
-                         chunk, group, B)
-    return fn(jnp.asarray(frontiers0), steps, ak, kern, req_types)
+                         chunk, group, B, fmasks is not None)
+    if fmasks is None:
+        return fn(jnp.asarray(frontiers0), steps, ak, kern, req_types)
+    return fn(jnp.asarray(frontiers0), steps, ak, kern, req_types,
+              fmasks, fsel)
 
 
 # ---------------------------------------------------------------------------

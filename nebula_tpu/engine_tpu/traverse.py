@@ -85,11 +85,11 @@ class EdgeKernel(NamedTuple):
     gather from every hop — measured ~1.8x on the batched path (the
     hop is gather-bound; cumsum and boundary reads are minor).
     """
-    src: jnp.ndarray          # int32[bp, cap_e] local src, canonical
-    etype: jnp.ndarray        # int32[bp, cap_e] signed type, canonical
+    src: jnp.ndarray          # i16|i32[bp, cap_e] local src, canonical
+    etype: jnp.ndarray        # i8|i32[bp, cap_e] signed type, canonical
     valid: jnp.ndarray        # bool [bp, cap_e] canonical
     src_sorted: jnp.ndarray   # int32[bp*cap_e] frontier slot, dst-sorted
-    etype_sorted: jnp.ndarray  # int32[bp*cap_e] dst-sorted
+    etype_sorted: jnp.ndarray  # i8|i32[bp*cap_e] dst-sorted
     valid_sorted: jnp.ndarray  # bool [bp*cap_e] dst-sorted
     seg_starts: jnp.ndarray   # int32[P*cap_v] cumsum boundary (incl.)
     seg_ends: jnp.ndarray     # int32[P*cap_v] cumsum boundary (excl.)
@@ -459,7 +459,7 @@ class AlignedKernel(NamedTuple):
     frontier matrix instead of summing at the edge level.
     """
     src: jnp.ndarray     # int32[E_pad] global src slot; dead -> n_slots
-    etype: jnp.ndarray   # int32[E_pad] signed type; padding -> 0
+    etype: jnp.ndarray   # i8|i32[E_pad] signed type; padding -> 0
     cbound: jnp.ndarray  # int32[n_slots+1] chunk index of each segment start
     deg_types: jnp.ndarray  # int32[T] signed types present in the graph
     degs: jnp.ndarray    # int32[T, n_slots] per-type out-degree per slot
@@ -503,7 +503,9 @@ def build_aligned(gsrc: np.ndarray, etype: np.ndarray, gdst: np.ndarray,
     # the final boundary
     e_pad = (int(astart[-1]) + span - 1) // span * span + span
     a_src = np.full(e_pad, n_slots, np.int32)
-    a_etype = np.zeros(e_pad, np.int32)
+    # etype keeps the snapshot's packed width (int8 when it fits) —
+    # the per-dispatch type-gate pass reads e_pad of these
+    a_etype = np.zeros(e_pad, getattr(etype, "dtype", np.int32))
     if nreal:
         pos = astart[:-1][sg] + (np.arange(nreal) - starts[sg])
         a_src[pos] = gsrc[order]
@@ -623,6 +625,35 @@ def _matrix_hop(f: jnp.ndarray, lay, chunk: int, group: int):
     return jnp.pad(hits.astype(jnp.int8), ((0, 1), (0, 0))), count
 
 
+def _masks_batch_core(frontiers0: jnp.ndarray, steps: jnp.ndarray,
+                      ak: AlignedKernel, k: EdgeKernel,
+                      req_types: jnp.ndarray, chunk: int,
+                      group: int) -> jnp.ndarray:
+    """Unjitted body of multi_hop_masks_batch — shared with the fused
+    window programs (fused.py), which append the compiled-WHERE lane
+    filters inside the SAME compiled program."""
+    B, P, cap_v = frontiers0.shape
+    if B > LANES:
+        raise ValueError(f"batch {B} > {LANES} lanes per dispatch")
+    lay = _matrix_layout(ak, req_types, chunk, group)
+    F = _init_lanes(frontiers0, lay[0])
+
+    def body(_, f):
+        return _matrix_hop(f, lay, chunk, group)[0]
+
+    F = lax.fori_loop(0, jnp.maximum(steps - 1, 0), body, F)
+    # one canonical gather closes the hop: [E, B] frontier bits at each
+    # edge's global src slot, masked by validity + requested types
+    cap_e = k.src.shape[-1]
+    gsrc = (jnp.arange(P, dtype=jnp.int32)[:, None] * cap_v
+            + k.src.reshape(P, cap_e))
+    rows = F[:, :B][gsrc.reshape(-1)]            # [P*cap_e, B] int8
+    ok_c = _edge_ok(k.etype.reshape(P, cap_e),
+                    k.valid.reshape(P, cap_e), req_types)
+    masks = (rows.reshape(P, cap_e, B) > 0) & ok_c[..., None]
+    return jnp.moveaxis(masks, 2, 0)
+
+
 @partial(jax.jit, static_argnames=("chunk", "group"))
 def multi_hop_masks_batch(frontiers0: jnp.ndarray, steps: jnp.ndarray,
                           ak: AlignedKernel, k: EdgeKernel,
@@ -645,26 +676,8 @@ def multi_hop_masks_batch(frontiers0: jnp.ndarray, steps: jnp.ndarray,
     allowed, dedup by saturation). frontiers0: bool[B, P, cap_v] ->
     bool[B, P, cap_e]; B is bounded by the caller's mask-memory budget
     (the output is the same size the vmapped form materializes)."""
-    B, P, cap_v = frontiers0.shape
-    if B > LANES:
-        raise ValueError(f"batch {B} > {LANES} lanes per dispatch")
-    lay = _matrix_layout(ak, req_types, chunk, group)
-    F = _init_lanes(frontiers0, lay[0])
-
-    def body(_, f):
-        return _matrix_hop(f, lay, chunk, group)[0]
-
-    F = lax.fori_loop(0, jnp.maximum(steps - 1, 0), body, F)
-    # one canonical gather closes the hop: [E, B] frontier bits at each
-    # edge's global src slot, masked by validity + requested types
-    cap_e = k.src.shape[-1]
-    gsrc = (jnp.arange(P, dtype=jnp.int32)[:, None] * cap_v
-            + k.src.reshape(P, cap_e))
-    rows = F[:, :B][gsrc.reshape(-1)]            # [P*cap_e, B] int8
-    ok_c = _edge_ok(k.etype.reshape(P, cap_e),
-                    k.valid.reshape(P, cap_e), req_types)
-    masks = (rows.reshape(P, cap_e, B) > 0) & ok_c[..., None]
-    return jnp.moveaxis(masks, 2, 0)
+    return _masks_batch_core(frontiers0, steps, ak, k, req_types,
+                             chunk, group)
 
 
 def build_aligned_blocks(gsrc: np.ndarray, etype: np.ndarray,

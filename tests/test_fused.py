@@ -1,0 +1,318 @@
+"""Fused device-resident serve loop tests (engine_tpu/fused.py;
+docs/manual/13-device-speed.md): one launch per dispatcher chunk with
+the compiled WHERE masks fused in, fused aggregation partials, the
+bounded-recompile signature contract, and the frontier double-buffer
+pool's accounting. Everything must stay byte-identical to the CPU
+pipe — the fusion moves work, never semantics."""
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from nba_fixture import load_nba
+from nebula_tpu.cluster import InProcCluster
+from nebula_tpu.engine_tpu import TpuGraphEngine, fused, traverse
+
+
+def _drain_engine(tpu):
+    for t in list(tpu._prewarm_threads.values()):
+        t.join(timeout=300)
+    for _ in range(600):
+        if not tpu._recalibrating:
+            return
+        time.sleep(0.05)
+
+
+@pytest.fixture(scope="module")
+def fused_pair():
+    """(cpu_conn, tpu cluster, tpu conn, engine) with dense routing
+    pinned so every plain GO rides the dispatcher's fused windows."""
+    _, cpu_conn = load_nba(space="fucpu")
+    tpu = TpuGraphEngine()
+    cluster = InProcCluster(tpu_engine=tpu)
+    _, conn = load_nba(cluster, space="futpu")
+    tpu.sparse_edge_budget = 0   # pin dense: windows, not host pulls
+    yield cpu_conn, cluster, conn, tpu
+    _drain_engine(tpu)
+
+
+# ---------------------------------------------------------------------------
+# kernel level: in-program lane filters == kernel + host AND
+# ---------------------------------------------------------------------------
+
+def test_window_filter_fusion_identity(fused_pair):
+    """fused.window_vmap with stacked filter masks must equal the
+    unfused kernel followed by the per-request host AND, lane by lane
+    (including unfiltered lanes, fsel=-1)."""
+    _, cluster, conn, tpu = fused_pair
+    conn.must("USE futpu")
+    sid = cluster.meta.get_space("futpu").value().space_id
+    snap = tpu.snapshot(sid)
+    assert snap is not None
+    seeds = [[100], [101, 102], [103], [100, 107]]
+    f0s = jnp.asarray(np.stack([snap.frontier_from_vids(s)
+                                for s in seeds]))
+    req = jnp.asarray(traverse.pad_edge_types([1]))
+    shape = (snap.num_parts, snap.cap_e)
+    rng = np.random.default_rng(7)
+    m0 = jnp.asarray(rng.random(shape) > 0.5)
+    m1 = jnp.asarray(rng.random(shape) > 0.2)
+    fmasks = jnp.stack([m0, m1])
+    fsel = jnp.asarray(np.array([0, -1, 1, 0], np.int32))
+    got = np.asarray(fused.window_vmap(
+        f0s, jnp.int32(2), snap.kernel, req, fmasks, fsel))
+    ref_masks = np.asarray(traverse.multi_hop_roots(
+        jnp.asarray(np.stack([snap.frontier_from_vids(s)
+                              for s in seeds])),
+        jnp.int32(2), snap.kernel, req))
+    hosts = [np.asarray(m0), None, np.asarray(m1), np.asarray(m0)]
+    for i, hm in enumerate(hosts):
+        want = ref_masks[i] if hm is None else ref_masks[i] & hm
+        assert (got[i] == want).all(), f"lane {i} diverged"
+
+
+def test_window_lane_filter_fusion_identity(fused_pair):
+    """Same contract for the lane-matrix variant (the aligned-layout
+    window program the dispatcher launches on TPU)."""
+    _, cluster, conn, tpu = fused_pair
+    sid = cluster.meta.get_space("futpu").value().space_id
+    snap = tpu.snapshot(sid)
+    ak, chunk, group = snap.aligned_kernel()
+    seeds = [[100], [101, 102], [103, 100]]
+    f0s = jnp.asarray(np.stack([snap.frontier_from_vids(s)
+                                for s in seeds]))
+    req = jnp.asarray(traverse.pad_edge_types([1]))
+    rng = np.random.default_rng(11)
+    m0 = jnp.asarray(rng.random((snap.num_parts, snap.cap_e)) > 0.4)
+    fsel = jnp.asarray(np.array([-1, 0, 0], np.int32))
+    got = np.asarray(fused.window_lane(
+        f0s, jnp.int32(2), ak, snap.kernel, req, jnp.stack([m0]),
+        fsel, chunk=chunk, group=group))
+    ref = np.asarray(traverse.multi_hop_masks_batch(
+        jnp.asarray(np.stack([snap.frontier_from_vids(s)
+                              for s in seeds])),
+        jnp.int32(2), ak, snap.kernel, req, chunk=chunk, group=group))
+    m0h = np.asarray(m0)
+    assert (got[0] == ref[0]).all()
+    assert (got[1] == (ref[1] & m0h)).all()
+    assert (got[2] == (ref[2] & m0h)).all()
+
+
+# ---------------------------------------------------------------------------
+# engine level: fused windows + fused aggregates vs the CPU pipe
+# ---------------------------------------------------------------------------
+
+def test_fused_windows_serve_identically(fused_pair):
+    """Concurrent sessions coalesce into fused window launches —
+    including a window that MIXES two compilable WHERE shapes and
+    unfiltered requests — and every result equals the CPU pipe."""
+    cpu_conn, cluster, conn, tpu = fused_pair
+    queries = [
+        "GO 2 STEPS FROM 100 OVER like YIELD like._dst",
+        "GO 2 STEPS FROM 101 OVER like WHERE $$.player.age > 33 "
+        "YIELD like._dst, $$.player.age",
+        "GO 2 STEPS FROM 102 OVER like WHERE $$.player.age > 30 "
+        "YIELD like._dst",
+        "GO FROM 100, 101, 102 OVER serve "
+        'WHERE $$.team.name == "Spurs" YIELD serve.start_year',
+    ]
+    expected = {q: sorted(map(repr, cpu_conn.must(q).rows))
+                for q in queries}
+    before = tpu.stats["fused_launches"]
+    errors = []
+
+    def worker(q, reps):
+        try:
+            c = cluster.connect()
+            c.must("USE futpu")
+            for _ in range(reps):
+                got = sorted(map(repr, c.must(q).rows))
+                assert got == expected[q], q
+        except Exception as e:   # noqa: BLE001 — surfaced below
+            errors.append((q, repr(e)))
+
+    threads = [threading.Thread(target=worker, args=(q, 4))
+               for q in queries for _ in range(3)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors[:3]
+    assert tpu.stats["fused_launches"] > before, tpu.fused_stats()
+    assert tpu.stats["batched_dispatches"] > 0
+
+
+def test_fused_aggregate_identity(fused_pair):
+    """The fused ungrouped aggregate program (traversal + err audit +
+    exact partials, one launch/one fetch) and the fused grouped
+    prologue serve device-side with CPU-identical rows."""
+    cpu_conn, _cluster, conn, tpu = fused_pair
+    served0 = tpu.stats["agg_served"]
+    fused0 = tpu.stats["fused_launches"]
+    for q in ("GO FROM 100, 101, 102 OVER serve YIELD "
+              "serve.start_year AS y | YIELD COUNT(*) AS n, "
+              "SUM($-.y) AS s, MIN($-.y) AS lo, MAX($-.y) AS hi, "
+              "AVG($-.y) AS a",
+              "GO FROM 100, 101, 102 OVER serve YIELD serve._dst AS t,"
+              " serve.start_year AS y | GROUP BY $-.t YIELD $-.t AS t,"
+              " COUNT(*) AS n, SUM($-.y) AS s, AVG($-.y) AS a"):
+        rc, rt = cpu_conn.must(q), conn.must(q)
+        assert sorted(map(repr, rc.rows)) == sorted(map(repr, rt.rows)), \
+            (q, rc.rows, rt.rows)
+    assert tpu.stats["agg_served"] == served0 + 2, \
+        tpu.agg_decline_reasons
+    assert tpu.stats["fused_launches"] >= fused0 + 2
+
+
+def test_fused_agg_err_cells_still_decline(fused_pair):
+    """The err-cell audit rides the fused program now — a query whose
+    YIELD the CPU walk would raise EvalError for must still decline to
+    the CPU pipe (identical rows, agg_declined counted)."""
+    cpu_conn, _cluster, conn, tpu = fused_pair
+    # add a second schema version so some rows' version lacks the field
+    conn.must("ALTER EDGE serve ADD (note int)")
+    cpu_conn.must("ALTER EDGE serve ADD (note int)")
+    try:
+        q = ("GO FROM 100 OVER serve YIELD serve.note AS x | "
+             "YIELD COUNT(*) AS n")
+        # pre-ALTER rows lack the field: the CPU walk raises EvalError
+        # — the fused err audit must DECLINE device serving so the TPU
+        # side fails exactly like the CPU side (a data-dependent
+        # error, not a silently-wrong device answer)
+        declined0 = tpu.stats["agg_declined"]
+        with pytest.raises(RuntimeError):
+            cpu_conn.must(q)
+        with pytest.raises(RuntimeError):
+            conn.must(q)
+        assert tpu.stats["agg_declined"] > declined0, \
+            tpu.agg_decline_reasons
+        assert tpu.agg_decline_reasons.get("err_cells", 0) >= 1
+    finally:
+        conn.must("ALTER EDGE serve DROP (note)")
+        cpu_conn.must("ALTER EDGE serve DROP (note)")
+
+
+# ---------------------------------------------------------------------------
+# bounded recompile guard (the recompile-bound contract)
+# ---------------------------------------------------------------------------
+
+def test_fused_signature_count_bounded(fused_pair):
+    """A mixed workload — varied steps, edge types, WHERE shapes and
+    aggregate specs, sequential AND windowed — must keep the fused-
+    program signature count under a fixed bound: steps/types/WHERE
+    constants are traced operands and WHERE shapes collapse to the
+    filter-arity bucket, so only (kind x batch bucket x filter bucket
+    x layout) can mint signatures. A recompile-per-window regression
+    (e.g. keying on steps or the filter expression) blows well past
+    the bound."""
+    cpu_conn, cluster, conn, tpu = fused_pair
+    cache0 = fused.compile_cache_size()
+    sigs0 = set(tpu._fused_signatures)
+    mixed = [
+        "GO FROM 100 OVER like YIELD like._dst",
+        "GO 2 STEPS FROM 100 OVER like YIELD like._dst",
+        "GO 3 STEPS FROM 100 OVER like YIELD like._dst",
+        "GO 2 STEPS FROM 100 OVER serve YIELD serve._dst",
+        "GO FROM 100 OVER like, serve YIELD _dst AS d",
+        "GO 2 STEPS FROM 100 OVER like WHERE $$.player.age > 33 "
+        "YIELD like._dst",
+        "GO 2 STEPS FROM 100 OVER like WHERE $$.player.age > 40 "
+        "YIELD like._dst",
+        'GO FROM 100 OVER serve WHERE $$.team.name == "Spurs" '
+        "YIELD serve._dst",
+        "GO FROM 100 OVER serve YIELD serve.start_year AS y | "
+        "YIELD COUNT(*) AS n, SUM($-.y) AS s",
+        "GO FROM 100 OVER serve YIELD serve.start_year AS y | "
+        "YIELD MIN($-.y) AS lo, MAX($-.y) AS hi",
+        "GO FROM 100, 101 OVER serve YIELD serve._dst AS t, "
+        "serve.start_year AS y | GROUP BY $-.t YIELD $-.t AS t, "
+        "COUNT(*) AS n",
+    ]
+    for q in mixed:
+        conn.must(q)
+    # the same mix again, concurrently, so windows of varied width form
+    def worker(q):
+        c = cluster.connect()
+        c.must("USE futpu")
+        for _ in range(2):
+            c.must(q)
+
+    threads = [threading.Thread(target=worker, args=(q,))
+               for q in mixed for _ in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    sigs = tpu._fused_signatures
+    assert len(sigs) <= 20, sorted(sigs)
+    # and the REAL XLA compile cache GROWTH over this workload stays
+    # in the same ballpark — a signature that retraced per call would
+    # blow past this by an entry per query repetition. Growth, not the
+    # absolute size: the jit caches are module-level and carry entries
+    # from every other engine/test in the process (including this
+    # module's background prewarm, hence the slack)
+    grown = fused.compile_cache_size() - cache0
+    assert grown <= 2 * len(sigs - sigs0) + 12, \
+        (grown, sorted(sigs - sigs0))
+    st = tpu.fused_stats()
+    assert st["hits"] >= 1 and st["launches"] >= 1
+    assert set(st) >= {"hits", "misses", "signatures", "launches",
+                       "declined", "xla_cache_entries"}
+
+
+# ---------------------------------------------------------------------------
+# frontier double-buffer pool accounting
+# ---------------------------------------------------------------------------
+
+def test_frontier_pool_overlap_accounting():
+    """stage() during an in-flight fetch counts as overlapped and
+    credits h2d_overlap_us at take(); a launch that was expected to
+    donate but left the buffer alive counts a donation fallback."""
+    pool = fused.FrontierPool()
+    a = np.zeros((2, 2, 4), bool)
+    s1 = pool.stage(a)
+    s1.take()
+    st = pool.snapshot()
+    assert st["stages"] == 1 and st["overlapped"] == 0
+    pool.fetch_begin()
+    try:
+        s2 = pool.stage(a)
+    finally:
+        pool.fetch_end()
+    s2.take()
+    st = pool.snapshot()
+    assert st["overlapped"] == 1
+    assert st["h2d_overlap_us"] >= 0
+    # the serve loop's OWN prefetch: staged first, then the loop
+    # blocks on the current chunk's masks — the fetch beginning AFTER
+    # the stage must still count the overlap, at take time
+    s3 = pool.stage(a)
+    pool.fetch_begin()
+    pool.fetch_end()
+    s3.take()
+    st = pool.snapshot()
+    assert st["overlapped"] == 2
+    # the buffer was never donated (no launch consumed it): expected-
+    # donation audit must count a fallback
+    s2.after_launch(donate_expected=True)
+    assert pool.snapshot()["donation_fallbacks"] == 1
+    # and an expected no-donation launch counts nothing
+    s1.after_launch(donate_expected=False)
+    assert pool.snapshot()["donation_fallbacks"] == 1
+
+
+def test_tpu_stats_blocks_present(fused_pair):
+    """/tpu_stats-facing accessors carry the fused_programs and
+    frontier_prefetch blocks with stable keys (flattened into
+    Prometheus by graphd's metric source)."""
+    _, _cluster, _conn, tpu = fused_pair
+    fs = tpu.fused_stats()
+    for k in ("hits", "misses", "signatures", "launches", "declined",
+              "xla_cache_entries"):
+        assert isinstance(fs[k], int), fs
+    ps = tpu.prefetch_stats()
+    for k in ("stages", "prefetch_hits", "prefetch_misses",
+              "overlapped", "h2d_overlap_us", "donation_fallbacks"):
+        assert isinstance(ps[k], int), ps

@@ -141,7 +141,15 @@ def test_storaged_death_falls_back_to_cpu(net_cluster):
     s2.stop()
     try:
         fallbacks0 = tpu.stats["fallbacks"]
-        tc.execute("GO FROM 100 OVER like YIELD like._dst")
+        # the version watch marks the space stale FAIL-FAST but
+        # asynchronously (its long-poll must first hit the dead
+        # socket) — poll within a bounded window instead of racing it
+        # with a single query
+        deadline = time.time() + 5.0
+        while time.time() < deadline and \
+                tpu.stats["fallbacks"] == fallbacks0:
+            tc.execute("GO FROM 100 OVER like YIELD like._dst")
+            time.sleep(0.05)
         # dead single-replica parts surface as a storage error on the
         # CPU path — either outcome is acceptable, but it must NOT be
         # served from the (now unverifiable) device snapshot
