@@ -1049,8 +1049,19 @@ def bench_chaos(out_path: str, trim: bool = False):
     # live — a stale or fault-corrupted cache entry would surface as a
     # mismatch here
     from nebula_tpu.common.flags import graph_flags, storage_flags
+    from nebula_tpu.common.status import ErrorCode
     graph_flags.set("cache_mode", "full")
     storage_flags.set("cache_mode", "full")
+    # chaos runs with the QoS ladder ARMED (docs/manual/14-qos.md):
+    # per-space admission + lane scheduling + a shed watermark must
+    # COMPOSE with breakers and CPU-pipe retries — the budgets are
+    # generous (this workload is legitimate), so sheds/denials are
+    # rare, but every E_OVERLOAD a worker does see is retried per the
+    # typed-retryable contract and counted, and any OTHER error still
+    # fails the tier
+    graph_flags.set("qos_plan", "chaos:rate=500,burst=500")
+    graph_flags.set("qos_shed_queue_depth", 64)
+    qos_overload_retries = [0]
     tpu = TpuGraphEngine()
     # tight ladder so the run observes the full trip -> half-open ->
     # recover cycle in seconds (production defaults are 3 / 0.5s / 30s)
@@ -1087,6 +1098,22 @@ def bench_chaos(out_path: str, trim: bool = False):
     errs: list = []
     olock = threading.Lock()
 
+    def must_qos(c, q):
+        """must() that honors the E_OVERLOAD contract: typed overloads
+        retry after a short backoff (counted); anything else raises
+        and fails the tier."""
+        for _ in range(400):
+            r = c.execute(q)
+            if r.ok():
+                return r
+            if r.code != ErrorCode.E_OVERLOAD:
+                raise RuntimeError(f"query failed [{r.code.name}]: "
+                                   f"{r.error_msg}\n  query: {q}")
+            with olock:
+                qos_overload_retries[0] += 1
+            time.sleep(0.02)
+        raise RuntimeError(f"E_OVERLOAD never cleared for: {q}")
+
     def worker(k):
         try:
             c = cluster.connect()
@@ -1101,7 +1128,7 @@ def bench_chaos(out_path: str, trim: bool = False):
                     # under the armed plan while the odd iterations
                     # still exercise cached serves' byte-identity
                     tpu.result_cache.clear()
-                r = c.must(q)
+                r = must_qos(c, q)
                 key = tuple(sorted(map(repr, r.rows)))
                 with olock:
                     observed.setdefault(q, set()).add(key)
@@ -1157,9 +1184,20 @@ def bench_chaos(out_path: str, trim: bool = False):
         time.sleep(0.1)
 
     rb = tpu.robustness_stats()
+    # sample the dispatcher qos block BEFORE disarming: the artifact
+    # must record the watermarks the run actually proved composition
+    # under, not the cleared values
+    qos_disp = tpu.qos_stats()
+    graph_flags.set("qos_plan", "")
+    graph_flags.set("qos_shed_queue_depth", 0)
     rec = {
         "trim": trim,
         "cache_mode": "full",
+        # QoS ladder armed for the whole run (composition proof):
+        # every overload a worker saw was typed + retried successfully
+        "qos": {"plan": "chaos:rate=500,burst=500",
+                "overload_retries": qos_overload_retries[0],
+                "dispatcher": qos_disp},
         "cache": tpu.cache_stats(),
         "seed": seed,
         "sessions": sessions,
@@ -1192,6 +1230,260 @@ def bench_chaos(out_path: str, trim: bool = False):
                             "mismatches")}}))
     if not ok:
         raise SystemExit(f"chaos tier FAILED: {rec}")
+    return rec
+
+
+# multi-tenant QoS tier bounds (docs/manual/14-qos.md): with the
+# abuser throttled, every small tenant's p99 must hold within this
+# factor of its own no-abuser baseline — with an absolute floor so
+# 1-core CPU-XLA timing noise can't flake a passing run
+QOS_P99_FACTOR = 8.0
+QOS_P99_FLOOR_MS = 250.0
+
+
+def bench_tenants(out_path: str, trim: bool = False):
+    """Multi-tenant QoS tier (`bench.py --tenants`): one ABUSIVE tenant
+    firing closed-loop bulk scans against many small tenants running
+    interactive point queries, all through one graphd/engine, with the
+    QoS ladder armed (per-space admission + priority lanes + shed
+    watermarks; docs/manual/14-qos.md). PASSES only when
+
+      (a) the abuser is actually throttled: admission denials > 0 and
+          the abuser observed typed E_OVERLOAD errors (with retry-after
+          hints) — and still made progress (throttled, not starved);
+      (b) every small tenant's p99 under abuse holds within
+          QOS_P99_FACTOR of its own no-abuser baseline (floor
+          QOS_P99_FLOOR_MS) — the isolation claim;
+      (c) the ONLY client-visible errors anywhere are E_OVERLOAD, and
+          none of them land on a small tenant;
+      (d) TPU-vs-CPU byte identity is green for every tenant's query
+          pool after the abuse phase.
+
+    Per-tenant slices (admitted/denied per space, lane rounds, sheds)
+    land in the JSON artifact — the same data /tpu_stats serves in its
+    "qos" block. Tier-1-safe on XLA:CPU (`--trim` shrinks everything
+    for the subprocess smoke test, tests/test_qos_smoke.py)."""
+    import random
+    import threading
+    from nebula_tpu.cluster import InProcCluster
+    from nebula_tpu.common.flags import graph_flags
+    from nebula_tpu.common.qos import admission
+    from nebula_tpu.common.status import ErrorCode
+    from nebula_tpu.engine_tpu import TpuGraphEngine
+
+    seed = int(os.environ.get("BENCH_TENANTS_SEED", 13))
+    n_small, sv, se, av, ae, phase_s, abusers = \
+        (3, 150, 900, 300, 2500, 2.5, 2) if trim \
+        else (5, 400, 3000, 900, 7000, 6.0, 3)
+    admission.reset()
+    tpu = TpuGraphEngine()
+    cluster = InProcCluster(tpu_engine=tpu)
+    conn = cluster.connect()
+    rng = np.random.default_rng(seed)
+
+    tenants = [f"tenant{i}" for i in range(n_small)]
+    pools: dict = {}
+    log(f"tenants tier: loading {n_small} small tenants "
+        f"(V={sv} E={se}) + 1 abuser (V={av} E={ae})...")
+    for t in tenants:
+        srcs, dsts, ts = zipf_edges(rng, sv, se, clip=60)
+        insert_person_knows(conn, t, 2, sv, srcs, dsts, ts)
+        hubs = [int(x) for x in
+                np.argsort(np.bincount(srcs, minlength=sv))[-3:]]
+        pools[t] = [
+            f"GO FROM {hubs[0]} OVER knows YIELD knows._dst",
+            f"GO 2 STEPS FROM {hubs[1]} OVER knows YIELD knows._dst",
+            f"GO FROM {hubs[1]}, {hubs[2]} OVER knows "
+            f"YIELD knows._dst, knows.ts",
+            f"GO 2 STEPS FROM {hubs[2]} OVER knows "
+            f"WHERE knows.ts > {TS_MAX // 2} YIELD knows._dst",
+        ]
+    srcs, dsts, ts = zipf_edges(rng, av, ae, clip=120)
+    insert_person_knows(conn, "abuser", 4, av, srcs, dsts, ts)
+    ab_hubs = [int(x) for x in
+               np.argsort(np.bincount(srcs, minlength=av))[-4:]]
+    abuser_pool = [
+        f"GO 3 STEPS FROM {ab_hubs[0]} OVER knows YIELD knows._dst",
+        f"GO 3 STEPS FROM {ab_hubs[1]} OVER knows "
+        f"WHERE knows.ts > {TS_MAX // 3} YIELD knows._dst, knows.ts",
+        f"GO 3 STEPS FROM {ab_hubs[2]}, {ab_hubs[3]} OVER knows "
+        f"YIELD knows._dst",
+    ]
+    for t in tenants + ["abuser"]:
+        sid = cluster.meta.get_space(t).value().space_id
+        tpu.prewarm(sid, block=True)
+    # one pass per pool off the clock (kernel compiles + plan cache)
+    for space, pool in list(pools.items()) + [("abuser", abuser_pool)]:
+        conn.must(f"USE {space}")
+        for q in pool:
+            conn.must(q)
+
+    errors: list = []             # every non-E_OVERLOAD failure
+    overloads = {"abuser": 0, "small": 0}
+    served = {"abuser": 0}
+    lock = threading.Lock()
+    lats = {t: {"baseline": [], "abuse": []} for t in tenants}
+
+    def tenant_worker(t, phase, stop):
+        rr = random.Random(seed * 100 + tenants.index(t))
+        c = cluster.connect()
+        c.must(f"USE {t}")
+        pool = pools[t]
+        while not stop.is_set():
+            q = pool[rr.randrange(len(pool))]
+            t0 = time.monotonic()
+            r = c.execute(q)
+            ms = (time.monotonic() - t0) * 1e3
+            with lock:
+                if r.ok():
+                    lats[t][phase].append(ms)
+                elif r.code == ErrorCode.E_OVERLOAD:
+                    overloads["small"] += 1
+                else:
+                    errors.append((t, phase, r.code.name,
+                                   r.error_msg))
+
+    def abuser_worker(k, stop):
+        rr = random.Random(seed * 999 + k)
+        c = cluster.connect()
+        c.must("USE abuser")
+        while not stop.is_set():
+            q = abuser_pool[rr.randrange(len(abuser_pool))]
+            r = c.execute(q)
+            with lock:
+                if r.ok():
+                    served["abuser"] += 1
+                elif r.code == ErrorCode.E_OVERLOAD:
+                    overloads["abuser"] += 1
+                else:
+                    errors.append(("abuser", "abuse", r.code.name,
+                                   r.error_msg))
+            if not r.ok():
+                # the E_OVERLOAD contract: typed + retryable — back
+                # off by (a fraction of) the hint and re-issue
+                time.sleep(0.02)
+
+    def run_phase(phase, with_abuser):
+        stop = threading.Event()
+        ths = [threading.Thread(target=tenant_worker,
+                                args=(t, phase, stop))
+               for t in tenants]
+        if with_abuser:
+            ths += [threading.Thread(target=abuser_worker,
+                                     args=(k, stop))
+                    for k in range(abusers)]
+        for th in ths:
+            th.start()
+        time.sleep(phase_s)
+        stop.set()
+        for th in ths:
+            th.join(timeout=120)
+        return [th.name for th in ths if th.is_alive()]
+
+    # ---- phase 1: small tenants alone (their own baseline)
+    stragglers = run_phase("baseline", False)
+
+    # ---- phase 2: abuser joins, QoS armed — admission throttles the
+    # abusive space, its scans classify onto the bulk lane, and the
+    # shed watermark stands behind both (ahead of deadline balks)
+    plan = "abuser:rate=8,burst=8,lane=bulk"
+    graph_flags.set("qos_plan", plan)
+    graph_flags.set("qos_shed_queue_depth", 32)
+    try:
+        stragglers += run_phase("abuse", True)
+    finally:
+        # sample the armed-state dispatcher block before disarming —
+        # the artifact records the configuration the phase ran under
+        qos_disp = tpu.qos_stats()
+        graph_flags.set("qos_plan", "")
+        graph_flags.set("qos_shed_queue_depth", 0)
+
+    # ---- identity: every tenant's pool TPU-vs-CPU byte-identical
+    identity_checked, mismatches = 0, []
+    for space, pool in list(pools.items()) + [("abuser", abuser_pool)]:
+        conn.must(f"USE {space}")
+        for q in pool:
+            rt = conn.must(q)
+            tpu.enabled = False
+            try:
+                rc = conn.must(q)
+            finally:
+                tpu.enabled = True
+            if sorted(map(repr, rt.rows)) != sorted(map(repr, rc.rows)):
+                mismatches.append(f"{space}: {q}")
+            identity_checked += 1
+
+    def pct(xs, p):
+        if not xs:
+            return None
+        return round(float(np.percentile(np.asarray(xs), p)), 2)
+
+    per_tenant: dict = {}
+    p99_ok = True
+    for t in tenants:
+        b, a = lats[t]["baseline"], lats[t]["abuse"]
+        bp99, ap99 = pct(b, 99), pct(a, 99)
+        bound = round(max((bp99 or 0) * QOS_P99_FACTOR,
+                          QOS_P99_FLOOR_MS), 2)
+        ok_t = bool(b) and bool(a) and ap99 <= bound
+        p99_ok = p99_ok and ok_t
+        per_tenant[t] = {
+            "baseline": {"n": len(b), "p50_ms": pct(b, 50),
+                         "p99_ms": bp99},
+            "abuse": {"n": len(a), "p50_ms": pct(a, 50),
+                      "p99_ms": ap99},
+            "p99_bound_ms": bound,
+            "p99_within_bound": ok_t,
+        }
+
+    adm = admission.describe()
+    ab = adm["spaces"].get("abuser", {})
+    rec = {
+        "trim": trim,
+        "seed": seed,
+        "tenants": {"small": n_small, "abusers": abusers},
+        "graph": {"small": {"V": sv, "E": se},
+                  "abuser": {"V": av, "E": ae}},
+        "phase_s": phase_s,
+        "qos_plan": plan,
+        "p99_factor": QOS_P99_FACTOR,
+        "p99_floor_ms": QOS_P99_FLOOR_MS,
+        "per_tenant": per_tenant,
+        "abuser": {"served": served["abuser"],
+                   "overloads": overloads["abuser"],
+                   "admitted": ab.get("admitted", 0),
+                   "denied": ab.get("denied", 0)},
+        "small_tenant_overloads": overloads["small"],
+        "client_errors": errors[:5],
+        "client_error_count": len(errors),
+        "identity": {"checked": identity_checked,
+                     "mismatches": mismatches},
+        "qos": {"admission": adm, "dispatcher": qos_disp},
+        "stragglers": stragglers,
+    }
+    abuser_throttled = ab.get("denied", 0) > 0 \
+        and overloads["abuser"] > 0
+    ok = (p99_ok and abuser_throttled and served["abuser"] > 0
+          and overloads["small"] == 0 and not errors
+          and not mismatches and not stragglers
+          and all(per_tenant[t]["abuse"]["n"] > 0 for t in tenants))
+    rec["ok"] = ok
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=1)
+    log(f"tenants tier: per_tenant={ {t: per_tenant[t]['abuse'] for t in tenants} } "
+        f"abuser={rec['abuser']} errors={len(errors)} "
+        f"mismatches={len(mismatches)} -> {out_path}")
+    print(json.dumps({
+        "metric": "tenants", "ok": ok,
+        "abuser": rec["abuser"],
+        "small_tenant_overloads": overloads["small"],
+        "client_errors": len(errors),
+        "p99_within_bound": {t: per_tenant[t]["p99_within_bound"]
+                             for t in tenants},
+        "identity_mismatches": len(mismatches)}))
+    if not ok:
+        raise SystemExit(f"tenants tier FAILED: "
+                         f"{json.dumps(rec, indent=1)[:4000]}")
     return rec
 
 
@@ -1452,7 +1744,11 @@ def bench_cluster(out_path: str, trim: bool = False):
             f"GO 2 STEPS FROM {hubs[2]} OVER knows YIELD knows.ts "
             f"AS t | YIELD COUNT(*) AS n, SUM($-.t) AS s",
         ]
-        gc.must(queries[0])          # compile + snapshot warm
+        for q in queries:            # compile + snapshot warm for
+            gc.must(q)               # EVERY shape: a cold XLA compile
+        # landing inside the short trim baseline window can eat the
+        # whole phase and record zero baseline latencies (observed as
+        # a load-dependent flake under the full tier-1 suite)
 
         # ---- traffic harness: closed-loop readers + one paced writer
         stop = threading.Event()
@@ -1752,6 +2048,13 @@ def bench_cluster(out_path: str, trim: bool = False):
 
 
 def main():
+    if "--tenants" in sys.argv:
+        out = os.environ.get("BENCH_TENANTS_OUT", "TENANTS_bench.json")
+        for a in sys.argv:
+            if a.startswith("--out="):
+                out = a.split("=", 1)[1]
+        bench_tenants(out, trim="--trim" in sys.argv)
+        return
     if "--cluster" in sys.argv:
         out = os.environ.get("BENCH_CLUSTER_OUT", "CLUSTER_bench.json")
         for a in sys.argv:

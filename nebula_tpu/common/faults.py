@@ -219,6 +219,16 @@ faults.register("encode.rows", doc="native nbc_encode_rows batch row "
                                    "encode (falls back to pure python)")
 faults.register("rpc.send", exc=InjectedConnectionFault,
                 doc="framed RPC transport send path")
+# the cluster's durability path (kvstore/wal.py): a fired wal.append
+# surfaces as the genuine failure mode — Wal.append returns False, so
+# the raft layers' E_WAL_FAIL handling engages exactly as it would for
+# a full disk; wal.sync raises (a failed fsync is not ignorable)
+faults.register("wal.append",
+                doc="segmented-WAL record append (raft leader local "
+                    "append AND follower replication appends)")
+faults.register("wal.sync",
+                doc="explicit WAL fsync (Wal.sync / "
+                    "wal_sync_every_append durability path)")
 
 if os.environ.get("NEBULA_TPU_FAULTS"):
     faults.set_plan(os.environ["NEBULA_TPU_FAULTS"])

@@ -187,6 +187,15 @@ storage_flags.declare("heartbeat_interval_secs", 10, MUTABLE,
 storage_flags.declare("raft_heartbeat_ms", 150, REBOOT,
                       "raft leader heartbeat/replication round period "
                       "for replicated parts (read at part bind time)")
+storage_flags.declare("wal_sync_every_append", False, REBOOT,
+                      "fsync the raft WAL on every record append "
+                      "(read at part bind time). Default off: appends "
+                      "ride buffered I/O — process-crash durability "
+                      "holds (restart replays the WAL) but a "
+                      "quorum-wide power loss can lose the tail. On "
+                      "buys power-loss durability at a per-append "
+                      "fsync (~0.1-10ms per record depending on the "
+                      "device; docs/manual/12-replication.md)")
 storage_flags.declare("raft_election_timeout_ms", 450, REBOOT,
                       "raft election timeout base (randomized 1-2x); "
                       "failover completes within ~2x this after a "

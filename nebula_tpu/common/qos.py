@@ -1,0 +1,369 @@
+"""Multi-tenant QoS: admission control, priority lanes, load shedding
+(docs/manual/14-qos.md).
+
+The serve path survives injected faults (the degradation ladder in
+common/faults.py) and host loss (the replicated cluster) — this module
+protects it BEFORE overload: one abusive tenant issuing bulk scans must
+not starve every interactive session. Three rungs, each engaging one
+step earlier than the next:
+
+  1. ADMISSION — per-space (tenant) token buckets at the graphd session
+     layer. Over-budget queries get a typed, retryable ``E_OVERLOAD``
+     with a retry-after hint: never a hang, never a generic failure.
+  2. PRIORITY LANES — the dispatcher classifies every GO as
+     ``interactive`` or ``bulk`` (statement kind + steps, overridable
+     per session or per space plan) and schedules group rounds
+     weighted-fair, so bulk scans cannot monopolize the concurrent
+     round slots (engine_tpu/engine.py).
+  3. LOAD SHEDDING — queue-depth + group-wait-p95 watermarks shed the
+     lowest-priority admitted work first (``shed:<reason>``-tagged
+     ``E_OVERLOAD``), engaging before ``tpu_query_deadline_ms`` blows
+     so deadline balks stay the last resort, ahead of the breakers.
+
+Activation mirrors common/faults.py: the MUTABLE graphd flag
+``qos_plan`` (hot-settable through /flags and the meta config pull) and
+the graphd admin endpoint ``/qos`` both feed the process-global
+``admission`` controller.
+
+Plan grammar: ``space:arg[,arg]...`` entries joined by ``;``. Args:
+
+    rate=<per_s>   token refill rate (required; 0 = deny all)
+    burst=<n>      bucket capacity (default max(rate, 1))
+    lane=<name>    force this space's queries onto a lane
+                   (``interactive`` | ``bulk``)
+
+A ``*`` entry is the default policy for spaces the plan does not name;
+with no ``*`` entry, unnamed spaces are unlimited. An empty plan clears
+everything (admission wide open).
+
+The module also hosts the per-query DEADLINE context (`set_query_
+deadline` / `deadline_remaining_s`): the graph service arms it from
+``tpu_query_deadline_ms`` at query start, and every retry loop
+downstream (StorageClient fan-out rounds) consults it so no retry
+budget can outlive the query's own deadline.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from contextvars import ContextVar
+from typing import Any, Dict, Optional, Tuple
+
+from .stats import stats as global_stats
+
+LANE_INTERACTIVE = "interactive"
+LANE_BULK = "bulk"
+LANES = (LANE_INTERACTIVE, LANE_BULK)
+
+
+def bulk_shape(steps: int, n_starts: int) -> bool:
+    """THE statement-shape bulk rule, shared by the graph-layer
+    classifier and the dispatcher's fallback (one copy: a threshold
+    change cannot silently diverge the two): deep (>= qos_bulk_steps)
+    or wide (>= qos_bulk_starts start vids) traversals are bulk."""
+    from .flags import graph_flags
+    return steps >= int(graph_flags.get("qos_bulk_steps", 3) or 3) \
+        or n_starts >= int(graph_flags.get("qos_bulk_starts", 32) or 32)
+
+# retry-after hints are clamped: a zero-rate (deny-all) bucket would
+# otherwise suggest an infinite wait, and sub-ms hints just busy-spin
+# well-behaved clients
+MIN_RETRY_AFTER_MS = 25
+MAX_RETRY_AFTER_MS = 60_000
+
+
+class OverloadShed(Exception):
+    """Raised by the dispatcher when a watermark sheds this request.
+    Converted to a typed ``E_OVERLOAD`` Result at the engine seam —
+    shedding must surface as a retryable client error, NEVER degrade to
+    the CPU pipe (that would shift the overload, not shed it)."""
+
+    def __init__(self, reason: str, retry_after_ms: int):
+        self.reason = reason
+        self.retry_after_ms = int(retry_after_ms)
+        super().__init__(
+            f"overloaded: shed at {reason} watermark (E_OVERLOAD, "
+            f"retryable); retry in ~{self.retry_after_ms}ms")
+
+
+class TokenBucket:
+    """Classic token bucket: `rate` tokens/s refill up to `burst`.
+    `try_acquire` never blocks — it returns (admitted, retry_after_s),
+    the retry hint being the exact refill time the missing tokens
+    need."""
+
+    __slots__ = ("rate", "burst", "_tokens", "_t", "_clock", "_lock")
+
+    def __init__(self, rate: float, burst: float,
+                 clock=time.monotonic):
+        self.rate = max(float(rate), 0.0)
+        self.burst = max(float(burst), 1.0)
+        self._tokens = self.burst
+        self._clock = clock
+        self._t = clock()
+        self._lock = threading.Lock()
+
+    def try_acquire(self, cost: float = 1.0) -> Tuple[bool, float]:
+        with self._lock:
+            if self.rate <= 0:
+                # rate=0 is the deny-all policy (emergency tenant
+                # block): no refill means any banked burst would be a
+                # one-shot leak per plan swap, so deny outright
+                return False, MAX_RETRY_AFTER_MS / 1e3
+            now = self._clock()
+            self._tokens = min(
+                self.burst, self._tokens + (now - self._t) * self.rate)
+            self._t = now
+            if self._tokens >= cost:
+                self._tokens -= cost
+                return True, 0.0
+            return False, (cost - self._tokens) / self.rate
+
+    def tokens(self) -> float:
+        with self._lock:
+            now = self._clock()
+            if self.rate > 0:
+                self._tokens = min(
+                    self.burst, self._tokens + (now - self._t) * self.rate)
+            self._t = now
+            return self._tokens
+
+
+class _Policy:
+    __slots__ = ("rate", "burst", "lane")
+
+    def __init__(self, rate: float, burst: Optional[float] = None,
+                 lane: Optional[str] = None):
+        self.rate = float(rate)
+        self.burst = float(burst) if burst is not None \
+            else max(self.rate, 1.0)
+        self.lane = lane
+
+    def describe(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"rate": self.rate, "burst": self.burst}
+        if self.lane:
+            out["lane"] = self.lane
+        return out
+
+
+class AdmissionController:
+    """Per-space token-bucket admission. `admit(space)` costs one dict
+    probe + one bucket op when a plan is armed, nothing when it is not
+    — cheap enough for every statement."""
+
+    def __init__(self, clock=time.monotonic):
+        self._lock = threading.Lock()
+        self._clock = clock
+        self._plan = ""
+        self._policies: Dict[str, _Policy] = {}
+        self._default: Optional[_Policy] = None
+        self._buckets: Dict[str, TokenBucket] = {}
+        self.admitted: Dict[str, int] = {}
+        self.denied: Dict[str, int] = {}
+
+    # ----------------------------------------------------------- plan
+    def set_plan(self, plan: str) -> None:
+        """Parse + install a plan string (module doc grammar). An empty
+        plan clears every policy. Raises ValueError on a malformed
+        plan, leaving the previous plan installed. Counters survive a
+        plan swap (observability never resets); buckets reset so the
+        new budgets take effect immediately."""
+        policies: Dict[str, _Policy] = {}
+        default: Optional[_Policy] = None
+        for part in (plan or "").split(";"):
+            part = part.strip()
+            if not part:
+                continue
+            name, _, args = part.partition(":")
+            name = name.strip()
+            if not name:
+                raise ValueError(f"bad qos plan entry {part!r}")
+            kw: Dict[str, Any] = {}
+            for a in args.split(","):
+                a = a.strip()
+                if not a:
+                    continue
+                k, eq, v = a.partition("=")
+                if not eq:
+                    raise ValueError(f"bad qos arg {a!r} in {part!r}")
+                if k == "rate":
+                    kw["rate"] = float(v)
+                elif k == "burst":
+                    kw["burst"] = float(v)
+                elif k == "lane":
+                    if v not in LANES:
+                        raise ValueError(
+                            f"unknown lane {v!r} in {part!r} "
+                            f"(expected one of {LANES})")
+                    kw["lane"] = v
+                else:
+                    raise ValueError(f"unknown qos arg {k!r} in {part!r}")
+            if "rate" not in kw:
+                raise ValueError(f"qos entry {part!r} needs rate=<per_s>")
+            if name == "*":
+                default = _Policy(**kw)
+            else:
+                policies[name] = _Policy(**kw)
+        with self._lock:
+            self._plan = plan or ""
+            self._policies = policies
+            self._default = default
+            self._buckets = {}
+
+    def clear(self) -> None:
+        self.set_plan("")
+
+    def armed(self) -> bool:
+        return bool(self._policies) or self._default is not None
+
+    # ---------------------------------------------------------- admit
+    def admit(self, space: str) -> Tuple[bool, int, Optional[str]]:
+        """-> (admitted, retry_after_ms, lane_override). Unlimited
+        spaces admit with no counter churn beyond the per-space
+        admitted tally."""
+        with self._lock:
+            pol = self._policies.get(space) or self._default
+            if pol is None:
+                self.admitted[space] = self.admitted.get(space, 0) + 1
+                return True, 0, None
+            bucket = self._buckets.get(space)
+            if bucket is None:
+                bucket = TokenBucket(pol.rate, pol.burst,
+                                     clock=self._clock)
+                self._buckets[space] = bucket
+        ok, retry_s = bucket.try_acquire()
+        retry_ms = min(max(int(retry_s * 1000) + 1, MIN_RETRY_AFTER_MS),
+                       MAX_RETRY_AFTER_MS)
+        with self._lock:
+            if ok:
+                self.admitted[space] = self.admitted.get(space, 0) + 1
+            else:
+                self.denied[space] = self.denied.get(space, 0) + 1
+        if ok:
+            global_stats.add_value("graph.qos.admitted", kind="counter")
+        else:
+            global_stats.add_value("graph.qos.admission_denied",
+                                   kind="counter")
+            global_stats.add_value("graph.qos.denied." + space,
+                                   kind="counter")
+        return ok, (0 if ok else retry_ms), pol.lane
+
+    # ---------------------------------------------------- observation
+    def describe(self) -> Dict[str, Any]:
+        """JSON-able controller state for /qos and the /tpu_stats qos
+        block — the per-tenant admission slices."""
+        with self._lock:
+            spaces: Dict[str, Any] = {}
+            names = set(self._policies) | set(self.admitted) \
+                | set(self.denied)
+            for name in sorted(names):
+                pol = self._policies.get(name)
+                entry: Dict[str, Any] = {
+                    "admitted": self.admitted.get(name, 0),
+                    "denied": self.denied.get(name, 0),
+                }
+                if pol is not None:
+                    entry["policy"] = pol.describe()
+                    b = self._buckets.get(name)
+                    if b is not None:
+                        entry["tokens"] = round(b.tokens(), 2)
+                spaces[name] = entry
+            return {
+                "plan": self._plan,
+                "armed": bool(self._policies) or self._default is not None,
+                "default": self._default.describe()
+                if self._default else None,
+                "spaces": spaces,
+            }
+
+    def reset(self) -> None:
+        """Disarm AND zero counters (test isolation only)."""
+        with self._lock:
+            self._plan = ""
+            self._policies = {}
+            self._default = None
+            self._buckets = {}
+            self.admitted = {}
+            self.denied = {}
+
+
+# process-global instance (the gflags-style singleton, like faults)
+admission = AdmissionController()
+
+
+def _wire_flags() -> None:
+    """QoS graphd flags, declared next to the controller they drive
+    (the `fault_plan` idiom — common/faults.py)."""
+    from .flags import MUTABLE, graph_flags
+    graph_flags.declare(
+        "qos_plan", "", MUTABLE,
+        "per-space admission plan (common/qos.py grammar, e.g. "
+        "'bulkspace:rate=5,burst=10,lane=bulk;*:rate=500'); empty "
+        "clears (admission wide open)")
+    graph_flags.declare(
+        "qos_shed_queue_depth", 0, MUTABLE,
+        "dispatcher queue-depth shed watermark: bulk-lane requests "
+        "shed (typed E_OVERLOAD) when the dispatch queue is this "
+        "deep, interactive at 2x. 0 disables")
+    graph_flags.declare(
+        "qos_shed_wait_p95_ms", 0, MUTABLE,
+        "group-wait p95 shed watermark (ms over the recent-round "
+        "window): bulk sheds at 1x, interactive at 2x — engages "
+        "before tpu_query_deadline_ms so deadline balks stay the "
+        "last resort. 0 disables")
+    graph_flags.declare(
+        "qos_bulk_steps", 3, MUTABLE,
+        "GO statements with at least this many steps classify onto "
+        "the bulk dispatcher lane (session/plan overrides win)")
+    graph_flags.declare(
+        "qos_bulk_starts", 32, MUTABLE,
+        "GO statements expanding at least this many start vertices "
+        "classify onto the bulk lane")
+
+    def _apply(name: str, value: Any) -> None:
+        if name == "qos_plan":
+            try:
+                admission.set_plan(str(value or ""))
+            except ValueError as e:
+                # a bad hot-set must never kill the watcher — but the
+                # flag value and the armed controller have just
+                # diverged, and that must be VISIBLE (the /qos
+                # endpoint 400s; this path can't): log + count
+                import logging
+                logging.getLogger("nebula_tpu.qos").warning(
+                    "qos_plan flag rejected, previous plan kept: %s", e)
+                global_stats.add_value("graph.qos.bad_plan",
+                                       kind="counter")
+
+    graph_flags.watch(_apply)
+
+
+_wire_flags()
+
+
+# ---------------------------------------------------------------------------
+# per-query deadline context (satellite: retry budgets must not outlive
+# the query's own deadline — docs/manual/14-qos.md, watermark ladder)
+# ---------------------------------------------------------------------------
+
+_query_deadline: ContextVar[Optional[float]] = ContextVar(
+    "nebula_tpu_query_deadline", default=None)
+
+
+def set_query_deadline(deadline_monotonic: Optional[float]):
+    """Arm this thread/context's query deadline (absolute
+    time.monotonic() seconds). Returns the reset token."""
+    return _query_deadline.set(deadline_monotonic)
+
+
+def clear_query_deadline(token) -> None:
+    _query_deadline.reset(token)
+
+
+def deadline_remaining_s() -> Optional[float]:
+    """Seconds left on the current query's deadline; None when no
+    deadline is armed. Negative means it already passed."""
+    dl = _query_deadline.get()
+    if dl is None:
+        return None
+    return dl - time.monotonic()

@@ -75,6 +75,11 @@ class ErrorCode(enum.IntEnum):
     E_BALANCER_RUNNING = -72
     E_NO_VALID_HOST = -73
     E_CORRUPTED_BALANCE_PLAN = -74
+    # multi-tenant QoS (common/qos.py; docs/manual/14-qos.md): the
+    # typed, RETRYABLE overload signal — admission denial or load shed.
+    # Clients back off by the retry-after hint and re-issue; it is
+    # never a hang and never masquerades as an execution failure
+    E_OVERLOAD = -81
 
 
 class NebulaError(Exception):

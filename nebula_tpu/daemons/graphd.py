@@ -113,6 +113,56 @@ def serve_graphd(meta_addr: str, host: str = "127.0.0.1", port: int = 0,
             return 200, freg.describe()
 
         web.register("/faults", faults_handler)
+
+        def qos_handler(params, body):
+            # /qos (docs/manual/14-qos.md): GET = admission controller
+            # + dispatcher lane/shed state; PUT body `plan=<grammar>`
+            # arms a per-space admission plan (same grammar as the
+            # `qos_plan` flag, common/qos.py), `session=<id>:<lane>`
+            # pins a session onto a lane (`<id>:` clears the pin);
+            # `?clear=1` disarms admission entirely.
+            from ..common.qos import LANES, admission
+            from urllib.parse import parse_qs as _pq
+            if body:
+                fields = {k: v[0] for k, v in
+                          _pq(body.decode(),
+                              keep_blank_values=True).items()}
+                if "plan" not in fields and "session" not in fields:
+                    return 400, {"error": "body must carry plan=<spec> "
+                                          "and/or session=<id>:<lane>"}
+                # validate EVERYTHING before mutating anything: a 400
+                # must mean "state untouched" — a body with a valid
+                # plan and a bad session must not half-apply
+                sess = None
+                lane = None
+                if "session" in fields:
+                    sid_s, _, lane = fields["session"].partition(":")
+                    if lane and lane not in LANES:
+                        return 400, {"error": f"unknown lane {lane!r} "
+                                              f"(expected {LANES})"}
+                    try:
+                        sr = service.sessions.find(int(sid_s))
+                    except ValueError:
+                        return 400, {"error": f"bad session id "
+                                              f"{sid_s!r}"}
+                    if not sr.ok():
+                        return 404, {"error": sr.status.msg}
+                    sess = sr.value()
+                if "plan" in fields:
+                    try:
+                        admission.set_plan(fields["plan"])
+                    except ValueError as e:
+                        return 400, {"error": str(e)}
+                if sess is not None:
+                    sess.qos_lane = lane or None
+            elif params.get("clear"):
+                admission.clear()
+            out = {"admission": admission.describe()}
+            if tpu_engine is not None:
+                out["dispatcher"] = tpu_engine.qos_stats()
+            return 200, out
+
+        web.register("/qos", qos_handler)
         if tpu_engine is not None:
             def trace(params, body):
                 # /trace?op=start&dir=/tmp/xprof | /trace?op=stop —
@@ -155,9 +205,21 @@ def serve_graphd(meta_addr: str, host: str = "127.0.0.1", port: int = 0,
                     cluster["balance"] = mc.balance_progress()
                 except Exception:
                     cluster["balance"] = None
+                from ..common.qos import admission as _adm
                 return 200, {
                     "stats": st,
                     "cluster": cluster,
+                    # multi-tenant QoS (docs/manual/14-qos.md): the
+                    # per-tenant admission slices (admitted/denied/
+                    # tokens per space) + the dispatcher's lane
+                    # occupancy and shed watermark state — the one
+                    # block that answers "who is being throttled, who
+                    # is being shed, and is the interactive lane
+                    # protected right now"
+                    "qos": {
+                        "admission": _adm.describe(),
+                        "dispatcher": tpu_engine.qos_stats(),
+                    },
                     # degradation ladder (docs/manual/9-robustness.md):
                     # live per-feature breaker states, trip/recovery
                     # counts, CPU-degraded serves, deadline bailouts,
@@ -272,6 +334,23 @@ def serve_graphd(meta_addr: str, host: str = "127.0.0.1", port: int = 0,
                     out[f"tpu_engine.fused.{k}"] = v
                 for k, v in tpu_engine.prefetch_stats().items():
                     out[f"tpu_engine.prefetch.{k}"] = v
+                # QoS lane/shed gauges (docs/manual/14-qos.md):
+                # scrape-flat twins of the /tpu_stats qos block (the
+                # per-event counters additionally stream through the
+                # StatsManager — graph.qos.* / tpu_engine.qos.shed.*)
+                q = tpu_engine.qos_stats()
+                out["tpu_engine.qos.queue_depth"] = q["queue_depth"]
+                out["tpu_engine.qos.group_wait_p95_ms"] = \
+                    q["group_wait_p95_ms"]
+                out["tpu_engine.qos.shed"] = q["shed"]
+                for lane, v in q["lane_rounds"].items():
+                    out[f"tpu_engine.qos.lane_rounds.{lane}"] = v
+                for lane, v in q["lane_rounds_in_flight"].items():
+                    out[f"tpu_engine.qos.lane_in_flight.{lane}"] = v
+                for reason, v in q["shed_reasons"].items():
+                    out[f"tpu_engine.qos.shed_reason.{reason}"] = v
+                for space, v in q["shed_by_space"].items():
+                    out[f"tpu_engine.qos.shed_by_space.{space}"] = v
                 return out
 
             web.add_metrics_source(tpu_metric_source)

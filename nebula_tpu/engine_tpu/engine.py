@@ -43,9 +43,10 @@ from ..common.cache import (CacheRung, plan_stage_enabled,
                             result_stage_enabled)
 from ..common.faults import CircuitBreaker, faults
 from ..common.flags import graph_flags
+from ..common.qos import LANE_BULK, LANE_INTERACTIVE, OverloadShed
 from ..common.stats import stats as global_stats
 from ..common.tracing import tracer as _tr
-from ..common.status import Status, StatusOr
+from ..common.status import ErrorCode, Status, StatusOr
 from ..filter.expressions import (Expression, InputPropExpr,
                                   VariablePropExpr, encode_expression)
 from ..parser import ast
@@ -97,7 +98,7 @@ class _GoReq:
     __slots__ = ("ctx", "s", "starts", "edge_types", "alias_map",
                  "name_by_type", "key", "yield_cols", "result",
                  "done", "claimed", "t_enq", "tctx", "dkey",
-                 "followers")
+                 "followers", "lane")
 
     def __init__(self, ctx, s, starts, edge_types, alias_map,
                  name_by_type, key, yield_cols, dkey=None):
@@ -115,6 +116,11 @@ class _GoReq:
         self.t_enq = 0.0
         self.dkey = dkey
         self.followers: Optional[List["_GoReq"]] = None
+        # QoS lane ("interactive" | "bulk"): set at enqueue from the
+        # ctx (graph-layer classification / overrides) or the engine's
+        # own statement-shape fallback; drives weighted-fair round
+        # selection and watermark shedding (docs/manual/14-qos.md)
+        self.lane = LANE_INTERACTIVE
         # the owner's trace context (None unsampled): whoever serves
         # this request — its own thread or a group leader — records
         # spans into the OWNER's trace via tracer.use (tracing.py)
@@ -171,6 +177,29 @@ class TpuGraphEngine:
         self._disp_cv = threading.Condition()
         self._disp_queue: List["_GoReq"] = []
         self._disp_serving: Dict[Tuple, "_GoReq"] = {}
+        # QoS priority lanes (docs/manual/14-qos.md): per-lane
+        # in-flight round counts + weighted-fair virtual time — the
+        # scheduler state _lane_may_lead_locked consults so bulk scans
+        # cannot monopolize the MAX_CONCURRENT_ROUNDS slots. All
+        # mutated under _disp_cv. Weights/cap are instance attrs so
+        # benches and tests can tighten them.
+        self.lane_weights = dict(self.LANE_WEIGHTS)
+        self.bulk_max_rounds = self.BULK_MAX_ROUNDS
+        self._lane_rounds = {LANE_INTERACTIVE: 0, LANE_BULK: 0}
+        self._lane_vtime = {LANE_INTERACTIVE: 0.0, LANE_BULK: 0.0}
+        # unclaimed queued requests per lane (enqueue +1, claim/balk
+        # -1): the O(1) early-out for _eligible_waiter_locked — the
+        # common no-cross-lane-contention case must not pay an
+        # O(queue) scan inside the cv wait predicate
+        self._lane_queued = {LANE_INTERACTIVE: 0, LANE_BULK: 0}
+        # recent group waits (ms) feeding the shed watermark's p95 —
+        # bounded sample window appended under _disp_cv in _mark_done
+        from collections import deque
+        self._wait_samples = deque(maxlen=self.WAIT_SAMPLE_WINDOW)
+        # per-reason / per-space shed tallies (the /tpu_stats qos
+        # block's per-tenant slices); bumped under _stats_lock
+        self.qos_shed_reasons: Dict[str, int] = {}
+        self.qos_shed_by_space: Dict[int, int] = {}
         # pull-mode budget: frontiers whose cumulative edge visits stay
         # under this run on host mirrors; larger ones amortize the dense
         # device dispatch (direction-optimized execution). The engine-
@@ -233,7 +262,13 @@ class TpuGraphEngine:
                       # the fused window/aggregate programs, and
                       # windows that mixed more distinct compiled
                       # WHERE masks than one program fuses
-                      "fused_launches": 0, "fused_declined": 0}
+                      "fused_launches": 0, "fused_declined": 0,
+                      # multi-tenant QoS (docs/manual/14-qos.md):
+                      # rounds granted per priority lane, and admitted
+                      # work shed at a watermark (typed E_OVERLOAD)
+                      # before it could queue toward its deadline
+                      "lane_rounds_interactive": 0,
+                      "lane_rounds_bulk": 0, "qos_shed": 0}
         # mesh execution service (mesh_exec.py): device-served queries
         # on SHARDED snapshots, per feature — the decline matrix the
         # round-5 verdict flagged (batched windows / aggregation / ALL
@@ -1323,6 +1358,17 @@ class TpuGraphEngine:
                                         dkey=None if ck is None
                                         else ck[:3] + ck[5:],
                                         yield_cols=yield_cols)
+        except OverloadShed:
+            # a shed is NOT a device failure: it must surface as the
+            # typed, retryable overload signal — feeding it to the
+            # breaker or the CPU pipe would either degrade everyone
+            # for load that is working as intended, or move the
+            # overload onto the slower path. It propagates AS the
+            # exception so the graph layer can build the E_OVERLOAD
+            # response with the machine-readable retry_after_ms hint
+            # intact — the same contract admission denials keep
+            # (docs/manual/14-qos.md)
+            raise
         except Exception as e:
             return self._device_failed("go", e)
         if r is not None:
@@ -1507,6 +1553,19 @@ class TpuGraphEngine:
     # per-root edge cap for the calibration walk probe — bounds the
     # engine-lock hold time on huge graphs (rate, not completion)
     CALIBRATION_PROBE_BUDGET = 1 << 18
+    # ---- multi-tenant QoS (docs/manual/14-qos.md) ----
+    # bulk-lane rounds may hold at most this many of the
+    # MAX_CONCURRENT_ROUNDS slots, so interactive lanes always have
+    # headroom no matter how many bulk scans queue
+    BULK_MAX_ROUNDS = 2
+    # weighted-fair round selection: a granted round advances its
+    # lane's virtual time by 1/weight — with 4:1 the bulk lane wins
+    # ~1 in 5 contended grants (and never more slots than its cap)
+    LANE_WEIGHTS = {LANE_INTERACTIVE: 4, LANE_BULK: 1}
+    # group-wait samples feeding the shed watermark's p95
+    WAIT_SAMPLE_WINDOW = 64
+    # minimum samples before the p95 watermark trusts the window
+    WAIT_SAMPLE_MIN = 8
 
     # ------------------------------------------------------------------
     # cross-session batched dispatch (round-4 verdict item 3): the
@@ -1534,9 +1593,30 @@ class TpuGraphEngine:
                       tuple(edge_types)), yield_cols, dkey=dkey)
         req.t_enq = time.monotonic()
         req.tctx = _tr.current_state()
+        lane = getattr(ctx, "qos_lane", None)
+        if lane is None:
+            lane = self._classify_lane(s, starts)
+        elif lane == LANE_INTERACTIVE \
+                and not getattr(ctx, "qos_lane_pinned", False) \
+                and self._classify_lane(s, starts) == LANE_BULK:
+            # shape-classified interactive at parse time, but the
+            # RESOLVED start set is wide (e.g. a pipe fanned out
+            # thousands of start vids the parser couldn't see):
+            # upgrade to bulk so width-abuse can't ride the protected
+            # lane. Explicit pins (session / plan lane=) are honored.
+            lane = LANE_BULK
+        req.lane = lane
+        # load-shedding watermark (docs/manual/14-qos.md): admitted
+        # work sheds HERE, before it queues — bulk first (1x), then
+        # interactive (2x) — so by the time deadline balks engage the
+        # queue has already stopped growing. A shed is a typed,
+        # retryable E_OVERLOAD, never a CPU fallback (that would move
+        # the overload, not shed it).
+        self._maybe_shed(req)
         dl = getattr(ctx, "_tpu_deadline", None)
         with self._disp_cv:
             self._disp_queue.append(req)
+            self._lane_queued[req.lane] += 1
         batch = None
         timed_out = False
         # dispatcher_wait: from enqueue until the owner either wakes
@@ -1550,7 +1630,8 @@ class TpuGraphEngine:
                         req.claimed
                         or req.key in self._disp_serving
                         or len(self._disp_serving)
-                        >= self.MAX_CONCURRENT_ROUNDS):
+                        >= self.MAX_CONCURRENT_ROUNDS
+                        or not self._lane_may_lead_locked(req)):
                     timeout = None
                     if dl is not None:
                         timeout = dl - time.monotonic()
@@ -1565,6 +1646,8 @@ class TpuGraphEngine:
                             self._disp_queue = [
                                 r for r in self._disp_queue
                                 if r is not req]
+                            if self._lane_queued.get(req.lane, 0) > 0:
+                                self._lane_queued[req.lane] -= 1
                             req.done = True
                             req.result = None
                             timed_out = True
@@ -1585,11 +1668,38 @@ class TpuGraphEngine:
                                     if id(r) not in taken]
                 for r in batch:
                     r.claimed = True
+                    # decrement by each request's ORIGINAL lane,
+                    # before the owner-lane normalization below
+                    if self._lane_queued.get(r.lane, 0) > 0:
+                        self._lane_queued[r.lane] -= 1
+                # the round is granted to THIS request's lane: pair
+                # the accounting with the recorded owner (batch[0]) so
+                # _release_round decrements the same lane it charges
+                batch[0].lane = req.lane
+                self._lane_rounds[req.lane] += 1
+                other = LANE_BULK if req.lane == LANE_INTERACTIVE \
+                    else LANE_INTERACTIVE
+                w = max(self.lane_weights.get(req.lane, 1), 1)
+                # weighted virtual time, deficit-bounded: an idle lane
+                # can bank at most ~one round of credit, so a returning
+                # lane gets priority without an exclusive burst
+                self._lane_vtime[req.lane] = max(
+                    self._lane_vtime[req.lane],
+                    self._lane_vtime[other] - 1.0) + 1.0 / w
+                self.stats["lane_rounds_" + req.lane] += 1
                 self._disp_serving[req.key] = batch[0]
                 self.stats["disp_rounds"] += 1
                 self.stats["disp_group_keys"] += 1 + len(
                     {r.key for r in self._disp_queue
                      if r.key != req.key})
+                # the grant itself can UNBLOCK a deferred waiter: the
+                # eligible waiter another lane yielded to is now
+                # claimed, and the vtime advance may flip the weighted
+                # comparison — before lanes existed a grant only ever
+                # tightened the wait predicate, so this notify is
+                # newly load-bearing (a deferred thread must re-check
+                # NOW, not when this round eventually releases)
+                self._disp_cv.notify_all()
             if not waited:
                 # elected leader: the wait is over — serving time is
                 # accounted by the window/kernel/materialize spans
@@ -1629,7 +1739,141 @@ class TpuGraphEngine:
         with self._disp_cv:
             if self._disp_serving.get(key) is owner:
                 del self._disp_serving[key]
+                ln = owner.lane
+                if self._lane_rounds.get(ln, 0) > 0:
+                    self._lane_rounds[ln] -= 1
                 self._disp_cv.notify_all()
+
+    # ------------------------------------------------------------------
+    # multi-tenant QoS: priority lanes + load shedding
+    # (common/qos.py; docs/manual/14-qos.md)
+    # ------------------------------------------------------------------
+    def _classify_lane(self, s, starts) -> str:
+        """Statement-shape fallback when the graph layer didn't set
+        ctx.qos_lane (direct-engine callers) — the ONE shared rule,
+        qos.bulk_shape, same as the graph-layer classifier."""
+        from ..common.qos import bulk_shape
+        if bulk_shape(int(s.step.steps), len(starts)):
+            return LANE_BULK
+        return LANE_INTERACTIVE
+
+    def _lane_may_lead_locked(self, req: "_GoReq") -> bool:
+        """May this request start a new round NOW? (under _disp_cv.)
+        Two rules on top of the slot/key checks:
+
+        - bulk cap: bulk rounds never hold more than bulk_max_rounds
+          slots, so interactive work always has headroom;
+        - weighted fairness: a lane whose virtual time is ahead yields
+          the slot when the OTHER lane has an eligible waiter (an
+          unclaimed request whose key is idle — an active thread that
+          will take the slot the moment this one defers). Yielding to
+          a waiter that could not lead would idle the slot, so
+          eligibility is checked, not just presence."""
+        lane = req.lane
+        other = LANE_BULK if lane == LANE_INTERACTIVE \
+            else LANE_INTERACTIVE
+        if lane == LANE_BULK and \
+                self._lane_rounds[LANE_BULK] >= max(self.bulk_max_rounds, 1):
+            return False
+        if self._lane_vtime[lane] > self._lane_vtime[other] and \
+                self._eligible_waiter_locked(other):
+            return False
+        return True
+
+    def _eligible_waiter_locked(self, lane: str) -> bool:
+        if self._lane_queued.get(lane, 0) <= 0:
+            return False    # O(1) common case: no cross-lane waiters
+        if lane == LANE_BULK and \
+                self._lane_rounds[LANE_BULK] >= max(self.bulk_max_rounds, 1):
+            return False    # capped out: it could not take the slot
+        for r in self._disp_queue:
+            if not r.claimed and r.lane == lane \
+                    and r.key not in self._disp_serving:
+                return True
+        return False
+
+    def _wait_p95_ms_locked(self) -> float:
+        """p95 of the recent group-wait window (ms); 0 until the
+        window has WAIT_SAMPLE_MIN samples (a cold dispatcher must
+        not shed on noise)."""
+        n = len(self._wait_samples)
+        if n < self.WAIT_SAMPLE_MIN:
+            return 0.0
+        xs = sorted(self._wait_samples)
+        return xs[min(int(n * 0.95), n - 1)]
+
+    def _maybe_shed(self, req: "_GoReq") -> None:
+        """Watermark check at enqueue time — raises OverloadShed
+        (converted to a typed E_OVERLOAD at the execute_go seam) when
+        a shed watermark is crossed. Bulk sheds at 1x the watermark,
+        interactive only at 2x: the lowest-priority admitted work goes
+        first. Disabled (both flags 0) this is two flag reads."""
+        qd = int(graph_flags.get("qos_shed_queue_depth", 0) or 0)
+        wp = float(graph_flags.get("qos_shed_wait_p95_ms", 0) or 0)
+        if qd <= 0 and wp <= 0:
+            return
+        mult = 1 if req.lane == LANE_BULK else 2
+        with self._disp_cv:
+            depth = len(self._disp_queue)
+            p95 = self._wait_p95_ms_locked()
+        reason = None
+        if qd > 0 and depth >= qd * mult:
+            reason = "queue_depth"
+        elif wp > 0 and p95 >= wp * mult:
+            reason = "wait_p95"
+        if reason is None:
+            return
+        retry_ms = max(int(p95) or 0, 25)
+        space_id = req.key[0]
+        with self._stats_lock:
+            self.stats["qos_shed"] += 1
+            rk = f"{reason}:{req.lane}"
+            self.qos_shed_reasons[rk] = \
+                self.qos_shed_reasons.get(rk, 0) + 1
+            self.qos_shed_by_space[space_id] = \
+                self.qos_shed_by_space.get(space_id, 0) + 1
+        global_stats.add_value("tpu_engine.qos.shed." + reason,
+                               kind="counter")
+        _tr.tag_root("shed", f"{reason}:{req.lane}")
+        raise OverloadShed(reason, retry_ms)
+
+    def qos_stats(self) -> Dict[str, Any]:
+        """The /tpu_stats "qos" dispatcher block: live lane occupancy,
+        the shed watermark inputs, per-reason and per-space shed
+        slices (docs/manual/14-qos.md)."""
+        with self._disp_cv:
+            depth = len(self._disp_queue)
+            in_flight = dict(self._lane_rounds)
+            queued = dict(self._lane_queued)
+            p95 = self._wait_p95_ms_locked()
+        with self._stats_lock:
+            shed_reasons = dict(self.qos_shed_reasons)
+            shed_by_space = {str(k): v for k, v in
+                             self.qos_shed_by_space.items()}
+            lanes = {
+                LANE_INTERACTIVE:
+                    self.stats["lane_rounds_interactive"],
+                LANE_BULK: self.stats["lane_rounds_bulk"],
+            }
+            shed = self.stats["qos_shed"]
+        return {
+            "queue_depth": depth,
+            "group_wait_p95_ms": round(p95, 2),
+            "lane_rounds": lanes,
+            "lane_rounds_in_flight": in_flight,
+            "lane_queued": queued,
+            "lane_weights": dict(self.lane_weights),
+            "bulk_max_rounds": self.bulk_max_rounds,
+            "shed": shed,
+            "shed_reasons": shed_reasons,
+            "shed_by_space": shed_by_space,
+            "watermarks": {
+                "queue_depth":
+                    graph_flags.get("qos_shed_queue_depth", 0),
+                "wait_p95_ms":
+                    graph_flags.get("qos_shed_wait_p95_ms", 0),
+            },
+        }
 
     def _mark_done(self, reqs: List["_GoReq"], early: bool = False) -> None:
         """Flip `done` and wake the owners NOW — waiters wake on their
@@ -1675,6 +1919,8 @@ class TpuGraphEngine:
                 self.stats["group_wait_count"] += 1
                 if w > self.stats["group_wait_us_max"]:
                     self.stats["group_wait_us_max"] = w
+                # shed-watermark feed: recent per-request waits (ms)
+                self._wait_samples.append(w / 1e3)
                 if early:
                     self.stats["early_releases"] += 1
             self._disp_cv.notify_all()

@@ -63,6 +63,16 @@ class ExecContext:
         self.session = session
         self.variables: Dict[str, InterimResult] = {}
         self.input: Optional[InterimResult] = None
+        # QoS dispatcher lane for this query (common/qos.py): set by
+        # the graph engine from session override > space plan >
+        # statement shape; None lets the dispatcher classify itself.
+        # `qos_lane_pinned` marks an EXPLICIT override (session pin /
+        # plan lane=): the dispatcher honors it verbatim, whereas a
+        # shape-classified interactive lane may still be upgraded to
+        # bulk once the RESOLVED start set turns out wide (a pipe
+        # feeding thousands of start vids parses as 0 literal vids)
+        self.qos_lane: Optional[str] = None
+        self.qos_lane_pinned: bool = False
 
     @property
     def meta(self):

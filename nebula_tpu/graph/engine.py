@@ -10,6 +10,7 @@ from __future__ import annotations
 import time
 from typing import Callable, Dict, Optional
 
+from ..common import qos
 from ..common.cache import CacheRung, plan_stage_enabled
 from ..common.status import ErrorCode, Status, StatusOr
 from ..common.tracing import (ActiveQueryRegistry, SlowQueryLog,
@@ -35,6 +36,56 @@ _SCHEMA_KINDS = {ast.Kind.CREATE_TAG, ast.Kind.CREATE_EDGE, ast.Kind.ALTER_TAG,
 _GOD_KINDS = {ast.Kind.CREATE_SPACE, ast.Kind.DROP_SPACE, ast.Kind.BALANCE,
               ast.Kind.CREATE_USER, ast.Kind.DROP_USER, ast.Kind.CONFIG,
               ast.Kind.CREATE_SNAPSHOT, ast.Kind.DROP_SNAPSHOT}
+
+# data-plane statement kinds gated by per-space admission (common/qos
+# .py; docs/manual/14-qos.md). Admin/DDL/session statements are exempt
+# — a throttled tenant must still be able to USE, SHOW and fix its own
+# schema; it's the scan/write volume that overloads the serve path.
+_QOS_GATED_KINDS = _WRITE_KINDS | {
+    ast.Kind.GO, ast.Kind.FIND_PATH, ast.Kind.FETCH_VERTICES,
+    ast.Kind.FETCH_EDGES, ast.Kind.YIELD, ast.Kind.PIPE,
+    ast.Kind.SET_OP, ast.Kind.ASSIGNMENT, ast.Kind.ORDER_BY,
+    ast.Kind.LIMIT, ast.Kind.GROUP_BY}
+
+
+def _lane_leaf(s: ast.Sentence) -> ast.Sentence:
+    """The leftmost data-bearing leaf of a pipe/assignment tree — the
+    statement whose shape decides the lane (GO ... | YIELD agg rides
+    the GO's scan weight)."""
+    while True:
+        if s.kind == ast.Kind.PIPE or s.kind == ast.Kind.SET_OP:
+            s = s.left
+        elif s.kind == ast.Kind.ASSIGNMENT:
+            s = s.sentence
+        else:
+            return s
+
+
+def sentence_lane(s0: ast.Sentence) -> str:
+    """Statement-shape lane classification for ONE sentence
+    (docs/manual/14-qos.md): deep or wide GO traversals and bounded
+    path searches are BULK (scan-weight work); point lookups and
+    shallow hops are INTERACTIVE. Session and space-plan overrides
+    win over this. The steps/starts thresholds live in ONE place —
+    qos.bulk_shape — shared with the dispatcher's fallback."""
+    s = _lane_leaf(s0)
+    if s.kind == ast.Kind.GO:
+        steps = int(getattr(s.step, "steps", 1) or 1)
+        starts = getattr(s.from_, "vids", None) or ()
+        if qos.bulk_shape(steps, len(starts)):
+            return qos.LANE_BULK
+    elif s.kind == ast.Kind.FIND_PATH:
+        if qos.bulk_shape(int(getattr(s.step, "steps", 0) or 0), 0):
+            return qos.LANE_BULK
+    return qos.LANE_INTERACTIVE
+
+
+def classify_lane(seq: ast.SequentialSentences) -> str:
+    """Lane for a whole statement sequence: bulk if ANY sentence is."""
+    for s0 in seq.sentences:
+        if sentence_lane(s0) == qos.LANE_BULK:
+            return qos.LANE_BULK
+    return qos.LANE_INTERACTIVE
 
 
 class PermissionManager:
@@ -151,8 +202,54 @@ class ExecutionEngine:
         tpu = self.tpu_engine
         profile_seq0 = tpu.profile_seq if tpu is not None else 0
         for sentence in seq.sentences:
-            with tracer.span("exec." + sentence.kind.value):
-                r = self._run(ctx, sentence)
+            # multi-tenant QoS (common/qos.py; docs/manual/14-qos.md):
+            # per-space token-bucket admission gates every data-plane
+            # SENTENCE against the session's CURRENT space — per
+            # sentence, not per request, so `USE abuser; GO ...`
+            # cannot slip through on the pre-USE space and a 50-GO
+            # compound cannot ride one token. Denials are typed +
+            # retryable (E_OVERLOAD with a retry-after hint), tagged
+            # on the trace root and counted per tenant — never a
+            # hang, never a generic failure. The lane the sentence
+            # rides (session override > space-plan override >
+            # statement shape) travels on the ctx for the
+            # dispatcher's weighted-fair scheduling.
+            space = session.space_name or ""
+            if space and sentence.kind in _QOS_GATED_KINDS:
+                admitted, retry_ms, lane_override = \
+                    qos.admission.admit(space)
+                if not admitted:
+                    tracer.tag_root("admission_denied", space)
+                    from ..common.stats import stats
+                    stats.add_value("graph.query_overload",
+                                    kind="counter")
+                    resp.code = ErrorCode.E_OVERLOAD
+                    resp.error_msg = (
+                        f"space '{space}' over its admission budget "
+                        f"(E_OVERLOAD, retryable); retry in "
+                        f"~{retry_ms}ms")
+                    resp.profile = {"retry_after_ms": retry_ms}
+                    resp.latency_us = int((time.monotonic() - t0) * 1e6)
+                    return resp
+                pinned = getattr(session, "qos_lane", None) \
+                    or lane_override
+                ctx.qos_lane = pinned or sentence_lane(sentence)
+                ctx.qos_lane_pinned = pinned is not None
+                if ctx.qos_lane == qos.LANE_BULK:
+                    tracer.tag_root("qos_lane", qos.LANE_BULK)
+            try:
+                with tracer.span("exec." + sentence.kind.value):
+                    r = self._run(ctx, sentence)
+            except qos.OverloadShed as e:
+                # a dispatcher shed surfaces with the SAME machine-
+                # readable contract as an admission denial: typed
+                # E_OVERLOAD + profile retry_after_ms (the trace root
+                # was already tagged shed:<reason> at the shed site)
+                resp.code = ErrorCode.E_OVERLOAD
+                resp.error_msg = str(e)
+                resp.profile = {"retry_after_ms": e.retry_after_ms}
+                resp.latency_us = int((time.monotonic() - t0) * 1e6)
+                return resp
             if not r.ok():
                 resp.code = r.status.code
                 resp.error_msg = r.status.msg or r.status.code.name
@@ -302,15 +399,29 @@ class GraphService:
         qtok = self.active_queries.register(
             text, session=session_id, user=session.user,
             trace_id=handle.trace_id)
+        # arm the per-query deadline context (common/qos.py): every
+        # retry loop downstream — the StorageClient fan-out rounds in
+        # particular — consults the remaining budget, so a stalled
+        # election's retries can never outlive the query's own
+        # tpu_query_deadline_ms (deadline balks beat open-ended
+        # retrying; docs/manual/14-qos.md watermark ladder)
+        from ..common.flags import graph_flags
+        dl_ms = graph_flags.get("tpu_query_deadline_ms", 0) or 0
+        dl_tok = qos.set_query_deadline(
+            time.monotonic() + dl_ms / 1e3) if dl_ms > 0 else None
         try:
             resp = self.engine.execute(session, text)
         except BaseException:
             # the handle owns this thread's trace context: finish it
             # even on an engine bug, or the NEXT query on this
             # connection thread would record into a dead trace
+            if dl_tok is not None:
+                qos.clear_query_deadline(dl_tok)
             self.active_queries.unregister(qtok)
             handle.finish(ok=False, error=True)
             raise
+        if dl_tok is not None:
+            qos.clear_query_deadline(dl_tok)
         self.active_queries.unregister(qtok)
         trace = handle.finish(ok=resp.ok(), latency_us=resp.latency_us)
         if trace is not None and profiled and resp.ok():

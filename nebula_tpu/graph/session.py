@@ -23,6 +23,12 @@ class ClientSession:
         self.user = user
         self.space_name: Optional[str] = None
         self.space_id: int = -1
+        # QoS lane override (common/qos.py; docs/manual/14-qos.md):
+        # "interactive" / "bulk" pins every statement of this session
+        # onto that dispatcher lane, beating statement-shape
+        # classification; None = classify per statement. Settable
+        # through the graphd /qos endpoint (session=<id>:<lane>).
+        self.qos_lane: Optional[str] = None
         self._last_access = time.time()
 
     def charge(self) -> None:

@@ -107,8 +107,15 @@ class RaftPart:
         self._last_quorum_contact = time.monotonic()
 
         os.makedirs(wal_dir, exist_ok=True)
+        # wal_sync_every_append (REBOOT gflag, read at part bind like
+        # the raft timing flags): per-append fsync for power-loss
+        # durability — docs/manual/12-replication.md, durability
+        # caveats
+        from ...common.flags import storage_flags
         self.wal = Wal(os.path.join(wal_dir, "wal"), ttl_secs=wal_ttl_secs,
-                       max_file_size=wal_file_size)
+                       max_file_size=wal_file_size,
+                       sync_every_append=bool(storage_flags.get(
+                           "wal_sync_every_append", False)))
         self._state_path = os.path.join(wal_dir, "raft_state")
         self._load_state()
 

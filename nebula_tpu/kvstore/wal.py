@@ -19,6 +19,7 @@ from dataclasses import dataclass
 from typing import Iterator, List, Optional
 
 from .. import native
+from ..common.faults import InjectedFault, faults
 
 
 @dataclass(frozen=True)
@@ -36,6 +37,7 @@ class Wal:
                  max_file_size: int = 16 * 1024 * 1024,
                  sync_every_append: bool = False):
         self._lib = native.load()
+        self.sync_every_append = bool(sync_every_append)
         self._h = self._lib.nwal_open(
             dir_path.encode(), ttl_secs, max_file_size,
             1 if sync_every_append else 0)
@@ -69,6 +71,15 @@ class Wal:
 
     def append(self, log_id: int, term: int, cluster: int,
                data: bytes) -> bool:
+        # fault point `wal.append` (common/faults.py): an injected
+        # failure takes the REAL failure shape — a False return, the
+        # same thing a full disk produces — so the raft quorum/retry
+        # machinery above is what gets exercised, not exception
+        # plumbing. Latency mode simply sleeps (a slow disk).
+        try:
+            faults.fire("wal.append")
+        except InjectedFault:
+            return False
         with self._lock:
             if self._closed:
                 return False
@@ -95,6 +106,9 @@ class Wal:
             return self._lib.nwal_clean_ttl(self._h)
 
     def sync(self) -> None:
+        # fault point `wal.sync`: raises — a failed fsync means the
+        # durability promise is broken and callers must see it
+        faults.fire("wal.sync")
         with self._lock:
             if not self._closed:
                 self._lib.nwal_sync(self._h)

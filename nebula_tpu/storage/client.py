@@ -190,25 +190,50 @@ class StorageClient:
             if not pending:
                 break
             from ..common.faults import jittered_delay
+            from ..common.qos import deadline_remaining_s
+            # deadline budget (ISSUE 8 satellite; docs/manual/14-qos
+            # .md): the retry loop must not outlive the query's own
+            # tpu_query_deadline_ms — a stalled election otherwise
+            # burns up to ~1.5s of hintless backoff past the deadline
+            # the client was promised. Out of budget -> the pending
+            # parts balk to a typed E_TIMEOUT (deadline_exceeded),
+            # tagged on the trace root and counted; with budget left,
+            # the sleep is clamped to what remains.
+            rem = deadline_remaining_s()
+            if rem is not None and rem <= 0:
+                stats.add_value("storage_client.fanout_deadline_balk",
+                                kind="counter")
+                tracer.tag_root("degraded", "deadline:storage_fanout")
+                for part in pending:
+                    # overwrite the round's retryable verdict (e.g.
+                    # E_LEADER_CHANGED): the query is out of budget,
+                    # and deadline_exceeded is the truthful terminal
+                    # classification
+                    resp.results[part] = PartResult(
+                        ErrorCode.E_TIMEOUT, None)
+                pending = {}
+                break
             left = attempt < max_retries
             if saw_no_part:
                 self._count_fanout_retry("no_part", left)
                 if self._refresh_hosts is not None:
                     self._refresh_hosts()
-                time.sleep(0.2)
+                time.sleep(0.2 if rem is None else min(0.2, rem))
             elif saw_hintless:
                 # election in progress / dead host: bounded expo jitter
                 # (same policy as _kv_retry) — the cumulative budget
                 # spans an election instead of burning retries in 150ms
                 self._count_fanout_retry("hintless", left)
                 if left:
-                    time.sleep(jittered_delay(*self.KV_BACKOFF["hintless"],
-                                              attempt))
+                    d = jittered_delay(*self.KV_BACKOFF["hintless"],
+                                       attempt)
+                    time.sleep(d if rem is None else min(d, rem))
             else:
                 self._count_fanout_retry("leader_moved", left)
                 if left:
-                    time.sleep(jittered_delay(
-                        *self.KV_BACKOFF["leader_moved"], attempt))
+                    d = jittered_delay(
+                        *self.KV_BACKOFF["leader_moved"], attempt)
+                    time.sleep(d if rem is None else min(d, rem))
         # parts still unreachable after every retry must surface as
         # errors — a missing entry would read as success to executors
         for part in pending:

@@ -539,6 +539,183 @@ def _run_soak_concurrent(seconds, threads, v, e, seed,
     return out
 
 
+def run_soak_tenants(seconds: float = 8.0, seed: int = 21) -> dict:
+    """`--tenants`: skewed multi-tenant load under the QoS ladder
+    (docs/manual/14-qos.md) — one abusive tenant firing closed-loop
+    bulk scans against small tenants running interactive reads, with
+    per-space admission + lanes + a shed watermark armed, and the
+    small tenants' CPU/TPU identity checks running CONTINUOUSLY (the
+    soak's signature move). ok requires: identity green, the abuser
+    throttled (admission denials + typed E_OVERLOAD observed), zero
+    overloads on the small tenants, and zero non-overload errors."""
+    import threading
+
+    import numpy as np
+    from ..cluster import InProcCluster
+    from ..common.flags import graph_flags
+    from ..common.qos import admission
+    from ..common.status import ErrorCode
+    from ..engine_tpu import TpuGraphEngine
+
+    rng = random.Random(seed)
+    admission.reset()
+    tpu = TpuGraphEngine()
+    cluster = InProcCluster(tpu_engine=tpu)
+    conn = cluster.connect()
+    tenants = ["t_a", "t_b"]
+    np_rng = np.random.default_rng(seed)
+
+    def load(space, v, e):
+        conn.must(f"CREATE SPACE {space}(partition_num=2)")
+        conn.must(f"USE {space}")
+        conn.must("CREATE TAG person(age int)")
+        conn.must("CREATE EDGE knows(w int)")
+        for i in range(0, v, 2000):
+            conn.must("INSERT VERTEX person(age) VALUES " + ", ".join(
+                f"{j}:({j % 80})" for j in range(i, min(i + 2000, v))))
+        srcs = np_rng.integers(0, v, e)
+        dsts = np_rng.integers(0, v, e)
+        for i in range(0, e, 2000):
+            conn.must("INSERT EDGE knows(w) VALUES " + ", ".join(
+                f"{int(s)} -> {int(d)}:({int((s + d) % 101)})"
+                for s, d in zip(srcs[i:i + 2000], dsts[i:i + 2000])))
+        sid = cluster.meta.get_space(space).value().space_id
+        tpu.prewarm(sid, block=True)
+        return [int(x) for x in
+                np.argsort(np.bincount(srcs, minlength=v))[-3:]], v
+
+    hubs = {}
+    for t in tenants:
+        hubs[t], _ = load(t, 600, 3000)
+    ab_hubs, ab_v = load("t_abuser", 800, 5000)
+
+    # QoS armed: the abuser throttled + bulk-laned, shed standing by
+    graph_flags.set("qos_plan", "t_abuser:rate=6,burst=6,lane=bulk")
+    graph_flags.set("qos_shed_queue_depth", 24)
+
+    errors: list = []
+    overloads = {"abuser": 0, "small": 0}
+    counts = {"queries": 0, "abuser_served": 0, "verifies": 0}
+    lock = threading.Lock()
+    vlock = threading.Lock()   # one identity verify at a time: the
+    # engine-enable toggle is global, and overlapped toggles would
+    # compare TPU-vs-TPU instead of TPU-vs-CPU
+    stop = threading.Event()
+
+    def verify(c, q, rows):
+        with vlock:
+            tpu.enabled = False
+            try:
+                rc = c.must(q)
+            finally:
+                tpu.enabled = True
+        if sorted(map(repr, rows)) != sorted(map(repr, rc.rows)):
+            _debug_bundle(cluster, tpu, {
+                "failure": "identity_divergence", "query": q,
+                "tpu_rows": sorted(map(repr, rows))[:20],
+                "cpu_rows": sorted(map(repr, rc.rows))[:20]})
+            errors.append(f"IDENTITY DIVERGENCE: {q}")
+            stop.set()
+            return
+        with lock:
+            counts["verifies"] += 1
+
+    def tenant_worker(t, k):
+        rr = random.Random(seed * 50 + k)
+        c = cluster.connect()
+        c.must(f"USE {t}")
+        n = 0
+        while not stop.is_set():
+            h = rr.choice(hubs[t])
+            steps = rr.choice([1, 2, 2])
+            q = (f"GO {steps} STEPS FROM {h} OVER knows "
+                 f"WHERE knows.w > {rr.randrange(80)} "
+                 f"YIELD knows._dst, knows.w")
+            r = c.execute(q)
+            if r.ok():
+                with lock:
+                    counts["queries"] += 1
+                n += 1
+                if n % 15 == 0:
+                    verify(c, q, r.rows)
+            elif r.code == ErrorCode.E_OVERLOAD:
+                with lock:
+                    overloads["small"] += 1
+            else:
+                errors.append(f"{t}: [{r.code.name}] {r.error_msg}")
+                stop.set()
+
+    def abuser_worker(k):
+        rr = random.Random(seed * 77 + k)
+        c = cluster.connect()
+        c.must("USE t_abuser")
+        while not stop.is_set():
+            if rr.random() < 0.1:
+                # light write mix on the ABUSER's own space only (the
+                # small tenants stay static so their continuous
+                # identity checks can't race a mutation)
+                s, d = rr.randrange(ab_v), rr.randrange(ab_v)
+                q = (f"INSERT EDGE knows(w) VALUES "
+                     f"{s} -> {d}:({(s + d) % 101})")
+            else:
+                q = (f"GO 3 STEPS FROM {rr.choice(ab_hubs)} OVER knows "
+                     f"YIELD knows._dst")
+            r = c.execute(q)
+            if r.ok():
+                with lock:
+                    counts["abuser_served"] += 1
+            elif r.code == ErrorCode.E_OVERLOAD:
+                with lock:
+                    overloads["abuser"] += 1
+                time.sleep(0.02)        # the retryable contract
+            else:
+                errors.append(f"abuser: [{r.code.name}] {r.error_msg}")
+                stop.set()
+
+    threads = [threading.Thread(target=tenant_worker, args=(t, k),
+                                daemon=True)
+               for k, t in enumerate(tenants)]
+    threads += [threading.Thread(target=abuser_worker, args=(k,),
+                                 daemon=True) for k in range(2)]
+    try:
+        for th in threads:
+            th.start()
+        deadline = time.monotonic() + seconds
+        # floor: enough verifies to mean something even on a slow box
+        # — but BOUNDED (4x the budget): if verifies stall without an
+        # error the soak must exit with a failing report, not hang
+        hard_stop = time.monotonic() + 4 * max(seconds, 1.0)
+        while (time.monotonic() < deadline
+               or counts["verifies"] < 6) and not stop.is_set() \
+                and time.monotonic() < hard_stop:
+            time.sleep(0.05)
+        stop.set()
+        for th in threads:
+            th.join(timeout=60)
+    finally:
+        graph_flags.set("qos_plan", "")
+        graph_flags.set("qos_shed_queue_depth", 0)
+    adm = admission.describe()
+    denied = adm["spaces"].get("t_abuser", {}).get("denied", 0)
+    out = {
+        "seconds": seconds, "tenants": len(tenants),
+        "queries": counts["queries"],
+        "identity_verifies": counts["verifies"],
+        "abuser": {"served": counts["abuser_served"],
+                   "overloads": overloads["abuser"],
+                   "denied": denied},
+        "small_tenant_overloads": overloads["small"],
+        "errors": errors[:5],
+        "qos": {"admission": adm, "dispatcher": tpu.qos_stats()},
+    }
+    out["ok"] = (not errors and counts["verifies"] >= 6
+                 and counts["queries"] > 0 and denied > 0
+                 and overloads["abuser"] > 0
+                 and counts["abuser_served"] > 0
+                 and overloads["small"] == 0)
+    return out
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="mixed INSERT+GO soak with continuous CPU/TPU "
@@ -563,7 +740,17 @@ def main(argv=None) -> int:
                          "soak additionally FAILS unless degraded "
                          "serves carry their degradation tags in the "
                          "sampled traces (trace-visibility proof)")
+    ap.add_argument("--tenants", action="store_true",
+                    help="skewed multi-tenant load under the QoS "
+                         "ladder (one abusive tenant vs small ones; "
+                         "docs/manual/14-qos.md): the abuser must be "
+                         "throttled with typed E_OVERLOAD only, small "
+                         "tenants unaffected, identity checks green")
     args = ap.parse_args(argv)
+    if args.tenants:
+        out = run_soak_tenants(args.seconds)
+        print(json.dumps(out))
+        return 0 if out["ok"] else 1
     if args.concurrent:
         out = run_soak_concurrent(args.seconds, args.threads,
                                   args.vertices, args.edges,

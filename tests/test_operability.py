@@ -442,7 +442,64 @@ def test_graphd_tpu_stats_endpoint():
         with urllib.request.urlopen(base + "?clear=1") as resp:
             cleared = _json.loads(resp.read())
         assert cleared["active"] == {}, cleared
+        # /qos admin endpoint (docs/manual/14-qos.md): arm a plan,
+        # observe per-tenant slices + the dispatcher lane block, pin a
+        # session lane, clear
+        assert "qos" in body and "admission" in body["qos"], body
+        assert "lane_rounds" in body["qos"]["dispatcher"]
+        qbase = f"http://127.0.0.1:{graphd.ws_port}/qos"
+        req = urllib.request.Request(
+            qbase, data=b"plan=ts_s:rate=1,burst=1,lane=bulk",
+            method="PUT")
+        with urllib.request.urlopen(req) as resp:
+            qarmed = _json.loads(resp.read())
+        assert qarmed["admission"]["armed"] is True
+        assert qarmed["admission"]["spaces"]["ts_s"]["policy"][
+            "lane"] == "bulk"
+        # the armed budget actually throttles: burn the burst token,
+        # then the next data statement is a typed retryable overload
+        gc.execute("GO FROM 1 OVER e YIELD e._dst")
+        r = gc.execute("GO FROM 1 OVER e YIELD e._dst")
+        from nebula_tpu.common.status import ErrorCode
+        assert r.code == ErrorCode.E_OVERLOAD, (r.code, r.error_msg)
+        assert "retry" in r.error_msg
+        # session lane pin through the endpoint
+        sess_id = next(iter(graphd.service.sessions._sessions))
+        req = urllib.request.Request(
+            qbase, data=f"session={sess_id}:interactive".encode(),
+            method="PUT")
+        with urllib.request.urlopen(req):
+            pass
+        assert graphd.service.sessions.find(sess_id).value() \
+            .qos_lane == "interactive"
+        req = urllib.request.Request(
+            qbase, data=f"session={sess_id}:".encode(), method="PUT")
+        with urllib.request.urlopen(req):
+            pass
+        assert graphd.service.sessions.find(sess_id).value() \
+            .qos_lane is None
+        with urllib.request.urlopen(qbase + "?clear=1") as resp:
+            qcleared = _json.loads(resp.read())
+        assert qcleared["admission"]["armed"] is False
+        r = gc.execute("GO FROM 1 OVER e YIELD e._dst")
+        assert r.ok(), r.error_msg
+        # bad plan / bad session are 400s, state untouched — including
+        # the half-apply shape (valid plan + bad session must apply
+        # NEITHER: a 400 means nothing changed)
+        for bad in (b"plan=x:warp=1", b"session=zap:bulk",
+                    b"nonsense=1", b"plan=ts_s:rate=1&session=zap:bulk"):
+            req = urllib.request.Request(qbase, data=bad, method="PUT")
+            try:
+                urllib.request.urlopen(req)
+                assert False, f"{bad!r} should have been rejected"
+            except urllib.error.HTTPError as e:
+                assert e.code == 400
+        with urllib.request.urlopen(qbase) as resp:
+            assert _json.loads(resp.read())["admission"][
+                "armed"] is False
     finally:
+        from nebula_tpu.common.qos import admission
+        admission.reset()
         graphd.stop(); storaged.stop(); metad.stop()
 
 

@@ -1,0 +1,600 @@
+"""Multi-tenant QoS tests (common/qos.py, graph admission gate,
+dispatcher priority lanes + load shedding, StorageClient deadline
+budget; docs/manual/14-qos.md).
+
+The contract under test, end to end: an over-budget or shed query gets
+a typed, RETRYABLE ``E_OVERLOAD`` with a retry-after hint — never a
+hang, never a generic failure, never a silent CPU fallback — and every
+denial/shed is visible (trace-root tags ``admission_denied`` /
+``shed:*`` + Prometheus counters), the same observability contract the
+degradation ladder keeps for its tags (PR 4's soak --chaos)."""
+import threading
+import time
+
+import pytest
+
+from nebula_tpu.cluster import InProcCluster
+from nebula_tpu.common import qos
+from nebula_tpu.common.flags import graph_flags
+from nebula_tpu.common.qos import (LANE_BULK, LANE_INTERACTIVE,
+                                   AdmissionController, OverloadShed,
+                                   TokenBucket, admission)
+from nebula_tpu.common.stats import stats as global_stats
+from nebula_tpu.common.status import ErrorCode
+from nebula_tpu.engine_tpu import TpuGraphEngine
+
+
+@pytest.fixture(autouse=True)
+def _clean_qos():
+    """The controller and the QoS flags are process-global: never leak
+    an armed plan or a shed watermark into unrelated tests."""
+    admission.reset()
+    for f, v in (("qos_plan", ""), ("qos_shed_queue_depth", 0),
+                 ("qos_shed_wait_p95_ms", 0)):
+        graph_flags.set(f, v)
+    yield
+    admission.reset()
+    for f, v in (("qos_plan", ""), ("qos_shed_queue_depth", 0),
+                 ("qos_shed_wait_p95_ms", 0)):
+        graph_flags.set(f, v)
+
+
+# ---------------------------------------------------------------------------
+# token bucket + controller unit tests
+# ---------------------------------------------------------------------------
+
+def test_token_bucket_burst_then_refill():
+    now = [0.0]
+    tb = TokenBucket(rate=10, burst=2, clock=lambda: now[0])
+    assert tb.try_acquire() == (True, 0.0)
+    assert tb.try_acquire() == (True, 0.0)
+    ok, retry = tb.try_acquire()
+    assert not ok and retry == pytest.approx(0.1)   # 1 token @ 10/s
+    now[0] += 0.1                                   # refill exactly one
+    assert tb.try_acquire()[0]
+    assert not tb.try_acquire()[0]
+
+
+def test_token_bucket_zero_rate_is_deny_all():
+    """rate=0 = the emergency tenant block: denies OUTRIGHT, never a
+    one-shot burst-token leak per plan swap (the doc's 'rate=0 denies
+    every data statement' is literal)."""
+    tb = TokenBucket(rate=0, burst=5)
+    ok, retry = tb.try_acquire()
+    assert not ok and retry == qos.MAX_RETRY_AFTER_MS / 1e3
+
+
+def test_admission_plan_parse_and_isolation():
+    ctl = AdmissionController()
+    ctl.set_plan("noisy:rate=0,burst=1,lane=bulk;*:rate=1000")
+    ok, retry_ms, lane = ctl.admit("noisy")
+    assert not ok and lane == LANE_BULK     # deny-all, lane intact
+    ok, retry_ms, _ = ctl.admit("noisy")
+    assert not ok and retry_ms >= qos.MIN_RETRY_AFTER_MS
+    # other spaces ride the default policy, unaffected by the abuser
+    for _ in range(50):
+        assert ctl.admit("quiet")[0]
+    d = ctl.describe()
+    assert d["spaces"]["noisy"]["denied"] >= 1
+    assert d["spaces"]["quiet"]["denied"] == 0
+    assert d["spaces"]["quiet"]["admitted"] == 50
+
+
+def test_admission_unnamed_space_unlimited_without_default():
+    ctl = AdmissionController()
+    ctl.set_plan("noisy:rate=1")
+    for _ in range(100):
+        assert ctl.admit("anything")[0]
+    assert not ctl.armed() or ctl.describe()["spaces"]["noisy"] is not None
+
+
+def test_admission_bad_plans_rejected_previous_kept():
+    ctl = AdmissionController()
+    ctl.set_plan("a:rate=1")
+    for bad in ("a:burst=2", "a:rate=x", "a:nope=1", ":rate=1",
+                "a:lane=warp"):
+        with pytest.raises(ValueError):
+            ctl.set_plan(bad)
+    assert ctl.describe()["plan"] == "a:rate=1"     # kept
+    ctl.set_plan("")
+    assert not ctl.armed()
+
+
+def test_qos_plan_flag_feeds_controller():
+    graph_flags.set("qos_plan", "flagspace:rate=7,burst=9")
+    d = admission.describe()
+    assert d["spaces"]["flagspace"]["policy"] == {"rate": 7.0,
+                                                  "burst": 9.0}
+    graph_flags.set("qos_plan", "not a plan !!!")   # bad hot-set: kept
+    assert admission.describe()["spaces"]["flagspace"][
+        "policy"]["rate"] == 7.0
+    graph_flags.set("qos_plan", "")
+    assert not admission.armed()
+
+
+# ---------------------------------------------------------------------------
+# graph-layer admission gate (e2e through a real cluster)
+# ---------------------------------------------------------------------------
+
+def _mini_cluster(space="qz", parts=2, v=60, e=240, seed=3):
+    import numpy as np
+    tpu = TpuGraphEngine()
+    cluster = InProcCluster(tpu_engine=tpu)
+    conn = cluster.connect()
+    conn.must(f"CREATE SPACE {space}(partition_num={parts})")
+    conn.must(f"USE {space}")
+    conn.must("CREATE TAG person(age int)")
+    conn.must("CREATE EDGE knows(w int)")
+    conn.must("INSERT VERTEX person(age) VALUES " + ", ".join(
+        f"{i}:({i % 70})" for i in range(v)))
+    rng = np.random.default_rng(seed)
+    srcs = rng.integers(0, v, e)
+    dsts = rng.integers(0, v, e)
+    for i in range(0, e, 200):
+        conn.must("INSERT EDGE knows(w) VALUES " + ", ".join(
+            f"{int(s)} -> {int(d)}@{j}:({int((s + d) % 50)})"
+            for j, (s, d) in enumerate(zip(srcs[i:i + 200],
+                                           dsts[i:i + 200]), start=i)))
+    sid = cluster.meta.get_space(space).value().space_id
+    return cluster, conn, tpu, sid
+
+
+@pytest.fixture()
+def mini():
+    return _mini_cluster()
+
+
+def test_admission_denial_is_typed_retryable_and_observable(mini):
+    """Throttled queries: E_OVERLOAD + retry-after hint + trace-root
+    `admission_denied` tag + Prometheus counter — and recovery after
+    the hinted wait (the RETRYABLE half of the contract)."""
+    from nebula_tpu.common.tracing import tracer
+    cluster, conn, tpu, sid = mini
+    q = "GO FROM 1 OVER knows YIELD knows._dst"
+    conn.must(q)
+    graph_flags.set("trace_sample_rate", 1.0)
+    graph_flags.set("qos_plan", "qz:rate=50,burst=1")
+    c0 = global_stats.lifetime_total("graph.qos.denied.qz")
+    try:
+        r1 = conn.execute(q)             # the burst token: admitted
+        assert r1.ok(), r1.error_msg
+        r2 = conn.execute(q)             # bucket empty: typed denial
+        assert r2.code == ErrorCode.E_OVERLOAD
+        assert "retry" in r2.error_msg and "E_OVERLOAD" in r2.error_msg
+        hint = (r2.profile or {}).get("retry_after_ms")
+        assert isinstance(hint, int) and hint >= qos.MIN_RETRY_AFTER_MS
+        # retryable: after the hinted wait the query is admitted again
+        time.sleep(min(hint, 1000) / 1e3 + 0.05)
+        r3 = conn.execute(q)
+        assert r3.ok(), r3.error_msg
+    finally:
+        graph_flags.set("trace_sample_rate", 0.0)
+        graph_flags.set("qos_plan", "")
+    assert global_stats.lifetime_total("graph.qos.denied.qz") > c0
+    denied_traces = [t for t in tracer.ring.snapshot()
+                     if t.get("tags", {}).get("admission_denied") == "qz"]
+    assert denied_traces, "denial did not tag its trace root"
+    # admin/session statements stay exempt: a throttled tenant can
+    # still navigate
+    graph_flags.set("qos_plan", "qz:rate=0")    # deny-all block
+    try:
+        assert conn.execute("SHOW SPACES").ok()
+        assert conn.execute("USE qz").ok()
+        assert conn.execute(q).code == ErrorCode.E_OVERLOAD
+    finally:
+        graph_flags.set("qos_plan", "")
+
+
+def test_admission_gates_post_use_space_and_charges_per_sentence(mini):
+    """Two bypass regressions (found in review): (1) `USE abuser;
+    GO ...` smuggled in ONE request must gate against the POST-USE
+    space — the gate is per sentence, not per request; (2) a compound
+    of N gated sentences charges N tokens, not one."""
+    cluster, conn, tpu, sid = mini
+    graph_flags.set("qos_plan", "qz:rate=0")
+    try:
+        c2 = cluster.connect()           # fresh session: no space yet
+        r = c2.execute("USE qz; GO FROM 1 OVER knows YIELD knows._dst")
+        assert r.code == ErrorCode.E_OVERLOAD, (r.code, r.error_msg)
+        assert "qz" in r.error_msg
+    finally:
+        graph_flags.set("qos_plan", "")
+    graph_flags.set("qos_plan", "qz:rate=1,burst=2")
+    a0 = admission.describe()["spaces"].get("qz", {}).get("admitted", 0)
+    try:
+        r = conn.execute("GO FROM 1 OVER knows; GO FROM 2 OVER knows; "
+                         "GO FROM 3 OVER knows")
+        # the 3rd sentence exceeds the 2-token burst mid-sequence
+        assert r.code == ErrorCode.E_OVERLOAD, (r.code, r.error_msg)
+        assert admission.describe()["spaces"]["qz"]["admitted"] \
+            - a0 == 2
+    finally:
+        graph_flags.set("qos_plan", "")
+
+
+def test_admission_bad_flag_hot_set_is_counted(mini):
+    """A malformed qos_plan hot-set through the flag path keeps the
+    previous plan AND leaves evidence (counter + log) — the flag value
+    and controller state must not diverge silently."""
+    b0 = global_stats.lifetime_total("graph.qos.bad_plan")
+    graph_flags.set("qos_plan", "ok:rate=5")
+    graph_flags.set("qos_plan", "broken:rate=")
+    assert admission.describe()["plan"] == "ok:rate=5"
+    assert global_stats.lifetime_total("graph.qos.bad_plan") > b0
+    graph_flags.set("qos_plan", "")
+
+
+def test_admission_prometheus_lines_exposed(mini):
+    cluster, conn, tpu, sid = mini
+    graph_flags.set("qos_plan", "qz:rate=0,burst=1")
+    try:
+        conn.execute("GO FROM 1 OVER knows YIELD knows._dst")
+        conn.execute("GO FROM 1 OVER knows YIELD knows._dst")
+    finally:
+        graph_flags.set("qos_plan", "")
+    lines = "\n".join(global_stats.prometheus_lines())
+    assert "nebula_graph_qos_denied_qz_total" in lines
+    assert "nebula_graph_qos_admission_denied_total" in lines
+
+
+# ---------------------------------------------------------------------------
+# lane classification + overrides
+# ---------------------------------------------------------------------------
+
+def _classify(text):
+    from nebula_tpu.graph.engine import classify_lane
+    from nebula_tpu.parser import GQLParser
+    return classify_lane(GQLParser().parse(text))
+
+
+def test_statement_shape_classification():
+    assert _classify("GO FROM 1 OVER knows") == LANE_INTERACTIVE
+    assert _classify("GO 2 STEPS FROM 1 OVER knows") == LANE_INTERACTIVE
+    assert _classify("GO 3 STEPS FROM 1 OVER knows") == LANE_BULK
+    # a pipe rides its scan's weight
+    assert _classify("GO 3 STEPS FROM 1 OVER knows YIELD knows.w AS w"
+                     " | YIELD COUNT(*) AS n") == LANE_BULK
+    assert _classify("GO FROM 1 OVER knows YIELD knows.w AS w"
+                     " | YIELD COUNT(*) AS n") == LANE_INTERACTIVE
+    # wide multi-start GO classifies bulk past qos_bulk_starts
+    wide = ", ".join(str(i) for i in range(40))
+    assert _classify(f"GO FROM {wide} OVER knows") == LANE_BULK
+    assert _classify("FIND ALL PATH FROM 1 TO 2 OVER knows "
+                     "UPTO 5 STEPS") == LANE_BULK
+    # the threshold is a MUTABLE flag
+    graph_flags.set("qos_bulk_steps", 2)
+    try:
+        assert _classify("GO 2 STEPS FROM 1 OVER knows") == LANE_BULK
+    finally:
+        graph_flags.set("qos_bulk_steps", 3)
+
+
+def test_session_and_plan_lane_overrides(mini):
+    """Pecking order: session pin > space-plan lane > statement
+    shape. Observed at the engine seam via ctx.qos_lane."""
+    cluster, conn, tpu, sid = mini
+    seen = []
+    orig = tpu.execute_go
+
+    def spy(ctx, *a, **kw):
+        seen.append(getattr(ctx, "qos_lane", None))
+        return orig(ctx, *a, **kw)
+
+    tpu.execute_go = spy
+    q = "GO FROM 1 OVER knows YIELD knows._dst"
+    try:
+        conn.must(q)
+        assert seen[-1] == LANE_INTERACTIVE
+        # space-plan lane override
+        graph_flags.set("qos_plan", "qz:rate=1000,lane=bulk")
+        conn.must(q)
+        assert seen[-1] == LANE_BULK
+        # session pin beats the plan
+        sess = cluster.service.sessions.find(conn.session_id).value()
+        sess.qos_lane = LANE_INTERACTIVE
+        conn.must(q)
+        assert seen[-1] == LANE_INTERACTIVE
+        sess.qos_lane = None
+    finally:
+        tpu.execute_go = orig
+        graph_flags.set("qos_plan", "")
+
+
+# ---------------------------------------------------------------------------
+# weighted-fair priority lanes at the dispatcher
+# ---------------------------------------------------------------------------
+
+def test_bulk_cannot_monopolize_concurrent_rounds(mini):
+    """4 distinct-key bulk groups + paced rounds: bulk in-flight
+    rounds never exceed bulk_max_rounds, and an interactive query
+    arriving mid-burst completes without waiting for the whole bulk
+    backlog."""
+    cluster, conn, tpu, sid = mini
+    tpu.sparse_edge_budget = 0          # pin dense: dispatcher path
+    # warm every query shape (compiles off the measurement)
+    bulk_qs = [f"GO {s} STEPS FROM {v} OVER knows YIELD knows._dst"
+               for s, v in ((3, 1), (3, 2), (4, 3), (4, 4))]
+    inter_q = "GO FROM 5 OVER knows YIELD knows._dst"
+    for q in bulk_qs + [inter_q]:
+        conn.must(q)
+
+    observed = []
+    orig = tpu._serve_batch
+
+    def paced(batch, ex):
+        with tpu._disp_cv:
+            observed.append(dict(tpu._lane_rounds))
+        time.sleep(0.05)
+        orig(batch, ex)
+
+    tpu._serve_batch = paced
+    errs = []
+    done_at = {}
+
+    def run(q, name):
+        try:
+            c = cluster.connect()
+            c.must("USE qz")
+            for _ in range(3):
+                c.must(q)
+            done_at[name] = time.monotonic()
+        except Exception as ex:   # noqa: BLE001 — recorded, fails test
+            errs.append(repr(ex))
+
+    # distinct steps per query -> 4 distinct group keys, all bulk
+    try:
+        t0 = time.monotonic()
+        ths = [threading.Thread(target=run, args=(q, f"bulk{i}"))
+               for i, q in enumerate(bulk_qs)]
+        for t in ths:
+            t.start()
+        time.sleep(0.02)                # bulk burst in flight first
+        ti = threading.Thread(target=run, args=(inter_q, "inter"))
+        ti.start()
+        ti.join(timeout=120)
+        for t in ths:
+            t.join(timeout=120)
+    finally:
+        tpu._serve_batch = orig
+    assert not errs, errs
+    assert observed, "no dispatcher rounds observed"
+    assert max(o[LANE_BULK] for o in observed) <= tpu.bulk_max_rounds
+    assert tpu.stats["lane_rounds_bulk"] > 0
+    assert tpu.stats["lane_rounds_interactive"] > 0
+    # the interactive session never queued behind the full bulk drain
+    assert done_at["inter"] - t0 <= max(done_at[f"bulk{i}"]
+                                        for i in range(4)) - t0 + 0.5
+
+
+def test_resolved_wide_starts_upgrade_to_bulk(mini):
+    """Width-abuse regression (found in review): a piped GO whose
+    start set resolves wide at runtime parses with ZERO literal vids,
+    so the parse-time classifier says interactive — the dispatcher
+    must re-check the RESOLVED width and upgrade to bulk (explicit
+    session/plan pins still win)."""
+    cluster, conn, tpu, sid = mini
+    tpu.sparse_edge_budget = 0          # pin dense: dispatcher path
+    graph_flags.set("qos_bulk_starts", 4)
+    seen = []
+    orig = tpu._serve_batch
+
+    def spy(batch, ex):
+        seen.extend((r.lane, len(r.starts)) for r in batch)
+        orig(batch, ex)
+
+    tpu._serve_batch = spy
+    try:
+        conn.must("GO FROM 1 OVER knows YIELD knows._dst AS id | "
+                  "GO FROM $-.id OVER knows YIELD knows._dst")
+    finally:
+        tpu._serve_batch = orig
+        graph_flags.set("qos_bulk_starts", 32)
+    wide = [(lane, n) for lane, n in seen if n >= 4]
+    assert wide, f"no wide window observed: {seen}"
+    assert all(lane == LANE_BULK for lane, n in wide), seen
+    # a pinned session is honored verbatim, no upgrade
+    sess = cluster.service.sessions.find(conn.session_id).value()
+    sess.qos_lane = LANE_INTERACTIVE
+    graph_flags.set("qos_bulk_starts", 4)
+    seen.clear()
+    tpu._serve_batch = spy
+    try:
+        conn.must("GO FROM 1 OVER knows YIELD knows._dst AS id | "
+                  "GO FROM $-.id OVER knows YIELD knows._dst")
+    finally:
+        tpu._serve_batch = orig
+        graph_flags.set("qos_bulk_starts", 32)
+        sess.qos_lane = None
+    assert all(lane == LANE_INTERACTIVE for lane, _ in seen), seen
+
+
+# ---------------------------------------------------------------------------
+# load shedding at the watermarks
+# ---------------------------------------------------------------------------
+
+def test_shed_bulk_first_typed_tagged_and_counted(mini):
+    """Seeded wait-p95 over the watermark: the next BULK query sheds
+    to a typed E_OVERLOAD (trace-tagged shed:<reason>, counted), while
+    an INTERACTIVE query — same watermark, 2x multiplier — still
+    serves. Shedding never silently degrades to the CPU pipe."""
+    from nebula_tpu.common.tracing import tracer
+    cluster, conn, tpu, sid = mini
+    tpu.sparse_edge_budget = 0
+    bulk_q = "GO 3 STEPS FROM 1 OVER knows YIELD knows._dst"
+    inter_q = "GO FROM 1 OVER knows YIELD knows._dst"
+    conn.must(bulk_q)
+    conn.must(inter_q)
+    # the recent-round window says waits are running at ~150ms p95
+    with tpu._disp_cv:
+        tpu._wait_samples.extend([150.0] * tpu.WAIT_SAMPLE_WINDOW)
+    graph_flags.set("trace_sample_rate", 1.0)
+    graph_flags.set("qos_shed_wait_p95_ms", 100)
+    d0 = tpu.stats["degraded_serves"]
+    s0 = global_stats.lifetime_total("tpu_engine.qos.shed.wait_p95")
+    try:
+        r = conn.execute(bulk_q)
+        assert r.code == ErrorCode.E_OVERLOAD, (r.code, r.error_msg)
+        assert "retry" in r.error_msg
+        # the machine-readable hint rides the SAME contract as an
+        # admission denial (clients read profile.retry_after_ms)
+        hint = (r.profile or {}).get("retry_after_ms")
+        assert isinstance(hint, int) and hint >= 25, r.profile
+        ri = conn.execute(inter_q)       # 150 < 2x100: not shed
+        assert ri.ok(), ri.error_msg
+    finally:
+        graph_flags.set("qos_shed_wait_p95_ms", 0)
+        graph_flags.set("trace_sample_rate", 0.0)
+    assert tpu.stats["qos_shed"] >= 1
+    assert tpu.qos_shed_reasons.get("wait_p95:bulk", 0) >= 1
+    assert tpu.qos_shed_by_space.get(sid, 0) >= 1
+    assert global_stats.lifetime_total(
+        "tpu_engine.qos.shed.wait_p95") > s0
+    # shed != degraded: the CPU pipe was NOT used for the shed query
+    assert tpu.stats["degraded_serves"] == d0
+    shed_traces = [t for t in tracer.ring.snapshot()
+                   if "shed" in t.get("tags", {})]
+    assert shed_traces and \
+        shed_traces[-1]["tags"]["shed"] == "wait_p95:bulk"
+    # watermark cleared: bulk serves again (retryable, not sticky)
+    with tpu._disp_cv:
+        tpu._wait_samples.clear()
+    assert conn.execute(bulk_q).ok()
+
+
+def test_shed_queue_depth_watermark(mini):
+    cluster, conn, tpu, sid = mini
+    tpu.sparse_edge_budget = 0
+    bulk_q = "GO 3 STEPS FROM 2 OVER knows YIELD knows._dst"
+    conn.must(bulk_q)
+    graph_flags.set("qos_shed_queue_depth", 1)
+    orig = tpu._serve_batch
+
+    def paced(batch, ex):
+        time.sleep(0.08)
+        orig(batch, ex)
+
+    tpu._serve_batch = paced
+    codes = []
+    lock = threading.Lock()
+
+    def run():
+        c = cluster.connect()
+        c.must("USE qz")
+        r = c.execute(bulk_q)
+        with lock:
+            codes.append(r.code)
+
+    try:
+        ths = [threading.Thread(target=run) for _ in range(8)]
+        for t in ths:
+            t.start()
+            time.sleep(0.01)            # arrivals pile behind the
+        for t in ths:                   # paced in-flight round
+            t.join(timeout=120)
+    finally:
+        tpu._serve_batch = orig
+        graph_flags.set("qos_shed_queue_depth", 0)
+    assert ErrorCode.E_OVERLOAD in codes, codes
+    assert all(c in (ErrorCode.SUCCEEDED, ErrorCode.E_OVERLOAD)
+               for c in codes), codes
+    assert tpu.qos_shed_reasons.get("queue_depth:bulk", 0) >= 1
+
+
+def test_qos_stats_block_shape(mini):
+    cluster, conn, tpu, sid = mini
+    q = tpu.qos_stats()
+    for key in ("queue_depth", "group_wait_p95_ms", "lane_rounds",
+                "lane_rounds_in_flight", "shed", "shed_reasons",
+                "shed_by_space", "watermarks", "lane_weights",
+                "bulk_max_rounds"):
+        assert key in q
+    assert set(q["lane_rounds"]) == {LANE_INTERACTIVE, LANE_BULK}
+
+
+# ---------------------------------------------------------------------------
+# deadline budget vs retry loops (ISSUE 8 satellite: _fanout)
+# ---------------------------------------------------------------------------
+
+class _OnePartSM:
+    def num_parts(self, space_id):
+        return 1
+
+
+class _ElectingForever:
+    """Hintless E_LEADER_CHANGED on every call — a stalled election."""
+
+    def __init__(self):
+        self.calls = 0
+
+    def get_vertex_props(self, space_id, parts, tag_ids):
+        from nebula_tpu.storage.types import PartResult, PropsResponse
+        self.calls += 1
+        r = PropsResponse()
+        for p in parts:
+            r.results[p] = PartResult(ErrorCode.E_LEADER_CHANGED, None)
+        return r
+
+
+def test_fanout_deadline_balks_instead_of_retrying_past_it():
+    """A stalled election with 150ms of query budget left: the retry
+    loop must balk to a typed E_TIMEOUT (deadline_exceeded) within the
+    budget's order of magnitude — not burn the full 5-round hintless
+    backoff (~1.5s) past the query's own deadline."""
+    from nebula_tpu.storage.client import StorageClient
+    svc = _ElectingForever()
+    client = StorageClient(_OnePartSM(), hosts={"h0": svc, "h1": svc},
+                           part_to_host=lambda s, p: "h0")
+    b0 = global_stats.lifetime_total(
+        "storage_client.fanout_deadline_balk")
+    tok = qos.set_query_deadline(time.monotonic() + 0.15)
+    t0 = time.monotonic()
+    try:
+        resp = client.get_vertex_props(1, [1])
+    finally:
+        qos.clear_query_deadline(tok)
+    dt = time.monotonic() - t0
+    assert resp.results[1].code == ErrorCode.E_TIMEOUT, resp.results
+    assert dt < 1.0, f"retried past the deadline ({dt:.2f}s)"
+    assert global_stats.lifetime_total(
+        "storage_client.fanout_deadline_balk") > b0
+
+
+def test_fanout_without_deadline_keeps_full_retry_budget():
+    """No deadline armed -> the PR 6 behavior is untouched: the full
+    hintless budget runs (it must outlast an election) and the
+    exhausted parts surface as E_HOST_NOT_FOUND."""
+    from nebula_tpu.storage.client import StorageClient
+    svc = _ElectingForever()
+    client = StorageClient(_OnePartSM(), hosts={"h0": svc, "h1": svc},
+                           part_to_host=lambda s, p: "h0")
+    assert qos.deadline_remaining_s() is None
+    resp = client.get_vertex_props(1, [1])
+    # exhaustion surfaces the last round's verdict, exactly as PR 6
+    # left it (a still-electing part stays E_LEADER_CHANGED)
+    assert resp.results[1].code == ErrorCode.E_LEADER_CHANGED
+    assert svc.calls == 6               # initial + 5 retries
+
+
+def test_graph_service_arms_deadline_context(mini):
+    """GraphService.execute arms the per-query deadline from
+    tpu_query_deadline_ms, and clears it afterwards."""
+    cluster, conn, tpu, sid = mini
+    seen = []
+    orig = cluster.service.engine.execute
+
+    def spy(session, text):
+        seen.append(qos.deadline_remaining_s())
+        return orig(session, text)
+
+    cluster.service.engine.execute = spy
+    try:
+        graph_flags.set("tpu_query_deadline_ms", 5000)
+        conn.must("YIELD 1")
+        assert seen[-1] is not None and 0 < seen[-1] <= 5.0
+        graph_flags.set("tpu_query_deadline_ms", 0)
+        conn.must("YIELD 1")
+        assert seen[-1] is None
+    finally:
+        cluster.service.engine.execute = orig
+        graph_flags.set("tpu_query_deadline_ms", 60000)
+    assert qos.deadline_remaining_s() is None

@@ -1,0 +1,67 @@
+"""Tier-1-safe multi-tenant QoS smoke: `bench.py --tenants --trim` in
+a SUBPROCESS on XLA:CPU — one abusive tenant firing bulk scans against
+small tenants with the QoS ladder armed (per-space admission, priority
+lanes, shed watermarks; docs/manual/14-qos.md). The tier itself FAILS
+unless the abuser is throttled with typed E_OVERLOAD only, every small
+tenant's p99 holds within the declared factor of its no-abuser
+baseline, and TPU-vs-CPU identity is green — the subprocess keeps the
+parent's JAX backend state out of the picture, exactly like the chaos
+and cluster smoke tiers."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def tenants_smoke(tmp_path_factory):
+    out = tmp_path_factory.mktemp("tenants") / "TENANTS_smoke.json"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_TENANTS_SEED"] = "13"    # deterministic graphs/load
+    env["BENCH_TENANTS_OUT"] = str(out)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--tenants", "--trim"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, (proc.stdout[-2000:],
+                                  proc.stderr[-2000:])
+    with open(out) as f:
+        return json.load(f)
+
+
+def test_tenants_abuser_throttled_not_starved(tenants_smoke):
+    ab = tenants_smoke["abuser"]
+    assert ab["denied"] > 0, ab          # admission actually bit
+    assert ab["overloads"] > 0, ab       # ...and the client saw typed
+    assert ab["served"] > 0, ab          # throttled, never starved
+
+
+def test_tenants_small_p99_holds_and_no_overloads(tenants_smoke):
+    for t, rec in tenants_smoke["per_tenant"].items():
+        assert rec["p99_within_bound"], (t, rec)
+        assert rec["abuse"]["n"] > 0, (t, rec)
+    assert tenants_smoke["small_tenant_overloads"] == 0
+
+
+def test_tenants_only_typed_overload_errors_and_identity(tenants_smoke):
+    assert tenants_smoke["client_error_count"] == 0, \
+        tenants_smoke["client_errors"]
+    assert tenants_smoke["identity"]["mismatches"] == []
+    assert tenants_smoke["identity"]["checked"] > 0
+
+
+def test_tenants_qos_slices_present(tenants_smoke):
+    qos = tenants_smoke["qos"]
+    spaces = qos["admission"]["spaces"]
+    assert "abuser" in spaces and spaces["abuser"]["denied"] > 0
+    # per-tenant slices: every small tenant visible, none throttled
+    smalls = [s for s in spaces if s.startswith("tenant")]
+    assert smalls and all(spaces[s]["denied"] == 0 for s in smalls)
+    # the abuser's scans actually rode the bulk lane
+    assert qos["dispatcher"]["lane_rounds"]["bulk"] > 0
+    assert qos["dispatcher"]["lane_rounds"]["interactive"] > 0

@@ -99,6 +99,63 @@ def test_concurrent_appends_coalesce(cluster3):
         assert cluster3.shards[a].data() == [b"c%03d" % i for i in range(100)]
 
 
+def test_injected_follower_wal_append_failure_keeps_quorum(cluster3):
+    """Satellite (ISSUE 8): the durability path has fault-injection
+    coverage — an injected `wal.append` failure on ONE follower (the
+    full-disk failure shape: Wal.append returns False, the follower
+    answers E_WAL_FAIL) must neither break quorum commit (leader +
+    surviving follower = 2/3) nor wedge the part: the failed follower
+    catches up on the next replication round once the fault clears."""
+    from nebula_tpu.common.faults import faults
+    leader = cluster3.wait_leader()
+    assert leader.append_async(b"pre").result(timeout=3) is \
+        RaftCode.SUCCEEDED
+    cluster3.wait_commit(1)
+    try:
+        # after=1 skips the leader's own local append; n=1 fails
+        # exactly one of the two follower replication appends
+        faults.set_plan("wal.append:after=1,n=1")
+        fut = leader.append_async(b"quorum-entry")
+        assert fut.result(timeout=5) is RaftCode.SUCCEEDED
+        fired = faults.counts().get("wal.append", 0)
+    finally:
+        faults.reset()
+    assert fired == 1, "the injected follower append never fired"
+    # no wedge: ALL replicas converge (the failed follower's match_id
+    # stayed behind, so the replicator re-shipped the entry)
+    cluster3.wait_commit(2)
+    datas = [tuple(cluster3.shards[a].data()) for a in cluster3.voting]
+    assert datas[0] == datas[1] == datas[2] == (b"pre", b"quorum-entry")
+    # and the part still serves: a follow-up append commits everywhere
+    assert leader.append_async(b"post").result(timeout=3) is \
+        RaftCode.SUCCEEDED
+    cluster3.wait_commit(3)
+
+
+def test_wal_sync_every_append_flag_consumed_at_bind(tmp_path):
+    """Satellite (ISSUE 8): the `wal_sync_every_append` storaged gflag
+    (REBOOT, read at part bind) reaches the Wal constructor —
+    docs/manual/12-replication.md durability caveats."""
+    from nebula_tpu.common.flags import storage_flags
+    assert storage_flags.get("wal_sync_every_append") is False
+    storage_flags.set("wal_sync_every_append", True)
+    try:
+        c = RaftCluster(1, tmp_path)
+        try:
+            assert all(p.wal.sync_every_append
+                       for p in c.parts.values())
+        finally:
+            c.stop()
+    finally:
+        storage_flags.set("wal_sync_every_append", False)
+    c2 = RaftCluster(1, tmp_path / "off")
+    try:
+        assert not any(p.wal.sync_every_append
+                       for p in c2.parts.values())
+    finally:
+        c2.stop()
+
+
 def test_append_survives_leader_change(cluster3):
     leader = cluster3.wait_leader()
     for i in range(5):
