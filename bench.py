@@ -1019,6 +1019,13 @@ def bench_mesh_dryrun(out_path: str, n_devices: int = 4):
     return rec
 
 
+def _witness_summary() -> dict:
+    """Compact lock-order-witness block for a bench record
+    (docs/manual/15-static-analysis.md#witness)."""
+    from nebula_tpu.common.lockwitness import witness
+    return witness.summary()
+
+
 def bench_chaos(out_path: str, trim: bool = False):
     """Chaos tier (`bench.py --chaos`): the 8-session workload under
     injected kernel/mesh/encode faults (common/faults.py; docs/manual/
@@ -1038,7 +1045,16 @@ def bench_chaos(out_path: str, trim: bool = False):
     import threading
     from nebula_tpu.cluster import InProcCluster
     from nebula_tpu.common.faults import faults
+    from nebula_tpu.common.lockwitness import witness
     from nebula_tpu.engine_tpu import TpuGraphEngine
+
+    # the lock-order witness rides every chaos run: the failure/
+    # degradation paths exercised here (breaker trips, CPU-pipe
+    # retries, half-open probes) are exactly where a lock-order
+    # inversion would hide; the run fails on a cycle or a sleep
+    # observed under a witnessed lock (common/lockwitness.py; set
+    # NEBULA_TPU_LOCK_WITNESS=1 to also wrap import-time locks)
+    witness.install()
 
     seed = int(os.environ.get("BENCH_CHAOS_SEED", 7))
     sessions = 8
@@ -1213,12 +1229,14 @@ def bench_chaos(out_path: str, trim: bool = False):
         "robustness": rb,
         "degraded_serves": rb["degraded_serves"],
         "deadline_exceeded": rb["deadline_exceeded"],
+        "lock_witness": _witness_summary(),
     }
     with open(out_path, "w") as f:
         json.dump(rec, f, indent=1)
     ok = (not errs and not mismatches and trips > 0 and recovered
           and sum(fired.values()) > 0
-          and rb["breaker_recoveries"] > 0)
+          and rb["breaker_recoveries"] > 0
+          and rec["lock_witness"]["clean"])
     log(f"chaos tier: {sessions} sessions x {per_session} queries under "
         f"{plan!r}: {sum(fired.values())} faults injected, "
         f"{trips} breaker trips, {rb['degraded_serves']} degraded "
@@ -1689,10 +1707,17 @@ def bench_cluster(out_path: str, trim: bool = False):
 
     from nebula_tpu.client import GraphClient
     from nebula_tpu.common.flags import storage_flags
+    from nebula_tpu.common.lockwitness import witness
     from nebula_tpu.common.stats import stats as _gstats
     from nebula_tpu.daemons import (serve_graphd, serve_metad,
                                     serve_storaged)
     from nebula_tpu.engine_tpu import TpuGraphEngine
+
+    # lock-order witness across raft elections, failover and rebalance
+    # — the heaviest cross-thread lock traffic in the tree (raft part
+    # locks x host locks x wal locks); a cycle or sleep-under-lock
+    # fails the tier (common/lockwitness.py)
+    witness.install()
 
     v, e, parts, readers_n, phase_s = \
         (240, 1500, 3, 3, 1.5) if trim else (1200, 9000, 4, 6, 4.0)
@@ -2002,6 +2027,7 @@ def bench_cluster(out_path: str, trim: bool = False):
                     "raftex.membership_reconciled"),
                 "balance_task_rows": len(balance_rows),
             },
+            "lock_witness": _witness_summary(),
         }
         # "bounded p99 impact": no phase may starve queries toward the
         # deadline horizon — a generous absolute cap, the exact ratios
@@ -2012,7 +2038,8 @@ def bench_cluster(out_path: str, trim: bool = False):
         ok = (not errors and identity_failover and identity_balance
               and post_failover_device and balance_done and evacuated
               and fully_replicated and p99_bounded
-              and all(phases[ph]["n"] > 0 for ph in phases))
+              and all(phases[ph]["n"] > 0 for ph in phases)
+              and rec["lock_witness"]["clean"])
         rec["ok"] = ok
         with open(out_path, "w") as f:
             json.dump(rec, f, indent=1)

@@ -27,3 +27,13 @@ Layer map (mirrors reference layers, re-designed TPU-first; see SURVEY.md §1):
 """
 
 __version__ = "0.1.0"
+
+# Opt-in runtime lock-order witness (docs/manual/15-static-analysis.md):
+# with NEBULA_TPU_LOCK_WITNESS set, importing the package installs the
+# witness BEFORE any submodule creates a lock, so module-level locks
+# (native encode lock, rpc stats lock, mesh build lock, tracer rings)
+# are wrapped too. The import itself performs the install.
+import os as _os
+
+if _os.environ.get("NEBULA_TPU_LOCK_WITNESS"):
+    from .common import lockwitness as _lockwitness  # noqa: F401

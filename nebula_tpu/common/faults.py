@@ -35,6 +35,7 @@ per-feature by `TpuGraphEngine`.
 """
 from __future__ import annotations
 
+import contextvars
 import os
 import random
 import threading
@@ -119,7 +120,7 @@ class FaultRegistry:
             self.fired[name] = self.fired.get(name, 0) + 1
             latency = spec.latency_ms
             exc = self._points.get(name, {}).get("exc", InjectedFault)
-        global_stats.add_value("faults.injected." + name)
+        global_stats.add_value("faults.injected." + name, kind="counter")
         if latency is not None:
             time.sleep(latency / 1e3)
             return
@@ -261,6 +262,26 @@ def jittered_delay(base_s: float, cap_s: float, attempt: int) -> float:
     retries): min(base * 2^attempt, cap) scaled by [0.5, 1.0)."""
     return min(base_s * (2 ** attempt), cap_s) \
         * (0.5 + random.random() * 0.5)
+
+
+# Serve-path sections that run while holding a hot lock (the engine
+# snapshot lock during a first-touch refresh) set this contextvar so
+# the SHARED retry loops they may reach (transport reconnect,
+# storage-client KV/scan backoff) rotate leader hints immediately but
+# never sleep: sleeping there blocks every other query on the held
+# lock for the backoff duration, which is strictly worse than failing
+# fast into the degradation ladder (CPU pipe + background repack with
+# its own pacing). Found at runtime by the lock-order witness during
+# `bench --cluster` failover (docs/manual/15-static-analysis.md).
+no_retry_sleep: "contextvars.ContextVar[bool]" = \
+    contextvars.ContextVar("nebula_no_retry_sleep", default=False)
+
+
+def pace_retry(delay_s: float) -> None:
+    """The shared retry pause: `time.sleep(delay_s)` unless the
+    current context suppresses retry sleeps (hot-lock sections)."""
+    if not no_retry_sleep.get():
+        time.sleep(delay_s)
 
 
 # ---------------------------------------------------------------------------

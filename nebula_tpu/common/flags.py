@@ -46,6 +46,18 @@ class FlagRegistry:
         f = self._flags.get(name)
         return f.value if f is not None else default
 
+    def get_or(self, name: str, default: Any, cast=None) -> Any:
+        """Typed read with fallback: the live value coerced through
+        `cast` (default: `type(default)`), or `default` when the flag
+        is unset or its value doesn't coerce — the shared shape for
+        call sites that consult a MUTABLE flag per use (hot-settable)
+        but must survive a malformed hot-set."""
+        v = self.get(name, default)
+        try:
+            return (cast or type(default))(v)
+        except (TypeError, ValueError):
+            return default
+
     def set(self, name: str, value: Any) -> bool:
         with self._lock:
             f = self._flags.get(name)
@@ -175,8 +187,11 @@ storage_flags.declare("download_dir", "/tmp/nebula_tpu_staging", REBOOT,
                       "staging dir for DOWNLOAD-ed bulk-load SST files")
 storage_flags.declare("snapshot_dir", "/tmp/nebula_tpu_snapshots", REBOOT,
                       "root dir for CREATE SNAPSHOT checkpoints")
-storage_flags.declare("max_edge_returned_per_vertex", 1 << 30, MUTABLE,
-                      "per-vertex edge truncation cap")
+storage_flags.declare("max_edge_returned_per_vertex", 10000, MUTABLE,
+                      "per-vertex edge truncation cap applied when a "
+                      "bound request doesn't carry its own (default "
+                      "matches the storage service's historical "
+                      "DEFAULT_MAX_EDGES_PER_VERTEX)")
 storage_flags.declare("kv_engine_options", "", MUTABLE,
                       "JSON map of native-engine tunables hot-applied to "
                       'every space engine, e.g. {"flush_bytes": 1048576, '

@@ -1,0 +1,396 @@
+"""Runtime lock-order witness: deadlock + blocked-under-lock detection.
+
+The static suite (nebula_tpu/tools/lint, NL001) catches blocking calls
+syntactically under a hot lock; this module catches what only the
+RUNTIME can see — the cross-thread lock *acquisition-order graph*.
+While installed, every `threading.Lock` / `RLock` / `Condition`
+constructed from code under `nebula_tpu/` (~44 sites: dispatcher cv,
+engine snapshot lock, stats leaf lock, cache rungs, raft parts, client
+pools) is wrapped in a recording proxy. Each acquisition that happens
+while the same thread already holds other witnessed locks adds edges
+`held-site -> acquired-site`; at the end of a run:
+
+- a CYCLE in that graph is a potential ABBA deadlock — two threads
+  interleaving those sites in opposite orders can hang the process;
+- a `time.sleep` observed while ANY witnessed lock is held is a
+  blocked-under-hot-lock event (the runtime twin of NL001).
+
+Nodes are lock CREATION SITES (file:line), not instances — the
+lockdep-style class aggregation that keeps the graph tiny and stable
+across runs. Same-site nestings (two instances born at one line, e.g.
+two raft parts) are reported separately as `self_edges`: they are only
+a deadlock risk when instance order can invert, so they don't fail
+`assert_clean()` but stay visible in the report.
+
+Opt-in, three ways:
+- env `NEBULA_TPU_LOCK_WITNESS=1` before importing `nebula_tpu`
+  (installs at import; tests/conftest.py honors it for tier-1);
+- `bench.py --chaos` / `--cluster` install it for the whole run and
+  embed `report()` in the output JSON (the smokes assert it clean);
+- `tools/soak.py --witness` does the same for soaks and dumps the
+  observed graph into the debug bundle on identity failure.
+
+Overhead: one `sys._getframe` walk per lock CONSTRUCTION and per
+acquisition-with-locks-held, plus two list ops per acquire/release —
+single-digit microseconds, measured ~2-3x on a bare uncontended
+acquire/release pair (docs/manual/15-static-analysis.md#witness).
+Locks created before install() are not wrapped; install early.
+"""
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+_REAL_LOCK = threading.Lock
+_REAL_RLOCK = threading.RLock
+_REAL_SLEEP = time.sleep
+_THREADING_FILE = getattr(threading, "__file__", "<threading>")
+_SELF_FILE = __file__
+
+
+class LockOrderViolation(AssertionError):
+    """Raised by assert_clean(): cycle or blocked-under-lock event."""
+
+
+def _caller_site() -> str:
+    """file:line of the nearest frame outside this module and
+    threading.py (Condition(None) constructs its RLock from inside
+    threading.py — the witness attributes it to the real caller)."""
+    f = sys._getframe(2)
+    while f is not None and f.f_code.co_filename in (_SELF_FILE,
+                                                     _THREADING_FILE):
+        f = f.f_back
+    if f is None:
+        return "<unknown>"
+    return f"{f.f_code.co_filename}:{f.f_lineno}"
+
+
+class _WitnessProxy:
+    """Wraps one real Lock/RLock; maintains the per-thread held stack
+    and feeds the order graph. Exposes the `_release_save` /
+    `_acquire_restore` / `_is_owned` triple so threading.Condition
+    treats it exactly like the lock it wraps (wait() pops ALL
+    recursion levels from the held stack and restores them)."""
+
+    __slots__ = ("_real", "_w", "site")
+
+    def __init__(self, real, witness: "LockWitness", site: str):
+        self._real = real
+        self._w = witness
+        self.site = site
+
+    # ------------------------------------------------------ lock API
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        got = self._real.acquire(blocking, timeout)
+        if got:
+            self._w._note_acquire(self)
+        return got
+
+    def release(self) -> None:
+        self._w._note_release(self)
+        self._real.release()
+
+    def locked(self) -> bool:
+        return self._real.locked()
+
+    def __enter__(self) -> "_WitnessProxy":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def __repr__(self) -> str:
+        return f"<witnessed {self._real!r} from {self.site}>"
+
+    # ------------------------------------- Condition integration
+    def _release_save(self):
+        n = self._w._pop_all(self)
+        real = self._real
+        rs = getattr(real, "_release_save", None)
+        if rs is not None:
+            return (rs(), n)
+        real.release()
+        return (None, n)
+
+    def _acquire_restore(self, state) -> None:
+        inner, n = state
+        real = self._real
+        ar = getattr(real, "_acquire_restore", None)
+        if ar is not None:
+            ar(inner)
+        else:
+            real.acquire()
+        self._w._push_n(self, n)
+
+    def _is_owned(self) -> bool:
+        real = self._real
+        io = getattr(real, "_is_owned", None)
+        if io is not None:
+            return io()
+        if real.acquire(False):
+            real.release()
+            return False
+        return True
+
+
+class LockWitness:
+    """One installable witness. The module-level `witness` instance is
+    scoped to locks created from nebula_tpu/ code; tests build private
+    instances with `scope=None` (wrap everything) for synthetic
+    scenarios."""
+
+    def __init__(self, scope: Optional[Tuple[str, ...]] = ("nebula_tpu",),
+                 sleep_floor_s: float = 0.0):
+        self.scope = scope          # None = wrap every creation site
+        self.sleep_floor_s = sleep_floor_s
+        self._installed = False
+        self._prev = (_REAL_LOCK, _REAL_RLOCK, _REAL_SLEEP)
+        self._tls = threading.local()
+        self._mu = _REAL_LOCK()
+        # (held_site, acquired_site) -> example detail (first sighting)
+        self._edges: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self._self_edges: Dict[str, Dict[str, Any]] = {}
+        self._blocking: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self._sites: Set[str] = set()
+        self.acquisitions = 0
+        self.wrapped = 0
+
+    # -------------------------------------------------- install/uninstall
+    def _in_scope(self, site: str) -> bool:
+        if self.scope is None:
+            return True
+        return any(s in site for s in self.scope)
+
+    def install(self) -> "LockWitness":
+        if self._installed:
+            return self
+        self._installed = True
+        # restore exactly what we displaced: a test witness installed
+        # inside an env-armed tier-1 run must hand control back to the
+        # outer witness's factories, not to the raw originals
+        self._prev = (threading.Lock, threading.RLock, time.sleep)
+
+        # delegate to what we DISPLACED, not the raw originals: with
+        # an outer witness installed (env-armed tier-1) its factory
+        # keeps seeing every creation/sleep made while an inner test
+        # witness is active, so locks born in that window — which may
+        # outlive the inner witness — still feed the outer graph
+        def make_lock():
+            site = _caller_site()
+            real = self._prev[0]()
+            if not self._in_scope(site):
+                return real
+            self.wrapped += 1
+            self._sites.add(site)
+            return _WitnessProxy(real, self, site)
+
+        def make_rlock():
+            site = _caller_site()
+            real = self._prev[1]()
+            if not self._in_scope(site):
+                return real
+            self.wrapped += 1
+            self._sites.add(site)
+            return _WitnessProxy(real, self, site)
+
+        def traced_sleep(secs):
+            held = getattr(self._tls, "held", None)
+            if held and secs is not None and secs > self.sleep_floor_s:
+                self._note_blocking(f"time.sleep({secs!r})",
+                                    [p.site for p in held])
+            return self._prev[2](secs)
+
+        threading.Lock = make_lock
+        threading.RLock = make_rlock
+        time.sleep = traced_sleep
+        return self
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            return
+        self._installed = False
+        threading.Lock, threading.RLock, time.sleep = self._prev
+
+    # ------------------------------------------------------ recording
+    def _held(self) -> List[_WitnessProxy]:
+        held = getattr(self._tls, "held", None)
+        if held is None:
+            held = self._tls.held = []
+        return held
+
+    def _note_acquire(self, proxy: _WitnessProxy) -> None:
+        held = self._held()
+        self.acquisitions += 1
+        if held:
+            acq_at = _caller_site()
+            seen: Set[str] = set()
+            for h in held:
+                if h is proxy or h.site in seen:
+                    continue      # recursive re-acquire / duplicate site
+                seen.add(h.site)
+                if h.site == proxy.site:
+                    if proxy.site not in self._self_edges:
+                        with self._mu:
+                            self._self_edges.setdefault(proxy.site, {
+                                "site": proxy.site,
+                                "thread": threading.current_thread().name,
+                                "acquired_at": acq_at,
+                            })
+                    continue
+                key = (h.site, proxy.site)
+                if key not in self._edges:
+                    with self._mu:
+                        self._edges.setdefault(key, {
+                            "held": h.site, "acquired": proxy.site,
+                            "thread": threading.current_thread().name,
+                            "acquired_at": acq_at,
+                        })
+        held.append(proxy)
+
+    def _note_release(self, proxy: _WitnessProxy) -> None:
+        held = self._held()
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is proxy:
+                del held[i]
+                return
+
+    def _pop_all(self, proxy: _WitnessProxy) -> int:
+        """Condition.wait: drop every recursion level of `proxy`."""
+        held = self._held()
+        n = 0
+        for i in range(len(held) - 1, -1, -1):
+            if held[i] is proxy:
+                del held[i]
+                n += 1
+        return n
+
+    def _push_n(self, proxy: _WitnessProxy, n: int) -> None:
+        held = self._held()
+        for _ in range(max(n, 1)):
+            held.append(proxy)
+
+    def _note_blocking(self, op: str, lock_sites: List[str]) -> None:
+        at = _caller_site()
+        key = (at, op.split("(")[0])
+        if key not in self._blocking:
+            with self._mu:
+                self._blocking.setdefault(key, {
+                    "op": op, "at": at,
+                    "locks_held": sorted(set(lock_sites)),
+                    "thread": threading.current_thread().name,
+                })
+
+    # ----------------------------------------------------- analysis
+    def graph(self) -> Dict[str, List[str]]:
+        with self._mu:
+            out: Dict[str, List[str]] = {}
+            for a, b in self._edges:
+                out.setdefault(a, []).append(b)
+            for a in out:
+                out[a].sort()
+            return out
+
+    def find_cycle(self) -> Optional[List[str]]:
+        """A site cycle in the acquisition-order graph, or None."""
+        g = self.graph()
+        WHITE, GREY, BLACK = 0, 1, 2
+        color = {n: WHITE for n in
+                 set(g) | {b for bs in g.values() for b in bs}}
+        stack: List[str] = []
+
+        def dfs(n: str) -> Optional[List[str]]:
+            color[n] = GREY
+            stack.append(n)
+            for m in g.get(n, ()):
+                if color[m] == GREY:
+                    return stack[stack.index(m):] + [m]
+                if color[m] == WHITE:
+                    found = dfs(m)
+                    if found:
+                        return found
+            stack.pop()
+            color[n] = BLACK
+            return None
+
+        for n in sorted(color):
+            if color[n] == WHITE:
+                found = dfs(n)
+                if found:
+                    return found
+        return None
+
+    def blocking_events(self) -> List[Dict[str, Any]]:
+        with self._mu:
+            return sorted(self._blocking.values(),
+                          key=lambda e: (e["at"], e["op"]))
+
+    def report(self) -> Dict[str, Any]:
+        cycle = self.find_cycle()
+        with self._mu:
+            edges = sorted(self._edges.values(),
+                           key=lambda e: (e["held"], e["acquired"]))
+            self_edges = sorted(self._self_edges.values(),
+                                key=lambda e: e["site"])
+        return {
+            "installed": self._installed,
+            "locks_wrapped": self.wrapped,
+            "acquisitions": self.acquisitions,
+            "edges": edges,
+            "self_edges": self_edges,
+            "cycle": cycle,
+            "blocking": self.blocking_events(),
+            "clean": cycle is None and not self._blocking,
+        }
+
+    def summary(self) -> Dict[str, Any]:
+        """Compact report for bench/soak JSON artifacts: edge/self-edge
+        LISTS are collapsed to counts (hundreds of rows on a cluster
+        run); cycles and blocking events — the failure evidence — are
+        embedded whole. One shape for every artifact consumer."""
+        rep = self.report()
+        return {
+            "installed": rep["installed"],
+            "locks_wrapped": rep["locks_wrapped"],
+            "acquisitions": rep["acquisitions"],
+            "edges": len(rep["edges"]),
+            "self_edges": len(rep["self_edges"]),
+            "cycle": rep["cycle"],
+            "blocking": rep["blocking"],
+            "clean": rep["clean"],
+        }
+
+    def assert_clean(self) -> Dict[str, Any]:
+        """Report, raising LockOrderViolation on a cycle or any
+        blocked-under-lock event. Returns the report when clean."""
+        rep = self.report()
+        if rep["cycle"] is not None:
+            raise LockOrderViolation(
+                "lock-order cycle (potential ABBA deadlock): "
+                + " -> ".join(rep["cycle"]))
+        if rep["blocking"]:
+            ev = rep["blocking"][0]
+            raise LockOrderViolation(
+                f"blocking op {ev['op']} at {ev['at']} while holding "
+                f"witnessed lock(s) {ev['locks_held']} "
+                f"(+{len(rep['blocking']) - 1} more event(s))")
+        return rep
+
+    def reset(self) -> None:
+        """Drop recorded edges/events (NOT the wrapping) — phase
+        isolation inside one run."""
+        with self._mu:
+            self._edges.clear()
+            self._self_edges.clear()
+            self._blocking.clear()
+
+
+# the process-global witness (scoped to nebula_tpu/ creation sites)
+witness = LockWitness()
+
+if os.environ.get("NEBULA_TPU_LOCK_WITNESS"):
+    witness.install()

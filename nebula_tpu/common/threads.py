@@ -1,0 +1,38 @@
+"""Thread-spawn helper that propagates contextvars (tracing) across
+the thread boundary.
+
+ContextVars do not cross `threading.Thread` on their own: a thread
+spawned while a trace is live would record its spans into nothing
+(docs/manual/10-observability.md). `traced_thread` is the shared
+compliant spawn for work done ON BEHALF OF the current request —
+the thread runs inside `contextvars.copy_context()`, so the caller's
+trace (and any other context vars) follow the work.
+
+Long-lived daemon loops (raft tick/replication, heartbeats, accept
+loops) must NOT use this: they outlive any single request and would
+pin whatever trace happened to be live at boot. Those sites keep a
+raw `threading.Thread` with an inline `# nlint: disable=NL002`
+suppression naming that reason (nebula-lint rule NL002;
+docs/manual/15-static-analysis.md).
+"""
+from __future__ import annotations
+
+import contextvars
+import threading
+from typing import Any, Callable, Mapping, Optional, Sequence
+
+
+def traced_thread(target: Callable[..., Any],
+                  args: Sequence[Any] = (),
+                  kwargs: Optional[Mapping[str, Any]] = None,
+                  *, name: Optional[str] = None,
+                  daemon: bool = True) -> threading.Thread:
+    """A not-yet-started Thread whose target runs inside a COPY of the
+    spawner's contextvars context (trace propagation, NL002)."""
+    ctx = contextvars.copy_context()
+    kw = dict(kwargs or {})
+
+    def run() -> None:
+        ctx.run(target, *args, **kw)
+
+    return threading.Thread(target=run, name=name, daemon=daemon)

@@ -45,6 +45,7 @@ from ..common.faults import CircuitBreaker, faults
 from ..common.flags import graph_flags
 from ..common.qos import LANE_BULK, LANE_INTERACTIVE, OverloadShed
 from ..common.stats import stats as global_stats
+from ..common.threads import traced_thread
 from ..common.tracing import tracer as _tr
 from ..common.status import ErrorCode, Status, StatusOr
 from ..filter.expressions import (Expression, InputPropExpr,
@@ -518,8 +519,32 @@ class TpuGraphEngine:
         return v() if callable(v) else v
 
     def refresh(self, space_id: int) -> Optional[CsrSnapshot]:
-        snap = self._build_fresh(space_id)
+        # Serve-path callers hold the engine lock. A REPLACEMENT
+        # refresh (the space already has a snapshot: failover,
+        # incompatible token) must FAIL FAST — retry sleeps
+        # (storage-client KV backoff, transport reconnect pacing) are
+        # suppressed for this context, the miss degrades to the old
+        # snapshot/CPU pipe, and a background repack (own pacing,
+        # off-lock) converges. The lock-order witness caught the
+        # un-suppressed form blocking every query on the engine lock
+        # for the backoff duration during `bench --cluster` failover
+        # (docs/manual/15-static-analysis.md). FIRST-TOUCH keeps the
+        # historical paced build: the space cannot device-serve until
+        # it exists, so blocking its first query through the transient
+        # (topology watch lag on a fresh space) is the better trade.
+        from ..common.faults import no_retry_sleep
+        replacement = self._snapshots.get(space_id) is not None
+        token = no_retry_sleep.set(True) if replacement else None
+        try:
+            snap = self._build_fresh(space_id)
+        finally:
+            if token is not None:
+                no_retry_sleep.reset(token)
         if snap is None:
+            if replacement:
+                # converge off-lock: the repack ladder retries with its
+                # own backoff while queries keep the previous snapshot
+                self._kick_repack(space_id)
             return None
         self._snapshots[space_id] = snap
         self.stats["rebuilds"] += 1
@@ -597,6 +622,8 @@ class TpuGraphEngine:
                                 self._space_churn.get(space_id, 0)
                     self._recalibrating.discard(space_id)
 
+        # nlint: disable=NL002 -- shared background refit outlives any
+        # one request; adopting a caller's trace would pin a dead trace
         t = threading.Thread(target=run, daemon=True,
                              name=f"csr-recal-{space_id}")
         t.start()
@@ -987,8 +1014,18 @@ class TpuGraphEngine:
             finally:
                 self._prewarming[space_id] = False
 
-        t = threading.Thread(target=run, daemon=True,
-                             name=f"csr-prewarm-{space_id}")
+        if block:
+            # traced_thread (NL002): a `block`ing caller joins this
+            # warmup from inside its own statement, so the caller's
+            # live trace rightfully owns the spans recorded here
+            t = traced_thread(run, name=f"csr-prewarm-{space_id}")
+        else:
+            # nlint: disable=NL002 -- fire-and-forget warmup (USE
+            # path) outlives the kicking request; adopting its context
+            # would pin a finished trace and ship dead trace ctx on
+            # every warmup RPC
+            t = threading.Thread(target=run, daemon=True,
+                                 name=f"csr-prewarm-{space_id}")
         # check-then-set AND handle store under one lock hold: two
         # concurrent USEs must not both start warmups, and a blocking
         # caller that loses the race must find the WINNER's thread
@@ -1170,7 +1207,16 @@ class TpuGraphEngine:
         cursor = getattr(snap, "delta_cursor", None)
         if cs is None or cursor is None:
             return False
-        entries, new_cursor = cs(snap.space_id, cursor)
+        # the pull runs under the engine lock: suppress retry sleeps
+        # (transport reconnect pacing on a just-died host) for this
+        # context — a failed pull already degrades cleanly (poison ->
+        # CPU pipe -> background repack). Same invariant as refresh().
+        from ..common.faults import no_retry_sleep
+        _tok = no_retry_sleep.set(True)
+        try:
+            entries, new_cursor = cs(snap.space_id, cursor)
+        finally:
+            no_retry_sleep.reset(_tok)
         if entries is None:
             return False
         if entries:
@@ -1266,6 +1312,8 @@ class TpuGraphEngine:
             finally:
                 self._repacking[space_id] = False
 
+        # nlint: disable=NL002 -- background repack serves every later
+        # query, not the one that happened to trip it; no trace adoption
         threading.Thread(target=run, daemon=True,
                          name=f"csr-repack-{space_id}").start()
         return True
@@ -2210,6 +2258,8 @@ class TpuGraphEngine:
             from . import mesh_exec
             mesh_exec.ensure_sharded_aligned(mesh, snap)
 
+        # nlint: disable=NL002 -- one-shot shared layout build spanning
+        # many windows; must not attach to the kicking window's trace
         threading.Thread(target=run, daemon=True,
                          name=f"mesh-aligned-{snap.space_id}").start()
 
@@ -3935,7 +3985,10 @@ class TpuGraphEngine:
                # space churns BUDGET_RECAL_CHURN versions past this
                "churn_at_fit": self._space_churn.get(space_id, 0)}
         self.sparse_budget_calibrations[space_id] = rec
-        global_stats.add_value("tpu_engine.sparse_budget_fit", fitted)
+        # kind="timing": the fitted budget is a value distribution (a
+        # gauge sampled per calibration), not a monotonic event count
+        global_stats.add_value("tpu_engine.sparse_budget_fit", fitted,
+                               kind="timing")
         _LOG.info("sparse budget calibrated (space %d): %s", space_id, rec)
         return rec
 

@@ -39,11 +39,24 @@ class ClientSession:
 
 
 class SessionManager:
-    def __init__(self, idle_timeout_secs: float = DEFAULT_IDLE_TIMEOUT_SECS):
+    def __init__(self, idle_timeout_secs: Optional[float] = None):
         self._sessions: Dict[int, ClientSession] = {}
         self._next_id = itertools.count(1)
         self._lock = threading.Lock()
-        self._idle_timeout = idle_timeout_secs
+        # explicit override wins; otherwise the MUTABLE
+        # `session_idle_timeout_secs` flag is consulted per check, so a
+        # hot-set (through /flags or the meta config pull) takes effect
+        # without a restart — gflags parity (found by nebula-lint NL003:
+        # the flag was declared but this manager hardcoded the default)
+        self._idle_timeout_override = idle_timeout_secs
+
+    @property
+    def _idle_timeout(self) -> float:
+        if self._idle_timeout_override is not None:
+            return self._idle_timeout_override
+        from ..common.flags import graph_flags
+        return graph_flags.get_or("session_idle_timeout_secs",
+                                  DEFAULT_IDLE_TIMEOUT_SECS, float)
 
     def create(self, user: str) -> ClientSession:
         with self._lock:

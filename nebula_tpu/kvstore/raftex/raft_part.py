@@ -126,9 +126,12 @@ class RaftPart:
         self._repl_cv = threading.Condition()
         self._repl_needed = False
         self._last_round = 0.0
+        # nlint: disable=NL002 -- part-lifetime consensus loops; they
+        # serve every client and must not adopt the booter's trace
         self._repl_thread = threading.Thread(
             target=self._replicator_loop, daemon=True,
             name=f"raft-repl-{space_id}-{part_id}-{addr}")
+        # nlint: disable=NL002 -- part-lifetime consensus loop (above)
         self._tick_thread = threading.Thread(
             target=self._ticker_loop, daemon=True,
             name=f"raft-tick-{space_id}-{part_id}-{addr}")
@@ -264,6 +267,8 @@ class RaftPart:
             # vote request (the command must replicate first, so the
             # leader does NOT step down at append time).
             if target == self.addr and self.role is not Role.LEADER:
+                # nlint: disable=NL002 -- election is cluster state
+                # machinery, not work owed to the triggering request
                 threading.Thread(target=self._leader_election,
                                  daemon=True).start()
 
@@ -612,6 +617,8 @@ class RaftPart:
             if host.sending_snapshot or self._snapshot_rows is None:
                 return
             host.sending_snapshot = True
+        # nlint: disable=NL002 -- catch-up transfer to a lagging peer;
+        # spans belong to no client trace
         threading.Thread(target=self._send_snapshot, args=(host,),
                          daemon=True).start()
 

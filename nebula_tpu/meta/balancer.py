@@ -151,6 +151,8 @@ class Balancer:
                     self.meta._put((t.key(), t.value()))
             self._running_plan = plan_id
             self._stop_flag = False
+            # nlint: disable=NL002 -- the plan runs for minutes, far
+            # beyond the BALANCE DATA statement that submitted it
             self._thread = threading.Thread(
                 target=self._run_plan, args=(plan_id, tasks), daemon=True,
                 name=f"balance-plan-{plan_id}")

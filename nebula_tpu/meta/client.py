@@ -88,12 +88,14 @@ class MetaClient:
     def start(self, heartbeat: bool = True, watch_topology: bool = True,
               load_interval: float = 1.0) -> "MetaClient":
         if heartbeat and self.local_addr:
+            # nlint: disable=NL002 -- process-lifetime heartbeat loop
             t = threading.Thread(target=self._hb_loop, daemon=True,
                                  name="meta-heartbeat")
             t.start()
             self._threads.append(t)
         if watch_topology:
             self._sync_once()  # synchronous first load (waitForMetadReady)
+            # nlint: disable=NL002 -- process-lifetime topology watch
             t = threading.Thread(target=self._watch_loop,
                                  args=(load_interval,), daemon=True,
                                  name="meta-watch")

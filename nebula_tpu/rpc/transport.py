@@ -32,7 +32,7 @@ import threading
 import time
 from typing import Any, Dict, Optional, Tuple
 
-from ..common.faults import faults, jittered_delay
+from ..common.faults import faults, jittered_delay, pace_retry
 from ..common.stats import stats as global_stats
 from ..common.tracing import tracer
 from . import wire
@@ -156,6 +156,8 @@ class RpcServer:
         with self._conns_lock:   # atomic vs stop(): no serve-after-close
             if self._stopping:
                 return self   # stopped before serving (e.g. wrong_cluster)
+            # nlint: disable=NL002 -- server-lifetime accept loop;
+            # per-request traces are adopted in _handle via tracer.remote
             self._thread = threading.Thread(
                 target=self._server.serve_forever,
                 name=f"rpc-{self.port}", daemon=True)
@@ -305,7 +307,10 @@ class RpcClient:
     RETRY_BACKOFF_CAP = 0.5
 
     def _reconnect_backoff(self, paced: int) -> None:
-        time.sleep(jittered_delay(self.RETRY_BACKOFF_BASE,
+        # pace_retry, not time.sleep: a hot-lock serve-path section
+        # (engine refresh) suppresses retry sleeps in its context —
+        # sleeping here would hold that lock for the backoff duration
+        pace_retry(jittered_delay(self.RETRY_BACKOFF_BASE,
                                   self.RETRY_BACKOFF_CAP, paced))
 
     def call(self, method: str, *args, **kwargs) -> Any:

@@ -480,14 +480,20 @@ class StorageClient:
 
     def _kv_backoff(self, cls_key: str, attempt: int,
                     retries_left: bool) -> None:
-        from ..common.faults import jittered_delay
+        from ..common.faults import jittered_delay, pace_retry
         self.retry_stats[cls_key] += 1
         stats.add_value("storage_client.kv_retry." + cls_key,
                         kind="counter")
         if not retries_left:
             return   # terminal failure: no point sleeping before it
         base, cap = self.KV_BACKOFF[cls_key]
-        time.sleep(jittered_delay(base, cap, attempt))
+        # pace_retry: a first-touch snapshot refresh reaches this loop
+        # while HOLDING the engine lock (scan_part_cols during
+        # failover) — that context suppresses the sleep, so retries
+        # rotate hints immediately and a miss degrades to the CPU pipe
+        # instead of blocking every query on the lock (lock-witness
+        # finding; docs/manual/15-static-analysis.md)
+        pace_retry(jittered_delay(base, cap, attempt))
 
     def _kv_retry(self, space_id: int, part: int, call, classify,
                   max_retries: int = 3):
@@ -602,6 +608,8 @@ class StorageClient:
             t = self._vwatchers.get(host)
             if t is not None and t.is_alive():
                 return
+            # nlint: disable=NL002 -- host-lifetime liveness long-poll;
+            # it watches for EVERY future query, not the current one
             t = threading.Thread(target=self._watch_host, args=(host,),
                                  name=f"version-watch-{host}", daemon=True)
             self._vwatchers[host] = t

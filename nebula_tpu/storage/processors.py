@@ -133,11 +133,16 @@ class StorageService:
 
     def __init__(self, store: GraphStore, schema_manager: SchemaManager,
                  host: str = "local",
-                 max_edges_per_vertex: int = DEFAULT_MAX_EDGES_PER_VERTEX):
+                 max_edges_per_vertex: Optional[int] = None):
         self.store = store
         self.sm = schema_manager
         self.host = host
-        self.max_edges_per_vertex = max_edges_per_vertex
+        # explicit override wins; otherwise the MUTABLE
+        # `max_edge_returned_per_vertex` storage flag supplies the
+        # per-vertex truncation cap hot-settably (found by nebula-lint
+        # NL003: the flag was declared but this service hardcoded the
+        # default and never read it)
+        self._max_edges_override = max_edges_per_vertex
         # in-flight read processors, served by storaged's /queries (the
         # storage-side twin of the graphd active-query registry)
         self.active_ops = ActiveQueryRegistry()
@@ -160,6 +165,13 @@ class StorageService:
             # keep working after construction (hot memory relief)
             byte_cap=lambda: int(storage_flags.get("scan_cache_mb",
                                                    256)) * (1 << 20))
+
+    @property
+    def max_edges_per_vertex(self) -> int:
+        if self._max_edges_override is not None:
+            return self._max_edges_override
+        return storage_flags.get_or("max_edge_returned_per_vertex",
+                                    DEFAULT_MAX_EDGES_PER_VERTEX, int)
 
     def _catalog_version(self) -> int:
         v = getattr(self.sm, "_meta", None)

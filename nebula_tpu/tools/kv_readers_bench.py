@@ -48,6 +48,8 @@ def main(argv=None):
                 i += len(batch)
                 counts[slot] = i
 
+        # nlint: disable=NL002 -- load-origin bench workers; there is
+        # no inbound trace to carry
         ts = [threading.Thread(target=reader, args=(i,))
               for i in range(threads)]
         t0 = time.time()

@@ -1,0 +1,22 @@
+"""nebula-lint: invariant-enforcing static analysis for this repo.
+
+Eight PRs of review-hardening notes in CHANGES.md are a hand-maintained
+invariant catalog — locks that must not be held across device launches,
+threads that must carry trace context, counters that must declare a
+kind, fault points that must be registered and documented, a frozen
+wire spec. This package machine-checks those invariants with stdlib
+`ast` (no third-party deps), so a refactor cannot silently regress
+them (docs/manual/15-static-analysis.md).
+
+Usage:
+    python -m nebula_tpu.tools.lint                # text report, exit 1 on findings
+    python -m nebula_tpu.tools.lint --json         # machine-readable
+    python -m nebula_tpu.tools.lint --update-baseline
+
+The companion RUNTIME check — the lock-order witness that records the
+cross-thread lock acquisition graph and fails on cycles — lives in
+`nebula_tpu.common.lockwitness`.
+"""
+from .core import (Finding, Project, load_baseline, run_lint,  # noqa: F401
+                   write_baseline)
+from .rules import RULES  # noqa: F401

@@ -1,0 +1,100 @@
+"""CLI: `python -m nebula_tpu.tools.lint` (docs/manual/15-static-analysis.md).
+
+Exit status: 0 when every finding is inline-suppressed or baselined,
+1 when new findings exist, 2 on usage errors. `--update-baseline`
+rewrites the committed baseline from the current findings and exits 0.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from .core import (BASELINE_NAME, Project, load_baseline, run_lint,
+                   split_baseline, write_baseline)
+from .rules import RULES
+
+
+def _default_root() -> str:
+    # nebula_tpu/tools/lint/__main__.py -> repo root three levels up
+    here = os.path.dirname(os.path.abspath(__file__))
+    root = os.path.dirname(os.path.dirname(os.path.dirname(here)))
+    return root if os.path.isdir(os.path.join(root, "nebula_tpu")) \
+        else os.getcwd()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m nebula_tpu.tools.lint",
+        description="nebula-lint: repo-specific invariant checks "
+                    "NL001-NL007")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to scan (default: nebula_tpu/, "
+                         "scripts/, bench.py, __graft_entry__.py)")
+    ap.add_argument("--root", default=_default_root(),
+                    help="repo root (baseline + docs anchors)")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable output")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default <root>/{BASELINE_NAME})")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report grandfathered findings too")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the baseline from current findings")
+    ap.add_argument("--select", default=None,
+                    help="comma-separated rule codes to run "
+                         "(e.g. NL001,NL004)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for code in sorted(RULES):
+            r = RULES[code]
+            print(f"{code}  {r.title}")
+        return 0
+
+    select = None
+    if args.select:
+        select = [c.strip() for c in args.select.split(",") if c.strip()]
+        unknown = [c for c in select if c not in RULES]
+        if unknown:
+            print(f"unknown rule code(s): {', '.join(unknown)}",
+                  file=sys.stderr)
+            return 2
+
+    project = Project(args.root, args.paths or None)
+    findings, n_suppressed = run_lint(project, RULES, select)
+
+    baseline_path = args.baseline or os.path.join(args.root, BASELINE_NAME)
+    if args.update_baseline:
+        write_baseline(baseline_path, findings)
+        print(f"nebula-lint: baseline written to {baseline_path} "
+              f"({len(findings)} finding(s))")
+        return 0
+
+    baseline = {} if args.no_baseline else load_baseline(baseline_path)
+    new, grandfathered = split_baseline(findings, baseline)
+
+    if args.as_json:
+        print(json.dumps({
+            "files_scanned": len(project.files),
+            "rules": sorted(RULES),
+            "findings": [f.to_dict() for f in new],
+            "grandfathered": [f.to_dict() for f in grandfathered],
+            "suppressed": n_suppressed,
+        }, indent=1))
+        return 1 if new else 0
+
+    for f in new:
+        print(f.render())
+    status = "FAIL" if new else "OK"
+    print(f"nebula-lint: {status} — {len(new)} finding(s), "
+          f"{len(grandfathered)} baselined, {n_suppressed} suppressed "
+          f"inline, {len(project.files)} files, "
+          f"{len(select or RULES)} rules")
+    return 1 if new else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

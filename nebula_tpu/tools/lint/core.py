@@ -1,0 +1,338 @@
+"""Rule engine for nebula-lint: file model, suppressions, baseline.
+
+The engine is deliberately small: a `Project` parses every scanned
+file once (stdlib `ast`), rules are plain functions `Project ->
+[Finding]` registered under a stable NLxxx code, and two escape
+hatches exist for findings that are intentional or grandfathered:
+
+- inline suppression on the finding's line (or the line above):
+      x = risky()   # nlint: disable=NL001 -- reason why this is safe
+  A reason after `--` is required policy for this repo (the lint
+  itself only enforces the grammar; review enforces the reason).
+- a committed baseline file (`.nlint-baseline.json`) keyed by
+  (rule, file, enclosing qualname, message) — line-number drift does
+  not invalidate entries, real changes to the finding do.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from typing import Callable, Dict, Iterable, List, Optional, Set, Tuple
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*nlint:\s*disable(?P<file>-file)?\s*=\s*"
+    r"(?P<codes>NL\d{3}(?:\s*,\s*NL\d{3})*)")
+
+# default scan roots, relative to the repo root
+DEFAULT_SCAN = ("nebula_tpu", "scripts", "bench.py", "__graft_entry__.py")
+SKIP_DIRS = {"__pycache__", ".git", ".claude", "node_modules"}
+
+
+class Finding:
+    """One rule violation at one site."""
+
+    __slots__ = ("rule", "path", "line", "col", "message", "context")
+
+    def __init__(self, rule: str, path: str, line: int, col: int,
+                 message: str, context: str = ""):
+        self.rule = rule
+        self.path = path          # repo-relative, forward slashes
+        self.line = int(line)
+        self.col = int(col)
+        self.message = message
+        self.context = context    # enclosing def/class qualname
+
+    def key(self) -> str:
+        """Line-independent identity used by the baseline."""
+        return f"{self.rule}|{self.path}|{self.context}|{self.message}"
+
+    def render(self) -> str:
+        where = f"{self.path}:{self.line}:{self.col}"
+        ctx = f" [{self.context}]" if self.context else ""
+        return f"{where}: {self.rule} {self.message}{ctx}"
+
+    def to_dict(self) -> Dict[str, object]:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "context": self.context}
+
+
+class SourceFile:
+    """One parsed file: AST, qualname map, inline suppressions."""
+
+    def __init__(self, root: str, path: str):
+        self.abspath = path
+        self.rel = os.path.relpath(path, root).replace(os.sep, "/")
+        with open(path, encoding="utf-8", errors="replace") as f:
+            self.text = f.read()
+        self.tree: Optional[ast.AST] = None
+        self.syntax_error: Optional[str] = None
+        try:
+            self.tree = ast.parse(self.text, filename=self.rel)
+        except SyntaxError as e:
+            self.syntax_error = f"{e.msg} (line {e.lineno})"
+        self.line_suppressions: Dict[int, Set[str]] = {}
+        self.file_suppressions: Set[str] = set()
+        self._comment_lines: Set[int] = set()
+        for i, line in enumerate(self.text.splitlines(), 1):
+            if line.lstrip().startswith("#"):
+                self._comment_lines.add(i)
+            if "nlint" not in line:
+                continue
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            codes = {c.strip() for c in m.group("codes").split(",")}
+            if m.group("file"):
+                self.file_suppressions |= codes
+            else:
+                self.line_suppressions.setdefault(i, set()).update(codes)
+        self._qualnames: Optional[Dict[ast.AST, str]] = None
+        self._parents: Optional[Dict[ast.AST, ast.AST]] = None
+
+    # ------------------------------------------------------------- maps
+    def qualnames(self) -> Dict[ast.AST, str]:
+        """node -> enclosing `Class.method`-style qualname (the node's
+        own name for def/class nodes)."""
+        if self._qualnames is None:
+            self._qualnames = {}
+            if self.tree is not None:
+                self._walk_qual(self.tree, "")
+        return self._qualnames
+
+    def _walk_qual(self, node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.ClassDef)):
+                q = f"{prefix}.{child.name}" if prefix else child.name
+                self._qualnames[child] = q
+                self._walk_qual(child, q)
+            else:
+                if prefix:
+                    self._qualnames[child] = prefix
+                self._walk_qual(child, prefix)
+
+    def parents(self) -> Dict[ast.AST, ast.AST]:
+        if self._parents is None:
+            self._parents = {}
+            if self.tree is not None:
+                for node in ast.walk(self.tree):
+                    for child in ast.iter_child_nodes(node):
+                        self._parents[child] = node
+        return self._parents
+
+    def qualname_at(self, node: ast.AST) -> str:
+        return self.qualnames().get(node, "")
+
+    def suppressed(self, finding: Finding) -> bool:
+        """Suppressed by a marker on the finding's line or anywhere in
+        the contiguous comment block directly above it (reasons often
+        wrap to several comment lines)."""
+        if finding.rule in self.file_suppressions:
+            return True
+        if finding.rule in self.line_suppressions.get(finding.line, ()):
+            return True
+        line = finding.line - 1
+        while line in self._comment_lines:
+            if finding.rule in self.line_suppressions.get(line, ()):
+                return True
+            line -= 1
+        return False
+
+
+class Project:
+    """All scanned files plus repo-level resources rules may consult."""
+
+    def __init__(self, root: str, paths: Optional[Iterable[str]] = None):
+        self.root = os.path.abspath(root)
+        self.files: List[SourceFile] = []
+        for p in self._discover(paths or DEFAULT_SCAN):
+            self.files.append(SourceFile(self.root, p))
+        self.files.sort(key=lambda f: f.rel)
+
+    def _discover(self, paths: Iterable[str]) -> List[str]:
+        out: List[str] = []
+        for p in paths:
+            full = p if os.path.isabs(p) else os.path.join(self.root, p)
+            if os.path.isfile(full) and full.endswith(".py"):
+                out.append(full)
+            elif os.path.isdir(full):
+                for dirpath, dirnames, filenames in os.walk(full):
+                    dirnames[:] = [d for d in dirnames
+                                   if d not in SKIP_DIRS]
+                    for fn in sorted(filenames):
+                        if fn.endswith(".py"):
+                            out.append(os.path.join(dirpath, fn))
+        return out
+
+    def read_text(self, rel: str) -> Optional[str]:
+        """A non-scanned repo file (docs, specs); None when absent."""
+        full = os.path.join(self.root, rel)
+        try:
+            with open(full, encoding="utf-8", errors="replace") as f:
+                return f.read()
+        except OSError:
+            return None
+
+    def read_json(self, rel: str):
+        text = self.read_text(rel)
+        if text is None:
+            return None
+        try:
+            return json.loads(text)
+        except ValueError:
+            return None
+
+
+# ---------------------------------------------------------------------------
+# AST helpers shared by rules
+# ---------------------------------------------------------------------------
+
+def dotted(node: ast.AST) -> Optional[str]:
+    """`self._lock` / `threading.Thread` -> dotted string, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def last_segment(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def import_map(tree: ast.AST) -> Dict[str, str]:
+    """Local name -> fully qualified imported name for top-level (and
+    nested) imports: `import numpy as np` -> {np: numpy}; `from time
+    import sleep` -> {sleep: time.sleep}."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                out[a.asname or a.name.split(".")[0]] = a.name
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                out[a.asname or a.name] = f"{node.module}.{a.name}"
+    return out
+
+
+# ---------------------------------------------------------------------------
+# baseline
+# ---------------------------------------------------------------------------
+
+BASELINE_NAME = ".nlint-baseline.json"
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    """Baseline file -> multiset of finding keys (key -> count)."""
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except OSError:
+        return {}
+    out: Dict[str, int] = {}
+    for e in data.get("findings", []):
+        k = f"{e['rule']}|{e['path']}|{e.get('context', '')}|{e['message']}"
+        out[k] = out.get(k, 0) + 1
+    return out
+
+
+def write_baseline(path: str, findings: List[Finding]) -> None:
+    data = {
+        "comment": "nebula-lint grandfathered findings; regenerate with "
+                   "`python -m nebula_tpu.tools.lint --update-baseline`. "
+                   "Entries are line-independent: (rule, path, context, "
+                   "message). Policy: NEW code never lands baseline "
+                   "entries — fix the finding or inline-suppress with a "
+                   "reason (docs/manual/15-static-analysis.md).",
+        "findings": [{"rule": f.rule, "path": f.path,
+                      "context": f.context, "message": f.message}
+                     for f in sorted(findings, key=lambda f: f.key())],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=False)
+        f.write("\n")
+
+
+def split_baseline(findings: List[Finding], baseline: Dict[str, int]
+                   ) -> Tuple[List[Finding], List[Finding]]:
+    """-> (new findings, grandfathered findings). The baseline is a
+    multiset: N entries absorb at most N identical findings."""
+    budget = dict(baseline)
+    new: List[Finding] = []
+    old: List[Finding] = []
+    for f in findings:
+        k = f.key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            old.append(f)
+        else:
+            new.append(f)
+    return new, old
+
+
+# ---------------------------------------------------------------------------
+# runner
+# ---------------------------------------------------------------------------
+
+def run_lint(project: Project,
+             rules: Dict[str, "object"],
+             select: Optional[Iterable[str]] = None
+             ) -> Tuple[List[Finding], int]:
+    """Run rules over the project. Returns (findings after inline
+    suppressions, count of inline-suppressed findings). Baseline
+    filtering is the caller's concern (CLI / tier-1 test)."""
+    by_rel = {f.rel: f for f in project.files}
+    selected = set(select) if select else None
+    raw: List[Finding] = []
+    for code in sorted(rules):
+        if selected is not None and code not in selected:
+            continue
+        raw.extend(rules[code].check(project))
+    for f in project.files:
+        if f.syntax_error:
+            raw.append(Finding("NL000", f.rel, 1, 0,
+                               f"syntax error: {f.syntax_error}"))
+    kept: List[Finding] = []
+    n_suppressed = 0
+    for fd in raw:
+        sf = by_rel.get(fd.path)
+        if sf is not None and sf.suppressed(fd):
+            n_suppressed += 1
+        else:
+            kept.append(fd)
+    kept.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return kept, n_suppressed
+
+
+class Rule:
+    """A registered rule: stable code, one-line title, check fn."""
+
+    def __init__(self, code: str, title: str,
+                 fn: Callable[[Project], List[Finding]]):
+        self.code = code
+        self.title = title
+        self.fn = fn
+        self.doc = (fn.__doc__ or "").strip()
+
+    def check(self, project: Project) -> List[Finding]:
+        out = []
+        for f in self.fn(project):
+            assert f.rule == self.code, f"{self.code} emitted {f.rule}"
+            out.append(f)
+        return out

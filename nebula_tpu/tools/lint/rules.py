@@ -1,0 +1,707 @@
+"""NL001-NL007: the rule catalog (docs/manual/15-static-analysis.md).
+
+Every rule encodes an invariant this repo already states in prose
+(CHANGES.md review-hardening notes, the manuals); the rule docstrings
+cite the source. Rules are AST-only — nothing here imports or executes
+repo code, so the lint runs in milliseconds and cannot be confused by
+import-time side effects.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import (Finding, Project, Rule, const_str, dotted, import_map,
+                   last_segment)
+
+RULES: Dict[str, Rule] = {}
+
+
+def rule(code: str, title: str):
+    def deco(fn):
+        RULES[code] = Rule(code, title, fn)
+        return fn
+    return deco
+
+
+def _in_package(f) -> bool:
+    return f.rel.startswith("nebula_tpu/")
+
+
+# ---------------------------------------------------------------------------
+# NL001 — blocking call under a hot lock
+# ---------------------------------------------------------------------------
+
+# names that make a `with <expr>:` subject a lock/condition guard
+_LOCK_NAME_RE = re.compile(r"(?:^|_)(?:lock|wlock|vlock|qlock|rlock|"
+                           r"mu|mutex|cv|cond)$")
+
+
+def is_lock_name(name: Optional[str]) -> bool:
+    return bool(name) and bool(_LOCK_NAME_RE.search(name.lstrip("_")))
+
+
+# module-level calls that block: {qualified prefix: why}
+_BLOCKING_QUALIFIED = {
+    "time.sleep": "sleeps",
+    "subprocess.run": "spawns a subprocess",
+    "subprocess.Popen": "spawns a subprocess",
+    "subprocess.call": "spawns a subprocess",
+    "subprocess.check_call": "spawns a subprocess",
+    "subprocess.check_output": "spawns a subprocess",
+    "jax.device_put": "synchronous device transfer",
+    "jax.device_get": "synchronous device fetch",
+}
+# method names that block regardless of receiver type
+_BLOCKING_METHODS = {
+    "block_until_ready": "blocks on the device kernel",
+    "sendall": "blocking socket send",
+    "recv": "blocking socket receive",
+    "recvfrom": "blocking socket receive",
+    "accept": "blocking socket accept",
+}
+# numpy fetch: np.asarray/np.array on a device buffer is a synchronous
+# D2H copy (CHANGES.md: "the blocking np.asarray fetch happens outside
+# the engine lock")
+_NUMPY_FETCH = {"asarray", "array"}
+
+
+@rule("NL001", "blocking call inside a `with <hot-lock>:` body")
+def nl001(project: Project) -> List[Finding]:
+    """Locks on the serve path are HOT: dispatcher cv, engine snapshot
+    lock, stats leaf lock, cache rungs, raft part lock. The degradation
+    ladder and the dispatcher's tail latency both assume none of them
+    is ever held across a blocking operation — a device launch, a
+    blocking `np.asarray` fetch, `time.sleep`, a socket send, a
+    subprocess (CHANGES.md PR 1/3/6 hardening notes). `<cv>.wait()` on
+    the lock itself is exempt (wait releases); any other blocking call
+    under a held lock is a finding. The runtime twin of this rule is
+    the lock-order witness's blocked-under-lock event stream."""
+    out: List[Finding] = []
+    for f in project.files:
+        if f.tree is None or not _in_package(f):
+            continue
+        imports = import_map(f.tree)
+        np_aliases = {a for a, m in imports.items() if m == "numpy"}
+
+        def classify(call: ast.Call) -> Optional[str]:
+            fn = call.func
+            d = dotted(fn)
+            if d is not None:
+                head = d.split(".")[0]
+                full = imports.get(head, head) + d[len(head):]
+                for q, why in _BLOCKING_QUALIFIED.items():
+                    if full == q:
+                        return f"`{d}()` {why}"
+                if isinstance(fn, ast.Attribute) and \
+                        isinstance(fn.value, ast.Name) and \
+                        fn.value.id in np_aliases and \
+                        fn.attr in _NUMPY_FETCH:
+                    return (f"`{d}()` may be a synchronous "
+                            f"device-to-host fetch")
+            if isinstance(fn, ast.Attribute) and \
+                    fn.attr in _BLOCKING_METHODS:
+                return f"`.{fn.attr}()` {_BLOCKING_METHODS[fn.attr]}"
+            return None
+
+        def visit(node: ast.AST, held: List[str]) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda, ast.ClassDef)):
+                # a nested def's body runs later, outside this hold
+                for child in ast.iter_child_nodes(node):
+                    visit(child, [])
+                return
+            if isinstance(node, ast.With):
+                locks = [dotted(item.context_expr) or "<lock>"
+                         for item in node.items
+                         if is_lock_name(last_segment(item.context_expr))]
+                for item in node.items:
+                    visit(item.context_expr, held)
+                inner = held + locks
+                for stmt in node.body:
+                    visit(stmt, inner)
+                return
+            if isinstance(node, ast.Call) and held:
+                why = classify(node)
+                if why is not None:
+                    fn = node.func
+                    # cv.wait()/cv.wait_for() on a HELD lock releases it
+                    is_wait = (isinstance(fn, ast.Attribute)
+                               and fn.attr in ("wait", "wait_for")
+                               and dotted(fn.value) in held)
+                    if not is_wait:
+                        out.append(Finding(
+                            "NL001", f.rel, node.lineno, node.col_offset,
+                            f"{why} while holding hot lock "
+                            f"`{held[-1]}`", f.qualname_at(node)))
+            for child in ast.iter_child_nodes(node):
+                visit(child, held)
+
+        visit(f.tree, [])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# NL002 — raw Thread spawn without trace-context propagation
+# ---------------------------------------------------------------------------
+
+@rule("NL002", "Thread() spawn without contextvars.copy_context()")
+def nl002(project: Project) -> List[Finding]:
+    """ContextVars don't cross threads on their own: a thread spawned
+    on a serve/fan-out path while a trace is live records its spans
+    into nothing (docs/manual/10-observability.md; the storage client's
+    `_submit` shows the required pattern). A `threading.Thread(...)`
+    spawn is compliant only when THE SPAWN ITSELF carries the context:
+    its target subtree references `copy_context` directly, a name
+    bound from `contextvars.copy_context()` in the enclosing scope, or
+    a local def whose body does (the `common.threads.traced_thread`
+    pattern) — a compliant spawn elsewhere in the same function does
+    NOT whitewash a raw one. Long-lived daemon loops that must NOT
+    adopt a request's trace (they outlive it) carry an inline
+    suppression naming that reason."""
+
+    def _references(tree: ast.AST, ctx_names: set,
+                    local_defs: Dict[str, List[ast.AST]],
+                    depth: int = 0) -> bool:
+        for sub in ast.walk(tree):
+            if isinstance(sub, (ast.Name, ast.Attribute)):
+                seg = last_segment(sub)
+                if seg in ("copy_context", "traced_thread"):
+                    return True
+                if isinstance(sub, ast.Name) and sub.id in ctx_names:
+                    return True
+            # target is a local def: its BODY may carry the context
+            # (ctx.run inside `run`, the traced_thread helper shape)
+            if depth == 0 and isinstance(sub, ast.Name) \
+                    and sub.id in local_defs:
+                for d in local_defs[sub.id]:
+                    if _references(d, ctx_names, local_defs, 1):
+                        return True
+        return False
+
+    out: List[Finding] = []
+    for f in project.files:
+        if f.tree is None or not _in_package(f):
+            continue
+        parents = f.parents()
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if d not in ("threading.Thread", "Thread"):
+                continue
+            # enclosing function scope (module, if top-level)
+            scope: ast.AST = node
+            while scope in parents and not isinstance(
+                    scope, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.Module)):
+                scope = parents[scope]
+            ctx_names = set()
+            local_defs: Dict[str, List[ast.AST]] = {}
+            for sub in ast.walk(scope):
+                if isinstance(sub, ast.Assign) and \
+                        isinstance(sub.value, ast.Call):
+                    vd = dotted(sub.value.func) or ""
+                    if vd.split(".")[-1] == "copy_context":
+                        for tgt in sub.targets:
+                            if isinstance(tgt, ast.Name):
+                                ctx_names.add(tgt.id)
+                elif isinstance(sub, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)) \
+                        and sub is not scope:
+                    local_defs.setdefault(sub.name, []).append(sub)
+            if not _references(node, ctx_names, local_defs):
+                out.append(Finding(
+                    "NL002", f.rel, node.lineno, node.col_offset,
+                    "raw Thread() spawn: target will not carry the "
+                    "caller's trace context (wrap with "
+                    "contextvars.copy_context().run or "
+                    "common.threads.traced_thread)",
+                    f.qualname_at(node)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# NL003 — flag declare/get cross-check
+# ---------------------------------------------------------------------------
+
+def _is_flags_receiver(fn: ast.AST) -> Optional[str]:
+    """`graph_flags.get` / `storage_flags.declare` -> receiver name
+    when it looks like a FlagRegistry, else None. A bare `flags` /
+    `_flags` receiver is the registry's INTERNAL dict (or the module
+    object), not a registry instance — excluded."""
+    if not isinstance(fn, ast.Attribute):
+        return None
+    seg = last_segment(fn.value)
+    if seg is None:
+        return None
+    stripped = seg.lstrip("_")
+    if stripped.endswith("flags") and stripped != "flags":
+        return seg
+    return None
+
+
+@rule("NL003", "undeclared flag read / dead declared flag")
+def nl003(project: Project) -> List[Finding]:
+    """Every `flags.get(name)` must have a matching `declare(...)`
+    (an undeclared read silently returns the fallback forever — the
+    gflags parity contract in common/flags.py), and every declared
+    flag must be READ somewhere (a declared-but-never-read flag is
+    dead weight that /flags and the meta config registry still
+    advertise). A flag consumed via a watcher or flagfile counts as
+    read when its name literal appears outside the declare call."""
+    declares: Dict[str, List[Tuple[str, int, int, str]]] = {}
+    reads: Set[str] = set()
+    read_sites: List[Tuple[str, str, int, int, str]] = []
+    literal_count: Dict[str, int] = {}
+
+    for f in project.files:
+        if f.tree is None:
+            continue
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str):
+                literal_count[node.value] = \
+                    literal_count.get(node.value, 0) + 1
+            if not isinstance(node, ast.Call):
+                continue
+            recv = _is_flags_receiver(node.func)
+            if recv is None:
+                continue
+            method = node.func.attr  # type: ignore[union-attr]
+            name = const_str(node.args[0]) if node.args else None
+            if name is None:
+                continue
+            if method == "declare":
+                declares.setdefault(name, []).append(
+                    (f.rel, node.lineno, node.col_offset,
+                     f.qualname_at(node)))
+            elif method in ("get", "get_or"):
+                reads.add(name)
+                read_sites.append((name, f.rel, node.lineno,
+                                   node.col_offset, f.qualname_at(node)))
+
+    out: List[Finding] = []
+    for name, rel, line, col, ctx in read_sites:
+        if name not in declares:
+            out.append(Finding(
+                "NL003", rel, line, col,
+                f"flag {name!r} is read but never declare()d — the "
+                f"read silently returns its fallback forever", ctx))
+    for name, sites in declares.items():
+        if name in reads:
+            continue
+        # watcher/flagfile-consumed flags: the literal shows up beyond
+        # its declare site(s)
+        if literal_count.get(name, 0) > len(sites):
+            continue
+        rel, line, col, ctx = sites[0]
+        out.append(Finding(
+            "NL003", rel, line, col,
+            f"flag {name!r} is declared but never read anywhere "
+            f"(dead flag)", ctx))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# NL004 — StatsManager.add_value kind consistency
+# ---------------------------------------------------------------------------
+
+@rule("NL004", "add_value kind inconsistent across sites for one metric")
+def nl004(project: Project) -> List[Finding]:
+    """A metric's kind ("counter" | "timing" | untagged) is fixed at
+    FIRST registration (common/stats.py) — when call sites disagree,
+    whichever site runs first wins and the snapshot/Prometheus shape
+    of the metric becomes load-order-dependent. One name, one kind,
+    across every `add_value` site; and every site must declare one
+    (an untagged metric keeps the legacy emit-everything shape —
+    p95 gauges over pure counters are noise on /metrics)."""
+    sites: Dict[str, List[Tuple[Optional[str], str, int, int, str]]] = {}
+    out: List[Finding] = []
+    for f in project.files:
+        if f.tree is None or not _in_package(f):
+            continue
+        if f.rel == "nebula_tpu/common/stats.py":
+            continue      # the registry itself (Duration's generic feed)
+        for node in ast.walk(f.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "add_value"):
+                continue
+            recv = last_segment(node.func.value)
+            if recv is None or "stats" not in recv.lstrip("_").lower():
+                continue
+            name = const_str(node.args[0]) if node.args else None
+            kind: Optional[str] = None
+            has_kind = False
+            if len(node.args) >= 3:
+                kind = const_str(node.args[2])
+                has_kind = True
+            for kw in node.keywords:
+                if kw.arg == "kind":
+                    kind = const_str(kw.value)
+                    has_kind = True
+            if not has_kind:
+                shown = name if name is not None else "<dynamic>"
+                out.append(Finding(
+                    "NL004", f.rel, node.lineno, node.col_offset,
+                    f"metric {shown!r} reported without a kind tag — "
+                    f"declare kind=\"counter\" or kind=\"timing\" so "
+                    f"the snapshot/Prometheus shape is explicit",
+                    f.qualname_at(node)))
+            if name is None:
+                continue          # dynamic names: per-family, skip
+            sites.setdefault(name, []).append(
+                (kind, f.rel, node.lineno, node.col_offset,
+                 f.qualname_at(node)))
+
+    for name, ss in sites.items():
+        # untagged sites are already reported above; conflict detection
+        # runs over the explicitly tagged ones
+        tagged = sorted({k for k, *_ in ss if k is not None})
+        if len(tagged) <= 1:
+            continue
+        canonical = tagged[0]
+        for kind, rel, line, col, ctx in ss:
+            if kind is not None and kind != canonical:
+                out.append(Finding(
+                    "NL004", rel, line, col,
+                    f"metric {name!r} reported here as {kind!r} but as "
+                    f"{canonical!r} elsewhere — kind is fixed at first "
+                    f"registration, so the metric's shape depends on "
+                    f"call order", ctx))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# NL005 — fault points: fired => registered => documented
+# ---------------------------------------------------------------------------
+
+_FAULT_DOC = "docs/manual/9-robustness.md"
+
+
+@rule("NL005", "faults.fire() point unregistered or undocumented")
+def nl005(project: Project) -> List[Finding]:
+    """Chaos plans arm fault points BY NAME; a fired-but-unregistered
+    point is invisible in the /faults catalog and un-armable by name
+    review, and an undocumented one breaks the docs/manual/
+    9-robustness.md contract that the manual lists every injectable
+    site (CHANGES.md PR 3)."""
+    registered: Set[str] = set()
+    fire_sites: List[Tuple[str, str, int, int, str]] = []
+    reg_sites: Dict[str, Tuple[str, int, int, str]] = {}
+    for f in project.files:
+        if f.tree is None:
+            continue
+        for node in ast.walk(f.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            recv = last_segment(node.func.value)
+            if recv is None or "faults" not in recv.lstrip("_").lower():
+                continue
+            name = const_str(node.args[0]) if node.args else None
+            if name is None:
+                continue
+            if node.func.attr == "register":
+                registered.add(name)
+                reg_sites.setdefault(
+                    name, (f.rel, node.lineno, node.col_offset,
+                           f.qualname_at(node)))
+            elif node.func.attr == "fire":
+                fire_sites.append((name, f.rel, node.lineno,
+                                   node.col_offset, f.qualname_at(node)))
+
+    doc = project.read_text(_FAULT_DOC)
+    out: List[Finding] = []
+    fired_names: Set[str] = set()
+    for name, rel, line, col, ctx in fire_sites:
+        fired_names.add(name)
+        if name not in registered:
+            out.append(Finding(
+                "NL005", rel, line, col,
+                f"fault point {name!r} is fired but never "
+                f"register()ed — invisible in the /faults catalog", ctx))
+    for name in sorted(fired_names & registered):
+        if doc is None or name not in doc:
+            rel, line, col, ctx = reg_sites[name]
+            out.append(Finding(
+                "NL005", rel, line, col,
+                f"fault point {name!r} is not listed in "
+                f"{_FAULT_DOC}", ctx))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# NL006 — jit purity
+# ---------------------------------------------------------------------------
+
+_NP_DTYPES = {"int8", "int16", "int32", "int64", "uint8", "uint16",
+              "uint32", "uint64", "float16", "float32", "float64",
+              "bool_", "dtype", "iinfo", "finfo"}
+_HOST_METHODS = {"item", "tolist"}
+
+
+def _jit_function_nodes(f) -> List[ast.AST]:
+    """Function nodes handed to jax.jit / shard_map in this file:
+    decorated defs, `jax.jit(fn)` / `shard_map(fn, ...)` on a local
+    def, and inline lambdas."""
+    tree = f.tree
+    defs_by_name: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    def is_jit_expr(e: ast.AST) -> bool:
+        d = dotted(e)
+        if d in ("jax.jit", "jit", "shard_map",
+                 "jax.experimental.shard_map.shard_map", "pjit",
+                 "jax.pjit"):
+            return True
+        # partial(jax.jit, ...) / functools.partial(jax.jit, ...)
+        if isinstance(e, ast.Call) and \
+                dotted(e.func) in ("partial", "functools.partial") and \
+                e.args and is_jit_expr(e.args[0]):
+            return True
+        return False
+
+    jitted: List[ast.AST] = []
+    seen: Set[int] = set()
+
+    def add(node: ast.AST) -> None:
+        if id(node) not in seen:
+            seen.add(id(node))
+            jitted.append(node)
+
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if is_jit_expr(dec):
+                    add(node)
+        elif isinstance(node, ast.Call) and is_jit_expr(node.func) \
+                and node.args:
+            target = node.args[0]
+            if isinstance(target, ast.Lambda):
+                add(target)
+            elif isinstance(target, ast.Name):
+                for d in defs_by_name.get(target.id, ()):
+                    add(d)
+    return jitted
+
+
+@rule("NL006", "host-side operation inside a jit-compiled function")
+def nl006(project: Project) -> List[Finding]:
+    """Functions handed to `jax.jit`/`shard_map`/the fused program
+    builders are traced: host numpy materialization (`np.asarray`),
+    `.item()`/`.tolist()`, Python RNG, `print`, clock reads and I/O
+    either poison the trace with a hidden synchronization or bake one
+    trace-time value into every later execution (docs/manual/
+    5-tpu-engine.md; /opt/skills jit guidance)."""
+    out: List[Finding] = []
+    for f in project.files:
+        if f.tree is None or not _in_package(f):
+            continue
+        imports = import_map(f.tree)
+        np_aliases = {a for a, m in imports.items() if m == "numpy"}
+        rng_aliases = {a for a, m in imports.items() if m == "random"}
+        time_aliases = {a for a, m in imports.items() if m == "time"}
+        for fn_node in _jit_function_nodes(f):
+            for node in ast.walk(fn_node):
+                if node is fn_node or not isinstance(node, ast.Call):
+                    continue
+                d = dotted(node.func)
+                msg = None
+                if d == "print":
+                    msg = "print() inside a jit-traced function"
+                elif d == "open":
+                    msg = "file I/O inside a jit-traced function"
+                elif isinstance(node.func, ast.Attribute) and \
+                        isinstance(node.func.value, ast.Name):
+                    head, attr = node.func.value.id, node.func.attr
+                    if head in np_aliases and attr not in _NP_DTYPES:
+                        msg = (f"host numpy call `{d}()` inside a "
+                               f"jit-traced function")
+                    elif head in rng_aliases:
+                        msg = (f"Python RNG `{d}()` inside a jit-traced "
+                               f"function (value freezes at trace time)")
+                    elif head in time_aliases:
+                        msg = (f"clock read `{d}()` inside a jit-traced "
+                               f"function (value freezes at trace time)")
+                if msg is None and isinstance(node.func, ast.Attribute) \
+                        and node.func.attr in _HOST_METHODS and \
+                        not node.args:
+                    msg = (f"`.{node.func.attr}()` forces a host sync "
+                           f"inside a jit-traced function")
+                if msg is not None:
+                    out.append(Finding(
+                        "NL006", f.rel, node.lineno, node.col_offset,
+                        msg, f.qualname_at(node)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# NL007 — frozen wire spec conformance
+# ---------------------------------------------------------------------------
+
+_WIRE_SPEC = "docs/manual/wire-vectors.json"
+_WIRE_MODULE = "nebula_tpu/rpc/wire.py"
+_TRANSPORT_MODULE = "nebula_tpu/rpc/transport.py"
+
+
+def _dataclass_fields(cls: ast.ClassDef) -> Optional[List[str]]:
+    """Ordered field names when `cls` is a dataclass, else None."""
+    is_dc = False
+    for dec in cls.decorator_list:
+        d = dotted(dec if not isinstance(dec, ast.Call) else dec.func)
+        if d in ("dataclass", "dataclasses.dataclass"):
+            is_dc = True
+    if not is_dc:
+        return None
+    fields: List[str] = []
+    for stmt in cls.body:
+        if isinstance(stmt, ast.AnnAssign) and \
+                isinstance(stmt.target, ast.Name):
+            ann = dotted(stmt.annotation) or ""
+            if isinstance(stmt.annotation, ast.Subscript):
+                ann = dotted(stmt.annotation.value) or ""
+            if ann.split(".")[-1] == "ClassVar":
+                continue
+            fields.append(stmt.target.id)
+    return fields
+
+
+def _init_params(cls: ast.ClassDef) -> Optional[List[str]]:
+    """Positional `__init__` params after self — the wire field order
+    for the plain (non-dataclass) registered classes the codec
+    special-cases (Status/StatusOr's hand-rolled encoding)."""
+    for stmt in cls.body:
+        if isinstance(stmt, ast.FunctionDef) and stmt.name == "__init__":
+            return [a.arg for a in stmt.args.args[1:]]
+    return None
+
+
+@rule("NL007", "wire-frozen struct or envelope drifted from v1 spec")
+def nl007(project: Project) -> List[Finding]:
+    """The v1 wire spec is FROZEN (docs/manual/6-wire-protocol.md):
+    registry ids are positional, struct fields encode by declared
+    order, the rpc envelope is a 4/5-tuple request and 2/3-tuple
+    response. The conformance vectors (docs/manual/wire-vectors.json)
+    record that contract; this rule diffs the live dataclasses, the
+    `register(...)` order in rpc/wire.py and the envelope tuples in
+    rpc/transport.py against it, so an innocent-looking field
+    insertion fails lint before it fails every peer."""
+    out: List[Finding] = []
+    spec = project.read_json(_WIRE_SPEC)
+    if not isinstance(spec, dict) or "registry" not in spec:
+        out.append(Finding(
+            "NL007", _WIRE_MODULE, 1, 0,
+            f"wire conformance spec {_WIRE_SPEC} missing or unreadable "
+            f"— the frozen v1 registry cannot be checked"))
+        return out
+
+    # 1. every registered struct's declared fields match the spec
+    classes: Dict[str, List[Tuple[object, "SourceFile"]]] = {}
+    for f in project.files:
+        if f.tree is None or not _in_package(f):
+            continue
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.ClassDef):
+                classes.setdefault(node.name, []).append((node, f))
+    for entry in spec["registry"]:
+        name, kind = entry["name"], entry["kind"]
+        cands = classes.get(name, [])
+        if not cands:
+            out.append(Finding(
+                "NL007", _WIRE_MODULE, 1, 0,
+                f"registered wire type {name!r} (id {entry['id']}) has "
+                f"no class definition in the tree"))
+            continue
+        if kind != "struct":
+            continue
+        want = entry["fields"]
+        matched = False
+        candidate_fields: List[Tuple[object, object, List[str]]] = []
+        for node, f in cands:
+            got = _dataclass_fields(node)
+            if got is None:
+                got = _init_params(node)
+            if got is None:
+                continue
+            candidate_fields.append((node, f, got))
+            if got == want:
+                matched = True
+        if not matched:
+            if candidate_fields:
+                node, f, got = candidate_fields[0]
+                out.append(Finding(
+                    "NL007", f.rel, node.lineno, node.col_offset,
+                    f"wire struct {name!r} fields {got} drifted from "
+                    f"frozen v1 spec {want} — adding/reordering fields "
+                    f"breaks every conformance vector and every peer",
+                    name))
+            else:
+                out.append(Finding(
+                    "NL007", _WIRE_MODULE, 1, 0,
+                    f"registered wire type {name!r} is not a checkable "
+                    f"dataclass anywhere in the tree"))
+
+    # 2. register(...) order in wire.py matches the positional ids
+    want_names = [e["name"] for e in spec["registry"]]
+    for f in project.files:
+        if f.rel != _WIRE_MODULE or f.tree is None:
+            continue
+        for node in ast.walk(f.tree):
+            if not (isinstance(node, ast.FunctionDef)
+                    and node.name == "_register_defaults"):
+                continue
+            got_names: List[str] = []
+            reg_node = node
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) and \
+                        dotted(sub.func) == "register":
+                    reg_node = sub
+                    for a in sub.args:
+                        seg = last_segment(a)
+                        if seg:
+                            got_names.append(seg)
+            if got_names != want_names:
+                drift = next((i for i, (a, b) in enumerate(
+                    zip(got_names, want_names)) if a != b),
+                    min(len(got_names), len(want_names)))
+                out.append(Finding(
+                    "NL007", f.rel, reg_node.lineno, reg_node.col_offset,
+                    f"wire registry order drifted from the frozen v1 "
+                    f"spec at id {drift}: got "
+                    f"{got_names[drift:drift + 2]}, spec "
+                    f"{want_names[drift:drift + 2]} — ids are "
+                    f"positional; append new types at the END",
+                    "_register_defaults"))
+
+    # 3. envelope arity in transport.py: requests 4/5, responses 2/3
+    for f in project.files:
+        if f.rel != _TRANSPORT_MODULE or f.tree is None:
+            continue
+        for node in ast.walk(f.tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "encode"
+                    and last_segment(node.func.value) == "wire"
+                    and node.args
+                    and isinstance(node.args[0], ast.Tuple)):
+                continue
+            tup = node.args[0]
+            arity = len(tup.elts)
+            first = tup.elts[0]
+            is_resp = isinstance(first, ast.Constant) and \
+                isinstance(first.value, bool)
+            ok = arity in ((2, 3) if is_resp else (4, 5))
+            if not ok:
+                shape = "response" if is_resp else "request"
+                out.append(Finding(
+                    "NL007", f.rel, node.lineno, node.col_offset,
+                    f"rpc {shape} envelope arity {arity} violates the "
+                    f"frozen wire contract ({'2/3' if is_resp else '4/5'}"
+                    f"-tuple; docs/manual/6-wire-protocol.md)",
+                    f.qualname_at(node)))
+    return out

@@ -61,6 +61,7 @@ def run_sessions(addr: str, queries: Sequence[str], n_sessions: int,
             if not r.ok():
                 errs[i] += 1
 
+    # nlint: disable=NL002 -- load-origin bench workers; no inbound trace
     threads = [threading.Thread(target=worker, args=(i,), daemon=True)
                for i in range(n_sessions)]
     t0 = time.time()

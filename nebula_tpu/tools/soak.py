@@ -66,8 +66,14 @@ def _debug_bundle(cluster, tpu, extra: dict,
     import os
     from ..common.tracing import tracer
     path = os.environ.get("SOAK_BUNDLE_OUT", path)
+    from ..common.lockwitness import witness
     out = {
         "trace_ring": tracer.ring.snapshot(),
+        # the observed lock-order graph rides every bundle: a
+        # divergence that involved a lock-ordering surprise arrives
+        # with the evidence attached (empty unless --witness /
+        # NEBULA_TPU_LOCK_WITNESS armed the witness)
+        "lock_witness": witness.report(),
         "queries": {
             "active": cluster.service.active_queries.snapshot(),
             "slow": cluster.service.slow_log.snapshot(),
@@ -124,6 +130,7 @@ def _fault_schedule(stop, period: float = 0.8, seed: int = 7):
             i += 1
         faults.clear()
 
+    # nlint: disable=NL002 -- run-lifetime chaos scheduler, not request work
     t = threading.Thread(target=run, daemon=True, name="fault-schedule")
     t.start()
     return t
@@ -422,8 +429,11 @@ def _run_soak_concurrent(seconds, threads, v, e, seed,
 
     def burst(n_writers, dense, dur):
         stop = threading.Event()
+        # nlint: disable=NL002 -- load-origin soak workers; no inbound
+        # trace to carry (each query starts its own)
         ts = [threading.Thread(target=writer, args=(i, stop))
               for i in range(n_writers)]
+        # nlint: disable=NL002 -- load-origin soak workers (above)
         ts += [threading.Thread(target=reader, args=(i, stop, dense))
                for i in range(threads - n_writers)]
         for t in ts:
@@ -672,9 +682,11 @@ def run_soak_tenants(seconds: float = 8.0, seed: int = 21) -> dict:
                 errors.append(f"abuser: [{r.code.name}] {r.error_msg}")
                 stop.set()
 
+    # nlint: disable=NL002 -- load-origin tenant workers; no inbound trace
     threads = [threading.Thread(target=tenant_worker, args=(t, k),
                                 daemon=True)
                for k, t in enumerate(tenants)]
+    # nlint: disable=NL002 -- load-origin abuser workers (above)
     threads += [threading.Thread(target=abuser_worker, args=(k,),
                                  daemon=True) for k in range(2)]
     try:
@@ -740,6 +752,14 @@ def main(argv=None) -> int:
                          "soak additionally FAILS unless degraded "
                          "serves carry their degradation tags in the "
                          "sampled traces (trace-visibility proof)")
+    ap.add_argument("--witness", action="store_true",
+                    help="install the runtime lock-order witness "
+                         "(common/lockwitness.py) for the whole soak: "
+                         "the run additionally FAILS on a cycle in the "
+                         "cross-thread lock acquisition graph or on a "
+                         "sleep observed under a witnessed lock; the "
+                         "observed graph lands in the output and in "
+                         "the debug bundle on identity failure")
     ap.add_argument("--tenants", action="store_true",
                     help="skewed multi-tenant load under the QoS "
                          "ladder (one abusive tenant vs small ones; "
@@ -747,11 +767,15 @@ def main(argv=None) -> int:
                          "throttled with typed E_OVERLOAD only, small "
                          "tenants unaffected, identity checks green")
     args = ap.parse_args(argv)
+    if args.witness:
+        # install before the run boots anything so every serve-path
+        # lock construction is wrapped (module-level locks created by
+        # earlier imports are only covered via NEBULA_TPU_LOCK_WITNESS)
+        from ..common.lockwitness import witness
+        witness.install()
     if args.tenants:
         out = run_soak_tenants(args.seconds)
-        print(json.dumps(out))
-        return 0 if out["ok"] else 1
-    if args.concurrent:
+    elif args.concurrent:
         out = run_soak_concurrent(args.seconds, args.threads,
                                   args.vertices, args.edges,
                                   fault_schedule=args.faults,
@@ -762,6 +786,15 @@ def main(argv=None) -> int:
                        progress=lambda q, w: print(
                            f"  ... {q} queries, {w} writes", flush=True),
                        fault_schedule=args.faults, chaos=args.chaos)
+    if args.witness:
+        from ..common.lockwitness import LockOrderViolation, witness
+        out["lock_witness"] = witness.summary()
+        if not out["lock_witness"]["clean"]:
+            try:
+                witness.assert_clean()
+            except LockOrderViolation as e:
+                print(f"soak: LOCK WITNESS VIOLATION: {e}", flush=True)
+            out["ok"] = False
     print(json.dumps(out))
     return 0 if out["ok"] else 1
 

@@ -91,6 +91,7 @@ def run_perf(client, sm, space_id: int, tag_id: int, etype: int,
                     errors[0] += 1
 
     t0 = time.monotonic()
+    # nlint: disable=NL002 -- load-origin bench workers; no inbound trace
     threads = [threading.Thread(target=worker) for _ in range(concurrency)]
     for t in threads:
         t.start()

@@ -107,6 +107,7 @@ class WebService:
 
         self._server = ThreadingHTTPServer((self._host, self._port), _Req)
         self._port = self._server.server_address[1]
+        # nlint: disable=NL002 -- daemon-lifetime admin HTTP server
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True,
                                         name=f"webservice-{self.name}")

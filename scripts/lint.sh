@@ -1,0 +1,14 @@
+#!/bin/sh
+# nebula-lint gate: run the repo-specific invariant suite (NL001-NL007,
+# docs/manual/15-static-analysis.md) BEFORE the tier-1 pytest sweep.
+# Exit 0 only when every finding is inline-suppressed (with a reason)
+# or in the committed baseline (.nlint-baseline.json).
+#
+#   scripts/lint.sh            # text report
+#   scripts/lint.sh --json     # machine-readable
+#   scripts/lint.sh --update-baseline
+#
+# Any extra args pass straight through to `python -m nebula_tpu.tools.lint`.
+set -e
+cd "$(dirname "$0")/.."
+exec python -m nebula_tpu.tools.lint "$@"

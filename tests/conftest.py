@@ -21,3 +21,13 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Opt-in runtime lock-order witness for the WHOLE tier-1 sweep: with
+# NEBULA_TPU_LOCK_WITNESS=1 the witness installs here — before any test
+# imports nebula_tpu — so every lock the serve path creates is wrapped
+# and the acquisition-order graph accumulates across all tests
+# (docs/manual/15-static-analysis.md). The dedicated witness coverage
+# that always runs lives in test_lock_witness.py and the chaos/cluster
+# smokes (their bench subprocesses set the env var themselves).
+if os.environ.get("NEBULA_TPU_LOCK_WITNESS"):
+    import nebula_tpu.common.lockwitness  # noqa: F401  (installs)

@@ -25,6 +25,10 @@ def cluster_smoke(tmp_path_factory):
     env["JAX_PLATFORMS"] = "cpu"
     env["BENCH_CLUSTER_SEED"] = "17"
     env["BENCH_CLUSTER_OUT"] = str(out)
+    # arm the lock-order witness across elections/failover/rebalance —
+    # the heaviest cross-thread lock traffic in the tree; the report
+    # rides the output JSON asserted below (common/lockwitness.py)
+    env["NEBULA_TPU_LOCK_WITNESS"] = "1"
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"),
          "--cluster", "--trim"],
@@ -58,3 +62,19 @@ def test_cluster_balance_completed_under_load(cluster_smoke):
     for ph, st in cluster_smoke["phases"].items():
         assert st["n"] > 0, (ph, st)
         assert st["p99_ms"] < 15000, (ph, st)
+
+
+def test_cluster_lock_witness_green(cluster_smoke):
+    """Witnessed lock order across elections, leader failover and
+    online rebalance — the heaviest cross-thread lock traffic in the
+    tree (raft part locks x host locks x wal locks x engine locks) —
+    must stay acyclic with no sleep observed under a held lock
+    (common/lockwitness.py; docs/manual/15-static-analysis.md)."""
+    lw = cluster_smoke["lock_witness"]
+    assert lw["installed"] is True
+    assert lw["locks_wrapped"] >= 50
+    assert lw["acquisitions"] >= 1000
+    assert lw["edges"] > 0
+    assert lw["cycle"] is None
+    assert lw["blocking"] == []
+    assert lw["clean"] is True

@@ -1,0 +1,414 @@
+"""nebula-lint (nebula_tpu/tools/lint): every rule NL001-NL007 proven
+LIVE on a minimal tripping snippet plus a negative twin, suppression
+and baseline semantics, and the full-tree gate — the committed tree
+must carry zero non-baselined findings."""
+import json
+import os
+import textwrap
+
+from nebula_tpu.tools.lint import RULES, Project, run_lint
+from nebula_tpu.tools.lint.core import (load_baseline, split_baseline,
+                                        write_baseline)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def proj(tmp_path, files):
+    rels = []
+    for rel, text in files.items():
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(text))
+        if rel.endswith(".py"):
+            rels.append(rel)
+    return Project(str(tmp_path), rels)
+
+
+def lint(tmp_path, files, select=None):
+    findings, suppressed = run_lint(proj(tmp_path, files), RULES, select)
+    return findings, suppressed
+
+
+def codes(findings):
+    return [f.rule for f in findings]
+
+
+# ---------------------------------------------------------------- NL001
+
+def test_nl001_trips_on_sleep_under_hot_lock(tmp_path):
+    fs, _ = lint(tmp_path, {"nebula_tpu/m.py": """
+        import threading
+        import time
+
+        class C:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self):
+                with self._lock:
+                    time.sleep(0.1)
+    """}, ["NL001"])
+    assert codes(fs) == ["NL001"]
+    assert "time.sleep" in fs[0].message and "_lock" in fs[0].message
+
+
+def test_nl001_numpy_fetch_and_socket_send_under_lock(tmp_path):
+    fs, _ = lint(tmp_path, {"nebula_tpu/m.py": """
+        import numpy as np
+
+        def bad(self, sock, x):
+            with self._lock:
+                y = np.asarray(x)
+                sock.sendall(b"hi")
+            return y
+    """}, ["NL001"])
+    assert codes(fs) == ["NL001", "NL001"]
+
+
+def test_nl001_clean_cases(tmp_path):
+    fs, _ = lint(tmp_path, {"nebula_tpu/m.py": """
+        import time
+
+        def ok(self):
+            with self._disp_cv:
+                self._disp_cv.wait(0.1)     # wait on the HELD cv: exempt
+            time.sleep(0.1)                 # off-lock: fine
+
+        def nested_def_runs_later(self):
+            with self._lock:
+                def cb():
+                    time.sleep(1)           # not under this hold
+                return cb
+    """}, ["NL001"])
+    assert fs == []
+
+
+# ---------------------------------------------------------------- NL002
+
+def test_nl002_trips_on_raw_thread_spawn(tmp_path):
+    fs, _ = lint(tmp_path, {"nebula_tpu/m.py": """
+        import threading
+
+        def spawn(fn):
+            threading.Thread(target=fn, daemon=True).start()
+    """}, ["NL002"])
+    assert codes(fs) == ["NL002"]
+
+
+def test_nl002_copy_context_and_helper_compliant(tmp_path):
+    fs, _ = lint(tmp_path, {"nebula_tpu/m.py": """
+        import contextvars
+        import threading
+        from nebula_tpu.common.threads import traced_thread
+
+        def ok1(fn):
+            ctx = contextvars.copy_context()
+            threading.Thread(target=lambda: ctx.run(fn)).start()
+
+        def ok2(fn):
+            traced_thread(fn).start()
+    """}, ["NL002"])
+    assert fs == []
+
+
+def test_nl002_compliant_spawn_does_not_whitewash_raw_one(tmp_path):
+    """Compliance is judged at THE SPAWN, not per enclosing scope: a
+    traced spawn (or a stray copy_context import) must not silence a
+    raw spawn beside it."""
+    fs, _ = lint(tmp_path, {"nebula_tpu/m.py": """
+        import contextvars
+        import threading
+        from contextvars import copy_context
+
+        def mixed(fn, gn):
+            ctx = contextvars.copy_context()
+            threading.Thread(target=lambda: ctx.run(fn)).start()   # ok
+            threading.Thread(target=gn, daemon=True).start()       # raw
+
+        threading.Thread(target=print).start()   # top-level raw spawn
+    """}, ["NL002"])
+    assert codes(fs) == ["NL002", "NL002"]
+    assert {f.line for f in fs} == {9, 11}
+
+
+def test_nl002_local_def_carrying_context_is_compliant(tmp_path):
+    fs, _ = lint(tmp_path, {"nebula_tpu/m.py": """
+        import contextvars
+        import threading
+
+        def spawn(target):
+            ctx = contextvars.copy_context()
+
+            def run():
+                ctx.run(target)
+
+            return threading.Thread(target=run, daemon=True)
+    """}, ["NL002"])
+    assert fs == []
+
+
+def test_nl002_out_of_package_not_flagged(tmp_path):
+    fs, _ = lint(tmp_path, {"scripts/x.py": """
+        import threading
+        threading.Thread(target=print).start()
+    """}, ["NL002"])
+    assert fs == []
+
+
+# ---------------------------------------------------------------- NL003
+
+def test_nl003_undeclared_read_and_dead_flag(tmp_path):
+    fs, _ = lint(tmp_path, {"nebula_tpu/m.py": """
+        graph_flags.declare("dead_flag", 1)
+        graph_flags.declare("live_flag", 2)
+        x = graph_flags.get("live_flag")
+        y = graph_flags.get("never_declared")
+    """}, ["NL003"])
+    msgs = sorted(f.message for f in fs)
+    assert len(fs) == 2
+    assert "'never_declared' is read but never declare()d" in msgs[1]
+    assert "'dead_flag' is declared but never read" in msgs[0]
+
+
+def test_nl003_watcher_consumed_flag_counts_as_read(tmp_path):
+    fs, _ = lint(tmp_path, {"nebula_tpu/m.py": """
+        graph_flags.declare("hooked", "")
+
+        def watcher(name, value):
+            if name == "hooked":
+                apply(value)
+    """}, ["NL003"])
+    assert fs == []
+
+
+# ---------------------------------------------------------------- NL004
+
+def test_nl004_kind_conflict_and_missing_kind(tmp_path):
+    fs, _ = lint(tmp_path, {"nebula_tpu/m.py": """
+        def f():
+            stats.add_value("mixed", 1, kind="counter")
+            stats.add_value("mixed", 2.5, kind="timing")
+            global_stats.add_value("untagged_metric", 1)
+    """}, ["NL004"])
+    assert len(fs) == 2
+    conflict = [f for f in fs if "mixed" in f.message]
+    missing = [f for f in fs if "untagged_metric" in f.message]
+    assert len(conflict) == 1 and "'timing'" in conflict[0].message
+    assert len(missing) == 1 and "without a kind" in missing[0].message
+
+
+def test_nl004_consistent_sites_clean(tmp_path):
+    fs, _ = lint(tmp_path, {"nebula_tpu/m.py": """
+        def f():
+            stats.add_value("n", 1, kind="counter")
+            stats.add_value("n", 3, kind="counter")
+    """}, ["NL004"])
+    assert fs == []
+
+
+# ---------------------------------------------------------------- NL005
+
+def test_nl005_unregistered_fire_and_undocumented_point(tmp_path):
+    fs, _ = lint(tmp_path, {
+        "docs/manual/9-robustness.md": "catalog: `known.point` only\n",
+        "nebula_tpu/m.py": """
+            faults.register("known.point")
+            faults.register("silent.point")
+
+            def f():
+                faults.fire("known.point")
+                faults.fire("silent.point")
+                faults.fire("ghost.point")
+        """}, ["NL005"])
+    msgs = " | ".join(sorted(f.message for f in fs))
+    assert len(fs) == 2
+    assert "'ghost.point' is fired but never register()ed" in msgs
+    assert "'silent.point' is not listed" in msgs
+
+
+# ---------------------------------------------------------------- NL006
+
+def test_nl006_host_ops_inside_jit(tmp_path):
+    fs, _ = lint(tmp_path, {"nebula_tpu/m.py": """
+        import functools
+        import random
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            print(x)
+            return np.asarray(x) + x.item()
+
+        @functools.partial(jax.jit, static_argnames=("n",))
+        def g(x, n):
+            return x * random.random()
+
+        def build():
+            def run(x):
+                return jnp.sum(x)          # pure: jnp, not np
+            return jax.jit(run)
+    """}, ["NL006"])
+    assert len(fs) == 4
+    blob = " | ".join(f.message for f in fs)
+    assert "print()" in blob and "np.asarray" in blob
+    assert ".item()" in blob and "RNG" in blob
+
+
+def test_nl006_dtype_names_and_unjitted_host_code_clean(tmp_path):
+    fs, _ = lint(tmp_path, {"nebula_tpu/m.py": """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return jnp.zeros(3, np.int32) + x    # dtype ref is fine
+
+        def host_side(x):
+            print(x)                             # not jitted
+            return np.asarray(x)
+    """}, ["NL006"])
+    assert fs == []
+
+
+# ---------------------------------------------------------------- NL007
+
+def test_nl007_struct_field_drift(tmp_path):
+    spec = {"registry": [
+        {"id": 0, "name": "Foo", "kind": "struct", "fields": ["a", "b"]}]}
+    fs, _ = lint(tmp_path, {
+        "docs/manual/wire-vectors.json": json.dumps(spec),
+        "nebula_tpu/m.py": """
+            from dataclasses import dataclass
+
+            @dataclass
+            class Foo:
+                a: int = 0
+                b: str = ""
+                sneaky: float = 0.0
+        """}, ["NL007"])
+    assert codes(fs) == ["NL007"]
+    assert "drifted from" in fs[0].message and "sneaky" in fs[0].message
+
+
+def test_nl007_matching_struct_clean(tmp_path):
+    spec = {"registry": [
+        {"id": 0, "name": "Foo", "kind": "struct", "fields": ["a", "b"]}]}
+    fs, _ = lint(tmp_path, {
+        "docs/manual/wire-vectors.json": json.dumps(spec),
+        "nebula_tpu/m.py": """
+            from dataclasses import dataclass
+
+            @dataclass
+            class Foo:
+                a: int = 0
+                b: str = ""
+        """}, ["NL007"])
+    assert fs == []
+
+
+def test_nl007_missing_spec_is_a_finding(tmp_path):
+    fs, _ = lint(tmp_path, {"nebula_tpu/m.py": "x = 1\n"}, ["NL007"])
+    assert codes(fs) == ["NL007"]
+    assert "spec" in fs[0].message
+
+
+# ------------------------------------------------------- suppressions
+
+def test_inline_suppression_same_line_and_block_above(tmp_path):
+    fs, suppressed = lint(tmp_path, {"nebula_tpu/m.py": """
+        def f():
+            stats.add_value("a", 1)   # nlint: disable=NL004 -- legacy
+            # nlint: disable=NL004 -- reason wraps over
+            # two comment lines above the site
+            stats.add_value("b", 1)
+            stats.add_value("c", 1)   # NOT suppressed
+    """}, ["NL004"])
+    assert suppressed == 2
+    assert len(fs) == 1 and "'c'" in fs[0].message
+
+
+def test_file_level_suppression(tmp_path):
+    fs, suppressed = lint(tmp_path, {"nebula_tpu/m.py": """
+        # nlint: disable-file=NL004
+        def f():
+            stats.add_value("a", 1)
+            stats.add_value("b", 1)
+    """}, ["NL004"])
+    assert fs == [] and suppressed == 2
+
+
+def test_suppression_is_per_rule(tmp_path):
+    fs, _ = lint(tmp_path, {"nebula_tpu/m.py": """
+        import threading
+
+        def f():
+            # nlint: disable=NL001 -- wrong code for this finding
+            threading.Thread(target=print).start()
+    """}, ["NL002"])
+    assert codes(fs) == ["NL002"]
+
+
+# ------------------------------------------------------------ baseline
+
+def test_baseline_absorbs_then_new_findings_surface(tmp_path):
+    files = {"nebula_tpu/m.py": """
+        def f():
+            stats.add_value("a", 1)
+    """}
+    findings, _ = lint(tmp_path, files, ["NL004"])
+    base_path = tmp_path / ".nlint-baseline.json"
+    write_baseline(str(base_path), findings)
+    baseline = load_baseline(str(base_path))
+    new, old = split_baseline(findings, baseline)
+    assert new == [] and len(old) == 1
+
+    files2 = {"nebula_tpu/m.py": """
+        def f():
+            x = 1   # shifted lines: baseline key is line-independent
+            stats.add_value("a", 1)
+            stats.add_value("fresh", 1)
+    """}
+    findings2, _ = lint(tmp_path, files2, ["NL004"])
+    new2, old2 = split_baseline(findings2, baseline)
+    assert len(old2) == 1
+    assert len(new2) == 1 and "fresh" in new2[0].message
+
+
+def test_baseline_is_a_multiset(tmp_path):
+    files = {"nebula_tpu/m.py": """
+        def f():
+            stats.add_value("a", 1)
+            stats.add_value("a", 1)
+    """}
+    findings, _ = lint(tmp_path, files, ["NL004"])
+    assert len(findings) == 2          # identical keys, two sites
+    base_path = tmp_path / "b.json"
+    write_baseline(str(base_path), findings[:1])
+    new, old = split_baseline(findings, load_baseline(str(base_path)))
+    assert len(old) == 1 and len(new) == 1
+
+
+# ------------------------------------------------------ full-tree gate
+
+def test_full_tree_has_zero_non_baselined_findings():
+    """THE gate: the committed tree, scanned with every rule, carries
+    no finding that is neither inline-suppressed (with a reason) nor
+    in the committed baseline — the same check scripts/lint.sh runs
+    before the tier-1 sweep."""
+    project = Project(REPO)
+    findings, _suppressed = run_lint(project, RULES)
+    baseline = load_baseline(os.path.join(REPO, ".nlint-baseline.json"))
+    new, old = split_baseline(findings, baseline)
+    assert new == [], "non-baselined lint findings:\n" + "\n".join(
+        f.render() for f in new)
+    # acceptance bound: the grandfathered set stays small
+    assert len(old) <= 25
+
+
+def test_rule_catalog_complete():
+    assert sorted(RULES) == [f"NL00{i}" for i in range(1, 8)]
+    for code, r in RULES.items():
+        assert r.title and r.doc, f"{code} must carry title + doc"

@@ -1,0 +1,218 @@
+"""Runtime lock-order witness (nebula_tpu/common/lockwitness.py).
+
+Synthetic scenarios prove the detector detects (ABBA cycle, sleep
+under a held lock, Condition round-trips, RLock recursion), then a
+real in-process serve run proves the production lock graph — engine
+snapshot lock, dispatcher cv, stats leaf lock, cache rungs, session
+lock — is cycle-free with no blocking observed under a hot lock
+(docs/manual/15-static-analysis.md)."""
+import threading
+import time
+
+import pytest
+
+from nebula_tpu.common.lockwitness import (LockOrderViolation,
+                                           LockWitness)
+
+
+@pytest.fixture
+def w():
+    """A private, wrap-everything witness, always uninstalled."""
+    wit = LockWitness(scope=None).install()
+    yield wit
+    wit.uninstall()
+
+
+def _run(fn):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join()
+
+
+def test_abba_cycle_detected(w):
+    a = threading.Lock()
+    b = threading.Lock()
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    def t2():
+        with b:
+            with a:
+                pass
+
+    _run(t1)   # sequential, so the test itself can never deadlock
+    _run(t2)
+    cycle = w.find_cycle()
+    assert cycle is not None and len(cycle) >= 3
+    with pytest.raises(LockOrderViolation, match="ABBA"):
+        w.assert_clean()
+    assert w.report()["clean"] is False
+
+
+def test_consistent_order_is_clean(w):
+    a = threading.Lock()
+    b = threading.Lock()
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    _run(t1)
+    _run(t1)
+    assert w.find_cycle() is None
+    rep = w.assert_clean()
+    assert rep["clean"] is True
+    assert len(rep["edges"]) == 1      # a -> b, recorded once
+
+
+def test_sleep_under_lock_flagged(w):
+    a = threading.Lock()
+    with a:
+        time.sleep(0.002)
+    rep = w.report()
+    assert len(rep["blocking"]) == 1
+    ev = rep["blocking"][0]
+    assert "time.sleep" in ev["op"]
+    assert ev["locks_held"]
+    with pytest.raises(LockOrderViolation, match="blocking"):
+        w.assert_clean()
+
+
+def test_sleep_outside_lock_not_flagged(w):
+    a = threading.Lock()
+    with a:
+        pass
+    time.sleep(0.002)
+    assert w.report()["blocking"] == []
+
+
+def test_condition_wait_releases_held_stack(w):
+    """cv.wait() must POP the lock from the held stack: a lock taken
+    by another thread while the waiter sleeps is not 'under' the cv,
+    and the waiter's re-acquire after notify must re-push."""
+    cv = threading.Condition()
+    other = threading.Lock()
+    done = []
+
+    def waiter():
+        with cv:
+            while not done:
+                cv.wait(1.0)
+            with other:   # held AFTER re-acquire: cv -> other edge
+                pass
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.05)
+    with other:           # while waiter is parked in wait(): no locks
+        pass              # held by it, so no other -> cv edge
+    with cv:
+        done.append(1)
+        cv.notify_all()
+    t.join()
+    rep = w.assert_clean()          # would raise if both edges formed
+    edges = {(e["held"], e["acquired"]) for e in rep["edges"]}
+    assert len(edges) == 1          # only cv -> other, never reversed
+
+
+def test_rlock_recursion_no_self_edge(w):
+    r = threading.RLock()
+    with r:
+        with r:
+            pass
+    rep = w.assert_clean()
+    assert rep["edges"] == []
+    assert rep["self_edges"] == []
+
+
+def test_same_site_nesting_reported_as_self_edge_not_cycle(w):
+    def make():
+        return threading.Lock()     # one creation site, two instances
+
+    a, b = make(), make()
+    with a:
+        with b:
+            pass
+    rep = w.report()
+    assert rep["cycle"] is None     # site-level graph has no cycle
+    assert len(rep["self_edges"]) == 1
+    rep2 = w.assert_clean()         # self-edges are visible, not fatal
+    assert rep2["self_edges"]
+
+
+def test_scope_filter_skips_foreign_creation_sites():
+    wit = LockWitness(scope=("nebula_tpu",)).install()
+    try:
+        lk = threading.Lock()       # created from tests/ -> out of scope
+        assert type(lk).__name__ != "_WitnessProxy"
+        assert wit.wrapped == 0
+    finally:
+        wit.uninstall()
+
+
+def test_uninstall_restores_patches():
+    before = (threading.Lock, threading.RLock, time.sleep)
+    wit = LockWitness(scope=None).install()
+    assert threading.Lock is not before[0]
+    wit.uninstall()
+    assert (threading.Lock, threading.RLock, time.sleep) == before
+
+
+def test_reset_clears_observations(w):
+    a = threading.Lock()
+    with a:
+        time.sleep(0.002)
+    assert w.report()["blocking"]
+    w.reset()
+    assert w.report()["blocking"] == []
+    assert w.report()["clean"] is True
+
+
+# ---------------------------------------------------------------------------
+# the real serve path under the witness
+# ---------------------------------------------------------------------------
+
+def test_serve_path_lock_graph_is_clean():
+    """Boot the in-process cluster with the witness installed FIRST,
+    so every lock the serve path constructs (engine RLock + stats
+    leaf lock + dispatcher cv, session lock, cache rungs, client
+    pools) is wrapped; run traced queries and a write, then require
+    an acyclic graph and zero blocked-under-lock events — the runtime
+    form of the CHANGES.md locking invariants."""
+    wit = LockWitness(scope=("nebula_tpu",)).install()
+    try:
+        from nebula_tpu.cluster import InProcCluster
+        from nebula_tpu.engine_tpu import TpuGraphEngine
+
+        tpu = TpuGraphEngine()
+        cluster = InProcCluster(tpu_engine=tpu)
+        conn = cluster.connect()
+        conn.must("CREATE SPACE wit(partition_num=2)")
+        conn.must("USE wit")
+        conn.must("CREATE EDGE knows(ts int)")
+        conn.must("CREATE TAG person(name string)")
+        edges = ",".join(f"{s}->{d}:({s + d})"
+                         for s in range(8) for d in range(8) if s != d)
+        conn.must(f"INSERT EDGE knows(ts) VALUES {edges}")
+        sid = cluster.meta.get_space("wit").value().space_id
+        tpu.prewarm(sid, block=True)
+        for q in ("GO FROM 1 OVER knows YIELD knows._dst",
+                  "GO 2 STEPS FROM 2 OVER knows YIELD knows._dst",
+                  "PROFILE GO FROM 3 OVER knows WHERE knows.ts > 4 "
+                  "YIELD knows._dst, knows.ts"):
+            r = conn.must(q)
+            assert r.rows
+        conn.must("INSERT EDGE knows(ts) VALUES 1->1:(99)")
+        conn.must("GO FROM 1 OVER knows YIELD knows._dst")
+        rep = wit.assert_clean()
+        # meaningful coverage: the engine + session + stats locks were
+        # wrapped and actually exercised under multi-lock holds
+        assert rep["locks_wrapped"] >= 10
+        assert rep["acquisitions"] >= 100
+        assert rep["edges"], "no nested holds observed — witness inert?"
+    finally:
+        wit.uninstall()
