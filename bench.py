@@ -439,7 +439,10 @@ def bench_full_queries(conn, tpu, snap, etype, seed_sets):
                                     # degradation ladder: breaker state
                                     # + trip/degrade/deadline counters
                                     # (all zero on a healthy run)
-                                    "robustness": tpu.robustness_stats()}
+                                    "robustness": tpu.robustness_stats(),
+                                    # histogram bucket vectors + flight
+                                    # trigger counts (ISSUE 10)
+                                    **_obs_block()}
 
 
 def bench_stats_query(conn, tpu, seed_sets):
@@ -598,7 +601,9 @@ def bench_concurrent(cluster, tpu, seed_sets, seconds=6.0, sessions=8):
            "frontier_prefetch": (pf1 := tpu.prefetch_stats()),
            "h2d_overlap_us": pf1["h2d_overlap_us"]
            - pf0["h2d_overlap_us"],
-           "robustness": tpu.robustness_stats()}
+           "robustness": tpu.robustness_stats(),
+           # histogram bucket vectors + flight trigger counts
+           **_obs_block()}
     log(f"tier3 concurrent ({sessions} sessions, {wall:.1f}s): "
         f"{out['qps']} QPS aggregate, {d['batched_queries']} queries "
         f"over {d['batched_dispatches']} shared dispatches "
@@ -607,6 +612,37 @@ def bench_concurrent(cluster, tpu, seed_sets, seconds=6.0, sessions=8):
         f"{out['early_releases']} early releases, "
         f"wait p_avg={out['group_wait_us_avg']}us)")
     return out
+
+
+def _obs_block():
+    """Observability block for the bench JSON artifacts (ISSUE 10):
+    native-histogram snapshots — the full bucket vectors plus the
+    exemplar trace ids, not just p50/p95 — and the flight recorder's
+    event/trigger/bundle state at sample time."""
+    from nebula_tpu.common.flight import recorder as _rec
+    from nebula_tpu.common.stats import stats as _st
+    hists = {}
+    for name in _st.histogram_names():
+        h = _st.histogram_snapshot(name)
+        if h is None:
+            continue
+        hists[name] = {
+            "bounds": h["bounds"],
+            "counts": h["counts"],
+            "sum": h["sum"],
+            "count": h["count"],
+            "exemplar_trace_ids": sorted(
+                {e["trace_id"] for e in h["exemplars"].values()}),
+        }
+    d = _rec.describe(limit=1)
+    return {
+        "histograms": hists,
+        "flight": {
+            "event_count": d["event_count"],
+            "triggers": {t["name"]: t["fires"] for t in d["triggers"]},
+            "bundles": d["bundles"],
+        },
+    }
 
 
 def _cache_rung_stats(cluster, tpu):
@@ -1078,6 +1114,16 @@ def bench_chaos(out_path: str, trim: bool = False):
     graph_flags.set("qos_plan", "chaos:rate=500,burst=500")
     graph_flags.set("qos_shed_queue_depth", 64)
     qos_overload_retries = [0]
+    # flight recorder armed for the run (ISSUE 10 acceptance): the
+    # injected anomalies must AUTO-capture at least one bundle whose
+    # events correlate by trace_id with a histogram exemplar on the
+    # metrics surface; bundles dump atomically to a scratch dir
+    import tempfile
+    from nebula_tpu.common.flight import recorder as flight_rec
+    flight_rec.reset()
+    graph_flags.set("flight_dir", tempfile.mkdtemp(
+        prefix="nebula_tpu_flight_"))
+    graph_flags.set("flight_arm_samples", 200)
     tpu = TpuGraphEngine()
     # tight ladder so the run observes the full trip -> half-open ->
     # recover cycle in seconds (production defaults are 3 / 0.5s / 30s)
@@ -1199,6 +1245,90 @@ def bench_chaos(out_path: str, trim: bool = False):
             break
         time.sleep(0.1)
 
+    # ---- phase 3 (ISSUE 10): an INJECTED OVERLOAD must drive an SLO
+    # burn-rate gauge over its threshold, and recovery traffic must
+    # bring it back under — the availability objective rides the QoS
+    # per-tenant admission slices (common/slo.py). Denials here are
+    # deliberate typed E_OVERLOADs, never client errors.
+    from nebula_tpu.common import slo as slo_mod
+    slo_name = "chaos-avail"
+    graph_flags.set("slo_plan",
+                    f"{slo_name}:kind=availability,"
+                    f"good=graph.qos.admitted.chaos,"
+                    f"bad=graph.qos.denied.chaos,target=0.9,burn=2")
+    slo_rec = {"denied": 0, "burn_peak": 0.0, "breached": False,
+               "burn_recovered": None, "recovered_under": False}
+    graph_flags.set("qos_plan", "chaos:rate=0")   # deny-all: overload
+    # paced like a real client under deny-all (denials return in
+    # ~0.2ms — unpaced, the WHOLE storm fits inside one evaluation
+    # cache window and the gauge legitimately never turns over):
+    # detection latency is bounded by the 1 Hz evaluator, so the
+    # storm keeps burning until the gauge has had a chance to see it
+    slo_poll = time.time() + 20
+    i = 0
+    while time.time() < slo_poll and not slo_rec["breached"]:
+        for _ in range(40):
+            i += 1
+            r = conn.execute("YIELD 1")
+            if r.code == ErrorCode.E_OVERLOAD:
+                slo_rec["denied"] += 1
+            elif not r.ok():
+                errs.append(f"slo overload phase: [{r.code.name}] "
+                            f"{r.error_msg}")
+                break
+        if errs and errs[-1].startswith("slo overload phase"):
+            break
+        time.sleep(0.25)   # let the evaluator tick / the cache age
+        g = slo_mod.engine.gauges()
+        slo_rec["burn_peak"] = max(slo_rec["burn_peak"],
+                                   g[f"slo.{slo_name}.burn_60s"])
+        if g[f"slo.{slo_name}.breached"] >= 1:
+            slo_rec["breached"] = True
+    graph_flags.set("qos_plan", "chaos:rate=500,burst=500")  # recover
+    slo_deadline = time.time() + 45
+    while slo_rec["breached"] and time.time() < slo_deadline:
+        for _ in range(25):
+            r = conn.execute("YIELD 1")
+            if r.code == ErrorCode.E_OVERLOAD:
+                time.sleep(0.01)   # paced: honor the restored budget
+            elif not r.ok():
+                errs.append(f"slo recovery phase: [{r.code.name}] "
+                            f"{r.error_msg}")
+                break
+        if errs and errs[-1].startswith("slo recovery phase"):
+            break   # fail fast with ONE error, not 45s of duplicates
+        time.sleep(0.25)   # evaluator cadence, like the breach side
+        g = slo_mod.engine.gauges()
+        slo_rec["burn_recovered"] = g[f"slo.{slo_name}.burn_60s"]
+        if g[f"slo.{slo_name}.breached"] < 1 \
+                and g[f"slo.{slo_name}.burn_60s"] < 2:
+            slo_rec["recovered_under"] = True
+            break
+    graph_flags.set("slo_plan", "")
+
+    # ---- flight-recorder acceptance: >= 1 auto-captured bundle with a
+    # populated ring whose events correlate (by trace_id) with at
+    # least one exemplar exposed on the metrics surface
+    flight_rec.flush(10.0)   # capture threads finish enrichment
+    from nebula_tpu.common.stats import stats as global_stats
+    exemplar_tids = set()
+    for hname in global_stats.histogram_names():
+        h = global_stats.histogram_snapshot(hname)
+        exemplar_tids.update(e["trace_id"]
+                             for e in h["exemplars"].values())
+    bundle_tids = set()
+    for b in flight_rec.bundles:
+        for e in list(b["events"]) + list(b["aftermath_events"]):
+            if "trace_id" in e:
+                bundle_tids.add(e["trace_id"])
+    flight_ok = bool(
+        flight_rec.bundles
+        and all(len(b["events"]) > 0 for b in flight_rec.bundles)
+        and (bundle_tids & exemplar_tids))
+    flight_summary = flight_rec.describe(limit=8)
+    graph_flags.set("flight_dir", "")
+    graph_flags.set("flight_arm_samples", 25)
+
     rb = tpu.robustness_stats()
     # sample the dispatcher qos block BEFORE disarming: the artifact
     # must record the watermarks the run actually proved composition
@@ -1230,22 +1360,39 @@ def bench_chaos(out_path: str, trim: bool = False):
         "degraded_serves": rb["degraded_serves"],
         "deadline_exceeded": rb["deadline_exceeded"],
         "lock_witness": _witness_summary(),
+        # continuous diagnostics (ISSUE 10): auto-captured flight
+        # bundles + the metric<->trace exemplar correlation, and the
+        # SLO burn round-trip under the injected overload (the
+        # "flight" block itself rides in via _obs_block below)
+        "flight_correlated_trace_ids": sorted(
+            bundle_tids & exemplar_tids)[:8],
+        "flight_ok": flight_ok,
+        "slo": {"plan_objective": slo_name, **slo_rec},
+        **_obs_block(),
     }
     with open(out_path, "w") as f:
         json.dump(rec, f, indent=1)
     ok = (not errs and not mismatches and trips > 0 and recovered
           and sum(fired.values()) > 0
           and rb["breaker_recoveries"] > 0
-          and rec["lock_witness"]["clean"])
+          and rec["lock_witness"]["clean"]
+          and flight_ok
+          and slo_rec["breached"] and slo_rec["recovered_under"])
     log(f"chaos tier: {sessions} sessions x {per_session} queries under "
         f"{plan!r}: {sum(fired.values())} faults injected, "
         f"{trips} breaker trips, {rb['degraded_serves']} degraded "
         f"serves, errors={len(errs)}, mismatches={len(mismatches)}, "
-        f"recovered={recovered} -> {out_path}")
+        f"recovered={recovered}, flight bundles="
+        f"{len(flight_summary['bundles'])} (correlated="
+        f"{len(bundle_tids & exemplar_tids)}), slo burn peak="
+        f"{slo_rec['burn_peak']} -> back under="
+        f"{slo_rec['recovered_under']} -> {out_path}")
     print(json.dumps({"metric": "chaos", "ok": ok, **{
         k: rec[k] for k in ("faults_injected", "breaker_trips",
                             "degraded_serves", "recovered",
-                            "mismatches")}}))
+                            "mismatches", "flight_ok")},
+        "slo_breached": slo_rec["breached"],
+        "slo_recovered": slo_rec["recovered_under"]}))
     if not ok:
         raise SystemExit(f"chaos tier FAILED: {rec}")
     return rec

@@ -26,7 +26,7 @@ Layer map (mirrors reference layers, re-designed TPU-first; see SURVEY.md §1):
   rpc/        wire transport for multi-process deploy  (ref: fbthrift seam)
 """
 
-__version__ = "0.1.0"
+__version__ = "0.10.0"
 
 # Opt-in runtime lock-order witness (docs/manual/15-static-analysis.md):
 # with NEBULA_TPU_LOCK_WITNESS set, importing the package installs the

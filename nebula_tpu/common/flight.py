@@ -1,0 +1,441 @@
+"""Flight recorder: the daemon notices its own anomalies and captures
+the evidence unprompted (docs/manual/10-observability.md).
+
+Every incident artifact this repo produced before — soak bundles,
+chaos JSON — existed because a harness asked for it at the right
+moment. The flight recorder inverts that: the sites that already
+COUNT interesting transitions (breaker trips, shed/admission denials,
+leader changes, snapshot poisons, fused-program compiles, deadline
+balks) now also RECORD a structured event into a bounded lock-free
+ring, and a small set of TRIGGER RULES watches the event stream for
+anomalies. When a rule fires, the recorder atomically dumps a BUNDLE
+(event ring + stats snapshot + registered collectors such as graphd's
+/tpu_stats block and the active-query registry + the last sampled
+traces) to disk and the in-memory list served by ``/flight`` — and
+auto-arms trace sampling for the next N queries, so the *aftermath*
+of the anomaly is captured at full fidelity (the events and exemplar
+histograms recorded while armed carry trace ids; the bundle's
+``aftermath_events`` section collects them).
+
+Lock-free steady state: `record()` appends to `collections.deque`
+rings and draws its seq from `itertools.count` — single C calls,
+GIL-atomic, no lock acquired on the hot path (nothing for the
+lock-order witness to even see). Only the rare trigger-fire path and
+the bounded aftermath window after one take a small lock (bundle
+capture + cooldown bookkeeping must not race between two threads
+tripping at once).
+
+Trigger catalog (docs/manual/10-observability.md):
+
+  breaker_open      any ``breaker_trip`` event         (immediate)
+  snapshot_poison   any ``snapshot_poisoned`` event    (immediate)
+  identity_failure  any ``identity_failure`` event     (immediate)
+  slo_burn          any ``slo_burn`` event (common/slo.py breach)
+  leader_churn      >= 3 ``leader_change`` in 10 s
+  shed_storm        >= 20 ``shed``/``admission_denied`` in 5 s
+  deadline_storm    >= 10 ``deadline_balk`` in 5 s
+
+Each fire is rate-limited by ``flight_cooldown_s`` per rule, so a
+storm produces one bundle, not hundreds.
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .flags import (MUTABLE, REBOOT, graph_flags, meta_flags,
+                    storage_flags)
+from .stats import current_trace_id
+from .stats import stats as global_stats
+
+# every daemon serves /flight, so the knobs must be settable through
+# every daemon's OWN /flags registry (a standalone storaged's
+# WebService serves storage_flags — a graph_flags-only declare would
+# make `PUT /flags flight_dir=...` there silently return false)
+_REGISTRIES = (graph_flags, storage_flags, meta_flags)
+
+
+def _flag(name: str, default):
+    """First non-default value across the three registries (graph
+    first) — in a daemon process only its own registry is ever set
+    over HTTP; in-process clusters keep using graph_flags."""
+    for reg in _REGISTRIES:
+        v = reg.get(name, default)
+        if v is not None and v != default:
+            return v
+    return default
+
+# events appended to a fired bundle AFTER its trigger — the armed-
+# sampling aftermath window, sized to comfortably cover the armed
+# queries' own events
+AFTERMATH_EVENTS = 64
+
+
+class TriggerRule:
+    """One anomaly rule: fire when >= `threshold` events of any of
+    `kinds` land within `window_s` seconds (threshold 1 + window 0 =
+    immediate)."""
+
+    __slots__ = ("name", "kinds", "threshold", "window_s",
+                 "fires", "last_fire_ts")
+
+    def __init__(self, name: str, kinds: Tuple[str, ...],
+                 threshold: int = 1, window_s: float = 0.0):
+        self.name = name
+        self.kinds = tuple(kinds)
+        self.threshold = int(threshold)
+        self.window_s = float(window_s)
+        self.fires = 0
+        self.last_fire_ts = 0.0
+
+    def describe(self) -> Dict[str, Any]:
+        return {"name": self.name, "kinds": list(self.kinds),
+                "threshold": self.threshold, "window_s": self.window_s,
+                "fires": self.fires, "last_fire_ts": self.last_fire_ts}
+
+
+def _default_rules() -> List[TriggerRule]:
+    return [
+        TriggerRule("breaker_open", ("breaker_trip",)),
+        TriggerRule("snapshot_poison", ("snapshot_poisoned",)),
+        TriggerRule("identity_failure", ("identity_failure",)),
+        TriggerRule("slo_burn", ("slo_burn",)),
+        TriggerRule("leader_churn", ("leader_change",), 3, 10.0),
+        TriggerRule("shed_storm", ("shed", "admission_denied"), 20, 5.0),
+        TriggerRule("deadline_storm", ("deadline_balk",), 10, 5.0),
+    ]
+
+
+class FlightRecorder:
+    """Process-global event ring + trigger engine + bundle store."""
+
+    def __init__(self, ring_size: Optional[int] = None,
+                 clock=time.time):
+        if ring_size is None:
+            ring_size = int(_flag("flight_ring_size", 512) or 512)
+        self._clock = clock
+        # deque appends are GIL-atomic: the RECORD path takes no lock
+        self._ring: "deque[Dict[str, Any]]" = deque(
+            maxlen=max(int(ring_size), 16))
+        self._kind_ts: Dict[str, deque] = {}
+        self._rules = _default_rules()
+        self._rules_by_kind: Dict[str, List[TriggerRule]] = {}
+        for r in self._rules:
+            for k in r.kinds:
+                self._rules_by_kind.setdefault(k, []).append(r)
+        # guards ONLY cooldown/seq/inflight bookkeeping and the
+        # aftermath counter — never held across collectors or disk
+        # I/O (see _fire: the recording thread may hold daemon locks)
+        self._fire_lock = threading.Lock()
+        # serializes disk dumps per recorder (a capture thread and an
+        # aftermath-close re-dump must not interleave tmp files)
+        self._dump_lock = threading.Lock()
+        self._inflight = 0
+        self._idle = threading.Event()
+        self._idle.set()
+        self.bundles: "deque[Dict[str, Any]]" = deque(maxlen=8)
+        self._bundle_seq = 0
+        # itertools.count: next() is a single C call — atomic under
+        # the GIL, unlike `self._n += 1` (the read-modify-write loses
+        # increments under thread interleaving)
+        self._event_seq = itertools.count(1)
+        self._last_seq = 0
+        self._aftermath: Optional[Dict[str, Any]] = None
+        self._aftermath_left = 0
+        # collectors: name -> zero-arg callable returning a JSON-able
+        # blob, captured into every bundle (graphd registers its
+        # /tpu_stats block + active queries; storaged its raft status)
+        self._collectors: Dict[str, Callable[[], Any]] = {}
+
+    # -------------------------------------------------------- wiring
+    def add_collector(self, name: str, fn: Callable[[], Any]) -> None:
+        """Idempotent by name — re-serving a daemon in one process
+        (tests) replaces its collector instead of stacking stale
+        closures."""
+        self._collectors[name] = fn
+
+    # ------------------------------------------------------ recording
+    def record(self, kind: str, trace_id: Optional[str] = None,
+               **detail: Any) -> Dict[str, Any]:
+        """Append one structured event; evaluates the trigger rules
+        watching `kind`. Lock-free on the non-firing path."""
+        if trace_id is None:
+            trace_id = current_trace_id()
+        now = self._clock()
+        seq = next(self._event_seq)     # atomic (single C call)
+        self._last_seq = seq
+        ev: Dict[str, Any] = {"seq": seq, "ts": now, "kind": kind}
+        if trace_id:
+            ev["trace_id"] = trace_id
+        if detail:
+            ev.update(detail)
+        self._ring.append(ev)
+        global_stats.add_value("flight.events", kind="counter")
+        if self._aftermath_left > 0:
+            self._append_aftermath(ev)
+        rules = self._rules_by_kind.get(kind)
+        if rules:
+            ts = self._kind_ts.get(kind)
+            if ts is None:
+                ts = self._kind_ts.setdefault(kind, deque(maxlen=256))
+            ts.append(now)
+            for rule in rules:
+                if self._rule_hot(rule, now):
+                    self._fire(rule, ev)
+        return ev
+
+    def _rule_hot(self, rule: TriggerRule, now: float) -> bool:
+        if rule.threshold <= 1:
+            return True
+        n = 0
+        for k in rule.kinds:
+            ts = self._kind_ts.get(k)
+            if ts is None:
+                continue
+            # list(deque) is one C call (atomic under the GIL); a
+            # Python-level `for t in ts` would raise "deque mutated
+            # during iteration" against concurrent recorders
+            for t in list(ts):
+                if now - t <= rule.window_s:
+                    n += 1
+        return n >= rule.threshold
+
+    # ------------------------------------------------------- triggers
+    def _fire(self, rule: TriggerRule,
+              ev: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+        """Fire a rule. Synchronously (cheap, lock-free beyond the
+        small fire lock): cooldown bookkeeping, the bundle SKELETON
+        (id/trigger/event + the ring snapshot + the live aftermath
+        window), the bundles-list publish and the sampling arm.
+        Asynchronously (a short-lived capture thread): collectors,
+        the stats/trace snapshots and the disk dump — the recording
+        thread may hold arbitrary daemon locks (a raft election
+        records leader_change under its part lock, a poisoned apply
+        under the engine lock) and collectors acquire daemon locks /
+        do blocking RPC / disk I/O; running them inline would extend
+        those critical sections by the whole capture and let two
+        different rules' captures deadlock ABBA across daemon locks.
+        Harnesses that read collector fields call `flush()` first.
+        Returns the bundle (skeleton, enriched in place), or None when
+        the rule was cooling down."""
+        cooldown = float(_flag("flight_cooldown_s", 30) or 30)
+        with self._fire_lock:
+            now = self._clock()
+            if now - rule.last_fire_ts < cooldown:
+                return None
+            rule.last_fire_ts = now
+            rule.fires += 1
+            self._bundle_seq += 1
+            bundle: Dict[str, Any] = {
+                "id": self._bundle_seq,
+                "ts": now,
+                "trigger": rule.name,
+                "event": dict(ev),
+                "events": list(self._ring),
+                "aftermath_events": [],
+                "path": None,
+            }
+            # open the aftermath window NOW: events recorded while the
+            # capture thread is still enriching must not be lost
+            self._aftermath = bundle
+            self._aftermath_left = AFTERMATH_EVENTS
+            self._inflight += 1
+            self._idle.clear()
+        self.bundles.append(bundle)
+        global_stats.add_value("flight.triggers." + rule.name,
+                               kind="counter")
+        # arm the trace head immediately: the aftermath of the anomaly
+        # is sampled at full fidelity for the next N queries (their
+        # spans, degradation tags and histogram exemplars all carry
+        # trace ids the bundle's aftermath events correlate with)
+        arm_n = int(_flag("flight_arm_samples", 25) or 0)
+        if arm_n > 0:
+            from . import tracing
+            tracing.tracer.arm(max(tracing.tracer.armed(), arm_n))
+        # nlint: disable=NL002 -- one-shot capture worker, not
+        # request-scoped work (must NOT inherit the recording
+        # thread's context or locks)
+        threading.Thread(target=self._capture, args=(bundle,),
+                         daemon=True,
+                         name=f"flight-capture-{bundle['id']}").start()
+        return bundle
+
+    def flush(self, timeout: float = 5.0) -> bool:
+        """Block until no capture threads are in flight — harnesses
+        (soak bundle attach, bench correlation checks, tests reading
+        collector fields) call this before inspecting bundles."""
+        return self._idle.wait(timeout)
+
+    def trigger(self, rule_name: str
+                ) -> Tuple[Optional[Dict[str, Any]], bool]:
+        """Manual fire (the /flight?fire= ops knob). Returns
+        (bundle, known): (bundle, True) on a capture, (None, True)
+        when the rule exists but is cooling down, (None, False) for an
+        unknown rule — the endpoint must not hand back a stale bundle
+        as if freshly fired."""
+        for rule in self._rules:
+            if rule.name == rule_name:
+                b = self._fire(rule, {"kind": "manual",
+                                      "ts": self._clock(),
+                                      "rule": rule_name})
+                return b, True
+        return None, False
+
+    # -------------------------------------------------------- bundles
+    def _capture(self, bundle: Dict[str, Any]) -> None:
+        """Enrich + dump one published bundle skeleton. Runs on its
+        own short-lived thread (see _fire) — collectors may block on
+        daemon locks or RPC, dumps on disk I/O; neither may run on
+        (or stall) a recording thread."""
+        try:
+            bundle["stats"] = global_stats.snapshot()
+            for name, fn in list(self._collectors.items()):
+                try:
+                    bundle.setdefault("collectors", {})[name] = fn()
+                except Exception as e:   # broken collector: evidence,
+                    bundle.setdefault("collectors", {})[name] = \
+                        {"error": repr(e)}   # never a failed capture
+            try:
+                from . import tracing
+                bundle["traces"] = tracing.tracer.ring.list(limit=16)
+            except Exception:
+                bundle["traces"] = []
+            with self._dump_lock:
+                bundle["path"] = self._dump(bundle)
+        finally:
+            with self._fire_lock:
+                self._inflight -= 1
+                if self._inflight <= 0:
+                    self._idle.set()
+
+    def _append_aftermath(self, ev: Dict[str, Any]) -> None:
+        # only reached while an aftermath window is open (the 64
+        # events after a trigger); the lock guards the counter, the
+        # one-time close re-dump runs on its own thread (a record()
+        # caller may hold daemon locks — it must never do disk I/O)
+        closed = None
+        with self._fire_lock:
+            bundle = self._aftermath
+            if bundle is None:
+                return
+            bundle["aftermath_events"].append(ev)
+            self._aftermath_left -= 1
+            if self._aftermath_left <= 0:
+                self._aftermath = None
+                closed = bundle
+                self._inflight += 1
+                self._idle.clear()
+        if closed is not None:
+            # nlint: disable=NL002 -- one-shot re-dump worker, not
+            # request-scoped work
+            threading.Thread(target=self._close_dump, args=(closed,),
+                             daemon=True,
+                             name="flight-redump").start()
+
+    def _close_dump(self, bundle: Dict[str, Any]) -> None:
+        try:
+            with self._dump_lock:   # serialize vs the capture thread
+                bundle["path"] = self._dump(bundle) \
+                    or bundle.get("path")
+        finally:
+            with self._fire_lock:
+                self._inflight -= 1
+                if self._inflight <= 0:
+                    self._idle.set()
+
+    def _dump(self, bundle: Dict[str, Any]) -> Optional[str]:
+        """Atomic disk dump (tmp + rename) under `flight_dir`; None
+        (in-memory only) when the flag is unset."""
+        d = str(_flag("flight_dir", "") or "")
+        if not d:
+            return None
+        try:
+            os.makedirs(d, exist_ok=True)
+            path = os.path.join(
+                d, f"flight-{bundle['id']:04d}-{bundle['trigger']}.json")
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(bundle, f, default=str)
+            os.replace(tmp, path)
+            return path
+        except OSError:
+            return None
+
+    # ---------------------------------------------------- observation
+    def describe(self, limit: int = 100) -> Dict[str, Any]:
+        """The /flight endpoint body: recent events newest-first,
+        trigger rule states, bundle summaries."""
+        events = list(self._ring)
+        return {
+            "event_count": self._last_seq,
+            "ring": len(events),
+            "events": list(reversed(events))[:max(int(limit), 1)],
+            "triggers": [r.describe() for r in self._rules],
+            "bundles": [{"id": b["id"], "ts": b["ts"],
+                         "trigger": b["trigger"],
+                         "events": len(b["events"]),
+                         "aftermath_events": len(b["aftermath_events"]),
+                         "path": b.get("path")}
+                        for b in self.bundles],
+        }
+
+    def get_bundle(self, bundle_id: int) -> Optional[Dict[str, Any]]:
+        for b in self.bundles:
+            if b["id"] == int(bundle_id):
+                return b
+        return None
+
+    def last_bundle(self) -> Optional[Dict[str, Any]]:
+        return self.bundles[-1] if self.bundles else None
+
+    def gauges(self) -> Dict[str, float]:
+        """Flat /metrics gauges (per-fire counters additionally stream
+        through the StatsManager as flight.triggers.<rule>)."""
+        out = {"flight.ring_events": float(len(self._ring)),
+               "flight.bundles": float(len(self.bundles))}
+        for r in self._rules:
+            out[f"flight.rule_fires.{r.name}"] = float(r.fires)
+        return out
+
+    def reset(self) -> None:
+        """Test/bench isolation: clear events, bundles and rule state
+        (the process-global stats counters are left alone)."""
+        self._ring.clear()
+        self._kind_ts.clear()
+        self.bundles.clear()
+        self._aftermath = None
+        self._aftermath_left = 0
+        self._event_seq = itertools.count(1)
+        self._last_seq = 0
+        for r in self._rules:
+            r.fires = 0
+            r.last_fire_ts = 0.0
+
+
+# declared on EVERY registry: each daemon's /flags serves only its
+# own (graph/storage/meta), and all three daemons run the recorder
+for _reg in _REGISTRIES:
+    _reg.declare(
+        "flight_ring_size", 512, REBOOT,
+        "flight-recorder event ring size (recent structured anomaly "
+        "events served by /flight and captured into bundles)")
+    _reg.declare(
+        "flight_cooldown_s", 30, MUTABLE,
+        "per-rule flight-recorder trigger cooldown: one bundle per "
+        "rule per this many seconds, however hard the storm")
+    _reg.declare(
+        "flight_arm_samples", 25, MUTABLE,
+        "queries force-sampled after a flight trigger fires (the "
+        "aftermath is captured at full trace fidelity; 0 disables)")
+    _reg.declare(
+        "flight_dir", "", MUTABLE,
+        "directory flight bundles are atomically dumped to on "
+        "trigger (empty = in-memory /flight only)")
+
+# process-global instance (the stats/tracer/faults singleton idiom)
+recorder = FlightRecorder()

@@ -241,11 +241,24 @@ class AdmissionController:
                 self.denied[space] = self.denied.get(space, 0) + 1
         if ok:
             global_stats.add_value("graph.qos.admitted", kind="counter")
+            # per-tenant good/bad slices: the availability SLOs ride
+            # these (common/slo.py — bad=graph.qos.denied.<space>,
+            # good=graph.qos.admitted.<space>)
+            global_stats.add_value("graph.qos.admitted." + space,
+                                   kind="counter")
         else:
             global_stats.add_value("graph.qos.admission_denied",
                                    kind="counter")
             global_stats.add_value("graph.qos.denied." + space,
                                    kind="counter")
+            # retry-after distribution (histogram: exemplars join a
+            # denial to the trace that was denied) + the flight
+            # recorder's shed_storm input
+            global_stats.add_value("graph.qos.retry_after_ms",
+                                   retry_ms, kind="histogram")
+            from . import flight
+            flight.recorder.record("admission_denied", space=space,
+                                   retry_after_ms=retry_ms)
         return ok, (0 if ok else retry_ms), pol.lane
 
     # ---------------------------------------------------- observation

@@ -1,0 +1,338 @@
+"""SLO engine: declarative objectives evaluated as multi-window burn
+rates over the native histograms (docs/manual/10-observability.md).
+
+An OBJECTIVE declares what "good" means for a slice of traffic —
+availability (good/bad event counters, e.g. the QoS per-tenant
+admission slices) or a latency threshold (fraction of a histogram
+metric's samples at or under ``le_ms``) — plus a target (0.999 means
+an error budget of 0.1%). The engine evaluates each objective over
+the StatsManager's trailing windows (60 s / 600 s / 3600 s) as a BURN
+RATE: ``bad_ratio / error_budget`` — burn 1.0 spends the budget
+exactly at the sustainable rate, burn 10 spends a day of budget in
+~2.4 hours. An objective BREACHES when the burn rate is over its
+threshold on BOTH the short (60 s) and medium (600 s) windows — the
+short window confirms the problem is happening *now*, the longer one
+that it is material, the standard multi-window guard against
+one-blip paging.
+
+A breach transition records a ``slo_burn`` event into the flight
+recorder (common/flight.py) whose ``slo_burn`` trigger captures a
+bundle and arms trace sampling — closing the loop: breach -> bundle
+-> exemplar -> trace.
+
+Plan grammar (the qos_plan/fault_plan idiom; MUTABLE flag ``slo_plan``
+and the graphd ``/slo`` endpoint):
+
+    <name>:kind=latency,metric=<hist>,le_ms=<N>,target=<0..1>[,burn=<N>]
+    <name>:kind=availability,good=<metric>,bad=<metric>,target=<0..1>[,burn=<N>]
+
+entries joined by ``;``. ``burn`` defaults to 10. Objectives are
+surfaced at ``/slo`` (JSON) and as Prometheus gauges
+(``nebula_slo_<name>_burn_60s`` / ``_burn_600s`` / ``_burn_3600s`` /
+``_breached`` / ``_breaches``) on every daemon's ``/metrics``.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+from .flags import MUTABLE, graph_flags
+from .stats import StatsManager, WINDOWS
+from .stats import stats as global_stats
+
+DEFAULT_BURN_THRESHOLD = 10.0
+# the multi-window breach pair: short confirms "now", medium "material"
+BREACH_WINDOWS = (WINDOWS[0], WINDOWS[1])
+
+
+class Objective:
+    """One parsed SLO."""
+
+    __slots__ = ("name", "kind", "target", "burn_threshold",
+                 "metric", "le_us", "good", "bad",
+                 "breached", "breaches", "last_breach_ts")
+
+    def __init__(self, name: str, kind: str, target: float,
+                 burn_threshold: float = DEFAULT_BURN_THRESHOLD,
+                 metric: Optional[str] = None,
+                 le_us: Optional[float] = None,
+                 good: Optional[str] = None,
+                 bad: Optional[str] = None):
+        if kind not in ("latency", "availability"):
+            raise ValueError(f"slo {name!r}: unknown kind {kind!r}")
+        if not (0.0 < target < 1.0):
+            raise ValueError(f"slo {name!r}: target must be in (0, 1)")
+        if burn_threshold <= 0:
+            raise ValueError(f"slo {name!r}: burn must be > 0")
+        if kind == "latency" and (not metric or not le_us or le_us <= 0):
+            raise ValueError(
+                f"slo {name!r}: latency needs metric= and le_ms= > 0")
+        if kind == "availability" and (not good or not bad):
+            raise ValueError(
+                f"slo {name!r}: availability needs good= and bad=")
+        self.name = name
+        self.kind = kind
+        self.target = float(target)
+        self.burn_threshold = float(burn_threshold)
+        self.metric = metric
+        self.le_us = le_us
+        self.good = good
+        self.bad = bad
+        self.breached = False
+        self.breaches = 0
+        self.last_breach_ts = 0.0
+
+    @property
+    def budget(self) -> float:
+        return 1.0 - self.target
+
+    def describe(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "name": self.name, "kind": self.kind, "target": self.target,
+            "burn_threshold": self.burn_threshold,
+            "breached": self.breached, "breaches": self.breaches,
+            "last_breach_ts": self.last_breach_ts,
+        }
+        if self.kind == "latency":
+            out["metric"] = self.metric
+            out["le_ms"] = (self.le_us or 0) / 1000.0
+        else:
+            out["good"] = self.good
+            out["bad"] = self.bad
+        return out
+
+
+def parse_plan(plan: str) -> List[Objective]:
+    """Plan string -> objectives; raises ValueError on any malformed
+    entry (the caller keeps its previous plan, like qos/fault plans)."""
+    out: List[Objective] = []
+    seen = set()
+    for part in (plan or "").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        name, colon, args = part.partition(":")
+        name = name.strip()
+        if not name or not colon:
+            raise ValueError(f"bad slo entry {part!r} "
+                             f"(want <name>:k=v,...)")
+        if name in seen:
+            raise ValueError(f"duplicate slo name {name!r}")
+        seen.add(name)
+        kw: Dict[str, Any] = {}
+        for a in args.split(","):
+            a = a.strip()
+            if not a:
+                continue
+            k, eq, v = a.partition("=")
+            if not eq:
+                raise ValueError(f"bad slo arg {a!r} in {part!r}")
+            if k == "kind":
+                kw["kind"] = v
+            elif k == "metric":
+                kw["metric"] = v
+            elif k == "le_ms":
+                kw["le_us"] = float(v) * 1000.0
+            elif k == "target":
+                kw["target"] = float(v)
+            elif k == "burn":
+                kw["burn_threshold"] = float(v)
+            elif k == "good":
+                kw["good"] = v
+            elif k == "bad":
+                kw["bad"] = v
+            else:
+                raise ValueError(f"unknown slo arg {k!r} in {part!r}")
+        if "kind" not in kw or "target" not in kw:
+            raise ValueError(f"slo entry {part!r} needs kind= and "
+                             f"target=")
+        out.append(Objective(name, **kw))
+    return out
+
+
+class SloEngine:
+    """Objectives + evaluation + the background evaluator that makes
+    breaches fire without anyone scraping."""
+
+    EVAL_PERIOD_S = 1.0
+
+    def __init__(self, stats: Optional[StatsManager] = None,
+                 flight_recorder=None):
+        self._stats = stats if stats is not None else global_stats
+        self._flight = flight_recorder
+        self._lock = threading.Lock()
+        self._plan = ""
+        self._objectives: List[Objective] = []
+        self._stop: Optional[threading.Event] = None
+        # (monotonic ts, result) of the last evaluate() — scrape-path
+        # readers (gauges/describe) serve this instead of
+        # re-evaluating: a read endpoint must not do O(window) work
+        # per scrape nor flip breach state on its own cadence
+        self._last_eval: Optional[Tuple[float, List[Dict[str, Any]]]] \
+            = None
+
+    # ----------------------------------------------------------- plan
+    def set_plan(self, plan: str) -> None:
+        objectives = parse_plan(plan)      # raises before any mutation
+        with self._lock:
+            self._plan = plan or ""
+            self._objectives = objectives
+            self._last_eval = None   # never serve the old plan's view
+            if objectives and self._stop is None:
+                self._start_evaluator_locked()
+            elif not objectives and self._stop is not None:
+                self._stop.set()
+                self._stop = None
+
+    def clear(self) -> None:
+        self.set_plan("")
+
+    def _start_evaluator_locked(self) -> None:
+        stop = self._stop = threading.Event()
+
+        def run() -> None:
+            while not stop.wait(self.EVAL_PERIOD_S):
+                try:
+                    self.evaluate()
+                except Exception:   # the evaluator must never die
+                    pass
+
+        # nlint: disable=NL002 -- plan-lifetime evaluator loop, not
+        # request-scoped work (stops when the plan empties)
+        t = threading.Thread(target=run, daemon=True,
+                             name="slo-evaluator")
+        t.start()
+
+    # ----------------------------------------------------- evaluation
+    def _ratio(self, obj: Objective, window: int) -> Dict[str, float]:
+        """Bad-event ratio for one window: {bad, total, ratio, burn}."""
+        if obj.kind == "latency":
+            good, total = self._stats.window_le(
+                obj.metric, obj.le_us, window)
+            bad = total - good
+        else:
+            good = self._stats.read_stats(
+                f"{obj.good}.sum.{window}") or 0.0
+            bad = self._stats.read_stats(
+                f"{obj.bad}.sum.{window}") or 0.0
+            total = good + bad
+        ratio = (bad / total) if total else 0.0
+        return {"bad": bad, "total": total, "ratio": round(ratio, 6),
+                "burn": round(ratio / obj.budget, 4)}
+
+    def evaluate(self) -> List[Dict[str, Any]]:
+        """Evaluate every objective over all windows; update breach
+        state; record breach transitions into the flight recorder
+        (slo_burn trigger) and the breach counters."""
+        with self._lock:
+            objectives = list(self._objectives)
+        out: List[Dict[str, Any]] = []
+        for obj in objectives:
+            windows = {w: self._ratio(obj, w) for w in WINDOWS}
+            burning = all(windows[w]["burn"] >= obj.burn_threshold
+                          for w in BREACH_WINDOWS)
+            # transition under the lock: evaluate() runs concurrently
+            # from the evaluator thread, /metrics scrapes and /slo
+            # GETs — an unguarded check-then-set would double-count a
+            # breach (two slo_burn events, double-paged alerting)
+            fired = recovered = False
+            with self._lock:
+                if burning and not obj.breached:
+                    obj.breached = True
+                    obj.breaches += 1
+                    obj.last_breach_ts = time.time()
+                    fired = True
+                elif not burning and obj.breached:
+                    obj.breached = False
+                    recovered = True
+            if fired:
+                global_stats.add_value("slo.breach." + obj.name,
+                                       kind="counter")
+                fr = self._flight
+                if fr is None:
+                    from . import flight
+                    fr = flight.recorder
+                fr.record("slo_burn", objective=obj.name,
+                          burn_60s=windows[BREACH_WINDOWS[0]]["burn"],
+                          burn_600s=windows[BREACH_WINDOWS[1]]["burn"],
+                          target=obj.target)
+            elif recovered:
+                global_stats.add_value("slo.recovered." + obj.name,
+                                       kind="counter")
+            rec = obj.describe()
+            rec["windows"] = {str(w): windows[w] for w in WINDOWS}
+            out.append(rec)
+        with self._lock:
+            self._last_eval = (time.monotonic(), out)
+        return out
+
+    def _cached_eval(self) -> List[Dict[str, Any]]:
+        """Last evaluate() result if fresher than one evaluator
+        period; re-evaluates otherwise. With a plan armed, the
+        evaluator thread keeps this fresh, so scrape-path readers
+        never re-do the O(window) work nor flip breach state on the
+        scrape cadence."""
+        with self._lock:
+            cached = self._last_eval
+        if cached is not None and \
+                time.monotonic() - cached[0] < self.EVAL_PERIOD_S:
+            return cached[1]
+        return self.evaluate()
+
+    # ---------------------------------------------------- observation
+    def describe(self) -> Dict[str, Any]:
+        with self._lock:
+            plan = self._plan
+        return {"plan": plan, "objectives": self._cached_eval(),
+                "windows": list(WINDOWS),
+                "breach_windows": list(BREACH_WINDOWS)}
+
+    def gauges(self) -> Dict[str, float]:
+        """Flat /metrics gauges per objective: burn rate per window,
+        the breached flag, lifetime breach count."""
+        out: Dict[str, float] = {}
+        for rec in self._cached_eval():
+            base = "slo." + rec["name"]
+            for w, wrec in rec["windows"].items():
+                out[f"{base}.burn_{w}s"] = wrec["burn"]
+            out[base + ".breached"] = 1.0 if rec["breached"] else 0.0
+            out[base + ".breaches"] = float(rec["breaches"])
+        return out
+
+    def reset(self) -> None:
+        """Test/bench isolation: drop the plan and stop the
+        evaluator."""
+        self.set_plan("")
+
+
+# declared + watched on EVERY registry: each daemon's /flags serves
+# only its own (graph/storage/meta), and all three daemons serve /slo
+from .flags import meta_flags, storage_flags  # noqa: E402
+
+for _reg in (graph_flags, storage_flags, meta_flags):
+    _reg.declare(
+        "slo_plan", "", MUTABLE,
+        "declarative SLO objectives (common/slo.py grammar, e.g. "
+        "'latency:kind=latency,metric=graph.query_latency_us,"
+        "le_ms=50,target=0.99'); empty disarms")
+
+
+def _on_flag(name: str, value: Any) -> None:
+    if name != "slo_plan":
+        return
+    try:
+        engine.set_plan(str(value or ""))
+    except ValueError as e:
+        # a bad hot-set keeps the previous plan, visibly (the /slo
+        # endpoint 400s; the flag path can only log + count)
+        import logging
+        logging.getLogger("nebula_tpu.slo").warning(
+            "slo_plan flag rejected, previous plan kept: %s", e)
+        global_stats.add_value("slo.bad_plan", kind="counter")
+
+
+# process-global instance (the qos/faults singleton idiom)
+engine = SloEngine()
+for _reg in (graph_flags, storage_flags, meta_flags):
+    _reg.watch(_on_flag)

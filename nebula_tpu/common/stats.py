@@ -24,6 +24,14 @@ WINDOWS = (60, 600, 3600)
 _BOUNDS: List[float] = [
     10 ** (d + i / 10.0) for d in range(9) for i in range(10)]
 
+# exposition buckets for kind="histogram" (native Prometheus
+# histograms): every EXPO_STEP-th internal bound — 30 `le` bounds per
+# series plus +Inf keeps /metrics readable while window_le/percentile
+# math keeps the full 90-bucket resolution
+_EXPO_STEP = 3
+EXPO_BOUNDS: List[float] = [
+    _BOUNDS[i] for i in range(_EXPO_STEP - 1, len(_BOUNDS), _EXPO_STEP)]
+
 
 def _bucket_of(v: float) -> int:
     if v <= 1:
@@ -33,7 +41,8 @@ def _bucket_of(v: float) -> int:
 
 class _Metric:
     __slots__ = ("lock", "sums", "counts", "hists", "head_sec",
-                 "kind", "life_sum", "life_count")
+                 "kind", "life_sum", "life_count", "life_buckets",
+                 "life_over", "exemplars")
 
     def __init__(self, now_sec: int, kind: Optional[str] = None):
         n = WINDOWS[-1]
@@ -42,16 +51,29 @@ class _Metric:
         self.counts = [0] * n
         self.hists = [None] * n          # lazily allocated per-second hist
         self.head_sec = now_sec
-        # "counter" | "timing" | None (legacy, untagged) — fixed by the
-        # first add_value call-site that opts in; drives which snapshot
-        # methods make sense (a pure counter never fed a histogram-worthy
-        # value distribution, so p95/p99/avg over it are noise) and the
-        # Prometheus # TYPE annotation
+        # "counter" | "timing" | "histogram" | None (legacy, untagged)
+        # — fixed by the first add_value call-site that opts in; drives
+        # which snapshot methods make sense (a pure counter never fed a
+        # histogram-worthy value distribution, so p95/p99/avg over it
+        # are noise) and the Prometheus # TYPE annotation. "histogram"
+        # additionally keeps cumulative bucket counts + per-bucket
+        # exemplars and exposes real `_bucket`/`_sum`/`_count` series.
         self.kind = kind
         # lifetime accumulators: Prometheus counters are cumulative,
         # the trailing windows above are not
         self.life_sum = 0.0
         self.life_count = 0
+        if kind == "histogram":
+            self.life_buckets = [0] * len(EXPO_BOUNDS)
+            self.life_over = 0           # the +Inf bucket's own count
+            # exposition-bucket idx -> (trace_id, value, unix_ts): the
+            # OpenMetrics exemplar linking a bucket to the trace of a
+            # sample that landed in it (newest kept)
+            self.exemplars: Dict[int, Tuple[str, float, float]] = {}
+        else:
+            self.life_buckets = None
+            self.life_over = 0
+            self.exemplars = None
 
     def _advance(self, now_sec: int) -> None:
         gap = now_sec - self.head_sec
@@ -65,7 +87,9 @@ class _Metric:
             self.hists[i] = None
         self.head_sec = now_sec
 
-    def add(self, value: float, now_sec: int) -> None:
+    def add(self, value: float, now_sec: int,
+            trace_id: Optional[str] = None,
+            now: Optional[float] = None) -> None:
         with self.lock:
             self._advance(now_sec)
             i = now_sec % WINDOWS[-1]
@@ -78,6 +102,17 @@ class _Metric:
                 h = self.hists[i] = {}
             b = _bucket_of(value)
             h[b] = h.get(b, 0) + 1
+            if self.life_buckets is not None:
+                if value > _BOUNDS[-1]:
+                    self.life_over += 1
+                    eb = len(EXPO_BOUNDS)
+                else:
+                    eb = b // _EXPO_STEP
+                    self.life_buckets[eb] += 1
+                if trace_id:
+                    self.exemplars[eb] = (
+                        trace_id, float(value),
+                        float(now if now is not None else now_sec))
 
     def read(self, method: str, window: int, now_sec: int) -> float:
         with self.lock:
@@ -125,19 +160,33 @@ class StatsManager:
         self._clock = clock
 
     def add_value(self, name: str, value: float = 1.0,
-                  kind: Optional[str] = None) -> None:
+                  kind: Optional[str] = None,
+                  trace_id: Optional[str] = None) -> None:
         """`kind` is a call-site opt-in fixed at FIRST registration:
         "counter" (monotonic event counts — snapshot/Prometheus emit
-        rate + totals only) or "timing" (a value distribution — avg and
-        percentiles are meaningful). Untagged metrics keep the legacy
-        emit-everything behavior; read_stats accepts any method for any
-        kind (backward-compatible specs)."""
-        now_sec = int(self._clock())
+        rate + totals only), "timing" (a value distribution — avg and
+        percentiles are meaningful) or "histogram" (a native Prometheus
+        histogram: real `_bucket`/`_sum`/`_count` series with
+        OpenMetrics exemplars carrying the trace_id of a sample in
+        that bucket). Untagged metrics keep the legacy emit-everything
+        behavior; read_stats accepts any method for any kind
+        (backward-compatible specs).
+
+        For histograms, `trace_id` pins the exemplar explicitly (the
+        dispatcher records waiters' waits under their own traces);
+        left None, the current ContextVar trace context — if any — is
+        captured. Pass "" to SUPPRESS the exemplar entirely — a
+        call-site recording on behalf of another request (an unsampled
+        waiter) must not fall back to the ambient (leader's) trace."""
+        now = self._clock()
+        now_sec = int(now)
         m = self._metrics.get(name)
         if m is None:
             with self._lock:
                 m = self._metrics.setdefault(name, _Metric(now_sec, kind))
-        m.add(value, now_sec)
+        if m.kind == "histogram" and trace_id is None:
+            trace_id = current_trace_id()
+        m.add(value, now_sec, trace_id=trace_id or None, now=now)
 
     def read_stats(self, spec: str) -> Optional[float]:
         """spec = '<name>.<method>.<window-secs>'."""
@@ -159,6 +208,57 @@ class StatsManager:
     def names(self) -> List[str]:
         return sorted(self._metrics)
 
+    def window_le(self, name: str, le: float,
+                  window: int) -> Tuple[float, float]:
+        """(samples <= `le`, total samples) over the trailing `window`
+        seconds of a histogram/timing metric — the SLO engine's
+        latency-compliance read (common/slo.py). Bucket-resolution:
+        a threshold landing inside a bucket counts that bucket as bad
+        (conservative: burn alerts err pessimistic). (0, 0) for an
+        unknown metric or window."""
+        if window not in WINDOWS:
+            return 0.0, 0.0
+        m = self._metrics.get(name)
+        if m is None:
+            return 0.0, 0.0
+        now_sec = int(self._clock())
+        # highest internal bucket whose upper bound is <= le
+        cutoff = bisect.bisect_right(_BOUNDS, le) - 1
+        with m.lock:
+            m._advance(now_sec)
+            n = WINDOWS[-1]
+            good = 0
+            total = 0
+            for k in range(window):
+                h = m.hists[(now_sec - k) % n]
+                if not h:
+                    continue
+                for b, c in h.items():
+                    total += c
+                    if b <= cutoff:
+                        good += c
+        return float(good), float(total)
+
+    def histogram_snapshot(self, name: str) -> Optional[Dict[str, object]]:
+        """Lifetime bucket vector + exemplars of a histogram metric —
+        what bench.py records into its JSON artifacts (bucket shape,
+        not just p50/p95). None for unknown/non-histogram metrics."""
+        m = self._metrics.get(name)
+        if m is None or m.life_buckets is None:
+            return None
+        with m.lock:
+            counts = list(m.life_buckets) + [m.life_over]
+            exemplars = {
+                i: {"trace_id": t, "value": v, "ts": ts}
+                for i, (t, v, ts) in m.exemplars.items()}
+            return {"bounds": list(EXPO_BOUNDS), "counts": counts,
+                    "sum": m.life_sum, "count": m.life_count,
+                    "exemplars": exemplars}
+
+    def histogram_names(self) -> List[str]:
+        return sorted(n for n, m in self._metrics.items()
+                      if m.kind == "histogram")
+
     def lifetime_total(self, name: str) -> float:
         """Cumulative sum since process start (the Prometheus `_total`
         value) — 0.0 for a metric never reported."""
@@ -167,9 +267,11 @@ class StatsManager:
 
     # which snapshot methods make sense per metric kind: counters get
     # rate/sum (their p95 would always be the bucket of 1.0 — noise),
-    # timings get the distribution views, untagged keeps legacy output
+    # timings/histograms get the distribution views, untagged keeps
+    # legacy output
     _KIND_METHODS = {"counter": ("rate", "sum"),
                      "timing": ("rate", "avg", "p95", "p99"),
+                     "histogram": ("rate", "avg", "p95", "p99"),
                      None: ("rate", "sum", "avg", "p95", "p99")}
 
     def snapshot(self, windows: Tuple[int, ...] = (60,)) -> Dict[str, float]:
@@ -185,29 +287,70 @@ class StatsManager:
         return out
 
     def prometheus_lines(self, prefix: str = "nebula") -> List[str]:
-        """Prometheus text exposition of every metric (served by
-        /metrics). Counters (and untagged metrics' totals) become
-        cumulative `_total` counters from the lifetime accumulators;
-        timings additionally expose 60s-window avg/p95/p99 gauges.
-        Names are stable: `<prefix>_<name>` with non-alphanumerics
-        folded to '_'."""
+        """OpenMetrics text exposition of every metric (served by
+        /metrics; docs/manual/10-observability.md). Family TYPE lines
+        declare the BASE name — counter samples carry the `_total`
+        suffix per the OpenMetrics counter contract (the strict parser
+        in tests/ enforces this). Counters (and untagged metrics'
+        totals) expose cumulative `_total` samples from the lifetime
+        accumulators; timings additionally expose `_count` +
+        60s-window avg/p95/p99 gauges; histograms expose native
+        `_bucket`/`_sum`/`_count` series with per-bucket OpenMetrics
+        exemplars carrying the trace_id of a sample that landed in
+        that bucket. Names are stable: `<prefix>_<name>` with
+        non-alphanumerics folded to '_'."""
         now = int(self._clock())
         lines: List[str] = []
         for name in self.names():
             m = self._metrics[name]
             base = _prom_name(prefix, name)
+            if m.kind == "histogram":
+                lines.extend(self._histogram_lines(m, base, now))
+                continue
             with m.lock:
                 life_sum, life_count = m.life_sum, m.life_count
-            lines.append(f"# TYPE {base}_total counter")
+            lines.append(f"# TYPE {base} counter")
             lines.append(f"{base}_total {_prom_num(life_sum)}")
             if m.kind == "counter":
                 continue
-            lines.append(f"# TYPE {base}_count_total counter")
+            lines.append(f"# TYPE {base}_count counter")
             lines.append(f"{base}_count_total {life_count}")
             for method in ("avg", "p95", "p99"):
                 v = m.read(method, 60, now)
                 lines.append(f"# TYPE {base}_{method}_60s gauge")
                 lines.append(f"{base}_{method}_60s {_prom_num(v)}")
+        return lines
+
+    def _histogram_lines(self, m: _Metric, base: str,
+                         now: int) -> List[str]:
+        with m.lock:
+            life_sum = m.life_sum
+            counts = list(m.life_buckets)
+            over = m.life_over
+            exemplars = dict(m.exemplars)
+        lines = [f"# TYPE {base} histogram"]
+        acc = 0
+        for i, le in enumerate(EXPO_BOUNDS):
+            acc += counts[i]
+            line = f'{base}_bucket{{le="{le:.6g}"}} {acc}'
+            ex = exemplars.get(i)
+            if ex is not None:
+                line += _exemplar_suffix(ex)
+            lines.append(line)
+        total = acc + over
+        line = f'{base}_bucket{{le="+Inf"}} {total}'
+        ex = exemplars.get(len(EXPO_BOUNDS))
+        if ex is not None:
+            line += _exemplar_suffix(ex)
+        lines.append(line)
+        lines.append(f"{base}_sum {_prom_num(life_sum)}")
+        lines.append(f"{base}_count {total}")
+        # window gauges ride along (dashboard parity with timings —
+        # the histogram series carry the shape, these the hot view)
+        for method in ("avg", "p95", "p99"):
+            v = m.read(method, 60, now)
+            lines.append(f"# TYPE {base}_{method}_60s gauge")
+            lines.append(f"{base}_{method}_60s {_prom_num(v)}")
         return lines
 
 
@@ -220,6 +363,33 @@ def _prom_num(v: float) -> str:
     if float(v).is_integer():
         return str(int(v))
     return repr(float(v))
+
+
+def _exemplar_suffix(ex: Tuple[str, float, float]) -> str:
+    """OpenMetrics exemplar: ` # {trace_id="<id>"} <value> <ts>` —
+    the metric -> trace join (docs/manual/10-observability.md)."""
+    trace_id, value, ts = ex
+    return (f' # {{trace_id="{trace_id}"}} {_prom_num(value)} '
+            f'{ts:.3f}')
+
+
+_tracer_ref = None
+
+
+def current_trace_id() -> Optional[str]:
+    """trace_id of the live sampled trace, if any — one ContextVar
+    read (lazy import: tracing itself reports metrics here). THE
+    shared lookup for histogram exemplar capture and flight-recorder
+    events (common/flight.py)."""
+    global _tracer_ref
+    if _tracer_ref is None:
+        try:
+            from . import tracing
+        except Exception:
+            return None
+        _tracer_ref = tracing.tracer
+    ctx = _tracer_ref.current_ctx()
+    return ctx[0] if ctx else None
 
 
 # process-global instance (the reference's static StatsManager)

@@ -78,14 +78,29 @@ def serve_graphd(meta_addr: str, host: str = "127.0.0.1", port: int = 0,
     server = RpcServer(host, port).register("graph", service).start()
     web = None
     if ws_port is not None:
+        import os as _os
         web = WebService("graphd", flags=graph_flags, stats=stats,
-                         host=host, port=ws_port)
+                         host=host, port=ws_port,
+                         build_labels={
+                             "role": "graph",
+                             "tpu": "1" if tpu_engine is not None
+                             else "0",
+                             "wide_csr": "1" if _os.environ.get(
+                                 "NEBULA_TPU_WIDE_CSR") else "0"})
         # observability surface (docs/manual/10-observability.md):
         # /traces (trace ring + ?arm=N force knob), /queries (active
-        # statements + slow-query log), /metrics (Prometheus — the
-        # WebService built-in, extended with engine counters below)
+        # statements + slow-query log), /metrics (OpenMetrics — the
+        # WebService built-in, extended with engine counters below),
+        # /flight + /slo (WebService built-ins; the collectors below
+        # put this daemon's serve-path state into every flight bundle)
         web.register_observability(active=service.active_queries,
                                    slow=service.slow_log)
+        from ..common.flight import recorder as flight_recorder
+        flight_recorder.add_collector("graphd.queries", lambda: {
+            "active": service.active_queries.snapshot(),
+            "slow": service.slow_log.snapshot(20)})
+        flight_recorder.add_collector("graphd.routing",
+                                      client.routing_stats)
 
         def faults_handler(params, body):
             # /faults: GET = registry state (armed plan, per-point fire
@@ -289,6 +304,11 @@ def serve_graphd(meta_addr: str, host: str = "127.0.0.1", port: int = 0,
                 }
 
             web.register("/tpu_stats", tpu_stats)
+            # every flight bundle carries the full /tpu_stats block —
+            # breaker states, qos slices, cache/fused counters — as
+            # captured at trigger time (common/flight.py)
+            flight_recorder.add_collector(
+                "graphd.tpu_stats", lambda: tpu_stats({}, b"")[1])
 
             def tpu_metric_source():
                 # engine counter dicts as flat Prometheus gauges:

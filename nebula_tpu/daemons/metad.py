@@ -64,7 +64,15 @@ def serve_metad(host: str = "127.0.0.1", port: int = 0,
     web = None
     if ws_port is not None:
         web = WebService("metad", flags=meta_flags, stats=stats,
-                         host=host, port=ws_port)
+                         host=host, port=ws_port,
+                         build_labels={"role": "meta"})
+        # flight bundles captured on metad carry the balancer/liveness
+        # view at trigger time (common/flight.py)
+        from ..common.flight import recorder as _flight
+        _flight.add_collector("metad.balance", meta.balance_progress)
+        _flight.add_collector(
+            "metad.active_hosts",
+            lambda: [h.host for h in meta.active_hosts("storage")])
 
         def balance_handler(params, body):
             # /balance: plan progress + persisted task rows (the BALANCE

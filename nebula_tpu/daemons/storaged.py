@@ -153,8 +153,13 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
         def on_leader_change(space_id, part_id, leader):
             # counted for /metrics; when THIS replica takes over, its
             # view of the meta allocation may already include peers the
-            # group hasn't admitted (heartbeat reconcile) — sync now
+            # group hasn't admitted (heartbeat reconcile) — sync now.
+            # Also a flight-recorder event: >= 3 leader changes in 10 s
+            # is the leader_churn trigger (common/flight.py)
             stats.add_value("raftex.leader_changes", kind="counter")
+            from ..common.flight import recorder as _flight
+            _flight.record("leader_change", space=space_id,
+                           part=part_id, leader=str(leader))
             if leader == raft_addr_of(addr):
                 _reconcile_part_membership(space_id, part_id)
 
@@ -355,7 +360,11 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
     web = None
     if ws_port is not None:
         web = WebService("storaged", flags=storage_flags, stats=stats,
-                         host=host, port=ws_port)
+                         host=host, port=ws_port,
+                         build_labels={
+                             "role": "storage",
+                             "replicated": "1" if replicated else "0",
+                             "engine": engine})
         _register_admin_handlers(web, storage)
         # observability surface: /traces serves this daemon's ring
         # (remote fragments it recorded for graphd-headed traces),
@@ -386,6 +395,11 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
                          "parts": node.raft_status()}
 
         web.register("/raft", raft_handler)
+        if node is not None:
+            # flight bundles captured on this storaged carry the
+            # per-part consensus state at trigger time
+            from ..common.flight import recorder as _fl
+            _fl.add_collector("storaged.raft", node.raft_status)
 
         if node is not None:
             def raft_metric_source():

@@ -42,6 +42,7 @@ import numpy as np
 from ..common.cache import (CacheRung, plan_stage_enabled,
                             result_stage_enabled)
 from ..common.faults import CircuitBreaker, faults
+from ..common.flight import recorder as _flight
 from ..common.flags import graph_flags
 from ..common.qos import LANE_BULK, LANE_INTERACTIVE, OverloadShed
 from ..common.stats import stats as global_stats
@@ -405,6 +406,9 @@ class TpuGraphEngine:
         if miss:
             global_stats.add_value("tpu_engine.fused.misses",
                                    kind="counter")
+            # a compile is a latency cliff worth remembering: the ring
+            # shows whether a p99 burn lined up with a signature miss
+            _flight.record("fused_compile", signature=str(sig))
         return fn
 
     def fused_stats(self) -> Dict[str, Any]:
@@ -463,7 +467,13 @@ class TpuGraphEngine:
         self.profile_seq += 1
         # every device-served query ends here with its stage timings —
         # the one hook that turns them into trace spans (backdated;
-        # no-ops when the query is unsampled)
+        # no-ops when the query is unsampled) and into the native
+        # stage histograms (exemplars carry the live trace id, so a
+        # bad bucket on /metrics links straight to a span tree)
+        global_stats.add_value("tpu_engine.kernel_us",
+                               t_kernel * 1e6, kind="histogram")
+        global_stats.add_value("tpu_engine.materialize_us",
+                               t_mat * 1e6, kind="histogram")
         if _tr.active():
             _tr.tag_root("mode", mode)
             _tr.add_span("snapshot", t_snap * 1e6)
@@ -682,6 +692,12 @@ class TpuGraphEngine:
                 self.stats["degraded_serves"] += 1
             global_stats.add_value("tpu_engine.degraded_serves."
                                    + feature, kind="counter")
+            # every open-breaker degrade is a flight event: the armed
+            # aftermath after a trip would otherwise be silent (the
+            # degraded queries carry their trace ids here — the ring
+            # shows WHO served on the CPU pipe while the device was
+            # fenced, and the ids join the histogram exemplars)
+            _flight.record("breaker_open_serve", feature=feature)
             _tr.tag_root("degraded", "breaker_open:" + feature)
             return False
         if ctx is not None:
@@ -700,6 +716,7 @@ class TpuGraphEngine:
             with self._stats_lock:
                 self.stats["breaker_recoveries"] += 1
             global_stats.add_value("tpu_engine.breaker_recoveries", kind="counter")
+            _flight.record("breaker_recovered", feature=feature)
             _LOG.info("device path %r recovered: half-open probe "
                       "succeeded, breaker closed", feature)
 
@@ -727,6 +744,13 @@ class TpuGraphEngine:
                 self.stats["breaker_trips"] += 1
             global_stats.add_value("tpu_engine.breaker_trips",
                                    kind="counter")
+            # the flight recorder's breaker_open trigger: a trip dumps
+            # a bundle + arms aftermath sampling (common/flight.py)
+            _flight.record("breaker_trip", feature=feature,
+                           error=repr(exc))
+        else:
+            _flight.record("device_failure", feature=feature,
+                           error=repr(exc))
         with self._stats_lock:
             self.stats["degraded_serves"] += 1
         global_stats.add_value("tpu_engine.device_failures." + feature,
@@ -755,6 +779,7 @@ class TpuGraphEngine:
             self.stats["deadline_exceeded"] += 1
         global_stats.add_value("tpu_engine.deadline_exceeded." + where,
                                kind="counter")
+        _flight.record("deadline_balk", where=where)
         _tr.tag_root("degraded", "deadline:" + where)
         return True
 
@@ -787,6 +812,8 @@ class TpuGraphEngine:
                 with self._stats_lock:
                     self.stats["mesh_demotions"] += 1
                 global_stats.add_value("tpu_engine.mesh_demotions", kind="counter")
+                _flight.record("mesh_demotion", space=snap.space_id,
+                               feature=feature)
                 _LOG.warning(
                     "space %d demoted to single-device serving "
                     "(unsharded rebuild kicked; half-open mesh probes "
@@ -1105,6 +1132,7 @@ class TpuGraphEngine:
             snap.stale = True
             self.stats["snapshot_poisoned"] += 1
             global_stats.add_value("tpu_engine.snapshot_poisoned", kind="counter")
+            _flight.record("snapshot_poisoned", space=space_id)
             # poison hygiene: drop the space's cached results/declines
             # alongside the snapshot (entries are already version-
             # orphaned; this frees them and counts the purge)
@@ -1767,6 +1795,7 @@ class TpuGraphEngine:
             global_stats.add_value(
                 "tpu_engine.deadline_exceeded.dispatch_wait",
                 kind="counter")
+            _flight.record("deadline_balk", where="dispatch_wait")
             _tr.tag_root("degraded", "deadline:dispatch_wait")
             return None
         if req.result is None:
@@ -1882,6 +1911,12 @@ class TpuGraphEngine:
                 self.qos_shed_by_space.get(space_id, 0) + 1
         global_stats.add_value("tpu_engine.qos.shed." + reason,
                                kind="counter")
+        # retry-after distribution: the shape of overload pressure
+        # (exemplars link a shed to the trace that was shed)
+        global_stats.add_value("tpu_engine.qos.shed_retry_ms",
+                               retry_ms, kind="histogram")
+        _flight.record("shed", reason=reason, lane=req.lane,
+                       space=space_id)
         _tr.tag_root("shed", f"{reason}:{req.lane}")
         raise OverloadShed(reason, retry_ms)
 
@@ -1937,6 +1972,7 @@ class TpuGraphEngine:
         the same notify as their representative — a deduped request
         never waits longer than the lane it rode."""
         now = time.monotonic()
+        wait_hist: List[Tuple[int, Optional[str]]] = []
         with self._disp_cv:
             done_now: List["_GoReq"] = []
             seen = set()
@@ -1969,9 +2005,20 @@ class TpuGraphEngine:
                     self.stats["group_wait_us_max"] = w
                 # shed-watermark feed: recent per-request waits (ms)
                 self._wait_samples.append(w / 1e3)
+                # dispatcher-wait histogram fed OUTSIDE the cv below,
+                # under each request's OWN trace id (the exemplar must
+                # point at the waiter that waited, not the leader —
+                # "" suppresses the exemplar for unsampled waiters
+                # instead of falling back to the leader's ambient
+                # trace, see StatsManager.add_value)
+                wait_hist.append(
+                    (w, r.tctx[0].trace_id if r.tctx else ""))
                 if early:
                     self.stats["early_releases"] += 1
             self._disp_cv.notify_all()
+        for w, tid in wait_hist:
+            global_stats.add_value("tpu_engine.dispatcher_wait_us", w,
+                                   kind="histogram", trace_id=tid)
 
     def _finalize_result(self, r):
         """Box a deferred (window-encoded) result into Python tuples in

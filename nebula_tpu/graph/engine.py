@@ -433,8 +433,20 @@ class GraphService:
         from ..common.flags import graph_flags
         from ..common.stats import stats
         stats.add_value("graph.query", kind="counter")
+        # native histogram (docs/manual/10-observability.md): real
+        # _bucket/_sum/_count series on /metrics whose exemplars carry
+        # this query's trace id when sampled — but the handle already
+        # finished above, so pin the exemplar explicitly
         stats.add_value("graph.query_latency_us", resp.latency_us,
-                        kind="timing")
+                        kind="histogram",
+                        trace_id=handle.trace_id)   # "" = no exemplar
+        if session.space_name:
+            # per-tenant latency slice (the SLO engine's per-space
+            # latency objectives ride these; cardinality = live spaces)
+            stats.add_value(
+                "graph.space." + session.space_name + ".latency_us",
+                resp.latency_us, kind="histogram",
+                trace_id=handle.trace_id)   # "" = no exemplar
         if not resp.ok():
             stats.add_value("graph.query_error", kind="counter")
         slow_ms = graph_flags.get("slow_op_threshold_ms", 50)

@@ -229,6 +229,7 @@ class Balancer:
     # plan execution
     # ------------------------------------------------------------------
     def _run_plan(self, plan_id: int, tasks: List[BalanceTask]) -> None:
+        from ..common.flight import recorder as _flight
         for task in tasks:
             if self._stop_flag:
                 break
@@ -238,6 +239,14 @@ class Balancer:
                 self._run_task(task)
             except Exception:
                 task.status = ST_FAILED
+            if task.status == ST_FAILED:
+                # a failed partition move is exactly the kind of
+                # incident the flight ring should remember: the bundle
+                # captured by whatever fires next (leader churn, an
+                # SLO burn) shows the rebalance context alongside it
+                _flight.record("balance_task_failed", plan=plan_id,
+                               space=task.space_id, part=task.part_id,
+                               src=str(task.src), dst=str(task.dst))
             self.meta._put((task.key(), task.value()))
 
     def _run_task(self, t: BalanceTask) -> None:

@@ -314,18 +314,31 @@ class RpcClient:
                                   self.RETRY_BACKOFF_CAP, paced))
 
     def call(self, method: str, *args, **kwargs) -> Any:
-        if tracer.current_ctx() is None:
-            payload = wire.encode((self.service, method, tuple(args),
-                                   kwargs))
-            return self._call_framed(payload)
-        # traced call: one rpc.call span covering every attempt (a
-        # retry that finally succeeds still joins the remote fragment
-        # under this span — the round-trip survives reconnects)
-        with tracer.span("rpc.call", service=self.service,
-                         method=method, peer=self.addr):
-            payload = wire.encode((self.service, method, tuple(args),
-                                   kwargs, tracer.current_ctx()))
-            return self._call_framed(payload)
+        # rpc.call_us native histogram: every call (traced or not)
+        # feeds the round-trip distribution; exemplars ride only the
+        # traced ones (docs/manual/10-observability.md). One finally
+        # for both branches — recorded after the rpc.call span closes,
+        # still inside the trace's dynamic extent.
+        t0 = time.perf_counter()
+        try:
+            if tracer.current_ctx() is None:
+                payload = wire.encode((self.service, method,
+                                       tuple(args), kwargs))
+                return self._call_framed(payload)
+            # traced call: one rpc.call span covering every attempt (a
+            # retry that finally succeeds still joins the remote
+            # fragment under this span — the round-trip survives
+            # reconnects)
+            with tracer.span("rpc.call", service=self.service,
+                             method=method, peer=self.addr):
+                payload = wire.encode((self.service, method,
+                                       tuple(args), kwargs,
+                                       tracer.current_ctx()))
+                return self._call_framed(payload)
+        finally:
+            global_stats.add_value(
+                "rpc.call_us", (time.perf_counter() - t0) * 1e6,
+                kind="histogram")
 
     def _call_framed(self, payload: bytes) -> Any:
         last_err: Optional[Exception] = None

@@ -203,6 +203,8 @@ class StorageClient:
             if rem is not None and rem <= 0:
                 stats.add_value("storage_client.fanout_deadline_balk",
                                 kind="counter")
+                from ..common.flight import recorder as _flight
+                _flight.record("deadline_balk", where="storage_fanout")
                 tracer.tag_root("degraded", "deadline:storage_fanout")
                 for part in pending:
                     # overwrite the round's retryable verdict (e.g.

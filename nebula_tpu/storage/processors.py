@@ -268,8 +268,10 @@ class StorageService:
                 resp.vertices.append(vd)
             resp.results[part] = PartResult(ErrorCode.SUCCEEDED)
         resp.latency_us = int((time.monotonic() - t0) * 1e6)
+        # native histogram (was kind="timing"): real bucket series on
+        # /metrics, exemplars carrying the adopted remote trace id
         stats.add_value("storage.get_bound_latency_us", resp.latency_us,
-                        kind="timing")
+                        kind="histogram")
         return resp
 
     def _collect_edge_props(self, engine, space: int, part: int, vid: int,
@@ -472,6 +474,8 @@ class StorageService:
                             _acc(idx, ed.props, d)
             resp.results[part] = PartResult(ErrorCode.SUCCEEDED)
         resp.latency_us = int((time.monotonic() - t0) * 1e6)
+        stats.add_value("storage.bound_stats_latency_us",
+                        resp.latency_us, kind="histogram")
         return resp
 
     # ------------------------------------------------------------------
@@ -933,6 +937,8 @@ class StorageService:
             np.asarray(scan.vlens, np.int64).tobytes(),
             np.asarray(scan.klens, np.int64).tobytes())
         resp.latency_us = int((time.monotonic() - t0) * 1e6)
+        stats.add_value("storage.scan_part_latency_us",
+                        resp.latency_us, kind="histogram")
         return resp
 
     # ------------------------------------------------------------------

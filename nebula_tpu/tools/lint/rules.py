@@ -308,15 +308,21 @@ def nl003(project: Project) -> List[Finding]:
 # NL004 — StatsManager.add_value kind consistency
 # ---------------------------------------------------------------------------
 
+_NL004_KINDS = ("counter", "timing", "histogram")
+
+
 @rule("NL004", "add_value kind inconsistent across sites for one metric")
 def nl004(project: Project) -> List[Finding]:
-    """A metric's kind ("counter" | "timing" | untagged) is fixed at
-    FIRST registration (common/stats.py) — when call sites disagree,
-    whichever site runs first wins and the snapshot/Prometheus shape
-    of the metric becomes load-order-dependent. One name, one kind,
-    across every `add_value` site; and every site must declare one
-    (an untagged metric keeps the legacy emit-everything shape —
-    p95 gauges over pure counters are noise on /metrics)."""
+    """A metric's kind ("counter" | "timing" | "histogram" | untagged)
+    is fixed at FIRST registration (common/stats.py) — when call sites
+    disagree, whichever site runs first wins and the snapshot/
+    Prometheus shape of the metric becomes load-order-dependent. One
+    name, one kind, across every `add_value` site; every site must
+    declare one (an untagged metric keeps the legacy emit-everything
+    shape — p95 gauges over pure counters are noise on /metrics); and
+    the declared kind must be a REAL kind (a typo like "histograms"
+    silently registers an untagged metric — histogram-on-counter and
+    cousins are exactly the misuse this rule exists to catch)."""
     sites: Dict[str, List[Tuple[Optional[str], str, int, int, str]]] = {}
     out: List[Finding] = []
     for f in project.files:
@@ -347,9 +353,17 @@ def nl004(project: Project) -> List[Finding]:
                 out.append(Finding(
                     "NL004", f.rel, node.lineno, node.col_offset,
                     f"metric {shown!r} reported without a kind tag — "
-                    f"declare kind=\"counter\" or kind=\"timing\" so "
-                    f"the snapshot/Prometheus shape is explicit",
-                    f.qualname_at(node)))
+                    f"declare kind=\"counter\", kind=\"timing\" or "
+                    f"kind=\"histogram\" so the snapshot/Prometheus "
+                    f"shape is explicit", f.qualname_at(node)))
+            elif kind is not None and kind not in _NL004_KINDS:
+                shown = name if name is not None else "<dynamic>"
+                out.append(Finding(
+                    "NL004", f.rel, node.lineno, node.col_offset,
+                    f"metric {shown!r} declares unknown kind {kind!r} "
+                    f"— common/stats.py registers it UNTAGGED (legacy "
+                    f"emit-everything shape); expected one of "
+                    f"{_NL004_KINDS}", f.qualname_at(node)))
             if name is None:
                 continue          # dynamic names: per-family, skip
             sites.setdefault(name, []).append(

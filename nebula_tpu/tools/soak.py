@@ -62,13 +62,31 @@ def _debug_bundle(cluster, tpu, extra: dict,
     dumps the trace ring, the /queries surfaces (active statements +
     slow-query log) and the engine's counters to one JSON artifact, so
     a divergence on a remote box arrives with its own evidence instead
-    of a bare assertion line."""
+    of a bare assertion line. The failure is also recorded into the
+    flight recorder (identity_failure trigger -> its own capture), and
+    that flight bundle rides INSIDE this artifact — one artifact per
+    incident, not two (docs/manual/10-observability.md)."""
     import os
+    from ..common.flight import recorder as flight_recorder
     from ..common.tracing import tracer
     path = os.environ.get("SOAK_BUNDLE_OUT", path)
     from ..common.lockwitness import witness
+    # the identity_failure trigger captures the flight side: event
+    # ring + collectors + last sampled traces, and arms aftermath
+    # sampling for whatever the soak does next
+    flight_recorder.record("identity_failure", source="soak",
+                           detail=str(extra.get("query",
+                                                extra.get("phase",
+                                                          "")))[:256])
+    # bundle enrichment (collectors/stats/dump) runs on a capture
+    # thread — wait for it so the attached bundle is complete
+    flight_recorder.flush(5.0)
     out = {
         "trace_ring": tracer.ring.snapshot(),
+        "flight": {
+            "state": flight_recorder.describe(limit=64),
+            "bundle": flight_recorder.last_bundle(),
+        },
         # the observed lock-order graph rides every bundle: a
         # divergence that involved a lock-ordering surprise arrives
         # with the evidence attached (empty unless --witness /

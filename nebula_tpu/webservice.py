@@ -7,36 +7,60 @@ custom handlers a daemon registers (the reference's storage admin/
 download/ingest endpoints hang off the same seam, WebService.h:31-49).
 
 Observability surface (docs/manual/10-observability.md): every daemon
-serves `/metrics` (Prometheus text exposition of the StatsManager plus
-any registered metric sources), and daemons that opt in via
-`register_observability` serve `/traces` (the finished-trace ring:
-list/filter/get-by-id, plus the ?arm=N X-Trace force knob) and
-`/queries` (active-query registry + slow-query log).
+serves `/metrics` (OpenMetrics text exposition of the StatsManager —
+native histograms with trace exemplars included — plus any registered
+metric sources, the process-global flight-recorder/SLO gauges, a
+`nebula_build_info` join-key gauge and process uptime), `/flight`
+(the flight recorder's event ring, trigger states and captured
+bundles) and `/slo` (declarative objectives + multi-window burn
+rates). Daemons that opt in via `register_observability` additionally
+serve `/traces` (the finished-trace ring: list/filter/get-by-id, plus
+the ?arm=N X-Trace force knob) and `/queries` (active-query registry
++ slow-query log).
 
 Implemented over http.server (stdlib) on a daemon thread; handlers are
 plain callables `(query_params, body) -> (code, obj)`. A handler that
-returns `bytes` is served verbatim as text/plain (the Prometheus
-exposition format); anything else is JSON-encoded.
+returns `bytes` is served verbatim as text/plain; a `(bytes, ctype)`
+pair sets the content type (the OpenMetrics exposition); anything
+else is JSON-encoded.
 """
 from __future__ import annotations
 
 import json
+import sys
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Callable, Dict, List, Optional, Tuple
 from urllib.parse import parse_qs, urlparse
 
 from .common.flags import FlagRegistry
 from .common.stats import StatsManager
+# eager, not lazy: importing these DECLARES their graph_flags
+# (slo_plan, flight_*) at daemon boot — a lazy handler-time import
+# would make `PUT /flags slo_plan=...` on a fresh daemon silently
+# fail (FlagRegistry.set returns False for undeclared names) until
+# the first /slo or /metrics request happened to land
+from .common import flight as _flight_mod
+from .common import slo as _slo_mod
 
 Handler = Callable[[Dict[str, str], bytes], Tuple[int, Any]]
+
+OPENMETRICS_CTYPE = \
+    "application/openmetrics-text; version=1.0.0; charset=utf-8"
+
+
+def _escape_label(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r'\"') \
+        .replace("\n", r"\n")
 
 
 class WebService:
     def __init__(self, name: str = "daemon",
                  flags: Optional[FlagRegistry] = None,
                  stats: Optional[StatsManager] = None,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 build_labels: Optional[Dict[str, str]] = None):
         self.name = name
         self.flags = flags
         self.stats = stats
@@ -46,11 +70,17 @@ class WebService:
         self._thread: Optional[threading.Thread] = None
         self._host = host
         self._port = port
+        # the fleet-dashboard join key + uptime (satellite: every
+        # daemon's /metrics carries a static build-info gauge)
+        self.build_labels: Dict[str, str] = dict(build_labels or {})
+        self._t_start = time.monotonic()
 
         self.register("/status", self._status_handler)
         self.register("/flags", self._flags_handler)
         self.register("/get_stats", self._stats_handler)
         self.register("/metrics", self._metrics_handler)
+        self.register("/flight", self._flight_handler)
+        self.register("/slo", self._slo_handler)
 
     # ------------------------------------------------------------------
     def register(self, path: str, handler: Handler) -> None:
@@ -83,9 +113,13 @@ class WebService:
                     code, obj = h(params, body)
                 except Exception as e:   # handler bug -> 500
                     code, obj = 500, {"error": str(e)}
-                if isinstance(obj, bytes):
-                    # raw text responses (the Prometheus exposition
-                    # format is line-oriented text, not JSON)
+                if isinstance(obj, tuple) and len(obj) == 2 \
+                        and isinstance(obj[0], bytes):
+                    # (payload, content-type) — the OpenMetrics
+                    # exposition declares its own media type
+                    data, ctype = obj
+                elif isinstance(obj, bytes):
+                    # raw text responses (line-oriented text, not JSON)
                     data, ctype = obj, "text/plain; version=0.0.4"
                 else:
                     data, ctype = json.dumps(obj).encode(), \
@@ -165,14 +199,43 @@ class WebService:
         return 200, out
 
     def _metrics_handler(self, params, body) -> Tuple[int, Any]:
-        """Prometheus text exposition: StatsManager windows (# TYPE
-        annotated counters/gauges per metric kind) + every registered
-        metric source rendered as gauges with stable names."""
+        """OpenMetrics text exposition: StatsManager families (# TYPE
+        annotated per metric kind, histograms with exemplars) + the
+        build-info/uptime gauges + the process-global flight/SLO
+        gauges + every registered metric source rendered as gauges
+        with stable names, `# EOF`-terminated. Family names are
+        deduplicated (first writer wins — a source gauge whose name
+        collides with a StatsManager family is skipped: its value
+        already scrapes as that family's `_total` twin)."""
         from .common.stats import _prom_name, _prom_num
         lines: List[str] = []
+        seen: set = set()
         if self.stats is not None:
-            lines.extend(self.stats.prometheus_lines())
-        for src in self._metric_sources:
+            stat_lines = self.stats.prometheus_lines()
+            lines.extend(stat_lines)
+            for ln in stat_lines:
+                if ln.startswith("# TYPE "):
+                    seen.add(ln.split(" ", 3)[2])
+        # build info: the standard fleet-dashboard join key (daemon
+        # role + versions + runtime backend), plus process uptime
+        labels = {"daemon": self.name, "version": _build_version(),
+                  "python": "%d.%d" % sys.version_info[:2],
+                  "jax_backend": _jax_backend()}
+        labels.update(self.build_labels)
+        lbl = ",".join(f'{k}="{_escape_label(v)}"'
+                       for k, v in sorted(labels.items()))
+        lines.append("# TYPE nebula_build_info gauge")
+        lines.append(f"nebula_build_info{{{lbl}}} 1")
+        lines.append("# TYPE nebula_process_uptime_seconds gauge")
+        lines.append(f"nebula_process_uptime_seconds "
+                     f"{time.monotonic() - self._t_start:.3f}")
+        seen.update(("nebula_build_info",
+                     "nebula_process_uptime_seconds"))
+        # gauge sources: flight-recorder + SLO burn rates (process-
+        # global, every daemon) then the daemon's registered sources
+        sources: List[Callable[[], Dict[str, Any]]] = \
+            [_flight_gauges, _slo_gauges] + list(self._metric_sources)
+        for src in sources:
             try:
                 extra = src()
             except Exception:
@@ -182,9 +245,68 @@ class WebService:
                 if not isinstance(v, (int, float)) or isinstance(v, bool):
                     continue
                 mn = _prom_name("nebula", name)
+                if mn in seen:
+                    continue
+                seen.add(mn)
                 lines.append(f"# TYPE {mn} gauge")
                 lines.append(f"{mn} {_prom_num(v)}")
-        return 200, ("\n".join(lines) + "\n").encode()
+        lines.append("# EOF")
+        return 200, (("\n".join(lines) + "\n").encode(),
+                     OPENMETRICS_CTYPE)
+
+    # ------------------------------------------------------------------
+    # flight recorder + SLO surfaces (process-global, every daemon —
+    # docs/manual/10-observability.md)
+    # ------------------------------------------------------------------
+    def _flight_handler(self, params, body) -> Tuple[int, Any]:
+        """/flight: GET = event ring + trigger states + bundle
+        summaries (?limit=N); ?bundle=<id> = one full bundle;
+        ?fire=<rule> = manual trigger (ops knob; 409 while the rule
+        is cooling down — never a stale bundle passed off as fresh)."""
+        recorder = _flight_mod.recorder
+        if "bundle" in params:
+            try:
+                b = recorder.get_bundle(int(params["bundle"]))
+            except ValueError:
+                return 400, {"error": "bundle must be an integer id"}
+            if b is None:
+                return 404, {"error": f"no bundle {params['bundle']!r} "
+                                      f"in memory"}
+            return 200, b
+        if "fire" in params:
+            b, known = recorder.trigger(params["fire"])
+            if not known:
+                return 404, {"error": f"unknown trigger rule "
+                                      f"{params['fire']!r}"}
+            if b is None:
+                return 409, {"error": f"rule {params['fire']!r} is "
+                                      f"cooling down "
+                                      f"(flight_cooldown_s)"}
+            return 200, {"fired": params["fire"], "bundle_id": b["id"]}
+        try:
+            limit = int(params.get("limit", 100))
+        except ValueError:
+            return 400, {"error": "limit must be an integer"}
+        return 200, recorder.describe(limit=limit)
+
+    def _slo_handler(self, params, body) -> Tuple[int, Any]:
+        """/slo: GET = objectives + multi-window burn rates; PUT body
+        `plan=<grammar>` installs a plan (400 keeps the previous one);
+        ?clear=1 disarms."""
+        engine = _slo_mod.engine
+        if body:
+            fields = {k: v[0] for k, v in
+                      parse_qs(body.decode(),
+                               keep_blank_values=True).items()}
+            if "plan" not in fields:
+                return 400, {"error": "body must carry plan=<spec>"}
+            try:
+                engine.set_plan(fields["plan"])
+            except ValueError as e:
+                return 400, {"error": str(e)}
+        elif params.get("clear"):
+            engine.clear()
+        return 200, engine.describe()
 
     # ------------------------------------------------------------------
     # tracing + query-visibility endpoints (opt-in per daemon)
@@ -243,3 +365,32 @@ class WebService:
 
         self.register("/traces", traces_handler)
         self.register("/queries", queries_handler)
+
+
+def _build_version() -> str:
+    try:
+        from . import __version__
+        return __version__
+    except Exception:
+        return "unknown"
+
+
+def _jax_backend() -> str:
+    """Backend label WITHOUT importing (let alone initializing) jax in
+    daemons that never use it — metad's scrape must not drag a second
+    XLA runtime up."""
+    jx = sys.modules.get("jax")
+    if jx is None:
+        return "none"
+    try:
+        return str(jx.default_backend())
+    except Exception:
+        return "error"
+
+
+def _flight_gauges() -> Dict[str, float]:
+    return _flight_mod.recorder.gauges()
+
+
+def _slo_gauges() -> Dict[str, float]:
+    return _slo_mod.engine.gauges()

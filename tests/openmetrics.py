@@ -1,0 +1,312 @@
+"""Strict OpenMetrics text-format parser (ISSUE 10 satellite).
+
+Validates the exposition every daemon serves at /metrics
+(docs/manual/10-observability.md): line grammar, family TYPE
+declarations ahead of (and contiguous with) their samples, the
+counter `_total` naming contract, histogram bucket monotonicity and
+`_count`/+Inf consistency, exemplar placement, duplicate-series
+detection and the trailing `# EOF`. Deliberately a PARSER, not a
+regex sieve — a malformed line raises with its line number, so a
+conformance regression in any exposition source fails tier-1 with
+the exact offending line.
+
+Not a general-purpose client: it accepts exactly the subset the
+repo's daemons emit (counter/gauge/histogram families, optional HELP/
+UNIT, exemplars on counter `_total` and histogram `_bucket` samples)
+and errors on everything else, which is the point.
+"""
+from __future__ import annotations
+
+import math
+import re
+from typing import Dict, List, Optional, Tuple
+
+_NAME_RE = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
+_LABEL_NAME_RE = re.compile(r"[a-zA-Z_][a-zA-Z0-9_]*$")
+
+# sample-name suffixes a family's samples may carry, per metric type
+_SUFFIXES = {
+    "counter": ("_total", "_created"),
+    "gauge": ("",),
+    "histogram": ("_bucket", "_sum", "_count", "_created"),
+}
+# suffixes allowed to carry exemplars
+_EXEMPLAR_OK = {("counter", "_total"), ("histogram", "_bucket")}
+
+
+class OpenMetricsError(ValueError):
+    def __init__(self, lineno: int, msg: str, line: str = ""):
+        self.lineno = lineno
+        super().__init__(f"line {lineno}: {msg}"
+                         + (f"  [{line!r}]" if line else ""))
+
+
+class Sample:
+    __slots__ = ("name", "labels", "value", "exemplar")
+
+    def __init__(self, name: str, labels: Dict[str, str], value: float,
+                 exemplar: Optional[Tuple[Dict[str, str], float]]):
+        self.name = name
+        self.labels = labels
+        self.value = value
+        self.exemplar = exemplar
+
+
+class Family:
+    __slots__ = ("name", "type", "samples")
+
+    def __init__(self, name: str, type_: str):
+        self.name = name
+        self.type = type_
+        self.samples: List[Sample] = []
+
+
+def _parse_labels(s: str, lineno: int, line: str
+                  ) -> Tuple[Dict[str, str], int]:
+    """Parse `{k="v",...}` starting at s[0] == '{'; returns (labels,
+    index one past the closing brace)."""
+    assert s[0] == "{"
+    labels: Dict[str, str] = {}
+    i = 1
+    while i < len(s):
+        if s[i] == "}":
+            return labels, i + 1
+        m = re.match(r"([a-zA-Z_][a-zA-Z0-9_]*)=\"", s[i:])
+        if not m:
+            raise OpenMetricsError(lineno, "bad label syntax", line)
+        key = m.group(1)
+        i += m.end()
+        val = []
+        while i < len(s) and s[i] != '"':
+            if s[i] == "\\":
+                if i + 1 >= len(s):
+                    raise OpenMetricsError(lineno, "dangling escape",
+                                           line)
+                esc = s[i + 1]
+                if esc not in ('"', "\\", "n"):
+                    raise OpenMetricsError(
+                        lineno, f"bad escape \\{esc}", line)
+                val.append("\n" if esc == "n" else esc)
+                i += 2
+            else:
+                val.append(s[i])
+                i += 1
+        if i >= len(s):
+            raise OpenMetricsError(lineno, "unterminated label value",
+                                   line)
+        i += 1   # closing quote
+        if key in labels:
+            raise OpenMetricsError(lineno,
+                                   f"duplicate label {key!r}", line)
+        labels[key] = "".join(val)
+        if i < len(s) and s[i] == ",":
+            i += 1
+    raise OpenMetricsError(lineno, "unterminated label set", line)
+
+
+def _parse_number(tok: str, lineno: int, line: str) -> float:
+    if tok in ("+Inf", "Inf"):
+        return math.inf
+    if tok == "-Inf":
+        return -math.inf
+    try:
+        return float(tok)
+    except ValueError:
+        raise OpenMetricsError(lineno, f"bad number {tok!r}", line)
+
+
+def _parse_sample(line: str, lineno: int) -> Sample:
+    # name[{labels}] value [timestamp] [# {exemplar-labels} value [ts]]
+    m = re.match(r"([a-zA-Z_:][a-zA-Z0-9_:]*)", line)
+    if not m:
+        raise OpenMetricsError(lineno, "bad sample name", line)
+    name = m.group(1)
+    rest = line[m.end():]
+    labels: Dict[str, str] = {}
+    if rest.startswith("{"):
+        labels, used = _parse_labels(rest, lineno, line)
+        rest = rest[used:]
+    if not rest.startswith(" "):
+        raise OpenMetricsError(lineno, "expected space before value",
+                               line)
+    rest = rest[1:]
+    exemplar: Optional[Tuple[Dict[str, str], float]] = None
+    ex_part = None
+    if " # " in rest:
+        rest, _, ex_part = rest.partition(" # ")
+    toks = rest.split(" ")
+    if not toks or not toks[0]:
+        raise OpenMetricsError(lineno, "missing sample value", line)
+    value = _parse_number(toks[0], lineno, line)
+    if len(toks) == 2:
+        _parse_number(toks[1], lineno, line)   # optional timestamp
+    elif len(toks) > 2:
+        raise OpenMetricsError(lineno, "trailing junk after value",
+                               line)
+    if ex_part is not None:
+        if not ex_part.startswith("{"):
+            raise OpenMetricsError(lineno, "exemplar must start with "
+                                           "a label set", line)
+        ex_labels, used = _parse_labels(ex_part, lineno, line)
+        ex_rest = ex_part[used:].strip()
+        ex_toks = ex_rest.split(" ") if ex_rest else []
+        if not ex_toks:
+            raise OpenMetricsError(lineno, "exemplar missing value",
+                                   line)
+        ex_value = _parse_number(ex_toks[0], lineno, line)
+        if len(ex_toks) == 2:
+            _parse_number(ex_toks[1], lineno, line)
+        elif len(ex_toks) > 2:
+            raise OpenMetricsError(lineno, "trailing junk after "
+                                           "exemplar", line)
+        ex_len = sum(len(k) + len(v) for k, v in ex_labels.items())
+        if ex_len > 128:
+            raise OpenMetricsError(lineno, "exemplar label set over "
+                                           "128 chars", line)
+        exemplar = (ex_labels, ex_value)
+    return Sample(name, labels, value, exemplar)
+
+
+def _family_of(name: str, fam: Optional[Family]) -> Optional[str]:
+    """Which suffix ties `name` to the current family (None = not this
+    family's sample)."""
+    if fam is None:
+        return None
+    for suffix in _SUFFIXES[fam.type]:
+        if name == fam.name + suffix:
+            return suffix
+    return None
+
+
+def parse(text: str) -> Dict[str, Family]:
+    """Strictly parse one OpenMetrics exposition; returns families by
+    name. Raises OpenMetricsError on the first violation."""
+    lines = text.split("\n")
+    if lines and lines[-1] == "":
+        lines.pop()
+    if not lines or lines[-1] != "# EOF":
+        raise OpenMetricsError(len(lines), "missing trailing # EOF")
+    families: Dict[str, Family] = {}
+    series_seen: set = set()
+    current: Optional[Family] = None
+    for idx, line in enumerate(lines):
+        lineno = idx + 1
+        if line == "# EOF":
+            if idx != len(lines) - 1:
+                raise OpenMetricsError(lineno, "content after # EOF")
+            break
+        if not line:
+            raise OpenMetricsError(lineno, "blank line")
+        if line != line.strip():
+            raise OpenMetricsError(lineno,
+                                   "leading/trailing whitespace", line)
+        if line.startswith("#"):
+            toks = line.split(" ")
+            kind = toks[1] if len(toks) > 1 else ""
+            if kind == "TYPE":
+                if len(toks) != 4:
+                    raise OpenMetricsError(lineno, "bad TYPE line",
+                                           line)
+                _, _, name, type_ = toks
+                if not _NAME_RE.match(name):
+                    raise OpenMetricsError(lineno,
+                                           f"bad family name {name!r}",
+                                           line)
+                if type_ not in _SUFFIXES:
+                    raise OpenMetricsError(
+                        lineno, f"unsupported family type {type_!r}",
+                        line)
+                if name in families:
+                    raise OpenMetricsError(
+                        lineno, f"duplicate family {name!r}", line)
+                current = families[name] = Family(name, type_)
+            elif kind in ("HELP", "UNIT") and len(toks) >= 3:
+                pass
+            else:
+                raise OpenMetricsError(lineno, "unknown comment form",
+                                       line)
+            continue
+        sample = _parse_sample(line, lineno)
+        suffix = _family_of(sample.name, current)
+        if suffix is None:
+            # strict: every sample belongs to the family declared
+            # immediately above it — no interleaving, no orphans
+            raise OpenMetricsError(
+                lineno,
+                f"sample {sample.name!r} outside its family "
+                f"(current: {current.name if current else None!r}) — "
+                f"missing/misplaced TYPE, or a counter named without "
+                f"_total", line)
+        if sample.exemplar is not None and \
+                (current.type, suffix) not in _EXEMPLAR_OK:
+            raise OpenMetricsError(
+                lineno, f"exemplar not allowed on {current.type} "
+                        f"sample {sample.name!r}", line)
+        series_key = (sample.name,
+                      tuple(sorted(sample.labels.items())))
+        if series_key in series_seen:
+            raise OpenMetricsError(
+                lineno, f"duplicate series {sample.name!r} "
+                        f"{sample.labels!r}", line)
+        series_seen.add(series_key)
+        current.samples.append(sample)
+    _validate_families(families)
+    return families
+
+
+def _validate_families(families: Dict[str, Family]) -> None:
+    for fam in families.values():
+        names = [s.name for s in fam.samples]
+        if fam.type == "counter":
+            if not any(n == fam.name + "_total" for n in names):
+                raise OpenMetricsError(
+                    0, f"counter family {fam.name!r} has no _total "
+                       f"sample")
+        elif fam.type == "gauge":
+            if not names:
+                raise OpenMetricsError(
+                    0, f"gauge family {fam.name!r} has no sample")
+        elif fam.type == "histogram":
+            buckets = [s for s in fam.samples
+                       if s.name == fam.name + "_bucket"]
+            if not buckets:
+                raise OpenMetricsError(
+                    0, f"histogram {fam.name!r} has no buckets")
+            les = []
+            for b in buckets:
+                if "le" not in b.labels:
+                    raise OpenMetricsError(
+                        0, f"histogram {fam.name!r} bucket without "
+                           f"le label")
+                les.append(math.inf if b.labels["le"] == "+Inf"
+                           else float(b.labels["le"]))
+            if les != sorted(les) or les[-1] != math.inf:
+                raise OpenMetricsError(
+                    0, f"histogram {fam.name!r} buckets not ascending "
+                       f"/ missing +Inf")
+            counts = [b.value for b in buckets]
+            if counts != sorted(counts):
+                raise OpenMetricsError(
+                    0, f"histogram {fam.name!r} bucket counts not "
+                       f"cumulative")
+            count = [s for s in fam.samples
+                     if s.name == fam.name + "_count"]
+            if not count or count[0].value != counts[-1]:
+                raise OpenMetricsError(
+                    0, f"histogram {fam.name!r} _count != +Inf bucket")
+            if not any(s.name == fam.name + "_sum"
+                       for s in fam.samples):
+                raise OpenMetricsError(
+                    0, f"histogram {fam.name!r} missing _sum")
+
+
+def exemplar_trace_ids(families: Dict[str, Family]) -> Dict[str, str]:
+    """{trace_id: family name} for every exemplar in the exposition —
+    the metric -> trace join the flight-recorder acceptance check
+    correlates on (bench.py --chaos)."""
+    out: Dict[str, str] = {}
+    for fam in families.values():
+        for s in fam.samples:
+            if s.exemplar and "trace_id" in s.exemplar[0]:
+                out[s.exemplar[0]["trace_id"]] = fam.name
+    return out

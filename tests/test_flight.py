@@ -1,0 +1,210 @@
+"""Flight recorder (ISSUE 10 tentpole): lock-free event ring, trigger
+rules, bundle capture + atomic disk dump, aftermath sampling arm, and
+the engine-site integration (a breaker trip records AND triggers)."""
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from nebula_tpu.common.flags import graph_flags
+from nebula_tpu.common.flight import (AFTERMATH_EVENTS, FlightRecorder,
+                                      recorder as global_recorder)
+from nebula_tpu.common.tracing import tracer
+
+
+@pytest.fixture
+def rec():
+    r = FlightRecorder(ring_size=64)
+    yield r
+
+
+@pytest.fixture(autouse=True)
+def _isolate():
+    """Tests that touch the process-global recorder/tracer/flags leave
+    them as found."""
+    arm0 = tracer.armed()
+    yield
+    global_recorder.reset()
+    tracer.arm(arm0)
+    graph_flags.set("flight_cooldown_s", 30)
+    graph_flags.set("flight_dir", "")
+
+
+def test_ring_is_bounded_and_events_structured(rec):
+    for i in range(200):
+        rec.record("shed", reason="queue_depth", lane="bulk", space=i)
+    d = rec.describe(limit=10)
+    assert d["ring"] == 64            # bounded
+    assert d["event_count"] == 200    # lifetime
+    ev = d["events"][0]               # newest-first
+    assert ev["kind"] == "shed" and ev["space"] == 199
+    assert ev["ts"] > 0 and ev["seq"] == 200
+
+
+def test_record_captures_live_trace_id(rec):
+    h = tracer.begin("q", force=True)
+    try:
+        ev = rec.record("deadline_balk", where="kernel")
+        assert ev["trace_id"] == h.trace_id
+    finally:
+        h.finish()
+    # unsampled: no trace_id key
+    assert "trace_id" not in rec.record("deadline_balk", where="x")
+
+
+def test_immediate_trigger_captures_bundle_and_arms_sampling(rec):
+    rec.add_collector("test.state", lambda: {"answer": 42})
+    tracer.arm(0)
+    rec.record("noise", x=1)
+    rec.record("breaker_trip", feature="go")
+    # the skeleton publishes synchronously...
+    assert len(rec.bundles) == 1
+    b = rec.bundles[-1]
+    assert b["trigger"] == "breaker_open"
+    assert b["event"]["feature"] == "go"
+    # the ring AT fire time rode along
+    assert [e["kind"] for e in b["events"]] == ["noise", "breaker_trip"]
+    # ...enrichment (collectors/stats/traces) lands on the capture
+    # thread — flush before reading it
+    assert rec.flush(5.0)
+    assert b["collectors"]["test.state"] == {"answer": 42}
+    assert "stats" in b and "traces" in b
+    # aftermath sampling armed for the next N queries
+    assert tracer.armed() == int(graph_flags.get("flight_arm_samples"))
+
+
+def test_cooldown_one_bundle_per_storm(rec):
+    for _ in range(5):
+        rec.record("breaker_trip", feature="go")
+    assert len(rec.bundles) == 1
+    rule = [r for r in rec._rules if r.name == "breaker_open"][0]
+    assert rule.fires == 1
+
+
+def test_windowed_rule_needs_threshold_in_window():
+    clock = [1000.0]
+    rec = FlightRecorder(ring_size=64, clock=lambda: clock[0])
+    # 19 denials: under the shed_storm threshold (20 in 5 s)
+    for _ in range(19):
+        rec.record("admission_denied", space="abuser")
+    assert not rec.bundles
+    # the 20th, but 10 s later: the early ones aged out of the window
+    clock[0] += 10.0
+    rec.record("admission_denied", space="abuser")
+    assert not rec.bundles
+    # a real storm: 20 shed/denial events inside the window fire once
+    for _ in range(20):
+        rec.record("shed", reason="wait_p95", lane="bulk", space=1)
+    assert len(rec.bundles) == 1
+    assert rec.bundles[-1]["trigger"] == "shed_storm"
+
+
+def test_aftermath_events_append_and_close(rec):
+    rec.record("breaker_trip", feature="go")
+    b = rec.bundles[-1]
+    for i in range(AFTERMATH_EVENTS + 10):
+        rec.record("device_failure", feature="go", i=i)
+    # exactly the window, then it closed
+    assert len(b["aftermath_events"]) == AFTERMATH_EVENTS
+    assert b["aftermath_events"][0]["i"] == 0
+
+
+def test_atomic_disk_dump_and_redump_after_aftermath(tmp_path, rec):
+    graph_flags.set("flight_dir", str(tmp_path))
+    try:
+        rec.record("snapshot_poisoned", space=7)
+        assert rec.flush(5.0)   # capture thread writes the artifact
+        b = rec.bundles[-1]
+        assert b["path"] and os.path.exists(b["path"])
+        assert not any(p.endswith(".tmp") for p in os.listdir(tmp_path))
+        with open(b["path"]) as f:
+            on_disk = json.load(f)
+        assert on_disk["trigger"] == "snapshot_poison"
+        assert on_disk["aftermath_events"] == []
+        # drain the aftermath window -> the artifact is re-dumped with it
+        for i in range(AFTERMATH_EVENTS):
+            rec.record("device_failure", i=i)
+        assert rec.flush(5.0)   # the close re-dump is async too
+        with open(b["path"]) as f:
+            assert len(json.load(f)["aftermath_events"]) \
+                == AFTERMATH_EVENTS
+    finally:
+        graph_flags.set("flight_dir", "")
+
+
+def test_manual_trigger_and_get_bundle(rec):
+    assert rec.trigger("no_such_rule") == (None, False)
+    b, known = rec.trigger("identity_failure")
+    assert known and b is not None and b["trigger"] == "identity_failure"
+    assert rec.get_bundle(b["id"]) is b
+    assert rec.get_bundle(999) is None
+    # within the cooldown: known rule, no fresh bundle (the endpoint
+    # turns this into a 409, never a stale bundle passed off as new)
+    b2, known = rec.trigger("identity_failure")
+    assert known and b2 is None
+
+
+def test_lock_free_record_under_concurrency(rec):
+    """8 threads hammering record() — no lock on the hot path, no lost
+    ring structure, triggers fire exactly once per cooldown."""
+    stop = threading.Event()
+
+    def worker(k):
+        for i in range(500):
+            rec.record("shed", reason="queue_depth", lane="bulk",
+                       space=k)
+
+    ts = [threading.Thread(target=worker, args=(k,)) for k in range(8)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    stop.set()
+    assert rec.describe()["event_count"] == 4000
+    assert len(rec.bundles) == 1     # one shed_storm, cooldown held
+
+
+def test_engine_breaker_trip_records_and_triggers():
+    """Integration: the degradation ladder's trip site feeds the
+    recorder — the flight loop's designed entry point."""
+    from nebula_tpu.engine_tpu import TpuGraphEngine
+
+    global_recorder.reset()
+    eng = TpuGraphEngine()
+    eng.breaker_threshold = 1
+    try:
+        eng._device_failed("go", RuntimeError("injected boom"))
+        d = global_recorder.describe()
+        kinds = [e["kind"] for e in d["events"]]
+        assert "breaker_trip" in kinds
+        assert len(global_recorder.bundles) == 1
+        assert global_recorder.bundles[-1]["trigger"] == "breaker_open"
+        # recovery is an event too (no trigger): force the half-open
+        # window open, probe, succeed
+        eng._breaker("go")._next_probe = 0.0
+        assert eng._breaker("go").allow()
+        eng._device_ok("go")
+        kinds = [e["kind"]
+                 for e in global_recorder.describe()["events"]]
+        assert "breaker_recovered" in kinds
+    finally:
+        global_recorder.reset()
+
+
+def test_qos_admission_denial_records_event():
+    from nebula_tpu.common.qos import admission
+
+    global_recorder.reset()
+    admission.set_plan("fr_space:rate=0")
+    try:
+        ok, retry_ms, _ = admission.admit("fr_space")
+        assert not ok
+        evs = global_recorder.describe()["events"]
+        assert evs[0]["kind"] == "admission_denied"
+        assert evs[0]["space"] == "fr_space"
+        assert evs[0]["retry_after_ms"] == retry_ms
+    finally:
+        admission.clear()
+        global_recorder.reset()

@@ -202,8 +202,26 @@ def test_nl004_consistent_sites_clean(tmp_path):
         def f():
             stats.add_value("n", 1, kind="counter")
             stats.add_value("n", 3, kind="counter")
+            stats.add_value("lat", 12.5, kind="histogram")
+            stats.add_value("lat", 7.5, kind="histogram")
     """}, ["NL004"])
     assert fs == []
+
+
+def test_nl004_histogram_kind_known_and_misuse_flagged(tmp_path):
+    # histogram is a REAL kind (PR 10); histogram-on-counter is the
+    # cross-site conflict; a typo'd kind registers untagged — flagged
+    fs, _ = lint(tmp_path, {"nebula_tpu/m.py": """
+        def f():
+            stats.add_value("evt", 1, kind="counter")
+            stats.add_value("evt", 33.0, kind="histogram")
+            stats.add_value("typo", 1, kind="histograms")
+    """}, ["NL004"])
+    assert len(fs) == 2
+    conflict = [f for f in fs if "evt" in f.message]
+    typo = [f for f in fs if "typo" in f.message]
+    assert len(conflict) == 1 and "'histogram'" in conflict[0].message
+    assert len(typo) == 1 and "unknown kind" in typo[0].message
 
 
 # ---------------------------------------------------------------- NL005

@@ -1,0 +1,202 @@
+"""/metrics format conformance (ISSUE 10 satellite).
+
+The strict OpenMetrics parser (tests/openmetrics.py) first proves
+itself on crafted good/bad documents, then scrapes the REAL
+graphd/storaged/metad /metrics handlers and fails on any malformed
+line, duplicate series/family, counter named without `_total`,
+non-cumulative histogram, misplaced exemplar or missing `# EOF` —
+today's answer to "nothing validates exposition output"."""
+import json as _json
+import time
+import urllib.request
+
+import pytest
+
+from openmetrics import (OpenMetricsError, exemplar_trace_ids, parse)
+
+GOOD = """\
+# TYPE acme_requests counter
+acme_requests_total 5 # {trace_id="deadbeef"} 1.5 1700000000.000
+# TYPE acme_lat histogram
+acme_lat_bucket{le="1"} 1 # {trace_id="cafe"} 0.5
+acme_lat_bucket{le="10"} 3
+acme_lat_bucket{le="+Inf"} 4
+acme_lat_sum 22.5
+acme_lat_count 4
+# TYPE acme_up gauge
+acme_up 1
+# TYPE acme_info gauge
+acme_info{version="1.0",name="a \\"quoted\\" x"} 1
+# EOF
+"""
+
+
+def test_parser_accepts_conformant_document():
+    fams = parse(GOOD)
+    assert fams["acme_requests"].type == "counter"
+    assert fams["acme_lat"].type == "histogram"
+    assert fams["acme_info"].samples[0].labels["name"] == 'a "quoted" x'
+    ex = exemplar_trace_ids(fams)
+    assert ex == {"deadbeef": "acme_requests", "cafe": "acme_lat"}
+
+
+@pytest.mark.parametrize("mutate,needle", [
+    # counter sample without the _total suffix
+    (lambda t: t.replace("acme_requests_total 5", "acme_requests 5"),
+     "outside its family"),
+    # duplicate series
+    (lambda t: t.replace("acme_up 1", "acme_up 1\nacme_up 2"),
+     "duplicate series"),
+    # duplicate family declaration
+    (lambda t: t.replace("# TYPE acme_up gauge",
+                         "# TYPE acme_up gauge\n# TYPE acme_up gauge"),
+     "duplicate family"),
+    # missing EOF
+    (lambda t: t.replace("# EOF\n", ""), "EOF"),
+    # EOF in the middle of the document
+    (lambda t: t.replace("# TYPE acme_up gauge",
+                         "# EOF\n# TYPE acme_up gauge"),
+     "after # EOF"),
+    # malformed line
+    (lambda t: t.replace("acme_up 1", "acme_up"), "space before value"),
+    # bad number
+    (lambda t: t.replace("acme_up 1", "acme_up one"), "bad number"),
+    # non-cumulative histogram buckets
+    (lambda t: t.replace('acme_lat_bucket{le="10"} 3',
+                         'acme_lat_bucket{le="10"} 0'),
+     "not cumulative"),
+    # _count disagreeing with +Inf
+    (lambda t: t.replace("acme_lat_count 4", "acme_lat_count 9"),
+     "_count != +Inf"),
+    # histogram bucket ordering
+    (lambda t: t.replace('le="1"', 'le="50"'), "not ascending"),
+    # exemplar on a gauge
+    (lambda t: t.replace("acme_up 1",
+                         'acme_up 1 # {trace_id="x"} 1'),
+     "exemplar not allowed"),
+    # orphan sample ahead of any TYPE
+    (lambda t: "orphan 1\n" + t, "outside its family"),
+    # blank line
+    (lambda t: t.replace("# TYPE acme_up gauge",
+                         "\n# TYPE acme_up gauge"), "blank line"),
+    # unknown comment
+    (lambda t: t.replace("# TYPE acme_up gauge",
+                         "# FROB acme_up gauge\n"
+                         "# TYPE acme_up gauge"), "comment form"),
+])
+def test_parser_rejects_violations(mutate, needle):
+    with pytest.raises(OpenMetricsError) as ei:
+        parse(mutate(GOOD))
+    assert needle in str(ei.value)
+
+
+def test_parser_rejects_interleaved_families():
+    bad = ("# TYPE a counter\n"
+           "a_total 1\n"
+           "# TYPE b counter\n"
+           "b_total 1\n"
+           "a_total 2\n"
+           "# EOF\n")
+    with pytest.raises(OpenMetricsError) as ei:
+        parse(bad)
+    # the stray sample is both an interleave AND a would-be duplicate;
+    # strict association catches it first
+    assert "outside its family" in str(ei.value)
+
+
+# --------------------------------------------------------------------------
+# the real thing: scrape every daemon's handler
+# --------------------------------------------------------------------------
+
+def _scrape(port):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics") as r:
+        assert "openmetrics-text" in r.headers.get("Content-Type", "")
+        return r.read().decode()
+
+
+def test_three_daemon_metrics_conformance():
+    """Boot metad + storaged + graphd(--tpu), push traffic through
+    every layer (device serves, storage scans, a PROFILE'd query so
+    at least one histogram carries an exemplar), then strictly parse
+    all three expositions."""
+    from nebula_tpu.client import GraphClient
+    from nebula_tpu.daemons import (serve_graphd, serve_metad,
+                                    serve_storaged)
+    from nebula_tpu.engine_tpu import TpuGraphEngine
+
+    metad = serve_metad(ws_port=0)
+    storaged = serve_storaged(metad.addr, load_interval=0.1, ws_port=0)
+    tpu = TpuGraphEngine()
+    graphd = serve_graphd(metad.addr, tpu_engine=tpu, ws_port=0)
+    try:
+        gc = GraphClient(graphd.addr).connect()
+        for s in ("CREATE SPACE om(partition_num=2)", "USE om",
+                  "CREATE TAG t(x int)", "CREATE EDGE e(w int)",
+                  "INSERT VERTEX t(x) VALUES 1:(5), 2:(6), 3:(7)",
+                  "INSERT EDGE e(w) VALUES 1 -> 2:(3), 2 -> 3:(4)"):
+            r = gc.execute(s)
+            assert r.ok(), (s, r.error_msg)
+        q = "GO 2 STEPS FROM 1 OVER e YIELD e.w AS w"
+        for _ in range(20):
+            if gc.execute(q).rows:
+                break
+            time.sleep(0.05)
+        r = gc.execute("PROFILE " + q)   # sampled -> exemplar source
+        assert r.ok(), r.error_msg
+
+        for port, daemon in ((graphd.ws_port, "graphd"),
+                             (storaged.ws_port, "storaged"),
+                             (metad.ws_port, "metad")):
+            text = _scrape(port)
+            fams = parse(text)   # raises with the offending line
+            # the fleet join key + uptime on every daemon
+            info = fams["nebula_build_info"].samples[0]
+            assert info.labels["daemon"] == daemon
+            assert "version" in info.labels
+            assert "jax_backend" in info.labels
+            up = fams["nebula_process_uptime_seconds"].samples[0]
+            assert up.value >= 0
+        # graphd: the migrated hot-path histograms are real histograms
+        gtext = _scrape(graphd.ws_port)
+        gfams = parse(gtext)
+        for h in ("nebula_graph_query_latency_us",
+                  "nebula_tpu_engine_dispatcher_wait_us",
+                  "nebula_tpu_engine_kernel_us",
+                  "nebula_tpu_engine_materialize_us"):
+            assert gfams[h].type == "histogram", h
+            count = [s for s in gfams[h].samples
+                     if s.name == h + "_count"][0]
+            assert count.value > 0, h
+        # the PROFILE'd query left at least one trace exemplar
+        assert exemplar_trace_ids(gfams), \
+            "no exemplar on any graphd histogram after PROFILE"
+        # per-tenant latency slice exists for the session's space
+        assert gfams["nebula_graph_space_om_latency_us"].type \
+            == "histogram"
+    finally:
+        graphd.stop()
+        storaged.stop()
+        metad.stop()
+
+
+def test_flight_and_slo_endpoints_serve_on_every_daemon():
+    """/flight and /slo are WebService built-ins: every daemon serves
+    them (the recorder/engine are process-global, like the tracer)."""
+    from nebula_tpu.daemons import serve_metad
+
+    metad = serve_metad(ws_port=0)
+    try:
+        def get(path):
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{metad.ws_port}{path}") as r:
+                return _json.loads(r.read()), r.status
+
+        body, st = get("/flight")
+        assert st == 200 and "triggers" in body and "events" in body
+        assert any(t["name"] == "breaker_open"
+                   for t in body["triggers"])
+        body, st = get("/slo")
+        assert st == 200 and "objectives" in body
+    finally:
+        metad.stop()

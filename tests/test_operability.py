@@ -604,11 +604,21 @@ def test_observability_endpoints_3daemon():
             body, st = http(port, "/metrics")
             assert st == 200 and isinstance(body, bytes)
         text = http(graphd.ws_port, "/metrics")[0].decode()
-        assert "# TYPE nebula_graph_query_total counter" in text
+        # OpenMetrics family declaration: TYPE names the BASE, the
+        # counter sample carries the _total suffix
+        assert "# TYPE nebula_graph_query counter" in text
+        assert "nebula_graph_query_total" in text
         assert "nebula_tpu_engine_go_served" in text
-        # counters don't emit meaningless percentiles; timings do
+        # counters don't emit meaningless percentiles; histograms
+        # expose native bucket series + window gauges
         assert "nebula_graph_query_p95_60s" not in text
+        assert "# TYPE nebula_graph_query_latency_us histogram" in text
+        assert 'nebula_graph_query_latency_us_bucket{le="+Inf"}' in text
         assert "nebula_graph_query_latency_us_p95_60s" in text
+        # the fleet join key + uptime gauge ride every daemon's scrape
+        assert 'nebula_build_info{daemon="graphd"' in text
+        assert "nebula_process_uptime_seconds" in text
+        assert text.rstrip().endswith("# EOF")
         stext = http(storaged.ws_port, "/metrics")[0].decode()
         # the snapshot sync hit the storage processors (get_bound only
         # fires on the CPU fan-out path, which the engine avoided)

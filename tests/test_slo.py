@@ -1,0 +1,192 @@
+"""SLO burn-rate engine (ISSUE 10 tentpole): plan grammar, latency/
+availability burn math over the native histograms, the multi-window
+breach guard, the breach -> flight-recorder loop, gauges and the /slo
+surface."""
+import time
+
+import pytest
+
+from nebula_tpu.common.flags import graph_flags
+from nebula_tpu.common.flight import FlightRecorder
+from nebula_tpu.common.slo import (DEFAULT_BURN_THRESHOLD, SloEngine,
+                                   parse_plan)
+from nebula_tpu.common.stats import StatsManager
+
+
+# ------------------------------------------------------------- grammar
+
+def test_plan_grammar_parses_both_kinds():
+    objs = parse_plan(
+        "lat:kind=latency,metric=graph.query_latency_us,le_ms=50,"
+        "target=0.99;"
+        "avail:kind=availability,good=graph.qos.admitted.t1,"
+        "bad=graph.qos.denied.t1,target=0.9,burn=2")
+    assert [o.name for o in objs] == ["lat", "avail"]
+    assert objs[0].kind == "latency" and objs[0].le_us == 50_000
+    assert objs[0].burn_threshold == DEFAULT_BURN_THRESHOLD
+    assert objs[1].kind == "availability" and objs[1].burn_threshold == 2
+    assert abs(objs[1].budget - 0.1) < 1e-9
+
+
+@pytest.mark.parametrize("plan,needle", [
+    ("x:kind=frobnicate,target=0.9", "unknown kind"),
+    ("x:kind=latency,metric=m,le_ms=5", "needs kind= and target="),
+    ("x:kind=latency,target=0.9", "needs metric= and le_ms="),
+    ("x:kind=availability,target=0.9", "needs good= and bad="),
+    ("x:kind=latency,metric=m,le_ms=5,target=1.5", "target must be"),
+    ("x:kind=latency,metric=m,le_ms=5,target=0.9,burn=0", "burn must"),
+    ("x:kind=latency,metric=m,le_ms=5,target=0.9,zap=1", "unknown slo"),
+    ("x kind=latency", "bad slo entry"),
+    ("a:kind=latency,metric=m,le_ms=5,target=0.9;"
+     "a:kind=latency,metric=m,le_ms=5,target=0.9", "duplicate slo"),
+])
+def test_plan_grammar_rejects(plan, needle):
+    with pytest.raises(ValueError) as ei:
+        parse_plan(plan)
+    assert needle in str(ei.value)
+
+
+def test_bad_plan_keeps_previous(slo_quad):
+    eng, _, _, _ = slo_quad
+    eng.set_plan("ok:kind=latency,metric=m,le_ms=5,target=0.9")
+    with pytest.raises(ValueError):
+        eng.set_plan("broken:kind=nope,target=0.9")
+    assert eng.describe()["plan"].startswith("ok:")
+    eng.clear()
+
+
+# ---------------------------------------------------------- evaluation
+
+@pytest.fixture
+def slo_quad():
+    """(engine, stats, clock, flight) with a controllable clock and a
+    private flight recorder — no process-global state touched."""
+    clock = [10_000.0]
+    sm = StatsManager(clock=lambda: clock[0])
+    fr = FlightRecorder(ring_size=32, clock=lambda: clock[0])
+    eng = SloEngine(stats=sm, flight_recorder=fr)
+    yield eng, sm, clock, fr
+    eng.clear()
+
+
+def test_latency_burn_math_and_multiwindow_breach(slo_quad):
+    eng, sm, clock, fr = slo_quad
+    eng.set_plan("lat:kind=latency,metric=lat_us,le_ms=10,target=0.9,"
+                 "burn=5")
+    # 10 samples: 4 slow (40% bad), budget 0.1 -> burn 4.0 < 5
+    for _ in range(6):
+        sm.add_value("lat_us", 1_000.0, kind="histogram")
+    for _ in range(4):
+        sm.add_value("lat_us", 1_000_000.0, kind="histogram")
+    recs = eval_one(eng)
+    assert recs["windows"]["60"]["burn"] == pytest.approx(4.0)
+    assert not recs["breached"] and not fr.bundles
+    # 6 more slow: 10/16 bad -> burn 6.25 >= 5 on BOTH 60s and 600s
+    for _ in range(6):
+        sm.add_value("lat_us", 1_000_000.0, kind="histogram")
+    recs = eval_one(eng)
+    assert recs["breached"] and recs["breaches"] == 1
+    # breach -> flight loop: the slo_burn trigger captured a bundle
+    assert fr.bundles and fr.bundles[-1]["trigger"] == "slo_burn"
+    assert fr.bundles[-1]["event"]["objective"] == "lat"
+    # recovery: the bad samples age out of the 60s window (they stay
+    # inside 600s, so the multi-window guard is what clears first on
+    # the short window -> no longer "both over" -> recovered)
+    clock[0] += 120
+    for _ in range(50):
+        sm.add_value("lat_us", 1_000.0, kind="histogram")
+    recs = eval_one(eng)
+    assert recs["windows"]["60"]["burn"] == 0.0
+    assert not recs["breached"]
+    assert recs["breaches"] == 1      # lifetime count survives
+
+
+def test_availability_burn_over_qos_counters(slo_quad):
+    eng, sm, clock, fr = slo_quad
+    eng.set_plan("t1:kind=availability,good=qos.admitted.t1,"
+                 "bad=qos.denied.t1,target=0.9,burn=2")
+    for _ in range(8):
+        sm.add_value("qos.admitted.t1", kind="counter")
+    for _ in range(2):
+        sm.add_value("qos.denied.t1", kind="counter")
+    # 2/10 bad, budget 0.1 -> burn 2.0 >= 2 on both windows: breach
+    recs = eval_one(eng)
+    assert recs["windows"]["60"]["ratio"] == pytest.approx(0.2)
+    assert recs["breached"]
+    # dilution recovery: good traffic pushes the ratio under budget
+    for _ in range(90):
+        sm.add_value("qos.admitted.t1", kind="counter")
+    recs = eval_one(eng)
+    assert recs["windows"]["60"]["burn"] < 2
+    assert not recs["breached"]
+
+
+def test_empty_metrics_do_not_breach(slo_quad):
+    eng, sm, clock, fr = slo_quad
+    eng.set_plan("lat:kind=latency,metric=never_fed,le_ms=1,"
+                 "target=0.999")
+    recs = eval_one(eng)
+    assert recs["windows"]["60"] == {"bad": 0.0, "total": 0.0,
+                                     "ratio": 0.0, "burn": 0.0}
+    assert not recs["breached"]
+
+
+def test_gauges_shape(slo_quad):
+    eng, sm, clock, fr = slo_quad
+    eng.set_plan("lat:kind=latency,metric=lat_us,le_ms=10,target=0.9")
+    sm.add_value("lat_us", 500.0, kind="histogram")
+    g = eng.gauges()
+    for key in ("slo.lat.burn_60s", "slo.lat.burn_600s",
+                "slo.lat.burn_3600s", "slo.lat.breached",
+                "slo.lat.breaches"):
+        assert key in g
+    assert g["slo.lat.breached"] == 0.0
+
+
+def eval_one(eng):
+    recs = eng.evaluate()
+    assert len(recs) == 1
+    return recs[0]
+
+
+# ------------------------------------------------------- global wiring
+
+def test_slo_plan_flag_watcher_and_bad_plan_counter():
+    from nebula_tpu.common.slo import engine as global_engine
+    from nebula_tpu.common.stats import stats as global_stats
+
+    try:
+        graph_flags.set("slo_plan",
+                        "w:kind=latency,metric=graph.query_latency_us,"
+                        "le_ms=50,target=0.99")
+        assert any(o["name"] == "w"
+                   for o in global_engine.describe()["objectives"])
+        b0 = global_stats.lifetime_total("slo.bad_plan")
+        graph_flags.set("slo_plan", "broken:kind=zap")
+        # rejected: previous plan kept, evidence left
+        assert global_stats.lifetime_total("slo.bad_plan") > b0
+        assert any(o["name"] == "w"
+                   for o in global_engine.describe()["objectives"])
+    finally:
+        graph_flags.set("slo_plan", "")
+        global_engine.clear()
+
+
+def test_slo_endpoint_put_validates_before_mutating():
+    from nebula_tpu.common.slo import engine as global_engine
+    from nebula_tpu.webservice import WebService
+
+    ws = WebService("t")
+    try:
+        code, body = ws._slo_handler(
+            {}, b"plan=e:kind=latency,metric=m,le_ms=5,target=0.9")
+        assert code == 200
+        assert body["objectives"][0]["name"] == "e"
+        code, body = ws._slo_handler({}, b"plan=broken")
+        assert code == 400 and "bad slo entry" in body["error"]
+        # previous plan survived the 400
+        assert global_engine.describe()["plan"].startswith("e:")
+        code, body = ws._slo_handler({"clear": "1"}, b"")
+        assert code == 200 and body["objectives"] == []
+    finally:
+        global_engine.clear()

@@ -152,7 +152,7 @@ def test_stats_kind_aware_snapshot_and_prometheus():
     # prometheus: counters cumulative; timings get window gauges
     lines = sm.prometheus_lines()
     text = "\n".join(lines)
-    assert "# TYPE nebula_reqs_total counter" in text
+    assert "# TYPE nebula_reqs counter" in text
     assert "nebula_reqs_total 2" in text
     assert "nebula_reqs_p95_60s" not in text
     assert "nebula_lat_us_p95_60s" in text
@@ -306,16 +306,26 @@ def test_profile_is_not_a_keyword(small_cluster):
 
 def test_sample_rate_flag_traces_plain_queries(small_cluster):
     cluster, conn, tpu = small_cluster
-    n0 = len(tracer.ring)
+    # a private ring + a drained armed counter make this airtight:
+    # the trace MUST come from rate sampling of THIS query (the
+    # process ring may be full of flight-recorder-armed samples from
+    # earlier tests, and any leftover armed count would also sample)
+    ring0, armed0 = tracer.ring, tracer.armed()
+    tracer.ring = TraceRing(16)
+    tracer.arm(0)
     assert graph_flags.set("trace_sample_rate", 1.0)
     try:
         r = conn.execute("GO FROM 1 OVER knows YIELD knows._dst")
         assert r.ok()
         # sampled by rate, NOT profiled: ring yes, response no
         assert r.trace_spans is None
-        assert len(tracer.ring) > n0
+        traces = tracer.ring.list(limit=4)
+        assert traces, "rate-sampled query left no trace"
+        assert traces[0]["tags"].get("feature") == "go"
     finally:
         graph_flags.set("trace_sample_rate", 0.0)
+        tracer.ring = ring0
+        tracer.arm(armed0)
     assert tracer.sample_rate == 0.0   # flag watcher applied
 
 
