@@ -2221,6 +2221,334 @@ def bench_cluster(out_path: str, trim: bool = False):
             shutil.rmtree(run_dir, ignore_errors=True)
 
 
+def bench_crash(out_path: str, trim: bool = False):
+    """Crash-storm tier (`bench.py --crash`): proof that a `kill -9`
+    against a storaged is a non-event (docs/manual/12-replication.md,
+    "Crash recovery & compaction"). Boots metad + TPU graphd in-process
+    and 3 REPLICATED storaged as real SUBPROCESSES (crashstorm harness
+    over scripts/services.py + serve_storaged, per-node data dirs,
+    aggressive wal compaction flags), then under closed-loop readers +
+    ledger-journaling writers runs a SIGKILL storm where every victim
+    restarts on its OWN data dir:
+
+      cycle 1  SIGKILL the storaged leading the most parts;
+      cycle 2  restart a node with `crashpoint.wal_applied` armed — it
+               aborts itself exactly between WAL append and engine
+               apply, then restarts clean (the recovery window forced,
+               not raced);
+      cycle 3  (full runs) SIGKILL a node, overflow wal_compact_lag so
+               the survivors' compaction truncates the gap, restart
+               with `crashpoint.snapshot_recv` armed — it dies
+               mid-snapshot-install, restarts clean, re-requests and
+               converges.
+
+    FAILS unless every ACKED write is readable after recovery (the
+    client-side durability ledger), zero non-retryable client errors,
+    TPU-vs-CPU byte identity green post-recovery with the device
+    actually serving, each recovery captured >=1 `wal_replay` flight
+    event, replay lengths bounded by wal_compact_lag, and WAL spans
+    bounded by compaction."""
+    import random
+    import shutil
+    import tempfile
+    import threading
+
+    from nebula_tpu.client import GraphClient
+    from nebula_tpu.engine_tpu import TpuGraphEngine
+    from nebula_tpu.tools.crashstorm import (RETRYABLE, CrashTopology,
+                                             LedgerWriters,
+                                             load_person_knows)
+
+    v, e, parts, traffic_s = (240, 1500, 3, 1.5) if trim \
+        else (900, 6000, 4, 3.0)
+    lag = 300
+    space = "crashb"
+    run_dir = tempfile.mkdtemp(prefix="nebula_tpu_crashbench_")
+    seed = int(os.environ.get("BENCH_CRASH_SEED", 23))
+    topo = None
+    try:
+        tpu = TpuGraphEngine()
+        log("crash tier: booting metad + graphd in-proc, 3 storaged "
+            "subprocesses...")
+        topo = CrashTopology(run_dir, n=3,
+                             flag_overrides={"wal_compact_lag": lag},
+                             tpu_engine=tpu)
+        gc = GraphClient(topo.graphd.addr).connect()
+        log(f"crash tier: loading V={v} E={e} parts={parts} rf=3...")
+        srcs, _dsts, _ts = load_person_knows(
+            gc, space, parts, v, e, seed, replica_factor=3,
+            settle_s=30.0)
+        sid = topo.metad.meta.get_space(space).value().space_id
+        hubs = [int(x) for x in
+                np.argsort(np.bincount(srcs, minlength=v))[-3:]]
+        queries = [
+            f"GO 2 STEPS FROM {hubs[0]} OVER knows YIELD knows._dst",
+            f"GO 2 STEPS FROM {hubs[1]} OVER knows "
+            f"WHERE knows.ts > 40000 YIELD knows._dst, knows.ts",
+            f"GO FROM {hubs[0]}, {hubs[2]} OVER knows "
+            f"YIELD knows._dst, knows.ts",
+            f"GO 2 STEPS FROM {hubs[2]} OVER knows YIELD knows.ts "
+            f"AS t | YIELD COUNT(*) AS n, SUM($-.t) AS s",
+        ]
+        for q in queries:         # warm every shape (XLA compile)
+            gc.must(q)
+        topo.wait_leaders(sid, parts)
+
+        # ---- traffic: ledger writers + retry-tolerant readers
+        writers = LedgerWriters(topo.graphd.addr, space, v,
+                                n_writers=2).start()
+        stop = threading.Event()
+        pause = threading.Event()
+        reader_errors: list = []
+        reader_retried = [0]
+        rlock = threading.Lock()
+
+        def reader(k):
+            rr = random.Random(3100 + k)
+            c = GraphClient(topo.graphd.addr).connect()
+            c.must(f"USE {space}")
+            while not stop.is_set():
+                if pause.is_set():
+                    time.sleep(0.02)
+                    continue
+                q = queries[rr.randrange(len(queries))]
+                r = c.execute(q)
+                if not r.ok():
+                    if r.code in RETRYABLE:
+                        with rlock:
+                            reader_retried[0] += 1
+                        time.sleep(0.05)
+                    else:
+                        with rlock:
+                            reader_errors.append(
+                                (q, f"{r.code}: {r.error_msg}"))
+
+        rthreads = [threading.Thread(target=reader, args=(k,),
+                                     daemon=True) for k in range(2)]
+        for t in rthreads:
+            t.start()
+
+        recoveries: list = []
+
+        def sample_recovery(i, label, timeout=90.0):
+            st = topo.wait_recovered(i, sid, parts, timeout=timeout)
+            evs = topo.flight_events(i, "wal_replay")
+            snaps = topo.flight_events(i, "snapshot_install")
+            rec = {"cycle": label, "node": i,
+                   "replay_events": len(evs),
+                   "replayed_total": sum(ev.get("n", 0) for ev in evs),
+                   "replay_max_n": max([ev.get("n", 0) for ev in evs]
+                                       or [0]),
+                   "snapshot_installs": len(snaps),
+                   "parts": len(st)}
+            recoveries.append(rec)
+            log(f"crash tier: recovery[{label}] node {i}: {rec}")
+            return rec
+
+        # ---- cycle 1: SIGKILL the leader-heaviest storaged
+        time.sleep(traffic_s)
+        counts = topo.leader_counts(sid)
+        victim = max(counts, key=counts.get)
+        log(f"crash tier: cycle 1 — SIGKILL storaged{victim} "
+            f"(leads {counts[victim]}/{parts}), restart on same dir")
+        topo.sigkill(victim)
+        time.sleep(traffic_s)
+        topo.restart(victim)
+        sample_recovery(victim, "sigkill_leader")
+
+        # ---- cycle 2: forced crash between WAL append and engine
+        # apply (crashpoint.wal_applied aborts the process at the seam)
+        victim2 = next(i for i in range(3) if i != victim)
+        log(f"crash tier: cycle 2 — storaged{victim2} restarted with "
+            f"crashpoint.wal_applied armed")
+        topo.sigkill(victim2)
+        topo.restart(victim2, env_extra={
+            "NEBULA_TPU_FAULTS": "crashpoint.wal_applied:after=40,n=1"})
+        died = topo.wait_exit(victim2, timeout=120.0)
+        assert died, "crashpoint.wal_applied never killed the process"
+        topo.restart(victim2)
+        sample_recovery(victim2, "crashpoint_wal_applied")
+
+        # ---- cycle 3 (full): crash mid-snapshot-install — kill a
+        # node, overflow the compaction lag so survivors truncate the
+        # gap, restart with crashpoint.snapshot_recv armed
+        snapshot_cycle = None
+        if not trim:
+            victim3 = next(i for i in range(3)
+                           if i not in (victim, victim2))
+            pre = {p["part"]: p["committed"]
+                   for p in topo.raft_parts(victim3)
+                   if p["space"] == sid}
+            log(f"crash tier: cycle 3 — SIGKILL storaged{victim3}, "
+                f"overflow wal_compact_lag={lag} while it is down")
+            topo.sigkill(victim3)
+            wc = GraphClient(topo.graphd.addr).connect()
+            wc.must(f"USE {space}")
+            burst = 0
+            deadline = time.time() + 120
+            while time.time() < deadline:
+                # singles (not batches): each INSERT is one raft log
+                # entry, which is what must overflow the lag
+                for _ in range(200):
+                    a = random.randrange(v)
+                    b = random.randrange(v)
+                    wc.execute(f"INSERT EDGE knows(ts) VALUES "
+                               f"{a} -> {b}@{5_000_000 + burst}:"
+                               f"({90000 + (burst % 1000)})")
+                    burst += 1
+                # compaction must have truncated past the dead node's
+                # tail on every part it needs to catch up
+                firsts: dict = {}
+                for j in range(3):
+                    if topo.nodes[j].pid is None:
+                        continue
+                    for p in topo.raft_parts(j):
+                        if p["space"] == sid and \
+                                p["role"] == "LEADER":
+                            firsts[p["part"]] = \
+                                p["wal_first_log_id"]
+                if firsts and all(
+                        firsts.get(pt, 0) > pre.get(pt, 0) + 1
+                        for pt in pre):
+                    break
+            gap_truncated = bool(firsts) and all(
+                firsts.get(pt, 0) > pre.get(pt, 0) + 1 for pt in pre)
+            topo.restart(victim3, env_extra={
+                "NEBULA_TPU_FAULTS": "crashpoint.snapshot_recv:n=1"})
+            died3 = topo.wait_exit(victim3, timeout=120.0)
+            topo.restart(victim3)
+            rec3 = sample_recovery(victim3, "crashpoint_snapshot_recv",
+                                   timeout=150.0)
+            snapshot_cycle = {"gap_truncated": gap_truncated,
+                              "burst_writes": burst,
+                              "crashpoint_fired": died3,
+                              "snapshot_installs":
+                                  rec3["snapshot_installs"]}
+            log(f"crash tier: cycle 3 — {snapshot_cycle}")
+
+        # ---- settle: stop traffic, verify
+        time.sleep(traffic_s)
+        writers.pause()
+        pause.set()
+        time.sleep(0.3)
+        deadline = time.time() + 20
+        while any(tpu._repacking.values()) and time.time() < deadline:
+            time.sleep(0.05)
+
+        def identity_sweep():
+            ok_all, device = True, False
+            for q in queries:
+                g0 = tpu.stats["go_served"] + tpu.stats["agg_served"]
+                rt = gc.must(q)
+                device |= (tpu.stats["go_served"]
+                           + tpu.stats["agg_served"]) > g0
+                tpu.enabled = False
+                try:
+                    rc = gc.must(q)
+                finally:
+                    tpu.enabled = True
+                if sorted(map(repr, rt.rows)) != \
+                        sorted(map(repr, rc.rows)):
+                    ok_all = False
+            return ok_all, device
+
+        identity_ok = device_served = False
+        deadline = time.time() + (90 if trim else 60)
+        while time.time() < deadline:
+            identity_ok, dev = identity_sweep()
+            if identity_ok and dev:
+                device_served = True
+                break
+            time.sleep(0.4)
+
+        missing = writers.verify_ledger(gc)
+        wsum = writers.summary()
+        stop.set()
+        writers.stop()
+        pause.clear()
+        for t in rthreads:
+            t.join(timeout=20)
+
+        spans = topo.wal_spans(sid)
+        # replay bounded by the compaction lag (+ slack for entries
+        # landed since the last 1s flush); wal span bounded by lag +
+        # whole-segment granularity
+        replay_bound = lag + 1024
+        span_bound = lag + 4096
+        replay_bounded = all(r["replay_max_n"] <= replay_bound
+                             for r in recoveries)
+        # every recovery must leave flight-recorder evidence: a
+        # wal_replay event per SIGKILL recovery; the forced
+        # mid-snapshot-crash cycle recovers parts whose gap was
+        # compacted away, where snapshot_install IS the recovery event
+        replay_events_per_recovery = all(
+            (r["replay_events"] >= 1
+             if r["cycle"] != "crashpoint_snapshot_recv"
+             else r["replay_events"] + r["snapshot_installs"] >= 1)
+            for r in recoveries) and any(
+            r["replay_events"] >= 1 for r in recoveries)
+        rec = {
+            "trim": trim,
+            "graph": {"V": v, "E": e, "partition_num": parts,
+                      "replica_factor": 3},
+            "flags": topo.flags,
+            "cycles": len(recoveries),
+            "recoveries": recoveries,
+            "snapshot_cycle": snapshot_cycle,
+            "ledger": {**wsum, "missing": len(missing),
+                       "missing_samples": missing[:5]},
+            "readers": {"errors": len(reader_errors),
+                        "error_samples": reader_errors[:5],
+                        "retried": reader_retried[0]},
+            "identity_post_recovery": identity_ok,
+            "device_served_post_recovery": device_served,
+            "wal_spans": {"max": max(spans) if spans else 0,
+                          "bound": span_bound},
+            "replay": {"bound": replay_bound,
+                       "bounded": replay_bounded,
+                       "events_per_recovery":
+                           replay_events_per_recovery},
+            "restarts": {n.name: n.restarts for n in topo.nodes},
+        }
+        ok = (len(missing) == 0 and wsum["errors"] == 0
+              and wsum["acked"] > 0
+              and len(reader_errors) == 0
+              and identity_ok and device_served
+              and replay_events_per_recovery and replay_bounded
+              and len(recoveries) >= (2 if trim else 3)
+              and (trim or (snapshot_cycle or {}).get("gap_truncated"))
+              and (trim or (snapshot_cycle or {}).get(
+                  "snapshot_installs", 0) >= 1)
+              and (spans and max(spans) <= span_bound))
+        rec["ok"] = bool(ok)
+        with open(out_path, "w") as f:
+            json.dump(rec, f, indent=1)
+        log(f"crash tier: ledger={rec['ledger']} "
+            f"recoveries={recoveries} identity={identity_ok} "
+            f"-> {out_path}")
+        print(json.dumps({
+            "metric": "crash", "ok": rec["ok"],
+            "acked": wsum["acked"], "missing": len(missing),
+            "client_errors": wsum["errors"] + len(reader_errors),
+            "recoveries": len(recoveries),
+            "replay_events": sum(r["replay_events"]
+                                 for r in recoveries),
+            "identity": identity_ok}))
+        if not ok:
+            raise SystemExit(f"crash tier FAILED: "
+                             f"{json.dumps(rec, indent=1)[:4000]}")
+        return rec
+    finally:
+        try:
+            if topo is not None:
+                topo.stop()
+        finally:
+            if os.environ.get("BENCH_CRASH_KEEP"):
+                log(f"crash tier: keeping run dir {run_dir}")
+            else:
+                shutil.rmtree(run_dir, ignore_errors=True)
+
+
 def main():
     if "--tenants" in sys.argv:
         out = os.environ.get("BENCH_TENANTS_OUT", "TENANTS_bench.json")
@@ -2235,6 +2563,13 @@ def main():
             if a.startswith("--out="):
                 out = a.split("=", 1)[1]
         bench_cluster(out, trim="--trim" in sys.argv)
+        return
+    if "--crash" in sys.argv:
+        out = os.environ.get("BENCH_CRASH_OUT", "CRASH_bench.json")
+        for a in sys.argv:
+            if a.startswith("--out="):
+                out = a.split("=", 1)[1]
+        bench_crash(out, trim="--trim" in sys.argv)
         return
     if "--cache-smoke" in sys.argv:
         out = os.environ.get("BENCH_CACHE_OUT", "CACHE_smoke.json")

@@ -49,6 +49,15 @@ int32_t nwal_reset(nwal *w);
  * (never the active segment). Returns number of files removed. */
 int32_t nwal_clean_ttl(nwal *w);
 
+/* TTL sweep bounded by id: an aged segment goes only if its every
+ * record id is < id — age alone never truncates unapplied entries. */
+int32_t nwal_clean_ttl_before(nwal *w, int64_t id);
+
+/* Delete sealed prefix segments whose every record id is < id (whole
+ * segments only; never the active segment) — snapshot-anchored
+ * compaction. Returns number of files removed. */
+int32_t nwal_clean_before(nwal *w, int64_t id);
+
 /* Force an fsync of the active segment. */
 int32_t nwal_sync(nwal *w);
 

@@ -279,20 +279,44 @@ struct nwal {
     return 0;
   }
 
-  int32_t clean_ttl() {
+  // Drop the front segment: erase its records from the index, fix up
+  // the surviving records' segment slots, and unlink the file.
+  void drop_front_segment() {
+    const Segment &s = segments.front();
+    auto it = std::upper_bound(
+        index.begin(), index.end(), s.last_id,
+        [](int64_t v, const RecordMeta &r) { return v < r.log_id; });
+    index.erase(index.begin(), it);
+    for (auto &r : index) r.seg -= 1;
+    remove(s.path.c_str());
+    segments.erase(segments.begin());
+  }
+
+  // TTL sweep, optionally bounded: only segments whose every record
+  // id is < bound may go (bound < 0 = unbounded). Callers pass the
+  // applied anchor so age alone can never truncate unapplied entries.
+  int32_t clean_ttl(int64_t bound = -1) {
     time_t now = time(nullptr);
     int32_t removed = 0;
     // Never touch the active (last) segment.
     while (segments.size() > 1 &&
-           now - segments.front().mtime >= ttl_secs) {
-      const Segment &s = segments.front();
-      auto it = std::upper_bound(
-          index.begin(), index.end(), s.last_id,
-          [](int64_t v, const RecordMeta &r) { return v < r.log_id; });
-      index.erase(index.begin(), it);
-      for (auto &r : index) r.seg -= 1;
-      remove(s.path.c_str());
-      segments.erase(segments.begin());
+           now - segments.front().mtime >= ttl_secs &&
+           (bound < 0 || segments.front().last_id < bound)) {
+      drop_front_segment();
+      removed++;
+    }
+    return removed;
+  }
+
+  // Snapshot-anchored compaction: drop sealed prefix segments whose
+  // every record id is below `id`. Whole segments only (the record
+  // layout is append-only), never the active segment, so the WAL
+  // keeps at least every record >= id — the caller passes
+  // applied_anchor - lag, which bounds both disk and restart replay.
+  int32_t clean_before(int64_t id) {
+    int32_t removed = 0;
+    while (segments.size() > 1 && segments.front().last_id < id) {
+      drop_front_segment();
       removed++;
     }
     return removed;
@@ -377,6 +401,10 @@ int32_t nwal_append(nwal *w, int64_t log_id, int64_t term, int64_t cluster,
 int32_t nwal_rollback(nwal *w, int64_t keep_to) { return w->rollback(keep_to); }
 int32_t nwal_reset(nwal *w) { return w->reset(); }
 int32_t nwal_clean_ttl(nwal *w) { return w->clean_ttl(); }
+int32_t nwal_clean_ttl_before(nwal *w, int64_t id) {
+  return w->clean_ttl(id);
+}
+int32_t nwal_clean_before(nwal *w, int64_t id) { return w->clean_before(id); }
 
 int32_t nwal_sync(nwal *w) {
   if (w->active) {

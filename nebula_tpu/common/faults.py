@@ -90,12 +90,18 @@ class FaultRegistry:
 
     # -------------------------------------------------------- catalog
     def register(self, name: str, exc: type = InjectedFault,
-                 doc: str = "") -> None:
+                 doc: str = "", crash: bool = False) -> None:
         """Declare a fault point (idempotent): names the site in the
         /faults catalog and fixes the exception type a raise-mode fire
-        uses (transport points raise InjectedConnectionFault)."""
+        uses (transport points raise InjectedConnectionFault).
+        `crash=True` makes the point a CRASHPOINT: an armed fire
+        hard-aborts the process (`os._exit`) instead of raising — the
+        seam dies exactly where a `kill -9` would leave it, with no
+        Python cleanup, atexit hooks or buffered-stream flushes. Only
+        subprocess harnesses (`bench --crash`) arm these."""
         with self._lock:
-            self._points.setdefault(name, {"exc": exc, "doc": doc})
+            self._points.setdefault(name, {"exc": exc, "doc": doc,
+                                           "crash": bool(crash)})
 
     # ----------------------------------------------------------- fire
     def fire(self, name: str) -> None:
@@ -119,8 +125,17 @@ class FaultRegistry:
                 spec.remaining -= 1
             self.fired[name] = self.fired.get(name, 0) + 1
             latency = spec.latency_ms
-            exc = self._points.get(name, {}).get("exc", InjectedFault)
+            point = self._points.get(name, {})
+            exc = point.get("exc", InjectedFault)
+            crash = point.get("crash", False)
         global_stats.add_value("faults.injected." + name, kind="counter")
+        if crash:
+            # hard process abort at the seam — the stderr note is the
+            # only trace (the harness watches for exit code 134)
+            import sys as _sys
+            print(f"CRASHPOINT {name!r} fired: aborting process",
+                  file=_sys.stderr, flush=True)
+            os._exit(134)
         if latency is not None:
             time.sleep(latency / 1e3)
             return
@@ -230,6 +245,26 @@ faults.register("wal.append",
 faults.register("wal.sync",
                 doc="explicit WAL fsync (Wal.sync / "
                     "wal_sync_every_append durability path)")
+faults.register("wal.torn_tail",
+                doc="truncate trailing bytes off the newest WAL "
+                    "segment at close — the shape a power cut "
+                    "mid-append leaves; the next open must "
+                    "CRC-truncate the torn record and recover the "
+                    "prefix (kvstore/wal.py close)")
+# crashpoints: hard process aborts (os._exit) at the recovery-critical
+# seams — armed only by crash harnesses (bench --crash), they force
+# the exact window `kill -9` races against (docs/manual/12-replication
+# .md crash recovery protocol)
+faults.register("crashpoint.wal_applied", crash=True,
+                doc="CRASHPOINT: abort after a commit batch is "
+                    "durable in the WAL but BEFORE the engine apply "
+                    "(raft_part._commit_range_locked) — restart must "
+                    "replay the tail")
+faults.register("crashpoint.snapshot_recv", crash=True,
+                doc="CRASHPOINT: abort mid-snapshot-install on the "
+                    "receiving replica (raft_part."
+                    "process_send_snapshot) — the restarted receiver "
+                    "must re-request and converge")
 
 if os.environ.get("NEBULA_TPU_FAULTS"):
     faults.set_plan(os.environ["NEBULA_TPU_FAULTS"])

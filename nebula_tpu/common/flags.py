@@ -211,6 +211,27 @@ storage_flags.declare("wal_sync_every_append", False, REBOOT,
                       "buys power-loss durability at a per-append "
                       "fsync (~0.1-10ms per record depending on the "
                       "device; docs/manual/12-replication.md)")
+storage_flags.declare("wal_compact_lag", 4096, MUTABLE,
+                      "entries of headroom kept BEHIND each part's "
+                      "applied anchor when the storaged compaction "
+                      "task truncates raft WAL prefixes — bounds both "
+                      "WAL disk and restart replay length (negative "
+                      "disables compaction; docs/manual/"
+                      "12-replication.md crash recovery & compaction)")
+storage_flags.declare("wal_compact_interval_secs", 20.0, MUTABLE,
+                      "period of the storaged WAL-compaction task "
+                      "(flush engines, then truncate each part's WAL "
+                      "behind its pre-flush applied anchor; also runs "
+                      "the wal_ttl_secs sweep)")
+storage_flags.declare("wal_file_size", 16 * 1024 * 1024, REBOOT,
+                      "raft WAL segment roll size in bytes (read at "
+                      "part bind); compaction drops whole sealed "
+                      "segments, so smaller files bound disk tighter "
+                      "at more file churn")
+storage_flags.declare("wal_ttl_secs", 86400, REBOOT,
+                      "age after which sealed raft WAL segments are "
+                      "eligible for the TTL sweep (read at part "
+                      "bind; the compaction task is the caller)")
 storage_flags.declare("raft_election_timeout_ms", 450, REBOOT,
                       "raft election timeout base (randomized 1-2x); "
                       "failover completes within ~2x this after a "

@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import argparse
 import sys
+import threading
 from dataclasses import dataclass
 from typing import Optional
 
@@ -31,6 +32,8 @@ class StoragedHandle:
     node: Optional[object] = None        # StorageNode when replicated
     raft_server: Optional[RpcServer] = None
     kv_watcher: Optional[object] = None  # storage_flags watcher to detach
+    compactor_stop: Optional[threading.Event] = None
+    compactor_thread: Optional[threading.Thread] = None
 
     @property
     def addr(self) -> str:
@@ -41,6 +44,13 @@ class StoragedHandle:
         return self.web.port if self.web else None
 
     def stop(self) -> None:
+        if self.compactor_stop is not None:
+            # stop AND join the compactor BEFORE the node goes down —
+            # a round mid-flight must not flush an engine whose native
+            # handle the shutdown is about to free
+            self.compactor_stop.set()
+            if self.compactor_thread is not None:
+                self.compactor_thread.join(timeout=10)
         if self.kv_watcher is not None:
             storage_flags.unwatch(self.kv_watcher)
         self.meta_client.stop()
@@ -120,6 +130,23 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
                    advertise_host: Optional[str] = None,
                    engine: str = "native") -> StoragedHandle:
     server = RpcServer(host, port)
+    raft_server = None
+    if replicated:
+        # raft listens on storage-port+1. When the storage port was
+        # auto-assigned (port=0), the neighbor can already be held by
+        # ANY socket on the box (an outbound connection's ephemeral
+        # source port, another daemon) — re-roll the pair instead of
+        # failing the whole daemon boot on the unlucky draw.
+        for attempt in range(16):
+            try:
+                raft_server = RpcServer(
+                    host, int(server.addr.rsplit(":", 1)[1]) + 1)
+                break
+            except OSError:
+                if port != 0 or attempt == 15:
+                    raise
+                server.stop()
+                server = RpcServer(host, 0)
     # the address REGISTERED with metad (and dialed by graphd + raft
     # peers) must be routable from other hosts — binding to 0.0.0.0 in
     # a container needs a separate advertised hostname, or every peer
@@ -138,16 +165,16 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
         import os as _os
         engine_factory = native_engine_factory(
             _os.path.join(data_dir, "engines") if data_dir else None)
-    raft_server = None
     node = None
     if replicated:
-        # raft-replicated parts: a second RpcServer on port+1 hosts this
-        # node's RaftexService; peers reach it via RpcTransport
+        # raft-replicated parts: the second RpcServer on port+1 (bound
+        # above, next to the storage server so an unlucky ephemeral
+        # pair re-rolls) hosts this node's RaftexService; peers reach
+        # it via RpcTransport
         from ..kvstore.raft_store import StorageNode
         from ..kvstore.raftex.service import RpcTransport
         from ..meta.net_admin import raft_addr_of, storage_addr_of
         import tempfile
-        raft_server = RpcServer(host, int(addr.rsplit(":", 1)[1]) + 1)
         raft_net = RpcTransport()
 
         def on_leader_change(space_id, part_id, leader):
@@ -176,7 +203,13 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
                            election_timeout=max(
                                0.05, storage_flags.get(
                                    "raft_election_timeout_ms", 450)
-                               / 1000.0))
+                               / 1000.0),
+                           # WAL sizing (REBOOT, read at part bind):
+                           # segment roll size + TTL-sweep age
+                           wal_file_size=storage_flags.get_or(
+                               "wal_file_size", 16 * 1024 * 1024),
+                           wal_ttl_secs=storage_flags.get_or(
+                               "wal_ttl_secs", 86400))
         node.raft_net = raft_net  # shut down with the node (handle.stop)
         raft_server.register("raftex", node.service).start()
         store = node.store
@@ -357,6 +390,43 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
         from ..meta.net_admin import AdminService
         server.register("admin", AdminService(node))
     server.start()
+    compactor_stop = None
+    compactor_thread = None
+    if node is not None:
+        # snapshot-anchored WAL compaction task (docs/manual/
+        # 12-replication.md): every wal_compact_interval_secs, capture
+        # per-part applied anchors, flush engines, truncate each WAL
+        # behind anchor - wal_compact_lag, and run the TTL sweep —
+        # bounding WAL disk and restart replay length. Both flags are
+        # MUTABLE and consulted per round.
+        compactor_stop = threading.Event()
+
+        def _wal_compactor(stop_ev=compactor_stop, n=node):
+            last_anchors: dict = {}
+            while not stop_ev.wait(max(0.05, storage_flags.get_or(
+                    "wal_compact_interval_secs", 20.0))):
+                lag = storage_flags.get_or("wal_compact_lag", 4096)
+                if lag < 0:
+                    continue            # negative disables, hot
+                try:
+                    # idle guard: the flush step is a full engine
+                    # checkpoint — skip the round entirely when no
+                    # part's applied anchor moved since last time
+                    cur = {k: h.raft.committed_id
+                           for k, h in list(n.hooks.items())
+                           if h.raft is not None}
+                    if cur and cur != last_anchors:
+                        n.compact_wals(lag)
+                        last_anchors = cur
+                except Exception:
+                    pass                # never die; next round retries
+
+        # nlint: disable=NL002 -- node-lifetime background maintenance
+        # loop; it serves every part and owes no request a trace
+        compactor_thread = threading.Thread(
+            target=_wal_compactor, daemon=True,
+            name=f"wal-compact-{addr}")
+        compactor_thread.start()
     web = None
     if ws_port is not None:
         web = WebService("storaged", flags=storage_flags, stats=stats,
@@ -414,6 +484,10 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
                         1 if st["role"] == "LEADER" else 0
                     out[base + ".term"] = st["term"]
                     out[base + ".commit_lag"] = st["commit_lag"]
+                    # crash-recovery/compaction surface: entries this
+                    # boot re-applied + segment files compacted away
+                    out[base + ".wal_replayed"] = st["wal_replayed"]
+                    out[base + ".wal_cleaned"] = st["wal_cleaned"]
                 return out
 
             web.add_metrics_source(raft_metric_source)
@@ -422,7 +496,9 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
         if wc_state["fired"]:   # wrong-cluster fired before web existed
             web.stop()
     return StoragedHandle(store, storage, mc, server, web, node, raft_server,
-                          kv_watcher=_apply_kv_options)
+                          kv_watcher=_apply_kv_options,
+                          compactor_stop=compactor_stop,
+                          compactor_thread=compactor_thread)
 
 
 def main(argv=None) -> None:

@@ -274,16 +274,29 @@ class NativeEngine(KVEngine):
     def ingest(self, kvs: Iterable[KV]) -> Status:
         return self.multi_put(kvs)
 
+    # flush/checkpoint/close share _wlock: a background flusher (the
+    # storaged WAL-compaction task) racing close() must find either a
+    # live handle or the closed flag — a bare check-then-call would
+    # let close() free the native handle mid-checkpoint (UAF)
     def flush(self) -> Status:
-        if self._ckpt:
-            rc = self._lib.nkv_checkpoint(self._h, self._ckpt.encode())
-            if rc != 0:
+        with self._wlock:
+            if self._closed:
                 return Status.error(ErrorCode.E_CHECKPOINT_ERROR,
-                                    f"checkpoint rc={rc}")
-        return Status.OK()
+                                    "closed")
+            if self._ckpt:
+                rc = self._lib.nkv_checkpoint(self._h,
+                                              self._ckpt.encode())
+                if rc != 0:
+                    return Status.error(ErrorCode.E_CHECKPOINT_ERROR,
+                                        f"checkpoint rc={rc}")
+            return Status.OK()
 
     def checkpoint(self, path: str) -> Status:
-        rc = self._lib.nkv_checkpoint(self._h, path.encode())
+        with self._wlock:
+            if self._closed:
+                return Status.error(ErrorCode.E_CHECKPOINT_ERROR,
+                                    "closed")
+            rc = self._lib.nkv_checkpoint(self._h, path.encode())
         return Status.OK() if rc == 0 else \
             Status.error(ErrorCode.E_CHECKPOINT_ERROR, f"checkpoint rc={rc}")
 
@@ -311,9 +324,10 @@ class NativeEngine(KVEngine):
         return None if v < 0 else int(v)
 
     def close(self) -> None:
-        if not self._closed:
-            self._lib.nkv_close(self._h)
-            self._closed = True
+        with self._wlock:
+            if not self._closed:
+                self._lib.nkv_close(self._h)
+                self._closed = True
 
     def __del__(self):
         try:

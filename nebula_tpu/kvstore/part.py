@@ -39,6 +39,7 @@ class Part:
         self._lock = threading.Lock()
         self.last_committed_log_id = 0
         self.last_committed_term = 0
+        self._snapshot_active = False   # mid-install chunk sequence
         self._load_commit_marker()
         self._consensus = consensus or DirectCommit(self)
         # consensus impls that need the Part (raft: commit/snapshot
@@ -81,6 +82,10 @@ class Part:
             return Status.OK()
         batch_puts: List[KV] = []
         with self._lock:
+            # applying log batches means no snapshot install is in
+            # flight — clear the flag a sender-side abort can leave
+            # behind, so the NEXT install gets its prefix cleanup
+            self._snapshot_active = False
             for log_id, term, data in logs:
                 if not data:
                     continue  # heartbeat/noop entry
@@ -118,8 +123,20 @@ class Part:
 
     def commit_snapshot(self, kvs: List[KV], committed_log_id: int,
                         committed_term: int, finished: bool) -> int:
-        """Ingest a snapshot chunk (ref: Part::commitSnapshot :321-348)."""
+        """Ingest a snapshot chunk (ref: Part::commitSnapshot :321-348).
+        The first chunk of an install clears the part's prefix first —
+        a snapshot REPLACES history, so keys deleted at the leader must
+        not survive as ghosts on a receiver that already held data
+        (reachable since WAL compaction: a lagging replica whose gap
+        was truncated re-syncs by snapshot onto a non-empty engine).
+        The commit marker lands only with the FINAL chunk; a crash
+        mid-install therefore restarts recovery from marker 0 and the
+        receiver simply re-requests the snapshot."""
         with self._lock:
+            if not self._snapshot_active:
+                self.engine.remove_prefix(
+                    keyutils.part_prefix(self.part_id))
+                self._snapshot_active = True
             self.engine.multi_put(kvs)
             if finished:
                 self.engine.put(keyutils.system_commit_key(self.part_id),
@@ -127,6 +144,7 @@ class Part:
                                                              committed_term))
                 self.last_committed_log_id = committed_log_id
                 self.last_committed_term = committed_term
+                self._snapshot_active = False
         return len(kvs)
 
     def cleanup(self) -> Status:

@@ -72,6 +72,7 @@ class RaftConsensusHook(ConsensusHook):
 
         wal_dir = os.path.join(
             self._wal_root, f"s{self._space_id}_p{self._part_id}")
+        self.wal_dir = wal_dir
         on_lc = None
         if self._on_leader_change is not None:
             cb, sid, pid = self._on_leader_change, self._space_id, \
@@ -130,9 +131,20 @@ class RaftConsensusHook(ConsensusHook):
         raw = self.raft.leader() if self.raft else None
         return self._leader_hint(raw) if raw else raw
 
-    def stop(self) -> None:
+    def stop(self, purge: bool = False) -> None:
         if self.raft is not None:
             self.raft.stop()
+        if purge:
+            # the part is being REMOVED from this host (balance
+            # evacuation / space drop) — delete its WAL + raft_state
+            # alongside the engine data Part.cleanup() drops, so a
+            # later re-add of the same part here starts from a clean
+            # dir. Without this, stale history would masquerade as a
+            # same-dir member restart (RaftPart's learner override).
+            import shutil
+            wal_dir = getattr(self, "wal_dir", None)
+            if wal_dir:
+                shutil.rmtree(wal_dir, ignore_errors=True)
 
 
 class StorageNode:
@@ -173,14 +185,14 @@ class StorageNode:
     def remove_part(self, space_id: int, part_id: int) -> None:
         hook = self.hooks.pop((space_id, part_id), None)
         if hook is not None:
-            hook.stop()
+            hook.stop(purge=True)   # evacuation: WAL goes with the data
         self.store.remove_part(space_id, part_id)
 
     def remove_space(self, space_id: int) -> None:
         """Stop every part's raft BEFORE the engine closes — committing
         into a freed native engine is a use-after-free."""
         for key in [k for k in self.hooks if k[0] == space_id]:
-            self.hooks.pop(key).stop()
+            self.hooks.pop(key).stop(purge=True)
         self.store.remove_space(space_id)
 
     def raft(self, space_id: int, part_id: int) -> Optional[RaftPart]:
@@ -195,6 +207,37 @@ class StorageNode:
             h = self.hooks.get(key)
             if h is not None and h.raft is not None:
                 out.append(h.raft.status())
+        return out
+
+    def compact_wals(self, lag: int) -> Dict[tuple, dict]:
+        """Snapshot-anchored WAL compaction across every local part
+        (the storaged background task's body; docs/manual/
+        12-replication.md). Ordering is the durability argument:
+        (1) capture each part's applied id as its anchor, (2) flush
+        every space engine so everything at/below the anchors is on
+        disk, (3) truncate each WAL behind anchor - lag. A crash at
+        any point leaves the WAL covering everything the engine might
+        be missing."""
+        anchors: Dict[tuple, int] = {}
+        for key, h in list(self.hooks.items()):
+            if h.raft is not None:
+                anchors[key] = h.raft.committed_id
+        for sid in self.store.spaces():
+            eng = self.store.space_engine(sid)
+            flush = getattr(eng, "flush", None)
+            if flush is not None:
+                try:
+                    flush()
+                except Exception:
+                    # an unflushed engine just means this round's
+                    # anchors are too optimistic — skip truncation
+                    anchors = {k: 0 for k in anchors}
+                    break
+        out: Dict[tuple, dict] = {}
+        for key, anchor in anchors.items():
+            h = self.hooks.get(key)
+            if h is not None and h.raft is not None:
+                out[key] = h.raft.compact_wal(lag, anchor=anchor)
         return out
 
     def leader_parts(self) -> Dict[int, List[int]]:

@@ -24,9 +24,28 @@ per the Raft safety argument.
 The state machine seam is three callbacks (on_commit / on_snapshot /
 snapshot_rows), matching the reference's commitLogs / commitSnapshot /
 accessAllRowsInSnapshot virtuals (RaftPart.h:241-252).
+
+Crash recovery (docs/manual/12-replication.md): at bind the part
+measures the WAL tail above the engine's persisted commit marker
+(`applied_id`) — the entries a hard kill left durable in the log but
+not yet applied. The tail is NOT applied eagerly: raft forbids a
+restarted replica from deciding commitment on its own, so the tail
+replays through the normal `_commit_range_locked` -> `on_commit`
+batch path (idempotent re-apply) once commitment is re-established —
+either by the new leader's committed_log_id (follower) or by this
+replica's own term-start no-op committing in its new term
+(leader-elect). Membership COMMAND entries found in the tail are
+re-applied to the in-memory peer/learner sets at bind (their append-
+time effects died with the process); TRANS_LEADER is skipped — a
+pre-crash transfer must not trigger an election from a constructor.
+When the tail is fully covered the part emits a `wal_replay` flight
+event and counts `raftex.wal_replayed`; a tail discarded by a term-
+conflict rollback or replaced wholesale by a snapshot shrinks or
+cancels the accounting instead.
 """
 from __future__ import annotations
 
+import binascii
 import os
 import random
 import threading
@@ -34,6 +53,9 @@ import time
 from concurrent.futures import Future
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ...common.faults import faults
+from ...common.flight import recorder as flight
+from ...common.stats import stats
 from ..wal import Wal
 from .host import Host
 from .service import RaftexService, Transport
@@ -117,10 +139,49 @@ class RaftPart:
                        sync_every_append=bool(storage_flags.get(
                            "wal_sync_every_append", False)))
         self._state_path = os.path.join(wal_dir, "raft_state")
+        self._persisted_learner: Optional[bool] = None
         self._load_state()
 
+        # Same-dir restart fencing: the storaged topology-join
+        # heuristic flags any part whose group already runs elsewhere
+        # as a LEARNER (an EMPTY-log voter campaigning would depose
+        # the incumbent). A replica restarting on its own data dir
+        # trips that heuristic too — but its raft_state records the
+        # role it actually held, and a persisted VOTER staying a
+        # learner would silently shrink the voting set. Only a
+        # provably-persisted voter is promoted: a genuine mid-catchup
+        # learner (or a pre-upgrade state file) keeps the learner
+        # fencing. Evacuations purge the WAL dir (raft_store
+        # hook.stop(purge=True)), so surviving state is this part's
+        # own history, not a predecessor's.
+        if is_learner and self.role is Role.LEARNER and \
+                self._persisted_learner is False:
+            self.role = Role.FOLLOWER
+
+        # ---- boot recovery bookkeeping (module doc: crash recovery).
+        # The tail [committed_id+1 .. wal.last] survived the previous
+        # process in the WAL but not (necessarily) in the engine; it
+        # replays through _commit_range_locked once commitment is
+        # re-established under a current term.
+        boot_last = self.wal.last_log_id
+        self._boot_replay_base = min(self.committed_id, boot_last)
+        self._boot_replay_to = boot_last
+        self._boot_replay_done = boot_last <= self.committed_id
+        self.wal_replayed = 0        # tail entries re-applied at boot
+        self.wal_cleaned = 0         # segment files compacted away
+        # hosts/pending must exist BEFORE the tail re-apply below — a
+        # REMOVE_PEER command in the tail touches self.hosts
         self._pending: Dict[int, Future] = {}   # log_id -> caller future
         self.hosts: Dict[str, Host] = {}
+        if not self._boot_replay_done:
+            # membership COMMANDs in the tail mutated the in-memory
+            # peer/learner sets at append time pre-crash; restore that
+            # (TRANS_LEADER excluded — see module doc)
+            for e in self.wal.iterate(self.committed_id + 1, boot_last):
+                if e.data[:1] == _M_COMMAND:
+                    op, _target = _decode_cmd(e.data[1:])
+                    if op != CMD_TRANS_LEADER:
+                        self._apply_command_locked(e.data[1:])
 
         self._running = True
         self._repl_cv = threading.Condition()
@@ -252,9 +313,11 @@ class RaftPart:
                     self.hosts[target] = h
                 h.is_learner = False
             # a promoted learner becomes a follower on its own replica
+            # — persisted, so a same-dir restart re-binds as a VOTER
             if target == self.addr and self.role is Role.LEARNER:
                 self.role = Role.FOLLOWER
                 self._last_msg_recv = time.monotonic()
+                self._persist_state()
         elif op == CMD_REMOVE_PEER:
             if target in self.peers:
                 self.peers.remove(target)
@@ -416,14 +479,58 @@ class RaftPart:
             else:
                 batch.append((e.log_id, e.term, payload))
         if batch:
+            # crashpoint: the batch is durable in the WAL; the engine
+            # has not applied it. A crash here is exactly the window
+            # restart recovery must close (bench --crash forces it).
+            faults.fire("crashpoint.wal_applied")
             self._on_commit(batch)
         self.committed_id = to_id
+        self._note_replay_locked(from_id, to_id)
         done = [f for i, f in self._pending.items() if i <= to_id]
         for i in [i for i in self._pending if i <= to_id]:
             del self._pending[i]
         for f in done:
             if not f.done():
                 f.set_result(RaftCode.SUCCEEDED)
+
+    # ------------------------------------------------------------------
+    # boot-recovery accounting (module doc: crash recovery)
+    # ------------------------------------------------------------------
+    def _note_replay_locked(self, from_id: int, to_id: int) -> None:
+        """Track how much of the boot tail a commit advance covered;
+        emit the `wal_replay` flight event once the tail is fully
+        re-applied (the bench --crash recovery proof reads it)."""
+        if self._boot_replay_done or from_id > self._boot_replay_to:
+            return
+        replayed = min(to_id, self._boot_replay_to) - from_id + 1
+        if replayed > 0:
+            self.wal_replayed += replayed
+            stats.add_value("raftex.wal_replayed", replayed,
+                            kind="counter")
+        if to_id >= self._boot_replay_to:
+            self._boot_replay_done = True
+            flight.record("wal_replay", space=self.space_id,
+                          part=self.part_id, addr=self.addr,
+                          from_id=self._boot_replay_base + 1,
+                          to_id=self._boot_replay_to,
+                          n=self.wal_replayed)
+
+    def _note_tail_rollback_locked(self, keep_to: int) -> None:
+        """A term-conflict rollback discarded WAL entries above
+        `keep_to`: any part of the boot tail up there was never
+        committed and will not replay — shrink the accounting so the
+        wal_replay event still fires for what remains."""
+        if self._boot_replay_done or keep_to >= self._boot_replay_to:
+            return
+        self._boot_replay_to = keep_to
+        if self._boot_replay_to <= self.committed_id:
+            self._boot_replay_done = True
+            if self.wal_replayed:
+                flight.record("wal_replay", space=self.space_id,
+                              part=self.part_id, addr=self.addr,
+                              from_id=self._boot_replay_base + 1,
+                              to_id=self._boot_replay_to,
+                              n=self.wal_replayed)
 
     # ------------------------------------------------------------------
     # elections
@@ -578,8 +685,9 @@ class RaftPart:
                         return self._append_resp_locked(RaftCode.E_LOG_GAP)
                 elif t != req.prev_log_term:
                     # conflicting history: drop our tail, ask for resend
-                    self.wal.rollback(max(self.committed_id,
-                                          req.prev_log_id - 1))
+                    keep = max(self.committed_id, req.prev_log_id - 1)
+                    self.wal.rollback(keep)
+                    self._note_tail_rollback_locked(keep)
                     return self._append_resp_locked(RaftCode.E_LOG_GAP)
 
             # append entries, skipping overlap and truncating conflicts
@@ -589,7 +697,9 @@ class RaftPart:
                 if lid <= self.wal.last_log_id:
                     if self.wal.log_term(lid) == req.log_term:
                         continue     # already have it
-                    self.wal.rollback(max(self.committed_id, lid - 1))
+                    keep = max(self.committed_id, lid - 1)
+                    self.wal.rollback(keep)
+                    self._note_tail_rollback_locked(keep)
                 if not self.wal.append(lid, req.log_term, rec.cluster,
                                        rec.data):
                     return self._append_resp_locked(RaftCode.E_WAL_FAIL)
@@ -670,35 +780,141 @@ class RaftPart:
                 self._step_down_locked(req.term, req.leader)
             self.leader_addr = req.leader
             self._last_msg_recv = time.monotonic()
+            if self._recv_snapshot_rows == 0:
+                # install START: history is being replaced wholesale,
+                # and the state-machine side clears the part prefix on
+                # its first chunk — so this replica must become an
+                # EMPTY replica now, not at done: WAL reset and commit
+                # index back to 0. If the sender aborts mid-install,
+                # recovery is then structurally sound either way — a
+                # leader still holding log 1 replays the full history
+                # into the wiped engine (commit restarts from 1), any
+                # compacted leader sees the gap and re-sends a full
+                # snapshot. Keeping the old committed_id would block
+                # re-apply below it over an engine that no longer has
+                # that data.
+                self.wal.reset()
+                self.committed_id = 0
+                self._boot_replay_done = True
+                self._boot_replay_to = 0
             if self._on_snapshot is not None:
                 self._on_snapshot(req.rows, req.committed_log_id,
                                   req.committed_log_term, req.done)
             self._recv_snapshot_rows += len(req.rows)
+            # crashpoint: chunk applied, install NOT finished — a crash
+            # here leaves a partial snapshot with no commit marker; the
+            # restarted receiver must be able to re-request the whole
+            # snapshot and converge (bench --crash forces it)
+            if not req.done:
+                faults.fire("crashpoint.snapshot_recv")
             if req.done:
                 # history replaced wholesale: WAL restarts after the
                 # snapshot point (ref RaftPart.cpp:1601)
                 self.wal.reset()
                 self.committed_id = req.committed_log_id
                 self._recv_snapshot_rows = 0
+                # any boot tail is gone with the old history — the
+                # recovery that actually happened is a snapshot install
+                self._boot_replay_done = True
+                flight.record("snapshot_install", space=self.space_id,
+                              part=self.part_id, addr=self.addr,
+                              committed=req.committed_log_id,
+                              rows=req.total_count)
             return SendSnapshotResponse(RaftCode.SUCCEEDED, self.term)
 
     # ------------------------------------------------------------------
     # persistence of (term, voted_for)
     # ------------------------------------------------------------------
+    # Layout: "term\nvoted_for\nrole(L|V)\ncrc32-of-first-3-lines\n".
+    # The temp file is fsync'd BEFORE the rename and the directory
+    # fsync'd after — without both, a power cut can publish a
+    # zero-length or torn file under the final name, and without the
+    # checksum a torn file parses as garbage (term regression =>
+    # double vote). The role line lets a same-dir restart distinguish
+    # a returning VOTER from a mid-catchup learner. A file that fails
+    # the checksum is treated as absent: the replica restarts at the
+    # in-memory defaults, counted (`raftex.state_recovered`) and
+    # flight-recorded so operators see it happened.
     def _persist_state(self) -> None:
+        role = "L" if self.role is Role.LEARNER else "V"
+        payload = f"{self.term}\n{self.voted_for or ''}\n{role}\n"
+        crc = binascii.crc32(payload.encode())
         tmp = self._state_path + ".tmp"
         with open(tmp, "w") as f:
-            f.write(f"{self.term}\n{self.voted_for or ''}\n")
+            f.write(f"{payload}{crc:08x}\n")
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp, self._state_path)
+        dfd = os.open(os.path.dirname(self._state_path) or ".",
+                      os.O_RDONLY)
+        try:
+            os.fsync(dfd)
+        finally:
+            os.close(dfd)
 
     def _load_state(self) -> None:
         try:
             with open(self._state_path) as f:
                 lines = f.read().splitlines()
+        except OSError:
+            return                    # first boot: nothing persisted
+        try:
+            if len(lines) >= 4:
+                payload = f"{lines[0]}\n{lines[1]}\n{lines[2]}\n"
+                if int(lines[3], 16) != binascii.crc32(payload.encode()):
+                    raise ValueError("raft_state checksum mismatch")
+                if lines[2] not in ("L", "V"):
+                    raise ValueError("raft_state bad role")
+                self._persisted_learner = lines[2] == "L"
+            elif len(lines) != 2:
+                raise ValueError("raft_state truncated")
+            # len(lines) == 2: pre-checksum format, accepted once —
+            # the next _persist_state upgrades it (role stays unknown)
             self.term = int(lines[0])
             self.voted_for = lines[1] or None
-        except (OSError, IndexError, ValueError):
-            pass
+        except (IndexError, ValueError):
+            # torn/corrupt: fall back to defaults instead of wedging
+            # the election on a garbage term
+            stats.add_value("raftex.state_recovered", kind="counter")
+            flight.record("state_recovered", space=self.space_id,
+                          part=self.part_id, addr=self.addr,
+                          path=self._state_path)
+
+    # ------------------------------------------------------------------
+    # snapshot-anchored WAL compaction (docs/manual/12-replication.md)
+    # ------------------------------------------------------------------
+    def compact_wal(self, lag: int, anchor: Optional[int] = None) -> dict:
+        """Truncate the WAL prefix behind the applied anchor, keeping
+        `lag` entries of headroom, plus run the TTL sweep. `anchor` is
+        a caller-supplied DURABLE bound — the storaged compaction task
+        captures each part's applied id BEFORE flushing the engine, so
+        everything at/below the anchor is on disk when truncation
+        happens. It is clamped to committed_id, and `lag >= 0`, so no
+        unapplied entry can ever be dropped (whole sealed segments
+        only — the native clean keeps every record >= keep_from).
+        Bounds both WAL disk and restart replay length."""
+        with self._lock:
+            committed = self.committed_id
+            running = self._running
+        if not running:
+            return {"removed": 0}
+        a = committed if anchor is None else min(int(anchor), committed)
+        keep_from = a - max(int(lag), 0)
+        removed = 0
+        if keep_from > 1:
+            removed = self.wal.clean_before(keep_from)
+        # satellite: the TTL sweep finally has a caller — aged sealed
+        # segments go, but only BELOW the applied anchor: age must
+        # never truncate an entry the engine hasn't durably applied
+        removed += self.wal.clean_ttl(before_id=a + 1)
+        if removed:
+            self.wal_cleaned += removed
+            stats.add_value("raftex.wal_cleaned", removed,
+                            kind="counter")
+        stats.add_value("raftex.wal_compactions", kind="counter")
+        return {"removed": removed, "anchor": a, "keep_from": keep_from,
+                "wal_first": self.wal.first_log_id,
+                "wal_last": self.wal.last_log_id}
 
     # ------------------------------------------------------------------
     def status(self) -> dict:
@@ -713,5 +929,12 @@ class RaftPart:
                 # leader means replication is stuck below quorum
                 "commit_lag": max(0, self.wal.last_log_id
                                   - self.committed_id),
+                # compaction + boot-recovery state (/raft surfacing):
+                # wal_first..last bounds restart replay; wal_replayed
+                # is what THIS boot actually re-applied
+                "wal_first_log_id": self.wal.first_log_id,
+                "wal_replayed": self.wal_replayed,
+                "wal_replay_done": self._boot_replay_done,
+                "wal_cleaned": self.wal_cleaned,
                 "peers": list(self.peers), "learners": list(self.learners),
             }

@@ -37,6 +37,7 @@ class Wal:
                  max_file_size: int = 16 * 1024 * 1024,
                  sync_every_append: bool = False):
         self._lib = native.load()
+        self._dir = dir_path
         self.sync_every_append = bool(sync_every_append)
         self._h = self._lib.nwal_open(
             dir_path.encode(), ttl_secs, max_file_size,
@@ -99,11 +100,30 @@ class Wal:
             if not self._closed:
                 self._lib.nwal_reset(self._h)
 
-    def clean_ttl(self) -> int:
+    def clean_ttl(self, before_id: Optional[int] = None) -> int:
+        """TTL sweep of aged sealed segments. `before_id` bounds it:
+        an aged segment goes only when its every record id is below
+        the bound — compaction passes the applied anchor so age alone
+        can never truncate an unapplied entry. None = unbounded (the
+        legacy shape, safe only when the caller knows the whole log
+        is applied)."""
         with self._lock:
             if self._closed:
                 return 0
-            return self._lib.nwal_clean_ttl(self._h)
+            if before_id is None:
+                return self._lib.nwal_clean_ttl(self._h)
+            return self._lib.nwal_clean_ttl_before(self._h, before_id)
+
+    def clean_before(self, before_id: int) -> int:
+        """Drop sealed prefix segments whose every record id is below
+        `before_id` (whole segments only, never the active one) —
+        snapshot-anchored compaction. Callers pass an APPLIED anchor
+        minus a replay-lag allowance, so no unapplied entry can ever
+        be truncated. Returns segment files removed."""
+        with self._lock:
+            if self._closed:
+                return 0
+            return self._lib.nwal_clean_before(self._h, before_id)
 
     def sync(self) -> None:
         # fault point `wal.sync`: raises — a failed fsync means the
@@ -136,10 +156,40 @@ class Wal:
         return iter(entries)
 
     def close(self) -> None:
+        # fault point `wal.torn_tail`: after the native handle closes,
+        # chop trailing bytes off the newest segment file — the
+        # on-disk shape a power cut mid-append leaves behind. The next
+        # open must CRC-truncate the torn record and recover the
+        # prefix (native/src/wal.cc load_segment), proving the
+        # torn-tail path end-to-end from Python.
+        torn = False
+        try:
+            faults.fire("wal.torn_tail")
+        except InjectedFault:
+            torn = True
         with self._lock:
             if not self._closed:
                 self._lib.nwal_close(self._h)
                 self._closed = True
+                if torn:
+                    self._tear_tail()
+
+    def _tear_tail(self) -> None:
+        """Truncate the newest segment by a few bytes (fault-injection
+        only; called after the native handle is closed)."""
+        import os
+        try:
+            segs = sorted(f for f in os.listdir(self._dir)
+                          if f.endswith(".wal"))
+            if not segs:
+                return
+            path = os.path.join(self._dir, segs[-1])
+            size = os.path.getsize(path)
+            if size > 23:            # keep at least the 16B header
+                with open(path, "r+b") as f:
+                    f.truncate(size - 7)
+        except OSError:
+            pass
 
     def __del__(self):
         try:

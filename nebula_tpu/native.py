@@ -73,6 +73,10 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.nwal_reset.argtypes = [vp]
     lib.nwal_clean_ttl.restype = i32
     lib.nwal_clean_ttl.argtypes = [vp]
+    lib.nwal_clean_ttl_before.restype = i32
+    lib.nwal_clean_ttl_before.argtypes = [vp, i64]
+    lib.nwal_clean_before.restype = i32
+    lib.nwal_clean_before.argtypes = [vp, i64]
     lib.nwal_sync.restype = i32
     lib.nwal_sync.argtypes = [vp]
 

@@ -1,0 +1,557 @@
+"""Crash-storm harness: a real-subprocess replicated topology plus a
+client-side durability ledger (docs/manual/12-replication.md, "Crash
+recovery & compaction").
+
+`bench.py --crash` and `tools/soak.py --crash` share this machinery:
+
+- **CrashTopology** boots metad + a TPU graphd IN-PROCESS (the parent
+  keeps the engine handle for TPU-vs-CPU identity sweeps) and N
+  `--replicated` storaged as detached SUBPROCESSES via the
+  `scripts/services.py` spawner (`serve_storaged` + per-node
+  `--data-dir`s + a shared flagfile), so a `kill -9` is a real SIGKILL
+  against a real process that must come back on the SAME data dir.
+  Restarts may arm per-process fault plans through `env_extra`
+  (`NEBULA_TPU_FAULTS=crashpoint.wal_applied:...`), which is how the
+  storm forces a crash exactly between WAL append and engine apply.
+
+- **LedgerWriters** journals every *acknowledged* write into a
+  client-side durability ledger: an INSERT only enters the ledger when
+  the server said SUCCEEDED, retryable codes (leader moved, overload,
+  timeout, consensus-in-flight) are retried client-side and counted,
+  and anything else is a hard error. `verify_ledger` then fails the
+  run unless every acked edge is readable after recovery — the
+  definition of "a kill -9 is a non-event".
+"""
+from __future__ import annotations
+
+import importlib.util
+import json
+import os
+import random
+import signal
+import socket
+import threading
+import time
+import urllib.request
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from ..common.status import ErrorCode
+
+REPO = os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))))
+
+# client-side retry contract: these codes mean "the cluster is
+# reconfiguring, re-issue"; everything else is a non-retryable client
+# error and fails the storm
+RETRYABLE = {ErrorCode.E_LEADER_CHANGED, ErrorCode.E_OVERLOAD,
+             ErrorCode.E_TIMEOUT, ErrorCode.E_CONSENSUS_ERROR}
+
+_services_mod = None
+
+
+def services():
+    """scripts/services.py loaded as a module (it is a CLI script, not
+    a package member) — the daemon spawner the storm reuses."""
+    global _services_mod
+    if _services_mod is None:
+        path = os.path.join(REPO, "scripts", "services.py")
+        spec = importlib.util.spec_from_file_location(
+            "nebula_tpu_services", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        _services_mod = mod
+    return _services_mod
+
+
+# Listener ports are drawn BELOW the kernel's ephemeral range
+# (32768+ by default): a crash-restarted storaged must re-bind the
+# SAME port, and an ephemeral-range port can meanwhile be grabbed as
+# the *source* port of any outbound connection on the box (raft peer
+# dials, RPC pool reconnects — exactly what a crash storm generates),
+# turning the re-bind into a flaky EADDRINUSE.
+_PORT_LO, _PORT_HI = 21000, 29000
+_port_rng = random.Random()
+
+
+def _probe(*ports: int) -> bool:
+    socks = []
+    try:
+        for p in ports:
+            s = socket.socket()
+            s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+            socks.append(s)
+            s.bind(("127.0.0.1", p))
+        return True
+    except OSError:
+        return False
+    finally:
+        for s in socks:
+            s.close()
+
+
+def _free_port_pair() -> int:
+    """A port p with p+1 also free — storaged binds raft on port+1."""
+    for _ in range(512):
+        p = _port_rng.randrange(_PORT_LO, _PORT_HI, 2)
+        if _probe(p, p + 1):
+            return p
+    raise RuntimeError("no adjacent free port pair")
+
+
+def _free_port() -> int:
+    for _ in range(512):
+        p = _port_rng.randrange(_PORT_LO, _PORT_HI)
+        if _probe(p):
+            return p
+    raise RuntimeError("no free port")
+
+
+class StoragedProc:
+    def __init__(self, idx: int, port: int, ws_port: int, data_dir: str):
+        self.idx = idx
+        self.name = f"storaged{idx}"
+        self.port = port
+        self.ws_port = ws_port
+        self.data_dir = data_dir
+        self.pid: Optional[int] = None
+        self.restarts = 0
+
+    @property
+    def addr(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+
+class CrashTopology:
+    """metad + graphd(TPU) in-process, N replicated storaged
+    subprocesses on fixed ports and per-node data dirs."""
+
+    def __init__(self, run_dir: str, n: int = 3,
+                 flag_overrides: Optional[Dict[str, Any]] = None,
+                 tpu_engine=None, boot_timeout: float = 45.0):
+        from ..daemons import serve_graphd, serve_metad
+        self.run_dir = run_dir
+        os.makedirs(run_dir, exist_ok=True)
+        # a harness killed by SIGTERM (CI `timeout`) must still reach
+        # its finally/stop() — otherwise the detached storaged fleet
+        # outlives it and starves every later run on the box
+        if threading.current_thread() is threading.main_thread():
+            prev = signal.getsignal(signal.SIGTERM)
+
+            def _term(signum, frame):
+                if callable(prev) and prev not in (
+                        signal.SIG_IGN, signal.SIG_DFL):
+                    prev(signum, frame)
+                raise SystemExit(143)
+
+            signal.signal(signal.SIGTERM, _term)
+        # the subprocess flagfile: fast raft + the compaction knobs the
+        # storm asserts against (callers override per scenario)
+        flags: Dict[str, Any] = {
+            "heartbeat_interval_secs": 1,
+            "raft_heartbeat_ms": 60,
+            "raft_election_timeout_ms": 250,
+            "wal_compact_interval_secs": 1.0,
+            "wal_compact_lag": 300,
+            "wal_file_size": 32768,
+        }
+        flags.update(flag_overrides or {})
+        self.flags = flags
+        self.flagfile = os.path.join(run_dir, "storaged.flags")
+        with open(self.flagfile, "w") as f:
+            for k, v in flags.items():
+                f.write(f"--{k}={v}\n")
+        self.metad = serve_metad()
+        self.nodes: List[StoragedProc] = []
+        for i in range(n):
+            self.nodes.append(StoragedProc(
+                i, _free_port_pair(), _free_port(),
+                os.path.join(run_dir, f"s{i}")))
+        for i in range(n):
+            self.spawn(i)
+        self.wait_registered(timeout=boot_timeout)
+        self.tpu = tpu_engine
+        self.graphd = serve_graphd(self.metad.addr, tpu_engine=tpu_engine)
+
+    # ------------------------------------------------------ lifecycle
+    def spawn(self, i: int, env_extra: Optional[Dict[str, str]] = None
+              ) -> StoragedProc:
+        node = self.nodes[i]
+        argv = ["--meta", self.metad.addr, "--host", "127.0.0.1",
+                "--port", str(node.port), "--ws-port", str(node.ws_port),
+                "--replicated", "--data-dir", node.data_dir,
+                "--cluster-id-file",
+                os.path.join(node.data_dir, "cluster.id"),
+                "--flagfile", self.flagfile]
+        os.makedirs(node.data_dir, exist_ok=True)
+        node.pid = services().spawn_daemon(
+            self.run_dir, node.name, "nebula_tpu.daemons.storaged",
+            argv, env_extra=env_extra)
+        return node
+
+    def _reap(self, pid: int, block: bool = False) -> bool:
+        """True once the child is reaped (i.e. definitely dead). A
+        SIGKILLed child stays a signalable zombie until waited."""
+        try:
+            done, _ = os.waitpid(pid, 0 if block else os.WNOHANG)
+            return done == pid
+        except ChildProcessError:
+            return True
+
+    def sigkill(self, i: int) -> None:
+        node = self.nodes[i]
+        if node.pid is None:
+            return
+        try:
+            os.kill(node.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        self._reap(node.pid, block=True)
+        node.pid = None
+
+    def wait_exit(self, i: int, timeout: float = 60.0) -> bool:
+        """Wait for the process to die ON ITS OWN (crashpoint aborts);
+        True when it exited within the timeout."""
+        node = self.nodes[i]
+        if node.pid is None:
+            return True
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if self._reap(node.pid):
+                node.pid = None
+                return True
+            time.sleep(0.1)
+        return False
+
+    def restart(self, i: int,
+                env_extra: Optional[Dict[str, str]] = None
+                ) -> StoragedProc:
+        node = self.nodes[i]
+        assert node.pid is None, f"{node.name} still running"
+        node.restarts += 1
+        return self.spawn(i, env_extra=env_extra)
+
+    def stop(self) -> None:
+        try:
+            if getattr(self, "graphd", None) is not None:
+                self.graphd.stop()
+        except Exception:
+            pass
+        for node in self.nodes:
+            if node.pid is not None:
+                try:
+                    os.kill(node.pid, signal.SIGKILL)
+                except OSError:
+                    pass
+                self._reap(node.pid, block=True)
+                node.pid = None
+        try:
+            self.metad.stop()
+        except Exception:
+            pass
+
+    # ----------------------------------------------------- inspection
+    def http_json(self, i: int, path: str, timeout: float = 3.0) -> Any:
+        url = f"http://127.0.0.1:{self.nodes[i].ws_port}{path}"
+        with urllib.request.urlopen(url, timeout=timeout) as r:
+            return json.loads(r.read().decode())
+
+    def _log_tail(self, i: int, n: int = 8) -> str:
+        try:
+            with open(os.path.join(self.run_dir,
+                                   f"{self.nodes[i].name}.log")) as f:
+                return " | ".join(f.read().splitlines()[-n:])
+        except OSError:
+            return "<no log>"
+
+    def raft_parts(self, i: int) -> List[dict]:
+        try:
+            return self.http_json(i, "/raft").get("parts", [])
+        except Exception:
+            return []
+
+    def flight_events(self, i: int, kind: Optional[str] = None
+                      ) -> List[dict]:
+        try:
+            evs = self.http_json(i, "/flight?limit=400")["events"]
+        except Exception:
+            return []
+        return [e for e in evs if kind is None or e.get("kind") == kind]
+
+    def wait_registered(self, timeout: float = 45.0) -> None:
+        want = {n.addr for n in self.nodes if n.pid is not None}
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            have = {h.host for h in self.metad.meta.active_hosts("storage")}
+            if want <= have:
+                return
+            time.sleep(0.2)
+        raise AssertionError(
+            f"storaged fleet never registered: want {want}, "
+            f"have {[h.host for h in self.metad.meta.active_hosts()]}")
+
+    def wait_recovered(self, i: int, sid: int, nparts: int,
+                       timeout: float = 60.0) -> List[dict]:
+        """Block until the (re)started node serves /raft with all
+        `nparts` parts of space `sid` bound, every boot WAL tail fully
+        re-applied (wal_replay_done), and commitment caught up to the
+        fleet within a small slack. Returns the final /raft parts."""
+        deadline = time.monotonic() + timeout
+        last: List[dict] = []
+        node = self.nodes[i]
+        while time.monotonic() < deadline:
+            if node.pid is not None and self._reap(node.pid):
+                node.pid = None
+                raise AssertionError(
+                    f"{node.name} died during recovery: "
+                    f"{self._log_tail(i)}")
+            parts = [p for p in self.raft_parts(i) if p["space"] == sid]
+            last = parts
+            if len(parts) >= nparts and \
+                    all(p["wal_replay_done"] for p in parts):
+                # caught up? compare against the max committed seen
+                # anywhere (writers may still be appending)
+                peers_max: Dict[int, int] = {}
+                for j, other in enumerate(self.nodes):
+                    if other.pid is None:
+                        continue
+                    for p in self.raft_parts(j):
+                        if p["space"] == sid:
+                            peers_max[p["part"]] = max(
+                                peers_max.get(p["part"], 0),
+                                p["committed"])
+                mine = {p["part"]: p["committed"] for p in parts}
+                if all(peers_max.get(pt, 0) - mine.get(pt, 0) <= 64
+                       for pt in peers_max):
+                    return parts
+            time.sleep(0.25)
+        raise AssertionError(
+            f"{self.nodes[i].name} never recovered: {last}")
+
+    def wait_leaders(self, sid: int, nparts: int,
+                     timeout: float = 30.0) -> Dict[int, int]:
+        """{part: node_idx of leader} once every part has exactly one
+        leader among live nodes."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            leaders: Dict[int, List[int]] = {}
+            for j, node in enumerate(self.nodes):
+                if node.pid is None:
+                    continue
+                for p in self.raft_parts(j):
+                    if p["space"] == sid and p["role"] == "LEADER":
+                        leaders.setdefault(p["part"], []).append(j)
+            if len(leaders) >= nparts and \
+                    all(len(v) == 1 for v in leaders.values()):
+                return {pt: v[0] for pt, v in leaders.items()}
+            time.sleep(0.15)
+        raise AssertionError(f"no stable leader set for space {sid}")
+
+    def leader_counts(self, sid: int) -> Dict[int, int]:
+        out = {j: 0 for j, n in enumerate(self.nodes) if n.pid is not None}
+        for j in list(out):
+            for p in self.raft_parts(j):
+                if p["space"] == sid and p["role"] == "LEADER":
+                    out[j] += 1
+        return out
+
+    def wal_spans(self, sid: int) -> List[int]:
+        """last-first WAL span per live part replica — the disk/replay
+        bound the compaction task enforces."""
+        spans = []
+        for j, node in enumerate(self.nodes):
+            if node.pid is None:
+                continue
+            for p in self.raft_parts(j):
+                if p["space"] == sid:
+                    spans.append(p["last_log_id"]
+                                 - max(p["wal_first_log_id"] - 1, 0))
+        return spans
+
+
+# ---------------------------------------------------------------------------
+# graph load + durability ledger
+# ---------------------------------------------------------------------------
+
+def load_person_knows(gc, space: str, parts: int, v: int, e: int,
+                      seed: int, replica_factor: int = 3,
+                      settle_s: float = 30.0):
+    """Schema + batch-INSERT a random person/knows graph; the first
+    INSERT retries for `settle_s` while raft elections finish. Returns
+    (srcs, dsts, ts) for query seeding."""
+    rng = random.Random(seed)
+    srcs = [rng.randrange(v) for _ in range(e)]
+    dsts = [rng.randrange(v) for _ in range(e)]
+    ts = [(srcs[j] + dsts[j]) % 100000 for j in range(e)]
+    gc.must(f"CREATE SPACE {space}(partition_num={parts}, "
+            f"replica_factor={replica_factor})")
+    gc.must(f"USE {space}")
+    gc.must("CREATE TAG person(age int)")
+    gc.must("CREATE EDGE knows(ts int)")
+    B = 400
+    first = True
+    for i in range(0, v, B):
+        stmt = "INSERT VERTEX person(age) VALUES " + ", ".join(
+            f"{j}:({20 + j % 60})" for j in range(i, min(i + B, v)))
+        if first:
+            deadline = time.time() + settle_s
+            while True:
+                r = gc.execute(stmt)
+                if r.ok() or time.time() >= deadline:
+                    break
+                time.sleep(0.25)
+            assert r.ok(), r.error_msg
+            first = False
+        else:
+            gc.must(stmt)
+    for i in range(0, e, B):
+        gc.must("INSERT EDGE knows(ts) VALUES " + ", ".join(
+            f"{srcs[j]} -> {dsts[j]}@{j}:({ts[j]})"
+            for j in range(i, min(i + B, e))))
+    return srcs, dsts, ts
+
+
+class LedgerWriters:
+    """Closed-loop INSERT writers journaling every ACKED write. Edge
+    identity: rank = 10^6*(w+1)+seq is writer-unique, ts =
+    10^7*(w+1)+seq is globally unique, so (dst, ts) alone identifies a
+    write when read back through GO."""
+
+    def __init__(self, graphd_addr: str, space: str, v: int,
+                 n_writers: int = 2, pace_s: float = 0.008,
+                 retry_budget_s: float = 25.0):
+        self.addr = graphd_addr
+        self.space = space
+        self.v = v
+        self.pace_s = pace_s
+        self.retry_budget_s = retry_budget_s
+        self.ledger: List[Tuple[int, int, int, int]] = []  # a,b,rank,ts
+        self.errors: List[Tuple[str, str]] = []            # stmt, msg
+        self.retried = 0
+        self.unacked = 0        # submitted, never acked (crash window)
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._pause = threading.Event()
+        self._busy = [False] * n_writers
+        # nlint: disable=NL002 -- load-origin storm writers; no inbound
+        # trace to propagate
+        self._threads = [threading.Thread(target=self._run, args=(w,),
+                                          daemon=True)
+                         for w in range(n_writers)]
+
+    def start(self):
+        for t in self._threads:
+            t.start()
+        return self
+
+    def pause(self):
+        self._pause.set()
+
+    def quiesce(self, timeout: float = 30.0) -> bool:
+        """Pause AND wait until no write is in flight — identity
+        verifies must not race a statement that was already submitted
+        (a mid-retry write can land seconds later, between a TPU read
+        and its CPU twin). True when fully drained."""
+        self._pause.set()
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if not any(self._busy):
+                return True
+            time.sleep(0.02)
+        return False
+
+    def resume(self):
+        self._pause.clear()
+
+    def stop(self, timeout: float = 60.0):
+        self._stop.set()
+        self._pause.clear()
+        for t in self._threads:
+            t.join(timeout=timeout)
+
+    def _run(self, w: int) -> None:
+        from ..client import GraphClient
+        rng = random.Random(5200 + w)
+        c = GraphClient(self.addr).connect()
+        c.must(f"USE {self.space}")
+        seq = 0
+        while not self._stop.is_set():
+            if self._pause.is_set():
+                time.sleep(0.02)
+                continue
+            a = rng.randrange(self.v)
+            b = rng.randrange(self.v)
+            rank = 1_000_000 * (w + 1) + seq
+            ts = 10_000_000 * (w + 1) + seq
+            stmt = (f"INSERT EDGE knows(ts) VALUES "
+                    f"{a} -> {b}@{rank}:({ts})")
+            self._busy[w] = True
+            if self._pause.is_set():
+                # a quiesce() raced the pause check at loop top: with
+                # busy now visible, re-check — either we abort here or
+                # quiesce sees the flag and waits the write out; no
+                # interleaving lets a write slip between a verifier's
+                # paired reads
+                self._busy[w] = False
+                continue
+            try:
+                acked = self._exec_retry(c, stmt)
+            finally:
+                self._busy[w] = False
+            if acked:
+                with self._lock:
+                    self.ledger.append((a, b, rank, ts))
+            else:
+                with self._lock:
+                    self.unacked += 1
+            seq += 1
+            time.sleep(self.pace_s)
+
+    def _exec_retry(self, c, stmt: str) -> bool:
+        deadline = time.monotonic() + self.retry_budget_s
+        attempt = 0
+        while True:
+            r = c.execute(stmt)
+            if r.ok():
+                return True
+            if r.code in RETRYABLE and time.monotonic() < deadline:
+                with self._lock:
+                    self.retried += 1
+                attempt += 1
+                time.sleep(min(0.05 * (2 ** min(attempt, 5)), 1.0)
+                           * (0.5 + random.random() * 0.5))
+                continue
+            if r.code in RETRYABLE:
+                # budget exhausted on a retryable code: the write is
+                # UNACKED, not a contract violation — the ledger just
+                # never records it
+                return False
+            with self._lock:
+                self.errors.append((stmt, f"{r.code}: {r.error_msg}"))
+            return False
+
+    # ------------------------------------------------------ verification
+    def verify_ledger(self, gc) -> List[Tuple[int, Tuple[int, int]]]:
+        """Every acked write must be readable: for each source vertex,
+        GO over knows and check the acked (dst, ts) pairs all appear.
+        Returns the missing pairs (empty == durable)."""
+        with self._lock:
+            entries = list(self.ledger)
+        by_src: Dict[int, Set[Tuple[int, int]]] = {}
+        for a, b, rank, ts in entries:
+            by_src.setdefault(a, set()).add((b, ts))
+        missing: List[Tuple[int, Tuple[int, int]]] = []
+        for a, want in sorted(by_src.items()):
+            r = gc.must(f"GO FROM {a} OVER knows "
+                        f"YIELD knows._dst, knows.ts")
+            got = {(int(row[0]), int(row[1])) for row in r.rows}
+            for pair in want - got:
+                missing.append((a, pair))
+        return missing
+
+    def summary(self) -> dict:
+        with self._lock:
+            return {"acked": len(self.ledger),
+                    "unacked": self.unacked,
+                    "retried": self.retried,
+                    "errors": len(self.errors),
+                    "error_samples": self.errors[:5]}

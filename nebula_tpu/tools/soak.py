@@ -746,6 +746,144 @@ def run_soak_tenants(seconds: float = 8.0, seed: int = 21) -> dict:
     return out
 
 
+def run_soak_crash(seconds: float = 45.0, seed: int = 29) -> dict:
+    """`--crash`: periodic SIGKILL/restart of one SUBPROCESS storaged
+    (crashstorm topology: real processes on per-node data dirs, same
+    machinery as `bench --crash`) under continuous TPU-vs-CPU identity
+    verifies and ledger-journaling writers. ok requires: >= 2 crash/
+    restart cycles completed with recovery, every acked write readable
+    at the end, identity green throughout, zero non-retryable errors,
+    and >= 1 wal_replay flight event observed across the restarts."""
+    import shutil
+    import tempfile
+    import threading
+
+    from ..client import GraphClient
+    from ..engine_tpu import TpuGraphEngine
+    from .crashstorm import (RETRYABLE, CrashTopology, LedgerWriters,
+                             load_person_knows)
+
+    v, e, parts, space = 240, 1500, 3, "soakcrash"
+    run_dir = tempfile.mkdtemp(prefix="nebula_tpu_soakcrash_")
+    rng = random.Random(seed)
+    crashes = 0
+    replay_events = 0
+    verifies = 0
+    errors: list = []
+    topo = None
+    try:
+        tpu = TpuGraphEngine()
+        topo = CrashTopology(run_dir, n=3, tpu_engine=tpu)
+        gc = GraphClient(topo.graphd.addr).connect()
+        srcs, _dsts, _ts = load_person_knows(
+            gc, space, parts, v, e, seed, settle_s=30.0)
+        sid = topo.metad.meta.get_space(space).value().space_id
+        deg: dict = {}
+        for s in srcs:
+            deg[s] = deg.get(s, 0) + 1
+        hubs = [s for s, _ in sorted(deg.items(), key=lambda kv: -kv[1])
+                [:3]]
+        queries = [
+            f"GO 2 STEPS FROM {hubs[0]} OVER knows YIELD knows._dst",
+            f"GO FROM {hubs[1]}, {hubs[2]} OVER knows "
+            f"YIELD knows._dst, knows.ts",
+        ]
+        for q in queries:
+            gc.must(q)
+        topo.wait_leaders(sid, parts)
+        writers = LedgerWriters(topo.graphd.addr, space, v,
+                                n_writers=1, pace_s=0.015).start()
+        stop = threading.Event()
+
+        def verifier():
+            nonlocal verifies
+            rr = random.Random(seed + 1)
+            c = GraphClient(topo.graphd.addr).connect()
+            c.must(f"USE {space}")
+            while not stop.is_set():
+                time.sleep(0.15)
+                q = queries[rr.randrange(len(queries))]
+                # writes quiesced for the TPU/CPU pair — an in-flight
+                # write landing between the two reads would diverge
+                # them legitimately (the one-engine-toggle-at-a-time
+                # idiom every soak verify uses)
+                if not writers.quiesce(timeout=30.0):
+                    writers.resume()
+                    continue
+                try:
+                    rt = c.execute(q)
+                    if not rt.ok():
+                        if rt.code in RETRYABLE:
+                            continue
+                        errors.append(f"verify: [{rt.code.name}] "
+                                      f"{rt.error_msg}")
+                        stop.set()
+                        return
+                    tpu.enabled = False
+                    try:
+                        rc = c.execute(q)
+                    finally:
+                        tpu.enabled = True
+                    if not rc.ok():
+                        continue  # cluster reconfiguring: skip compare
+                    if sorted(map(repr, rt.rows)) != \
+                            sorted(map(repr, rc.rows)):
+                        errors.append(f"IDENTITY DIVERGENCE: {q}")
+                        stop.set()
+                        return
+                    verifies += 1
+                finally:
+                    writers.resume()
+
+        # nlint: disable=NL002 -- soak-lifetime verifier; no inbound trace
+        vt = threading.Thread(target=verifier, daemon=True)
+        vt.start()
+        deadline = time.monotonic() + seconds
+        while time.monotonic() < deadline and not stop.is_set():
+            time.sleep(min(3.0, max(seconds / 4, 1.0)))
+            if stop.is_set():
+                break
+            i = rng.choice([j for j, n in enumerate(topo.nodes)
+                            if n.pid is not None])
+            topo.sigkill(i)
+            time.sleep(0.8)
+            topo.restart(i)
+            try:
+                topo.wait_recovered(i, sid, parts, timeout=90)
+            except AssertionError as ex:
+                errors.append(str(ex))
+                stop.set()
+                break
+            replay_events += len(topo.flight_events(i, "wal_replay"))
+            crashes += 1
+        writers.pause()
+        time.sleep(0.3)
+        missing = writers.verify_ledger(gc)
+        wsum = writers.summary()
+        stop.set()
+        writers.stop()
+        vt.join(timeout=30)
+        out = {
+            "seconds": seconds, "crashes": crashes,
+            "identity_verifies": verifies,
+            "wal_replay_events": replay_events,
+            "ledger": {**wsum, "missing": len(missing),
+                       "missing_samples": missing[:5]},
+            "errors": errors[:5],
+        }
+        out["ok"] = (not errors and crashes >= 2
+                     and len(missing) == 0 and wsum["errors"] == 0
+                     and wsum["acked"] > 0 and verifies >= 10
+                     and replay_events >= 1)
+        return out
+    finally:
+        try:
+            if topo is not None:
+                topo.stop()
+        finally:
+            shutil.rmtree(run_dir, ignore_errors=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="mixed INSERT+GO soak with continuous CPU/TPU "
@@ -778,6 +916,13 @@ def main(argv=None) -> int:
                          "sleep observed under a witnessed lock; the "
                          "observed graph lands in the output and in "
                          "the debug bundle on identity failure")
+    ap.add_argument("--crash", action="store_true",
+                    help="periodic SIGKILL/restart of one subprocess "
+                         "storaged (the bench --crash topology) under "
+                         "continuous identity verifies + a durability "
+                         "ledger: every acked write must be readable "
+                         "after each recovery (docs/manual/"
+                         "12-replication.md)")
     ap.add_argument("--tenants", action="store_true",
                     help="skewed multi-tenant load under the QoS "
                          "ladder (one abusive tenant vs small ones; "
@@ -791,7 +936,9 @@ def main(argv=None) -> int:
         # earlier imports are only covered via NEBULA_TPU_LOCK_WITNESS)
         from ..common.lockwitness import witness
         witness.install()
-    if args.tenants:
+    if args.crash:
+        out = run_soak_crash(args.seconds)
+    elif args.tenants:
         out = run_soak_tenants(args.seconds)
     elif args.concurrent:
         out = run_soak_concurrent(args.seconds, args.threads,

@@ -50,16 +50,31 @@ def _read_pid(run_dir: str, name: str):
         return None
 
 
-def _spawn(run_dir: str, name: str, module: str, args) -> int:
+def spawn_daemon(run_dir: str, name: str, module: str, args,
+                 env_extra=None) -> int:
+    """Start one daemon as a detached subprocess: appending log at
+    <run-dir>/<name>.log, pidfile at <run-dir>/<name>.pid, repo on
+    PYTHONPATH, own session (a SIGKILL storm can't splash the
+    parent). Shared by the CLI below and the crash-storm harness
+    (nebula_tpu/tools/crashstorm.py — `bench --crash` boots its
+    storaged fleet through exactly this path). `env_extra` lets a
+    harness arm per-process fault plans (NEBULA_TPU_FAULTS
+    crashpoints) without touching its own environment."""
     log = open(os.path.join(run_dir, f"{name}.log"), "a")
     env = dict(os.environ, PYTHONPATH=REPO + os.pathsep +
                os.environ.get("PYTHONPATH", ""))
+    if env_extra:
+        env.update(env_extra)
     p = subprocess.Popen([sys.executable, "-m", module, *args],
                          stdout=log, stderr=subprocess.STDOUT, env=env,
                          start_new_session=True)
     with open(_pidfile(run_dir, name), "w") as f:
         f.write(str(p.pid))
     return p.pid
+
+
+def _spawn(run_dir: str, name: str, module: str, args) -> int:
+    return spawn_daemon(run_dir, name, module, args)
 
 
 def start(args) -> int:

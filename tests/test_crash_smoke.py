@@ -1,0 +1,69 @@
+"""Tier-1-safe crash-storm smoke: `bench.py --crash --trim` in a
+SUBPROCESS on XLA:CPU — metad + TPU graphd in-process, 3 replicated
+storaged as real SUBPROCESSES, a SIGKILL/restart-on-same-data-dir
+cycle plus a `crashpoint.wal_applied`-forced crash exactly between WAL
+append and engine apply, under ledger-journaling writers. The run must
+show every ACKED write readable after recovery, zero non-retryable
+client errors, TPU-vs-CPU identity green post-recovery, and >= 1
+`wal_replay` flight event per recovery (docs/manual/12-replication.md,
+"Crash recovery & compaction")."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def crash_smoke(tmp_path_factory):
+    out = tmp_path_factory.mktemp("crash") / "CRASH_smoke.json"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_CRASH_SEED"] = "23"
+    env["BENCH_CRASH_OUT"] = str(out)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--crash", "--trim"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, (proc.stdout[-2000:],
+                                  proc.stderr[-2000:])
+    with open(out) as f:
+        return json.load(f)
+
+
+def test_crash_ledger_green(crash_smoke):
+    """The durability contract: every write the client was told
+    SUCCEEDED is readable after the storm — zero acked-write loss —
+    and no client ever saw a non-retryable error."""
+    led = crash_smoke["ledger"]
+    assert led["acked"] > 0
+    assert led["missing"] == 0, led["missing_samples"]
+    assert led["errors"] == 0, led["error_samples"]
+    assert crash_smoke["readers"]["errors"] == 0, \
+        crash_smoke["readers"]["error_samples"]
+
+
+def test_crash_recovery_replayed_and_flight_recorded(crash_smoke):
+    """Each SIGKILL/restart cycle (including the crashpoint-forced
+    crash between WAL append and engine apply) replayed its WAL tail,
+    captured >= 1 wal_replay flight event, and stayed under the
+    compaction replay bound."""
+    assert crash_smoke["cycles"] >= 2
+    labels = {r["cycle"] for r in crash_smoke["recoveries"]}
+    assert "crashpoint_wal_applied" in labels
+    for r in crash_smoke["recoveries"]:
+        assert r["replay_events"] >= 1, r
+        assert r["replay_max_n"] <= crash_smoke["replay"]["bound"], r
+    assert sum(r["replayed_total"]
+               for r in crash_smoke["recoveries"]) > 0
+
+
+def test_crash_identity_and_bounds(crash_smoke):
+    assert crash_smoke["identity_post_recovery"] is True
+    assert crash_smoke["device_served_post_recovery"] is True
+    assert crash_smoke["wal_spans"]["max"] <= \
+        crash_smoke["wal_spans"]["bound"]
+    assert crash_smoke["ok"] is True

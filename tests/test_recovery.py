@@ -1,0 +1,387 @@
+"""Crash-recovery tests (ISSUE 11 tier-1): same-dir restart replay,
+mid-snapshot-install crash convergence, corrupted raft_state fallback,
+and the compaction safety invariant — the in-process twins of what
+`bench.py --crash` proves with real SIGKILLed subprocesses
+(docs/manual/12-replication.md, "Crash recovery & compaction")."""
+import os
+import time
+
+import pytest
+
+from nebula_tpu.common import keys as keyutils
+from nebula_tpu.common.flight import recorder as flight
+from nebula_tpu.common.stats import stats
+from nebula_tpu.kvstore.raft_store import StorageNode
+from nebula_tpu.kvstore.raftex import InProcNetwork, RaftCode, Role
+from nebula_tpu.kvstore.raftex.types import SendSnapshotRequest
+from raft_fixture import FAST, RaftCluster
+
+ADDRS = ["n0", "n1", "n2"]
+
+
+def _mk_nodes(tmp_path, net, **raft_kw):
+    kw = {**FAST, **raft_kw}
+    return {a: StorageNode(a, str(tmp_path), net, **kw) for a in ADDRS}
+
+
+def _wait_leader(nodes, sid=1, pid=1, timeout=6.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        leaders = [a for a, n in nodes.items()
+                   if n.raft(sid, pid) is not None
+                   and n.raft(sid, pid).is_leader()]
+        if len(leaders) == 1:
+            return leaders[0]
+        time.sleep(0.02)
+    raise AssertionError("no single leader")
+
+
+def _part_rows(node, sid=1, pid=1):
+    eng = node.store.space_engine(sid)
+    return sorted((k, v) for k, v in
+                  eng.prefix(keyutils.part_data_prefix(pid, 0x01)))
+
+
+def _wait_rows_equal(a, b, timeout=8.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        ra, rb = _part_rows(a), _part_rows(b)
+        if ra == rb and ra:
+            return ra
+        time.sleep(0.05)
+    raise AssertionError(f"engines never converged:\n{_part_rows(a)}\n"
+                         f"vs\n{_part_rows(b)}")
+
+
+def _kv(i: int):
+    return (keyutils.part_data_prefix(1, 0x01) + b"k%04d" % i,
+            b"v%04d" % i)
+
+
+# ---------------------------------------------------------------- restart
+
+def test_same_dir_restart_replays_tail_and_serves_identical_bytes(tmp_path):
+    """A storage node killed and re-bound on its OWN data dir replays
+    the WAL tail through the normal commit_logs path and converges to
+    byte-identical part contents — including writes that landed while
+    it was down. The replay is visible: wal_replayed > 0 on the
+    restarted part and a `wal_replay` flight event in the ring."""
+    net = InProcNetwork()
+    nodes = _mk_nodes(tmp_path, net)
+    try:
+        for a in ADDRS:
+            nodes[a].add_part(1, 1, ADDRS)
+        leader = _wait_leader(nodes)
+        store = nodes[leader].store
+        assert store.async_multi_put(
+            1, 1, [_kv(i) for i in range(20)]).ok()
+        victim = next(a for a in ADDRS if a != leader)
+        _wait_rows_equal(nodes[leader], nodes[victim])
+
+        nodes[victim].stop()            # "kill": raft + service down
+        assert store.async_multi_put(
+            1, 1, [_kv(i) for i in range(20, 35)]).ok()
+
+        # restart on the SAME data dir: fresh engines (marker 0 for
+        # the in-memory engine — the worst case), full WAL replay
+        nodes[victim] = StorageNode(victim, str(tmp_path), net, **FAST)
+        nodes[victim].add_part(1, 1, ADDRS)
+        rows = _wait_rows_equal(nodes[leader], nodes[victim])
+        assert len(rows) == 35          # identical bytes incl. the gap
+        st = nodes[victim].raft(1, 1).status()
+        assert st["wal_replayed"] > 0
+        assert st["wal_replay_done"] is True
+        evs = [e for e in flight.describe(limit=400)["events"]
+               if e["kind"] == "wal_replay" and e.get("addr") == victim]
+        assert evs, "no wal_replay flight event for the restart"
+        assert evs[0]["n"] == st["wal_replayed"]
+    finally:
+        for n in nodes.values():
+            n.stop()
+        net.shutdown()
+
+
+def test_restarted_member_with_history_is_not_a_learner(tmp_path):
+    """The topology-join heuristic flags restarted parts as learners
+    (group already formed elsewhere) — but a replica with durable WAL/
+    term history is a returning MEMBER; staying a learner would
+    silently shrink the voting set (RaftPart same-dir restart
+    fencing)."""
+    c = RaftCluster(3, tmp_path)
+    try:
+        leader = c.wait_leader()
+        for i in range(5):
+            leader.append_async(b"m%d" % i).result(timeout=3)
+        c.wait_commit(5)
+        victim = next(a for a in c.voting if a != leader.addr)
+        c.kill(victim)
+        part = c.restart(victim, is_learner=True)   # heuristic verdict
+        assert part.role is not Role.LEARNER        # history overrides
+        c.wait_commit(5)
+    finally:
+        c.stop()
+
+
+# ------------------------------------------------------------- snapshot
+
+def test_mid_snapshot_crash_receiver_rerequests_and_converges(tmp_path):
+    """A receiver that dies mid-snapshot-install (partial rows applied,
+    part prefix cleared, NO commit marker) must be able to re-request
+    the snapshot after restart and converge — the in-process twin of
+    the `crashpoint.snapshot_recv` cycle in bench --crash."""
+    net = InProcNetwork()
+    # tiny segments so compaction can actually evict the gap
+    nodes = _mk_nodes(tmp_path, net, wal_file_size=512)
+    try:
+        for a in ADDRS:
+            nodes[a].add_part(1, 1, ADDRS)
+        leader = _wait_leader(nodes)
+        store = nodes[leader].store
+        assert store.async_multi_put(
+            1, 1, [_kv(i) for i in range(10)]).ok()
+        victim = next(a for a in ADDRS if a != leader)
+        _wait_rows_equal(nodes[leader], nodes[victim])
+        nodes[victim].stop()
+
+        # while the victim is down: enough singles to roll segments,
+        # then compact the survivors behind their applied anchor so
+        # the victim's gap is truncated -> snapshot is the ONLY way in
+        for i in range(10, 60):
+            assert store.async_multi_put(1, 1, [_kv(i)]).ok()
+        for a in ADDRS:
+            if a != victim:
+                nodes[a].compact_wals(lag=0)
+        lead_raft = nodes[leader].raft(1, 1)
+        assert lead_raft.wal.first_log_id > 1, "nothing compacted"
+
+        # restart + simulate the crashpoint: a PARTIAL install (one
+        # non-done chunk) lands, then the process dies again
+        nodes[victim] = StorageNode(victim, str(tmp_path), net,
+                                    **{**FAST,
+                                       "wal_file_size": 512})
+        nodes[victim].add_part(1, 1, ADDRS)
+        vr = nodes[victim].raft(1, 1)
+        vr.process_send_snapshot(SendSnapshotRequest(
+            space=1, part=1, term=max(vr.term, lead_raft.term),
+            leader=leader, committed_log_id=lead_raft.committed_id,
+            committed_log_term=lead_raft.wal.last_log_term,
+            rows=[_kv(0)], total_size=1, total_count=2, done=False))
+        nodes[victim].stop()            # crash between chunks
+
+        # clean restart: marker 0 + truncated gap => the leader must
+        # send a FULL snapshot again; the receiver converges
+        nodes[victim] = StorageNode(victim, str(tmp_path), net,
+                                    **{**FAST,
+                                       "wal_file_size": 512})
+        nodes[victim].add_part(1, 1, ADDRS)
+        rows = _wait_rows_equal(nodes[leader], nodes[victim],
+                                timeout=12.0)
+        assert len(rows) == 60
+        evs = [e for e in flight.describe(limit=400)["events"]
+               if e["kind"] == "snapshot_install"
+               and e.get("addr") == victim]
+        assert evs, "no snapshot_install flight event"
+    finally:
+        for n in nodes.values():
+            try:
+                n.stop()
+            except Exception:
+                pass
+        net.shutdown()
+
+
+# ------------------------------------------------------------ raft_state
+
+def test_corrupted_raft_state_falls_back_without_wedging(tmp_path):
+    """A torn/garbage raft_state file is detected by the checksum at
+    load, counted + flight-recorded, and the replica falls back to
+    defaults instead of silently parsing garbage — and the cluster
+    still elects (term catch-up via vote responses)."""
+    c = RaftCluster(3, tmp_path)
+    state_paths = [p._state_path for p in c.parts.values()]
+    try:
+        leader = c.wait_leader()
+        for i in range(5):
+            leader.append_async(b"s%d" % i).result(timeout=3)
+        c.wait_commit(5)
+        # the hardened writer produced the checksummed 4-line format
+        # (term, voted_for, role L|V, crc)
+        for sp in state_paths:
+            if os.path.exists(sp):
+                lines = open(sp).read().splitlines()
+                assert len(lines) == 4
+                assert lines[2] in ("L", "V")
+    finally:
+        c.stop()
+
+    # corrupt ONE replica's state file with a torn/garbage write
+    with open(state_paths[0], "w") as f:
+        f.write("999999\nno-such-candidate\ndeadbeef\n")
+    before = stats.lifetime_total("raftex.state_recovered")
+    c2 = RaftCluster(3, tmp_path)
+    try:
+        assert stats.lifetime_total("raftex.state_recovered") > before
+        leader = c2.wait_leader(timeout=8.0)   # no wedge
+        assert leader.append_async(b"post").result(timeout=5) is \
+            RaftCode.SUCCEEDED
+    finally:
+        c2.stop()
+
+
+def test_state_file_survives_and_roundtrips(tmp_path):
+    """_persist_state -> _load_state round trip across a restart: the
+    persisted (term, voted_for) pair comes back verbatim under the
+    checksummed format; the legacy 2-line format still parses."""
+    c = RaftCluster(1, tmp_path)
+    try:
+        leader = c.wait_leader()
+        term = leader.term
+        sp = leader._state_path
+        assert term >= 1
+    finally:
+        c.stop()
+    c2 = RaftCluster(1, tmp_path)
+    try:
+        # the restarted part adopted at least the persisted term
+        part = list(c2.parts.values())[0]
+        assert part.term >= term
+    finally:
+        c2.stop()
+    # legacy 2-line file (pre-checksum) is accepted, not "recovered"
+    with open(sp, "w") as f:
+        f.write("7\nsomeone\n")
+    before = stats.lifetime_total("raftex.state_recovered")
+    c3 = RaftCluster(1, tmp_path)
+    try:
+        assert stats.lifetime_total("raftex.state_recovered") == before
+        assert list(c3.parts.values())[0].term >= 7
+    finally:
+        c3.stop()
+
+
+# ------------------------------------------------------------ compaction
+
+def test_boot_tail_membership_commands_reapply_without_crashing(tmp_path):
+    """A membership COMMAND left in the boot tail (crash before the
+    commit marker covered it) re-applies to the in-memory peer set at
+    bind — including REMOVE_PEER, which touches self.hosts and must
+    not blow up the constructor."""
+    from nebula_tpu.kvstore.raftex import RaftexService
+    from nebula_tpu.kvstore.raftex.raft_part import (
+        _M_COMMAND, CMD_ADD_LEARNER, CMD_REMOVE_PEER, RaftPart,
+        _encode_cmd)
+    from nebula_tpu.kvstore.wal import Wal
+
+    wal_dir = str(tmp_path / "boot")
+    os.makedirs(wal_dir)
+    w = Wal(os.path.join(wal_dir, "wal"))
+    w.append(1, 1, 0, b"\x00payload")
+    w.append(2, 1, 0, _M_COMMAND + _encode_cmd(CMD_REMOVE_PEER, "nX"))
+    w.append(3, 1, 0, _M_COMMAND + _encode_cmd(CMD_ADD_LEARNER, "nL"))
+    w.close()
+    net = InProcNetwork()
+    svc = RaftexService("n0", net)
+    part = RaftPart(space_id=1, part_id=1, addr="n0",
+                    peers=["n0", "n1", "nX"], wal_dir=wal_dir,
+                    service=svc, on_commit=lambda logs: None,
+                    applied_id=0, **FAST)
+    try:
+        assert "nX" not in part.peers       # REMOVE_PEER re-applied
+        assert "nL" in part.learners        # ADD_LEARNER re-applied
+        assert part.status()["wal_replay_done"] is False
+    finally:
+        part.stop()
+        svc.stop()
+        net.shutdown()
+
+
+def test_compaction_never_truncates_past_unapplied_entries(tmp_path):
+    """compact_wal clamps the anchor to committed_id — and bounds the
+    TTL sweep by it too (wal_ttl_secs=0 makes every sealed segment
+    age-eligible here): entries appended but NOT yet committed (no
+    quorum) survive any compaction request, however aggressive the
+    caller's anchor/lag and however old the segments."""
+    c = RaftCluster(3, tmp_path, wal_file_size=512, wal_ttl_secs=0)
+    try:
+        leader = c.wait_leader()
+        for i in range(60):
+            assert leader.append_async(b"c%03d" % i).result(timeout=3) \
+                is RaftCode.SUCCEEDED
+        c.wait_commit(60)
+        committed = leader.committed_id
+        # cut the leader off so new appends can NEVER commit
+        for a in c.voting:
+            if a != leader.addr:
+                c.isolate(a)
+        futs = [leader.append_async(b"uncommitted-%d" % i)
+                for i in range(10)]
+        first_unapplied = committed + 1
+        tail_last = leader.wal.last_log_id
+        assert tail_last >= committed + 10
+
+        # the most aggressive possible request: absurd anchor, lag 0
+        out = leader.compact_wal(0, anchor=10 ** 9)
+        assert out["anchor"] <= committed
+        assert out["removed"] > 0          # sealed prefix did go
+        assert leader.wal.first_log_id <= first_unapplied
+        got = [e.log_id for e in leader.wal.iterate(first_unapplied,
+                                                    tail_last)]
+        assert got == list(range(first_unapplied, tail_last + 1)), \
+            "an unapplied entry was truncated"
+        for a in c.voting:
+            c.heal(a)
+        for f in futs:
+            f.result(timeout=5)
+    finally:
+        c.stop()
+
+
+def test_ttl_clean_wired_through_compaction_task_body(tmp_path):
+    """Satellite: the orphaned Wal.clean_ttl finally has a caller —
+    StorageNode.compact_wals (the storaged background task body) runs
+    it per part; `raftex.wal_cleaned` counts the removed segments."""
+    net = InProcNetwork()
+    nodes = _mk_nodes(tmp_path, net, wal_file_size=512, wal_ttl_secs=0)
+    try:
+        for a in ADDRS:
+            nodes[a].add_part(1, 1, ADDRS)
+        leader = _wait_leader(nodes)
+        store = nodes[leader].store
+        for i in range(60):
+            assert store.async_multi_put(1, 1, [_kv(i)]).ok()
+        before = stats.lifetime_total("raftex.wal_cleaned")
+        # a HUGE lag disables the anchor clean entirely: whatever goes
+        # is the TTL sweep's doing (ttl=0 -> every sealed segment)
+        out = nodes[leader].compact_wals(lag=10 ** 9)
+        assert sum(r["removed"] for r in out.values()) > 0
+        assert stats.lifetime_total("raftex.wal_cleaned") > before
+        assert nodes[leader].raft(1, 1).wal_cleaned > 0
+        # tail intact and the part still serves
+        assert store.async_multi_put(1, 1, [_kv(1000)]).ok()
+    finally:
+        for n in nodes.values():
+            n.stop()
+        net.shutdown()
+
+
+def test_evacuation_purges_wal_dir(tmp_path):
+    """remove_part deletes the part's WAL + raft_state alongside the
+    engine data, so a later re-add of the same part starts clean
+    instead of impersonating a same-dir member restart."""
+    net = InProcNetwork()
+    nodes = _mk_nodes(tmp_path, net)
+    try:
+        for a in ADDRS:
+            nodes[a].add_part(1, 1, ADDRS)
+        leader = _wait_leader(nodes)
+        assert nodes[leader].store.async_multi_put(
+            1, 1, [_kv(0)]).ok()
+        victim = next(a for a in ADDRS if a != leader)
+        wal_dir = nodes[victim].hooks[(1, 1)].wal_dir
+        assert os.path.isdir(wal_dir)
+        nodes[victim].remove_part(1, 1)
+        assert not os.path.exists(wal_dir)
+    finally:
+        for n in nodes.values():
+            n.stop()
+        net.shutdown()

@@ -167,6 +167,61 @@ def test_ttl_cleanup(wdir):
     w.close()
 
 
+def test_clean_before_drops_only_sealed_prefix(wdir):
+    w = Wal(wdir, max_file_size=256)
+    for i in range(1, 101):
+        w.append(i, 1, 0, b"cb-%03d" % i)
+    n_before = len([f for f in os.listdir(wdir) if f.endswith(".wal")])
+    assert n_before > 3
+    removed = w.clean_before(60)
+    assert removed > 0
+    # every record >= 60 survives; nothing above the anchor is touched
+    assert w.first_log_id <= 60
+    assert w.last_log_id == 100
+    assert [e.log_id for e in w.iterate(60, 62)] == [60, 61, 62]
+    # idempotent: a second call with the same anchor is a no-op
+    assert w.clean_before(60) == 0
+    w.close()
+    # survives reopen: the compacted WAL recovers [first..100]
+    w2 = Wal(wdir, max_file_size=256)
+    assert w2.last_log_id == 100
+    assert w2.first_log_id <= 60
+    w2.close()
+
+
+def test_clean_before_never_touches_active_segment(wdir):
+    w = Wal(wdir)                       # single (active) segment
+    for i in range(1, 21):
+        w.append(i, 1, 0, b"x%d" % i)
+    # an anchor past the end must not drop the active segment
+    assert w.clean_before(10 ** 9) == 0
+    assert w.first_log_id == 1
+    assert w.last_log_id == 20
+    w.close()
+
+
+def test_torn_tail_fault_point_recovers_on_reopen(wdir):
+    """Satellite: the `wal.torn_tail` fault point truncates trailing
+    bytes at close — the next open must CRC-truncate the torn record
+    and recover the prefix (the native torn-tail path proven
+    end-to-end from Python, docs/manual/9-robustness.md)."""
+    from nebula_tpu.common.faults import faults
+    w = Wal(wdir)
+    for i in range(1, 11):
+        w.append(i, 1, 0, b"tt-%d" % i)
+    try:
+        faults.set_plan("wal.torn_tail:n=1")
+        w.close()
+        assert faults.counts().get("wal.torn_tail") == 1
+    finally:
+        faults.reset()
+    w2 = Wal(wdir)
+    assert w2.last_log_id == 9          # torn record 10 dropped
+    assert w2.append(10, 2, 0, b"rewritten")
+    assert list(w2.iterate(10))[0].data == b"rewritten"
+    w2.close()
+
+
 def test_cluster_field_roundtrip(wdir):
     w = Wal(wdir)
     w.append(1, 1, 12345, struct.pack("<q", -99))
