@@ -317,7 +317,13 @@ def span_breakdown_run(run_queries, n_samples):
     — BENCH_*.json tracks WHERE the time goes (dispatcher_wait /
     kernel / materialize / encode), not just end-to-end QPS. The
     forced-sample pass runs OUTSIDE the measured loops so sampling
-    overhead never touches the headline numbers."""
+    overhead never touches the headline numbers.
+
+    The same sampled traces feed the critical-path analyzer (ISSUE
+    12): the artifact's `attribution` block must explain where the
+    wall time went — per-(span, host) self-time shares plus the mean
+    explained fraction (common/critpath.py)."""
+    from nebula_tpu.common import critpath
     from nebula_tpu.common.tracing import stage_breakdown, tracer
     # identify NEW traces by id, not ring position: the ring is
     # bounded, so once full its length stops growing and a positional
@@ -331,6 +337,7 @@ def span_breakdown_run(run_queries, n_samples):
               and not t.get("remote_fragment")]
     out = stage_breakdown(traces)
     out["sampled_traces"] = len(traces)
+    out["attribution"] = critpath.aggregate(traces)
     return out
 
 
@@ -2117,6 +2124,13 @@ def bench_cluster(out_path: str, trim: bool = False):
                 post_balance_device = True
                 break
             time.sleep(0.4)
+        # forced-sample attribution pass (ISSUE 12): where a cluster
+        # query's wall time actually goes, per span and host — runs
+        # quiesced, off the measured phases, over the warm query pool
+        n_attr = len(queries) * (2 if trim else 3)
+        spans_cluster = span_breakdown_run(
+            lambda: [gc.must(q)
+                     for q in queries * (2 if trim else 3)], n_attr)
         stop.set()
         resume()
         for t in threads:
@@ -2174,6 +2188,11 @@ def bench_cluster(out_path: str, trim: bool = False):
                     "raftex.membership_reconciled"),
                 "balance_task_rows": len(balance_rows),
             },
+            # ISSUE 12: span breakdown + dominant-path attribution of
+            # the forced-sample pass — the artifact must EXPLAIN where
+            # cluster wall time went, not just report it
+            "span_breakdown": spans_cluster,
+            "attribution": spans_cluster["attribution"],
             "lock_witness": _witness_summary(),
         }
         # "bounded p99 impact": no phase may starve queries toward the
@@ -2182,9 +2201,13 @@ def bench_cluster(out_path: str, trim: bool = False):
         p99_bounded = all(
             (phases[ph].get("p99_ms") or 0) < 15000
             for ph in ("failover", "balance"))
+        # the attribution must explain >= 80% of sampled wall time
+        # (acceptance: a cost story with holes is not a cost story)
+        attribution_ok = rec["attribution"]["explained"] >= 0.8 and \
+            rec["attribution"]["sampled_traces"] > 0
         ok = (not errors and identity_failover and identity_balance
               and post_failover_device and balance_done and evacuated
-              and fully_replicated and p99_bounded
+              and fully_replicated and p99_bounded and attribution_ok
               and all(phases[ph]["n"] > 0 for ph in phases)
               and rec["lock_witness"]["clean"])
         rec["ok"] = ok

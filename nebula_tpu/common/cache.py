@@ -40,6 +40,7 @@ import threading
 from collections import OrderedDict
 from typing import Any, Callable, Dict, Hashable, Optional
 
+from . import ledger as _ledger
 from .stats import stats as global_stats
 
 MODE_OFF = "off"
@@ -119,6 +120,14 @@ class CacheRung:
                 self.hits += 1
                 miss = False
         self._count("miss" if miss else "hit")
+        # per-query cost ledger: rung hits/misses on this query's path
+        # (one ContextVar read when no ledger is live)
+        led = _ledger.current()
+        if led is not None:
+            if miss:
+                led.cache_misses += 1
+            else:
+                led.cache_hits += 1
         return default if miss else v
 
     def put(self, key: Hashable, value: Any) -> None:

@@ -1,0 +1,183 @@
+"""Critical-path analysis over trace span trees: fold a finished trace
+— including cross-host storaged fragments — into a dominant-path
+attribution: "73% proc.scan_part on host B, 11% dispatcher.wait"
+(docs/manual/10-observability.md, "Cost ledger & critical path").
+
+A span tree answers "what happened"; this module answers "what should
+the next optimization attack". Two reductions over one trace dict
+(the common/tracing.py ring shape):
+
+1. SELF-TIME ATTRIBUTION — every span's self time (its duration minus
+   the time covered by its children, interval-merged so concurrent
+   children are not double-subtracted) is aggregated by (name, host)
+   and expressed as a fraction of the root's wall time. Spans whose
+   parent is missing from the tree (a remote fragment whose graft
+   raced the trace finish, a dropped span) are treated as extra roots:
+   their time still attributes, nothing silently disappears.
+
+2. CRITICAL PATH — from the root, repeatedly descend into the child
+   covering the largest share of its parent's duration; the resulting
+   chain is the path a latency optimization must shorten. Remote
+   fragments participate naturally: storaged's fragment root is a
+   child of the caller's rpc.call span (the PR 4 graft contract).
+
+`explained` is the fraction of the root's wall time attributed to
+spans OTHER than the root's own self time — the root's self time is
+precisely the wall time no instrumented seam covered, so a low
+`explained` means the span set has a hole, not that the query was
+fast. (Capped at 1.0: attributed time on concurrent spans can exceed
+wall time.) Bench tier-2/3 and CLUSTER_bench run this over
+their forced-sample pass (bench.py) and publish the aggregate as the
+artifact's `attribution` block; `/traces?critpath=<id>` serves the
+single-trace form.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Tuple
+
+# span names that identify where work ran remotely: fragment roots are
+# "<service>.<method>" (tracing.RemoteTrace); processor spans carry an
+# explicit host tag (storage/processors.py)
+_HOST_TAG = "host"
+
+
+def _merged_coverage(intervals: List[Tuple[int, int]]) -> int:
+    """Total microseconds covered by a set of [start, end) intervals
+    (children overlap when they ran concurrently)."""
+    if not intervals:
+        return 0
+    intervals.sort()
+    total = 0
+    cur_s, cur_e = intervals[0]
+    for s, e in intervals[1:]:
+        if s > cur_e:
+            total += cur_e - cur_s
+            cur_s, cur_e = s, e
+        else:
+            cur_e = max(cur_e, e)
+    total += cur_e - cur_s
+    return total
+
+
+def _span_host(span: Dict[str, Any],
+               inherited: Optional[str]) -> Optional[str]:
+    """The host a span's work ran on: its own `host` tag wins, else the
+    nearest ancestor's (fragment roots rarely tag themselves but their
+    processor children do — and vice versa)."""
+    h = span.get("tags", {}).get(_HOST_TAG)
+    return str(h) if h is not None else inherited
+
+
+def analyze(trace: Dict[str, Any], top: int = 8) -> Dict[str, Any]:
+    """Fold one finished trace into its attribution. Returns:
+
+      {"trace_id", "wall_us",
+       "attribution": [{"name", "host", "self_us", "pct"}...],
+       "critical_path": [{"name", "host", "dur_us", "pct"}...],
+       "explained": float}       # capped at 1.0
+
+    Degenerate inputs (no spans, a single span, orphaned subtrees) are
+    handled, never raised on — this runs inside /traces handlers and
+    bench artifact assembly."""
+    spans = list(trace.get("spans", ()))
+    if not spans:
+        return {"trace_id": trace.get("trace_id", ""), "wall_us": 0,
+                "attribution": [], "critical_path": [],
+                "explained": 0.0}
+    by_id = {s["span_id"]: s for s in spans}
+    children: Dict[str, List[Dict[str, Any]]] = {}
+    roots: List[Dict[str, Any]] = []
+    for s in spans:
+        if s.get("parent_id") and s["parent_id"] in by_id \
+                and s["parent_id"] != s["span_id"]:
+            children.setdefault(s["parent_id"], []).append(s)
+        else:
+            roots.append(s)
+    # the trace root is the longest root span (the tracing.TraceHandle
+    # root for a normal trace; for a bare fragment, its own root)
+    root = max(roots, key=lambda s: int(s.get("dur_us", 0)))
+    wall_us = max(int(root.get("dur_us", 0)), 1)
+
+    # ---- 1. self-time aggregation by (name, host) ------------------
+    agg: Dict[Tuple[str, Optional[str]], int] = {}
+    root_self = 0
+    visited: set = set()        # malformed parent cycles terminate
+    stack: List[Tuple[Dict[str, Any], Optional[str]]] = \
+        [(r, None) for r in roots]
+    while stack:
+        s, inh_host = stack.pop()
+        if id(s) in visited:
+            continue
+        visited.add(id(s))
+        host = _span_host(s, inh_host)
+        dur = int(s.get("dur_us", 0))
+        kids = children.get(s["span_id"], ())
+        ivals = []
+        for c in kids:
+            t0 = int(c.get("t0_us", 0))
+            ivals.append((t0, t0 + int(c.get("dur_us", 0))))
+            stack.append((c, host))
+        self_us = max(dur - _merged_coverage(ivals), 0)
+        if self_us:
+            if s is root:
+                root_self = self_us
+            key = (s["name"], host)
+            agg[key] = agg.get(key, 0) + self_us
+    attribution = [
+        {"name": name, "host": host, "self_us": us,
+         "pct": round(100.0 * us / wall_us, 1)}
+        for (name, host), us in
+        sorted(agg.items(), key=lambda kv: -kv[1])]
+    explained = min(max(
+        sum(a["self_us"] for a in attribution) - root_self, 0)
+        / wall_us, 1.0)
+
+    # ---- 2. dominant path ------------------------------------------
+    path: List[Dict[str, Any]] = []
+    cur, host = root, _span_host(root, None)
+    seen = set()
+    while cur is not None and cur["span_id"] not in seen:
+        seen.add(cur["span_id"])
+        host = _span_host(cur, host)
+        path.append({"name": cur["name"], "host": host,
+                     "dur_us": int(cur.get("dur_us", 0)),
+                     "pct": round(100.0 * int(cur.get("dur_us", 0))
+                                  / wall_us, 1)})
+        kids = children.get(cur["span_id"], ())
+        cur = max(kids, key=lambda c: int(c.get("dur_us", 0))) \
+            if kids else None
+
+    return {"trace_id": trace.get("trace_id", ""), "wall_us": wall_us,
+            "attribution": attribution[:max(int(top), 1)],
+            "critical_path": path,
+            "explained": round(explained, 4)}
+
+
+def aggregate(traces: List[Dict[str, Any]], top: int = 8
+              ) -> Dict[str, Any]:
+    """Attribution across a SET of traces (the bench forced-sample
+    pass): per-(name, host) self time summed over all traces as a
+    fraction of their total wall time, plus the mean explained
+    fraction — the artifact's `attribution` block."""
+    total_wall = 0
+    agg: Dict[Tuple[str, Optional[str]], int] = {}
+    explained: List[float] = []
+    for t in traces:
+        a = analyze(t, top=64)
+        if not a["wall_us"]:
+            continue
+        total_wall += a["wall_us"]
+        explained.append(a["explained"])
+        for row in a["attribution"]:
+            key = (row["name"], row["host"])
+            agg[key] = agg.get(key, 0) + row["self_us"]
+    rows = [
+        {"name": name, "host": host, "self_us": us,
+         "pct": round(100.0 * us / max(total_wall, 1), 1)}
+        for (name, host), us in
+        sorted(agg.items(), key=lambda kv: -kv[1])]
+    return {"sampled_traces": len(explained),
+            "wall_us_total": total_wall,
+            "explained": round(sum(explained) / len(explained), 4)
+            if explained else 0.0,
+            "attribution": rows[:max(int(top), 1)]}

@@ -1,0 +1,259 @@
+"""Per-query resource ledger: WHERE a query's microseconds and bytes
+actually went, attributed per host (docs/manual/10-observability.md,
+"Cost ledger & critical path").
+
+PR 4's spans record wall time per seam and PR 10's histograms record
+distributions — neither can answer "how much device compute, H2D/D2H
+transfer, rows scanned, queue wait and RPC payload did THIS query
+consume, on which host?". The ledger closes that gap: one accumulator
+per query, carried on its own ContextVar with the same propagation
+rules as the trace context (copy_context across pool threads, an
+explicit re-point when the dispatcher leader serves a waiter's
+request) — but populated for EVERY query, trace sampling on or off,
+because the slow-query log and the per-tenant cost rollups must cover
+what head sampling misses.
+
+Charge sites (each one ContextVar read when no ledger is active):
+  - dispatcher queue wait + window share  (engine_tpu/engine.py)
+  - fused-kernel device time + launches   (TpuGraphEngine._record_profile)
+  - H2D staged frontier bytes             (fused.FrontierPool.stage)
+  - D2H fetched mask bytes                (the chunk-loop fetches)
+  - rows scanned / row bytes returned     (storage/processors.py,
+                                           charged SERVER-side)
+  - RPC round-trips + payload bytes       (rpc/transport.py)
+  - cache rung hits/misses                (common/cache.py CacheRung)
+  - WAL bytes appended                    (kvstore/raft_store.py)
+
+Server-side charges cross the RPC boundary piggybacked on the response
+envelope exactly like PR 4's span fragments (an additive v1.2 wire
+element, docs/manual/6-wire-protocol.md) and merge client-side under
+the PEER's host key — so a cluster query's cost block reads "rows
+scanned: 1200 on host B, 800 on host C".
+
+Shared-launch attribution: a coalesced dispatcher window launches ONE
+kernel for N queries; like the window span, every rider's ledger is
+charged the full device time (attributed time, not exclusive time —
+`launches` counts real launches once, on the leader). Window H2D/D2H
+bytes are charged to the leader's query (the thread that staged and
+fetched them); a solo PROFILE window (the common diagnostic case) is
+exact.
+"""
+from __future__ import annotations
+
+import contextvars
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+from .flags import MUTABLE, graph_flags
+
+# Accounting fields, in WIRE ORDER — append-only (the piggybacked RPC
+# fragment is a positional int tuple; reordering breaks mixed-version
+# merges the same way reordering a wire struct would).
+FIELDS: Tuple[str, ...] = (
+    "queue_wait_us",    # dispatcher enqueue -> wake (the waiter's wait)
+    "window_share_us",  # wall time of the shared window that served it
+    "device_us",        # kernel dispatch+fetch wall time (attributed)
+    "launches",         # device program launches
+    "h2d_bytes",        # host->device staged bytes (frontier stacks)
+    "d2h_bytes",        # device->host fetched bytes (mask stacks)
+    "rows_scanned",     # storage rows iterated server-side
+    "bytes_returned",   # raw row-value bytes the processors decoded
+    "rpc_calls",        # client-side round trips
+    "rpc_bytes_out",    # request payload bytes
+    "rpc_bytes_in",     # response payload bytes
+    "cache_hits",       # cache-rung hits on the query's path
+    "cache_misses",     # cache-rung misses
+    "wal_bytes",        # raft WAL bytes appended for this query
+)
+
+graph_flags.declare(
+    "cost_ledger_enabled", True, MUTABLE,
+    "attach a per-query resource ledger (cost attribution in PROFILE/"
+    "slow-query log + graph.cost.* rollups); off = queries carry no "
+    "ledger and every charge site is a single ContextVar read")
+
+
+class Ledger:
+    """One query's cost accumulator. Direct attribute adds are for
+    sites that provably run on the query's single serving thread (the
+    dispatcher charges under the owner's re-pointed context); charge /
+    charge_host / merge_wire take the ledger lock because the storage
+    fan-out runs them from concurrent pool threads (a lost increment
+    would silently under-report cost)."""
+
+    __slots__ = FIELDS + ("hosts", "verb", "_lock")
+
+    def __init__(self):
+        for f in FIELDS:
+            setattr(self, f, 0)
+        # host addr -> {field: int}: the per-host slice merged back
+        # from RPC response fragments (and, server-side, local charges
+        # recorded under the serving host's own name)
+        self.hosts: Dict[str, Dict[str, int]] = {}
+        self.verb = ""   # first statement kind (rollup dimension)
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------- charge
+    def charge(self, **fields: int) -> None:
+        with self._lock:
+            for f, v in fields.items():
+                setattr(self, f, getattr(self, f) + int(v))
+
+    def charge_host(self, host: str, **fields: int) -> None:
+        """Charge totals AND the per-host slice (server-side sites
+        pass their own advertised host)."""
+        with self._lock:
+            hd = self.hosts.get(host)
+            if hd is None:
+                hd = self.hosts[host] = {}
+            for f, v in fields.items():
+                v = int(v)
+                setattr(self, f, getattr(self, f) + v)
+                hd[f] = hd.get(f, 0) + v
+
+    # --------------------------------------------------------------- wire
+    def to_wire(self) -> Tuple:
+        """(field ints in FIELDS order, {host: {field: int}}) — the
+        additive response-envelope element (manual 6, v1.2)."""
+        return (tuple(getattr(self, f) for f in FIELDS),
+                {h: dict(d) for h, d in self.hosts.items()})
+
+    def merge_wire(self, w, host: Optional[str] = None) -> None:
+        """Merge a piggybacked fragment. Nested host slices merge
+        under their original names; `host` (the RPC peer that produced
+        the fragment) labels only the REMAINDER of the top-level
+        charges — what the server charged without host attribution
+        (e.g. wal_bytes at the consensus hook). Charges the server
+        already attributed via charge_host would otherwise appear
+        twice in the per-host breakdown (once under the server's own
+        name, once under the peer address — the same host). Malformed
+        fragments are dropped — cost attribution must never break a
+        query."""
+        try:
+            vals, hosts = w[0], w[1]
+            with self._lock:
+                for f, v in zip(FIELDS, vals):
+                    setattr(self, f, getattr(self, f) + int(v))
+                nested: Dict[str, int] = {}
+                for h, d in hosts.items():
+                    hd = self.hosts.setdefault(h, {})
+                    for f, v in d.items():
+                        hd[f] = hd.get(f, 0) + int(v)
+                        nested[f] = nested.get(f, 0) + int(v)
+                if host is not None:
+                    rem = {f: int(v) - nested.get(f, 0)
+                           for f, v in zip(FIELDS, vals)}
+                    if any(v > 0 for v in rem.values()):
+                        hd = self.hosts.setdefault(host, {})
+                        for f, v in rem.items():
+                            if v > 0:
+                                hd[f] = hd.get(f, 0) + v
+        except Exception:
+            return
+
+    # --------------------------------------------------------------- view
+    def to_dict(self) -> Dict[str, Any]:
+        """The PROFILE `cost` block / slow-query ledger slice: every
+        field (stable shape) plus the nonzero per-host breakdown."""
+        out: Dict[str, Any] = {f: getattr(self, f) for f in FIELDS}
+        hosts = {}
+        for h, d in self.hosts.items():
+            nz = {f: v for f, v in d.items() if v}
+            if nz:
+                hosts[h] = nz
+        if hosts:
+            out["hosts"] = hosts
+        return out
+
+    def nonzero(self) -> Dict[str, int]:
+        return {f: getattr(self, f) for f in FIELDS if getattr(self, f)}
+
+
+# The query's ledger; None = no accounting (internal/background work,
+# or cost_ledger_enabled off). contextvars, not threading.local, for
+# the same reason as the trace context: executor fan-outs carry it
+# into pool threads via copy_context().
+_current: contextvars.ContextVar[Optional[Ledger]] = \
+    contextvars.ContextVar("nebula_ledger", default=None)
+
+
+def current() -> Optional[Ledger]:
+    return _current.get()
+
+
+def charge(**fields: int) -> None:
+    """Ambient charge — one ContextVar read when no ledger is live."""
+    led = _current.get()
+    if led is not None:
+        led.charge(**fields)
+
+
+def charge_host(host: str, **fields: int) -> None:
+    led = _current.get()
+    if led is not None:
+        led.charge_host(host, **fields)
+
+
+def begin() -> Tuple[Optional[Ledger], Optional[object]]:
+    """Attach a fresh ledger to the calling context (the graph-service
+    query head). Returns (ledger, token) — (None, None) when the
+    cost_ledger_enabled flag is off."""
+    if not graph_flags.get("cost_ledger_enabled", True):
+        return None, None
+    led = Ledger()
+    return led, _current.set(led)
+
+
+def end(token) -> None:
+    if token is not None:
+        _current.reset(token)
+
+
+class _UseCtx:
+    """Temporarily re-point the current thread at another request's
+    ledger (the dispatcher leader charging a waiter's request). A None
+    ledger DETACHES — charges recorded while serving a ledger-less
+    request must not land on the leader's own query."""
+
+    __slots__ = ("_led", "_token")
+
+    def __init__(self, led: Optional[Ledger]):
+        self._led = led
+        self._token = None
+
+    def __enter__(self):
+        self._token = _current.set(self._led)
+        return self
+
+    def __exit__(self, *exc):
+        if self._token is not None:
+            _current.reset(self._token)
+            self._token = None
+        return False
+
+
+def use(led: Optional[Ledger]) -> _UseCtx:
+    return _UseCtx(led)
+
+
+class adopt:
+    """Server-side adoption around an RPC handler whose request carried
+    the cost flag: charges recorded in the extent land in a fresh
+    ledger, exposed wire-shaped as `.wire` for the response envelope
+    (rpc/transport.py) — the cost twin of tracing.RemoteTrace."""
+
+    __slots__ = ("ledger", "wire", "_token")
+
+    def __init__(self):
+        self.ledger = Ledger()
+        self.wire: Optional[Tuple] = None
+        self._token = None
+
+    def __enter__(self) -> "adopt":
+        self._token = _current.set(self.ledger)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        _current.reset(self._token)
+        self.wire = self.ledger.to_wire()
+        return False

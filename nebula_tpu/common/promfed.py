@@ -1,0 +1,114 @@
+"""Cluster-wide OpenMetrics federation: merge every daemon's /metrics
+into ONE strict exposition with `instance`/`role` labels
+(docs/manual/10-observability.md, "Cluster rollup / nebtop").
+
+graphd's `/cluster_metrics` (daemons/graphd.py) fetches its own
+exposition plus every registered storaged/metad `/metrics` (targets
+from the heartbeat-carried web-port registry, meta/service.py) and
+feeds them through `merge_expositions`:
+
+ - every sample line gains `instance="host:ws_port"` and
+   `role="graph|storage|meta"` labels (prepended, so an upstream
+   label named the same would fail the strict duplicate-label check
+   rather than be silently shadowed);
+ - family TYPE lines are emitted ONCE per family, with all instances'
+   samples contiguous under it (the strict parser forbids
+   interleaving); a family whose declared type disagrees across
+   instances keeps the first and DROPS the dissenters' samples
+   (counted in the scrape gauge) instead of emitting a malformed doc;
+ - per-target scrape health is itself a family
+   (`nebula_cluster_scrape{instance,role}` 1|0), so a dead daemon is
+   visible in the rollup instead of silently absent;
+ - exemplars ride along untouched (they live after the value, which
+   the label injection never touches).
+
+The output strict-parses with tests/openmetrics.py (histogram
+bucket/_count consistency is validated per label-series there, which
+multi-instance federation requires).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+
+def _inject_labels(line: str, extra: str) -> Optional[str]:
+    """Prepend `extra` (already rendered `k="v",k2="v2"`) into a
+    sample line's label set. Returns None for a line that does not
+    look like a sample (caller drops it rather than corrupting the
+    merged document)."""
+    i = 0
+    n = len(line)
+    while i < n and (line[i].isalnum() or line[i] in "_:"):
+        i += 1
+    if i == 0:
+        return None
+    if i < n and line[i] == "{":
+        return line[:i + 1] + extra + "," + line[i + 1:]
+    if i < n and line[i] == " ":
+        return line[:i] + "{" + extra + "}" + line[i:]
+    return None
+
+
+def _escape(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r'\"') \
+        .replace("\n", r"\n")
+
+
+def merge_expositions(
+        sources: List[Tuple[str, str, Optional[str]]]) -> str:
+    """`sources` = [(instance, role, exposition_text | None)]; None
+    text = the scrape failed (recorded in nebula_cluster_scrape and
+    skipped). Returns one strict OpenMetrics document."""
+    # family name -> (type, [sample lines]) in first-seen order
+    families: Dict[str, Tuple[str, List[str]]] = {}
+    scrape_lines: List[str] = []
+    for instance, role, text in sources:
+        extra_full = (f'instance="{_escape(instance)}",'
+                      f'role="{_escape(role)}"')
+        scrape_lines.append(
+            f"nebula_cluster_scrape{{{extra_full}}} "
+            f"{1 if text is not None else 0}")
+        if text is None:
+            continue
+        current: Optional[str] = None       # current family name
+        cur_type: Optional[str] = None
+        for line in text.split("\n"):
+            if not line or line == "# EOF":
+                continue
+            if line.startswith("#"):
+                toks = line.split(" ")
+                kind = toks[1] if len(toks) > 1 else ""
+                if kind == "TYPE" and len(toks) == 4:
+                    current, cur_type = toks[2], toks[3]
+                    if current not in families:
+                        families[current] = (cur_type, [])
+                    elif families[current][0] != cur_type:
+                        # type conflict across instances: keep the
+                        # first declaration, drop this instance's
+                        # samples of the family (a mixed-type family
+                        # would fail every strict consumer)
+                        current = None
+                # HELP/UNIT dropped: per-instance help text would
+                # duplicate across the merged family
+                continue
+            if current is None:
+                continue                    # orphan or conflicting
+            # a sample that already carries a role label (the
+            # nebula_build_info join gauge labels its daemon role)
+            # gets only `instance` — a duplicate label key would fail
+            # the strict parser
+            extra = extra_full if 'role="' not in line else \
+                f'instance="{_escape(instance)}"'
+            merged = _inject_labels(line, extra)
+            if merged is not None:
+                families[current][1].append(merged)
+    out: List[str] = []
+    for name, (type_, samples) in families.items():
+        if not samples:
+            continue
+        out.append(f"# TYPE {name} {type_}")
+        out.extend(samples)
+    out.append("# TYPE nebula_cluster_scrape gauge")
+    out.extend(scrape_lines)
+    out.append("# EOF")
+    return "\n".join(out) + "\n"

@@ -462,12 +462,20 @@ class SlowQueryLog:
         self._lock = threading.Lock()
 
     def add(self, stmt: str, latency_us: int, session: int = -1,
-            user: str = "", trace_id: str = "", ok: bool = True) -> None:
+            user: str = "", trace_id: str = "", ok: bool = True,
+            cost: Optional[Dict[str, Any]] = None) -> None:
+        """`cost` is the offender's resource-ledger slice
+        (common/ledger.py to_dict) — the slow-query log records WHERE
+        a slow query's time and bytes went, not just that it was
+        slow."""
+        entry = {"stmt": stmt[:512], "latency_us": int(latency_us),
+                 "session": session, "user": user,
+                 "trace_id": trace_id, "ok": bool(ok),
+                 "ts": time.time()}
+        if cost:
+            entry["cost"] = cost
         with self._lock:
-            self._dq.append({"stmt": stmt[:512], "latency_us": int(latency_us),
-                             "session": session, "user": user,
-                             "trace_id": trace_id, "ok": bool(ok),
-                             "ts": time.time()})
+            self._dq.append(entry)
 
     def snapshot(self, limit: int = 50) -> List[Dict[str, Any]]:
         with self._lock:
@@ -504,6 +512,18 @@ class ActiveQueryRegistry:
     def unregister(self, token: int) -> None:
         with self._lock:
             self._active.pop(token, None)
+
+    def finish(self, token: int) -> Optional[float]:
+        """Unregister AND return the op's elapsed milliseconds (None
+        for an unknown token) — so finished storage-processor ops can
+        be checked against slow_query_threshold_ms instead of being
+        dropped without a duration (ISSUE 12 satellite)."""
+        now = time.monotonic()
+        with self._lock:
+            entry = self._active.pop(token, None)
+        if entry is None:
+            return None
+        return round((now - entry["_mono"]) * 1e3, 2)
 
     def snapshot(self) -> List[Dict[str, Any]]:
         now = time.monotonic()

@@ -158,6 +158,17 @@ class Console:
                   file=self.out)
             print(f"Trace {getattr(resp, 'trace_id', '')} "
                   f"({len(spans)} spans)", file=self.out)
+        cost = (prof or {}).get("cost")
+        if spans and cost:
+            # the PROFILE cost block next to the span tree: nonzero
+            # totals on one line, per-host slices under it
+            totals = " | ".join(
+                f"{k} {v}" for k, v in cost.items()
+                if k != "hosts" and v)
+            print(f"Cost: {totals or '(all zero)'}", file=self.out)
+            for h, d in sorted((cost.get("hosts") or {}).items()):
+                hs = " ".join(f"{k}={v}" for k, v in sorted(d.items()))
+                print(f"  host {h}: {hs}", file=self.out)
         return True
 
     def run_file(self, path: str) -> None:

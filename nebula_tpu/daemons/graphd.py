@@ -95,6 +95,53 @@ def serve_graphd(meta_addr: str, host: str = "127.0.0.1", port: int = 0,
         # put this daemon's serve-path state into every flight bundle)
         web.register_observability(active=service.active_queries,
                                    slow=service.slow_log)
+
+        def cluster_metrics(params, body):
+            # /cluster_metrics (docs/manual/10-observability.md,
+            # "Cluster rollup / nebtop"): this graphd's own exposition
+            # plus every registered storaged/metad /metrics (targets
+            # from metad's heartbeat-carried web-port registry),
+            # merged into ONE strict OpenMetrics document with
+            # instance/role labels — one scrape for the whole cluster,
+            # dead daemons visible as nebula_cluster_scrape 0.
+            import urllib.request
+            from ..common import promfed
+            from ..webservice import OPENMETRICS_CTYPE
+            _code, own = web._metrics_handler({}, b"")
+            sources = [(f"{host}:{web.port}", "graph",
+                        own[0].decode() if isinstance(own, tuple)
+                        else str(own))]
+            try:
+                endpoints = mc.web_endpoints()
+            except Exception:
+                endpoints = []
+            try:
+                timeout = float(params.get("timeout", 2.0))
+            except ValueError:
+                timeout = 2.0
+
+            def fetch(ep):
+                try:
+                    with urllib.request.urlopen(
+                            f"http://{ep['web']}/metrics",
+                            timeout=timeout) as r:
+                        return r.read().decode()
+                except Exception:
+                    return None     # scraped as down, not dropped
+            # concurrent fan-out: one slow/dead target costs ONE
+            # timeout for the whole scrape, not one per target
+            if endpoints:
+                from concurrent.futures import ThreadPoolExecutor
+                with ThreadPoolExecutor(
+                        max_workers=min(len(endpoints), 16)) as pool:
+                    texts = list(pool.map(fetch, endpoints))
+                sources.extend(
+                    (ep["web"], ep["role"], text)
+                    for ep, text in zip(endpoints, texts))
+            doc = promfed.merge_expositions(sources)
+            return 200, (doc.encode(), OPENMETRICS_CTYPE)
+
+        web.register("/cluster_metrics", cluster_metrics)
         from ..common.flight import recorder as flight_recorder
         flight_recorder.add_collector("graphd.queries", lambda: {
             "active": service.active_queries.snapshot(),

@@ -96,6 +96,10 @@ def serve_metad(host: str = "127.0.0.1", port: int = 0,
 
         web.add_metrics_source(meta_metric_source)
         web.start()
+        # self-register as a /cluster_metrics scrape target (metad
+        # doesn't heartbeat to itself; storaged/graphd ports arrive
+        # via heartbeat)
+        meta.note_web_port(server.addr, web.port, "meta")
     return MetadHandle(meta, server, web)
 
 

@@ -438,9 +438,12 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
         _register_admin_handlers(web, storage)
         # observability surface: /traces serves this daemon's ring
         # (remote fragments it recorded for graphd-headed traces),
-        # /queries its in-flight processor ops, /metrics the built-in
-        # Prometheus exposition (docs/manual/10-observability.md)
-        web.register_observability(active=storage.active_ops)
+        # /queries its in-flight processor ops AND the finished ops
+        # that crossed slow_query_threshold_ms (with their ledger
+        # slice), /metrics the built-in Prometheus exposition
+        # (docs/manual/10-observability.md)
+        web.register_observability(active=storage.active_ops,
+                                   slow=storage.slow_ops)
 
         def cache_metric_source():
             # storaged cache rungs as flat gauges (bound_stats
@@ -492,6 +495,14 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
 
             web.add_metrics_source(raft_metric_source)
         web.start()
+        # advertise the admin port: future heartbeats carry it, and
+        # one immediate beat makes this daemon a /cluster_metrics
+        # scrape target without waiting a heartbeat period
+        mc.ws_port = web.port
+        try:
+            mc.heartbeat(addr, "storage", ws_port=web.port)
+        except Exception:
+            pass
         wc_state["web"] = web
         if wc_state["fired"]:   # wrong-cluster fired before web existed
             web.stop()

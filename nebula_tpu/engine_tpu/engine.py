@@ -41,6 +41,7 @@ import numpy as np
 
 from ..common.cache import (CacheRung, plan_stage_enabled,
                             result_stage_enabled)
+from ..common import ledger as _ledger
 from ..common.faults import CircuitBreaker, faults
 from ..common.flight import recorder as _flight
 from ..common.flags import graph_flags
@@ -100,7 +101,7 @@ class _GoReq:
     __slots__ = ("ctx", "s", "starts", "edge_types", "alias_map",
                  "name_by_type", "key", "yield_cols", "result",
                  "done", "claimed", "t_enq", "tctx", "dkey",
-                 "followers", "lane")
+                 "followers", "lane", "ledger")
 
     def __init__(self, ctx, s, starts, edge_types, alias_map,
                  name_by_type, key, yield_cols, dkey=None):
@@ -127,6 +128,10 @@ class _GoReq:
         # this request — its own thread or a group leader — records
         # spans into the OWNER's trace via tracer.use (tracing.py)
         self.tctx = None
+        # the owner's cost ledger (None when accounting is off): the
+        # serving thread charges the OWNER's ledger via ledger.use,
+        # same discipline as tctx (common/ledger.py)
+        self.ledger = None
 
 
 def _uses_input_refs(exprs: List[Expression]) -> bool:
@@ -474,6 +479,14 @@ class TpuGraphEngine:
                                t_kernel * 1e6, kind="histogram")
         global_stats.add_value("tpu_engine.materialize_us",
                                t_mat * 1e6, kind="histogram")
+        # cost ledger: device compute attributed to the query being
+        # served (the caller re-points the ledger ContextVar at the
+        # owner for window requests, like the trace context). Sparse
+        # modes are host pulls — no device launch to charge.
+        led = _ledger.current()
+        if led is not None and "sparse" not in mode:
+            led.device_us += int(t_kernel * 1e6)
+            led.launches += 1
         if _tr.active():
             _tr.tag_root("mode", mode)
             _tr.add_span("snapshot", t_snap * 1e6)
@@ -1669,6 +1682,7 @@ class TpuGraphEngine:
                       tuple(edge_types)), yield_cols, dkey=dkey)
         req.t_enq = time.monotonic()
         req.tctx = _tr.current_state()
+        req.ledger = _ledger.current()
         lane = getattr(ctx, "qos_lane", None)
         if lane is None:
             lane = self._classify_lane(s, starts)
@@ -1999,6 +2013,9 @@ class TpuGraphEngine:
             for r in done_now:
                 r.done = True
                 w = int((now - r.t_enq) * 1e6)
+                if r.ledger is not None:
+                    # the waiter's own queue time (enqueue -> wake)
+                    r.ledger.queue_wait_us += w
                 self.stats["group_wait_us_total"] += w
                 self.stats["group_wait_count"] += 1
                 if w > self.stats["group_wait_us_max"]:
@@ -2134,7 +2151,7 @@ class TpuGraphEngine:
                 # the solo round is still a dispatcher window (of 1):
                 # PROFILE of an idle GO shows the same tree shape as a
                 # coalesced one, just with window=1
-                with _tr.use(r.tctx), \
+                with _tr.use(r.tctx), _ledger.use(r.ledger), \
                         _tr.span("dispatcher.window", window=1):
                     with self._lock:
                         r.result = self._execute_go_locked(
@@ -2173,8 +2190,9 @@ class TpuGraphEngine:
             # sharded window dispatch.
             for r in group:
                 # spans recorded while serving THIS request belong to
-                # its owner's trace, not the leader's
-                with _tr.use(r.tctx):
+                # its owner's trace, not the leader's (and its charges
+                # to the owner's ledger)
+                with _tr.use(r.tctx), _ledger.use(r.ledger):
                     try:
                         if self._deadline_exceeded(r.ctx,
                                                    "dispatch_claim"):
@@ -2318,7 +2336,7 @@ class TpuGraphEngine:
         degrades to the CPU pipe in its own session (result=None),
         never to a client error."""
         for r in reqs:
-            with _tr.use(r.tctx):
+            with _tr.use(r.tctx), _ledger.use(r.ledger):
                 try:
                     with self._lock:
                         r.result = self._execute_go_locked(
@@ -2443,6 +2461,9 @@ class TpuGraphEngine:
                         masks_np = np.asarray(masks)   # wait OFF lock
                     finally:
                         pool.fetch_end()
+                    # window D2H lands on the leader's query (module
+                    # doc in common/ledger.py — solo windows exact)
+                    _ledger.charge(d2h_bytes=masks_np.nbytes)
                 except Exception as e:
                     launch_err = e
             if launch_err is not None:
@@ -2713,6 +2734,9 @@ class TpuGraphEngine:
                             else np.asarray(dmasks)
                     finally:
                         pool.fetch_end()
+                    _ledger.charge(d2h_bytes=masks_np.nbytes + (
+                        dmasks_np.nbytes if dmasks_np is not None
+                        else 0))
                 except Exception as e:
                     launch_err = e
             if launch_err is not None:
@@ -2773,7 +2797,7 @@ class TpuGraphEngine:
         dispatch actually served the request (mesh accounting: stale2
         redos are charged by their own single-query serve)."""
         r, _f0, yield_cols, columns = entry
-        with _tr.use(r.tctx):
+        with _tr.use(r.tctx), _ledger.use(r.ledger):
             try:
                 if stale2:
                     r.result = self._execute_go_locked(
@@ -2782,6 +2806,10 @@ class TpuGraphEngine:
                     return False
                 _tr.add_span("dispatcher.window", win_us,
                              window=window, chunk=ci, meshed=meshed)
+                if r.ledger is not None:
+                    # wall time of the shared window this request rode
+                    # (the span twin above carries the same number)
+                    r.ledger.window_share_us += int(win_us)
                 device_mask, local_filter = plan_filter_cached(r)
                 mask = masks_np[i]
                 if device_mask is not None and \
@@ -2907,6 +2935,7 @@ class TpuGraphEngine:
             return StatusOr.of(ex.InterimResult(columns))
         import jax.numpy as jnp
         f0 = jnp.asarray(frontier0)
+        _ledger.charge(h2d_bytes=frontier0.nbytes)
         req = jnp.asarray(traverse.pad_edge_types(edge_types))
 
         use_delta = snap.delta is not None and snap.delta.edge_count > 0
@@ -2958,6 +2987,8 @@ class TpuGraphEngine:
         mask = np.asarray(active)
         t_kernel = time.monotonic() - t1
         d_mask = None if d_active is None else np.asarray(d_active)
+        _ledger.charge(d2h_bytes=mask.nbytes + (
+            d_mask.nbytes if d_mask is not None else 0))
         return self._go_emit_dense(ctx, s, snap, mask, d_mask,
                                    local_filter, yield_cols, columns,
                                    alias_map, name_by_type, ex, edge_types,

@@ -62,6 +62,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..common import ledger as _ledger
 from . import traverse
 from .aggregate import SUM_CHUNK, _BIAS
 
@@ -323,7 +324,8 @@ class FrontierPool:
         self._fetch_epoch = 0
         self.stats = {"stages": 0, "prefetch_hits": 0,
                       "prefetch_misses": 0, "overlapped": 0,
-                      "h2d_overlap_us": 0, "donation_fallbacks": 0}
+                      "h2d_overlap_us": 0, "donation_fallbacks": 0,
+                      "h2d_bytes": 0}
 
     def fetch_begin(self) -> None:
         with self._lock:
@@ -337,10 +339,18 @@ class FrontierPool:
     def stage(self, arr: np.ndarray) -> _Staged:
         with self._lock:
             self.stats["stages"] += 1
+            self.stats["h2d_bytes"] += arr.nbytes
             overlapped = self._fetches > 0
             if overlapped:
                 self.stats["overlapped"] += 1
             epoch0 = self._fetch_epoch
+        # per-query cost ledger (common/ledger.py): the staging
+        # thread's query carries the transfer — exact for solo windows
+        # (the PROFILE case); a coalesced window's H2D lands on its
+        # leader's query (see the ledger module doc)
+        led = _ledger.current()
+        if led is not None:
+            led.h2d_bytes += arr.nbytes
         return _Staged(jax.device_put(arr), arr.shape, time.monotonic(),
                        overlapped, epoch0, self)
 

@@ -10,7 +10,7 @@ from __future__ import annotations
 import time
 from typing import Callable, Dict, Optional
 
-from ..common import qos
+from ..common import ledger, qos
 from ..common.cache import CacheRung, plan_stage_enabled
 from ..common.status import ErrorCode, Status, StatusOr
 from ..common.tracing import (ActiveQueryRegistry, SlowQueryLog,
@@ -197,6 +197,10 @@ class ExecutionEngine:
             return resp
         if seq.sentences:
             tracer.tag_root("feature", seq.sentences[0].kind.value)
+            led = ledger.current()
+            if led is not None and not led.verb:
+                # per-verb cost rollup dimension (graph.cost.verb.*)
+                led.verb = seq.sentences[0].kind.value
         ctx = ExecContext(self, session)
         result: Optional[InterimResult] = None
         tpu = self.tpu_engine
@@ -353,6 +357,41 @@ _DISPATCH: Dict[ast.Kind, Callable] = {
 }
 
 
+# ledger fields streamed into the graph.cost.* histogram families —
+# the ISSUE-12 rollup surface (per space and per verb). rpc bytes are
+# folded into one field to bound family cardinality.
+_COST_ROLLUP_FIELDS = ("device_us", "queue_wait_us", "h2d_bytes",
+                       "d2h_bytes", "rows_scanned", "bytes_returned",
+                       "wal_bytes")
+
+
+def _roll_cost(led, space_name: str, trace_id: str) -> None:
+    """Stream one query's ledger into the PR 10 histogram machinery:
+    `graph.cost.<space>.<field>` + `graph.cost.verb.<verb>.<field>`
+    native histograms whose exemplars carry the query's trace id when
+    sampled (the metric -> trace join rides cost too). Kind is pinned
+    to "histogram" — nebula-lint NL004 enforces it for every
+    graph.cost.* site."""
+    from ..common.stats import stats
+    space = space_name or "_"
+    for f in _COST_ROLLUP_FIELDS:
+        v = getattr(led, f)
+        if not v:
+            continue
+        stats.add_value(f"graph.cost.{space}.{f}", v,
+                        kind="histogram", trace_id=trace_id)
+        if led.verb:
+            stats.add_value(f"graph.cost.verb.{led.verb}.{f}", v,
+                            kind="histogram", trace_id=trace_id)
+    rpc_b = led.rpc_bytes_out + led.rpc_bytes_in
+    if rpc_b:
+        stats.add_value(f"graph.cost.{space}.rpc_bytes", rpc_b,
+                        kind="histogram", trace_id=trace_id)
+        if led.verb:
+            stats.add_value(f"graph.cost.verb.{led.verb}.rpc_bytes",
+                            rpc_b, kind="histogram", trace_id=trace_id)
+
+
 def _wants_profile(text: str) -> bool:
     """Pre-parse sniff for the PROFILE prefix — the sampling decision
     must land BEFORE parsing so the parse span is in the trace; the
@@ -396,6 +435,10 @@ class GraphService:
         profiled = _wants_profile(text)
         handle = tracer.begin("query", force=profiled,
                               session=session_id, user=session.user)
+        # cost head (common/ledger.py): EVERY query carries a ledger
+        # (sampling on or off) — the slow-query log and the per-tenant
+        # cost rollups below must cover what head sampling misses
+        led, led_tok = ledger.begin()
         qtok = self.active_queries.register(
             text, session=session_id, user=session.user,
             trace_id=handle.trace_id)
@@ -414,20 +457,29 @@ class GraphService:
         except BaseException:
             # the handle owns this thread's trace context: finish it
             # even on an engine bug, or the NEXT query on this
-            # connection thread would record into a dead trace
+            # connection thread would record into a dead trace (the
+            # ledger token likewise)
             if dl_tok is not None:
                 qos.clear_query_deadline(dl_tok)
+            ledger.end(led_tok)
             self.active_queries.unregister(qtok)
             handle.finish(ok=False, error=True)
             raise
         if dl_tok is not None:
             qos.clear_query_deadline(dl_tok)
+        ledger.end(led_tok)
         self.active_queries.unregister(qtok)
         trace = handle.finish(ok=resp.ok(), latency_us=resp.latency_us)
         if trace is not None and profiled and resp.ok():
             resp.attach_trace(trace["trace_id"], [
                 (s["span_id"], s["parent_id"], s["name"], s["t0_us"],
                  s["dur_us"], s["tags"]) for s in trace["spans"]])
+        if led is not None and profiled and resp.ok():
+            # the PROFILE cost block rides next to the span tree in
+            # the profile map (the one extensible slot of the frozen
+            # ExecutionResponse — see graph/context.py)
+            resp.profile = dict(resp.profile) if resp.profile else {}
+            resp.profile["cost"] = led.to_dict()
         # per-query QPS/latency metrics + slow-op log (ref: per-query
         # latency_in_us in every response, SlowOpTracker)
         from ..common.flags import graph_flags
@@ -447,6 +499,13 @@ class GraphService:
                 "graph.space." + session.space_name + ".latency_us",
                 resp.latency_us, kind="histogram",
                 trace_id=handle.trace_id)   # "" = no exemplar
+        if led is not None:
+            # per-tenant + per-verb COST rollups (graph.cost.*, native
+            # histograms — SLOs and exemplars ride cost, not just
+            # latency; docs/manual/10-observability.md). Zero fields
+            # are skipped: a FETCH that never touched the device must
+            # not pour zeros into the device_us distribution.
+            _roll_cost(led, session.space_name, handle.trace_id)
         if not resp.ok():
             stats.add_value("graph.query_error", kind="counter")
         slow_ms = graph_flags.get("slow_op_threshold_ms", 50)
@@ -456,5 +515,7 @@ class GraphService:
         if slowlog_ms and resp.latency_us > slowlog_ms * 1000:
             self.slow_log.add(text, resp.latency_us, session=session_id,
                               user=session.user,
-                              trace_id=handle.trace_id, ok=resp.ok())
+                              trace_id=handle.trace_id, ok=resp.ok(),
+                              cost=led.to_dict() if led is not None
+                              else None)
         return resp

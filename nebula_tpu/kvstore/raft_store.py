@@ -20,7 +20,10 @@ from concurrent.futures import Future
 from typing import Dict, List, Optional
 
 from ..common import keys as keyutils
+from ..common import ledger
+from ..common.stats import stats
 from ..common.status import ErrorCode, Status
+from ..common.tracing import tracer
 from .iface import KVEngine
 from .part import AtomicOp, ConsensusHook, Part
 from .raftex import InProcNetwork, RaftCode, RaftPart, RaftexService
@@ -119,10 +122,35 @@ class RaftConsensusHook(ConsensusHook):
         return Status.error(ErrorCode.E_CONSENSUS_ERROR, str(code))
 
     def submit(self, log: bytes) -> Status:
-        return self._wait(self.raft.append_async(log))
+        # Raft write-path tracing + cost (ISSUE 12 satellite): spans
+        # record on the WAITER's thread under its own trace — the
+        # append span CLOSES after append_async returns (part lock
+        # released), the replicate span covers the quorum wait, and
+        # the commit_logs apply (replicator thread, under the part
+        # lock — off-limits for recording, PR 10 rule) is backdated
+        # from the part's last-commit accounting after the wait.
+        with tracer.span("raft.append_wal", bytes=len(log)):
+            fut = self.raft.append_async(log)
+        led = ledger.current()
+        if led is not None:
+            led.wal_bytes += len(log)
+        stats.add_value("raftex.append_bytes", len(log), kind="counter")
+        with tracer.span("raft.replicate"):
+            st = self._wait(fut)
+        if st.ok() and tracer.active() and self.raft.last_commit_us:
+            tracer.add_span("raft.commit_logs", self.raft.last_commit_us,
+                            entries=self.raft.last_commit_n)
+        return st
 
     def submit_atomic(self, op: AtomicOp) -> Status:
-        return self._wait(self.raft.atomic_op_async(op))
+        with tracer.span("raft.append_wal", atomic=True):
+            fut = self.raft.atomic_op_async(op)
+        with tracer.span("raft.replicate"):
+            st = self._wait(fut)
+        if st.ok() and tracer.active() and self.raft.last_commit_us:
+            tracer.add_span("raft.commit_logs", self.raft.last_commit_us,
+                            entries=self.raft.last_commit_n)
+        return st
 
     def is_leader(self) -> bool:
         return self.raft is not None and self.raft.is_leader()

@@ -169,6 +169,13 @@ class RaftPart:
         self._boot_replay_done = boot_last <= self.committed_id
         self.wal_replayed = 0        # tail entries re-applied at boot
         self.wal_cleaned = 0         # segment files compacted away
+        # last commit_logs batch (duration us, entry count): read by
+        # the WAITER after its append future resolves to backdate a
+        # raft.commit_logs span into its OWN trace — the commit itself
+        # runs on the replicator thread under the part lock, where the
+        # PR 10 rule forbids recording spans (kvstore/raft_store.py)
+        self.last_commit_us = 0
+        self.last_commit_n = 0
         # hosts/pending must exist BEFORE the tail re-apply below — a
         # REMOVE_PEER command in the tail touches self.hosts
         self._pending: Dict[int, Future] = {}   # log_id -> caller future
@@ -483,7 +490,10 @@ class RaftPart:
             # has not applied it. A crash here is exactly the window
             # restart recovery must close (bench --crash forces it).
             faults.fire("crashpoint.wal_applied")
+            t0 = time.monotonic()
             self._on_commit(batch)
+            self.last_commit_us = int((time.monotonic() - t0) * 1e6)
+            self.last_commit_n = len(batch)
         self.committed_id = to_id
         self._note_replay_locked(from_id, to_id)
         done = [f for i, f in self._pending.items() if i <= to_id]

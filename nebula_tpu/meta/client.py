@@ -47,6 +47,11 @@ class MetaClient:
         # raft leadership here so every heartbeat refreshes metad's
         # ActiveHostsMan leader view (SHOW HOSTS/PARTS leader columns)
         self.leader_source: Optional[Callable[[], Dict[int, List[int]]]] = None
+        # this daemon's HTTP admin port, carried on every heartbeat so
+        # metad can hand the /cluster_metrics federation its scrape
+        # target (set by the daemon once its WebService is up; -1 =
+        # no admin surface)
+        self.ws_port = -1
         self._listeners: List[Callable] = []
         self._known_parts: Dict[int, Set[int]] = {}  # space -> my part ids
         self._known_spaces: Dict[int, object] = {}
@@ -143,7 +148,8 @@ class MetaClient:
                         lp = None
                 st = self._rpc.heartbeat(self.local_addr, self.role,
                                          cluster_id=cluster_id,
-                                         leader_parts=lp)
+                                         leader_parts=lp,
+                                         ws_port=self.ws_port)
                 if st is not None and not st.ok() and \
                         st.code == ErrorCode.E_WRONG_CLUSTER:
                     # the reference daemon aborts on mismatch; as a

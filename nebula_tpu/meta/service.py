@@ -74,6 +74,11 @@ class MetaService:
         # (the ActiveHostsMan leader view; feeds SHOW HOSTS / SHOW PARTS
         # leader columns and the balancer's placement decisions)
         self._leader_view: Dict[str, Dict[int, List[int]]] = {}
+        # heartbeat-carried HTTP admin ports: rpc host -> (ws_port,
+        # role). The /cluster_metrics federation (daemons/graphd.py)
+        # reads this to find every daemon's /metrics; in-memory like
+        # the leader view (refreshes within one heartbeat)
+        self._web_ports: Dict[str, Tuple[int, str]] = {}
         # replica-reconcile gating: the full catalog sweep runs only
         # for a host's FIRST heartbeat or while a space is known to be
         # under-replicated — not on every beat of every host (the
@@ -589,7 +594,8 @@ class MetaService:
         return self.cluster_id
 
     def heartbeat(self, host: str, role: str = "storage",
-                  cluster_id: int = 0, leader_parts=None) -> Status:
+                  cluster_id: int = 0, leader_parts=None,
+                  ws_port: int = -1) -> Status:
         # cluster_id 0 = first contact (client hasn't learned it yet);
         # a non-zero mismatch is a daemon from another cluster (ref:
         # HBProcessor clusterId check)
@@ -598,6 +604,12 @@ class MetaService:
                                 f"wrong cluster id {cluster_id}")
         info = HostInfo(host, time.time(), role)
         st = self._put((mk.host_key(host), info.to_json()))
+        if ws_port is not None and int(ws_port) >= 0:
+            # heartbeat-carried HTTP admin port: the /cluster_metrics
+            # federation's scrape-target registry (in-memory like the
+            # leader view — it refreshes within one heartbeat after a
+            # metad restart; HostInfo itself is wire-frozen)
+            self.note_web_port(host, int(ws_port), role)
         if leader_parts is not None:
             # heartbeat-carried raft leadership ({space_id: [part...]}),
             # the ActiveHostsMan leader view (ref meta/ActiveHostsMan.h
@@ -644,6 +656,43 @@ class MetaService:
         # liveness horizon and fill the gap); in the steady state the
         # flag is False and heartbeats stay O(1)
         self._needs_reconcile = still_short
+
+    def note_web_port(self, host: str, ws_port: int,
+                      role: str = "storage") -> None:
+        """Record a daemon's HTTP admin port (heartbeat-carried for
+        storaged; metad registers its own at boot). `host` is the
+        daemon's RPC address — the scrape target is its hostname +
+        ws_port."""
+        self._web_ports[host] = (int(ws_port), role)
+
+    def web_endpoints(self) -> List[Dict[str, Any]]:
+        """Every registered daemon /metrics target for the cluster
+        rollup: [{"host": rpc_addr, "role", "web": "host:ws_port",
+        "alive": bool}]. graphd adds itself locally (it registers with
+        heartbeat=False). A host whose heartbeat has EXPIRED past the
+        liveness horizon is PRUNED from the registry — a crashed
+        daemon scrapes as nebula_cluster_scrape 0 until the horizon,
+        then stops haunting every scrape (a killed-and-replaced
+        storaged must not add a fetch timeout to /cluster_metrics
+        forever). metad's self-registration has no heartbeat and is
+        never pruned."""
+        now = time.time()
+        alive_by_host = {}
+        for _, v in self._scan(mk.P_HOST):
+            info = HostInfo.from_json(v)
+            alive_by_host[info.host] = \
+                now - info.last_hb < self._expired_threshold
+        out = []
+        for host, (port, role) in sorted(self._web_ports.items()):
+            alive = alive_by_host.get(host, role == "meta")
+            if not alive:
+                self._web_ports.pop(host, None)
+                continue
+            hostname = host.rsplit(":", 1)[0]
+            out.append({"host": host, "role": role,
+                        "web": f"{hostname}:{port}",
+                        "alive": alive})
+        return out
 
     def active_hosts(self, role: str = "storage") -> List[HostInfo]:
         now = time.time()

@@ -7,8 +7,8 @@ port) like `ThriftClientManager` (ref common/thrift/ThriftClientManager
 .h). Frames are u32-length-prefixed wire.py payloads:
 
     request  = (service: str, method: str, args: tuple, kwargs: dict
-                [, (trace_id, span_id)])
-    response = (True, result[, spans]) | (False, exception string)
+                [, (trace_id, span_id) [, cost_flag]])
+    response = (True, result[, spans[, ledger]]) | (False, exc string)
 
 The optional 5th request element is the Dapper-style propagated trace
 context (common/tracing.py): a traced caller stamps it on the
@@ -17,6 +17,15 @@ around processor + KV work) and returns the recorded spans as the
 response's 3rd element, which the client grafts into its live trace —
 graphd joins the full graphd->storaged span tree with zero cost on
 untraced calls (the envelope stays a 4-tuple).
+
+The optional 6th request element (v1.2, additive — docs/manual/
+6-wire-protocol.md) is the cost flag: a caller with an active query
+LEDGER (common/ledger.py) sets it truthy; the server then adopts a
+fresh server-side ledger around the handler (rows scanned, row bytes,
+WAL appends charge into it) and piggybacks it back as the response's
+4th element, which the client merges into the live query ledger under
+this peer's host key — per-host cost attribution with, again, zero
+cost for callers carrying neither context.
 
 Remote exceptions re-raise client-side as RpcError. The server is a
 thread-per-connection loop (daemons are IO-bound python; the heavy
@@ -32,6 +41,7 @@ import threading
 import time
 from typing import Any, Dict, Optional, Tuple
 
+from ..common import ledger
 from ..common.faults import faults, jittered_delay, pace_retry
 from ..common.stats import stats as global_stats
 from ..common.tracing import tracer
@@ -128,6 +138,7 @@ class RpcServer:
             envelope = wire.decode(raw)
             service_name, method, args, kwargs = envelope[:4]
             tctx = envelope[4] if len(envelope) > 4 else None
+            want_cost = bool(envelope[5]) if len(envelope) > 5 else False
             svc = self._services.get(service_name)
             if svc is None:
                 raise RpcError(f"no service {service_name!r}")
@@ -136,16 +147,29 @@ class RpcServer:
             fn = getattr(svc, method, None)
             if fn is None or not callable(fn):
                 raise RpcError(f"{service_name}.{method} not found")
-            if tctx is None:
+            if tctx is None and not want_cost:
                 return wire.encode((True, fn(*args, **kwargs)))
             # propagated trace context: adopt it around the handler so
             # processor/KV spans record under the caller's trace, and
-            # hand the recorded fragment back in the response
-            rt = tracer.remote(f"{service_name}.{method}",
-                               tctx[0], tctx[1])
-            with rt:
-                result = fn(*args, **kwargs)
-            return wire.encode((True, result, rt.wire_spans))
+            # hand the recorded fragment back in the response. The
+            # cost flag likewise adopts a server-side ledger whose
+            # charges piggyback back as the 4th response element.
+            rt = None if tctx is None else tracer.remote(
+                f"{service_name}.{method}", tctx[0], tctx[1])
+            la = ledger.adopt() if want_cost else None
+            if rt is not None and la is not None:
+                with rt, la:
+                    result = fn(*args, **kwargs)
+            elif la is not None:
+                with la:
+                    result = fn(*args, **kwargs)
+            else:
+                with rt:
+                    result = fn(*args, **kwargs)
+            spans = rt.wire_spans if rt is not None else []
+            if la is not None:
+                return wire.encode((True, result, spans, la.wire))
+            return wire.encode((True, result, spans))
         except Exception as e:  # noqa: BLE001 — errors cross the wire
             try:
                 return wire.encode((False, f"{type(e).__name__}: {e}"))
@@ -321,9 +345,19 @@ class RpcClient:
         # still inside the trace's dynamic extent.
         t0 = time.perf_counter()
         try:
-            if tracer.current_ctx() is None:
-                payload = wire.encode((self.service, method,
-                                       tuple(args), kwargs))
+            tctx = tracer.current_ctx()
+            costed = ledger.current() is not None
+            if tctx is None:
+                if not costed:
+                    payload = wire.encode((self.service, method,
+                                           tuple(args), kwargs))
+                else:
+                    # ledger without trace (sampling off): the cost
+                    # flag still rides — per-host attribution must not
+                    # depend on the sampling decision
+                    payload = wire.encode((self.service, method,
+                                           tuple(args), kwargs,
+                                           None, 1))
                 return self._call_framed(payload)
             # traced call: one rpc.call span covering every attempt (a
             # retry that finally succeeds still joins the remote
@@ -331,9 +365,14 @@ class RpcClient:
             # reconnects)
             with tracer.span("rpc.call", service=self.service,
                              method=method, peer=self.addr):
-                payload = wire.encode((self.service, method,
-                                       tuple(args), kwargs,
-                                       tracer.current_ctx()))
+                if costed:
+                    payload = wire.encode((self.service, method,
+                                           tuple(args), kwargs,
+                                           tracer.current_ctx(), 1))
+                else:
+                    payload = wire.encode((self.service, method,
+                                           tuple(args), kwargs,
+                                           tracer.current_ctx()))
                 return self._call_framed(payload)
         finally:
             global_stats.add_value(
@@ -400,6 +439,14 @@ class RpcClient:
             ok, value = resp[0], resp[1]
             if not ok:
                 raise RpcError(value)
+            led = ledger.current()
+            if led is not None:
+                led.charge(rpc_calls=1, rpc_bytes_out=len(payload),
+                           rpc_bytes_in=len(raw))
+                if len(resp) > 3 and resp[3]:
+                    # server-side cost fragment: merge under the peer's
+                    # host key (per-host rows_scanned/bytes attribution)
+                    led.merge_wire(resp[3], host=self.addr)
             if len(resp) > 2 and resp[2]:
                 # remote span fragment: join it into the live trace
                 tracer.graft(resp[2])

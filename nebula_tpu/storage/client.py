@@ -97,11 +97,14 @@ class StorageClient:
         return by_host
 
     def _submit(self, fn, *args):
-        """Pool submit that carries the caller's trace context into the
-        worker thread (ContextVars don't cross ThreadPoolExecutor on
-        their own) — the per-host RPC spans then land in the query's
-        trace. Untraced callers pay nothing."""
-        if tracer.active():
+        """Pool submit that carries the caller's trace AND ledger
+        contexts into the worker thread (ContextVars don't cross
+        ThreadPoolExecutor on their own) — the per-host RPC spans land
+        in the query's trace, and the per-host cost fragments merge
+        into the query's ledger. Callers carrying neither pay
+        nothing."""
+        from ..common import ledger
+        if tracer.active() or ledger.current() is not None:
             return self._pool.submit(
                 contextvars.copy_context().run, fn, *args)
         return self._pool.submit(fn, *args)

@@ -37,7 +37,7 @@ from ..codec.row import RowReader, RowUpdater, RowWriter, peek_schema_version
 from ..codec.schema import Schema
 from ..common import keys as ku
 from ..common.cache import CacheRung, result_stage_enabled
-from ..common.flags import storage_flags
+from ..common.flags import MUTABLE, storage_flags
 from ..common.status import ErrorCode, Status
 from ..filter.expressions import (DestPropExpr, EdgePropExpr, EvalError,
                                   Expression, ExpressionContext, InputPropExpr,
@@ -45,14 +45,23 @@ from ..filter.expressions import (DestPropExpr, EdgePropExpr, EvalError,
 from ..kvstore.store import GraphStore
 from ..kvstore import log_encoder as le
 from ..meta.schema_manager import SchemaManager
+from ..common import ledger
 from ..common.stats import stats
-from ..common.tracing import ActiveQueryRegistry, tracer
+from ..common.tracing import ActiveQueryRegistry, SlowQueryLog, tracer
 from .types import (BoundRequest, BoundResponse, EdgeData, EdgeKey,
                     ExecResponse, NewEdge, NewVertex, PartResult,
                     PropsResponse, StatDef, StatsResponse, UpdateItemReq,
                     UpdateResponse, VertexData)
 
 DEFAULT_MAX_EDGES_PER_VERTEX = 10000  # FLAGS_max_edge_returned_per_vertex
+
+# storaged's own registry (a standalone storaged's /flags and meta
+# config pull serve storage_flags — the graph_flags twin declared in
+# common/tracing.py is unreachable from another process)
+storage_flags.declare(
+    "slow_query_threshold_ms", 500, MUTABLE,
+    "finished processor ops slower than this land in the slow-op log "
+    "(/queries) with their ledger slice; 0 disables")
 
 
 def is_pushable(expr: Expression) -> bool:
@@ -144,8 +153,13 @@ class StorageService:
         # default and never read it)
         self._max_edges_override = max_edges_per_vertex
         # in-flight read processors, served by storaged's /queries (the
-        # storage-side twin of the graphd active-query registry)
+        # storage-side twin of the graphd active-query registry).
+        # FINISHED ops over slow_query_threshold_ms land in slow_ops
+        # with their ledger slice — before ISSUE 12 a completed op was
+        # dropped without duration or row counts (the gap found while
+        # wiring the cost ledger)
         self.active_ops = ActiveQueryRegistry()
+        self.slow_ops = SlowQueryLog()
         # storaged cache rungs (common/cache.py; cache_mode=full on
         # storage_flags; docs/manual/11-caching.md): bound_stats
         # responses and (part, version) columnar scans, both keyed by
@@ -182,6 +196,26 @@ class StorageService:
         engine = self.store.space_engine(space_id)
         return None if engine is None else int(engine.write_version)
 
+    def _finish_op(self, tok: int, stmt: str) -> None:
+        """Retire an in-flight processor op WITH its duration: ops
+        over slow_query_threshold_ms land in the slow-op log with the
+        trace id they adopted and their server-side ledger slice
+        (ISSUE 12 satellite — completed ops used to vanish from
+        /queries without duration or rows)."""
+        elapsed_ms = self.active_ops.finish(tok)
+        if elapsed_ms is None:
+            return
+        thr = storage_flags.get("slow_query_threshold_ms", 500)
+        if not thr or elapsed_ms <= float(thr):
+            return
+        stats.add_value("storage.slow_op", kind="counter")
+        ctx = tracer.current_ctx()
+        led = ledger.current()
+        self.slow_ops.add(stmt, int(elapsed_ms * 1000),
+                          trace_id=ctx[0] if ctx else "",
+                          cost=led.to_dict() if led is not None
+                          else None)
+
     # ------------------------------------------------------------------
     # schema/row helpers
     # ------------------------------------------------------------------
@@ -213,15 +247,15 @@ class StorageService:
     # ------------------------------------------------------------------
     def get_bound(self, req: BoundRequest) -> BoundResponse:
         n_vids = sum(len(v) for v in req.parts.values())
-        tok = self.active_ops.register(
-            f"get_bound space={req.space_id} parts={len(req.parts)} "
-            f"vids={n_vids}")
+        desc = (f"get_bound space={req.space_id} parts={len(req.parts)} "
+                f"vids={n_vids}")
+        tok = self.active_ops.register(desc)
         try:
             with tracer.span("proc.get_bound", parts=len(req.parts),
                              vids=n_vids, host=self.host):
                 return self._get_bound(req)
         finally:
-            self.active_ops.unregister(tok)
+            self._finish_op(tok, desc)
 
     def _get_bound(self, req: BoundRequest) -> BoundResponse:
         t0 = time.monotonic()
@@ -241,6 +275,8 @@ class StorageService:
         # tags used in the filter must be loaded too
         filter_tags = _filter_tag_ids(self.sm, space, flt)
 
+        scanned = 0
+        ret_bytes = 0
         for part, vids in req.parts.items():
             pr = self.store.part(space, part)
             if not pr.ok():
@@ -253,6 +289,7 @@ class StorageService:
                 want_tags = set(req.vertex_props) | filter_tags
                 for tag_id in want_tags:
                     row = self._newest_tag_row(engine, space, part, vid, tag_id)
+                    scanned += 1
                     if row is not None:
                         if tag_id in req.vertex_props and req.vertex_props[tag_id]:
                             vd.tag_props[tag_id] = {
@@ -263,10 +300,23 @@ class StorageService:
                     (self.sm.tag_name(space, tid) or str(tid)): props
                     for tid, props in vd.tag_props.items()}
                 for etype in edge_types:
-                    self._collect_edge_props(engine, space, part, vid, etype,
-                                             req, ctx, flt, max_edges, vd)
+                    s, b = self._collect_edge_props(
+                        engine, space, part, vid, etype, req, ctx, flt,
+                        max_edges, vd)
+                    scanned += s
+                    ret_bytes += b
                 resp.vertices.append(vd)
             resp.results[part] = PartResult(ErrorCode.SUCCEEDED)
+        # cost ledger, charged SERVER-side under this host's own name
+        # (merged client-side from the RPC piggyback) + fleet counters
+        ledger.charge_host(self.host, rows_scanned=scanned,
+                           bytes_returned=ret_bytes)
+        if scanned:
+            stats.add_value("storage.rows_scanned", scanned,
+                            kind="counter")
+        if ret_bytes:
+            stats.add_value("storage.bytes_returned", ret_bytes,
+                            kind="counter")
         resp.latency_us = int((time.monotonic() - t0) * 1e6)
         # native histogram (was kind="timing"): real bucket series on
         # /metrics, exemplars carrying the adopted remote trace id
@@ -277,7 +327,12 @@ class StorageService:
     def _collect_edge_props(self, engine, space: int, part: int, vid: int,
                             etype: int, req: BoundRequest,
                             ctx: _StorageExprContext, flt, max_edges: int,
-                            vd: VertexData) -> None:
+                            vd: VertexData) -> Tuple[int, int]:
+        """-> (rows scanned, row-value bytes returned) — the cost-
+        ledger accounting of this (vid, etype) scan: scanned counts
+        every deduped edge row ITERATED (filtered-out rows cost IO
+        too), bytes count the raw values of rows that made the
+        response."""
         edge_name = self.sm.edge_name(space, etype) or str(abs(etype))
         ctx.edge_name = edge_name
         prefix = ku.edge_prefix(part, vid, etype)
@@ -289,6 +344,8 @@ class StorageService:
             it = engine.prefix(prefix)
         last_group: Optional[Tuple[int, int]] = None
         count = 0
+        scanned = 0
+        ret_bytes = 0
         for k, v in it:
             _, src, et, rank, dst, _ver = ku.parse_edge_key(k)
             group = (rank, dst)
@@ -297,6 +354,7 @@ class StorageService:
             last_group = group
             if count >= max_edges:
                 break  # cap, ref: FLAGS_max_edge_returned_per_vertex
+            scanned += 1
             if not v:
                 continue  # tombstone
             props = self._decode_row(self.sm.edge_schema, space, etype, v)
@@ -314,6 +372,8 @@ class StorageService:
                 props = {p: props.get(p) for p in req.edge_props if p in props}
             vd.edges.append(EdgeData(vid, et, rank, dst, props))
             count += 1
+            ret_bytes += len(v)
+        return scanned, ret_bytes
 
     # ------------------------------------------------------------------
     # bound_stats — aggregate pushdown (ref: QueryStatsProcessor,
@@ -322,9 +382,10 @@ class StorageService:
     def bound_stats(self, req: BoundRequest,
                     stat_defs: List[StatDef]) -> StatsResponse:
         n_vids = sum(len(v) for v in req.parts.values())
-        tok = self.active_ops.register(
-            f"bound_stats space={req.space_id} parts={len(req.parts)} "
-            f"vids={n_vids} defs={len(stat_defs)}")
+        desc = (f"bound_stats space={req.space_id} "
+                f"parts={len(req.parts)} vids={n_vids} "
+                f"defs={len(stat_defs)}")
+        tok = self.active_ops.register(desc)
         try:
             with tracer.span("proc.bound_stats", parts=len(req.parts),
                              vids=n_vids, host=self.host):
@@ -347,7 +408,7 @@ class StorageService:
                     self.stats_cache.put(key, _copy_stats_response(resp))
                 return resp
         finally:
-            self.active_ops.unregister(tok)
+            self._finish_op(tok, desc)
 
     def _stats_cache_key(self, req: BoundRequest,
                          stat_defs: List[StatDef]):
@@ -436,6 +497,7 @@ class StorageService:
             resp.sums[idx] += v
             resp.counts[idx] += 1
 
+        scanned = 0
         for part, vids in req.parts.items():
             pr = self.store.part(space, part)
             if not pr.ok():
@@ -457,6 +519,7 @@ class StorageService:
                     row = want[d.schema_id]
                     if row is not None:
                         _acc(idx, row, d)
+                scanned += len(want)
                 for tid, row in want.items():
                     if row is not None:
                         src_props[self.sm.tag_name(space, tid) or str(tid)] = row
@@ -465,14 +528,20 @@ class StorageService:
                     continue
                 for etype in edge_types:
                     vd = VertexData(vid)
-                    self._collect_edge_props(engine, space, part, vid, etype,
-                                             req, ctx, flt, max_edges, vd)
+                    s, _b = self._collect_edge_props(
+                        engine, space, part, vid, etype, req, ctx, flt,
+                        max_edges, vd)
+                    scanned += s
                     for ed in vd.edges:
                         for idx, d in edge_defs:
                             if d.schema_id and d.schema_id != ed.etype:
                                 continue
                             _acc(idx, ed.props, d)
             resp.results[part] = PartResult(ErrorCode.SUCCEEDED)
+        ledger.charge_host(self.host, rows_scanned=scanned)
+        if scanned:
+            stats.add_value("storage.rows_scanned", scanned,
+                            kind="counter")
         resp.latency_us = int((time.monotonic() - t0) * 1e6)
         stats.add_value("storage.bound_stats_latency_us",
                         resp.latency_us, kind="histogram")
@@ -864,18 +933,32 @@ class StorageService:
         the remote TPU engine's incremental snapshot feed.
         -> (now_version, entries | None); None = rebuild needed."""
         from ..kvstore.changelog import resolve_changes
-        engine = self.store.space_engine(space_id)
-        if engine is None or getattr(engine, "changes", None) is None:
-            return -1, None
-        now_v, raw = engine.changes_snapshot(since)
-        if raw is None:
-            return now_v, None
-        return now_v, resolve_changes(engine, raw)
+        desc = f"changes_since space={space_id} since={since}"
+        tok = self.active_ops.register(desc)
+        try:
+            with tracer.span("proc.changes_since", space=space_id,
+                             host=self.host):
+                engine = self.store.space_engine(space_id)
+                if engine is None or \
+                        getattr(engine, "changes", None) is None:
+                    return -1, None
+                now_v, raw = engine.changes_snapshot(since)
+                if raw is None:
+                    return now_v, None
+                entries = resolve_changes(engine, raw)
+                # delta-feed cost: every resolved change row was read
+                # server-side on this query's behalf (the incremental
+                # twin of the scan_part charge)
+                ledger.charge_host(self.host,
+                                   rows_scanned=len(entries))
+                return now_v, entries
+        finally:
+            self._finish_op(tok, desc)
 
     def scan_part_cols(self, space_id: int, part: int,
                        kind: int) -> "ScanPartResponse":
-        tok = self.active_ops.register(
-            f"scan_part_cols space={space_id} part={part} kind={kind}")
+        desc = f"scan_part_cols space={space_id} part={part} kind={kind}"
+        tok = self.active_ops.register(desc)
         try:
             with tracer.span("proc.scan_part", part=part, kind=kind,
                              host=self.host):
@@ -908,9 +991,15 @@ class StorageService:
                         resp.result.code == ErrorCode.SUCCEEDED and \
                         self._engine_version(space_id) == key[3]:
                     self.scan_cache.put(key, resp)
+                # columnar scan cost (cache hits return above and
+                # charge only the rung hit): rows + blob bytes shipped
+                ledger.charge_host(
+                    self.host, rows_scanned=resp.n,
+                    bytes_returned=len(resp.keys_blob or b"")
+                    + len(resp.vals_blob or b""))
                 return resp
         finally:
-            self.active_ops.unregister(tok)
+            self._finish_op(tok, desc)
 
     def _scan_part_cols(self, space_id: int, part: int,
                         kind: int) -> "ScanPartResponse":

@@ -1,0 +1,187 @@
+"""benchdiff: compare two bench JSON artifacts with per-metric
+direction + tolerance rules — the perf-trajectory gate (ISSUE 12
+satellite; docs/manual/10-observability.md).
+
+Every BENCH_r*/CLUSTER_bench/TENANTS_bench artifact records the same
+dotted-path numeric tree; until now the only regression check was
+prose in CHANGES.md. benchdiff walks both trees, pairs every numeric
+leaf, and judges the gated ones:
+
+    python -m nebula_tpu.tools.benchdiff OLD.json NEW.json
+        [--tolerance 0.25] [--json] [--advisory] [--rule PAT=dir ...]
+
+Direction rules match dotted paths by glob-ish patterns (fnmatch on
+the full path, case-insensitive); first match wins; unmatched leaves
+are reported as informational drift, never gated. Exit status: 0 = no
+gated regression, 1 = regression beyond tolerance (unless
+--advisory), 2 = usage/IO error.
+
+The verify skill runs this as an advisory step against the committed
+baseline artifact — the trajectory is measured, not asserted.
+"""
+from __future__ import annotations
+
+import argparse
+import fnmatch
+import json
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+# (pattern, direction): direction "higher" = bigger is better,
+# "lower" = smaller is better, "ignore" = never judged (counts,
+# configuration echoes, wall clocks of fixed-duration phases).
+# First match wins; patterns are matched case-insensitively against
+# the full dotted path.
+DEFAULT_RULES: Tuple[Tuple[str, str], ...] = (
+    # configuration echoes / identifiers / counts: not performance
+    ("*.n", "ignore"), ("*.sessions*", "ignore"), ("*.seed", "ignore"),
+    ("*graph.*", "ignore"), ("*topology.*", "ignore"),
+    ("*.wall_s", "ignore"), ("*.plan", "ignore"),
+    ("*batch", "ignore"), ("*.sampled_traces", "ignore"),
+    ("*threshold*", "ignore"), ("*bound_ms", "ignore"),
+    ("*.ts", "ignore"), ("*phase_s", "ignore"),
+    # latencies / waits / impact ratios: smaller is better
+    ("*p50*", "lower"), ("*p9*", "lower"), ("*_ms", "lower"),
+    ("*_us", "lower"), ("*latency*", "lower"),
+    ("*p99_impact*", "lower"), ("*errors*", "lower"),
+    ("*overload*", "lower"), ("*fallback*", "lower"),
+    # throughputs: bigger is better
+    ("*qps*", "higher"), ("*value", "higher"), ("*eps*", "higher"),
+    ("*gbs*", "higher"), ("*served*", "higher"),
+    ("*explained*", "higher"),
+)
+
+
+def flatten(obj: Any, prefix: str = "",
+            out: Optional[Dict[str, float]] = None) -> Dict[str, float]:
+    """Numeric leaves of a JSON tree as {dotted.path: value}. Bools
+    are skipped (ok flags judge themselves); lists index by position
+    only when numeric (bucket vectors)."""
+    if out is None:
+        out = {}
+    if isinstance(obj, dict):
+        for k, v in obj.items():
+            flatten(v, f"{prefix}{k}.", out)
+    elif isinstance(obj, list):
+        for i, v in enumerate(obj):
+            if isinstance(v, (int, float)) and not isinstance(v, bool):
+                out[f"{prefix}{i}"] = float(v)
+    elif isinstance(obj, (int, float)) and not isinstance(obj, bool):
+        out[prefix[:-1] if prefix.endswith(".") else prefix] = \
+            float(obj)
+    return out
+
+
+def direction_of(path: str,
+                 rules: Tuple[Tuple[str, str], ...]) -> Optional[str]:
+    p = path.lower()
+    for pat, d in rules:
+        if fnmatch.fnmatch(p, pat):
+            return d
+    return None
+
+
+def compare(old: Dict[str, Any], new: Dict[str, Any],
+            tolerance: float = 0.25,
+            rules: Tuple[Tuple[str, str], ...] = DEFAULT_RULES
+            ) -> Dict[str, Any]:
+    """-> {"regressions": [...], "improvements": [...],
+           "drift": [...], "only_old": [...], "only_new": [...]}.
+    A gated metric regresses when it moves against its direction by
+    more than `tolerance` (relative; absolute floor of 1e-9 guards
+    zero baselines)."""
+    fo, fn = flatten(old), flatten(new)
+    regressions: List[Dict[str, Any]] = []
+    improvements: List[Dict[str, Any]] = []
+    drift: List[Dict[str, Any]] = []
+    for path in sorted(set(fo) & set(fn)):
+        a, b = fo[path], fn[path]
+        if a == b:
+            continue
+        d = direction_of(path, rules)
+        rel = (b - a) / abs(a) if abs(a) > 1e-9 else float("inf")
+        row = {"path": path, "old": a, "new": b,
+               "rel": round(rel, 4) if rel != float("inf") else None,
+               "direction": d}
+        if d in (None, "ignore"):
+            drift.append(row)
+            continue
+        against = -rel if d == "higher" else rel
+        if against > tolerance:
+            regressions.append(row)
+        elif against < 0:
+            improvements.append(row)
+        else:
+            drift.append(row)
+    return {"regressions": regressions, "improvements": improvements,
+            "drift": drift,
+            "only_old": sorted(set(fo) - set(fn)),
+            "only_new": sorted(set(fn) - set(fo)),
+            "tolerance": tolerance}
+
+
+def render_text(result: Dict[str, Any]) -> str:
+    lines = []
+
+    def fmt(row):
+        rel = row["rel"]
+        rel_s = f"{rel * 100:+.1f}%" if rel is not None else "new!=0"
+        return (f"  {row['path']}: {row['old']:g} -> {row['new']:g} "
+                f"({rel_s}, {row['direction'] or 'unrated'})")
+
+    lines.append(f"benchdiff (tolerance {result['tolerance']:.0%})")
+    lines.append(f"REGRESSIONS ({len(result['regressions'])}):")
+    lines.extend(fmt(r) for r in result["regressions"])
+    lines.append(f"improvements ({len(result['improvements'])}):")
+    lines.extend(fmt(r) for r in result["improvements"][:20])
+    lines.append(f"drift/unrated ({len(result['drift'])} paths, "
+                 f"{len(result['only_old'])} removed, "
+                 f"{len(result['only_new'])} added)")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="benchdiff",
+        description="compare two bench JSON artifacts; exit 1 on "
+                    "regression beyond tolerance")
+    ap.add_argument("old")
+    ap.add_argument("new")
+    ap.add_argument("--tolerance", type=float, default=0.25,
+                    help="relative regression tolerance (default 0.25)")
+    ap.add_argument("--json", action="store_true",
+                    help="machine output instead of text")
+    ap.add_argument("--advisory", action="store_true",
+                    help="report but always exit 0 (CI advisory mode)")
+    ap.add_argument("--rule", action="append", default=[],
+                    metavar="PAT=DIR",
+                    help="prepend a direction rule (DIR: higher|lower|"
+                         "ignore); first match wins")
+    args = ap.parse_args(argv)
+    rules: List[Tuple[str, str]] = []
+    for r in args.rule:
+        pat, _, d = r.partition("=")
+        if d not in ("higher", "lower", "ignore"):
+            print(f"benchdiff: bad --rule {r!r} (DIR must be "
+                  f"higher|lower|ignore)", file=sys.stderr)
+            return 2
+        rules.append((pat.lower(), d))
+    try:
+        with open(args.old) as f:
+            old = json.load(f)
+        with open(args.new) as f:
+            new = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"benchdiff: {e}", file=sys.stderr)
+        return 2
+    result = compare(old, new, tolerance=args.tolerance,
+                     rules=tuple(rules) + DEFAULT_RULES)
+    print(json.dumps(result, indent=1) if args.json
+          else render_text(result))
+    if result["regressions"] and not args.advisory:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -310,6 +310,36 @@ def nl003(project: Project) -> List[Finding]:
 
 _NL004_KINDS = ("counter", "timing", "histogram")
 
+# Metric-family kind CONTRACTS by name prefix: every add_value whose
+# name starts with (or is an f-string/concat whose constant prefix
+# reaches into) one of these families must declare exactly this kind.
+# graph.cost.* are the ISSUE-12 per-tenant/per-verb cost rollups —
+# dynamic names (f"graph.cost.{space}.{field}") skip the per-name
+# conflict check below, so the prefix contract is what keeps a typo'd
+# kind from silently registering an untagged (or counter-shaped)
+# cost family.
+_NL004_FAMILY_KINDS = {
+    "graph.cost.": "histogram",
+}
+
+
+def _const_prefix(node) -> Optional[str]:
+    """Best-effort constant PREFIX of a metric-name expression:
+    handles plain constants, f-strings (leading literal), and
+    string concatenation ('a.' + x). None when nothing constant
+    leads the name."""
+    s = const_str(node)
+    if s is not None:
+        return s
+    if isinstance(node, ast.JoinedStr) and node.values:
+        first = node.values[0]
+        if isinstance(first, ast.Constant) and \
+                isinstance(first.value, str):
+            return first.value
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        return _const_prefix(node.left)
+    return None
+
 
 @rule("NL004", "add_value kind inconsistent across sites for one metric")
 def nl004(project: Project) -> List[Finding]:
@@ -364,6 +394,22 @@ def nl004(project: Project) -> List[Finding]:
                     f"— common/stats.py registers it UNTAGGED (legacy "
                     f"emit-everything shape); expected one of "
                     f"{_NL004_KINDS}", f.qualname_at(node)))
+            # family-prefix kind contracts (covers DYNAMIC names too:
+            # the f-string's constant prefix identifies the family)
+            prefix = _const_prefix(node.args[0]) if node.args else None
+            if prefix is not None:
+                for fam_prefix, want_kind in _NL004_FAMILY_KINDS.items():
+                    if prefix.startswith(fam_prefix) and \
+                            kind != want_kind:
+                        out.append(Finding(
+                            "NL004", f.rel, node.lineno,
+                            node.col_offset,
+                            f"metric family {fam_prefix}* is "
+                            f"contractually kind={want_kind!r} but "
+                            f"this site declares {kind!r} — the cost "
+                            f"rollups must stay native histograms "
+                            f"(docs/manual/10-observability.md)",
+                            f.qualname_at(node)))
             if name is None:
                 continue          # dynamic names: per-family, skip
             sites.setdefault(name, []).append(
@@ -692,7 +738,9 @@ def nl007(project: Project) -> List[Finding]:
                     f"positional; append new types at the END",
                     "_register_defaults"))
 
-    # 3. envelope arity in transport.py: requests 4/5, responses 2/3
+    # 3. envelope arity in transport.py: requests 4/5/6, responses
+    # 2/3/4 (v1.1 added the trace context + span fragment; v1.2 the
+    # cost flag + ledger fragment — both additive, manual 6 §2)
     for f in project.files:
         if f.rel != _TRANSPORT_MODULE or f.tree is None:
             continue
@@ -709,13 +757,14 @@ def nl007(project: Project) -> List[Finding]:
             first = tup.elts[0]
             is_resp = isinstance(first, ast.Constant) and \
                 isinstance(first.value, bool)
-            ok = arity in ((2, 3) if is_resp else (4, 5))
+            ok = arity in ((2, 3, 4) if is_resp else (4, 5, 6))
             if not ok:
                 shape = "response" if is_resp else "request"
                 out.append(Finding(
                     "NL007", f.rel, node.lineno, node.col_offset,
                     f"rpc {shape} envelope arity {arity} violates the "
-                    f"frozen wire contract ({'2/3' if is_resp else '4/5'}"
+                    f"frozen wire contract "
+                    f"({'2/3/4' if is_resp else '4/5/6'}"
                     f"-tuple; docs/manual/6-wire-protocol.md)",
                     f.qualname_at(node)))
     return out

@@ -1,0 +1,237 @@
+"""nebtop: a live top-style view of the whole cluster from ONE scrape
+(docs/manual/10-observability.md, "Cluster rollup / nebtop").
+
+Reads graphd's `/cluster_metrics` — the federated OpenMetrics document
+carrying every daemon's families under instance/role labels — and
+renders, per refresh:
+
+  - per-instance liveness (nebula_cluster_scrape), role, uptime
+  - cluster QPS + error rate (deltas of nebula_graph_query_total
+    between scrapes), p95/p99 latency gauges
+  - device utilization proxies (kernel_us avg, fused launches/s,
+    dispatcher queue depth + lane occupancy)
+  - per-tenant COST rates from the graph.cost.* histogram _sum deltas
+    (device us/s, rows scanned/s, rpc bytes/s per space)
+  - raft leader distribution (storage.raft.*.is_leader gauges per
+    instance) — a skewed leader column is tomorrow's hotspot
+
+    python -m nebula_tpu.tools.nebtop --url http://127.0.0.1:13000 \
+        [--interval 2.0] [--once] [--json]
+
+`--once` prints a single snapshot (totals, no rates) and exits —
+scriptable and testable; the loop mode redraws with ANSI clears.
+Parsing is self-contained (sample-line subset) so the tool runs
+against any conformant exposition without importing the test parser.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+import time
+import urllib.request
+from typing import Any, Dict, List, Optional, Tuple
+
+_SAMPLE_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{.*?\})? "
+                        r"(-?[0-9.eE+]+|[+-]?Inf)")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="((?:[^"\\]|\\.)*)"')
+
+
+def parse_samples(text: str) -> List[Tuple[str, Dict[str, str], float]]:
+    """[(name, labels, value)] for every sample line; comments,
+    exemplars and timestamps are ignored (the rollup view needs
+    values, not full conformance — tests/openmetrics.py does that)."""
+    out = []
+    for line in text.split("\n"):
+        if not line or line.startswith("#"):
+            continue
+        m = _SAMPLE_RE.match(line)
+        if not m:
+            continue
+        name, lbl, val = m.group(1), m.group(2), m.group(3)
+        labels = dict(_LABEL_RE.findall(lbl)) if lbl else {}
+        try:
+            v = float(val)
+        except ValueError:
+            continue
+        out.append((name, labels, v))
+    return out
+
+
+class Snapshot:
+    """One scrape, indexed for the views nebtop renders."""
+
+    def __init__(self, samples: List[Tuple[str, Dict[str, str], float]],
+                 t: float):
+        self.t = t
+        self.samples = samples
+
+    def get(self, name: str, **labels) -> Optional[float]:
+        for n, lbl, v in self.samples:
+            if n == name and all(lbl.get(k) == w
+                                 for k, w in labels.items()):
+                return v
+        return None
+
+    def sum(self, name: str, **labels) -> float:
+        return sum(v for n, lbl, v in self.samples
+                   if n == name and all(lbl.get(k) == w
+                                        for k, w in labels.items()))
+
+    def by_instance(self, name: str) -> Dict[str, float]:
+        out: Dict[str, float] = {}
+        for n, lbl, v in self.samples:
+            if n == name:
+                inst = lbl.get("instance", "?")
+                out[inst] = out.get(inst, 0.0) + v
+        return out
+
+    def instances(self) -> List[Dict[str, Any]]:
+        out = []
+        for n, lbl, v in self.samples:
+            if n == "nebula_cluster_scrape":
+                out.append({"instance": lbl.get("instance", "?"),
+                            "role": lbl.get("role", "?"),
+                            "up": v >= 1})
+        return sorted(out, key=lambda r: (r["role"], r["instance"]))
+
+    def leader_counts(self) -> Dict[str, int]:
+        """instance -> parts led (storage.raft.sX.pY.is_leader
+        gauges, federated as nebula_storage_raft_*_is_leader)."""
+        out: Dict[str, int] = {}
+        for n, lbl, v in self.samples:
+            if n.startswith("nebula_storage_raft_") and \
+                    n.endswith("_is_leader") and v >= 1:
+                inst = lbl.get("instance", "?")
+                out[inst] = out.get(inst, 0) + 1
+        return out
+
+    def tenant_cost(self) -> Dict[str, Dict[str, float]]:
+        """space -> {field: histogram _sum total} from the
+        nebula_graph_cost_<space>_<field>_sum families."""
+        out: Dict[str, Dict[str, float]] = {}
+        pat = re.compile(r"^nebula_graph_cost_(?!verb_)(.+)_"
+                         r"(device_us|rows_scanned|rpc_bytes|"
+                         r"h2d_bytes|d2h_bytes|queue_wait_us|"
+                         r"bytes_returned|wal_bytes)_sum$")
+        for n, _lbl, v in self.samples:
+            m = pat.match(n)
+            if m:
+                space, field = m.group(1), m.group(2)
+                out.setdefault(space, {})[field] = \
+                    out.setdefault(space, {}).get(field, 0.0) + v
+        return out
+
+
+def scrape(url: str, timeout: float = 5.0) -> Snapshot:
+    with urllib.request.urlopen(url, timeout=timeout) as r:
+        text = r.read().decode()
+    return Snapshot(parse_samples(text), time.time())
+
+
+def _rate(new: Snapshot, old: Optional[Snapshot], name: str) -> float:
+    if old is None:
+        return 0.0
+    dt = max(new.t - old.t, 1e-6)
+    return max((new.sum(name) - old.sum(name)) / dt, 0.0)
+
+
+def render(new: Snapshot, old: Optional[Snapshot]) -> str:
+    lines: List[str] = []
+    insts = new.instances()
+    up = sum(1 for i in insts if i["up"])
+    lines.append(f"nebtop — {up}/{len(insts)} daemons up    "
+                 f"{time.strftime('%H:%M:%S')}")
+    leaders = new.leader_counts()
+    lines.append(f"{'INSTANCE':<24}{'ROLE':<9}{'UP':<4}{'LEADERS':<8}"
+                 f"{'UPTIME_S':<10}")
+    for i in insts:
+        upt = new.get("nebula_process_uptime_seconds",
+                      instance=i["instance"])
+        lines.append(
+            f"{i['instance']:<24}{i['role']:<9}"
+            f"{'y' if i['up'] else 'N':<4}"
+            f"{leaders.get(i['instance'], 0):<8}"
+            f"{upt if upt is not None else '-':<10}")
+    qps = _rate(new, old, "nebula_graph_query_total")
+    errs = _rate(new, old, "nebula_graph_query_error_total")
+    p99 = new.get("nebula_graph_query_latency_us_p99_60s") or 0.0
+    lines.append("")
+    lines.append(f"queries: {qps:8.1f} qps   errors: {errs:6.2f}/s   "
+                 f"p99(60s): {p99 / 1000:8.2f} ms")
+    qd = new.sum("nebula_tpu_engine_qos_queue_depth")
+    kern = new.get("nebula_tpu_engine_kernel_us_avg_60s") or 0.0
+    fl = _rate(new, old, "nebula_tpu_engine_fused_launches")
+    lines.append(f"device:  kernel avg {kern:8.0f} us   "
+                 f"fused {fl:6.1f} launch/s   queue depth {qd:.0f}")
+    cost = new.tenant_cost()
+    if cost:
+        lines.append("")
+        lines.append(f"{'TENANT':<16}{'DEV_US':>12}{'ROWS':>12}"
+                     f"{'RPC_B':>12}")
+        old_cost = old.tenant_cost() if old is not None else {}
+        dt = max(new.t - old.t, 1e-6) if old is not None else None
+
+        def cell(space, f):
+            total = cost[space].get(f, 0.0)
+            if dt is None:
+                return f"{total:.0f}"
+            prev = old_cost.get(space, {}).get(f, 0.0)
+            return f"{max(total - prev, 0) / dt:.0f}/s"
+
+        for space in sorted(cost):
+            lines.append(f"{space:<16}{cell(space, 'device_us'):>12}"
+                         f"{cell(space, 'rows_scanned'):>12}"
+                         f"{cell(space, 'rpc_bytes'):>12}")
+    return "\n".join(lines)
+
+
+def snapshot_dict(s: Snapshot) -> Dict[str, Any]:
+    """--once --json machine form (totals, no rates)."""
+    return {"instances": s.instances(),
+            "leaders": s.leader_counts(),
+            "query_total": s.sum("nebula_graph_query_total"),
+            "tenant_cost": s.tenant_cost()}
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="nebtop", description="cluster top over /cluster_metrics")
+    ap.add_argument("--url", default="http://127.0.0.1:13000",
+                    help="graphd admin base URL (or a full "
+                         "/cluster_metrics URL)")
+    ap.add_argument("--interval", type=float, default=2.0)
+    ap.add_argument("--once", action="store_true",
+                    help="one snapshot, no rates, exit")
+    ap.add_argument("--json", action="store_true")
+    args = ap.parse_args(argv)
+    url = args.url if args.url.endswith("/cluster_metrics") \
+        else args.url.rstrip("/") + "/cluster_metrics"
+    try:
+        snap = scrape(url)
+    except Exception as e:
+        print(f"nebtop: scrape failed: {e}", file=sys.stderr)
+        return 2
+    if args.once:
+        print(json.dumps(snapshot_dict(snap), indent=1) if args.json
+              else render(snap, None))
+        return 0
+    prev = snap
+    try:
+        while True:
+            time.sleep(max(args.interval, 0.2))
+            try:
+                cur = scrape(url)
+            except Exception as e:
+                print(f"nebtop: scrape failed: {e}", file=sys.stderr)
+                continue
+            sys.stdout.write("\x1b[2J\x1b[H")
+            print(render(cur, prev))
+            prev = cur
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

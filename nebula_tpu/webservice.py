@@ -333,6 +333,16 @@ class WebService:
                 except ValueError:
                     return 400, {"error": "arm must be an integer"}
                 return 200, {"armed": tracing.tracer.arm(n)}
+            # ?critpath=<id> — fold one trace (remote fragments
+            # included) into its dominant-path attribution
+            # (common/critpath.py; "73% proc.scan_part on host B")
+            cp = params.get("critpath")
+            if cp:
+                t = trace_ring.get(cp)
+                if t is None:
+                    return 404, {"error": f"trace {cp!r} not in ring"}
+                from .common import critpath
+                return 200, critpath.analyze(t)
             tid = params.get("id")
             if tid:
                 t = trace_ring.get(tid)

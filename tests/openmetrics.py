@@ -267,37 +267,53 @@ def _validate_families(families: Dict[str, Family]) -> None:
                 raise OpenMetricsError(
                     0, f"gauge family {fam.name!r} has no sample")
         elif fam.type == "histogram":
-            buckets = [s for s in fam.samples
-                       if s.name == fam.name + "_bucket"]
-            if not buckets:
+            # validated PER LABEL SERIES (labels minus `le`): a
+            # federated family carries one complete bucket ladder per
+            # instance — cross-series bucket ordering is meaningless,
+            # per-series monotonicity/consistency is the contract
+            # (graphd's /cluster_metrics merges every daemon's
+            # exposition into one document)
+            def series_key(s: Sample) -> Tuple:
+                return tuple(sorted((k, v) for k, v in
+                                    s.labels.items() if k != "le"))
+
+            buckets_by: Dict[Tuple, List[Sample]] = {}
+            for s in fam.samples:
+                if s.name == fam.name + "_bucket":
+                    buckets_by.setdefault(series_key(s), []).append(s)
+            if not buckets_by:
                 raise OpenMetricsError(
                     0, f"histogram {fam.name!r} has no buckets")
-            les = []
-            for b in buckets:
-                if "le" not in b.labels:
+            counts_by = {series_key(s): s.value for s in fam.samples
+                         if s.name == fam.name + "_count"}
+            sums_by = {series_key(s) for s in fam.samples
+                       if s.name == fam.name + "_sum"}
+            for key, buckets in buckets_by.items():
+                les = []
+                for b in buckets:
+                    if "le" not in b.labels:
+                        raise OpenMetricsError(
+                            0, f"histogram {fam.name!r} bucket "
+                               f"without le label")
+                    les.append(math.inf if b.labels["le"] == "+Inf"
+                               else float(b.labels["le"]))
+                if les != sorted(les) or les[-1] != math.inf:
                     raise OpenMetricsError(
-                        0, f"histogram {fam.name!r} bucket without "
-                           f"le label")
-                les.append(math.inf if b.labels["le"] == "+Inf"
-                           else float(b.labels["le"]))
-            if les != sorted(les) or les[-1] != math.inf:
-                raise OpenMetricsError(
-                    0, f"histogram {fam.name!r} buckets not ascending "
-                       f"/ missing +Inf")
-            counts = [b.value for b in buckets]
-            if counts != sorted(counts):
-                raise OpenMetricsError(
-                    0, f"histogram {fam.name!r} bucket counts not "
-                       f"cumulative")
-            count = [s for s in fam.samples
-                     if s.name == fam.name + "_count"]
-            if not count or count[0].value != counts[-1]:
-                raise OpenMetricsError(
-                    0, f"histogram {fam.name!r} _count != +Inf bucket")
-            if not any(s.name == fam.name + "_sum"
-                       for s in fam.samples):
-                raise OpenMetricsError(
-                    0, f"histogram {fam.name!r} missing _sum")
+                        0, f"histogram {fam.name!r} series {key!r} "
+                           f"buckets not ascending / missing +Inf")
+                counts = [b.value for b in buckets]
+                if counts != sorted(counts):
+                    raise OpenMetricsError(
+                        0, f"histogram {fam.name!r} series {key!r} "
+                           f"bucket counts not cumulative")
+                if counts_by.get(key) != counts[-1]:
+                    raise OpenMetricsError(
+                        0, f"histogram {fam.name!r} series {key!r} "
+                           f"_count != +Inf bucket")
+                if key not in sums_by:
+                    raise OpenMetricsError(
+                        0, f"histogram {fam.name!r} series {key!r} "
+                           f"missing _sum")
 
 
 def exemplar_trace_ids(families: Dict[str, Family]) -> Dict[str, str]:

@@ -1,0 +1,191 @@
+"""benchdiff + nebtop + federation units (ISSUE 12 satellites): the
+perf-trajectory gate over crafted fixtures, the cluster-metrics merge
+(strict-parsed), and nebtop's exposition reader."""
+import json
+
+from nebula_tpu.tools import benchdiff
+
+import openmetrics
+
+
+# ------------------------------------------------------------ fixtures
+
+OLD = {
+    "parsed": {
+        "value": 100.0,
+        "tier2_full_query_ms": {"p50": 2.0, "p99": 5.0,
+                                "qps_batch1": 300.0},
+        "tier3": {"qps": 40.0, "sessions": 8},
+    },
+    "phases": {"baseline": {"n": 100, "p99_ms": 120.0, "qps": 75.0}},
+}
+
+
+def _new(**over):
+    new = json.loads(json.dumps(OLD))
+    for path, v in over.items():
+        cur = new
+        keys = path.split("__")
+        for k in keys[:-1]:
+            cur = cur[k]
+        cur[keys[-1]] = v
+    return new
+
+
+def test_no_change_passes(tmp_path):
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(OLD))
+    b.write_text(json.dumps(OLD))
+    assert benchdiff.main([str(a), str(b)]) == 0
+
+
+def test_latency_regression_fails(tmp_path):
+    new = _new(parsed__tier2_full_query_ms__p99=9.0)   # 5 -> 9 ms
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(OLD))
+    b.write_text(json.dumps(new))
+    assert benchdiff.main([str(a), str(b)]) == 1
+    # advisory mode reports but exits 0 (the verify-skill CI step)
+    assert benchdiff.main([str(a), str(b), "--advisory"]) == 0
+
+
+def test_qps_drop_fails_and_direction_is_respected(tmp_path):
+    new = _new(parsed__tier3__qps=20.0)               # 40 -> 20
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(OLD))
+    b.write_text(json.dumps(new))
+    assert benchdiff.main([str(a), str(b)]) == 1
+    # a qps INCREASE is an improvement, never a regression
+    new2 = _new(parsed__tier3__qps=80.0)
+    b.write_text(json.dumps(new2))
+    assert benchdiff.main([str(a), str(b)]) == 0
+
+
+def test_tolerance_absorbs_noise(tmp_path):
+    new = _new(parsed__tier2_full_query_ms__p99=5.5)  # +10% < 25%
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(OLD))
+    b.write_text(json.dumps(new))
+    assert benchdiff.main([str(a), str(b)]) == 0
+    assert benchdiff.main([str(a), str(b), "--tolerance", "0.05"]) == 1
+
+
+def test_config_echoes_are_ignored():
+    new = _new(parsed__tier3__sessions=16, phases__baseline__n=1)
+    r = benchdiff.compare(OLD, new)
+    assert not r["regressions"]
+    paths = {d["path"] for d in r["drift"]}
+    assert "parsed.tier3.sessions" in paths
+
+
+def test_custom_rule_wins(tmp_path):
+    new = _new(parsed__value=50.0)
+    r = benchdiff.compare(OLD, new)
+    assert any(x["path"] == "parsed.value" for x in r["regressions"])
+    # --rule can demote it to ignore (first match wins)
+    r2 = benchdiff.compare(
+        OLD, new, rules=(("parsed.value", "ignore"),)
+        + benchdiff.DEFAULT_RULES)
+    assert not r2["regressions"]
+
+
+def test_bad_usage_exits_2(tmp_path):
+    assert benchdiff.main(["/nope/a.json", "/nope/b.json"]) == 2
+    a = tmp_path / "a.json"
+    a.write_text("{}")
+    assert benchdiff.main([str(a), str(a), "--rule", "x=sideways"]) == 2
+
+
+def test_json_output_shape(tmp_path, capsys):
+    a = tmp_path / "a.json"
+    a.write_text(json.dumps(OLD))
+    assert benchdiff.main([str(a), str(a), "--json"]) == 0
+    out = json.loads(capsys.readouterr().out)
+    assert set(out) >= {"regressions", "improvements", "drift"}
+
+
+# --------------------------------------------------------- federation
+
+def test_merge_expositions_strict_parses():
+    from nebula_tpu.common.promfed import merge_expositions
+    graph_text = (
+        "# TYPE nebula_graph_query counter\n"
+        "nebula_graph_query_total 12\n"
+        "# TYPE nebula_lat histogram\n"
+        'nebula_lat_bucket{le="1"} 1\n'
+        'nebula_lat_bucket{le="+Inf"} 2\n'
+        "nebula_lat_sum 3\n"
+        "nebula_lat_count 2\n"
+        "# TYPE nebula_build_info gauge\n"
+        'nebula_build_info{daemon="graphd",role="graph"} 1\n'
+        "# EOF\n")
+    storage_text = (
+        "# TYPE nebula_graph_query counter\n"
+        "nebula_graph_query_total 0\n"
+        "# TYPE nebula_lat histogram\n"
+        'nebula_lat_bucket{le="1"} 5\n'
+        'nebula_lat_bucket{le="+Inf"} 6\n'
+        "nebula_lat_sum 9\n"
+        "nebula_lat_count 6\n"
+        "# EOF\n")
+    doc = merge_expositions([
+        ("127.0.0.1:13000", "graph", graph_text),
+        ("127.0.0.1:12000", "storage", storage_text),
+        ("127.0.0.1:12001", "storage", None),       # dead daemon
+    ])
+    fams = openmetrics.parse(doc)
+    # one family per name, samples from both instances
+    q = fams["nebula_graph_query"]
+    insts = {s.labels["instance"] for s in q.samples}
+    assert insts == {"127.0.0.1:13000", "127.0.0.1:12000"}
+    # per-series histogram consistency survives federation
+    assert "nebula_lat" in fams
+    # the pre-labeled role on build_info is NOT duplicated
+    bi = fams["nebula_build_info"].samples[0]
+    assert bi.labels["role"] == "graph"
+    assert bi.labels["instance"] == "127.0.0.1:13000"
+    # scrape-health family marks the dead daemon down
+    scrape = {s.labels["instance"]: s.value
+              for s in fams["nebula_cluster_scrape"].samples}
+    assert scrape["127.0.0.1:12001"] == 0
+    assert scrape["127.0.0.1:12000"] == 1
+
+
+def test_merge_type_conflict_drops_dissenter():
+    from nebula_tpu.common.promfed import merge_expositions
+    a = "# TYPE nebula_x gauge\nnebula_x 1\n# EOF\n"
+    b = "# TYPE nebula_x counter\nnebula_x_total 2\n# EOF\n"
+    doc = merge_expositions([("i1", "graph", a), ("i2", "storage", b)])
+    fams = openmetrics.parse(doc)
+    assert fams["nebula_x"].type == "gauge"
+    assert len(fams["nebula_x"].samples) == 1
+
+
+# -------------------------------------------------------------- nebtop
+
+def test_nebtop_parse_and_views():
+    from nebula_tpu.tools import nebtop
+    text = (
+        "# TYPE nebula_cluster_scrape gauge\n"
+        'nebula_cluster_scrape{instance="a:1",role="graph"} 1\n'
+        'nebula_cluster_scrape{instance="b:2",role="storage"} 0\n'
+        "# TYPE nebula_graph_query counter\n"
+        'nebula_graph_query_total{instance="a:1",role="graph"} 42\n'
+        "# TYPE nebula_storage_raft_s1_p1_is_leader gauge\n"
+        'nebula_storage_raft_s1_p1_is_leader{instance="b:2"} 1\n'
+        "# TYPE nebula_graph_cost_myspace_device_us histogram\n"
+        'nebula_graph_cost_myspace_device_us_bucket'
+        '{instance="a:1",le="+Inf"} 3\n'
+        'nebula_graph_cost_myspace_device_us_sum{instance="a:1"} 777\n'
+        'nebula_graph_cost_myspace_device_us_count{instance="a:1"} 3\n'
+        "# EOF\n")
+    snap = nebtop.Snapshot(nebtop.parse_samples(text), t=100.0)
+    insts = snap.instances()
+    assert [i["instance"] for i in insts] == ["a:1", "b:2"]
+    assert insts[1]["up"] is False
+    assert snap.sum("nebula_graph_query_total") == 42
+    assert snap.leader_counts() == {"b:2": 1}
+    assert snap.tenant_cost()["myspace"]["device_us"] == 777
+    # render must not raise with or without a previous snapshot
+    assert "nebtop" in nebtop.render(snap, None)
+    assert nebtop.snapshot_dict(snap)["query_total"] == 42

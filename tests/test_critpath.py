@@ -1,0 +1,122 @@
+"""Critical-path analyzer (ISSUE 12): self-time attribution, the
+dominant path, remote-fragment host attribution, and the degenerate
+trees /traces?critpath must survive (common/critpath.py)."""
+from nebula_tpu.common import critpath
+
+
+def _span(sid, parent, name, t0_us, dur_us, **tags):
+    return {"span_id": sid, "parent_id": parent, "name": name,
+            "t0_us": t0_us, "dur_us": dur_us, "tags": tags}
+
+
+def _trace(spans, trace_id="t1"):
+    return {"trace_id": trace_id, "spans": spans}
+
+
+def test_nested_tree_attribution_and_path():
+    # root(1000) -> exec(900) -> kernel(600), materialize(200)
+    spans = [
+        _span("r", "", "query", 0, 1000),
+        _span("e", "r", "exec.go", 50, 900),
+        _span("k", "e", "kernel", 100, 600),
+        _span("m", "e", "materialize", 700, 200),
+    ]
+    a = critpath.analyze(_trace(spans))
+    assert a["wall_us"] == 1000
+    by_name = {(row["name"]): row for row in a["attribution"]}
+    # kernel/materialize are leaves: full self time
+    assert by_name["kernel"]["self_us"] == 600
+    assert by_name["materialize"]["self_us"] == 200
+    # exec self = 900 - (600 + 200) covered
+    assert by_name["exec.go"]["self_us"] == 100
+    # dominant path descends by largest child duration
+    assert [p["name"] for p in a["critical_path"]] == \
+        ["query", "exec.go", "kernel"]
+    # explained excludes the ROOT's own self time (900/1000 here)
+    assert a["explained"] == 0.9
+
+
+def test_concurrent_children_not_double_subtracted():
+    # two children overlap in time: coverage merges their intervals
+    spans = [
+        _span("r", "", "query", 0, 1000),
+        _span("a", "r", "fan.a", 0, 600),
+        _span("b", "r", "fan.b", 300, 600),
+    ]
+    a = critpath.analyze(_trace(spans))
+    root_row = [x for x in a["attribution"] if x["name"] == "query"]
+    # merged coverage [0,900) -> root self = 100
+    assert root_row and root_row[0]["self_us"] == 100
+
+
+def test_remote_fragment_host_attribution():
+    # graphd root -> rpc.call -> (grafted) storage.get_bound ->
+    # proc.scan_part tagged host=B; host inherits downward
+    spans = [
+        _span("r", "", "query", 0, 1000),
+        _span("c", "r", "rpc.call", 0, 800, peer="B:45500"),
+        _span("f", "c", "storage.get_bound", 10, 700),
+        _span("p", "f", "proc.scan_part", 20, 650, host="B:45500"),
+    ]
+    a = critpath.analyze(_trace(spans))
+    rows = {(x["name"], x["host"]): x for x in a["attribution"]}
+    assert rows[("proc.scan_part", "B:45500")]["self_us"] == 650
+    # the fragment root inherits no host of its own; its child's tag
+    # does not leak UP
+    assert ("storage.get_bound", None) in rows
+    # dominant path reaches the remote processor with its host
+    path = a["critical_path"]
+    assert path[-1]["name"] == "proc.scan_part"
+    assert path[-1]["host"] == "B:45500"
+
+
+def test_degenerate_single_span():
+    a = critpath.analyze(_trace([_span("r", "", "query", 0, 500)]))
+    assert a["wall_us"] == 500
+    assert a["critical_path"][0]["name"] == "query"
+    # nothing but root self time -> nothing is EXPLAINED
+    assert a["explained"] == 0.0
+
+
+def test_empty_trace():
+    a = critpath.analyze(_trace([]))
+    assert a["wall_us"] == 0 and a["attribution"] == [] \
+        and a["critical_path"] == [] and a["explained"] == 0.0
+
+
+def test_missing_parent_becomes_extra_root():
+    # an orphaned subtree (graft raced the finish): still attributed
+    spans = [
+        _span("r", "", "query", 0, 1000),
+        _span("x", "GONE", "proc.get_bound", 0, 400, host="C:1"),
+    ]
+    a = critpath.analyze(_trace(spans))
+    rows = {(x["name"], x["host"]) for x in a["attribution"]}
+    assert ("proc.get_bound", "C:1") in rows
+    # root selection: the longest root wins
+    assert a["wall_us"] == 1000
+
+
+def test_cycle_guard_in_dominant_path():
+    # malformed self-parenting must not loop forever
+    spans = [_span("r", "r", "query", 0, 100)]
+    a = critpath.analyze(_trace(spans))
+    assert len(a["critical_path"]) <= 2
+
+
+def test_aggregate_over_traces():
+    t1 = _trace([
+        _span("r", "", "query", 0, 1000),
+        _span("k", "r", "kernel", 0, 900),
+    ], "t1")
+    t2 = _trace([
+        _span("r2", "", "query", 0, 1000),
+        _span("k2", "r2", "kernel", 0, 700),
+        _span("w2", "r2", "dispatcher.wait", 700, 300),
+    ], "t2")
+    agg = critpath.aggregate([t1, t2])
+    assert agg["sampled_traces"] == 2
+    assert agg["wall_us_total"] == 2000
+    top = agg["attribution"][0]
+    assert top["name"] == "kernel" and top["self_us"] == 1600
+    assert 0.0 < agg["explained"] <= 1.0

@@ -1,0 +1,226 @@
+"""Per-query resource ledger (ISSUE 12): accumulator semantics, the
+cross-host RPC piggyback merge, context propagation rules, and the
+off-path cost guard (common/ledger.py; rpc/transport.py v1.2
+envelope)."""
+import threading
+import time
+
+import pytest
+
+from nebula_tpu.common import ledger
+from nebula_tpu.common.flags import graph_flags
+from nebula_tpu.rpc import proxy, wire
+from nebula_tpu.rpc.transport import RpcServer
+
+
+# ---------------------------------------------------------------- unit
+
+def test_fields_and_charges():
+    led = ledger.Ledger()
+    assert all(getattr(led, f) == 0 for f in ledger.FIELDS)
+    led.charge(device_us=100, launches=1)
+    led.charge(device_us=50)
+    assert led.device_us == 150 and led.launches == 1
+    led.charge_host("hostA:1", rows_scanned=10, bytes_returned=99)
+    assert led.rows_scanned == 10
+    assert led.hosts["hostA:1"] == {"rows_scanned": 10,
+                                    "bytes_returned": 99}
+    d = led.to_dict()
+    assert d["device_us"] == 150 and d["rows_scanned"] == 10
+    assert d["hosts"]["hostA:1"]["rows_scanned"] == 10
+    # stable shape: every field present even when zero
+    for f in ledger.FIELDS:
+        assert f in d
+
+
+def test_wire_roundtrip_and_merge_across_hosts():
+    server_led = ledger.Ledger()
+    server_led.charge_host("hostB:2", rows_scanned=7, bytes_returned=70)
+    server_led.charge(wal_bytes=33)
+    # the fragment crosses the real wire codec (the v1.2 response
+    # element is wire-encoded with everything else)
+    w = wire.decode(wire.encode(server_led.to_wire()))
+    client_led = ledger.Ledger()
+    client_led.charge(rpc_calls=1)
+    client_led.merge_wire(w, host="peer:9")
+    assert client_led.rows_scanned == 7
+    assert client_led.wal_bytes == 33
+    # the nested per-host slice survives under its original name;
+    # only the UNATTRIBUTED remainder (wal_bytes here) lands under
+    # the peer's key — already-attributed rows must not double-count
+    assert client_led.hosts["hostB:2"]["rows_scanned"] == 7
+    assert client_led.hosts["peer:9"] == {"wal_bytes": 33}
+
+
+def test_merge_wire_malformed_fragment_is_dropped():
+    led = ledger.Ledger()
+    led.merge_wire(("garbage",), host="x")
+    led.merge_wire(None, host="x")
+    assert led.rows_scanned == 0 and not led.hosts
+
+
+def test_begin_end_and_ambient_charge():
+    assert ledger.current() is None
+    led, tok = ledger.begin()
+    try:
+        assert ledger.current() is led
+        ledger.charge(h2d_bytes=5)
+        assert led.h2d_bytes == 5
+    finally:
+        ledger.end(tok)
+    assert ledger.current() is None
+    ledger.charge(h2d_bytes=1)     # no ledger: silently dropped
+
+
+def test_use_repoints_and_detaches():
+    owner = ledger.Ledger()
+    led, tok = ledger.begin()
+    try:
+        with ledger.use(owner):
+            ledger.charge(device_us=9)
+        # a None ledger DETACHES (serving a ledger-less request must
+        # not charge the leader's own query)
+        with ledger.use(None):
+            ledger.charge(device_us=100)
+        assert owner.device_us == 9
+        assert led.device_us == 0
+    finally:
+        ledger.end(tok)
+
+
+def test_concurrent_charges_do_not_lose_increments():
+    led = ledger.Ledger()
+
+    def worker():
+        for _ in range(500):
+            led.charge(rpc_calls=1)
+            led.charge_host("h", rows_scanned=1)
+
+    ts = [threading.Thread(target=worker) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert led.rpc_calls == 2000
+    assert led.hosts["h"]["rows_scanned"] == 2000
+
+
+# ------------------------------------------------------- off-path guard
+
+def test_cost_ledger_flag_off_means_no_ledger():
+    graph_flags.set("cost_ledger_enabled", False)
+    try:
+        led, tok = ledger.begin()
+        assert led is None and tok is None
+        ledger.end(tok)               # no-op, no raise
+        assert ledger.current() is None
+    finally:
+        graph_flags.set("cost_ledger_enabled", True)
+
+
+def test_off_path_charge_is_cheap():
+    """The off-path contract: a charge with no active ledger is one
+    ContextVar read. Generous bound (20x a bare function call) so CI
+    jitter can't flake it — the point is catching an accidental
+    allocation or lock on the no-ledger path."""
+    def bare():
+        pass
+
+    n = 20000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        bare()
+    base = time.perf_counter() - t0
+    assert ledger.current() is None
+    t0 = time.perf_counter()
+    for _ in range(n):
+        ledger.charge(device_us=1)
+    off = time.perf_counter() - t0
+    assert off < max(base, 1e-4) * 20
+
+
+# ------------------------------------------------- RPC piggyback (v1.2)
+
+class _CostedService:
+    def scan(self, n):
+        ledger.charge_host("server-host:7", rows_scanned=n,
+                           bytes_returned=n * 10)
+        return n * 2
+
+    def plain(self, x):
+        return x + 1
+
+
+@pytest.fixture()
+def costed_server():
+    srv = RpcServer().register("svc", _CostedService())
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_rpc_carries_cost_flag_and_merges_fragment(costed_server):
+    client = proxy(costed_server.addr, "svc")
+    led, tok = ledger.begin()
+    try:
+        assert client.scan(5) == 10
+    finally:
+        ledger.end(tok)
+    assert led.rpc_calls == 1
+    assert led.rpc_bytes_out > 0 and led.rpc_bytes_in > 0
+    assert led.rows_scanned == 5 and led.bytes_returned == 50
+    # per-host attribution: the server's explicit host slice survives
+    # EXACTLY ONCE (no re-label under the dialed address — the server
+    # already attributed these rows)
+    assert led.hosts["server-host:7"]["rows_scanned"] == 5
+    assert led.to_dict()["hosts"]["server-host:7"]["rows_scanned"] == 5
+    assert sum(d.get("rows_scanned", 0)
+               for d in led.hosts.values()) == 5
+
+
+def test_rpc_without_ledger_stays_v1_envelope(costed_server):
+    """No ledger, no trace -> the request is the byte-identical v1.0
+    4-tuple and the response a 2-tuple (the off-path guard's wire
+    half)."""
+    assert ledger.current() is None
+    payload = wire.encode(("svc", "plain", (1,), {}))
+    import socket
+    from nebula_tpu.rpc.transport import _recv_frame, _send_frame
+    sock = socket.create_connection(
+        (costed_server.host, costed_server.port), timeout=5)
+    try:
+        _send_frame(sock, payload)
+        resp = wire.decode(_recv_frame(sock))
+    finally:
+        sock.close()
+    assert resp == (True, 2)      # exactly 2 elements: v1.0 shape
+
+
+def test_rpc_cost_flag_without_trace(costed_server):
+    """Sampling off + ledger on: the envelope carries (None, 1) and
+    the response 4-tuple still merges — cost attribution must not
+    depend on the trace sampling decision."""
+    from nebula_tpu.common.tracing import tracer
+    assert tracer.current_ctx() is None
+    client = proxy(costed_server.addr, "svc")
+    led, tok = ledger.begin()
+    try:
+        client.scan(3)
+    finally:
+        ledger.end(tok)
+    assert led.rows_scanned == 3
+
+
+# ------------------------------------------------- cache rung charging
+
+def test_cache_rung_charges_ledger():
+    from nebula_tpu.common.cache import CacheRung
+    rung = CacheRung("test.ledger_rung", 4)
+    led, tok = ledger.begin()
+    try:
+        assert rung.get("k") is None
+        rung.put("k", 1)
+        assert rung.get("k") == 1
+    finally:
+        ledger.end(tok)
+    assert led.cache_misses == 1 and led.cache_hits == 1

@@ -625,3 +625,127 @@ def test_observability_endpoints_3daemon():
         assert "nebula_storage_scan_part_qps_total" in stext
     finally:
         graphd.stop(); storaged.stop(); metad.stop()
+
+
+def test_cost_ledger_and_cluster_metrics_3daemon():
+    """Acceptance (ISSUE 12): PROFILE over the real graphd→storaged
+    boundary returns a `cost` block (per-host rows_scanned, rpc
+    bytes) next to the span tree with byte-identical rows; the
+    critical-path analyzer serves at /traces?critpath=<id>; slow
+    queries carry their ledger on BOTH daemons; and graphd's
+    /cluster_metrics federates all three roles into one strict
+    OpenMetrics document."""
+    import json as _json
+    import time as _time
+    import urllib.request
+    from nebula_tpu.client import GraphClient
+    from nebula_tpu.common.flags import graph_flags
+    from nebula_tpu.daemons import serve_graphd, serve_metad, serve_storaged
+    from nebula_tpu.engine_tpu import TpuGraphEngine
+    import openmetrics
+
+    metad = serve_metad(ws_port=0)
+    storaged = serve_storaged(metad.addr, load_interval=0.1, ws_port=0)
+    tpu = TpuGraphEngine()
+    graphd = serve_graphd(metad.addr, tpu_engine=tpu, ws_port=0)
+
+    def http(port, path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}") as r:
+            ctype = r.headers.get("Content-Type", "")
+            body = r.read()
+            return (body if "json" not in ctype
+                    else _json.loads(body)), r.status
+
+    try:
+        gc = GraphClient(graphd.addr).connect()
+        for s in ("CREATE SPACE costspace(partition_num=2)",
+                  "USE costspace",
+                  "CREATE TAG t(x int)", "CREATE EDGE e(w int)",
+                  "INSERT VERTEX t(x) VALUES 1:(5), 2:(6), 3:(7)",
+                  "INSERT EDGE e(w) VALUES 1 -> 2:(3), 2 -> 3:(4)"):
+            r = gc.execute(s)
+            assert r.ok(), (s, r.error_msg)
+        q = "GO 2 STEPS FROM 1 OVER e YIELD e.w AS w"
+        gc.execute(q)                 # snapshot warm
+        r = gc.execute("INSERT EDGE e(w) VALUES 3 -> 1:(9)")
+        assert r.ok(), r.error_msg
+        prof = None
+        for _ in range(40):
+            _time.sleep(0.05)
+            prof = gc.execute("PROFILE " + q)
+            assert prof.ok(), prof.error_msg
+            cost = (prof.profile or {}).get("cost", {})
+            # the INSERT forces the traced query to pull the change
+            # feed over the storage RPC: server-side charges appear
+            if cost.get("rows_scanned", 0) > 0:
+                break
+            r = gc.execute("INSERT EDGE e(w) VALUES 3 -> 2:(8)")
+            assert r.ok(), r.error_msg
+        plain = gc.execute(q)
+        assert plain.ok()
+        assert sorted(plain.rows) == sorted(prof.rows)
+        cost = prof.profile["cost"]
+        # the ledger crossed the RPC boundary: round trips + payload
+        # bytes + server-side rows, attributed to the storaged host
+        assert cost["rpc_calls"] > 0
+        assert cost["rpc_bytes_out"] > 0 and cost["rpc_bytes_in"] > 0
+        assert cost["rows_scanned"] > 0
+        assert cost["hosts"], cost
+        assert any(h.get("rows_scanned", 0) > 0
+                   for h in cost["hosts"].values()), cost
+        # queue wait is charged by the dispatcher for every GO
+        assert cost["queue_wait_us"] > 0
+        # critical-path attribution over the same trace
+        body, st = http(graphd.ws_port,
+                        f"/traces?critpath={prof.trace_id}")
+        assert st == 200
+        assert body["wall_us"] > 0 and body["critical_path"]
+        assert 0.0 <= body["explained"] <= 1.0
+        assert any(row["name"] == "query"
+                   for row in body["critical_path"])
+        # slow-query ledgers on both daemons: drop the threshold so
+        # everything qualifies, then drive one more traced pull
+        # (per-registry: graphd reads graph_flags, storaged its own
+        # storage_flags twin)
+        from nebula_tpu.common.flags import storage_flags
+        graph_flags.set("slow_query_threshold_ms", 0.0001)
+        storage_flags.set("slow_query_threshold_ms", 0.0001)
+        try:
+            r = gc.execute("INSERT EDGE e(w) VALUES 2 -> 1:(7)")
+            assert r.ok()
+            slow_st = None
+            for _ in range(40):
+                _time.sleep(0.05)
+                gc.execute(q)
+                slow_st = http(storaged.ws_port, "/queries")[0]["slow"]
+                if slow_st:
+                    break
+            assert slow_st and "cost" in slow_st[0], slow_st
+            slow_g = http(graphd.ws_port, "/queries")[0]["slow"]
+            assert slow_g and "cost" in slow_g[0], slow_g
+        finally:
+            graph_flags.set("slow_query_threshold_ms", 500)
+            storage_flags.set("slow_query_threshold_ms", 500)
+        # /cluster_metrics: all three roles federated, strict-parsed
+        doc = http(graphd.ws_port, "/cluster_metrics")[0].decode()
+        fams = openmetrics.parse(doc)
+        scrape = fams["nebula_cluster_scrape"]
+        roles = {s.labels["role"]: s.value for s in scrape.samples}
+        assert set(roles) == {"graph", "storage", "meta"}, roles
+        assert all(v == 1 for v in roles.values()), roles
+        # per-instance families carry the instance label end-to-end
+        bi = fams["nebula_build_info"]
+        assert {s.labels.get("role") for s in bi.samples} >= \
+            {"graph", "storage", "meta"}
+        # the cost rollups scrape as native histogram families
+        assert any(name.startswith("nebula_graph_cost_")
+                   for name in fams), sorted(fams)[:20]
+        # nebtop consumes the same document (--once, machine form)
+        from nebula_tpu.tools import nebtop
+        snap = nebtop.Snapshot(nebtop.parse_samples(doc), t=0.0)
+        insts = snap.instances()
+        assert len(insts) == 3 and all(i["up"] for i in insts)
+        assert snap.sum("nebula_graph_query_total") > 0
+    finally:
+        graphd.stop(); storaged.stop(); metad.stop()
