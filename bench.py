@@ -535,36 +535,85 @@ def bench_concurrent(cluster, tpu, seed_sets, seconds=6.0, sessions=8):
                                     "group_wait_count",
                                     "fused_launches")}
     pf0 = tpu.prefetch_stats()
-    stop = threading.Event()
-    counts = [0] * sessions
     errs = []
 
-    def worker(k):
-        q = tier3_q(k)
-        while not stop.is_set():
-            try:
-                conns[k].must(q)
-                counts[k] += 1
-            except Exception as ex:   # noqa: BLE001 — recorded, fails run
-                errs.append(repr(ex))
-                return
+    def measure(secs):
+        """One closed-loop measured window over all sessions."""
+        stop = threading.Event()
+        counts = [0] * sessions
 
-    threads = [threading.Thread(target=worker, args=(k,))
-               for k in range(sessions)]
-    t0 = time.time()
-    for t in threads:
-        t.start()
-    time.sleep(seconds)
-    stop.set()
-    for t in threads:
-        # a round in flight at stop must complete; one full-scale
-        # dense round on the CPU fallback can take minutes
-        t.join(timeout=300)
-    wall = time.time() - t0
-    assert not [t for t in threads if t.is_alive()], \
-        "tier3 stragglers would skew the CPU baselines"
-    assert not errs, errs[:2]
-    total = sum(counts)
+        def worker(k):
+            q = tier3_q(k)
+            while not stop.is_set():
+                try:
+                    conns[k].must(q)
+                    counts[k] += 1
+                except Exception as ex:   # noqa: BLE001 — recorded,
+                    errs.append(repr(ex))  # fails the run
+                    return
+
+        threads = [threading.Thread(target=worker, args=(k,),
+                                    name=f"bench-t3-{k}")
+                   for k in range(sessions)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        time.sleep(secs)
+        stop.set()
+        for t in threads:
+            # a round in flight at stop must complete; one full-scale
+            # dense round on the CPU fallback can take minutes
+            t.join(timeout=300)
+        w = time.time() - t0
+        assert not [t for t in threads if t.is_alive()], \
+            "tier3 stragglers would skew the CPU baselines"
+        assert not errs, errs[:2]
+        return sum(counts), w
+
+    # OVERHEAD PROOF (ISSUE 13 acceptance): the same measured loop
+    # runs twice on the same warm engine — sampler OFF (profile_hz=0,
+    # no sampler thread) then ON at the default 19 Hz — and the
+    # artifact records both QPS numbers plus the sampler's own
+    # measured self-time. The hz=19 window also supplies the tier's
+    # `profile` block (top self-time frames + top contended locks
+    # during the measured loop).
+    from nebula_tpu.common import profiler as prof_mod
+    prof_mod.ensure_started()
+    prof_mod.profiler.set_hz(0)
+    total0, wall0 = measure(seconds)
+    qps_hz0 = total0 / wall0
+    prof_mod.profiler.reset()
+    prof_mod.profiler.set_hz(19.0)
+    lock0 = {s["name"]: s["wait_us_total"]
+             for s in prof_mod.lock_table(50)}
+    total, wall = measure(seconds)
+    qps_hz19 = total / wall
+    # sampler state sampled BEFORE disarming: the artifact must show
+    # the hz the profiled window actually ran at, not the cleared 0
+    sampler_state = prof_mod.profiler.state()
+    prof_mod.profiler.set_hz(0)
+    prof_top = prof_mod.profiler.top(window=600, n=20)
+    top_share = round(sum(f["share"] for f in prof_top["frames"]), 4)
+    locks_delta = sorted(
+        ({"name": s["name"], "contended": s["contended"],
+          "wait_us": s["wait_us_total"] - lock0.get(s["name"], 0),
+          "last_holder": s["last_holder"]}
+         for s in prof_mod.lock_table(50)),
+        key=lambda r: -r["wait_us"])[:8]
+    profile_block = {
+        "sampler": sampler_state,
+        "qps_hz0": round(qps_hz0, 1),
+        "qps_hz19": round(qps_hz19, 1),
+        # < 1.0 means the profiled window was slower; the acceptance
+        # bound is |1 - ratio| <= 0.03 on a full-scale run
+        "qps_ratio": round(qps_hz19 / max(qps_hz0, 1e-9), 4),
+        "top_frames": prof_top["frames"][:10],
+        # top-N self-time coverage of the sampled wall time
+        "top_share": top_share,
+        "top_locks": locks_delta,
+        "gc": prof_mod.gc_profiler.table(),
+        "compiles": prof_mod.compiles.totals(),
+    }
     d = {k: tpu.stats[k] - b0[k] for k in b0}
 
     # span-level breakdown under COALESCED load — a short forced-sample
@@ -581,8 +630,12 @@ def bench_concurrent(cluster, tpu, seed_sets, seconds=6.0, sessions=8):
     spans3 = span_breakdown_run(
         lambda: [barrage() for _ in range(3)], sessions * 3)
     log(f"tier3 span breakdown (us): {spans3}")
-    out = {"sessions": sessions, "qps": round(total / wall, 1),
-           "queries": total,
+    out = {"sessions": sessions,
+           # headline QPS is the UNPROFILED window (the clean number);
+           # the profile block records the hz=19 twin + ratio
+           "qps": round(qps_hz0, 1),
+           "queries": total0 + total,
+           "profile": profile_block,
            "span_breakdown": spans3,
            "batched_queries": d["batched_queries"],
            "batched_dispatches": d["batched_dispatches"],
@@ -609,11 +662,15 @@ def bench_concurrent(cluster, tpu, seed_sets, seconds=6.0, sessions=8):
            "h2d_overlap_us": pf1["h2d_overlap_us"]
            - pf0["h2d_overlap_us"],
            "robustness": tpu.robustness_stats(),
-           # histogram bucket vectors + flight trigger counts
-           **_obs_block()}
-    log(f"tier3 concurrent ({sessions} sessions, {wall:.1f}s): "
-        f"{out['qps']} QPS aggregate, {d['batched_queries']} queries "
-        f"over {d['batched_dispatches']} shared dispatches "
+           # histogram bucket vectors + flight trigger counts (the
+           # tier builds its own richer `profile` block above)
+           **_obs_block(profile=False)}
+    log(f"tier3 concurrent ({sessions} sessions, "
+        f"{wall0 + wall:.1f}s): {out['qps']} QPS aggregate "
+        f"(profiled twin {profile_block['qps_hz19']}, ratio "
+        f"{profile_block['qps_ratio']}, top-frame share "
+        f"{profile_block['top_share']}), {d['batched_queries']} "
+        f"queries over {d['batched_dispatches']} shared dispatches "
         f"({d['batched_lane_rounds']} lane rounds, "
         f"{out['groups_per_round']} group keys visible/election, "
         f"{out['early_releases']} early releases, "
@@ -621,11 +678,14 @@ def bench_concurrent(cluster, tpu, seed_sets, seconds=6.0, sessions=8):
     return out
 
 
-def _obs_block():
-    """Observability block for the bench JSON artifacts (ISSUE 10):
-    native-histogram snapshots — the full bucket vectors plus the
-    exemplar trace ids, not just p50/p95 — and the flight recorder's
-    event/trigger/bundle state at sample time."""
+def _obs_block(profile=True):
+    """Observability block for the bench JSON artifacts (ISSUE 10 +
+    13): native-histogram snapshots — the full bucket vectors plus the
+    exemplar trace ids, not just p50/p95 — the flight recorder's
+    event/trigger/bundle state at sample time, and (unless the tier
+    builds a richer one itself) a compact continuous-profiling block:
+    top self-time frames + top contended locks + GC/compile tables."""
+    from nebula_tpu.common import profiler as _prof
     from nebula_tpu.common.flight import recorder as _rec
     from nebula_tpu.common.stats import stats as _st
     hists = {}
@@ -642,7 +702,7 @@ def _obs_block():
                 {e["trace_id"] for e in h["exemplars"].values()}),
         }
     d = _rec.describe(limit=1)
-    return {
+    out = {
         "histograms": hists,
         "flight": {
             "event_count": d["event_count"],
@@ -650,6 +710,18 @@ def _obs_block():
             "bundles": d["bundles"],
         },
     }
+    if profile:
+        top = _prof.profiler.top(window=600, n=10)
+        out["profile"] = {
+            "sampler": _prof.profiler.state(),
+            "top_frames": top["frames"],
+            "top_share": round(sum(f["share"]
+                                   for f in top["frames"]), 4),
+            "top_locks": _prof.lock_table(8),
+            "gc": _prof.gc_profiler.table(),
+            "compiles": _prof.compiles.totals(),
+        }
+    return out
 
 
 def _cache_rung_stats(cluster, tpu):
@@ -1131,6 +1203,15 @@ def bench_chaos(out_path: str, trim: bool = False):
     graph_flags.set("flight_dir", tempfile.mkdtemp(
         prefix="nebula_tpu_flight_"))
     graph_flags.set("flight_arm_samples", 200)
+    # continuous-profiling observatory armed for the run (ISSUE 13
+    # acceptance): every auto-captured bundle must embed a populated
+    # profile capture whose trace-tagged samples correlate with an
+    # exemplar trace id — the chaos harness runs headless (no
+    # webservice), so it arms the sampler the way a daemon boot would
+    from nebula_tpu.common import profiler as prof_mod
+    prof_mod.ensure_started()
+    prof_mod.profiler.reset()
+    prof_mod.profiler.set_hz(19.0)
     tpu = TpuGraphEngine()
     # tight ladder so the run observes the full trip -> half-open ->
     # recover cycle in seconds (production defaults are 3 / 0.5s / 30s)
@@ -1332,6 +1413,21 @@ def bench_chaos(out_path: str, trim: bool = False):
         flight_rec.bundles
         and all(len(b["events"]) > 0 for b in flight_rec.bundles)
         and (bundle_tids & exemplar_tids))
+    # ---- continuous-profiling acceptance (ISSUE 13): the bundles'
+    # embedded profile captures are populated (sampled frames) and
+    # their trace-TAGGED samples correlate with >= 1 exemplar trace id
+    profile_tids = set()
+    profile_samples = 0
+    for b in flight_rec.bundles:
+        pb = (b.get("collectors") or {}).get("profile")
+        if not isinstance(pb, dict) or "top" not in pb:
+            continue
+        profile_samples = max(profile_samples,
+                              pb["top"].get("samples", 0))
+        profile_tids.update(s["trace_id"]
+                            for s in pb.get("tagged_samples", ()))
+    profile_ok = bool(profile_samples > 0
+                      and (profile_tids & exemplar_tids))
     flight_summary = flight_rec.describe(limit=8)
     graph_flags.set("flight_dir", "")
     graph_flags.set("flight_arm_samples", 25)
@@ -1374,16 +1470,27 @@ def bench_chaos(out_path: str, trim: bool = False):
         "flight_correlated_trace_ids": sorted(
             bundle_tids & exemplar_tids)[:8],
         "flight_ok": flight_ok,
+        # the bundles' embedded profile captures (ISSUE 13): sampled
+        # frames present + tagged samples correlating with exemplars
+        "profile_bundle": {
+            "ok": profile_ok,
+            "samples": profile_samples,
+            "correlated_trace_ids": sorted(
+                profile_tids & exemplar_tids)[:8],
+        },
         "slo": {"plan_objective": slo_name, **slo_rec},
         **_obs_block(),
     }
+    # disarm AFTER the artifact's profile block sampled the live
+    # sampler state (it must record the hz the run actually ran at)
+    prof_mod.profiler.set_hz(0)
     with open(out_path, "w") as f:
         json.dump(rec, f, indent=1)
     ok = (not errs and not mismatches and trips > 0 and recovered
           and sum(fired.values()) > 0
           and rb["breaker_recoveries"] > 0
           and rec["lock_witness"]["clean"]
-          and flight_ok
+          and flight_ok and profile_ok
           and slo_rec["breached"] and slo_rec["recovered_under"])
     log(f"chaos tier: {sessions} sessions x {per_session} queries under "
         f"{plan!r}: {sum(fired.values())} faults injected, "
@@ -1391,8 +1498,10 @@ def bench_chaos(out_path: str, trim: bool = False):
         f"serves, errors={len(errs)}, mismatches={len(mismatches)}, "
         f"recovered={recovered}, flight bundles="
         f"{len(flight_summary['bundles'])} (correlated="
-        f"{len(bundle_tids & exemplar_tids)}), slo burn peak="
-        f"{slo_rec['burn_peak']} -> back under="
+        f"{len(bundle_tids & exemplar_tids)}), profile capture "
+        f"ok={profile_ok} ({profile_samples} samples, "
+        f"{len(profile_tids & exemplar_tids)} correlated), slo burn "
+        f"peak={slo_rec['burn_peak']} -> back under="
         f"{slo_rec['recovered_under']} -> {out_path}")
     print(json.dumps({"metric": "chaos", "ok": ok, **{
         k: rec[k] for k in ("faults_injected", "breaker_trips",

@@ -45,6 +45,7 @@ import threading
 from typing import Any, Dict, Optional, Tuple
 
 from .flags import MUTABLE, graph_flags
+from . import profiler as _profiler
 
 # Accounting fields, in WIRE ORDER — append-only (the piggybacked RPC
 # fragment is a positional int tuple; reordering breaks mixed-version
@@ -197,16 +198,31 @@ def charge_host(host: str, **fields: int) -> None:
 def begin() -> Tuple[Optional[Ledger], Optional[object]]:
     """Attach a fresh ledger to the calling context (the graph-service
     query head). Returns (ledger, token) — (None, None) when the
-    cost_ledger_enabled flag is off."""
+    cost_ledger_enabled flag is off. The token also carries the
+    profiler's per-thread verb mirror, cleared here and restored at
+    end() (set_verb below fills it once the statement kind is
+    known)."""
     if not graph_flags.get("cost_ledger_enabled", True):
         return None, None
     led = Ledger()
-    return led, _current.set(led)
+    return led, (_current.set(led), _profiler.note_verb(None))
+
+
+def set_verb(led: Ledger, verb: str) -> None:
+    """Record the statement kind on the ledger AND mirror it as the
+    calling thread's live verb, so a stack sample of this thread is
+    tagged with what query shape it was serving
+    (common/profiler.py)."""
+    led.verb = verb
+    tid = threading.get_ident()
+    _profiler._thread_verb[tid] = verb
 
 
 def end(token) -> None:
     if token is not None:
-        _current.reset(token)
+        cv_tok, verb_tok = token
+        _current.reset(cv_tok)
+        _profiler.restore_verb(verb_tok)
 
 
 class _UseCtx:
@@ -215,20 +231,26 @@ class _UseCtx:
     ledger DETACHES — charges recorded while serving a ledger-less
     request must not land on the leader's own query."""
 
-    __slots__ = ("_led", "_token")
+    __slots__ = ("_led", "_token", "_vtok")
 
     def __init__(self, led: Optional[Ledger]):
         self._led = led
         self._token = None
+        self._vtok = None
 
     def __enter__(self):
         self._token = _current.set(self._led)
+        self._vtok = _profiler.note_verb(
+            self._led.verb if self._led is not None else None)
         return self
 
     def __exit__(self, *exc):
         if self._token is not None:
             _current.reset(self._token)
             self._token = None
+        if self._vtok is not None:
+            _profiler.restore_verb(self._vtok)
+            self._vtok = None
         return False
 
 

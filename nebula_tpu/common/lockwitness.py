@@ -49,6 +49,13 @@ _REAL_RLOCK = threading.RLock
 _REAL_SLEEP = time.sleep
 _THREADING_FILE = getattr(threading, "__file__", "<threading>")
 _SELF_FILE = __file__
+# the contention profiler (common/profiler.py) constructs the real
+# lock INSIDE profiled_lock()/profiled_rlock(); without this skip
+# every profiled lock — engine snapshot lock, dispatcher cv, part
+# locks — would collapse into ONE witness node at that factory line,
+# masking real ABBA orderings between them
+_PROFILER_FILE = os.path.join(os.path.dirname(__file__), "profiler.py")
+_INFRA_FILES = (_SELF_FILE, _THREADING_FILE, _PROFILER_FILE)
 
 
 class LockOrderViolation(AssertionError):
@@ -56,12 +63,12 @@ class LockOrderViolation(AssertionError):
 
 
 def _caller_site() -> str:
-    """file:line of the nearest frame outside this module and
+    """file:line of the nearest frame outside this module,
     threading.py (Condition(None) constructs its RLock from inside
-    threading.py — the witness attributes it to the real caller)."""
+    threading.py — the witness attributes it to the real caller) and
+    the contention-profiler factories."""
     f = sys._getframe(2)
-    while f is not None and f.f_code.co_filename in (_SELF_FILE,
-                                                     _THREADING_FILE):
+    while f is not None and f.f_code.co_filename in _INFRA_FILES:
         f = f.f_back
     if f is None:
         return "<unknown>"

@@ -1,0 +1,1043 @@
+"""Continuous profiling observatory: always-on stack sampling, lock
+contention and runtime-health (GC / XLA compile / device memory)
+profiling, joined to traces and flight bundles
+(docs/manual/10-observability.md, "Continuous profiling").
+
+The stack can say *what* happened (traces), *that* it breached
+(flight/SLO) and *what a query cost* (ledger/critpath) — this module
+says what the PROCESS was doing meanwhile: which thread roles burned
+or waited the wall time, on which frames, behind which locks, and how
+much of it was the runtime's own overhead (GC pauses, XLA compiles).
+Three instruments, all daemon-resident and negligible-overhead:
+
+1. SAMPLING PROFILER (`SamplingProfiler`): a sampler thread walks
+   `sys._current_frames()` at the MUTABLE `profile_hz` flag (default
+   ~19 Hz — deliberately co-prime with 1 kHz timer ticks; 0 = off,
+   and off means NO sampler thread and zero metric families). Each
+   tick folds every other thread's stack into a collapsed-stack key
+   aggregated per thread ROLE (the thread's `name=` with digit runs
+   normalized — the thread-naming hygiene rule NL008 exists so this
+   attribution works), into 60 s / 600 s rotating windows plus
+   lifetime totals. Samples are tagged with the sampled thread's live
+   trace/ledger context (a per-thread mirror maintained by
+   common/tracing.py + common/ledger.py at the points they re-point
+   their ContextVars — zero cost for unsampled queries), so a profile
+   answers "this query's dispatcher_wait was spent under
+   `_serve_group` waiting on the round cv" and flight bundles can
+   correlate hot frames with exemplar trace ids. Served at
+   `/profile` on every daemon (webservice built-in): JSON top-N
+   self-time, `?format=collapsed` (flamegraph.pl / inferno input —
+   scripts/flame.sh), `?seconds=N` on-demand high-rate capture,
+   `?thread=<role>` filter.
+
+2. LOCK-CONTENTION PROFILER (`profiled_lock`/`profiled_rlock`): the
+   hot serve-path locks (engine snapshot lock, dispatcher cv, raft/kv
+   part locks) are constructed through a thin always-on wrapper that
+   sits UNDER the lockwitness layer (it wraps whatever
+   `threading.Lock()` returns, so a witness-armed run still sees
+   every acquisition). The uncontended path is one extra try-acquire
+   + a holder stamp; only CONTENDED acquires pay for accounting:
+   per-site acquire-wait histograms (`lock.wait_us.<site>` — native
+   OpenMetrics histograms with trace exemplars, scraping as
+   `nebula_lock_wait_us_<site>`), last-holder attribution (which
+   thread role made me wait), and the `/profile?locks=1`
+   top-contended table.
+
+3. RUNTIME-HEALTH PROFILE: GC pause tracking via `gc.callbacks`
+   (`graph.gc.pause_us` histogram + a `gc_pause` flight event past
+   the `gc_pause_flight_ms` flag), XLA compile accounting wrapped
+   around the fused-program registry (`tpu_engine.compile_us`
+   histogram + the per-signature table at `/profile?compiles=1`),
+   and the per-snapshot device-memory ledger
+   (TpuGraphEngine.device_mem_stats, gauges next to the bench's
+   tier1_hbm_model estimate).
+
+Overhead contract (tests/test_profiler.py): the sampler measures its
+own per-tick cost (`self_us`); a 19 Hz burst run must keep that under
+`SAMPLER_OVERHEAD_BUDGET` of wall time, and `profile_hz=0` must leave
+zero sampler thread and a byte-identical /metrics exposition.
+"""
+from __future__ import annotations
+
+import gc as _gc
+import re
+import sys
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .flags import MUTABLE, graph_flags, meta_flags, storage_flags
+from .stats import stats as global_stats
+
+_REGISTRIES = (graph_flags, storage_flags, meta_flags)
+
+# declared on EVERY registry: all three daemons serve /profile and
+# each daemon's /flags serves only its own registry (the flight-flag
+# precedent, common/flight.py)
+for _reg in _REGISTRIES:
+    _reg.declare(
+        "profile_hz", 19.0, MUTABLE,
+        "always-on sampling-profiler rate in Hz (sys._current_frames "
+        "walks aggregated per thread role, served at /profile). "
+        "Default ~19 Hz is co-prime with common timer frequencies; "
+        "0 disables the sampler entirely (no thread, no metrics)")
+    _reg.declare(
+        "profile_capture_hz", 97.0, MUTABLE,
+        "sampling rate of on-demand /profile?seconds=N captures "
+        "(bounded high-rate bursts; the always-on rate stays "
+        "profile_hz)")
+    _reg.declare(
+        "gc_pause_flight_ms", 50.0, MUTABLE,
+        "GC stop-the-world pauses longer than this become gc_pause "
+        "flight-recorder events (every pause feeds the graph.gc."
+        "pause_us histogram regardless; 0 records every pause as an "
+        "event)")
+
+
+def _flag(name: str, default):
+    """First non-default value across the three registries (the
+    common/flight.py idiom: one process may host all three daemons)."""
+    for reg in _REGISTRIES:
+        v = reg.get(name, default)
+        if v is not None and v != default:
+            return v
+    return default
+
+
+# ---------------------------------------------------------------------------
+# per-thread trace/ledger context mirror
+# ---------------------------------------------------------------------------
+# ContextVars cannot be read across threads, but the sampler must tag
+# a sample with the SAMPLED thread's live query context. tracing.py
+# and ledger.py mirror their ContextVar re-points into these plain
+# dicts (GIL-atomic store/delete per entry, keyed by thread ident).
+# Only SAMPLED traces and attached ledgers ever write here — the
+# unsampled hot path never touches the mirror.
+
+_thread_trace: Dict[int, str] = {}
+_thread_verb: Dict[int, str] = {}
+
+
+def note_trace(trace_id: Optional[str]) -> Tuple[int, Optional[str]]:
+    """Mirror `trace_id` as the calling thread's live trace (None
+    detaches). Returns an opaque token for restore_trace."""
+    tid = threading.get_ident()
+    prev = _thread_trace.get(tid)
+    if trace_id:
+        _thread_trace[tid] = trace_id
+    else:
+        _thread_trace.pop(tid, None)
+    return (tid, prev)
+
+
+def restore_trace(token: Tuple[int, Optional[str]]) -> None:
+    tid, prev = token
+    if prev:
+        _thread_trace[tid] = prev
+    else:
+        _thread_trace.pop(tid, None)
+
+
+def note_verb(verb: Optional[str]) -> Tuple[int, Optional[str]]:
+    """Mirror the ledger's statement verb (the sample's 'what query
+    shape was this thread serving' tag)."""
+    tid = threading.get_ident()
+    prev = _thread_verb.get(tid)
+    if verb:
+        _thread_verb[tid] = verb
+    else:
+        _thread_verb.pop(tid, None)
+    return (tid, prev)
+
+
+def restore_verb(token: Tuple[int, Optional[str]]) -> None:
+    tid, prev = token
+    if prev:
+        _thread_verb[tid] = prev
+    else:
+        _thread_verb.pop(tid, None)
+
+
+# ---------------------------------------------------------------------------
+# sampling profiler
+# ---------------------------------------------------------------------------
+
+# sampler self-time budget as a fraction of wall time — the declared
+# bound the tier-1 overhead guard asserts at 19 Hz under a query burst
+SAMPLER_OVERHEAD_BUDGET = 0.05
+
+_DIGITS = re.compile(r"\d+")
+# CPython names unnamed threads "Thread-N (target_name)": the target
+# is the only role information there is (stdlib spawns — http.server
+# request handlers — can't be renamed by NL008)
+_ANON = re.compile(r"^Thread-\d+ \((.+)\)$")
+
+
+def thread_role(name: str) -> str:
+    """Thread name -> stable ROLE: digit runs collapse to 'N' so
+    per-instance names (raft-repl-1-3-127.0.0.1:5001) aggregate into
+    one role (raft-repl-N-N-N.N.N.N:N); anonymous stdlib spawns fall
+    back to their target-function hint."""
+    if not name:
+        return "unnamed"
+    m = _ANON.match(name)
+    if m:
+        name = m.group(1)
+    return _DIGITS.sub("N", name)
+
+
+class SamplingProfiler:
+    """Always-on wall-clock stack sampler (instrument 1 above).
+
+    Aggregation: (role, collapsed-stack) -> [seconds, samples,
+    last_trace_id, last_verb] in BUCKET_S-second epoch buckets kept
+    for the largest window, plus a lifetime dict; `seconds` weights
+    each sample by the live sampling period, so a mid-run hz change
+    never skews the wall-time shares. A bounded ring of trace-tagged
+    samples feeds the flight-bundle profile capture (the trace-id
+    correlation bench --chaos asserts)."""
+
+    BUCKET_S = 10
+    WINDOWS = (60, 600)
+    MAX_STACK_DEPTH = 48
+    MAX_KEYS = 20000          # lifetime fold-to-<other> cardinality cap
+    TAGGED_RING = 512
+
+    def __init__(self, clock=time.time, stats=global_stats):
+        self._clock = clock
+        self._stats = stats
+        self._mu = threading.Lock()
+        # deque[(bucket_epoch, {key: [secs, n, trace_id, verb]})]
+        self._buckets: "deque[Tuple[int, Dict]]" = deque()
+        self._life: Dict[Tuple[str, str], List] = {}
+        self._tagged: "deque[Dict[str, Any]]" = deque(
+            maxlen=self.TAGGED_RING)
+        self._hz = 0.0
+        self._enabled = False
+        self._thread: Optional[threading.Thread] = None
+        self._wake = threading.Event()
+        self._role_cache: Dict[str, str] = {}
+        self._code_names: Dict[Any, str] = {}
+        self.ticks = 0
+        self.samples = 0          # thread-stacks recorded
+        self.self_us = 0          # the sampler's OWN per-tick cost
+        self._t_started: Optional[float] = None
+
+    # ------------------------------------------------------- lifecycle
+    def ensure(self, hz: Optional[float] = None) -> None:
+        """Arm the sampler at `hz` (default: the profile_hz flag).
+        Idempotent; hz <= 0 means NO sampler thread is ever created
+        (the zero-cost fast path the tier-1 test proves)."""
+        if hz is None:
+            hz = float(_flag("profile_hz", 19.0) or 0.0)
+        self._enabled = True
+        self.set_hz(hz)
+
+    def set_hz(self, hz: float) -> None:
+        try:
+            hz = max(0.0, float(hz))
+        except (TypeError, ValueError):
+            return
+        self._hz = hz
+        if hz > 0 and self._thread is None:
+            if self._t_started is None:
+                self._t_started = time.monotonic()
+            # nlint: disable=NL002 -- process-lifetime sampler loop;
+            # it observes every thread and must not adopt any trace
+            t = threading.Thread(target=self._loop, daemon=True,
+                                 name="profiler-sampler")
+            self._thread = t
+            t.start()
+        self._wake.set()
+
+    def on_flag(self, hz) -> None:
+        """profile_hz watcher seam: applies only once a daemon armed
+        the profiler (ensure) — a bare library import must stay
+        thread-free."""
+        if self._enabled:
+            self.set_hz(hz)
+
+    def thread_alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def _loop(self) -> None:
+        while True:
+            hz = self._hz
+            if hz <= 0:
+                self._wake.wait(1.0)
+                self._wake.clear()
+                continue
+            period = 1.0 / hz
+            t0 = time.perf_counter()
+            try:
+                self._sample_once(period)
+            except Exception:
+                pass        # a sampler bug must never take a daemon down
+            cost = time.perf_counter() - t0
+            self.self_us += int(cost * 1e6)
+            self.ticks += 1
+            if period > cost:
+                time.sleep(period - cost)
+
+    # -------------------------------------------------------- sampling
+    def _frame_name(self, code) -> str:
+        s = self._code_names.get(code)
+        if s is None:
+            fn = code.co_filename
+            i = fn.rfind("/")
+            s = f"{fn[i + 1:]}:{code.co_name}"
+            if len(self._code_names) < 100000:
+                self._code_names[code] = s
+        return s
+
+    def _fold(self, frame) -> Tuple[str, str]:
+        """(leaf, collapsed root;..;leaf) of one thread's stack."""
+        parts: List[str] = []
+        f = frame
+        while f is not None and len(parts) < self.MAX_STACK_DEPTH:
+            parts.append(self._frame_name(f.f_code))
+            f = f.f_back
+        return parts[0] if parts else "<empty>", ";".join(reversed(parts))
+
+    def _role_of(self, name: str) -> str:
+        role = self._role_cache.get(name)
+        if role is None:
+            role = thread_role(name)
+            if len(self._role_cache) < 8192:
+                self._role_cache[name] = role
+        return role
+
+    def _sample_once(self, period: float,
+                     sink: Optional[Dict] = None,
+                     role_filter: Optional[str] = None) -> int:
+        frames = sys._current_frames()
+        own = threading.get_ident()
+        now = self._clock()
+        bucket_epoch = int(now) // self.BUCKET_S
+        n = 0
+        recs = []
+        # tid -> name resolved FRESH each tick (one list copy under
+        # threading's lock, ~µs): thread idents are reused by the OS,
+        # so a cross-tick cache would pin a dead thread's role onto
+        # whatever thread inherits its ident
+        names = {t.ident: t.name for t in threading.enumerate()}
+        for tid, frame in frames.items():
+            if tid == own:
+                continue
+            role = self._role_of(names.get(tid, ""))
+            if role_filter is not None and role != role_filter:
+                continue
+            leaf, stack = self._fold(frame)
+            trace_id = _thread_trace.get(tid)
+            verb = _thread_verb.get(tid)
+            recs.append((role, stack, leaf, trace_id, verb))
+            n += 1
+        if sink is not None:
+            for role, stack, leaf, trace_id, verb in recs:
+                v = sink.get((role, stack))
+                if v is None:
+                    v = sink[(role, stack)] = [0.0, 0, None, None]
+                v[0] += period
+                v[1] += 1
+                if trace_id:
+                    v[2] = trace_id
+                if verb:
+                    v[3] = verb
+            return n
+        with self._mu:
+            if not self._buckets or self._buckets[-1][0] != bucket_epoch:
+                self._buckets.append((bucket_epoch, {}))
+                horizon = bucket_epoch - \
+                    (self.WINDOWS[-1] // self.BUCKET_S) - 1
+                while self._buckets and self._buckets[0][0] < horizon:
+                    self._buckets.popleft()
+            cur = self._buckets[-1][1]
+            for role, stack, leaf, trace_id, verb in recs:
+                key = (role, stack)
+                if key not in self._life and \
+                        len(self._life) >= self.MAX_KEYS:
+                    key = (role, "<other>")
+                for d in (cur, self._life):
+                    v = d.get(key)
+                    if v is None:
+                        v = d[key] = [0.0, 0, None, None]
+                    v[0] += period
+                    v[1] += 1
+                    if trace_id:
+                        v[2] = trace_id
+                    if verb:
+                        v[3] = verb
+                if trace_id:
+                    self._tagged.append(
+                        {"ts": now, "role": role, "frame": leaf,
+                         "trace_id": trace_id, "verb": verb or ""})
+            self.samples += n
+        return n
+
+    # --------------------------------------------------------- reading
+    def _merged(self, window: Optional[int],
+                role: Optional[str] = None) -> Dict[Tuple[str, str], List]:
+        """Aggregation over the trailing `window` seconds (None =
+        lifetime), optionally filtered to one role."""
+        with self._mu:
+            if window is None:
+                items = [dict(self._life)]
+            else:
+                horizon = (int(self._clock()) - window) // self.BUCKET_S
+                items = [dict(d) for ep, d in self._buckets
+                         if ep >= horizon]
+        out: Dict[Tuple[str, str], List] = {}
+        for d in items:
+            for key, v in d.items():
+                if role is not None and key[0] != role:
+                    continue
+                cur = out.get(key)
+                if cur is None:
+                    out[key] = list(v)
+                else:
+                    cur[0] += v[0]
+                    cur[1] += v[1]
+                    cur[2] = v[2] or cur[2]
+                    cur[3] = v[3] or cur[3]
+        return out
+
+    def top(self, window: Optional[int] = 60, n: int = 20,
+            role: Optional[str] = None) -> Dict[str, Any]:
+        """Top-N SELF-time frames (the leaf frame owns the sample) per
+        the trailing window — the /profile JSON body."""
+        merged = self._merged(window, role)
+        total_s = sum(v[0] for v in merged.values())
+        total_n = sum(v[1] for v in merged.values())
+        frames: Dict[Tuple[str, str], List] = {}
+        roles: Dict[str, int] = {}
+        for (r, stack), v in merged.items():
+            leaf = stack.rsplit(";", 1)[-1]
+            cur = frames.get((r, leaf))
+            if cur is None:
+                frames[(r, leaf)] = list(v)
+            else:
+                cur[0] += v[0]
+                cur[1] += v[1]
+                cur[2] = v[2] or cur[2]
+                cur[3] = v[3] or cur[3]
+            roles[r] = roles.get(r, 0) + v[1]
+        rows = sorted(frames.items(), key=lambda kv: -kv[1][0])[:n]
+        return {
+            "window_s": window, "wall_s": round(total_s, 3),
+            "samples": total_n,
+            "threads": dict(sorted(roles.items(),
+                                   key=lambda kv: -kv[1])),
+            "frames": [
+                {"role": r, "frame": leaf,
+                 "self_s": round(v[0], 3), "samples": v[1],
+                 "share": round(v[0] / total_s, 4) if total_s else 0.0,
+                 **({"trace_id": v[2]} if v[2] else {}),
+                 **({"verb": v[3]} if v[3] else {})}
+                for (r, leaf), v in rows],
+        }
+
+    def collapsed(self, window: Optional[int] = 600,
+                  role: Optional[str] = None) -> str:
+        """flamegraph.pl / inferno collapsed-stack output: one
+        `role;frame;frame;... weight` line per distinct stack. The
+        weight is the stack's period-weighted wall time in ms (not a
+        raw sample count): a mid-run profile_hz change must not skew
+        flamegraph widths — same discipline as top()'s seconds."""
+        merged = self._merged(window, role)
+        lines = [f"{r};{stack} {max(1, round(v[0] * 1000))}"
+                 for (r, stack), v in sorted(merged.items())]
+        return "\n".join(lines) + ("\n" if lines else "")
+
+    def capture(self, seconds: float, hz: Optional[float] = None,
+                role: Optional[str] = None) -> Dict[str, Any]:
+        """On-demand high-rate capture (/profile?seconds=N): sample
+        synchronously into a private sink at `hz` (default: the
+        profile_capture_hz flag) for `seconds` (bounded), leaving the
+        always-on aggregation untouched."""
+        seconds = min(max(float(seconds), 0.05), 30.0)
+        if hz is None:
+            hz = float(_flag("profile_capture_hz", 97.0) or 97.0)
+        hz = min(max(float(hz), 1.0), 500.0)
+        period = 1.0 / hz
+        sink: Dict[Tuple[str, str], List] = {}
+        deadline = time.monotonic() + seconds
+        ticks = 0
+        while time.monotonic() < deadline:
+            t0 = time.perf_counter()
+            self._sample_once(period, sink=sink, role_filter=role)
+            ticks += 1
+            cost = time.perf_counter() - t0
+            if period > cost:
+                time.sleep(period - cost)
+        total_n = sum(v[1] for v in sink.values())
+        frames: Dict[Tuple[str, str], float] = {}
+        for (r, stack), v in sink.items():
+            leaf = stack.rsplit(";", 1)[-1]
+            frames[(r, leaf)] = frames.get((r, leaf), 0.0) + v[0]
+        top = sorted(frames.items(), key=lambda kv: -kv[1])[:20]
+        return {
+            "seconds": seconds, "hz": hz, "ticks": ticks,
+            "samples": total_n,
+            "frames": [{"role": r, "frame": leaf,
+                        "self_s": round(s, 4)} for (r, leaf), s in top],
+            "collapsed": "\n".join(
+                f"{r};{stack} {max(1, round(v[0] * 1000))}"
+                for (r, stack), v in sorted(sink.items())),
+        }
+
+    def tagged_samples(self, limit: int = 64) -> List[Dict[str, Any]]:
+        """Newest trace-tagged samples — the profile <-> trace join
+        evidence embedded in flight bundles."""
+        with self._mu:
+            items = list(self._tagged)
+        return items[-limit:]
+
+    def state(self) -> Dict[str, Any]:
+        wall = (time.monotonic() - self._t_started) \
+            if self._t_started else 0.0
+        return {
+            "hz": self._hz,
+            "thread_alive": self.thread_alive(),
+            "ticks": self.ticks,
+            "samples": self.samples,
+            "self_us": self.self_us,
+            "overhead": round(self.self_us / 1e6 / wall, 5)
+            if wall > 0 else 0.0,
+            "overhead_budget": SAMPLER_OVERHEAD_BUDGET,
+        }
+
+    def overhead(self) -> float:
+        """Sampler self-time as a fraction of wall time since the
+        sampler started — the tier-1 overhead-guard metric."""
+        if not self._t_started:
+            return 0.0
+        wall = time.monotonic() - self._t_started
+        return (self.self_us / 1e6) / wall if wall > 0 else 0.0
+
+    def reset(self) -> None:
+        with self._mu:
+            self._buckets.clear()
+            self._life.clear()
+            self._tagged.clear()
+        self.ticks = 0
+        self.samples = 0
+        self.self_us = 0
+        if self._t_started is not None:
+            self._t_started = time.monotonic()
+
+
+# ---------------------------------------------------------------------------
+# lock-contention profiler
+# ---------------------------------------------------------------------------
+
+# cv re-acquire waits under this are indistinguishable from scheduler
+# noise — _acquire_restore has no try-first fast path, so a floor
+# keeps every cv.wait from fabricating "contention"
+CV_CONTENDED_MIN_US = 100
+
+
+class _LockSite:
+    """Per-creation-site contention aggregate, shared by every lock
+    instance born with this name (all raft part locks are ONE site —
+    the lockdep-style class aggregation). `acquires` is a GIL-racy
+    monitoring counter (exactness would put a second lock on the
+    uncontended hot path); the contended stats are exact under the
+    site mutex."""
+
+    __slots__ = ("name", "acquires", "contended", "wait_us_total",
+                 "wait_us_max", "last_wait_us", "last_holder", "blame",
+                 "_mu")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.acquires = 0
+        self.contended = 0
+        self.wait_us_total = 0
+        self.wait_us_max = 0
+        self.last_wait_us = 0
+        self.last_holder = ""
+        self.blame: Dict[str, int] = {}
+        self._mu = threading.Lock()
+
+    def note_contended(self, wait_us: int,
+                       blamed: Optional[str]) -> None:
+        holder = thread_role(blamed) if blamed else ""
+        with self._mu:
+            self.contended += 1
+            self.wait_us_total += wait_us
+            self.last_wait_us = wait_us
+            if wait_us > self.wait_us_max:
+                self.wait_us_max = wait_us
+            if holder:
+                self.last_holder = holder
+                self.blame[holder] = self.blame.get(holder, 0) + 1
+        # native histogram with trace exemplars: the WAITER's ambient
+        # trace context (if sampled) pins the exemplar — the metric ->
+        # trace join for lock waits (scrapes as
+        # nebula_lock_wait_us_<site>)
+        global_stats.add_value(f"lock.wait_us.{self.name}", wait_us,
+                               kind="histogram")
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._mu:
+            top_blame = sorted(self.blame.items(),
+                               key=lambda kv: -kv[1])[:3]
+            return {
+                "name": self.name,
+                "acquires": self.acquires,
+                "contended": self.contended,
+                "wait_us_total": self.wait_us_total,
+                "wait_us_max": self.wait_us_max,
+                "last_wait_us": self.last_wait_us,
+                "last_holder": self.last_holder,
+                "blame": dict(top_blame),
+            }
+
+
+_lock_sites: Dict[str, _LockSite] = {}
+_lock_sites_mu = threading.Lock()
+
+
+def _site(name: str) -> _LockSite:
+    s = _lock_sites.get(name)
+    if s is None:
+        with _lock_sites_mu:
+            s = _lock_sites.setdefault(name, _LockSite(name))
+    return s
+
+
+class ProfiledLock:
+    """Always-on contention wrapper around one Lock/RLock instance.
+
+    Sits UNDER the lockwitness: it wraps whatever `threading.Lock()` /
+    `threading.RLock()` returned at construction (the witness proxy
+    when armed, the raw primitive otherwise), and forwards the
+    `_release_save`/`_acquire_restore`/`_is_owned` triple so
+    `threading.Condition(profiled_lock(...))` behaves exactly like a
+    Condition over the wrapped lock — the cv re-acquire after notify
+    is real dispatcher contention and is timed in _acquire_restore.
+
+    Uncontended cost: one failed-is-impossible try-acquire plus a
+    holder-ident stamp. Contended cost: two clock reads + the site
+    accounting + one histogram add — paid only after the thread
+    already burned a context switch waiting."""
+
+    __slots__ = ("_real", "_site", "_holder")
+
+    def __init__(self, real, site: _LockSite):
+        self._real = real
+        self._site = site
+        # last holder's thread NAME, stamped at acquire (resolving an
+        # ident later races the holder thread's teardown)
+        self._holder: Optional[str] = None
+
+    # ------------------------------------------------------- lock API
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        site = self._site
+        if self._real.acquire(False):
+            self._holder = threading.current_thread().name
+            site.acquires += 1      # monitoring-grade (see _LockSite)
+            return True
+        if not blocking:
+            return False
+        blamed = self._holder
+        t0 = time.perf_counter()
+        got = self._real.acquire(True, timeout)
+        wait_us = int((time.perf_counter() - t0) * 1e6)
+        if got:
+            self._holder = threading.current_thread().name
+            site.acquires += 1
+            site.note_contended(wait_us, blamed)
+        return got
+
+    def release(self) -> None:
+        # the holder stamp survives release ON PURPOSE: last-holder
+        # attribution ("who was in there when I had to wait")
+        self._real.release()
+
+    def locked(self) -> bool:
+        real = self._real
+        if hasattr(real, "locked"):
+            return real.locked()
+        if real.acquire(False):
+            real.release()
+            return False
+        return True
+
+    def __enter__(self) -> "ProfiledLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def __repr__(self) -> str:
+        return f"<profiled[{self._site.name}] {self._real!r}>"
+
+    # ------------------------------------------- Condition integration
+    def _release_save(self):
+        real = self._real
+        rs = getattr(real, "_release_save", None)
+        if rs is not None:
+            return rs()
+        real.release()
+        return None
+
+    def _acquire_restore(self, state) -> None:
+        blamed = self._holder
+        t0 = time.perf_counter()
+        real = self._real
+        ar = getattr(real, "_acquire_restore", None)
+        if ar is not None:
+            ar(state)
+        else:
+            real.acquire()
+        wait_us = int((time.perf_counter() - t0) * 1e6)
+        self._holder = threading.current_thread().name
+        site = self._site
+        site.acquires += 1
+        if wait_us >= CV_CONTENDED_MIN_US:
+            site.note_contended(wait_us, blamed)
+
+    def _is_owned(self) -> bool:
+        real = self._real
+        io = getattr(real, "_is_owned", None)
+        if io is not None:
+            return io()
+        if real.acquire(False):
+            real.release()
+            return False
+        return True
+
+
+def profiled_lock(name: str) -> ProfiledLock:
+    """A contention-profiled `threading.Lock()` under site `name`
+    (lowercase_with_underscores — it becomes the
+    nebula_lock_wait_us_<name> metric family)."""
+    return ProfiledLock(threading.Lock(), _site(name))
+
+
+def profiled_rlock(name: str) -> ProfiledLock:
+    """RLock twin of profiled_lock (the engine snapshot lock and raft
+    part locks are re-entrant)."""
+    return ProfiledLock(threading.RLock(), _site(name))
+
+
+def lock_table(top: int = 16) -> List[Dict[str, Any]]:
+    """The /profile?locks=1 top-contended table, most-waited first."""
+    with _lock_sites_mu:
+        sites = list(_lock_sites.values())
+    rows = [s.snapshot() for s in sites]
+    rows.sort(key=lambda r: -r["wait_us_total"])
+    return rows[:top]
+
+
+# ---------------------------------------------------------------------------
+# GC pause profiler
+# ---------------------------------------------------------------------------
+
+class GcProfiler:
+    """gc.callbacks-driven pause tracking: every collection's
+    stop-the-world wall time feeds the graph.gc.pause_us native
+    histogram; pauses past the gc_pause_flight_ms flag become
+    `gc_pause` flight events (the p99 burn that lines up with a gen-2
+    collection becomes visible in the ring)."""
+
+    def __init__(self, stats=global_stats):
+        self._stats = stats
+        self._installed = False
+        self._t0: Dict[int, Tuple[float, int]] = {}   # tid -> (t0, gen)
+        self._mu = threading.Lock()
+        self.collections = [0, 0, 0]
+        self.pause_us_total = 0
+        self.pause_us_max = 0
+        self.last_pause_us = 0
+        self.last_collected = 0
+        self.uncollectable = 0
+
+    def install(self) -> None:
+        if not self._installed:
+            self._installed = True
+            _gc.callbacks.append(self._cb)
+
+    def uninstall(self) -> None:
+        if self._installed:
+            self._installed = False
+            try:
+                _gc.callbacks.remove(self._cb)
+            except ValueError:
+                pass
+
+    def _cb(self, phase: str, info: Dict[str, Any]) -> None:
+        tid = threading.get_ident()
+        if phase == "start":
+            self._t0[tid] = (time.perf_counter(),
+                             int(info.get("generation", 0)))
+            return
+        t0g = self._t0.pop(tid, None)
+        if t0g is None:
+            return
+        pause_us = int((time.perf_counter() - t0g[0]) * 1e6)
+        gen = t0g[1]
+        collected = int(info.get("collected", 0))
+        with self._mu:
+            if 0 <= gen < 3:
+                self.collections[gen] += 1
+            self.pause_us_total += pause_us
+            self.last_pause_us = pause_us
+            self.last_collected = collected
+            self.uncollectable += int(info.get("uncollectable", 0))
+            if pause_us > self.pause_us_max:
+                self.pause_us_max = pause_us
+        self._stats.add_value("graph.gc.pause_us", pause_us,
+                              kind="histogram")
+        threshold_ms = float(_flag("gc_pause_flight_ms", 50.0) or 0.0)
+        if pause_us >= threshold_ms * 1000.0:
+            try:
+                from .flight import recorder
+                recorder.record("gc_pause", gen=gen, pause_us=pause_us,
+                                collected=collected)
+            except Exception:
+                pass
+
+    def table(self) -> Dict[str, Any]:
+        with self._mu:
+            return {
+                "installed": self._installed,
+                "collections": list(self.collections),
+                "pause_us_total": self.pause_us_total,
+                "pause_us_max": self.pause_us_max,
+                "last_pause_us": self.last_pause_us,
+                "last_collected": self.last_collected,
+                "uncollectable": self.uncollectable,
+            }
+
+    def gauges(self) -> Dict[str, float]:
+        with self._mu:
+            out = {"graph.gc.collections.gen" + str(g):
+                   float(self.collections[g]) for g in range(3)}
+            out["graph.gc.pause_us_max"] = float(self.pause_us_max)
+            out["graph.gc.uncollectable"] = float(self.uncollectable)
+            return out
+
+
+# ---------------------------------------------------------------------------
+# XLA compile accounting
+# ---------------------------------------------------------------------------
+
+class CompileTable:
+    """Per-signature XLA compile accounting around the fused-program
+    registry: TpuGraphEngine._fused_entry wraps each registry MISS in
+    `timed_first_call`, so the first launch — the call that pays
+    trace + XLA compile — lands in the tpu_engine.compile_us
+    histogram and the /profile?compiles=1 table. Subsequent launches
+    go through one delegating call (fractions of a µs next to a
+    device launch)."""
+
+    def __init__(self, stats=global_stats, clock=time.time):
+        self._stats = stats
+        self._clock = clock
+        self._mu = threading.Lock()
+        self._table: Dict[str, Dict[str, Any]] = {}
+
+    def note(self, signature: str, dur_us: int) -> None:
+        self._stats.add_value("tpu_engine.compile_us", dur_us,
+                              kind="histogram")
+        now = self._clock()
+        with self._mu:
+            rec = self._table.get(signature)
+            if rec is None:
+                rec = self._table[signature] = {
+                    "signature": signature, "compiles": 0,
+                    "total_us": 0, "last_us": 0, "last_ts": 0.0}
+            rec["compiles"] += 1
+            rec["total_us"] += int(dur_us)
+            rec["last_us"] = int(dur_us)
+            rec["last_ts"] = now
+
+    def timed_first_call(self, fn: Callable, signature: str) -> Callable:
+        return _TimedFirstCall(fn, signature, self)
+
+    def table(self, top: int = 32) -> List[Dict[str, Any]]:
+        with self._mu:
+            rows = [dict(r) for r in self._table.values()]
+        rows.sort(key=lambda r: -r["total_us"])
+        return rows[:top]
+
+    def totals(self) -> Dict[str, int]:
+        with self._mu:
+            return {
+                "signatures": len(self._table),
+                "compiles": sum(r["compiles"]
+                                for r in self._table.values()),
+                "total_us": sum(r["total_us"]
+                                for r in self._table.values()),
+            }
+
+
+class _TimedFirstCall:
+    """Times exactly the FIRST invocation (trace + XLA compile + first
+    execute — compile-dominated on any cold signature) into the
+    CompileTable; later calls delegate straight through."""
+
+    __slots__ = ("fn", "signature", "_table", "_done")
+
+    def __init__(self, fn: Callable, signature: str, table: CompileTable):
+        self.fn = fn
+        self.signature = signature
+        self._table = table
+        self._done = False
+
+    def __call__(self, *args, **kwargs):
+        if self._done:
+            return self.fn(*args, **kwargs)
+        t0 = time.perf_counter()
+        out = self.fn(*args, **kwargs)
+        dur_us = int((time.perf_counter() - t0) * 1e6)
+        self._done = True      # GIL-atomic; a concurrent double-note
+        self._table.note(self.signature, dur_us)   # is harmless
+        return out
+
+    def __getattr__(self, name):
+        # jit callables expose _cache_size/lower/etc. — pass through
+        return getattr(self.fn, name)
+
+
+# ---------------------------------------------------------------------------
+# process-global instruments + daemon wiring
+# ---------------------------------------------------------------------------
+
+profiler = SamplingProfiler()
+gc_profiler = GcProfiler()
+compiles = CompileTable()
+
+_armed = False
+
+
+def flight_block() -> Dict[str, Any]:
+    """The profile capture embedded in EVERY flight bundle: the
+    anomaly window's hot frames (60 s), the trace-tagged samples
+    (trace-id correlation evidence), the top contended locks and the
+    runtime-health tables."""
+    return {
+        "state": profiler.state(),
+        "top": profiler.top(window=60, n=12),
+        "tagged_samples": profiler.tagged_samples(48),
+        "locks": lock_table(8),
+        "gc": gc_profiler.table(),
+        "compiles": compiles.table(8),
+    }
+
+
+def ensure_started() -> None:
+    """Arm the observatory for this process (idempotent): start the
+    sampler at the profile_hz flag, install the GC callbacks, watch
+    the flag on every registry, and register the flight-bundle
+    collector. Called by WebService.start() — a daemon serving
+    /profile is a daemon being profiled; bare library imports stay
+    inert."""
+    global _armed
+    if not _armed:
+        _armed = True
+        for reg in _REGISTRIES:
+            reg.watch(_on_flag)
+        gc_profiler.install()
+        try:
+            from .flight import recorder
+            recorder.add_collector("profile", flight_block)
+        except Exception:
+            pass
+    profiler.ensure()
+
+
+def _on_flag(name: str, value) -> None:
+    if name == "profile_hz":
+        profiler.on_flag(value)
+
+
+def profile_endpoint(params: Dict[str, str], body: bytes
+                     ) -> Tuple[int, Any]:
+    """The /profile handler body (webservice built-in, every daemon):
+      GET /profile                 top-N self-time JSON (?window=60|600|life,
+                                   ?top=N, ?thread=<role>)
+      GET /profile?format=collapsed  flamegraph.pl collapsed stacks
+      GET /profile?seconds=N       on-demand high-rate capture (?hz=)
+      GET /profile?locks=1         top-contended lock table
+      GET /profile?compiles=1      per-signature XLA compile table
+    """
+    def _top(default: int):
+        try:
+            return int(params.get("top", default) or default)
+        except ValueError:
+            return None
+
+    if params.get("locks"):
+        n = _top(16)
+        if n is None:
+            return 400, {"error": "top must be an integer"}
+        return 200, {"locks": lock_table(n)}
+    if params.get("compiles"):
+        n = _top(32)
+        if n is None:
+            return 400, {"error": "top must be an integer"}
+        return 200, {"totals": compiles.totals(),
+                     "compiles": compiles.table(n)}
+    role = params.get("thread")
+    if "seconds" in params:
+        try:
+            seconds = float(params["seconds"])
+        except ValueError:
+            return 400, {"error": "seconds must be numeric"}
+        hz = None
+        if "hz" in params:
+            try:
+                hz = float(params["hz"])
+            except ValueError:
+                return 400, {"error": "hz must be numeric"}
+        cap = profiler.capture(seconds, hz=hz, role=role)
+        if params.get("format") == "collapsed":
+            return 200, (cap["collapsed"] + "\n").encode()
+        cap.pop("collapsed", None)
+        return 200, cap
+    window_s = params.get("window", "60")
+    window: Optional[int]
+    if window_s in ("life", "lifetime", "0"):
+        window = None
+    else:
+        try:
+            window = int(window_s)
+        except ValueError:
+            return 400, {"error": "window must be 60, 600 or 'life'"}
+        if window not in SamplingProfiler.WINDOWS:
+            return 400, {"error": "window must be 60, 600 or 'life'"}
+    if params.get("format") == "collapsed":
+        return 200, profiler.collapsed(window=window, role=role).encode()
+    top_n = _top(20)
+    if top_n is None:
+        return 400, {"error": "top must be an integer"}
+    return 200, {
+        "state": profiler.state(),
+        **profiler.top(window=window, n=top_n, role=role),
+        "locks": lock_table(8),
+        "gc": gc_profiler.table(),
+        "compiles": compiles.totals(),
+    }
+
+
+def gauges() -> Dict[str, float]:
+    """Flat /metrics gauges: sampler health + GC tables (the pause
+    distribution itself is the graph.gc.pause_us histogram)."""
+    st = profiler.state()
+    out = {
+        "profiler.hz": float(st["hz"]),
+        "profiler.samples": float(st["samples"]),
+        "profiler.ticks": float(st["ticks"]),
+        "profiler.self_us": float(st["self_us"]),
+    }
+    out.update(gc_profiler.gauges())
+    ct = compiles.totals()
+    out["tpu_engine.compile.signatures"] = float(ct["signatures"])
+    out["tpu_engine.compile.total_us"] = float(ct["total_us"])
+    return out

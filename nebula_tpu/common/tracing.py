@@ -36,6 +36,7 @@ from collections import deque
 from typing import Any, Dict, List, Optional, Tuple
 
 from .flags import MUTABLE, REBOOT, graph_flags
+from . import profiler as _profiler
 
 # (state, current-span) of the sampled trace this thread of control is
 # inside; None = unsampled (the off-path case: every span() call is one
@@ -178,22 +179,31 @@ class _UseCtx:
     A None ctx DETACHES: serving an UNSAMPLED request must not record
     its spans/degradation tags into the (possibly sampled) leader's
     own trace — an N-query window would give the leader N duplicates
-    of every stage span and other requests' failure tags."""
+    of every stage span and other requests' failure tags. The
+    re-point also mirrors into the profiler's per-thread context
+    (common/profiler.py), so a stack sample of the leader serving a
+    waiter's request is tagged with the WAITER's trace."""
 
-    __slots__ = ("_ctx", "_token")
+    __slots__ = ("_ctx", "_token", "_ptok")
 
     def __init__(self, ctx):
         self._ctx = ctx
         self._token = None
+        self._ptok = None
 
     def __enter__(self):
         self._token = _current.set(self._ctx)
+        self._ptok = _profiler.note_trace(
+            self._ctx[0].trace_id if self._ctx else None)
         return self
 
     def __exit__(self, *exc):
         if self._token is not None:
             _current.reset(self._token)
             self._token = None
+        if self._ptok is not None:
+            _profiler.restore_trace(self._ptok)
+            self._ptok = None
         return False
 
 
@@ -202,7 +212,7 @@ class TraceHandle:
     the current span for the extent between the two calls."""
 
     __slots__ = ("_tracer", "_state", "_token", "_t0", "sampled",
-                 "trace_id")
+                 "trace_id", "_ptok")
 
     def __init__(self, tracer: "Tracer", name: str,
                  tags: Optional[Dict[str, Any]]):
@@ -213,6 +223,9 @@ class TraceHandle:
         self.sampled = True
         self._t0 = time.perf_counter()
         self._token = _current.set((self._state, root))
+        # per-thread mirror for the sampling profiler: only SAMPLED
+        # queries pay these two dict stores (common/profiler.py)
+        self._ptok = _profiler.note_trace(self.trace_id)
 
     def finish(self, **tags) -> Optional[Dict[str, Any]]:
         state = self._state
@@ -220,6 +233,7 @@ class TraceHandle:
         root.dur_us = int((time.perf_counter() - self._t0) * 1e6)
         root.tags.update(tags)
         _current.reset(self._token)
+        _profiler.restore_trace(self._ptok)
         state.spans.append(root)
         trace = {"trace_id": state.trace_id, "name": root.name,
                  "t0_us": int(root.t0 * 1e6), "dur_us": root.dur_us,
@@ -249,7 +263,8 @@ class RemoteTrace:
     deposited in the LOCAL ring, so storaged's /traces serves the
     work it did for remote queries."""
 
-    __slots__ = ("_tracer", "_state", "_token", "_t0", "wire_spans")
+    __slots__ = ("_tracer", "_state", "_token", "_t0", "wire_spans",
+                 "_ptok")
 
     def __init__(self, tracer: "Tracer", name: str, trace_id: str,
                  parent_span_id: str):
@@ -261,6 +276,7 @@ class RemoteTrace:
     def __enter__(self) -> "RemoteTrace":
         self._t0 = time.perf_counter()
         self._token = _current.set((self._state, self._state.root))
+        self._ptok = _profiler.note_trace(self._state.trace_id)
         return self
 
     def __exit__(self, etype, evalue, tb) -> bool:
@@ -270,6 +286,7 @@ class RemoteTrace:
         if etype is not None:
             root.tags["error"] = etype.__name__
         _current.reset(self._token)
+        _profiler.restore_trace(self._ptok)
         state.spans.append(root)
         self.wire_spans = [s.to_wire() for s in state.spans]
         self._tracer.ring.add(

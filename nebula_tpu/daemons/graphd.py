@@ -341,6 +341,11 @@ def serve_graphd(meta_addr: str, host: str = "127.0.0.1", port: int = 0,
                     # hit/miss, kernel-overlapped transfers + the time
                     # they had to hide, donation fallbacks
                     "frontier_prefetch": tpu_engine.prefetch_stats(),
+                    # per-snapshot device-memory ledger (continuous
+                    # profiling, docs/manual/10-observability.md):
+                    # live CSR bytes by packed width per space — the
+                    # measured twin of bench's tier1_hbm_model
+                    "device_mem": tpu_engine.device_mem_stats(),
                     "sparse_budget_calibrations": {
                         str(k): v for k, v in
                         tpu_engine.sparse_budget_calibrations.items()},
@@ -401,6 +406,16 @@ def serve_graphd(meta_addr: str, host: str = "127.0.0.1", port: int = 0,
                     out[f"tpu_engine.fused.{k}"] = v
                 for k, v in tpu_engine.prefetch_stats().items():
                     out[f"tpu_engine.prefetch.{k}"] = v
+                # device-memory ledger gauges (continuous profiling):
+                # live CSR bytes by width next to the modeled HBM
+                # estimate's inputs
+                dm = tpu_engine.device_mem_stats()
+                out["tpu_engine.device_mem.bytes"] = dm["bytes"]
+                out["tpu_engine.device_mem.snapshots"] = dm["snapshots"]
+                out["tpu_engine.device_mem.frontier_h2d_bytes"] = \
+                    dm["frontier_h2d_bytes"]
+                for w, v in dm["by_width"].items():
+                    out[f"tpu_engine.device_mem.bytes.{w}"] = v
                 # QoS lane/shed gauges (docs/manual/14-qos.md):
                 # scrape-flat twins of the /tpu_stats qos block (the
                 # per-event counters additionally stream through the

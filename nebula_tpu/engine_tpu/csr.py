@@ -410,6 +410,48 @@ class CsrSnapshot:
                 "edge_etype": int(s.edge_etype.dtype.itemsize),
                 "edge_dst_local": int(s.edge_dst_local.dtype.itemsize)}
 
+    def device_mem(self) -> Dict[str, int]:
+        """Live device bytes held by this snapshot's CSR streams, by
+        dtype width — the per-snapshot device-memory ledger next to
+        bench's tier1_hbm_model ESTIMATE (docs/manual/
+        10-observability.md, "Continuous profiling"). Counts the
+        resident kernel arrays (both layouts) + the canonical gidx;
+        the lazily built aligned/sharded layouts are included when
+        live. Transient frontier stacks are accounted separately by
+        the FrontierPool's h2d_bytes counter."""
+        by_width: Dict[str, int] = {}
+        total = 0
+
+        def add(a) -> None:
+            nonlocal total
+            if a is None:
+                return
+            if isinstance(a, (tuple, list)):
+                for x in a:       # covers NamedTuples (EdgeKernel,
+                    add(x)        # AlignedKernel) and block lists
+                return
+            nb = getattr(a, "nbytes", None)
+            dt = getattr(a, "dtype", None)
+            if nb is None or dt is None:
+                return
+            total += int(nb)
+            key = str(dt)
+            by_width[key] = by_width.get(key, 0) + int(nb)
+
+        add((self.d_edge_src, self.d_edge_gidx,
+             self.d_edge_etype, self.d_edge_valid))
+        k = self.kernel
+        if k is not None:
+            add((k.src_sorted, k.etype_sorted, k.valid_sorted,
+                 k.seg_starts, k.seg_ends))
+        add(self._aligned)
+        add(self.sharded_kernel)
+        sa = self._sharded_aligned
+        if sa is not None and sa != "failed":
+            add(sa)
+        return {"bytes": total,
+                **{f"bytes.{w}": n for w, n in sorted(by_width.items())}}
+
 
 # ---------------------------------------------------------------------------
 # builder — vectorized: the keys are fixed-width big-endian with

@@ -43,6 +43,7 @@ from ..common.cache import (CacheRung, plan_stage_enabled,
                             result_stage_enabled)
 from ..common import ledger as _ledger
 from ..common.faults import CircuitBreaker, faults
+from ..common import profiler as _profiler
 from ..common.flight import recorder as _flight
 from ..common.flags import graph_flags
 from ..common.qos import LANE_BULK, LANE_INTERACTIVE, OverloadShed
@@ -160,8 +161,10 @@ class TpuGraphEngine:
         # serializes snapshot lifecycle + host-mirror reads: delta
         # applies mutate shard mirrors in place, so queries and applies
         # must not interleave (rebuild swaps were immutable; deltas are
-        # not)
-        self._lock = threading.RLock()
+        # not). Contention-profiled (common/profiler.py): acquire
+        # waits feed the nebula_lock_wait_us_engine_snapshot histogram
+        # + the /profile?locks=1 table
+        self._lock = _profiler.profiled_rlock("engine_snapshot")
         # tiny leaf lock for counters bumped OUTSIDE the engine lock
         # (pre-lock decline paths, off-lock window encode): dict-int
         # += is read-add-store and loses increments under thread
@@ -181,7 +184,11 @@ class TpuGraphEngine:
         # and coalesce into the next window (the group-commit batching
         # pressure). `MAX_CONCURRENT_ROUNDS` bounds device/queue
         # pressure from many distinct keys.
-        self._disp_cv = threading.Condition()
+        # contention-profiled cv lock: waiter re-acquires after
+        # notify_all are the dispatcher's real convoy signal
+        # (nebula_lock_wait_us_dispatcher_cv)
+        self._disp_cv = threading.Condition(
+            _profiler.profiled_rlock("dispatcher_cv"))
         self._disp_queue: List["_GoReq"] = []
         self._disp_serving: Dict[Tuple, "_GoReq"] = {}
         # QoS priority lanes (docs/manual/14-qos.md): per-lane
@@ -401,7 +408,12 @@ class TpuGraphEngine:
             fn = reg.get(sig)
             miss = fn is None
             if miss:
-                fn = reg[sig] = make()
+                # XLA compile accounting (common/profiler.py): the
+                # FIRST launch of a fresh signature pays trace +
+                # compile — timed into the tpu_engine.compile_us
+                # histogram and the /profile?compiles=1 table
+                fn = reg[sig] = _profiler.compiles.timed_first_call(
+                    make(), str(sig))
         with self._stats_lock:
             if miss:
                 self._fused_counters["misses"] += 1
@@ -434,6 +446,34 @@ class TpuGraphEngine:
         prefetch hits/misses, kernel-overlapped transfers + the wall
         time they had to hide, and donation fallbacks."""
         return self.frontier_pool.snapshot()
+
+    def device_mem_stats(self) -> Dict[str, Any]:
+        """The per-snapshot device-memory ledger (docs/manual/
+        10-observability.md, "Continuous profiling"): live CSR bytes
+        by dtype width per served space, plus the FrontierPool's
+        cumulative staged frontier bytes — the MEASURED companion of
+        bench's modeled tier1_hbm_model, scraped as
+        tpu_engine.device_mem.* gauges."""
+        spaces: Dict[str, Dict[str, int]] = {}
+        total = 0
+        by_width: Dict[str, int] = {}
+        with self._lock:
+            snaps = dict(self._snapshots)
+        for space_id, snap in snaps.items():
+            try:
+                mem = snap.device_mem()
+            except Exception:
+                continue     # a snapshot mid-poison must not 500 /profile
+            spaces[str(space_id)] = mem
+            total += mem.get("bytes", 0)
+            for k, v in mem.items():
+                if k.startswith("bytes."):
+                    w = k[len("bytes."):]
+                    by_width[w] = by_width.get(w, 0) + v
+        return {"snapshots": len(spaces), "bytes": total,
+                "frontier_h2d_bytes":
+                    self.frontier_pool.snapshot()["h2d_bytes"],
+                "by_width": by_width, "spaces": spaces}
 
     @property
     def sparse_edge_budget(self) -> int:

@@ -200,7 +200,8 @@ class ExecutionEngine:
             led = ledger.current()
             if led is not None and not led.verb:
                 # per-verb cost rollup dimension (graph.cost.verb.*)
-                led.verb = seq.sentences[0].kind.value
+                # + the profiler's per-thread verb mirror
+                ledger.set_verb(led, seq.sentences[0].kind.value)
         ctx = ExecContext(self, session)
         result: Optional[InterimResult] = None
         tpu = self.tpu_engine

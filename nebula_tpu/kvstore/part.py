@@ -19,6 +19,7 @@ import threading
 from typing import Callable, Iterable, List, Optional, Tuple
 
 from ..common import keys as keyutils
+from ..common import profiler as _profiler
 from ..common.status import ErrorCode, Status
 from . import log_encoder as le
 from .iface import KVEngine
@@ -36,7 +37,9 @@ class Part:
         self.space_id = space_id
         self.part_id = part_id
         self.engine = engine
-        self._lock = threading.Lock()
+        # contention-profiled: all kv parts share the "kv_part" site
+        # (common/profiler.py; nebula_lock_wait_us_kv_part)
+        self._lock = _profiler.profiled_lock("kv_part")
         self.last_committed_log_id = 0
         self.last_committed_term = 0
         self._snapshot_active = False   # mid-install chunk sequence

@@ -53,6 +53,7 @@ import time
 from concurrent.futures import Future
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ...common import profiler as _profiler
 from ...common.faults import faults
 from ...common.flight import recorder as flight
 from ...common.stats import stats
@@ -118,7 +119,11 @@ class RaftPart:
         self._election_timeout = election_timeout
         self._rpc_timeout = rpc_timeout
 
-        self._lock = threading.RLock()
+        # contention-profiled (common/profiler.py): every raft part's
+        # lock shares ONE site ("raft_part"), so the
+        # nebula_lock_wait_us_raft_part histogram is the tier-wide
+        # consensus-lock convoy signal
+        self._lock = _profiler.profiled_rlock("raft_part")
         self.role = Role.LEARNER if is_learner else Role.FOLLOWER
         self.term = 0
         self.voted_for: Optional[str] = None
@@ -339,8 +344,10 @@ class RaftPart:
             if target == self.addr and self.role is not Role.LEADER:
                 # nlint: disable=NL002 -- election is cluster state
                 # machinery, not work owed to the triggering request
-                threading.Thread(target=self._leader_election,
-                                 daemon=True).start()
+                threading.Thread(
+                    target=self._leader_election, daemon=True,
+                    name=f"raft-elect-{self.space_id}-{self.part_id}"
+                ).start()
 
     # ------------------------------------------------------------------
     # replicator: one round ships wal[next..last] to every host, then
@@ -740,7 +747,9 @@ class RaftPart:
         # nlint: disable=NL002 -- catch-up transfer to a lagging peer;
         # spans belong to no client trace
         threading.Thread(target=self._send_snapshot, args=(host,),
-                         daemon=True).start()
+                         daemon=True,
+                         name=f"raft-snapsend-{self.space_id}-"
+                              f"{self.part_id}").start()
 
     def _send_snapshot(self, host: Host) -> None:
         try:

@@ -435,7 +435,8 @@ class LedgerWriters:
         # nlint: disable=NL002 -- load-origin storm writers; no inbound
         # trace to propagate
         self._threads = [threading.Thread(target=self._run, args=(w,),
-                                          daemon=True)
+                                          daemon=True,
+                                          name=f"crash-writer-{w}")
                          for w in range(n_writers)]
 
     def start(self):

@@ -50,7 +50,8 @@ def main(argv=None):
 
         # nlint: disable=NL002 -- load-origin bench workers; there is
         # no inbound trace to carry
-        ts = [threading.Thread(target=reader, args=(i,))
+        ts = [threading.Thread(target=reader, args=(i,),
+                               name=f"kvbench-reader-{i}")
               for i in range(threads)]
         t0 = time.time()
         for t in ts:

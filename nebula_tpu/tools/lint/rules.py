@@ -1,4 +1,4 @@
-"""NL001-NL007: the rule catalog (docs/manual/15-static-analysis.md).
+"""NL001-NL008: the rule catalog (docs/manual/15-static-analysis.md).
 
 Every rule encodes an invariant this repo already states in prose
 (CHANGES.md review-hardening notes, the manuals); the rule docstrings
@@ -320,6 +320,14 @@ _NL004_KINDS = ("counter", "timing", "histogram")
 # cost family.
 _NL004_FAMILY_KINDS = {
     "graph.cost.": "histogram",
+    # continuous-profiling families (common/profiler.py): lock
+    # acquire-wait distributions (nebula_lock_wait_us_* on /metrics)
+    # and GC pause distributions are contractually native histograms —
+    # the strict-OpenMetrics scrape tests and the SLO engine's
+    # window_le reads both depend on the bucket series existing
+    "lock.wait_us.": "histogram",
+    "graph.gc.": "histogram",
+    "tpu_engine.compile_us": "histogram",
 }
 
 
@@ -767,4 +775,42 @@ def nl007(project: Project) -> List[Finding]:
                     f"({'2/3/4' if is_resp else '4/5/6'}"
                     f"-tuple; docs/manual/6-wire-protocol.md)",
                     f.qualname_at(node)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# NL008 — thread spawns must carry a stable name
+# ---------------------------------------------------------------------------
+
+@rule("NL008", "Thread spawn without a descriptive name=")
+def nl008(project: Project) -> List[Finding]:
+    """The continuous-profiling observatory (common/profiler.py)
+    attributes stack samples and lock-wait blame per thread ROLE —
+    the thread's `name=` with digit runs normalized. A spawn without
+    `name=` samples as `Thread-N`, which aggregates every anonymous
+    background task into one meaningless role and breaks last-holder
+    attribution in the /profile?locks=1 table. Every
+    `threading.Thread(...)` / `traced_thread(...)` spawn under
+    nebula_tpu/ must pass a descriptive `name=` (constant or
+    f-string; per-instance digits are fine — roles normalize them)."""
+    out: List[Finding] = []
+    for f in project.files:
+        if f.tree is None or not _in_package(f):
+            continue
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            d = dotted(node.func)
+            if d not in ("threading.Thread", "Thread", "traced_thread",
+                         "threads.traced_thread",
+                         "common.threads.traced_thread"):
+                continue
+            if any(kw.arg == "name" for kw in node.keywords):
+                continue
+            out.append(Finding(
+                "NL008", f.rel, node.lineno, node.col_offset,
+                f"`{d}(...)` spawn without name= — it samples as "
+                f"Thread-N, breaking the profiler's per-role "
+                f"attribution (docs/manual/10-observability.md, "
+                f"continuous profiling)", f.qualname_at(node)))
     return out

@@ -14,6 +14,10 @@ renders, per refresh:
     (device us/s, rows scanned/s, rpc bytes/s per space)
   - raft leader distribution (storage.raft.*.is_leader gauges per
     instance) — a skewed leader column is tomorrow's hotspot
+  - HOT FRAMES: the continuous profiler's top self-time frames per
+    thread role + the top contended locks, pulled from graphd's
+    /profile endpoint next to the scrape (ISSUE 13; the panel is
+    omitted when the daemon predates /profile)
 
     python -m nebula_tpu.tools.nebtop --url http://127.0.0.1:13000 \
         [--interval 2.0] [--once] [--json]
@@ -130,6 +134,43 @@ def scrape(url: str, timeout: float = 5.0) -> Snapshot:
     return Snapshot(parse_samples(text), time.time())
 
 
+def fetch_profile(base_url: str,
+                  timeout: float = 5.0) -> Optional[Dict[str, Any]]:
+    """graphd /profile JSON (top self-time + lock table), or None when
+    the endpoint is absent/unreachable — the panel is optional."""
+    try:
+        with urllib.request.urlopen(
+                base_url.rstrip("/") + "/profile?top=8",
+                timeout=timeout) as r:
+            return json.loads(r.read())
+    except Exception:
+        return None
+
+
+def render_profile(prof: Optional[Dict[str, Any]]) -> List[str]:
+    """The hot-frames panel rows (empty when no profile available)."""
+    if not prof or not prof.get("frames"):
+        return []
+    lines = [""]
+    st = prof.get("state", {})
+    lines.append(f"hot frames ({prof.get('samples', 0)} samples @ "
+                 f"{st.get('hz', 0):g} Hz, window "
+                 f"{prof.get('window_s')}s)")
+    lines.append(f"{'ROLE':<26}{'FRAME':<40}{'SELF_S':>8}{'PCT':>7}")
+    for f in prof["frames"][:8]:
+        lines.append(f"{f['role'][:25]:<26}{f['frame'][:39]:<40}"
+                     f"{f['self_s']:>8.2f}{f['share'] * 100:>6.1f}%")
+    locks = [l for l in prof.get("locks", ()) if l.get("contended")]
+    if locks:
+        lines.append(f"{'LOCK':<26}{'CONTENDED':>10}{'WAIT_MS':>10}"
+                     f"{'LAST HOLDER':>24}")
+        for l in locks[:4]:
+            lines.append(f"{l['name'][:25]:<26}{l['contended']:>10}"
+                         f"{l['wait_us_total'] / 1000:>10.1f}"
+                         f"{l['last_holder'][:23]:>24}")
+    return lines
+
+
 def _rate(new: Snapshot, old: Optional[Snapshot], name: str) -> float:
     if old is None:
         return 0.0
@@ -137,7 +178,8 @@ def _rate(new: Snapshot, old: Optional[Snapshot], name: str) -> float:
     return max((new.sum(name) - old.sum(name)) / dt, 0.0)
 
 
-def render(new: Snapshot, old: Optional[Snapshot]) -> str:
+def render(new: Snapshot, old: Optional[Snapshot],
+           prof: Optional[Dict[str, Any]] = None) -> str:
     lines: List[str] = []
     insts = new.instances()
     up = sum(1 for i in insts if i["up"])
@@ -184,15 +226,23 @@ def render(new: Snapshot, old: Optional[Snapshot]) -> str:
             lines.append(f"{space:<16}{cell(space, 'device_us'):>12}"
                          f"{cell(space, 'rows_scanned'):>12}"
                          f"{cell(space, 'rpc_bytes'):>12}")
+    lines.extend(render_profile(prof))
     return "\n".join(lines)
 
 
-def snapshot_dict(s: Snapshot) -> Dict[str, Any]:
+def snapshot_dict(s: Snapshot,
+                  prof: Optional[Dict[str, Any]] = None
+                  ) -> Dict[str, Any]:
     """--once --json machine form (totals, no rates)."""
-    return {"instances": s.instances(),
-            "leaders": s.leader_counts(),
-            "query_total": s.sum("nebula_graph_query_total"),
-            "tenant_cost": s.tenant_cost()}
+    out = {"instances": s.instances(),
+           "leaders": s.leader_counts(),
+           "query_total": s.sum("nebula_graph_query_total"),
+           "tenant_cost": s.tenant_cost()}
+    if prof is not None:
+        out["profile"] = {"frames": prof.get("frames", []),
+                          "locks": prof.get("locks", []),
+                          "state": prof.get("state", {})}
+    return out
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -208,16 +258,23 @@ def main(argv: Optional[List[str]] = None) -> int:
     args = ap.parse_args(argv)
     url = args.url if args.url.endswith("/cluster_metrics") \
         else args.url.rstrip("/") + "/cluster_metrics"
+    base = url[:-len("/cluster_metrics")]
     try:
         snap = scrape(url)
     except Exception as e:
         print(f"nebtop: scrape failed: {e}", file=sys.stderr)
         return 2
     if args.once:
-        print(json.dumps(snapshot_dict(snap), indent=1) if args.json
-              else render(snap, None))
+        prof = fetch_profile(base)
+        print(json.dumps(snapshot_dict(snap, prof), indent=1)
+              if args.json else render(snap, None, prof))
         return 0
     prev = snap
+    # the profile panel must never stall the dashboard: sub-interval
+    # timeout, and after 3 consecutive failures (a pre-/profile
+    # daemon, a wedged endpoint) stop asking — the panel is optional
+    prof_timeout = min(2.0, max(0.5, args.interval / 2))
+    prof_fails = 0
     try:
         while True:
             time.sleep(max(args.interval, 0.2))
@@ -226,8 +283,12 @@ def main(argv: Optional[List[str]] = None) -> int:
             except Exception as e:
                 print(f"nebtop: scrape failed: {e}", file=sys.stderr)
                 continue
+            prof = None
+            if prof_fails < 3:
+                prof = fetch_profile(base, timeout=prof_timeout)
+                prof_fails = 0 if prof is not None else prof_fails + 1
             sys.stdout.write("\x1b[2J\x1b[H")
-            print(render(cur, prev))
+            print(render(cur, prev, prof))
             prev = cur
     except KeyboardInterrupt:
         return 0

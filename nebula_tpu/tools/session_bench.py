@@ -62,7 +62,8 @@ def run_sessions(addr: str, queries: Sequence[str], n_sessions: int,
                 errs[i] += 1
 
     # nlint: disable=NL002 -- load-origin bench workers; no inbound trace
-    threads = [threading.Thread(target=worker, args=(i,), daemon=True)
+    threads = [threading.Thread(target=worker, args=(i,), daemon=True,
+                                name=f"session-bench-{i}")
                for i in range(n_sessions)]
     t0 = time.time()
     for t in threads:

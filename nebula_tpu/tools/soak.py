@@ -81,12 +81,18 @@ def _debug_bundle(cluster, tpu, extra: dict,
     # bundle enrichment (collectors/stats/dump) runs on a capture
     # thread — wait for it so the attached bundle is complete
     flight_recorder.flush(5.0)
+    from ..common import profiler as _prof
     out = {
         "trace_ring": tracer.ring.snapshot(),
         "flight": {
             "state": flight_recorder.describe(limit=64),
             "bundle": flight_recorder.last_bundle(),
         },
+        # what the process was DOING at failure time (ISSUE 13): top
+        # self-time frames per thread role, trace-tagged samples, top
+        # contended locks, GC/compile tables — the same capture every
+        # flight bundle embeds
+        "profile": _prof.flight_block(),
         # the observed lock-order graph rides every bundle: a
         # divergence that involved a lock-ordering surprise arrives
         # with the evidence attached (empty unless --witness /
@@ -449,10 +455,12 @@ def _run_soak_concurrent(seconds, threads, v, e, seed,
         stop = threading.Event()
         # nlint: disable=NL002 -- load-origin soak workers; no inbound
         # trace to carry (each query starts its own)
-        ts = [threading.Thread(target=writer, args=(i, stop))
+        ts = [threading.Thread(target=writer, args=(i, stop),
+                               name=f"soak-writer-{i}")
               for i in range(n_writers)]
         # nlint: disable=NL002 -- load-origin soak workers (above)
-        ts += [threading.Thread(target=reader, args=(i, stop, dense))
+        ts += [threading.Thread(target=reader, args=(i, stop, dense),
+                                name=f"soak-reader-{i}")
                for i in range(threads - n_writers)]
         for t in ts:
             t.start()
@@ -702,11 +710,14 @@ def run_soak_tenants(seconds: float = 8.0, seed: int = 21) -> dict:
 
     # nlint: disable=NL002 -- load-origin tenant workers; no inbound trace
     threads = [threading.Thread(target=tenant_worker, args=(t, k),
-                                daemon=True)
+                                daemon=True,
+                                name=f"soak-tenant-{k}")
                for k, t in enumerate(tenants)]
     # nlint: disable=NL002 -- load-origin abuser workers (above)
     threads += [threading.Thread(target=abuser_worker, args=(k,),
-                                 daemon=True) for k in range(2)]
+                                 daemon=True,
+                                 name=f"soak-abuser-{k}")
+                for k in range(2)]
     try:
         for th in threads:
             th.start()
@@ -836,7 +847,8 @@ def run_soak_crash(seconds: float = 45.0, seed: int = 29) -> dict:
                     writers.resume()
 
         # nlint: disable=NL002 -- soak-lifetime verifier; no inbound trace
-        vt = threading.Thread(target=verifier, daemon=True)
+        vt = threading.Thread(target=verifier, daemon=True,
+                              name="soak-crash-verifier")
         vt.start()
         deadline = time.monotonic() + seconds
         while time.monotonic() < deadline and not stop.is_set():
@@ -930,6 +942,12 @@ def main(argv=None) -> int:
                          "throttled with typed E_OVERLOAD only, small "
                          "tenants unaffected, identity checks green")
     args = ap.parse_args(argv)
+    # the continuous-profiling observatory rides every soak (ISSUE
+    # 13): the sampler runs at profile_hz so an identity-failure debug
+    # bundle arrives with the hot frames / lock contention / GC state
+    # of the failure window, not an empty profile block
+    from ..common import profiler as _prof
+    _prof.ensure_started()
     if args.witness:
         # install before the run boots anything so every serve-path
         # lock construction is wrapped (module-level locks created by

@@ -92,7 +92,9 @@ def run_perf(client, sm, space_id: int, tag_id: int, etype: int,
 
     t0 = time.monotonic()
     # nlint: disable=NL002 -- load-origin bench workers; no inbound trace
-    threads = [threading.Thread(target=worker) for _ in range(concurrency)]
+    threads = [threading.Thread(target=worker,
+                                name=f"storage-perf-{i}")
+               for i in range(concurrency)]
     for t in threads:
         t.start()
     for t in threads:

@@ -43,6 +43,10 @@ from .common.stats import StatsManager
 # the first /slo or /metrics request happened to land
 from .common import flight as _flight_mod
 from .common import slo as _slo_mod
+# likewise eager: declares profile_hz/profile_capture_hz/
+# gc_pause_flight_ms on every registry at daemon boot (the continuous
+# profiling observatory, common/profiler.py)
+from .common import profiler as _profiler_mod
 
 Handler = Callable[[Dict[str, str], bytes], Tuple[int, Any]]
 
@@ -81,6 +85,7 @@ class WebService:
         self.register("/metrics", self._metrics_handler)
         self.register("/flight", self._flight_handler)
         self.register("/slo", self._slo_handler)
+        self.register("/profile", self._profile_handler)
 
     # ------------------------------------------------------------------
     def register(self, path: str, handler: Handler) -> None:
@@ -141,6 +146,12 @@ class WebService:
 
         self._server = ThreadingHTTPServer((self._host, self._port), _Req)
         self._port = self._server.server_address[1]
+        # a daemon serving /profile is a daemon being profiled: arm
+        # the continuous-profiling observatory (sampler at the
+        # profile_hz flag — 0 means no sampler thread at all — GC
+        # callbacks, flight profile collector). Idempotent and
+        # process-global, like the flight recorder.
+        _profiler_mod.ensure_started()
         # nlint: disable=NL002 -- daemon-lifetime admin HTTP server
         self._thread = threading.Thread(target=self._server.serve_forever,
                                         daemon=True,
@@ -234,7 +245,8 @@ class WebService:
         # gauge sources: flight-recorder + SLO burn rates (process-
         # global, every daemon) then the daemon's registered sources
         sources: List[Callable[[], Dict[str, Any]]] = \
-            [_flight_gauges, _slo_gauges] + list(self._metric_sources)
+            [_flight_gauges, _slo_gauges, _profiler_gauges] \
+            + list(self._metric_sources)
         for src in sources:
             try:
                 extra = src()
@@ -288,6 +300,14 @@ class WebService:
         except ValueError:
             return 400, {"error": "limit must be an integer"}
         return 200, recorder.describe(limit=limit)
+
+    def _profile_handler(self, params, body) -> Tuple[int, Any]:
+        """/profile (docs/manual/10-observability.md, "Continuous
+        profiling"): top-N self-time per thread role, ?format=collapsed
+        flamegraph output, ?seconds=N on-demand capture, ?thread=<role>
+        filter, ?locks=1 contention table, ?compiles=1 XLA compile
+        table."""
+        return _profiler_mod.profile_endpoint(params, body)
 
     def _slo_handler(self, params, body) -> Tuple[int, Any]:
         """/slo: GET = objectives + multi-window burn rates; PUT body
@@ -404,3 +424,14 @@ def _flight_gauges() -> Dict[str, float]:
 
 def _slo_gauges() -> Dict[str, float]:
     return _slo_mod.engine.gauges()
+
+
+def _profiler_gauges() -> Dict[str, float]:
+    """Sampler health + GC/compile gauges. Empty (no families at all)
+    until ensure_started ran AND the sampler is armed — the
+    profile_hz=0 fast path keeps /metrics byte-identical to a
+    profiler-free build."""
+    if not _profiler_mod.profiler.thread_alive() and \
+            _profiler_mod.profiler.samples == 0:
+        return {}
+    return _profiler_mod.gauges()

@@ -1,0 +1,59 @@
+#!/bin/sh
+# flame.sh: pull a collapsed-stack profile from a daemon's /profile
+# endpoint into a flamegraph-ready file (docs/manual/
+# 10-observability.md, "Continuous profiling").
+#
+#   scripts/flame.sh [URL] [OUT] [SECONDS]
+#
+#   URL      daemon admin base (default http://127.0.0.1:13000);
+#            a full /profile URL also works
+#   OUT      output file (default ./profile.collapsed)
+#   SECONDS  optional: run an on-demand high-rate capture of this
+#            many seconds instead of reading the always-on 600s
+#            window (bounded to 30 by the daemon)
+#
+# The output is flamegraph.pl / inferno collapsed-stack input — one
+# "role;frame;frame;... weight" line (weight = sampled wall ms) per
+# distinct sampled stack:
+#
+#   scripts/flame.sh http://127.0.0.1:13000 /tmp/g.collapsed 5
+#   flamegraph.pl /tmp/g.collapsed > /tmp/g.svg     # or:
+#   inferno-flamegraph /tmp/g.collapsed > /tmp/g.svg
+#
+# Requires only curl (or python3 as fallback). The sampler must be
+# armed (profile_hz > 0, the default 19 Hz); `?thread=<role>` can be
+# appended to URL to narrow to one thread role.
+set -e
+
+URL="${1:-http://127.0.0.1:13000}"
+OUT="${2:-profile.collapsed}"
+SECONDS_ARG="${3:-}"
+
+case "$URL" in
+  */profile*) BASE_Q="$URL" ;;
+  *) BASE_Q="${URL%/}/profile" ;;
+esac
+case "$BASE_Q" in
+  *\?*) Q="$BASE_Q&format=collapsed" ;;
+  *) Q="$BASE_Q?format=collapsed" ;;
+esac
+if [ -n "$SECONDS_ARG" ]; then
+  Q="$Q&seconds=$SECONDS_ARG"
+else
+  # the always-on 600s window (the endpoint's bare default is 60s)
+  case "$Q" in
+    *window=*) ;;
+    *) Q="$Q&window=600" ;;
+  esac
+fi
+
+if command -v curl >/dev/null 2>&1; then
+  curl -fsS "$Q" -o "$OUT"
+else
+  python3 -c "import sys, urllib.request; \
+sys.stdout.buffer.write(urllib.request.urlopen('$Q').read())" > "$OUT"
+fi
+
+LINES=$(wc -l < "$OUT")
+echo "flame.sh: $LINES collapsed stacks -> $OUT"
+echo "  render: flamegraph.pl $OUT > profile.svg"

@@ -78,6 +78,38 @@ def test_config_echoes_are_ignored():
     assert "parsed.tier3.sessions" in paths
 
 
+def test_profile_block_rules(tmp_path):
+    """ISSUE 13 satellite: the tier-3 `profile` block's diagnostics
+    (sampler bookkeeping, lock-wait totals, GC/compile tables,
+    top-frame shares) are advisory drift — never gated — while the
+    overhead proof's twin QPS numbers judge as throughput."""
+    old = {"tier3": {"profile": {
+        "qps_hz19": 40.0, "qps_ratio": 0.99, "top_share": 0.8,
+        "sampler": {"self_us": 1000, "ticks": 100},
+        "top_locks": [{"contended": 3, "wait_us": 9000}],
+        "gc": {"pause_us_total": 500},
+        "compiles": {"total_us": 100000},
+    }}}
+    new = json.loads(json.dumps(old))
+    p = new["tier3"]["profile"]
+    # wild diagnostic swings: all advisory
+    p["qps_ratio"] = 0.5
+    p["top_share"] = 0.1
+    p["sampler"]["self_us"] = 99999
+    p["top_locks"][0]["wait_us"] = 900000
+    p["gc"]["pause_us_total"] = 50000
+    p["compiles"]["total_us"] = 9999999
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(old))
+    b.write_text(json.dumps(new))
+    assert benchdiff.main([str(a), str(b)]) == 0
+    # ... but the profiled-twin QPS collapsing IS a regression
+    p["qps_hz19"] = 10.0
+    b.write_text(json.dumps(new))
+    assert benchdiff.main([str(a), str(b)]) == 1
+    assert benchdiff.main([str(a), str(b), "--advisory"]) == 0
+
+
 def test_custom_rule_wins(tmp_path):
     new = _new(parsed__value=50.0)
     r = benchdiff.compare(OLD, new)

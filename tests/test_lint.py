@@ -1,4 +1,4 @@
-"""nebula-lint (nebula_tpu/tools/lint): every rule NL001-NL007 proven
+"""nebula-lint (nebula_tpu/tools/lint): every rule NL001-NL008 proven
 LIVE on a minimal tripping snippet plus a negative twin, suppression
 and baseline semantics, and the full-tree gate — the committed tree
 must carry zero non-baselined findings."""
@@ -411,6 +411,54 @@ def test_baseline_is_a_multiset(tmp_path):
 
 # ------------------------------------------------------ full-tree gate
 
+# ---------------------------------------------------------------- NL008
+
+def test_nl008_trips_on_unnamed_thread_spawn(tmp_path):
+    fs, _ = lint(tmp_path, {"nebula_tpu/m.py": """
+        import threading
+        from nebula_tpu.common.threads import traced_thread
+
+        def spawn(fn):
+            threading.Thread(target=fn, daemon=True).start()
+            traced_thread(fn).start()
+    """}, ["NL008"])
+    assert codes(fs) == ["NL008", "NL008"]
+
+
+def test_nl008_named_spawn_clean_and_out_of_package_ignored(tmp_path):
+    fs, _ = lint(tmp_path, {
+        "nebula_tpu/m.py": """
+            import threading
+
+            def spawn(fn, i):
+                threading.Thread(target=fn, daemon=True,
+                                 name=f"worker-{i}").start()
+        """,
+        "scripts/x.py": """
+            import threading
+            threading.Thread(target=print).start()
+        """}, ["NL008"])
+    assert fs == []
+
+
+def test_nl004_profiler_family_kinds_pinned(tmp_path):
+    """lock.wait_us.* / graph.gc.* / tpu_engine.compile_us are
+    contractually native histograms (the continuous-profiling metric
+    families) — a site declaring any other kind is a finding even
+    through an f-string name."""
+    fs, _ = lint(tmp_path, {"nebula_tpu/m.py": """
+        from nebula_tpu.common.stats import stats
+
+        def feed(site, us):
+            stats.add_value(f"lock.wait_us.{site}", us, kind="timing")
+            stats.add_value("graph.gc.pause_us", us, kind="counter")
+            stats.add_value("tpu_engine.compile_us", us,
+                            kind="histogram")
+    """}, ["NL004"])
+    assert codes(fs) == ["NL004", "NL004"]
+    assert all("contractually" in f.message for f in fs)
+
+
 def test_full_tree_has_zero_non_baselined_findings():
     """THE gate: the committed tree, scanned with every rule, carries
     no finding that is neither inline-suppressed (with a reason) nor
@@ -427,6 +475,6 @@ def test_full_tree_has_zero_non_baselined_findings():
 
 
 def test_rule_catalog_complete():
-    assert sorted(RULES) == [f"NL00{i}" for i in range(1, 8)]
+    assert sorted(RULES) == [f"NL00{i}" for i in range(1, 9)]
     for code, r in RULES.items():
         assert r.title and r.doc, f"{code} must carry title + doc"

@@ -180,6 +180,105 @@ def test_three_daemon_metrics_conformance():
         metad.stop()
 
 
+def test_profiling_families_conformance_and_federation():
+    """ISSUE 13 satellite: the continuous-profiling metric families —
+    nebula_lock_wait_us_* acquire-wait histograms, the
+    nebula_graph_gc_pause_us GC histogram and the
+    nebula_tpu_engine_compile_us XLA-compile histogram — parse
+    STRICTLY on all three daemons' /metrics, and federate through
+    graphd's /cluster_metrics where the parser's per-label-series
+    validation checks each instance's complete bucket ladder."""
+    import gc as _gc
+    import threading as _threading
+    from nebula_tpu.client import GraphClient
+    from nebula_tpu.common import profiler as _prof
+    from nebula_tpu.daemons import (serve_graphd, serve_metad,
+                                    serve_storaged)
+    from nebula_tpu.engine_tpu import TpuGraphEngine
+
+    metad = serve_metad(ws_port=0)
+    storaged = serve_storaged(metad.addr, load_interval=0.1, ws_port=0)
+    tpu = TpuGraphEngine()
+    graphd = serve_graphd(metad.addr, tpu_engine=tpu, ws_port=0)
+    try:
+        gc = GraphClient(graphd.addr).connect()
+        for s in ("CREATE SPACE omprof(partition_num=2)", "USE omprof",
+                  "CREATE TAG t(x int)", "CREATE EDGE e(w int)",
+                  "INSERT VERTEX t(x) VALUES 1:(5), 2:(6), 3:(7)",
+                  "INSERT EDGE e(w) VALUES 1 -> 2:(3), 2 -> 3:(4)"):
+            r = gc.execute(s)
+            assert r.ok(), (s, r.error_msg)
+        q = "GO 2 STEPS FROM 1 OVER e YIELD e.w AS w"
+        for _ in range(20):
+            if gc.execute(q).rows:
+                break
+            time.sleep(0.05)
+        # deterministic instrument activity: one contended acquire on
+        # a profiled lock, one full GC pass (the webservice armed the
+        # GC callbacks at boot), one noted compile (prewarm usually
+        # supplies real ones, but a race-free family is the contract
+        # under test, not prewarm timing)
+        lk = _prof.profiled_lock("scrape_probe")
+
+        def hold():
+            with lk:
+                time.sleep(0.05)
+
+        ht = _threading.Thread(target=hold, name="scrape-holder",
+                               daemon=True)
+        ht.start()
+        time.sleep(0.01)
+        with lk:
+            pass
+        ht.join()
+        _gc.collect()
+        _prof.compiles.note("scrape-probe-sig", 1234)
+
+        families = ("nebula_lock_wait_us_scrape_probe",
+                    "nebula_graph_gc_pause_us",
+                    "nebula_tpu_engine_compile_us")
+        # the daemons share the process StatsManager, so every role's
+        # exposition must carry the families — and parse strictly
+        for port, daemon in ((graphd.ws_port, "graphd"),
+                             (storaged.ws_port, "storaged"),
+                             (metad.ws_port, "metad")):
+            fams = parse(_scrape(port))
+            for fam in families:
+                assert fam in fams, (daemon, fam)
+                assert fams[fam].type == "histogram", (daemon, fam)
+                count = [s for s in fams[fam].samples
+                         if s.name == fam + "_count"][0]
+                assert count.value >= 1, (daemon, fam)
+        # graphd also carries the serve-path lock sites + the
+        # device-memory ledger gauges next to them
+        gfams = parse(_scrape(graphd.ws_port))
+        assert gfams["nebula_tpu_engine_device_mem_bytes"] \
+            .samples[0].value > 0
+        assert "nebula_tpu_engine_device_mem_snapshots" in gfams
+        # federation: /cluster_metrics merges all three roles; the
+        # strict parser validates each instance's bucket ladder per
+        # label series (label-series validation)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{graphd.ws_port}/cluster_metrics"
+                ) as r:
+            doc = r.read().decode()
+        cfams = parse(doc)
+        for fam in families:
+            assert fam in cfams, fam
+            counts = [s for s in cfams[fam].samples
+                      if s.name == fam + "_count"]
+            # one complete label series per daemon instance
+            assert len(counts) == 3, (fam, [s.labels for s in counts])
+            roles = {s.labels.get("role") for s in counts}
+            assert roles == {"graph", "storage", "meta"}, roles
+            instances = {s.labels.get("instance") for s in counts}
+            assert len(instances) == 3, instances
+    finally:
+        graphd.stop()
+        storaged.stop()
+        metad.stop()
+
+
 def test_flight_and_slo_endpoints_serve_on_every_daemon():
     """/flight and /slo are WebService built-ins: every daemon serves
     them (the recorder/engine are process-global, like the tracer)."""

@@ -749,3 +749,121 @@ def test_cost_ledger_and_cluster_metrics_3daemon():
         assert snap.sum("nebula_graph_query_total") > 0
     finally:
         graphd.stop(); storaged.stop(); metad.stop()
+
+
+def test_profile_endpoints_3daemon():
+    """Acceptance (ISSUE 13): /profile, /profile?locks=1 and
+    /profile?compiles=1 serve end-to-end on graphd + storaged + metad;
+    the always-on sampler attributes self-time per named thread role,
+    ?format=collapsed emits flamegraph input, ?seconds=N captures on
+    demand, and the engine's serve path shows up in the lock table."""
+    import json as _json
+    import time as _time
+    import urllib.request
+    from nebula_tpu.client import GraphClient
+    from nebula_tpu.daemons import serve_graphd, serve_metad, serve_storaged
+    from nebula_tpu.engine_tpu import TpuGraphEngine
+
+    metad = serve_metad(ws_port=0)
+    storaged = serve_storaged(metad.addr, load_interval=0.1, ws_port=0)
+    tpu = TpuGraphEngine()
+    graphd = serve_graphd(metad.addr, tpu_engine=tpu, ws_port=0)
+
+    def http(port, path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}") as r:
+            ctype = r.headers.get("Content-Type", "")
+            body = r.read()
+            return (body if "json" not in ctype
+                    else _json.loads(body)), r.status
+
+    try:
+        gc = GraphClient(graphd.addr).connect()
+        for s in ("CREATE SPACE profspace(partition_num=2)",
+                  "USE profspace",
+                  "CREATE TAG t(x int)", "CREATE EDGE e(w int)",
+                  "INSERT VERTEX t(x) VALUES 1:(5), 2:(6), 3:(7)",
+                  "INSERT EDGE e(w) VALUES 1 -> 2:(3), 2 -> 3:(4)"):
+            r = gc.execute(s)
+            assert r.ok(), (s, r.error_msg)
+        q = "GO 2 STEPS FROM 1 OVER e YIELD e.w AS w"
+        for _ in range(20):
+            if gc.execute(q).rows:
+                break
+            _time.sleep(0.05)
+        # force the dense device dispatch (a 2-edge toy graph routes
+        # through the host sparse pull otherwise) and coalesce a
+        # window, so the fused-program registry compiles — the
+        # /profile?compiles=1 table's source
+        tpu.sparse_edge_budget = 0
+        import threading as _threading
+        gcs = [GraphClient(graphd.addr).connect() for _ in range(3)]
+        for c in gcs:
+            assert c.execute("USE profspace").ok()
+        from nebula_tpu.common import profiler as _prof
+        for _ in range(10):
+            ts = [_threading.Thread(target=lambda c=c: c.execute(q),
+                                    name="prof-e2e-worker")
+                  for c in gcs]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            if _prof.compiles.totals()["compiles"]:
+                break
+        # the sampler runs at profile_hz (armed by WebService.start);
+        # give it a few ticks so the window has samples to serve
+        deadline = _time.monotonic() + 5
+        body = None
+        while _time.monotonic() < deadline:
+            gc.execute(q)
+            body, st = http(graphd.ws_port, "/profile")
+            assert st == 200
+            if body["samples"] > 0 and body["frames"]:
+                break
+            _time.sleep(0.1)
+        assert body["samples"] > 0 and body["frames"], body["state"]
+        assert body["state"]["thread_alive"]
+        assert body["state"]["hz"] > 0
+        # per-role attribution: daemon threads carry stable role names
+        # (digit runs normalized; anonymous stdlib spawns resolve to
+        # their target hint) — never a bare Thread-N
+        assert body["threads"], body
+        assert not any(r == "Thread-N" for r in body["threads"]), \
+            body["threads"]
+        # the three surfaces serve on EVERY daemon
+        for port in (graphd.ws_port, storaged.ws_port, metad.ws_port):
+            j, st = http(port, "/profile")
+            assert st == 200 and "frames" in j and "state" in j
+            j, st = http(port, "/profile?locks=1")
+            assert st == 200 and isinstance(j["locks"], list)
+            j, st = http(port, "/profile?compiles=1")
+            assert st == 200 and "totals" in j
+        # the serve-path lock sites registered (engine snapshot lock,
+        # dispatcher cv wired through profiled locks at construction)
+        j, _ = http(graphd.ws_port, "/profile?locks=1")
+        names = {row["name"] for row in j["locks"]}
+        assert {"engine_snapshot", "dispatcher_cv"} & names or \
+            {"kv_part", "raft_part"} & names, names
+        # fused programs compiled for the GO path -> compile table
+        j, _ = http(graphd.ws_port, "/profile?compiles=1")
+        assert j["totals"]["compiles"] >= 1, j["totals"]
+        assert any(row["total_us"] > 0 for row in j["compiles"])
+        # collapsed flamegraph output: "role;frame;... count" lines
+        raw, st = http(graphd.ws_port, "/profile?format=collapsed")
+        assert st == 200
+        lines = [ln for ln in raw.decode().splitlines() if ln]
+        assert lines
+        stack, _, count = lines[0].rpartition(" ")
+        assert ";" in stack and int(count) > 0
+        # on-demand high-rate capture is bounded and private
+        j, st = http(graphd.ws_port, "/profile?seconds=0.2&hz=97")
+        assert st == 200 and j["samples"] > 0 and j["frames"]
+        # role filter narrows the aggregation
+        role = next(iter(body["threads"]))
+        j, st = http(graphd.ws_port,
+                     "/profile?thread=" + urllib.parse.quote(role))
+        assert st == 200
+        assert set(j["threads"]) <= {role}
+    finally:
+        graphd.stop(); storaged.stop(); metad.stop()
