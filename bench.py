@@ -710,6 +710,20 @@ def _obs_block(profile=True):
             "bundles": d["bundles"],
         },
     }
+    # workload & data observatory (ISSUE 14): per-space skew indices
+    # + the hottest parts at sample time (empty when heat disarmed)
+    from nebula_tpu.common import heat as _heat
+    pr = _heat.accountant.parts_snapshot()
+    pr.sort(key=lambda r: r["score_600s"], reverse=True)
+    out["heat"] = {
+        "enabled": _heat.enabled(),
+        "skew": {str(s): v["index"]
+                 for s, v in _heat.accountant.skew_indices().items()},
+        "parts_tracked": len(pr),
+        "top_parts": [{"space": r["space"], "part": r["part"],
+                       "score_600s": r["score_600s"]}
+                      for r in pr[:4]],
+    }
     if profile:
         top = _prof.profiler.top(window=600, n=10)
         out["profile"] = {
@@ -1139,6 +1153,286 @@ def _witness_summary() -> dict:
     (docs/manual/15-static-analysis.md#witness)."""
     from nebula_tpu.common.lockwitness import witness
     return witness.summary()
+
+
+def bench_skew(out_path: str, trim: bool = False):
+    """Workload & data observatory proof tier (`bench.py --skew`;
+    docs/manual/10-observability.md, "Workload & data observatory").
+    Tier-1-safe on XLA:CPU, no accelerator / native engine. PASSES
+    only when
+
+      (a) DISARMED IS FREE: with heat_enabled=false an entire warm
+          query loop leaves zero heat slabs, zero nebula_part_heat_*/
+          nebula_heat_* families on the metrics surface (byte-
+          identical /metrics), and zero sketch state;
+      (b) SKETCH RECALL: the space-saving hot-vertex sketch's top-K
+          over a Zipf start-vid stream recalls >= 0.9 of the ground-
+          truth top-K the bench itself counted;
+      (c) SKEW INDEX SEPARATES: the per-space p99/mean part-heat
+          index reads ~1 under uniform starts and >= 1.5x that under
+          Zipf starts (same graph, same query shape);
+      (d) HOT_PART FIRES: with heat_hot_part_pct armed below the
+          measured dominant-part share, the flight recorder captures
+          a hot_part-triggered bundle embedding the /heat view;
+      (e) ADVISOR REDUCES SPREAD: on a deliberately skewed 3-host
+          layout fed through REAL heartbeats, the heat-aware BALANCE
+          advisor's modeled plan strictly reduces the per-host heat
+          spread (and moves leadership toward replica holders);
+      (f) OVERHEAD: armed-vs-disarmed interleaved QPS ratio recorded;
+          full runs gate it within the PR 13 3% contract.
+    """
+    from nebula_tpu.cluster import InProcCluster
+    from nebula_tpu.common import heat as heat_mod
+    from nebula_tpu.common.flags import graph_flags, storage_flags
+    from nebula_tpu.common.flight import recorder as flight_rec
+    from nebula_tpu.common.stats import stats as global_stats
+    from nebula_tpu.engine_tpu import TpuGraphEngine
+
+    seed = int(os.environ.get("BENCH_SKEW_SEED", 13))
+    parts = 8
+    v, e = (400, 3000) if trim else (2000, 16000)
+    n_uniform, n_zipf = (240, 320) if trim else (1200, 1600)
+    rng = np.random.default_rng(seed)
+    gates: dict = {}
+    art: dict = {"seed": seed,
+                 "graph": {"V": v, "E": e, "parts": parts},
+                 "trim": trim}
+
+    def heat_metric_lines():
+        # every family the observatory would add to /metrics: the
+        # accountant's gauge source + any heat.*/staleness stats
+        # families (the WebService renders exactly these)
+        lines = [ln for ln in global_stats.prometheus_lines()
+                 if "nebula_heat_" in ln or "part_heat" in ln
+                 or "staleness" in ln]
+        return lines, heat_mod.accountant.gauges()
+
+    # ---- phase 0: DISARMED — the whole loop must leave no trace
+    heat_mod.accountant.reset()
+    flight_rec.reset()
+    graph_flags.set("heat_enabled", False)
+    storage_flags.set("heat_enabled", False)
+    graph_flags.set("heat_vertices_k", 64)   # k armed but heat off:
+    storage_flags.set("heat_vertices_k", 64)  # master flag wins
+    tpu = TpuGraphEngine()
+    cluster = InProcCluster(tpu_engine=tpu)
+    conn = cluster.connect()
+    srcs, dsts, ts = zipf_edges(rng, v, e, clip=120)
+    insert_person_knows(conn, "skew", parts, v, srcs, dsts, ts)
+    sid = cluster.meta.get_space("skew").value().space_id
+    tpu.prewarm(sid, block=True)
+
+    def go(start, steps=2):
+        return conn.must(f"GO {steps} STEPS FROM {int(start)} "
+                         f"OVER knows YIELD knows._dst")
+
+    warm = rng.integers(0, v, 32)
+    for s in warm:
+        go(s)
+    lines0, gauges0 = heat_metric_lines()
+    gates["disarmed_no_metric_families"] = lines0 == []
+    gates["disarmed_no_gauges"] = gauges0 == {}
+    gates["disarmed_no_slabs"] = \
+        heat_mod.accountant.parts_snapshot() == []
+    gates["disarmed_no_sketch"] = \
+        heat_mod.accountant.sketch(sid) is None
+    art["disarmed"] = {"metric_lines": len(lines0),
+                       "gauges": len(gauges0)}
+
+    # ---- overhead: interleaved disarmed/armed passes on the same
+    # warm engine (the PR 13 qps_hz0/qps_hz19 idiom)
+    per_pass = 40 if trim else 150
+    passes_off: list = []
+    passes_on: list = []
+    starts_oh = rng.integers(0, v, per_pass)
+    for _ in range(3 if trim else 5):
+        # BOTH registries every toggle: heat._flag takes the first
+        # non-default value across them, so a lone graph-side True
+        # (== default, skipped) with storage still False would leave
+        # the "armed" pass actually disarmed
+        graph_flags.set("heat_enabled", False)
+        storage_flags.set("heat_enabled", False)
+        assert not heat_mod.enabled()
+        t0 = time.perf_counter()
+        for s in starts_oh:
+            go(s)
+        passes_off.append(time.perf_counter() - t0)
+        graph_flags.set("heat_enabled", True)
+        storage_flags.set("heat_enabled", True)
+        assert heat_mod.enabled()
+        t0 = time.perf_counter()
+        for s in starts_oh:
+            go(s)
+        passes_on.append(time.perf_counter() - t0)
+    # the A/B ratio (median of per-pair ratios, drift cancels within a
+    # pair) is RECORDED for the artifact — but at ~200ms passes it
+    # carries +-5% box noise, far above the ~1% true cost, so the 3%
+    # contract is GATED on the deterministic measurement instead: the
+    # armed seam's own per-query cost (observe_query + charge_device
+    # + restore, exactly what a device-served GO pays) against the
+    # workload's measured per-query latency (the PR 13 idiom — the
+    # profiler gates its sampler's measured overhead, not an
+    # end-to-end QPS ratio it can't measure above the noise floor)
+    pair_ratios = sorted(off / on for off, on
+                         in zip(passes_off, passes_on))
+    ratio = pair_ratios[len(pair_ratios) // 2]
+    qps_off = per_pass / min(passes_off)
+    qps_on = per_pass / min(passes_on)
+    n_seam = 4000
+
+    def seam_cost(starts_shape):
+        t0 = time.perf_counter()
+        for _ in range(n_seam):
+            tok = heat_mod.observe_query(sid, starts_shape, parts)
+            heat_mod.charge_device(1500.0)
+            heat_mod.restore(tok)
+        return (time.perf_counter() - t0) / n_seam * 1e6
+    # gate like-for-like: the measured workload is single-start GOs,
+    # so the gated seam runs the same shape; the 8-start variant
+    # (wide piped frontiers) is recorded as information
+    seam_us = seam_cost([int(starts_oh[0])])
+    seam_us_8 = seam_cost([int(x) for x in starts_oh[:8]])
+    query_us = min(passes_on) / per_pass * 1e6
+    seam_frac = seam_us / query_us
+    art["overhead"] = {"qps_disarmed": round(qps_off, 1),
+                       "qps_armed": round(qps_on, 1),
+                       "ratio": round(ratio, 4),
+                       "seam_us_per_query": round(seam_us, 2),
+                       "seam_us_8start": round(seam_us_8, 2),
+                       "query_us": round(query_us, 1),
+                       "seam_frac": round(seam_frac, 4)}
+    gates["overhead_within_contract"] = seam_frac <= 0.03
+
+    # ---- phase 1: ARMED, uniform starts -> skew index ~ 1
+    graph_flags.set("heat_enabled", True)
+    storage_flags.set("heat_enabled", True)
+    heat_mod.accountant.reset()
+    for s in rng.integers(0, v, n_uniform):
+        go(s)
+    skew_u = heat_mod.accountant.skew_index(sid, window=600)
+    art["skew_index"] = {"uniform": skew_u["index"],
+                         "uniform_detail": skew_u}
+
+    # ---- phase 2: ARMED, Zipf starts -> sketch recall + skew index
+    heat_mod.accountant.reset()
+    alpha = 1.25
+    draws = rng.zipf(alpha, n_zipf * 4)
+    draws = draws[draws <= v][:n_zipf]
+    # map rank r -> a scattered vid (rank-1 vids would all be tiny and
+    # co-located; the affine map spreads hubs across parts while
+    # keeping the draw<->vid mapping deterministic)
+    vids = [(int(r) * 131 + 7) % v for r in draws]
+    truth: dict = {}
+    for x in vids:
+        truth[x] = truth.get(x, 0) + 1
+    for x in vids:
+        go(x)
+    skew_z = heat_mod.accountant.skew_index(sid, window=600)
+    art["skew_index"]["zipf"] = skew_z["index"]
+    art["skew_index"]["zipf_detail"] = skew_z
+    sep = skew_z["index"] / max(skew_u["index"], 1e-9)
+    art["skew_index"]["separation"] = round(sep, 3)
+    gates["skew_separates"] = sep >= 1.5 and skew_z["index"] > 1.2
+
+    K = 10
+    true_top = [x for x, _ in sorted(truth.items(),
+                                     key=lambda kv: kv[1],
+                                     reverse=True)[:K]]
+    sk = heat_mod.accountant.sketch(sid)
+    gates["sketch_exists"] = sk is not None
+    est_top = [int(r["vid"]) for r in (sk.topk(K) if sk else [])]
+    recall = len(set(true_top) & set(est_top)) / K
+    art["sketch"] = {
+        "k": sk.k if sk else 0, "recall": round(recall, 3),
+        "tracked": len(sk.counts) if sk else 0,
+        "evictions": sk.evictions if sk else 0,
+        "true_topk": true_top, "est_topk": est_top,
+    }
+    gates["sketch_recall"] = recall >= 0.9
+    gates["sketch_cardinality_cap"] = \
+        sk is not None and len(sk.counts) <= sk.k
+
+    # ---- phase 2b: hot_part flight trigger, armed just under the
+    # measured dominant-part share (testing the plumbing, not the
+    # threshold choice)
+    scores = heat_mod.accountant.space_scores(600).get(sid, {})
+    total = sum(scores.values()) or 1.0
+    top_share = 100.0 * max(scores.values()) / total
+    pct = max(5.0, top_share - 5.0)
+    graph_flags.set("heat_hot_part_pct", pct)
+    heat_mod.accountant.check_hot_part(sid)
+    flight_rec.flush()
+    fired = [b for b in flight_rec.bundles
+             if b["trigger"] == "hot_part"]
+    gates["hot_part_bundle"] = bool(
+        fired and fired[-1].get("collectors", {}).get("heat"))
+    art["hot_part"] = {"top_share_pct": round(top_share, 1),
+                       "armed_pct": round(pct, 1),
+                       "bundles": len(fired)}
+    graph_flags.set("heat_hot_part_pct", 0)
+
+    # ---- phase 3: the heat-aware BALANCE advisor on a deliberately
+    # skewed 3-host layout, fed through REAL heartbeats (the exact
+    # storaged -> metad carry path)
+    from nebula_tpu.meta.balancer import Balancer
+    from nebula_tpu.meta.service import MetaService
+    meta2 = MetaService(expired_threshold_secs=3600)
+    hosts3 = ["10.0.0.1:1", "10.0.0.2:1", "10.0.0.3:1"]
+    for h in hosts3:
+        meta2.heartbeat(h, "storage")
+    sid2 = meta2.create_space("hot", partition_num=6,
+                              replica_factor=2).value()
+    alloc = meta2.get_parts_alloc(sid2)
+    # every part's first replica leads; host 1 deliberately leads the
+    # hot parts (a zipf score ladder)
+    leaders = {p: hs[0] for p, hs in alloc.items()}
+    ladder = [100.0, 60.0, 8.0, 4.0, 2.0, 1.0]
+    hot_host = leaders[sorted(alloc)[0]]
+    score_of_part = {}
+    hot_rank = 0
+    cold_rank = len(ladder) - 1
+    for p in sorted(alloc):
+        if leaders[p] == hot_host:
+            score_of_part[p] = ladder[hot_rank]
+            hot_rank += 1
+        else:
+            score_of_part[p] = ladder[cold_rank]
+            cold_rank -= 1
+    for h in hosts3:
+        led = sorted(p for p, l in leaders.items() if l == h)
+        payload = {"parts": {sid2: {
+            p: {"score": score_of_part[p], "reads": score_of_part[p]}
+            for p in led}}}
+        meta2.heartbeat(h, "storage", leader_parts={sid2: led},
+                        part_heat=payload)
+    bal = Balancer(meta2, admin=None)
+    meta2.attach_balancer(bal)
+    advise = meta2.balance_advise_heat().value()
+    art["advisor"] = advise
+    gates["advisor_reduces_spread"] = bool(
+        advise["spread_after"] < advise["spread_before"]
+        and advise["moves"])
+    gates["advisor_moves_wellformed"] = all(
+        m["kind"] in ("leader", "move") and m["src"] != m["dst"]
+        and m["score"] > 0 for m in advise["moves"])
+
+    # ---- artifact + verdict (_obs_block supplies the compact `heat`
+    # block every tier carries; `heat_detail` is this tier's full view)
+    art["heat_detail"] = heat_mod.accountant.describe(vertices=False)
+    art.update(_obs_block(profile=False))
+    art["gates"] = gates
+    art["ok"] = all(bool(x) for x in gates.values())
+    with open(out_path, "w") as f:
+        json.dump(art, f, indent=1, default=str)
+    log(f"SKEW tier: {json.dumps(gates)}")
+    log(f"skew index uniform={skew_u['index']} zipf={skew_z['index']} "
+        f"recall={recall} advisor spread "
+        f"{advise['spread_before']} -> {advise['spread_after']} "
+        f"overhead ratio={ratio:.4f}")
+    log(f"wrote {out_path}")
+    if not art["ok"]:
+        failed = [k for k, ok in gates.items() if not ok]
+        raise SystemExit(f"SKEW tier FAILED gates: {failed}")
 
 
 def bench_chaos(out_path: str, trim: bool = False):
@@ -2702,6 +2996,13 @@ def main():
             if a.startswith("--out="):
                 out = a.split("=", 1)[1]
         bench_crash(out, trim="--trim" in sys.argv)
+        return
+    if "--skew" in sys.argv:
+        out = os.environ.get("BENCH_SKEW_OUT", "SKEW_bench.json")
+        for a in sys.argv:
+            if a.startswith("--out="):
+                out = a.split("=", 1)[1]
+        bench_skew(out, trim="--trim" in sys.argv)
         return
     if "--cache-smoke" in sys.argv:
         out = os.environ.get("BENCH_CACHE_OUT", "CACHE_smoke.json")

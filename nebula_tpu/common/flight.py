@@ -34,6 +34,10 @@ Trigger catalog (docs/manual/10-observability.md):
   leader_churn      >= 3 ``leader_change`` in 10 s
   shed_storm        >= 20 ``shed``/``admission_denied`` in 5 s
   deadline_storm    >= 10 ``deadline_balk`` in 5 s
+  hot_part          any ``hot_part`` event (common/heat.py, gated by
+                    ``heat_hot_part_pct``)
+  staleness_breach  any ``staleness_breach`` event (kvstore/raftex,
+                    gated by ``staleness_breach_ms``)
 
 Each fire is rate-limited by ``flight_cooldown_s`` per rule, so a
 storm produces one bundle, not hundreds.
@@ -108,6 +112,12 @@ def _default_rules() -> List[TriggerRule]:
         TriggerRule("leader_churn", ("leader_change",), 3, 10.0),
         TriggerRule("shed_storm", ("shed", "admission_denied"), 20, 5.0),
         TriggerRule("deadline_storm", ("deadline_balk",), 10, 5.0),
+        # workload & data observatory (common/heat.py): a part
+        # dominating its space's heat / a follower past the staleness
+        # bound — both flag-gated at the recording site, immediate
+        # here, rate-limited by the per-rule cooldown
+        TriggerRule("hot_part", ("hot_part",)),
+        TriggerRule("staleness_breach", ("staleness_breach",)),
     ]
 
 

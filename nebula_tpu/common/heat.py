@@ -1,0 +1,599 @@
+"""Workload & data observatory: per-part heat accounting, hot-vertex
+sketches and skew indices (docs/manual/10-observability.md, "Workload
+& data observatory").
+
+PRs 10-13 made the *process* observable; the *data and workload* were
+still dark: nothing could answer "which parts are hot, which vertices
+are hubs, how stale is each follower, where should data live?" — the
+inputs placement decisions (ROADMAP items 1/2/5) need. This module is
+the shared core all three daemons feed:
+
+PART HEAT SLABS — per-(space, part) accumulators with 60 s / 600 s
+rolling windows plus lifetime totals, charged at the seams that
+already charge the PR 12 cost ledger:
+
+  reads / rows_scanned / bytes_returned   storage/processors.py
+                                          (server-side, real parts)
+  writes                                  storage/processors.py
+                                          mutation handlers, per part
+  device_us                               TpuGraphEngine._record_profile
+                                          (graphd; attributed to the
+                                          parts of the serving query's
+                                          start vids — coalesced-window
+                                          riders land on the LEADER's
+                                          parts, same attributed-time
+                                          discipline as the ledger)
+  raft_appends                            kvstore/raftex/raft_part.py
+                                          leader append path
+
+One scalar HEAT SCORE (documented weights below) ranks parts/hosts;
+the per-space SKEW INDEX is the p99-to-mean score ratio across that
+space's parts — ~1.0 under uniform load, growing with concentration —
+an SLO-able gauge (`nebula_heat_skew_index_s<sid>`).
+
+HOT-VERTEX SKETCH — a bounded space-saving top-K sketch per space over
+frontier start vids (graphd) + scanned src vids (storaged). Classic
+Metwally et al. guarantees: with K counters over N observations every
+reported count overestimates by at most its recorded `err`, and any
+vid with true frequency > N/K is present. Disarmed (heat_vertices_k=0,
+the default) the observe path is a single flag read.
+
+Steady-state cost when armed: dict lookup + float adds under a
+per-slab lock per charge; the whole observatory disarms via the
+MUTABLE `heat_enabled` flag — disarmed, every charge site is one flag
+read and /metrics is byte-identical to a heat-free build (the
+profile_hz=0 idiom).
+
+FLIGHT TRIGGERS — a part drawing more than `heat_hot_part_pct` percent
+of its space's 60 s heat (flag-gated, time-throttled evaluation)
+records a `hot_part` event; kvstore/raftex records `staleness_breach`
+past `staleness_breach_ms`. Both are immediate flight-recorder rules,
+and every bundle embeds the /heat capture via the collector registered
+below.
+"""
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .flags import MUTABLE, graph_flags, meta_flags, storage_flags
+from .stats import stats as global_stats
+
+# accounting fields, the order /heat and the heartbeat payload use
+FIELDS: Tuple[str, ...] = ("reads", "writes", "rows_scanned",
+                           "bytes_returned", "device_us",
+                           "raft_appends")
+
+# heat-score weights: one scalar so parts/hosts rank on a single axis.
+# Reads and writes count as one unit of serving work; bulk byte/row/
+# microsecond streams are scaled so one "unit" is roughly one row-level
+# storage touch (100 rows scanned ~ 1 read, 4 KiB returned ~ 1 read,
+# 1 ms of device time ~ 1 read, 1 raft append ~ 1 write).
+SCORE_WEIGHTS: Dict[str, float] = {
+    "reads": 1.0,
+    "writes": 2.0,
+    "rows_scanned": 0.01,
+    "bytes_returned": 1.0 / 4096.0,
+    "device_us": 0.001,
+    "raft_appends": 2.0,
+}
+
+# rolling-window geometry: 60 buckets of 10 s = 600 s of history; the
+# 60 s window reads the newest 6 buckets
+BUCKET_SECS = 10
+N_BUCKETS = 60
+WINDOWS = (60, 600)
+
+# at most this many distinct start vids are part-attributed per query
+# (a piped GO can fan out thousands; the sample keeps entry-seam cost
+# bounded while the part histogram stays representative)
+QUERY_PART_SAMPLE = 128
+
+# hot-part evaluation is time-throttled per space: the trigger check
+# is O(parts), so it runs at most once per this many seconds per space
+HOT_PART_CHECK_SECS = 5.0
+# a space must carry at least this much 60s heat before a dominant
+# part is an anomaly (an idle space's single touched part is 100%)
+HOT_PART_MIN_SCORE = 50.0
+
+# every daemon charges heat and serves /heat knobs via its OWN /flags
+# registry (the flight/profiler multi-registry idiom)
+_REGISTRIES = (graph_flags, storage_flags, meta_flags)
+for _reg in _REGISTRIES:
+    _reg.declare(
+        "heat_enabled", True, MUTABLE,
+        "workload & data observatory master switch: per-(space,part) "
+        "heat accounting (/heat, nebula_part_heat_* families), "
+        "heartbeat-carried placement telemetry AND the replica-"
+        "staleness metric families (nebula_raftex_staleness_ms + "
+        "per-part gauges; the /raft watermarks themselves stay); "
+        "off = every charge site is one flag read and /metrics is "
+        "byte-identical to a heat-free build")
+    _reg.declare(
+        "heat_vertices_k", 0, MUTABLE,
+        "hot-vertex space-saving sketch size per space (top-K over "
+        "frontier start vids + scanned src vids; /heat?vertices=1); "
+        "0 disarms the sketch entirely (one flag read per query)")
+    _reg.declare(
+        "heat_hot_part_pct", 0, MUTABLE,
+        "flight-recorder hot_part trigger: fire when one part draws "
+        "more than this percent of its space's 60s heat (evaluated at "
+        "most every 5s per space); 0 disarms")
+    _reg.declare(
+        "staleness_breach_ms", 0, MUTABLE,
+        "flight-recorder staleness_breach trigger: a follower whose "
+        "estimated replica staleness exceeds this many ms records a "
+        "breach event on the leader (kvstore/raftex); 0 disarms")
+
+
+def _flag(name: str, default):
+    """First non-default value across the registries (graph first) —
+    the flight-recorder idiom: a daemon process sets only its own
+    registry over HTTP, in-process harnesses use graph_flags."""
+    for reg in _REGISTRIES:
+        v = reg.get(name, default)
+        if v is not None and v != default:
+            return v
+    return default
+
+
+def enabled() -> bool:
+    return bool(_flag("heat_enabled", True))
+
+
+def score_of(fields: Dict[str, float]) -> float:
+    return sum(SCORE_WEIGHTS[f] * fields.get(f, 0.0) for f in FIELDS)
+
+
+# field name -> slab index (hot charge path — FIELDS.index is O(n))
+_FIDX: Dict[str, int] = {f: i for i, f in enumerate(FIELDS)}
+
+
+class SpaceSaving:
+    """Bounded space-saving top-K frequency sketch (Metwally et al.):
+    `k` counters, each (count, err). On overflow the minimum counter
+    is evicted and the newcomer inherits its count as both floor and
+    error bound — every reported count is within `err` of truth, and
+    any item with true frequency > total/k is guaranteed present.
+
+    Eviction finds the minimum through a lazy-deletion heap of
+    (count, vid) entries (stale entries skipped on pop, heap rebuilt
+    past 4k entries) — O(log k) amortized per unseen vid instead of
+    an O(k) min() scan under the per-space lock every serving thread
+    shares (a cold high-cardinality scan stream evicts on every
+    observation)."""
+
+    __slots__ = ("k", "counts", "total", "evictions", "_lock", "_heap")
+
+    def __init__(self, k: int):
+        self.k = max(int(k), 1)
+        # vid -> [count, err]
+        self.counts: Dict[int, List[float]] = {}
+        self.total = 0.0
+        self.evictions = 0
+        self._lock = threading.Lock()
+        self._heap: List[Tuple[float, int]] = []
+
+    def observe(self, vid: int, w: float = 1.0) -> None:
+        with self._lock:
+            self._observe_locked(int(vid), float(w))
+
+    def observe_many(self, vids: Sequence[int], w: float = 1.0) -> None:
+        with self._lock:
+            for v in vids:
+                self._observe_locked(int(v), float(w))
+
+    def _observe_locked(self, vid: int, w: float) -> None:
+        import heapq
+        self.total += w
+        c = self.counts.get(vid)
+        if c is not None:
+            c[0] += w
+            heapq.heappush(self._heap, (c[0], vid))
+            return
+        if len(self.counts) < self.k:
+            self.counts[vid] = [w, 0.0]
+            heapq.heappush(self._heap, (w, vid))
+            return
+        # evict the minimum counter; the newcomer inherits its count
+        # (cardinality cap: the dict NEVER exceeds k entries). Heap
+        # entries are stale once their counter was bumped or evicted
+        # — the top is valid only when it matches the live count.
+        mc = None
+        while self._heap:
+            hc, hv = self._heap[0]
+            cur = self.counts.get(hv)
+            if cur is not None and cur[0] == hc:
+                heapq.heappop(self._heap)
+                del self.counts[hv]
+                mc = hc
+                break
+            heapq.heappop(self._heap)
+        if mc is None:      # heap starved (all stale): full rescan
+            hv = min(self.counts, key=lambda x: self.counts[x][0])
+            mc = self.counts.pop(hv)[0]
+        self.counts[vid] = [mc + w, mc]
+        heapq.heappush(self._heap, (mc + w, vid))
+        self.evictions += 1
+        if len(self._heap) > 4 * self.k:
+            self._heap = [(c[0], v) for v, c in self.counts.items()]
+            heapq.heapify(self._heap)
+
+    def topk(self, n: Optional[int] = None) -> List[Dict[str, float]]:
+        with self._lock:
+            items = sorted(self.counts.items(),
+                           key=lambda kv: kv[1][0], reverse=True)
+        if n is not None:
+            items = items[:int(n)]
+        return [{"vid": v, "count": c[0], "err": c[1]} for v, c in items]
+
+    def describe(self) -> Dict[str, Any]:
+        return {"k": self.k, "tracked": len(self.counts),
+                "total": self.total, "evictions": self.evictions,
+                "top": self.topk(16)}
+
+
+class _Slab:
+    """One (space, part)'s accumulators: lifetime totals + a ring of
+    10 s buckets covering 600 s, advanced lazily on charge/read."""
+
+    __slots__ = ("lock", "life", "ring", "head")
+
+    def __init__(self, now_bucket: int):
+        self.lock = threading.Lock()
+        self.life = [0.0] * len(FIELDS)
+        self.ring = [None] * N_BUCKETS     # lazily allocated lists
+        self.head = now_bucket
+
+    def _advance(self, now_bucket: int) -> None:
+        gap = now_bucket - self.head
+        if gap <= 0:
+            return
+        for k in range(1, min(gap, N_BUCKETS) + 1):
+            self.ring[(self.head + k) % N_BUCKETS] = None
+        self.head = now_bucket
+
+    def add(self, now_bucket: int, idx_vals) -> None:
+        with self.lock:
+            self._advance(now_bucket)
+            b = self.ring[now_bucket % N_BUCKETS]
+            if b is None:
+                b = self.ring[now_bucket % N_BUCKETS] = [0.0] * len(FIELDS)
+            for i, v in idx_vals:
+                b[i] += v
+                self.life[i] += v
+
+    def window(self, now_bucket: int, secs: int) -> List[float]:
+        n = max(1, min(secs // BUCKET_SECS, N_BUCKETS))
+        out = [0.0] * len(FIELDS)
+        with self.lock:
+            self._advance(now_bucket)
+            for k in range(n):
+                b = self.ring[(now_bucket - k) % N_BUCKETS]
+                if b is None:
+                    continue
+                for i in range(len(FIELDS)):
+                    out[i] += b[i]
+        return out
+
+    def lifetime(self) -> List[float]:
+        with self.lock:
+            return list(self.life)
+
+
+class HeatAccountant:
+    """Process-global heat registry (instantiable for tests): slabs
+    per (space, part), hot-vertex sketches per space, and the derived
+    skew / hot-part / heartbeat / Prometheus views."""
+
+    def __init__(self, clock=time.time):
+        self._clock = clock
+        self._slabs: Dict[Tuple[int, int], _Slab] = {}
+        self._sketches: Dict[int, SpaceSaving] = {}
+        self._lock = threading.Lock()       # slab/sketch creation only
+        self._hot_checked: Dict[int, float] = {}
+
+    # ------------------------------------------------------------ charge
+    def _slab(self, space: int, part: int) -> _Slab:
+        key = (int(space), int(part))
+        s = self._slabs.get(key)
+        if s is None:
+            with self._lock:
+                s = self._slabs.setdefault(
+                    key, _Slab(int(self._clock()) // BUCKET_SECS))
+        return s
+
+    def charge(self, space: int, part: int, **fields: float) -> None:
+        """Bump one part's slab (one flag read when disarmed)."""
+        if not enabled():
+            return
+        now = self._clock()
+        iv = [(_FIDX[f], float(v)) for f, v in fields.items() if v]
+        if iv:
+            self._slab(space, part).add(int(now) // BUCKET_SECS, iv)
+            self._maybe_hot_part(int(space), now)
+
+    def charge_parts(self, space: int, parts: Sequence[int],
+                     **fields: float) -> None:
+        """Split a charge evenly across `parts` (device-time
+        attribution from a query's start-vid parts)."""
+        if not parts or not enabled():
+            return
+        share = 1.0 / len(parts)
+        now = self._clock()
+        nb = int(now) // BUCKET_SECS
+        iv = [(_FIDX[f], float(v) * share)
+              for f, v in fields.items() if v]
+        if iv:
+            for p in parts:
+                self._slab(space, p).add(nb, iv)
+        self._maybe_hot_part(int(space), now)
+
+    # ------------------------------------------------- hot-vertex sketch
+    def observe_vids(self, space: int, vids: Sequence[int]) -> None:
+        """Feed the per-space sketch (frontier start vids on graphd,
+        scanned src vids on storaged). Disarmed (heat_vertices_k=0 or
+        heat off) this is one or two flag reads and no allocation."""
+        k = int(_flag("heat_vertices_k", 0) or 0)
+        if k <= 0 or not vids or not enabled():
+            return
+        sk = self._sketches.get(int(space))
+        if sk is None or sk.k != k:
+            with self._lock:
+                sk = self._sketches.get(int(space))
+                if sk is None or sk.k != k:
+                    sk = self._sketches[int(space)] = SpaceSaving(k)
+        sk.observe_many(vids)
+        global_stats.add_value("heat.sketch.observed", len(vids),
+                               kind="counter")
+
+    def sketch(self, space: int) -> Optional[SpaceSaving]:
+        return self._sketches.get(int(space))
+
+    # ------------------------------------------------------------- reads
+    def _slab_items(self) -> List[Tuple[Tuple[int, int], _Slab]]:
+        """Point-in-time (key, slab) list — readers must not iterate
+        the live dict while serving threads insert new slabs."""
+        with self._lock:
+            return list(self._slabs.items())
+
+    def parts_snapshot(self) -> List[Dict[str, Any]]:
+        """Every known (space, part) with its 60s/600s/lifetime fields
+        and scores — the /heat body."""
+        nb = int(self._clock()) // BUCKET_SECS
+        out = []
+        for (space, part), slab in sorted(self._slab_items()):
+            row: Dict[str, Any] = {"space": space, "part": part}
+            for secs in WINDOWS:
+                w = slab.window(nb, secs)
+                row[f"{secs}s"] = dict(zip(FIELDS, w))
+                row[f"score_{secs}s"] = round(
+                    score_of(row[f"{secs}s"]), 3)
+            row["life"] = dict(zip(FIELDS, slab.lifetime()))
+            row["score_life"] = round(score_of(row["life"]), 3)
+            out.append(row)
+        return out
+
+    def space_scores(self, window: int = 600) -> Dict[int, Dict[int, float]]:
+        """{space: {part: score}} over the trailing window."""
+        nb = int(self._clock()) // BUCKET_SECS
+        out: Dict[int, Dict[int, float]] = {}
+        for (space, part), slab in self._slab_items():
+            out.setdefault(space, {})[part] = score_of(
+                dict(zip(FIELDS, slab.window(nb, window))))
+        return out
+
+    @staticmethod
+    def _skew_of(part_scores: Dict[int, float]) -> Dict[str, float]:
+        scores = sorted(part_scores.values())
+        n = len(scores)
+        if n == 0 or sum(scores) <= 0:
+            return {"index": 0.0, "p99": 0.0, "mean": 0.0, "parts": n}
+        mean = sum(scores) / n
+        p99 = scores[min(n - 1, max(0, int(-(-n * 99 // 100)) - 1))]
+        return {"index": round(p99 / mean, 4), "p99": round(p99, 3),
+                "mean": round(mean, 3), "parts": n}
+
+    def skew_index(self, space: int,
+                   window: int = 600) -> Dict[str, float]:
+        """p99-to-mean heat-score ratio across one space's parts:
+        ~1.0 uniform, growing with concentration. Parts a space has
+        but never touched contribute zero heat only once ANY slab for
+        them exists — callers wanting exact part counts pass them via
+        the /heat surface; the index is about relative concentration
+        among serving parts."""
+        return self._skew_of(self.space_scores(window)
+                             .get(int(space), {}))
+
+    def skew_indices(self, window: int = 600) -> Dict[int, Dict[str, float]]:
+        # ONE slab walk for every space's index — this runs on every
+        # /metrics scrape (gauges) and /heat request
+        return {s: self._skew_of(parts)
+                for s, parts in self.space_scores(window).items()}
+
+    # ------------------------------------------------ heartbeat payload
+    def heartbeat_payload(self, lead_parts: Optional[Dict[int, List[int]]]
+                          = None) -> Optional[Dict[str, Any]]:
+        """The additive heartbeat field storaged carries to metad
+        (meta/client.py heat_source): per-(space, part) 600s window
+        fields + score. `lead_parts` restricts to parts this node
+        LEADS (the authoritative copy — every replica serves reads of
+        parts it leads, so summing leader payloads never double-counts
+        a part). None when heat is disarmed (the heartbeat then
+        carries no heat field at all)."""
+        if not enabled():
+            return None
+        nb = int(self._clock()) // BUCKET_SECS
+        parts: Dict[int, Dict[int, Dict[str, float]]] = {}
+        for (space, part), slab in self._slab_items():
+            if lead_parts is not None and \
+                    part not in (lead_parts.get(space) or ()):
+                continue
+            w = dict(zip(FIELDS, slab.window(nb, 600)))
+            w["score"] = round(score_of(w), 3)
+            parts.setdefault(space, {})[part] = w
+        return {"parts": parts}
+
+    # ------------------------------------------------------ hot-part eval
+    def check_hot_part(self, space: int) -> None:
+        """Force one hot_part evaluation for `space`, bypassing the
+        time throttle (harness/ops seam — the charge path goes through
+        the throttled _maybe_hot_part)."""
+        self._hot_checked.pop(int(space), None)
+        self._maybe_hot_part(int(space), self._clock())
+
+    def _maybe_hot_part(self, space: int, now: float) -> None:
+        """Flag-gated, time-throttled hot_part trigger evaluation:
+        at most once per HOT_PART_CHECK_SECS per space, O(parts).
+        Throttle FIRST — one dict read per charge in the steady
+        state, the flag consulted only once per window."""
+        last = self._hot_checked.get(space, 0.0)
+        if now - last < HOT_PART_CHECK_SECS:
+            return
+        self._hot_checked[space] = now
+        pct = float(_flag("heat_hot_part_pct", 0) or 0)
+        if pct <= 0:
+            return
+        scores = self.space_scores(60).get(space)
+        if not scores:
+            return
+        total = sum(scores.values())
+        if total < HOT_PART_MIN_SCORE:
+            return
+        part, top = max(scores.items(), key=lambda kv: kv[1])
+        share = 100.0 * top / total
+        if share > pct:
+            from .flight import recorder
+            recorder.record("hot_part", space=space, part=part,
+                            share=round(share, 1),
+                            score=round(top, 1),
+                            space_score=round(total, 1))
+
+    # ------------------------------------------------------- /metrics
+    def gauges(self) -> Dict[str, float]:
+        """Flat /metrics source: `nebula_part_heat_s<sid>_p<pid>_<f>`
+        60s-window families + per-part scores + per-space skew
+        indices. Empty (zero families — byte-identical /metrics) when
+        disarmed."""
+        if not enabled():
+            return {}
+        nb = int(self._clock()) // BUCKET_SECS
+        out: Dict[str, float] = {}
+        for (space, part), slab in sorted(self._slab_items()):
+            w = slab.window(nb, 60)
+            base = f"part_heat.s{space}.p{part}"
+            for i, f in enumerate(FIELDS):
+                out[f"{base}.{f}"] = w[i]
+            out[f"{base}.score"] = round(
+                score_of(dict(zip(FIELDS, w))), 3)
+        for space, sk in self.skew_indices(600).items():
+            out[f"heat.skew_index.s{space}"] = sk["index"]
+        return out
+
+    # ---------------------------------------------------------- surface
+    def describe(self, vertices: bool = False) -> Dict[str, Any]:
+        """The /heat endpoint body (shared by graphd + storaged;
+        daemons merge their extras — staleness, degree stats)."""
+        out: Dict[str, Any] = {
+            "enabled": enabled(),
+            "fields": list(FIELDS),
+            "score_weights": dict(SCORE_WEIGHTS),
+            "parts": self.parts_snapshot(),
+            "skew": {str(s): v
+                     for s, v in self.skew_indices(600).items()},
+        }
+        if vertices:
+            k = int(_flag("heat_vertices_k", 0) or 0)
+            with self._lock:
+                sketches = list(self._sketches.items())
+            out["vertices"] = {
+                "k": k,
+                "spaces": {str(s): sk.describe() for s, sk in sketches},
+            }
+        return out
+
+    def capture(self) -> Dict[str, Any]:
+        """The flight-bundle collector body: the /heat view including
+        sketches, captured at trigger time."""
+        return self.describe(vertices=True)
+
+    def drop_space(self, space: int) -> None:
+        """Forget a dropped space's slabs/sketch — without this a
+        long-running daemon's /metrics would keep scraping dead
+        nebula_part_heat_* families forever (storaged calls it from
+        the space_removed topology event)."""
+        space = int(space)
+        with self._lock:
+            for key in [k for k in self._slabs if k[0] == space]:
+                del self._slabs[key]
+            self._sketches.pop(space, None)
+            self._hot_checked.pop(space, None)
+
+    def reset(self) -> None:
+        """Test/bench isolation (phase boundaries): drop every slab,
+        sketch and hot-part throttle."""
+        with self._lock:
+            self._slabs.clear()
+            self._sketches.clear()
+            self._hot_checked.clear()
+
+
+# ----------------------------------------------------------------------
+# device-time attribution note: the engine entry seam records WHICH
+# parts the query's start vids live in; _record_profile (which only
+# knows stage timings) charges device_us against the note. ContextVar,
+# like the ledger — but deliberately NOT re-pointed by the dispatcher:
+# a coalesced window's riders charge the LEADER's parts (same space,
+# attributed time — see the module docstring).
+# ----------------------------------------------------------------------
+_note: contextvars.ContextVar[Optional[Tuple[int, Tuple[int, ...]]]] = \
+    contextvars.ContextVar("nebula_heat_note", default=None)
+
+
+def observe_query(space: int, starts: Sequence[int],
+                  num_parts: int):
+    """Engine entry seam (execute_go / aggregate / find_path): charge
+    one read per start-vid part, feed the hot-vertex sketch, and note
+    the parts for device-time attribution. Returns the note token to
+    hand back to `restore` (None when disarmed — one flag read)."""
+    if not enabled():
+        return None
+    if not starts or num_parts <= 0:
+        return None
+    from .keys import part_id
+    sample = starts[:QUERY_PART_SAMPLE]
+    parts: Dict[int, int] = {}
+    for v in sample:
+        p = part_id(int(v), num_parts)
+        parts[p] = parts.get(p, 0) + 1
+    scale = len(starts) / len(sample)
+    for p, n in parts.items():
+        accountant.charge(space, p, reads=n * scale)
+    accountant.observe_vids(space, sample)
+    return _note.set((int(space), tuple(parts)))
+
+
+def restore(token) -> None:
+    if token is not None:
+        _note.reset(token)
+
+
+def charge_device(us: float) -> None:
+    """Charge device microseconds against the noted parts (one
+    ContextVar read when no query noted parts; one flag read when heat
+    is disarmed — checked inside charge_parts)."""
+    note = _note.get()
+    if note is not None:
+        accountant.charge_parts(note[0], note[1], device_us=us)
+
+
+# process-global instance (the stats/flight/profiler singleton idiom)
+accountant = HeatAccountant()
+
+# every flight bundle embeds the workload view at trigger time — the
+# recorder is process-global and collectors are idempotent by name
+from .flight import recorder as _flight_recorder  # noqa: E402
+
+_flight_recorder.add_collector("heat", accountant.capture)

@@ -225,6 +225,41 @@ def serve_graphd(meta_addr: str, host: str = "127.0.0.1", port: int = 0,
             return 200, out
 
         web.register("/qos", qos_handler)
+
+        def heat_handler(params, body):
+            # /heat (docs/manual/10-observability.md, "Workload & data
+            # observatory"): graphd's per-(space, part) heat slabs
+            # (start-vid reads + attributed device time) + per-space
+            # skew indices; ?vertices=1 adds the frontier hot-vertex
+            # sketches and, with a TPU engine attached, the per-build
+            # degree-skew stats (hub-split candidates vs cap_e)
+            from ..common import heat as _heat
+            want_v = bool(params.get("vertices"))
+            out = _heat.accountant.describe(vertices=want_v)
+            if want_v and tpu_engine is not None:
+                degrees = {}
+                for sid, snap in list(
+                        getattr(tpu_engine, "_snapshots", {}).items()):
+                    ds = getattr(snap, "degree_stats", None)
+                    if ds:
+                        degrees[str(sid)] = ds
+                out.setdefault("vertices", {})["degrees"] = degrees
+            return 200, out
+
+        web.register("/heat", heat_handler)
+        from ..common import heat as _heat_mod
+        # nebula_part_heat_* / nebula_heat_skew_index_* families
+        # (empty — byte-identical /metrics — when heat is disarmed)
+        web.add_metrics_source(_heat_mod.accountant.gauges)
+
+        def _heat_topology(event, **kw):
+            # heat hygiene (same contract as storaged): a dropped
+            # space's slabs must stop scraping as nebula_part_heat_*
+            # families on this graphd too
+            if event == "space_removed":
+                _heat_mod.accountant.drop_space(kw["space_id"])
+
+        mc.add_listener(_heat_topology)
         if tpu_engine is not None:
             def trace(params, body):
                 # /trace?op=start&dir=/tmp/xprof | /trace?op=stop —

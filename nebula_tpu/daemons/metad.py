@@ -76,13 +76,24 @@ def serve_metad(host: str = "127.0.0.1", port: int = 0,
 
         def balance_handler(params, body):
             # /balance: plan progress + persisted task rows (the BALANCE
-            # SHOW table, operator-readable without a console session)
+            # SHOW table, operator-readable without a console session);
+            # ?heat=1 = the heat-aware ADVISORY plan — current vs
+            # post-plan modeled per-host heat spread, nothing moved
+            # (docs/manual/12-replication.md)
+            if params.get("heat"):
+                r = meta.balance_advise_heat()
+                if not r.ok():
+                    return 500, {"error": r.status.msg}
+                return 200, r.value()
             pg = meta.balance_progress()
             pg["rows"] = meta.balance_show(
                 int(params["plan"]) if params.get("plan") else None)
             return 200, pg
 
         web.register("/balance", balance_handler)
+        # the heartbeat-carried workload heat view rides every metad
+        # flight bundle next to the balancer state
+        _flight.add_collector("metad.heat", meta.heat_overview)
 
         def meta_metric_source():
             out = {"meta.active_storage_hosts":

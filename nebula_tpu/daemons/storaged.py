@@ -12,6 +12,7 @@ import threading
 from dataclasses import dataclass
 from typing import Optional
 
+from ..common import heat
 from ..common.flags import storage_flags
 from ..common.stats import stats
 from ..kvstore.store import GraphStore
@@ -313,6 +314,9 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
                 node.remove_space(kw["space_id"])
             else:
                 store.remove_space(kw["space_id"])
+            # heat hygiene: a dropped space's slabs must stop
+            # scraping as nebula_part_heat_* families
+            heat.accountant.drop_space(kw["space_id"])
 
     # the web service is created after the heartbeat thread starts, so
     # the callback reads it through this box (and the box records the
@@ -351,6 +355,32 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
         return out
 
     mc.leader_source = leader_source
+
+    def heat_source():
+        # heartbeat-carried placement telemetry (workload & data
+        # observatory, common/heat.py): per-(space, part) 600s heat
+        # for the parts this node LEADS, plus the leader-side replica
+        # staleness watermarks — metad's heat view feeds SHOW HOSTS/
+        # SHOW PARTS heat columns and the heat-aware BALANCE advisor.
+        # None (no heartbeat field at all) when heat is disarmed.
+        payload = heat.accountant.heartbeat_payload(
+            lead_parts=leader_source())
+        if payload is None:
+            return None
+        if node is not None:
+            stale = {}
+            for st in node.raft_status():
+                reps = st.get("replicas") or []
+                if reps:
+                    stale.setdefault(st["space"], {})[st["part"]] = {
+                        "max_ms": st.get("staleness_ms", 0.0),
+                        "replicas": {m["addr"]: m["staleness_ms"]
+                                     for m in reps}}
+            if stale:
+                payload["staleness"] = stale
+        return payload
+
+    mc.heat_source = heat_source
     # register with metad BEFORE the first topology sync so part
     # allocation can target this host (waitForMetadReady ordering)
     mc.heartbeat(addr, "storage")
@@ -468,6 +498,28 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
                          "parts": node.raft_status()}
 
         web.register("/raft", raft_handler)
+
+        def heat_handler(params, body):
+            # /heat (docs/manual/10-observability.md, "Workload & data
+            # observatory"): per-(space, part) heat slabs + per-space
+            # skew indices; ?vertices=1 adds the scanned-src-vid
+            # hot-vertex sketches; replicated nodes append the /raft
+            # staleness watermarks
+            out = heat.accountant.describe(
+                vertices=bool(params.get("vertices")))
+            if node is not None:
+                out["staleness"] = [
+                    {"space": st["space"], "part": st["part"],
+                     "staleness_ms": st.get("staleness_ms", 0.0),
+                     "replicas": st.get("replicas", [])}
+                    for st in node.raft_status()
+                    if st.get("replicas")]
+            return 200, out
+
+        web.register("/heat", heat_handler)
+        # nebula_part_heat_* / nebula_heat_skew_index_* families
+        # (empty — byte-identical /metrics — when heat is disarmed)
+        web.add_metrics_source(heat.accountant.gauges)
         if node is not None:
             # flight bundles captured on this storaged carry the
             # per-part consensus state at trigger time
@@ -491,6 +543,13 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
                     # boot re-applied + segment files compacted away
                     out[base + ".wal_replayed"] = st["wal_replayed"]
                     out[base + ".wal_cleaned"] = st["wal_cleaned"]
+                    # replica staleness watermark (max over followers;
+                    # 0 on non-leaders — the leader owns the signal);
+                    # observatory telemetry, so the heat_enabled
+                    # disarm contract removes the family too
+                    if heat.enabled():
+                        out[base + ".staleness_ms"] = \
+                            st.get("staleness_ms", 0.0)
                 return out
 
             web.add_metrics_source(raft_metric_source)

@@ -235,6 +235,46 @@ class CsrSnapshot:
         self._device_prop_cache: Dict[Tuple, Any] = {}
         # global string dictionaries: (kind 'e'|'t', prop name) -> {str: code}
         self.str_dicts: Dict[Tuple[str, str], Dict[str, int]] = {}
+        # degree-skew stats, computed ONCE per build (workload & data
+        # observatory, /heat?vertices=1): out-degree distribution +
+        # the hub list — tomorrow's hub-split candidates, named
+        # against the cap_e this layout pays for them (ROADMAP item 5)
+        self.degree_stats = self._degree_stats()
+
+    def _degree_stats(self, hubs: int = 8) -> Dict[str, Any]:
+        """max/p99/mean out-degree over the build-time edges plus the
+        top-`hubs` (vid, out_degree) list and their share of cap_e.
+        One numpy pass over the host mirrors; delta-added edges are
+        not re-counted (the stats describe the built layout)."""
+        degs = []
+        vids = []
+        for s in self.shards:
+            n = len(s.vids)
+            if n == 0:
+                continue
+            d = np.bincount(
+                s.edge_src[s.edge_valid].astype(np.int64),
+                minlength=n)[:n]
+            degs.append(d)
+            vids.append(s.vids)
+        if not degs:
+            return {"vertices": 0, "edges": 0, "max": 0, "p99": 0,
+                    "mean": 0.0, "cap_e": self.cap_e, "hubs": []}
+        deg = np.concatenate(degs)
+        vid = np.concatenate(vids)
+        top = np.argsort(deg)[::-1][:hubs]
+        return {
+            "vertices": int(len(deg)),
+            "edges": int(deg.sum()),
+            "max": int(deg.max()),
+            "p99": int(np.percentile(deg, 99)),
+            "mean": round(float(deg.mean()), 2),
+            "cap_e": self.cap_e,
+            "hubs": [{"vid": int(vid[i]), "out_degree": int(deg[i]),
+                      "cap_e_share": round(float(deg[i]) / self.cap_e,
+                                           4)}
+                     for i in top if deg[i] > 0],
+        }
 
     # ------------------------------------------------------------------
     def _np_edge_stacks(self) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:

@@ -41,6 +41,7 @@ import numpy as np
 
 from ..common.cache import (CacheRung, plan_stage_enabled,
                             result_stage_enabled)
+from ..common import heat as _heat
 from ..common import ledger as _ledger
 from ..common.faults import CircuitBreaker, faults
 from ..common import profiler as _profiler
@@ -527,6 +528,12 @@ class TpuGraphEngine:
         if led is not None and "sparse" not in mode:
             led.device_us += int(t_kernel * 1e6)
             led.launches += 1
+        if "sparse" not in mode:
+            # per-part heat: device time attributed to the parts the
+            # serving query's start vids noted at the engine entry
+            # (common/heat.py — coalesced-window riders land on the
+            # leader's parts, the ledger's attributed-time discipline)
+            _heat.charge_device(t_kernel * 1e6)
         if _tr.active():
             _tr.tag_root("mode", mode)
             _tr.add_span("snapshot", t_snap * 1e6)
@@ -1473,6 +1480,26 @@ class TpuGraphEngine:
         device degrades to a warm cache, not straight to the CPU pipe.
         Keys embed the freshness token, so staleness is structural:
         any committed write moves the token and orphans old entries."""
+        # workload observatory: charge read heat to the start-vid
+        # parts, feed the hot-vertex sketch, and note the parts for
+        # device-time attribution (one flag read when disarmed)
+        heat_tok = self._heat_note_query(ctx, starts)
+        try:
+            return self._execute_go_outer(ctx, s, starts, edge_types,
+                                          alias_map, name_by_type)
+        finally:
+            _heat.restore(heat_tok)
+
+    def _heat_note_query(self, ctx, starts):
+        try:
+            space = ctx.space_id()
+            return _heat.observe_query(space, starts,
+                                       ctx.sm.num_parts(space))
+        except Exception:
+            return None    # telemetry must never fail a query
+
+    def _execute_go_outer(self, ctx, s, starts, edge_types, alias_map,
+                          name_by_type):
         ck, yield_cols = self._go_cache_key(ctx, s, starts, edge_types,
                                             alias_map, name_by_type)
         if ck is not None:
@@ -3152,6 +3179,17 @@ class TpuGraphEngine:
         (cache_mode=full; rows are tiny and the reductions are the
         expensive half of the stats surface) — checked BEFORE the
         breaker gate, same warm-cache-under-breaker rationale as GO."""
+        heat_tok = self._heat_note_query(ctx, starts)
+        try:
+            return self._execute_go_aggregate_outer(
+                ctx, s, specs, out_cols, starts, edge_types, alias_map,
+                name_by_type, group_layout)
+        finally:
+            _heat.restore(heat_tok)
+
+    def _execute_go_aggregate_outer(self, ctx, s, specs, out_cols,
+                                    starts, edge_types, alias_map,
+                                    name_by_type, group_layout):
         ck = self._agg_cache_key(ctx, s, specs, out_cols, starts,
                                  edge_types, alias_map, group_layout)
         if ck is not None:
@@ -4595,6 +4633,7 @@ class TpuGraphEngine:
             return None
         if not self._device_admit("path", ctx):
             return None
+        heat_tok = self._heat_note_query(ctx, sources)
         try:
             with self._lock:   # delta applies mutate mirrors in place
                 r = self._execute_find_path_locked(ctx, s, sources,
@@ -4602,6 +4641,8 @@ class TpuGraphEngine:
                                                    name_by_type, ex)
         except Exception as e:
             return self._device_failed("path", e)
+        finally:
+            _heat.restore(heat_tok)
         if r is not None:
             self._device_ok("path")
         return r

@@ -194,21 +194,30 @@ def execute_show(ctx: ExecContext, s: ast.ShowSentence) -> Result:
                     for info, alive in ctx.meta.all_hosts()]
             return _ok(InterimResult(["Ip:Port", "Status"], rows))
         rows = [(h["host"], h["status"], h["leader_count"],
-                 _dist(h["leader_dist"]), _dist(h["part_dist"]))
+                 _dist(h["leader_dist"]), _dist(h["part_dist"]),
+                 h.get("leader_heat", 0.0))
                 for h in overview]
         return _ok(InterimResult(
             ["Ip:Port", "Status", "Leader count", "Leader distribution",
-             "Partition distribution"], rows))
+             "Partition distribution", "Leader heat"], rows))
     if k == ast.ShowKind.PARTS:
         st = ctx.require_space()
         if not st.ok():
             return StatusOr.from_status(st)
         try:
             parts = ctx.meta.parts_overview(ctx.space_id())
-            rows = [(pid, leader, ", ".join(hosts), ", ".join(losts))
-                    for pid, leader, hosts, losts in parts]
+            rows = []
+            for row in parts:
+                # [part, leader, hosts, losts] pre-ISSUE-14 metas;
+                # [+ heat, staleness_ms] since the heat view landed
+                pid, leader, hosts, losts = row[:4]
+                heat_score = row[4] if len(row) > 4 else 0.0
+                stale_ms = row[5] if len(row) > 5 else 0.0
+                rows.append((pid, leader, ", ".join(hosts),
+                             ", ".join(losts), heat_score, stale_ms))
             return _ok(InterimResult(
-                ["Partition ID", "Leader", "Peers", "Losts"], rows))
+                ["Partition ID", "Leader", "Peers", "Losts", "Heat",
+                 "Staleness ms"], rows))
         except Exception:
             alloc = ctx.meta.get_parts_alloc(ctx.space_id())
             rows = [(pid, ", ".join(hosts))
@@ -272,6 +281,9 @@ class _MetaBalancerProxy:
     def show_plan(self, plan_id=None):
         return self._meta.balance_show(plan_id)
 
+    def advise_heat(self):
+        return self._meta.balance_advise_heat()
+
     def stop(self):
         return self._meta.balance_stop()
 
@@ -294,6 +306,32 @@ def execute_balance(ctx: ExecContext, s: ast.BalanceSentence) -> Result:
         rows = balancer.show_plan(s.plan_id)
         return _ok(InterimResult(
             ["plan", "space", "part", "src", "dst", "status"], rows))
+    if s.sub == "HEAT":
+        # heat-aware ADVISORY plan (docs/manual/12-replication.md):
+        # per-host current vs modeled heat, the proposed moves, and
+        # the spread delta — nothing is executed
+        if hasattr(balancer, "advise_heat"):
+            r = balancer.advise_heat()
+        else:
+            r = _MetaBalancerProxy(ctx.meta).advise_heat()
+        if hasattr(r, "ok"):
+            if not r.ok():
+                return StatusOr.from_status(r.status)
+            plan = r.value()
+        else:
+            plan = r
+        rows = [("host", h, plan["current"].get(h, 0.0),
+                 plan["planned"].get(h, 0.0))
+                for h in plan.get("hosts", [])]
+        rows += [("move", f"s{m['space']} p{m['part']} "
+                  f"{m['src']} -> {m['dst']} ({m['kind']})",
+                  m["score"], None)
+                 for m in plan.get("moves", [])]
+        rows.append(("spread", "max-min per-host heat",
+                     plan.get("spread_before", 0.0),
+                     plan.get("spread_after", 0.0)))
+        return _ok(InterimResult(
+            ["Kind", "Detail", "Heat", "Planned"], rows))
     if s.sub == "STOP":
         st = balancer.stop()
         if not st.ok():

@@ -234,7 +234,7 @@ class StorageNode:
         for key in sorted(self.hooks):
             h = self.hooks.get(key)
             if h is not None and h.raft is not None:
-                out.append(h.raft.status())
+                out.append(h.raft.status_with_replicas())
         return out
 
     def compact_wals(self, lag: int) -> Dict[tuple, dict]:

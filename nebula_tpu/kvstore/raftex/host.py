@@ -9,6 +9,7 @@ needed logs — the trigger for snapshot transfer (ref Host.cpp:409).
 from __future__ import annotations
 
 import threading
+import time
 
 
 class Host:
@@ -20,6 +21,13 @@ class Host:
         self.match_id = 0
         self.sending_snapshot = False
         self.paused = False
+        # replica staleness watermarks (docs/manual/12-replication.md,
+        # "Workload & data observatory"): when this follower last
+        # acked an append, and when it was last observed fully caught
+        # up to the leader's commit index — staleness_ms derives from
+        # these on the leader (RaftPart.replica_watermarks)
+        self.last_ack_ts = 0.0
+        self.caught_up_ts = time.monotonic()
         self._lock = threading.Lock()
 
     def reset_for_leader(self, last_log_id: int) -> None:
@@ -27,11 +35,13 @@ class Host:
             self.next_id = last_log_id + 1
             self.match_id = 0
             self.sending_snapshot = False
+            self.caught_up_ts = time.monotonic()
 
     def on_success(self, last_sent: int) -> None:
         with self._lock:
             self.match_id = max(self.match_id, last_sent)
             self.next_id = self.match_id + 1
+            self.last_ack_ts = time.monotonic()
 
     def on_gap(self, follower_last: int) -> None:
         """Follower is behind/conflicting: back up to just past its

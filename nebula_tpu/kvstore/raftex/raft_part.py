@@ -53,6 +53,7 @@ import time
 from concurrent.futures import Future
 from typing import Callable, Dict, List, Optional, Tuple
 
+from ...common import heat as _heat
 from ...common import profiler as _profiler
 from ...common.faults import faults
 from ...common.flight import recorder as flight
@@ -132,6 +133,8 @@ class RaftPart:
         self._last_msg_recv = time.monotonic()
         self._next_election_due = self._rand_timeout()
         self._last_quorum_contact = time.monotonic()
+        # replica-staleness bookkeeping throttle (_note_staleness)
+        self._stale_noted_ts = 0.0
 
         os.makedirs(wal_dir, exist_ok=True)
         # wal_sync_every_append (REBOOT gflag, read at part bind like
@@ -292,6 +295,10 @@ class RaftPart:
         if not self.wal.append(log_id, self.term, 0, marker + data):
             fut.set_result(RaftCode.E_WAL_FAIL)
             return fut
+        # write heat, charged on the accepting leader (workload
+        # observatory, common/heat.py — counter bump, leaf lock)
+        _heat.accountant.charge(self.space_id, self.part_id,
+                                raft_appends=1)
         if log_type is LogType.COMMAND:
             self._apply_command_locked(data)
         self._pending[log_id] = fut
@@ -410,6 +417,12 @@ class RaftPart:
             if resp.code is RaftCode.SUCCEEDED:
                 sent_last = (req.prev_log_id + len(req.entries))
                 host.on_success(sent_last)
+                # staleness watermark: the follower is "caught up"
+                # when its durable match covers everything the leader
+                # had committed at round start — the timestamp
+                # staleness_ms is estimated from while it lags
+                if host.match_id >= committed:
+                    host.caught_up_ts = time.monotonic()
             elif resp.code in (RaftCode.E_LOG_GAP, RaftCode.E_LOG_STALE):
                 host.on_gap(resp.last_log_id)
             elif resp.code is RaftCode.E_TERM_OUT_OF_DATE:
@@ -431,6 +444,73 @@ class RaftPart:
                 return
 
         self._advance_commit(term, last_id)
+        self._note_staleness()
+
+    def _note_staleness(self) -> None:
+        """Per-round replica-staleness bookkeeping on the leader:
+        feed the raftex.staleness_ms histogram and record a
+        flight-recorder `staleness_breach` event past the
+        `staleness_breach_ms` flag (0 = disarmed). Time-throttled to
+        once per second — the replicator runs every hb/2. Gated on
+        the observatory master switch like every other heat family
+        (heat_enabled=false must leave /metrics byte-identical to a
+        heat-free build; the /raft watermarks themselves are status,
+        not telemetry, and stay)."""
+        now = time.monotonic()
+        if now - self._stale_noted_ts < 1.0:
+            return
+        self._stale_noted_ts = now
+        if not _heat.enabled():
+            return
+        marks = self.replica_watermarks()
+        if not marks:
+            return
+        breach_ms = float(_heat._flag("staleness_breach_ms", 0) or 0)
+        for m in marks:
+            stats.add_value("raftex.staleness_ms", m["staleness_ms"],
+                            kind="histogram")
+            if breach_ms > 0 and m["staleness_ms"] > breach_ms:
+                flight.record("staleness_breach", space=self.space_id,
+                              part=self.part_id, replica=m["addr"],
+                              staleness_ms=m["staleness_ms"],
+                              applied=m["applied"],
+                              commit=m["commit"])
+
+    def replica_watermarks(self) -> List[dict]:
+        """Per-replica applied/commit watermarks + a staleness_ms
+        estimate, leader-side (empty on followers/learners — only the
+        leader sees the whole group). `applied` is the follower's
+        durable match clamped to the leader's commit index (followers
+        apply exactly what the leader tells them is committed, so this
+        is the tightest bound the protocol itself provides);
+        `staleness_ms` is time since the replica was last observed
+        fully caught up — bounded by one heartbeat round in the steady
+        state, growing while the follower lags. The measurement
+        bounded-staleness follower reads will be gated on
+        (ROADMAP item 1; docs/manual/12-replication.md)."""
+        now = time.monotonic()
+        with self._lock:
+            if self.role is not Role.LEADER:
+                return []
+            committed = self.committed_id
+            out = []
+            for h in self.hosts.values():
+                applied = min(h.match_id, committed)
+                if h.match_id >= committed:
+                    # caught up: staleness is at most the time since
+                    # its last ack (one replication round)
+                    ref = h.last_ack_ts or h.caught_up_ts
+                else:
+                    ref = h.caught_up_ts
+                out.append({
+                    "addr": h.addr, "learner": h.is_learner,
+                    "match": h.match_id, "applied": applied,
+                    "commit": committed,
+                    "lag": max(0, committed - h.match_id),
+                    "staleness_ms": round(
+                        max(0.0, (now - ref) * 1000.0), 1),
+                })
+            return out
 
     def _build_append_locked(self, host: Host,
                              committed: int) -> Optional[AppendLogRequest]:
@@ -957,3 +1037,13 @@ class RaftPart:
                 "wal_cleaned": self.wal_cleaned,
                 "peers": list(self.peers), "learners": list(self.learners),
             }
+
+    def status_with_replicas(self) -> dict:
+        """status() + the per-replica staleness watermarks (leader
+        only) — the /raft endpoint row (docs/manual/12-replication.md,
+        "Replica staleness watermarks")."""
+        st = self.status()
+        st["replicas"] = self.replica_watermarks()
+        st["staleness_ms"] = max(
+            (m["staleness_ms"] for m in st["replicas"]), default=0.0)
+        return st

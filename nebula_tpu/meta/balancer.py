@@ -159,6 +159,130 @@ class Balancer:
             self._thread.start()
             return StatusOr.of(plan_id)
 
+    # ------------------------------------------------------------------
+    # heat-aware BALANCE advisor (ISSUE 14; docs/manual/
+    # 12-replication.md, "Heat-aware BALANCE advisor")
+    # ------------------------------------------------------------------
+    def advise_heat(self) -> Dict:
+        """Fold the heartbeat-carried heat view into a placement
+        scorer and produce an ADVISORY plan: the per-host heat today,
+        the modeled per-host heat after the proposed moves, and the
+        moves themselves — `BALANCE DATA heat` / `/balance?heat=1`
+        report it; nothing is executed (moving is a later PR).
+
+        Model: a host's heat is the summed 600s score of the parts it
+        LEADS (the leader serves the reads/writes that heat measures).
+        Greedy descent on the spread (hottest-host max - coldest-host
+        min): repeatedly take the hottest host's hottest part and move
+        its leadership to the host that would stay coolest —
+        preferring an existing replica (kind="leader", a
+        TRANS_LEADER-shaped move) over a data move to a non-replica
+        host (kind="move", an add+remove-replica-shaped move) — until
+        no single move lowers the spread. Bounded at 2x total part
+        count. Scores come from each leader's own heartbeat; a part
+        whose leader carries no heat scores 0 and never moves."""
+        view = self.meta.heat_overview()
+        active = sorted(self._get_active())
+        if not active:
+            return {"hosts": {}, "moves": [],
+                    "spread_before": 0.0, "spread_after": 0.0,
+                    "advisory": True}
+        # part -> (leader, score): leadership can transiently be
+        # claimed by TWO heartbeat views right after a leader change
+        # (the deposed host's view survives until its next beat) — a
+        # part counts ONCE, under the claimant with the NEWER view,
+        # or the modeled totals and the move's src would both be wrong
+        # for a whole heartbeat period
+        part_leader: Dict[Tuple[int, int], str] = {}
+        part_score: Dict[Tuple[int, int], float] = {}
+        claim_ts: Dict[Tuple[int, int], float] = {}
+        for host, hv in view.get("hosts", {}).items():
+            if host not in active:
+                continue
+            ts = float(hv.get("ts") or 0.0)
+            for key, score in hv.get("parts", {}).items():
+                sid_s, _, pid_s = key.partition(":")
+                k = (int(sid_s), int(pid_s))
+                if k in part_leader and claim_ts[k] >= ts:
+                    continue
+                part_leader[k] = host
+                part_score[k] = float(score)
+                claim_ts[k] = ts
+        modeled: Dict[str, float] = {h: 0.0 for h in active}
+        for k, host in part_leader.items():
+            modeled[host] += part_score[k]
+        current = {h: round(v, 1) for h, v in modeled.items()}
+        # replica sets, for preferring leader-transfer moves
+        replicas: Dict[Tuple[int, int], List[str]] = {}
+        for desc in self.meta.list_spaces():
+            for part, hosts in self.meta.get_parts_alloc(
+                    desc.space_id).items():
+                replicas[(desc.space_id, part)] = [
+                    h for h in hosts if h in modeled]
+
+        def spread(m: Dict[str, float]) -> float:
+            return (max(m.values()) - min(m.values())) if m else 0.0
+
+        spread_before = spread(modeled)
+        moves: List[Dict] = []
+        max_moves = 2 * max(len(part_score), 1)
+        while len(moves) < max_moves and len(modeled) > 1:
+            hot = max(modeled, key=lambda h: modeled[h])
+            led = sorted(
+                (k for k, h in part_leader.items() if h == hot),
+                key=lambda k: part_score.get(k, 0.0), reverse=True)
+            best = None
+            cur_spread = spread(modeled)
+            for k in led:
+                s = part_score.get(k, 0.0)
+                if s <= 0:
+                    break
+
+                def after(dst):
+                    return spread({
+                        h: (modeled[h] - s if h == hot else
+                            modeled[h] + s if h == dst
+                            else modeled[h])
+                        for h in modeled})
+                # every destination whose move lowers the spread,
+                # coolest-after first; among those, a replica holder
+                # wins outright — a TRANS_LEADER-shaped move is far
+                # cheaper than a data move, and any spread improvement
+                # it offers beats a (possibly larger) one that has to
+                # copy the part
+                improving = sorted(
+                    (h for h in modeled
+                     if h != hot and after(h) < cur_spread - 1e-9),
+                    key=lambda h: modeled[h] + s)
+                if not improving:
+                    continue
+                dst = next((h for h in improving
+                            if h in replicas.get(k, ())),
+                           improving[0])
+                best = (k, s, dst)
+                break
+            if best is None:
+                break
+            k, s, dst = best
+            modeled[hot] -= s
+            modeled[dst] += s
+            part_leader[k] = dst
+            moves.append({
+                "space": k[0], "part": k[1], "src": hot, "dst": dst,
+                "score": round(s, 1),
+                "kind": "leader" if dst in replicas.get(k, ())
+                else "move"})
+        return {
+            "hosts": sorted(modeled),
+            "current": current,
+            "planned": {h: round(v, 1) for h, v in modeled.items()},
+            "moves": moves,
+            "spread_before": round(spread_before, 1),
+            "spread_after": round(spread(modeled), 1),
+            "staleness": view.get("staleness", []),
+            "advisory": True,
+        }
+
     def leader_balance(self) -> Status:
         """Even out leaders per host without moving data (ref
         Balancer::leaderBalance)."""

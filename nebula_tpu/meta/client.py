@@ -47,6 +47,13 @@ class MetaClient:
         # raft leadership here so every heartbeat refreshes metad's
         # ActiveHostsMan leader view (SHOW HOSTS/PARTS leader columns)
         self.leader_source: Optional[Callable[[], Dict[int, List[int]]]] = None
+        # optional heat-payload provider (common/heat.py
+        # heartbeat_payload): per-(space, part) heat + staleness for
+        # the parts this node leads, carried as an ADDITIVE heartbeat
+        # field (the leader_parts idiom) into metad's heat view —
+        # SHOW HOSTS/PARTS heat columns + the heat-aware BALANCE
+        # advisor. None (or a None payload) = field not sent.
+        self.heat_source: Optional[Callable[[], Optional[Dict]]] = None
         # this daemon's HTTP admin port, carried on every heartbeat so
         # metad can hand the /cluster_metrics federation its scrape
         # target (set by the daemon once its WebService is up; -1 =
@@ -146,10 +153,17 @@ class MetaClient:
                         lp = self.leader_source()
                     except Exception:
                         lp = None
+                ph = None
+                if self.heat_source is not None:
+                    try:
+                        ph = self.heat_source()
+                    except Exception:
+                        ph = None
                 st = self._rpc.heartbeat(self.local_addr, self.role,
                                          cluster_id=cluster_id,
                                          leader_parts=lp,
-                                         ws_port=self.ws_port)
+                                         ws_port=self.ws_port,
+                                         part_heat=ph)
                 if st is not None and not st.ok() and \
                         st.code == ErrorCode.E_WRONG_CLUSTER:
                     # the reference daemon aborts on mismatch; as a

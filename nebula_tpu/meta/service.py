@@ -74,6 +74,13 @@ class MetaService:
         # (the ActiveHostsMan leader view; feeds SHOW HOSTS / SHOW PARTS
         # leader columns and the balancer's placement decisions)
         self._leader_view: Dict[str, Dict[int, List[int]]] = {}
+        # heartbeat-carried workload heat (common/heat.py
+        # heartbeat_payload): host -> {"parts": {sid: {pid: fields +
+        # score}}, "staleness": {sid: {pid: {...}}}, "ts"}. In-memory
+        # like the leader view — placement telemetry refreshes within
+        # one heartbeat; feeds SHOW HOSTS/PARTS heat columns and the
+        # heat-aware BALANCE advisor (meta/balancer.py)
+        self._heat_view: Dict[str, Dict[str, Any]] = {}
         # heartbeat-carried HTTP admin ports: rpc host -> (ws_port,
         # role). The /cluster_metrics federation (daemons/graphd.py)
         # reads this to find every daemon's /metrics; in-memory like
@@ -595,7 +602,7 @@ class MetaService:
 
     def heartbeat(self, host: str, role: str = "storage",
                   cluster_id: int = 0, leader_parts=None,
-                  ws_port: int = -1) -> Status:
+                  ws_port: int = -1, part_heat=None) -> Status:
         # cluster_id 0 = first contact (client hasn't learned it yet);
         # a non-zero mismatch is a daemon from another cluster (ref:
         # HBProcessor clusterId check)
@@ -618,6 +625,31 @@ class MetaService:
             self._leader_view[host] = {
                 int(s): sorted(int(p) for p in ps)
                 for s, ps in dict(leader_parts).items()}
+        if part_heat is not None:
+            # heartbeat-carried per-part heat + staleness (additive
+            # field, the leader_parts idiom): normalized to int keys,
+            # stamped so stale views age out with the host's liveness
+            try:
+                self._heat_view[host] = {
+                    "ts": time.time(),
+                    "parts": {int(s): {int(p): dict(f)
+                                       for p, f in ps.items()}
+                              for s, ps in dict(
+                                  part_heat.get("parts") or {}).items()},
+                    "staleness": {int(s): {int(p): dict(f)
+                                           for p, f in ps.items()}
+                                  for s, ps in dict(
+                                      part_heat.get("staleness")
+                                      or {}).items()},
+                }
+            except (TypeError, ValueError, AttributeError):
+                pass   # malformed telemetry must never fail a beat
+        elif role == "storage":
+            # a storage beat WITHOUT heat means the node's observatory
+            # is disarmed (heat_source returns None) — drop its view
+            # so SHOW HOSTS/PARTS and the advisor don't serve frozen
+            # telemetry forever (the disarm kill-switch contract)
+            self._heat_view.pop(host, None)
         if st.ok() and role == "storage":
             new_host = host not in self._hosts_seen
             self._hosts_seen.add(host)
@@ -717,7 +749,9 @@ class MetaService:
     # view and the part allocation into one table)
     # ------------------------------------------------------------------
     def hosts_overview(self) -> List[Dict[str, Any]]:
-        """Per-host liveness + leader/partition distribution rows."""
+        """Per-host liveness + leader/partition distribution rows +
+        the heartbeat-carried leader-heat rollup (600s score summed
+        over the parts this host leads; workload observatory)."""
         spaces = self.list_spaces()
         name_of = {d.space_id: d.name for d in spaces}
         allocs = {d.space_id: self.get_parts_alloc(d.space_id)
@@ -735,17 +769,56 @@ class MetaService:
                         if info.host in hosts)
                 if n:
                     part_dist[name_of[sid]] = n
+            hv = self._heat_view.get(info.host) if alive else None
+            leader_heat = 0.0
+            if hv:
+                for sid, parts in hv.get("parts", {}).items():
+                    for pid, f in parts.items():
+                        leader_heat += float(f.get("score", 0.0))
             out.append({"host": info.host,
                         "status": "online" if alive else "offline",
                         "leader_count": sum(leader_dist.values()),
                         "leader_dist": leader_dist,
-                        "part_dist": part_dist})
+                        "part_dist": part_dist,
+                        "leader_heat": round(leader_heat, 1)})
         return out
 
+    def heat_overview(self) -> Dict[str, Any]:
+        """The heartbeat-carried heat view, advisor-shaped:
+        {"hosts": {host: {"parts": {(sid, pid) serialized as
+        "sid:pid": score}, "total": float}}, "staleness": [{space,
+        part, host, max_ms}]} — consumed by the heat-aware BALANCE
+        advisor (meta/balancer.py) and metad's /balance?heat=1."""
+        alive = {h.host for h in self.active_hosts()}
+        hosts: Dict[str, Any] = {}
+        staleness: List[Dict[str, Any]] = []
+        for host, hv in self._heat_view.items():
+            if host not in alive:
+                continue
+            parts = {}
+            total = 0.0
+            for sid, ps in hv.get("parts", {}).items():
+                for pid, f in ps.items():
+                    s = float(f.get("score", 0.0))
+                    parts[f"{sid}:{pid}"] = s
+                    total += s
+            hosts[host] = {"parts": parts, "total": round(total, 1),
+                           "ts": hv.get("ts")}
+            for sid, ps in hv.get("staleness", {}).items():
+                for pid, f in ps.items():
+                    staleness.append({"space": sid, "part": pid,
+                                      "host": host,
+                                      "max_ms": f.get("max_ms", 0.0)})
+        return {"hosts": hosts, "staleness": staleness}
+
     def parts_overview(self, space_id: int) -> List[List]:
-        """[part, leader, peers, losts] per part: leader from the
-        heartbeat-carried view (validated against the allocation),
-        losts = allocated hosts outside the liveness horizon."""
+        """[part, leader, peers, losts, heat, staleness_ms] per part:
+        leader from the heartbeat-carried view (validated against the
+        allocation), losts = allocated hosts outside the liveness
+        horizon, heat = the leader's 600s heat score for the part and
+        staleness_ms = the max replica staleness watermark (both from
+        the heartbeat heat payload; 0 when the leader doesn't carry
+        heat — disarmed or unreplicated without telemetry)."""
         alive = {h.host for h in self.active_hosts()}
         leader_of: Dict[int, str] = {}
         for host, by_space in self._leader_view.items():
@@ -759,7 +832,19 @@ class MetaService:
             if leader and leader not in hosts:
                 leader = ""          # stale heartbeat from a moved part
             losts = [h for h in hosts if h != "local" and h not in alive]
-            rows.append([part, leader, list(hosts), losts])
+            heat_score = 0.0
+            stale_ms = 0.0
+            hv = self._heat_view.get(leader) if leader else None
+            if hv:
+                f = (hv.get("parts", {}).get(space_id) or {}).get(part)
+                if f:
+                    heat_score = float(f.get("score", 0.0))
+                sf = (hv.get("staleness", {}).get(space_id)
+                      or {}).get(part)
+                if sf:
+                    stale_ms = float(sf.get("max_ms", 0.0))
+            rows.append([part, leader, list(hosts), losts,
+                         round(heat_score, 1), round(stale_ms, 1)])
         return rows
 
     # ------------------------------------------------------------------
@@ -783,6 +868,18 @@ class MetaService:
             if not st.ok():
                 return StatusOr.from_status(st)
         return b.balance(remove_hosts=tuple(remove_hosts))
+
+    def balance_advise_heat(self) -> StatusOr[Dict]:
+        """Heat-aware BALANCE advisor (BALANCE DATA heat /
+        /balance?heat=1): the current vs post-plan MODELED per-host
+        heat spread — advisory only, nothing moves
+        (docs/manual/12-replication.md, "Heat-aware BALANCE
+        advisor")."""
+        b = self._bal()
+        if b is None:
+            return StatusOr.err(ErrorCode.E_UNSUPPORTED,
+                                "balancer not available")
+        return StatusOr.of(b.advise_heat())
 
     def balance_leader(self) -> Status:
         b = self._bal()

@@ -674,7 +674,7 @@ class ConfigSentence(Sentence):
 
 @dataclass
 class BalanceSentence(Sentence):
-    sub: str                       # DATA | LEADER | SHOW | STOP
+    sub: str                       # DATA | LEADER | SHOW | STOP | HEAT
     plan_id: Optional[int] = None
     remove_hosts: List[str] = field(default_factory=list)
     kind = Kind.BALANCE
@@ -682,6 +682,8 @@ class BalanceSentence(Sentence):
     def to_string(self) -> str:
         if self.sub == "SHOW":
             return f"BALANCE DATA {self.plan_id}"
+        if self.sub == "HEAT":
+            return "BALANCE DATA heat"
         s = f"BALANCE {self.sub}"
         if self.remove_hosts:
             s += " REMOVE " + ", ".join(self.remove_hosts)

@@ -733,6 +733,12 @@ class GQLParser:
             return ast.BalanceSentence("SHOW", plan_id=self._expect(T_INT).value)
         if self._accept("STOP"):
             return ast.BalanceSentence("STOP")
+        # BALANCE DATA heat: the heat-aware ADVISORY plan — current vs
+        # post-plan modeled per-host heat, nothing moved ("heat" is an
+        # unreserved identifier, like the reference's soft keywords)
+        if self._at(T_ID) and self._peek().value.lower() == "heat":
+            self.i += 1
+            return ast.BalanceSentence("HEAT")
         hosts = []
         if self._accept("REMOVE"):
             while True:

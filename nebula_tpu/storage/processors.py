@@ -45,7 +45,7 @@ from ..filter.expressions import (DestPropExpr, EdgePropExpr, EvalError,
 from ..kvstore.store import GraphStore
 from ..kvstore import log_encoder as le
 from ..meta.schema_manager import SchemaManager
-from ..common import ledger
+from ..common import heat, ledger
 from ..common.stats import stats
 from ..common.tracing import ActiveQueryRegistry, SlowQueryLog, tracer
 from .types import (BoundRequest, BoundResponse, EdgeData, EdgeKey,
@@ -283,6 +283,8 @@ class StorageService:
                 resp.results[part] = PartResult(pr.status.code, pr.status.msg or None)
                 continue
             engine = pr.value().engine
+            part_scanned = scanned
+            part_bytes = ret_bytes
             for vid in vids:
                 vd = VertexData(vid)
                 # source-vertex props for $^ refs and YIELD
@@ -307,6 +309,13 @@ class StorageService:
                     ret_bytes += b
                 resp.vertices.append(vd)
             resp.results[part] = PartResult(ErrorCode.SUCCEEDED)
+            # per-part heat slab (workload observatory): this part's
+            # share of the scan, plus the scanned src vids feeding the
+            # hot-vertex sketch (both one flag read when disarmed)
+            heat.accountant.charge(space, part, reads=len(vids),
+                                   rows_scanned=scanned - part_scanned,
+                                   bytes_returned=ret_bytes - part_bytes)
+            heat.accountant.observe_vids(space, vids)
         # cost ledger, charged SERVER-side under this host's own name
         # (merged client-side from the RPC piggyback) + fleet counters
         ledger.charge_host(self.host, rows_scanned=scanned,
@@ -505,6 +514,7 @@ class StorageService:
                                                 pr.status.msg or None)
                 continue
             engine = pr.value().engine
+            part_scanned = scanned
             for vid in vids:
                 # tag-owner stats + $^ bindings for the filter
                 src_props: Dict[str, Dict[str, Any]] = {}
@@ -538,6 +548,9 @@ class StorageService:
                                 continue
                             _acc(idx, ed.props, d)
             resp.results[part] = PartResult(ErrorCode.SUCCEEDED)
+            heat.accountant.charge(space, part, reads=len(vids),
+                                   rows_scanned=scanned - part_scanned)
+            heat.accountant.observe_vids(space, vids)
         ledger.charge_host(self.host, rows_scanned=scanned)
         if scanned:
             stats.add_value("storage.rows_scanned", scanned,
@@ -630,6 +643,9 @@ class StorageService:
                     kvs.append((ku.vertex_key(part, nv.vid, tag_id, ver), row))
             st = self.store.async_multi_put(space_id, part, kvs)
             resp.results[part] = _to_part_result(st)
+            if st.ok():
+                heat.accountant.charge(space_id, part,
+                                       writes=len(vertices))
         return resp
 
     def add_edges(self, space_id: int, parts: Dict[int, List[NewEdge]],
@@ -644,6 +660,8 @@ class StorageService:
                    for e in edges]
             st = self.store.async_multi_put(space_id, part, kvs)
             resp.results[part] = _to_part_result(st)
+            if st.ok():
+                heat.accountant.charge(space_id, part, writes=len(edges))
         return resp
 
     def delete_vertex(self, space_id: int, part: int, vid: int) -> ExecResponse:
@@ -657,6 +675,8 @@ class StorageService:
         dead += [k for k, _ in engine.prefix(ku.edge_prefix(part, vid))]
         st = self.store.async_multi_remove(space_id, part, dead)
         resp.results[part] = _to_part_result(st)
+        if st.ok():
+            heat.accountant.charge(space_id, part, writes=1)
         return resp
 
     def delete_edges(self, space_id: int,
@@ -675,6 +695,8 @@ class StorageService:
                 dead.extend(k for k, _ in engine.prefix(prefix))
             st = self.store.async_multi_remove(space_id, part, dead)
             resp.results[part] = _to_part_result(st)
+            if st.ok():
+                heat.accountant.charge(space_id, part, writes=len(eks))
         return resp
 
     # ------------------------------------------------------------------
@@ -747,6 +769,8 @@ class StorageService:
         st = self.store.async_atomic_op(space_id, part, atomic_op)
         if not st.ok() and out.code == ErrorCode.SUCCEEDED:
             out.code = st.code
+        if st.ok() and out.code == ErrorCode.SUCCEEDED:
+            heat.accountant.charge(space_id, part, writes=1)
         return out
 
     def update_edge(self, space_id: int, part: int, ek: EdgeKey,
@@ -821,6 +845,8 @@ class StorageService:
         st = self.store.async_atomic_op(space_id, part, atomic_op)
         if not st.ok() and out.code == ErrorCode.SUCCEEDED:
             out.code = st.code
+        if st.ok() and out.code == ErrorCode.SUCCEEDED:
+            heat.accountant.charge(space_id, part, writes=1)
         return out
 
     # ------------------------------------------------------------------
@@ -993,10 +1019,14 @@ class StorageService:
                     self.scan_cache.put(key, resp)
                 # columnar scan cost (cache hits return above and
                 # charge only the rung hit): rows + blob bytes shipped
+                blob_bytes = (len(resp.keys_blob or b"")
+                              + len(resp.vals_blob or b""))
                 ledger.charge_host(
                     self.host, rows_scanned=resp.n,
-                    bytes_returned=len(resp.keys_blob or b"")
-                    + len(resp.vals_blob or b""))
+                    bytes_returned=blob_bytes)
+                heat.accountant.charge(space_id, part, reads=1,
+                                       rows_scanned=resp.n,
+                                       bytes_returned=blob_bytes)
                 return resp
         finally:
             self._finish_op(tok, desc)

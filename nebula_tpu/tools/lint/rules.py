@@ -328,6 +328,15 @@ _NL004_FAMILY_KINDS = {
     "lock.wait_us.": "histogram",
     "graph.gc.": "histogram",
     "tpu_engine.compile_us": "histogram",
+    # workload & data observatory (ISSUE 14, common/heat.py): the
+    # hot-vertex sketch feed counters are monotonic events, and the
+    # replica-staleness distribution is contractually a native
+    # histogram — the staleness SLO / federation conformance tests
+    # read its bucket series (the nebula_part_heat_* and
+    # nebula_heat_skew_index_* families are metric-SOURCE gauges, not
+    # add_value sites, so they carry no kind tag to pin)
+    "heat.": "counter",
+    "raftex.staleness_ms": "histogram",
 }
 
 

@@ -111,6 +111,30 @@ class Snapshot:
                 out[inst] = out.get(inst, 0) + 1
         return out
 
+    _HEAT_RE = re.compile(r"^nebula_part_heat_s(\d+)_p(\d+)_"
+                          r"(reads|writes|rows_scanned|bytes_returned|"
+                          r"device_us|raft_appends|score)$")
+    _SKEW_RE = re.compile(r"^nebula_heat_skew_index_s(\d+)$")
+
+    def part_heat(self) -> Dict[str, Any]:
+        """The workload-observatory panel inputs: per-(space, part,
+        instance) 60s heat fields (nebula_part_heat_* families) and
+        the per-space skew indices. Empty when heat is disarmed —
+        those families then don't exist at all."""
+        parts: Dict[Tuple[int, int, str], Dict[str, float]] = {}
+        skew: Dict[str, float] = {}
+        for n, lbl, v in self.samples:
+            m = self._HEAT_RE.match(n)
+            if m:
+                key = (int(m.group(1)), int(m.group(2)),
+                       lbl.get("instance", "?"))
+                parts.setdefault(key, {})[m.group(3)] = v
+                continue
+            m = self._SKEW_RE.match(n)
+            if m:
+                skew[m.group(1)] = max(skew.get(m.group(1), 0.0), v)
+        return {"parts": parts, "skew": skew}
+
     def tenant_cost(self) -> Dict[str, Dict[str, float]]:
         """space -> {field: histogram _sum total} from the
         nebula_graph_cost_<space>_<field>_sum families."""
@@ -226,18 +250,51 @@ def render(new: Snapshot, old: Optional[Snapshot],
             lines.append(f"{space:<16}{cell(space, 'device_us'):>12}"
                          f"{cell(space, 'rows_scanned'):>12}"
                          f"{cell(space, 'rpc_bytes'):>12}")
+    lines.extend(render_heat(new.part_heat()))
     lines.extend(render_profile(prof))
     return "\n".join(lines)
+
+
+def render_heat(ph: Dict[str, Any]) -> List[str]:
+    """The hot-parts panel (workload & data observatory): top parts by
+    60s heat score + per-space skew indices. Empty when heat is
+    disarmed (the families don't scrape at all)."""
+    parts = ph.get("parts") or {}
+    if not parts:
+        return []
+    lines = [""]
+    skew = ph.get("skew") or {}
+    skew_s = "  ".join(f"s{s}:{v:g}" for s, v in sorted(skew.items()))
+    lines.append(f"hot parts (60s heat score)"
+                 f"{('   skew ' + skew_s) if skew_s else ''}")
+    lines.append(f"{'SPACE:PART':<12}{'INSTANCE':<24}{'SCORE':>10}"
+                 f"{'READS':>9}{'WRITES':>9}{'ROWS':>10}{'DEV_US':>10}")
+    top = sorted(parts.items(),
+                 key=lambda kv: kv[1].get("score", 0.0),
+                 reverse=True)[:6]
+    for (sid, pid, inst), f in top:
+        lines.append(f"{f'{sid}:{pid}':<12}{inst[:23]:<24}"
+                     f"{f.get('score', 0.0):>10.1f}"
+                     f"{f.get('reads', 0.0):>9.0f}"
+                     f"{f.get('writes', 0.0):>9.0f}"
+                     f"{f.get('rows_scanned', 0.0):>10.0f}"
+                     f"{f.get('device_us', 0.0):>10.0f}")
+    return lines
 
 
 def snapshot_dict(s: Snapshot,
                   prof: Optional[Dict[str, Any]] = None
                   ) -> Dict[str, Any]:
     """--once --json machine form (totals, no rates)."""
+    ph = s.part_heat()
     out = {"instances": s.instances(),
            "leaders": s.leader_counts(),
            "query_total": s.sum("nebula_graph_query_total"),
-           "tenant_cost": s.tenant_cost()}
+           "tenant_cost": s.tenant_cost(),
+           "heat": {"skew": ph["skew"],
+                    "parts": {f"{sid}:{pid}@{inst}": f
+                              for (sid, pid, inst), f
+                              in ph["parts"].items()}}}
     if prof is not None:
         out["profile"] = {"frames": prof.get("frames", []),
                           "locks": prof.get("locks", []),

@@ -47,6 +47,11 @@ from .common import slo as _slo_mod
 # gc_pause_flight_ms on every registry at daemon boot (the continuous
 # profiling observatory, common/profiler.py)
 from .common import profiler as _profiler_mod
+# likewise eager: declares heat_enabled/heat_vertices_k/
+# heat_hot_part_pct/staleness_breach_ms on every registry at daemon
+# boot and registers the flight "heat" collector (the workload & data
+# observatory, common/heat.py)
+from .common import heat as _heat_mod  # noqa: F401
 
 Handler = Callable[[Dict[str, str], bytes], Tuple[int, Any]]
 

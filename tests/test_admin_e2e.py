@@ -135,7 +135,8 @@ def test_show_hosts_and_parts(conn):
     conn.must("USE sp")
     r = conn.must("SHOW PARTS")
     assert len(r.rows) == 2
-    assert r.columns == ["Partition ID", "Leader", "Peers", "Losts"]
+    assert r.columns == ["Partition ID", "Leader", "Peers", "Losts",
+                         "Heat", "Staleness ms"]
 
 
 def test_drop_user_exact_role_match():

@@ -110,6 +110,48 @@ def test_profile_block_rules(tmp_path):
     assert benchdiff.main([str(a), str(b), "--advisory"]) == 0
 
 
+def test_skew_block_rules(tmp_path):
+    """ISSUE 14 satellite: SKEW_bench.json diffs — sketch recall and
+    the Zipf-phase skew index judge with tolerance; raw heat counters,
+    the advisory plan, hot-part shares and staleness watermarks are
+    advisory drift, never gated."""
+    old = {
+        "sketch": {"recall": 1.0, "evictions": 12, "tracked": 64},
+        "skew_index": {"uniform": 1.05, "zipf": 2.8,
+                       "separation": 2.6},
+        "advisor": {"spread_before": 155.0, "spread_after": 85.0},
+        "hot_part": {"top_share_pct": 31.0, "armed_pct": 26.0},
+        "overhead": {"qps_disarmed": 900.0, "qps_armed": 890.0,
+                     "ratio": 0.989},
+        "heat": {"parts_tracked": 8,
+                 "top_parts": [{"score_600s": 300.0}]},
+        "staleness_ms": 4.0,
+    }
+    new = json.loads(json.dumps(old))
+    # wild diagnostic swings: all advisory
+    new["advisor"]["spread_after"] = 300.0
+    new["hot_part"]["top_share_pct"] = 99.0
+    new["heat"]["top_parts"][0]["score_600s"] = 9.0
+    new["staleness_ms"] = 900.0
+    new["overhead"]["ratio"] = 0.5
+    new["sketch"]["evictions"] = 9999
+    new["skew_index"]["uniform"] = 3.0
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(old))
+    b.write_text(json.dumps(new))
+    assert benchdiff.main([str(a), str(b)]) == 0
+    # ... but recall collapsing IS a regression
+    new["sketch"]["recall"] = 0.4
+    b.write_text(json.dumps(new))
+    assert benchdiff.main([str(a), str(b)]) == 1
+    # ... and so is the Zipf skew index no longer separating
+    new["sketch"]["recall"] = 1.0
+    new["skew_index"]["zipf"] = 1.0
+    b.write_text(json.dumps(new))
+    assert benchdiff.main([str(a), str(b)]) == 1
+    assert benchdiff.main([str(a), str(b), "--advisory"]) == 0
+
+
 def test_custom_rule_wins(tmp_path):
     new = _new(parsed__value=50.0)
     r = benchdiff.compare(OLD, new)
@@ -221,3 +263,36 @@ def test_nebtop_parse_and_views():
     # render must not raise with or without a previous snapshot
     assert "nebtop" in nebtop.render(snap, None)
     assert nebtop.snapshot_dict(snap)["query_total"] == 42
+
+
+def test_nebtop_heat_panel():
+    """ISSUE 14: the hot-parts panel reads the nebula_part_heat_* and
+    nebula_heat_skew_index_* families and renders the top parts; the
+    panel is absent when heat is disarmed (families missing)."""
+    from nebula_tpu.tools import nebtop
+    text = (
+        "# TYPE nebula_part_heat_s1_p3_reads gauge\n"
+        'nebula_part_heat_s1_p3_reads{instance="b:2"} 120\n'
+        "# TYPE nebula_part_heat_s1_p3_score gauge\n"
+        'nebula_part_heat_s1_p3_score{instance="b:2"} 250.5\n'
+        "# TYPE nebula_part_heat_s1_p1_score gauge\n"
+        'nebula_part_heat_s1_p1_score{instance="b:2"} 10\n'
+        "# TYPE nebula_heat_skew_index_s1 gauge\n"
+        'nebula_heat_skew_index_s1{instance="b:2"} 2.75\n'
+        "# EOF\n")
+    snap = nebtop.Snapshot(nebtop.parse_samples(text), t=1.0)
+    ph = snap.part_heat()
+    assert ph["parts"][(1, 3, "b:2")]["score"] == 250.5
+    assert ph["parts"][(1, 3, "b:2")]["reads"] == 120
+    assert ph["skew"]["1"] == 2.75
+    lines = nebtop.render_heat(ph)
+    assert any("hot parts" in ln for ln in lines)
+    assert any("1:3" in ln for ln in lines)
+    # hottest part renders first
+    rows = [ln for ln in lines if ln.startswith("1:")]
+    assert rows[0].startswith("1:3")
+    # disarmed: no families -> no panel
+    empty = nebtop.Snapshot([], t=1.0)
+    assert nebtop.render_heat(empty.part_heat()) == []
+    d = nebtop.snapshot_dict(snap)
+    assert d["heat"]["parts"]["1:3@b:2"]["score"] == 250.5

@@ -1,0 +1,564 @@
+"""Workload & data observatory tests (ISSUE 14, common/heat.py):
+space-saving sketch bounds under adversarial streams, heat-slab
+window math, skew indices, disarmed byte-identity, the hot_part /
+staleness_breach flight triggers, the heartbeat heat carry into
+metad's views, the heat-aware BALANCE advisor, and replica staleness
+watermarks across a leader change in the raft fixture."""
+import time
+
+import pytest
+
+from nebula_tpu.common import heat
+from nebula_tpu.common.flags import graph_flags, storage_flags
+from nebula_tpu.common.heat import (FIELDS, HeatAccountant, SpaceSaving,
+                                    score_of)
+from raft_fixture import RaftCluster
+
+
+@pytest.fixture(autouse=True)
+def _heat_isolation():
+    """Every test runs with a clean process-global accountant and the
+    observatory flags back at defaults afterwards."""
+    heat.accountant.reset()
+    yield
+    heat.accountant.reset()
+    for reg in (graph_flags, storage_flags):
+        reg.set("heat_enabled", True)
+        reg.set("heat_vertices_k", 0)
+        reg.set("heat_hot_part_pct", 0)
+        reg.set("staleness_breach_ms", 0)
+
+
+# ------------------------------------------------------------- sketch
+
+def test_space_saving_error_bound_on_rotating_hot_set():
+    """Adversarial stream: the hot set ROTATES every phase (the
+    classic space-saving stressor — each new hot set must displace
+    the old one through the min-counter eviction path). Invariants:
+    reported count OVERestimates truth by at most err, and any item
+    with true frequency > total/k is tracked."""
+    k = 16
+    sk = SpaceSaving(k)
+    truth: dict = {}
+    vid = 10_000
+    for phase in range(6):
+        hot = [phase * 100 + i for i in range(6)]
+        for rep in range(40):
+            for h in hot:
+                sk.observe(h)
+                truth[h] = truth.get(h, 0) + 1
+            # two one-off background vids per hot sweep (churn that
+            # pressures the eviction path without dominating)
+            for _ in range(2):
+                sk.observe(vid)
+                truth[vid] = 1
+                vid += 1
+    assert len(sk.counts) <= k            # cardinality cap held
+    total = sum(truth.values())
+    assert sk.total == total
+    tracked = {r["vid"]: r for r in sk.topk()}
+    for v, r in tracked.items():
+        t = truth.get(v, 0)
+        assert r["count"] >= t            # never underestimates
+        assert r["count"] - r["err"] <= t  # err bounds the inflation
+    # guaranteed-present: anything with true freq > total/k
+    for v, t in truth.items():
+        if t > total / k:
+            assert v in tracked, (v, t, total / k)
+    # the final phase's hot set displaced its predecessors
+    last_hot = [500 + i for i in range(6)]
+    est_top = [r["vid"] for r in sk.topk(6)]
+    assert set(last_hot) & set(est_top)
+
+
+def test_space_saving_cardinality_cap_under_distinct_flood():
+    sk = SpaceSaving(32)
+    for v in range(10_000):
+        sk.observe(v)
+        assert len(sk.counts) <= 32
+    assert sk.evictions > 0
+    assert sk.total == 10_000
+
+
+def test_observe_vids_disarmed_is_flag_read_only():
+    """heat_vertices_k=0 (default): no sketch object is ever created,
+    whatever flows through the observe seam."""
+    acct = HeatAccountant()
+    acct.observe_vids(1, list(range(100)))
+    assert acct.sketch(1) is None
+    # armed: sketch materializes at the flag's k
+    graph_flags.set("heat_vertices_k", 8)
+    try:
+        acct.observe_vids(1, list(range(100)))
+        assert acct.sketch(1) is not None
+        assert acct.sketch(1).k == 8
+    finally:
+        graph_flags.set("heat_vertices_k", 0)
+
+
+# ------------------------------------------------------------- slabs
+
+def test_slab_windows_roll_and_lifetime_persists():
+    t = [1000.0]
+    acct = HeatAccountant(clock=lambda: t[0])
+    acct.charge(1, 2, reads=5, rows_scanned=100)
+    row = acct.parts_snapshot()[0]
+    assert row["60s"]["reads"] == 5 and row["600s"]["reads"] == 5
+    # +120 s: out of the 60s window, still inside 600s
+    t[0] += 120
+    row = acct.parts_snapshot()[0]
+    assert row["60s"]["reads"] == 0
+    assert row["600s"]["reads"] == 5
+    # +700 s total: out of every window; lifetime survives
+    t[0] += 600
+    row = acct.parts_snapshot()[0]
+    assert row["600s"]["reads"] == 0
+    assert row["life"]["reads"] == 5
+    assert row["life"]["rows_scanned"] == 100
+
+
+def test_charge_parts_splits_evenly_and_score_weights():
+    acct = HeatAccountant()
+    acct.charge_parts(7, (1, 2), device_us=2000)
+    scores = acct.space_scores(600)[7]
+    assert scores[1] == scores[2] == pytest.approx(
+        score_of({"device_us": 1000}))
+    fields = {f: 1 for f in FIELDS}
+    assert score_of(fields) == pytest.approx(
+        sum(heat.SCORE_WEIGHTS.values()))
+
+
+def test_skew_index_separates_uniform_from_concentrated():
+    acct = HeatAccountant()
+    for p in range(1, 9):
+        acct.charge(1, p, reads=100)
+    uniform = acct.skew_index(1)
+    assert uniform["index"] == pytest.approx(1.0, abs=0.01)
+    acct2 = HeatAccountant()
+    acct2.charge(1, 1, reads=930)
+    for p in range(2, 9):
+        acct2.charge(1, p, reads=10)
+    skewed = acct2.skew_index(1)
+    assert skewed["index"] > 4 * uniform["index"]
+    # empty space: defined, zeroed
+    assert acct2.skew_index(99) == {"index": 0.0, "p99": 0.0,
+                                    "mean": 0.0, "parts": 0}
+
+
+# --------------------------------------------- disarmed byte-identity
+
+def test_disarmed_charges_leave_no_trace():
+    """The profile_hz=0 idiom: with heat_enabled=false every charge/
+    observe seam is a flag read — no slabs, no sketches, no metric
+    families, so /metrics is byte-identical to a heat-free build (the
+    gauge source contributes zero families)."""
+    graph_flags.set("heat_enabled", False)
+    try:
+        acct = HeatAccountant()
+        acct.charge(1, 1, reads=50, writes=10)
+        acct.charge_parts(1, (1, 2, 3), device_us=9000)
+        graph_flags.set("heat_vertices_k", 16)
+        acct.observe_vids(1, list(range(64)))
+        tok = heat.observe_query(1, [1, 2, 3], 4)
+        assert tok is None
+        heat.charge_device(12345)
+        assert acct.parts_snapshot() == []
+        assert acct.gauges() == {}
+        assert acct.sketch(1) is None
+        assert heat.accountant.parts_snapshot() == []
+    finally:
+        graph_flags.set("heat_enabled", True)
+        graph_flags.set("heat_vertices_k", 0)
+
+
+def test_disarm_after_arming_silences_metric_families():
+    """Flipping heat_enabled off mid-flight hides the families on the
+    very next scrape (operator kill-switch), even though slab history
+    is retained for re-arming."""
+    acct = HeatAccountant()
+    acct.charge(1, 1, reads=5)
+    assert acct.gauges() != {}
+    graph_flags.set("heat_enabled", False)
+    try:
+        assert acct.gauges() == {}
+    finally:
+        graph_flags.set("heat_enabled", True)
+    assert acct.gauges() != {}
+
+
+# ------------------------------------------------- device attribution
+
+def test_observe_query_notes_parts_and_charges_device():
+    tok = heat.observe_query(3, [0, 1, 2, 3, 4, 5, 6, 7], 4)
+    try:
+        heat.charge_device(4000)
+    finally:
+        heat.restore(tok)
+    # one read per start, spread over its owner part (vid % 4 + 1)
+    scores = heat.accountant.space_scores(600)[3]
+    assert set(scores) == {1, 2, 3, 4}
+    snap = {r["part"]: r for r in heat.accountant.parts_snapshot()}
+    assert sum(r["600s"]["reads"] for r in snap.values()) == 8
+    assert sum(r["600s"]["device_us"]
+               for r in snap.values()) == pytest.approx(4000)
+    # outside the note: device charges go nowhere
+    heat.charge_device(100000)
+    snap2 = {r["part"]: r for r in heat.accountant.parts_snapshot()}
+    assert sum(r["600s"]["device_us"]
+               for r in snap2.values()) == pytest.approx(4000)
+
+
+# ------------------------------------------------------ flight wiring
+
+def test_hot_part_trigger_captures_bundle_with_heat_collector():
+    from nebula_tpu.common.flight import recorder
+    recorder.reset()
+    graph_flags.set("heat_hot_part_pct", 50)
+    try:
+        # one part draws ~97% of the space's 60s heat, over the floor
+        heat.accountant.charge(5, 1, reads=400)
+        heat.accountant.charge(5, 2, reads=10)
+        heat.accountant.check_hot_part(5)
+        assert recorder.flush(5)
+        bundles = [b for b in recorder.bundles
+                   if b["trigger"] == "hot_part"]
+        assert bundles, recorder.describe()
+        b = bundles[-1]
+        assert b["event"]["space"] == 5 and b["event"]["part"] == 1
+        assert b["event"]["share"] > 90
+        # the registered collector embeds the /heat capture
+        assert "heat" in b.get("collectors", {})
+        assert b["collectors"]["heat"]["parts"]
+    finally:
+        graph_flags.set("heat_hot_part_pct", 0)
+        recorder.reset()
+
+
+def test_hot_part_disarmed_and_idle_space_never_fire():
+    from nebula_tpu.common.flight import recorder
+    recorder.reset()
+    # disarmed (pct=0): nothing fires no matter the concentration
+    heat.accountant.charge(6, 1, reads=500)
+    heat.accountant.check_hot_part(6)
+    # armed but under the minimum-score floor: idle spaces are quiet
+    graph_flags.set("heat_hot_part_pct", 10)
+    try:
+        heat.accountant.charge(7, 1, reads=3)
+        heat.accountant.check_hot_part(7)
+        recorder.flush(5)
+        assert not [b for b in recorder.bundles
+                    if b["trigger"] == "hot_part"]
+    finally:
+        graph_flags.set("heat_hot_part_pct", 0)
+        recorder.reset()
+
+
+# ------------------------------------- heartbeat carry + metad views
+
+def _meta_with_heat():
+    from nebula_tpu.meta.service import MetaService
+    meta = MetaService(expired_threshold_secs=3600)
+    hosts = ["10.1.0.1:1", "10.1.0.2:1"]
+    for h in hosts:
+        meta.heartbeat(h, "storage")
+    sid = meta.create_space("hv", partition_num=4,
+                            replica_factor=2).value()
+    alloc = meta.get_parts_alloc(sid)
+    leaders = {p: hs[0] for p, hs in alloc.items()}
+    for h in hosts:
+        led = sorted(p for p, l in leaders.items() if l == h)
+        payload = {
+            "parts": {sid: {p: {"reads": 10.0 * p, "score": 10.0 * p}
+                            for p in led}},
+            "staleness": {sid: {p: {"max_ms": 7.5 * p,
+                                    "replicas": {"r": 7.5 * p}}
+                                for p in led}},
+        }
+        meta.heartbeat(h, "storage", leader_parts={sid: led},
+                       part_heat=payload)
+    return meta, sid, hosts, leaders
+
+
+def test_heartbeat_heat_carry_feeds_meta_views():
+    meta, sid, hosts, leaders = _meta_with_heat()
+    ho = {h["host"]: h for h in meta.hosts_overview()}
+    for h in hosts:
+        led = [p for p, l in leaders.items() if l == h]
+        assert ho[h]["leader_heat"] == pytest.approx(
+            sum(10.0 * p for p in led), abs=0.1)
+    rows = meta.parts_overview(sid)
+    assert len(rows[0]) == 6            # + heat, staleness columns
+    for pid, leader, _hosts, _losts, score, stale in rows:
+        assert score == pytest.approx(10.0 * pid, abs=0.1)
+        assert stale == pytest.approx(7.5 * pid, abs=0.1)
+    hv = meta.heat_overview()
+    assert set(hv["hosts"]) == set(hosts)
+    assert hv["staleness"]
+    # a malformed payload never fails the beat or poisons the view
+    st = meta.heartbeat(hosts[0], "storage", part_heat="garbage")
+    assert st.ok()
+    assert set(meta.heat_overview()["hosts"]) == set(hosts)
+
+
+def test_heat_advisor_reduces_modeled_spread():
+    meta, sid, hosts, leaders = _meta_with_heat()
+    from nebula_tpu.meta.balancer import Balancer
+    bal = Balancer(meta, admin=None)
+    meta.attach_balancer(bal)
+    # make host 1 deliberately hot: re-beat with a skewed ladder
+    led0 = sorted(p for p, l in leaders.items() if l == hosts[0])
+    meta.heartbeat(
+        hosts[0], "storage", leader_parts={sid: led0},
+        part_heat={"parts": {sid: {p: {"score": 200.0 + i}
+                                   for i, p in enumerate(led0)}}})
+    advise = meta.balance_advise_heat().value()
+    assert advise["advisory"] is True
+    assert advise["moves"], advise
+    assert advise["spread_after"] < advise["spread_before"]
+    for m in advise["moves"]:
+        assert m["src"] != m["dst"] and m["score"] > 0
+        assert m["kind"] in ("leader", "move")
+    # modeled totals are conserved: moves shuffle heat, never mint it
+    assert sum(advise["planned"].values()) == pytest.approx(
+        sum(advise["current"].values()), abs=0.5)
+
+
+def test_disarmed_storage_beat_drops_meta_heat_view():
+    """The disarm kill-switch reaches metad: once a storage node's
+    heartbeats stop carrying part_heat (heat_enabled=false ->
+    heat_source returns None), its frozen telemetry leaves SHOW
+    HOSTS/PARTS and the advisor within one beat."""
+    meta, sid, hosts, leaders = _meta_with_heat()
+    assert set(meta.heat_overview()["hosts"]) == set(hosts)
+    meta.heartbeat(hosts[0], "storage")          # no part_heat field
+    assert set(meta.heat_overview()["hosts"]) == {hosts[1]}
+    ho = {h["host"]: h for h in meta.hosts_overview()}
+    assert ho[hosts[0]]["leader_heat"] == 0.0
+    # graph-role beats never clear storage telemetry
+    meta.heartbeat("10.9.9.9:1", "graph")
+    assert set(meta.heat_overview()["hosts"]) == {hosts[1]}
+
+
+def test_heat_advisor_prefers_replica_holder_over_cooler_nonreplica():
+    """Among spread-improving destinations, a replica holder wins
+    outright (a TRANS_LEADER-shaped move) even when a non-replica
+    host would model slightly cooler — the preference is real, not a
+    float-equality tie-break."""
+    from types import SimpleNamespace
+
+    from nebula_tpu.meta.balancer import Balancer
+
+    class FakeMeta:
+        def heat_overview(self):
+            return {"hosts": {
+                "A": {"parts": {"1:1": 30.0, "1:3": 20.0},
+                      "total": 50.0},
+                "B": {"parts": {"1:2": 4.0}, "total": 4.0},
+                "C": {"parts": {}, "total": 0.0},
+            }, "staleness": []}
+
+        def list_spaces(self):
+            return [SimpleNamespace(space_id=1)]
+
+        def get_parts_alloc(self, sid):
+            return {1: ["A", "B"], 2: ["B", "C"], 3: ["A", "C"]}
+
+    bal = Balancer(FakeMeta(), admin=None,
+                   get_active_hosts=lambda: ["A", "B", "C"])
+    advise = bal.advise_heat()
+    assert advise["moves"], advise
+    m = advise["moves"][0]
+    # part 1 (score 30) off hot host A: C models cooler after the
+    # move, but B holds a replica — B must win, as kind="leader"
+    assert (m["space"], m["part"]) == (1, 1)
+    assert m["dst"] == "B" and m["kind"] == "leader"
+    assert advise["spread_after"] < advise["spread_before"]
+
+
+def test_heat_advisor_empty_view_is_a_noop_plan():
+    from nebula_tpu.meta.balancer import Balancer
+    from nebula_tpu.meta.service import MetaService
+    meta = MetaService(expired_threshold_secs=3600)
+    meta.heartbeat("10.2.0.1:1", "storage")
+    meta.attach_balancer(Balancer(meta, admin=None))
+    advise = meta.balance_advise_heat().value()
+    assert advise["moves"] == []
+    assert advise["spread_after"] == advise["spread_before"]
+
+
+def test_balance_data_heat_parses():
+    from nebula_tpu.parser import GQLParser
+    from nebula_tpu.parser.ast import BalanceSentence
+
+    def parse(text):
+        return GQLParser().parse(text).sentences[0]
+
+    s = parse("BALANCE DATA heat")
+    assert isinstance(s, BalanceSentence) and s.sub == "HEAT"
+    assert "heat" in s.to_string()
+    s2 = parse("BALANCE DATA")
+    assert s2.sub == "DATA"
+
+
+# ------------------------------------------- staleness watermarks
+
+def test_staleness_watermarks_across_leader_change(tmp_path):
+    """Leader-side replica watermarks: caught-up followers read ~0
+    staleness, an isolated follower's staleness grows with wall time
+    and its applied watermark pins at the pre-partition commit; after
+    a LEADER CHANGE the new leader owns the measurement (the old
+    leader reports none) and the healed replica's staleness collapses
+    once it catches up."""
+    c = RaftCluster(3, tmp_path)
+    try:
+        leader = c.wait_leader()
+        for i in range(5):
+            assert leader.append_async(b"w%d" % i).result(timeout=3) \
+                .name == "SUCCEEDED"
+        c.wait_commit(5)
+        time.sleep(0.2)                 # one replication round
+        marks = leader.replica_watermarks()
+        assert len(marks) == 2
+        for m in marks:
+            assert m["applied"] == m["commit"] == leader.committed_id
+            assert m["lag"] == 0
+            assert m["staleness_ms"] < 2000
+        # isolate one follower; its watermark must stall and age
+        behind = marks[0]["addr"]
+        c.isolate(behind)
+        pre_commit = leader.committed_id
+        for i in range(3):
+            assert leader.append_async(b"x%d" % i).result(timeout=3) \
+                .name == "SUCCEEDED"
+        time.sleep(0.6)
+        by_addr = {m["addr"]: m for m in leader.replica_watermarks()}
+        assert by_addr[behind]["lag"] >= 3
+        assert by_addr[behind]["applied"] <= pre_commit
+        assert by_addr[behind]["staleness_ms"] >= 400
+        healthy = [a for a in by_addr if a != behind][0]
+        assert by_addr[healthy]["lag"] == 0
+        assert by_addr[healthy]["staleness_ms"] < \
+            by_addr[behind]["staleness_ms"]
+        # status_with_replicas surfaces the same marks (the /raft row)
+        st = leader.status_with_replicas()
+        assert st["staleness_ms"] == pytest.approx(
+            max(m["staleness_ms"] for m in st["replicas"]), abs=50)
+        # ---- leader change: depose the current leader
+        c.heal(behind)
+        old = leader.addr
+        c.isolate(old)
+        others = [a for a in c.voting if a != old]
+        new_leader = c.wait_leader(among=others)
+        c.heal(old)
+        c.wait_commit(8, addrs=others)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            nm = {m["addr"]: m for m in
+                  new_leader.replica_watermarks()}
+            if old in nm and nm[old]["lag"] == 0 and \
+                    nm[behind if behind != new_leader.addr
+                       else old]["lag"] == 0:
+                break
+            time.sleep(0.05)
+        nm = {m["addr"]: m for m in new_leader.replica_watermarks()}
+        assert set(nm) == {a for a in c.voting
+                           if a != new_leader.addr}
+        for m in nm.values():
+            assert m["lag"] == 0, nm
+        # the deposed leader measures nothing
+        time.sleep(0.3)
+        assert c.parts[old].replica_watermarks() == []
+        assert c.parts[old].status_with_replicas()["replicas"] == []
+    finally:
+        c.stop()
+
+
+def test_staleness_breach_flight_event(tmp_path):
+    """staleness_breach_ms armed: a follower held behind long enough
+    records a breach event that fires the flight rule."""
+    from nebula_tpu.common.flight import recorder
+    recorder.reset()
+    storage_flags.set("staleness_breach_ms", 200)
+    graph_flags.set("staleness_breach_ms", 200)
+    c = RaftCluster(3, tmp_path)
+    try:
+        leader = c.wait_leader()
+        assert leader.append_async(b"a").result(timeout=3).name == \
+            "SUCCEEDED"
+        c.wait_commit(1)
+        behind = [a for a in c.voting if a != leader.addr][0]
+        c.isolate(behind)
+        assert leader.append_async(b"b").result(timeout=3).name == \
+            "SUCCEEDED"
+        deadline = time.monotonic() + 6
+        ev = None
+        while time.monotonic() < deadline and ev is None:
+            ev = next((e for e in list(recorder._ring)
+                       if e["kind"] == "staleness_breach"), None)
+            time.sleep(0.1)
+        assert ev is not None, recorder.describe()
+        assert ev["replica"] == behind
+        assert ev["staleness_ms"] > 200
+        recorder.flush(5)
+        assert [b for b in recorder.bundles
+                if b["trigger"] == "staleness_breach"]
+    finally:
+        c.stop()
+        graph_flags.set("staleness_breach_ms", 0)
+        storage_flags.set("staleness_breach_ms", 0)
+        recorder.reset()
+
+
+def test_raft_append_charges_write_heat(tmp_path):
+    c = RaftCluster(3, tmp_path)
+    try:
+        leader = c.wait_leader()
+        heat.accountant.reset()
+        for i in range(4):
+            assert leader.append_async(b"h%d" % i).result(timeout=3) \
+                .name == "SUCCEEDED"
+        snap = {(r["space"], r["part"]): r
+                for r in heat.accountant.parts_snapshot()}
+        assert snap[(1, 1)]["600s"]["raft_appends"] >= 4
+    finally:
+        c.stop()
+
+
+# -------------------------------------------------- degree-skew stats
+
+def test_degree_stats_once_per_build():
+    import numpy as np
+
+    from nebula_tpu.cluster import InProcCluster
+    from nebula_tpu.engine_tpu import TpuGraphEngine
+
+    tpu = TpuGraphEngine()
+    cluster = InProcCluster(tpu_engine=tpu)
+    conn = cluster.connect()
+    conn.must("CREATE SPACE deg(partition_num=2, replica_factor=1)")
+    conn.must("USE deg")
+    conn.must("CREATE TAG person(age int)")
+    conn.must("CREATE EDGE knows(ts int)")
+    conn.must("INSERT VERTEX person(age) VALUES " + ", ".join(
+        f"{i}:({i})" for i in range(20)))
+    # vid 0 is the hub: degree 12; everyone else degree <= 2
+    edges = [(0, d) for d in range(1, 13)] + [(5, 6), (7, 8), (7, 9)]
+    conn.must("INSERT EDGE knows(ts) VALUES " + ", ".join(
+        f"{s} -> {d}:({i})" for i, (s, d) in enumerate(edges)))
+    sid = cluster.meta.get_space("deg").value().space_id
+    tpu.prewarm(sid, block=True)
+    snap = tpu.snapshot(sid)
+    ds = snap.degree_stats
+    assert ds["max"] == 12
+    # 2x stored rows: every forward edge has a reverse copy under the
+    # dst vid (negative etype) — the stats describe the built layout
+    assert ds["edges"] == 2 * len(edges)
+    assert ds["vertices"] == 20
+    assert ds["cap_e"] == snap.cap_e
+    hubs = ds["hubs"]
+    assert hubs[0]["vid"] == 0 and hubs[0]["out_degree"] == 12
+    assert hubs[0]["cap_e_share"] == pytest.approx(12 / snap.cap_e,
+                                                   abs=1e-4)
+    assert all(hubs[i]["out_degree"] >= hubs[i + 1]["out_degree"]
+               for i in range(len(hubs) - 1))
+    assert ds["p99"] <= ds["max"] and ds["mean"] > 0

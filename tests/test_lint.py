@@ -459,6 +459,24 @@ def test_nl004_profiler_family_kinds_pinned(tmp_path):
     assert all("contractually" in f.message for f in fs)
 
 
+def test_nl004_heat_family_kinds_pinned(tmp_path):
+    """ISSUE 14: the workload-observatory families are pinned —
+    heat.* feed counters are contractually counters and
+    raftex.staleness_ms is a native histogram (its bucket series
+    feeds the staleness SLO / federation tests); f-string prefixes
+    included."""
+    fs, _ = lint(tmp_path, {"nebula_tpu/m.py": """
+        from nebula_tpu.common.stats import stats
+
+        def feed(n, ms, space):
+            stats.add_value("heat.sketch.observed", n, kind="counter")
+            stats.add_value(f"heat.sketch.{space}", n, kind="timing")
+            stats.add_value("raftex.staleness_ms", ms, kind="counter")
+    """}, ["NL004"])
+    assert codes(fs) == ["NL004", "NL004"]
+    assert all("contractually" in f.message for f in fs)
+
+
 def test_full_tree_has_zero_non_baselined_findings():
     """THE gate: the committed tree, scanned with every rule, carries
     no finding that is neither inline-suppressed (with a reason) nor

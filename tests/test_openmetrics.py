@@ -299,3 +299,123 @@ def test_flight_and_slo_endpoints_serve_on_every_daemon():
         assert st == 200 and "objectives" in body
     finally:
         metad.stop()
+
+
+def test_heat_families_conformance_and_federation(tmp_path):
+    """ISSUE 14 satellite: the workload-observatory families — the
+    nebula_part_heat_* per-part gauges, the nebula_heat_skew_index_*
+    per-space gauges, the nebula_heat_sketch_observed counter and the
+    nebula_raftex_staleness_ms native histogram — parse STRICTLY on
+    every daemon's /metrics and federate through /cluster_metrics
+    with instance labels. Disarming heat removes the gauge families
+    from the very next scrape (the byte-identity contract)."""
+    from nebula_tpu.client import GraphClient
+    from nebula_tpu.common import heat as heat_mod
+    from nebula_tpu.common.flags import graph_flags, storage_flags
+    from nebula_tpu.daemons import (serve_graphd, serve_metad,
+                                    serve_storaged)
+    from nebula_tpu.engine_tpu import TpuGraphEngine
+    from raft_fixture import RaftCluster
+
+    heat_mod.accountant.reset()
+    graph_flags.set("heat_enabled", True)
+    storage_flags.set("heat_enabled", True)
+    graph_flags.set("heat_vertices_k", 32)
+    storage_flags.set("heat_vertices_k", 32)
+    metad = serve_metad(ws_port=0)
+    storaged = serve_storaged(metad.addr, load_interval=0.1, ws_port=0)
+    tpu = TpuGraphEngine()
+    graphd = serve_graphd(metad.addr, tpu_engine=tpu, ws_port=0)
+    raftc = None
+    try:
+        gc = GraphClient(graphd.addr).connect()
+        for s in ("CREATE SPACE omheat(partition_num=2)", "USE omheat",
+                  "CREATE TAG t(x int)", "CREATE EDGE e(w int)",
+                  "INSERT VERTEX t(x) VALUES 1:(5), 2:(6), 3:(7)",
+                  "INSERT EDGE e(w) VALUES 1 -> 2:(3), 2 -> 3:(4)"):
+            r = gc.execute(s)
+            assert r.ok(), (s, r.error_msg)
+        q = "GO 2 STEPS FROM 1 OVER e YIELD e.w AS w"
+        for _ in range(20):
+            if gc.execute(q).rows:
+                break
+            time.sleep(0.05)
+        for _ in range(5):
+            gc.execute(q)
+        # the real raftex staleness site: a leader with followers in
+        # THIS process feeds the shared raftex.staleness_ms histogram
+        raftc = RaftCluster(2, tmp_path)
+        leader = raftc.wait_leader()
+        assert leader.append_async(b"x").result(timeout=3).name == \
+            "SUCCEEDED"
+        deadline = time.time() + 5
+        from nebula_tpu.common.stats import stats as _stats
+        while time.time() < deadline and \
+                "raftex.staleness_ms" not in _stats.histogram_names():
+            time.sleep(0.05)
+        assert "raftex.staleness_ms" in _stats.histogram_names()
+
+        # strict conformance on ALL THREE daemons (parse() validates
+        # the whole document); graphd + storaged additionally carry
+        # the per-part gauge families, every daemon the shared
+        # sketch counter + staleness histogram
+        for port, daemon in ((graphd.ws_port, "graphd"),
+                             (storaged.ws_port, "storaged"),
+                             (metad.ws_port, "metad")):
+            fams = parse(_scrape(port))
+            assert "nebula_heat_sketch_observed" in fams, daemon
+            assert fams["nebula_heat_sketch_observed"].type == \
+                "counter", daemon
+            stale = fams["nebula_raftex_staleness_ms"]
+            assert stale.type == "histogram", daemon
+            count = [s for s in stale.samples
+                     if s.name == stale.name + "_count"][0]
+            assert count.value >= 1, daemon
+            heat_fams = [f for f in fams
+                         if f.startswith("nebula_part_heat_")]
+            skew_fams = [f for f in fams
+                         if f.startswith("nebula_heat_skew_index_")]
+            if daemon in ("graphd", "storaged"):
+                assert heat_fams, daemon
+                assert skew_fams, daemon
+                for f in heat_fams + skew_fams:
+                    assert fams[f].type == "gauge", (daemon, f)
+
+        # federation: /cluster_metrics strict-parses and carries the
+        # part-heat families with instance labels from both roles
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{graphd.ws_port}/cluster_metrics"
+                ) as r:
+            doc = r.read().decode()
+        cfams = parse(doc)
+        heat_fams = [f for f in cfams
+                     if f.startswith("nebula_part_heat_")]
+        assert heat_fams
+        insts = set()
+        for f in heat_fams:
+            for s in cfams[f].samples:
+                insts.add(s.labels.get("instance"))
+        assert len(insts) >= 2, insts     # graphd AND storaged slabs
+        assert "nebula_raftex_staleness_ms" in cfams
+
+        # kill switch: disarm -> the gauge families vanish from the
+        # next scrape on every daemon that served them
+        graph_flags.set("heat_enabled", False)
+        storage_flags.set("heat_enabled", False)
+        for port in (graphd.ws_port, storaged.ws_port):
+            fams = parse(_scrape(port))
+            assert not [f for f in fams
+                        if f.startswith("nebula_part_heat_")]
+            assert not [f for f in fams
+                        if f.startswith("nebula_heat_skew_index_")]
+    finally:
+        if raftc is not None:
+            raftc.stop()
+        graphd.stop()
+        storaged.stop()
+        metad.stop()
+        graph_flags.set("heat_enabled", True)
+        storage_flags.set("heat_enabled", True)
+        graph_flags.set("heat_vertices_k", 0)
+        storage_flags.set("heat_vertices_k", 0)
+        heat_mod.accountant.reset()

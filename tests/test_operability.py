@@ -867,3 +867,154 @@ def test_profile_endpoints_3daemon():
         assert set(j["threads"]) <= {role}
     finally:
         graphd.stop(); storaged.stop(); metad.stop()
+
+
+def test_heat_observatory_3daemon(tmp_path):
+    """Acceptance (ISSUE 14): the workload & data observatory proven
+    e2e on a real 3-daemon topology — /heat serves on graphd AND
+    storaged with populated slabs/sketches, the heartbeat carries the
+    leaders' heat + staleness to metad, SHOW HOSTS gains the Leader
+    heat column and SHOW PARTS the Heat/Staleness columns, BALANCE
+    DATA heat returns the advisory table, metad's /balance?heat=1
+    reports the modeled plan, and /cluster_metrics federates the
+    nebula_part_heat_* families from both roles."""
+    import json as _json
+    import time
+    import urllib.request
+    from nebula_tpu.client import GraphClient
+    from nebula_tpu.common import heat as heat_mod
+    from nebula_tpu.common.flags import graph_flags, storage_flags
+    from nebula_tpu.daemons import (serve_graphd, serve_metad,
+                                    serve_storaged)
+    from nebula_tpu.engine_tpu import TpuGraphEngine
+
+    heat_mod.accountant.reset()
+    old_hb = storage_flags.get("heartbeat_interval_secs")
+    storage_flags.set("heartbeat_interval_secs", 0.2)
+    graph_flags.set("heat_vertices_k", 32)
+    storage_flags.set("heat_vertices_k", 32)
+    metad = serve_metad(ws_port=0)
+    s0 = serve_storaged(metad.addr, replicated=True,
+                        data_dir=str(tmp_path / "s0"),
+                        load_interval=0.1, ws_port=0)
+    s1 = serve_storaged(metad.addr, replicated=True,
+                        data_dir=str(tmp_path / "s1"),
+                        load_interval=0.1, ws_port=0)
+    tpu = TpuGraphEngine()
+    graphd = serve_graphd(metad.addr, tpu_engine=tpu, ws_port=0)
+
+    def http(port, path):
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}") as r:
+            return _json.loads(r.read()), r.status
+
+    try:
+        gc = GraphClient(graphd.addr).connect()
+        r = gc.execute("CREATE SPACE heatobs(partition_num=4, "
+                       "replica_factor=2)")
+        assert r.ok(), r.error_msg
+        assert gc.execute("USE heatobs").ok()
+        for s in ("CREATE TAG t(x int)", "CREATE EDGE e(w int)"):
+            assert gc.execute(s).ok()
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            r = gc.execute("INSERT VERTEX t(x) VALUES " + ", ".join(
+                f"{i}:({i})" for i in range(16)))
+            if r.ok():
+                break
+            time.sleep(0.2)
+        assert r.ok(), r.error_msg
+        assert gc.execute("INSERT EDGE e(w) VALUES " + ", ".join(
+            f"{i} -> {(i + 1) % 16}:({i})" for i in range(16))).ok()
+        q = "GO 2 STEPS FROM 1 OVER e YIELD e._dst"
+        for _ in range(40):
+            if gc.execute(q).rows:
+                break
+            time.sleep(0.1)
+        for i in range(24):
+            gc.execute(f"GO 2 STEPS FROM {i % 4} OVER e "
+                       f"YIELD e._dst")
+
+        # ---- /heat on graphd: slabs + skew + sketches + degrees
+        body, st = http(graphd.ws_port, "/heat?vertices=1")
+        assert st == 200 and body["enabled"]
+        assert body["parts"], body
+        assert body["skew"]
+        assert body["vertices"]["spaces"]
+        assert any(s["top"] for s in body["vertices"]["spaces"]
+                   .values())
+        assert "degrees" in body["vertices"]
+        # ---- /heat on storaged: slabs + the staleness watermarks
+        sid = metad.meta.get_space("heatobs").value().space_id
+        stale_rows = []
+        for sd in (s0, s1):
+            body, st = http(sd.ws_port, "/heat")
+            assert st == 200 and body["enabled"]
+            stale_rows.extend(body.get("staleness", []))
+        # at least one leader reports populated per-replica watermarks
+        assert stale_rows
+        for row in stale_rows:
+            assert row["replicas"], row
+            for m in row["replicas"]:
+                assert m["applied"] <= m["commit"], m
+                assert m["match"] >= m["applied"], m
+                assert m["staleness_ms"] >= 0, m
+        # ---- /raft carries per-replica watermarks on leaders
+        for sd in (s0, s1):
+            body, st = http(sd.ws_port, "/raft")
+            leads = [p for p in body["parts"]
+                     if p["role"] == "LEADER"]
+            for p in leads:
+                assert "replicas" in p and "staleness_ms" in p
+
+        # ---- heartbeat carry -> SHOW HOSTS / SHOW PARTS columns
+        deadline = time.time() + 10
+        rows = []
+        while time.time() < deadline:
+            r = gc.execute("SHOW PARTS")
+            assert r.ok(), r.error_msg
+            assert r.columns == ["Partition ID", "Leader", "Peers",
+                                 "Losts", "Heat", "Staleness ms"]
+            rows = r.rows
+            if any(row[4] > 0 for row in rows):
+                break
+            time.sleep(0.3)
+        assert any(row[4] > 0 for row in rows), rows
+        r = gc.execute("SHOW HOSTS")
+        assert r.ok()
+        assert r.columns[-1] == "Leader heat"
+        assert any(row[-1] > 0 for row in r.rows), r.rows
+
+        # ---- the advisor surfaces: statement + metad endpoint
+        r = gc.execute("BALANCE DATA heat")
+        assert r.ok(), r.error_msg
+        assert r.columns == ["Kind", "Detail", "Heat", "Planned"]
+        kinds = {row[0] for row in r.rows}
+        assert "host" in kinds and "spread" in kinds
+        body, st = http(metad.ws_port, "/balance?heat=1")
+        assert st == 200 and body["advisory"] is True
+        assert set(body["current"])    # per-host heat present
+
+        # ---- /cluster_metrics federates the heat families
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{graphd.ws_port}/cluster_metrics"
+                ) as resp:
+            doc = resp.read().decode()
+        assert "nebula_part_heat_" in doc
+        assert "nebula_heat_skew_index_" in doc
+        insts = set()
+        for line in doc.splitlines():
+            if line.startswith("nebula_part_heat_") and \
+                    "instance=" in line:
+                insts.add(line.split('instance="', 1)[1]
+                          .split('"', 1)[0])
+        assert len(insts) >= 2, insts   # graphd + a storaged
+    finally:
+        graphd.stop()
+        s0.stop()
+        s1.stop()
+        metad.stop()
+        storage_flags.set("heartbeat_interval_secs", old_hb)
+        graph_flags.set("heat_vertices_k", 0)
+        storage_flags.set("heat_vertices_k", 0)
+        heat_mod.accountant.reset()
