@@ -1435,6 +1435,342 @@ def bench_skew(out_path: str, trim: bool = False):
         raise SystemExit(f"SKEW tier FAILED gates: {failed}")
 
 
+def bench_consistency(out_path: str, trim: bool = False):
+    """Consistency observatory proof tier (`bench.py --consistency`;
+    docs/manual/10-observability.md, "Consistency observatory").
+    Tier-1-safe on XLA:CPU. PASSES only when
+
+      (a) DISARMED IS FREE: with consistency_enabled=false a whole
+          warm read+write loop leaves ZERO nebula_consistency_*/
+          nebula_shadow_* families on the metrics surface (byte-
+          identical /metrics), no part digests and no shadow state;
+      (b) CLEAN PHASE IS SILENT: armed, a single-host mixed workload
+          with shadow-read sampling at 0.5 produces verifications > 0
+          with ZERO mismatches (the production-resident identity
+          discipline), every part's deep scrub agrees with its
+          incremental digest, and the device-snapshot audit checks
+          clean — zero false positives anywhere;
+      (c) SHOW CONSISTENCY renders per-part digest rows;
+      (d) CORRUPTION IS DETECTED: on a REAL 3-replica raft cluster
+          (metad + 3 replicated storaged + TPU graphd, localhost TCP)
+          an armed `consistency.corrupt:n=1` flips one byte of one
+          committed put on one replica — the leader's digest exchange
+          must flag the divergence within DETECT_WINDOW_S, the
+          `replica_divergence` flight bundle must name the part,
+          replica and anchor, the per-part digest_ok gauge must drop
+          to 0 on /metrics, and the pre-corruption clean window must
+          have had zero divergence (no false positives).
+    """
+    import shutil
+    import tempfile
+    import threading
+    import urllib.request
+
+    from nebula_tpu.client import GraphClient
+    from nebula_tpu.cluster import InProcCluster
+    from nebula_tpu.common import consistency as cons
+    from nebula_tpu.common.faults import faults
+    from nebula_tpu.common.flags import graph_flags, storage_flags
+    from nebula_tpu.common.flight import recorder as flight_rec
+    from nebula_tpu.common.stats import stats as global_stats
+    from nebula_tpu.daemons import (serve_graphd, serve_metad,
+                                    serve_storaged)
+    from nebula_tpu.engine_tpu import TpuGraphEngine
+
+    seed = int(os.environ.get("BENCH_CONSISTENCY_SEED", 23))
+    DETECT_WINDOW_S = 5.0
+    parts = 3
+    v, e = (240, 1500) if trim else (1000, 8000)
+    n_reads = 60 if trim else 300
+    rng = np.random.default_rng(seed)
+    gates: dict = {}
+    art: dict = {"seed": seed, "trim": trim,
+                 "graph": {"V": v, "E": e, "parts": parts},
+                 "detect_window_s": DETECT_WINDOW_S}
+
+    def cons_metric_lines():
+        return [ln for ln in global_stats.prometheus_lines()
+                if "nebula_consistency" in ln or "nebula_shadow" in ln]
+
+    # ---- phase 0: DISARMED — the whole loop must leave no trace
+    cons.shadow.reset()
+    flight_rec.reset()
+    graph_flags.set("consistency_enabled", False)
+    storage_flags.set("consistency_enabled", False)
+    graph_flags.set("shadow_read_rate", 0.0)
+    tpu = TpuGraphEngine()
+    cluster = InProcCluster(tpu_engine=tpu)
+    conn = cluster.connect()
+    srcs, dsts, ts = zipf_edges(rng, v, e, clip=100)
+    insert_person_knows(conn, "consb", parts, v, srcs, dsts, ts)
+    sid = cluster.meta.get_space("consb").value().space_id
+    tpu.prewarm(sid, block=True)
+
+    def go(start, steps=2):
+        return conn.must(f"GO {steps} STEPS FROM {int(start)} "
+                         f"OVER knows YIELD knows._dst, knows.ts")
+
+    for s in rng.integers(0, v, 24):
+        go(s)
+    conn.must(f"INSERT EDGE knows(ts) VALUES 1 -> 2:(7)")
+    go(1)
+    lines0 = cons_metric_lines()
+    gates["disarmed_no_metric_families"] = lines0 == []
+    gates["disarmed_no_store_digest"] = \
+        cluster.store.space_digest(sid) is None
+    gates["disarmed_no_shadow"] = \
+        cons.shadow.stats()["sampled"] == 0
+    art["disarmed"] = {"metric_lines": len(lines0)}
+
+    # ---- phase 1: ARMED single host — clean-phase silence + shadow
+    # identity + scrub + snapshot audit + SHOW CONSISTENCY
+    graph_flags.set("consistency_enabled", True)
+    storage_flags.set("consistency_enabled", True)
+    graph_flags.set("shadow_read_rate", 0.5)
+    cons.shadow.reset()
+    div0 = global_stats.lifetime_total("consistency.divergence")
+    writes = 0
+    for i, s in enumerate(rng.integers(0, v, n_reads)):
+        if i % 10 == 9:      # writes interleaved: stale-skip machinery
+            conn.must(f"INSERT EDGE knows(ts) VALUES "
+                      f"{int(s)} -> {int((s * 13 + 1) % v)}:"
+                      f"({int(s) % 1000})")
+            writes += 1
+            continue
+        if i % 7 == 3:
+            conn.must(f"FETCH PROP ON person {int(s)}")
+        else:
+            go(s, steps=1 + int(s) % 2)
+    go(0)                    # settle the snapshot at the final version
+    gates["shadow_drained"] = cons.shadow.drain(30)
+    sh = cons.shadow.stats()
+    art["shadow"] = {k: sh[k] for k in
+                     ("sampled", "verified", "mismatches",
+                      "skipped_stale", "errors", "dropped")}
+    gates["shadow_sampled"] = sh["sampled"] > 0
+    gates["shadow_verified"] = sh["verified"] > 0
+    gates["shadow_identity_green"] = sh["mismatches"] == 0
+    scrubs = [p.digest_scrub() for p in cluster.store.space_parts(sid)]
+    art["scrub"] = scrubs
+    gates["scrub_green"] = bool(scrubs) and \
+        all(r["ok"] is True for r in scrubs)
+    audit = None
+    for _ in range(50):
+        audit = tpu.audit_snapshots()
+        if audit["checked"] >= 1 or audit["mismatches"]:
+            break
+        go(0)
+        time.sleep(0.05)
+    art["audit"] = audit
+    gates["audit_checked"] = audit is not None and \
+        audit["checked"] >= 1
+    gates["audit_green"] = audit is not None and \
+        audit["mismatches"] == 0
+    showr = conn.must("SHOW CONSISTENCY")
+    art["show_consistency_rows"] = len(showr.rows)
+    gates["show_consistency"] = len(showr.rows) >= parts
+    gates["clean_phase_no_divergence"] = \
+        global_stats.lifetime_total("consistency.divergence") == div0
+    graph_flags.set("shadow_read_rate", 0.0)
+    log(f"CONSISTENCY phase 1: shadow={art['shadow']} "
+        f"scrubs={len(scrubs)} audit={audit}")
+
+    # ---- phase 2: the corruption drill on a REAL replicated cluster
+    space = "consrep"
+    run_dir = tempfile.mkdtemp(prefix="nebula_tpu_consbench_")
+    old_hb = storage_flags.get("heartbeat_interval_secs")
+    old_rhb = storage_flags.get("raft_heartbeat_ms")
+    old_rel = storage_flags.get("raft_election_timeout_ms")
+    storage_flags.set("heartbeat_interval_secs", 0.4)
+    storage_flags.set("raft_heartbeat_ms", 60)
+    storage_flags.set("raft_election_timeout_ms", 250)
+    metad = graphd = None
+    storers = {}
+    try:
+        metad = serve_metad(expired_threshold_secs=5)
+        for i in range(3):
+            storers[i] = serve_storaged(
+                metad.addr, replicated=True, engine="mem",
+                data_dir=os.path.join(run_dir, f"s{i}"),
+                load_interval=0.15, ws_port=0)
+        tpu2 = TpuGraphEngine()
+        graphd = serve_graphd(metad.addr, tpu_engine=tpu2)
+        gc = GraphClient(graphd.addr).connect()
+        v2, e2 = (160, 900) if trim else (400, 3000)
+        srcs2, dsts2, ts2 = zipf_edges(rng, v2, e2, clip=60)
+        insert_person_knows(gc, space, parts, v2, srcs2, dsts2, ts2,
+                            replica_factor=3, settle_s=20.0)
+        sid2 = metad.meta.get_space(space).value().space_id
+        gc.must(f"GO 2 STEPS FROM 1 OVER knows YIELD knows._dst")
+        graph_flags.set("shadow_read_rate", 0.3)
+        cons.shadow.reset()
+
+        def divergent() -> list:
+            found = []
+            for h in storers.values():
+                if h.node is None:
+                    continue
+                for p in h.node.consistency_status():
+                    for rep in p.get("digest_divergent") or []:
+                        found.append({"node": h.addr,
+                                      "space": p["space"],
+                                      "part": p["part"],
+                                      "replica": rep,
+                                      "digest": p.get("digest")})
+            return found
+
+        def verified_replicas() -> int:
+            n = 0
+            for h in storers.values():
+                if h.node is None:
+                    continue
+                for p in h.node.consistency_status():
+                    n += sum(1 for m in p["replicas"]
+                             if m.get("digest_ok") is True)
+            return n
+
+        # clean window: traffic flows, every replica verifies, zero
+        # divergence — the no-false-positive half of the drill
+        div_clean0 = global_stats.lifetime_total(
+            "consistency.divergence")
+        clean_end = time.monotonic() + (1.5 if trim else 4.0)
+        wseq = 0
+        while time.monotonic() < clean_end:
+            s = int(rng.integers(0, v2))
+            gc.must(f"GO FROM {s} OVER knows YIELD knows._dst")
+            gc.must(f"INSERT EDGE knows(ts) VALUES {s} -> "
+                    f"{(s * 7 + 3) % v2}:({wseq % 997})")
+            wseq += 1
+            time.sleep(0.01)
+        deadline = time.monotonic() + 5
+        while verified_replicas() == 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        art["clean"] = {"writes": wseq,
+                        "verified_replicas": verified_replicas(),
+                        "divergent": divergent()}
+        gates["clean_replicas_verified"] = \
+            art["clean"]["verified_replicas"] > 0
+        gates["clean_no_divergence"] = (
+            not art["clean"]["divergent"] and
+            global_stats.lifetime_total("consistency.divergence")
+            == div_clean0)
+
+        # ARM the corruption: exactly one committed put on exactly one
+        # replica gets one byte flipped as it is applied
+        flight_rec.reset()
+        faults.set_plan("consistency.corrupt:n=1")
+        t0 = time.monotonic()
+        fired_at = None
+        detect_at = None
+        for i in range(400):
+            s = int(rng.integers(0, v2))
+            gc.must(f"INSERT EDGE knows(ts) VALUES {s} -> "
+                    f"{(s * 11 + 5) % v2}:({i})")
+            if fired_at is None and \
+                    faults.counts().get("consistency.corrupt"):
+                fired_at = time.monotonic()
+            if fired_at is not None:
+                if divergent():
+                    detect_at = time.monotonic()
+                    break
+            time.sleep(0.02)
+        if fired_at is not None and detect_at is None:
+            deadline = fired_at + DETECT_WINDOW_S
+            while time.monotonic() < deadline:
+                if divergent():
+                    detect_at = time.monotonic()
+                    break
+                time.sleep(0.05)
+        div = divergent()
+        art["drill"] = {
+            "corrupt_fired": faults.counts().get(
+                "consistency.corrupt", 0),
+            "detect_s": round(detect_at - fired_at, 3)
+            if (detect_at and fired_at) else None,
+            "divergent": div,
+        }
+        gates["corrupt_fired"] = bool(fired_at)
+        gates["divergence_detected"] = bool(detect_at)
+        gates["detected_within_window"] = bool(
+            detect_at and fired_at and
+            detect_at - fired_at <= DETECT_WINDOW_S)
+        # the flight bundle names part / replica / anchor
+        flight_rec.flush()
+        bundles = [b for b in flight_rec.bundles
+                   if b["trigger"] == "replica_divergence"]
+        ev = bundles[-1]["event"] if bundles else {}
+        art["drill"]["bundle_event"] = {
+            k: ev.get(k) for k in ("kind", "space", "part", "replica",
+                                   "anchor", "term")}
+        gates["divergence_bundle"] = bool(
+            bundles and ev.get("part") is not None
+            and ev.get("replica") and ev.get("anchor") is not None)
+        gates["divergence_counter_moved"] = \
+            global_stats.lifetime_total("consistency.divergence") > \
+            div_clean0
+        # the gauge surface: some leader part scrapes digest_ok 0
+        gauge_zero = False
+        gauge_lines = 0
+        for h in storers.values():
+            if not h.ws_port:
+                continue
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{h.ws_port}/metrics",
+                        timeout=3) as r:
+                    text = r.read().decode()
+            except Exception:
+                continue
+            for ln in text.splitlines():
+                if "_digest_ok" in ln and "nebula_consistency" in ln:
+                    gauge_lines += 1
+                    if ln.strip().endswith(" 0"):
+                        gauge_zero = True
+        art["drill"]["digest_ok_gauge_lines"] = gauge_lines
+        gates["divergence_gauge"] = gauge_zero
+        # SHOW CONSISTENCY federates the verdicts over the storaged
+        # /consistency endpoints (registered via heartbeat ws ports)
+        showr2 = gc.must("SHOW CONSISTENCY")
+        flat = [" ".join(str(c) for c in row) for row in showr2.rows]
+        art["drill"]["show_rows"] = len(flat)
+        gates["show_consistency_diverged"] = any(
+            "DIVERGED" in ln for ln in flat)
+        # shadow reads rode the replicated phase too — still green
+        # (divergence on a follower never changes leader-served rows)
+        gates["shadow_drained_repl"] = cons.shadow.drain(30)
+        sh2 = cons.shadow.stats()
+        art["drill"]["shadow"] = {k: sh2[k] for k in
+                                 ("sampled", "verified", "mismatches",
+                                  "skipped_stale", "errors")}
+        gates["shadow_identity_green_repl"] = sh2["mismatches"] == 0
+    finally:
+        faults.clear()
+        graph_flags.set("shadow_read_rate", 0.0)
+        try:
+            if graphd is not None:
+                graphd.stop()
+            for h in storers.values():
+                h.stop()
+            if metad is not None:
+                metad.stop()
+        except Exception:
+            pass
+        storage_flags.set("heartbeat_interval_secs", old_hb)
+        storage_flags.set("raft_heartbeat_ms", old_rhb)
+        storage_flags.set("raft_election_timeout_ms", old_rel)
+        shutil.rmtree(run_dir, ignore_errors=True)
+
+    art["gates"] = gates
+    art["ok"] = all(bool(x) for x in gates.values())
+    with open(out_path, "w") as f:
+        json.dump(art, f, indent=1, default=str)
+    log(f"CONSISTENCY tier: {json.dumps(gates)}")
+    log(f"wrote {out_path}")
+    if not art["ok"]:
+        failed = [k for k, ok in gates.items() if not ok]
+        raise SystemExit(f"CONSISTENCY tier FAILED gates: {failed}")
+
+
 def bench_chaos(out_path: str, trim: bool = False):
     """Chaos tier (`bench.py --chaos`): the 8-session workload under
     injected kernel/mesh/encode faults (common/faults.py; docs/manual/
@@ -3003,6 +3339,14 @@ def main():
             if a.startswith("--out="):
                 out = a.split("=", 1)[1]
         bench_skew(out, trim="--trim" in sys.argv)
+        return
+    if "--consistency" in sys.argv:
+        out = os.environ.get("BENCH_CONSISTENCY_OUT",
+                             "CONSISTENCY_bench.json")
+        for a in sys.argv:
+            if a.startswith("--out="):
+                out = a.split("=", 1)[1]
+        bench_consistency(out, trim="--trim" in sys.argv)
         return
     if "--cache-smoke" in sys.argv:
         out = os.environ.get("BENCH_CACHE_OUT", "CACHE_smoke.json")

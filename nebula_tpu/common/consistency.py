@@ -1,0 +1,701 @@
+"""Consistency observatory: online replica content digests, shadow-read
+verification and device-snapshot audit (docs/manual/10-observability.md,
+"Consistency observatory").
+
+Every correctness guarantee this stack makes used to be proven only in
+offline harnesses: TPU-vs-CPU byte identity in bench/soak loops,
+durability in the ``--crash`` ledgers, replica convergence in raft
+fixture tests. This module makes correctness a first-class, always-on
+observable next to heat and profiling:
+
+PART CONTENT DIGESTS — every storage ``Part`` maintains an
+order-independent rolling digest over its live data keys (sum mod
+2**128 of per-KV hashes, so inserts fold in and removes fold out
+incrementally), anchored to ``(term, applied_log_id)`` at every commit
+batch. Two replicas at the same applied index MUST agree; leaders
+compare each follower's digest (carried on the existing append/
+heartbeat round as an additive wire element) against their own anchor
+history and flag `digest_ok` per replica. A mismatch records a
+``replica_divergence`` flight event naming the part, replica and
+anchor. THE hashing implementation lives here — the offline checkers
+(tools/integrity_check.py, tools/kv_verify.py), the online digests,
+shadow-read comparison and the snapshot audit all share ``item_hash``/
+``kv_hash``/``fold_add`` (one authority, no divergable twins).
+
+SHADOW-READ VERIFICATION — a MUTABLE ``shadow_read_rate`` flag samples
+a fraction of production GO/FETCH serves at the graph layer; a
+background worker re-executes each sampled statement through the CPU
+pipe (the ``shadow_serve`` ContextVar makes the device engine decline)
+and compares the encoded row multisets byte-for-byte via the shared
+digest. The queue is bounded and budgeted (``shadow_read_budget``
+re-executions per second, drop-oldest beyond ``SHADOW_QUEUE_CAP``) so
+verification can never become load; a write landing between the
+original serve and the shadow run moves the space's freshness token
+and the comparison is SKIPPED (counted), never a false positive.
+Mismatches count per verb/space, annotate the sampled trace, and fire
+a ``shadow_mismatch`` flight trigger.
+
+DEVICE-SNAPSHOT AUDIT — CSR builds/delta applies record the store
+digest they were built from (engine_tpu/engine.py); auditors
+registered here cross-check live snapshot lineage against the current
+engine digest on a background cadence (``consistency_audit_interval_s``)
+and record ``snapshot_audit_mismatch`` — catching the delta-overrun /
+silent-store-mutation class where content moved without the version
+token.
+
+Disarm contract (the heat_enabled / profile_hz=0 idiom): with
+``consistency_enabled=false`` every charge site is one flag read, no
+``consistency.*``/``shadow.*`` stats family is ever created, and
+``gauges()`` is empty — /metrics stays byte-identical to a
+consistency-free build. Re-arming rebuilds part digests lazily from an
+engine scan on first probe.
+"""
+from __future__ import annotations
+
+import contextvars
+import hashlib
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .flags import MUTABLE, graph_flags, meta_flags, storage_flags
+from .stats import stats as global_stats
+
+# ---------------------------------------------------------------------------
+# flags (every daemon serves /consistency knobs via its OWN registry —
+# the flight/heat/profiler multi-registry idiom)
+# ---------------------------------------------------------------------------
+_REGISTRIES = (graph_flags, storage_flags, meta_flags)
+for _reg in _REGISTRIES:
+    _reg.declare(
+        "consistency_enabled", True, MUTABLE,
+        "consistency observatory master switch: per-part content "
+        "digests (anchored to (term, applied_log_id)), leader-side "
+        "replica digest checks, snapshot audit and the "
+        "nebula_consistency_* metric families; off = every charge "
+        "site is one flag read and /metrics is byte-identical to a "
+        "consistency-free build")
+    _reg.declare(
+        "shadow_read_rate", 0.0, MUTABLE,
+        "fraction of production GO/FETCH serves re-executed through "
+        "the CPU pipe off the serve path and compared byte-for-byte "
+        "(0 disarms — one flag read per query); mismatches fire the "
+        "shadow_mismatch flight trigger")
+    _reg.declare(
+        "shadow_read_budget", 20, MUTABLE,
+        "max shadow-read re-executions per second; samples beyond the "
+        "budget (or the bounded queue) are dropped, counted — shadow "
+        "verification can never become load")
+    _reg.declare(
+        "consistency_audit_interval_s", 0.0, MUTABLE,
+        "device-snapshot audit cadence: cross-check live CSR snapshot "
+        "lineage digests against the current engine digest every this "
+        "many seconds (0 = on-demand only via /consistency?audit=1)")
+
+
+def _flag(name: str, default):
+    """First non-default value across the registries (graph first) —
+    a daemon process sets only its own registry over HTTP, in-process
+    harnesses use graph_flags."""
+    for reg in _REGISTRIES:
+        v = reg.get(name, default)
+        if v is not None and v != default:
+            return v
+    return default
+
+
+def enabled() -> bool:
+    return bool(_flag("consistency_enabled", True))
+
+
+def shadow_rate() -> float:
+    try:
+        return float(_flag("shadow_read_rate", 0.0) or 0.0)
+    except (TypeError, ValueError):
+        return 0.0
+
+
+# ---------------------------------------------------------------------------
+# the hashing authority (shared by part digests, shadow compare, the
+# snapshot audit and the offline tools — ONE implementation)
+# ---------------------------------------------------------------------------
+DIGEST_BITS = 128
+_MASK = (1 << DIGEST_BITS) - 1
+
+
+def item_hash(data: bytes) -> int:
+    """128-bit hash of one opaque item (a row image, a blob)."""
+    return int.from_bytes(
+        hashlib.blake2b(data, digest_size=16).digest(), "big")
+
+
+def kv_hash(key: bytes, value: bytes) -> int:
+    """128-bit hash of one KV pair. The key length prefixes the
+    concatenation so (k, v) pairs can never alias across the
+    boundary."""
+    h = hashlib.blake2b(digest_size=16)
+    h.update(len(key).to_bytes(4, "big"))
+    h.update(key)
+    h.update(value)
+    return int.from_bytes(h.digest(), "big")
+
+
+def fold_add(digest: int, h: int) -> int:
+    """Fold one item INTO an order-independent multiset digest
+    (sum mod 2**128 — duplicate-safe, unlike XOR)."""
+    return (digest + h) & _MASK
+
+
+def fold_sub(digest: int, h: int) -> int:
+    """Fold one item OUT of the digest (the remove/overwrite path)."""
+    return (digest - h) & _MASK
+
+
+def digest_items(items) -> int:
+    """Digest of an iterable of (key, value) pairs — the full-scan /
+    offline-tool form of the same authority the incremental path
+    folds."""
+    d = 0
+    for k, v in items:
+        d = fold_add(d, kv_hash(k, v))
+    return d
+
+
+def digest_rows(rows) -> Tuple[int, int]:
+    """(digest, count) over an iterable of result rows — the shadow
+    comparison form: each row's repr bytes hashed, folded
+    order-independently (sorting-free, duplicate-safe)."""
+    d = 0
+    n = 0
+    for r in rows:
+        d = fold_add(d, item_hash(repr(r).encode()))
+        n += 1
+    return d, n
+
+
+def hex_digest(d: Optional[int]) -> Optional[str]:
+    return None if d is None else format(d, "032x")
+
+
+# ---------------------------------------------------------------------------
+# per-part incremental digest (owned by kvstore/part.py)
+# ---------------------------------------------------------------------------
+# the kind byte that marks system keys (commit marker, balance key) —
+# excluded from content digests: they encode per-replica bookkeeping
+# that is covered by the ANCHOR, not the content
+_KIND_SYSTEM = 0x00
+
+HISTORY_ANCHORS = 256
+
+
+def is_digestable_key(key: bytes) -> bool:
+    return len(key) >= 5 and key[4] != _KIND_SYSTEM
+
+
+class PartDigest:
+    """One part's rolling content digest + its (term, applied_log_id)
+    anchor history. All mutation happens under the owning Part's lock
+    (the apply serialization point); reads take the small local lock
+    so monitors never race an apply."""
+
+    __slots__ = ("_lock", "value", "anchor_term", "anchor_id", "valid",
+                 "mid_install", "history")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.value = 0
+        self.anchor_term = 0
+        self.anchor_id = 0
+        self.valid = False
+        self.mid_install = False
+        # deque of (log_id, term, digest) — the leader's comparison
+        # base for follower-reported anchors (batch boundaries align
+        # in the steady state; unknown anchors are skipped, counted)
+        self.history: "deque[Tuple[int, int, int]]" = deque(
+            maxlen=HISTORY_ANCHORS)
+
+    # -- mutation (caller holds the Part lock) --------------------------
+    def add(self, key: bytes, value: bytes) -> None:
+        self.value = fold_add(self.value, kv_hash(key, value))
+
+    def remove(self, key: bytes, value: bytes) -> None:
+        self.value = fold_sub(self.value, kv_hash(key, value))
+
+    def anchor_to(self, term: int, log_id: int) -> None:
+        with self._lock:
+            self.anchor_term = int(term)
+            self.anchor_id = int(log_id)
+            self.mid_install = False
+            self.history.append((self.anchor_id, self.anchor_term,
+                                 self.value))
+
+    def begin_install(self) -> None:
+        """Snapshot install START: history is being replaced wholesale
+        (the part prefix was just cleared) — the digest restarts from
+        empty and stays unreportable until the final chunk anchors."""
+        with self._lock:
+            self.value = 0
+            self.valid = True
+            self.mid_install = True
+            self.history.clear()
+
+    def invalidate(self) -> None:
+        with self._lock:
+            self.valid = False
+            self.mid_install = False
+            self.history.clear()
+
+    def rebuild(self, engine, part_prefix: bytes) -> None:
+        """Full recompute from an engine scan (boot, re-arm after a
+        disarm window, post-ingest). Caller holds the Part lock."""
+        d = 0
+        for k, v in engine.prefix(part_prefix):
+            if is_digestable_key(k):
+                d = fold_add(d, kv_hash(k, v))
+        with self._lock:
+            self.value = d
+            self.valid = True
+            self.mid_install = False
+            self.history.clear()
+
+    # -- reads ----------------------------------------------------------
+    def anchor(self) -> Optional[Tuple[int, int, int]]:
+        """(term, log_id, digest) — None while invalid/mid-install."""
+        with self._lock:
+            if not self.valid or self.mid_install:
+                return None
+            return (self.anchor_term, self.anchor_id, self.value)
+
+    def at(self, log_id: int) -> Optional[int]:
+        """The digest this part held when its applied index was
+        exactly `log_id` — None when the anchor is unknown (rolled off
+        the bounded history, or batch boundaries didn't align)."""
+        with self._lock:
+            if not self.valid:
+                return None
+            for lid, _term, dig in reversed(self.history):
+                if lid == log_id:
+                    return dig
+                if lid < log_id:
+                    break
+            return None
+
+
+# ---------------------------------------------------------------------------
+# shadow-read verification (graph layer)
+# ---------------------------------------------------------------------------
+# set while the shadow worker re-executes a sampled statement: the
+# device engine declines (can_serve/can_serve_path) so the run takes
+# the CPU pipe, admission is bypassed (off-path internal work must not
+# spend a tenant's tokens) and re-sampling is suppressed
+_shadow_ctx: "contextvars.ContextVar[bool]" = contextvars.ContextVar(
+    "nebula_shadow_serve", default=False)
+
+
+def is_shadow() -> bool:
+    return _shadow_ctx.get()
+
+
+SHADOW_QUEUE_CAP = 128
+# at most this many row reprs kept per sample as mismatch evidence
+SHADOW_EVIDENCE_ROWS = 8
+
+# per-space write sequence (graph layer): part of the shadow freshness
+# token so a write landing between the sampled serve and the shadow
+# re-execution SKIPS the comparison instead of false-positiving. Bumped
+# by the graph engine on every successful mutation statement while
+# shadow sampling is armed (disarmed: one flag read per write).
+_write_seq: Dict[str, int] = {}
+_write_seq_lock = threading.Lock()
+
+
+def note_space_write(space: str) -> None:
+    if shadow_rate() <= 0.0:
+        return
+    with _write_seq_lock:
+        _write_seq[space] = _write_seq.get(space, 0) + 1
+
+
+def space_write_seq(space: str) -> int:
+    return _write_seq.get(space, 0)
+
+
+class ShadowVerifier:
+    """Process-global sampled re-execution verifier. ``install`` wires
+    the runner (execute one statement through the CPU pipe, return its
+    rows) and the per-space freshness probe; ``maybe_sample`` is the
+    serve-path seam — one flag read disarmed, one RNG draw + bounded
+    deque append armed. The worker thread is lazy and never blocks a
+    serve."""
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
+        self._q: "deque[dict]" = deque()
+        self._runner: Optional[Callable[[str, str], list]] = None
+        self._version_fn: Optional[Callable[[str], Any]] = None
+        self._worker: Optional[threading.Thread] = None
+        self._in_flight = False     # worker holds a popped sample
+        self._budget_sec = 0
+        self._budget_used = 0
+        import random as _random
+        self._rng = _random.Random()
+        self.counts: Dict[str, int] = {
+            "sampled": 0, "verified": 0, "mismatches": 0,
+            "skipped_stale": 0, "dropped": 0, "errors": 0}
+        self.mismatch_by_verb: Dict[str, int] = {}
+        self.mismatch_by_space: Dict[str, int] = {}
+        self.last_mismatch: Optional[dict] = None
+
+    # -- wiring ---------------------------------------------------------
+    def install(self, runner: Callable[[str, str], list],
+                version_fn: Optional[Callable[[str], Any]] = None
+                ) -> None:
+        """Idempotent by replacement (the flight-collector idiom): the
+        newest graph service in the process owns the runner."""
+        with self._lock:
+            self._runner = runner
+            self._version_fn = version_fn
+
+    # -- serve-path seam -------------------------------------------------
+    def armed(self) -> bool:
+        return enabled() and shadow_rate() > 0.0
+
+    def current_version(self, space: str):
+        """The installed freshness probe, for callers that must pin
+        the token BEFORE computing the rows they later sample (the
+        graph engine captures it at execute start — a write landing
+        between row computation and sampling must SKIP the
+        comparison, never false-positive)."""
+        return self._version(space)
+
+    def maybe_sample(self, space: str, verb: str, text: str,
+                     rows, trace_id: Optional[str] = None,
+                     version=None) -> bool:
+        """Sample one successful serve. Never blocks: digesting the
+        rows + a deque append under a leaf lock. `version` is the
+        freshness token captured BEFORE the rows were computed
+        (current_version); left None it is probed now — safe only
+        when no write can have landed since the rows were read.
+        Returns True when the sample was enqueued (tests)."""
+        r = shadow_rate()
+        if r <= 0.0 or not enabled() or _shadow_ctx.get():
+            return False
+        if self._rng.random() >= r:
+            return False
+        digest, n = digest_rows(rows)
+        evidence = [repr(x) for x in rows[:SHADOW_EVIDENCE_ROWS]]
+        item = {
+            "space": space or "", "verb": verb, "text": text,
+            "digest": digest, "rows": n, "evidence": evidence,
+            "trace_id": trace_id,
+            "version": version if version is not None
+            else self._version(space),
+        }
+        with self._cv:
+            self.counts["sampled"] += 1
+            self._q.append(item)
+            if len(self._q) > SHADOW_QUEUE_CAP:
+                self._q.popleft()
+                self.counts["dropped"] += 1
+            self._ensure_worker_locked()
+            self._cv.notify()
+        global_stats.add_value("shadow.sampled", kind="counter")
+        return True
+
+    def _version(self, space: str):
+        fn = self._version_fn
+        if fn is None:
+            return None
+        try:
+            return fn(space or "")
+        except Exception:
+            return None
+
+    # -- worker ----------------------------------------------------------
+    def _ensure_worker_locked(self) -> None:
+        if self._worker is not None and self._worker.is_alive():
+            return
+        # nlint: disable=NL002 -- process-lifetime verification worker;
+        # it serves samples from every session and owes none a trace
+        self._worker = threading.Thread(
+            target=self._run, daemon=True, name="shadow-verify")
+        self._worker.start()
+
+    def _budget_ok(self) -> bool:
+        budget = int(_flag("shadow_read_budget", 20) or 0)
+        if budget <= 0:
+            return False
+        sec = int(self._clock())
+        if sec != self._budget_sec:
+            self._budget_sec = sec
+            self._budget_used = 0
+        if self._budget_used >= budget:
+            return False
+        self._budget_used += 1
+        return True
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                while not self._q:
+                    self._cv.wait(timeout=5.0)
+                item = self._q.popleft()
+                runner = self._runner
+                # visible to drain(): the popped sample's verdict has
+                # not landed yet — gates must not read stats early
+                self._in_flight = True
+            try:
+                if runner is None:
+                    with self._lock:
+                        self.counts["dropped"] += 1
+                    continue
+                if not self._budget_ok():
+                    with self._lock:
+                        self.counts["dropped"] += 1
+                    global_stats.add_value("shadow.dropped",
+                                           kind="counter")
+                    continue
+                try:
+                    self._verify(runner, item)
+                except Exception:
+                    with self._lock:
+                        self.counts["errors"] += 1
+                    if enabled():
+                        global_stats.add_value("shadow.errors",
+                                               kind="counter")
+            finally:
+                with self._lock:
+                    self._in_flight = False
+
+    def _verify(self, runner, item: dict) -> None:
+        # a write between the original serve and now moves the token:
+        # the comparison would be apples-to-oranges — skip, counted
+        if item["version"] != self._version(item["space"]):
+            with self._lock:
+                self.counts["skipped_stale"] += 1
+            global_stats.add_value("shadow.skipped_stale",
+                                   kind="counter")
+            return
+        tok = _shadow_ctx.set(True)
+        try:
+            rows = runner(item["space"], item["text"])
+        except Exception:
+            with self._lock:
+                self.counts["errors"] += 1
+            global_stats.add_value("shadow.errors", kind="counter")
+            return
+        finally:
+            _shadow_ctx.reset(tok)
+        # re-check: a write may have landed DURING the shadow run
+        if item["version"] != self._version(item["space"]):
+            with self._lock:
+                self.counts["skipped_stale"] += 1
+            global_stats.add_value("shadow.skipped_stale",
+                                   kind="counter")
+            return
+        digest, n = digest_rows(rows)
+        if digest == item["digest"] and n == item["rows"]:
+            with self._lock:
+                self.counts["verified"] += 1
+            global_stats.add_value("shadow.verified", kind="counter")
+            return
+        detail = {
+            "space": item["space"], "verb": item["verb"],
+            "text": item["text"][:200],
+            "served_rows": item["rows"], "shadow_rows": n,
+            "served_digest": hex_digest(item["digest"]),
+            "shadow_digest": hex_digest(digest),
+            "served_sample": item["evidence"],
+            "shadow_sample": [repr(x) for x in
+                              rows[:SHADOW_EVIDENCE_ROWS]],
+        }
+        with self._lock:
+            self.counts["mismatches"] += 1
+            self.mismatch_by_verb[item["verb"]] = \
+                self.mismatch_by_verb.get(item["verb"], 0) + 1
+            sp = item["space"] or "_"
+            self.mismatch_by_space[sp] = \
+                self.mismatch_by_space.get(sp, 0) + 1
+            self.last_mismatch = detail
+        global_stats.add_value("shadow.mismatch." + item["verb"],
+                               kind="counter")
+        self._tag_trace(item.get("trace_id"))
+        from .flight import recorder
+        recorder.record("shadow_mismatch", trace_id=item.get("trace_id"),
+                        **{k: v for k, v in detail.items()
+                           if k not in ("served_sample",
+                                        "shadow_sample")})
+
+    @staticmethod
+    def _tag_trace(trace_id: Optional[str]) -> None:
+        """Best-effort: annotate the (already finished) sampled trace
+        in the ring so the /traces view shows the query was later
+        proven divergent."""
+        if not trace_id:
+            return
+        try:
+            from . import tracing
+            t = tracing.tracer.ring.get(trace_id)
+            if t is not None and t.get("spans"):
+                t["spans"][0].setdefault("tags", {})[
+                    "shadow_mismatch"] = True
+        except Exception:
+            pass
+
+    # -- observation ------------------------------------------------------
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "rate": shadow_rate(),
+                "queue": len(self._q),
+                "queue_cap": SHADOW_QUEUE_CAP,
+                "budget_per_s": int(_flag("shadow_read_budget", 20)
+                                    or 0),
+                **dict(self.counts),
+                "mismatch_by_verb": dict(self.mismatch_by_verb),
+                "mismatch_by_space": dict(self.mismatch_by_space),
+                "last_mismatch": self.last_mismatch,
+            }
+
+    def drain(self, timeout: float = 10.0) -> bool:
+        """Block until the queue is empty AND no popped sample is
+        still being verified (harness/test seam — gates read stats
+        right after, so the last verdict must have landed). True when
+        drained within the timeout."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            with self._lock:
+                if not self._q and not self._in_flight:
+                    return True
+            time.sleep(0.02)
+        return False
+
+    def reset(self) -> None:
+        """Test/bench isolation: drop queued samples and counters."""
+        with self._lock:
+            self._q.clear()
+            for k in self.counts:
+                self.counts[k] = 0
+            self.mismatch_by_verb.clear()
+            self.mismatch_by_space.clear()
+            self.last_mismatch = None
+
+
+# ---------------------------------------------------------------------------
+# device-snapshot audit registry: one process-global cadence thread
+# driving every registered engine auditor (weakly held)
+# ---------------------------------------------------------------------------
+_audit_lock = threading.Lock()
+_audit_fns: List["weakref.WeakMethod"] = []
+_audit_thread: Optional[threading.Thread] = None
+
+
+def register_audit(bound_method) -> None:
+    """Register an engine's ``audit_snapshots`` bound method. Weakly
+    held (a test engine must be collectable); the single background
+    thread starts on first registration and runs each live auditor
+    every ``consistency_audit_interval_s`` seconds while armed."""
+    global _audit_thread
+    with _audit_lock:
+        _audit_fns.append(weakref.WeakMethod(bound_method))
+        if _audit_thread is None or not _audit_thread.is_alive():
+            # nlint: disable=NL002 -- process-lifetime audit cadence;
+            # background maintenance owes no request a trace
+            _audit_thread = threading.Thread(
+                target=_audit_loop, daemon=True,
+                name="consistency-audit")
+            _audit_thread.start()
+
+
+def run_audits() -> int:
+    """Run every live registered auditor once (the on-demand seam:
+    /consistency?audit=1, benches). Returns how many ran."""
+    with _audit_lock:
+        refs = list(_audit_fns)
+    n = 0
+    for ref in refs:
+        fn = ref()
+        if fn is None:
+            continue
+        try:
+            fn()
+            n += 1
+        except Exception:
+            pass
+    with _audit_lock:
+        _audit_fns[:] = [r for r in _audit_fns if r() is not None]
+    return n
+
+
+def _audit_loop() -> None:
+    while True:
+        try:
+            interval = float(_flag("consistency_audit_interval_s", 0.0)
+                             or 0.0)
+        except (TypeError, ValueError):
+            interval = 0.0
+        time.sleep(min(max(interval, 0.5), 5.0) if interval > 0
+                   else 5.0)
+        if interval <= 0 or not enabled():
+            continue
+        run_audits()
+
+
+# ---------------------------------------------------------------------------
+# /consistency surface helpers
+# ---------------------------------------------------------------------------
+def store_rows(store) -> List[Dict[str, Any]]:
+    """Per-part digest rows of a local GraphStore (the unreplicated /
+    in-process form the storaged endpoint and SHOW CONSISTENCY fall
+    back to). Empty when disarmed."""
+    if not enabled():
+        return []
+    out: List[Dict[str, Any]] = []
+    for sid in store.spaces():
+        for part in store.space_parts(sid):
+            anc = part.digest_anchor()
+            row: Dict[str, Any] = {
+                "space": sid, "part": part.part_id,
+                "role": "LEADER" if part.is_leader() else "FOLLOWER",
+                "anchor_term": anc[0] if anc else None,
+                "anchor_id": anc[1] if anc else None,
+                "digest": hex_digest(anc[2]) if anc else None,
+                "replicas": [],
+            }
+            out.append(row)
+    return out
+
+
+def record_divergence(space: int, part: int, replica: str,
+                      anchor_id: int, anchor_term: int,
+                      leader_digest: int, replica_digest: int) -> None:
+    """One replica-divergence observation (leader side, kvstore/
+    raftex): counted + flight-recorded. Caller gates on transition so
+    a persistent divergence records one event per episode, not one
+    per heartbeat round."""
+    global_stats.add_value("consistency.divergence", kind="counter")
+    from .flight import recorder
+    recorder.record("replica_divergence", space=space, part=part,
+                    replica=replica, anchor=anchor_id,
+                    term=anchor_term,
+                    leader_digest=hex_digest(leader_digest),
+                    replica_digest=hex_digest(replica_digest))
+
+
+# process-global instance (the stats/flight/heat singleton idiom)
+shadow = ShadowVerifier()
+
+
+def capture() -> Dict[str, Any]:
+    """Flight-bundle collector body: the shadow verifier's state (the
+    per-daemon digest views ride the daemons' own collectors)."""
+    return {"enabled": enabled(), "shadow": shadow.stats()}
+
+
+from .flight import recorder as _flight_recorder  # noqa: E402
+
+_flight_recorder.add_collector("consistency", capture)

@@ -38,6 +38,13 @@ Trigger catalog (docs/manual/10-observability.md):
                     ``heat_hot_part_pct``)
   staleness_breach  any ``staleness_breach`` event (kvstore/raftex,
                     gated by ``staleness_breach_ms``)
+  replica_divergence  any ``replica_divergence`` or
+                    ``snapshot_audit_mismatch`` event — a replica (or
+                    device snapshot) whose content digest disagrees
+                    with the committed log (common/consistency.py)
+  shadow_mismatch   any ``shadow_mismatch`` event — a sampled
+                    production serve whose CPU-pipe re-execution
+                    returned different rows (common/consistency.py)
 
 Each fire is rate-limited by ``flight_cooldown_s`` per rule, so a
 storm produces one bundle, not hundreds.
@@ -118,6 +125,14 @@ def _default_rules() -> List[TriggerRule]:
         # here, rate-limited by the per-rule cooldown
         TriggerRule("hot_part", ("hot_part",)),
         TriggerRule("staleness_breach", ("staleness_breach",)),
+        # consistency observatory (common/consistency.py): a replica
+        # or device snapshot whose content digest drifted from the
+        # committed log, and a shadow-read identity failure — both
+        # immediate (the recording sites already gate on transition /
+        # the sampling budget; the per-rule cooldown bounds bundles)
+        TriggerRule("replica_divergence",
+                    ("replica_divergence", "snapshot_audit_mismatch")),
+        TriggerRule("shadow_mismatch", ("shadow_mismatch",)),
     ]
 
 

@@ -246,6 +246,53 @@ def serve_graphd(meta_addr: str, host: str = "127.0.0.1", port: int = 0,
                 out.setdefault("vertices", {})["degrees"] = degrees
             return 200, out
 
+        def consistency_handler(params, body):
+            # /consistency (docs/manual/10-observability.md,
+            # "Consistency observatory"): this graphd's shadow-read
+            # verifier state + the device-snapshot audit, plus a
+            # federated per-part digest view pulled from every
+            # registered storaged's /consistency (the /cluster_metrics
+            # target registry). ?audit=1 runs the snapshot audit now.
+            from ..common import consistency as _cons
+            out = {"enabled": _cons.enabled(),
+                   "shadow": _cons.shadow.stats()}
+            if tpu_engine is not None:
+                if params.get("audit"):
+                    _cons.run_audits()
+                out["audit"] = tpu_engine.audit_state()
+            try:
+                endpoints = [ep for ep in mc.web_endpoints()
+                             if ep.get("role") == "storage"]
+            except Exception:
+                endpoints = []
+            try:
+                timeout = float(params.get("timeout", 2.0))
+            except ValueError:
+                timeout = 2.0
+            # concurrent fan-out (the /cluster_metrics idiom, shared
+            # with SHOW CONSISTENCY): dead targets cost ONE timeout
+            from ..graph.admin_executors import \
+                _fetch_consistency_endpoints
+            cluster = []
+            for ep, doc in _fetch_consistency_endpoints(
+                    endpoints, timeout=timeout):
+                if doc is None:
+                    cluster.append({"host": ep["web"],
+                                    "error": "unreachable"})
+                else:
+                    cluster.append({"host": ep["web"], **doc})
+            out["cluster"] = cluster
+            divergent = []
+            for host in cluster:
+                for p in host.get("parts") or []:
+                    for rep in p.get("digest_divergent") or []:
+                        divergent.append(
+                            {"host": host["host"], "space": p["space"],
+                             "part": p["part"], "replica": rep})
+            out["divergent"] = divergent
+            return 200, out
+
+        web.register("/consistency", consistency_handler)
         web.register("/heat", heat_handler)
         from ..common import heat as _heat_mod
         # nebula_part_heat_* / nebula_heat_skew_index_* families

@@ -499,6 +499,31 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
 
         web.register("/raft", raft_handler)
 
+        def consistency_handler(params, body):
+            # /consistency (docs/manual/10-observability.md,
+            # "Consistency observatory"): per-part content-digest
+            # anchors; leaders add every replica's match/applied/
+            # digest_ok. ?scrub=1 deep-scrubs the incremental digests
+            # against a full engine scan (catches silent store
+            # mutation that bypassed the apply path).
+            from ..common import consistency as _cons
+            out = {"enabled": _cons.enabled(), "addr": addr,
+                   "replicated": node is not None}
+            if node is not None:
+                out["parts"] = node.consistency_status()
+                if params.get("scrub"):
+                    out["scrub"] = node.digest_scrub()
+            else:
+                out["parts"] = _cons.store_rows(store)
+                if params.get("scrub"):
+                    out["scrub"] = [
+                        p.digest_scrub()
+                        for sid in store.spaces()
+                        for p in store.space_parts(sid)]
+            return 200, out
+
+        web.register("/consistency", consistency_handler)
+
         def heat_handler(params, body):
             # /heat (docs/manual/10-observability.md, "Workload & data
             # observatory"): per-(space, part) heat slabs + per-space
@@ -525,6 +550,10 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
             # per-part consensus state at trigger time
             from ..common.flight import recorder as _fl
             _fl.add_collector("storaged.raft", node.raft_status)
+            # ... and the digest view, so a replica_divergence bundle
+            # names the diverging part/replica/anchor in-band
+            _fl.add_collector("storaged.consistency",
+                              node.consistency_status)
 
         if node is not None:
             def raft_metric_source():
@@ -550,6 +579,18 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
                     if heat.enabled():
                         out[base + ".staleness_ms"] = \
                             st.get("staleness_ms", 0.0)
+                    # consistency observatory: 1 while every replica's
+                    # last digest check agreed (leader-side; families
+                    # vanish when disarmed — the same byte-identity
+                    # contract as heat)
+                    from ..common import consistency as _cons
+                    if _cons.enabled() and st.get("replicas"):
+                        out[f"consistency.s{st['space']}."
+                            f"p{st['part']}.digest_ok"] = \
+                            0 if st.get("digest_divergent") else 1
+                        out[f"consistency.s{st['space']}."
+                            f"p{st['part']}.divergent_replicas"] = \
+                            len(st.get("digest_divergent") or ())
                 return out
 
             web.add_metrics_source(raft_metric_source)

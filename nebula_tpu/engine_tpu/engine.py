@@ -41,6 +41,7 @@ import numpy as np
 
 from ..common.cache import (CacheRung, plan_stage_enabled,
                             result_stage_enabled)
+from ..common import consistency as _consistency
 from ..common import heat as _heat
 from ..common import ledger as _ledger
 from ..common.faults import CircuitBreaker, faults
@@ -567,12 +568,14 @@ class TpuGraphEngine:
         self._provider = LocalStoreProvider(cluster.store, cluster.sm)
         self._sm = cluster.sm
         self._meta = cluster.meta
+        _consistency.register_audit(self.audit_snapshots)
 
     def attach_raw(self, store, sm, meta=None) -> None:
         from .provider import LocalStoreProvider
         self._provider = LocalStoreProvider(store, sm)
         self._sm = sm
         self._meta = meta
+        _consistency.register_audit(self.audit_snapshots)
 
     def attach_provider(self, provider, sm, meta=None) -> None:
         """Arbitrary snapshot feed — the RemoteStorageProvider path for
@@ -580,6 +583,87 @@ class TpuGraphEngine:
         self._provider = provider
         self._sm = sm
         self._meta = meta
+        _consistency.register_audit(self.audit_snapshots)
+
+    # ------------------------------------------------------------------
+    # device-snapshot audit (consistency observatory; docs/manual/
+    # 10-observability.md "Consistency observatory")
+    # ------------------------------------------------------------------
+    def _record_store_digest(self, snap) -> None:
+        """Record the store digest this snapshot's content came from
+        (build or delta apply). Only recorded when the provider can
+        name a digest at EXACTLY the snapshot's version — anything
+        else leaves None and the auditor skips (counted), never
+        guesses."""
+        snap.store_digest = None
+        fn = getattr(self._provider, "store_digest", None)
+        if fn is None or not _consistency.enabled():
+            return
+        try:
+            d = fn(snap.space_id)
+        except Exception:
+            return
+        if d is not None and d[1] == snap.write_version:
+            snap.store_digest = d[0]
+
+    def audit_snapshots(self) -> Dict[str, Any]:
+        """Cross-check every live snapshot's lineage digest against
+        the CURRENT engine digest: when the version token says nothing
+        changed, the content digest must agree — a mismatch is the
+        delta-overrun / silent-store-mutation class (flight event
+        ``snapshot_audit_mismatch``, rides the replica_divergence
+        trigger). Cheap (per space: one version read + a fold over
+        part digests); runs on the consistency audit cadence and on
+        demand (/consistency?audit=1)."""
+        out = {"checked": 0, "mismatches": 0, "skipped": 0}
+        if self._provider is None or not _consistency.enabled():
+            return out
+        fn = getattr(self._provider, "store_digest", None)
+        with self._lock:
+            snaps = list(self._snapshots.items())
+        for space_id, snap in snaps:
+            recorded = getattr(snap, "store_digest", None)
+            if fn is None or recorded is None or snap.stale:
+                out["skipped"] += 1
+                continue
+            try:
+                cur = fn(space_id)
+            except Exception:
+                cur = None
+            if cur is None or cur[1] != snap.write_version:
+                # writes in flight / version moved: a rebuild or delta
+                # apply is the judge, not this round
+                out["skipped"] += 1
+                continue
+            out["checked"] += 1
+            global_stats.add_value("consistency.audit_checks",
+                                   kind="counter")
+            if cur[0] != recorded:
+                out["mismatches"] += 1
+                global_stats.add_value("consistency.audit_mismatch",
+                                       kind="counter")
+                _flight.record(
+                    "snapshot_audit_mismatch", space=space_id,
+                    version=str(snap.write_version),
+                    recorded=_consistency.hex_digest(recorded),
+                    engine=_consistency.hex_digest(cur[0]))
+        self._audit_last = {**out, "ts": time.time()}
+        return out
+
+    def audit_state(self) -> Dict[str, Any]:
+        """The graphd /consistency audit block: last audit outcome +
+        per-space snapshot lineage."""
+        with self._lock:
+            snaps = {
+                str(sid): {
+                    "write_version": str(snap.write_version),
+                    "store_digest": _consistency.hex_digest(
+                        getattr(snap, "store_digest", None)),
+                    "stale": bool(snap.stale),
+                }
+                for sid, snap in self._snapshots.items()}
+        return {"last": getattr(self, "_audit_last", None),
+                "snapshots": snaps}
 
     # ------------------------------------------------------------------
     # snapshot lifecycle
@@ -909,6 +993,10 @@ class TpuGraphEngine:
         if snap is None:
             return None
         snap.catalog_version = catalog
+        # consistency observatory: remember the store digest this
+        # build scanned, so the auditor can later prove the snapshot's
+        # lineage still matches the engine at the same version
+        self._record_store_digest(snap)
         if (self.mesh is not None and self.mesh.devices.size > 1
                 and snap.num_parts % self.mesh.devices.size == 0
                 and space_id not in self._mesh_demoted):
@@ -1331,6 +1419,10 @@ class TpuGraphEngine:
             self._maybe_recalibrate(snap.space_id, snap)
         snap.delta_cursor = new_cursor
         snap.write_version = token
+        # the snapshot now claims version `token`: re-anchor its
+        # lineage digest at that version (None when a write raced —
+        # the auditor then skips until the next build/apply)
+        self._record_store_digest(snap)
         d = snap.delta
         if d is not None:
             self.stats["delta_edges"] = d.edge_count
@@ -1412,6 +1504,10 @@ class TpuGraphEngine:
     def can_serve(self, space_id: int, s: ast.GoSentence) -> bool:
         if not (self.enabled and self._provider is not None):
             return False
+        if _consistency.is_shadow():
+            # shadow-read re-execution (common/consistency.py): the
+            # whole point is an independent CPU-pipe twin — decline
+            return False
         exprs = [c.expr for c in (s.yield_.columns if s.yield_ else [])]
         if s.where:
             exprs.append(s.where.filter)
@@ -1431,6 +1527,8 @@ class TpuGraphEngine:
         tpu_engine.path_declined.<reason>)."""
         if not (self.enabled and self._provider is not None):
             return False
+        if _consistency.is_shadow():
+            return False    # shadow runs take the CPU pipe by design
         if not s.shortest:
             # ALL/NOLOOP paths serve meshed AND unmeshed: sharded
             # snapshots take the per-step sharded expansion

@@ -42,6 +42,12 @@ class LocalStoreProvider:
         engine = self._store.space_engine(space_id)
         return None if engine is None else engine.write_version
 
+    def store_digest(self, space_id: int):
+        """(content digest, write_version) of the space's parts — the
+        snapshot-audit lineage source (common/consistency.py). None
+        when the observatory is disarmed or a write raced the walk."""
+        return self._store.space_digest(space_id)
+
     def build(self, space_id: int) -> Optional[CsrSnapshot]:
         if self._store.space_engine(space_id) is None:
             return None
@@ -96,6 +102,13 @@ class RemoteStorageProvider:
 
     def version(self, space_id: int):
         return self._client.space_versions(space_id)
+
+    def store_digest(self, space_id: int):
+        """Remote stores don't expose a digest walk over the storage
+        RPC boundary (yet) — the snapshot audit declines; replica
+        divergence detection lives on the storaged tier's own digest
+        exchange (kvstore/raftex)."""
+        return None
 
     def build(self, space_id: int) -> Optional[CsrSnapshot]:
         token = self.version(space_id)   # BEFORE the scans (see module doc)

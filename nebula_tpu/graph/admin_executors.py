@@ -238,7 +238,97 @@ def execute_show(ctx: ExecContext, s: ast.ShowSentence) -> Result:
     if k == ast.ShowKind.VARIABLES:
         rows = [(name, repr(res.columns)) for name, res in ctx.variables.items()]
         return _ok(InterimResult(["Variable", "Columns"], rows))
+    if k == ast.ShowKind.CONSISTENCY:
+        return _show_consistency(ctx)
     return _err(ErrorCode.E_UNSUPPORTED, f"SHOW {k.value}")
+
+
+def _fetch_consistency_endpoints(endpoints, timeout: float = 2.0):
+    """[(endpoint, /consistency JSON | None)] fetched CONCURRENTLY —
+    shared by SHOW CONSISTENCY and graphd's /consistency federation
+    (the /cluster_metrics fan-out idiom: one slow/dead target costs
+    one timeout for the whole round, not one per target)."""
+    import json as _json
+    import urllib.request
+    from concurrent.futures import ThreadPoolExecutor
+
+    def fetch(ep):
+        try:
+            with urllib.request.urlopen(
+                    f"http://{ep['web']}/consistency",
+                    timeout=timeout) as r:
+                return _json.loads(r.read())
+        except Exception:
+            return None
+
+    if not endpoints:
+        return []
+    with ThreadPoolExecutor(max_workers=min(len(endpoints), 16)) \
+            as pool:
+        docs = list(pool.map(fetch, endpoints))
+    return list(zip(endpoints, docs))
+
+
+def _show_consistency(ctx: ExecContext) -> Result:
+    """SHOW CONSISTENCY (docs/manual/10-observability.md, "Consistency
+    observatory"): cluster-wide per-part content-digest state. In a
+    deployed cluster the rows federate from every registered
+    storaged's /consistency endpoint (the /cluster_metrics target
+    registry); in a single-process deployment they come from the local
+    store's parts. Leaders expand one row per replica with the
+    leader-side digest verdict."""
+    from ..common import consistency as _cons
+    columns = ["Host", "Space", "Part", "Role", "Anchor term",
+               "Anchor id", "Digest", "Replica", "Match", "Applied",
+               "Digest ok"]
+    rows = []
+
+    def part_rows(host, p):
+        dig = p.get("digest") or {}
+        if isinstance(dig, dict):
+            aterm, aid, dhex = (dig.get("anchor_term"),
+                                dig.get("anchor_id"), dig.get("digest"))
+        else:
+            aterm = p.get("anchor_term")
+            aid = p.get("anchor_id")
+            dhex = p.get("digest")
+        reps = p.get("replicas") or []
+        base = (host, p["space"], p["part"], p.get("role", "?"),
+                aterm, aid, dhex)
+        if not reps:
+            rows.append(base + ("-", "-", "-", "-"))
+            return
+        for m in reps:
+            ok = m.get("digest_ok")
+            rows.append(base + (
+                m.get("addr", "?"), m.get("match"), m.get("applied"),
+                "?" if ok is None else ("ok" if ok else "DIVERGED")))
+
+    endpoints = []
+    try:
+        endpoints = [ep for ep in ctx.meta.web_endpoints()
+                     if ep.get("role") == "storage"]
+    except Exception:
+        endpoints = []
+    if endpoints:
+        # concurrent fan-out (the /cluster_metrics idiom): several
+        # dead/slow storagds must cost ONE timeout for the whole
+        # statement, not one each — this runs on a user session
+        for ep, doc in _fetch_consistency_endpoints(endpoints):
+            if doc is None:
+                rows.append((ep["web"], "-", "-", "UNREACHABLE",
+                             None, None, None, "-", "-", "-", "-"))
+                continue
+            for p in doc.get("parts") or []:
+                part_rows(doc.get("addr") or ep["web"], p)
+    else:
+        # single-process deployment: walk the local store directly
+        svc = getattr(ctx.client, "_hosts", {}).get("local")
+        store = getattr(svc, "store", None)
+        if store is not None:
+            for p in _cons.store_rows(store):
+                part_rows("local", p)
+    return _ok(InterimResult(columns, rows))
 
 
 def execute_config(ctx: ExecContext, s: ast.ConfigSentence) -> Result:

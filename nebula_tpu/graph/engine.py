@@ -10,7 +10,7 @@ from __future__ import annotations
 import time
 from typing import Callable, Dict, Optional
 
-from ..common import ledger, qos
+from ..common import consistency, ledger, qos
 from ..common.cache import CacheRung, plan_stage_enabled
 from ..common.status import ErrorCode, Status, StatusOr
 from ..common.tracing import (ActiveQueryRegistry, SlowQueryLog,
@@ -206,6 +206,17 @@ class ExecutionEngine:
         result: Optional[InterimResult] = None
         tpu = self.tpu_engine
         profile_seq0 = tpu.profile_seq if tpu is not None else 0
+        # shadow freshness token, pinned BEFORE any sentence computes
+        # rows: a write committing between row computation and the
+        # sampling seam below must make the shadow comparison SKIP,
+        # never false-positive (one flag read when disarmed)
+        shadow_ver = None
+        if consistency.shadow.armed() and not consistency.is_shadow():
+            try:
+                shadow_ver = consistency.shadow.current_version(
+                    session.space_name or "")
+            except Exception:
+                shadow_ver = None
         for sentence in seq.sentences:
             # multi-tenant QoS (common/qos.py; docs/manual/14-qos.md):
             # per-space token-bucket admission gates every data-plane
@@ -220,7 +231,12 @@ class ExecutionEngine:
             # statement shape) travels on the ctx for the
             # dispatcher's weighted-fair scheduling.
             space = session.space_name or ""
-            if space and sentence.kind in _QOS_GATED_KINDS:
+            # shadow-read re-executions are off-path internal
+            # verification (common/consistency.py): they must not
+            # spend a tenant's admission tokens — being denied would
+            # starve verification exactly when the system is busiest
+            if space and sentence.kind in _QOS_GATED_KINDS \
+                    and not consistency.is_shadow():
                 admitted, retry_ms, lane_override = \
                     qos.admission.admit(space)
                 if not admitted:
@@ -262,10 +278,17 @@ class ExecutionEngine:
                 return resp
             result = r.value()
             ctx.input = None  # pipe input does not leak across ';'
+            if sentence.kind in _WRITE_KINDS:
+                # shadow freshness: a committed mutation moves the
+                # space's write sequence so any in-flight shadow
+                # sample skips its comparison (one flag read when
+                # shadow sampling is disarmed)
+                consistency.note_space_write(session.space_name or "")
         if result is not None:
             resp.columns = result.columns
             resp.rows = result.rows
         resp.space_name = session.space_name or ""
+        self._maybe_shadow_sample(session, seq, text, resp, shadow_ver)
         if tpu is not None and tpu.profile_seq != profile_seq0:
             # device-served: attach the engine's per-stage breakdown
             # (under concurrent sessions the latest served wins — the
@@ -276,6 +299,56 @@ class ExecutionEngine:
             resp.profile = dict(lp) if lp else lp
         resp.latency_us = int((time.monotonic() - t0) * 1e6)
         return resp
+
+    # ------------------------------------------------------------------
+    # shadow-read sampling (consistency observatory, common/
+    # consistency.py; docs/manual/10-observability.md)
+    # ------------------------------------------------------------------
+    # statements eligible for shadow re-execution: pure reads whose
+    # leftmost data leaf is a GO/FETCH (the serve paths the device
+    # engine owns), single-sentence so re-execution in a fresh shadow
+    # session has identical semantics
+    _SHADOW_LEAF_KINDS = {ast.Kind.GO, ast.Kind.FETCH_VERTICES,
+                          ast.Kind.FETCH_EDGES}
+    _SHADOW_KINDS = _SHADOW_LEAF_KINDS | {
+        ast.Kind.PIPE, ast.Kind.SET_OP, ast.Kind.YIELD,
+        ast.Kind.ORDER_BY, ast.Kind.LIMIT, ast.Kind.GROUP_BY}
+
+    def _maybe_shadow_sample(self, session, seq, text: str,
+                             resp: ExecutionResponse,
+                             shadow_ver=None) -> None:
+        """Sample this successful serve for CPU-pipe re-execution —
+        one flag read disarmed; armed, a digest of the rows + a
+        bounded enqueue (the verifier worker does the rest off the
+        serve path). `shadow_ver` is the freshness token pinned at
+        execute START (before row computation). Never raises."""
+        try:
+            if resp.code != ErrorCode.SUCCEEDED or \
+                    shadow_ver is None or \
+                    not consistency.shadow.armed() or \
+                    consistency.is_shadow():
+                return
+            if seq.profile or len(seq.sentences) != 1:
+                return
+            s = seq.sentences[0]
+            if s.kind not in self._SHADOW_KINDS or \
+                    _lane_leaf(s).kind not in self._SHADOW_LEAF_KINDS:
+                return
+            # $var refs read another statement's result — they don't
+            # survive re-execution in a fresh session; $- / $^ / $$
+            # forms are self-contained within the one statement
+            i = text.find("$")
+            while i != -1:
+                if text[i + 1:i + 2] not in ("-", "^", "$"):
+                    return
+                i = text.find("$", i + 2)
+            from ..common.stats import current_trace_id
+            consistency.shadow.maybe_sample(
+                session.space_name or "", s.kind.value, text,
+                resp.rows or [], current_trace_id(),
+                version=shadow_ver)
+        except Exception:
+            pass    # verification must never fail a serve
 
     # ------------------------------------------------------------------
     def _run(self, ctx: ExecContext, s: ast.Sentence) -> ex.Result:
@@ -413,6 +486,50 @@ class GraphService:
         self.sessions = sessions or SessionManager()
         self.active_queries = ActiveQueryRegistry()
         self.slow_log = SlowQueryLog()
+        # shadow-read verification (common/consistency.py): this
+        # service owns the process's shadow runner — sampled serves
+        # re-execute here through the CPU pipe (the shadow ContextVar
+        # makes the device engine decline) and compare byte-for-byte.
+        # install() replaces by design (the flight-collector idiom).
+        consistency.shadow.install(self._shadow_run,
+                                   self._shadow_version)
+
+    def _shadow_run(self, space: str, text: str) -> list:
+        """Re-execute one sampled statement in a fresh root session
+        (the worker sets the shadow ContextVar around this call, so
+        the device engine declines and admission is bypassed)."""
+        session = self.sessions.create("root")
+        try:
+            if space:
+                r = self.engine.execute(session, f"USE {space}")
+                if not r.ok():
+                    raise RuntimeError(f"shadow USE failed: "
+                                       f"{r.error_msg}")
+            resp = self.engine.execute(session, text)
+            if not resp.ok():
+                raise RuntimeError(f"shadow execute failed "
+                                   f"[{resp.code.name}]: "
+                                   f"{resp.error_msg}")
+            return resp.rows or []
+        finally:
+            self.sessions.remove(session.session_id)
+
+    def _shadow_version(self, space: str):
+        """The freshness token a shadow comparison must hold across:
+        the graph-level write sequence plus — when a device provider
+        serves the space — its structural version token (any committed
+        write moves it)."""
+        seq = consistency.space_write_seq(space)
+        tok = None
+        tpu = self.engine.tpu_engine
+        if tpu is not None and space and \
+                getattr(tpu, "_provider", None) is not None:
+            try:
+                sid = self.engine.meta.get_space(space).value().space_id
+                tok = tpu._provider.version(sid)
+            except Exception:
+                tok = None
+        return (seq, tok)
 
     def authenticate(self, user: str, password: str) -> StatusOr[int]:
         if not self.engine.meta.check_password(user, password):

@@ -18,13 +18,27 @@ from __future__ import annotations
 import threading
 from typing import Callable, Iterable, List, Optional, Tuple
 
+from ..common import consistency as _consistency
 from ..common import keys as keyutils
 from ..common import profiler as _profiler
+from ..common.faults import InjectedFault, faults
 from ..common.status import ErrorCode, Status
 from . import log_encoder as le
 from .iface import KVEngine
 
 KV = Tuple[bytes, bytes]
+
+# consistency.corrupt (docs/manual/9-robustness.md): armed on ONE
+# replica (n=1 in an in-proc cluster fires on exactly one apply), it
+# silently flips one byte of a committed put's value as the state
+# machine applies it — the replica's store AND its content digest
+# drift from the committed log, and the leader's next digest exchange
+# round must flag the divergence (the bench --consistency drill)
+faults.register("consistency.corrupt",
+                doc="flip one byte of a committed put value during a "
+                    "replicated Part.commit_logs apply — the silent "
+                    "single-replica corruption the consistency "
+                    "observatory exists to detect")
 
 # An atomic op runs at the serialization point and returns encoded log
 # bytes to commit (or None to abort) — ref: KVStore.h:140-143 asyncAtomicOp.
@@ -44,7 +58,23 @@ class Part:
         self.last_committed_term = 0
         self._snapshot_active = False   # mid-install chunk sequence
         self._load_commit_marker()
+        # consistency observatory (common/consistency.py): rolling
+        # content digest over this part's data keys, anchored to
+        # (term, applied_log_id) at every commit batch. Built eagerly
+        # at bind when armed (one prefix scan — the same cost class as
+        # a CSR build); a disarm window invalidates it and the next
+        # probe rebuilds lazily.
+        self.digest = _consistency.PartDigest()
+        if _consistency.enabled():
+            self.digest.rebuild(self.engine,
+                                keyutils.part_prefix(self.part_id))
+            self.digest.anchor_to(self.last_committed_term,
+                                  self.last_committed_log_id)
         self._consensus = consensus or DirectCommit(self)
+        # replicated parts (raft consensus) are the consistency.corrupt
+        # drill's targets — DirectCommit (meta store, single-replica
+        # spaces) has no second replica to diverge from
+        self._replicated = hasattr(self._consensus, "raft")
         # consensus impls that need the Part (raft: commit/snapshot
         # callbacks + applied id) late-bind here
         if hasattr(self._consensus, "bind"):
@@ -89,26 +119,104 @@ class Part:
             # flight — clear the flag a sender-side abort can leave
             # behind, so the NEXT install gets its prefix cleanup
             self._snapshot_active = False
+            # consistency digest (common/consistency.py): fold this
+            # batch's effects incrementally — overwrites/removes need
+            # the OLD value (one engine get per touched key, armed
+            # only). `pending` tracks keys of the still-unflushed put
+            # batch so a key written twice in one batch folds against
+            # its in-batch predecessor, not the engine.
+            dig = None
+            if _consistency.enabled():
+                dig = self.digest
+                if not dig.valid or dig.mid_install:
+                    # re-arm after a disarm window / a sender-aborted
+                    # install left the digest unreliable: rebuild from
+                    # the pre-batch engine state before folding
+                    dig.rebuild(self.engine,
+                                keyutils.part_prefix(self.part_id))
+            elif self.digest.valid:
+                self.digest.invalidate()   # disarmed mid-flight
+            pending: dict = {}
+            corrupted = False
+
+            def _fold_put(k: bytes, v: bytes) -> None:
+                if dig is None or not _consistency.is_digestable_key(k):
+                    return
+                old = pending[k] if k in pending else self.engine.get(k)
+                if old is not None:
+                    dig.remove(k, old)
+                dig.add(k, v)
+                pending[k] = v
+
+            def _flush() -> None:
+                nonlocal batch_puts
+                if batch_puts:
+                    self.engine.multi_put(batch_puts)
+                    batch_puts = []
+                    pending.clear()
+
+            def _corrupt(v: bytes) -> bytes:
+                # consistency.corrupt: flip one byte of THIS put's
+                # value (replicated parts only; an armed n=1 plan
+                # corrupts exactly one replica's apply in an in-proc
+                # cluster). The flipped value flows through the digest
+                # too — the drift is cross-replica, detected by the
+                # leader's digest exchange, never self-reported.
+                nonlocal corrupted
+                if corrupted or not self._replicated or not v:
+                    return v
+                try:
+                    faults.fire("consistency.corrupt")
+                except InjectedFault:
+                    corrupted = True
+                    return v[:-1] + bytes([v[-1] ^ 0x01])
+                return v
+
             for log_id, term, data in logs:
                 if not data:
                     continue  # heartbeat/noop entry
                 op, payload = le.decode(data)
                 if op == le.OP_PUT:
-                    batch_puts.append(payload)
+                    k, v = payload
+                    v = _corrupt(v)
+                    _fold_put(k, v)
+                    batch_puts.append((k, v))
                 elif op == le.OP_MULTI_PUT:
-                    batch_puts.extend(payload[0])
+                    for k, v in payload[0]:
+                        v = _corrupt(v)
+                        _fold_put(k, v)
+                        batch_puts.append((k, v))
                 else:
                     # non-put ops flush accumulated puts first to keep order
-                    if batch_puts:
-                        self.engine.multi_put(batch_puts)
-                        batch_puts = []
+                    _flush()
                     if op == le.OP_REMOVE:
+                        if dig is not None:
+                            old = self.engine.get(payload[0])
+                            if old is not None and \
+                                    _consistency.is_digestable_key(
+                                        payload[0]):
+                                dig.remove(payload[0], old)
                         self.engine.remove(payload[0])
                     elif op == le.OP_MULTI_REMOVE:
+                        if dig is not None:
+                            for k in payload[0]:
+                                old = self.engine.get(k)
+                                if old is not None and \
+                                        _consistency.is_digestable_key(k):
+                                    dig.remove(k, old)
                         self.engine.multi_remove(payload[0])
                     elif op == le.OP_REMOVE_RANGE:
+                        if dig is not None:
+                            for k, v in self.engine.range(payload[0],
+                                                          payload[1]):
+                                if _consistency.is_digestable_key(k):
+                                    dig.remove(k, v)
                         self.engine.remove_range(payload[0], payload[1])
                     elif op == le.OP_REMOVE_PREFIX:
+                        if dig is not None:
+                            for k, v in self.engine.prefix(payload[0]):
+                                if _consistency.is_digestable_key(k):
+                                    dig.remove(k, v)
                         self.engine.remove_prefix(payload[0])
                     elif op in (le.OP_ADD_LEARNER, le.OP_TRANS_LEADER,
                                 le.OP_ADD_PEER, le.OP_REMOVE_PEER):
@@ -122,6 +230,8 @@ class Part:
             self.engine.multi_put(batch_puts)
             self.last_committed_log_id = last_id
             self.last_committed_term = logs[-1][1]
+            if dig is not None:
+                dig.anchor_to(logs[-1][1], last_id)
         return Status.OK()
 
     def commit_snapshot(self, kvs: List[KV], committed_log_id: int,
@@ -136,10 +246,32 @@ class Part:
         mid-install therefore restarts recovery from marker 0 and the
         receiver simply re-requests the snapshot."""
         with self._lock:
+            track = _consistency.enabled()
             if not self._snapshot_active:
                 self.engine.remove_prefix(
                     keyutils.part_prefix(self.part_id))
                 self._snapshot_active = True
+                # install START replaces history wholesale: the digest
+                # restarts from the cleared prefix and folds chunks in
+                # (mid-install it is unreportable; the final chunk
+                # anchors it to the snapshot's commit point)
+                if track:
+                    self.digest.begin_install()
+                else:
+                    self.digest.invalidate()
+            if track and self.digest.valid:
+                for k, v in kvs:
+                    if _consistency.is_digestable_key(k):
+                        # snapshot rows are a sorted unique scan of the
+                        # sender's prefix (its system keys ride along
+                        # but are excluded here like everywhere else)
+                        self.digest.add(k, v)
+            elif self.digest.valid:
+                # disarmed MID-install: chunks applied but not folded
+                # — the digest must not survive to be anchored as
+                # valid at `finished` (or after a re-arm) missing this
+                # window's keys; invalidate so the next probe rebuilds
+                self.digest.invalidate()
             self.engine.multi_put(kvs)
             if finished:
                 self.engine.put(keyutils.system_commit_key(self.part_id),
@@ -148,12 +280,76 @@ class Part:
                 self.last_committed_log_id = committed_log_id
                 self.last_committed_term = committed_term
                 self._snapshot_active = False
+                if track and self.digest.valid:
+                    self.digest.anchor_to(committed_term,
+                                          committed_log_id)
         return len(kvs)
 
     def cleanup(self) -> Status:
         """Drop all data of this part (ref: Part::cleanup on removePart)."""
         with self._lock:
+            self.digest.invalidate()
             return self.engine.remove_prefix(keyutils.part_prefix(self.part_id))
+
+    def ingest(self, kvs: Iterable[KV]) -> Status:
+        """Bulk load around the log path (SST ingest): the engine
+        content changes without a commit batch, so the digest is
+        invalidated and lazily rebuilt on the next probe."""
+        with self._lock:
+            self.digest.invalidate()
+            return self.engine.ingest(kvs)
+
+    # ------------------------------------------------------------------
+    # consistency observatory surface (common/consistency.py)
+    # ------------------------------------------------------------------
+    def digest_anchor(self) -> Optional[Tuple[int, int, int]]:
+        """(anchor_term, anchor_log_id, digest) of this part's live
+        content — None when disarmed or mid-snapshot-install. A digest
+        invalidated by a disarm window / ingest rebuilds here from one
+        engine scan (under the part lock, once per re-arm)."""
+        if not _consistency.enabled():
+            return None
+        anc = self.digest.anchor()
+        if anc is not None:
+            return anc
+        with self._lock:
+            if self.digest.mid_install or self._snapshot_active:
+                return None
+            if not self.digest.valid:
+                self.digest.rebuild(self.engine,
+                                    keyutils.part_prefix(self.part_id))
+                self.digest.anchor_to(self.last_committed_term,
+                                      self.last_committed_log_id)
+        return self.digest.anchor()
+
+    def digest_at(self, log_id: int) -> Optional[int]:
+        """This part's digest when its applied index was `log_id` —
+        the leader's comparison base for follower-reported anchors
+        (None when unknown: rolled off the bounded history or batch
+        boundaries didn't align — skipped, never a false positive)."""
+        if not _consistency.enabled():
+            return None
+        return self.digest.at(log_id)
+
+    def digest_scrub(self) -> dict:
+        """Deep scrub: recompute the content digest from a full engine
+        scan under the part lock and compare against the incremental
+        one — catches silent store mutation that bypassed the apply
+        path (the bit-rot class). /consistency?scrub=1."""
+        with self._lock:
+            if not _consistency.enabled() or not self.digest.valid:
+                return {"space": self.space_id, "part": self.part_id,
+                        "ok": None, "reason": "disarmed"}
+            scanned = _consistency.digest_items(
+                (k, v) for k, v in self.engine.prefix(
+                    keyutils.part_prefix(self.part_id))
+                if _consistency.is_digestable_key(k))
+            ok = scanned == self.digest.value
+            return {"space": self.space_id, "part": self.part_id,
+                    "ok": ok,
+                    "incremental": _consistency.hex_digest(
+                        self.digest.value),
+                    "scanned": _consistency.hex_digest(scanned)}
 
     # ------------------------------------------------------------------
     def _load_commit_marker(self) -> None:

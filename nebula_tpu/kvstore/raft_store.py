@@ -89,6 +89,7 @@ class RaftConsensusHook(ConsensusHook):
                 _t.Thread(target=_cb, args=(_sid, _pid, leader),
                           daemon=True,
                           name=f"raft-lc-{_sid}-{_pid}").start()
+        self.part = part
         self.raft = RaftPart(
             space_id=self._space_id, part_id=self._part_id,
             addr=self._addr, peers=self._peers, wal_dir=wal_dir,
@@ -100,6 +101,11 @@ class RaftConsensusHook(ConsensusHook):
             applied_id=part.last_committed_log_id,
             is_learner=self._is_learner,
             on_leader_change=on_lc,
+            # consistency observatory: the state machine's content-
+            # digest seams — responders report their anchor on every
+            # append round, leaders compare against their own history
+            digest_probe=part.digest_anchor,
+            digest_at=part.digest_at,
             **self._raft_kw)
         self.raft.start()
 
@@ -266,6 +272,42 @@ class StorageNode:
             h = self.hooks.get(key)
             if h is not None and h.raft is not None:
                 out[key] = h.raft.compact_wal(lag, anchor=anchor)
+        return out
+
+    def consistency_status(self) -> List[dict]:
+        """Per-part consistency view (the storaged /consistency body):
+        this replica's digest anchor plus — on leaders — every
+        replica's match/applied/digest_ok. A deep scrub is the Part's
+        own digest_scrub (the ?scrub=1 path walks hooks too)."""
+        out = []
+        for key in sorted(self.hooks):
+            h = self.hooks.get(key)
+            if h is None or h.raft is None:
+                continue
+            st = h.raft.status_with_replicas()
+            out.append({
+                "space": st["space"], "part": st["part"],
+                "role": st["role"], "term": st["term"],
+                "committed": st["committed"],
+                "digest": st.get("digest"),
+                "digest_divergent": st.get("digest_divergent", []),
+                "replicas": [
+                    {k: m.get(k) for k in
+                     ("addr", "learner", "match", "applied",
+                      "digest_ok", "digest_anchor", "staleness_ms")}
+                    for m in st.get("replicas", [])],
+            })
+        return out
+
+    def digest_scrub(self) -> List[dict]:
+        """Deep-scrub every local part's digest against a full engine
+        scan (the /consistency?scrub=1 body)."""
+        out = []
+        for key in sorted(self.hooks):
+            h = self.hooks.get(key)
+            part = getattr(h, "part", None) if h is not None else None
+            if part is not None:
+                out.append(part.digest_scrub())
         return out
 
     def leader_parts(self) -> Dict[int, List[int]]:

@@ -28,6 +28,13 @@ class Host:
         # these on the leader (RaftPart.replica_watermarks)
         self.last_ack_ts = 0.0
         self.caught_up_ts = time.monotonic()
+        # consistency observatory (common/consistency.py): outcome of
+        # the leader's last digest comparison against this replica —
+        # None until a comparable anchor was seen, then True/False;
+        # digest_anchor is the applied log id the verdict anchors to
+        self.digest_ok: "bool|None" = None
+        self.digest_anchor = 0
+        self.digest_ts = 0.0
         self._lock = threading.Lock()
 
     def reset_for_leader(self, last_log_id: int) -> None:

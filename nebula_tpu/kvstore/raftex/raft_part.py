@@ -102,7 +102,9 @@ class RaftPart:
                  rpc_timeout: float = 1.0,
                  wal_ttl_secs: int = 86400,
                  wal_file_size: int = 16 * 1024 * 1024,
-                 on_leader_change: Callable[[Optional[str]], None] = None):
+                 on_leader_change: Callable[[Optional[str]], None] = None,
+                 digest_probe: Callable[[], Optional[Tuple[int, int, int]]] = None,
+                 digest_at: Callable[[int], Optional[int]] = None):
         self.space_id = space_id
         self.part_id = part_id
         self.addr = addr
@@ -115,6 +117,12 @@ class RaftPart:
         self._on_snapshot = on_snapshot
         self._snapshot_rows = snapshot_rows
         self._on_leader_change = on_leader_change
+        # consistency observatory (common/consistency.py): the state
+        # machine's content-digest seams — the responder reports its
+        # anchor on every append/heartbeat response, the leader
+        # compares each follower's anchor against its own history
+        self._digest_probe = digest_probe
+        self._digest_at = digest_at
 
         self._hb = heartbeat_interval
         self._election_timeout = election_timeout
@@ -423,6 +431,12 @@ class RaftPart:
                 # staleness_ms is estimated from while it lags
                 if host.match_id >= committed:
                     host.caught_up_ts = time.monotonic()
+                # consistency: compare the replica's reported content-
+                # digest anchor against this leader's own history at
+                # the same applied index (common/consistency.py) —
+                # outside the part lock, monitoring-grade
+                if getattr(resp, "digest", None) is not None:
+                    self._note_replica_digest(host, resp.digest)
             elif resp.code in (RaftCode.E_LOG_GAP, RaftCode.E_LOG_STALE):
                 host.on_gap(resp.last_log_id)
             elif resp.code is RaftCode.E_TERM_OUT_OF_DATE:
@@ -476,6 +490,45 @@ class RaftPart:
                               applied=m["applied"],
                               commit=m["commit"])
 
+    def _note_replica_digest(self, host: Host,
+                             dig: Tuple[int, int, int]) -> None:
+        """Leader-side digest comparison for one replica (consistency
+        observatory, common/consistency.py). The replica reports
+        (anchor_term, applied_log_id, digest); two replicas at the
+        same applied index MUST agree, so a known anchor with a
+        different digest is a divergence — counted, flagged on the
+        Host, and flight-recorded ON THE TRANSITION (a persistent
+        divergence records one event per episode, not one per round).
+        Unknown anchors (rolled off the bounded history / batch
+        boundaries unaligned) are skipped — never a false positive."""
+        from ...common import consistency as _consistency
+        if self._digest_at is None or not _consistency.enabled():
+            return
+        try:
+            term, log_id, value = dig
+            mine = self._digest_at(int(log_id))
+        except Exception:
+            return
+        stats.add_value("consistency.digest_checks", kind="counter")
+        if mine is None:
+            stats.add_value("consistency.anchor_miss", kind="counter")
+            return
+        if mine == value:
+            host.digest_ok = True
+            host.digest_anchor = int(log_id)
+            host.digest_ts = time.monotonic()
+            return
+        first = host.digest_ok is not False
+        host.digest_ok = False
+        host.digest_anchor = int(log_id)
+        host.digest_ts = time.monotonic()
+        if first:
+            _consistency.record_divergence(
+                self.space_id, self.part_id, host.addr,
+                int(log_id), int(term), mine, value)
+        else:
+            stats.add_value("consistency.divergence", kind="counter")
+
     def replica_watermarks(self) -> List[dict]:
         """Per-replica applied/commit watermarks + a staleness_ms
         estimate, leader-side (empty on followers/learners — only the
@@ -509,6 +562,11 @@ class RaftPart:
                     "lag": max(0, committed - h.match_id),
                     "staleness_ms": round(
                         max(0.0, (now - ref) * 1000.0), 1),
+                    # consistency observatory: the leader's latest
+                    # digest verdict for this replica (None = no
+                    # comparable anchor seen yet / disarmed)
+                    "digest_ok": h.digest_ok,
+                    "digest_anchor": h.digest_anchor,
                 })
             return out
 
@@ -810,11 +868,21 @@ class RaftPart:
             return self._append_resp_locked(RaftCode.SUCCEEDED)
 
     def _append_resp_locked(self, code: RaftCode) -> AppendLogResponse:
+        # additive consistency element (v1.3): report this replica's
+        # content-digest anchor so the leader can verify it on the
+        # same round — one probe (disarmed: a single flag read)
+        dig = None
+        if self._digest_probe is not None:
+            try:
+                dig = self._digest_probe()
+            except Exception:
+                dig = None
         return AppendLogResponse(
             code=code, term=self.term, leader=self.leader_addr,
             committed_log_id=self.committed_id,
             last_log_id=self.wal.last_log_id,
-            last_log_term=self.wal.last_log_term)
+            last_log_term=self.wal.last_log_term,
+            digest=dig)
 
     # ------------------------------------------------------------------
     # snapshot transfer
@@ -1046,4 +1114,21 @@ class RaftPart:
         st["replicas"] = self.replica_watermarks()
         st["staleness_ms"] = max(
             (m["staleness_ms"] for m in st["replicas"]), default=0.0)
+        # consistency observatory: this replica's own content-digest
+        # anchor (status, not telemetry — like the /raft watermarks)
+        dig = None
+        if self._digest_probe is not None:
+            try:
+                dig = self._digest_probe()
+            except Exception:
+                dig = None
+        if dig is not None:
+            from ...common import consistency as _consistency
+            st["digest"] = {"anchor_term": dig[0], "anchor_id": dig[1],
+                            "digest": _consistency.hex_digest(dig[2])}
+        else:
+            st["digest"] = None
+        st["digest_divergent"] = sorted(
+            m["addr"] for m in st["replicas"]
+            if m.get("digest_ok") is False)
         return st

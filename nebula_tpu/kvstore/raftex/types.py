@@ -85,6 +85,12 @@ class AppendLogResponse:
     committed_log_id: int
     last_log_id: int
     last_log_term: int
+    # consistency observatory (v1.3 additive, docs/manual/
+    # 6-wire-protocol.md §2): the responder's content-digest anchor
+    # (anchor_term, applied_log_id, digest) for this part, or None
+    # when disarmed/mid-install — the leader compares it against its
+    # own anchor history on every replication round
+    digest: Optional[Tuple[int, int, int]] = None
 
 
 @dataclass

@@ -81,6 +81,38 @@ class GraphStore:
         info = self._spaces.get(space_id)
         return sorted(info.parts) if info else []
 
+    def space_parts(self, space_id: int) -> List[Part]:
+        """The live Part objects of one space (point-in-time list) —
+        the consistency observatory's digest walk."""
+        info = self._spaces.get(space_id)
+        if info is None:
+            return []
+        return [p for _, p in sorted(info.parts.items())]
+
+    def space_digest(self, space_id: int):
+        """(folded content digest, engine write_version) of one
+        space's parts, or None when disarmed / unavailable / a write
+        raced the walk (version re-checked after folding — the pair is
+        only returned when it names a consistent point). The store
+        digest CSR snapshot lineage records (engine_tpu/engine.py
+        snapshot audit)."""
+        from ..common import consistency as _consistency
+        if not _consistency.enabled():
+            return None
+        info = self._spaces.get(space_id)
+        if info is None:
+            return None
+        v0 = info.engine.write_version
+        total = 0
+        for part in self.space_parts(space_id):
+            anc = part.digest_anchor()
+            if anc is None:
+                return None
+            total = _consistency.fold_add(total, anc[2])
+        if info.engine.write_version != v0:
+            return None          # a write landed mid-walk: no claim
+        return total, v0
+
     def leader_parts(self, space_id: int) -> List[int]:
         """Parts of the space this node currently LEADS (every part for
         unreplicated DirectCommit nodes). Folded into the freshness
@@ -207,4 +239,6 @@ class GraphStore:
         pr = self.part(space_id, part_id)
         if not pr.ok():
             return pr.status
-        return pr.value().engine.ingest(kvs)
+        # through the Part so its content digest invalidates (bulk
+        # load bypasses the commit-batch digest fold)
+        return pr.value().ingest(kvs)

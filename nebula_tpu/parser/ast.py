@@ -628,6 +628,10 @@ class ShowKind(enum.Enum):
     CONFIGS = "CONFIGS"
     VARIABLES = "VARIABLES"
     SNAPSHOTS = "SNAPSHOTS"
+    # consistency observatory (docs/manual/10-observability.md):
+    # cluster-wide per-part digest state — "consistency" stays an
+    # unreserved identifier (soft keyword, like BALANCE DATA heat)
+    CONSISTENCY = "CONSISTENCY"
 
 
 @dataclass

@@ -700,6 +700,12 @@ class GQLParser:
             if self._at("GRAPH", "META", "STORAGE"):
                 module = self._expect("GRAPH", "META", "STORAGE").type
             return ast.ConfigSentence("SHOW", module)
+        # SHOW CONSISTENCY: cluster-wide digest state (consistency
+        # observatory; "consistency" is an unreserved identifier —
+        # the BALANCE DATA heat soft-keyword idiom)
+        if self._at(T_ID) and self._peek().value.lower() == "consistency":
+            self.i += 1
+            return ast.ShowSentence(ast.ShowKind.CONSISTENCY)
         t = self._expect("SPACES", "TAGS", "EDGES", "HOSTS", "PARTS", "USERS",
                          "ROLES", "VARIABLES", "SNAPSHOTS")
         arg = None

@@ -62,6 +62,18 @@ DEFAULT_RULES: Tuple[Tuple[str, str], ...] = (
     ("*overhead.ratio", "ignore"),
     ("*heat.*", "ignore"),
     ("*staleness*", "ignore"),
+    # consistency observatory (ISSUE 15, CONSISTENCY_bench.json):
+    # detection latency is the quality gate (smaller is better);
+    # sample/verify tallies, digest echoes, shadow queue state and
+    # the drill's fault bookkeeping are run-length-dependent
+    # diagnostics — advisory drift, never gated
+    ("*detect_s", "lower"),
+    ("*shadow.mismatches", "lower"),
+    ("*divergence.*", "ignore"),
+    ("*shadow.*", "ignore"),
+    ("*consistency.*", "ignore"),
+    ("*digest*", "ignore"),
+    ("*corrupt*", "ignore"),
     # configuration echoes / identifiers / counts: not performance
     ("*.n", "ignore"), ("*.sessions*", "ignore"), ("*.seed", "ignore"),
     ("*graph.*", "ignore"), ("*topology.*", "ignore"),

@@ -4,14 +4,29 @@ StorageIntegrityTool.cpp — HBase "IntegrationTestBigLinkedList" style).
 Writes width*height vertices forming one big circle where each vertex's
 single int property points at the next vertex, then traverses from the
 first vertex and verifies the walk returns home in exactly width*height
-steps — any lost or corrupted write breaks the circle."""
+steps — any lost or corrupted write breaks the circle.
+
+The walk additionally folds every (vid -> next) hop it observes through
+the consistency observatory's shared hashing authority (common/
+consistency.py — the same fold the online per-part digests use) and
+compares against the digest of what was WRITTEN: a corrupted property
+that still happens to close the circle (e.g. a swapped pair) is caught
+by the content digest even when the step count looks right."""
 from __future__ import annotations
 
 import argparse
 from typing import Any, Dict
 
 from ..codec.row import RowWriter
+from ..common import consistency
 from ..storage.types import NewVertex
+
+
+def _hop_digest(pairs) -> int:
+    """Order-independent digest over (vid, next_vid) hops via the one
+    shared authority — used for both the written and observed sides."""
+    return consistency.digest_items(
+        (str(vid).encode(), str(nxt).encode()) for vid, nxt in pairs)
 
 
 def prepare_data(client, sm, space_id: int, tag_id: int, prop: str,
@@ -36,13 +51,16 @@ def prepare_data(client, sm, space_id: int, tag_id: int, prop: str,
 
 
 def validate(client, sm, space_id: int, tag_id: int, prop: str,
-             start_vid: int, expected_steps: int) -> Dict[str, Any]:
+             start_vid: int, expected_steps: int,
+             expected_digest=None) -> Dict[str, Any]:
     """Walk the circle from start_vid; OK iff we return to start in
-    exactly expected_steps hops. The chain is sequential pointer
-    chasing, so it is one get_vertex_props RPC per hop, exactly like
-    the reference's traversal loop."""
+    exactly expected_steps hops AND (when the writer's digest is
+    known) the observed hop digest matches it. The chain is sequential
+    pointer chasing, so it is one get_vertex_props RPC per hop, exactly
+    like the reference's traversal loop."""
     cur = start_vid
     steps = 0
+    observed = 0
     while steps < expected_steps:
         resp = client.get_vertex_props(space_id, [cur], [tag_id])
         nxt = None
@@ -52,21 +70,37 @@ def validate(client, sm, space_id: int, tag_id: int, prop: str,
         if nxt is None:
             return {"ok": False, "steps": steps, "broken_at": cur,
                     "reason": "missing vertex or property"}
+        observed = consistency.fold_add(
+            observed, consistency.kv_hash(str(cur).encode(),
+                                          str(nxt).encode()))
         cur = nxt
         steps += 1
         if cur == start_vid:
             break
     ok = (cur == start_vid and steps == expected_steps)
-    return {"ok": ok, "steps": steps,
-            "reason": None if ok else
-            f"walk closed after {steps} steps, expected {expected_steps}"}
+    out = {"ok": ok, "steps": steps,
+           "observed_digest": consistency.hex_digest(observed),
+           "reason": None if ok else
+           f"walk closed after {steps} steps, expected {expected_steps}"}
+    if expected_digest is not None:
+        match = observed == expected_digest
+        out["written_digest"] = consistency.hex_digest(expected_digest)
+        out["digests_equal"] = match
+        if not match:
+            out["ok"] = False
+            out["reason"] = out["reason"] or \
+                "content digest diverged from what was written"
+    return out
 
 
 def run_integrity(client, sm, space_id: int, tag_id: int, prop: str,
                   width: int, height: int, first_vid: int = 1) -> Dict[str, Any]:
     prepare_data(client, sm, space_id, tag_id, prop, width, height, first_vid)
-    return validate(client, sm, space_id, tag_id, prop, first_vid,
-                    width * height)
+    n = width * height
+    written = _hop_digest(
+        (first_vid + i, first_vid + ((i + 1) % n)) for i in range(n))
+    return validate(client, sm, space_id, tag_id, prop, first_vid, n,
+                    expected_digest=written)
 
 
 def main(argv=None) -> int:

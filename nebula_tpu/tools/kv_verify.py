@@ -1,11 +1,19 @@
 """Generic-KV roundtrip verification (role parity: tools/simple-kv-verify
 /SimpleKVVerifyTool.cpp): put N random key/values through the storage
-generic KV API, read them all back, compare."""
+generic KV API, read them all back, compare.
+
+The comparison runs through the consistency observatory's shared
+hashing authority (common/consistency.py kv_hash/fold_add — the SAME
+implementation the online per-part digests, shadow reads and snapshot
+audit fold), so the offline checker and the online observatory can
+never diverge on what "identical content" means."""
 from __future__ import annotations
 
 import argparse
 import random
 from typing import Any, Dict
+
+from ..common import consistency
 
 
 def run_kv_verify(client, space_id: int, count: int = 1000,
@@ -19,12 +27,24 @@ def run_kv_verify(client, space_id: int, count: int = 1000,
     st = client.kv_put(space_id, kvs)
     if not st.ok():
         return {"ok": False, "reason": f"put failed: {st.msg}"}
+    # fold what we WROTE and what we READ BACK through the one shared
+    # digest; per-key mismatches are still counted for the report
+    written = consistency.digest_items(kvs)
+    read_back = 0
     mismatches = 0
     for k, v in kvs:
         r = client.kv_get(space_id, k)
-        if not r.ok() or r.value() != v:
+        got = r.value() if r.ok() else b"\x00<missing>"
+        read_back = consistency.fold_add(
+            read_back, consistency.kv_hash(k, got))
+        if not r.ok() or got != v:
             mismatches += 1
-    return {"ok": mismatches == 0, "count": count, "mismatches": mismatches}
+    digests_equal = read_back == written
+    return {"ok": mismatches == 0 and digests_equal, "count": count,
+            "mismatches": mismatches,
+            "written_digest": consistency.hex_digest(written),
+            "read_digest": consistency.hex_digest(read_back),
+            "digests_equal": digests_equal}
 
 
 def main(argv=None) -> int:

@@ -337,6 +337,13 @@ _NL004_FAMILY_KINDS = {
     # add_value sites, so they carry no kind tag to pin)
     "heat.": "counter",
     "raftex.staleness_ms": "histogram",
+    # consistency observatory (ISSUE 15, common/consistency.py):
+    # digest checks/divergence/audit and shadow-read sample/verify/
+    # mismatch streams are all monotonic events — counters, so the
+    # disarm byte-identity contract (no families until a site fires,
+    # plain _total series after) holds uniformly
+    "consistency.": "counter",
+    "shadow.": "counter",
 }
 
 

@@ -158,6 +158,69 @@ def scrape(url: str, timeout: float = 5.0) -> Snapshot:
     return Snapshot(parse_samples(text), time.time())
 
 
+def fetch_consistency(base_url: str,
+                      timeout: float = 5.0) -> Optional[Dict[str, Any]]:
+    """graphd /consistency JSON (shadow verifier + federated per-part
+    digest state), or None when the endpoint is absent/unreachable —
+    the panel is optional like the profile panel."""
+    try:
+        with urllib.request.urlopen(
+                base_url.rstrip("/") + "/consistency",
+                timeout=timeout) as r:
+            return json.loads(r.read())
+    except Exception:
+        return None
+
+
+def render_consistency(cons: Optional[Dict[str, Any]]) -> List[str]:
+    """The consistency panel rows (docs/manual/10-observability.md,
+    "Consistency observatory"): per-part digest_ok / last-verified
+    anchor across the fleet + the shadow-read sample/mismatch rates.
+    Empty when the endpoint is absent or the observatory disarmed."""
+    if not cons or not cons.get("enabled", False):
+        return []
+    lines = [""]
+    sh = cons.get("shadow") or {}
+    lines.append(
+        f"consistency — shadow rate {sh.get('rate', 0):g}  "
+        f"sampled {sh.get('sampled', 0)}  "
+        f"verified {sh.get('verified', 0)}  "
+        f"MISMATCH {sh.get('mismatches', 0)}  "
+        f"stale-skip {sh.get('skipped_stale', 0)}")
+    divergent = cons.get("divergent") or []
+    if divergent:
+        for d in divergent[:4]:
+            lines.append(f"  DIVERGED s{d['space']}:p{d['part']} "
+                         f"replica {d['replica']} @ {d['host']}")
+    parts = [(h.get("addr") or h.get("host", "?"), p)
+             for h in (cons.get("cluster") or [])
+             for p in (h.get("parts") or [])]
+    if parts:
+        lines.append(f"{'SPACE:PART':<12}{'HOST':<24}{'ROLE':<10}"
+                     f"{'ANCHOR':>10}{'REPLICAS':>9}{'DIGEST_OK':>10}")
+        shown = sorted(
+            parts, key=lambda hp: (bool(hp[1].get('digest_divergent')),
+                                   hp[1].get('space', 0),
+                                   hp[1].get('part', 0)),
+            reverse=True)[:6]
+        for host, p in shown:
+            dig = p.get("digest") or {}
+            anchor = dig.get("anchor_id") if isinstance(dig, dict) \
+                else p.get("anchor_id")
+            reps = p.get("replicas") or []
+            oks = [m.get("digest_ok") for m in reps]
+            verdict = "DIVERGED" if p.get("digest_divergent") else (
+                "ok" if any(o is True for o in oks) else
+                ("-" if not reps else "?"))
+            sp = "%s:%s" % (p.get("space"), p.get("part"))
+            lines.append(
+                f"{sp:<12}"
+                f"{str(host)[:23]:<24}{p.get('role', '?'):<10}"
+                f"{anchor if anchor is not None else '-':>10}"
+                f"{len(reps):>9}{verdict:>10}")
+    return lines
+
+
 def fetch_profile(base_url: str,
                   timeout: float = 5.0) -> Optional[Dict[str, Any]]:
     """graphd /profile JSON (top self-time + lock table), or None when
@@ -203,7 +266,8 @@ def _rate(new: Snapshot, old: Optional[Snapshot], name: str) -> float:
 
 
 def render(new: Snapshot, old: Optional[Snapshot],
-           prof: Optional[Dict[str, Any]] = None) -> str:
+           prof: Optional[Dict[str, Any]] = None,
+           cons: Optional[Dict[str, Any]] = None) -> str:
     lines: List[str] = []
     insts = new.instances()
     up = sum(1 for i in insts if i["up"])
@@ -251,6 +315,7 @@ def render(new: Snapshot, old: Optional[Snapshot],
                          f"{cell(space, 'rows_scanned'):>12}"
                          f"{cell(space, 'rpc_bytes'):>12}")
     lines.extend(render_heat(new.part_heat()))
+    lines.extend(render_consistency(cons))
     lines.extend(render_profile(prof))
     return "\n".join(lines)
 
@@ -283,7 +348,8 @@ def render_heat(ph: Dict[str, Any]) -> List[str]:
 
 
 def snapshot_dict(s: Snapshot,
-                  prof: Optional[Dict[str, Any]] = None
+                  prof: Optional[Dict[str, Any]] = None,
+                  cons: Optional[Dict[str, Any]] = None
                   ) -> Dict[str, Any]:
     """--once --json machine form (totals, no rates)."""
     ph = s.part_heat()
@@ -299,6 +365,13 @@ def snapshot_dict(s: Snapshot,
         out["profile"] = {"frames": prof.get("frames", []),
                           "locks": prof.get("locks", []),
                           "state": prof.get("state", {})}
+    if cons is not None:
+        out["consistency"] = {
+            "enabled": cons.get("enabled"),
+            "shadow": cons.get("shadow", {}),
+            "divergent": cons.get("divergent", []),
+            "parts": sum(len(h.get("parts") or [])
+                         for h in (cons.get("cluster") or []))}
     return out
 
 
@@ -323,8 +396,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
     if args.once:
         prof = fetch_profile(base)
-        print(json.dumps(snapshot_dict(snap, prof), indent=1)
-              if args.json else render(snap, None, prof))
+        cons = fetch_consistency(base)
+        print(json.dumps(snapshot_dict(snap, prof, cons), indent=1)
+              if args.json else render(snap, None, prof, cons))
         return 0
     prev = snap
     # the profile panel must never stall the dashboard: sub-interval
@@ -332,7 +406,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     # daemon, a wedged endpoint) stop asking — the panel is optional
     prof_timeout = min(2.0, max(0.5, args.interval / 2))
     prof_fails = 0
-    try:
+    cons_fails = 0        # independent: a dead /profile must not
+    try:                  # kill a healthy consistency panel
         while True:
             time.sleep(max(args.interval, 0.2))
             try:
@@ -341,11 +416,15 @@ def main(argv: Optional[List[str]] = None) -> int:
                 print(f"nebtop: scrape failed: {e}", file=sys.stderr)
                 continue
             prof = None
+            cons = None
             if prof_fails < 3:
                 prof = fetch_profile(base, timeout=prof_timeout)
                 prof_fails = 0 if prof is not None else prof_fails + 1
+            if cons_fails < 3:
+                cons = fetch_consistency(base, timeout=prof_timeout)
+                cons_fails = 0 if cons is not None else cons_fails + 1
             sys.stdout.write("\x1b[2J\x1b[H")
-            print(render(cur, prev, prof))
+            print(render(cur, prev, prof, cons))
             prev = cur
     except KeyboardInterrupt:
         return 0

@@ -56,6 +56,48 @@ def _setup_cluster(space: str, v: int, e: int, seed: int):
     return cluster, conn, tpu, srcs, dsts
 
 
+def _arm_consistency(rate: float = 0.15) -> dict:
+    """Arm the consistency observatory's continuous assertions for a
+    soak run (ISSUE 15 satellite): shadow-read sampling on, counters
+    reset, divergence baseline captured. Returns the token
+    _settle_consistency consumes."""
+    from ..common import consistency as _cons
+    from ..common.flags import graph_flags
+    from ..common.stats import stats as _gstats
+    _cons.shadow.reset()
+    graph_flags.set("shadow_read_rate", rate)
+    return {"div0": _gstats.lifetime_total("consistency.divergence"),
+            "aud0": _gstats.lifetime_total(
+                "consistency.audit_mismatch")}
+
+
+def _settle_consistency(tok: dict) -> dict:
+    """Disarm sampling, drain the shadow queue and return the
+    continuous-consistency block: the soak FAILS unless shadow
+    mismatches and replica divergence stayed zero for the whole run
+    (no corruption fault is ever armed here — the observatory must be
+    silent on a healthy cluster, however hard the device faults
+    fire)."""
+    from ..common import consistency as _cons
+    from ..common.flags import graph_flags
+    from ..common.stats import stats as _gstats
+    graph_flags.set("shadow_read_rate", 0.0)
+    _cons.shadow.drain(20)
+    sh = _cons.shadow.stats()
+    block = {
+        "shadow": {k: sh[k] for k in
+                   ("sampled", "verified", "mismatches",
+                    "skipped_stale", "errors", "dropped")},
+        "divergence": _gstats.lifetime_total("consistency.divergence")
+        - tok["div0"],
+        "audit_mismatches": _gstats.lifetime_total(
+            "consistency.audit_mismatch") - tok["aud0"],
+    }
+    block["ok"] = (sh["mismatches"] == 0 and block["divergence"] == 0
+                   and block["audit_mismatches"] == 0)
+    return block
+
+
 def _debug_bundle(cluster, tpu, extra: dict,
                   path: str = "SOAK_DEBUG_BUNDLE.json") -> str:
     """First-class debug bundle: on any identity-check failure the soak
@@ -247,6 +289,10 @@ def _run_soak(seconds, write_ratio, verify_every, v, e, seed, progress,
         with tpu._stats_lock:
             tpu._breakers.clear()
         fthread = _fault_schedule(fstop, seed=seed)
+    # continuous-consistency assertion (ISSUE 15): shadow-read
+    # sampling runs for the whole faulted soak; mismatches and
+    # replica divergence must stay zero
+    ctok = _arm_consistency() if fault_schedule else None
 
     lats: List[float] = []
     next_vid = v
@@ -336,6 +382,7 @@ def _run_soak(seconds, write_ratio, verify_every, v, e, seed, progress,
     if fault_schedule:
         out["robustness"] = tpu.robustness_stats()
         out["cache"] = tpu.cache_stats()   # full ladder is armed here
+        out["consistency"] = _settle_consistency(ctok)
     # foreground rebuilds during the soak mean a write forced a
     # stop-the-world snapshot rebuild — the delta buffer's whole job
     # is keeping that at zero (background repacks are fine). Under an
@@ -346,7 +393,8 @@ def _run_soak(seconds, write_ratio, verify_every, v, e, seed, progress,
                  and verifies > 0)
     if fault_schedule:
         out["ok"] = out["ok"] and \
-            sum(out["robustness"]["faults_injected"].values()) > 0
+            sum(out["robustness"]["faults_injected"].values()) > 0 \
+            and out["consistency"]["ok"]
     return out
 
 
@@ -399,6 +447,7 @@ def _run_soak_concurrent(seconds, threads, v, e, seed,
         with tpu._stats_lock:
             tpu._breakers.clear()
         fthread = _fault_schedule(fstop, seed=seed)
+    ctok = _arm_consistency() if fault_schedule else None
     deg = np.bincount(srcs, minlength=v)
     hubs = [int(x) for x in np.argsort(deg)[-3:]]
     errors: List[str] = []
@@ -567,11 +616,13 @@ def _run_soak_concurrent(seconds, threads, v, e, seed,
     if fault_schedule:
         out["robustness"] = tpu.robustness_stats()
         out["cache"] = tpu.cache_stats()   # full ladder is armed here
+        out["consistency"] = _settle_consistency(ctok)
     out["ok"] = (not errors and verifies >= 15 and queries > 0
                  and stats["batched_queries"] > 0)
     if fault_schedule:
         out["ok"] = out["ok"] and \
-            sum(out["robustness"]["faults_injected"].values()) > 0
+            sum(out["robustness"]["faults_injected"].values()) > 0 \
+            and out["consistency"]["ok"]
     return out
 
 
@@ -939,6 +990,34 @@ def run_soak_crash(seconds: float = 45.0, seed: int = 29) -> dict:
         for q in queries:
             gc.must(q)
         topo.wait_leaders(sid, parts)
+        # continuous-consistency assertion (ISSUE 15): shadow-read
+        # sampling on the in-proc graphd for the whole storm; replica
+        # divergence polled from the SUBPROCESS storagds' /consistency
+        # at the end (their digest exchange runs in their processes)
+        ctok = _arm_consistency(rate=0.1)
+
+        def divergent_replicas() -> list:
+            import json as _json
+            import urllib.request
+            found = []
+            for n in topo.nodes:
+                if n.pid is None:
+                    continue
+                try:
+                    with urllib.request.urlopen(
+                            f"http://127.0.0.1:{n.ws_port}/consistency",
+                            timeout=3) as r:
+                        doc = _json.loads(r.read())
+                except Exception:
+                    continue
+                for p in doc.get("parts") or []:
+                    for rep in p.get("digest_divergent") or []:
+                        found.append({"node": n.name,
+                                      "space": p["space"],
+                                      "part": p["part"],
+                                      "replica": rep})
+            return found
+
         writers = LedgerWriters(topo.graphd.addr, space, v,
                                 n_writers=1, pace_s=0.015).start()
         stop = threading.Event()
@@ -1012,18 +1091,27 @@ def run_soak_crash(seconds: float = 45.0, seed: int = 29) -> dict:
         stop.set()
         writers.stop()
         vt.join(timeout=30)
+        cons = _settle_consistency(ctok)
+        div = divergent_replicas()
+        cons["divergent_replicas"] = div
         out = {
             "seconds": seconds, "crashes": crashes,
             "identity_verifies": verifies,
             "wal_replay_events": replay_events,
             "ledger": {**wsum, "missing": len(missing),
                        "missing_samples": missing[:5]},
+            "consistency": cons,
             "errors": errors[:5],
         }
+        # shadow errors are tolerated here (a re-execution can land in
+        # a kill window); mismatches and divergence are not — crash
+        # recovery must leave every replica's content digest verifying
         out["ok"] = (not errors and crashes >= 2
                      and len(missing) == 0 and wsum["errors"] == 0
                      and wsum["acked"] > 0 and verifies >= 10
-                     and replay_events >= 1)
+                     and replay_events >= 1
+                     and cons["shadow"]["mismatches"] == 0
+                     and not div)
         return out
     finally:
         try:

@@ -152,6 +152,38 @@ def test_skew_block_rules(tmp_path):
     assert benchdiff.main([str(a), str(b), "--advisory"]) == 0
 
 
+def test_consistency_block_rules(tmp_path):
+    """ISSUE 15 satellite: CONSISTENCY_bench.json diffs — the
+    detection latency judges as a latency (smaller is better); sample
+    tallies, digest echoes, fault bookkeeping and shadow queue state
+    are run-length diagnostics, advisory only."""
+    old = {
+        "drill": {"corrupt_fired": 1, "detect_s": 0.02,
+                  "digest_ok_gauge_lines": 6, "show_rows": 12,
+                  "shadow": {"sampled": 40, "verified": 9,
+                             "skipped_stale": 3}},
+        "shadow": {"sampled": 120, "verified": 30, "dropped": 50,
+                   "skipped_stale": 9},
+        "clean": {"writes": 200, "verified_replicas": 6},
+        "audit": {"checked": 1, "skipped": 0},
+    }
+    new = json.loads(json.dumps(old))
+    # diagnostic swings: all advisory
+    new["shadow"]["sampled"] = 3
+    new["shadow"]["dropped"] = 900
+    new["clean"]["verified_replicas"] = 1
+    new["drill"]["digest_ok_gauge_lines"] = 1
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    a.write_text(json.dumps(old))
+    b.write_text(json.dumps(new))
+    assert benchdiff.main([str(a), str(b)]) == 0
+    # ... but detection latency blowing up IS a regression
+    new["drill"]["detect_s"] = 4.5
+    b.write_text(json.dumps(new))
+    assert benchdiff.main([str(a), str(b)]) == 1
+    assert benchdiff.main([str(a), str(b), "--advisory"]) == 0
+
+
 def test_custom_rule_wins(tmp_path):
     new = _new(parsed__value=50.0)
     r = benchdiff.compare(OLD, new)

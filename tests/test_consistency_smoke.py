@@ -1,0 +1,74 @@
+"""Tier-1-safe consistency-observatory smoke: `bench.py --consistency
+--trim` in a SUBPROCESS on XLA:CPU — the corruption drill that proves
+an injected single-replica byte flip is DETECTED within the declared
+window (divergence gauge + replica_divergence flight bundle naming the
+part/replica/anchor), the clean phase has zero false positives,
+shadow-read verification stays identity-green, and the fully disarmed
+path leaves the metrics surface untouched (docs/manual/
+10-observability.md, "Consistency observatory"). The subprocess keeps
+the parent's JAX backend state out of the picture, exactly like the
+chaos/cluster/skew smoke tiers."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def cons_smoke(tmp_path_factory):
+    out = tmp_path_factory.mktemp("cons") / "CONSISTENCY_smoke.json"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_CONSISTENCY_SEED"] = "23"   # deterministic graph/draws
+    env["BENCH_CONSISTENCY_OUT"] = str(out)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--consistency", "--trim"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, (proc.stdout[-2000:],
+                                  proc.stderr[-2000:])
+    with open(out) as f:
+        return json.load(f)
+
+
+def test_consistency_all_gates_green(cons_smoke):
+    assert cons_smoke["ok"] is True, cons_smoke["gates"]
+    assert all(cons_smoke["gates"].values()), cons_smoke["gates"]
+
+
+def test_consistency_disarmed_left_no_trace(cons_smoke):
+    assert cons_smoke["disarmed"]["metric_lines"] == 0
+
+
+def test_consistency_shadow_identity_green(cons_smoke):
+    sh = cons_smoke["shadow"]
+    assert sh["sampled"] > 0 and sh["verified"] > 0, sh
+    assert sh["mismatches"] == 0 and sh["errors"] == 0, sh
+    # the replicated phase rode shadow too
+    sh2 = cons_smoke["drill"]["shadow"]
+    assert sh2["mismatches"] == 0, sh2
+
+
+def test_consistency_corruption_detected_in_window(cons_smoke):
+    drill = cons_smoke["drill"]
+    assert drill["corrupt_fired"] == 1, drill
+    assert drill["detect_s"] is not None
+    assert drill["detect_s"] <= cons_smoke["detect_window_s"], drill
+    # the bundle names the offending part, replica and anchor
+    ev = drill["bundle_event"]
+    assert ev["part"] is not None and ev["replica"], ev
+    assert ev["anchor"] is not None, ev
+    assert drill["divergent"], drill
+
+
+def test_consistency_clean_phase_no_false_positives(cons_smoke):
+    clean = cons_smoke["clean"]
+    assert clean["verified_replicas"] > 0, clean
+    assert clean["divergent"] == [], clean
+    # audit + scrub both green on the single-host phase
+    assert cons_smoke["audit"]["mismatches"] == 0
+    assert all(s["ok"] for s in cons_smoke["scrub"])
