@@ -2619,6 +2619,7 @@ def bench_cluster(out_path: str, trim: bool = False):
     old_hb = storage_flags.get("heartbeat_interval_secs")
     old_rhb = storage_flags.get("raft_heartbeat_ms")
     old_rel = storage_flags.get("raft_election_timeout_ms")
+    old_fr = storage_flags.get("follower_read_max_ms")
     # fast heartbeats + elections so failover and liveness expiry fit a
     # bench run (production keeps the defaults)
     storage_flags.set("heartbeat_interval_secs", 0.4)
@@ -2780,8 +2781,125 @@ def bench_cluster(out_path: str, trim: bool = False):
             phase_dur[name] = time.monotonic() - t0
             phase_box["name"] = None
 
-        # ---- phase 1: baseline
+        # ---- phase 1: baseline (leader-only routing)
         run_phase("baseline", lambda: time.sleep(phase_s))
+
+        # ---- phase 1b: arm bounded-staleness follower reads and
+        # measure the same traffic with GO windows spread across
+        # follower replicas under the raft read fence (ISSUE 16;
+        # docs/manual/12-replication.md "Follower reads")
+        fr_bound_ms = int(os.environ.get("BENCH_FOLLOWER_READ_MS", 150))
+        # arm through the cluster config registry (UPDATE CONFIGS ->
+        # meta -> heartbeat pull), the production path — a bare local
+        # flag set would be overwritten by the next meta pull
+        gc.must(f"UPDATE CONFIGS STORAGE:follower_read_max_ms = "
+                f"{fr_bound_ms}")
+        deadline = time.time() + 15
+        while storage_flags.get("follower_read_max_ms") != fr_bound_ms \
+                and time.time() < deadline:
+            time.sleep(0.05)
+        assert storage_flags.get("follower_read_max_ms") == fr_bound_ms
+        run_phase("follower_reads", lambda: time.sleep(phase_s))
+        quiesce()
+        identity_follower = follower_device = False
+        deadline = time.time() + (60 if trim else 45)
+        while time.time() < deadline:
+            identity_follower, dev = identity_sweep()
+            if identity_follower and dev:
+                follower_device = True
+                break
+            time.sleep(0.4)
+        resume()
+
+        def pct(phase):
+            xs = sorted(ms for ph, ms in lats if ph == phase)
+            if not xs:
+                return {"n": 0}
+            dur = max(phase_dur.get(phase, phase_s), 1e-3)
+            return {"n": len(xs),
+                    "p50_ms": round(float(np.percentile(xs, 50)), 2),
+                    "p99_ms": round(float(np.percentile(xs, 99)), 2),
+                    "qps": round(len(xs) / dur, 1),
+                    "wall_s": round(dur, 1)}
+
+        def follower_read_summary():
+            """Client + per-host device-serve counters, measured max
+            SERVED staleness, and the bound it must respect (fence
+            budget + shard-freshness slack)."""
+            cdev = dict(graphd.engine.client.device_stats)
+            per_host = {}
+            stal = [float(cdev.get("max_staleness_ms", 0.0))]
+            fr_granted = 0
+            for h in storers.values():
+                mgr = getattr(h, "device_shards", None)
+                if mgr is None:
+                    continue
+                per_host[h.addr] = dict(mgr.stats)
+                stal.append(float(mgr.stats.get("max_staleness_ms", 0)))
+                for p in range(1, parts + 1):
+                    r = h.node.raft(sid, p)
+                    if r is not None:
+                        fr_granted += r.follower_read_stats["granted"]
+            slack = int(storage_flags.get_or(
+                "device_shard_max_ms", 250, int))
+            max_stal = round(max(stal), 2)
+            return {
+                "bound_ms": fr_bound_ms,
+                "shard_slack_ms": slack,
+                "identity": identity_follower,
+                "device_served": follower_device,
+                "client": cdev,
+                "per_host": per_host,
+                "follower_parts_served": sum(
+                    s.get("follower_parts_served", 0)
+                    for s in per_host.values()),
+                "fence_grants": fr_granted,
+                "max_served_staleness_ms": max_stal,
+                "staleness_bounded": max_stal <= fr_bound_ms + slack,
+            }
+
+        if os.environ.get("BENCH_CLUSTER_READS_ONLY") == "1":
+            # the follower-read smoke tier
+            # (tests/test_cluster_read_smoke.py): stop after the armed
+            # phase — failover/balance ride the full cluster tier
+            stop.set()
+            resume()
+            for t in threads:
+                t.join(timeout=30)
+            fr = follower_read_summary()
+            phases = {ph: pct(ph) for ph in ("baseline",
+                                             "follower_reads")}
+            rec = {
+                "trim": trim, "reads_only": True,
+                "graph": {"V": v, "E": e, "partition_num": parts,
+                          "replica_factor": 3},
+                "sessions": {"readers": readers_n, "writers": 1},
+                "phases": phases,
+                "client_errors": errors[:5],
+                "client_error_count": len(errors),
+                "follower_reads": fr,
+                "lock_witness": _witness_summary(),
+            }
+            ok = (not errors and identity_follower and follower_device
+                  and fr["staleness_bounded"]
+                  and fr["follower_parts_served"] > 0
+                  and all(phases[ph]["n"] > 0 for ph in phases))
+            rec["ok"] = ok
+            with open(out_path, "w") as f:
+                json.dump(rec, f, indent=1)
+            log(f"cluster reads tier: phases={phases} "
+                f"errors={len(errors)} follower={fr['client']} "
+                f"-> {out_path}")
+            print(json.dumps({
+                "metric": "cluster_reads", "ok": ok,
+                "client_errors": len(errors),
+                "follower_parts_served": fr["follower_parts_served"],
+                "max_served_staleness_ms":
+                    fr["max_served_staleness_ms"]}))
+            if not ok:
+                raise SystemExit(f"cluster reads tier FAILED: "
+                                 f"{json.dumps(rec, indent=1)[:4000]}")
+            return rec
 
         # ---- phase 2: kill the storaged leading the most partitions
         def leader_counts():
@@ -2875,20 +2993,13 @@ def bench_cluster(out_path: str, trim: bool = False):
         for t in threads:
             t.join(timeout=30)
 
-        def pct(phase):
-            xs = sorted(ms for ph, ms in lats if ph == phase)
-            if not xs:
-                return {"n": 0}
-            dur = max(phase_dur.get(phase, phase_s), 1e-3)
-            return {"n": len(xs),
-                    "p50_ms": round(float(np.percentile(xs, 50)), 2),
-                    "p99_ms": round(float(np.percentile(xs, 99)), 2),
-                    "qps": round(len(xs) / dur, 1),
-                    "wall_s": round(dur, 1)}
-
-        phases = {ph: pct(ph) for ph in ("baseline", "failover",
-                                         "balance")}
+        phases = {ph: pct(ph) for ph in ("baseline", "follower_reads",
+                                         "failover", "balance")}
         base_p99 = phases["baseline"].get("p99_ms") or 1.0
+        follower_reads = follower_read_summary()
+        # leader-only vs follower-armed comparison of the SAME traffic
+        follower_reads["leader_only"] = phases["baseline"]
+        follower_reads["follower_armed"] = phases["follower_reads"]
         rec = {
             "trim": trim,
             "graph": {"V": v, "E": e, "partition_num": parts,
@@ -2917,6 +3028,11 @@ def bench_cluster(out_path: str, trim: bool = False):
                         "all_succeeded": balance_done,
                         "dead_host_evacuated": evacuated,
                         "fully_replicated": fully_replicated},
+            # ISSUE 16: bounded-staleness follower reads — leader-only
+            # vs follower-armed QPS/p99, per-host device-partial
+            # counters, and the measured max SERVED staleness against
+            # its bound (fence budget + shard slack)
+            "follower_reads": follower_reads,
             "cluster_stats": {
                 "retries": dict(graphd.engine.client.retry_stats),
                 # raft elections/deposals observed across the in-proc
@@ -2948,6 +3064,9 @@ def bench_cluster(out_path: str, trim: bool = False):
               and post_failover_device and balance_done and evacuated
               and fully_replicated and p99_bounded and attribution_ok
               and all(phases[ph]["n"] > 0 for ph in phases)
+              and identity_follower and follower_device
+              and follower_reads["staleness_bounded"]
+              and follower_reads["follower_parts_served"] > 0
               and rec["lock_witness"]["clean"])
         rec["ok"] = ok
         with open(out_path, "w") as f:
@@ -2980,6 +3099,7 @@ def bench_cluster(out_path: str, trim: bool = False):
             storage_flags.set("heartbeat_interval_secs", old_hb)
             storage_flags.set("raft_heartbeat_ms", old_rhb)
             storage_flags.set("raft_election_timeout_ms", old_rel)
+            storage_flags.set("follower_read_max_ms", old_fr)
             shutil.rmtree(run_dir, ignore_errors=True)
 
 

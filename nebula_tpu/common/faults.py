@@ -245,6 +245,13 @@ faults.register("wal.append",
 faults.register("wal.sync",
                 doc="explicit WAL fsync (Wal.sync / "
                     "wal_sync_every_append durability path)")
+faults.register("followerread.stale",
+                doc="follower-read fence lie: the replica reports a "
+                    "perfectly fresh time watermark regardless of how "
+                    "stale it really is (raft_part.read_fence) — the "
+                    "commit-index fence must reject it on its own, "
+                    "and a slip past both would surface in the PR 15 "
+                    "digest/shadow-read verification")
 faults.register("wal.torn_tail",
                 doc="truncate trailing bytes off the newest WAL "
                     "segment at close — the shape a power cut "

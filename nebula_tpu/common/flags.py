@@ -236,5 +236,31 @@ storage_flags.declare("raft_election_timeout_ms", 450, REBOOT,
                       "raft election timeout base (randomized 1-2x); "
                       "failover completes within ~2x this after a "
                       "leader dies")
+storage_flags.declare("follower_read_max_ms", 0, MUTABLE,
+                      "bounded-staleness follower reads: a follower "
+                      "replica may serve device-window reads whose "
+                      "staleness is provably under this bound "
+                      "(raft_part.read_fence — commit-index fence + "
+                      "time lease capped at the election timeout). "
+                      "0 disables: every read routes to the leader "
+                      "(docs/manual/12-replication.md)")
+storage_flags.declare("device_shard_max_ms", 250, MUTABLE,
+                      "storaged device-shard staleness budget: a "
+                      "local CSR shard whose build version has fallen "
+                      "behind the engine's write version keeps "
+                      "serving for this long before the part refuses "
+                      "to vouch and the read falls back to the row "
+                      "scan (docs/manual/13-device-speed.md)")
+storage_flags.declare("device_shard_refresh_ms", 50, MUTABLE,
+                      "period of the storaged device-shard refresh "
+                      "task (rebuild the local CSR shard when the "
+                      "engine write version moved; off the raft "
+                      "apply path)")
+graph_flags.declare("cluster_device_serve", True, MUTABLE,
+                    "graphd scatter/gather v2: fan GO windows out to "
+                    "per-storaged device partials (device_window RPC) "
+                    "instead of leader-routed row scans when the "
+                    "engine runs against a remote provider "
+                    "(docs/manual/13-device-speed.md)")
 meta_flags.declare("expired_threshold_sec", 10 * 60, MUTABLE,
                    "host liveness horizon")

@@ -35,6 +35,10 @@ class StoragedHandle:
     kv_watcher: Optional[object] = None  # storage_flags watcher to detach
     compactor_stop: Optional[threading.Event] = None
     compactor_thread: Optional[threading.Thread] = None
+    # storaged-tier device shards (storage/device_serve.py)
+    device_shards: Optional[object] = None
+    shard_stop: Optional[threading.Event] = None
+    shard_thread: Optional[threading.Thread] = None
 
     @property
     def addr(self) -> str:
@@ -45,6 +49,12 @@ class StoragedHandle:
         return self.web.port if self.web else None
 
     def stop(self) -> None:
+        if self.shard_stop is not None:
+            # device-shard refresh rebuilds scan the engine — stop and
+            # join before the node (and its engines) go down
+            self.shard_stop.set()
+            if self.shard_thread is not None:
+                self.shard_thread.join(timeout=10)
         if self.compactor_stop is not None:
             # stop AND join the compactor BEFORE the node goes down —
             # a round mid-flight must not flush an engine whose native
@@ -167,6 +177,10 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
         engine_factory = native_engine_factory(
             _os.path.join(data_dir, "engines") if data_dir else None)
     node = None
+    # filled once the DeviceShardManager exists (it needs the
+    # StorageService built below); the raft leader-change callback
+    # closes over the cell so elections invalidate shards immediately
+    shard_state: dict = {}
     if replicated:
         # raft-replicated parts: the second RpcServer on port+1 (bound
         # above, next to the storage server so an unlucky ephemeral
@@ -188,6 +202,13 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
             from ..common.flight import recorder as _flight
             _flight.record("leader_change", space=space_id,
                            part=part_id, leader=str(leader))
+            # leadership moved: the local device shard's vouch set is
+            # gone — drop it now (the old shard refuses to vouch, the
+            # refresh task rebuilds against the new leadership
+            # signature; docs/manual/13-device-speed.md)
+            mgr = shard_state.get("mgr")
+            if mgr is not None:
+                mgr.invalidate(space_id, part_id)
             if leader == raft_addr_of(addr):
                 _reconcile_part_membership(space_id, part_id)
 
@@ -420,6 +441,37 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
         from ..meta.net_admin import AdminService
         server.register("admin", AdminService(node))
     server.start()
+    device_shards = None
+    shard_stop = None
+    shard_thread = None
+    if node is not None:
+        # storaged-tier device shards (storage/device_serve.py;
+        # docs/manual/13-device-speed.md): a local CSR snapshot over
+        # this node's engines, refreshed off the raft apply path every
+        # device_shard_refresh_ms, serving graphd's device_window
+        # scatter/gather instead of leader-routed row scans
+        from ..storage.device_serve import DeviceShardManager
+        device_shards = DeviceShardManager(store, sm,
+                                           raft_lookup=node.raft,
+                                           host=addr)
+        storage.device_serve = device_shards
+        shard_state["mgr"] = device_shards
+        shard_stop = threading.Event()
+
+        def _shard_refresher(stop_ev=shard_stop, mgr=device_shards):
+            while not stop_ev.wait(max(0.01, storage_flags.get_or(
+                    "device_shard_refresh_ms", 50) / 1000.0)):
+                try:
+                    mgr.refresh()
+                except Exception:
+                    pass            # never die; next round retries
+
+        # nlint: disable=NL002 -- node-lifetime background maintenance
+        # loop; it serves every part and owes no request a trace
+        shard_thread = threading.Thread(
+            target=_shard_refresher, daemon=True,
+            name=f"device-shards-{addr}")
+        shard_thread.start()
     compactor_stop = None
     compactor_thread = None
     if node is not None:
@@ -542,6 +594,26 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
             return 200, out
 
         web.register("/heat", heat_handler)
+
+        def device_shards_handler(params, body):
+            # /device_shards (docs/manual/13-device-speed.md): the
+            # storaged-tier device-shard lifecycle — per-space build/
+            # freshness state + the serve counters (leader vs follower
+            # parts, fence refusals, measured max served staleness)
+            if device_shards is None:
+                return 200, {"enabled": False}
+            return 200, {"enabled": True, "addr": addr,
+                         "spaces": {sid: device_shards.snapshot_info(sid)
+                                    for sid in store.spaces()},
+                         "stats": dict(device_shards.stats)}
+
+        web.register("/device_shards", device_shards_handler)
+        if device_shards is not None:
+            def device_shard_metric_source():
+                return {f"storage.device_serve.{k}": v
+                        for k, v in device_shards.stats.items()}
+
+            web.add_metrics_source(device_shard_metric_source)
         # nebula_part_heat_* / nebula_heat_skew_index_* families
         # (empty — byte-identical /metrics — when heat is disarmed)
         web.add_metrics_source(heat.accountant.gauges)
@@ -609,7 +681,10 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
     return StoragedHandle(store, storage, mc, server, web, node, raft_server,
                           kv_watcher=_apply_kv_options,
                           compactor_stop=compactor_stop,
-                          compactor_thread=compactor_thread)
+                          compactor_thread=compactor_thread,
+                          device_shards=device_shards,
+                          shard_stop=shard_stop,
+                          shard_thread=shard_thread)
 
 
 def main(argv=None) -> None:

@@ -1,0 +1,134 @@
+"""graphd scatter/gather v2: GO windows over per-host device partials.
+
+The replicated cluster path used to degrade to leader-routed storaged
+row scans (CLUSTER_bench: ~70-90 QPS vs 7489 cached single-host) —
+the TPU engine lived only in graphd, and its remote snapshot
+invalidated on every committed write. This module is the other half of
+the storaged-tier device shards (storage/device_serve.py): instead of
+row scans, each GO hop fans out as ONE `device_window` RPC per host
+(storage/client.py, multiplexed over the existing pool), every storaged
+serves the parts it can vouch for from its LOCAL CSR shard (leader
+parts always; follower parts under the bounded-staleness raft read
+fence), and graphd merges the per-host partials — the psum-shaped
+merge is the vertices union (disjoint part sets: edges live at their
+source's part), then the SAME row assembly the CPU pipe uses
+(`executors._emit_go_rows`), which is the identity anchor: the cluster
+device path and the CPU pipe build rows from byte-identical
+BoundResponse-shaped partials.
+
+Fallback ladder (docs/manual/9-robustness.md): a part no host vouches
+for falls back to the row-scan `get_neighbors` path FOR THAT PART ONLY;
+a storage error anywhere declines the whole query to the CPU pipe
+(return None), which re-runs it — a client never sees a device-path
+error. Cluster-served results never enter the graphd result cache:
+bounded-staleness rows must not be published under the fresh token
+(`_tpu_no_cache`).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Set
+
+from ..common.flags import graph_flags, storage_flags
+from ..common.stats import stats as global_stats
+from ..common.status import ErrorCode, StatusOr
+from ..common.tracing import tracer as _tr
+
+
+class ClusterDeviceServe:
+    """Per-engine cluster GO serving over storaged device partials."""
+
+    def __init__(self, engine, client):
+        self.engine = engine
+        self.client = client
+        self.stats = {"served": 0, "declined": 0, "hops": 0,
+                      "fallback_parts": 0, "fallback_errors": 0}
+
+    def _decline(self, reason: str):
+        self.stats["declined"] += 1
+        self.engine.path_decline_reasons[f"cluster.{reason}"] = \
+            self.engine.path_decline_reasons.get(
+                f"cluster.{reason}", 0) + 1
+        return None
+
+    def serve_go(self, ctx, s, starts: List[int], edge_types: List[int],
+                 alias_map, name_by_type, ex, yield_cols):
+        """Returns a finalized Result, or None to decline (the caller
+        then rides the dispatcher / CPU pipe). Plain-form GO only —
+        the caller already excluded UPTO and input refs."""
+        all_exprs = [c.expr for c in yield_cols]
+        if s.where is not None:
+            all_exprs.append(s.where.filter)
+        vertex_props, needs_dst, _needs_input = \
+            ex._collect_prop_requirements(all_exprs, ctx)
+        if vertex_props:
+            # $^ source-tag props: device partials don't carry tag rows
+            return self._decline("src_props")
+        space = ctx.space_id()
+        # WHERE always evaluates graphd-side over full edge props —
+        # the identity-preserving choice (pushdown skip == local skip)
+        local_filter = s.where.filter if s.where is not None else None
+        fmax = int(storage_flags.get_or("follower_read_max_ms", 0, int))
+        allow_follower = fmax > 0
+        columns = [c.name() for c in yield_cols]
+        rows: List[tuple] = []
+        frontier = list(starts)
+        roots: Dict[int, Set[int]] = {v: {v} for v in starts}
+        for step_no in range(1, s.step.steps + 1):
+            final = step_no == s.step.steps
+            eprops = None if final else []
+            resp = self.client.device_window(
+                space, frontier, edge_types, edge_props=eprops,
+                allow_follower=allow_follower, follower_max_ms=fmax)
+            self.stats["hops"] += 1
+            refused = [p for p, pr in resp.results.items()
+                       if pr.code != ErrorCode.SUCCEEDED]
+            if refused:
+                # per-part row-scan fallback: only the unvouched parts'
+                # vids ride the CPU storage path
+                self.stats["fallback_parts"] += len(refused)
+                parts_map = self.client.cluster_ids_to_parts(
+                    space, frontier)
+                fb_vids = [v for p in refused
+                           for v in parts_map.get(p, [])]
+                if fb_vids:
+                    fb = self.client.get_neighbors(
+                        space, fb_vids, edge_types, edge_props=eprops)
+                    if any(r.code != ErrorCode.SUCCEEDED
+                           for r in fb.results.values()):
+                        self.stats["fallback_errors"] += 1
+                        return self._decline("storage_error")
+                    resp.vertices.extend(fb.vertices)
+            if final:
+                st = ex._emit_go_rows(ctx, resp, rows, yield_cols,
+                                      local_filter, alias_map,
+                                      name_by_type, roots, {}, False,
+                                      needs_dst)
+                if not st.ok():
+                    return StatusOr.from_status(st)
+                break
+            next_roots: Dict[int, Set[int]] = {}
+            seen: Set[int] = set()
+            nxt: List[int] = []
+            for v in resp.vertices:
+                for e in v.edges:
+                    if e.dst not in seen:
+                        seen.add(e.dst)
+                        nxt.append(e.dst)
+                    next_roots.setdefault(e.dst, set()).update(
+                        roots.get(v.vid, {v.vid}))
+            frontier = nxt
+            roots = next_roots
+            if not frontier:
+                break
+        from ..graph.interim import InterimResult
+        result = InterimResult(columns, rows)
+        if s.yield_ and s.yield_.distinct:
+            result = result.distinct()
+        # bounded-staleness partials must never be published under the
+        # fresh token (_result_cache_put checks this marker)
+        result._tpu_no_cache = True
+        self.stats["served"] += 1
+        global_stats.add_value("tpu_engine.cluster_served",
+                               kind="counter")
+        _tr.tag_root("served", "cluster_device")
+        return StatusOr.of(result)

@@ -284,7 +284,12 @@ class TpuGraphEngine:
                       # work shed at a watermark (typed E_OVERLOAD)
                       # before it could queue toward its deadline
                       "lane_rounds_interactive": 0,
-                      "lane_rounds_bulk": 0, "qos_shed": 0}
+                      "lane_rounds_bulk": 0, "qos_shed": 0,
+                      # cluster scatter/gather v2 (cluster.py;
+                      # docs/manual/13-device-speed.md): GO windows
+                      # served from per-storaged device partials
+                      "cluster_served": 0, "cluster_declined": 0,
+                      "cluster_hops": 0, "cluster_fallback_parts": 0}
         # mesh execution service (mesh_exec.py): device-served queries
         # on SHARDED snapshots, per feature — the decline matrix the
         # round-5 verdict flagged (batched windows / aggregation / ALL
@@ -372,6 +377,9 @@ class TpuGraphEngine:
         # stacks (double-buffering: window N+1's transfer overlaps
         # window N's kernel)
         self.frontier_pool = fused.FrontierPool()
+        # cluster scatter/gather v2 (cluster.py): lazily built when
+        # the provider is remote and cluster_device_serve is on
+        self._cluster = None
 
     # results bigger than this never enter the result cache (a handful
     # of supernode answers must not evict the whole working set)
@@ -1032,7 +1040,7 @@ class TpuGraphEngine:
                     cur = self._snapshots.get(space_id)
                     if (cur is not None and not cur.stale
                             and cur.write_version ==
-                            self._provider.version(space_id)
+                            self._version_nosleep(space_id)
                             and getattr(cur, "catalog_version", -1) ==
                             self._catalog_version()):
                         snap = cur
@@ -1126,7 +1134,7 @@ class TpuGraphEngine:
                         if space_id not in self._snapshots and \
                                 snap.total_edges > 0 and \
                                 self._provider is not None and \
-                                self._provider.version(space_id) == \
+                                self._version_nosleep(space_id) == \
                                 snap.write_version:
                             self._snapshots[space_id] = snap
                         else:
@@ -1228,6 +1236,19 @@ class TpuGraphEngine:
         if block:
             t.join()
 
+    def _version_nosleep(self, space_id: int):
+        """provider.version from a section HOLDING the engine lock:
+        suppress the shared retry sleeps (transport reconnect pacing
+        on a just-died host) — a miss fails fast into the decline/CPU
+        ladder instead of holding the lock for the backoff duration
+        (lock-witness finding during `bench --cluster` failover)."""
+        from ..common.faults import no_retry_sleep
+        tok = no_retry_sleep.set(True)
+        try:
+            return self._provider.version(space_id)
+        finally:
+            no_retry_sleep.reset(tok)
+
     def _snapshot_locked(self, space_id: int) -> Optional[CsrSnapshot]:
         if self._mesh_demoted and space_id in self._mesh_demoted \
                 and self.mesh is not None:
@@ -1245,7 +1266,7 @@ class TpuGraphEngine:
                 self._mesh_demoted.discard(space_id)
                 if not self._kick_repack(space_id):
                     self._mesh_demoted.add(space_id)   # retry later
-        token = self._provider.version(space_id)
+        token = self._version_nosleep(space_id)
         if token is None:
             return None
         snap = self._snapshots.get(space_id)
@@ -1703,6 +1724,11 @@ class TpuGraphEngine:
             return
         if getattr(v, "_tpu_deferred", None) is not None:
             return    # not boxed yet (defensive; callers finalize first)
+        if getattr(v, "_tpu_no_cache", False):
+            return    # cluster-served partials may be bounded-stale
+            # (follower fence / shard budget): publishing them under
+            # the FRESH token would hand later readers stale rows the
+            # token says are current
         if getattr(v, "_tpu_dedupe_clone", False):
             return    # a deduped window wakes N owners with one shared
             # payload: the representative's put is the only one needed
@@ -1777,6 +1803,14 @@ class TpuGraphEngine:
         # snapshots serve batched windows via mesh_exec (concurrent
         # sessions coalesce on the mesh exactly as single-chip)
         if not s.step.upto and not _uses_input_refs(exprs):
+            # cluster scatter/gather v2 (cluster.py): a remote-provider
+            # engine fans the window out to per-storaged device
+            # partials instead of building/refreshing a graphd-local
+            # snapshot from row scans (docs/manual/13-device-speed.md)
+            cr = self._cluster_go(ctx, s, starts, edge_types, alias_map,
+                                  name_by_type, ex, yield_cols)
+            if cr is not None:
+                return cr
             return self._go_via_dispatcher(ctx, s, starts, edge_types,
                                            alias_map, name_by_type, ex,
                                            yield_cols, dkey=dkey)
@@ -1785,6 +1819,33 @@ class TpuGraphEngine:
                                         alias_map, name_by_type, ex,
                                         yield_cols)
         return self._finalize_result(r)
+
+    def _cluster_go(self, ctx, s, starts, edge_types, alias_map,
+                    name_by_type, ex, yield_cols):
+        """Serve a plain-form GO via the cluster device path (per-host
+        storaged device partials; cluster.py) when the provider is
+        remote and `cluster_device_serve` is on. None -> caller rides
+        the dispatcher. Exceptions propagate to the outer breaker
+        ladder like any device failure."""
+        client = getattr(self._provider, "_client", None)
+        if client is None or not graph_flags.get_or(
+                "cluster_device_serve", True, bool):
+            return None
+        cl = self._cluster
+        if cl is None or cl.client is not client:
+            from .cluster import ClusterDeviceServe
+            cl = self._cluster = ClusterDeviceServe(self, client)
+        r = cl.serve_go(ctx, s, starts, edge_types, alias_map,
+                        name_by_type, ex, yield_cols)
+        with self._stats_lock:
+            self.stats["cluster_hops"] = cl.stats["hops"]
+            self.stats["cluster_declined"] = cl.stats["declined"]
+            self.stats["cluster_fallback_parts"] = \
+                cl.stats["fallback_parts"]
+            if r is not None:
+                self.stats["cluster_served"] += 1
+                self.stats["go_served"] += 1
+        return r
 
     MAX_ROOTS_ON_DEVICE = 64   # per-root frontier memory bound
     MAX_DEVICE_STEPS = 16      # per-step mask stacks are [N, P, cap_e]:

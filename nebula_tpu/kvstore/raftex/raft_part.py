@@ -143,6 +143,16 @@ class RaftPart:
         self._last_quorum_contact = time.monotonic()
         # replica-staleness bookkeeping throttle (_note_staleness)
         self._stale_noted_ts = 0.0
+        # bounded-staleness follower-read fence (docs/manual/
+        # 12-replication.md "Follower reads"): highest leader commit
+        # index this replica has SEEN (not necessarily applied) and
+        # the last instant it was provably caught up to it. Both
+        # advance on the append/heartbeat path under the part lock;
+        # read_fence() turns them into a grant/reject decision.
+        self._fence_leader_commit = 0
+        self._fence_caught_up_ts = 0.0
+        self.follower_read_stats = {"granted": 0, "rejected_stale": 0,
+                                    "rejected_commit": 0, "fault_lies": 0}
 
         os.makedirs(wal_dir, exist_ok=True)
         # wal_sync_every_append (REBOOT gflag, read at part bind like
@@ -570,6 +580,57 @@ class RaftPart:
                 })
             return out
 
+    def read_fence(self, max_ms: float) -> Tuple[bool, float, str]:
+        """Bounded-staleness follower-read gate (ROADMAP item 1;
+        docs/manual/12-replication.md "Follower reads").
+
+        Returns (ok, staleness_ms, reason). The leader always grants
+        at staleness 0 (linearizable by definition). A follower grants
+        only when BOTH independent checks pass:
+
+        - commit-index fence: everything the leader reported committed
+          on the last append round is applied here (`committed_id >=
+          _fence_leader_commit`) — a pure index comparison that a
+          clock lie cannot forge;
+        - time lease: the replica was provably caught up within
+          `min(max_ms, election_timeout)`. The cap means the lease can
+          NEVER outlive the window in which a new leader could have
+          been elected and committed writes this replica hasn't heard
+          about (the classic read-lease safety argument), no matter
+          how loose the operator sets `follower_read_max_ms`.
+
+        The `followerread.stale` fault point forges the time watermark
+        (staleness -> 0) to prove the commit-index fence independently
+        rejects a lying replica (docs/manual/9-robustness.md)."""
+        now = time.monotonic()
+        with self._lock:
+            if self.role is Role.LEADER:
+                return True, 0.0, "leader"
+            bound = min(float(max_ms), self._election_timeout * 1000.0)
+            ts = self._fence_caught_up_ts
+            staleness = (now - ts) * 1000.0 if ts > 0 else float("inf")
+            try:
+                faults.fire("followerread.stale")
+            except Exception:
+                # injected lie: report a perfectly fresh time
+                # watermark — only the commit-index fence stands
+                staleness = 0.0
+                self.follower_read_stats["fault_lies"] += 1
+            if self.committed_id < self._fence_leader_commit:
+                self.follower_read_stats["rejected_commit"] += 1
+                stats.add_value("raftex.follower_read.rejected_commit",
+                                kind="counter")
+                return False, staleness, "commit_fence"
+            if not (staleness <= bound):
+                self.follower_read_stats["rejected_stale"] += 1
+                stats.add_value("raftex.follower_read.rejected_stale",
+                                kind="counter")
+                return False, staleness, "stale"
+            self.follower_read_stats["granted"] += 1
+            stats.add_value("raftex.follower_read.granted",
+                            kind="counter")
+            return True, staleness, "follower"
+
     def _build_append_locked(self, host: Host,
                              committed: int) -> Optional[AppendLogRequest]:
         """Build the batch wal[host.next_id .. last], clamped to one term
@@ -865,6 +926,14 @@ class RaftPart:
             new_commit = min(req.committed_log_id, self.wal.last_log_id)
             if new_commit > self.committed_id:
                 self._commit_range_locked(self.committed_id + 1, new_commit)
+            # follower-read fence bookkeeping: remember the freshest
+            # leader commit index seen, and stamp the instant this
+            # replica was provably caught up to it — the two inputs
+            # read_fence() gates bounded-staleness reads on
+            if req.committed_log_id > self._fence_leader_commit:
+                self._fence_leader_commit = req.committed_log_id
+            if self.committed_id >= req.committed_log_id:
+                self._fence_caught_up_ts = time.monotonic()
             return self._append_resp_locked(RaftCode.SUCCEEDED)
 
     def _append_resp_locked(self, code: RaftCode) -> AppendLogResponse:

@@ -56,10 +56,17 @@ class RpcTransport(Transport):
                 # max_attempts=2: one stale-socket drain + one fresh
                 # connect — a black-holed peer costs ~1 timeout, not a
                 # whole pool drain
-                return proxy(to_addr, "raftex", timeout=self._timeout,
+                resp = proxy(to_addr, "raftex", timeout=self._timeout,
                              max_attempts=2).call(method, req)
             except Exception:
                 return _unreachable_response(method)
+            if isinstance(resp, (AskForVoteResponse, AppendLogResponse,
+                                 SendSnapshotResponse)):
+                return resp
+            # a peer mid-shutdown can answer with an rpc-layer error
+            # payload (plain string) instead of a raft response —
+            # treating it as typed crashed the caller's ticker thread
+            return _unreachable_response(method)
         return self._pool.submit(run)
 
     def shutdown(self) -> None:

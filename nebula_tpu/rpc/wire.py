@@ -60,7 +60,10 @@ def _register_defaults() -> None:
              rt.SendSnapshotRequest, rt.SendSnapshotResponse,
              # NEW types append at the END: registry ids are positional
              # and must stay stable across versions (wire compat)
-             st.ScanPartResponse)
+             st.ScanPartResponse,
+             # storaged-tier device serving (storage/device_serve.py)
+             st.DeviceWindowRequest, st.DevicePartResult,
+             st.DeviceWindowResponse)
 
 
 def _zigzag(n: int) -> int:
@@ -102,6 +105,20 @@ def encode(obj: Any) -> bytes:
     out = bytearray()
     _enc(out, obj)
     return bytes(out)
+
+
+# per-class field-name tuples: dataclasses.fields() rebuilds its
+# tuple on every call, which dominates encode/decode of bulk
+# responses (thousands of EdgeData per device_window partial)
+_fields_cache: Dict[type, Tuple[str, ...]] = {}
+
+
+def _dc_fields(cls: type) -> Tuple[str, ...]:
+    names = _fields_cache.get(cls)
+    if names is None:
+        names = tuple(f.name for f in dataclasses.fields(cls))
+        _fields_cache[cls] = names
+    return names
 
 
 def _enc(out: bytearray, o: Any) -> None:
@@ -156,8 +173,8 @@ def _enc(out: bytearray, o: Any) -> None:
             raise WireError(f"unregistered dataclass {type(o).__name__}")
         out.append(ord("c"))
         out += _U32.pack(rid)
-        for f in dataclasses.fields(o):
-            _enc(out, getattr(o, f.name))
+        for name in _dc_fields(type(o)):
+            _enc(out, getattr(o, name))
     elif type(o).__name__ in ("Status", "StatusOr"):
         # Status/StatusOr are plain classes, not dataclasses
         rid = _reg_ids.get(type(o))
@@ -242,7 +259,7 @@ def _dec(buf: bytes, off: int) -> Tuple[Any, int]:
             from ..common.status import StatusOr
             return StatusOr(status, value), off
         vals = []
-        for _ in dataclasses.fields(cls):
+        for _ in _dc_fields(cls):
             v, off = _dec(buf, off)
             vals.append(v)
         return cls(*vals), off

@@ -24,8 +24,9 @@ from ..common.stats import stats
 from ..common.status import ErrorCode, Status, StatusOr
 from ..common.tracing import tracer
 from ..meta.schema_manager import SchemaManager
-from .types import (BoundRequest, BoundResponse, EdgeData, EdgeKey,
-                    ExecResponse, NewEdge, NewVertex, PartResult,
+from .types import (BoundRequest, BoundResponse, DevicePartResult,
+                    DeviceWindowRequest, DeviceWindowResponse, EdgeData,
+                    EdgeKey, ExecResponse, NewEdge, NewVertex, PartResult,
                     PropsResponse, StatDef, StatsResponse, UpdateItemReq,
                     UpdateResponse, VertexData)
 
@@ -66,6 +67,18 @@ class StorageClient:
         # to the global stats manager as storage_client.kv_retry.<cls>
         self.retry_stats = {"leader_moved": 0, "hintless": 0,
                             "no_part": 0}
+        # sibling leader-cache invalidations: entries dropped because
+        # another part's E_LEADER_CHANGED deposed their cached host
+        # (one election moves a whole leadership signature, not one
+        # part — invalidating siblings saves a redirect round-trip per
+        # part)
+        self.sibling_invalidations = 0
+        # device_window scatter/gather counters (engine_tpu/cluster.py
+        # reads these for /tpu_stats + CLUSTER_bench)
+        self.device_stats = {"windows": 0, "parts_requested": 0,
+                             "parts_served": 0, "follower_parts": 0,
+                             "leader_retries": 0, "refused_parts": 0,
+                             "max_staleness_ms": 0.0}
 
     # ------------------------------------------------------------------
     # routing
@@ -84,9 +97,13 @@ class StorageClient:
 
     def cluster_ids_to_parts(self, space_id: int,
                              vids: List[int]) -> Dict[int, List[int]]:
+        # resolve the part count ONCE: num_parts checks the meta
+        # catalog version per access (an RPC round-trip) — per-vid
+        # resolution turns a big frontier into a meta hot loop
+        n = self.sm.num_parts(space_id)
         out: Dict[int, List[int]] = {}
         for vid in vids:
-            out.setdefault(self.part_id(space_id, vid), []).append(vid)
+            out.setdefault(ku.part_id(vid, n), []).append(vid)
         return out
 
     def _group_by_host(self, space_id: int,
@@ -156,9 +173,11 @@ class StorageClient:
                 idx = (hosts_list.index(prev) + 1) % len(hosts_list)
                 self._leader_cache[(space_id, part)] = hosts_list[idx]
                 pending[part] = parts[part]
+            deposed_hosts: set = set()
             for part, result in round_resp.results.items():
                 if result.code == ErrorCode.E_LEADER_CHANGED and part in parts:
                     redirected.append(part)
+                    deposed_hosts.add(tried.get(part))
                     if result.leader:
                         self._note_leader(space_id, part, result.leader)
                     else:
@@ -184,6 +203,22 @@ class StorageClient:
                         self._leader_cache.pop((space_id, part), None)
                         pending[part] = parts[part]
             if redirected:
+                # sibling invalidation: one election moves a whole
+                # leadership signature (every part that host led), not
+                # just the part that happened to error — drop every
+                # cached entry still pointing at a deposed host so the
+                # NEXT query re-consults routing instead of paying one
+                # redirect round-trip per sibling part
+                deposed_hosts.discard(None)
+                if deposed_hosts:
+                    for key, cached in list(self._leader_cache.items()):
+                        if key[0] == space_id and cached in deposed_hosts \
+                                and key[1] not in pending:
+                            del self._leader_cache[key]
+                            self.sibling_invalidations += 1
+                            stats.add_value(
+                                "storage_client.sibling_invalidations",
+                                kind="counter")
                 # a leader moved under this query — visible in its trace
                 # (the cluster-observability satellite: elections and
                 # rebalances tag the traces they touched)
@@ -270,6 +305,95 @@ class StorageClient:
             acc.latency_us = max(acc.latency_us, part_resp.latency_us)
 
         return self._fanout(space_id, parts, call, BoundResponse(), merge)
+
+    def device_window(self, space_id: int, vids: List[int],
+                      edge_types: List[int],
+                      edge_props: Optional[List[str]] = None,
+                      max_edges_per_vertex: Optional[int] = None,
+                      allow_follower: bool = False,
+                      follower_max_ms: int = 0) -> DeviceWindowResponse:
+        """Scatter one hop of a GO window to per-host DEVICE partials
+        (storaged-tier device shards, storage/device_serve.py) and
+        gather BoundResponse-shaped vertices + per-part serve verdicts.
+
+        Routing: with follower reads armed, parts spread
+        deterministically across every host (a follower that passes
+        the raft read fence serves its replica's shard — the capacity
+        double); otherwise parts route to their cached leader. Refused
+        parts (fence rejected, shard stale, wrong host) get ONE leader
+        retry; parts still refused come back refused — the caller
+        falls back to the row-scan path per part, never whole-window."""
+        parts = self.cluster_ids_to_parts(space_id, vids)
+        self.device_stats["windows"] += 1
+        self.device_stats["parts_requested"] += len(parts)
+        hosts_list = sorted(self._hosts)
+        resp = DeviceWindowResponse()
+
+        def call(svc, host_parts, af):
+            return svc.device_window(DeviceWindowRequest(
+                space_id=space_id, parts=host_parts,
+                edge_types=edge_types, edge_props=edge_props,
+                max_edges_per_vertex=max_edges_per_vertex,
+                allow_follower=af, follower_max_ms=follower_max_ms))
+
+        def run_round(assignment: Dict[int, str], af: bool) -> None:
+            by_host: Dict[str, Dict[int, List[int]]] = {}
+            for part, host in assignment.items():
+                by_host.setdefault(host, {})[part] = parts[part]
+            futures = []
+            for host, hp in by_host.items():
+                svc = self._hosts.get(host)
+                if svc is None:
+                    for p in hp:
+                        resp.results[p] = DevicePartResult(
+                            code=ErrorCode.E_HOST_NOT_FOUND)
+                    continue
+                futures.append((hp, self._submit(call, svc, hp, af)))
+            for hp, fut in futures:
+                try:
+                    r = fut.result()
+                except Exception:
+                    for p in hp:
+                        resp.results[p] = DevicePartResult(
+                            code=ErrorCode.E_HOST_NOT_FOUND)
+                    continue
+                resp.results.update(r.results)
+                resp.vertices.extend(r.vertices)
+                resp.latency_us = max(resp.latency_us, r.latency_us)
+
+        spread = allow_follower and follower_max_ms > 0 and hosts_list
+        assign = {}
+        for part in parts:
+            if spread:
+                # deterministic rotation over the NON-leader hosts —
+                # the point of follower reads is taking load OFF the
+                # leader; a non-replica pick refuses and rides the one
+                # leader retry below
+                ldr = self._leader(space_id, part)
+                cands = [h for h in hosts_list if h != ldr] or [ldr]
+                assign[part] = cands[part % len(cands)]
+            else:
+                assign[part] = self._leader(space_id, part)
+        run_round(assign, allow_follower)
+        retry = {}
+        for part, pr in list(resp.results.items()):
+            if pr.code == ErrorCode.E_LEADER_CHANGED:
+                if pr.leader:
+                    self._note_leader(space_id, part, pr.leader)
+                retry[part] = self._leader(space_id, part)
+        if retry:
+            self.device_stats["leader_retries"] += len(retry)
+            run_round(retry, False)
+        for part, pr in resp.results.items():
+            if pr.code == ErrorCode.SUCCEEDED:
+                self.device_stats["parts_served"] += 1
+                if pr.mode == "follower":
+                    self.device_stats["follower_parts"] += 1
+                if pr.staleness_ms > self.device_stats["max_staleness_ms"]:
+                    self.device_stats["max_staleness_ms"] = pr.staleness_ms
+            else:
+                self.device_stats["refused_parts"] += 1
+        return resp
 
     def bound_stats(self, space_id: int, vids: List[int],
                     edge_types: List[int], stat_defs: List[StatDef],

@@ -1,0 +1,477 @@
+"""Storaged-tier device serving: per-host CSR shards + window serve.
+
+PAPER.md's layer map puts storage processors next to the KVStore so
+compute lands where data lives — this module is that move for the TPU
+engine: every replicated storaged keeps a LOCAL CsrSnapshot (engine_tpu/
+csr.py narrow-width packing) built from its own KV engine, refreshed
+off the raft apply path, and serves one-hop window expansions from it
+(`device_window` RPC) so graphd's scatter/gather v2 fans a GO window
+out to per-host device partials instead of leader-routed row scans
+(docs/manual/13-device-speed.md, "Storaged-tier device shards").
+
+Vouching: a host answers for a part only when it can PROVE freshness —
+
+- leadership: the part is in `store.leader_parts` (the PR 6
+  leadership-signature token's set) -> authoritative, fence staleness 0;
+- bounded-staleness follower read: the part's raft replica passes
+  `read_fence(follower_max_ms)` (commit-index fence + time lease capped
+  at the election timeout — kvstore/raftex/raft_part.py);
+- shard freshness: the local CSR's version may trail the engine's
+  write version by at most `device_shard_max_ms` (the refresh task
+  delta-patches behind a moved version — engine_tpu/delta.py in-place
+  applies from the change ring, full rebuild only on first build /
+  ring truncation / delta fold; between move and patch the shard
+  serves within the budget, then refuses to vouch).
+
+A refused part returns E_LEADER_CHANGED (leadership/fence: the client
+re-routes to the leader) or E_PART_NOT_FOUND (no servable shard here:
+the client falls back to the row-scan path for that part). Leadership
+changes invalidate the space's shard outright (`invalidate`): the old
+shard refuses to vouch immediately and the next refresh rebuilds
+against the new led set.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ..common.faults import faults
+from ..common.flags import storage_flags
+from ..common.flight import recorder as _flight
+from ..common.stats import stats as global_stats
+from ..common.status import ErrorCode
+from .types import (DevicePartResult, DeviceWindowRequest,
+                    DeviceWindowResponse, EdgeData, VertexData)
+
+# device window programs fuse at most this many edge types (engine
+# contract — traverse.pad_edge_types); wider requests take the host path
+MAX_EDGE_TYPES_ON_DEVICE = 8
+
+
+class _SpaceShard:
+    __slots__ = ("snap", "stale_since", "mu")
+
+    def __init__(self, snap):
+        self.snap = snap
+        # monotonic instant the engine write version was first observed
+        # past the build version (None = shard is current)
+        self.stale_since: Optional[float] = None
+        # serializes in-place delta application against window serving
+        # (the same invariant graphd's engine lock provides: delta
+        # applies mutate host mirrors the emit path reads)
+        self.mu = threading.Lock()
+
+
+class DeviceShardManager:
+    """Local device-shard lifecycle + window serving for one storaged.
+
+    `raft_lookup(space, part) -> RaftPart | None` supplies the fence;
+    without it (single-node stores) every held part serves as leader.
+    """
+
+    def __init__(self, store, sm, raft_lookup=None, host: str = ""):
+        self._store = store
+        self._sm = sm
+        self._raft = raft_lookup
+        self.host = host
+        self._lock = threading.Lock()
+        self._spaces: Dict[int, _SpaceShard] = {}
+        self._building: set = set()
+        self.stats = {
+            "builds": 0, "build_failures": 0, "serves": 0,
+            "parts_served": 0, "parts_refused": 0,
+            "follower_parts_served": 0, "leader_parts_served": 0,
+            "leader_invalidations": 0, "stale_refusals": 0,
+            "fence_refusals": 0, "device_launches": 0,
+            "delta_applies": 0, "delta_declines": 0,
+            "host_expansions": 0, "edges_emitted": 0,
+            "max_staleness_ms": 0.0,
+        }
+
+    def _leader_hint(self, space: int, part: int) -> Optional[str]:
+        """Client-routable leader hint for a refused part. The store
+        Part's consensus hook maps the raft leader's RAFT address to
+        the storage RPC address — a raw RaftPart.leader() is NOT
+        dialable by the StorageClient (raft listens one port over), so
+        hinting it poisons the client's leader cache until the next
+        heartbeat repairs it (observed as E_HOST_NOT_FOUND retries
+        that dropped whole parts to the row-scan fallback)."""
+        pr = self._store.part(space, part)
+        if pr.ok():
+            return self.host or None
+        if pr.status.code == ErrorCode.E_LEADER_CHANGED:
+            return pr.status.msg or None
+        return None
+
+    # ------------------------------------------------------------------
+    # shard lifecycle
+    # ------------------------------------------------------------------
+    def refresh(self) -> int:
+        """Freshen every space whose engine write version moved past
+        its shard's version (the background task's body; also builds
+        first-time shards). Committed writes are patched in PLACE from
+        the engine's change ring (engine_tpu/delta.py — the same
+        machinery graphd's local snapshots ride); a full rebuild runs
+        only first time, on ring truncation, or when the delta buffer
+        needs folding. Returns refreshes performed. Runs OFF the raft
+        apply path — never blocks commits."""
+        n = 0
+        for space_id in list(self._store.spaces()):
+            engine = self._store.space_engine(space_id)
+            if engine is None:
+                continue
+            wv = int(engine.write_version)
+            with self._lock:
+                ent = self._spaces.get(space_id)
+                if ent is not None and ent.snap.write_version == wv:
+                    ent.stale_since = None
+                    continue
+                if ent is not None and ent.stale_since is None:
+                    ent.stale_since = time.monotonic()
+                if space_id in self._building:
+                    continue
+                self._building.add(space_id)
+            try:
+                if ent is None or \
+                        not self._apply_deltas(space_id, ent, engine):
+                    self._rebuild(space_id)
+                n += 1
+            finally:
+                with self._lock:
+                    self._building.discard(space_id)
+        return n
+
+    def _apply_deltas(self, space_id: int, ent: _SpaceShard,
+                      engine) -> bool:
+        """Patch the shard in place from the engine's committed-write
+        ring. False -> the caller full-rebuilds (first build, ring
+        truncated past the cursor, apply capacity exhausted, or the
+        delta buffer is full enough to fold into a fresh base)."""
+        snap = ent.snap
+        cursor = getattr(snap, "delta_cursor", None)
+        if cursor is None or getattr(engine, "changes", None) is None:
+            return False
+        now_v, raw = engine.changes_snapshot(cursor)
+        if raw is None:
+            self.stats["delta_declines"] += 1
+            return False
+        if raw:
+            from ..engine_tpu.delta import apply_entries
+            from ..kvstore.changelog import resolve_changes
+            try:
+                faults.fire("csr.delta_apply")
+                entries = resolve_changes(engine, raw)
+                with ent.mu:
+                    ok = apply_entries(snap, self._sm, entries,
+                                       time.time())
+            except Exception:
+                ok = False
+            if not ok:
+                # the snapshot may be partially patched — it must not
+                # serve until rebuilt (the rebuild replaces it)
+                self.stats["delta_declines"] += 1
+                return False
+            snap.invalidate_aligned()
+            self.stats["delta_applies"] += 1
+        with ent.mu:
+            snap.delta_cursor = now_v
+            snap.write_version = now_v
+        with self._lock:
+            ent.stale_since = None
+        d = snap.delta
+        if d is not None and \
+                d.edge_count + d.tomb_count > 0.75 * d.max_edges:
+            return False    # fold the delta into a fresh base now
+        return True
+
+    def _rebuild(self, space_id: int) -> None:
+        from ..engine_tpu.csr import build_snapshot
+        try:
+            num_parts = int(self._sm.num_parts(space_id))
+        except Exception:
+            held = self._store.parts(space_id)
+            num_parts = max(held) if held else 0
+        if num_parts <= 0:
+            return
+        try:
+            snap = build_snapshot(self._store, self._sm, space_id,
+                                  num_parts)
+            # arm the incremental feed: subsequent refreshes patch in
+            # place from the change ring starting at this version
+            snap.delta_cursor = snap.write_version
+        except Exception:
+            self.stats["build_failures"] += 1
+            global_stats.add_value("device_serve.build_failures",
+                                   kind="counter")
+            return
+        with self._lock:
+            self._spaces[space_id] = _SpaceShard(snap)
+        self.stats["builds"] += 1
+        global_stats.add_value("device_serve.builds", kind="counter")
+
+    def invalidate(self, space_id: int, part_id: int = 0) -> None:
+        """Leadership moved: the old shard must refuse to vouch NOW
+        (the led set it was serving under is gone) — drop it; the next
+        refresh rebuilds against the new leadership signature."""
+        with self._lock:
+            dropped = self._spaces.pop(space_id, None)
+        self.stats["leader_invalidations"] += 1
+        if dropped is not None:
+            _flight.record("device_shard_invalidated", space=space_id,
+                           part=part_id, host=self.host)
+
+    def shard_version(self, space_id: int) -> int:
+        with self._lock:
+            ent = self._spaces.get(space_id)
+            return int(ent.snap.write_version) if ent else -1
+
+    def snapshot_info(self, space_id: int) -> Dict[str, Any]:
+        """Freshness view for the web surface / bench quiesce."""
+        engine = self._store.space_engine(space_id)
+        wv = int(engine.write_version) if engine is not None else -1
+        with self._lock:
+            ent = self._spaces.get(space_id)
+            if ent is None:
+                return {"built": False, "write_version": wv}
+            d = ent.snap.delta
+            return {"built": True, "shard_version":
+                    int(ent.snap.write_version), "write_version": wv,
+                    "fresh": int(ent.snap.write_version) == wv,
+                    "total_edges": ent.snap.total_edges +
+                    (d.edge_count if d is not None else 0)}
+
+    # ------------------------------------------------------------------
+    # serving
+    # ------------------------------------------------------------------
+    def serve(self, req: DeviceWindowRequest) -> DeviceWindowResponse:
+        t0 = time.monotonic()
+        resp = DeviceWindowResponse(host=self.host)
+        self.stats["serves"] += 1
+        space = req.space_id
+        engine = self._store.space_engine(space)
+        with self._lock:
+            ent = self._spaces.get(space)
+        # shard staleness: build version vs live write version, timed
+        # from the first observation of the move
+        shard_ms = 0.0
+        servable = ent is not None and engine is not None
+        if servable and int(engine.write_version) != \
+                int(ent.snap.write_version):
+            now = time.monotonic()
+            with self._lock:
+                if ent.stale_since is None:
+                    ent.stale_since = now
+                shard_ms = (now - ent.stale_since) * 1000.0
+            budget = storage_flags.get_or("device_shard_max_ms", 250, int)
+            if shard_ms > float(budget):
+                servable = False
+                self.stats["stale_refusals"] += 1
+        led = set(self._store.leader_parts(space)) if servable else set()
+        held = set(self._store.parts(space)) if servable else set()
+        granted: Dict[int, DevicePartResult] = {}
+        for part, vids in req.parts.items():
+            raft = self._raft(space, part) if self._raft else None
+            if part in led or (raft is None and servable
+                               and part in held):
+                mode, fence_ms = "leader", 0.0
+            elif raft is not None and req.allow_follower and \
+                    req.follower_max_ms > 0 and servable:
+                ok, st, _reason = raft.read_fence(req.follower_max_ms)
+                if not ok:
+                    self.stats["fence_refusals"] += 1
+                    self.stats["parts_refused"] += 1
+                    resp.results[part] = DevicePartResult(
+                        code=ErrorCode.E_LEADER_CHANGED,
+                        leader=self._leader_hint(space, part))
+                    continue
+                mode, fence_ms = "follower", st
+            else:
+                self.stats["parts_refused"] += 1
+                if not servable:
+                    resp.results[part] = DevicePartResult(
+                        code=ErrorCode.E_PART_NOT_FOUND)
+                else:
+                    resp.results[part] = DevicePartResult(
+                        code=ErrorCode.E_LEADER_CHANGED,
+                        leader=self._leader_hint(space, part))
+                continue
+            staleness = fence_ms + shard_ms
+            granted[part] = DevicePartResult(
+                mode=mode, staleness_ms=round(staleness, 3),
+                shard_version=int(ent.snap.write_version))
+            if staleness > self.stats["max_staleness_ms"]:
+                self.stats["max_staleness_ms"] = round(staleness, 3)
+        if granted:
+            vids = [v for p in granted for v in req.parts[p]]
+            with ent.mu:   # delta applies patch the mirrors we read
+                idx_per_part = self._expand(ent.snap, vids,
+                                            req.edge_types)
+                self._emit(ent.snap, idx_per_part, set(granted), req,
+                           resp)
+        for part, pr in granted.items():
+            resp.results[part] = pr
+            self.stats["parts_served"] += 1
+            if pr.mode == "follower":
+                self.stats["follower_parts_served"] += 1
+            else:
+                self.stats["leader_parts_served"] += 1
+        resp.latency_us = int((time.monotonic() - t0) * 1e6)
+        return resp
+
+    def _expand(self, snap, vids: List[int],
+                edge_types: List[int]) -> Dict[int, np.ndarray]:
+        """One-hop active-edge expansion -> {part0: ascending edge idx}.
+        Device path: the snapshot's traversal kernel (the fused window
+        program served against the local shard); host path when the
+        request is wider than the kernel fuses or the launch fails —
+        both produce the identical edge set."""
+        if edge_types and len(edge_types) <= MAX_EDGE_TYPES_ON_DEVICE:
+            try:
+                faults.fire("kernel.launch")
+                import jax.numpy as jnp
+                from ..engine_tpu import traverse
+                f0 = jnp.asarray(snap.frontier_from_vids(vids))
+                reqt = jnp.asarray(traverse.pad_edge_types(edge_types))
+                _, act = traverse.multi_hop(f0, jnp.int32(1),
+                                            snap.kernel, reqt)
+                act = np.asarray(act)
+                self.stats["device_launches"] += 1
+                return {p: np.nonzero(act[p])[0]
+                        for p in range(snap.num_parts)}
+            except Exception:
+                pass
+        self.stats["host_expansions"] += 1
+        return self._expand_host(snap, vids, edge_types)
+
+    def _expand_host(self, snap, vids: List[int],
+                     edge_types: List[int]) -> Dict[int, np.ndarray]:
+        from ..engine_tpu.engine import _shard_indptr
+        per_part: Dict[int, List[int]] = {}
+        for v in vids:
+            loc = snap.locate(v)
+            if loc is not None and loc[1] < snap.shards[loc[0]].num_vids_base:
+                per_part.setdefault(loc[0], []).append(loc[1])
+        out: Dict[int, np.ndarray] = {}
+        for p0, locals_ in per_part.items():
+            shard = snap.shards[p0]
+            indptr = _shard_indptr(shard)
+            la = np.asarray(sorted(set(locals_)), np.int64)
+            lo, hi = indptr[la], indptr[la + 1]
+            counts = (hi - lo).astype(np.int64)
+            total = int(counts.sum())
+            if total == 0:
+                continue
+            idx = (np.repeat(lo - np.pad(np.cumsum(counts),
+                                         (1, 0))[:-1], counts)
+                   + np.arange(total))
+            ok = shard.edge_valid[idx]
+            if edge_types:
+                ok = ok & np.isin(shard.edge_etype[idx], edge_types)
+            else:
+                ok = ok & (shard.edge_etype[idx] > 0)
+            out[p0] = np.sort(idx[ok])
+        return out
+
+    def _emit(self, snap, idx_per_part: Dict[int, np.ndarray],
+              granted_parts: set, req: DeviceWindowRequest,
+              resp: DeviceWindowResponse) -> None:
+        """Materialize active edges into BoundResponse-shaped vertices,
+        mirroring the engine's `_materialize` / the CPU getBound row
+        semantics: per-(src, etype) cap, props from host mirrors with
+        version-missing keys omitted, trim to `req.edge_props` AFTER
+        materialization (None = all)."""
+        from ..engine_tpu.csr import host_gather
+        cap = req.max_edges_per_vertex or storage_flags.get_or(
+            "max_edge_returned_per_vertex", 10000, int)
+        want = None if req.edge_props is None else set(req.edge_props)
+        per_vertex: Dict[int, VertexData] = {}
+        cap_counts: Dict[tuple, int] = {}
+        n_edges = 0
+        for p0, idxs in idx_per_part.items():
+            if (p0 + 1) not in granted_parts or len(idxs) == 0:
+                continue
+            shard = snap.shards[p0]
+            idxs = np.asarray(idxs, np.int64)
+            all_ets = shard.edge_etype[idxs]
+            all_srcs = shard.vids[shard.edge_src[idxs]]
+            all_ranks = shard.edge_rank[idxs]
+            all_dsts = shard.edge_dst_vid[idxs]
+            # per-(part, etype) column gathers: one fancy index per
+            # prop column instead of a python host_item call per cell
+            # (canonical order within a (src, etype) group is
+            # preserved, so the per-(src, etype) cap selects the
+            # same edges the per-edge walk did)
+            for et in np.unique(all_ets):
+                sel = np.nonzero(all_ets == et)[0]
+                et_i = int(et)
+                grp = idxs[sel]
+                colvals = []
+                for name, col in (shard.edge_props.get(et_i)
+                                  or {}).items():
+                    if want is not None and name not in want:
+                        continue
+                    vals = host_gather(col, grp).tolist()
+                    miss = None if col.missing is None \
+                        else col.missing[grp]
+                    colvals.append((name, vals, miss))
+                for k, j in enumerate(sel):
+                    src_vid = int(all_srcs[j])
+                    ckey = (src_vid, et_i)
+                    cap_counts[ckey] = cap_counts.get(ckey, 0) + 1
+                    if cap_counts[ckey] > cap:
+                        continue
+                    vd = per_vertex.get(src_vid)
+                    if vd is None:
+                        vd = VertexData(src_vid)
+                        per_vertex[src_vid] = vd
+                    props = {}
+                    for name, vals, miss in colvals:
+                        if miss is None or not miss[k]:
+                            props[name] = vals[k]
+                    vd.edges.append(EdgeData(src_vid, et_i,
+                                             int(all_ranks[j]),
+                                             int(all_dsts[j]),
+                                             props))
+                    n_edges += 1
+        # delta-buffer ADDS (edges committed after the base build,
+        # patched in by _apply_deltas) live in the ELL side buffer the
+        # canonical arrays don't cover — walk them per frontier vid
+        # via the by-source index, same cap/type/prop semantics
+        d = snap.delta
+        if d is not None and d.edge_count:
+            et_ok = set(req.edge_types) if req.edge_types else None
+            for part in granted_parts:
+                for vid in req.parts.get(part, ()):
+                    loc = snap.locate(vid)
+                    if loc is None or loc[0] != part - 1:
+                        continue
+                    gslot = loc[0] * snap.cap_v + loc[1]
+                    for lane_key in d.by_src.get(gslot, ()):
+                        if not d.h_ok[lane_key]:
+                            continue
+                        src_vid, et, rank, dst_vid, dprops = \
+                            d.info[lane_key]
+                        if (et not in et_ok) if et_ok is not None \
+                                else et <= 0:
+                            continue
+                        ckey = (src_vid, et)
+                        cap_counts[ckey] = cap_counts.get(ckey, 0) + 1
+                        if cap_counts[ckey] > cap:
+                            continue
+                        vd = per_vertex.get(src_vid)
+                        if vd is None:
+                            vd = VertexData(src_vid)
+                            per_vertex[src_vid] = vd
+                        props = dict(dprops or {})
+                        if want is not None:
+                            props = {k: v for k, v in props.items()
+                                     if k in want}
+                        vd.edges.append(EdgeData(src_vid, int(et),
+                                                 int(rank),
+                                                 int(dst_vid), props))
+                        n_edges += 1
+        resp.vertices = list(per_vertex.values())
+        self.stats["edges_emitted"] += n_edges

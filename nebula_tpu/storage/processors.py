@@ -48,8 +48,9 @@ from ..meta.schema_manager import SchemaManager
 from ..common import heat, ledger
 from ..common.stats import stats
 from ..common.tracing import ActiveQueryRegistry, SlowQueryLog, tracer
-from .types import (BoundRequest, BoundResponse, EdgeData, EdgeKey,
-                    ExecResponse, NewEdge, NewVertex, PartResult,
+from .types import (BoundRequest, BoundResponse, DevicePartResult,
+                    DeviceWindowRequest, DeviceWindowResponse, EdgeData,
+                    EdgeKey, ExecResponse, NewEdge, NewVertex, PartResult,
                     PropsResponse, StatDef, StatsResponse, UpdateItemReq,
                     UpdateResponse, VertexData)
 
@@ -152,6 +153,11 @@ class StorageService:
         # NL003: the flag was declared but this service hardcoded the
         # default and never read it)
         self._max_edges_override = max_edges_per_vertex
+        # storaged-tier device shards (storage/device_serve.py): set by
+        # the storaged daemon wiring; None on plain single-node
+        # services (device_window then refuses every part and the
+        # client rides the row-scan path)
+        self.device_serve = None
         # in-flight read processors, served by storaged's /queries (the
         # storage-side twin of the graphd active-query registry).
         # FINISHED ops over slow_query_threshold_ms land in slow_ops
@@ -912,6 +918,33 @@ class StorageService:
     # the storage-service seam the north star designates as the engine
     # plugin boundary; ref storage/StorageServer.cpp:32-55)
     # ------------------------------------------------------------------
+    def device_window(self, req: DeviceWindowRequest) -> DeviceWindowResponse:
+        """Serve one hop of a graphd scatter/gather-v2 window from this
+        host's LOCAL device shard (storage/device_serve.py) — the
+        storaged-tier twin of the engine's fused window programs. Parts
+        this host cannot vouch for (not leader, follower fence refused,
+        shard too stale) come back refused per part; the client
+        re-routes or falls back per part, never whole-request."""
+        mgr = self.device_serve
+        if mgr is None:
+            resp = DeviceWindowResponse(host=self.host)
+            for part in req.parts:
+                resp.results[part] = DevicePartResult(
+                    code=ErrorCode.E_PART_NOT_FOUND)
+            return resp
+        n_vids = sum(len(v) for v in req.parts.values())
+        tok = self.active_ops.register(
+            f"device_window space={req.space_id} parts={len(req.parts)} "
+            f"vids={n_vids}")
+        try:
+            with tracer.span("proc.device_window", parts=len(req.parts),
+                             vids=n_vids):
+                resp = mgr.serve(req)
+                stats.add_value("storage.device_window", kind="counter")
+                return resp
+        finally:
+            self._finish_op(tok, "device_window")
+
     def space_version(self, space_id: int):
         """Freshness element for this host × space: (engine
         write-version, leadership signature) — or -1 when the space has

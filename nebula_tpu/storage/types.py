@@ -77,6 +77,46 @@ class BoundResponse:
 
 
 @dataclass
+class DeviceWindowRequest:
+    """One hop of a graphd scatter/gather-v2 window, served from the
+    receiving storaged's LOCAL device shard (storage/device_serve.py)
+    instead of a kv row scan. Shape mirrors BoundRequest so the graphd
+    row assembly (`executors._emit_go_rows`) is shared verbatim — the
+    identity anchor between the cluster device path and the CPU pipe."""
+    space_id: int
+    # part -> frontier vids owned by that part
+    parts: Dict[int, List[int]]
+    # signed edge types to expand (negative = reverse); empty = all out
+    edge_types: List[int]
+    # edge prop names to return (None = all; applies per edge schema)
+    edge_props: Optional[List[str]] = None
+    max_edges_per_vertex: Optional[int] = None
+    # bounded-staleness follower reads (raft_part.read_fence): when
+    # armed, a non-leader replica may vouch for a part it replicates
+    allow_follower: bool = False
+    follower_max_ms: int = 0
+
+
+@dataclass
+class DevicePartResult:
+    code: ErrorCode = ErrorCode.SUCCEEDED
+    leader: Optional[str] = None   # redirect hint on E_LEADER_CHANGED
+    mode: str = ""                 # "leader" | "follower" on success
+    # measured served staleness: raft fence staleness (follower) +
+    # device-shard staleness (build version behind write version)
+    staleness_ms: float = 0.0
+    shard_version: int = 0
+
+
+@dataclass
+class DeviceWindowResponse:
+    results: Dict[int, DevicePartResult] = field(default_factory=dict)
+    vertices: List[VertexData] = field(default_factory=list)
+    latency_us: int = 0
+    host: str = ""
+
+
+@dataclass
 class NewVertex:
     vid: int
     # tag_id -> encoded row (graphd encodes with RowWriter, like reference)

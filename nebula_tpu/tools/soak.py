@@ -1121,6 +1121,204 @@ def run_soak_crash(seconds: float = 45.0, seed: int = 29) -> dict:
             shutil.rmtree(run_dir, ignore_errors=True)
 
 
+def run_soak_cluster_reads(seconds: float = 20.0,
+                           seed: int = 37) -> dict:
+    """`--cluster-reads`: continuous-identity soak of the storaged-tier
+    device shards WITH bounded-staleness follower reads armed (ISSUE
+    16; docs/manual/12-replication.md "Follower reads"). An in-proc
+    replicated 3-storaged topology serves GO windows from per-host CSR
+    shards while a paced writer keeps versions moving; identity verify
+    pairs (TPU cluster path vs CPU pipe, writer quiesced per pair) run
+    for the whole soak. ok requires: identity green throughout, zero
+    client errors, follower-SERVED parts > 0, and every served
+    staleness within follower_read_max_ms + the shard-freshness slack."""
+    import shutil
+    import tempfile
+    import threading
+
+    from ..client import GraphClient
+    from ..common.flags import storage_flags
+    from ..daemons import serve_graphd, serve_metad, serve_storaged
+    from ..engine_tpu import TpuGraphEngine
+
+    v, e, parts, space, bound_ms = 240, 1500, 4, "soakreads", 150
+    run_dir = tempfile.mkdtemp(prefix="nebula_tpu_soakreads_")
+    rng = random.Random(seed)
+    saved = {f: storage_flags.get(f) for f in
+             ("heartbeat_interval_secs", "raft_heartbeat_ms",
+              "raft_election_timeout_ms", "follower_read_max_ms")}
+    storage_flags.set("heartbeat_interval_secs", 0.4)
+    storage_flags.set("raft_heartbeat_ms", 60)
+    storage_flags.set("raft_election_timeout_ms", 250)
+    metad = graphd = None
+    storers: list = []
+    verifies = 0
+    errors: list = []
+    try:
+        metad = serve_metad()
+        for i in range(3):
+            storers.append(serve_storaged(
+                metad.addr, replicated=True, engine="mem",
+                data_dir=f"{run_dir}/s{i}", load_interval=0.15))
+        tpu = TpuGraphEngine()
+        graphd = serve_graphd(metad.addr, tpu_engine=tpu)
+        gc = GraphClient(graphd.addr).connect()
+        for q in (f"CREATE SPACE {space}(partition_num={parts}, "
+                  f"replica_factor=3)", f"USE {space}",
+                  "CREATE TAG person(name string)",
+                  "CREATE EDGE knows(ts int)"):
+            r = gc.execute(q)
+            assert r.ok(), (q, r.error_msg)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            r = gc.execute('INSERT VERTEX person(name) VALUES 0:("p")')
+            if r.ok():
+                break
+            time.sleep(0.2)     # part elections still settling
+        assert r.ok(), r.error_msg
+        rows = ", ".join(f'{i}:("p{i}")' for i in range(1, v))
+        assert gc.execute(
+            f"INSERT VERTEX person(name) VALUES {rows}").ok()
+        srcs = [rng.randrange(v) for _ in range(e)]
+        dsts = [(s * 7 + k) % v for k, s in enumerate(srcs)]
+        for lo in range(0, e, 500):
+            chunk = ", ".join(
+                f"{a} -> {b}:({(a + b) % 97})"
+                for a, b in zip(srcs[lo:lo + 500], dsts[lo:lo + 500]))
+            assert gc.execute(
+                f"INSERT EDGE knows(ts) VALUES {chunk}").ok()
+        deg: dict = {}
+        for s in srcs:
+            deg[s] = deg.get(s, 0) + 1
+        hubs = [s for s, _ in sorted(deg.items(),
+                                     key=lambda kv: -kv[1])[:3]]
+        queries = [
+            f"GO 2 STEPS FROM {hubs[0]} OVER knows YIELD knows._dst",
+            f"GO FROM {hubs[1]}, {hubs[2]} OVER knows "
+            f"YIELD knows._dst, knows.ts",
+            f"GO 2 STEPS FROM {hubs[1]} OVER knows "
+            f"WHERE knows.ts > 40 YIELD knows._dst, knows.ts",
+        ]
+        for q in queries:
+            gc.must(q)
+        # arm through the cluster config registry (the production
+        # path) — a bare local flag set would be overwritten by the
+        # next meta heartbeat pull
+        gc.must(f"UPDATE CONFIGS STORAGE:follower_read_max_ms = "
+                f"{bound_ms}")
+        deadline = time.monotonic() + 15
+        while storage_flags.get("follower_read_max_ms") != bound_ms \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert storage_flags.get("follower_read_max_ms") == bound_ms
+
+        stop = threading.Event()
+        pause = threading.Event()
+        paused = threading.Event()
+
+        def writer():
+            wc = GraphClient(graphd.addr).connect()
+            wc.must(f"USE {space}")
+            rank = e + 1
+            while not stop.is_set():
+                if pause.is_set():
+                    paused.set()
+                    time.sleep(0.02)
+                    continue
+                paused.clear()
+                a, b = rng.randrange(v), rng.randrange(v)
+                r = wc.execute(f"INSERT EDGE knows(ts) VALUES "
+                               f"{a} -> {b}@{rank}:({(a + b) % 97})")
+                rank += 1
+                if not r.ok():
+                    errors.append(f"write: {r.error_msg}")
+                time.sleep(0.02)
+
+        # nlint: disable=NL002 -- soak-lifetime writer; no inbound trace
+        wt = threading.Thread(target=writer, daemon=True,
+                              name="soak-reads-writer")
+        wt.start()
+        deadline = time.monotonic() + seconds
+        while time.monotonic() < deadline and not errors:
+            q = queries[rng.randrange(len(queries))]
+            pause.set()
+            if not paused.wait(timeout=10.0):
+                pause.clear()
+                continue
+            # writer quiesced + staleness drained: a follower partial
+            # may trail by the bound; let it catch up so the TPU/CPU
+            # pair compares one version (the identity contract is
+            # bounded-stale, not time-travel)
+            time.sleep((bound_ms + 100) / 1000.0)
+            try:
+                rt = gc.execute(q)
+                if not rt.ok():
+                    errors.append(f"verify: {rt.error_msg}")
+                    break
+                tpu.enabled = False
+                try:
+                    rc = gc.execute(q)
+                finally:
+                    tpu.enabled = True
+                if not rc.ok():
+                    errors.append(f"verify-cpu: {rc.error_msg}")
+                    break
+                if sorted(map(repr, rt.rows)) != \
+                        sorted(map(repr, rc.rows)):
+                    errors.append(f"IDENTITY DIVERGENCE: {q}")
+                    break
+                verifies += 1
+            finally:
+                pause.clear()
+            time.sleep(0.05)
+        stop.set()
+        pause.clear()
+        wt.join(timeout=20)
+        cdev = dict(graphd.engine.client.device_stats)
+        per_host = {}
+        stal = [float(cdev.get("max_staleness_ms", 0.0))]
+        for h in storers:
+            mgr = getattr(h, "device_shards", None)
+            if mgr is not None:
+                per_host[h.addr] = dict(mgr.stats)
+                stal.append(float(mgr.stats.get("max_staleness_ms", 0)))
+        slack = int(storage_flags.get_or("device_shard_max_ms", 250,
+                                         int))
+        max_stal = round(max(stal), 2)
+        follower_served = sum(s.get("follower_parts_served", 0)
+                              for s in per_host.values())
+        out = {
+            "seconds": seconds, "identity_verifies": verifies,
+            "bound_ms": bound_ms, "shard_slack_ms": slack,
+            "max_served_staleness_ms": max_stal,
+            "staleness_bounded": max_stal <= bound_ms + slack,
+            "follower_parts_served": follower_served,
+            "client_device": cdev, "per_host": per_host,
+            "cluster_served": tpu.stats.get("cluster_served", 0),
+            "errors": errors[:5],
+        }
+        out["ok"] = (not errors and verifies >= 5
+                     and out["staleness_bounded"]
+                     and follower_served > 0
+                     and out["cluster_served"] > 0)
+        return out
+    finally:
+        try:
+            if graphd is not None:
+                graphd.stop()
+            for h in storers:
+                try:
+                    h.stop()
+                except Exception:
+                    pass
+            if metad is not None:
+                metad.stop()
+        finally:
+            for f, val in saved.items():
+                storage_flags.set(f, val)
+            shutil.rmtree(run_dir, ignore_errors=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="mixed INSERT+GO soak with continuous CPU/TPU "
@@ -1166,6 +1364,14 @@ def main(argv=None) -> int:
                          "docs/manual/14-qos.md): the abuser must be "
                          "throttled with typed E_OVERLOAD only, small "
                          "tenants unaffected, identity checks green")
+    ap.add_argument("--cluster-reads", action="store_true",
+                    help="replicated 3-storaged topology with bounded-"
+                         "staleness follower reads ARMED under a paced "
+                         "writer + continuous TPU-vs-CPU identity "
+                         "verifies: follower-served parts must be > 0, "
+                         "every served staleness within the bound, "
+                         "identity green, zero errors (docs/manual/"
+                         "12-replication.md)")
     ap.add_argument("--skew", action="store_true",
                     help="Zipf-distributed start vids with the "
                          "workload observatory armed (common/heat.py) "
@@ -1188,6 +1394,8 @@ def main(argv=None) -> int:
         witness.install()
     if args.crash:
         out = run_soak_crash(args.seconds)
+    elif args.cluster_reads:
+        out = run_soak_cluster_reads(args.seconds)
     elif args.skew:
         out = run_soak_skew(args.seconds)
     elif args.tenants:

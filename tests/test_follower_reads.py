@@ -1,0 +1,122 @@
+"""Bounded-staleness follower reads — the raft read fence
+(kvstore/raftex/raft_part.py `read_fence`; docs/manual/12-replication.md
+"Follower reads").
+
+The fence is two INDEPENDENT checks: a commit-index fence (everything
+the leader last reported committed is applied here — a pure index
+comparison no clock lie can forge) and a time lease capped at the
+election timeout (the window in which a new leader could have committed
+writes this replica hasn't heard about). These tests pin the safety
+arguments: the lease can never outlive the election timeout no matter
+how loose the operator flag is, a lagging replica is rejected on the
+index alone, and the `followerread.stale` fault — a replica LYING about
+its time watermark — still bounces off the commit fence
+(docs/manual/9-robustness.md)."""
+import time
+
+import pytest
+
+from nebula_tpu.common.faults import faults
+from nebula_tpu.kvstore.raftex import RaftCode
+from raft_fixture import RaftCluster
+
+
+@pytest.fixture
+def cluster3(tmp_path):
+    c = RaftCluster(3, tmp_path)
+    yield c
+    c.stop()
+
+
+def _follower(c, leader):
+    return next(c.parts[a] for a in c.voting if a != leader.addr)
+
+
+def _wait_granted(part, max_ms, timeout=4.0):
+    """Poll until the fence grants (a heartbeat round must carry the
+    leader's commit index first)."""
+    deadline = time.monotonic() + timeout
+    res = part.read_fence(max_ms)
+    while not res[0] and time.monotonic() < deadline:
+        time.sleep(0.02)
+        res = part.read_fence(max_ms)
+    return res
+
+
+def test_leader_always_grants_at_staleness_zero(cluster3):
+    leader = cluster3.wait_leader()
+    ok, staleness, reason = leader.read_fence(0.001)
+    assert ok and staleness == 0.0 and reason == "leader"
+
+
+def test_caught_up_follower_granted_within_bound(cluster3):
+    leader = cluster3.wait_leader()
+    assert leader.append_async(b"x").result(timeout=3) is \
+        RaftCode.SUCCEEDED
+    cluster3.wait_commit(1)
+    f = _follower(cluster3, leader)
+    ok, staleness, reason = _wait_granted(f, 1000.0)
+    assert ok and reason == "follower", (ok, staleness, reason)
+    # granted staleness is a real measurement within the bound
+    bound = min(1000.0, f._election_timeout * 1000.0)
+    assert 0.0 <= staleness <= bound
+    assert f.follower_read_stats["granted"] >= 1
+
+
+def test_lease_never_outlives_election_timeout(cluster3):
+    """The safety cap: even with follower_read_max_ms set absurdly
+    high, an isolated follower stops granting within the election
+    timeout — the window in which a new leader could exist."""
+    leader = cluster3.wait_leader()
+    f = _follower(cluster3, leader)
+    assert _wait_granted(f, 1e9)[0]
+    cluster3.isolate(f.addr)
+    time.sleep(f._election_timeout + 0.4)
+    ok, staleness, reason = f.read_fence(1e9)
+    assert not ok and reason == "stale", (ok, staleness, reason)
+    assert staleness > f._election_timeout * 1000.0
+    assert f.follower_read_stats["rejected_stale"] >= 1
+
+
+def test_commit_index_fence_rejects_lagging_follower(cluster3):
+    leader = cluster3.wait_leader()
+    assert leader.append_async(b"y").result(timeout=3) is \
+        RaftCode.SUCCEEDED
+    cluster3.wait_commit(1)
+    f = _follower(cluster3, leader)
+    assert _wait_granted(f, 1e9)[0]
+    # forge a leader commit index ahead of what this replica applied,
+    # with a perfectly FRESH time watermark: the index comparison must
+    # reject on its own
+    with f._lock:
+        f._fence_leader_commit = f.committed_id + 5
+        f._fence_caught_up_ts = time.monotonic()
+    ok, _staleness, reason = f.read_fence(1e9)
+    assert not ok and reason == "commit_fence"
+    assert f.follower_read_stats["rejected_commit"] >= 1
+
+
+def test_stale_fault_lie_bounces_off_commit_fence(cluster3):
+    """`followerread.stale` forges the time watermark (staleness -> 0).
+    A lagging replica armed with the lie must STILL be rejected — by
+    the commit-index fence alone — proving the two checks are
+    independent (the fault-catalog contract)."""
+    assert "followerread.stale" in faults.describe()["points"]
+    leader = cluster3.wait_leader()
+    assert leader.append_async(b"z").result(timeout=3) is \
+        RaftCode.SUCCEEDED
+    cluster3.wait_commit(1)
+    f = _follower(cluster3, leader)
+    assert _wait_granted(f, 1e9)[0]
+    with f._lock:
+        f._fence_leader_commit = f.committed_id + 5
+        f._fence_caught_up_ts = time.monotonic() - 999.0  # truly stale
+    faults.set_plan("followerread.stale:n=1")
+    try:
+        ok, staleness, reason = f.read_fence(1e9)
+    finally:
+        faults.reset()
+    assert not ok and reason == "commit_fence", (ok, staleness, reason)
+    assert staleness == 0.0            # the lie was told...
+    assert f.follower_read_stats["fault_lies"] >= 1
+    assert f.follower_read_stats["rejected_commit"] >= 1  # ...and caught
