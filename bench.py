@@ -1853,6 +1853,10 @@ def bench_chaos(out_path: str, trim: bool = False):
     rng = np.random.default_rng(seed)
     srcs, dsts, ts = zipf_edges(rng, v, e, clip=120)
     insert_person_knows(conn, "chaos", 4, v, srcs, dsts, ts)
+    # the index verbs ride the same chaos mix (ISSUE 17): LOOKUP needs
+    # a catalog index, and index.search faults join the plan below so
+    # the device index path degrades to the storaged scan under fire
+    conn.must("CREATE TAG INDEX chaos_person_age ON person(age)")
     sid = cluster.meta.get_space("chaos").value().space_id
     tpu.prewarm(sid, block=True)
     tpu.sparse_edge_budget = 0   # pin dense: faults land on the
@@ -1867,12 +1871,16 @@ def bench_chaos(out_path: str, trim: bool = False):
         f" | YIELD COUNT(*) AS n, SUM($-.t) AS s, AVG($-.t) AS a",
         f"GO FROM {hubs[0]}, {hubs[1]} OVER knows "
         f"YIELD knows._dst, knows.ts",
+        # PR 17 verbs under the same identity + zero-client-error bar
+        "LOOKUP ON person WHERE person.age > 70 YIELD person.age",
+        f"GET SUBGRAPH 2 STEPS FROM {hubs[2]} OVER knows",
+        "MATCH (a:person {age: 42})-[e:knows]->(b) RETURN a, b",
     ]
     conn.must(queries[0])   # compile + snapshot warm, OFF the chaos
 
     # ---- phase 1: the 8-session workload under an armed fault plan
     plan = (f"seed={seed};kernel.launch:p=0.3;mesh.collective:p=0.3;"
-            f"encode.rows:p=0.2")
+            f"encode.rows:p=0.2;index.search:p=0.2")
     faults.set_plan(plan)
     observed: dict = {}
     errs: list = []
@@ -1953,11 +1961,15 @@ def bench_chaos(out_path: str, trim: bool = False):
     while time.time() < deadline:
         tpu.result_cache.clear()
         g0 = tpu.stats["go_served"] + tpu.stats["agg_served"]
+        l0 = tpu.stats["lookup_served"]
         for q in queries:
             conn.must(q)
         states = tpu.breaker_states()
-        served_again = (tpu.stats["go_served"]
-                        + tpu.stats["agg_served"]) > g0
+        # the device must serve GO *and* the index path again (the
+        # armed index.search faults trip the "index" breaker too)
+        served_again = ((tpu.stats["go_served"]
+                         + tpu.stats["agg_served"]) > g0
+                        and tpu.stats["lookup_served"] > l0)
         if served_again and all(s == "closed" for s in states.values()):
             recovered = True
             break
@@ -2078,6 +2090,9 @@ def bench_chaos(out_path: str, trim: bool = False):
                 "overload_retries": qos_overload_retries[0],
                 "dispatcher": qos_disp},
         "cache": tpu.cache_stats(),
+        # device secondary-index lifecycle under fire (ISSUE 17):
+        # nonzero lookup/subgraph serves prove the verbs rode the mix
+        "index": tpu.index_stats(),
         "seed": seed,
         "sessions": sessions,
         "graph": {"V": v, "E": e},
@@ -2568,6 +2583,176 @@ def bench_cache_smoke(out_path: str):
     print(json.dumps({"metric": "cache_smoke", "ok": ok, **checks}))
     if not ok:
         raise SystemExit(f"cache smoke FAILED: {rec}")
+    return rec
+
+
+def bench_lookup_smoke(out_path: str):
+    """Index-verb smoke tier (`bench.py --lookup-smoke`): tier-1-safe
+    on XLA:CPU, no accelerator / native engine. Proves the device
+    secondary-index subsystem (docs/manual/16-indexes.md) end to end
+    on one small in-proc cluster:
+
+      (a) SERVES: a LOOKUP / GET SUBGRAPH / MATCH mix runs with the
+          device index armed and the artifact records NONZERO
+          lookup_served / subgraph_served / index-hit counters,
+      (b) IS BIT-IDENTICAL: every device-served result equals the
+          storaged CPU-scan twin (`tpu.enabled = False`), exactly,
+      (c) INVALIDATES: an INSERT between two identical LOOKUPs drops
+          the sorted arrays — the second result includes the new
+          vertex and matches the CPU pipe,
+      (d) DEGRADES: with index.search faults armed every LOOKUP still
+          succeeds via the storaged scan — zero client errors — and
+          the "index" breaker recovers once the faults stop.
+
+    Records per-verb QPS/p50/p99 plus the engine's index counters in
+    the JSON artifact and exits nonzero on any failure."""
+    from nebula_tpu.cluster import InProcCluster
+    from nebula_tpu.common.faults import faults
+    from nebula_tpu.engine_tpu import TpuGraphEngine
+
+    tpu = TpuGraphEngine()
+    cluster = InProcCluster(tpu_engine=tpu)
+    conn = cluster.connect()
+    rng = np.random.default_rng(23)
+    v, e = 400, 3000
+    srcs, dsts, ts = zipf_edges(rng, v, e, clip=80)
+    insert_person_knows(conn, "lookupsmoke", 4, v, srcs, dsts, ts)
+    conn.must("CREATE TAG INDEX smoke_age ON person(age)")
+    sid = cluster.meta.get_space("lookupsmoke").value().space_id
+    tpu.prewarm(sid, block=True)
+    hubs = [int(x) for x in np.argsort(np.bincount(srcs,
+                                                   minlength=v))[-3:]]
+    # MATCH seeds pin to the hubs' ages so the 1-hop expansions are
+    # guaranteed nonempty on the zipf graph (ages are 20 + vid % 60)
+    mix = {
+        "lookup": [
+            "LOOKUP ON person WHERE person.age > 70 YIELD person.age",
+            "LOOKUP ON person WHERE person.age == 42 "
+            "YIELD person.age AS age",
+            "LOOKUP ON person WHERE person.age <= 21",
+        ],
+        "subgraph": [
+            f"GET SUBGRAPH FROM {hubs[0]}",
+            f"GET SUBGRAPH 2 STEPS FROM {hubs[1]}, {hubs[2]} "
+            f"OVER knows",
+        ],
+        "match": [
+            f"MATCH (a:person {{age: {20 + hubs[0] % 60}}})"
+            f"-[e:knows]->(b) RETURN a, b",
+            f"MATCH (a:person {{age: {20 + hubs[1] % 60}}})"
+            f"-[e*1..2]->(b) RETURN a.age, b",
+        ],
+    }
+    checks: dict = {}
+
+    # ---- (b) identity: device rows vs the storaged CPU-scan twin
+    dev_rows = {q: conn.must(q).rows
+                for qs in mix.values() for q in qs}
+    tpu.enabled = False
+    try:
+        cpu_rows = {q: conn.must(q).rows
+                    for qs in mix.values() for q in qs}
+    finally:
+        tpu.enabled = True
+    mismatches = [q for q in dev_rows
+                  if sorted(map(repr, dev_rows[q]))
+                  != sorted(map(repr, cpu_rows[q]))]
+    checks["identity"] = not mismatches
+    checks["nonempty_mix"] = all(len(dev_rows[q]) > 0
+                                 for qs in mix.values() for q in qs)
+
+    # ---- (a) per-verb QPS/p99, every iteration a genuine device
+    # serve (the result cache would absorb the fixed pool otherwise)
+    iters = 30
+    perf = {}
+    for verb, qs in mix.items():
+        lat = []
+        for i in range(iters):
+            q = qs[i % len(qs)]
+            tpu.result_cache.clear()
+            t0 = time.perf_counter()
+            conn.must(q)
+            lat.append(time.perf_counter() - t0)
+        lat_ms = np.asarray(lat) * 1e3
+        perf[verb] = {
+            "iters": iters,
+            "qps": round(iters / float(np.sum(lat)), 1),
+            "p50_ms": round(float(np.percentile(lat_ms, 50)), 3),
+            "p99_ms": round(float(np.percentile(lat_ms, 99)), 3),
+        }
+    idx = tpu.index_stats()
+    checks["lookup_served"] = idx["lookup_served"]
+    checks["subgraph_served"] = idx["subgraph_served"]
+    checks["index_hits"] = idx["hits"]
+    checks["device_served"] = (idx["lookup_served"] > 0
+                               and idx["subgraph_served"] > 0
+                               and idx["builds"] > 0
+                               and idx["hits"] > 0)
+
+    # ---- (c) a write between identical LOOKUPs invalidates: ages
+    # land in 20..79, so 97 can only match the inserted vertex
+    qw = "LOOKUP ON person WHERE person.age == 97 YIELD person.age"
+    before = conn.must(qw).rows
+    inv0 = tpu.index_stats()["invalidations"]
+    conn.must("INSERT VERTEX person(age) VALUES 999888:(97)")
+    after = conn.must(qw).rows
+    tpu.enabled = False
+    try:
+        cpu_after = conn.must(qw).rows
+    finally:
+        tpu.enabled = True
+    checks["write_invalidates"] = (
+        before == [] and [999888, 97] in after
+        and sorted(map(repr, after)) == sorted(map(repr, cpu_after))
+        and tpu.index_stats()["invalidations"] > inv0)
+
+    # ---- (d) degradation ladder: index.search faults at p=1 must
+    # feed the "index" breaker and degrade every LOOKUP to the
+    # storaged scan — identical successes only, never a client error
+    tpu.breaker_threshold = 2
+    tpu.breaker_base_s = 0.1
+    tpu.breaker_max_s = 0.5
+    faults.set_plan("seed=23;index.search:p=1")
+    degraded_ok = True
+    ref = sorted(map(repr, conn.must(mix["lookup"][0]).rows))
+    try:
+        for _ in range(6):
+            tpu.result_cache.clear()
+            r = conn.execute(mix["lookup"][0])
+            if not r.ok() or sorted(map(repr, r.rows)) != ref:
+                degraded_ok = False
+    finally:
+        faults.clear()
+    checks["degrades_to_scan"] = (degraded_ok
+                                  and tpu.stats["breaker_trips"] > 0)
+    recovered = False
+    deadline = time.time() + 30
+    l0 = tpu.stats["lookup_served"]
+    while time.time() < deadline:
+        tpu.result_cache.clear()
+        conn.must(mix["lookup"][0])
+        if tpu.stats["lookup_served"] > l0 and all(
+                s == "closed"
+                for s in tpu.breaker_states().values()):
+            recovered = True
+            break
+        time.sleep(0.05)
+    checks["breaker_recovered"] = recovered
+
+    rec = {"graph": {"V": v, "E": e}, "perf": perf, "checks": checks,
+           "mismatches": mismatches, "index": tpu.index_stats(),
+           "robustness": tpu.robustness_stats()}
+    ok = all(checks[k] for k in
+             ("identity", "nonempty_mix", "device_served",
+              "write_invalidates", "degrades_to_scan",
+              "breaker_recovered"))
+    rec["ok"] = ok
+    with open(out_path, "w") as f:
+        json.dump(rec, f, indent=1)
+    log(f"lookup smoke: checks={checks} -> {out_path}")
+    print(json.dumps({"metric": "lookup_smoke", "ok": ok, **checks}))
+    if not ok:
+        raise SystemExit(f"lookup smoke FAILED: {rec}")
     return rec
 
 
@@ -3474,6 +3659,13 @@ def main():
             if a.startswith("--out="):
                 out = a.split("=", 1)[1]
         bench_cache_smoke(out)
+        return
+    if "--lookup-smoke" in sys.argv:
+        out = os.environ.get("BENCH_LOOKUP_OUT", "LOOKUP_smoke.json")
+        for a in sys.argv:
+            if a.startswith("--out="):
+                out = a.split("=", 1)[1]
+        bench_lookup_smoke(out)
         return
     if "--chaos" in sys.argv:
         out = os.environ.get("BENCH_CHAOS_OUT", "CHAOS_bench.json")

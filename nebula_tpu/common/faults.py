@@ -231,6 +231,14 @@ faults.register("kernel.launch",
                     "dispatcher windows)")
 faults.register("mesh.collective",
                 doc="sharded collective entry points in mesh_exec")
+faults.register("index.build",
+                doc="secondary-index sorted-array build on a fresh "
+                    "snapshot (engine_tpu/index.py); a fired build "
+                    "degrades that (tag, prop) to the CPU scan")
+faults.register("index.search",
+                doc="device LOOKUP index search; a fired search feeds "
+                    "the 'index' breaker and the storaged CPU scan "
+                    "serves the query")
 faults.register("encode.rows", doc="native nbc_encode_rows batch row "
                                    "encode (falls back to pure python)")
 faults.register("rpc.send", exc=InjectedConnectionFault,

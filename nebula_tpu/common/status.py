@@ -46,6 +46,7 @@ class ErrorCode(enum.IntEnum):
     E_SCHEMA_NOT_FOUND = -23
     E_INVALID_SCHEMA_VER = -24
     E_CONFLICT = -25
+    E_INDEX_NOT_FOUND = -26
     # storage
     E_KEY_NOT_FOUND = -31
     E_CONSENSUS_ERROR = -32

@@ -375,6 +375,11 @@ def serve_graphd(meta_addr: str, host: str = "127.0.0.1", port: int = 0,
                         dict(tpu_engine.agg_decline_reasons),
                     "path_decline_reasons":
                         dict(tpu_engine.path_decline_reasons),
+                    # device secondary indexes (docs/manual/16-indexes
+                    # .md): build/serve lifecycle — builds, resident
+                    # bytes, searches, hits, declines by reason,
+                    # invalidations, per-verb served counts
+                    "index": tpu_engine.index_stats(),
                     # mesh execution service (docs/manual/8-mesh.md):
                     # device-served queries on SHARDED snapshots per
                     # feature, and the decline matrix {feature:
@@ -471,6 +476,15 @@ def serve_graphd(meta_addr: str, host: str = "127.0.0.1", port: int = 0,
                     out[f"tpu_engine.agg_declined.{k}"] = v
                 for k, v in path_decl.items():
                     out[f"tpu_engine.path_declined.{k}"] = v
+                # secondary-index lifecycle as tpu_engine.index.*
+                # (docs/manual/16-indexes.md) — the scrape-flat twin
+                # of the /tpu_stats "index" block
+                for k, v in tpu_engine.index_stats().items():
+                    if k == "decline_reasons":
+                        for reason, n in v.items():
+                            out[f"tpu_engine.index.declined.{reason}"] = n
+                    else:
+                        out[f"tpu_engine.index.{k}"] = v
                 # cache rungs as flat gauges (the per-event counters
                 # additionally stream through the StatsManager with
                 # kind="counter" — see common/cache.py stats_prefix)

@@ -289,7 +289,15 @@ class TpuGraphEngine:
                       # docs/manual/13-device-speed.md): GO windows
                       # served from per-storaged device partials
                       "cluster_served": 0, "cluster_declined": 0,
-                      "cluster_hops": 0, "cluster_fallback_parts": 0}
+                      "cluster_hops": 0, "cluster_fallback_parts": 0,
+                      # device-resident secondary indexes (index.py;
+                      # docs/manual/16-indexes.md): per-snapshot sorted
+                      # property arrays serving LOOKUP, plus the
+                      # GET SUBGRAPH frontier-expansion verb
+                      "index_builds": 0, "index_bytes": 0,
+                      "index_searches": 0, "index_hits": 0,
+                      "index_declined": 0, "index_invalidations": 0,
+                      "lookup_served": 0, "subgraph_served": 0}
         # mesh execution service (mesh_exec.py): device-served queries
         # on SHARDED snapshots, per feature — the decline matrix the
         # round-5 verdict flagged (batched windows / aggregation / ALL
@@ -303,6 +311,10 @@ class TpuGraphEngine:
         # reason (mirrors agg_decline_reasons; /tpu_stats + /get_stats
         # tpu_engine.path_declined.<reason>)
         self.path_decline_reasons: Dict[str, int] = {}
+        # why a device index serve (LOOKUP / GET SUBGRAPH) declined,
+        # by reason (/tpu_stats "index" block + /get_stats
+        # tpu_engine.index.declined.<reason>)
+        self.index_decline_reasons: Dict[str, int] = {}
         # why aggregate pushdown declined, by reason (round-4 verdict:
         # the decline path was invisible — 0/3 bench queries served
         # with no stat saying why); mirrored into the global stats
@@ -1005,6 +1017,10 @@ class TpuGraphEngine:
         # build scanned, so the auditor can later prove the snapshot's
         # lineage still matches the engine at the same version
         self._record_store_digest(snap)
+        # secondary indexes ride the same off-lock build: every
+        # cataloged (tag, leading field) gets its sorted device array
+        # now, so the first LOOKUP never pays the sort under the lock
+        self._prebuild_indexes(space_id, snap)
         if (self.mesh is not None and self.mesh.devices.size > 1
                 and snap.num_parts % self.mesh.devices.size == 0
                 and space_id not in self._mesh_demoted):
@@ -1304,7 +1320,10 @@ class TpuGraphEngine:
             _flight.record("snapshot_poisoned", space=space_id)
             # poison hygiene: drop the space's cached results/declines
             # alongside the snapshot (entries are already version-
-            # orphaned; this frees them and counts the purge)
+            # orphaned; this frees them and counts the purge) — and the
+            # poisoned snapshot's secondary indexes, exactly like the
+            # CSR caches (the repack's fresh build re-creates them)
+            self._invalidate_prop_indexes(snap)
             self._purge_space_cache(space_id)
             self._kick_repack(space_id)
             return None
@@ -1434,6 +1453,10 @@ class TpuGraphEngine:
             # tombstones/patches mutate the canonical arrays the
             # batched aligned layout was built from
             snap.invalidate_aligned()
+            # ... and the host prop columns the secondary indexes were
+            # sorted from: drop them now (the write-version key already
+            # orphans them structurally; the next LOOKUP rebuilds lazily)
+            self._invalidate_prop_indexes(snap)
             self.stats["delta_applies"] += 1
             self._space_churn[snap.space_id] = \
                 self._space_churn.get(snap.space_id, 0) + 1
@@ -1579,6 +1602,356 @@ class TpuGraphEngine:
         global_stats.add_value("tpu_engine.path_declined." + reason,
                                kind="counter")
         return False
+
+    # ------------------------------------------------------------------
+    # secondary indexes: LOOKUP / GET SUBGRAPH on device (index.py;
+    # docs/manual/16-indexes.md)
+    # ------------------------------------------------------------------
+    def _index_decline(self, reason: str):
+        """Count one index/subgraph device decline by reason and return
+        None so the storaged CPU scan serves — a failed or refused
+        device index search is never a client error."""
+        with self._stats_lock:
+            self.stats["index_declined"] += 1
+            self.index_decline_reasons[reason] = \
+                self.index_decline_reasons.get(reason, 0) + 1
+        global_stats.add_value("tpu_engine.index.declined." + reason,
+                               kind="counter")
+        return None
+
+    def _index_specs(self, space_id: int) -> List[dict]:
+        """Cataloged tag-index descriptors (metad DDL; edge indexes are
+        catalog-only for now — LOOKUP ON edge serves via the CPU scan)."""
+        if self._sm is None:
+            return []
+        try:
+            return [d for d in self._sm.list_indexes(space_id)
+                    if not d.get("is_edge")]
+        except Exception:
+            return []
+
+    def _prebuild_indexes(self, space_id: int, snap) -> None:
+        """Eagerly build every cataloged tag index on a fresh snapshot —
+        the same off-lock build path the CSR arrays ride; a failed
+        build degrades that (tag, prop) to the CPU scan, it never
+        fails the snapshot build."""
+        cache = getattr(snap, "prop_indexes", None)
+        if cache is None:
+            cache = snap.prop_indexes = {}
+        for spec in self._index_specs(space_id):
+            fields = spec.get("fields") or []
+            if not fields:
+                continue
+            # device search covers the index's LEADING field (the
+            # composite tail is catalog metadata only)
+            key = (spec["schema_id"], fields[0])
+            if key not in cache:
+                cache[key] = self._build_one_index(snap, key[0], key[1])
+
+    def _build_one_index(self, snap, tag_id: int, prop: str):
+        from . import index as secindex
+        try:
+            faults.fire("index.build")
+            idx = secindex.build_tag_index(snap, tag_id, prop)
+        except Exception:
+            _LOG.exception(
+                "device index build for (tag %d, %r) on space %d "
+                "failed; LOOKUP serves via the storaged CPU scan",
+                tag_id, prop, snap.space_id)
+            return None
+        if idx is not None:
+            with self._stats_lock:
+                self.stats["index_builds"] += 1
+                self.stats["index_bytes"] += idx.nbytes
+            global_stats.add_value("tpu_engine.index.builds",
+                                   kind="counter")
+        return idx
+
+    def _get_index_locked(self, snap, tag_id: int, prop: str):
+        """Per-snapshot index, building lazily when the eager pass
+        missed it (index created after the snapshot, or a delta apply
+        dropped it). Caller holds the engine lock — the build reads
+        the delta-mutable host columns. A None entry is sticky for the
+        snapshot's current write_version (the decline is deterministic
+        for these mirrors); a version-orphaned survivor rebuilds."""
+        cache = getattr(snap, "prop_indexes", None)
+        if cache is None:
+            cache = snap.prop_indexes = {}
+        key = (tag_id, prop)
+        if key in cache:
+            idx = cache[key]
+            if idx is None or idx.matches_snapshot(snap):
+                return idx
+        idx = cache[key] = self._build_one_index(snap, tag_id, prop)
+        return idx
+
+    def _invalidate_prop_indexes(self, snap) -> None:
+        """Delta applies / poison: drop the snapshot's secondary
+        indexes (prop patches mutate the host columns they were sorted
+        from). The write-version key already makes stale ones
+        structurally unreachable; this frees the device arrays now and
+        counts the purge."""
+        cache = getattr(snap, "prop_indexes", None)
+        if not cache:
+            return
+        n = len(cache)
+        cache.clear()
+        with self._stats_lock:
+            self.stats["index_invalidations"] += n
+        global_stats.add_value("tpu_engine.index.invalidations", n,
+                               kind="counter")
+
+    def index_stats(self) -> Dict[str, Any]:
+        """The /tpu_stats "index" block (flattened to Prometheus as
+        tpu_engine.index.*): build/serve lifecycle of the device
+        secondary indexes."""
+        with self._stats_lock:
+            out = {"builds": self.stats["index_builds"],
+                   "bytes": self.stats["index_bytes"],
+                   "searches": self.stats["index_searches"],
+                   "hits": self.stats["index_hits"],
+                   "declines": self.stats["index_declined"],
+                   "invalidations": self.stats["index_invalidations"],
+                   "lookup_served": self.stats["lookup_served"],
+                   "subgraph_served": self.stats["subgraph_served"],
+                   "decline_reasons": dict(self.index_decline_reasons)}
+        return out
+
+    def can_serve_lookup(self, space_id: int) -> bool:
+        """Structural pre-check for LOOKUP device serving (the executor
+        already verified a catalog index exists — E_INDEX_NOT_FOUND
+        is a client error, not a routing decision)."""
+        if not (self.enabled and self._provider is not None):
+            return False
+        if _consistency.is_shadow():
+            return False    # shadow runs take the CPU pipe by design
+        return True
+
+    def execute_lookup(self, ctx, tag_id: int, prop: str,
+                       op: Optional[str], value,
+                       yield_props: List[Tuple[str, str]]):
+        """Serve LOOKUP ON tag WHERE prop OP value via the device
+        sorted-array index. `yield_props` are (column name, prop name)
+        plain-prop yields the executor pre-resolved — anything richer
+        declined upstream. Returns StatusOr(InterimResult) with rows
+        sorted by VertexID, or None so the storaged scan twin serves.
+
+        Same ladder/cache shape as GO: result-cache hit BEFORE the
+        "index" breaker gate; any device failure feeds the breaker and
+        degrades to the CPU scan, never a client error."""
+        space = ctx.space_id()
+        ck = None
+        try:
+            if result_stage_enabled(graph_flags):
+                token = self._provider.version(space)
+                if token is not None:
+                    ck = ("lookup", space, int(tag_id), token,
+                          self._catalog_version(), prop, op, value,
+                          tuple(yield_props))
+        except Exception:
+            ck = None    # unkeyable literal: skip the rung
+        if ck is not None:
+            hit = self._result_cache_get(ck)
+            if hit is not None:
+                return hit
+        if not self._device_admit("index", ctx):
+            return None
+        try:
+            r = self._execute_lookup_inner(space, tag_id, prop, op,
+                                           value, yield_props)
+        except Exception as e:
+            return self._device_failed("index", e)
+        if r is not None:
+            self._device_ok("index")
+            with self._stats_lock:
+                self.stats["lookup_served"] += 1
+                self.stats["index_hits"] += 1
+            global_stats.add_value("tpu_engine.index.hits",
+                                   kind="counter")
+            if ck is not None:
+                self._result_cache_put(ck, r)
+        return r
+
+    def _execute_lookup_inner(self, space, tag_id, prop, op, value,
+                              yield_props):
+        from . import index as secindex
+        with self._lock:
+            snap = self._snapshot_locked(space)
+            if snap is None:
+                return self._index_decline("no_snapshot")
+            with self._stats_lock:
+                self.stats["index_searches"] += 1
+            global_stats.add_value("tpu_engine.index.searches",
+                                   kind="counter")
+            faults.fire("index.search")
+            idx = self._get_index_locked(snap, tag_id, prop)
+            if idx is None:
+                return self._index_decline("unindexable_prop")
+            if op is None:
+                # no-WHERE dump form: null-prop rows are absent from
+                # the index but present in the scan — CPU serves
+                return self._index_decline("no_where")
+            if idx.is_str:
+                if op != "==":
+                    return self._index_decline("string_order_compare")
+                if not isinstance(value, str):
+                    return self._index_decline("type_mismatch")
+                vids = secindex.search(idx, op,
+                                       snap.str_code("t", prop, value))
+            else:
+                if isinstance(value, str):
+                    return self._index_decline("type_mismatch")
+                vids = secindex.search(idx, op, value)
+            if vids is None:
+                return self._index_decline("unsupported_op")
+            rows = self._materialize_lookup_rows(snap, tag_id,
+                                                 np.sort(vids),
+                                                 yield_props)
+            if rows is None:
+                return self._index_decline("unmaterializable_yield")
+        from ..graph.interim import InterimResult
+        cols = ["VertexID"] + [n for n, _ in yield_props]
+        return StatusOr.of(InterimResult(cols, rows))
+
+    def _materialize_lookup_rows(self, snap, tag_id, vids, yield_props):
+        """Rows for the matched vids from the snapshot host mirrors —
+        the same decoded values the storaged scan twin returns. None
+        (decline) when any needed cell can't be read with identical
+        semantics (absent column / schema-version-missing cells /
+        nulls whose CPU reading is schema-dependent). Caller holds the
+        engine lock (mirrors are delta-mutable)."""
+        from .csr import host_item
+        rows = []
+        for vid in vids:
+            loc = snap.locate(int(vid))
+            if loc is None:
+                return None
+            p0, local = loc
+            row = [int(vid)]
+            for _, pname in yield_props:
+                col = snap.shards[p0].tag_props.get(tag_id, {}).get(pname)
+                if col is None or col.missing is not None:
+                    return None
+                if col.present is not None and not col.present[local]:
+                    return None
+                row.append(host_item(col, local))
+            rows.append(row)
+        return rows
+
+    def can_serve_subgraph(self, space_id: int, steps: int) -> bool:
+        if not (self.enabled and self._provider is not None):
+            return False
+        if _consistency.is_shadow():
+            return False    # shadow runs take the CPU pipe by design
+        return 1 <= int(steps) <= self.MAX_DEVICE_STEPS
+
+    def execute_subgraph(self, ctx, steps: int, starts: List[int],
+                         edge_types: List[int],
+                         name_by_type: Dict[int, str]):
+        """GET SUBGRAPH: bounded frontier expansion with edge capture
+        over the per-step device masks (traverse.multi_hop_steps /
+        the sharded twin). Rows (Step, SrcVID, EdgeName, Ranking,
+        DstVID), sorted; None -> the CPU expansion twin serves."""
+        space = ctx.space_id()
+        heat_tok = self._heat_note_query(ctx, starts)
+        try:
+            ck = None
+            try:
+                if result_stage_enabled(graph_flags):
+                    token = self._provider.version(space)
+                    if token is not None:
+                        ck = ("subgraph", space, int(steps), token,
+                              self._catalog_version(),
+                              tuple(edge_types), tuple(starts))
+            except Exception:
+                ck = None
+            if ck is not None:
+                hit = self._result_cache_get(ck)
+                if hit is not None:
+                    return hit
+            if not self._device_admit("subgraph", ctx):
+                return None
+            try:
+                r = self._execute_subgraph_inner(space, steps, starts,
+                                                 edge_types,
+                                                 name_by_type)
+            except Exception as e:
+                return self._device_failed("subgraph", e)
+            if r is not None:
+                self._device_ok("subgraph")
+                with self._stats_lock:
+                    self.stats["subgraph_served"] += 1
+                if ck is not None:
+                    self._result_cache_put(ck, r)
+            return r
+        finally:
+            _heat.restore(heat_tok)
+
+    def _execute_subgraph_inner(self, space, steps, starts, edge_types,
+                                name_by_type):
+        import jax.numpy as jnp
+        if not edge_types:
+            return self._index_decline("no_edge_types")
+        if len(edge_types) > traverse.MAX_EDGE_TYPES_PER_QUERY:
+            return self._index_decline("too_many_edge_types")
+        with self._lock:
+            snap = self._snapshot_locked(space)
+            if snap is None:
+                return self._index_decline("no_snapshot")
+            if snap.delta is not None and snap.delta.edge_count > 0:
+                # delta-added edges live outside the canonical kernel;
+                # the per-step capture below would miss them (tombstones
+                # alone are fine — they point-update the valid masks)
+                return self._index_decline("delta_edges")
+            f0 = jnp.asarray(
+                snap.frontier_from_vids([int(v) for v in starts]))
+            req = jnp.asarray(traverse.pad_edge_types(list(edge_types)))
+            if getattr(snap, "sharded_kernel", None) is not None:
+                from . import mesh_exec
+                try:
+                    masks = mesh_exec.multi_hop_steps_sharded(
+                        self.mesh, f0, snap.sharded_kernel, req,
+                        int(steps))
+                except Exception as e:
+                    self._mesh_failed("subgraph", e, snap)
+                    return None
+                self.stats["sharded_queries"] += 1
+                self._mesh_served("subgraph")
+            else:
+                masks = traverse.multi_hop_steps(f0, snap.kernel, req,
+                                                 steps=int(steps))
+            v0 = snap.write_version
+        # device wait OFF the engine lock (jax releases the GIL);
+        # materialize re-takes it and declines if a delta apply moved
+        # the snapshot under the fetch — the CPU pipe serves instead
+        masks_np = np.asarray(masks)
+        with self._lock:
+            if snap.stale or snap.write_version != v0:
+                return self._index_decline("snapshot_moved")
+            rows = self._materialize_subgraph_rows(snap, masks_np,
+                                                   name_by_type)
+        rows.sort()
+        from ..graph.interim import InterimResult
+        return StatusOr.of(InterimResult(
+            ["Step", "SrcVID", "EdgeName", "Ranking", "DstVID"],
+            [list(t) for t in rows]))
+
+    def _materialize_subgraph_rows(self, snap, masks_np, name_by_type):
+        """(step, src, edge name, rank, dst) tuples from the per-step
+        active masks + host mirrors; caller holds the engine lock."""
+        rows = []
+        for si in range(masks_np.shape[0]):
+            for p0, shard in enumerate(snap.shards):
+                for e in np.nonzero(masks_np[si, p0])[0]:
+                    et = int(shard.edge_etype[e])
+                    name = name_by_type.get(et)
+                    src = snap.vid_of_slot(p0, int(shard.edge_src[e]))
+                    if name is None or src is None:
+                        continue
+                    rows.append((si + 1, int(src), name,
+                                 int(shard.edge_rank[e]),
+                                 int(shard.edge_dst_vid[e])))
+        return rows
 
     # ------------------------------------------------------------------
     # GO on device

@@ -1,0 +1,305 @@
+"""Device-resident secondary property indexes (ROADMAP item 4).
+
+Role parity with the reference's storage-side index scans
+(`storage/index/LookUpProcessor` next to the KVStore): LOOKUP ON tag
+WHERE prop OP value resolves through a named index instead of a full
+scan. Here the index is a per-snapshot SORTED property array living on
+device: one `(tag_id, prop)` pair -> values sorted ascending plus the
+matching global vertex slots, binary-searched on device
+(jnp.searchsorted is a lax-friendly O(log n) ladder) and gathered into
+a vid set / frontier.
+
+Design points, mirroring the CSR discipline (csr.py):
+
+- Built on the same off-lock per-snapshot build path CSR uses
+  (`TpuGraphEngine._build_fresh` builds cataloged indexes eagerly;
+  anything missed builds lazily under the engine lock) and keyed by
+  the snapshot's PR 5 write-version token — a committed write moves
+  the token and structurally orphans the index (delta applies also
+  clear the per-snapshot dict, poison purges it like the CSR caches).
+- Values ride the narrow-width packing ladder: int columns re-pack to
+  int8/int16 when their range allows (NEBULA_TPU_WIDE_CSR=1 pins
+  int32, same switch as the edge arrays); the global slot array packs
+  via `edge_index_dtype`. Query constants outside the packed range
+  resolve host-side to all/nothing before touching the device.
+- Byte-identity with the CPU scan twin is exact, not approximate:
+  integer/bool/string-code searches are exact by construction; float
+  columns are searched in the device's f32 encoding and only the
+  equality BAND [lo, hi) — where f32 rounding could disagree with the
+  host's f64 compare — is re-verified against the full-fidelity host
+  mirror (f32 rounding is monotone, so everything outside the band is
+  provably on the right side).
+- String props are dictionary codes on device (csr.str_code):
+  equality only; ordered string compares decline to the CPU scan.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..codec.schema import PropType
+from .csr import (FORCE_WIDE_DTYPES, CsrSnapshot, PropColumn,
+                  edge_index_dtype, host_item)
+
+# ops a device index search can serve; "!=" walks the whole array and
+# is better off on the CPU scan
+SUPPORTED_OPS = ("==", "<", "<=", ">", ">=")
+
+
+@dataclass
+class PropIndex:
+    """One (tag, prop) sorted-array index over one snapshot."""
+    space_id: int
+    tag_id: int
+    prop: str
+    ptype: PropType
+    write_version: Any
+    values_d: Any                 # device, sorted ascending (codes for str)
+    gidx_d: Any                   # device, int16|int32 global slot per entry
+    vids_sorted: np.ndarray       # host int64, parallel to values_d
+    host_vals: np.ndarray         # host full-fidelity, parallel (band verify)
+    is_float: bool
+    is_str: bool
+    count: int
+    nbytes: int
+
+    def matches_snapshot(self, snap: CsrSnapshot) -> bool:
+        return self.write_version == snap.write_version
+
+
+def _slot_vid(shard, local: int, delta_rev: Dict[int, int]) -> Optional[int]:
+    if local < shard.num_vids_base:
+        return int(shard.vids[local])
+    return delta_rev.get(local)
+
+
+def build_tag_index(snap: CsrSnapshot, tag_id: int,
+                    prop: str) -> Optional[PropIndex]:
+    """Build the sorted device index for (tag_id, prop) from the
+    snapshot's host mirrors. None = this prop can't host a device
+    index (no device-encodable column) — the CPU scan serves.
+    Runs off-lock at build time or under the engine lock for lazy
+    (post-delta) rebuilds; either way the caller owns installation."""
+    import jax.numpy as jnp
+    vals_parts: List[np.ndarray] = []
+    host_parts: List[np.ndarray] = []
+    gidx_parts: List[np.ndarray] = []
+    vid_parts: List[np.ndarray] = []
+    ptype: Optional[PropType] = None
+    any_col = False
+    for p0, shard in enumerate(snap.shards):
+        col: Optional[PropColumn] = shard.tag_props.get(tag_id, {}).get(prop)
+        if col is None:
+            continue
+        any_col = True
+        if not col.device_ok or col.device_vals is None:
+            return None
+        if col.missing is not None:
+            # mixed no-row / version-missing cells: the CPU's
+            # schema-default-vs-error semantics can't be mirrored from
+            # the present mask alone — the scan twin serves this prop
+            return None
+        ptype = col.ptype
+        present = col.present
+        if present is None:
+            present = np.ones(len(col.device_vals), dtype=bool)
+        slots = np.nonzero(present)[0]
+        if len(slots) == 0:
+            continue
+        delta_rev = {loc: vid for vid, loc in shard.delta_vids.items()}
+        vids = np.empty(len(slots), np.int64)
+        keep = np.ones(len(slots), bool)
+        for i, local in enumerate(slots):
+            v = _slot_vid(shard, int(local), delta_rev)
+            if v is None:
+                keep[i] = False
+            else:
+                vids[i] = v
+        slots = slots[keep]
+        vids = vids[keep]
+        if len(slots) == 0:
+            continue
+        vals_parts.append(col.device_vals[slots])
+        hv = col.host[slots]
+        host_parts.append(hv if hv.dtype != object else hv)
+        gidx_parts.append(p0 * snap.cap_v + slots.astype(np.int64))
+        vid_parts.append(vids)
+    if not any_col or not vals_parts:
+        # tag/prop exists but no rows: an EMPTY index still serves
+        # (zero matches) as long as the column itself was indexable
+        if not any_col:
+            return None
+        vals = np.zeros(0, np.int32)
+        host_vals = np.zeros(0, np.int64)
+        gidx = np.zeros(0, np.int64)
+        vids = np.zeros(0, np.int64)
+    else:
+        vals = np.concatenate(vals_parts)
+        host_vals = np.concatenate(host_parts)
+        gidx = np.concatenate(gidx_parts)
+        vids = np.concatenate(vid_parts)
+    order = np.lexsort((vids, vals))
+    vals = vals[order]
+    host_vals = host_vals[order]
+    gidx = gidx[order]
+    vids = vids[order]
+    is_float = vals.dtype.kind == "f"
+    if is_float and len(vals) and np.isnan(vals.astype(np.float64)).any():
+        # NaN sorts to the tail, so the ">" exact region would include
+        # entries every python compare rejects — scan twin serves
+        return None
+    is_str = ptype == PropType.STRING if ptype is not None else False
+    # narrow-width packing for int values (PR 7 ladder): int8/int16
+    # when the value range allows, int32 fallback; the env pin wins
+    if vals.dtype.kind == "i" and not FORCE_WIDE_DTYPES and len(vals):
+        lo, hi = int(vals.min()), int(vals.max())
+        for dt in (np.int8, np.int16):
+            ii = np.iinfo(dt)
+            if ii.min <= lo and hi <= ii.max:
+                vals = vals.astype(dt)
+                break
+    gdt = edge_index_dtype(snap.num_parts * snap.cap_v)
+    gidx_packed = gidx.astype(gdt)
+    import jax.numpy as jnp
+    values_d = jnp.asarray(vals)
+    gidx_d = jnp.asarray(gidx_packed)
+    return PropIndex(space_id=snap.space_id, tag_id=tag_id, prop=prop,
+                     ptype=ptype or PropType.INT,
+                     write_version=snap.write_version,
+                     values_d=values_d, gidx_d=gidx_d,
+                     vids_sorted=vids, host_vals=host_vals,
+                     is_float=is_float, is_str=is_str,
+                     count=len(vids),
+                     nbytes=int(vals.nbytes + gidx_packed.nbytes))
+
+
+def _py_cmp(op: str, a, b) -> bool:
+    if a is None:
+        return False
+    if op == "==":
+        return a == b
+    if op == "<":
+        return a < b
+    if op == "<=":
+        return a <= b
+    if op == ">":
+        return a > b
+    return a >= b
+
+
+def _cast_query(idx: PropIndex, op: str, value):
+    """Map the python query constant into the packed dtype. Returns
+    ("all",), ("none",) for range-resolved constants, ("val", v) to
+    search, or ("decline",) when the comparison can't be exact."""
+    dt = idx.values_d.dtype
+    if idx.is_str:
+        return ("val", value)    # caller passes the dict code already
+    if dt.kind == "b":
+        # bool column: python `True == 5` is False, so only a bool
+        # constant compares exactly against the packed bool array
+        if not isinstance(value, bool):
+            return ("decline",)
+        return ("val", np.asarray(value, dt), op)
+    if isinstance(value, bool):
+        value = int(value)
+    if not isinstance(value, (int, float)):
+        return ("decline",)
+    if dt.kind == "i":
+        if isinstance(value, float):
+            if value != int(value):
+                # fractional constant vs int column: resolve by shifting
+                # to the neighbouring integer, exactly like the CPU's
+                # mixed-type compare
+                if op == "==":
+                    return ("none",)
+                if op in ("<", "<="):
+                    value = int(np.floor(value))
+                    op = "<="
+                else:
+                    value = int(np.ceil(value))
+                    op = ">="
+            else:
+                value = int(value)
+        info = np.iinfo(dt)
+        if value > info.max:
+            return ("all",) if op in ("<", "<=") else ("none",)
+        if value < info.min:
+            return ("all",) if op in (">", ">=") else ("none",)
+        return ("val", np.asarray(value, dt), op)
+    # float column: searches run in f32; the band re-verifies
+    return ("val", np.asarray(float(value), dt), op)
+
+
+def search(idx: PropIndex, op: str, value,
+           query_value=None) -> Optional[np.ndarray]:
+    """Device binary search -> matching vids (int64, unsorted).
+    `value`: the device-comparable constant (dict code for strings);
+    `query_value`: the original python constant for float band
+    verification (defaults to `value`). None = decline (CPU serves)."""
+    if op not in SUPPORTED_OPS:
+        return None
+    if idx.is_str and op != "==":
+        return None              # dict codes aren't lexicographic
+    if query_value is None:
+        query_value = value
+    if idx.count == 0:
+        return np.zeros(0, np.int64)
+    cast = _cast_query(idx, op, value)
+    if cast[0] == "decline":
+        return None
+    if cast[0] == "all":
+        return idx.vids_sorted.copy()
+    if cast[0] == "none":
+        return np.zeros(0, np.int64)
+    v = cast[1]
+    if len(cast) > 2:
+        op = cast[2]
+    import jax.numpy as jnp
+    # the device part: O(log n) searchsorted ladder over the resident
+    # sorted array (eager ops execute on the backend device)
+    lo = int(jnp.searchsorted(idx.values_d, v, side="left"))
+    hi = int(jnp.searchsorted(idx.values_d, v, side="right"))
+    n = idx.count
+    if op == "==":
+        exact_sl: List[slice] = []
+        band = slice(lo, hi)
+    elif op == "<":
+        exact_sl = [slice(0, lo)]
+        band = slice(lo, hi)
+    elif op == "<=":
+        exact_sl = [slice(0, lo)]
+        band = slice(lo, hi)
+    elif op == ">":
+        exact_sl = [slice(hi, n)]
+        band = slice(lo, hi)
+    else:  # ">="
+        exact_sl = [slice(hi, n)]
+        band = slice(lo, hi)
+    out = [idx.vids_sorted[s] for s in exact_sl]
+    if band.stop > band.start:
+        if idx.is_float:
+            # f32-equal band: re-verify against the f64 host mirror
+            bh = idx.host_vals[band]
+            keep = np.fromiter(
+                (_py_cmp(op, (x.item() if isinstance(x, np.generic) else x),
+                         query_value) for x in bh),
+                dtype=bool, count=len(bh))
+            out.append(idx.vids_sorted[band][keep])
+        elif op in ("==", "<=", ">="):
+            out.append(idx.vids_sorted[band])
+        # for exact dtypes "<" / ">" exclude the equality band entirely
+    if not out:
+        return np.zeros(0, np.int64)
+    return np.concatenate(out) if len(out) > 1 else out[0].copy()
+
+
+def search_frontier(snap: CsrSnapshot, idx: PropIndex, op: str, value,
+                    query_value=None) -> Optional[np.ndarray]:
+    """Like search() but gathers the matched global slots into a
+    bool[P, cap_v] frontier (the LOOKUP-seeded GO / MATCH entry)."""
+    vids = search(idx, op, value, query_value)
+    if vids is None:
+        return None
+    return snap.frontier_from_vids([int(v) for v in vids])

@@ -107,6 +107,28 @@ def execute_drop_schema(ctx: ExecContext, s: ast.DropSchemaSentence) -> Result:
     return _ok()
 
 
+def execute_create_index(ctx: ExecContext,
+                         s: ast.CreateIndexSentence) -> Result:
+    st = ctx.require_space()
+    if not st.ok():
+        return StatusOr.from_status(st)
+    r = ctx.meta.create_index(ctx.space_id(), s.name, s.is_edge,
+                              s.schema_name, s.fields, s.if_not_exists)
+    if not r.ok():
+        return StatusOr.from_status(r.status)
+    return _ok()
+
+
+def execute_drop_index(ctx: ExecContext, s: ast.DropIndexSentence) -> Result:
+    st = ctx.require_space()
+    if not st.ok():
+        return StatusOr.from_status(st)
+    st = ctx.meta.drop_index(ctx.space_id(), s.name, s.if_exists)
+    if not st.ok():
+        return StatusOr.from_status(st)
+    return _ok()
+
+
 def execute_describe_schema(ctx: ExecContext, s: ast.DescribeSchemaSentence) -> Result:
     st = ctx.require_space()
     if not st.ok():
@@ -223,6 +245,18 @@ def execute_show(ctx: ExecContext, s: ast.ShowSentence) -> Result:
             rows = [(pid, ", ".join(hosts))
                     for pid, hosts in sorted(alloc.items())]
             return _ok(InterimResult(["Partition ID", "Peers"], rows))
+    if k in (ast.ShowKind.TAG_INDEXES, ast.ShowKind.EDGE_INDEXES):
+        st = ctx.require_space()
+        if not st.ok():
+            return StatusOr.from_status(st)
+        want_edge = k == ast.ShowKind.EDGE_INDEXES
+        rows = [(d["index_id"], d["name"], d["schema_name"],
+                 ", ".join(d["fields"]))
+                for d in sorted(ctx.meta.list_indexes(ctx.space_id()),
+                                key=lambda d: d["index_id"])
+                if bool(d.get("is_edge")) == want_edge]
+        return _ok(InterimResult(
+            ["Index ID", "Index Name", "Schema Name", "Fields"], rows))
     if k == ast.ShowKind.USERS:
         return _ok(InterimResult(["User"],
                                  [(u,) for u in ctx.meta.list_users()]))

@@ -32,7 +32,8 @@ _WRITE_KINDS = {ast.Kind.INSERT_VERTICES, ast.Kind.INSERT_EDGES,
                 ast.Kind.UPDATE_VERTEX, ast.Kind.UPDATE_EDGE, ast.Kind.INGEST,
                 ast.Kind.DOWNLOAD}
 _SCHEMA_KINDS = {ast.Kind.CREATE_TAG, ast.Kind.CREATE_EDGE, ast.Kind.ALTER_TAG,
-                 ast.Kind.ALTER_EDGE, ast.Kind.DROP_TAG, ast.Kind.DROP_EDGE}
+                 ast.Kind.ALTER_EDGE, ast.Kind.DROP_TAG, ast.Kind.DROP_EDGE,
+                 ast.Kind.CREATE_INDEX, ast.Kind.DROP_INDEX}
 _GOD_KINDS = {ast.Kind.CREATE_SPACE, ast.Kind.DROP_SPACE, ast.Kind.BALANCE,
               ast.Kind.CREATE_USER, ast.Kind.DROP_USER, ast.Kind.CONFIG,
               ast.Kind.CREATE_SNAPSHOT, ast.Kind.DROP_SNAPSHOT}
@@ -45,7 +46,8 @@ _QOS_GATED_KINDS = _WRITE_KINDS | {
     ast.Kind.GO, ast.Kind.FIND_PATH, ast.Kind.FETCH_VERTICES,
     ast.Kind.FETCH_EDGES, ast.Kind.YIELD, ast.Kind.PIPE,
     ast.Kind.SET_OP, ast.Kind.ASSIGNMENT, ast.Kind.ORDER_BY,
-    ast.Kind.LIMIT, ast.Kind.GROUP_BY}
+    ast.Kind.LIMIT, ast.Kind.GROUP_BY,
+    ast.Kind.LOOKUP, ast.Kind.GET_SUBGRAPH, ast.Kind.MATCH}
 
 
 def _lane_leaf(s: ast.Sentence) -> ast.Sentence:
@@ -309,7 +311,8 @@ class ExecutionEngine:
     # engine owns), single-sentence so re-execution in a fresh shadow
     # session has identical semantics
     _SHADOW_LEAF_KINDS = {ast.Kind.GO, ast.Kind.FETCH_VERTICES,
-                          ast.Kind.FETCH_EDGES}
+                          ast.Kind.FETCH_EDGES, ast.Kind.LOOKUP,
+                          ast.Kind.GET_SUBGRAPH}
     _SHADOW_KINDS = _SHADOW_LEAF_KINDS | {
         ast.Kind.PIPE, ast.Kind.SET_OP, ast.Kind.YIELD,
         ast.Kind.ORDER_BY, ast.Kind.LIMIT, ast.Kind.GROUP_BY}
@@ -398,6 +401,9 @@ _DISPATCH: Dict[ast.Kind, Callable] = {
     ast.Kind.DELETE_EDGES: ex.execute_delete_edges,
     ast.Kind.UPDATE_VERTEX: ex.execute_update_vertex,
     ast.Kind.UPDATE_EDGE: ex.execute_update_edge,
+    ast.Kind.LOOKUP: ex.execute_lookup,
+    ast.Kind.GET_SUBGRAPH: ex.execute_subgraph,
+    ast.Kind.MATCH: ex.execute_match,
     ast.Kind.YIELD: ex.execute_yield,
     ast.Kind.ORDER_BY: ex.execute_order_by,
     ast.Kind.LIMIT: ex.execute_limit,
@@ -414,6 +420,8 @@ _DISPATCH: Dict[ast.Kind, Callable] = {
     ast.Kind.DROP_EDGE: adm.execute_drop_schema,
     ast.Kind.DESCRIBE_TAG: adm.execute_describe_schema,
     ast.Kind.DESCRIBE_EDGE: adm.execute_describe_schema,
+    ast.Kind.CREATE_INDEX: adm.execute_create_index,
+    ast.Kind.DROP_INDEX: adm.execute_drop_index,
     ast.Kind.SHOW: adm.execute_show,
     ast.Kind.SHOW_CREATE: adm.execute_show_create,
     ast.Kind.CONFIG: adm.execute_config,

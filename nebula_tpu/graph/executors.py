@@ -25,8 +25,9 @@ from ..common.status import ErrorCode, Status, StatusOr
 from ..filter.expressions import (DestPropExpr, EdgeDstIdExpr, EdgePropExpr,
                                   EdgeRankExpr, EdgeSrcIdExpr, EdgeTypeExpr,
                                   EvalError, Expression, FunctionCall,
-                                  InputPropExpr, Literal, SourcePropExpr,
-                                  VariablePropExpr, encode_expression)
+                                  InputPropExpr, Literal, RelationalExpr,
+                                  SourcePropExpr, VariablePropExpr,
+                                  encode_expression)
 from ..parser import ast
 from ..storage.processors import is_pushable
 from ..storage.types import EdgeKey, NewEdge, NewVertex, UpdateItemReq
@@ -630,6 +631,327 @@ def _all_paths(ctx: ExecContext, space: int, sources: List[int],
                 nxt.append(cand)
         level = nxt[:max_paths]
     return sorted(set(found))
+
+
+# ---------------------------------------------------------------------------
+# LOOKUP (ref: graph/LookupExecutor.cpp — index-backed property search)
+# ---------------------------------------------------------------------------
+
+_FLIP_OP = {"==": "==", "!=": "!=", "<": ">", "<=": ">=",
+            ">": "<", ">=": "<="}
+
+
+def _lookup_simple_condition(s: ast.LookupSentence
+                             ) -> Optional[Tuple[str, str, Any]]:
+    """WHERE as a single `prop OP literal` comparison (either
+    orientation) -> (prop, op, value); None = richer filter, the CPU
+    scan evaluates the full expression tree per row."""
+    if s.where is None:
+        return None
+    f = s.where.filter
+    if not isinstance(f, RelationalExpr):
+        return None
+    left, right, op = f.left, f.right, f.op
+    if isinstance(left, Literal) and isinstance(right, EdgePropExpr):
+        left, right = right, left
+        op = _FLIP_OP.get(op)
+    if op is None or not isinstance(left, EdgePropExpr) or \
+            not isinstance(right, Literal):
+        return None
+    if left.edge not in (None, s.on_name):
+        return None
+    v = right.value
+    if v is None:
+        return None
+    return (left.prop, op, v)
+
+
+def _plain_yield_props(yield_cols: List[ast.YieldColumn], on_name: str
+                       ) -> Optional[List[Tuple[str, str]]]:
+    """YIELD columns as plain (column name, prop name) refs of the
+    scanned schema — the only shape the device materializer serves;
+    anything richer returns None and the CPU twin evaluates."""
+    out: List[Tuple[str, str]] = []
+    for c in yield_cols:
+        e = c.expr
+        if c.agg_fun or not isinstance(e, EdgePropExpr) or \
+                e.edge not in (None, on_name):
+            return None
+        out.append((c.name(), e.prop))
+    return out
+
+
+def _lookup_yield_eval(yield_cols: List[ast.YieldColumn], on_name: str,
+                       props: Dict[str, Any], src: int = 0, dst: int = 0,
+                       rank: int = 0) -> List[Any]:
+    """Evaluate YIELD exprs against one matched row. Prop refs bind to
+    the scanned schema's row (bare `prop` or `schema.prop`); a ref the
+    row can't satisfy yields NULL — the filter already decided
+    membership, a missing yield cell must not fail the query."""
+    ectx = EdgeRowExprContext(src_props={}, edge_props=props,
+                              edge_name=on_name,
+                              alias_map={on_name: on_name},
+                              src=src, dst=dst, rank=rank)
+    out: List[Any] = []
+    for c in yield_cols:
+        try:
+            out.append(c.expr.eval(ectx))
+        except EvalError:
+            out.append(None)
+    return out
+
+
+def execute_lookup(ctx: ExecContext, s: ast.LookupSentence) -> Result:
+    st = ctx.require_space()
+    if not st.ok():
+        return StatusOr.from_status(st)
+    space = ctx.space_id()
+    tag_id = ctx.sm.tag_id(space, s.on_name)
+    is_edge = tag_id is None
+    schema_id = tag_id
+    if is_edge:
+        schema_id = ctx.sm.edge_type(space, s.on_name)
+        if schema_id is None:
+            return _err(ErrorCode.E_TAG_NOT_FOUND, s.on_name)
+    # LOOKUP is the index-backed verb: the catalog must hold an index
+    # on the schema (ref: LookupExecutor checks IndexManager first) —
+    # which ENGINE serves the search is a routing decision below
+    specs = [d for d in ctx.sm.list_indexes(space)
+             if bool(d.get("is_edge")) == is_edge
+             and d.get("schema_id") == schema_id]
+    if not specs:
+        return _err(ErrorCode.E_INDEX_NOT_FOUND,
+                    f"no index on {'edge' if is_edge else 'tag'} "
+                    f"{s.on_name}")
+    yield_cols = list(s.yield_.columns) if s.yield_ else []
+
+    # TPU offload seam (tag form): single prop-OP-literal WHERE over an
+    # index whose leading field is that prop, plain prop-ref yields.
+    # None = declined -> the storaged CPU scan twin serves.
+    tpu = getattr(ctx.engine, "tpu_engine", None)
+    cond = _lookup_simple_condition(s)
+    if tpu is not None and not is_edge and cond is not None and \
+            tpu.can_serve_lookup(space):
+        prop, op, value = cond
+        yp = _plain_yield_props(yield_cols, s.on_name)
+        if yp is not None and \
+                any((d.get("fields") or [None])[0] == prop for d in specs):
+            r = tpu.execute_lookup(ctx, schema_id, prop, op, value, yp)
+            if r is not None:
+                return r
+
+    filter_bytes = encode_expression(s.where.filter) if s.where else None
+    resp = ctx.client.lookup_scan(space, is_edge, schema_id, filter_bytes)
+    bad = [r for r in resp.results.values()
+           if r.code != ErrorCode.SUCCEEDED]
+    if bad:
+        return _err(bad[0].code, "storage error during LOOKUP")
+    if is_edge:
+        columns = ["SrcVID", "Ranking", "DstVID"] + \
+            [c.name() for c in yield_cols]
+        rows = []
+        for r in sorted(resp.rows, key=lambda r: (r.src, r.rank, r.dst)):
+            rows.append([r.src, r.rank, r.dst] +
+                        _lookup_yield_eval(yield_cols, s.on_name, r.props,
+                                           r.src, r.dst, r.rank))
+    else:
+        columns = ["VertexID"] + [c.name() for c in yield_cols]
+        rows = []
+        for r in sorted(resp.rows, key=lambda r: r.vid):
+            rows.append([r.vid] +
+                        _lookup_yield_eval(yield_cols, s.on_name, r.props))
+    result = InterimResult(columns, rows)
+    if s.yield_ and s.yield_.distinct:
+        result = result.distinct()
+    return _ok(result)
+
+
+# ---------------------------------------------------------------------------
+# GET SUBGRAPH (ref: graph/GetSubgraphExecutor — bounded expansion with
+# edge capture)
+# ---------------------------------------------------------------------------
+
+_SUBGRAPH_COLUMNS = ["Step", "SrcVID", "EdgeName", "Ranking", "DstVID"]
+
+
+def execute_subgraph(ctx: ExecContext, s: ast.GetSubgraphSentence) -> Result:
+    st = ctx.require_space()
+    if not st.ok():
+        return StatusOr.from_status(st)
+    space = ctx.space_id()
+    starts_r = resolve_starts(ctx, s.from_)
+    if not starts_r.ok():
+        return StatusOr.from_status(starts_r.status)
+    starts = starts_r.value()
+    if not starts:
+        return _ok(InterimResult(list(_SUBGRAPH_COLUMNS)))
+    over_r = resolve_over(ctx, s.over)
+    if not over_r.ok():
+        return StatusOr.from_status(over_r.status)
+    edge_types, _, name_by_type = over_r.value()
+    if not edge_types:
+        return _err(ErrorCode.E_EDGE_NOT_FOUND, "no edges in OVER clause")
+    steps = max(1, int(s.step.steps))
+    # one SIGNED type->name map shared by both engines (in-edge slots
+    # carry the negated type; the emitted EdgeName stays the plain name)
+    signed_names = {et: name_by_type[abs(et)] for et in edge_types
+                    if abs(et) in name_by_type}
+
+    # TPU offload seam: per-step fused window masks over the resident
+    # kernel (traverse.multi_hop_steps / the meshed twin)
+    tpu = getattr(ctx.engine, "tpu_engine", None)
+    if tpu is not None and tpu.can_serve_subgraph(space, steps):
+        r = tpu.execute_subgraph(ctx, steps, starts, edge_types,
+                                 signed_names)
+        if r is not None:
+            return r
+
+    # CPU twin: plain frontier advance, NO cross-step visited set —
+    # the device masks re-activate edges reachable again at a later
+    # step, and the twin must capture the identical row set
+    rows: List[Tuple[int, int, str, int, int]] = []
+    frontier = starts
+    for step_no in range(1, steps + 1):
+        resp = ctx.client.get_neighbors(space, frontier, edge_types,
+                                        edge_props=[])
+        bad = [r for r in resp.results.values()
+               if r.code != ErrorCode.SUCCEEDED]
+        if bad:
+            return _err(bad[0].code,
+                        f"storage error during SUBGRAPH step {step_no}")
+        nxt: Set[int] = set()
+        for v in resp.vertices:
+            for e in v.edges:
+                name = signed_names.get(e.etype)
+                if name is None:
+                    continue
+                rows.append((step_no, v.vid, name, e.rank, e.dst))
+                nxt.add(e.dst)
+        frontier = sorted(nxt)
+        if not frontier:
+            break
+    rows.sort()
+    return _ok(InterimResult(list(_SUBGRAPH_COLUMNS),
+                             [list(t) for t in rows]))
+
+
+# ---------------------------------------------------------------------------
+# MATCH subset: (a:tag {prop: v})-[e*m..n]->(b) RETURN ... lowered onto
+# a LOOKUP-seeded GO plan (ref: the reference stubs MatchExecutor
+# entirely; this serves the pattern shape the parser recognizes and
+# keeps the raw fallback on the reference's 'not supported' answer)
+# ---------------------------------------------------------------------------
+
+def _match_seed_rows(ctx: ExecContext, tag_name: str, tag_id: int,
+                     prop: str, value, a_props: List[str]
+                     ) -> StatusOr[List[List[Any]]]:
+    """Equality-matched seeds for the pattern's source node, each row
+    [vid, *a_props values], sorted by vid — the LOOKUP stage of the
+    MATCH plan (device index search when it accepts, CPU scan twin
+    otherwise)."""
+    space = ctx.space_id()
+    tpu = getattr(ctx.engine, "tpu_engine", None)
+    if tpu is not None and tpu.can_serve_lookup(space):
+        r = tpu.execute_lookup(ctx, tag_id, prop, "==", value,
+                               [(p, p) for p in a_props])
+        if r is not None:
+            if not r.ok():
+                return StatusOr.from_status(r.status)
+            return StatusOr.of([list(row) for row in r.value().rows])
+    flt = RelationalExpr("==", EdgePropExpr(None, prop), Literal(value))
+    resp = ctx.client.lookup_scan(space, False, tag_id,
+                                  encode_expression(flt))
+    bad = [pr for pr in resp.results.values()
+           if pr.code != ErrorCode.SUCCEEDED]
+    if bad:
+        return StatusOr.err(bad[0].code, "storage error during MATCH seed")
+    rows = [[r.vid] + [r.props.get(p) for p in a_props]
+            for r in sorted(resp.rows, key=lambda r: r.vid)]
+    return StatusOr.of(rows)
+
+
+def execute_match(ctx: ExecContext, s: ast.MatchSentence) -> Result:
+    if s.pattern is None or s.return_ is None:
+        return _err(ErrorCode.E_UNSUPPORTED,
+                    "MATCH is supported only as (a:tag {prop: value})"
+                    "-[e[:name][*m..n]]->(b) RETURN ...")
+    st = ctx.require_space()
+    if not st.ok():
+        return StatusOr.from_status(st)
+    space = ctx.space_id()
+    p = s.pattern
+    tag_id = ctx.sm.tag_id(space, p.tag)
+    if tag_id is None:
+        return _err(ErrorCode.E_TAG_NOT_FOUND, p.tag)
+    try:
+        value = p.value.eval(RowExprContext())
+    except EvalError as ex:
+        return _err(ErrorCode.E_EXECUTION_ERROR, str(ex))
+
+    # hop range -> GO step clause: *n..n = GO n STEPS, *1..n = GO UPTO n
+    if p.min_hops == p.max_hops:
+        step = ast.StepClause(p.max_hops)
+    elif p.min_hops == 1:
+        step = ast.StepClause(p.max_hops, upto=True)
+    else:
+        return _err(ErrorCode.E_UNSUPPORTED,
+                    f"MATCH hop range *{p.min_hops}..{p.max_hops}: only "
+                    "*1..n and *n..n lower onto GO plans")
+    over = ast.OverClause(edges=[ast.OverEdge(n) for n in p.edge_names],
+                          is_all=not p.edge_names)
+
+    # RETURN analysis: bare aliases and a.prop refs lower; anything
+    # else (b.prop needs a second fetch per row, e needs edge identity
+    # reconstruction) stays unsupported
+    ret: List[Tuple[str, str, Optional[str]]] = []  # (kind, colname, prop)
+    a_props: List[str] = []
+    for c in s.return_.columns:
+        e = c.expr
+        if not isinstance(e, EdgePropExpr) or c.agg_fun:
+            return _err(ErrorCode.E_UNSUPPORTED,
+                        f"MATCH RETURN {c.name()}: only the pattern "
+                        "aliases and a.<prop> are supported")
+        if e.edge is None and e.prop == p.src_alias:
+            ret.append(("a", c.name(), None))
+        elif e.edge is None and e.prop == p.dst_alias:
+            ret.append(("b", c.name(), None))
+        elif e.edge == p.src_alias:
+            ret.append(("a_prop", c.name(), e.prop))
+            if e.prop not in a_props:
+                a_props.append(e.prop)
+        else:
+            return _err(ErrorCode.E_UNSUPPORTED,
+                        f"MATCH RETURN {c.name()}: only the pattern "
+                        "aliases and a.<prop> are supported")
+
+    seeds_r = _match_seed_rows(ctx, p.tag, tag_id, p.prop, value, a_props)
+    if not seeds_r.ok():
+        return StatusOr.from_status(seeds_r.status)
+    columns = [name for _, name, _ in ret]
+    rows: List[List[Any]] = []
+    for seed in seeds_r.value():
+        vid = seed[0]
+        # per-seed GO: the seed IS `a`, so a / a.prop become literal
+        # columns riding the expansion rows (VertexBackTracker without
+        # the join — one root per plan)
+        go_cols = []
+        for kind, name, pr in ret:
+            if kind == "b":
+                go_cols.append(ast.YieldColumn(EdgeDstIdExpr(None),
+                                               alias=name))
+            elif kind == "a":
+                go_cols.append(ast.YieldColumn(Literal(vid), alias=name))
+            else:
+                go_cols.append(ast.YieldColumn(
+                    Literal(seed[1 + a_props.index(pr)]), alias=name))
+        go = ast.GoSentence(step, ast.VertexRef(vids=[Literal(vid)]),
+                            over, None, ast.YieldClause(go_cols))
+        r = execute_go(ctx, go)
+        if not r.ok():
+            return r
+        if r.value() is not None:
+            rows.extend([list(row) for row in r.value().rows])
+    return _ok(InterimResult(columns, rows))
 
 
 # ---------------------------------------------------------------------------

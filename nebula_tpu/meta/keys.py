@@ -28,6 +28,7 @@ P_ID = b"__id:"               # + counter name         -> u32 (next id)
 P_BALANCE = b"__bal:"         # + plan_id(u64)+task    -> task json
 P_SEGMENT = b"__seg:"         # + segment:key          -> custom KV
 P_SNAPSHOT = b"__snp:"        # + name                 -> status str
+P_INDEX = b"__idx:"           # + space(u32)+name      -> IndexDesc json
 K_CLUSTER_ID = b"__cluster_id__"  # -> u63 cluster id (ClusterIdMan)
 
 
@@ -112,6 +113,14 @@ def segment_key(segment: str, key: str) -> bytes:
 
 def snapshot_key(name: str) -> bytes:
     return P_SNAPSHOT + name.encode("utf-8")
+
+
+def index_key(space_id: int, name: str) -> bytes:
+    return P_INDEX + _U32.pack(space_id) + name.encode("utf-8")
+
+
+def index_prefix(space_id: int) -> bytes:
+    return P_INDEX + _U32.pack(space_id)
 
 
 def unpack_u32(b: bytes) -> int:

@@ -87,6 +87,14 @@ class SchemaManager:
                           lambda: [tid for tid in
                                    [t for _, t in self._meta.list_tags(space_id)]])
 
+    def list_indexes(self, space_id: int) -> List[dict]:
+        return self._memo(("idxs", space_id),
+                          lambda: self._meta.list_indexes(space_id))
+
+    def indexes_for_tag(self, space_id: int, tag_id: int) -> List[dict]:
+        return [d for d in self.list_indexes(space_id)
+                if not d["is_edge"] and d["schema_id"] == tag_id]
+
 
 class AdHocSchemaManager(SchemaManager):
     """Schema injection without a meta service, for storage-layer tests
@@ -155,3 +163,9 @@ class AdHocSchemaManager(SchemaManager):
     def all_tag_ids(self, space_id: int) -> List[int]:
         return sorted(self._tag_names[k] for k in self._tag_names
                       if k[0] == space_id)
+
+    def list_indexes(self, space_id: int) -> List[dict]:
+        return []
+
+    def indexes_for_tag(self, space_id: int, tag_id: int) -> List[dict]:
+        return []

@@ -205,6 +205,7 @@ class MetaService:
             dead.extend(k for k, _ in self._scan(prefix))
         dead.extend(k for k, _ in self._scan(mk.P_TAG_NAME + mk.pack_u32(space_id)))
         dead.extend(k for k, _ in self._scan(mk.P_EDGE_NAME + mk.pack_u32(space_id)))
+        dead.extend(k for k, _ in self._scan(mk.index_prefix(space_id)))
         st = self._remove(*dead)
         if st.ok():
             self.catalog_version += 1
@@ -416,6 +417,14 @@ class MetaService:
         dead = [name_key]
         dead.extend(k for k, _ in self._scan(
             (mk.edge_prefix if is_edge else mk.tag_prefix)(space_id, sid)))
+        # indexes on a dropped schema die with it (reference: DropTag
+        # rejects while indexes exist; we cascade instead — simpler and
+        # the graphd layer has no multi-statement transactions to stage
+        # the two drops atomically)
+        for k, v in self._scan(mk.index_prefix(space_id)):
+            d = json.loads(v)
+            if d.get("is_edge") == is_edge and d.get("schema_name") == name:
+                dead.append(k)
         st = self._remove(*dead)
         if st.ok():
             self.catalog_version += 1
@@ -439,6 +448,73 @@ class MetaService:
 
     def list_edges(self, space_id: int) -> List[Tuple[str, int]]:
         return self._list_schemas(True, space_id)
+
+    # ------------------------------------------------------------------
+    # secondary indexes (indexMan; ref: meta/processors/indexMan
+    # CreateTagIndexProcessor / CreateEdgeIndexProcessor). An index is a
+    # named (schema, [fields]) pair; storaged serves it as a CPU prop
+    # scan and engine_tpu/index.py builds the device-resident sorted
+    # twin per snapshot. Descriptor is a JSON blob under P_INDEX.
+    # ------------------------------------------------------------------
+    def create_index(self, space_id: int, name: str, is_edge: bool,
+                     schema_name: str, fields: List[str],
+                     if_not_exists: bool = False) -> StatusOr[int]:
+        if self._get(mk.space_key(space_id)) is None:
+            return StatusOr.err(ErrorCode.E_SPACE_NOT_FOUND, str(space_id))
+        if not fields:
+            return StatusOr.err(ErrorCode.E_INVALID_ARGUMENT,
+                                "index needs at least one field")
+        if len(set(fields)) != len(fields):
+            return StatusOr.err(ErrorCode.E_INVALID_ARGUMENT,
+                                "duplicate index field")
+        sid = self._schema_id(is_edge, space_id, schema_name)
+        if sid is None:
+            return StatusOr.err(
+                ErrorCode.E_EDGE_NOT_FOUND if is_edge else ErrorCode.E_TAG_NOT_FOUND,
+                schema_name)
+        schema = self._get_schema(is_edge, space_id, sid).value()
+        for f in fields:
+            if schema.field_type(f) is None:
+                return StatusOr.err(ErrorCode.E_INVALID_ARGUMENT,
+                                    f"field {f!r} not in "
+                                    f"{'edge' if is_edge else 'tag'} "
+                                    f"{schema_name!r}")
+        ikey = mk.index_key(space_id, name)
+        existing = self._get(ikey)
+        if existing is not None:
+            if if_not_exists:
+                return StatusOr.of(json.loads(existing)["index_id"])
+            return StatusOr.err(ErrorCode.E_EXISTED, name)
+        index_id = self._next_id("index")
+        desc = {"index_id": index_id, "name": name, "is_edge": is_edge,
+                "schema_name": schema_name, "schema_id": sid,
+                "fields": list(fields)}
+        st = self._put((ikey, json.dumps(desc).encode()))
+        if not st.ok():
+            return StatusOr.from_status(st)
+        self.catalog_version += 1
+        return StatusOr.of(index_id)
+
+    def drop_index(self, space_id: int, name: str,
+                   if_exists: bool = False) -> Status:
+        ikey = mk.index_key(space_id, name)
+        if self._get(ikey) is None:
+            if if_exists:
+                return Status.OK()
+            return Status.error(ErrorCode.E_NOT_FOUND, name)
+        st = self._remove(ikey)
+        if st.ok():
+            self.catalog_version += 1
+        return st
+
+    def get_index(self, space_id: int, name: str) -> StatusOr[dict]:
+        raw = self._get(mk.index_key(space_id, name))
+        if raw is None:
+            return StatusOr.err(ErrorCode.E_NOT_FOUND, name)
+        return StatusOr.of(json.loads(raw))
+
+    def list_indexes(self, space_id: int) -> List[dict]:
+        return [json.loads(v) for _, v in self._scan(mk.index_prefix(space_id))]
 
     # ------------------------------------------------------------------
     # users & roles (usersMan; roles GOD > ADMIN > USER > GUEST)

@@ -61,6 +61,10 @@ class Kind(enum.Enum):
     DROP_SNAPSHOT = "drop_snapshot"
     MATCH = "match"
     FIND = "find"
+    LOOKUP = "lookup"
+    GET_SUBGRAPH = "get_subgraph"
+    CREATE_INDEX = "create_index"
+    DROP_INDEX = "drop_index"
 
 
 class Sentence:
@@ -286,6 +290,70 @@ class FetchEdgesSentence(Sentence):
 
 
 @dataclass
+class LookupSentence(Sentence):
+    """LOOKUP ON <tag|edge> [WHERE prop OP value [AND ...]] [YIELD ...]
+    (ref: parser/TraverseSentences.h LookupSentence). Serves from a
+    secondary index: device-resident sorted-array search when one
+    covers the filter, storaged CPU prop scan otherwise."""
+    on_name: str
+    where: Optional[WhereClause] = None
+    yield_: Optional[YieldClause] = None
+    kind = Kind.LOOKUP
+
+    def to_string(self) -> str:
+        parts = [f"LOOKUP ON {self.on_name}"]
+        if self.where:
+            parts.append(self.where.to_string())
+        if self.yield_:
+            parts.append(self.yield_.to_string())
+        return " ".join(parts)
+
+
+@dataclass
+class GetSubgraphSentence(Sentence):
+    """GET SUBGRAPH [<n> STEPS] FROM <vids> [OVER edges] — bounded
+    frontier expansion capturing every traversed edge (ref:
+    parser/TraverseSentences.h GetSubgraphSentence)."""
+    step: StepClause
+    from_: VertexRef
+    over: OverClause = field(default_factory=OverClause)
+    kind = Kind.GET_SUBGRAPH
+
+    def to_string(self) -> str:
+        parts = ["GET SUBGRAPH"]
+        if self.step.steps != 1:
+            parts.append(f"{self.step.steps} STEPS")
+        parts.append(f"FROM {self.from_.to_string()}")
+        if self.over.edges or self.over.is_all:
+            parts.append(self.over.to_string())
+        return " ".join(parts)
+
+
+@dataclass
+class MatchPattern:
+    """The supported MATCH subset:
+    (a:tag {prop: value})-[e[:name][*min..max]]->(b)"""
+    src_alias: str
+    tag: str
+    prop: str
+    value: Expression
+    edge_alias: Optional[str] = None
+    edge_names: List[str] = field(default_factory=list)  # empty = all edges
+    min_hops: int = 1
+    max_hops: int = 1
+    dst_alias: Optional[str] = None
+
+    def to_string(self) -> str:
+        e = self.edge_alias or ""
+        if self.edge_names:
+            e += ":" + "|".join(self.edge_names)
+        if (self.min_hops, self.max_hops) != (1, 1):
+            e += f"*{self.min_hops}..{self.max_hops}"
+        return (f"({self.src_alias}:{self.tag} {{{self.prop}: "
+                f"{self.value.to_string()}}})-[{e}]->({self.dst_alias or ''})")
+
+
+@dataclass
 class YieldSentence(Sentence):
     yield_: YieldClause
     where: Optional[WhereClause] = None
@@ -378,10 +446,14 @@ class UseSentence(Sentence):
 
 @dataclass
 class MatchSentence(Sentence):
-    """Grammar-level only, like the reference: MATCH parses but execution
-    reports unsupported (ref: graph/MatchExecutor.cpp 'Match not
-    supported yet', parser Sentence.h kMatch)."""
+    """MATCH (a:tag {prop: v})-[e*1..k]->(b) RETURN ... — when `pattern`
+    is set the executor lowers it onto a LOOKUP-seeded GO plan. Any
+    other MATCH text still parses to the raw form and execution reports
+    unsupported (ref: graph/MatchExecutor.cpp 'Match not supported
+    yet', parser Sentence.h kMatch)."""
     raw: str
+    pattern: Optional["MatchPattern"] = None
+    return_: Optional[YieldClause] = None
     kind = Kind.MATCH
 
     def to_string(self) -> str:
@@ -488,6 +560,34 @@ class DropSchemaSentence(Sentence):
 
     def to_string(self) -> str:
         return f"DROP {'EDGE' if self.is_edge else 'TAG'} {self.name}"
+
+
+@dataclass
+class CreateIndexSentence(Sentence):
+    """CREATE TAG|EDGE INDEX <name> ON <schema>(<fields>) (ref:
+    parser/MaintainSentences.h CreateTagIndexSentence)."""
+    is_edge: bool
+    name: str
+    schema_name: str
+    fields: List[str] = field(default_factory=list)
+    if_not_exists: bool = False
+    kind = Kind.CREATE_INDEX
+
+    def to_string(self) -> str:
+        what = "EDGE" if self.is_edge else "TAG"
+        return (f"CREATE {what} INDEX {self.name} ON "
+                f"{self.schema_name}({', '.join(self.fields)})")
+
+
+@dataclass
+class DropIndexSentence(Sentence):
+    is_edge: bool
+    name: str
+    if_exists: bool = False
+    kind = Kind.DROP_INDEX
+
+    def to_string(self) -> str:
+        return f"DROP {'EDGE' if self.is_edge else 'TAG'} INDEX {self.name}"
 
 
 @dataclass
@@ -628,6 +728,8 @@ class ShowKind(enum.Enum):
     CONFIGS = "CONFIGS"
     VARIABLES = "VARIABLES"
     SNAPSHOTS = "SNAPSHOTS"
+    TAG_INDEXES = "TAG INDEXES"
+    EDGE_INDEXES = "EDGE INDEXES"
     # consistency observatory (docs/manual/10-observability.md):
     # cluster-wide per-part digest state — "consistency" stays an
     # unreserved identifier (soft keyword, like BALANCE DATA heat)

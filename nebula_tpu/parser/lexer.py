@@ -35,6 +35,7 @@ KEYWORDS = {
     "ROLES", "GOD", "ADMIN", "GUEST", "WITH", "IN",
     "INGEST", "DOWNLOAD", "HDFS", "SUBMIT", "JOB", "JOBS",
     "SNAPSHOT", "SNAPSHOTS", "MATCH", "RETURN",
+    "LOOKUP", "SUBGRAPH", "INDEX", "INDEXES",
 }
 
 # token types
@@ -62,7 +63,7 @@ class LexError(Exception):
         self.pos = pos
 
 
-_SYMBOLS2 = {"==", "!=", "<=", ">=", "&&", "||", "->", "<-", "=~"}
+_SYMBOLS2 = {"==", "!=", "<=", ">=", "&&", "||", "->", "<-", "=~", ".."}
 _SYMBOLS1 = set("()[]{},;|.$@=<>+-*/%!^:")
 
 
@@ -127,7 +128,8 @@ def tokenize(text: str) -> List[Token]:
                 continue
             while j < n and text[j].isdigit():
                 j += 1
-            if j < n and text[j] == ".":
+            if j < n and text[j] == "." and text[j + 1:j + 2] != ".":
+                # (but "1..3" is INT .. INT — the MATCH hop-range form)
                 if j + 1 < n and text[j + 1].isdigit():
                     is_double = True
                     j += 1

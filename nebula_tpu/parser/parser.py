@@ -150,9 +150,9 @@ class GQLParser:
         if tt == "FIND":
             return self._find_path()
         if tt == "MATCH":
-            # grammar-level stub (ref: MATCH parses, executor says
-            # "not supported yet") — swallow tokens to the stmt boundary
-            return ast.MatchSentence(self._swallow_to_stmt_boundary())
+            return self._match()
+        if tt == "LOOKUP":
+            return self._lookup()
         if tt == "FETCH":
             return self._fetch()
         if tt == "USE":
@@ -183,6 +183,8 @@ class GQLParser:
         if tt == "SHOW":
             return self._show()
         if tt == "GET":
+            if self._peek(1).type == "SUBGRAPH":
+                return self._get_subgraph()
             return self._configs_get()
         if tt == "BALANCE":
             return self._balance()
@@ -224,6 +226,94 @@ class GQLParser:
         where = self._opt_where()
         yld = self._opt_yield()
         return ast.GoSentence(step, from_, over, where, yld)
+
+    def _lookup(self) -> ast.LookupSentence:
+        self._expect("LOOKUP")
+        self._expect("ON")
+        name = self._ident("tag or edge name")
+        where = self._opt_where()
+        yld = self._opt_yield()
+        return ast.LookupSentence(name, where, yld)
+
+    def _get_subgraph(self) -> ast.GetSubgraphSentence:
+        self._expect("GET")
+        self._expect("SUBGRAPH")
+        step = ast.StepClause(1)
+        if self._at(T_INT):
+            n = self._expect(T_INT).value
+            self._expect("STEPS", "STEP")
+            step = ast.StepClause(n)
+        self._expect("FROM")
+        from_ = self._vertex_ref()
+        # no OVER = every edge type (outbound; REVERSELY/BIDIRECT opt in)
+        over = ast.OverClause(is_all=True)
+        if self._at("OVER"):
+            over = self._over_clause()
+        return ast.GetSubgraphSentence(step, from_, over)
+
+    def _match(self) -> ast.MatchSentence:
+        # try the supported subset; anything else keeps the reference's
+        # grammar-level-stub behavior (parses, executor reports
+        # unsupported)
+        start = self.i
+        try:
+            return self._match_structured()
+        except ParseError:
+            self.i = start
+            return ast.MatchSentence(self._swallow_to_stmt_boundary())
+
+    def _match_structured(self) -> ast.MatchSentence:
+        start = self.i
+        self._expect("MATCH")
+        self._expect("(")
+        src_alias = self._ident("node alias")
+        self._expect(":")
+        tag = self._ident("tag name")
+        self._expect("{")
+        prop = self._ident("property name")
+        self._expect(":")
+        value = self._expression()
+        self._expect("}")
+        self._expect(")")
+        self._expect("-")
+        self._expect("[")
+        edge_alias = None
+        edge_names: List[str] = []
+        min_hops = max_hops = 1
+        if self._at(T_ID):
+            edge_alias = self._ident()
+        if self._accept(":"):
+            edge_names.append(self._ident("edge name"))
+            while self._accept("|"):
+                self._accept(":")       # both [:a|b] and [:a|:b] forms
+                edge_names.append(self._ident("edge name"))
+        if self._at("*"):
+            min_hops, max_hops = self._match_range()
+        self._expect("]")
+        self._expect("->")
+        self._expect("(")
+        dst_alias = self._ident() if self._at(T_ID) else None
+        self._expect(")")
+        self._expect("RETURN")
+        cols = [self._yield_column()]
+        while self._accept(","):
+            cols.append(self._yield_column())
+        raw = " ".join(str(t.value) if t.value is not None else t.type
+                       for t in self.toks[start:self.i])
+        pat = ast.MatchPattern(src_alias, tag, prop, value, edge_alias,
+                               edge_names, min_hops, max_hops, dst_alias)
+        return ast.MatchSentence(raw, pattern=pat,
+                                 return_=ast.YieldClause(cols))
+
+    def _match_range(self) -> Tuple[int, int]:
+        self._expect("*")
+        lo = self._expect(T_INT).value
+        if not self._accept(".."):      # "*k" fixed-length form
+            return lo, lo
+        hi = self._expect(T_INT).value
+        if lo < 1 or hi < lo:
+            raise ParseError("bad hop range", self._peek())
+        return lo, hi
 
     def _swallow_to_stmt_boundary(self) -> str:
         """Consume tokens up to the next statement boundary (`;`, `|`,
@@ -453,6 +543,20 @@ class GQLParser:
                         raise ParseError(f"unknown space option {opt}")
                     self._accept(",")
             return ast.CreateSpaceSentence(name, part_num, replica, ine)
+        if self._at("TAG", "EDGE") and self._peek(1).type == "INDEX":
+            is_edge = self._expect("TAG", "EDGE").type == "EDGE"
+            self._expect("INDEX")
+            ine = self._if_not_exists()
+            name = self._ident("index name")
+            self._expect("ON")
+            schema_name = self._ident("tag or edge name")
+            self._expect("(")
+            fields = [self._ident("field name")]
+            while self._accept(","):
+                fields.append(self._ident("field name"))
+            self._expect(")")
+            return ast.CreateIndexSentence(is_edge, name, schema_name,
+                                           fields, ine)
         if self._at("TAG", "EDGE"):
             is_edge = self._expect("TAG", "EDGE").type == "EDGE"
             ine = self._if_not_exists()
@@ -509,6 +613,11 @@ class GQLParser:
         if self._accept("SPACE"):
             ie = self._if_exists()
             return ast.DropSpaceSentence(self._ident(), ie)
+        if self._at("TAG", "EDGE") and self._peek(1).type == "INDEX":
+            is_edge = self._expect("TAG", "EDGE").type == "EDGE"
+            self._expect("INDEX")
+            ie = self._if_exists()
+            return ast.DropIndexSentence(is_edge, self._ident("index name"), ie)
         if self._at("TAG", "EDGE"):
             is_edge = self._expect("TAG", "EDGE").type == "EDGE"
             ie = self._if_exists()
@@ -706,6 +815,11 @@ class GQLParser:
         if self._at(T_ID) and self._peek().value.lower() == "consistency":
             self.i += 1
             return ast.ShowSentence(ast.ShowKind.CONSISTENCY)
+        if self._at("TAG", "EDGE") and self._peek(1).type == "INDEXES":
+            is_edge = self._expect("TAG", "EDGE").type == "EDGE"
+            self._expect("INDEXES")
+            return ast.ShowSentence(ast.ShowKind.EDGE_INDEXES if is_edge
+                                    else ast.ShowKind.TAG_INDEXES)
         t = self._expect("SPACES", "TAGS", "EDGES", "HOSTS", "PARTS", "USERS",
                          "ROLES", "VARIABLES", "SNAPSHOTS")
         arg = None

@@ -63,7 +63,9 @@ def _register_defaults() -> None:
              st.ScanPartResponse,
              # storaged-tier device serving (storage/device_serve.py)
              st.DeviceWindowRequest, st.DevicePartResult,
-             st.DeviceWindowResponse)
+             st.DeviceWindowResponse,
+             # LOOKUP index scans (storage/processors.py lookup_scan)
+             st.LookupRequest, st.LookupRow, st.LookupResponse)
 
 
 def _zigzag(n: int) -> int:

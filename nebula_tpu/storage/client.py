@@ -26,7 +26,8 @@ from ..common.tracing import tracer
 from ..meta.schema_manager import SchemaManager
 from .types import (BoundRequest, BoundResponse, DevicePartResult,
                     DeviceWindowRequest, DeviceWindowResponse, EdgeData,
-                    EdgeKey, ExecResponse, NewEdge, NewVertex, PartResult,
+                    EdgeKey, ExecResponse, LookupRequest, LookupResponse,
+                    NewEdge, NewVertex, PartResult,
                     PropsResponse, StatDef, StatsResponse, UpdateItemReq,
                     UpdateResponse, VertexData)
 
@@ -305,6 +306,24 @@ class StorageClient:
             acc.latency_us = max(acc.latency_us, part_resp.latency_us)
 
         return self._fanout(space_id, parts, call, BoundResponse(), merge)
+
+    def lookup_scan(self, space_id: int, is_edge: bool, schema_id: int,
+                    filter_bytes: Optional[bytes] = None) -> LookupResponse:
+        """LOOKUP's CPU scan: fan the whole part range out (no vid
+        routing — every part owns candidate rows) and gather matches."""
+        parts = {p: True for p in range(1, self.sm.num_parts(space_id) + 1)}
+
+        def call(svc, host_parts):
+            return svc.lookup_scan(LookupRequest(
+                space_id=space_id, parts=host_parts, is_edge=is_edge,
+                schema_id=schema_id, filter=filter_bytes))
+
+        def merge(acc: LookupResponse, part_resp: LookupResponse):
+            acc.results.update(part_resp.results)
+            acc.rows.extend(part_resp.rows)
+            acc.latency_us = max(acc.latency_us, part_resp.latency_us)
+
+        return self._fanout(space_id, parts, call, LookupResponse(), merge)
 
     def device_window(self, space_id: int, vids: List[int],
                       edge_types: List[int],

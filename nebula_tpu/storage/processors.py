@@ -50,7 +50,8 @@ from ..common.stats import stats
 from ..common.tracing import ActiveQueryRegistry, SlowQueryLog, tracer
 from .types import (BoundRequest, BoundResponse, DevicePartResult,
                     DeviceWindowRequest, DeviceWindowResponse, EdgeData,
-                    EdgeKey, ExecResponse, NewEdge, NewVertex, PartResult,
+                    EdgeKey, ExecResponse, LookupRequest, LookupResponse,
+                    LookupRow, NewEdge, NewVertex, PartResult,
                     PropsResponse, StatDef, StatsResponse, UpdateItemReq,
                     UpdateResponse, VertexData)
 
@@ -613,6 +614,95 @@ class StorageService:
                     break
             resp.results[part] = PartResult()
         return resp
+
+    # ------------------------------------------------------------------
+    # lookup_scan — the LOOKUP identity twin (ref role: the storage
+    # index scans under storage/index/LookUpIndexProcessor): full part
+    # scan over one schema, newest row per entity, WHERE evaluated per
+    # row. The device secondary index (engine_tpu/index.py) must be
+    # byte-identical to this path; anything the device declines lands
+    # here.
+    # ------------------------------------------------------------------
+    def lookup_scan(self, req: LookupRequest) -> LookupResponse:
+        desc = (f"lookup_scan space={req.space_id} parts={len(req.parts)} "
+                f"{'edge' if req.is_edge else 'tag'}={req.schema_id}")
+        tok = self.active_ops.register(desc)
+        try:
+            with tracer.span("proc.lookup_scan", parts=len(req.parts),
+                             host=self.host):
+                t0 = time.monotonic()
+                stats.add_value("storage.lookup_scan_qps", kind="counter")
+                resp = LookupResponse()
+                flt = decode_expression(req.filter) if req.filter else None
+                for part in req.parts:
+                    self._lookup_scan_part(req, part, flt, resp)
+                resp.latency_us = int((time.monotonic() - t0) * 1e6)
+                stats.add_value("storage.lookup_scan_latency_us",
+                                resp.latency_us, kind="histogram")
+                return resp
+        finally:
+            self._finish_op(tok, desc)
+
+    def _lookup_scan_part(self, req: LookupRequest, part: int, flt,
+                          resp: LookupResponse) -> None:
+        pr = self.store.part(req.space_id, part)
+        if not pr.ok():
+            resp.results[part] = PartResult(pr.status.code,
+                                            pr.status.msg or None)
+            return
+        engine = pr.value().engine
+        space = req.space_id
+        name = (self.sm.edge_name(space, req.schema_id) if req.is_edge
+                else self.sm.tag_name(space, req.schema_id)) or ""
+        ectx = _StorageExprContext(self.sm, space)
+        ectx.edge_name = name
+        kind = ku.KIND_EDGE if req.is_edge else ku.KIND_VERTEX
+        rows_scanned = 0
+        bytes_returned = 0
+        last = None
+        for k, v in engine.prefix(ku.part_data_prefix(part, kind)):
+            rows_scanned += 1
+            if req.is_edge:
+                _, src, et, rank, dst, _ = ku.parse_edge_key(k)
+                if et != req.schema_id:
+                    continue
+                ent = (src, et, rank, dst)
+            else:
+                _, vid, tag_id, _ = ku.parse_vertex_key(k)
+                if tag_id != req.schema_id:
+                    continue
+                ent = vid
+            if ent == last:
+                continue        # older version of the same entity
+            last = ent
+            if not v:
+                continue        # tombstone hides every older version
+            row = self._decode_row(
+                self.sm.edge_schema if req.is_edge else self.sm.tag_schema,
+                space, req.schema_id, v)
+            if row is None:
+                continue        # TTL-expired / undecodable
+            if flt is not None:
+                ectx.edge_props = row
+                if req.is_edge:
+                    ectx.src, ectx.rank, ectx.dst = src, rank, dst
+                try:
+                    if not flt.eval(ectx):
+                        continue
+                except EvalError:
+                    continue    # same row-drop rule as get_bound
+            if req.is_edge:
+                resp.rows.append(LookupRow(src=src, rank=rank, dst=dst,
+                                           props=row))
+            else:
+                resp.rows.append(LookupRow(vid=ent, props=row))
+            bytes_returned += len(v)
+        resp.results[part] = PartResult()
+        ledger.charge_host(self.host, rows_scanned=rows_scanned,
+                           bytes_returned=bytes_returned)
+        heat.accountant.charge(space, part, reads=1,
+                               rows_scanned=rows_scanned,
+                               bytes_returned=bytes_returned)
 
     def get_edge_keys(self, space_id: int, part: int,
                       vid: int) -> Tuple[PartResult, List[EdgeKey]]:

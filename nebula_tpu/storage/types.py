@@ -77,6 +77,38 @@ class BoundResponse:
 
 
 @dataclass
+class LookupRequest:
+    """LOOKUP scan over whole parts (ref role: the storage-side
+    LookUpIndexProcessor) — the CPU identity twin of the device
+    secondary-index search. The filter is the full encoded WHERE; the
+    processor evaluates it per row (bare prop refs bind to the scanned
+    schema's row)."""
+    space_id: int
+    parts: Dict[int, bool]            # part -> unused payload (fanout shape)
+    is_edge: bool
+    schema_id: int                    # tag_id, or positive edge type
+    filter: Optional[bytes] = None    # encoded Expression; None = match all
+
+
+@dataclass
+class LookupRow:
+    """One LOOKUP match: vid (tag form) or (src, rank, dst) (edge form),
+    plus the matched row's decoded props."""
+    vid: int = 0
+    src: int = 0
+    rank: int = 0
+    dst: int = 0
+    props: Dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class LookupResponse:
+    results: Dict[int, PartResult] = field(default_factory=dict)
+    rows: List[LookupRow] = field(default_factory=list)
+    latency_us: int = 0
+
+
+@dataclass
 class DeviceWindowRequest:
     """One hop of a graphd scatter/gather-v2 window, served from the
     receiving storaged's LOCAL device shard (storage/device_serve.py)

@@ -1,0 +1,452 @@
+"""Device secondary-index subsystem tests (ISSUE 17; engine_tpu/
+index.py, docs/manual/16-indexes.md): DDL through the metad catalog
+(including a metad restart round-trip), LOOKUP / GET SUBGRAPH / MATCH
+byte-identity between the device sorted-array path and the storaged
+CPU-scan twin (narrow, forced-wide and meshed builds), the
+write-invalidates-index regression, fault degradation through the
+"index" breaker (device failure NEVER reaches a client), and
+shadow-read sampling of the new verbs."""
+import time
+
+import pytest
+
+from nba_fixture import load_nba
+from nebula_tpu.cluster import InProcCluster
+from nebula_tpu.common import consistency as cons
+from nebula_tpu.common.faults import faults
+from nebula_tpu.common.flags import graph_flags
+from nebula_tpu.common.status import ErrorCode
+from nebula_tpu.engine_tpu import TpuGraphEngine, csr
+from nebula_tpu.engine_tpu import distributed as dist
+from nebula_tpu.parser import GQLParser, ast
+
+
+def _drain_engine(tpu):
+    for t in list(tpu._prewarm_threads.values()):
+        t.join(timeout=300)
+    for _ in range(600):
+        if not tpu._recalibrating:
+            return
+        time.sleep(0.05)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+INDEX_DDL = [
+    "CREATE TAG INDEX player_age ON player(age)",
+    "CREATE TAG INDEX player_name ON player(name)",
+    "CREATE EDGE INDEX serve_start ON serve(start_year)",
+]
+
+# every index-verb shape in one sweep: range + equality LOOKUP over
+# int and string props (dict-coded on device), reversed operands,
+# no-yield and aliased yields, an edge LOOKUP (storaged scan on both
+# pipes), bounded subgraph expansions, and the supported MATCH subset
+LOOKUP_SUITE = [
+    "LOOKUP ON player WHERE player.age > 33 "
+    "YIELD player.name, player.age",
+    "LOOKUP ON player WHERE player.age >= 36 YIELD player.age",
+    "LOOKUP ON player WHERE player.age < 30 YIELD player.name AS n",
+    "LOOKUP ON player WHERE player.age <= 27",
+    "LOOKUP ON player WHERE player.age == 32 YIELD player.name",
+    "LOOKUP ON player WHERE 36 <= player.age YIELD player.age AS a",
+    'LOOKUP ON player WHERE player.name == "Tim Duncan" '
+    "YIELD player.age",
+    "LOOKUP ON serve WHERE serve.start_year >= 2000 "
+    "YIELD serve.start_year",
+]
+SUBGRAPH_SUITE = [
+    "GET SUBGRAPH FROM 100",
+    "GET SUBGRAPH 2 STEPS FROM 100 OVER like",
+    "GET SUBGRAPH 3 STEPS FROM 100, 101 OVER like, serve",
+    "GET SUBGRAPH 2 STEPS FROM 121",
+]
+MATCH_SUITE = [
+    'MATCH (a:player {name: "Tim Duncan"})-[e:like]->(b) RETURN a, b',
+    "MATCH (a:player {age: 36})-[e*1..2]->(b) RETURN a.name, b",
+    "MATCH (a:player {age: 33})-[e:like|:serve*2]->(b) RETURN a, b",
+]
+
+
+def _suite(conn, queries):
+    return {q: sorted(map(repr, conn.must(q).rows)) for q in queries}
+
+
+@pytest.fixture(scope="module")
+def pair():
+    """CPU-only and TPU clusters over identical NBA data, indexes
+    created on both (read-only: mutation tests build their own)."""
+    _, cpu_conn = load_nba(space="idxcpu")
+    for q in INDEX_DDL:
+        cpu_conn.must(q)
+    tpu = TpuGraphEngine()
+    cluster = InProcCluster(tpu_engine=tpu)
+    _, conn = load_nba(cluster, space="idxtpu")
+    for q in INDEX_DDL:
+        conn.must(q)
+    sid = cluster.meta.get_space("idxtpu").value().space_id
+    tpu.prewarm(sid, block=True)
+    yield cpu_conn, conn, tpu, cluster
+    _drain_engine(tpu)
+
+
+# ---------------------------------------------------------------------------
+# parser round-trips
+# ---------------------------------------------------------------------------
+
+def parse1(text):
+    seq = GQLParser().parse(text)
+    assert len(seq.sentences) == 1
+    return seq.sentences[0]
+
+
+def test_parse_lookup_roundtrip():
+    s = parse1("LOOKUP ON player WHERE player.age > 33 "
+               "YIELD player.name AS n, player.age")
+    assert isinstance(s, ast.LookupSentence)
+    assert s.on_name == "player"
+    assert s.where is not None and s.yield_ is not None
+    assert "LOOKUP ON player" in s.to_string()
+
+
+def test_parse_get_subgraph_roundtrip():
+    s = parse1("GET SUBGRAPH 3 STEPS FROM 100, 101 OVER like, serve")
+    assert isinstance(s, ast.GetSubgraphSentence)
+    assert s.step.steps == 3
+    assert [v.to_string() for v in s.from_.vids] == ["100", "101"]
+    assert [e.name for e in s.over.edges] == ["like", "serve"]
+    s2 = parse1("GET SUBGRAPH FROM 7")
+    assert s2.step.steps == 1 and s2.over.is_all
+
+
+def test_parse_match_structured_subset():
+    s = parse1('MATCH (a:player {name: "x"})-[e:like*1..3]->(b) '
+               "RETURN a, b.name")
+    assert isinstance(s, ast.MatchSentence)
+    p = s.pattern
+    assert p is not None
+    assert (p.src_alias, p.tag, p.prop) == ("a", "player", "name")
+    assert p.edge_names == ["like"]
+    assert (p.min_hops, p.max_hops) == (1, 3)
+    assert p.dst_alias == "b"
+    assert len(s.return_.columns) == 2
+
+
+def test_parse_match_unsupported_keeps_raw():
+    s = parse1("MATCH (a)-[e]->(b) WHERE a.x > 1 RETURN a")
+    assert isinstance(s, ast.MatchSentence)
+    assert s.pattern is None      # grammar-level stub: parses, raw
+
+
+def test_parse_index_ddl():
+    s = parse1("CREATE TAG INDEX pa ON player(age)")
+    assert isinstance(s, ast.CreateIndexSentence)
+    assert (s.is_edge, s.name, s.schema_name, s.fields) == \
+        (False, "pa", "player", ["age"])
+    s = parse1("CREATE EDGE INDEX IF NOT EXISTS sl ON serve"
+               "(start_year, end_year)")
+    assert s.is_edge and s.if_not_exists
+    assert s.fields == ["start_year", "end_year"]
+    s = parse1("DROP TAG INDEX IF EXISTS pa")
+    assert isinstance(s, ast.DropIndexSentence)
+    assert not s.is_edge and s.if_exists and s.name == "pa"
+
+
+# ---------------------------------------------------------------------------
+# DDL through the metad catalog
+# ---------------------------------------------------------------------------
+
+def test_ddl_show_create_drop():
+    tpu = TpuGraphEngine()
+    cluster = InProcCluster(tpu_engine=tpu)
+    _, conn = load_nba(cluster, space="idxddl")
+    conn.must("CREATE TAG INDEX pa ON player(age)")
+    conn.must("CREATE EDGE INDEX sl ON serve(start_year)")
+    rows = conn.must("SHOW TAG INDEXES").rows
+    assert [(r[1], r[2], r[3]) for r in rows] == \
+        [("pa", "player", "age")]
+    erows = conn.must("SHOW EDGE INDEXES").rows
+    assert [(r[1], r[2], r[3]) for r in erows] == \
+        [("sl", "serve", "start_year")]
+
+    assert conn.execute("CREATE TAG INDEX pa ON player(age)").code \
+        == ErrorCode.E_EXISTED
+    conn.must("CREATE TAG INDEX IF NOT EXISTS pa ON player(age)")
+    assert not conn.execute(
+        "CREATE TAG INDEX bad ON player(nope)").ok()
+    assert conn.execute(
+        "CREATE TAG INDEX bad ON ghost(age)").code \
+        == ErrorCode.E_TAG_NOT_FOUND
+
+    conn.must("DROP TAG INDEX pa")
+    assert conn.must("SHOW TAG INDEXES").rows == []
+    assert not conn.execute("DROP TAG INDEX pa").ok()
+    conn.must("DROP TAG INDEX IF EXISTS pa")
+    _drain_engine(tpu)
+
+
+def test_ddl_survives_metad_restart():
+    """The catalog rides the meta KV: a fresh MetaService over the
+    same store (same-dir metad restart) sees identical descriptors."""
+    from nebula_tpu.meta.service import MetaService
+    cluster, conn = load_nba(space="idxmeta")
+    conn.must("CREATE TAG INDEX pa ON player(age)")
+    conn.must("CREATE EDGE INDEX sl ON serve(start_year)")
+    sid = cluster.meta.get_space("idxmeta").value().space_id
+    before = sorted(cluster.meta.list_indexes(sid),
+                    key=lambda d: d["index_id"])
+    assert [d["name"] for d in before] == ["pa", "sl"]
+    restarted = MetaService(store=cluster.meta._store)
+    after = sorted(restarted.list_indexes(sid),
+                   key=lambda d: d["index_id"])
+    assert after == before
+
+
+def test_lookup_without_index_is_client_error(pair):
+    cpu_conn, conn, _, _ = pair
+    q = 'LOOKUP ON team WHERE team.name == "Spurs"'
+    for c in (cpu_conn, conn):
+        r = c.execute(q)
+        assert r.code == ErrorCode.E_INDEX_NOT_FOUND, r.error_msg
+
+
+# ---------------------------------------------------------------------------
+# per-verb TPU-vs-CPU byte identity
+# ---------------------------------------------------------------------------
+
+def test_lookup_identity(pair):
+    cpu_conn, conn, tpu, _ = pair
+    assert _suite(conn, LOOKUP_SUITE) == _suite(cpu_conn, LOOKUP_SUITE)
+    # tag lookups genuinely rode the device index, not a fallback tie
+    assert tpu.stats["lookup_served"] > 0
+    assert tpu.stats["index_builds"] > 0
+    assert tpu.stats["index_hits"] > 0
+
+
+def test_subgraph_identity(pair):
+    cpu_conn, conn, tpu, _ = pair
+    assert _suite(conn, SUBGRAPH_SUITE) == \
+        _suite(cpu_conn, SUBGRAPH_SUITE)
+    assert tpu.stats["subgraph_served"] > 0
+
+
+def test_match_identity(pair):
+    cpu_conn, conn, _, _ = pair
+    assert _suite(conn, MATCH_SUITE) == _suite(cpu_conn, MATCH_SUITE)
+
+
+def test_lookup_rows_shape(pair):
+    """Headers + row ordering are part of the identity contract:
+    VertexID first, rows sorted by vid, yields in YIELD order."""
+    _, conn, _, _ = pair
+    r = conn.must("LOOKUP ON player WHERE player.age >= 36 "
+                  "YIELD player.name, player.age")
+    assert r.columns == ["VertexID", "player.name", "player.age"]
+    vids = [row[0] for row in r.rows]
+    assert vids == sorted(vids)
+    assert [100, "Tim Duncan", 42] in r.rows
+
+
+def test_subgraph_rows_shape(pair):
+    _, conn, _, _ = pair
+    r = conn.must("GET SUBGRAPH 2 STEPS FROM 100 OVER like")
+    assert r.columns == ["Step", "SrcVID", "EdgeName", "Ranking",
+                         "DstVID"]
+    steps = sorted({row[0] for row in r.rows})
+    assert steps == [1, 2]
+    assert all(row[2] == "like" for row in r.rows)
+
+
+def test_wide_csr_lookup_identity():
+    """NEBULA_TPU_WIDE_CSR=1 (forced int32 packing): the index rides
+    the same per-snapshot columns, so the whole verb suite must stay
+    identical to the device's own CPU twin."""
+    old = csr.FORCE_WIDE_DTYPES
+    csr.FORCE_WIDE_DTYPES = True
+    try:
+        tpu = TpuGraphEngine()
+        cluster = InProcCluster(tpu_engine=tpu)
+        _, conn = load_nba(cluster, space="idxwide")
+        for q in INDEX_DDL:
+            conn.must(q)
+        sid = cluster.meta.get_space("idxwide").value().space_id
+        tpu.prewarm(sid, block=True)
+        queries = LOOKUP_SUITE + SUBGRAPH_SUITE
+        dev = _suite(conn, queries)
+        tpu.enabled = False
+        try:
+            ref = _suite(conn, queries)
+        finally:
+            tpu.enabled = True
+        assert dev == ref
+        assert tpu.stats["lookup_served"] > 0
+        assert tpu.stats["subgraph_served"] > 0
+    finally:
+        csr.FORCE_WIDE_DTYPES = old
+    _drain_engine(tpu)
+
+
+def test_meshed_lookup_subgraph_identity():
+    """Meshed/sharded snapshots: LOOKUP serves off the host columns'
+    sorted arrays and GET SUBGRAPH through the sharded kernel — both
+    byte-identical to a plain CPU cluster."""
+    _, cpu_conn = load_nba(space="idxmcpu", parts=8)
+    for q in INDEX_DDL:
+        cpu_conn.must(q)
+    tpu = TpuGraphEngine(mesh=dist.make_mesh())
+    cluster = InProcCluster(tpu_engine=tpu)
+    _, conn = load_nba(cluster, space="idxmtpu", parts=8)
+    for q in INDEX_DDL:
+        conn.must(q)
+    try:
+        sid = cluster.meta.get_space("idxmtpu").value().space_id
+        tpu.prewarm(sid, block=True)
+        assert tpu.snapshot(sid).sharded_kernel is not None
+        queries = LOOKUP_SUITE + SUBGRAPH_SUITE
+        assert _suite(conn, queries) == _suite(cpu_conn, queries)
+        assert tpu.stats["lookup_served"] > 0
+        assert tpu.stats["subgraph_served"] > 0
+    finally:
+        _drain_engine(tpu)
+
+
+# ---------------------------------------------------------------------------
+# write invalidation
+# ---------------------------------------------------------------------------
+
+def test_write_invalidates_index():
+    """INSERT between two identical LOOKUPs: the sorted arrays drop
+    (counted), the rebuild includes the new vertex, and the device
+    result stays identical to the CPU scan."""
+    tpu = TpuGraphEngine()
+    cluster = InProcCluster(tpu_engine=tpu)
+    _, conn = load_nba(cluster, space="idxwrite")
+    conn.must("CREATE TAG INDEX pa ON player(age)")
+    sid = cluster.meta.get_space("idxwrite").value().space_id
+    tpu.prewarm(sid, block=True)
+    q = "LOOKUP ON player WHERE player.age == 97 YIELD player.age"
+    assert conn.must(q).rows == []
+    inv0 = tpu.index_stats()["invalidations"]
+    conn.must('INSERT VERTEX player(name, age) VALUES '
+              '999888:("Old Man", 97)')
+    after = conn.must(q).rows
+    tpu.enabled = False
+    try:
+        cpu_after = conn.must(q).rows
+    finally:
+        tpu.enabled = True
+    assert after == cpu_after == [[999888, 97]]
+    assert tpu.index_stats()["invalidations"] > inv0
+    # the rebuilt index (not a decline) served the post-write query
+    assert tpu.stats["lookup_served"] >= 2
+    _drain_engine(tpu)
+
+
+# ---------------------------------------------------------------------------
+# fault degradation (common/faults.py index.build / index.search)
+# ---------------------------------------------------------------------------
+
+def _fault_cluster(space):
+    tpu = TpuGraphEngine()
+    tpu.breaker_threshold = 2
+    tpu.breaker_base_s = 0.1
+    tpu.breaker_max_s = 0.5
+    cluster = InProcCluster(tpu_engine=tpu)
+    _, conn = load_nba(cluster, space=space)
+    conn.must("CREATE TAG INDEX pa ON player(age)")
+    sid = cluster.meta.get_space(space).value().space_id
+    tpu.prewarm(sid, block=True)
+    return tpu, conn
+
+
+def test_index_search_fault_degrades_then_recovers():
+    """index.search faults: every LOOKUP still succeeds with rows
+    identical to the CPU scan (never a client error), the "index"
+    breaker trips, and a half-open probe re-admits the device."""
+    tpu, conn = _fault_cluster("idxflt1")
+    q = LOOKUP_SUITE[0]
+    ref = sorted(map(repr, conn.must(q).rows))
+    served0 = tpu.stats["lookup_served"]
+    trips0 = tpu.stats["breaker_trips"]
+    faults.set_plan("index.search:p=1")
+    try:
+        for _ in range(5):
+            tpu.result_cache.clear()
+            r = conn.execute(q)
+            assert r.ok(), r.error_msg
+            assert sorted(map(repr, r.rows)) == ref
+    finally:
+        faults.clear()
+    assert tpu.stats["breaker_trips"] > trips0
+    assert tpu.stats["lookup_served"] == served0   # all degraded
+    deadline = time.time() + 30
+    recovered = False
+    while time.time() < deadline:
+        tpu.result_cache.clear()
+        conn.must(q)
+        if tpu.stats["lookup_served"] > served0:
+            recovered = True
+            break
+        time.sleep(0.05)
+    assert recovered, tpu.breaker_states()
+    _drain_engine(tpu)
+
+
+def test_index_build_fault_degrades_to_scan():
+    """A failing index BUILD never surfaces: the engine declines and
+    the storaged scan serves identical rows."""
+    tpu, conn = _fault_cluster("idxflt2")
+    q = "LOOKUP ON player WHERE player.age > 40 YIELD player.name"
+    tpu.enabled = False
+    try:
+        ref = sorted(map(repr, conn.must(q).rows))
+    finally:
+        tpu.enabled = True
+    # drop the prebuilt arrays so the next serve must rebuild —
+    # straight into the armed build fault
+    for snap in list(tpu._snapshots.values()):
+        tpu._invalidate_prop_indexes(snap)
+    faults.set_plan("index.build:p=1")
+    try:
+        tpu.result_cache.clear()
+        r = conn.execute(q)
+        assert r.ok(), r.error_msg
+        assert sorted(map(repr, r.rows)) == ref
+    finally:
+        faults.clear()
+    _drain_engine(tpu)
+
+
+# ---------------------------------------------------------------------------
+# shadow-read sampling of the new verbs (PR 15 observatory)
+# ---------------------------------------------------------------------------
+
+def test_shadow_samples_lookup_and_subgraph():
+    tpu = TpuGraphEngine()
+    cluster = InProcCluster(tpu_engine=tpu)
+    _, conn = load_nba(cluster, space="idxshadow")
+    conn.must("CREATE TAG INDEX pa ON player(age)")
+    sid = cluster.meta.get_space("idxshadow").value().space_id
+    tpu.prewarm(sid, block=True)
+    cons.shadow.reset()
+    graph_flags.set("shadow_read_rate", 1.0)
+    try:
+        conn.must(LOOKUP_SUITE[0])
+        conn.must("GET SUBGRAPH 2 STEPS FROM 100 OVER like")
+        assert cons.shadow.drain(15)
+        deadline = time.time() + 10
+        while time.time() < deadline and \
+                cons.shadow.stats()["verified"] < 2:
+            time.sleep(0.05)
+        st = cons.shadow.stats()
+        assert st["sampled"] >= 2, st
+        assert st["verified"] >= 2, st
+        assert st["mismatches"] == 0 and st["errors"] == 0, st
+    finally:
+        graph_flags.set("shadow_read_rate", 0.0)
+    _drain_engine(tpu)

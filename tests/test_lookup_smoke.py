@@ -1,0 +1,69 @@
+"""Tier-1-safe index-verb smoke: `bench.py --lookup-smoke` in a
+SUBPROCESS on XLA:CPU (no accelerator, no native engine — same
+isolation pattern as the cache/chaos/mesh smokes). The tier asserts
+the device secondary-index subsystem on one small cluster: the
+LOOKUP / GET SUBGRAPH / MATCH mix SERVES on device (nonzero counters
+in the artifact), every result is BIT-IDENTICAL to the storaged
+CPU-scan twin, a write between identical LOOKUPs INVALIDATES, and
+index.search faults DEGRADE to the scan with breaker recovery
+(docs/manual/16-indexes.md)."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def lookup_smoke(tmp_path_factory):
+    out = tmp_path_factory.mktemp("lookup") / "LOOKUP_smoke.json"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_LOOKUP_OUT"] = str(out)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--lookup-smoke"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, (proc.stdout[-2000:],
+                                  proc.stderr[-2000:])
+    with open(out) as f:
+        return json.load(f)
+
+
+def test_lookup_smoke_device_serves(lookup_smoke):
+    c = lookup_smoke["checks"]
+    assert c["device_served"]
+    assert c["lookup_served"] > 0
+    assert c["subgraph_served"] > 0
+    assert lookup_smoke["index"]["builds"] > 0
+
+
+def test_lookup_smoke_identity(lookup_smoke):
+    c = lookup_smoke["checks"]
+    assert c["identity"] and not lookup_smoke["mismatches"]
+    assert c["nonempty_mix"]
+
+
+def test_lookup_smoke_write_invalidates(lookup_smoke):
+    assert lookup_smoke["checks"]["write_invalidates"]
+    assert lookup_smoke["index"]["invalidations"] > 0
+
+
+def test_lookup_smoke_degrades_and_recovers(lookup_smoke):
+    c = lookup_smoke["checks"]
+    assert c["degrades_to_scan"]
+    assert c["breaker_recovered"]
+
+
+def test_lookup_smoke_perf_recorded(lookup_smoke):
+    perf = lookup_smoke["perf"]
+    for verb in ("lookup", "subgraph", "match"):
+        assert perf[verb]["qps"] > 0
+        assert perf[verb]["p99_ms"] > 0
+
+
+def test_lookup_smoke_overall_ok(lookup_smoke):
+    assert lookup_smoke["ok"] is True

@@ -121,10 +121,15 @@ def test_three_daemon_metrics_conformance():
     at least one histogram carries an exemplar), then strictly parse
     all three expositions."""
     from nebula_tpu.client import GraphClient
+    from nebula_tpu.common.flags import graph_flags
     from nebula_tpu.daemons import (serve_graphd, serve_metad,
                                     serve_storaged)
     from nebula_tpu.engine_tpu import TpuGraphEngine
 
+    # the dispatcher/kernel/materialize histograms this test asserts
+    # populate on the graphd-local fused serve path — pin it (cluster
+    # scatter/gather v2 serves remote-provider GO without them)
+    graph_flags.set("cluster_device_serve", False)
     metad = serve_metad(ws_port=0)
     storaged = serve_storaged(metad.addr, load_interval=0.1, ws_port=0)
     tpu = TpuGraphEngine()
@@ -175,6 +180,7 @@ def test_three_daemon_metrics_conformance():
         assert gfams["nebula_graph_space_om_latency_us"].type \
             == "histogram"
     finally:
+        graph_flags.set("cluster_device_serve", True)
         graphd.stop()
         storaged.stop()
         metad.stop()
@@ -192,10 +198,15 @@ def test_profiling_families_conformance_and_federation():
     import threading as _threading
     from nebula_tpu.client import GraphClient
     from nebula_tpu.common import profiler as _prof
+    from nebula_tpu.common.flags import graph_flags
     from nebula_tpu.daemons import (serve_graphd, serve_metad,
                                     serve_storaged)
     from nebula_tpu.engine_tpu import TpuGraphEngine
 
+    # the device-memory ledger gauges require a graphd-LOCAL snapshot
+    # — pin the dispatcher path (cluster scatter/gather v2 keeps the
+    # CSR on the storaged tier)
+    graph_flags.set("cluster_device_serve", False)
     metad = serve_metad(ws_port=0)
     storaged = serve_storaged(metad.addr, load_interval=0.1, ws_port=0)
     tpu = TpuGraphEngine()
@@ -274,6 +285,7 @@ def test_profiling_families_conformance_and_federation():
             instances = {s.labels.get("instance") for s in counts}
             assert len(instances) == 3, instances
     finally:
+        graph_flags.set("cluster_device_serve", True)
         graphd.stop()
         storaged.stop()
         metad.stop()

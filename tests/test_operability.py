@@ -512,10 +512,16 @@ def test_observability_endpoints_3daemon():
     import json as _json
     import urllib.request
     from nebula_tpu.client import GraphClient
+    from nebula_tpu.common.flags import graph_flags
     from nebula_tpu.common.tracing import tracer
     from nebula_tpu.daemons import serve_graphd, serve_metad, serve_storaged
     from nebula_tpu.engine_tpu import TpuGraphEngine
 
+    # this test is ABOUT the dispatcher-window span tree: pin the
+    # engine to the dispatcher path (cluster scatter/gather v2 serves
+    # plain GO without a graphd-local window — its spans are covered
+    # by test_device_serve)
+    graph_flags.set("cluster_device_serve", False)
     metad = serve_metad()
     storaged = serve_storaged(metad.addr, load_interval=0.1, ws_port=0)
     tpu = TpuGraphEngine()
@@ -624,6 +630,7 @@ def test_observability_endpoints_3daemon():
         # fires on the CPU fan-out path, which the engine avoided)
         assert "nebula_storage_scan_part_qps_total" in stext
     finally:
+        graph_flags.set("cluster_device_serve", True)
         graphd.stop(); storaged.stop(); metad.stop()
 
 
@@ -644,6 +651,10 @@ def test_cost_ledger_and_cluster_metrics_3daemon():
     from nebula_tpu.engine_tpu import TpuGraphEngine
     import openmetrics
 
+    # queue_wait_us is charged by the DISPATCHER; pin the engine to
+    # that path (the cluster scatter/gather serve has no graphd-local
+    # window to queue behind)
+    graph_flags.set("cluster_device_serve", False)
     metad = serve_metad(ws_port=0)
     storaged = serve_storaged(metad.addr, load_interval=0.1, ws_port=0)
     tpu = TpuGraphEngine()
@@ -748,6 +759,7 @@ def test_cost_ledger_and_cluster_metrics_3daemon():
         assert len(insts) == 3 and all(i["up"] for i in insts)
         assert snap.sum("nebula_graph_query_total") > 0
     finally:
+        graph_flags.set("cluster_device_serve", True)
         graphd.stop(); storaged.stop(); metad.stop()
 
 
@@ -761,9 +773,14 @@ def test_profile_endpoints_3daemon():
     import time as _time
     import urllib.request
     from nebula_tpu.client import GraphClient
+    from nebula_tpu.common.flags import graph_flags
     from nebula_tpu.daemons import serve_graphd, serve_metad, serve_storaged
     from nebula_tpu.engine_tpu import TpuGraphEngine
 
+    # the compile table + engine_snapshot lock contention this test
+    # asserts live on the graphd-local fused serve path — pin it (the
+    # cluster scatter/gather serve compiles on the storaged tier)
+    graph_flags.set("cluster_device_serve", False)
     metad = serve_metad(ws_port=0)
     storaged = serve_storaged(metad.addr, load_interval=0.1, ws_port=0)
     tpu = TpuGraphEngine()
@@ -866,6 +883,7 @@ def test_profile_endpoints_3daemon():
         assert st == 200
         assert set(j["threads"]) <= {role}
     finally:
+        graph_flags.set("cluster_device_serve", True)
         graphd.stop(); storaged.stop(); metad.stop()
 
 
