@@ -3616,6 +3616,518 @@ def bench_crash(out_path: str, trim: bool = False):
                 shutil.rmtree(run_dir, ignore_errors=True)
 
 
+def bench_partition(out_path: str, trim: bool = False):
+    """Partition & gray-failure tier (`bench.py --partition`, ISSUE 18;
+    docs/manual/9-robustness.md "Network nemesis"): the same real
+    multi-daemon topology as `--cluster` (metad + 3 replicated storaged
+    + TPU graphd over localhost TCP), but the failures are NETWORK
+    shapes injected by the nemesis into the live transport, not process
+    kills:
+
+      baseline        closed-loop readers + durability-ledger writers;
+      follower_reads  bounded-staleness reads armed (the staleness
+                      bound under test);
+      sym_split       the leader-heaviest storaged fully partitioned
+                      (raft both directions + graphd data inbound) —
+                      failover + peer-health ejection + hedged reads
+                      carry the traffic;
+      follower_fenced a FOLLOWER raft-isolated while its data plane
+                      stays open: the raft read fence must DECLINE its
+                      follower reads (never serve staler than the
+                      bound), observable as fence rejections;
+      gray            one storaged slowed 250ms±100 (data plane only):
+                      hedged reads must win and keep phase p99 within
+                      BENCH_GRAY_FACTOR x baseline;
+      flap            the symmetric split toggled on/off repeatedly;
+      converge        heal everything, then prove: zero acked-write
+                      loss (ledger re-read), zero non-retryable client
+                      errors, zero replica divergence (observatory
+                      armed the whole run), committed ids converged,
+                      served staleness within bound + slack, and the
+                      TPU-vs-CPU identity sweep green with device
+                      serving back on.
+
+    Tier-1-safe on XLA:CPU (`--trim` shrinks the graph and phases for
+    tests/test_partition_smoke.py)."""
+    import random
+    import shutil
+    import tempfile
+    import threading
+
+    from nebula_tpu.client import GraphClient
+    from nebula_tpu.common import consistency as cons
+    from nebula_tpu.common.faults import Nemesis, faults
+    from nebula_tpu.common.flags import graph_flags, storage_flags
+    from nebula_tpu.common.flight import recorder as flight_rec
+    from nebula_tpu.common.lockwitness import witness
+    from nebula_tpu.common.stats import stats as _gstats
+    from nebula_tpu.daemons import (serve_graphd, serve_metad,
+                                    serve_storaged)
+    from nebula_tpu.engine_tpu import TpuGraphEngine
+    from nebula_tpu.meta.net_admin import raft_addr_of
+    from nebula_tpu.tools.crashstorm import RETRYABLE, LedgerWriters
+
+    witness.install()
+
+    v, e, parts, readers_n, phase_s = \
+        (240, 1500, 3, 3, 1.5) if trim else (1200, 9000, 4, 6, 3.0)
+    space = "partb"
+    run_dir = tempfile.mkdtemp(prefix="nebula_tpu_partbench_")
+    gray_factor = float(os.environ.get("BENCH_GRAY_FACTOR", 10.0))
+    fr_bound_ms = int(os.environ.get("BENCH_FOLLOWER_READ_MS", 150))
+    saved = {f: storage_flags.get(f) for f in
+             ("heartbeat_interval_secs", "raft_heartbeat_ms",
+              "raft_election_timeout_ms", "follower_read_max_ms",
+              "consistency_enabled")}
+    saved_g = {f: graph_flags.get(f) for f in
+               ("consistency_enabled", "shadow_read_rate",
+                "storage_client_timeout_ms")}
+    storage_flags.set("heartbeat_interval_secs", 0.4)
+    storage_flags.set("raft_heartbeat_ms", 60)
+    storage_flags.set("raft_election_timeout_ms", 250)
+    # consistency observatory armed for the WHOLE run: every injected
+    # partition must leave replica digests convergent
+    storage_flags.set("consistency_enabled", True)
+    graph_flags.set("consistency_enabled", True)
+    # bounded data-plane timeout so blackholed peers cost ~2s per
+    # attempt, not the 30s default — the gray-hygiene knob under test
+    graph_flags.set("storage_client_timeout_ms", 2000)
+    cons.shadow.reset()
+    metad = storers = graphd = lw = None
+    stop = threading.Event()
+    try:
+        metad = serve_metad(expired_threshold_secs=5)
+        storers = {}
+        for i in range(3):
+            storers[i] = serve_storaged(
+                metad.addr, replicated=True, engine="mem",
+                data_dir=os.path.join(run_dir, f"s{i}"),
+                load_interval=0.15)
+        tpu = TpuGraphEngine()
+        graphd = serve_graphd(metad.addr, tpu_engine=tpu)
+        gc = GraphClient(graphd.addr).connect()
+        client = graphd.engine.client
+
+        rng = np.random.default_rng(int(os.environ.get(
+            "BENCH_PARTITION_SEED", 23)))
+        srcs, dsts, ts = zipf_edges(rng, v, e, clip=100)
+        log(f"partition tier: loading V={v} E={e} parts={parts} rf=3 "
+            f"over 3 storaged + raft-TCP, observatory armed...")
+        insert_person_knows(gc, space, parts, v, srcs, dsts, ts,
+                            replica_factor=3, settle_s=20.0)
+        sid = metad.meta.get_space(space).value().space_id
+        div0 = _gstats.lifetime_total("consistency.divergence")
+        # shadow-read verification sampled throughout: partitions must
+        # never make the serve path LIE, only decline/fail retryably
+        graph_flags.set("shadow_read_rate", 0.05)
+        hubs = [int(x) for x in
+                np.argsort(np.bincount(srcs, minlength=v))[-3:]]
+        queries = [
+            f"GO 2 STEPS FROM {hubs[0]} OVER knows YIELD knows._dst",
+            f"GO 2 STEPS FROM {hubs[1]} OVER knows "
+            f"WHERE knows.ts > {TS_MAX // 2} "
+            f"YIELD knows._dst, knows.ts",
+            f"GO FROM {hubs[0]}, {hubs[2]} OVER knows "
+            f"YIELD knows._dst, knows.ts",
+        ]
+        for q in queries:
+            gc.must(q)               # compile + snapshot warm
+
+        # ---- traffic: closed-loop readers (RETRYABLE-tolerant — the
+        # contract is zero NON-retryable errors) + ledger writers
+        pause = threading.Event()
+        phase_box = {"name": None}
+        lock = threading.Lock()
+        lats: list = []
+        errors: list = []            # non-retryable / budget-exhausted
+        read_retries = [0]
+        paused_flags = [threading.Event() for _ in range(readers_n)]
+
+        def reader(k):
+            rr = random.Random(1000 + k)
+            c = GraphClient(graphd.addr).connect()
+            c.must(f"USE {space}")
+            while not stop.is_set():
+                if pause.is_set():
+                    paused_flags[k].set()
+                    time.sleep(0.02)
+                    continue
+                paused_flags[k].clear()
+                q = queries[rr.randrange(len(queries))]
+                t0 = time.monotonic()
+                r = c.execute(q)
+                n_retry = 0
+                while (not r.ok() and r.code in RETRYABLE
+                       and n_retry < 8 and not stop.is_set()):
+                    n_retry += 1
+                    time.sleep(min(0.05 * n_retry, 0.4))
+                    r = c.execute(q)
+                ms = (time.monotonic() - t0) * 1000
+                ph = phase_box["name"]
+                with lock:
+                    read_retries[0] += n_retry
+                    if not r.ok():
+                        errors.append((ph, q, f"{r.code}: {r.error_msg}"))
+                    elif ph:
+                        lats.append((ph, ms))
+
+        lw = LedgerWriters(graphd.addr, space, v, n_writers=2,
+                           pace_s=0.012).start()
+        threads = [threading.Thread(target=reader, args=(k,),
+                                    daemon=True)
+                   for k in range(readers_n)]
+        for t in threads:
+            t.start()
+
+        def quiesce():
+            pause.set()
+            lw.quiesce()
+            deadline = time.time() + 15
+            while time.time() < deadline and \
+                    not all(f.is_set() for f in paused_flags):
+                time.sleep(0.02)
+            deadline = time.time() + 15
+            while any(tpu._repacking.values()) and \
+                    time.time() < deadline:
+                time.sleep(0.05)
+
+        def resume():
+            for f in paused_flags:
+                f.clear()
+            pause.clear()
+            lw.resume()
+
+        def identity_sweep():
+            ok_all, device = True, False
+            for q in queries:
+                g0 = tpu.stats["go_served"] + tpu.stats["agg_served"]
+                rt = gc.must(q)
+                device |= (tpu.stats["go_served"]
+                           + tpu.stats["agg_served"]) > g0
+                tpu.enabled = False
+                try:
+                    rc = gc.must(q)
+                finally:
+                    tpu.enabled = True
+                if sorted(map(repr, rt.rows)) != \
+                        sorted(map(repr, rc.rows)):
+                    ok_all = False
+            return ok_all, device
+
+        phase_dur: dict = {}
+
+        def run_phase(name, end_fn):
+            phase_box["name"] = name
+            t0 = time.monotonic()
+            end_fn()
+            phase_dur[name] = time.monotonic() - t0
+            phase_box["name"] = None
+
+        def pct(phase):
+            xs = sorted(ms for ph, ms in lats if ph == phase)
+            if not xs:
+                return {"n": 0}
+            dur = max(phase_dur.get(phase, phase_s), 1e-3)
+            return {"n": len(xs),
+                    "p50_ms": round(float(np.percentile(xs, 50)), 2),
+                    "p99_ms": round(float(np.percentile(xs, 99)), 2),
+                    "qps": round(len(xs) / dur, 1),
+                    "wall_s": round(dur, 1)}
+
+        def leader_counts():
+            out = {}
+            for i, h in storers.items():
+                n = 0
+                for p in range(1, parts + 1):
+                    r = h.node.raft(sid, p)
+                    if r is not None and r.is_leader():
+                        n += 1
+                out[i] = n
+            return out
+
+        def fence_rejections():
+            n = 0
+            for h in storers.values():
+                for p in range(1, parts + 1):
+                    r = h.node.raft(sid, p)
+                    if r is not None:
+                        n += (r.follower_read_stats["rejected_stale"]
+                              + r.follower_read_stats["rejected_commit"])
+            return n
+
+        def wait_converged(timeout=30.0):
+            """All three replicas of every part report the same
+            committed id (post-heal catch-up proof)."""
+            deadline = time.time() + timeout
+            while time.time() < deadline:
+                ok = True
+                for p in range(1, parts + 1):
+                    ids = {h.node.raft(sid, p).committed_id
+                           for h in storers.values()
+                           if h.node.raft(sid, p) is not None}
+                    if len(ids) != 1:
+                        ok = False
+                        break
+                if ok:
+                    return True
+                time.sleep(0.1)
+            return False
+
+        nemesis = Nemesis()
+
+        def heal_and_settle(settle_s=1.5):
+            nemesis.heal()
+            deadline = time.time() + 20
+            while sum(leader_counts().values()) < parts and \
+                    time.time() < deadline:
+                time.sleep(0.1)
+            time.sleep(settle_s)
+
+        # ---- phase 1: baseline (leader-only routing)
+        run_phase("baseline", lambda: time.sleep(phase_s))
+
+        # ---- phase 2: arm bounded-staleness follower reads via the
+        # production config path (UPDATE CONFIGS -> meta -> heartbeat)
+        gc.must(f"UPDATE CONFIGS STORAGE:follower_read_max_ms = "
+                f"{fr_bound_ms}")
+        deadline = time.time() + 15
+        while storage_flags.get("follower_read_max_ms") != fr_bound_ms \
+                and time.time() < deadline:
+            time.sleep(0.05)
+        assert storage_flags.get("follower_read_max_ms") == fr_bound_ms
+        run_phase("follower_reads", lambda: time.sleep(phase_s))
+
+        # ---- phase 3: symmetric split — the leader-heaviest storaged
+        # partitioned raft-and-data; failover + ejection + hedges
+        deadline = time.time() + 15
+        counts = leader_counts()
+        while sum(counts.values()) < parts and time.time() < deadline:
+            time.sleep(0.1)
+            counts = leader_counts()
+        victim = max(counts, key=counts.get)
+        v_store = storers[victim].addr
+        v_raft = raft_addr_of(v_store)
+        o_rafts = [raft_addr_of(storers[i].addr)
+                   for i in storers if i != victim]
+        log(f"partition tier: sym-splitting storaged {victim} "
+            f"({v_store}), led {counts[victim]}/{parts} parts")
+        sym_plan = ";".join([
+            Nemesis.symmetric_split([v_raft], o_rafts),
+            f"symdata:peer=*>{v_store},hang=1",
+        ])
+
+        def sym_split():
+            nemesis.apply(sym_plan)
+            time.sleep(phase_s * 2)
+
+        run_phase("sym_split", sym_split)
+        sym_fired = dict(faults.counts())
+        heal_and_settle()
+
+        # ---- phase 4: raft-isolate a FOLLOWER, data plane open — its
+        # fence must decline follower reads rather than serve stale
+        counts = leader_counts()
+        fenced = min(counts, key=counts.get)
+        if fenced == victim and len(storers) > 2:
+            others = sorted(i for i in storers if i != victim)
+            fenced = min(others, key=lambda i: counts[i])
+        f_raft = raft_addr_of(storers[fenced].addr)
+        rej0 = fence_rejections()
+        log(f"partition tier: raft-isolating follower {fenced} "
+            f"({storers[fenced].addr}), data plane open")
+
+        def follower_fence():
+            nemesis.apply(f"fence:peer=*>{f_raft},hang=1;"
+                          f"fence:peer={f_raft}>*,hang=1")
+            time.sleep(phase_s * 2)
+
+        run_phase("follower_fenced", follower_fence)
+        fence_rej = fence_rejections() - rej0
+        heal_and_settle()
+
+        # ---- phase 5: gray node — slow, never erroring; hedged reads
+        # must win and contain p99
+        counts = leader_counts()
+        gray = min(counts, key=counts.get)
+        g_store = storers[gray].addr
+        wins0 = client.hedge_stats["won"]
+        log(f"partition tier: graying storaged {gray} ({g_store}) "
+            f"+250ms±100 data-plane latency")
+
+        def gray_phase():
+            nemesis.apply(Nemesis.slow_node(
+                [g_store], latency_ms=250.0, jitter_ms=100.0))
+            time.sleep(phase_s * 2)
+
+        run_phase("gray", gray_phase)
+        hedge_wins_gray = client.hedge_stats["won"] - wins0
+        heal_and_settle()
+
+        # ---- phase 6: flapping link — the split toggled on/off
+        def flap_phase():
+            nemesis.flap(sym_plan, cycles=3 if trim else 5,
+                         on_s=0.3, off_s=0.3)
+
+        run_phase("flap", flap_phase)
+        heal_and_settle()
+
+        # ---- converge: ledger re-read, divergence, staleness bound,
+        # identity + device serving
+        converged = wait_converged()
+        quiesce()
+        graph_flags.set("shadow_read_rate", 0.0)
+        cons.shadow.drain(20)
+        missing = lw.verify_ledger(gc)
+        identity_ok = device_ok = False
+        deadline = time.time() + (60 if trim else 45)
+        while time.time() < deadline:
+            identity_ok, dev = identity_sweep()
+            if identity_ok and dev:
+                device_ok = True
+                break
+            time.sleep(0.4)
+        resume()
+        stop.set()
+        lw.stop()
+        for t in threads:
+            t.join(timeout=30)
+
+        # follower-read staleness bound: measured max SERVED staleness
+        # across client + hosts vs fence budget + shard slack
+        cdev = dict(client.device_stats)
+        stal = [float(cdev.get("max_staleness_ms", 0.0))]
+        per_host = {}
+        for h in storers.values():
+            mgr = getattr(h, "device_shards", None)
+            if mgr is None:
+                continue
+            per_host[h.addr] = dict(mgr.stats)
+            stal.append(float(mgr.stats.get("max_staleness_ms", 0)))
+        slack = int(storage_flags.get_or("device_shard_max_ms", 250,
+                                         int))
+        max_stal = round(max(stal), 2)
+        divergence = _gstats.lifetime_total(
+            "consistency.divergence") - div0
+        cons_rows = []
+        for h in storers.values():
+            for row in h.node.consistency_status():
+                if row.get("digest_divergent"):
+                    cons_rows.append(row)
+        sh = cons.shadow.stats()
+        flight_triggers = {r["name"]: r["fires"]
+                           for r in flight_rec.describe()["triggers"]
+                           if r["fires"]}
+
+        phases = {ph: pct(ph) for ph in (
+            "baseline", "follower_reads", "sym_split",
+            "follower_fenced", "gray", "flap")}
+        base_p99 = max(phases["baseline"].get("p99_ms") or 1.0, 25.0)
+        gray_p99 = phases["gray"].get("p99_ms") or 0.0
+        rec = {
+            "trim": trim,
+            "graph": {"V": v, "E": e, "partition_num": parts,
+                      "replica_factor": 3},
+            "sessions": {"readers": readers_n, "writers": 2},
+            "phases": phases,
+            "nemesis": {
+                "sym_split_victim": v_store,
+                "fenced_follower": storers[fenced].addr,
+                "gray_node": g_store,
+                "sym_fired": sym_fired,
+                "fired_total": dict(faults.counts()),
+            },
+            "ledger": {**lw.summary(), "missing": len(missing),
+                       "missing_samples": missing[:5]},
+            "client": {
+                "read_errors": errors[:5],
+                "read_error_count": len(errors),
+                "read_retries": read_retries[0],
+                "retry_stats": dict(client.retry_stats),
+                "peer_health": client.peer_health.snapshot(),
+                "hedge": dict(client.hedge_stats),
+            },
+            "gray_slo": {
+                "baseline_p99_ms_floored": base_p99,
+                "gray_p99_ms": gray_p99,
+                "factor": round(gray_p99 / base_p99, 2),
+                "declared_factor": gray_factor,
+                "hedge_wins_in_phase": hedge_wins_gray,
+            },
+            "follower_reads": {
+                "bound_ms": fr_bound_ms,
+                "shard_slack_ms": slack,
+                "max_served_staleness_ms": max_stal,
+                "staleness_bounded": max_stal <= fr_bound_ms + slack,
+                "fence_rejections_while_fenced": fence_rej,
+                "client": cdev,
+                "per_host": per_host,
+            },
+            "consistency": {
+                "divergence": divergence,
+                "divergent_rows": cons_rows[:5],
+                "shadow": {k: sh[k] for k in
+                           ("sampled", "verified", "mismatches")},
+            },
+            "convergence": {"committed_ids_converged": converged,
+                            "identity": identity_ok,
+                            "device_served": device_ok},
+            "flight_triggers": flight_triggers,
+            "lock_witness": _witness_summary(),
+        }
+        ok = (len(missing) == 0                    # no acked-write loss
+              and not errors and not lw.errors     # no non-retryable
+              and divergence == 0 and not cons_rows
+              and sh["sampled"] > 0
+              and sh["mismatches"] == 0            # no replica lies
+              and rec["follower_reads"]["staleness_bounded"]
+              and fence_rej > 0                    # fenced != served
+              and hedge_wins_gray > 0
+              and gray_p99 <= gray_factor * base_p99
+              and converged and identity_ok and device_ok
+              and all(phases[ph]["n"] > 0 for ph in phases)
+              and rec["lock_witness"]["clean"])
+        rec["ok"] = ok
+        with open(out_path, "w") as f:
+            json.dump(rec, f, indent=1)
+        log(f"partition tier: phases={ {p: phases[p].get('p99_ms') for p in phases} } "
+            f"errors={len(errors)} missing={len(missing)} "
+            f"fence_rej={fence_rej} hedge_wins={hedge_wins_gray} "
+            f"-> {out_path}")
+        print(json.dumps({
+            "metric": "partition", "ok": ok,
+            "acked_missing": len(missing),
+            "read_errors": len(errors),
+            "divergence": divergence,
+            "fence_rejections": fence_rej,
+            "gray_p99_factor": rec["gray_slo"]["factor"],
+            "hedge_wins": hedge_wins_gray}))
+        if not ok:
+            raise SystemExit(f"partition tier FAILED: "
+                             f"{json.dumps(rec, indent=1)[:4000]}")
+        return rec
+    finally:
+        stop.set()
+        faults.reset()
+        try:
+            if lw is not None:
+                lw.stop(timeout=10)
+            if graphd is not None:
+                graphd.stop()
+            for h in (storers or {}).values():
+                try:
+                    h.stop()
+                except Exception:
+                    pass
+            if metad is not None:
+                metad.stop()
+        finally:
+            for k, val in saved.items():
+                storage_flags.set(k, val)
+            for k, val in saved_g.items():
+                graph_flags.set(k, val)
+            shutil.rmtree(run_dir, ignore_errors=True)
+
+
 def main():
     if "--tenants" in sys.argv:
         out = os.environ.get("BENCH_TENANTS_OUT", "TENANTS_bench.json")
@@ -3630,6 +4142,14 @@ def main():
             if a.startswith("--out="):
                 out = a.split("=", 1)[1]
         bench_cluster(out, trim="--trim" in sys.argv)
+        return
+    if "--partition" in sys.argv:
+        out = os.environ.get("BENCH_PARTITION_OUT",
+                             "PARTITION_bench.json")
+        for a in sys.argv:
+            if a.startswith("--out="):
+                out = a.split("=", 1)[1]
+        bench_partition(out, trim="--trim" in sys.argv)
         return
     if "--crash" in sys.argv:
         out = os.environ.get("BENCH_CRASH_OUT", "CRASH_bench.json")

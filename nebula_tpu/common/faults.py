@@ -28,6 +28,33 @@ Plan grammar: `point:arg[,arg]...` joined by `;`. Args:
 A bare `seed=<int>` entry reseeds the plan RNG so probabilistic plans
 replay deterministically (the chaos smoke test pins one).
 
+NETWORK NEMESIS (docs/manual/9-robustness.md "Nemesis catalog"): a
+plan entry carrying a `peer=` arg is a LINK RULE, not a point spec —
+it targets the real framed-TCP transport per (src, dst) peer pair
+instead of a named code site. The entry name becomes the rule label
+(free-form, may repeat). Link args:
+
+    peer=<dst>          match calls TO <dst> from anyone ("*" wildcard)
+    peer=<src>><dst>    directional: only calls <src> -> <dst>
+                        (either side may be "*")
+    drop=<0..1>         drop the frame pre-send with this probability
+                        (surfaces as a retryable connection error)
+    hang=<0..1>         blackhole: the connection stays open but no
+                        reply ever comes (accept-then-hang, the
+                        gray-failure shape) — the caller burns its
+                        socket timeout
+    latency=<ms>        sleep before send (slow link / slow node)
+    jitter=<ms>         add uniform [0, jitter) on top of latency=
+    dup=<0..1>          duplicate delivery: send the frame twice
+    p=<0..1>, n=<int>   the usual gate / bounded-count args
+
+One-way partitions fall out of directional `peer=` + `hang=1`;
+symmetric splits arm both directions. `Nemesis` (below) builds these
+plan strings for the canonical scenarios. `set_link_plan` installs
+link rules WITHOUT disturbing armed point specs (so a crash plan and
+a nemesis can coexist); `set_plan` replaces both stores wholesale.
+Every daemon serves the plan surface at `/nemesis` (webservice.py).
+
 The module also hosts `CircuitBreaker` — the degradation ladder's
 state machine (closed -> open on N consecutive failures -> half-open
 probes after exponential backoff -> closed on a probe success), used
@@ -40,7 +67,7 @@ import os
 import random
 import threading
 import time
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from .stats import stats as global_stats
 
@@ -77,6 +104,54 @@ class _FaultSpec:
         return out
 
 
+class _LinkRule:
+    """One armed nemesis rule on a (src, dst) peer link (module doc:
+    NETWORK NEMESIS). Matching is first-rule-wins; "*" wildcards either
+    side; a caller with no declared src identity (src=None) matches
+    only "*" src patterns."""
+
+    __slots__ = ("label", "src", "dst", "drop_p", "hang_p",
+                 "latency_ms", "jitter_ms", "dup_p", "p", "remaining")
+
+    def __init__(self, label: str, peer: str, drop: float = 0.0,
+                 hang: float = 0.0, latency_ms: float = 0.0,
+                 jitter_ms: float = 0.0, dup: float = 0.0,
+                 p: float = 1.0, n: Optional[int] = None):
+        self.label = label
+        if ">" in peer:
+            src, _, dst = peer.partition(">")
+        else:
+            src, dst = "*", peer
+        self.src = src.strip() or "*"
+        self.dst = dst.strip() or "*"
+        self.drop_p = drop
+        self.hang_p = hang
+        self.latency_ms = latency_ms
+        self.jitter_ms = jitter_ms
+        self.dup_p = dup
+        self.p = p
+        self.remaining = n          # None = unbounded
+
+    def matches(self, src: Optional[str], dst: str) -> bool:
+        if self.src != "*" and self.src != src:
+            return False
+        return self.dst == "*" or self.dst == dst
+
+    def describe(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {"label": self.label,
+                               "peer": f"{self.src}>{self.dst}"}
+        for k, v in (("drop", self.drop_p), ("hang", self.hang_p),
+                     ("latency_ms", self.latency_ms),
+                     ("jitter_ms", self.jitter_ms), ("dup", self.dup_p)):
+            if v:
+                out[k] = v
+        if self.p < 1.0:
+            out["p"] = self.p
+        if self.remaining is not None:
+            out["remaining"] = self.remaining
+        return out
+
+
 class FaultRegistry:
     """Process-global named fault points. `fire(name)` costs one dict
     probe when no plan is active — cheap enough for the hot path."""
@@ -84,6 +159,7 @@ class FaultRegistry:
     def __init__(self):
         self._lock = threading.Lock()
         self._active: Dict[str, _FaultSpec] = {}
+        self._links: List[_LinkRule] = []
         self._points: Dict[str, Dict[str, Any]] = {}   # name -> catalog
         self.fired: Dict[str, int] = {}
         self._rng = random.Random()
@@ -141,12 +217,65 @@ class FaultRegistry:
             return
         raise exc(f"injected fault at {name!r}")
 
+    # -------------------------------------------------------- nemesis
+    def link_actions(self, src: Optional[str],
+                     dst: str) -> Optional[Dict[str, Any]]:
+        """Evaluate the nemesis link rules for one transport call on
+        the (src, dst) link. Returns None (the overwhelmingly common
+        case — one list probe when no nemesis is armed) or an action
+        dict the transport executes IN ORDER: `latency_s` sleep first,
+        then at most one of `drop` / `hang` / `dup`. First matching
+        rule wins; rolls that produce no action consume nothing."""
+        if not self._links:             # fast path: no nemesis armed
+            return None
+        acts: Optional[Dict[str, Any]] = None
+        with self._lock:
+            for rule in self._links:
+                if not rule.matches(src, dst):
+                    continue
+                if rule.remaining is not None and rule.remaining <= 0:
+                    continue
+                if rule.p < 1.0 and self._rng.random() >= rule.p:
+                    return None
+                out: Dict[str, Any] = {}
+                if rule.latency_ms or rule.jitter_ms:
+                    out["latency_s"] = (
+                        rule.latency_ms
+                        + self._rng.random() * rule.jitter_ms) / 1e3
+                if rule.hang_p and self._rng.random() < rule.hang_p:
+                    out["hang"] = True
+                elif rule.drop_p and self._rng.random() < rule.drop_p:
+                    out["drop"] = True
+                elif rule.dup_p and self._rng.random() < rule.dup_p:
+                    out["dup"] = True
+                if not out:
+                    return None
+                if rule.remaining is not None:
+                    rule.remaining -= 1
+                self.fired[rule.label] = \
+                    self.fired.get(rule.label, 0) + 1
+                acts = out
+                break
+        if acts is None:
+            return None
+        # counters outside the lock (stats has its own)
+        for mode in ("latency_s", "drop", "hang", "dup"):
+            if mode in acts:
+                global_stats.add_value(
+                    "rpc.nemesis." + mode.replace("latency_s",
+                                                  "latency"),
+                    kind="counter")
+        return acts
+
     # ----------------------------------------------------------- plan
-    def set_plan(self, plan: str) -> None:
-        """Parse + install a plan string (see module doc). An empty
-        plan clears every armed point. Raises ValueError on a
-        malformed plan, leaving the previous plan installed."""
-        new: Dict[str, _FaultSpec] = {}
+    @staticmethod
+    def _parse_plan(plan: str) -> Tuple[Dict[str, _FaultSpec],
+                                        List[_LinkRule], Optional[int]]:
+        """Shared plan parser (module doc grammar). An entry carrying
+        a `peer=` arg parses as a link rule; anything else is a point
+        spec. Raises ValueError on malformed input."""
+        points: Dict[str, _FaultSpec] = {}
+        links: List[_LinkRule] = []
         seed: Optional[int] = None
         for part in (plan or "").split(";"):
             part = part.strip()
@@ -175,24 +304,77 @@ class FaultRegistry:
                     kw["latency_ms"] = float(v)
                 elif k == "after":
                     kw["after"] = int(v)
+                elif k == "peer":
+                    kw["peer"] = v.strip()
+                elif k in ("drop", "hang", "dup"):
+                    kw[k] = float(v)
+                elif k == "jitter":
+                    kw["jitter_ms"] = float(v)
                 else:
                     raise ValueError(f"unknown fault arg {k!r} in "
                                      f"{part!r}")
-            new[name] = _FaultSpec(**kw)
+            if "peer" in kw:
+                if not kw["peer"]:
+                    raise ValueError(f"empty peer= in {part!r}")
+                if "after" in kw:
+                    raise ValueError(
+                        f"after= is a point-spec arg; not valid on "
+                        f"link rule {part!r}")
+                try:
+                    links.append(_LinkRule(name, **kw))
+                except TypeError:
+                    raise ValueError(f"bad link rule {part!r}")
+            else:
+                for bad in ("drop", "hang", "dup", "jitter_ms"):
+                    if bad in kw:
+                        raise ValueError(
+                            f"{bad.split('_')[0]}= requires peer= in "
+                            f"{part!r}")
+                points[name] = _FaultSpec(**kw)
+        return points, links, seed
+
+    def set_plan(self, plan: str) -> None:
+        """Parse + install a plan string (see module doc). An empty
+        plan clears every armed point AND link rule. Raises ValueError
+        on a malformed plan, leaving the previous plan installed."""
+        points, links, seed = self._parse_plan(plan)
         with self._lock:
-            self._active = new
+            self._active = points
+            self._links = links
             if seed is not None:
                 self._rng = random.Random(seed)
+
+    def set_link_plan(self, plan: str) -> None:
+        """Install ONLY the link rules of `plan`, leaving armed point
+        specs untouched (so a nemesis can run alongside a crash plan).
+        Raises ValueError if the plan contains point specs, or on any
+        malformed entry. An empty plan heals every link."""
+        points, links, seed = self._parse_plan(plan)
+        if points:
+            raise ValueError(
+                f"set_link_plan accepts only peer= link rules; got "
+                f"point specs {sorted(points)}")
+        with self._lock:
+            self._links = links
+            if seed is not None:
+                self._rng = random.Random(seed)
+
+    def clear_links(self) -> None:
+        """Heal every nemesis link rule (point specs stay armed)."""
+        with self._lock:
+            self._links = []
 
     def clear(self) -> None:
         with self._lock:
             self._active = {}
+            self._links = []
 
     def reset(self) -> None:
         """Disarm everything AND zero the fire counters (test
         isolation; production observability never resets)."""
         with self._lock:
             self._active = {}
+            self._links = []
             self.fired = {}
 
     # ---------------------------------------------------- observation
@@ -210,6 +392,7 @@ class FaultRegistry:
             return {
                 "active": {n: s.describe()
                            for n, s in self._active.items()},
+                "links": [r.describe() for r in self._links],
                 "fired": dict(self.fired),
                 "total_fired": sum(self.fired.values()),
                 "points": {n: p["doc"] for n, p in self._points.items()},
@@ -304,6 +487,88 @@ def _wire_flag() -> None:
 
 
 _wire_flag()
+
+
+# ---------------------------------------------------------------------------
+# Nemesis scenario driver (docs/manual/9-robustness.md "Nemesis catalog")
+# ---------------------------------------------------------------------------
+
+class Nemesis:
+    """Builds and installs link-rule plans for the canonical partition
+    scenarios. The plan-string builders are static (pure string
+    assembly, unit-testable); an instance binds an `apply_plan`
+    callable so the same driver works in-process (default: the local
+    registry's `set_link_plan`) or against subprocess clusters (pass a
+    closure that PUTs the plan to every node's `/nemesis` endpoint —
+    link rules evaluate in the CALLER's process, so every process that
+    dials peers must receive the plan)."""
+
+    def __init__(self, apply_plan=None):
+        self._apply = apply_plan or faults.set_link_plan
+        self.installed = ""
+
+    # ----------------------------------------------- plan builders
+    @staticmethod
+    def symmetric_split(a_addrs, b_addrs) -> str:
+        """Full two-way partition between groups A and B."""
+        rules = []
+        for a in a_addrs:
+            for b in b_addrs:
+                rules.append(f"split:peer={a}>{b},hang=1")
+                rules.append(f"split:peer={b}>{a},hang=1")
+        return ";".join(rules)
+
+    @staticmethod
+    def asymmetric_split(from_addrs, to_addrs) -> str:
+        """One-way partition: from->to blackholed, replies/reverse
+        direction untouched (the asymmetric-link failure shape)."""
+        return ";".join(f"oneway:peer={a}>{b},hang=1"
+                        for a in from_addrs for b in to_addrs)
+
+    @staticmethod
+    def isolate(addrs) -> str:
+        """Blackhole every link to AND from each addr (node unplugged
+        at the switch, sockets still accept)."""
+        rules = []
+        for a in addrs:
+            rules.append(f"iso:peer=*>{a},hang=1")
+            rules.append(f"iso:peer={a}>*,hang=1")
+        return ";".join(rules)
+
+    @staticmethod
+    def slow_node(addrs, latency_ms: float = 250.0,
+                  jitter_ms: float = 0.0) -> str:
+        """Gray failure: every call TO each addr pays added latency —
+        the node is alive, correct, and slow."""
+        j = f",jitter={jitter_ms:g}" if jitter_ms else ""
+        return ";".join(f"slow:peer=*>{a},latency={latency_ms:g}{j}"
+                        for a in addrs)
+
+    @staticmethod
+    def lossy_link(addrs, drop: float = 0.3) -> str:
+        """Probabilistic frame loss toward each addr (retry pressure
+        without a full partition)."""
+        return ";".join(f"lossy:peer=*>{a},drop={drop:g}"
+                        for a in addrs)
+
+    # ------------------------------------------------- application
+    def apply(self, plan: str) -> str:
+        self._apply(plan)
+        self.installed = plan
+        return plan
+
+    def heal(self) -> str:
+        return self.apply("")
+
+    def flap(self, plan: str, cycles: int, on_s: float,
+             off_s: float) -> None:
+        """Flapping link: install/heal `plan` for `cycles` rounds
+        (blocking — run from a scenario thread, never a serve path)."""
+        for _ in range(max(int(cycles), 0)):
+            self.apply(plan)
+            time.sleep(on_s)
+            self.heal()
+            time.sleep(off_s)
 
 
 def jittered_delay(base_s: float, cap_s: float, attempt: int) -> float:

@@ -165,6 +165,14 @@ graph_flags.declare("tpu_query_deadline_ms", 60000, MUTABLE,
                     "+ kernel + materialize); past it the device path "
                     "yields to the CPU pipe and deadline_exceeded is "
                     "counted in /tpu_stats. 0 disables.")
+graph_flags.declare("storage_client_timeout_ms", 30000, MUTABLE,
+                    "graphd data-plane RPC timeout per storaged "
+                    "connection (read when a host proxy is first "
+                    "created). A bounded budget is gray-failure "
+                    "hygiene: a blackholed storaged costs this much "
+                    "per attempt, letting peer-health ejection and "
+                    "hedged reads react inside the query deadline — "
+                    "the reference's --storage_client_timeout_ms")
 graph_flags.declare("cache_mode", "plan", MUTABLE,
                     "serve-path cache ladder (common/cache.py; docs/"
                     "manual/11-caching.md): off = no caching, plan = "

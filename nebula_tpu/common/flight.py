@@ -133,6 +133,12 @@ def _default_rules() -> List[TriggerRule]:
         TriggerRule("replica_divergence",
                     ("replica_divergence", "snapshot_audit_mismatch")),
         TriggerRule("shadow_mismatch", ("shadow_mismatch",)),
+        # partition observatory (rpc/transport.py + storage/client.py):
+        # a storm of per-peer transport timeouts / health ejections is
+        # the network-partition signature — a single straggler stays
+        # below threshold, a split or blackholed node does not
+        TriggerRule("partition_suspected",
+                    ("peer_timeout", "peer_ejected"), 8, 5.0),
     ]
 
 

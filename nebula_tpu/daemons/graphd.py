@@ -23,7 +23,13 @@ class _StorageHostMap(dict):
     ThriftClientManager creates clients per address on demand)."""
 
     def __missing__(self, addr: str):
-        p = proxy(addr, "storage")
+        # bounded data-plane timeout (gray-failure hygiene, ISSUE 18):
+        # a blackholed storaged costs a caller this budget per attempt
+        # — not the transport's liberal default — so peer-health
+        # ejection and hedged reads can react within a query deadline.
+        # Mirrors the reference's --storage_client_timeout_ms.
+        ms = graph_flags.get_or("storage_client_timeout_ms", 30000, int)
+        p = proxy(addr, "storage", timeout=ms / 1000.0)
         self[addr] = p
         return p
 

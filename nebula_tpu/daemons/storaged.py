@@ -626,6 +626,17 @@ def serve_storaged(meta_addr: str, host: str = "127.0.0.1",
             # names the diverging part/replica/anchor in-band
             _fl.add_collector("storaged.consistency",
                               node.consistency_status)
+            # ... and the armed network nemesis, so a
+            # partition_suspected bundle shows whether the timeouts
+            # were injected (link rules + fired counts) or organic
+            from ..common.faults import faults as _freg
+
+            def _nemesis_state():
+                d = _freg.describe()
+                return {"links": d.get("links", []),
+                        "fired": d.get("fired", {})}
+
+            _fl.add_collector("storaged.nemesis", _nemesis_state)
 
         if node is not None:
             def raft_metric_source():

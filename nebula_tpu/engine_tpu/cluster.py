@@ -41,7 +41,8 @@ class ClusterDeviceServe:
         self.engine = engine
         self.client = client
         self.stats = {"served": 0, "declined": 0, "hops": 0,
-                      "fallback_parts": 0, "fallback_errors": 0}
+                      "fallback_parts": 0, "fallback_errors": 0,
+                      "hedged_hops": 0}
 
     def _decline(self, reason: str):
         self.stats["declined"] += 1
@@ -76,10 +77,18 @@ class ClusterDeviceServe:
         for step_no in range(1, s.step.steps + 1):
             final = step_no == s.step.steps
             eprops = None if final else []
+            hedge_won0 = self.client.hedge_stats.get("won", 0)
             resp = self.client.device_window(
                 space, frontier, edge_types, edge_props=eprops,
                 allow_follower=allow_follower, follower_max_ms=fmax)
             self.stats["hops"] += 1
+            if self.client.hedge_stats.get("won", 0) > hedge_won0:
+                # a straggler replica was hedged around mid-hop
+                # (storage/client.py peer health): the hop stayed on
+                # the device path instead of riding the fallback
+                # ladder — monitoring-grade, racy across concurrent
+                # queries by design
+                self.stats["hedged_hops"] += 1
             refused = [p for p, pr in resp.results.items()
                        if pr.code != ErrorCode.SUCCEEDED]
             if refused:

@@ -703,21 +703,32 @@ class TpuGraphEngine:
         # un-suppressed form blocking every query on the engine lock
         # for the backoff duration during `bench --cluster` failover
         # (docs/manual/15-static-analysis.md). FIRST-TOUCH keeps the
-        # historical paced build: the space cannot device-serve until
-        # it exists, so blocking its first query through the transient
-        # (topology watch lag on a fresh space) is the better trade.
+        # historical paced build only on a LOCAL provider: the space
+        # cannot device-serve until the snapshot exists, so blocking
+        # its first query through the transient (topology watch lag
+        # on a fresh space) is the better trade. A cluster-capable
+        # REMOTE provider inverts that trade — queries device-serve
+        # via per-storaged partials (cluster.py) with no local
+        # snapshot at all, so the first local build typically happens
+        # mid-failover (the cluster path just declined) and pacing
+        # its scan retries would block every query on the engine lock
+        # through an election (lock-witness finding during
+        # `bench --partition` nemesis phases).
         from ..common.faults import no_retry_sleep
         replacement = self._snapshots.get(space_id) is not None
-        token = no_retry_sleep.set(True) if replacement else None
+        remote = getattr(self._provider, "_client", None) is not None
+        fail_fast = replacement or remote
+        token = no_retry_sleep.set(True) if fail_fast else None
         try:
             snap = self._build_fresh(space_id)
         finally:
             if token is not None:
                 no_retry_sleep.reset(token)
         if snap is None:
-            if replacement:
+            if fail_fast:
                 # converge off-lock: the repack ladder retries with its
                 # own backoff while queries keep the previous snapshot
+                # (or, remote, the cluster/CPU ladder)
                 self._kick_repack(space_id)
             return None
         self._snapshots[space_id] = snap

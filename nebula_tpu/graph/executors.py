@@ -46,6 +46,29 @@ def _err(code: ErrorCode, msg: str = "") -> Result:
     return StatusOr.err(code, msg)
 
 
+# part-level storage codes a client may retry verbatim: surfacing them
+# (instead of flattening every write failure to E_EXECUTION_ERROR)
+# lets clients distinguish "the cluster is failing over, try again"
+# from "your statement is broken" — without it, every partition window
+# turns transient write failures into permanent-looking client errors
+_RETRYABLE_STORAGE = frozenset({
+    ErrorCode.E_LEADER_CHANGED, ErrorCode.E_CONSENSUS_ERROR,
+    ErrorCode.E_TIMEOUT, ErrorCode.E_OVERLOAD,
+})
+
+
+def _storage_err(resp, what: str) -> Result:
+    """Graph-level error for a failed storage ExecResponse: keep the
+    part's own code when it is retryable, E_EXECUTION_ERROR otherwise."""
+    codes = sorted({r.code for r in resp.results.values()
+                    if r.code is not ErrorCode.SUCCEEDED},
+                   key=lambda c: c.value, reverse=True)
+    code = next((c for c in codes if c in _RETRYABLE_STORAGE),
+                ErrorCode.E_EXECUTION_ERROR)
+    detail = ",".join(c.name for c in codes) or "unknown"
+    return _err(code, f"{what} failed ({detail})")
+
+
 # ---------------------------------------------------------------------------
 # shared helpers
 # ---------------------------------------------------------------------------
@@ -1142,7 +1165,7 @@ def execute_insert_vertices(ctx: ExecContext, s: ast.InsertVerticesSentence) -> 
         vertices.append(NewVertex(vid, tags))
     resp = ctx.client.add_vertices(space, vertices, s.overwritable)
     if not resp.ok():
-        return _err(ErrorCode.E_EXECUTION_ERROR, "insert vertices failed")
+        return _storage_err(resp, "insert vertices")
     return _ok()
 
 
@@ -1179,7 +1202,7 @@ def execute_insert_edges(ctx: ExecContext, s: ast.InsertEdgesSentence) -> Result
         edges.append(NewEdge(sr.value(), et, rank, dr.value(), w.encode()))
     resp = ctx.client.add_edges(space, edges, s.overwritable)
     if not resp.ok():
-        return _err(ErrorCode.E_EXECUTION_ERROR, "insert edges failed")
+        return _storage_err(resp, "insert edges")
     return _ok()
 
 
@@ -1196,7 +1219,7 @@ def execute_delete_vertices(ctx: ExecContext, s: ast.DeleteVerticesSentence) -> 
         return StatusOr.from_status(starts_r.status)
     resp = ctx.client.delete_vertices(ctx.space_id(), starts_r.value())
     if not resp.ok():
-        return _err(ErrorCode.E_EXECUTION_ERROR, "delete vertices failed")
+        return _storage_err(resp, "delete vertices")
     return _ok()
 
 
@@ -1219,7 +1242,7 @@ def execute_delete_edges(ctx: ExecContext, s: ast.DeleteEdgesSentence) -> Result
         eks.append(EdgeKey(sr.value(), et, k.rank, dr.value()))
     resp = ctx.client.delete_edges(space, eks)
     if not resp.ok():
-        return _err(ErrorCode.E_EXECUTION_ERROR, "delete edges failed")
+        return _storage_err(resp, "delete edges")
     return _ok()
 
 

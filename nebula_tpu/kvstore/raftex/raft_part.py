@@ -50,7 +50,8 @@ import os
 import random
 import threading
 import time
-from concurrent.futures import Future
+from concurrent.futures import FIRST_COMPLETED, Future
+from concurrent.futures import wait as futures_wait
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ...common import heat as _heat
@@ -206,6 +207,12 @@ class RaftPart:
         # REMOVE_PEER command in the tail touches self.hosts
         self._pending: Dict[int, Future] = {}   # log_id -> caller future
         self.hosts: Dict[str, Host] = {}
+        # bounded per-peer in-flight (ISSUE 18): append sends that
+        # outlived their round's gather, keyed by follower addr.
+        # Value: (future, request, host, committed-at-round-start).
+        # Replicator-thread-private — see _replicate_once.
+        self._repl_inflight: Dict[
+            str, Tuple[Future, AppendLogRequest, Host, int]] = {}
         if not self._boot_replay_done:
             # membership COMMANDs in the tail mutated the in-memory
             # peer/learner sets at append time pre-crash; restore that
@@ -406,7 +413,47 @@ class RaftPart:
                     pass
                 self._last_round = time.monotonic()
 
+    def _absorb_append_resp(self, host: Host, req: AppendLogRequest,
+                            resp: AppendLogResponse,
+                            committed: int) -> bool:
+        """Apply one append_log response to the host's replication
+        state (shared by the fresh-send gather and the parked-send
+        harvest). Returns False when the response deposed this leader
+        — the caller must abandon its round."""
+        if resp.code is RaftCode.SUCCEEDED:
+            host.on_success(req.prev_log_id + len(req.entries))
+            # staleness watermark: the follower is "caught up" when
+            # its durable match covers everything the leader had
+            # committed at the request's round start — the timestamp
+            # staleness_ms is estimated from while it lags
+            if host.match_id >= committed:
+                host.caught_up_ts = time.monotonic()
+            # consistency: compare the replica's reported content-
+            # digest anchor against this leader's own history at the
+            # same applied index (common/consistency.py) — outside
+            # the part lock, monitoring-grade
+            if getattr(resp, "digest", None) is not None:
+                self._note_replica_digest(host, resp.digest)
+        elif resp.code in (RaftCode.E_LOG_GAP, RaftCode.E_LOG_STALE):
+            host.on_gap(resp.last_log_id)
+        elif resp.code is RaftCode.E_TERM_OUT_OF_DATE:
+            with self._lock:
+                if resp.term > self.term:
+                    self._step_down_locked(resp.term, None)
+            return False
+        return True
+
     def _replicate_once(self) -> None:
+        """One replication round with bounded per-peer in-flight
+        (ISSUE 18): a follower whose previous append is still in
+        flight is SKIPPED this round instead of re-waited — a
+        blackholed (accept-then-hang) follower costs the pipeline at
+        most one bounded gather once, then zero, while healthy
+        followers keep replicating at full cadence. Parked sends are
+        harvested when their transport future finally resolves (late
+        acks still advance match/commit), after which the follower
+        re-enters the rotation and catches up batch by batch.
+        `_repl_inflight` is touched only by the replicator thread."""
         with self._lock:
             if self.role is not Role.LEADER:
                 return
@@ -414,7 +461,32 @@ class RaftPart:
             last_id = self.wal.last_log_id
             committed = self.committed_id
             targets = [(h, self._build_append_locked(h, committed))
-                       for h in list(self.hosts.values())]
+                       for h in list(self.hosts.values())
+                       if h.addr not in self._repl_inflight]
+
+        # harvest parked sends whose reply finally arrived
+        reached = 1   # self
+        for addr, (f, req, host, req_committed) in \
+                list(self._repl_inflight.items()):
+            if not f.done():
+                continue
+            del self._repl_inflight[addr]
+            try:
+                resp: AppendLogResponse = f.result()
+            except Exception:
+                continue
+            if req.term != term:
+                continue      # parked under a previous leadership
+            stats.add_value("raftex.replicate.late_ack", kind="counter")
+            if resp.code is not RaftCode.E_UNREACHABLE \
+                    and not host.is_learner:
+                reached += 1
+            if not self._absorb_append_resp(host, req, resp,
+                                            req_committed):
+                return
+        if self._repl_inflight:
+            stats.add_value("raftex.replicate.skipped_inflight",
+                            kind="counter")
 
         sends = []
         for host, req in targets:
@@ -424,41 +496,43 @@ class RaftPart:
             f = self.network.call(self.addr, host.addr, "append_log", req)
             sends.append((host, req, f))
 
-        reached = 1   # self
-        for host, req, f in sends:
-            try:
-                resp: AppendLogResponse = f.result(timeout=self._rpc_timeout)
-            except Exception:
-                continue
-            if resp.code is not RaftCode.E_UNREACHABLE and not host.is_learner:
-                reached += 1
-            if resp.code is RaftCode.SUCCEEDED:
-                sent_last = (req.prev_log_id + len(req.entries))
-                host.on_success(sent_last)
-                # staleness watermark: the follower is "caught up"
-                # when its durable match covers everything the leader
-                # had committed at round start — the timestamp
-                # staleness_ms is estimated from while it lags
-                if host.match_id >= committed:
-                    host.caught_up_ts = time.monotonic()
-                # consistency: compare the replica's reported content-
-                # digest anchor against this leader's own history at
-                # the same applied index (common/consistency.py) —
-                # outside the part lock, monitoring-grade
-                if getattr(resp, "digest", None) is not None:
-                    self._note_replica_digest(host, resp.digest)
-            elif resp.code in (RaftCode.E_LOG_GAP, RaftCode.E_LOG_STALE):
-                host.on_gap(resp.last_log_id)
-            elif resp.code is RaftCode.E_TERM_OUT_OF_DATE:
-                with self._lock:
-                    if resp.term > self.term:
-                        self._step_down_locked(resp.term, None)
-                return
+        # gather under ONE shared deadline (not rpc_timeout PER host),
+        # with a short post-quorum grace: once a quorum has acked, the
+        # round closes and stragglers are parked instead of awaited
+        quorum = len(self.peers) // 2 + 1
+        pending = {f: (host, req) for host, req, f in sends}
+        deadline = time.monotonic() + self._rpc_timeout
+        grace_until: Optional[float] = None
+        while pending:
+            now = time.monotonic()
+            limit = deadline if grace_until is None \
+                else min(deadline, grace_until)
+            if now >= limit:
+                break
+            done, _ = futures_wait(set(pending),
+                                   timeout=min(0.05, limit - now),
+                                   return_when=FIRST_COMPLETED)
+            for f in done:
+                host, req = pending.pop(f)
+                try:
+                    resp = f.result()
+                except Exception:
+                    continue
+                if resp.code is not RaftCode.E_UNREACHABLE \
+                        and not host.is_learner:
+                    reached += 1
+                if not self._absorb_append_resp(host, req, resp,
+                                                committed):
+                    return
+            if grace_until is None and pending and reached >= quorum:
+                grace_until = time.monotonic() + 0.025
+        for f, (host, req) in pending.items():
+            self._repl_inflight[host.addr] = (f, req, host, committed)
+            stats.add_value("raftex.replicate.parked", kind="counter")
 
         # check-quorum: a leader partitioned away from a majority steps
         # down so its pending appends fail fast instead of hanging
         with self._lock:
-            quorum = len(self.peers) // 2 + 1
             if reached >= quorum:
                 self._last_quorum_contact = time.monotonic()
             elif (self.role is Role.LEADER and

@@ -55,9 +55,12 @@ class RpcTransport(Transport):
             try:
                 # max_attempts=2: one stale-socket drain + one fresh
                 # connect — a black-holed peer costs ~1 timeout, not a
-                # whole pool drain
+                # whole pool drain. src=from_addr: raft traffic carries
+                # its sender identity so DIRECTIONAL nemesis link rules
+                # (peer=src>dst) apply — the asymmetric-partition shape
                 resp = proxy(to_addr, "raftex", timeout=self._timeout,
-                             max_attempts=2).call(method, req)
+                             max_attempts=2,
+                             src=from_addr).call(method, req)
             except Exception:
                 return _unreachable_response(method)
             if isinstance(resp, (AskForVoteResponse, AppendLogResponse,

@@ -42,7 +42,8 @@ import time
 from typing import Any, Dict, Optional, Tuple
 
 from ..common import ledger
-from ..common.faults import faults, jittered_delay, pace_retry
+from ..common.faults import (InjectedConnectionFault, faults,
+                             jittered_delay, pace_retry)
 from ..common.stats import stats as global_stats
 from ..common.tracing import tracer
 from . import wire
@@ -288,7 +289,8 @@ class RpcClient:
     def __init__(self, addr: str, service: str,
                  timeout: Optional[float] = None,
                  max_attempts: Optional[int] = None,
-                 dedicated: bool = False):
+                 dedicated: bool = False,
+                 src: Optional[str] = None):
         """`dedicated` gives THIS client its own private connection
         instead of the process-wide shared per-address pool. The shared
         pool (4 sockets) is right for internal control-plane fan-out
@@ -297,7 +299,13 @@ class RpcClient:
         with the number of clients, like the reference's one-socket
         GraphClient (client/cpp/GraphClient.cpp): N in-process sessions
         sharing 4 sockets capped measured query concurrency at 4
-        regardless of session count."""
+        regardless of session count.
+
+        `src` declares the CALLER's service address for the network
+        nemesis (common/faults.py): directional link rules
+        (`peer=src>dst`) match against it. Callers with no service
+        identity (graph clients, admin tools) leave it None and match
+        only `*>dst` rules."""
         host, port_s = addr.rsplit(":", 1)
         self._key = (host, int(port_s))
         self.addr = addr
@@ -315,6 +323,7 @@ class RpcClient:
         # low-latency callers (raft) cap the stale-socket drain so a
         # black-holed peer costs ~1 timeout, not pool_size timeouts
         self._max_attempts = max_attempts
+        self._src = src
 
     def close(self) -> None:
         """Release this client's private socket (dedicated clients
@@ -379,6 +388,62 @@ class RpcClient:
                 "rpc.call_us", (time.perf_counter() - t0) * 1e6,
                 kind="histogram")
 
+    def _budget(self) -> float:
+        """Effective deadline for the next transport wait: the
+        client's per-call timeout clamped to the query's remaining
+        deadline budget (qos.set_query_deadline ContextVar) — a
+        blackholed peer must never hold a caller past the deadline the
+        admission layer promised. Raises RpcError once the budget is
+        already exhausted (balks are counted, never silent)."""
+        from ..common import qos
+        rem = qos.deadline_remaining_s()
+        if rem is None:
+            return self._timeout
+        if rem <= 0:
+            global_stats.add_value("rpc.deadline_balk", kind="counter")
+            raise RpcError(f"rpc to {self.addr}: query deadline "
+                           f"exhausted before transport wait")
+        return min(self._timeout, rem)
+
+    def _note_peer_timeout(self) -> None:
+        """A wait on this peer burned its full budget: count it and
+        feed the flight recorder's `partition_suspected` trigger (a
+        storm of these across peers is the partition signature)."""
+        global_stats.add_value("rpc.peer_timeout", kind="counter")
+        from ..common.flight import recorder
+        recorder.record("peer_timeout", peer=self.addr,
+                        service=self.service)
+
+    def _nemesis_exchange(self, sock: socket.socket, payload: bytes,
+                          acts: Dict[str, Any], budget: float) -> bytes:
+        """Execute an armed nemesis action on this call (common/
+        faults.py NETWORK NEMESIS): latency first, then at most one of
+        drop / hang / dup. Each surfaces through the exact code path
+        the genuine network failure would take."""
+        lat = acts.get("latency_s")
+        if lat:
+            time.sleep(min(lat, budget))
+        if acts.get("drop"):
+            # frame loss: ConnectionError subclass — the reconnect /
+            # drain retry machinery engages as for a reset socket
+            raise InjectedConnectionFault(
+                f"nemesis dropped frame to {self.addr}")
+        if acts.get("hang"):
+            # blackhole (accept-then-hang, the gray-failure shape):
+            # the request is never sent; the caller waits on a reply
+            # that never comes and burns its budget via socket.timeout
+            return _recv_frame(sock)
+        _send_frame(sock, payload)
+        if acts.get("dup"):
+            # duplicate delivery: the peer genuinely executes the
+            # frame twice; the duplicate's response is drained so the
+            # framed stream stays aligned
+            _send_frame(sock, payload)
+            raw = _recv_frame(sock)
+            _recv_frame(sock)
+            return raw
+        return _recv_frame(sock)
+
     def _call_framed(self, payload: bytes) -> Any:
         last_err: Optional[Exception] = None
         fresh_fail = False
@@ -398,36 +463,46 @@ class RpcClient:
                 if fresh_fail:
                     self._reconnect_backoff(paced)
                     paced += 1
+            # recomputed per attempt: retries shrink the remaining
+            # query budget, so later attempts wait less, never more
+            budget = self._budget()
             try:
-                sock = self._pool.acquire(self._timeout)
+                sock = self._pool.acquire(budget)
             except socket.timeout as e:
                 # SYN-dropped peer: the connect already consumed the
                 # caller's full budget — don't multiply it by retrying
+                self._note_peer_timeout()
                 raise RpcError(f"rpc to {self.addr} connect timed out "
-                               f"({self._timeout}s): {e}") from e
+                               f"({budget:.3g}s): {e}") from e
             except queue.Empty as e:
                 raise RpcError(f"rpc to {self.addr}: no pooled connection "
-                               f"within {self._timeout}s") from e
+                               f"within {budget:.3g}s") from e
             except OSError as e:
                 last_err = e   # instant failures (refused etc.): retry
                 fresh_fail = True
                 continue
-            sock.settimeout(self._timeout)  # deadline is per-call
+            sock.settimeout(budget)  # deadline is per-call + clamped
             try:
                 # transport-shaped fault point: raises a ConnectionError
                 # subclass, so the production retry/backoff machinery
                 # engages exactly as for a genuinely broken socket
                 faults.fire("rpc.send")
-                _send_frame(sock, payload)
-                raw = _recv_frame(sock)
+                acts = faults.link_actions(self._src, self.addr)
+                if acts is None:
+                    _send_frame(sock, payload)
+                    raw = _recv_frame(sock)
+                else:
+                    raw = self._nemesis_exchange(sock, payload, acts,
+                                                 budget)
             except socket.timeout as e:
                 # a live-but-unresponsive (black-holed) peer: retrying
                 # another pooled socket would multiply the deadline —
                 # fail within the caller's budget instead
                 sock.close()
                 self._pool.release(None)
+                self._note_peer_timeout()
                 raise RpcError(f"rpc to {self.addr} timed out "
-                               f"({self._timeout}s): {e}") from e
+                               f"({budget:.3g}s): {e}") from e
             except (ConnectionError, OSError) as e:
                 sock.close()
                 self._pool.release(None)
@@ -461,12 +536,15 @@ class RpcClient:
 
 def proxy(addr: str, service: str, timeout: Optional[float] = None,
           max_attempts: Optional[int] = None,
-          dedicated: bool = False) -> RpcClient:
+          dedicated: bool = False,
+          src: Optional[str] = None) -> RpcClient:
     """A client whose attribute calls mirror the remote service's
     methods — drop-in for the in-proc service objects that
     StorageClient/MetaClient hold per host. `timeout` is this client's
     per-call deadline (connect + send + recv), independent of any other
     client sharing the address's connection pool. `dedicated` opts out
-    of the shared pool (see RpcClient)."""
+    of the shared pool (see RpcClient); `src` declares the caller's
+    address for directional nemesis link rules (see RpcClient)."""
     return RpcClient(addr, service, timeout=timeout,
-                     max_attempts=max_attempts, dedicated=dedicated)
+                     max_attempts=max_attempts, dedicated=dedicated,
+                     src=src)

@@ -16,7 +16,9 @@ from __future__ import annotations
 import contextvars
 import threading
 import time
-from concurrent.futures import ThreadPoolExecutor
+from collections import deque
+from concurrent.futures import FIRST_COMPLETED, ThreadPoolExecutor
+from concurrent.futures import wait as futures_wait
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..common import keys as ku
@@ -30,6 +32,234 @@ from .types import (BoundRequest, BoundResponse, DevicePartResult,
                     NewEdge, NewVertex, PartResult,
                     PropsResponse, StatDef, StatsResponse, UpdateItemReq,
                     UpdateResponse, VertexData)
+
+
+class PeerHealth:
+    """Per-peer health scoring for the DATA fan-out (the CircuitBreaker
+    idiom applied per peer — ISSUE 18; docs/manual/12-replication.md
+    "Partitions & gray failure"). Two independent ejection signals:
+
+    - CONSECUTIVE transport failures (`EJECT_AFTER` timeouts/errors in
+      a row) — the blackholed/dead-peer shape;
+    - EWMA latency OUTLIER (smoothed latency above `OUTLIER_FACTOR` x
+      the cross-peer median, past an absolute floor) — the gray
+      slow-but-alive shape that ruins p99 without ever erroring.
+
+    An ejected peer leaves the data-routing candidate set until a
+    background half-open probe answers HEALTHY-FAST (under the same
+    outlier bar that ejected it; exponential backoff between probes)
+    or its ejection window lapses and live traffic finds it fast. A
+    slow-but-successful answer never re-admits — that is the gray
+    shape itself — it widens the half-open window instead. The
+    cross-peer recent-latency window also derives the hedge delay
+    (p95) for hedged reads.
+
+    SCOPE (ISSUE 18 satellite): only StorageClient DATA fan-out
+    consults this — raft election/heartbeat/replication traffic
+    (kvstore/raftex) never does, so an ejected gray storaged still
+    votes, still heartbeats, and still catches up."""
+
+    ALPHA = 0.2               # EWMA smoothing
+    EJECT_AFTER = 3           # consecutive transport failures
+    OUTLIER_FACTOR = 4.0      # x cross-peer median EWMA
+    OUTLIER_MIN_MS = 50.0     # never eject under this absolute latency
+    MIN_SAMPLES = 8
+    BASE_BACKOFF_S = 1.0
+    MAX_BACKOFF_S = 30.0
+    HEDGE_FLOOR_S = 0.010
+    HEDGE_CAP_S = 0.5
+    HEDGE_DEFAULT_S = 0.05    # until the p95 window has samples
+
+    def __init__(self, probe: Optional[Callable[[str], bool]] = None):
+        self._lock = threading.Lock()
+        self._peers: Dict[str, Dict[str, Any]] = {}
+        self._recent: deque = deque(maxlen=256)   # cross-peer ms
+        self._probe = probe
+        self._closed = False
+        self.counts = {"ejected": 0, "recovered": 0, "probes": 0}
+
+    def _rec(self, host: str) -> Dict[str, Any]:
+        rec = self._peers.get(host)
+        if rec is None:
+            rec = self._peers[host] = {
+                "ewma_ms": None, "samples": 0, "consec": 0,
+                "ejected": False, "until": 0.0, "probing": False,
+                "backoff": self.BASE_BACKOFF_S,
+                "ejections": 0, "straggles": 0}
+        return rec
+
+    # -------------------------------------------------- observations
+    def observe(self, host: str, ms: float) -> None:
+        ejected_now = False
+        with self._lock:
+            rec = self._rec(host)
+            rec["consec"] = 0
+            prev = rec["ewma_ms"]
+            rec["ewma_ms"] = ms if prev is None \
+                else prev + self.ALPHA * (ms - prev)
+            rec["samples"] += 1
+            self._recent.append(ms)
+            if rec["ejected"]:
+                # traffic reached an ejected peer (half-open window /
+                # pre-ejection race / a response already in flight at
+                # ejection time). Recover ONLY on a healthy-fast
+                # answer — a slow-but-successful one is exactly the
+                # gray shape that got it ejected, and re-admitting on
+                # it makes the ejection flap (eject -> stale in-flight
+                # response lands -> recover -> re-eject ...).
+                if ms <= self._healthy_ms_locked(host):
+                    self._recover_locked(rec)
+                else:
+                    # still gray: widen the half-open window
+                    rec["backoff"] = min(rec["backoff"] * 2,
+                                         self.MAX_BACKOFF_S)
+                    rec["until"] = time.monotonic() + rec["backoff"]
+            elif rec["samples"] >= self.MIN_SAMPLES:
+                others = [r["ewma_ms"] for h, r in self._peers.items()
+                          if h != host and r["ewma_ms"] is not None]
+                if others and rec["ewma_ms"] > \
+                        self._healthy_ms_locked(host):
+                    ejected_now = self._eject_locked(rec)
+        if ejected_now:
+            self._on_ejected(host)
+
+    def observe_failure(self, host: str) -> None:
+        ejected_now = False
+        with self._lock:
+            rec = self._rec(host)
+            rec["consec"] += 1
+            if rec["ejected"]:
+                # failure in the half-open window: double the backoff
+                rec["backoff"] = min(rec["backoff"] * 2,
+                                     self.MAX_BACKOFF_S)
+                rec["until"] = time.monotonic() + rec["backoff"]
+            elif rec["consec"] >= self.EJECT_AFTER:
+                ejected_now = self._eject_locked(rec)
+        if ejected_now:
+            self._on_ejected(host)
+
+    def straggled(self, host: str) -> None:
+        """A hedge beat this peer's in-flight response (evidence of
+        grayness that never became an error)."""
+        with self._lock:
+            self._rec(host)["straggles"] += 1
+
+    def _healthy_ms_locked(self, host: str) -> float:
+        """Latency bar for `host` to count as healthy: OUTLIER_FACTOR x
+        the cross-peer median EWMA, floored at OUTLIER_MIN_MS. The same
+        bar ejects (EWMA above it) and re-admits (answer below it)."""
+        others = sorted(r["ewma_ms"] for h, r in self._peers.items()
+                        if h != host and r["ewma_ms"] is not None)
+        if not others:
+            return self.OUTLIER_MIN_MS
+        med = others[len(others) // 2]
+        return max(self.OUTLIER_FACTOR * med, self.OUTLIER_MIN_MS)
+
+    # ------------------------------------------- ejection lifecycle
+    def _eject_locked(self, rec: Dict[str, Any]) -> bool:
+        rec["ejected"] = True
+        rec["ejections"] += 1
+        rec["until"] = time.monotonic() + rec["backoff"]
+        self.counts["ejected"] += 1
+        return True
+
+    def _recover_locked(self, rec: Dict[str, Any]) -> None:
+        rec["ejected"] = False
+        rec["consec"] = 0
+        rec["backoff"] = self.BASE_BACKOFF_S
+        rec["until"] = 0.0
+        self.counts["recovered"] += 1
+
+    def _on_ejected(self, host: str) -> None:
+        from ..common.flight import recorder as _flight
+        stats.add_value("storage_client.peer_ejected", kind="counter")
+        _flight.record("peer_ejected", peer=host)
+        if self._probe is None:
+            return
+        with self._lock:
+            rec = self._rec(host)
+            if rec["probing"]:
+                return
+            rec["probing"] = True
+        # nlint: disable=NL002 -- ejection-lifetime half-open prober;
+        # exits as soon as the peer recovers (or the client closes)
+        threading.Thread(target=self._probe_loop, args=(host,),
+                         name=f"peer-probe-{host}", daemon=True).start()
+
+    def _probe_loop(self, host: str) -> None:
+        try:
+            while not self._closed:
+                with self._lock:
+                    rec = self._peers.get(host)
+                    if rec is None or not rec["ejected"]:
+                        return
+                    delay = rec["backoff"]
+                time.sleep(delay)
+                if self._closed:
+                    return
+                with self._lock:
+                    self.counts["probes"] += 1
+                t0 = time.monotonic()
+                try:
+                    ok = bool(self._probe(host))
+                except Exception:
+                    ok = False
+                probe_ms = (time.monotonic() - t0) * 1e3
+                with self._lock:
+                    rec = self._peers.get(host)
+                    if rec is None or not rec["ejected"]:
+                        return
+                    # a slow-but-successful probe is still gray: only
+                    # a healthy-fast answer closes the half-open state
+                    if ok and probe_ms <= self._healthy_ms_locked(host):
+                        self._recover_locked(rec)
+                        return
+                    rec["backoff"] = min(rec["backoff"] * 2,
+                                         self.MAX_BACKOFF_S)
+                    rec["until"] = time.monotonic() + rec["backoff"]
+        finally:
+            with self._lock:
+                rec = self._peers.get(host)
+                if rec is not None:
+                    rec["probing"] = False
+
+    # ------------------------------------------------------ queries
+    def ejected(self, host: str) -> bool:
+        """Should data routing skip this peer right now? An elapsed
+        ejection window reads healthy (half-open: live traffic probes
+        it; a failure re-ejects with doubled backoff)."""
+        rec = self._peers.get(host)
+        if rec is None or not rec["ejected"]:
+            return False
+        return time.monotonic() < rec["until"]
+
+    def hedge_delay_s(self) -> float:
+        """p95 of the cross-peer recent-latency window, clamped — the
+        wait before a straggler's parts are re-issued elsewhere."""
+        with self._lock:
+            if len(self._recent) < self.MIN_SAMPLES:
+                return self.HEDGE_DEFAULT_S
+            xs = sorted(self._recent)
+            p95 = xs[min(len(xs) - 1, int(len(xs) * 0.95))]
+        return min(max(p95 / 1e3, self.HEDGE_FLOOR_S),
+                   self.HEDGE_CAP_S)
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            peers = {h: {"ewma_ms": (None if r["ewma_ms"] is None
+                                     else round(r["ewma_ms"], 3)),
+                         "samples": r["samples"],
+                         "consec_failures": r["consec"],
+                         "ejected": r["ejected"],
+                         "ejections": r["ejections"],
+                         "straggles": r["straggles"]}
+                     for h, r in self._peers.items()}
+            out: Dict[str, Any] = dict(self.counts)
+        out["peers"] = peers
+        return out
+
+    def close(self) -> None:
+        self._closed = True
 
 
 class StorageClient:
@@ -80,6 +310,15 @@ class StorageClient:
                              "parts_served": 0, "follower_parts": 0,
                              "leader_retries": 0, "refused_parts": 0,
                              "max_staleness_ms": 0.0}
+        # partition & gray-failure tolerance (ISSUE 18): per-peer
+        # health scoring for the data fan-out, and the hedged-read
+        # token bucket — hedges draw tokens refilled at HEDGE_RATE per
+        # part-request, so hedging can never add more than that
+        # fraction of extra cluster load (let alone double it)
+        self.peer_health = PeerHealth(probe=self._probe_peer)
+        self.hedge_stats = {"issued": 0, "won": 0, "capped": 0}
+        self._hedge_lock = threading.Lock()
+        self._hedge_tokens = self.HEDGE_BURST
 
     # ------------------------------------------------------------------
     # routing
@@ -127,6 +366,67 @@ class StorageClient:
                 contextvars.copy_context().run, fn, *args)
         return self._pool.submit(fn, *args)
 
+    def _timed_call(self, host: str, call, *args):
+        """Per-host call wrapper feeding the peer-health scorer: wall
+        latency on success, a failure mark on any transport-level
+        exception (response-level error codes are NOT peer failures —
+        a follower refusing a stale read is healthy)."""
+        t0 = time.perf_counter()
+        try:
+            r = call(*args)
+        except Exception:
+            self.peer_health.observe_failure(host)
+            raise
+        self.peer_health.observe(host, (time.perf_counter() - t0) * 1e3)
+        return r
+
+    def _next_healthy(self, hosts_list: List[str], prev: str) -> str:
+        """Hintless-rotation target: the next host after `prev`,
+        skipping health-ejected peers — unless EVERY candidate is
+        ejected, in which case plain rotation (something must be
+        tried; total ejection is indistinguishable from a local
+        network problem)."""
+        idx = hosts_list.index(prev) if prev in hosts_list else 0
+        for step in range(1, len(hosts_list) + 1):
+            cand = hosts_list[(idx + step) % len(hosts_list)]
+            if not self.peer_health.ejected(cand):
+                return cand
+        return hosts_list[(idx + 1) % len(hosts_list)]
+
+    def _probe_peer(self, host: str) -> bool:
+        """Half-open health probe for an ejected peer: one cheap
+        version RPC on a fail-fast client (the _watch_host twin idiom
+        — the shared proxy's paced reconnect backoff would slow the
+        verdict down). Success proves the peer answers again."""
+        svc = self._hosts.get(host)
+        if svc is None or self._closed:
+            return False
+        from ..rpc.transport import RpcClient, proxy
+        if isinstance(svc, RpcClient):
+            svc = proxy(svc.addr, svc.service, timeout=1.0,
+                        max_attempts=1)
+        svc.watch_space_versions({}, timeout=0.05)
+        return True
+
+    # hedged-read budget: tokens refill per part-request, hedges spend
+    # them — sustained hedge volume is capped at HEDGE_RATE x request
+    # load with HEDGE_BURST headroom for latency spikes
+    HEDGE_RATE = 0.5
+    HEDGE_BURST = 64.0
+
+    def _hedge_refill(self, parts_requested: int) -> None:
+        with self._hedge_lock:
+            self._hedge_tokens = min(
+                self.HEDGE_BURST,
+                self._hedge_tokens + self.HEDGE_RATE * parts_requested)
+
+    def _hedge_budget(self, want: int) -> int:
+        with self._hedge_lock:
+            n = min(want, int(self._hedge_tokens))
+            if n > 0:
+                self._hedge_tokens -= n
+        return n
+
     def _fanout(self, space_id: int, parts: Dict[int, Any], call, empty_resp,
                 merge, max_retries: int = 5) -> Any:
         """Scatter per leader host, gather with leader-cache fixups and
@@ -144,7 +444,8 @@ class StorageClient:
             for host, host_parts in by_host.items():
                 svc = self._hosts[host]
                 futures.append((host_parts,
-                                self._submit(call, svc, host_parts)))
+                                self._submit(self._timed_call, host,
+                                             call, svc, host_parts)))
             round_resp = empty_resp.__class__()
             dead_parts: list = []
             for host_parts, fut in futures:
@@ -171,8 +472,8 @@ class StorageClient:
                     continue
                 saw_hintless = True
                 prev = tried.get(part, hosts_list[0])
-                idx = (hosts_list.index(prev) + 1) % len(hosts_list)
-                self._leader_cache[(space_id, part)] = hosts_list[idx]
+                self._leader_cache[(space_id, part)] = \
+                    self._next_healthy(hosts_list, prev)
                 pending[part] = parts[part]
             deposed_hosts: set = set()
             for part, result in round_resp.results.items():
@@ -184,8 +485,8 @@ class StorageClient:
                     else:
                         saw_hintless = True
                         prev = tried.get(part, hosts_list[0])
-                        idx = (hosts_list.index(prev) + 1) % len(hosts_list)
-                        self._leader_cache[(space_id, part)] = hosts_list[idx]
+                        self._leader_cache[(space_id, part)] = \
+                            self._next_healthy(hosts_list, prev)
                     pending[part] = parts[part]
                 elif result.code in (ErrorCode.E_PART_NOT_FOUND,
                                      ErrorCode.E_SPACE_NOT_FOUND) \
@@ -336,17 +637,30 @@ class StorageClient:
         gather BoundResponse-shaped vertices + per-part serve verdicts.
 
         Routing: with follower reads armed, parts spread
-        deterministically across every host (a follower that passes
-        the raft read fence serves its replica's shard — the capacity
-        double); otherwise parts route to their cached leader. Refused
+        deterministically across every HEALTHY host (a follower that
+        passes the raft read fence serves its replica's shard — the
+        capacity double; health-ejected peers leave the candidate
+        set); otherwise parts route to their cached leader. Refused
         parts (fence rejected, shard stale, wrong host) get ONE leader
         retry; parts still refused come back refused — the caller
-        falls back to the row-scan path per part, never whole-window."""
+        falls back to the row-scan path per part, never whole-window.
+
+        Hedging (ISSUE 18): spread rounds are hedged — after a
+        p95-derived delay, a straggler host's unresolved parts are
+        re-issued to another replica (the part's leader where it isn't
+        the straggler itself, else the next healthy host), first
+        response wins per part. Hedges draw from the token bucket
+        (`_hedge_budget`) so they can never double cluster load, and
+        wins mark the straggler in the health scorer. The abandoned
+        straggler future resolves (or times out) in its pool thread
+        and only feeds health stats — the window never waits on it."""
         parts = self.cluster_ids_to_parts(space_id, vids)
         self.device_stats["windows"] += 1
         self.device_stats["parts_requested"] += len(parts)
+        self._hedge_refill(len(parts))
         hosts_list = sorted(self._hosts)
         resp = DeviceWindowResponse()
+        num_parts = self.sm.num_parts(space_id)
 
         def call(svc, host_parts, af):
             return svc.device_window(DeviceWindowRequest(
@@ -355,11 +669,25 @@ class StorageClient:
                 max_edges_per_vertex=max_edges_per_vertex,
                 allow_follower=af, follower_max_ms=follower_max_ms))
 
-        def run_round(assignment: Dict[int, str], af: bool) -> None:
+        def hedge_target(part: int, straggler: str) -> Optional[str]:
+            # another replica for the straggler's part: prefer the
+            # cached leader (it can always serve), else the next
+            # healthy host in rotation
+            ldr = self._leader(space_id, part)
+            if ldr != straggler and not self.peer_health.ejected(ldr):
+                return ldr
+            for h in hosts_list:
+                if h != straggler and h != ldr \
+                        and not self.peer_health.ejected(h):
+                    return h
+            return None
+
+        def run_round(assignment: Dict[int, str], af: bool,
+                      hedged: bool = False) -> None:
             by_host: Dict[str, Dict[int, List[int]]] = {}
             for part, host in assignment.items():
                 by_host.setdefault(host, {})[part] = parts[part]
-            futures = []
+            futs: Dict[Any, Tuple[str, Dict[int, List[int]], bool]] = {}
             for host, hp in by_host.items():
                 svc = self._hosts.get(host)
                 if svc is None:
@@ -367,33 +695,136 @@ class StorageClient:
                         resp.results[p] = DevicePartResult(
                             code=ErrorCode.E_HOST_NOT_FOUND)
                     continue
-                futures.append((hp, self._submit(call, svc, hp, af)))
-            for hp, fut in futures:
+                futs[self._submit(self._timed_call, host, call,
+                                  svc, hp, af)] = (host, hp, False)
+            if not futs:
+                return
+            round_res: Dict[int, DevicePartResult] = {}
+
+            def absorb(fut) -> None:
+                host, hp, is_hedge = futs[fut]
                 try:
                     r = fut.result()
                 except Exception:
                     for p in hp:
-                        resp.results[p] = DevicePartResult(
-                            code=ErrorCode.E_HOST_NOT_FOUND)
-                    continue
-                resp.results.update(r.results)
-                resp.vertices.extend(r.vertices)
+                        round_res.setdefault(p, DevicePartResult(
+                            code=ErrorCode.E_HOST_NOT_FOUND))
+                    return
+                accepted = set()
+                for p, pr in r.results.items():
+                    prev = round_res.get(p)
+                    # first response wins per part; a later SUCCESS
+                    # still replaces an earlier failure verdict
+                    if prev is not None and (
+                            prev.code == ErrorCode.SUCCEEDED
+                            or pr.code != ErrorCode.SUCCEEDED):
+                        continue
+                    round_res[p] = pr
+                    accepted.add(p)
+                    if is_hedge and pr.code == ErrorCode.SUCCEEDED:
+                        self.hedge_stats["won"] += 1
+                        stats.add_value("storage_client.hedge.won",
+                                        kind="counter")
+                        straggler = assignment.get(p)
+                        if straggler:
+                            self.peer_health.straggled(straggler)
+                # vertices ride only for parts whose verdict THIS
+                # response supplied — a straggler's late duplicate
+                # must not double-count rows
+                if accepted:
+                    if len(accepted) == len(r.results):
+                        resp.vertices.extend(r.vertices)
+                    else:
+                        resp.vertices.extend(
+                            v for v in r.vertices
+                            if ku.part_id(v.vid, num_parts) in accepted)
                 resp.latency_us = max(resp.latency_us, r.latency_us)
+
+            pending = set(futs)
+            if hedged and len(hosts_list) > 1:
+                done, pending = futures_wait(
+                    pending, timeout=self.peer_health.hedge_delay_s())
+                for f in done:
+                    absorb(f)
+                if pending:
+                    # stragglers: re-issue their unresolved parts to
+                    # another replica, budget permitting
+                    want: List[Tuple[int, str]] = []
+                    for f in pending:
+                        host, hp, _ = futs[f]
+                        for p in hp:
+                            pr = round_res.get(p)
+                            if pr is not None \
+                                    and pr.code == ErrorCode.SUCCEEDED:
+                                continue
+                            alt = hedge_target(p, host)
+                            if alt is not None:
+                                want.append((p, alt))
+                    granted = self._hedge_budget(len(want))
+                    if granted < len(want):
+                        capped = len(want) - granted
+                        self.hedge_stats["capped"] += capped
+                        stats.add_value("storage_client.hedge.capped",
+                                        kind="counter")
+                    hedge_by_host: Dict[str, Dict[int, List[int]]] = {}
+                    for p, alt in want[:granted]:
+                        hedge_by_host.setdefault(alt, {})[p] = parts[p]
+                    for alt, hp in hedge_by_host.items():
+                        svc = self._hosts.get(alt)
+                        if svc is None:
+                            continue
+                        fut = self._submit(self._timed_call, alt,
+                                           call, svc, hp, af)
+                        futs[fut] = (alt, hp, True)
+                        pending.add(fut)
+                        self.hedge_stats["issued"] += len(hp)
+                        stats.add_value("storage_client.hedge.issued",
+                                        kind="counter")
+
+            def unresolved() -> bool:
+                # keep waiting only while a pending future could still
+                # improve some part's verdict; anything else pending is
+                # an abandoned straggler (its pool thread resolves on
+                # its own RPC/deadline timeout and feeds health stats)
+                covered: set = set()
+                for f in pending:
+                    covered.update(futs[f][1])
+                for p in assignment:
+                    pr = round_res.get(p)
+                    if (pr is None or pr.code != ErrorCode.SUCCEEDED) \
+                            and p in covered:
+                        return True
+                return False
+
+            while pending and unresolved():
+                done, pending = futures_wait(
+                    pending, return_when=FIRST_COMPLETED)
+                for f in done:
+                    absorb(f)
+            for p in assignment:
+                # abandoned-straggler parts whose hedge also failed
+                # must still carry a verdict (a silent hole would read
+                # as neither served nor refused to the caller)
+                round_res.setdefault(p, DevicePartResult(
+                    code=ErrorCode.E_HOST_NOT_FOUND))
+            resp.results.update(round_res)
 
         spread = allow_follower and follower_max_ms > 0 and hosts_list
         assign = {}
         for part in parts:
             if spread:
-                # deterministic rotation over the NON-leader hosts —
-                # the point of follower reads is taking load OFF the
-                # leader; a non-replica pick refuses and rides the one
-                # leader retry below
+                # deterministic rotation over the healthy NON-leader
+                # hosts — the point of follower reads is taking load
+                # OFF the leader; a non-replica pick refuses and rides
+                # the one leader retry below. All followers ejected ->
+                # the leader serves (it always can)
                 ldr = self._leader(space_id, part)
-                cands = [h for h in hosts_list if h != ldr] or [ldr]
+                cands = [h for h in hosts_list if h != ldr
+                         and not self.peer_health.ejected(h)] or [ldr]
                 assign[part] = cands[part % len(cands)]
             else:
                 assign[part] = self._leader(space_id, part)
-        run_round(assign, allow_follower)
+        run_round(assign, allow_follower, hedged=bool(spread))
         retry = {}
         for part, pr in list(resp.results.items()):
             if pr.code == ErrorCode.E_LEADER_CHANGED:
@@ -814,6 +1245,8 @@ class StorageClient:
             "leader_cache_size": len(self._leader_cache),
             "retries": dict(self.retry_stats),
             "version_watch": dict(self.version_stats),
+            "peer_health": self.peer_health.snapshot(),
+            "hedge": dict(self.hedge_stats),
         }
 
     def note_local_write(self, space_id: int) -> None:
@@ -826,6 +1259,7 @@ class StorageClient:
 
     def close(self) -> None:
         self._closed = True
+        self.peer_health.close()
 
     def kv_put(self, space_id: int, kvs: List[Tuple[bytes, bytes]]) -> Status:
         by_part: Dict[int, List[Tuple[bytes, bytes]]] = {}

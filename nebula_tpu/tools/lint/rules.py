@@ -344,6 +344,16 @@ _NL004_FAMILY_KINDS = {
     # plain _total series after) holds uniformly
     "consistency.": "counter",
     "shadow.": "counter",
+    # partition & gray-failure tolerance (ISSUE 18): nemesis
+    # injections, per-peer transport timeouts/balks, hedge outcomes
+    # and health ejections are all monotonic event streams — counters,
+    # so the strict-OpenMetrics flatteners expose plain _total twins
+    "rpc.nemesis.": "counter",
+    "rpc.peer_timeout": "counter",
+    "rpc.deadline_balk": "counter",
+    "storage_client.hedge.": "counter",
+    "storage_client.peer_ejected": "counter",
+    "raftex.replicate.": "counter",
 }
 
 

@@ -1319,6 +1319,256 @@ def run_soak_cluster_reads(seconds: float = 20.0,
             shutil.rmtree(run_dir, ignore_errors=True)
 
 
+def run_soak_nemesis(seconds: float = 25.0, seed: int = 41) -> dict:
+    """`--nemesis`: the cluster-reads soak under a CYCLING network
+    nemesis (ISSUE 18; docs/manual/9-robustness.md "Network nemesis").
+    The same replicated 3-storaged topology with bounded-staleness
+    follower reads armed, but a background scenario thread rotates
+    link failures through the live transport — a symmetric raft split
+    of one storaged, a gray (slow-not-dead) node, a lossy data link —
+    healing between rounds, while the consistency observatory samples
+    shadow reads the whole time. Writers tolerate RETRYABLE codes
+    (that's the failover contract); ok requires identity green
+    throughout, zero NON-retryable errors, every served staleness
+    within the bound, zero shadow mismatches / replica divergence, and
+    the nemesis having actually fired."""
+    import shutil
+    import tempfile
+    import threading
+
+    from ..client import GraphClient
+    from ..common.faults import Nemesis, faults
+    from ..common.flags import graph_flags, storage_flags
+    from ..daemons import serve_graphd, serve_metad, serve_storaged
+    from ..engine_tpu import TpuGraphEngine
+    from ..meta.net_admin import raft_addr_of
+    from .crashstorm import RETRYABLE
+
+    v, e, parts, space, bound_ms = 240, 1500, 4, "soaknem", 150
+    run_dir = tempfile.mkdtemp(prefix="nebula_tpu_soaknem_")
+    rng = random.Random(seed)
+    saved = {f: storage_flags.get(f) for f in
+             ("heartbeat_interval_secs", "raft_heartbeat_ms",
+              "raft_election_timeout_ms", "follower_read_max_ms",
+              "consistency_enabled")}
+    saved_g = {f: graph_flags.get(f) for f in
+               ("consistency_enabled", "storage_client_timeout_ms")}
+    storage_flags.set("heartbeat_interval_secs", 0.4)
+    storage_flags.set("raft_heartbeat_ms", 60)
+    storage_flags.set("raft_election_timeout_ms", 250)
+    storage_flags.set("consistency_enabled", True)
+    graph_flags.set("consistency_enabled", True)
+    graph_flags.set("storage_client_timeout_ms", 2000)
+    metad = graphd = None
+    storers: list = []
+    verifies = 0
+    errors: list = []
+    retried = [0]
+    nemesis = Nemesis()
+    try:
+        metad = serve_metad(expired_threshold_secs=5)
+        for i in range(3):
+            storers.append(serve_storaged(
+                metad.addr, replicated=True, engine="mem",
+                data_dir=f"{run_dir}/s{i}", load_interval=0.15))
+        tpu = TpuGraphEngine()
+        graphd = serve_graphd(metad.addr, tpu_engine=tpu)
+        gc = GraphClient(graphd.addr).connect()
+        for q in (f"CREATE SPACE {space}(partition_num={parts}, "
+                  f"replica_factor=3)", f"USE {space}",
+                  "CREATE TAG person(name string)",
+                  "CREATE EDGE knows(ts int)"):
+            r = gc.execute(q)
+            assert r.ok(), (q, r.error_msg)
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            r = gc.execute('INSERT VERTEX person(name) VALUES 0:("p")')
+            if r.ok():
+                break
+            time.sleep(0.2)     # part elections still settling
+        assert r.ok(), r.error_msg
+        rows = ", ".join(f'{i}:("p{i}")' for i in range(1, v))
+        assert gc.execute(
+            f"INSERT VERTEX person(name) VALUES {rows}").ok()
+        srcs = [rng.randrange(v) for _ in range(e)]
+        dsts = [(s * 7 + k) % v for k, s in enumerate(srcs)]
+        for lo in range(0, e, 500):
+            chunk = ", ".join(
+                f"{a} -> {b}:({(a + b) % 97})"
+                for a, b in zip(srcs[lo:lo + 500], dsts[lo:lo + 500]))
+            assert gc.execute(
+                f"INSERT EDGE knows(ts) VALUES {chunk}").ok()
+        deg: dict = {}
+        for s in srcs:
+            deg[s] = deg.get(s, 0) + 1
+        hubs = [s for s, _ in sorted(deg.items(),
+                                     key=lambda kv: -kv[1])[:3]]
+        queries = [
+            f"GO 2 STEPS FROM {hubs[0]} OVER knows YIELD knows._dst",
+            f"GO FROM {hubs[1]}, {hubs[2]} OVER knows "
+            f"YIELD knows._dst, knows.ts",
+            f"GO 2 STEPS FROM {hubs[1]} OVER knows "
+            f"WHERE knows.ts > 40 YIELD knows._dst, knows.ts",
+        ]
+        for q in queries:
+            gc.must(q)
+        gc.must(f"UPDATE CONFIGS STORAGE:follower_read_max_ms = "
+                f"{bound_ms}")
+        deadline = time.monotonic() + 15
+        while storage_flags.get("follower_read_max_ms") != bound_ms \
+                and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert storage_flags.get("follower_read_max_ms") == bound_ms
+        cons_tok = _arm_consistency(rate=0.1)
+
+        stop = threading.Event()
+        pause = threading.Event()
+        paused = threading.Event()
+
+        def writer():
+            wc = GraphClient(graphd.addr).connect()
+            wc.must(f"USE {space}")
+            rank = e + 1
+            while not stop.is_set():
+                if pause.is_set():
+                    paused.set()
+                    time.sleep(0.02)
+                    continue
+                paused.clear()
+                a, b = rng.randrange(v), rng.randrange(v)
+                stmt = (f"INSERT EDGE knows(ts) VALUES "
+                        f"{a} -> {b}@{rank}:({(a + b) % 97})")
+                rank += 1
+                r = wc.execute(stmt)
+                n = 0
+                while (not r.ok() and r.code in RETRYABLE and n < 8
+                       and not stop.is_set()):
+                    n += 1
+                    retried[0] += 1
+                    time.sleep(min(0.05 * n, 0.4))
+                    r = wc.execute(stmt)
+                if not r.ok() and r.code not in RETRYABLE:
+                    errors.append(f"write: {r.code}: {r.error_msg}")
+                time.sleep(0.02)
+
+        def scenario():
+            """Rotate nemesis shapes; ALWAYS healed while the identity
+            pair runs (pause is the verify window)."""
+            while not stop.is_set():
+                i = rng.randrange(len(storers))
+                s_addr = storers[i].addr
+                v_raft = raft_addr_of(s_addr)
+                o_rafts = [raft_addr_of(h.addr)
+                           for h in storers if h.addr != s_addr]
+                plan = rng.choice([
+                    Nemesis.symmetric_split([v_raft], o_rafts),
+                    Nemesis.slow_node([s_addr], latency_ms=200.0,
+                                      jitter_ms=80.0),
+                    Nemesis.lossy_link([s_addr], drop=0.3),
+                ])
+                if pause.is_set():      # verify window: stay healed
+                    time.sleep(0.1)
+                    continue
+                nemesis.apply(plan)
+                stop.wait(0.8)
+                nemesis.heal()
+                stop.wait(0.6)          # let elections/hints settle
+
+        # nlint: disable=NL002 -- soak-lifetime threads; no inbound trace
+        wt = threading.Thread(target=writer, daemon=True,
+                              name="soak-nemesis-writer")
+        # nlint: disable=NL002 -- soak-lifetime scenario driver (above)
+        nt = threading.Thread(target=scenario, daemon=True,
+                              name="soak-nemesis-scenario")
+        wt.start()
+        nt.start()
+        deadline = time.monotonic() + seconds
+        while time.monotonic() < deadline and not errors:
+            q = queries[rng.randrange(len(queries))]
+            pause.set()
+            if not paused.wait(timeout=10.0):
+                pause.clear()
+                continue
+            nemesis.heal()              # verify on a healed network
+            time.sleep((bound_ms + 100) / 1000.0)
+            try:
+                rt = gc.execute(q)
+                if not rt.ok():
+                    errors.append(f"verify: {rt.error_msg}")
+                    break
+                tpu.enabled = False
+                try:
+                    rc = gc.execute(q)
+                finally:
+                    tpu.enabled = True
+                if not rc.ok():
+                    errors.append(f"verify-cpu: {rc.error_msg}")
+                    break
+                if sorted(map(repr, rt.rows)) != \
+                        sorted(map(repr, rc.rows)):
+                    errors.append(f"IDENTITY DIVERGENCE: {q}")
+                    break
+                verifies += 1
+            finally:
+                pause.clear()
+            time.sleep(0.05)
+        stop.set()
+        pause.clear()
+        wt.join(timeout=20)
+        nt.join(timeout=20)
+        nemesis.heal()
+        fired = dict(faults.counts())
+        cons_block = _settle_consistency(cons_tok)
+        client = graphd.engine.client
+        cdev = dict(client.device_stats)
+        per_host = {}
+        stal = [float(cdev.get("max_staleness_ms", 0.0))]
+        for h in storers:
+            mgr = getattr(h, "device_shards", None)
+            if mgr is not None:
+                per_host[h.addr] = dict(mgr.stats)
+                stal.append(float(mgr.stats.get("max_staleness_ms", 0)))
+        slack = int(storage_flags.get_or("device_shard_max_ms", 250,
+                                         int))
+        max_stal = round(max(stal), 2)
+        out = {
+            "seconds": seconds, "identity_verifies": verifies,
+            "bound_ms": bound_ms, "shard_slack_ms": slack,
+            "max_served_staleness_ms": max_stal,
+            "staleness_bounded": max_stal <= bound_ms + slack,
+            "nemesis_fired": fired,
+            "write_retries": retried[0],
+            "peer_health": client.peer_health.snapshot(),
+            "hedge": dict(client.hedge_stats),
+            "consistency": cons_block,
+            "client_device": cdev, "per_host": per_host,
+            "errors": errors[:5],
+        }
+        out["ok"] = (not errors and verifies >= 5
+                     and out["staleness_bounded"]
+                     and cons_block["ok"]
+                     and sum(fired.values()) > 0)
+        return out
+    finally:
+        faults.reset()
+        try:
+            if graphd is not None:
+                graphd.stop()
+            for h in storers:
+                try:
+                    h.stop()
+                except Exception:
+                    pass
+            if metad is not None:
+                metad.stop()
+        finally:
+            for f, val in saved.items():
+                storage_flags.set(f, val)
+            for f, val in saved_g.items():
+                graph_flags.set(f, val)
+            shutil.rmtree(run_dir, ignore_errors=True)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="mixed INSERT+GO soak with continuous CPU/TPU "
@@ -1372,6 +1622,16 @@ def main(argv=None) -> int:
                          "every served staleness within the bound, "
                          "identity green, zero errors (docs/manual/"
                          "12-replication.md)")
+    ap.add_argument("--nemesis", action="store_true",
+                    help="the --cluster-reads topology under a cycling "
+                         "network nemesis (symmetric raft split / gray "
+                         "node / lossy link, healed between rounds; "
+                         "common/faults.py link rules in the live "
+                         "transport) with the consistency observatory "
+                         "sampling throughout: identity green, zero "
+                         "non-retryable errors, staleness bounded, "
+                         "zero shadow mismatches / divergence (docs/"
+                         "manual/9-robustness.md)")
     ap.add_argument("--skew", action="store_true",
                     help="Zipf-distributed start vids with the "
                          "workload observatory armed (common/heat.py) "
@@ -1396,6 +1656,8 @@ def main(argv=None) -> int:
         out = run_soak_crash(args.seconds)
     elif args.cluster_reads:
         out = run_soak_cluster_reads(args.seconds)
+    elif args.nemesis:
+        out = run_soak_nemesis(args.seconds)
     elif args.skew:
         out = run_soak_skew(args.seconds)
     elif args.tenants:

@@ -91,6 +91,7 @@ class WebService:
         self.register("/flight", self._flight_handler)
         self.register("/slo", self._slo_handler)
         self.register("/profile", self._profile_handler)
+        self.register("/nemesis", self._nemesis_handler)
 
     # ------------------------------------------------------------------
     def register(self, path: str, handler: Handler) -> None:
@@ -270,6 +271,31 @@ class WebService:
         lines.append("# EOF")
         return 200, (("\n".join(lines) + "\n").encode(),
                      OPENMETRICS_CTYPE)
+
+    def _nemesis_handler(self, params, body) -> Tuple[int, Any]:
+        """/nemesis: the network-nemesis admin surface, served by
+        EVERY daemon (link rules evaluate in the caller's process, so
+        a scenario driver must reach each node — docs/manual/
+        9-robustness.md "Nemesis catalog"). GET = armed link rules +
+        fire counts; PUT body `plan=<grammar>` installs the link plan
+        (replacing only link rules — armed point specs survive);
+        `?clear=1` heals every link. Only `peer=` link entries are
+        accepted (400 otherwise); /faults owns point specs."""
+        from .common.faults import faults as freg
+        if body:
+            fields = {k: v[0] for k, v in
+                      parse_qs(body.decode(),
+                               keep_blank_values=True).items()}
+            if "plan" not in fields:
+                return 400, {"error": "body must carry plan=<spec>"}
+            try:
+                freg.set_link_plan(fields["plan"])
+            except ValueError as e:
+                return 400, {"error": str(e)}
+        elif params.get("clear"):
+            freg.clear_links()
+        d = freg.describe()
+        return 200, {"links": d["links"], "fired": d["fired"]}
 
     # ------------------------------------------------------------------
     # flight recorder + SLO surfaces (process-global, every daemon —

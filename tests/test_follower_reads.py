@@ -96,6 +96,67 @@ def test_commit_index_fence_rejects_lagging_follower(cluster3):
     assert f.follower_read_stats["rejected_commit"] >= 1
 
 
+def test_partitioned_leader_fences_instead_of_lying(cluster3):
+    """A leader cut off from the quorum mid-write must DEMOTE (check-
+    quorum) and then refuse reads, never serve from its frozen state:
+    the majority side may have elected a new leader and committed
+    writes it cannot see (ISSUE 18; docs/manual/12-replication.md
+    "Partitions & gray failure")."""
+    leader = cluster3.wait_leader()
+    assert leader.append_async(b"pre").result(timeout=3) is \
+        RaftCode.SUCCEEDED
+    cluster3.wait_commit(1)
+    cluster3.isolate(leader.addr)
+    # check-quorum: no follower ack within 2x election timeout demotes
+    deadline = time.monotonic() + leader._election_timeout * 6 + 2.0
+    while leader.is_leader() and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert not leader.is_leader(), "isolated leader never demoted"
+    # the majority side carries on without it
+    survivors = [a for a in cluster3.voting if a != leader.addr]
+    new_leader = cluster3.wait_leader(among=survivors)
+    assert new_leader.append_async(b"during").result(timeout=3) is \
+        RaftCode.SUCCEEDED
+    cluster3.wait_commit(2, addrs=survivors)
+    # the demoted replica's lease lapses within the election timeout;
+    # past it the fence must reject — decline, not lie
+    time.sleep(leader._election_timeout + 0.3)
+    ok, _staleness, reason = leader.read_fence(1e9)
+    assert not ok and reason in ("stale", "commit_fence"), \
+        (ok, _staleness, reason)
+    assert (leader.follower_read_stats["rejected_stale"]
+            + leader.follower_read_stats["rejected_commit"]) >= 1
+    cluster3.heal(leader.addr)
+    cluster3.wait_commit(2)
+
+
+def test_follower_heal_recovers_watermark(cluster3):
+    """An isolated follower stops granting; after heal it catches up
+    and the SAME fence grants again with a fresh watermark — the
+    recovery half of the partition story."""
+    leader = cluster3.wait_leader()
+    assert leader.append_async(b"a").result(timeout=3) is \
+        RaftCode.SUCCEEDED
+    cluster3.wait_commit(1)
+    f = _follower(cluster3, leader)
+    assert _wait_granted(f, 1e9)[0]
+    cluster3.isolate(f.addr)
+    for payload in (b"b", b"c", b"d"):
+        assert leader.append_async(payload).result(timeout=3) is \
+            RaftCode.SUCCEEDED
+    cluster3.wait_commit(4, addrs=[a for a in cluster3.voting
+                                   if a != f.addr])
+    time.sleep(f._election_timeout + 0.3)
+    ok, staleness, reason = f.read_fence(1e9)
+    assert not ok and reason == "stale", (ok, staleness, reason)
+    cluster3.heal(f.addr)
+    cluster3.wait_commit(4)          # catch-up includes the follower
+    ok, staleness, reason = _wait_granted(f, 1000.0)
+    assert ok and reason == "follower", (ok, staleness, reason)
+    assert staleness <= min(1000.0, f._election_timeout * 1000.0)
+    assert f.committed_id == leader.committed_id
+
+
 def test_stale_fault_lie_bounces_off_commit_fence(cluster3):
     """`followerread.stale` forges the time watermark (staleness -> 0).
     A lagging replica armed with the lie must STILL be rejected — by
