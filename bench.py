@@ -1771,6 +1771,502 @@ def bench_consistency(out_path: str, trim: bool = False):
         raise SystemExit(f"CONSISTENCY tier FAILED gates: {failed}")
 
 
+def bench_writes(out_path: str, trim: bool = False):
+    """Write-path observatory proof tier (`bench.py --writes`;
+    docs/manual/10-observability.md, "Write-path observatory") — the
+    before-numbers baseline for ROADMAP item 2 (group-commit pipelined
+    raft writes, on-device delta compaction). Tier-1-safe on XLA:CPU.
+    PASSES only when
+
+      (a) DISARMED IS FREE: with write_obs_enabled=false a whole warm
+          mixed write+read loop leaves ZERO nebula_write_*/
+          nebula_snapshot_*/nebula_wal_fsync* families on /metrics and
+          /snapshots reports only {"enabled": false};
+      (b) STAGE TIMELINE: armed, a mixed INSERT/UPDATE/GO workload
+          populates the per-stage histograms for every in-proc seam
+          (execute/fanout/commit_apply/ring_publish/delta_apply) with
+          trace exemplars, PROFILE on a mutation renders the
+          write_stages cost block, the ack-to-visible watermark
+          advances and its histogram records, the PR 15 shadow reads
+          ride armed with ZERO mismatches, and EVERY acked write reads
+          back (zero acked-write loss);
+      (c) OVERRUN CHAIN: a sustained-churn burst past a shrunk change
+          ring forces a GENUINE ring overrun — overrun(truncated) ->
+          snapshot poison(ring_overrun) -> full host repack is one
+          attributed chain in the lifecycle ledger, the ring_overrun
+          flight bundle's "writepath" collector carries that ledger,
+          the `ring.overrun` fault point fires as the deterministic
+          backstop, and no acked write is lost through the repack;
+      (d) REPLICATION SEAMS: on a REAL 3-replica raft cluster (metad +
+          3 replicated storaged + TPU graphd, localhost TCP,
+          wal_sync_every_append) the wal_append/replicate stage
+          histograms, the group-commit readiness metrics
+          (write.raft.round_us/round_entries/commit_batch_entries) and
+          the WAL fsync histogram all populate; an injected slow fsync
+          fires the fsync_stall flight trigger and a real
+          acked-but-unpulled write fires visibility_stall; /snapshots
+          on a storaged serves the lifecycle view;
+      (e) SEAM COST: the measured per-write cost of every armed seam
+          (seam_cost_probe) stays within 3% of a measured end-to-end
+          write (the PR 13/14 deterministic-overhead idiom).
+    """
+    import shutil
+    import tempfile
+    import urllib.request
+
+    from nebula_tpu.client import GraphClient
+    from nebula_tpu.cluster import InProcCluster
+    from nebula_tpu.common import consistency as cons
+    from nebula_tpu.common import writepath as wp
+    from nebula_tpu.common.faults import faults
+    from nebula_tpu.common.flags import graph_flags, storage_flags
+    from nebula_tpu.common.flight import recorder as flight_rec
+    from nebula_tpu.common.stats import stats as global_stats
+    from nebula_tpu.common.tracing import tracer
+    from nebula_tpu.daemons import (serve_graphd, serve_metad,
+                                    serve_storaged)
+    from nebula_tpu.engine_tpu import TpuGraphEngine
+
+    seed = int(os.environ.get("BENCH_WRITES_SEED", 29))
+    parts = 3
+    v, e = (240, 1500) if trim else (1000, 8000)
+    rng = np.random.default_rng(seed)
+    gates: dict = {}
+    art: dict = {"seed": seed, "trim": trim,
+                 "graph": {"V": v, "E": e, "parts": parts}}
+
+    def wp_metric_lines():
+        return [ln for ln in global_stats.prometheus_lines()
+                if "nebula_write" in ln or "nebula_snapshot" in ln
+                or "nebula_wal_fsync" in ln]
+
+    def hist(name):
+        return global_stats.histogram_snapshot(name)
+
+    def hist_count(name) -> int:
+        h = hist(name)
+        return int(h["count"]) if h else 0
+
+    def verify_edges(connX, space, wantmap):
+        """Durability journal check: every acked rank-0 write must
+        read back with its LAST acked ts (the zero-acked-write-loss
+        gate). One GO per distinct src; (dst, ts) existence — seed
+        edges at other ranks ride the same adjacency and never mask a
+        missing row."""
+        connX.must(f"USE {space}")
+        by_src: dict = {}
+        for (s, d), t in wantmap.items():
+            by_src.setdefault(s, {})[d] = t
+        missing = []
+        for s, dm in by_src.items():
+            r = connX.must(f"GO FROM {s} OVER knows "
+                           f"YIELD knows._dst, knows.ts")
+            seen = {(int(row[0]), int(row[1])) for row in r.rows}
+            for d, t in dm.items():
+                if (d, t) not in seen:
+                    missing.append([s, d, t])
+        return missing
+
+    # ---- phase 0: DISARMED — the whole loop must leave no trace
+    wp.reset()
+    flight_rec.reset()
+    graph_flags.set("write_obs_enabled", False)
+    storage_flags.set("write_obs_enabled", False)
+    assert not wp.enabled()
+    want: dict = {}          # (src, dst) -> last acked rank-0 ts
+    tpu = TpuGraphEngine()
+    cluster = InProcCluster(tpu_engine=tpu)
+    conn = cluster.connect()
+    srcs, dsts, ts = zipf_edges(rng, v, e, clip=100)
+    insert_person_knows(conn, "wrt", parts, v, srcs, dsts, ts)
+    sid = cluster.meta.get_space("wrt").value().space_id
+    tpu.prewarm(sid, block=True)
+
+    def go(start, steps=1):
+        return conn.must(f"GO {steps} STEPS FROM {int(start)} "
+                         f"OVER knows YIELD knows._dst, knows.ts")
+
+    for i in range(24):
+        s = int(rng.integers(0, v))
+        d = (s * 7 + 1) % v
+        conn.must(f"INSERT EDGE knows(ts) VALUES {s} -> {d}:({i})")
+        want[(s, d)] = i
+        go(s)
+    lines0 = wp_metric_lines()
+    gates["disarmed_no_metric_families"] = lines0 == []
+    gates["disarmed_snapshots_view"] = \
+        wp.snapshots_view() == {"enabled": False}
+    gates["disarmed_gauges_empty"] = wp.gauges() == {}
+    art["disarmed"] = {"metric_lines": len(lines0)}
+
+    # ---- phase 1: ARMED — mixed INSERT/UPDATE/GO with the durability
+    # journal, shadow reads riding, stage histograms + watermark
+    graph_flags.set("write_obs_enabled", True)
+    storage_flags.set("write_obs_enabled", True)
+    graph_flags.set("consistency_enabled", True)
+    storage_flags.set("consistency_enabled", True)
+    graph_flags.set("shadow_read_rate", 0.5)
+    cons.shadow.reset()
+    wp.reset()
+    tracer.arm(64)           # exemplar fuel: sampled traces for the
+    n_ops = 150 if trim else 600   # next 64 queries' stage records
+    n_ins = n_upd = n_reads = 0
+    for i in range(n_ops):
+        s = int(rng.integers(0, v))
+        r = i % 10
+        if r < 5:
+            d = int(rng.integers(0, v))
+            t = TS_MAX + i
+            conn.must(f"INSERT EDGE knows(ts) VALUES {s} -> {d}:({t})")
+            want[(s, d)] = t
+            n_ins += 1
+        elif r < 7 and want:
+            pairs = list(want)
+            s2, d2 = pairs[int(rng.integers(0, len(pairs)))]
+            t = TS_MAX + n_ops + i
+            conn.must(f"UPDATE EDGE {s2} -> {d2} OF knows SET ts = {t}")
+            want[(s2, d2)] = t
+            n_upd += 1
+        else:
+            go(s, steps=1 + i % 2)
+            n_reads += 1
+    # PROFILE on a mutation renders the per-stage cost block the way
+    # reads already do (the appended write_* ledger fields)
+    t_prof = TS_MAX + 10 * n_ops
+    rp = conn.must(f"PROFILE INSERT EDGE knows(ts) "
+                   f"VALUES 1 -> 2:({t_prof})")
+    want[(1, 2)] = t_prof
+    ws = (getattr(rp, "profile", None) or {}).get("write_stages") or {}
+    art["profile_write_stages"] = ws
+    gates["profile_write_stages"] = \
+        {"execute", "fanout", "commit_apply"} <= set(ws)
+    go(0)                    # settle: pull deltas, advance watermark
+    wmv = wp.watermark.stats_view()
+    art["watermark"] = {str(k): dict(val) for k, val in wmv.items()}
+    gates["acks_recorded"] = any(m["acked"] > 0 for m in wmv.values())
+    gates["watermark_advanced"] = \
+        any(m["visible"] > 0 for m in wmv.values())
+    gates["ack_to_visible_recorded"] = \
+        hist_count("write.ack_to_visible_ms") > 0
+    art["ack_to_visible_ms"] = {
+        "count": hist_count("write.ack_to_visible_ms"),
+        "avg_600s": global_stats.read_stats(
+            "write.ack_to_visible_ms.avg.600"),
+        "p99_600s": global_stats.read_stats(
+            "write.ack_to_visible_ms.p99.600")}
+    st_counts = {}
+    for stg in wp.STAGES:
+        h = hist(f"write.stage.{stg}_us")
+        st_counts[stg] = {"count": int(h["count"]),
+                          "exemplars": len(h["exemplars"]),
+                          "p99_600s": global_stats.read_stats(
+                              f"write.stage.{stg}_us.p99.600")} \
+            if h else None
+    art["stages"] = st_counts
+    gates["stage_timeline_inproc"] = all(
+        st_counts[stg] and st_counts[stg]["count"] > 0
+        for stg in ("execute", "fanout", "commit_apply",
+                    "ring_publish", "delta_apply"))
+    gates["stage_exemplars"] = any(
+        (st_counts[stg] or {}).get("exemplars", 0) > 0
+        for stg in ("execute", "fanout", "commit_apply"))
+    gates["shadow_drained"] = cons.shadow.drain(30)
+    sh = cons.shadow.stats()
+    art["shadow"] = {k: sh[k] for k in
+                     ("sampled", "verified", "mismatches",
+                      "skipped_stale", "errors", "dropped")}
+    gates["shadow_verified"] = sh["verified"] > 0
+    gates["shadow_identity_green"] = sh["mismatches"] == 0
+    graph_flags.set("shadow_read_rate", 0.0)
+    missing = verify_edges(conn, "wrt", want)
+    art["durability"] = {"edges_tracked": len(want),
+                         "inserts": n_ins, "updates": n_upd,
+                         "reads": n_reads, "missing": missing[:10]}
+    gates["zero_acked_write_loss"] = missing == []
+    log(f"WRITES phase 1: stages={ {k: (s0 or {}).get('count') for k, s0 in st_counts.items()} } "
+        f"shadow={art['shadow']} tracked={len(want)}")
+
+    # ---- seam cost: measured armed-seam cost vs a measured write
+    # (PR 13/14 idiom — gate the deterministic seam measurement, not a
+    # noisy A/B QPS ratio)
+    n_probe = 60 if trim else 200
+    t0 = time.perf_counter()
+    for i in range(n_probe):
+        s = int(rng.integers(0, v))
+        d = int(rng.integers(0, v))
+        t = 2 * TS_MAX + i
+        conn.must(f"INSERT EDGE knows(ts) VALUES {s} -> {d}:({t})")
+        want[(s, d)] = t
+    write_us = (time.perf_counter() - t0) / n_probe * 1e6
+    seam_us = wp.seam_cost_probe()
+    seam_frac = seam_us / write_us
+    art["overhead"] = {"seam_us_per_write": round(seam_us, 2),
+                       "write_us": round(write_us, 1),
+                       "seam_frac": round(seam_frac, 4)}
+    gates["overhead_within_contract"] = seam_frac <= 0.03
+
+    # ---- phase 2: sustained churn past a shrunk change ring — the
+    # GENUINE overrun -> poison -> repack chain, attributed end to end
+    old_ring_ops = storage_flags.get("change_ring_ops")
+    storage_flags.set("change_ring_ops", 64)   # REBOOT-effective: the
+    v2, e2 = (120, 400) if trim else (300, 1200)  # ring is born with
+    srcs2, dsts2, ts2 = zipf_edges(rng, v2, e2, clip=40)  # this space
+    insert_person_knows(conn, "wchurn", parts, v2, srcs2, dsts2, ts2)
+    storage_flags.set("change_ring_ops", old_ring_ops)
+    sid2 = cluster.meta.get_space("wchurn").value().space_id
+    tpu.prewarm(sid2, block=True)
+    conn.must("GO FROM 1 OVER knows YIELD knows._dst")  # anchor cursor
+    flight_rec.reset()
+    ov0 = global_stats.lifetime_total("write.ring.overrun")
+    rp0 = wp.snapshots.view()["counts"].get("repack", 0)
+    want2: dict = {}
+    n_burst = 200 if trim else 400     # >> the 64-op ring between pulls
+    for i in range(n_burst):
+        s = int(rng.integers(0, v2))
+        d = int(rng.integers(0, v2))
+        t = 3 * TS_MAX + i
+        conn.must(f"INSERT EDGE knows(ts) VALUES {s} -> {d}:({t})")
+        want2[(s, d)] = t
+    deadline = time.monotonic() + 20
+    while time.monotonic() < deadline:
+        conn.must("GO FROM 1 OVER knows YIELD knows._dst")
+        if (global_stats.lifetime_total("write.ring.overrun") > ov0
+                and wp.snapshots.view()["counts"].get("repack", 0)
+                > rp0):
+            break
+        time.sleep(0.05)
+    gates["ring_overrun_fired"] = \
+        global_stats.lifetime_total("write.ring.overrun") > ov0
+    view = wp.snapshots.view()
+    ev2 = view["spaces"].get(sid2, [])
+    causes: dict = {}
+    for evt in ev2:
+        causes.setdefault(evt["event"], []).append(evt.get("cause"))
+    art["overrun"] = {"ledger_counts": view["counts"],
+                      "space_events": ev2[-12:],
+                      "rings": {str(k): val for k, val
+                                in wp.ring_status().items()}}
+    gates["overrun_cause_chain"] = (
+        "truncated" in causes.get("overrun", ())
+        and "ring_overrun" in causes.get("poison", ())
+        and "ring_overrun" in causes.get("repack", ()))
+    flight_rec.flush()
+    bundles = [b for b in flight_rec.bundles
+               if b["trigger"] == "ring_overrun"]
+    wcol = (bundles[-1].get("collectors") or {}).get("writepath") \
+        if bundles else None
+    gates["overrun_bundle"] = bool(
+        bundles and bundles[-1]["event"].get("cause") == "truncated")
+    gates["bundle_carries_lifecycle"] = bool(
+        wcol and (wcol.get("ledger") or {}).get("counts", {})
+        .get("overrun"))
+    # deterministic backstop: the `ring.overrun` fault point forces
+    # the identical decline shape (cause="injected") on the next pull
+    faults.set_plan("ring.overrun:n=1")
+    t_inj = 3 * TS_MAX + n_burst + 1
+    conn.must(f"INSERT EDGE knows(ts) VALUES 2 -> 3:({t_inj})")
+    want2[(2, 3)] = t_inj
+    # the fault sits in the provider's delta pull — under load the
+    # first GO can land while the post-overrun repack is still
+    # installing (no snapshot to pull against), so retry until the
+    # engine is back on the incremental feed and the point fires
+    deadline = time.monotonic() + 15
+    while time.monotonic() < deadline:
+        conn.must("GO FROM 2 OVER knows YIELD knows._dst")
+        if faults.counts().get("ring.overrun", 0) >= 1:
+            break
+        time.sleep(0.1)
+    gates["overrun_fault_fired"] = \
+        faults.counts().get("ring.overrun", 0) >= 1
+    faults.clear()
+    # zero acked-write loss THROUGH the overrun + repack: retry while
+    # the background repack lands
+    deadline = time.monotonic() + 20
+    missing2 = verify_edges(conn, "wchurn", want2)
+    while missing2 and time.monotonic() < deadline:
+        time.sleep(0.2)
+        missing2 = verify_edges(conn, "wchurn", want2)
+    art["overrun"]["edges_tracked"] = len(want2)
+    art["overrun"]["missing"] = missing2[:10]
+    gates["zero_loss_through_overrun"] = missing2 == []
+    log(f"WRITES phase 2: overruns="
+        f"{global_stats.lifetime_total('write.ring.overrun') - ov0:g} "
+        f"chain={gates['overrun_cause_chain']} "
+        f"bundle={gates['overrun_bundle']}")
+
+    # ---- phase 3: the replication seams on a REAL 3-replica cluster
+    space = "wrep"
+    run_dir = tempfile.mkdtemp(prefix="nebula_tpu_writebench_")
+    old_hb = storage_flags.get("heartbeat_interval_secs")
+    old_rhb = storage_flags.get("raft_heartbeat_ms")
+    old_rel = storage_flags.get("raft_election_timeout_ms")
+    old_sync = storage_flags.get("wal_sync_every_append")
+    storage_flags.set("heartbeat_interval_secs", 0.4)
+    storage_flags.set("raft_heartbeat_ms", 60)
+    storage_flags.set("raft_election_timeout_ms", 250)
+    storage_flags.set("wal_sync_every_append", True)   # REBOOT: read
+    metad = graphd = None                              # at part bind
+    storers = {}
+    try:
+        metad = serve_metad(expired_threshold_secs=5)
+        for i in range(3):
+            storers[i] = serve_storaged(
+                metad.addr, replicated=True, engine="mem",
+                data_dir=os.path.join(run_dir, f"s{i}"),
+                load_interval=0.15, ws_port=0)
+        tpu2 = TpuGraphEngine()
+        graphd = serve_graphd(metad.addr, tpu_engine=tpu2)
+        gc = GraphClient(graphd.addr).connect()
+        v3, e3 = (120, 600) if trim else (300, 2000)
+        srcs3, dsts3, ts3 = zipf_edges(rng, v3, e3, clip=60)
+        insert_person_knows(gc, space, parts, v3, srcs3, dsts3, ts3,
+                            replica_factor=3, settle_s=20.0)
+        sid3 = metad.meta.get_space(space).value().space_id
+        gc.must("GO 1 STEPS FROM 1 OVER knows YIELD knows._dst")
+        wseq = 0
+        end = time.monotonic() + (1.5 if trim else 3.0)
+        while time.monotonic() < end:
+            s = int(rng.integers(0, v3))
+            gc.must(f"INSERT EDGE knows(ts) VALUES {s} -> "
+                    f"{(s * 7 + 3) % v3}:({wseq})")
+            if wseq % 3 == 0:
+                gc.must(f"GO FROM {s} OVER knows YIELD knows._dst")
+            wseq += 1
+        repl = {}
+        for name in ("write.stage.wal_append_us",
+                     "write.stage.replicate_us",
+                     "write.raft.round_us",
+                     "write.raft.round_entries",
+                     "write.raft.pending_appends",
+                     "write.raft.quorum_wait_us",
+                     "write.raft.commit_batch_entries",
+                     "wal.fsync_us"):
+            repl[name] = {"count": hist_count(name),
+                          "p99_600s": global_stats.read_stats(
+                              f"{name}.p99.600")}
+        art["replicated"] = {"writes": wseq, "metrics": repl}
+        gates["stage_timeline_replicated"] = (
+            hist_count("write.stage.wal_append_us") > 0
+            and hist_count("write.stage.replicate_us") > 0)
+        gates["group_commit_metrics"] = (
+            hist_count("write.raft.round_us") > 0
+            and hist_count("write.raft.round_entries") > 0
+            and hist_count("write.raft.commit_batch_entries") > 0)
+        gates["fsync_histogram"] = hist_count("wal.fsync_us") > 0
+        # /snapshots on a storaged serves the lifecycle view
+        snap_body = None
+        for h in storers.values():
+            if not h.ws_port:
+                continue
+            try:
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{h.ws_port}/snapshots",
+                        timeout=3) as r:
+                    snap_body = json.loads(r.read().decode())
+                break
+            except Exception:
+                continue
+        gates["snapshots_endpoint"] = bool(
+            snap_body and snap_body.get("enabled") is True
+            and "ledger" in snap_body and "watermark" in snap_body)
+        # fsync_stall drill: one injected slow fsync on a leader WAL
+        # (the fault sleeps INSIDE the measured sync extent)
+        storage_flags.set("fsync_stall_ms", 2)
+        # n=3: group-commit/compaction syncs race this plan — a budget
+        # of 1 can be consumed before the drill's own sync under load.
+        # The whole drill retries: under heavy load the leader lookup
+        # can catch sid3 mid-election (no LEADER row → nothing to
+        # sync), so keep re-resolving until the stall lands.
+        faults.set_plan("wal.sync:latency=10,n=3")
+        gates["fsync_stall_fired"] = False
+        fs_deadline = time.monotonic() + 15
+        while time.monotonic() < fs_deadline \
+                and not gates["fsync_stall_fired"]:
+            target = None
+            for h in storers.values():
+                if h.node is None:
+                    continue
+                for st in h.node.raft_status():
+                    if st["role"] == "LEADER" and st["space"] == sid3:
+                        target = h.node.raft(st["space"], st["part"])
+                        break
+                if target is not None:
+                    break
+            if target is None:
+                time.sleep(0.3)
+                continue
+            if faults.counts().get("wal.sync", 0) < 1:
+                faults.set_plan("wal.sync:latency=10,n=3")
+            target.wal.sync()
+            flight_rec.flush()
+            gates["fsync_stall_fired"] = (
+                faults.counts().get("wal.sync", 0) >= 1
+                and any(b["trigger"] == "fsync_stall"
+                        for b in flight_rec.bundles))
+            if not gates["fsync_stall_fired"]:
+                time.sleep(0.3)
+        storage_flags.set("fsync_stall_ms", 0)
+        faults.clear()
+        # visibility_stall drill: a REAL acked write with no read to
+        # pull it device-side — the gauge scrape fires the trigger
+        graph_flags.set("visibility_stall_ms", 1)
+        gc.must(f"INSERT EDGE knows(ts) VALUES 1 -> 5:({4 * TS_MAX})")
+        time.sleep(0.05)
+        wp.gauges()          # scrape path: stalled spaces fire without
+        flight_rec.flush()   # a fresh watermark advance
+        gates["visibility_stall_fired"] = any(
+            b["trigger"] == "visibility_stall"
+            for b in flight_rec.bundles)
+        graph_flags.set("visibility_stall_ms", 0)
+        art["flight_bundles"] = sorted(
+            {b["trigger"] for b in flight_rec.bundles})
+        log(f"WRITES phase 3: writes={wseq} repl_metrics="
+            f"{ {k: m['count'] for k, m in repl.items()} }")
+    finally:
+        faults.clear()
+        graph_flags.set("shadow_read_rate", 0.0)
+        graph_flags.set("consistency_enabled", False)
+        storage_flags.set("consistency_enabled", False)
+        graph_flags.set("visibility_stall_ms", 0)
+        storage_flags.set("fsync_stall_ms", 0)
+        storage_flags.set("change_ring_ops", old_ring_ops)
+        try:
+            if graphd is not None:
+                graphd.stop()
+            for h in storers.values():
+                h.stop()
+            if metad is not None:
+                metad.stop()
+        except Exception:
+            pass
+        storage_flags.set("heartbeat_interval_secs", old_hb)
+        storage_flags.set("raft_heartbeat_ms", old_rhb)
+        storage_flags.set("raft_election_timeout_ms", old_rel)
+        storage_flags.set("wal_sync_every_append", old_sync)
+        shutil.rmtree(run_dir, ignore_errors=True)
+
+    # ---- disarm re-check: the live surfaces empty out the moment the
+    # flag drops (the registered stats families are process-lifetime —
+    # phase 0 proved none exist before arming)
+    graph_flags.set("write_obs_enabled", False)
+    storage_flags.set("write_obs_enabled", False)
+    gates["disarm_gauges_empty"] = wp.gauges() == {}
+    gates["disarm_snapshots_view"] = \
+        wp.snapshots_view() == {"enabled": False}
+    graph_flags.set("write_obs_enabled", True)
+    storage_flags.set("write_obs_enabled", True)
+
+    art["gates"] = gates
+    art["ok"] = all(bool(x) for x in gates.values())
+    with open(out_path, "w") as f:
+        json.dump(art, f, indent=1, default=str)
+    log(f"WRITES tier: {json.dumps(gates)}")
+    log(f"wrote {out_path}")
+    if not art["ok"]:
+        failed = [k for k, ok in gates.items() if not ok]
+        raise SystemExit(f"WRITES tier FAILED gates: {failed}")
+
+
 def bench_chaos(out_path: str, trim: bool = False):
     """Chaos tier (`bench.py --chaos`): the 8-session workload under
     injected kernel/mesh/encode faults (common/faults.py; docs/manual/
@@ -4172,6 +4668,13 @@ def main():
             if a.startswith("--out="):
                 out = a.split("=", 1)[1]
         bench_consistency(out, trim="--trim" in sys.argv)
+        return
+    if "--writes" in sys.argv:
+        out = os.environ.get("BENCH_WRITES_OUT", "WRITE_bench.json")
+        for a in sys.argv:
+            if a.startswith("--out="):
+                out = a.split("=", 1)[1]
+        bench_writes(out, trim="--trim" in sys.argv)
         return
     if "--cache-smoke" in sys.argv:
         out = os.environ.get("BENCH_CACHE_OUT", "CACHE_smoke.json")

@@ -139,6 +139,15 @@ def _default_rules() -> List[TriggerRule]:
         # below threshold, a split or blackholed node does not
         TriggerRule("partition_suspected",
                     ("peer_timeout", "peer_ejected"), 8, 5.0),
+        # write-path observatory (common/writepath.py): a change-ring
+        # overrun (snapshot consumer must repack), a WAL fsync past
+        # fsync_stall_ms, an acked write not device-visible past
+        # visibility_stall_ms — all flag-gated/throttled at the
+        # recording site, immediate here; the "writepath" collector
+        # embeds the snapshot lifecycle ledger in every bundle
+        TriggerRule("ring_overrun", ("ring_overrun",)),
+        TriggerRule("fsync_stall", ("fsync_stall",)),
+        TriggerRule("visibility_stall", ("visibility_stall",)),
     ]
 
 

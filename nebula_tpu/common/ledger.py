@@ -65,6 +65,16 @@ FIELDS: Tuple[str, ...] = (
     "cache_hits",       # cache-rung hits on the query's path
     "cache_misses",     # cache-rung misses
     "wal_bytes",        # raft WAL bytes appended for this query
+    # write-path observatory (ISSUE 19, common/writepath.py): the
+    # synchronous write stages' per-query microseconds — appended wire
+    # fields (positional tuple: older peers simply truncate), charged
+    # at the same seams that feed the write.stage.* histograms, so
+    # PROFILE on a mutation renders a per-stage cost block
+    "write_exec_us",    # graph mutation executor run
+    "write_fanout_us",  # StorageClient write fan-out extent
+    "wal_append_us",    # leader WAL append (server-side)
+    "replicate_us",     # replication quorum wait (server-side)
+    "commit_apply_us",  # commit_logs engine apply (server-side)
 )
 
 graph_flags.declare(

@@ -1,0 +1,531 @@
+"""Write-path observatory: per-stage attribution from client ack to
+device visibility (docs/manual/10-observability.md, "Write-path
+observatory").
+
+PRs 4/10/12-15 saturated the READ path with observability; the write
+path was still dark — PAPER.md's FileBasedWal batching and the PR 13
+engine-snapshot-lock convoy are both claims about a pipeline nothing
+could see end to end. This module is the shared core every daemon
+feeds; ROADMAP item 2 (group-commit pipelined raft writes, on-device
+delta compaction) is designed against the numbers it produces.
+
+STAGE TIMELINE — native histograms (`write.stage.<name>_us`, trace
+exemplars) + ledger charges for every write seam:
+
+  execute        graph/engine.py: the mutation sentence's executor run
+  fanout         storage/client.py: the StorageClient write fan-out
+  wal_append     kvstore/raft_store.py: leader WAL append (the
+                 append_async extent, part lock included)
+  replicate      kvstore/raft_store.py: the quorum wait (append future)
+  commit_apply   raft leaders backdate from RaftPart.last_commit_us;
+                 DirectCommit (single replica) times commit_logs itself
+  ring_publish   engine_tpu/provider.py changes_since: the committed-
+                 write feed pull + logical-delta resolve
+  delta_apply    engine_tpu/engine.py _try_apply_deltas (runs under
+                 `engine_snapshot`, so the duration IS lock-hold time)
+  repack         engine_tpu/engine.py _build_fresh full host rebuild
+
+The first six are synchronous with the acking query and ALSO charge
+the PR 12 cost ledger (`write_exec_us` .. `commit_apply_us`, appended
+wire fields), so PROFILE on a mutation renders a per-stage cost block
+the way reads already do. ring_publish/delta_apply/repack are
+asynchronous (device-visibility machinery) and surface through the
+watermark below instead.
+
+ACK-TO-VISIBLE WATERMARK — `watermark.note_ack(space, host, version)`
+at the storage commit ack; `watermark.note_visible(space, token,
+cause)` when a device snapshot advances past that version (delta apply
+or repack install). The gap is the MVCC currency ROADMAP item 2
+optimizes: histogram `write.ack_to_visible_ms` + per-space lag gauges,
+with a `visibility_stall` flight event past `visibility_stall_ms`.
+
+SNAPSHOT LIFECYCLE LEDGER — every live snapshot's build/delta/repack/
+poison/overrun history with durations, trigger causes, lock-hold time
+and device-mem deltas; served by `/snapshots` (a webservice built-in,
+so graphd AND every storaged with device serving expose it) and
+embedded in flight bundles via the "writepath" collector — a
+ring_overrun bundle carries the full lifecycle that led to it.
+
+CHANGE-RING TELEMETRY — occupancy/floor/dropped per space (gauges via
+registered stores), overrun counters with cause attribution: ring
+overrun -> snapshot poison -> full host repack is one attributed chain
+in the ledger, not three disconnected counters.
+
+Disarm contract (the `heat_enabled`/`profile_hz=0` idiom): the MUTABLE
+`write_obs_enabled` flag disarms the whole observatory — every charge
+site is one flag read, no `write.*`/`snapshot.*`/`wal.fsync*` families
+ever register, /metrics is byte-identical to an observatory-free
+build, and /snapshots reports only {"enabled": false}.
+"""
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+import weakref
+from collections import deque
+from contextlib import contextmanager
+from typing import Any, Dict, Optional
+
+from . import ledger as _ledger
+from .flags import MUTABLE, graph_flags, meta_flags, storage_flags
+from .flight import recorder as _flight_recorder
+from .stats import stats as _global_stats
+from .tracing import tracer as _tracer
+
+# stage names in pipeline order (the /snapshots + bench render order)
+STAGES = ("execute", "fanout", "wal_append", "replicate",
+          "commit_apply", "ring_publish", "delta_apply", "repack")
+
+# the synchronous stages' ledger twins (cost observatory, PR 12):
+# stage name -> appended Ledger field
+LEDGER_FIELDS = {
+    "execute": "write_exec_us",
+    "fanout": "write_fanout_us",
+    "wal_append": "wal_append_us",
+    "replicate": "replicate_us",
+    "commit_apply": "commit_apply_us",
+}
+
+# bounded history: per-space lifecycle events / pending acks per host
+LEDGER_EVENTS_CAP = 128
+PENDING_ACKS_CAP = 4096
+
+_REGISTRIES = (graph_flags, storage_flags, meta_flags)
+for _wflags in _REGISTRIES:
+    _wflags.declare(
+        "write_obs_enabled", True, MUTABLE,
+        "write-path observatory master switch: per-stage write "
+        "histograms (write.stage.*), ack-to-visible watermark, "
+        "snapshot lifecycle ledger (/snapshots), change-ring & WAL "
+        "fsync telemetry and the ring_overrun/fsync_stall/"
+        "visibility_stall flight triggers; off = every charge site is "
+        "one flag read and /metrics is byte-identical to an "
+        "observatory-free build")
+    _wflags.declare(
+        "visibility_stall_ms", 0, MUTABLE,
+        "flight-recorder visibility_stall trigger: an acked write not "
+        "servable from the device snapshot after this many ms records "
+        "a stall event (evaluated on watermark advance + /metrics "
+        "scrape, throttled to 1/s per space); 0 disarms")
+    _wflags.declare(
+        "fsync_stall_ms", 0, MUTABLE,
+        "flight-recorder fsync_stall trigger: a WAL fsync (or "
+        "sync-every-append durable append) slower than this many ms "
+        "records a stall event with the fsync latency; 0 disarms")
+    _wflags.declare(
+        "change_ring_ops", 0, MUTABLE,
+        "override the engine change-ring op capacity (entries) at ring "
+        "construction — REBOOT-effective per engine; the write bench "
+        "shrinks it to force genuine overruns; 0 = built-in 4096")
+    _wflags.declare(
+        "change_ring_kvs", 0, MUTABLE,
+        "override the engine change-ring kv capacity at ring "
+        "construction (REBOOT-effective per engine); 0 = built-in "
+        "131072")
+
+
+def _flag(name: str, default):
+    """First non-default value across the registries (graph first) —
+    the flight/heat multi-registry idiom."""
+    for reg in _REGISTRIES:
+        v = reg.get(name, default)
+        if v is not None and v != default:
+            return v
+    return default
+
+
+def enabled() -> bool:
+    return bool(_flag("write_obs_enabled", True))
+
+
+# swappable for the disarm byte-identity test (tier-1 runs share one
+# process-global StatsManager, so the test injects a private one)
+stats = _global_stats
+
+
+def _trace_id() -> Optional[str]:
+    cur = _tracer.current_state()
+    return cur[0].trace_id if cur is not None else None
+
+
+def stage(name: str, us: float,
+          trace_id: Optional[str] = None) -> None:
+    """One write-stage observation -> native histogram with exemplar."""
+    if not enabled():
+        return
+    stats.add_value(f"write.stage.{name}_us", int(us), kind="histogram",
+                    trace_id=trace_id if trace_id is not None
+                    else (_trace_id() or ""))
+
+
+# nested same-name stages (DELETE VERTEX fans out edge deletes through
+# the same client, whose delete_edges times its own fanout) must not
+# double-charge: the outer extent already contains the inner one
+_in_stage = contextvars.ContextVar("writepath_in_stage", default=())
+
+
+@contextmanager
+def timed_stage(name: str, ledger_field: Optional[str] = None,
+                host: Optional[str] = None):
+    """Time a synchronous write seam: records the stage histogram when
+    armed AND charges the cost-ledger twin unconditionally (the PR 12
+    ledger has its own gating contract). Reentrant per stage name —
+    the inner extent is a no-op."""
+    active = _in_stage.get()
+    if name in active:
+        yield
+        return
+    tok = _in_stage.set(active + (name,))
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        _in_stage.reset(tok)
+        us = int((time.perf_counter() - t0) * 1e6)
+        if ledger_field is not None:
+            led = _ledger.current()
+            if led is not None:
+                if host is not None:
+                    led.charge_host(host, **{ledger_field: us})
+                else:
+                    led.charge(**{ledger_field: us})
+        stage(name, us)
+
+
+# ---------------------------------------------------------------------------
+# ack-to-visible watermark
+# ---------------------------------------------------------------------------
+class VisibilityWatermark:
+    """Per-space registry of acked-but-not-yet-device-visible writes.
+
+    `note_ack` runs at the storage commit ack with the space engine's
+    post-commit write_version — the same monotonic token the snapshot
+    providers ride, so visibility is a pure version comparison, never a
+    clock guess. `note_visible` accepts both provider token shapes: a
+    bare int (LocalStoreProvider) satisfies every host's acks at or
+    below it; a {host: version} dict (RemoteStorageProvider) satisfies
+    per host, and pending hosts the token doesn't know are satisfied
+    against min(token values) — conservative, never early."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # space -> host -> deque[(version, t_mono)]
+        self._pending: Dict[int, Dict[str, deque]] = {}
+        self._acked: Dict[int, int] = {}
+        self._visible: Dict[int, int] = {}
+        self._dropped: Dict[int, int] = {}
+        self._last_cause: Dict[int, str] = {}
+        self._stall_ts: Dict[int, float] = {}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._pending.clear()
+            self._acked.clear()
+            self._visible.clear()
+            self._dropped.clear()
+            self._last_cause.clear()
+            self._stall_ts.clear()
+
+    def note_ack(self, space_id: int, host: str, version: int) -> None:
+        if not enabled():
+            return
+        now = time.monotonic()
+        with self._lock:
+            hosts = self._pending.setdefault(int(space_id), {})
+            dq = hosts.get(host)
+            if dq is None:
+                dq = hosts[host] = deque()
+            dq.append((int(version), now))
+            if len(dq) > PENDING_ACKS_CAP:
+                dq.popleft()
+                self._dropped[space_id] = \
+                    self._dropped.get(space_id, 0) + 1
+            self._acked[space_id] = self._acked.get(space_id, 0) + 1
+        stats.add_value("write.acked", kind="counter")
+
+    def note_visible(self, space_id: int, token,
+                     cause: str = "delta") -> None:
+        if not enabled() or token is None:
+            return
+        space_id = int(space_id)
+        now = time.monotonic()
+        popped = []
+        with self._lock:
+            hosts = self._pending.get(space_id)
+            if hosts:
+                if isinstance(token, dict):
+                    floor = min(token.values()) if token else 0
+                    vfor = lambda h: token.get(h, floor)  # noqa: E731
+                else:
+                    tv = int(token)
+                    vfor = lambda h: tv                   # noqa: E731
+                for h, dq in hosts.items():
+                    v = vfor(h)
+                    while dq and dq[0][0] <= v:
+                        popped.append(dq.popleft()[1])
+            if popped:
+                self._visible[space_id] = \
+                    self._visible.get(space_id, 0) + len(popped)
+                self._last_cause[space_id] = cause
+        for t_ack in popped:
+            stats.add_value("write.ack_to_visible_ms",
+                            (now - t_ack) * 1e3, kind="histogram",
+                            trace_id=_trace_id() or "")
+        if popped:
+            stats.add_value("write.visible", len(popped),
+                            kind="counter")
+        self._check_stall(space_id, now)
+
+    def lag_ms(self, space_id: int) -> float:
+        """Age of the oldest acked-but-not-visible write (0 = none)."""
+        now = time.monotonic()
+        with self._lock:
+            hosts = self._pending.get(int(space_id)) or {}
+            oldest = min((dq[0][1] for dq in hosts.values() if dq),
+                        default=None)
+        return 0.0 if oldest is None else (now - oldest) * 1e3
+
+    def _check_stall(self, space_id: int, now: float) -> None:
+        thr = float(_flag("visibility_stall_ms", 0) or 0)
+        if thr <= 0:
+            return
+        if now - self._stall_ts.get(space_id, 0.0) < 1.0:
+            return
+        lag = self.lag_ms(space_id)
+        if lag > thr:
+            self._stall_ts[space_id] = now
+            with self._lock:
+                hosts = self._pending.get(space_id) or {}
+                pending = sum(len(dq) for dq in hosts.values())
+            _flight_recorder.record(
+                "visibility_stall", space=space_id,
+                lag_ms=round(lag, 1), pending=pending,
+                threshold_ms=thr)
+
+    def scrape(self) -> None:
+        """Gauge-time stall evaluation (a stalled space with no further
+        note_visible calls must still fire)."""
+        with self._lock:
+            spaces = list(self._pending)
+        now = time.monotonic()
+        for sid in spaces:
+            self._check_stall(sid, now)
+
+    def stats_view(self) -> Dict[int, Dict[str, Any]]:
+        with self._lock:
+            out = {}
+            for sid, hosts in self._pending.items():
+                out[sid] = {
+                    "pending": sum(len(dq) for dq in hosts.values()),
+                    "acked": self._acked.get(sid, 0),
+                    "visible": self._visible.get(sid, 0),
+                    "dropped": self._dropped.get(sid, 0),
+                    "last_cause": self._last_cause.get(sid),
+                }
+        for sid in out:
+            out[sid]["lag_ms"] = round(self.lag_ms(sid), 2)
+        return out
+
+
+# ---------------------------------------------------------------------------
+# snapshot lifecycle ledger
+# ---------------------------------------------------------------------------
+class SnapshotLedger:
+    """Bounded per-space history of device-snapshot lifecycle events:
+    build / delta_apply / poison / repack / overrun, each with
+    duration, trigger cause, lock-hold time under `engine_snapshot`
+    and device-mem delta where the event changes residency. The
+    /snapshots body and the flight "writepath" collector both read it,
+    so every ring_overrun bundle carries the chain that led to it."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events: Dict[int, deque] = {}
+        self._counts: Dict[str, int] = {}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._counts.clear()
+
+    def note(self, space_id: int, event: str, **detail) -> None:
+        if not enabled():
+            return
+        rec = {"t": round(time.time(), 3), "event": event}
+        rec.update({k: v for k, v in detail.items() if v is not None})
+        with self._lock:
+            dq = self._events.get(int(space_id))
+            if dq is None:
+                dq = self._events[int(space_id)] = deque(
+                    maxlen=LEDGER_EVENTS_CAP)
+            dq.append(rec)
+            self._counts[event] = self._counts.get(event, 0) + 1
+        stats.add_value(f"snapshot.{event}", kind="counter")
+
+    def view(self) -> Dict[str, Any]:
+        with self._lock:
+            return {"counts": dict(self._counts),
+                    "spaces": {sid: list(dq)
+                               for sid, dq in self._events.items()}}
+
+
+watermark = VisibilityWatermark()
+snapshots = SnapshotLedger()
+
+# live info sources: TPU engines (per-space snapshot status) and
+# GraphStores (change-ring occupancy) register weakly at construction
+_ENGINES: "weakref.WeakSet" = weakref.WeakSet()
+_STORES: "weakref.WeakSet" = weakref.WeakSet()
+
+
+def register_engine(engine) -> None:
+    _ENGINES.add(engine)
+
+
+def register_store(store) -> None:
+    _STORES.add(store)
+
+
+def ring_status() -> Dict[int, Dict[str, int]]:
+    """Change-ring occupancy per space, summed across registered
+    stores (one store per daemon; the in-proc bench sums replicas)."""
+    out: Dict[int, Dict[str, int]] = {}
+    for store in list(_STORES):
+        try:
+            spaces = store.spaces()
+        except Exception:
+            continue
+        for sid in spaces:
+            eng = store.space_engine(sid)
+            ring = getattr(eng, "changes", None)
+            if ring is None:
+                continue
+            occ = ring.occupancy()
+            acc = out.setdefault(int(sid), {"ops": 0, "kvs": 0,
+                                            "floor": 0, "dropped": 0,
+                                            "cap_ops": 0})
+            for k in acc:
+                acc[k] += occ.get(k, 0)
+    return out
+
+
+def note_ring_overrun(space_id: int, cause: str = "truncated",
+                      **detail) -> None:
+    """A snapshot consumer found the change ring no longer reaches its
+    cursor (or a host-set change / injected overrun forced the same
+    decline): counter + flight event + lifecycle ledger entry. The
+    poison and repack that follow carry this cause forward."""
+    if not enabled():
+        return
+    stats.add_value("write.ring.overrun", kind="counter")
+    occ = ring_status().get(int(space_id))
+    snapshots.note(space_id, "overrun", cause=cause, ring=occ, **detail)
+    _flight_recorder.record("ring_overrun", space=space_id, cause=cause,
+                            ring=occ, **detail)
+
+
+def note_ring_barrier(space_id: int) -> None:
+    if not enabled():
+        return
+    stats.add_value("write.ring.barrier", kind="counter")
+
+
+def note_fsync(us: float) -> None:
+    """One durable WAL sync (explicit sync() or a sync-every-append
+    durable append): latency histogram with trace exemplar, plus the
+    fsync_stall flight event past `fsync_stall_ms`."""
+    if not enabled():
+        return
+    stats.add_value("wal.fsync_us", int(us), kind="histogram",
+                    trace_id=_trace_id() or "")
+    thr = float(_flag("fsync_stall_ms", 0) or 0)
+    if thr > 0 and us > thr * 1e3:
+        _flight_recorder.record("fsync_stall", us=int(us),
+                                threshold_ms=thr)
+
+
+def ring_cap_ops(default: int) -> int:
+    return int(_flag("change_ring_ops", 0) or 0) or default
+
+
+def ring_cap_kvs(default: int) -> int:
+    return int(_flag("change_ring_kvs", 0) or 0) or default
+
+
+# ---------------------------------------------------------------------------
+# surfaces: /snapshots body, flight collector, /metrics gauges
+# ---------------------------------------------------------------------------
+def snapshots_view() -> Dict[str, Any]:
+    """The /snapshots endpoint body (graphd + every storaged; the
+    flight "writepath" collector captures the same view)."""
+    if not enabled():
+        return {"enabled": False}
+    engines = []
+    for eng in list(_ENGINES):
+        try:
+            engines.append(eng.snapshots_status())
+        except Exception:
+            continue
+    return {
+        "enabled": True,
+        "watermark": watermark.stats_view(),
+        "ledger": snapshots.view(),
+        "rings": ring_status(),
+        "engines": engines,
+    }
+
+
+def gauges() -> Dict[str, float]:
+    """Per-space /metrics gauges (registered as a webservice metric
+    source on every daemon). Disarmed -> {} (byte-identity)."""
+    if not enabled():
+        return {}
+    watermark.scrape()   # stalled spaces fire without fresh advances
+    # NOTE: gauge-source names are UNPREFIXED dotted paths — the
+    # webservice runs every source through _prom_name("nebula", ...),
+    # so a literal "nebula_" here would scrape as nebula_nebula_*.
+    out: Dict[str, float] = {}
+    for sid, wm in watermark.stats_view().items():
+        out[f"write.visible_lag_ms_s{sid}"] = float(wm["lag_ms"])
+        out[f"write.pending_acks_s{sid}"] = float(wm["pending"])
+    for sid, occ in ring_status().items():
+        out[f"write.ring_ops_s{sid}"] = float(occ["ops"])
+        out[f"write.ring_kvs_s{sid}"] = float(occ["kvs"])
+        out[f"write.ring_dropped_s{sid}"] = float(occ["dropped"])
+    return out
+
+
+def reset() -> None:
+    """Bench/test helper: drop watermark + lifecycle state (stats
+    families live in the process-global StatsManager and stay)."""
+    watermark.reset()
+    snapshots.reset()
+
+
+def seam_cost_probe(n: int = 2000) -> float:
+    """Measured per-write cost of the armed observatory seams, in µs —
+    the PR 14 deterministic overhead idiom (time the seam itself, not
+    a noisy A/B workload). One probe write = every synchronous stage
+    record + an ack + a visible advance."""
+    sid = 1 << 30   # private space id, cleaned below
+    t0 = time.perf_counter()
+    for i in range(n):
+        for s in ("execute", "fanout", "wal_append", "replicate",
+                  "commit_apply"):
+            stage(s, 5.0, trace_id="")
+        watermark.note_ack(sid, "probe", i)
+        watermark.note_visible(sid, i, cause="delta")
+    per_write_us = (time.perf_counter() - t0) * 1e6 / max(n, 1)
+    with watermark._lock:
+        watermark._pending.pop(sid, None)
+        watermark._acked.pop(sid, None)
+        watermark._visible.pop(sid, None)
+        watermark._last_cause.pop(sid, None)
+    return per_write_us
+
+
+# every flight bundle (and specifically ring_overrun bundles) embeds
+# the lifecycle ledger + watermark via this collector — idempotent,
+# process-global, the heat-collector idiom
+_flight_recorder.add_collector("writepath", snapshots_view)

@@ -52,6 +52,7 @@ from ..common.qos import LANE_BULK, LANE_INTERACTIVE, OverloadShed
 from ..common.stats import stats as global_stats
 from ..common.threads import traced_thread
 from ..common.tracing import tracer as _tr
+from ..common import writepath as _writepath
 from ..common.status import ErrorCode, Status, StatusOr
 from ..filter.expressions import (Expression, InputPropExpr,
                                   VariablePropExpr, encode_expression)
@@ -79,6 +80,15 @@ def _drain_prewarm_threads() -> None:
 
 
 DEFAULT_MAX_EDGES_PER_VERTEX = 10000
+
+
+def _snap_bytes(snap) -> int:
+    """Device bytes resident for a snapshot (0 when the walk declines)
+    — the write-path lifecycle ledger's device-mem delta source."""
+    try:
+        return int(snap.device_mem().get("bytes", 0))
+    except Exception:
+        return 0
 
 
 class _BudgetExceeded(Exception):
@@ -167,6 +177,9 @@ class TpuGraphEngine:
         # waits feed the nebula_lock_wait_us_engine_snapshot histogram
         # + the /profile?locks=1 table
         self._lock = _profiler.profiled_rlock("engine_snapshot")
+        # write-path observatory: /snapshots + the flight "writepath"
+        # collector read per-space lifecycle status via weak registry
+        _writepath.register_engine(self)
         # tiny leaf lock for counters bumped OUTSIDE the engine lock
         # (pre-lock decline paths, off-lock window encode): dict-int
         # += is read-add-store and loses increments under thread
@@ -685,6 +698,32 @@ class TpuGraphEngine:
         return {"last": getattr(self, "_audit_last", None),
                 "snapshots": snaps}
 
+    def snapshots_status(self) -> Dict[str, Any]:
+        """Per-space live snapshot status for the write-path
+        observatory's /snapshots body (common/writepath.py): version,
+        staleness, delta occupancy, repack-in-flight and approximate
+        device residency — the instantaneous complement of the
+        lifecycle ledger's event history."""
+        with self._lock:
+            spaces = {}
+            for sid, snap in self._snapshots.items():
+                d = snap.delta
+                spaces[str(sid)] = {
+                    "write_version": str(snap.write_version),
+                    "stale": bool(snap.stale),
+                    "sharded": getattr(snap, "sharded_kernel",
+                                       None) is not None,
+                    "delta_edges": 0 if d is None else d.edge_count,
+                    "delta_tombs": 0 if d is None else d.tomb_count,
+                    "device_bytes": _snap_bytes(snap),
+                    "repacking": bool(self._repacking.get(sid)),
+                }
+        with self._stats_lock:
+            counters = {k: self.stats[k] for k in
+                        ("rebuilds", "bg_repacks", "delta_applies",
+                         "snapshot_poisoned", "repack_failures")}
+        return {"spaces": spaces, "counters": counters}
+
     # ------------------------------------------------------------------
     # snapshot lifecycle
     # ------------------------------------------------------------------
@@ -719,6 +758,7 @@ class TpuGraphEngine:
         remote = getattr(self._provider, "_client", None) is not None
         fail_fast = replacement or remote
         token = no_retry_sleep.set(True) if fail_fast else None
+        t0 = time.perf_counter()
         try:
             snap = self._build_fresh(space_id)
         finally:
@@ -729,12 +769,26 @@ class TpuGraphEngine:
                 # converge off-lock: the repack ladder retries with its
                 # own backoff while queries keep the previous snapshot
                 # (or, remote, the cluster/CPU ladder)
-                self._kick_repack(space_id)
+                self._kick_repack(space_id, cause="refresh_failed")
             return None
+        old = self._snapshots.get(space_id)
         self._snapshots[space_id] = snap
         self.stats["rebuilds"] += 1
         self._space_churn[space_id] = \
             self._space_churn.get(space_id, 0) + 1
+        # lifecycle ledger + watermark: a fresh build makes every write
+        # at or below its capture token device-visible (runs under the
+        # engine lock — counter-class records only, the read_fence
+        # precedent; no spans here)
+        build_us = int((time.perf_counter() - t0) * 1e6)
+        _writepath.snapshots.note(
+            space_id, "build", dur_us=build_us,
+            cause="replace" if replacement else "first_touch",
+            device_bytes=_snap_bytes(snap),
+            device_bytes_delta=_snap_bytes(snap) - (
+                _snap_bytes(old) if old is not None else 0))
+        _writepath.watermark.note_visible(
+            space_id, getattr(snap, "delta_cursor", None), cause="build")
         self._maybe_recalibrate(space_id, snap)
         return snap
 
@@ -993,7 +1047,7 @@ class TpuGraphEngine:
                     "space %d demoted to single-device serving "
                     "(unsharded rebuild kicked; half-open mesh probes "
                     "re-admit)", snap.space_id)
-            self._kick_repack(snap.space_id)
+            self._kick_repack(snap.space_id, cause="mesh_demotion")
 
     def breaker_states(self) -> Dict[str, str]:
         with self._stats_lock:   # _breaker() inserts concurrently
@@ -1291,7 +1345,7 @@ class TpuGraphEngine:
             b = self._breakers.get("mesh")
             if b is not None and b.allow():
                 self._mesh_demoted.discard(space_id)
-                if not self._kick_repack(space_id):
+                if not self._kick_repack(space_id, cause="mesh_readmit"):
                     self._mesh_demoted.add(space_id)   # retry later
         token = self._version_nosleep(space_id)
         if token is None:
@@ -1328,7 +1382,15 @@ class TpuGraphEngine:
             snap.stale = True
             self.stats["snapshot_poisoned"] += 1
             global_stats.add_value("tpu_engine.snapshot_poisoned", kind="counter")
-            _flight.record("snapshot_poisoned", space=space_id)
+            # the provider stamped WHY the pull declined (ring overrun /
+            # barrier / pull failure) — the poison event and lifecycle
+            # ledger carry that cause so overrun -> poison -> repack
+            # reads as one attributed chain, not three counters
+            cause = getattr(self._provider, "last_decline",
+                            None) or "apply_failed"
+            _flight.record("snapshot_poisoned", space=space_id,
+                           cause=cause)
+            _writepath.snapshots.note(space_id, "poison", cause=cause)
             # poison hygiene: drop the space's cached results/declines
             # alongside the snapshot (entries are already version-
             # orphaned; this frees them and counts the purge) — and the
@@ -1336,7 +1398,7 @@ class TpuGraphEngine:
             # CSR caches (the repack's fresh build re-creates them)
             self._invalidate_prop_indexes(snap)
             self._purge_space_cache(space_id)
-            self._kick_repack(space_id)
+            self._kick_repack(space_id, cause=cause)
             return None
         return self.refresh(space_id)
 
@@ -1440,6 +1502,7 @@ class TpuGraphEngine:
         # CPU pipe -> background repack). Same invariant as refresh().
         from ..common.faults import no_retry_sleep
         _tok = no_retry_sleep.set(True)
+        t0 = time.perf_counter()
         try:
             entries, new_cursor = cs(snap.space_id, cursor)
         finally:
@@ -1478,15 +1541,27 @@ class TpuGraphEngine:
         # lineage digest at that version (None when a write raced —
         # the auditor then skips until the next build/apply)
         self._record_store_digest(snap)
+        # write-path observatory: the whole apply ran under
+        # `engine_snapshot`, so this extent IS the lock-hold cost the
+        # ROADMAP item 2 delta-compaction work optimizes; the cursor
+        # advance makes every write at or below it device-visible
+        us = int((time.perf_counter() - t0) * 1e6)
+        _writepath.stage("delta_apply", us)
+        if entries:
+            _writepath.snapshots.note(
+                snap.space_id, "delta_apply", dur_us=us, lock_us=us,
+                entries=len(entries))
+        _writepath.watermark.note_visible(snap.space_id, new_cursor,
+                                          cause="delta")
         d = snap.delta
         if d is not None:
             self.stats["delta_edges"] = d.edge_count
             if d.edge_count + d.tomb_count > 0.75 * d.max_edges:
                 # fold the delta into a fresh base while still serving
-                self._kick_repack(snap.space_id)
+                self._kick_repack(snap.space_id, cause="delta_full")
         return True
 
-    def _kick_repack(self, space_id: int) -> bool:
+    def _kick_repack(self, space_id: int, cause: str = "kick") -> bool:
         """Rebuild off the query path; queries keep serving the current
         snapshot (or CPU fallback when poisoned) until the swap.
         Returns True when a rebuild thread actually started (False: one
@@ -1508,6 +1583,7 @@ class TpuGraphEngine:
         self._repacking[space_id] = True
 
         def run():
+            t0 = time.perf_counter()
             try:
                 snap = self._build_fresh(space_id)   # scan without lock
                 if snap is not None:
@@ -1523,7 +1599,9 @@ class TpuGraphEngine:
                         # the engine lock)
                         from . import mesh_exec
                         mesh_exec.ensure_sharded_aligned(self.mesh, snap)
+                    t_lock = time.perf_counter()
                     with self._lock:                 # swap under lock
+                        old = self._snapshots.get(space_id)
                         self._snapshots[space_id] = snap
                         # a repack swap is a snapshot version like any
                         # other: it counts toward the budget-staleness
@@ -1534,12 +1612,34 @@ class TpuGraphEngine:
                     self.stats["rebuilds"] += 1
                     self.stats["bg_repacks"] += 1
                     self._repack_backoff.pop(space_id, None)
+                    # observatory: the repack folded every committed
+                    # write up to the build's capture token into the
+                    # served snapshot — record the full-rebuild cost
+                    # (stage histogram), lifecycle event (with swap
+                    # lock-hold + device-mem delta) and watermark
+                    # advance, all OFF the engine lock
+                    us = int((time.perf_counter() - t0) * 1e6)
+                    _writepath.stage("repack", us, trace_id="")
+                    _writepath.snapshots.note(
+                        space_id, "repack", dur_us=us, cause=cause,
+                        lock_us=int((time.perf_counter() - t_lock)
+                                    * 1e6),
+                        device_bytes=_snap_bytes(snap),
+                        device_bytes_delta=_snap_bytes(snap) - (
+                            _snap_bytes(old) if old is not None
+                            else 0))
+                    _writepath.watermark.note_visible(
+                        space_id, getattr(snap, "delta_cursor", None),
+                        cause="repack")
             except Exception:
                 n = fails + 1
                 delay = min(2.0 ** (n - 1), 60.0)
                 self._repack_backoff[space_id] = (n, time.time() + delay)
                 self.stats["repack_failures"] += 1
                 global_stats.add_value("tpu_engine.repack_failures", kind="counter")
+                _writepath.snapshots.note(
+                    space_id, "repack_failed", cause=cause,
+                    consecutive=n, retry_in_s=round(delay, 1))
                 _LOG.exception(
                     "background repack of space %d failed (consecutive "
                     "failure %d, next attempt in %.0fs); continuing to "

@@ -19,12 +19,26 @@ stale.
 """
 from __future__ import annotations
 
+import time
 from typing import Optional
 
 import numpy as np
 
+from ..common import writepath as _writepath
+from ..common.faults import InjectedFault, faults
 from ..kvstore.scan import ScanCols
 from .csr import CsrSnapshot, build_shards, build_snapshot
+
+# ring.overrun (docs/manual/9-robustness.md): forces the next
+# changes_since pull to decline exactly the way a truncated change
+# ring does — the consumer must poison its snapshot and repack. The
+# write bench/tier-1 tests use it to prove the overrun -> poison ->
+# repack cause chain deterministically (a REAL overrun needs a write
+# burst past the ring cap, which the churn phase also drives).
+faults.register("ring.overrun",
+                doc="decline a changes_since pull as if the change "
+                    "ring had truncated past the consumer's cursor — "
+                    "snapshot poison + full host repack follow")
 
 
 class SnapshotBuildError(RuntimeError):
@@ -59,17 +73,36 @@ class LocalStoreProvider:
     def changes_since(self, space_id: int, cursor):
         """Committed writes since `cursor` as resolved logical deltas.
         -> (entries | None, new_cursor); None entries = rebuild (ring
-        truncated or a barrier op)."""
+        truncated or a barrier op). Declines stamp `last_decline` so
+        the consumer's poison event carries the cause (overrun ->
+        poison -> repack, one attributed chain)."""
         from ..kvstore.changelog import resolve_changes
+        self.last_decline = None
         engine = self._store.space_engine(space_id)
         if engine is None or getattr(engine, "changes", None) is None:
+            self.last_decline = "no_engine"
             return None, cursor
+        try:
+            faults.fire("ring.overrun")
+        except InjectedFault:
+            self.last_decline = "ring_overrun"
+            _writepath.note_ring_overrun(space_id, cause="injected",
+                                         cursor=cursor)
+            return None, cursor
+        t0 = time.perf_counter()
         now_v, raw = engine.changes_snapshot(cursor)
         if raw is None:
+            self.last_decline = "ring_overrun"
+            _writepath.note_ring_overrun(space_id, cause="truncated",
+                                         cursor=cursor)
             return None, cursor
         entries = resolve_changes(engine, raw)
         if entries is None:
+            self.last_decline = "barrier"
+            _writepath.note_ring_barrier(space_id)
             return None, cursor
+        _writepath.stage("ring_publish",
+                         (time.perf_counter() - t0) * 1e6)
         return entries, now_v
 
 
@@ -137,11 +170,22 @@ class RemoteStorageProvider:
         local write by one push (~50ms), and trusting them here would
         stamp the snapshot fresh without that write.
         -> (entries | None, new_cursor)."""
+        self.last_decline = None
         token = self.version(space_id)
         if token is None:
+            self.last_decline = "no_version"
             return None, cursor
         if {h for h, _ in token[0]} != set(cursor):
+            self.last_decline = "host_set_changed"
             return None, cursor          # host set changed: rebuild
+        try:
+            faults.fire("ring.overrun")
+        except InjectedFault:
+            self.last_decline = "ring_overrun"
+            _writepath.note_ring_overrun(space_id, cause="injected",
+                                         cursor=dict(cursor))
+            return None, cursor
+        t0 = time.perf_counter()
         entries = []
         new_cursor = dict(cursor)
         for host, since in cursor.items():
@@ -149,9 +193,19 @@ class RemoteStorageProvider:
                 now_v, es = self._client.host_changes_since(host, space_id,
                                                             since)
             except Exception:
+                self.last_decline = "pull_failed"
                 return None, cursor
             if es is None:
+                # the serving host's ring truncated past our cursor
+                # (or a barrier op — the host can't distinguish over
+                # the wire; either way the consumer repacks)
+                self.last_decline = "ring_overrun"
+                _writepath.note_ring_overrun(space_id,
+                                             cause="truncated",
+                                             host=host, cursor=since)
                 return None, cursor
             entries.extend(es)
             new_cursor[host] = now_v
+        _writepath.stage("ring_publish",
+                         (time.perf_counter() - t0) * 1e6)
         return entries, new_cursor

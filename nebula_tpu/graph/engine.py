@@ -10,7 +10,7 @@ from __future__ import annotations
 import time
 from typing import Callable, Dict, Optional
 
-from ..common import consistency, ledger, qos
+from ..common import consistency, ledger, qos, writepath
 from ..common.cache import CacheRung, plan_stage_enabled
 from ..common.status import ErrorCode, Status, StatusOr
 from ..common.tracing import (ActiveQueryRegistry, SlowQueryLog,
@@ -262,7 +262,16 @@ class ExecutionEngine:
                     tracer.tag_root("qos_lane", qos.LANE_BULK)
             try:
                 with tracer.span("exec." + sentence.kind.value):
-                    r = self._run(ctx, sentence)
+                    if sentence.kind in _WRITE_KINDS:
+                        # write-path observatory: the mutation
+                        # executor's full run is the `execute` stage of
+                        # the write timeline (common/writepath.py); the
+                        # StorageClient fan-out below it times itself
+                        with writepath.timed_stage("execute",
+                                                   "write_exec_us"):
+                            r = self._run(ctx, sentence)
+                    else:
+                        r = self._run(ctx, sentence)
             except qos.OverloadShed as e:
                 # a dispatcher shed surfaces with the SAME machine-
                 # readable contract as an admission denial: typed
@@ -605,7 +614,16 @@ class GraphService:
             # the profile map (the one extensible slot of the frozen
             # ExecutionResponse — see graph/context.py)
             resp.profile = dict(resp.profile) if resp.profile else {}
-            resp.profile["cost"] = led.to_dict()
+            cost = led.to_dict()
+            resp.profile["cost"] = cost
+            # PROFILE on a mutation renders the per-stage write
+            # timeline the way reads already render their cost block:
+            # the synchronous stages' ledger charges, in pipeline order
+            ws = {st: cost[f]
+                  for st, f in writepath.LEDGER_FIELDS.items()
+                  if cost.get(f)}
+            if ws:
+                resp.profile["write_stages"] = ws
         # per-query QPS/latency metrics + slow-op log (ref: per-query
         # latency_in_us in every response, SlowOpTracker)
         from ..common.flags import graph_flags

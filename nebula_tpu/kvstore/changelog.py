@@ -49,15 +49,21 @@ class ChangeRing:
     per engine call)."""
 
     def __init__(self, cap_ops: int = 4096, cap_kvs: int = 131072):
+        # the write-path observatory's REBOOT-effective cap overrides
+        # (change_ring_ops/change_ring_kvs; the write bench shrinks
+        # them to force genuine overruns) apply at construction — the
+        # ring is born with an engine and lives exactly as long
+        from ..common import writepath as _writepath
         self._entries: deque = deque()
         self._lock = threading.Lock()
-        self._cap_ops = cap_ops
-        self._cap_kvs = cap_kvs
+        self._cap_ops = _writepath.ring_cap_ops(cap_ops)
+        self._cap_kvs = _writepath.ring_cap_kvs(cap_kvs)
         self._kvs = 0
         # highest version known to be dropped from the ring; a `since`
         # at or below this can't be served (0 = nothing dropped yet,
         # and version 0 predates every write)
         self._floor = 0
+        self._dropped = 0
 
     def record(self, version: int, op: str, payload) -> None:
         n = len(payload) if isinstance(payload, list) else 1
@@ -69,6 +75,16 @@ class ChangeRing:
                 v, _, p = self._entries.popleft()
                 self._kvs -= len(p) if isinstance(p, list) else 1
                 self._floor = v
+                self._dropped += 1
+
+    def occupancy(self) -> dict:
+        """Ring telemetry (write-path observatory gauges/flight
+        bundles): live op/kv counts, the truncation floor and how many
+        ops have ever been dropped past it."""
+        with self._lock:
+            return {"ops": len(self._entries), "kvs": self._kvs,
+                    "floor": self._floor, "dropped": self._dropped,
+                    "cap_ops": self._cap_ops}
 
     def since(self, version: int) -> Optional[List[RawEntry]]:
         """Entries with version > `version`, oldest first; None when the

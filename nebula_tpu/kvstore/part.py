@@ -16,11 +16,14 @@ keeps consensus *below* the KVStore interface and out of the read path.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, Iterable, List, Optional, Tuple
 
 from ..common import consistency as _consistency
 from ..common import keys as keyutils
+from ..common import ledger as _ledger
 from ..common import profiler as _profiler
+from ..common import writepath as _writepath
 from ..common.faults import InjectedFault, faults
 from ..common.status import ErrorCode, Status
 from . import log_encoder as le
@@ -382,18 +385,31 @@ class ConsensusHook:
 
 
 class DirectCommit(ConsensusHook):
-    """Single-replica commit path: serialize + apply immediately."""
+    """Single-replica commit path: serialize + apply immediately.
+    The commit_apply write stage (write-path observatory) is timed
+    here — the raft path backdates the same stage from the part's
+    commit accounting instead (kvstore/raft_store.py)."""
 
     def __init__(self, part: Part):
         self._part = part
         self._lock = threading.Lock()
         self._next_log_id = 1
 
+    def _commit(self, log_id: int, log: bytes) -> Status:
+        t0 = time.perf_counter()
+        st = self._part.commit_logs([(log_id, 1, log)])
+        us = (time.perf_counter() - t0) * 1e6
+        led = _ledger.current()
+        if led is not None:
+            led.charge(commit_apply_us=us)
+        _writepath.stage("commit_apply", us)
+        return st
+
     def submit(self, log: bytes) -> Status:
         with self._lock:
             log_id = self._next_log_id
             self._next_log_id += 1
-            return self._part.commit_logs([(log_id, 1, log)])
+            return self._commit(log_id, log)
 
     def submit_atomic(self, op: AtomicOp) -> Status:
         with self._lock:
@@ -402,4 +418,4 @@ class DirectCommit(ConsensusHook):
                 return Status.error(ErrorCode.E_FILTER_OUT, "atomic op aborted")
             log_id = self._next_log_id
             self._next_log_id += 1
-            return self._part.commit_logs([(log_id, 1, log)])
+            return self._commit(log_id, log)

@@ -16,11 +16,13 @@ reference's in-process multi-server fixture idiom.
 from __future__ import annotations
 
 import os
+import time
 from concurrent.futures import Future
 from typing import Dict, List, Optional
 
 from ..common import keys as keyutils
 from ..common import ledger
+from ..common import writepath as _writepath
 from ..common.stats import stats
 from ..common.status import ErrorCode, Status
 from ..common.tracing import tracer
@@ -135,27 +137,58 @@ class RaftConsensusHook(ConsensusHook):
         # the commit_logs apply (replicator thread, under the part
         # lock — off-limits for recording, PR 10 rule) is backdated
         # from the part's last-commit accounting after the wait.
+        t0 = time.perf_counter()
         with tracer.span("raft.append_wal", bytes=len(log)):
             fut = self.raft.append_async(log)
+        t1 = time.perf_counter()
         led = ledger.current()
         if led is not None:
             led.wal_bytes += len(log)
+            led.charge(wal_append_us=(t1 - t0) * 1e6)
         stats.add_value("raftex.append_bytes", len(log), kind="counter")
+        _writepath.stage("wal_append", (t1 - t0) * 1e6)
         with tracer.span("raft.replicate"):
             st = self._wait(fut)
-        if st.ok() and tracer.active() and self.raft.last_commit_us:
-            tracer.add_span("raft.commit_logs", self.raft.last_commit_us,
-                            entries=self.raft.last_commit_n)
+        t2 = time.perf_counter()
+        if led is not None:
+            led.charge(replicate_us=(t2 - t1) * 1e6)
+        _writepath.stage("replicate", (t2 - t1) * 1e6)
+        if st.ok() and self.raft.last_commit_us:
+            # the engine apply ran on the replicator thread under the
+            # part lock (off-limits for recording, PR 10 rule) — the
+            # waiter backdates it from the part's commit accounting
+            if tracer.active():
+                tracer.add_span("raft.commit_logs",
+                                self.raft.last_commit_us,
+                                entries=self.raft.last_commit_n)
+            if led is not None:
+                led.charge(commit_apply_us=self.raft.last_commit_us)
+            _writepath.stage("commit_apply", self.raft.last_commit_us)
         return st
 
     def submit_atomic(self, op: AtomicOp) -> Status:
+        t0 = time.perf_counter()
         with tracer.span("raft.append_wal", atomic=True):
             fut = self.raft.atomic_op_async(op)
+        t1 = time.perf_counter()
+        led = ledger.current()
+        if led is not None:
+            led.charge(wal_append_us=(t1 - t0) * 1e6)
+        _writepath.stage("wal_append", (t1 - t0) * 1e6)
         with tracer.span("raft.replicate"):
             st = self._wait(fut)
-        if st.ok() and tracer.active() and self.raft.last_commit_us:
-            tracer.add_span("raft.commit_logs", self.raft.last_commit_us,
-                            entries=self.raft.last_commit_n)
+        t2 = time.perf_counter()
+        if led is not None:
+            led.charge(replicate_us=(t2 - t1) * 1e6)
+        _writepath.stage("replicate", (t2 - t1) * 1e6)
+        if st.ok() and self.raft.last_commit_us:
+            if tracer.active():
+                tracer.add_span("raft.commit_logs",
+                                self.raft.last_commit_us,
+                                entries=self.raft.last_commit_n)
+            if led is not None:
+                led.charge(commit_apply_us=self.raft.last_commit_us)
+            _writepath.stage("commit_apply", self.raft.last_commit_us)
         return st
 
     def is_leader(self) -> bool:

@@ -56,6 +56,7 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 from ...common import heat as _heat
 from ...common import profiler as _profiler
+from ...common import writepath as _writepath
 from ...common.faults import faults
 from ...common.flight import recorder as flight
 from ...common.stats import stats
@@ -454,12 +455,17 @@ class RaftPart:
         acks still advance match/commit), after which the follower
         re-enters the rotation and catches up batch by batch.
         `_repl_inflight` is touched only by the replicator thread."""
+        t_round0 = time.monotonic()
         with self._lock:
             if self.role is not Role.LEADER:
                 return
             term = self.term
             last_id = self.wal.last_log_id
             committed = self.committed_id
+            # group-commit readiness (write-path observatory): appends
+            # awaiting commit when this round starts — the occupancy a
+            # pipelined group-commit design would batch
+            n_pending = len(self._pending)
             targets = [(h, self._build_append_locked(h, committed))
                        for h in list(self.hosts.values())
                        if h.addr not in self._repl_inflight]
@@ -495,6 +501,9 @@ class RaftPart:
                 continue
             f = self.network.call(self.addr, host.addr, "append_log", req)
             sends.append((host, req, f))
+        n_shipped = sum(len(req.entries) for _, req, _f in sends)
+        t_sends = time.monotonic()
+        t_quorum: Optional[float] = None
 
         # gather under ONE shared deadline (not rpc_timeout PER host),
         # with a short post-quorum grace: once a quorum has acked, the
@@ -525,7 +534,10 @@ class RaftPart:
                                                 committed):
                     return
             if grace_until is None and pending and reached >= quorum:
-                grace_until = time.monotonic() + 0.025
+                t_quorum = time.monotonic()
+                grace_until = t_quorum + 0.025
+        if t_quorum is None and reached >= quorum:
+            t_quorum = time.monotonic()   # quorum on the last response
         for f, (host, req) in pending.items():
             self._repl_inflight[host.addr] = (f, req, host, committed)
             stats.add_value("raftex.replicate.parked", kind="counter")
@@ -542,6 +554,22 @@ class RaftPart:
                 return
 
         self._advance_commit(term, last_id)
+        # group-commit readiness metrics (write-path observatory,
+        # ROADMAP item 2's before-numbers): rounds that shipped entries
+        # record batch size, round wall time, the quorum wait and the
+        # pending-append occupancy; heartbeat-only rounds stay silent
+        if n_shipped and _writepath.enabled():
+            now = time.monotonic()
+            stats.add_value("write.raft.round_us",
+                            (now - t_round0) * 1e6, kind="histogram")
+            stats.add_value("write.raft.round_entries", n_shipped,
+                            kind="histogram")
+            stats.add_value("write.raft.pending_appends", n_pending,
+                            kind="histogram")
+            if t_quorum is not None:
+                stats.add_value("write.raft.quorum_wait_us",
+                                (t_quorum - t_sends) * 1e6,
+                                kind="histogram")
         self._note_staleness()
 
     def _note_staleness(self) -> None:
@@ -774,6 +802,13 @@ class RaftPart:
             self._on_commit(batch)
             self.last_commit_us = int((time.monotonic() - t0) * 1e6)
             self.last_commit_n = len(batch)
+            # raft append batch occupancy (write-path observatory):
+            # entries applied as ONE engine batch — the group-commit
+            # granularity item 2 will widen. Counter-class recording
+            # under the raft lock follows the read_fence precedent.
+            if _writepath.enabled():
+                stats.add_value("write.raft.commit_batch_entries",
+                                len(batch), kind="histogram")
         self.committed_id = to_id
         self._note_replay_locked(from_id, to_id)
         done = [f for i, f in self._pending.items() if i <= to_id]

@@ -14,6 +14,7 @@ import threading
 from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 from ..common.status import ErrorCode, Status, StatusOr
+from ..common import writepath as _writepath
 from .iface import KVEngine, KVIterator
 from .memengine import MemEngine
 from .part import AtomicOp, Part
@@ -38,6 +39,9 @@ class GraphStore:
         self._spaces: Dict[int, SpaceInfo] = {}
         self._engine_options: Dict[str, int] = {}
         self._lock = threading.Lock()
+        # write-path observatory: change-ring occupancy gauges walk the
+        # registered stores (weakly; common/writepath.py ring_status)
+        _writepath.register_store(self)
 
     # ------------------------------------------------------------------
     # topology management (PartManager::Handler surface)

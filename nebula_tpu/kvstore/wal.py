@@ -18,7 +18,10 @@ import threading
 from dataclasses import dataclass
 from typing import Iterator, List, Optional
 
+import time
+
 from .. import native
+from ..common import writepath as _writepath
 from ..common.faults import InjectedFault, faults
 
 
@@ -84,8 +87,19 @@ class Wal:
         with self._lock:
             if self._closed:
                 return False
-            rc = self._lib.nwal_append(self._h, log_id, term, cluster,
-                                       data, len(data))
+            if self.sync_every_append:
+                # durable append: the native call fsyncs inline, so
+                # its latency IS the fsync-bearing write latency the
+                # group-commit design needs measured (wal.fsync_us
+                # histogram; docs/manual/10-observability.md)
+                t0 = time.perf_counter()
+                rc = self._lib.nwal_append(self._h, log_id, term,
+                                           cluster, data, len(data))
+                _writepath.note_fsync(
+                    (time.perf_counter() - t0) * 1e6)
+            else:
+                rc = self._lib.nwal_append(self._h, log_id, term,
+                                           cluster, data, len(data))
         return rc == 0
 
     def rollback(self, keep_to: int) -> bool:
@@ -127,11 +141,15 @@ class Wal:
 
     def sync(self) -> None:
         # fault point `wal.sync`: raises — a failed fsync means the
-        # durability promise is broken and callers must see it
+        # durability promise is broken and callers must see it (its
+        # latency mode sleeps here, INSIDE the measured extent, so the
+        # fsync_stall drill measures what a slow disk would)
+        t0 = time.perf_counter()
         faults.fire("wal.sync")
         with self._lock:
             if not self._closed:
                 self._lib.nwal_sync(self._h)
+        _writepath.note_fsync((time.perf_counter() - t0) * 1e6)
 
     def iterate(self, from_id: int, to_id: int = -1) -> Iterator[LogEntry]:
         """Yield entries in [from_id, to_id] (to_id<0 → through last).

@@ -22,6 +22,7 @@ from concurrent.futures import wait as futures_wait
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from ..common import keys as ku
+from ..common import writepath as _writepath
 from ..common.stats import stats
 from ..common.status import ErrorCode, Status, StatusOr
 from ..common.tracing import tracer
@@ -914,7 +915,12 @@ class StorageClient:
         def merge(acc, r):
             acc.results.update(r.results)
 
-        resp = self._fanout(space_id, parts, call, ExecResponse(), merge)
+        # write-path observatory: the fan-out extent (leader routing +
+        # per-host RPC + merge) is the `fanout` stage of the write
+        # timeline (common/writepath.py) — same on all write methods
+        with _writepath.timed_stage("fanout", "write_fanout_us"):
+            resp = self._fanout(space_id, parts, call, ExecResponse(),
+                                merge)
         self.note_local_write(space_id)   # AFTER the write lands
         return resp
 
@@ -934,26 +940,30 @@ class StorageClient:
         def merge(acc, r):
             acc.results.update(r.results)
 
-        resp = self._fanout(space_id, parts, call, ExecResponse(), merge)
+        with _writepath.timed_stage("fanout", "write_fanout_us"):
+            resp = self._fanout(space_id, parts, call, ExecResponse(),
+                                merge)
         self.note_local_write(space_id)   # AFTER the write lands
         return resp
 
     def delete_vertices(self, space_id: int, vids: List[int]) -> ExecResponse:
         resp = ExecResponse()
-        for vid in vids:
-            part = self.part_id(space_id, vid)
-            svc = self._hosts[self._leader(space_id, part)]
-            pr, local_keys = svc.get_edge_keys(space_id, part, vid)
-            if pr.code != ErrorCode.SUCCEEDED:
-                resp.results[part] = pr
-                continue
-            # counterpart keys live on the neighbor's part
-            remote: List[EdgeKey] = [EdgeKey(ek.dst, -ek.etype, ek.rank, ek.src)
-                                     for ek in local_keys]
-            if remote:
-                self.delete_edges(space_id, remote)
-            r = svc.delete_vertex(space_id, part, vid)
-            resp.results.update(r.results)
+        with _writepath.timed_stage("fanout", "write_fanout_us"):
+            for vid in vids:
+                part = self.part_id(space_id, vid)
+                svc = self._hosts[self._leader(space_id, part)]
+                pr, local_keys = svc.get_edge_keys(space_id, part, vid)
+                if pr.code != ErrorCode.SUCCEEDED:
+                    resp.results[part] = pr
+                    continue
+                # counterpart keys live on the neighbor's part
+                remote: List[EdgeKey] = [EdgeKey(ek.dst, -ek.etype,
+                                                 ek.rank, ek.src)
+                                         for ek in local_keys]
+                if remote:
+                    self.delete_edges(space_id, remote)
+                r = svc.delete_vertex(space_id, part, vid)
+                resp.results.update(r.results)
         self.note_local_write(space_id)
         return resp
 
@@ -970,7 +980,9 @@ class StorageClient:
         def merge(acc, r):
             acc.results.update(r.results)
 
-        resp = self._fanout(space_id, parts, call, ExecResponse(), merge)
+        with _writepath.timed_stage("fanout", "write_fanout_us"):
+            resp = self._fanout(space_id, parts, call, ExecResponse(),
+                                merge)
         self.note_local_write(space_id)   # AFTER the write lands
         return resp
 
@@ -979,9 +991,10 @@ class StorageClient:
                       insertable: bool = False,
                       yield_props: Optional[List[str]] = None) -> UpdateResponse:
         part = self.part_id(space_id, vid)
-        svc = self._hosts[self._leader(space_id, part)]
-        resp = svc.update_vertex(space_id, part, vid, tag_id, items, when,
-                                 insertable, yield_props)
+        with _writepath.timed_stage("fanout", "write_fanout_us"):
+            svc = self._hosts[self._leader(space_id, part)]
+            resp = svc.update_vertex(space_id, part, vid, tag_id, items,
+                                     when, insertable, yield_props)
         if resp.code == ErrorCode.E_LEADER_CHANGED:
             self._note_leader(space_id, part, resp.leader)
         self.note_local_write(space_id)   # AFTER the write lands
@@ -992,18 +1005,21 @@ class StorageClient:
                     insertable: bool = False,
                     yield_props: Optional[List[str]] = None) -> UpdateResponse:
         part = self.part_id(space_id, ek.src)
-        svc = self._hosts[self._leader(space_id, part)]
-        resp = svc.update_edge(space_id, part, ek, items, when, insertable,
-                               yield_props)
-        if resp.code == ErrorCode.SUCCEEDED:
-            # keep the reverse copy in sync (goes beyond the reference,
-            # which leaves reversed scans stale after UPDATE EDGE)
-            rev_part = self.part_id(space_id, ek.dst)
-            rev_svc = self._hosts[self._leader(space_id, rev_part)]
-            rev_svc.update_edge(space_id, rev_part,
-                                EdgeKey(ek.dst, -ek.etype, ek.rank, ek.src),
-                                items, None, True, None)
-        elif resp.code == ErrorCode.E_LEADER_CHANGED:
+        with _writepath.timed_stage("fanout", "write_fanout_us"):
+            svc = self._hosts[self._leader(space_id, part)]
+            resp = svc.update_edge(space_id, part, ek, items, when,
+                                   insertable, yield_props)
+            if resp.code == ErrorCode.SUCCEEDED:
+                # keep the reverse copy in sync (goes beyond the
+                # reference, which leaves reversed scans stale after
+                # UPDATE EDGE)
+                rev_part = self.part_id(space_id, ek.dst)
+                rev_svc = self._hosts[self._leader(space_id, rev_part)]
+                rev_svc.update_edge(space_id, rev_part,
+                                    EdgeKey(ek.dst, -ek.etype, ek.rank,
+                                            ek.src),
+                                    items, None, True, None)
+        if resp.code == ErrorCode.E_LEADER_CHANGED:
             self._note_leader(space_id, part, resp.leader)
         self.note_local_write(space_id)   # AFTER the write lands
         return resp

@@ -38,6 +38,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from ..common import writepath as _writepath
 from ..common.faults import faults
 from ..common.flags import storage_flags
 from ..common.flight import recorder as _flight
@@ -156,11 +157,18 @@ class DeviceShardManager:
             return False
         now_v, raw = engine.changes_snapshot(cursor)
         if raw is None:
+            # the engine's ring truncated past our cursor (or a
+            # barrier op — indistinguishable here, same consequence):
+            # the rebuild that follows carries this cause forward
+            _writepath.note_ring_overrun(space_id, cause="truncated",
+                                         host=self.host or None,
+                                         cursor=cursor)
             self.stats["delta_declines"] += 1
             return False
         if raw:
             from ..engine_tpu.delta import apply_entries
             from ..kvstore.changelog import resolve_changes
+            t0 = time.perf_counter()
             try:
                 faults.fire("csr.delta_apply")
                 entries = resolve_changes(engine, raw)
@@ -176,11 +184,22 @@ class DeviceShardManager:
                 return False
             snap.invalidate_aligned()
             self.stats["delta_applies"] += 1
+            us = int((time.perf_counter() - t0) * 1e6)
+            _writepath.stage("delta_apply", us)
+            _writepath.snapshots.note(space_id, "delta_apply",
+                                      dur_us=us, lock_us=us,
+                                      entries=len(entries))
         with ent.mu:
             snap.delta_cursor = now_v
             snap.write_version = now_v
         with self._lock:
             ent.stale_since = None
+        # device visibility on the storaged serving tier: acks keyed by
+        # this host (processors._note_ack) clear against its own shard
+        # cursor — never another storaged's
+        _writepath.watermark.note_visible(
+            space_id, {self.host: now_v} if self.host else now_v,
+            cause="delta")
         d = snap.delta
         if d is not None and \
                 d.edge_count + d.tomb_count > 0.75 * d.max_edges:
@@ -196,6 +215,7 @@ class DeviceShardManager:
             num_parts = max(held) if held else 0
         if num_parts <= 0:
             return
+        t0 = time.perf_counter()
         try:
             snap = build_snapshot(self._store, self._sm, space_id,
                                   num_parts)
@@ -208,9 +228,19 @@ class DeviceShardManager:
                                    kind="counter")
             return
         with self._lock:
+            replacement = self._spaces.get(space_id) is not None
             self._spaces[space_id] = _SpaceShard(snap)
         self.stats["builds"] += 1
         global_stats.add_value("device_serve.builds", kind="counter")
+        _writepath.snapshots.note(
+            space_id, "build",
+            dur_us=int((time.perf_counter() - t0) * 1e6),
+            cause="replace" if replacement else "first_touch")
+        _writepath.watermark.note_visible(
+            space_id,
+            {self.host: snap.write_version} if self.host
+            else snap.write_version,
+            cause="build")
 
     def invalidate(self, space_id: int, part_id: int = 0) -> None:
         """Leadership moved: the old shard must refuse to vouch NOW

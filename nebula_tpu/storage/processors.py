@@ -46,6 +46,7 @@ from ..kvstore.store import GraphStore
 from ..kvstore import log_encoder as le
 from ..meta.schema_manager import SchemaManager
 from ..common import heat, ledger
+from ..common import writepath as _writepath
 from ..common.stats import stats
 from ..common.tracing import ActiveQueryRegistry, SlowQueryLog, tracer
 from .types import (BoundRequest, BoundResponse, DevicePartResult,
@@ -202,6 +203,20 @@ class StorageService:
     def _engine_version(self, space_id: int) -> Optional[int]:
         engine = self.store.space_engine(space_id)
         return None if engine is None else int(engine.write_version)
+
+    def _note_ack(self, space_id: int) -> None:
+        """Write-path observatory: one client-visible mutation ack.
+        Runs AFTER the consensus/engine commit, so the engine's
+        write_version already covers this write — the ack-to-visible
+        watermark (common/writepath.py) pairs it against the device
+        snapshot's later cursor advance. Keyed by this service's host
+        identity so the RemoteStorageProvider's per-host cursor dict
+        matches acks host-by-host."""
+        if not _writepath.enabled():
+            return
+        v = self._engine_version(space_id)
+        if v is not None:
+            _writepath.watermark.note_ack(space_id, self.host, v)
 
     def _finish_op(self, tok: int, stmt: str) -> None:
         """Retire an in-flight processor op WITH its duration: ops
@@ -732,6 +747,7 @@ class StorageService:
                      overwritable: bool = True) -> ExecResponse:
         resp = ExecResponse()
         ver = ku.now_version()
+        any_ok = False
         for part, vertices in parts.items():
             kvs = []
             for nv in vertices:
@@ -740,8 +756,11 @@ class StorageService:
             st = self.store.async_multi_put(space_id, part, kvs)
             resp.results[part] = _to_part_result(st)
             if st.ok():
+                any_ok = True
                 heat.accountant.charge(space_id, part,
                                        writes=len(vertices))
+        if any_ok:
+            self._note_ack(space_id)
         return resp
 
     def add_edges(self, space_id: int, parts: Dict[int, List[NewEdge]],
@@ -751,13 +770,17 @@ class StorageService:
         copy to the dst part (matching the reference split)."""
         resp = ExecResponse()
         ver = ku.now_version()
+        any_ok = False
         for part, edges in parts.items():
             kvs = [(ku.edge_key(part, e.src, e.etype, e.rank, e.dst, ver), e.row)
                    for e in edges]
             st = self.store.async_multi_put(space_id, part, kvs)
             resp.results[part] = _to_part_result(st)
             if st.ok():
+                any_ok = True
                 heat.accountant.charge(space_id, part, writes=len(edges))
+        if any_ok:
+            self._note_ack(space_id)
         return resp
 
     def delete_vertex(self, space_id: int, part: int, vid: int) -> ExecResponse:
@@ -773,11 +796,13 @@ class StorageService:
         resp.results[part] = _to_part_result(st)
         if st.ok():
             heat.accountant.charge(space_id, part, writes=1)
+            self._note_ack(space_id)
         return resp
 
     def delete_edges(self, space_id: int,
                      parts: Dict[int, List[EdgeKey]]) -> ExecResponse:
         resp = ExecResponse()
+        any_ok = False
         for part, eks in parts.items():
             pr = self.store.part(space_id, part)
             if not pr.ok():
@@ -792,7 +817,10 @@ class StorageService:
             st = self.store.async_multi_remove(space_id, part, dead)
             resp.results[part] = _to_part_result(st)
             if st.ok():
+                any_ok = True
                 heat.accountant.charge(space_id, part, writes=len(eks))
+        if any_ok:
+            self._note_ack(space_id)
         return resp
 
     # ------------------------------------------------------------------
@@ -867,6 +895,7 @@ class StorageService:
             out.code = st.code
         if st.ok() and out.code == ErrorCode.SUCCEEDED:
             heat.accountant.charge(space_id, part, writes=1)
+            self._note_ack(space_id)
         return out
 
     def update_edge(self, space_id: int, part: int, ek: EdgeKey,
@@ -943,6 +972,7 @@ class StorageService:
             out.code = st.code
         if st.ok() and out.code == ErrorCode.SUCCEEDED:
             heat.accountant.charge(space_id, part, writes=1)
+            self._note_ack(space_id)
         return out
 
     # ------------------------------------------------------------------

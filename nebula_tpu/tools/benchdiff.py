@@ -74,6 +74,26 @@ DEFAULT_RULES: Tuple[Tuple[str, str], ...] = (
     ("*consistency.*", "ignore"),
     ("*digest*", "ignore"),
     ("*corrupt*", "ignore"),
+    # write-path observatory (ISSUE 19, WRITE_bench.json): the
+    # ack-to-visible latency, the per-stage/replication p99s and the
+    # armed seam cost are the judged before/after numbers for ROADMAP
+    # item 2 (group-commit pipelined writes); every tally — stage/
+    # exemplar counts, watermark & lifecycle-ledger bookkeeping, ring
+    # occupancy, durability-journal sizes, drill evidence — is
+    # run-length-dependent diagnostics: advisory drift, never gated
+    ("*ack_to_visible_ms.count", "ignore"),
+    ("*ack_to_visible_ms.*", "lower"),
+    ("*overhead.seam_frac", "lower"),
+    ("*overhead.seam_us_per_write", "lower"),
+    ("*stages.*.p9*", "lower"),
+    ("*stages.*", "ignore"),
+    ("*replicated.metrics.*.p9*", "lower"),
+    ("*replicated.metrics.*", "ignore"),
+    ("*watermark.*", "ignore"),
+    ("*overrun.*", "ignore"),
+    ("*durability.*", "ignore"),
+    ("*profile_write_stages.*", "ignore"),
+    ("*replicated.writes", "ignore"),
     # configuration echoes / identifiers / counts: not performance
     ("*.n", "ignore"), ("*.sessions*", "ignore"), ("*.seed", "ignore"),
     ("*graph.*", "ignore"), ("*topology.*", "ignore"),

@@ -354,6 +354,21 @@ _NL004_FAMILY_KINDS = {
     "storage_client.hedge.": "counter",
     "storage_client.peer_ejected": "counter",
     "raftex.replicate.": "counter",
+    # write-path observatory (ISSUE 19, common/writepath.py): every
+    # per-stage write seam and the raft group-commit occupancy series
+    # are contractually native histograms (the write bench reads their
+    # bucket series + exemplars), the ack/visible/ring event streams
+    # and per-event snapshot lifecycle tallies are monotonic counters,
+    # and the WAL fsync distribution is the fsync_stall trigger's
+    # histogram source
+    "write.stage.": "histogram",
+    "write.raft.": "histogram",
+    "write.ack_to_visible_ms": "histogram",
+    "write.acked": "counter",
+    "write.visible": "counter",
+    "write.ring.": "counter",
+    "snapshot.": "counter",
+    "wal.fsync_us": "histogram",
 }
 
 

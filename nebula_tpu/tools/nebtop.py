@@ -314,10 +314,53 @@ def render(new: Snapshot, old: Optional[Snapshot],
             lines.append(f"{space:<16}{cell(space, 'device_us'):>12}"
                          f"{cell(space, 'rows_scanned'):>12}"
                          f"{cell(space, 'rpc_bytes'):>12}")
+    lines.extend(render_writes(new, old))
     lines.extend(render_heat(new.part_heat()))
     lines.extend(render_consistency(cons))
     lines.extend(render_profile(prof))
     return "\n".join(lines)
+
+
+_WM_RE = re.compile(r"^nebula_write_(visible_lag_ms|pending_acks|"
+                    r"ring_ops|ring_kvs|ring_dropped)_s(\d+)$")
+
+
+def render_writes(new: Snapshot, old: Optional[Snapshot]) -> List[str]:
+    """The write-path panel (write-path observatory, common/
+    writepath.py): acked-write rate, ack-to-visible p99, per-space
+    visibility lag / pending acks / change-ring occupancy and the WAL
+    fsync p99. Empty when the observatory is disarmed — none of these
+    families scrape at all then (the byte-identity contract)."""
+    spaces: Dict[str, Dict[str, float]] = {}
+    for n, _lbl, v in new.samples:
+        m = _WM_RE.match(n)
+        if m:
+            row = spaces.setdefault(m.group(2), {})
+            row[m.group(1)] = row.get(m.group(1), 0.0) + v
+    acked = _rate(new, old, "nebula_write_acked_total")
+    visible = _rate(new, old, "nebula_write_visible_total")
+    if not spaces and not acked and not new.sum("nebula_write_acked_total"):
+        return []
+    a2v = new.get("nebula_write_ack_to_visible_ms_p99_60s") or 0.0
+    fsync = new.get("nebula_wal_fsync_us_p99_60s") or 0.0
+    overruns = new.sum("nebula_write_ring_overrun_total")
+    lines = [""]
+    lines.append(f"writes:  acked {acked:7.1f}/s   visible "
+                 f"{visible:7.1f}/s   ack→visible p99(60s) "
+                 f"{a2v:7.2f} ms   fsync p99 {fsync / 1000:6.2f} ms   "
+                 f"ring overruns {overruns:.0f}")
+    if spaces:
+        lines.append(f"{'SPACE':<8}{'LAG_MS':>10}{'PENDING':>9}"
+                     f"{'RING_OPS':>10}{'RING_KVS':>10}{'DROPPED':>9}")
+        for sid in sorted(spaces, key=int)[:6]:
+            f = spaces[sid]
+            lines.append(f"{sid:<8}"
+                         f"{f.get('visible_lag_ms', 0.0):>10.1f}"
+                         f"{f.get('pending_acks', 0.0):>9.0f}"
+                         f"{f.get('ring_ops', 0.0):>10.0f}"
+                         f"{f.get('ring_kvs', 0.0):>10.0f}"
+                         f"{f.get('ring_dropped', 0.0):>9.0f}")
+    return lines
 
 
 def render_heat(ph: Dict[str, Any]) -> List[str]:
@@ -356,6 +399,13 @@ def snapshot_dict(s: Snapshot,
     out = {"instances": s.instances(),
            "leaders": s.leader_counts(),
            "query_total": s.sum("nebula_graph_query_total"),
+           "writes": {
+               "acked_total": s.sum("nebula_write_acked_total"),
+               "visible_total": s.sum("nebula_write_visible_total"),
+               "ring_overruns": s.sum("nebula_write_ring_overrun_total"),
+               "spaces": {m.group(2) + "." + m.group(1): v
+                          for n, _l, v in s.samples
+                          for m in [_WM_RE.match(n)] if m}},
            "tenant_cost": s.tenant_cost(),
            "heat": {"skew": ph["skew"],
                     "parts": {f"{sid}:{pid}@{inst}": f

@@ -945,6 +945,136 @@ def run_soak_skew(seconds: float = 8.0, seed: int = 31,
     return out
 
 
+def run_soak_churn(seconds: float = 10.0, seed: int = 43,
+                   v: int = 1500, e: int = 8000,
+                   bound_ms: float = 2000.0) -> dict:
+    """`--churn` (ISSUE 19): sustained write-heavy churn with the
+    write-path observatory armed and the change ring deliberately
+    small (REBOOT-effective cap captured at CREATE SPACE), so write
+    bursts genuinely roll the ring — overrun -> snapshot poison ->
+    full host repack cycling CONTINUOUSLY under load — with the soak's
+    signature continuous TPU-vs-CPU identity verifies, and the
+    ack-to-visible watermark as a GATE: at quiesce every acked write
+    must have become visible (pending drains to zero over anchor
+    reads) and the run's observed ack-to-visible p99 must stay within
+    bound_ms (docs/manual/10-observability.md, "Write-path
+    observatory")."""
+    import numpy as np
+
+    from ..common import writepath as wp
+    from ..common.flags import graph_flags, storage_flags
+    from ..common.stats import stats as _gstats
+
+    rng = random.Random(seed)
+    saved = {"g": graph_flags.get("write_obs_enabled"),
+             "s": storage_flags.get("write_obs_enabled"),
+             "ring": storage_flags.get("change_ring_ops")}
+    graph_flags.set("write_obs_enabled", True)
+    storage_flags.set("write_obs_enabled", True)
+    # a production-sized ring never overruns at soak scale; a tiny one
+    # makes the bursts below a real overrun workload
+    storage_flags.set("change_ring_ops", 64)
+    try:
+        cluster, conn, tpu, srcs, dsts = _setup_cluster(
+            "churn", v, e, seed)
+    finally:
+        storage_flags.set("change_ring_ops", saved["ring"])
+    sid = cluster.meta.get_space("churn").value().space_id
+    ov0 = _gstats.lifetime_total("write.ring.overrun")
+    led0 = dict(wp.snapshots.view()["counts"])
+    try:
+        lats: List[float] = []
+        queries = writes = verifies = 0
+        max_lag_ms = 0.0
+        deadline = time.monotonic() + seconds
+        min_queries = 60
+        while time.monotonic() < deadline or queries < min_queries:
+            # write burst long enough to roll the 64-op ring past its
+            # floor before the next read pulls the delta
+            for _ in range(rng.randrange(40, 120)):
+                s, d = rng.randrange(v), rng.randrange(v)
+                if rng.random() < 0.85:
+                    conn.must(f"INSERT EDGE knows(w) VALUES "
+                              f"{s} -> {d}:({(s + d) % 101})")
+                else:
+                    conn.must(f"DELETE EDGE knows {s} -> {d}")
+                writes += 1
+            wm = wp.watermark.stats_view().get(sid) or {}
+            max_lag_ms = max(max_lag_ms, wm.get("lag_ms", 0.0))
+            seed_vid = rng.randrange(v)
+            steps = rng.choice([1, 2, 2])
+            q = (f"GO {steps} STEPS FROM {seed_vid} OVER knows "
+                 f"WHERE knows.w > {rng.randrange(0, 101)} "
+                 f"YIELD knows._dst, knows.w")
+            t0 = time.monotonic()
+            r = conn.must(q)
+            lats.append((time.monotonic() - t0) * 1e3)
+            queries += 1
+            if queries % 4 == 0:      # continuous identity, mid-churn
+                tpu.enabled = False
+                try:
+                    rc = conn.must(q)
+                finally:
+                    tpu.enabled = True
+                if sorted(map(repr, r.rows)) != \
+                        sorted(map(repr, rc.rows)):
+                    _debug_bundle(cluster, tpu, {
+                        "failure": "identity_divergence", "query": q})
+                    raise AssertionError(
+                        f"IDENTITY DIVERGENCE on: {q}")
+                verifies += 1
+        # quiesce: anchor reads pull the remaining deltas (or wait out
+        # an in-flight repack) until every acked write became visible
+        wmv: dict = {}
+        drain_deadline = time.monotonic() + 20
+        while time.monotonic() < drain_deadline:
+            conn.must("GO FROM 0 OVER knows")
+            wmv = dict(wp.watermark.stats_view().get(sid) or {})
+            if wmv.get("pending", 1) == 0 \
+                    and not any(tpu._repacking.values()):
+                break
+            time.sleep(0.05)
+    finally:
+        graph_flags.set("write_obs_enabled", saved["g"])
+        storage_flags.set("write_obs_enabled", saved["s"])
+    overruns = _gstats.lifetime_total("write.ring.overrun") - ov0
+    counts = wp.snapshots.view()["counts"]
+    led = {k: counts.get(k, 0) - led0.get(k, 0)
+           for k in ("overrun", "poison", "repack", "build")}
+    h = _gstats.histogram_snapshot("write.ack_to_visible_ms")
+    p99 = _gstats.read_stats("write.ack_to_visible_ms.p99.600")
+    stage_counts = {}
+    for stg in ("execute", "fanout", "commit_apply", "ring_publish",
+                "delta_apply", "repack"):
+        sh = _gstats.histogram_snapshot(f"write.stage.{stg}_us")
+        stage_counts[stg] = int(sh["count"]) if sh else 0
+    with tpu._lock:
+        stats = dict(tpu.stats)
+    lat = np.sort(np.asarray(lats)) if lats else np.zeros(1)
+    out = {
+        "seconds": seconds, "queries": queries, "writes": writes,
+        "identity_verifies": verifies,
+        "latency_ms": {"p50": round(float(np.percentile(lat, 50)), 2),
+                       "p99": round(float(np.percentile(lat, 99)), 2)},
+        "watermark": {**wmv, "bound_ms": bound_ms,
+                      "max_lag_ms": round(max_lag_ms, 2)},
+        "ack_to_visible_ms": {"count": int(h["count"]) if h else 0,
+                              "p99_600s": p99},
+        "ring": {"overruns": overruns, "lifecycle": led},
+        "stages": stage_counts,
+        "bg_repacks": stats["bg_repacks"],
+        "delta_applies": stats["delta_applies"],
+    }
+    out["ok"] = (verifies >= 5
+                 and wmv.get("pending", 1) == 0
+                 and (h is not None and h["count"] > 0)
+                 and p99 is not None and p99 <= bound_ms
+                 and overruns >= 1 and led["repack"] >= 1
+                 and all(stage_counts[s] > 0 for s in
+                         ("execute", "fanout", "commit_apply")))
+    return out
+
+
 def run_soak_crash(seconds: float = 45.0, seed: int = 29) -> dict:
     """`--crash`: periodic SIGKILL/restart of one SUBPROCESS storaged
     (crashstorm topology: real processes on per-node data dirs, same
@@ -1632,6 +1762,17 @@ def main(argv=None) -> int:
                          "non-retryable errors, staleness bounded, "
                          "zero shadow mismatches / divergence (docs/"
                          "manual/9-robustness.md)")
+    ap.add_argument("--churn", action="store_true",
+                    help="write-heavy sustained churn with the write-"
+                         "path observatory armed and a deliberately "
+                         "tiny change ring (overrun -> poison -> "
+                         "repack cycling under load) under continuous "
+                         "identity verifies: the ack-to-visible "
+                         "watermark must drain to zero at quiesce and "
+                         "its p99 stay within --churn-bound-ms "
+                         "(docs/manual/10-observability.md)")
+    ap.add_argument("--churn-bound-ms", type=float, default=2000.0,
+                    help="ack-to-visible p99 gate for --churn")
     ap.add_argument("--skew", action="store_true",
                     help="Zipf-distributed start vids with the "
                          "workload observatory armed (common/heat.py) "
@@ -1658,6 +1799,9 @@ def main(argv=None) -> int:
         out = run_soak_cluster_reads(args.seconds)
     elif args.nemesis:
         out = run_soak_nemesis(args.seconds)
+    elif args.churn:
+        out = run_soak_churn(args.seconds,
+                             bound_ms=args.churn_bound_ms)
     elif args.skew:
         out = run_soak_skew(args.seconds)
     elif args.tenants:

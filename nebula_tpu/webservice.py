@@ -52,6 +52,11 @@ from .common import profiler as _profiler_mod
 # boot and registers the flight "heat" collector (the workload & data
 # observatory, common/heat.py)
 from .common import heat as _heat_mod  # noqa: F401
+# likewise eager: declares write_obs_enabled/visibility_stall_ms/
+# fsync_stall_ms/change_ring_* on every registry at daemon boot and
+# registers the flight "writepath" collector (the write-path
+# observatory, common/writepath.py)
+from .common import writepath as _writepath_mod
 
 Handler = Callable[[Dict[str, str], bytes], Tuple[int, Any]]
 
@@ -92,6 +97,7 @@ class WebService:
         self.register("/slo", self._slo_handler)
         self.register("/profile", self._profile_handler)
         self.register("/nemesis", self._nemesis_handler)
+        self.register("/snapshots", self._snapshots_handler)
 
     # ------------------------------------------------------------------
     def register(self, path: str, handler: Handler) -> None:
@@ -251,7 +257,8 @@ class WebService:
         # gauge sources: flight-recorder + SLO burn rates (process-
         # global, every daemon) then the daemon's registered sources
         sources: List[Callable[[], Dict[str, Any]]] = \
-            [_flight_gauges, _slo_gauges, _profiler_gauges] \
+            [_flight_gauges, _slo_gauges, _profiler_gauges,
+             _writepath_gauges] \
             + list(self._metric_sources)
         for src in sources:
             try:
@@ -359,6 +366,16 @@ class WebService:
             engine.clear()
         return 200, engine.describe()
 
+    def _snapshots_handler(self, params, body) -> Tuple[int, Any]:
+        """/snapshots: the write-path observatory's snapshot lifecycle
+        surface (common/writepath.py) — ack-to-visible watermark per
+        space, build/delta/poison/repack event history with durations
+        and causes, change-ring occupancy, and each registered engine's
+        live snapshot status. Served by every daemon (graphd's TPU
+        engine AND storaged device serving both register); disarmed ->
+        {"enabled": false}."""
+        return 200, _writepath_mod.snapshots_view()
+
     # ------------------------------------------------------------------
     # tracing + query-visibility endpoints (opt-in per daemon)
     # ------------------------------------------------------------------
@@ -455,6 +472,13 @@ def _flight_gauges() -> Dict[str, float]:
 
 def _slo_gauges() -> Dict[str, float]:
     return _slo_mod.engine.gauges()
+
+
+def _writepath_gauges() -> Dict[str, float]:
+    """Write-path observatory per-space gauges (ack-to-visible lag,
+    pending acks, change-ring occupancy). Disarmed -> {} so /metrics
+    stays byte-identical to an observatory-free build."""
+    return _writepath_mod.gauges()
 
 
 def _profiler_gauges() -> Dict[str, float]:

@@ -1,0 +1,75 @@
+"""Tier-1-safe write-path observatory smoke: `bench.py --writes
+--trim` in a SUBPROCESS on XLA:CPU — the full proof tier: disarmed
+byte-identity, the per-stage timeline (execute → fanout → wal_append →
+replicate → commit_apply → ring_publish → delta_apply/repack) with
+exemplars, the ack-to-visible watermark, zero acked-write loss through
+a genuine change-ring overrun (overrun → poison → repack, one
+attributed chain in the ring_overrun flight bundle), the replicated
+group-commit/fsync metrics, the fsync_stall + visibility_stall drills
+and the ≤3% seam-cost contract (docs/manual/10-observability.md,
+"Write-path observatory"). The subprocess keeps the parent's JAX
+backend state out of the picture, exactly like the consistency/chaos/
+cluster smoke tiers."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def write_smoke(tmp_path_factory):
+    out = tmp_path_factory.mktemp("writes") / "WRITE_smoke.json"
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["BENCH_WRITES_SEED"] = "29"     # deterministic graph/draws
+    env["BENCH_WRITES_OUT"] = str(out)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--writes", "--trim"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, (proc.stdout[-2000:],
+                                  proc.stderr[-2000:])
+    with open(out) as f:
+        return json.load(f)
+
+
+def test_writes_all_gates_green(write_smoke):
+    assert write_smoke["ok"] is True, write_smoke["gates"]
+    assert all(write_smoke["gates"].values()), write_smoke["gates"]
+
+
+def test_writes_disarmed_left_no_trace(write_smoke):
+    assert write_smoke["disarmed"]["metric_lines"] == 0
+
+
+def test_writes_stage_timeline_populated(write_smoke):
+    st = write_smoke["stages"]
+    for stage in ("execute", "fanout", "commit_apply",
+                  "ring_publish", "delta_apply"):
+        assert st[stage]["count"] > 0, (stage, st)
+    # at least one synchronous stage carries a trace exemplar
+    assert any((st[s] or {}).get("exemplars", 0) > 0
+               for s in ("execute", "fanout", "commit_apply")), st
+
+
+def test_writes_no_acked_write_lost(write_smoke):
+    assert write_smoke["durability"]["missing"] == []
+    assert write_smoke["durability"]["edges_tracked"] > 0
+    assert write_smoke["overrun"]["missing"] == []
+    assert write_smoke["ack_to_visible_ms"]["count"] > 0
+
+
+def test_writes_overrun_chain_attributed(write_smoke):
+    counts = write_smoke["overrun"]["ledger_counts"]
+    assert counts.get("overrun", 0) >= 1, counts
+    assert counts.get("poison", 0) >= 1, counts
+    assert counts.get("repack", 0) >= 1, counts
+
+
+def test_writes_seam_cost_within_contract(write_smoke):
+    oh = write_smoke["overhead"]
+    assert oh["seam_frac"] <= 0.03, oh

@@ -1,0 +1,196 @@
+"""Write-path observatory tests (common/writepath.py; docs/manual/
+10-observability.md, "Write-path observatory"): the per-stage write
+timeline, the ack-to-visible watermark (delta apply AND repack
+advances), the overrun -> poison -> repack cause chain, the /snapshots
+lifecycle surface and the write_obs_enabled disarm byte-identity
+contract."""
+import time
+
+import pytest
+
+from nba_fixture import load_nba
+from nebula_tpu.cluster import InProcCluster
+from nebula_tpu.common import writepath as wp
+from nebula_tpu.common.faults import faults
+from nebula_tpu.common.flags import graph_flags, storage_flags
+from nebula_tpu.common.flight import recorder as flight_rec
+from nebula_tpu.common.stats import StatsManager
+from nebula_tpu.engine_tpu import TpuGraphEngine
+
+
+@pytest.fixture()
+def rig(monkeypatch):
+    """Armed in-proc cluster with a PRIVATE StatsManager behind the
+    writepath module (tier-1 shares one process-global registry; the
+    swap keeps every count in this test's hands) and pristine
+    watermark/ledger state."""
+    priv = StatsManager()
+    monkeypatch.setattr(wp, "stats", priv)
+    wp.reset()
+    tpu = TpuGraphEngine()
+    cluster = InProcCluster(tpu_engine=tpu)
+    _, conn = load_nba(cluster)
+    sid = cluster.meta.get_space("nba").value().space_id
+    yield cluster, conn, tpu, sid, priv
+    wp.reset()
+
+
+def _hist_count(priv, name):
+    h = priv.histogram_snapshot(name)
+    return int(h["count"]) if h else 0
+
+
+def test_stage_timeline_on_write(rig):
+    """One replicated-shape write through the in-proc stack populates
+    every synchronous seam plus the async visibility stages."""
+    cluster, conn, tpu, sid, priv = rig
+    conn.must("GO FROM 100 OVER like")           # snapshot + cursor up
+    conn.must("INSERT EDGE like(likeness) VALUES 101 -> 100:(70.0)")
+    conn.must("GO FROM 101 OVER like")           # pull -> delta apply
+    for stage in ("execute", "fanout", "commit_apply",
+                  "ring_publish", "delta_apply"):
+        assert _hist_count(priv, f"write.stage.{stage}_us") > 0, stage
+    assert priv.lifetime_total("write.acked") > 0
+    assert priv.lifetime_total("write.visible") > 0
+
+
+def test_profile_renders_write_stages(rig):
+    """PROFILE on a mutation renders the per-stage cost block the way
+    reads already do (the appended write_* ledger fields)."""
+    _, conn, _, _, _ = rig
+    r = conn.must("PROFILE INSERT EDGE like(likeness) "
+                  "VALUES 102 -> 100:(41.0)")
+    ws = (r.profile or {}).get("write_stages") or {}
+    assert {"execute", "fanout", "commit_apply"} <= set(ws), ws
+    assert all(v > 0 for v in ws.values()), ws
+
+
+def test_watermark_advances_on_delta_and_repack(rig):
+    """`note_visible` fires from BOTH visibility paths: the in-place
+    delta apply (cause delta) and a full host repack (cause repack)."""
+    cluster, conn, tpu, sid, priv = rig
+    conn.must("GO FROM 100 OVER like")
+    conn.must("INSERT EDGE like(likeness) VALUES 103 -> 100:(33.0)")
+    conn.must("GO FROM 103 OVER like")
+    wmv = wp.watermark.stats_view()
+    assert wmv[sid]["visible"] > 0
+    assert wmv[sid]["last_cause"] == "delta"
+    assert _hist_count(priv, "write.ack_to_visible_ms") > 0
+    # a second acked write made visible by a REPACK, not a delta pull
+    conn.must("INSERT EDGE like(likeness) VALUES 104 -> 100:(34.0)")
+    assert wp.watermark.stats_view()[sid]["pending"] > 0
+    tpu._kick_repack(sid, cause="test")
+    deadline = time.time() + 10
+    while (wp.watermark.stats_view()[sid]["pending"] > 0
+           and time.time() < deadline):
+        time.sleep(0.05)
+    wmv = wp.watermark.stats_view()
+    assert wmv[sid]["pending"] == 0, wmv
+    assert wmv[sid]["last_cause"] == "repack"
+    events = wp.snapshots.view()["spaces"][sid]
+    assert any(ev["event"] == "repack" for ev in events)
+
+
+def test_overrun_cause_attribution(rig):
+    """`ring.overrun:n=1` forces the decline: the lifecycle ledger
+    records overrun(injected) -> poison(ring_overrun) ->
+    repack(ring_overrun) as ONE attributed chain, and the ring_overrun
+    flight bundle's "writepath" collector carries that ledger."""
+    cluster, conn, tpu, sid, priv = rig
+    conn.must("GO FROM 100 OVER like")
+    flight_rec.reset()
+    faults.set_plan("ring.overrun:n=1")
+    try:
+        conn.must("INSERT EDGE like(likeness) VALUES 105 -> 100:(5.0)")
+        conn.must("GO FROM 105 OVER like")       # pull hits the fault
+        deadline = time.time() + 10
+        while (wp.snapshots.view()["counts"].get("repack", 0) == 0
+               and time.time() < deadline):
+            conn.must("GO FROM 105 OVER like")
+            time.sleep(0.05)
+    finally:
+        faults.clear()
+    assert faults.counts().get("ring.overrun") == 1
+    assert priv.lifetime_total("write.ring.overrun") >= 1
+    causes = {}
+    for ev in wp.snapshots.view()["spaces"][sid]:
+        causes.setdefault(ev["event"], set()).add(ev.get("cause"))
+    assert "injected" in causes.get("overrun", ()), causes
+    assert "ring_overrun" in causes.get("poison", ()), causes
+    assert "ring_overrun" in causes.get("repack", ()), causes
+    assert flight_rec.flush()
+    bundles = [b for b in flight_rec.bundles
+               if b["trigger"] == "ring_overrun"]
+    assert bundles
+    col = bundles[-1]["collectors"]["writepath"]
+    assert col["ledger"]["counts"].get("overrun", 0) >= 1
+    # serving survived the poison: the edge reads back post-repack
+    r = conn.must("GO FROM 105 OVER like YIELD like._dst")
+    assert (100,) in r.rows
+
+
+def test_snapshots_view_shape(rig):
+    """/snapshots body: watermark + lifecycle ledger + ring occupancy
+    + per-engine snapshot status (graphd and storaged both serve it
+    through the webservice built-in)."""
+    cluster, conn, tpu, sid, priv = rig
+    conn.must("GO FROM 100 OVER like")
+    body = wp.snapshots_view()
+    assert body["enabled"] is True
+    assert {"watermark", "ledger", "rings", "engines"} <= set(body)
+    assert sid in body["rings"]
+    assert body["rings"][sid]["cap_ops"] > 0
+    eng = next(st for st in body["engines"]
+               if str(sid) in st["spaces"])
+    sp = eng["spaces"][str(sid)]
+    assert {"write_version", "stale", "device_bytes",
+            "repacking"} <= set(sp)
+    assert {"rebuilds", "bg_repacks", "delta_applies"} \
+        <= set(eng["counters"])
+    # the lifecycle ledger saw the build
+    assert wp.snapshots.view()["counts"].get("build", 0) >= 1
+
+
+def test_disarm_byte_identity(monkeypatch):
+    """write_obs_enabled=false BEFORE any armed traffic: the whole
+    load + write + read loop registers ZERO families on the stats
+    surface, /snapshots reports only {"enabled": false} and the gauge
+    source is empty — the heat_enabled/profile_hz=0 idiom."""
+    priv = StatsManager()
+    monkeypatch.setattr(wp, "stats", priv)
+    wp.reset()
+    graph_flags.set("write_obs_enabled", False)
+    storage_flags.set("write_obs_enabled", False)
+    try:
+        assert not wp.enabled()
+        tpu = TpuGraphEngine()
+        cluster = InProcCluster(tpu_engine=tpu)
+        _, conn = load_nba(cluster)
+        for i in range(6):
+            conn.must(f"INSERT EDGE like(likeness) VALUES "
+                      f"106 -> {100 + i}:(9.0)")
+            conn.must("GO FROM 106 OVER like")
+        assert not any(n.startswith(("write.", "snapshot.", "wal."))
+                       for n in priv.names())
+        assert wp.snapshots_view() == {"enabled": False}
+        assert wp.gauges() == {}
+        # the PR 12 cost ledger keeps its own contract: PROFILE still
+        # renders the write stages from the unconditional charges
+        r = conn.must("PROFILE INSERT EDGE like(likeness) "
+                      "VALUES 107 -> 100:(8.0)")
+        ws = (r.profile or {}).get("write_stages") or {}
+        assert {"execute", "fanout", "commit_apply"} <= set(ws), ws
+    finally:
+        graph_flags.set("write_obs_enabled", True)
+        storage_flags.set("write_obs_enabled", True)
+        wp.reset()
+
+
+def test_nested_fanout_charges_once(rig):
+    """DELETE VERTEX fans edge deletes through the same StorageClient;
+    the nested timed_stage("fanout") extents must not double-charge
+    (the reentrancy guard)."""
+    cluster, conn, tpu, sid, priv = rig
+    n0 = _hist_count(priv, "write.stage.fanout_us")
+    conn.must("DELETE VERTEX 110")
+    assert _hist_count(priv, "write.stage.fanout_us") == n0 + 1
